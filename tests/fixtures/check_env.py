@@ -1,0 +1,16 @@
+"""Asserts the executor env contract (reference check_env*.py analog)."""
+import json, os, sys
+
+def req(name):
+    v = os.environ.get(name)
+    assert v, f"missing env {name}"
+    return v
+
+job, idx = req("JOB_NAME"), int(req("TASK_INDEX"))
+spec = json.loads(req("CLUSTER_SPEC"))
+assert job in spec and len(spec[job]) > idx, f"{job}:{idx} not in spec {spec}"
+tf = json.loads(req("TF_CONFIG"))
+assert tf["task"] == {"type": job, "index": idx}, tf
+assert set(tf["cluster"]) == set(spec), (tf, spec)
+print("check_env: ok")
+sys.exit(0)

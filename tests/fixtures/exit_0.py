@@ -1,0 +1,2 @@
+"""Trivial success workload (reference test fixture exit_0.py analog)."""
+print("fixture: ok")

@@ -1,0 +1,4 @@
+"""Trivial failure workload (reference test fixture exit_1.py analog)."""
+import sys
+print("fixture: failing")
+sys.exit(1)

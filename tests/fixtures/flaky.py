@@ -1,0 +1,5 @@
+"""Fails on gang attempt 0, succeeds after a gang restart (elasticity fixture)."""
+import os, sys
+attempt = int(os.environ.get("TONY_RESTART_ATTEMPT", "0"))
+print(f"fixture: attempt {attempt}")
+sys.exit(1 if attempt == 0 else 0)

@@ -1,0 +1,4 @@
+"""Sleep-forever workload for heartbeat/untracked-kill paths (forever.py analog)."""
+import time
+while True:
+    time.sleep(0.5)

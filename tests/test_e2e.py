@@ -1,0 +1,198 @@
+"""End-to-end lifecycle tests: submit → AM → executors → user processes.
+
+The TestTonyE2E analog (SURVEY.md §4): no real cluster — the
+LocalResourceManager realizes containers as local subprocesses, and the
+"training" workloads are the tiny fixture scripts in tests/fixtures/
+asserting on the env contract, exactly the reference's strategy.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.cluster.client import Client
+from tony_tpu.cluster.session import JobStatus
+from tony_tpu.cluster import history
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+FAST = {
+    keys.AM_MONITOR_INTERVAL_MS: "50",
+    keys.TASK_HEARTBEAT_INTERVAL_MS: "100",
+    keys.AM_GANG_TIMEOUT_MS: "30000",
+}
+
+
+def fixture_cmd(name: str) -> str:
+    return f"{sys.executable} {os.path.join(FIXTURES, name)}"
+
+
+def run_job(tmp_tony_root, conf: dict) -> tuple[JobStatus, Client, object]:
+    cfg = TonyConfig({**FAST, keys.STAGING_ROOT: str(tmp_tony_root), **conf})
+    client = Client(cfg)
+    handle = client.submit()
+    final = client.monitor_application(handle, quiet=True)
+    return final, client, handle
+
+
+@pytest.mark.e2e
+class TestLifecycle:
+    def test_single_worker_success(self, tmp_tony_root):
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {"tony.worker.instances": "1", keys.EXECUTES: fixture_cmd("exit_0.py")},
+        )
+        assert final == JobStatus.SUCCEEDED
+        status = handle.final_status()
+        assert status["tasks"][0]["exit_code"] == 0
+
+    def test_multi_worker_gang(self, tmp_tony_root):
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {"tony.worker.instances": "3", keys.EXECUTES: fixture_cmd("check_env.py"),
+             keys.APPLICATION_FRAMEWORK: "tensorflow"},
+        )
+        assert final == JobStatus.SUCCEEDED, handle.final_status()
+
+    def test_failure_fails_job(self, tmp_tony_root):
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {"tony.worker.instances": "1", keys.EXECUTES: fixture_cmd("exit_1.py")},
+        )
+        assert final == JobStatus.FAILED
+        assert handle.final_status()["tasks"][0]["exit_code"] == 1
+
+    def test_untracked_forever_task_killed_at_end(self, tmp_tony_root):
+        # ps (untracked) sleeps forever; job ends when the tracked worker exits
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {
+                "tony.worker.instances": "1",
+                "tony.ps.instances": "1",
+                keys.EXECUTES: fixture_cmd("exit_0.py"),
+                "tony.ps.command": fixture_cmd("forever.py"),
+            },
+        )
+        assert final == JobStatus.SUCCEEDED
+        statuses = {f"{t['name']}": t["status"] for t in handle.final_status()["tasks"]}
+        assert statuses["worker"] == "SUCCEEDED"
+        assert statuses["ps"] in ("KILLED", "FAILED")
+
+    def test_history_written(self, tmp_tony_root):
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {"tony.worker.instances": "1", keys.EXECUTES: fixture_cmd("exit_0.py")},
+        )
+        assert final == JobStatus.SUCCEEDED
+        history_root = os.path.join(str(tmp_tony_root), "history")
+        jobs = history.list_finished_jobs(history_root)
+        assert [j.app_id for j in jobs] == [handle.app_id]
+        assert jobs[0].status == "SUCCEEDED"
+        types = [e.type.value for e in history.read_events(history_root, handle.app_id)]
+        assert types[0] == "APPLICATION_INITED"
+        assert "GANG_COMPLETE" in types
+        assert types[-1] == "APPLICATION_FINISHED"
+        # frozen config snapshot alongside (config.json)
+        dest = history.finished_dir(history_root, handle.app_id, jobs[0].completed_ms)
+        assert os.path.exists(os.path.join(dest, constants.CONFIG_SNAPSHOT_FILE))
+
+    def test_task_logs_captured(self, tmp_tony_root):
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {"tony.worker.instances": "1", keys.EXECUTES: fixture_cmd("exit_0.py")},
+        )
+        assert final == JobStatus.SUCCEEDED
+        log = os.path.join(handle.staging_dir, constants.TASK_LOG_DIRNAME, "worker_0", "stdout.log")
+        assert "fixture: ok" in open(log).read()
+
+
+@pytest.mark.e2e
+class TestFailureDetection:
+    def test_heartbeat_loss_marks_task_lost(self, tmp_tony_root, monkeypatch):
+        # fault injection: executor suppresses heartbeats → AM must declare LOST
+        monkeypatch.setenv("TONY_TEST_SUPPRESS_HEARTBEAT", "1")
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {
+                "tony.worker.instances": "1",
+                keys.EXECUTES: fixture_cmd("forever.py"),
+                keys.TASK_MAX_MISSED_HEARTBEATS: "3",
+            },
+        )
+        assert final == JobStatus.FAILED
+        assert handle.final_status()["tasks"][0]["status"] == "LOST"
+
+    def test_gang_restart_from_flaky_task(self, tmp_tony_root):
+        # rebuild-only elasticity: whole-gang restart after a tracked failure
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {
+                "tony.worker.instances": "1",
+                keys.EXECUTES: fixture_cmd("flaky.py"),
+                keys.TASK_RESTART_ON_FAILURE: "true",
+                keys.TASK_MAX_TOTAL_INSTANCE_FAILURES: "2",
+            },
+        )
+        assert final == JobStatus.SUCCEEDED
+        assert handle.final_status()["app_id"] == handle.app_id
+
+    def test_kill_application(self, tmp_tony_root):
+        cfg = TonyConfig(
+            {
+                **FAST,
+                keys.STAGING_ROOT: str(tmp_tony_root),
+                "tony.worker.instances": "1",
+                keys.EXECUTES: fixture_cmd("forever.py"),
+            }
+        )
+        client = Client(cfg)
+        handle = client.submit()
+        rpc = handle.rpc()
+        assert rpc is not None
+        # wait until the worker is running, then kill
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            infos = rpc.call("get_task_infos")
+            if infos and infos[0]["status"] in ("REGISTERED", "RUNNING"):
+                break
+            time.sleep(0.1)
+        assert Client.kill(handle)
+        final = client.monitor_application(handle, quiet=True)
+        assert final == JobStatus.KILLED
+
+
+@pytest.mark.e2e
+class TestSchedulingE2E:
+    def test_dependency_ordering_ps_before_worker(self, tmp_tony_root):
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {
+                "tony.ps.instances": "1",
+                "tony.worker.instances": "1",
+                "tony.ps.command": fixture_cmd("forever.py"),
+                keys.EXECUTES: fixture_cmd("exit_0.py"),
+                keys.dependency_key("worker", "ps"): "20s",
+            },
+        )
+        assert final == JobStatus.SUCCEEDED
+        # event order: ps TASK_STARTED strictly before worker TASK_STARTED
+        history_root = os.path.join(str(tmp_tony_root), "history")
+        evs = history.read_events(history_root, handle.app_id)
+        started = [e.payload["task"] for e in evs if e.type.value == "TASK_STARTED"]
+        assert started.index("ps:0") < started.index("worker:0")
+
+    def test_allocation_failure_fails_job(self, tmp_tony_root):
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {
+                "tony.worker.instances": "2",
+                "tony.worker.memory": "48g",   # 2x48g > 64g host
+                keys.EXECUTES: fixture_cmd("exit_0.py"),
+            },
+        )
+        assert final == JobStatus.FAILED
+        assert "memory" in (handle.final_status().get("reason") or "")

@@ -1,0 +1,62 @@
+"""Event stream + history file tests (TestHistoryFileUtils analog, SURVEY.md §4)."""
+
+import json
+import os
+
+import pytest
+
+from tony_tpu.cluster.events import Event, EventHandler, EventType
+from tony_tpu.cluster import history
+
+
+class TestHistoryFilenameCodec:
+    def test_roundtrip(self):
+        h = history.HistoryFileName("application_123_abc", 100, 200, "alice", "SUCCEEDED")
+        assert history.HistoryFileName.parse(h.render()) == h
+
+    def test_app_id_with_dashes(self):
+        h = history.HistoryFileName("app-with-dashes", 1, 2, "bob", "FAILED")
+        assert history.HistoryFileName.parse(h.render()).app_id == "app-with-dashes"
+
+
+class TestEventHandler:
+    def test_events_drained_to_jsonl(self, tmp_path):
+        eh = EventHandler(str(tmp_path), "app1")
+        eh.start()
+        eh.emit(EventType.APPLICATION_INITED, app_id="app1")
+        eh.emit(EventType.TASK_STARTED, task="worker:0")
+        eh.stop()
+        lines = open(eh.intermediate_path).read().splitlines()
+        assert len(lines) == 2
+        evs = [Event.from_json(line) for line in lines]
+        assert evs[0].type == EventType.APPLICATION_INITED
+        assert evs[1].payload == {"task": "worker:0"}
+
+    def test_finalize_moves_and_snapshots_config(self, tmp_path):
+        eh = EventHandler(str(tmp_path), "app2")
+        eh.start()
+        eh.emit(EventType.APPLICATION_FINISHED, status="SUCCEEDED")
+        eh.stop()
+        dest = history.finalize_history(
+            str(tmp_path), "app2", eh.intermediate_path, 100, 200, "SUCCEEDED",
+            config_snapshot={"tony.worker.instances": "1"}, user="tester",
+        )
+        assert os.path.exists(dest)
+        assert not os.path.exists(eh.intermediate_path)
+        cfg = json.load(open(os.path.join(os.path.dirname(dest), "config.json")))
+        assert cfg["tony.worker.instances"] == "1"
+
+        jobs = history.list_finished_jobs(str(tmp_path))
+        assert [j.app_id for j in jobs] == ["app2"]
+        evs = history.read_events(str(tmp_path), "app2")
+        assert evs[-1].type == EventType.APPLICATION_FINISHED
+
+    def test_read_events_intermediate(self, tmp_path):
+        eh = EventHandler(str(tmp_path), "app3")
+        eh.start()
+        eh.emit(EventType.TASK_STARTED, task="w:0")
+        eh.stop()
+        assert history.read_events(str(tmp_path), "app3")[0].type == EventType.TASK_STARTED
+
+    def test_missing_app_gives_empty(self, tmp_path):
+        assert history.read_events(str(tmp_path), "ghost") == []

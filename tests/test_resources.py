@@ -1,0 +1,110 @@
+"""TPU-slice resource model tests: topology parsing, ICI-contiguous rectangle
+allocation (the GPU-scheduling analog of TestTaskScheduler, SURVEY.md §4)."""
+
+import pytest
+
+from tony_tpu.cluster.resources import (
+    AllocationError,
+    ChipGrid,
+    LocalResourceManager,
+    Resources,
+    SliceSpec,
+    squarish_topology,
+)
+
+
+class TestSliceSpec:
+    @pytest.mark.parametrize(
+        "spec,accel,topo",
+        [
+            ("v5e-64", "v5e", (8, 8)),
+            ("v5e-8", "v5e", (2, 4)),
+            ("v5e-256", "v5e", (16, 16)),
+            ("v5e,4x8", "v5e", (4, 8)),
+            ("cpu", "cpu", (0, 0)),
+        ],
+    )
+    def test_parse(self, spec, accel, topo):
+        s = SliceSpec.parse(spec)
+        assert (s.accelerator, s.topology) == (accel, topo)
+
+    def test_chips(self):
+        assert SliceSpec.parse("v5e-64").chips == 64
+        assert SliceSpec.parse("cpu").chips == 0
+
+    def test_squarish(self):
+        assert squarish_topology(12) == (3, 4)
+        assert squarish_topology(7) == (1, 7)
+
+
+class TestChipGrid:
+    def test_rect_allocation_contiguous(self):
+        g = ChipGrid((4, 4))
+        coords = g.allocate_rect((2, 2))
+        rows = {r for r, _ in coords}
+        cols = {c for _, c in coords}
+        assert len(coords) == 4
+        # contiguity: the rectangle spans consecutive rows/cols (ICI affinity)
+        assert rows == set(range(min(rows), max(rows) + 1))
+        assert cols == set(range(min(cols), max(cols) + 1))
+
+    def test_exhaustion(self):
+        g = ChipGrid((2, 2))
+        assert g.allocate_rect((2, 2)) is not None
+        assert g.allocate_rect((1, 1)) is None
+
+    def test_release_reuses(self):
+        g = ChipGrid((2, 2))
+        coords = g.allocate_rect((2, 2))
+        g.release(coords)
+        assert g.allocate_rect((2, 2)) is not None
+
+    def test_orientation_fallback(self):
+        g = ChipGrid((2, 4))
+        assert g.allocate_rect((4, 2)) is not None  # rotated to fit
+
+    def test_allocate_chips_prefers_square(self):
+        g = ChipGrid((8, 8))
+        coords = g.allocate_chips(16)
+        rows = {r for r, _ in coords}
+        cols = {c for _, c in coords}
+        assert (len(rows), len(cols)) == (4, 4)
+
+    def test_fragmentation_respected(self):
+        g = ChipGrid((2, 4))
+        g.allocate_rect((2, 2))
+        assert g.allocate_chips(4) is not None   # 2x2 fits in the remainder
+        assert g.allocate_chips(2) is None       # full now
+
+
+class TestLocalResourceManager:
+    def test_allocate_sets_device_env(self):
+        rm = LocalResourceManager("local:v5e-8")
+        c = rm.allocate("worker", 0, Resources(chips=4))
+        env = c.device_env()
+        assert env["TPU_CHIPS_PER_TASK"] == "4"
+        assert env["TPU_SLICE_NAME"] == "v5e-8"
+        assert len(env["TPU_CHIP_COORDS"].split(";")) == 4
+
+    def test_chip_exhaustion_raises(self):
+        rm = LocalResourceManager("local:v5e-4")
+        rm.allocate("worker", 0, Resources(chips=4))
+        with pytest.raises(AllocationError):
+            rm.allocate("worker", 1, Resources(chips=1))
+
+    def test_release_returns_chips(self):
+        rm = LocalResourceManager("local:v5e-4")
+        c = rm.allocate("worker", 0, Resources(chips=4))
+        rm.release(c)
+        rm.allocate("worker", 1, Resources(chips=4))
+
+    def test_memory_accounting(self):
+        rm = LocalResourceManager("local:cpu", host_memory="4g")
+        rm.allocate("worker", 0, Resources(memory_bytes=3 * 1024**3))
+        with pytest.raises(AllocationError):
+            rm.allocate("worker", 1, Resources(memory_bytes=2 * 1024**3))
+
+    def test_cpu_pool_rejects_chip_asks(self):
+        rm = LocalResourceManager("local:cpu")
+        with pytest.raises(AllocationError):
+            rm.allocate("worker", 0, Resources(chips=4))

@@ -1,0 +1,126 @@
+"""Runtime adapter env-contract tests (SURVEY.md §2.2 parity)."""
+
+import json
+
+import pytest
+
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.runtime import Framework, get_runtime
+from tony_tpu.runtime.jax_runtime import canonical_task_order, coordinator_address, global_rank
+
+SPEC = {
+    "ps": ["h1:10", "h2:20"],
+    "worker": ["h3:30", "h3:31", "h4:40"],
+}
+CHIEF_SPEC = {"chief": ["c:1"], "worker": ["w:2"]}
+
+
+def runtime_for(framework: str, extra: dict | None = None):
+    cfg = TonyConfig({keys.APPLICATION_FRAMEWORK: framework, **(extra or {})})
+    return get_runtime(cfg)
+
+
+class TestFactory:
+    @pytest.mark.parametrize("name", ["jax", "tensorflow", "pytorch", "horovod", "mxnet", "generic"])
+    def test_selects(self, name):
+        assert runtime_for(name) is not None
+
+    def test_unknown_framework_raises(self):
+        cfg = TonyConfig({keys.APPLICATION_FRAMEWORK: "caffe"})
+        with pytest.raises(ValueError, match="unknown"):
+            Framework.from_config(cfg)
+
+
+class TestCanonicalOrder:
+    def test_chief_first(self):
+        assert canonical_task_order(CHIEF_SPEC)[0] == ("chief", 0)
+        assert coordinator_address(CHIEF_SPEC) == "c:1"
+
+    def test_rank_stable(self):
+        order = canonical_task_order(SPEC)
+        assert order == [("ps", 0), ("ps", 1), ("worker", 0), ("worker", 1), ("worker", 2)]
+        assert global_rank(SPEC, "worker", 2) == 4
+
+
+class TestBaseContract:
+    def test_generic_env(self):
+        env = runtime_for("generic").executor_env(SPEC, "worker", 1)
+        assert env["JOB_NAME"] == "worker"
+        assert env["TASK_INDEX"] == "1"
+        assert env["TASK_NUM"] == "3"
+        assert env["DISTRIBUTED_MODE"] == "GANG"
+        assert json.loads(env["CLUSTER_SPEC"]) == SPEC
+
+    def test_single_node_mode(self):
+        env = runtime_for("generic").executor_env({"worker": ["h:1"]}, "worker", 0)
+        assert env["DISTRIBUTED_MODE"] == "SINGLE_NODE"
+
+
+class TestTFRuntime:
+    def test_tf_config_shape(self):
+        env = runtime_for("tensorflow").executor_env(SPEC, "worker", 1)
+        tf = json.loads(env["TF_CONFIG"])
+        assert tf["cluster"] == SPEC
+        assert tf["task"] == {"type": "worker", "index": 1}
+
+    def test_tensorboard_excluded_from_cluster(self):
+        spec = dict(SPEC, tensorboard=["tb:99"])
+        tf = json.loads(runtime_for("tensorflow").executor_env(spec, "worker", 0)["TF_CONFIG"])
+        assert "tensorboard" not in tf["cluster"]
+
+
+class TestTorchRuntime:
+    def test_rendezvous_env(self):
+        env = runtime_for("pytorch").executor_env(SPEC, "worker", 1)
+        assert env["MASTER_ADDR"] == "h1"
+        assert env["MASTER_PORT"] == "10"
+        assert env["RANK"] == "3"
+        assert env["WORLD_SIZE"] == "5"
+        assert env["INIT_METHOD"] == "tcp://h1:10"
+
+
+class TestJaxRuntime:
+    def test_coordinator_contract(self):
+        env = runtime_for("jax").executor_env(SPEC, "ps", 0)
+        assert env["JAX_COORDINATOR_ADDRESS"] == "h1:10"
+        assert env["JAX_PROCESS_ID"] == "0"
+        assert env["JAX_NUM_PROCESSES"] == "5"
+
+
+class TestHorovodRuntime:
+    def test_slot_plan(self):
+        from tony_tpu.cluster.session import Session
+
+        cfg = TonyConfig(
+            {
+                keys.APPLICATION_FRAMEWORK: "horovod",
+                "tony.worker.instances": "3",
+            }
+        )
+        rt = get_runtime(cfg)
+        session = Session(cfg)
+        # two tasks share h3 → local ranks 0/1; h4 is cross-rank 1
+        session.register_worker_spec("worker", 0, "h3", 30)
+        session.register_worker_spec("worker", 1, "h3", 31)
+        session.register_worker_spec("worker", 2, "h4", 40)
+        rt.on_gang_complete(session)
+
+        e0 = rt.am_extra_env(session, "worker", 0)
+        e1 = rt.am_extra_env(session, "worker", 1)
+        e2 = rt.am_extra_env(session, "worker", 2)
+        assert (e0["HOROVOD_RANK"], e1["HOROVOD_RANK"], e2["HOROVOD_RANK"]) == ("0", "1", "2")
+        assert (e0["HOROVOD_LOCAL_RANK"], e1["HOROVOD_LOCAL_RANK"]) == ("0", "1")
+        assert e0["HOROVOD_LOCAL_SIZE"] == "2"
+        assert e2["HOROVOD_CROSS_RANK"] == "1"
+        assert e0["HOROVOD_SIZE"] == "3"
+        assert e0["HOROVOD_GLOO_RENDEZVOUS_ADDR"] == "h3"
+
+
+class TestMXNetRuntime:
+    def test_dmlc_env(self):
+        env = runtime_for("mxnet").executor_env(SPEC, "ps", 1)
+        assert env["DMLC_ROLE"] == "server"
+        assert env["DMLC_PS_ROOT_URI"] == "h1"
+        assert env["DMLC_NUM_SERVER"] == "2"
+        assert env["DMLC_NUM_WORKER"] == "3"
+        assert runtime_for("mxnet").executor_env(SPEC, "worker", 0)["DMLC_ROLE"] == "worker"

@@ -1,0 +1,68 @@
+"""Gang/dependency scheduler tests (TestTaskScheduler analog, SURVEY.md §4)."""
+
+import pytest
+
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.cluster.resources import AllocationError, LocalResourceManager
+from tony_tpu.cluster.scheduler import DependencyTimeout, TaskScheduler
+from tony_tpu.cluster.session import Session
+
+
+def build(conf: dict, pool="local:cpu"):
+    cfg = TonyConfig(conf)
+    session = Session(cfg)
+    rm = LocalResourceManager(pool)
+    return TaskScheduler(cfg, session, rm), session, rm
+
+
+class TestDependencyOrdering:
+    CONF = {
+        "tony.ps.instances": "1",
+        "tony.worker.instances": "2",
+        keys.dependency_key("worker", "ps"): "10s",
+    }
+
+    def test_worker_waits_for_ps(self):
+        sched, session, _ = build(self.CONF)
+        assert sched.ready_types() == ["ps"]
+        sched.allocate_type("ps")
+        assert sched.ready_types() == []  # ps allocated but not registered yet
+        session.register_worker_spec("ps", 0, "h", 1)
+        assert sched.ready_types() == ["worker"]
+
+    def test_dependency_timeout_raises(self):
+        conf = dict(self.CONF)
+        conf[keys.dependency_key("worker", "ps")] = "0ms"
+        sched, _, _ = build(conf)
+        sched.allocate_type("ps")
+        import time
+
+        sched.ready_types()  # starts the wait clock
+        time.sleep(0.01)
+        with pytest.raises(DependencyTimeout):
+            sched.ready_types()
+
+    def test_undeclared_dependency_rejected(self):
+        with pytest.raises(ValueError):
+            build({
+                "tony.worker.instances": "1",
+                keys.dependency_key("worker", "ghost"): "1s",
+            })
+
+
+class TestGangAllocation:
+    def test_all_or_nothing(self):
+        # 4-chip pool, 2 workers x 4 chips: second alloc fails → first released
+        sched, _, rm = build(
+            {"tony.worker.instances": "2", "tony.worker.chips": "4"}, pool="local:v5e-4"
+        )
+        with pytest.raises(AllocationError):
+            sched.allocate_type("worker")
+        assert rm.grid.free == 4  # nothing leaked
+
+    def test_no_dependencies_all_ready_in_priority_order(self):
+        sched, _, _ = build({"tony.worker.instances": "1", "tony.evaluator.instances": "1"})
+        assert sched.ready_types() == ["evaluator", "worker"]  # declared order
+        sched.allocate_type("evaluator")
+        sched.allocate_type("worker")
+        assert sched.all_launched()

@@ -1,0 +1,96 @@
+"""Session state machine unit tests (TestTonySession analog, SURVEY.md §4)."""
+
+import pytest
+
+from tony_tpu.config import TonyConfig
+from tony_tpu.cluster.session import JobStatus, Session, TaskStatus
+
+
+def make_session(**types):
+    cfg = TonyConfig({f"tony.{t}.instances": str(n) for t, n in types.items()})
+    return Session(cfg)
+
+
+class TestGangBarrier:
+    def test_spec_incomplete_until_all_register(self):
+        s = make_session(ps=1, worker=2)
+        assert s.cluster_spec() is None
+        s.register_worker_spec("ps", 0, "h1", 1000)
+        s.register_worker_spec("worker", 0, "h2", 2000)
+        assert not s.cluster_spec_complete()
+        s.register_worker_spec("worker", 1, "h3", 3000)
+        spec = s.cluster_spec()
+        assert spec == {"ps": ["h1:1000"], "worker": ["h2:2000", "h3:3000"]}
+
+    def test_spec_ordered_by_index(self):
+        s = make_session(worker=2)
+        s.register_worker_spec("worker", 1, "b", 2)
+        s.register_worker_spec("worker", 0, "a", 1)
+        assert s.cluster_spec() == {"worker": ["a:1", "b:2"]}
+
+    def test_unknown_task_rejected(self):
+        s = make_session(worker=1)
+        with pytest.raises(KeyError):
+            s.register_worker_spec("worker", 5, "h", 1)
+
+
+class TestVerdict:
+    def test_all_tracked_succeed(self):
+        s = make_session(worker=2)
+        s.on_task_completed("worker", 0, 0)
+        s.on_task_completed("worker", 1, 0)
+        assert s.tracked_all_terminal()
+        assert s.reduce_final_status() == JobStatus.SUCCEEDED
+
+    def test_any_tracked_failure_fails_job(self):
+        s = make_session(worker=2)
+        s.on_task_completed("worker", 0, 0)
+        s.on_task_completed("worker", 1, 3)
+        assert s.any_tracked_failed() is not None
+        assert s.reduce_final_status() == JobStatus.FAILED
+
+    def test_untracked_failure_ignored(self):
+        # ps is untracked by default: its exit never gates the verdict
+        s = make_session(ps=1, worker=1)
+        s.on_task_completed("ps", 0, 1)
+        s.on_task_completed("worker", 0, 0)
+        assert s.any_tracked_failed() is None
+        assert s.reduce_final_status() == JobStatus.SUCCEEDED
+
+    def test_completion_is_idempotent(self):
+        s = make_session(worker=1)
+        s.on_task_completed("worker", 0, 0)
+        s.on_task_completed("worker", 0, 7)  # late duplicate must not flip status
+        t = s.get_task("worker", 0)
+        assert t.status == TaskStatus.SUCCEEDED
+        assert t.exit_code == 0
+
+    def test_lost_task_fails_job(self):
+        s = make_session(worker=1)
+        s.register_worker_spec("worker", 0, "h", 1)
+        s.mark_lost(s.get_task("worker", 0))
+        assert s.reduce_final_status() == JobStatus.FAILED
+
+
+class TestHeartbeats:
+    def test_heartbeat_promotes_to_running(self):
+        s = make_session(worker=1)
+        s.register_worker_spec("worker", 0, "h", 1)
+        assert s.get_task("worker", 0).status == TaskStatus.REGISTERED
+        s.on_heartbeat("worker", 0)
+        assert s.get_task("worker", 0).status == TaskStatus.RUNNING
+
+    def test_dead_task_detection(self):
+        s = make_session(worker=1)
+        s.register_worker_spec("worker", 0, "h", 1)
+        t = s.get_task("worker", 0)
+        t.last_heartbeat_ms -= 10_000  # simulate silence
+        dead = s.find_dead_tasks(heartbeat_interval_ms=100, max_missed=5)
+        assert dead == [t]
+
+    def test_terminal_tasks_not_dead(self):
+        s = make_session(worker=1)
+        s.register_worker_spec("worker", 0, "h", 1)
+        s.on_task_completed("worker", 0, 0)
+        s.get_task("worker", 0).last_heartbeat_ms -= 10_000
+        assert s.find_dead_tasks(100, 5) == []

@@ -1,0 +1,28 @@
+"""tony-tpu: a TPU-native distributed-ML orchestration + training framework.
+
+A from-scratch rebuild of the capabilities of TonY (LinkedIn's "TensorFlow on
+YARN" orchestrator — reference layout: tony-core/src/main/java/com/linkedin/tony/),
+re-designed TPU-first:
+
+- control plane: ``tony_tpu.cluster`` — Client / ApplicationMaster / TaskExecutor
+  (analog of TonyClient.java / TonyApplicationMaster.java / TaskExecutor.java)
+  gang-scheduling **TPU slices** instead of GPU-labeled YARN containers.
+- runtime adapters: ``tony_tpu.runtime`` — analog of tony-core runtime/
+  (TFRuntime/PyTorchRuntime/HorovodRuntime/MXNetRuntime), bootstrapping
+  jax.distributed / TF_CONFIG / torch rendezvous env contracts.
+- parallelism: ``tony_tpu.parallel`` — the layer TonY delegated to user
+  frameworks, here first-class: mesh axes (data/fsdp/model/expert/context/stage),
+  FSDP, tensor/pipeline/expert/context parallelism over XLA collectives on
+  ICI/DCN.
+- compute: ``tony_tpu.ops`` (Pallas TPU kernels + XLA references) and
+  ``tony_tpu.models`` (MLP, BERT, ResNet, Llama, Mixtral).
+- training: ``tony_tpu.train`` — train-step builder, Orbax checkpointing,
+  MFU/throughput metrics.
+
+See SURVEY.md at the repo root for the full blueprint and reference citations.
+"""
+
+__version__ = "0.1.0"
+
+from tony_tpu import constants  # noqa: F401
+from tony_tpu.config import TonyConfig, keys  # noqa: F401

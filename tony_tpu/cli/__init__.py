@@ -1,0 +1,1 @@
+"""CLI front end (tony-cli analog)."""

@@ -1,0 +1,79 @@
+"""The ``tony`` command-line front end.
+
+Analog of the reference's ``tony-cli`` module (``ClusterSubmitter`` /
+``NotebookSubmitter`` — SURVEY.md §2.3): subcommands wrap the client and
+auxiliary services.
+
+    tony submit --conf_file job.xml --executes "python train.py"
+    tony history [--root DIR]
+    tony portal [--port N]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tony_tpu import constants
+
+
+def _cmd_submit(argv: list[str]) -> int:
+    from tony_tpu.cluster.client import main as client_main
+
+    return client_main(argv)
+
+
+def _cmd_history(argv: list[str]) -> int:
+    import argparse
+    import os
+
+    from tony_tpu.cluster import history
+
+    p = argparse.ArgumentParser(prog="tony history")
+    p.add_argument("--root", default=None, help="history root (default: $TONY_ROOT/history)")
+    p.add_argument("app_id", nargs="?", help="show events for one application")
+    args = p.parse_args(argv)
+    root = args.root or os.path.join(constants.default_tony_root(), "history")
+    if args.app_id:
+        for ev in history.read_events(root, args.app_id):
+            print(ev.to_json())
+        return 0
+    jobs = history.list_finished_jobs(root)
+    if not jobs:
+        print(f"no finished jobs under {root}")
+        return 0
+    for j in jobs:
+        dur_s = max(j.completed_ms - j.started_ms, 0) / 1000
+        print(f"{j.app_id}  {j.status:9s}  {dur_s:8.1f}s  user={j.user}")
+    return 0
+
+
+def _cmd_portal(argv: list[str]) -> int:
+    from tony_tpu.portal.server import main as portal_main
+
+    return portal_main(argv)
+
+
+_COMMANDS = {
+    "submit": _cmd_submit,
+    "history": _cmd_history,
+    "portal": _cmd_portal,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: tony {submit|history|portal} [options]\n")
+        print("  submit   submit and monitor a job (tony submit --help)")
+        print("  history  list finished jobs / dump one job's events")
+        print("  portal   serve the history web portal")
+        return 0
+    cmd = _COMMANDS.get(argv[0])
+    if cmd is None:
+        print(f"tony: unknown command {argv[0]!r} (expected one of {sorted(_COMMANDS)})", file=sys.stderr)
+        return 2
+    return cmd(argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Control plane: Client / ApplicationMaster / TaskExecutor + scheduling.
+
+The L2-L4 analog of the reference (SURVEY.md §1): submission, the per-job
+application master with its RPC surface and gang scheduler, the per-container
+executor, and the TPU-slice resource model.
+"""
+
+from tony_tpu.cluster.client import ApplicationHandle, Client  # noqa: F401
+from tony_tpu.cluster.resources import (  # noqa: F401
+    ChipGrid,
+    Container,
+    LocalResourceManager,
+    ResourceManager,
+    Resources,
+    SliceSpec,
+)
+from tony_tpu.cluster.session import JobStatus, Session, Task, TaskStatus  # noqa: F401

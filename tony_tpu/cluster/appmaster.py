@@ -1,0 +1,416 @@
+"""The Application Master: per-job control plane.
+
+Analog of the reference's ``TonyApplicationMaster.java`` (SURVEY.md §2.1,
+§3.1): runs inside the cluster (here: a subprocess the client spawns, playing
+YARN-RM-launches-AM), serves the ApplicationRpc surface, drives the
+gang/dependency scheduler against a ResourceManager, launches a TaskExecutor
+per container, monitors heartbeats, reduces the tracked/untracked verdict,
+emits history events, and finalizes the ``.jhist`` on exit.
+
+Implicit invariants carried over from the reference (SURVEY.md §7 hard part
+(e)): registration-before-spec (the gang barrier), idempotent task completion,
+tracked/untracked verdict reduction, untracked tasks killed at job end.
+
+Rebuild-only addition (SURVEY.md §5.3/§5.4): optional whole-gang restart on
+task failure (``tony.task.restart-on-failure``) so jobs resume from their
+latest checkpoint instead of failing fast.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import secrets as _secrets
+import socket
+import sys
+import time
+from typing import Any
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.cluster import history
+from tony_tpu.cluster.events import EventHandler, EventType
+from tony_tpu.cluster.resources import (
+    AllocationError,
+    Container,
+    LocalResourceManager,
+    ResourceManager,
+)
+from tony_tpu.cluster.scheduler import DependencyTimeout, TaskScheduler
+from tony_tpu.cluster.rpc import APPLICATION_RPC_METHODS, RpcServer
+from tony_tpu.cluster.session import JobStatus, Session, TaskStatus
+from tony_tpu.runtime import get_runtime
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_resource_manager(config: TonyConfig) -> ResourceManager:
+    """Pool factory: 'local:<accel>[,RxC]' → LocalResourceManager.
+
+    The spec string lives in ``tony.tpu.pool`` so the same frozen config
+    drives tests (cpu pool), one TPU VM (v5e-1..8), or a future remote pool.
+    """
+    spec = config.get(keys.TPU_POOL_SPEC) or "local:cpu"
+    if spec.startswith("local:"):
+        return LocalResourceManager(spec)
+    raise ValueError(f"unknown resource pool spec: {spec!r}")
+
+
+class ApplicationMaster:
+    def __init__(
+        self,
+        config: TonyConfig,
+        app_id: str,
+        staging_dir: str,
+        rm: ResourceManager | None = None,
+    ):
+        self.config = config
+        self.app_id = app_id
+        self.staging_dir = staging_dir
+        self.rm = rm or build_resource_manager(config)
+        self.runtime = get_runtime(config)
+        self.session = Session(config)
+        self.scheduler = TaskScheduler(config, self.session, self.rm)
+        self.secret = _secrets.token_hex(16)
+        self.rpc = RpcServer(host=_local_host(), port=config.get_int(keys.AM_RPC_PORT, 0), secret=self.secret)
+        history_root = config.get(keys.HISTORY_LOCATION) or os.path.join(
+            os.path.dirname(staging_dir.rstrip("/")), "history"
+        )
+        self.history_root = history_root
+        self.events = EventHandler(history_root, app_id)
+        self.started_ms = int(time.time() * 1000)
+        self.tensorboard_url: str | None = None
+        self._kill_requested = False
+        self._containers: dict[str, Container] = {}          # container_id → Container
+        self._by_task: dict[tuple[str, int], Container] = {}  # (job, idx) → Container
+        self._gang_started_ms: float | None = None
+        self._restart_attempt = 0
+        self._failures_seen = 0
+        self._gang_complete_fired = False
+
+    # ------------------------------------------------------------------ rpc
+    def _stale(self, attempt: int) -> bool:
+        """Fence RPCs from executors of a killed previous gang attempt: their
+        (job_name, index) identities recur, so without the epoch a dying old
+        executor could poison the replacement session's state."""
+        return attempt != self._restart_attempt
+
+    def register_worker_spec(
+        self, job_name: str, index: int, host: str, port: int, attempt: int = 0
+    ) -> dict[str, Any]:
+        if self._stale(attempt):
+            return {"spec_complete": False, "stale": True}
+        self.session.register_worker_spec(job_name, index, host, port)
+        self.events.emit(EventType.TASK_REGISTERED, task=f"{job_name}:{index}", host=host, port=port)
+        complete = self.session.cluster_spec_complete()
+        if complete and not self._gang_complete_fired:
+            self._gang_complete_fired = True
+            self.runtime.on_gang_complete(self.session)
+            self.events.emit(EventType.GANG_COMPLETE, tasks=self.session.total_tasks())
+        return {"spec_complete": complete}
+
+    def get_cluster_spec(self, job_name: str, index: int) -> dict[str, Any]:
+        spec = self.session.cluster_spec()
+        if spec is None or not self._gang_complete_fired:
+            return {"spec": None}
+        return {
+            "spec": spec,
+            "extra_env": self.runtime.am_extra_env(self.session, job_name, index),
+            "restart_attempt": self._restart_attempt,
+        }
+
+    def register_execution_result(
+        self, job_name: str, index: int, exit_code: int, attempt: int = 0
+    ) -> dict[str, Any]:
+        if self._stale(attempt):
+            return {"ack": False, "stale": True}
+        self.session.on_task_completed(job_name, index, exit_code)
+        self.events.emit(EventType.TASK_FINISHED, task=f"{job_name}:{index}", exit_code=exit_code)
+        return {"ack": True}
+
+    def register_tensorboard_url(self, url: str) -> dict[str, Any]:
+        self.tensorboard_url = url
+        return {"ack": True}
+
+    def task_executor_heartbeat(self, job_name: str, index: int, attempt: int = 0) -> dict[str, Any]:
+        if self._stale(attempt):
+            return {"ack": False, "stale": True}
+        self.session.on_heartbeat(job_name, index)
+        return {"ack": True}
+
+    def get_task_infos(self) -> list[dict[str, Any]]:
+        return self.session.task_infos()
+
+    def get_application_status(self) -> dict[str, Any]:
+        st = self.session.job_status
+        return {
+            "app_id": self.app_id,
+            "state": st.value,
+            "final": st not in (JobStatus.NEW, JobStatus.RUNNING),
+            "reason": self.session.failure_reason,
+            "tensorboard_url": self.tensorboard_url,
+            "restart_attempt": self._restart_attempt,
+        }
+
+    def finish_application(self) -> dict[str, Any]:
+        self._kill_requested = True
+        return {"ack": True}
+
+    def push_metrics(
+        self, job_name: str, index: int, metrics: dict[str, Any], attempt: int = 0
+    ) -> dict[str, Any]:
+        if self._stale(attempt):
+            return {"ack": False, "stale": True}
+        with self.session.lock:
+            self.session.get_task(job_name, index).metrics = metrics
+        return {"ack": True}
+
+    # ------------------------------------------------------------ lifecycle
+    def prepare(self) -> None:
+        self.runtime.validate()
+        self.rpc.register_object(self, APPLICATION_RPC_METHODS)
+        self.rpc.start()
+        self.events.start()
+        self.events.emit(
+            EventType.APPLICATION_INITED,
+            app_id=self.app_id,
+            job_types={t: self.config.instances(t) for t in self.config.job_types()},
+        )
+        host, port = self.rpc.address
+        info = {"host": host, "port": port, "secret": self.secret, "pid": os.getpid()}
+        _atomic_write_json(os.path.join(self.staging_dir, constants.AM_INFO_FILE), info)
+        self.session.job_status = JobStatus.RUNNING
+
+    def _launch_type(self, job_type: str) -> None:
+        for container in self.scheduler.allocate_type(job_type):
+            task = self.session.get_task(job_type, container.task_index)
+            task.status = TaskStatus.SCHEDULED
+            task.container_id = container.id
+            task.chip_coords = container.chip_coords
+            task.start_time_ms = int(time.time() * 1000)
+            self._containers[container.id] = container
+            self._by_task[(job_type, container.task_index)] = container
+            self._start_executor(container)
+            self.events.emit(
+                EventType.TASK_STARTED,
+                task=task.id,
+                container=container.id,
+                chips=len(container.chip_coords),
+            )
+        if self._gang_started_ms is None:
+            self._gang_started_ms = time.time() * 1000
+
+    def _start_executor(self, container: Container) -> None:
+        log_dir = os.path.join(
+            self.staging_dir,
+            constants.TASK_LOG_DIRNAME,
+            f"{container.job_type}_{container.task_index}"
+            + (f"_r{self._restart_attempt}" if self._restart_attempt else ""),
+        )
+        task = self.session.get_task(container.job_type, container.task_index)
+        task.log_dir = log_dir
+        host, port = self.rpc.address
+        env = dict(os.environ)
+        env.update(container.device_env())
+        env.update(
+            {
+                constants.ENV_APP_ID: self.app_id,
+                constants.ENV_AM_HOST: host,
+                constants.ENV_AM_PORT: str(port),
+                constants.ENV_AM_SECRET: self.secret,
+                constants.ENV_STAGING_DIR: self.staging_dir,
+                constants.ENV_JOB_NAME: container.job_type,
+                constants.ENV_TASK_INDEX: str(container.task_index),
+                "TONY_RESTART_ATTEMPT": str(self._restart_attempt),
+                "PYTHONPATH": _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+            }
+        )
+        cmd = [sys.executable, "-u", "-m", "tony_tpu.cluster.executor"]
+        self.rm.start_container(container, cmd, env, log_dir)
+
+    def _fail(self, reason: str) -> None:
+        self.session.failure_reason = self.session.failure_reason or reason
+        self.session.job_status = JobStatus.FAILED
+
+    def _kill_all_containers(self) -> None:
+        for c in list(self._containers.values()):
+            self.rm.kill_container(c)
+
+    def _handle_container_exits(self) -> None:
+        """NM container-completed callback analog: catches executors that died
+        without RPC-reporting a result (OOM-kill, crash, SIGKILL)."""
+        for cid, rc in self.rm.poll_exited().items():
+            c = self._containers.get(cid)
+            if c is None:
+                continue
+            task = self.session.get_task(c.job_type, c.task_index)
+            if not task.status.terminal:
+                self.session.on_task_completed(c.job_type, c.task_index, rc)
+                self.events.emit(
+                    EventType.TASK_FINISHED, task=task.id, exit_code=rc, source="container-exit"
+                )
+
+    def _maybe_restart_gang(self, reason: str) -> bool:
+        """Whole-gang restart from checkpoint (rebuild-only elasticity)."""
+        if not self.config.get_bool(keys.TASK_RESTART_ON_FAILURE):
+            return False
+        budget = self.config.get_int(keys.TASK_MAX_TOTAL_INSTANCE_FAILURES, 0)
+        self._failures_seen += 1
+        if self._failures_seen > budget:
+            return False
+        self.events.emit(EventType.HEARTBEAT_LOST, reason=f"gang restart: {reason}")
+        self._kill_all_containers()
+        for c in list(self._containers.values()):
+            self.rm.release(c)
+        self._containers.clear()
+        self._by_task.clear()
+        self._restart_attempt += 1
+        self._gang_complete_fired = False
+        self._gang_started_ms = None
+        self.session = Session(self.config)
+        self.session.job_status = JobStatus.RUNNING
+        self.scheduler = TaskScheduler(self.config, self.session, self.rm)
+        return True
+
+    def run(self) -> JobStatus:
+        """The AM monitor loop (SURVEY.md §3.1 middle block)."""
+        interval_s = self.config.get_time_ms(keys.AM_MONITOR_INTERVAL_MS, 200) / 1000
+        hb_interval = self.config.get_time_ms(keys.TASK_HEARTBEAT_INTERVAL_MS, 1000)
+        hb_max_missed = self.config.get_int(keys.TASK_MAX_MISSED_HEARTBEATS, 25)
+        gang_timeout = self.config.get_time_ms(keys.AM_GANG_TIMEOUT_MS, 300_000)
+
+        while True:
+            if self._kill_requested:
+                self._kill_all_containers()
+                for t in self.session.all_tasks():
+                    self.session.mark_killed(t)
+                self.session.job_status = JobStatus.KILLED
+                break
+
+            # 1. launch job types whose dependencies are satisfied
+            try:
+                for job_type in self.scheduler.ready_types():
+                    self._launch_type(job_type)
+            except (DependencyTimeout, AllocationError) as e:
+                self._fail(str(e))
+                self._kill_all_containers()
+                break
+
+            # 2. container exits (catches silent executor death)
+            self._handle_container_exits()
+
+            # 3. heartbeat liveness
+            for t in self.session.find_dead_tasks(hb_interval, hb_max_missed):
+                self.session.mark_lost(t)
+                self.events.emit(EventType.HEARTBEAT_LOST, task=t.id)
+                c = self._by_task.get((t.job_name, t.index))
+                if c is not None:
+                    self.rm.kill_container(c)
+
+            # 4. gang-registration timeout
+            if (
+                not self.session.cluster_spec_complete()
+                and self._gang_started_ms is not None
+                and self.scheduler.all_launched()
+                and time.time() * 1000 - self._gang_started_ms > gang_timeout
+            ):
+                self._fail(f"gang incomplete after {gang_timeout}ms "
+                           f"({self.session.registered_count()}/{self.session.total_tasks()} registered)")
+                self._kill_all_containers()
+                break
+
+            # 5. fail-fast on tracked failure (or gang-restart if enabled)
+            failed = self.session.any_tracked_failed()
+            if failed is not None:
+                if self._maybe_restart_gang(f"task {failed.id} {failed.status.value}"):
+                    continue
+                self._fail(f"tracked task {failed.id} {failed.status.value} "
+                           f"(exit_code={failed.exit_code})")
+                self._kill_all_containers()
+                for t in self.session.all_tasks():
+                    self.session.mark_killed(t)
+                break
+
+            # 6. normal completion: all tracked done → kill untracked, reduce
+            if self.session.tracked_all_terminal() or (
+                not self.session.tracked_tasks()
+                and all(t.status.terminal for t in self.session.all_tasks())
+            ):
+                for t in self.session.untracked_tasks():
+                    if not t.status.terminal:
+                        c = self._by_task.get((t.job_name, t.index))
+                        if c is not None:
+                            self.rm.kill_container(c)
+                        self.session.mark_killed(t)
+                break
+
+            time.sleep(interval_s)
+
+        return self.stop()
+
+    def stop(self) -> JobStatus:
+        final = self.session.reduce_final_status()
+        completed_ms = int(time.time() * 1000)
+        self.events.emit(
+            EventType.APPLICATION_FINISHED,
+            status=final.value,
+            reason=self.session.failure_reason,
+            tasks=self.session.task_infos(),
+        )
+        self.events.stop()
+        try:
+            history.finalize_history(
+                self.history_root,
+                self.app_id,
+                self.events.intermediate_path,
+                self.started_ms,
+                completed_ms,
+                final.value,
+                config_snapshot=self.config.to_dict(),
+            )
+        except OSError:
+            pass  # history must never change the job verdict
+        _atomic_write_json(
+            os.path.join(self.staging_dir, "am_status.json"),
+            {
+                "app_id": self.app_id,
+                "status": final.value,
+                "reason": self.session.failure_reason,
+                "started_ms": self.started_ms,
+                "completed_ms": completed_ms,
+                "tensorboard_url": self.tensorboard_url,
+                "tasks": self.session.task_infos(),
+            },
+        )
+        self.rpc.stop()
+        self.rm.shutdown()
+        return final
+
+
+def _local_host() -> str:
+    return os.environ.get("TONY_BIND_HOST", "127.0.0.1")
+
+
+def _atomic_write_json(path: str, obj: Any) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tony-am")
+    p.add_argument("--app-id", required=True)
+    p.add_argument("--staging-dir", required=True)
+    args = p.parse_args(argv)
+    config = TonyConfig.load_final(os.path.join(args.staging_dir, constants.TONY_FINAL_CONF))
+    am = ApplicationMaster(config, args.app_id, args.staging_dir)
+    am.prepare()
+    final = am.run()
+    return constants.EXIT_SUCCESS if final == JobStatus.SUCCEEDED else constants.EXIT_FAILURE
+
+
+if __name__ == "__main__":
+    sys.exit(main())

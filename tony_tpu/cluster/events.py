@@ -1,0 +1,100 @@
+"""Job event stream and history writing.
+
+Analog of the reference's ``tony-core/.../tony/events/`` (Avro ``Event{type,
+payload, timestamp}`` records drained by an ``EventHandler`` thread into a
+``.jhist`` file in an HDFS intermediate dir, moved to
+``finished/yyyy/MM/dd/<appId>/`` on completion — SURVEY.md §2.1, §5.5).
+
+TPU-native carrier: JSONL instead of Avro (self-describing, zero schema
+tooling, portal/CLI-greppable), local/shared filesystem instead of HDFS.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from tony_tpu import constants
+
+
+class EventType(enum.Enum):
+    APPLICATION_INITED = "APPLICATION_INITED"
+    TASK_SCHEDULED = "TASK_SCHEDULED"
+    TASK_STARTED = "TASK_STARTED"
+    TASK_REGISTERED = "TASK_REGISTERED"
+    TASK_FINISHED = "TASK_FINISHED"
+    HEARTBEAT_LOST = "HEARTBEAT_LOST"
+    GANG_COMPLETE = "GANG_COMPLETE"
+    METRICS_SNAPSHOT = "METRICS_SNAPSHOT"
+    APPLICATION_FINISHED = "APPLICATION_FINISHED"
+
+
+@dataclass
+class Event:
+    type: EventType
+    payload: dict[str, Any] = field(default_factory=dict)
+    timestamp_ms: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.timestamp_ms:
+            self.timestamp_ms = int(time.time() * 1000)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"type": self.type.value, "timestamp_ms": self.timestamp_ms, "payload": self.payload}
+        )
+
+    @classmethod
+    def from_json(cls, line: str) -> "Event":
+        d = json.loads(line)
+        return cls(EventType(d["type"]), d.get("payload", {}), d.get("timestamp_ms", 0))
+
+
+class EventHandler:
+    """Queue-draining writer thread (reference EventHandler analog).
+
+    Events are appended (line-buffered JSONL) to
+    ``<history>/intermediate/<app_id>.jhist``; ``finalize()`` moves the file to
+    ``<history>/finished/yyyy/MM/dd/<app_id>/`` with the status-encoding
+    filename (history.py codec) and writes ``config.json`` alongside.
+    """
+
+    def __init__(self, history_root: str, app_id: str):
+        self.history_root = history_root
+        self.app_id = app_id
+        self._q: "queue.Queue[Event | None]" = queue.Queue()
+        self._path = os.path.join(history_root, constants.HISTORY_INTERMEDIATE_DIR, app_id + constants.HISTORY_SUFFIX)
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        self._file = open(self._path, "a", buffering=1)
+        self._thread = threading.Thread(target=self._drain, name="event-handler", daemon=True)
+        self._started = False
+
+    def start(self) -> None:
+        self._thread.start()
+        self._started = True
+
+    def emit(self, type_: EventType, **payload: Any) -> None:
+        self._q.put(Event(type_, payload))
+
+    def _drain(self) -> None:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                return
+            self._file.write(ev.to_json() + "\n")
+
+    def stop(self) -> None:
+        if self._started:
+            self._q.put(None)
+            self._thread.join(timeout=10)
+        self._file.close()
+
+    @property
+    def intermediate_path(self) -> str:
+        return self._path

@@ -1,0 +1,251 @@
+"""The per-container task executor.
+
+Analog of the reference's ``TaskExecutor.java`` (SURVEY.md §2.1, §3.1): runs
+inside a container, registers ``jobName:index`` + its rendezvous port with the
+AM, blocks on the gang barrier until the full cluster spec is available,
+applies the framework runtime's env contract, execs the user process via the
+shell, heartbeats and pushes metrics in the background, and reports the exit
+code back. The hot training loop lives entirely inside the user process — the
+executor never touches tensors.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.cluster.metrics import MetricsSampler
+from tony_tpu.cluster.rpc import RpcClient, RpcError
+from tony_tpu.runtime import get_runtime
+
+
+def pick_free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class TaskExecutor:
+    def __init__(self, env: dict[str, str] | None = None):
+        env = dict(env or os.environ)
+        self.app_id = env[constants.ENV_APP_ID]
+        self.staging_dir = env[constants.ENV_STAGING_DIR]
+        self.job_name = env[constants.ENV_JOB_NAME]
+        self.index = int(env[constants.ENV_TASK_INDEX])
+        self.host = env.get(constants.ENV_AM_HOST, "127.0.0.1")
+        self.config = TonyConfig.load_final(os.path.join(self.staging_dir, constants.TONY_FINAL_CONF))
+        self.rpc = RpcClient(
+            self.host,
+            int(env[constants.ENV_AM_PORT]),
+            secret=env.get(constants.ENV_AM_SECRET, ""),
+        )
+        self.runtime = get_runtime(self.config)
+        self.attempt = int(env.get("TONY_RESTART_ATTEMPT", "0"))  # gang-epoch fence
+        self.port = pick_free_port(self.host)
+        self.child: subprocess.Popen | None = None
+        self._stop = threading.Event()
+        self._hb_failures = 0
+
+    # -- gang barrier ------------------------------------------------------
+    def register(self) -> None:
+        timeout_ms = self.config.get_time_ms(keys.TASK_EXECUTOR_REGISTRATION_TIMEOUT_MS, 60_000)
+        self.rpc.call_with_retry(
+            "register_worker_spec",
+            retries=max(int(timeout_ms / 200), 1),
+            delay_s=0.2,
+            job_name=self.job_name,
+            index=self.index,
+            host=self.host,
+            port=self.port,
+            attempt=self.attempt,
+        )
+
+    def await_cluster_spec(self) -> tuple[dict[str, list[str]], dict[str, str]]:
+        """Poll until the AM has the complete gang (SURVEY.md §3.2)."""
+        deadline = time.time() + self.config.get_time_ms(keys.AM_GANG_TIMEOUT_MS, 300_000) / 1000
+        while time.time() < deadline:
+            resp = self.rpc.call_with_retry(
+                "get_cluster_spec", job_name=self.job_name, index=self.index
+            )
+            if resp.get("spec") is not None:
+                return resp["spec"], resp.get("extra_env") or {}
+            time.sleep(0.2)
+        raise TimeoutError("cluster spec never completed (gang barrier timeout)")
+
+    # -- user process ------------------------------------------------------
+    def resolve_command(self) -> str:
+        per_type = self.config.get(keys.jobtype_key(self.job_name, keys.COMMAND_SUFFIX))
+        cmd = per_type or self.config.get(keys.EXECUTES) or ""
+        if not cmd:
+            raise ValueError(
+                f"no command for task type {self.job_name!r} "
+                f"(set {keys.EXECUTES} or tony.{self.job_name}.command)"
+            )
+        return cmd
+
+    def build_child_env(self, spec: dict[str, list[str]], extra_env: dict[str, str]) -> dict[str, str]:
+        env = dict(os.environ)
+        env.update(self.runtime.executor_env(spec, self.job_name, self.index))
+        env.update(extra_env)  # AM-side adapter contribution (e.g. horovod plan)
+        # user-specified shell env (csv k=v, reference --shell_env)
+        for kv in self.config.get_list(keys.SHELL_ENV):
+            k, _, v = kv.partition("=")
+            env[k] = v
+        # venv activation analog: put the venv's bin first on PATH
+        venv = self.config.get(keys.PYTHON_VENV)
+        if venv:
+            env["VIRTUAL_ENV"] = venv
+            env["PATH"] = os.path.join(venv, "bin") + os.pathsep + env.get("PATH", "")
+        pybin = self.config.get(keys.PYTHON_BINARY_PATH)
+        if pybin:
+            env["PYTHON_BINARY"] = pybin
+        if self.job_name == constants.TENSORBOARD_JOB_NAME:
+            env[constants.ENV_TB_PORT] = str(self.port)
+        return env
+
+    def launch_child(self, command: str, env: dict[str, str]) -> subprocess.Popen:
+        """Exec the user process via the shell (Utils.executeShell analog);
+        stdio inherits the container's captured stdout/stderr."""
+        cwd = None
+        src_dir = self.config.get(keys.SRC_DIR)
+        if src_dir:
+            staged_src = os.path.join(self.staging_dir, "src")
+            cwd = staged_src if os.path.isdir(staged_src) else src_dir
+        return subprocess.Popen(
+            ["/bin/bash", "-c", command],
+            env=env,
+            cwd=cwd,
+            start_new_session=True,
+        )
+
+    # -- background loops --------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        interval = self.config.get_time_ms(keys.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000
+        max_missed = self.config.get_int(keys.TASK_MAX_MISSED_HEARTBEATS, 25)
+        while not self._stop.wait(interval):
+            try:
+                self.rpc.call(
+                    "task_executor_heartbeat",
+                    job_name=self.job_name,
+                    index=self.index,
+                    attempt=self.attempt,
+                )
+                self._hb_failures = 0
+            except (RpcError, OSError):
+                self._hb_failures += 1
+                if self._hb_failures > max_missed:
+                    # AM is gone: orphaned container must not outlive the job
+                    self._kill_child()
+                    os._exit(constants.EXIT_HEARTBEAT_LOST)
+
+    def _metrics_loop(self) -> None:
+        interval = self.config.get_time_ms(keys.TASK_METRICS_INTERVAL_MS, 5000) / 1000
+        # with_tpu stays False here: PJRT device access is exclusive per
+        # process, and the chips belong to the CHILD training process — the
+        # supervisor must never initialize the TPU runtime. TPU metrics come
+        # from inside the training loop (tony_tpu.train reporting).
+        sampler = MetricsSampler(
+            child_pid=self.child.pid if self.child else None,
+            with_tpu=False,
+        )
+        while not self._stop.wait(interval):
+            try:
+                self.rpc.call(
+                    "push_metrics",
+                    job_name=self.job_name,
+                    index=self.index,
+                    metrics=sampler.sample(),
+                    attempt=self.attempt,
+                )
+            except (RpcError, OSError):
+                pass  # metrics are best-effort; liveness is the heartbeat's job
+
+    def _kill_child(self) -> None:
+        if self.child and self.child.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.child.pid), signal.SIGTERM)
+                try:
+                    self.child.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    os.killpg(os.getpgid(self.child.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    # -- main --------------------------------------------------------------
+    def run(self) -> int:
+        signal.signal(signal.SIGTERM, lambda *_: (_sigterm(self)))
+        try:
+            self.register()
+            # heartbeat starts at registration, not child launch: the gang
+            # barrier can legitimately outlast the liveness window (dependency-
+            # gated types, slow containers) and REGISTERED tasks are monitored.
+            # fault-injection hook (test-only; SURVEY.md §5.3): simulate a
+            # wedged executor whose heartbeats stop while its process lives.
+            if not os.environ.get("TONY_TEST_SUPPRESS_HEARTBEAT"):
+                threading.Thread(target=self._heartbeat_loop, name="heartbeat", daemon=True).start()
+            spec, extra_env = self.await_cluster_spec()
+            command = self.resolve_command()
+            env = self.build_child_env(spec, extra_env)
+        except Exception as e:  # registration/barrier failure
+            print(f"[tony-executor] startup failed: {e}", file=sys.stderr, flush=True)
+            try:
+                self.rpc.call(
+                    "register_execution_result",
+                    job_name=self.job_name,
+                    index=self.index,
+                    exit_code=constants.EXIT_EXECUTOR_REGISTRATION_FAILED,
+                    attempt=self.attempt,
+                )
+            except (RpcError, OSError):
+                pass
+            return constants.EXIT_EXECUTOR_REGISTRATION_FAILED
+
+        self.child = self.launch_child(command, env)
+        threading.Thread(target=self._metrics_loop, name="metrics", daemon=True).start()
+
+        if self.job_name == constants.TENSORBOARD_JOB_NAME:
+            try:
+                self.rpc.call("register_tensorboard_url", url=f"http://{self.host}:{self.port}")
+            except (RpcError, OSError):
+                pass
+
+        timeout_ms = self.config.get_time_ms(keys.TASK_EXECUTOR_EXECUTION_TIMEOUT_MS, 0)
+        try:
+            rc = self.child.wait(timeout=timeout_ms / 1000 if timeout_ms else None)
+        except subprocess.TimeoutExpired:
+            self._kill_child()
+            rc = constants.EXIT_FAILURE
+        self._stop.set()
+        try:
+            self.rpc.call_with_retry(
+                "register_execution_result",
+                retries=10,
+                job_name=self.job_name,
+                index=self.index,
+                exit_code=rc,
+                attempt=self.attempt,
+            )
+        except RpcError:
+            pass  # AM also learns the code from the container exit
+        return rc
+
+
+def _sigterm(executor: TaskExecutor) -> None:
+    executor._stop.set()
+    executor._kill_child()
+    sys.exit(constants.EXIT_KILLED)
+
+
+def main() -> int:
+    return TaskExecutor().run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""History-file naming, finalization, and parsing.
+
+Analog of the reference's ``HistoryFileUtils`` / ``ParserUtils``
+(SURVEY.md §2.1): the finished-history filename encodes
+``appId-started-completed-user-status``; the job's frozen config snapshot
+(``config.json``) lives alongside the ``.jhist``; finished files are grouped
+under ``finished/yyyy/MM/dd/<app_id>/``.
+"""
+
+from __future__ import annotations
+
+import getpass
+import json
+import os
+import shutil
+import time
+from dataclasses import dataclass
+
+from tony_tpu import constants
+from tony_tpu.cluster.events import Event
+
+
+@dataclass(frozen=True)
+class HistoryFileName:
+    app_id: str
+    started_ms: int
+    completed_ms: int
+    user: str
+    status: str  # SUCCEEDED | FAILED | KILLED
+
+    def render(self) -> str:
+        return (
+            f"{self.app_id}-{self.started_ms}-{self.completed_ms}-{self.user}-{self.status}"
+            + constants.HISTORY_SUFFIX
+        )
+
+    @classmethod
+    def parse(cls, filename: str) -> "HistoryFileName":
+        base = filename[: -len(constants.HISTORY_SUFFIX)]
+        # app_id may itself contain '-': split from the right (4 fixed fields).
+        app_id, started, completed, user, status = base.rsplit("-", 4)
+        return cls(app_id, int(started), int(completed), user, status)
+
+
+def finished_dir(history_root: str, app_id: str, completed_ms: int | None = None) -> str:
+    t = time.localtime((completed_ms or time.time() * 1000) / 1000)
+    return os.path.join(
+        history_root,
+        constants.HISTORY_FINISHED_DIR,
+        f"{t.tm_year:04d}",
+        f"{t.tm_mon:02d}",
+        f"{t.tm_mday:02d}",
+        app_id,
+    )
+
+
+def finalize_history(
+    history_root: str,
+    app_id: str,
+    intermediate_path: str,
+    started_ms: int,
+    completed_ms: int,
+    status: str,
+    config_snapshot: dict[str, str] | None = None,
+    user: str | None = None,
+) -> str:
+    """Move intermediate .jhist → finished dir with the encoding filename."""
+    user = user or getpass.getuser()
+    dest_dir = finished_dir(history_root, app_id, completed_ms)
+    os.makedirs(dest_dir, exist_ok=True)
+    name = HistoryFileName(app_id, started_ms, completed_ms, user, status).render()
+    dest = os.path.join(dest_dir, name)
+    shutil.move(intermediate_path, dest)
+    if config_snapshot is not None:
+        with open(os.path.join(dest_dir, constants.CONFIG_SNAPSHOT_FILE), "w") as f:
+            json.dump(config_snapshot, f, indent=1, sort_keys=True)
+    return dest
+
+
+def list_finished_jobs(history_root: str) -> list[HistoryFileName]:
+    """Scan finished/ for history files (portal's job-list source)."""
+    out: list[HistoryFileName] = []
+    root = os.path.join(history_root, constants.HISTORY_FINISHED_DIR)
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            if fn.endswith(constants.HISTORY_SUFFIX):
+                try:
+                    out.append(HistoryFileName.parse(fn))
+                except ValueError:
+                    continue
+    return sorted(out, key=lambda h: h.completed_ms, reverse=True)
+
+
+def read_events(history_root: str, app_id: str) -> list[Event]:
+    """Read the event stream for a finished (or in-flight) app."""
+    # finished first
+    for h in list_finished_jobs(history_root):
+        if h.app_id == app_id:
+            path = os.path.join(finished_dir(history_root, app_id, h.completed_ms), h.render())
+            with open(path) as f:
+                return [Event.from_json(line) for line in f if line.strip()]
+    inter = os.path.join(
+        history_root, constants.HISTORY_INTERMEDIATE_DIR, app_id + constants.HISTORY_SUFFIX
+    )
+    if os.path.exists(inter):
+        with open(inter) as f:
+            return [Event.from_json(line) for line in f if line.strip()]
+    return []

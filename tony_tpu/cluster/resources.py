@@ -1,0 +1,331 @@
+"""TPU-slice resource model and resource managers.
+
+The reference asks YARN for containers with ``{memory, vcores, gpus}``
+(``TonyApplicationMaster`` container requests — SURVEY.md §2.1). The
+TPU-native rebuild makes the **slice** the first-class resource
+(BASELINE.json north star): a pool is a 2D chip grid with ICI links
+(v5e meshes are 2D), and an allocation is an **axis-aligned contiguous
+sub-rectangle** of that grid — contiguity is what keeps a job's collectives
+on ICI instead of DCN (SURVEY.md §2.6, §5.8).
+
+``ResourceManager`` is the interface the AM schedules against; the
+``LocalResourceManager`` realizes containers as local subprocesses (the
+MiniYARNCluster analog, SURVEY.md §4) so the same AM code path runs under
+tests, on one TPU VM, or (later rounds) against a multi-host pool service.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import signal
+import subprocess
+import threading
+import uuid
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+
+from tony_tpu import constants
+from tony_tpu.config import parse_memory_string
+
+# chips per accelerator host VM (v5e: 4 chips per VM is typical; v4/v5p: 4)
+DEFAULT_CHIPS_PER_HOST = 4
+
+# Known slice sizes → canonical 2D topologies (v5e/v6e pod slices).
+_KNOWN_TOPOLOGIES: dict[int, tuple[int, int]] = {
+    1: (1, 1), 2: (1, 2), 4: (2, 2), 8: (2, 4), 16: (4, 4),
+    32: (4, 8), 64: (8, 8), 128: (8, 16), 256: (16, 16),
+}
+
+
+def squarish_topology(chips: int) -> tuple[int, int]:
+    """Most-square 2D factorization for a chip count (ICI-friendly)."""
+    if chips in _KNOWN_TOPOLOGIES:
+        return _KNOWN_TOPOLOGIES[chips]
+    best = (1, chips)
+    for r in range(1, int(chips**0.5) + 1):
+        if chips % r == 0:
+            best = (r, chips // r)
+    return best
+
+
+@dataclass(frozen=True)
+class SliceSpec:
+    """An accelerator slice shape, e.g. v5e-64 = ('v5e', (8, 8))."""
+
+    accelerator: str           # v5e | v5p | v4 | cpu
+    topology: tuple[int, int]  # chip grid (rows, cols); (0, 0) for cpu
+
+    @property
+    def chips(self) -> int:
+        return self.topology[0] * self.topology[1]
+
+    @property
+    def name(self) -> str:
+        return f"{self.accelerator}-{self.chips}" if self.chips else self.accelerator
+
+    @classmethod
+    def parse(cls, spec: str) -> "SliceSpec":
+        """Accepts 'v5e-64', 'v5e,8x8', or 'cpu'."""
+        spec = spec.strip()
+        if "," in spec:
+            accel, topo = spec.split(",", 1)
+            r, c = topo.lower().split("x")
+            return cls(accel.strip(), (int(r), int(c)))
+        if "-" in spec:
+            accel, _, n = spec.rpartition("-")
+            return cls(accel, squarish_topology(int(n)))
+        return cls(spec, (0, 0))
+
+
+@dataclass
+class Resources:
+    """Per-task resource ask (reference: memory/vcores/gpus → chips)."""
+
+    memory_bytes: int = 2 * 1024**3
+    vcores: int = 1
+    chips: int = 0
+
+    @classmethod
+    def from_config_strings(cls, memory: str | None, vcores: str | None, chips: str | None) -> "Resources":
+        return cls(
+            memory_bytes=parse_memory_string(memory) if memory else 2 * 1024**3,
+            vcores=int(vcores) if vcores else 1,
+            chips=int(chips) if chips else 0,
+        )
+
+
+@dataclass
+class Container:
+    """An allocated execution slot (YARN Container analog), with TPU coords."""
+
+    id: str
+    host: str
+    resources: Resources
+    chip_coords: tuple[tuple[int, int], ...] = ()   # coords within the pool grid
+    slice_name: str = ""                            # e.g. "v5e-64"
+    slice_topology: tuple[int, int] = (0, 0)        # the job gang's slice shape
+    job_type: str = ""
+    task_index: int = -1
+
+    def device_env(self) -> dict[str, str]:
+        """TPU placement env injected into the executor (replaces the
+        reference's GPU device plumbing via nvidia-smi/YARN GPU isolation)."""
+        env = {
+            constants.ENV_CONTAINER_ID: self.id,
+            constants.ENV_TPU_CHIPS_PER_TASK: str(len(self.chip_coords)),
+        }
+        if self.chip_coords:
+            env[constants.ENV_TPU_SLICE_NAME] = self.slice_name
+            env[constants.ENV_TPU_SLICE_TOPOLOGY] = f"{self.slice_topology[0]}x{self.slice_topology[1]}"
+            env[constants.ENV_TPU_CHIP_COORDS] = ";".join(f"{r},{c}" for r, c in self.chip_coords)
+        return env
+
+
+class AllocationError(RuntimeError):
+    pass
+
+
+class ChipGrid:
+    """Occupancy tracking + contiguous-rectangle allocation on a 2D chip mesh.
+
+    The ICI-affinity invariant (tony.tpu.ici-strict): an allocation is always
+    an axis-aligned contiguous rectangle, so every chip in it reaches every
+    other over ICI hops inside the rectangle — a mesh axis never silently
+    spans DCN.
+    """
+
+    def __init__(self, topology: tuple[int, int]):
+        self.rows, self.cols = topology
+        self._used: set[tuple[int, int]] = set()
+        self._lock = threading.Lock()
+
+    @property
+    def total(self) -> int:
+        return self.rows * self.cols
+
+    @property
+    def free(self) -> int:
+        return self.total - len(self._used)
+
+    def allocate_rect(self, shape: tuple[int, int]) -> tuple[tuple[int, int], ...] | None:
+        """First-fit scan for a free shape=(r,c) rectangle; tries both
+        orientations. Returns row-major chip coords or None."""
+        with self._lock:
+            for r, c in dict.fromkeys([shape, shape[::-1]]):
+                if r > self.rows or c > self.cols:
+                    continue
+                for r0 in range(self.rows - r + 1):
+                    for c0 in range(self.cols - c + 1):
+                        coords = tuple(
+                            (r0 + i, c0 + j) for i, j in itertools.product(range(r), range(c))
+                        )
+                        if not self._used.intersection(coords):
+                            self._used.update(coords)
+                            return coords
+            return None
+
+    def allocate_chips(self, n: int) -> tuple[tuple[int, int], ...] | None:
+        """Allocate n chips as the most-square rectangle that fits."""
+        if n <= 0:
+            return ()
+        for r in sorted(
+            {r for r in range(1, n + 1) if n % r == 0},
+            key=lambda r: abs(r - n // r),
+        ):
+            got = self.allocate_rect((r, n // r))
+            if got is not None:
+                return got
+        return None
+
+    def release(self, coords: tuple[tuple[int, int], ...]) -> None:
+        with self._lock:
+            self._used.difference_update(coords)
+
+
+@dataclass
+class _Host:
+    name: str
+    memory_bytes: int
+    vcores: int
+    used_memory: int = 0
+    used_vcores: int = 0
+
+
+class ResourceManager(ABC):
+    """What the AM's scheduler talks to (YARN RM + NM analog, collapsed).
+
+    Separated so the loopback-emulated pool and a real multi-host pool are
+    interchangeable (SURVEY.md §7 hard part (a)).
+    """
+
+    @abstractmethod
+    def allocate(self, job_type: str, task_index: int, resources: Resources) -> Container:
+        """Allocate a container or raise AllocationError."""
+
+    @abstractmethod
+    def release(self, container: Container) -> None: ...
+
+    @abstractmethod
+    def start_container(
+        self, container: Container, command: list[str], env: dict[str, str], log_dir: str
+    ) -> None: ...
+
+    @abstractmethod
+    def poll_exited(self) -> dict[str, int]:
+        """container_id → exit code, for containers that exited since last poll
+        (the NMClient container-completed callback analog)."""
+
+    @abstractmethod
+    def kill_container(self, container: Container) -> None: ...
+
+    @abstractmethod
+    def shutdown(self) -> None: ...
+
+
+class LocalResourceManager(ResourceManager):
+    """Process-per-container RM on one host (MiniCluster analog, SURVEY.md §4).
+
+    Models a single TPU VM pool (or a pure-CPU pool for tests): one logical
+    host with a chip grid; containers are local subprocesses in their own
+    process groups with stdout/stderr captured per-container.
+    """
+
+    def __init__(
+        self,
+        pool_spec: str = "local:cpu",
+        host_memory: str = "64g",
+        host_vcores: int = 64,
+    ):
+        name, _, accel = pool_spec.partition(":")
+        self.slice = SliceSpec.parse(accel or "cpu")
+        self.grid = ChipGrid(self.slice.topology)
+        self.host = _Host(name or "localhost", parse_memory_string(host_memory), host_vcores)
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._containers: dict[str, Container] = {}
+        self._reported: set[str] = set()
+        self._lock = threading.Lock()
+
+    def allocate(self, job_type: str, task_index: int, resources: Resources) -> Container:
+        with self._lock:
+            if self.host.used_memory + resources.memory_bytes > self.host.memory_bytes:
+                raise AllocationError(f"host out of memory for {job_type}:{task_index}")
+            if self.host.used_vcores + resources.vcores > self.host.vcores:
+                raise AllocationError(f"host out of vcores for {job_type}:{task_index}")
+            coords = self.grid.allocate_chips(resources.chips)
+            if coords is None:
+                raise AllocationError(
+                    f"no contiguous {resources.chips}-chip rectangle free "
+                    f"({self.grid.free}/{self.grid.total} chips free)"
+                )
+            self.host.used_memory += resources.memory_bytes
+            self.host.used_vcores += resources.vcores
+            c = Container(
+                id=f"container_{uuid.uuid4().hex[:12]}",
+                host=self.host.name,
+                resources=resources,
+                chip_coords=coords,
+                slice_name=self.slice.name,
+                slice_topology=self.slice.topology,
+                job_type=job_type,
+                task_index=task_index,
+            )
+            self._containers[c.id] = c
+            return c
+
+    def release(self, container: Container) -> None:
+        with self._lock:
+            if self._containers.pop(container.id, None) is None:
+                return
+            self.grid.release(container.chip_coords)
+            self.host.used_memory -= container.resources.memory_bytes
+            self.host.used_vcores -= container.resources.vcores
+
+    def start_container(
+        self, container: Container, command: list[str], env: dict[str, str], log_dir: str
+    ) -> None:
+        os.makedirs(log_dir, exist_ok=True)
+        with open(os.path.join(log_dir, "stdout.log"), "ab") as stdout, open(
+            os.path.join(log_dir, "stderr.log"), "ab"
+        ) as stderr:
+            proc = subprocess.Popen(
+                command,
+                env=env,
+                stdout=stdout,
+                stderr=stderr,
+                start_new_session=True,  # own process group → clean kill of user subtree
+            )
+        with self._lock:
+            self._procs[container.id] = proc
+
+    def poll_exited(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        with self._lock:
+            for cid, proc in self._procs.items():
+                if cid in self._reported:
+                    continue
+                rc = proc.poll()
+                if rc is not None:
+                    out[cid] = rc
+                    self._reported.add(cid)
+        return out
+
+    def kill_container(self, container: Container) -> None:
+        with self._lock:
+            proc = self._procs.get(container.id)
+        if proc and proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                try:
+                    proc.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    def shutdown(self) -> None:
+        with self._lock:
+            containers = list(self._containers.values())
+        for c in containers:
+            self.kill_container(c)
+            self.release(c)

@@ -1,0 +1,185 @@
+"""Control-plane RPC: length-framed JSON over TCP.
+
+Analog of the reference's ``tony-core/.../tony/rpc/`` (``ApplicationRpc`` over
+Hadoop protobuf RPC + ``MetricsRpc``; SURVEY.md §2.1, §2.6). The traffic is
+low-rate control-plane only — register/heartbeat/spec/result — so a tiny
+threaded server with a shared-secret auth token is the idiomatic analog; the
+data plane never touches this path (it rides ICI/DCN inside XLA).
+
+Wire format: 4-byte big-endian length, then a UTF-8 JSON object.
+Request:  {"method": str, "params": {...}, "auth": str}
+Response: {"ok": true, "result": ...} | {"ok": false, "error": str}
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class RpcError(RuntimeError):
+    """Remote method raised, or protocol violation."""
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj).encode("utf-8")
+    if len(payload) > MAX_FRAME:
+        raise RpcError(f"frame too large: {len(payload)}")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if length > MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    return json.loads(_recv_exact(sock, length))
+
+
+class RpcServer:
+    """Threaded RPC server dispatching to registered methods.
+
+    The AM (ApplicationRpcServer analog) registers its handlers and runs this
+    next to its event loop; handlers must be thread-safe (session lock).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, secret: str = ""):
+        self._methods: dict[str, Callable[..., Any]] = {}
+        self._secret = secret
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:  # one connection may issue many calls
+                sock = self.request
+                try:
+                    while True:
+                        req = _recv_frame(sock)
+                        _send_frame(sock, outer._dispatch(req))
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, name="rpc-server", daemon=True)
+
+    def _dispatch(self, req: Any) -> dict[str, Any]:
+        try:
+            if not isinstance(req, dict):
+                raise RpcError("malformed request")
+            if self._secret and req.get("auth") != self._secret:
+                raise RpcError("authentication failed")
+            method = self._methods.get(req.get("method", ""))
+            if method is None:
+                raise RpcError(f"unknown method: {req.get('method')!r}")
+            return {"ok": True, "result": method(**(req.get("params") or {}))}
+        except Exception as e:  # noqa: BLE001 — fault isolation at the RPC boundary
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def register(self, name: str, fn: Callable[..., Any]) -> None:
+        self._methods[name] = fn
+
+    def register_object(self, obj: Any, names: list[str]) -> None:
+        for n in names:
+            self.register(n, getattr(obj, n))
+
+    def start(self) -> None:
+        self._thread.start()
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._server.server_address[:2]
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RpcClient:
+    """Blocking client with per-call reconnect-on-failure and retry helpers.
+
+    (ApplicationRpcClient analog; executors and the monitoring client use it.)
+    """
+
+    def __init__(self, host: str, port: int, secret: str = "", timeout_s: float = 10.0):
+        self.host, self.port, self.secret = host, port, secret
+        self.timeout_s = timeout_s
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection((self.host, self.port), timeout=self.timeout_s)
+            s.settimeout(self.timeout_s)
+            self._sock = s
+        return self._sock
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def call(self, method: str, **params: Any) -> Any:
+        with self._lock:
+            for attempt in (0, 1):  # one transparent reconnect on a stale socket
+                try:
+                    sock = self._connect()
+                    _send_frame(sock, {"method": method, "params": params, "auth": self.secret})
+                    resp = _recv_frame(sock)
+                    break
+                except (ConnectionError, OSError):
+                    self._sock = None
+                    if attempt:
+                        raise
+            if not resp.get("ok"):
+                raise RpcError(resp.get("error", "unknown remote error"))
+            return resp.get("result")
+
+    def call_with_retry(
+        self, method: str, *, retries: int = 30, delay_s: float = 0.2, **params: Any
+    ) -> Any:
+        """Retry through AM startup races / transient connect failures."""
+        last: Exception | None = None
+        for _ in range(retries):
+            try:
+                return self.call(method, **params)
+            except (ConnectionError, OSError, RpcError) as e:
+                last = e
+                time.sleep(delay_s)
+        raise RpcError(f"{method} failed after {retries} retries: {last}")
+
+
+# Canonical ApplicationRpc method names (reference iface, SURVEY.md §2.1)
+APPLICATION_RPC_METHODS = [
+    "register_worker_spec",
+    "get_cluster_spec",
+    "register_execution_result",
+    "register_tensorboard_url",
+    "task_executor_heartbeat",
+    "get_task_infos",
+    "get_application_status",
+    "finish_application",
+    "push_metrics",          # MetricsRpc analog
+]

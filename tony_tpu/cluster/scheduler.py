@@ -1,0 +1,114 @@
+"""Gang/dependency task scheduler.
+
+Analog of the reference's ``TaskScheduler.java`` (SURVEY.md §2.1): per-job-type
+container requests at distinct priorities with **dependency-ordered start** —
+``tony.application.dependency.<A>.timeout.after.<B>`` means type A's containers
+are not launched until every type-B task has *registered*, failing the job if
+B takes longer than the timeout.
+
+TPU-twist: resources come from per-type ``tony.<type>.{memory,vcores,chips}``
+keys, and chip asks are satisfied as ICI-contiguous rectangles by the
+ResourceManager (resources.py).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.cluster.resources import AllocationError, Container, ResourceManager, Resources
+from tony_tpu.cluster.session import Session
+
+
+@dataclass
+class _TypePlan:
+    job_type: str
+    instances: int
+    resources: Resources
+    priority: int
+    depends_on: dict[str, int] = field(default_factory=dict)  # dependee → timeout_ms
+    launched: bool = False
+    wait_started_ms: float = 0.0
+
+
+class DependencyTimeout(RuntimeError):
+    pass
+
+
+class TaskScheduler:
+    """Decides *when* each job type's containers are allocated and launched.
+
+    ``ready_types()`` is polled from the AM event loop; it returns the next
+    batch of types whose dependencies are satisfied. Allocation itself
+    (``allocate_type``) is gang-style: all instances of a type allocate
+    together or the job fails (no partial gangs holding chips).
+    """
+
+    def __init__(self, config: TonyConfig, session: Session, rm: ResourceManager):
+        self.config = config
+        self.session = session
+        self.rm = rm
+        deps = config.dependencies()
+        self.plans: dict[str, _TypePlan] = {}
+        for prio, job_type in enumerate(config.job_types()):
+            self.plans[job_type] = _TypePlan(
+                job_type=job_type,
+                instances=config.instances(job_type),
+                resources=Resources.from_config_strings(
+                    config.get(keys.jobtype_key(job_type, keys.MEMORY_SUFFIX)),
+                    config.get(keys.jobtype_key(job_type, keys.VCORES_SUFFIX)),
+                    config.get(keys.jobtype_key(job_type, keys.CHIPS_SUFFIX)),
+                ),
+                priority=prio,
+                depends_on=dict(deps.get(job_type, {})),
+            )
+        unknown = {d for p in self.plans.values() for d in p.depends_on} - set(self.plans)
+        if unknown:
+            raise ValueError(f"dependency on undeclared job types: {sorted(unknown)}")
+
+    # -- dependency gating -------------------------------------------------
+    def _dependency_satisfied(self, plan: _TypePlan) -> bool:
+        """All dependee types fully registered (the reference gates worker
+        start on ps registration the same way)."""
+        now = time.time() * 1000
+        if plan.wait_started_ms == 0.0:
+            plan.wait_started_ms = now
+        for dependee, timeout_ms in plan.depends_on.items():
+            dep_plan = self.plans[dependee]
+            if self.session.registered_count(dependee) < dep_plan.instances:
+                if now - plan.wait_started_ms > timeout_ms:
+                    raise DependencyTimeout(
+                        f"{plan.job_type} waited >{timeout_ms}ms for {dependee} to register"
+                    )
+                return False
+        return True
+
+    def ready_types(self) -> list[str]:
+        """Unlaunched types whose dependencies are satisfied, priority order.
+
+        Raises DependencyTimeout when a dependency wait expires (job fails).
+        """
+        ready = []
+        for plan in sorted(self.plans.values(), key=lambda p: p.priority):
+            if not plan.launched and self._dependency_satisfied(plan):
+                ready.append(plan.job_type)
+        return ready
+
+    def all_launched(self) -> bool:
+        return all(p.launched for p in self.plans.values())
+
+    # -- allocation --------------------------------------------------------
+    def allocate_type(self, job_type: str) -> list[Container]:
+        """Allocate every instance of a type as one gang; all-or-nothing."""
+        plan = self.plans[job_type]
+        got: list[Container] = []
+        try:
+            for i in range(plan.instances):
+                got.append(self.rm.allocate(job_type, i, plan.resources))
+        except AllocationError:
+            for c in got:
+                self.rm.release(c)
+            raise
+        plan.launched = True
+        return got

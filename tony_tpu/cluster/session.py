@@ -1,0 +1,237 @@
+"""Job session and task state model.
+
+Analog of the reference's ``TonySession.java`` / ``TonyTask`` / ``TaskInfo`` /
+``TaskStatus`` (SURVEY.md §2.1): maps job type → task array, assembles the
+cluster spec once every expected task has registered (the gang barrier,
+SURVEY.md §3.2), and reduces per-task outcomes into the job verdict with
+tracked/untracked semantics.
+
+Thread-safety follows the reference's design (SURVEY.md §5.2): a single AM
+event loop plus one coarse lock around session state.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from tony_tpu.config import TonyConfig, keys
+
+
+class TaskStatus(enum.Enum):
+    NEW = "NEW"                # declared, no container yet
+    SCHEDULED = "SCHEDULED"    # container allocated, executor launching
+    REGISTERED = "REGISTERED"  # executor registered host:port, waiting on gang
+    RUNNING = "RUNNING"        # user process running
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+    LOST = "LOST"              # heartbeat lost
+
+    @property
+    def terminal(self) -> bool:
+        return self in (TaskStatus.SUCCEEDED, TaskStatus.FAILED, TaskStatus.KILLED, TaskStatus.LOST)
+
+
+class JobStatus(enum.Enum):
+    NEW = "NEW"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+
+
+@dataclass
+class Task:
+    """One gang member (TonyTask analog)."""
+
+    job_name: str
+    index: int
+    status: TaskStatus = TaskStatus.NEW
+    host: str | None = None
+    port: int | None = None
+    container_id: str | None = None
+    exit_code: int | None = None
+    start_time_ms: int = 0
+    end_time_ms: int = 0
+    last_heartbeat_ms: float = 0.0
+    missed_heartbeats: int = 0
+    metrics: dict[str, Any] = field(default_factory=dict)
+    log_dir: str | None = None
+    chip_coords: tuple[tuple[int, ...], ...] = ()
+
+    @property
+    def id(self) -> str:
+        return f"{self.job_name}:{self.index}"
+
+    @property
+    def address(self) -> str | None:
+        return f"{self.host}:{self.port}" if self.host and self.port else None
+
+    def to_info(self) -> dict[str, Any]:
+        """Wire form (TaskInfo analog) for get_task_infos / history."""
+        return {
+            "name": self.job_name,
+            "index": self.index,
+            "status": self.status.value,
+            "host": self.host,
+            "port": self.port,
+            "container_id": self.container_id,
+            "exit_code": self.exit_code,
+            "start_time_ms": self.start_time_ms,
+            "end_time_ms": self.end_time_ms,
+            "metrics": dict(self.metrics),
+            "log_dir": self.log_dir,
+            "chip_coords": [list(c) for c in self.chip_coords],
+        }
+
+
+class Session:
+    """Gang bookkeeping + cluster-spec barrier + verdict reduction."""
+
+    def __init__(self, config: TonyConfig):
+        self.config = config
+        self.lock = threading.RLock()
+        self.tasks: dict[str, list[Task]] = {}
+        self.untracked = config.untracked_types()
+        self.job_status = JobStatus.NEW
+        self.failure_reason: str | None = None
+        self._spec_cache: dict[str, list[str]] | None = None
+        for jobtype in config.job_types():
+            n = config.instances(jobtype)
+            self.tasks[jobtype] = [Task(jobtype, i) for i in range(n)]
+
+    # -- lookup ------------------------------------------------------------
+    def get_task(self, job_name: str, index: int) -> Task:
+        try:
+            return self.tasks[job_name][index]
+        except (KeyError, IndexError):
+            raise KeyError(f"unknown task {job_name}:{index}") from None
+
+    def all_tasks(self) -> list[Task]:
+        return [t for ts in self.tasks.values() for t in ts]
+
+    def total_tasks(self) -> int:
+        return sum(len(ts) for ts in self.tasks.values())
+
+    def task_infos(self) -> list[dict[str, Any]]:
+        with self.lock:
+            return [t.to_info() for t in self.all_tasks()]
+
+    # -- registration / the gang barrier (SURVEY §3.2) ---------------------
+    def register_worker_spec(self, job_name: str, index: int, host: str, port: int) -> None:
+        with self.lock:
+            t = self.get_task(job_name, index)
+            t.host, t.port = host, port
+            if not t.status.terminal:
+                t.status = TaskStatus.REGISTERED
+                t.last_heartbeat_ms = time.time() * 1000
+            self._spec_cache = None
+
+    def cluster_spec_complete(self) -> bool:
+        with self.lock:
+            return all(t.address for t in self.all_tasks())
+
+    def cluster_spec(self) -> dict[str, list[str]] | None:
+        """{job_type: ["host:port", ...] ordered by index}, or None until complete."""
+        with self.lock:
+            if not self.cluster_spec_complete():
+                return None
+            if self._spec_cache is None:
+                self._spec_cache = {
+                    jt: [t.address for t in sorted(ts, key=lambda t: t.index)]  # type: ignore[misc]
+                    for jt, ts in self.tasks.items()
+                }
+            return self._spec_cache
+
+    def registered_count(self, job_name: str | None = None) -> int:
+        with self.lock:
+            ts = self.tasks.get(job_name, []) if job_name else self.all_tasks()
+            return sum(1 for t in ts if t.address)
+
+    # -- liveness ----------------------------------------------------------
+    def on_heartbeat(self, job_name: str, index: int) -> None:
+        with self.lock:
+            t = self.get_task(job_name, index)
+            t.last_heartbeat_ms = time.time() * 1000
+            t.missed_heartbeats = 0
+            if t.status == TaskStatus.REGISTERED:
+                t.status = TaskStatus.RUNNING
+
+    def find_dead_tasks(self, heartbeat_interval_ms: int, max_missed: int) -> list[Task]:
+        """Tasks whose heartbeats stopped (mark LOST). Reference: AM hb monitor."""
+        now = time.time() * 1000
+        dead = []
+        with self.lock:
+            for t in self.all_tasks():
+                if t.status in (TaskStatus.REGISTERED, TaskStatus.RUNNING) and t.last_heartbeat_ms:
+                    missed = (now - t.last_heartbeat_ms) / max(heartbeat_interval_ms, 1)
+                    if missed > max_missed:
+                        dead.append(t)
+        return dead
+
+    # -- completion + verdict (tracked/untracked reduction, SURVEY §3.1) ---
+    def on_task_completed(self, job_name: str, index: int, exit_code: int) -> None:
+        with self.lock:
+            t = self.get_task(job_name, index)
+            if t.status.terminal:
+                return  # idempotent completion (reference invariant)
+            t.exit_code = exit_code
+            t.end_time_ms = int(time.time() * 1000)
+            t.status = TaskStatus.SUCCEEDED if exit_code == 0 else TaskStatus.FAILED
+
+    def mark_lost(self, task: Task) -> None:
+        with self.lock:
+            if not task.status.terminal:
+                task.status = TaskStatus.LOST
+                task.end_time_ms = int(time.time() * 1000)
+
+    def mark_killed(self, task: Task) -> None:
+        with self.lock:
+            if not task.status.terminal:
+                task.status = TaskStatus.KILLED
+                task.end_time_ms = int(time.time() * 1000)
+
+    def tracked_tasks(self) -> list[Task]:
+        return [t for t in self.all_tasks() if t.job_name not in self.untracked]
+
+    def untracked_tasks(self) -> list[Task]:
+        return [t for t in self.all_tasks() if t.job_name in self.untracked]
+
+    def tracked_all_terminal(self) -> bool:
+        with self.lock:
+            tracked = self.tracked_tasks()
+            return bool(tracked) and all(t.status.terminal for t in tracked)
+
+    def any_tracked_failed(self) -> Task | None:
+        """First tracked task in a failure state (fail-fast trigger)."""
+        with self.lock:
+            for t in self.tracked_tasks():
+                if t.status in (TaskStatus.FAILED, TaskStatus.LOST):
+                    return t
+            return None
+
+    def reduce_final_status(self) -> JobStatus:
+        """All tracked SUCCEEDED → SUCCEEDED; any tracked FAILED/LOST → FAILED.
+
+        Untracked types (ps, tensorboard, ...) never gate the verdict; they are
+        killed at job end (reference: TonyApplicationMaster verdict logic).
+        """
+        with self.lock:
+            if self.job_status in (JobStatus.KILLED,):
+                return self.job_status
+            tracked = self.tracked_tasks()
+            if not tracked:
+                # job of only-untracked types: succeed when they all exited 0
+                ok = all(t.status == TaskStatus.SUCCEEDED for t in self.all_tasks())
+                self.job_status = JobStatus.SUCCEEDED if ok else JobStatus.FAILED
+            elif any(t.status in (TaskStatus.FAILED, TaskStatus.LOST, TaskStatus.KILLED) for t in tracked):
+                self.job_status = JobStatus.FAILED
+            elif all(t.status == TaskStatus.SUCCEEDED for t in tracked):
+                self.job_status = JobStatus.SUCCEEDED
+            else:
+                self.job_status = JobStatus.FAILED
+            return self.job_status

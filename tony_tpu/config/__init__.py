@@ -1,0 +1,12 @@
+"""Config system: the tony.* key registry and the layered, freezable config.
+
+Analog of TonyConfigurationKeys.java + Hadoop Configuration layering +
+tony-default.xml / tony-final.xml (SURVEY.md §2.1, §5.6).
+"""
+
+from tony_tpu.config import keys  # noqa: F401
+from tony_tpu.config.config import (  # noqa: F401
+    TonyConfig,
+    parse_memory_string,
+    parse_time_ms,
+)

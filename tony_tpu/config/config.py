@@ -1,0 +1,249 @@
+"""Layered job configuration with freeze-to-artifact semantics.
+
+Analog of the reference's layered Hadoop ``Configuration``
+(SURVEY.md §5.6): ``tony-default.xml`` ← ``tony-site.xml`` ← ``--conf_file`` ←
+``--conf k=v``, frozen to a single ``tony-final.xml`` artifact shipped to the
+AM and every executor so one config artifact is the whole-job truth.
+
+Here the carrier is a flat ``str -> str`` mapping (like Hadoop Configuration)
+with typed accessors, and the frozen artifact is ``tony-final.json``.
+Conf files may be JSON (flat or nested), TOML, or Hadoop-style XML
+(``<configuration><property><name>..</name><value>..</value>``) for parity
+with reference job files like tony-examples/mnist-tensorflow/tony.xml.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import xml.etree.ElementTree as ET
+from typing import Any, Iterator, Mapping
+
+from tony_tpu import constants
+from tony_tpu.config import keys
+
+_TIME_RE = re.compile(r"^(\d+)(ms|s|m|h|d)?$")
+_MEM_RE = re.compile(r"^(\d+)([kmgt]?)b?$", re.IGNORECASE)
+
+_TIME_MULT = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000, None: 1}
+_MEM_MULT = {"": 1, "k": 1024, "m": 1024**2, "g": 1024**3, "t": 1024**4}
+
+
+def _flatten(obj: Any, prefix: str = "") -> Iterator[tuple[str, str]]:
+    """Flatten nested dicts to dotted keys; scalars become strings."""
+    if isinstance(obj, Mapping):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            yield from _flatten(v, key)
+    elif isinstance(obj, bool):
+        yield prefix, "true" if obj else "false"
+    elif isinstance(obj, (list, tuple)):
+        yield prefix, ",".join(str(x) for x in obj)
+    elif obj is None:
+        yield prefix, ""
+    else:
+        yield prefix, str(obj)
+
+
+def parse_memory_string(mem: str) -> int:
+    """'2g' → bytes. Analog of Utils.parseMemoryString (reference Utils.java)."""
+    m = _MEM_RE.match(str(mem).strip())
+    if not m:
+        raise ValueError(f"unparseable memory string: {mem!r}")
+    return int(m.group(1)) * _MEM_MULT[m.group(2).lower()]
+
+
+def parse_time_ms(val: str) -> int:
+    """'500', '500ms', '5s', '2m' → milliseconds."""
+    m = _TIME_RE.match(str(val).strip())
+    if not m:
+        raise ValueError(f"unparseable time string: {val!r}")
+    return int(m.group(1)) * _TIME_MULT[m.group(2)]
+
+
+class TonyConfig:
+    """Flat, layered, string-valued configuration.
+
+    Layering is applied by construction order: later ``set``/``update_from``
+    calls win. ``freeze()`` produces the immutable whole-job artifact.
+    """
+
+    def __init__(self, data: Mapping[str, str] | None = None, *, with_defaults: bool = True):
+        self._data: dict[str, str] = dict(keys.DEFAULTS) if with_defaults else {}
+        self._frozen = False
+        if data:
+            self.update_from(data)
+
+    # -- mutation ----------------------------------------------------------
+    def set(self, key: str, value: Any) -> "TonyConfig":
+        if self._frozen:
+            raise RuntimeError("config is frozen (tony-final artifact is immutable)")
+        for k, v in _flatten(value, key):
+            self._data[k] = v
+        return self
+
+    def update_from(self, mapping: Mapping[str, Any]) -> "TonyConfig":
+        for k, v in mapping.items():
+            self.set(k, v)
+        return self
+
+    def load_file(self, path: str | os.PathLike) -> "TonyConfig":
+        """Layer a conf file on top: .json, .toml, or Hadoop-style .xml."""
+        path = os.fspath(path)
+        if path.endswith(".xml"):
+            self.update_from(_parse_hadoop_xml(path))
+        elif path.endswith(".toml"):
+            import tomllib
+
+            with open(path, "rb") as f:
+                self.update_from(dict(_flatten(tomllib.load(f))))
+        else:
+            with open(path) as f:
+                self.update_from(dict(_flatten(json.load(f))))
+        return self
+
+    def set_kv_args(self, conf_args: list[str]) -> "TonyConfig":
+        """Apply ``--conf key=value`` CLI overrides (highest layer)."""
+        for arg in conf_args:
+            if "=" not in arg:
+                raise ValueError(f"--conf expects key=value, got {arg!r}")
+            k, _, v = arg.partition("=")
+            self.set(k.strip(), v.strip())
+        return self
+
+    # -- typed accessors ---------------------------------------------------
+    def get(self, key: str, default: str | None = None) -> str | None:
+        return self._data.get(key, default)
+
+    def __getitem__(self, key: str) -> str:
+        return self._data[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._data
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self._data.get(key)
+        return int(v) if v not in (None, "") else default
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self._data.get(key)
+        if v in (None, ""):
+            return default
+        return str(v).strip().lower() in ("true", "1", "yes", "on")
+
+    def get_time_ms(self, key: str, default: int = 0) -> int:
+        v = self._data.get(key)
+        return parse_time_ms(v) if v not in (None, "") else default
+
+    def get_memory_bytes(self, key: str, default: int = 0) -> int:
+        v = self._data.get(key)
+        return parse_memory_string(v) if v not in (None, "") else default
+
+    def get_list(self, key: str, default: tuple[str, ...] = ()) -> tuple[str, ...]:
+        v = self._data.get(key)
+        if v in (None, ""):
+            return tuple(default)
+        return tuple(s.strip() for s in v.split(",") if s.strip())
+
+    # -- per-jobtype parameterized access (tony.<type>.*) ------------------
+    def job_types(self) -> tuple[str, ...]:
+        """All job types with a declared instance count, stable order.
+
+        Mirrors how the reference discovers the gang from
+        ``tony.<jobtype>.instances`` keys (TonyConfigurationKeys / Utils).
+        """
+        found = []
+        for k in self._data:
+            m = re.match(r"^tony\.([A-Za-z0-9_\-]+)\.instances$", k)
+            if m and m.group(1) not in ("task", "am", "application"):
+                if self.get_int(k, 0) > 0:
+                    found.append(m.group(1))
+        return tuple(sorted(found))
+
+    def instances(self, jobtype: str) -> int:
+        return self.get_int(keys.jobtype_key(jobtype, keys.INSTANCES_SUFFIX), 0)
+
+    def untracked_types(self) -> frozenset[str]:
+        return frozenset(self.get_list(keys.APPLICATION_UNTRACKED_TYPES))
+
+    def tracked_types(self) -> tuple[str, ...]:
+        untracked = self.untracked_types()
+        return tuple(t for t in self.job_types() if t not in untracked)
+
+    def dependencies(self) -> dict[str, dict[str, int]]:
+        """{depender: {dependee: timeout_ms}} from dependency.* keys."""
+        out: dict[str, dict[str, int]] = {}
+        pat = re.compile(
+            re.escape(keys.DEPENDENCY_PREFIX) + r"([A-Za-z0-9_\-]+)\.timeout\.after\.([A-Za-z0-9_\-]+)$"
+        )
+        for k, v in self._data.items():
+            m = pat.match(k)
+            if m:
+                out.setdefault(m.group(1), {})[m.group(2)] = parse_time_ms(v)
+        return out
+
+    # -- freeze / artifact I/O --------------------------------------------
+    def freeze(self) -> "TonyConfig":
+        self._frozen = True
+        return self
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen
+
+    def to_dict(self) -> dict[str, str]:
+        return dict(self._data)
+
+    def write_final(self, directory: str | os.PathLike) -> str:
+        """Write the frozen whole-job artifact (tony-final.xml analog)."""
+        path = os.path.join(os.fspath(directory), constants.TONY_FINAL_CONF)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load_final(cls, path: str | os.PathLike) -> "TonyConfig":
+        """Load a frozen artifact verbatim (no re-layering of defaults)."""
+        with open(path) as f:
+            cfg = cls(json.load(f), with_defaults=False)
+        cfg.freeze()
+        return cfg
+
+    @classmethod
+    def from_layers(
+        cls,
+        site_file: str | None = None,
+        conf_file: str | None = None,
+        conf_args: list[str] | None = None,
+    ) -> "TonyConfig":
+        """defaults ← site ← conf_file ← --conf k=v (reference layer order)."""
+        cfg = cls()
+        if site_file and os.path.exists(site_file):
+            cfg.load_file(site_file)
+        if conf_file:
+            cfg.load_file(conf_file)
+        if conf_args:
+            cfg.set_kv_args(conf_args)
+        return cfg
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __repr__(self) -> str:
+        return f"TonyConfig({len(self._data)} keys, frozen={self._frozen})"
+
+
+def _parse_hadoop_xml(path: str) -> dict[str, str]:
+    """Parse ``<configuration><property><name/><value/></property>...`` files."""
+    root = ET.parse(path).getroot()
+    out: dict[str, str] = {}
+    for prop in root.iter("property"):
+        name = prop.findtext("name")
+        if name is None:
+            raise ValueError(f"{path}: <property> missing <name>")
+        out[name.strip()] = (prop.findtext("value") or "").strip()
+    return out

@@ -1,0 +1,195 @@
+"""The ``tony.*`` configuration-key namespace, with defaults.
+
+Analog of the reference's ``TonyConfigurationKeys.java`` plus
+``tony-core/src/main/resources/tony-default.xml`` (SURVEY.md §2.1, §5.6):
+every knob the framework reads is declared here, with its default, so the
+config-completeness unit test (mirroring TestTonyConfigurationFields) can
+assert the registry and the defaults artifact never drift apart.
+
+Naming keeps the reference's dotted namespace (``tony.application.*``,
+``tony.am.*``, ``tony.task.*``, per-job-type ``tony.<jobtype>.*``) so configs
+look familiar; TPU-specific keys replace GPU/YARN ones (``tony.<type>.gpus`` →
+``tony.<type>.chips`` / ``tony.<type>.slice``).
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# tony.application.* — job-level
+# ---------------------------------------------------------------------------
+APPLICATION_NAME = "tony.application.name"
+APPLICATION_QUEUE = "tony.application.queue"
+APPLICATION_FRAMEWORK = "tony.application.framework"      # jax|tensorflow|pytorch|horovod|mxnet|generic
+APPLICATION_UNTRACKED_TYPES = "tony.application.untracked.jobtypes"  # csv; don't gate job verdict
+APPLICATION_NODE_LABEL = "tony.application.node-label"
+APPLICATION_SECURITY_ENABLED = "tony.application.security.enabled"
+APPLICATION_PREPARE_STAGE_TIMEOUT_MS = "tony.application.prepare-timeout-ms"
+# dependency ordering: tony.application.dependency.<A>.timeout.after.<B> = ms
+DEPENDENCY_PREFIX = "tony.application.dependency."
+APPLICATION_TAGS = "tony.application.tags"
+
+# ---------------------------------------------------------------------------
+# tony.am.* — application master
+# ---------------------------------------------------------------------------
+AM_RETRY_COUNT = "tony.am.retry-count"
+AM_RPC_PORT = "tony.am.rpc.port"                  # 0 = ephemeral
+AM_GANG_TIMEOUT_MS = "tony.am.gang-timeout-ms"    # max wait for full gang registration
+AM_MONITOR_INTERVAL_MS = "tony.am.monitor-interval-ms"
+AM_MEMORY = "tony.am.memory"
+AM_VCORES = "tony.am.vcores"
+
+# ---------------------------------------------------------------------------
+# tony.task.* — executor / liveness contract
+# ---------------------------------------------------------------------------
+TASK_HEARTBEAT_INTERVAL_MS = "tony.task.heartbeat-interval-ms"
+TASK_MAX_MISSED_HEARTBEATS = "tony.task.max-missed-heartbeats"
+TASK_METRICS_INTERVAL_MS = "tony.task.metrics-interval-ms"
+TASK_EXECUTOR_REGISTRATION_TIMEOUT_MS = "tony.task.registration-timeout-ms"
+TASK_EXECUTOR_EXECUTION_TIMEOUT_MS = "tony.task.execution-timeout-ms"  # 0 = unlimited
+TASK_RESTART_ON_FAILURE = "tony.task.restart-on-failure"  # gang-restart-from-checkpoint
+TASK_MAX_TOTAL_INSTANCE_FAILURES = "tony.task.max-total-instance-failures"
+
+# ---------------------------------------------------------------------------
+# Per-job-type parameterized keys: tony.<jobtype>.<suffix>
+# (analog: tony.<jobtype>.{instances,memory,vcores,gpus}; gpus→chips/slice)
+# ---------------------------------------------------------------------------
+INSTANCES_SUFFIX = "instances"
+MEMORY_SUFFIX = "memory"
+VCORES_SUFFIX = "vcores"
+CHIPS_SUFFIX = "chips"          # TPU chips per task (reference: gpus)
+SLICE_SUFFIX = "slice"          # TPU slice spec per task gang, e.g. "v5e-8" or "2x4"
+COMMAND_SUFFIX = "command"      # per-type command override (reference: tony.<type>.command)
+
+
+def jobtype_key(jobtype: str, suffix: str) -> str:
+    """`tony.<jobtype>.<suffix>` — per-type parameterized key."""
+    return f"tony.{jobtype}.{suffix}"
+
+
+def dependency_key(depender: str, dependee: str) -> str:
+    """`tony.application.dependency.<A>.timeout.after.<B>` — A starts after B."""
+    return f"{DEPENDENCY_PREFIX}{depender}.timeout.after.{dependee}"
+
+
+# ---------------------------------------------------------------------------
+# tony.docker.* — container image passthrough (reference parity)
+# ---------------------------------------------------------------------------
+DOCKER_ENABLED = "tony.docker.enabled"
+DOCKER_IMAGE = "tony.docker.containers.image"
+
+# ---------------------------------------------------------------------------
+# tony.keytab.* — security analog (no Kerberos here; shared-secret auth)
+# ---------------------------------------------------------------------------
+KEYTAB_USER = "tony.keytab.user"
+KEYTAB_LOCATION = "tony.keytab.location"
+
+# ---------------------------------------------------------------------------
+# tony.tpu.* — TPU-native resource model (replaces GPU-on-YARN)
+# ---------------------------------------------------------------------------
+TPU_POOL_SPEC = "tony.tpu.pool"                 # RM inventory, e.g. "v5e-64" or "host:v5e,8x8"
+TPU_ACCELERATOR_TYPE = "tony.tpu.accelerator-type"  # v5e | v5p | v4 | cpu
+TPU_ICI_STRICT = "tony.tpu.ici-strict"          # never split a slice across DCN
+TPU_CHIPS_PER_HOST = "tony.tpu.chips-per-host"
+
+# ---------------------------------------------------------------------------
+# tony.history.* / tony.portal.* — events, history, portal
+# ---------------------------------------------------------------------------
+HISTORY_LOCATION = "tony.history.location"
+HISTORY_MOVE_INTERVAL_MS = "tony.history.move-interval-ms"
+PORTAL_PORT = "tony.portal.port"
+
+# ---------------------------------------------------------------------------
+# tony.checkpoint.* — gang-restart-from-checkpoint (rebuild-only; SURVEY §5.3/5.4)
+# ---------------------------------------------------------------------------
+CHECKPOINT_DIR = "tony.checkpoint.dir"
+CHECKPOINT_INTERVAL_STEPS = "tony.checkpoint.interval-steps"
+CHECKPOINT_MAX_TO_KEEP = "tony.checkpoint.max-to-keep"
+CHECKPOINT_ASYNC = "tony.checkpoint.async"
+
+# ---------------------------------------------------------------------------
+# Submission-time keys filled by client (paths, venv, shell env)
+# ---------------------------------------------------------------------------
+EXECUTES = "tony.submit.executes"               # user training command
+SRC_DIR = "tony.submit.src-dir"
+PYTHON_BINARY_PATH = "tony.submit.python-binary-path"
+PYTHON_VENV = "tony.submit.python-venv"
+SHELL_ENV = "tony.submit.shell-env"             # csv k=v extra env
+STAGING_ROOT = "tony.submit.staging-root"
+
+# ---------------------------------------------------------------------------
+# Defaults — the tony-default.xml analog. Single source of truth.
+# ---------------------------------------------------------------------------
+DEFAULTS: dict[str, str] = {
+    APPLICATION_NAME: "tony-tpu-app",
+    APPLICATION_QUEUE: "default",
+    APPLICATION_FRAMEWORK: "jax",
+    APPLICATION_UNTRACKED_TYPES: "ps,tensorboard,notebook",
+    APPLICATION_NODE_LABEL: "",
+    APPLICATION_SECURITY_ENABLED: "true",
+    APPLICATION_PREPARE_STAGE_TIMEOUT_MS: "60000",
+    APPLICATION_TAGS: "",
+
+    AM_RETRY_COUNT: "0",
+    AM_RPC_PORT: "0",
+    AM_GANG_TIMEOUT_MS: "300000",
+    AM_MONITOR_INTERVAL_MS: "200",
+    AM_MEMORY: "2g",
+    AM_VCORES: "1",
+
+    TASK_HEARTBEAT_INTERVAL_MS: "1000",
+    TASK_MAX_MISSED_HEARTBEATS: "25",
+    TASK_METRICS_INTERVAL_MS: "5000",
+    TASK_EXECUTOR_REGISTRATION_TIMEOUT_MS: "60000",
+    TASK_EXECUTOR_EXECUTION_TIMEOUT_MS: "0",
+    TASK_RESTART_ON_FAILURE: "false",
+    TASK_MAX_TOTAL_INSTANCE_FAILURES: "0",
+
+    DOCKER_ENABLED: "false",
+    DOCKER_IMAGE: "",
+
+    KEYTAB_USER: "",
+    KEYTAB_LOCATION: "",
+
+    TPU_POOL_SPEC: "local:cpu,1x1",
+    TPU_ACCELERATOR_TYPE: "cpu",
+    TPU_ICI_STRICT: "true",
+    TPU_CHIPS_PER_HOST: "4",
+
+    HISTORY_LOCATION: "",            # empty → <staging-root>/history
+    HISTORY_MOVE_INTERVAL_MS: "1000",
+    PORTAL_PORT: "28080",
+
+    CHECKPOINT_DIR: "",
+    CHECKPOINT_INTERVAL_STEPS: "0",
+    CHECKPOINT_MAX_TO_KEEP: "3",
+    CHECKPOINT_ASYNC: "true",
+
+    EXECUTES: "",
+    SRC_DIR: "",
+    PYTHON_BINARY_PATH: "",
+    PYTHON_VENV: "",
+    SHELL_ENV: "",
+    STAGING_ROOT: "",                # empty → constants.default_tony_root()
+}
+
+# Known per-jobtype suffixes, for validation + docs.
+JOBTYPE_SUFFIXES = (
+    INSTANCES_SUFFIX,
+    MEMORY_SUFFIX,
+    VCORES_SUFFIX,
+    CHIPS_SUFFIX,
+    SLICE_SUFFIX,
+    COMMAND_SUFFIX,
+)
+
+
+def all_known_keys() -> frozenset[str]:
+    """Every fixed (non-parameterized) key declared in this module."""
+    return frozenset(
+        v
+        for k, v in globals().items()
+        if isinstance(v, str)
+        and k.isupper()
+        and v.startswith("tony.")
+        and not k.endswith("_PREFIX")  # key-family prefixes are parameterized, not fixed keys
+    )

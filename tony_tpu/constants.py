@@ -1,0 +1,112 @@
+"""Well-known names shared across the framework.
+
+Analog of the reference's ``tony-core/.../tony/Constants.java`` (SURVEY.md §2.1):
+frozen-config artifact name, staging-dir layout, env-var names forming the
+executor↔user-process contract, and TPU-specific additions (slice coordinates,
+jax.distributed rendezvous) that replace the reference's GPU/YARN names.
+"""
+
+from __future__ import annotations
+
+import os
+
+# ---------------------------------------------------------------------------
+# Artifact / directory names (analog: Constants.TONY_FINAL_XML, ".tony/" staging)
+# ---------------------------------------------------------------------------
+TONY_FINAL_CONF = "tony-final.json"     # frozen job conf shipped to AM/executors
+TONY_DEFAULT_CONF = "tony-default.json"  # packaged defaults (tony-default.xml analog)
+TONY_SITE_CONF = "tony-site.json"       # cluster-level overrides
+TONY_STAGING_DIRNAME = ".tony"          # per-app staging root
+AM_INFO_FILE = "am_info.json"           # AM host/port/secret advertisement (YARN report analog)
+CONFIG_SNAPSHOT_FILE = "config.json"    # job conf written alongside history (HistoryFileUtils)
+HISTORY_SUFFIX = ".jhist"               # history event file suffix (Avro .jhist analog → JSONL)
+HISTORY_INTERMEDIATE_DIR = "intermediate"
+HISTORY_FINISHED_DIR = "finished"
+TASK_LOG_DIRNAME = "logs"
+
+# ---------------------------------------------------------------------------
+# Env-var contract: AM/executor plumbing
+# (analog: Constants.java env names CLUSTER_SPEC, JOB_NAME, TASK_INDEX, ...)
+# ---------------------------------------------------------------------------
+ENV_APP_ID = "TONY_APP_ID"
+ENV_AM_HOST = "TONY_AM_HOST"
+ENV_AM_PORT = "TONY_AM_PORT"
+ENV_AM_SECRET = "TONY_AM_SECRET"
+ENV_STAGING_DIR = "TONY_STAGING_DIR"
+ENV_CONTAINER_ID = "TONY_CONTAINER_ID"
+
+ENV_JOB_NAME = "JOB_NAME"               # task type, e.g. "worker"
+ENV_TASK_INDEX = "TASK_INDEX"           # index within the type
+ENV_TASK_NUM = "TASK_NUM"               # instances of this type
+ENV_DISTRIBUTED_MODE = "DISTRIBUTED_MODE"  # GANG | SINGLE_NODE
+ENV_CLUSTER_SPEC = "CLUSTER_SPEC"       # full cluster spec JSON (legacy TF contract)
+ENV_TB_PORT = "TB_PORT"                 # tensorboard task port
+
+# ---------------------------------------------------------------------------
+# Env-var contract: framework rendezvous (runtime adapters, SURVEY.md §2.2)
+# ---------------------------------------------------------------------------
+ENV_TF_CONFIG = "TF_CONFIG"
+ENV_RANK = "RANK"
+ENV_WORLD_SIZE = "WORLD_SIZE"
+ENV_LOCAL_RANK = "LOCAL_RANK"
+ENV_MASTER_ADDR = "MASTER_ADDR"
+ENV_MASTER_PORT = "MASTER_PORT"
+ENV_INIT_METHOD = "INIT_METHOD"
+ENV_DMLC_ROLE = "DMLC_ROLE"
+ENV_DMLC_PS_ROOT_URI = "DMLC_PS_ROOT_URI"
+ENV_DMLC_PS_ROOT_PORT = "DMLC_PS_ROOT_PORT"
+ENV_DMLC_NUM_SERVER = "DMLC_NUM_SERVER"
+ENV_DMLC_NUM_WORKER = "DMLC_NUM_WORKER"
+ENV_HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
+ENV_HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
+ENV_HOROVOD_GLOO_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+ENV_HOROVOD_GLOO_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+ENV_HOROVOD_RANK = "HOROVOD_RANK"
+ENV_HOROVOD_SIZE = "HOROVOD_SIZE"
+ENV_HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+ENV_HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+ENV_HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+ENV_HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+
+# ---------------------------------------------------------------------------
+# Env-var contract: TPU-native additions (replace nvidia-smi / CUDA_VISIBLE_DEVICES)
+# ---------------------------------------------------------------------------
+ENV_JAX_COORDINATOR = "JAX_COORDINATOR_ADDRESS"   # host:port for jax.distributed
+ENV_JAX_PROCESS_ID = "JAX_PROCESS_ID"
+ENV_JAX_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_TPU_SLICE_NAME = "TPU_SLICE_NAME"             # e.g. "v5e-64"
+ENV_TPU_SLICE_TOPOLOGY = "TPU_SLICE_TOPOLOGY"     # e.g. "8x8"
+ENV_TPU_CHIP_COORDS = "TPU_CHIP_COORDS"           # this task's chip coords within slice, JSON
+ENV_TPU_CHIPS_PER_TASK = "TPU_CHIPS_PER_TASK"
+
+# ---------------------------------------------------------------------------
+# Task types with built-in behavior (analog: Constants.java well-known job names)
+# ---------------------------------------------------------------------------
+CHIEF_JOB_NAME = "chief"
+WORKER_JOB_NAME = "worker"
+PS_JOB_NAME = "ps"
+EVALUATOR_JOB_NAME = "evaluator"
+TENSORBOARD_JOB_NAME = "tensorboard"
+NOTEBOOK_JOB_NAME = "notebook"
+DRIVER_JOB_NAME = "driver"
+
+# Exit codes (analog of TonY's exit-code conventions)
+EXIT_SUCCESS = 0
+EXIT_FAILURE = 1
+EXIT_AM_ERROR = 10
+EXIT_EXECUTOR_REGISTRATION_FAILED = 11
+EXIT_HEARTBEAT_LOST = 12
+EXIT_KILLED = 137
+
+# Distributed-mode values
+DISTRIBUTED_MODE_GANG = "GANG"
+DISTRIBUTED_MODE_SINGLE_NODE = "SINGLE_NODE"
+
+
+def default_tony_root() -> str:
+    """Root directory for staging + history when not configured.
+
+    (The reference stages to ``hdfs://.../.tony``; with no HDFS in a TPU-VM
+    world we stage to a local/shared filesystem path.)
+    """
+    return os.environ.get("TONY_ROOT", os.path.join(os.path.expanduser("~"), TONY_STAGING_DIRNAME))

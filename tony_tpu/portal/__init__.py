@@ -1,0 +1,1 @@
+"""History web portal (tony-portal analog)."""

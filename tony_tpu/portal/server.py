@@ -1,0 +1,156 @@
+"""History web portal.
+
+Analog of the reference's ``tony-portal`` Play application (SURVEY.md §2.3):
+a job-list page, per-job detail (event timeline + task table), and the frozen
+config view, read from the ``.jhist`` JSONL + ``config.json`` files the AM
+finalizes. Stdlib http.server — the portal is an ops convenience, not a
+dependency of the control plane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import os
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+from tony_tpu import constants
+from tony_tpu.cluster import history
+
+_STYLE = """
+body{font-family:system-ui,sans-serif;margin:2em;color:#222}
+table{border-collapse:collapse;min-width:40em}
+td,th{border:1px solid #ccc;padding:4px 10px;text-align:left}
+th{background:#f0f0f0} a{color:#0645ad;text-decoration:none}
+.SUCCEEDED{color:#080} .FAILED{color:#b00} .KILLED{color:#850} .LOST{color:#b00}
+pre{background:#f6f6f6;padding:1em;overflow-x:auto}
+"""
+
+
+def _page(title: str, body: str) -> bytes:
+    return (
+        f"<!doctype html><html><head><title>{html.escape(title)}</title>"
+        f"<style>{_STYLE}</style></head><body><h1>{html.escape(title)}</h1>"
+        f'<p><a href="/">← jobs</a></p>{body}</body></html>'
+    ).encode()
+
+
+class PortalHandler(BaseHTTPRequestHandler):
+    history_root = ""
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    def _send(self, content: bytes, status: int = 200, ctype: str = "text/html") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(content)))
+        self.end_headers()
+        self.wfile.write(content)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+        path = urlparse(self.path).path.rstrip("/")
+        try:
+            if path == "":
+                self._send(self._job_list())
+            elif path.startswith("/job/"):
+                parts = path.split("/")
+                app_id = parts[2]
+                if len(parts) > 3 and parts[3] == "config":
+                    self._send(self._job_config(app_id))
+                else:
+                    self._send(self._job_detail(app_id))
+            elif path == "/api/jobs":
+                jobs = [vars(j) for j in history.list_finished_jobs(self.history_root)]
+                self._send(json.dumps(jobs).encode(), ctype="application/json")
+            else:
+                self._send(_page("not found", "<p>404</p>"), status=404)
+        except Exception as e:  # noqa: BLE001 — a bad file must not kill the portal
+            self._send(_page("error", f"<pre>{html.escape(str(e))}</pre>"), status=500)
+
+    def _job_list(self) -> bytes:
+        rows = []
+        for j in history.list_finished_jobs(self.history_root):
+            dur = max(j.completed_ms - j.started_ms, 0) / 1000
+            rows.append(
+                f'<tr><td><a href="/job/{j.app_id}">{html.escape(j.app_id)}</a></td>'
+                f'<td class="{j.status}">{j.status}</td><td>{dur:.1f}s</td>'
+                f"<td>{html.escape(j.user)}</td></tr>"
+            )
+        table = (
+            "<table><tr><th>application</th><th>status</th><th>duration</th><th>user</th></tr>"
+            + "".join(rows)
+            + "</table>"
+        ) if rows else "<p>no finished jobs yet</p>"
+        return _page("tony-tpu job history", table)
+
+    def _job_detail(self, app_id: str) -> bytes:
+        evs = history.read_events(self.history_root, app_id)
+        if not evs:
+            return _page(app_id, "<p>no events found</p>")
+        tasks_html = ""
+        for ev in evs:
+            if ev.type.value == "APPLICATION_FINISHED":
+                rows = "".join(
+                    f"<tr><td>{t['name']}:{t['index']}</td>"
+                    f'<td class="{t["status"]}">{t["status"]}</td>'
+                    f"<td>{t.get('exit_code')}</td><td>{html.escape(str(t.get('host') or ''))}</td></tr>"
+                    for t in ev.payload.get("tasks", [])
+                )
+                tasks_html = (
+                    "<h2>tasks</h2><table><tr><th>task</th><th>status</th>"
+                    f"<th>exit</th><th>host</th></tr>{rows}</table>"
+                )
+        timeline = "".join(
+            f"<tr><td>{ev.timestamp_ms}</td><td>{ev.type.value}</td>"
+            f"<td><pre style='margin:0'>{html.escape(json.dumps(ev.payload)[:500])}</pre></td></tr>"
+            for ev in evs
+        )
+        body = (
+            f'<p><a href="/job/{app_id}/config">frozen config</a></p>'
+            + tasks_html
+            + f"<h2>events</h2><table><tr><th>ts</th><th>type</th><th>payload</th></tr>{timeline}</table>"
+        )
+        return _page(app_id, body)
+
+    def _job_config(self, app_id: str) -> bytes:
+        for j in history.list_finished_jobs(self.history_root):
+            if j.app_id == app_id:
+                path = os.path.join(
+                    history.finished_dir(self.history_root, app_id, j.completed_ms),
+                    constants.CONFIG_SNAPSHOT_FILE,
+                )
+                if os.path.exists(path):
+                    cfg = json.load(open(path))
+                    body = "<pre>" + html.escape(json.dumps(cfg, indent=1, sort_keys=True)) + "</pre>"
+                    return _page(f"{app_id} config", body)
+        return _page(app_id, "<p>no config snapshot</p>")
+
+
+def serve(history_root: str, port: int = 28080) -> ThreadingHTTPServer:
+    handler = type("Handler", (PortalHandler,), {"history_root": history_root})
+    server = ThreadingHTTPServer(("0.0.0.0", port), handler)
+    return server
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tony portal")
+    p.add_argument("--root", default=None)
+    p.add_argument("--port", type=int, default=28080)
+    args = p.parse_args(argv)
+    root = args.root or os.path.join(constants.default_tony_root(), "history")
+    server = serve(root, args.port)
+    print(f"[tony-portal] serving {root} on http://0.0.0.0:{args.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,33 @@
+"""Framework runtime adapters (reference tony-core runtime/ analog).
+
+``get_runtime(config)`` selects the adapter from
+``tony.application.framework``; ``init_distributed()`` is the user-side helper
+that consumes the env contract the JaxRuntime injects.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tony_tpu import constants
+from tony_tpu.runtime.base import Framework, FrameworkRuntime, get_runtime  # noqa: F401
+
+
+def init_distributed() -> None:
+    """Join the job's jax.distributed process group from injected env.
+
+    Called at the top of TPU-native user programs (the analog of user TF code
+    reading TF_CONFIG). No-op for single-process jobs or when the contract env
+    is absent, so the same script runs under `tony submit` and bare python.
+    """
+    coord = os.environ.get(constants.ENV_JAX_COORDINATOR)
+    n = int(os.environ.get(constants.ENV_JAX_NUM_PROCESSES, "1"))
+    if not coord or n <= 1:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=n,
+        process_id=int(os.environ[constants.ENV_JAX_PROCESS_ID]),
+    )

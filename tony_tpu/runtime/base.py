@@ -1,0 +1,115 @@
+"""Framework runtime adapter interface.
+
+Analog of the reference's ``tony-core/.../tony/runtime/`` (``Framework`` enum,
+``FrameworkRuntime`` factory/interface, ``MLGenericRuntime`` base —
+SURVEY.md §2.2). An adapter has hooks on **both sides** of the control plane,
+exactly like the reference:
+
+- AM side: validate the job conf, observe registrations, and contribute
+  per-task extra env once the gang is complete (the Horovod driver's
+  slot-plan/rendezvous is the reference case for this hook).
+- Executor side: turn (cluster spec, my identity) into the env contract the
+  user process expects (TF_CONFIG / torch rendezvous / jax.distributed ...).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import TYPE_CHECKING
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig
+
+if TYPE_CHECKING:
+    from tony_tpu.cluster.session import Session
+
+
+class Framework(enum.Enum):
+    JAX = "jax"
+    TENSORFLOW = "tensorflow"
+    PYTORCH = "pytorch"
+    HOROVOD = "horovod"
+    MXNET = "mxnet"
+    GENERIC = "generic"
+
+    @classmethod
+    def from_config(cls, config: TonyConfig) -> "Framework":
+        from tony_tpu.config import keys
+
+        name = (config.get(keys.APPLICATION_FRAMEWORK) or "generic").strip().lower()
+        try:
+            return cls(name)
+        except ValueError:
+            raise ValueError(
+                f"unknown tony.application.framework {name!r}; "
+                f"expected one of {[f.value for f in cls]}"
+            ) from None
+
+
+class FrameworkRuntime:
+    """Base adapter = the MLGenericRuntime analog: generic env only."""
+
+    def __init__(self, config: TonyConfig):
+        self.config = config
+
+    # -- AM-side hooks -----------------------------------------------------
+    def validate(self) -> None:
+        """Raise on an invalid conf for this framework (AM prepare-time)."""
+
+    def on_gang_complete(self, session: "Session") -> None:
+        """Called once when every task has registered (spec is complete)."""
+
+    def am_extra_env(self, session: "Session", job_name: str, index: int) -> dict[str, str]:
+        """Per-task env contributed by the AM side (e.g. Horovod rank plan)."""
+        return {}
+
+    # -- executor-side hooks ----------------------------------------------
+    def executor_env(
+        self,
+        cluster_spec: dict[str, list[str]],
+        job_name: str,
+        index: int,
+    ) -> dict[str, str]:
+        """Env for the user process, built from the complete cluster spec.
+
+        Base contract (every adapter inherits it): JOB_NAME / TASK_INDEX /
+        TASK_NUM / DISTRIBUTED_MODE / CLUSTER_SPEC.
+        """
+        import json
+
+        total = sum(len(v) for v in cluster_spec.values())
+        return {
+            constants.ENV_JOB_NAME: job_name,
+            constants.ENV_TASK_INDEX: str(index),
+            constants.ENV_TASK_NUM: str(len(cluster_spec.get(job_name, []))),
+            constants.ENV_DISTRIBUTED_MODE: (
+                constants.DISTRIBUTED_MODE_SINGLE_NODE if total <= 1 else constants.DISTRIBUTED_MODE_GANG
+            ),
+            constants.ENV_CLUSTER_SPEC: json.dumps(cluster_spec),
+        }
+
+
+def get_runtime(config: TonyConfig) -> FrameworkRuntime:
+    """Factory (the reference's Framework enum → runtime selection)."""
+    fw = Framework.from_config(config)
+    if fw == Framework.JAX:
+        from tony_tpu.runtime.jax_runtime import JaxRuntime
+
+        return JaxRuntime(config)
+    if fw == Framework.TENSORFLOW:
+        from tony_tpu.runtime.tf_runtime import TFRuntime
+
+        return TFRuntime(config)
+    if fw == Framework.PYTORCH:
+        from tony_tpu.runtime.torch_runtime import TorchRuntime
+
+        return TorchRuntime(config)
+    if fw == Framework.HOROVOD:
+        from tony_tpu.runtime.horovod_runtime import HorovodRuntime
+
+        return HorovodRuntime(config)
+    if fw == Framework.MXNET:
+        from tony_tpu.runtime.mxnet_runtime import MXNetRuntime
+
+        return MXNetRuntime(config)
+    return FrameworkRuntime(config)

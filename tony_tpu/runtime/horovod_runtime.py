@@ -1,0 +1,74 @@
+"""Horovod runtime adapter: AM-side driver plan + worker rank env.
+
+Analog of the reference's ``runtime/HorovodRuntime.java`` (SURVEY.md §2.2,
+§3.3) — the one adapter where the AM participates in rendezvous: it builds the
+host/slot plan from all registrations (AM-side hook), then hands each worker
+its rank/local-rank/cross-rank coordinates plus the rendezvous address via the
+cluster-spec response. In the reference the ring then forms worker-to-worker
+over Gloo/NCCL; here the "ring" is the ICI mesh and the rendezvous collapses
+into ``jax.distributed`` bootstrap, so we export BOTH env families:
+``HOROVOD_*`` (drop-in for horovod-style user scripts) and the jax coordinator
+contract (what a TPU job actually consumes).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from tony_tpu import constants
+from tony_tpu.runtime.base import FrameworkRuntime
+from tony_tpu.runtime.jax_runtime import canonical_task_order, coordinator_address
+
+if False:  # typing only
+    from tony_tpu.cluster.session import Session
+
+
+class HorovodRuntime(FrameworkRuntime):
+    def __init__(self, config):
+        super().__init__(config)
+        self._plan: dict[tuple[str, int], dict[str, str]] = {}
+
+    # -- AM side: the driver's slot plan ----------------------------------
+    def on_gang_complete(self, session: "Session") -> None:
+        spec = session.cluster_spec()
+        assert spec is not None
+        order = canonical_task_order(spec)
+        size = len(order)
+
+        # group ranks by host → local ranks; hosts in first-seen order → cross ranks
+        host_of: dict[tuple[str, int], str] = {}
+        by_host: dict[str, list[tuple[str, int]]] = defaultdict(list)
+        for t, i in order:
+            host = spec[t][i].rsplit(":", 1)[0]
+            host_of[(t, i)] = host
+            by_host[host].append((t, i))
+        hosts = list(by_host.keys())
+
+        rendezvous = coordinator_address(spec)
+        rdv_host, _, rdv_port = rendezvous.rpartition(":")
+        for rank, (t, i) in enumerate(order):
+            host = host_of[(t, i)]
+            self._plan[(t, i)] = {
+                constants.ENV_HOROVOD_CONTROLLER: "gloo",
+                constants.ENV_HOROVOD_CPU_OPERATIONS: "gloo",
+                constants.ENV_HOROVOD_GLOO_RENDEZVOUS_ADDR: rdv_host,
+                constants.ENV_HOROVOD_GLOO_RENDEZVOUS_PORT: rdv_port,
+                constants.ENV_HOROVOD_RANK: str(rank),
+                constants.ENV_HOROVOD_SIZE: str(size),
+                constants.ENV_HOROVOD_LOCAL_RANK: str(by_host[host].index((t, i))),
+                constants.ENV_HOROVOD_LOCAL_SIZE: str(len(by_host[host])),
+                constants.ENV_HOROVOD_CROSS_RANK: str(hosts.index(host)),
+                constants.ENV_HOROVOD_CROSS_SIZE: str(len(hosts)),
+            }
+
+    def am_extra_env(self, session: "Session", job_name: str, index: int) -> dict[str, str]:
+        return dict(self._plan.get((job_name, index), {}))
+
+    # -- executor side -----------------------------------------------------
+    def executor_env(self, cluster_spec: dict[str, list[str]], job_name: str, index: int) -> dict[str, str]:
+        env = super().executor_env(cluster_spec, job_name, index)
+        order = canonical_task_order(cluster_spec)
+        env[constants.ENV_JAX_COORDINATOR] = coordinator_address(cluster_spec)
+        env[constants.ENV_JAX_PROCESS_ID] = str(order.index((job_name, index)))
+        env[constants.ENV_JAX_NUM_PROCESSES] = str(len(order))
+        return env

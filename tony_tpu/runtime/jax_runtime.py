@@ -1,0 +1,52 @@
+"""JAX runtime adapter — the TPU-native first-class runtime.
+
+This is the adapter the reference never had (its closest analogs are
+TFRuntime/HorovodRuntime rendezvous — SURVEY.md §2.2): it injects the
+``jax.distributed.initialize`` contract so every task joins one JAX process
+group whose collectives ride ICI/DCN via XLA:
+
+- coordinator = the rank-0 task's registered address (chief if declared,
+  else the first task in canonical order),
+- ``JAX_PROCESS_ID`` = canonical global rank, ``JAX_NUM_PROCESSES`` = gang size.
+
+User code then just calls ``tony_tpu.runtime.init_distributed()`` (or plain
+``jax.distributed.initialize()`` reading these env vars).
+"""
+
+from __future__ import annotations
+
+from tony_tpu import constants
+from tony_tpu.runtime.base import FrameworkRuntime
+
+
+def canonical_task_order(cluster_spec: dict[str, list[str]]) -> list[tuple[str, int]]:
+    """Deterministic global rank order: chief first, then remaining types
+    alphabetically, each type by index. Every adapter that needs a global
+    rank (jax, pytorch, horovod) uses this one ordering."""
+    order: list[tuple[str, int]] = []
+    types = sorted(cluster_spec.keys())
+    if constants.CHIEF_JOB_NAME in cluster_spec:
+        types.remove(constants.CHIEF_JOB_NAME)
+        types.insert(0, constants.CHIEF_JOB_NAME)
+    for t in types:
+        order.extend((t, i) for i in range(len(cluster_spec[t])))
+    return order
+
+
+def global_rank(cluster_spec: dict[str, list[str]], job_name: str, index: int) -> int:
+    return canonical_task_order(cluster_spec).index((job_name, index))
+
+
+def coordinator_address(cluster_spec: dict[str, list[str]]) -> str:
+    t, i = canonical_task_order(cluster_spec)[0]
+    return cluster_spec[t][i]
+
+
+class JaxRuntime(FrameworkRuntime):
+    def executor_env(self, cluster_spec: dict[str, list[str]], job_name: str, index: int) -> dict[str, str]:
+        env = super().executor_env(cluster_spec, job_name, index)
+        order = canonical_task_order(cluster_spec)
+        env[constants.ENV_JAX_COORDINATOR] = coordinator_address(cluster_spec)
+        env[constants.ENV_JAX_PROCESS_ID] = str(order.index((job_name, index)))
+        env[constants.ENV_JAX_NUM_PROCESSES] = str(len(order))
+        return env

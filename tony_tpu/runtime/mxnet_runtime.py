@@ -1,0 +1,28 @@
+"""MXNet runtime adapter: the DMLC PS-Lite env contract.
+
+Analog of the reference's ``runtime/MXNetRuntime.java`` (SURVEY.md §2.2,
+confidence [L] there — details follow the DMLC convention): the ``ps`` job
+type plays the DMLC server role, ``worker`` the worker role, and the root URI
+points at the first ps (or a dedicated ``scheduler`` type if declared).
+"""
+
+from __future__ import annotations
+
+from tony_tpu import constants
+from tony_tpu.runtime.base import FrameworkRuntime
+
+
+class MXNetRuntime(FrameworkRuntime):
+    _ROLE_MAP = {constants.PS_JOB_NAME: "server", "scheduler": "scheduler"}
+
+    def executor_env(self, cluster_spec: dict[str, list[str]], job_name: str, index: int) -> dict[str, str]:
+        env = super().executor_env(cluster_spec, job_name, index)
+        root_type = "scheduler" if "scheduler" in cluster_spec else constants.PS_JOB_NAME
+        root = cluster_spec.get(root_type, [None])[0] or next(iter(cluster_spec.values()))[0]
+        host, _, port = root.rpartition(":")
+        env[constants.ENV_DMLC_PS_ROOT_URI] = host
+        env[constants.ENV_DMLC_PS_ROOT_PORT] = port
+        env[constants.ENV_DMLC_ROLE] = self._ROLE_MAP.get(job_name, "worker")
+        env[constants.ENV_DMLC_NUM_SERVER] = str(len(cluster_spec.get(constants.PS_JOB_NAME, [])))
+        env[constants.ENV_DMLC_NUM_WORKER] = str(len(cluster_spec.get(constants.WORKER_JOB_NAME, [])))
+        return env

@@ -1,0 +1,25 @@
+"""TensorFlow runtime adapter: the TF_CONFIG contract.
+
+Analog of the reference's ``runtime/TFRuntime.java`` (SURVEY.md §2.2, §3.2):
+renders ``TF_CONFIG = {"cluster": {type: ["h:p", ...]}, "task": {"type": t,
+"index": i}}`` plus the legacy ``CLUSTER_SPEC`` env (inherited from the base
+contract), and surfaces the tensorboard task type.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tony_tpu import constants
+from tony_tpu.runtime.base import FrameworkRuntime
+
+
+class TFRuntime(FrameworkRuntime):
+    def executor_env(self, cluster_spec: dict[str, list[str]], job_name: str, index: int) -> dict[str, str]:
+        env = super().executor_env(cluster_spec, job_name, index)
+        # tensorboard is an observer, not a TF_CONFIG cluster member
+        cluster = {t: a for t, a in cluster_spec.items() if t != constants.TENSORBOARD_JOB_NAME}
+        env[constants.ENV_TF_CONFIG] = json.dumps(
+            {"cluster": cluster, "task": {"type": job_name, "index": index}}
+        )
+        return env

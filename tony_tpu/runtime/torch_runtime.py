@@ -1,0 +1,29 @@
+"""PyTorch / torch-xla runtime adapter: torch.distributed rendezvous env.
+
+Analog of the reference's ``runtime/PyTorchRuntime.java`` (SURVEY.md §2.2):
+coordinator = the rank-0 task's address; exports MASTER_ADDR / MASTER_PORT /
+RANK / WORLD_SIZE / LOCAL_RANK and a tcp:// INIT_METHOD. On TPU hosts,
+torch-xla's PJRT picks the device; DDP-style jobs map their all-reduce onto
+XLA collectives instead of NCCL (BASELINE.json config #3).
+"""
+
+from __future__ import annotations
+
+from tony_tpu import constants
+from tony_tpu.runtime.base import FrameworkRuntime
+from tony_tpu.runtime.jax_runtime import canonical_task_order, coordinator_address
+
+
+class TorchRuntime(FrameworkRuntime):
+    def executor_env(self, cluster_spec: dict[str, list[str]], job_name: str, index: int) -> dict[str, str]:
+        env = super().executor_env(cluster_spec, job_name, index)
+        order = canonical_task_order(cluster_spec)
+        coord = coordinator_address(cluster_spec)
+        host, _, port = coord.rpartition(":")
+        env[constants.ENV_MASTER_ADDR] = host
+        env[constants.ENV_MASTER_PORT] = port
+        env[constants.ENV_RANK] = str(order.index((job_name, index)))
+        env[constants.ENV_WORLD_SIZE] = str(len(order))
+        env[constants.ENV_LOCAL_RANK] = "0"  # one task per container
+        env[constants.ENV_INIT_METHOD] = f"tcp://{coord}"
+        return env
