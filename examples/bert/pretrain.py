@@ -1,0 +1,34 @@
+"""BERT MLM pretraining (BASELINE.json config #2 analog)."""
+import functools
+import sys
+
+import jax
+
+from tony_tpu.models import bert
+from tony_tpu.parallel import MeshSpec
+from tony_tpu.runtime import init_distributed
+from tony_tpu.train import OptimizerConfig, make_train_step, sharded_init
+from tony_tpu.train.loop import parse_loop_args
+
+
+def main() -> int:
+    init_distributed()
+    loop, extra = parse_loop_args()
+    cfg = bert.config_from_dict(extra["preset"])
+    mesh = MeshSpec.auto(model=loop.model_axis).build()
+    opt = OptimizerConfig(learning_rate=loop.learning_rate, warmup_steps=loop.warmup_steps,
+                          total_steps=loop.steps).build()
+    state = sharded_init(lambda: bert.init(jax.random.PRNGKey(0), cfg),
+                         bert.sharding_rules(cfg), mesh, opt)
+    step = make_train_step(functools.partial(bert.loss_fn, cfg=cfg, mesh=mesh), opt)
+    key = jax.random.PRNGKey(1)
+    for i in range(loop.steps):
+        batch = bert.synthetic_batch(jax.random.fold_in(key, i), loop.batch_size, loop.seq_len, cfg)
+        state, m = step(state, batch)
+        if (i + 1) % loop.log_every == 0:
+            print(f"step {i+1} loss={float(m['loss']):.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,20 @@
+"""Llama pretraining under `tony submit` (BASELINE.json config #4).
+
+    tony submit --conf_file examples/llama/tony.json \
+        --executes "python examples/llama/pretrain.py --preset llama3-8b --model_axis 4"
+"""
+import sys
+
+from tony_tpu.models import llama
+from tony_tpu.train.loop import parse_loop_args, run_lm_training
+
+
+def main() -> int:
+    loop, extra = parse_loop_args()
+    cfg = llama.config_from_dict(extra["preset"])
+    run_lm_training(llama, cfg, loop)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

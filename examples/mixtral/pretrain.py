@@ -1,0 +1,17 @@
+"""Mixtral MoE pretraining under `tony submit` (BASELINE.json config #5):
+expert-parallel over the `expert` mesh axis (--expert_axis N)."""
+import sys
+
+from tony_tpu.models import mixtral
+from tony_tpu.train.loop import parse_loop_args, run_lm_training
+
+
+def main() -> int:
+    loop, extra = parse_loop_args()
+    cfg = mixtral.config_from_dict(extra["preset"])
+    run_lm_training(mixtral, cfg, loop)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,29 @@
+"""MNIST-scale training under `tony submit` (BASELINE.json config #1; the
+tony-examples/mnist analog). Runs standalone or as a gang task."""
+import functools
+import sys
+
+import jax
+
+from tony_tpu.models import mlp
+from tony_tpu.runtime import init_distributed
+from tony_tpu.train import OptimizerConfig, TrainState, make_train_step
+
+
+def main() -> int:
+    init_distributed()
+    cfg = mlp.MLPConfig()
+    opt = OptimizerConfig(learning_rate=1e-3, warmup_steps=0, total_steps=200).build()
+    state = TrainState.create(mlp.init(jax.random.PRNGKey(0), cfg), opt)
+    step = make_train_step(functools.partial(mlp.loss_fn, cfg=cfg), opt)
+    key = jax.random.PRNGKey(1)
+    for i in range(200):
+        batch = mlp.synthetic_batch(jax.random.fold_in(key, i), 64, cfg)
+        state, m = step(state, batch)
+        if (i + 1) % 50 == 0:
+            print(f"step {i+1} loss={float(m['loss']):.4f} acc={float(m['accuracy']):.3f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,34 @@
+"""ResNet image-classification training (BASELINE.json config #3 analog)."""
+import functools
+import sys
+
+import jax
+
+from tony_tpu.models import resnet
+from tony_tpu.runtime import init_distributed
+from tony_tpu.train import OptimizerConfig, TrainState, make_train_step
+from tony_tpu.train.loop import parse_loop_args
+
+
+def main() -> int:
+    init_distributed()
+    loop, extra = parse_loop_args()
+    cfg = resnet.config_from_dict(extra["preset"])
+    opt = OptimizerConfig(learning_rate=loop.learning_rate, warmup_steps=loop.warmup_steps,
+                          total_steps=loop.steps).build()
+    params, bn_state = resnet.init(jax.random.PRNGKey(0), cfg)
+    state = TrainState.create(params, opt)
+    step = make_train_step(functools.partial(resnet.loss_fn, cfg=cfg), opt)
+    key = jax.random.PRNGKey(1)
+    for i in range(loop.steps):
+        batch = resnet.synthetic_batch(jax.random.fold_in(key, i), loop.batch_size, cfg)
+        batch["bn_state"] = bn_state
+        state, m = step(state, batch)
+        bn_state = m.pop("bn_state", bn_state)
+        if (i + 1) % loop.log_every == 0:
+            print(f"step {i+1} loss={float(m['loss']):.4f} acc={float(m['accuracy']):.3f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

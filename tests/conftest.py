@@ -10,11 +10,20 @@ jax is first imported.
 import os
 import sys
 
-# Must happen before any jax import anywhere in the test session.
+# Force the CPU platform with 8 virtual devices. The axon sitecustomize may
+# have imported jax at interpreter startup (registering the one-chip TPU
+# plugin), so setting env vars here is not enough — override via jax.config
+# before any backend initializes. Both env and config are set so subprocesses
+# spawned by E2E tests (AM/executors) inherit the CPU platform too.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # Repo root on sys.path so `import tony_tpu` works without install.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,191 @@
+"""Parallelism library tests on the 8-virtual-device CPU mesh (SURVEY.md §4
+strategy: multi-chip behavior without multi-chip hardware)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tony_tpu.ops.attention import attention_reference
+from tony_tpu.parallel import MeshSpec, ShardingRules, fsdp_spec_tree
+from tony_tpu.parallel.context import ring_attention, ulysses_attention
+from tony_tpu.parallel.expert import MoEConfig, capacity, moe_ffn, route
+from tony_tpu.parallel.pipeline import spmd_pipeline, split_layers_into_stages, stack_stages
+
+
+class TestMeshSpec:
+    def test_build_all_axes(self):
+        mesh = MeshSpec(data=2, fsdp=2, model=2).build()
+        assert mesh.shape == {"stage": 1, "data": 2, "fsdp": 2, "expert": 1, "context": 1, "model": 2}
+
+    def test_wrong_device_count_raises(self):
+        with pytest.raises(ValueError, match="devices"):
+            MeshSpec(data=3).build()
+
+    def test_auto_fills_fsdp(self):
+        spec = MeshSpec.auto(8, model=2)
+        assert spec.fsdp == 4 and spec.model == 2 and spec.num_devices == 8
+
+    def test_auto_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            MeshSpec.auto(8, model=3)
+
+    def test_dcn_discipline_rejects_ici_axis_spanning_slices(self):
+        spec = MeshSpec(model=8)
+        with pytest.raises(ValueError, match="ICI|DCN|slice"):
+            spec._check_dcn_discipline(num_slices=2)
+
+
+class TestShardingRules:
+    def test_first_match_wins_and_default_replicates(self):
+        rules = ShardingRules([(r"w$", P("fsdp", "model")), (r"w", P("model"))])
+        assert rules.spec_for("layers/w") == P("fsdp", "model")
+        assert rules.spec_for("layers/wx") == P("model")
+        assert rules.spec_for("bias") == P()
+
+    def test_spec_tree_paths(self):
+        params = {"a": {"w": jnp.zeros((4, 4)), "b": jnp.zeros((4,))}}
+        tree = ShardingRules([(r"a/w", P("fsdp", None))]).spec_tree(params)
+        assert tree["a"]["w"] == P("fsdp", None)
+        assert tree["a"]["b"] == P()
+
+    def test_fsdp_spec_tree_shards_largest_dim(self):
+        params = {"big": jnp.zeros((128, 64)), "small": jnp.zeros((4,))}
+        tree = fsdp_spec_tree(params, min_size=128)
+        assert tree["big"] == P("fsdp", None)
+        assert tree["small"] == P()
+
+
+def _qkv(key, B=2, H=4, T=64, D=16, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (B, H, T, D), dtype) for k in ks)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        mesh = MeshSpec(context=8).build()
+        spec = P(None, None, "context", None)
+        ring = jax.shard_map(
+            functools.partial(ring_attention, axis_name="context", causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names={"context"}, check_vma=False,
+        )
+        got = jax.jit(ring)(q, k, v)
+        want = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+    def test_context_4_with_other_axes_active(self):
+        q, k, v = _qkv(jax.random.PRNGKey(1), H=4, T=32)
+        mesh = MeshSpec(data=2, context=4).build()
+        spec = P(None, None, "context", None)
+        ring = jax.shard_map(
+            functools.partial(ring_attention, axis_name="context", causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names={"context"}, check_vma=False,
+        )
+        got = jax.jit(ring)(q, k, v)
+        want = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+class TestUlyssesAttention:
+    def test_matches_reference(self):
+        q, k, v = _qkv(jax.random.PRNGKey(2), H=8)
+        mesh = MeshSpec(context=8).build()
+        spec = P(None, None, "context", None)
+        uly = jax.shard_map(
+            functools.partial(ulysses_attention, axis_name="context", causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names={"context"}, check_vma=False,
+        )
+        got = jax.jit(uly)(q, k, v)
+        want = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+class TestPipeline:
+    def test_matches_sequential(self):
+        S, B, D, M = 4, 8, 16, 4
+        key = jax.random.PRNGKey(3)
+        stages = [
+            {"w": jax.random.normal(jax.random.fold_in(key, s), (D, D)) / D**0.5, "b": jnp.zeros((D,))}
+            for s in range(S)
+        ]
+        stacked = stack_stages(stages)
+        x = jax.random.normal(jax.random.fold_in(key, 99), (B, D))
+
+        def stage_fn(p, h):
+            return jax.nn.relu(h @ p["w"] + p["b"])
+
+        mesh = MeshSpec(stage=4, data=2).build()
+        got = jax.jit(
+            functools.partial(spmd_pipeline, stage_fn, mesh=mesh, num_microbatches=M)
+        )(stacked, x)
+
+        want = x
+        for s in range(S):
+            want = stage_fn(stages[s], want)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5)
+
+    def test_split_layers_into_stages(self):
+        layers = {"w": jnp.zeros((8, 3, 3))}
+        split = split_layers_into_stages(layers, 4)
+        assert split["w"].shape == (4, 2, 3, 3)
+        with pytest.raises(ValueError):
+            split_layers_into_stages({"w": jnp.zeros((7, 3))}, 4)
+
+    def test_bad_microbatch_count(self):
+        mesh = MeshSpec(stage=4, data=2).build()
+        with pytest.raises(ValueError, match="divisible"):
+            spmd_pipeline(lambda p, x: x, {"w": jnp.zeros((4, 1))}, jnp.zeros((6, 2)),
+                          mesh=mesh, num_microbatches=4)
+
+
+class TestMoE:
+    CFG = MoEConfig(num_experts=4, top_k=2, capacity_factor=2.0)
+
+    def test_capacity(self):
+        assert capacity(64, self.CFG) == 64  # 2*64*2/4
+        assert capacity(1, MoEConfig(num_experts=8, top_k=2)) == 2  # floor >= top_k
+
+    def test_route_shapes_and_mass(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 8))
+        router = jax.random.normal(jax.random.PRNGKey(1), (8, 4))
+        dispatch, combine, aux = route(x, router, self.CFG)
+        C = capacity(16, self.CFG)
+        assert dispatch.shape == (2, 16, 4, C)
+        # every token dispatched to exactly top_k slots (ample capacity)
+        np.testing.assert_allclose(np.asarray(dispatch.sum(axis=(2, 3))), 2.0)
+        # combine weights sum to 1 per token (renormalized top-k)
+        np.testing.assert_allclose(np.asarray(combine.sum(axis=(2, 3))), 1.0, atol=1e-5)
+        assert float(aux["moe_dropped_frac"]) == pytest.approx(0.0, abs=1e-6)
+
+    def test_capacity_drops_tokens(self):
+        cfg = MoEConfig(num_experts=4, top_k=1, capacity_factor=0.25)
+        x = jax.random.normal(jax.random.PRNGKey(2), (1, 32, 8))
+        # router that sends everything to expert 0 → capacity clamps
+        router = jnp.zeros((8, 4)).at[:, 0].set(10.0)
+        dispatch, _, aux = route(x, router, cfg)
+        assert float(aux["moe_dropped_frac"]) > 0.5
+
+    def test_moe_ffn_sharded_matches_unsharded(self):
+        E, D, F = 4, 16, 32
+        key = jax.random.PRNGKey(4)
+        ks = jax.random.split(key, 5)
+        x = jax.random.normal(ks[0], (2, 8, D))
+        router = jax.random.normal(ks[1], (D, E))
+        wg = jax.random.normal(ks[2], (E, D, F)) / D**0.5
+        wu = jax.random.normal(ks[3], (E, D, F)) / D**0.5
+        wd = jax.random.normal(ks[4], (E, F, D)) / F**0.5
+        y_ref, _ = moe_ffn(x, router, wg, wu, wd, self.CFG, mesh=None)
+
+        mesh = MeshSpec(data=2, expert=4).build()
+        y_sharded, _ = jax.jit(
+            functools.partial(moe_ffn, cfg=self.CFG, mesh=mesh)
+        )(x, router, wg, wu, wd)
+        np.testing.assert_allclose(np.asarray(y_sharded), np.asarray(y_ref), atol=1e-5, rtol=1e-5)

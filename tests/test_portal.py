@@ -1,0 +1,64 @@
+"""History portal tests (tony-portal analog, SURVEY.md §2.3)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from tony_tpu.cluster.events import EventHandler, EventType
+from tony_tpu.cluster.history import finalize_history
+from tony_tpu.portal.server import serve
+
+
+@pytest.fixture()
+def portal(tmp_path):
+    # one finished job in history
+    eh = EventHandler(str(tmp_path), "app_x")
+    eh.start()
+    eh.emit(EventType.APPLICATION_INITED, app_id="app_x")
+    eh.emit(
+        EventType.APPLICATION_FINISHED,
+        status="SUCCEEDED",
+        tasks=[{"name": "worker", "index": 0, "status": "SUCCEEDED", "exit_code": 0, "host": "h"}],
+    )
+    eh.stop()
+    finalize_history(
+        str(tmp_path), "app_x", eh.intermediate_path, 100, 200, "SUCCEEDED",
+        config_snapshot={"tony.worker.instances": "1"}, user="t",
+    )
+    server = serve(str(tmp_path), 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def get(url):
+    with urllib.request.urlopen(url) as r:
+        return r.status, r.read().decode()
+
+
+class TestPortal:
+    def test_job_list(self, portal):
+        status, body = get(portal + "/")
+        assert status == 200
+        assert "app_x" in body and "SUCCEEDED" in body
+
+    def test_job_detail(self, portal):
+        _, body = get(portal + "/job/app_x")
+        assert "APPLICATION_INITED" in body and "worker:0" in body
+
+    def test_config_view(self, portal):
+        _, body = get(portal + "/job/app_x/config")
+        assert "tony.worker.instances" in body
+
+    def test_api_jobs(self, portal):
+        _, body = get(portal + "/api/jobs")
+        jobs = json.loads(body)
+        assert jobs[0]["app_id"] == "app_x"
+
+    def test_404(self, portal):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError):
+            get(portal + "/nope")

@@ -71,20 +71,43 @@ class TestTFRuntime:
 
 class TestTorchRuntime:
     def test_rendezvous_env(self):
+        # ps is untracked by default → not a torch.distributed member
         env = runtime_for("pytorch").executor_env(SPEC, "worker", 1)
+        assert env["MASTER_ADDR"] == "h3"
+        assert env["MASTER_PORT"] == "30"
+        assert env["RANK"] == "1"
+        assert env["WORLD_SIZE"] == "3"
+        assert env["INIT_METHOD"] == "tcp://h3:30"
+
+    def test_ps_worker_topology_when_tracked(self):
+        # a config that tracks ps (clears the untracked list) ranks ps first
+        env = runtime_for(
+            "pytorch", {keys.APPLICATION_UNTRACKED_TYPES: ""}
+        ).executor_env(SPEC, "worker", 1)
         assert env["MASTER_ADDR"] == "h1"
-        assert env["MASTER_PORT"] == "10"
         assert env["RANK"] == "3"
         assert env["WORLD_SIZE"] == "5"
-        assert env["INIT_METHOD"] == "tcp://h1:10"
 
 
 class TestJaxRuntime:
     def test_coordinator_contract(self):
+        # ps is untracked by default → excluded from the jax process group;
+        # the first worker is the coordinator
+        env = runtime_for("jax").executor_env(SPEC, "worker", 1)
+        assert env["JAX_COORDINATOR_ADDRESS"] == "h3:30"
+        assert env["JAX_PROCESS_ID"] == "1"
+        assert env["JAX_NUM_PROCESSES"] == "3"
+
+    def test_sidecar_gets_no_process_group(self):
         env = runtime_for("jax").executor_env(SPEC, "ps", 0)
-        assert env["JAX_COORDINATOR_ADDRESS"] == "h1:10"
-        assert env["JAX_PROCESS_ID"] == "0"
-        assert env["JAX_NUM_PROCESSES"] == "5"
+        assert "JAX_COORDINATOR_ADDRESS" not in env
+        assert "JAX_PROCESS_ID" not in env
+
+    def test_tensorboard_never_coordinator(self):
+        spec = {"tensorboard": ["a:1"], "worker": ["w:2", "w:3"]}
+        env = runtime_for("jax").executor_env(spec, "worker", 0)
+        assert env["JAX_COORDINATOR_ADDRESS"] == "w:2"
+        assert env["JAX_NUM_PROCESSES"] == "2"
 
 
 class TestHorovodRuntime:

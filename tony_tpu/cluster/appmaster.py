@@ -88,26 +88,35 @@ class ApplicationMaster:
         self._restart_attempt = 0
         self._failures_seen = 0
         self._gang_complete_fired = False
+        # guards (attempt, session) as one unit: RPC handlers capture both
+        # atomically so a stale-attempt call can never touch a fresh session
+        import threading
+
+        self._epoch_lock = threading.Lock()
 
     # ------------------------------------------------------------------ rpc
-    def _stale(self, attempt: int) -> bool:
+    def _fenced_session(self, attempt: int) -> Session | None:
         """Fence RPCs from executors of a killed previous gang attempt: their
         (job_name, index) identities recur, so without the epoch a dying old
-        executor could poison the replacement session's state."""
-        return attempt != self._restart_attempt
+        executor could poison the replacement session's state. The session is
+        captured atomically with the attempt check (same lock as the restart
+        swap) so a stale caller can never touch a fresh session."""
+        with self._epoch_lock:
+            return self.session if attempt == self._restart_attempt else None
 
     def register_worker_spec(
         self, job_name: str, index: int, host: str, port: int, attempt: int = 0
     ) -> dict[str, Any]:
-        if self._stale(attempt):
+        session = self._fenced_session(attempt)
+        if session is None:
             return {"spec_complete": False, "stale": True}
-        self.session.register_worker_spec(job_name, index, host, port)
+        session.register_worker_spec(job_name, index, host, port)
         self.events.emit(EventType.TASK_REGISTERED, task=f"{job_name}:{index}", host=host, port=port)
-        complete = self.session.cluster_spec_complete()
+        complete = session.cluster_spec_complete()
         if complete and not self._gang_complete_fired:
             self._gang_complete_fired = True
-            self.runtime.on_gang_complete(self.session)
-            self.events.emit(EventType.GANG_COMPLETE, tasks=self.session.total_tasks())
+            self.runtime.on_gang_complete(session)
+            self.events.emit(EventType.GANG_COMPLETE, tasks=session.total_tasks())
         return {"spec_complete": complete}
 
     def get_cluster_spec(self, job_name: str, index: int) -> dict[str, Any]:
@@ -123,9 +132,10 @@ class ApplicationMaster:
     def register_execution_result(
         self, job_name: str, index: int, exit_code: int, attempt: int = 0
     ) -> dict[str, Any]:
-        if self._stale(attempt):
+        session = self._fenced_session(attempt)
+        if session is None:
             return {"ack": False, "stale": True}
-        self.session.on_task_completed(job_name, index, exit_code)
+        session.on_task_completed(job_name, index, exit_code)
         self.events.emit(EventType.TASK_FINISHED, task=f"{job_name}:{index}", exit_code=exit_code)
         return {"ack": True}
 
@@ -134,9 +144,10 @@ class ApplicationMaster:
         return {"ack": True}
 
     def task_executor_heartbeat(self, job_name: str, index: int, attempt: int = 0) -> dict[str, Any]:
-        if self._stale(attempt):
+        session = self._fenced_session(attempt)
+        if session is None:
             return {"ack": False, "stale": True}
-        self.session.on_heartbeat(job_name, index)
+        session.on_heartbeat(job_name, index)
         return {"ack": True}
 
     def get_task_infos(self) -> list[dict[str, Any]]:
@@ -160,10 +171,11 @@ class ApplicationMaster:
     def push_metrics(
         self, job_name: str, index: int, metrics: dict[str, Any], attempt: int = 0
     ) -> dict[str, Any]:
-        if self._stale(attempt):
+        session = self._fenced_session(attempt)
+        if session is None:
             return {"ack": False, "stale": True}
-        with self.session.lock:
-            self.session.get_task(job_name, index).metrics = metrics
+        with session.lock:
+            session.get_task(job_name, index).metrics = metrics
         return {"ack": True}
 
     # ------------------------------------------------------------ lifecycle
@@ -265,12 +277,13 @@ class ApplicationMaster:
             self.rm.release(c)
         self._containers.clear()
         self._by_task.clear()
-        self._restart_attempt += 1
-        self._gang_complete_fired = False
-        self._gang_started_ms = None
-        self.session = Session(self.config)
-        self.session.job_status = JobStatus.RUNNING
-        self.scheduler = TaskScheduler(self.config, self.session, self.rm)
+        with self._epoch_lock:  # atomic with _fenced_session's capture
+            self._restart_attempt += 1
+            self._gang_complete_fired = False
+            self._gang_started_ms = None
+            self.session = Session(self.config)
+            self.session.job_status = JobStatus.RUNNING
+            self.scheduler = TaskScheduler(self.config, self.session, self.rm)
         return True
 
     def run(self) -> JobStatus:

@@ -32,6 +32,17 @@ def pick_free_port(host: str = "127.0.0.1") -> int:
         return s.getsockname()[1]
 
 
+def _own_host(am_host: str) -> str:
+    """This container's reachable address: loopback deployments stay on
+    loopback; otherwise the host's resolved address."""
+    if am_host.startswith("127.") or am_host == "localhost":
+        return "127.0.0.1"
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return socket.gethostname()
+
+
 class TaskExecutor:
     def __init__(self, env: dict[str, str] | None = None):
         env = dict(env or os.environ)
@@ -39,15 +50,18 @@ class TaskExecutor:
         self.staging_dir = env[constants.ENV_STAGING_DIR]
         self.job_name = env[constants.ENV_JOB_NAME]
         self.index = int(env[constants.ENV_TASK_INDEX])
-        self.host = env.get(constants.ENV_AM_HOST, "127.0.0.1")
+        am_host = env.get(constants.ENV_AM_HOST, "127.0.0.1")
         self.config = TonyConfig.load_final(os.path.join(self.staging_dir, constants.TONY_FINAL_CONF))
         self.rpc = RpcClient(
-            self.host,
+            am_host,
             int(env[constants.ENV_AM_PORT]),
             secret=env.get(constants.ENV_AM_SECRET, ""),
         )
         self.runtime = get_runtime(self.config)
         self.attempt = int(env.get("TONY_RESTART_ATTEMPT", "0"))  # gang-epoch fence
+        # THIS task's rendezvous address — the executor's own host, not the
+        # AM's (they differ on any multi-host pool).
+        self.host = env.get("TONY_EXECUTOR_HOST") or _own_host(am_host)
         self.port = pick_free_port(self.host)
         self.child: subprocess.Popen | None = None
         self._stop = threading.Event()

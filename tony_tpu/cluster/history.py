@@ -29,8 +29,11 @@ class HistoryFileName:
     status: str  # SUCCEEDED | FAILED | KILLED
 
     def render(self) -> str:
+        # '-' is the field separator; usernames may contain it (app_ids are
+        # ours and never do between the numeric fields) → sanitize user.
+        user = self.user.replace("-", "_")
         return (
-            f"{self.app_id}-{self.started_ms}-{self.completed_ms}-{self.user}-{self.status}"
+            f"{self.app_id}-{self.started_ms}-{self.completed_ms}-{user}-{self.status}"
             + constants.HISTORY_SUFFIX
         )
 

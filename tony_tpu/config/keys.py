@@ -142,7 +142,7 @@ DEFAULTS: dict[str, str] = {
     TASK_EXECUTOR_REGISTRATION_TIMEOUT_MS: "60000",
     TASK_EXECUTOR_EXECUTION_TIMEOUT_MS: "0",
     TASK_RESTART_ON_FAILURE: "false",
-    TASK_MAX_TOTAL_INSTANCE_FAILURES: "0",
+    TASK_MAX_TOTAL_INSTANCE_FAILURES: "3",  # only consulted when restart-on-failure
 
     DOCKER_ENABLED: "false",
     DOCKER_IMAGE: "",

@@ -1,0 +1,1 @@
+"""Model families (BASELINE.json configs #1-#5), pure-functional JAX."""

@@ -1,0 +1,218 @@
+"""Llama-family decoder (the flagship model; BASELINE.json config #4).
+
+Pure-functional JAX: params are a plain pytree with **stacked layers**
+(leading dim L on every block param) so the forward pass is a single
+``lax.scan`` — one compiled block regardless of depth — and pipeline
+parallelism can split the same stacked dim over the ``stage`` axis.
+
+Parallelism (SURVEY.md §2.5 rebuild plan):
+- FSDP: weights sharded over ``fsdp`` (all-gather on use via XLA propagation)
+- TP: Megatron-style — qkv/gate/up column-parallel over ``model``, wo/down
+  row-parallel; vocab-parallel embedding + lm head
+- CP: sequence dim over ``context`` with ring attention (parallel/context.py)
+- bf16 params/activations, f32 norm+softmax accumulation, optional remat
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tony_tpu.ops import attention as attn_ops
+from tony_tpu.ops import layers as L
+from tony_tpu.parallel.context import ring_attention
+from tony_tpu.parallel.sharding import ShardingRules, constrain
+
+BATCH_AXES = ("data", "fsdp")
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14_336
+    max_seq: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = True
+    attn_impl: str = "auto"   # auto | flash | reference
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    def num_params(self) -> int:
+        D, F, V, Dh = self.d_model, self.d_ff, self.vocab_size, self.head_dim
+        per_layer = (
+            D * self.n_heads * Dh            # wq
+            + 2 * D * self.n_kv_heads * Dh   # wk, wv
+            + self.n_heads * Dh * D          # wo
+            + 3 * D * F                      # gate, up, down
+            + 2 * D                          # norms
+        )
+        return V * D + self.n_layers * per_layer + D + D * V
+
+    def flops_per_token(self) -> int:
+        """Training FLOPs/token — the one shared formula (train/metrics.py):
+        6N + causal-attention term 12·L·D·T/2."""
+        from tony_tpu.train.metrics import transformer_flops_per_token
+
+        return transformer_flops_per_token(
+            self.num_params(), self.n_layers, self.d_model, self.max_seq, training=True
+        )
+
+
+# -- presets (BASELINE.json configs) ----------------------------------------
+LLAMA3_8B = LlamaConfig()
+LLAMA_1B = LlamaConfig(
+    vocab_size=32_000, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+    d_ff=5504, max_seq=2048,
+)
+LLAMA_TINY = LlamaConfig(
+    vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq=128, remat=False, attn_impl="reference",
+)
+
+PRESETS = {"llama3-8b": LLAMA3_8B, "llama-1b": LLAMA_1B, "tiny": LLAMA_TINY}
+
+
+def init(key: jax.Array, cfg: LlamaConfig) -> dict:
+    """Initialize the parameter pytree (truncated-normal fan-in scaling)."""
+    D, F, V = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    Dh, H, Hkv, Lyr = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    dt = cfg.jdtype
+    ks = jax.random.split(key, 9)
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dt)
+
+    def dense(k, *shape, fan_in):
+        return (jax.random.truncated_normal(k, -2, 2, shape, jnp.float32) * fan_in**-0.5).astype(dt)
+
+    return {
+        "embed": dense(ks[0], V, D, fan_in=1.0),
+        "layers": {
+            "attn_norm": norm_init(Lyr, D),
+            "wq": dense(ks[1], Lyr, D, H * Dh, fan_in=D),
+            "wk": dense(ks[2], Lyr, D, Hkv * Dh, fan_in=D),
+            "wv": dense(ks[3], Lyr, D, Hkv * Dh, fan_in=D),
+            "wo": dense(ks[4], Lyr, H * Dh, D, fan_in=H * Dh),
+            "mlp_norm": norm_init(Lyr, D),
+            "w_gate": dense(ks[5], Lyr, D, F, fan_in=D),
+            "w_up": dense(ks[6], Lyr, D, F, fan_in=D),
+            "w_down": dense(ks[7], Lyr, F, D, fan_in=F),
+        },
+        "final_norm": norm_init(D),
+        "lm_head": dense(ks[8], D, V, fan_in=D),  # independent of embed (not tied)
+    }
+
+
+def sharding_rules(cfg: LlamaConfig) -> ShardingRules:
+    """FSDP × TP rules (stacked leading layer dim never sharded here; the
+    pipeline module re-shards it over 'stage')."""
+    return ShardingRules([
+        (r"embed", P("model", "fsdp")),                  # vocab-parallel
+        (r"layers/(wq|wk|wv|w_gate|w_up)", P(None, "fsdp", "model")),
+        (r"layers/(wo|w_down)", P(None, "model", "fsdp")),
+        (r"layers/.*norm", P(None, None)),
+        (r"final_norm", P(None)),
+        (r"lm_head", P("fsdp", "model")),
+    ])
+
+
+def _attention(q, k, v, cfg: LlamaConfig, mesh) -> jax.Array:
+    """Dispatch: ring attention when the context axis is real, else fused MHA.
+
+    q: [B, H, T, Dh]; k/v: [B, Hkv, T, Dh].
+    """
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    k = attn_ops.repeat_kv(k, n_rep)
+    v = attn_ops.repeat_kv(v, n_rep)
+    if mesh is not None and mesh.shape.get("context", 1) > 1:
+        spec = P(None, None, "context", None)
+        ring = jax.shard_map(
+            partial(ring_attention, axis_name="context", causal=True),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            axis_names={"context"},
+            check_vma=False,
+        )
+        return ring(q, k, v)
+    return attn_ops.mha(q, k, v, causal=True, impl=cfg.attn_impl)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax.Array:
+    """tokens [B, T] int32 → logits [B, T, V]."""
+    B, T = tokens.shape
+    Dh, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    cos, sin = L.rope_frequencies(Dh, T, cfg.rope_theta)
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+    act_spec = P(BATCH_AXES, "context", None)
+    if mesh is not None:
+        x = constrain(x, mesh, act_spec)
+
+    def block(x, lp):
+        h = L.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(B, T, Hkv, Dh).transpose(0, 2, 1, 3)
+        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(B, T, Hkv, Dh).transpose(0, 2, 1, 3)
+        q = L.apply_rope(q, cos, sin)
+        k = L.apply_rope(k, cos, sin)
+        o = _attention(q, k, v, cfg, mesh)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
+        x = x + jnp.einsum("bth,hd->btd", o, lp["wo"])
+        if mesh is not None:
+            x = constrain(x, mesh, act_spec)
+        h = L.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + L.swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        if mesh is not None:
+            x = constrain(x, mesh, act_spec)
+        return x, None
+
+    block_fn = jax.checkpoint(block) if cfg.remat else block
+    x, _ = jax.lax.scan(block_fn, x, params["layers"])
+
+    x = L.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"])
+    if mesh is not None:
+        logits = constrain(logits, mesh, P(BATCH_AXES, "context", None))
+    return logits
+
+
+def loss_fn(params: dict, batch: dict, cfg: LlamaConfig, mesh=None) -> tuple[jax.Array, dict]:
+    """batch: {"tokens": [B, T+1]} → next-token CE loss."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], cfg, mesh)
+    loss, n = L.cross_entropy_loss(logits, tokens[:, 1:])
+    return loss, {"loss": loss, "tokens": n}
+
+
+def synthetic_batch(key: jax.Array, batch_size: int, seq_len: int, cfg: LlamaConfig) -> dict:
+    return {
+        "tokens": jax.random.randint(key, (batch_size, seq_len + 1), 0, cfg.vocab_size, jnp.int32)
+    }
+
+
+def config_from_dict(d: dict) -> LlamaConfig:
+    if isinstance(d, str):
+        return PRESETS[d]
+    fields = {f.name for f in dataclasses.fields(LlamaConfig)}
+    return dataclasses.replace(
+        PRESETS.get(d.get("preset", ""), LlamaConfig()),
+        **{k: v for k, v in d.items() if k in fields},
+    )

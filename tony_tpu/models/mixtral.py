@@ -1,0 +1,166 @@
+"""Mixtral-style sparse-MoE decoder (BASELINE.json config #5).
+
+Llama backbone (RMSNorm / RoPE / GQA attention, scanned stacked layers) with
+the dense FFN replaced by a top-2-of-E SwiGLU mixture routed per token
+(parallel/expert.py); expert weights shard over the ``expert`` mesh axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tony_tpu.models import llama as llama_mod
+from tony_tpu.ops import layers as L
+from tony_tpu.parallel.expert import MoEConfig, moe_ffn
+from tony_tpu.parallel.sharding import ShardingRules, constrain
+
+BATCH_AXES = llama_mod.BATCH_AXES
+
+
+@dataclass(frozen=True)
+class MixtralConfig(llama_mod.LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+    @property
+    def moe(self) -> MoEConfig:
+        return MoEConfig(self.num_experts, self.top_k, self.capacity_factor)
+
+    def num_params(self) -> int:
+        base = super().num_params()
+        D, F = self.d_model, self.d_ff
+        dense_ffn = self.n_layers * 3 * D * F
+        moe_ffn_params = self.n_layers * (self.num_experts * 3 * D * F + D * self.num_experts)
+        return base - dense_ffn + moe_ffn_params
+
+    def active_params(self) -> int:
+        """Params touched per token (top-k of E experts) — the MFU basis."""
+        D, F = self.d_model, self.d_ff
+        dense_ffn = self.n_layers * 3 * D * F
+        active_ffn = self.n_layers * (self.top_k * 3 * D * F + D * self.num_experts)
+        return super().num_params() - dense_ffn + active_ffn
+
+    def flops_per_token(self) -> int:
+        from tony_tpu.train.metrics import transformer_flops_per_token
+
+        return transformer_flops_per_token(
+            self.active_params(), self.n_layers, self.d_model, self.max_seq, training=True
+        )
+
+
+MIXTRAL_8X7B = MixtralConfig(
+    vocab_size=32_000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+    d_ff=14_336, max_seq=8192, rope_theta=1e6, num_experts=8, top_k=2,
+)
+MIXTRAL_TINY = MixtralConfig(
+    vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
+    max_seq=128, num_experts=4, top_k=2, remat=False, attn_impl="reference",
+)
+PRESETS = {"mixtral-8x7b": MIXTRAL_8X7B, "tiny": MIXTRAL_TINY}
+
+
+def init(key: jax.Array, cfg: MixtralConfig) -> dict:
+    D, F, E, Lyr = cfg.d_model, cfg.d_ff, cfg.num_experts, cfg.n_layers
+    dt = cfg.jdtype
+    base = llama_mod.init(key, cfg)
+    ks = jax.random.split(jax.random.fold_in(key, 1), 4)
+
+    def dense(k, *shape, fan_in):
+        return (jax.random.truncated_normal(k, -2, 2, shape, jnp.float32) * fan_in**-0.5).astype(dt)
+
+    layers = dict(base["layers"])
+    for gone in ("w_gate", "w_up", "w_down"):
+        del layers[gone]
+    layers.update(
+        router=dense(ks[0], Lyr, D, E, fan_in=D).astype(jnp.float32),
+        we_gate=dense(ks[1], Lyr, E, D, F, fan_in=D),
+        we_up=dense(ks[2], Lyr, E, D, F, fan_in=D),
+        we_down=dense(ks[3], Lyr, E, F, D, fan_in=F),
+    )
+    base["layers"] = layers
+    return base
+
+
+def sharding_rules(cfg: MixtralConfig) -> ShardingRules:
+    return ShardingRules([
+        (r"embed", P("model", "fsdp")),
+        (r"layers/(wq|wk|wv)", P(None, "fsdp", "model")),
+        (r"layers/wo", P(None, "model", "fsdp")),
+        (r"layers/router", P(None, None, None)),
+        (r"layers/(we_gate|we_up)", P(None, "expert", "fsdp", "model")),
+        (r"layers/we_down", P(None, "expert", "model", "fsdp")),
+        (r"layers/.*norm", P(None, None)),
+        (r"final_norm", P(None)),
+        (r"lm_head", P("fsdp", "model")),
+    ])
+
+
+def forward(params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None) -> tuple[jax.Array, dict]:
+    """tokens [B, T] → (logits [B, T, V], moe aux losses summed over layers)."""
+    B, T = tokens.shape
+    Dh, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    cos, sin = L.rope_frequencies(Dh, T, cfg.rope_theta)
+    act_spec = P(BATCH_AXES, "context", None)
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+    if mesh is not None:
+        x = constrain(x, mesh, act_spec)
+
+    def block(carry, lp):
+        x, aux_acc = carry
+        h = L.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(B, T, Hkv, Dh).transpose(0, 2, 1, 3)
+        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(B, T, Hkv, Dh).transpose(0, 2, 1, 3)
+        q, k = L.apply_rope(q, cos, sin), L.apply_rope(k, cos, sin)
+        o = llama_mod._attention(q, k, v, cfg, mesh)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
+        x = x + jnp.einsum("bth,hd->btd", o, lp["wo"])
+        if mesh is not None:
+            x = constrain(x, mesh, act_spec)
+        h = L.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        y, aux = moe_ffn(h, lp["router"], lp["we_gate"], lp["we_up"], lp["we_down"], cfg.moe, mesh)
+        x = x + y
+        if mesh is not None:
+            x = constrain(x, mesh, act_spec)
+        aux_acc = {
+            "moe_balance_loss": aux_acc["moe_balance_loss"] + aux["moe_balance_loss"],
+            "moe_z_loss": aux_acc["moe_z_loss"] + aux["moe_z_loss"],
+            "moe_dropped_frac": aux_acc["moe_dropped_frac"] + aux["moe_dropped_frac"] / cfg.n_layers,
+        }
+        return (x, aux_acc), None
+
+    aux0 = {k: jnp.zeros((), jnp.float32) for k in ("moe_balance_loss", "moe_z_loss", "moe_dropped_frac")}
+    block_fn = jax.checkpoint(block) if cfg.remat else block
+    (x, aux), _ = jax.lax.scan(block_fn, (x, aux0), params["layers"])
+
+    x = L.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"])
+    return logits, aux
+
+
+def loss_fn(params: dict, batch: dict, cfg: MixtralConfig, mesh=None) -> tuple[jax.Array, dict]:
+    tokens = batch["tokens"]
+    logits, aux = forward(params, tokens[:, :-1], cfg, mesh)
+    ce, n = L.cross_entropy_loss(logits, tokens[:, 1:])
+    loss = ce + aux["moe_balance_loss"] + aux["moe_z_loss"]
+    return loss, {"loss": loss, "ce_loss": ce, "tokens": n, **aux}
+
+
+synthetic_batch = llama_mod.synthetic_batch
+
+
+def config_from_dict(d: dict | str) -> MixtralConfig:
+    if isinstance(d, str):
+        return PRESETS[d]
+    fields = {f.name for f in dataclasses.fields(MixtralConfig)}
+    return dataclasses.replace(
+        PRESETS.get(d.get("preset", ""), MixtralConfig()),
+        **{k: v for k, v in d.items() if k in fields},
+    )

@@ -1,0 +1,70 @@
+"""MNIST-scale MLP classifier (BASELINE.json config #1: the mnist example
+analog — the smallest end-to-end workload `tony submit` runs)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tony_tpu.parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    input_dim: int = 784
+    hidden_dim: int = 512
+    num_classes: int = 10
+    n_layers: int = 2
+    dtype: str = "float32"
+
+    def num_params(self) -> int:
+        dims = [self.input_dim] + [self.hidden_dim] * self.n_layers + [self.num_classes]
+        return sum(dims[i] * dims[i + 1] + dims[i + 1] for i in range(len(dims) - 1))
+
+
+def init(key: jax.Array, cfg: MLPConfig) -> dict:
+    dims = [cfg.input_dim] + [cfg.hidden_dim] * cfg.n_layers + [cfg.num_classes]
+    dt = jnp.dtype(cfg.dtype)
+    params = {}
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        k = jax.random.fold_in(key, i)
+        params[f"layer_{i}"] = {
+            "w": (jax.random.normal(k, (d_in, d_out)) * d_in**-0.5).astype(dt),
+            "b": jnp.zeros((d_out,), dt),
+        }
+    return params
+
+
+def sharding_rules(cfg: MLPConfig) -> ShardingRules:
+    return ShardingRules([(r"layer_\d+/w", P("fsdp", "model")), (r".*", P())])
+
+
+def forward(params: dict, x: jax.Array, cfg: MLPConfig, mesh=None) -> jax.Array:
+    n = cfg.n_layers + 1
+    for i in range(n):
+        lp = params[f"layer_{i}"]
+        x = x @ lp["w"] + lp["b"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def loss_fn(params: dict, batch: dict, cfg: MLPConfig, mesh=None) -> tuple[jax.Array, dict]:
+    logits = forward(params, batch["image"], cfg, mesh)
+    labels = batch["label"]
+    loss = jnp.mean(
+        -jax.nn.log_softmax(logits.astype(jnp.float32))[jnp.arange(labels.shape[0]), labels]
+    )
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": acc}
+
+
+def synthetic_batch(key: jax.Array, batch_size: int, cfg: MLPConfig) -> dict:
+    k1, k2 = jax.random.split(key)
+    return {
+        "image": jax.random.uniform(k1, (batch_size, cfg.input_dim), jnp.float32),
+        "label": jax.random.randint(k2, (batch_size,), 0, cfg.num_classes, jnp.int32),
+    }

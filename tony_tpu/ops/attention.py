@@ -1,0 +1,186 @@
+"""Attention kernels: XLA reference + Pallas TPU flash attention.
+
+The compute hot path the reference never owned (it lived inside TF/torch —
+SURVEY.md §2.4): here multi-head attention is a first-class op with
+- ``attention_reference``: einsum+softmax through XLA (runs everywhere; XLA
+  already fuses mask+softmax into the matmuls well on TPU),
+- ``flash_attention``: blockwise-online-softmax Pallas kernel keeping the
+  score matrix in VMEM tiles (O(T) memory), for long sequences on TPU,
+- ``mha``: the dispatcher models call (impl='auto' picks per backend).
+
+GQA/MQA is handled by broadcasting KV heads before the kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """[B, Hkv, T, D] → [B, Hkv*n_rep, T, D] (GQA head broadcast)."""
+    if n_rep == 1:
+        return k
+    B, H, T, D = k.shape
+    return jnp.broadcast_to(k[:, :, None], (B, H, n_rep, T, D)).reshape(B, H * n_rep, T, D)
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Plain attention; q/k/v: [B, H, T, D] (KV already head-broadcast)."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else D ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool), Tk - Tq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention (TPU)
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scale: float):
+    """Grid: (B*H, Tq//block_q). Online softmax over KV blocks in VMEM."""
+    from jax.experimental import pallas as pl
+
+    block_q, D = q_ref.shape
+    Tk = k_ref.shape[0]
+    q_blk_idx = pl.program_id(1)
+    q = q_ref[:] .astype(jnp.float32) * scale
+    q_pos = q_blk_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    num_k_blocks = pl.cdiv(Tk, block_k)
+    if causal:
+        # only blocks at or below the diagonal contribute
+        num_k_blocks = jnp.minimum(num_k_blocks, (q_blk_idx + 1) * block_q // block_k + 1)
+
+    def body(kb, carry):
+        o, m, l = carry
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_b = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_b)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (o0, m0, l0))
+    o_ref[:] = (o / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+) -> jax.Array:
+    """Pallas TPU flash attention; q/k/v: [B, H, T, D], T % block == 0."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    scale = D ** -0.5
+    if Tq % block_q or Tk % block_k:
+        return attention_reference(q, k, v, causal=causal)
+
+    qf = q.reshape(B * H, Tq, D)
+    kf = k.reshape(B * H, Tk, D)
+    vf = v.reshape(B * H, Tk, D)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * H * Tq * Tk * D,
+            bytes_accessed=2 * (qf.size + kf.size + vf.size) * q.dtype.itemsize,
+            transcendentals=B * H * Tq * Tk,
+        ),
+    )(qf, kf, vf)
+    return out.reshape(B, H, Tq, D)
+
+
+# -- trainable flash attention: pallas forward + custom VJP ------------------
+# pallas_call has no JVP rule (pallas guide §20: production kernels define a
+# custom VJP). v1 backward recomputes through the XLA reference path — the
+# forward stays O(T) memory in the kernel; a Pallas backward kernel is the
+# follow-up optimization for long sequences.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_trainable(q, k, v, causal):
+    return flash_attention(q, k, v, causal=causal)
+
+
+def _flash_fwd(q, k, v, causal):
+    return flash_attention(q, k, v, causal=causal), (q, k, v)
+
+
+def _flash_bwd(causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal=causal), q, k, v)
+    return vjp(g)
+
+
+_flash_trainable.defvjp(_flash_fwd, _flash_bwd)
+
+
+def mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+) -> jax.Array:
+    """Dispatcher: Pallas flash kernel on TPU, XLA reference elsewhere."""
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() not in ("cpu",) else "reference"
+    if impl == "flash":
+        Tq, Tk = q.shape[2], k.shape[2]
+        if Tq % min(256, Tq) == 0 and Tk % min(256, Tk) == 0 and Tq >= 128:
+            return _flash_trainable(q, k, v, causal)
+    return attention_reference(q, k, v, causal=causal)

@@ -1,0 +1,74 @@
+"""Elementwise/normalization building blocks (XLA-fused on TPU).
+
+These stay as plain jnp expressions on purpose: XLA fuses RMSNorm/RoPE/SwiGLU
+into adjacent matmuls (the HBM-bandwidth win hand-written kernels would chase)
+— Pallas is reserved for ops XLA can't schedule well (attention, ring
+collectives, quantization; see ops/attention.py, ops/quant.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in f32 accumulation regardless of input dtype."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+def layer_norm(x: jax.Array, weight: jax.Array, bias: jax.Array, eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight + bias
+
+
+def rope_frequencies(dim: int, max_seq: int, theta: float = 10000.0) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables [max_seq, dim//2] in f32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array | None = None
+) -> jax.Array:
+    """Rotary embedding; x: [B, H, T, D], tables [>=T, D//2]."""
+    T = x.shape[-2]
+    if positions is None:
+        c, s = cos[:T], sin[:T]
+    else:
+        c, s = cos[positions], sin[positions]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
+    """SwiGLU MLP: (silu(x@Wg) * (x@Wu)) @ Wd, bf16-friendly."""
+    g = jax.nn.silu(jnp.einsum("...d,df->...f", x, w_gate))
+    u = jnp.einsum("...d,df->...f", x, w_up)
+    return jnp.einsum("...f,fd->...d", g * u, w_down)
+
+
+def gelu_mlp(x: jax.Array, w_in: jax.Array, b_in: jax.Array, w_out: jax.Array, b_out: jax.Array) -> jax.Array:
+    h = jax.nn.gelu(jnp.einsum("...d,df->...f", x, w_in) + b_in)
+    return jnp.einsum("...f,fd->...d", h, w_out) + b_out
+
+
+def cross_entropy_loss(
+    logits: jax.Array, targets: jax.Array, ignore_index: int = -100
+) -> tuple[jax.Array, jax.Array]:
+    """Token-mean CE in f32; returns (loss, n_valid_tokens)."""
+    mask = targets != ignore_index
+    safe_targets = jnp.where(mask, targets, 0)
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe_targets[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    n = jnp.maximum(mask.sum(), 1)
+    return nll.sum() / n, n

@@ -1,0 +1,23 @@
+"""Parallelism library: mesh axes, sharding rules, and the strategies the
+reference delegated to external frameworks (SURVEY.md §2.5) — FSDP, tensor,
+pipeline, expert, and context (ring-attention) parallelism over XLA
+collectives on ICI/DCN."""
+
+from tony_tpu.parallel.mesh import (  # noqa: F401
+    ALL_AXES,
+    AXIS_CONTEXT,
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_STAGE,
+    MeshSpec,
+    single_device_mesh,
+)
+from tony_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules,
+    batch_spec,
+    constrain,
+    fsdp_spec_tree,
+    shard_params,
+)

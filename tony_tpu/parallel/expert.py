@@ -1,0 +1,104 @@
+"""Expert parallelism: MoE routing + dispatch over the ``expert`` mesh axis.
+
+Absent from the reference (SURVEY.md §2.5); needed for Mixtral-style models
+(BASELINE.json config #5). GShard/Switch-style **dense dispatch**: routing
+builds a [B, T, E, C] dispatch tensor (top-k gating, capacity-bounded) and
+the expert exchange is two einsums whose E dimension is sharded over the
+``expert`` axis — XLA lowers the resharding into the ragged all-to-all on
+ICI, and the same code runs unsharded when the axis is 1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tony_tpu.parallel.sharding import constrain
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_z_coef: float = 1e-3      # router z-loss (stability)
+    aux_loss_coef: float = 1e-2      # load-balance loss
+
+
+def capacity(tokens_per_batch: int, cfg: MoEConfig) -> int:
+    c = int(cfg.top_k * tokens_per_batch * cfg.capacity_factor / cfg.num_experts)
+    return max(c, cfg.top_k)
+
+
+def route(x: jax.Array, router_w: jax.Array, cfg: MoEConfig) -> tuple[jax.Array, jax.Array, dict]:
+    """Top-k routing with capacity.
+
+    x: [B, T, D]; router_w: [D, E] →
+    dispatch [B, T, E, C] bool-ish, combine [B, T, E, C] f32, aux losses.
+    """
+    B, T, _ = x.shape
+    E, C = cfg.num_experts, capacity(T, cfg)
+
+    logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), router_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # top-k gates, renormalized (Mixtral convention)
+    gate_vals, gate_idx = jax.lax.top_k(probs, cfg.top_k)            # [B,T,K]
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # expert-choice position assignment: for each (expert, k-slot) count
+    # prior tokens routed to that expert to get its capacity slot
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)          # [B,T,K,E]
+    flat = onehot.transpose(0, 2, 1, 3).reshape(B, cfg.top_k * T, E)  # k-major order
+    pos_in_expert = (jnp.cumsum(flat, axis=1) - flat).reshape(B, cfg.top_k, T, E).transpose(0, 2, 1, 3)
+    within_cap = pos_in_expert < C                                   # [B,T,K,E]
+
+    slot_onehot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), C, dtype=jnp.float32)  # [B,T,K,E,C]
+    dispatch = (onehot * within_cap)[..., None] * slot_onehot        # [B,T,K,E,C]
+    combine = dispatch * gate_vals[..., None, None]
+    dispatch = dispatch.sum(axis=2)                                  # [B,T,E,C]
+    combine = combine.sum(axis=2)
+
+    # aux losses: load-balance (Switch) + router z-loss
+    me = probs.mean(axis=(0, 1))                                     # [E] mean prob
+    ce = onehot.sum(axis=2).mean(axis=(0, 1))                        # [E] token fraction
+    aux = {
+        "moe_balance_loss": cfg.aux_loss_coef * E * jnp.sum(me * ce) * (1.0 / cfg.top_k),
+        "moe_z_loss": cfg.router_z_coef * jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2),
+        "moe_dropped_frac": 1.0 - (dispatch.sum() / (B * T * cfg.top_k)),
+    }
+    return dispatch, combine, aux
+
+
+def moe_ffn(
+    x: jax.Array,
+    router_w: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    cfg: MoEConfig,
+    mesh=None,
+) -> tuple[jax.Array, dict]:
+    """SwiGLU mixture-of-experts FFN.
+
+    x: [B, T, D]; router_w [D, E]; w_gate/w_up [E, D, F]; w_down [E, F, D].
+    Expert weights shard P('expert', 'fsdp', 'model'); the dispatched-token
+    tensor constrains to P(batch, 'expert', ...) so the exchange rides the
+    expert axis (ICI all-to-all).
+    """
+    dtype = x.dtype
+    dispatch, combine, aux = route(x, router_w, cfg)
+
+    xe = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), x)     # [E,B,C,D]
+    if mesh is not None:
+        xe = constrain(xe, mesh, P("expert", ("data", "fsdp"), None, None))
+    g = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", xe, w_gate))
+    u = jnp.einsum("ebcd,edf->ebcf", xe, w_up)
+    ye = jnp.einsum("ebcf,efd->ebcd", g * u, w_down)                 # [E,B,C,D]
+    if mesh is not None:
+        ye = constrain(ye, mesh, P("expert", ("data", "fsdp"), None, None))
+    y = jnp.einsum("ebcd,btec->btd", ye, combine.astype(dtype))
+    return y.astype(dtype), aux

@@ -1,0 +1,156 @@
+"""Device-mesh construction with the framework's canonical parallelism axes.
+
+The reference delegated every parallelism strategy to user frameworks
+(SURVEY.md §2.5); here the mesh is first-class. Canonical axes (a superset of
+what each model family uses):
+
+- ``data``    — pure data parallel (replicated params, sharded batch)
+- ``fsdp``    — data parallel with sharded params/optimizer (ZeRO-3 analog)
+- ``model``   — tensor parallel (Megatron-style)
+- ``context`` — sequence/context parallel (ring attention)
+- ``expert``  — expert parallel (MoE all-to-all)
+- ``stage``   — pipeline parallel
+
+ICI/DCN discipline (SURVEY.md §5.8, the scaling-book recipe): axes that move
+activations every layer (model/context/expert) must live on ICI; only
+data/fsdp/stage may span the slower DCN boundary between slices. On one slice
+``build()`` uses ``mesh_utils.create_device_mesh`` (ICI-topology-aware); with
+``num_slices > 1`` it uses the hybrid builder and enforces that discipline.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, fields
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_MODEL = "model"
+AXIS_CONTEXT = "context"
+AXIS_EXPERT = "expert"
+AXIS_STAGE = "stage"
+
+# canonical order: slowest-varying (DCN-friendly) first
+ALL_AXES = (AXIS_STAGE, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_CONTEXT, AXIS_MODEL)
+DCN_SAFE_AXES = frozenset({AXIS_DATA, AXIS_FSDP, AXIS_STAGE})
+ICI_ONLY_AXES = frozenset({AXIS_MODEL, AXIS_CONTEXT, AXIS_EXPERT})
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape over the canonical axes."""
+
+    stage: int = 1
+    data: int = 1
+    fsdp: int = 1
+    expert: int = 1
+    context: int = 1
+    model: int = 1
+
+    @property
+    def axis_sizes(self) -> dict[str, int]:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.axis_sizes.values())
+
+    def active_axes(self) -> tuple[str, ...]:
+        """Axes with size > 1, canonical order."""
+        return tuple(a for a in ALL_AXES if self.axis_sizes[a] > 1)
+
+    def build(self, devices: list | None = None, num_slices: int = 1) -> Mesh:
+        """Build a Mesh over all six named axes (size-1 axes included so one
+        set of PartitionSpecs works for every configuration).
+
+        ``num_slices > 1`` declares that the device list spans DCN-connected
+        slices; the slowest-varying axes absorb the slice boundary and must be
+        DCN-safe.
+        """
+        devices = list(jax.devices()) if devices is None else list(devices)
+        if self.num_devices != len(devices):
+            raise ValueError(
+                f"MeshSpec wants {self.num_devices} devices "
+                f"({self.axis_sizes}), got {len(devices)}"
+            )
+        shape = tuple(self.axis_sizes[a] for a in ALL_AXES)
+        if num_slices > 1:
+            self._check_dcn_discipline(num_slices)
+            from jax.experimental import mesh_utils
+
+            per_slice = {a: s for a, s in self.axis_sizes.items()}
+            dcn_shape, ici_shape = [], []
+            remaining = num_slices
+            for a in ALL_AXES:
+                s = per_slice[a]
+                if remaining > 1 and a in DCN_SAFE_AXES and s % remaining == 0:
+                    dcn_shape.append(remaining)
+                    ici_shape.append(s // remaining)
+                    remaining = 1
+                else:
+                    dcn_shape.append(1)
+                    ici_shape.append(s)
+            if remaining > 1:
+                raise ValueError(
+                    f"cannot place {num_slices} slices: no DCN-safe axis "
+                    f"(one of {sorted(DCN_SAFE_AXES)}) is divisible by the slice count"
+                )
+            arr = mesh_utils.create_hybrid_device_mesh(
+                tuple(ici_shape), tuple(dcn_shape), devices=devices
+            )
+            return Mesh(arr, ALL_AXES)
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_device_mesh(shape, devices=devices)
+        except (ValueError, AssertionError):
+            # emulated/CPU backends without topology info: row-major is fine
+            arr = np.array(devices).reshape(shape)
+        return Mesh(arr, ALL_AXES)
+
+    def _check_dcn_discipline(self, num_slices: int) -> None:
+        for a in self.active_axes():
+            if a in ICI_ONLY_AXES and num_slices > 1:
+                sz = self.axis_sizes[a]
+                per_slice_devices = self.num_devices // num_slices
+                if sz > per_slice_devices:
+                    raise ValueError(
+                        f"axis {a!r} (size {sz}) would span DCN; "
+                        f"{sorted(ICI_ONLY_AXES)} must fit within one slice"
+                    )
+
+    @classmethod
+    def auto(
+        cls,
+        n_devices: int | None = None,
+        *,
+        model: int = 1,
+        context: int = 1,
+        expert: int = 1,
+        stage: int = 1,
+        prefer_fsdp: bool = True,
+    ) -> "MeshSpec":
+        """Fill the leftover device factor into fsdp (or data) after the
+        explicitly-requested axes — the common launch-time path."""
+        n = n_devices if n_devices is not None else len(jax.devices())
+        used = model * context * expert * stage
+        if n % used:
+            raise ValueError(f"{n} devices not divisible by model*context*expert*stage={used}")
+        rest = n // used
+        return cls(
+            stage=stage,
+            data=1 if prefer_fsdp else rest,
+            fsdp=rest if prefer_fsdp else 1,
+            expert=expert,
+            context=context,
+            model=model,
+        )
+
+
+def single_device_mesh() -> Mesh:
+    """A 1-device mesh over all axes (bench / single-chip paths)."""
+    return MeshSpec().build(devices=[jax.devices()[0]])

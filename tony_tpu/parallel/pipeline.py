@@ -1,0 +1,119 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the ``stage``
+mesh axis, inside shard_map with ``ppermute`` activation hand-off.
+
+Absent from the reference (SURVEY.md §2.5). The schedule is SPMD: every stage
+runs the same program; on tick t, stage s computes microbatch ``t - s`` (when
+valid) and ships its activation to stage ``s+1`` over the ring — a bubble of
+``S - 1`` ticks at the start/end, the classic GPipe cost, amortized by the
+microbatch count M.
+
+``spmd_pipeline`` is model-agnostic: ``stage_fn(stage_params, x) -> x`` is
+one stage's compute, stage params are leaves with a leading ``[S, ...]`` dim
+(sharded over 'stage'), and the input is pre-split into M microbatches.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_body(
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    axis_name: str,
+) -> jax.Array:
+    """Runs inside shard_map: stage_params are stage-local (leading dim 1),
+    microbatches [M, B, ...] are replicated along the stage axis."""
+    S = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    local_params = jax.tree.map(lambda p: p[0], stage_params)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (clamped); others take the ring input
+        feed = microbatches[jnp.minimum(t, M - 1)]
+        x = jnp.where(idx == 0, feed, state)
+        y = stage_fn(local_params, x)
+        # the last stage banks its finished microbatch (valid when t >= S-1)
+        out_idx = t - (S - 1)
+        valid = jnp.logical_and(idx == S - 1, out_idx >= 0)
+        outputs = jax.lax.cond(
+            valid,
+            lambda o: jax.lax.dynamic_update_index_in_dim(o, y, jnp.maximum(out_idx, 0), 0),
+            lambda o: o,
+            outputs,
+        )
+        # ring hand-off to the next stage (stage S-1 → 0 wraps; ignored there)
+        state = jax.lax.ppermute(y, axis_name, [(i, (i + 1) % S) for i in range(S)])
+        return (state, outputs), None
+
+    state0 = jnp.zeros(mb_shape, microbatches.dtype)
+    outputs0 = jnp.zeros((M, *mb_shape), microbatches.dtype)
+    (_, outputs), _ = jax.lax.scan(tick, (state0, outputs0), jnp.arange(M + S - 1))
+    # outputs live on the last stage only; make them uniform across the axis.
+    # psum in f32: bf16 all-reduce promotion trips an XLA-CPU compiler CHECK
+    # (AllReducePromotion "Invalid binary instruction opcode copy").
+    mask = (idx == S - 1).astype(jnp.float32)
+    summed = jax.lax.psum(outputs.astype(jnp.float32) * mask, axis_name)
+    return summed.astype(outputs.dtype)
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "stage",
+) -> jax.Array:
+    """Apply an S-stage pipeline to a batch.
+
+    - ``stage_params``: pytree, every leaf ``[S, ...]``, sharded P('stage', ...)
+    - ``x``: [B, ...] batch; B % num_microbatches == 0
+    - returns [B, ...] as if ``fn = stage_S-1 ∘ ... ∘ stage_0`` ran whole.
+    """
+    B = x.shape[0]
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    mb = x.reshape(M, B // M, *x.shape[1:])
+
+    param_specs = jax.tree.map(lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params)
+    body = jax.shard_map(
+        partial(_pipeline_body, stage_fn=stage_fn, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    out = body(stage_params, mb)
+    return out.reshape(B, *out.shape[2:])
+
+
+def stack_stages(params_per_stage: list[Any]) -> Any:
+    """[pytree_s for s in stages] → pytree with leading stage dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_per_stage)
+
+
+def split_layers_into_stages(stacked_layer_params: Any, num_stages: int) -> Any:
+    """Reshape scan-stacked layer params [L, ...] → [S, L/S, ...] so a model's
+    layer stack becomes pipeline stages of equal depth."""
+
+    def reshape(p):
+        L = p.shape[0]
+        if L % num_stages:
+            raise ValueError(f"{L} layers not divisible by {num_stages} stages")
+        return p.reshape(num_stages, L // num_stages, *p.shape[1:])
+
+    return jax.tree.map(reshape, stacked_layer_params)

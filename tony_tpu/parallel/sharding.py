@@ -1,0 +1,90 @@
+"""Sharding rules: mapping parameter trees and activations onto mesh axes.
+
+The Megatron/FSDP "how is each weight split" knowledge lives here as
+path-pattern rules (the idiomatic-JAX equivalent of per-layer sharding code
+in GPU frameworks): a rule list maps parameter tree paths to
+``PartitionSpec``s; unmatched params are replicated. Models ship their own
+rule lists (see tony_tpu/models/*) and the trainer applies them at init.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+
+def path_str(path: tuple) -> str:
+    """jax.tree_util key path → 'a/b/c' string for rule matching."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) rules; first match wins."""
+
+    def __init__(self, rules: Iterable[tuple[str, PartitionSpec]]):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str) -> PartitionSpec:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return spec
+        return P()  # replicate by default
+
+    def spec_tree(self, params: Any) -> Any:
+        """PartitionSpec pytree mirroring ``params``."""
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: self.spec_for(path_str(path)), params
+        )
+
+    def sharding_tree(self, params: Any, mesh: Mesh) -> Any:
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: NamedSharding(mesh, self.spec_for(path_str(path))), params
+        )
+
+
+def shard_params(params: Any, rules: "ShardingRules", mesh: Mesh) -> Any:
+    """Place a parameter pytree onto the mesh per the rules."""
+    return jax.device_put(params, rules.sharding_tree(params, mesh))
+
+
+def constrain(x: jax.Array, mesh: Mesh, spec: PartitionSpec) -> jax.Array:
+    """Activation sharding constraint (inside jit)."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def batch_spec(data_axes: tuple[str, ...] = ("data", "fsdp")) -> PartitionSpec:
+    """The canonical input-batch sharding: batch dim over the data axes."""
+    return P(data_axes)
+
+
+def fsdp_spec_tree(params: Any, axis: str = "fsdp", min_size: int = 2**12) -> Any:
+    """Generic FSDP rule: shard each large param's largest dim over ``axis``.
+
+    Used when a model ships no explicit rules: every parameter with
+    >= min_size elements is sharded on its largest dimension (ties → first),
+    the rest replicated. With XLA's sharding propagation this yields the
+    all-gather-on-use / reduce-scatter-on-grad ZeRO-3 schedule.
+    """
+
+    def spec_of(x) -> PartitionSpec:
+        if not hasattr(x, "shape") or x.size < min_size or x.ndim == 0:
+            return P()
+        dim = int(max(range(x.ndim), key=lambda d: x.shape[d]))
+        return P(*[axis if d == dim else None for d in range(x.ndim)])
+
+    return jax.tree_util.tree_map(spec_of, params)

@@ -32,7 +32,7 @@ class HorovodRuntime(FrameworkRuntime):
     def on_gang_complete(self, session: "Session") -> None:
         spec = session.cluster_spec()
         assert spec is not None
-        order = canonical_task_order(spec)
+        order = canonical_task_order(spec, self.config.untracked_types())
         size = len(order)
 
         # group ranks by host → local ranks; hosts in first-seen order → cross ranks
@@ -44,7 +44,7 @@ class HorovodRuntime(FrameworkRuntime):
             by_host[host].append((t, i))
         hosts = list(by_host.keys())
 
-        rendezvous = coordinator_address(spec)
+        rendezvous = coordinator_address(spec, self.config.untracked_types())
         rdv_host, _, rdv_port = rendezvous.rpartition(":")
         for rank, (t, i) in enumerate(order):
             host = host_of[(t, i)]
@@ -67,8 +67,11 @@ class HorovodRuntime(FrameworkRuntime):
     # -- executor side -----------------------------------------------------
     def executor_env(self, cluster_spec: dict[str, list[str]], job_name: str, index: int) -> dict[str, str]:
         env = super().executor_env(cluster_spec, job_name, index)
-        order = canonical_task_order(cluster_spec)
-        env[constants.ENV_JAX_COORDINATOR] = coordinator_address(cluster_spec)
+        exclude = self.config.untracked_types()
+        order = canonical_task_order(cluster_spec, exclude)
+        if (job_name, index) not in order:
+            return env
+        env[constants.ENV_JAX_COORDINATOR] = coordinator_address(cluster_spec, exclude)
         env[constants.ENV_JAX_PROCESS_ID] = str(order.index((job_name, index)))
         env[constants.ENV_JAX_NUM_PROCESSES] = str(len(order))
         return env

@@ -19,13 +19,17 @@ from tony_tpu import constants
 from tony_tpu.runtime.base import FrameworkRuntime
 
 
-def canonical_task_order(cluster_spec: dict[str, list[str]]) -> list[tuple[str, int]]:
+def canonical_task_order(
+    cluster_spec: dict[str, list[str]], exclude: frozenset[str] = frozenset()
+) -> list[tuple[str, int]]:
     """Deterministic global rank order: chief first, then remaining types
     alphabetically, each type by index. Every adapter that needs a global
-    rank (jax, pytorch, horovod) uses this one ordering."""
+    rank (jax, pytorch, horovod) uses this one ordering. ``exclude`` drops
+    sidecar types (tensorboard, notebook, ...) that must not join the
+    training process group."""
     order: list[tuple[str, int]] = []
-    types = sorted(cluster_spec.keys())
-    if constants.CHIEF_JOB_NAME in cluster_spec:
+    types = sorted(t for t in cluster_spec if t not in exclude)
+    if constants.CHIEF_JOB_NAME in types:
         types.remove(constants.CHIEF_JOB_NAME)
         types.insert(0, constants.CHIEF_JOB_NAME)
     for t in types:
@@ -33,20 +37,30 @@ def canonical_task_order(cluster_spec: dict[str, list[str]]) -> list[tuple[str, 
     return order
 
 
-def global_rank(cluster_spec: dict[str, list[str]], job_name: str, index: int) -> int:
-    return canonical_task_order(cluster_spec).index((job_name, index))
+def global_rank(
+    cluster_spec: dict[str, list[str]], job_name: str, index: int,
+    exclude: frozenset[str] = frozenset(),
+) -> int:
+    return canonical_task_order(cluster_spec, exclude).index((job_name, index))
 
 
-def coordinator_address(cluster_spec: dict[str, list[str]]) -> str:
-    t, i = canonical_task_order(cluster_spec)[0]
+def coordinator_address(
+    cluster_spec: dict[str, list[str]], exclude: frozenset[str] = frozenset()
+) -> str:
+    t, i = canonical_task_order(cluster_spec, exclude)[0]
     return cluster_spec[t][i]
 
 
 class JaxRuntime(FrameworkRuntime):
     def executor_env(self, cluster_spec: dict[str, list[str]], job_name: str, index: int) -> dict[str, str]:
         env = super().executor_env(cluster_spec, job_name, index)
-        order = canonical_task_order(cluster_spec)
-        env[constants.ENV_JAX_COORDINATOR] = coordinator_address(cluster_spec)
+        # untracked sidecars (tensorboard, notebook, ps-as-observer) never join
+        # the jax.distributed group — and must not become its coordinator.
+        exclude = self.config.untracked_types()
+        order = canonical_task_order(cluster_spec, exclude)
+        if (job_name, index) not in order:
+            return env  # sidecar task: no process-group contract
+        env[constants.ENV_JAX_COORDINATOR] = coordinator_address(cluster_spec, exclude)
         env[constants.ENV_JAX_PROCESS_ID] = str(order.index((job_name, index)))
         env[constants.ENV_JAX_NUM_PROCESSES] = str(len(order))
         return env

@@ -17,8 +17,11 @@ from tony_tpu.runtime.jax_runtime import canonical_task_order, coordinator_addre
 class TorchRuntime(FrameworkRuntime):
     def executor_env(self, cluster_spec: dict[str, list[str]], job_name: str, index: int) -> dict[str, str]:
         env = super().executor_env(cluster_spec, job_name, index)
-        order = canonical_task_order(cluster_spec)
-        coord = coordinator_address(cluster_spec)
+        exclude = self.config.untracked_types()
+        order = canonical_task_order(cluster_spec, exclude)
+        if (job_name, index) not in order:
+            return env  # sidecar task: not a torch.distributed member
+        coord = coordinator_address(cluster_spec, exclude)
         host, _, port = coord.rpartition(":")
         env[constants.ENV_MASTER_ADDR] = host
         env[constants.ENV_MASTER_PORT] = port

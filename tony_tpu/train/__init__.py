@@ -1,0 +1,9 @@
+"""Training: train-step builder, checkpointing, throughput/MFU metrics."""
+
+from tony_tpu.train.trainer import (  # noqa: F401
+    OptimizerConfig,
+    Throughput,
+    TrainState,
+    make_train_step,
+    sharded_init,
+)

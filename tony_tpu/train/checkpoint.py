@@ -1,0 +1,93 @@
+"""Sharded checkpointing + resume (SURVEY.md §5.4 rebuild duty).
+
+The reference never owned checkpoints (user code wrote to HDFS; TonY only
+restarted gangs). Here checkpoint/resume is part of the framework because the
+AM's gang-restart elasticity (appmaster.py) is only useful if a restarted gang
+resumes: Orbax async sharded save (per-host writes, non-blocking train loop) +
+latest-step restore with the target sharding applied on load.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax.checkpoint.CheckpointManager.
+
+    save() is async by default: the train loop keeps stepping while device
+    arrays are serialized; wait() (or close()) drains in-flight writes.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 0,
+        use_async: bool = True,
+    ):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps or 1,
+            enable_async_checkpointing=use_async,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        return self._mgr.save(step, args=self._ocp.args.StandardSave(state), force=force)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, state_like: Any, step: int | None = None) -> Any:
+        """Restore into the sharding/structure of ``state_like`` (an abstract
+        or concrete pytree; concrete shardings are honored on load)."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree.map(_abstractify, state_like)
+        return self._mgr.restore(step, args=self._ocp.args.StandardRestore(abstract))
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def _abstractify(x: Any) -> Any:
+    if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+    return x
+
+
+def restore_or_init(
+    ckpt_dir: str | None,
+    init_fn,
+    *,
+    max_to_keep: int = 3,
+    use_async: bool = True,
+) -> tuple[Any, "CheckpointManager | None", int]:
+    """The gang-restart resume path: (state, manager, start_step).
+
+    With no ckpt_dir configured → (init_fn(), None, 0). With one configured,
+    restores the latest checkpoint if present, else initializes fresh.
+    """
+    if not ckpt_dir:
+        return init_fn(), None, 0
+    mgr = CheckpointManager(ckpt_dir, max_to_keep=max_to_keep, use_async=use_async)
+    state = init_fn()
+    step = mgr.latest_step()
+    if step is not None:
+        state = mgr.restore(state)
+        return state, mgr, int(step)
+    return state, mgr, 0

@@ -1,0 +1,137 @@
+"""Reusable training loop: what a user program run by `tony submit` calls.
+
+The analog of the reference's example training scripts' shared structure
+(tony-examples, SURVEY.md §2.3) promoted into the framework: join the gang
+(init_distributed), build the mesh from the env/args, shard-init the model,
+step with throughput metrics, checkpoint on an interval, resume after a gang
+restart.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+from dataclasses import dataclass
+
+import jax
+
+from tony_tpu.parallel import MeshSpec
+from tony_tpu.runtime import init_distributed
+from tony_tpu.train.checkpoint import restore_or_init
+from tony_tpu.train.metrics import detect_peak_flops
+from tony_tpu.train.trainer import OptimizerConfig, Throughput, make_train_step, sharded_init
+
+
+@dataclass(frozen=True)
+class LoopConfig:
+    steps: int = 100
+    batch_size: int = 8
+    seq_len: int = 512
+    log_every: int = 10
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    model_axis: int = 1
+    context_axis: int = 1
+    expert_axis: int = 1
+
+
+def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
+    """Generic decoder-LM pretraining loop (llama/mixtral modules).
+
+    model_module must expose init/loss_fn/sharding_rules/synthetic_batch and
+    the config flops_per_token(). Returns the final metrics dict.
+    """
+    init_distributed()  # no-op off-gang; joins jax.distributed under tony
+    spec = MeshSpec.auto(
+        model=loop.model_axis, context=loop.context_axis, expert=loop.expert_axis
+    )
+    mesh = spec.build()
+    n_chips = len(jax.devices())
+
+    opt_cfg = OptimizerConfig(
+        learning_rate=loop.learning_rate, warmup_steps=loop.warmup_steps, total_steps=loop.steps
+    )
+    opt = opt_cfg.build()
+    rules = model_module.sharding_rules(model_cfg)
+
+    def init_state():
+        return sharded_init(
+            lambda: model_module.init(jax.random.PRNGKey(0), model_cfg), rules, mesh, opt
+        )
+
+    state, ckpt_mgr, start_step = restore_or_init(loop.checkpoint_dir or None, init_state)
+    if start_step:
+        print(f"[train] resumed from checkpoint step {start_step}", flush=True)
+
+    step_fn = make_train_step(
+        functools.partial(model_module.loss_fn, cfg=model_cfg, mesh=mesh), opt
+    )
+    meter = Throughput(
+        tokens_per_step=loop.batch_size * loop.seq_len,
+        flops_per_token=model_cfg.flops_per_token(),
+        n_chips=n_chips,
+        peak_flops=detect_peak_flops(),
+    )
+
+    key = jax.random.PRNGKey(start_step + 1)
+    metrics: dict = {}
+    meter.start()
+    for step in range(start_step, loop.steps):
+        batch = model_module.synthetic_batch(
+            jax.random.fold_in(key, step), loop.batch_size, loop.seq_len, model_cfg
+        )
+        state, metrics = step_fn(state, batch)
+        meter.step()
+        if (step + 1) % loop.log_every == 0 or step + 1 == loop.steps:
+            jax.block_until_ready(metrics["loss"])
+            report = meter.report()
+            line = {
+                "step": int(metrics["step"]),
+                "loss": round(float(metrics["loss"]), 4),
+                "grad_norm": round(float(metrics["grad_norm"]), 4),
+                "tokens_per_sec": round(report["tokens_per_sec"], 1),
+                "mfu": round(report["mfu"], 4),
+                "time": time.strftime("%H:%M:%S"),
+            }
+            print(json.dumps(line), flush=True)
+            meter.start()
+        if (
+            ckpt_mgr is not None
+            and loop.checkpoint_every
+            and (step + 1) % loop.checkpoint_every == 0
+        ):
+            ckpt_mgr.save(step + 1, state)
+    if ckpt_mgr is not None:
+        # skip if this step is already on disk (resume that ran no new steps)
+        if ckpt_mgr.latest_step() != loop.steps:
+            ckpt_mgr.save(loop.steps, state, force=True)
+        ckpt_mgr.wait()
+        ckpt_mgr.close()
+    return {k: float(v) for k, v in metrics.items() if hasattr(v, "item") or isinstance(v, (int, float))}
+
+
+def parse_loop_args(argv: list[str] | None = None) -> tuple[LoopConfig, dict]:
+    """Shared CLI for example scripts; returns (LoopConfig, extra model args)."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch_size", type=int, default=8)
+    p.add_argument("--seq_len", type=int, default=512)
+    p.add_argument("--log_every", type=int, default=10)
+    p.add_argument("--checkpoint_dir", default="")
+    p.add_argument("--checkpoint_every", type=int, default=0)
+    p.add_argument("--learning_rate", type=float, default=3e-4)
+    p.add_argument("--warmup_steps", type=int, default=100)
+    p.add_argument("--model_axis", type=int, default=1)
+    p.add_argument("--context_axis", type=int, default=1)
+    p.add_argument("--expert_axis", type=int, default=1)
+    p.add_argument("--preset", default="tiny")
+    args = p.parse_args(argv if argv is not None else sys.argv[1:])
+    d = vars(args)
+    preset = d.pop("preset")
+    return LoopConfig(**d), {"preset": preset}

@@ -1,0 +1,39 @@
+"""Throughput/MFU accounting (SURVEY.md §5.5 rebuild duty).
+
+Peak-FLOPs table for MFU is per-chip bf16 dense compute; MFU =
+model_flops_per_token * tokens_per_sec / (peak * chips). The reference
+published no throughput numbers (BASELINE.md) — these are the numbers this
+framework measures about itself.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# bf16 dense peak FLOPs per chip
+PEAK_FLOPS = {
+    "v5e": 197e12,
+    "v5 lite": 197e12,   # PJRT device_kind spelling on v5e
+    "v6e": 918e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "cpu": 1e12,         # nominal; keeps MFU finite in CPU test runs
+}
+
+
+def detect_peak_flops(device=None) -> float:
+    d = device or jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu").lower()
+    for name, peak in PEAK_FLOPS.items():
+        if name in kind:
+            return peak
+    return PEAK_FLOPS["cpu"]
+
+
+def transformer_flops_per_token(
+    n_params: int, n_layers: int, d_model: int, seq_len: int, *, training: bool = True
+) -> int:
+    """6N (fwd+bwd) + causal-attention term 12·L·D·T (PaLM appendix formula)."""
+    mult = 6 if training else 2
+    attn = (12 if training else 4) * n_layers * d_model * seq_len // 2  # causal halves it
+    return mult * n_params + attn

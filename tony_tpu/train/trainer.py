@@ -1,0 +1,147 @@
+"""Train-step builder: the compute loop the reference left to user frameworks.
+
+Functional and jit-first: one ``TrainState`` pytree, one compiled
+``train_step`` (value_and_grad → optax update), gradient accumulation as a
+``lax.scan`` over microbatches (stays on-device, no host sync), donation of
+the input state so params/optimizer memory is reused in place.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tony_tpu.parallel.sharding import ShardingRules, shard_params
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params: Any, optimizer: optax.GradientTransformation) -> "TrainState":
+        return cls(params=params, opt_state=optimizer.init(params), step=jnp.zeros((), jnp.int32))
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 10_000
+    weight_decay: float = 0.1
+    grad_clip: float = 1.0
+    b1: float = 0.9
+    b2: float = 0.95
+
+    def build(self) -> optax.GradientTransformation:
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, self.learning_rate, self.warmup_steps, max(self.total_steps, self.warmup_steps + 1)
+        )
+        return optax.chain(
+            optax.clip_by_global_norm(self.grad_clip),
+            optax.adamw(schedule, b1=self.b1, b2=self.b2, weight_decay=self.weight_decay),
+        )
+
+
+def make_train_step(
+    loss_fn: Callable[[Any, Any], tuple[jax.Array, dict]],
+    optimizer: optax.GradientTransformation,
+    accum_steps: int = 1,
+) -> Callable[[TrainState, Any], tuple[TrainState, dict]]:
+    """loss_fn(params, batch) -> (loss, aux). Returns a jitted step with the
+    state donated (in-place param/optimizer update on device).
+
+    With accum_steps > 1, the batch's leading dim must be
+    ``accum_steps * microbatch`` and gradients average over a lax.scan.
+    """
+
+    def compute_grads(params, batch):
+        if accum_steps == 1:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+            return loss, aux, grads
+
+        def micro(carry, mb):
+            loss_acc, grads_acc = carry
+            (loss, _aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, mb)
+            return (loss_acc + loss, jax.tree.map(jnp.add, grads_acc, grads)), None
+
+        microbatches = jax.tree.map(
+            lambda x: x.reshape(accum_steps, x.shape[0] // accum_steps, *x.shape[1:]), batch
+        )
+        zeros = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        (loss_sum, grads_sum), _ = jax.lax.scan(micro, (jnp.zeros((), jnp.float32), zeros), microbatches)
+        inv = 1.0 / accum_steps
+        return loss_sum * inv, {}, jax.tree.map(lambda g: g * inv, grads_sum)
+
+    def train_step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
+        loss, aux, grads = compute_grads(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss.astype(jnp.float32),
+            "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+            "step": state.step + 1,
+            **{k: v for k, v in aux.items() if k != "loss"},
+        }
+        return TrainState(params, opt_state, state.step + 1), metrics
+
+    return jax.jit(train_step, donate_argnums=0)
+
+
+def sharded_init(
+    init_fn: Callable[[], Any],
+    rules: ShardingRules,
+    mesh,
+    optimizer: optax.GradientTransformation,
+) -> TrainState:
+    """Initialize params directly onto the mesh (jit with out_shardings so
+    large models never materialize unsharded on one device), then build the
+    optimizer state under the same sharding."""
+    abstract = jax.eval_shape(init_fn)
+    out_sharding = rules.sharding_tree(abstract, mesh)
+    params = jax.jit(init_fn, out_shardings=out_sharding)()
+    # zeros_like under optax.init inherits each param's sharding, so the
+    # optimizer state (the FSDP memory win) lands sharded too.
+    opt_state = optimizer.init(params)
+    return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+
+class Throughput:
+    """Wall-clock tokens/s + MFU meter around the jitted step (host side)."""
+
+    def __init__(self, tokens_per_step: int, flops_per_token: int, n_chips: int, peak_flops: float):
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_token = flops_per_token
+        self.n_chips = max(n_chips, 1)
+        self.peak_flops = peak_flops
+        self._t0: float | None = None
+        self.steps = 0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+        self.steps = 0
+
+    def step(self) -> None:
+        self.steps += 1
+
+    def report(self) -> dict:
+        dt = time.perf_counter() - (self._t0 or time.perf_counter())
+        if dt <= 0 or self.steps == 0:
+            return {"tokens_per_sec": 0.0, "mfu": 0.0, "step_time_ms": 0.0}
+        tps = self.tokens_per_step * self.steps / dt
+        flops = tps * self.flops_per_token
+        return {
+            "tokens_per_sec": tps,
+            "tokens_per_sec_per_chip": tps / self.n_chips,
+            "step_time_ms": 1000 * dt / self.steps,
+            "mfu": flops / (self.peak_flops * self.n_chips),
+        }
