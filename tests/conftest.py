@@ -17,6 +17,9 @@ import sys
 # spawned by E2E tests (AM/executors) inherit the CPU platform too.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Run Pallas TPU kernels through the interpreter on CPU so kernel numerics
+# (incl. the flash-attention backward) are covered without a chip.
+os.environ["TONY_PALLAS_INTERPRET"] = "1"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

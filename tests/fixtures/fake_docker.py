@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""Fixture: stands in for the docker CLI in container-runtime tests.
+
+Parses ``run`` flags the way docker would (enough of them), records the
+invocation to $FAKE_DOCKER_LOG, then execs the container command directly on
+the host with the ``-e`` environment applied — the process tree behaves like
+a real container launch from the RM's point of view.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    args = sys.argv[1:]
+    assert args and args[0] == "run", f"fake docker got {args[:1]}"
+    args = args[1:]
+    env = dict(os.environ)
+    mounts, flags = [], []
+    image = None
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a in ("-e", "--env"):
+            spec = args[i + 1]
+            if "=" in spec:  # bare `-e KEY` inherits from the client env
+                k, _, v = spec.partition("=")
+                env[k] = v
+            i += 2
+        elif a in ("-v", "--volume"):
+            mounts.append(args[i + 1])
+            i += 2
+        elif a.startswith("-"):
+            flags.append(a)
+            i += 1
+        else:
+            image = a
+            i += 1
+            break
+    command = args[i:]
+    log = os.environ.get("FAKE_DOCKER_LOG")
+    if log:
+        with open(log, "a") as f:
+            f.write(json.dumps({"image": image, "flags": flags, "mounts": mounts,
+                                "command": command}) + "\n")
+    assert image and command, f"fake docker: image={image!r} command={command!r}"
+    os.execvpe(command[0], command, env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,25 @@
+"""Fixture: stands in for a Jupyter server — binds $NOTEBOOK_PORT, serves HTTP.
+
+(The reference tests fake training with tiny scripts; same idea for the
+notebook path: assert the env contract, serve something proxyable.)
+"""
+
+import http.server
+import os
+
+PORT = int(os.environ["NOTEBOOK_PORT"])
+
+
+class Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = b"notebook-fixture-ok"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+http.server.HTTPServer(("0.0.0.0", PORT), Handler).serve_forever()

@@ -1,0 +1,83 @@
+"""Container-runtime (docker) passthrough: AM env injection + RM command wrap.
+
+Mirrors the reference's tony.docker.* behavior (SURVEY.md §2.1 "Docker
+support"): TonY sets YARN docker-runtime envs; here the AM sets the analog
+envs and the ResourceManager (NM analog) rewrites the launch command.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.cluster.client import Client
+from tony_tpu.cluster.resources import _docker_wrap
+from tony_tpu.cluster.session import JobStatus
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+class TestDockerWrap:
+    def _env(self, **extra):
+        return {
+            constants.ENV_CONTAINER_RUNTIME_TYPE: "docker",
+            constants.ENV_CONTAINER_RUNTIME_IMAGE: "tf:latest",
+            constants.ENV_STAGING_DIR: "/stage/app1",
+            "TONY_APP_ID": "app1",
+            "HOME": "/root",  # must NOT be forwarded
+            **extra,
+        }
+
+    def test_wraps_command_with_image_and_mount(self):
+        cmd = _docker_wrap(["python", "-m", "x"], self._env())
+        assert cmd[0] == "docker" and cmd[1] == "run"
+        assert "tf:latest" in cmd
+        assert cmd[-3:] == ["python", "-m", "x"]
+        assert "/stage/app1:/stage/app1" in cmd
+
+    def test_forwards_contract_env_only(self):
+        cmd = _docker_wrap(["x"], self._env())
+        joined = " ".join(cmd)
+        assert "TONY_APP_ID=app1" in joined
+        assert "HOME=" not in joined
+
+    def test_secret_never_on_command_line(self):
+        cmd = _docker_wrap(["x"], self._env(TONY_AM_SECRET="hunter2"))
+        assert "hunter2" not in " ".join(cmd)  # /proc/<pid>/cmdline is world-readable
+        assert "TONY_AM_SECRET" in cmd  # bare -e KEY: inherited from client env
+
+    def test_missing_image_raises(self):
+        env = self._env()
+        env[constants.ENV_CONTAINER_RUNTIME_IMAGE] = ""
+        with pytest.raises(ValueError, match="no image"):
+            _docker_wrap(["x"], env)
+
+
+@pytest.mark.e2e
+class TestDockerE2E:
+    def test_job_runs_inside_fake_docker(self, tmp_tony_root, monkeypatch):
+        log = os.path.join(str(tmp_tony_root), "docker_invocations.jsonl")
+        monkeypatch.setenv("FAKE_DOCKER_LOG", log)
+        cfg = TonyConfig({
+            keys.AM_MONITOR_INTERVAL_MS: "50",
+            keys.STAGING_ROOT: str(tmp_tony_root),
+            "tony.worker.instances": "1",
+            keys.EXECUTES: f"{sys.executable} {os.path.join(FIXTURES, 'exit_0.py')}",
+            keys.DOCKER_ENABLED: "true",
+            keys.DOCKER_IMAGE: "my-train-image:1.0",
+            keys.DOCKER_BINARY: os.path.join(FIXTURES, "fake_docker.py"),
+        })
+        client = Client(cfg)
+        handle = client.submit()
+        final = client.monitor_application(handle, quiet=True)
+        assert final == JobStatus.SUCCEEDED, handle.final_status()
+        with open(log) as f:
+            inv = json.loads(f.readline())
+        assert inv["image"] == "my-train-image:1.0"
+        # the image's python runs the executor (host interpreter path would
+        # not exist inside the image); the repo is bind-mounted read-only
+        assert inv["command"][0] == "python"
+        assert any(m.endswith(":ro") for m in inv["mounts"])

@@ -1,0 +1,94 @@
+"""Notebook path: ProxyServer forwarding + NotebookSubmitter e2e.
+
+Mirrors the reference's NotebookSubmitter/ProxyServer behavior (SURVEY.md
+§2.1, §3.4) with the fixture-server strategy of its test suite.
+"""
+
+import os
+import socket
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.cluster.client import Client
+from tony_tpu.cluster.proxy import ProxyServer
+from tony_tpu.cli.notebook import build_notebook_config, wait_for_notebook_url
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+FAST = {
+    keys.AM_MONITOR_INTERVAL_MS: "50",
+    keys.TASK_HEARTBEAT_INTERVAL_MS: "100",
+}
+
+
+class TestProxyServer:
+    def test_forwards_bytes_both_ways(self):
+        # upstream echo server
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+
+        def echo():
+            conn, _ = srv.accept()
+            with conn:
+                while data := conn.recv(4096):
+                    conn.sendall(data.upper())
+
+        threading.Thread(target=echo, daemon=True).start()
+        proxy = ProxyServer("127.0.0.1", srv.getsockname()[1]).start()
+        try:
+            with socket.create_connection(("127.0.0.1", proxy.local_port), timeout=5) as c:
+                c.sendall(b"hello")
+                assert c.recv(4096) == b"HELLO"
+        finally:
+            proxy.stop()
+            srv.close()
+
+    def test_stop_closes_listener(self):
+        proxy = ProxyServer("127.0.0.1", 1).start()
+        port = proxy.local_port
+        proxy.stop()
+        with pytest.raises(OSError):
+            socket.create_connection(("127.0.0.1", port), timeout=0.5)
+
+
+class TestNotebookConfig:
+    def test_build_config_declares_single_notebook_task(self):
+        config, args = build_notebook_config(["--executes", "mycmd", "--local_port", "7777"])
+        assert config.instances(constants.NOTEBOOK_JOB_NAME) == 1
+        assert (
+            config.get(keys.jobtype_key(constants.NOTEBOOK_JOB_NAME, keys.COMMAND_SUFFIX))
+            == "mycmd"
+        )
+        assert args.local_port == 7777
+
+
+@pytest.mark.e2e
+class TestNotebookE2E:
+    def test_notebook_url_registered_and_proxyable(self, tmp_tony_root):
+        cmd = f"{sys.executable} {os.path.join(FIXTURES, 'notebook_server.py')}"
+        cfg = TonyConfig({**FAST, keys.STAGING_ROOT: str(tmp_tony_root)})
+        cfg.set(keys.jobtype_key(constants.NOTEBOOK_JOB_NAME, keys.INSTANCES_SUFFIX), "1")
+        cfg.set(keys.jobtype_key(constants.NOTEBOOK_JOB_NAME, keys.COMMAND_SUFFIX), cmd)
+
+        client = Client(cfg)
+        handle = client.submit()
+        try:
+            target = wait_for_notebook_url(handle, timeout_s=30)
+            assert target is not None, "notebook URL never registered with the AM"
+            proxy = ProxyServer(target[0], target[1]).start()
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{proxy.local_port}/", timeout=10
+                ).read()
+                assert body == b"notebook-fixture-ok"
+            finally:
+                proxy.stop()
+        finally:
+            Client.kill(handle)
+            client.monitor_application(handle, quiet=True)

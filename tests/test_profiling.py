@@ -1,0 +1,84 @@
+"""Profiler sidecar (SURVEY.md §5.1 rebuild): window state machine + env wiring."""
+
+import os
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.train import profiling
+from tony_tpu.train.profiling import StepProfiler
+
+
+class _FakeJaxProfiler:
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, d):
+        self.calls.append(("start", d))
+
+    def stop_trace(self):
+        self.calls.append(("stop", None))
+
+
+class TestStepProfiler:
+    def test_disabled_without_env(self):
+        p = StepProfiler(env={})
+        assert not p.enabled
+        p.step(0); p.step(100)  # must be a no-op (would import jax otherwise)
+        assert not p.active
+
+    def test_window_state_machine(self, tmp_path, monkeypatch):
+        import jax
+
+        fake = _FakeJaxProfiler()
+        monkeypatch.setattr(jax, "profiler", fake)
+        p = StepProfiler(env={
+            profiling.ENV_PROFILE_DIR: str(tmp_path / "trace"),
+            profiling.ENV_PROFILE_START_STEP: "2",
+            profiling.ENV_PROFILE_NUM_STEPS: "3",
+        })
+        for step in range(8):
+            p.step(step)
+        assert fake.calls == [("start", str(tmp_path / "trace")), ("stop", None)]
+        assert p.done
+        p.step(20)  # one window only
+        assert len(fake.calls) == 2
+
+    def test_stop_flushes_open_window(self, tmp_path, monkeypatch):
+        import jax
+
+        fake = _FakeJaxProfiler()
+        monkeypatch.setattr(jax, "profiler", fake)
+        p = StepProfiler(env={profiling.ENV_PROFILE_DIR: str(tmp_path),
+                              profiling.ENV_PROFILE_START_STEP: "0"})
+        p.step(0)
+        assert p.active
+        p.stop()
+        p.stop()  # idempotent
+        assert fake.calls.count(("stop", None)) == 1
+
+
+class TestExecutorEnvWiring:
+    def test_profile_env_injected(self, monkeypatch, tmp_path):
+        """build_child_env exports the profile contract when enabled."""
+        from tony_tpu.cluster.executor import TaskExecutor
+
+        staging = tmp_path / "stage"
+        staging.mkdir()
+        cfg = TonyConfig({
+            "tony.worker.instances": "1",
+            keys.TASK_PROFILE: "true",
+            keys.TASK_PROFILE_START_STEP: "7",
+        })
+        cfg.freeze()
+        cfg.write_final(str(staging))
+        env = {
+            constants.ENV_APP_ID: "app",
+            constants.ENV_STAGING_DIR: str(staging),
+            constants.ENV_JOB_NAME: "worker",
+            constants.ENV_TASK_INDEX: "0",
+            constants.ENV_AM_PORT: "1",
+        }
+        ex = TaskExecutor(env=env)
+        child_env = ex.build_child_env({"worker": ["h:1"]}, {})
+        assert child_env[profiling.ENV_PROFILE_DIR].endswith(os.path.join("profile", "worker_0"))
+        assert child_env[profiling.ENV_PROFILE_START_STEP] == "7"

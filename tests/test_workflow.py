@@ -1,0 +1,62 @@
+"""Workflow-jobtype integration (tony-azkaban analog, SURVEY.md §2.3)."""
+
+import os
+import sys
+
+import pytest
+
+from tony_tpu.config import keys
+from tony_tpu.cluster.session import JobStatus
+from tony_tpu.integrations import TonyWorkflowJob
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+class TestPropertyMerge:
+    def test_shorthands_map_to_tony_keys(self):
+        job = TonyWorkflowJob("step1", {
+            "executes": "python train.py",
+            "src_dir": "/src",
+            "queue": "ml",
+        })
+        cfg = job.build_config()
+        assert cfg.get(keys.EXECUTES) == "python train.py"
+        assert cfg.get(keys.SRC_DIR) == "/src"
+        assert cfg.get(keys.APPLICATION_QUEUE) == "ml"
+
+    def test_explicit_tony_props_win_over_shorthands(self):
+        job = TonyWorkflowJob("step1", {
+            "executes": "shorthand-cmd",
+            keys.EXECUTES: "explicit-cmd",
+        })
+        assert job.build_config().get(keys.EXECUTES) == "explicit-cmd"
+
+    def test_passthrough_of_arbitrary_tony_keys(self):
+        job = TonyWorkflowJob("s", {"tony.worker.instances": "4"})
+        assert job.build_config().instances("worker") == 4
+
+    def test_job_name_becomes_application_name(self):
+        assert (
+            TonyWorkflowJob("nightly-train", {}).build_config().get(keys.APPLICATION_NAME)
+            == "nightly-train"
+        )
+        assert (
+            TonyWorkflowJob("s", {keys.APPLICATION_NAME: "explicit"})
+            .build_config()
+            .get(keys.APPLICATION_NAME)
+            == "explicit"
+        )
+
+
+@pytest.mark.e2e
+class TestWorkflowE2E:
+    def test_workflow_step_runs_job_and_reports_exit_code(self, tmp_tony_root):
+        props = {
+            "tony.worker.instances": "1",
+            "executes": f"{sys.executable} {os.path.join(FIXTURES, 'exit_0.py')}",
+            "staging_root": str(tmp_tony_root),
+            keys.AM_MONITOR_INTERVAL_MS: "50",
+        }
+        assert TonyWorkflowJob("wf-ok", props).run() == 0
+        props["executes"] = f"{sys.executable} {os.path.join(FIXTURES, 'exit_1.py')}"
+        assert TonyWorkflowJob("wf-fail", props).run() != 0

@@ -53,20 +53,28 @@ def _cmd_portal(argv: list[str]) -> int:
     return portal_main(argv)
 
 
+def _cmd_notebook(argv: list[str]) -> int:
+    from tony_tpu.cli.notebook import main as notebook_main
+
+    return notebook_main(argv)
+
+
 _COMMANDS = {
     "submit": _cmd_submit,
     "history": _cmd_history,
     "portal": _cmd_portal,
+    "notebook": _cmd_notebook,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|history|portal} [options]\n")
-        print("  submit   submit and monitor a job (tony submit --help)")
-        print("  history  list finished jobs / dump one job's events")
-        print("  portal   serve the history web portal")
+        print("usage: tony {submit|history|portal|notebook} [options]\n")
+        print("  submit    submit and monitor a job (tony submit --help)")
+        print("  history   list finished jobs / dump one job's events")
+        print("  portal    serve the history web portal")
+        print("  notebook  launch an interactive notebook container + local proxy")
         return 0
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:

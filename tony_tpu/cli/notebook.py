@@ -1,0 +1,114 @@
+"""Interactive-notebook submission: single-container Jupyter + local proxy.
+
+Analog of the reference's ``tony-cli/.../cli/NotebookSubmitter.java``
+(SURVEY.md §2.3, §3.4): submits a one-task ``notebook`` job, waits for the
+executor to register the notebook server's URL with the AM, then runs a local
+``ProxyServer`` so the user's browser reaches the container via
+``http://localhost:<port>``.
+
+The notebook command sees ``NOTEBOOK_PORT`` in its env and must bind it
+(the executor's registered rendezvous port — the address the AM published).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.cluster.client import Client
+from tony_tpu.cluster.proxy import ProxyServer
+from tony_tpu.cluster.session import JobStatus
+
+DEFAULT_NOTEBOOK_CMD = (
+    'python -m jupyter notebook --no-browser --ip=0.0.0.0 --port="$NOTEBOOK_PORT"'
+)
+
+
+def wait_for_notebook_url(
+    handle, timeout_s: float = 120.0, poll_s: float = 0.3
+) -> tuple[str, int] | None:
+    """Poll the AM until the notebook task registers its URL → (host, port)."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        status = handle.final_status()
+        if status is not None:
+            return None  # job already over — nothing to proxy
+        rpc = handle.rpc(timeout_s=5.0)
+        if rpc is not None:
+            try:
+                for info in rpc.call("get_task_infos"):
+                    if info["name"] == constants.NOTEBOOK_JOB_NAME and info.get("url"):
+                        host, _, port = info["url"].rpartition("//")[2].partition(":")
+                        return host, int(port)
+            except Exception:  # noqa: BLE001 — AM may still be starting
+                pass
+        time.sleep(poll_s)
+    return None
+
+
+def submit_notebook(
+    config: TonyConfig, local_port: int = 0, url_timeout_s: float = 120.0
+) -> int:
+    """Submit, proxy, block until the notebook job ends (or Ctrl-C kills it)."""
+    client = Client(config)
+    handle = client.submit()
+    print(f"[tony-notebook] submitted {handle.app_id}", flush=True)
+
+    try:
+        target = wait_for_notebook_url(handle, timeout_s=url_timeout_s)
+    except KeyboardInterrupt:
+        # interrupt while waiting must not orphan the gang
+        print("[tony-notebook] interrupt — killing notebook job", flush=True)
+        Client.kill(handle)
+        client.monitor_application(handle, quiet=True)
+        return constants.EXIT_KILLED
+    if target is None:
+        print("[tony-notebook] notebook never registered a URL", file=sys.stderr)
+        Client.kill(handle)
+        client.monitor_application(handle, quiet=True)
+        return constants.EXIT_FAILURE
+
+    proxy = ProxyServer(target[0], target[1], local_port=local_port).start()
+    print(
+        f"[tony-notebook] notebook at http://localhost:{proxy.local_port} "
+        f"(→ {target[0]}:{target[1]})",
+        flush=True,
+    )
+    try:
+        final = client.monitor_application(handle, quiet=True)
+    except KeyboardInterrupt:
+        print("[tony-notebook] interrupt — killing notebook job", flush=True)
+        Client.kill(handle)
+        final = client.monitor_application(handle, quiet=True)
+    finally:
+        proxy.stop()
+    return constants.EXIT_SUCCESS if final in (JobStatus.SUCCEEDED, JobStatus.KILLED) else constants.EXIT_FAILURE
+
+
+def build_notebook_config(argv: list[str]) -> tuple[TonyConfig, argparse.Namespace]:
+    p = argparse.ArgumentParser(prog="tony notebook")
+    p.add_argument("--executes", default=DEFAULT_NOTEBOOK_CMD,
+                   help="notebook server command (must bind $NOTEBOOK_PORT)")
+    p.add_argument("--conf_file", default=None)
+    p.add_argument("--conf", action="append", default=[], metavar="K=V")
+    p.add_argument("--local_port", type=int, default=0,
+                   help="local proxy port (0 = pick a free one)")
+    p.add_argument("--url_timeout_s", type=float, default=120.0)
+    args = p.parse_args(argv)
+
+    config = TonyConfig.from_layers(conf_file=args.conf_file, conf_args=args.conf)
+    config.set(keys.jobtype_key(constants.NOTEBOOK_JOB_NAME, keys.INSTANCES_SUFFIX), "1")
+    config.set(keys.jobtype_key(constants.NOTEBOOK_JOB_NAME, keys.COMMAND_SUFFIX), args.executes)
+    return config, args
+
+
+def main(argv: list[str] | None = None) -> int:
+    config, args = build_notebook_config(list(sys.argv[1:] if argv is None else argv))
+    return submit_notebook(config, local_port=args.local_port, url_timeout_s=args.url_timeout_s)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -143,6 +143,19 @@ class ApplicationMaster:
         self.tensorboard_url = url
         return {"ack": True}
 
+    def register_task_url(
+        self, job_name: str, index: int, url: str, attempt: int = 0
+    ) -> dict[str, Any]:
+        """Interactive tasks (notebook, tensorboard, ...) publish their URL so
+        the submitter can proxy it (SURVEY.md §3.4 NotebookSubmitter path)."""
+        session = self._fenced_session(attempt)
+        if session is None:
+            return {"ack": False, "stale": True}
+        with session.lock:
+            session.get_task(job_name, index).url = url
+        self.events.emit(EventType.TASK_URL_REGISTERED, task=f"{job_name}:{index}", url=url)
+        return {"ack": True}
+
     def task_executor_heartbeat(self, job_name: str, index: int, attempt: int = 0) -> dict[str, Any]:
         session = self._fenced_session(attempt)
         if session is None:
@@ -191,7 +204,10 @@ class ApplicationMaster:
         )
         host, port = self.rpc.address
         info = {"host": host, "port": port, "secret": self.secret, "pid": os.getpid()}
-        _atomic_write_json(os.path.join(self.staging_dir, constants.AM_INFO_FILE), info)
+        info_path = os.path.join(self.staging_dir, constants.AM_INFO_FILE)
+        # mode set before publication: the file carries the RPC secret
+        # (delegation-token analog) and pollers race the rename
+        _atomic_write_json(info_path, info, mode=0o600)
         self.session.job_status = JobStatus.RUNNING
 
     def _launch_type(self, job_type: str) -> None:
@@ -239,6 +255,17 @@ class ApplicationMaster:
             }
         )
         cmd = [sys.executable, "-u", "-m", "tony_tpu.cluster.executor"]
+        if self.config.get_bool(keys.DOCKER_ENABLED):
+            # YARN docker-runtime env passthrough analog: the RM (NM analog)
+            # interprets these at container launch (reference: Utils + tony.docker.*).
+            # The framework code is bind-mounted (PYTHONPATH stays valid inside)
+            # and the image's own `python` runs the executor — the host
+            # interpreter path does not exist in the image.
+            env[constants.ENV_CONTAINER_RUNTIME_TYPE] = "docker"
+            env[constants.ENV_CONTAINER_RUNTIME_IMAGE] = self.config.get(keys.DOCKER_IMAGE) or ""
+            env[constants.ENV_CONTAINER_RUNTIME_BINARY] = self.config.get(keys.DOCKER_BINARY) or "docker"
+            env[constants.ENV_CONTAINER_MOUNTS] = f"{_REPO_ROOT}:ro"
+            cmd = ["python", "-u", "-m", "tony_tpu.cluster.executor"]
         self.rm.start_container(container, cmd, env, log_dir)
 
     def _fail(self, reason: str) -> None:
@@ -405,10 +432,10 @@ def _local_host() -> str:
     return os.environ.get("TONY_BIND_HOST", "127.0.0.1")
 
 
-def _atomic_write_json(path: str, obj: Any) -> None:
+def _atomic_write_json(path: str, obj: Any, mode: int = 0o644) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + ".tmp"
-    with open(tmp, "w") as f:
+    with os.fdopen(os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode), "w") as f:
         json.dump(obj, f, indent=1)
     os.replace(tmp, path)
 

@@ -31,6 +31,7 @@ class EventType(enum.Enum):
     TASK_FINISHED = "TASK_FINISHED"
     HEARTBEAT_LOST = "HEARTBEAT_LOST"
     GANG_COMPLETE = "GANG_COMPLETE"
+    TASK_URL_REGISTERED = "TASK_URL_REGISTERED"
     METRICS_SNAPSHOT = "METRICS_SNAPSHOT"
     APPLICATION_FINISHED = "APPLICATION_FINISHED"
 

@@ -120,8 +120,20 @@ class TaskExecutor:
         pybin = self.config.get(keys.PYTHON_BINARY_PATH)
         if pybin:
             env["PYTHON_BINARY"] = pybin
+        if self.config.get_bool(keys.TASK_PROFILE):
+            from tony_tpu.train import profiling
+
+            env[profiling.ENV_PROFILE_DIR] = os.path.join(
+                self.staging_dir, "profile", f"{self.job_name}_{self.index}"
+            )
+            env[profiling.ENV_PROFILE_START_STEP] = self.config.get(keys.TASK_PROFILE_START_STEP)
+            env[profiling.ENV_PROFILE_NUM_STEPS] = self.config.get(keys.TASK_PROFILE_NUM_STEPS)
         if self.job_name == constants.TENSORBOARD_JOB_NAME:
             env[constants.ENV_TB_PORT] = str(self.port)
+        if self.job_name == constants.NOTEBOOK_JOB_NAME:
+            # the interactive server binds the executor's rendezvous port; the
+            # submitter proxies it (NotebookSubmitter/ProxyServer, SURVEY §3.4)
+            env[constants.ENV_NOTEBOOK_PORT] = str(self.port)
         return env
 
     def launch_child(self, command: str, env: dict[str, str]) -> subprocess.Popen:
@@ -224,9 +236,18 @@ class TaskExecutor:
         self.child = self.launch_child(command, env)
         threading.Thread(target=self._metrics_loop, name="metrics", daemon=True).start()
 
-        if self.job_name == constants.TENSORBOARD_JOB_NAME:
+        if self.job_name in (constants.TENSORBOARD_JOB_NAME, constants.NOTEBOOK_JOB_NAME):
+            url = f"http://{self.host}:{self.port}"
             try:
-                self.rpc.call("register_tensorboard_url", url=f"http://{self.host}:{self.port}")
+                if self.job_name == constants.TENSORBOARD_JOB_NAME:
+                    self.rpc.call("register_tensorboard_url", url=url)
+                self.rpc.call(
+                    "register_task_url",
+                    job_name=self.job_name,
+                    index=self.index,
+                    url=url,
+                    attempt=self.attempt,
+                )
             except (RpcError, OSError):
                 pass
 

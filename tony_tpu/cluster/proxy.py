@@ -1,0 +1,102 @@
+"""Local TCP port forwarder for interactive tasks.
+
+Analog of the reference's ``tony-core/.../tony/ProxyServer.java`` (SURVEY.md
+§2.1 "Notebook proxy", §3.4): the notebook submitter runs this on the gateway
+host so a user's browser can reach a Jupyter (or any HTTP) server inside a
+container via ``localhost:<local_port>``. Pure stdlib threads — the traffic is
+a single user's interactive session, not a data plane.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+
+class ProxyServer:
+    """Forwards every connection on ``local_port`` to ``remote_host:remote_port``.
+
+    ``local_port=0`` picks a free port (read it back from ``local_port`` after
+    construction). ``start()`` returns immediately; ``stop()`` closes the
+    listener and all live relays.
+    """
+
+    def __init__(self, remote_host: str, remote_port: int, local_port: int = 0,
+                 bind_host: str = "127.0.0.1"):
+        self.remote_host = remote_host
+        self.remote_port = remote_port
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((bind_host, local_port))
+        self._listener.listen(16)
+        self.local_port: int = self._listener.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, name="proxy-accept", daemon=True)
+        self._conns: set[socket.socket] = set()
+        self._lock = threading.Lock()
+
+    def start(self) -> "ProxyServer":
+        self._thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed by stop()
+            try:
+                upstream = socket.create_connection(
+                    (self.remote_host, self.remote_port), timeout=10
+                )
+            except OSError:
+                client.close()
+                continue
+            with self._lock:
+                self._conns.update((client, upstream))
+            threading.Thread(target=self._relay, args=(client, upstream), daemon=True).start()
+
+    def _relay(self, client: socket.socket, upstream: socket.socket) -> None:
+        """Pump both directions; close and forget both sockets when done
+        (browser UIs open hundreds of short connections — fds must not leak)."""
+        t = threading.Thread(target=self._pump, args=(upstream, client), daemon=True)
+        t.start()
+        self._pump(client, upstream)
+        t.join()
+        with self._lock:
+            self._conns.difference_update((client, upstream))
+        for s in (client, upstream):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, set()
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -183,6 +183,44 @@ class ChipGrid:
             self._used.difference_update(coords)
 
 
+# Env keys forwarded into docker containers: the executor/user contract, not
+# the host's whole environment (reference: YARN forwards a whitelist).
+_DOCKER_ENV_PREFIXES = (
+    "TONY_", "JOB_", "TASK_", "JAX_", "TPU_", "PYTHON", "TF_", "DMLC_",
+    "HOROVOD_", "RANK", "WORLD_SIZE", "LOCAL_RANK", "MASTER_", "CLUSTER_SPEC",
+)
+
+
+# Env values that must never appear on a command line (visible in /proc):
+# passed as bare `-e KEY` so docker inherits them from the client process env.
+_DOCKER_SECRET_KEYS = (constants.ENV_AM_SECRET,)
+
+
+def _docker_wrap(command: list[str], env: dict[str, str]) -> list[str]:
+    """Rewrite a container launch into ``docker run`` (YARN docker-runtime
+    analog). Host networking keeps the executor's registered host:port valid;
+    the staging dir and any TONY_CONTAINER_MOUNTS paths are bind-mounted so
+    the frozen config, logs, and framework code resolve inside the image."""
+    binary = env.get(constants.ENV_CONTAINER_RUNTIME_BINARY) or "docker"
+    image = env.get(constants.ENV_CONTAINER_RUNTIME_IMAGE)
+    if not image:
+        raise ValueError(f"docker runtime requested but no image set "
+                         f"({constants.ENV_CONTAINER_RUNTIME_IMAGE} empty)")
+    cmd = [binary, "run", "--rm", "--network=host", "--ipc=host"]
+    mounts = [env.get(constants.ENV_STAGING_DIR)]
+    mounts += (env.get(constants.ENV_CONTAINER_MOUNTS) or "").split(",")
+    for m in mounts:
+        if m:
+            src = m.split(":", 1)[0]
+            cmd += ["-v", f"{src}:{m}" if ":" in m else f"{m}:{m}"]
+    for k, v in env.items():
+        if k in _DOCKER_SECRET_KEYS:
+            cmd += ["-e", k]  # value inherited from the docker client's env
+        elif any(k.startswith(p) for p in _DOCKER_ENV_PREFIXES):
+            cmd += ["-e", f"{k}={v}"]
+    return cmd + [image] + command
+
+
 @dataclass
 class _Host:
     name: str
@@ -285,6 +323,8 @@ class LocalResourceManager(ResourceManager):
         self, container: Container, command: list[str], env: dict[str, str], log_dir: str
     ) -> None:
         os.makedirs(log_dir, exist_ok=True)
+        if env.get(constants.ENV_CONTAINER_RUNTIME_TYPE) == "docker":
+            command = _docker_wrap(command, env)
         with open(os.path.join(log_dir, "stdout.log"), "ab") as stdout, open(
             os.path.join(log_dir, "stderr.log"), "ab"
         ) as stderr:

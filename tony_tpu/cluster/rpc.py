@@ -177,6 +177,7 @@ APPLICATION_RPC_METHODS = [
     "get_cluster_spec",
     "register_execution_result",
     "register_tensorboard_url",
+    "register_task_url",
     "task_executor_heartbeat",
     "get_task_infos",
     "get_application_status",

@@ -62,6 +62,7 @@ class Task:
     metrics: dict[str, Any] = field(default_factory=dict)
     log_dir: str | None = None
     chip_coords: tuple[tuple[int, ...], ...] = ()
+    url: str | None = None  # interactive tasks (notebook/tensorboard) register one
 
     @property
     def id(self) -> str:
@@ -86,6 +87,7 @@ class Task:
             "metrics": dict(self.metrics),
             "log_dir": self.log_dir,
             "chip_coords": [list(c) for c in self.chip_coords],
+            "url": self.url,
         }
 
 

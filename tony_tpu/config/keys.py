@@ -48,6 +48,9 @@ TASK_EXECUTOR_REGISTRATION_TIMEOUT_MS = "tony.task.registration-timeout-ms"
 TASK_EXECUTOR_EXECUTION_TIMEOUT_MS = "tony.task.execution-timeout-ms"  # 0 = unlimited
 TASK_RESTART_ON_FAILURE = "tony.task.restart-on-failure"  # gang-restart-from-checkpoint
 TASK_MAX_TOTAL_INSTANCE_FAILURES = "tony.task.max-total-instance-failures"
+TASK_PROFILE = "tony.task.profile"                 # capture jax.profiler traces per worker
+TASK_PROFILE_START_STEP = "tony.task.profile.start-step"
+TASK_PROFILE_NUM_STEPS = "tony.task.profile.num-steps"
 
 # ---------------------------------------------------------------------------
 # Per-job-type parameterized keys: tony.<jobtype>.<suffix>
@@ -76,6 +79,7 @@ def dependency_key(depender: str, dependee: str) -> str:
 # ---------------------------------------------------------------------------
 DOCKER_ENABLED = "tony.docker.enabled"
 DOCKER_IMAGE = "tony.docker.containers.image"
+DOCKER_BINARY = "tony.docker.binary"  # docker CLI (tests substitute a fake)
 
 # ---------------------------------------------------------------------------
 # tony.keytab.* — security analog (no Kerberos here; shared-secret auth)
@@ -143,9 +147,13 @@ DEFAULTS: dict[str, str] = {
     TASK_EXECUTOR_EXECUTION_TIMEOUT_MS: "0",
     TASK_RESTART_ON_FAILURE: "false",
     TASK_MAX_TOTAL_INSTANCE_FAILURES: "3",  # only consulted when restart-on-failure
+    TASK_PROFILE: "false",
+    TASK_PROFILE_START_STEP: "3",
+    TASK_PROFILE_NUM_STEPS: "5",
 
     DOCKER_ENABLED: "false",
     DOCKER_IMAGE: "",
+    DOCKER_BINARY: "docker",
 
     KEYTAB_USER: "",
     KEYTAB_LOCATION: "",

@@ -35,12 +35,21 @@ ENV_AM_SECRET = "TONY_AM_SECRET"
 ENV_STAGING_DIR = "TONY_STAGING_DIR"
 ENV_CONTAINER_ID = "TONY_CONTAINER_ID"
 
+# Container-runtime passthrough (analog: YARN_CONTAINER_RUNTIME_TYPE /
+# YARN_CONTAINER_RUNTIME_DOCKER_IMAGE set by TonY when tony.docker.enabled).
+# The AM sets these; the ResourceManager (NM analog) interprets them at launch.
+ENV_CONTAINER_RUNTIME_TYPE = "TONY_CONTAINER_RUNTIME_TYPE"
+ENV_CONTAINER_RUNTIME_IMAGE = "TONY_CONTAINER_RUNTIME_DOCKER_IMAGE"
+ENV_CONTAINER_RUNTIME_BINARY = "TONY_CONTAINER_RUNTIME_DOCKER_BINARY"
+ENV_CONTAINER_MOUNTS = "TONY_CONTAINER_MOUNTS"  # csv "path[:ro]" extra binds
+
 ENV_JOB_NAME = "JOB_NAME"               # task type, e.g. "worker"
 ENV_TASK_INDEX = "TASK_INDEX"           # index within the type
 ENV_TASK_NUM = "TASK_NUM"               # instances of this type
 ENV_DISTRIBUTED_MODE = "DISTRIBUTED_MODE"  # GANG | SINGLE_NODE
 ENV_CLUSTER_SPEC = "CLUSTER_SPEC"       # full cluster spec JSON (legacy TF contract)
 ENV_TB_PORT = "TB_PORT"                 # tensorboard task port
+ENV_NOTEBOOK_PORT = "NOTEBOOK_PORT"     # notebook task port (proxied by submitter)
 
 # ---------------------------------------------------------------------------
 # Env-var contract: framework rendezvous (runtime adapters, SURVEY.md §2.2)

@@ -1,0 +1,5 @@
+"""Workflow-scheduler integrations (the reference's ``tony-azkaban`` analog)."""
+
+from tony_tpu.integrations.workflow import TonyWorkflowJob, run_workflow_job
+
+__all__ = ["TonyWorkflowJob", "run_workflow_job"]

@@ -1,0 +1,85 @@
+"""Workflow-scheduler jobtype: run a tony job as one step of a DAG engine.
+
+Analog of the reference's ``tony-azkaban`` plugin (``TonyJob`` extending the
+Hadoop java jobtype — SURVEY.md §2.3): a workflow engine hands the jobtype a
+flat properties map; the jobtype merges those properties into ``tony.*``
+configuration with the same precedence the reference uses (explicit ``tony.*``
+props win over convenience shorthands), then submits through the normal client
+and reports the job's exit status back to the engine.
+
+Engine-agnostic on purpose: Azkaban/Airflow/Oozie all reduce to "flat props in,
+exit code out". An Airflow user wraps ``run_workflow_job`` in a PythonOperator;
+an Azkaban-style engine shells out to ``python -m tony_tpu.integrations.workflow``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from tony_tpu.config import TonyConfig, keys
+
+# Convenience shorthands a workflow step may use instead of full tony.* keys
+# (reference TonyJob maps Azkaban's job props the same way).
+_SHORTHANDS = {
+    "executes": keys.EXECUTES,
+    "command": keys.EXECUTES,
+    "src_dir": keys.SRC_DIR,
+    "python_venv": keys.PYTHON_VENV,
+    "python_binary_path": keys.PYTHON_BINARY_PATH,
+    "shell_env": keys.SHELL_ENV,
+    "staging_root": keys.STAGING_ROOT,
+    "queue": keys.APPLICATION_QUEUE,
+}
+
+
+class TonyWorkflowJob:
+    """One workflow step that submits a tony job (TonyJob analog)."""
+
+    def __init__(self, name: str, props: dict[str, str]):
+        self.name = name
+        self.props = dict(props)
+
+    def build_config(self) -> TonyConfig:
+        """Merge workflow props → layered tony config.
+
+        Order (later wins, mirroring the reference's Props resolution):
+        defaults ← conf_file prop ← shorthand props ← explicit ``tony.*`` props.
+        """
+        config = TonyConfig.from_layers(conf_file=self.props.get("conf_file"))
+        config.set(keys.APPLICATION_NAME, self.name)  # step name; overridable below
+        for prop, key in _SHORTHANDS.items():
+            if prop in self.props:
+                config.set(key, self.props[prop])
+        for prop, value in self.props.items():
+            if prop.startswith("tony."):
+                config.set(prop, value)
+        return config
+
+    def run(self) -> int:
+        """Submit and monitor; the exit code is the workflow step's verdict."""
+        from tony_tpu.cluster.client import Client
+
+        return Client(self.build_config()).run(quiet=False)
+
+
+def run_workflow_job(name: str, props: dict[str, str]) -> int:
+    """Functional entry point for PythonOperator-style engines."""
+    return TonyWorkflowJob(name, props).run()
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Shell entry point: ``python -m tony_tpu.integrations.workflow <name> <props.json>``
+    (props.json: flat string map, the engine's rendered step properties)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 2:
+        print("usage: python -m tony_tpu.integrations.workflow <job-name> <props.json>",
+              file=sys.stderr)
+        return 2
+    with open(argv[1]) as f:
+        props = {str(k): str(v) for k, v in json.load(f).items()}
+    return run_workflow_job(argv[0], props)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,7 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     remat: bool = True
+    remat_policy: str = "full"  # full | dots (save matmul outputs, recompute the rest)
     attn_impl: str = "auto"   # auto | flash | reference
 
     @property
@@ -184,7 +185,18 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax
             x = constrain(x, mesh, act_spec)
         return x, None
 
-    block_fn = jax.checkpoint(block) if cfg.remat else block
+    if cfg.remat:
+        if cfg.remat_policy == "dots":
+            # save every matmul output inside the block; recompute only the
+            # cheap elementwise/norm chains in the backward pass (trades
+            # ~N_layers × activation-dots memory for skipping the fwd replay)
+            block_fn = jax.checkpoint(
+                block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+        else:
+            block_fn = jax.checkpoint(block)
+    else:
+        block_fn = block
     x, _ = jax.lax.scan(block_fn, x, params["layers"])
 
     x = L.rms_norm(x, params["final_norm"], cfg.norm_eps)

@@ -14,11 +14,16 @@ GQA/MQA is handled by broadcasting KV heads before the kernel.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+# CPU tests run the TPU kernels through the Pallas interpreter (the reference
+# tests multi-node logic without a cluster; same idea for kernels without a chip)
+_INTERPRET = os.environ.get("TONY_PALLAS_INTERPRET", "") == "1"
 
 
 def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
@@ -53,8 +58,12 @@ def attention_reference(
 # Pallas flash attention (TPU)
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scale: float):
-    """Grid: (B*H, Tq//block_q). Online softmax over KV blocks in VMEM."""
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float):
+    """Grid: (B*H, Tq//block_q). Online softmax over KV blocks in VMEM.
+
+    Also emits the per-row logsumexp (scaled-score space) so the Pallas
+    backward can recompute probabilities blockwise without the T×T matrix.
+    """
     from jax.experimental import pallas as pl
 
     block_q, D = q_ref.shape
@@ -92,7 +101,53 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sca
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     o, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (o0, m0, l0))
-    o_ref[:] = (o / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-20)
+    o_ref[:] = (o / l).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_fwd_impl(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, block_q: int, block_k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Shared forward: ([B,H,Tq,D], lse [B,H,Tq]) — shapes pre-validated."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = D ** -0.5
+    qf = q.reshape(B * H, Tq, D)
+    kf = k.reshape(B * H, Tk, D)
+    vf = v.reshape(B * H, Tk, D)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal, scale=scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=_INTERPRET,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * H * Tq * Tk * D,
+            bytes_accessed=2 * (qf.size + kf.size + vf.size) * q.dtype.itemsize,
+            transcendentals=B * H * Tq * Tk,
+        ),
+    )(qf, kf, vf)
+    return out.reshape(B, H, Tq, D), lse.reshape(B, H, Tq)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
@@ -106,49 +161,182 @@ def flash_attention(
     block_k: int = 256,
 ) -> jax.Array:
     """Pallas TPU flash attention; q/k/v: [B, H, T, D], T % block == 0."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    if Tq % block_q or Tk % block_k:
+        return attention_reference(q, k, v, causal=causal)
+    return _flash_fwd_impl(q, k, v, causal, block_q, block_k)[0]
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, block_k: int, causal: bool, scale: float,
+):
+    """Grid: (B*H, Tq//block_q). dq[i] = scale · Σ_kb ds[i,kb] @ k[kb]."""
+    from jax.experimental import pallas as pl
+
+    block_q, D = q_ref.shape
+    Tk = k_ref.shape[0]
+    q_blk_idx = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:][:, None]          # [block_q, 1]
+    delta = delta_ref[:][:, None]      # [block_q, 1]
+    q_pos = q_blk_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    num_k_blocks = pl.cdiv(Tk, block_k)
+    if causal:
+        num_k_blocks = jnp.minimum(num_k_blocks, (q_blk_idx + 1) * block_q // block_k + 1)
+
+    def body(kb, dq):
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                                   # [block_q, block_k]
+        dp = jax.lax.dot_general(                              # do @ v^T
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dq = dq + jax.lax.dot_general(                         # ds @ k
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dq
+
+    dq = jax.lax.fori_loop(0, num_k_blocks, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[:] = (scale * dq).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q: int, causal: bool, scale: float,
+):
+    """Grid: (B*H, Tk//block_k). dk/dv accumulated over contributing q blocks."""
+    from jax.experimental import pallas as pl
+
+    block_k, D = k_ref.shape
+    Tq = q_ref.shape[0]
+    k_blk_idx = pl.program_id(1)
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    k_pos = k_blk_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+
+    num_q_blocks = pl.cdiv(Tq, block_q)
+    # causal: q blocks strictly above the diagonal contribute nothing
+    qb_start = (k_blk_idx * block_k) // block_q if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[pl.ds(qb * block_q, block_q)][:, None]
+        delta_blk = delta_ref[pl.ds(qb * block_q, block_q)][:, None]
+        s = scale * jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_blk)
+        dv = dv + jax.lax.dot_general(                        # p^T @ do
+            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(                             # do @ v^T
+            do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_blk)
+        dk = dk + jax.lax.dot_general(                        # ds^T @ q
+            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    zeros = jnp.zeros((block_k, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qb_start, num_q_blocks, body, (zeros, zeros))
+    dk_ref[:] = (scale * dk).astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(
+    q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Pallas flash backward: recompute p blockwise from (q, k, lse)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    block_q = min(block_q, Tq)
-    block_k = min(block_k, Tk)
     scale = D ** -0.5
-    if Tq % block_q or Tk % block_k:
-        return attention_reference(q, k, v, causal=causal)
-
     qf = q.reshape(B * H, Tq, D)
     kf = k.reshape(B * H, Tk, D)
     vf = v.reshape(B * H, Tk, D)
+    dof = do.reshape(B * H, Tq, D)
+    lsef = lse.reshape(B * H, Tq)
+    # delta[i] = rowsum(do ⊙ o): the softmax-normalization term of ds
+    delta = jnp.sum(
+        dof.astype(jnp.float32) * o.reshape(B * H, Tq, D).astype(jnp.float32), axis=-1
+    )
 
-    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal, scale=scale)
-    out = pl.pallas_call(
-        kernel,
+    full_q = pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0))
+    full_k = pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0))
+    blk_q = pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0))
+    blk_k = pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0))
+    row_q = pl.BlockSpec((None, block_q), lambda b, i: (b, i))
+    row_full = pl.BlockSpec((None, Tq), lambda b, i: (b, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale),
         grid=(B * H, Tq // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        in_specs=[blk_q, full_k, full_k, blk_q, row_q, row_q],
+        out_specs=blk_q,
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
-        ),
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "arbitrary")),
+        interpret=_INTERPRET,
         cost_estimate=pl.CostEstimate(
-            flops=4 * B * H * Tq * Tk * D,
-            bytes_accessed=2 * (qf.size + kf.size + vf.size) * q.dtype.itemsize,
+            flops=6 * B * H * Tq * Tk * D,
+            bytes_accessed=3 * (qf.size + kf.size) * q.dtype.itemsize,
             transcendentals=B * H * Tq * Tk,
         ),
-    )(qf, kf, vf)
-    return out.reshape(B, H, Tq, D)
+    )(qf, kf, vf, dof, lsef, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
+        grid=(B * H, Tk // block_k),
+        in_specs=[full_q, blk_k, blk_k, full_q, row_full, row_full],
+        out_specs=[blk_k, blk_k],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "arbitrary")),
+        interpret=_INTERPRET,
+        cost_estimate=pl.CostEstimate(
+            flops=8 * B * H * Tq * Tk * D,
+            bytes_accessed=3 * (qf.size + kf.size) * q.dtype.itemsize,
+            transcendentals=B * H * Tq * Tk,
+        ),
+    )(qf, kf, vf, dof, lsef, delta)
+
+    return (
+        dq.reshape(B, H, Tq, D),
+        dk.reshape(B, H, Tk, D),
+        dv.reshape(B, H, Tk, D),
+    )
 
 
-# -- trainable flash attention: pallas forward + custom VJP ------------------
+# -- trainable flash attention: pallas forward + pallas backward -------------
 # pallas_call has no JVP rule (pallas guide §20: production kernels define a
-# custom VJP). v1 backward recomputes through the XLA reference path — the
-# forward stays O(T) memory in the kernel; a Pallas backward kernel is the
-# follow-up optimization for long sequences.
+# custom VJP). The backward is the FlashAttention-2 scheme: forward saves the
+# per-row logsumexp; backward recomputes probabilities blockwise in VMEM (two
+# kernels: dq over q blocks, dk/dv over k blocks) — no T×T materialization.
+
+_BLOCK_Q, _BLOCK_K = 256, 256
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_trainable(q, k, v, causal):
@@ -156,13 +344,17 @@ def _flash_trainable(q, k, v, causal):
 
 
 def _flash_fwd(q, k, v, causal):
-    return flash_attention(q, k, v, causal=causal), (q, k, v)
+    Tq, Tk = q.shape[2], k.shape[2]
+    bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
+    o, lse = _flash_fwd_impl(q, k, v, causal, bq, bk)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    Tq, Tk = q.shape[2], k.shape[2]
+    bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
+    return _flash_bwd_impl(q, k, v, o, lse, g, causal, bq, bk)
 
 
 _flash_trainable.defvjp(_flash_fwd, _flash_bwd)

@@ -21,6 +21,7 @@ from tony_tpu.parallel import MeshSpec
 from tony_tpu.runtime import init_distributed
 from tony_tpu.train.checkpoint import restore_or_init
 from tony_tpu.train.metrics import detect_peak_flops
+from tony_tpu.train.profiling import StepProfiler
 from tony_tpu.train.trainer import OptimizerConfig, Throughput, make_train_step, sharded_init
 
 
@@ -79,8 +80,10 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
 
     key = jax.random.PRNGKey(start_step + 1)
     metrics: dict = {}
+    profiler = StepProfiler()  # no-op unless the executor exported TONY_PROFILE_DIR
     meter.start()
     for step in range(start_step, loop.steps):
+        profiler.step(step)
         batch = model_module.synthetic_batch(
             jax.random.fold_in(key, step), loop.batch_size, loop.seq_len, model_cfg
         )
@@ -105,6 +108,7 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
             and (step + 1) % loop.checkpoint_every == 0
         ):
             ckpt_mgr.save(step + 1, state)
+    profiler.stop()  # flush if the run ended inside the capture window
     if ckpt_mgr is not None:
         # skip if this step is already on disk (resume that ran no new steps)
         if ckpt_mgr.latest_step() != loop.steps:

@@ -1,0 +1,69 @@
+"""Per-worker profiler capture: first-class what the reference delegated.
+
+The reference's only profiling story is scheduling a ``tensorboard`` task and
+registering its URL (SURVEY.md §5.1); trace capture itself lived inside the
+user's TF. Here the framework owns it: when a job is submitted with
+``tony.task.profile=true``, each executor exports ``TONY_PROFILE_DIR`` and the
+training loop captures a ``jax.profiler`` trace for a step window into that
+directory — viewable with TensorBoard's profile plugin (including via the
+``tensorboard`` sidecar task type, whose URL the AM registers).
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_PROFILE_DIR = "TONY_PROFILE_DIR"
+ENV_PROFILE_START_STEP = "TONY_PROFILE_START_STEP"
+ENV_PROFILE_NUM_STEPS = "TONY_PROFILE_NUM_STEPS"
+
+
+class StepProfiler:
+    """Captures a ``jax.profiler`` trace over a window of training steps.
+
+    Driven from env (the executor↔user-process contract) so any training
+    program run under tony profiles without code changes beyond calling
+    ``step()`` once per iteration — the framework's own loop does.
+
+    Window semantics: trace starts when ``step() `` is called with
+    ``step == start_step`` and stops ``num_steps`` steps later (default:
+    start at 3 — past compile — for 5 steps).
+    """
+
+    def __init__(self, env: dict[str, str] | None = None):
+        env = dict(os.environ if env is None else env)
+        self.trace_dir = env.get(ENV_PROFILE_DIR) or ""
+        self.start_step = int(env.get(ENV_PROFILE_START_STEP, "3"))
+        self.num_steps = int(env.get(ENV_PROFILE_NUM_STEPS, "5"))
+        self.active = False
+        self.done = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.trace_dir)
+
+    def step(self, step: int) -> None:
+        """Call once per training step (before or after the step body)."""
+        if not self.enabled or self.done:
+            return
+        if not self.active and step >= self.start_step:
+            self._start()
+        elif self.active and step >= self.start_step + self.num_steps:
+            self.stop()
+
+    def _start(self) -> None:
+        import jax
+
+        os.makedirs(self.trace_dir, exist_ok=True)
+        jax.profiler.start_trace(self.trace_dir)
+        self.active = True
+
+    def stop(self) -> None:
+        """Idempotent; also the end-of-training flush for short runs."""
+        if not self.active:
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True
