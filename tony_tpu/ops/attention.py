@@ -21,6 +21,12 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# Per-row stats (logsumexp, delta) are carried with a trailing lane dim of
+# this size: TPU Pallas requires >=2-D tiles whose last dim is 128-divisible
+# OR equal to the full array dim — a small full-width lane dim keeps the
+# HBM cost of the stats negligible while satisfying the tiling rule.
+_STAT_LANES = 8
+
 # CPU tests run the TPU kernels through the Pallas interpreter (the reference
 # tests multi-node logic without a cluster; same idea for kernels without a chip)
 _INTERPRET = os.environ.get("TONY_PALLAS_INTERPRET", "") == "1"
@@ -103,13 +109,22 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: 
     o, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (o0, m0, l0))
     l = jnp.maximum(l, 1e-20)
     o_ref[:] = (o / l).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l))[:, 0]
+    lse_ref[:] = jnp.broadcast_to(m + jnp.log(l), (block_q, _STAT_LANES))
 
 
 def _flash_fwd_impl(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, block_q: int, block_k: int
 ) -> tuple[jax.Array, jax.Array]:
     """Shared forward: ([B,H,Tq,D], lse [B,H,Tq]) — shapes pre-validated."""
+    out, lse_lanes = _flash_fwd_lanes(q, k, v, causal, block_q, block_k)
+    return out, lse_lanes[:, :, :, 0]
+
+
+def _flash_fwd_lanes(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, block_q: int, block_k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Forward returning the lane-replicated lse [B,H,Tq,_STAT_LANES] so the
+    backward can feed it to the Pallas kernels without a re-broadcast."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -131,11 +146,11 @@ def _flash_fwd_impl(
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, _STAT_LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tq, _STAT_LANES), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
@@ -147,7 +162,7 @@ def _flash_fwd_impl(
             transcendentals=B * H * Tq * Tk,
         ),
     )(qf, kf, vf)
-    return out.reshape(B, H, Tq, D), lse.reshape(B, H, Tq)
+    return out.reshape(B, H, Tq, D), lse.reshape(B, H, Tq, _STAT_LANES)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
@@ -182,8 +197,8 @@ def _flash_bwd_dq_kernel(
     q_blk_idx = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:][:, None]          # [block_q, 1]
-    delta = delta_ref[:][:, None]      # [block_q, 1]
+    lse = lse_ref[:][:, :1]            # [block_q, 1] (lanes identical)
+    delta = delta_ref[:][:, :1]        # [block_q, 1]
     q_pos = q_blk_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
     num_k_blocks = pl.cdiv(Tk, block_k)
@@ -235,8 +250,8 @@ def _flash_bwd_dkv_kernel(
         dk, dv = carry
         q_blk = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do_blk = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[pl.ds(qb * block_q, block_q)][:, None]
-        delta_blk = delta_ref[pl.ds(qb * block_q, block_q)][:, None]
+        lse_blk = lse_ref[pl.ds(qb * block_q, block_q), :][:, :1]
+        delta_blk = delta_ref[pl.ds(qb * block_q, block_q), :][:, :1]
         s = scale * jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
@@ -276,18 +291,19 @@ def _flash_bwd_impl(
     kf = k.reshape(B * H, Tk, D)
     vf = v.reshape(B * H, Tk, D)
     dof = do.reshape(B * H, Tq, D)
-    lsef = lse.reshape(B * H, Tq)
+    lsef = lse.reshape(B * H, Tq, _STAT_LANES)  # lane-replicated from the fwd
     # delta[i] = rowsum(do ⊙ o): the softmax-normalization term of ds
     delta = jnp.sum(
         dof.astype(jnp.float32) * o.reshape(B * H, Tq, D).astype(jnp.float32), axis=-1
     )
+    delta = jnp.broadcast_to(delta[:, :, None], (B * H, Tq, _STAT_LANES))
 
     full_q = pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0))
     full_k = pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0))
     blk_q = pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0))
     blk_k = pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0))
-    row_q = pl.BlockSpec((None, block_q), lambda b, i: (b, i))
-    row_full = pl.BlockSpec((None, Tq), lambda b, i: (b, 0))
+    row_q = pl.BlockSpec((None, block_q, _STAT_LANES), lambda b, i: (b, i, 0))
+    row_full = pl.BlockSpec((None, Tq, _STAT_LANES), lambda b, i: (b, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale),
@@ -346,7 +362,7 @@ def _flash_trainable(q, k, v, causal):
 def _flash_fwd(q, k, v, causal):
     Tq, Tk = q.shape[2], k.shape[2]
     bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
-    o, lse = _flash_fwd_impl(q, k, v, causal, bq, bk)
+    o, lse = _flash_fwd_lanes(q, k, v, causal, bq, bk)
     return o, (q, k, v, o, lse)
 
 
