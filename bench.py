@@ -25,9 +25,11 @@ NORTH_STAR_MFU = 0.45
 def _build_presets():
     from tony_tpu.models import llama
 
-    # ~0.9B params: fits one 16G v5e chip with Adam + remat at seq 2048
+    # ~0.9B params: fits one 16G v5e chip with Adam + remat at seq 2048.
+    # remat_policy="dots" saves matmul outputs so the backward skips the
+    # forward replay (measured +2pt MFU over full remat; no-remat OOMs).
     bench_1chip = dataclasses.replace(
-        llama.LLAMA_1B, max_seq=2048, remat=True, attn_impl="auto"
+        llama.LLAMA_1B, max_seq=2048, remat=True, remat_policy="dots", attn_impl="auto"
     )
     tiny = dataclasses.replace(llama.LLAMA_TINY, max_seq=128)
     return {

@@ -1,0 +1,263 @@
+// tonyio: native data-plane loader for tokenized training shards.
+//
+// The reference delegated its data plane to the user's ML framework
+// (tf.data / torch DataLoader inside the user process — SURVEY.md §2.4);
+// here the framework owns it: a C++ loader that mmaps token shards, samples
+// fixed-length sequences (shuffled, sharded across data-parallel workers),
+// and fills pinned host batch buffers from a background prefetch thread so
+// the TPU step never waits on the host.
+//
+// Shard format ("TONYTOK1"): 8-byte magic, u32 dtype (0=u16, 1=i32),
+// u64 token count, then the flat token stream. Written by
+// tony_tpu/data/dataset.py, which also carries the Python fallback reader.
+//
+// C ABI (ctypes-friendly): every function returns 0 on success or a negative
+// errno-style code; the loader handle is an opaque pointer.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'T', 'O', 'N', 'Y', 'T', 'O', 'K', '1'};
+constexpr int kErrIO = -1;
+constexpr int kErrFormat = -2;
+constexpr int kErrArg = -3;
+constexpr int kErrStopped = -4;
+
+struct Shard {
+  void* map = nullptr;
+  size_t map_len = 0;
+  const uint8_t* tokens = nullptr;  // past the header
+  uint64_t count = 0;               // number of tokens
+  uint32_t dtype = 0;               // 0=u16, 1=i32
+
+  int64_t token_at(uint64_t i) const {
+    if (dtype == 0) {
+      uint16_t v;
+      std::memcpy(&v, tokens + i * 2, 2);
+      return v;
+    }
+    int32_t v;
+    std::memcpy(&v, tokens + i * 4, 4);
+    return v;
+  }
+};
+
+struct Batch {
+  std::vector<int32_t> data;  // [batch, seq+1] int32 (inputs+shifted targets)
+  uint64_t index = 0;
+};
+
+struct Loader {
+  std::vector<Shard> shards;
+  uint64_t total_tokens = 0;
+  // sampling plan
+  uint32_t batch = 0, seq = 0;
+  uint32_t shard_id = 0, num_shards = 1;  // data-parallel split
+  uint64_t seed = 0;
+  uint64_t num_windows = 0;  // usable (seq+1)-token windows across shards
+  // prefetch machinery
+  std::deque<Batch> ready;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  std::vector<std::thread> workers;
+  std::atomic<uint64_t> next_index{0};
+  uint64_t next_consume = 0;  // guarded by mu: index the consumer must get next
+  std::atomic<bool> stop{false};
+  uint32_t prefetch_depth = 4;
+
+  ~Loader() {
+    stop.store(true);
+    cv_space.notify_all();
+    cv_ready.notify_all();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    for (auto& s : shards)
+      if (s.map) munmap(s.map, s.map_len);
+  }
+
+  // Map a global window id -> (shard, offset) and copy seq+1 tokens.
+  void fill_sequence(uint64_t window, int32_t* out) const {
+    const uint64_t stride = seq + 1;
+    uint64_t w = window;
+    for (const auto& s : shards) {
+      const uint64_t here = s.count / stride;
+      if (w < here) {
+        const uint64_t base = w * stride;
+        for (uint64_t i = 0; i < stride; ++i) out[i] = (int32_t)s.token_at(base + i);
+        return;
+      }
+      w -= here;
+    }
+    std::memset(out, 0, stride * sizeof(int32_t));  // unreachable when window < num_windows
+  }
+
+  // Deterministic shuffle: batch b draws windows via a splitmix-style hash of
+  // (seed, epoch, slot) — no epoch-wide permutation array, O(1) memory.
+  static uint64_t mix(uint64_t x) {
+    x += 0x9e3779b97f4a7c15ull;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+    return x ^ (x >> 31);
+  }
+
+  Batch make_batch(uint64_t index) const {
+    Batch b;
+    b.index = index;
+    b.data.resize((size_t)batch * (seq + 1));
+    const uint64_t slots_per_epoch = num_windows / num_shards;
+    for (uint32_t i = 0; i < batch; ++i) {
+      const uint64_t slot = index * batch + i;
+      const uint64_t epoch = slots_per_epoch ? slot / slots_per_epoch : 0;
+      const uint64_t pos = slots_per_epoch ? slot % slots_per_epoch : 0;
+      // hash-based draw within this worker's shard of the window space
+      const uint64_t r = mix(seed ^ mix(epoch * 0x10001 + pos));
+      const uint64_t window =
+          slots_per_epoch ? (r % slots_per_epoch) * num_shards + shard_id : 0;
+      fill_sequence(window, b.data.data() + (size_t)i * (seq + 1));
+    }
+    return b;
+  }
+
+  void worker_loop() {
+    while (!stop.load()) {
+      const uint64_t idx = next_index.fetch_add(1);
+      Batch b = make_batch(idx);
+      std::unique_lock<std::mutex> lk(mu);
+      // The batch the consumer is waiting on is always admitted even when
+      // the deque is at depth — otherwise a full deque of later indices
+      // would deadlock against the in-order consumer below.
+      cv_space.wait(lk, [&] {
+        return stop.load() || ready.size() < prefetch_depth || b.index == next_consume;
+      });
+      if (stop.load()) return;
+      // keep batches ordered by index; the consumer pops strictly in order
+      auto it = ready.begin();
+      while (it != ready.end() && it->index < b.index) ++it;
+      ready.insert(it, std::move(b));
+      cv_ready.notify_all();
+    }
+  }
+};
+
+int map_shard(const char* path, Shard* out) {
+  const int fd = open(path, O_RDONLY);
+  if (fd < 0) return kErrIO;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size < 20) {
+    close(fd);
+    return kErrFormat;
+  }
+  void* m = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (m == MAP_FAILED) return kErrIO;
+  const uint8_t* p = (const uint8_t*)m;
+  if (std::memcmp(p, kMagic, 8) != 0) {
+    munmap(m, st.st_size);
+    return kErrFormat;
+  }
+  Shard s;
+  s.map = m;
+  s.map_len = st.st_size;
+  std::memcpy(&s.dtype, p + 8, 4);
+  std::memcpy(&s.count, p + 12, 8);
+  s.tokens = p + 20;
+  const size_t want = s.count * (s.dtype == 0 ? 2 : 4);
+  if (s.dtype > 1 || s.map_len < 20 + want) {
+    munmap(m, st.st_size);
+    return kErrFormat;
+  }
+  madvise(m, st.st_size, MADV_WILLNEED);
+  *out = s;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// paths: NUL-separated, double-NUL-terminated list of shard files.
+int tony_loader_open(const char* paths, uint32_t batch, uint32_t seq,
+                     uint32_t shard_id, uint32_t num_shards, uint64_t seed,
+                     uint32_t prefetch_depth, uint32_t num_threads, void** out) {
+  if (!paths || !out || batch == 0 || seq == 0 || num_shards == 0 || shard_id >= num_shards)
+    return kErrArg;
+  auto ld = new Loader();
+  ld->batch = batch;
+  ld->seq = seq;
+  ld->shard_id = shard_id;
+  ld->num_shards = num_shards;
+  ld->seed = seed;
+  ld->prefetch_depth = prefetch_depth ? prefetch_depth : 4;
+  for (const char* p = paths; *p;) {
+    Shard s;
+    const int rc = map_shard(p, &s);
+    if (rc != 0) {
+      delete ld;
+      return rc;
+    }
+    ld->shards.push_back(s);
+    ld->total_tokens += s.count;
+    ld->num_windows += s.count / (seq + 1);
+    p += std::strlen(p) + 1;
+  }
+  if (ld->num_windows < num_shards) {
+    delete ld;
+    return kErrFormat;  // not enough data for one window per worker
+  }
+  const uint32_t n = num_threads ? num_threads : 2;
+  for (uint32_t i = 0; i < n; ++i) ld->workers.emplace_back([ld] { ld->worker_loop(); });
+  *out = ld;
+  return 0;
+}
+
+// Blocks until the next *sequential* batch is ready; copies [batch, seq+1]
+// int32 into out. Strict index order keeps the stream deterministic (and
+// identical to the single-threaded Python fallback) regardless of how many
+// prefetch threads race on production.
+int tony_loader_next(void* handle, int32_t* out, uint64_t* out_index) {
+  if (!handle || !out) return kErrArg;
+  auto ld = (Loader*)handle;
+  std::unique_lock<std::mutex> lk(ld->mu);
+  ld->cv_ready.wait(lk, [&] {
+    return ld->stop.load() ||
+           (!ld->ready.empty() && ld->ready.front().index == ld->next_consume);
+  });
+  if (ld->stop.load()) return kErrStopped;
+  Batch b = std::move(ld->ready.front());
+  ld->ready.pop_front();
+  ld->next_consume = b.index + 1;
+  lk.unlock();
+  ld->cv_space.notify_all();
+  std::memcpy(out, b.data.data(), b.data.size() * sizeof(int32_t));
+  if (out_index) *out_index = b.index;
+  return 0;
+}
+
+uint64_t tony_loader_total_tokens(void* handle) {
+  return handle ? ((Loader*)handle)->total_tokens : 0;
+}
+
+uint64_t tony_loader_num_windows(void* handle) {
+  return handle ? ((Loader*)handle)->num_windows : 0;
+}
+
+void tony_loader_close(void* handle) {
+  delete (Loader*)handle;
+}
+
+}  // extern "C"

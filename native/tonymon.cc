@@ -1,0 +1,94 @@
+// tonymon: low-overhead host metrics sampling for executor heartbeats.
+//
+// The reference sampled per-container CPU/mem (and forked nvidia-smi for GPU)
+// from the Java executor (SURVEY.md §2.1 "GPU metrics"); the TPU rebuild keeps
+// device metrics on the PJRT side (Python) and does the host side natively:
+// one syscall-cheap snapshot of /proc counters per heartbeat, delta-ed in C++
+// so the Python thread never parses /proc under the GIL.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <unistd.h>
+
+namespace {
+
+struct CpuTimes {
+  uint64_t total = 0, idle = 0;
+};
+
+bool read_cpu(CpuTimes* out) {
+  FILE* f = std::fopen("/proc/stat", "re");
+  if (!f) return false;
+  char line[512];
+  bool ok = false;
+  if (std::fgets(line, sizeof line, f)) {
+    uint64_t v[8] = {0};
+    if (std::sscanf(line, "cpu %lu %lu %lu %lu %lu %lu %lu %lu", &v[0], &v[1],
+                    &v[2], &v[3], &v[4], &v[5], &v[6], &v[7]) >= 4) {
+      out->idle = v[3] + v[4];  // idle + iowait
+      out->total = 0;
+      for (uint64_t x : v) out->total += x;
+      ok = true;
+    }
+  }
+  std::fclose(f);
+  return ok;
+}
+
+uint64_t meminfo_kb(const char* key) {
+  FILE* f = std::fopen("/proc/meminfo", "re");
+  if (!f) return 0;
+  char line[256];
+  uint64_t val = 0;
+  const size_t klen = std::strlen(key);
+  while (std::fgets(line, sizeof line, f)) {
+    if (std::strncmp(line, key, klen) == 0 && line[klen] == ':') {
+      std::sscanf(line + klen + 1, "%lu", &val);
+      break;
+    }
+  }
+  std::fclose(f);
+  return val;
+}
+
+uint64_t self_rss_kb() {
+  FILE* f = std::fopen("/proc/self/statm", "re");
+  if (!f) return 0;
+  uint64_t size = 0, rss = 0;
+  const int n = std::fscanf(f, "%lu %lu", &size, &rss);
+  std::fclose(f);
+  return n == 2 ? rss * (uint64_t)(sysconf(_SC_PAGESIZE) / 1024) : 0;
+}
+
+CpuTimes g_last;  // per-process sampler state (one executor per process)
+
+}  // namespace
+
+extern "C" {
+
+// Fills out[0..4] = {cpu_util_pct, mem_used_pct, mem_total_mb, rss_mb, ncpus}.
+// cpu_util is the delta since the previous call (first call returns 0).
+int tony_mon_sample(double* out) {
+  if (!out) return -3;
+  CpuTimes now;
+  if (!read_cpu(&now)) return -1;
+  double util = 0.0;
+  if (g_last.total && now.total > g_last.total) {
+    const double dt = (double)(now.total - g_last.total);
+    const double di = (double)(now.idle - g_last.idle);
+    util = 100.0 * (1.0 - di / dt);
+  }
+  g_last = now;
+  const uint64_t total_kb = meminfo_kb("MemTotal");
+  const uint64_t avail_kb = meminfo_kb("MemAvailable");
+  out[0] = util;
+  out[1] = total_kb ? 100.0 * (1.0 - (double)avail_kb / (double)total_kb) : 0.0;
+  out[2] = (double)total_kb / 1024.0;
+  out[3] = (double)self_rss_kb() / 1024.0;
+  out[4] = (double)sysconf(_SC_NPROCESSORS_ONLN);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,143 @@
+"""Data plane: TONYTOK shards + native/fallback TokenLoader equivalence.
+
+Mirrors the reference's test style for native-boundary code (SURVEY.md §4):
+deterministic fixtures, both implementations run against the same shards,
+and the env contract (shard_id/num_shards split) asserted directly.
+"""
+
+import numpy as np
+import pytest
+
+from tony_tpu.data import TokenShardWriter, read_shard, write_token_shard
+from tony_tpu.data.native import TokenLoader, HostMetricsSampler, native_available
+
+
+@pytest.fixture()
+def shards(tmp_path):
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(3):
+        toks = rng.integers(0, 32000, size=4096 + i * 512, dtype=np.int32)
+        paths.append(write_token_shard(tmp_path / f"s{i}.tonytok", toks))
+    return paths
+
+
+class TestShardFormat:
+    def test_roundtrip_u16(self, tmp_path):
+        toks = np.arange(1000, dtype=np.int32) % 60000
+        p = write_token_shard(tmp_path / "a.tonytok", toks)
+        np.testing.assert_array_equal(read_shard(p), toks)
+
+    def test_roundtrip_i32(self, tmp_path):
+        toks = np.array([0, 70000, 128255], dtype=np.int32)
+        p = write_token_shard(tmp_path / "b.tonytok", toks)
+        got = read_shard(p)
+        assert got.dtype == np.int32
+        np.testing.assert_array_equal(got, toks)
+
+    def test_rejects_garbage(self, tmp_path):
+        p = tmp_path / "bad.tonytok"
+        p.write_bytes(b"NOTATOKENFILE" * 10)
+        with pytest.raises(ValueError):
+            read_shard(p)
+
+    def test_writer_rolls_shards(self, tmp_path):
+        w = TokenShardWriter(tmp_path / "out", shard_tokens=1000)
+        for _ in range(5):
+            w.append(np.arange(400, dtype=np.int32))
+        paths = w.close()
+        assert len(paths) == 2
+        total = sum(read_shard(p).size for p in paths)
+        assert total == 2000
+
+
+class TestTokenLoader:
+    def test_batch_shape_and_range(self, shards):
+        with TokenLoader(shards, batch=4, seq=128, seed=7) as ld:
+            b = ld.next()
+        assert b.shape == (4, 129) and b.dtype == np.int32
+        assert b.min() >= 0 and b.max() < 32000
+
+    def test_deterministic_across_instances(self, shards):
+        with TokenLoader(shards, batch=2, seq=64, seed=3) as a:
+            got_a = [a.next() for _ in range(4)]
+        with TokenLoader(shards, batch=2, seq=64, seed=3) as b:
+            got_b = [b.next() for _ in range(4)]
+        for x, y in zip(got_a, got_b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_seed_changes_stream(self, shards):
+        with TokenLoader(shards, batch=2, seq=64, seed=1) as a, \
+             TokenLoader(shards, batch=2, seq=64, seed=2) as b:
+            assert not np.array_equal(a.next(), b.next())
+
+    def test_dp_shards_draw_disjoint_windows(self, shards):
+        """shard_id strides the window space: workers never read the same window."""
+        seen = set()
+        for sid in range(2):
+            with TokenLoader(shards, batch=4, seq=64, shard_id=sid, num_shards=2, seed=5) as ld:
+                spe = ld.num_windows // 2
+                for i in range(4):
+                    for j in range(4):
+                        slot = i * 4 + j
+                        from tony_tpu.data.native import _splitmix
+                        r = _splitmix(5 ^ _splitmix((slot // spe) * 0x10001 + slot % spe))
+                        seen.add(((r % spe) * 2 + sid, sid))
+        by_window: dict = {}
+        for w, sid in seen:
+            by_window.setdefault(w, set()).add(sid)
+        for w, sids in by_window.items():
+            assert len(sids) == 1, f"window {w} drawn by both workers"
+
+    def test_python_fallback_matches_native(self, shards, monkeypatch):
+        """Both implementations must produce identical batch streams."""
+        if not native_available():
+            pytest.skip("no native toolchain")
+        with TokenLoader(shards, batch=3, seq=96, seed=11) as nat:
+            assert nat.is_native
+            native_batches = [nat.next() for _ in range(3)]
+        import tony_tpu.data.native as N
+        monkeypatch.setattr(N, "_lib", None)
+        monkeypatch.setattr(N, "_lib_err", "forced-off")
+        with TokenLoader(shards, batch=3, seq=96, seed=11) as py:
+            assert not py.is_native
+            for want in native_batches:
+                np.testing.assert_array_equal(py.next(), want)
+
+    def test_empty_paths_raise(self):
+        with pytest.raises(ValueError):
+            TokenLoader([], batch=1, seq=8)
+
+    def test_bad_shard_id_raises(self, shards):
+        with pytest.raises(ValueError):
+            TokenLoader(shards, batch=1, seq=8, shard_id=2, num_shards=2)
+
+    def test_many_threads_keep_batch_order(self, shards, monkeypatch):
+        """4 racing prefetch threads must still deliver index order 0,1,2,…"""
+        if not native_available():
+            pytest.skip("no native toolchain")
+        with TokenLoader(shards, batch=2, seq=64, seed=9, num_threads=4,
+                         prefetch_depth=2) as nat:
+            native_batches = [nat.next() for _ in range(8)]
+        import tony_tpu.data.native as N
+        monkeypatch.setattr(N, "_lib", None)
+        monkeypatch.setattr(N, "_lib_err", "forced-off")
+        with TokenLoader(shards, batch=2, seq=64, seed=9) as py:
+            for want in native_batches:
+                np.testing.assert_array_equal(py.next(), want)
+
+    def test_too_little_data_raises(self, tmp_path):
+        p = write_token_shard(tmp_path / "tiny.tonytok", np.arange(4, dtype=np.int32))
+        with pytest.raises((ValueError, RuntimeError)):
+            TokenLoader([p], batch=1, seq=64)
+
+
+class TestHostMetrics:
+    def test_sample_fields(self):
+        s = HostMetricsSampler()
+        s.sample()  # first call primes the cpu delta
+        m = s.sample()
+        assert set(m) == {"cpu_util_pct", "mem_used_pct", "mem_total_mb", "rss_mb", "ncpus"}
+        assert 0 <= m["cpu_util_pct"] <= 100
+        assert 0 <= m["mem_used_pct"] <= 100
+        assert m["ncpus"] >= 1

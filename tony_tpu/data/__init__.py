@@ -1,0 +1,20 @@
+"""Data plane: tokenized shard datasets + native prefetching loader.
+
+The reference left the input pipeline to the user's framework (tf.data /
+torch DataLoader inside the user process — SURVEY.md §2.4); tony-tpu owns it:
+- ``dataset``: the TONYTOK shard format (writer + pure-Python reader),
+- ``native``: ctypes bindings to the C++ loader (native/tonyio.cc) with
+  mmap + background prefetch; transparently falls back to Python.
+"""
+
+from tony_tpu.data.dataset import TokenShardWriter, read_shard, write_token_shard
+from tony_tpu.data.native import HostMetricsSampler, TokenLoader, native_available
+
+__all__ = [
+    "TokenShardWriter",
+    "read_shard",
+    "write_token_shard",
+    "TokenLoader",
+    "HostMetricsSampler",
+    "native_available",
+]

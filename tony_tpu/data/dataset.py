@@ -1,0 +1,87 @@
+"""TONYTOK shard format: flat token streams for LM pretraining.
+
+Layout (little-endian): 8-byte magic ``TONYTOK1``, u32 dtype (0=uint16,
+1=int32), u64 token count, then the flat token payload. uint16 covers
+vocabularies <= 65535 (2 bytes/token on disk); int32 covers the rest.
+The C++ loader (native/tonyio.cc) mmaps the same format.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+
+import numpy as np
+
+MAGIC = b"TONYTOK1"
+HEADER_SIZE = 20  # 8-byte magic + u32 dtype + u64 count
+
+_DTYPES = {0: np.dtype("<u2"), 1: np.dtype("<i4")}
+
+
+def write_token_shard(path: str | Path, tokens: np.ndarray) -> Path:
+    """Write one shard; dtype picked from the token range."""
+    path = Path(path)
+    tokens = np.asarray(tokens).ravel()
+    if tokens.size and int(tokens.min()) < 0:
+        raise ValueError("negative token ids")
+    code = 0 if (tokens.size == 0 or int(tokens.max()) <= 0xFFFF) else 1
+    payload = tokens.astype(_DTYPES[code])
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<IQ", code, payload.size))
+        f.write(payload.tobytes())
+    return path
+
+
+class TokenShardWriter:
+    """Streaming writer: append token arrays, roll shards at ``shard_tokens``."""
+
+    def __init__(self, out_dir: str | Path, prefix: str = "shard", shard_tokens: int = 1 << 24):
+        self.out_dir = Path(out_dir)
+        self.out_dir.mkdir(parents=True, exist_ok=True)
+        self.prefix = prefix
+        self.shard_tokens = shard_tokens
+        self._buf: list[np.ndarray] = []
+        self._buffered = 0
+        self._shards: list[Path] = []
+
+    def append(self, tokens: np.ndarray) -> None:
+        tokens = np.asarray(tokens).ravel()
+        self._buf.append(tokens)
+        self._buffered += tokens.size
+        if self._buffered >= self.shard_tokens:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._buffered:
+            return
+        path = self.out_dir / f"{self.prefix}-{len(self._shards):05d}.tonytok"
+        write_token_shard(path, np.concatenate(self._buf))
+        self._shards.append(path)
+        self._buf, self._buffered = [], 0
+
+    def close(self) -> list[Path]:
+        self._flush()
+        return self._shards
+
+
+def open_shard(path: str | Path) -> np.memmap:
+    """Memory-map a shard's payload in its stored dtype (u16 or i32) —
+    no copy; slices convert to int32 at use (TokenLoader fallback does
+    this per window so a large corpus never materializes in RAM)."""
+    path = Path(path)
+    with open(path, "rb") as f:
+        head = f.read(HEADER_SIZE)
+    if len(head) < HEADER_SIZE or head[:8] != MAGIC:
+        raise ValueError(f"{path}: not a TONYTOK1 shard")
+    code, count = struct.unpack_from("<IQ", head, 8)
+    if code not in _DTYPES:
+        raise ValueError(f"{path}: unknown dtype code {code}")
+    return np.memmap(path, dtype=_DTYPES[code], mode="r", offset=HEADER_SIZE, shape=(count,))
+
+
+def read_shard(path: str | Path) -> np.ndarray:
+    """Read a whole shard as int32 (materializes; fine for tools/tests —
+    streaming consumers should use open_shard / TokenLoader)."""
+    return np.asarray(open_shard(path), dtype=np.int32)

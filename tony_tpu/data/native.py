@@ -1,0 +1,262 @@
+"""ctypes bindings to the native loader/sampler (native/tonyio.cc, tonymon.cc).
+
+The shared library is built lazily with ``make -C native`` the first time it
+is needed (cached thereafter); when no C++ toolchain is available every entry
+point falls back to a pure-Python implementation with identical semantics —
+the same batches in the same order (both sides implement the same
+splitmix-hash window draw), just without the off-GIL prefetch.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from queue import Queue
+
+import numpy as np
+
+from tony_tpu.data.dataset import open_shard
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libtonyio.so"
+_lib = None
+_lib_err: str | None = None
+_build_lock = threading.Lock()
+
+
+def _load_library():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        try:
+            if not _LIB_PATH.exists():
+                if os.environ.get("TONY_NATIVE_BUILD", "1") != "1":
+                    raise RuntimeError("native build disabled (TONY_NATIVE_BUILD=0)")
+                subprocess.run(
+                    ["make", "-C", str(_NATIVE_DIR)],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            lib = ctypes.CDLL(str(_LIB_PATH))
+            lib.tony_loader_open.restype = ctypes.c_int
+            lib.tony_loader_open.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_void_p),
+            ]
+            lib.tony_loader_next.restype = ctypes.c_int
+            lib.tony_loader_next.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.tony_loader_total_tokens.restype = ctypes.c_uint64
+            lib.tony_loader_total_tokens.argtypes = [ctypes.c_void_p]
+            lib.tony_loader_num_windows.restype = ctypes.c_uint64
+            lib.tony_loader_num_windows.argtypes = [ctypes.c_void_p]
+            lib.tony_loader_close.restype = None
+            lib.tony_loader_close.argtypes = [ctypes.c_void_p]
+            lib.tony_mon_sample.restype = ctypes.c_int
+            lib.tony_mon_sample.argtypes = [ctypes.POINTER(ctypes.c_double)]
+            _lib = lib
+        except Exception as e:  # noqa: BLE001 — any failure → Python fallback
+            _lib_err = f"{type(e).__name__}: {e}"
+        return _lib
+
+
+def native_available() -> bool:
+    """True iff the C++ library is (or can be) loaded; may build it."""
+    return _load_library() is not None
+
+
+def _splitmix(x: int) -> int:
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+
+
+class TokenLoader:
+    """Batched (seq+1)-token window sampler over TONYTOK shards.
+
+    Native path: C++ mmap + prefetch threads (off-GIL). Fallback: numpy with
+    a single Python prefetch thread. Both draw windows with the same
+    splitmix hash of (seed, epoch, slot), strided by ``num_shards`` with
+    offset ``shard_id`` — the data-parallel split the executor env provides.
+    """
+
+    def __init__(
+        self,
+        shard_paths: list[str | Path],
+        batch: int,
+        seq: int,
+        *,
+        shard_id: int = 0,
+        num_shards: int = 1,
+        seed: int = 0,
+        prefetch_depth: int = 4,
+        num_threads: int = 2,
+    ):
+        if not shard_paths:
+            raise ValueError("no shard paths")
+        if num_shards < 1 or not 0 <= shard_id < num_shards:
+            raise ValueError(f"shard_id {shard_id} out of range for num_shards {num_shards}")
+        self.batch, self.seq = batch, seq
+        self.shard_id, self.num_shards, self.seed = shard_id, num_shards, seed
+        self._handle = None
+        self._out = np.empty((batch, seq + 1), np.int32)
+        lib = _load_library()
+        if lib is not None:
+            blob = b"".join(str(Path(p)).encode() + b"\0" for p in shard_paths) + b"\0"
+            handle = ctypes.c_void_p()
+            rc = lib.tony_loader_open(
+                blob, batch, seq, shard_id, num_shards, seed,
+                prefetch_depth, num_threads, ctypes.byref(handle),
+            )
+            if rc != 0:
+                raise ValueError(f"tony_loader_open failed (rc={rc}) for {shard_paths}")
+            self._handle = handle
+            self._lib = lib
+            self.total_tokens = int(lib.tony_loader_total_tokens(handle))
+            self.num_windows = int(lib.tony_loader_num_windows(handle))
+        else:
+            self._shards = [open_shard(p) for p in shard_paths]  # mmapped, stored dtype
+            self.total_tokens = int(sum(s.size for s in self._shards))
+            self.num_windows = int(sum(s.size // (seq + 1) for s in self._shards))
+            if self.num_windows < num_shards:
+                raise ValueError("not enough data for one window per worker")
+            self._queue: Queue = Queue(maxsize=prefetch_depth)
+            self._index = 0
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._py_prefetch, daemon=True)
+            self._thread.start()
+
+    # -- python fallback ----------------------------------------------------
+    def _py_window(self, window: int) -> np.ndarray:
+        stride = self.seq + 1
+        for s in self._shards:
+            here = s.size // stride
+            if window < here:
+                # per-window int32 conversion: only seq+1 tokens leave the mmap
+                return np.asarray(s[window * stride:(window + 1) * stride], np.int32)
+            window -= here
+        raise IndexError(window)
+
+    def _py_batch(self, index: int) -> np.ndarray:
+        out = np.empty((self.batch, self.seq + 1), np.int32)
+        spe = self.num_windows // self.num_shards  # slots per epoch
+        for i in range(self.batch):
+            slot = index * self.batch + i
+            epoch, pos = (slot // spe, slot % spe) if spe else (0, 0)
+            r = _splitmix(self.seed ^ _splitmix(epoch * 0x10001 + pos))
+            window = (r % spe) * self.num_shards + self.shard_id if spe else 0
+            out[i] = self._py_window(window)
+        return out
+
+    def _py_prefetch(self) -> None:
+        # Exceptions are shipped through the queue — a silent producer death
+        # would otherwise hang the consumer forever on an empty queue.
+        try:
+            while not self._stop.is_set():
+                b = self._py_batch(self._index)
+                self._index += 1
+                self._queue.put(b)
+        except Exception as e:  # noqa: BLE001
+            self._queue.put(e)
+
+    # -- public -------------------------------------------------------------
+    @property
+    def is_native(self) -> bool:
+        return self._handle is not None
+
+    def next(self) -> np.ndarray:
+        """Next [batch, seq+1] int32 batch (tokens + shifted targets)."""
+        if self._handle is not None:
+            idx = ctypes.c_uint64()
+            rc = self._lib.tony_loader_next(
+                self._handle,
+                self._out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                ctypes.byref(idx),
+            )
+            if rc != 0:
+                raise RuntimeError(f"tony_loader_next failed (rc={rc})")
+            return self._out.copy()
+        item = self._queue.get()
+        if isinstance(item, Exception):
+            raise RuntimeError("data loader producer failed") from item
+        return item
+
+    def __iter__(self):
+        while True:
+            yield self.next()
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.tony_loader_close(self._handle)
+            self._handle = None
+        elif hasattr(self, "_stop"):
+            self._stop.set()
+            try:  # unblock the producer if it is waiting on a full queue
+                self._queue.get_nowait()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class HostMetricsSampler:
+    """CPU/mem utilization snapshot: native /proc sampler, /proc-free fallback."""
+
+    def __init__(self):
+        self._lib = _load_library()
+        self._last: tuple[int, int] | None = None
+
+    def sample(self) -> dict:
+        if self._lib is not None:
+            out = (ctypes.c_double * 5)()
+            if self._lib.tony_mon_sample(out) == 0:
+                return {
+                    "cpu_util_pct": round(out[0], 2),
+                    "mem_used_pct": round(out[1], 2),
+                    "mem_total_mb": round(out[2], 1),
+                    "rss_mb": round(out[3], 1),
+                    "ncpus": int(out[4]),
+                }
+        return self._py_sample()
+
+    def _py_sample(self) -> dict:
+        try:
+            with open("/proc/stat") as f:
+                parts = [int(x) for x in f.readline().split()[1:9]]
+            total, idle = sum(parts), parts[3] + parts[4]
+            util = 0.0
+            if self._last and total > self._last[0]:
+                util = 100.0 * (1 - (idle - self._last[1]) / (total - self._last[0]))
+            self._last = (total, idle)
+            mem = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, v = line.split(":", 1)
+                    mem[k] = int(v.split()[0])
+            total_kb = mem.get("MemTotal", 0)
+            avail_kb = mem.get("MemAvailable", 0)
+            return {
+                "cpu_util_pct": round(util, 2),
+                "mem_used_pct": round(100.0 * (1 - avail_kb / total_kb), 2) if total_kb else 0.0,
+                "mem_total_mb": round(total_kb / 1024, 1),
+                "rss_mb": 0.0,
+                "ncpus": os.cpu_count() or 1,
+            }
+        except OSError:
+            return {"cpu_util_pct": 0.0, "mem_used_pct": 0.0, "mem_total_mb": 0.0,
+                    "rss_mb": 0.0, "ncpus": os.cpu_count() or 1}

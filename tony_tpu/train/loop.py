@@ -38,6 +38,7 @@ class LoopConfig:
     model_axis: int = 1
     context_axis: int = 1
     expert_axis: int = 1
+    data_dir: str = ""  # dir of *.tonytok shards; empty → synthetic batches
 
 
 def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
@@ -79,36 +80,64 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
     )
 
     key = jax.random.PRNGKey(start_step + 1)
+    loader = None
+    if loop.data_dir:
+        # Real data: the native prefetching loader, data-parallel split by
+        # process (the TF_CONFIG-analog contract: each gang member reads a
+        # disjoint stride of the window space).
+        from pathlib import Path
+
+        from tony_tpu.data import TokenLoader
+
+        paths = sorted(Path(loop.data_dir).glob("*.tonytok"))
+        loader = TokenLoader(
+            paths, loop.batch_size, loop.seq_len,
+            shard_id=jax.process_index(), num_shards=jax.process_count(),
+            seed=start_step,
+        )
+        print(f"[train] data: {len(paths)} shards, {loader.total_tokens} tokens, "
+              f"native={loader.is_native}", flush=True)
+
     metrics: dict = {}
     profiler = StepProfiler()  # no-op unless the executor exported TONY_PROFILE_DIR
     meter.start()
-    for step in range(start_step, loop.steps):
-        profiler.step(step)
-        batch = model_module.synthetic_batch(
-            jax.random.fold_in(key, step), loop.batch_size, loop.seq_len, model_cfg
-        )
-        state, metrics = step_fn(state, batch)
-        meter.step()
-        if (step + 1) % loop.log_every == 0 or step + 1 == loop.steps:
-            jax.block_until_ready(metrics["loss"])
-            report = meter.report()
-            line = {
-                "step": int(metrics["step"]),
-                "loss": round(float(metrics["loss"]), 4),
-                "grad_norm": round(float(metrics["grad_norm"]), 4),
-                "tokens_per_sec": round(report["tokens_per_sec"], 1),
-                "mfu": round(report["mfu"], 4),
-                "time": time.strftime("%H:%M:%S"),
-            }
-            print(json.dumps(line), flush=True)
-            meter.start()
-        if (
-            ckpt_mgr is not None
-            and loop.checkpoint_every
-            and (step + 1) % loop.checkpoint_every == 0
-        ):
-            ckpt_mgr.save(step + 1, state)
-    profiler.stop()  # flush if the run ended inside the capture window
+    try:
+        for step in range(start_step, loop.steps):
+            profiler.step(step)
+            if loader is not None:
+                batch = {"tokens": jax.numpy.asarray(loader.next())}
+            else:
+                batch = model_module.synthetic_batch(
+                    jax.random.fold_in(key, step), loop.batch_size, loop.seq_len, model_cfg
+                )
+            state, metrics = step_fn(state, batch)
+            meter.step()
+            if (step + 1) % loop.log_every == 0 or step + 1 == loop.steps:
+                jax.block_until_ready(metrics["loss"])
+                report = meter.report()
+                line = {
+                    "step": int(metrics["step"]),
+                    "loss": round(float(metrics["loss"]), 4),
+                    "grad_norm": round(float(metrics["grad_norm"]), 4),
+                    "tokens_per_sec": round(report["tokens_per_sec"], 1),
+                    "mfu": round(report["mfu"], 4),
+                    "time": time.strftime("%H:%M:%S"),
+                }
+                print(json.dumps(line), flush=True)
+                meter.start()
+            if (
+                ckpt_mgr is not None
+                and loop.checkpoint_every
+                and (step + 1) % loop.checkpoint_every == 0
+            ):
+                ckpt_mgr.save(step + 1, state)
+    finally:
+        # a failed step/save must not leak the loader's native prefetch
+        # threads + mmapped shards (gang restarts re-enter this function
+        # in the same process) nor a dangling profiler capture
+        if loader is not None:
+            loader.close()
+        profiler.stop()  # flush if the run ended inside the capture window
     if ckpt_mgr is not None:
         # skip if this step is already on disk (resume that ran no new steps)
         if ckpt_mgr.latest_step() != loop.steps:
@@ -134,6 +163,7 @@ def parse_loop_args(argv: list[str] | None = None) -> tuple[LoopConfig, dict]:
     p.add_argument("--model_axis", type=int, default=1)
     p.add_argument("--context_axis", type=int, default=1)
     p.add_argument("--expert_axis", type=int, default=1)
+    p.add_argument("--data_dir", default="")
     p.add_argument("--preset", default="tiny")
     args = p.parse_args(argv if argv is not None else sys.argv[1:])
     d = vars(args)
