@@ -124,8 +124,10 @@ struct Loader {
       const uint64_t slot = index * batch + i;
       const uint64_t epoch = slots_per_epoch ? slot / slots_per_epoch : 0;
       const uint64_t pos = slots_per_epoch ? slot % slots_per_epoch : 0;
-      // hash-based draw within this worker's shard of the window space
-      const uint64_t r = mix(seed ^ mix(epoch * 0x10001 + pos));
+      // hash-based draw within this worker's shard of the window space;
+      // epoch goes through its own mix round so (epoch, pos) keys can't
+      // alias linearly across epochs for any slots_per_epoch
+      const uint64_t r = mix(mix(seed ^ mix(epoch)) ^ pos);
       const uint64_t window =
           slots_per_epoch ? (r % slots_per_epoch) * num_shards + shard_id : 0;
       fill_sequence(window, b.data.data() + (size_t)i * (seq + 1));
@@ -176,8 +178,10 @@ int map_shard(const char* path, Shard* out) {
   std::memcpy(&s.dtype, p + 8, 4);
   std::memcpy(&s.count, p + 12, 8);
   s.tokens = p + 20;
-  const size_t want = s.count * (s.dtype == 0 ? 2 : 4);
-  if (s.dtype > 1 || s.map_len < 20 + want) {
+  // divide instead of multiply: `count * width` can wrap for a corrupt
+  // header, which would pass the size check and read past the mapping
+  const uint64_t width = (s.dtype == 0 ? 2 : 4);
+  if (s.dtype > 1 || s.count > (uint64_t)(s.map_len - 20) / width) {
     munmap(m, st.st_size);
     return kErrFormat;
   }

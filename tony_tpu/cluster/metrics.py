@@ -65,14 +65,31 @@ def sample_tpu_metrics() -> dict[str, Any]:
 
 
 class MetricsSampler:
-    """Combined host+TPU snapshot builder used by the executor push loop."""
+    """Combined host+TPU snapshot builder used by the executor push loop.
+
+    Whole-host CPU utilization / memory pressure comes from the native
+    sampler (native/tonymon.cc via tony_tpu.data.native.HostMetricsSampler,
+    Python /proc fallback inside it); per-process CPU/RSS and per-device HBM
+    are sampled here.
+    """
 
     def __init__(self, child_pid: int | None = None, with_tpu: bool = True):
         self.child_pid = child_pid
         self.with_tpu = with_tpu
+        try:
+            from tony_tpu.data.native import HostMetricsSampler
+
+            self._host = HostMetricsSampler()
+        except Exception:  # noqa: BLE001 — metrics are strictly best-effort
+            self._host = None
 
     def sample(self) -> dict[str, Any]:
         m = sample_host_metrics(self.child_pid)
+        if self._host is not None:
+            try:
+                m["host"] = self._host.sample()
+            except Exception:  # noqa: BLE001
+                pass
         if self.with_tpu:
             tpu = sample_tpu_metrics()
             if tpu:

@@ -35,15 +35,23 @@ def _load_library():
         if _lib is not None or _lib_err is not None:
             return _lib
         try:
-            if not _LIB_PATH.exists():
-                if os.environ.get("TONY_NATIVE_BUILD", "1") != "1":
-                    raise RuntimeError("native build disabled (TONY_NATIVE_BUILD=0)")
-                subprocess.run(
-                    ["make", "-C", str(_NATIVE_DIR)],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
+            if os.environ.get("TONY_NATIVE_BUILD", "1") == "1":
+                # Always invoke make: its prerequisites are the staleness
+                # cache, so an up-to-date .so costs milliseconds while an
+                # edited .cc actually rebuilds. Build failure only matters
+                # when no previously built library exists to load.
+                try:
+                    subprocess.run(
+                        ["make", "-C", str(_NATIVE_DIR)],
+                        check=True,
+                        capture_output=True,
+                        timeout=120,
+                    )
+                except Exception:
+                    if not _LIB_PATH.exists():
+                        raise
+            elif not _LIB_PATH.exists():
+                raise RuntimeError("native build disabled (TONY_NATIVE_BUILD=0)")
             lib = ctypes.CDLL(str(_LIB_PATH))
             lib.tony_loader_open.restype = ctypes.c_int
             lib.tony_loader_open.argtypes = [
@@ -154,7 +162,7 @@ class TokenLoader:
         for i in range(self.batch):
             slot = index * self.batch + i
             epoch, pos = (slot // spe, slot % spe) if spe else (0, 0)
-            r = _splitmix(self.seed ^ _splitmix(epoch * 0x10001 + pos))
+            r = _splitmix(_splitmix(self.seed ^ _splitmix(epoch)) ^ pos)
             window = (r % spe) * self.num_shards + self.shard_id if spe else 0
             out[i] = self._py_window(window)
         return out
