@@ -26,20 +26,30 @@ def _build_presets():
     from tony_tpu.models import llama
 
     # ~0.9B params: fits one 16G v5e chip with Adam + remat at seq 2048.
-    # remat_policy="dots" saves matmul outputs so the backward skips the
-    # forward replay (measured +2pt MFU over full remat; no-remat OOMs).
+    # Best measured single-chip recipe: batch 8 + full remat + materialized
+    # logits (32k vocab). batch 4 + remat_policy="dots" is within noise;
+    # chunked CE costs ~1pt here but is what makes the 128k-vocab 8B fit.
     bench_1chip = dataclasses.replace(
-        llama.LLAMA_1B, max_seq=2048, remat=True, remat_policy="dots", attn_impl="auto"
+        llama.LLAMA_1B, max_seq=2048, remat=True, remat_policy="full",
+        attn_impl="auto", ce_chunk=0,
     )
     tiny = dataclasses.replace(llama.LLAMA_TINY, max_seq=128)
     return {
         "tiny": (tiny, 8, 128),          # (config, batch, seq) — CPU/CI smoke
-        "1chip": (bench_1chip, 4, 2048),  # single v5e
+        "1chip": (bench_1chip, 8, 2048),  # single v5e
         "8b": (llama.LLAMA3_8B, 8, 4096),  # needs a slice (FSDP over ICI)
     }
 
 
-def run_bench(preset: str, steps: int, warmup: int, batch: int | None, seq: int | None) -> dict:
+def run_bench(
+    preset: str,
+    steps: int,
+    warmup: int,
+    batch: int | None,
+    seq: int | None,
+    remat_policy: str | None = None,
+    ce_chunk: int | None = None,
+) -> dict:
     import jax
 
     from tony_tpu.models import llama
@@ -51,6 +61,12 @@ def run_bench(preset: str, steps: int, warmup: int, batch: int | None, seq: int 
     B = batch or B
     T = seq or T
     cfg = dataclasses.replace(cfg, max_seq=T)
+    if remat_policy is not None:
+        cfg = dataclasses.replace(
+            cfg, remat=remat_policy != "none", remat_policy=remat_policy
+        )
+    if ce_chunk is not None:
+        cfg = dataclasses.replace(cfg, ce_chunk=ce_chunk)
 
     n_dev = len(jax.devices())
     spec = MeshSpec.auto(n_dev)  # fsdp over all chips
@@ -105,6 +121,8 @@ def main() -> int:
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--batch", type=int, default=None)
     p.add_argument("--seq", type=int, default=None)
+    p.add_argument("--remat-policy", default=None, choices=["none", "full", "dots"])
+    p.add_argument("--ce-chunk", type=int, default=None, help="0 = materialize logits")
     args = p.parse_args()
 
     import jax
@@ -118,7 +136,10 @@ def main() -> int:
     last_err = None
     for attempt in attempts:
         try:
-            r = run_bench(attempt, args.steps, args.warmup, args.batch, args.seq)
+            r = run_bench(
+                attempt, args.steps, args.warmup, args.batch, args.seq,
+                args.remat_policy, args.ce_chunk,
+            )
             out = {
                 "metric": f"llama_train_mfu_{r['n_chips']}chip_{attempt}",
                 "value": r["mfu"],

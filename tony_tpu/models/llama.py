@@ -46,6 +46,7 @@ class LlamaConfig:
     remat: bool = True
     remat_policy: str = "full"  # full | dots (save matmul outputs, recompute the rest)
     attn_impl: str = "auto"   # auto | flash | reference
+    ce_chunk: int = 512       # fused lm-head+CE chunk length; 0 = materialize logits
 
     @property
     def head_dim(self) -> int:
@@ -139,10 +140,10 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh) -> jax.Array:
 
     q: [B, H, T, Dh]; k/v: [B, Hkv, T, Dh].
     """
-    n_rep = cfg.n_heads // cfg.n_kv_heads
-    k = attn_ops.repeat_kv(k, n_rep)
-    v = attn_ops.repeat_kv(v, n_rep)
     if mesh is not None and mesh.shape.get("context", 1) > 1:
+        n_rep = cfg.n_heads // cfg.n_kv_heads
+        k = attn_ops.repeat_kv(k, n_rep)
+        v = attn_ops.repeat_kv(v, n_rep)
         spec = P(None, None, "context", None)
         ring = jax.shard_map(
             partial(ring_attention, axis_name="context", causal=True),
@@ -156,8 +157,8 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh) -> jax.Array:
     return attn_ops.mha(q, k, v, causal=True, impl=cfg.attn_impl)
 
 
-def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax.Array:
-    """tokens [B, T] int32 → logits [B, T, V]."""
+def hidden_states(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax.Array:
+    """tokens [B, T] int32 → final-norm hidden states [B, T, D]."""
     B, T = tokens.shape
     Dh, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     cos, sin = L.rope_frequencies(Dh, T, cfg.rope_theta)
@@ -199,7 +200,12 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax
         block_fn = block
     x, _ = jax.lax.scan(block_fn, x, params["layers"])
 
-    x = L.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return L.rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax.Array:
+    """tokens [B, T] int32 → logits [B, T, V]."""
+    x = hidden_states(params, tokens, cfg, mesh)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"])
     if mesh is not None:
         logits = constrain(logits, mesh, P(BATCH_AXES, "context", None))
@@ -207,10 +213,21 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax
 
 
 def loss_fn(params: dict, batch: dict, cfg: LlamaConfig, mesh=None) -> tuple[jax.Array, dict]:
-    """batch: {"tokens": [B, T+1]} → next-token CE loss."""
+    """batch: {"tokens": [B, T+1]} → next-token CE loss.
+
+    With ``cfg.ce_chunk > 0`` the lm-head matmul and CE are fused per
+    sequence chunk (ops/layers.chunked_cross_entropy_loss) so the [B, T, V]
+    logits never exist — the activation that otherwise bounds batch size.
+    """
     tokens = batch["tokens"]
-    logits = forward(params, tokens[:, :-1], cfg, mesh)
-    loss, n = L.cross_entropy_loss(logits, tokens[:, 1:])
+    if cfg.ce_chunk > 0:
+        x = hidden_states(params, tokens[:, :-1], cfg, mesh)
+        loss, n = L.chunked_cross_entropy_loss(
+            x, params["lm_head"], tokens[:, 1:], chunk=cfg.ce_chunk
+        )
+    else:
+        logits = forward(params, tokens[:, :-1], cfg, mesh)
+        loss, n = L.cross_entropy_loss(logits, tokens[:, 1:])
     return loss, {"loss": loss, "tokens": n}
 
 

@@ -3,6 +3,7 @@
 from tony_tpu.ops.attention import attention_reference, flash_attention, mha, repeat_kv  # noqa: F401
 from tony_tpu.ops.layers import (  # noqa: F401
     apply_rope,
+    chunked_cross_entropy_loss,
     cross_entropy_loss,
     gelu_mlp,
     layer_norm,

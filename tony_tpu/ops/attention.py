@@ -8,7 +8,10 @@ SURVEY.md §2.4): here multi-head attention is a first-class op with
   score matrix in VMEM tiles (O(T) memory), for long sequences on TPU,
 - ``mha``: the dispatcher models call (impl='auto' picks per backend).
 
-GQA/MQA is handled by broadcasting KV heads before the kernel.
+GQA/MQA is kernel-native: k/v keep their [B, Hkv, T, D] shape and the
+kernels alias q heads onto kv heads through BlockSpec index maps
+(head h reads kv head h // n_rep), so K/V HBM traffic stays at Hkv size.
+Only the XLA reference path broadcasts (``repeat_kv``).
 """
 
 from __future__ import annotations
@@ -124,16 +127,24 @@ def _flash_fwd_lanes(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, block_q: int, block_k: int
 ) -> tuple[jax.Array, jax.Array]:
     """Forward returning the lane-replicated lse [B,H,Tq,_STAT_LANES] so the
-    backward can feed it to the Pallas kernels without a re-broadcast."""
+    backward can feed it to the Pallas kernels without a re-broadcast.
+
+    GQA is kernel-native: k/v arrive as [B, Hkv, Tk, D] and the q-head grid
+    aliases onto kv heads through the BlockSpec index map (head h reads kv
+    head h // n_rep) — no head broadcast, so K/V HBM traffic stays at the
+    Hkv size. Consecutive q heads map to the same kv block, which Pallas
+    recognizes as a revisit and keeps resident in VMEM.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, Tq, D = q.shape
-    Tk = k.shape[2]
+    Hkv, Tk = k.shape[1], k.shape[2]
+    n_rep = H // Hkv
     scale = D ** -0.5
     qf = q.reshape(B * H, Tq, D)
-    kf = k.reshape(B * H, Tk, D)
-    vf = v.reshape(B * H, Tk, D)
+    kf = k.reshape(B * Hkv, Tk, D)
+    vf = v.reshape(B * Hkv, Tk, D)
 
     kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal, scale=scale)
     out, lse = pl.pallas_call(
@@ -141,8 +152,8 @@ def _flash_fwd_lanes(
         grid=(B * H, Tq // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b // n_rep, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b // n_rep, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
@@ -175,13 +186,16 @@ def flash_attention(
     block_q: int = 256,
     block_k: int = 256,
 ) -> jax.Array:
-    """Pallas TPU flash attention; q/k/v: [B, H, T, D], T % block == 0."""
+    """Pallas TPU flash attention; q: [B, H, T, D], k/v: [B, Hkv, T, D] with
+    H % Hkv == 0 (GQA handled inside the kernel), T % block == 0."""
     B, H, Tq, D = q.shape
-    Tk = k.shape[2]
+    Hkv, Tk = k.shape[1], k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"n_heads {H} must be divisible by n_kv_heads {Hkv}")
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
     if Tq % block_q or Tk % block_k:
-        return attention_reference(q, k, v, causal=causal)
+        return attention_reference(q, repeat_kv(k, H // Hkv), repeat_kv(v, H // Hkv), causal=causal)
     return _flash_fwd_impl(q, k, v, causal, block_q, block_k)[0]
 
 
@@ -228,53 +242,120 @@ def _flash_bwd_dq_kernel(
     dq_ref[:] = (scale * dq).astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(
+def _dkv_block_contrib(q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, causal, scale):
+    """One q-block's contribution to (dk, dv) for one k block — the shared
+    gradient math of both dkv variants (they differ only in data staging).
+    Returns dk WITHOUT the final `scale` factor (callers apply it)."""
+    s = scale * jax.lax.dot_general(
+        q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [block_q, block_k]
+    if causal:
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse_blk)
+    dv_c = jax.lax.dot_general(                    # p^T @ do
+        p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dp = jax.lax.dot_general(                      # do @ v^T
+        do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta_blk)
+    dk_c = jax.lax.dot_general(                    # ds^T @ q
+        ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return dk_c, dv_c
+
+
+def _flash_bwd_dkv_kernel_resident(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q: int, causal: bool, scale: float,
+    *, block_q: int, n_rep: int, causal: bool, scale: float,
 ):
-    """Grid: (B*H, Tk//block_k). dk/dv accumulated over contributing q blocks."""
+    """Grid: (B*Hkv, Tk//block_k) with the whole [n_rep·Tq, D] q/do staged in
+    VMEM — the fast variant for moderate sequence lengths: causally-skipped
+    q blocks cost neither DMA nor flops (the fori_loop starts at the
+    diagonal). Selected when the staged operands fit the VMEM budget."""
     from jax.experimental import pallas as pl
 
     block_k, D = k_ref.shape
-    Tq = q_ref.shape[0]
+    Tq = q_ref.shape[0] // n_rep
     k_blk_idx = pl.program_id(1)
     k = k_ref[:].astype(jnp.float32)
     v = v_ref[:].astype(jnp.float32)
     k_pos = k_blk_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
 
     num_q_blocks = pl.cdiv(Tq, block_q)
-    # causal: q blocks strictly above the diagonal contribute nothing
     qb_start = (k_blk_idx * block_k) // block_q if causal else 0
 
-    def body(qb, carry):
-        dk, dv = carry
-        q_blk = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[pl.ds(qb * block_q, block_q), :][:, :1]
-        delta_blk = delta_ref[pl.ds(qb * block_q, block_q), :][:, :1]
-        s = scale * jax.lax.dot_general(
-            q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [block_q, block_k]
-        if causal:
+    def make_body(g_off: int):
+        def body(qb, carry):
+            dk, dv = carry
+            q_blk = q_ref[pl.ds(g_off + qb * block_q, block_q), :].astype(jnp.float32)
+            do_blk = do_ref[pl.ds(g_off + qb * block_q, block_q), :].astype(jnp.float32)
+            lse_blk = lse_ref[pl.ds(g_off + qb * block_q, block_q), :][:, :1]
+            delta_blk = delta_ref[pl.ds(g_off + qb * block_q, block_q), :][:, :1]
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse_blk)
-        dv = dv + jax.lax.dot_general(                        # p^T @ do
-            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        dp = jax.lax.dot_general(                             # do @ v^T
-            do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta_blk)
-        dk = dk + jax.lax.dot_general(                        # ds^T @ q
-            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return dk, dv
+            dk_c, dv_c = _dkv_block_contrib(
+                q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, causal, scale
+            )
+            return dk + dk_c, dv + dv_c
+
+        return body
 
     zeros = jnp.zeros((block_k, D), jnp.float32)
-    dk, dv = jax.lax.fori_loop(qb_start, num_q_blocks, body, (zeros, zeros))
+    dk, dv = zeros, zeros
+    for g in range(n_rep):  # static group unroll
+        dk, dv = jax.lax.fori_loop(qb_start, num_q_blocks, make_body(g * Tq), (dk, dv))
     dk_ref[:] = (scale * dk).astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+# staged q/do bytes (bf16, double-buffered) beyond which the resident dkv
+# variant would exceed the ~16M scoped-VMEM budget → use the streaming grid
+_DKV_RESIDENT_MAX_QROWS = 4096
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q: int, num_q_blocks: int, causal: bool, scale: float,
+):
+    """Grid: (B*Hkv, Tk//block_k, n_rep·Tq//block_q) — q blocks innermost.
+
+    Only one q block is staged in VMEM per step (long sequences would blow
+    the VMEM budget if the whole [n_rep·Tq, D] q were staged, as an earlier
+    design did). dk/dv output blocks are revisited across the inner grid
+    dim, accumulating in f32 in VMEM; GQA group members are folded into the
+    q dim (layout [B*Hkv, n_rep*Tq, …]), so ``j`` walks every (group member,
+    q block) pair and positions are taken modulo the per-head Tq.
+    """
+    from jax.experimental import pallas as pl
+
+    block_k, D = k_ref.shape
+    k_blk_idx = pl.program_id(1)
+    j = pl.program_id(2)
+    qb = j % num_q_blocks  # q-block index within this group member's head
+
+    @pl.when(j == 0)
+    def _init():
+        dk_ref[:] = jnp.zeros_like(dk_ref)
+        dv_ref[:] = jnp.zeros_like(dv_ref)
+
+    # causal: q blocks strictly above the diagonal contribute nothing
+    contributes = True if not causal else (qb + 1) * block_q > k_blk_idx * block_k
+
+    @pl.when(contributes)
+    def _accumulate():
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        k_pos = k_blk_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        q_blk = q_ref[:].astype(jnp.float32)
+        do_blk = do_ref[:].astype(jnp.float32)
+        lse_blk = lse_ref[:][:, :1]
+        delta_blk = delta_ref[:][:, :1]
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+        dk_c, dv_c = _dkv_block_contrib(
+            q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, causal, scale
+        )
+        dk_ref[:] += scale * dk_c
+        dv_ref[:] += dv_c
 
 
 def _flash_bwd_impl(
@@ -285,11 +366,12 @@ def _flash_bwd_impl(
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, Tq, D = q.shape
-    Tk = k.shape[2]
+    Hkv, Tk = k.shape[1], k.shape[2]
+    n_rep = H // Hkv
     scale = D ** -0.5
     qf = q.reshape(B * H, Tq, D)
-    kf = k.reshape(B * H, Tk, D)
-    vf = v.reshape(B * H, Tk, D)
+    kf = k.reshape(B * Hkv, Tk, D)
+    vf = v.reshape(B * Hkv, Tk, D)
     dof = do.reshape(B * H, Tq, D)
     lsef = lse.reshape(B * H, Tq, _STAT_LANES)  # lane-replicated from the fwd
     # delta[i] = rowsum(do ⊙ o): the softmax-normalization term of ds
@@ -298,12 +380,10 @@ def _flash_bwd_impl(
     )
     delta = jnp.broadcast_to(delta[:, :, None], (B * H, Tq, _STAT_LANES))
 
-    full_q = pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0))
-    full_k = pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0))
+    full_k = pl.BlockSpec((None, Tk, D), lambda b, i: (b // n_rep, 0, 0))
     blk_q = pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0))
     blk_k = pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0))
     row_q = pl.BlockSpec((None, block_q, _STAT_LANES), lambda b, i: (b, i, 0))
-    row_full = pl.BlockSpec((None, Tq, _STAT_LANES), lambda b, i: (b, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale),
@@ -320,28 +400,70 @@ def _flash_bwd_impl(
         ),
     )(qf, kf, vf, dof, lsef, delta)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
-        grid=(B * H, Tk // block_k),
-        in_specs=[full_q, blk_k, blk_k, full_q, row_full, row_full],
-        out_specs=[blk_k, blk_k],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
-        ],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "arbitrary")),
-        interpret=_INTERPRET,
-        cost_estimate=pl.CostEstimate(
-            flops=8 * B * H * Tq * Tk * D,
-            bytes_accessed=3 * (qf.size + kf.size) * q.dtype.itemsize,
-            transcendentals=B * H * Tq * Tk,
-        ),
-    )(qf, kf, vf, dof, lsef, delta)
+    # dk/dv: grid over (kv head, k block, group-member × q block); the GQA
+    # group is folded into the q dim (layout [B*Hkv, n_rep*Tq, …]) and the
+    # innermost grid dim walks one q block at a time — O(block) VMEM at any
+    # sequence length, with dk/dv blocks revisited and accumulated in f32.
+    num_q_blocks = Tq // block_q
+    qg = qf.reshape(B * Hkv, n_rep * Tq, D)
+    dog = dof.reshape(B * Hkv, n_rep * Tq, D)
+    lseg = lsef.reshape(B * Hkv, n_rep * Tq, _STAT_LANES)
+    deltag = delta.reshape(B * Hkv, n_rep * Tq, _STAT_LANES)
+    blk_kv2 = pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0))
+    cost = pl.CostEstimate(
+        flops=8 * B * H * Tq * Tk * D,
+        bytes_accessed=3 * (qf.size + kf.size) * q.dtype.itemsize,
+        transcendentals=B * H * Tq * Tk,
+    )
+
+    if n_rep * Tq <= _DKV_RESIDENT_MAX_QROWS:
+        full_qg = pl.BlockSpec((None, n_rep * Tq, D), lambda b, i: (b, 0, 0))
+        row_full_g = pl.BlockSpec((None, n_rep * Tq, _STAT_LANES), lambda b, i: (b, 0, 0))
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_dkv_kernel_resident,
+                block_q=block_q, n_rep=n_rep, causal=causal, scale=scale,
+            ),
+            grid=(B * Hkv, Tk // block_k),
+            in_specs=[full_qg, blk_kv2, blk_kv2, full_qg, row_full_g, row_full_g],
+            out_specs=[blk_kv2, blk_kv2],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * Hkv, Tk, D), k.dtype),
+                jax.ShapeDtypeStruct((B * Hkv, Tk, D), v.dtype),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")
+            ),
+            interpret=_INTERPRET,
+            cost_estimate=cost,
+        )(qg, kf, vf, dog, lseg, deltag)
+    else:
+        blk_qg = pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, j, 0))
+        blk_kv = pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, i, 0))
+        row_qg = pl.BlockSpec((None, block_q, _STAT_LANES), lambda b, i, j: (b, j, 0))
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_dkv_kernel,
+                block_q=block_q, num_q_blocks=num_q_blocks, causal=causal, scale=scale,
+            ),
+            grid=(B * Hkv, Tk // block_k, n_rep * num_q_blocks),
+            in_specs=[blk_qg, blk_kv, blk_kv, blk_qg, row_qg, row_qg],
+            out_specs=[blk_kv, blk_kv],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * Hkv, Tk, D), jnp.float32),
+                jax.ShapeDtypeStruct((B * Hkv, Tk, D), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            ),
+            interpret=_INTERPRET,
+            cost_estimate=cost,
+        )(qg, kf, vf, dog, lseg, deltag)
 
     return (
         dq.reshape(B, H, Tq, D),
-        dk.reshape(B, H, Tk, D),
-        dv.reshape(B, H, Tk, D),
+        dk.reshape(B, Hkv, Tk, D).astype(k.dtype),
+        dv.reshape(B, Hkv, Tk, D).astype(v.dtype),
     )
 
 
@@ -384,11 +506,18 @@ def mha(
     causal: bool = True,
     impl: str = "auto",
 ) -> jax.Array:
-    """Dispatcher: Pallas flash kernel on TPU, XLA reference elsewhere."""
+    """Dispatcher: Pallas flash kernel on TPU, XLA reference elsewhere.
+
+    k/v may carry fewer heads than q (GQA/MQA): the flash kernels read kv
+    heads in place via index-map aliasing; the reference path broadcasts.
+    """
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(f"n_heads {q.shape[1]} must be divisible by n_kv_heads {k.shape[1]}")
+    n_rep = q.shape[1] // k.shape[1]
     if impl == "auto":
         impl = "flash" if jax.default_backend() not in ("cpu",) else "reference"
     if impl == "flash":
         Tq, Tk = q.shape[2], k.shape[2]
         if Tq % min(256, Tq) == 0 and Tk % min(256, Tk) == 0 and Tq >= 128:
             return _flash_trainable(q, k, v, causal)
-    return attention_reference(q, k, v, causal=causal)
+    return attention_reference(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), causal=causal)

@@ -72,3 +72,53 @@ def cross_entropy_loss(
     nll = (logz - gold) * mask
     n = jnp.maximum(mask.sum(), 1)
     return nll.sum() / n, n
+
+
+def chunked_cross_entropy_loss(
+    x: jax.Array,
+    lm_head: jax.Array,
+    targets: jax.Array,
+    ignore_index: int = -100,
+    chunk: int = 512,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused lm-head + CE that never materializes the [B, T, V] logits.
+
+    A ``lax.scan`` over sequence chunks computes each chunk's logits, its
+    logsumexp, and the gold logit, keeping only O(B·chunk·V) live; the
+    chunk body is checkpointed so the backward recomputes per-chunk logits
+    instead of saving them. At Llama-scale vocab this removes the largest
+    activation in the train step (the bf16 logits + f32 softmax temps),
+    which is what bounds the per-chip batch size.
+
+    x: [B, T, D] final hidden states; lm_head: [D, V]; targets: [B, T].
+    """
+    B, T, D = x.shape
+    if chunk <= 0:
+        chunk = T
+    elif T % chunk:
+        # largest divisor of T not exceeding the requested chunk, so the
+        # memory bound survives awkward sequence lengths instead of silently
+        # re-materializing the full [B, T, V] logits
+        chunk = next(c for c in range(min(chunk, T), 0, -1) if T % c == 0)
+    n_chunks = T // chunk
+    mask_all = targets != ignore_index
+    xs = x.reshape(B, n_chunks, chunk, D).transpose(1, 0, 2, 3)
+    ts = targets.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+
+    def chunk_nll(carry, xt):
+        xc, tc = xt
+        logits = jnp.einsum(
+            "bcd,dv->bcv", xc, lm_head, preferred_element_type=jnp.float32
+        )
+        mask = tc != ignore_index
+        safe = jnp.where(mask, tc, 0)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        # gold logit via masked reduce (fuses; no gather, so vocab-parallel
+        # TP shards reduce locally and psum instead of rematerializing)
+        iota = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 2)
+        gold = jnp.sum(jnp.where(iota == safe[..., None], logits, 0.0), axis=-1)
+        return carry + jnp.sum((logz - gold) * mask), None
+
+    total, _ = jax.lax.scan(jax.checkpoint(chunk_nll), jnp.float32(0.0), (xs, ts))
+    n = jnp.maximum(mask_all.sum(), 1)
+    return total / n, n
