@@ -1,0 +1,16 @@
+"""Fixture: thin wrapper over the packaged distributed smoke workload.
+
+Kept as a file fixture so e2e tests exercise the same `--executes "python
+<script>"` path users take; the actual collective logic lives in
+tony_tpu/cli/distributed_smoke.py (shipped with the package, also behind
+``tony mini --distributed``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tony_tpu.cli.distributed_smoke import main  # noqa: E402
+
+raise SystemExit(main())

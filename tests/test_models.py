@@ -35,6 +35,26 @@ class TestLlama:
         actual = sum(p.size for p in jax.tree.leaves(params))
         assert actual == self.cfg.num_params()
 
+    def test_flash_remat_policy_grads_match_full(self):
+        # remat_policy="flash" pins the named flash-kernel outputs; grads must
+        # equal plain full remat (kernels run via the Pallas interpreter on CPU)
+        import dataclasses as dc
+        import numpy as np
+
+        base = dc.replace(self.cfg, remat=True, attn_impl="flash", max_seq=128)
+        params = llama.init(KEY, base)
+        batch = llama.synthetic_batch(KEY, 2, 128, base)
+
+        def loss_with(policy):
+            cfg = dc.replace(base, remat_policy=policy)
+            return jax.grad(lambda p: llama.loss_fn(p, batch, cfg)[0])(params)
+
+        g_full, g_flash = loss_with("full"), loss_with("flash")
+        for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_flash)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=2e-2, atol=1e-4
+            )
+
     def test_loss_decreases(self):
         params = llama.init(KEY, self.cfg)
         opt = quick_opt()

@@ -55,7 +55,7 @@ class TestLayers:
         targets = targets.at[0, :3].set(-100)  # masked prefix
 
         plain_loss, plain_n = L.cross_entropy_loss(jnp.einsum("btd,dv->btv", x, w), targets)
-        for chunk in (4, 16, 5):  # 5: non-divisible → single-chunk fallback
+        for chunk in (4, 16, 5):  # 5: non-divisible → padded with ignored targets
             loss, n = L.chunked_cross_entropy_loss(x, w, targets, chunk=chunk)
             np.testing.assert_allclose(float(loss), float(plain_loss), rtol=1e-5)
             assert int(n) == int(plain_n)

@@ -1,0 +1,66 @@
+"""Distributed smoke workload: join the injected jax.distributed group and
+run a real cross-process collective.
+
+Ships inside the package (``python -m tony_tpu.cli.distributed_smoke``) so
+``tony mini --distributed`` works from an installed wheel, and doubles as the
+data-plane E2E proof (SURVEY.md §2.6): the gang's workers form one JAX
+process group from the env the JaxRuntime adapter injected, all-gather each
+process's rank, and check a jitted psum over the global device set. Runs on
+the CPU backend so no chip is needed — the same code path carries ICI/DCN
+collectives on TPU.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def sanitize_env_for_cpu_group() -> None:
+    """Force one CPU device per process regardless of inherited env: the
+    shell may carry a TPU-plugin JAX_PLATFORMS or a test harness's
+    multi-virtual-device XLA_FLAGS — both would break the
+    one-device-per-rank group."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", os.environ.get("XLA_FLAGS", "")
+    ).strip()
+
+
+def main() -> int:
+    sanitize_env_for_cpu_group()
+
+    import numpy as np
+
+    from tony_tpu.runtime import init_distributed
+
+    init_distributed()
+
+    import jax
+    from jax.experimental import multihost_utils
+
+    n = jax.process_count()
+    r = jax.process_index()
+    assert n == int(os.environ["JAX_NUM_PROCESSES"]), (n, os.environ["JAX_NUM_PROCESSES"])
+    assert r == int(os.environ["JAX_PROCESS_ID"]), (r, os.environ["JAX_PROCESS_ID"])
+
+    ranks = multihost_utils.process_allgather(np.array([r], np.int32))
+    assert sorted(np.asarray(ranks).ravel().tolist()) == list(range(n)), ranks
+
+    # a jitted psum over the global device set (one CPU device per process)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+    x = jax.make_array_from_process_local_data(
+        jax.NamedSharding(mesh, jax.sharding.PartitionSpec("data")),
+        np.array([float(r + 1)], np.float32),
+    )
+    total = jax.jit(
+        lambda a: a.sum(), out_shardings=jax.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    )(x)
+    want = n * (n + 1) / 2
+    assert float(total) == want, (float(total), want)
+    print(f"distributed_smoke ok: rank {r}/{n}, sum={float(total)}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

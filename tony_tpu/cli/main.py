@@ -59,22 +59,75 @@ def _cmd_notebook(argv: list[str]) -> int:
     return notebook_main(argv)
 
 
+def _cmd_mini(argv: list[str]) -> int:
+    """Self-contained sandbox: submit a smoke gang against the local resource
+    manager and print the verdict + history location.
+
+    Analog of the reference's ``tony-mini`` single-node sandbox (SURVEY.md
+    §2.3) — one command to see the whole submit→AM→executor→verdict spine
+    work on this machine, no configuration needed.
+    """
+    import argparse
+    import os
+    import sys as _sys
+    import tempfile
+
+    from tony_tpu.cluster.client import Client
+    from tony_tpu.config import TonyConfig, keys
+
+    p = argparse.ArgumentParser(prog="tony mini", description=_cmd_mini.__doc__)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument(
+        "--distributed", action="store_true",
+        help="workers form a jax.distributed group and run a cross-process "
+             "collective (CPU backend) instead of the env-echo smoke",
+    )
+    p.add_argument("--root", default=None, help="sandbox dir (default: a temp dir)")
+    args = p.parse_args(argv)
+
+    root = args.root or tempfile.mkdtemp(prefix="tony-mini-")
+    if args.distributed:
+        # -m so this works from an installed wheel, not just a source checkout
+        command = f"{_sys.executable} -m tony_tpu.cli.distributed_smoke"
+    else:
+        command = (
+            f"{_sys.executable} -c \"import os; "
+            f"print('hello from', os.environ['JOB_NAME'], os.environ['TASK_INDEX'], "
+            f"'of', os.environ['TASK_NUM'])\""
+        )
+    cfg = TonyConfig({
+        keys.STAGING_ROOT: root,
+        keys.EXECUTES: command,
+        keys.APPLICATION_FRAMEWORK: "jax",
+        keys.jobtype_key("worker", keys.INSTANCES_SUFFIX): str(args.workers),
+    })
+    client = Client(cfg)
+    handle = client.submit()
+    final = client.monitor_application(handle)
+    print(f"[tony-mini] sandbox root: {root}")
+    print(f"[tony-mini] task logs:    {os.path.join(root, handle.app_id, 'logs')}")
+    print(f"[tony-mini] history:      tony history --root {os.path.join(root, 'history')}")
+    return 0 if final.name == "SUCCEEDED" else 1
+
+
 _COMMANDS = {
     "submit": _cmd_submit,
     "history": _cmd_history,
     "portal": _cmd_portal,
     "notebook": _cmd_notebook,
+    "mini": _cmd_mini,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|history|portal|notebook} [options]\n")
+        print("usage: tony {submit|history|portal|notebook|mini} [options]\n")
         print("  submit    submit and monitor a job (tony submit --help)")
         print("  history   list finished jobs / dump one job's events")
         print("  portal    serve the history web portal")
         print("  notebook  launch an interactive notebook container + local proxy")
+        print("  mini      one-command local sandbox (smoke gang, optional --distributed)")
         return 0
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:

@@ -194,6 +194,16 @@ def hidden_states(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) 
             block_fn = jax.checkpoint(
                 block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
             )
+        elif cfg.remat_policy == "flash":
+            # middle ground: pin only the flash-attention kernel outputs so
+            # the backward never replays the O(T²) forward kernel, while the
+            # cheap matmul/elementwise chains still rematerialize
+            block_fn = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "flash_o", "flash_lse"
+                ),
+            )
         else:
             block_fn = jax.checkpoint(block)
     else:

@@ -482,9 +482,16 @@ def _flash_trainable(q, k, v, causal):
 
 
 def _flash_fwd(q, k, v, causal):
+    from jax.ad_checkpoint import checkpoint_name
+
     Tq, Tk = q.shape[2], k.shape[2]
     bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
     o, lse = _flash_fwd_lanes(q, k, v, causal, bq, bk)
+    # Named so a remat policy can pin JUST the kernel outputs
+    # (save_only_these_names("flash_o", "flash_lse")): the backward then
+    # recomputes the cheap qkv matmuls but not the O(T²) flash forward.
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 

@@ -93,13 +93,15 @@ def chunked_cross_entropy_loss(
     x: [B, T, D] final hidden states; lm_head: [D, V]; targets: [B, T].
     """
     B, T, D = x.shape
-    if chunk <= 0:
-        chunk = T
-    elif T % chunk:
-        # largest divisor of T not exceeding the requested chunk, so the
-        # memory bound survives awkward sequence lengths instead of silently
-        # re-materializing the full [B, T, V] logits
-        chunk = next(c for c in range(min(chunk, T), 0, -1) if T % c == 0)
+    chunk = T if chunk <= 0 else min(chunk, T)
+    pad = (-T) % chunk
+    if pad:
+        # pad to a chunk multiple with ignored targets: keeps the memory
+        # bound AND the chunk-sized matmuls for awkward sequence lengths
+        # (a divisor-based fallback would degenerate to tiny chunks)
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)), constant_values=ignore_index)
+        T += pad
     n_chunks = T // chunk
     mask_all = targets != ignore_index
     xs = x.reshape(B, n_chunks, chunk, D).transpose(1, 0, 2, 3)
