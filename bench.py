@@ -23,7 +23,7 @@ NORTH_STAR_MFU = 0.45
 
 
 def _build_presets():
-    from tony_tpu.models import llama
+    from tony_tpu.models import llama, mixtral
 
     # ~0.9B params: fits one 16G v5e chip with Adam + remat at seq 2048.
     # Best measured single-chip recipe: batch 12, remat_policy="flash" (pin
@@ -34,10 +34,19 @@ def _build_presets():
         attn_impl="auto", ce_chunk=1024,
     )
     tiny = dataclasses.replace(llama.LLAMA_TINY, max_seq=128)
+    # ~0.5B-total / ~0.17B-active MoE that fits one chip (all 8 experts
+    # local; EP shards them over the `expert` axis on a slice). MFU is
+    # computed on ACTIVE params — the honest MoE basis.
+    moe_1chip = mixtral.MixtralConfig(
+        vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16, n_kv_heads=8,
+        d_ff=2048, max_seq=2048, num_experts=8, top_k=2,
+        remat=True, remat_policy="flash", ce_chunk=1024,
+    )
     return {
-        "tiny": (tiny, 8, 128),          # (config, batch, seq) — CPU/CI smoke
-        "1chip": (bench_1chip, 12, 2048),  # single v5e
-        "8b": (llama.LLAMA3_8B, 8, 4096),  # needs a slice (FSDP over ICI)
+        "tiny": (llama, tiny, 8, 128),          # (module, config, batch, seq)
+        "1chip": (llama, bench_1chip, 12, 2048),  # single v5e
+        "8b": (llama, llama.LLAMA3_8B, 8, 4096),  # needs a slice (FSDP over ICI)
+        "moe": (mixtral, moe_1chip, 24, 2048),    # Mixtral-style MoE, single v5e
     }
 
 
@@ -52,12 +61,11 @@ def run_bench(
 ) -> dict:
     import jax
 
-    from tony_tpu.models import llama
     from tony_tpu.parallel import MeshSpec
     from tony_tpu.train import OptimizerConfig, Throughput, make_train_step, sharded_init
     from tony_tpu.train.metrics import detect_peak_flops
 
-    cfg, B, T = _build_presets()[preset]
+    model, cfg, B, T = _build_presets()[preset]
     B = batch or B
     T = seq or T
     cfg = dataclasses.replace(cfg, max_seq=T)
@@ -73,12 +81,12 @@ def run_bench(
     mesh = spec.build()
     opt = OptimizerConfig(warmup_steps=10, total_steps=1000).build()
     state = sharded_init(
-        lambda: llama.init(jax.random.PRNGKey(0), cfg), llama.sharding_rules(cfg), mesh, opt
+        lambda: model.init(jax.random.PRNGKey(0), cfg), model.sharding_rules(cfg), mesh, opt
     )
-    step_fn = make_train_step(functools.partial(llama.loss_fn, cfg=cfg, mesh=mesh), opt)
+    step_fn = make_train_step(functools.partial(model.loss_fn, cfg=cfg, mesh=mesh), opt)
 
     key = jax.random.PRNGKey(1)
-    batch_data = llama.synthetic_batch(key, B, T, cfg)
+    batch_data = model.synthetic_batch(key, B, T, cfg)
 
     t_compile = time.perf_counter()
     for _ in range(max(warmup, 2)):  # step 2 hits the donated-buffer recompile
@@ -103,6 +111,7 @@ def run_bench(
     r = meter.report()
     return {
         "preset": preset,
+        "model": model.__name__.rsplit(".", 1)[-1],
         "model_params": cfg.num_params(),
         "batch": B,
         "seq": T,
@@ -116,7 +125,7 @@ def run_bench(
 
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--preset", default=None, choices=["tiny", "1chip", "8b"])
+    p.add_argument("--preset", default=None, choices=["tiny", "1chip", "8b", "moe"])
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--batch", type=int, default=None)
@@ -141,7 +150,7 @@ def main() -> int:
                 args.remat_policy, args.ce_chunk,
             )
             out = {
-                "metric": f"llama_train_mfu_{r['n_chips']}chip_{attempt}",
+                "metric": f"{r['model']}_train_mfu_{r['n_chips']}chip_{attempt}",
                 "value": r["mfu"],
                 "unit": "mfu",
                 "vs_baseline": round(r["mfu"] / NORTH_STAR_MFU, 4),
@@ -152,7 +161,7 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 — fall back to a smaller preset
             last_err = e
             print(f"[bench] preset {attempt} failed: {type(e).__name__}: {e}", file=sys.stderr)
-    print(json.dumps({"metric": "llama_train_mfu", "value": 0.0, "unit": "mfu",
+    print(json.dumps({"metric": f"train_mfu_{preset}", "value": 0.0, "unit": "mfu",
                       "vs_baseline": 0.0, "error": str(last_err)}))
     return 1
 

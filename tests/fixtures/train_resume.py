@@ -1,0 +1,52 @@
+"""Elasticity fixture: train with checkpoints, die, resume after gang restart.
+
+Attempt 0 trains 4 steps (checkpointing every 2) then exits nonzero —
+simulating a mid-run crash. The AM's gang restart relaunches the task with
+TONY_RESTART_ATTEMPT=1; this attempt must find the checkpoint, resume from
+step >= 2 (run_lm_training prints "resumed from checkpoint step N"), and
+finish the full 8 steps. The E2E test asserts on both the verdict and the
+resume line in this task's stdout log.
+"""
+
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tony_tpu.cli.distributed_smoke import sanitize_env_for_cpu_group  # noqa: E402
+
+sanitize_env_for_cpu_group()  # one CPU device: the tiny batch can't shard over 8
+
+from tony_tpu.models import llama  # noqa: E402
+from tony_tpu.train.checkpoint import CheckpointManager  # noqa: E402
+from tony_tpu.train.loop import LoopConfig, run_lm_training  # noqa: E402
+
+attempt = int(os.environ.get("TONY_RESTART_ATTEMPT", "0"))
+ckpt_dir = os.path.join(os.environ["TONY_STAGING_DIR"], "ckpt")
+
+if attempt > 0:
+    mgr = CheckpointManager(ckpt_dir)
+    latest = mgr.latest_step() or 0
+    assert latest >= 2, f"gang restart found no checkpoint to resume from (latest={latest})"
+    print(f"fixture: attempt {attempt} resuming, latest checkpoint step {latest}")
+
+cfg = dataclasses.replace(llama.LLAMA_TINY, max_seq=16)
+loop = LoopConfig(
+    steps=4 if attempt == 0 else 8,
+    batch_size=2,
+    seq_len=16,
+    log_every=100,
+    checkpoint_dir=ckpt_dir,
+    checkpoint_every=2,
+    warmup_steps=0,
+)
+run_lm_training(llama, cfg, loop)
+
+if attempt == 0:
+    print("fixture: attempt 0 crashing after checkpointed steps")
+    sys.exit(1)
+
+final_mgr = CheckpointManager(ckpt_dir)
+assert final_mgr.latest_step() == 8, final_mgr.latest_step()
+print("fixture: resume run completed to step 8")

@@ -146,6 +146,29 @@ class TestFailureDetection:
         assert final == JobStatus.FAILED
         assert handle.final_status()["tasks"][0]["status"] == "LOST"
 
+    def test_gang_restart_resumes_training_from_checkpoint(self, tmp_tony_root):
+        """Reliability spine (SURVEY.md §5.3/§5.4): a training task dies
+        mid-run, the gang restarts, and the relaunched task RESUMES from its
+        checkpoint instead of step 0 — verified by the verdict and the
+        'resumed from checkpoint' line in the task's stdout."""
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {
+                "tony.worker.instances": "1",
+                keys.EXECUTES: fixture_cmd("train_resume.py"),
+                keys.TASK_RESTART_ON_FAILURE: "true",
+            },
+        )
+        assert final == JobStatus.SUCCEEDED, handle.final_status()
+        # the relaunched attempt logs under worker_0_r1 (restart suffix)
+        log = os.path.join(
+            str(tmp_tony_root), handle.app_id, "logs", "worker_0_r1", "stdout.log"
+        )
+        with open(log) as f:
+            out = f.read()
+        assert "resumed from checkpoint step" in out, out
+        assert "resume run completed to step 8" in out, out
+
     def test_gang_restart_from_flaky_task(self, tmp_tony_root):
         # rebuild-only elasticity: whole-gang restart after a tracked failure
         final, _, handle = run_job(

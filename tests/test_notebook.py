@@ -82,8 +82,11 @@ class TestNotebookE2E:
         client = Client(cfg)
         handle = client.submit()
         try:
-            target = wait_for_notebook_url(handle, timeout_s=30)
-            assert target is not None, "notebook URL never registered with the AM"
+            target = wait_for_notebook_url(handle, timeout_s=60)
+            assert target is not None, (
+                f"notebook URL never registered with the AM; "
+                f"final_status={handle.final_status()}"
+            )
             proxy = ProxyServer(target[0], target[1]).start()
             try:
                 body = urllib.request.urlopen(
