@@ -1,0 +1,55 @@
+"""Sample from a (checkpointed or randomly initialized) Llama.
+
+    python examples/llama/generate.py --preset tiny --max_new_tokens 32
+    python examples/llama/generate.py --preset llama-1b \
+        --checkpoint_dir /path/to/ckpt --prompt "1 2 3 4" --temperature 0.7
+"""
+
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu.models import generate, llama
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="tiny")
+    p.add_argument("--checkpoint_dir", default="")
+    p.add_argument("--prompt", default="", help="space-separated token ids")
+    p.add_argument("--max_new_tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top_k", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    cfg = llama.PRESETS[args.preset]
+    params = llama.init(jax.random.PRNGKey(args.seed), cfg)
+    if args.checkpoint_dir:
+        # training checkpoints hold the full TrainState (params/opt/step), so
+        # restore against a matching template and keep only the params
+        from tony_tpu.train.checkpoint import CheckpointManager
+        from tony_tpu.train.trainer import OptimizerConfig, TrainState
+
+        opt = OptimizerConfig(warmup_steps=0, total_steps=1).build()
+        template = TrainState.create(params, opt)
+        mgr = CheckpointManager(args.checkpoint_dir)
+        params = mgr.restore(template).params
+        print(f"[generate] restored checkpoint step {mgr.latest_step()}", file=sys.stderr)
+
+    ids = [int(t) for t in args.prompt.split()] if args.prompt else [0, 1, 2, 3]
+    prompt = jnp.asarray([ids], jnp.int32)
+    out = generate.generate(
+        params, prompt, cfg,
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature, top_k=args.top_k,
+        key=jax.random.PRNGKey(args.seed),
+    )
+    print(" ".join(str(int(t)) for t in out[0]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

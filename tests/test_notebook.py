@@ -4,10 +4,13 @@ Mirrors the reference's NotebookSubmitter/ProxyServer behavior (SURVEY.md
 §2.1, §3.4) with the fixture-server strategy of its test suite.
 """
 
+import http.client
 import os
 import socket
 import sys
 import threading
+import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -89,10 +92,20 @@ class TestNotebookE2E:
             )
             proxy = ProxyServer(target[0], target[1]).start()
             try:
-                body = urllib.request.urlopen(
-                    f"http://127.0.0.1:{proxy.local_port}/", timeout=10
-                ).read()
-                assert body == b"notebook-fixture-ok"
+                # the URL registers at task launch; under suite load the
+                # fixture server may still be binding — poll like a browser
+                # retry would
+                body = None
+                deadline = time.time() + 20
+                while time.time() < deadline:
+                    try:
+                        body = urllib.request.urlopen(
+                            f"http://127.0.0.1:{proxy.local_port}/", timeout=10
+                        ).read()
+                        break
+                    except (urllib.error.URLError, ConnectionError, http.client.HTTPException):
+                        time.sleep(0.5)
+                assert body == b"notebook-fixture-ok", body
             finally:
                 proxy.stop()
         finally:

@@ -1,0 +1,97 @@
+"""int8 weight-only quantization: error bounds + kernel/reference parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tony_tpu.ops import quant
+
+
+class TestQuantizeInt8:
+    def test_roundtrip_error_bound(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (128, 64), jnp.float32)
+        qt = quant.quantize_int8(w)
+        deq = np.asarray(quant.dequantize(qt, jnp.float32))
+        # absmax int8: per-channel max error <= scale/2 ~ absmax/254
+        err = np.abs(deq - np.asarray(w))
+        bound = np.asarray(qt.scale) * 0.5 + 1e-7
+        assert (err <= bound[None, :]).all()
+
+    def test_scale_per_output_channel(self):
+        w = jnp.stack([jnp.ones(16), 100 * jnp.ones(16)], axis=1)  # [16, 2]
+        qt = quant.quantize_int8(w)
+        assert qt.scale.shape == (2,)
+        assert float(qt.scale[1]) > float(qt.scale[0]) * 50
+
+    def test_int8_values_in_range(self):
+        w = jax.random.normal(jax.random.PRNGKey(1), (64, 32)) * 10
+        qt = quant.quantize_int8(w)
+        assert qt.q.dtype == jnp.int8
+        assert int(jnp.max(jnp.abs(qt.q.astype(jnp.int32)))) <= 127
+
+
+class TestInt8Matmul:
+    def test_reference_close_to_float(self):
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 128), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(3), (128, 64), jnp.float32) * 0.1
+        qt = quant.quantize_int8(w)
+        got = np.asarray(quant.int8_matmul_ref(x, qt))
+        want = np.asarray(x @ w)
+        # int8 quant error accumulates over K=128; ~1% relative is expected
+        np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+
+    def test_kernel_matches_reference(self):
+        # interpreter mode on CPU (conftest sets TONY_PALLAS_INTERPRET=1);
+        # the kernel streams x through bf16 so tolerance covers bf16 rounding
+        # accumulated over K=512 (outputs are O(sqrt(K)) ≈ 22)
+        x = jax.random.normal(jax.random.PRNGKey(4), (256, 512), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(5), (512, 256), jnp.float32)
+        qt = quant.quantize_int8(w)
+        got = np.asarray(quant.int8_matmul(x, qt))
+        want = np.asarray(quant.int8_matmul_ref(x, qt))
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=0.3)
+
+    def test_kernel_fallback_on_awkward_shapes(self):
+        # M=300 > block_m=256 and not divisible → XLA reference path (exact)
+        x = jax.random.normal(jax.random.PRNGKey(6), (300, 512), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(7), (512, 256), jnp.float32)
+        qt = quant.quantize_int8(w)
+        got = np.asarray(quant.int8_matmul(x, qt))
+        want = np.asarray(quant.int8_matmul_ref(x, qt))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_batched_leading_dims(self):
+        x = jax.random.normal(jax.random.PRNGKey(8), (2, 4, 64), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(9), (64, 32), jnp.float32)
+        qt = quant.quantize_int8(w)
+        out = quant.int8_matmul(x, qt)
+        assert out.shape == (2, 4, 32)
+
+
+class TestQuantizeTree:
+    def test_llama_params_shrink_near_half(self):
+        import dataclasses
+
+        from tony_tpu.models import llama
+
+        cfg = dataclasses.replace(
+            llama.LLAMA_TINY, d_model=128, d_ff=256, vocab_size=512
+        )
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        qtree, before, after = quant.quantize_tree(params, min_size=1 << 12)
+        assert after < before * 0.65  # big mats bf16 → int8 (~half), norms stay
+        # stacked-layer 3-D leaves quantize per layer; norms stay float
+        assert isinstance(qtree["layers"]["wq"], quant.QTensor)
+        assert isinstance(qtree["lm_head"], quant.QTensor)
+        assert not isinstance(qtree["layers"]["attn_norm"], quant.QTensor)
+        # per-layer scales: [L, N]
+        assert qtree["layers"]["wq"].scale.ndim == 2
+
+    def test_stacked_dequant_roundtrip(self):
+        w = jax.random.normal(jax.random.PRNGKey(10), (3, 32, 16), jnp.float32)
+        qt = quant.quantize_int8(w)
+        assert qt.scale.shape == (3, 16)
+        deq = np.asarray(quant.dequantize(qt, jnp.float32))
+        err = np.abs(deq - np.asarray(w))
+        bound = np.asarray(qt.scale)[:, None, :] * 0.5 + 1e-7
+        assert (err <= bound).all()

@@ -22,9 +22,12 @@ class ProxyServer:
     """
 
     def __init__(self, remote_host: str, remote_port: int, local_port: int = 0,
-                 bind_host: str = "127.0.0.1"):
+                 bind_host: str = "127.0.0.1", connect_retries: int = 5,
+                 connect_retry_delay_s: float = 0.5):
         self.remote_host = remote_host
         self.remote_port = remote_port
+        self.connect_retries = connect_retries
+        self.connect_retry_delay_s = connect_retry_delay_s
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((bind_host, local_port))
@@ -45,16 +48,41 @@ class ProxyServer:
                 client, _ = self._listener.accept()
             except OSError:
                 return  # listener closed by stop()
+            with self._lock:
+                self._conns.add(client)
+            # upstream dial happens inside the per-connection thread: a slow
+            # or still-starting upstream must not head-of-line block accept()
+            threading.Thread(target=self._dial_and_relay, args=(client,), daemon=True).start()
+
+    def _dial_and_relay(self, client: socket.socket) -> None:
+        upstream = self._connect_upstream()
+        if upstream is None:
+            with self._lock:
+                self._conns.discard(client)
+            client.close()
+            return
+        with self._lock:
+            self._conns.add(upstream)
+        self._relay(client, upstream)
+
+    def _connect_upstream(self) -> socket.socket | None:
+        """Dial the remote with brief retries: the task registers its URL as
+        soon as it launches, which can beat the server process to bind()
+        (Jupyter startup takes seconds) — a first connection must not fail
+        on that race."""
+        import time
+
+        for i in range(max(self.connect_retries, 1)):
+            if self._stop.is_set():
+                return None
             try:
-                upstream = socket.create_connection(
+                return socket.create_connection(
                     (self.remote_host, self.remote_port), timeout=10
                 )
             except OSError:
-                client.close()
-                continue
-            with self._lock:
-                self._conns.update((client, upstream))
-            threading.Thread(target=self._relay, args=(client, upstream), daemon=True).start()
+                if i + 1 < max(self.connect_retries, 1):
+                    time.sleep(self.connect_retry_delay_s)
+        return None
 
     def _relay(self, client: socket.socket, upstream: socket.socket) -> None:
         """Pump both directions; close and forget both sockets when done

@@ -1,0 +1,195 @@
+"""Autoregressive generation with a KV cache for the Llama family.
+
+The reference orchestrates training jobs only — serving/eval is new
+capability (SURVEY.md §2.5 "absent" rows). TPU-first shape discipline:
+the cache is a static [L, B, Hkv, max_len, Dh] ring of bf16 K/V, decode
+steps are one jitted token step with `lax.scan` over positions (no Python
+loop, no dynamic shapes), and attention against the cache is masked
+full-length so XLA compiles one kernel for every step.
+
+Numerical parity with training: reuses the same rms_norm/rope/swiglu ops
+and the params pytree from models/llama.py — `tests/test_generate.py`
+asserts greedy decode reproduces teacher-forced forward argmaxes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu.models.llama import LlamaConfig
+from tony_tpu.ops import layers as L
+from tony_tpu.ops import quant as Q
+
+
+def _mm(x, w):
+    """x @ w where w may be an int8 QTensor (weight-only quantized serving:
+    quant.quantize_tree(params) then pass the tree here unchanged)."""
+    if isinstance(w, Q.QTensor):
+        return Q.int8_matmul(x, w).astype(x.dtype)
+    return jnp.einsum("...d,dh->...h", x, w)
+
+
+def _embed_lookup(embed, tokens, dtype):
+    if isinstance(embed, Q.QTensor):
+        rows = jnp.take(embed.q, tokens, axis=0).astype(jnp.float32)
+        return (rows * embed.scale).astype(dtype)
+    return jnp.take(embed, tokens, axis=0)
+
+
+class KVCache(NamedTuple):
+    """Static-shape decode state. k/v: [L, B, Hkv, max_len, Dh]."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # [] int32 — tokens already in the cache
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> KVCache:
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.jdtype),
+        v=jnp.zeros(shape, cfg.jdtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def _cached_attention(q, ck, cv, length, n_rep):
+    """q: [B, H, Tq, Dh]; ck/cv: [B, Hkv, maxT, Dh]; positions < length+Tq.
+
+    Masked full-length attention: rows attend to cache slots [0, length+row]
+    (causal within the new tokens, everything before them unconditionally).
+    """
+    from tony_tpu.ops.attention import repeat_kv
+
+    B, H, Tq, Dh = q.shape
+    maxT = ck.shape[2]
+    ck = repeat_kv(ck, n_rep)
+    cv = repeat_kv(cv, n_rep)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, ck, preferred_element_type=jnp.float32)
+    s = s * (Dh ** -0.5)
+    slot = jax.lax.broadcasted_iota(jnp.int32, (Tq, maxT), 1)
+    row_end = length + jax.lax.broadcasted_iota(jnp.int32, (Tq, maxT), 0)
+    s = jnp.where(slot <= row_end, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(cv.dtype), cv)
+
+
+def _block_with_cache(x, lp, ck, cv, length, cos, sin, cfg: LlamaConfig):
+    """One decoder block over Tq new tokens at positions [length, length+Tq).
+
+    Returns (x, new_k, new_v) where new_k/v are this step's K/V slabs
+    [B, Hkv, Tq, Dh] for the caller to write into the cache.
+    """
+    B, Tq = x.shape[0], x.shape[1]
+    Dh, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    positions = length + jnp.arange(Tq)
+
+    h = L.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = _mm(h, lp["wq"]).reshape(B, Tq, H, Dh).transpose(0, 2, 1, 3)
+    k = _mm(h, lp["wk"]).reshape(B, Tq, Hkv, Dh).transpose(0, 2, 1, 3)
+    v = _mm(h, lp["wv"]).reshape(B, Tq, Hkv, Dh).transpose(0, 2, 1, 3)
+    q = L.apply_rope(q, cos, sin, positions=positions)
+    k = L.apply_rope(k, cos, sin, positions=positions)
+
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, length, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, length, 0))
+    o = _cached_attention(q, ck, cv, length, H // Hkv)
+    o = o.transpose(0, 2, 1, 3).reshape(B, Tq, H * Dh)
+    x = x + _mm(o, lp["wo"])
+    h = L.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    g = jax.nn.silu(_mm(h, lp["w_gate"]))
+    u = _mm(h, lp["w_up"])
+    x = x + _mm(g * u, lp["w_down"])
+    return x, k, v
+
+
+def _forward_with_cache(params, tokens, cache: KVCache, cfg: LlamaConfig):
+    """tokens [B, Tq] (new tokens only) → (logits [B, Tq, V], cache')."""
+    maxT = cache.k.shape[3]
+    cos, sin = L.rope_frequencies(cfg.head_dim, maxT, cfg.rope_theta)
+    x = _embed_lookup(params["embed"], tokens, cfg.jdtype)
+
+    def layer(x, inputs):
+        lp, ck, cv = inputs
+        x, new_k, new_v = _block_with_cache(x, lp, ck, cv, cache.length, cos, sin, cfg)
+        return x, (new_k, new_v)
+
+    x, (new_ks, new_vs) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
+    Tq = tokens.shape[1]
+    k = jax.lax.dynamic_update_slice(cache.k, new_ks, (0, 0, 0, cache.length, 0))
+    v = jax.lax.dynamic_update_slice(cache.v, new_vs, (0, 0, 0, cache.length, 0))
+    x = L.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
+    return logits, KVCache(k, v, cache.length + Tq)
+
+
+def prefill(params, tokens, cache: KVCache, cfg: LlamaConfig):
+    """Run the prompt through the model, filling the cache.
+
+    Returns (last-position logits [B, V], cache')."""
+    logits, cache = _forward_with_cache(params, tokens, cache, cfg)
+    return logits[:, -1], cache
+
+
+# module-level jits: generate() is called per serving request, so the traced
+# functions must be cached across calls (keys/prompt/cache are arguments,
+# never closure constants — a closure would retrace every request)
+_prefill_jit = jax.jit(prefill, static_argnames=("cfg",))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "temperature", "top_k"))
+def _decode_all(params, cache, first, keys, cfg, temperature, top_k):
+    def step(carry, k_step):
+        cache, tok = carry
+        logits, cache = _forward_with_cache(params, tok[:, None], cache, cfg)
+        nxt = _sample(logits[:, -1], k_step, temperature, top_k)
+        return (cache, nxt), nxt
+
+    (_, _), rest = jax.lax.scan(step, (cache, first), keys)
+    return rest
+
+
+def _sample(logits, key, temperature: float, top_k: int):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(
+    params,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    key: jax.Array | None = None,
+    max_len: int | None = None,
+) -> jax.Array:
+    """prompt [B, Tp] int32 → generated tokens [B, max_new_tokens].
+
+    Greedy when temperature == 0, else top-k/temperature sampling. One jit
+    for prefill, one for the scanned decode loop.
+    """
+    B, Tp = prompt.shape
+    max_len = max_len or (Tp + max_new_tokens)
+    assert max_len >= Tp + max_new_tokens, "cache too small for requested tokens"
+    key = key if key is not None else jax.random.PRNGKey(0)
+    keys = jax.random.split(key, max_new_tokens)
+
+    cache = init_cache(cfg, B, max_len)
+    logits, cache = _prefill_jit(params, prompt, cache, cfg)
+    first = _sample(logits, keys[0], temperature, top_k)
+
+    if max_new_tokens == 1:
+        return first[:, None]
+    rest = _decode_all(params, cache, first, keys[1:], cfg, temperature, top_k)  # [N-1, B]
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
