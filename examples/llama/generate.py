@@ -26,18 +26,22 @@ def main() -> int:
     args = p.parse_args()
 
     cfg = llama.PRESETS[args.preset]
-    params = llama.init(jax.random.PRNGKey(args.seed), cfg)
     if args.checkpoint_dir:
-        # training checkpoints hold the full TrainState (params/opt/step), so
-        # restore against a matching template and keep only the params
+        # training checkpoints hold the full TrainState (params/opt/step):
+        # restore against an ABSTRACT template (eval_shape — no multi-GB
+        # random init just to throw it away) and keep only the params
         from tony_tpu.train.checkpoint import CheckpointManager
         from tony_tpu.train.trainer import OptimizerConfig, TrainState
 
         opt = OptimizerConfig(warmup_steps=0, total_steps=1).build()
-        template = TrainState.create(params, opt)
+        template = jax.eval_shape(
+            lambda: TrainState.create(llama.init(jax.random.PRNGKey(0), cfg), opt)
+        )
         mgr = CheckpointManager(args.checkpoint_dir)
         params = mgr.restore(template).params
         print(f"[generate] restored checkpoint step {mgr.latest_step()}", file=sys.stderr)
+    else:
+        params = llama.init(jax.random.PRNGKey(args.seed), cfg)
 
     ids = [int(t) for t in args.prompt.split()] if args.prompt else [0, 1, 2, 3]
     prompt = jnp.asarray([ids], jnp.int32)

@@ -1,0 +1,32 @@
+"""Fixture: real torch.distributed all-reduce from the TorchRuntime env.
+
+The PyTorchRuntime-analog parity proof (SURVEY.md §2.2): workers read only
+the injected MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE contract — exactly what
+a user's DDP script reads — form a gloo process group, and all-reduce their
+ranks. CPU-only (gloo); on TPU hosts the same env drives torch-xla.
+"""
+
+import datetime
+import os
+import sys
+
+import torch
+import torch.distributed as dist
+
+rank = int(os.environ["RANK"])
+world = int(os.environ["WORLD_SIZE"])
+
+dist.init_process_group(
+    "gloo",
+    init_method=os.environ["INIT_METHOD"],
+    rank=rank,
+    world_size=world,
+    timeout=datetime.timedelta(seconds=60),
+)
+t = torch.tensor([float(rank + 1)])
+dist.all_reduce(t, op=dist.ReduceOp.SUM)
+want = world * (world + 1) / 2
+assert float(t) == want, (float(t), want)
+dist.destroy_process_group()
+print(f"torch_allreduce ok: rank {rank}/{world}, sum={float(t)}")
+sys.exit(0)

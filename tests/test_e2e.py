@@ -131,6 +131,25 @@ class TestDistributedDataPlane:
 
 
 @pytest.mark.e2e
+class TestTorchRuntimeDataPlane:
+    def test_gang_forms_torch_process_group_and_reduces(self, tmp_tony_root):
+        """TorchRuntime parity proof: workers read only the injected DDP env
+        (MASTER_ADDR/PORT, RANK, WORLD_SIZE, INIT_METHOD), form a real gloo
+        process group, and all-reduce across the gang."""
+        pytest.importorskip("torch")
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {
+                "tony.worker.instances": "2",
+                keys.EXECUTES: fixture_cmd("torch_allreduce.py"),
+                keys.APPLICATION_FRAMEWORK: "pytorch",
+                keys.AM_GANG_TIMEOUT_MS: "60000",
+            },
+        )
+        assert final == JobStatus.SUCCEEDED, handle.final_status()
+
+
+@pytest.mark.e2e
 class TestFailureDetection:
     def test_heartbeat_loss_marks_task_lost(self, tmp_tony_root, monkeypatch):
         # fault injection: executor suppresses heartbeats → AM must declare LOST

@@ -87,6 +87,17 @@ class TestQuantizeTree:
         # per-layer scales: [L, N]
         assert qtree["layers"]["wq"].scale.ndim == 2
 
+    def test_stacked_norm_weights_never_quantize(self):
+        # 8B-scale norm shape [L, D] is 2-D and large but K=L is tiny — it
+        # must stay float or the layer scan and rms_norm break
+        tree = {
+            "norm": jnp.ones((32, 4096), jnp.bfloat16),      # stacked norms
+            "w": jnp.ones((32, 4096, 4096), jnp.bfloat16),   # stacked matmuls
+        }
+        qtree, _, _ = quant.quantize_tree(tree, min_size=1 << 10)
+        assert not isinstance(qtree["norm"], quant.QTensor)
+        assert isinstance(qtree["w"], quant.QTensor)
+
     def test_stacked_dequant_roundtrip(self):
         w = jax.random.normal(jax.random.PRNGKey(10), (3, 32, 16), jnp.float32)
         qt = quant.quantize_int8(w)

@@ -121,3 +121,39 @@ class TestSchedulerDagStress:
             for c in containers:
                 rm.release(c)
             assert rm.grid.free == grid_free
+
+
+class TestSessionScale:
+    def test_thousand_task_gang_barrier_and_verdict(self):
+        """The AM event loop's data structures at reference scale (SURVEY.md
+        §3.1: 'responsive at O(1000) containers'): registration, the gang
+        barrier flipping exactly at the last arrival, heartbeats, and the
+        verdict reduction must all stay correct (and fast) at 1000 tasks."""
+        import time as _time
+
+        conf = {"tony.worker.instances": "900", "tony.ps.instances": "100"}
+        cfg = TonyConfig(conf)
+        session = Session(cfg)
+        assert session.total_tasks() == 1000
+
+        t0 = _time.monotonic()
+        order = [(t, i) for t in ("worker", "ps")
+                 for i in range(cfg.instances(t))]
+        rng = random.Random(42)
+        rng.shuffle(order)
+        for n, (t, i) in enumerate(order):
+            assert not session.cluster_spec_complete()
+            session.register_worker_spec(t, i, "h", 2000 + n)
+        assert session.cluster_spec_complete()
+        spec = session.cluster_spec()
+        assert len(spec["worker"]) == 900 and len(spec["ps"]) == 100
+
+        for t, i in order:
+            session.on_heartbeat(t, i)
+        assert not session.find_dead_tasks(heartbeat_interval_ms=10_000, max_missed=3)
+
+        for i in range(900):
+            session.on_task_completed("worker", i, 0)
+        assert session.tracked_all_terminal()  # ps is untracked by default
+        elapsed = _time.monotonic() - t0
+        assert elapsed < 10, f"1000-task lifecycle took {elapsed:.1f}s"

@@ -59,6 +59,12 @@ def _cmd_notebook(argv: list[str]) -> int:
     return notebook_main(argv)
 
 
+def _cmd_data_prep(argv: list[str]) -> int:
+    from tony_tpu.data.prepare import main as prep_main
+
+    return prep_main(argv)
+
+
 def _cmd_mini(argv: list[str]) -> int:
     """Self-contained sandbox: submit a smoke gang against the local resource
     manager and print the verdict + history location.
@@ -116,18 +122,20 @@ _COMMANDS = {
     "portal": _cmd_portal,
     "notebook": _cmd_notebook,
     "mini": _cmd_mini,
+    "data-prep": _cmd_data_prep,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|history|portal|notebook|mini} [options]\n")
-        print("  submit    submit and monitor a job (tony submit --help)")
-        print("  history   list finished jobs / dump one job's events")
-        print("  portal    serve the history web portal")
-        print("  notebook  launch an interactive notebook container + local proxy")
-        print("  mini      one-command local sandbox (smoke gang, optional --distributed)")
+        print("usage: tony {submit|history|portal|notebook|mini|data-prep} [options]\n")
+        print("  submit     submit and monitor a job (tony submit --help)")
+        print("  history    list finished jobs / dump one job's events")
+        print("  portal     serve the history web portal")
+        print("  notebook   launch an interactive notebook container + local proxy")
+        print("  mini       one-command local sandbox (smoke gang, optional --distributed)")
+        print("  data-prep  tokenize text files into TONYTOK training shards")
         return 0
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:

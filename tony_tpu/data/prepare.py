@@ -1,0 +1,97 @@
+"""Corpus preparation: text files → TONYTOK token shards.
+
+Completes the data plane (dataset.py writes/reads shards; native.py streams
+them into training): one command takes raw text to the shard format the
+C++ loader mmaps. Tokenizers:
+
+- ``bytes`` (default): UTF-8 byte-level, vocab 256 + optional EOD marker —
+  dependency-free, works offline, exactly reversible.
+- ``hf:<path>``: a local HuggingFace tokenizer directory, loaded with
+  ``local_files_only`` (no network fetch is attempted). Requires the
+  optional ``transformers`` package; a clear error tells the user if it
+  is absent.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from tony_tpu.data.dataset import TokenShardWriter
+
+EOD = 0  # byte-level end-of-document marker (NUL never appears in text)
+
+
+def _encode_bytes(text: str) -> np.ndarray:
+    return np.frombuffer(text.encode("utf-8"), dtype=np.uint8).astype(np.uint16)
+
+
+def _load_hf_tokenizer(path: str):
+    try:
+        from transformers import AutoTokenizer
+    except ImportError as e:
+        raise RuntimeError(
+            "tokenizer 'hf:<path>' needs the optional `transformers` package "
+            "(pip install transformers), or use the built-in 'bytes' tokenizer"
+        ) from e
+
+    return AutoTokenizer.from_pretrained(path, local_files_only=True)
+
+
+def prepare_corpus(
+    inputs: list[str | Path],
+    out_dir: str | Path,
+    *,
+    tokenizer: str = "bytes",
+    shard_tokens: int = 1 << 24,
+    append_eod: bool = True,
+) -> dict:
+    """Tokenize text files into shards; returns a manifest dict."""
+    hf = _load_hf_tokenizer(tokenizer[3:]) if tokenizer.startswith("hf:") else None
+    writer = TokenShardWriter(out_dir, shard_tokens=shard_tokens)
+    n_docs = total = 0
+    for p in inputs:
+        text = Path(p).read_text(encoding="utf-8", errors="replace")
+        if hf is not None:
+            tokens = np.asarray(hf.encode(text), dtype=np.int32)
+        else:
+            tokens = _encode_bytes(text)
+        if append_eod:
+            eod = hf.eos_token_id if hf is not None and hf.eos_token_id is not None else EOD
+            tokens = np.concatenate([tokens, np.asarray([eod], tokens.dtype)])
+        writer.append(tokens)
+        n_docs += 1
+        total += int(tokens.size)
+    shards = writer.close()
+    return {
+        "shards": [str(s) for s in shards],
+        "n_docs": n_docs,
+        "total_tokens": total,
+        "vocab_size": (len(hf) if hf is not None else 256),
+        "tokenizer": tokenizer,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(
+        prog="tony data-prep", description="tokenize text files into TONYTOK shards"
+    )
+    p.add_argument("inputs", nargs="+", help="text files")
+    p.add_argument("--out", required=True, help="output shard directory")
+    p.add_argument("--tokenizer", default="bytes", help="'bytes' or 'hf:<local dir>'")
+    p.add_argument("--shard_tokens", type=int, default=1 << 24)
+    args = p.parse_args(argv if argv is not None else sys.argv[1:])
+    manifest = prepare_corpus(
+        args.inputs, args.out, tokenizer=args.tokenizer, shard_tokens=args.shard_tokens
+    )
+    print(json.dumps(manifest))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,7 +94,11 @@ def int8_matmul(
     xm = x.reshape(-1, K)
     M = xm.shape[0]
     bm, bn, bk = min(block_m, M), min(block_n, N), min(block_k, K)
-    if M % bm or N % bn or K % bk:
+    # TPU minimum-tile alignment (8 sublanes × 128 lanes for f32 blocks) in
+    # addition to even tiling — sub-tile blocks would fail Mosaic lowering
+    # on hardware even though the interpreter accepts them (batch-1 decode,
+    # tiny K, etc. route to XLA, which handles small shapes fine).
+    if (M % bm or N % bn or K % bk or bm % 8 or bk % 128 or bn % 128):
         return int8_matmul_ref(x, qt)
     n_k = K // bk
 
@@ -134,7 +138,14 @@ def quantize_tree(params, min_size: int = 1 << 16):
         nonlocal before, after
         sz = leaf.size * leaf.dtype.itemsize
         before += sz
-        if leaf.ndim >= 2 and leaf.size >= min_size and jnp.issubdtype(leaf.dtype, jnp.floating):
+        # both trailing dims must look like a matmul [K, N] (>= 64 each):
+        # stacked norm weights ([L, D]) are 2-D and large at real model
+        # scale but have a tiny K — quantizing them would both break the
+        # layer scan (mismatched leading dims) and be numerically wrong
+        is_matmul_like = (
+            leaf.ndim >= 2 and leaf.shape[-1] >= 64 and leaf.shape[-2] >= 64
+        )
+        if is_matmul_like and leaf.size >= min_size and jnp.issubdtype(leaf.dtype, jnp.floating):
             qt = quantize_int8(leaf)
             after += qt.q.size + qt.scale.size * 4
             return qt
