@@ -16,6 +16,7 @@ import argparse
 import dataclasses
 import functools
 import json
+import os
 import sys
 import time
 
@@ -42,11 +43,15 @@ def _build_presets():
         d_ff=2048, max_seq=2048, num_experts=8, top_k=2,
         remat=True, remat_policy="flash", ce_chunk=1024,
     )
+    from tony_tpu.models import bert
+
+    bert_base = dataclasses.replace(bert.BERT_BASE, remat=True, attn_impl="auto")
     return {
         "tiny": (llama, tiny, 8, 128),          # (module, config, batch, seq)
         "1chip": (llama, bench_1chip, 12, 2048),  # single v5e
         "8b": (llama, llama.LLAMA3_8B, 8, 4096),  # needs a slice (FSDP over ICI)
         "moe": (mixtral, moe_1chip, 24, 2048),    # Mixtral-style MoE, single v5e
+        "bert": (bert, bert_base, 128, 512),      # BASELINE config #2, single v5e
     }
 
 
@@ -69,17 +74,21 @@ def run_bench(
     B = batch or B
     T = seq or T
     cfg = dataclasses.replace(cfg, max_seq=T)
+    fields = {f.name for f in dataclasses.fields(cfg)}
     if remat_policy is not None:
-        cfg = dataclasses.replace(
-            cfg, remat=remat_policy != "none", remat_policy=remat_policy
-        )
-    if ce_chunk is not None:
+        override = {"remat": remat_policy != "none"}
+        if "remat_policy" in fields:
+            override["remat_policy"] = remat_policy
+        cfg = dataclasses.replace(cfg, **override)
+    if ce_chunk is not None and "ce_chunk" in fields:
         cfg = dataclasses.replace(cfg, ce_chunk=ce_chunk)
 
     n_dev = len(jax.devices())
     spec = MeshSpec.auto(n_dev)  # fsdp over all chips
     mesh = spec.build()
-    opt = OptimizerConfig(warmup_steps=10, total_steps=1000).build()
+    opt = OptimizerConfig(
+        warmup_steps=10, total_steps=1000, mu_dtype=os.environ.get("TONY_BENCH_MU_DTYPE", "")
+    ).build()
     state = sharded_init(
         lambda: model.init(jax.random.PRNGKey(0), cfg), model.sharding_rules(cfg), mesh, opt
     )
@@ -125,7 +134,7 @@ def run_bench(
 
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--preset", default=None, choices=["tiny", "1chip", "8b", "moe"])
+    p.add_argument("--preset", default=None, choices=["tiny", "1chip", "8b", "moe", "bert"])
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--batch", type=int, default=None)

@@ -91,11 +91,13 @@ class TestQuantizeTree:
         # 8B-scale norm shape [L, D] is 2-D and large but K=L is tiny — it
         # must stay float or the layer scan and rms_norm break
         tree = {
-            "norm": jnp.ones((32, 4096), jnp.bfloat16),      # stacked norms
-            "w": jnp.ones((32, 4096, 4096), jnp.bfloat16),   # stacked matmuls
+            "attn_norm": jnp.ones((32, 4096), jnp.bfloat16),   # stacked norms
+            "mlp_norm": jnp.ones((80, 8192), jnp.bfloat16),    # 70B-scale: L >= 64
+            "w": jnp.ones((32, 4096, 4096), jnp.bfloat16),     # stacked matmuls
         }
         qtree, _, _ = quant.quantize_tree(tree, min_size=1 << 10)
-        assert not isinstance(qtree["norm"], quant.QTensor)
+        assert not isinstance(qtree["attn_norm"], quant.QTensor)
+        assert not isinstance(qtree["mlp_norm"], quant.QTensor)
         assert isinstance(qtree["w"], quant.QTensor)
 
     def test_stacked_dequant_roundtrip(self):

@@ -117,3 +117,24 @@ class TestLoopWithData:
         )
         assert np.isfinite(out["loss"])
         assert out["step"] == 3
+
+
+class TestOptimizerMemory:
+    def test_mu_dtype_bf16_halves_first_moment(self):
+        import jax.numpy as jnp
+
+        from tony_tpu.models import mlp
+        from tony_tpu.train import OptimizerConfig, TrainState, make_train_step
+
+        params = mlp.init(jax.random.PRNGKey(0), mlp.MLPConfig())
+        opt = OptimizerConfig(warmup_steps=0, total_steps=5, mu_dtype="bfloat16").build()
+        state = TrainState.create(params, opt)
+        mus = [l for l in jax.tree.leaves(state.opt_state)
+               if hasattr(l, "dtype") and l.dtype == jnp.bfloat16]
+        assert mus, "no bf16 first-moment leaves found"
+        step = make_train_step(
+            lambda p, b: mlp.loss_fn(p, b, mlp.MLPConfig()), opt
+        )
+        batch = mlp.synthetic_batch(jax.random.PRNGKey(1), 4, mlp.MLPConfig())
+        state, metrics = step(state, batch)
+        assert bool(jnp.isfinite(metrics["loss"]))

@@ -4,8 +4,10 @@ Completes the data plane (dataset.py writes/reads shards; native.py streams
 them into training): one command takes raw text to the shard format the
 C++ loader mmaps. Tokenizers:
 
-- ``bytes`` (default): UTF-8 byte-level, vocab 256 + optional EOD marker —
-  dependency-free, works offline, exactly reversible.
+- ``bytes`` (default): UTF-8 byte-level, vocab 256, streamed in fixed-size
+  chunks (flat memory for arbitrarily large files) — dependency-free and
+  works offline. NUL bytes are stripped so token 0 is unambiguously the
+  end-of-document marker; all other bytes round-trip exactly.
 - ``hf:<path>``: a local HuggingFace tokenizer directory, loaded with
   ``local_files_only`` (no network fetch is attempted). Requires the
   optional ``transformers`` package; a clear error tells the user if it
@@ -21,11 +23,13 @@ import numpy as np
 
 from tony_tpu.data.dataset import TokenShardWriter
 
-EOD = 0  # byte-level end-of-document marker (NUL never appears in text)
+EOD = 0  # byte-level end-of-document marker (NUL bytes are stripped on encode)
+_CHUNK_BYTES = 1 << 20
 
 
-def _encode_bytes(text: str) -> np.ndarray:
-    return np.frombuffer(text.encode("utf-8"), dtype=np.uint8).astype(np.uint16)
+def _encode_bytes(data: bytes) -> np.ndarray:
+    tokens = np.frombuffer(data, dtype=np.uint8)
+    return tokens[tokens != EOD].astype(np.uint16)  # keep token 0 = EOD only
 
 
 def _load_hf_tokenizer(path: str):
@@ -53,17 +57,26 @@ def prepare_corpus(
     writer = TokenShardWriter(out_dir, shard_tokens=shard_tokens)
     n_docs = total = 0
     for p in inputs:
-        text = Path(p).read_text(encoding="utf-8", errors="replace")
         if hf is not None:
+            # HF tokenizers need document context; per-file memory here
+            text = Path(p).read_text(encoding="utf-8", errors="replace")
             tokens = np.asarray(hf.encode(text), dtype=np.int32)
+            writer.append(tokens)
+            total += int(tokens.size)
+            eod_dtype = tokens.dtype
         else:
-            tokens = _encode_bytes(text)
+            # byte-level is position-independent → stream in flat memory
+            eod_dtype = np.uint16
+            with open(p, "rb") as f:
+                while chunk := f.read(_CHUNK_BYTES):
+                    tokens = _encode_bytes(chunk)
+                    writer.append(tokens)
+                    total += int(tokens.size)
         if append_eod:
             eod = hf.eos_token_id if hf is not None and hf.eos_token_id is not None else EOD
-            tokens = np.concatenate([tokens, np.asarray([eod], tokens.dtype)])
-        writer.append(tokens)
+            writer.append(np.asarray([eod], eod_dtype))
+            total += 1
         n_docs += 1
-        total += int(tokens.size)
     shards = writer.close()
     return {
         "shards": [str(s) for s in shards],

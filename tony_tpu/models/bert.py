@@ -48,6 +48,12 @@ class BertConfig:
         per_layer = 4 * D * D + 4 * D + 2 * D * F + D + F + 4 * D
         return (V + self.max_seq + self.type_vocab) * D + 2 * D + self.n_layers * per_layer + D * V + V
 
+    def flops_per_token(self) -> int:
+        """Training FLOPs/token (PaLM convention, as train/metrics.py);
+        the attention term is NOT halved — bidirectional, no causal mask."""
+        attn = 12 * self.n_layers * self.d_model * self.max_seq
+        return 6 * self.num_params() + attn
+
 
 BERT_BASE = BertConfig()
 BERT_TINY = BertConfig(

@@ -133,24 +133,33 @@ def quantize_tree(params, min_size: int = 1 << 16):
 
     Returns (tree-with-QTensor-leaves, bytes_before, bytes_after)."""
     before = after = 0
+    _SKIP_NAMES = ("norm", "bias", "scale", "embed_ln")
 
-    def visit(leaf):
+    def visit(path, leaf):
         nonlocal before, after
         sz = leaf.size * leaf.dtype.itemsize
         before += sz
-        # both trailing dims must look like a matmul [K, N] (>= 64 each):
-        # stacked norm weights ([L, D]) are 2-D and large at real model
-        # scale but have a tiny K — quantizing them would both break the
-        # layer scan (mismatched leading dims) and be numerically wrong
+        # two guards against quantizing non-matmul weights:
+        # 1. name-based: norm/bias stacks are [L, D] — 2-D and large at real
+        #    model scale, but quantizing them breaks the layer scan
+        #    (mismatched leading dims) and is numerically wrong;
+        # 2. shape-based: both trailing dims must look like matmul [K, N].
+        keystr = "/".join(str(getattr(k, "key", k)) for k in path).lower()
+        named_skip = any(s in keystr for s in _SKIP_NAMES)
         is_matmul_like = (
             leaf.ndim >= 2 and leaf.shape[-1] >= 64 and leaf.shape[-2] >= 64
         )
-        if is_matmul_like and leaf.size >= min_size and jnp.issubdtype(leaf.dtype, jnp.floating):
+        if (
+            not named_skip
+            and is_matmul_like
+            and leaf.size >= min_size
+            and jnp.issubdtype(leaf.dtype, jnp.floating)
+        ):
             qt = quantize_int8(leaf)
             after += qt.q.size + qt.scale.size * 4
             return qt
         after += sz
         return leaf
 
-    tree = jax.tree.map(visit, params)
+    tree = jax.tree_util.tree_map_with_path(visit, params)
     return tree, before, after

@@ -41,6 +41,9 @@ class OptimizerConfig:
     grad_clip: float = 1.0
     b1: float = 0.9
     b2: float = 0.95
+    # dtype of Adam's first moment. bf16 halves its HBM (the variance stays
+    # f32 — it is the numerically sensitive one); "" keeps the param dtype.
+    mu_dtype: str = ""
 
     def build(self) -> optax.GradientTransformation:
         schedule = optax.warmup_cosine_decay_schedule(
@@ -48,7 +51,10 @@ class OptimizerConfig:
         )
         return optax.chain(
             optax.clip_by_global_norm(self.grad_clip),
-            optax.adamw(schedule, b1=self.b1, b2=self.b2, weight_decay=self.weight_decay),
+            optax.adamw(
+                schedule, b1=self.b1, b2=self.b2, weight_decay=self.weight_decay,
+                mu_dtype=jnp.dtype(self.mu_dtype) if self.mu_dtype else None,
+            ),
         )
 
 
