@@ -16,7 +16,6 @@ import argparse
 import dataclasses
 import functools
 import json
-import os
 import sys
 import time
 
@@ -63,6 +62,7 @@ def run_bench(
     seq: int | None,
     remat_policy: str | None = None,
     ce_chunk: int | None = None,
+    mu_dtype: str = "",
 ) -> dict:
     import jax
 
@@ -79,16 +79,21 @@ def run_bench(
         override = {"remat": remat_policy != "none"}
         if "remat_policy" in fields:
             override["remat_policy"] = remat_policy
+        elif remat_policy not in ("none", "full"):
+            print(f"[bench] ignoring --remat-policy {remat_policy}: "
+                  f"{type(cfg).__name__} has no such field", file=sys.stderr)
         cfg = dataclasses.replace(cfg, **override)
-    if ce_chunk is not None and "ce_chunk" in fields:
-        cfg = dataclasses.replace(cfg, ce_chunk=ce_chunk)
+    if ce_chunk is not None:
+        if "ce_chunk" in fields:
+            cfg = dataclasses.replace(cfg, ce_chunk=ce_chunk)
+        else:
+            print(f"[bench] ignoring --ce-chunk: {type(cfg).__name__} has no such field",
+                  file=sys.stderr)
 
     n_dev = len(jax.devices())
     spec = MeshSpec.auto(n_dev)  # fsdp over all chips
     mesh = spec.build()
-    opt = OptimizerConfig(
-        warmup_steps=10, total_steps=1000, mu_dtype=os.environ.get("TONY_BENCH_MU_DTYPE", "")
-    ).build()
+    opt = OptimizerConfig(warmup_steps=10, total_steps=1000, mu_dtype=mu_dtype).build()
     state = sharded_init(
         lambda: model.init(jax.random.PRNGKey(0), cfg), model.sharding_rules(cfg), mesh, opt
     )
@@ -141,6 +146,8 @@ def main() -> int:
     p.add_argument("--seq", type=int, default=None)
     p.add_argument("--remat-policy", default=None, choices=["none", "full", "dots", "flash"])
     p.add_argument("--ce-chunk", type=int, default=None, help="0 = materialize logits")
+    p.add_argument("--mu-dtype", default="", choices=["", "bfloat16", "float32"],
+                   help="Adam first-moment dtype (default: param dtype)")
     args = p.parse_args()
 
     import jax
@@ -156,7 +163,7 @@ def main() -> int:
         try:
             r = run_bench(
                 attempt, args.steps, args.warmup, args.batch, args.seq,
-                args.remat_policy, args.ce_chunk,
+                args.remat_policy, args.ce_chunk, args.mu_dtype,
             )
             out = {
                 "metric": f"{r['model']}_train_mfu_{r['n_chips']}chip_{attempt}",

@@ -9,13 +9,13 @@ Prints one JSON line with images/sec/chip and model-flops utilization
 import argparse
 import json
 import sys
-import time
 
 import jax
 import optax
 
 from tony_tpu.models import resnet
 from tony_tpu.train.metrics import detect_peak_flops
+from tony_tpu.train.trainer import Throughput
 
 FWD_GFLOP_PER_IMAGE = 4.1
 
@@ -45,24 +45,31 @@ def main() -> int:
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss, aux["bn_state"]
 
-    for _ in range(args.warmup):
+    for _ in range(max(args.warmup, 2)):  # step 2 hits the donated-buffer recompile
         params, opt_state, loss, batch["bn_state"] = step(params, opt_state, batch)
         float(loss)  # per-step host sync (honest timing on async backends)
-    t0 = time.perf_counter()
+
+    # the shared meter — same timing/MFU methodology as bench.py, with
+    # "tokens" = images and flops/token = training flops per image
+    meter = Throughput(
+        tokens_per_step=args.batch,
+        flops_per_token=int(3 * FWD_GFLOP_PER_IMAGE * 1e9),
+        n_chips=1,
+        peak_flops=detect_peak_flops(),
+    )
+    meter.start()
     for _ in range(args.steps):
         params, opt_state, loss, batch["bn_state"] = step(params, opt_state, batch)
         float(loss)
-    dt = (time.perf_counter() - t0) / args.steps
-
-    ips = args.batch / dt
-    mfu = (3 * FWD_GFLOP_PER_IMAGE * 1e9 * ips) / detect_peak_flops()
+        meter.step()
+    r = meter.report()
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_1chip",
-        "value": round(ips, 1),
+        "value": round(r["tokens_per_sec"], 1),
         "unit": "images/sec/chip",
-        "step_time_ms": round(dt * 1000, 1),
+        "step_time_ms": round(r["step_time_ms"], 1),
         "batch": args.batch,
-        "mfu": round(mfu, 4),
+        "mfu": round(r["mfu"], 4),
     }))
     return 0
 

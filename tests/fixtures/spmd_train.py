@@ -1,0 +1,61 @@
+"""Fixture: multi-process SPMD training step over a global mesh.
+
+The full multi-host training proof (SURVEY.md §2.6, §5.8): two tony-launched
+worker processes each own 4 virtual CPU devices; after `init_distributed`
+the global mesh spans all 8 devices across both processes, parameters are
+sharded over the global `fsdp` axis, and one train step runs with XLA
+collectives crossing the process boundary (the ICI/DCN path on real slices).
+Every rank must see the same finite loss — proof the gradient all-reduce
+spanned processes.
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+# 4 virtual CPU devices per process (8 global across the 2-worker gang)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "", os.environ.get("XLA_FLAGS", "")
+).strip()
+os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=4").strip()
+
+import dataclasses  # noqa: E402
+import functools  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tony_tpu.models import llama  # noqa: E402
+from tony_tpu.parallel import MeshSpec  # noqa: E402
+from tony_tpu.runtime import init_distributed  # noqa: E402
+from tony_tpu.train import OptimizerConfig, make_train_step, sharded_init  # noqa: E402
+
+init_distributed()
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == 4
+
+cfg = dataclasses.replace(llama.LLAMA_TINY, max_seq=32)
+mesh = MeshSpec(fsdp=8).build()
+opt = OptimizerConfig(warmup_steps=0, total_steps=5).build()
+state = sharded_init(lambda: llama.init(jax.random.PRNGKey(0), cfg), llama.sharding_rules(cfg), mesh, opt)
+step = make_train_step(functools.partial(llama.loss_fn, cfg=cfg, mesh=mesh), opt)
+
+# global batch sharded over fsdp: each process contributes its local half
+B, T = 8, 32
+local = np.asarray(
+    llama.synthetic_batch(
+        jax.random.fold_in(jax.random.PRNGKey(1), jax.process_index()), B // 2, T, cfg
+    )["tokens"]
+)
+sharding = jax.NamedSharding(mesh, jax.sharding.PartitionSpec(("data", "fsdp")))
+batch = {"tokens": jax.make_array_from_process_local_data(sharding, local)}
+
+state, metrics = step(state, batch)
+loss = float(metrics["loss"])
+assert np.isfinite(loss), loss
+print(f"spmd_train ok: rank {jax.process_index()}/2, loss={loss:.4f}")

@@ -133,19 +133,21 @@ def quantize_tree(params, min_size: int = 1 << 16):
 
     Returns (tree-with-QTensor-leaves, bytes_before, bytes_after)."""
     before = after = 0
-    _SKIP_NAMES = ("norm", "bias", "scale", "embed_ln")
+    _SKIP_SUFFIXES = ("norm", "bias", "scale", "ln")
 
     def visit(path, leaf):
         nonlocal before, after
         sz = leaf.size * leaf.dtype.itemsize
         before += sz
         # two guards against quantizing non-matmul weights:
-        # 1. name-based: norm/bias stacks are [L, D] — 2-D and large at real
-        #    model scale, but quantizing them breaks the layer scan
-        #    (mismatched leading dims) and is numerically wrong;
+        # 1. name-based: the LAST path segment ending in norm/bias/scale/ln
+        #    marks a norm/bias stack ([L, D] — 2-D and large at real model
+        #    scale, but quantizing it breaks the layer scan and is
+        #    numerically wrong). Suffix-of-last-segment, not substring, so
+        #    legitimate projections like "upscale_proj" still quantize.
         # 2. shape-based: both trailing dims must look like matmul [K, N].
-        keystr = "/".join(str(getattr(k, "key", k)) for k in path).lower()
-        named_skip = any(s in keystr for s in _SKIP_NAMES)
+        last = str(getattr(path[-1], "key", path[-1])).lower() if path else ""
+        named_skip = any(last.endswith(s) for s in _SKIP_SUFFIXES)
         is_matmul_like = (
             leaf.ndim >= 2 and leaf.shape[-1] >= 64 and leaf.shape[-2] >= 64
         )

@@ -41,8 +41,10 @@ class OptimizerConfig:
     grad_clip: float = 1.0
     b1: float = 0.9
     b2: float = 0.95
-    # dtype of Adam's first moment. bf16 halves its HBM (the variance stays
-    # f32 — it is the numerically sensitive one); "" keeps the param dtype.
+    # dtype of Adam's first moment; "" keeps optax's default (the PARAM
+    # dtype — so bf16-param models already hold bf16 moments). Set
+    # "bfloat16" to halve mu's HBM when params are f32, or "float32" to
+    # upcast it for extra stability on bf16-param models.
     mu_dtype: str = ""
 
     def build(self) -> optax.GradientTransformation:
