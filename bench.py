@@ -80,8 +80,8 @@ def run_bench(
         if "remat_policy" in fields:
             override["remat_policy"] = remat_policy
         elif remat_policy not in ("none", "full"):
-            print(f"[bench] ignoring --remat-policy {remat_policy}: "
-                  f"{type(cfg).__name__} has no such field", file=sys.stderr)
+            print(f"[bench] {type(cfg).__name__} has no remat_policy field: "
+                  f"--remat-policy {remat_policy} falls back to full remat", file=sys.stderr)
         cfg = dataclasses.replace(cfg, **override)
     if ce_chunk is not None:
         if "ce_chunk" in fields:

@@ -100,6 +100,16 @@ class TestQuantizeTree:
         assert not isinstance(qtree["mlp_norm"], quant.QTensor)
         assert isinstance(qtree["w"], quant.QTensor)
 
+    def test_nested_norm_dicts_stay_float(self):
+        # BERT-style layout: the telling name is an INNER path segment
+        tree = {"layers": {
+            "attn_norm": {"w": jnp.ones((64, 768), jnp.bfloat16)},
+            "wq": jnp.ones((64, 768, 768), jnp.bfloat16),
+        }}
+        qtree, _, _ = quant.quantize_tree(tree, min_size=1 << 10)
+        assert not isinstance(qtree["layers"]["attn_norm"]["w"], quant.QTensor)
+        assert isinstance(qtree["layers"]["wq"], quant.QTensor)
+
     def test_stacked_dequant_roundtrip(self):
         w = jax.random.normal(jax.random.PRNGKey(10), (3, 32, 16), jnp.float32)
         qt = quant.quantize_int8(w)

@@ -147,3 +147,35 @@ class TestMXNetRuntime:
         assert env["DMLC_NUM_SERVER"] == "2"
         assert env["DMLC_NUM_WORKER"] == "3"
         assert runtime_for("mxnet").executor_env(SPEC, "worker", 0)["DMLC_ROLE"] == "worker"
+
+
+class TestCheckpointEnvContract:
+    def test_checkpoint_keys_reach_executor_env(self):
+        rt = runtime_for("jax", {
+            keys.CHECKPOINT_DIR: "/ckpt/run1",
+            keys.CHECKPOINT_INTERVAL_STEPS: "50",
+        })
+        env = rt.executor_env({"worker": ["h:1"]}, "worker", 0)
+        from tony_tpu import constants
+
+        assert env[constants.ENV_CHECKPOINT_DIR] == "/ckpt/run1"
+        assert env[constants.ENV_CHECKPOINT_INTERVAL] == "50"
+
+    def test_absent_when_unconfigured(self):
+        from tony_tpu import constants
+
+        env = runtime_for("jax").executor_env({"worker": ["h:1"]}, "worker", 0)
+        assert constants.ENV_CHECKPOINT_DIR not in env
+
+    def test_loop_args_default_from_env(self, monkeypatch):
+        from tony_tpu import constants
+        from tony_tpu.train.loop import parse_loop_args
+
+        monkeypatch.setenv(constants.ENV_CHECKPOINT_DIR, "/ckpt/fromenv")
+        monkeypatch.setenv(constants.ENV_CHECKPOINT_INTERVAL, "25")
+        loop, _ = parse_loop_args([])
+        assert loop.checkpoint_dir == "/ckpt/fromenv"
+        assert loop.checkpoint_every == 25
+        # explicit CLI wins over env
+        loop2, _ = parse_loop_args(["--checkpoint_dir", "/cli"])
+        assert loop2.checkpoint_dir == "/cli"

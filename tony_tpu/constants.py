@@ -49,6 +49,8 @@ ENV_TASK_NUM = "TASK_NUM"               # instances of this type
 ENV_DISTRIBUTED_MODE = "DISTRIBUTED_MODE"  # GANG | SINGLE_NODE
 ENV_CLUSTER_SPEC = "CLUSTER_SPEC"       # full cluster spec JSON (legacy TF contract)
 ENV_TB_PORT = "TB_PORT"                 # tensorboard task port
+ENV_CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"            # from tony.checkpoint.dir
+ENV_CHECKPOINT_INTERVAL = "TONY_CHECKPOINT_INTERVAL"  # from tony.checkpoint.interval-steps
 ENV_NOTEBOOK_PORT = "NOTEBOOK_PORT"     # notebook task port (proxied by submitter)
 
 # ---------------------------------------------------------------------------

@@ -140,14 +140,15 @@ def quantize_tree(params, min_size: int = 1 << 16):
         sz = leaf.size * leaf.dtype.itemsize
         before += sz
         # two guards against quantizing non-matmul weights:
-        # 1. name-based: the LAST path segment ending in norm/bias/scale/ln
-        #    marks a norm/bias stack ([L, D] — 2-D and large at real model
-        #    scale, but quantizing it breaks the layer scan and is
-        #    numerically wrong). Suffix-of-last-segment, not substring, so
-        #    legitimate projections like "upscale_proj" still quantize.
+        # 1. name-based: ANY path segment ending in norm/bias/scale/ln marks
+        #    a norm/bias (stacks are [L, D] — 2-D and large at real model
+        #    scale, but quantizing them breaks the layer scan and is
+        #    numerically wrong; nested layouts like attn_norm/{w,b} put the
+        #    telling name on an inner segment). Suffix-of-segment, not
+        #    substring, so projections like "upscale_proj" still quantize.
         # 2. shape-based: both trailing dims must look like matmul [K, N].
-        last = str(getattr(path[-1], "key", path[-1])).lower() if path else ""
-        named_skip = any(last.endswith(s) for s in _SKIP_SUFFIXES)
+        segments = [str(getattr(k, "key", k)).lower() for k in path]
+        named_skip = any(seg.endswith(s) for seg in segments for s in _SKIP_SUFFIXES)
         is_matmul_like = (
             leaf.ndim >= 2 and leaf.shape[-1] >= 64 and leaf.shape[-2] >= 64
         )

@@ -77,8 +77,10 @@ class FrameworkRuntime:
         """
         import json
 
+        from tony_tpu.config import keys
+
         total = sum(len(v) for v in cluster_spec.values())
-        return {
+        env = {
             constants.ENV_JOB_NAME: job_name,
             constants.ENV_TASK_INDEX: str(index),
             constants.ENV_TASK_NUM: str(len(cluster_spec.get(job_name, []))),
@@ -87,6 +89,17 @@ class FrameworkRuntime:
             ),
             constants.ENV_CLUSTER_SPEC: json.dumps(cluster_spec),
         }
+        # checkpoint contract: the frozen job conf is the whole-job truth
+        # (SURVEY.md §5.6), so tony.checkpoint.* reaches the user process as
+        # env that train.loop's arg parser defaults from — the job config
+        # configures resume without touching the training script's CLI
+        ckpt_dir = self.config.get(keys.CHECKPOINT_DIR)
+        if ckpt_dir:
+            env[constants.ENV_CHECKPOINT_DIR] = ckpt_dir
+            env[constants.ENV_CHECKPOINT_INTERVAL] = (
+                self.config.get(keys.CHECKPOINT_INTERVAL_STEPS) or "0"
+            )
+        return env
 
 
 def get_runtime(config: TonyConfig) -> FrameworkRuntime:

@@ -151,13 +151,32 @@ def parse_loop_args(argv: list[str] | None = None) -> tuple[LoopConfig, dict]:
     """Shared CLI for example scripts; returns (LoopConfig, extra model args)."""
     import argparse
 
+    import os
+
+    from tony_tpu import constants
+
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch_size", type=int, default=8)
     p.add_argument("--seq_len", type=int, default=512)
     p.add_argument("--log_every", type=int, default=10)
-    p.add_argument("--checkpoint_dir", default="")
-    p.add_argument("--checkpoint_every", type=int, default=0)
+    # checkpoint settings default from the executor-injected env (the
+    # tony.checkpoint.* keys of the frozen job conf); CLI flags override
+    p.add_argument(
+        "--checkpoint_dir", default=os.environ.get(constants.ENV_CHECKPOINT_DIR, "")
+    )
+    try:
+        env_interval = int(os.environ.get(constants.ENV_CHECKPOINT_INTERVAL, "0") or 0)
+    except ValueError:
+        # a malformed tony.checkpoint.interval-steps must not crash every
+        # worker at argparse-construction time; fall back to "final only"
+        print(
+            f"[train] ignoring non-integer {constants.ENV_CHECKPOINT_INTERVAL}="
+            f"{os.environ[constants.ENV_CHECKPOINT_INTERVAL]!r}",
+            file=sys.stderr,
+        )
+        env_interval = 0
+    p.add_argument("--checkpoint_every", type=int, default=env_interval)
     p.add_argument("--learning_rate", type=float, default=3e-4)
     p.add_argument("--warmup_steps", type=int, default=100)
     p.add_argument("--model_axis", type=int, default=1)
