@@ -223,12 +223,14 @@ class TestFullScaleConfigsSymbolic:
         # every LARGE leaf must be actually sharded (a replicated 8B matmul
         # would silently blow per-chip HBM on a slice) — spec_for defaults
         # to replicate, so check for a non-empty PartitionSpec explicitly
+        from tony_tpu.parallel.sharding import path_str
+
         rules = llama.sharding_rules(cfg)
         flat = jax.tree_util.tree_flatten_with_path(shapes)[0]
         for path, leaf in flat:
             if int(np.prod(leaf.shape)) < 1 << 20:
                 continue  # norms etc. may replicate
-            spec = rules.spec_for("/".join(str(getattr(k, "key", k)) for k in path))
+            spec = rules.spec_for(path_str(path))  # same renderer production uses
             assert any(ax is not None for ax in spec), (path, spec)
 
     def test_mixtral_8x7b_structure(self):

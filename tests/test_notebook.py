@@ -59,7 +59,9 @@ class TestProxyServer:
         # port with a connect would be racy on a shared host (another process
         # may legitimately reuse the freed port)
         assert proxy._listener.fileno() == -1
-        proxy._thread.join(timeout=5)
+        # generous join: under full-suite load (leftover jax workers from e2e
+        # tests burning CPU) the accept thread can take a while to schedule
+        proxy._thread.join(timeout=30)
         assert not proxy._thread.is_alive()
 
 

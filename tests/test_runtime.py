@@ -179,3 +179,16 @@ class TestCheckpointEnvContract:
         # explicit CLI wins over env
         loop2, _ = parse_loop_args(["--checkpoint_dir", "/cli"])
         assert loop2.checkpoint_dir == "/cli"
+
+    def test_interval_injected_without_dir(self):
+        from tony_tpu import constants
+
+        rt = runtime_for("jax", {keys.CHECKPOINT_INTERVAL_STEPS: "100"})
+        env = rt.executor_env({"worker": ["h:1"]}, "worker", 0)
+        assert env[constants.ENV_CHECKPOINT_INTERVAL] == "100"
+        assert constants.ENV_CHECKPOINT_DIR not in env
+
+    def test_malformed_interval_rejected_at_validate(self):
+        rt = runtime_for("jax", {keys.CHECKPOINT_INTERVAL_STEPS: "1OO"})
+        with pytest.raises(ValueError, match="interval-steps"):
+            rt.validate()

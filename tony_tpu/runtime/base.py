@@ -54,7 +54,20 @@ class FrameworkRuntime:
 
     # -- AM-side hooks -----------------------------------------------------
     def validate(self) -> None:
-        """Raise on an invalid conf for this framework (AM prepare-time)."""
+        """Raise on an invalid conf for this framework (AM prepare-time).
+
+        Base checks apply to every framework; subclasses extend."""
+        from tony_tpu.config import keys
+
+        interval = self.config.get(keys.CHECKPOINT_INTERVAL_STEPS)
+        if interval:
+            try:
+                int(interval)
+            except ValueError:
+                raise ValueError(
+                    f"{keys.CHECKPOINT_INTERVAL_STEPS} must be an integer, "
+                    f"got {interval!r}"
+                ) from None
 
     def on_gang_complete(self, session: "Session") -> None:
         """Called once when every task has registered (spec is complete)."""
@@ -96,9 +109,11 @@ class FrameworkRuntime:
         ckpt_dir = self.config.get(keys.CHECKPOINT_DIR)
         if ckpt_dir:
             env[constants.ENV_CHECKPOINT_DIR] = ckpt_dir
-            env[constants.ENV_CHECKPOINT_INTERVAL] = (
-                self.config.get(keys.CHECKPOINT_INTERVAL_STEPS) or "0"
-            )
+        interval = self.config.get(keys.CHECKPOINT_INTERVAL_STEPS)
+        if interval and interval != "0":
+            # independent of the dir: the training command may pass its own
+            # --checkpoint_dir while the job conf owns the cadence
+            env[constants.ENV_CHECKPOINT_INTERVAL] = interval
         return env
 
 
