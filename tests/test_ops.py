@@ -193,10 +193,42 @@ class TestFlashAttentionInterpret:
             assert err < 2e-4, f"{name} rel err {err}"
 
     def test_gqa_backward_streaming_variant(self, monkeypatch):
-        # force the 3D-grid (long-sequence) dkv kernel and check parity
+        # force the pair-enumeration (long-sequence) dkv kernel and check parity
         monkeypatch.setattr(A, "_DKV_RESIDENT_MAX_QROWS", 0)
         self.test_gqa_backward_matches_reference()
         self.test_backward_matches_reference()
+
+    def test_streaming_dkv_causal_tk_gt_tq(self, monkeypatch):
+        # Tk > Tq + causal: k blocks wholly past the causal horizon must come
+        # back as exact ZERO dk/dv (the sparse pair walk still has to visit
+        # them once to zero-init the output block)
+        monkeypatch.setattr(A, "_DKV_RESIDENT_MAX_QROWS", 0)
+        B, H, Tq, Tk, D = 1, 2, 256, 1024, 64
+        ks = [jax.random.fold_in(jax.random.PRNGKey(17), i) for i in range(3)]
+        q = jax.random.normal(ks[0], (B, H, Tq, D), jnp.float32) * 0.5
+        k = jax.random.normal(ks[1], (B, H, Tk, D), jnp.float32) * 0.5
+        v = jax.random.normal(ks[2], (B, H, Tk, D), jnp.float32) * 0.5
+
+        def loss_flash(q, k, v):
+            return A._flash_trainable(q, k, v, True).sum()
+
+        def loss_ref(q, k, v):
+            # flash-kernel causal semantics: ABSOLUTE positions (query i sees
+            # keys <= i), unlike attention_reference's bottom-aligned tril
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+            mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+            p = jax.nn.softmax(jnp.where(mask, s, A.NEG_INF), axis=-1)
+            return jnp.einsum("bhqk,bhkd->bhqd", p, v).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), gf, gr):
+            scale = float(jnp.max(jnp.abs(b))) + 1e-9
+            err = float(jnp.max(jnp.abs(a - b))) / scale
+            assert err < 2e-4, f"{name} rel err {err}"
+        # keys at positions >= Tq are unreachable: gradients exactly zero
+        np.testing.assert_array_equal(np.asarray(gf[1][:, :, Tq:, :]), 0.0)
+        np.testing.assert_array_equal(np.asarray(gf[2][:, :, Tq:, :]), 0.0)
 
     def test_backward_noncausal(self):
         q, k, v = self._qkv(T=256)

@@ -314,48 +314,49 @@ _DKV_RESIDENT_MAX_QROWS = 4096
 
 
 def _flash_bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q: int, num_q_blocks: int, causal: bool, scale: float,
+    kb_ref, qrow_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+    delta_ref, dk_ref, dv_ref, *, num_q_blocks: int, causal: bool, scale: float,
 ):
-    """Grid: (B*Hkv, Tk//block_k, n_rep·Tq//block_q) — q blocks innermost.
+    """Grid: (B*Hkv, n_pairs) — one causally-contributing (k block, q block)
+    pair per step, streamed via scalar-prefetched index arrays.
 
     Only one q block is staged in VMEM per step (long sequences would blow
     the VMEM budget if the whole [n_rep·Tq, D] q were staged, as an earlier
-    design did). dk/dv output blocks are revisited across the inner grid
-    dim, accumulating in f32 in VMEM; GQA group members are folded into the
-    q dim (layout [B*Hkv, n_rep*Tq, …]), so ``j`` walks every (group member,
-    q block) pair and positions are taken modulo the per-head Tq.
+    design did), and — unlike a dense (k block × q block) grid — pairs above
+    the causal diagonal are never enumerated, so they cost neither DMA nor a
+    grid step. dk/dv output blocks are revisited across consecutive pairs of
+    the same k block (pairs are sorted by k block), accumulating in f32 in
+    VMEM; GQA group members are folded into the q dim (layout
+    [B*Hkv, n_rep*Tq, …]), so each pair's q-block index within its own head
+    (for position masking) is ``qrow % num_q_blocks``.
     """
     from jax.experimental import pallas as pl
 
-    block_k, D = k_ref.shape
-    k_blk_idx = pl.program_id(1)
-    j = pl.program_id(2)
-    qb = j % num_q_blocks  # q-block index within this group member's head
+    block_q = q_ref.shape[0]
+    block_k = k_ref.shape[0]
+    j = pl.program_id(1)
+    k_blk_idx = kb_ref[j]
+    qb = qrow_ref[j] % num_q_blocks  # q-block index within this member's head
+    first = jnp.logical_or(j == 0, k_blk_idx != kb_ref[jnp.maximum(j - 1, 0)])
 
-    @pl.when(j == 0)
+    @pl.when(first)
     def _init():
         dk_ref[:] = jnp.zeros_like(dk_ref)
         dv_ref[:] = jnp.zeros_like(dv_ref)
 
-    # causal: q blocks strictly above the diagonal contribute nothing
-    contributes = True if not causal else (qb + 1) * block_q > k_blk_idx * block_k
-
-    @pl.when(contributes)
-    def _accumulate():
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        k_pos = k_blk_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
-        q_blk = q_ref[:].astype(jnp.float32)
-        do_blk = do_ref[:].astype(jnp.float32)
-        lse_blk = lse_ref[:][:, :1]
-        delta_blk = delta_ref[:][:, :1]
-        q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
-        dk_c, dv_c = _dkv_block_contrib(
-            q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, causal, scale
-        )
-        dk_ref[:] += scale * dk_c
-        dv_ref[:] += dv_c
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    k_pos = k_blk_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    q_blk = q_ref[:].astype(jnp.float32)
+    do_blk = do_ref[:].astype(jnp.float32)
+    lse_blk = lse_ref[:][:, :1]
+    delta_blk = delta_ref[:][:, :1]
+    q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    dk_c, dv_c = _dkv_block_contrib(
+        q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, causal, scale
+    )
+    dk_ref[:] += scale * dk_c
+    dv_ref[:] += dv_c
 
 
 def _flash_bwd_impl(
@@ -438,27 +439,64 @@ def _flash_bwd_impl(
             cost_estimate=cost,
         )(qg, kf, vf, dog, lseg, deltag)
     else:
-        blk_qg = pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, j, 0))
-        blk_kv = pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, i, 0))
-        row_qg = pl.BlockSpec((None, block_q, _STAT_LANES), lambda b, i, j: (b, j, 0))
+        # streaming grid: enumerate only the causally-contributing
+        # (k block, group member, q block) pairs, sorted by k block, and
+        # scalar-prefetch the index arrays so BlockSpec index maps (and the
+        # DMA pipeline) follow the sparse walk — q blocks above the diagonal
+        # are never fetched, halving DMA traffic and grid steps for causal.
+        kb_l, qrow_l = [], []
+        for i in range(Tk // block_k):
+            # fully-masked k blocks (possible when Tk > Tq) still emit ONE
+            # q block per group member: its contribution is exactly zero
+            # through the mask, but the visit zero-initializes the output
+            # block, which would otherwise be returned uninitialized
+            qb0 = min((i * block_k) // block_q, num_q_blocks - 1) if causal else 0
+            for g in range(n_rep):
+                for qb in range(qb0, num_q_blocks):
+                    kb_l.append(i)
+                    qrow_l.append(g * num_q_blocks + qb)
+        kb = jnp.array(kb_l, dtype=jnp.int32)
+        qrow = jnp.array(qrow_l, dtype=jnp.int32)
+        n_pairs = len(kb_l)
+
+        def q_map(b, j, kb_r, qrow_r):
+            return (b, qrow_r[j], 0)
+
+        def kv_map(b, j, kb_r, qrow_r):
+            return (b, kb_r[j], 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B * Hkv, n_pairs),
+            in_specs=[
+                pl.BlockSpec((None, block_q, D), q_map),
+                pl.BlockSpec((None, block_k, D), kv_map),
+                pl.BlockSpec((None, block_k, D), kv_map),
+                pl.BlockSpec((None, block_q, D), q_map),
+                pl.BlockSpec((None, block_q, _STAT_LANES), q_map),
+                pl.BlockSpec((None, block_q, _STAT_LANES), q_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, block_k, D), kv_map),
+                pl.BlockSpec((None, block_k, D), kv_map),
+            ],
+        )
         dk, dv = pl.pallas_call(
             functools.partial(
                 _flash_bwd_dkv_kernel,
-                block_q=block_q, num_q_blocks=num_q_blocks, causal=causal, scale=scale,
+                num_q_blocks=num_q_blocks, causal=causal, scale=scale,
             ),
-            grid=(B * Hkv, Tk // block_k, n_rep * num_q_blocks),
-            in_specs=[blk_qg, blk_kv, blk_kv, blk_qg, row_qg, row_qg],
-            out_specs=[blk_kv, blk_kv],
+            grid_spec=grid_spec,
             out_shape=[
                 jax.ShapeDtypeStruct((B * Hkv, Tk, D), jnp.float32),
                 jax.ShapeDtypeStruct((B * Hkv, Tk, D), jnp.float32),
             ],
             compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel", "arbitrary")
+                dimension_semantics=("parallel", "arbitrary")
             ),
             interpret=_INTERPRET,
             cost_estimate=cost,
-        )(qg, kf, vf, dog, lseg, deltag)
+        )(kb, qrow, qg, kf, vf, dog, lseg, deltag)
 
     return (
         dq.reshape(B, H, Tq, D),
