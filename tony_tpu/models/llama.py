@@ -46,6 +46,7 @@ class LlamaConfig:
     remat: bool = True
     remat_policy: str = "full"  # full | dots (save matmul outputs, recompute the rest)
     attn_impl: str = "auto"   # auto | flash | reference
+    cp_impl: str = "xla"      # context-parallel ring: xla (scan+ppermute) | pallas (remote-DMA kernel)
     ce_chunk: int = 512       # fused lm-head+CE chunk length; 0 = materialize logits
 
     @property
@@ -140,7 +141,35 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh) -> jax.Array:
 
     q: [B, H, T, Dh]; k/v: [B, Hkv, T, Dh].
     """
+    if cfg.cp_impl not in ("xla", "pallas"):
+        raise ValueError(f"cp_impl must be 'xla' or 'pallas', got {cfg.cp_impl!r}")
     if mesh is not None and mesh.shape.get("context", 1) > 1:
+        if cfg.cp_impl == "pallas":
+            # remote-DMA ring kernel: GQA-native (KV stays at Hkv width on
+            # the wire); fully-manual shard_map because the kernel manages
+            # its own collectives (and interpret-mode emulation requires it)
+            from tony_tpu.ops.ring import ring_attention_pallas
+
+            model_deg = mesh.shape.get("model", 1)
+            batch_deg = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+            if cfg.n_kv_heads % model_deg or q.shape[0] % batch_deg:
+                raise ValueError(
+                    "cp_impl='pallas' shards kv heads over 'model' and batch "
+                    f"over data×fsdp explicitly: n_kv_heads {cfg.n_kv_heads} "
+                    f"must divide by model={model_deg} and batch {q.shape[0]} "
+                    f"by data×fsdp={batch_deg} (cp_impl='xla' has no such "
+                    "constraint)"
+                )
+            qspec = P(BATCH_AXES, "model", "context", None)
+            ring = jax.shard_map(
+                partial(ring_attention_pallas, axis_name="context", causal=True),
+                mesh=mesh,
+                in_specs=(qspec, qspec, qspec),
+                out_specs=qspec,
+                axis_names=set(mesh.axis_names),
+                check_vma=False,
+            )
+            return ring(q, k, v)
         n_rep = cfg.n_heads // cfg.n_kv_heads
         k = attn_ops.repeat_kv(k, n_rep)
         v = attn_ops.repeat_kv(v, n_rep)

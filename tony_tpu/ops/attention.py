@@ -458,6 +458,13 @@ def _flash_bwd_impl(
         kb = jnp.array(kb_l, dtype=jnp.int32)
         qrow = jnp.array(qrow_l, dtype=jnp.int32)
         n_pairs = len(kb_l)
+        # the sparse walk does `frac` of the dense grid's work (~1/2 causal)
+        frac = n_pairs / ((Tk // block_k) * n_rep * num_q_blocks)
+        cost = pl.CostEstimate(
+            flops=int(cost.flops * frac),
+            bytes_accessed=int(cost.bytes_accessed * frac),
+            transcendentals=int(cost.transcendentals * frac),
+        )
 
         def q_map(b, j, kb_r, qrow_r):
             return (b, qrow_r[j], 0)

@@ -1,0 +1,293 @@
+"""Pallas remote-DMA ring attention: the hand-overlapped CP data plane.
+
+The XLA implementation (parallel/context.ring_attention) expresses the ring
+as ``lax.scan`` + ``ppermute`` and leaves transfer/compute overlap to the
+compiler. This module is the same blockwise-softmax schedule written as ONE
+Pallas kernel per device: KV shards travel the ``context``-axis ring as
+inter-chip RDMA (``make_async_remote_copy`` over ICI) between **HBM-resident
+double-buffered slots**, while the kernel overlaps each transfer with the
+flash-attention math on the slot it already holds — the TPU analog of the
+reference's NCCL-ring data plane, which lived inside user frameworks
+(SURVEY.md §2.6), built per the Pallas guide's ring-collective pattern.
+
+VMEM discipline: only tiles pass through VMEM (q/k/v blocks and the f32
+softmax state for one q block), so per-device shard size is bounded by HBM,
+not VMEM, and KV stays at Hkv width end to end (GQA-native — q heads alias
+onto kv heads inside the compute loop, never broadcast).
+
+Differentiable: the custom VJP recomputes the backward through the XLA ring
+(numerically identical schedule), so the kernel drops into training models
+wherever ``ring_attention`` is used (``LlamaConfig(cp_impl="pallas")``).
+
+Validated in TPU-interpret mode (which emulates RDMA + semaphores across
+shard_map devices, with race detection) on a virtual CPU mesh; the real-ICI
+path uses the same code with ``interpret=None`` on a physical slice.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu.ops.attention import NEG_INF, _STAT_LANES
+
+
+def default_interpret():
+    """InterpretParams when the env asks for emulated kernels, else False
+    (same TONY_PALLAS_INTERPRET contract as ops/attention.py)."""
+    if os.environ.get("TONY_PALLAS_INTERPRET", "") == "1":
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pltpu.InterpretParams()
+    return False
+
+
+def _ring_fwd_kernel(
+    my_ref, q_hbm, k_hbm, v_hbm, o_hbm,
+    kbuf, vbuf, acc_hbm, m_hbm, l_hbm,
+    qt, kt, vt, acct, mt, lt, ot, csem, send_sem, recv_sem,
+    *, n: int, axis_name: str, causal: bool, scale: float,
+    n_rep: int, bq: int, bk: int,
+):
+    """One device's whole ring pass. Grid: () — the ring loop is in-kernel.
+
+    Per step: (1) neighbor barrier, (2) start the HBM→HBM RDMA of the current
+    KV slot to the right neighbor's other slot, (3) stream (q block × kv
+    block) tiles through VMEM updating the online-softmax state persisted in
+    HBM scratch, (4) wait both RDMA semaphores. Causally-masked tiles are
+    skipped before their DMA is issued.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, Tl, D = q_hbm.shape
+    my = my_ref[0]
+    right = jax.lax.rem(my + 1, n)
+    left = jax.lax.rem(my + n - 1, n)
+    num_qb, num_kb = Tl // bq, Tl // bk
+
+    def copy(src, dst):
+        cp = pltpu.make_async_copy(src, dst, csem.at[0])
+        cp.start()
+        cp.wait()
+
+    # stage the local KV shard into ring slot 0
+    copy(k_hbm, kbuf.at[0])
+    copy(v_hbm, vbuf.at[0])
+
+    for s in range(n):  # static unroll: n is the mesh-axis size
+        cur, nxt = s % 2, (s + 1) % 2
+        if s < n - 1:
+            # everyone is at step s once the barrier clears ⇒ the right
+            # neighbor finished computing on ITS slot `nxt` (= its `cur`
+            # of step s-1) and we may overwrite it
+            barrier = pltpu.get_barrier_semaphore()
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id={axis_name: left},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id={axis_name: right},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            pltpu.semaphore_wait(barrier, 2)
+            rk = pltpu.make_async_remote_copy(
+                src_ref=kbuf.at[cur], dst_ref=kbuf.at[nxt],
+                send_sem=send_sem.at[cur, 0], recv_sem=recv_sem.at[nxt, 0],
+                device_id={axis_name: right},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            rv = pltpu.make_async_remote_copy(
+                src_ref=vbuf.at[cur], dst_ref=vbuf.at[nxt],
+                send_sem=send_sem.at[cur, 1], recv_sem=recv_sem.at[nxt, 1],
+                device_id={axis_name: right},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            rk.start()
+            rv.start()
+
+        src = jax.lax.rem(my - s + n, n)  # whose KV shard slot `cur` holds
+
+        def qb_body(bh, qb):
+            kvh = bh // n_rep
+            copy(q_hbm.at[bh, pl.ds(qb * bq, bq)], qt)
+            if s == 0:
+                acct[:] = jnp.zeros_like(acct)
+                mt[:] = jnp.full_like(mt, NEG_INF)
+                lt[:] = jnp.zeros_like(lt)
+            else:
+                copy(acc_hbm.at[bh, pl.ds(qb * bq, bq)], acct)
+                copy(m_hbm.at[bh, pl.ds(qb * bq, bq)], mt)
+                copy(l_hbm.at[bh, pl.ds(qb * bq, bq)], lt)
+            qv = qt[:].astype(jnp.float32) * scale
+            q0 = my * Tl + qb * bq  # global position of this q block's row 0
+
+            def kb_body(kb, _):
+                k0 = src * Tl + kb * bk
+
+                @pl.when(jnp.logical_or(not causal, k0 <= q0 + bq - 1))
+                def _tile():
+                    copy(kbuf.at[cur, kvh, pl.ds(kb * bk, bk)], kt)
+                    copy(vbuf.at[cur, kvh, pl.ds(kb * bk, bk)], vt)
+                    s_blk = jax.lax.dot_general(
+                        qv, kt[:].astype(jnp.float32),
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )  # [bq, bk]
+                    if causal:
+                        q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+                        k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+                        s_blk = jnp.where(q_pos >= k_pos, s_blk, NEG_INF)
+                    m_prev = mt[:][:, :1]
+                    l_prev = lt[:][:, :1]
+                    m_new = jnp.maximum(m_prev, jnp.max(s_blk, axis=-1, keepdims=True))
+                    alpha = jnp.exp(m_prev - m_new)
+                    p = jnp.exp(s_blk - m_new)
+                    if causal:  # fully-masked rows: keep contributions exactly 0
+                        p = jnp.where(s_blk <= NEG_INF / 2, 0.0, p)
+                    lt[:] = jnp.broadcast_to(
+                        l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True), lt.shape
+                    )
+                    mt[:] = jnp.broadcast_to(m_new, mt.shape)
+                    acct[:] = acct[:] * alpha + jax.lax.dot_general(
+                        p, vt[:].astype(jnp.float32),
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+
+                return 0
+
+            jax.lax.fori_loop(0, num_kb, kb_body, 0)
+            if s == n - 1:
+                ot[:] = (acct[:] / jnp.maximum(lt[:][:, :1], 1e-20)).astype(ot.dtype)
+                copy(ot, o_hbm.at[bh, pl.ds(qb * bq, bq)])
+            else:
+                copy(acct, acc_hbm.at[bh, pl.ds(qb * bq, bq)])
+                copy(mt, m_hbm.at[bh, pl.ds(qb * bq, bq)])
+                copy(lt, l_hbm.at[bh, pl.ds(qb * bq, bq)])
+
+        def run_qb_loop():
+            jax.lax.fori_loop(
+                0, BH * num_qb,
+                lambda i, _: (qb_body(i // num_qb, i % num_qb), 0)[1], 0,
+            )
+
+        if causal and 0 < s < n - 1:
+            # whole KV shard in the future ⇒ skip the entire state round-trip
+            # for this step, not just the tile compute (s=0 always has src=my;
+            # s=n-1 must run to write o)
+            pl.when(src <= my)(run_qb_loop)
+        else:
+            run_qb_loop()
+
+        if s < n - 1:
+            rk.wait()
+            rv.wait()
+
+
+def _ring_fwd(q, k, v, axis_name: str, causal: bool, interpret: Any):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tl, D = q.shape
+    Hkv = k.shape[1]
+    if H % Hkv:
+        raise ValueError(f"n_heads {H} must be divisible by n_kv_heads {Hkv}")
+    n_rep = H // Hkv
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = D ** -0.5
+    bq = min(256, Tl)
+    bk = min(256, Tl)
+    if Tl % bq or Tl % bk:
+        raise ValueError(f"per-device sequence {Tl} must be a multiple of {bq}")
+    qf = q.reshape(B * H, Tl, D)
+    kf = k.reshape(B * Hkv, Tl, D)
+    vf = v.reshape(B * Hkv, Tl, D)
+
+    kernel = functools.partial(
+        _ring_fwd_kernel, n=n, axis_name=axis_name, causal=causal, scale=scale,
+        n_rep=n_rep, bq=bq, bk=bk,
+    )
+    hbm = pltpu.MemorySpace.HBM
+    out = pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+            pl.BlockSpec(memory_space=hbm),
+            pl.BlockSpec(memory_space=hbm),
+            pl.BlockSpec(memory_space=hbm),
+        ],
+        out_specs=pl.BlockSpec(memory_space=hbm),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tl, D), q.dtype),
+        scratch_shapes=[
+            hbm((2, B * Hkv, Tl, D), k.dtype),            # ring KV slots
+            hbm((2, B * Hkv, Tl, D), v.dtype),
+            hbm((B * H, Tl, D), jnp.float32),             # online-softmax state
+            hbm((B * H, Tl, _STAT_LANES), jnp.float32),
+            hbm((B * H, Tl, _STAT_LANES), jnp.float32),
+            pltpu.MemorySpace.VMEM((bq, D), q.dtype),     # tiles
+            pltpu.MemorySpace.VMEM((bk, D), k.dtype),
+            pltpu.MemorySpace.VMEM((bk, D), v.dtype),
+            pltpu.MemorySpace.VMEM((bq, D), jnp.float32),
+            pltpu.MemorySpace.VMEM((bq, _STAT_LANES), jnp.float32),
+            pltpu.MemorySpace.VMEM((bq, _STAT_LANES), jnp.float32),
+            pltpu.MemorySpace.VMEM((bq, D), q.dtype),
+            pltpu.SemaphoreType.DMA((1,)),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=7),
+        interpret=interpret if interpret is not None else default_interpret(),
+    )(jnp.full((1,), my, jnp.int32), qf, kf, vf)
+    return out.reshape(B, H, Tl, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_attention_pallas(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "context",
+    causal: bool = True,
+    interpret: Any = None,
+) -> jax.Array:
+    """Ring attention with the KV rotation as in-kernel remote DMA.
+
+    Must run inside ``shard_map`` with the sequence dim sharded over
+    ``axis_name``; per-shard shapes q [B, H, T_local, D], k/v
+    [B, Hkv, T_local, D] with H % Hkv == 0 (GQA stays at Hkv width on the
+    wire). ``interpret`` accepts ``pltpu.InterpretParams`` for the
+    emulated-RDMA CPU path; None defers to ``TONY_PALLAS_INTERPRET``.
+    """
+    return _ring_fwd(q, k, v, axis_name, causal, interpret)
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, causal, interpret):
+    return _ring_fwd(q, k, v, axis_name, causal, interpret), (q, k, v)
+
+
+def _ring_vjp_bwd(axis_name, causal, interpret, res, g):
+    # backward through the XLA ring (same schedule, compiler-scheduled
+    # collectives): recompute-from-inputs, the standard flash-bwd trade
+    from tony_tpu.ops.attention import repeat_kv
+    from tony_tpu.parallel.context import ring_attention
+
+    q, k, v = res
+    n_rep = q.shape[1] // k.shape[1]
+
+    def ref(q, k, v):
+        return ring_attention(
+            q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
+            axis_name=axis_name, causal=causal,
+        )
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+ring_attention_pallas.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
