@@ -1,0 +1,209 @@
+"""Continuous-batching decode engine for Llama-family serving.
+
+The reference orchestrates training jobs only — serving is new capability
+(SURVEY.md §2.5 "absent" rows); this is the slot-based engine layer above
+models/generate.py. TPU shape discipline: one compiled decode step serves a
+FIXED number of slots against a FIXED-length KV cache; requests of any
+length flow through by admission into free slots (prefill, padded to
+power-of-two buckets so the jit cache stays small) and per-slot position
+masking — no dynamic shapes ever reach XLA.
+
+One source of truth for the math: the decode step is ``jax.vmap`` of the
+SAME single-request cache forward that ``generate()`` uses
+(generate._forward_with_cache), mapped over the slot dimension with
+per-slot lengths — greedy parity with batch-of-one generation is by
+construction, and the cache argument is donated so XLA updates K/V in
+place instead of copying the whole slot cache every token.
+
+Host/device split: admission, queueing, EOS/termination bookkeeping run on
+the host between steps (microseconds, overlapped with the device step);
+everything per-token is one jitted call over all slots. Weights may be an
+int8-quantized tree (ops/quant.py) — the same ``_mm`` dispatch as
+generate.py serves both.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu.models.generate import KVCache, _forward_with_cache, _sample, init_cache
+from tony_tpu.models.llama import LlamaConfig
+
+
+class SlotCache(NamedTuple):
+    """Decode state for S slots. k/v: [S, L, Hkv, maxT, Dh]; lengths: [S]."""
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array  # int32 [S] — tokens already cached per slot
+
+
+def init_slot_cache(cfg: LlamaConfig, num_slots: int, max_len: int) -> SlotCache:
+    shape = (num_slots, cfg.n_layers, cfg.n_kv_heads, max_len, cfg.head_dim)
+    return SlotCache(
+        k=jnp.zeros(shape, cfg.jdtype),
+        v=jnp.zeros(shape, cfg.jdtype),
+        lengths=jnp.zeros((num_slots,), jnp.int32),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "temperature", "top_k"), donate_argnums=(1,)
+)
+def decode_step(
+    params, cache: SlotCache, tokens: jax.Array, key: jax.Array,
+    cfg: LlamaConfig, temperature: float = 0.0, top_k: int = 0,
+):
+    """One token for every slot: (next tokens [S], cache').
+
+    vmap of the single-request cache forward over slots — each slot runs at
+    its own position (cache.lengths[s]). Inactive slots decode garbage
+    harmlessly; the host ignores them (their lengths advance, clamped by
+    the cache update at maxT-1).
+    """
+
+    def one(tok, ck, cv, length):
+        c = KVCache(ck[:, None], cv[:, None], length)  # inner batch dim of 1
+        logits, c2 = _forward_with_cache(params, tok[None, None], c, cfg)
+        return logits[0, -1].astype(jnp.float32), c2.k[:, 0], c2.v[:, 0]
+
+    logits, new_k, new_v = jax.vmap(one)(tokens, cache.k, cache.v, cache.lengths)
+    nxt = _sample(logits, key, temperature, top_k)
+    return nxt, SlotCache(new_k, new_v, cache.lengths + 1)
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+# one jit variant per (prompt bucket, cache length) — buckets are powers of
+# two so the variant count stays logarithmic in max_len
+_prefill_padded = jax.jit(_forward_with_cache, static_argnames=("cfg",))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _insert_prefill(cache: SlotCache, pre: KVCache, slot: jax.Array, true_len: jax.Array):
+    """Copy a 1-request prefill cache [L, 1, Hkv, maxT, Dh] into ``slot``."""
+    k = jax.lax.dynamic_update_slice(cache.k, pre.k.transpose(1, 0, 2, 3, 4), (slot, 0, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(cache.v, pre.v.transpose(1, 0, 2, 3, 4), (slot, 0, 0, 0, 0))
+    lengths = cache.lengths.at[slot].set(true_len)
+    return SlotCache(k, v, lengths)
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: list[int]
+    max_new_tokens: int
+    out: list[int] = field(default_factory=list)
+    slot: int = -1
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching: admit → decode → retire, every step.
+
+    One engine instance owns S slots over a shared static KV cache. Requests
+    are admitted into free slots as they arrive (prefill padded to a bucket
+    so prompt-length jit variants stay bounded) and retire independently on
+    EOS or their token budget — the running batch never drains to admit new
+    work, which is the throughput property batch-of-one ``generate()`` lacks.
+    """
+
+    def __init__(
+        self, params, cfg: LlamaConfig, *, num_slots: int = 8, max_len: int = 512,
+        eos_id: int = -1, temperature: float = 0.0, top_k: int = 0,
+        key: jax.Array | None = None,
+    ):
+        self.params, self.cfg = params, cfg
+        self.S, self.max_len, self.eos_id = num_slots, max_len, eos_id
+        self.temperature, self.top_k = temperature, top_k
+        self.cache = init_slot_cache(cfg, num_slots, max_len)
+        self.tokens = jnp.zeros((num_slots,), jnp.int32)  # last token per slot
+        self.key = key if key is not None else jax.random.PRNGKey(0)
+        self.pending: list[_Request] = []
+        self.running: dict[int, _Request] = {}   # slot → request
+        self.done: dict[int, list[int]] = {}
+        self._next_rid = 0
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        prompt = [int(t) for t in prompt]
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"exceeds engine max_len {self.max_len}"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self.pending.append(_Request(rid, prompt, max_new_tokens))
+        return rid
+
+    # -- engine internals ---------------------------------------------------
+
+    def _free_slots(self) -> list[int]:
+        return [s for s in range(self.S) if s not in self.running]
+
+    def _admit(self):
+        free = self._free_slots()
+        while self.pending and free:
+            req = self.pending.pop(0)
+            slot = free.pop(0)
+            Tp = len(req.prompt)
+            pad = min(_bucket(Tp), self.max_len) - Tp
+            padded = jnp.array(req.prompt + [0] * pad, jnp.int32)[None, :]
+            pre = init_cache(self.cfg, 1, self.max_len)
+            # padded positions write garbage K/V past Tp; decode masks them
+            # out via lengths[slot] = Tp, and causality protects the prefix
+            logits, pre = _prefill_padded(self.params, padded, pre, self.cfg)
+            first = _sample(
+                logits[:, Tp - 1].astype(jnp.float32), self._split(),
+                self.temperature, self.top_k,
+            )
+            self.cache = _insert_prefill(
+                self.cache, pre, jnp.int32(slot), jnp.int32(Tp)
+            )
+            self.tokens = self.tokens.at[slot].set(first[0])
+            req.slot = slot
+            req.out.append(int(first[0]))
+            self.running[slot] = req
+            self._retire_if_done(req)  # 1-token requests finish at admission
+
+    def _split(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+    def _retire_if_done(self, req: _Request):
+        if req.slot in self.running and (
+            len(req.out) >= req.max_new_tokens
+            or (self.eos_id >= 0 and req.out and req.out[-1] == self.eos_id)
+        ):
+            del self.running[req.slot]
+            self.done[req.rid] = req.out
+
+    def step(self) -> bool:
+        """Admit + one decode step. Returns True while work remains."""
+        self._admit()
+        if not self.running:
+            return bool(self.pending)
+        nxt, self.cache = decode_step(
+            self.params, self.cache, self.tokens, self._split(), self.cfg,
+            self.temperature, self.top_k,
+        )
+        self.tokens = nxt
+        for slot, req in list(self.running.items()):
+            req.out.append(int(nxt[slot]))
+            self._retire_if_done(req)
+        return bool(self.running or self.pending)
+
+    def run(self) -> dict[int, list[int]]:
+        """Drain all submitted requests; returns {request_id: tokens}."""
+        while self.step():
+            pass
+        return dict(self.done)

@@ -49,7 +49,7 @@ def default_interpret():
 def _ring_fwd_kernel(
     my_ref, q_hbm, k_hbm, v_hbm, o_hbm,
     kbuf, vbuf, acc_hbm, m_hbm, l_hbm,
-    qt, kt, vt, acct, mt, lt, ot, csem, send_sem, recv_sem,
+    qt, kt, vt, acct, mt, lt, ot, csem, send_sem, recv_sem, ready_sem,
     *, n: int, axis_name: str, causal: bool, scale: float,
     n_rep: int, bq: int, bk: int,
 ):
@@ -75,6 +75,21 @@ def _ring_fwd_kernel(
         cp.start()
         cp.wait()
 
+    # entry rendezvous: both neighbors have entered the kernel (so their
+    # ring-slot scratch is live) before any RDMA targets it. Data
+    # dependencies bound inter-invocation skew to one kernel, so the global
+    # barrier semaphore's counting cannot alias across invocations.
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id={axis_name: left},
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id={axis_name: right},
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_wait(barrier, 2)
+
     # stage the local KV shard into ring slot 0
     copy(k_hbm, kbuf.at[0])
     copy(v_hbm, vbuf.at[0])
@@ -82,19 +97,14 @@ def _ring_fwd_kernel(
     for s in range(n):  # static unroll: n is the mesh-axis size
         cur, nxt = s % 2, (s + 1) % 2
         if s < n - 1:
-            # everyone is at step s once the barrier clears ⇒ the right
-            # neighbor finished computing on ITS slot `nxt` (= its `cur`
-            # of step s-1) and we may overwrite it
-            barrier = pltpu.get_barrier_semaphore()
-            pltpu.semaphore_signal(
-                barrier, inc=1, device_id={axis_name: left},
-                device_id_type=pltpu.DeviceIdType.MESH,
-            )
-            pltpu.semaphore_signal(
-                barrier, inc=1, device_id={axis_name: right},
-                device_id_type=pltpu.DeviceIdType.MESH,
-            )
-            pltpu.semaphore_wait(barrier, 2)
+            if s > 0:
+                # the right neighbor freed its slot `nxt` (it finished
+                # computing step s-1 on it and said so); a per-neighbor,
+                # per-slot semaphore — unlike a counting barrier, a fast
+                # LEFT neighbor's signals can never stand in for the right
+                # neighbor's (data deps bound neighbor skew to one step, so
+                # parity indexing cannot alias across rounds)
+                pltpu.semaphore_wait(ready_sem.at[nxt], 1)
             rk = pltpu.make_async_remote_copy(
                 src_ref=kbuf.at[cur], dst_ref=kbuf.at[nxt],
                 send_sem=send_sem.at[cur, 0], recv_sem=recv_sem.at[nxt, 0],
@@ -185,6 +195,12 @@ def _ring_fwd_kernel(
             run_qb_loop()
 
         if s < n - 1:
+            # done reading slot `cur`: tell the LEFT neighbor (whose step-s+1
+            # RDMA targets our `cur`) it may overwrite it
+            pltpu.semaphore_signal(
+                ready_sem.at[cur], inc=1, device_id={axis_name: left},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
             rk.wait()
             rv.wait()
 
@@ -240,6 +256,7 @@ def _ring_fwd(q, k, v, axis_name: str, causal: bool, interpret: Any):
             pltpu.SemaphoreType.DMA((1,)),
             pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.REGULAR((2,)),    # per-slot "free" acks
         ],
         compiler_params=pltpu.CompilerParams(collective_id=7),
         interpret=interpret if interpret is not None else default_interpret(),
