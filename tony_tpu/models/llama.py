@@ -215,28 +215,7 @@ def hidden_states(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) 
             x = constrain(x, mesh, act_spec)
         return x, None
 
-    if cfg.remat:
-        if cfg.remat_policy == "dots":
-            # save every matmul output inside the block; recompute only the
-            # cheap elementwise/norm chains in the backward pass (trades
-            # ~N_layers × activation-dots memory for skipping the fwd replay)
-            block_fn = jax.checkpoint(
-                block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            )
-        elif cfg.remat_policy == "flash":
-            # middle ground: pin only the flash-attention kernel outputs so
-            # the backward never replays the O(T²) forward kernel, while the
-            # cheap matmul/elementwise chains still rematerialize
-            block_fn = jax.checkpoint(
-                block,
-                policy=jax.checkpoint_policies.save_only_these_names(
-                    "flash_o", "flash_lse"
-                ),
-            )
-        else:
-            block_fn = jax.checkpoint(block)
-    else:
-        block_fn = block
+    block_fn = attn_ops.remat_block(block, cfg.remat, cfg.remat_policy)
     x, _ = jax.lax.scan(block_fn, x, params["layers"])
 
     return L.rms_norm(x, params["final_norm"], cfg.norm_eps)

@@ -27,10 +27,14 @@ class MixtralConfig(llama_mod.LlamaConfig):
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
+    moe_dispatch: str = "gather"  # gather (indexed) | dense (GShard einsum)
 
     @property
     def moe(self) -> MoEConfig:
-        return MoEConfig(self.num_experts, self.top_k, self.capacity_factor)
+        return MoEConfig(
+            self.num_experts, self.top_k, self.capacity_factor,
+            dispatch=self.moe_dispatch,
+        )
 
     def num_params(self) -> int:
         base = super().num_params()
@@ -101,8 +105,8 @@ def sharding_rules(cfg: MixtralConfig) -> ShardingRules:
     ])
 
 
-def forward(params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None) -> tuple[jax.Array, dict]:
-    """tokens [B, T] → (logits [B, T, V], moe aux losses summed over layers)."""
+def hidden_states(params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None) -> tuple[jax.Array, dict]:
+    """tokens [B, T] → (final-norm hidden states [B, T, D], moe aux losses)."""
     B, T = tokens.shape
     Dh, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     cos, sin = L.rope_frequencies(Dh, T, cfg.rope_theta)
@@ -137,18 +141,33 @@ def forward(params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None) -> t
         return (x, aux_acc), None
 
     aux0 = {k: jnp.zeros((), jnp.float32) for k in ("moe_balance_loss", "moe_z_loss", "moe_dropped_frac")}
-    block_fn = jax.checkpoint(block) if cfg.remat else block
+    from tony_tpu.ops.attention import remat_block
+
+    block_fn = remat_block(block, cfg.remat, cfg.remat_policy)
     (x, aux), _ = jax.lax.scan(block_fn, (x, aux0), params["layers"])
 
-    x = L.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return L.rms_norm(x, params["final_norm"], cfg.norm_eps), aux
+
+
+def forward(params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None) -> tuple[jax.Array, dict]:
+    """tokens [B, T] → (logits [B, T, V], moe aux losses summed over layers)."""
+    x, aux = hidden_states(params, tokens, cfg, mesh)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"])
     return logits, aux
 
 
 def loss_fn(params: dict, batch: dict, cfg: MixtralConfig, mesh=None) -> tuple[jax.Array, dict]:
+    """With ``cfg.ce_chunk > 0`` the lm-head + CE fuse per sequence chunk so
+    the [B, T, V] logits never materialize (same scheme as llama.loss_fn)."""
     tokens = batch["tokens"]
-    logits, aux = forward(params, tokens[:, :-1], cfg, mesh)
-    ce, n = L.cross_entropy_loss(logits, tokens[:, 1:])
+    if cfg.ce_chunk > 0:
+        x, aux = hidden_states(params, tokens[:, :-1], cfg, mesh)
+        ce, n = L.chunked_cross_entropy_loss(
+            x, params["lm_head"], tokens[:, 1:], chunk=cfg.ce_chunk
+        )
+    else:
+        logits, aux = forward(params, tokens[:, :-1], cfg, mesh)
+        ce, n = L.cross_entropy_loss(logits, tokens[:, 1:])
     loss = ce + aux["moe_balance_loss"] + aux["moe_z_loss"]
     return loss, {"loss": loss, "ce_loss": ce, "tokens": n, **aux}
 

@@ -550,6 +550,29 @@ def _flash_bwd(causal, res, g):
 _flash_trainable.defvjp(_flash_fwd, _flash_bwd)
 
 
+def remat_block(block_fn, remat: bool, policy: str = "full"):
+    """Wrap a scanned decoder block in the configured remat policy.
+
+    Lives here because the "flash" policy pins THIS module's checkpoint
+    names (flash_o / flash_lse from _flash_fwd) — models must not hardcode
+    them. Policies: "full" (recompute everything), "dots" (save matmul
+    outputs), "flash" (save only the flash-kernel outputs so the backward
+    never replays the O(T²) forward kernel).
+    """
+    if not remat:
+        return block_fn
+    if policy == "dots":
+        return jax.checkpoint(
+            block_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    if policy == "flash":
+        return jax.checkpoint(
+            block_fn,
+            policy=jax.checkpoint_policies.save_only_these_names("flash_o", "flash_lse"),
+        )
+    return jax.checkpoint(block_fn)
+
+
 def mha(
     q: jax.Array,
     k: jax.Array,

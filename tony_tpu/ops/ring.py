@@ -195,14 +195,18 @@ def _ring_fwd_kernel(
             run_qb_loop()
 
         if s < n - 1:
-            # done reading slot `cur`: tell the LEFT neighbor (whose step-s+1
-            # RDMA targets our `cur`) it may overwrite it
+            rk.wait()
+            rv.wait()
+            # done reading slot `cur` — BOTH as compute input and as the
+            # outgoing RDMA source (rk/rv.wait() above confirms the send
+            # finished; signaling earlier would let the left neighbor
+            # overwrite the buffer mid-send). Tell the LEFT neighbor (whose
+            # step-s+1 RDMA targets our `cur`) it may overwrite it. No
+            # circular wait: the ready-wait chain grounds out at s=0.
             pltpu.semaphore_signal(
                 ready_sem.at[cur], inc=1, device_id={axis_name: left},
                 device_id_type=pltpu.DeviceIdType.MESH,
             )
-            rk.wait()
-            rv.wait()
 
 
 def _ring_fwd(q, k, v, axis_name: str, causal: bool, interpret: Any):

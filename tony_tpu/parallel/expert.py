@@ -26,6 +26,7 @@ class MoEConfig:
     capacity_factor: float = 1.25
     router_z_coef: float = 1e-3      # router z-loss (stability)
     aux_loss_coef: float = 1e-2      # load-balance loss
+    dispatch: str = "gather"         # gather (indexed, default) | dense (GShard einsum)
 
 
 def capacity(tokens_per_batch: int, cfg: MoEConfig) -> int:
@@ -33,14 +34,15 @@ def capacity(tokens_per_batch: int, cfg: MoEConfig) -> int:
     return max(c, cfg.top_k)
 
 
-def route(x: jax.Array, router_w: jax.Array, cfg: MoEConfig) -> tuple[jax.Array, jax.Array, dict]:
-    """Top-k routing with capacity.
+def _route_common(x: jax.Array, router_w: jax.Array, cfg: MoEConfig):
+    """Shared routing prefix of both dispatch schemes: gating + per-choice
+    capacity-slot assignment + aux losses (sans dropped-frac, which depends
+    on the dispatch representation).
 
-    x: [B, T, D]; router_w: [D, E] →
-    dispatch [B, T, E, C] bool-ish, combine [B, T, E, C] f32, aux losses.
-    """
+    Returns (gate_vals [B,T,K], gate_idx [B,T,K], onehot [B,T,K,E],
+    pos_in_expert [B,T,K,E], aux)."""
     B, T, _ = x.shape
-    E, C = cfg.num_experts, capacity(T, cfg)
+    E = cfg.num_experts
 
     logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), router_w.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)
@@ -54,13 +56,6 @@ def route(x: jax.Array, router_w: jax.Array, cfg: MoEConfig) -> tuple[jax.Array,
     onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)          # [B,T,K,E]
     flat = onehot.transpose(0, 2, 1, 3).reshape(B, cfg.top_k * T, E)  # k-major order
     pos_in_expert = (jnp.cumsum(flat, axis=1) - flat).reshape(B, cfg.top_k, T, E).transpose(0, 2, 1, 3)
-    within_cap = pos_in_expert < C                                   # [B,T,K,E]
-
-    slot_onehot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), C, dtype=jnp.float32)  # [B,T,K,E,C]
-    dispatch = (onehot * within_cap)[..., None] * slot_onehot        # [B,T,K,E,C]
-    combine = dispatch * gate_vals[..., None, None]
-    dispatch = dispatch.sum(axis=2)                                  # [B,T,E,C]
-    combine = combine.sum(axis=2)
 
     # aux losses: load-balance (Switch) + router z-loss
     me = probs.mean(axis=(0, 1))                                     # [E] mean prob
@@ -68,9 +63,86 @@ def route(x: jax.Array, router_w: jax.Array, cfg: MoEConfig) -> tuple[jax.Array,
     aux = {
         "moe_balance_loss": cfg.aux_loss_coef * E * jnp.sum(me * ce) * (1.0 / cfg.top_k),
         "moe_z_loss": cfg.router_z_coef * jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2),
-        "moe_dropped_frac": 1.0 - (dispatch.sum() / (B * T * cfg.top_k)),
     }
+    return gate_vals, gate_idx, onehot, pos_in_expert, aux
+
+
+def route(x: jax.Array, router_w: jax.Array, cfg: MoEConfig) -> tuple[jax.Array, jax.Array, dict]:
+    """Top-k routing with capacity (dense/GShard representation).
+
+    x: [B, T, D]; router_w: [D, E] →
+    dispatch [B, T, E, C] bool-ish, combine [B, T, E, C] f32, aux losses.
+    """
+    B, T, _ = x.shape
+    C = capacity(T, cfg)
+    gate_vals, _, onehot, pos_in_expert, aux = _route_common(x, router_w, cfg)
+    within_cap = pos_in_expert < C                                   # [B,T,K,E]
+
+    slot_onehot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), C, dtype=jnp.float32)  # [B,T,K,E,C]
+    dispatch = (onehot * within_cap)[..., None] * slot_onehot        # [B,T,K,E,C]
+    combine = dispatch * gate_vals[..., None, None]
+    dispatch = dispatch.sum(axis=2)                                  # [B,T,E,C]
+    combine = combine.sum(axis=2)
+    aux["moe_dropped_frac"] = 1.0 - (dispatch.sum() / (B * T * cfg.top_k))
     return dispatch, combine, aux
+
+
+def route_indices(x, router_w, cfg: MoEConfig):
+    """Top-k routing producing GATHER indices instead of dispatch tensors.
+
+    Returns (src [B, E, C] token index per expert slot, slot_valid
+    [B, E, C] 0/1, gate [B, E, C] combine weight, aux). Same capacity and
+    gating math as ``route`` (shared prefix), but the per-slot assignment is
+    expressed as indices, so dispatch/combine become a row gather and a
+    masked scatter-add — O(E·C·D) data movement instead of the
+    O(T·E·C·D) one-hot einsum FLOPs, and no [B,T,K,E,C] intermediate.
+    """
+    B, T, _ = x.shape
+    E, C = cfg.num_experts, capacity(T, cfg)
+    K = cfg.top_k
+
+    gate_vals, gate_idx, onehot, pos_in_expert, aux = _route_common(x, router_w, cfg)
+    pos_of_choice = jnp.sum(pos_in_expert * onehot, axis=-1).astype(jnp.int32)  # [B,T,K]
+    within_cap = pos_of_choice < C
+
+    # scatter each (t, k) choice into its (expert, slot) cell
+    expert_of_choice = gate_idx                                        # [B,T,K]
+    t_idx = jnp.broadcast_to(jnp.arange(T)[None, :, None], (B, T, K))
+    safe_slot = jnp.where(within_cap, pos_of_choice, C - 1)
+    src = jnp.zeros((B, E, C), jnp.int32)
+    valid = jnp.zeros((B, E, C), jnp.bool_)
+    gate = jnp.zeros((B, E, C), jnp.float32)
+
+    def scatter_b(src, valid, gate, e_i, s_i, t_i, w_i, ok_i):
+        # each (e, slot) receives at most one choice (slots are unique by
+        # construction); mode="drop" discards the masked duplicates at C-1
+        e_f, s_f, t_f = e_i.reshape(-1), s_i.reshape(-1), t_i.reshape(-1)
+        ok_f = ok_i.reshape(-1)
+        w_f = w_i.reshape(-1)
+        e_f = jnp.where(ok_f, e_f, cfg.num_experts)  # out-of-bounds → dropped
+        src = src.at[e_f, s_f].set(t_f, mode="drop")
+        valid = valid.at[e_f, s_f].set(True, mode="drop")
+        gate = gate.at[e_f, s_f].set(w_f, mode="drop")
+        return src, valid, gate
+
+    src, valid, gate = jax.vmap(scatter_b)(
+        src, valid, gate, expert_of_choice, safe_slot, t_idx, gate_vals, within_cap
+    )
+
+    aux["moe_dropped_frac"] = 1.0 - jnp.sum(valid).astype(jnp.float32) / (B * T * K)
+    return src, valid, gate, aux
+
+
+def _expert_mlp(xe, w_gate, w_up, w_down, mesh):
+    """xe [E, B, C, D] → [E, B, C, D] through each expert's SwiGLU."""
+    if mesh is not None:
+        xe = constrain(xe, mesh, P("expert", ("data", "fsdp"), None, None))
+    g = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", xe, w_gate))
+    u = jnp.einsum("ebcd,edf->ebcf", xe, w_up)
+    ye = jnp.einsum("ebcf,efd->ebcd", g * u, w_down)
+    if mesh is not None:
+        ye = constrain(ye, mesh, P("expert", ("data", "fsdp"), None, None))
+    return ye
 
 
 def moe_ffn(
@@ -87,18 +159,35 @@ def moe_ffn(
     x: [B, T, D]; router_w [D, E]; w_gate/w_up [E, D, F]; w_down [E, F, D].
     Expert weights shard P('expert', 'fsdp', 'model'); the dispatched-token
     tensor constrains to P(batch, 'expert', ...) so the exchange rides the
-    expert axis (ICI all-to-all).
+    expert axis (ICI all-to-all). Two dispatch schemes (cfg.dispatch):
+    "gather" (default) moves token rows by index; "dense" is the GShard
+    one-hot einsum pair (kept for parity/verification — same math).
     """
     dtype = x.dtype
-    dispatch, combine, aux = route(x, router_w, cfg)
+    if cfg.dispatch == "dense":
+        dispatch, combine, aux = route(x, router_w, cfg)
+        xe = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), x)  # [E,B,C,D]
+        ye = _expert_mlp(xe, w_gate, w_up, w_down, mesh)
+        y = jnp.einsum("ebcd,btec->btd", ye, combine.astype(dtype))
+        return y.astype(dtype), aux
+    if cfg.dispatch != "gather":
+        raise ValueError(f"dispatch must be 'gather' or 'dense', got {cfg.dispatch!r}")
 
-    xe = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), x)     # [E,B,C,D]
-    if mesh is not None:
-        xe = constrain(xe, mesh, P("expert", ("data", "fsdp"), None, None))
-    g = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", xe, w_gate))
-    u = jnp.einsum("ebcd,edf->ebcf", xe, w_up)
-    ye = jnp.einsum("ebcf,efd->ebcd", g * u, w_down)                 # [E,B,C,D]
-    if mesh is not None:
-        ye = constrain(ye, mesh, P("expert", ("data", "fsdp"), None, None))
-    y = jnp.einsum("ebcd,btec->btd", ye, combine.astype(dtype))
+    src, valid, gate, aux = route_indices(x, router_w, cfg)
+
+    def gather_b(xb, srcb):                                           # [T,D],[E,C]
+        return xb[srcb]                                               # [E,C,D]
+
+    xe = jax.vmap(gather_b)(x, src)                                   # [B,E,C,D]
+    xe = (xe * valid[..., None].astype(dtype)).transpose(1, 0, 2, 3)  # [E,B,C,D]
+    ye = _expert_mlp(xe, w_gate, w_up, w_down, mesh)
+    ye = ye.transpose(1, 0, 2, 3)                                     # [B,E,C,D]
+    w = jnp.where(valid, gate, 0.0).astype(dtype)
+
+    def combine_b(yeb, srcb, wb):
+        flat = (yeb * wb[..., None]).reshape(-1, yeb.shape[-1])       # [E*C, D]
+        out = jnp.zeros((x.shape[1], yeb.shape[-1]), flat.dtype)
+        return out.at[srcb.reshape(-1)].add(flat)                     # scatter-add
+
+    y = jax.vmap(combine_b)(ye, src, w)
     return y.astype(dtype), aux
