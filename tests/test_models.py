@@ -159,6 +159,23 @@ class TestBert:
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0]
 
+    def test_gathered_head_matches_dense(self):
+        # the gathered-positions MLM loss must equal the full-logits loss
+        # over the same mask
+        import jax.numpy as jnp
+
+        params = bert.init(KEY, self.cfg)
+        batch = bert.synthetic_batch(KEY, 4, 32, self.cfg)
+        dense = {
+            "tokens": batch["tokens"],
+            "targets": jnp.full_like(batch["tokens"], -100)
+            .at[jnp.arange(4)[:, None], batch["masked_pos"]]
+            .set(batch["masked_targets"]),
+        }
+        got, _ = bert.loss_fn(params, batch, self.cfg)
+        want, _ = bert.loss_fn(params, dense, self.cfg)
+        assert abs(float(got) - float(want)) < 1e-3
+
     def test_sharded_matches(self):
         params = bert.init(KEY, self.cfg)
         batch = bert.synthetic_batch(KEY, 4, 32, self.cfg)

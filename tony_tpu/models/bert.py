@@ -48,11 +48,17 @@ class BertConfig:
         per_layer = 4 * D * D + 4 * D + 2 * D * F + D + F + 4 * D
         return (V + self.max_seq + self.type_vocab) * D + 2 * D + self.n_layers * per_layer + D * V + V
 
-    def flops_per_token(self) -> int:
+    def flops_per_token(self, masked_frac: float | None = None) -> int:
         """Training FLOPs/token (PaLM convention, as train/metrics.py);
-        the attention term is NOT halved — bidirectional, no causal mask."""
+        the attention term is NOT halved — bidirectional, no causal mask.
+        With ``masked_frac``, the MLM-head matmul is counted only at the
+        masked positions actually projected (the gathered-positions path)."""
         attn = 12 * self.n_layers * self.d_model * self.max_seq
-        return 6 * self.num_params() + attn
+        flops = 6 * self.num_params() + attn
+        if masked_frac is not None:
+            head = self.d_model * self.vocab_size
+            flops -= int(6 * head * (1.0 - masked_frac))
+        return flops
 
 
 BERT_BASE = BertConfig()
@@ -106,8 +112,9 @@ def sharding_rules(cfg: BertConfig) -> ShardingRules:
     ])
 
 
-def forward(params: dict, tokens: jax.Array, cfg: BertConfig, mesh=None,
-            type_ids: jax.Array | None = None) -> jax.Array:
+def hidden_states(params: dict, tokens: jax.Array, cfg: BertConfig, mesh=None,
+                  type_ids: jax.Array | None = None) -> jax.Array:
+    """Encoder output [B, T, D] without the MLM head."""
     B, T = tokens.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     act_spec = P(BATCH_AXES, None, None)
@@ -143,11 +150,33 @@ def forward(params: dict, tokens: jax.Array, cfg: BertConfig, mesh=None,
 
     block_fn = jax.checkpoint(block) if cfg.remat else block
     x, _ = jax.lax.scan(block_fn, x, params["layers"])
+    return x
+
+
+def forward(params: dict, tokens: jax.Array, cfg: BertConfig, mesh=None,
+            type_ids: jax.Array | None = None) -> jax.Array:
+    """Full-vocab MLM logits [B, T, V] at every position."""
+    x = hidden_states(params, tokens, cfg, mesh, type_ids)
     return jnp.einsum("btd,dv->btv", x, params["mlm_head"]) + params["mlm_bias"]
 
 
 def loss_fn(params: dict, batch: dict, cfg: BertConfig, mesh=None) -> tuple[jax.Array, dict]:
-    """MLM loss; batch: tokens [B,T], targets [B,T] with -100 = unmasked."""
+    """MLM loss.
+
+    Two batch layouts:
+    - gathered (preferred): ``masked_pos`` [B, M] + ``masked_targets``
+      [B, M] — the MLM head projects ONLY the masked positions (as original
+      BERT does), skipping ~85% of the head matmul and never materializing
+      the [B, T, V] logits.
+    - dense: ``targets`` [B, T] with -100 = unmasked; full-logits path.
+    """
+    if "masked_pos" in batch:
+        x = hidden_states(params, batch["tokens"], cfg, mesh)
+        pos = batch["masked_pos"]                                     # [B, M]
+        xm = jnp.take_along_axis(x, pos[..., None], axis=1)           # [B, M, D]
+        logits = jnp.einsum("bmd,dv->bmv", xm, params["mlm_head"]) + params["mlm_bias"]
+        loss, n = L.cross_entropy_loss(logits, batch["masked_targets"])
+        return loss, {"loss": loss, "tokens": n}
     logits = forward(params, batch["tokens"], cfg, mesh)
     loss, n = L.cross_entropy_loss(logits, batch["targets"])
     return loss, {"loss": loss, "tokens": n}
@@ -155,6 +184,22 @@ def loss_fn(params: dict, batch: dict, cfg: BertConfig, mesh=None) -> tuple[jax.
 
 def synthetic_batch(key: jax.Array, batch_size: int, seq_len: int, cfg: BertConfig,
                     mask_frac: float = 0.15) -> dict:
+    """Gathered MLM layout: exactly M = round(mask_frac·T) masked positions
+    per row (fixed count = static shapes for the gathered-head loss path;
+    this is also how production BERT pipelines batch MLM)."""
+    k1, k2 = jax.random.split(key)
+    tokens = jax.random.randint(k1, (batch_size, seq_len), 0, cfg.vocab_size, jnp.int32)
+    M = max(1, round(seq_len * mask_frac))
+    # top-M of uniform noise = M distinct positions, sorted for locality
+    noise = jax.random.uniform(k2, (batch_size, seq_len))
+    pos = jnp.sort(jnp.argsort(noise, axis=-1)[:, :M], axis=-1).astype(jnp.int32)
+    targets = jnp.take_along_axis(tokens, pos, axis=1)
+    return {"tokens": tokens, "masked_pos": pos, "masked_targets": targets}
+
+
+def dense_synthetic_batch(key: jax.Array, batch_size: int, seq_len: int, cfg: BertConfig,
+                         mask_frac: float = 0.15) -> dict:
+    """Dense [B, T] targets layout (-100 = unmasked) for the full-logits path."""
     k1, k2 = jax.random.split(key)
     tokens = jax.random.randint(k1, (batch_size, seq_len), 0, cfg.vocab_size, jnp.int32)
     masked = jax.random.uniform(k2, (batch_size, seq_len)) < mask_frac

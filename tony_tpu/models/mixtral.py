@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from tony_tpu.models import llama as llama_mod
+from tony_tpu.ops import attention as attn_ops
 from tony_tpu.ops import layers as L
 from tony_tpu.parallel.expert import MoEConfig, moe_ffn
 from tony_tpu.parallel.sharding import ShardingRules, constrain
@@ -141,9 +142,7 @@ def hidden_states(params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None
         return (x, aux_acc), None
 
     aux0 = {k: jnp.zeros((), jnp.float32) for k in ("moe_balance_loss", "moe_z_loss", "moe_dropped_frac")}
-    from tony_tpu.ops.attention import remat_block
-
-    block_fn = remat_block(block, cfg.remat, cfg.remat_policy)
+    block_fn = attn_ops.remat_block(block, cfg.remat, cfg.remat_policy)
     (x, aux), _ = jax.lax.scan(block_fn, (x, aux0), params["layers"])
 
     return L.rms_norm(x, params["final_norm"], cfg.norm_eps), aux

@@ -570,6 +570,8 @@ def remat_block(block_fn, remat: bool, policy: str = "full"):
             block_fn,
             policy=jax.checkpoint_policies.save_only_these_names("flash_o", "flash_lse"),
         )
+    if policy != "full":
+        raise ValueError(f"remat_policy must be full|dots|flash, got {policy!r}")
     return jax.checkpoint(block_fn)
 
 
