@@ -187,6 +187,19 @@ class TestBert:
 
 
 class TestResNet:
+    def test_stem_s2d_matches_plain_conv(self):
+        import jax.numpy as jnp
+
+        img = jax.random.normal(KEY, (2, 64, 64, 3), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (7, 7, 3, 16), jnp.float32) * 0.1
+        from tony_tpu.models import resnet as R
+
+        np.testing.assert_allclose(
+            np.asarray(R._stem_conv_s2d(img, w)),
+            np.asarray(R._conv(img, w, 2)),
+            atol=1e-4, rtol=1e-4,
+        )
+
     cfg = resnet.RESNET_TINY
 
     def test_forward_and_bn_state(self):

@@ -72,9 +72,19 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
     step_fn = make_train_step(
         functools.partial(model_module.loss_fn, cfg=model_cfg, mesh=mesh), opt
     )
+    # gathered-MLM batches (BERT) project only the masked positions through
+    # the vocab head — derive the flops basis from an actual batch so the
+    # reported MFU matches the work done (same contract as bench.py)
+    probe = model_module.synthetic_batch(
+        jax.random.PRNGKey(0), 1, loop.seq_len, model_cfg
+    )
+    if "masked_pos" in probe:
+        fpt = model_cfg.flops_per_token(probe["masked_pos"].shape[1] / loop.seq_len)
+    else:
+        fpt = model_cfg.flops_per_token()
     meter = Throughput(
         tokens_per_step=loop.batch_size * loop.seq_len,
-        flops_per_token=model_cfg.flops_per_token(),
+        flops_per_token=fpt,
         n_chips=n_chips,
         peak_flops=detect_peak_flops(),
     )
