@@ -109,16 +109,11 @@ def run_bench(
         float(metrics["loss"])
     compile_s = time.perf_counter() - t_compile
 
-    # BERT's gathered-MLM head only projects the masked positions — count
-    # what is actually computed (honest MFU), deriving the fraction from
-    # the batch itself so bench and model can't drift
-    if "masked_pos" in batch_data:
-        fpt = cfg.flops_per_token(batch_data["masked_pos"].shape[1] / T)
-    else:
-        fpt = cfg.flops_per_token()
+    from tony_tpu.train.metrics import flops_per_token_for_batch
+
     meter = Throughput(
         tokens_per_step=B * T,
-        flops_per_token=fpt,
+        flops_per_token=flops_per_token_for_batch(cfg, batch_data, T),
         n_chips=n_dev,
         peak_flops=detect_peak_flops(),
     )

@@ -1,0 +1,90 @@
+"""Continuous-batching decode throughput (serving path, single chip).
+
+    python examples/serving/bench_decode.py --slots 8 --new-tokens 64 [--int8]
+
+Prints one JSON line with decode tokens/sec (all slots active, steady
+state) for the ~0.9B bench Llama. Weight-only int8 (ops/quant.py) halves
+the HBM bytes per token-step, which is what bounds batch-decode on TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from tony_tpu.models import llama
+from tony_tpu.models.serving import ContinuousBatcher
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--new-tokens", type=int, default=64)
+    p.add_argument("--max-len", type=int, default=512)
+    p.add_argument("--int8", action="store_true")
+    p.add_argument("--chunk", type=int, default=8)
+    p.add_argument("--preset", default="bench-1b")
+    args = p.parse_args()
+
+    cfg = (
+        dataclasses.replace(llama.LLAMA_1B, max_seq=args.max_len)
+        if args.preset == "bench-1b" else llama.PRESETS[args.preset]
+    )
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    if args.int8:
+        from tony_tpu.ops import quant
+
+        params, before, after = quant.quantize_tree(params)
+        print(f"[bench] int8: {before / 1e9:.2f} GB -> {after / 1e9:.2f} GB",
+              file=sys.stderr)
+
+    eng = ContinuousBatcher(
+        params, cfg, num_slots=args.slots, max_len=args.max_len,
+        decode_chunk=args.chunk,
+    )
+    rng = np.random.default_rng(0)
+    for _ in range(args.slots):
+        prompt = rng.integers(0, cfg.vocab_size, args.prompt_len).tolist()
+        eng.submit(prompt, max_new_tokens=args.new_tokens)
+
+    # admission (prefills) + decode-chunk compile warmup
+    for _ in range(2):
+        eng.step()
+
+    def produced():
+        return sum(len(r.out) for r in eng.running.values()) + sum(
+            len(v) for v in eng.done.values()
+        )
+
+    tok0 = produced()
+    t0 = time.perf_counter()
+    while eng.step():
+        pass
+    jax.block_until_ready(eng.tokens)
+    dt = time.perf_counter() - t0
+    n_tokens = produced() - tok0
+
+    out = {
+        "metric": "llama_decode_tokens_per_sec_1chip",
+        "value": round(n_tokens / dt, 1),
+        "unit": "tokens/sec/chip",
+        "slots": args.slots,
+        "decode_chunk": args.chunk,
+        "model_params": cfg.num_params(),
+        "int8": bool(args.int8),
+        "ms_per_token_step": round(1000 * dt / (n_tokens / args.slots), 2),
+        "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tony_tpu.models.generate import KVCache, _forward_with_cache, _sample, init_cache
 from tony_tpu.models.llama import LlamaConfig
@@ -52,10 +53,7 @@ def init_slot_cache(cfg: LlamaConfig, num_slots: int, max_len: int) -> SlotCache
     )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "temperature", "top_k"), donate_argnums=(1,)
-)
-def decode_step(
+def _decode_one(
     params, cache: SlotCache, tokens: jax.Array, key: jax.Array,
     cfg: LlamaConfig, temperature: float = 0.0, top_k: int = 0,
 ):
@@ -75,6 +73,30 @@ def decode_step(
     logits, new_k, new_v = jax.vmap(one)(tokens, cache.k, cache.v, cache.lengths)
     nxt = _sample(logits, key, temperature, top_k)
     return nxt, SlotCache(new_k, new_v, cache.lengths + 1)
+
+
+decode_step = functools.partial(jax.jit, static_argnames=("cfg", "temperature", "top_k"),
+                                donate_argnums=(1,))(_decode_one)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "n", "temperature", "top_k"), donate_argnums=(1,)
+)
+def decode_steps(
+    params, cache: SlotCache, tokens: jax.Array, key: jax.Array,
+    cfg: LlamaConfig, n: int, temperature: float = 0.0, top_k: int = 0,
+):
+    """``n`` decode steps in ONE compiled call (lax.scan): (tokens [S],
+    all tokens [n, S], cache'). Amortizes per-dispatch host overhead —
+    the dominant cost of single-token steps on remote/tunneled backends."""
+
+    def body(carry, k_step):
+        cache, toks = carry
+        nxt, cache = _decode_one(params, cache, toks, k_step, cfg, temperature, top_k)
+        return (cache, nxt), nxt
+
+    (cache, toks), seq = jax.lax.scan(body, (cache, tokens), jax.random.split(key, n))
+    return toks, seq, cache
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -106,6 +128,13 @@ class _Request:
     out: list[int] = field(default_factory=list)
     slot: int = -1
 
+    def is_done(self, eos_id: int) -> bool:
+        """THE termination predicate — budget spent or EOS emitted. Both the
+        chunk-drain loop and retirement consult this one method."""
+        return len(self.out) >= self.max_new_tokens or (
+            eos_id >= 0 and bool(self.out) and self.out[-1] == eos_id
+        )
+
 
 class ContinuousBatcher:
     """Slot-based continuous batching: admit → decode → retire, every step.
@@ -120,11 +149,15 @@ class ContinuousBatcher:
     def __init__(
         self, params, cfg: LlamaConfig, *, num_slots: int = 8, max_len: int = 512,
         eos_id: int = -1, temperature: float = 0.0, top_k: int = 0,
-        key: jax.Array | None = None,
+        key: jax.Array | None = None, decode_chunk: int = 8,
     ):
         self.params, self.cfg = params, cfg
         self.S, self.max_len, self.eos_id = num_slots, max_len, eos_id
         self.temperature, self.top_k = temperature, top_k
+        # decode this many tokens per compiled call (clamped to the smallest
+        # remaining budget so no request overshoots); >1 amortizes host
+        # dispatch overhead at the cost of admission latency for new arrivals
+        self.decode_chunk = max(1, decode_chunk)
         self.cache = init_slot_cache(cfg, num_slots, max_len)
         self.tokens = jnp.zeros((num_slots,), jnp.int32)  # last token per slot
         self.key = key if key is not None else jax.random.PRNGKey(0)
@@ -180,29 +213,37 @@ class ContinuousBatcher:
             self._retire_if_done(req)  # 1-token requests finish at admission
 
     def _split(self):
+        if self.temperature == 0.0:
+            return self.key  # greedy sampling never consumes the key
         self.key, sub = jax.random.split(self.key)
         return sub
 
     def _retire_if_done(self, req: _Request):
-        if req.slot in self.running and (
-            len(req.out) >= req.max_new_tokens
-            or (self.eos_id >= 0 and req.out and req.out[-1] == self.eos_id)
-        ):
+        if req.slot in self.running and req.is_done(self.eos_id):
             del self.running[req.slot]
             self.done[req.rid] = req.out
 
     def step(self) -> bool:
-        """Admit + one decode step. Returns True while work remains."""
+        """Admit + one decode chunk. Returns True while work remains."""
         self._admit()
         if not self.running:
             return bool(self.pending)
-        nxt, self.cache = decode_step(
-            self.params, self.cache, self.tokens, self._split(), self.cfg,
+        # constant chunk height = ONE compiled decode variant; slots whose
+        # request finishes mid-chunk simply discard the overshoot tokens
+        # (their cache writes clamp at maxT-1 and the slot is fully
+        # overwritten at its next admission)
+        h = self.decode_chunk
+        toks, seq, self.cache = decode_steps(
+            self.params, self.cache, self.tokens, self._split(), self.cfg, h,
             self.temperature, self.top_k,
         )
-        self.tokens = nxt
+        self.tokens = toks
+        seq_host = np.asarray(seq)  # [h, S]: ONE device→host transfer
         for slot, req in list(self.running.items()):
-            req.out.append(int(nxt[slot]))
+            for i in range(h):
+                req.out.append(int(seq_host[i, slot]))
+                if req.is_done(self.eos_id):
+                    break  # post-budget/post-EOS chunk tokens are discarded
             self._retire_if_done(req)
         return bool(self.running or self.pending)
 

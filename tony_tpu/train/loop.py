@@ -20,7 +20,7 @@ import jax
 from tony_tpu.parallel import MeshSpec
 from tony_tpu.runtime import init_distributed
 from tony_tpu.train.checkpoint import restore_or_init
-from tony_tpu.train.metrics import detect_peak_flops
+from tony_tpu.train.metrics import detect_peak_flops, flops_per_token_for_batch
 from tony_tpu.train.profiling import StepProfiler
 from tony_tpu.train.trainer import OptimizerConfig, Throughput, make_train_step, sharded_init
 
@@ -74,17 +74,13 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
     )
     # gathered-MLM batches (BERT) project only the masked positions through
     # the vocab head — derive the flops basis from an actual batch so the
-    # reported MFU matches the work done (same contract as bench.py)
+    # reported MFU matches the work done (shared helper with bench.py)
     probe = model_module.synthetic_batch(
         jax.random.PRNGKey(0), 1, loop.seq_len, model_cfg
     )
-    if "masked_pos" in probe:
-        fpt = model_cfg.flops_per_token(probe["masked_pos"].shape[1] / loop.seq_len)
-    else:
-        fpt = model_cfg.flops_per_token()
     meter = Throughput(
         tokens_per_step=loop.batch_size * loop.seq_len,
-        flops_per_token=fpt,
+        flops_per_token=flops_per_token_for_batch(model_cfg, probe, loop.seq_len),
         n_chips=n_chips,
         peak_flops=detect_peak_flops(),
     )

@@ -30,6 +30,16 @@ def detect_peak_flops(device=None) -> float:
     return PEAK_FLOPS["cpu"]
 
 
+def flops_per_token_for_batch(model_cfg, batch: dict, seq_len: int) -> int:
+    """The model's flops/token ON THIS BATCH LAYOUT — the one place that
+    knows gathered-MLM batches (``masked_pos``) only project the masked
+    fraction through the vocab head. Both bench.py and the training loop
+    derive their MFU basis here so they cannot drift."""
+    if "masked_pos" in batch:
+        return model_cfg.flops_per_token(batch["masked_pos"].shape[1] / seq_len)
+    return model_cfg.flops_per_token()
+
+
 def transformer_flops_per_token(
     n_params: int, n_layers: int, d_model: int, seq_len: int, *, training: bool = True
 ) -> int:
