@@ -55,8 +55,7 @@ def main() -> int:
         eng.submit(prompt, max_new_tokens=args.new_tokens)
 
     # admission (prefills) + decode-chunk compile warmup
-    for _ in range(2):
-        eng.step()
+    eng.step()
 
     def produced():
         return sum(len(r.out) for r in eng.running.values()) + sum(
@@ -70,6 +69,9 @@ def main() -> int:
     jax.block_until_ready(eng.tokens)
     dt = time.perf_counter() - t0
     n_tokens = produced() - tok0
+    if n_tokens <= 0:
+        sys.exit(f"nothing left to measure after warmup: raise --new-tokens "
+                 f"above {1 + eng.decode_chunk} or lower --chunk")
 
     out = {
         "metric": "llama_decode_tokens_per_sec_1chip",

@@ -1,0 +1,17 @@
+"""Fixture: assert the multi-slice pool placement contract.
+
+Every task must see TPU_SLICE_ID in [0, TPU_NUM_SLICES), chip coords, and
+the per-slice topology; prints its slice id so the test can check the gang
+actually spanned slices.
+"""
+
+import os
+import sys
+
+slice_id = int(os.environ["TPU_SLICE_ID"])
+num_slices = int(os.environ["TPU_NUM_SLICES"])
+assert 0 <= slice_id < num_slices, (slice_id, num_slices)
+assert os.environ["TPU_CHIP_COORDS"], "chip coords missing"
+assert "x" in os.environ["TPU_SLICE_TOPOLOGY"]
+print(f"SLICE_PLACEMENT {os.environ['JOB_NAME']}:{os.environ['TASK_INDEX']} -> {slice_id}")
+sys.exit(0)

@@ -279,3 +279,44 @@ class TestSchedulingE2E:
         )
         assert final == JobStatus.FAILED
         assert "memory" in (handle.final_status().get("reason") or "")
+
+
+@pytest.mark.e2e
+class TestMultiSlicePool:
+    def test_gang_spans_slices_with_placement_env(self, tmp_tony_root):
+        # 4 workers x 4 chips on a pool of two v5e-8 slices: the gang MUST
+        # spill onto the second slice, and every task sees the slice contract
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {
+                "tony.worker.instances": "4",
+                "tony.worker.chips": "4",
+                keys.TPU_POOL_SPEC: "pool:v5e-8x2",
+                keys.EXECUTES: fixture_cmd("check_slice_env.py"),
+            },
+        )
+        assert final == JobStatus.SUCCEEDED, handle.final_status()
+        app_dir = os.path.join(str(tmp_tony_root), handle.app_id)
+        placements = set()
+        for root, _, files in os.walk(app_dir):
+            for f in files:
+                if f == "stdout.log":
+                    with open(os.path.join(root, f)) as fh:
+                        for line in fh:
+                            if line.startswith("SLICE_PLACEMENT"):
+                                placements.add(line.strip().split(" -> ")[1])
+        assert placements == {"0", "1"}, placements
+
+    def test_pool_too_small_fails_cleanly(self, tmp_tony_root):
+        # a 16-chip task cannot fit an 8-chip slice: allocation must fail the
+        # job (DCN-spanning single tasks are rejected), not hang the gang
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {
+                "tony.worker.instances": "1",
+                "tony.worker.chips": "16",
+                keys.TPU_POOL_SPEC: "pool:v5e-8x2",
+                keys.EXECUTES: fixture_cmd("exit_0.py"),
+            },
+        )
+        assert final == JobStatus.FAILED

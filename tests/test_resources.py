@@ -1,6 +1,9 @@
 """TPU-slice resource model tests: topology parsing, ICI-contiguous rectangle
 allocation (the GPU-scheduling analog of TestTaskScheduler, SURVEY.md §4)."""
 
+import os
+import time
+
 import pytest
 
 from tony_tpu.cluster.resources import (
@@ -108,3 +111,109 @@ class TestLocalResourceManager:
         rm = LocalResourceManager("local:cpu")
         with pytest.raises(AllocationError):
             rm.allocate("worker", 0, Resources(chips=4))
+
+
+class TestMultiSlicePool:
+    def _rm(self, spec="pool:v5e-8x2"):
+        from tony_tpu.cluster.resources import MultiSliceResourceManager
+
+        return MultiSliceResourceManager(spec)
+
+    def test_spec_parse_and_env(self):
+        rm = self._rm("pool:v5e-8x4")
+        assert rm.num_slices == 4
+        assert rm.slices[0].spec.chips == 8
+        c = rm.allocate("worker", 0, Resources(chips=4))
+        assert c.slice_name == "v5e-8"
+        assert rm.slice_of(c) in range(4)
+
+    def test_bad_specs_rejected(self):
+        import pytest as _pytest
+
+        for bad in ("pool:v5e-8", "pool:x", "pool:v5e-0x2"):
+            with _pytest.raises(ValueError):
+                self._rm(bad)
+
+    def test_best_fit_packs_one_slice_first(self):
+        rm = self._rm("pool:v5e-8x2")
+        a = rm.allocate("worker", 0, Resources(chips=4))
+        b = rm.allocate("worker", 1, Resources(chips=4))
+        # both fit slice 0 exactly — best-fit must co-locate them
+        assert rm.slice_of(a) == rm.slice_of(b)
+
+    def test_spill_to_second_slice(self):
+        rm = self._rm("pool:v5e-8x2")
+        cs = [rm.allocate("worker", i, Resources(chips=4)) for i in range(4)]
+        slices = {rm.slice_of(c) for c in cs}
+        assert slices == {0, 1}  # 4x4 chips over two 8-chip slices
+
+    def test_task_larger_than_slice_rejected(self):
+        rm = self._rm("pool:v5e-8x2")
+        with pytest.raises(AllocationError, match="span DCN"):
+            rm.allocate("worker", 0, Resources(chips=16))
+
+    def test_pool_exhaustion(self):
+        rm = self._rm("pool:v5e-4x2")
+        rm.allocate("w", 0, Resources(chips=4))
+        rm.allocate("w", 1, Resources(chips=4))
+        with pytest.raises(AllocationError, match="no slice"):
+            rm.allocate("w", 2, Resources(chips=1))
+
+    def test_release_refills_slice(self):
+        rm = self._rm("pool:v5e-4x2")
+        a = rm.allocate("w", 0, Resources(chips=4))
+        rm.allocate("w", 1, Resources(chips=4))
+        rm.release(a)
+        c = rm.allocate("w", 2, Resources(chips=4))
+        assert rm.slice_of(c) == 0 or rm.slice_of(c) == 1
+
+    def test_slice_env_injected_at_start(self, tmp_path):
+        import sys as _sys
+
+        rm = self._rm("pool:v5e-4x2")
+        c = rm.allocate("w", 0, Resources(chips=4))
+        rm.allocate("w", 1, Resources(chips=4))  # spills → gang spans 2 slices
+        out = tmp_path / "env.txt"
+        rm.start_container(
+            c,
+            [_sys.executable, "-c",
+             "import os;open(r'%s','w').write(os.environ['TPU_SLICE_ID']+' '+os.environ['TPU_NUM_SLICES'])" % out],
+            {"PATH": os.environ.get("PATH", "")},
+            str(tmp_path / "logs"),
+        )
+        for _ in range(100):
+            if rm.poll_exited():
+                break
+            time.sleep(0.05)
+        assert out.read_text() == "0 2"
+        rm.shutdown()
+
+    def test_hosts_per_slice(self):
+        rm = self._rm("pool:v5e-8x2")
+        assert len(rm.slices[0].hosts) == 2  # 8 chips / 4 per host
+        c = rm.allocate("w", 0, Resources(chips=8))
+        assert c.host.startswith("slice")
+
+    def test_gang_span_not_pool_size(self, tmp_path):
+        # a gang packed into ONE slice of a 4-slice pool is all-ICI: its env
+        # must say num_slices=1 (pool size would force a bogus hybrid mesh)
+        import sys as _sys
+
+        rm = self._rm("pool:v5e-8x4")
+        a = rm.allocate("w", 0, Resources(chips=4))
+        b = rm.allocate("w", 1, Resources(chips=4))
+        assert rm.gang_slice_span() == [rm.slice_of(a)]
+        out = tmp_path / "env.txt"
+        rm.start_container(
+            b,
+            [_sys.executable, "-c",
+             "import os;open(r'%s','w').write(os.environ['TPU_SLICE_ID']+' '+os.environ['TPU_NUM_SLICES'])" % out],
+            {"PATH": os.environ.get("PATH", "")},
+            str(tmp_path / "logs"),
+        )
+        for _ in range(100):
+            if rm.poll_exited():
+                break
+            time.sleep(0.05)
+        assert out.read_text() == "0 1"
+        rm.shutdown()

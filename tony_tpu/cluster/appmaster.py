@@ -46,14 +46,21 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 
 
 def build_resource_manager(config: TonyConfig) -> ResourceManager:
-    """Pool factory: 'local:<accel>[,RxC]' → LocalResourceManager.
+    """Pool factory from ``tony.tpu.pool``:
+    - 'local:<accel>[,RxC]' → LocalResourceManager (one host, one slice),
+    - 'pool:<accel>-<chips>x<num_slices>' → MultiSliceResourceManager
+      (several ICI slices joined by DCN, best-fit gang packing).
 
-    The spec string lives in ``tony.tpu.pool`` so the same frozen config
-    drives tests (cpu pool), one TPU VM (v5e-1..8), or a future remote pool.
+    The spec string lives in the frozen config so the same artifact drives
+    tests (cpu pool), one TPU VM, or a multi-slice pool.
     """
     spec = config.get(keys.TPU_POOL_SPEC) or "local:cpu"
     if spec.startswith("local:"):
         return LocalResourceManager(spec)
+    if spec.startswith("pool:"):
+        from tony_tpu.cluster.resources import MultiSliceResourceManager
+
+        return MultiSliceResourceManager(spec)
     raise ValueError(f"unknown resource pool spec: {spec!r}")
 
 

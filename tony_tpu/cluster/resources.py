@@ -261,7 +261,71 @@ class ResourceManager(ABC):
     def shutdown(self) -> None: ...
 
 
-class LocalResourceManager(ResourceManager):
+class ProcessContainerMixin:
+    """Shared container realization: each container is a local subprocess in
+    its own process group with per-container stdio capture. Both the
+    single-host RM and the multi-slice pool emulation launch this way (a
+    real multi-host pool subclasses and launches over its fabric instead —
+    the AM never knows the difference)."""
+
+    _procs: dict[str, subprocess.Popen]
+    _reported: set[str]
+    _lock: threading.Lock
+
+    def start_container(
+        self, container: Container, command: list[str], env: dict[str, str], log_dir: str
+    ) -> None:
+        os.makedirs(log_dir, exist_ok=True)
+        if env.get(constants.ENV_CONTAINER_RUNTIME_TYPE) == "docker":
+            command = _docker_wrap(command, env)
+        with open(os.path.join(log_dir, "stdout.log"), "ab") as stdout, open(
+            os.path.join(log_dir, "stderr.log"), "ab"
+        ) as stderr:
+            proc = subprocess.Popen(
+                command,
+                env=env,
+                stdout=stdout,
+                stderr=stderr,
+                start_new_session=True,  # own process group → clean kill of user subtree
+            )
+        with self._lock:
+            self._procs[container.id] = proc
+
+    def poll_exited(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        with self._lock:
+            for cid, proc in self._procs.items():
+                if cid in self._reported:
+                    continue
+                rc = proc.poll()
+                if rc is not None:
+                    out[cid] = rc
+                    self._reported.add(cid)
+        return out
+
+    def kill_container(self, container: Container) -> None:
+        with self._lock:
+            proc = self._procs.get(container.id)
+        if proc and proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                try:
+                    proc.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    def _live_containers(self) -> list[Container]:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        for c in self._live_containers():
+            self.kill_container(c)
+            self.release(c)
+
+
+class LocalResourceManager(ProcessContainerMixin, ResourceManager):
     """Process-per-container RM on one host (MiniCluster analog, SURVEY.md §4).
 
     Models a single TPU VM pool (or a pure-CPU pool for tests): one logical
@@ -319,53 +383,163 @@ class LocalResourceManager(ResourceManager):
             self.host.used_memory -= container.resources.memory_bytes
             self.host.used_vcores -= container.resources.vcores
 
+    def _live_containers(self) -> list[Container]:
+        with self._lock:
+            return list(self._containers.values())
+
+
+@dataclass
+class _PoolSlice:
+    """One ICI island in a multi-slice pool."""
+
+    slice_id: int
+    spec: SliceSpec
+    grid: ChipGrid
+    hosts: list[_Host]
+
+    def host_of(self, coords: tuple[tuple[int, int], ...]) -> _Host:
+        """The host owning a rect's first chip (chips are tiled onto hosts
+        row-major, DEFAULT_CHIPS_PER_HOST per host)."""
+        if not coords:
+            return self.hosts[0]
+        r, c = coords[0]
+        linear = r * self.spec.topology[1] + c
+        return self.hosts[min(linear // DEFAULT_CHIPS_PER_HOST, len(self.hosts) - 1)]
+
+
+class MultiSliceResourceManager(ProcessContainerMixin, ResourceManager):
+    """A pool of SEVERAL ICI slices joined by DCN (the multi-slice analog of
+    a YARN cluster with several racks). Spec: ``pool:v5e-64x4`` = four
+    v5e-64 slices.
+
+    Placement policy:
+    - a chip ask is always satisfied INSIDE one slice as a contiguous
+      rectangle (the ICI invariant — `tony.tpu.ici-strict`); asks larger
+      than a slice are rejected with a clear error,
+    - best-fit across slices: the fullest slice that still fits takes the
+      task, so gangs pack into as few slices as possible and data-parallel
+      replicas spill onto the next slice only when one fills — exactly the
+      DP-over-DCN / TP-CP-EP-over-ICI split the mesh layer assumes,
+    - every container env carries its slice id and the pool's slice count
+      (``TPU_SLICE_ID`` / ``TPU_NUM_SLICES``) so runtimes can build
+      ``MeshSpec(num_slices=...)`` with DCN-safe axis placement.
+
+    Containers are realized as local subprocesses (the pool *scheduling*
+    model is the thing under test without multi-host hardware); a real
+    deployment overrides the launch methods with its fabric.
+    """
+
+    def __init__(
+        self,
+        pool_spec: str = "pool:v5e-8x2",
+        host_memory: str = "64g",
+        host_vcores: int = 64,
+    ):
+        _, _, spec = pool_spec.partition(":")
+        base, _, count = spec.rpartition("x")
+        if not base or not count.isdigit():
+            raise ValueError(
+                f"multi-slice pool spec must look like 'pool:v5e-64x4', got {pool_spec!r}"
+            )
+        self.num_slices = int(count)
+        slice_spec = SliceSpec.parse(base)
+        if self.num_slices < 1 or slice_spec.chips < 1:
+            raise ValueError(f"degenerate pool spec {pool_spec!r}")
+        self.slices = []
+        for s in range(self.num_slices):
+            n_hosts = max(1, slice_spec.chips // DEFAULT_CHIPS_PER_HOST)
+            hosts = [
+                _Host(f"slice{s}-host{h}", parse_memory_string(host_memory), host_vcores)
+                for h in range(n_hosts)
+            ]
+            self.slices.append(
+                _PoolSlice(s, slice_spec, ChipGrid(slice_spec.topology), hosts)
+            )
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._containers: dict[str, tuple[Container, int, _Host]] = {}
+        self._reported: set[str] = set()
+        self._lock = threading.Lock()
+
+    def allocate(self, job_type: str, task_index: int, resources: Resources) -> Container:
+        chips = resources.chips
+        per_slice = self.slices[0].spec.chips
+        if chips > per_slice:
+            raise AllocationError(
+                f"{job_type}:{task_index} asks {chips} chips but a slice has "
+                f"{per_slice}: a task may not span DCN (shard the job into "
+                f"per-slice tasks and let data/pipeline axes cross slices)"
+            )
+        with self._lock:
+            # best-fit: fullest slice that still fits → gangs pack tightly
+            order = sorted(self.slices, key=lambda s: s.grid.free)
+            for sl in order:
+                if chips and sl.grid.free < chips:
+                    continue
+                coords = sl.grid.allocate_chips(chips)
+                if coords is None and chips:
+                    continue
+                host = sl.host_of(coords or ())
+                if (
+                    host.used_memory + resources.memory_bytes > host.memory_bytes
+                    or host.used_vcores + resources.vcores > host.vcores
+                ):
+                    if coords:
+                        sl.grid.release(coords)
+                    continue
+                host.used_memory += resources.memory_bytes
+                host.used_vcores += resources.vcores
+                c = Container(
+                    id=f"container_{uuid.uuid4().hex[:12]}",
+                    host=host.name,
+                    resources=resources,
+                    chip_coords=coords or (),
+                    slice_name=sl.spec.name,
+                    slice_topology=sl.spec.topology,
+                    job_type=job_type,
+                    task_index=task_index,
+                )
+                self._containers[c.id] = (c, sl.slice_id, host)
+                return c
+            raise AllocationError(
+                f"no slice can host {job_type}:{task_index} "
+                f"({chips} chips; free per slice: "
+                f"{[s.grid.free for s in self.slices]})"
+            )
+
+    def slice_of(self, container: Container) -> int:
+        with self._lock:
+            return self._containers[container.id][1]
+
+    def release(self, container: Container) -> None:
+        with self._lock:
+            entry = self._containers.pop(container.id, None)
+            if entry is None:
+                return
+            c, slice_id, host = entry
+            self.slices[slice_id].grid.release(c.chip_coords)
+            host.used_memory -= c.resources.memory_bytes
+            host.used_vcores -= c.resources.vcores
+
+    def gang_slice_span(self) -> list[int]:
+        """Distinct slice ids the CURRENT allocations occupy, sorted. One AM
+        owns one application, and the scheduler allocates the whole gang
+        before starting any container, so at start time this is the job's
+        DCN span."""
+        with self._lock:
+            return sorted({sid for _, sid, _ in self._containers.values()})
+
     def start_container(
         self, container: Container, command: list[str], env: dict[str, str], log_dir: str
     ) -> None:
-        os.makedirs(log_dir, exist_ok=True)
-        if env.get(constants.ENV_CONTAINER_RUNTIME_TYPE) == "docker":
-            command = _docker_wrap(command, env)
-        with open(os.path.join(log_dir, "stdout.log"), "ab") as stdout, open(
-            os.path.join(log_dir, "stderr.log"), "ab"
-        ) as stderr:
-            proc = subprocess.Popen(
-                command,
-                env=env,
-                stdout=stdout,
-                stderr=stderr,
-                start_new_session=True,  # own process group → clean kill of user subtree
-            )
-        with self._lock:
-            self._procs[container.id] = proc
+        # the env carries the GANG's slice layout, not the pool's: a gang
+        # packed into one slice of a 4-slice pool is all-ICI and must build
+        # a plain (non-hybrid) mesh — slice ids are densified over the span
+        span = self.gang_slice_span()
+        env = dict(env)
+        env[constants.ENV_TPU_SLICE_ID] = str(span.index(self.slice_of(container)))
+        env[constants.ENV_TPU_NUM_SLICES] = str(len(span))
+        super().start_container(container, command, env, log_dir)
 
-    def poll_exited(self) -> dict[str, int]:
-        out: dict[str, int] = {}
+    def _live_containers(self) -> list[Container]:
         with self._lock:
-            for cid, proc in self._procs.items():
-                if cid in self._reported:
-                    continue
-                rc = proc.poll()
-                if rc is not None:
-                    out[cid] = rc
-                    self._reported.add(cid)
-        return out
-
-    def kill_container(self, container: Container) -> None:
-        with self._lock:
-            proc = self._procs.get(container.id)
-        if proc and proc.poll() is None:
-            try:
-                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
-                try:
-                    proc.wait(timeout=3)
-                except subprocess.TimeoutExpired:
-                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-
-    def shutdown(self) -> None:
-        with self._lock:
-            containers = list(self._containers.values())
-        for c in containers:
-            self.kill_container(c)
-            self.release(c)
+            return [c for c, _, _ in self._containers.values()]

@@ -89,6 +89,8 @@ ENV_TPU_SLICE_NAME = "TPU_SLICE_NAME"             # e.g. "v5e-64"
 ENV_TPU_SLICE_TOPOLOGY = "TPU_SLICE_TOPOLOGY"     # e.g. "8x8"
 ENV_TPU_CHIP_COORDS = "TPU_CHIP_COORDS"           # this task's chip coords within slice, JSON
 ENV_TPU_CHIPS_PER_TASK = "TPU_CHIPS_PER_TASK"
+ENV_TPU_SLICE_ID = "TPU_SLICE_ID"                 # which pool slice this task landed on (0-based)
+ENV_TPU_NUM_SLICES = "TPU_NUM_SLICES"             # slices in the pool (DCN groups for MeshSpec)
 
 # ---------------------------------------------------------------------------
 # Task types with built-in behavior (analog: Constants.java well-known job names)

@@ -154,9 +154,9 @@ class ContinuousBatcher:
         self.params, self.cfg = params, cfg
         self.S, self.max_len, self.eos_id = num_slots, max_len, eos_id
         self.temperature, self.top_k = temperature, top_k
-        # decode this many tokens per compiled call (clamped to the smallest
-        # remaining budget so no request overshoots); >1 amortizes host
-        # dispatch overhead at the cost of admission latency for new arrivals
+        # decode this many tokens per compiled call; requests finishing
+        # mid-chunk simply DISCARD their overshoot tokens (see step()). >1
+        # amortizes host dispatch overhead at the cost of admission latency
         self.decode_chunk = max(1, decode_chunk)
         self.cache = init_slot_cache(cfg, num_slots, max_len)
         self.tokens = jnp.zeros((num_slots,), jnp.int32)  # last token per slot

@@ -11,12 +11,14 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import sys
 import time
 from dataclasses import dataclass
 
 import jax
 
+from tony_tpu import constants
 from tony_tpu.parallel import MeshSpec
 from tony_tpu.runtime import init_distributed
 from tony_tpu.train.checkpoint import restore_or_init
@@ -51,7 +53,10 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
     spec = MeshSpec.auto(
         model=loop.model_axis, context=loop.context_axis, expert=loop.expert_axis
     )
-    mesh = spec.build()
+    # multi-slice pools (MultiSliceResourceManager) announce the DCN layout;
+    # build() then restricts DCN crossings to data/pipeline axes
+    num_slices = int(os.environ.get(constants.ENV_TPU_NUM_SLICES, "1") or "1")
+    mesh = spec.build(num_slices=num_slices)
     n_chips = len(jax.devices())
 
     opt_cfg = OptimizerConfig(
