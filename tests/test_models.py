@@ -82,6 +82,33 @@ class TestLlama:
             )
             assert abs(float(got) - float(want)) < 0.05, (spec, float(got), float(want))
 
+    def test_ulysses_cp_matches_single_device(self):
+        import dataclasses as dc
+
+        cfg = dc.replace(self.cfg, cp_impl="ulysses")
+        params = llama.init(KEY, cfg)
+        batch = llama.synthetic_batch(KEY, 4, 32, cfg)
+        want, _ = llama.loss_fn(params, batch, self.cfg)
+        mesh = MeshSpec(context=4, model=2).build()
+        sharded = jax.device_put(
+            params, llama.sharding_rules(cfg).sharding_tree(params, mesh)
+        )
+        got, _ = jax.jit(functools.partial(llama.loss_fn, cfg=cfg, mesh=mesh))(
+            sharded, batch
+        )
+        assert abs(float(got) - float(want)) < 0.05
+
+    def test_ulysses_cp_head_divisibility_validated(self):
+        import dataclasses as dc
+
+        cfg = dc.replace(self.cfg, cp_impl="ulysses", n_heads=3, n_kv_heads=3)
+        mesh = MeshSpec(context=2, data=4).build()
+        with pytest.raises(ValueError, match="divisible"):
+            llama._attention(
+                jnp.zeros((1, 3, 8, 4)), jnp.zeros((1, 3, 8, 4)),
+                jnp.zeros((1, 3, 8, 4)), cfg, mesh,
+            )
+
     def test_grad_accumulation_matches_full_batch(self):
         cfg = self.cfg
         params = llama.init(KEY, cfg)

@@ -103,6 +103,25 @@ def test_pallas_ring_multi_tile():
     np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
+def test_pallas_ring_eight_devices():
+    # n=8: seven ring rotations → the per-neighbor ready/parity handshake
+    # cycles both slots repeatedly (race detection is on in interpret mode)
+    from tony_tpu.ops.ring import ring_attention_pallas
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("context",))
+    q, k, v = _mk_qkv(H=2, Hkv=1, T=512, seed=13)
+    ring = _shard_ring(
+        functools.partial(
+            ring_attention_pallas, axis_name="context", causal=True,
+            interpret=_interpret_params(),
+        ),
+        mesh,
+    )
+    out = ring(q, k, v)
+    want = attention_reference(q, repeat_kv(k, 2), repeat_kv(v, 2), causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
 def test_pallas_ring_backward():
     from tony_tpu.ops.ring import ring_attention_pallas
 

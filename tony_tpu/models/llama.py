@@ -46,7 +46,7 @@ class LlamaConfig:
     remat: bool = True
     remat_policy: str = "full"  # full | dots (save matmul outputs, recompute the rest)
     attn_impl: str = "auto"   # auto | flash | reference
-    cp_impl: str = "xla"      # context-parallel ring: xla (scan+ppermute) | pallas (remote-DMA kernel)
+    cp_impl: str = "xla"      # context parallel: xla (ppermute ring) | pallas (remote-DMA ring) | ulysses (all-to-all)
     ce_chunk: int = 512       # fused lm-head+CE chunk length; 0 = materialize logits
 
     @property
@@ -137,12 +137,16 @@ def sharding_rules(cfg: LlamaConfig) -> ShardingRules:
 
 
 def _attention(q, k, v, cfg: LlamaConfig, mesh) -> jax.Array:
-    """Dispatch: ring attention when the context axis is real, else fused MHA.
+    """Dispatch: context-parallel attention (cfg.cp_impl: XLA ring,
+    Pallas remote-DMA ring, or Ulysses all-to-all) when the context axis is
+    real, else fused single-device MHA.
 
     q: [B, H, T, Dh]; k/v: [B, Hkv, T, Dh].
     """
-    if cfg.cp_impl not in ("xla", "pallas"):
-        raise ValueError(f"cp_impl must be 'xla' or 'pallas', got {cfg.cp_impl!r}")
+    if cfg.cp_impl not in ("xla", "pallas", "ulysses"):
+        raise ValueError(
+            f"cp_impl must be 'xla', 'pallas', or 'ulysses', got {cfg.cp_impl!r}"
+        )
     if mesh is not None and mesh.shape.get("context", 1) > 1:
         if cfg.cp_impl == "pallas":
             # remote-DMA ring kernel: GQA-native (KV stays at Hkv width on
@@ -171,11 +175,33 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh) -> jax.Array:
             )
             return ring(q, k, v)
         n_rep = cfg.n_heads // cfg.n_kv_heads
-        k = attn_ops.repeat_kv(k, n_rep)
-        v = attn_ops.repeat_kv(v, n_rep)
         spec = P(None, None, "context", None)
+        if cfg.cp_impl == "ulysses":
+            # all-to-all seq↔head reshard: cheaper collectives than the ring
+            # when n_heads >= context degree (docs/parallelism.md). KV stays
+            # at Hkv width on the wire when it divides the context degree
+            # (mha's GQA aliasing then applies); otherwise broadcast first.
+            from tony_tpu.parallel.context import ulysses_attention
+
+            cp = mesh.shape["context"]
+            if cfg.n_heads % cp:
+                raise ValueError(
+                    f"cp_impl='ulysses' needs n_heads {cfg.n_heads} divisible "
+                    f"by the context degree {cp} (use 'xla'/'pallas' ring)"
+                )
+            if cfg.n_kv_heads % cp:
+                k = attn_ops.repeat_kv(k, n_rep)
+                v = attn_ops.repeat_kv(v, n_rep)
+            fn = partial(
+                ulysses_attention, axis_name="context",
+                attn_fn=partial(attn_ops.mha, causal=True, impl=cfg.attn_impl),
+            )
+        else:
+            k = attn_ops.repeat_kv(k, n_rep)
+            v = attn_ops.repeat_kv(v, n_rep)
+            fn = partial(ring_attention, axis_name="context", causal=True)
         ring = jax.shard_map(
-            partial(ring_attention, axis_name="context", causal=True),
+            fn,
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
