@@ -98,6 +98,24 @@ class TestLlama:
         )
         assert abs(float(got) - float(want)) < 0.05
 
+    def test_ulysses_cp_narrow_kv_gqa(self):
+        # n_kv_heads % context == 0: KV must stay at Hkv width through the
+        # all-to-all (no repeat_kv fallback) and still match single-device
+        import dataclasses as dc
+
+        cfg = dc.replace(self.cfg, cp_impl="ulysses", n_heads=4, n_kv_heads=2)
+        params = llama.init(KEY, cfg)
+        batch = llama.synthetic_batch(KEY, 4, 32, cfg)
+        want, _ = llama.loss_fn(params, batch, dc.replace(cfg, cp_impl="xla"))
+        mesh = MeshSpec(context=2, data=4).build()
+        sharded = jax.device_put(
+            params, llama.sharding_rules(cfg).sharding_tree(params, mesh)
+        )
+        got, _ = jax.jit(functools.partial(llama.loss_fn, cfg=cfg, mesh=mesh))(
+            sharded, batch
+        )
+        assert abs(float(got) - float(want)) < 0.05
+
     def test_ulysses_cp_head_divisibility_validated(self):
         import dataclasses as dc
 
