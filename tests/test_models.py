@@ -127,6 +127,26 @@ class TestLlama:
                 jnp.zeros((1, 3, 8, 4)), cfg, mesh,
             )
 
+    def test_pipeline_loss_matches_flat(self):
+        # llama's own PP path: stage-split layer stack + GPipe microbatches
+        # must reproduce the flat scan's loss AND gradients
+        params = llama.init(KEY, self.cfg)
+        batch = llama.synthetic_batch(KEY, 4, 32, self.cfg)
+        mesh = MeshSpec(stage=2, data=4).build()
+
+        want, gw = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, batch, self.cfg)[0]
+        )(params)
+        got, gg = jax.jit(jax.value_and_grad(
+            lambda p: llama.pp_loss_fn(p, batch, self.cfg, mesh, num_microbatches=2)[0]
+        ))(params)
+        assert abs(float(got) - float(want)) < 0.05
+        for a, b in zip(jax.tree.leaves(gg), jax.tree.leaves(gw)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=5e-2, rtol=5e-2,
+            )
+
     def test_grad_accumulation_matches_full_batch(self):
         cfg = self.cfg
         params = llama.init(KEY, cfg)

@@ -212,39 +212,91 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh) -> jax.Array:
     return attn_ops.mha(q, k, v, causal=True, impl=cfg.attn_impl)
 
 
-def hidden_states(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax.Array:
-    """tokens [B, T] int32 → final-norm hidden states [B, T, D]."""
-    B, T = tokens.shape
+def _block(x: jax.Array, lp: dict, cos, sin, cfg: LlamaConfig, mesh) -> tuple[jax.Array, None]:
+    """One decoder block (pre-norm attention + SwiGLU), scan-compatible.
+    Shared by the flat layer scan (hidden_states) and the pipeline stage
+    body (pp_loss_fn, where mesh is None — stages run per-device)."""
+    B, T = x.shape[0], x.shape[1]
     Dh, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
-    cos, sin = L.rope_frequencies(Dh, T, cfg.rope_theta)
-
-    x = jnp.take(params["embed"], tokens, axis=0)
     act_spec = P(BATCH_AXES, "context", None)
+    h = L.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+    k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(B, T, Hkv, Dh).transpose(0, 2, 1, 3)
+    v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(B, T, Hkv, Dh).transpose(0, 2, 1, 3)
+    q = L.apply_rope(q, cos, sin)
+    k = L.apply_rope(k, cos, sin)
+    o = _attention(q, k, v, cfg, mesh)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
+    x = x + jnp.einsum("bth,hd->btd", o, lp["wo"])
     if mesh is not None:
         x = constrain(x, mesh, act_spec)
+    h = L.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    x = x + L.swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+    if mesh is not None:
+        x = constrain(x, mesh, act_spec)
+    return x, None
 
-    def block(x, lp):
-        h = L.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
-        k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(B, T, Hkv, Dh).transpose(0, 2, 1, 3)
-        v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(B, T, Hkv, Dh).transpose(0, 2, 1, 3)
-        q = L.apply_rope(q, cos, sin)
-        k = L.apply_rope(k, cos, sin)
-        o = _attention(q, k, v, cfg, mesh)
-        o = o.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
-        x = x + jnp.einsum("bth,hd->btd", o, lp["wo"])
-        if mesh is not None:
-            x = constrain(x, mesh, act_spec)
-        h = L.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        x = x + L.swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
-        if mesh is not None:
-            x = constrain(x, mesh, act_spec)
-        return x, None
 
-    block_fn = attn_ops.remat_block(block, cfg.remat, cfg.remat_policy)
+def hidden_states(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax.Array:
+    """tokens [B, T] int32 → final-norm hidden states [B, T, D]."""
+    T = tokens.shape[1]
+    cos, sin = L.rope_frequencies(cfg.head_dim, T, cfg.rope_theta)
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+    if mesh is not None:
+        x = constrain(x, mesh, P(BATCH_AXES, "context", None))
+
+    block_fn = attn_ops.remat_block(
+        partial(_block, cos=cos, sin=sin, cfg=cfg, mesh=mesh),
+        cfg.remat, cfg.remat_policy,
+    )
     x, _ = jax.lax.scan(block_fn, x, params["layers"])
 
     return L.rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def pp_loss_fn(
+    params: dict, batch: dict, cfg: LlamaConfig, mesh, num_microbatches: int = 2
+) -> tuple[jax.Array, dict]:
+    """Pipeline-parallel training loss: the stacked layer dim splits into
+    equal-depth stages over the mesh's ``stage`` axis (GPipe microbatch
+    schedule, parallel/pipeline.py); embedding and the (chunked) CE head run
+    outside the pipeline, replicated over stages.
+
+    Stages run per-device inside shard_map, so this path composes with
+    data/fsdp sharding of the batch but not with a context axis (use
+    cp_impl on the flat path for that).
+    """
+    from tony_tpu.parallel.pipeline import spmd_pipeline, split_layers_into_stages
+
+    S = mesh.shape.get("stage", 1)
+    if S <= 1:
+        return loss_fn(params, batch, cfg, mesh)
+    if mesh.shape.get("context", 1) > 1:
+        raise ValueError("pp_loss_fn does not compose with a context axis")
+    tokens = batch["tokens"]
+    T = tokens.shape[1] - 1
+    cos, sin = L.rope_frequencies(cfg.head_dim, T, cfg.rope_theta)
+    x = jnp.take(params["embed"], tokens[:, :-1], axis=0)
+
+    block_fn = attn_ops.remat_block(
+        partial(_block, cos=cos, sin=sin, cfg=cfg, mesh=None),
+        cfg.remat, cfg.remat_policy,
+    )
+
+    def stage_fn(stage_lp, h):
+        h, _ = jax.lax.scan(block_fn, h, stage_lp)
+        return h
+
+    stages = split_layers_into_stages(params["layers"], S)
+    x = spmd_pipeline(stage_fn, stages, x, mesh=mesh, num_microbatches=num_microbatches)
+    x = L.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    # always the fused CE (ce_chunk<=0 → one full-length chunk in the
+    # callee): the PP path never materializes [B, T, V] logits
+    loss, n = L.chunked_cross_entropy_loss(
+        x, params["lm_head"], tokens[:, 1:], chunk=cfg.ce_chunk
+    )
+    return loss, {"loss": loss, "tokens": n}
 
 
 def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax.Array:

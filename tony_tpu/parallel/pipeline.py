@@ -28,13 +28,23 @@ def _pipeline_body(
     *,
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     axis_name: str,
+    compute_dtype,
 ) -> jax.Array:
     """Runs inside shard_map: stage_params are stage-local (leading dim 1),
-    microbatches [M, B, ...] are replicated along the stage axis."""
+    microbatches [M, B, ...] are replicated along the stage axis.
+
+    ``microbatches`` arrive (and all cross-stage traffic travels) in the
+    caller's wire dtype — f32 by default, because bf16 through the backward
+    of the replicated input's transpose-psum / ppermute trips an XLA-CPU
+    compiler CHECK (AllReducePromotion "Invalid binary instruction opcode
+    copy"), and f32 hand-off is numerically lossless between stages.
+    Compute inside each stage runs in ``compute_dtype``.
+    """
     S = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     M = microbatches.shape[0]
     mb_shape = microbatches.shape[1:]
+    wire_dtype = microbatches.dtype
     local_params = jax.tree.map(lambda p: p[0], stage_params)
 
     def tick(carry, t):
@@ -42,7 +52,7 @@ def _pipeline_body(
         # stage 0 ingests microbatch t (clamped); others take the ring input
         feed = microbatches[jnp.minimum(t, M - 1)]
         x = jnp.where(idx == 0, feed, state)
-        y = stage_fn(local_params, x)
+        y = stage_fn(local_params, x.astype(compute_dtype)).astype(wire_dtype)
         # the last stage banks its finished microbatch (valid when t >= S-1)
         out_idx = t - (S - 1)
         valid = jnp.logical_and(idx == S - 1, out_idx >= 0)
@@ -56,15 +66,13 @@ def _pipeline_body(
         state = jax.lax.ppermute(y, axis_name, [(i, (i + 1) % S) for i in range(S)])
         return (state, outputs), None
 
-    state0 = jnp.zeros(mb_shape, microbatches.dtype)
-    outputs0 = jnp.zeros((M, *mb_shape), microbatches.dtype)
+    state0 = jnp.zeros(mb_shape, wire_dtype)
+    outputs0 = jnp.zeros((M, *mb_shape), wire_dtype)
     (_, outputs), _ = jax.lax.scan(tick, (state0, outputs0), jnp.arange(M + S - 1))
-    # outputs live on the last stage only; make them uniform across the axis.
-    # psum in f32: bf16 all-reduce promotion trips an XLA-CPU compiler CHECK
-    # (AllReducePromotion "Invalid binary instruction opcode copy").
-    mask = (idx == S - 1).astype(jnp.float32)
-    summed = jax.lax.psum(outputs.astype(jnp.float32) * mask, axis_name)
-    return summed.astype(outputs.dtype)
+    # outputs live on the last stage only; make them uniform across the axis
+    mask = (idx == S - 1).astype(wire_dtype)
+    summed = jax.lax.psum(outputs * mask, axis_name)
+    return summed.astype(compute_dtype)
 
 
 def spmd_pipeline(
@@ -75,6 +83,7 @@ def spmd_pipeline(
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = "stage",
+    wire_dtype=jnp.float32,
 ) -> jax.Array:
     """Apply an S-stage pipeline to a batch.
 
@@ -86,11 +95,20 @@ def spmd_pipeline(
     M = num_microbatches
     if B % M:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
-    mb = x.reshape(M, B // M, *x.shape[1:])
+    compute_dtype = x.dtype
+    if jnp.dtype(wire_dtype).itemsize < 4 and jax.default_backend() == "cpu":
+        raise ValueError(
+            f"wire_dtype {jnp.dtype(wire_dtype).name} would go through bf16 "
+            "collective backward on the CPU backend, which trips an XLA "
+            "compiler CHECK — use float32 (narrow wire is a TPU-only option)"
+        )
+    # wire dtype applies from the shard_map boundary in: the replicated
+    # input's backward is itself a stage-axis psum (see _pipeline_body)
+    mb = x.astype(wire_dtype).reshape(M, B // M, *x.shape[1:])
 
     param_specs = jax.tree.map(lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params)
     body = jax.shard_map(
-        partial(_pipeline_body, stage_fn=stage_fn, axis_name=axis_name),
+        partial(_pipeline_body, stage_fn=stage_fn, axis_name=axis_name, compute_dtype=compute_dtype),
         mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P(),
