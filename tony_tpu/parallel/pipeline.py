@@ -96,7 +96,8 @@ def spmd_pipeline(
     if B % M:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     compute_dtype = x.dtype
-    if jnp.dtype(wire_dtype).itemsize < 4 and jax.default_backend() == "cpu":
+    mesh_platform = next(iter(mesh.devices.flat)).platform
+    if jnp.dtype(wire_dtype).itemsize < 4 and mesh_platform == "cpu":
         raise ValueError(
             f"wire_dtype {jnp.dtype(wire_dtype).name} would go through bf16 "
             "collective backward on the CPU backend, which trips an XLA "
