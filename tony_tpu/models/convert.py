@@ -1,0 +1,136 @@
+"""Weight import from Hugging Face checkpoints (LlamaForCausalLM family).
+
+The reference orchestrates user-supplied training programs; users arriving
+from that ecosystem hold HF/PyTorch checkpoints. This converter maps an HF
+Llama state dict onto models/llama.py's pytree (and config), verified to
+logit-level parity in tests/test_convert.py — the rope convention
+(rotate-half, non-interleaved), GQA head layout, and un-tied lm head all
+line up, so only transposes are needed (HF nn.Linear stores [out, in]; our
+einsums consume [in, out]).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from tony_tpu.models.llama import LlamaConfig
+
+
+def config_from_hf(hf_config, dtype: str = "bfloat16", **overrides) -> LlamaConfig:
+    """transformers LlamaConfig → LlamaConfig (ours). Rejects checkpoint
+    features the native model does not implement, rather than importing
+    something that silently diverges."""
+    if getattr(hf_config, "rope_scaling", None):
+        raise NotImplementedError(
+            "rope_scaling (Llama 3.1+ long-context scaling) is not implemented "
+            "in ops/layers.rope_frequencies — importing would silently diverge "
+            "from the HF forward at long positions"
+        )
+    explicit_hd = getattr(hf_config, "head_dim", None)
+    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    if explicit_hd is not None and explicit_hd != derived_hd:
+        raise NotImplementedError(
+            f"checkpoint head_dim {explicit_hd} != hidden_size/num_heads "
+            f"{derived_hd}; the native LlamaConfig derives head_dim"
+        )
+    if getattr(hf_config, "attention_bias", False) or getattr(hf_config, "mlp_bias", False):
+        raise NotImplementedError(
+            "attention_bias/mlp_bias checkpoints are not supported (the native "
+            "block has no bias terms)"
+        )
+    base = LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", hf_config.num_attention_heads),
+        d_ff=hf_config.intermediate_size,
+        max_seq=hf_config.max_position_embeddings,
+        rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
+        norm_eps=hf_config.rms_norm_eps,
+        dtype=dtype,
+    )
+    return dataclasses.replace(base, **overrides) if overrides else base
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().to("cpu").float().numpy()
+    return np.asarray(t, np.float32)
+
+
+# non-parameter buffers some transformers versions persist in state dicts
+_IGNORABLE_SUFFIXES = ("rotary_emb.inv_freq",)
+
+
+def params_from_hf_state_dict(state_dict: dict, cfg: LlamaConfig) -> dict:
+    """HF LlamaForCausalLM state dict → stacked-layer params pytree.
+
+    Accepts torch tensors or numpy arrays; each tensor converts lazily at
+    consumption (no second full-precision copy of the whole checkpoint).
+    Missing ``lm_head.weight`` means a tied-embedding checkpoint: the
+    embedding row matrix is reused. Any key this mapping does not consume
+    (e.g. bias terms) raises — silently dropping weights would produce a
+    model that runs but diverges.
+    """
+    dt = cfg.jdtype
+    consumed: set[str] = set()
+
+    def take(key: str, transpose: bool) -> np.ndarray:
+        consumed.add(key)
+        w = _to_np(state_dict[key])
+        return w.T if transpose else w
+
+    def stack(fmt: str, transpose: bool = True):
+        return jnp.asarray(
+            np.stack([take(fmt.format(i=i), transpose) for i in range(cfg.n_layers)]), dt
+        )
+
+    embed = take("model.embed_tokens.weight", transpose=False)
+    params = {
+        "embed": jnp.asarray(embed, dt),
+        "layers": {
+            "attn_norm": stack("model.layers.{i}.input_layernorm.weight", transpose=False),
+            "wq": stack("model.layers.{i}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{i}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
+            "mlp_norm": stack("model.layers.{i}.post_attention_layernorm.weight", transpose=False),
+            "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight"),
+            "w_up": stack("model.layers.{i}.mlp.up_proj.weight"),
+            "w_down": stack("model.layers.{i}.mlp.down_proj.weight"),
+        },
+        "final_norm": jnp.asarray(take("model.norm.weight", transpose=False), dt),
+    }
+    if "lm_head.weight" in state_dict:
+        params["lm_head"] = jnp.asarray(take("lm_head.weight", transpose=True), dt)
+    else:  # tied embeddings
+        params["lm_head"] = jnp.asarray(embed.T, dt)
+
+    leftover = [
+        k for k in state_dict
+        if k not in consumed and not k.endswith(_IGNORABLE_SUFFIXES)
+    ]
+    if leftover:
+        raise ValueError(
+            f"state dict has {len(leftover)} unconsumed tensors (e.g. "
+            f"{sorted(leftover)[:4]}): this checkpoint carries weights the "
+            "native Llama has no slot for — refusing a silently-wrong import"
+        )
+    return params
+
+
+def from_hf(model, dtype: str = "bfloat16", **overrides):
+    """One-call import: (params, cfg) from a transformers LlamaForCausalLM.
+    For a bare state dict, build the config yourself (``config_from_hf`` or
+    a native LlamaConfig) and call ``params_from_hf_state_dict``."""
+    if hasattr(model, "state_dict") and hasattr(model, "config"):
+        cfg = config_from_hf(model.config, dtype=dtype, **overrides)
+        return params_from_hf_state_dict(model.state_dict(), cfg), cfg
+    raise TypeError(
+        "pass a transformers LlamaForCausalLM; for a bare state dict use "
+        "params_from_hf_state_dict with an explicit config"
+    )
