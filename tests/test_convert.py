@@ -97,3 +97,37 @@ class TestHfImport:
         prompt = jax.numpy.zeros((1, 4), jax.numpy.int32)
         out = generate.generate(params, prompt, cfg, max_new_tokens=4)
         assert out.shape == (1, 4)
+
+
+class TestMixtralHfImport:
+    def _tiny_hf_mixtral(self):
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_local_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=32, rms_norm_eps=1e-5,
+            attn_implementation="eager",
+        )
+        torch.manual_seed(1)
+        return transformers.MixtralForCausalLM(hf_cfg).eval()
+
+    def test_logit_parity_with_transformers(self):
+        from tony_tpu.models import convert, mixtral
+
+        model = self._tiny_hf_mixtral()
+        params, cfg = convert.from_hf(model, dtype="float32")
+        # lossless capacity: HF routing never drops tokens
+        assert cfg.capacity_factor == pytest.approx(2.0)
+
+        tokens = np.random.default_rng(3).integers(0, 128, (2, 16))
+        with torch.no_grad():
+            want = model(torch.tensor(tokens)).logits.numpy()
+        got, aux = mixtral.forward(
+            params, jax.numpy.asarray(tokens, jax.numpy.int32), cfg
+        )
+        got = np.asarray(got, np.float32)
+        scale = np.abs(want).max() + 1e-6
+        assert np.abs(got - want).max() / scale < 2e-3, (
+            f"max logit divergence {np.abs(got - want).max() / scale:.2e}"
+        )
+        assert float(aux["moe_dropped_frac"]) == pytest.approx(0.0, abs=1e-6)

@@ -19,28 +19,37 @@ import numpy as np
 from tony_tpu.models.llama import LlamaConfig
 
 
-def config_from_hf(hf_config, dtype: str = "bfloat16", **overrides) -> LlamaConfig:
-    """transformers LlamaConfig → LlamaConfig (ours). Rejects checkpoint
-    features the native model does not implement, rather than importing
-    something that silently diverges."""
+def _reject_unsupported(hf_config) -> None:
+    """Checkpoint features the native models do not implement raise here,
+    rather than importing something that silently diverges."""
     if getattr(hf_config, "rope_scaling", None):
         raise NotImplementedError(
             "rope_scaling (Llama 3.1+ long-context scaling) is not implemented "
             "in ops/layers.rope_frequencies — importing would silently diverge "
             "from the HF forward at long positions"
         )
+    if getattr(hf_config, "sliding_window", None):
+        raise NotImplementedError(
+            "sliding_window attention is not implemented — the native models "
+            "attend full-causal, which diverges beyond the window"
+        )
     explicit_hd = getattr(hf_config, "head_dim", None)
     derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
     if explicit_hd is not None and explicit_hd != derived_hd:
         raise NotImplementedError(
             f"checkpoint head_dim {explicit_hd} != hidden_size/num_heads "
-            f"{derived_hd}; the native LlamaConfig derives head_dim"
+            f"{derived_hd}; the native configs derive head_dim"
         )
     if getattr(hf_config, "attention_bias", False) or getattr(hf_config, "mlp_bias", False):
         raise NotImplementedError(
             "attention_bias/mlp_bias checkpoints are not supported (the native "
             "block has no bias terms)"
         )
+
+
+def config_from_hf(hf_config, dtype: str = "bfloat16", **overrides) -> LlamaConfig:
+    """transformers LlamaConfig → LlamaConfig (ours)."""
+    _reject_unsupported(hf_config)
     base = LlamaConfig(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -66,71 +75,157 @@ def _to_np(t) -> np.ndarray:
 _IGNORABLE_SUFFIXES = ("rotary_emb.inv_freq",)
 
 
-def params_from_hf_state_dict(state_dict: dict, cfg: LlamaConfig) -> dict:
-    """HF LlamaForCausalLM state dict → stacked-layer params pytree.
+class _Consumer:
+    """Tracks which state-dict keys the mapping consumed; converts each
+    tensor lazily at consumption (no second full-precision copy of the
+    whole checkpoint) and refuses to finish while any weight tensor is
+    left unconsumed — silently dropping weights would produce a model
+    that runs but diverges."""
 
-    Accepts torch tensors or numpy arrays; each tensor converts lazily at
-    consumption (no second full-precision copy of the whole checkpoint).
-    Missing ``lm_head.weight`` means a tied-embedding checkpoint: the
-    embedding row matrix is reused. Any key this mapping does not consume
-    (e.g. bias terms) raises — silently dropping weights would produce a
-    model that runs but diverges.
-    """
-    dt = cfg.jdtype
-    consumed: set[str] = set()
+    def __init__(self, state_dict: dict, cfg):
+        self.sd = state_dict
+        self.cfg = cfg
+        self.dt = cfg.jdtype
+        self.consumed: set[str] = set()
 
-    def take(key: str, transpose: bool) -> np.ndarray:
-        consumed.add(key)
-        w = _to_np(state_dict[key])
+    def take(self, key: str, transpose: bool) -> np.ndarray:
+        self.consumed.add(key)
+        w = _to_np(self.sd[key])
         return w.T if transpose else w
 
-    def stack(fmt: str, transpose: bool = True):
-        return jnp.asarray(
-            np.stack([take(fmt.format(i=i), transpose) for i in range(cfg.n_layers)]), dt
-        )
+    def stack(self, fmt: str, transpose: bool = True, dtype=None):
+        # per-layer dtype conversion bounds the f32 peak to one layer
+        return jnp.stack([
+            jnp.asarray(self.take(fmt.format(i=i), transpose), dtype or self.dt)
+            for i in range(self.cfg.n_layers)
+        ])
 
-    embed = take("model.embed_tokens.weight", transpose=False)
-    params = {
-        "embed": jnp.asarray(embed, dt),
-        "layers": {
-            "attn_norm": stack("model.layers.{i}.input_layernorm.weight", transpose=False),
-            "wq": stack("model.layers.{i}.self_attn.q_proj.weight"),
-            "wk": stack("model.layers.{i}.self_attn.k_proj.weight"),
-            "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
-            "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
-            "mlp_norm": stack("model.layers.{i}.post_attention_layernorm.weight", transpose=False),
-            "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight"),
-            "w_up": stack("model.layers.{i}.mlp.up_proj.weight"),
-            "w_down": stack("model.layers.{i}.mlp.down_proj.weight"),
-        },
-        "final_norm": jnp.asarray(take("model.norm.weight", transpose=False), dt),
-    }
-    if "lm_head.weight" in state_dict:
-        params["lm_head"] = jnp.asarray(take("lm_head.weight", transpose=True), dt)
-    else:  # tied embeddings
-        params["lm_head"] = jnp.asarray(embed.T, dt)
+    def common(self) -> tuple[dict, dict]:
+        """The embedding/attention/norm/lm-head mapping every Llama-family
+        architecture shares. Returns (params, layer dict to extend)."""
+        embed = self.take("model.embed_tokens.weight", transpose=False)
+        layers = {
+            "attn_norm": self.stack("model.layers.{i}.input_layernorm.weight", transpose=False),
+            "wq": self.stack("model.layers.{i}.self_attn.q_proj.weight"),
+            "wk": self.stack("model.layers.{i}.self_attn.k_proj.weight"),
+            "wv": self.stack("model.layers.{i}.self_attn.v_proj.weight"),
+            "wo": self.stack("model.layers.{i}.self_attn.o_proj.weight"),
+            "mlp_norm": self.stack("model.layers.{i}.post_attention_layernorm.weight", transpose=False),
+        }
+        params = {
+            "embed": jnp.asarray(embed, self.dt),
+            "layers": layers,
+            "final_norm": jnp.asarray(self.take("model.norm.weight", transpose=False), self.dt),
+        }
+        if "lm_head.weight" in self.sd:
+            params["lm_head"] = jnp.asarray(self.take("lm_head.weight", transpose=True), self.dt)
+        else:  # tied embeddings
+            params["lm_head"] = jnp.asarray(embed.T, self.dt)
+        return params, layers
 
-    leftover = [
-        k for k in state_dict
-        if k not in consumed and not k.endswith(_IGNORABLE_SUFFIXES)
-    ]
-    if leftover:
-        raise ValueError(
-            f"state dict has {len(leftover)} unconsumed tensors (e.g. "
-            f"{sorted(leftover)[:4]}): this checkpoint carries weights the "
-            "native Llama has no slot for — refusing a silently-wrong import"
-        )
-    return params
+    def finish(self, params: dict) -> dict:
+        leftover = [
+            k for k in self.sd
+            if k not in self.consumed and not k.endswith(_IGNORABLE_SUFFIXES)
+        ]
+        if leftover:
+            raise ValueError(
+                f"state dict has {len(leftover)} unconsumed tensors (e.g. "
+                f"{sorted(leftover)[:4]}): this checkpoint carries weights the "
+                "native model has no slot for — refusing a silently-wrong import"
+            )
+        return params
+
+
+def params_from_hf_state_dict(state_dict: dict, cfg: LlamaConfig) -> dict:
+    """HF LlamaForCausalLM state dict → stacked-layer params pytree.
+    Missing ``lm_head.weight`` means a tied-embedding checkpoint: the
+    embedding row matrix is reused."""
+    c = _Consumer(state_dict, cfg)
+    params, layers = c.common()
+    layers.update(
+        w_gate=c.stack("model.layers.{i}.mlp.gate_proj.weight"),
+        w_up=c.stack("model.layers.{i}.mlp.up_proj.weight"),
+        w_down=c.stack("model.layers.{i}.mlp.down_proj.weight"),
+    )
+    return c.finish(params)
+
+
+def config_from_hf_mixtral(hf_config, dtype: str = "bfloat16", **overrides):
+    """transformers MixtralConfig → MixtralConfig (ours).
+
+    capacity_factor defaults to num_experts/top_k — the lossless setting
+    (HF's reference routing has no capacity and drops nothing; any smaller
+    factor would make imported logits diverge when routing is imbalanced).
+    """
+    from tony_tpu.models.mixtral import MixtralConfig
+
+    _reject_unsupported(hf_config)
+    base = MixtralConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        d_ff=hf_config.intermediate_size,
+        max_seq=hf_config.max_position_embeddings,
+        rope_theta=getattr(hf_config, "rope_theta", 1e6),
+        norm_eps=hf_config.rms_norm_eps,
+        dtype=dtype,
+        num_experts=hf_config.num_local_experts,
+        top_k=hf_config.num_experts_per_tok,
+        capacity_factor=hf_config.num_local_experts / hf_config.num_experts_per_tok,
+    )
+    return dataclasses.replace(base, **overrides) if overrides else base
+
+
+def params_from_hf_mixtral_state_dict(state_dict: dict, cfg) -> dict:
+    """HF MixtralForCausalLM state dict → native Mixtral pytree.
+
+    Expert naming: HF w1 = gate, w3 = up, w2 = down; the per-expert matrices
+    stack into [L, E, ...] tensors. The router imports directly in f32
+    (never rounded through the model dtype — bf16-quantized routing logits
+    could flip near-tie expert selections versus the HF forward).
+    """
+    c = _Consumer(state_dict, cfg)
+    params, layers = c.common()
+
+    def stack_experts(which: str):
+        # per-layer conversion: the f32 staging peak is one layer's experts
+        return jnp.stack([
+            jnp.asarray(
+                np.stack([
+                    c.take(f"model.layers.{i}.block_sparse_moe.experts.{e}.{which}.weight", True)
+                    for e in range(cfg.num_experts)
+                ]),
+                c.dt,
+            )
+            for i in range(cfg.n_layers)
+        ])
+
+    layers.update(
+        router=c.stack("model.layers.{i}.block_sparse_moe.gate.weight", dtype=jnp.float32),
+        we_gate=stack_experts("w1"),
+        we_up=stack_experts("w3"),
+        we_down=stack_experts("w2"),
+    )
+    return c.finish(params)
 
 
 def from_hf(model, dtype: str = "bfloat16", **overrides):
-    """One-call import: (params, cfg) from a transformers LlamaForCausalLM.
-    For a bare state dict, build the config yourself (``config_from_hf`` or
-    a native LlamaConfig) and call ``params_from_hf_state_dict``."""
+    """One-call import: (params, cfg) from a transformers LlamaForCausalLM
+    or MixtralForCausalLM (dispatch on config.model_type). For a bare state
+    dict, build the config yourself (``config_from_hf`` /
+    ``config_from_hf_mixtral``) and call the matching
+    ``params_from_hf*_state_dict``."""
     if hasattr(model, "state_dict") and hasattr(model, "config"):
+        kind = getattr(model.config, "model_type", "llama")
+        if kind == "mixtral":
+            cfg = config_from_hf_mixtral(model.config, dtype=dtype, **overrides)
+            return params_from_hf_mixtral_state_dict(model.state_dict(), cfg), cfg
         cfg = config_from_hf(model.config, dtype=dtype, **overrides)
         return params_from_hf_state_dict(model.state_dict(), cfg), cfg
     raise TypeError(
-        "pass a transformers LlamaForCausalLM; for a bare state dict use "
-        "params_from_hf_state_dict with an explicit config"
+        "pass a transformers LlamaForCausalLM/MixtralForCausalLM; for a bare "
+        "state dict use the params_from_hf*_state_dict functions"
     )
