@@ -1,0 +1,16 @@
+"""Sleeps forever on gang attempt 0 (so the test can kill its node), exits 0
+on the restarted attempt after recording which node ran it — the node-death →
+gang-restart E2E workload."""
+import os
+import sys
+import time
+
+if os.environ.get("TONY_RESTART_ATTEMPT", "0") == "0":
+    time.sleep(600)
+out = os.path.join(
+    os.environ["TONY_STAGING_DIR"],
+    f"node_of_{os.environ['JOB_NAME']}_{os.environ['TASK_INDEX']}.txt",
+)
+with open(out, "w") as f:
+    f.write(os.environ.get("TONY_NODE_NAME", ""))
+sys.exit(0)

@@ -1,0 +1,9 @@
+"""Record which pool node launched this task (agent-launch E2E proof)."""
+import os
+
+out = os.path.join(
+    os.environ["TONY_STAGING_DIR"],
+    f"node_of_{os.environ['JOB_NAME']}_{os.environ['TASK_INDEX']}.txt",
+)
+with open(out, "w") as f:
+    f.write(os.environ.get("TONY_NODE_NAME", ""))

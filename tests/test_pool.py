@@ -1,0 +1,302 @@
+"""Multi-host pool service tests: RM daemon + NodeAgent protocol.
+
+The reference's RM/NM machine boundary (SURVEY.md §2.1 AM → NMClient, §3.1
+process boundary #2), tested the reference's way (SURVEY.md §4): real daemons
+on loopback — the pool service in-process, ≥2 host agents as separate OS
+processes — driving the real client → AM → executor spine.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.cluster.client import Client
+from tony_tpu.cluster.pool import PoolService, RemoteResourceManager, _rect_from
+from tony_tpu.cluster.resources import AllocationError, Resources
+from tony_tpu.cluster.session import JobStatus
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+FAST = {
+    keys.AM_MONITOR_INTERVAL_MS: "50",
+    keys.TASK_HEARTBEAT_INTERVAL_MS: "100",
+    keys.AM_GANG_TIMEOUT_MS: "30000",
+}
+
+SECRET = "pool-test-secret"
+
+
+def fixture_cmd(name: str) -> str:
+    return f"{sys.executable} {os.path.join(FIXTURES, name)}"
+
+
+# ---------------------------------------------------------------------------
+# Unit: per-node rectangle carving
+# ---------------------------------------------------------------------------
+class TestRectFrom:
+    def test_exact_block(self):
+        free = {(0, 0), (0, 1), (1, 0), (1, 1)}
+        assert set(_rect_from(free, 4)) == free
+
+    def test_subrect_prefers_square(self):
+        free = {(r, c) for r in range(2) for c in range(4)}
+        got = _rect_from(free, 4)
+        rows = {r for r, _ in got}
+        cols = {c for _, c in got}
+        assert len(rows) == 2 and len(cols) == 2  # 2x2, not 1x4
+
+    def test_fragmented_no_rect(self):
+        # 3 free chips in an L: no contiguous 1x3/3x1
+        assert _rect_from({(0, 0), (0, 1), (1, 1)}, 3) is None
+
+    def test_zero_and_too_big(self):
+        assert _rect_from(set(), 0) == ()
+        assert _rect_from({(0, 0)}, 2) is None
+
+
+# ---------------------------------------------------------------------------
+# Unit: pool service model (no RPC, direct method calls)
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def pool():
+    svc = PoolService(heartbeat_interval_ms=100, max_missed_heartbeats=3, secret=SECRET)
+    yield svc
+    svc.stop()
+
+
+def register_cpu_node(svc, name, memory=4 * 1024**3, vcores=8):
+    svc.register_node(name=name, host="127.0.0.1", port=1, memory_bytes=memory, vcores=vcores)
+
+
+class TestPoolModel:
+    def test_allocate_spreads_by_memory(self, pool):
+        register_cpu_node(pool, "n0")
+        register_cpu_node(pool, "n1")
+        a = pool.allocate("app", "worker", 0, 3 * 1024**3, 1, 0)
+        b = pool.allocate("app", "worker", 1, 3 * 1024**3, 1, 0)
+        assert {a["node"], b["node"]} == {"n0", "n1"}
+        with pytest.raises(AllocationError):
+            pool.allocate("app", "worker", 2, 3 * 1024**3, 1, 0)
+
+    def test_chips_from_one_node_only(self, pool):
+        pool.register_node(
+            name="t0", host="h", port=1, memory_bytes=8 * 1024**3, vcores=8,
+            slice_id=0, slice_spec="v5e-8", chips=[[0, 0], [0, 1], [1, 0], [1, 1]],
+        )
+        pool.register_node(
+            name="t1", host="h", port=1, memory_bytes=8 * 1024**3, vcores=8,
+            slice_id=0, slice_spec="v5e-8", chips=[[0, 2], [0, 3], [1, 2], [1, 3]],
+        )
+        got = pool.allocate("app", "worker", 0, 1024, 1, 4)
+        assert got["node"] in ("t0", "t1") and len(got["chips"]) == 4
+        with pytest.raises(AllocationError, match="per-host"):
+            pool.allocate("app", "worker", 1, 1024, 1, 8)  # larger than any host
+
+    def test_gang_packs_into_one_slice(self, pool):
+        for s in (0, 1):
+            for h in (0, 1):
+                pool.register_node(
+                    name=f"s{s}h{h}", host="h", port=1, memory_bytes=8 * 1024**3,
+                    vcores=8, slice_id=s, slice_spec="v5e-8",
+                    chips=[[r, 2 * h + c] for r in (0, 1) for c in (0, 1)],
+                )
+        a = pool.allocate("app", "worker", 0, 1024, 1, 4)
+        b = pool.allocate("app", "worker", 1, 1024, 1, 4)
+        assert a["slice_id"] == b["slice_id"]  # same slice → ICI, not DCN
+        assert a["node"] != b["node"]
+
+    def test_chip_collision_rejected(self, pool):
+        pool.register_node(
+            name="t0", host="h", port=1, memory_bytes=1024, vcores=8,
+            slice_id=0, slice_spec="v5e-8", chips=[[0, 0], [0, 1]],
+        )
+        with pytest.raises(ValueError, match="collide"):
+            pool.register_node(
+                name="t1", host="h", port=1, memory_bytes=1024, vcores=8,
+                slice_id=0, slice_spec="v5e-8", chips=[[0, 1], [0, 2]],
+            )
+
+    def test_exit_frees_resources(self, pool):
+        register_cpu_node(pool, "n0")
+        got = pool.allocate("app", "worker", 0, 3 * 1024**3, 1, 0)
+        pool.node_heartbeat("n0", exited={got["id"]: 0})
+        assert pool.poll_exited("app") == {got["id"]: 0}
+        assert pool.poll_exited("app") == {}  # drained
+        pool.allocate("app", "worker", 1, 3 * 1024**3, 1, 0)  # memory was freed
+
+    def test_dead_node_containers_lost(self, pool):
+        register_cpu_node(pool, "n0")
+        got = pool.allocate("app", "worker", 0, 1024, 1, 0)
+        node = pool._nodes["n0"]
+        node.last_heartbeat -= 10  # way past 3×100ms
+        pool._monitor.start()
+        deadline = time.time() + 5
+        exited = {}
+        while time.time() < deadline and not exited:
+            exited = pool.poll_exited("app")
+            time.sleep(0.02)
+        assert exited == {got["id"]: constants.EXIT_NODE_LOST}
+        assert not node.alive
+        # a dead node takes no new work
+        with pytest.raises(AllocationError):
+            pool.allocate("app", "worker", 1, 1024, 1, 0)
+        # and a late heartbeat from it is told to re-register
+        assert pool.node_heartbeat("n0") == {"unknown_node": True}
+
+
+# ---------------------------------------------------------------------------
+# E2E: pool service + ≥2 agent PROCESSES on loopback, full submit spine
+# ---------------------------------------------------------------------------
+def spawn_agent(rm_addr, name, tmp, memory="4g", extra=()):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    log = open(os.path.join(tmp, f"agent_{name}.log"), "ab")
+    return subprocess.Popen(
+        [
+            sys.executable, "-u", "-m", "tony_tpu.cluster.agent",
+            "--rm", f"{rm_addr[0]}:{rm_addr[1]}", "--name", name,
+            "--secret", SECRET, "--memory", memory, "--vcores", "8",
+            "--heartbeat-ms", "100", *extra,
+        ],
+        env=env, stdout=log, stderr=subprocess.STDOUT,
+    )
+
+
+@pytest.fixture()
+def pool_with_agents(tmp_tony_root, tmp_path):
+    svc = PoolService(heartbeat_interval_ms=100, max_missed_heartbeats=4, secret=SECRET)
+    svc.start()
+    agents = [
+        spawn_agent(svc.address, "nodeA", str(tmp_path)),
+        spawn_agent(svc.address, "nodeB", str(tmp_path)),
+    ]
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if sum(1 for n in svc._nodes.values() if n.alive) >= 2:
+            break
+        time.sleep(0.05)
+    else:
+        raise RuntimeError("agents failed to register")
+    yield svc, agents
+    for a in agents:
+        if a.poll() is None:
+            a.terminate()
+    for a in agents:
+        try:
+            a.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            a.kill()
+    svc.stop()
+
+
+def pool_conf(svc, extra):
+    host, port = svc.address
+    return {
+        **FAST,
+        keys.TPU_POOL_SPEC: f"rm:{host}:{port}",
+        keys.TPU_POOL_SECRET: SECRET,
+        **extra,
+    }
+
+
+def run_job(tmp_tony_root, conf) -> tuple[JobStatus, object]:
+    cfg = TonyConfig({keys.STAGING_ROOT: str(tmp_tony_root), **conf})
+    client = Client(cfg)
+    handle = client.submit()
+    final = client.monitor_application(handle, quiet=True)
+    return final, handle
+
+
+@pytest.mark.e2e
+class TestPoolE2E:
+    def test_executors_launch_via_agents_on_two_nodes(self, tmp_tony_root, pool_with_agents):
+        svc, _ = pool_with_agents
+        final, handle = run_job(
+            tmp_tony_root,
+            pool_conf(svc, {
+                "tony.worker.instances": "2",
+                "tony.worker.memory": "3g",   # 3g+3g > one 4g node → must spread
+                keys.EXECUTES: fixture_cmd("record_node.py"),
+            }),
+        )
+        assert final == JobStatus.SUCCEEDED, handle.final_status()
+        nodes = set()
+        for i in (0, 1):
+            with open(os.path.join(handle.staging_dir, f"node_of_worker_{i}.txt")) as f:
+                nodes.add(f.read())
+        assert nodes == {"nodeA", "nodeB"}  # launched BY the agents, one each
+
+    def test_node_death_fails_job(self, tmp_tony_root, pool_with_agents):
+        svc, agents = pool_with_agents
+        cfg = TonyConfig({
+            keys.STAGING_ROOT: str(tmp_tony_root),
+            **pool_conf(svc, {
+                "tony.worker.instances": "2",
+                "tony.worker.memory": "3g",
+                keys.EXECUTES: fixture_cmd("forever.py"),
+            }),
+        })
+        client = Client(cfg)
+        handle = client.submit()
+        # wait for both workers to be running, then SIGKILL one agent (the
+        # whole "machine" dies: its heartbeats stop, its container orphans)
+        rpc = handle.rpc(timeout_s=30)
+        assert rpc is not None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            infos = rpc.call("get_task_infos")
+            if len(infos) == 2 and all(i["status"] == "RUNNING" for i in infos):
+                break
+            time.sleep(0.1)
+        os.kill(agents[0].pid, signal.SIGKILL)
+        final = client.monitor_application(handle, quiet=True)
+        assert final == JobStatus.FAILED
+        status = handle.final_status()
+        codes = {t["exit_code"] for t in status["tasks"]}
+        assert constants.EXIT_NODE_LOST in codes, status
+
+    def test_node_death_gang_restart_recovers(self, tmp_tony_root, pool_with_agents):
+        svc, agents = pool_with_agents
+        cfg = TonyConfig({
+            keys.STAGING_ROOT: str(tmp_tony_root),
+            **pool_conf(svc, {
+                "tony.worker.instances": "2",
+                "tony.worker.memory": "1g",   # after the node dies, BOTH fit on the survivor
+                keys.TASK_RESTART_ON_FAILURE: "true",
+                keys.EXECUTES: fixture_cmd("lost_then_ok.py"),
+            }),
+        })
+        client = Client(cfg)
+        handle = client.submit()
+        rpc = handle.rpc(timeout_s=30)
+        assert rpc is not None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            infos = rpc.call("get_task_infos")
+            if len(infos) == 2 and all(i["status"] == "RUNNING" for i in infos):
+                break
+            time.sleep(0.1)
+        os.kill(agents[0].pid, signal.SIGKILL)
+        final = client.monitor_application(handle, quiet=True)
+        assert final == JobStatus.SUCCEEDED, handle.final_status()
+        # the restarted gang ran entirely on the surviving node
+        for i in (0, 1):
+            with open(os.path.join(handle.staging_dir, f"node_of_worker_{i}.txt")) as f:
+                assert f.read() == "nodeB"
+
+
+class TestRemoteResourceManagerUnit:
+    def test_allocation_error_surfaces_as_allocation_error(self, pool):
+        pool.rpc.start()
+        host, port = pool.address
+        rm = RemoteResourceManager(host, port, secret=SECRET, app_id="app")
+        with pytest.raises(AllocationError):
+            rm.allocate("worker", 0, Resources(memory_bytes=1024))  # no nodes at all
+        rm.shutdown()

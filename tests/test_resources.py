@@ -217,3 +217,21 @@ class TestMultiSlicePool:
             time.sleep(0.05)
         assert out.read_text() == "0 1"
         rm.shutdown()
+
+    def test_gang_span_appends_across_launch_waves(self):
+        # dependency-gated type B allocated AFTER type A started may land on
+        # a new slice: the span must grow (appending, so A's indices stay
+        # valid) rather than crash on a frozen snapshot
+        rm = self._rm("pool:v5e-4x2")
+        a = [rm.allocate("a", i, Resources(chips=4)) for i in range(1)]
+        assert rm.gang_slice_span() == [rm.slice_of(a[0])]
+        # wave 2: slice of wave 1 is full → lands on the other slice
+        b = rm.allocate("b", 0, Resources(chips=4))
+        span = rm.gang_slice_span()
+        assert span[0] == rm.slice_of(a[0]) and set(span) == {0, 1}
+        # release everything → span resets for a restarted gang
+        for c in a + [b]:
+            rm.release(c)
+        c2 = rm.allocate("a", 0, Resources(chips=4))
+        assert rm.gang_slice_span() == [rm.slice_of(c2)]
+        rm.shutdown()

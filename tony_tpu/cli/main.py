@@ -116,8 +116,87 @@ def _cmd_mini(argv: list[str]) -> int:
     return 0 if final.name == "SUCCEEDED" else 1
 
 
+def _cmd_pool(argv: list[str]) -> int:
+    """Stand up a multi-host pool on this machine: the pool service (RM
+    analog) plus one NodeAgent process per emulated host, then print the
+    ``rm:host:port`` spec to submit against. On a real cluster you run
+    ``python -m tony_tpu.cluster.pool`` on the coordinator and
+    ``python -m tony_tpu.cluster.agent`` on every host instead — this
+    command is those daemons wired together on loopback.
+    """
+    import argparse
+    import os
+    import secrets
+    import signal as _signal
+    import subprocess
+    import sys as _sys
+    import threading
+
+    from tony_tpu.cluster.pool import PoolService
+    from tony_tpu.cluster.resources import DEFAULT_CHIPS_PER_HOST, SliceSpec
+
+    p = argparse.ArgumentParser(prog="tony pool", description=_cmd_pool.__doc__)
+    p.add_argument("--spec", default="", help="TPU pool, e.g. 'v5e-8x2' (slice spec x num slices); empty → CPU-only hosts")
+    p.add_argument("--hosts", type=int, default=2, help="host agents when no --spec (CPU pool)")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--memory", default="64g", help="memory per host")
+    p.add_argument("--vcores", type=int, default=64)
+    args = p.parse_args(argv)
+
+    secret = os.environ.get(constants.ENV_POOL_SECRET) or secrets.token_hex(16)
+    svc = PoolService(port=args.port, secret=secret)
+    svc.start()
+    host, port = svc.address
+
+    def agent_args(name: str, extra: list[str]) -> list[str]:
+        return [
+            _sys.executable, "-u", "-m", "tony_tpu.cluster.agent",
+            "--rm", f"{host}:{port}", "--name", name, "--secret", secret,
+            "--memory", args.memory, "--vcores", str(args.vcores), *extra,
+        ]
+
+    agents: list[subprocess.Popen] = []
+    if args.spec:
+        base, _, count = args.spec.rpartition("x")
+        num_slices = int(count) if count.isdigit() and base else 1
+        slice_spec = SliceSpec.parse(base if count.isdigit() and base else args.spec)
+        rows, cols = slice_spec.topology
+        per_host = min(DEFAULT_CHIPS_PER_HOST, slice_spec.chips)
+        hosts_per_slice = max(1, slice_spec.chips // per_host)
+        for s in range(num_slices):
+            # tile the slice grid onto hosts row-major, per_host chips each
+            linear = [(r, c) for r in range(rows) for c in range(cols)]
+            for h in range(hosts_per_slice):
+                chips = ";".join(f"{r},{c}" for r, c in linear[h * per_host:(h + 1) * per_host])
+                agents.append(subprocess.Popen(agent_args(
+                    f"slice{s}-host{h}",
+                    ["--slice-id", str(s), "--slice", slice_spec.name, "--chips", chips],
+                )))
+    else:
+        for h in range(args.hosts):
+            agents.append(subprocess.Popen(agent_args(f"host{h}", [])))
+
+    print(f"[tony-pool] pool service on {host}:{port} with {len(agents)} host agents")
+    print(f"[tony-pool] submit with: --conf tony.tpu.pool=rm:{host}:{port} "
+          f"--conf tony.tpu.pool.secret={secret}")
+    done = threading.Event()
+    _signal.signal(_signal.SIGTERM, lambda *_: done.set())
+    _signal.signal(_signal.SIGINT, lambda *_: done.set())
+    done.wait()
+    for a in agents:
+        a.terminate()
+    for a in agents:
+        try:
+            a.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            a.kill()
+    svc.stop()
+    return 0
+
+
 _COMMANDS = {
     "submit": _cmd_submit,
+    "pool": _cmd_pool,
     "history": _cmd_history,
     "portal": _cmd_portal,
     "notebook": _cmd_notebook,
@@ -129,8 +208,9 @@ _COMMANDS = {
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|history|portal|notebook|mini|data-prep} [options]\n")
+        print("usage: tony {submit|pool|history|portal|notebook|mini|data-prep} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
+        print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    list finished jobs / dump one job's events")
         print("  portal     serve the history web portal")
         print("  notebook   launch an interactive notebook container + local proxy")

@@ -1,0 +1,203 @@
+"""Per-host node agent: the NodeManager analog.
+
+The reference's defining process split is RM/NM daemons launching containers
+on *other* machines (SURVEY.md §2.1 AM → NMClient, §3.1 process boundary #2).
+This daemon is the NM half: it runs one-per-host, registers its inventory
+(memory, vcores, and the TPU chips this host owns within its ICI slice) with
+the pool service (cluster/pool.py, the RM analog), heartbeats node liveness,
+and launches/kills containers on AM request over the same length-framed RPC
+the rest of the control plane uses.
+
+Container semantics are byte-identical to the in-process pools: the agent
+drives the same ``ContainerLauncher`` (resources.py) — process groups,
+per-container stdio, docker rewrite — so a job cannot tell whether its
+containers were launched in-process or by an agent fleet.
+
+RPC surface served to the AM (NMClient analog):
+    launch_container(container_id, command, env, log_dir)
+    kill_container(container_id)
+    ping()
+
+Outbound to the pool service:
+    register_node(...)             on start and whenever the RM forgets us
+    node_heartbeat(name, exited)   liveness + piggybacked container exits;
+                                   the response carries kill orders
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Any
+
+from tony_tpu import constants
+from tony_tpu.config import parse_memory_string
+from tony_tpu.cluster.resources import ContainerLauncher, SliceSpec
+from tony_tpu.cluster.rpc import RpcClient, RpcError, RpcServer
+
+AGENT_RPC_METHODS = ["launch_container", "kill_container", "ping"]
+
+
+def parse_chip_coords(spec: str) -> tuple[tuple[int, int], ...]:
+    """'0,0;0,1;1,0' → ((0,0),(0,1),(1,0)) — this host's coords in the slice grid."""
+    if not spec:
+        return ()
+    out = []
+    for part in spec.split(";"):
+        r, c = part.split(",")
+        out.append((int(r), int(c)))
+    return tuple(out)
+
+
+class NodeAgent:
+    """One host's container-launch daemon (NodeManager analog)."""
+
+    def __init__(
+        self,
+        name: str,
+        rm_host: str,
+        rm_port: int,
+        secret: str = "",
+        *,
+        memory: str = "64g",
+        vcores: int = 64,
+        slice_id: int = -1,
+        slice_spec: str = "",
+        chips: str = "",
+        bind_host: str = "127.0.0.1",
+        port: int = 0,
+        heartbeat_interval_ms: int = 1000,
+    ):
+        self.name = name or socket.gethostname()
+        self.secret = secret
+        self.memory_bytes = parse_memory_string(memory)
+        self.vcores = vcores
+        self.slice_id = slice_id
+        self.slice_spec = slice_spec
+        self.chip_coords = parse_chip_coords(chips)
+        if self.chip_coords and slice_id < 0:
+            raise ValueError("chips declared but no --slice-id: chips must belong to a slice")
+        if self.chip_coords and not slice_spec:
+            raise ValueError("chips declared but no --slice spec (e.g. 'v5e-16')")
+        if slice_spec:
+            SliceSpec.parse(slice_spec)  # fail fast on a malformed spec
+        self.heartbeat_interval_s = heartbeat_interval_ms / 1000
+        self.launcher = ContainerLauncher()
+        self.rm = RpcClient(rm_host, rm_port, secret=secret)
+        self.rpc = RpcServer(host=bind_host, port=port, secret=secret)
+        self.rpc.register_object(self, AGENT_RPC_METHODS)
+        self._stop = threading.Event()
+
+    # ---------------------------------------------------------------- AM-side
+    def launch_container(
+        self, container_id: str, command: list[str], env: dict[str, str], log_dir: str
+    ) -> dict[str, Any]:
+        # merge over THIS host's environment (the AM's environ does not exist
+        # here); the AM-sent contract keys win
+        merged = dict(os.environ)
+        merged.update(env)
+        merged[constants.ENV_NODE_NAME] = self.name
+        self.launcher.start(container_id, command, merged, log_dir)
+        return {"ack": True}
+
+    def kill_container(self, container_id: str) -> dict[str, Any]:
+        self.launcher.kill(container_id)
+        return {"ack": True}
+
+    def ping(self) -> dict[str, Any]:
+        return {"name": self.name, "live": self.launcher.live_ids()}
+
+    # ---------------------------------------------------------------- RM-side
+    def _register(self) -> None:
+        host, port = self.rpc.address
+        resp = self.rm.call_with_retry(
+            "register_node",
+            retries=50,
+            delay_s=0.2,
+            name=self.name,
+            host=host,
+            port=port,
+            memory_bytes=self.memory_bytes,
+            vcores=self.vcores,
+            slice_id=self.slice_id,
+            slice_spec=self.slice_spec,
+            chips=[list(c) for c in self.chip_coords],
+        )
+        hb = resp.get("heartbeat_interval_ms")
+        if hb:
+            self.heartbeat_interval_s = int(hb) / 1000
+
+    def run(self) -> None:
+        self.rpc.start()
+        self._register()
+        pending_exits: dict[str, int] = {}  # exits not yet acked by the RM
+        while not self._stop.is_set():
+            pending_exits.update(self.launcher.poll_exited())
+            try:
+                resp = self.rm.call(
+                    "node_heartbeat",
+                    name=self.name,
+                    exited=pending_exits,
+                    live=self.launcher.live_ids(),
+                )
+                pending_exits = {}  # delivered; a failed call retries next beat
+                if resp.get("unknown_node"):
+                    # RM restarted (or we were declared dead and came back):
+                    # containers from the previous epoch are orphans — kill
+                    # them and start clean, then re-register
+                    self.launcher.kill_all()
+                    self._register()
+                for cid in resp.get("kill", []):
+                    self.launcher.kill(cid)
+            except (RpcError, OSError):
+                pass  # RM unreachable: keep containers alive, retry next beat
+            self._stop.wait(self.heartbeat_interval_s)
+        self.launcher.kill_all()
+        self.rpc.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tony-agent", description="tony-tpu host agent (NM analog)")
+    p.add_argument("--rm", required=True, help="pool service address host:port")
+    p.add_argument("--name", default="", help="node name (default: hostname)")
+    p.add_argument("--secret", default=os.environ.get(constants.ENV_POOL_SECRET, ""))
+    p.add_argument("--memory", default="64g")
+    p.add_argument("--vcores", type=int, default=64)
+    p.add_argument("--slice-id", type=int, default=-1, help="ICI slice this host belongs to")
+    p.add_argument("--slice", default="", help="the whole slice's spec, e.g. 'v5e-16'")
+    p.add_argument("--chips", default="", help="chip coords owned by this host: 'r,c;r,c;...'")
+    p.add_argument("--bind-host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--heartbeat-ms", type=int, default=1000)
+    args = p.parse_args(argv)
+    rm_host, _, rm_port = args.rm.rpartition(":")
+    agent = NodeAgent(
+        args.name,
+        rm_host,
+        int(rm_port),
+        secret=args.secret,
+        memory=args.memory,
+        vcores=args.vcores,
+        slice_id=args.slice_id,
+        slice_spec=args.slice,
+        chips=args.chips,
+        bind_host=args.bind_host,
+        port=args.port,
+        heartbeat_interval_ms=args.heartbeat_ms,
+    )
+    signal.signal(signal.SIGTERM, lambda *_: agent.stop())
+    signal.signal(signal.SIGINT, lambda *_: agent.stop())
+    agent.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,14 +45,17 @@ from tony_tpu.runtime import get_runtime
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_resource_manager(config: TonyConfig) -> ResourceManager:
+def build_resource_manager(config: TonyConfig, app_id: str = "") -> ResourceManager:
     """Pool factory from ``tony.tpu.pool``:
     - 'local:<accel>[,RxC]' → LocalResourceManager (one host, one slice),
     - 'pool:<accel>-<chips>x<num_slices>' → MultiSliceResourceManager
-      (several ICI slices joined by DCN, best-fit gang packing).
+      (several ICI slices joined by DCN, best-fit gang packing),
+    - 'rm:<host>:<port>' → RemoteResourceManager against a running pool
+      service + host-agent fleet (cluster/pool.py — the YARN RM/NM split).
 
     The spec string lives in the frozen config so the same artifact drives
-    tests (cpu pool), one TPU VM, or a multi-slice pool.
+    tests (cpu pool), one TPU VM, a multi-slice emulation, or a real
+    multi-host pool.
     """
     spec = config.get(keys.TPU_POOL_SPEC) or "local:cpu"
     if spec.startswith("local:"):
@@ -61,6 +64,14 @@ def build_resource_manager(config: TonyConfig) -> ResourceManager:
         from tony_tpu.cluster.resources import MultiSliceResourceManager
 
         return MultiSliceResourceManager(spec)
+    if spec.startswith("rm:"):
+        from tony_tpu.cluster.pool import RemoteResourceManager
+
+        _, host, port = spec.split(":")
+        secret = config.get(keys.TPU_POOL_SECRET) or os.environ.get(
+            constants.ENV_POOL_SECRET, ""
+        )
+        return RemoteResourceManager(host, int(port), secret=secret, app_id=app_id)
     raise ValueError(f"unknown resource pool spec: {spec!r}")
 
 
@@ -75,7 +86,7 @@ class ApplicationMaster:
         self.config = config
         self.app_id = app_id
         self.staging_dir = staging_dir
-        self.rm = rm or build_resource_manager(config)
+        self.rm = rm or build_resource_manager(config, app_id)
         self.runtime = get_runtime(config)
         self.session = Session(config)
         self.scheduler = TaskScheduler(config, self.session, self.rm)

@@ -1,0 +1,559 @@
+"""Multi-host pool service: the ResourceManager daemon and its AM-side client.
+
+This supplies the reference's defining process split (SURVEY.md §2.1, §3.1
+process boundary #2): a cluster-wide RM daemon that host agents
+(cluster/agent.py, the NM analog) register with and heartbeat to, and that
+per-job Application Masters allocate containers from. Container *launch* goes
+AM → agent directly (the NMClient analog); the RM only arbitrates inventory
+and liveness — exactly YARN's split.
+
+TPU twist on the YARN resource model: a node's inventory is memory + vcores +
+the TPU chips it owns *within an ICI slice* (a v5e host owns 4 chips of its
+slice's 2D grid). A container's chip ask is satisfied from ONE node — on real
+TPU pods a training task is one process per host — so multi-host jobs are
+expressed as gangs of per-host tasks, and the pool keeps a gang's chips inside
+as few slices as possible so mesh axes ride ICI, not DCN.
+
+Node death is detected by missed agent heartbeats; containers on a dead node
+are surfaced to their AM through the normal ``poll_exited`` path with
+``EXIT_NODE_LOST`` — the AM's existing failure machinery (fail-fast or
+whole-gang restart from checkpoint) takes it from there.
+
+Deployments of the same protocol:
+  - in-process:  LocalResourceManager / MultiSliceResourceManager drive a
+    ``ContainerLauncher`` directly (resources.py) — the MiniCluster analog;
+  - distributed: this RM daemon + one NodeAgent per host, the AM holding a
+    ``RemoteResourceManager``. Same scheduler, same launcher, same env
+    contract; only the transport differs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+from tony_tpu import constants
+from tony_tpu.cluster.resources import (
+    AllocationError,
+    Container,
+    ResourceManager,
+    Resources,
+    SliceSpec,
+)
+from tony_tpu.cluster.rpc import RpcClient, RpcError, RpcServer
+
+POOL_RPC_METHODS = [
+    "register_node",
+    "node_heartbeat",
+    "allocate",
+    "release",
+    "release_all",
+    "poll_exited",
+    "request_kill",
+    "pool_status",
+]
+
+_RUNNING, _EXITED, _RELEASED = "RUNNING", "EXITED", "RELEASED"
+
+
+@dataclass(eq=False)
+class _Node:
+    """One registered host agent and its live accounting."""
+
+    name: str
+    host: str
+    port: int
+    memory_bytes: int
+    vcores: int
+    slice_id: int                       # -1 → CPU-only node
+    slice_spec: str                     # e.g. "v5e-16": the WHOLE slice's shape
+    chips: tuple[tuple[int, int], ...]  # slice-grid coords this host owns
+    used_memory: int = 0
+    used_vcores: int = 0
+    used_chips: set[tuple[int, int]] = field(default_factory=set)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    pending_kills: list[str] = field(default_factory=list)
+
+    @property
+    def free_chips(self) -> set[tuple[int, int]]:
+        return set(self.chips) - self.used_chips
+
+
+def _rect_from(free: set[tuple[int, int]], n: int) -> tuple[tuple[int, int], ...] | None:
+    """A contiguous axis-aligned n-chip rectangle from a host's free chips,
+    most-square shape first (the per-node analog of ChipGrid.allocate_chips)."""
+    if n <= 0:
+        return ()
+    if len(free) < n:
+        return None
+    rows = [r for r, _ in free]
+    cols = [c for _, c in free]
+    shapes = sorted(
+        {(r, n // r) for r in range(1, n + 1) if n % r == 0},
+        key=lambda rc: abs(rc[0] - rc[1]),
+    )
+    for r, c in shapes:
+        for r0 in range(min(rows), max(rows) - r + 2):
+            for c0 in range(min(cols), max(cols) - c + 2):
+                coords = tuple(
+                    (r0 + i, c0 + j) for i, j in itertools.product(range(r), range(c))
+                )
+                if free.issuperset(coords):
+                    return coords
+    return None
+
+
+class PoolService:
+    """The RM daemon: node registry, slice-aware inventory, per-app exits."""
+
+    def __init__(
+        self,
+        bind_host: str = "127.0.0.1",
+        port: int = 0,
+        secret: str = "",
+        heartbeat_interval_ms: int = 1000,
+        max_missed_heartbeats: int = 10,
+    ):
+        self.heartbeat_interval_ms = heartbeat_interval_ms
+        self.max_missed = max_missed_heartbeats
+        self._nodes: dict[str, _Node] = {}
+        self._containers: dict[str, dict[str, Any]] = {}   # cid → record
+        self._app_exits: dict[str, dict[str, int]] = {}    # app → {cid: rc}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.rpc = RpcServer(host=bind_host, port=port, secret=secret)
+        self.rpc.register_object(self, POOL_RPC_METHODS)
+        self._monitor = threading.Thread(target=self._liveness_loop, name="pool-liveness", daemon=True)
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        self.rpc.start()
+        self._monitor.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.rpc.stop()
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.rpc.address
+
+    # ------------------------------------------------------------ agent side
+    def register_node(
+        self,
+        name: str,
+        host: str,
+        port: int,
+        memory_bytes: int,
+        vcores: int,
+        slice_id: int = -1,
+        slice_spec: str = "",
+        chips: list[list[int]] | None = None,
+    ) -> dict[str, Any]:
+        coords = tuple((int(r), int(c)) for r, c in (chips or []))
+        with self._lock:
+            # validate FIRST: a rejected registration must not disturb a
+            # healthy node's bookkeeping (same-name check excluded — a valid
+            # re-registration replaces the old incarnation below)
+            if coords:
+                spec = SliceSpec.parse(slice_spec)
+                rows, cols = spec.topology
+                for r, c in coords:
+                    if not (0 <= r < rows and 0 <= c < cols):
+                        raise ValueError(f"chip {r},{c} outside slice grid {rows}x{cols}")
+                for other in self._nodes.values():
+                    if (
+                        other.name != name
+                        and other.alive
+                        and other.slice_id == slice_id
+                        and set(other.chips) & set(coords)
+                    ):
+                        raise ValueError(
+                            f"chips of {name} collide with {other.name} in slice {slice_id}"
+                        )
+            old = self._nodes.get(name)
+            if old is not None:
+                # agent restart: everything it was running is gone
+                self._mark_node_lost_locked(old, reason="re-registered")
+            self._nodes[name] = _Node(
+                name=name, host=host, port=port,
+                memory_bytes=int(memory_bytes), vcores=int(vcores),
+                slice_id=int(slice_id), slice_spec=slice_spec, chips=coords,
+            )
+        return {"ack": True, "heartbeat_interval_ms": self.heartbeat_interval_ms}
+
+    def node_heartbeat(
+        self, name: str, exited: dict[str, int] | None = None, live: list[str] | None = None
+    ) -> dict[str, Any]:
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None or not node.alive:
+                # we never met this agent, or declared it dead while it was
+                # partitioned — its containers were already written off
+                return {"unknown_node": True}
+            now = time.monotonic()
+            node.last_heartbeat = now
+            for cid, rc in (exited or {}).items():
+                self._record_exit_locked(cid, int(rc))
+            if live is not None:
+                # reconcile: a container the agent once reported live but is
+                # no longer tracking (and didn't just report exited) is gone —
+                # e.g. its exit report was lost across an agent hiccup. Gated
+                # on seen_live so a container allocated-but-not-yet-launched
+                # (the AM launches after the whole gang allocates) is immune.
+                live_set = set(live)
+                for cid, rec in list(self._containers.items()):
+                    if rec["node"] != name or rec["state"] != _RUNNING:
+                        continue
+                    if cid in live_set:
+                        rec["seen_live"] = True
+                    elif rec.get("seen_live") and cid not in (exited or {}):
+                        self._record_exit_locked(cid, constants.EXIT_NODE_LOST)
+            kills, node.pending_kills = node.pending_kills, []
+        return {"ack": True, "kill": kills}
+
+    # --------------------------------------------------------------- AM side
+    def allocate(
+        self,
+        app_id: str,
+        job_type: str,
+        task_index: int,
+        memory_bytes: int,
+        vcores: int,
+        chips: int = 0,
+    ) -> dict[str, Any]:
+        with self._lock:
+            alive = [n for n in self._nodes.values() if n.alive]
+            if chips > 0:
+                biggest = max((len(n.chips) for n in alive), default=0)
+                if chips > biggest:
+                    raise AllocationError(
+                        f"{job_type}:{task_index} asks {chips} chips but the largest "
+                        f"host owns {biggest}: a container runs on one host — shard "
+                        f"the job into per-host tasks (one process per TPU VM)"
+                    )
+                # pack the gang's chips into as few slices as possible: prefer
+                # slices this app already occupies, then fullest host first
+                app_slices = {
+                    rec["slice_id"]
+                    for rec in self._containers.values()
+                    if rec["app_id"] == app_id and rec["state"] == _RUNNING and rec["slice_id"] >= 0
+                }
+                candidates = sorted(
+                    (n for n in alive if n.slice_id >= 0),
+                    key=lambda n: (n.slice_id not in app_slices, len(n.free_chips)),
+                )
+            else:
+                # chipless tasks spread by free memory (headroom-first)
+                candidates = sorted(
+                    alive, key=lambda n: n.memory_bytes - n.used_memory, reverse=True
+                )
+            for node in candidates:
+                if (
+                    node.used_memory + memory_bytes > node.memory_bytes
+                    or node.used_vcores + vcores > node.vcores
+                ):
+                    continue
+                coords = _rect_from(node.free_chips, chips)
+                if coords is None:
+                    continue
+                node.used_memory += memory_bytes
+                node.used_vcores += vcores
+                node.used_chips.update(coords)
+                cid = f"container_{uuid.uuid4().hex[:12]}"
+                rec = {
+                    "id": cid, "app_id": app_id, "job_type": job_type,
+                    "task_index": int(task_index), "node": node.name,
+                    "memory_bytes": int(memory_bytes), "vcores": int(vcores),
+                    "chips": [list(c) for c in coords], "slice_id": node.slice_id,
+                    "state": _RUNNING,
+                }
+                self._containers[cid] = rec
+                return {
+                    **rec,
+                    "agent_host": node.host, "agent_port": node.port,
+                    "slice_spec": node.slice_spec,
+                }
+            raise AllocationError(
+                f"no node can host {job_type}:{task_index} "
+                f"(ask: {memory_bytes}B/{vcores}vc/{chips}ch; nodes: "
+                + ", ".join(
+                    f"{n.name}[{n.memory_bytes - n.used_memory}B free"
+                    + (f", {len(n.free_chips)}ch]" if n.chips else "]")
+                    for n in alive
+                )
+                + ")"
+            )
+
+    def release(self, app_id: str, container_id: str) -> dict[str, Any]:
+        with self._lock:
+            self._release_locked(container_id)
+        return {"ack": True}
+
+    def release_all(self, app_id: str) -> dict[str, Any]:
+        with self._lock:
+            for cid, rec in list(self._containers.items()):
+                if rec["app_id"] == app_id:
+                    self._request_kill_locked(rec)
+                    self._release_locked(cid)
+            self._app_exits.pop(app_id, None)
+        return {"ack": True}
+
+    def poll_exited(self, app_id: str) -> dict[str, int]:
+        with self._lock:
+            return self._app_exits.pop(app_id, {})
+
+    def request_kill(self, container_id: str) -> dict[str, Any]:
+        """Backstop kill path when the AM cannot reach the agent directly:
+        the order rides the agent's next heartbeat response."""
+        with self._lock:
+            rec = self._containers.get(container_id)
+            if rec is not None:
+                self._request_kill_locked(rec)
+        return {"ack": True}
+
+    def pool_status(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "nodes": [
+                    {
+                        "name": n.name, "alive": n.alive, "slice_id": n.slice_id,
+                        "chips_total": len(n.chips), "chips_free": len(n.free_chips),
+                        "memory_free": n.memory_bytes - n.used_memory,
+                        "vcores_free": n.vcores - n.used_vcores,
+                    }
+                    for n in self._nodes.values()
+                ],
+                "containers_running": sum(
+                    1 for r in self._containers.values() if r["state"] == _RUNNING
+                ),
+            }
+
+    # -------------------------------------------------------------- internal
+    def _request_kill_locked(self, rec: dict[str, Any]) -> None:
+        node = self._nodes.get(rec["node"])
+        if node is not None and node.alive and rec["state"] == _RUNNING:
+            node.pending_kills.append(rec["id"])
+
+    def _free_locked(self, rec: dict[str, Any]) -> None:
+        node = self._nodes.get(rec["node"])
+        if node is not None:
+            node.used_memory -= rec["memory_bytes"]
+            node.used_vcores -= rec["vcores"]
+            node.used_chips.difference_update(tuple(c) for c in rec["chips"])
+
+    def _record_exit_locked(self, cid: str, rc: int) -> None:
+        rec = self._containers.get(cid)
+        if rec is None or rec["state"] != _RUNNING:
+            return
+        rec["state"] = _EXITED
+        self._free_locked(rec)
+        self._app_exits.setdefault(rec["app_id"], {})[cid] = rc
+
+    def _release_locked(self, cid: str) -> None:
+        rec = self._containers.pop(cid, None)
+        if rec is not None and rec["state"] == _RUNNING:
+            self._free_locked(rec)
+
+    def _mark_node_lost_locked(self, node: _Node, reason: str) -> None:
+        node.alive = False
+        for cid, rec in self._containers.items():
+            if rec["node"] == node.name and rec["state"] == _RUNNING:
+                self._record_exit_locked(cid, constants.EXIT_NODE_LOST)
+
+    def _liveness_loop(self) -> None:
+        timeout_s = self.heartbeat_interval_ms * self.max_missed / 1000
+        while not self._stop.wait(self.heartbeat_interval_ms / 1000 / 2):
+            now = time.monotonic()
+            with self._lock:
+                for node in self._nodes.values():
+                    if node.alive and now - node.last_heartbeat > timeout_s:
+                        self._mark_node_lost_locked(node, reason="missed heartbeats")
+
+
+class RemoteResourceManager(ResourceManager):
+    """AM-side adapter speaking to a PoolService + its agents.
+
+    allocate/release/poll ride the RM; launch/kill go straight to the owning
+    node's agent (the NMClient analog). Satisfies the same ``ResourceManager``
+    interface the in-process pools do, so the AM, scheduler, and every E2E
+    behavior are unchanged.
+    """
+
+    def __init__(self, rm_host: str, rm_port: int, secret: str = "", app_id: str = ""):
+        self.app_id = app_id or f"app_{uuid.uuid4().hex[:8]}"
+        self.rm = RpcClient(rm_host, rm_port, secret=secret)
+        self.secret = secret
+        self._agents: dict[tuple[str, int], RpcClient] = {}
+        self._containers: dict[str, tuple[Container, tuple[str, int], int]] = {}
+        self._span: list[int] | None = None
+        self._lock = threading.Lock()
+
+    def _agent(self, addr: tuple[str, int]) -> RpcClient:
+        with self._lock:
+            cli = self._agents.get(addr)
+            if cli is None:
+                cli = self._agents[addr] = RpcClient(addr[0], addr[1], secret=self.secret)
+            return cli
+
+    def allocate(self, job_type: str, task_index: int, resources: Resources) -> Container:
+        try:
+            got = self.rm.call(
+                "allocate",
+                app_id=self.app_id,
+                job_type=job_type,
+                task_index=task_index,
+                memory_bytes=resources.memory_bytes,
+                vcores=resources.vcores,
+                chips=resources.chips,
+            )
+        except RpcError as e:
+            if "AllocationError" in str(e):
+                raise AllocationError(str(e)) from e
+            raise
+        coords = tuple((r, c) for r, c in got["chips"])
+        spec = SliceSpec.parse(got["slice_spec"]) if got.get("slice_spec") else None
+        container = Container(
+            id=got["id"],
+            host=got["node"],
+            resources=resources,
+            chip_coords=coords,
+            slice_name=spec.name if spec else "",
+            slice_topology=spec.topology if spec else (0, 0),
+            job_type=job_type,
+            task_index=task_index,
+        )
+        with self._lock:
+            self._containers[container.id] = (
+                container,
+                (got["agent_host"], got["agent_port"]),
+                got["slice_id"],
+            )
+        return container
+
+    def release(self, container: Container) -> None:
+        with self._lock:
+            self._containers.pop(container.id, None)
+            if not self._containers:
+                self._span = None  # gang fully released: next gang re-snapshots
+        try:
+            self.rm.call("release", app_id=self.app_id, container_id=container.id)
+        except (RpcError, OSError):
+            pass  # RM unreachable at teardown: release_all in shutdown retries
+
+    def _gang_span(self) -> list[int]:
+        """Gang DCN span, append-only across launch waves (same contract as
+        MultiSliceResourceManager.gang_slice_span): one wave's tasks all see
+        the same span; a later dependency-gated wave appends new slices so
+        earlier tasks' TPU_SLICE_ID indices stay valid."""
+        with self._lock:
+            current = {sid for _, _, sid in self._containers.values() if sid >= 0}
+            if self._span is None:
+                self._span = sorted(current)
+            else:
+                self._span.extend(sorted(current - set(self._span)))
+            return self._span
+
+    def start_container(
+        self, container: Container, command: list[str], env: dict[str, str], log_dir: str
+    ) -> None:
+        with self._lock:
+            entry = self._containers.get(container.id)
+        if entry is None:
+            raise AllocationError(f"start of unknown container {container.id}")
+        _, addr, slice_id = entry
+        # ship only the env DELTA over the AM's inherited environment: the
+        # agent merges over the REMOTE host's environ, so baseline keys
+        # (PATH, HOME, ...) must come from the node, not from the AM
+        delta = {k: v for k, v in env.items() if os.environ.get(k) != v}
+        if slice_id >= 0:
+            span = self._gang_span()
+            delta[constants.ENV_TPU_SLICE_ID] = str(span.index(slice_id))
+            delta[constants.ENV_TPU_NUM_SLICES] = str(len(span))
+        self._agent(addr).call(
+            "launch_container",
+            container_id=container.id,
+            command=command,
+            env=delta,
+            log_dir=log_dir,
+        )
+
+    def poll_exited(self) -> dict[str, int]:
+        try:
+            return {cid: int(rc) for cid, rc in self.rm.call("poll_exited", app_id=self.app_id).items()}
+        except (RpcError, OSError):
+            return {}
+
+    def kill_container(self, container: Container) -> None:
+        with self._lock:
+            entry = self._containers.get(container.id)
+        if entry is None:
+            return
+        _, addr, _ = entry
+        try:
+            self._agent(addr).call("kill_container", container_id=container.id)
+        except (RpcError, OSError):
+            # agent unreachable (dead node?) — backstop via the RM
+            try:
+                self.rm.call("request_kill", container_id=container.id)
+            except (RpcError, OSError):
+                pass
+
+    def shutdown(self) -> None:
+        try:
+            self.rm.call("release_all", app_id=self.app_id)
+        except (RpcError, OSError):
+            pass
+        with self._lock:
+            self._containers.clear()
+            agents = list(self._agents.values())
+            self._agents.clear()
+        for cli in agents:
+            cli.close()
+        self.rm.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tony-pool", description="tony-tpu pool service (RM analog)")
+    p.add_argument("--bind-host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--secret", default=os.environ.get(constants.ENV_POOL_SECRET, ""))
+    p.add_argument("--heartbeat-ms", type=int, default=1000)
+    p.add_argument("--max-missed", type=int, default=10)
+    p.add_argument("--info-file", default="", help="write host/port JSON here once serving")
+    args = p.parse_args(argv)
+    svc = PoolService(
+        bind_host=args.bind_host,
+        port=args.port,
+        secret=args.secret,
+        heartbeat_interval_ms=args.heartbeat_ms,
+        max_missed_heartbeats=args.max_missed,
+    )
+    svc.start()
+    host, port = svc.address
+    if args.info_file:
+        tmp = args.info_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"host": host, "port": port}, f)
+        os.replace(tmp, args.info_file)
+    print(f"[tony-pool] serving on {host}:{port}", flush=True)
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    signal.signal(signal.SIGINT, lambda *_: done.set())
+    done.wait()
+    svc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -221,7 +221,7 @@ def _docker_wrap(command: list[str], env: dict[str, str]) -> list[str]:
     return cmd + [image] + command
 
 
-@dataclass
+@dataclass(eq=False)  # identity hash: hosts are accounting objects, keyed by identity
 class _Host:
     name: str
     memory_bytes: int
@@ -261,19 +261,25 @@ class ResourceManager(ABC):
     def shutdown(self) -> None: ...
 
 
-class ProcessContainerMixin:
-    """Shared container realization: each container is a local subprocess in
-    its own process group with per-container stdio capture. Both the
-    single-host RM and the multi-slice pool emulation launch this way (a
-    real multi-host pool subclasses and launches over its fabric instead —
-    the AM never knows the difference)."""
+class ContainerLauncher:
+    """Agent-side container runtime (NM ``ContainerExecutor`` analog): one
+    local subprocess per container id, own process group, per-container stdio
+    capture, docker rewrite when requested.
 
-    _procs: dict[str, subprocess.Popen]
-    _reported: set[str]
-    _lock: threading.Lock
+    This is the single implementation of the *launch half* of the host-agent
+    protocol: the in-process resource managers drive it directly, and the
+    ``NodeAgent`` daemon (cluster/agent.py) drives the same object on a remote
+    host on behalf of AM launch RPCs — local and distributed pools differ only
+    in who calls it (SURVEY.md §3.1 process boundary #2).
+    """
 
-    def start_container(
-        self, container: Container, command: list[str], env: dict[str, str], log_dir: str
+    def __init__(self) -> None:
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._reported: set[str] = set()
+        self._lock = threading.Lock()
+
+    def start(
+        self, container_id: str, command: list[str], env: dict[str, str], log_dir: str
     ) -> None:
         os.makedirs(log_dir, exist_ok=True)
         if env.get(constants.ENV_CONTAINER_RUNTIME_TYPE) == "docker":
@@ -289,7 +295,7 @@ class ProcessContainerMixin:
                 start_new_session=True,  # own process group → clean kill of user subtree
             )
         with self._lock:
-            self._procs[container.id] = proc
+            self._procs[container_id] = proc
 
     def poll_exited(self) -> dict[str, int]:
         out: dict[str, int] = {}
@@ -303,9 +309,9 @@ class ProcessContainerMixin:
                     self._reported.add(cid)
         return out
 
-    def kill_container(self, container: Container) -> None:
+    def kill(self, container_id: str) -> None:
         with self._lock:
-            proc = self._procs.get(container.id)
+            proc = self._procs.get(container_id)
         if proc and proc.poll() is None:
             try:
                 os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
@@ -315,6 +321,34 @@ class ProcessContainerMixin:
                     os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
             except ProcessLookupError:
                 pass
+
+    def live_ids(self) -> list[str]:
+        with self._lock:
+            return [cid for cid, p in self._procs.items() if p.poll() is None]
+
+    def kill_all(self) -> None:
+        for cid in self.live_ids():
+            self.kill(cid)
+
+
+class ProcessContainerMixin:
+    """RM-facing adapter over a local ``ContainerLauncher``: the in-process
+    deployments (single-host RM, multi-slice pool emulation) launch through
+    the very same runtime object the NodeAgent daemon uses, so swapping in a
+    distributed pool changes the transport, never the container semantics."""
+
+    launcher: ContainerLauncher
+
+    def start_container(
+        self, container: Container, command: list[str], env: dict[str, str], log_dir: str
+    ) -> None:
+        self.launcher.start(container.id, command, env, log_dir)
+
+    def poll_exited(self) -> dict[str, int]:
+        return self.launcher.poll_exited()
+
+    def kill_container(self, container: Container) -> None:
+        self.launcher.kill(container.id)
 
     def _live_containers(self) -> list[Container]:
         raise NotImplementedError
@@ -343,9 +377,8 @@ class LocalResourceManager(ProcessContainerMixin, ResourceManager):
         self.slice = SliceSpec.parse(accel or "cpu")
         self.grid = ChipGrid(self.slice.topology)
         self.host = _Host(name or "localhost", parse_memory_string(host_memory), host_vcores)
-        self._procs: dict[str, subprocess.Popen] = {}
+        self.launcher = ContainerLauncher()
         self._containers: dict[str, Container] = {}
-        self._reported: set[str] = set()
         self._lock = threading.Lock()
 
     def allocate(self, job_type: str, task_index: int, resources: Resources) -> Container:
@@ -406,6 +439,19 @@ class _PoolSlice:
         linear = r * self.spec.topology[1] + c
         return self.hosts[min(linear // DEFAULT_CHIPS_PER_HOST, len(self.hosts) - 1)]
 
+    def hosts_of(self, coords: tuple[tuple[int, int], ...]) -> dict[int, int]:
+        """host index → chip count for every host a rect touches (a multi-host
+        allocation charges memory/vcores on every host it lands on, not just
+        the first chip's)."""
+        if not coords:
+            return {self.hosts.index(self.host_of(coords)): 0}
+        counts: dict[int, int] = {}
+        for r, c in coords:
+            linear = r * self.spec.topology[1] + c
+            h = min(linear // DEFAULT_CHIPS_PER_HOST, len(self.hosts) - 1)
+            counts[h] = counts.get(h, 0) + 1
+        return counts
+
 
 class MultiSliceResourceManager(ProcessContainerMixin, ResourceManager):
     """A pool of SEVERAL ICI slices joined by DCN (the multi-slice analog of
@@ -455,10 +501,38 @@ class MultiSliceResourceManager(ProcessContainerMixin, ResourceManager):
             self.slices.append(
                 _PoolSlice(s, slice_spec, ChipGrid(slice_spec.topology), hosts)
             )
-        self._procs: dict[str, subprocess.Popen] = {}
-        self._containers: dict[str, tuple[Container, int, _Host]] = {}
-        self._reported: set[str] = set()
+        self.launcher = ContainerLauncher()
+        self._containers: dict[str, tuple[Container, int, dict[_Host, tuple[int, int]]]] = {}
+        self._span: list[int] | None = None  # gang DCN span, snapshotted at first launch
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _host_charges(
+        sl: _PoolSlice, coords: tuple[tuple[int, int], ...], resources: Resources
+    ) -> dict[_Host, tuple[int, int]]:
+        """Split a container's memory/vcores across every host its chip rect
+        touches, pro-rata by chip count (remainder on the first host). A
+        chipless ask charges wholly on the rect's nominal host."""
+        counts = sl.hosts_of(coords)
+        total = sum(counts.values())
+        if total == 0:
+            only = next(iter(counts))
+            return {sl.hosts[only]: (resources.memory_bytes, resources.vcores)}
+        charges: dict[_Host, tuple[int, int]] = {}
+        for h, n in sorted(counts.items()):
+            charges[sl.hosts[h]] = (
+                resources.memory_bytes * n // total,
+                resources.vcores * n // total,
+            )
+        # integer remainders land on the first touched host
+        mem_used = sum(m for m, _ in charges.values())
+        vc_used = sum(v for _, v in charges.values())
+        h0 = sl.hosts[min(counts)]
+        charges[h0] = (
+            charges[h0][0] + resources.memory_bytes - mem_used,
+            charges[h0][1] + resources.vcores - vc_used,
+        )
+        return charges
 
     def allocate(self, job_type: str, task_index: int, resources: Resources) -> Container:
         chips = resources.chips
@@ -478,19 +552,20 @@ class MultiSliceResourceManager(ProcessContainerMixin, ResourceManager):
                 coords = sl.grid.allocate_chips(chips)
                 if coords is None and chips:
                     continue
-                host = sl.host_of(coords or ())
-                if (
-                    host.used_memory + resources.memory_bytes > host.memory_bytes
-                    or host.used_vcores + resources.vcores > host.vcores
+                charges = self._host_charges(sl, coords or (), resources)
+                if any(
+                    h.used_memory + mem > h.memory_bytes or h.used_vcores + vc > h.vcores
+                    for h, (mem, vc) in charges.items()
                 ):
                     if coords:
                         sl.grid.release(coords)
                     continue
-                host.used_memory += resources.memory_bytes
-                host.used_vcores += resources.vcores
+                for h, (mem, vc) in charges.items():
+                    h.used_memory += mem
+                    h.used_vcores += vc
                 c = Container(
                     id=f"container_{uuid.uuid4().hex[:12]}",
-                    host=host.name,
+                    host=sl.host_of(coords or ()).name,
                     resources=resources,
                     chip_coords=coords or (),
                     slice_name=sl.spec.name,
@@ -498,7 +573,7 @@ class MultiSliceResourceManager(ProcessContainerMixin, ResourceManager):
                     job_type=job_type,
                     task_index=task_index,
                 )
-                self._containers[c.id] = (c, sl.slice_id, host)
+                self._containers[c.id] = (c, sl.slice_id, charges)
                 return c
             raise AllocationError(
                 f"no slice can host {job_type}:{task_index} "
@@ -515,18 +590,31 @@ class MultiSliceResourceManager(ProcessContainerMixin, ResourceManager):
             entry = self._containers.pop(container.id, None)
             if entry is None:
                 return
-            c, slice_id, host = entry
+            c, slice_id, charges = entry
             self.slices[slice_id].grid.release(c.chip_coords)
-            host.used_memory -= c.resources.memory_bytes
-            host.used_vcores -= c.resources.vcores
+            for h, (mem, vc) in charges.items():
+                h.used_memory -= mem
+                h.used_vcores -= vc
+            if not self._containers:
+                # gang fully released (restart path): next gang spans anew
+                self._span = None
 
     def gang_slice_span(self) -> list[int]:
-        """Distinct slice ids the CURRENT allocations occupy, sorted. One AM
-        owns one application, and the scheduler allocates the whole gang
-        before starting any container, so at start time this is the job's
-        DCN span."""
+        """Slice ids the gang's allocations occupy — the job's DCN span.
+
+        Append-only across launch waves: the scheduler allocates a whole job
+        type before starting any of its containers, so every task in one wave
+        sees the identical span; a dependency-gated later type that lands on
+        a new slice *appends* it, keeping earlier tasks' TPU_SLICE_ID indices
+        stable (tasks in different waves never form one mesh). Reset only
+        when the gang is fully released (whole-gang restart)."""
         with self._lock:
-            return sorted({sid for _, sid, _ in self._containers.values()})
+            current = {sid for _, sid, _ in self._containers.values()}
+            if self._span is None:
+                self._span = sorted(current)
+            else:
+                self._span.extend(sorted(current - set(self._span)))
+            return self._span
 
     def start_container(
         self, container: Container, command: list[str], env: dict[str, str], log_dir: str

@@ -110,7 +110,10 @@ class RpcServer:
         return self._server.server_address[:2]
 
     def stop(self) -> None:
-        self._server.shutdown()
+        if self._thread.is_alive():
+            # shutdown() blocks on the serve_forever loop acknowledging; only
+            # safe when that loop is actually running
+            self._server.shutdown()
         self._server.server_close()
 
 

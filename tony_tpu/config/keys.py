@@ -91,9 +91,16 @@ KEYTAB_LOCATION = "tony.keytab.location"
 # tony.tpu.* — TPU-native resource model (replaces GPU-on-YARN)
 # ---------------------------------------------------------------------------
 TPU_POOL_SPEC = "tony.tpu.pool"                 # RM inventory, e.g. "v5e-64" or "host:v5e,8x8"
+TPU_POOL_SECRET = "tony.tpu.pool.secret"        # shared secret for a remote (rm:) pool service
 TPU_ACCELERATOR_TYPE = "tony.tpu.accelerator-type"  # v5e | v5p | v4 | cpu
 TPU_ICI_STRICT = "tony.tpu.ici-strict"          # never split a slice across DCN
 TPU_CHIPS_PER_HOST = "tony.tpu.chips-per-host"
+
+# ---------------------------------------------------------------------------
+# tony.node.* — host-agent liveness (pool-service ↔ NodeAgent contract)
+# ---------------------------------------------------------------------------
+NODE_HEARTBEAT_INTERVAL_MS = "tony.node.heartbeat-interval-ms"
+NODE_MAX_MISSED_HEARTBEATS = "tony.node.max-missed-heartbeats"
 
 # ---------------------------------------------------------------------------
 # tony.history.* / tony.portal.* — events, history, portal
@@ -159,9 +166,13 @@ DEFAULTS: dict[str, str] = {
     KEYTAB_LOCATION: "",
 
     TPU_POOL_SPEC: "local:cpu,1x1",
+    TPU_POOL_SECRET: "",
     TPU_ACCELERATOR_TYPE: "cpu",
     TPU_ICI_STRICT: "true",
     TPU_CHIPS_PER_HOST: "4",
+
+    NODE_HEARTBEAT_INTERVAL_MS: "1000",
+    NODE_MAX_MISSED_HEARTBEATS: "10",
 
     HISTORY_LOCATION: "",            # empty → <staging-root>/history
     HISTORY_MOVE_INTERVAL_MS: "1000",

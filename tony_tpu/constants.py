@@ -18,6 +18,7 @@ TONY_DEFAULT_CONF = "tony-default.json"  # packaged defaults (tony-default.xml a
 TONY_SITE_CONF = "tony-site.json"       # cluster-level overrides
 TONY_STAGING_DIRNAME = ".tony"          # per-app staging root
 AM_INFO_FILE = "am_info.json"           # AM host/port/secret advertisement (YARN report analog)
+POOL_INFO_FILE = "pool_info.json"       # pool-service host/port advertisement (RM address analog)
 CONFIG_SNAPSHOT_FILE = "config.json"    # job conf written alongside history (HistoryFileUtils)
 HISTORY_SUFFIX = ".jhist"               # history event file suffix (Avro .jhist analog → JSONL)
 HISTORY_INTERMEDIATE_DIR = "intermediate"
@@ -34,6 +35,8 @@ ENV_AM_PORT = "TONY_AM_PORT"
 ENV_AM_SECRET = "TONY_AM_SECRET"
 ENV_STAGING_DIR = "TONY_STAGING_DIR"
 ENV_CONTAINER_ID = "TONY_CONTAINER_ID"
+ENV_NODE_NAME = "TONY_NODE_NAME"        # host-agent name that launched this container
+ENV_POOL_SECRET = "TONY_POOL_SECRET"    # pool-service shared secret (daemons only)
 
 # Container-runtime passthrough (analog: YARN_CONTAINER_RUNTIME_TYPE /
 # YARN_CONTAINER_RUNTIME_DOCKER_IMAGE set by TonY when tony.docker.enabled).
@@ -110,6 +113,7 @@ EXIT_AM_ERROR = 10
 EXIT_EXECUTOR_REGISTRATION_FAILED = 11
 EXIT_HEARTBEAT_LOST = 12
 EXIT_KILLED = 137
+EXIT_NODE_LOST = -100   # container's host agent died (YARN ContainerExitStatus.ABORTED analog)
 
 # Distributed-mode values
 DISTRIBUTED_MODE_GANG = "GANG"

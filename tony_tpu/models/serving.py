@@ -151,6 +151,8 @@ class ContinuousBatcher:
         eos_id: int = -1, temperature: float = 0.0, top_k: int = 0,
         key: jax.Array | None = None, decode_chunk: int = 8,
     ):
+        if num_slots < 1 or max_len < 1:
+            raise ValueError(f"need num_slots>=1 and max_len>=1, got {num_slots}/{max_len}")
         self.params, self.cfg = params, cfg
         self.S, self.max_len, self.eos_id = num_slots, max_len, eos_id
         self.temperature, self.top_k = temperature, top_k

@@ -35,6 +35,12 @@ import jax.numpy as jnp
 
 from tony_tpu.ops.attention import NEG_INF, _STAT_LANES
 
+# Registry of Pallas collective_ids in this program. A collective_id names the
+# cross-device barrier-semaphore set; two concurrently-live collective kernels
+# sharing an id would alias barrier counts and silently hang. Reserve ids here.
+RING_ATTENTION_COLLECTIVE_ID = 7
+# next free id: 8
+
 
 def default_interpret():
     """InterpretParams when the env asks for emulated kernels, else False
@@ -262,7 +268,7 @@ def _ring_fwd(q, k, v, axis_name: str, causal: bool, interpret: Any):
             pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.SemaphoreType.REGULAR((2,)),    # per-slot "free" acks
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=7),
+        compiler_params=pltpu.CompilerParams(collective_id=RING_ATTENTION_COLLECTIVE_ID),
         interpret=interpret if interpret is not None else default_interpret(),
     )(jnp.full((1,), my, jnp.int32), qf, kf, vf)
     return out.reshape(B, H, Tl, D)
