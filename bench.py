@@ -140,9 +140,206 @@ def run_bench(
     }
 
 
+# ---------------------------------------------------------------------------
+# On-chip kernel smoke: numerics of every hot Pallas path ON THIS BACKEND.
+#
+# Exists because interpret-mode tests are a numerics check, not a lowering
+# check: a kernel that fails TPU lowering (or lowers to wrong math) while the
+# CPU suite stays green shows up here as a hard failure, not as a silent MFU
+# regression. Runs before every throughput bench (quick set) so the driver
+# exercises it each round; `bench.py --smoke` runs the full set standalone.
+# ---------------------------------------------------------------------------
+
+def _smoke_checks(full: bool):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tony_tpu.ops import attention as A
+    from tony_tpu.ops import layers as L
+    from tony_tpu.ops import quant as Q
+
+    def qkv(B, H, Hkv, T, D, seed=7):
+        ks = [jax.random.fold_in(jax.random.PRNGKey(seed), i) for i in range(3)]
+        q = jax.random.normal(ks[0], (B, H, T, D), jnp.float32) * 0.5
+        k = jax.random.normal(ks[1], (B, Hkv, T, D), jnp.float32) * 0.5
+        v = jax.random.normal(ks[2], (B, Hkv, T, D), jnp.float32) * 0.5
+        return q, k, v
+
+    def rel_err(a, b):
+        scale = float(jnp.max(jnp.abs(b))) + 1e-9
+        return float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))) / scale
+
+    def flash_fwd():
+        q, k, v = qkv(1, 4, 4, 1024, 128)
+        out = A._flash_fwd_impl(q, k, v, True, 256, 256)[0]
+        want = A.attention_reference(q, k, v, causal=True)
+        return rel_err(out, want)
+
+    def flash_fwd_gqa():
+        q, k, v = qkv(1, 4, 2, 512, 128, seed=11)
+        out = A._flash_fwd_impl(q, k, v, True, 256, 256)[0]
+        want = A.attention_reference(q, A.repeat_kv(k, 2), A.repeat_kv(v, 2), causal=True)
+        return rel_err(out, want)
+
+    def _bwd_err(B, H, Hkv, T, D, seed):
+        q, k, v = qkv(B, H, Hkv, T, D, seed=seed)
+        n_rep = H // Hkv
+        w = jnp.arange(D, dtype=jnp.float32)
+
+        def loss_flash(q, k, v):
+            return (A._flash_trainable(q, k, v, True) * w).sum()
+
+        def loss_ref(q, k, v):
+            return (
+                A.attention_reference(q, A.repeat_kv(k, n_rep), A.repeat_kv(v, n_rep), causal=True) * w
+            ).sum()
+
+        gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        return max(rel_err(a, b) for a, b in zip(gf, gr))
+
+    def flash_bwd():
+        # resident dkv kernel (q rows ≤ _DKV_RESIDENT_MAX_QROWS)
+        return _bwd_err(1, 4, 2, 1024, 128, seed=13)
+
+    def flash_bwd_streaming():
+        # q rows beyond the resident ceiling → causal-aware streaming dkv
+        assert 2 * 8192 > A._DKV_RESIDENT_MAX_QROWS
+        return _bwd_err(1, 2, 1, 8192, 64, seed=17)
+
+    def flash_packed():
+        # packed sequences: segment-confined attention fwd+bwd on chip
+        q, k, v = qkv(1, 2, 2, 512, 128, seed=29)
+        seg = jnp.where(jnp.arange(512) < 200, 1, 2)[None, :].astype(jnp.int32)
+        w = jnp.arange(q.shape[-1], dtype=jnp.float32)
+
+        def loss_flash(q, k, v):
+            return (A._flash_trainable_seg(q, k, v, seg, True) * w).sum()
+
+        def loss_ref(q, k, v):
+            return (A.attention_reference(q, k, v, causal=True, segment_ids=seg) * w).sum()
+
+        gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        return max(rel_err(a, b) for a, b in zip(gf, gr))
+
+    def flash_swa():
+        # sliding-window attention fwd+bwd on chip (Mixtral parity)
+        q, k, v = qkv(1, 2, 2, 1024, 128, seed=37)
+        w = jnp.arange(q.shape[-1], dtype=jnp.float32)
+        window = 300
+
+        def loss_flash(q, k, v):
+            return (A._flash_trainable(q, k, v, True, window) * w).sum()
+
+        def loss_ref(q, k, v):
+            return (A.attention_reference(q, k, v, causal=True, window=window) * w).sum()
+
+        gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        return max(rel_err(a, b) for a, b in zip(gf, gr))
+
+    def chunked_ce():
+        key = jax.random.PRNGKey(3)
+        B, T, D, V = 2, 512, 256, 2048
+        x = jax.random.normal(key, (B, T, D), jnp.float32) * 0.1
+        head = jax.random.normal(jax.random.fold_in(key, 1), (D, V), jnp.float32) * 0.05
+        tgt = jax.random.randint(jax.random.fold_in(key, 2), (B, T), 0, V)
+
+        def chunked(x, h):
+            return L.chunked_cross_entropy_loss(x, h, tgt, chunk=128)[0]
+
+        def plain(x, h):
+            return L.cross_entropy_loss(x @ h, tgt)[0]
+
+        lc, gc = jax.value_and_grad(chunked, argnums=(0, 1))(x, head)
+        lp, gp = jax.value_and_grad(plain, argnums=(0, 1))(x, head)
+        return max(rel_err(jnp.asarray(lc), jnp.asarray(lp)), *(rel_err(a, b) for a, b in zip(gc, gp)))
+
+    def int8_mm():
+        key = jax.random.PRNGKey(5)
+        x = jax.random.normal(key, (512, 1024), jnp.bfloat16)
+        w = jax.random.normal(jax.random.fold_in(key, 1), (1024, 1024), jnp.float32)
+        qt = Q.quantize_int8(w)
+        out = Q.int8_matmul(x, qt)           # tile-aligned → Pallas kernel
+        want = Q.int8_matmul_ref(x, qt)      # XLA reference of the SAME quantized math
+        return rel_err(out, want)
+
+    def remat_parity():
+        import dataclasses as dc
+        import functools as ft
+
+        from tony_tpu.models import llama
+
+        cfg = dc.replace(llama.LLAMA_TINY, max_seq=256)
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        batch = llama.synthetic_batch(jax.random.PRNGKey(1), 2, 256, cfg)
+        results = []
+        for pol in ("none", "full", "dots", "flash"):
+            c = dc.replace(cfg, remat=pol != "none", remat_policy=pol if pol != "none" else "full")
+            loss, grads = jax.jit(
+                jax.value_and_grad(lambda p: llama.loss_fn(p, batch, c, None)[0])
+            )(params)
+            gnorm = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in jax.tree.leaves(grads)))
+            results.append((float(loss), float(gnorm)))
+        l0, g0 = results[0]
+        return max(
+            max(abs(l - l0) / (abs(l0) + 1e-9), abs(g - g0) / (abs(g0) + 1e-9))
+            for l, g in results[1:]
+        )
+
+    checks = [
+        ("flash_fwd", flash_fwd, 2e-2),
+        ("flash_fwd_gqa", flash_fwd_gqa, 2e-2),
+        ("flash_bwd", flash_bwd, 2e-2),
+        ("flash_bwd_streaming", flash_bwd_streaming, 2e-2),
+        ("flash_packed", flash_packed, 2e-2),
+        ("flash_swa", flash_swa, 2e-2),
+        ("chunked_ce", chunked_ce, 2e-2),
+    ]
+    if full:
+        checks += [
+            ("int8_matmul", int8_mm, 2e-2),
+            ("remat_parity", remat_parity, 2e-2),
+        ]
+    return checks
+
+
+def run_smoke(full: bool = False) -> dict:
+    """Run the kernel smoke set; returns {"passed": n, "total": n, "failures": [...]}."""
+    import os
+
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # no chip: still meaningful as an interpreter numerics pass
+        os.environ.setdefault("TONY_PALLAS_INTERPRET", "1")
+    results, failures = [], []
+    for name, fn, tol in _smoke_checks(full):
+        t0 = time.perf_counter()
+        try:
+            err = fn()
+            ok = err < tol
+            detail = f"max_rel_err={err:.2e} tol={tol:.0e}"
+        except Exception as e:  # noqa: BLE001 — a lowering failure IS the signal
+            ok, detail = False, f"{type(e).__name__}: {e}"
+        dt = time.perf_counter() - t0
+        print(f"[smoke] {name:22s} {'PASS' if ok else 'FAIL'}  {detail}  ({dt:.1f}s)",
+              file=sys.stderr)
+        results.append(ok)
+        if not ok:
+            failures.append(f"{name}: {detail}")
+    return {"passed": sum(results), "total": len(results), "failures": failures}
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--preset", default=None, choices=["tiny", "1chip", "8b", "moe", "bert"])
+    p.add_argument("--smoke", action="store_true",
+                   help="run ONLY the on-chip kernel smoke (full set) and exit")
+    p.add_argument("--no-smoke", action="store_true",
+                   help="skip the quick kernel smoke that precedes the bench")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--batch", type=int, default=None)
@@ -157,6 +354,24 @@ def main() -> int:
 
     backend = jax.default_backend()
     preset = args.preset or ("tiny" if backend == "cpu" else "1chip")
+
+    if args.smoke:
+        smoke = run_smoke(full=True)
+        print(json.dumps({
+            "metric": "kernel_smoke_pass_fraction",
+            "value": round(smoke["passed"] / max(smoke["total"], 1), 4),
+            "unit": "fraction",
+            "vs_baseline": 1.0 if not smoke["failures"] else 0.0,
+            **smoke,
+        }))
+        return 0 if not smoke["failures"] else 1
+
+    smoke = None
+    if not args.no_smoke and backend != "cpu":
+        # every round, before trusting MFU: the hot kernels must be RIGHT on
+        # this chip, not just fast (r1 lost 6 MFU points to a silent lowering
+        # fallback the CPU suite could not see)
+        smoke = run_smoke(full=False)
 
     attempts = [preset]
     if preset != "tiny":
@@ -175,6 +390,10 @@ def main() -> int:
                 "vs_baseline": round(r["mfu"] / NORTH_STAR_MFU, 4),
                 **{k: v for k, v in r.items() if k not in ("mfu",)},
             }
+            if smoke is not None:
+                out["kernel_smoke"] = f"{smoke['passed']}/{smoke['total']}"
+                if smoke["failures"]:
+                    out["kernel_smoke_failures"] = smoke["failures"]
             print(json.dumps(out))
             return 0
         except Exception as e:  # noqa: BLE001 — fall back to a smaller preset

@@ -81,13 +81,48 @@ class TestHfImport:
         with pytest.raises(ValueError, match="unconsumed"):
             convert.params_from_hf_state_dict(sd, cfg)
 
-    def test_rope_scaling_rejected(self):
+    def test_rope_scaling_llama3_imported(self):
         from tony_tpu.models import convert
 
         hf_cfg = _tiny_hf_model().config
-        hf_cfg.rope_scaling = {"rope_type": "llama3", "factor": 8.0}
+        hf_cfg.rope_scaling = {
+            "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 8192,
+        }
+        cfg = convert.config_from_hf(hf_cfg)
+        assert cfg.rope_scaling == ("llama3", 8.0, 1.0, 4.0, 8192.0)
+
+    def test_rope_scaling_unknown_type_rejected(self):
+        from tony_tpu.models import convert
+
+        hf_cfg = _tiny_hf_model().config
+        hf_cfg.rope_scaling = {"rope_type": "yarn", "factor": 8.0}
         with pytest.raises(NotImplementedError, match="rope_scaling"):
             convert.config_from_hf(hf_cfg)
+
+    def test_llama3_rope_matches_hf(self):
+        # frequency-band scaling must reproduce transformers' llama3 rope
+        import numpy as np
+
+        from tony_tpu.ops import layers as L
+
+        dim, theta = 64, 500_000.0
+        factor, lo, hi, orig = 8.0, 1.0, 4.0, 8192
+        cos, sin = L.rope_frequencies(
+            dim, 64, theta, ("llama3", factor, lo, hi, orig)
+        )
+        # reference computation (transformers _compute_llama3_parameters),
+        # in f64 so the band-boundary comparisons don't flip vs the jnp f32
+        inv = 1.0 / (theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+        wavelen = 2 * np.pi / inv
+        inv_llama = np.where(wavelen > orig / lo, inv / factor, inv)
+        smooth = (orig / wavelen - lo) / (hi - lo)
+        is_mid = (wavelen >= orig / hi) & (wavelen <= orig / lo)
+        want_inv = np.where(is_mid, (1 - smooth) * inv / factor + smooth * inv, inv_llama)
+        t = np.arange(64, dtype=np.float64)
+        np.testing.assert_allclose(
+            np.asarray(cos), np.cos(np.outer(t, want_inv)), rtol=1e-4, atol=1e-5
+        )
 
     def test_generation_runs_on_imported_weights(self):
         from tony_tpu.models import convert, generate

@@ -300,3 +300,40 @@ class TestRemoteResourceManagerUnit:
         with pytest.raises(AllocationError):
             rm.allocate("worker", 0, Resources(memory_bytes=1024))  # no nodes at all
         rm.shutdown()
+
+
+class TestPoolCredential:
+    """tony.keytab.* wiring: the keytab file is the pool credential source
+    (Kerberos-keytab analog); keytab.user asserts the submitting identity."""
+
+    def test_keytab_file_supplies_pool_secret(self, tmp_path):
+        from tony_tpu.cluster.appmaster import _pool_credential
+
+        kt = tmp_path / "pool.keytab"
+        kt.write_text("s3cret-from-keytab\n")
+        cfg = TonyConfig({keys.KEYTAB_LOCATION: str(kt)})
+        assert _pool_credential(cfg) == "s3cret-from-keytab"
+
+    def test_explicit_secret_wins_over_keytab(self, tmp_path):
+        from tony_tpu.cluster.appmaster import _pool_credential
+
+        kt = tmp_path / "pool.keytab"
+        kt.write_text("from-file")
+        cfg = TonyConfig({
+            keys.KEYTAB_LOCATION: str(kt), keys.TPU_POOL_SECRET: "explicit",
+        })
+        assert _pool_credential(cfg) == "explicit"
+
+    def test_missing_keytab_fails_fast(self):
+        from tony_tpu.cluster.appmaster import _pool_credential
+
+        cfg = TonyConfig({keys.KEYTAB_LOCATION: "/nonexistent/pool.keytab"})
+        with pytest.raises(FileNotFoundError):
+            _pool_credential(cfg)
+
+    def test_wrong_keytab_user_rejected(self):
+        from tony_tpu.cluster.appmaster import _pool_credential
+
+        cfg = TonyConfig({keys.KEYTAB_USER: "definitely-not-this-user"})
+        with pytest.raises(PermissionError, match="keytab.user"):
+            _pool_credential(cfg)

@@ -162,7 +162,9 @@ def _cmd_pool(argv: list[str]) -> int:
         slice_spec = SliceSpec.parse(base if count.isdigit() and base else args.spec)
         rows, cols = slice_spec.topology
         per_host = min(DEFAULT_CHIPS_PER_HOST, slice_spec.chips)
-        hosts_per_slice = max(1, slice_spec.chips // per_host)
+        # ceil: a slice whose chip count is not a host multiple still
+        # registers ALL its chips (the last host owns the remainder)
+        hosts_per_slice = -(-slice_spec.chips // per_host)
         for s in range(num_slices):
             # tile the slice grid onto hosts row-major, per_host chips each
             linear = [(r, c) for r in range(rows) for c in range(cols)]

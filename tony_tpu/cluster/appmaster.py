@@ -68,11 +68,35 @@ def build_resource_manager(config: TonyConfig, app_id: str = "") -> ResourceMana
         from tony_tpu.cluster.pool import RemoteResourceManager
 
         _, host, port = spec.split(":")
-        secret = config.get(keys.TPU_POOL_SECRET) or os.environ.get(
-            constants.ENV_POOL_SECRET, ""
-        )
+        secret = _pool_credential(config)
         return RemoteResourceManager(host, int(port), secret=secret, app_id=app_id)
     raise ValueError(f"unknown resource pool spec: {spec!r}")
+
+
+def _pool_credential(config: TonyConfig) -> str:
+    """Credential for a secured pool service, resolved in order: explicit
+    ``tony.tpu.pool.secret`` → TONY_POOL_SECRET env → the keytab file
+    (``tony.keytab.location`` — the reference's Kerberos-keytab analog: a
+    file on disk carrying the cluster credential). ``tony.keytab.user``,
+    when set, asserts the submitting identity the way a kinit would."""
+    user = config.get(keys.KEYTAB_USER)
+    if user:
+        import getpass
+
+        actual = getpass.getuser()
+        if user != actual:
+            raise PermissionError(
+                f"tony.keytab.user={user!r} but submitting as {actual!r}"
+            )
+    secret = config.get(keys.TPU_POOL_SECRET) or os.environ.get(constants.ENV_POOL_SECRET, "")
+    if not secret:
+        keytab = config.get(keys.KEYTAB_LOCATION)
+        if keytab:
+            if not os.path.exists(keytab):
+                raise FileNotFoundError(f"tony.keytab.location={keytab} does not exist")
+            with open(keytab) as f:
+                secret = f.read().strip()
+    return secret
 
 
 class ApplicationMaster:

@@ -472,10 +472,20 @@ class RemoteResourceManager(ResourceManager):
         if entry is None:
             raise AllocationError(f"start of unknown container {container.id}")
         _, addr, slice_id = entry
-        # ship only the env DELTA over the AM's inherited environment: the
-        # agent merges over the REMOTE host's environ, so baseline keys
-        # (PATH, HOME, ...) must come from the node, not from the AM
-        delta = {k: v for k, v in env.items() if os.environ.get(k) != v}
+        # ship the job-facing env, not the AM's machine baseline: keys the
+        # framework contract owns (TONY_/JAX_/TPU_/... prefixes, same
+        # whitelist the docker runtime forwards) plus anything the AM
+        # changed relative to its inherited environment. Baseline keys the
+        # AM merely inherited (PATH, HOME, ...) come from the REMOTE node's
+        # environ, which the agent merges under the shipped delta.
+        from tony_tpu.cluster.resources import _DOCKER_ENV_PREFIXES
+
+        delta = {
+            k: v
+            for k, v in env.items()
+            if any(k.startswith(p) for p in _DOCKER_ENV_PREFIXES)
+            or os.environ.get(k) != v
+        }
         if slice_id >= 0:
             span = self._gang_span()
             delta[constants.ENV_TPU_SLICE_ID] = str(span.index(slice_id))
@@ -524,20 +534,31 @@ class RemoteResourceManager(ResourceManager):
 
 
 def main(argv: list[str] | None = None) -> int:
+    from tony_tpu.config import TonyConfig, keys
+
     p = argparse.ArgumentParser(prog="tony-pool", description="tony-tpu pool service (RM analog)")
     p.add_argument("--bind-host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--secret", default=os.environ.get(constants.ENV_POOL_SECRET, ""))
-    p.add_argument("--heartbeat-ms", type=int, default=1000)
-    p.add_argument("--max-missed", type=int, default=10)
+    p.add_argument("--conf_file", default=None, help="site config supplying tony.node.* liveness keys")
+    p.add_argument("--conf", action="append", default=[], help="key=value override (repeatable)")
+    p.add_argument("--heartbeat-ms", type=int, default=None,
+                   help="overrides tony.node.heartbeat-interval-ms")
+    p.add_argument("--max-missed", type=int, default=None,
+                   help="overrides tony.node.max-missed-heartbeats")
     p.add_argument("--info-file", default="", help="write host/port JSON here once serving")
     args = p.parse_args(argv)
+    config = TonyConfig.from_layers(conf_file=args.conf_file, conf_args=args.conf)
     svc = PoolService(
         bind_host=args.bind_host,
         port=args.port,
         secret=args.secret,
-        heartbeat_interval_ms=args.heartbeat_ms,
-        max_missed_heartbeats=args.max_missed,
+        heartbeat_interval_ms=args.heartbeat_ms
+        if args.heartbeat_ms is not None
+        else config.get_time_ms(keys.NODE_HEARTBEAT_INTERVAL_MS, 1000),
+        max_missed_heartbeats=args.max_missed
+        if args.max_missed is not None
+        else config.get_int(keys.NODE_MAX_MISSED_HEARTBEATS, 10),
     )
     svc.start()
     host, port = svc.address

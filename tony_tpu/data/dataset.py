@@ -85,3 +85,38 @@ def read_shard(path: str | Path) -> np.ndarray:
     """Read a whole shard as int32 (materializes; fine for tools/tests —
     streaming consumers should use open_shard / TokenLoader)."""
     return np.asarray(open_shard(path), dtype=np.int32)
+
+
+def pack_sequences(
+    sequences: list[np.ndarray] | list[list[int]],
+    seq_len: int,
+    pad_id: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """First-fit pack variable-length sequences into [N, seq_len] rows.
+
+    Returns (tokens, segment_ids), both [N, seq_len] int32. Each row holds
+    one or more whole sequences back to back; segment_ids number them 1, 2,
+    ... within the row, with 0 marking trailing padding. Feed both to
+    ``llama.loss_fn`` (as ``tokens``/``segment_ids``): attention and RoPE
+    stay confined per segment and cross-boundary/pad targets are masked.
+    Sequences longer than seq_len are split into seq_len-sized pieces.
+    """
+    rows: list[tuple[list[int], list[int]]] = []  # (tokens, segs), mutable fill
+    for seq in sequences:
+        seq = list(np.asarray(seq, dtype=np.int32))
+        for off in range(0, len(seq), seq_len):
+            piece = seq[off:off + seq_len]
+            for toks, segs in rows:
+                if len(toks) + len(piece) <= seq_len:
+                    seg_id = segs[-1] + 1 if segs else 1
+                    toks.extend(int(t) for t in piece)
+                    segs.extend([seg_id] * len(piece))
+                    break
+            else:
+                rows.append(([int(t) for t in piece], [1] * len(piece)))
+    tokens = np.full((len(rows), seq_len), pad_id, dtype=np.int32)
+    segment_ids = np.zeros((len(rows), seq_len), dtype=np.int32)
+    for i, (toks, segs) in enumerate(rows):
+        tokens[i, : len(toks)] = toks
+        segment_ids[i, : len(segs)] = segs
+    return tokens, segment_ids

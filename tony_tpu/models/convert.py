@@ -22,17 +22,14 @@ from tony_tpu.models.llama import LlamaConfig
 def _reject_unsupported(hf_config) -> None:
     """Checkpoint features the native models do not implement raise here,
     rather than importing something that silently diverges."""
-    if getattr(hf_config, "rope_scaling", None):
-        raise NotImplementedError(
-            "rope_scaling (Llama 3.1+ long-context scaling) is not implemented "
-            "in ops/layers.rope_frequencies — importing would silently diverge "
-            "from the HF forward at long positions"
-        )
-    if getattr(hf_config, "sliding_window", None):
-        raise NotImplementedError(
-            "sliding_window attention is not implemented — the native models "
-            "attend full-causal, which diverges beyond the window"
-        )
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        kind = scaling.get("rope_type", scaling.get("type"))
+        if kind not in ("llama3", "linear"):
+            raise NotImplementedError(
+                f"rope_scaling type {kind!r} is not implemented (llama3 and "
+                "linear are; yarn/dynamic would silently diverge)"
+            )
     explicit_hd = getattr(hf_config, "head_dim", None)
     derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
     if explicit_hd is not None and explicit_hd != derived_hd:
@@ -45,6 +42,25 @@ def _reject_unsupported(hf_config) -> None:
             "attention_bias/mlp_bias checkpoints are not supported (the native "
             "block has no bias terms)"
         )
+
+
+def _rope_scaling_tuple(hf_config) -> tuple:
+    """HF rope_scaling dict → the hashable tuple ops/layers expects."""
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if not scaling:
+        return ()
+    kind = scaling.get("rope_type", scaling.get("type"))
+    if kind == "linear":
+        return ("linear", float(scaling["factor"]))
+    if kind == "llama3":
+        return (
+            "llama3",
+            float(scaling["factor"]),
+            float(scaling["low_freq_factor"]),
+            float(scaling["high_freq_factor"]),
+            float(scaling["original_max_position_embeddings"]),
+        )
+    raise NotImplementedError(f"rope_scaling type {kind!r}")
 
 
 def config_from_hf(hf_config, dtype: str = "bfloat16", **overrides) -> LlamaConfig:
@@ -61,6 +77,8 @@ def config_from_hf(hf_config, dtype: str = "bfloat16", **overrides) -> LlamaConf
         rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
         norm_eps=hf_config.rms_norm_eps,
         dtype=dtype,
+        sliding_window=int(getattr(hf_config, "sliding_window", None) or 0),
+        rope_scaling=_rope_scaling_tuple(hf_config),
     )
     return dataclasses.replace(base, **overrides) if overrides else base
 

@@ -49,6 +49,15 @@ class KVCache(NamedTuple):
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> KVCache:
+    if cfg.sliding_window and max_len > cfg.sliding_window:
+        # the decode path attends the WHOLE cache; beyond the window that
+        # silently diverges from training/HF — fail fast until a windowed
+        # (rolling-buffer) cache exists. Within the window, full == banded.
+        raise NotImplementedError(
+            f"decode beyond sliding_window={cfg.sliding_window} needs a "
+            f"rolling KV cache (asked max_len={max_len}); cap max_len to the "
+            "window or clear cfg.sliding_window for full-causal decode"
+        )
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
     return KVCache(
         k=jnp.zeros(shape, cfg.jdtype),
@@ -110,7 +119,7 @@ def _block_with_cache(x, lp, ck, cv, length, cos, sin, cfg: LlamaConfig):
 def _forward_with_cache(params, tokens, cache: KVCache, cfg: LlamaConfig):
     """tokens [B, Tq] (new tokens only) → (logits [B, Tq, V], cache')."""
     maxT = cache.k.shape[3]
-    cos, sin = L.rope_frequencies(cfg.head_dim, maxT, cfg.rope_theta)
+    cos, sin = L.rope_frequencies(cfg.head_dim, maxT, cfg.rope_theta, cfg.rope_scaling)
     x = _embed_lookup(params["embed"], tokens, cfg.jdtype)
 
     def layer(x, inputs):

@@ -48,6 +48,8 @@ class LlamaConfig:
     attn_impl: str = "auto"   # auto | flash | reference
     cp_impl: str = "xla"      # context parallel: xla (ppermute ring) | pallas (remote-DMA ring) | ulysses (all-to-all)
     ce_chunk: int = 512       # fused lm-head+CE chunk length; 0 = materialize logits
+    sliding_window: int = 0   # >0: Mistral/Mixtral-style sliding-window attention
+    rope_scaling: tuple = ()  # () | ("linear", f) | ("llama3", f, lo, hi, orig) — see ops/layers.rope_frequencies
 
     @property
     def head_dim(self) -> int:
@@ -136,18 +138,28 @@ def sharding_rules(cfg: LlamaConfig) -> ShardingRules:
     ])
 
 
-def _attention(q, k, v, cfg: LlamaConfig, mesh) -> jax.Array:
+def _attention(q, k, v, cfg: LlamaConfig, mesh, segment_ids=None) -> jax.Array:
     """Dispatch: context-parallel attention (cfg.cp_impl: XLA ring,
     Pallas remote-DMA ring, or Ulysses all-to-all) when the context axis is
     real, else fused single-device MHA.
 
-    q: [B, H, T, Dh]; k/v: [B, Hkv, T, Dh].
+    q: [B, H, T, Dh]; k/v: [B, Hkv, T, Dh]; segment_ids [B, T] (packing).
     """
     if cfg.cp_impl not in ("xla", "pallas", "ulysses"):
         raise ValueError(
             f"cp_impl must be 'xla', 'pallas', or 'ulysses', got {cfg.cp_impl!r}"
         )
     if mesh is not None and mesh.shape.get("context", 1) > 1:
+        if segment_ids is not None:
+            raise ValueError(
+                "sequence packing (segment_ids) does not compose with a "
+                "context axis yet — pack on the data/fsdp axes instead"
+            )
+        if cfg.sliding_window > 0:
+            raise ValueError(
+                "sliding_window does not compose with a context axis yet — "
+                "a windowed sequence rarely needs CP in the first place"
+            )
         if cfg.cp_impl == "pallas":
             # remote-DMA ring kernel: GQA-native (KV stays at Hkv width on
             # the wire); fully-manual shard_map because the kernel manages
@@ -209,10 +221,28 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh) -> jax.Array:
             check_vma=False,
         )
         return ring(q, k, v)
-    return attn_ops.mha(q, k, v, causal=True, impl=cfg.attn_impl)
+    return attn_ops.mha(
+        q, k, v, causal=True, impl=cfg.attn_impl, segment_ids=segment_ids,
+        window=cfg.sliding_window,
+    )
 
 
-def _block(x: jax.Array, lp: dict, cos, sin, cfg: LlamaConfig, mesh) -> tuple[jax.Array, None]:
+def segment_positions(segment_ids: jax.Array) -> jax.Array:
+    """[B, T] per-segment positions (0-based, restarting at each segment
+    boundary) for RoPE on packed batches."""
+    B, T = segment_ids.shape
+    idx = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    is_start = jnp.concatenate(
+        [jnp.ones((B, 1), bool), segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1
+    )
+    start = jax.lax.cummax(jnp.where(is_start, idx, 0), axis=1)
+    return idx - start
+
+
+def _block(
+    x: jax.Array, lp: dict, cos, sin, cfg: LlamaConfig, mesh,
+    segment_ids=None, positions=None,
+) -> tuple[jax.Array, None]:
     """One decoder block (pre-norm attention + SwiGLU), scan-compatible.
     Shared by the flat layer scan (hidden_states) and the pipeline stage
     body (pp_loss_fn, where mesh is None — stages run per-device)."""
@@ -223,9 +253,9 @@ def _block(x: jax.Array, lp: dict, cos, sin, cfg: LlamaConfig, mesh) -> tuple[ja
     q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
     k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(B, T, Hkv, Dh).transpose(0, 2, 1, 3)
     v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(B, T, Hkv, Dh).transpose(0, 2, 1, 3)
-    q = L.apply_rope(q, cos, sin)
-    k = L.apply_rope(k, cos, sin)
-    o = _attention(q, k, v, cfg, mesh)
+    q = L.apply_rope(q, cos, sin, positions=positions)
+    k = L.apply_rope(k, cos, sin, positions=positions)
+    o = _attention(q, k, v, cfg, mesh, segment_ids=segment_ids)
     o = o.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
     x = x + jnp.einsum("bth,hd->btd", o, lp["wo"])
     if mesh is not None:
@@ -237,17 +267,25 @@ def _block(x: jax.Array, lp: dict, cos, sin, cfg: LlamaConfig, mesh) -> tuple[ja
     return x, None
 
 
-def hidden_states(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax.Array:
-    """tokens [B, T] int32 → final-norm hidden states [B, T, D]."""
+def hidden_states(
+    params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None, segment_ids=None
+) -> jax.Array:
+    """tokens [B, T] int32 → final-norm hidden states [B, T, D].
+
+    ``segment_ids`` [B, T] enables packed-sequence training: attention is
+    confined within segments (flash-kernel-native masking) and RoPE
+    positions restart at every segment boundary."""
     T = tokens.shape[1]
-    cos, sin = L.rope_frequencies(cfg.head_dim, T, cfg.rope_theta)
+    cos, sin = L.rope_frequencies(cfg.head_dim, T, cfg.rope_theta, cfg.rope_scaling)
+    positions = segment_positions(segment_ids) if segment_ids is not None else None
 
     x = jnp.take(params["embed"], tokens, axis=0)
     if mesh is not None:
         x = constrain(x, mesh, P(BATCH_AXES, "context", None))
 
     block_fn = attn_ops.remat_block(
-        partial(_block, cos=cos, sin=sin, cfg=cfg, mesh=mesh),
+        partial(_block, cos=cos, sin=sin, cfg=cfg, mesh=mesh,
+                segment_ids=segment_ids, positions=positions),
         cfg.remat, cfg.remat_policy,
     )
     x, _ = jax.lax.scan(block_fn, x, params["layers"])
@@ -274,9 +312,14 @@ def pp_loss_fn(
         return loss_fn(params, batch, cfg, mesh)
     if mesh.shape.get("context", 1) > 1:
         raise ValueError("pp_loss_fn does not compose with a context axis")
+    if "segment_ids" in batch:
+        raise ValueError(
+            "pp_loss_fn does not support packed batches (segment_ids) yet — "
+            "silently ignoring them would train across document boundaries"
+        )
     tokens = batch["tokens"]
     T = tokens.shape[1] - 1
-    cos, sin = L.rope_frequencies(cfg.head_dim, T, cfg.rope_theta)
+    cos, sin = L.rope_frequencies(cfg.head_dim, T, cfg.rope_theta, cfg.rope_scaling)
     x = jnp.take(params["embed"], tokens[:, :-1], axis=0)
 
     block_fn = attn_ops.remat_block(
@@ -299,9 +342,11 @@ def pp_loss_fn(
     return loss, {"loss": loss, "tokens": n}
 
 
-def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax.Array:
+def forward(
+    params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None, segment_ids=None
+) -> jax.Array:
     """tokens [B, T] int32 → logits [B, T, V]."""
-    x = hidden_states(params, tokens, cfg, mesh)
+    x = hidden_states(params, tokens, cfg, mesh, segment_ids=segment_ids)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"])
     if mesh is not None:
         logits = constrain(logits, mesh, P(BATCH_AXES, "context", None))
@@ -309,21 +354,33 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig, mesh=None) -> jax
 
 
 def loss_fn(params: dict, batch: dict, cfg: LlamaConfig, mesh=None) -> tuple[jax.Array, dict]:
-    """batch: {"tokens": [B, T+1]} → next-token CE loss.
+    """batch: {"tokens": [B, T+1], optional "segment_ids": [B, T+1]} →
+    next-token CE loss.
 
     With ``cfg.ce_chunk > 0`` the lm-head matmul and CE are fused per
     sequence chunk (ops/layers.chunked_cross_entropy_loss) so the [B, T, V]
     logits never exist — the activation that otherwise bounds batch size.
+
+    With ``segment_ids`` (packed sequences), attention and RoPE respect
+    segment boundaries and the cross-boundary targets (a segment's last
+    token predicting the NEXT segment's first) are masked out of the loss.
     """
     tokens = batch["tokens"]
+    seg = batch.get("segment_ids")
+    targets = tokens[:, 1:]
+    if seg is not None:
+        # valid next-token pairs stay within one segment; segment 0 is padding
+        ok = (seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] != 0)
+        targets = jnp.where(ok, targets, -100)
+    seg_in = seg[:, :-1] if seg is not None else None
     if cfg.ce_chunk > 0:
-        x = hidden_states(params, tokens[:, :-1], cfg, mesh)
+        x = hidden_states(params, tokens[:, :-1], cfg, mesh, segment_ids=seg_in)
         loss, n = L.chunked_cross_entropy_loss(
-            x, params["lm_head"], tokens[:, 1:], chunk=cfg.ce_chunk
+            x, params["lm_head"], targets, chunk=cfg.ce_chunk
         )
     else:
-        logits = forward(params, tokens[:, :-1], cfg, mesh)
-        loss, n = L.cross_entropy_loss(logits, tokens[:, 1:])
+        logits = forward(params, tokens[:, :-1], cfg, mesh, segment_ids=seg_in)
+        loss, n = L.cross_entropy_loss(logits, targets)
     return loss, {"loss": loss, "tokens": n}
 
 

@@ -62,6 +62,7 @@ class MixtralConfig(llama_mod.LlamaConfig):
 MIXTRAL_8X7B = MixtralConfig(
     vocab_size=32_000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
     d_ff=14_336, max_seq=8192, rope_theta=1e6, num_experts=8, top_k=2,
+    sliding_window=4096,  # real Mixtral-8x7B (v0.1) uses a 4096 SWA band
 )
 MIXTRAL_TINY = MixtralConfig(
     vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
@@ -110,7 +111,7 @@ def hidden_states(params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None
     """tokens [B, T] → (final-norm hidden states [B, T, D], moe aux losses)."""
     B, T = tokens.shape
     Dh, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
-    cos, sin = L.rope_frequencies(Dh, T, cfg.rope_theta)
+    cos, sin = L.rope_frequencies(Dh, T, cfg.rope_theta, cfg.rope_scaling)
     act_spec = P(BATCH_AXES, "context", None)
 
     x = jnp.take(params["embed"], tokens, axis=0)

@@ -50,41 +50,80 @@ def attention_reference(
     *,
     causal: bool = True,
     scale: float | None = None,
+    segment_ids: jax.Array | None = None,
+    window: int = 0,
 ) -> jax.Array:
-    """Plain attention; q/k/v: [B, H, T, D] (KV already head-broadcast)."""
+    """Plain attention; q/k/v: [B, H, T, D] (KV already head-broadcast).
+
+    ``segment_ids`` [B, T] (packed sequences): attention is confined within
+    each segment — position i attends j only when seg[i] == seg[j].
+    ``window`` > 0: sliding-window (Mistral/Mixtral-style) — position i
+    attends only the last ``window`` positions (i−window, i].
+    """
     D = q.shape[-1]
     scale = scale if scale is not None else D ** -0.5
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    Tq, Tk = s.shape[-2], s.shape[-1]
+    q_pos = jnp.arange(Tq)[:, None] + (Tk - Tq)
+    k_pos = jnp.arange(Tk)[None, :]
     if causal:
-        Tq, Tk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool), Tk - Tq)
-        s = jnp.where(mask, s, NEG_INF)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if window > 0:
+        s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
+    if segment_ids is not None:
+        same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        s = jnp.where(same, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def _seg_arrays(segment_ids: jax.Array, B: int, T: int) -> tuple[jax.Array, jax.Array]:
+    """Lane-/sublane-replicated segment-id layouts the kernels can tile:
+    q-side [B, T, _STAT_LANES] (rows) and k-side [B, _STAT_LANES, T] (cols)."""
+    s = segment_ids.astype(jnp.int32)
+    segq = jnp.broadcast_to(s[:, :, None], (B, T, _STAT_LANES))
+    segk = jnp.broadcast_to(s[:, None, :], (B, _STAT_LANES, T))
+    return segq, segk
 
 
 # ---------------------------------------------------------------------------
 # Pallas flash attention (TPU)
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float):
+def _flash_kernel(
+    q_ref, k_ref, v_ref, *rest,
+    block_k: int, causal: bool, has_seg: bool, window: int, scale: float,
+):
     """Grid: (B*H, Tq//block_q). Online softmax over KV blocks in VMEM.
 
     Also emits the per-row logsumexp (scaled-score space) so the Pallas
     backward can recompute probabilities blockwise without the T×T matrix.
+    With ``has_seg``, two extra refs carry packed-sequence segment ids
+    (q-side rows, k-side cols) and scores cross segments are masked.
+    ``window`` > 0 adds the sliding-window band: k blocks wholly before the
+    window are skipped (no DMA, no flops), partial blocks are masked.
     """
     from jax.experimental import pallas as pl
 
+    if has_seg:
+        segq_ref, segk_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     block_q, D = q_ref.shape
     Tk = k_ref.shape[0]
     q_blk_idx = pl.program_id(1)
     q = q_ref[:] .astype(jnp.float32) * scale
     q_pos = q_blk_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    sq = segq_ref[:][:, :1] if has_seg else None  # [block_q, 1]
 
     num_k_blocks = pl.cdiv(Tk, block_k)
+    kb_start = 0
     if causal:
         # only blocks at or below the diagonal contribute
         num_k_blocks = jnp.minimum(num_k_blocks, (q_blk_idx + 1) * block_q // block_k + 1)
+    if window > 0:
+        # first k position any row of this q block can see: q_first−window+1
+        kb_start = jnp.maximum(0, (q_blk_idx * block_q - window + 1) // block_k)
 
     def body(kb, carry):
         o, m, l = carry
@@ -93,9 +132,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: 
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
         if causal:
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if window > 0:
+            s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
+        if has_seg:
+            sk = segk_ref[:1, pl.ds(kb * block_k, block_k)]  # [1, block_k]
+            s = jnp.where(sq == sk, s, NEG_INF)
         m_b = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_b)
         alpha = jnp.exp(m - m_new)
@@ -109,22 +153,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: 
     o0 = jnp.zeros((block_q, D), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    o, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (o0, m0, l0))
+    o, m, l = jax.lax.fori_loop(kb_start, num_k_blocks, body, (o0, m0, l0))
     l = jnp.maximum(l, 1e-20)
     o_ref[:] = (o / l).astype(o_ref.dtype)
     lse_ref[:] = jnp.broadcast_to(m + jnp.log(l), (block_q, _STAT_LANES))
 
 
 def _flash_fwd_impl(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, block_q: int, block_k: int
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, block_q: int, block_k: int,
+    segment_ids: jax.Array | None = None, window: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """Shared forward: ([B,H,Tq,D], lse [B,H,Tq]) — shapes pre-validated."""
-    out, lse_lanes = _flash_fwd_lanes(q, k, v, causal, block_q, block_k)
+    out, lse_lanes = _flash_fwd_lanes(q, k, v, causal, block_q, block_k, segment_ids, window)
     return out, lse_lanes[:, :, :, 0]
 
 
 def _flash_fwd_lanes(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, block_q: int, block_k: int
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, block_q: int, block_k: int,
+    segment_ids: jax.Array | None = None, window: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """Forward returning the lane-replicated lse [B,H,Tq,_STAT_LANES] so the
     backward can feed it to the Pallas kernels without a re-broadcast.
@@ -146,15 +192,28 @@ def _flash_fwd_lanes(
     kf = k.reshape(B * Hkv, Tk, D)
     vf = v.reshape(B * Hkv, Tk, D)
 
-    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal, scale=scale)
+    has_seg = segment_ids is not None
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, has_seg=has_seg,
+        window=window, scale=scale,
+    )
+    in_specs = [
+        pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((None, Tk, D), lambda b, i: (b // n_rep, 0, 0)),
+        pl.BlockSpec((None, Tk, D), lambda b, i: (b // n_rep, 0, 0)),
+    ]
+    operands = [qf, kf, vf]
+    if has_seg:
+        segq, segk = _seg_arrays(segment_ids, B, Tq)
+        in_specs += [
+            pl.BlockSpec((None, block_q, _STAT_LANES), lambda b, i: (b // H, i, 0)),
+            pl.BlockSpec((None, _STAT_LANES, Tk), lambda b, i: (b // H, 0, 0)),
+        ]
+        operands += [segq, segk]
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, Tk, D), lambda b, i: (b // n_rep, 0, 0)),
-            pl.BlockSpec((None, Tk, D), lambda b, i: (b // n_rep, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, block_q, _STAT_LANES), lambda b, i: (b, i, 0)),
@@ -172,11 +231,11 @@ def _flash_fwd_lanes(
             bytes_accessed=2 * (qf.size + kf.size + vf.size) * q.dtype.itemsize,
             transcendentals=B * H * Tq * Tk,
         ),
-    )(qf, kf, vf)
+    )(*operands)
     return out.reshape(B, H, Tq, D), lse.reshape(B, H, Tq, _STAT_LANES)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "window"))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -185,27 +244,41 @@ def flash_attention(
     causal: bool = True,
     block_q: int = 256,
     block_k: int = 256,
+    segment_ids: jax.Array | None = None,
+    window: int = 0,
 ) -> jax.Array:
     """Pallas TPU flash attention; q: [B, H, T, D], k/v: [B, Hkv, T, D] with
-    H % Hkv == 0 (GQA handled inside the kernel), T % block == 0."""
+    H % Hkv == 0 (GQA handled inside the kernel), T % block == 0.
+    ``segment_ids`` [B, T] confines attention within packed segments
+    (training-shape only: Tq == Tk). ``window`` > 0: sliding-window band —
+    out-of-band k blocks are skipped entirely (no DMA, no flops)."""
     B, H, Tq, D = q.shape
     Hkv, Tk = k.shape[1], k.shape[2]
     if H % Hkv:
         raise ValueError(f"n_heads {H} must be divisible by n_kv_heads {Hkv}")
+    if segment_ids is not None and Tq != Tk:
+        raise ValueError(f"segment_ids requires Tq == Tk, got {Tq} vs {Tk}")
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
     if Tq % block_q or Tk % block_k:
-        return attention_reference(q, repeat_kv(k, H // Hkv), repeat_kv(v, H // Hkv), causal=causal)
-    return _flash_fwd_impl(q, k, v, causal, block_q, block_k)[0]
+        return attention_reference(
+            q, repeat_kv(k, H // Hkv), repeat_kv(v, H // Hkv),
+            causal=causal, segment_ids=segment_ids, window=window,
+        )
+    return _flash_fwd_impl(q, k, v, causal, block_q, block_k, segment_ids, window)[0]
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, block_k: int, causal: bool, scale: float,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_k: int, causal: bool, has_seg: bool, window: int, scale: float,
 ):
     """Grid: (B*H, Tq//block_q). dq[i] = scale · Σ_kb ds[i,kb] @ k[kb]."""
     from jax.experimental import pallas as pl
 
+    if has_seg:
+        segq_ref, segk_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     block_q, D = q_ref.shape
     Tk = k_ref.shape[0]
     q_blk_idx = pl.program_id(1)
@@ -214,10 +287,14 @@ def _flash_bwd_dq_kernel(
     lse = lse_ref[:][:, :1]            # [block_q, 1] (lanes identical)
     delta = delta_ref[:][:, :1]        # [block_q, 1]
     q_pos = q_blk_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    sq = segq_ref[:][:, :1] if has_seg else None
 
     num_k_blocks = pl.cdiv(Tk, block_k)
+    kb_start = 0
     if causal:
         num_k_blocks = jnp.minimum(num_k_blocks, (q_blk_idx + 1) * block_q // block_k + 1)
+    if window > 0:
+        kb_start = jnp.maximum(0, (q_blk_idx * block_q - window + 1) // block_k)
 
     def body(kb, dq):
         k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
@@ -225,9 +302,14 @@ def _flash_bwd_dq_kernel(
         s = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
         if causal:
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if window > 0:
+            s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
+        if has_seg:
+            sk = segk_ref[:1, pl.ds(kb * block_k, block_k)]
+            s = jnp.where(sq == sk, s, NEG_INF)
         p = jnp.exp(s - lse)                                   # [block_q, block_k]
         dp = jax.lax.dot_general(                              # do @ v^T
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -238,11 +320,14 @@ def _flash_bwd_dq_kernel(
         )
         return dq
 
-    dq = jax.lax.fori_loop(0, num_k_blocks, body, jnp.zeros((block_q, D), jnp.float32))
+    dq = jax.lax.fori_loop(kb_start, num_k_blocks, body, jnp.zeros((block_q, D), jnp.float32))
     dq_ref[:] = (scale * dq).astype(dq_ref.dtype)
 
 
-def _dkv_block_contrib(q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, causal, scale):
+def _dkv_block_contrib(
+    q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, causal, scale,
+    sq=None, sk=None, window: int = 0,
+):
     """One q-block's contribution to (dk, dv) for one k block — the shared
     gradient math of both dkv variants (they differ only in data staging).
     Returns dk WITHOUT the final `scale` factor (callers apply it)."""
@@ -251,6 +336,10 @@ def _dkv_block_contrib(q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, ca
     )  # [block_q, block_k]
     if causal:
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if window > 0:
+        s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
+    if sq is not None:
+        s = jnp.where(sq == sk, s, NEG_INF)
     p = jnp.exp(s - lse_blk)
     dv_c = jax.lax.dot_general(                    # p^T @ do
         p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -266,8 +355,8 @@ def _dkv_block_contrib(q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, ca
 
 
 def _flash_bwd_dkv_kernel_resident(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q: int, n_rep: int, causal: bool, scale: float,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_q: int, n_rep: int, causal: bool, has_seg: bool, window: int, scale: float,
 ):
     """Grid: (B*Hkv, Tk//block_k) with the whole [n_rep·Tq, D] q/do staged in
     VMEM — the fast variant for moderate sequence lengths: causally-skipped
@@ -275,15 +364,25 @@ def _flash_bwd_dkv_kernel_resident(
     diagonal). Selected when the staged operands fit the VMEM budget."""
     from jax.experimental import pallas as pl
 
+    if has_seg:
+        segq_ref, segk_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
     block_k, D = k_ref.shape
     Tq = q_ref.shape[0] // n_rep
     k_blk_idx = pl.program_id(1)
     k = k_ref[:].astype(jnp.float32)
     v = v_ref[:].astype(jnp.float32)
     k_pos = k_blk_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    sk = segk_ref[:1, :] if has_seg else None  # [1, block_k] (this k block)
 
     num_q_blocks = pl.cdiv(Tq, block_q)
     qb_start = (k_blk_idx * block_k) // block_q if causal else 0
+    qb_end = num_q_blocks
+    if window > 0:
+        # rows beyond the window of this k block's LAST position contribute 0
+        last_k = k_blk_idx * block_k + block_k - 1
+        qb_end = jnp.minimum(num_q_blocks, (last_k + window - 1) // block_q + 1)
 
     def make_body(g_off: int):
         def body(qb, carry):
@@ -293,8 +392,11 @@ def _flash_bwd_dkv_kernel_resident(
             lse_blk = lse_ref[pl.ds(g_off + qb * block_q, block_q), :][:, :1]
             delta_blk = delta_ref[pl.ds(g_off + qb * block_q, block_q), :][:, :1]
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+            # seg rows are PER HEAD (not group-folded): index by qb directly
+            sq = segq_ref[pl.ds(qb * block_q, block_q), :][:, :1] if has_seg else None
             dk_c, dv_c = _dkv_block_contrib(
-                q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, causal, scale
+                q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, causal, scale,
+                sq, sk, window,
             )
             return dk + dk_c, dv + dv_c
 
@@ -303,7 +405,7 @@ def _flash_bwd_dkv_kernel_resident(
     zeros = jnp.zeros((block_k, D), jnp.float32)
     dk, dv = zeros, zeros
     for g in range(n_rep):  # static group unroll
-        dk, dv = jax.lax.fori_loop(qb_start, num_q_blocks, make_body(g * Tq), (dk, dv))
+        dk, dv = jax.lax.fori_loop(qb_start, qb_end, make_body(g * Tq), (dk, dv))
     dk_ref[:] = (scale * dk).astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
@@ -314,8 +416,8 @@ _DKV_RESIDENT_MAX_QROWS = 4096
 
 
 def _flash_bwd_dkv_kernel(
-    kb_ref, qrow_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-    delta_ref, dk_ref, dv_ref, *, num_q_blocks: int, causal: bool, scale: float,
+    kb_ref, qrow_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    num_q_blocks: int, causal: bool, has_seg: bool, window: int, scale: float,
 ):
     """Grid: (B*Hkv, n_pairs) — one causally-contributing (k block, q block)
     pair per step, streamed via scalar-prefetched index arrays.
@@ -332,6 +434,10 @@ def _flash_bwd_dkv_kernel(
     """
     from jax.experimental import pallas as pl
 
+    if has_seg:
+        segq_ref, segk_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
     block_q = q_ref.shape[0]
     block_k = k_ref.shape[0]
     j = pl.program_id(1)
@@ -352,15 +458,18 @@ def _flash_bwd_dkv_kernel(
     lse_blk = lse_ref[:][:, :1]
     delta_blk = delta_ref[:][:, :1]
     q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    sq = segq_ref[:][:, :1] if has_seg else None
+    sk = segk_ref[:1, :] if has_seg else None
     dk_c, dv_c = _dkv_block_contrib(
-        q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, causal, scale
+        q_blk, do_blk, lse_blk, delta_blk, k, v, q_pos, k_pos, causal, scale, sq, sk, window
     )
     dk_ref[:] += scale * dk_c
     dv_ref[:] += dv_c
 
 
 def _flash_bwd_impl(
-    q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int
+    q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int,
+    segment_ids: jax.Array | None = None, window: int = 0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Pallas flash backward: recompute p blockwise from (q, k, lse)."""
     from jax.experimental import pallas as pl
@@ -386,10 +495,25 @@ def _flash_bwd_impl(
     blk_k = pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0))
     row_q = pl.BlockSpec((None, block_q, _STAT_LANES), lambda b, i: (b, i, 0))
 
+    has_seg = segment_ids is not None
+    if has_seg:
+        segq, segk = _seg_arrays(segment_ids, B, Tq)  # Tq == Tk (validated)
+
+    dq_specs = [blk_q, full_k, full_k, blk_q, row_q, row_q]
+    dq_operands = [qf, kf, vf, dof, lsef, delta]
+    if has_seg:
+        dq_specs += [
+            pl.BlockSpec((None, block_q, _STAT_LANES), lambda b, i: (b // H, i, 0)),
+            pl.BlockSpec((None, _STAT_LANES, Tk), lambda b, i: (b // H, 0, 0)),
+        ]
+        dq_operands += [segq, segk]
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale),
+        functools.partial(
+            _flash_bwd_dq_kernel, block_k=block_k, causal=causal, has_seg=has_seg,
+            window=window, scale=scale,
+        ),
         grid=(B * H, Tq // block_q),
-        in_specs=[blk_q, full_k, full_k, blk_q, row_q, row_q],
+        in_specs=dq_specs,
         out_specs=blk_q,
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
         compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "arbitrary")),
@@ -399,7 +523,7 @@ def _flash_bwd_impl(
             bytes_accessed=3 * (qf.size + kf.size) * q.dtype.itemsize,
             transcendentals=B * H * Tq * Tk,
         ),
-    )(qf, kf, vf, dof, lsef, delta)
+    )(*dq_operands)
 
     # dk/dv: grid over (kv head, k block, group-member × q block); the GQA
     # group is folded into the q dim (layout [B*Hkv, n_rep*Tq, …]) and the
@@ -420,13 +544,23 @@ def _flash_bwd_impl(
     if n_rep * Tq <= _DKV_RESIDENT_MAX_QROWS:
         full_qg = pl.BlockSpec((None, n_rep * Tq, D), lambda b, i: (b, 0, 0))
         row_full_g = pl.BlockSpec((None, n_rep * Tq, _STAT_LANES), lambda b, i: (b, 0, 0))
+        dkv_specs = [full_qg, blk_kv2, blk_kv2, full_qg, row_full_g, row_full_g]
+        dkv_operands = [qg, kf, vf, dog, lseg, deltag]
+        if has_seg:
+            dkv_specs += [
+                # per-head q rows (NOT group-folded; kernel indexes by qb)
+                pl.BlockSpec((None, Tq, _STAT_LANES), lambda b, i: (b // Hkv, 0, 0)),
+                pl.BlockSpec((None, _STAT_LANES, block_k), lambda b, i: (b // Hkv, 0, i)),
+            ]
+            dkv_operands += [segq, segk]
         dk, dv = pl.pallas_call(
             functools.partial(
                 _flash_bwd_dkv_kernel_resident,
-                block_q=block_q, n_rep=n_rep, causal=causal, scale=scale,
+                block_q=block_q, n_rep=n_rep, causal=causal, has_seg=has_seg,
+                window=window, scale=scale,
             ),
             grid=(B * Hkv, Tk // block_k),
-            in_specs=[full_qg, blk_kv2, blk_kv2, full_qg, row_full_g, row_full_g],
+            in_specs=dkv_specs,
             out_specs=[blk_kv2, blk_kv2],
             out_shape=[
                 jax.ShapeDtypeStruct((B * Hkv, Tk, D), k.dtype),
@@ -437,7 +571,7 @@ def _flash_bwd_impl(
             ),
             interpret=_INTERPRET,
             cost_estimate=cost,
-        )(qg, kf, vf, dog, lseg, deltag)
+        )(*dkv_operands)
     else:
         # streaming grid: enumerate only the causally-contributing
         # (k block, group member, q block) pairs, sorted by k block, and
@@ -451,8 +585,13 @@ def _flash_bwd_impl(
             # through the mask, but the visit zero-initializes the output
             # block, which would otherwise be returned uninitialized
             qb0 = min((i * block_k) // block_q, num_q_blocks - 1) if causal else 0
+            qb1 = num_q_blocks
+            if window > 0:
+                # q rows past this k block's window band contribute nothing
+                last_k = i * block_k + block_k - 1
+                qb1 = max(min(num_q_blocks, (last_k + window - 1) // block_q + 1), qb0 + 1)
             for g in range(n_rep):
-                for qb in range(qb0, num_q_blocks):
+                for qb in range(qb0, qb1):
                     kb_l.append(i)
                     qrow_l.append(g * num_q_blocks + qb)
         kb = jnp.array(kb_l, dtype=jnp.int32)
@@ -472,17 +611,33 @@ def _flash_bwd_impl(
         def kv_map(b, j, kb_r, qrow_r):
             return (b, kb_r[j], 0)
 
+        stream_specs = [
+            pl.BlockSpec((None, block_q, D), q_map),
+            pl.BlockSpec((None, block_k, D), kv_map),
+            pl.BlockSpec((None, block_k, D), kv_map),
+            pl.BlockSpec((None, block_q, D), q_map),
+            pl.BlockSpec((None, block_q, _STAT_LANES), q_map),
+            pl.BlockSpec((None, block_q, _STAT_LANES), q_map),
+        ]
+        stream_operands = [qg, kf, vf, dog, lseg, deltag]
+        if has_seg:
+            # seg arrays are [B, ...] per-head (not group-folded): batch =
+            # b // Hkv, q block within head = qrow % num_q_blocks
+            stream_specs += [
+                pl.BlockSpec(
+                    (None, block_q, _STAT_LANES),
+                    lambda b, j, kb_r, qrow_r: (b // Hkv, qrow_r[j] % num_q_blocks, 0),
+                ),
+                pl.BlockSpec(
+                    (None, _STAT_LANES, block_k),
+                    lambda b, j, kb_r, qrow_r: (b // Hkv, 0, kb_r[j]),
+                ),
+            ]
+            stream_operands += [segq, segk]
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B * Hkv, n_pairs),
-            in_specs=[
-                pl.BlockSpec((None, block_q, D), q_map),
-                pl.BlockSpec((None, block_k, D), kv_map),
-                pl.BlockSpec((None, block_k, D), kv_map),
-                pl.BlockSpec((None, block_q, D), q_map),
-                pl.BlockSpec((None, block_q, _STAT_LANES), q_map),
-                pl.BlockSpec((None, block_q, _STAT_LANES), q_map),
-            ],
+            in_specs=stream_specs,
             out_specs=[
                 pl.BlockSpec((None, block_k, D), kv_map),
                 pl.BlockSpec((None, block_k, D), kv_map),
@@ -491,7 +646,8 @@ def _flash_bwd_impl(
         dk, dv = pl.pallas_call(
             functools.partial(
                 _flash_bwd_dkv_kernel,
-                num_q_blocks=num_q_blocks, causal=causal, scale=scale,
+                num_q_blocks=num_q_blocks, causal=causal, has_seg=has_seg,
+                window=window, scale=scale,
             ),
             grid_spec=grid_spec,
             out_shape=[
@@ -503,7 +659,7 @@ def _flash_bwd_impl(
             ),
             interpret=_INTERPRET,
             cost_estimate=cost,
-        )(kb, qrow, qg, kf, vf, dog, lseg, deltag)
+        )(kb, qrow, *stream_operands)
 
     return (
         dq.reshape(B, H, Tq, D),
@@ -521,17 +677,17 @@ def _flash_bwd_impl(
 _BLOCK_Q, _BLOCK_K = 256, 256
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash_trainable(q, k, v, causal):
-    return flash_attention(q, k, v, causal=causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_trainable(q, k, v, causal, window=0):
+    return flash_attention(q, k, v, causal=causal, window=window)
 
 
-def _flash_fwd(q, k, v, causal):
+def _flash_fwd(q, k, v, causal, window):
     from jax.ad_checkpoint import checkpoint_name
 
     Tq, Tk = q.shape[2], k.shape[2]
     bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
-    o, lse = _flash_fwd_lanes(q, k, v, causal, bq, bk)
+    o, lse = _flash_fwd_lanes(q, k, v, causal, bq, bk, None, window)
     # Named so a remat policy can pin JUST the kernel outputs
     # (save_only_these_names("flash_o", "flash_lse")): the backward then
     # recomputes the cheap qkv matmuls but not the O(T²) flash forward.
@@ -540,14 +696,46 @@ def _flash_fwd(q, k, v, causal):
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, res, g):
+def _flash_bwd(causal, window, res, g):
     q, k, v, o, lse = res
     Tq, Tk = q.shape[2], k.shape[2]
     bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
-    return _flash_bwd_impl(q, k, v, o, lse, g, causal, bq, bk)
+    return _flash_bwd_impl(q, k, v, o, lse, g, causal, bq, bk, None, window)
 
 
 _flash_trainable.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_trainable_seg(q, k, v, seg, causal, window=0):
+    """Packed-sequence variant: seg [B, T] int; cotangent for seg is float0."""
+    B, H, Tq, D = q.shape
+    bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, k.shape[2])
+    return _flash_fwd_impl(q, k, v, causal, bq, bk, seg, window)[0]
+
+
+def _flash_seg_fwd(q, k, v, seg, causal, window):
+    from jax.ad_checkpoint import checkpoint_name
+
+    Tq, Tk = q.shape[2], k.shape[2]
+    bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
+    o, lse = _flash_fwd_lanes(q, k, v, causal, bq, bk, seg, window)
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
+    return o, (q, k, v, seg, o, lse)
+
+
+def _flash_seg_bwd(causal, window, res, g):
+    import numpy as np
+
+    q, k, v, seg, o, lse = res
+    Tq, Tk = q.shape[2], k.shape[2]
+    bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
+    dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, g, causal, bq, bk, seg, window)
+    return dq, dk, dv, np.zeros(seg.shape, jax.dtypes.float0)
+
+
+_flash_trainable_seg.defvjp(_flash_seg_fwd, _flash_seg_bwd)
 
 
 def remat_block(block_fn, remat: bool, policy: str = "full"):
@@ -566,9 +754,14 @@ def remat_block(block_fn, remat: bool, policy: str = "full"):
             block_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
     if policy == "flash":
+        # also pins MoE routing outputs (parallel/expert.py names them
+        # "moe_route"): tiny tensors whose recompute would re-run the whole
+        # vector-bound gating pipeline in the backward
         return jax.checkpoint(
             block_fn,
-            policy=jax.checkpoint_policies.save_only_these_names("flash_o", "flash_lse"),
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_o", "flash_lse", "moe_route"
+            ),
         )
     if policy != "full":
         raise ValueError(f"remat_policy must be full|dots|flash, got {policy!r}")
@@ -582,11 +775,15 @@ def mha(
     *,
     causal: bool = True,
     impl: str = "auto",
+    segment_ids: jax.Array | None = None,
+    window: int = 0,
 ) -> jax.Array:
     """Dispatcher: Pallas flash kernel on TPU, XLA reference elsewhere.
 
     k/v may carry fewer heads than q (GQA/MQA): the flash kernels read kv
     heads in place via index-map aliasing; the reference path broadcasts.
+    ``segment_ids`` [B, T] confines attention within packed segments.
+    ``window`` > 0: sliding-window (Mistral/Mixtral) attention band.
     """
     if q.shape[1] % k.shape[1]:
         raise ValueError(f"n_heads {q.shape[1]} must be divisible by n_kv_heads {k.shape[1]}")
@@ -596,5 +793,12 @@ def mha(
     if impl == "flash":
         Tq, Tk = q.shape[2], k.shape[2]
         if Tq % min(256, Tq) == 0 and Tk % min(256, Tk) == 0 and Tq >= 128:
-            return _flash_trainable(q, k, v, causal)
-    return attention_reference(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), causal=causal)
+            if segment_ids is not None:
+                if Tq != Tk:
+                    raise ValueError(f"segment_ids requires Tq == Tk, got {Tq} vs {Tk}")
+                return _flash_trainable_seg(q, k, v, segment_ids, causal, window)
+            return _flash_trainable(q, k, v, causal, window)
+    return attention_reference(
+        q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
+        causal=causal, segment_ids=segment_ids, window=window,
+    )

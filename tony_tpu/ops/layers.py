@@ -26,10 +26,41 @@ def layer_norm(x: jax.Array, weight: jax.Array, bias: jax.Array, eps: float = 1e
     return ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight + bias
 
 
-def rope_frequencies(dim: int, max_seq: int, theta: float = 10000.0) -> tuple[jax.Array, jax.Array]:
-    """cos/sin tables [max_seq, dim//2] in f32."""
+def rope_frequencies(
+    dim: int, max_seq: int, theta: float = 10000.0, scaling: tuple = ()
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables [max_seq, dim//2] in f32.
+
+    ``scaling`` (hashable tuple so configs stay frozen/static):
+      ()                                → no scaling,
+      ("linear", factor)                → positions divided by factor,
+      ("llama3", factor, low_freq_factor, high_freq_factor, original_max)
+        → Llama-3.1 frequency-band scaling (matches the HF implementation:
+        low-frequency bands divided by factor, high-frequency bands kept,
+        the middle band smoothly interpolated).
+    """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
     t = jnp.arange(max_seq, dtype=jnp.float32)
+    if scaling:
+        kind = scaling[0]
+        if kind == "linear":
+            t = t / float(scaling[1])
+        elif kind == "llama3":
+            factor, lo, hi, orig = (float(s) for s in scaling[1:])
+            wavelen = 2.0 * jnp.pi / inv_freq
+            smooth = (orig / wavelen - lo) / (hi - lo)
+            scaled = jnp.where(
+                wavelen > orig / lo,                       # low-frequency band
+                inv_freq / factor,
+                jnp.where(
+                    wavelen < orig / hi,                   # high-frequency band
+                    inv_freq,
+                    (1.0 - smooth) * inv_freq / factor + smooth * inv_freq,
+                ),
+            )
+            inv_freq = scaled
+        else:
+            raise ValueError(f"unknown rope scaling kind {kind!r} (linear|llama3)")
     freqs = jnp.outer(t, inv_freq)
     return jnp.cos(freqs), jnp.sin(freqs)
 
@@ -37,12 +68,17 @@ def rope_frequencies(dim: int, max_seq: int, theta: float = 10000.0) -> tuple[ja
 def apply_rope(
     x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array | None = None
 ) -> jax.Array:
-    """Rotary embedding; x: [B, H, T, D], tables [>=T, D//2]."""
+    """Rotary embedding; x: [B, H, T, D], tables [>=T, D//2].
+
+    ``positions``: [T] shared positions, or [B, T] per-batch positions
+    (packed sequences restart positions at each segment)."""
     T = x.shape[-2]
     if positions is None:
         c, s = cos[:T], sin[:T]
     else:
         c, s = cos[positions], sin[positions]
+        if positions.ndim == 2:  # [B, T, D/2] → broadcast over heads
+            c, s = c[:, None], s[:, None]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return out.astype(x.dtype)
