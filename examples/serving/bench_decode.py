@@ -31,19 +31,41 @@ def main() -> int:
     p.add_argument("--int8", action="store_true")
     p.add_argument("--chunk", type=int, default=8)
     p.add_argument("--preset", default="bench-1b")
+    p.add_argument("--host-init", action="store_true",
+                   help="init + quantize on the host CPU, then ship to the "
+                        "chip — required for models whose bf16 weights don't "
+                        "fit HBM before quantization (llama3-8b on one v5e)")
     args = p.parse_args()
 
     cfg = (
         dataclasses.replace(llama.LLAMA_1B, max_seq=args.max_len)
         if args.preset == "bench-1b" else llama.PRESETS[args.preset]
     )
-    params = llama.init(jax.random.PRNGKey(0), cfg)
-    if args.int8:
+    if args.host_init:
         from tony_tpu.ops import quant
 
-        params, before, after = quant.quantize_tree(params)
-        print(f"[bench] int8: {before / 1e9:.2f} GB -> {after / 1e9:.2f} GB",
+        cpu = jax.devices("cpu")[0]
+        t0 = time.perf_counter()
+        with jax.default_device(cpu):
+            params = llama.init(jax.random.PRNGKey(0), cfg)
+            params, before, after = quant.quantize_tree(params)
+            jax.block_until_ready(params)
+        print(f"[bench] host init+quant: {before / 1e9:.2f} GB -> "
+              f"{after / 1e9:.2f} GB in {time.perf_counter() - t0:.0f}s",
               file=sys.stderr)
+        t0 = time.perf_counter()
+        params = jax.device_put(params, jax.devices()[0])
+        jax.block_until_ready(params)
+        print(f"[bench] weights to chip in {time.perf_counter() - t0:.0f}s",
+              file=sys.stderr)
+    else:
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        if args.int8:
+            from tony_tpu.ops import quant
+
+            params, before, after = quant.quantize_tree(params)
+            print(f"[bench] int8: {before / 1e9:.2f} GB -> {after / 1e9:.2f} GB",
+                  file=sys.stderr)
 
     eng = ContinuousBatcher(
         params, cfg, num_slots=args.slots, max_len=args.max_len,
@@ -80,7 +102,7 @@ def main() -> int:
         "slots": args.slots,
         "decode_chunk": args.chunk,
         "model_params": cfg.num_params(),
-        "int8": bool(args.int8),
+        "int8": bool(args.int8 or args.host_init),  # host-init always quantizes
         "ms_per_token_step": round(1000 * dt / (n_tokens / args.slots), 2),
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
     }

@@ -320,3 +320,45 @@ class TestMultiSlicePool:
             },
         )
         assert final == JobStatus.FAILED
+
+
+@pytest.mark.e2e
+class TestVenvArchive:
+    def test_venv_zip_staged_and_activated(self, tmp_tony_root, tmp_path):
+        # build a fake venv archive: bin/activate marker + bin/ on PATH
+        import zipfile
+
+        venv_src = tmp_path / "venv" / "bin"
+        venv_src.mkdir(parents=True)
+        probe = venv_src / "tony-venv-probe"
+        probe.write_text("#!/bin/sh\necho venv-probe-ran\n")
+        probe.chmod(0o755)
+        archive = tmp_path / "venv.zip"
+        with zipfile.ZipFile(archive, "w") as z:
+            # z.write records each file's on-disk mode in external_attr
+            # (the probe is 0755), which the unpacker must restore
+            for p in (tmp_path / "venv").rglob("*"):
+                z.write(p, p.relative_to(tmp_path))
+
+        out_file = tmp_path / "which.txt"
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {
+                "tony.worker.instances": "1",
+                keys.PYTHON_VENV: str(archive),
+                keys.EXECUTES: (
+                    # EXECUTE the probe (not just resolve it): catches zip
+                    # extraction dropping the executable bit
+                    f"bash -c 'tony-venv-probe > {out_file} && "
+                    f"command -v tony-venv-probe >> {out_file} && "
+                    f"echo VIRTUAL_ENV=$VIRTUAL_ENV >> {out_file}'"
+                ),
+            },
+        )
+        assert final == JobStatus.SUCCEEDED, handle.final_status()
+        text = out_file.read_text()
+        # the probe RAN from the unpacked archive inside staging, and
+        # VIRTUAL_ENV points there too
+        assert "venv-probe-ran" in text
+        assert "/venv/worker_0" in text and "tony-venv-probe" in text
+        assert "VIRTUAL_ENV=" in text and str(tmp_tony_root) in text

@@ -60,8 +60,8 @@ class TestProxyServer:
         # may legitimately reuse the freed port)
         assert proxy._listener.fileno() == -1
         # generous join: under full-suite load (leftover jax workers from e2e
-        # tests burning CPU) the accept thread can take a while to schedule
-        proxy._thread.join(timeout=30)
+        # tests burning CPU) the accept thread can take minutes to schedule
+        proxy._thread.join(timeout=120)
         assert not proxy._thread.is_alive()
 
 

@@ -188,3 +188,107 @@ def test_cp_impl_validation():
             jnp.zeros((1, 4, 8, 16)), jnp.zeros((1, 2, 8, 16)),
             jnp.zeros((1, 2, 8, 16)), cfg, None,
         )
+
+
+_EIGHT_DEV_BWD_PROBE = r"""
+import sys
+sys.path.insert(0, "__REPO__")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.extend.backend as _jeb
+_jeb.clear_backends()
+jax.config.update("jax_num_cpu_devices", 16)
+import jax.numpy as jnp, numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.pallas import tpu as pltpu
+from tony_tpu.ops.ring import ring_attention_pallas
+from tony_tpu.ops.attention import attention_reference, repeat_kv
+
+mesh = Mesh(np.array(jax.devices()[:8]), ("context",))
+key = jax.random.PRNGKey(11)
+B, H, Hkv, T, D = 1, 2, 1, 8 * 512, 64
+q = jax.random.normal(jax.random.fold_in(key, 0), (B, H, T, D), jnp.float32) * 0.5
+k = jax.random.normal(jax.random.fold_in(key, 1), (B, Hkv, T, D), jnp.float32) * 0.5
+v = jax.random.normal(jax.random.fold_in(key, 2), (B, Hkv, T, D), jnp.float32) * 0.5
+w = jnp.arange(D, dtype=jnp.float32) / D
+spec = P(None, None, "context", None)
+
+def body(q, k, v):
+    out = ring_attention_pallas(
+        q, k, v, axis_name="context", causal=True,
+        interpret=pltpu.InterpretParams(detect_races=True),
+    )
+    return jax.lax.psum((out * w).sum(), "context")
+
+inner = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(),
+                      axis_names={"context"}, check_vma=False)
+g_pallas = jax.jit(jax.grad(inner, argnums=(0, 1, 2)))(q, k, v)
+
+def loss_ref(q, k, v):
+    return (attention_reference(q, repeat_kv(k, 2), repeat_kv(v, 2), causal=True) * w).sum()
+
+g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+for name, a, b in zip("dq dk dv".split(), g_pallas, g_ref):
+    scale = float(jnp.max(jnp.abs(b))) + 1e-9
+    err = float(jnp.max(jnp.abs(a - b))) / scale
+    assert err < 5e-4, f"{name} rel err {err}"
+print("EIGHT_DEV_BWD_OK")
+"""
+
+
+def test_pallas_ring_backward_eight_devices_multi_tile():
+    # 8-way ring backward with multiple (bq=bk=256) tiles per shard: the
+    # riding dk/dv accumulators cross 7 rotations + the final delivery hop.
+    # Runs in a SUBPROCESS with SPARE virtual devices (16 for an 8-mesh):
+    # the interpret emulation starves for executor threads — and wedges —
+    # when a collective kernel with large tiles occupies every device in
+    # the process (8-of-16 passes in ~17 s, 8-of-8 deadlocks; same for the
+    # FORWARD kernel at n-of-n with 256-row tiles, so this is an emulation
+    # artifact, not a kernel-protocol property).
+    import os
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # a clean jax env: the probe does its own backend/device setup, and the
+    # conftest's XLA_FLAGS/interpret env wedges the emulation at this scale
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "TONY_PALLAS_INTERPRET")
+    }
+    out = subprocess.run(
+        [_sys.executable, "-c", _EIGHT_DEV_BWD_PROBE.replace("__REPO__", repo)],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert out.returncode == 0, f"stdout:\n{out.stdout}\nstderr:\n{out.stderr[-2000:]}"
+    assert "EIGHT_DEV_BWD_OK" in out.stdout
+
+
+def test_pallas_ring_backward_noncausal():
+    from tony_tpu.ops.ring import ring_attention_pallas
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("context",))
+    q, k, v = _mk_qkv(seed=13)
+    w = jnp.arange(64, dtype=jnp.float32) / 64.0
+    spec = P(None, None, "context", None)
+
+    def body(q, k, v):
+        out = ring_attention_pallas(
+            q, k, v, axis_name="context", causal=False, interpret=_interpret_params()
+        )
+        return jax.lax.psum((out * w).sum(), "context")
+
+    inner = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(),
+        axis_names={"context"}, check_vma=False,
+    )
+    g_pallas = jax.jit(jax.grad(inner, argnums=(0, 1, 2)))(q, k, v)
+
+    def loss_ref(q, k, v):
+        return (attention_reference(q, repeat_kv(k, 2), repeat_kv(v, 2), causal=False) * w).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), g_pallas, g_ref):
+        scale = float(jnp.max(jnp.abs(b))) + 1e-9
+        err = float(jnp.max(jnp.abs(a - b))) / scale
+        assert err < 2e-4, f"{name} rel err {err}"

@@ -112,3 +112,32 @@ class TestContinuousBatching:
         out = eng.run()[rid]
         assert len(out) == 4
         assert all(0 <= t < CFG.vocab_size for t in out)
+
+
+class TestLengthBucketing:
+    def test_parity_across_bucket_boundary(self):
+        # prompt length just under a bucket edge + enough new tokens that the
+        # chunked decode crosses power-of-two cache views (16 → 32 → 64):
+        # every variant must agree with batch-of-one generate()
+        params = _params()
+        eng = ContinuousBatcher(params, CFG, num_slots=2, max_len=64, decode_chunk=4)
+        p = _prompt(13, seed=9)   # 13 + chunk → needed 17 → bucket 32 → later 64
+        rid = eng.submit(list(np.asarray(p[0])), max_new_tokens=40)
+        results = eng.run()
+        want = generate.generate(params, p, CFG, max_new_tokens=40)
+        np.testing.assert_array_equal(np.asarray(results[rid]), np.asarray(want[0]))
+
+    def test_staged_prefill_admitted_after_retirement(self):
+        # more requests than slots with tiny budgets: the speculative staged
+        # prefill (dispatched during the chunk) must land in freed slots and
+        # still match generate()
+        params = _params()
+        eng = ContinuousBatcher(params, CFG, num_slots=2, max_len=64, decode_chunk=2)
+        prompts = {i: _prompt(4 + i, seed=20 + i) for i in range(5)}
+        rids = {i: eng.submit(list(np.asarray(p[0])), max_new_tokens=3)
+                for i, p in prompts.items()}
+        results = eng.run()
+        assert len(results) == 5
+        for i, p in prompts.items():
+            want = generate.generate(params, p, CFG, max_new_tokens=3)
+            np.testing.assert_array_equal(np.asarray(results[rids[i]]), np.asarray(want[0]))

@@ -112,9 +112,14 @@ class TaskExecutor:
         for kv in self.config.get_list(keys.SHELL_ENV):
             k, _, v = kv.partition("=")
             env[k] = v
-        # venv activation analog: put the venv's bin first on PATH
+        # venv activation analog: put the venv's bin first on PATH. An
+        # ARCHIVE (--python_venv venv.zip / .tar.gz, reference parity:
+        # localized per container) is unpacked once into the container's
+        # staging area; a directory is used in place.
         venv = self.config.get(keys.PYTHON_VENV)
         if venv:
+            if venv.endswith((".zip", ".tar.gz", ".tgz", ".tar")):
+                venv = self._localize_venv_archive(venv)
             env["VIRTUAL_ENV"] = venv
             env["PATH"] = os.path.join(venv, "bin") + os.pathsep + env.get("PATH", "")
         pybin = self.config.get(keys.PYTHON_BINARY_PATH)
@@ -135,6 +140,49 @@ class TaskExecutor:
             # submitter proxies it (NotebookSubmitter/ProxyServer, SURVEY §3.4)
             env[constants.ENV_NOTEBOOK_PORT] = str(self.port)
         return env
+
+    def _localize_venv_archive(self, archive: str) -> str:
+        """Unpack a venv archive into this container's staging area (the
+        reference ships ``--python_venv venv.zip`` as a localized resource;
+        SURVEY.md §3.1). Idempotent per container — keyed on the archive's
+        identity (path + mtime + size), so a CHANGED archive re-unpacks
+        instead of silently reusing a stale venv. Zip members' permission
+        bits are restored from their external attributes (zipfile.extractall
+        drops them, which would leave bin/python non-executable). If the
+        archive has a single top-level dir, that dir becomes the venv root."""
+        import shutil
+
+        st = os.stat(archive)
+        stamp = f"{archive}:{st.st_mtime_ns}:{st.st_size}"
+        dest = os.path.join(
+            self.staging_dir, "venv", f"{self.job_name}_{self.index}"
+        )
+        marker = os.path.join(dest, ".unpacked")
+        current = None
+        if os.path.exists(marker):
+            with open(marker) as f:
+                current = f.read()
+        if current != stamp:
+            if os.path.isdir(dest):
+                shutil.rmtree(dest)
+            os.makedirs(dest, exist_ok=True)
+            if archive.endswith(".zip"):
+                import zipfile
+
+                with zipfile.ZipFile(archive) as z:
+                    for info in z.infolist():
+                        path = z.extract(info, dest)
+                        mode = (info.external_attr >> 16) & 0o7777
+                        if mode:
+                            os.chmod(path, mode)
+            else:
+                shutil.unpack_archive(archive, dest)  # tar preserves modes
+            with open(marker, "w") as f:
+                f.write(stamp)
+        entries = [e for e in os.listdir(dest) if e != ".unpacked"]
+        if len(entries) == 1 and os.path.isdir(os.path.join(dest, entries[0])):
+            return os.path.join(dest, entries[0])
+        return dest
 
     def launch_child(self, command: str, env: dict[str, str]) -> subprocess.Popen:
         """Exec the user process via the shell (Utils.executeShell analog);

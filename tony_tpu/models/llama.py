@@ -301,9 +301,11 @@ def pp_loss_fn(
     schedule, parallel/pipeline.py); embedding and the (chunked) CE head run
     outside the pipeline, replicated over stages.
 
-    Stages run per-device inside shard_map, so this path composes with
-    data/fsdp sharding of the batch but not with a context axis (use
-    cp_impl on the flat path for that).
+    The microbatches enter the schedule REPLICATED along data/fsdp (every
+    device recomputes the full batch — numerically correct, no DP speedup);
+    for pipeline × data-parallel composition use ``pp_value_and_grad`` (the
+    1F1B schedule shards the microbatch batch dim over data/fsdp). Does not
+    compose with a context axis either (use cp_impl on the flat path).
     """
     from tony_tpu.parallel.pipeline import spmd_pipeline, split_layers_into_stages
 
@@ -340,6 +342,79 @@ def pp_loss_fn(
         x, params["lm_head"], tokens[:, 1:], chunk=cfg.ce_chunk
     )
     return loss, {"loss": loss, "tokens": n}
+
+
+def pp_value_and_grad(
+    params: dict, batch: dict, cfg: LlamaConfig, mesh, num_microbatches: int = 2,
+    wire_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, dict, dict]:
+    """1F1B pipeline train-step core: ``(loss, metrics, grads)`` with grads
+    shaped exactly like ``params``.
+
+    The hand-scheduled backward (parallel/pipeline.spmd_pipeline_1f1b)
+    interleaves each microbatch's backward with later microbatches' forwards,
+    bounding live activations per stage at O(S) microbatches instead of the
+    GPipe path's O(M); the CE head runs inside the last stage (no [M, …]
+    output bank broadcast), and the microbatch batch dim shards over
+    data/fsdp. Use via ``make_pp_train_step`` (train/trainer.py).
+    """
+    from tony_tpu.parallel.pipeline import spmd_pipeline_1f1b, split_layers_into_stages
+
+    S = mesh.shape.get("stage", 1)
+    if S <= 1:
+        loss_and_grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg, mesh), has_aux=True
+        )(params)
+        (loss, metrics), grads = loss_and_grads
+        return loss, metrics, grads
+    if mesh.shape.get("context", 1) > 1:
+        raise ValueError("pipeline parallelism does not compose with a context axis")
+    if "segment_ids" in batch:
+        raise ValueError("pp paths do not support packed batches (segment_ids) yet")
+    tokens = batch["tokens"]
+    T = tokens.shape[1] - 1
+    cos, sin = L.rope_frequencies(cfg.head_dim, T, cfg.rope_theta, cfg.rope_scaling)
+
+    block_fn = attn_ops.remat_block(
+        partial(_block, cos=cos, sin=sin, cfg=cfg, mesh=None),
+        cfg.remat, cfg.remat_policy,
+    )
+
+    def stage_fn(stage_lp, h):
+        h, _ = jax.lax.scan(block_fn, h, stage_lp)
+        return h
+
+    def embed_fn(embed_p, tok_in):
+        return jnp.take(embed_p, tok_in, axis=0)
+
+    def loss_head_fn(head_p, y, tok):
+        x = L.rms_norm(y, head_p["final_norm"], cfg.norm_eps)
+        mean, n = L.chunked_cross_entropy_loss(
+            x, head_p["lm_head"], tok[:, 1:], chunk=cfg.ce_chunk
+        )
+        return mean * n, n
+
+    stages = split_layers_into_stages(params["layers"], S)
+    head_params = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
+    nll, ntok, (dstage, dembed, dhead) = spmd_pipeline_1f1b(
+        stage_fn, stages, tokens, params["embed"], head_params,
+        embed_fn, loss_head_fn,
+        mesh=mesh, num_microbatches=num_microbatches, wire_dtype=wire_dtype,
+        compute_dtype=cfg.jdtype,
+    )
+    loss = nll / jnp.maximum(ntok, 1.0)
+    inv = 1.0 / jnp.maximum(ntok, 1.0)
+    d_layers = jax.tree.map(
+        lambda g, p: (g.reshape(cfg.n_layers, *g.shape[2:]) * inv).astype(p.dtype),
+        dstage, params["layers"],
+    )
+    grads = {
+        "embed": (dembed * inv).astype(params["embed"].dtype),
+        "layers": d_layers,
+        "final_norm": (dhead["final_norm"] * inv).astype(params["final_norm"].dtype),
+        "lm_head": (dhead["lm_head"] * inv).astype(params["lm_head"].dtype),
+    }
+    return loss, {"loss": loss, "tokens": ntok}, grads
 
 
 def forward(

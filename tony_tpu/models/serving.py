@@ -99,6 +99,35 @@ def decode_steps(
     return toks, seq, cache
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "n", "bucket", "temperature", "top_k"),
+    donate_argnums=(1,),
+)
+def decode_steps_bucketed(
+    params, cache: SlotCache, tokens: jax.Array, key: jax.Array,
+    cfg: LlamaConfig, n: int, bucket: int, temperature: float = 0.0, top_k: int = 0,
+):
+    """``decode_steps`` over a LENGTH-BUCKETED cache view: attention reads
+    only the first ``bucket`` cache positions (a power of two ≥ the longest
+    active slot + n, chosen by the host), then the grown view is written
+    back into the full cache. With short active requests in a long-max_len
+    engine this removes most of the per-token KV read traffic — the decode
+    step is KV-bandwidth-bound, so tokens/s follows the bucket, not max_len.
+    One jit variant per bucket (powers of two → log(max_len) variants)."""
+    sub = SlotCache(cache.k[:, :, :, :bucket], cache.v[:, :, :, :bucket], cache.lengths)
+
+    def body(carry, k_step):
+        c, toks = carry
+        nxt, c = _decode_one(params, c, toks, k_step, cfg, temperature, top_k)
+        return (c, nxt), nxt
+
+    (sub, toks), seq = jax.lax.scan(body, (sub, tokens), jax.random.split(key, n))
+    k = jax.lax.dynamic_update_slice(cache.k, sub.k, (0, 0, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(cache.v, sub.v, (0, 0, 0, 0, 0))
+    return toks, seq, SlotCache(k, v, sub.lengths)
+
+
 def _bucket(n: int, lo: int = 16) -> int:
     b = lo
     while b < n:
@@ -167,6 +196,10 @@ class ContinuousBatcher:
         self.running: dict[int, _Request] = {}   # slot → request
         self.done: dict[int, list[int]] = {}
         self._next_rid = 0
+        # prefills dispatched ahead of slot availability (overlap with the
+        # in-flight decode chunk): [(request, prefill cache, first token)]
+        self._staged: list[tuple[_Request, KVCache, jax.Array]] = []
+        self._slot_len = [0] * num_slots  # host mirror of cache.lengths
 
     def submit(self, prompt, max_new_tokens: int) -> int:
         prompt = [int(t) for t in prompt]
@@ -189,11 +222,13 @@ class ContinuousBatcher:
     def _free_slots(self) -> list[int]:
         return [s for s in range(self.S) if s not in self.running]
 
-    def _admit(self):
-        free = self._free_slots()
-        while self.pending and free:
+    def _stage_prefills(self, budget: int):
+        """Dispatch (async) prefills for up to ``budget`` pending requests.
+        Called right after the decode chunk is dispatched so the prefill
+        compute/transfers queue behind it instead of delaying the NEXT
+        chunk — admission then only inserts the finished prefill."""
+        while self.pending and len(self._staged) < budget:
             req = self.pending.pop(0)
-            slot = free.pop(0)
             Tp = len(req.prompt)
             pad = min(_bucket(Tp), self.max_len) - Tp
             padded = jnp.array(req.prompt + [0] * pad, jnp.int32)[None, :]
@@ -205,10 +240,20 @@ class ContinuousBatcher:
                 logits[:, Tp - 1].astype(jnp.float32), self._split(),
                 self.temperature, self.top_k,
             )
+            self._staged.append((req, pre, first))
+
+    def _admit(self):
+        free = self._free_slots()
+        self._stage_prefills(len(free))
+        while self._staged and free:
+            req, pre, first = self._staged.pop(0)
+            slot = free.pop(0)
+            Tp = len(req.prompt)
             self.cache = _insert_prefill(
                 self.cache, pre, jnp.int32(slot), jnp.int32(Tp)
             )
             self.tokens = self.tokens.at[slot].set(first[0])
+            self._slot_len[slot] = Tp
             req.slot = slot
             req.out.append(int(first[0]))
             self.running[slot] = req
@@ -229,25 +274,36 @@ class ContinuousBatcher:
         """Admit + one decode chunk. Returns True while work remains."""
         self._admit()
         if not self.running:
-            return bool(self.pending)
+            return bool(self.pending or self._staged)
         # constant chunk height = ONE compiled decode variant; slots whose
         # request finishes mid-chunk simply discard the overshoot tokens
-        # (their cache writes clamp at maxT-1 and the slot is fully
+        # (their cache writes clamp at the view's end and the slot is fully
         # overwritten at its next admission)
         h = self.decode_chunk
-        toks, seq, self.cache = decode_steps(
+        # length bucket: attention reads only the shortest power-of-two
+        # cache prefix covering every active slot through this chunk —
+        # tokens/s then follows actual lengths, not max_len
+        needed = max(self._slot_len[s] for s in self.running) + h
+        bucket = min(_bucket(max(needed, 1)), self.max_len)
+        toks, seq, self.cache = decode_steps_bucketed(
             self.params, self.cache, self.tokens, self._split(), self.cfg, h,
-            self.temperature, self.top_k,
+            bucket, self.temperature, self.top_k,
         )
         self.tokens = toks
+        # overlap: queue prefills for the next admissions while the chunk
+        # (already dispatched, still in flight) computes; one speculative
+        # stage beyond the currently-free slots covers mid-chunk retirement
+        self._stage_prefills(max(len(self._free_slots()), 1))
         seq_host = np.asarray(seq)  # [h, S]: ONE device→host transfer
+        for slot in self.running:
+            self._slot_len[slot] = min(self._slot_len[slot] + h, self.max_len)
         for slot, req in list(self.running.items()):
             for i in range(h):
                 req.out.append(int(seq_host[i, slot]))
                 if req.is_done(self.eos_id):
                     break  # post-budget/post-EOS chunk tokens are discarded
             self._retire_if_done(req)
-        return bool(self.running or self.pending)
+        return bool(self.running or self.pending or self._staged)
 
     def run(self) -> dict[int, list[int]]:
         """Drain all submitted requests; returns {request_id: tokens}."""

@@ -15,9 +15,12 @@ softmax state for one q block), so per-device shard size is bounded by HBM,
 not VMEM, and KV stays at Hkv width end to end (GQA-native — q heads alias
 onto kv heads inside the compute loop, never broadcast).
 
-Differentiable: the custom VJP recomputes the backward through the XLA ring
-(numerically identical schedule), so the kernel drops into training models
-wherever ``ring_attention`` is used (``LlamaConfig(cp_impl="pallas")``).
+Differentiable end-to-end in-kernel: the custom VJP's backward is its own
+remote-DMA ring kernel (``_ring_bwd_kernel``) — dk/dv partial sums ride the
+ring alongside their KV shard, each device adds its local contribution
+(recomputing p blockwise from q/k/lse), and a final rotation delivers each
+shard's finished gradients home. No XLA-ring fallback anywhere; the kernel
+drops into training models via ``LlamaConfig(cp_impl="pallas")``.
 
 Validated in TPU-interpret mode (which emulates RDMA + semaphores across
 shard_map devices, with race detection) on a virtual CPU mesh; the real-ICI
@@ -38,8 +41,10 @@ from tony_tpu.ops.attention import NEG_INF, _STAT_LANES
 # Registry of Pallas collective_ids in this program. A collective_id names the
 # cross-device barrier-semaphore set; two concurrently-live collective kernels
 # sharing an id would alias barrier counts and silently hang. Reserve ids here.
-RING_ATTENTION_COLLECTIVE_ID = 7
-# next free id: 8
+RING_ATTENTION_COLLECTIVE_ID = 7      # forward ring kernel
+RING_ATTENTION_BWD_COLLECTIVE_ID = 8  # backward ring kernel (may overlap fwd
+                                      # of the next microbatch under pipelining)
+# next free id: 9
 
 
 def default_interpret():
@@ -53,7 +58,7 @@ def default_interpret():
 
 
 def _ring_fwd_kernel(
-    my_ref, q_hbm, k_hbm, v_hbm, o_hbm,
+    my_ref, q_hbm, k_hbm, v_hbm, o_hbm, lse_hbm,
     kbuf, vbuf, acc_hbm, m_hbm, l_hbm,
     qt, kt, vt, acct, mt, lt, ot, csem, send_sem, recv_sem, ready_sem,
     *, n: int, axis_name: str, causal: bool, scale: float,
@@ -181,6 +186,9 @@ def _ring_fwd_kernel(
             if s == n - 1:
                 ot[:] = (acct[:] / jnp.maximum(lt[:][:, :1], 1e-20)).astype(ot.dtype)
                 copy(ot, o_hbm.at[bh, pl.ds(qb * bq, bq)])
+                # lse residual for the ring backward (lane-replicated)
+                mt[:] = mt[:] + jnp.log(jnp.maximum(lt[:], 1e-20))
+                copy(mt, lse_hbm.at[bh, pl.ds(qb * bq, bq)])
             else:
                 copy(acct, acc_hbm.at[bh, pl.ds(qb * bq, bq)])
                 copy(mt, m_hbm.at[bh, pl.ds(qb * bq, bq)])
@@ -214,6 +222,11 @@ def _ring_fwd_kernel(
                 device_id_type=pltpu.DeviceIdType.MESH,
             )
 
+    if n > 1:
+        # drain the right neighbor's final free-signal (sent at its step
+        # n-2, consumed by no RDMA): semaphores must be zero at kernel exit
+        pltpu.semaphore_wait(ready_sem.at[(n - 2) % 2], 1)
+
 
 def _ring_fwd(q, k, v, axis_name: str, causal: bool, interpret: Any):
     from jax.experimental import pallas as pl
@@ -240,7 +253,7 @@ def _ring_fwd(q, k, v, axis_name: str, causal: bool, interpret: Any):
         n_rep=n_rep, bq=bq, bk=bk,
     )
     hbm = pltpu.MemorySpace.HBM
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
@@ -248,8 +261,11 @@ def _ring_fwd(q, k, v, axis_name: str, causal: bool, interpret: Any):
             pl.BlockSpec(memory_space=hbm),
             pl.BlockSpec(memory_space=hbm),
         ],
-        out_specs=pl.BlockSpec(memory_space=hbm),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tl, D), q.dtype),
+        out_specs=[pl.BlockSpec(memory_space=hbm), pl.BlockSpec(memory_space=hbm)],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tl, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tl, _STAT_LANES), jnp.float32),
+        ],
         scratch_shapes=[
             hbm((2, B * Hkv, Tl, D), k.dtype),            # ring KV slots
             hbm((2, B * Hkv, Tl, D), v.dtype),
@@ -271,7 +287,302 @@ def _ring_fwd(q, k, v, axis_name: str, causal: bool, interpret: Any):
         compiler_params=pltpu.CompilerParams(collective_id=RING_ATTENTION_COLLECTIVE_ID),
         interpret=interpret if interpret is not None else default_interpret(),
     )(jnp.full((1,), my, jnp.int32), qf, kf, vf)
-    return out.reshape(B, H, Tl, D)
+    return out.reshape(B, H, Tl, D), lse.reshape(B, H, Tl, _STAT_LANES)
+
+
+def _ring_bwd_kernel(
+    my_ref, q_hbm, k_hbm, v_hbm, do_hbm, lse_hbm, delta_hbm,
+    dq_hbm, dk_hbm, dv_hbm,
+    kbuf, vbuf, dkbuf, dvbuf,
+    qt, kt, vt, dot, lset, deltat, dqt, dkt, dvt,
+    csem, send_sem, recv_sem, ready_sem, fin_sem_s, fin_sem_r,
+    *, n: int, axis_name: str, causal: bool, scale: float,
+    n_rep: int, bq: int, bk: int,
+):
+    """Ring-attention backward as one remote-DMA ring pass per device.
+
+    The rotating payload is (k, v, dk_acc, dv_acc): each KV shard carries its
+    f32 dk/dv partial sums around the ring, every device adds its local
+    q-block contributions (recomputing p blockwise from q, k, lse — the
+    flash-backward trade), dq accumulates locally in HBM, and after the last
+    compute step ONE extra rotation delivers each shard's finished dk/dv to
+    its home device's output refs. KV shards wholly in this device's causal
+    future skip compute (their accumulators still ride the ring).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, Tl, D = q_hbm.shape
+    BHkv = k_hbm.shape[0]
+    my = my_ref[0]
+    right = jax.lax.rem(my + 1, n)
+    left = jax.lax.rem(my + n - 1, n)
+    num_qb, num_kb = Tl // bq, Tl // bk
+
+    def copy(src, dst):
+        cp = pltpu.make_async_copy(src, dst, csem.at[0])
+        cp.start()
+        cp.wait()
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id={axis_name: left},
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id={axis_name: right},
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_wait(barrier, 2)
+
+    # zero the local dq accumulator
+    dqt[:] = jnp.zeros_like(dqt)
+
+    def zero_dq(i, _):
+        copy(dqt, dq_hbm.at[i // num_qb, pl.ds((i % num_qb) * bq, bq)])
+        return 0
+
+    jax.lax.fori_loop(0, BH * num_qb, zero_dq, 0)
+
+    # stage the local KV shard into ring slot 0; its dk/dv start at zero
+    copy(k_hbm, kbuf.at[0])
+    copy(v_hbm, vbuf.at[0])
+    dkt[:] = jnp.zeros_like(dkt)
+    dvt[:] = jnp.zeros_like(dvt)
+
+    def zero_dkv(i, _):
+        copy(dkt, dkbuf.at[0, i // num_kb, pl.ds((i % num_kb) * bk, bk)])
+        copy(dvt, dvbuf.at[0, i // num_kb, pl.ds((i % num_kb) * bk, bk)])
+        return 0
+
+    jax.lax.fori_loop(0, BHkv * num_kb, zero_dkv, 0)
+
+    for s in range(n):
+        cur, nxt = s % 2, (s + 1) % 2
+        src = jax.lax.rem(my - s + n, n)  # whose KV shard slot `cur` holds
+
+        # kv is read-only: its RDMA can overlap this step's compute. dk/dv
+        # must ship AFTER our contribution is added — started post-compute.
+        if s < n - 1:
+            if s > 0:
+                pltpu.semaphore_wait(ready_sem.at[nxt], 1)
+            rk = pltpu.make_async_remote_copy(
+                src_ref=kbuf.at[cur], dst_ref=kbuf.at[nxt],
+                send_sem=send_sem.at[cur, 0], recv_sem=recv_sem.at[nxt, 0],
+                device_id={axis_name: right},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            rv = pltpu.make_async_remote_copy(
+                src_ref=vbuf.at[cur], dst_ref=vbuf.at[nxt],
+                send_sem=send_sem.at[cur, 1], recv_sem=recv_sem.at[nxt, 1],
+                device_id={axis_name: right},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            rk.start()
+            rv.start()
+
+        def kb_body(bh, kb):
+            k0 = src * Tl + kb * bk
+            copy(kbuf.at[cur, bh, pl.ds(kb * bk, bk)], kt)
+            copy(vbuf.at[cur, bh, pl.ds(kb * bk, bk)], vt)
+            copy(dkbuf.at[cur, bh, pl.ds(kb * bk, bk)], dkt)
+            copy(dvbuf.at[cur, bh, pl.ds(kb * bk, bk)], dvt)
+            kv = kt[:].astype(jnp.float32)
+            vv = vt[:].astype(jnp.float32)
+            k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+
+            def qb_body(g, qb):
+                qh = bh * n_rep + g
+                q0 = my * Tl + qb * bq
+
+                @pl.when(jnp.logical_or(not causal, k0 <= q0 + bq - 1))
+                def _tile():
+                    copy(q_hbm.at[qh, pl.ds(qb * bq, bq)], qt)
+                    copy(do_hbm.at[qh, pl.ds(qb * bq, bq)], dot)
+                    copy(lse_hbm.at[qh, pl.ds(qb * bq, bq)], lset)
+                    copy(delta_hbm.at[qh, pl.ds(qb * bq, bq)], deltat)
+                    qv = qt[:].astype(jnp.float32)
+                    dov = dot[:].astype(jnp.float32)
+                    s_blk = scale * jax.lax.dot_general(
+                        qv, kv, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    if causal:
+                        q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+                        s_blk = jnp.where(q_pos >= k_pos, s_blk, NEG_INF)
+                    p = jnp.exp(s_blk - lset[:][:, :1])
+                    dp = jax.lax.dot_general(
+                        dov, vv, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    ds = p * (dp - deltat[:][:, :1])
+                    dvt[:] += jax.lax.dot_general(   # p^T @ do
+                        p, dov, (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    dkt[:] += scale * jax.lax.dot_general(  # ds^T @ q
+                        ds, qv, (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    # dq: read-modify-write the local accumulator tile
+                    copy(dq_hbm.at[qh, pl.ds(qb * bq, bq)], dqt)
+                    dqt[:] += scale * jax.lax.dot_general(  # ds @ k
+                        ds, kv, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    copy(dqt, dq_hbm.at[qh, pl.ds(qb * bq, bq)])
+
+                return 0
+
+            jax.lax.fori_loop(
+                0, n_rep * num_qb,
+                lambda i, _: (qb_body(i // num_qb, i % num_qb), 0)[1], 0,
+            )
+            copy(dkt, dkbuf.at[cur, bh, pl.ds(kb * bk, bk)])
+            copy(dvt, dvbuf.at[cur, bh, pl.ds(kb * bk, bk)])
+            return 0
+
+        def run_kb_loop():
+            jax.lax.fori_loop(
+                0, BHkv * num_kb,
+                lambda i, _: (kb_body(i // num_kb, i % num_kb), 0)[1], 0,
+            )
+
+        if causal and s > 0:
+            # whole shard in this device's causal future ⇒ nothing to add
+            # (the accumulators still ride the ring untouched)
+            pl.when(src <= my)(run_kb_loop)
+        else:
+            run_kb_loop()
+
+        if s < n - 1:
+            # ship the updated dk/dv accumulators after compute
+            rdk = pltpu.make_async_remote_copy(
+                src_ref=dkbuf.at[cur], dst_ref=dkbuf.at[nxt],
+                send_sem=send_sem.at[cur, 2], recv_sem=recv_sem.at[nxt, 2],
+                device_id={axis_name: right},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            rdv = pltpu.make_async_remote_copy(
+                src_ref=dvbuf.at[cur], dst_ref=dvbuf.at[nxt],
+                send_sem=send_sem.at[cur, 3], recv_sem=recv_sem.at[nxt, 3],
+                device_id={axis_name: right},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            rdk.start()
+            rdv.start()
+            rk.wait()
+            rv.wait()
+            rdk.wait()
+            rdv.wait()
+            pltpu.semaphore_signal(
+                ready_sem.at[cur], inc=1, device_id={axis_name: left},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+
+    if n > 1:
+        # drain the right neighbor's final free-signal (same reason as the
+        # forward kernel: zero semaphores at exit)
+        pltpu.semaphore_wait(ready_sem.at[(n - 2) % 2], 1)
+
+    # final rotation: shard my+1's finished dk/dv sits in our last slot —
+    # deliver it straight into the right neighbor's output refs
+    last = (n - 1) % 2
+    fdk = pltpu.make_async_remote_copy(
+        src_ref=dkbuf.at[last], dst_ref=dk_hbm,
+        send_sem=fin_sem_s.at[0], recv_sem=fin_sem_r.at[0],
+        device_id={axis_name: right},
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    fdv = pltpu.make_async_remote_copy(
+        src_ref=dvbuf.at[last], dst_ref=dv_hbm,
+        send_sem=fin_sem_s.at[1], recv_sem=fin_sem_r.at[1],
+        device_id={axis_name: right},
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    fdk.start()
+    fdv.start()
+    fdk.wait()
+    fdv.wait()
+
+
+def _ring_bwd(q, k, v, o, lse, do, axis_name: str, causal: bool, interpret: Any):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tl, D = q.shape
+    Hkv = k.shape[1]
+    n_rep = H // Hkv
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = D ** -0.5
+    bq = min(256, Tl)
+    bk = min(256, Tl)
+    qf = q.reshape(B * H, Tl, D)
+    kf = k.reshape(B * Hkv, Tl, D)
+    vf = v.reshape(B * Hkv, Tl, D)
+    dof = do.reshape(B * H, Tl, D)
+    lsef = lse.reshape(B * H, Tl, _STAT_LANES)
+    delta = jnp.sum(
+        dof.astype(jnp.float32) * o.reshape(B * H, Tl, D).astype(jnp.float32), axis=-1
+    )
+    delta = jnp.broadcast_to(delta[:, :, None], (B * H, Tl, _STAT_LANES))
+
+    kernel = functools.partial(
+        _ring_bwd_kernel, n=n, axis_name=axis_name, causal=causal, scale=scale,
+        n_rep=n_rep, bq=bq, bk=bk,
+    )
+    hbm = pltpu.MemorySpace.HBM
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+            pl.BlockSpec(memory_space=hbm),
+            pl.BlockSpec(memory_space=hbm),
+            pl.BlockSpec(memory_space=hbm),
+            pl.BlockSpec(memory_space=hbm),
+            pl.BlockSpec(memory_space=hbm),
+            pl.BlockSpec(memory_space=hbm),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=hbm),
+            pl.BlockSpec(memory_space=hbm),
+            pl.BlockSpec(memory_space=hbm),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tl, D), jnp.float32),
+            jax.ShapeDtypeStruct((B * Hkv, Tl, D), jnp.float32),
+            jax.ShapeDtypeStruct((B * Hkv, Tl, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            hbm((2, B * Hkv, Tl, D), k.dtype),     # ring KV slots
+            hbm((2, B * Hkv, Tl, D), v.dtype),
+            hbm((2, B * Hkv, Tl, D), jnp.float32),  # riding dk/dv accumulators
+            hbm((2, B * Hkv, Tl, D), jnp.float32),
+            pltpu.MemorySpace.VMEM((bq, D), q.dtype),      # tiles
+            pltpu.MemorySpace.VMEM((bk, D), k.dtype),
+            pltpu.MemorySpace.VMEM((bk, D), v.dtype),
+            pltpu.MemorySpace.VMEM((bq, D), do.dtype),
+            pltpu.MemorySpace.VMEM((bq, _STAT_LANES), jnp.float32),
+            pltpu.MemorySpace.VMEM((bq, _STAT_LANES), jnp.float32),
+            pltpu.MemorySpace.VMEM((bq, D), jnp.float32),
+            pltpu.MemorySpace.VMEM((bk, D), jnp.float32),
+            pltpu.MemorySpace.VMEM((bk, D), jnp.float32),
+            pltpu.SemaphoreType.DMA((1,)),
+            pltpu.SemaphoreType.DMA((2, 4)),
+            pltpu.SemaphoreType.DMA((2, 4)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=RING_ATTENTION_BWD_COLLECTIVE_ID),
+        interpret=interpret if interpret is not None else default_interpret(),
+    )(jnp.full((1,), my, jnp.int32), qf, kf, vf, dof, lsef, delta)
+    return (
+        dq.reshape(B, H, Tl, D).astype(q.dtype),
+        dk.reshape(B, Hkv, Tl, D).astype(k.dtype),
+        dv.reshape(B, Hkv, Tl, D).astype(v.dtype),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -290,31 +601,22 @@ def ring_attention_pallas(
     [B, Hkv, T_local, D] with H % Hkv == 0 (GQA stays at Hkv width on the
     wire). ``interpret`` accepts ``pltpu.InterpretParams`` for the
     emulated-RDMA CPU path; None defers to ``TONY_PALLAS_INTERPRET``.
+
+    Trainable end-to-end in-kernel: the backward is its own remote-DMA ring
+    kernel (``_ring_bwd_kernel``) — dk/dv accumulators ride the ring WITH
+    their KV shard and a final rotation returns them home.
     """
-    return _ring_fwd(q, k, v, axis_name, causal, interpret)
+    return _ring_fwd(q, k, v, axis_name, causal, interpret)[0]
 
 
 def _ring_vjp_fwd(q, k, v, axis_name, causal, interpret):
-    return _ring_fwd(q, k, v, axis_name, causal, interpret), (q, k, v)
+    o, lse = _ring_fwd(q, k, v, axis_name, causal, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _ring_vjp_bwd(axis_name, causal, interpret, res, g):
-    # backward through the XLA ring (same schedule, compiler-scheduled
-    # collectives): recompute-from-inputs, the standard flash-bwd trade
-    from tony_tpu.ops.attention import repeat_kv
-    from tony_tpu.parallel.context import ring_attention
-
-    q, k, v = res
-    n_rep = q.shape[1] // k.shape[1]
-
-    def ref(q, k, v):
-        return ring_attention(
-            q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
-            axis_name=axis_name, causal=causal,
-        )
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _ring_bwd(q, k, v, o, lse, g, axis_name, causal, interpret)
 
 
 ring_attention_pallas.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)

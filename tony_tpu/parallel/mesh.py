@@ -99,9 +99,28 @@ class MeshSpec:
                     f"cannot place {num_slices} slices: no DCN-safe axis "
                     f"(one of {sorted(DCN_SAFE_AXES)}) is divisible by the slice count"
                 )
-            arr = mesh_utils.create_hybrid_device_mesh(
-                tuple(ici_shape), tuple(dcn_shape), devices=devices
-            )
+            if all(hasattr(d, "slice_index") for d in devices):
+                arr = mesh_utils.create_hybrid_device_mesh(
+                    tuple(ici_shape), tuple(dcn_shape), devices=devices
+                )
+            else:
+                # emulated/CPU devices carry no slice topology: lay slices
+                # out contiguously by hand (device i//per_slice = its slice),
+                # with the DCN axis slowest-varying — the same logical layout
+                # create_hybrid_device_mesh produces on real multi-slice pods
+                per_slice_n = len(devices) // num_slices
+                arr = (
+                    np.array(devices)
+                    .reshape(num_slices, per_slice_n)
+                    .reshape(tuple(dcn_shape) + tuple(ici_shape))
+                )
+                # interleave [dcn..., ici...] → [axis0_dcn, axis0_ici, ...]
+                # then merge each axis's (dcn, ici) pair
+                n_ax = len(ALL_AXES)
+                perm = [i for pair in zip(range(n_ax), range(n_ax, 2 * n_ax)) for i in pair]
+                arr = arr.transpose(perm).reshape(
+                    tuple(d * i for d, i in zip(dcn_shape, ici_shape))
+                )
             return Mesh(arr, ALL_AXES)
         try:
             from jax.experimental import mesh_utils

@@ -120,6 +120,185 @@ def spmd_pipeline(
     return out.reshape(B, *out.shape[2:])
 
 
+def _mask_tree(pred, tree):
+    return jax.tree.map(lambda a: jnp.where(pred, a, jnp.zeros_like(a)), tree)
+
+
+def _add_trees(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def _f32_zeros_like(tree):
+    return jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+
+
+def spmd_pipeline_1f1b(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    tokens: jax.Array,
+    embed_params: Any,
+    head_params: Any,
+    embed_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_head_fn: Callable[[Any, jax.Array, jax.Array], tuple[jax.Array, jax.Array]],
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "stage",
+    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+    wire_dtype=jnp.bfloat16,
+    compute_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, jax.Array, tuple[Any, Any, Any]]:
+    """One-forward-one-backward (1F1B) pipeline **train step core**: returns
+    ``(nll_sum, n_tokens, (d_stage_params, d_embed_params, d_head_params))``.
+
+    Unlike the GPipe path (``spmd_pipeline`` + autodiff), the backward is
+    hand-scheduled INSIDE the same tick loop: on tick t, stage s runs the
+    forward of microbatch ``t - s`` and the backward of microbatch
+    ``t - 2S + 1 + s``, with activations travelling the stage ring forward
+    and gradients travelling it backward. Consequences:
+
+    - peak live activations per stage are bounded by the residual buffer
+      (2S + 1 microbatch inputs) instead of GPipe's M — the win when M ≫ S;
+    - the loss head runs *inside* the last stage's tick (no [M, …] output
+      bank psum-broadcast to every stage);
+    - no autodiff ever touches a collective, so the bf16 wire works on every
+      backend (the GPipe path must widen to f32 off-TPU);
+    - the microbatch BATCH dim composes with data/fsdp sharding: tokens are
+      sharded over ``batch_axes`` and every gradient is psum-reduced over
+      them before leaving the shard_map.
+
+    Contract: ``stage_fn(stage_local_params, x) -> y`` (applied per stage,
+    recomputed during its backward unit — activation remat is built in);
+    ``embed_fn(embed_params, tok_in) -> x0``; ``loss_head_fn(head_params,
+    y_last, tok_mb) -> (nll_sum, n_valid_tokens)``. ``tokens`` is
+    [B, T+1] (targets derived inside the head fn). Losses are summed, NOT
+    token-normalized — divide grads by ``n_tokens`` for a mean-loss step.
+
+    SPMD cost note: every stage executes the loss-head and embed computation
+    each tick (their results are masked off except on the owning stage) —
+    the price of a single lockstep program; keep ``ce_chunk`` moderate.
+    """
+    S = mesh.shape[axis_name]
+    M = num_microbatches
+    B = tokens.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    present = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    tok_mb = tokens.reshape(M, B // M, *tokens.shape[1:])
+
+    def body(stage_p, embed_p, head_p, toks):
+        idx = jax.lax.axis_index(axis_name)
+        local_params = jax.tree.map(lambda p: p[0], stage_p)
+        x_probe = embed_fn(embed_p, toks[0, :, :-1])
+        mb_shape = x_probe.shape  # [b, Tin, D]
+        BUF = 2 * S + 1  # last slot is the trash slot for invalid writes
+
+        def head_value_grads(hp, y, tok):
+            def f(hp, y):
+                nll, n = loss_head_fn(hp, y, tok)
+                return nll, n
+
+            (nll, n), (dhp, dy) = jax.value_and_grad(f, argnums=(0, 1), has_aux=True)(hp, y)
+            return nll, n, dhp, dy
+
+        def tick(carry, t):
+            fwd_in, bwd_in, resid, dstage, dembed, dhead, nll_acc, ntok_acc = carry
+            last = idx == S - 1
+            first = idx == 0
+
+            # ---- forward unit: microbatch mf enters this stage
+            mf = t - idx
+            fwd_valid = jnp.logical_and(mf >= 0, mf < M)
+            tok_f = toks[jnp.clip(mf, 0, M - 1)]
+            x0 = embed_fn(embed_p, tok_f[:, :-1]).astype(compute_dtype)
+            x = jnp.where(first, x0, fwd_in.astype(compute_dtype)).astype(compute_dtype)
+            y = stage_fn(local_params, x).astype(compute_dtype)
+            slot_w = jnp.where(fwd_valid, mf % (2 * S), 2 * S)
+            resid = jax.lax.dynamic_update_index_in_dim(resid, x, slot_w, 0)
+
+            # ---- backward unit: microbatch mb leaves this stage
+            mb = t - 2 * S + 1 + idx
+            bwd_valid = jnp.logical_and(mb >= 0, mb < M)
+            slot_r = jnp.where(bwd_valid, mb % (2 * S), 2 * S)
+            x_res = jax.lax.dynamic_index_in_dim(resid, slot_r, 0, keepdims=False)
+            tok_b = toks[jnp.clip(mb, 0, M - 1)]
+            y_res, stage_vjp = jax.vjp(stage_fn, local_params, x_res)
+            nll, n, dhp, dy = head_value_grads(head_p, y_res, tok_b)
+            g = jnp.where(last, dy.astype(wire_dtype), bwd_in).astype(y_res.dtype)
+            dp_m, dx_m = stage_vjp(g)
+
+            dstage = _add_trees(dstage, _mask_tree(bwd_valid, dp_m))
+            dhead = _add_trees(
+                dhead, _mask_tree(jnp.logical_and(bwd_valid, last), dhp)
+            )
+            nll_acc = nll_acc + jnp.where(jnp.logical_and(bwd_valid, last), nll, 0.0)
+            ntok_acc = ntok_acc + jnp.where(
+                jnp.logical_and(bwd_valid, last), n.astype(jnp.float32), 0.0
+            )
+            # stage 0 accumulates the embed gradient in-tick (the vjp's
+            # scatter-add fuses into the running accumulator — no [M, …]
+            # bank, which would reinstate the O(M) memory 1F1B avoids)
+            _, evjp = jax.vjp(lambda ep: embed_fn(ep, tok_b[:, :-1]), embed_p)
+            (dE_m,) = evjp(dx_m.astype(x_probe.dtype))
+            dembed = _add_trees(
+                dembed,
+                _mask_tree(
+                    jnp.logical_and(bwd_valid, first),
+                    jax.tree.map(lambda a: a.astype(jnp.float32), dE_m),
+                ),
+            )
+
+            # ---- rings: activations forward, gradients backward
+            fwd_out = jax.lax.ppermute(
+                y.astype(wire_dtype), axis_name, [(i, (i + 1) % S) for i in range(S)]
+            )
+            bwd_out = jax.lax.ppermute(
+                dx_m.astype(wire_dtype), axis_name, [(i, (i - 1) % S) for i in range(S)]
+            )
+            return (fwd_out, bwd_out, resid, dstage, dembed, dhead, nll_acc, ntok_acc), None
+
+        carry0 = (
+            jnp.zeros(mb_shape, wire_dtype),
+            jnp.zeros(mb_shape, wire_dtype),
+            jnp.zeros((BUF, *mb_shape), compute_dtype),
+            _f32_zeros_like(local_params),
+            _f32_zeros_like(embed_p),
+            _f32_zeros_like(head_p),
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
+        )
+        (_, _, _, dstage, dembed, dhead, nll, ntok), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(M + 2 * S - 1)
+        )
+
+        # reduce: batch shards partial-sum everything; the stage axis
+        # all-reduces the per-stage-owned pieces (zeros elsewhere)
+        axes_all = (axis_name, *present)
+        nll = jax.lax.psum(nll, axes_all)
+        ntok = jax.lax.psum(ntok, axes_all)
+        dembed = jax.tree.map(lambda a: jax.lax.psum(a, axes_all), dembed)
+        dhead = jax.tree.map(lambda a: jax.lax.psum(a, axes_all), dhead)
+        if present:
+            dstage = jax.tree.map(lambda a: jax.lax.psum(a, present), dstage)
+        dstage = jax.tree.map(lambda a: a[None], dstage)  # local [1, ...] → P(stage)
+        return nll, ntok, dstage, dembed, dhead
+
+    param_specs = jax.tree.map(lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params)
+    rep = jax.tree.map(lambda p: P(), embed_params)
+    rep_head = jax.tree.map(lambda p: P(), head_params)
+    tok_spec = P(None, present or None, *([None] * (tok_mb.ndim - 2)))
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, rep, rep_head, tok_spec),
+        out_specs=(P(), P(), param_specs, rep, rep_head),
+        axis_names={axis_name, *present},
+        check_vma=False,
+    )
+    nll, ntok, dstage, dembed, dhead = fn(stage_params, embed_params, head_params, tok_mb)
+    return nll, ntok, (dstage, dembed, dhead)
+
+
 def stack_stages(params_per_stage: list[Any]) -> Any:
     """[pytree_s for s in stages] → pytree with leading stage dim."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *params_per_stage)

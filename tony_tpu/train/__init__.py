@@ -4,6 +4,7 @@ from tony_tpu.train.trainer import (  # noqa: F401
     OptimizerConfig,
     Throughput,
     TrainState,
+    make_pp_train_step,
     make_train_step,
     sharded_init,
 )

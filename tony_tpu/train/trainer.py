@@ -105,6 +105,32 @@ def make_train_step(
     return jax.jit(train_step, donate_argnums=0)
 
 
+def make_pp_train_step(
+    value_and_grad_fn: Callable[[Any, Any], tuple[jax.Array, dict, Any]],
+    optimizer: optax.GradientTransformation,
+) -> Callable[[TrainState, Any], tuple[TrainState, dict]]:
+    """Train step from a function that produces gradients itself —
+    ``value_and_grad_fn(params, batch) -> (loss, aux, grads)``. The 1F1B
+    pipeline schedule (llama.pp_value_and_grad) hand-runs its backward
+    inside the pipeline loop, so it cannot go through jax.value_and_grad;
+    everything after gradients (optimizer, metrics, donation) is identical
+    to make_train_step."""
+
+    def train_step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
+        loss, aux, grads = value_and_grad_fn(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss.astype(jnp.float32),
+            "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+            "step": state.step + 1,
+            **{k: v for k, v in aux.items() if k != "loss"},
+        }
+        return TrainState(params, opt_state, state.step + 1), metrics
+
+    return jax.jit(train_step, donate_argnums=0)
+
+
 def sharded_init(
     init_fn: Callable[[], Any],
     rules: ShardingRules,
