@@ -138,3 +138,36 @@ class TestOptimizerMemory:
         batch = mlp.synthetic_batch(jax.random.PRNGKey(1), 4, mlp.MLPConfig())
         state, metrics = step(state, batch)
         assert bool(jnp.isfinite(metrics["loss"]))
+
+
+class TestLoopPipelineParallel:
+    def test_run_lm_training_with_stage_axis(self):
+        """tony-submit-path pipeline training: stage_axis=2 routes the loop
+        through the 1F1B schedule (make_pp_train_step) on the virtual mesh."""
+        import dataclasses as dc
+
+        import numpy as np
+
+        from tony_tpu.models import llama
+        from tony_tpu.train.loop import LoopConfig, run_lm_training
+
+        cfg = dc.replace(llama.LLAMA_TINY, max_seq=64)
+        out = run_lm_training(
+            llama, cfg,
+            LoopConfig(steps=3, batch_size=8, seq_len=64, log_every=1,
+                       warmup_steps=0, stage_axis=2, pp_microbatches=2),
+        )
+        assert np.isfinite(out["loss"])
+        assert out["step"] == 3
+
+    def test_stage_axis_rejects_models_without_pp(self):
+        import pytest as _pytest
+
+        from tony_tpu.models import bert
+        from tony_tpu.train.loop import LoopConfig, run_lm_training
+
+        with _pytest.raises(ValueError, match="pp_value_and_grad"):
+            run_lm_training(
+                bert, bert.BERT_TINY,
+                LoopConfig(steps=1, batch_size=8, seq_len=64, stage_axis=2),
+            )

@@ -24,7 +24,13 @@ from tony_tpu.runtime import init_distributed
 from tony_tpu.train.checkpoint import restore_or_init
 from tony_tpu.train.metrics import detect_peak_flops, flops_per_token_for_batch
 from tony_tpu.train.profiling import StepProfiler
-from tony_tpu.train.trainer import OptimizerConfig, Throughput, make_train_step, sharded_init
+from tony_tpu.train.trainer import (
+    OptimizerConfig,
+    Throughput,
+    make_pp_train_step,
+    make_train_step,
+    sharded_init,
+)
 
 
 @dataclass(frozen=True)
@@ -40,6 +46,8 @@ class LoopConfig:
     model_axis: int = 1
     context_axis: int = 1
     expert_axis: int = 1
+    stage_axis: int = 1        # >1: pipeline parallelism (1F1B schedule)
+    pp_microbatches: int = 4   # microbatches per 1F1B step (batch must divide)
     data_dir: str = ""  # dir of *.tonytok shards; empty → synthetic batches
 
 
@@ -49,9 +57,16 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
     model_module must expose init/loss_fn/sharding_rules/synthetic_batch and
     the config flops_per_token(). Returns the final metrics dict.
     """
+    if loop.stage_axis > 1 and not hasattr(model_module, "pp_value_and_grad"):
+        # fail in milliseconds, not after a multi-GB sharded init/restore
+        raise ValueError(
+            f"{model_module.__name__} has no pp_value_and_grad — "
+            "pipeline parallelism (stage_axis > 1) is llama-family only"
+        )
     init_distributed()  # no-op off-gang; joins jax.distributed under tony
     spec = MeshSpec.auto(
-        model=loop.model_axis, context=loop.context_axis, expert=loop.expert_axis
+        model=loop.model_axis, context=loop.context_axis, expert=loop.expert_axis,
+        stage=loop.stage_axis,
     )
     # multi-slice pools (MultiSliceResourceManager) announce the DCN layout;
     # build() then restricts DCN crossings to data/pipeline axes
@@ -74,9 +89,20 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
     if start_step:
         print(f"[train] resumed from checkpoint step {start_step}", flush=True)
 
-    step_fn = make_train_step(
-        functools.partial(model_module.loss_fn, cfg=model_cfg, mesh=mesh), opt
-    )
+    if loop.stage_axis > 1:
+        # pipeline parallelism: the 1F1B schedule produces its own gradients
+        # (hand-scheduled interleaved backward; see parallel/pipeline.py)
+        step_fn = make_pp_train_step(
+            functools.partial(
+                model_module.pp_value_and_grad, cfg=model_cfg, mesh=mesh,
+                num_microbatches=loop.pp_microbatches,
+            ),
+            opt,
+        )
+    else:
+        step_fn = make_train_step(
+            functools.partial(model_module.loss_fn, cfg=model_cfg, mesh=mesh), opt
+        )
     # gathered-MLM batches (BERT) project only the masked positions through
     # the vocab head — derive the flops basis from an actual batch so the
     # reported MFU matches the work done (shared helper with bench.py)
@@ -193,6 +219,9 @@ def parse_loop_args(argv: list[str] | None = None) -> tuple[LoopConfig, dict]:
     p.add_argument("--model_axis", type=int, default=1)
     p.add_argument("--context_axis", type=int, default=1)
     p.add_argument("--expert_axis", type=int, default=1)
+    p.add_argument("--stage_axis", type=int, default=1,
+                   help="pipeline stages (1F1B schedule when > 1)")
+    p.add_argument("--pp_microbatches", type=int, default=4)
     p.add_argument("--data_dir", default="")
     p.add_argument("--preset", default="tiny")
     args = p.parse_args(argv if argv is not None else sys.argv[1:])
