@@ -407,3 +407,31 @@ class TestSequencePacking:
             np.testing.assert_allclose(
                 np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=2e-2, atol=2e-4
             )
+
+
+class TestMixtralPacking:
+    def test_packed_loss_equals_unpacked(self):
+        # same invariant as the llama packing test, through the MoE model:
+        # packed token-NLL mass == the sequences run separately
+        import dataclasses as dc
+
+        from tony_tpu.models import mixtral
+
+        cfg = dc.replace(mixtral.MIXTRAL_TINY, max_seq=64, remat=False)
+        params = mixtral.init(KEY, cfg)
+        a = jax.random.randint(jax.random.fold_in(KEY, 1), (33,), 0, cfg.vocab_size)
+        b = jax.random.randint(jax.random.fold_in(KEY, 2), (32,), 0, cfg.vocab_size)
+
+        def solo_mass(seq):
+            _, m = mixtral.loss_fn(params, {"tokens": seq[None, :]}, cfg)
+            return float(m["ce_loss"]) * float(m["tokens"])
+
+        seg = jnp.concatenate([jnp.full((33,), 1), jnp.full((32,), 2)])[None, :]
+        packed = jnp.concatenate([a, b])[None, :]
+        _, m_p = mixtral.loss_fn(params, {"tokens": packed, "segment_ids": seg}, cfg)
+        np.testing.assert_allclose(
+            float(m_p["ce_loss"]) * float(m_p["tokens"]),
+            solo_mass(a) + solo_mass(b),
+            rtol=5e-3,
+        )
+        assert int(m_p["tokens"]) == 63

@@ -227,6 +227,17 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh, segment_ids=None) -> jax.Array:
     )
 
 
+def mask_packed_targets(tokens: jax.Array, seg: jax.Array | None):
+    """Shared packed-batch target masking (llama + mixtral): next-token
+    pairs must stay within one segment, and segment 0 (padding) never
+    contributes loss. Returns (targets [B, T], seg_in [B, T] or None)."""
+    targets = tokens[:, 1:]
+    if seg is None:
+        return targets, None
+    ok = (seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] != 0)
+    return jnp.where(ok, targets, -100), seg[:, :-1]
+
+
 def segment_positions(segment_ids: jax.Array) -> jax.Array:
     """[B, T] per-segment positions (0-based, restarting at each segment
     boundary) for RoPE on packed batches."""
@@ -441,13 +452,7 @@ def loss_fn(params: dict, batch: dict, cfg: LlamaConfig, mesh=None) -> tuple[jax
     token predicting the NEXT segment's first) are masked out of the loss.
     """
     tokens = batch["tokens"]
-    seg = batch.get("segment_ids")
-    targets = tokens[:, 1:]
-    if seg is not None:
-        # valid next-token pairs stay within one segment; segment 0 is padding
-        ok = (seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] != 0)
-        targets = jnp.where(ok, targets, -100)
-    seg_in = seg[:, :-1] if seg is not None else None
+    targets, seg_in = mask_packed_targets(tokens, batch.get("segment_ids"))
     if cfg.ce_chunk > 0:
         x = hidden_states(params, tokens[:, :-1], cfg, mesh, segment_ids=seg_in)
         loss, n = L.chunked_cross_entropy_loss(

@@ -107,11 +107,20 @@ def sharding_rules(cfg: MixtralConfig) -> ShardingRules:
     ])
 
 
-def hidden_states(params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None) -> tuple[jax.Array, dict]:
-    """tokens [B, T] → (final-norm hidden states [B, T, D], moe aux losses)."""
+def hidden_states(
+    params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None, segment_ids=None
+) -> tuple[jax.Array, dict]:
+    """tokens [B, T] → (final-norm hidden states [B, T, D], moe aux losses).
+
+    ``segment_ids`` [B, T] (packed sequences): segment-confined attention +
+    per-segment RoPE positions, same contract as llama.hidden_states."""
     B, T = tokens.shape
     Dh, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     cos, sin = L.rope_frequencies(Dh, T, cfg.rope_theta, cfg.rope_scaling)
+    positions = (
+        llama_mod.segment_positions(segment_ids) if segment_ids is not None else None
+    )
+    token_mask = (segment_ids != 0) if segment_ids is not None else None
     act_spec = P(BATCH_AXES, "context", None)
 
     x = jnp.take(params["embed"], tokens, axis=0)
@@ -124,14 +133,18 @@ def hidden_states(params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None
         q = jnp.einsum("btd,dh->bth", h, lp["wq"]).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
         k = jnp.einsum("btd,dh->bth", h, lp["wk"]).reshape(B, T, Hkv, Dh).transpose(0, 2, 1, 3)
         v = jnp.einsum("btd,dh->bth", h, lp["wv"]).reshape(B, T, Hkv, Dh).transpose(0, 2, 1, 3)
-        q, k = L.apply_rope(q, cos, sin), L.apply_rope(k, cos, sin)
-        o = llama_mod._attention(q, k, v, cfg, mesh)
+        q = L.apply_rope(q, cos, sin, positions=positions)
+        k = L.apply_rope(k, cos, sin, positions=positions)
+        o = llama_mod._attention(q, k, v, cfg, mesh, segment_ids=segment_ids)
         o = o.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
         x = x + jnp.einsum("bth,hd->btd", o, lp["wo"])
         if mesh is not None:
             x = constrain(x, mesh, act_spec)
         h = L.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        y, aux = moe_ffn(h, lp["router"], lp["we_gate"], lp["we_up"], lp["we_down"], cfg.moe, mesh)
+        y, aux = moe_ffn(
+            h, lp["router"], lp["we_gate"], lp["we_up"], lp["we_down"], cfg.moe,
+            mesh, token_mask=token_mask,
+        )
         x = x + y
         if mesh is not None:
             x = constrain(x, mesh, act_spec)
@@ -149,25 +162,30 @@ def hidden_states(params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None
     return L.rms_norm(x, params["final_norm"], cfg.norm_eps), aux
 
 
-def forward(params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None) -> tuple[jax.Array, dict]:
+def forward(
+    params: dict, tokens: jax.Array, cfg: MixtralConfig, mesh=None, segment_ids=None
+) -> tuple[jax.Array, dict]:
     """tokens [B, T] → (logits [B, T, V], moe aux losses summed over layers)."""
-    x, aux = hidden_states(params, tokens, cfg, mesh)
+    x, aux = hidden_states(params, tokens, cfg, mesh, segment_ids=segment_ids)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"])
     return logits, aux
 
 
 def loss_fn(params: dict, batch: dict, cfg: MixtralConfig, mesh=None) -> tuple[jax.Array, dict]:
     """With ``cfg.ce_chunk > 0`` the lm-head + CE fuse per sequence chunk so
-    the [B, T, V] logits never materialize (same scheme as llama.loss_fn)."""
+    the [B, T, V] logits never materialize; packed batches (segment_ids)
+    get segment-confined attention and boundary/pad target masking (same
+    scheme as llama.loss_fn)."""
     tokens = batch["tokens"]
+    targets, seg_in = llama_mod.mask_packed_targets(tokens, batch.get("segment_ids"))
     if cfg.ce_chunk > 0:
-        x, aux = hidden_states(params, tokens[:, :-1], cfg, mesh)
+        x, aux = hidden_states(params, tokens[:, :-1], cfg, mesh, segment_ids=seg_in)
         ce, n = L.chunked_cross_entropy_loss(
-            x, params["lm_head"], tokens[:, 1:], chunk=cfg.ce_chunk
+            x, params["lm_head"], targets, chunk=cfg.ce_chunk
         )
     else:
-        logits, aux = forward(params, tokens[:, :-1], cfg, mesh)
-        ce, n = L.cross_entropy_loss(logits, tokens[:, 1:])
+        logits, aux = forward(params, tokens[:, :-1], cfg, mesh, segment_ids=seg_in)
+        ce, n = L.cross_entropy_loss(logits, targets)
     loss = ce + aux["moe_balance_loss"] + aux["moe_z_loss"]
     return loss, {"loss": loss, "ce_loss": ce, "tokens": n, **aux}
 

@@ -34,10 +34,17 @@ def capacity(tokens_per_batch: int, cfg: MoEConfig) -> int:
     return max(c, cfg.top_k)
 
 
-def _route_common(x: jax.Array, router_w: jax.Array, cfg: MoEConfig):
+def _route_common(
+    x: jax.Array, router_w: jax.Array, cfg: MoEConfig, token_mask: jax.Array | None = None
+):
     """Shared routing prefix of both dispatch schemes: gating + per-choice
     capacity-slot assignment + aux losses (sans dropped-frac, which depends
     on the dispatch representation).
+
+    ``token_mask`` [B, T] (packed batches): masked-out tokens — padding —
+    claim NO capacity slots, get zero gates, and are excluded from the
+    balance/z losses, so pads neither evict real tokens nor train the
+    router on garbage hidden states.
 
     Returns (gate_vals [B,T,K], gate_idx [B,T,K], onehot [B,T,K,E],
     pos_in_expert [B,T,K,E], aux)."""
@@ -51,23 +58,39 @@ def _route_common(x: jax.Array, router_w: jax.Array, cfg: MoEConfig):
     gate_vals, gate_idx = jax.lax.top_k(probs, cfg.top_k)            # [B,T,K]
     gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
 
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)          # [B,T,K,E]
+    if token_mask is not None:
+        m = token_mask.astype(jnp.float32)
+        gate_vals = gate_vals * m[:, :, None]
+        onehot = onehot * m[:, :, None, None]
+
     # expert-choice position assignment: for each (expert, k-slot) count
     # prior tokens routed to that expert to get its capacity slot
-    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)          # [B,T,K,E]
     flat = onehot.transpose(0, 2, 1, 3).reshape(B, cfg.top_k * T, E)  # k-major order
     pos_in_expert = (jnp.cumsum(flat, axis=1) - flat).reshape(B, cfg.top_k, T, E).transpose(0, 2, 1, 3)
 
-    # aux losses: load-balance (Switch) + router z-loss
-    me = probs.mean(axis=(0, 1))                                     # [E] mean prob
-    ce = onehot.sum(axis=2).mean(axis=(0, 1))                        # [E] token fraction
+    # aux losses: load-balance (Switch) + router z-loss, over VALID tokens
+    if token_mask is None:
+        n_valid = jnp.float32(B * T)
+        me = probs.mean(axis=(0, 1))                                 # [E] mean prob
+        z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    else:
+        m = token_mask.astype(jnp.float32)
+        n_valid = jnp.maximum(m.sum(), 1.0)
+        me = (probs * m[:, :, None]).sum(axis=(0, 1)) / n_valid
+        z = jnp.sum(jax.nn.logsumexp(logits, axis=-1) ** 2 * m) / n_valid
+    ce = onehot.sum(axis=2).sum(axis=(0, 1)) / n_valid               # [E] token fraction
     aux = {
         "moe_balance_loss": cfg.aux_loss_coef * E * jnp.sum(me * ce) * (1.0 / cfg.top_k),
-        "moe_z_loss": cfg.router_z_coef * jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2),
+        "moe_z_loss": cfg.router_z_coef * z,
+        "moe_n_valid": n_valid,
     }
     return gate_vals, gate_idx, onehot, pos_in_expert, aux
 
 
-def route(x: jax.Array, router_w: jax.Array, cfg: MoEConfig) -> tuple[jax.Array, jax.Array, dict]:
+def route(
+    x: jax.Array, router_w: jax.Array, cfg: MoEConfig, token_mask: jax.Array | None = None
+) -> tuple[jax.Array, jax.Array, dict]:
     """Top-k routing with capacity (dense/GShard representation).
 
     x: [B, T, D]; router_w: [D, E] →
@@ -75,7 +98,7 @@ def route(x: jax.Array, router_w: jax.Array, cfg: MoEConfig) -> tuple[jax.Array,
     """
     B, T, _ = x.shape
     C = capacity(T, cfg)
-    gate_vals, _, onehot, pos_in_expert, aux = _route_common(x, router_w, cfg)
+    gate_vals, _, onehot, pos_in_expert, aux = _route_common(x, router_w, cfg, token_mask)
     within_cap = pos_in_expert < C                                   # [B,T,K,E]
 
     slot_onehot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), C, dtype=jnp.float32)  # [B,T,K,E,C]
@@ -83,11 +106,12 @@ def route(x: jax.Array, router_w: jax.Array, cfg: MoEConfig) -> tuple[jax.Array,
     combine = dispatch * gate_vals[..., None, None]
     dispatch = dispatch.sum(axis=2)                                  # [B,T,E,C]
     combine = combine.sum(axis=2)
-    aux["moe_dropped_frac"] = 1.0 - (dispatch.sum() / (B * T * cfg.top_k))
+    n_valid = aux.pop("moe_n_valid")
+    aux["moe_dropped_frac"] = 1.0 - dispatch.sum() / (n_valid * cfg.top_k)
     return dispatch, combine, aux
 
 
-def route_indices(x, router_w, cfg: MoEConfig):
+def route_indices(x, router_w, cfg: MoEConfig, token_mask: jax.Array | None = None):
     """Top-k routing producing GATHER indices instead of dispatch tensors.
 
     Returns (src [B, E, C] token index per expert slot, slot_valid
@@ -101,9 +125,13 @@ def route_indices(x, router_w, cfg: MoEConfig):
     E, C = cfg.num_experts, capacity(T, cfg)
     K = cfg.top_k
 
-    gate_vals, gate_idx, onehot, pos_in_expert, aux = _route_common(x, router_w, cfg)
+    gate_vals, gate_idx, onehot, pos_in_expert, aux = _route_common(x, router_w, cfg, token_mask)
     pos_of_choice = jnp.sum(pos_in_expert * onehot, axis=-1).astype(jnp.int32)  # [B,T,K]
     within_cap = pos_of_choice < C
+    if token_mask is not None:
+        # masked tokens have zeroed onehot → pos 0, which would CLAIM slot 0
+        # of their expert and clobber a real token: exclude them outright
+        within_cap = jnp.logical_and(within_cap, token_mask[:, :, None])
 
     # scatter each (t, k) choice into its (expert, slot) cell — ONE scatter
     # of a packed (token, gate) payload; valid falls out of the -1 init.
@@ -132,7 +160,8 @@ def route_indices(x, router_w, cfg: MoEConfig):
     src = jnp.where(valid, cells[..., 0], 0.0).astype(jnp.int32)
     gate = jnp.where(valid, cells[..., 1], 0.0)
 
-    aux["moe_dropped_frac"] = 1.0 - jnp.sum(valid).astype(jnp.float32) / (B * T * K)
+    n_valid = aux.pop("moe_n_valid")
+    aux["moe_dropped_frac"] = 1.0 - jnp.sum(valid).astype(jnp.float32) / (n_valid * K)
     return src, valid, gate, aux
 
 
@@ -156,6 +185,7 @@ def moe_ffn(
     w_down: jax.Array,
     cfg: MoEConfig,
     mesh=None,
+    token_mask: jax.Array | None = None,
 ) -> tuple[jax.Array, dict]:
     """SwiGLU mixture-of-experts FFN.
 
@@ -168,7 +198,7 @@ def moe_ffn(
     """
     dtype = x.dtype
     if cfg.dispatch == "dense":
-        dispatch, combine, aux = route(x, router_w, cfg)
+        dispatch, combine, aux = route(x, router_w, cfg, token_mask)
         xe = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), x)  # [E,B,C,D]
         ye = _expert_mlp(xe, w_gate, w_up, w_down, mesh)
         y = jnp.einsum("ebcd,btec->btd", ye, combine.astype(dtype))
@@ -176,7 +206,7 @@ def moe_ffn(
     if cfg.dispatch != "gather":
         raise ValueError(f"dispatch must be 'gather' or 'dense', got {cfg.dispatch!r}")
 
-    src, valid, gate, aux = route_indices(x, router_w, cfg)
+    src, valid, gate, aux = route_indices(x, router_w, cfg, token_mask)
     # routing outputs are tiny ([B,E,C] ints/floats) but their recompute in a
     # remat backward re-runs the whole gating pipeline (softmax, top-k,
     # cumsum, scatter — vector-bound): name them so remat policies can pin
