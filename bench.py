@@ -64,6 +64,7 @@ def run_bench(
     remat_policy: str | None = None,
     ce_chunk: int | None = None,
     mu_dtype: str = "",
+    moe_dispatch: str | None = None,
 ) -> dict:
     import jax
 
@@ -89,6 +90,12 @@ def run_bench(
             cfg = dataclasses.replace(cfg, ce_chunk=ce_chunk)
         else:
             print(f"[bench] ignoring --ce-chunk: {type(cfg).__name__} has no such field",
+                  file=sys.stderr)
+    if moe_dispatch is not None:
+        if "moe_dispatch" in fields:
+            cfg = dataclasses.replace(cfg, moe_dispatch=moe_dispatch)
+        else:
+            print(f"[bench] ignoring --moe-dispatch: {type(cfg).__name__} has no such field",
                   file=sys.stderr)
 
     n_dev = len(jax.devices())
@@ -348,6 +355,8 @@ def main() -> int:
     p.add_argument("--ce-chunk", type=int, default=None, help="0 = materialize logits")
     p.add_argument("--mu-dtype", default="", choices=["", "bfloat16", "float32"],
                    help="Adam first-moment dtype (default: param dtype)")
+    p.add_argument("--moe-dispatch", default=None, choices=["ragged", "gather", "dense"],
+                   help="override the MoE dispatch scheme (moe preset only)")
     args = p.parse_args()
 
     import jax
@@ -381,7 +390,7 @@ def main() -> int:
         try:
             r = run_bench(
                 attempt, args.steps, args.warmup, args.batch, args.seq,
-                args.remat_policy, args.ce_chunk, args.mu_dtype,
+                args.remat_policy, args.ce_chunk, args.mu_dtype, args.moe_dispatch,
             )
             out = {
                 "metric": f"{r['model']}_train_mfu_{r['n_chips']}chip_{attempt}",

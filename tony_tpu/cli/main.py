@@ -148,10 +148,14 @@ def _cmd_pool(argv: list[str]) -> int:
     svc.start()
     host, port = svc.address
 
+    # the secret travels via env, never argv: /proc/<pid>/cmdline is
+    # world-readable, agent.py's --secret already defaults to this env var
+    agent_env = {**os.environ, constants.ENV_POOL_SECRET: secret}
+
     def agent_args(name: str, extra: list[str]) -> list[str]:
         return [
             _sys.executable, "-u", "-m", "tony_tpu.cluster.agent",
-            "--rm", f"{host}:{port}", "--name", name, "--secret", secret,
+            "--rm", f"{host}:{port}", "--name", name,
             "--memory", args.memory, "--vcores", str(args.vcores), *extra,
         ]
 
@@ -173,14 +177,15 @@ def _cmd_pool(argv: list[str]) -> int:
                 agents.append(subprocess.Popen(agent_args(
                     f"slice{s}-host{h}",
                     ["--slice-id", str(s), "--slice", slice_spec.name, "--chips", chips],
-                )))
+                ), env=agent_env))
     else:
         for h in range(args.hosts):
-            agents.append(subprocess.Popen(agent_args(f"host{h}", [])))
+            agents.append(subprocess.Popen(agent_args(f"host{h}", []), env=agent_env))
 
     print(f"[tony-pool] pool service on {host}:{port} with {len(agents)} host agents")
     print(f"[tony-pool] submit with: --conf tony.tpu.pool=rm:{host}:{port} "
-          f"--conf tony.tpu.pool.secret={secret}")
+          f"(pool secret in ${constants.ENV_POOL_SECRET}; pass it via env or "
+          "--conf tony.tpu.pool.secret=...)")
     done = threading.Event()
     _signal.signal(_signal.SIGTERM, lambda *_: done.set())
     _signal.signal(_signal.SIGINT, lambda *_: done.set())

@@ -307,16 +307,16 @@ def hidden_states(
 def pp_loss_fn(
     params: dict, batch: dict, cfg: LlamaConfig, mesh, num_microbatches: int = 2
 ) -> tuple[jax.Array, dict]:
-    """Pipeline-parallel training loss: the stacked layer dim splits into
-    equal-depth stages over the mesh's ``stage`` axis (GPipe microbatch
-    schedule, parallel/pipeline.py); embedding and the (chunked) CE head run
-    outside the pipeline, replicated over stages.
+    """TEACHING-PATH pipeline loss (GPipe schedule + autodiff): the stacked
+    layer dim splits into equal-depth stages over the mesh's ``stage`` axis
+    (parallel/pipeline.spmd_pipeline); embedding and the (chunked) CE head
+    run outside the pipeline, replicated over stages.
 
-    The microbatches enter the schedule REPLICATED along data/fsdp (every
-    device recomputes the full batch — numerically correct, no DP speedup);
-    for pipeline × data-parallel composition use ``pp_value_and_grad`` (the
-    1F1B schedule shards the microbatch batch dim over data/fsdp). Does not
-    compose with a context axis either (use cp_impl on the flat path).
+    Production training uses ``pp_value_and_grad`` (1F1B) — the train loop
+    only ever routes there. This path stays as the independently-verifiable
+    spec the 1F1B parity tests compare against: microbatches enter
+    REPLICATED along data/fsdp (no DP speedup), the output bank broadcasts
+    to every stage, and neither packing nor a context axis composes.
     """
     from tony_tpu.parallel.pipeline import spmd_pipeline, split_layers_into_stages
 
@@ -365,9 +365,12 @@ def pp_value_and_grad(
     The hand-scheduled backward (parallel/pipeline.spmd_pipeline_1f1b)
     interleaves each microbatch's backward with later microbatches' forwards,
     bounding live activations per stage at O(S) microbatches instead of the
-    GPipe path's O(M); the CE head runs inside the last stage (no [M, …]
-    output bank broadcast), and the microbatch batch dim shards over
-    data/fsdp. Use via ``make_pp_train_step`` (train/trainer.py).
+    GPipe path's O(M); the CE head runs inside the last stage's tick behind
+    a ``lax.cond`` (other stages pay none of its FLOPs), and the microbatch
+    batch dim shards over data/fsdp. Packed batches (segment_ids) are
+    supported: attention confinement, per-segment RoPE, and boundary target
+    masking all apply per microbatch. Use via ``make_pp_train_step``
+    (train/trainer.py).
     """
     from tony_tpu.parallel.pipeline import spmd_pipeline_1f1b, split_layers_into_stages
 
@@ -380,35 +383,47 @@ def pp_value_and_grad(
         return loss, metrics, grads
     if mesh.shape.get("context", 1) > 1:
         raise ValueError("pipeline parallelism does not compose with a context axis")
-    if "segment_ids" in batch:
-        raise ValueError("pp paths do not support packed batches (segment_ids) yet")
     tokens = batch["tokens"]
     T = tokens.shape[1] - 1
     cos, sin = L.rope_frequencies(cfg.head_dim, T, cfg.rope_theta, cfg.rope_scaling)
 
-    block_fn = attn_ops.remat_block(
-        partial(_block, cos=cos, sin=sin, cfg=cfg, mesh=None),
-        cfg.remat, cfg.remat_policy,
-    )
+    def _mb_ctx(mb):
+        seg = mb.get("segment_ids")
+        seg_in = seg[:, :-1] if seg is not None else None
+        positions = segment_positions(seg_in) if seg_in is not None else None
+        return seg_in, positions
 
-    def stage_fn(stage_lp, h):
+    def stage_fn(stage_lp, h, mb):
+        seg_in, positions = _mb_ctx(mb)
+        block_fn = attn_ops.remat_block(
+            partial(_block, cos=cos, sin=sin, cfg=cfg, mesh=None,
+                    segment_ids=seg_in, positions=positions),
+            cfg.remat, cfg.remat_policy,
+        )
         h, _ = jax.lax.scan(block_fn, h, stage_lp)
         return h
 
-    def embed_fn(embed_p, tok_in):
-        return jnp.take(embed_p, tok_in, axis=0)
+    def embed_fn(embed_p, mb):
+        return jnp.take(embed_p, mb["tokens"][:, :-1], axis=0)
 
-    def loss_head_fn(head_p, y, tok):
+    def loss_head_fn(head_p, y, mb):
+        targets, _ = mask_packed_targets(mb["tokens"], mb.get("segment_ids"))
         x = L.rms_norm(y, head_p["final_norm"], cfg.norm_eps)
         mean, n = L.chunked_cross_entropy_loss(
-            x, head_p["lm_head"], tok[:, 1:], chunk=cfg.ce_chunk
+            x, head_p["lm_head"], targets, chunk=cfg.ce_chunk
         )
-        return mean * n, n
+        # mean * n == the exact nll SUM even when n is the CE's >=1 clamp
+        # (0/1 * 1 = 0); report the TRUE count so an all-pad microbatch
+        # doesn't inflate the token total the grads divide by
+        return mean * n, jnp.sum(targets != -100)
 
+    pp_batch = {"tokens": tokens}
+    if "segment_ids" in batch:
+        pp_batch["segment_ids"] = batch["segment_ids"]
     stages = split_layers_into_stages(params["layers"], S)
     head_params = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
-    nll, ntok, (dstage, dembed, dhead) = spmd_pipeline_1f1b(
-        stage_fn, stages, tokens, params["embed"], head_params,
+    nll, ntok, _, (dstage, dembed, dhead) = spmd_pipeline_1f1b(
+        stage_fn, stages, pp_batch, params["embed"], head_params,
         embed_fn, loss_head_fn,
         mesh=mesh, num_microbatches=num_microbatches, wire_dtype=wire_dtype,
         compute_dtype=cfg.jdtype,

@@ -26,7 +26,7 @@ class MoEConfig:
     capacity_factor: float = 1.25
     router_z_coef: float = 1e-3      # router z-loss (stability)
     aux_loss_coef: float = 1e-2      # load-balance loss
-    dispatch: str = "gather"         # gather (indexed, default) | dense (GShard einsum)
+    dispatch: str = "ragged"         # ragged (grouped GEMM, default) | gather (indexed) | dense (GShard einsum)
 
 
 def capacity(tokens_per_batch: int, cfg: MoEConfig) -> int:
@@ -34,43 +34,34 @@ def capacity(tokens_per_batch: int, cfg: MoEConfig) -> int:
     return max(c, cfg.top_k)
 
 
-def _route_common(
+def _gating(
     x: jax.Array, router_w: jax.Array, cfg: MoEConfig, token_mask: jax.Array | None = None
 ):
-    """Shared routing prefix of both dispatch schemes: gating + per-choice
-    capacity-slot assignment + aux losses (sans dropped-frac, which depends
-    on the dispatch representation).
+    """Gating shared by every dispatch scheme: router softmax, top-k gates
+    (renormalized, Mixtral convention), aux losses.
 
     ``token_mask`` [B, T] (packed batches): masked-out tokens — padding —
-    claim NO capacity slots, get zero gates, and are excluded from the
-    balance/z losses, so pads neither evict real tokens nor train the
-    router on garbage hidden states.
+    get zero gates and are excluded from the balance/z losses, so pads
+    neither contribute to the output nor train the router on garbage
+    hidden states.
 
-    Returns (gate_vals [B,T,K], gate_idx [B,T,K], onehot [B,T,K,E],
-    pos_in_expert [B,T,K,E], aux)."""
-    B, T, _ = x.shape
+    Returns (gate_vals [B,T,K] mask-zeroed, gate_idx [B,T,K], aux)."""
     E = cfg.num_experts
 
     logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), router_w.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)
 
-    # top-k gates, renormalized (Mixtral convention)
     gate_vals, gate_idx = jax.lax.top_k(probs, cfg.top_k)            # [B,T,K]
     gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
-
-    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)          # [B,T,K,E]
+    choice_onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)   # [B,T,K,E]
     if token_mask is not None:
         m = token_mask.astype(jnp.float32)
         gate_vals = gate_vals * m[:, :, None]
-        onehot = onehot * m[:, :, None, None]
-
-    # expert-choice position assignment: for each (expert, k-slot) count
-    # prior tokens routed to that expert to get its capacity slot
-    flat = onehot.transpose(0, 2, 1, 3).reshape(B, cfg.top_k * T, E)  # k-major order
-    pos_in_expert = (jnp.cumsum(flat, axis=1) - flat).reshape(B, cfg.top_k, T, E).transpose(0, 2, 1, 3)
+        choice_onehot = choice_onehot * m[:, :, None, None]
 
     # aux losses: load-balance (Switch) + router z-loss, over VALID tokens
     if token_mask is None:
+        B, T, _ = x.shape
         n_valid = jnp.float32(B * T)
         me = probs.mean(axis=(0, 1))                                 # [E] mean prob
         z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
@@ -79,12 +70,32 @@ def _route_common(
         n_valid = jnp.maximum(m.sum(), 1.0)
         me = (probs * m[:, :, None]).sum(axis=(0, 1)) / n_valid
         z = jnp.sum(jax.nn.logsumexp(logits, axis=-1) ** 2 * m) / n_valid
-    ce = onehot.sum(axis=2).sum(axis=(0, 1)) / n_valid               # [E] token fraction
+    ce = choice_onehot.sum(axis=2).sum(axis=(0, 1)) / n_valid        # [E] token fraction
     aux = {
         "moe_balance_loss": cfg.aux_loss_coef * E * jnp.sum(me * ce) * (1.0 / cfg.top_k),
         "moe_z_loss": cfg.router_z_coef * z,
         "moe_n_valid": n_valid,
     }
+    return gate_vals, gate_idx, choice_onehot, aux
+
+
+def _route_common(
+    x: jax.Array, router_w: jax.Array, cfg: MoEConfig, token_mask: jax.Array | None = None
+):
+    """Shared routing prefix of the capacity-based dispatch schemes: gating
+    + per-choice capacity-slot assignment + aux losses (sans dropped-frac,
+    which depends on the dispatch representation).
+
+    Returns (gate_vals [B,T,K], gate_idx [B,T,K], onehot [B,T,K,E],
+    pos_in_expert [B,T,K,E], aux)."""
+    B, T, _ = x.shape
+    gate_vals, gate_idx, onehot, aux = _gating(x, router_w, cfg, token_mask)
+
+    # expert-choice position assignment: for each (expert, k-slot) count
+    # prior tokens routed to that expert to get its capacity slot
+    E = cfg.num_experts
+    flat = onehot.transpose(0, 2, 1, 3).reshape(B, cfg.top_k * T, E)  # k-major order
+    pos_in_expert = (jnp.cumsum(flat, axis=1) - flat).reshape(B, cfg.top_k, T, E).transpose(0, 2, 1, 3)
     return gate_vals, gate_idx, onehot, pos_in_expert, aux
 
 
@@ -165,6 +176,88 @@ def route_indices(x, router_w, cfg: MoEConfig, token_mask: jax.Array | None = No
     return src, valid, gate, aux
 
 
+def route_ragged(x, router_w, cfg: MoEConfig, token_mask: jax.Array | None = None):
+    """Capacity-FREE routing for the grouped-GEMM (ragged) dispatch.
+
+    Instead of (expert, capacity-slot) cells, produce the expert-major
+    token order directly: a counting sort of all N = B·T·K routing choices
+    by expert id, built from one cumsum (rank within expert) plus the
+    exclusive prefix-sum of per-expert counts — no capacity bound, no
+    drops, no [B,T,E,C] tensors, and no TPU sort (measured 6 MFU pt slower
+    than arithmetic construction, BASELINE.md r2 negative results).
+
+    Masked (pad) tokens still occupy group slots — ``jax.lax.ragged_dot``
+    computes garbage for rows beyond ``sum(group_sizes)``, so every choice
+    must live inside a real group — but their gates are zero (``_gating``),
+    so they add only the pad fraction of expert FLOPs and nothing to the
+    output or the router losses.
+
+    Returns (sort_tok [N] int32 — flat B·T token index in expert-major
+    order, dest [N] int32 — each choice's position in that order,
+    gate_vals [B,T,K] f32, group_sizes [E] int32 summing to N, aux).
+    """
+    B, T, _ = x.shape
+    E, K = cfg.num_experts, cfg.top_k
+    N = B * T * K
+
+    gate_vals, gate_idx, _, aux = _gating(x, router_w, cfg, token_mask)
+    e_onehot = jax.nn.one_hot(gate_idx.reshape(N), E, dtype=jnp.int32)   # [N, E]
+    pos = jnp.cumsum(e_onehot, axis=0) - e_onehot                        # rank within expert
+    group_sizes = e_onehot.sum(axis=0)                                   # [E], sums to N
+    offsets = jnp.cumsum(group_sizes) - group_sizes                      # exclusive prefix
+    dest = jnp.sum((pos + offsets[None, :]) * e_onehot, axis=-1)         # [N] a permutation
+
+    # invert the permutation with one int32 scatter (token ids stay int32 —
+    # a packed f32 payload would corrupt ids beyond 2^24 tokens). Gates are
+    # NOT sorted: the combine consumes them in choice order (see
+    # _ragged_expert_ffn), so no second scatter.
+    tok = jnp.arange(N, dtype=jnp.int32) // K                            # flat B·T token id
+    sort_tok = jnp.zeros((N,), jnp.int32).at[dest].set(tok)
+
+    aux = dict(aux)
+    aux.pop("moe_n_valid")
+    aux["moe_dropped_frac"] = jnp.zeros((), jnp.float32)                 # capacity-free: no drops
+    return sort_tok, dest, gate_vals, group_sizes, aux
+
+
+def _ragged_expert_ffn(x, router_w, w_gate, w_up, w_down, cfg: MoEConfig, token_mask):
+    """Grouped-GEMM MoE: expert matmuls computed straight from gathered
+    rows via ``jax.lax.ragged_dot`` (XLA's megablox-style grouped GEMM) —
+    the [E,B,C,D] dispatched bank of the capacity schemes never exists.
+    Per layer this removes the ~4 extra full-activation HBM round-trips
+    the r2 decomposition charged to the bank (BASELINE.md) plus the
+    capacity overcompute (N = K·B·T rows exactly, vs 1.25·K·B·T slots).
+
+    Measured layout choices (same-session bench A/Bs, BASELINE.md r3): the
+    combine is a GATHER back to choice order, not a scatter-add — under
+    remat replay an op's fwd runs twice per step, and gather-fwd (4.4 ms)
+    beats scatter-add-fwd (7.6 ms) at [N, D] bench shape. Fusing gate+up
+    into one [E, D, 2F] grouped GEMM via per-layer concat measured 1.3 MFU
+    pt SLOWER end-to-end (the concat + its backward split/copies outweigh
+    the saved xs read) — kept separate."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    B, T, D = x.shape
+    K = cfg.top_k
+    dtype = x.dtype
+    sort_tok, dest, gate_vals, group_sizes, aux = route_ragged(x, router_w, cfg, token_mask)
+    # pin routing outputs for remat (vector-bound gating pipeline; see gather path)
+    sort_tok = checkpoint_name(sort_tok, "moe_route")
+    dest = checkpoint_name(dest, "moe_route")
+    gate_vals = checkpoint_name(gate_vals, "moe_route")
+    group_sizes = checkpoint_name(group_sizes, "moe_route")
+
+    xs = x.reshape(B * T, D)[sort_tok]                                   # [N, D] row gather
+    g = jax.nn.silu(jax.lax.ragged_dot(xs, w_gate, group_sizes))
+    u = jax.lax.ragged_dot(xs, w_up, group_sizes)
+    ys = jax.lax.ragged_dot((g * u).astype(dtype), w_down, group_sizes)  # [N, D]
+    # combine in choice order: gather each (token, k) choice's row and
+    # weight-sum over k — no scatter in the forward
+    yc = ys[dest].reshape(B * T, K, D)
+    y = jnp.einsum("tkd,tk->td", yc, gate_vals.reshape(B * T, K).astype(dtype))
+    return y.reshape(B, T, D).astype(dtype), aux
+
+
 def _expert_mlp(xe, w_gate, w_up, w_down, mesh):
     """xe [E, B, C, D] → [E, B, C, D] through each expert's SwiGLU."""
     if mesh is not None:
@@ -192,11 +285,29 @@ def moe_ffn(
     x: [B, T, D]; router_w [D, E]; w_gate/w_up [E, D, F]; w_down [E, F, D].
     Expert weights shard P('expert', 'fsdp', 'model'); the dispatched-token
     tensor constrains to P(batch, 'expert', ...) so the exchange rides the
-    expert axis (ICI all-to-all). Two dispatch schemes (cfg.dispatch):
-    "gather" (default) moves token rows by index; "dense" is the GShard
+    expert axis (ICI all-to-all). Three dispatch schemes (cfg.dispatch):
+    "ragged" (default) is the grouped-GEMM path — capacity-free counting
+    sort + ``jax.lax.ragged_dot``, no dispatched bank; "gather" moves token
+    rows into (expert, capacity-slot) cells by index; "dense" is the GShard
     one-hot einsum pair (kept for parity/verification — same math).
+
+    The ragged path's group dimension is data-dependent, which GSPMD cannot
+    shard over an ``expert`` mesh axis — with expert-sharded weights it
+    falls back to "gather" (capacity-dense, all-to-all-friendly); on an
+    unsharded expert axis (incl. the single-chip bench) ragged runs.
     """
     dtype = x.dtype
+    if cfg.dispatch == "ragged":
+        expert_sharded = (
+            mesh is not None
+            and "expert" in getattr(mesh, "axis_names", ())
+            and mesh.shape["expert"] > 1
+        )
+        if not expert_sharded:
+            return _ragged_expert_ffn(x, router_w, w_gate, w_up, w_down, cfg, token_mask)
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, dispatch="gather")
     if cfg.dispatch == "dense":
         dispatch, combine, aux = route(x, router_w, cfg, token_mask)
         xe = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), x)  # [E,B,C,D]

@@ -1,11 +1,24 @@
-"""Pipeline parallelism: GPipe-style microbatch schedule over the ``stage``
-mesh axis, inside shard_map with ``ppermute`` activation hand-off.
+"""Pipeline parallelism over the ``stage`` mesh axis, inside shard_map with
+``ppermute`` activation hand-off.
 
-Absent from the reference (SURVEY.md §2.5). The schedule is SPMD: every stage
-runs the same program; on tick t, stage s computes microbatch ``t - s`` (when
-valid) and ships its activation to stage ``s+1`` over the ring — a bubble of
-``S - 1`` ticks at the start/end, the classic GPipe cost, amortized by the
-microbatch count M.
+Absent from the reference (SURVEY.md §2.5). Two schedules:
+
+- ``spmd_pipeline_1f1b`` — the PRODUCTION path (all training flows route
+  here via make_pp_train_step): hand-scheduled one-forward-one-backward
+  with O(S) live activations, owning-stage-gated embed/head units, sharded
+  microbatch batch dim, bf16 wire.
+- ``spmd_pipeline`` — the TEACHING/REFERENCE schedule: GPipe forward under
+  ordinary autodiff. Kept because its 40 lines + jax.grad make it the
+  verifiable spec the 1F1B parity tests lean on, and the shape every
+  pipelining tutorial starts from. Known teaching-path costs, by design:
+  the output bank psum-broadcasts to every stage, microbatches enter
+  replicated (no DP composition), and the wire must widen to f32 off-TPU.
+  Don't train real models with it.
+
+The schedule is SPMD: every stage runs the same program; on tick t, stage s
+computes microbatch ``t - s`` (when valid) and ships its activation to stage
+``s+1`` over the ring — a bubble of ``S - 1`` ticks at the start/end,
+amortized by the microbatch count M.
 
 ``spmd_pipeline`` is model-agnostic: ``stage_fn(stage_params, x) -> x`` is
 one stage's compute, stage params are leaves with a leading ``[S, ...]`` dim
@@ -120,10 +133,6 @@ def spmd_pipeline(
     return out.reshape(B, *out.shape[2:])
 
 
-def _mask_tree(pred, tree):
-    return jax.tree.map(lambda a: jnp.where(pred, a, jnp.zeros_like(a)), tree)
-
-
 def _add_trees(a, b):
     return jax.tree.map(jnp.add, a, b)
 
@@ -133,13 +142,13 @@ def _f32_zeros_like(tree):
 
 
 def spmd_pipeline_1f1b(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[..., Any],
     stage_params: Any,
-    tokens: jax.Array,
+    batch: Any,
     embed_params: Any,
     head_params: Any,
-    embed_fn: Callable[[Any, jax.Array], jax.Array],
-    loss_head_fn: Callable[[Any, jax.Array, jax.Array], tuple[jax.Array, jax.Array]],
+    embed_fn: Callable[[Any, Any], jax.Array],
+    loss_head_fn: Callable[[Any, jax.Array, Any], tuple[jax.Array, jax.Array]],
     *,
     mesh: Mesh,
     num_microbatches: int,
@@ -147,9 +156,11 @@ def spmd_pipeline_1f1b(
     batch_axes: tuple[str, ...] = ("data", "fsdp"),
     wire_dtype=jnp.bfloat16,
     compute_dtype=jnp.bfloat16,
-) -> tuple[jax.Array, jax.Array, tuple[Any, Any, Any]]:
+    stage_has_aux: bool = False,
+    aux_seed_scale: jax.Array | float = 1.0,
+) -> tuple[jax.Array, jax.Array, jax.Array, tuple[Any, Any, Any]]:
     """One-forward-one-backward (1F1B) pipeline **train step core**: returns
-    ``(nll_sum, n_tokens, (d_stage_params, d_embed_params, d_head_params))``.
+    ``(nll_sum, n_tokens, aux_total, (d_stage, d_embed, d_head_params))``.
 
     Unlike the GPipe path (``spmd_pipeline`` + autodiff), the backward is
     hand-scheduled INSIDE the same tick loop: on tick t, stage s runs the
@@ -163,90 +174,158 @@ def spmd_pipeline_1f1b(
       bank psum-broadcast to every stage);
     - no autodiff ever touches a collective, so the bf16 wire works on every
       backend (the GPipe path must widen to f32 off-TPU);
-    - the microbatch BATCH dim composes with data/fsdp sharding: tokens are
-      sharded over ``batch_axes`` and every gradient is psum-reduced over
-      them before leaving the shard_map.
+    - the microbatch batch dim composes with data/fsdp sharding: the batch
+      is sharded over ``batch_axes`` and every gradient is psum-reduced over
+      them before leaving the shard_map;
+    - embed forward/VJP, loss-head value+grad, and the whole backward unit
+      sit behind ``lax.cond`` on the OWNING stage (and tick validity), so a
+      non-owning stage pays none of their FLOPs — inside shard_map's manual
+      SPMD, cond lowers to a real per-device branch, not a select. The
+      conds contain no collectives (the rings run unconditionally every
+      tick), so divergent predicates cannot deadlock.
 
-    Contract: ``stage_fn(stage_local_params, x) -> y`` (applied per stage,
-    recomputed during its backward unit — activation remat is built in);
-    ``embed_fn(embed_params, tok_in) -> x0``; ``loss_head_fn(head_params,
-    y_last, tok_mb) -> (nll_sum, n_valid_tokens)``. ``tokens`` is
-    [B, T+1] (targets derived inside the head fn). Losses are summed, NOT
+    Contract: ``batch`` is a pytree of [B, ...] arrays (tokens, optional
+    segment_ids, ...), microbatched internally to [M, B/M, ...];
+    ``stage_fn(stage_local_params, x, mb) -> y`` — or ``(y, aux_scalar)``
+    with ``stage_has_aux=True`` (MoE balance/z losses); the aux convention
+    is ``aux_total = (1/M)·Σ_mb Σ_stages aux`` with matching cotangent seed,
+    i.e. aux is averaged over microbatches (and over batch shards — for
+    non-linear aux like MoE balance this is the standard per-group
+    approximation of the full-batch statistic).
+    ``embed_fn(embed_params, mb) -> x0``; ``loss_head_fn(head_params,
+    y_last, mb) -> (nll_sum, n_valid_tokens)``. Losses are summed, NOT
     token-normalized — divide grads by ``n_tokens`` for a mean-loss step.
 
-    SPMD cost note: every stage executes the loss-head and embed computation
-    each tick (their results are masked off except on the owning stage) —
-    the price of a single lockstep program; keep ``ce_chunk`` moderate.
+    ``aux_seed_scale``: the returned grads differentiate
+    ``nll_sum + aux_seed_scale · aux_total``. A caller that divides all
+    grads by ``n_tokens`` afterwards (the mean-loss recipe above) should
+    pass its (pre-computable) token count here so the aux contribution
+    survives the division at unit scale — see mixtral.pp_value_and_grad.
     """
     S = mesh.shape[axis_name]
     M = num_microbatches
-    B = tokens.shape[0]
+    B = jax.tree.leaves(batch)[0].shape[0]
     if B % M:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     present = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
-    tok_mb = tokens.reshape(M, B // M, *tokens.shape[1:])
+    n_bshards = 1
+    for a in present:
+        n_bshards *= mesh.shape[a]
+    batch_mb = jax.tree.map(lambda a: a.reshape(M, B // M, *a.shape[1:]), batch)
+    aux_scale = 1.0 / (M * n_bshards)
 
-    def body(stage_p, embed_p, head_p, toks):
+    def fwd_only(lp, x, mb):
+        y = stage_fn(lp, x, mb)
+        return y[0] if stage_has_aux else y
+
+    def body(stage_p, embed_p, head_p, mbs):
         idx = jax.lax.axis_index(axis_name)
         local_params = jax.tree.map(lambda p: p[0], stage_p)
-        x_probe = embed_fn(embed_p, toks[0, :, :-1])
+        mb0 = jax.tree.map(lambda a: a[0], mbs)
+        x_probe = jax.eval_shape(embed_fn, embed_p, mb0)
         mb_shape = x_probe.shape  # [b, Tin, D]
         BUF = 2 * S + 1  # last slot is the trash slot for invalid writes
 
-        def head_value_grads(hp, y, tok):
+        def head_value_grads(hp, y, mb):
             def f(hp, y):
-                nll, n = loss_head_fn(hp, y, tok)
+                nll, n = loss_head_fn(hp, y, mb)
                 return nll, n
 
             (nll, n), (dhp, dy) = jax.value_and_grad(f, argnums=(0, 1), has_aux=True)(hp, y)
-            return nll, n, dhp, dy
+            return nll, n.astype(jnp.float32), dhp, dy
 
         def tick(carry, t):
-            fwd_in, bwd_in, resid, dstage, dembed, dhead, nll_acc, ntok_acc = carry
+            fwd_in, bwd_in, resid, dstage, dembed, dhead, nll_acc, ntok_acc, aux_acc = carry
             last = idx == S - 1
             first = idx == 0
 
             # ---- forward unit: microbatch mf enters this stage
             mf = t - idx
             fwd_valid = jnp.logical_and(mf >= 0, mf < M)
-            tok_f = toks[jnp.clip(mf, 0, M - 1)]
-            x0 = embed_fn(embed_p, tok_f[:, :-1]).astype(compute_dtype)
-            x = jnp.where(first, x0, fwd_in.astype(compute_dtype)).astype(compute_dtype)
-            y = stage_fn(local_params, x).astype(compute_dtype)
+            mb_f = jax.tree.map(lambda a: a[jnp.clip(mf, 0, M - 1)], mbs)
+            # only stage 0 embeds; the rest take the ring input
+            x = jax.lax.cond(
+                first,
+                lambda: embed_fn(embed_p, mb_f).astype(compute_dtype),
+                lambda: fwd_in.astype(compute_dtype),
+            )
+            # bubble ticks (invalid mf) skip the stage compute entirely
+            y = jax.lax.cond(
+                fwd_valid,
+                lambda: fwd_only(local_params, x, mb_f).astype(compute_dtype),
+                lambda: jnp.zeros(mb_shape, compute_dtype),
+            )
             slot_w = jnp.where(fwd_valid, mf % (2 * S), 2 * S)
             resid = jax.lax.dynamic_update_index_in_dim(resid, x, slot_w, 0)
 
             # ---- backward unit: microbatch mb leaves this stage
             mb = t - 2 * S + 1 + idx
             bwd_valid = jnp.logical_and(mb >= 0, mb < M)
-            slot_r = jnp.where(bwd_valid, mb % (2 * S), 2 * S)
-            x_res = jax.lax.dynamic_index_in_dim(resid, slot_r, 0, keepdims=False)
-            tok_b = toks[jnp.clip(mb, 0, M - 1)]
-            y_res, stage_vjp = jax.vjp(stage_fn, local_params, x_res)
-            nll, n, dhp, dy = head_value_grads(head_p, y_res, tok_b)
-            g = jnp.where(last, dy.astype(wire_dtype), bwd_in).astype(y_res.dtype)
-            dp_m, dx_m = stage_vjp(g)
+            mb_b = jax.tree.map(lambda a: a[jnp.clip(mb, 0, M - 1)], mbs)
 
-            dstage = _add_trees(dstage, _mask_tree(bwd_valid, dp_m))
-            dhead = _add_trees(
-                dhead, _mask_tree(jnp.logical_and(bwd_valid, last), dhp)
+            def bwd_compute():
+                slot_r = jnp.where(bwd_valid, mb % (2 * S), 2 * S)
+                x_res = jax.lax.dynamic_index_in_dim(resid, slot_r, 0, keepdims=False)
+                if stage_has_aux:
+                    (y_res, aux_res), stage_vjp = jax.vjp(
+                        lambda lp, x: stage_fn(lp, x, mb_b), local_params, x_res
+                    )
+                else:
+                    y_res, stage_vjp = jax.vjp(
+                        lambda lp, x: stage_fn(lp, x, mb_b), local_params, x_res
+                    )
+                    aux_res = jnp.zeros((), jnp.float32)
+                # loss head: last stage only
+                nll, n, dhp, dy = jax.lax.cond(
+                    last,
+                    lambda: head_value_grads(head_p, y_res, mb_b),
+                    lambda: (
+                        jnp.zeros((), jnp.float32),
+                        jnp.zeros((), jnp.float32),
+                        jax.tree.map(jnp.zeros_like, head_p),
+                        jnp.zeros_like(y_res),
+                    ),
+                )
+                g = jnp.where(last, dy.astype(wire_dtype), bwd_in).astype(y_res.dtype)
+                if stage_has_aux:
+                    dp_m, dx_m = stage_vjp((g, jnp.asarray(aux_scale * aux_seed_scale, jnp.float32)))
+                else:
+                    dp_m, dx_m = stage_vjp(g)
+                # embed VJP: stage 0 only (in-tick scatter-add into the
+                # running accumulator — no [M, …] bank, which would
+                # reinstate the O(M) memory 1F1B avoids)
+                dE_m = jax.lax.cond(
+                    first,
+                    lambda: jax.vjp(lambda ep: embed_fn(ep, mb_b), embed_p)[1](
+                        dx_m.astype(x_probe.dtype)
+                    )[0],
+                    lambda: jax.tree.map(jnp.zeros_like, embed_p),
+                )
+                return nll, n, dp_m, dx_m, dhp, dE_m, aux_res * aux_scale
+
+            def bwd_skip():
+                return (
+                    jnp.zeros((), jnp.float32),
+                    jnp.zeros((), jnp.float32),
+                    jax.tree.map(jnp.zeros_like, local_params),
+                    jnp.zeros(mb_shape, compute_dtype),
+                    jax.tree.map(jnp.zeros_like, head_p),
+                    jax.tree.map(jnp.zeros_like, embed_p),
+                    jnp.zeros((), jnp.float32),
+                )
+
+            nll, n, dp_m, dx_m, dhp, dE_m, aux_mb = jax.lax.cond(
+                bwd_valid, bwd_compute, bwd_skip
             )
-            nll_acc = nll_acc + jnp.where(jnp.logical_and(bwd_valid, last), nll, 0.0)
-            ntok_acc = ntok_acc + jnp.where(
-                jnp.logical_and(bwd_valid, last), n.astype(jnp.float32), 0.0
-            )
-            # stage 0 accumulates the embed gradient in-tick (the vjp's
-            # scatter-add fuses into the running accumulator — no [M, …]
-            # bank, which would reinstate the O(M) memory 1F1B avoids)
-            _, evjp = jax.vjp(lambda ep: embed_fn(ep, tok_b[:, :-1]), embed_p)
-            (dE_m,) = evjp(dx_m.astype(x_probe.dtype))
+
+            dstage = _add_trees(dstage, dp_m)
+            dhead = _add_trees(dhead, dhp)
             dembed = _add_trees(
-                dembed,
-                _mask_tree(
-                    jnp.logical_and(bwd_valid, first),
-                    jax.tree.map(lambda a: a.astype(jnp.float32), dE_m),
-                ),
+                dembed, jax.tree.map(lambda a: a.astype(jnp.float32), dE_m)
             )
+            nll_acc = nll_acc + nll
+            ntok_acc = ntok_acc + n
+            aux_acc = aux_acc + aux_mb
 
             # ---- rings: activations forward, gradients backward
             fwd_out = jax.lax.ppermute(
@@ -255,7 +334,9 @@ def spmd_pipeline_1f1b(
             bwd_out = jax.lax.ppermute(
                 dx_m.astype(wire_dtype), axis_name, [(i, (i - 1) % S) for i in range(S)]
             )
-            return (fwd_out, bwd_out, resid, dstage, dembed, dhead, nll_acc, ntok_acc), None
+            return (
+                fwd_out, bwd_out, resid, dstage, dembed, dhead, nll_acc, ntok_acc, aux_acc,
+            ), None
 
         carry0 = (
             jnp.zeros(mb_shape, wire_dtype),
@@ -266,8 +347,9 @@ def spmd_pipeline_1f1b(
             _f32_zeros_like(head_p),
             jnp.zeros((), jnp.float32),
             jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
         )
-        (_, _, _, dstage, dembed, dhead, nll, ntok), _ = jax.lax.scan(
+        (_, _, _, dstage, dembed, dhead, nll, ntok, aux), _ = jax.lax.scan(
             tick, carry0, jnp.arange(M + 2 * S - 1)
         )
 
@@ -276,27 +358,30 @@ def spmd_pipeline_1f1b(
         axes_all = (axis_name, *present)
         nll = jax.lax.psum(nll, axes_all)
         ntok = jax.lax.psum(ntok, axes_all)
+        aux = jax.lax.psum(aux, axes_all)
         dembed = jax.tree.map(lambda a: jax.lax.psum(a, axes_all), dembed)
         dhead = jax.tree.map(lambda a: jax.lax.psum(a, axes_all), dhead)
         if present:
             dstage = jax.tree.map(lambda a: jax.lax.psum(a, present), dstage)
         dstage = jax.tree.map(lambda a: a[None], dstage)  # local [1, ...] → P(stage)
-        return nll, ntok, dstage, dembed, dhead
+        return nll, ntok, aux, dstage, dembed, dhead
 
     param_specs = jax.tree.map(lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params)
     rep = jax.tree.map(lambda p: P(), embed_params)
     rep_head = jax.tree.map(lambda p: P(), head_params)
-    tok_spec = P(None, present or None, *([None] * (tok_mb.ndim - 2)))
+    mb_specs = jax.tree.map(
+        lambda a: P(None, present or None, *([None] * (a.ndim - 2))), batch_mb
+    )
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(param_specs, rep, rep_head, tok_spec),
-        out_specs=(P(), P(), param_specs, rep, rep_head),
+        in_specs=(param_specs, rep, rep_head, mb_specs),
+        out_specs=(P(), P(), P(), param_specs, rep, rep_head),
         axis_names={axis_name, *present},
         check_vma=False,
     )
-    nll, ntok, dstage, dembed, dhead = fn(stage_params, embed_params, head_params, tok_mb)
-    return nll, ntok, (dstage, dembed, dhead)
+    nll, ntok, aux, dstage, dembed, dhead = fn(stage_params, embed_params, head_params, batch_mb)
+    return nll, ntok, aux, (dstage, dembed, dhead)
 
 
 def stack_stages(params_per_stage: list[Any]) -> Any:

@@ -61,7 +61,8 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
         # fail in milliseconds, not after a multi-GB sharded init/restore
         raise ValueError(
             f"{model_module.__name__} has no pp_value_and_grad — "
-            "pipeline parallelism (stage_axis > 1) is llama-family only"
+            "pipeline parallelism (stage_axis > 1) needs a model with a "
+            "1F1B train-step core (llama and mixtral families have one)"
         )
     init_distributed()  # no-op off-gang; joins jax.distributed under tony
     spec = MeshSpec.auto(
