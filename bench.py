@@ -273,6 +273,32 @@ def _smoke_checks(full: bool):
         want = Q.int8_matmul_ref(x, qt)      # XLA reference of the SAME quantized math
         return rel_err(out, want)
 
+    def moe_grouped_gemm():
+        import dataclasses as dc
+
+        from tony_tpu.parallel.expert import MoEConfig, moe_ffn
+
+        E, D, F = 8, 256, 512
+        ks = jax.random.split(jax.random.PRNGKey(7), 5)
+        x = (jax.random.normal(ks[0], (4, 128, D)) * 0.5).astype(jnp.bfloat16)
+        router = jax.random.normal(ks[1], (D, E))
+        wg = (jax.random.normal(ks[2], (E, D, F)) / D**0.5).astype(jnp.bfloat16)
+        wu = (jax.random.normal(ks[3], (E, D, F)) / D**0.5).astype(jnp.bfloat16)
+        wd = (jax.random.normal(ks[4], (E, F, D)) / F**0.5).astype(jnp.bfloat16)
+        kcfg = MoEConfig(num_experts=E, top_k=2, dispatch="ragged")
+        xcfg = dc.replace(kcfg, dispatch="ragged_xla")
+
+        def loss(cfg):
+            def f(x, wg, wu, wd):
+                y, _ = moe_ffn(x, router, wg, wu, wd, cfg)
+                return (y.astype(jnp.float32) ** 2).sum()
+            return jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2, 3)))
+
+        lk, gk = loss(kcfg)(x, wg, wu, wd)
+        lx, gx = loss(xcfg)(x, wg, wu, wd)
+        return max(rel_err(jnp.asarray(lk), jnp.asarray(lx)),
+                   *(rel_err(a, b) for a, b in zip(gk, gx)))
+
     def remat_parity():
         import dataclasses as dc
         import functools as ft
@@ -304,6 +330,7 @@ def _smoke_checks(full: bool):
         ("flash_packed", flash_packed, 2e-2),
         ("flash_swa", flash_swa, 2e-2),
         ("chunked_ce", chunked_ce, 2e-2),
+        ("moe_grouped_gemm", moe_grouped_gemm, 3e-2),
     ]
     if full:
         checks += [
@@ -355,7 +382,8 @@ def main() -> int:
     p.add_argument("--ce-chunk", type=int, default=None, help="0 = materialize logits")
     p.add_argument("--mu-dtype", default="", choices=["", "bfloat16", "float32"],
                    help="Adam first-moment dtype (default: param dtype)")
-    p.add_argument("--moe-dispatch", default=None, choices=["ragged", "gather", "dense"],
+    p.add_argument("--moe-dispatch", default=None,
+                   choices=["ragged", "ragged_xla", "gather", "dense"],
                    help="override the MoE dispatch scheme (moe preset only)")
     args = p.parse_args()
 

@@ -31,23 +31,44 @@ def main() -> int:
     p.add_argument("--int8", action="store_true")
     p.add_argument("--chunk", type=int, default=8)
     p.add_argument("--preset", default="bench-1b")
+    p.add_argument("--model", default="llama", choices=["llama", "mixtral"])
     p.add_argument("--host-init", action="store_true",
                    help="init + quantize on the host CPU, then ship to the "
                         "chip — required for models whose bf16 weights don't "
                         "fit HBM before quantization (llama3-8b on one v5e)")
+    p.add_argument("--attn", default="auto", choices=["auto", "ragged", "bucketed"])
+    p.add_argument("--long-slot", action="store_true",
+                   help="pre-occupy slot 0 with a near-max_len request: with "
+                        "attn=ragged the other slots' tokens/s should barely "
+                        "move (per-slot cache reads); with bucketed the long "
+                        "slot drags every slot to the max bucket")
     args = p.parse_args()
 
-    cfg = (
-        dataclasses.replace(llama.LLAMA_1B, max_seq=args.max_len)
-        if args.preset == "bench-1b" else llama.PRESETS[args.preset]
-    )
+    if args.model == "mixtral":
+        from tony_tpu.models import mixtral
+
+        # the moe bench geometry (~0.49B total / 0.17B active), serving shape
+        cfg = mixtral.MixtralConfig(
+            vocab_size=32_000, d_model=1024, n_layers=8, n_heads=8, n_kv_heads=4,
+            d_ff=2048, max_seq=args.max_len, num_experts=8, top_k=2,
+        )
+        params_init = lambda: mixtral.init(jax.random.PRNGKey(0), cfg)
+    else:
+        cfg = (
+            dataclasses.replace(llama.LLAMA_1B, max_seq=args.max_len)
+            if args.preset == "bench-1b" else llama.PRESETS[args.preset]
+        )
+        params_init = lambda: llama.init(jax.random.PRNGKey(0), cfg)
+    if args.model == "mixtral" and (args.int8 or args.host_init):
+        sys.exit("int8/host-init quantization is dense-family only (the MoE "
+                 "decode path einsums stacked expert weights directly)")
     if args.host_init:
         from tony_tpu.ops import quant
 
         cpu = jax.devices("cpu")[0]
         t0 = time.perf_counter()
         with jax.default_device(cpu):
-            params = llama.init(jax.random.PRNGKey(0), cfg)
+            params = params_init()
             params, before, after = quant.quantize_tree(params)
             jax.block_until_ready(params)
         print(f"[bench] host init+quant: {before / 1e9:.2f} GB -> "
@@ -59,7 +80,7 @@ def main() -> int:
         print(f"[bench] weights to chip in {time.perf_counter() - t0:.0f}s",
               file=sys.stderr)
     else:
-        params = llama.init(jax.random.PRNGKey(0), cfg)
+        params = params_init()
         if args.int8:
             from tony_tpu.ops import quant
 
@@ -69,10 +90,18 @@ def main() -> int:
 
     eng = ContinuousBatcher(
         params, cfg, num_slots=args.slots, max_len=args.max_len,
-        decode_chunk=args.chunk,
+        decode_chunk=args.chunk, attn=args.attn,
     )
     rng = np.random.default_rng(0)
-    for _ in range(args.slots):
+    n_short = args.slots
+    if args.long_slot:
+        # one near-max-length resident request; its decode budget outlasts
+        # the short requests so it stays active the whole measurement
+        long_prompt_len = args.max_len - args.new_tokens - 1
+        eng.submit(rng.integers(0, cfg.vocab_size, long_prompt_len).tolist(),
+                   max_new_tokens=args.new_tokens)
+        n_short -= 1
+    for _ in range(n_short):
         prompt = rng.integers(0, cfg.vocab_size, args.prompt_len).tolist()
         eng.submit(prompt, max_new_tokens=args.new_tokens)
 
@@ -96,7 +125,9 @@ def main() -> int:
                  f"above {1 + eng.decode_chunk} or lower --chunk")
 
     out = {
-        "metric": "llama_decode_tokens_per_sec_1chip",
+        "metric": f"{args.model}_decode_tokens_per_sec_1chip",
+        "attn": eng.attn,
+        "long_slot": bool(args.long_slot),
         "value": round(n_tokens / dt, 1),
         "unit": "tokens/sec/chip",
         "slots": args.slots,

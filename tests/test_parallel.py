@@ -326,6 +326,53 @@ class TestMoE:
         y_ref, _ = moe_ffn(x, router, wg, wu, wd, big)
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5, rtol=1e-5)
 
+    def test_fused_kernel_matches_xla_ragged(self):
+        """The Pallas fused grouped-GEMM SwiGLU (interpret mode here) must
+        match the jax.lax.ragged_dot path bit-for-tolerance: outputs, aux,
+        and grads — including with a pad mask and an MXU-aligned geometry
+        that actually triggers the kernel (D,F % 128 == 0, bf16)."""
+        import dataclasses
+
+        from tony_tpu.ops import moe_gemm
+
+        assert moe_gemm._INTERPRET, "conftest must set TONY_PALLAS_INTERPRET"
+        E, D, F = 4, 128, 256
+        ks = jax.random.split(jax.random.PRNGKey(21), 5)
+        x = (jax.random.normal(ks[0], (2, 16, D)) * 0.5).astype(jnp.bfloat16)
+        router = jax.random.normal(ks[1], (D, E))
+        wg = (jax.random.normal(ks[2], (E, D, F)) / D**0.5).astype(jnp.bfloat16)
+        wu = (jax.random.normal(ks[3], (E, D, F)) / D**0.5).astype(jnp.bfloat16)
+        wd = (jax.random.normal(ks[4], (E, F, D)) / F**0.5).astype(jnp.bfloat16)
+        kcfg = dataclasses.replace(self.CFG, dispatch="ragged")
+        xcfg = dataclasses.replace(self.CFG, dispatch="ragged_xla")
+        mask = jnp.ones((2, 16), bool).at[1, 10:].set(False)
+
+        for tm in (None, mask):
+            yk, auxk = moe_ffn(x, router, wg, wu, wd, kcfg, token_mask=tm)
+            yx, auxx = moe_ffn(x, router, wg, wu, wd, xcfg, token_mask=tm)
+            np.testing.assert_allclose(
+                np.asarray(yk, jnp.float32), np.asarray(yx, jnp.float32),
+                atol=3e-2, rtol=3e-2,
+            )
+            for k in auxx:
+                np.testing.assert_allclose(float(auxk[k]), float(auxx[k]), atol=1e-6)
+
+            def loss(cfg, tm=tm):
+                def f(x, wg, wu, wd):
+                    y, aux = moe_ffn(x, router, wg, wu, wd, cfg, token_mask=tm)
+                    return (y.astype(jnp.float32) ** 2).sum() + aux["moe_balance_loss"]
+                return jax.grad(f, argnums=(0, 1, 2, 3))
+
+            gk = loss(kcfg)(x, wg, wu, wd)
+            gx = loss(xcfg)(x, wg, wu, wd)
+            for name, a, b in zip("dx dwg dwu dwd".split(), gk, gx):
+                a = np.asarray(a, jnp.float32)
+                b = np.asarray(b, jnp.float32)
+                scale = np.abs(b).max() + 1e-9
+                assert np.abs(a - b).max() / scale < 5e-2, (
+                    f"{name} mismatch kernel vs xla (mask={tm is not None})"
+                )
+
     def test_gather_dispatch_capacity_drops(self):
         import dataclasses
 

@@ -113,15 +113,31 @@ def sharding_rules(cfg: BertConfig) -> ShardingRules:
 
 
 def hidden_states(params: dict, tokens: jax.Array, cfg: BertConfig, mesh=None,
-                  type_ids: jax.Array | None = None) -> jax.Array:
-    """Encoder output [B, T, D] without the MLM head."""
+                  type_ids: jax.Array | None = None,
+                  segment_ids: jax.Array | None = None) -> jax.Array:
+    """Encoder output [B, T, D] without the MLM head.
+
+    ``segment_ids`` [B, T] (packed batches, data.pack_sequences layout):
+    attention is confined within segments (flash-kernel segment masking,
+    bidirectional) and the learned absolute positions restart at every
+    segment boundary — the packing r2 built for the decoder models, applied
+    to the padded-512 MLM batches it was built for (SURVEY §5.7 / VERDICT
+    r2 weak #7). Pad tokens (segment 0) attend only among themselves and
+    must simply carry no masked positions.
+    """
     B, T = tokens.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     act_spec = P(BATCH_AXES, None, None)
 
+    if segment_ids is not None:
+        from tony_tpu.models.llama import segment_positions
+
+        pos_e = jnp.take(params["pos_embed"], segment_positions(segment_ids), axis=0)
+    else:
+        pos_e = params["pos_embed"][:T]
     x = (
         jnp.take(params["tok_embed"], tokens, axis=0)
-        + params["pos_embed"][:T]
+        + pos_e
         + jnp.take(params["type_embed"], type_ids if type_ids is not None else jnp.zeros_like(tokens), axis=0)
     )
     x = L.layer_norm(x, params["embed_norm"]["w"], params["embed_norm"]["b"], cfg.norm_eps)
@@ -134,7 +150,9 @@ def hidden_states(params: dict, tokens: jax.Array, cfg: BertConfig, mesh=None,
         q = q.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
         k = k.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
         v = v.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
-        o = attn_ops.mha(q, k, v, causal=False, impl=cfg.attn_impl)
+        o = attn_ops.mha(
+            q, k, v, causal=False, impl=cfg.attn_impl, segment_ids=segment_ids
+        )
         o = o.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
         x = L.layer_norm(
             x + jnp.einsum("bth,hd->btd", o, lp["wo"]) + lp["bo"],
@@ -154,9 +172,10 @@ def hidden_states(params: dict, tokens: jax.Array, cfg: BertConfig, mesh=None,
 
 
 def forward(params: dict, tokens: jax.Array, cfg: BertConfig, mesh=None,
-            type_ids: jax.Array | None = None) -> jax.Array:
+            type_ids: jax.Array | None = None,
+            segment_ids: jax.Array | None = None) -> jax.Array:
     """Full-vocab MLM logits [B, T, V] at every position."""
-    x = hidden_states(params, tokens, cfg, mesh, type_ids)
+    x = hidden_states(params, tokens, cfg, mesh, type_ids, segment_ids=segment_ids)
     return jnp.einsum("btd,dv->btv", x, params["mlm_head"]) + params["mlm_bias"]
 
 
@@ -171,14 +190,20 @@ def loss_fn(params: dict, batch: dict, cfg: BertConfig, mesh=None) -> tuple[jax.
     - dense: ``targets`` [B, T] with -100 = unmasked; full-logits path.
     """
     if "masked_pos" in batch:
-        x = hidden_states(params, batch["tokens"], cfg, mesh)
+        x = hidden_states(params, batch["tokens"], cfg, mesh,
+                          segment_ids=batch.get("segment_ids"))
         pos = batch["masked_pos"]                                     # [B, M]
         xm = jnp.take_along_axis(x, pos[..., None], axis=1)           # [B, M, D]
         logits = jnp.einsum("bmd,dv->bmv", xm, params["mlm_head"]) + params["mlm_bias"]
         loss, n = L.cross_entropy_loss(logits, batch["masked_targets"])
         return loss, {"loss": loss, "tokens": n}
-    logits = forward(params, batch["tokens"], cfg, mesh)
-    loss, n = L.cross_entropy_loss(logits, batch["targets"])
+    logits = forward(params, batch["tokens"], cfg, mesh,
+                     segment_ids=batch.get("segment_ids"))
+    targets = batch["targets"]
+    if "segment_ids" in batch:
+        # packed rows: never score padding, whatever the caller put there
+        targets = jnp.where(batch["segment_ids"] != 0, targets, -100)
+    loss, n = L.cross_entropy_loss(logits, targets)
     return loss, {"loss": loss, "tokens": n}
 
 

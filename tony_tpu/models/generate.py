@@ -49,15 +49,6 @@ class KVCache(NamedTuple):
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> KVCache:
-    if cfg.sliding_window and max_len > cfg.sliding_window:
-        # the decode path attends the WHOLE cache; beyond the window that
-        # silently diverges from training/HF — fail fast until a windowed
-        # (rolling-buffer) cache exists. Within the window, full == banded.
-        raise NotImplementedError(
-            f"decode beyond sliding_window={cfg.sliding_window} needs a "
-            f"rolling KV cache (asked max_len={max_len}); cap max_len to the "
-            "window or clear cfg.sliding_window for full-causal decode"
-        )
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
     return KVCache(
         k=jnp.zeros(shape, cfg.jdtype),
@@ -66,11 +57,14 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> KVCache:
     )
 
 
-def _cached_attention(q, ck, cv, length, n_rep):
+def _cached_attention(q, ck, cv, length, n_rep, window: int = 0):
     """q: [B, H, Tq, Dh]; ck/cv: [B, Hkv, maxT, Dh]; positions < length+Tq.
 
     Masked full-length attention: rows attend to cache slots [0, length+row]
-    (causal within the new tokens, everything before them unconditionally).
+    (causal within the new tokens, everything before them unconditionally);
+    with ``window`` > 0 the band narrows to the last ``window`` positions —
+    decode then matches the training-side sliding-window semantics instead
+    of silently widening beyond it.
     """
     from tony_tpu.ops.attention import repeat_kv
 
@@ -82,9 +76,48 @@ def _cached_attention(q, ck, cv, length, n_rep):
     s = s * (Dh ** -0.5)
     slot = jax.lax.broadcasted_iota(jnp.int32, (Tq, maxT), 1)
     row_end = length + jax.lax.broadcasted_iota(jnp.int32, (Tq, maxT), 0)
-    s = jnp.where(slot <= row_end, s, -1e30)
+    ok = slot <= row_end
+    if window > 0:
+        ok = jnp.logical_and(ok, slot > row_end - window)
+    s = jnp.where(ok, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(cv.dtype), cv)
+
+
+def _ffn_with_cache(h, lp, cfg: LlamaConfig):
+    """Decode-side FFN: dense SwiGLU, or the MoE mixture when the layer
+    params carry a router (Mixtral family).
+
+    The MoE DECODE path (short Tq) computes ALL experts and combines with
+    the top-k one-hot gates — at decode batch sizes (a handful of tokens)
+    the step is weight-bandwidth-bound and B·K distinct expert picks touch
+    most experts anyway, so dense-expert compute costs ~nothing extra
+    while avoiding per-token weight gathers; gates renormalize over top-k
+    exactly like training (parallel/expert._gating). PREFILL (long Tq)
+    routes through the training dispatch instead — all-expert compute
+    over a whole prompt would pay E/top_k× the FFN FLOPs and materialize
+    [B, T, E, F] banks."""
+    if "router" not in lp:
+        g = jax.nn.silu(_mm(h, lp["w_gate"]))
+        u = _mm(h, lp["w_up"])
+        return _mm(g * u, lp["w_down"])
+    if h.shape[1] > 16:  # prefill: routed dispatch, same math, top-k FLOPs
+        from tony_tpu.parallel.expert import moe_ffn
+
+        y, _ = moe_ffn(
+            h, lp["router"], lp["we_gate"], lp["we_up"], lp["we_down"], cfg.moe, None
+        )
+        return y
+    E = lp["router"].shape[-1]
+    logits = jnp.einsum("btd,de->bte", h.astype(jnp.float32), lp["router"].astype(jnp.float32))
+    top_k = getattr(cfg, "top_k", 2)
+    gate_vals, gate_idx = jax.lax.top_k(jax.nn.softmax(logits, axis=-1), top_k)
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+    w = jnp.sum(jax.nn.one_hot(gate_idx, E) * gate_vals[..., None], axis=-2)  # [B,T,E]
+    ge = jnp.einsum("btd,edf->btef", h, lp["we_gate"])
+    ue = jnp.einsum("btd,edf->btef", h, lp["we_up"])
+    ye = jnp.einsum("btef,efd->bted", jax.nn.silu(ge) * ue, lp["we_down"])
+    return jnp.einsum("bted,bte->btd", ye, w.astype(ye.dtype))
 
 
 def _block_with_cache(x, lp, ck, cv, length, cos, sin, cfg: LlamaConfig):
@@ -106,13 +139,11 @@ def _block_with_cache(x, lp, ck, cv, length, cos, sin, cfg: LlamaConfig):
 
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, length, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, length, 0))
-    o = _cached_attention(q, ck, cv, length, H // Hkv)
+    o = _cached_attention(q, ck, cv, length, H // Hkv, window=cfg.sliding_window)
     o = o.transpose(0, 2, 1, 3).reshape(B, Tq, H * Dh)
     x = x + _mm(o, lp["wo"])
     h = L.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    g = jax.nn.silu(_mm(h, lp["w_gate"]))
-    u = _mm(h, lp["w_up"])
-    x = x + _mm(g * u, lp["w_down"])
+    x = x + _ffn_with_cache(h, lp, cfg)
     return x, k, v
 
 

@@ -238,6 +238,30 @@ def mask_packed_targets(tokens: jax.Array, seg: jax.Array | None):
     return jnp.where(ok, targets, -100), seg[:, :-1]
 
 
+def embed_lookup(embed: jax.Array, tokens: jax.Array, mesh=None) -> jax.Array:
+    """Embedding lookup that compiles cleanly on every mesh.
+
+    Whenever the activation sharding spans two or more mesh axes (hybrid
+    data×fsdp, or fsdp×tp×cp), XLA's gather-op sharding cannot move the
+    take's output between the table's layout and the batch layout and
+    falls back to "involuntary full rematerialization"
+    (replicate-then-reshard) in fwd AND bwd. A one-hot dot has native
+    GSPMD sharding rules — vocab contraction over the 'model' shards, D
+    stays on fsdp, batch stays put — at the FLOP cost of one extra
+    lm-head-sized matmul, so it's used ONLY on those multi-axis meshes; a
+    single sharded axis (e.g. the pure-FSDP 8B plan) and the unsharded
+    case keep the plain take, whose transition XLA handles cleanly.
+    """
+    if mesh is not None:
+        active = sum(
+            1 for a in ("data", "fsdp", "model", "context") if mesh.shape.get(a, 1) > 1
+        )
+        if active >= 2:
+            onehot = jax.nn.one_hot(tokens, embed.shape[0], dtype=embed.dtype)
+            return jnp.einsum("btv,vd->btd", onehot, embed)
+    return jnp.take(embed, tokens, axis=0)
+
+
 def segment_positions(segment_ids: jax.Array) -> jax.Array:
     """[B, T] per-segment positions (0-based, restarting at each segment
     boundary) for RoPE on packed batches."""
@@ -290,7 +314,7 @@ def hidden_states(
     cos, sin = L.rope_frequencies(cfg.head_dim, T, cfg.rope_theta, cfg.rope_scaling)
     positions = segment_positions(segment_ids) if segment_ids is not None else None
 
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = embed_lookup(params["embed"], tokens, mesh)
     if mesh is not None:
         x = constrain(x, mesh, P(BATCH_AXES, "context", None))
 

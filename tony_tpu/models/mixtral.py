@@ -28,7 +28,7 @@ class MixtralConfig(llama_mod.LlamaConfig):
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
-    moe_dispatch: str = "ragged"  # ragged (grouped GEMM) | gather (indexed) | dense (GShard einsum)
+    moe_dispatch: str = "ragged"  # ragged (grouped GEMM / fused kernel) | ragged_xla | gather | dense
     aux_loss_coef: float = 1e-2   # load-balance loss weight
     router_z_coef: float = 1e-3   # router z-loss weight
 
@@ -160,7 +160,7 @@ def hidden_states(
     )
     token_mask = (segment_ids != 0) if segment_ids is not None else None
 
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = llama_mod.embed_lookup(params["embed"], tokens, mesh)
     if mesh is not None:
         x = constrain(x, mesh, P(BATCH_AXES, "context", None))
 

@@ -755,12 +755,15 @@ def remat_block(block_fn, remat: bool, policy: str = "full"):
         )
     if policy == "flash":
         # also pins MoE routing outputs (parallel/expert.py names them
-        # "moe_route"): tiny tensors whose recompute would re-run the whole
-        # vector-bound gating pipeline in the backward
+        # "moe_route": tiny tensors whose recompute would re-run the whole
+        # vector-bound gating pipeline) and the fused expert-MLP kernel
+        # output ("moe_gemm", ops/moe_gemm.py): [N_rows, D] bf16 per layer
+        # — the one activation whose replay would re-run three grouped
+        # GEMMs (A/B'd +0.8 MFU pt on the moe bench preset, BASELINE.md r3)
         return jax.checkpoint(
             block_fn,
             policy=jax.checkpoint_policies.save_only_these_names(
-                "flash_o", "flash_lse", "moe_route"
+                "flash_o", "flash_lse", "moe_route", "moe_gemm"
             ),
         )
     if policy != "full":

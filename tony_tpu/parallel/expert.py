@@ -26,7 +26,10 @@ class MoEConfig:
     capacity_factor: float = 1.25
     router_z_coef: float = 1e-3      # router z-loss (stability)
     aux_loss_coef: float = 1e-2      # load-balance loss
-    dispatch: str = "ragged"         # ragged (grouped GEMM, default) | gather (indexed) | dense (GShard einsum)
+    # ragged (grouped GEMM — fused Pallas kernel when aligned, default)
+    # | ragged_xla (force jax.lax.ragged_dot) | gather (indexed, capacity)
+    # | dense (GShard einsum)
+    dispatch: str = "ragged"
 
 
 def capacity(tokens_per_batch: int, cfg: MoEConfig) -> int:
@@ -176,7 +179,10 @@ def route_indices(x, router_w, cfg: MoEConfig, token_mask: jax.Array | None = No
     return src, valid, gate, aux
 
 
-def route_ragged(x, router_w, cfg: MoEConfig, token_mask: jax.Array | None = None):
+def route_ragged(
+    x, router_w, cfg: MoEConfig, token_mask: jax.Array | None = None,
+    tile: int | None = None,
+):
     """Capacity-FREE routing for the grouped-GEMM (ragged) dispatch.
 
     Instead of (expert, capacity-slot) cells, produce the expert-major
@@ -192,32 +198,127 @@ def route_ragged(x, router_w, cfg: MoEConfig, token_mask: jax.Array | None = Non
     so they add only the pad fraction of expert FLOPs and nothing to the
     output or the router losses.
 
-    Returns (sort_tok [N] int32 — flat B·T token index in expert-major
-    order, dest [N] int32 — each choice's position in that order,
-    gate_vals [B,T,K] f32, group_sizes [E] int32 summing to N, aux).
+    With ``tile`` set (the Pallas fused-kernel path, ops/moe_gemm.py), each
+    group's span is padded up to a multiple of ``tile`` (and at least one
+    tile, so every expert's weight-grad block gets initialized) — pad rows
+    scatter nothing, so they keep the zero-init token index 0 and are never
+    read back by the combine. The row count becomes the STATIC
+    ``PN = (ceil(N/tile) + E) · tile ≥ sum(padded group sizes)``.
+
+    Returns (sort_tok [N or PN] int32 — flat B·T token index in
+    expert-major order, dest [N] int32 — each choice's position in that
+    order, gate_vals [B,T,K] f32, gate_sorted [N or PN] f32 (zero on pad
+    rows), group_sizes [E] int32 (padded when tile is set), aux).
     """
     B, T, _ = x.shape
     E, K = cfg.num_experts, cfg.top_k
     N = B * T * K
 
     gate_vals, gate_idx, _, aux = _gating(x, router_w, cfg, token_mask)
-    e_onehot = jax.nn.one_hot(gate_idx.reshape(N), E, dtype=jnp.int32)   # [N, E]
-    pos = jnp.cumsum(e_onehot, axis=0) - e_onehot                        # rank within expert
-    group_sizes = e_onehot.sum(axis=0)                                   # [E], sums to N
+    # rank-within-expert via per-batch-row cumsum ([B, T·K, E], depth
+    # log(T·K) with B in parallel — the construction r2 measured as free)
+    # + a tiny [B, E] prefix across rows; global order is b-major within
+    # each expert's span
+    oh = jax.nn.one_hot(gate_idx.reshape(B, T * K), E, dtype=jnp.int32)  # [B, TK, E]
+    pos_b = jnp.cumsum(oh, axis=1) - oh                                  # rank within (b, e)
+    counts_b = oh.sum(axis=1)                                            # [B, E]
+    prefix_b = jnp.cumsum(counts_b, axis=0) - counts_b                   # earlier rows' counts
+    group_sizes = counts_b.sum(axis=0)                                   # [E], sums to N
+    rows = N
+    if tile is not None:
+        group_sizes = jnp.maximum(-(-group_sizes // tile), 1) * tile     # ceil, >= 1 tile
+        rows = (-(-N // tile) + E) * tile                                # static upper bound
     offsets = jnp.cumsum(group_sizes) - group_sizes                      # exclusive prefix
-    dest = jnp.sum((pos + offsets[None, :]) * e_onehot, axis=-1)         # [N] a permutation
+    dest = jnp.sum(
+        (pos_b + (offsets[None, :] + prefix_b)[:, None, :]) * oh, axis=-1
+    ).reshape(N)                                                         # [N], injective
 
-    # invert the permutation with one int32 scatter (token ids stay int32 —
-    # a packed f32 payload would corrupt ids beyond 2^24 tokens). Gates are
-    # NOT sorted: the combine consumes them in choice order (see
-    # _ragged_expert_ffn), so no second scatter.
+    # invert the permutation with two small typed scatters (token ids stay
+    # int32 — a packed f32 payload would corrupt ids beyond 2^24 tokens).
+    # gate_sorted keeps ZERO on pad rows, which is what makes the combine's
+    # gather-form backward blank them out (see _combine_gather).
     tok = jnp.arange(N, dtype=jnp.int32) // K                            # flat B·T token id
-    sort_tok = jnp.zeros((N,), jnp.int32).at[dest].set(tok)
+    sort_tok = jnp.zeros((rows,), jnp.int32).at[dest].set(tok)
+    gate_sorted = jnp.zeros((rows,), jnp.float32).at[dest].set(
+        gate_vals.reshape(N).astype(jnp.float32)
+    )
 
     aux = dict(aux)
     aux.pop("moe_n_valid")
     aux["moe_dropped_frac"] = jnp.zeros((), jnp.float32)                 # capacity-free: no drops
-    return sort_tok, dest, gate_vals, group_sizes, aux
+    return sort_tok, dest, gate_vals, gate_sorted, group_sizes, aux
+
+
+@jax.custom_vjp
+def _dispatch_gather(x_flat, sort_tok, dest):
+    """xs = x_flat[sort_tok] with a GATHER-form backward.
+
+    The autodiff transpose of a row gather is a scatter-add, which costs
+    ~1.7× a gather at [N, D] bench shape (BASELINE.md r3 probes). Because
+    every token appears exactly top_k times and ``dest`` enumerates those
+    appearances, the cotangent is expressible as a gather:
+    ``dx[t] = Σ_k dxs[dest[t, k]]`` — no scatter anywhere."""
+    return x_flat[sort_tok]
+
+
+def _dispatch_gather_fwd(x_flat, sort_tok, dest):
+    return x_flat[sort_tok], (sort_tok, dest, x_flat.shape[0])
+
+
+def _dispatch_gather_bwd(res, dxs):
+    import numpy as np
+
+    sort_tok, dest, BT = res
+    K = dest.shape[0] // BT
+    dx = dxs[dest].reshape(BT, K, dxs.shape[-1]).sum(axis=1)
+    return (
+        dx,
+        np.zeros(sort_tok.shape, jax.dtypes.float0),
+        np.zeros(dest.shape, jax.dtypes.float0),
+    )
+
+
+_dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
+
+
+@jax.custom_vjp
+def _combine_gather(ys, dest, sort_tok, gate_vals, gate_sorted):
+    """y[t] = Σ_k gate[t,k] · ys[dest[t,k]] with a GATHER-form backward.
+
+    Forward gathers expert outputs back to choice order and K-sums with
+    the gates. The transpose w.r.t. ``ys`` is again a gather, not a
+    scatter: sorted row j belongs to token ``sort_tok[j]`` with weight
+    ``gate_sorted[j]`` (zero on pad rows), so
+    ``dys[j] = gate_sorted[j] · dy[sort_tok[j]]``."""
+    BT, K = gate_vals.shape
+    yc = ys[dest].reshape(BT, K, ys.shape[-1])
+    return jnp.einsum("tkd,tk->td", yc, gate_vals.astype(ys.dtype))
+
+
+def _combine_gather_fwd(ys, dest, sort_tok, gate_vals, gate_sorted):
+    return _combine_gather(ys, dest, sort_tok, gate_vals, gate_sorted), (
+        ys, dest, sort_tok, gate_vals, gate_sorted,
+    )
+
+
+def _combine_gather_bwd(res, dy):
+    import numpy as np
+
+    ys, dest, sort_tok, gate_vals, gate_sorted = res
+    K = gate_vals.shape[1]
+    dys = dy[sort_tok] * gate_sorted[:, None].astype(dy.dtype)
+    yc = ys[dest].reshape(gate_vals.shape[0], K, ys.shape[-1])
+    dgate = jnp.einsum("tkd,td->tk", yc.astype(jnp.float32), dy.astype(jnp.float32))
+    return (
+        dys.astype(ys.dtype),
+        np.zeros(dest.shape, jax.dtypes.float0),
+        np.zeros(sort_tok.shape, jax.dtypes.float0),
+        dgate.astype(gate_vals.dtype),
+        jnp.zeros_like(gate_sorted),
+    )
+
+
+_combine_gather.defvjp(_combine_gather_fwd, _combine_gather_bwd)
 
 
 def _ragged_expert_ffn(x, router_w, w_gate, w_up, w_down, cfg: MoEConfig, token_mask):
@@ -237,24 +338,46 @@ def _ragged_expert_ffn(x, router_w, w_gate, w_up, w_down, cfg: MoEConfig, token_
     the saved xs read) — kept separate."""
     from jax.ad_checkpoint import checkpoint_name
 
+    from tony_tpu.ops import moe_gemm
+
     B, T, D = x.shape
+    F = w_gate.shape[-1]
     K = cfg.top_k
     dtype = x.dtype
-    sort_tok, dest, gate_vals, group_sizes, aux = route_ragged(x, router_w, cfg, token_mask)
+    # fused Pallas kernel (one VMEM pass for the whole expert MLP) when the
+    # geometry is MXU-aligned and we're on a TPU backend (or the interpret
+    # harness); otherwise three jax.lax.ragged_dot grouped GEMMs
+    use_kernel = (
+        cfg.dispatch == "ragged"
+        and D % 128 == 0
+        and F % 128 == 0
+        and dtype == jnp.bfloat16
+        and (jax.default_backend() not in ("cpu", "gpu", "cuda", "rocm") or moe_gemm._INTERPRET)
+    )
+    tile = moe_gemm.TILE_M if use_kernel else None
+    sort_tok, dest, gate_vals, gate_sorted, group_sizes, aux = route_ragged(
+        x, router_w, cfg, token_mask, tile=tile
+    )
     # pin routing outputs for remat (vector-bound gating pipeline; see gather path)
     sort_tok = checkpoint_name(sort_tok, "moe_route")
     dest = checkpoint_name(dest, "moe_route")
     gate_vals = checkpoint_name(gate_vals, "moe_route")
+    gate_sorted = checkpoint_name(gate_sorted, "moe_route")
     group_sizes = checkpoint_name(group_sizes, "moe_route")
 
-    xs = x.reshape(B * T, D)[sort_tok]                                   # [N, D] row gather
-    g = jax.nn.silu(jax.lax.ragged_dot(xs, w_gate, group_sizes))
-    u = jax.lax.ragged_dot(xs, w_up, group_sizes)
-    ys = jax.lax.ragged_dot((g * u).astype(dtype), w_down, group_sizes)  # [N, D]
+    xs = _dispatch_gather(x.reshape(B * T, D), sort_tok, dest)           # [N|PN, D]
+    if use_kernel:
+        tg = moe_gemm.tile_group_map(group_sizes, xs.shape[0] // tile, tile)
+        ys = moe_gemm.moe_swiglu_grouped(xs, w_gate, w_up, w_down, tg, tile)
+    else:
+        g = jax.nn.silu(jax.lax.ragged_dot(xs, w_gate, group_sizes))
+        u = jax.lax.ragged_dot(xs, w_up, group_sizes)
+        ys = jax.lax.ragged_dot((g * u).astype(dtype), w_down, group_sizes)
     # combine in choice order: gather each (token, k) choice's row and
-    # weight-sum over k — no scatter in the forward
-    yc = ys[dest].reshape(B * T, K, D)
-    y = jnp.einsum("tkd,tk->td", yc, gate_vals.reshape(B * T, K).astype(dtype))
+    # weight-sum over k — gathers in the backward too (_combine_gather)
+    y = _combine_gather(
+        ys, dest, sort_tok, gate_vals.reshape(B * T, K), gate_sorted
+    )
     return y.reshape(B, T, D).astype(dtype), aux
 
 
@@ -297,7 +420,7 @@ def moe_ffn(
     unsharded expert axis (incl. the single-chip bench) ragged runs.
     """
     dtype = x.dtype
-    if cfg.dispatch == "ragged":
+    if cfg.dispatch in ("ragged", "ragged_xla"):
         expert_sharded = (
             mesh is not None
             and "expert" in getattr(mesh, "axis_names", ())
