@@ -62,3 +62,100 @@ class TestPortal:
 
         with pytest.raises(urllib.error.HTTPError):
             get(portal + "/nope")
+
+
+class TestLivePortal:
+    """r3 live view: running jobs from intermediate .jhist, AM RPC task
+    table, METRICS_SNAPSHOT sparklines, pool status page."""
+
+    def _mk_running(self, tmp_path, app_id="app_live"):
+        eh = EventHandler(str(tmp_path), app_id)
+        eh.start()
+        eh.emit(EventType.APPLICATION_INITED, app_id=app_id)
+        for step in range(3):
+            eh.emit(
+                EventType.METRICS_SNAPSHOT,
+                tasks=[{
+                    "task": "worker:0",
+                    "metrics": {"train": {
+                        "loss": 3.0 - step, "tokens_per_sec": 1000.0 + step,
+                        "mfu": 0.4 + 0.01 * step,
+                    }},
+                }],
+            )
+        eh.stop()  # file stays in intermediate/ (no finalize) → RUNNING
+
+    def test_running_section_and_charts(self, tmp_path):
+        self._mk_running(tmp_path)
+        server = serve(str(tmp_path), 0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            _, body = get(base + "/")
+            assert "running" in body and "app_live" in body
+            _, detail = get(base + "/job/app_live")
+            assert "LIVE" in detail
+            assert "<svg" in detail and "tokens_per_sec" in detail  # sparklines
+            _, api = get(base + "/api/jobs")
+            assert any(j["app_id"] == "app_live" and j["status"] == "RUNNING"
+                       for j in json.loads(api))
+        finally:
+            server.shutdown()
+
+    def test_live_task_table_via_am_rpc(self, tmp_path):
+        import os
+
+        from tony_tpu import constants
+        from tony_tpu.cluster.rpc import RpcServer
+
+        self._mk_running(tmp_path, "app_rpc")
+
+        class FakeAM:
+            def get_application_status(self):
+                return {"state": "RUNNING", "restart_attempt": 0}
+
+            def get_task_infos(self):
+                return [{
+                    "name": "worker", "index": 0, "status": "RUNNING",
+                    "host": "h1", "metrics": {"train": {"loss": 1.5}},
+                }]
+
+        rpc = RpcServer(port=0, secret="s3")
+        rpc.register_object(FakeAM(), ["get_application_status", "get_task_infos"])
+        rpc.start()
+        host, port = rpc.address
+        staging = tmp_path / "app_rpc"
+        staging.mkdir()
+        (staging / constants.AM_INFO_FILE).write_text(
+            json.dumps({"host": host, "port": port, "secret": "s3"})
+        )
+        server = serve(str(tmp_path), 0, staging_root=str(tmp_path))
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            _, detail = get(base + "/job/app_rpc")
+            assert "AM state: RUNNING" in detail
+            assert "worker:0" in detail and "h1" in detail
+        finally:
+            server.shutdown()
+            rpc.stop()
+
+    def test_pool_page(self, tmp_path, monkeypatch):
+        from tony_tpu import constants
+        from tony_tpu.cluster.pool import PoolService
+
+        svc = PoolService(port=0, secret="psec")
+        svc.start()
+        host, port = svc.address
+        monkeypatch.setenv(constants.ENV_POOL_SECRET, "psec")
+        server = serve(str(tmp_path), 0, pool=f"{host}:{port}")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            _, body = get(base + "/pool")
+            assert "containers running" in body
+            _, api = get(base + "/api/pool")
+            assert "nodes" in json.loads(api)
+        finally:
+            server.shutdown()
+            svc.stop()

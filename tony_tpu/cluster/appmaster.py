@@ -361,6 +361,9 @@ class ApplicationMaster:
         hb_interval = self.config.get_time_ms(keys.TASK_HEARTBEAT_INTERVAL_MS, 1000)
         hb_max_missed = self.config.get_int(keys.TASK_MAX_MISSED_HEARTBEATS, 25)
         gang_timeout = self.config.get_time_ms(keys.AM_GANG_TIMEOUT_MS, 300_000)
+        metrics_every_s = self.config.get_time_ms(keys.TASK_METRICS_INTERVAL_MS, 5000) / 1000
+        last_metrics_emit = 0.0
+        last_snapshot_key = None
 
         while True:
             if self._kill_requested:
@@ -381,6 +384,29 @@ class ApplicationMaster:
 
             # 2. container exits (catches silent executor death)
             self._handle_container_exits()
+
+            # 2b. periodic METRICS_SNAPSHOT into the .jhist: executors push
+            # metrics over RPC onto TaskInfo; snapshotting them into the
+            # event stream gives the portal (live view + finished-job
+            # charts) a time series without a second storage path
+            now = time.time()
+            if now - last_metrics_emit >= metrics_every_s:
+                last_metrics_emit = now
+                snap = [
+                    {"task": f"{t['name']}:{t['index']}", "metrics": t["metrics"]}
+                    for t in self.session.task_infos()
+                    if t.get("metrics")
+                ]
+                # dedup on the per-task TRAIN step identity: executors
+                # re-push the same step report until the next one lands, and
+                # identical snapshots would bloat the .jhist without bound
+                key = tuple(
+                    (e["task"], (e["metrics"].get("train") or {}).get("step"))
+                    for e in snap
+                )
+                if snap and key != last_snapshot_key:
+                    last_snapshot_key = key
+                    self.events.emit(EventType.METRICS_SNAPSHOT, tasks=snap)
 
             # 3. heartbeat liveness
             for t in self.session.find_dead_tasks(hb_interval, hb_max_missed):

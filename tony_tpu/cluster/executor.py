@@ -133,6 +133,15 @@ class TaskExecutor:
             )
             env[profiling.ENV_PROFILE_START_STEP] = self.config.get(keys.TASK_PROFILE_START_STEP)
             env[profiling.ENV_PROFILE_NUM_STEPS] = self.config.get(keys.TASK_PROFILE_NUM_STEPS)
+        # train-side throughput metrics contract: the loop writes its step
+        # report (loss/tokens_per_sec/mfu) here; the metrics push loop
+        # attaches it so the AM/portal see TRAINING progress, not just
+        # host/TPU counters
+        self._train_metrics_path = os.path.join(
+            self.staging_dir, "metrics", f"{self.job_name}_{self.index}.json"
+        )
+        os.makedirs(os.path.dirname(self._train_metrics_path), exist_ok=True)
+        env[constants.ENV_TRAIN_METRICS_FILE] = self._train_metrics_path
         if self.job_name == constants.TENSORBOARD_JOB_NAME:
             env[constants.ENV_TB_PORT] = str(self.port)
         if self.job_name == constants.NOTEBOOK_JOB_NAME:
@@ -187,6 +196,15 @@ class TaskExecutor:
     def launch_child(self, command: str, env: dict[str, str]) -> subprocess.Popen:
         """Exec the user process via the shell (Utils.executeShell analog);
         stdio inherits the container's captured stdout/stderr."""
+        # clear any previous attempt's train-metrics drop: a stale step
+        # report must not masquerade as live progress while the new child
+        # is still compiling
+        path = getattr(self, "_train_metrics_path", None)
+        if path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         cwd = None
         src_dir = self.config.get(keys.SRC_DIR)
         if src_dir:
@@ -231,15 +249,34 @@ class TaskExecutor:
         )
         while not self._stop.wait(interval):
             try:
+                m = sampler.sample()
+                train = self._read_train_metrics()
+                if train is not None:
+                    m["train"] = train
                 self.rpc.call(
                     "push_metrics",
                     job_name=self.job_name,
                     index=self.index,
-                    metrics=sampler.sample(),
+                    metrics=m,
                     attempt=self.attempt,
                 )
             except (RpcError, OSError):
                 pass  # metrics are best-effort; liveness is the heartbeat's job
+
+    def _read_train_metrics(self):
+        """Latest step report the training loop dropped (atomic rename
+        write, loop.py), or None. Malformed/missing files are ignored —
+        metrics must never take down the supervisor."""
+        path = getattr(self, "_train_metrics_path", None)
+        if not path:
+            return None
+        try:
+            import json as _json
+
+            with open(path) as f:
+                return _json.load(f)
+        except (OSError, ValueError):
+            return None
 
     def _kill_child(self) -> None:
         if self.child and self.child.poll() is None:

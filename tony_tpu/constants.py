@@ -52,6 +52,7 @@ ENV_TASK_NUM = "TASK_NUM"               # instances of this type
 ENV_DISTRIBUTED_MODE = "DISTRIBUTED_MODE"  # GANG | SINGLE_NODE
 ENV_CLUSTER_SPEC = "CLUSTER_SPEC"       # full cluster spec JSON (legacy TF contract)
 ENV_TB_PORT = "TB_PORT"                 # tensorboard task port
+ENV_TRAIN_METRICS_FILE = "TONY_TRAIN_METRICS_FILE"  # train loop drops step metrics here; executor push loop picks them up
 ENV_CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"            # from tony.checkpoint.dir
 ENV_CHECKPOINT_INTERVAL = "TONY_CHECKPOINT_INTERVAL"  # from tony.checkpoint.interval-steps
 ENV_NOTEBOOK_PORT = "NOTEBOOK_PORT"     # notebook task port (proxied by submitter)

@@ -108,11 +108,12 @@ def _ffn_with_cache(h, lp, cfg: LlamaConfig):
             h, lp["router"], lp["we_gate"], lp["we_up"], lp["we_down"], cfg.moe, None
         )
         return y
+    from tony_tpu.parallel.expert import _gating
+
     E = lp["router"].shape[-1]
-    logits = jnp.einsum("btd,de->bte", h.astype(jnp.float32), lp["router"].astype(jnp.float32))
-    top_k = getattr(cfg, "top_k", 2)
-    gate_vals, gate_idx = jax.lax.top_k(jax.nn.softmax(logits, axis=-1), top_k)
-    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+    # ONE copy of the gating convention: the training-side _gating
+    # (softmax → top-k → renormalize) drives decode too
+    gate_vals, gate_idx, _, _ = _gating(h, lp["router"], cfg.moe, None)
     w = jnp.sum(jax.nn.one_hot(gate_idx, E) * gate_vals[..., None], axis=-2)  # [B,T,E]
     ge = jnp.einsum("btd,edf->btef", h, lp["we_gate"])
     ue = jnp.einsum("btd,edf->btef", h, lp["we_up"])

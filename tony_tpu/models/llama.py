@@ -150,21 +150,26 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh, segment_ids=None) -> jax.Array:
             f"cp_impl must be 'xla', 'pallas', or 'ulysses', got {cfg.cp_impl!r}"
         )
     if mesh is not None and mesh.shape.get("context", 1) > 1:
-        if segment_ids is not None:
-            raise ValueError(
-                "sequence packing (segment_ids) does not compose with a "
-                "context axis yet — pack on the data/fsdp axes instead"
-            )
-        if cfg.sliding_window > 0:
-            raise ValueError(
-                "sliding_window does not compose with a context axis yet — "
-                "a windowed sequence rarely needs CP in the first place"
-            )
+        if cfg.cp_impl != "pallas":
+            if segment_ids is not None:
+                raise ValueError(
+                    "sequence packing (segment_ids) composes with a context "
+                    "axis only via cp_impl='pallas' (the ring kernel carries "
+                    "the global segment table); xla/ulysses do not"
+                )
+            if cfg.sliding_window > 0:
+                raise ValueError(
+                    "sliding_window composes with a context axis only via "
+                    "cp_impl='pallas' (in-kernel band skipping)"
+                )
         if cfg.cp_impl == "pallas":
             # remote-DMA ring kernel: GQA-native (KV stays at Hkv width on
             # the wire); fully-manual shard_map because the kernel manages
             # its own collectives (and interpret-mode emulation requires it)
-            from tony_tpu.ops.ring import ring_attention_pallas
+            from tony_tpu.ops.ring import (
+                ring_attention_pallas,
+                ring_attention_pallas_seg,
+            )
 
             model_deg = mesh.shape.get("model", 1)
             batch_deg = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
@@ -177,8 +182,24 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh, segment_ids=None) -> jax.Array:
                     "constraint)"
                 )
             qspec = P(BATCH_AXES, "model", "context", None)
+            if segment_ids is not None:
+                ring = jax.shard_map(
+                    partial(
+                        ring_attention_pallas_seg, axis_name="context",
+                        causal=True, window=cfg.sliding_window,
+                    ),
+                    mesh=mesh,
+                    in_specs=(qspec, qspec, qspec, P(BATCH_AXES, "context")),
+                    out_specs=qspec,
+                    axis_names=set(mesh.axis_names),
+                    check_vma=False,
+                )
+                return ring(q, k, v, segment_ids)
             ring = jax.shard_map(
-                partial(ring_attention_pallas, axis_name="context", causal=True),
+                partial(
+                    ring_attention_pallas, axis_name="context", causal=True,
+                    window=cfg.sliding_window,
+                ),
                 mesh=mesh,
                 in_specs=(qspec, qspec, qspec),
                 out_specs=qspec,

@@ -63,8 +63,10 @@ def _kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, *, chunk, window, n_rep):
                 ),
             )
 
-        for d in dma(0, c0):
-            d.start()
+        @pl.when(c0 < c1)  # a zero-length slot must not leave a DMA in flight
+        def _warmup():
+            for d in dma(0, c0):
+                d.start()
 
         def step(c, carry):
             m, l, acc = carry

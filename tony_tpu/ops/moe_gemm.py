@@ -35,7 +35,10 @@ import numpy as np
 
 _INTERPRET = os.environ.get("TONY_PALLAS_INTERPRET", "") == "1"
 
-TILE_M = 256  # row-tile; group sizes are padded to multiples of this
+TILE_M = 256      # fwd row-tile; group sizes are padded to multiples of this
+                  # (512 measured 0.5 MFU pt slower end-to-end on the moe bench)
+TILE_M_BWD = 256  # bwd row-tile (more VMEM-hungry: f32 dW accumulators);
+                  # must divide TILE_M so the padded group spans stay aligned
 
 
 def _silu(x):
@@ -214,7 +217,15 @@ def _vjp_fwd(xs, wg, wu, wd, tile_group, tile):
 
 def _vjp_bwd(tile, res, dy):
     xs, wg, wu, wd, tile_group = res
-    dxs, dwg, dwu, dwd = _bwd_call(xs, dy.astype(xs.dtype), wg, wu, wd, tile_group, tile)
+    bwd_tile = tile
+    if tile > TILE_M_BWD and tile % TILE_M_BWD == 0:
+        # finer backward tiling: same group spans (TILE_M_BWD divides the
+        # fwd tile), each fwd tile simply splits into tile/TILE_M_BWD rows
+        tile_group = jnp.repeat(tile_group, tile // TILE_M_BWD)
+        bwd_tile = TILE_M_BWD
+    dxs, dwg, dwu, dwd = _bwd_call(
+        xs, dy.astype(xs.dtype), wg, wu, wd, tile_group, bwd_tile
+    )
     return (
         dxs,
         dwg.astype(wg.dtype),

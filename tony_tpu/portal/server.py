@@ -1,10 +1,23 @@
-"""History web portal.
+"""Web portal: job history, LIVE jobs, metrics charts, pool status.
 
 Analog of the reference's ``tony-portal`` Play application (SURVEY.md §2.3):
-a job-list page, per-job detail (event timeline + task table), and the frozen
-config view, read from the ``.jhist`` JSONL + ``config.json`` files the AM
-finalizes. Stdlib http.server — the portal is an ops convenience, not a
-dependency of the control plane.
+job list + per-job detail from the ``.jhist`` JSONL + ``config.json`` the AM
+finalizes — extended (r3) with the pieces the reference portal surfaces for
+running applications:
+
+- RUNNING jobs from ``<history>/intermediate/*.jhist`` (the AM streams
+  events there until finalization);
+- a LIVE task table straight from the AM's ``get_task_infos`` RPC when the
+  job's ``am_info.json`` is readable (same staging root, same user);
+- per-job loss / tokens-per-sec / MFU sparklines from the
+  ``METRICS_SNAPSHOT`` series the AM now emits into the event stream
+  (train-side numbers travel train loop → executor push → TaskInfo → AM);
+- a ``/pool`` page rendering ``pool_status`` from a pool service
+  (``--pool host:port``; secret from $TONY_POOL_SECRET).
+
+Stdlib http.server — the portal is an ops convenience, not a dependency of
+the control plane; every remote call is best-effort with the static view as
+fallback.
 """
 
 from __future__ import annotations
@@ -18,6 +31,7 @@ from urllib.parse import urlparse
 
 from tony_tpu import constants
 from tony_tpu.cluster import history
+from tony_tpu.cluster.events import Event
 
 _STYLE = """
 body{font-family:system-ui,sans-serif;margin:2em;color:#222}
@@ -25,7 +39,10 @@ table{border-collapse:collapse;min-width:40em}
 td,th{border:1px solid #ccc;padding:4px 10px;text-align:left}
 th{background:#f0f0f0} a{color:#0645ad;text-decoration:none}
 .SUCCEEDED{color:#080} .FAILED{color:#b00} .KILLED{color:#850} .LOST{color:#b00}
+.RUNNING{color:#06c} .REGISTERED{color:#06c}
 pre{background:#f6f6f6;padding:1em;overflow-x:auto}
+svg{background:#fafafa;border:1px solid #eee;margin:2px 8px 2px 0}
+.spark{display:inline-block;text-align:center;font-size:12px;color:#555}
 """
 
 
@@ -33,12 +50,33 @@ def _page(title: str, body: str) -> bytes:
     return (
         f"<!doctype html><html><head><title>{html.escape(title)}</title>"
         f"<style>{_STYLE}</style></head><body><h1>{html.escape(title)}</h1>"
-        f'<p><a href="/">← jobs</a></p>{body}</body></html>'
+        f'<p><a href="/">← jobs</a> · <a href="/pool">pool</a></p>{body}</body></html>'
     ).encode()
+
+
+def _sparkline(values: list[float], label: str, w: int = 220, h: int = 48) -> str:
+    """Inline SVG polyline — no JS, renders anywhere."""
+    if len(values) < 2:
+        return ""
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    pts = " ".join(
+        f"{i * (w - 4) / (len(values) - 1) + 2:.1f},"
+        f"{h - 2 - (v - lo) / span * (h - 14):.1f}"
+        for i, v in enumerate(values)
+    )
+    return (
+        f'<span class="spark"><svg width="{w}" height="{h}">'
+        f'<polyline fill="none" stroke="#06c" stroke-width="1.5" points="{pts}"/>'
+        f'<text x="4" y="10" font-size="9" fill="#888">{html.escape(label)}: '
+        f"{values[-1]:.4g} (max {hi:.4g})</text></svg></span>"
+    )
 
 
 class PortalHandler(BaseHTTPRequestHandler):
     history_root = ""
+    staging_root = ""       # where <app_id>/am_info.json lives (TONY_ROOT)
+    pool_addr = ""          # "host:port" of a pool service, optional
 
     def log_message(self, *args) -> None:  # quiet
         pass
@@ -55,6 +93,8 @@ class PortalHandler(BaseHTTPRequestHandler):
         try:
             if path == "":
                 self._send(self._job_list())
+            elif path == "/pool":
+                self._send(self._pool_page())
             elif path.startswith("/job/"):
                 parts = path.split("/")
                 app_id = parts[2]
@@ -64,13 +104,76 @@ class PortalHandler(BaseHTTPRequestHandler):
                     self._send(self._job_detail(app_id))
             elif path == "/api/jobs":
                 jobs = [vars(j) for j in history.list_finished_jobs(self.history_root)]
+                jobs += [
+                    {"app_id": a, "status": "RUNNING"} for a in self._running_ids()
+                ]
                 self._send(json.dumps(jobs).encode(), ctype="application/json")
+            elif path == "/api/pool":
+                self._send(
+                    json.dumps(self._pool_status() or {}).encode(),
+                    ctype="application/json",
+                )
             else:
                 self._send(_page("not found", "<p>404</p>"), status=404)
         except Exception as e:  # noqa: BLE001 — a bad file must not kill the portal
             self._send(_page("error", f"<pre>{html.escape(str(e))}</pre>"), status=500)
 
+    # -- data helpers -------------------------------------------------------
+
+    def _running_ids(self) -> list[str]:
+        d = os.path.join(self.history_root, constants.HISTORY_INTERMEDIATE_DIR)
+        if not os.path.isdir(d):
+            return []
+        suf = constants.HISTORY_SUFFIX
+        return sorted(
+            f[: -len(suf)] for f in os.listdir(d) if f.endswith(suf)
+        )
+
+    def _am_client(self, app_id: str):
+        """RpcClient for a running job's AM, or None (best-effort)."""
+        if not self.staging_root:
+            return None
+        info_path = os.path.join(self.staging_root, app_id, constants.AM_INFO_FILE)
+        try:
+            with open(info_path) as f:
+                info = json.load(f)
+            from tony_tpu.cluster.rpc import RpcClient
+
+            return RpcClient(info["host"], info["port"], info.get("secret", ""), timeout_s=2.0)
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def _pool_status(self):
+        if not self.pool_addr:
+            return None
+        try:
+            from tony_tpu.cluster.rpc import RpcClient
+
+            host, _, port = self.pool_addr.rpartition(":")
+            cli = RpcClient(host, int(port),
+                            os.environ.get(constants.ENV_POOL_SECRET, ""), timeout_s=2.0)
+            try:
+                return cli.call("pool_status")
+            finally:
+                cli.close()
+        except Exception:  # noqa: BLE001 — pool may be down; render that
+            return None
+
+    # -- pages --------------------------------------------------------------
+
     def _job_list(self) -> bytes:
+        sections = []
+        running = self._running_ids()
+        if running:
+            rows = "".join(
+                f'<tr><td><a href="/job/{html.escape(a)}">{html.escape(a)}</a></td>'
+                f'<td class="RUNNING">RUNNING</td></tr>'
+                for a in running
+            )
+            sections.append(
+                "<h2>running</h2><table><tr><th>application</th><th>status</th></tr>"
+                + rows + "</table>"
+            )
         rows = []
         for j in history.list_finished_jobs(self.history_root):
             dur = max(j.completed_ms - j.started_ms, 0) / 1000
@@ -79,41 +182,122 @@ class PortalHandler(BaseHTTPRequestHandler):
                 f'<td class="{j.status}">{j.status}</td><td>{dur:.1f}s</td>'
                 f"<td>{html.escape(j.user)}</td></tr>"
             )
-        table = (
-            "<table><tr><th>application</th><th>status</th><th>duration</th><th>user</th></tr>"
-            + "".join(rows)
-            + "</table>"
-        ) if rows else "<p>no finished jobs yet</p>"
-        return _page("tony-tpu job history", table)
+        sections.append(
+            "<h2>finished</h2>"
+            + (
+                "<table><tr><th>application</th><th>status</th><th>duration</th><th>user</th></tr>"
+                + "".join(rows) + "</table>"
+                if rows else "<p>no finished jobs yet</p>"
+            )
+        )
+        return _page("tony-tpu jobs", "".join(sections))
+
+    def _metrics_charts(self, evs: list[Event]) -> str:
+        """METRICS_SNAPSHOT series → per-task loss/tok-s/MFU sparklines."""
+        series: dict[str, dict[str, list[float]]] = {}
+        for ev in evs:
+            if ev.type.value != "METRICS_SNAPSHOT":
+                continue
+            for entry in ev.payload.get("tasks", []):
+                train = (entry.get("metrics") or {}).get("train") or {}
+                per = series.setdefault(entry.get("task", "?"), {})
+                for k in ("loss", "tokens_per_sec", "mfu"):
+                    if isinstance(train.get(k), (int, float)):
+                        per.setdefault(k, []).append(float(train[k]))
+        if not series:
+            return ""
+        blocks = []
+        for task, per in sorted(series.items()):
+            charts = "".join(
+                _sparkline(vals, k) for k, vals in per.items() if len(vals) >= 2
+            )
+            if charts:
+                blocks.append(f"<p><b>{html.escape(task)}</b><br>{charts}</p>")
+        return "<h2>training metrics</h2>" + "".join(blocks) if blocks else ""
+
+    def _live_table(self, app_id: str) -> str:
+        cli = self._am_client(app_id)
+        if cli is None:
+            return ""
+        try:
+            status = cli.call("get_application_status")
+            infos = cli.call("get_task_infos")
+        except Exception:  # noqa: BLE001 — AM may have just exited
+            return ""
+        finally:
+            cli.close()
+        rows = "".join(
+            f"<tr><td>{html.escape(str(t['name']))}:{html.escape(str(t['index']))}</td>"
+            f'<td class="{html.escape(str(t["status"]))}">{html.escape(str(t["status"]))}</td>'
+            f"<td>{html.escape(str(t.get('host') or ''))}</td>"
+            f"<td>{html.escape(json.dumps((t.get('metrics') or {}).get('train') or {})[:120])}</td></tr>"
+            for t in infos
+        )
+        return (
+            f"<h2>live (AM state: {html.escape(str(status.get('state')))}"
+            f", attempt {status.get('restart_attempt', 0)})</h2>"
+            f"<table><tr><th>task</th><th>status</th><th>host</th><th>train</th></tr>{rows}</table>"
+        )
 
     def _job_detail(self, app_id: str) -> bytes:
-        evs = history.read_events(self.history_root, app_id)
+        live = app_id not in {
+            j.app_id for j in history.list_finished_jobs(self.history_root)
+        }
+        evs = history.read_events(self.history_root, app_id)  # falls back to intermediate
         if not evs:
             return _page(app_id, "<p>no events found</p>")
-        tasks_html = ""
-        for ev in evs:
-            if ev.type.value == "APPLICATION_FINISHED":
-                rows = "".join(
-                    f"<tr><td>{t['name']}:{t['index']}</td>"
-                    f'<td class="{t["status"]}">{t["status"]}</td>'
-                    f"<td>{t.get('exit_code')}</td><td>{html.escape(str(t.get('host') or ''))}</td></tr>"
-                    for t in ev.payload.get("tasks", [])
-                )
-                tasks_html = (
-                    "<h2>tasks</h2><table><tr><th>task</th><th>status</th>"
-                    f"<th>exit</th><th>host</th></tr>{rows}</table>"
-                )
+        tasks_html = self._live_table(app_id) if live else ""
+        if not tasks_html:
+            for ev in evs:
+                if ev.type.value == "APPLICATION_FINISHED":
+                    rows = "".join(
+                        f"<tr><td>{t['name']}:{t['index']}</td>"
+                        f'<td class="{t["status"]}">{t["status"]}</td>'
+                        f"<td>{t.get('exit_code')}</td><td>{html.escape(str(t.get('host') or ''))}</td></tr>"
+                        for t in ev.payload.get("tasks", [])
+                    )
+                    tasks_html = (
+                        "<h2>tasks</h2><table><tr><th>task</th><th>status</th>"
+                        f"<th>exit</th><th>host</th></tr>{rows}</table>"
+                    )
+        charts = self._metrics_charts(evs)
         timeline = "".join(
             f"<tr><td>{ev.timestamp_ms}</td><td>{ev.type.value}</td>"
             f"<td><pre style='margin:0'>{html.escape(json.dumps(ev.payload)[:500])}</pre></td></tr>"
             for ev in evs
+            if ev.type.value != "METRICS_SNAPSHOT"  # charts render these
         )
         body = (
-            f'<p><a href="/job/{app_id}/config">frozen config</a></p>'
+            f'<p><a href="/job/{app_id}/config">frozen config</a>'
+            + (" · <b>LIVE</b>" if live else "")
+            + "</p>"
             + tasks_html
+            + charts
             + f"<h2>events</h2><table><tr><th>ts</th><th>type</th><th>payload</th></tr>{timeline}</table>"
         )
         return _page(app_id, body)
+
+    def _pool_page(self) -> bytes:
+        if not self.pool_addr:
+            return _page("pool", "<p>no pool configured (start with --pool host:port)</p>")
+        st = self._pool_status()
+        if st is None:
+            return _page("pool", f"<p>pool {html.escape(self.pool_addr)} unreachable</p>")
+        rows = "".join(
+            f"<tr><td>{html.escape(n['name'])}</td>"
+            f"<td class=\"{'SUCCEEDED' if n['alive'] else 'LOST'}\">"
+            f"{'alive' if n['alive'] else 'LOST'}</td>"
+            f"<td>{html.escape(str(n.get('slice_id', '')))}</td>"
+            f"<td>{n['chips_free']}/{n['chips_total']}</td>"
+            f"<td>{n['memory_free'] // (1 << 20)} MiB</td><td>{n['vcores_free']}</td></tr>"
+            for n in st.get("nodes", [])
+        )
+        body = (
+            f"<p>{st.get('containers_running', 0)} containers running</p>"
+            "<table><tr><th>node</th><th>liveness</th><th>slice</th>"
+            f"<th>chips free</th><th>mem free</th><th>vcores free</th></tr>{rows}</table>"
+        )
+        return _page(f"pool {self.pool_addr}", body)
 
     def _job_config(self, app_id: str) -> bytes:
         for j in history.list_finished_jobs(self.history_root):
@@ -129,20 +313,31 @@ class PortalHandler(BaseHTTPRequestHandler):
         return _page(app_id, "<p>no config snapshot</p>")
 
 
-def serve(history_root: str, port: int = 28080) -> ThreadingHTTPServer:
-    handler = type("Handler", (PortalHandler,), {"history_root": history_root})
+def serve(
+    history_root: str, port: int = 28080, staging_root: str = "", pool: str = ""
+) -> ThreadingHTTPServer:
+    handler = type(
+        "Handler", (PortalHandler,),
+        {"history_root": history_root, "staging_root": staging_root, "pool_addr": pool},
+    )
     server = ThreadingHTTPServer(("0.0.0.0", port), handler)
     return server
 
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tony portal")
-    p.add_argument("--root", default=None)
+    p.add_argument("--root", default=None, help="history root (default $TONY_ROOT/history)")
+    p.add_argument("--staging", default=None,
+                   help="staging root holding <app_id>/am_info.json for the "
+                        "live view (default: parent of --root)")
+    p.add_argument("--pool", default="", help="pool service host:port for /pool")
     p.add_argument("--port", type=int, default=28080)
     args = p.parse_args(argv)
     root = args.root or os.path.join(constants.default_tony_root(), "history")
-    server = serve(root, args.port)
-    print(f"[tony-portal] serving {root} on http://0.0.0.0:{args.port}")
+    staging = args.staging or os.path.dirname(root.rstrip("/"))
+    server = serve(root, args.port, staging, args.pool)
+    print(f"[tony-portal] serving {root} on http://0.0.0.0:{args.port}"
+          + (f" (pool {args.pool})" if args.pool else ""))
     try:
         server.serve_forever()
     except KeyboardInterrupt:

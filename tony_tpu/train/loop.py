@@ -51,6 +51,24 @@ class LoopConfig:
     data_dir: str = ""  # dir of *.tonytok shards; empty → synthetic batches
 
 
+def _drop_train_metrics(line: dict) -> None:
+    """Atomically publish the latest step report to the path the executor
+    advertised (ENV_TRAIN_METRICS_FILE) — the metrics push loop attaches
+    it to this task's heartbeat metrics so the AM/portal see training
+    progress (loss/tokens_per_sec/mfu), not just host counters. No-op
+    outside a tony container; never raises."""
+    path = os.environ.get(constants.ENV_TRAIN_METRICS_FILE)
+    if not path:
+        return
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(line, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
     """Generic decoder-LM pretraining loop (llama/mixtral modules).
 
@@ -162,6 +180,7 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
                     "time": time.strftime("%H:%M:%S"),
                 }
                 print(json.dumps(line), flush=True)
+                _drop_train_metrics(line)
                 meter.start()
             if (
                 ckpt_mgr is not None
