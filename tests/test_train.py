@@ -171,3 +171,52 @@ class TestLoopPipelineParallel:
                 bert, bert.BERT_TINY,
                 LoopConfig(steps=1, batch_size=8, seq_len=64, stage_axis=2),
             )
+
+
+class TestTrainMetricsDrop:
+    def test_drop_and_executor_read(self, tmp_path, monkeypatch):
+        """The loop's step report reaches the executor's metrics payload:
+        loop._drop_train_metrics writes atomically to the advertised path;
+        Executor._read_train_metrics picks it up; launch_child clears it
+        (stale reports must not outlive an attempt)."""
+        from tony_tpu import constants
+        from tony_tpu.train import loop as loop_mod
+
+        path = tmp_path / "m" / "worker_0.json"
+        path.parent.mkdir()
+        monkeypatch.setenv(constants.ENV_TRAIN_METRICS_FILE, str(path))
+        line = {"step": 7, "loss": 1.25, "tokens_per_sec": 123.0, "mfu": 0.41}
+        loop_mod._drop_train_metrics(line)
+        import json as _json
+
+        assert _json.loads(path.read_text()) == line
+
+        # executor-side read + clear-on-launch, without standing up a gang
+        from tony_tpu.cluster.executor import TaskExecutor as Executor
+
+        ex = Executor.__new__(Executor)
+        ex._train_metrics_path = str(path)
+        assert Executor._read_train_metrics(ex) == line
+        path.write_text("{not json")
+        assert Executor._read_train_metrics(ex) is None  # malformed → ignored
+
+        path.write_text(_json.dumps(line))
+
+        class _Cfg:
+            def get(self, *a, **k):
+                return ""
+
+        ex.config = _Cfg()
+        ex.staging_dir = str(tmp_path)
+        try:
+            Executor.launch_child(ex, "true", {})
+        except Exception:
+            pass  # Popen details don't matter; the unlink happens first
+        assert not path.exists()
+
+    def test_drop_is_noop_outside_container(self, monkeypatch):
+        from tony_tpu import constants
+        from tony_tpu.train import loop as loop_mod
+
+        monkeypatch.delenv(constants.ENV_TRAIN_METRICS_FILE, raising=False)
+        loop_mod._drop_train_metrics({"step": 1})  # must not raise
