@@ -337,3 +337,60 @@ class TestPoolCredential:
         cfg = TonyConfig({keys.KEYTAB_USER: "definitely-not-this-user"})
         with pytest.raises(PermissionError, match="keytab.user"):
             _pool_credential(cfg)
+
+
+class TestPortalLiveInPool:
+    def test_portal_shows_live_job_mid_run(self, tmp_tony_root, pool_with_agents, monkeypatch):
+        """The portal renders a RUNNING pool job mid-flight: running section
+        from the intermediate .jhist, live task table over the AM RPC, and
+        the pool page against the same pool service (r2 VERDICT #7
+        done-when)."""
+        import json as _json
+        import threading
+        import urllib.request
+
+        from tony_tpu.portal.server import serve
+
+        svc, _ = pool_with_agents
+        cfg = TonyConfig({
+            keys.STAGING_ROOT: str(tmp_tony_root),
+            **pool_conf(svc, {
+                "tony.worker.instances": "2",
+                keys.EXECUTES: fixture_cmd("forever.py"),
+            }),
+        })
+        client = Client(cfg)
+        handle = client.submit()
+        rpc = handle.rpc(timeout_s=30)
+        assert rpc is not None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            infos = rpc.call("get_task_infos")
+            if len(infos) == 2 and all(i["status"] == "RUNNING" for i in infos):
+                break
+            time.sleep(0.1)
+
+        history_root = os.path.join(str(tmp_tony_root), "history")
+        host, port = svc.address
+        monkeypatch.setenv(constants.ENV_POOL_SECRET, SECRET)
+        server = serve(
+            history_root, 0, staging_root=str(tmp_tony_root), pool=f"{host}:{port}"
+        )
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            with urllib.request.urlopen(base + "/") as r:
+                body = r.read().decode()
+            assert handle.app_id in body and "running" in body
+            with urllib.request.urlopen(f"{base}/job/{handle.app_id}") as r:
+                detail = r.read().decode()
+            assert "LIVE" in detail
+            assert "AM state: RUNNING" in detail  # live table over the AM RPC
+            assert "worker:0" in detail and "worker:1" in detail
+            with urllib.request.urlopen(base + "/api/pool") as r:
+                pool_state = _json.loads(r.read())
+            assert pool_state["containers_running"] >= 2
+        finally:
+            server.shutdown()
+            rpc.call("finish_application")
+            client.monitor_application(handle, quiet=True)
