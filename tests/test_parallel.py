@@ -373,6 +373,43 @@ class TestMoE:
                     f"{name} mismatch kernel vs xla (mask={tm is not None})"
                 )
 
+    def test_fused_kernel_empty_experts(self):
+        """All tokens routed to ONE expert through the KERNEL path (aligned
+        dims): empty experts still get zero-initialized dW blocks (each
+        padded group keeps >= one tile) and outputs/grads match the dense
+        reference with unbounded capacity."""
+        import dataclasses
+
+        E, D, F = 4, 128, 256
+        ks = jax.random.split(jax.random.PRNGKey(31), 4)
+        x = (jax.random.normal(ks[0], (2, 16, D)) * 0.5).astype(jnp.bfloat16)
+        x = x.at[:, :, 0].set(5.0)                     # fixed positive feature
+        router = jnp.zeros((D, E)).at[0, 1].set(10.0)  # everything → expert 1
+        wg = (jax.random.normal(ks[1], (E, D, F)) / D**0.5).astype(jnp.bfloat16)
+        wu = (jax.random.normal(ks[2], (E, D, F)) / D**0.5).astype(jnp.bfloat16)
+        wd = (jax.random.normal(ks[3], (E, F, D)) / F**0.5).astype(jnp.bfloat16)
+        base = MoEConfig(num_experts=E, top_k=1)  # top_k=1: experts 0/2/3 truly empty
+        kcfg = dataclasses.replace(base, dispatch="ragged")
+        big = dataclasses.replace(base, dispatch="dense", capacity_factor=4.0)
+
+        def loss(cfg):
+            def f(x, wg, wu, wd):
+                y, aux = moe_ffn(x, router, wg, wu, wd, cfg)
+                return (y.astype(jnp.float32) ** 2).sum()
+            return jax.value_and_grad(f, argnums=(1, 2, 3))
+
+        lk, gk = loss(kcfg)(x, wg, wu, wd)
+        ld, gd = loss(big)(x, wg, wu, wd)
+        np.testing.assert_allclose(float(lk), float(ld), rtol=3e-2)
+        for name, a, b in zip("dwg dwu dwd".split(), gk, gd):
+            a = np.asarray(a, jnp.float32)
+            b = np.asarray(b, jnp.float32)
+            # empty experts (0, 2, 3) must have exactly ZERO grads, not junk
+            for e in (0, 2, 3):
+                assert np.all(a[e] == 0.0), f"{name}[{e}] nonzero for empty expert"
+            scale = np.abs(b).max() + 1e-9
+            assert np.abs(a - b).max() / scale < 5e-2, f"{name} mismatch"
+
     def test_gather_dispatch_capacity_drops(self):
         import dataclasses
 
