@@ -369,6 +369,7 @@ def _ring_bwd_kernel(
     my_ref, q_hbm, k_hbm, v_hbm, do_hbm, lse_hbm, delta_hbm, *rest,
     n: int, axis_name: str, causal: bool, scale: float,
     n_rep: int, bq: int, bk: int, window: int, has_seg: bool, H: int,
+    slab: int,
 ):
     """Ring-attention backward as one remote-DMA ring pass per device.
 
@@ -387,13 +388,13 @@ def _ring_bwd_kernel(
         segq_hbm, segk_hbm = rest[0], rest[1]
         (dq_hbm, dk_hbm, dv_hbm,
          kbuf, vbuf, dkbuf, dvbuf,
-         qt, kt, vt, dot, lset, deltat, dqt, dkt, dvt, segqt, segkt,
+         qt, kt, vt, dot, lset, deltat, dqt, dks, dvs, segqt, segkt,
          csem, send_sem, recv_sem, ready_sem, fin_sem_s, fin_sem_r) = rest[2:]
     else:
         segq_hbm = segk_hbm = segqt = segkt = None
         (dq_hbm, dk_hbm, dv_hbm,
          kbuf, vbuf, dkbuf, dvbuf,
-         qt, kt, vt, dot, lset, deltat, dqt, dkt, dvt,
+         qt, kt, vt, dot, lset, deltat, dqt, dks, dvs,
          csem, send_sem, recv_sem, ready_sem, fin_sem_s, fin_sem_r) = rest
 
     BH, Tl, D = q_hbm.shape
@@ -431,15 +432,16 @@ def _ring_bwd_kernel(
     # stage the local KV shard into ring slot 0; its dk/dv start at zero
     copy(k_hbm, kbuf.at[0])
     copy(v_hbm, vbuf.at[0])
-    dkt[:] = jnp.zeros_like(dkt)
-    dvt[:] = jnp.zeros_like(dvt)
+    dks[:] = jnp.zeros_like(dks)
+    dvs[:] = jnp.zeros_like(dvs)
+    n_sl = Tl // slab
 
     def zero_dkv(i, _):
-        copy(dkt, dkbuf.at[0, i // num_kb, pl.ds((i % num_kb) * bk, bk)])
-        copy(dvt, dvbuf.at[0, i // num_kb, pl.ds((i % num_kb) * bk, bk)])
+        copy(dks, dkbuf.at[0, i // n_sl, pl.ds((i % n_sl) * slab, slab)])
+        copy(dvs, dvbuf.at[0, i // n_sl, pl.ds((i % n_sl) * slab, slab)])
         return 0
 
-    jax.lax.fori_loop(0, BHkv * num_kb, zero_dkv, 0)
+    jax.lax.fori_loop(0, BHkv * n_sl, zero_dkv, 0)
 
     for s in range(n):
         cur, nxt = s % 2, (s + 1) % 2
@@ -465,78 +467,105 @@ def _ring_bwd_kernel(
             rk.start()
             rv.start()
 
-        def kb_body(bh, kb):
-            k0 = src * Tl + kb * bk
-            copy(kbuf.at[cur, bh, pl.ds(kb * bk, bk)], kt)
-            copy(vbuf.at[cur, bh, pl.ds(kb * bk, bk)], vt)
-            copy(dkbuf.at[cur, bh, pl.ds(kb * bk, bk)], dkt)
-            copy(dvbuf.at[cur, bh, pl.ds(kb * bk, bk)], dvt)
-            if has_seg:
-                # bh indexes B*Hkv; batch = bh // Hkv with Hkv = BHkv*H//BH
-                copy(
-                    segk_hbm.at[bh // (BHkv * H // BH), :, pl.ds(src * Tl + kb * bk, bk)],
-                    segkt,
-                )
-            kv = kt[:].astype(jnp.float32)
-            vv = vt[:].astype(jnp.float32)
-            k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        num_slabs = Tl // slab
+        kb_per_slab = slab // bk
+
+        def slab_body(bh, sl):
+            # a SLAB of the riding dk/dv accumulators lives in VMEM
+            # (dks/dvs scratch, size bounded by the slab — NOT by Tl, so
+            # long shards can't blow the VMEM budget): inner tiles
+            # accumulate with ZERO HBM read-modify-writes; dq is
+            # loaded/stored once per (q tile, slab) instead of once per
+            # (q tile × kv tile) — the r2 "serial dq RMW"
+            s_lo = sl * slab
+            copy(dkbuf.at[cur, bh, pl.ds(s_lo, slab)], dks)
+            copy(dvbuf.at[cur, bh, pl.ds(s_lo, slab)], dvs)
 
             def qb_body(g, qb):
                 qh = bh * n_rep + g
                 q0 = my * Tl + qb * bq
-
-                ok = jnp.bool_(True)
+                # whole-q-tile skip: nothing in this slab is visible to it
+                q_ok = jnp.bool_(True)
                 if causal:
-                    ok = jnp.logical_and(ok, k0 <= q0 + bq - 1)
+                    q_ok = jnp.logical_and(q_ok, src * Tl + s_lo <= q0 + bq - 1)
                 if window > 0:
-                    ok = jnp.logical_and(ok, k0 + bk - 1 >= q0 - window + 1)
+                    q_ok = jnp.logical_and(
+                        q_ok, src * Tl + s_lo + slab - 1 >= q0 - window + 1
+                    )
 
-                @pl.when(ok)
-                def _tile():
+                @pl.when(q_ok)
+                def _qtile():
                     copy(q_hbm.at[qh, pl.ds(qb * bq, bq)], qt)
                     copy(do_hbm.at[qh, pl.ds(qb * bq, bq)], dot)
                     copy(lse_hbm.at[qh, pl.ds(qb * bq, bq)], lset)
                     copy(delta_hbm.at[qh, pl.ds(qb * bq, bq)], deltat)
+                    copy(dq_hbm.at[qh, pl.ds(qb * bq, bq)], dqt)
                     if has_seg:
                         copy(segq_hbm.at[qh // H, pl.ds(qb * bq, bq)], segqt)
                     qv = qt[:].astype(jnp.float32)
                     dov = dot[:].astype(jnp.float32)
-                    s_blk = scale * jax.lax.dot_general(
-                        qv, kv, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
-                    if causal or window > 0:
-                        q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-                        keep = jnp.bool_(True)
+
+                    def kb_body(kb, _):
+                        k0 = src * Tl + s_lo + kb * bk
+                        ok = jnp.bool_(True)
                         if causal:
-                            keep = jnp.logical_and(keep, q_pos >= k_pos)
+                            ok = jnp.logical_and(ok, k0 <= q0 + bq - 1)
                         if window > 0:
-                            keep = jnp.logical_and(keep, k_pos > q_pos - window)
-                        s_blk = jnp.where(keep, s_blk, NEG_INF)
-                    if has_seg:
-                        s_blk = jnp.where(
-                            segqt[:][:, :1] == segkt[:][:1, :], s_blk, NEG_INF
-                        )
-                    p = jnp.exp(s_blk - lset[:][:, :1])
-                    dp = jax.lax.dot_general(
-                        dov, vv, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
-                    ds = p * (dp - deltat[:][:, :1])
-                    dvt[:] += jax.lax.dot_general(   # p^T @ do
-                        p, dov, (((0,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
-                    dkt[:] += scale * jax.lax.dot_general(  # ds^T @ q
-                        ds, qv, (((0,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
-                    # dq: read-modify-write the local accumulator tile
-                    copy(dq_hbm.at[qh, pl.ds(qb * bq, bq)], dqt)
-                    dqt[:] += scale * jax.lax.dot_general(  # ds @ k
-                        ds, kv, (((1,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
+                            ok = jnp.logical_and(ok, k0 + bk - 1 >= q0 - window + 1)
+
+                        @pl.when(ok)
+                        def _tile():
+                            copy(kbuf.at[cur, bh, pl.ds(s_lo + kb * bk, bk)], kt)
+                            copy(vbuf.at[cur, bh, pl.ds(s_lo + kb * bk, bk)], vt)
+                            if has_seg:
+                                copy(
+                                    segk_hbm.at[
+                                        bh // (BHkv * H // BH), :,
+                                        pl.ds(src * Tl + s_lo + kb * bk, bk),
+                                    ],
+                                    segkt,
+                                )
+                            kv = kt[:].astype(jnp.float32)
+                            vv = vt[:].astype(jnp.float32)
+                            k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+                            s_blk = scale * jax.lax.dot_general(
+                                qv, kv, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                            )
+                            if causal or window > 0:
+                                q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+                                keep = jnp.bool_(True)
+                                if causal:
+                                    keep = jnp.logical_and(keep, q_pos >= k_pos)
+                                if window > 0:
+                                    keep = jnp.logical_and(keep, k_pos > q_pos - window)
+                                s_blk = jnp.where(keep, s_blk, NEG_INF)
+                            if has_seg:
+                                s_blk = jnp.where(
+                                    segqt[:][:, :1] == segkt[:][:1, :], s_blk, NEG_INF
+                                )
+                            p = jnp.exp(s_blk - lset[:][:, :1])
+                            dp = jax.lax.dot_general(
+                                dov, vv, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                            )
+                            ds = p * (dp - deltat[:][:, :1])
+                            dvs[pl.ds(kb * bk, bk)] += jax.lax.dot_general(  # p^T @ do
+                                p, dov, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                            )
+                            dks[pl.ds(kb * bk, bk)] += scale * jax.lax.dot_general(
+                                ds, qv, (((0,), (0,)), ((), ())),            # ds^T @ q
+                                preferred_element_type=jnp.float32,
+                            )
+                            dqt[:] += scale * jax.lax.dot_general(           # ds @ k
+                                ds, kv, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                            )
+
+                        return 0
+
+                    jax.lax.fori_loop(0, kb_per_slab, kb_body, 0)
                     copy(dqt, dq_hbm.at[qh, pl.ds(qb * bq, bq)])
 
                 return 0
@@ -545,14 +574,14 @@ def _ring_bwd_kernel(
                 0, n_rep * num_qb,
                 lambda i, _: (qb_body(i // num_qb, i % num_qb), 0)[1], 0,
             )
-            copy(dkt, dkbuf.at[cur, bh, pl.ds(kb * bk, bk)])
-            copy(dvt, dvbuf.at[cur, bh, pl.ds(kb * bk, bk)])
+            copy(dks, dkbuf.at[cur, bh, pl.ds(s_lo, slab)])
+            copy(dvs, dvbuf.at[cur, bh, pl.ds(s_lo, slab)])
             return 0
 
         def run_kb_loop():
             jax.lax.fori_loop(
-                0, BHkv * num_kb,
-                lambda i, _: (kb_body(i // num_kb, i % num_kb), 0)[1], 0,
+                0, BHkv * num_slabs,
+                lambda i, _: (slab_body(i // num_slabs, i % num_slabs), 0)[1], 0,
             )
 
         if causal and s > 0:
@@ -643,9 +672,18 @@ def _ring_bwd(q, k, v, o, lse, do, axis_name: str, causal: bool, interpret: Any,
     )
     delta = jnp.broadcast_to(delta[:, :, None], (B * H, Tl, _STAT_LANES))
 
+    # slab: largest bk-multiple divisor of Tl within a ~4 MB f32 budget —
+    # the VMEM accumulator footprint is bounded by the slab, not by Tl
+    budget_rows = max(bk, (4 * 2 ** 20) // (D * 4) // bk * bk)
+    slab = bk
+    for s_cand in range(min(Tl, budget_rows), bk - 1, -bk):
+        if Tl % s_cand == 0:
+            slab = s_cand
+            break
     kernel = functools.partial(
         _ring_bwd_kernel, n=n, axis_name=axis_name, causal=causal, scale=scale,
         n_rep=n_rep, bq=bq, bk=bk, window=window, has_seg=has_seg, H=H,
+        slab=slab,
     )
     hbm = pltpu.MemorySpace.HBM
     operands = [jnp.full((1,), my, jnp.int32), qf, kf, vf, dof, lsef, delta]
@@ -692,8 +730,8 @@ def _ring_bwd(q, k, v, o, lse, do, axis_name: str, causal: bool, interpret: Any,
             pltpu.MemorySpace.VMEM((bq, _STAT_LANES), jnp.float32),
             pltpu.MemorySpace.VMEM((bq, _STAT_LANES), jnp.float32),
             pltpu.MemorySpace.VMEM((bq, D), jnp.float32),
-            pltpu.MemorySpace.VMEM((bk, D), jnp.float32),
-            pltpu.MemorySpace.VMEM((bk, D), jnp.float32),
+            pltpu.MemorySpace.VMEM((slab, D), jnp.float32),  # slab dk acc
+            pltpu.MemorySpace.VMEM((slab, D), jnp.float32),  # slab dv acc
             *seg_tiles,
             pltpu.SemaphoreType.DMA((1,)),
             pltpu.SemaphoreType.DMA((2, 4)),
