@@ -254,6 +254,34 @@ def route_ragged(
     return sort_tok, dest, gate_vals, gate_sorted, group_sizes, aux
 
 
+def _kernel_eligible(cfg: MoEConfig, D: int, F: int, dtype) -> bool:
+    """One copy of the fused-kernel eligibility rule (MXU-aligned geometry
+    on a TPU backend or the interpret harness)."""
+    from tony_tpu.ops import moe_gemm
+
+    return (
+        cfg.dispatch == "ragged"
+        and D % 128 == 0
+        and F % 128 == 0
+        and dtype == jnp.bfloat16
+        and (jax.default_backend() not in ("cpu", "gpu", "cuda", "rocm")
+             or moe_gemm._INTERPRET)
+    )
+
+
+def _expert_swiglu(xs, w_gate, w_up, w_down, group_sizes, tile):
+    """Grouped expert SwiGLU on sorted rows: the fused Pallas kernel when
+    ``tile`` is set, else three jax.lax.ragged_dot grouped GEMMs."""
+    from tony_tpu.ops import moe_gemm
+
+    if tile is not None:
+        tg = moe_gemm.tile_group_map(group_sizes, xs.shape[0] // tile, tile)
+        return moe_gemm.moe_swiglu_grouped(xs, w_gate, w_up, w_down, tg, tile)
+    g = jax.nn.silu(jax.lax.ragged_dot(xs, w_gate, group_sizes))
+    u = jax.lax.ragged_dot(xs, w_up, group_sizes)
+    return jax.lax.ragged_dot((g * u).astype(xs.dtype), w_down, group_sizes)
+
+
 @jax.custom_vjp
 def _dispatch_gather(x_flat, sort_tok, dest):
     """xs = x_flat[sort_tok] with a GATHER-form backward.
@@ -284,6 +312,40 @@ def _dispatch_gather_bwd(res, dxs):
 
 
 _dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
+
+
+@jax.custom_vjp
+def _span_dispatch_gather(x_flat, tok_span, idx, gates):
+    """EP-span row gather with a GATHER-form backward: fwd is
+    ``x_flat[tok_span]``; the cotangent is
+    ``dx[t] = Σ_k in-span dxs[idx[t,k]]`` (out-of-span choices carry zero
+    ``gates``, whose sign function doubles as the in-span mask here).
+    ``idx``/``gates`` are positional residuals only — their cotangents are
+    zero/float0 (gates' real gradient flows through the combine)."""
+    return x_flat[tok_span]
+
+
+def _span_dispatch_gather_fwd(x_flat, tok_span, idx, gates):
+    return x_flat[tok_span], (tok_span, idx, gates, x_flat.shape[0])
+
+
+def _span_dispatch_gather_bwd(res, dxs):
+    import numpy as np
+
+    tok_span, idx, gates, BT = res
+    K = idx.shape[0] // BT
+    mask = (gates != 0.0).reshape(BT, K)
+    picked = dxs[idx].reshape(BT, K, dxs.shape[-1])
+    dx = jnp.sum(jnp.where(mask[..., None], picked, 0), axis=1)
+    return (
+        dx.astype(dxs.dtype),
+        np.zeros(tok_span.shape, jax.dtypes.float0),
+        np.zeros(idx.shape, jax.dtypes.float0),
+        jnp.zeros_like(gates),
+    )
+
+
+_span_dispatch_gather.defvjp(_span_dispatch_gather_fwd, _span_dispatch_gather_bwd)
 
 
 @jax.custom_vjp
@@ -326,6 +388,115 @@ def _combine_gather_bwd(res, dy):
 _combine_gather.defvjp(_combine_gather_fwd, _combine_gather_bwd)
 
 
+def _ragged_expert_ffn_ep(
+    x, router_w, w_gate, w_up, w_down, cfg: MoEConfig, mesh, token_mask,
+):
+    """Expert-SHARDED ragged dispatch: the capacity-free grouped-GEMM path
+    under an ``expert`` mesh axis (SURVEY §2.5 "EP ragged all-to-all").
+
+    The sorted row order is expert-major, so shard s owns one CONTIGUOUS
+    span of rows. Each shard therefore:
+
+    1. runs the (replicated, deterministic) routing on its batch shard;
+    2. slices its span's token indices and gathers ONLY those rows —
+       per-shard data movement is its own tokens, the gather itself is the
+       ragged all-to-all (rows cross batch shards via the index gather);
+    3. runs the fused grouped GEMM (or ragged_dot) on its local experts;
+    4. partial-combines choices whose dest falls in its span and psums the
+       result over the expert axis.
+
+    Both the dispatch and combine keep GATHER-form backwards (span
+    variants of _dispatch_gather/_combine_gather). The span length bound
+    is static: ``(ceil(N/tile)+E_local)·tile`` rows. Aux losses are
+    per-batch-shard means (pmean): exact for the z/balance statistic only
+    when every shard holds the same valid-token count — with packed
+    batches whose pads concentrate on one shard, pad-heavy shards'
+    tokens are up-weighted (the standard per-group MoE approximation).
+    """
+    E = cfg.num_experts
+    ep = mesh.shape["expert"]
+    if E % ep:
+        raise ValueError(f"num_experts {E} must divide the expert axis {ep}")
+    E_local = E // ep
+    B, T, D = x.shape
+    K = cfg.top_k
+    from tony_tpu.ops import moe_gemm
+
+    tile = (
+        moe_gemm.TILE_M
+        if _kernel_eligible(cfg, D, w_gate.shape[-1], x.dtype)
+        else None
+    )
+    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+
+    def body(x_l, router_l, wg_l, wu_l, wd_l, tm_l):
+        from jax.ad_checkpoint import checkpoint_name
+
+        ei = jax.lax.axis_index("expert")
+        Bl = x_l.shape[0]
+        Nl = Bl * T * K
+        sort_tok, dest, gate_vals, gate_sorted, group_sizes, aux = route_ragged(
+            x_l, router_l, cfg, tm_l if token_mask is not None else None, tile=tile
+        )
+        sort_tok = checkpoint_name(sort_tok, "moe_route")
+        dest = checkpoint_name(dest, "moe_route")
+        gate_vals = checkpoint_name(gate_vals, "moe_route")
+        gate_sorted = checkpoint_name(gate_sorted, "moe_route")
+        group_sizes = checkpoint_name(group_sizes, "moe_route")
+
+        offsets = jnp.cumsum(group_sizes) - group_sizes
+        start = offsets[ei * E_local]                        # span start (dynamic)
+        gs_local = jax.lax.dynamic_slice(group_sizes, (ei * E_local,), (E_local,))
+        # static span bound: every token could land on this shard
+        span = (-(-Nl // tile) + E_local) * tile if tile is not None else Nl
+        # pad the per-row arrays so the dynamic slices NEVER clamp (a
+        # clamped start would silently misalign rows against gs_local)
+        pad0 = jnp.zeros((span,), jnp.int32)
+        tok_span = jax.lax.dynamic_slice(
+            jnp.concatenate([sort_tok, pad0]), (start,), (span,)
+        )
+        gate_span = jax.lax.dynamic_slice(
+            jnp.concatenate([gate_sorted, pad0.astype(gate_sorted.dtype)]),
+            (start,), (span,),
+        )
+        local_total = gs_local.sum()
+        rel = dest - start
+        in_span = jnp.logical_and(rel >= 0, rel < local_total)
+        idx = jnp.clip(rel, 0, span - 1)
+        gates = jnp.where(
+            in_span.reshape(Bl * T, K), gate_vals.reshape(Bl * T, K), 0.0
+        )
+
+        xs = _span_dispatch_gather(x_l.reshape(Bl * T, D), tok_span, idx, gates)
+        ys = _expert_swiglu(xs, wg_l, wu_l, wd_l, gs_local, tile)
+        # rows past the local content are unspecified (ragged_dot tail /
+        # pad tiles): zero them so the masked combine can't import NaNs
+        row_ok = jnp.arange(span)[:, None] < local_total
+        ys = jnp.where(row_ok, ys, 0)
+        y = _combine_gather(ys, idx, tok_span, gates, gate_span)
+        y = jax.lax.psum(y, "expert")
+        # aux computed identically on every expert shard (replicated
+        # routing) but differs across batch shards: per-shard means (see
+        # the docstring's approximation note)
+        if batch_axes:
+            aux = {k: jax.lax.pmean(v, batch_axes) for k, v in aux.items()}
+        return y.reshape(Bl, T, D).astype(x_l.dtype), aux
+
+    act = P(batch_axes or None, None, None)
+    wspec = P("expert", None, None)
+    tm = token_mask if token_mask is not None else jnp.ones((B, T), bool)
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(act, P(None, None), wspec, wspec, wspec,
+                  P(batch_axes or None, None)),
+        out_specs=(act, P()),
+        axis_names=set(mesh.axis_names),
+        check_vma=False,
+    )
+    return fn(x, router_w, w_gate, w_up, w_down, tm)
+
+
 def _ragged_expert_ffn(x, router_w, w_gate, w_up, w_down, cfg: MoEConfig, token_mask):
     """Grouped-GEMM MoE: expert matmuls computed straight from gathered
     rows via ``jax.lax.ragged_dot`` (XLA's megablox-style grouped GEMM) —
@@ -346,20 +517,16 @@ def _ragged_expert_ffn(x, router_w, w_gate, w_up, w_down, cfg: MoEConfig, token_
     from tony_tpu.ops import moe_gemm
 
     B, T, D = x.shape
-    F = w_gate.shape[-1]
     K = cfg.top_k
     dtype = x.dtype
     # fused Pallas kernel (one VMEM pass for the whole expert MLP) when the
     # geometry is MXU-aligned and we're on a TPU backend (or the interpret
     # harness); otherwise three jax.lax.ragged_dot grouped GEMMs
-    use_kernel = (
-        cfg.dispatch == "ragged"
-        and D % 128 == 0
-        and F % 128 == 0
-        and dtype == jnp.bfloat16
-        and (jax.default_backend() not in ("cpu", "gpu", "cuda", "rocm") or moe_gemm._INTERPRET)
+    tile = (
+        moe_gemm.TILE_M
+        if _kernel_eligible(cfg, D, w_gate.shape[-1], dtype)
+        else None
     )
-    tile = moe_gemm.TILE_M if use_kernel else None
     sort_tok, dest, gate_vals, gate_sorted, group_sizes, aux = route_ragged(
         x, router_w, cfg, token_mask, tile=tile
     )
@@ -374,13 +541,7 @@ def _ragged_expert_ffn(x, router_w, w_gate, w_up, w_down, cfg: MoEConfig, token_
     # NOT pinned: saving xs would skip the gather replay in the backward,
     # but the PN·D/layer it costs forces a smaller batch — measured net
     # NEGATIVE (b24 32.6% / b28 33.2% pinned vs b32 33.8% unpinned)
-    if use_kernel:
-        tg = moe_gemm.tile_group_map(group_sizes, xs.shape[0] // tile, tile)
-        ys = moe_gemm.moe_swiglu_grouped(xs, w_gate, w_up, w_down, tg, tile)
-    else:
-        g = jax.nn.silu(jax.lax.ragged_dot(xs, w_gate, group_sizes))
-        u = jax.lax.ragged_dot(xs, w_up, group_sizes)
-        ys = jax.lax.ragged_dot((g * u).astype(dtype), w_down, group_sizes)
+    ys = _expert_swiglu(xs, w_gate, w_up, w_down, group_sizes, tile)
     # combine in choice order: gather each (token, k) choice's row and
     # weight-sum over k — gathers in the backward too (_combine_gather)
     y = _combine_gather(
@@ -422,10 +583,11 @@ def moe_ffn(
     rows into (expert, capacity-slot) cells by index; "dense" is the GShard
     one-hot einsum pair (kept for parity/verification — same math).
 
-    The ragged path's group dimension is data-dependent, which GSPMD cannot
-    shard over an ``expert`` mesh axis — with expert-sharded weights it
-    falls back to "gather" (capacity-dense, all-to-all-friendly); on an
-    unsharded expert axis (incl. the single-chip bench) ragged runs.
+    The ragged path runs in BOTH regimes: unsharded expert axis (incl.
+    the single-chip bench) uses the flat grouped-GEMM path; an expert
+    axis > 1 routes to the contiguous-span shard_map path
+    (_ragged_expert_ffn_ep) — still capacity-free, no drops, each shard
+    computing only its own experts' span.
     """
     dtype = x.dtype
     if cfg.dispatch in ("ragged", "ragged_xla"):
@@ -436,9 +598,9 @@ def moe_ffn(
         )
         if not expert_sharded:
             return _ragged_expert_ffn(x, router_w, w_gate, w_up, w_down, cfg, token_mask)
-        import dataclasses
-
-        cfg = dataclasses.replace(cfg, dispatch="gather")
+        return _ragged_expert_ffn_ep(
+            x, router_w, w_gate, w_up, w_down, cfg, mesh, token_mask
+        )
     if cfg.dispatch == "dense":
         dispatch, combine, aux = route(x, router_w, cfg, token_mask)
         xe = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), x)  # [E,B,C,D]
