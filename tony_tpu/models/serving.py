@@ -262,6 +262,7 @@ class ContinuousBatcher:
         self, params, cfg: LlamaConfig, *, num_slots: int = 8, max_len: int = 512,
         eos_id: int = -1, temperature: float = 0.0, top_k: int = 0,
         key: jax.Array | None = None, decode_chunk: int = 8, attn: str = "auto",
+        prefill_chunk: int = 0,
     ):
         if num_slots < 1 or max_len < 1:
             raise ValueError(f"need num_slots>=1 and max_len>=1, got {num_slots}/{max_len}")
@@ -281,6 +282,13 @@ class ContinuousBatcher:
         # mid-chunk simply DISCARD their overshoot tokens (see step()). >1
         # amortizes host dispatch overhead at the cost of admission latency
         self.decode_chunk = max(1, decode_chunk)
+        # >0: long prompts prefill in chunks of this many tokens, ONE chunk
+        # per engine step, so a long admission can't stall running decodes
+        # for more than ~one chunk's compute. Middle chunks are EXACT
+        # length (cache positions must be true); only the final partial
+        # chunk pads to a bucket (garbage K/V past the prompt is masked by
+        # the slot length, as in the unchunked path).
+        self.prefill_chunk = prefill_chunk
         self.cache = init_slot_cache(cfg, num_slots, max_len)
         self.tokens = jnp.zeros((num_slots,), jnp.int32)  # last token per slot
         self.key = key if key is not None else jax.random.PRNGKey(0)
@@ -288,9 +296,10 @@ class ContinuousBatcher:
         self.running: dict[int, _Request] = {}   # slot → request
         self.done: dict[int, list[int]] = {}
         self._next_rid = 0
-        # prefills dispatched ahead of slot availability (overlap with the
-        # in-flight decode chunk): [(request, prefill cache, first token)]
-        self._staged: list[tuple[_Request, KVCache, jax.Array]] = []
+        # prefill state machine entries, dispatched ahead of slot
+        # availability (overlap with the in-flight decode chunk):
+        # [request, prefill cache, tokens prefilled, first token | None]
+        self._staged: list[list] = []
         self._slot_len = [0] * num_slots  # host mirror of cache.lengths
 
     def submit(self, prompt, max_new_tokens: int) -> int:
@@ -314,31 +323,61 @@ class ContinuousBatcher:
     def _free_slots(self) -> list[int]:
         return [s for s in range(self.S) if s not in self.running]
 
-    def _stage_prefills(self, budget: int):
-        """Dispatch (async) prefills for up to ``budget`` pending requests.
-        Called right after the decode chunk is dispatched so the prefill
-        compute/transfers queue behind it instead of delaying the NEXT
-        chunk — admission then only inserts the finished prefill."""
+    def _stage_prefills(self, budget: int, advance: bool = True):
+        """Stage up to ``budget`` pending requests and (when ``advance``)
+        run prefill work for every staged entry. The advancing call site is
+        AFTER the decode chunk is dispatched, so prefill compute queues
+        behind it instead of delaying it; admission-time staging passes
+        ``advance=False`` (unless nothing is decoding) to keep the
+        one-chunk-per-step stall bound honest."""
         while self.pending and len(self._staged) < budget:
             req = self.pending.pop(0)
-            Tp = len(req.prompt)
-            pad = min(_bucket(Tp), self.max_len) - Tp
-            padded = jnp.array(req.prompt + [0] * pad, jnp.int32)[None, :]
-            pre = init_cache(self.cfg, 1, self.max_len)
+            self._staged.append([req, init_cache(self.cfg, 1, self.max_len), 0, None])
+        if advance:
+            for entry in self._staged:
+                self._advance_prefill(entry)
+
+    def _advance_prefill(self, entry) -> None:
+        """Run one prefill chunk (or the whole prompt when unchunked)."""
+        req, pre, pos, first = entry
+        if first is not None:
+            return
+        Tp = len(req.prompt)
+        step = self.prefill_chunk if self.prefill_chunk > 0 else Tp
+        while first is None:
+            take = min(step, Tp - pos)
+            last = pos + take >= Tp
+            if last:
+                # cap the pad so the padded write NEVER runs past max_len —
+                # dynamic_update_slice would clamp the start and silently
+                # shift real prompt K/V (caught by review repro: prompt 59,
+                # chunk 8, max_len 64 corrupted positions 48..59)
+                pad = min(_bucket(take), self.max_len - pos) - take
+            else:
+                pad = 0  # middle chunks are exact: cache positions stay true
+            toks = jnp.array(
+                req.prompt[pos:pos + take] + [0] * pad, jnp.int32
+            )[None, :]
             # padded positions write garbage K/V past Tp; decode masks them
             # out via lengths[slot] = Tp, and causality protects the prefix
-            logits, pre = _prefill_padded(self.params, padded, pre, self.cfg)
-            first = _sample(
-                logits[:, Tp - 1].astype(jnp.float32), self._split(),
-                self.temperature, self.top_k,
-            )
-            self._staged.append((req, pre, first))
+            logits, pre = _prefill_padded(self.params, toks, pre, self.cfg)
+            pos += take
+            if last:
+                first = _sample(
+                    logits[:, take - 1].astype(jnp.float32), self._split(),
+                    self.temperature, self.top_k,
+                )
+            entry[1], entry[2], entry[3] = pre, pos, first
+            if self.prefill_chunk > 0:
+                break  # one chunk per engine step — decode interleaves
 
     def _admit(self):
         free = self._free_slots()
-        self._stage_prefills(len(free))
-        while self._staged and free:
-            req, pre, first = self._staged.pop(0)
+        # only compute prefills here when nothing is decoding (startup /
+        # drain); otherwise they advance after the decode chunk dispatches
+        self._stage_prefills(len(free), advance=not self.running)
+        while self._staged and free and self._staged[0][3] is not None:
+            req, pre, _, first = self._staged.pop(0)
             slot = free.pop(0)
             Tp = len(req.prompt)
             self.cache = _insert_prefill(

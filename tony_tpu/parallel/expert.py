@@ -598,9 +598,17 @@ def moe_ffn(
         )
         if not expert_sharded:
             return _ragged_expert_ffn(x, router_w, w_gate, w_up, w_down, cfg, token_mask)
-        return _ragged_expert_ffn_ep(
-            x, router_w, w_gate, w_up, w_down, cfg, mesh, token_mask
-        )
+        if mesh.shape.get("model", 1) == 1 and mesh.shape.get("context", 1) == 1:
+            # the span shard_map honors batch+expert axes (weight fsdp
+            # shards all-gather at use — FSDP semantics); a model/context
+            # axis would silently REPLICATE the MoE compute, so those
+            # layouts keep the GSPMD gather dispatch below
+            return _ragged_expert_ffn_ep(
+                x, router_w, w_gate, w_up, w_down, cfg, mesh, token_mask
+            )
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, dispatch="gather")
     if cfg.dispatch == "dense":
         dispatch, combine, aux = route(x, router_w, cfg, token_mask)
         xe = jnp.einsum("btec,btd->ebcd", dispatch.astype(dtype), x)  # [E,B,C,D]
