@@ -553,6 +553,74 @@ class TestPipeline1F1B:
         self._check(MeshSpec(stage=4), S=4, M=4, wire=jnp.float32,
                     devices=jax.devices()[:4])
 
+    def test_interleaved_grads_match_flat_scan(self):
+        """Interleaved 1F1B (virtual pipeline chunks, V=2): each device
+        owns two model chunks, microbatches visit it twice, the wrap hop
+        advances the chunk — loss and grads must equal the flat scan."""
+        llama, cfg, params, batch = self._setup(S=4)  # 4 layers → S2 × V2
+        from tony_tpu.parallel import MeshSpec
+
+        mesh = MeshSpec(stage=2).build(jax.devices()[:2])
+        loss_pp, metrics, grads = jax.jit(
+            functools.partial(
+                llama.pp_value_and_grad, cfg=cfg, mesh=mesh,
+                num_microbatches=4, num_chunks=2, wire_dtype=jnp.float32,
+            )
+        )(params, batch)
+        (loss_flat, m_flat), grads_flat = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, batch, cfg), has_aux=True
+        )(params)
+        np.testing.assert_allclose(float(loss_pp), float(loss_flat), rtol=1e-4)
+        assert int(metrics["tokens"]) == int(m_flat["tokens"])
+        pp_g = dict(jax.tree.leaves_with_path(grads))
+        for path, g in jax.tree.leaves_with_path(grads_flat):
+            scale = float(jnp.max(jnp.abs(g))) + 1e-9
+            err = float(jnp.max(jnp.abs(pp_g[path].astype(jnp.float32) - g.astype(jnp.float32)))) / scale
+            assert err < 1e-3, f"{path} rel err {err}"
+
+    def test_interleaved_composes_with_data_axis(self):
+        """V=2 chunks × stage=2 × data=4, bf16 wire: the production shape."""
+        import dataclasses as dc
+
+        from tony_tpu.models import llama as llama_mod
+        from tony_tpu.parallel import MeshSpec
+
+        cfg = dc.replace(
+            llama_mod.LLAMA_TINY, n_layers=8, max_seq=32, remat=False,
+            dtype="float32", ce_chunk=16,
+        )
+        params = llama_mod.init(jax.random.PRNGKey(0), cfg)
+        batch = llama_mod.synthetic_batch(jax.random.PRNGKey(1), 16, 32, cfg)
+        mesh = MeshSpec(stage=2, data=4).build()
+        loss_pp, metrics, grads = jax.jit(
+            functools.partial(
+                llama_mod.pp_value_and_grad, cfg=cfg, mesh=mesh,
+                num_microbatches=4, num_chunks=2,
+            )
+        )(params, batch)
+        (loss_flat, m_flat), grads_flat = jax.value_and_grad(
+            lambda p: llama_mod.loss_fn(p, batch, cfg), has_aux=True
+        )(params)
+        np.testing.assert_allclose(float(loss_pp), float(loss_flat), rtol=3e-3)
+        pp_g = dict(jax.tree.leaves_with_path(grads))
+        for path, g in jax.tree.leaves_with_path(grads_flat):
+            scale = float(jnp.max(jnp.abs(g))) + 1e-9
+            err = float(jnp.max(jnp.abs(pp_g[path].astype(jnp.float32) - g.astype(jnp.float32)))) / scale
+            assert err < 2e-2, f"{path} rel err {err}"
+
+    def test_interleaved_rejects_bad_microbatches(self):
+        llama, cfg, params, batch = self._setup(S=4)
+        from tony_tpu.parallel import MeshSpec
+
+        mesh = MeshSpec(stage=2).build(jax.devices()[:2])
+        with pytest.raises(ValueError, match="microbatches"):
+            jax.jit(
+                functools.partial(
+                    llama.pp_value_and_grad, cfg=cfg, mesh=mesh,
+                    num_microbatches=3, num_chunks=2,  # 3 % S(2) != 0
+                )
+            )(params, batch)
+
     def test_packed_batch_matches_flat(self):
         """Packed batches (segment_ids) through the 1F1B schedule: loss and
         grads must match the flat scan on the same packed batch."""

@@ -402,7 +402,7 @@ def pp_loss_fn(
 
 def pp_value_and_grad(
     params: dict, batch: dict, cfg: LlamaConfig, mesh, num_microbatches: int = 2,
-    wire_dtype=jnp.bfloat16,
+    wire_dtype=jnp.bfloat16, num_chunks: int = 1,
 ) -> tuple[jax.Array, dict, dict]:
     """1F1B pipeline train-step core: ``(loss, metrics, grads)`` with grads
     shaped exactly like ``params``.
@@ -465,8 +465,39 @@ def pp_value_and_grad(
     pp_batch = {"tokens": tokens}
     if "segment_ids" in batch:
         pp_batch["segment_ids"] = batch["segment_ids"]
-    stages = split_layers_into_stages(params["layers"], S)
     head_params = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
+    if num_chunks > 1:
+        from tony_tpu.parallel.pipeline import (
+            spmd_pipeline_1f1b_interleaved,
+            split_layers_into_chunks,
+        )
+
+        chunks = split_layers_into_chunks(params["layers"], S, num_chunks)
+        nll, ntok, (dchunk, dembed, dhead) = spmd_pipeline_1f1b_interleaved(
+            stage_fn, chunks, pp_batch, params["embed"], head_params,
+            embed_fn, loss_head_fn,
+            mesh=mesh, num_microbatches=num_microbatches, num_chunks=num_chunks,
+            wire_dtype=wire_dtype, compute_dtype=cfg.jdtype,
+        )
+        loss = nll / jnp.maximum(ntok, 1.0)
+        inv = 1.0 / jnp.maximum(ntok, 1.0)
+
+        def unsplit(g, p):
+            # [S, V, Lc, ...] grads → [L, ...] matching the stacked layout
+            V = num_chunks
+            r = g.reshape(S, V, -1, *p.shape[1:])
+            r = r.transpose(1, 0, *range(2, r.ndim))  # [V, S, Lc, ...]
+            return (r.reshape(cfg.n_layers, *p.shape[1:]) * inv).astype(p.dtype)
+
+        d_layers = jax.tree.map(unsplit, dchunk, params["layers"])
+        grads = {
+            "embed": (dembed * inv).astype(params["embed"].dtype),
+            "layers": d_layers,
+            "final_norm": (dhead["final_norm"] * inv).astype(params["final_norm"].dtype),
+            "lm_head": (dhead["lm_head"] * inv).astype(params["lm_head"].dtype),
+        }
+        return loss, {"loss": loss, "tokens": ntok}, grads
+    stages = split_layers_into_stages(params["layers"], S)
     nll, ntok, _, (dstage, dembed, dhead) = spmd_pipeline_1f1b(
         stage_fn, stages, pp_batch, params["embed"], head_params,
         embed_fn, loss_head_fn,

@@ -384,6 +384,262 @@ def spmd_pipeline_1f1b(
     return nll, ntok, aux, (dstage, dembed, dhead)
 
 
+def spmd_pipeline_1f1b_interleaved(
+    stage_fn: Callable[..., Any],
+    chunk_params: Any,
+    batch: Any,
+    embed_params: Any,
+    head_params: Any,
+    embed_fn: Callable[[Any, Any], jax.Array],
+    loss_head_fn: Callable[[Any, jax.Array, Any], tuple[jax.Array, jax.Array]],
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    num_chunks: int,
+    axis_name: str = "stage",
+    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+    wire_dtype=jnp.bfloat16,
+    compute_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, jax.Array, tuple[Any, Any, Any]]:
+    """INTERLEAVED 1F1B (virtual pipeline stages): every device owns
+    ``num_chunks`` (V) model chunks; global stage ``g = v·S + s`` so a
+    microbatch visits each device V times. Bubble shrinks from
+    ``(2S−1)`` stage-units to ``≈(2S−1)/V`` (the classic interleaved
+    trade: V× more live activations per device, V× less bubble).
+
+    Schedule (lockstep SPMD, chunk-sized ticks; m in groups of S):
+
+    - fwd of (m, v) on device s at ``t = s + (m//S)·VS + v·S + (m%S)``
+    - bwd of (m, v) on device s at
+      ``t = VS + (V−1−v)·S + (S−1−s) + (m//S)·VS + (m%S)``
+
+    Both recurrences advance exactly one tick per ring hop — including
+    the device-(S−1)→0 wrap that carries chunk v's output into chunk
+    v+1 — so ONE fwd ppermute and ONE bwd ppermute per tick move all V
+    chunks' traffic (stacked on a leading V dim). Per (device, chunk,
+    tick) there is at most one fwd and one bwd unit (mixed-radix
+    bijection), and all expensive units sit behind ``lax.cond`` exactly
+    like the non-interleaved schedule. Requires ``M % S == 0``.
+
+    ``chunk_params``: pytree with leading ``[S, V, ...]`` dims (see
+    ``split_layers_into_chunks``), sharded P(axis_name). Contract of
+    ``stage_fn/embed_fn/loss_head_fn`` matches ``spmd_pipeline_1f1b``
+    (no stage-aux support here yet). Returns
+    ``(nll_sum, n_tokens, (d_chunk_params, d_embed, d_head))``.
+    """
+    S = mesh.shape[axis_name]
+    V = num_chunks
+    M = num_microbatches
+    B = jax.tree.leaves(batch)[0].shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    if M % S:
+        raise ValueError(
+            f"interleaved 1F1B needs microbatches {M} % stages {S} == 0"
+        )
+    present = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    batch_mb = jax.tree.map(lambda a: a.reshape(M, B // M, *a.shape[1:]), batch)
+    VS = V * S
+    # total ticks: one past the last backward unit (m=M−1, v=0, s=0)
+    T_TOT = VS + (V - 1) * S + (S - 1) + (M // S - 1) * VS + (S - 1) + 1
+    # residual slots per chunk: an activation's worst-case lifetime is
+    # 2VS-1 ticks, during which at most 2S-1 newer microbatches write the
+    # same chunk's slots (m advances S per VS ticks) -> 2S+1 suffices,
+    # the same geometry as the non-interleaved schedule
+    RES = 2 * S + 1
+
+    def body(chunk_p, embed_p, head_p, mbs):
+        idx = jax.lax.axis_index(axis_name)
+        local = jax.tree.map(lambda p: p[0], chunk_p)  # [V, ...] per leaf
+        mb0 = jax.tree.map(lambda a: a[0], mbs)
+        x_probe = jax.eval_shape(embed_fn, embed_p, mb0)
+        mb_shape = x_probe.shape
+
+        def head_value_grads(hp, y, mb):
+            def f(hp, y):
+                return loss_head_fn(hp, y, mb)
+
+            (nll, n), (dhp, dy) = jax.value_and_grad(f, argnums=(0, 1), has_aux=True)(hp, y)
+            return nll, n.astype(jnp.float32), dhp, dy
+
+        def unit_indices(t, v):
+            """(fwd_valid, m_f, bwd_valid, m_b) for chunk v at tick t."""
+            u_f = t - idx - v * S
+            r_f = jax.lax.rem(u_f, VS)
+            ok_f = jnp.logical_and(u_f >= 0, r_f < S)
+            m_f = jax.lax.div(u_f, VS) * S + r_f
+            ok_f = jnp.logical_and(ok_f, m_f < M)
+            u_b = t - VS - (V - 1 - v) * S - (S - 1 - idx)
+            r_b = jax.lax.rem(u_b, VS)
+            ok_b = jnp.logical_and(u_b >= 0, r_b < S)
+            m_b = jax.lax.div(u_b, VS) * S + r_b
+            ok_b = jnp.logical_and(ok_b, m_b < M)
+            return ok_f, jnp.clip(m_f, 0, M - 1), ok_b, jnp.clip(m_b, 0, M - 1)
+
+        def tick(carry, t):
+            fwd_in, bwd_in, resid, dchunk, dembed, dhead, nll_acc, ntok_acc = carry
+            y_out = []
+            dx_out = []
+            for v in range(V):  # static unroll over this device's chunks
+                first_g = jnp.logical_and(idx == 0, v == 0)
+                last_g = jnp.logical_and(idx == S - 1, v == V - 1)
+                ok_f, m_f, ok_b, m_b = unit_indices(t, v)
+                lp = jax.tree.map(lambda p: p[v], local)
+
+                # ---- forward unit of chunk v
+                mb_f = jax.tree.map(lambda a: a[m_f], mbs)
+                x = jax.lax.cond(
+                    first_g,
+                    lambda: embed_fn(embed_p, mb_f).astype(compute_dtype),
+                    lambda: fwd_in[v].astype(compute_dtype),
+                )
+                y = jax.lax.cond(
+                    ok_f,
+                    lambda: stage_fn(lp, x, mb_f).astype(compute_dtype),
+                    lambda: jnp.zeros(mb_shape, compute_dtype),
+                )
+                slot_w = jnp.where(ok_f, jax.lax.rem(m_f, RES), RES)
+                resid = resid.at[v].set(
+                    jax.lax.dynamic_update_index_in_dim(resid[v], x, slot_w, 0)
+                )
+                y_out.append(y)
+
+                # ---- backward unit of chunk v
+                mb_b = jax.tree.map(lambda a: a[m_b], mbs)
+
+                def bwd_compute(v=v, lp=lp, m_b=m_b, ok_b=ok_b, mb_b=mb_b,
+                                last_g=last_g, first_g=first_g):
+                    slot_r = jnp.where(ok_b, jax.lax.rem(m_b, RES), RES)
+                    x_res = jax.lax.dynamic_index_in_dim(
+                        resid[v], slot_r, 0, keepdims=False
+                    )
+                    y_res, vjp = jax.vjp(lambda p, x: stage_fn(p, x, mb_b), lp, x_res)
+                    nll, n, dhp, dy = jax.lax.cond(
+                        last_g,
+                        lambda: head_value_grads(head_p, y_res, mb_b),
+                        lambda: (
+                            jnp.zeros((), jnp.float32),
+                            jnp.zeros((), jnp.float32),
+                            jax.tree.map(jnp.zeros_like, head_p),
+                            jnp.zeros_like(y_res),
+                        ),
+                    )
+                    g = jnp.where(last_g, dy.astype(wire_dtype), bwd_in[v]).astype(
+                        y_res.dtype
+                    )
+                    dp_m, dx_m = vjp(g)
+                    dE_m = jax.lax.cond(
+                        first_g,
+                        lambda: jax.vjp(lambda ep: embed_fn(ep, mb_b), embed_p)[1](
+                            dx_m.astype(x_probe.dtype)
+                        )[0],
+                        lambda: jax.tree.map(jnp.zeros_like, embed_p),
+                    )
+                    return nll, n, dp_m, dx_m, dhp, dE_m
+
+                def bwd_skip():
+                    return (
+                        jnp.zeros((), jnp.float32),
+                        jnp.zeros((), jnp.float32),
+                        jax.tree.map(jnp.zeros_like, jax.tree.map(lambda p: p[v], local)),
+                        jnp.zeros(mb_shape, compute_dtype),
+                        jax.tree.map(jnp.zeros_like, head_p),
+                        jax.tree.map(jnp.zeros_like, embed_p),
+                    )
+
+                nll, n, dp_m, dx_m, dhp, dE_m = jax.lax.cond(ok_b, bwd_compute, bwd_skip)
+                dchunk = jax.tree.map(
+                    lambda acc, g, vv=v: acc.at[vv].add(g), dchunk, dp_m
+                )
+                dhead = _add_trees(dhead, dhp)
+                dembed = _add_trees(
+                    dembed, jax.tree.map(lambda a: a.astype(jnp.float32), dE_m)
+                )
+                nll_acc = nll_acc + nll
+                ntok_acc = ntok_acc + n
+                dx_out.append(dx_m)
+
+            y_all = jnp.stack([y.astype(wire_dtype) for y in y_out])     # [V, ...]
+            dx_all = jnp.stack([d.astype(wire_dtype) for d in dx_out])
+            fwd_out = jax.lax.ppermute(
+                y_all, axis_name, [(i, (i + 1) % S) for i in range(S)]
+            )
+            # the wrap also advances the chunk: what device 0 receives for
+            # "chunk v" left device S-1 as chunk v's output but must enter
+            # chunk v+1 — roll the chunk dim on the wrap receiver only
+            rolled = jnp.roll(fwd_out, 1, axis=0)
+            fwd_out = jnp.where(idx == 0, rolled, fwd_out)
+            bwd_out = jax.lax.ppermute(
+                dx_all, axis_name, [(i, (i - 1) % S) for i in range(S)]
+            )
+            rolled_b = jnp.roll(bwd_out, -1, axis=0)
+            bwd_out = jnp.where(idx == S - 1, rolled_b, bwd_out)
+            return (
+                fwd_out, bwd_out, resid, dchunk, dembed, dhead, nll_acc, ntok_acc,
+            ), None
+
+        carry0 = (
+            jnp.zeros((V, *mb_shape), wire_dtype),
+            jnp.zeros((V, *mb_shape), wire_dtype),
+            jnp.zeros((V, RES + 1, *mb_shape), compute_dtype),
+            _f32_zeros_like(local),
+            _f32_zeros_like(embed_p),
+            _f32_zeros_like(head_p),
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
+        )
+        (_, _, _, dchunk, dembed, dhead, nll, ntok), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(T_TOT)
+        )
+
+        axes_all = (axis_name, *present)
+        nll = jax.lax.psum(nll, axes_all)
+        ntok = jax.lax.psum(ntok, axes_all)
+        dembed = jax.tree.map(lambda a: jax.lax.psum(a, axes_all), dembed)
+        dhead = jax.tree.map(lambda a: jax.lax.psum(a, axes_all), dhead)
+        if present:
+            dchunk = jax.tree.map(lambda a: jax.lax.psum(a, present), dchunk)
+        dchunk = jax.tree.map(lambda a: a[None], dchunk)
+        return nll, ntok, dchunk, dembed, dhead
+
+    param_specs = jax.tree.map(
+        lambda p: P(axis_name, *([None] * (p.ndim - 1))), chunk_params
+    )
+    rep = jax.tree.map(lambda p: P(), embed_params)
+    rep_head = jax.tree.map(lambda p: P(), head_params)
+    mb_specs = jax.tree.map(
+        lambda a: P(None, present or None, *([None] * (a.ndim - 2))), batch_mb
+    )
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, rep, rep_head, mb_specs),
+        out_specs=(P(), P(), param_specs, rep, rep_head),
+        axis_names={axis_name, *present},
+        check_vma=False,
+    )
+    nll, ntok, dchunk, dembed, dhead = fn(chunk_params, embed_params, head_params, batch_mb)
+    return nll, ntok, (dchunk, dembed, dhead)
+
+
+def split_layers_into_chunks(stacked_layer_params: Any, num_stages: int, num_chunks: int) -> Any:
+    """[L, ...] scan-stacked layers → [S, V, L/(S·V), ...] for the
+    interleaved schedule: global stage ``g = v·S + s`` owns layer block g,
+    so device s's chunk v holds layers ``g·Lc ... (g+1)·Lc``."""
+
+    def reshape(p):
+        L = p.shape[0]
+        SV = num_stages * num_chunks
+        if L % SV:
+            raise ValueError(f"{L} layers not divisible by {SV} stage-chunks")
+        Lc = L // SV
+        # [L] → [V, S, Lc, ...] (g = v·S + s varies s fastest) → [S, V, Lc]
+        r = p.reshape(num_chunks, num_stages, Lc, *p.shape[1:])
+        return r.transpose(1, 0, *range(2, r.ndim))
+
+    return jax.tree.map(reshape, stacked_layer_params)
+
+
 def stack_stages(params_per_stage: list[Any]) -> Any:
     """[pytree_s for s in stages] → pytree with leading stage dim."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *params_per_stage)

@@ -48,6 +48,7 @@ class LoopConfig:
     expert_axis: int = 1
     stage_axis: int = 1        # >1: pipeline parallelism (1F1B schedule)
     pp_microbatches: int = 4   # microbatches per 1F1B step (batch must divide)
+    pp_chunks: int = 1         # >1: interleaved virtual stages per device
     data_dir: str = ""  # dir of *.tonytok shards; empty → synthetic batches
 
 
@@ -82,6 +83,15 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
             "pipeline parallelism (stage_axis > 1) needs a model with a "
             "1F1B train-step core (llama and mixtral families have one)"
         )
+    if loop.stage_axis > 1 and loop.pp_chunks > 1:
+        import inspect
+
+        sig = inspect.signature(model_module.pp_value_and_grad)
+        if "num_chunks" not in sig.parameters:
+            raise ValueError(
+                f"{model_module.__name__}.pp_value_and_grad has no interleaved "
+                "schedule (num_chunks) — --pp_chunks > 1 is llama-family only"
+            )
     init_distributed()  # no-op off-gang; joins jax.distributed under tony
     spec = MeshSpec.auto(
         model=loop.model_axis, context=loop.context_axis, expert=loop.expert_axis,
@@ -115,6 +125,7 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
             functools.partial(
                 model_module.pp_value_and_grad, cfg=model_cfg, mesh=mesh,
                 num_microbatches=loop.pp_microbatches,
+                **({"num_chunks": loop.pp_chunks} if loop.pp_chunks > 1 else {}),
             ),
             opt,
         )
@@ -242,6 +253,9 @@ def parse_loop_args(argv: list[str] | None = None) -> tuple[LoopConfig, dict]:
     p.add_argument("--stage_axis", type=int, default=1,
                    help="pipeline stages (1F1B schedule when > 1)")
     p.add_argument("--pp_microbatches", type=int, default=4)
+    p.add_argument("--pp_chunks", type=int, default=1,
+                   help=">1: interleaved 1F1B (virtual stage chunks per device; "
+                        "llama family)")
     p.add_argument("--data_dir", default="")
     p.add_argument("--preset", default="tiny")
     args = p.parse_args(argv if argv is not None else sys.argv[1:])
