@@ -1,0 +1,253 @@
+"""Probe 2: does per-row DMA gather hide under the fused MoE tile GEMMs?
+
+Arms (loop-in-jit as gather_probe.py):
+  gemm        — xs already in expert order, pipelined BlockSpec input, the
+                3-GEMM SwiGLU tile body (ops/moe_gemm._fwd_kernel shape)
+  gather_gemm — same body, but rows arrive via in-kernel per-row DMA from
+                x in HBM (double-buffered across tiles)
+  xla_total   — xs = x[idx] (XLA gather) THEN the gemm kernel — i.e. the
+                current production forward
+
+If gather_gemm ≈ gemm, the descriptor issue overlaps MXU work and the
+in-kernel gather removes the XLA gather for free. If gather_gemm ≈
+gemm + standalone-gather, the scalar issue serializes and the lever is dead.
+
+Run: python examples/mixtral/gather_gemm_probe.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TILE = 256
+ITERS = 16
+
+
+def _swiglu_body(x, wg, wu, wd, o_dtype):
+    g = jnp.dot(x, wg, preferred_element_type=jnp.float32)
+    u = jnp.dot(x, wu, preferred_element_type=jnp.float32)
+    h = (g * jax.nn.sigmoid(g) * u).astype(x.dtype)
+    return jnp.dot(h, wd, preferred_element_type=jnp.float32).astype(o_dtype)
+
+
+def gemm_plain(xs, wg, wu, wd, tile=TILE):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    PN, D = xs.shape
+    F = wg.shape[1]
+
+    def kern(xs_ref, wg_ref, wu_ref, wd_ref, o_ref):
+        o_ref[...] = _swiglu_body(
+            xs_ref[...], wg_ref[...], wu_ref[...], wd_ref[...], o_ref.dtype
+        )
+
+    return pl.pallas_call(
+        kern,
+        grid=(PN // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, D), lambda m: (m, 0)),
+            pl.BlockSpec((D, F), lambda m: (0, 0)),
+            pl.BlockSpec((D, F), lambda m: (0, 0)),
+            pl.BlockSpec((F, D), lambda m: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, D), lambda m: (m, 0)),
+        out_shape=jax.ShapeDtypeStruct((PN, D), xs.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",), vmem_limit_bytes=100 * 1024 * 1024
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * PN * D * F * 3,
+            bytes_accessed=2 * PN * D * 2,
+            transcendentals=PN * F,
+        ),
+    )(xs, wg, wu, wd)
+
+
+def gemm_gathered(x, idx, wg, wu, wd, tile=TILE, order="issue_first", unroll=False):
+    """order="issue_first": tile m+1's DMA issue loop runs BEFORE tile m's
+    wait+compute (the scalar core delays every compute by the issue time).
+    order="compute_first": wait(m) → compute(m) → issue(m+1) — the scalar
+    core issues while the MXU chews on tile m. unroll: python-range loops
+    (straight-line scalar code) instead of fori_loop."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    PN = idx.shape[0]
+    BT, D = x.shape
+    F = wg.shape[1]
+    x3 = x.reshape(BT, 8, D // 8)
+
+    def kern(idx_ref, x_hbm, wg_ref, wu_ref, wd_ref, o_ref, buf, sem):
+        m = pl.program_id(0)
+        nm = pl.num_programs(0)
+
+        def row_copy(t, slot, r):
+            return pltpu.make_async_copy(
+                x_hbm.at[idx_ref[t * tile + r]], buf.at[slot, r], sem.at[slot]
+            )
+
+        def start(t, slot):
+            if unroll:
+                for r in range(tile):
+                    row_copy(t, slot, r).start()
+            else:
+                def row(r, _):
+                    row_copy(t, slot, r).start()
+                    return 0
+
+                jax.lax.fori_loop(0, tile, row, 0)
+
+        def wait_all(t, slot):
+            if unroll:
+                for r in range(tile):
+                    row_copy(t, slot, r).wait()
+            else:
+                def row(r, _):
+                    row_copy(t, slot, r).wait()
+                    return 0
+
+                jax.lax.fori_loop(0, tile, row, 0)
+
+        @pl.when(m == 0)
+        def _warm():
+            start(0, 0)
+
+        slot = m % 2
+        if order == "issue_first":
+            @pl.when(m + 1 < nm)
+            def _next():
+                start(m + 1, (m + 1) % 2)
+
+            wait_all(m, slot)
+            x_t = buf[slot].reshape(tile, D)
+            o_ref[...] = _swiglu_body(
+                x_t, wg_ref[...], wu_ref[...], wd_ref[...], o_ref.dtype
+            )
+        else:
+            wait_all(m, slot)
+            x_t = buf[slot].reshape(tile, D)
+            o_ref[...] = _swiglu_body(
+                x_t, wg_ref[...], wu_ref[...], wd_ref[...], o_ref.dtype
+            )
+
+            @pl.when(m + 1 < nm)
+            def _next():
+                start(m + 1, (m + 1) % 2)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(PN // tile,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((D, F), lambda m, idx: (0, 0)),
+            pl.BlockSpec((D, F), lambda m, idx: (0, 0)),
+            pl.BlockSpec((F, D), lambda m, idx: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, D), lambda m, idx: (m, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, tile, 8, D // 8), jnp.bfloat16),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((PN, D), x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",), vmem_limit_bytes=100 * 1024 * 1024
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * PN * D * F * 3,
+            bytes_accessed=2 * PN * D * 2,
+            transcendentals=PN * F,
+        ),
+    )(idx, x3, wg, wu, wd)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--bt", type=int, default=65536)
+    p.add_argument("--d", type=int, default=1024)
+    p.add_argument("--f", type=int, default=2048)
+    p.add_argument("--pn", type=int, default=133120)
+    p.add_argument("--reps", type=int, default=3)
+    args = p.parse_args()
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (args.bt, args.d), jnp.bfloat16)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (args.pn,), 0, args.bt, jnp.int32)
+    wg = jax.random.normal(jax.random.PRNGKey(2), (args.d, args.f), jnp.bfloat16) * 0.02
+    wu = jax.random.normal(jax.random.PRNGKey(3), (args.d, args.f), jnp.bfloat16) * 0.02
+    wd = jax.random.normal(jax.random.PRNGKey(4), (args.f, args.d), jnp.bfloat16) * 0.02
+
+    a = jax.jit(lambda x, i: gemm_plain(x[i], wg, wu, wd))(x, idx)
+    for nm, kw in [
+        ("issue_first", {}),
+        ("compute_first", {"order": "compute_first"}),
+        ("cf_unroll", {"order": "compute_first", "unroll": True}),
+    ]:
+        b = jax.jit(lambda x, i, kw=kw: gemm_gathered(x, i, wg, wu, wd, **kw))(x, idx)
+        ok = np.allclose(np.asarray(a), np.asarray(b))
+        print(f"parity {nm}: {'OK' if ok else 'MISMATCH'}")
+
+    xs_pn = jax.jit(lambda x, i: x[i])(x, idx)  # PN-row input for the gemm arm
+
+    def make_loop(arm):
+        @jax.jit
+        def loop(x, xs_pn, idx):
+            def body(i, carry):
+                x, xs_pn, acc = carry
+                if arm == "gemm":
+                    ys = gemm_plain(xs_pn, wg, wu, wd)
+                elif arm == "gather_gemm":
+                    ys = gemm_gathered(x, idx, wg, wu, wd)
+                elif arm == "gg_compute_first":
+                    ys = gemm_gathered(x, idx, wg, wu, wd, order="compute_first")
+                elif arm == "gg_cf_unroll":
+                    ys = gemm_gathered(
+                        x, idx, wg, wu, wd, order="compute_first", unroll=True
+                    )
+                elif arm == "xla_total":
+                    ys = gemm_plain(x[idx], wg, wu, wd)
+                else:
+                    ys = None
+                if ys is not None:
+                    acc = acc + ys.astype(jnp.float32).sum()
+                x = jnp.where(jnp.isnan(acc), jnp.bfloat16(0), x)
+                xs_pn = jnp.where(jnp.isnan(acc), jnp.bfloat16(0), xs_pn)
+                return (x, xs_pn, acc)
+
+            x, xs_pn, acc = jax.lax.fori_loop(
+                0, ITERS, body, (x, xs_pn, x[0, 0].astype(jnp.float32))
+            )
+            return acc
+
+        return loop
+
+    results = {}
+    for arm in [
+        "control", "gemm", "gather_gemm", "gg_compute_first", "gg_cf_unroll",
+        "xla_total",
+    ]:
+        loop = make_loop(arm)
+        loop(x, xs_pn, idx).block_until_ready()
+        best = float("inf")
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            loop(x, xs_pn, idx).block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        results[arm] = best / ITERS * 1e3
+        print(f"{arm:16s}: {results[arm]:7.3f} ms/iter")
+
+    ctl = results["control"]
+    for arm in ["gemm", "gather_gemm", "gg_compute_first", "gg_cf_unroll", "xla_total"]:
+        print(f"{arm:16s}: net {results[arm] - ctl:7.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

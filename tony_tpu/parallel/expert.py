@@ -373,9 +373,15 @@ def _combine_gather_bwd(res, dy):
 
     ys, dest, sort_tok, gate_vals, gate_sorted = res
     K = gate_vals.shape[1]
-    dys = dy[sort_tok] * gate_sorted[:, None].astype(dy.dtype)
-    yc = ys[dest].reshape(gate_vals.shape[0], K, ys.shape[-1])
-    dgate = jnp.einsum("tkd,td->tk", yc.astype(jnp.float32), dy.astype(jnp.float32))
+    # one row gather serves both outputs: dys_raw = dy[sort_tok] feeds the
+    # gate-scaled cotangent AND the gate grad as a row-dot —
+    # ``dgate[t,k] = ys[dest[t,k]]·dy[t] = (ys ⊙ dys_raw).sum(-1)[dest[t,k]]``
+    # (sort_tok[dest[t,k]] == t) — replacing the former ys[dest] row gather
+    # + [N,D] einsum with a fusable elementwise-reduce + a scalar gather.
+    dys_raw = dy[sort_tok]
+    dys = dys_raw * gate_sorted[:, None].astype(dy.dtype)
+    dgate_sorted = (ys.astype(jnp.float32) * dys_raw.astype(jnp.float32)).sum(-1)
+    dgate = dgate_sorted[dest].reshape(gate_vals.shape)
     return (
         dys.astype(ys.dtype),
         np.zeros(dest.shape, jax.dtypes.float0),
