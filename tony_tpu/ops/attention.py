@@ -242,8 +242,8 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = True,
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int | None = None,
+    block_k: int | None = None,
     segment_ids: jax.Array | None = None,
     window: int = 0,
 ) -> jax.Array:
@@ -251,15 +251,25 @@ def flash_attention(
     H % Hkv == 0 (GQA handled inside the kernel), T % block == 0.
     ``segment_ids`` [B, T] confines attention within packed segments
     (training-shape only: Tq == Tk). ``window`` > 0: sliding-window band —
-    out-of-band k blocks are skipped entirely (no DMA, no flops)."""
+    out-of-band k blocks are skipped entirely (no DMA, no flops).
+    ``block_q``/``block_k`` default to the tuned module constants, shrunk
+    to divide the sequence lengths (``_block_sizes``)."""
     B, H, Tq, D = q.shape
     Hkv, Tk = k.shape[1], k.shape[2]
     if H % Hkv:
         raise ValueError(f"n_heads {H} must be divisible by n_kv_heads {Hkv}")
     if segment_ids is not None and Tq != Tk:
         raise ValueError(f"segment_ids requires Tq == Tk, got {Tq} vs {Tk}")
-    block_q = min(block_q, Tq)
-    block_k = min(block_k, Tk)
+    auto_bq, auto_bk = _block_sizes(Tq, Tk)
+    block_q = auto_bq if block_q is None else min(block_q, Tq)
+    block_k = auto_bk if block_k is None else min(block_k, Tk)
+    # awkward lengths (e.g. 257) make _block_sizes halve to degenerate
+    # blocks — take the XLA reference path rather than a laneless grid
+    if block_q < min(8, Tq) or block_k < min(128, Tk):
+        return attention_reference(
+            q, repeat_kv(k, H // Hkv), repeat_kv(v, H // Hkv),
+            causal=causal, segment_ids=segment_ids, window=window,
+        )
     if Tq % block_q or Tk % block_k:
         return attention_reference(
             q, repeat_kv(k, H // Hkv), repeat_kv(v, H // Hkv),
@@ -674,7 +684,26 @@ def _flash_bwd_impl(
 # per-row logsumexp; backward recomputes probabilities blockwise in VMEM (two
 # kernels: dq over q blocks, dk/dv over k blocks) — no T×T materialization.
 
-_BLOCK_Q, _BLOCK_K = 256, 256
+# bq 256 / bk 512: the r3 measured optimum on v5e — halving k-block count
+# beats 256/256 on EVERY bench preset, same-session A/Bs: llama-0.87B
+# 46.5→49.0% MFU, llama 2×8192 38.4→46.1%, moe 35.3→37.0%, BERT 34.5→37.7%.
+# (512/512 and bk 1024 fail to compile — VMEM; bq 128 is neutral.)
+# Env-overridable for per-hardware tuning; BASELINE.md records the ladder.
+_BLOCK_Q = int(os.environ.get("TONY_FLASH_BQ", "256"))
+_BLOCK_K = int(os.environ.get("TONY_FLASH_BK", "512"))
+
+
+def _block_sizes(Tq: int, Tk: int) -> tuple[int, int]:
+    """Largest blocks ≤ the configured defaults that DIVIDE the sequence
+    lengths (halving until they do). With bq ≠ bk defaults, a length like
+    768 divides 256 but not 512 — every kernel entry point must agree on
+    this rule or the grid reads padded garbage past the last block."""
+    bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
+    while bq > 1 and Tq % bq:
+        bq //= 2
+    while bk > 1 and Tk % bk:
+        bk //= 2
+    return bq, bk
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -686,7 +715,7 @@ def _flash_fwd(q, k, v, causal, window):
     from jax.ad_checkpoint import checkpoint_name
 
     Tq, Tk = q.shape[2], k.shape[2]
-    bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
+    bq, bk = _block_sizes(Tq, Tk)
     o, lse = _flash_fwd_lanes(q, k, v, causal, bq, bk, None, window)
     # Named so a remat policy can pin JUST the kernel outputs
     # (save_only_these_names("flash_o", "flash_lse")): the backward then
@@ -699,7 +728,7 @@ def _flash_fwd(q, k, v, causal, window):
 def _flash_bwd(causal, window, res, g):
     q, k, v, o, lse = res
     Tq, Tk = q.shape[2], k.shape[2]
-    bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
+    bq, bk = _block_sizes(Tq, Tk)
     return _flash_bwd_impl(q, k, v, o, lse, g, causal, bq, bk, None, window)
 
 
@@ -710,7 +739,7 @@ _flash_trainable.defvjp(_flash_fwd, _flash_bwd)
 def _flash_trainable_seg(q, k, v, seg, causal, window=0):
     """Packed-sequence variant: seg [B, T] int; cotangent for seg is float0."""
     B, H, Tq, D = q.shape
-    bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, k.shape[2])
+    bq, bk = _block_sizes(Tq, k.shape[2])
     return _flash_fwd_impl(q, k, v, causal, bq, bk, seg, window)[0]
 
 
@@ -718,7 +747,7 @@ def _flash_seg_fwd(q, k, v, seg, causal, window):
     from jax.ad_checkpoint import checkpoint_name
 
     Tq, Tk = q.shape[2], k.shape[2]
-    bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
+    bq, bk = _block_sizes(Tq, Tk)
     o, lse = _flash_fwd_lanes(q, k, v, causal, bq, bk, seg, window)
     o = checkpoint_name(o, "flash_o")
     lse = checkpoint_name(lse, "flash_lse")
@@ -730,7 +759,7 @@ def _flash_seg_bwd(causal, window, res, g):
 
     q, k, v, seg, o, lse = res
     Tq, Tk = q.shape[2], k.shape[2]
-    bq, bk = min(_BLOCK_Q, Tq), min(_BLOCK_K, Tk)
+    bq, bk = _block_sizes(Tq, Tk)
     dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, g, causal, bq, bk, seg, window)
     return dq, dk, dv, np.zeros(seg.shape, jax.dtypes.float0)
 
@@ -795,7 +824,10 @@ def mha(
         impl = "flash" if jax.default_backend() not in ("cpu",) else "reference"
     if impl == "flash":
         Tq, Tk = q.shape[2], k.shape[2]
-        if Tq % min(256, Tq) == 0 and Tk % min(256, Tk) == 0 and Tq >= 128:
+        bq, bk = _block_sizes(Tq, Tk)
+        # ragged lengths shrink the blocks; below 128 the kernel grid is
+        # lane-starved and the XLA reference path wins
+        if bq >= 128 and bk >= 128 and Tq >= 128:
             if segment_ids is not None:
                 if Tq != Tk:
                     raise ValueError(f"segment_ids requires Tq == Tk, got {Tq} vs {Tk}")
