@@ -157,6 +157,45 @@ class TestFlashAttentionInterpret:
             err = float(jnp.max(jnp.abs(a - b))) / scale
             assert err < 2e-4, f"{name} rel err {err}"
 
+    def test_block_sizes_shrink_to_divide(self):
+        # the tuned defaults (bq 256 / bk 512) must halve until they divide
+        # the sequence — a 768-long sequence divides 256 but not 512
+        assert A._block_sizes(768, 768) == (256, 256)
+        assert A._block_sizes(2048, 2048) == (min(A._BLOCK_Q, 2048), min(A._BLOCK_K, 2048))
+        assert A._block_sizes(512, 512) == (256, 512)
+        # awkward lengths bottom out small — flash_attention must then take
+        # the reference path, not launch a degenerate laneless grid
+        bq, bk = A._block_sizes(257, 257)
+        assert bq < 8  # degenerate → flash_attention takes the reference path
+
+    def test_awkward_length_falls_back_to_reference(self):
+        # T=257: _block_sizes degenerates; flash_attention must return the
+        # reference result (and not crash or mis-tile)
+        ks = [jax.random.fold_in(jax.random.PRNGKey(17), i) for i in range(3)]
+        q, k, v = (jax.random.normal(kk, (1, 2, 257, 64), jnp.float32) * 0.5 for kk in ks)
+        out = A.flash_attention(q, k, v, causal=True)
+        want = A.attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+    def test_bq_ne_bk_matches_reference(self):
+        # asymmetric blocks (the production default) through fwd AND bwd
+        q, k, v = self._qkv(T=512)
+        w = jnp.arange(q.shape[-1], dtype=jnp.float32)
+
+        def loss_flash(q, k, v):
+            return (A._flash_trainable(q, k, v, True) * w).sum()
+
+        def loss_ref(q, k, v):
+            return (A.attention_reference(q, k, v, causal=True) * w).sum()
+
+        assert A._block_sizes(512, 512) == (256, 512)  # exercising bq != bk
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), gf, gr):
+            scale = float(jnp.max(jnp.abs(b))) + 1e-9
+            err = float(jnp.max(jnp.abs(a - b))) / scale
+            assert err < 2e-4, f"{name} rel err {err}"
+
     def test_gqa_forward_matches_reference(self):
         B, H, Hkv, T, D = 1, 4, 2, 512, 64
         ks = [jax.random.fold_in(jax.random.PRNGKey(11), i) for i in range(3)]
