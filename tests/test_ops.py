@@ -158,11 +158,15 @@ class TestFlashAttentionInterpret:
             assert err < 2e-4, f"{name} rel err {err}"
 
     def test_block_sizes_shrink_to_divide(self):
-        # the tuned defaults (bq 256 / bk 512) must halve until they divide
-        # the sequence — a 768-long sequence divides 256 but not 512
-        assert A._block_sizes(768, 768) == (256, 256)
-        assert A._block_sizes(2048, 2048) == (min(A._BLOCK_Q, 2048), min(A._BLOCK_K, 2048))
-        assert A._block_sizes(512, 512) == (256, 512)
+        # invariants hold under any TONY_FLASH_BQ/BK retuning
+        for t in (768, 2048, 512, 640):
+            bq, bk = A._block_sizes(t, t)
+            assert t % bq == 0 and t % bk == 0
+            assert bq <= min(A._BLOCK_Q, t) and bk <= min(A._BLOCK_K, t)
+        if (A._BLOCK_Q, A._BLOCK_K) == (256, 512):  # stock defaults
+            # a 768-long sequence divides 256 but not 512 — bk must halve
+            assert A._block_sizes(768, 768) == (256, 256)
+            assert A._block_sizes(512, 512) == (256, 512)
         # awkward lengths bottom out small — flash_attention must then take
         # the reference path, not launch a degenerate laneless grid
         bq, bk = A._block_sizes(257, 257)
@@ -188,7 +192,8 @@ class TestFlashAttentionInterpret:
         def loss_ref(q, k, v):
             return (A.attention_reference(q, k, v, causal=True) * w).sum()
 
-        assert A._block_sizes(512, 512) == (256, 512)  # exercising bq != bk
+        if (A._BLOCK_Q, A._BLOCK_K) == (256, 512):  # stock defaults
+            assert A._block_sizes(512, 512) == (256, 512)  # exercising bq != bk
         gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
         gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
         for name, a, b in zip("dq dk dv".split(), gf, gr):

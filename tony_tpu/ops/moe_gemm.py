@@ -19,9 +19,12 @@ in ONE VMEM pass per row tile:
   expert's run of tiles, flushing once per expert (revisited out blocks).
 
 No counterpart in the reference (its MoE support is framework-side; the
-equivalent fused kernels live in vendor libraries). VMEM budget at the
-default tile (D=1024, F=2048, bf16 weights): fwd ≈ 45 MB, bwd ≈ 90 MB —
-measured fine on a v5e's 128 MB.
+equivalent fused kernels live in vendor libraries). VMEM is dominated by
+the per-expert weight slabs (3·D·F bf16 ≈ 12.6 MB at D=1024/F=2048,
+double-buffered by the pipeline) plus, in the backward, the f32 dW
+accumulators (3·D·F·4 ≈ 25 MB); the row-tile buffers scale with TILE_M
+(~0.5 MB at the default 128). Measured fine on a v5e's 128 MB at tiles
+64–512.
 """
 
 from __future__ import annotations
@@ -35,10 +38,15 @@ import numpy as np
 
 _INTERPRET = os.environ.get("TONY_PALLAS_INTERPRET", "") == "1"
 
-TILE_M = 256      # fwd row-tile; group sizes are padded to multiples of this
-                  # (512 measured 0.5 MFU pt slower end-to-end on the moe bench)
-TILE_M_BWD = 256  # bwd row-tile (more VMEM-hungry: f32 dW accumulators);
-                  # must divide TILE_M so the padded group spans stay aligned
+# fwd row-tile; group sizes are padded to multiples of this. 128 is the r3
+# measured optimum on v5e at the bench geometry (same-session ladder:
+# 64→36.2%, 96→38.1%, 128→38.4%, 256→36.9%, 512→36.8% active MFU — less
+# group-padding waste and tighter pipelining beat bigger GEMM tiles).
+# Env-overridable for per-hardware tuning; BASELINE.md records the ladder.
+TILE_M = int(os.environ.get("TONY_MOE_TILE", "128"))
+# bwd row-tile (more VMEM-hungry: f32 dW accumulators); must divide TILE_M
+# when smaller (the backward splits fwd tiles into bwd tiles)
+TILE_M_BWD = int(os.environ.get("TONY_MOE_TILE_BWD", "128"))
 
 
 def _silu(x):
@@ -218,6 +226,12 @@ def _vjp_fwd(xs, wg, wu, wd, tile_group, tile):
 def _vjp_bwd(tile, res, dy):
     xs, wg, wu, wd, tile_group = res
     bwd_tile = tile
+    if tile > TILE_M_BWD and tile % TILE_M_BWD:
+        raise ValueError(
+            f"TONY_MOE_TILE={tile} is larger than but not a multiple of "
+            f"TONY_MOE_TILE_BWD={TILE_M_BWD}: the backward cannot split the "
+            "padded group spans — pick a multiple (or set them equal)"
+        )
     if tile > TILE_M_BWD and tile % TILE_M_BWD == 0:
         # finer backward tiling: same group spans (TILE_M_BWD divides the
         # fwd tile), each fwd tile simply splits into tile/TILE_M_BWD rows
