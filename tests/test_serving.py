@@ -152,14 +152,21 @@ class TestRaggedDecode:
         from tony_tpu.models.serving import _masked_slot_attention
 
         S, H, Hkv, maxT, Dh = 3, 4, 2, 256, 128
-        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        ks = jax.random.split(jax.random.PRNGKey(3), 5)
         q = jax.random.normal(ks[0], (S, H, Dh), jnp.float32)
         ck = jax.random.normal(ks[1], (S, Hkv, maxT, Dh), jnp.float32)
         cv = jax.random.normal(ks[2], (S, Hkv, maxT, Dh), jnp.float32)
-        lengths = jnp.array([1, 129, 250], jnp.int32)
+        cur_k = jax.random.normal(ks[3], (S, Hkv, Dh), jnp.float32)
+        cur_v = jax.random.normal(ks[4], (S, Hkv, Dh), jnp.float32)
+        # lengths are CACHE-only counts; 0 = empty cache (self-attention only)
+        lengths = jnp.array([0, 129, 250], jnp.int32)
         for window in (0, 128):
-            got = ragged_decode_attention(q, ck, cv, lengths, window=window)
-            want = _masked_slot_attention(q, ck, cv, lengths, H // Hkv, window=window)
+            got = ragged_decode_attention(
+                q, ck, cv, lengths, cur_k=cur_k, cur_v=cur_v, window=window
+            )
+            want = _masked_slot_attention(
+                q, ck, cv, lengths, H // Hkv, window=window, cur_k=cur_k, cur_v=cur_v
+            )
             np.testing.assert_allclose(
                 np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5,
                 err_msg=f"window={window}",
@@ -201,7 +208,12 @@ class TestMixtralServing:
     def test_mixtral_continuous_batcher(self):
         from tony_tpu.models import mixtral
 
-        mcfg = dataclasses.replace(mixtral.MIXTRAL_TINY, max_seq=64)
+        # f32: the contract here is engine PLUMBING ≡ generate() (slots,
+        # admission, chunking, retirement). In bf16 a batched [S,1,D]
+        # projection differs from the batch-1 one by 1 ulp (deterministic
+        # XLA tiling), and the MoE router amplifies that into a token flip
+        # on knife-edge prompts — rounding luck, not a plumbing property.
+        mcfg = dataclasses.replace(mixtral.MIXTRAL_TINY, max_seq=64, dtype="float32")
         params = mixtral.init(KEY, mcfg)
         eng = ContinuousBatcher(params, mcfg, num_slots=2, max_len=64)
         prompts = {i: jax.random.randint(jax.random.PRNGKey(10 + i), (1, 4), 0, mcfg.vocab_size)

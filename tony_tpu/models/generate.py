@@ -84,6 +84,46 @@ def _cached_attention(q, ck, cv, length, n_rep, window: int = 0):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(cv.dtype), cv)
 
 
+def _masked_slot_attention(q1, ck, cv, lengths, n_rep, window: int = 0,
+                           *, cur_k, cur_v):
+    """Single-token decode attention over read-only caches (shared by the
+    serving engine's bucketed path and ``generate()``'s decode steps — ONE
+    implementation, so the two paths cannot diverge in attention MATH;
+    note bf16 projections can still differ by 1 ulp between batch sizes
+    from XLA tiling, which is why MoE greedy-parity tests run f32).
+
+    q1 [S, H, Dh] vs per-slot caches [S, Hkv, maxT, Dh]. ``lengths`` counts
+    CACHE positions only; the current token's K/V arrive separately
+    (``cur_k``/``cur_v`` [S, Hkv, Dh]) and its score is appended before the
+    softmax — the big cache is READ-ONLY here, so callers write it once per
+    step with a tiny scatter instead of carrying a full cache copy through
+    their layer scans (the r3-cont serving fix: the copy cost −32% decode
+    tok/s at 64 slots). Slot s attends cache positions
+    [max(0, len_s + 1 - window), len_s) plus itself (always in-window)."""
+    from tony_tpu.ops.attention import repeat_kv
+
+    S, H, Dh = q1.shape
+    maxT = ck.shape[2]
+    ckr = repeat_kv(ck, n_rep)
+    cvr = repeat_kv(cv, n_rep)
+    s = jnp.einsum("shd,shkd->shk", q1, ckr, preferred_element_type=jnp.float32)
+    s = s * (Dh ** -0.5)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (S, 1, maxT), 2)
+    hi = lengths[:, None, None]
+    ok = idx < hi
+    if window > 0:
+        ok = jnp.logical_and(ok, idx >= hi + 1 - window)
+    s = jnp.where(ok, s, -1e30)
+    ckr1 = repeat_kv(cur_k[:, :, None], n_rep)[:, :, 0]          # [S, H, Dh]
+    cvr1 = repeat_kv(cur_v[:, :, None], n_rep)[:, :, 0]
+    s_self = jnp.einsum(
+        "shd,shd->sh", q1, ckr1, preferred_element_type=jnp.float32
+    )[..., None] * (Dh ** -0.5)
+    p = jax.nn.softmax(jnp.concatenate([s, s_self], axis=-1), axis=-1)
+    o = jnp.einsum("shk,shkd->shd", p[..., :maxT].astype(cvr.dtype), cvr)
+    return o + p[..., maxT:].astype(cvr1.dtype) * cvr1
+
+
 def _ffn_with_cache(h, lp, cfg: LlamaConfig):
     """Decode-side FFN: dense SwiGLU, or the MoE mixture when the layer
     params carry a router (Mixtral family).
@@ -138,9 +178,19 @@ def _block_with_cache(x, lp, ck, cv, length, cos, sin, cfg: LlamaConfig):
     q = L.apply_rope(q, cos, sin, positions=positions)
     k = L.apply_rope(k, cos, sin, positions=positions)
 
-    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, length, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, length, 0))
-    o = _cached_attention(q, ck, cv, length, H // Hkv, window=cfg.sliding_window)
+    if Tq == 1:
+        # decode: the cache stays read-only (same split attention math as
+        # the serving engine — shared _masked_slot_attention) and the
+        # caller's post-scan dynamic_update_slice is the only cache write
+        o = _masked_slot_attention(
+            q[:, :, 0], ck, cv, jnp.broadcast_to(length, (B,)), H // Hkv,
+            window=cfg.sliding_window,
+            cur_k=k[:, :, 0].astype(ck.dtype), cur_v=v[:, :, 0].astype(cv.dtype),
+        )[:, :, None]
+    else:
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, length, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, length, 0))
+        o = _cached_attention(q, ck, cv, length, H // Hkv, window=cfg.sliding_window)
     o = o.transpose(0, 2, 1, 3).reshape(B, Tq, H * Dh)
     x = x + _mm(o, lp["wo"])
     h = L.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)

@@ -36,13 +36,15 @@ _INTERPRET = os.environ.get("TONY_PALLAS_INTERPRET", "") == "1"
 CHUNK = 128  # cache positions streamed per DMA
 
 
-def _kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, *, chunk, window, n_rep):
+def _kernel(len_ref, q_ref, ck_ref, cv_ref, k_hbm, v_hbm, o_ref, *, chunk, window, n_rep):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     s_i = pl.program_id(0)
-    length = len_ref[s_i]  # valid positions incl. current token
-    lo = jnp.maximum(length - window, 0) if window > 0 else jnp.int32(0)
+    length = len_ref[s_i]  # CACHE positions (current token arrives via ck/cv refs)
+    # the current token sits at position `length`; cache band is
+    # (length - window, length) — the self term is always in-window
+    lo = jnp.maximum(length + 1 - window, 0) if window > 0 else jnp.int32(0)
     c0 = lo // chunk
     c1 = pl.cdiv(length, chunk)
     Dh = q_ref.shape[-1]
@@ -104,6 +106,19 @@ def _kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, *, chunk, window, n_rep):
         l0 = jnp.zeros((Hkv, n_rep, 1), jnp.float32)
         acc0 = jnp.zeros((Hkv, n_rep, Dh), jnp.float32)
         m, l, acc = jax.lax.fori_loop(c0, c1, step, (m0, l0, acc0))
+
+        # fold the current token (position `length`) as a final online step:
+        # the cache stays read-only and a zero-length slot still normalizes
+        k_cur = ck_ref[0].astype(jnp.float32)                  # [Hkv, Dh]
+        v_cur = cv_ref[0].astype(jnp.float32)
+        s_self = jax.lax.dot_general(   # [Hkv, n_rep] (q pre-scaled)
+            q, k_cur, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        )[..., None]
+        m_new = jnp.maximum(m, s_self)
+        alpha = jnp.exp(m - m_new)
+        p_self = jnp.exp(s_self - m_new)
+        l = l * alpha + p_self
+        acc = acc * alpha + p_self * v_cur[:, None, :]
         o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
     pl.run_scoped(
@@ -117,17 +132,21 @@ def _kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, *, chunk, window, n_rep):
 @functools.partial(jax.jit, static_argnames=("window", "chunk"))
 def ragged_decode_attention(
     q: jax.Array,        # [S, H, Dh] — one new token per slot
-    ck: jax.Array,       # [S, Hkv, maxT, Dh]
+    ck: jax.Array,       # [S, Hkv, maxT, Dh] — read-only cache
     cv: jax.Array,
-    lengths: jax.Array,  # [S] int32 — valid positions INCLUDING current token
+    lengths: jax.Array,  # [S] int32 — CACHE positions (excluding current token)
     *,
+    cur_k: jax.Array,    # [S, Hkv, Dh] — current token's K (not yet cached)
+    cur_v: jax.Array,
     window: int = 0,
     chunk: int = CHUNK,
 ) -> jax.Array:
     """Per-slot ragged cache attention; returns o [S, H, Dh].
 
-    Slot s attends cache positions [max(0, len_s - window), len_s) — the
-    caller must already have written the current token's K/V at len_s - 1.
+    Slot s attends cache positions [max(0, len_s + 1 - window), len_s) plus
+    the current token (its K/V arrive via ``cur_k``/``cur_v``, folded as a
+    final online-softmax step) — the cache is never written here, so the
+    engine can defer the cache write to one small scatter per step.
     HBM traffic per step is Σ_s ceil(len_s/chunk)·chunk positions.
     """
     from jax.experimental import pallas as pl
@@ -145,16 +164,18 @@ def ragged_decode_attention(
         grid=(S,),
         in_specs=[
             pl.BlockSpec((1, Hkv, n_rep, Dh), lambda s, L: (s, 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, Dh), lambda s, L: (s, 0, 0)),
+            pl.BlockSpec((1, Hkv, Dh), lambda s, L: (s, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),   # ck stays in HBM
             pl.BlockSpec(memory_space=pl.ANY),   # cv stays in HBM
         ],
         out_specs=pl.BlockSpec((1, Hkv, n_rep, Dh), lambda s, L: (s, 0, 0, 0)),
     )
 
-    def kern(len_ref, q_ref, k_hbm, v_hbm, o_ref):
+    def kern(len_ref, q_ref, ck_ref, cv_ref, k_hbm, v_hbm, o_ref):
         s_i = pl.program_id(0)
         _kernel(
-            len_ref, q_ref,
+            len_ref, q_ref, ck_ref, cv_ref,
             k_hbm.at[pl.ds(s_i, 1)],
             v_hbm.at[pl.ds(s_i, 1)],
             o_ref, chunk=chunk, window=window, n_rep=n_rep,
@@ -173,5 +194,5 @@ def ragged_decode_attention(
             bytes_accessed=(ck.size + cv.size) * ck.dtype.itemsize // 4,
             transcendentals=S * H * maxT,
         ),
-    )(lengths, qg, ck, cv)
+    )(lengths, qg, cur_k, cur_v, ck, cv)
     return o.reshape(S, H, Dh)

@@ -48,6 +48,23 @@ TILE_M = int(os.environ.get("TONY_MOE_TILE", "128"))
 # when smaller (the backward splits fwd tiles into bwd tiles)
 TILE_M_BWD = int(os.environ.get("TONY_MOE_TILE_BWD", "128"))
 
+# fail at import, not deep inside Mosaic lowering or the first backward
+for _name, _t in (("TONY_MOE_TILE", TILE_M), ("TONY_MOE_TILE_BWD", TILE_M_BWD)):
+    if _t < 8 or _t % 8:
+        raise ValueError(f"{_name}={_t}: row tiles must be positive multiples of 8")
+if TILE_M > TILE_M_BWD and TILE_M % TILE_M_BWD:
+    raise ValueError(
+        f"TONY_MOE_TILE={TILE_M} is larger than but not a multiple of "
+        f"TONY_MOE_TILE_BWD={TILE_M_BWD}: the backward cannot split the "
+        "padded group spans — pick a multiple (or set them equal)"
+    )
+if TILE_M_BWD > TILE_M:
+    raise ValueError(
+        f"TONY_MOE_TILE_BWD={TILE_M_BWD} > TONY_MOE_TILE={TILE_M} has no "
+        "effect (the backward never uses a coarser tile than the forward) — "
+        "raise TONY_MOE_TILE instead"
+    )
+
 
 def _silu(x):
     return x * jax.nn.sigmoid(x)
@@ -226,13 +243,13 @@ def _vjp_fwd(xs, wg, wu, wd, tile_group, tile):
 def _vjp_bwd(tile, res, dy):
     xs, wg, wu, wd, tile_group = res
     bwd_tile = tile
-    if tile > TILE_M_BWD and tile % TILE_M_BWD:
-        raise ValueError(
-            f"TONY_MOE_TILE={tile} is larger than but not a multiple of "
-            f"TONY_MOE_TILE_BWD={TILE_M_BWD}: the backward cannot split the "
-            "padded group spans — pick a multiple (or set them equal)"
-        )
-    if tile > TILE_M_BWD and tile % TILE_M_BWD == 0:
+    if tile > TILE_M_BWD:
+        if tile % TILE_M_BWD:  # import checks cover defaults; tile is a call arg
+            raise ValueError(
+                f"tile={tile} is larger than but not a multiple of "
+                f"TONY_MOE_TILE_BWD={TILE_M_BWD}: the backward cannot split "
+                "the padded group spans — pick a multiple (or set them equal)"
+            )
         # finer backward tiling: same group spans (TILE_M_BWD divides the
         # fwd tile), each fwd tile simply splits into tile/TILE_M_BWD rows
         tile_group = jnp.repeat(tile_group, tile // TILE_M_BWD)
