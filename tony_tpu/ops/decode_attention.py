@@ -6,9 +6,11 @@ longest bucket (VERDICT r2 weak #3 — serving is KV-bandwidth-bound at long
 context). This kernel reads each slot's cache RAGGED: slot s streams only
 ``ceil(lengths[s]/chunk)`` chunks from HBM through a double-buffered VMEM
 pipeline, so the step's KV traffic is Σ_s len_s instead of S·max(len).
-Sliding-window models start at ``max(0, len - window)`` — decode reads
-window-sized cache, closing the r2 gap where windowed models still read
-the full bucket.
+``lengths`` counts CACHE positions only — the current token's K/V arrive
+via ``cur_k``/``cur_v`` and fold in as a final online-softmax step (the
+r3-cont read-only-cache contract). Sliding-window models read cache from
+``max(0, len + 1 - window)`` — window-sized reads, closing the r2 gap
+where windowed models still read the full bucket.
 
 Grid is (S,): one instance per slot streams [Hkv, chunk, Dh] K/V SLABS
 (all kv heads per DMA — 8× bigger transfers than a per-head grid, which

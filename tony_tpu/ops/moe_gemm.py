@@ -58,12 +58,10 @@ if TILE_M > TILE_M_BWD and TILE_M % TILE_M_BWD:
         f"TONY_MOE_TILE_BWD={TILE_M_BWD}: the backward cannot split the "
         "padded group spans — pick a multiple (or set them equal)"
     )
-if TILE_M_BWD > TILE_M:
-    raise ValueError(
-        f"TONY_MOE_TILE_BWD={TILE_M_BWD} > TONY_MOE_TILE={TILE_M} has no "
-        "effect (the backward never uses a coarser tile than the forward) — "
-        "raise TONY_MOE_TILE instead"
-    )
+# a bwd tile coarser than the fwd tile can never apply (the backward only
+# ever SPLITS fwd tiles) — clamp rather than raise so forward-only paths
+# (e.g. Mixtral serving prefill) keep working under a stale env tuning
+TILE_M_BWD = min(TILE_M_BWD, TILE_M)
 
 
 def _silu(x):

@@ -98,7 +98,11 @@ def int8_matmul(
     # addition to even tiling — sub-tile blocks would fail Mosaic lowering
     # on hardware even though the interpreter accepts them (batch-1 decode,
     # tiny K, etc. route to XLA, which handles small shapes fine).
-    if (M % bm or N % bn or K % bk or bm % 8 or bk % 128 or bn % 128):
+    # decode-sized row counts underfill the kernel's M tile: the XLA
+    # reference (dequant fused into the einsum) measured faster at M ≤ 32 on
+    # BOTH bench geometries (8B-geometry chunk 233→181 ms, 1B 181→171 ms —
+    # r3-cont); the kernel is the prefill/training-sized path
+    if M < 64 or (M % bm or N % bn or K % bk or bm % 8 or bk % 128 or bn % 128):
         return int8_matmul_ref(x, qt)
     n_k = K // bk
 
