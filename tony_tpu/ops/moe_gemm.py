@@ -58,10 +58,9 @@ if TILE_M > TILE_M_BWD and TILE_M % TILE_M_BWD:
         f"TONY_MOE_TILE_BWD={TILE_M_BWD}: the backward cannot split the "
         "padded group spans — pick a multiple (or set them equal)"
     )
-# a bwd tile coarser than the fwd tile can never apply (the backward only
-# ever SPLITS fwd tiles) — clamp rather than raise so forward-only paths
-# (e.g. Mixtral serving prefill) keep working under a stale env tuning
-TILE_M_BWD = min(TILE_M_BWD, TILE_M)
+# NOTE: TILE_M_BWD > TILE_M is legal — it simply never applies for calls at
+# the default fwd tile (the backward only SPLITS fwd tiles), but a caller
+# passing an explicitly larger ``tile=`` still gets the coarser bwd split.
 
 
 def _silu(x):
