@@ -78,13 +78,13 @@ class TestContinuousBatching:
                 assert lengths[s] > 0, "re-admitted slot lost its length"
         r1_slot = next(req.slot for req in eng.running.values())
         eng.run()
-        # full drain: one more step flushes the remaining retirement. (Only
-        # JUST-retired slots are zeroed — a long-idle slot's length regrows
-        # +1 per decode step from its last zeroing, which is the bounded,
-        # pre-existing idle-slot behavior.)
+        # full drain: one more step flushes the remaining retirement; idle
+        # slots stay pinned at length 0 (no +1 regrowth)
         eng.step()
         assert not eng._retired_slots
-        assert np.asarray(eng.cache.lengths)[r1_slot] == 0
+        lengths = np.asarray(eng.cache.lengths)
+        assert lengths[r1_slot] == 0
+        assert all(lengths[s] == 0 for s in range(2) if s not in eng.running)
         assert len(eng.done[r1]) == 20
 
     def test_staggered_submission(self):

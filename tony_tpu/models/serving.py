@@ -138,7 +138,13 @@ def _decode_one(
 
     ks = jax.vmap(write_slot, in_axes=(1, 1, 0), out_axes=1)(cache.k, ks_new, pos)
     vs = jax.vmap(write_slot, in_axes=(1, 1, 0), out_axes=1)(cache.v, vs_new, pos)
-    return nxt, SlotCache(ks, vs, jnp.minimum(cache.lengths + 1, maxT))
+    # idle slots (length 0 — flushed retirements / never admitted) stay at 0
+    # instead of regrowing +1 per step: their stale cache never re-enters
+    # the ragged kernel's Σ len_s (active slots always have length ≥ 1)
+    new_len = jnp.where(
+        cache.lengths > 0, jnp.minimum(cache.lengths + 1, maxT), 0
+    )
+    return nxt, SlotCache(ks, vs, new_len)
 
 
 decode_step = functools.partial(

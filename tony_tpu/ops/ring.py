@@ -65,8 +65,9 @@ def default_interpret():
 _RING_BQ = int(os.environ.get("TONY_RING_BQ", "256"))
 _RING_BK = int(os.environ.get("TONY_RING_BK", "256"))
 for _name, _b in (("TONY_RING_BQ", _RING_BQ), ("TONY_RING_BK", _RING_BK)):
-    if _b < 8 or _b % 8:  # fail at import, not deep inside a shard_map trace
-        raise ValueError(f"{_name}={_b}: ring blocks must be multiples of 8")
+    if _b < 8:  # fail at import, not deep inside a shard_map trace; the value
+        # is a CAP on the block search, so any integer ≥ 8 is usable
+        raise ValueError(f"{_name}={_b}: ring block caps must be >= 8")
 
 
 def _pick_block(Tl: int, cap: int = 256) -> int:
