@@ -190,9 +190,12 @@ class TestRaggedDecode:
         cur_v = jax.random.normal(ks[4], (S, Hkv, Dh), jnp.float32)
         # lengths are CACHE-only counts; 0 = empty cache (self-attention only)
         lengths = jnp.array([0, 129, 250], jnp.int32)
+        # chunk=128 keeps the MULTI-chunk DMA pipeline under test (length 250
+        # → 2 slabs; the default 256 would make every slot single-slab here)
         for window in (0, 128):
             got = ragged_decode_attention(
-                q, ck, cv, lengths, cur_k=cur_k, cur_v=cur_v, window=window
+                q, ck, cv, lengths, cur_k=cur_k, cur_v=cur_v, window=window,
+                chunk=128,
             )
             want = _masked_slot_attention(
                 q, ck, cv, lengths, H // Hkv, window=window, cur_k=cur_k, cur_v=cur_v

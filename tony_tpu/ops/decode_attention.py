@@ -35,7 +35,13 @@ import jax.numpy as jnp
 
 _INTERPRET = os.environ.get("TONY_PALLAS_INTERPRET", "") == "1"
 
-CHUNK = 128  # cache positions streamed per DMA
+# cache positions streamed per DMA slab; 256 measured best on v5e (r3-cont
+# ladder at 8×2048-cache slots: 128→533, 256→554, 512→531 tok/s) — bigger
+# slabs amortize per-DMA overhead until VMEM pressure bites. Env-tunable;
+# shrunk by halving to divide the cache length.
+CHUNK = int(os.environ.get("TONY_DECODE_CHUNK", "256"))
+if CHUNK < 8:  # fail at import, not inside a jit trace
+    raise ValueError(f"TONY_DECODE_CHUNK={CHUNK}: DMA slab must be >= 8 positions")
 
 
 def _kernel(len_ref, q_ref, ck_ref, cv_ref, k_hbm, v_hbm, o_ref, *, chunk, window, n_rep):
@@ -157,8 +163,11 @@ def ragged_decode_attention(
     S, H, Dh = q.shape
     Hkv, maxT = ck.shape[1], ck.shape[2]
     n_rep = H // Hkv
-    if maxT % chunk:
-        raise ValueError(f"cache max_len {maxT} must be a chunk multiple ({chunk})")
+    chunk = min(chunk, maxT)
+    while chunk > 8 and maxT % chunk:  # shrink to divide (cf. _block_sizes)
+        chunk //= 2
+    if maxT % chunk:  # floor at 8: a 1-position slab would be a perf cliff
+        raise ValueError(f"cache max_len {maxT} has no slab size >= 8 that divides it")
     qg = q.reshape(S, Hkv, n_rep, Dh)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
