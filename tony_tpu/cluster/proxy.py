@@ -32,6 +32,7 @@ class ProxyServer:
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((bind_host, local_port))
         self._listener.listen(16)
+        self._bind_host = bind_host
         self.local_port: int = self._listener.getsockname()[1]
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop, name="proxy-accept", daemon=True)
@@ -117,10 +118,27 @@ class ProxyServer:
 
     def stop(self) -> None:
         self._stop.set()
+        # close() alone does not wake a thread parked in accept(2) on this
+        # platform: shutdown() the listener first (wakes accept with EINVAL on
+        # Linux), and nudge with a throwaway self-connect in case the runtime
+        # swallowed the shutdown (e.g. listener already mid-teardown).
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            # wildcard binds aren't dialable addresses — nudge via loopback
+            host = self._bind_host if self._bind_host not in ("", "0.0.0.0", "::") else "127.0.0.1"
+            nudge = socket.create_connection((host, self.local_port), timeout=1)
+            nudge.close()
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
             pass
+        if self._thread.is_alive() and self._thread is not threading.current_thread():
+            self._thread.join(timeout=10)
         with self._lock:
             conns, self._conns = self._conns, set()
         for s in conns:

@@ -264,8 +264,12 @@ def flash_attention(
     block_q = auto_bq if block_q is None else min(block_q, Tq)
     block_k = auto_bk if block_k is None else min(block_k, Tk)
     # awkward lengths (e.g. 257) make _block_sizes halve to degenerate
-    # blocks — take the XLA reference path rather than a laneless grid
-    if block_q < min(8, Tq) or block_k < min(128, Tk):
+    # blocks — take the XLA reference path rather than a laneless grid.
+    # Non-8-multiple blocks (a 300-long seq reaching the kernel as one
+    # block) are a Mosaic sublane-alignment lowering risk the interpreter
+    # won't catch — route them to the reference path too.
+    if (block_q < min(8, Tq) or block_k < min(128, Tk)
+            or block_q % 8 or block_k % 8):
         return attention_reference(
             q, repeat_kv(k, H // Hkv), repeat_kv(v, H // Hkv),
             causal=causal, segment_ids=segment_ids, window=window,
@@ -691,6 +695,10 @@ def _flash_bwd_impl(
 # Env-overridable for per-hardware tuning; BASELINE.md records the ladder.
 _BLOCK_Q = int(os.environ.get("TONY_FLASH_BQ", "256"))
 _BLOCK_K = int(os.environ.get("TONY_FLASH_BK", "512"))
+if _BLOCK_Q < 8 or _BLOCK_Q % 8:
+    raise ValueError(f"TONY_FLASH_BQ must be a multiple of 8 >= 8, got {_BLOCK_Q}")
+if _BLOCK_K < 128 or _BLOCK_K % 128:
+    raise ValueError(f"TONY_FLASH_BK must be a multiple of 128 >= 128, got {_BLOCK_K}")
 
 
 def _block_sizes(Tq: int, Tk: int) -> tuple[int, int]:
@@ -703,6 +711,15 @@ def _block_sizes(Tq: int, Tk: int) -> tuple[int, int]:
         bq //= 2
     while bk > 1 and Tk % bk:
         bk //= 2
+    # Mosaic sublane alignment: a non-8-multiple block (Tq=132 → bq=132
+    # divides but can't lower cleanly) is a hardware lowering risk. Degrade
+    # it to 1 so EVERY caller's small-block fallback gate — including the
+    # custom_vjp training entry points, which don't re-check alignment —
+    # routes such shapes to the XLA reference path.
+    if bq % 8:
+        bq = 1
+    if bk % 8:
+        bk = 1
     return bq, bk
 
 

@@ -156,6 +156,12 @@ def ragged_decode_attention(
     final online-softmax step) — the cache is never written here, so the
     engine can defer the cache write to one small scatter per step.
     HBM traffic per step is Σ_s ceil(len_s/chunk)·chunk positions.
+
+    PRECONDITION: ``lengths[s] < maxT`` for every slot whose output is
+    consumed. At ``lengths == maxT`` (only reachable via the engine's
+    clamped write position for retired-not-yet-flushed slots) position
+    maxT-1 is attended twice — once as stale cache, once as the current
+    token — and the result is garbage the caller must discard.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
