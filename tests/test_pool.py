@@ -82,8 +82,12 @@ class TestPoolModel:
         a = pool.allocate("app", "worker", 0, 3 * 1024**3, 1, 0)
         b = pool.allocate("app", "worker", 1, 3 * 1024**3, 1, 0)
         assert {a["node"], b["node"]} == {"n0", "n1"}
-        with pytest.raises(AllocationError):
-            pool.allocate("app", "worker", 2, 3 * 1024**3, 1, 0)
+        # transient shortage (capacity busy, ask feasible) now WAITS instead
+        # of failing — AllocationError is reserved for never-fits asks
+        got = pool.allocate("app", "worker", 2, 3 * 1024**3, 1, 0)
+        assert got.get("wait") is True
+        with pytest.raises(AllocationError, match="memory"):
+            pool.allocate("app", "worker", 2, 5 * 1024**3, 1, 0)  # > any host
 
     def test_chips_from_one_node_only(self, pool):
         pool.register_node(
@@ -144,9 +148,9 @@ class TestPoolModel:
             time.sleep(0.02)
         assert exited == {got["id"]: constants.EXIT_NODE_LOST}
         assert not node.alive
-        # a dead node takes no new work
-        with pytest.raises(AllocationError):
-            pool.allocate("app", "worker", 1, 1024, 1, 0)
+        # a dead node takes no new work; a KNOWN node may come back
+        # (re-register), so the ask waits rather than failing the job
+        assert pool.allocate("app", "worker", 1, 1024, 1, 0).get("wait") is True
         # and a late heartbeat from it is told to re-register
         assert pool.node_heartbeat("n0") == {"unknown_node": True}
 

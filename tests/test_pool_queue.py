@@ -1,0 +1,390 @@
+"""Pool multi-tenancy: queue admission, shares, priority, preemption.
+
+The reference submits into YARN capacity queues (`tony.application.queue`,
+SURVEY.md §2.1 config keys, §3.1 ApplicationSubmissionContext): jobs WAIT for
+capacity instead of failing, FIFO within a queue, per-queue capacity shares,
+priority ordering, optional preemption. This file tests the rebuild's analog
+at both levels: the PoolService admission scheduler directly, and the full
+client → AM → agent spine with two jobs racing one job's worth of capacity.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.cluster.client import Client
+from tony_tpu.cluster.pool import PoolService, RemoteResourceManager, parse_queue_spec
+from tony_tpu.cluster.resources import AllocationError, AllocationPending, Resources
+from tony_tpu.cluster.session import JobStatus
+
+from tests.test_pool import (
+    FAST,
+    SECRET,
+    pool_conf,
+    register_cpu_node,
+    spawn_agent,
+)
+
+GB = 1024**3
+
+
+# ---------------------------------------------------------------------------
+# Unit: queue-spec parsing
+# ---------------------------------------------------------------------------
+class TestParseQueueSpec:
+    def test_basic(self):
+        assert parse_queue_spec("prod=0.7,dev=0.3") == {"prod": 0.7, "dev": 0.3}
+
+    def test_default(self):
+        assert parse_queue_spec("") == {"default": 1.0}
+
+    def test_bad_share(self):
+        with pytest.raises(ValueError, match="share"):
+            parse_queue_spec("prod=1.5")
+        with pytest.raises(ValueError, match="share"):
+            parse_queue_spec("prod=abc")
+
+    def test_oversubscribed_shares_rejected(self):
+        # guarantees cannot oversubscribe the pool (YARN rejects >100% too)
+        with pytest.raises(ValueError, match="oversubscribe"):
+            parse_queue_spec("prod=0.9,dev=0.9")
+        with pytest.raises(ValueError, match="oversubscribe"):
+            PoolService(secret=SECRET, queues={"a": 0.8, "b": 0.8})
+
+
+# ---------------------------------------------------------------------------
+# Unit: admission scheduler (direct PoolService calls, no RPC)
+# ---------------------------------------------------------------------------
+def make_pool(**kw):
+    svc = PoolService(heartbeat_interval_ms=100, max_missed_heartbeats=3,
+                      secret=SECRET, **kw)
+    return svc
+
+
+class TestQueueAdmission:
+    def test_second_app_waits_then_admits(self):
+        svc = make_pool()
+        register_cpu_node(svc, "n0")  # 4 GB
+        svc.register_app("app1", memory_bytes=3 * GB, vcores=1)
+        got = svc.allocate("app1", "worker", 0, 3 * GB, 1, 0)
+        assert got["node"] == "n0"
+        # second tenant: feasible but the pool is busy → queued, NOT failed
+        svc.register_app("app2", memory_bytes=3 * GB, vcores=1)
+        wait = svc.allocate("app2", "worker", 0, 3 * GB, 1, 0)
+        assert wait.get("wait") is True and wait["queue"] == "default"
+        st = svc.pool_status()
+        assert [w["app_id"] for w in st["queues"]["default"]["waiting"]] == ["app2"]
+        # first app finishes → second admits and allocates
+        svc.release_all("app1")
+        got2 = svc.allocate("app2", "worker", 0, 3 * GB, 1, 0)
+        assert got2["node"] == "n0"
+        svc.stop()
+
+    def test_fifo_within_queue(self):
+        svc = make_pool()
+        register_cpu_node(svc, "n0")
+        svc.register_app("app1", memory_bytes=3 * GB, vcores=1)
+        svc.allocate("app1", "worker", 0, 3 * GB, 1, 0)
+        svc.register_app("app2", memory_bytes=3 * GB, vcores=1)
+        svc.register_app("app3", memory_bytes=3 * GB, vcores=1)
+        assert svc.allocate("app2", "worker", 0, 3 * GB, 1, 0)["position"] == 0
+        assert svc.allocate("app3", "worker", 0, 3 * GB, 1, 0)["position"] == 1
+        svc.release_all("app1")
+        # FIFO: app2 (earlier) admits; app3 keeps waiting
+        assert "node" in svc.allocate("app2", "worker", 0, 3 * GB, 1, 0)
+        assert svc.allocate("app3", "worker", 0, 3 * GB, 1, 0).get("wait") is True
+        svc.stop()
+
+    def test_priority_beats_fifo(self):
+        svc = make_pool()
+        register_cpu_node(svc, "n0")
+        svc.register_app("low", priority=0, memory_bytes=3 * GB, vcores=1)
+        svc.allocate("low", "worker", 0, 3 * GB, 1, 0)
+        svc.register_app("mid", priority=1, memory_bytes=3 * GB, vcores=1)
+        svc.register_app("high", priority=9, memory_bytes=3 * GB, vcores=1)
+        assert svc.allocate("mid", "worker", 0, 3 * GB, 1, 0).get("wait")
+        assert svc.allocate("high", "worker", 0, 3 * GB, 1, 0)["position"] == 0
+        svc.release_all("low")
+        assert "node" in svc.allocate("high", "worker", 0, 3 * GB, 1, 0)
+        assert svc.allocate("mid", "worker", 0, 3 * GB, 1, 0).get("wait") is True
+        svc.stop()
+
+    def test_queue_shares_cap_borrowing(self):
+        """When capacity frees, a queue already OVER its share loses to
+        another queue's waiter — even one that arrived later (the
+        capacity-scheduler guarantee behind ``tony.pool.queues``)."""
+        svc = make_pool(queues={"a": 0.5, "b": 0.5})
+        register_cpu_node(svc, "n0")  # 4 GB total → 2 GB/queue share
+        for app in ("a1", "a2"):  # queue a borrows the whole idle pool
+            svc.register_app(app, queue="a", memory_bytes=2 * GB, vcores=1)
+            svc.allocate(app, "worker", 0, 2 * GB, 1, 0)
+        svc.register_app("a3", queue="a", memory_bytes=2 * GB, vcores=1)
+        svc.register_app("b1", queue="b", memory_bytes=2 * GB, vcores=1)
+        assert svc.allocate("a3", "worker", 0, 2 * GB, 1, 0).get("wait") is True
+        assert svc.allocate("b1", "worker", 0, 2 * GB, 1, 0).get("wait") is True
+        # capacity frees: a3 arrived first but queue a is at 2× share while
+        # b waits at 0 — b1 is admitted, a3 keeps waiting
+        svc.release_all("a1")
+        assert "node" in svc.allocate("b1", "worker", 0, 2 * GB, 1, 0)
+        assert svc.allocate("a3", "worker", 0, 2 * GB, 1, 0).get("wait") is True
+        # once queue a drains under its share, a3 runs
+        svc.release_all("a2")
+        assert "node" in svc.allocate("a3", "worker", 0, 2 * GB, 1, 0)
+        svc.stop()
+
+    def test_elastic_borrow_when_pool_idle(self):
+        """With no other queue waiting, a queue may exceed its share."""
+        svc = make_pool(queues={"a": 0.25, "b": 0.75})
+        register_cpu_node(svc, "n0")
+        svc.register_app("a1", queue="a", memory_bytes=2 * GB, vcores=1)  # 2× share
+        svc.allocate("a1", "worker", 0, 2 * GB, 1, 0)
+        svc.register_app("a2", queue="a", memory_bytes=2 * GB, vcores=1)  # 4× share
+        assert "node" in svc.allocate("a2", "worker", 0, 2 * GB, 1, 0)
+        svc.stop()
+
+    def test_unknown_queue_rejected(self):
+        svc = make_pool(queues={"prod": 1.0})
+        with pytest.raises(ValueError, match="unknown queue"):
+            svc.register_app("x", queue="dev")
+        svc.stop()
+
+    def test_impossible_demand_is_allocation_error(self):
+        svc = make_pool()
+        register_cpu_node(svc, "n0")  # 4 GB
+        svc.register_app("big", memory_bytes=64 * GB, vcores=1)
+        with pytest.raises(AllocationError, match="never"):
+            svc.allocate("big", "worker", 0, 2 * GB, 1, 0)
+        svc.stop()
+
+    def test_preemption_evicts_lower_priority(self):
+        svc = make_pool(preemption=True)
+        register_cpu_node(svc, "n0")
+        svc.register_app("low", priority=0, memory_bytes=3 * GB, vcores=1)
+        got = svc.allocate("low", "worker", 0, 3 * GB, 1, 0)
+        # higher-priority arrival triggers eviction at registration time
+        svc.register_app("high", priority=5, memory_bytes=3 * GB, vcores=1)
+        node = svc._nodes["n0"]
+        assert got["id"] in node.pending_kills  # kill order queued for agent
+        st = svc.pool_status()
+        q = st["queues"]["default"]
+        assert [a["app_id"] for a in q["admitted"]] == ["high"]
+        assert [w["app_id"] for w in q["waiting"]] == ["low"]
+        assert q["waiting"][0]["preempted"] is True
+        # the agent reports the kill → recorded as EXIT_PREEMPTED, capacity frees
+        svc.node_heartbeat("n0", exited={got["id"]: 137})
+        assert svc.poll_exited("low") == {got["id"]: constants.EXIT_PREEMPTED}
+        assert "node" in svc.allocate("high", "worker", 0, 3 * GB, 1, 0)
+        # low re-queues and returns once high releases
+        assert svc.allocate("low", "worker", 0, 3 * GB, 1, 0).get("wait") is True
+        svc.release_all("high")
+        assert "node" in svc.allocate("low", "worker", 0, 3 * GB, 1, 0)
+        svc.stop()
+
+    def test_no_preemption_of_equal_priority(self):
+        svc = make_pool(preemption=True)
+        register_cpu_node(svc, "n0")
+        svc.register_app("first", priority=3, memory_bytes=3 * GB, vcores=1)
+        svc.allocate("first", "worker", 0, 3 * GB, 1, 0)
+        svc.register_app("second", priority=3, memory_bytes=3 * GB, vcores=1)
+        assert svc.allocate("second", "worker", 0, 3 * GB, 1, 0).get("wait") is True
+        assert not svc._nodes["n0"].pending_kills  # strictly-lower only
+        svc.stop()
+
+    def test_admitted_chip_asks_keep_slice_packing(self):
+        """Regression: the queue-wait restructuring must not reroute admitted
+        chip allocations through the chipless memory-headroom ordering — a
+        gang's second task must join its app's slice even when another
+        slice's host has MORE free memory."""
+        svc = make_pool()
+        for s, mem in ((0, 8 * GB), (1, 64 * GB)):  # slice 1 = memory-rich
+            for h in (0, 1):
+                svc.register_node(
+                    name=f"s{s}h{h}", host="h", port=1, memory_bytes=mem,
+                    vcores=8, slice_id=s, slice_spec="v5e-8",
+                    chips=[[r, 2 * h + c] for r in (0, 1) for c in (0, 1)],
+                )
+        svc.register_app("app", memory_bytes=2 * GB, vcores=2, chips=8)
+        a = svc.allocate("app", "worker", 0, GB, 1, 4)
+        b = svc.allocate("app", "worker", 1, GB, 1, 4)
+        assert a["slice_id"] == b["slice_id"]  # ICI affinity, not memory headroom
+        svc.stop()
+
+    def test_unplaceable_rectangle_is_allocation_error(self):
+        """An ask no host layout can form EVEN WHEN EMPTY must fail fast,
+        not wait forever as 'fragmentation'."""
+        svc = make_pool()
+        svc.register_node(
+            name="t0", host="h", port=1, memory_bytes=8 * GB, vcores=8,
+            slice_id=0, slice_spec="v5e-8",
+            chips=[[0, 0], [0, 1], [1, 2], [1, 3]],  # two disjoint dominoes
+        )
+        with pytest.raises(AllocationError, match="rectangle"):
+            svc.allocate("app", "worker", 0, 1024, 1, 4)
+        svc.stop()
+
+    def test_remote_rm_raises_allocation_pending(self):
+        svc = make_pool()
+        svc.rpc.start()
+        register_cpu_node(svc, "n0")
+        host, port = svc.address
+        rm1 = RemoteResourceManager(host, port, secret=SECRET, app_id="rm1")
+        rm2 = RemoteResourceManager(host, port, secret=SECRET, app_id="rm2")
+        rm1.register_app("default", 0, Resources(memory_bytes=3 * GB))
+        rm2.register_app("default", 0, Resources(memory_bytes=3 * GB))
+        rm1.allocate("worker", 0, Resources(memory_bytes=3 * GB))
+        with pytest.raises(AllocationPending, match="queued"):
+            rm2.allocate("worker", 0, Resources(memory_bytes=3 * GB))
+        rm1.shutdown()  # release_all → rm2 admitted
+        assert rm2.allocate("worker", 0, Resources(memory_bytes=3 * GB))
+        rm2.shutdown()
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# E2E: two jobs race one job's worth of capacity through the full spine
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def small_pool(tmp_tony_root, tmp_path):
+    """Pool service + ONE 4 GB agent: fits exactly one 3 GB job."""
+    svc = PoolService(heartbeat_interval_ms=100, max_missed_heartbeats=4,
+                      secret=SECRET, preemption=True)
+    svc.start()
+    agent = spawn_agent(svc.address, "solo", str(tmp_path))
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if any(n.alive for n in svc._nodes.values()):
+            break
+        time.sleep(0.05)
+    else:
+        raise RuntimeError("agent failed to register")
+    yield svc
+    if agent.poll() is None:
+        agent.terminate()
+    try:
+        agent.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        agent.kill()
+    svc.stop()
+
+
+def submit_async(tmp_tony_root, conf):
+    cfg = TonyConfig({keys.STAGING_ROOT: str(tmp_tony_root), **conf})
+    client = Client(cfg)
+    handle = client.submit()
+    result: dict = {}
+
+    def monitor():
+        result["final"] = client.monitor_application(handle, quiet=True)
+
+    t = threading.Thread(target=monitor, daemon=True)
+    t.start()
+    return handle, t, result
+
+
+@pytest.mark.e2e
+class TestQueueE2E:
+    def test_second_job_waits_then_runs(self, tmp_tony_root, small_pool, tmp_path,
+                                        monkeypatch):
+        svc = small_pool
+        sleeper = tmp_path / "sleeper.py"
+        sleeper.write_text("import time; time.sleep(4)\n")
+        h1, t1, r1 = submit_async(tmp_tony_root, pool_conf(svc, {
+            "tony.worker.instances": "1", "tony.worker.memory": "3g",
+            keys.EXECUTES: f"{sys.executable} {sleeper}",
+        }))
+        # job1 occupies the pool
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if svc.pool_status()["containers_running"] >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("job1 never started")
+        quick = tmp_path / "quick.py"
+        quick.write_text("print('ran')\n")
+        h2, t2, r2 = submit_async(tmp_tony_root, pool_conf(svc, {
+            "tony.worker.instances": "1", "tony.worker.memory": "3g",
+            keys.EXECUTES: f"{sys.executable} {quick}",
+        }))
+        # job2 must WAIT in the queue (not fail) while job1 runs
+        deadline = time.time() + 20
+        waiting = []
+        while time.time() < deadline:
+            waiting = svc.pool_status()["queues"]["default"]["waiting"]
+            if waiting:
+                break
+            time.sleep(0.05)
+        assert waiting and waiting[0]["app_id"] == h2.app_id
+        assert r2.get("final") is None  # still pending, not failed
+
+        # the portal /pool page renders the queue (VERDICT r3 done-when)
+        from tony_tpu.portal.server import serve
+
+        monkeypatch.setenv(constants.ENV_POOL_SECRET, SECRET)
+        server = serve(
+            os.path.join(str(tmp_tony_root), "history"), 0,
+            staging_root=str(tmp_tony_root), pool="%s:%d" % svc.address,
+        )
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.server_address[1]}/pool", timeout=10
+            ).read().decode()
+            assert h2.app_id in page and "waiting" in page
+        finally:
+            server.shutdown()
+
+        # both jobs complete, in order
+        t1.join(timeout=60)
+        t2.join(timeout=60)
+        assert r1.get("final") == JobStatus.SUCCEEDED, h1.final_status()
+        assert r2.get("final") == JobStatus.SUCCEEDED, h2.final_status()
+
+    def test_preemption_evicts_and_restarts_lower_priority(
+        self, tmp_tony_root, small_pool, tmp_path
+    ):
+        svc = small_pool
+        # first incarnation parks forever; after preemption the gang restarts
+        # and the second incarnation (marker present) exits clean
+        marker = tmp_path / "ran_once"
+        script = tmp_path / "preemptee.py"
+        script.write_text(
+            "import os, sys, time\n"
+            f"m = {str(marker)!r}\n"
+            "if os.path.exists(m):\n"
+            "    sys.exit(0)\n"
+            "open(m, 'w').close()\n"
+            "time.sleep(600)\n"
+        )
+        h1, t1, r1 = submit_async(tmp_tony_root, pool_conf(svc, {
+            "tony.worker.instances": "1", "tony.worker.memory": "3g",
+            keys.APPLICATION_PRIORITY: "0",
+            keys.EXECUTES: f"{sys.executable} {script}",
+        }))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if marker.exists():
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("low-priority job never started")
+        quick = tmp_path / "quick.py"
+        quick.write_text("print('prio')\n")
+        h2, t2, r2 = submit_async(tmp_tony_root, pool_conf(svc, {
+            "tony.worker.instances": "1", "tony.worker.memory": "3g",
+            keys.APPLICATION_PRIORITY: "5",
+            keys.EXECUTES: f"{sys.executable} {quick}",
+        }))
+        # high-priority job preempts, runs, finishes; low-priority job
+        # restarts from the top and now exits clean — BOTH succeed
+        t2.join(timeout=90)
+        assert r2.get("final") == JobStatus.SUCCEEDED, h2.final_status()
+        t1.join(timeout=90)
+        assert r1.get("final") == JobStatus.SUCCEEDED, h1.final_status()

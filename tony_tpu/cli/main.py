@@ -141,10 +141,18 @@ def _cmd_pool(argv: list[str]) -> int:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--memory", default="64g", help="memory per host")
     p.add_argument("--vcores", type=int, default=64)
+    p.add_argument("--queues", default="default=1.0",
+                   help="capacity queues 'name=share,...' (tony.pool.queues)")
+    p.add_argument("--preemption", action="store_true",
+                   help="let waiting higher-priority jobs evict lower-priority ones")
     args = p.parse_args(argv)
 
+    from tony_tpu.cluster.pool import parse_queue_spec
+
     secret = os.environ.get(constants.ENV_POOL_SECRET) or secrets.token_hex(16)
-    svc = PoolService(port=args.port, secret=secret)
+    svc = PoolService(port=args.port, secret=secret,
+                      queues=parse_queue_spec(args.queues),
+                      preemption=args.preemption)
     svc.start()
     host, port = svc.address
 

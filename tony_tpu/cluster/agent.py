@@ -106,6 +106,11 @@ class NodeAgent:
         return {"ack": True}
 
     def kill_container(self, container_id: str) -> dict[str, Any]:
+        # BLOCKING through the teardown grace: the AM releases the container
+        # back to the pool right after this RPC returns (gang restart), and
+        # the freed chips/memory must not be re-placeable while the old
+        # process still lives. Runs on an RPC handler thread — the heartbeat
+        # loop is unaffected (its own kill orders use wait=False instead).
         self.launcher.kill(container_id)
         return {"ack": True}
 
@@ -149,11 +154,15 @@ class NodeAgent:
                 if resp.get("unknown_node"):
                     # RM restarted (or we were declared dead and came back):
                     # containers from the previous epoch are orphans — kill
-                    # them and start clean, then re-register
-                    self.launcher.kill_all()
+                    # them and start clean, then re-register. wait=False: N
+                    # sequential 3 s graces would blow the liveness window
+                    self.launcher.kill_all(wait=False)
                     self._register()
                 for cid in resp.get("kill", []):
-                    self.launcher.kill(cid)
+                    # NEVER block the heartbeat loop on teardown grace: a
+                    # synchronous 3 s wait exceeds the liveness window and a
+                    # preemption kill would take the whole node down with it
+                    self.launcher.kill(cid, wait=False)
             except (RpcError, OSError):
                 pass  # RM unreachable: keep containers alive, retry next beat
             self._stop.wait(self.heartbeat_interval_s)

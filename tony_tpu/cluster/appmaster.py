@@ -33,6 +33,7 @@ from tony_tpu.cluster import history
 from tony_tpu.cluster.events import EventHandler, EventType
 from tony_tpu.cluster.resources import (
     AllocationError,
+    AllocationPending,
     Container,
     LocalResourceManager,
     ResourceManager,
@@ -130,6 +131,7 @@ class ApplicationMaster:
         self._restart_attempt = 0
         self._failures_seen = 0
         self._gang_complete_fired = False
+        self._queue_waiting = False
         # guards (attempt, session) as one unit: RPC handlers capture both
         # atomically so a stale-attempt call can never touch a fresh session
         import threading
@@ -239,6 +241,14 @@ class ApplicationMaster:
         self.rpc.register_object(self, APPLICATION_RPC_METHODS)
         self.rpc.start()
         self.events.start()
+        # announce queue/priority/whole-gang demand to the pool (the
+        # ApplicationSubmissionContext analog): multi-tenant pools queue us
+        # when capacity is short instead of failing the job
+        self.rm.register_app(
+            queue=self.config.get(keys.APPLICATION_QUEUE) or "default",
+            priority=self.config.get_int(keys.APPLICATION_PRIORITY, 0),
+            demand=self.scheduler.total_demand(),
+        )
         self.events.emit(
             EventType.APPLICATION_INITED,
             app_id=self.app_id,
@@ -332,14 +342,22 @@ class ApplicationMaster:
                     EventType.TASK_FINISHED, task=task.id, exit_code=rc, source="container-exit"
                 )
 
-    def _maybe_restart_gang(self, reason: str) -> bool:
-        """Whole-gang restart from checkpoint (rebuild-only elasticity)."""
-        if not self.config.get_bool(keys.TASK_RESTART_ON_FAILURE):
-            return False
-        budget = self.config.get_int(keys.TASK_MAX_TOTAL_INSTANCE_FAILURES, 0)
-        self._failures_seen += 1
-        if self._failures_seen > budget:
-            return False
+    def _maybe_restart_gang(self, reason: str, exit_code: int | None = None) -> bool:
+        """Whole-gang restart from checkpoint (rebuild-only elasticity).
+
+        Preemption (EXIT_PREEMPTED) is a CLUSTER action, not a job failure:
+        the gang always restarts (re-queuing through pool admission) and the
+        eviction never consumes the failure budget — YARN likewise excludes
+        preempted containers from AM failure counts.
+        """
+        preempted = exit_code == constants.EXIT_PREEMPTED
+        if not preempted:
+            if not self.config.get_bool(keys.TASK_RESTART_ON_FAILURE):
+                return False
+            budget = self.config.get_int(keys.TASK_MAX_TOTAL_INSTANCE_FAILURES, 0)
+            self._failures_seen += 1
+            if self._failures_seen > budget:
+                return False
         self.events.emit(EventType.HEARTBEAT_LOST, reason=f"gang restart: {reason}")
         self._kill_all_containers()
         for c in list(self._containers.values()):
@@ -377,6 +395,15 @@ class ApplicationMaster:
             try:
                 for job_type in self.scheduler.ready_types():
                     self._launch_type(job_type)
+                if self._queue_waiting:
+                    self._queue_waiting = False
+                    self.events.emit(EventType.QUEUE_WAIT, state="admitted")
+            except AllocationPending as e:
+                # queued behind other tenants: wait (don't fail) and retry
+                # the whole type next tick; emit one event per wait episode
+                if not self._queue_waiting:
+                    self._queue_waiting = True
+                    self.events.emit(EventType.QUEUE_WAIT, state="waiting", reason=str(e))
             except (DependencyTimeout, AllocationError) as e:
                 self._fail(str(e))
                 self._kill_all_containers()
@@ -431,7 +458,9 @@ class ApplicationMaster:
             # 5. fail-fast on tracked failure (or gang-restart if enabled)
             failed = self.session.any_tracked_failed()
             if failed is not None:
-                if self._maybe_restart_gang(f"task {failed.id} {failed.status.value}"):
+                if self._maybe_restart_gang(
+                    f"task {failed.id} {failed.status.value}", failed.exit_code
+                ):
                     continue
                 self._fail(f"tracked task {failed.id} {failed.status.value} "
                            f"(exit_code={failed.exit_code})")

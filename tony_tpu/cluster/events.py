@@ -30,6 +30,7 @@ class EventType(enum.Enum):
     TASK_REGISTERED = "TASK_REGISTERED"
     TASK_FINISHED = "TASK_FINISHED"
     HEARTBEAT_LOST = "HEARTBEAT_LOST"
+    QUEUE_WAIT = "QUEUE_WAIT"
     GANG_COMPLETE = "GANG_COMPLETE"
     TASK_URL_REGISTERED = "TASK_URL_REGISTERED"
     METRICS_SNAPSHOT = "METRICS_SNAPSHOT"

@@ -358,8 +358,14 @@ class TaskExecutor:
 
 
 def _sigterm(executor: TaskExecutor) -> None:
-    executor._stop.set()
+    # kill the child FIRST, stop heartbeating LAST: the supervisor is alive
+    # throughout the (up to 3 s) teardown grace, and the AM must keep seeing
+    # heartbeats until then — going silent at SIGTERM opens a race where the
+    # AM marks the task heartbeat-LOST (a budget-consuming failure) before
+    # the container's true exit record (e.g. EXIT_PREEMPTED, which is NOT a
+    # failure) can reach it through agent → pool → poll_exited.
     executor._kill_child()
+    executor._stop.set()
     sys.exit(constants.EXIT_KILLED)
 
 

@@ -44,6 +44,7 @@ from typing import Any
 from tony_tpu import constants
 from tony_tpu.cluster.resources import (
     AllocationError,
+    AllocationPending,
     Container,
     ResourceManager,
     Resources,
@@ -54,6 +55,7 @@ from tony_tpu.cluster.rpc import RpcClient, RpcError, RpcServer
 POOL_RPC_METHODS = [
     "register_node",
     "node_heartbeat",
+    "register_app",
     "allocate",
     "release",
     "release_all",
@@ -63,6 +65,73 @@ POOL_RPC_METHODS = [
 ]
 
 _RUNNING, _EXITED, _RELEASED = "RUNNING", "EXITED", "RELEASED"
+
+
+def parse_queue_spec(spec: str) -> dict[str, float]:
+    """``"prod=0.7,dev=0.3"`` → {"prod": 0.7, "dev": 0.3}. Shares are each
+    queue's guaranteed fraction of the pool's primary capacity dimension
+    (chips when the pool has chips, memory otherwise); a queue may borrow
+    beyond its share while no other queue has waiting apps (elastic, the
+    capacity-scheduler behavior)."""
+    queues: dict[str, float] = {}
+    for part in (spec or "default=1.0").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, share = part.partition("=")
+        try:
+            f = float(share) if share else 1.0
+        except ValueError:
+            raise ValueError(f"bad queue share in {part!r}: expected name=fraction") from None
+        if not 0 < f <= 1:
+            raise ValueError(f"queue {name!r} share must be in (0, 1], got {f}")
+        queues[name.strip()] = f
+    if not queues:
+        raise ValueError(f"no queues in spec {spec!r}")
+    _validate_queue_shares(queues)
+    return queues
+
+
+def _validate_queue_shares(queues: dict[str, float]) -> None:
+    """Shares are GUARANTEES — they cannot oversubscribe the pool. YARN's
+    capacity scheduler rejects capacities that don't fit 100% for the same
+    reason: with prod=0.9,dev=0.9 the over-share gate almost never fires and
+    the operator's 'guarantee' silently degrades to FIFO."""
+    bad = [(q, f) for q, f in queues.items() if not 0 < f <= 1]
+    if bad:
+        raise ValueError(f"queue shares must each be in (0, 1]: {bad}")
+    total = sum(queues.values())
+    if total > 1.0 + 1e-9:
+        raise ValueError(
+            f"queue shares sum to {total:g} > 1 — guarantees would "
+            f"oversubscribe the pool: {queues}"
+        )
+
+
+@dataclass(eq=False)
+class _App:
+    """One tenant application and its queue/admission state.
+
+    ``admitted`` apps hold a capacity CLAIM of elementwise
+    max(demand, held) — reserved even while their containers are being
+    (re)allocated, so an app mid-gang-restart keeps its capacity and two
+    half-allocated gangs can never deadlock each other. Waiting apps hold
+    nothing and retry through ``allocate`` until the scheduler admits them.
+    """
+
+    app_id: str
+    queue: str
+    priority: int = 0
+    demand_memory: int = 0
+    demand_vcores: int = 0
+    demand_chips: int = 0
+    seq: int = 0
+    admitted: bool = False
+    preempted: bool = False    # demoted by preemption; re-queues via allocate
+
+    @property
+    def sort_key(self) -> tuple[int, int]:
+        return (-self.priority, self.seq)  # higher priority first, then FIFO
 
 
 @dataclass(eq=False)
@@ -123,12 +192,20 @@ class PoolService:
         secret: str = "",
         heartbeat_interval_ms: int = 1000,
         max_missed_heartbeats: int = 10,
+        queues: dict[str, float] | None = None,
+        preemption: bool = False,
     ):
         self.heartbeat_interval_ms = heartbeat_interval_ms
         self.max_missed = max_missed_heartbeats
+        self.queues = dict(queues) if queues else {"default": 1.0}
+        _validate_queue_shares(self.queues)
+        self.preemption = preemption
         self._nodes: dict[str, _Node] = {}
         self._containers: dict[str, dict[str, Any]] = {}   # cid → record
         self._app_exits: dict[str, dict[str, int]] = {}    # app → {cid: rc}
+        self._apps: dict[str, _App] = {}                   # app → queue state
+        self._app_seq = itertools.count()
+        self._preempt_cids: set[str] = set()               # kills we initiated
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.rpc = RpcServer(host=bind_host, port=port, secret=secret)
@@ -223,6 +300,39 @@ class PoolService:
         return {"ack": True, "kill": kills}
 
     # --------------------------------------------------------------- AM side
+    def register_app(
+        self,
+        app_id: str,
+        queue: str = "default",
+        priority: int = 0,
+        memory_bytes: int = 0,
+        vcores: int = 0,
+        chips: int = 0,
+    ) -> dict[str, Any]:
+        """ApplicationSubmissionContext analog: the AM announces its queue,
+        priority, and TOTAL gang demand before allocating. Admission (the
+        YARN capacity-queue behavior ``tony.application.queue`` configures)
+        is decided from these demands: apps WAIT when the pool is busy
+        instead of failing."""
+        if queue not in self.queues:
+            raise ValueError(
+                f"unknown queue {queue!r}: pool queues are {sorted(self.queues)} "
+                f"(tony.pool.queues)"
+            )
+        with self._lock:
+            app = self._apps.get(app_id)
+            if app is None:
+                app = self._apps[app_id] = _App(
+                    app_id=app_id, queue=queue, priority=int(priority),
+                    seq=next(self._app_seq),
+                )
+            app.queue, app.priority = queue, int(priority)
+            app.demand_memory = int(memory_bytes)
+            app.demand_vcores = int(vcores)
+            app.demand_chips = int(chips)
+            self._schedule_locked()
+            return {"ack": True, "queue": queue, "admitted": app.admitted}
+
     def allocate(
         self,
         app_id: str,
@@ -234,6 +344,18 @@ class PoolService:
     ) -> dict[str, Any]:
         with self._lock:
             alive = [n for n in self._nodes.values() if n.alive]
+            if not alive:
+                if not self._nodes:
+                    # nothing EVER registered: a misconfigured pool — fail fast
+                    raise AllocationError(
+                        f"pool has no registered nodes to host {job_type}:{task_index}"
+                    )
+                # nodes exist but are all currently dead (agent blip/restart):
+                # they re-register on their next heartbeat — wait, don't fail
+                return {
+                    "wait": True, "queue": "", "position": 0,
+                    "reason": "all pool nodes currently unreachable",
+                }
             if chips > 0:
                 biggest = max((len(n.chips) for n in alive), default=0)
                 if chips > biggest:
@@ -242,6 +364,67 @@ class PoolService:
                         f"host owns {biggest}: a container runs on one host — shard "
                         f"the job into per-host tasks (one process per TPU VM)"
                     )
+                # placeability-if-empty: an ask no host could satisfy even
+                # with ZERO occupancy (e.g. a 2x2 rect on a host owning a
+                # 1x4 strip) would otherwise wait forever as "fragmentation"
+                if not any(_rect_from(set(n.chips), chips) for n in alive):
+                    raise AllocationError(
+                        f"{job_type}:{task_index} asks a {chips}-chip rectangle "
+                        f"no host's chip layout can form even when empty"
+                    )
+            if memory_bytes > max(n.memory_bytes for n in alive):
+                raise AllocationError(
+                    f"{job_type}:{task_index} asks {memory_bytes}B memory but the "
+                    f"largest host owns {max(n.memory_bytes for n in alive)}B"
+                )
+            if vcores > max(n.vcores for n in alive):
+                raise AllocationError(
+                    f"{job_type}:{task_index} asks {vcores} vcores but the largest "
+                    f"host owns {max(n.vcores for n in alive)}"
+                )
+            app = self._apps.get(app_id)
+            if app is None:
+                # back-compat: an unregistered app enters the default queue
+                # claiming only what it asks for (AMs register real demands)
+                default_q = "default" if "default" in self.queues else next(iter(self.queues))
+                app = self._apps[app_id] = _App(
+                    app_id=app_id, queue=default_q, seq=next(self._app_seq),
+                )
+            # demand learns the observed gang size (auto-registered apps
+            # under-claim; held+ask is exact once the gang allocates serially)
+            held = self._held_locked(app_id)
+            app.demand_memory = max(app.demand_memory, held[0] + memory_bytes)
+            app.demand_vcores = max(app.demand_vcores, held[1] + vcores)
+            app.demand_chips = max(app.demand_chips, held[2] + chips)
+            if not app.admitted:
+                self._schedule_locked()
+            if not app.admitted:
+                totals = self._totals_locked()
+                if (
+                    app.demand_memory > totals[0]
+                    or app.demand_vcores > totals[1]
+                    or app.demand_chips > totals[2]
+                ):
+                    raise AllocationError(
+                        f"app {app_id} demand ({app.demand_memory}B/"
+                        f"{app.demand_vcores}vc/{app.demand_chips}ch) exceeds the "
+                        f"pool's total capacity ({totals[0]}B/{totals[1]}vc/"
+                        f"{totals[2]}ch) — it can never be admitted"
+                    )
+                waiting = [
+                    a for a in self._apps.values()
+                    if a.queue == app.queue and not a.admitted
+                ]
+                waiting.sort(key=lambda a: a.sort_key)
+                return {
+                    "wait": True,
+                    "queue": app.queue,
+                    "position": waiting.index(app),
+                    "reason": f"queued in {app.queue!r} at position "
+                              f"{waiting.index(app)} of {len(waiting)}"
+                              + (" (preempted)" if app.preempted else ""),
+                }
+            if chips > 0:
                 # pack the gang's chips into as few slices as possible: prefer
                 # slices this app already occupies, then fullest host first
                 app_slices = {
@@ -284,20 +467,27 @@ class PoolService:
                     "agent_host": node.host, "agent_port": node.port,
                     "slice_spec": node.slice_spec,
                 }
-            raise AllocationError(
-                f"no node can host {job_type}:{task_index} "
-                f"(ask: {memory_bytes}B/{vcores}vc/{chips}ch; nodes: "
-                + ", ".join(
-                    f"{n.name}[{n.memory_bytes - n.used_memory}B free"
-                    + (f", {len(n.free_chips)}ch]" if n.chips else "]")
-                    for n in alive
-                )
-                + ")"
-            )
+            # ADMITTED but nothing fits right now (other tenants' containers
+            # still draining, or fragmentation): transient — the app keeps
+            # its claim and the AM retries. Never-fit asks were rejected above.
+            return {
+                "wait": True,
+                "queue": app.queue,
+                "position": 0,
+                "reason": f"admitted; no node can host {job_type}:{task_index} yet "
+                          f"(ask: {memory_bytes}B/{vcores}vc/{chips}ch; nodes: "
+                          + ", ".join(
+                              f"{n.name}[{n.memory_bytes - n.used_memory}B free"
+                              + (f", {len(n.free_chips)}ch]" if n.chips else "]")
+                              for n in alive
+                          )
+                          + ")",
+            }
 
     def release(self, app_id: str, container_id: str) -> dict[str, Any]:
         with self._lock:
             self._release_locked(container_id)
+            self._schedule_locked()
         return {"ack": True}
 
     def release_all(self, app_id: str) -> dict[str, Any]:
@@ -307,6 +497,8 @@ class PoolService:
                     self._request_kill_locked(rec)
                     self._release_locked(cid)
             self._app_exits.pop(app_id, None)
+            self._apps.pop(app_id, None)  # app done: leave the queue entirely
+            self._schedule_locked()
         return {"ack": True}
 
     def poll_exited(self, app_id: str) -> dict[str, int]:
@@ -337,7 +529,210 @@ class PoolService:
                 "containers_running": sum(
                     1 for r in self._containers.values() if r["state"] == _RUNNING
                 ),
+                "queues": {
+                    q: {
+                        "share": share,
+                        "admitted": sorted(
+                            (
+                                {
+                                    "app_id": a.app_id, "priority": a.priority,
+                                    "held_chips": self._held_locked(a.app_id)[2],
+                                    "held_memory": self._held_locked(a.app_id)[0],
+                                }
+                                for a in self._apps.values()
+                                if a.queue == q and a.admitted
+                            ),
+                            key=lambda e: e["app_id"],
+                        ),
+                        "waiting": [
+                            {
+                                "app_id": a.app_id, "priority": a.priority,
+                                "position": i, "preempted": a.preempted,
+                            }
+                            for i, a in enumerate(sorted(
+                                (a for a in self._apps.values()
+                                 if a.queue == q and not a.admitted),
+                                key=lambda a: a.sort_key,
+                            ))
+                        ],
+                    }
+                    for q, share in self.queues.items()
+                },
+                "preemption": self.preemption,
             }
+
+    # ------------------------------------------------- admission scheduling
+    def _totals_locked(self) -> tuple[int, int, int]:
+        """(memory, vcores, chips) over alive nodes — the admission universe."""
+        alive = [n for n in self._nodes.values() if n.alive]
+        return (
+            sum(n.memory_bytes for n in alive),
+            sum(n.vcores for n in alive),
+            sum(len(n.chips) for n in alive),
+        )
+
+    def _held_locked(self, app_id: str) -> tuple[int, int, int]:
+        mem = vc = ch = 0
+        for rec in self._containers.values():
+            if rec["app_id"] == app_id and rec["state"] == _RUNNING:
+                mem += rec["memory_bytes"]
+                vc += rec["vcores"]
+                ch += len(rec["chips"])
+        return mem, vc, ch
+
+    def _claim_locked(self, app: _App) -> tuple[int, int, int]:
+        held = self._held_locked(app.app_id)
+        return (
+            max(app.demand_memory, held[0]),
+            max(app.demand_vcores, held[1]),
+            max(app.demand_chips, held[2]),
+        )
+
+    @staticmethod
+    def _fits(free: list[int], demand: tuple[int, int, int]) -> bool:
+        return all(f >= d for f, d in zip(free, demand))
+
+    def _schedule_locked(self) -> None:
+        """Admit waiting apps (the capacity-scheduler decision).
+
+        Claims-based: each admitted app reserves max(demand, held), so
+        admission is all-or-nothing at GANG granularity — two apps can never
+        interleave half-gangs into a deadlock. Within a queue: priority desc,
+        then FIFO. Across queues: least relative usage (claim/share) first.
+        A queue may exceed its share while no other queue has waiters, and
+        every queue may always run at least one app (no share-induced
+        starvation). With preemption on, a waiting app may evict
+        strictly-lower-priority admitted apps from its own queue.
+        """
+        totals = self._totals_locked()
+        if not any(totals):
+            return  # no capacity registered yet — everything waits
+        primary = 2 if totals[2] > 0 else 0  # chips when the pool has chips
+        demand_of = lambda a: (a.demand_memory, a.demand_vcores, a.demand_chips)  # noqa: E731
+        claims = {a.app_id: self._claim_locked(a) for a in self._apps.values() if a.admitted}
+        free = [t - sum(c[i] for c in claims.values()) for i, t in enumerate(totals)]
+        queue_used: dict[str, int] = {q: 0 for q in self.queues}
+        for a in self._apps.values():
+            if a.admitted:
+                queue_used[a.queue] = queue_used.get(a.queue, 0) + claims[a.app_id][primary]
+
+        def waiting_in(q: str) -> list[_App]:
+            return sorted(
+                (a for a in self._apps.values() if a.queue == q and not a.admitted),
+                key=lambda a: a.sort_key,
+            )
+
+        def admit(app: _App) -> None:
+            app.admitted, app.preempted = True, False
+            d = demand_of(app)
+            for i in range(3):
+                free[i] -= d[i]
+            queue_used[app.queue] = queue_used.get(app.queue, 0) + d[primary]
+
+        while True:
+            eligible: list[tuple[float, tuple[int, int], _App]] = []
+            blocked_heads: list[_App] = []
+            for q, share in self.queues.items():
+                heads = waiting_in(q)
+                if not heads:
+                    continue
+                head = heads[0]
+                if not self._fits(free, demand_of(head)):
+                    blocked_heads.append(head)
+                    continue
+                others_waiting = any(
+                    a for a in self._apps.values() if not a.admitted and a.queue != q
+                )
+                cap = share * totals[primary]
+                over_share = queue_used.get(q, 0) + demand_of(head)[primary] > cap
+                if over_share and others_waiting and queue_used.get(q, 0) > 0:
+                    # queue is over its share while others wait (elastic
+                    # borrowing only applies to an otherwise-idle pool; a
+                    # queue's FIRST app always may run)
+                    blocked_heads.append(head)
+                    continue
+                eligible.append((queue_used.get(q, 0) / share, head.sort_key, head))
+            if eligible:
+                eligible.sort(key=lambda e: (e[0], e[1]))
+                admit(eligible[0][2])
+                continue
+            if self.preemption and blocked_heads:
+                blocked_heads.sort(key=lambda a: a.sort_key)
+                if self._preempt_for_locked(
+                    blocked_heads[0], free, claims, queue_used, primary, totals, admit
+                ):
+                    continue
+            return
+
+    def _preempt_for_locked(
+        self,
+        cand: _App,
+        free: list[int],
+        claims: dict[str, tuple[int, int, int]],
+        queue_used: dict[str, int],
+        primary: int,
+        totals: tuple[int, int, int],
+        admit,
+    ) -> bool:
+        """Evict strictly-lower-priority admitted apps from ``cand``'s own
+        queue (lowest priority, newest first) and admit ``cand`` in the SAME
+        action. The atomic evict+admit matters: if the freed claims went back
+        to the general pool, the next admission pass could hand them to
+        another queue's head and the eviction would cascade (or be wasted) —
+        victims are evicted exactly for the app that takes their place.
+        Kills ride the agents' heartbeats; the claim swap is immediate, so
+        ``cand``'s allocations simply wait out the drain.
+
+        Share gate: evicting same-queue victims cannot grow the queue's
+        usage, but the part of ``cand``'s demand NOT covered by the victims'
+        freed claims must pass the same over-share rule as normal admission
+        — preemption overrides priority inside a queue, never the queue's
+        capacity contract with other tenants."""
+        victims = sorted(
+            (a for a in self._apps.values()
+             if a.admitted and a.queue == cand.queue and a.priority < cand.priority),
+            key=lambda a: (a.priority, -a.seq),
+        )
+        demand = (cand.demand_memory, cand.demand_vcores, cand.demand_chips)
+        chosen: list[_App] = []
+        trial = list(free)
+        freed_primary = 0
+        for v in victims:
+            if self._fits(trial, demand):
+                break
+            # canonical claim, not the pass-local dict: apps admitted earlier
+            # in THIS scheduling pass (incl. by a prior preemption) are
+            # missing from it, and their claim is simply their demand
+            c = self._claim_locked(v)
+            for i in range(3):
+                trial[i] += c[i]
+            freed_primary += c[primary]
+            chosen.append(v)
+        if not chosen or not self._fits(trial, demand):
+            return False
+        net_growth = demand[primary] - freed_primary
+        if net_growth > 0:
+            others_waiting = any(
+                a for a in self._apps.values()
+                if not a.admitted and a.queue != cand.queue
+            )
+            used_after = queue_used.get(cand.queue, 0) - freed_primary
+            cap = self.queues.get(cand.queue, 1.0) * totals[primary]
+            if others_waiting and used_after > 0 and used_after + demand[primary] > cap:
+                return False
+        for v in chosen:
+            c = self._claim_locked(v)
+            v.admitted, v.preempted = False, True
+            claims.pop(v.app_id, None)
+            for i in range(3):
+                free[i] += c[i]
+            queue_used[v.queue] -= c[primary]
+            for cid, rec in self._containers.items():
+                if rec["app_id"] == v.app_id and rec["state"] == _RUNNING:
+                    self._preempt_cids.add(cid)
+                    self._request_kill_locked(rec)
+        admit(cand)
+        return True
 
     # -------------------------------------------------------------- internal
     def _request_kill_locked(self, rec: dict[str, Any]) -> None:
@@ -356,9 +751,15 @@ class PoolService:
         rec = self._containers.get(cid)
         if rec is None or rec["state"] != _RUNNING:
             return
+        if cid in self._preempt_cids:
+            # we killed it: report the cluster action, not the signal — AMs
+            # exclude EXIT_PREEMPTED from restart budgets (YARN PREEMPTED)
+            self._preempt_cids.discard(cid)
+            rc = constants.EXIT_PREEMPTED
         rec["state"] = _EXITED
         self._free_locked(rec)
         self._app_exits.setdefault(rec["app_id"], {})[cid] = rc
+        self._schedule_locked()
 
     def _release_locked(self, cid: str) -> None:
         rec = self._containers.pop(cid, None)
@@ -406,6 +807,17 @@ class RemoteResourceManager(ResourceManager):
                 cli = self._agents[addr] = RpcClient(addr[0], addr[1], secret=self.secret)
             return cli
 
+    def register_app(self, queue: str, priority: int, demand: Resources) -> None:
+        self.rm.call(
+            "register_app",
+            app_id=self.app_id,
+            queue=queue,
+            priority=priority,
+            memory_bytes=demand.memory_bytes,
+            vcores=demand.vcores,
+            chips=demand.chips,
+        )
+
     def allocate(self, job_type: str, task_index: int, resources: Resources) -> Container:
         try:
             got = self.rm.call(
@@ -421,6 +833,8 @@ class RemoteResourceManager(ResourceManager):
             if "AllocationError" in str(e):
                 raise AllocationError(str(e)) from e
             raise
+        if got.get("wait"):
+            raise AllocationPending(got.get("reason", "queued"))
         coords = tuple((r, c) for r, c in got["chips"])
         spec = SliceSpec.parse(got["slice_spec"]) if got.get("slice_spec") else None
         container = Container(
@@ -559,6 +973,8 @@ def main(argv: list[str] | None = None) -> int:
         max_missed_heartbeats=args.max_missed
         if args.max_missed is not None
         else config.get_int(keys.NODE_MAX_MISSED_HEARTBEATS, 10),
+        queues=parse_queue_spec(config.get(keys.POOL_QUEUES) or "default=1.0"),
+        preemption=config.get_bool(keys.POOL_PREEMPTION_ENABLED),
     )
     svc.start()
     host, port = svc.address

@@ -123,7 +123,14 @@ class Container:
 
 
 class AllocationError(RuntimeError):
-    pass
+    """The ask can NEVER be satisfied by this pool (or the pool has no
+    nodes): the job fails. Transient shortage raises AllocationPending."""
+
+
+class AllocationPending(RuntimeError):
+    """Capacity is short NOW but the ask is feasible: the app waits in its
+    queue (YARN capacity-queue analog). The caller releases any partial gang
+    and retries on its next scheduling tick."""
 
 
 class ChipGrid:
@@ -237,9 +244,15 @@ class ResourceManager(ABC):
     interchangeable (SURVEY.md §7 hard part (a)).
     """
 
+    def register_app(self, queue: str, priority: int, demand: "Resources") -> None:
+        """Announce the app's queue, priority, and TOTAL gang demand to the
+        pool (ApplicationSubmissionContext analog). In-process pools are
+        single-tenant — only the remote pool service consumes this."""
+
     @abstractmethod
     def allocate(self, job_type: str, task_index: int, resources: Resources) -> Container:
-        """Allocate a container or raise AllocationError."""
+        """Allocate a container, raise AllocationError (never fits), or raise
+        AllocationPending (queued behind other tenants — retry later)."""
 
     @abstractmethod
     def release(self, container: Container) -> None: ...
@@ -309,26 +322,43 @@ class ContainerLauncher:
                     self._reported.add(cid)
         return out
 
-    def kill(self, container_id: str) -> None:
+    def kill(self, container_id: str, wait: bool = True) -> None:
+        """SIGTERM the container's process group, escalating to SIGKILL after
+        a 3 s grace. ``wait=False`` runs the grace/escalation in a background
+        thread — the node agent's heartbeat loop must never block on a
+        container's teardown (a 3 s synchronous wait exceeds the liveness
+        window and gets the whole NODE declared dead)."""
         with self._lock:
             proc = self._procs.get(container_id)
-        if proc and proc.poll() is None:
+        if not proc or proc.poll() is not None:
+            return
+        try:
+            pgid = os.getpgid(proc.pid)
+            os.killpg(pgid, signal.SIGTERM)
+        except ProcessLookupError:
+            return
+
+        def escalate() -> None:
             try:
-                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
                 try:
-                    proc.wait(timeout=3)
-                except subprocess.TimeoutExpired:
-                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-            except ProcessLookupError:
-                pass
+                    os.killpg(pgid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+        if wait:
+            escalate()
+        else:
+            threading.Thread(target=escalate, daemon=True).start()
 
     def live_ids(self) -> list[str]:
         with self._lock:
             return [cid for cid, p in self._procs.items() if p.poll() is None]
 
-    def kill_all(self) -> None:
+    def kill_all(self, wait: bool = True) -> None:
         for cid in self.live_ids():
-            self.kill(cid)
+            self.kill(cid, wait=wait)
 
 
 class ProcessContainerMixin:

@@ -17,7 +17,13 @@ import time
 from dataclasses import dataclass, field
 
 from tony_tpu.config import TonyConfig, keys
-from tony_tpu.cluster.resources import AllocationError, Container, ResourceManager, Resources
+from tony_tpu.cluster.resources import (
+    AllocationError,
+    AllocationPending,
+    Container,
+    ResourceManager,
+    Resources,
+)
 from tony_tpu.cluster.session import Session
 
 
@@ -100,15 +106,30 @@ class TaskScheduler:
 
     # -- allocation --------------------------------------------------------
     def allocate_type(self, job_type: str) -> list[Container]:
-        """Allocate every instance of a type as one gang; all-or-nothing."""
+        """Allocate every instance of a type as one gang; all-or-nothing.
+
+        AllocationError (never fits) fails the job. AllocationPending
+        (queued behind other tenants) releases the partial gang — holding
+        half a gang while waiting would deadlock against another waiter —
+        and propagates so the AM retries the whole type on its next tick.
+        """
         plan = self.plans[job_type]
         got: list[Container] = []
         try:
             for i in range(plan.instances):
                 got.append(self.rm.allocate(job_type, i, plan.resources))
-        except AllocationError:
+        except (AllocationError, AllocationPending):
             for c in got:
                 self.rm.release(c)
             raise
         plan.launched = True
         return got
+
+    def total_demand(self) -> Resources:
+        """The job's WHOLE-GANG resource demand (every instance of every
+        type) — what the AM registers with the pool for queue admission."""
+        return Resources(
+            memory_bytes=sum(p.instances * p.resources.memory_bytes for p in self.plans.values()),
+            vcores=sum(p.instances * p.resources.vcores for p in self.plans.values()),
+            chips=sum(p.instances * p.resources.chips for p in self.plans.values()),
+        )

@@ -19,6 +19,7 @@ from __future__ import annotations
 # ---------------------------------------------------------------------------
 APPLICATION_NAME = "tony.application.name"
 APPLICATION_QUEUE = "tony.application.queue"
+APPLICATION_PRIORITY = "tony.application.priority"  # int; higher runs first within a queue
 APPLICATION_FRAMEWORK = "tony.application.framework"      # jax|tensorflow|pytorch|horovod|mxnet|generic
 APPLICATION_UNTRACKED_TYPES = "tony.application.untracked.jobtypes"  # csv; don't gate job verdict
 APPLICATION_NODE_LABEL = "tony.application.node-label"
@@ -103,6 +104,12 @@ NODE_HEARTBEAT_INTERVAL_MS = "tony.node.heartbeat-interval-ms"
 NODE_MAX_MISSED_HEARTBEATS = "tony.node.max-missed-heartbeats"
 
 # ---------------------------------------------------------------------------
+# tony.pool.* — pool-service multi-tenancy (capacity-queue analog, SURVEY §3.1)
+# ---------------------------------------------------------------------------
+POOL_QUEUES = "tony.pool.queues"                # "name=share,..." e.g. "prod=0.7,dev=0.3"
+POOL_PREEMPTION_ENABLED = "tony.pool.preemption.enabled"
+
+# ---------------------------------------------------------------------------
 # tony.history.* / tony.portal.* — events, history, portal
 # ---------------------------------------------------------------------------
 HISTORY_LOCATION = "tony.history.location"
@@ -133,6 +140,7 @@ STAGING_ROOT = "tony.submit.staging-root"
 DEFAULTS: dict[str, str] = {
     APPLICATION_NAME: "tony-tpu-app",
     APPLICATION_QUEUE: "default",
+    APPLICATION_PRIORITY: "0",
     APPLICATION_FRAMEWORK: "jax",
     APPLICATION_UNTRACKED_TYPES: "ps,tensorboard,notebook",
     APPLICATION_NODE_LABEL: "",
@@ -173,6 +181,9 @@ DEFAULTS: dict[str, str] = {
 
     NODE_HEARTBEAT_INTERVAL_MS: "1000",
     NODE_MAX_MISSED_HEARTBEATS: "10",
+
+    POOL_QUEUES: "default=1.0",
+    POOL_PREEMPTION_ENABLED: "false",
 
     HISTORY_LOCATION: "",            # empty → <staging-root>/history
     HISTORY_MOVE_INTERVAL_MS: "1000",

@@ -115,6 +115,7 @@ EXIT_EXECUTOR_REGISTRATION_FAILED = 11
 EXIT_HEARTBEAT_LOST = 12
 EXIT_KILLED = 137
 EXIT_NODE_LOST = -100   # container's host agent died (YARN ContainerExitStatus.ABORTED analog)
+EXIT_PREEMPTED = -102   # pool preempted the container for a higher-priority app (YARN ContainerExitStatus.PREEMPTED analog; not a job failure — excluded from restart budgets)
 
 # Distributed-mode values
 DISTRIBUTED_MODE_GANG = "GANG"

@@ -297,6 +297,29 @@ class PortalHandler(BaseHTTPRequestHandler):
             "<table><tr><th>node</th><th>liveness</th><th>slice</th>"
             f"<th>chips free</th><th>mem free</th><th>vcores free</th></tr>{rows}</table>"
         )
+        queues = st.get("queues") or {}
+        if queues:
+            qrows = []
+            for qname, q in sorted(queues.items()):
+                admitted = ", ".join(
+                    f"{html.escape(a['app_id'])} (p{a['priority']}, "
+                    f"{a['held_chips']}ch/{a['held_memory'] // (1 << 20)}MiB)"
+                    for a in q.get("admitted", [])
+                ) or "—"
+                waiting = ", ".join(
+                    f"#{w['position']} {html.escape(w['app_id'])} (p{w['priority']})"
+                    + (" [preempted]" if w.get("preempted") else "")
+                    for w in q.get("waiting", [])
+                ) or "—"
+                qrows.append(
+                    f"<tr><td>{html.escape(qname)}</td><td>{q.get('share', 1.0):.0%}</td>"
+                    f"<td>{admitted}</td><td>{waiting}</td></tr>"
+                )
+            body += (
+                f"<h3>queues{' (preemption on)' if st.get('preemption') else ''}</h3>"
+                "<table><tr><th>queue</th><th>share</th><th>admitted</th>"
+                f"<th>waiting</th></tr>{''.join(qrows)}</table>"
+            )
         return _page(f"pool {self.pool_addr}", body)
 
     def _job_config(self, app_id: str) -> bytes:
