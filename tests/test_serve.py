@@ -1,0 +1,199 @@
+"""``tony serve`` jobtype tests: the AM-supervised inference endpoint.
+
+VERDICT r3 #2's done-when: a job submission stands up the serving engine
+behind a streaming HTTP endpoint, the URL registers through the AM
+(SURVEY.md §3.4 register_task_url path), a client streams completions
+mid-run, engine metrics reach the AM task info (the portal's data source),
+and kill drains gracefully.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.cluster.client import Client
+from tony_tpu.cluster.session import JobStatus
+from tony_tpu.cli.notebook import wait_for_task_url
+from tony_tpu.cli.serve import build_serve_config
+from tony_tpu.models.llama import LLAMA_TINY, init
+from tony_tpu.models.serving import ContinuousBatcher
+from tony_tpu.models.serving_http import EngineServer
+
+
+def tiny_engine(**kw):
+    params = init(jax.random.PRNGKey(0), LLAMA_TINY)
+    defaults = dict(num_slots=2, max_len=64, decode_chunk=4)
+    defaults.update(kw)
+    return ContinuousBatcher(params, LLAMA_TINY, **defaults)
+
+
+def post(url, obj, timeout=120):
+    req = urllib.request.Request(
+        url, json.dumps(obj).encode(), {"Content-Type": "application/json"}
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+# ---------------------------------------------------------------------------
+# Unit: the thread-safe engine facade
+# ---------------------------------------------------------------------------
+class TestEngineServer:
+    def test_concurrent_requests_match_direct_engine(self):
+        # direct engine (same seed/params) is the parity reference
+        ref = tiny_engine()
+        rids = [ref.submit([1 + i, 2, 3], max_new_tokens=5) for i in range(3)]
+        expect = ref.run()
+
+        srv = EngineServer(tiny_engine()).start()
+        outs = [srv.submit([1 + i, 2, 3], max_tokens=5) for i in range(3)]
+        got = []
+        for out in outs:
+            toks = []
+            while True:
+                kind, payload = out.get(timeout=120)
+                assert kind != "error", payload
+                if kind == "done":
+                    got.append(list(payload))
+                    break
+                toks.extend(payload)
+        assert got == [expect[r] for r in rids]
+        srv.stop()
+
+    def test_drain_refuses_new_work(self):
+        srv = EngineServer(tiny_engine()).start()
+        out = srv.submit([1, 2], max_tokens=4)
+        kind = None
+        while kind != "done":
+            kind, payload = out.get(timeout=120)
+        srv.stop()
+        refused = srv.submit([1], max_tokens=1)
+        kind, payload = refused.get(timeout=10)
+        assert kind == "error" and "draining" in payload
+
+    def test_invalid_request_surfaces_error(self):
+        srv = EngineServer(tiny_engine(max_len=16)).start()
+        out = srv.submit([1] * 20, max_tokens=10)  # exceeds max_len
+        kind, payload = out.get(timeout=60)
+        assert kind == "error" and "max_len" in payload
+        srv.stop()
+
+    def test_engine_failure_errors_streams_and_marks_unhealthy(self):
+        """A dead-silent engine thread is the worst failure mode: streams
+        must error out, health must flip, and the fatal hook must fire."""
+        srv = EngineServer(tiny_engine())
+        fired = threading.Event()
+        srv._on_fatal = fired.set
+        srv.engine.step = lambda: (_ for _ in ()).throw(RuntimeError("device lost"))
+        srv.start()
+        out = srv.submit([1, 2], max_tokens=4)
+        kind, payload = out.get(timeout=60)
+        assert kind == "error" and "device lost" in payload
+        assert fired.wait(timeout=10)
+        assert srv.error is not None and not srv.stats()["healthy"]
+        # post-failure submissions are refused immediately
+        kind, payload = srv.submit([1], max_tokens=1).get(timeout=10)
+        assert kind == "error"
+
+    def test_drain_stream_reports_each_request_once(self):
+        eng = tiny_engine()
+        r1 = eng.submit([1, 2], max_new_tokens=3)
+        seen: dict[int, list[int]] = {}
+        finished: set[int] = set()
+        while eng.step():
+            for rid, (toks, done) in eng.drain_stream().items():
+                seen.setdefault(rid, []).extend(toks)
+                if done:
+                    assert rid not in finished
+                    finished.add(rid)
+        for rid, (toks, done) in eng.drain_stream().items():
+            seen.setdefault(rid, []).extend(toks)
+            if done:
+                assert rid not in finished
+                finished.add(rid)
+        assert finished == {r1}
+        assert seen[r1] == eng.done[r1]
+
+
+# ---------------------------------------------------------------------------
+# E2E: serve jobtype through the client → AM → executor spine
+# ---------------------------------------------------------------------------
+@pytest.mark.e2e
+class TestServeE2E:
+    def test_serve_job_end_to_end(self, tmp_tony_root):
+        config, _ = build_serve_config([
+            "--preset", "tiny", "--slots", "2", "--max_len", "64",
+            "--decode_chunk", "4",
+        ])
+        config.set(keys.STAGING_ROOT, str(tmp_tony_root))
+        config.set(keys.AM_MONITOR_INTERVAL_MS, "50")
+        config.set(keys.TASK_METRICS_INTERVAL_MS, "500")
+        client = Client(config)
+        handle = client.submit()
+        result: dict = {}
+        mon = threading.Thread(
+            target=lambda: result.update(final=client.monitor_application(handle, quiet=True)),
+            daemon=True,
+        )
+        mon.start()
+        try:
+            # 1. the endpoint registers its URL through the AM (§3.4 path)
+            target = wait_for_task_url(
+                handle, constants.SERVE_JOB_NAME, timeout_s=120
+            )
+            assert target is not None, "serve task never registered a URL"
+            url = f"http://{target[0]}:{target[1]}"
+
+            # 2. blocking completion + greedy determinism
+            r = post(url + "/v1/completions",
+                     {"prompt_tokens": [1, 2, 3], "max_tokens": 6})
+            assert r["finished"] and len(r["tokens"]) == 6
+            r2 = post(url + "/v1/completions",
+                      {"prompt_tokens": [1, 2, 3], "max_tokens": 6})
+            assert r2["tokens"] == r["tokens"]
+
+            # 3. streaming completion mid-run
+            req = urllib.request.Request(
+                url + "/v1/completions",
+                json.dumps({"prompt_tokens": [4, 5], "max_tokens": 8,
+                            "stream": True}).encode(),
+                {"Content-Type": "application/json"},
+            )
+            events = []
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert resp.headers["Content-Type"].startswith("text/event-stream")
+                for line in resp:
+                    line = line.decode().strip()
+                    if line.startswith("data: "):
+                        events.append(json.loads(line[6:]))
+                        if events[-1].get("finished"):
+                            break
+            assert events[-1]["finished"] and len(events[-1]["tokens"]) == 8
+
+            # 4. engine metrics flow into the AM task info (portal's source)
+            rpc = handle.rpc(timeout_s=10)
+            assert rpc is not None
+            deadline = time.time() + 30
+            metrics = {}
+            while time.time() < deadline:
+                infos = rpc.call("get_task_infos")
+                m = next(
+                    (i.get("metrics") for i in infos
+                     if i["name"] == constants.SERVE_JOB_NAME), None
+                ) or {}
+                metrics = m.get("train") or {}
+                if metrics.get("requests_done", 0) >= 3:
+                    break
+                time.sleep(0.2)
+            assert metrics.get("requests_done", 0) >= 3, metrics
+            assert "tokens_per_s" in metrics and "slots_active" in metrics
+        finally:
+            # 5. kill → graceful drain → KILLED verdict
+            Client.kill(handle)
+            mon.join(timeout=60)
+        assert result.get("final") == JobStatus.KILLED, handle.final_status()

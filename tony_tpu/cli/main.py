@@ -65,6 +65,12 @@ def _cmd_data_prep(argv: list[str]) -> int:
     return prep_main(argv)
 
 
+def _cmd_serve(argv: list[str]) -> int:
+    from tony_tpu.cli.serve import main as serve_main
+
+    return serve_main(argv)
+
+
 def _cmd_mini(argv: list[str]) -> int:
     """Self-contained sandbox: submit a smoke gang against the local resource
     manager and print the verdict + history location.
@@ -215,6 +221,7 @@ _COMMANDS = {
     "history": _cmd_history,
     "portal": _cmd_portal,
     "notebook": _cmd_notebook,
+    "serve": _cmd_serve,
     "mini": _cmd_mini,
     "data-prep": _cmd_data_prep,
 }
@@ -223,12 +230,13 @@ _COMMANDS = {
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|portal|notebook|mini|data-prep} [options]\n")
+        print("usage: tony {submit|pool|history|portal|notebook|serve|mini|data-prep} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    list finished jobs / dump one job's events")
         print("  portal     serve the history web portal")
         print("  notebook   launch an interactive notebook container + local proxy")
+        print("  serve      run the inference engine as an AM-supervised HTTP endpoint")
         print("  mini       one-command local sandbox (smoke gang, optional --distributed)")
         print("  data-prep  tokenize text files into TONYTOK training shards")
         return 0

@@ -27,26 +27,34 @@ DEFAULT_NOTEBOOK_CMD = (
 )
 
 
-def wait_for_notebook_url(
-    handle, timeout_s: float = 120.0, poll_s: float = 0.3
+def wait_for_task_url(
+    handle, job_name: str, timeout_s: float = 120.0, poll_s: float = 0.3
 ) -> tuple[str, int] | None:
-    """Poll the AM until the notebook task registers its URL → (host, port)."""
+    """Poll the AM until a ``job_name`` task registers its URL → (host, port).
+    Shared by the notebook proxy and ``tony serve`` (both ride the §3.4
+    register_task_url path)."""
     deadline = time.time() + timeout_s
     while time.time() < deadline:
         status = handle.final_status()
         if status is not None:
-            return None  # job already over — nothing to proxy
+            return None  # job already over — nothing to reach
         rpc = handle.rpc(timeout_s=5.0)
         if rpc is not None:
             try:
                 for info in rpc.call("get_task_infos"):
-                    if info["name"] == constants.NOTEBOOK_JOB_NAME and info.get("url"):
+                    if info["name"] == job_name and info.get("url"):
                         host, _, port = info["url"].rpartition("//")[2].partition(":")
                         return host, int(port)
             except Exception:  # noqa: BLE001 — AM may still be starting
                 pass
         time.sleep(poll_s)
     return None
+
+
+def wait_for_notebook_url(
+    handle, timeout_s: float = 120.0, poll_s: float = 0.3
+) -> tuple[str, int] | None:
+    return wait_for_task_url(handle, constants.NOTEBOOK_JOB_NAME, timeout_s, poll_s)
 
 
 def submit_notebook(

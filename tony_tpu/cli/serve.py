@@ -1,0 +1,116 @@
+"""``tony serve``: run the inference engine as an AM-supervised job.
+
+The reference's interactive-service shape (SURVEY.md §3.4: a one-task
+jobtype that registers its URL with the AM so the submitter can reach it —
+the NotebookSubmitter path) applied to serving: submits a single ``serve``
+task running the continuous-batching HTTP server
+(tony_tpu/models/serving_http.py), waits for the endpoint URL to register,
+prints it, and supervises until the job ends or Ctrl-C kills it. The server
+pushes engine throughput through the executor's metrics loop, so
+``tony portal`` charts tok/s, active slots, and queue depth live.
+
+Because it is an ordinary job, everything the orchestrator gives training
+jobs applies: pool queues/priority/preemption, restart-on-failure, history,
+and the portal. Kill → SIGTERM → the server drains (stops admitting,
+finishes in-flight requests) and exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.cluster.client import Client
+from tony_tpu.cluster.session import JobStatus
+from tony_tpu.cli.notebook import wait_for_task_url
+
+# flags forwarded verbatim to the serving_http process
+_ENGINE_FLAGS = (
+    "preset", "hf", "tokenizer", "slots", "max_len", "decode_chunk",
+    "prefill_chunk", "attn", "temperature", "top_k", "eos_id", "seed", "port",
+)
+
+
+def build_serve_config(argv: list[str]) -> tuple[TonyConfig, argparse.Namespace]:
+    p = argparse.ArgumentParser(prog="tony serve", description=__doc__)
+    p.add_argument("--conf_file", default=None)
+    p.add_argument("--conf", action="append", default=[], metavar="K=V")
+    p.add_argument("--preset", default="tiny")
+    p.add_argument("--hf", default="", help="HuggingFace checkpoint dir")
+    p.add_argument("--tokenizer", default="", help="tokenizer dir for text prompts")
+    p.add_argument("--int8", action="store_true")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--max_len", type=int, default=512)
+    p.add_argument("--decode_chunk", type=int, default=8)
+    p.add_argument("--prefill_chunk", type=int, default=0)
+    p.add_argument("--attn", default="auto")
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top_k", type=int, default=0)
+    p.add_argument("--eos_id", type=int, default=-1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--port", type=int, default=0, help="endpoint port (0 = free)")
+    p.add_argument("--url_timeout_s", type=float, default=180.0)
+    args = p.parse_args(argv)
+
+    cmd = [sys.executable, "-m", "tony_tpu.models.serving_http"]
+    for flag in _ENGINE_FLAGS:
+        v = getattr(args, flag)
+        if v not in ("", None):
+            cmd += [f"--{flag.replace('_', '-')}", str(v)]
+    if args.int8:
+        cmd.append("--int8")
+    config = TonyConfig.from_layers(conf_file=args.conf_file, conf_args=args.conf)
+    config.set(keys.jobtype_key(constants.SERVE_JOB_NAME, keys.INSTANCES_SUFFIX), "1")
+    config.set(
+        keys.jobtype_key(constants.SERVE_JOB_NAME, keys.COMMAND_SUFFIX),
+        shlex.join(cmd),
+    )
+    return config, args
+
+
+def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0) -> int:
+    client = Client(config)
+    handle = client.submit()
+    print(f"[tony-serve] submitted {handle.app_id}", flush=True)
+    try:
+        target = wait_for_task_url(
+            handle, constants.SERVE_JOB_NAME, timeout_s=url_timeout_s
+        )
+    except KeyboardInterrupt:
+        print("[tony-serve] interrupt — killing serving job", flush=True)
+        Client.kill(handle)
+        client.monitor_application(handle, quiet=True)
+        return constants.EXIT_KILLED
+    if target is None:
+        print("[tony-serve] endpoint never registered a URL", file=sys.stderr)
+        Client.kill(handle)
+        client.monitor_application(handle, quiet=True)
+        return constants.EXIT_FAILURE
+    print(
+        f"[tony-serve] endpoint http://{target[0]}:{target[1]} "
+        f"(POST /v1/completions; GET /stats, /healthz)",
+        flush=True,
+    )
+    try:
+        final = client.monitor_application(handle, quiet=True)
+    except KeyboardInterrupt:
+        print("[tony-serve] interrupt — killing serving job (drains first)", flush=True)
+        Client.kill(handle)
+        final = client.monitor_application(handle, quiet=True)
+    return (
+        constants.EXIT_SUCCESS
+        if final in (JobStatus.SUCCEEDED, JobStatus.KILLED)
+        else constants.EXIT_FAILURE
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    config, args = build_serve_config(list(sys.argv[1:] if argv is None else argv))
+    return submit_serve(config, url_timeout_s=args.url_timeout_s)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -302,6 +302,9 @@ class ApplicationMaster:
                 constants.ENV_STAGING_DIR: self.staging_dir,
                 constants.ENV_JOB_NAME: container.job_type,
                 constants.ENV_TASK_INDEX: str(container.task_index),
+                constants.ENV_KILL_GRACE_MS: str(
+                    self.config.get_time_ms(keys.TASK_KILL_GRACE_MS, 3000)
+                ),
                 "TONY_RESTART_ATTEMPT": str(self._restart_attempt),
                 "PYTHONPATH": _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", ""),
             }

@@ -279,11 +279,12 @@ class TaskExecutor:
             return None
 
     def _kill_child(self) -> None:
+        grace_s = self.config.get_time_ms(keys.TASK_KILL_GRACE_MS, 3000) / 1000
         if self.child and self.child.poll() is None:
             try:
                 os.killpg(os.getpgid(self.child.pid), signal.SIGTERM)
                 try:
-                    self.child.wait(timeout=3)
+                    self.child.wait(timeout=grace_s)
                 except subprocess.TimeoutExpired:
                     os.killpg(os.getpgid(self.child.pid), signal.SIGKILL)
             except ProcessLookupError:

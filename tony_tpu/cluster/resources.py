@@ -288,6 +288,7 @@ class ContainerLauncher:
 
     def __init__(self) -> None:
         self._procs: dict[str, subprocess.Popen] = {}
+        self._grace_s: dict[str, float] = {}
         self._reported: set[str] = set()
         self._lock = threading.Lock()
 
@@ -297,6 +298,14 @@ class ContainerLauncher:
         os.makedirs(log_dir, exist_ok=True)
         if env.get(constants.ENV_CONTAINER_RUNTIME_TYPE) == "docker":
             command = _docker_wrap(command, env)
+        # SIGTERM→SIGKILL grace, from the job's env contract (the AM sets it
+        # from tony.task.kill-grace-ms): long-draining tasks — a serving
+        # endpoint finishing in-flight requests — need more than the 3 s
+        # default before escalation
+        try:
+            grace_s = float(env.get(constants.ENV_KILL_GRACE_MS, "3000")) / 1000
+        except ValueError:
+            grace_s = 3.0
         with open(os.path.join(log_dir, "stdout.log"), "ab") as stdout, open(
             os.path.join(log_dir, "stderr.log"), "ab"
         ) as stderr:
@@ -309,6 +318,7 @@ class ContainerLauncher:
             )
         with self._lock:
             self._procs[container_id] = proc
+            self._grace_s[container_id] = grace_s
 
     def poll_exited(self) -> dict[str, int]:
         out: dict[str, int] = {}
@@ -324,12 +334,14 @@ class ContainerLauncher:
 
     def kill(self, container_id: str, wait: bool = True) -> None:
         """SIGTERM the container's process group, escalating to SIGKILL after
-        a 3 s grace. ``wait=False`` runs the grace/escalation in a background
-        thread — the node agent's heartbeat loop must never block on a
-        container's teardown (a 3 s synchronous wait exceeds the liveness
+        the container's grace window (tony.task.kill-grace-ms; default 3 s).
+        ``wait=False`` runs the grace/escalation in a background thread — the
+        node agent's heartbeat loop must never block on a container's
+        teardown (a synchronous multi-second wait exceeds the liveness
         window and gets the whole NODE declared dead)."""
         with self._lock:
             proc = self._procs.get(container_id)
+            grace_s = self._grace_s.get(container_id, 3.0)
         if not proc or proc.poll() is not None:
             return
         try:
@@ -340,7 +352,7 @@ class ContainerLauncher:
 
         def escalate() -> None:
             try:
-                proc.wait(timeout=3)
+                proc.wait(timeout=grace_s)
             except subprocess.TimeoutExpired:
                 try:
                     os.killpg(pgid, signal.SIGKILL)

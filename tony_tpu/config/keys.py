@@ -47,6 +47,7 @@ TASK_MAX_MISSED_HEARTBEATS = "tony.task.max-missed-heartbeats"
 TASK_METRICS_INTERVAL_MS = "tony.task.metrics-interval-ms"
 TASK_EXECUTOR_REGISTRATION_TIMEOUT_MS = "tony.task.registration-timeout-ms"
 TASK_EXECUTOR_EXECUTION_TIMEOUT_MS = "tony.task.execution-timeout-ms"  # 0 = unlimited
+TASK_KILL_GRACE_MS = "tony.task.kill-grace-ms"     # SIGTERM→SIGKILL window (serve tasks drain here)
 TASK_RESTART_ON_FAILURE = "tony.task.restart-on-failure"  # gang-restart-from-checkpoint
 TASK_MAX_TOTAL_INSTANCE_FAILURES = "tony.task.max-total-instance-failures"
 TASK_PROFILE = "tony.task.profile"                 # capture jax.profiler traces per worker
@@ -160,6 +161,7 @@ DEFAULTS: dict[str, str] = {
     TASK_METRICS_INTERVAL_MS: "5000",
     TASK_EXECUTOR_REGISTRATION_TIMEOUT_MS: "60000",
     TASK_EXECUTOR_EXECUTION_TIMEOUT_MS: "0",
+    TASK_KILL_GRACE_MS: "3000",
     TASK_RESTART_ON_FAILURE: "false",
     TASK_MAX_TOTAL_INSTANCE_FAILURES: "3",  # only consulted when restart-on-failure
     TASK_PROFILE: "false",

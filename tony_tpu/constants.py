@@ -53,6 +53,7 @@ ENV_DISTRIBUTED_MODE = "DISTRIBUTED_MODE"  # GANG | SINGLE_NODE
 ENV_CLUSTER_SPEC = "CLUSTER_SPEC"       # full cluster spec JSON (legacy TF contract)
 ENV_TB_PORT = "TB_PORT"                 # tensorboard task port
 ENV_TRAIN_METRICS_FILE = "TONY_TRAIN_METRICS_FILE"  # train loop drops step metrics here; executor push loop picks them up
+ENV_KILL_GRACE_MS = "TONY_KILL_GRACE_MS"  # SIGTERM→SIGKILL window for this container (tony.task.kill-grace-ms)
 ENV_CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"            # from tony.checkpoint.dir
 ENV_CHECKPOINT_INTERVAL = "TONY_CHECKPOINT_INTERVAL"  # from tony.checkpoint.interval-steps
 ENV_NOTEBOOK_PORT = "NOTEBOOK_PORT"     # notebook task port (proxied by submitter)
@@ -105,6 +106,7 @@ PS_JOB_NAME = "ps"
 EVALUATOR_JOB_NAME = "evaluator"
 TENSORBOARD_JOB_NAME = "tensorboard"
 NOTEBOOK_JOB_NAME = "notebook"
+SERVE_JOB_NAME = "serve"
 DRIVER_JOB_NAME = "driver"
 
 # Exit codes (analog of TonY's exit-code conventions)

@@ -90,6 +90,12 @@ def _decode_one(
     Dh, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     maxT = cache.k.shape[3]
     cos, sin = L.rope_frequencies(Dh, maxT, cfg.rope_theta, cfg.rope_scaling)
+    # KERNEL PRECONDITION: active slots have lengths < maxT (enforced by
+    # submit()'s prompt+budget <= max_len check). A slot clamped AT maxT
+    # would attend both the stale cached entry at maxT-1 and the current
+    # token (double-counting one position) in the read-only-cache split —
+    # only retired-not-yet-flushed slots decoding discarded overshoot
+    # tokens can reach that state, and their output is never read.
     pos = jnp.minimum(cache.lengths, maxT - 1)                      # write position
     x = _embed_lookup(params["embed"], tokens[:, None], cfg.jdtype)  # [S, 1, D]
 
@@ -305,6 +311,10 @@ class ContinuousBatcher:
         # when a whole batch retires together (r3-cont)
         self._retired_slots: list[int] = []
         self._next_rid = 0
+        # streaming cursor per request: drain_stream() hands out tokens
+        # appended since the last drain (serving_http's SSE path)
+        self._stream_pos: dict[int, int] = {}
+        self._stream_done: set[int] = set()
         # prefill state machine entries, dispatched ahead of slot
         # availability (overlap with the in-flight decode chunk):
         # [request, prefill cache, tokens prefilled, first token | None]
@@ -467,7 +477,38 @@ class ContinuousBatcher:
                 if req.is_done(self.eos_id):
                     break  # post-budget/post-EOS chunk tokens are discarded
             self._retire_if_done(req)
-        return bool(self.running or self.pending or self._staged)
+        more = bool(self.running or self.pending or self._staged)
+        if not more:
+            # drained: zero the final chunk's retirees now — cache.lengths is
+            # externally observable and must agree with _slot_len between runs
+            self._flush_retired()
+        return more
+
+    def drain_stream(self) -> dict[int, tuple[list[int], bool]]:
+        """Tokens appended per request since the last drain:
+        {rid: (new_tokens, finished)}. Pure host-side bookkeeping (reads
+        ``req.out`` cursors) — call between ``step()``s to stream
+        incrementally; a finished request is reported exactly once with its
+        final tokens and then forgotten."""
+        out: dict[int, tuple[list[int], bool]] = {}
+        # prune: once a finished request is popped from ``done`` by the
+        # caller, its dedup entry has no further use — without this the set
+        # grows with every request a long-lived server ever finishes
+        self._stream_done &= self.done.keys()
+        for rid, toks in self.done.items():
+            if rid not in self._stream_done:
+                pos = self._stream_pos.pop(rid, 0)
+                out[rid] = (list(toks[pos:]), True)
+                self._stream_done.add(rid)
+        live = [e[0] for e in self._staged] + list(self.pending) + list(self.running.values())
+        for req in live:
+            if req.rid in self._stream_done or req.rid in out:
+                continue
+            pos = self._stream_pos.get(req.rid, 0)
+            if len(req.out) > pos:
+                out[req.rid] = (list(req.out[pos:]), False)
+                self._stream_pos[req.rid] = len(req.out)
+        return out
 
     def run(self) -> dict[int, list[int]]:
         """Drain all submitted requests; returns {request_id: tokens}."""

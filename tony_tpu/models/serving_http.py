@@ -1,0 +1,446 @@
+"""HTTP front end for the continuous-batching engine: the ``serve`` jobtype.
+
+The reference runs training jobs and interactive notebooks under the AM
+(SURVEY.md §3.4: the notebook jobtype registers its URL so the submitter can
+proxy it); serving is new TPU-era capability built the same way — a
+long-running, AM-supervised task that:
+
+- boots a ``ContinuousBatcher`` (models/serving.py) over a model preset,
+  HF checkpoint, or random-init weights (bench/test mode), optionally int8;
+- serves a streaming completions API (stdlib ThreadingHTTPServer — one
+  user-facing control path, no framework dependency):
+    POST /v1/completions   {"prompt_tokens": [...], "max_tokens": N,
+                            "stream": true|false, "temperature": ..,
+                            "top_k": ..}  → JSON or SSE token stream
+    GET  /healthz           liveness
+    GET  /stats             engine counters (slots, queue depth, tok/s)
+- when launched inside a tony container (TONY_AM_* env present), registers
+  its URL over the AM RPC (``register_task_url`` — the §3.4 path) and drops
+  engine throughput into ENV_TRAIN_METRICS_FILE so the executor's existing
+  metrics loop feeds the portal;
+- drains on SIGTERM: stops admitting, finishes the in-flight decode chunk,
+  answers in-flight streams, exits 0.
+
+Threading model: HTTP handler threads only ever touch thread-safe queues;
+ONE engine thread owns the batcher (submit → step → drain_stream), so the
+engine itself needs no locks — the same host/device split the engine's
+docstring promises stays intact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import jax
+
+from tony_tpu import constants
+from tony_tpu.models.llama import PRESETS, init
+from tony_tpu.models.serving import ContinuousBatcher
+
+
+class EngineServer:
+    """Thread-safe facade over one ContinuousBatcher.
+
+    HTTP threads call ``submit()`` (enqueue + wait on a per-request queue);
+    the engine thread drains the inbox, steps the batcher, and fans tokens
+    out. ``stop()`` initiates the drain."""
+
+    def __init__(self, engine: ContinuousBatcher, on_fatal=None):
+        self.engine = engine
+        self._inbox: "queue.Queue[tuple[list[int], int, queue.Queue]]" = queue.Queue()
+        self._streams: dict[int, queue.Queue] = {}
+        self._draining = threading.Event()
+        self._stopped = threading.Event()
+        # serializes the draining-check+enqueue in submit() against the
+        # loop's final refuse-sweep: without it a request slipping between
+        # the sweep and _stopped would sit in an inbox nobody reads
+        self._admit_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, name="engine", daemon=True)
+        self.error: BaseException | None = None  # fatal engine failure, if any
+        self._on_fatal = on_fatal
+        # engine counters (read by /stats without locking: ints are atomic)
+        self.started_s = time.time()
+        self.tokens_out = 0
+        self.requests_done = 0
+
+    def start(self) -> "EngineServer":
+        self._thread.start()
+        return self
+
+    def submit(self, prompt_tokens: list[int], max_tokens: int) -> queue.Queue:
+        """Enqueue a request; returns the queue its events arrive on:
+        ("tokens", [..]) zero or more times, then ("done", all_tokens) —
+        or ("error", message)."""
+        out: queue.Queue = queue.Queue()
+        with self._admit_lock:
+            if self._draining.is_set() or self.error is not None:
+                out.put(("error", "server is draining" if self.error is None
+                         else f"engine failed: {self.error}"))
+                return out
+            self._inbox.put((prompt_tokens, max_tokens, out))
+        return out
+
+    def stats(self) -> dict[str, Any]:
+        eng = self.engine
+        up = max(time.time() - self.started_s, 1e-9)
+        return {
+            "slots_total": eng.S,
+            "slots_active": len(eng.running),
+            "queue_depth": len(eng.pending) + len(eng._staged) + self._inbox.qsize(),
+            "requests_done": self.requests_done,
+            "tokens_out": self.tokens_out,
+            "tokens_per_s": round(self.tokens_out / up, 2),
+            "uptime_s": round(up, 1),
+            "draining": self._draining.is_set(),
+            "healthy": self.error is None,
+        }
+
+    def stop(self, timeout_s: float = 10.0) -> bool:
+        """Drain: no new admissions; in-flight requests finish. Returns True
+        if the drain completed inside ``timeout_s`` (False → truncated)."""
+        self._draining.set()
+        return self._stopped.wait(timeout_s)
+
+    def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except BaseException as e:  # noqa: BLE001 — a dead silent engine thread
+            # is the worst failure mode: every in-flight stream would block
+            # forever while /healthz keeps answering ok. Record, error out
+            # every stream, and tell the process (the AM supervises restarts).
+            import traceback
+
+            self.error = e
+            traceback.print_exc()
+            for out in self._streams.values():
+                out.put(("error", f"engine failed: {e}"))
+            self._streams.clear()
+            if self._on_fatal is not None:
+                self._on_fatal()
+        finally:
+            # refuse anything still queued (or enqueued mid-teardown)
+            with self._admit_lock:
+                self._draining.set()
+                while True:
+                    try:
+                        self._inbox.get_nowait()[2].put(("error", "server is draining"))
+                    except queue.Empty:
+                        break
+                self._stopped.set()
+
+    def _loop_inner(self) -> None:
+        eng = self.engine
+        carry = None  # item pulled by the idle wait — admitted FIRST (FIFO)
+        while True:
+            while True:
+                if carry is not None:
+                    prompt, max_tokens, out = carry
+                    carry = None
+                else:
+                    try:
+                        prompt, max_tokens, out = self._inbox.get_nowait()
+                    except queue.Empty:
+                        break
+                try:
+                    rid = eng.submit(prompt, max_tokens)
+                except ValueError as e:
+                    out.put(("error", str(e)))
+                    continue
+                self._streams[rid] = out
+            had_work = eng.step()
+            for rid, (toks, done) in eng.drain_stream().items():
+                out = self._streams.get(rid)
+                final = eng.done.pop(rid, None) if done else None
+                if out is None:
+                    continue
+                self.tokens_out += len(toks)
+                if done:
+                    self.requests_done += 1
+                    out.put(("done", final if final is not None else toks))
+                    del self._streams[rid]
+                else:
+                    out.put(("tokens", toks))
+            if not had_work:
+                if self._draining.is_set():
+                    return
+                # idle: block until the next request (or drain) arrives; the
+                # pulled item is carried to the admission pass directly —
+                # re-queuing it would reorder it behind later arrivals
+                try:
+                    carry = self._inbox.get(timeout=0.2)
+                except queue.Empty:
+                    pass
+
+
+def _json_body(handler: BaseHTTPRequestHandler) -> dict:
+    n = int(handler.headers.get("Content-Length") or 0)
+    return json.loads(handler.rfile.read(n) or b"{}")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ref: EngineServer = None  # set by serve()
+    tokenizer = None
+
+    def log_message(self, *a) -> None:  # quiet
+        pass
+
+    def _reply(self, code: int, obj: Any) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802
+        if self.path == "/healthz":
+            err = self.server_ref.error
+            if err is None:
+                self._reply(200, {"ok": True})
+            else:
+                self._reply(503, {"ok": False, "error": str(err)})
+        elif self.path == "/stats":
+            self._reply(200, self.server_ref.stats())
+        else:
+            self._reply(404, {"error": "not found"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path != "/v1/completions":
+            self._reply(404, {"error": "not found"})
+            return
+        try:
+            req = _json_body(self)
+            prompt = req.get("prompt_tokens")
+            if prompt is None and "prompt" in req:
+                if self.tokenizer is None:
+                    raise ValueError("text prompts need --tokenizer; send prompt_tokens")
+                prompt = self.tokenizer.encode(req["prompt"])
+            if not prompt:
+                raise ValueError("empty prompt")
+            max_tokens = int(req.get("max_tokens", 16))
+            stream = bool(req.get("stream", False))
+        except (ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        out = self.server_ref.submit([int(t) for t in prompt], max_tokens)
+        if stream:
+            self._stream_response(out)
+        else:
+            self._block_response(out)
+
+    def _block_response(self, out: "queue.Queue") -> None:
+        toks: list[int] = []
+        while True:
+            kind, payload = out.get()
+            if kind == "error":
+                self._reply(503 if "draining" in payload else 400, {"error": payload})
+                return
+            if kind == "tokens":
+                toks.extend(payload)
+            else:  # done → payload is the authoritative full list
+                self._reply(200, {"tokens": list(payload), "finished": True})
+                return
+
+    def _stream_response(self, out: "queue.Queue") -> None:
+        """SSE: one ``data: {"tokens": [...]}`` event per decode chunk, then
+        ``data: {"finished": true, ...}``."""
+        first_kind, first_payload = out.get()
+        if first_kind == "error":
+            self._reply(503 if "draining" in first_payload else 400, {"error": first_payload})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+
+        def emit(obj: Any) -> None:
+            self.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
+            self.wfile.flush()
+
+        kind, payload = first_kind, first_payload
+        while True:
+            if kind == "tokens":
+                emit({"tokens": payload})
+            elif kind == "done":
+                emit({"finished": True, "tokens": list(payload)})
+                return
+            else:
+                emit({"error": payload})
+                return
+            kind, payload = out.get()
+
+
+def _register_with_am(url: str) -> None:
+    """Inside a tony container, publish the endpoint through the AM
+    (SURVEY.md §3.4 register_task_url path). No-op standalone."""
+    host = os.environ.get(constants.ENV_AM_HOST)
+    if not host:
+        return
+    from tony_tpu.cluster.rpc import RpcClient, RpcError
+
+    try:
+        cli = RpcClient(
+            host,
+            int(os.environ[constants.ENV_AM_PORT]),
+            secret=os.environ.get(constants.ENV_AM_SECRET, ""),
+        )
+        cli.call(
+            "register_task_url",
+            job_name=os.environ.get(constants.ENV_JOB_NAME, "serve"),
+            index=int(os.environ.get(constants.ENV_TASK_INDEX, "0")),
+            url=url,
+            attempt=int(os.environ.get("TONY_RESTART_ATTEMPT", "0")),
+        )
+        cli.close()
+    except (RpcError, OSError, ValueError):
+        pass  # AM unreachable: serving still works, just unadvertised
+
+
+def _metrics_pump(srv: EngineServer, stop: threading.Event, interval_s: float = 2.0) -> None:
+    """Drop engine stats into ENV_TRAIN_METRICS_FILE (atomic rename) — the
+    executor's metrics loop ships them to the AM, so the portal charts
+    serving throughput with the machinery training already uses."""
+    path = os.environ.get(constants.ENV_TRAIN_METRICS_FILE)
+    if not path:
+        return
+    step = 0
+    last_tokens = 0
+    last_t = time.time()
+    while not stop.wait(interval_s):
+        step += 1
+        now, toks = time.time(), srv.tokens_out
+        rate = (toks - last_tokens) / max(now - last_t, 1e-9)
+        last_tokens, last_t = toks, now
+        st = srv.stats()
+        line = {
+            "step": step,
+            "tokens_per_s": round(rate, 2),
+            "slots_active": st["slots_active"],
+            "queue_depth": st["queue_depth"],
+            "requests_done": st["requests_done"],
+        }
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(line, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+
+def build_engine(args) -> ContinuousBatcher:
+    cfg = PRESETS[args.preset]
+    if args.hf:
+        from tony_tpu.models.convert import from_hf
+
+        params, cfg = from_hf(args.hf)
+    else:
+        params = init(jax.random.PRNGKey(args.seed), cfg)
+    if args.int8:
+        from tony_tpu.ops.quant import quantize_tree
+
+        params = quantize_tree(params)
+    return ContinuousBatcher(
+        params, cfg,
+        num_slots=args.slots, max_len=args.max_len, eos_id=args.eos_id,
+        temperature=args.temperature, top_k=args.top_k,
+        decode_chunk=args.decode_chunk, attn=args.attn,
+        prefill_chunk=args.prefill_chunk,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tony-serve", description="continuous-batching HTTP inference server"
+    )
+    p.add_argument("--preset", default="tiny", choices=sorted(PRESETS),
+                   help="model preset (random init unless --hf)")
+    p.add_argument("--hf", default="", help="HuggingFace checkpoint dir to load")
+    p.add_argument("--tokenizer", default="", help="tokenizer dir for text prompts")
+    p.add_argument("--int8", action="store_true", help="int8 weight-only quantization")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--max-len", type=int, default=512)
+    p.add_argument("--decode-chunk", type=int, default=8)
+    p.add_argument("--prefill-chunk", type=int, default=0)
+    p.add_argument("--attn", default="auto", choices=["auto", "ragged", "bucketed"])
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--eos-id", type=int, default=-1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--host", default="",
+                   help="bind AND advertise this host; default: bind all "
+                        "interfaces, advertise the container's reachable "
+                        "address (loopback deployments stay on loopback)")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--url-file", default="", help="write the bound URL here once serving")
+    args = p.parse_args(argv)
+
+    done = threading.Event()
+    srv = EngineServer(build_engine(args), on_fatal=done.set).start()
+    tokenizer = None
+    if args.tokenizer:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+    handler = type("Handler", (_Handler,), {"server_ref": srv, "tokenizer": tokenizer})
+    if args.host:
+        bind_host, adv_host = args.host, args.host
+    else:
+        # same reachability rule as the executor's URL registration: a
+        # remote pool needs a routable address, a loopback deployment must
+        # NOT advertise a hostname other containers can't resolve
+        from tony_tpu.cluster.executor import _own_host
+
+        bind_host = "0.0.0.0"
+        adv_host = _own_host(os.environ.get(constants.ENV_AM_HOST, "127.0.0.1"))
+    httpd = ThreadingHTTPServer((bind_host, args.port), handler)
+    url = f"http://{adv_host}:{httpd.server_address[1]}"
+    if args.url_file:
+        tmp = args.url_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(url)
+        os.replace(tmp, args.url_file)
+    _register_with_am(url)
+    stop_metrics = threading.Event()
+    threading.Thread(
+        target=_metrics_pump, args=(srv, stop_metrics), daemon=True
+    ).start()
+
+    def _drain(*_):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    print(f"[tony-serve] {url} preset={args.preset} slots={args.slots} "
+          f"max_len={args.max_len}", flush=True)
+    done.wait()
+    if srv.error is not None:
+        print(f"[tony-serve] engine failed: {srv.error}", file=sys.stderr, flush=True)
+        httpd.shutdown()
+        return 1
+    # graceful drain: refuse new work, finish in-flight, then exit 0. The
+    # budget is the container's SIGTERM→SIGKILL window
+    # (tony.task.kill-grace-ms) minus a margin for teardown itself.
+    grace_ms = float(os.environ.get(constants.ENV_KILL_GRACE_MS, "0") or 0)
+    budget_s = max(grace_ms / 1000 - 1.0, 2.0) if grace_ms else 10.0
+    print(f"[tony-serve] draining (budget {budget_s:.0f}s)", flush=True)
+    if not srv.stop(timeout_s=budget_s):
+        print(f"[tony-serve] drain timed out with {len(srv._streams)} "
+              f"request(s) in flight — truncating", file=sys.stderr, flush=True)
+    stop_metrics.set()
+    httpd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
