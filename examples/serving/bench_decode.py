@@ -37,6 +37,14 @@ def main() -> int:
                         "chip — required for models whose bf16 weights don't "
                         "fit HBM before quantization (llama3-8b on one v5e)")
     p.add_argument("--attn", default="auto", choices=["auto", "ragged", "bucketed"])
+    p.add_argument("--kv", default="dense", choices=["dense", "paged"],
+                   help="paged: block-paged KV pool + shared-prefix reuse")
+    p.add_argument("--page-len", type=int, default=256)
+    p.add_argument("--num-pages", type=int, default=0,
+                   help="page pool size (0 = dense-equivalent)")
+    p.add_argument("--shared-prefix", type=int, default=0,
+                   help=">0: every request shares a prompt prefix of this "
+                        "many tokens (prefix-cache workload)")
     p.add_argument("--long-slot", action="store_true",
                    help="pre-occupy slot 0 with a near-max_len request: with "
                         "attn=ragged the other slots' tokens/s should barely "
@@ -90,10 +98,26 @@ def main() -> int:
 
     eng = ContinuousBatcher(
         params, cfg, num_slots=args.slots, max_len=args.max_len,
-        decode_chunk=args.chunk, attn=args.attn,
+        decode_chunk=args.chunk, attn=args.attn, kv=args.kv,
+        page_len=args.page_len,
+        num_pages=args.num_pages if args.num_pages > 0 else None,
     )
     rng = np.random.default_rng(0)
     n_short = args.slots
+    shared = []
+    if args.shared_prefix > 0:
+        # the shared prefix is PART of the prompt (prompts stay at
+        # --prompt-len); at least one token per request stays unique so the
+        # last-token logits differ per request
+        n_shared = min(args.shared_prefix, args.prompt_len - 1)
+        if n_shared < args.shared_prefix:
+            print(f"[bench] shared prefix capped at {n_shared} "
+                  f"(prompt-len {args.prompt_len})", file=sys.stderr)
+        if args.kv == "paged" and n_shared < args.page_len:
+            print(f"[bench] WARNING: shared prefix {n_shared} < page-len "
+                  f"{args.page_len}: no full page to share — zero prefix hits",
+                  file=sys.stderr)
+        shared = rng.integers(0, cfg.vocab_size, n_shared).tolist()
     if args.long_slot:
         # one near-max-length resident request; its decode budget outlasts
         # the short requests so it stays active the whole measurement
@@ -102,7 +126,8 @@ def main() -> int:
                    max_new_tokens=args.new_tokens)
         n_short -= 1
     for _ in range(n_short):
-        prompt = rng.integers(0, cfg.vocab_size, args.prompt_len).tolist()
+        tail = max(args.prompt_len - len(shared), 1)
+        prompt = shared + rng.integers(0, cfg.vocab_size, tail).tolist()
         eng.submit(prompt, max_new_tokens=args.new_tokens)
 
     # admission (prefills) + decode-chunk compile warmup
@@ -127,7 +152,15 @@ def main() -> int:
     out = {
         "metric": f"{args.model}_decode_tokens_per_sec_1chip",
         "attn": eng.attn,
+        "kv": args.kv,
         "long_slot": bool(args.long_slot),
+        **(
+            {
+                "pages_total": eng.num_pages - 1,
+                "prefix_hit_tokens": eng.prefix_hit_tokens,
+            }
+            if args.kv == "paged" else {}
+        ),
         "value": round(n_tokens / dt, 1),
         "unit": "tokens/sec/chip",
         "slots": args.slots,

@@ -1,0 +1,204 @@
+"""Paged KV cache + prefix caching (VERDICT r3 #4).
+
+Block-paged page pool with per-slot page tables, refcounted shared-prefix
+reuse, reservation-based admission. Properties under test:
+- the paged Pallas kernel matches the dense ragged kernel bit-for-bit in
+  math (interpret mode on CPU), including sliding windows and page-table
+  indirection through a shuffled pool;
+- the paged ENGINE matches the dense engine's greedy outputs exactly;
+- N same-prefix requests cost ~1 prefill (prefix_hit_tokens accounting)
+  and still match the dense engine;
+- a pool smaller than slots × max_pages (the HBM win) still serves
+  everything, waiting at admission instead of failing;
+- allocator invariants: page 0 never allocated, LRU reuse-pool eviction,
+  refcount sharing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.models.llama import LLAMA_TINY, init
+from tony_tpu.models.paged_cache import PageAllocator, prefix_keys
+from tony_tpu.models.serving import ContinuousBatcher
+
+
+def _params():
+    return init(jax.random.PRNGKey(0), LLAMA_TINY)
+
+
+# ---------------------------------------------------------------------------
+# Kernel parity: paged vs dense ragged, shuffled pages, with/without SWA
+# ---------------------------------------------------------------------------
+class TestPagedKernel:
+    def test_matches_dense_ragged_kernel(self):
+        from tony_tpu.ops.decode_attention import (
+            paged_decode_attention,
+            ragged_decode_attention,
+        )
+
+        S, H, Hkv, maxT, Dh, PLEN = 3, 4, 2, 256, 128, 64
+        max_pages = maxT // PLEN
+        P = S * max_pages + 2
+        ks = jax.random.split(jax.random.PRNGKey(3), 5)
+        q = jax.random.normal(ks[0], (S, H, Dh), jnp.float32)
+        ck = jax.random.normal(ks[1], (S, Hkv, maxT, Dh), jnp.float32)
+        cv = jax.random.normal(ks[2], (S, Hkv, maxT, Dh), jnp.float32)
+        cur_k = jax.random.normal(ks[3], (S, Hkv, Dh), jnp.float32)
+        cur_v = jax.random.normal(ks[4], (S, Hkv, Dh), jnp.float32)
+        lengths = jnp.array([0, 129, 250], jnp.int32)
+        # scatter the dense caches into a SHUFFLED page pool: parity then
+        # proves the page-table indirection, not just the math
+        rng = np.random.default_rng(0)
+        pt = rng.permutation(P)[: S * max_pages].reshape(S, max_pages).astype(np.int32)
+        kp = np.zeros((P, Hkv, PLEN, Dh), np.float32)
+        vp = np.zeros((P, Hkv, PLEN, Dh), np.float32)
+        for s in range(S):
+            for j in range(max_pages):
+                kp[pt[s, j]] = np.asarray(ck)[s, :, j * PLEN:(j + 1) * PLEN]
+                vp[pt[s, j]] = np.asarray(cv)[s, :, j * PLEN:(j + 1) * PLEN]
+        for window in (0, 100):
+            want = ragged_decode_attention(
+                q, ck, cv, lengths, cur_k=cur_k, cur_v=cur_v,
+                window=window, chunk=PLEN,
+            )
+            got = paged_decode_attention(
+                q, jnp.asarray(kp), jnp.asarray(vp), lengths, jnp.asarray(pt),
+                cur_k=cur_k, cur_v=cur_v, window=window,
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5,
+                err_msg=f"window={window}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# Allocator invariants
+# ---------------------------------------------------------------------------
+class TestPageAllocator:
+    def test_page_zero_never_allocated(self):
+        a = PageAllocator(6)
+        got = a.alloc(5)
+        assert 0 not in got and sorted(got) == [1, 2, 3, 4, 5]
+        with pytest.raises(RuntimeError, match="exhausted"):
+            a.alloc(1)
+
+    def test_release_unkeyed_returns_to_free(self):
+        a = PageAllocator(4)
+        p = a.alloc(1)[0]
+        a.release(p)
+        assert a.available() == 3 and p in a.alloc(3)
+
+    def test_refcount_sharing(self):
+        a = PageAllocator(4)
+        keys = prefix_keys([1, 2, 3, 4], 2)  # two full pages
+        pages = a.alloc(2)
+        for p, k in zip(pages, keys):
+            a.register(p, k)
+        shared = a.match_prefix(keys)
+        assert shared == pages  # both matched and pinned (ref 2)
+        for p in pages:
+            a.release(p)  # first holder retires
+        assert a.available() == 1  # still live via the second holder
+        for p in pages:
+            a.release(p)  # second holder retires → reuse pool
+        assert a.available() == 3
+        assert a.match_prefix(keys) == pages  # resurrected from reuse pool
+        for p in pages:
+            a.release(p)
+
+    def test_lru_eviction_of_reuse_pool(self):
+        a = PageAllocator(4)  # 3 usable
+        keys = prefix_keys([9, 9, 8, 8, 7, 7], 2)
+        pages = a.alloc(3)
+        for p, k in zip(pages, keys):
+            a.register(p, k)
+        for p in pages:
+            a.release(p)  # all parked in the reuse pool
+        fresh = a.alloc(2)  # evicts the two LRU pages
+        assert set(fresh) == set(pages[:2])
+        assert a.match_prefix(keys) == []  # chain broken at evicted page 0
+        assert a.match_prefix(keys[1:2]) == []  # keys are cumulative chains
+
+
+# ---------------------------------------------------------------------------
+# Engine: parity, sharing, capacity
+# ---------------------------------------------------------------------------
+class TestPagedEngine:
+    def test_greedy_parity_with_dense_engine(self):
+        params = _params()
+        dense = ContinuousBatcher(params, LLAMA_TINY, num_slots=3, max_len=128,
+                                  decode_chunk=4)
+        paged = ContinuousBatcher(params, LLAMA_TINY, num_slots=3, max_len=128,
+                                  decode_chunk=4, kv="paged", page_len=32)
+        prompts = [[1, 2, 3], [7, 8, 9, 10, 11], list(range(1, 40))]
+        rd = [dense.submit(p, max_new_tokens=8) for p in prompts]
+        rp = [paged.submit(p, max_new_tokens=8) for p in prompts]
+        outd, outp = dense.run(), paged.run()
+        for a, b in zip(rd, rp):
+            assert outd[a] == outp[b]
+
+    def test_shared_prefix_burst_prefills_once(self):
+        """VERDICT done-when (a): N same-prefix slots ~1 prefill cost."""
+        params = _params()
+        paged = ContinuousBatcher(params, LLAMA_TINY, num_slots=4, max_len=128,
+                                  decode_chunk=4, kv="paged", page_len=32)
+        prefix = list(range(3, 3 + 64))  # exactly 2 full pages
+        reqs = [paged.submit(prefix + [100 + i], max_new_tokens=4) for i in range(4)]
+        out = paged.run()
+        # 3 of the 4 requests reuse both prefix pages: 3 × 64 skipped tokens
+        assert paged.prefix_hit_tokens == 3 * 64
+        dense = ContinuousBatcher(params, LLAMA_TINY, num_slots=4, max_len=128,
+                                  decode_chunk=4)
+        rd = [dense.submit(prefix + [100 + i], max_new_tokens=4) for i in range(4)]
+        outd = dense.run()
+        for a, b in zip(rd, reqs):
+            assert outd[a] == out[b]
+
+    def test_late_arrival_reuses_resident_prefix(self):
+        params = _params()
+        paged = ContinuousBatcher(params, LLAMA_TINY, num_slots=2, max_len=128,
+                                  decode_chunk=4, kv="paged", page_len=32)
+        prefix = list(range(5, 5 + 32))
+        r1 = paged.submit(prefix + [70], max_new_tokens=3)
+        out1 = paged.run()
+        # first request retired; its full prompt page parks in the reuse pool
+        r2 = paged.submit(prefix + [71], max_new_tokens=3)
+        out2 = paged.run()
+        assert paged.prefix_hit_tokens == 32
+        assert len(out2[r2]) == 3 and len(out1[r1]) == 3
+
+    def test_small_pool_overcommit_waits_and_serves(self):
+        """VERDICT done-when (b): pool smaller than slots × max_pages —
+        admission waits for pages, every request still completes."""
+        params = _params()
+        paged = ContinuousBatcher(params, LLAMA_TINY, num_slots=4, max_len=128,
+                                  decode_chunk=4, kv="paged", page_len=32,
+                                  num_pages=9)  # 8 usable vs 4 slots × 4 pages
+        rids = [paged.submit([5 + i], max_new_tokens=30) for i in range(6)]
+        out = paged.run()
+        assert len(out) == 6 and all(len(v) == 30 for v in out.values())
+        assert paged.allocator.live_pages() == 0  # everything reclaimed
+
+    def test_oversized_request_rejected_at_submit(self):
+        params = _params()
+        paged = ContinuousBatcher(params, LLAMA_TINY, num_slots=2, max_len=128,
+                                  decode_chunk=4, kv="paged", page_len=32,
+                                  num_pages=3)  # 2 usable pages = 64 positions
+        with pytest.raises(ValueError, match="pages"):
+            paged.submit(list(range(1, 100)), max_new_tokens=20)
+
+    def test_swa_paged_matches_dense(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(LLAMA_TINY, sliding_window=48)
+        params = init(jax.random.PRNGKey(1), cfg)
+        dense = ContinuousBatcher(params, cfg, num_slots=2, max_len=128,
+                                  decode_chunk=4)
+        paged = ContinuousBatcher(params, cfg, num_slots=2, max_len=128,
+                                  decode_chunk=4, kv="paged", page_len=32)
+        prompt = list(range(2, 2 + 60))  # longer than the window
+        a = dense.submit(prompt, max_new_tokens=10)
+        b = paged.submit(prompt, max_new_tokens=10)
+        assert dense.run()[a] == paged.run()[b]

@@ -1,0 +1,110 @@
+"""Per-request sampling: temperature / top-k / top-p (VERDICT r3 #7).
+
+The dynamic ``sample_logits`` (one compiled variant, per-row device params)
+against hand-computable distributions, and the engine's per-slot path: mixed
+greedy + sampled requests decoding in the same batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tony_tpu.models.generate import sample_logits
+from tony_tpu.models.llama import LLAMA_TINY, init
+from tony_tpu.models.serving import ContinuousBatcher
+
+
+def _counts(fn, n=300):
+    out = {}
+    for i in range(n):
+        t = int(fn(jax.random.PRNGKey(i))[0])
+        out[t] = out.get(t, 0) + 1
+    return out
+
+
+class TestSampleLogits:
+    LOGITS = jnp.asarray([[3.0, 2.0, 1.0, 0.0, -10.0]])
+
+    def _one(self, temp, k, p):
+        return lambda key: sample_logits(
+            self.LOGITS, key,
+            jnp.asarray([temp], jnp.float32),
+            jnp.asarray([k], jnp.int32),
+            jnp.asarray([p], jnp.float32),
+        )
+
+    def test_greedy_row(self):
+        assert _counts(self._one(0.0, 0, 0.0), n=5) == {0: 5}
+
+    def test_top_k_restricts_support(self):
+        got = _counts(self._one(1.0, 2, 0.0))
+        assert set(got) <= {0, 1} and len(got) == 2  # only the top-2 tokens
+
+    def test_top_p_restricts_support(self):
+        # softmax([3,2,1,0,-10]) ≈ [.66,.24,.09,.03,~0]; p=.7 keeps {0,1}
+        got = _counts(self._one(1.0, 0, 0.7))
+        assert set(got) <= {0, 1} and len(got) == 2
+
+    def test_top_p_one_keeps_all_support(self):
+        got = _counts(self._one(2.0, 0, 1.0), n=600)
+        assert set(got) >= {0, 1, 2, 3}  # p=1 → no nucleus cut
+
+    def test_rows_are_independent(self):
+        logits = jnp.tile(self.LOGITS, (3, 1))
+        toks = sample_logits(
+            logits, jax.random.PRNGKey(0),
+            jnp.asarray([0.0, 1.0, 0.0], jnp.float32),
+            jnp.asarray([0, 2, 0], jnp.int32),
+            jnp.asarray([0.0, 0.0, 0.0], jnp.float32),
+        )
+        assert int(toks[0]) == 0 and int(toks[2]) == 0  # greedy rows
+        assert int(toks[1]) in (0, 1)                   # top-2 sampled row
+
+    def test_matches_static_sampler_distribution(self):
+        # same key, same effective params → identical draw as _sample
+        from tony_tpu.models.generate import _sample
+
+        key = jax.random.PRNGKey(7)
+        logits = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+        want = _sample(logits, key, 0.8, 3)
+        got = sample_logits(
+            logits, key,
+            jnp.full((4,), 0.8, jnp.float32),
+            jnp.full((4,), 3, jnp.int32),
+            jnp.zeros((4,), jnp.float32),
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestEnginePerSlotSampling:
+    def test_mixed_greedy_and_sampled_slots(self):
+        params = init(jax.random.PRNGKey(0), LLAMA_TINY)
+        eng = ContinuousBatcher(params, LLAMA_TINY, num_slots=3, max_len=64,
+                                decode_chunk=4)
+        # greedy reference from a pure-greedy engine
+        ref_eng = ContinuousBatcher(params, LLAMA_TINY, num_slots=3, max_len=64,
+                                    decode_chunk=4)
+        ref = ref_eng.run() if False else None  # noqa: F841 — layout aid
+        g_ref = ref_eng.submit([1, 2, 3], max_new_tokens=8)
+        ref_out = ref_eng.run()
+
+        g = eng.submit([1, 2, 3], max_new_tokens=8)                      # default greedy
+        s1 = eng.submit([4, 5], max_new_tokens=8, temperature=1.0, top_k=5)
+        s2 = eng.submit([6, 7], max_new_tokens=8, temperature=0.9, top_p=0.8)
+        out = eng.run()
+        # the greedy slot is EXACTLY the pure-greedy engine's output even
+        # while sampled slots decode alongside it
+        assert out[g] == ref_out[g_ref]
+        assert len(out[s1]) == 8 and len(out[s2]) == 8
+        vocab = LLAMA_TINY.vocab_size
+        assert all(0 <= t < vocab for t in out[s1] + out[s2])
+
+    def test_per_request_override_validation(self):
+        params = init(jax.random.PRNGKey(0), LLAMA_TINY)
+        eng = ContinuousBatcher(params, LLAMA_TINY, num_slots=1, max_len=32)
+        import pytest
+
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit([1], max_new_tokens=1, top_p=1.5)
+        with pytest.raises(ValueError, match="temperature"):
+            eng.submit([1], max_new_tokens=1, temperature=-1)

@@ -254,6 +254,38 @@ def _sample(logits, key, temperature: float, top_k: int):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def sample_logits(logits, key, temperature, top_k, top_p):
+    """PER-ROW sampling with temperature / top-k / top-p (nucleus), all
+    DEVICE arrays [B] — one compiled variant serves every mixture of
+    per-request params (the serving engine's per-slot path; the static
+    ``_sample`` stays the cheap batch path when every row shares params).
+
+    Row semantics: temperature 0 → greedy (argmax; the key is unused for
+    that row); top_k 0 → no k-cut; top_p outside (0, 1) → no nucleus cut.
+    One descending sort powers both cuts.
+    """
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]                     # [B, V]
+    # top-k: threshold at the k-th largest (k<=0 → keep all)
+    k_idx = jnp.clip(top_k - 1, 0, V - 1)
+    kth = jnp.take_along_axis(desc, k_idx[:, None], axis=1)       # [B, 1]
+    keep_k = (top_k[:, None] <= 0) | (scaled >= kth)
+    # top-p: smallest prefix of the sorted probs with mass >= p; the
+    # threshold is the logit of the LAST kept rank
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    p = top_p[:, None]
+    nucleus = (cum - probs) < p                                    # keep-while mask
+    last_rank = jnp.maximum(nucleus.sum(axis=-1) - 1, 0)           # [B]
+    pth = jnp.take_along_axis(desc, last_rank[:, None], axis=1)    # [B, 1]
+    keep_p = (p <= 0) | (p >= 1) | (scaled >= pth)
+    masked = jnp.where(keep_k & keep_p, scaled, -1e30)
+    sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
 def generate(
     params,
     prompt: jax.Array,

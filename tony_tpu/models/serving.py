@@ -48,6 +48,7 @@ from tony_tpu.models.generate import (
     _mm,
     _sample,
     init_cache,
+    sample_logits,
 )
 from tony_tpu.models.llama import LlamaConfig
 from tony_tpu.ops import layers as L
@@ -76,8 +77,9 @@ def init_slot_cache(cfg: LlamaConfig, num_slots: int, max_len: int) -> SlotCache
 
 
 def _decode_one(
-    params, cache: SlotCache, tokens: jax.Array, key: jax.Array,
+    params, cache, tokens: jax.Array, key: jax.Array,
     cfg: LlamaConfig, temperature: float = 0.0, top_k: int = 0, attn: str = "bucketed",
+    samp=None,
 ):
     """One token for every slot, slot-native: (next tokens [S], cache').
 
@@ -85,10 +87,20 @@ def _decode_one(
     maxT-1). Inactive slots decode garbage harmlessly; the host ignores
     them. Projections and the FFN (dense SwiGLU or the Mixtral mixture —
     generate._ffn_with_cache) run batched over the slot dim.
+
+    ``cache`` is a SlotCache (dense per-slot slabs) or a PagedCache (page
+    pool + per-slot page tables, models/paged_cache.py): the trace-time
+    branch picks the attention read (per-slot slab DMA vs page-indirected
+    DMA — same kernel body) and the write (per-slot column scatter vs
+    (page, offset) scatter). Everything else — projections, RoPE, FFN,
+    sampling — is identical, so the two cache layouts cannot drift.
     """
+    from tony_tpu.models.paged_cache import PagedCache
+
+    paged = isinstance(cache, PagedCache)
     S = tokens.shape[0]
     Dh, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
-    maxT = cache.k.shape[3]
+    maxT = (cache.page_table.shape[1] * cache.k.shape[3]) if paged else cache.k.shape[3]
     cos, sin = L.rope_frequencies(Dh, maxT, cfg.rope_theta, cfg.rope_scaling)
     # KERNEL PRECONDITION: active slots have lengths < maxT (enforced by
     # submit()'s prompt+budget <= max_len check). A slot clamped AT maxT
@@ -105,7 +117,7 @@ def _decode_one(
     # through the scan instead (the first r3 design) stacked a full cache
     # copy as scan ys EVERY token — measured −32% decode tok/s at 64 slots.
     def layer(x, inputs):
-        lp, ck, cv = inputs  # ck/cv [S, Hkv, maxT, Dh], read-only
+        lp, ck, cv = inputs  # dense: ck/cv [S, Hkv, maxT, Dh]; paged: [P, Hkv, page_len, Dh]
         h = L.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = _mm(h, lp["wq"]).reshape(S, 1, H, Dh).transpose(0, 2, 1, 3)
         k = _mm(h, lp["wk"]).reshape(S, 1, Hkv, Dh).transpose(0, 2, 1, 3)
@@ -114,7 +126,14 @@ def _decode_one(
         k = L.apply_rope(k, cos, sin, positions=pos[:, None])
         k1 = k[:, :, 0].astype(ck.dtype)                             # [S, Hkv, Dh]
         v1 = v[:, :, 0].astype(cv.dtype)
-        if attn == "ragged":
+        if paged:
+            from tony_tpu.ops.decode_attention import paged_decode_attention
+
+            o = paged_decode_attention(
+                q[:, :, 0], ck, cv, pos, cache.page_table, cur_k=k1, cur_v=v1,
+                window=cfg.sliding_window,
+            )
+        elif attn == "ragged":
             from tony_tpu.ops.decode_attention import ragged_decode_attention
 
             o = ragged_decode_attention(
@@ -134,7 +153,29 @@ def _decode_one(
     x, (ks_new, vs_new) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
     x = L.rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = _mm(x[:, 0], params["lm_head"]).astype(jnp.float32)     # [S, V]
-    nxt = _sample(logits, key, temperature, top_k)
+    if samp is not None:
+        nxt = sample_logits(logits, key, *samp)  # per-slot temp/top_k/top_p
+    else:
+        nxt = _sample(logits, key, temperature, top_k)
+
+    # idle slots (length 0 — flushed retirements / never admitted) stay at 0
+    # instead of regrowing +1 per step: their stale cache never re-enters
+    # the ragged kernel's Σ len_s (active slots always have length ≥ 1)
+    new_len = jnp.where(
+        cache.lengths > 0, jnp.minimum(cache.lengths + 1, maxT), 0
+    )
+    if paged:
+        # single write: scatter each slot's [L, Hkv, Dh] column at its
+        # (physical page, in-page offset) — advanced indexing puts the
+        # slot axis FIRST in the indexed view, hence the transposes
+        page_len = cache.k.shape[3]
+        pages = cache.page_table[jnp.arange(S), pos // page_len]     # [S]
+        offs = pos % page_len
+        ks = cache.k.at[:, pages, :, offs, :].set(ks_new.transpose(1, 0, 2, 3))
+        vs = cache.v.at[:, pages, :, offs, :].set(vs_new.transpose(1, 0, 2, 3))
+        from tony_tpu.models.paged_cache import PagedCache as _PC
+
+        return nxt, _PC(ks, vs, new_len, cache.page_table)
 
     # single write: scatter each slot's [L, Hkv, Dh] column at its position
     # (the donated cache updates in place — no full-cache copy per token)
@@ -144,12 +185,6 @@ def _decode_one(
 
     ks = jax.vmap(write_slot, in_axes=(1, 1, 0), out_axes=1)(cache.k, ks_new, pos)
     vs = jax.vmap(write_slot, in_axes=(1, 1, 0), out_axes=1)(cache.v, vs_new, pos)
-    # idle slots (length 0 — flushed retirements / never admitted) stay at 0
-    # instead of regrowing +1 per step: their stale cache never re-enters
-    # the ragged kernel's Σ len_s (active slots always have length ≥ 1)
-    new_len = jnp.where(
-        cache.lengths > 0, jnp.minimum(cache.lengths + 1, maxT), 0
-    )
     return nxt, SlotCache(ks, vs, new_len)
 
 
@@ -165,17 +200,21 @@ decode_step = functools.partial(
 def decode_steps(
     params, cache: SlotCache, tokens: jax.Array, key: jax.Array,
     cfg: LlamaConfig, n: int, temperature: float = 0.0, top_k: int = 0,
-    attn: str = "ragged",
+    attn: str = "ragged", samp=None,
 ):
     """``n`` decode steps in ONE compiled call (lax.scan): (tokens [S],
     all tokens [n, S], cache'). Amortizes per-dispatch host overhead —
     the dominant cost of single-token steps on remote/tunneled backends.
     With ``attn='ragged'`` the Pallas kernel reads each slot's own cache
-    length, so no bucketing is needed (or helpful)."""
+    length, so no bucketing is needed (or helpful). ``samp``: per-slot
+    (temperature, top_k, top_p) device arrays — overrides the static
+    sampling params when present."""
 
     def body(carry, k_step):
         cache, toks = carry
-        nxt, cache = _decode_one(params, cache, toks, k_step, cfg, temperature, top_k, attn)
+        nxt, cache = _decode_one(
+            params, cache, toks, k_step, cfg, temperature, top_k, attn, samp
+        )
         return (cache, nxt), nxt
 
     (cache, toks), seq = jax.lax.scan(body, (cache, tokens), jax.random.split(key, n))
@@ -190,6 +229,7 @@ def decode_steps(
 def decode_steps_bucketed(
     params, cache: SlotCache, tokens: jax.Array, key: jax.Array,
     cfg: LlamaConfig, n: int, bucket: int, temperature: float = 0.0, top_k: int = 0,
+    samp=None,
 ):
     """``decode_steps`` over a LENGTH-BUCKETED cache view (XLA fallback):
     attention reads only the first ``bucket`` cache positions (a power of
@@ -201,7 +241,9 @@ def decode_steps_bucketed(
 
     def body(carry, k_step):
         c, toks = carry
-        nxt, c = _decode_one(params, c, toks, k_step, cfg, temperature, top_k, "bucketed")
+        nxt, c = _decode_one(
+            params, c, toks, k_step, cfg, temperature, top_k, "bucketed", samp
+        )
         return (c, nxt), nxt
 
     (sub, toks), seq = jax.lax.scan(body, (sub, tokens), jax.random.split(key, n))
@@ -238,6 +280,10 @@ class _Request:
     max_new_tokens: int
     out: list[int] = field(default_factory=list)
     slot: int = -1
+    # per-request sampling overrides (None → the engine's defaults)
+    temperature: float | None = None
+    top_k: int | None = None
+    top_p: float | None = None
 
     def is_done(self, eos_id: int) -> bool:
         """THE termination predicate — budget spent or EOS emitted. Both the
@@ -245,6 +291,18 @@ class _Request:
         return len(self.out) >= self.max_new_tokens or (
             eos_id >= 0 and bool(self.out) and self.out[-1] == eos_id
         )
+
+
+@dataclass
+class _Staged:
+    """A request mid-prefill, staged ahead of slot availability."""
+
+    req: _Request
+    pre: KVCache                      # per-request dense staging cache
+    pos: int = 0                      # prompt tokens prefilled so far
+    first: object = None              # sampled first output token (None → prefilling)
+    matched: list[int] = field(default_factory=list)  # pinned shared-prefix pages
+    keys: list[tuple] = field(default_factory=list)   # cumulative prefix keys (paged)
 
 
 class ContinuousBatcher:
@@ -271,22 +329,43 @@ class ContinuousBatcher:
         self, params, cfg: LlamaConfig, *, num_slots: int = 8, max_len: int = 512,
         eos_id: int = -1, temperature: float = 0.0, top_k: int = 0,
         key: jax.Array | None = None, decode_chunk: int = 8, attn: str = "auto",
-        prefill_chunk: int = 0,
+        prefill_chunk: int = 0, kv: str = "dense", page_len: int = 256,
+        num_pages: int | None = None,
     ):
         if num_slots < 1 or max_len < 1:
             raise ValueError(f"need num_slots>=1 and max_len>=1, got {num_slots}/{max_len}")
+        if kv not in ("dense", "paged"):
+            raise ValueError(f"kv must be dense|paged, got {kv!r}")
+        self.kv = kv
+        if kv == "paged":
+            # paged mode always decodes through the paged Pallas kernel; the
+            # attn policy knob only governs the dense engine
+            if page_len < 8 or page_len % 8:
+                raise ValueError(f"page_len must be a multiple of 8 >= 8, got {page_len}")
+            if max_len % page_len:
+                raise ValueError(f"max_len {max_len} must be a multiple of page_len {page_len}")
         if attn == "auto" and jax.default_backend() == "cpu":
             attn = "bucketed"
         if attn not in ("auto", "ragged", "bucketed"):
             raise ValueError(f"attn must be auto|ragged|bucketed, got {attn!r}")
         if attn == "auto" and max_len <= self.RAGGED_THRESHOLD:
             attn = "bucketed"  # ragged could never engage at this max_len
-        if attn in ("auto", "ragged") and max_len % 128:
+        if kv == "dense" and attn in ("auto", "ragged") and max_len % 128:
             raise ValueError(f"attn={attn!r} needs max_len % 128 == 0, got {max_len}")
         self.params, self.cfg = params, cfg
         self.S, self.max_len, self.eos_id = num_slots, max_len, eos_id
         self.temperature, self.top_k = temperature, top_k
         self.attn = attn
+        # per-slot sampling state (host mirrors, shipped per decode chunk):
+        # engine defaults until a request overrides them. The first override
+        # latches _per_slot and switches the decode step to the dynamic
+        # sampler (one-time recompile; greedy/static engines never pay it)
+        self._samp_temp = np.full((num_slots,), temperature, np.float32)
+        self._samp_topk = np.full((num_slots,), top_k, np.int32)
+        self._samp_topp = np.zeros((num_slots,), np.float32)
+        self._per_slot = False
+        self._samp_dev = None  # cached device copies; refreshed when dirty
+        self._samp_dirty = True
         # decode this many tokens per compiled call; requests finishing
         # mid-chunk simply DISCARD their overshoot tokens (see step()). >1
         # amortizes host dispatch overhead at the cost of admission latency
@@ -298,7 +377,26 @@ class ContinuousBatcher:
         # chunk pads to a bucket (garbage K/V past the prompt is masked by
         # the slot length, as in the unchunked path).
         self.prefill_chunk = prefill_chunk
-        self.cache = init_slot_cache(cfg, num_slots, max_len)
+        if kv == "paged":
+            from tony_tpu.models.paged_cache import PageAllocator, init_paged_cache
+
+            self.page_len = page_len
+            self.max_pages = max_len // page_len
+            # default pool = dense-equivalent (every slot fully backed) + the
+            # sacrificial page; the capacity win comes from running MORE
+            # slots against the same pool (or a smaller pool) — HBM then
+            # tracks reserved tokens, not slots × max_len
+            self.num_pages = (
+                num_pages if num_pages is not None else num_slots * self.max_pages + 1
+            )
+            self.allocator = PageAllocator(self.num_pages)
+            self.cache = init_paged_cache(cfg, num_slots, max_len, page_len, self.num_pages)
+            self._slot_pages: dict[int, list[int]] = {}  # slot → reserved pages
+            #: cumulative count of prompt tokens whose prefill compute was
+            #: skipped via prefix-cache hits (the sharing win, observable)
+            self.prefix_hit_tokens = 0
+        else:
+            self.cache = init_slot_cache(cfg, num_slots, max_len)
         self.tokens = jnp.zeros((num_slots,), jnp.int32)  # last token per slot
         self.key = key if key is not None else jax.random.PRNGKey(0)
         self.pending: list[_Request] = []
@@ -315,32 +413,66 @@ class ContinuousBatcher:
         # appended since the last drain (serving_http's SSE path)
         self._stream_pos: dict[int, int] = {}
         self._stream_done: set[int] = set()
-        # prefill state machine entries, dispatched ahead of slot
-        # availability (overlap with the in-flight decode chunk):
-        # [request, prefill cache, tokens prefilled, first token | None]
-        self._staged: list[list] = []
+        # prefill state machine, dispatched ahead of slot availability
+        # (overlap with the in-flight decode chunk)
+        self._staged: list[_Staged] = []
         self._slot_len = [0] * num_slots  # host mirror of cache.lengths
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
+    def submit(
+        self, prompt, max_new_tokens: int, *,
+        temperature: float | None = None, top_k: int | None = None,
+        top_p: float | None = None,
+    ) -> int:
+        """``temperature``/``top_k``/``top_p`` override the engine defaults
+        for THIS request only (per-slot sampling); None keeps the default."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if temperature is not None and temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if top_k is not None and top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if top_p is not None and not 0 <= top_p <= 1:
+            raise ValueError(f"top_p must be in [0, 1], got {top_p}")
         if len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
                 f"exceeds engine max_len {self.max_len}"
             )
+        if self.kv == "paged":
+            need = self._pages_needed(len(prompt), max_new_tokens)
+            if need > self.num_pages - 1:
+                raise ValueError(
+                    f"request needs {need} pages but the pool holds "
+                    f"{self.num_pages - 1}: raise num_pages or shrink the request"
+                )
         rid = self._next_rid
         self._next_rid += 1
-        self.pending.append(_Request(rid, prompt, max_new_tokens))
+        if temperature is not None or top_k is not None or top_p is not None:
+            self._per_slot = True
+        self.pending.append(_Request(
+            rid, prompt, max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+        ))
         return rid
 
     # -- engine internals ---------------------------------------------------
 
     def _free_slots(self) -> list[int]:
         return [s for s in range(self.S) if s not in self.running]
+
+    def _pages_needed(self, Tp: int, max_new: int) -> int:
+        """Worst-case page RESERVATION for a request: prompt + budget,
+        rounded up to whole decode chunks — a request retiring mid-chunk
+        keeps writing (discarded) tokens until the chunk ends, and those
+        writes must land inside its own pages. Reserving up front means
+        decode can never hit an empty pool mid-request: admission is the
+        only wait point, exactly like waiting for a free slot."""
+        h = self.decode_chunk
+        hi = min(Tp + -(-max_new // h) * h, self.max_len)
+        return -(-hi // self.page_len)
 
     def _stage_prefills(self, budget: int, advance: bool = True):
         """Stage up to ``budget`` pending requests and (when ``advance``)
@@ -351,17 +483,69 @@ class ContinuousBatcher:
         one-chunk-per-step stall bound honest."""
         while self.pending and len(self._staged) < budget:
             req = self.pending.pop(0)
-            self._staged.append([req, init_cache(self.cfg, 1, self.max_len), 0, None])
-        if advance:
-            for entry in self._staged:
-                self._advance_prefill(entry)
+            entry = _Staged(req, init_cache(self.cfg, 1, self.max_len))
+            if self.kv == "paged":
+                from tony_tpu.models.paged_cache import prefix_keys
 
-    def _advance_prefill(self, entry) -> None:
-        """Run one prefill chunk (or the whole prompt when unchunked)."""
-        req, pre, pos, first = entry
+                entry.keys = prefix_keys(req.prompt, self.page_len)
+                self._match_prefix_into(entry)
+            self._staged.append(entry)
+        if advance:
+            # burst dedup: a staged entry whose FIRST full page matches ANY
+            # earlier still-staged entry defers its prefill — the earlier
+            # one admits and registers its pages, and this one re-matches
+            # them (_advance_prefill) instead of recomputing. The leader
+            # keeps claiming its key even after ITS prefill completes:
+            # while it is page-blocked at admission nothing is registered
+            # yet, and letting a follower through would burn a full
+            # redundant prefill per blocked round.
+            seen_first: set[tuple] = set()
+            for entry in self._staged:
+                fk = entry.keys[0] if entry.keys else None
+                defer = (
+                    fk is not None and fk in seen_first
+                    and entry.first is None and entry.pos == 0 and not entry.matched
+                )
+                if fk is not None:
+                    seen_first.add(fk)
+                if not defer:
+                    self._advance_prefill(entry)
+
+    def _match_prefix_into(self, entry: _Staged) -> bool:
+        """Shared-prefix reuse (paged kv): pin the longest resident chain of
+        FULL prompt pages, copy it into the entry's staging cache, and start
+        prefill after it — N same-prefix requests run ~1 prefill. Capped at
+        (Tp-1)//page_len: the LAST prompt token must always be prefilled
+        (its logits sample the first output token). Only callable while the
+        entry has no pins and no prefill progress."""
+        from tony_tpu.models.paged_cache import gather_prefix_into_staging
+
+        cap = (len(entry.req.prompt) - 1) // self.page_len
+        matched = self.allocator.match_prefix(entry.keys[:cap])
+        if not matched:
+            return False
+        entry.pre = gather_prefix_into_staging(
+            entry.pre, self.cache.k, self.cache.v,
+            jnp.asarray(matched, jnp.int32), n=len(matched),
+        )
+        entry.pos = len(matched) * self.page_len
+        entry.matched = matched
+        self.prefix_hit_tokens += entry.pos
+        return True
+
+    def _advance_prefill(self, entry: _Staged) -> None:
+        """Run one prefill chunk (or the whole prompt when unchunked).
+        ``pos`` starts past any shared-prefix pages (paged kv)."""
+        req, pre, pos, first = entry.req, entry.pre, entry.pos, entry.first
         if first is not None:
             return
         Tp = len(req.prompt)
+        if self.kv == "paged" and pos == 0 and not entry.matched:
+            # the prefix chain may have grown since this entry was staged
+            # (an earlier same-prefix request admitted) — re-match before
+            # spending any prefill compute
+            if self._match_prefix_into(entry):
+                pre, pos = entry.pre, entry.pos
         step = self.prefill_chunk if self.prefill_chunk > 0 else Tp
         while first is None:
             take = min(step, Tp - pos)
@@ -382,11 +566,24 @@ class ContinuousBatcher:
             logits, pre = _prefill_padded(self.params, toks, pre, self.cfg)
             pos += take
             if last:
-                first = _sample(
-                    logits[:, take - 1].astype(jnp.float32), self._split(),
-                    self.temperature, self.top_k,
-                )
-            entry[1], entry[2], entry[3] = pre, pos, first
+                last_logits = logits[:, take - 1].astype(jnp.float32)
+                if (
+                    req.temperature is not None or req.top_k is not None
+                    or req.top_p is not None
+                ):
+                    first = sample_logits(
+                        last_logits, self._split(),
+                        jnp.full((1,), req.temperature if req.temperature is not None
+                                 else self.temperature, jnp.float32),
+                        jnp.full((1,), req.top_k if req.top_k is not None
+                                 else self.top_k, jnp.int32),
+                        jnp.full((1,), req.top_p or 0.0, jnp.float32),
+                    )
+                else:
+                    first = _sample(
+                        last_logits, self._split(), self.temperature, self.top_k
+                    )
+            entry.pre, entry.pos, entry.first = pre, pos, first
             if self.prefill_chunk > 0:
                 break  # one chunk per engine step — decode interleaves
 
@@ -395,22 +592,87 @@ class ContinuousBatcher:
         # only compute prefills here when nothing is decoding (startup /
         # drain); otherwise they advance after the decode chunk dispatches
         self._stage_prefills(len(free), advance=not self.running)
-        while self._staged and free and self._staged[0][3] is not None:
-            req, pre, _, first = self._staged.pop(0)
-            slot = free.pop(0)
+        while self._staged and free and self._staged[0].first is not None:
+            head = self._staged[0]
+            req, pre, first = head.req, head.pre, head.first
+            slot = free[0]
             Tp = len(req.prompt)
-            self.cache = _insert_prefill(
-                self.cache, pre, jnp.int32(slot), jnp.int32(Tp)
-            )
+            if self.kv == "paged":
+                if not self._admit_paged(req, pre, head.matched, head.keys, slot, Tp):
+                    break  # pages short: admission waits for retirements
+            else:
+                self.cache = _insert_prefill(
+                    self.cache, pre, jnp.int32(slot), jnp.int32(Tp)
+                )
+            self._staged.pop(0)
+            free.pop(0)
             self.tokens = self.tokens.at[slot].set(first[0])
+            self._samp_temp[slot] = (
+                req.temperature if req.temperature is not None else self.temperature
+            )
+            self._samp_topk[slot] = req.top_k if req.top_k is not None else self.top_k
+            self._samp_topp[slot] = req.top_p if req.top_p is not None else 0.0
+            self._samp_dirty = True
             self._slot_len[slot] = Tp
             req.slot = slot
             req.out.append(int(first[0]))
             self.running[slot] = req
             self._retire_if_done(req)  # 1-token requests finish at admission
 
+    def _admit_paged(
+        self, req, pre, matched: list[int], keys: list[tuple], slot: int, Tp: int
+    ) -> bool:
+        """Reserve pages, attach the shared prefix, copy the prefilled span,
+        install the page-table row. False → pool short, caller waits."""
+        import numpy as np
+
+        from tony_tpu.models.paged_cache import insert_paged_prefill
+
+        # a retired-but-unflushed slot being re-admitted still holds its old
+        # reservation — release it BEFORE the availability check (the freed
+        # pages may be exactly what covers this admission; checking first
+        # would stall the request one needless chunk)
+        for p in self._slot_pages.pop(slot, []):
+            self.allocator.release(p)
+        n_covered = self._pages_needed(Tp, req.max_new_tokens)
+        n_fresh = n_covered - len(matched)
+        if n_fresh > self.allocator.available():
+            # nothing running means nothing will retire to free pages — the
+            # only reclaimable capacity is OTHER staged entries' prefix pins.
+            # Demoting a pin is free: its content was already COPIED into
+            # that entry's staging cache, so insert simply copies instead of
+            # attaching. Demote and retry once; still short → a true wait.
+            if not self.running:
+                for entry in self._staged:
+                    if entry.req is not req and entry.matched:
+                        for p in entry.matched:
+                            self.allocator.release(p)
+                        entry.matched = []
+                if n_fresh > self.allocator.available():
+                    return False
+            else:
+                return False
+        fresh = self.allocator.alloc(n_fresh)
+        row = list(matched) + fresh                      # logical page order
+        n_prefill = -(-Tp // self.page_len)              # pages holding prompt K/V
+        nc = n_prefill - len(matched)                    # pages to COPY from staging
+        pt_row = np.zeros(self.max_pages, np.int32)
+        pt_row[:n_covered] = row
+        self.cache = insert_paged_prefill(
+            self.cache, pre.k, pre.v,
+            jnp.asarray(fresh[:nc], jnp.int32), jnp.asarray(pt_row),
+            jnp.int32(slot), jnp.int32(Tp), jnp.int32(len(matched)), n=nc,
+        )
+        # content-address the request's FULL prompt pages so later
+        # same-prefix requests reuse them (first writer wins)
+        for j in range(Tp // self.page_len):
+            if j >= len(matched):
+                self.allocator.register(row[j], keys[j])
+        self._slot_pages[slot] = row
+        return True
+
     def _split(self):
-        if self.temperature == 0.0:
+        if self.temperature == 0.0 and not self._per_slot:
             return self.key  # greedy sampling never consumes the key
         self.key, sub = jax.random.split(self.key)
         return sub
@@ -430,10 +692,27 @@ class ContinuousBatcher:
         idle = [s for s in self._retired_slots if s not in self.running]
         self._retired_slots = []
         if idle:
-            self.cache = SlotCache(
-                self.cache.k, self.cache.v,
-                self.cache.lengths.at[jnp.asarray(idle, jnp.int32)].set(0),
-            )
+            idx = jnp.asarray(idle, jnp.int32)
+            if self.kv == "paged":
+                from tony_tpu.models.paged_cache import PagedCache
+
+                # release the reservation (registered full-prompt pages park
+                # in the allocator's reuse pool for future prefix hits) and
+                # reset the page-table rows: an idle slot's garbage write
+                # lands in the sacrificial page 0, never a live page
+                for s in idle:
+                    for p in self._slot_pages.pop(s, []):
+                        self.allocator.release(p)
+                self.cache = PagedCache(
+                    self.cache.k, self.cache.v,
+                    self.cache.lengths.at[idx].set(0),
+                    self.cache.page_table.at[idx].set(0),
+                )
+            else:
+                self.cache = SlotCache(
+                    self.cache.k, self.cache.v,
+                    self.cache.lengths.at[idx].set(0),
+                )
 
     def step(self) -> bool:
         """Admit + one decode chunk. Returns True while work remains."""
@@ -446,22 +725,39 @@ class ContinuousBatcher:
         # (their cache writes clamp at the view's end and the slot is fully
         # overwritten at its next admission)
         h = self.decode_chunk
-        needed = max(self._slot_len[s] for s in self.running) + h
-        bucket = min(_bucket(max(needed, 1)), self.max_len)
-        use_ragged = self.attn == "ragged" or (
-            self.attn == "auto" and bucket > self.RAGGED_THRESHOLD
-        )
+        if self.kv == "paged":
+            # paged decode has exactly one path: the page-indirected ragged
+            # kernel ("ragged" below is ignored by _decode_one's paged branch)
+            use_ragged, bucket = True, 0
+        else:
+            needed = max(self._slot_len[s] for s in self.running) + h
+            bucket = min(_bucket(max(needed, 1)), self.max_len)
+            use_ragged = self.attn == "ragged" or (
+                self.attn == "auto" and bucket > self.RAGGED_THRESHOLD
+            )
+        samp = None
+        if self._per_slot:
+            # host→device upload only when an admission changed a slot's
+            # params — not per chunk forever after the first override
+            if self._samp_dirty or self._samp_dev is None:
+                self._samp_dev = (
+                    jnp.asarray(self._samp_temp),
+                    jnp.asarray(self._samp_topk),
+                    jnp.asarray(self._samp_topp),
+                )
+                self._samp_dirty = False
+            samp = self._samp_dev
         if use_ragged:
             toks, seq, self.cache = decode_steps(
                 self.params, self.cache, self.tokens, self._split(), self.cfg, h,
-                self.temperature, self.top_k, "ragged",
+                self.temperature, self.top_k, "ragged", samp,
             )
         else:
             # length bucket: attention reads only the shortest power-of-two
             # cache prefix covering every active slot through this chunk
             toks, seq, self.cache = decode_steps_bucketed(
                 self.params, self.cache, self.tokens, self._split(), self.cfg, h,
-                bucket, self.temperature, self.top_k,
+                bucket, self.temperature, self.top_k, samp,
             )
         self.tokens = toks
         # overlap: queue prefills for the next admissions while the chunk
@@ -500,7 +796,7 @@ class ContinuousBatcher:
                 pos = self._stream_pos.pop(rid, 0)
                 out[rid] = (list(toks[pos:]), True)
                 self._stream_done.add(rid)
-        live = [e[0] for e in self._staged] + list(self.pending) + list(self.running.values())
+        live = [e.req for e in self._staged] + list(self.pending) + list(self.running.values())
         for req in live:
             if req.rid in self._stream_done or req.rid in out:
                 continue

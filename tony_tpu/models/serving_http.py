@@ -76,17 +76,21 @@ class EngineServer:
         self._thread.start()
         return self
 
-    def submit(self, prompt_tokens: list[int], max_tokens: int) -> queue.Queue:
+    def submit(
+        self, prompt_tokens: list[int], max_tokens: int,
+        sampling: dict | None = None,
+    ) -> queue.Queue:
         """Enqueue a request; returns the queue its events arrive on:
         ("tokens", [..]) zero or more times, then ("done", all_tokens) —
-        or ("error", message)."""
+        or ("error", message). ``sampling``: per-request temperature /
+        top_k / top_p overrides."""
         out: queue.Queue = queue.Queue()
         with self._admit_lock:
             if self._draining.is_set() or self.error is not None:
                 out.put(("error", "server is draining" if self.error is None
                          else f"engine failed: {self.error}"))
                 return out
-            self._inbox.put((prompt_tokens, max_tokens, out))
+            self._inbox.put((prompt_tokens, max_tokens, sampling or {}, out))
         return out
 
     def stats(self) -> dict[str, Any]:
@@ -102,6 +106,15 @@ class EngineServer:
             "uptime_s": round(up, 1),
             "draining": self._draining.is_set(),
             "healthy": self.error is None,
+            **(
+                {
+                    "pages_live": eng.allocator.live_pages(),
+                    "pages_total": eng.num_pages - 1,
+                    "prefix_hit_tokens": eng.prefix_hit_tokens,
+                }
+                if getattr(eng, "kv", "dense") == "paged"
+                else {}
+            ),
         }
 
     def stop(self, timeout_s: float = 10.0) -> bool:
@@ -132,7 +145,7 @@ class EngineServer:
                 self._draining.set()
                 while True:
                     try:
-                        self._inbox.get_nowait()[2].put(("error", "server is draining"))
+                        self._inbox.get_nowait()[-1].put(("error", "server is draining"))
                     except queue.Empty:
                         break
                 self._stopped.set()
@@ -143,16 +156,16 @@ class EngineServer:
         while True:
             while True:
                 if carry is not None:
-                    prompt, max_tokens, out = carry
+                    prompt, max_tokens, sampling, out = carry
                     carry = None
                 else:
                     try:
-                        prompt, max_tokens, out = self._inbox.get_nowait()
+                        prompt, max_tokens, sampling, out = self._inbox.get_nowait()
                     except queue.Empty:
                         break
                 try:
-                    rid = eng.submit(prompt, max_tokens)
-                except ValueError as e:
+                    rid = eng.submit(prompt, max_tokens, **sampling)
+                except (ValueError, TypeError) as e:
                     out.put(("error", str(e)))
                     continue
                 self._streams[rid] = out
@@ -228,10 +241,15 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError("empty prompt")
             max_tokens = int(req.get("max_tokens", 16))
             stream = bool(req.get("stream", False))
+            sampling = {
+                k: (float(req[k]) if k != "top_k" else int(req[k]))
+                for k in ("temperature", "top_k", "top_p")
+                if req.get(k) is not None
+            }
         except (ValueError, json.JSONDecodeError) as e:
             self._reply(400, {"error": str(e)})
             return
-        out = self.server_ref.submit([int(t) for t in prompt], max_tokens)
+        out = self.server_ref.submit([int(t) for t in prompt], max_tokens, sampling)
         if stream:
             self._stream_response(out)
         else:
@@ -355,6 +373,8 @@ def build_engine(args) -> ContinuousBatcher:
         temperature=args.temperature, top_k=args.top_k,
         decode_chunk=args.decode_chunk, attn=args.attn,
         prefill_chunk=args.prefill_chunk,
+        kv=args.kv, page_len=args.page_len,
+        num_pages=args.num_pages if args.num_pages > 0 else None,
     )
 
 
@@ -372,6 +392,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--decode-chunk", type=int, default=8)
     p.add_argument("--prefill-chunk", type=int, default=0)
     p.add_argument("--attn", default="auto", choices=["auto", "ragged", "bucketed"])
+    p.add_argument("--kv", default="dense", choices=["dense", "paged"],
+                   help="paged: block-paged KV pool + shared-prefix reuse")
+    p.add_argument("--page-len", type=int, default=256)
+    p.add_argument("--num-pages", type=int, default=0,
+                   help="page pool size (0 = dense-equivalent: slots x max_len)")
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--eos-id", type=int, default=-1)

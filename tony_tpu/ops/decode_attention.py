@@ -44,7 +44,14 @@ if CHUNK < 8:  # fail at import, not inside a jit trace
     raise ValueError(f"TONY_DECODE_CHUNK={CHUNK}: DMA slab must be >= 8 positions")
 
 
-def _kernel(len_ref, q_ref, ck_ref, cv_ref, k_hbm, v_hbm, o_ref, *, chunk, window, n_rep):
+def _kernel(len_ref, q_ref, ck_ref, cv_ref, k_hbm, v_hbm, o_ref, *, chunk, window,
+            n_rep, pt_ref=None):
+    """Shared ragged-attention body. ``pt_ref=None``: dense per-slot cache —
+    slab c reads ``k_hbm[0, :, c*chunk:(c+1)*chunk]``. ``pt_ref`` set: PAGED
+    cache — ``k_hbm`` is the whole [P, Hkv, page_len, Dh] page pool
+    (chunk == page_len) and slab c reads physical page ``pt_ref[slot, c]``;
+    the logical position math (lo/c0/c1, masking) is identical because a
+    page holds exactly one slab's worth of positions."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -64,13 +71,16 @@ def _kernel(len_ref, q_ref, ck_ref, cv_ref, k_hbm, v_hbm, o_ref, *, chunk, windo
 
         def dma(slot, c):
             # one DMA per buffer: the whole [Hkv, chunk, Dh] slab
+            if pt_ref is None:
+                k_src = k_hbm.at[0, :, pl.ds(c * chunk, chunk)]
+                v_src = v_hbm.at[0, :, pl.ds(c * chunk, chunk)]
+            else:
+                page = pt_ref[s_i, c]
+                k_src = k_hbm.at[page]
+                v_src = v_hbm.at[page]
             return (
-                pltpu.make_async_copy(
-                    k_hbm.at[0, :, pl.ds(c * chunk, chunk)], k_buf.at[slot], sem.at[slot, 0]
-                ),
-                pltpu.make_async_copy(
-                    v_hbm.at[0, :, pl.ds(c * chunk, chunk)], v_buf.at[slot], sem.at[slot, 1]
-                ),
+                pltpu.make_async_copy(k_src, k_buf.at[slot], sem.at[slot, 0]),
+                pltpu.make_async_copy(v_src, v_buf.at[slot], sem.at[slot, 1]),
             )
 
         @pl.when(c0 < c1)  # a zero-length slot must not leave a DMA in flight
@@ -212,4 +222,79 @@ def ragged_decode_attention(
             transcendentals=S * H * maxT,
         ),
     )(lengths, qg, cur_k, cur_v, ck, cv)
+    return o.reshape(S, H, Dh)
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def paged_decode_attention(
+    q: jax.Array,           # [S, H, Dh] — one new token per slot
+    kp: jax.Array,          # [P, Hkv, page_len, Dh] — page pool (read-only)
+    vp: jax.Array,
+    lengths: jax.Array,     # [S] int32 — CACHE positions (excluding current)
+    page_table: jax.Array,  # [S, max_pages] int32 — logical page j → physical
+    *,
+    cur_k: jax.Array,       # [S, Hkv, Dh]
+    cur_v: jax.Array,
+    window: int = 0,
+) -> jax.Array:
+    """Ragged decode attention over a PAGED cache; returns o [S, H, Dh].
+
+    Identical math and streaming structure to ``ragged_decode_attention``
+    (one grid instance per slot, double-buffered slab DMA, online softmax,
+    current token folded as the final step) with one indirection: the DMA
+    slab size is the PAGE size, and slab c of slot s reads physical page
+    ``page_table[s, c]`` of the pool. HBM traffic per step is still
+    Σ_s ceil(len_s/page_len)·page_len positions — the pool's total size P
+    is irrelevant to step cost, which is the whole point: HBM footprint
+    tracks allocated pages, not slots × max_len. Entries of ``page_table``
+    beyond slot s's live pages are never read (loop bounds come from
+    ``lengths``); SWA slots skip whole pages below the window exactly as
+    the dense kernel skips slabs.
+
+    Same PRECONDITION as the dense kernel: consumed slots have
+    ``lengths[s] < max_pages * page_len`` and their pages allocated.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, H, Dh = q.shape
+    Hkv, page_len = kp.shape[1], kp.shape[2]
+    n_rep = H // Hkv
+    if page_len < 8:
+        raise ValueError(f"page_len {page_len} < 8: sub-sublane pages cannot DMA cleanly")
+    qg = q.reshape(S, Hkv, n_rep, Dh)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # lengths, page_table
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, n_rep, Dh), lambda s, L, PT: (s, 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, Dh), lambda s, L, PT: (s, 0, 0)),
+            pl.BlockSpec((1, Hkv, Dh), lambda s, L, PT: (s, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),   # kp stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # vp stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, Hkv, n_rep, Dh), lambda s, L, PT: (s, 0, 0, 0)),
+    )
+
+    def kern(len_ref, pt_ref, q_ref, ck_ref, cv_ref, k_hbm, v_hbm, o_ref):
+        _kernel(
+            len_ref, q_ref, ck_ref, cv_ref, k_hbm, v_hbm, o_ref,
+            chunk=page_len, window=window, n_rep=n_rep, pt_ref=pt_ref,
+        )
+
+    o = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, Hkv, n_rep, Dh), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=_INTERPRET,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * S * H * page_table.shape[1] * page_len * Dh,
+            bytes_accessed=(kp.size + vp.size) * kp.dtype.itemsize // 4,
+            transcendentals=S * H * page_table.shape[1] * page_len,
+        ),
+    )(lengths, page_table, qg, cur_k, cur_v, kp, vp)
     return o.reshape(S, H, Dh)
