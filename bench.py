@@ -379,6 +379,11 @@ def main() -> int:
                    help="skip the quick kernel smoke that precedes the bench")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--repeats", type=int, default=None,
+                   help="measurement runs; the MEDIAN is reported (ambient "
+                        "throughput on tunneled backends drifts ±1pt between "
+                        "runs — a single run makes round-over-round deltas "
+                        "uninterpretable). Default: 3 on accelerators, 1 on CPU")
     p.add_argument("--batch", type=int, default=None)
     p.add_argument("--seq", type=int, default=None)
     p.add_argument("--remat-policy", default=None, choices=["none", "full", "dots", "flash"])
@@ -413,21 +418,31 @@ def main() -> int:
         # fallback the CPU suite could not see)
         smoke = run_smoke(full=False)
 
+    repeats = args.repeats if args.repeats is not None else (1 if backend == "cpu" else 3)
     attempts = [preset]
     if preset != "tiny":
         attempts.append("tiny")  # OOM/compile-failure fallback so bench always reports
     last_err = None
     for attempt in attempts:
         try:
-            r = run_bench(
-                attempt, args.steps, args.warmup, args.batch, args.seq,
-                args.remat_policy, args.ce_chunk, args.mu_dtype, args.moe_dispatch,
-            )
+            # median-of-N: the compile is cached after run 1, so extra runs
+            # cost only measurement steps; the median absorbs the tunneled
+            # backend's ambient drift (r3 weak #7)
+            runs = [
+                run_bench(
+                    attempt, args.steps, args.warmup, args.batch, args.seq,
+                    args.remat_policy, args.ce_chunk, args.mu_dtype, args.moe_dispatch,
+                )
+                for _ in range(max(repeats, 1))
+            ]
+            runs.sort(key=lambda r: r["mfu"])
+            r = runs[len(runs) // 2]
             out = {
                 "metric": f"{r['model']}_train_mfu_{r['n_chips']}chip_{attempt}",
                 "value": r["mfu"],
                 "unit": "mfu",
                 "vs_baseline": round(r["mfu"] / NORTH_STAR_MFU, 4),
+                "runs_mfu": [x["mfu"] for x in runs],
                 **{k: v for k, v in r.items() if k not in ("mfu",)},
             }
             if smoke is not None:

@@ -195,12 +195,18 @@ int map_shard(const char* path, Shard* out) {
 extern "C" {
 
 // paths: NUL-separated, double-NUL-terminated list of shard files.
-int tony_loader_open(const char* paths, uint32_t batch, uint32_t seq,
-                     uint32_t shard_id, uint32_t num_shards, uint64_t seed,
-                     uint32_t prefetch_depth, uint32_t num_threads, void** out) {
+// start_index: first batch index to produce — the draw is a pure function of
+// (seed, batch index), so resuming a run at step K with start_index=K replays
+// the exact uninterrupted stream (no repeated, no skipped samples).
+int tony_loader_open_at(const char* paths, uint32_t batch, uint32_t seq,
+                        uint32_t shard_id, uint32_t num_shards, uint64_t seed,
+                        uint32_t prefetch_depth, uint32_t num_threads,
+                        uint64_t start_index, void** out) {
   if (!paths || !out || batch == 0 || seq == 0 || num_shards == 0 || shard_id >= num_shards)
     return kErrArg;
   auto ld = new Loader();
+  ld->next_index = start_index;
+  ld->next_consume = start_index;
   ld->batch = batch;
   ld->seq = seq;
   ld->shard_id = shard_id;
@@ -227,6 +233,13 @@ int tony_loader_open(const char* paths, uint32_t batch, uint32_t seq,
   for (uint32_t i = 0; i < n; ++i) ld->workers.emplace_back([ld] { ld->worker_loop(); });
   *out = ld;
   return 0;
+}
+
+int tony_loader_open(const char* paths, uint32_t batch, uint32_t seq,
+                     uint32_t shard_id, uint32_t num_shards, uint64_t seed,
+                     uint32_t prefetch_depth, uint32_t num_threads, void** out) {
+  return tony_loader_open_at(paths, batch, seq, shard_id, num_shards, seed,
+                             prefetch_depth, num_threads, 0, out);
 }
 
 // Blocks until the next *sequential* batch is ready; copies [batch, seq+1]

@@ -244,7 +244,9 @@ def test_pallas_ring_backward_eight_devices_multi_tile():
     # when a collective kernel with large tiles occupies every device in
     # the process (8-of-16 passes in ~17 s, 8-of-8 deadlocks; same for the
     # FORWARD kernel at n-of-n with 256-row tiles, so this is an emulation
-    # artifact, not a kernel-protocol property).
+    # artifact, not a kernel-protocol property). Standalone demonstration:
+    # docs/repros/pallas_interpret_collective_starvation.py (run it at
+    # 8-of-16 to see the pass, 8-of-8 under timeout to see the wedge).
     import os
     import subprocess
     import sys as _sys

@@ -119,6 +119,88 @@ class TestLoopWithData:
         assert out["step"] == 3
 
 
+class TestDataReplayOnResume:
+    def test_interrupted_run_equals_uninterrupted(self, tmp_path):
+        """VERDICT r3 #6a end-to-end: a run checkpointed at step 4 and
+        resumed to step 8 sees the SAME data stream as a run that never
+        stopped — identical final loss (bitwise: same params path, same
+        batches, same op order on CPU)."""
+        import numpy as np
+
+        from tony_tpu.data import write_token_shard
+        from tony_tpu.models import llama
+        from tony_tpu.train.loop import LoopConfig, run_lm_training
+
+        rng = np.random.default_rng(0)
+        data = tmp_path / "data"
+        data.mkdir()
+        write_token_shard(data / "s0.tonytok", rng.integers(0, 256, 40_000, dtype=np.int32))
+        cfg = llama.LLAMA_TINY
+        # schedule_steps pins the LR schedule to the full 8-step plan in
+        # every run — the interrupted 4-step run must not decay twice as fast
+        base = dict(batch_size=2, seq_len=64, log_every=100, warmup_steps=0,
+                    data_dir=str(data), checkpoint_every=4, schedule_steps=8)
+        ref = run_lm_training(
+            llama, cfg,
+            LoopConfig(steps=8, checkpoint_dir=str(tmp_path / "A"), **base),
+        )
+        # interrupted: 4 steps, "crash", resume the same config to 8
+        run_lm_training(
+            llama, cfg, LoopConfig(steps=4, checkpoint_dir=str(tmp_path / "B"), **base)
+        )
+        got = run_lm_training(
+            llama, cfg, LoopConfig(steps=8, checkpoint_dir=str(tmp_path / "B"), **base)
+        )
+        assert got["step"] == 8
+        assert got["loss"] == ref["loss"], (got, ref)
+
+
+class TestCrossShapeResume:
+    def test_restore_onto_smaller_mesh_keeps_training(self, tmp_path):
+        """VERDICT r3 #6b: a checkpoint written by an 8-device FSDP run
+        restores onto a 4-device mesh (Orbax reshards into the target
+        shardings) and training continues with the same loss as the
+        8-device continuation — the node-lost → re-pack-smaller story."""
+        import functools
+
+        from tony_tpu.models import llama
+        from tony_tpu.parallel import MeshSpec
+        from tony_tpu.train.trainer import make_train_step, sharded_init
+
+        cfg = llama.LLAMA_TINY
+        opt = OptimizerConfig(warmup_steps=0, total_steps=10).build()
+        rules = llama.sharding_rules(cfg)
+        init_fn = lambda: llama.init(KEY, cfg)  # noqa: E731
+        batch = llama.synthetic_batch(KEY, 8, 32, cfg)
+
+        mesh8 = MeshSpec(fsdp=8).build()
+        state8 = sharded_init(init_fn, rules, mesh8, opt)
+        step8 = make_train_step(
+            functools.partial(llama.loss_fn, cfg=cfg, mesh=mesh8), opt
+        )
+        for _ in range(2):
+            state8, _ = step8(state8, batch)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), use_async=False)
+        mgr.save(2, state8)
+        mgr.wait()
+        state8, m8 = step8(state8, batch)  # the 8-device continuation
+
+        mesh4 = MeshSpec(fsdp=4).build(devices=jax.devices()[:4])
+        state4 = sharded_init(init_fn, rules, mesh4, opt)
+        restored = mgr.restore(state4)
+        # restored arrays carry the 4-device shardings, not the saved ones
+        p = jax.tree.leaves(restored.params)[0]
+        assert len(p.sharding.device_set) == 4
+        step4 = make_train_step(
+            functools.partial(llama.loss_fn, cfg=cfg, mesh=mesh4), opt
+        )
+        _, m4 = step4(restored, batch)
+        np.testing.assert_allclose(
+            float(m4["loss"]), float(m8["loss"]), rtol=1e-5
+        )
+        mgr.close()
+
+
 class TestOptimizerMemory:
     def test_mu_dtype_bf16_halves_first_moment(self):
         import jax.numpy as jnp

@@ -59,6 +59,14 @@ def _load_library():
                 ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
                 ctypes.POINTER(ctypes.c_void_p),
             ]
+            # open_at (resume replay) — a stale .so without the symbol drops
+            # the whole native path to the Python fallback, never misbinds
+            lib.tony_loader_open_at.restype = ctypes.c_int
+            lib.tony_loader_open_at.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
+            ]
             lib.tony_loader_next.restype = ctypes.c_int
             lib.tony_loader_next.argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
@@ -110,11 +118,18 @@ class TokenLoader:
         seed: int = 0,
         prefetch_depth: int = 4,
         num_threads: int = 2,
+        start_index: int = 0,
     ):
+        """``start_index``: first batch index to produce. The window draw is
+        a pure function of (seed, batch index), so a resumed run that keeps
+        its seed and starts the loader at its step counter replays the exact
+        uninterrupted stream — no repeated, no skipped samples."""
         if not shard_paths:
             raise ValueError("no shard paths")
         if num_shards < 1 or not 0 <= shard_id < num_shards:
             raise ValueError(f"shard_id {shard_id} out of range for num_shards {num_shards}")
+        if start_index < 0:
+            raise ValueError(f"start_index must be >= 0, got {start_index}")
         self.batch, self.seq = batch, seq
         self.shard_id, self.num_shards, self.seed = shard_id, num_shards, seed
         self._handle = None
@@ -123,9 +138,9 @@ class TokenLoader:
         if lib is not None:
             blob = b"".join(str(Path(p)).encode() + b"\0" for p in shard_paths) + b"\0"
             handle = ctypes.c_void_p()
-            rc = lib.tony_loader_open(
+            rc = lib.tony_loader_open_at(
                 blob, batch, seq, shard_id, num_shards, seed,
-                prefetch_depth, num_threads, ctypes.byref(handle),
+                prefetch_depth, num_threads, start_index, ctypes.byref(handle),
             )
             if rc != 0:
                 raise ValueError(f"tony_loader_open failed (rc={rc}) for {shard_paths}")
@@ -140,7 +155,7 @@ class TokenLoader:
             if self.num_windows < num_shards:
                 raise ValueError("not enough data for one window per worker")
             self._queue: Queue = Queue(maxsize=prefetch_depth)
-            self._index = 0
+            self._index = start_index
             self._stop = threading.Event()
             self._thread = threading.Thread(target=self._py_prefetch, daemon=True)
             self._thread.start()

@@ -36,6 +36,11 @@ from tony_tpu.train.trainer import (
 @dataclass(frozen=True)
 class LoopConfig:
     steps: int = 100
+    #: LR-schedule horizon; 0 → ``steps``. Set it when a run will be
+    #: extended (or was cut short) so warmup/decay stay anchored to the
+    #: FULL plan — otherwise a 4-step run resumed to 8 decays twice as fast
+    #: over its first half as the uninterrupted 8-step run did
+    schedule_steps: int = 0
     batch_size: int = 8
     seq_len: int = 512
     log_every: int = 10
@@ -50,6 +55,7 @@ class LoopConfig:
     pp_microbatches: int = 4   # microbatches per 1F1B step (batch must divide)
     pp_chunks: int = 1         # >1: interleaved virtual stages per device
     data_dir: str = ""  # dir of *.tonytok shards; empty → synthetic batches
+    data_seed: int = 0  # window-draw seed; FIXED across restarts (replay)
 
 
 def _drop_train_metrics(line: dict) -> None:
@@ -104,7 +110,8 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
     n_chips = len(jax.devices())
 
     opt_cfg = OptimizerConfig(
-        learning_rate=loop.learning_rate, warmup_steps=loop.warmup_steps, total_steps=loop.steps
+        learning_rate=loop.learning_rate, warmup_steps=loop.warmup_steps,
+        total_steps=loop.schedule_steps or loop.steps,
     )
     opt = opt_cfg.build()
     rules = model_module.sharding_rules(model_cfg)
@@ -157,10 +164,16 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
         from tony_tpu.data import TokenLoader
 
         paths = sorted(Path(loop.data_dir).glob("*.tonytok"))
+        # exact replay on resume: the draw is a pure function of
+        # (data_seed, batch index), so keeping the seed FIXED and starting
+        # the loader at the resumed step replays the uninterrupted stream —
+        # no sample is repeated or skipped relative to a run that never
+        # restarted (the old seed=start_step re-seeding drew a fresh
+        # permutation every resume)
         loader = TokenLoader(
             paths, loop.batch_size, loop.seq_len,
             shard_id=jax.process_index(), num_shards=jax.process_count(),
-            seed=start_step,
+            seed=loop.data_seed, start_index=start_step,
         )
         print(f"[train] data: {len(paths)} shards, {loader.total_tokens} tokens, "
               f"native={loader.is_native}", flush=True)
@@ -225,6 +238,8 @@ def parse_loop_args(argv: list[str] | None = None) -> tuple[LoopConfig, dict]:
 
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--schedule_steps", type=int, default=0,
+                   help="LR-schedule horizon (0 = --steps); set when extending runs")
     p.add_argument("--batch_size", type=int, default=8)
     p.add_argument("--seq_len", type=int, default=512)
     p.add_argument("--log_every", type=int, default=10)
@@ -257,6 +272,7 @@ def parse_loop_args(argv: list[str] | None = None) -> tuple[LoopConfig, dict]:
                    help=">1: interleaved 1F1B (virtual stage chunks per device; "
                         "llama family)")
     p.add_argument("--data_dir", default="")
+    p.add_argument("--data_seed", type=int, default=0)
     p.add_argument("--preset", default="tiny")
     args = p.parse_args(argv if argv is not None else sys.argv[1:])
     d = vars(args)

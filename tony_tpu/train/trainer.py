@@ -140,13 +140,34 @@ def sharded_init(
     """Initialize params directly onto the mesh (jit with out_shardings so
     large models never materialize unsharded on one device), then build the
     optimizer state under the same sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
     abstract = jax.eval_shape(init_fn)
     out_sharding = rules.sharding_tree(abstract, mesh)
     params = jax.jit(init_fn, out_shardings=out_sharding)()
     # zeros_like under optax.init inherits each param's sharding, so the
     # optimizer state (the FSDP memory win) lands sharded too.
     opt_state = optimizer.init(params)
-    return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+    # scalar leaves (optax step counts, TrainState.step) get a DEFAULT
+    # single-device placement — harmless uncommitted at init, but a restored
+    # checkpoint COMMITS every leaf to its recorded sharding, and a scalar
+    # pinned to device 0 next to mesh-sharded params is an incompatible-
+    # devices error in the first jitted step after resume. Replicate them
+    # over the mesh so the whole TrainState (and any checkpoint of it)
+    # lives on the mesh — which also makes checkpoints restore cleanly onto
+    # a DIFFERENT mesh shape (elastic re-pack).
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    def _on_mesh(x):
+        if isinstance(x, jax.Array) and not isinstance(x.sharding, NamedSharding):
+            return jax.device_put(x, repl)
+        return x
+
+    opt_state = jax.tree.map(_on_mesh, opt_state)
+    return TrainState(
+        params=params, opt_state=opt_state,
+        step=jax.device_put(jnp.zeros((), jnp.int32), repl),
+    )
 
 
 class Throughput:
