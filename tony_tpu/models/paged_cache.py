@@ -148,6 +148,12 @@ class PageAllocator:
             got.append(p)
         return got
 
+    def has_key(self, key: tuple) -> bool:
+        """Is this prefix page resident (live or reusable)? Cheap host
+        lookup — the engine's burst dedup stops deferring followers the
+        moment their leader registers."""
+        return key in self._chain
+
     def register(self, page: int, key: tuple) -> None:
         """Content-address a LIVE full prompt page. First writer wins — a
         concurrent duplicate simply stays unregistered and frees normally."""

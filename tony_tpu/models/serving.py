@@ -165,14 +165,25 @@ def _decode_one(
         cache.lengths > 0, jnp.minimum(cache.lengths + 1, maxT), 0
     )
     if paged:
-        # single write: scatter each slot's [L, Hkv, Dh] column at its
-        # (physical page, in-page offset) — advanced indexing puts the
-        # slot axis FIRST in the indexed view, hence the transposes
+        # write each slot's [L, Hkv, Dh] column at its (physical page,
+        # in-page offset) via a fori chain of dynamic_update_slice — XLA
+        # keeps these in-place on the donated pool, where the equivalent
+        # two-index-array scatter measured +24% on the whole decode chunk
+        # (it materializes gather/scatter traffic instead of aliasing)
         page_len = cache.k.shape[3]
         pages = cache.page_table[jnp.arange(S), pos // page_len]     # [S]
         offs = pos % page_len
-        ks = cache.k.at[:, pages, :, offs, :].set(ks_new.transpose(1, 0, 2, 3))
-        vs = cache.v.at[:, pages, :, offs, :].set(vs_new.transpose(1, 0, 2, 3))
+
+        def write_slot_page(s, kv):
+            ks, vs = kv
+            kcol = jax.lax.dynamic_slice_in_dim(ks_new, s, 1, axis=1)  # [L,1,Hkv,Dh]
+            vcol = jax.lax.dynamic_slice_in_dim(vs_new, s, 1, axis=1)
+            idx = (0, pages[s], 0, offs[s], 0)
+            ks = jax.lax.dynamic_update_slice(ks, kcol[:, 0][:, None, :, None, :], idx)
+            vs = jax.lax.dynamic_update_slice(vs, vcol[:, 0][:, None, :, None, :], idx)
+            return ks, vs
+
+        ks, vs = jax.lax.fori_loop(0, S, write_slot_page, (cache.k, cache.v))
         from tony_tpu.models.paged_cache import PagedCache as _PC
 
         return nxt, _PC(ks, vs, new_len, cache.page_table)
@@ -505,6 +516,11 @@ class ContinuousBatcher:
                 defer = (
                     fk is not None and fk in seen_first
                     and entry.first is None and entry.pos == 0 and not entry.matched
+                    # once the leader REGISTERED the prefix, followers must
+                    # all proceed this round (they re-match, not recompute) —
+                    # deferring on the raw key would serialize the burst to
+                    # one follower per engine step
+                    and not self.allocator.has_key(fk)
                 )
                 if fk is not None:
                     seen_first.add(fk)

@@ -263,6 +263,9 @@ def paged_decode_attention(
     if page_len < 8:
         raise ValueError(f"page_len {page_len} < 8: sub-sublane pages cannot DMA cleanly")
     qg = q.reshape(S, Hkv, n_rep, Dh)
+    # two scalar-prefetch operands (lengths, page_table). A packed
+    # single-operand variant was built and A/B'd on-chip: 342 vs 341
+    # ms/chunk — neutral, so the simpler two-operand form ships.
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # lengths, page_table
