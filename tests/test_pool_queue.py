@@ -288,6 +288,23 @@ def submit_async(tmp_tony_root, conf):
     return handle, t, result
 
 
+def marker_script(tmp_path, name: str = "preemptee.py"):
+    """Two-incarnation script: first run parks forever (gets preempted /
+    killed), the restart (marker present) exits clean. Returns
+    (script_path, marker_path)."""
+    marker = tmp_path / f"{name}.ran_once"
+    script = tmp_path / name
+    script.write_text(
+        "import os, sys, time\n"
+        f"m = {str(marker)!r}\n"
+        "if os.path.exists(m):\n"
+        "    sys.exit(0)\n"
+        "open(m, 'w').close()\n"
+        "time.sleep(600)\n"
+    )
+    return script, marker
+
+
 @pytest.mark.e2e
 class TestQueueE2E:
     def test_second_job_waits_then_runs(self, tmp_tony_root, small_pool, tmp_path,
@@ -353,16 +370,7 @@ class TestQueueE2E:
         svc = small_pool
         # first incarnation parks forever; after preemption the gang restarts
         # and the second incarnation (marker present) exits clean
-        marker = tmp_path / "ran_once"
-        script = tmp_path / "preemptee.py"
-        script.write_text(
-            "import os, sys, time\n"
-            f"m = {str(marker)!r}\n"
-            "if os.path.exists(m):\n"
-            "    sys.exit(0)\n"
-            "open(m, 'w').close()\n"
-            "time.sleep(600)\n"
-        )
+        script, marker = marker_script(tmp_path)
         h1, t1, r1 = submit_async(tmp_tony_root, pool_conf(svc, {
             "tony.worker.instances": "1", "tony.worker.memory": "3g",
             keys.APPLICATION_PRIORITY: "0",

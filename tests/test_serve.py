@@ -8,6 +8,7 @@ and kill drains gracefully.
 """
 
 import json
+import sys
 import threading
 import time
 import urllib.request
@@ -16,7 +17,7 @@ import jax
 import pytest
 
 from tony_tpu import constants
-from tony_tpu.config import TonyConfig, keys
+from tony_tpu.config import keys
 from tony_tpu.cluster.client import Client
 from tony_tpu.cluster.session import JobStatus
 from tony_tpu.cli.notebook import wait_for_task_url
@@ -197,3 +198,80 @@ class TestServeE2E:
             Client.kill(handle)
             mon.join(timeout=60)
         assert result.get("final") == JobStatus.KILLED, handle.final_status()
+
+
+# ---------------------------------------------------------------------------
+# Capstone: the two halves compose — a high-priority serving job PREEMPTS a
+# training job through the multi-tenant pool, serves, and hands capacity back
+# ---------------------------------------------------------------------------
+from tests.test_pool_queue import small_pool  # noqa: F401, E402 — fixture reuse
+
+
+@pytest.mark.e2e
+class TestServeComposesWithPool:
+    def test_high_priority_serve_preempts_training(
+        self, tmp_tony_root, small_pool, tmp_path  # noqa: F811
+    ):
+        from tests.test_pool import pool_conf
+        from tests.test_pool_queue import marker_script, submit_async
+
+        svc = small_pool  # one 4 GB agent + preemption on (shared fixture)
+        h1 = h2 = None
+        try:
+            # low-priority "training" job: first incarnation parks forever;
+            # the post-preemption restart (marker present) exits clean
+            script, marker = marker_script(tmp_path, "trainee.py")
+            h1, t1, r1 = submit_async(tmp_tony_root, pool_conf(svc, {
+                "tony.worker.instances": "1", "tony.worker.memory": "3g",
+                keys.APPLICATION_PRIORITY: "0",
+                keys.EXECUTES: f"{sys.executable} {script}",
+            }))
+            deadline = time.time() + 30
+            while time.time() < deadline and not marker.exists():
+                time.sleep(0.05)
+            assert marker.exists(), "training job never started"
+
+            # high-priority serving job into the SAME full pool
+            serve_conf, _ = build_serve_config([
+                "--preset", "tiny", "--slots", "2", "--max_len", "64",
+            ])
+            serve_conf.set(keys.STAGING_ROOT, str(tmp_tony_root))
+            for k, v in pool_conf(svc, {}).items():
+                serve_conf.set(k, v)
+            serve_conf.set(keys.APPLICATION_PRIORITY, "5")
+            serve_conf.set(keys.jobtype_key(constants.SERVE_JOB_NAME, keys.MEMORY_SUFFIX), "3g")
+            c2 = Client(serve_conf)
+            h2 = c2.submit()
+            r2: dict = {}
+            t2 = threading.Thread(
+                target=lambda: r2.update(final=c2.monitor_application(h2, quiet=True)),
+                daemon=True,
+            )
+            t2.start()
+
+            # the serve job preempts the trainee, comes up, and serves
+            target = wait_for_task_url(h2, constants.SERVE_JOB_NAME, timeout_s=180)
+            assert target is not None, "serve endpoint never registered (preemption failed?)"
+            url = f"http://{target[0]}:{target[1]}"
+            r = post(url + "/v1/completions",
+                     {"prompt_tokens": [1, 2, 3], "max_tokens": 4})
+            assert r["finished"] and len(r["tokens"]) == 4
+
+            # hand capacity back: kill the serve job; the preempted training
+            # job re-queues, restarts from the top, and completes clean
+            Client.kill(h2)
+            t2.join(timeout=90)
+            assert r2.get("final") == JobStatus.KILLED
+            h2 = None  # terminal: no cleanup kill needed
+            t1.join(timeout=120)
+            assert r1.get("final") == JobStatus.SUCCEEDED
+            h1 = None
+        finally:
+            # a failed assertion must not leak detached AMs (and their
+            # sleeping executors) into the rest of the pytest session
+            for h in (h1, h2):
+                if h is not None:
+                    try:
+                        Client.kill(h)
+                    except Exception:  # noqa: BLE001 — best-effort teardown
+                        pass
