@@ -225,6 +225,23 @@ class TestPagedEngine:
         b = paged.submit([5, 6, 7, 8], max_new_tokens=6)
         assert dense.run()[a] == paged.run()[b]
 
+    def test_swa_window_smaller_than_chunk_matches_dense(self):
+        """The staged fold's out-of-window mask only fires when the sliding
+        window is SMALLER than the decode chunk (staged positions can fall
+        below the band) — lock that case in."""
+        import dataclasses
+
+        cfg = dataclasses.replace(LLAMA_TINY, sliding_window=3)
+        params = init(jax.random.PRNGKey(4), cfg)
+        dense = ContinuousBatcher(params, cfg, num_slots=2, max_len=128,
+                                  decode_chunk=8)
+        paged = ContinuousBatcher(params, cfg, num_slots=2, max_len=128,
+                                  decode_chunk=8, kv="paged", page_len=32)
+        prompt = list(range(2, 2 + 20))
+        a = dense.submit(prompt, max_new_tokens=12)
+        b = paged.submit(prompt, max_new_tokens=12)
+        assert dense.run()[a] == paged.run()[b]
+
     def test_swa_paged_matches_dense(self):
         import dataclasses
 
