@@ -72,6 +72,23 @@ class TestPagedKernel:
                 err_msg=f"window={window}",
             )
 
+    def test_rejects_unaligned_page_len(self):
+        """Direct kernel callers get the same sublane-alignment guard the
+        engine enforces: page_len must be a multiple of 8 (ADVICE r4)."""
+        from tony_tpu.ops.decode_attention import paged_decode_attention
+
+        S, H, Hkv, Dh = 1, 2, 1, 128
+        for plen in (4, 12):
+            kp = jnp.zeros((2, Hkv, plen, Dh), jnp.float32)
+            with pytest.raises(ValueError, match="multiple of 8"):
+                paged_decode_attention(
+                    jnp.zeros((S, H, Dh), jnp.float32), kp, kp,
+                    jnp.zeros((S,), jnp.int32),
+                    jnp.zeros((S, 1), jnp.int32),
+                    cur_k=jnp.zeros((S, Hkv, Dh), jnp.float32),
+                    cur_v=jnp.zeros((S, Hkv, Dh), jnp.float32),
+                )
+
 
 # ---------------------------------------------------------------------------
 # Allocator invariants

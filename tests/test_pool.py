@@ -154,6 +154,25 @@ class TestPoolModel:
         # and a late heartbeat from it is told to re-register
         assert pool.node_heartbeat("n0") == {"unknown_node": True}
 
+    def test_all_nodes_dead_wait_is_bounded(self, pool):
+        """Agents that stay gone past one liveness budget are permanently
+        dead: the ask must escalate to AllocationError, not queue forever
+        (ADVICE r4: unbounded AllocationPending retry)."""
+        register_cpu_node(pool, "n0")
+        pool._nodes["n0"].alive = False
+        # within the budget: wait (the agent may re-register)
+        assert pool.allocate("app", "w", 0, 1024, 1, 0).get("wait") is True
+        # past the budget (backdate the first all-dead observation)
+        pool._all_dead_since -= 10
+        with pytest.raises(AllocationError, match="permanently"):
+            pool.allocate("app", "w", 0, 1024, 1, 0)
+        # a node coming back clears the escalation clock AT REGISTRATION
+        # (not only on the allocate path): a stale timestamp from this
+        # outage must not insta-fail a future brief blip
+        register_cpu_node(pool, "n0")
+        assert pool._all_dead_since is None
+        assert "id" in pool.allocate("app", "w", 0, 1024, 1, 0)
+
 
 # ---------------------------------------------------------------------------
 # E2E: pool service + ≥2 agent PROCESSES on loopback, full submit spine

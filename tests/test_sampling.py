@@ -106,5 +106,9 @@ class TestEnginePerSlotSampling:
 
         with pytest.raises(ValueError, match="top_p"):
             eng.submit([1], max_new_tokens=1, top_p=1.5)
+        # 0.0 is the internal "no nucleus cut" sentinel — a client sending
+        # it would silently get the FULL distribution, so it is rejected
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit([1], max_new_tokens=1, top_p=0.0)
         with pytest.raises(ValueError, match="temperature"):
             eng.submit([1], max_new_tokens=1, temperature=-1)

@@ -11,6 +11,7 @@ import json
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import jax
@@ -34,11 +35,33 @@ def tiny_engine(**kw):
     return ContinuousBatcher(params, LLAMA_TINY, **defaults)
 
 
-def post(url, obj, timeout=120):
+def http_server(srv):
+    """A bare ThreadingHTTPServer around an EngineServer — the HTTP layer
+    without the tony job spine (for handler-level tests)."""
+    from http.server import ThreadingHTTPServer
+
+    from tony_tpu.models.serving_http import _Handler
+
+    handler = type("Handler", (_Handler,), {"server_ref": srv, "tokenizer": None})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def post_raw(url, obj, timeout=120):
+    """POST returning (status, parsed-json) — does NOT raise on 4xx/5xx."""
     req = urllib.request.Request(
         url, json.dumps(obj).encode(), {"Content-Type": "application/json"}
     )
-    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def post(url, obj, timeout=120):
+    return post_raw(url, obj, timeout)[1]
 
 
 # ---------------------------------------------------------------------------
@@ -100,6 +123,32 @@ class TestEngineServer:
         # post-failure submissions are refused immediately
         kind, payload = srv.submit([1], max_tokens=1).get(timeout=10)
         assert kind == "error"
+
+    def test_malformed_prompt_tokens_is_400_not_dropped_connection(self):
+        """Non-integer prompt_tokens must map to a 400 JSON error, not an
+        uncaught ValueError in the handler thread (ADVICE r4)."""
+        srv = EngineServer(tiny_engine()).start()
+        httpd, url = http_server(srv)
+        try:
+            for bad in (["x", "y"], "abc", [[1]], [None]):
+                code, body = post_raw(
+                    url + "/v1/completions",
+                    {"prompt_tokens": bad, "max_tokens": 2}, timeout=30,
+                )
+                assert code == 400 and "error" in body, (bad, code, body)
+            # a valid-JSON NON-OBJECT body must also be a 400, not a crash
+            for bad_body in ([1, 2, 3], "abc", 7):
+                code, body = post_raw(url + "/v1/completions", bad_body, timeout=30)
+                assert code == 400 and "error" in body, (bad_body, code, body)
+            # a valid request on the same server still works
+            code, body = post_raw(
+                url + "/v1/completions", {"prompt_tokens": [1, 2], "max_tokens": 2},
+                timeout=120,
+            )
+            assert code == 200 and body["finished"]
+        finally:
+            httpd.shutdown()
+            srv.stop()
 
     def test_drain_stream_reports_each_request_once(self):
         eng = tiny_engine()

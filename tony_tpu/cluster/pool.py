@@ -206,6 +206,7 @@ class PoolService:
         self._apps: dict[str, _App] = {}                   # app → queue state
         self._app_seq = itertools.count()
         self._preempt_cids: set[str] = set()               # kills we initiated
+        self._all_dead_since: float | None = None          # allocate() saw 0 alive
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.rpc = RpcServer(host=bind_host, port=port, secret=secret)
@@ -262,6 +263,10 @@ class PoolService:
             if old is not None:
                 # agent restart: everything it was running is gone
                 self._mark_node_lost_locked(old, reason="re-registered")
+            # a live node clears the all-dead escalation clock — otherwise a
+            # stale timestamp from a PAST outage would fail the next brief
+            # blip instantly instead of granting its liveness-budget grace
+            self._all_dead_since = None
             self._nodes[name] = _Node(
                 name=name, host=host, port=port,
                 memory_bytes=int(memory_bytes), vcores=int(vcores),
@@ -351,11 +356,26 @@ class PoolService:
                         f"pool has no registered nodes to host {job_type}:{task_index}"
                     )
                 # nodes exist but are all currently dead (agent blip/restart):
-                # they re-register on their next heartbeat — wait, don't fail
+                # they re-register on their next heartbeat — wait, but only
+                # for one more liveness budget: agents that stay gone past it
+                # are permanently dead, and an unbounded wait would leave the
+                # job queued forever with no escalation
+                now = time.monotonic()
+                if self._all_dead_since is None:
+                    self._all_dead_since = now
+                budget_s = self.heartbeat_interval_ms * self.max_missed / 1000
+                waited = now - self._all_dead_since
+                if waited > budget_s:
+                    raise AllocationError(
+                        f"all pool nodes unreachable for {waited:.1f}s (> liveness "
+                        f"budget {budget_s:.1f}s) — pool agents look permanently "
+                        f"dead; cannot host {job_type}:{task_index}"
+                    )
                 return {
                     "wait": True, "queue": "", "position": 0,
                     "reason": "all pool nodes currently unreachable",
                 }
+            self._all_dead_since = None
             if chips > 0:
                 biggest = max((len(n.chips) for n in alive), default=0)
                 if chips > biggest:

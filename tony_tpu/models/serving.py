@@ -511,8 +511,14 @@ class ContinuousBatcher:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if top_k is not None and top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
-        if top_p is not None and not 0 <= top_p <= 1:
-            raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+        if top_p is not None and not 0 < top_p <= 1:
+            # 0.0 is the internal "nucleus cut disabled" sentinel — a client
+            # sending top_p=0 expecting near-greedy would silently get the
+            # FULL distribution, so reject it (use temperature=0 for greedy)
+            raise ValueError(
+                f"top_p must be in (0, 1], got {top_p} "
+                "(for greedy decoding use temperature=0)"
+            )
         if len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
@@ -659,7 +665,8 @@ class ContinuousBatcher:
                                  else self.temperature, jnp.float32),
                         jnp.full((1,), req.top_k if req.top_k is not None
                                  else self.top_k, jnp.int32),
-                        jnp.full((1,), req.top_p or 0.0, jnp.float32),
+                        jnp.full((1,), req.top_p if req.top_p is not None
+                                 else 0.0, jnp.float32),
                     )
                 else:
                     first = _sample(

@@ -232,6 +232,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             req = _json_body(self)
+            if not isinstance(req, dict):
+                raise ValueError("request body must be a JSON object")
             prompt = req.get("prompt_tokens")
             if prompt is None and "prompt" in req:
                 if self.tokenizer is None:
@@ -246,10 +248,11 @@ class _Handler(BaseHTTPRequestHandler):
                 for k in ("temperature", "top_k", "top_p")
                 if req.get(k) is not None
             }
-        except (ValueError, json.JSONDecodeError) as e:
+            prompt = [int(t) for t in prompt]
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
             self._reply(400, {"error": str(e)})
             return
-        out = self.server_ref.submit([int(t) for t in prompt], max_tokens, sampling)
+        out = self.server_ref.submit(prompt, max_tokens, sampling)
         if stream:
             self._stream_response(out)
         else:

@@ -303,8 +303,11 @@ def paged_decode_attention(
     S, H, Dh = q.shape
     Hkv, page_len = kp.shape[1], kp.shape[2]
     n_rep = H // Hkv
-    if page_len < 8:
-        raise ValueError(f"page_len {page_len} < 8: sub-sublane pages cannot DMA cleanly")
+    if page_len < 8 or page_len % 8:
+        raise ValueError(
+            f"page_len {page_len} must be a multiple of 8 (>= 8): the "
+            "slab-DMA/sublane layout assumes sublane-aligned pages"
+        )
     qg = q.reshape(S, Hkv, n_rep, Dh)
     has_staged = staged_k is not None
     if has_staged and (staged_v is None or staged_count is None):
