@@ -119,18 +119,21 @@ struct Loader {
     Batch b;
     b.index = index;
     b.data.resize((size_t)batch * (seq + 1));
-    const uint64_t slots_per_epoch = num_windows / num_shards;
+    // GLOBAL-ORDER draw (elastic-replay contract, mirrored in the Python
+    // fallback): the stream is one global sequence of samples keyed by
+    // (seed, global slot); shard k of K owns rows [k*batch, (k+1)*batch)
+    // of each global batch of G = batch*num_shards rows. Resharding K->K'
+    // replays exactly as long as G is held constant, because the slots a
+    // resumed run consumes are the same regardless of how they re-split.
+    const uint64_t gbatch = (uint64_t)batch * num_shards;
     for (uint32_t i = 0; i < batch; ++i) {
-      const uint64_t slot = index * batch + i;
-      const uint64_t epoch = slots_per_epoch ? slot / slots_per_epoch : 0;
-      const uint64_t pos = slots_per_epoch ? slot % slots_per_epoch : 0;
-      // hash-based draw within this worker's shard of the window space;
+      const uint64_t g = index * gbatch + (uint64_t)shard_id * batch + i;
+      const uint64_t epoch = g / num_windows;
+      const uint64_t pos = g % num_windows;
       // epoch goes through its own mix round so (epoch, pos) keys can't
-      // alias linearly across epochs for any slots_per_epoch
+      // alias linearly across epochs for any num_windows
       const uint64_t r = mix(mix(seed ^ mix(epoch)) ^ pos);
-      const uint64_t window =
-          slots_per_epoch ? (r % slots_per_epoch) * num_shards + shard_id : 0;
-      fill_sequence(window, b.data.data() + (size_t)i * (seq + 1));
+      fill_sequence(r % num_windows, b.data.data() + (size_t)i * (seq + 1));
     }
     return b;
   }
@@ -195,9 +198,11 @@ int map_shard(const char* path, Shard* out) {
 extern "C" {
 
 // paths: NUL-separated, double-NUL-terminated list of shard files.
-// start_index: first batch index to produce — the draw is a pure function of
-// (seed, batch index), so resuming a run at step K with start_index=K replays
-// the exact uninterrupted stream (no repeated, no skipped samples).
+// start_index: first GLOBAL batch index to produce — the draw is a pure
+// function of (seed, global slot), so resuming a run at step K with
+// start_index=K replays the exact uninterrupted stream (no repeated, no
+// skipped samples), even across a shard-count change as long as the global
+// batch (batch * num_shards) is held constant.
 int tony_loader_open_at(const char* paths, uint32_t batch, uint32_t seq,
                         uint32_t shard_id, uint32_t num_shards, uint64_t seed,
                         uint32_t prefetch_depth, uint32_t num_threads,
@@ -225,9 +230,9 @@ int tony_loader_open_at(const char* paths, uint32_t batch, uint32_t seq,
     ld->num_windows += s.count / (seq + 1);
     p += std::strlen(p) + 1;
   }
-  if (ld->num_windows < num_shards) {
+  if (ld->num_windows < 1) {
     delete ld;
-    return kErrFormat;  // not enough data for one window per worker
+    return kErrFormat;  // not enough data for a single window
   }
   const uint32_t n = num_threads ? num_threads : 2;
   for (uint32_t i = 0; i < n; ++i) ld->workers.emplace_back([ld] { ld->worker_loop(); });

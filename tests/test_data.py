@@ -71,23 +71,51 @@ class TestTokenLoader:
              TokenLoader(shards, batch=2, seq=64, seed=2) as b:
             assert not np.array_equal(a.next(), b.next())
 
-    def test_dp_shards_draw_disjoint_windows(self, shards):
-        """shard_id strides the window space: workers never read the same window."""
-        seen = set()
+    def test_dp_shards_resplit_one_global_stream(self, shards):
+        """The global-order contract: K shards' local batches, concatenated
+        in shard order, reconstruct the K=1 stream with batch G exactly —
+        workers own disjoint row-slices of ONE global batch sequence."""
+        G, STEPS = 8, 3
+        with TokenLoader(shards, batch=G, seq=64, seed=5) as ref:
+            want = [ref.next() for _ in range(STEPS)]
+        for K in (2, 4):
+            parts = []
+            for sid in range(K):
+                with TokenLoader(shards, batch=G // K, seq=64,
+                                 shard_id=sid, num_shards=K, seed=5) as ld:
+                    parts.append([ld.next() for _ in range(STEPS)])
+            for t in range(STEPS):
+                got = np.concatenate([parts[sid][t] for sid in range(K)])
+                np.testing.assert_array_equal(got, want[t], err_msg=f"K={K} t={t}")
+
+    def test_reshard_resume_no_repeat_no_skip(self, shards):
+        """The elastic-replay contract (VERDICT r4 #1): a run that consumed
+        3 global batches at K=2 and resumes at K=4 (same global batch G,
+        start_index=3) continues the EXACT global stream — bitwise equal to
+        the uninterrupted K=1 reference, nothing repeated, nothing skipped."""
+        G, SPLIT, TOTAL = 8, 3, 6
+        with TokenLoader(shards, batch=G, seq=64, seed=9) as ref:
+            want = [ref.next() for _ in range(TOTAL)]
+        # phase 1: K=2 consumes global batches [0, SPLIT)
         for sid in range(2):
-            with TokenLoader(shards, batch=4, seq=64, shard_id=sid, num_shards=2, seed=5) as ld:
-                spe = ld.num_windows // 2
-                for i in range(4):
-                    for j in range(4):
-                        slot = i * 4 + j
-                        from tony_tpu.data.native import _splitmix
-                        r = _splitmix(5 ^ _splitmix((slot // spe) * 0x10001 + slot % spe))
-                        seen.add(((r % spe) * 2 + sid, sid))
-        by_window: dict = {}
-        for w, sid in seen:
-            by_window.setdefault(w, set()).add(sid)
-        for w, sids in by_window.items():
-            assert len(sids) == 1, f"window {w} drawn by both workers"
+            with TokenLoader(shards, batch=G // 2, seq=64,
+                             shard_id=sid, num_shards=2, seed=9) as ld:
+                for t in range(SPLIT):
+                    np.testing.assert_array_equal(
+                        ld.next(), want[t][sid * (G // 2):(sid + 1) * (G // 2)]
+                    )
+        # phase 2 ("node lost, gang shrunk... or grown"): K=4 resumes at
+        # start_index=SPLIT and continues the same global stream
+        for K in (4, 1):
+            for sid in range(K):
+                with TokenLoader(shards, batch=G // K, seq=64, shard_id=sid,
+                                 num_shards=K, seed=9, start_index=SPLIT) as ld:
+                    for t in range(SPLIT, TOTAL):
+                        np.testing.assert_array_equal(
+                            ld.next(),
+                            want[t][sid * (G // K):(sid + 1) * (G // K)],
+                            err_msg=f"K={K} sid={sid} t={t}",
+                        )
 
     def test_python_fallback_matches_native(self, shards, monkeypatch):
         """Both implementations must produce identical batch streams."""

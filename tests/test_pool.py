@@ -7,6 +7,7 @@ processes — driving the real client → AM → executor spine.
 """
 
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -313,6 +314,92 @@ class TestPoolE2E:
         for i in (0, 1):
             with open(os.path.join(handle.staging_dir, f"node_of_worker_{i}.txt")) as f:
                 assert f.read() == "nodeB"
+
+    def test_node_death_gang_downsizes_and_resumes(self, tmp_tony_root, pool_with_agents, tmp_path):
+        """The full elastic loop (VERDICT r4 #1): a 2-worker training gang
+        loses one node FOR GOOD; the configured gang (2×3g) no longer fits
+        the surviving 4g node, so the AM re-plans to 1 worker
+        (tony.worker.min-instances=1), the pool admits the shrunken demand,
+        and the restarted single process restores the checkpoint onto the
+        smaller mesh and trains to completion. The global-order loader
+        replays the exact sample stream across the shard-count change, so
+        the final loss matches an uninterrupted fixed-shape reference."""
+        import numpy as np
+
+        from tony_tpu.data import write_token_shard
+        from tony_tpu.models import llama
+        from tony_tpu.train.loop import LoopConfig, run_lm_training
+
+        rng = np.random.default_rng(0)
+        data = tmp_path / "data"
+        data.mkdir()
+        write_token_shard(data / "s0.tonytok", rng.integers(0, 256, 40_000, dtype=np.int32))
+        ckpt = tmp_path / "ckpt"
+
+        svc, agents = pool_with_agents
+        cfg = TonyConfig({
+            keys.STAGING_ROOT: str(tmp_tony_root),
+            **pool_conf(svc, {
+                "tony.worker.instances": "2",
+                "tony.worker.min-instances": "1",
+                "tony.worker.memory": "3g",  # 2×3g > the surviving 4g node
+                # short hysteresis so the test shrinks promptly (the default
+                # 10s guards real pools against heartbeat blips)
+                keys.APPLICATION_DOWNSIZE_GRACE_MS: "500",
+                keys.TASK_RESTART_ON_FAILURE: "true",
+                keys.TASK_MAX_TOTAL_INSTANCE_FAILURES: "2",
+                keys.EXECUTES: f"{fixture_cmd('elastic_train.py')} {data} {ckpt}",
+            }),
+        })
+        client = Client(cfg)
+        handle = client.submit()
+        rpc = handle.rpc(timeout_s=30)
+        assert rpc is not None
+        # wait for attempt 0 (2 procs) to finish its 4 steps + checkpoint,
+        # then kill nodeA permanently
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if (ckpt / "4").exists():
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError("attempt 0 never checkpointed step 4")
+        os.kill(agents[0].pid, signal.SIGKILL)
+        final = client.monitor_application(handle, quiet=True)
+        assert final == JobStatus.SUCCEEDED, handle.final_status()
+
+        # the gang RAN smaller: final status shows ONE worker (the portal's
+        # job page renders this same task list)
+        status = handle.final_status()
+        workers = [t for t in status["tasks"] if t["name"] == "worker"]
+        assert len(workers) == 1, status["tasks"]
+        # the resize is in the history stream (portal event log)
+        hist_dir = os.path.join(str(tmp_tony_root), "history")
+        blob = ""
+        for root, _, files in os.walk(hist_dir):
+            for f in files:
+                if handle.app_id in f or handle.app_id in root:
+                    with open(os.path.join(root, f)) as fh:
+                        blob += fh.read()
+        assert "GANG_RESIZED" in blob
+        # attempt 1 resumed from the checkpoint, single-process, to step 8
+        log = os.path.join(handle.staging_dir, "logs", "worker_0_r1", "stdout.log")
+        with open(log) as f:
+            out = f.read()
+        assert "resumed from checkpoint step" in out, out
+        m = re.search(r"elastic attempt 1: step=8 loss=([0-9.]+) procs=1", out)
+        assert m, out
+        resumed_loss = float(m.group(1))
+
+        # loss continuity: an uninterrupted fixed-shape run over the SAME
+        # global stream ends at the same loss (reduction-order noise only)
+        ref = run_lm_training(
+            llama, llama.LLAMA_TINY,
+            LoopConfig(steps=8, schedule_steps=8, batch_size=4, seq_len=64,
+                       log_every=8, warmup_steps=0, data_dir=str(data),
+                       checkpoint_dir=str(tmp_path / "ref_ckpt")),
+        )
+        np.testing.assert_allclose(resumed_loss, ref["loss"], rtol=1e-3)
 
 
 class TestRemoteResourceManagerUnit:

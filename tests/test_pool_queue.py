@@ -187,6 +187,108 @@ class TestQueueAdmission:
         assert "node" in svc.allocate("low", "worker", 0, 3 * GB, 1, 0)
         svc.stop()
 
+    def test_cross_queue_reclaim_restores_guarantee(self):
+        """VERDICT r4 #2: a dev job that borrowed the whole idle pool is
+        preempted back when a prod job arrives — the 70% guarantee is a
+        guarantee at RECLAIM time, not only at admission time."""
+        svc = make_pool(preemption=True, queues={"prod": 0.7, "dev": 0.3})
+        register_cpu_node(svc, "n0")  # 4 GB → prod share 2.8 GB, dev 1.2 GB
+        svc.register_app("dev1", queue="dev", memory_bytes=4 * GB, vcores=1)
+        got = svc.allocate("dev1", "worker", 0, 4 * GB, 1, 0)  # idle borrow: whole pool
+        assert "node" in got
+        # prod arrives within its guarantee → dev1 is evicted for it
+        svc.register_app("prod1", queue="prod", memory_bytes=2 * GB, vcores=1)
+        assert got["id"] in svc._nodes["n0"].pending_kills
+        st = svc.pool_status()
+        assert [a["app_id"] for a in st["queues"]["prod"]["admitted"]] == ["prod1"]
+        waiting = st["queues"]["dev"]["waiting"]
+        assert [w["app_id"] for w in waiting] == ["dev1"]
+        assert waiting[0]["preempted"] is True
+        # the eviction is a preemption, not a failure (budget-exempt)
+        svc.node_heartbeat("n0", exited={got["id"]: 137})
+        assert svc.poll_exited("dev1") == {got["id"]: constants.EXIT_PREEMPTED}
+        assert "node" in svc.allocate("prod1", "worker", 0, 2 * GB, 1, 0)
+        # dev re-queues; once prod releases, dev borrows again
+        assert svc.allocate("dev1", "worker", 0, 4 * GB, 1, 0).get("wait") is True
+        svc.release_all("prod1")
+        assert "node" in svc.allocate("dev1", "worker", 0, 4 * GB, 1, 0)
+        svc.stop()
+
+    def test_reclaim_never_digs_a_queue_below_its_share(self):
+        """Eviction stops the moment the borrower queue is no longer over
+        its share: an at-share queue is protected from reclaim."""
+        svc = make_pool(preemption=True, queues={"a": 0.5, "b": 0.5})
+        register_cpu_node(svc, "n0")  # 4 GB → 2 GB per queue share
+        for app in ("b1", "b2"):  # b borrows the whole idle pool (2× share)
+            svc.register_app(app, queue="b", memory_bytes=2 * GB, vcores=1)
+            svc.allocate(app, "worker", 0, 2 * GB, 1, 0)
+        b_cids = {rec["id"] for rec in svc._containers.values()}
+        svc.register_app("a1", queue="a", memory_bytes=2 * GB, vcores=1)
+        # exactly ONE b app (the newest, b2) is evicted — b lands AT share
+        st = svc.pool_status()
+        assert [a["app_id"] for a in st["queues"]["b"]["admitted"]] == ["b1"]
+        assert [w["app_id"] for w in st["queues"]["b"]["waiting"]] == ["b2"]
+        assert [a["app_id"] for a in st["queues"]["a"]["admitted"]] == ["a1"]
+        assert len(svc._nodes["n0"].pending_kills) == 1
+        # a second a-app cannot reclaim from b (b is AT its share now):
+        # it waits for free capacity like anyone else
+        svc.register_app("a2", queue="a", memory_bytes=2 * GB, vcores=1)
+        st = svc.pool_status()
+        assert [a["app_id"] for a in st["queues"]["b"]["admitted"]] == ["b1"]
+        assert [w["app_id"] for w in st["queues"]["a"]["waiting"]] == ["a2"]
+        assert len(svc._nodes["n0"].pending_kills) == 1  # no new kills
+        assert b_cids  # silence unused warning; ids asserted via counts
+        svc.stop()
+
+    def test_reclaim_evicts_straddling_borrower_whole(self):
+        """Whole-gang granularity: a borrower whose claim STRADDLES the
+        share line (3 GB app, 2 GB share) evicts whole — the claimant's
+        guarantee wins over the borrower's partial entitlement (the app
+        only ever ran by borrowing; it re-queues with under-share
+        priority)."""
+        svc = make_pool(preemption=True, queues={"a": 0.5, "b": 0.5})
+        register_cpu_node(svc, "n0")  # 4 GB → 2 GB per queue share
+        svc.register_app("b1", queue="b", memory_bytes=3 * GB, vcores=1)
+        svc.allocate("b1", "worker", 0, 3 * GB, 1, 0)  # 1 GB over share
+        svc.register_app("a1", queue="a", memory_bytes=2 * GB, vcores=1)
+        st = svc.pool_status()
+        assert [a["app_id"] for a in st["queues"]["a"]["admitted"]] == ["a1"]
+        assert [w["app_id"] for w in st["queues"]["b"]["waiting"]] == ["b1"]
+        svc.stop()
+
+    def test_reclaim_never_lifts_the_head_beyond_its_own_share(self):
+        """Reclaim restores guarantees — it never funds borrowing: a head
+        whose demand exceeds its own share cannot evict other queues."""
+        svc = make_pool(preemption=True, queues={"a": 0.25, "b": 0.75})
+        register_cpu_node(svc, "n0")  # 4 GB → a share 1 GB
+        svc.register_app("b1", queue="b", memory_bytes=4 * GB, vcores=1)
+        svc.allocate("b1", "worker", 0, 4 * GB, 1, 0)
+        svc.register_app("a1", queue="a", memory_bytes=2 * GB, vcores=1)  # 2× share
+        st = svc.pool_status()
+        assert [a["app_id"] for a in st["queues"]["b"]["admitted"]] == ["b1"]
+        assert [w["app_id"] for w in st["queues"]["a"]["waiting"]] == ["a1"]
+        assert not svc._nodes["n0"].pending_kills
+        svc.stop()
+
+    def test_reclaim_grace_defers_cross_queue_eviction(self):
+        """tony.pool.preemption.grace-ms: cross-queue kills fire only after
+        the under-share head has waited out the grace window."""
+        svc = make_pool(preemption=True, preemption_grace_ms=400,
+                        queues={"prod": 0.7, "dev": 0.3})
+        register_cpu_node(svc, "n0")
+        svc.register_app("dev1", queue="dev", memory_bytes=4 * GB, vcores=1)
+        svc.allocate("dev1", "worker", 0, 4 * GB, 1, 0)
+        svc.register_app("prod1", queue="prod", memory_bytes=2 * GB, vcores=1)
+        assert not svc._nodes["n0"].pending_kills  # inside the grace window
+        assert svc.allocate("prod1", "worker", 0, 2 * GB, 1, 0).get("wait") is True
+        time.sleep(0.5)
+        # next scheduling pass (any allocate retry) fires the reclaim
+        assert svc.allocate("prod1", "worker", 0, 2 * GB, 1, 0).get("wait") is True
+        assert svc._nodes["n0"].pending_kills
+        st = svc.pool_status()
+        assert [a["app_id"] for a in st["queues"]["prod"]["admitted"]] == ["prod1"]
+        svc.stop()
+
     def test_no_preemption_of_equal_priority(self):
         svc = make_pool(preemption=True)
         register_cpu_node(svc, "n0")
@@ -363,6 +465,60 @@ class TestQueueE2E:
         t2.join(timeout=60)
         assert r1.get("final") == JobStatus.SUCCEEDED, h1.final_status()
         assert r2.get("final") == JobStatus.SUCCEEDED, h2.final_status()
+
+    def test_cross_queue_reclaim_evicts_borrower_end_to_end(
+        self, tmp_tony_root, tmp_path
+    ):
+        """VERDICT r4 #2 done-when: prod=0.7,dev=0.3 — a dev job borrows the
+        whole idle pool, a prod job arrives, dev is preempted back (and
+        gang-restarts later), prod runs. Both jobs SUCCEED."""
+        svc = PoolService(heartbeat_interval_ms=100, max_missed_heartbeats=4,
+                          secret=SECRET, preemption=True,
+                          queues={"prod": 0.7, "dev": 0.3})
+        svc.start()
+        agent = spawn_agent(svc.address, "solo", str(tmp_path))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if any(n.alive for n in svc._nodes.values()):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("agent failed to register")
+        try:
+            script, marker = marker_script(tmp_path, "dev_borrower.py")
+            h1, t1, r1 = submit_async(tmp_tony_root, pool_conf(svc, {
+                "tony.worker.instances": "1", "tony.worker.memory": "3g",
+                keys.APPLICATION_QUEUE: "dev",
+                keys.EXECUTES: f"{sys.executable} {script}",
+            }))
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if marker.exists():
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("dev job never started")
+            quick = tmp_path / "prod_quick.py"
+            quick.write_text("print('prod ran')\n")
+            h2, t2, r2 = submit_async(tmp_tony_root, pool_conf(svc, {
+                "tony.worker.instances": "1", "tony.worker.memory": "2g",
+                keys.APPLICATION_QUEUE: "prod",
+                keys.EXECUTES: f"{sys.executable} {quick}",
+            }))
+            # prod's guarantee reclaims the borrower: prod runs and finishes,
+            # dev gang-restarts (marker present → exits clean) — both succeed
+            t2.join(timeout=90)
+            assert r2.get("final") == JobStatus.SUCCEEDED, h2.final_status()
+            t1.join(timeout=90)
+            assert r1.get("final") == JobStatus.SUCCEEDED, h1.final_status()
+        finally:
+            if agent.poll() is None:
+                agent.terminate()
+            try:
+                agent.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                agent.kill()
+            svc.stop()
 
     def test_preemption_evicts_and_restarts_lower_priority(
         self, tmp_tony_root, small_pool, tmp_path

@@ -331,3 +331,64 @@ class TestChunkedPrefill:
         results = eng.run()
         want = generate.generate(params, p, cfg, max_new_tokens=5)
         np.testing.assert_array_equal(np.asarray(results[rid]), np.asarray(want[0]))
+
+
+class TestTPServing:
+    """Model-axis tensor-parallel decode (VERDICT r4 #3): the training
+    column/row rules shard the decode projections, the cache shards over
+    heads, the host loop is untouched — greedy output must match the
+    single-device engine exactly."""
+
+    def test_tp2_greedy_matches_single_device(self):
+        from tony_tpu.parallel import MeshSpec
+
+        params = _params()
+        prompts = [[1, 2, 3, 4], [7, 8]]
+        ref = ContinuousBatcher(params, CFG, num_slots=2, max_len=64, decode_chunk=4)
+        rids = [ref.submit(p, max_new_tokens=6) for p in prompts]
+        want = ref.run()
+
+        mesh = MeshSpec(model=2).build(devices=jax.devices()[:2])
+        eng = ContinuousBatcher(
+            params, CFG, num_slots=2, max_len=64, decode_chunk=4, mesh=mesh,
+        )
+        rids2 = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        got = eng.run()
+        # the cache (and so the decode step's operands) really shard over
+        # the model axis — this is TP, not a replicated copy
+        assert len(eng.cache.k.sharding.device_set) == 2
+        for ra, rb in zip(rids, rids2):
+            assert got[rb] == want[ra], (got[rb], want[ra])
+
+    def test_tp_rejects_paged_and_bad_heads(self):
+        from tony_tpu.parallel import MeshSpec
+
+        params = _params()
+        mesh = MeshSpec(model=2).build(devices=jax.devices()[:2])
+        with pytest.raises(ValueError, match="dense"):
+            ContinuousBatcher(params, CFG, num_slots=1, max_len=64,
+                              kv="paged", page_len=32, mesh=mesh)
+        cfg3 = dataclasses.replace(CFG, n_heads=3, n_kv_heads=3)
+        with pytest.raises(ValueError, match="divide"):
+            ContinuousBatcher(llama.init(KEY, cfg3), cfg3, num_slots=1,
+                              max_len=64, mesh=mesh)
+
+    def test_tp2_per_request_sampling_and_streaming(self):
+        """The dynamic per-slot sampler and drain_stream ride the TP engine
+        unchanged (host bookkeeping never sees the mesh)."""
+        from tony_tpu.parallel import MeshSpec
+
+        params = _params()
+        mesh = MeshSpec(model=2).build(devices=jax.devices()[:2])
+        eng = ContinuousBatcher(
+            params, CFG, num_slots=2, max_len=64, decode_chunk=4, mesh=mesh,
+        )
+        g = eng.submit([1, 2, 3], max_new_tokens=6)  # greedy (engine default)
+        s = eng.submit([4, 5], max_new_tokens=6, temperature=0.8, top_k=8)
+        out = eng.run()
+        ref = ContinuousBatcher(params, CFG, num_slots=2, max_len=64, decode_chunk=4)
+        g_ref = ref.submit([1, 2, 3], max_new_tokens=6)
+        ref_out = ref.run()
+        assert out[g] == ref_out[g_ref]  # greedy slot exact despite sampled neighbor
+        assert len(out[s]) == 6
+        assert all(0 <= t < CFG.vocab_size for t in out[s])

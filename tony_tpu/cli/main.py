@@ -150,7 +150,11 @@ def _cmd_pool(argv: list[str]) -> int:
     p.add_argument("--queues", default="default=1.0",
                    help="capacity queues 'name=share,...' (tony.pool.queues)")
     p.add_argument("--preemption", action="store_true",
-                   help="let waiting higher-priority jobs evict lower-priority ones")
+                   help="let waiting higher-priority jobs evict lower-priority ones, "
+                        "and under-share queues reclaim capacity from over-share borrowers")
+    p.add_argument("--preemption-grace-ms", type=int, default=0,
+                   help="wait this long before cross-queue reclaim evicts borrowers "
+                        "(tony.pool.preemption.grace-ms)")
     args = p.parse_args(argv)
 
     from tony_tpu.cluster.pool import parse_queue_spec
@@ -158,7 +162,8 @@ def _cmd_pool(argv: list[str]) -> int:
     secret = os.environ.get(constants.ENV_POOL_SECRET) or secrets.token_hex(16)
     svc = PoolService(port=args.port, secret=secret,
                       queues=parse_queue_spec(args.queues),
-                      preemption=args.preemption)
+                      preemption=args.preemption,
+                      preemption_grace_ms=args.preemption_grace_ms)
     svc.start()
     host, port = svc.address
 

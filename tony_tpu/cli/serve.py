@@ -30,7 +30,7 @@ from tony_tpu.cli.notebook import wait_for_task_url
 # flags forwarded verbatim to the serving_http process
 _ENGINE_FLAGS = (
     "preset", "hf", "tokenizer", "slots", "max_len", "decode_chunk",
-    "prefill_chunk", "attn", "kv", "page_len", "num_pages",
+    "prefill_chunk", "attn", "kv", "page_len", "num_pages", "tp",
     "temperature", "top_k", "eos_id", "seed", "port",
 )
 
@@ -51,6 +51,8 @@ def build_serve_config(argv: list[str]) -> tuple[TonyConfig, argparse.Namespace]
     p.add_argument("--kv", default="dense", choices=["dense", "paged"])
     p.add_argument("--page_len", type=int, default=256)
     p.add_argument("--num_pages", type=int, default=0)
+    p.add_argument("--tp", type=int, default=1,
+                   help="model-axis tensor parallelism for the decode step")
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top_k", type=int, default=0)
     p.add_argument("--eos_id", type=int, default=-1)

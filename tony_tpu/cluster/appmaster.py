@@ -38,7 +38,7 @@ from tony_tpu.cluster.resources import (
     LocalResourceManager,
     ResourceManager,
 )
-from tony_tpu.cluster.scheduler import DependencyTimeout, TaskScheduler
+from tony_tpu.cluster.scheduler import DependencyTimeout, TaskScheduler, plan_downsize
 from tony_tpu.cluster.rpc import APPLICATION_RPC_METHODS, RpcServer
 from tony_tpu.cluster.session import JobStatus, Session, TaskStatus
 from tony_tpu.runtime import get_runtime
@@ -132,6 +132,9 @@ class ApplicationMaster:
         self._failures_seen = 0
         self._gang_complete_fired = False
         self._queue_waiting = False
+        self._shrunk: dict[str, int] = {}   # elastic downsize: type → instances
+        self._last_capacity_probe = 0.0
+        self._capacity_short_since: float | None = None  # downsize hysteresis
         # guards (attempt, session) as one unit: RPC handlers capture both
         # atomically so a stale-attempt call can never touch a fresh session
         import threading
@@ -345,6 +348,98 @@ class ApplicationMaster:
                     EventType.TASK_FINISHED, task=task.id, exit_code=rc, source="container-exit"
                 )
 
+    # ------------------------------------------------- elastic gang shrink
+    def _effective_config(self) -> TonyConfig:
+        """The job config with any elastic downsize applied to the per-type
+        instance counts (everything else untouched)."""
+        if not self._shrunk:
+            return self.config
+        d = self.config.to_dict()
+        for t, n in self._shrunk.items():
+            d[keys.jobtype_key(t, keys.INSTANCES_SUFFIX)] = str(n)
+        return TonyConfig(d)
+
+    def _plan_gang_downsize(self) -> dict[str, int] | None:
+        """The elastic DECISION (VERDICT r4 #1): does the gang still FIT
+        (and PLACE on) the pool's alive capacity? When it doesn't — a node
+        was lost for good, so waiting would queue forever — and
+        ``tony.<type>.min-instances`` floors permit, return shrunken
+        per-type counts. None → keep the current size (fits, no floors,
+        capacity unknown, or the shortfall is younger than the downsize
+        grace — a blip must not permanently halve the gang)."""
+        floors = {
+            t: self.config.get_int(keys.jobtype_key(t, keys.MIN_INSTANCES_SUFFIX), 0)
+            for t in self.config.job_types()
+        }
+        if not any(floors.values()):
+            return None  # elasticity not enabled for any type
+        # ONE capacity snapshot: totals derived from the same node list the
+        # placement check uses (two RPCs would race a node dying in between)
+        nodes = self.rm.node_capacities()
+        if nodes is not None:
+            from tony_tpu.cluster.resources import Resources
+
+            cap = Resources(
+                memory_bytes=sum(n.memory_bytes for n in nodes),
+                vcores=sum(n.vcores for n in nodes),
+                chips=sum(n.chips for n in nodes),
+            )
+        else:
+            cap = self.rm.total_capacity()
+        if cap is None:
+            return None
+        cfg = self._effective_config()
+        counts = {t: cfg.instances(t) for t in cfg.job_types()}
+        per_instance = {t: self.scheduler.plans[t].resources for t in counts}
+        plan = plan_downsize(counts, per_instance, floors, cap, nodes=nodes)
+        if plan is None:
+            self._capacity_short_since = None  # capacity recovered (or fits)
+            return None
+        now = time.time()
+        if self._capacity_short_since is None:
+            self._capacity_short_since = now
+        grace_s = self.config.get_time_ms(keys.APPLICATION_DOWNSIZE_GRACE_MS, 10_000) / 1000
+        if now - self._capacity_short_since < grace_s:
+            # inside the hysteresis window: restart/queue at FULL size; the
+            # mid-wait probe re-checks and applies the shrink only if the
+            # shortfall persists past the grace
+            return None
+        return plan
+
+    def _announce_downsize(self, shrink: dict[str, int], reason: str) -> None:
+        cfg = self._effective_config()
+        self.events.emit(
+            EventType.GANG_RESIZED,
+            instances={t: cfg.instances(t) for t in cfg.job_types()},
+            shrunk=shrink,
+            reason=reason,
+        )
+        # shrunken demand re-registers with the pool so queue admission
+        # evaluates the gang the AM will actually ask for
+        self.rm.register_app(
+            queue=self.config.get(keys.APPLICATION_QUEUE) or "default",
+            priority=self.config.get_int(keys.APPLICATION_PRIORITY, 0),
+            demand=self.scheduler.total_demand(),
+        )
+
+    def _downsize_while_queued(self) -> bool:
+        """A gang waiting in pool admission with NOTHING running re-plans in
+        place when capacity was permanently lost mid-wait (the node died
+        while we were queued — the restart path below never fires)."""
+        if self._containers:
+            return False  # partial gangs restart through the failure path
+        shrink = self._plan_gang_downsize()
+        if not shrink:
+            return False
+        with self._epoch_lock:
+            self._shrunk.update(shrink)
+            cfg = self._effective_config()
+            self.session = Session(cfg)
+            self.session.job_status = JobStatus.RUNNING
+            self.scheduler = TaskScheduler(cfg, self.session, self.rm)
+        self._announce_downsize(shrink, "capacity lost while queued")
+        return True
+
     def _maybe_restart_gang(self, reason: str, exit_code: int | None = None) -> bool:
         """Whole-gang restart from checkpoint (rebuild-only elasticity).
 
@@ -352,6 +447,11 @@ class ApplicationMaster:
         the gang always restarts (re-queuing through pool admission) and the
         eviction never consumes the failure budget — YARN likewise excludes
         preempted containers from AM failure counts.
+
+        Before relaunching, the AM re-checks the pool's alive capacity: a
+        gang that no longer fits (node permanently lost) re-plans to a
+        smaller instance count when ``tony.<type>.min-instances`` allows —
+        the workers then restore the checkpoint onto the smaller mesh.
         """
         preempted = exit_code == constants.EXIT_PREEMPTED
         if not preempted:
@@ -367,13 +467,19 @@ class ApplicationMaster:
             self.rm.release(c)
         self._containers.clear()
         self._by_task.clear()
+        shrink = self._plan_gang_downsize()
         with self._epoch_lock:  # atomic with _fenced_session's capture
+            if shrink:
+                self._shrunk.update(shrink)
+            cfg = self._effective_config()
             self._restart_attempt += 1
             self._gang_complete_fired = False
             self._gang_started_ms = None
-            self.session = Session(self.config)
+            self.session = Session(cfg)
             self.session.job_status = JobStatus.RUNNING
-            self.scheduler = TaskScheduler(self.config, self.session, self.rm)
+            self.scheduler = TaskScheduler(cfg, self.session, self.rm)
+        if shrink:
+            self._announce_downsize(shrink, f"capacity lost: {reason}")
         return True
 
     def run(self) -> JobStatus:
@@ -407,6 +513,26 @@ class ApplicationMaster:
                 if not self._queue_waiting:
                     self._queue_waiting = True
                     self.events.emit(EventType.QUEUE_WAIT, state="waiting", reason=str(e))
+                # mid-wait elastic check (throttled): if capacity was lost
+                # for good while we queued, shrink instead of waiting forever
+                now = time.time()
+                if now - self._last_capacity_probe > 2.0:
+                    self._last_capacity_probe = now
+                    if (
+                        not self._downsize_while_queued()
+                        and self._containers
+                        and self._plan_gang_downsize()
+                    ):
+                        # PARTIALLY-allocated gang (some containers running,
+                        # the rest waiting on capacity that died): the only
+                        # safe shrink is a whole-gang restart — budget-exempt
+                        # like preemption, since capacity loss is a cluster
+                        # event, not a job failure. The restart path re-plans
+                        # the smaller gang itself.
+                        self._maybe_restart_gang(
+                            "capacity lost while partially allocated",
+                            exit_code=constants.EXIT_PREEMPTED,
+                        )
             except (DependencyTimeout, AllocationError) as e:
                 self._fail(str(e))
                 self._kill_all_containers()

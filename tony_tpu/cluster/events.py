@@ -32,6 +32,7 @@ class EventType(enum.Enum):
     HEARTBEAT_LOST = "HEARTBEAT_LOST"
     QUEUE_WAIT = "QUEUE_WAIT"
     GANG_COMPLETE = "GANG_COMPLETE"
+    GANG_RESIZED = "GANG_RESIZED"
     TASK_URL_REGISTERED = "TASK_URL_REGISTERED"
     METRICS_SNAPSHOT = "METRICS_SNAPSHOT"
     APPLICATION_FINISHED = "APPLICATION_FINISHED"

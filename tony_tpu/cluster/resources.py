@@ -254,6 +254,21 @@ class ResourceManager(ABC):
         """Allocate a container, raise AllocationError (never fits), or raise
         AllocationPending (queued behind other tenants — retry later)."""
 
+    def total_capacity(self) -> "Resources | None":
+        """TOTAL resources of the pool's currently-alive universe (ignoring
+        occupancy), or None when unknown. The AM's elastic-downsize decision
+        compares this against the configured gang demand: a gang that no
+        longer FITS the pool (node permanently lost) can re-plan smaller
+        instead of queuing forever."""
+        return None
+
+    def node_capacities(self) -> "list[Resources] | None":
+        """Per-alive-node capacities (same universe as ``total_capacity``),
+        or None when unknown. Lets the downsize decision check a real
+        PLACEMENT, not just totals — a 4x3g gang does not fit three 4g
+        hosts even though the sums agree."""
+        return None
+
     @abstractmethod
     def release(self, container: Container) -> None: ...
 
@@ -458,6 +473,16 @@ class LocalResourceManager(ProcessContainerMixin, ResourceManager):
             self.host.used_memory -= container.resources.memory_bytes
             self.host.used_vcores -= container.resources.vcores
 
+    def total_capacity(self) -> Resources:
+        return Resources(
+            memory_bytes=self.host.memory_bytes,
+            vcores=self.host.vcores,
+            chips=self.grid.total,
+        )
+
+    def node_capacities(self) -> list[Resources]:
+        return [self.total_capacity()]
+
     def _live_containers(self) -> list[Container]:
         with self._lock:
             return list(self._containers.values())
@@ -640,6 +665,24 @@ class MultiSliceResourceManager(ProcessContainerMixin, ResourceManager):
             if not self._containers:
                 # gang fully released (restart path): next gang spans anew
                 self._span = None
+
+    def total_capacity(self) -> Resources:
+        return Resources(
+            memory_bytes=sum(h.memory_bytes for sl in self.slices for h in sl.hosts),
+            vcores=sum(h.vcores for sl in self.slices for h in sl.hosts),
+            chips=sum(sl.grid.total for sl in self.slices),
+        )
+
+    def node_capacities(self) -> list[Resources]:
+        return [
+            Resources(
+                memory_bytes=h.memory_bytes,
+                vcores=h.vcores,
+                chips=sl.grid.total // max(len(sl.hosts), 1),
+            )
+            for sl in self.slices
+            for h in sl.hosts
+        ]
 
     def gang_slice_span(self) -> list[int]:
         """Slice ids the gang's allocations occupy — the job's DCN span.

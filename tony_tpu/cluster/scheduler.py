@@ -133,3 +133,90 @@ class TaskScheduler:
             vcores=sum(p.instances * p.resources.vcores for p in self.plans.values()),
             chips=sum(p.instances * p.resources.chips for p in self.plans.values()),
         )
+
+
+def _next_lower_divisor(orig: int, below: int, floor: int) -> int | None:
+    """Largest divisor of ``orig`` strictly below ``below`` and >= floor."""
+    for n in range(below - 1, max(floor, 1) - 1, -1):
+        if orig % n == 0:
+            return n
+    return None
+
+
+def plan_downsize(
+    counts: dict[str, int],
+    per_instance: dict[str, Resources],
+    floors: dict[str, int],
+    capacity: Resources,
+    nodes: list[Resources] | None = None,
+) -> dict[str, int] | None:
+    """The elastic-downsize DECISION (SURVEY.md §2.5 elastic row): given the
+    gang's current per-type instance ``counts``, each type's ``per_instance``
+    resources, per-type shrink ``floors`` (tony.<type>.min-instances; 0 = not
+    shrinkable), and the pool's alive ``capacity`` — return the largest
+    shrunken counts that fit, or None when no shrink is needed (already fits)
+    or none can help (even the floor gang exceeds capacity, e.g. a transient
+    outage the AM should keep queuing through).
+
+    Two rules that keep the shrunken gang actually RUNNABLE:
+    - shrunken counts are DIVISORS of the configured count (4 -> 2 -> 1,
+      never 4 -> 3): data/fsdp jobs size their global batch and device mesh
+      to the gang, and only divisor gangs preserve batch/mesh divisibility
+      (a 3-process gang would crash the relaunch of a batch-8 job forever);
+    - when per-node capacities are given, "fits" requires a first-fit-
+      decreasing PLACEMENT onto the nodes, not just aggregate totals —
+      a 4-worker x 3g gang does NOT fit three 4g nodes even though
+      12g <= 12g.
+
+    Shrink order: the shrinkable type furthest ABOVE its floor first
+    (ties: largest count), so multi-type gangs shrink evenly.
+    """
+
+    def demand(c: dict[str, int]) -> Resources:
+        return Resources(
+            memory_bytes=sum(c[t] * per_instance[t].memory_bytes for t in c),
+            vcores=sum(c[t] * per_instance[t].vcores for t in c),
+            chips=sum(c[t] * per_instance[t].chips for t in c),
+        )
+
+    def fits(c: dict[str, int]) -> bool:
+        d = demand(c)
+        if not (
+            d.memory_bytes <= capacity.memory_bytes
+            and d.vcores <= capacity.vcores
+            and d.chips <= capacity.chips
+        ):
+            return False
+        if nodes is None:
+            return True
+        free = [[n.memory_bytes, n.vcores, n.chips] for n in nodes]
+        inst: list[Resources] = []
+        for t, n in c.items():
+            inst.extend([per_instance[t]] * n)
+        inst.sort(key=lambda r: (r.memory_bytes, r.chips, r.vcores), reverse=True)
+        for r in inst:
+            for f in free:
+                if f[0] >= r.memory_bytes and f[1] >= r.vcores and f[2] >= r.chips:
+                    f[0] -= r.memory_bytes
+                    f[1] -= r.vcores
+                    f[2] -= r.chips
+                    break
+            else:
+                return False
+        return True
+
+    now = dict(counts)
+    if fits(now):
+        return None
+    while not fits(now):
+        options = {
+            t: _next_lower_divisor(counts[t], now[t], floors[t])
+            for t in now
+            if floors.get(t, 0) > 0
+        }
+        options = {t: n for t, n in options.items() if n is not None}
+        if not options:
+            return None  # no lever left: keep queuing at current size
+        t = max(options, key=lambda t: (now[t] - floors[t], now[t]))
+        now[t] = options[t]
+    return {t: n for t, n in now.items() if n != counts[t]}

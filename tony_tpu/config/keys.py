@@ -20,6 +20,11 @@ from __future__ import annotations
 APPLICATION_NAME = "tony.application.name"
 APPLICATION_QUEUE = "tony.application.queue"
 APPLICATION_PRIORITY = "tony.application.priority"  # int; higher runs first within a queue
+# Elastic-downsize hysteresis: the pool's capacity must stay short for this
+# long (continuously) before the AM applies a min-instances shrink — a node
+# heartbeat blip coinciding with an unrelated restart must not permanently
+# halve the gang. While waiting, the gang queues at full size and retries.
+APPLICATION_DOWNSIZE_GRACE_MS = "tony.application.downsize-grace-ms"
 APPLICATION_FRAMEWORK = "tony.application.framework"      # jax|tensorflow|pytorch|horovod|mxnet|generic
 APPLICATION_UNTRACKED_TYPES = "tony.application.untracked.jobtypes"  # csv; don't gate job verdict
 APPLICATION_NODE_LABEL = "tony.application.node-label"
@@ -64,6 +69,12 @@ VCORES_SUFFIX = "vcores"
 CHIPS_SUFFIX = "chips"          # TPU chips per task (reference: gpus)
 SLICE_SUFFIX = "slice"          # TPU slice spec per task gang, e.g. "v5e-8" or "2x4"
 COMMAND_SUFFIX = "command"      # per-type command override (reference: tony.<type>.command)
+# Elastic floor: on gang restart, if the pool's ALIVE capacity can no longer
+# fit the configured gang (node permanently lost), the AM may re-plan this
+# type down to min-instances and the workers restore the checkpoint onto the
+# smaller mesh (data/fsdp-axis jobs — the global-order data replay keeps the
+# sample stream exact). Absent/0 → the type never shrinks (default).
+MIN_INSTANCES_SUFFIX = "min-instances"
 
 
 def jobtype_key(jobtype: str, suffix: str) -> str:
@@ -109,6 +120,10 @@ NODE_MAX_MISSED_HEARTBEATS = "tony.node.max-missed-heartbeats"
 # ---------------------------------------------------------------------------
 POOL_QUEUES = "tony.pool.queues"                # "name=share,..." e.g. "prod=0.7,dev=0.3"
 POOL_PREEMPTION_ENABLED = "tony.pool.preemption.enabled"
+# Cross-queue reclaim grace: a waiting under-share head must wait this long
+# before the scheduler evicts over-share borrowers from OTHER queues
+# (same-queue priority preemption has no grace — it is an explicit ranking).
+POOL_PREEMPTION_GRACE_MS = "tony.pool.preemption.grace-ms"
 
 # ---------------------------------------------------------------------------
 # tony.history.* / tony.portal.* — events, history, portal
@@ -142,6 +157,7 @@ DEFAULTS: dict[str, str] = {
     APPLICATION_NAME: "tony-tpu-app",
     APPLICATION_QUEUE: "default",
     APPLICATION_PRIORITY: "0",
+    APPLICATION_DOWNSIZE_GRACE_MS: "10s",
     APPLICATION_FRAMEWORK: "jax",
     APPLICATION_UNTRACKED_TYPES: "ps,tensorboard,notebook",
     APPLICATION_NODE_LABEL: "",
@@ -186,6 +202,7 @@ DEFAULTS: dict[str, str] = {
 
     POOL_QUEUES: "default=1.0",
     POOL_PREEMPTION_ENABLED: "false",
+    POOL_PREEMPTION_GRACE_MS: "0",
 
     HISTORY_LOCATION: "",            # empty → <staging-root>/history
     HISTORY_MOVE_INTERVAL_MS: "1000",
@@ -212,6 +229,7 @@ JOBTYPE_SUFFIXES = (
     CHIPS_SUFFIX,
     SLICE_SUFFIX,
     COMMAND_SUFFIX,
+    MIN_INSTANCES_SUFFIX,
 )
 
 

@@ -103,8 +103,19 @@ class TokenLoader:
 
     Native path: C++ mmap + prefetch threads (off-GIL). Fallback: numpy with
     a single Python prefetch thread. Both draw windows with the same
-    splitmix hash of (seed, epoch, slot), strided by ``num_shards`` with
-    offset ``shard_id`` — the data-parallel split the executor env provides.
+    splitmix hash of (seed, GLOBAL slot).
+
+    GLOBAL-ORDER CONTRACT (the elastic-replay spec): the stream is ONE
+    global sequence of samples, a pure function of (seed, global slot);
+    shard ``k`` of ``K`` produces rows ``[k*batch, (k+1)*batch)`` of each
+    global batch of ``G = batch * num_shards`` rows — i.e. local batch
+    ``t``, row ``i`` is global slot ``t*G + k*batch + i``. Consequences:
+    - concatenating the K shards' local batches (in shard order)
+      reconstructs the K=1 stream with batch ``G`` exactly;
+    - replay after a RESHARD (K -> K') is exact provided the global batch
+      ``G`` is held constant (per-shard batch adapts to ``G / K'``) and the
+      resumed loaders start at ``start_index`` = global batch index —
+      no sample is repeated or skipped across the shape change.
     """
 
     def __init__(
@@ -120,10 +131,11 @@ class TokenLoader:
         num_threads: int = 2,
         start_index: int = 0,
     ):
-        """``start_index``: first batch index to produce. The window draw is
-        a pure function of (seed, batch index), so a resumed run that keeps
-        its seed and starts the loader at its step counter replays the exact
-        uninterrupted stream — no repeated, no skipped samples."""
+        """``start_index``: first GLOBAL batch index to produce. The window
+        draw is a pure function of (seed, global slot), so a resumed run
+        that keeps its seed and global batch size and starts the loader at
+        its step counter replays the exact uninterrupted stream — no
+        repeated, no skipped samples — even across a shard-count change."""
         if not shard_paths:
             raise ValueError("no shard paths")
         if num_shards < 1 or not 0 <= shard_id < num_shards:
@@ -152,8 +164,8 @@ class TokenLoader:
             self._shards = [open_shard(p) for p in shard_paths]  # mmapped, stored dtype
             self.total_tokens = int(sum(s.size for s in self._shards))
             self.num_windows = int(sum(s.size // (seq + 1) for s in self._shards))
-            if self.num_windows < num_shards:
-                raise ValueError("not enough data for one window per worker")
+            if self.num_windows < 1:
+                raise ValueError("not enough data for a single (seq+1)-token window")
             self._queue: Queue = Queue(maxsize=prefetch_depth)
             self._index = start_index
             self._stop = threading.Event()
@@ -173,13 +185,15 @@ class TokenLoader:
 
     def _py_batch(self, index: int) -> np.ndarray:
         out = np.empty((self.batch, self.seq + 1), np.int32)
-        spe = self.num_windows // self.num_shards  # slots per epoch
+        gbatch = self.batch * self.num_shards
+        nw = self.num_windows
         for i in range(self.batch):
-            slot = index * self.batch + i
-            epoch, pos = (slot // spe, slot % spe) if spe else (0, 0)
+            # global slot: this shard owns rows [k*batch, (k+1)*batch) of
+            # global batch `index` — the elastic-replay contract above
+            g = index * gbatch + self.shard_id * self.batch + i
+            epoch, pos = divmod(g, nw)
             r = _splitmix(_splitmix(self.seed ^ _splitmix(epoch)) ^ pos)
-            window = (r % spe) * self.num_shards + self.shard_id if spe else 0
-            out[i] = self._py_window(window)
+            out[i] = self._py_window(r % nw)
         return out
 
     def _py_prefetch(self) -> None:

@@ -407,7 +407,7 @@ class ContinuousBatcher:
         eos_id: int = -1, temperature: float = 0.0, top_k: int = 0,
         key: jax.Array | None = None, decode_chunk: int = 8, attn: str = "auto",
         prefill_chunk: int = 0, kv: str = "dense", page_len: int = 256,
-        num_pages: int | None = None,
+        num_pages: int | None = None, mesh=None,
     ):
         if num_slots < 1 or max_len < 1:
             raise ValueError(f"need num_slots>=1 and max_len>=1, got {num_slots}/{max_len}")
@@ -421,6 +421,36 @@ class ContinuousBatcher:
                 raise ValueError(f"page_len must be a multiple of 8 >= 8, got {page_len}")
             if max_len % page_len:
                 raise ValueError(f"max_len {max_len} must be a multiple of page_len {page_len}")
+        # model-axis tensor parallelism (VERDICT r4 #3): the TRAINING
+        # column/row rules (models/llama.py sharding_rules) shard the decode
+        # projections unchanged, the KV cache shards over its head dim, and
+        # the host loop stays identical — admission/retirement/sampling
+        # bookkeeping never sees the mesh. GSPMD inserts the row-parallel
+        # psums; attention is embarrassingly parallel over heads. TP=1 with
+        # a mesh (or mesh=None) is byte-for-byte the single-device program.
+        self.mesh = mesh
+        self.tp = int(mesh.shape.get("model", 1)) if mesh is not None else 1
+        if self.tp > 1:
+            if kv == "paged":
+                raise ValueError(
+                    "model-axis TP serving currently requires kv='dense' "
+                    "(the paged pool's page indirection is per-device)"
+                )
+            if cfg.n_kv_heads % self.tp or cfg.n_heads % self.tp:
+                raise ValueError(
+                    f"n_heads {cfg.n_heads} and n_kv_heads {cfg.n_kv_heads} "
+                    f"must divide the model axis ({self.tp})"
+                )
+            # the Pallas ragged kernel is not GSPMD-partitionable; the
+            # pure-XLA bucketed path shards cleanly over the head dim.
+            # An EXPLICIT ragged ask under TP is an error (silently running
+            # a different kernel would hide a perf cliff); "auto" coerces.
+            if attn == "ragged":
+                raise ValueError(
+                    "attn='ragged' is incompatible with model-axis TP (the "
+                    "Pallas kernel is not GSPMD-partitionable); use attn='auto'"
+                )
+            attn = "bucketed"
         if attn == "auto" and jax.default_backend() == "cpu":
             attn = "bucketed"
         if attn not in ("auto", "ragged", "bucketed"):
@@ -475,6 +505,27 @@ class ContinuousBatcher:
         else:
             self.cache = init_slot_cache(cfg, num_slots, max_len)
         self.tokens = jnp.zeros((num_slots,), jnp.int32)  # last token per slot
+        if self.tp > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from tony_tpu.models import llama as _llama
+            from tony_tpu.models import mixtral as _mixtral
+
+            rules = (
+                _mixtral.sharding_rules(cfg)
+                if isinstance(cfg, _mixtral.MixtralConfig)
+                else _llama.sharding_rules(cfg)
+            )
+            self.params = jax.device_put(params, rules.sharding_tree(params, mesh))
+            repl = NamedSharding(mesh, P())
+            heads = NamedSharding(mesh, P(None, None, "model"))  # [L,S,Hkv,T,Dh]
+            self.cache = SlotCache(
+                k=jax.device_put(self.cache.k, heads),
+                v=jax.device_put(self.cache.v, heads),
+                lengths=jax.device_put(self.cache.lengths, repl),
+            )
+            self.tokens = jax.device_put(self.tokens, repl)
         self.key = key if key is not None else jax.random.PRNGKey(0)
         self.pending: list[_Request] = []
         self.running: dict[int, _Request] = {}   # slot → request

@@ -369,7 +369,20 @@ def build_engine(args) -> ContinuousBatcher:
     if args.int8:
         from tony_tpu.ops.quant import quantize_tree
 
-        params = quantize_tree(params)
+        params, _, _ = quantize_tree(params)
+    mesh = None
+    if getattr(args, "tp", 1) > 1:
+        from tony_tpu.parallel import MeshSpec
+
+        # model-axis TP decode over the FIRST tp visible devices: the host
+        # may expose more chips than the mesh uses (MeshSpec.build requires
+        # an exact count, so hand it the slice explicitly)
+        if len(jax.devices()) < args.tp:
+            raise ValueError(
+                f"--tp {args.tp} needs {args.tp} devices but only "
+                f"{len(jax.devices())} are visible"
+            )
+        mesh = MeshSpec(model=args.tp).build(devices=jax.devices()[:args.tp])
     return ContinuousBatcher(
         params, cfg,
         num_slots=args.slots, max_len=args.max_len, eos_id=args.eos_id,
@@ -378,6 +391,7 @@ def build_engine(args) -> ContinuousBatcher:
         prefill_chunk=args.prefill_chunk,
         kv=args.kv, page_len=args.page_len,
         num_pages=args.num_pages if args.num_pages > 0 else None,
+        mesh=mesh,
     )
 
 
@@ -400,6 +414,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--page-len", type=int, default=256)
     p.add_argument("--num-pages", type=int, default=0,
                    help="page pool size (0 = dense-equivalent: slots x max_len)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="model-axis tensor parallelism for the decode step "
+                        "(shards projections + KV heads over the mesh; dense kv only)")
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--eos-id", type=int, default=-1)

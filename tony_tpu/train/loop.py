@@ -41,6 +41,10 @@ class LoopConfig:
     #: FULL plan — otherwise a 4-step run resumed to 8 decays twice as fast
     #: over its first half as the uninterrupted 8-step run did
     schedule_steps: int = 0
+    #: GLOBAL batch rows per step — constant across gang sizes. Each of the
+    #: K gang processes contributes ``batch_size // K`` rows, so an elastic
+    #: restart onto a smaller gang keeps the optimization trajectory AND
+    #: the data-replay contract (global-order draw) intact.
     batch_size: int = 8
     seq_len: int = 512
     log_every: int = 10
@@ -154,29 +158,53 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
     )
 
     key = jax.random.PRNGKey(start_step + 1)
+    procs = jax.process_count()
+    if loop.batch_size % procs:
+        raise ValueError(
+            f"global batch_size {loop.batch_size} must divide by the gang's "
+            f"{procs} processes (elastic restarts re-split the SAME global "
+            "batch across the new gang)"
+        )
+    local_rows = loop.batch_size // procs
     loader = None
     if loop.data_dir:
         # Real data: the native prefetching loader, data-parallel split by
-        # process (the TF_CONFIG-analog contract: each gang member reads a
-        # disjoint stride of the window space).
+        # process (the TF_CONFIG-analog contract: each gang member owns a
+        # contiguous row-slice of every GLOBAL batch).
         from pathlib import Path
 
         from tony_tpu.data import TokenLoader
 
         paths = sorted(Path(loop.data_dir).glob("*.tonytok"))
         # exact replay on resume: the draw is a pure function of
-        # (data_seed, batch index), so keeping the seed FIXED and starting
-        # the loader at the resumed step replays the uninterrupted stream —
-        # no sample is repeated or skipped relative to a run that never
-        # restarted (the old seed=start_step re-seeding drew a fresh
-        # permutation every resume)
+        # (data_seed, GLOBAL slot), so keeping the seed and global batch
+        # FIXED and starting the loader at the resumed step replays the
+        # uninterrupted stream — no sample repeated or skipped — even when
+        # the gang restarted at a DIFFERENT size (global-order contract,
+        # data/native.py)
         loader = TokenLoader(
-            paths, loop.batch_size, loop.seq_len,
-            shard_id=jax.process_index(), num_shards=jax.process_count(),
+            paths, local_rows, loop.seq_len,
+            shard_id=jax.process_index(), num_shards=procs,
             seed=loop.data_seed, start_index=start_step,
         )
         print(f"[train] data: {len(paths)} shards, {loader.total_tokens} tokens, "
               f"native={loader.is_native}", flush=True)
+
+    assemble = None
+    if procs > 1:
+        # each process contributes its contiguous row-slice; the global
+        # batch array is sharded over the data-parallel mesh axes (the
+        # spmd_train E2E pattern promoted into the loop)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        batch_sharding = NamedSharding(mesh, PartitionSpec(("data", "fsdp")))
+
+        def assemble(local):
+            import numpy as np
+
+            return jax.make_array_from_process_local_data(
+                batch_sharding, np.asarray(local)
+            )
 
     metrics: dict = {}
     profiler = StepProfiler()  # no-op unless the executor exported TONY_PROFILE_DIR
@@ -185,7 +213,16 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
         for step in range(start_step, loop.steps):
             profiler.step(step)
             if loader is not None:
-                batch = {"tokens": jax.numpy.asarray(loader.next())}
+                local = loader.next()
+                batch = {
+                    "tokens": assemble(local) if assemble else jax.numpy.asarray(local)
+                }
+            elif assemble is not None:
+                local = model_module.synthetic_batch(
+                    jax.random.fold_in(jax.random.fold_in(key, step), jax.process_index()),
+                    local_rows, loop.seq_len, model_cfg,
+                )
+                batch = {k: assemble(v) for k, v in local.items()}
             else:
                 batch = model_module.synthetic_batch(
                     jax.random.fold_in(key, step), loop.batch_size, loop.seq_len, model_cfg
