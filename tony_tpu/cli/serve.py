@@ -32,6 +32,7 @@ _ENGINE_FLAGS = (
     "preset", "hf", "tokenizer", "slots", "max_len", "decode_chunk",
     "prefill_chunk", "attn", "kv", "page_len", "num_pages", "tp",
     "temperature", "top_k", "eos_id", "seed", "port",
+    "admission_queue", "request_timeout_s",
 )
 
 
@@ -53,6 +54,10 @@ def build_serve_config(argv: list[str]) -> tuple[TonyConfig, argparse.Namespace]
     p.add_argument("--num_pages", type=int, default=0)
     p.add_argument("--tp", type=int, default=1,
                    help="model-axis tensor parallelism for the decode step")
+    p.add_argument("--admission_queue", type=int, default=256,
+                   help="bounded admission inbox; full → 429")
+    p.add_argument("--request_timeout_s", type=float, default=0.0,
+                   help="default per-request deadline (0 = none)")
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top_k", type=int, default=0)
     p.add_argument("--eos_id", type=int, default=-1)

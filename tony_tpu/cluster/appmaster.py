@@ -422,15 +422,10 @@ class ApplicationMaster:
             demand=self.scheduler.total_demand(),
         )
 
-    def _downsize_while_queued(self) -> bool:
+    def _downsize_while_queued(self, shrink: dict[str, int]) -> None:
         """A gang waiting in pool admission with NOTHING running re-plans in
         place when capacity was permanently lost mid-wait (the node died
         while we were queued — the restart path below never fires)."""
-        if self._containers:
-            return False  # partial gangs restart through the failure path
-        shrink = self._plan_gang_downsize()
-        if not shrink:
-            return False
         with self._epoch_lock:
             self._shrunk.update(shrink)
             cfg = self._effective_config()
@@ -438,9 +433,11 @@ class ApplicationMaster:
             self.session.job_status = JobStatus.RUNNING
             self.scheduler = TaskScheduler(cfg, self.session, self.rm)
         self._announce_downsize(shrink, "capacity lost while queued")
-        return True
 
-    def _maybe_restart_gang(self, reason: str, exit_code: int | None = None) -> bool:
+    def _maybe_restart_gang(
+        self, reason: str, exit_code: int | None = None,
+        shrink: dict[str, int] | None = None,
+    ) -> bool:
         """Whole-gang restart from checkpoint (rebuild-only elasticity).
 
         Preemption (EXIT_PREEMPTED) is a CLUSTER action, not a job failure:
@@ -467,7 +464,8 @@ class ApplicationMaster:
             self.rm.release(c)
         self._containers.clear()
         self._by_task.clear()
-        shrink = self._plan_gang_downsize()
+        if shrink is None:  # a caller may pass the plan it already computed
+            shrink = self._plan_gang_downsize()
         with self._epoch_lock:  # atomic with _fenced_session's capture
             if shrink:
                 self._shrunk.update(shrink)
@@ -518,20 +516,21 @@ class ApplicationMaster:
                 now = time.time()
                 if now - self._last_capacity_probe > 2.0:
                     self._last_capacity_probe = now
-                    if (
-                        not self._downsize_while_queued()
-                        and self._containers
-                        and self._plan_gang_downsize()
-                    ):
+                    plan = self._plan_gang_downsize()
+                    if plan and not self._containers:
+                        self._downsize_while_queued(plan)
+                    elif plan:
                         # PARTIALLY-allocated gang (some containers running,
                         # the rest waiting on capacity that died): the only
                         # safe shrink is a whole-gang restart — budget-exempt
                         # like preemption, since capacity loss is a cluster
-                        # event, not a job failure. The restart path re-plans
-                        # the smaller gang itself.
+                        # event, not a job failure. The plan is passed in so
+                        # a flapping second probe can't kill the gang for a
+                        # full-size relaunch.
                         self._maybe_restart_gang(
                             "capacity lost while partially allocated",
                             exit_code=constants.EXIT_PREEMPTED,
+                            shrink=plan,
                         )
             except (DependencyTimeout, AllocationError) as e:
                 self._fail(str(e))

@@ -674,15 +674,20 @@ class MultiSliceResourceManager(ProcessContainerMixin, ResourceManager):
         )
 
     def node_capacities(self) -> list[Resources]:
-        return [
-            Resources(
-                memory_bytes=h.memory_bytes,
-                vcores=h.vcores,
-                chips=sl.grid.total // max(len(sl.hosts), 1),
-            )
-            for sl in self.slices
-            for h in sl.hosts
-        ]
+        out = []
+        for sl in self.slices:
+            n = max(len(sl.hosts), 1)
+            base, rem = divmod(sl.grid.total, n)
+            for i, h in enumerate(sl.hosts):
+                # remainder chips land on the first hosts so the node list
+                # SUMS to the true pool total — an undercount here would
+                # trigger spurious elastic downsizing
+                out.append(Resources(
+                    memory_bytes=h.memory_bytes,
+                    vcores=h.vcores,
+                    chips=base + (1 if i < rem else 0),
+                ))
+        return out
 
     def gang_slice_span(self) -> list[int]:
         """Slice ids the gang's allocations occupy — the job's DCN span.

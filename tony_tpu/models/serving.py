@@ -361,11 +361,13 @@ class _Request:
     temperature: float | None = None
     top_k: int | None = None
     top_p: float | None = None
+    cancelled: bool = False           # client gone: retire at the next chunk
 
     def is_done(self, eos_id: int) -> bool:
-        """THE termination predicate — budget spent or EOS emitted. Both the
-        chunk-drain loop and retirement consult this one method."""
-        return len(self.out) >= self.max_new_tokens or (
+        """THE termination predicate — budget spent, EOS emitted, or the
+        request cancelled. Both the chunk-drain loop and retirement consult
+        this one method, so a cancelled slot frees within one decode chunk."""
+        return self.cancelled or len(self.out) >= self.max_new_tokens or (
             eos_id >= 0 and bool(self.out) and self.out[-1] == eos_id
         )
 
@@ -591,6 +593,33 @@ class ContinuousBatcher:
             temperature=temperature, top_k=top_k, top_p=top_p,
         ))
         return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Drop a request wherever it is (same-thread as step(), like all
+        engine calls). Pending → removed; staged → removed with its prefix
+        pins released; running → retires at the next chunk boundary (the
+        slot and its pages free through the normal retirement flush — a
+        dropped client stops costing TPU within one decode chunk). Returns
+        False for unknown/already-finished rids. A cancelled request never
+        lands in ``done``; its partial tokens are discarded."""
+        for i, req in enumerate(self.pending):
+            if req.rid == rid:
+                self.pending.pop(i)
+                self._stream_pos.pop(rid, None)
+                return True
+        for i, entry in enumerate(self._staged):
+            if entry.req.rid == rid:
+                if self.kv == "paged":
+                    for p in entry.matched:
+                        self.allocator.release(p)
+                self._staged.pop(i)
+                self._stream_pos.pop(rid, None)
+                return True
+        for slot, req in self.running.items():
+            if req.rid == rid:
+                req.cancelled = True  # is_done() now true → retires next chunk
+                return True
+        return False
 
     # -- engine internals ---------------------------------------------------
 
@@ -820,7 +849,10 @@ class ContinuousBatcher:
     def _retire_if_done(self, req: _Request):
         if req.slot in self.running and req.is_done(self.eos_id):
             del self.running[req.slot]
-            self.done[req.rid] = req.out
+            if req.cancelled:
+                self._stream_pos.pop(req.rid, None)  # nobody drains it again
+            else:
+                self.done[req.rid] = req.out
             self._retired_slots.append(req.slot)
             self._slot_len[req.slot] = 0
 

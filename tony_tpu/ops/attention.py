@@ -805,12 +805,18 @@ def remat_block(block_fn, remat: bool, policy: str = "full"):
         # vector-bound gating pipeline) and the fused expert-MLP kernel
         # output ("moe_gemm", ops/moe_gemm.py): [N_rows, D] bf16 per layer
         # — the one activation whose replay would re-run three grouped
-        # GEMMs (A/B'd +0.8 MFU pt on the moe bench preset, BASELINE.md r3)
+        # GEMMs (A/B'd +0.8 MFU pt on the moe bench preset, BASELINE.md r3).
+        # TONY_REMAT_EXTRA_NAMES ("a,b") appends further named activations
+        # (e.g. moe_disp / moe_combine) — the measurement ladder's knob for
+        # per-shape save-vs-replay tradeoffs without code edits.
+        import os as _os
+
+        names = ["flash_o", "flash_lse", "moe_route", "moe_gemm"]
+        extra = _os.environ.get("TONY_REMAT_EXTRA_NAMES", "")
+        names += [n.strip() for n in extra.split(",") if n.strip()]
         return jax.checkpoint(
             block_fn,
-            policy=jax.checkpoint_policies.save_only_these_names(
-                "flash_o", "flash_lse", "moe_route", "moe_gemm"
-            ),
+            policy=jax.checkpoint_policies.save_only_these_names(*names),
         )
     if policy != "full":
         raise ValueError(f"remat_policy must be full|dots|flash, got {policy!r}")

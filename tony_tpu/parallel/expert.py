@@ -544,15 +544,21 @@ def _ragged_expert_ffn(x, router_w, w_gate, w_up, w_down, cfg: MoEConfig, token_
     group_sizes = checkpoint_name(group_sizes, "moe_route")
 
     xs = _dispatch_gather(x.reshape(B * T, D), sort_tok, dest)           # [N|PN, D]
-    # NOT pinned: saving xs would skip the gather replay in the backward,
-    # but the PN·D/layer it costs forces a smaller batch — measured net
-    # NEGATIVE (b24 32.6% / b28 33.2% pinned vs b32 33.8% unpinned)
+    # NAMED but not saved by the default flash policy: saving xs would skip
+    # the gather replay in the backward, but the PN·D/layer it costs forces
+    # a smaller batch — measured net NEGATIVE (b24 32.6% / b28 33.2% pinned
+    # vs b32 33.8% unpinned). The name lets the remat ladder
+    # (TONY_REMAT_EXTRA_NAMES=moe_disp) re-test the tradeoff per shape.
+    xs = checkpoint_name(xs, "moe_disp")
     ys = _expert_swiglu(xs, w_gate, w_up, w_down, group_sizes, tile)
     # combine in choice order: gather each (token, k) choice's row and
     # weight-sum over k — gathers in the backward too (_combine_gather)
     y = _combine_gather(
         ys, dest, sort_tok, gate_vals.reshape(B * T, K), gate_sorted
     )
+    # combine output [B·T, D]: saving it stops the backward from replaying
+    # the combine gather chain (ladder name, not in the default save list)
+    y = checkpoint_name(y, "moe_combine")
     return y.reshape(B, T, D).astype(dtype), aux
 
 
