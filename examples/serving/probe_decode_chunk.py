@@ -1,0 +1,89 @@
+"""Warmed per-chunk timing of the jitted decode for dense vs paged.
+
+    python examples/serving/probe_decode_chunk.py --ctx 1056 --max-len 2048
+
+Builds both engines at identical slot state (every slot length = --ctx),
+compiles the decode-chunk program once, then times N warmed calls each —
+no admission, no prefill, no compile in the timed region. This is the
+cleanest per-chunk paged-vs-dense number the engine can produce; the
+bench_decode end-to-end figure layers admission + compile on top.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from tony_tpu.models import llama
+from tony_tpu.models.serving import ContinuousBatcher
+
+
+def steady_state(eng, ctx: int, budget: int) -> None:
+    rng = np.random.default_rng(0)
+    for _ in range(eng.S):
+        eng.submit(rng.integers(0, eng.cfg.vocab_size, ctx).tolist(),
+                   max_new_tokens=budget)
+    eng.step()  # admit + first chunk (compiles here)
+    jax.block_until_ready(eng.tokens)
+
+
+def time_chunks(eng, n_calls: int) -> list[float]:
+    out = []
+    for _ in range(n_calls):
+        t0 = time.perf_counter()
+        eng.step()
+        jax.block_until_ready(eng.tokens)
+        out.append((time.perf_counter() - t0) * 1000)
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--slots", type=int, default=16)
+    p.add_argument("--ctx", type=int, default=1056)
+    p.add_argument("--max-len", type=int, default=2048)
+    p.add_argument("--page-len", type=int, default=256)
+    p.add_argument("--chunk", type=int, default=8)
+    p.add_argument("--calls", type=int, default=10)
+    p.add_argument("--attn", default="auto", choices=["auto", "ragged", "bucketed"])
+    args = p.parse_args()
+
+    cfg = dataclasses.replace(llama.LLAMA_1B, max_seq=args.max_len)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    # decode budget: enough chunks for warm + measured calls
+    budget = (args.calls + 3) * args.chunk
+
+    res = {}
+    for kv in ("dense", "paged"):
+        eng = ContinuousBatcher(
+            params, cfg, num_slots=args.slots, max_len=args.max_len,
+            kv=kv, page_len=args.page_len, decode_chunk=args.chunk,
+            attn=args.attn,
+        )
+        steady_state(eng, args.ctx, budget)
+        time_chunks(eng, 2)  # settle
+        ms = time_chunks(eng, args.calls)
+        res[kv] = dict(
+            attn=eng.attn, ms_per_chunk=[round(m, 1) for m in ms],
+            median=round(sorted(ms)[len(ms) // 2], 1),
+        )
+        print(f"[probe] {kv}: median {res[kv]['median']} ms/chunk "
+              f"({res[kv]['ms_per_chunk']})", file=sys.stderr)
+
+    print(json.dumps(dict(
+        metric="decode_chunk_warmed_ms", slots=args.slots, ctx=args.ctx,
+        max_len=args.max_len, chunk=args.chunk,
+        dense=res["dense"], paged=res["paged"],
+        paged_over_dense=round(res["paged"]["median"] / res["dense"]["median"], 3),
+    )))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+"""Per-step timeline of the shared-prefix workload (paged-vs-dense probe).
+
+    python examples/serving/probe_prefix_phases.py --kv paged
+
+Replicates bench_decode's shared-prefix workload (16 requests, 1024-token
+common prefix + 32 unique, 16 new tokens each) but times EVERY engine
+step individually and labels it with what the engine did (staged / admitted
+/ running / prefix-hit delta), so the end-to-end gap between dense and
+paged decomposes into named phases instead of one opaque total.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from tony_tpu.models import llama
+from tony_tpu.models.serving import ContinuousBatcher
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--kv", default="paged", choices=["dense", "paged"])
+    p.add_argument("--slots", type=int, default=16)
+    p.add_argument("--prompt-len", type=int, default=1056)
+    p.add_argument("--shared-prefix", type=int, default=1024)
+    p.add_argument("--new-tokens", type=int, default=16)
+    p.add_argument("--max-len", type=int, default=2048)
+    p.add_argument("--page-len", type=int, default=256)
+    p.add_argument("--passes", type=int, default=1,
+                   help=">1: drain the workload N-1 times first (compiles + "
+                        "prefix registration), then per-step-time the last")
+    args = p.parse_args()
+
+    cfg = dataclasses.replace(llama.LLAMA_1B, max_seq=args.max_len)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    eng = ContinuousBatcher(
+        params, cfg, num_slots=args.slots, max_len=args.max_len,
+        kv=args.kv, page_len=args.page_len,
+    )
+    rng = np.random.default_rng(0)
+    shared = rng.integers(0, cfg.vocab_size, args.shared_prefix).tolist()
+
+    def submit_all():
+        for _ in range(args.slots):
+            tail = args.prompt_len - len(shared)
+            eng.submit(shared + rng.integers(0, cfg.vocab_size, tail).tolist(),
+                       max_new_tokens=args.new_tokens)
+
+    for _ in range(max(args.passes, 1) - 1):
+        submit_all()
+        while eng.step():
+            pass
+        jax.block_until_ready(eng.tokens)
+    submit_all()
+    tok0 = sum(len(v) for v in eng.done.values())  # exclude warm-pass output
+
+    t_start = time.perf_counter()
+    i = 0
+    rows = []
+    while True:
+        before = dict(
+            pending=len(eng.pending), staged=len(eng._staged),
+            running=len(eng.running),
+            hits=getattr(eng, "prefix_hit_tokens", 0),
+        )
+        t0 = time.perf_counter()
+        more = eng.step()
+        jax.block_until_ready(eng.tokens)
+        dt = time.perf_counter() - t0
+        rows.append(dict(
+            step=i, ms=round(dt * 1000, 1), **{f"pre_{k}": v for k, v in before.items()},
+            post_pending=len(eng.pending), post_staged=len(eng._staged),
+            post_running=len(eng.running),
+            post_hits=getattr(eng, "prefix_hit_tokens", 0),
+        ))
+        i += 1
+        if not more:
+            break
+    total = time.perf_counter() - t_start
+    for r in rows:
+        print(json.dumps(r), file=sys.stderr)
+    n_tok = sum(len(v) for v in eng.done.values()) - tok0
+    print(json.dumps(dict(
+        metric="prefix_phase_probe", kv=args.kv, total_s=round(total, 2),
+        steps=len(rows), tokens=n_tok,
+        step_ms=[r["ms"] for r in rows],
+    )))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -409,3 +409,45 @@ class TestServeComposesWithPool:
                         Client.kill(h)
                     except Exception:  # noqa: BLE001 — best-effort teardown
                         pass
+
+
+class TestKvDefaultResolution:
+    """--kv unset resolves in the SERVER process (where the backend is
+    visible), to paged only where paged can actually run (r5 review
+    findings: the old CLI-side paged default broke CPU pools without
+    interpret mode and turned page-misaligned --max_len into startup
+    errors)."""
+
+    @staticmethod
+    def _args(**kw):
+        import types
+
+        d = dict(kv=None, tp=1, max_len=512, page_len=256)
+        d.update(kw)
+        return types.SimpleNamespace(**d)
+
+    def test_resolution_matrix(self, monkeypatch):
+        from tony_tpu.models.serving_http import _resolve_kv
+
+        # the harness backend is cpu + interpret (conftest) → paged
+        assert _resolve_kv(self._args()) == "paged"
+        assert _resolve_kv(self._args(tp=2)) == "dense"
+        assert _resolve_kv(self._args(max_len=640)) == "dense"
+        assert _resolve_kv(self._args(kv="dense")) == "dense"
+        # explicit paged is passed through even where the default
+        # would decline it (the engine then raises its own hard error)
+        assert _resolve_kv(self._args(kv="paged", tp=2)) == "paged"
+        # cpu WITHOUT interpret mode: the paged kernel cannot run
+        monkeypatch.delenv("TONY_PALLAS_INTERPRET", raising=False)
+        assert _resolve_kv(self._args()) == "dense"
+
+    def test_cli_forwards_only_explicit_kv(self):
+        import shlex
+
+        from tony_tpu.cli.serve import build_serve_config
+
+        cfg, _ = build_serve_config([])
+        assert "--kv" not in cfg.get("tony.serve.command")
+        cfg, _ = build_serve_config(["--kv", "paged"])
+        cmd = shlex.split(cfg.get("tony.serve.command"))
+        assert cmd[cmd.index("--kv") + 1] == "paged"

@@ -49,7 +49,13 @@ def build_serve_config(argv: list[str]) -> tuple[TonyConfig, argparse.Namespace]
     p.add_argument("--decode_chunk", type=int, default=8)
     p.add_argument("--prefill_chunk", type=int, default=0)
     p.add_argument("--attn", default="auto")
-    p.add_argument("--kv", default="dense", choices=["dense", "paged"])
+    p.add_argument("--kv", default=None, choices=["dense", "paged"],
+                   help="KV cache layout. Unset → the server resolves it "
+                        "(paged where it can run: TPU backend, tp=1, "
+                        "page-aligned max_len; dense otherwise). Paged wins "
+                        "shared-prefix workloads +11-13%% and 3x slot "
+                        "capacity at equal HBM; dense wins uniform short "
+                        "bursts (~10%%). See docs/serving.md.")
     p.add_argument("--page_len", type=int, default=256)
     p.add_argument("--num_pages", type=int, default=0)
     p.add_argument("--tp", type=int, default=1,

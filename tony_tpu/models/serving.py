@@ -329,6 +329,28 @@ def decode_steps_bucketed(
     return toks, seq, SlotCache(k, v, sub.lengths)
 
 
+# host-loop cache/token updates MUST be shape-stable jitted calls: an eager
+# `.at[idx].set()` whose index list length (or constant-folded position)
+# varies re-lowers and RE-COMPILES per distinct pattern — ~50 ms per tiny
+# executable on a local backend, >1 s through a remote-compile tunnel. The
+# r5 probe caught retirement flushes + per-admission token writes costing
+# 13.7 s of an 18 s serving pass this way (decode itself: 0.6 s); with the
+# fixed-shape forms below each helper compiles exactly once per engine.
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _set_slot_token(tokens, slot, val):
+    return tokens.at[slot].set(val[0])  # val [1]: indexed inside the jit
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _mask_zero(lengths, mask):
+    return jnp.where(mask, 0, lengths)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _mask_zero_paged(lengths, page_table, mask):
+    return jnp.where(mask, 0, lengths), jnp.where(mask[:, None], 0, page_table)
+
+
 def _bucket(n: int, lo: int = 16) -> int:
     b = lo
     while b < n:
@@ -753,6 +775,16 @@ class ContinuousBatcher:
                         last_logits, self._split(), self.temperature, self.top_k
                     )
             entry.pre, entry.pos, entry.first = pre, pos, first
+            if first is not None:
+                # start the device→host copy NOW, while the prefill is still
+                # in flight: admission's int(first[0]) then finds the value
+                # already local instead of paying a blocking round trip per
+                # request (~165 ms/request of pure admission serialization
+                # on a tunneled backend, r5 probe)
+                try:
+                    first.copy_to_host_async()
+                except AttributeError:  # non-jax.Array stand-ins in tests
+                    pass
             if self.prefill_chunk > 0:
                 break  # one chunk per engine step — decode interleaves
 
@@ -775,7 +807,7 @@ class ContinuousBatcher:
                 )
             self._staged.pop(0)
             free.pop(0)
-            self.tokens = self.tokens.at[slot].set(first[0])
+            self.tokens = _set_slot_token(self.tokens, jnp.int32(slot), first)
             self._samp_temp[slot] = (
                 req.temperature if req.temperature is not None else self.temperature
             )
@@ -784,7 +816,7 @@ class ContinuousBatcher:
             self._samp_dirty = True
             self._slot_len[slot] = Tp
             req.slot = slot
-            req.out.append(int(first[0]))
+            req.out.append(int(np.asarray(first)[0]))  # host copy (async-warmed)
             self.running[slot] = req
             self._retire_if_done(req)  # 1-token requests finish at admission
 
@@ -864,7 +896,9 @@ class ContinuousBatcher:
         idle = [s for s in self._retired_slots if s not in self.running]
         self._retired_slots = []
         if idle:
-            idx = jnp.asarray(idle, jnp.int32)
+            mask = np.zeros(self.S, bool)
+            mask[idle] = True
+            mask = jnp.asarray(mask)  # [S] always — one compiled variant
             if self.kv == "paged":
                 from tony_tpu.models.paged_cache import PagedCache
 
@@ -875,15 +909,16 @@ class ContinuousBatcher:
                 for s in idle:
                     for p in self._slot_pages.pop(s, []):
                         self.allocator.release(p)
+                lengths, page_table = _mask_zero_paged(
+                    self.cache.lengths, self.cache.page_table, mask
+                )
                 self.cache = PagedCache(
-                    self.cache.k, self.cache.v,
-                    self.cache.lengths.at[idx].set(0),
-                    self.cache.page_table.at[idx].set(0),
+                    self.cache.k, self.cache.v, lengths, page_table
                 )
             else:
                 self.cache = SlotCache(
                     self.cache.k, self.cache.v,
-                    self.cache.lengths.at[idx].set(0),
+                    _mask_zero(self.cache.lengths, mask),
                 )
 
     def step(self) -> bool:

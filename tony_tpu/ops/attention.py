@@ -809,10 +809,8 @@ def remat_block(block_fn, remat: bool, policy: str = "full"):
         # TONY_REMAT_EXTRA_NAMES ("a,b") appends further named activations
         # (e.g. moe_disp / moe_combine) — the measurement ladder's knob for
         # per-shape save-vs-replay tradeoffs without code edits.
-        import os as _os
-
         names = ["flash_o", "flash_lse", "moe_route", "moe_gemm"]
-        extra = _os.environ.get("TONY_REMAT_EXTRA_NAMES", "")
+        extra = os.environ.get("TONY_REMAT_EXTRA_NAMES", "")
         names += [n.strip() for n in extra.split(",") if n.strip()]
         return jax.checkpoint(
             block_fn,
