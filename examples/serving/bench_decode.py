@@ -50,6 +50,15 @@ def main() -> int:
                         "attn=ragged the other slots' tokens/s should barely "
                         "move (per-slot cache reads); with bucketed the long "
                         "slot drags every slot to the max bucket")
+    p.add_argument("--mixed-lengths", default="",
+                   help="comma list of prompt lengths, e.g. 96,224,480,992: "
+                        "requests cycle through them (--prompt-len ignored). "
+                        "The capacity workload: a paged pool sized well below "
+                        "slots*max_len serves short requests in slots a dense "
+                        "cache would hold whole-max_len slabs for")
+    p.add_argument("--requests", type=int, default=0,
+                   help="total requests to drain (0 = --slots). >slots "
+                        "exercises continuous admission through retirements")
     p.add_argument("--passes", type=int, default=1,
                    help=">1: run the whole workload N times through one "
                         "engine and time only the LAST pass. Pass 1 compiles "
@@ -128,8 +137,10 @@ def main() -> int:
                   file=sys.stderr)
         shared = rng.integers(0, cfg.vocab_size, n_shared).tolist()
 
+    mixed = [int(x) for x in args.mixed_lengths.split(",") if x.strip()]
+
     def submit_workload():
-        n_short = args.slots
+        n_short = args.requests if args.requests > 0 else args.slots
         if args.long_slot:
             # one near-max-length resident request; its decode budget
             # outlasts the short requests so it stays active throughout
@@ -137,8 +148,9 @@ def main() -> int:
             eng.submit(rng.integers(0, cfg.vocab_size, long_prompt_len).tolist(),
                        max_new_tokens=args.new_tokens)
             n_short -= 1
-        for _ in range(n_short):
-            tail = max(args.prompt_len - len(shared), 1)
+        for i in range(n_short):
+            plen = mixed[i % len(mixed)] if mixed else args.prompt_len
+            tail = max(plen - len(shared), 1)
             prompt = shared + rng.integers(0, cfg.vocab_size, tail).tolist()
             eng.submit(prompt, max_new_tokens=args.new_tokens)
 

@@ -438,3 +438,42 @@ class TestCancel:
         assert eng.cancel(rB) is True
         assert eng.allocator.available() == avail0  # pins released
         assert rA in eng.done
+
+
+class TestHostLoopCompileStability:
+    """The r5 root-cause: host-loop cache/token updates whose eager shapes
+    varied per retirement/admission pattern re-compiled a tiny executable
+    per distinct pattern (>1 s each through a remote-compile tunnel,
+    BASELINE.md r5). The fixed-shape helpers must compile ONCE no matter
+    how retirement patterns vary."""
+
+    @pytest.mark.parametrize("kv", ["dense", "paged"])
+    def test_helpers_compile_once_across_varying_patterns(self, kv):
+        from tony_tpu.models import serving as S
+
+        params = _params()
+        eng = ContinuousBatcher(
+            params, CFG, num_slots=4, max_len=64, kv=kv, page_len=16,
+        )
+        set0 = S._set_slot_token._cache_size()
+        mask0 = (S._mask_zero_paged if kv == "paged" else S._mask_zero)._cache_size()
+        # three waves with DIFFERENT lengths and counts → different
+        # retirement patterns (1, then 3, then 2 slots retiring together)
+        for wave in ([4], [3, 5, 6], [7, 4]):
+            for j, n in enumerate(wave):
+                eng.submit(list(np.asarray(_prompt(n, seed=n + j)[0])),
+                           max_new_tokens=2 + j)
+            while eng.step():
+                pass
+        helper = S._mask_zero_paged if kv == "paged" else S._mask_zero
+        # <= 1: the jit caches are module-level, so an earlier test (or the
+        # other kv parametrization) may have compiled the same shapes
+        # already; the bug this guards against adds one entry PER pattern
+        assert S._set_slot_token._cache_size() - set0 <= 1, (
+            "per-admission token write re-traced: the slot index leaked in "
+            "as a constant again"
+        )
+        assert helper._cache_size() - mask0 <= 1, (
+            "retirement flush re-traced across patterns: the update shape "
+            "is no longer fixed at [S]"
+        )
