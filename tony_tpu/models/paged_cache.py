@@ -206,29 +206,45 @@ def gather_prefix_into_staging(
     return staging._replace(k=sk, v=sv, length=jnp.int32(n * page_len))
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("n",))
+@functools.partial(jax.jit, donate_argnums=(0,))
 def insert_paged_prefill(
     cache: PagedCache,
     sk: jax.Array, sv: jax.Array,        # staging [L, 1, Hkv, maxT, Dh]
-    fresh_pages: jax.Array,              # [n] physical pages for logical j0..j0+n
+    fresh_pages: jax.Array,              # [max_pages] physical pages, first n live
     pt_row: jax.Array,                   # [max_pages] the slot's full page table row
     slot: jax.Array, true_len: jax.Array,
     j0: jax.Array,                       # [] int32 — first NON-shared logical page
-    n: int = 0,
+    n: jax.Array | int = 0,              # [] int32 — pages to copy (dynamic)
 ):
     """Admission commit: copy the slot's NON-shared prefill span (logical
     pages j0..j0+n) from staging into its fresh physical pages, and install
     the page-table row + length. Shared prefix pages (j < j0) are already
-    resident — installing the row is all it takes to attach them."""
+    resident — installing the row is all it takes to attach them.
+
+    The copy is a dynamic-trip fori_loop of per-page dynamic_update_slice
+    ops: the staging slice [L, 1, Hkv, page_len, Dh] is axis-for-axis the
+    pool's per-page layout, so each dus aliases the DONATED pool in place
+    with no transpose, and the traced trip count + fixed-width
+    ``fresh_pages`` mean ONE compiled variant covers every page-count
+    class. The previous one-shot index-array scatter
+    (`.at[:, fresh_pages].set(span)`) materialized a pool-sized copy per
+    admission — the entirety of the paged engine's admission-side deficit
+    vs dense (BASELINE.md r5)."""
     L, _, Hkv, page_len, Dh = cache.k.shape
-    span_k = jax.lax.dynamic_slice(
-        sk, (0, 0, 0, j0 * page_len, 0), (L, 1, Hkv, n * page_len, Dh)
-    )[:, 0].reshape(L, Hkv, n, page_len, Dh).transpose(0, 2, 1, 3, 4)
-    span_v = jax.lax.dynamic_slice(
-        sv, (0, 0, 0, j0 * page_len, 0), (L, 1, Hkv, n * page_len, Dh)
-    )[:, 0].reshape(L, Hkv, n, page_len, Dh).transpose(0, 2, 1, 3, 4)
-    k = cache.k.at[:, fresh_pages].set(span_k)
-    v = cache.v.at[:, fresh_pages].set(span_v)
+
+    def body(j, kv):
+        k, v = kv
+        pk = jax.lax.dynamic_slice(
+            sk, (0, 0, 0, (j0 + j) * page_len, 0), (L, 1, Hkv, page_len, Dh)
+        )
+        pv = jax.lax.dynamic_slice(
+            sv, (0, 0, 0, (j0 + j) * page_len, 0), (L, 1, Hkv, page_len, Dh)
+        )
+        k = jax.lax.dynamic_update_slice(k, pk, (0, fresh_pages[j], 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(v, pv, (0, fresh_pages[j], 0, 0, 0))
+        return k, v
+
+    k, v = jax.lax.fori_loop(0, n, body, (cache.k, cache.v))
     return PagedCache(
         k=k, v=v,
         lengths=cache.lengths.at[slot].set(true_len),

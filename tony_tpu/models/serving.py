@@ -859,10 +859,15 @@ class ContinuousBatcher:
         nc = n_prefill - len(matched)                    # pages to COPY from staging
         pt_row = np.zeros(self.max_pages, np.int32)
         pt_row[:n_covered] = row
+        # fresh-page list padded to a FIXED [max_pages] width + traced copy
+        # count: one compiled insert variant covers every page-count class
+        # (a [nc]-shaped arg would re-compile per distinct nc)
+        fp = np.zeros(self.max_pages, np.int32)
+        fp[:nc] = fresh[:nc]
         self.cache = insert_paged_prefill(
-            self.cache, pre.k, pre.v,
-            jnp.asarray(fresh[:nc], jnp.int32), jnp.asarray(pt_row),
-            jnp.int32(slot), jnp.int32(Tp), jnp.int32(len(matched)), n=nc,
+            self.cache, pre.k, pre.v, fp, pt_row,
+            jnp.int32(slot), jnp.int32(Tp), jnp.int32(len(matched)),
+            n=jnp.int32(nc),
         )
         # content-address the request's FULL prompt pages so later
         # same-prefix requests reuse them (first writer wins)
