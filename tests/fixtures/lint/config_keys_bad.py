@@ -1,0 +1,11 @@
+"""config-keys fixture: two undeclared keys, one suppressed."""
+
+
+def read(cfg):
+    a = cfg.get("tony.app.name")                # declared: ok
+    b = cfg.get("tony.app.nmae")                # undeclared (typo): finding
+    c = cfg.get("tony.family.anything.goes")    # prefix family: ok
+    d = cfg.get("tony.missing.key")             # undeclared: finding
+    e = cfg.get("tony.app.nmae")  # lint: disable=config-keys — fixture for suppression
+    msg = f"set tony.app.name={a} first"        # f-string part, not key-shaped: ok
+    return a, b, c, d, e, msg

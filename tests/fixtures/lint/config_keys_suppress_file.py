@@ -1,0 +1,5 @@
+# lint: disable-file=config-keys — whole-file grandfather fixture
+
+
+def read(cfg):
+    return cfg.get("tony.totally.unknown")

@@ -1,0 +1,39 @@
+"""donation-safety fixture: donated buffers reused after the call."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, x):
+    return state + x
+
+
+def _impl(cache, tok):
+    return cache
+
+
+cached_step = functools.partial(jax.jit, donate_argnums=(0,))(_impl)
+jit_applied = jax.jit(_impl, donate_argnums=(0,))
+
+
+def bad_reuse(state, x):
+    new = step(state, x)
+    return state + new                      # line 23: finding (state donated)
+
+
+def bad_kw(state, x):
+    new = step(x=x, state=state)            # donated position passed by kw
+    return state                            # line 28: finding
+
+
+def bad_applied(cache, tok):
+    out = jit_applied(cache, tok)
+    return cache.sum() + out                # line 33: finding
+
+
+class Engine:
+    def bad_attr(self, x):
+        out = cached_step(self.cache, x)
+        return self.cache.sum() + out       # line 39: finding

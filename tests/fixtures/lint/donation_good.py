@@ -1,0 +1,46 @@
+"""donation-safety fixture: the approved rebind idioms and suppression."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, x):
+    return state + x
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def pair_step(cache, x):
+    return x, cache
+
+
+def good_rebind_inline(state, x):
+    state = step(state, x)
+    return state + 1
+
+
+def good_rebind_later(state, x):
+    new = step(state, x)
+    state = new
+    return state
+
+
+def good_last_use(state, x):
+    return step(state, x)
+
+
+class Engine:
+    def good_tuple_target(self, x):
+        out, self.cache = pair_step(self.cache, x)
+        return out
+
+    def good_prefix_rebind(self, x):
+        lengths = step(self.cache.lengths, x)
+        self.cache = type(self.cache)(self.cache.k, lengths)
+        return self.cache.lengths           # reads the REBOUND cache: ok
+
+
+def suppressed(state, x):
+    new = step(state, x)
+    return state + new  # lint: disable=donation-safety — CPU-backend test fixture

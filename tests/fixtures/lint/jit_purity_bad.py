@@ -1,0 +1,48 @@
+"""jit-purity fixture: one finding per side-effect class."""
+
+import functools
+import time
+
+import jax
+
+_ACC = []
+
+
+@jax.jit
+def bad_print(x):
+    print("tracing", x)                     # line 13: finding
+    return x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def bad_time(x, n):
+    t = time.time()                         # line 19: finding
+    return x * t
+
+
+@jax.jit
+def bad_mutate_closure(x):
+    _ACC.append(x)                          # line 25: finding
+    return x
+
+
+@jax.jit
+def bad_global(x):
+    global _COUNT                           # line 31: finding
+    _COUNT = 1
+    return x
+
+
+class Engine:
+    @jax.jit
+    def bad_self(self, x):
+        self.cache = x                      # line 39: finding
+        return x
+
+
+def make_step():
+    def inner(x):
+        print(x)                            # line 45: finding (jitted below)
+        return x
+
+    return jax.jit(inner)

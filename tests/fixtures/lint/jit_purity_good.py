@@ -1,0 +1,42 @@
+"""jit-purity fixture: pure jitted code, benign look-alikes, suppression."""
+
+import functools
+import time
+
+import jax
+
+_OPT = object()
+
+
+def not_jitted(x):
+    print(x)                    # plain function: ok
+    return time.time() + x
+
+
+@jax.jit
+def pure(x):
+    parts = []
+    parts.append(x)             # local container: ok
+    key = jax.random.PRNGKey(0)  # jax.random, not stdlib random: ok
+    return parts[0] + jax.random.uniform(key)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def api_method_named_update(state):
+    updates, new_state = _OPT.update(state)   # result consumed: ok
+    return updates, new_state
+
+
+@jax.jit
+def nested_helper_class(x):
+    class _View:
+        def __init__(self, ref):
+            self.ref = ref      # the helper's own self: ok
+
+    return _View(x).ref
+
+
+@jax.jit
+def suppressed(x):
+    print("debug", x)  # lint: disable=jit-purity — trace-time debug fixture
+    return x

@@ -1,0 +1,6 @@
+"""Mini config-key registry for lint fixtures (a module named ``keys`` is a
+declaration site for the config-keys checker)."""
+
+APP_NAME = "tony.app.name"
+TASK_TIMEOUT = "tony.task.timeout-ms"
+FAMILY_PREFIX = "tony.family."

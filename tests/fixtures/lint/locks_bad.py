@@ -1,0 +1,35 @@
+"""lock-discipline fixture: cross-thread writes missing the lock."""
+
+import threading
+
+
+class Daemon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._status = ""
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            self._count += 1                # line 15: finding (thread side)
+            with self._lock:
+                self._status = "beat"       # locked: ok
+
+    def bump(self):
+        self._count = 0                     # line 20: finding (public side)
+
+    def set_status(self, s):
+        self._status = s                    # line 23: finding (thread also writes)
+
+
+class NoLock:
+    def __init__(self):
+        self._n = 0
+        self._thread = threading.Thread(target=self._tick)
+
+    def _tick(self):
+        self._n += 1                        # line 32: finding (no lock declared)
+
+    def reset(self):
+        self._n = 0                         # line 35: finding (no lock declared)

@@ -1,0 +1,46 @@
+"""lock-discipline fixture: locked writes, *_locked trust, single-thread
+state, RPC-handler resolution through a module-level method list."""
+
+import threading
+
+RPC_METHODS = ["handle_set"]
+
+
+class GoodDaemon:
+    def __init__(self, rpc):
+        self._lock = threading.Lock()
+        self._state = {}
+        self._beats = 0
+        rpc.register_object(self, RPC_METHODS)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._state["beat"] = True      # locked: ok
+            self._beats += 1                    # only _loop writes it: ok
+
+    def handle_set(self, k, v):
+        with self._lock:
+            self._apply_locked(k, v)
+
+    def _apply_locked(self, k, v):
+        self._state[k] = v                      # *_locked contract: trusted
+
+    def reset(self):
+        self._state = {}  # lint: disable=lock-discipline — called pre-thread-start only
+
+
+class SingleThread:
+    """Helper + loop on the SAME thread must not be flagged."""
+
+    def __init__(self):
+        self._seen = 0
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        while True:
+            self._step()
+
+    def _step(self):
+        self._seen += 1                         # same thread as _loop: ok

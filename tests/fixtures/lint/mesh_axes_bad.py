@@ -1,0 +1,46 @@
+"""mesh-axes fixture: undeclared literals; declared/threaded names pass.
+
+The ``AXIS_*`` constants make this module its own declaration site.
+"""
+
+import jax
+from jax import lax
+
+AXIS_ROW = "row"
+AXIS_COL = "col"
+
+
+def bad_psum(x):
+    return jax.lax.psum(x, "rows")              # line 14: finding (typo)
+
+
+def bad_kw(x):
+    return lax.all_gather(x, axis_name="diag")  # line 18: finding
+
+
+def bad_wrapper(x, fn):
+    return fn(x, axis_name="bogus")             # line 22: finding (any call)
+
+
+def bad_default(x, axis_name="colz"):           # line 25: finding (param default)
+    return lax.pmean(x, axis_name)
+
+
+def good_declared(x):
+    return lax.psum(x, AXIS_ROW) + jax.lax.pmean(x, "col")
+
+
+def good_threaded(x, axis_name):
+    return lax.all_gather(x, axis_name)
+
+
+def good_tuple(x):
+    return lax.pmean(x, ("row", "col"))
+
+
+def suppressed(x):
+    return lax.psum(x, "legacy")  # lint: disable=mesh-axes — external-mesh fixture
+
+
+def bad_axis_index(x):
+    return x[jax.lax.axis_index("rowz")]        # line 46: finding (slot 0)

@@ -1,0 +1,263 @@
+"""Checker-level tests for the static-analysis suite (tony_tpu/analysis/).
+
+Each checker gets fixture-backed true-positive assertions (exact finding
+counts + line numbers) and false-positive/suppression coverage, plus the
+``tony lint`` CLI exit-code and JSON contract external CI relies on.
+"""
+
+import json
+import os
+
+import pytest
+
+from tony_tpu.analysis.analyzer import Analyzer, all_checkers
+from tony_tpu.cli import lint as lint_cli
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures", "lint")
+
+
+def run_lint(*files, checks=None):
+    checkers = all_checkers()
+    if checks:
+        checkers = [c for c in checkers if c.name in checks]
+    analyzer = Analyzer(checkers, root=FIXTURES)
+    return analyzer.run([os.path.join(FIXTURES, f) for f in files])
+
+
+def lines_of(findings, checker):
+    return [f.line for f in findings if f.checker == checker]
+
+
+# ---------------------------------------------------------------- config-keys
+def test_config_keys_flags_undeclared_literals():
+    findings = run_lint("keys.py", "config_keys_bad.py", checks={"config-keys"})
+    assert lines_of(findings, "config-keys") == [6, 8]
+    typo = findings[0]
+    assert "tony.app.nmae" in typo.message
+    assert "did you mean 'tony.app.name'" in typo.message  # typo hint
+
+
+def test_config_keys_prefix_families_and_suppression():
+    findings = run_lint("keys.py", "config_keys_bad.py", checks={"config-keys"})
+    # line 7 (declared prefix family) and line 9 (suppressed) are absent
+    assert 7 not in lines_of(findings, "config-keys")
+    assert 9 not in lines_of(findings, "config-keys")
+
+
+def test_config_keys_file_level_suppression():
+    findings = run_lint(
+        "keys.py", "config_keys_suppress_file.py", checks={"config-keys"}
+    )
+    assert findings == []
+
+
+def test_config_keys_skips_without_registry():
+    # no keys.py in scope → nothing to validate against, no noise
+    findings = run_lint("config_keys_bad.py", checks={"config-keys"})
+    assert findings == []
+
+
+# ----------------------------------------------------------------- jit-purity
+def test_jit_purity_true_positives():
+    findings = run_lint("jit_purity_bad.py", checks={"jit-purity"})
+    assert lines_of(findings, "jit-purity") == [13, 19, 25, 31, 39, 45]
+    messages = " | ".join(f.message for f in findings)
+    for needle in ("print()", "time.time()", ".append()", "global", "self.*"):
+        assert needle in messages
+
+
+def test_jit_purity_clean_and_suppressed():
+    findings = run_lint("jit_purity_good.py", checks={"jit-purity"})
+    assert findings == []
+
+
+# ------------------------------------------------------------ donation-safety
+def test_donation_true_positives():
+    findings = run_lint("donation_bad.py", checks={"donation-safety"})
+    assert lines_of(findings, "donation-safety") == [23, 28, 33, 39]
+    # keyword-passed donated arg and self-attribute donors are both tracked
+    assert "'state'" in findings[1].message
+    assert "'self.cache'" in findings[3].message
+
+
+def test_donation_rebind_idioms_are_clean():
+    findings = run_lint("donation_good.py", checks={"donation-safety"})
+    assert findings == []
+
+
+# ------------------------------------------------------------ lock-discipline
+def test_lock_discipline_true_positives():
+    findings = run_lint("locks_bad.py", checks={"lock-discipline"})
+    assert lines_of(findings, "lock-discipline") == [15, 20, 23, 32, 35]
+    assert "hold one of: self._lock" in findings[0].message
+    assert "declare a threading.Lock" in findings[3].message
+
+
+def test_lock_discipline_clean_patterns():
+    # locked writes, *_locked helper trust, single-thread helper chains,
+    # RPC method-list resolution, per-line suppression
+    findings = run_lint("locks_good.py", checks={"lock-discipline"})
+    assert findings == []
+
+
+# ---------------------------------------------------------------- mesh-axes
+def test_mesh_axes_true_positives():
+    findings = run_lint("mesh_axes_bad.py", checks={"mesh-axes"})
+    # axis_index takes its axis at positional slot 0, the rest at slot 1
+    assert lines_of(findings, "mesh-axes") == [14, 18, 22, 25, 46]
+    assert "'rows'" in findings[0].message
+    assert "declared: col, row" in findings[0].message
+    assert "'rowz'" in findings[-1].message
+
+
+def test_mesh_axes_declared_and_threaded_are_clean():
+    findings = run_lint("mesh_axes_bad.py", checks={"mesh-axes"})
+    flagged = {f.line for f in findings}
+    # good_declared / good_threaded / good_tuple / suppressed bodies
+    assert not flagged & {30, 34, 38, 42}
+
+
+def test_mesh_axes_real_registry_covers_canonical_axes():
+    from tony_tpu.analysis.mesh_axes import MeshAxisChecker
+    from tony_tpu.analysis.analyzer import load_module
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    checker = MeshAxisChecker()
+    checker.collect(load_module(os.path.join(repo, "tony_tpu", "parallel", "mesh.py")))
+    assert checker.declared == {"data", "fsdp", "model", "context", "expert", "stage"}
+
+
+# -------------------------------------------------------------- CLI contract
+def test_cli_exit_0_clean_json(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    rc = lint_cli.main([str(clean), "--format", "json", "--no-baseline"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == lint_cli.EXIT_CLEAN == 0
+    assert out["findings"] == []
+    assert out["summary"]["total"] == 0
+
+
+def test_cli_exit_1_findings_json(capsys):
+    rc = lint_cli.main([
+        os.path.join(FIXTURES, "mesh_axes_bad.py"),
+        "--format", "json", "--no-baseline", "--checks", "mesh-axes",
+    ])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == lint_cli.EXIT_FINDINGS == 1
+    assert out["summary"]["total"] == 5
+    assert out["summary"]["by_checker"] == {"mesh-axes": 5}
+    f = out["findings"][0]
+    assert set(f) >= {"checker", "path", "line", "col", "message", "severity", "fingerprint"}
+
+
+def test_cli_exit_2_internal_error(capsys):
+    rc = lint_cli.main(["/nonexistent/path/nowhere", "--format", "json"])
+    assert rc == lint_cli.EXIT_INTERNAL_ERROR == 2
+    assert json.loads(capsys.readouterr().out or "{}") == {}  # nothing on stdout
+
+
+def test_cli_unknown_checker_is_internal_error(capsys):
+    rc = lint_cli.main([FIXTURES, "--checks", "no-such-checker"])
+    assert rc == 2
+
+
+def test_cli_baseline_workflow(tmp_path, capsys):
+    """--update-baseline grandfathers findings; new findings still fail."""
+    baseline = tmp_path / "baseline.json"
+    target = os.path.join(FIXTURES, "mesh_axes_bad.py")
+    args = [target, "--baseline", str(baseline)]
+    assert lint_cli.main(args + ["--update-baseline"]) == 0
+    capsys.readouterr()
+    # everything grandfathered → clean (also through a checker-subset run)
+    rc = lint_cli.main(args + ["--checks", "mesh-axes", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["summary"] == {"total": 0, "grandfathered": 5, "by_checker": {}}
+    # --no-baseline resurfaces them
+    assert lint_cli.main(args + ["--no-baseline"]) == 1
+    capsys.readouterr()
+    # a checker-subset run must not rewrite the baseline (it would drop
+    # the other checkers' grandfathered entries)
+    assert lint_cli.main(args + ["--checks", "mesh-axes", "--update-baseline"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_registered_in_tony_main(capsys):
+    from tony_tpu.cli.main import main as tony_main
+
+    rc = tony_main(["lint", "--list-checks"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for name in (
+        "config-keys", "jit-purity", "donation-safety",
+        "lock-discipline", "mesh-axes",
+    ):
+        assert name in out
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    analyzer = Analyzer(all_checkers(), root=str(tmp_path))
+    findings = analyzer.run([str(broken)])
+    assert len(findings) == 1 and findings[0].checker == "parse"
+
+
+def test_undecodable_file_is_a_finding_not_an_abort(tmp_path):
+    """One broken file must not swallow the other files' findings."""
+    (tmp_path / "bad_bytes.py").write_bytes(b"x = '\xff\xfe'\n")
+    (tmp_path / "keys.py").write_text('K = "tony.app.name"\n')
+    (tmp_path / "mod.py").write_text('V = "tony.nope.key"\n')
+    findings = Analyzer(all_checkers(), root=str(tmp_path)).run([str(tmp_path)])
+    assert {f.checker for f in findings} == {"parse", "config-keys"}
+
+
+def test_coding_cookie_files_are_readable(tmp_path):
+    src = "# -*- coding: latin-1 -*-\n# caf\xe9\nV = 1\n"
+    (tmp_path / "latin.py").write_bytes(src.encode("latin-1"))
+    findings = Analyzer(all_checkers(), root=str(tmp_path)).run([str(tmp_path)])
+    assert findings == []
+
+
+def test_donation_local_plain_def_shadows_foreign_donor(tmp_path):
+    """A module's own non-donating `update` must not be treated as the
+    donor another module registered under the same name."""
+    (tmp_path / "a.py").write_text(
+        "import functools, jax\n"
+        "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+        "def update(s, x):\n    return s + x\n"
+    )
+    (tmp_path / "b.py").write_text(
+        "def update(s, x):\n    return s\n"
+        "def caller(s, x):\n"
+        "    out = update(s, x)\n"
+        "    return s + out\n"
+    )
+    findings = Analyzer(all_checkers(), root=str(tmp_path)).run([str(tmp_path)])
+    assert [f for f in findings if f.checker == "donation-safety"] == []
+
+
+def test_fingerprints_are_line_stable(tmp_path):
+    """Shifting a finding down the file must not change its fingerprint
+    (the property the baseline workflow depends on)."""
+    src = (
+        "import functools, jax\n"
+        'K = "tony.nope.key"\n'  # config-keys finding
+        "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+        "def step(s, x):\n"
+        "    return s + x\n"
+        "def reuse(s, x):\n"
+        "    out = step(s, x)\n"
+        "    return s + out\n"  # donation finding
+    )
+    keys = 'K = "tony.app.name"\n'
+    (tmp_path / "keys.py").write_text(keys)
+    a = tmp_path / "mod.py"
+    a.write_text(src)
+    f1 = Analyzer(all_checkers(), root=str(tmp_path)).run([str(tmp_path)])
+    a.write_text("# a new leading comment\n\n" + src)
+    f2 = Analyzer(all_checkers(), root=str(tmp_path)).run([str(tmp_path)])
+    assert {x.checker for x in f1} == {"config-keys", "donation-safety"}
+    assert [x.fingerprint() for x in f1] == [x.fingerprint() for x in f2]
+    assert [x.line for x in f1] != [x.line for x in f2]

@@ -1,0 +1,32 @@
+"""Tier-1 gate: ``tony lint tony_tpu/`` must stay clean.
+
+The suite's value is the CI ratchet — a PR that introduces an undeclared
+config key, a side effect in traced code, donated-buffer reuse, an unlocked
+cross-thread write, or a typo'd mesh axis fails here, with the same output
+``tony lint`` prints locally. Deliberate exceptions carry an inline
+``# lint: disable=<checker> — <why>`` comment, never a silent baseline entry
+(the checked-in baseline stays empty; see docs/static-analysis.md).
+"""
+
+import json
+import os
+
+from tony_tpu.cli.lint import default_baseline_path, main as lint_main, repo_root
+
+
+def test_tony_tpu_lints_clean(capsys):
+    rc = lint_main([os.path.join(repo_root(), "tony_tpu"), "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"tony lint found regressions in tony_tpu/:\n{out}"
+
+
+def test_checked_in_baseline_is_empty():
+    path = default_baseline_path()
+    assert os.path.exists(path), "the .lint-baseline.json ratchet file is gone"
+    with open(path) as f:
+        data = json.load(f)
+    assert data["findings"] == [], (
+        "baseline grew — grandfathering real findings is reserved for "
+        "generated/vendored code; fix the finding or suppress it inline "
+        "with a justification"
+    )

@@ -35,6 +35,7 @@ class TestLlama:
         actual = sum(p.size for p in jax.tree.leaves(params))
         assert actual == self.cfg.num_params()
 
+    @pytest.mark.slow  # ~17 s flash-kernel remat grad comparison
     def test_flash_remat_policy_grads_match_full(self):
         # remat_policy="flash" pins the named flash-kernel outputs; grads must
         # equal plain full remat (kernels run via the Pallas interpreter on CPU)
@@ -423,6 +424,7 @@ class TestSequencePacking:
         assert int(m_p["tokens"]) == 63
         np.testing.assert_allclose(packed_mass, want_mass, rtol=5e-3)
 
+    @pytest.mark.slow  # ~21 s packed flash-kernel parity
     def test_packed_flash_matches_reference_impl(self):
         import dataclasses as dc
 

@@ -143,6 +143,7 @@ class TestPageAllocator:
 # Engine: parity, sharing, capacity
 # ---------------------------------------------------------------------------
 class TestPagedEngine:
+    @pytest.mark.slow  # ~10 s full-engine decode parity sweep
     def test_greedy_parity_with_dense_engine(self):
         params = _params()
         dense = ContinuousBatcher(params, LLAMA_TINY, num_slots=3, max_len=128,
@@ -156,6 +157,7 @@ class TestPagedEngine:
         for a, b in zip(rd, rp):
             assert outd[a] == outp[b]
 
+    @pytest.mark.slow  # ~8 s full-engine prefix-cache burst
     def test_shared_prefix_burst_prefills_once(self):
         """VERDICT done-when (a): N same-prefix slots ~1 prefill cost."""
         params = _params()
@@ -206,6 +208,7 @@ class TestPagedEngine:
         with pytest.raises(ValueError, match="pages"):
             paged.submit(list(range(1, 100)), max_new_tokens=20)
 
+    @pytest.mark.slow  # ~10 s int8 engine parity sweep
     def test_int8_paged_matches_dense(self):
         """Composition: int8 weight-only trees decode through the paged
         cache identically to the dense engine (the cache stays bf16; only
@@ -222,6 +225,7 @@ class TestPagedEngine:
         b = paged.submit([3, 4, 5], max_new_tokens=6)
         assert dense.run()[a] == paged.run()[b]
 
+    @pytest.mark.slow  # ~10 s mixtral engine parity sweep
     def test_mixtral_paged_matches_dense(self):
         """Composition: the MoE decode FFN (all-expert + top-k combine)
         runs through the paged cache identically to dense."""
@@ -242,6 +246,7 @@ class TestPagedEngine:
         b = paged.submit([5, 6, 7, 8], max_new_tokens=6)
         assert dense.run()[a] == paged.run()[b]
 
+    @pytest.mark.slow  # ~9 s SWA engine parity sweep
     def test_swa_window_smaller_than_chunk_matches_dense(self):
         """The staged fold's out-of-window mask only fires when the sliding
         window is SMALLER than the decode chunk (staged positions can fall
@@ -259,6 +264,7 @@ class TestPagedEngine:
         b = paged.submit(prompt, max_new_tokens=12)
         assert dense.run()[a] == paged.run()[b]
 
+    @pytest.mark.slow  # ~8 s SWA engine parity sweep
     def test_swa_paged_matches_dense(self):
         import dataclasses
 

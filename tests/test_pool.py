@@ -315,6 +315,7 @@ class TestPoolE2E:
             with open(os.path.join(handle.staging_dir, f"node_of_worker_{i}.txt")) as f:
                 assert f.read() == "nodeB"
 
+    @pytest.mark.slow  # ~3 min multi-process e2e: node kill + downsize-grace waits
     def test_node_death_gang_downsizes_and_resumes(self, tmp_tony_root, pool_with_agents, tmp_path):
         """The full elastic loop (VERDICT r4 #1): a 2-worker training gang
         loses one node FOR GOOD; the configured gang (2×3g) no longer fits

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tony_tpu.ops import quant
 
@@ -110,6 +111,7 @@ class TestQuantizeTree:
         assert not isinstance(qtree["layers"]["attn_norm"]["w"], quant.QTensor)
         assert isinstance(qtree["layers"]["wq"], quant.QTensor)
 
+    @pytest.mark.slow  # ~14 s layer-stacked quant roundtrip
     def test_stacked_dequant_roundtrip(self):
         w = jax.random.normal(jax.random.PRNGKey(10), (3, 32, 16), jnp.float32)
         qt = quant.quantize_int8(w)

@@ -16,6 +16,7 @@ import pytest
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from tony_tpu.compat import shard_map
 from tony_tpu.ops.attention import attention_reference, repeat_kv
 from tony_tpu.parallel.context import ring_attention
 
@@ -37,7 +38,7 @@ def _mk_qkv(B=1, H=4, Hkv=2, T=256, D=64, seed=3):
 def _shard_ring(fn, mesh):
     spec = P(None, None, "context", None)
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             axis_names={"context"}, check_vma=False,
         )
@@ -134,7 +135,7 @@ def test_pallas_ring_backward():
             return jax.lax.psum((attn(q, k, v) * w).sum(), "context")
 
         spec = P(None, None, "context", None)
-        inner = jax.shard_map(
+        inner = shard_map(
             body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(),
             axis_names={"context"}, check_vma=False,
         )
@@ -201,6 +202,7 @@ jax.config.update("jax_num_cpu_devices", 16)
 import jax.numpy as jnp, numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.pallas import tpu as pltpu
+from tony_tpu.compat import shard_map
 from tony_tpu.ops.ring import ring_attention_pallas
 from tony_tpu.ops.attention import attention_reference, repeat_kv
 
@@ -220,7 +222,7 @@ def body(q, k, v):
     )
     return jax.lax.psum((out * w).sum(), "context")
 
-inner = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(),
+inner = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(),
                       axis_names={"context"}, check_vma=False)
 g_pallas = jax.jit(jax.grad(inner, argnums=(0, 1, 2)))(q, k, v)
 
@@ -280,7 +282,7 @@ def test_pallas_ring_backward_noncausal():
         )
         return jax.lax.psum((out * w).sum(), "context")
 
-    inner = jax.shard_map(
+    inner = shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(),
         axis_names={"context"}, check_vma=False,
     )
@@ -324,7 +326,7 @@ def test_pallas_ring_packed_matches_reference(n_dev):
 
     spec = P(None, None, "context", None)
     ring = jax.jit(
-        jax.shard_map(
+        shard_map(
             functools.partial(
                 ring_attention_pallas_seg, axis_name="context", causal=True,
                 interpret=_interpret_params(),
@@ -430,6 +432,7 @@ import functools
 import jax.numpy as jnp, numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.pallas import tpu as pltpu
+from tony_tpu.compat import shard_map
 from tony_tpu.ops.ring import ring_attention_pallas, ring_attention_pallas_seg
 from tony_tpu.ops.attention import attention_reference, repeat_kv
 
@@ -450,7 +453,7 @@ def body_seg(q, k, v, s):
     out = ring_attention_pallas_seg(q, k, v, s, axis_name="context", causal=True, interpret=ip)
     return jax.lax.psum((out * w).sum(), "context")
 
-inner = jax.shard_map(body_seg, mesh=mesh, in_specs=(spec, spec, spec, P(None, "context")),
+inner = shard_map(body_seg, mesh=mesh, in_specs=(spec, spec, spec, P(None, "context")),
                       out_specs=P(), axis_names={"context"}, check_vma=False)
 g_pallas = jax.jit(jax.grad(inner, argnums=(0, 1, 2)))(q, k, v, seg)
 g_ref = jax.grad(
@@ -469,7 +472,7 @@ def body_swa(q, k, v):
                                 interpret=ip, window=window)
     return jax.lax.psum((out * w).sum(), "context")
 
-inner2 = jax.shard_map(body_swa, mesh=mesh, in_specs=(spec, spec, spec),
+inner2 = shard_map(body_swa, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=P(), axis_names={"context"}, check_vma=False)
 g2 = jax.jit(jax.grad(inner2, argnums=(0, 1, 2)))(q, k, v)
 g2_ref = jax.grad(

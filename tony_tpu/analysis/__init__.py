@@ -1,0 +1,27 @@
+"""AST-based static-analysis suite (``tony lint``).
+
+The reference guards its config surface with a drift test
+(TestTonyConfigurationFields, SURVEY.md §2.1); this package generalizes that
+idea into checkers for the hazard classes the TPU-native rebuild actually
+added: config-key discipline, traced-code purity, donated-buffer reuse,
+cross-thread lock discipline, and mesh-axis naming. See
+docs/static-analysis.md for the checker catalogue and suppression syntax.
+"""
+
+from tony_tpu.analysis.analyzer import (
+    Analyzer,
+    Checker,
+    Finding,
+    Module,
+    Severity,
+    all_checkers,
+)
+
+__all__ = [
+    "Analyzer",
+    "Checker",
+    "Finding",
+    "Module",
+    "Severity",
+    "all_checkers",
+]

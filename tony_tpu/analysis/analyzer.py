@@ -1,0 +1,341 @@
+"""Core of the ``tony lint`` framework: findings, suppressions, the checker
+base class, the two-phase driver, and the text/JSON reporters.
+
+Checkers are pure AST walkers — linted code is never imported, so a broken
+(or side-effectful) module can be analyzed safely. The driver runs two
+phases over every module: ``collect`` builds cross-module registries
+(declared config keys, donating jit wrappers, mesh axes), then ``check``
+emits findings. Suppression comments:
+
+    x = do_thing()  # lint: disable=jit-purity        (this line, one checker)
+    y = other()     # lint: disable=all               (this line, all checkers)
+    # lint: disable-file=lock-discipline              (whole file, anywhere)
+
+Every suppression should carry a justification in the same comment; the
+baseline file (``.lint-baseline.json``) exists only for grandfathered
+findings that cannot carry an inline comment (generated code, vendored
+files) — see docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Iterable, Iterator
+
+
+class Severity(Enum):
+    WARNING = "warning"
+    ERROR = "error"
+
+
+# Shared vocabularies — single definitions so checkers cannot drift.
+#: spellings under which jax's tracing compiler is imported/applied
+JIT_NAMES = frozenset({
+    "jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit",
+    "jax.experimental.pjit.pjit",
+})
+#: spellings of functools.partial (used to curry jit with options)
+PARTIAL_NAMES = frozenset({"functools.partial", "partial"})
+#: container methods that mutate their receiver in place
+MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "clear", "update",
+    "setdefault", "popitem", "add", "discard", "sort", "reverse",
+})
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: where, which checker, what."""
+
+    checker: str
+    path: str          # repo-relative (or as-given) path for display
+    line: int          # 1-based
+    col: int           # 0-based, matching ast
+    message: str
+    severity: Severity = Severity.ERROR
+
+    def fingerprint(self) -> str:
+        """Line-insensitive identity for baselining: a finding keeps its
+        fingerprint when unrelated edits shift it up or down the file."""
+        raw = f"{self.path}::{self.checker}::{self.message}"
+        return hashlib.sha1(raw.encode("utf-8")).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "checker": self.checker,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "severity": self.severity.value,
+            "fingerprint": self.fingerprint(),
+        }
+
+
+_SUPPRESS_RE = re.compile(r"#\s*lint:\s*(disable|disable-file)\s*=\s*([\w,\- ]+)")
+
+
+@dataclass
+class Module:
+    """One parsed source file plus its suppression table."""
+
+    path: str                 # display path (repo-relative when possible)
+    abspath: str
+    source: str
+    tree: ast.Module
+    line_suppressions: dict[int, set[str]] = field(default_factory=dict)
+    file_suppressions: set[str] = field(default_factory=set)
+
+    @property
+    def name(self) -> str:
+        """Module stem, e.g. ``keys`` for ``tony_tpu/config/keys.py``."""
+        return os.path.splitext(os.path.basename(self.path))[0]
+
+    def suppressed(self, checker: str, line: int) -> bool:
+        if self.file_suppressions & {checker, "all"}:
+            return True
+        on_line = self.line_suppressions.get(line, set())
+        return bool(on_line & {checker, "all"})
+
+
+def _parse_suppressions(source: str) -> tuple[dict[int, set[str]], set[str]]:
+    per_line: dict[int, set[str]] = {}
+    per_file: set[str] = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            names = {n.strip() for n in m.group(2).split(",") if n.strip()}
+            if m.group(1) == "disable-file":
+                per_file |= names
+            else:
+                per_line.setdefault(tok.start[0], set()).update(names)
+    except tokenize.TokenError:
+        pass  # the ast parse will surface the real syntax problem
+    return per_line, per_file
+
+
+def load_module(abspath: str, display_path: str | None = None) -> Module:
+    with tokenize.open(abspath) as f:  # honors PEP 263 coding cookies
+        source = f.read()
+    tree = ast.parse(source, filename=abspath)
+    per_line, per_file = _parse_suppressions(source)
+    return Module(
+        path=display_path or abspath,
+        abspath=abspath,
+        source=source,
+        tree=tree,
+        line_suppressions=per_line,
+        file_suppressions=per_file,
+    )
+
+
+class Checker:
+    """Base class: subclass, set ``name``/``description``, implement
+    ``check``; override ``collect`` to build cross-module state first."""
+
+    name = "base"
+    description = ""
+
+    def collect(self, module: Module) -> None:  # phase 1, every module
+        pass
+
+    def check(self, module: Module) -> Iterable[Finding]:  # phase 2
+        raise NotImplementedError
+
+    # -- shared helpers -----------------------------------------------------
+    def finding(
+        self, module: Module, node: ast.AST, message: str,
+        severity: Severity = Severity.ERROR,
+    ) -> Finding:
+        return Finding(
+            checker=self.name,
+            path=module.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            severity=severity,
+        )
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``jax.lax.psum`` for an Attribute/Name chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_functions(tree: ast.AST) -> Iterator[ast.FunctionDef | ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def discover(paths: Iterable[str]) -> list[str]:
+    """Expand files/directories into a sorted list of .py file paths."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs if not d.startswith(".") and d != "__pycache__"
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".py"):
+            out.append(p)
+        else:
+            raise FileNotFoundError(f"not a python file or directory: {p}")
+    return out
+
+
+class Analyzer:
+    """Two-phase driver: collect registries over every module, then check."""
+
+    def __init__(self, checkers: list[Checker], root: str | None = None):
+        self.checkers = checkers
+        self.root = root or os.getcwd()
+
+    def _display(self, abspath: str) -> str:
+        try:
+            rel = os.path.relpath(abspath, self.root)
+        except ValueError:  # different drive (windows)
+            return abspath
+        return abspath if rel.startswith("..") else rel
+
+    def run(self, paths: Iterable[str]) -> list[Finding]:
+        modules: list[Module] = []
+        findings: list[Finding] = []
+        for abspath in discover(paths):
+            display = self._display(os.path.abspath(abspath))
+            try:
+                modules.append(load_module(os.path.abspath(abspath), display))
+            except SyntaxError as e:
+                findings.append(Finding(
+                    checker="parse", path=display,
+                    line=e.lineno or 1, col=(e.offset or 1) - 1,
+                    message=f"syntax error: {e.msg}",
+                ))
+            except (UnicodeDecodeError, ValueError) as e:
+                # undecodable bytes / NUL: a per-file finding, never a
+                # whole-run abort — the other files' findings must survive
+                findings.append(Finding(
+                    checker="parse", path=display, line=1, col=0,
+                    message=f"unreadable source: {e}",
+                ))
+        for checker in self.checkers:
+            for mod in modules:
+                checker.collect(mod)
+        for checker in self.checkers:
+            for mod in modules:
+                for f in checker.check(mod):
+                    if not mod.suppressed(checker.name, f.line):
+                        findings.append(f)
+        # dedup: a node can be reached through two walks (e.g. a jitted
+        # function nested inside another jitted function)
+        findings = list(dict.fromkeys(findings))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.checker))
+        return findings
+
+
+# --------------------------------------------------------------- baseline
+def load_baseline(path: str) -> set[str]:
+    """Fingerprints of grandfathered findings (empty set if no file)."""
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"] for e in data.get("findings", [])}
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    data = {
+        "comment": "grandfathered `tony lint` findings; prefer inline "
+                   "`# lint: disable=<checker>` with a justification",
+        "findings": [
+            {
+                "fingerprint": f.fingerprint(),
+                "checker": f.checker,
+                "path": f.path,
+                "message": f.message,
+            }
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+def apply_baseline(
+    findings: list[Finding], baseline: set[str]
+) -> tuple[list[Finding], int]:
+    """(new findings, grandfathered count)."""
+    fresh = [f for f in findings if f.fingerprint() not in baseline]
+    return fresh, len(findings) - len(fresh)
+
+
+# --------------------------------------------------------------- reporters
+def render_text(findings: list[Finding], grandfathered: int = 0) -> str:
+    lines = [
+        f"{f.path}:{f.line}:{f.col + 1}: [{f.checker}] {f.message}"
+        for f in findings
+    ]
+    summary = f"{len(findings)} finding{'s' if len(findings) != 1 else ''}"
+    if grandfathered:
+        summary += f" ({grandfathered} grandfathered by baseline)"
+    lines.append(summary)
+    return "\n".join(lines)
+
+def render_json(findings: list[Finding], grandfathered: int = 0) -> str:
+    return json.dumps(
+        {
+            "findings": [f.to_dict() for f in findings],
+            "summary": {
+                "total": len(findings),
+                "grandfathered": grandfathered,
+                "by_checker": _counts(findings),
+            },
+        },
+        indent=1,
+    )
+
+def _counts(findings: list[Finding]) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for f in findings:
+        out[f.checker] = out.get(f.checker, 0) + 1
+    return dict(sorted(out.items()))
+
+
+def all_checkers() -> list[Checker]:
+    """One fresh instance of every built-in checker (registries are
+    per-run state, so instances must not be shared between runs)."""
+    from tony_tpu.analysis.config_keys import ConfigKeyChecker
+    from tony_tpu.analysis.donation import DonationChecker
+    from tony_tpu.analysis.jit_purity import JitPurityChecker
+    from tony_tpu.analysis.locks import LockDisciplineChecker
+    from tony_tpu.analysis.mesh_axes import MeshAxisChecker
+
+    return [
+        ConfigKeyChecker(),
+        JitPurityChecker(),
+        DonationChecker(),
+        LockDisciplineChecker(),
+        MeshAxisChecker(),
+    ]

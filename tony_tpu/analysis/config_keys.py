@@ -1,0 +1,96 @@
+"""config-keys: every ``tony.*`` string literal must be a declared key.
+
+The runtime resolves unknown keys to their default silently
+(``TonyConfig.get`` → DEFAULTS → ""), so a typo'd key is a latent
+misconfiguration, not an error. The reference guards this with
+TestTonyConfigurationFields (SURVEY.md §2.1); this checker closes the same
+gap at lint time: any string literal shaped like a config key
+(``tony.<segment>.<segment>...``) appearing outside the declaration module
+must be declared in ``tony_tpu/config/keys.py`` — either as a fixed key, or
+covered by a declared ``*_PREFIX`` key family.
+
+Declaration sites are modules named ``keys`` (phase 1 collects every
+module-level ``UPPER_NAME = "tony...."`` assignment; names ending in
+``_PREFIX`` declare parameterized families matched by prefix). Dynamically
+built keys (``keys.jobtype_key(...)``, f-strings) never form a full-match
+literal and are out of scope by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tony_tpu.analysis.analyzer import Checker, Finding, Module
+
+#: a whole literal that looks like a config key: dotted, lowercase segments
+_KEY_SHAPED = re.compile(r"^tony\.[a-z0-9][a-z0-9_-]*(\.[a-z0-9][a-z0-9_.-]*)+$")
+
+
+class ConfigKeyChecker(Checker):
+    name = "config-keys"
+    description = (
+        'every "tony.*" key literal is declared in config/keys.py '
+        "(catches typos the runtime silently defaults)"
+    )
+
+    def __init__(self) -> None:
+        self.declared: set[str] = set()
+        self.prefixes: set[str] = set()
+        self._declaration_modules: set[str] = set()
+
+    # ------------------------------------------------------------- phase 1
+    def collect(self, module: Module) -> None:
+        if module.name != "keys":
+            return
+        self._declaration_modules.add(module.abspath)
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (
+                isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+                and node.value.value.startswith("tony.")
+            ):
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id.isupper():
+                    if target.id.endswith("_PREFIX"):
+                        self.prefixes.add(node.value.value)
+                    else:
+                        self.declared.add(node.value.value)
+
+    # ------------------------------------------------------------- phase 2
+    def check(self, module: Module) -> Iterable[Finding]:
+        if module.abspath in self._declaration_modules:
+            return
+        if not self.declared and not self.prefixes:
+            return  # no registry in scope: nothing to validate against
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
+                continue
+            value = node.value
+            if not _KEY_SHAPED.match(value):
+                continue
+            if value in self.declared:
+                continue
+            if any(value.startswith(p) for p in self.prefixes):
+                continue
+            hint = _closest(value, self.declared)
+            yield self.finding(
+                module, node,
+                f"undeclared config key {value!r}"
+                + (f" (did you mean {hint!r}?)" if hint else "")
+                + " — declare it in tony_tpu/config/keys.py",
+            )
+
+
+def _closest(value: str, declared: set[str]) -> str | None:
+    """Typo hint: the most similar declared key at difflib ratio >= 0.85.
+    Runs once per undeclared-key finding, against short dotted keys, so
+    SequenceMatcher's cost is irrelevant here."""
+    import difflib
+
+    matches = difflib.get_close_matches(value, sorted(declared), n=1, cutoff=0.85)
+    return matches[0] if matches else None

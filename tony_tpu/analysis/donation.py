@@ -1,0 +1,279 @@
+"""donation-safety: a buffer passed at a ``donate_argnums`` position must
+not be used again.
+
+Donation lets XLA alias the argument's memory for an output — after the
+call, the donor may hold garbage (on TPU the runtime *sometimes* errors,
+sometimes silently reuses). The safe idiom rebinds the donor from the
+call's result in the same statement::
+
+    toks, seq, self.cache = decode_steps(params, self.cache, ...)   # ok
+    new = decode_steps(params, self.cache, ...)
+    log(self.cache.lengths)                                         # FLAGGED
+
+Phase 1 builds a registry of donating callables from every module:
+``@functools.partial(jax.jit, donate_argnums=...)`` decorators, plus the
+application forms ``f = jax.jit(g, donate_argnums=...)`` and
+``f = functools.partial(jax.jit, donate_argnums=...)(g)``. Phase 2 walks
+each function scope linearly: a donated argument that is *read* after the
+donating call — before being rebound — is flagged. The scan is lexical
+(source order within the scope, nested defs skipped), so loop-carried reuse
+is out of scope; tests pin the supported shapes.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterable
+
+from tony_tpu.analysis.analyzer import (
+    JIT_NAMES as _JIT_NAMES,
+    PARTIAL_NAMES as _PARTIAL_NAMES,
+    Checker,
+    Finding,
+    Module,
+    dotted_name,
+)
+
+
+@dataclass(frozen=True)
+class _Donor:
+    positions: tuple[int, ...]
+    params: tuple[str, ...]  # positional param names of the wrapped fn ("" unknown)
+
+
+def _donate_positions(call: ast.Call) -> tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                        out.append(el.value)
+                return tuple(out)
+    return ()
+
+
+def _jit_call_donations(node: ast.AST) -> tuple[int, ...]:
+    """donate_argnums carried by a jit expression (``jax.jit(...)`` call or
+    ``functools.partial(jax.jit, ...)``), else ()."""
+    if not isinstance(node, ast.Call):
+        return ()
+    fname = dotted_name(node.func)
+    if fname in _JIT_NAMES:
+        return _donate_positions(node)
+    if fname in _PARTIAL_NAMES and node.args and dotted_name(node.args[0]) in _JIT_NAMES:
+        return _donate_positions(node)
+    return ()
+
+
+def _positional_params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> tuple[str, ...]:
+    a = fn.args
+    return tuple(arg.arg for arg in [*a.posonlyargs, *a.args])
+
+
+class DonationChecker(Checker):
+    name = "donation-safety"
+    description = (
+        "no reuse of a buffer after it was passed at a donate_argnums "
+        "position (XLA may alias its memory for an output)"
+    )
+
+    def __init__(self) -> None:
+        self.registry: dict[str, _Donor] = {}
+        # module → names it defines as plain (non-donating) callables: a
+        # local `def update(...)` shadows a same-named donor registered by
+        # another module, so its call sites must not be flagged
+        self._local_plain: dict[str, set[str]] = {}
+
+    # ------------------------------------------------------------- phase 1
+    def collect(self, module: Module) -> None:
+        defs = {
+            n.name: n
+            for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        local_donors: set[str] = set()
+        # decorated definitions
+        for fn in defs.values():
+            for dec in fn.decorator_list:
+                pos = _jit_call_donations(dec)
+                if pos:
+                    self.registry[fn.name] = _Donor(pos, _positional_params(fn))
+                    local_donors.add(fn.name)
+        # application forms bound to a name
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            inner: str | None = None
+            pos: tuple[int, ...] = ()
+            fname = dotted_name(call.func)
+            if fname in _JIT_NAMES and call.args and isinstance(call.args[0], ast.Name):
+                # f = jax.jit(g, donate_argnums=...)
+                inner, pos = call.args[0].id, _donate_positions(call)
+            elif (
+                isinstance(call.func, ast.Call)
+                and _jit_call_donations(call.func)
+                and call.args
+                and isinstance(call.args[0], ast.Name)
+            ):
+                # f = functools.partial(jax.jit, donate_argnums=...)(g)
+                inner, pos = call.args[0].id, _jit_call_donations(call.func)
+            if not inner or not pos:
+                continue
+            wrapped = defs.get(inner)
+            params = _positional_params(wrapped) if wrapped else ()
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    self.registry[target.id] = _Donor(pos, params)
+                    local_donors.add(target.id)
+        self._local_plain[module.abspath] = set(defs) - local_donors
+
+    # ------------------------------------------------------------- phase 2
+    def check(self, module: Module) -> Iterable[Finding]:
+        if not self.registry:
+            return
+        for scope in ast.walk(module.tree):
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_scope(module, scope)
+
+    def _check_scope(self, module: Module, scope: ast.AST) -> Iterable[Finding]:
+        shadowed = self._local_plain.get(module.abspath, set())
+        own = list(_scope_nodes(scope))
+        calls = [
+            n for n in own
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Name)
+            and n.func.id in self.registry
+            and n.func.id not in shadowed  # local plain def wins over a
+                                           # same-named donor elsewhere
+        ]
+        if not calls:
+            return
+        stmts = [n for n in own if isinstance(n, ast.stmt)]
+        for call in calls:
+            donor = self.registry[call.func.id]
+            stmt = _enclosing_stmt(stmts, call)
+            for pos in donor.positions:
+                arg = _argument_at(call, pos, donor.params)
+                key = dotted_name(arg) if arg is not None else None
+                if key is None:
+                    continue
+                if stmt is not None and _stmt_rebinds(stmt, key):
+                    continue  # canonical idiom: result rebinds the donor
+                use = _first_use_after(own, stmts, call, key)
+                if use is not None:
+                    # no line numbers in the message: fingerprints must stay
+                    # stable when unrelated edits shift the file (baseline)
+                    yield self.finding(
+                        module, use,
+                        f"{key!r} was donated (donate_argnums={pos}) to "
+                        f"{call.func.id!r} and is read here — a donated "
+                        f"buffer may hold garbage; rebind it from the "
+                        f"call's result",
+                    )
+
+    # no collect-time findings: the registry is global, so a clean module
+    # can still teach the checker about donors other modules call
+
+
+def _scope_nodes(scope: ast.AST) -> Iterable[ast.AST]:
+    """All nodes of a function scope, excluding nested function/class
+    bodies (closure use is not lexically ordered)."""
+    def visit(node: ast.AST, top: bool) -> Iterable[ast.AST]:
+        if not top and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            return
+        yield node
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, False)
+
+    yield from visit(scope, True)
+
+
+def _enclosing_stmt(stmts: list[ast.stmt], call: ast.Call) -> ast.stmt | None:
+    """Smallest statement whose span contains the call."""
+    best: ast.stmt | None = None
+    for s in stmts:
+        if s.lineno <= call.lineno and (s.end_lineno or s.lineno) >= (call.end_lineno or call.lineno):
+            if best is None or (s.lineno, -(s.end_lineno or 0)) >= (best.lineno, -(best.end_lineno or 0)):
+                best = s
+    return best
+
+
+def _rebinds_key(node: ast.AST, key: str) -> bool:
+    """A store to ``key`` itself or to a prefix of it (rebinding
+    ``self.cache`` invalidates the stale ``self.cache.lengths`` chain)."""
+    d = dotted_name(node)
+    return d is not None and (d == key or key.startswith(d + "."))
+
+
+def _stmt_rebinds(stmt: ast.stmt, key: str) -> bool:
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for el in ast.walk(t):
+            if isinstance(el, (ast.Name, ast.Attribute)) and _rebinds_key(el, key):
+                return True
+    return False
+
+
+def _first_use_after(
+    own: Iterable[ast.AST], stmts: list[ast.stmt], call: ast.Call, key: str
+) -> ast.AST | None:
+    """First reference to ``key`` lexically after the call: a Load before
+    any (exact or prefix) Store means the donated buffer is reused. Within
+    one statement RHS loads execute before the target store, so loads sort
+    first there regardless of column."""
+    call_end = (call.end_lineno or call.lineno, call.end_col_offset or 0)
+
+    def stmt_order(node: ast.AST) -> tuple[int, int]:
+        # innermost containing statement = the latest-starting one
+        containing = [
+            s for s in stmts
+            if (s.lineno, s.col_offset) <= (node.lineno, node.col_offset)
+            and ((s.end_lineno or s.lineno), (s.end_col_offset or 10**9))
+            >= (node.lineno, node.col_offset)
+        ]
+        if containing:
+            s = max(containing, key=lambda s: (s.lineno, s.col_offset))
+            return (s.lineno, s.col_offset)
+        return (node.lineno, node.col_offset)
+
+    refs: list[tuple[tuple, ast.AST, bool]] = []
+    for node in own:
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            continue
+        is_store = isinstance(node.ctx, (ast.Store, ast.Del))
+        matches = _rebinds_key(node, key) if is_store else dotted_name(node) == key
+        if not matches:
+            continue
+        at = (node.lineno, node.col_offset)
+        if at <= call_end:
+            continue
+        refs.append(((stmt_order(node), is_store, at), node, is_store))
+    refs.sort(key=lambda r: r[0])
+    for _, node, is_store in refs:
+        return None if is_store else node
+    return None
+
+
+def _argument_at(
+    call: ast.Call, pos: int, params: tuple[str, ...]
+) -> ast.AST | None:
+    if pos < len(call.args):
+        return call.args[pos]
+    if pos < len(params):
+        want = params[pos]
+        for kw in call.keywords:
+            if kw.arg == want:
+                return kw.value
+    return None

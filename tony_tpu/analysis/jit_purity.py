@@ -1,0 +1,211 @@
+"""jit-purity: Python side effects inside traced (jitted) functions.
+
+``jax.jit`` runs the Python body ONCE per compile cache entry; side effects
+fire at trace time only and silently stop happening on cached calls — the
+classic "my print/append/time.time() works the first step and never again"
+bug class. Flagged inside any jit/pjit-compiled function:
+
+- ``print(...)``
+- stdlib ``time.*`` / ``random.*`` calls (``jax.random`` is fine — its root
+  is ``jax``)
+- ``global`` / ``nonlocal`` declarations
+- assignments to ``self.*`` (or any closed-over object's attributes/items)
+- bare mutating-method statements (``.append/.update/...``) on closed-over
+  state — calls whose *result is used* are not flagged, so API methods that
+  merely share a name (``optimizer.update(...)`` in an assignment) pass
+
+Jitted functions are recognized by decorator (``@jax.jit``,
+``@functools.partial(jax.jit, ...)``) and by application
+(``f = jax.jit(g, ...)``, ``f = functools.partial(jax.jit, ...)(g)``,
+``return jax.jit(g)``) anywhere in the module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tony_tpu.analysis.analyzer import (
+    JIT_NAMES as _JIT_NAMES,
+    MUTATOR_METHODS as _MUTATORS,
+    PARTIAL_NAMES as _PARTIAL_NAMES,
+    Checker,
+    Finding,
+    Module,
+    dotted_name,
+)
+
+_IMPURE_ROOTS = {"time", "random"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` or ``functools.partial(jax.jit, ...)``."""
+    if dotted_name(node) in _JIT_NAMES:
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and dotted_name(node.func) in _PARTIAL_NAMES
+        and bool(node.args)
+        and dotted_name(node.args[0]) in _JIT_NAMES
+    )
+
+
+def _jit_applied_to(node: ast.AST) -> str | None:
+    """Name of the function a jit application wraps, for forms like
+    ``jax.jit(f, ...)`` and ``functools.partial(jax.jit, ...)(f)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jit_expr(node.func) and node.args and isinstance(node.args[0], ast.Name):
+        # partial(jax.jit, ...)(f) — func is itself the jit expr;
+        # jax.jit(f, ...) — func is the jax.jit name
+        if dotted_name(node.func) in _JIT_NAMES or (
+            isinstance(node.func, ast.Call)
+        ):
+            return node.args[0].id
+    return None
+
+
+def _bound_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    """Every name bound within ``fn`` (params, assignments, loop/with/except
+    targets, comprehensions, nested defs) — anything NOT in here that gets
+    mutated is closed-over state."""
+    bound: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            a = node.args
+            for arg in [*a.posonlyargs, *a.args, *a.kwonlyargs]:
+                bound.add(arg.arg)
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+            if not isinstance(node, ast.Lambda):
+                bound.add(node.name)
+        elif isinstance(node, ast.ClassDef):
+            bound.add(node.name)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+    return bound
+
+
+def _root_name(node: ast.AST) -> str | None:
+    """Leftmost Name of an attribute/subscript chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class JitPurityChecker(Checker):
+    name = "jit-purity"
+    description = (
+        "no Python side effects (print/time/random/global/self or "
+        "closed-over mutation) inside jit-compiled functions"
+    )
+
+    def _jitted_functions(self, module: Module) -> list[ast.FunctionDef | ast.AsyncFunctionDef]:
+        jit_applied: set[str] = set()
+        for node in ast.walk(module.tree):
+            target = _jit_applied_to(node)
+            if target:
+                jit_applied.add(target)
+        out = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in jit_applied or any(
+                _is_jit_expr(dec) for dec in node.decorator_list
+            ):
+                out.append(node)
+        return out
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        for fn in self._jitted_functions(module):
+            bound = _bound_names(fn)
+            yield from self._visit(module, fn, fn, bound, nested_params=set())
+
+    def _visit(
+        self, module, fn, node, bound, nested_params: set[str]
+    ) -> Iterable[Finding]:
+        """Recursive walk tracking names bound as params of *nested* defs:
+        a nested helper's own ``self`` (e.g. a trace-time utility class's
+        ``__init__``) is that object's state, not the jitted caller's."""
+        if node is not fn and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            a = node.args
+            params = {arg.arg for arg in [*a.posonlyargs, *a.args, *a.kwonlyargs]}
+            if a.vararg:
+                params.add(a.vararg.arg)
+            if a.kwarg:
+                params.add(a.kwarg.arg)
+            nested_params = nested_params | params
+        yield from self._check_node(module, fn, node, bound, nested_params)
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(module, fn, child, bound, nested_params)
+
+    def _check_node(self, module, fn, node, bound, nested_params) -> Iterable[Finding]:
+        where = f"jitted function {fn.name!r}"
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kw = "global" if isinstance(node, ast.Global) else "nonlocal"
+            yield self.finding(
+                module, node,
+                f"{kw} declaration inside {where}: writes happen at trace "
+                f"time only, not per call",
+            )
+            return
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name == "print":
+                yield self.finding(
+                    module, node,
+                    f"print() inside {where} fires at trace time only "
+                    f"(use jax.debug.print for per-call output)",
+                )
+            elif name and name.split(".", 1)[0] in _IMPURE_ROOTS and "." in name:
+                yield self.finding(
+                    module, node,
+                    f"{name}() inside {where} is evaluated once at trace "
+                    f"time and baked into the compiled program",
+                )
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                for el in ast.walk(t):
+                    if not isinstance(el, (ast.Attribute, ast.Subscript)):
+                        continue
+                    if not isinstance(el.ctx, ast.Store):
+                        continue
+                    root = _root_name(el)
+                    if root == "self" and "self" not in nested_params:
+                        yield self.finding(
+                            module, el,
+                            f"assignment to self.* inside {where}: object "
+                            f"state mutates at trace time only — return the "
+                            f"new value instead",
+                        )
+                    elif root is not None and root not in bound:
+                        yield self.finding(
+                            module, el,
+                            f"mutation of closed-over {root!r} inside "
+                            f"{where}: happens at trace time only — thread "
+                            f"it through the function's inputs/outputs",
+                        )
+            return
+        # bare mutating-method statement on closed-over state; calls whose
+        # result is consumed (assignments, args) are not mutation idioms
+        if (
+            isinstance(node, ast.Expr)
+            and isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Attribute)
+            and node.value.func.attr in _MUTATORS
+        ):
+            root = _root_name(node.value.func.value)
+            is_self = root == "self" and "self" not in nested_params
+            if is_self or (root is not None and root not in bound):
+                owner = "self" if is_self else f"closed-over {root!r}"
+                yield self.finding(
+                    module, node,
+                    f".{node.value.func.attr}() on {owner} inside {where}: "
+                    f"container mutates at trace time only",
+                )

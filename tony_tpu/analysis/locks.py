@@ -1,0 +1,272 @@
+"""lock-discipline: cross-thread writes to ``self._*`` must hold the lock.
+
+Heuristic lockset pass for the control-plane daemons (appmaster, executor,
+pool, agent): their background loops run as ``threading.Thread`` targets,
+and their RPC handlers run on the RPC server's handler threads — both race
+the object's main-loop methods. Per class:
+
+- *declared locks*: attributes assigned ``threading.Lock()``/``RLock()``;
+- *entry methods*: ``threading.Thread(target=self.m)`` targets plus methods
+  registered via ``rpc.register_object(self, METHOD_LIST)`` (the list is
+  resolved from module-level string-list constants, cross-module), expanded
+  transitively through ``self.m()`` calls;
+- *writes*: assignments (attribute, subscript, augmented) and bare mutating
+  method statements on ``self._x``.
+
+An attribute written both from an entry method and from any other method
+(or from two distinct entry methods — two racing threads) is shared state:
+every write to it must be lexically inside ``with self.<lock>:`` for a
+declared lock. Methods whose name ends in ``_locked`` are trusted to be
+called with the lock held (the repo's naming contract) — their writes count
+as locked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tony_tpu.analysis.analyzer import (
+    MUTATOR_METHODS as _MUTATORS,
+    Checker,
+    Finding,
+    Module,
+    dotted_name,
+)
+
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_THREAD_NAMES = {"threading.Thread", "Thread"}
+
+
+class LockDisciplineChecker(Checker):
+    name = "lock-discipline"
+    description = (
+        "self._* state shared between a thread/RPC entry point and other "
+        "methods is only written under a declared lock"
+    )
+
+    def __init__(self) -> None:
+        # module-level NAME = ["str", ...] constants, cross-module (RPC
+        # method lists like APPLICATION_RPC_METHODS live in another file
+        # than the class that registers them)
+        self.string_lists: dict[str, list[str]] = {}
+
+    # ------------------------------------------------------------- phase 1
+    def collect(self, module: Module) -> None:
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, (ast.List, ast.Tuple)):
+                continue
+            values = [
+                el.value
+                for el in node.value.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, str)
+            ]
+            if len(values) != len(node.value.elts) or not values:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    self.string_lists[target.id] = values
+
+    # ------------------------------------------------------------- phase 2
+    def check(self, module: Module) -> Iterable[Finding]:
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(module, node)
+
+    def _check_class(self, module: Module, cls: ast.ClassDef) -> Iterable[Finding]:
+        methods = {
+            n.name: n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        locks = self._declared_locks(cls)
+        roots = self._entry_roots(cls, methods)
+        if not roots:
+            return  # no concurrency inside this class
+        closures = {
+            label: self._transitive(seeds, methods)
+            for label, seeds in roots.items()
+        }
+        entries = set().union(*closures.values())
+
+        def contexts(method: str) -> set[str]:
+            """Concurrency contexts a method runs in: the thread roots it is
+            reachable from, or the caller's ("main") context otherwise."""
+            got = {label for label, cl in closures.items() if method in cl}
+            return got or {"main"}
+
+        # attr → [(method, node, locked)]
+        writes: dict[str, list[tuple[str, ast.AST, bool]]] = {}
+        for name, fn in methods.items():
+            if name == "__init__" or name.startswith("__"):
+                continue
+            trusted = name.endswith("_locked")
+            for attr, node, locked in self._writes(fn, locks):
+                writes.setdefault(attr, []).append((name, node, locked or trusted))
+
+        for attr, sites in sorted(writes.items()):
+            # shared = written from two distinct concurrency contexts (two
+            # different threads). Methods reachable from one thread root
+            # only — however many of them — are that single thread's state.
+            seen: set[str] = set()
+            for m, _, _ in sites:
+                seen |= contexts(m)
+            if len(seen) < 2:
+                continue
+            for method, node, locked in sites:
+                if locked:
+                    continue
+                hint = (
+                    f"hold one of: {', '.join(sorted('self.' + lk for lk in locks))}"
+                    if locks
+                    else f"declare a threading.Lock on {cls.name} and hold it"
+                )
+                yield self.finding(
+                    module, node,
+                    f"self.{attr} is written in {method!r} without a lock, "
+                    f"but is also written from "
+                    f"{'thread/RPC entry ' if method not in entries else ''}"
+                    f"{self._other_writers(method, sites)} — {hint}",
+                )
+
+    @staticmethod
+    def _other_writers(method: str, sites: list[tuple[str, ast.AST, bool]]) -> str:
+        others = sorted({m for m, _, _ in sites if m != method})
+        return ", ".join(repr(m) for m in others) or "another thread"
+
+    # ------------------------------------------------------------ gathering
+    def _declared_locks(self, cls: ast.ClassDef) -> set[str]:
+        locks: set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (
+                isinstance(node.value, ast.Call)
+                and dotted_name(node.value.func) in _LOCK_FACTORIES
+            ):
+                continue
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    locks.add(t.attr)
+        return locks
+
+    def _entry_roots(self, cls: ast.ClassDef, methods: dict) -> dict[str, set[str]]:
+        """Concurrency roots: each ``threading.Thread`` target is its own
+        thread; all RPC-registered handlers share the server's handler-
+        thread pool (one root)."""
+        roots: dict[str, set[str]] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname in _THREAD_NAMES:
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    tgt = kw.value
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and tgt.attr in methods
+                    ):
+                        roots.setdefault(f"thread:{tgt.attr}", set()).add(tgt.attr)
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "register_object"
+                and len(node.args) >= 2
+            ):
+                names: list[str] = []
+                second = node.args[1]
+                if isinstance(second, ast.Name):
+                    names = self.string_lists.get(second.id, [])
+                elif isinstance(second, (ast.List, ast.Tuple)):
+                    names = [
+                        el.value
+                        for el in second.elts
+                        if isinstance(el, ast.Constant) and isinstance(el.value, str)
+                    ]
+                handlers = {n for n in names if n in methods}
+                if handlers:
+                    roots.setdefault("rpc", set()).update(handlers)
+        return roots
+
+    @staticmethod
+    def _transitive(entries: set[str], methods: dict) -> set[str]:
+        """Grow the entry set through self-method calls: a helper invoked
+        from a thread entry runs on that thread."""
+        out = set(entries)
+        frontier = list(entries)
+        while frontier:
+            fn = methods.get(frontier.pop())
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr in methods
+                    and node.func.attr not in out
+                ):
+                    out.add(node.func.attr)
+                    frontier.append(node.func.attr)
+        return out
+
+    def _writes(
+        self, fn: ast.AST, locks: set[str]
+    ) -> Iterable[tuple[str, ast.AST, bool]]:
+        """(attr, node, lexically_locked) for every write to self._* in fn."""
+
+        def self_underscore_attr(node: ast.AST) -> str | None:
+            """'x' for an access chain rooted at ``self._x``."""
+            while isinstance(node, (ast.Attribute, ast.Subscript)):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    attr = node.attr
+                    return attr if attr.startswith("_") and attr not in locks else None
+                node = node.value
+            return None
+
+        def visit(node: ast.AST, locked: bool) -> Iterable[tuple[str, ast.AST, bool]]:
+            if isinstance(node, ast.With):
+                holds = locked or any(
+                    dotted_name(item.context_expr) in {f"self.{lk}" for lk in locks}
+                    for item in node.items
+                )
+                for child in ast.iter_child_nodes(node):
+                    yield from visit(child, holds)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    els = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                    for el in els:
+                        attr = self_underscore_attr(el)
+                        if attr is not None and not isinstance(el, ast.Name):
+                            yield attr, el, locked
+            elif (
+                isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr in _MUTATORS
+            ):
+                attr = self_underscore_attr(node.value.func.value)
+                if attr is not None:
+                    yield attr, node, locked
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, locked)
+
+        yield from visit(fn, False)

@@ -1,0 +1,126 @@
+"""mesh-axes: collective axis names must be declared mesh axes.
+
+``jax.lax.psum(x, "contxt")`` fails only at trace time inside the target
+mesh context — on a v5e-64 run, after minutes of setup. The canonical axis
+names live in ``tony_tpu/parallel/mesh.py`` (``AXIS_* = "..."``); phase 1
+collects every such declaration (any module declaring ``AXIS_*`` string
+constants is a declaration site, so fixtures can carry their own). Checked:
+
+- the axis argument (keyword ``axis_name`` or the collective's positional
+  slot) of ``jax.lax.psum/pmean/pmax/pmin/all_gather/ppermute/all_to_all/
+  psum_scatter/axis_index/axis_size``;
+- an ``axis_name=`` keyword on ANY call (wrappers like ``ring_attention``
+  thread it straight into collectives);
+- a string default on a function parameter named ``axis_name``.
+
+String literals (or tuples of them) must be declared axes; names threaded
+in as variables/parameters are trusted — that is the approved way to
+parameterize an axis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tony_tpu.analysis.analyzer import Checker, Finding, Module, dotted_name
+
+# collective → positional slot of its axis-name argument
+_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "all_gather": 1,
+    "ppermute": 1, "all_to_all": 1, "psum_scatter": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+
+class MeshAxisChecker(Checker):
+    name = "mesh-axes"
+    description = (
+        "axis names passed to collectives are declared mesh axes "
+        "(parallel/mesh.py) or threaded parameters"
+    )
+
+    def __init__(self) -> None:
+        self.declared: set[str] = set()
+
+    # ------------------------------------------------------------- phase 1
+    def collect(self, module: Module) -> None:
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (
+                isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id.startswith("AXIS_"):
+                    self.declared.add(node.value.value)
+
+    # ------------------------------------------------------------- phase 2
+    def check(self, module: Module) -> Iterable[Finding]:
+        if not self.declared:
+            return  # no axis registry in scope
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(module, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_defaults(module, node)
+
+    def _check_call(self, module: Module, call: ast.Call) -> Iterable[Finding]:
+        fname = dotted_name(call.func) or ""
+        parts = fname.rsplit(".", 1)
+        is_lax_collective = (
+            len(parts) == 2
+            and parts[1] in _COLLECTIVES
+            and parts[0] in ("lax", "jax.lax")
+        )
+        axis_arg: ast.AST | None = None
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                axis_arg = kw.value
+        if axis_arg is None and is_lax_collective:
+            slot = _COLLECTIVES[parts[1]]
+            if len(call.args) > slot:
+                axis_arg = call.args[slot]
+        if axis_arg is None:
+            return
+        if not is_lax_collective and not any(
+            kw.arg == "axis_name" for kw in call.keywords
+        ):
+            return
+        yield from self._validate(module, axis_arg, context=fname or "call")
+
+    def _check_defaults(self, module: Module, fn) -> Iterable[Finding]:
+        a = fn.args
+        pos = [*a.posonlyargs, *a.args]
+        defaults = a.defaults
+        for arg, default in zip(pos[len(pos) - len(defaults):], defaults):
+            if arg.arg == "axis_name":
+                yield from self._validate(
+                    module, default, context=f"default of {fn.name}()"
+                )
+        for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+            if default is not None and arg.arg == "axis_name":
+                yield from self._validate(
+                    module, default, context=f"default of {fn.name}()"
+                )
+
+    def _validate(
+        self, module: Module, node: ast.AST, context: str
+    ) -> Iterable[Finding]:
+        literals: list[ast.Constant] = []
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            literals = [node]
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            literals = [
+                el for el in node.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, str)
+            ]
+        for lit in literals:
+            if lit.value not in self.declared:
+                yield self.finding(
+                    module, lit,
+                    f"axis name {lit.value!r} ({context}) is not a declared "
+                    f"mesh axis — declared: {', '.join(sorted(self.declared))}",
+                )

@@ -1,0 +1,125 @@
+"""``tony lint`` — run the static-analysis suite (tony_tpu/analysis/).
+
+Exit-code contract (stable, for CI consumption):
+    0  clean (no findings beyond the baseline)
+    1  findings
+    2  internal error (bad arguments, unreadable path, checker crash)
+
+``--format json`` prints a single JSON object on stdout:
+``{"findings": [...], "summary": {"total": N, "grandfathered": N,
+"by_checker": {...}}}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tony_tpu.analysis.analyzer import (
+    Analyzer,
+    all_checkers,
+    apply_baseline,
+    load_baseline,
+    render_json,
+    render_text,
+    write_baseline,
+)
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_INTERNAL_ERROR = 2
+
+
+def repo_root() -> str:
+    """Directory containing the ``tony_tpu`` package (the checkout root for
+    a source tree; site-packages for an installed wheel)."""
+    import tony_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(tony_tpu.__file__)))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), ".lint-baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tony lint",
+        description="AST-based static analysis for tony-tpu hazard classes "
+                    "(see docs/static-analysis.md)",
+    )
+    p.add_argument(
+        "paths", nargs="*", default=None,
+        help="files/directories to lint (default: the tony_tpu package)",
+    )
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument(
+        "--checks", default="",
+        help="comma-separated checker names to run (default: all)",
+    )
+    p.add_argument("--list-checks", action="store_true", help="list checkers and exit")
+    p.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file of grandfathered findings "
+             f"(default: {os.path.basename(default_baseline_path())} at the repo root)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring the baseline file",
+    )
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help="write the current findings to the baseline file and exit 0",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    try:
+        args = build_parser().parse_args(argv)
+    except SystemExit as e:  # argparse exits 2 on bad usage, 0 on --help
+        return int(e.code or 0)
+    try:
+        checkers = all_checkers()
+        if args.list_checks:
+            for c in checkers:
+                print(f"{c.name:16s} {c.description}")
+            return EXIT_CLEAN
+        if args.checks:
+            wanted = {n.strip() for n in args.checks.split(",") if n.strip()}
+            known = {c.name for c in checkers}
+            unknown = wanted - known
+            if unknown:
+                raise ValueError(
+                    f"unknown checker(s) {sorted(unknown)}; known: {sorted(known)}"
+                )
+            checkers = [c for c in checkers if c.name in wanted]
+        paths = args.paths or [os.path.join(repo_root(), "tony_tpu")]
+        analyzer = Analyzer(checkers, root=repo_root())
+        findings = analyzer.run(paths)
+
+        baseline_path = args.baseline or default_baseline_path()
+        if args.update_baseline:
+            if args.checks:
+                # a checker-subset run must not rewrite the baseline: it
+                # would silently drop every grandfathered entry belonging
+                # to the checkers that did not run
+                raise ValueError(
+                    "--update-baseline requires all checkers (drop --checks)"
+                )
+            write_baseline(baseline_path, findings)
+            print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+            return EXIT_CLEAN
+        baseline = set() if args.no_baseline else load_baseline(baseline_path)
+        fresh, grandfathered = apply_baseline(findings, baseline)
+        render = render_json if args.format == "json" else render_text
+        print(render(fresh, grandfathered))
+        return EXIT_FINDINGS if fresh else EXIT_CLEAN
+    except Exception as e:
+        print(f"tony lint: internal error: {type(e).__name__}: {e}", file=sys.stderr)
+        return EXIT_INTERNAL_ERROR
+
+
+if __name__ == "__main__":
+    sys.exit(main())

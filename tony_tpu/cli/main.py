@@ -71,6 +71,12 @@ def _cmd_serve(argv: list[str]) -> int:
     return serve_main(argv)
 
 
+def _cmd_lint(argv: list[str]) -> int:
+    from tony_tpu.cli.lint import main as lint_main
+
+    return lint_main(argv)
+
+
 def _cmd_mini(argv: list[str]) -> int:
     """Self-contained sandbox: submit a smoke gang against the local resource
     manager and print the verdict + history location.
@@ -142,7 +148,8 @@ def _cmd_pool(argv: list[str]) -> int:
     from tony_tpu.cluster.resources import DEFAULT_CHIPS_PER_HOST, SliceSpec
 
     p = argparse.ArgumentParser(prog="tony pool", description=_cmd_pool.__doc__)
-    p.add_argument("--spec", default="", help="TPU pool, e.g. 'v5e-8x2' (slice spec x num slices); empty → CPU-only hosts")
+    p.add_argument("--spec", default="",
+                   help="TPU pool, e.g. 'v5e-8x2' (slice spec x num slices); empty → CPU-only hosts")
     p.add_argument("--hosts", type=int, default=2, help="host agents when no --spec (CPU pool)")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--memory", default="64g", help="memory per host")
@@ -229,13 +236,14 @@ _COMMANDS = {
     "serve": _cmd_serve,
     "mini": _cmd_mini,
     "data-prep": _cmd_data_prep,
+    "lint": _cmd_lint,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|portal|notebook|serve|mini|data-prep} [options]\n")
+        print("usage: tony {submit|pool|history|portal|notebook|serve|mini|data-prep|lint} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    list finished jobs / dump one job's events")
@@ -244,6 +252,7 @@ def main(argv: list[str] | None = None) -> int:
         print("  serve      run the inference engine as an AM-supervised HTTP endpoint")
         print("  mini       one-command local sandbox (smoke gang, optional --distributed)")
         print("  data-prep  tokenize text files into TONYTOK training shards")
+        print("  lint       run the AST static-analysis suite (config/jit/lock/mesh discipline)")
         return 0
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:

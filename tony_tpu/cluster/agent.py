@@ -32,7 +32,6 @@ import signal
 import socket
 import sys
 import threading
-import time
 from typing import Any
 
 from tony_tpu import constants

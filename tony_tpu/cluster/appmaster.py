@@ -22,7 +22,6 @@ import argparse
 import json
 import os
 import secrets as _secrets
-import socket
 import sys
 import time
 from typing import Any
@@ -160,8 +159,16 @@ class ApplicationMaster:
         session.register_worker_spec(job_name, index, host, port)
         self.events.emit(EventType.TASK_REGISTERED, task=f"{job_name}:{index}", host=host, port=port)
         complete = session.cluster_spec_complete()
-        if complete and not self._gang_complete_fired:
-            self._gang_complete_fired = True
+        fire = False
+        if complete:
+            # atomic check-and-set: the gang's last two registrations race on
+            # separate RPC handler threads, and on_gang_complete must fire
+            # exactly once per gang epoch (it assigns collective ranks)
+            with self._epoch_lock:
+                if not self._gang_complete_fired and session is self.session:
+                    self._gang_complete_fired = True
+                    fire = True
+        if fire:
             self.runtime.on_gang_complete(session)
             self.events.emit(EventType.GANG_COMPLETE, tasks=session.total_tasks())
         return {"spec_complete": complete}

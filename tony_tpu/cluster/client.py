@@ -176,7 +176,8 @@ class Client:
                     if not quiet:
                         loc = f" on {info['host']}:{info['port']}" if info.get("host") else ""
                         print(f"[tony] task {tid} → {st}{loc}" +
-                              (f" (logs: {info['log_dir']})" if st in ("FAILED", "LOST") and info.get("log_dir") else ""))
+                              (f" (logs: {info['log_dir']})"
+                               if st in ("FAILED", "LOST") and info.get("log_dir") else ""))
             if app.get("tensorboard_url") and not tb_reported:
                 tb_reported = True
                 self._notify("tensorboard_url", app["tensorboard_url"])

@@ -23,7 +23,7 @@ import subprocess
 import threading
 import uuid
 from abc import ABC, abstractmethod
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tony_tpu import constants
 from tony_tpu.config import parse_memory_string

@@ -18,7 +18,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
-from tony_tpu.config import TonyConfig, keys
+from tony_tpu.config import TonyConfig
 
 
 class TaskStatus(enum.Enum):

@@ -1,0 +1,87 @@
+"""Version-compatibility shims for the jax API surface.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to a top-level
+``jax.shard_map`` with renamed knobs (``check_rep`` → ``check_vma``, the
+manual-axes subset spelled ``axis_names=`` instead of its complement
+``auto=``). The installed jax may sit on either side of that move; import
+``shard_map`` from here and write call sites against the NEW surface —
+on an older jax the wrapper translates.
+"""
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # older jax: the experimental location + old knobs
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=None, check_rep=None, **kwargs):
+        if check_rep is None:
+            check_rep = True if check_vma is None else bool(check_vma)
+        # ``axis_names`` (the manual subset) is dropped rather than
+        # translated to the old ``auto=`` complement: partial-manual
+        # subgroups trip an XLA CHECK in this jaxlib's SPMD partitioner
+        # (spmd_partitioner.cc IsManualSubgroup), a hard process abort.
+        # Full-manual replicates the unlisted axes instead — numerically
+        # identical, just without GSPMD sharding them inside the body.
+        del axis_names
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_rep, **kwargs,
+        )
+
+try:
+    tree_leaves_with_path = jax.tree.leaves_with_path
+except AttributeError:  # older jax: only the tree_util spelling exists
+    from jax.tree_util import tree_leaves_with_path
+
+try:
+    axis_size = jax.lax.axis_size
+except AttributeError:  # older jax: the frame lookup (static size, same value)
+
+    def axis_size(axis_name):
+        from jax._src.core import axis_frame
+
+        frame = axis_frame(axis_name)
+        return getattr(frame, "size", frame)
+
+
+def _filter_kwargs(cls, kwargs):
+    import inspect
+
+    try:
+        accepted = set(inspect.signature(cls).parameters)
+    except (TypeError, ValueError):
+        return kwargs
+    return {k: v for k, v in kwargs.items() if k in accepted}
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (new name) / ``TPUCompilerParams`` (old)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**_filter_kwargs(cls, kwargs))
+
+
+def tpu_interpret_params(**kwargs):
+    """``pltpu.InterpretParams`` where it exists; plain ``interpret=True``
+    (no race detection) on a jax without the TPU interpret machinery."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "InterpretParams", None) or getattr(
+        pltpu, "TPUInterpretParams", None
+    )
+    if cls is None:
+        return True
+    return cls(**_filter_kwargs(cls, kwargs))
+
+
+__all__ = [
+    "axis_size",
+    "shard_map",
+    "tpu_compiler_params",
+    "tpu_interpret_params",
+    "tree_leaves_with_path",
+]

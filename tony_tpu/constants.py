@@ -52,7 +52,8 @@ ENV_TASK_NUM = "TASK_NUM"               # instances of this type
 ENV_DISTRIBUTED_MODE = "DISTRIBUTED_MODE"  # GANG | SINGLE_NODE
 ENV_CLUSTER_SPEC = "CLUSTER_SPEC"       # full cluster spec JSON (legacy TF contract)
 ENV_TB_PORT = "TB_PORT"                 # tensorboard task port
-ENV_TRAIN_METRICS_FILE = "TONY_TRAIN_METRICS_FILE"  # train loop drops step metrics here; executor push loop picks them up
+# train loop drops step metrics here; the executor push loop picks them up
+ENV_TRAIN_METRICS_FILE = "TONY_TRAIN_METRICS_FILE"
 ENV_KILL_GRACE_MS = "TONY_KILL_GRACE_MS"  # SIGTERM→SIGKILL window for this container (tony.task.kill-grace-ms)
 ENV_CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"            # from tony.checkpoint.dir
 ENV_CHECKPOINT_INTERVAL = "TONY_CHECKPOINT_INTERVAL"  # from tony.checkpoint.interval-steps
@@ -117,7 +118,10 @@ EXIT_EXECUTOR_REGISTRATION_FAILED = 11
 EXIT_HEARTBEAT_LOST = 12
 EXIT_KILLED = 137
 EXIT_NODE_LOST = -100   # container's host agent died (YARN ContainerExitStatus.ABORTED analog)
-EXIT_PREEMPTED = -102   # pool preempted the container for a higher-priority app (YARN ContainerExitStatus.PREEMPTED analog; not a job failure — excluded from restart budgets)
+# pool preempted the container for a higher-priority app (the YARN
+# ContainerExitStatus.PREEMPTED analog; not a job failure — excluded
+# from restart budgets)
+EXIT_PREEMPTED = -102
 
 # Distributed-mode values
 DISTRIBUTED_MODE_GANG = "GANG"

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from tony_tpu.compat import shard_map
 from tony_tpu.ops import attention as attn_ops
 from tony_tpu.ops import layers as L
 from tony_tpu.parallel.context import ring_attention
@@ -183,7 +184,7 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh, segment_ids=None) -> jax.Array:
                 )
             qspec = P(BATCH_AXES, "model", "context", None)
             if segment_ids is not None:
-                ring = jax.shard_map(
+                ring = shard_map(
                     partial(
                         ring_attention_pallas_seg, axis_name="context",
                         causal=True, window=cfg.sliding_window,
@@ -195,7 +196,7 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh, segment_ids=None) -> jax.Array:
                     check_vma=False,
                 )
                 return ring(q, k, v, segment_ids)
-            ring = jax.shard_map(
+            ring = shard_map(
                 partial(
                     ring_attention_pallas, axis_name="context", causal=True,
                     window=cfg.sliding_window,
@@ -233,7 +234,7 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh, segment_ids=None) -> jax.Array:
             k = attn_ops.repeat_kv(k, n_rep)
             v = attn_ops.repeat_kv(v, n_rep)
             fn = partial(ring_attention, axis_name="context", causal=True)
-        ring = jax.shard_map(
+        ring = shard_map(
             fn,
             mesh=mesh,
             in_specs=(spec, spec, spec),

@@ -22,6 +22,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from tony_tpu.compat import tpu_compiler_params
+
 NEG_INF = -1e30
 
 # Per-row stats (logsumexp, delta) are carried with a trailing lane dim of
@@ -222,7 +224,7 @@ def _flash_fwd_lanes(
             jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, Tq, _STAT_LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=_INTERPRET,
@@ -530,7 +532,7 @@ def _flash_bwd_impl(
         in_specs=dq_specs,
         out_specs=blk_q,
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "arbitrary")),
+        compiler_params=tpu_compiler_params(dimension_semantics=("parallel", "arbitrary")),
         interpret=_INTERPRET,
         cost_estimate=pl.CostEstimate(
             flops=6 * B * H * Tq * Tk * D,
@@ -580,7 +582,7 @@ def _flash_bwd_impl(
                 jax.ShapeDtypeStruct((B * Hkv, Tk, D), k.dtype),
                 jax.ShapeDtypeStruct((B * Hkv, Tk, D), v.dtype),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "arbitrary")
             ),
             interpret=_INTERPRET,
@@ -668,7 +670,7 @@ def _flash_bwd_impl(
                 jax.ShapeDtypeStruct((B * Hkv, Tk, D), jnp.float32),
                 jax.ShapeDtypeStruct((B * Hkv, Tk, D), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "arbitrary")
             ),
             interpret=_INTERPRET,

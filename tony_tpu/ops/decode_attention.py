@@ -33,6 +33,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from tony_tpu.compat import tpu_compiler_params
+
 _INTERPRET = os.environ.get("TONY_PALLAS_INTERPRET", "") == "1"
 
 # cache positions streamed per DMA slab; 256 measured best on v5e (r3-cont
@@ -244,7 +246,7 @@ def ragged_decode_attention(
         kern,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, Hkv, n_rep, Dh), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=_INTERPRET,
@@ -372,7 +374,7 @@ def paged_decode_attention(
         kern,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, Hkv, n_rep, Dh), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=_INTERPRET,

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tony_tpu.compat import tpu_compiler_params
+
 _INTERPRET = os.environ.get("TONY_PALLAS_INTERPRET", "") == "1"
 
 # fwd row-tile; group sizes are padded to multiples of this. 128 is the r3
@@ -176,7 +178,7 @@ def _fwd_call(xs, wg, wu, wd, tile_group, tile):
         _fwd_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((PN, D), xs.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),  # revisit caching needs order
             vmem_limit_bytes=100 * 1024 * 1024,  # weight slabs resident (v5e: 128M)
         ),
@@ -221,7 +223,7 @@ def _bwd_call(xs, dy, wg, wu, wd, tile_group, tile):
             jax.ShapeDtypeStruct((E, D, F), jnp.float32),
             jax.ShapeDtypeStruct((E, F, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024,  # f32 dW accumulators + weight slabs
         ),

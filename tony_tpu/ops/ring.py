@@ -36,6 +36,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from tony_tpu.compat import axis_size, tpu_compiler_params, tpu_interpret_params
 from tony_tpu.ops.attention import NEG_INF, _STAT_LANES
 
 # Registry of Pallas collective_ids in this program. A collective_id names the
@@ -51,9 +52,7 @@ def default_interpret():
     """InterpretParams when the env asks for emulated kernels, else False
     (same TONY_PALLAS_INTERPRET contract as ops/attention.py)."""
     if os.environ.get("TONY_PALLAS_INTERPRET", "") == "1":
-        from jax.experimental.pallas import tpu as pltpu
-
-        return pltpu.InterpretParams()
+        return tpu_interpret_params()
     return False
 
 
@@ -314,7 +313,7 @@ def _ring_fwd(q, k, v, axis_name: str, causal: bool, interpret: Any,
     if H % Hkv:
         raise ValueError(f"n_heads {H} must be divisible by n_kv_heads {Hkv}")
     n_rep = H // Hkv
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     scale = D ** -0.5
     bq = _pick_block(Tl, _RING_BQ)
@@ -372,7 +371,7 @@ def _ring_fwd(q, k, v, axis_name: str, causal: bool, interpret: Any,
             pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.SemaphoreType.REGULAR((2,)),    # per-slot "free" acks
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=RING_ATTENTION_COLLECTIVE_ID),
+        compiler_params=tpu_compiler_params(collective_id=RING_ATTENTION_COLLECTIVE_ID),
         interpret=interpret if interpret is not None else default_interpret(),
     )(*operands)
     return out.reshape(B, H, Tl, D), lse.reshape(B, H, Tl, _STAT_LANES)
@@ -669,7 +668,7 @@ def _ring_bwd(q, k, v, o, lse, do, axis_name: str, causal: bool, interpret: Any,
     B, H, Tl, D = q.shape
     Hkv = k.shape[1]
     n_rep = H // Hkv
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     scale = D ** -0.5
     bq = _pick_block(Tl, _RING_BQ)
@@ -753,7 +752,7 @@ def _ring_bwd(q, k, v, o, lse, do, axis_name: str, causal: bool, interpret: Any,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=RING_ATTENTION_BWD_COLLECTIVE_ID),
+        compiler_params=tpu_compiler_params(collective_id=RING_ATTENTION_BWD_COLLECTIVE_ID),
         interpret=interpret if interpret is not None else default_interpret(),
     )(*operands)
     return (

@@ -10,11 +10,12 @@ sharding propagation instead and never call these directly.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
+
+from tony_tpu.compat import axis_size
 
 
 def ring_size(axis_name: str) -> int:
-    return jax.lax.axis_size(axis_name)
+    return axis_size(axis_name)
 
 
 def ring_index(axis_name: str) -> jax.Array:
@@ -24,7 +25,7 @@ def ring_index(axis_name: str) -> jax.Array:
 def rotate(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
     """Send to the next rank on the axis ring (ppermute); the ICI-neighbor
     pattern every ring collective here is built from."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name, perm)
 
@@ -56,7 +57,7 @@ def ring_all_reduce_sum(x: jax.Array, axis_name: str) -> jax.Array:
     compute in shard_map bodies (and as the XLA-level analog of the Pallas
     remote-DMA ring in ops/ring kernels).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if x.shape[0] % n:
         return jax.lax.psum(x, axis_name)
     scattered = psum_scatter(x, axis_name, axis=0)
@@ -71,4 +72,4 @@ def moe_all_to_all(tokens: jax.Array, axis_name: str) -> jax.Array:
 
 def stop_transfer_if_single(axis_name: str, x: jax.Array) -> jax.Array:
     """No-op guard for size-1 axes (lets one code path serve all mesh shapes)."""
-    return x if jax.lax.axis_size(axis_name) > 1 else x
+    return x if axis_size(axis_name) > 1 else x

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from tony_tpu.compat import shard_map
 from tony_tpu.parallel.sharding import constrain
 
 
@@ -491,7 +492,7 @@ def _ragged_expert_ffn_ep(
     act = P(batch_axes or None, None, None)
     wspec = P("expert", None, None)
     tm = token_mask if token_mask is not None else jnp.ones((B, T), bool)
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(act, P(None, None), wspec, wspec, wspec,

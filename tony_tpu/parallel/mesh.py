@@ -21,7 +21,7 @@ data/fsdp/stage may span the slower DCN boundary between slices. On one slice
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field, fields
+from dataclasses import dataclass, fields
 
 import jax
 import numpy as np

@@ -10,14 +10,13 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from functools import partial
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from tony_tpu.parallel.sharding import ShardingRules, shard_params
+from tony_tpu.parallel.sharding import ShardingRules
 
 
 @jax.tree_util.register_dataclass
