@@ -1,0 +1,50 @@
+"""Multi-fault soak workload: train with checkpoints, crash, resume through a
+chaos-torn checkpoint.
+
+Attempt 0 trains 4 steps (checkpointing every 2) then exits nonzero. Under
+the soak schedule (``ckpt-corrupt:latest`` + background ``rpc-drop``), the
+restarted attempt's ``restore_or_init`` finds step 4 torn, quarantines it,
+falls back to step 2 ("resumed from checkpoint step 2"), and completes the
+full 8 steps. The soak test asserts the verdict, the fallback-resume line,
+the exactly-once gang-complete invariant, and that no orphans survive.
+"""
+
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tony_tpu.cli.distributed_smoke import sanitize_env_for_cpu_group  # noqa: E402
+
+sanitize_env_for_cpu_group()  # one CPU device: the tiny batch can't shard over 8
+
+from tony_tpu.models import llama  # noqa: E402
+from tony_tpu.train.checkpoint import CheckpointManager  # noqa: E402
+from tony_tpu.train.loop import LoopConfig, run_lm_training  # noqa: E402
+
+attempt = int(os.environ.get("TONY_RESTART_ATTEMPT", "0"))
+ckpt_dir = os.path.join(os.environ["TONY_STAGING_DIR"], "ckpt")
+
+cfg = dataclasses.replace(llama.LLAMA_TINY, max_seq=16)
+loop = LoopConfig(
+    steps=4 if attempt == 0 else 8,
+    batch_size=2,
+    seq_len=16,
+    log_every=100,
+    checkpoint_dir=ckpt_dir,
+    checkpoint_every=2,
+    warmup_steps=0,
+)
+run_lm_training(llama, cfg, loop)
+
+if attempt == 0:
+    print("fixture: attempt 0 crashing after checkpointed steps")
+    sys.exit(1)
+
+# the chaos ckpt-corrupt fault tore step 4 at restore time: the quarantine
+# must have fallen back to step 2 and the corrupt dir must be out of the way
+assert os.path.isdir(os.path.join(ckpt_dir, ".corrupt-4")), os.listdir(ckpt_dir)
+final_mgr = CheckpointManager(ckpt_dir)
+assert final_mgr.latest_step() == 8, final_mgr.latest_step()
+print("fixture: soak resume run completed to step 8")

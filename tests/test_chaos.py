@@ -1,0 +1,454 @@
+"""Deterministic fault-injection suite (tony_tpu/chaos; docs/fault-tolerance.md).
+
+Fast tier-1 coverage of the recovery matrix — one fault per recovery path:
+rpc retry/backoff/deadline, heartbeat-lost → LOST / → gang restart,
+stale-epoch spec fencing, execution-timeout exit code, corrupt-checkpoint
+fallback — plus the seeded multi-fault soak (marked slow).
+"""
+
+import os
+import socket
+import time
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.chaos import ChaosContext, FaultSchedule, corrupt_latest_checkpoint
+from tony_tpu.cluster import history
+from tony_tpu.cluster.rpc import RpcClient, RpcError, RpcServer
+from tony_tpu.cluster.session import JobStatus
+from tony_tpu.config import TonyConfig, keys
+
+from tests.test_e2e import FAST, fixture_cmd, run_job
+
+pytestmark = pytest.mark.chaos
+
+
+def ctx_for(spec: str, seed: int = 0, identity: str = "worker:0", staging=None) -> ChaosContext:
+    return ChaosContext(FaultSchedule.parse(spec, seed), identity, staging_dir=staging)
+
+
+# ---------------------------------------------------------------------------
+# grammar
+# ---------------------------------------------------------------------------
+class TestFaultGrammar:
+    def test_full_exemplar_schedule(self):
+        s = FaultSchedule.parse(
+            "rpc-drop:p=0.05;exec-crash:worker:1@gang_complete;"
+            "hb-stall:worker:0@t+5s;ckpt-corrupt:latest",
+            seed=42,
+        )
+        assert [f.kind for f in s.faults] == ["rpc-drop", "exec-crash", "hb-stall", "ckpt-corrupt"]
+        drop, crash, stall, corrupt = s.faults
+        assert drop.params == {"p": 0.05} and drop.target is None
+        assert crash.target == ("worker", 1) and crash.trigger == "gang_complete"
+        assert stall.target == ("worker", 0) and stall.delay_ms == 5000 and stall.trigger is None
+        assert corrupt.args == ("latest",)
+        assert s.seed == 42
+
+    def test_params_and_args_mix(self):
+        (f,) = FaultSchedule.parse("rpc-delay:worker:2:p=0.5:ms=250").faults
+        assert f.target == ("worker", 2)
+        assert f.params == {"p": 0.5, "ms": 250.0}
+        assert f.ms(default=1) == 250
+
+    def test_empty_spec_and_whitespace(self):
+        assert FaultSchedule.parse("").faults == ()
+        assert FaultSchedule.parse(" ; ;").faults == ()
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultSchedule.parse("rpc-frobnicate:p=1")
+
+    def test_bad_probability_rejected(self):
+        with pytest.raises(ValueError, match="out of"):
+            FaultSchedule.parse("rpc-drop:p=1.5")
+
+    def test_non_numeric_param_rejected(self):
+        with pytest.raises(ValueError, match="non-numeric"):
+            FaultSchedule.parse("rpc-drop:p=often")
+
+
+# ---------------------------------------------------------------------------
+# determinism: the acceptance criterion — same seed + schedule, same sequence
+# ---------------------------------------------------------------------------
+class TestDeterminism:
+    SPEC = "rpc-drop:p=0.3;rpc-delay:p=0.2:ms=1"
+
+    @staticmethod
+    def _sequence(ctx, n=300):
+        return [
+            (ctx.take("rpc-drop") is not None, ctx.take("rpc-delay") is not None)
+            for _ in range(n)
+        ]
+
+    def test_same_seed_same_injected_sequence(self):
+        a = self._sequence(ctx_for(self.SPEC, seed=42))
+        b = self._sequence(ctx_for(self.SPEC, seed=42))
+        assert a == b
+
+    def test_different_seed_differs(self):
+        a = self._sequence(ctx_for(self.SPEC, seed=42))
+        c = self._sequence(ctx_for(self.SPEC, seed=43))
+        assert a != c
+
+    def test_streams_are_per_identity(self):
+        a = self._sequence(ctx_for(self.SPEC, seed=42, identity="worker:0"))
+        b = self._sequence(ctx_for(self.SPEC, seed=42, identity="worker:1"))
+        assert a != b
+
+    def test_targeted_fault_ignores_other_tasks(self):
+        ctx = ctx_for("hb-stall:worker:1", identity="worker:0")
+        assert ctx.take("hb-stall") is None
+        ctx = ctx_for("hb-stall:worker:1", identity="worker:1")
+        assert ctx.take("hb-stall") is not None
+
+    def test_once_per_job_latch_survives_process_restart(self, tmp_path):
+        staging = str(tmp_path)
+        assert ctx_for("hb-stall:worker:0", staging=staging).take("hb-stall") is not None
+        # a NEW context (a restarted attempt) sees the shared latch
+        assert ctx_for("hb-stall:worker:0", staging=staging).take("hb-stall") is None
+
+    def test_time_armed_fault_waits(self):
+        ctx = ctx_for("exec-crash:worker:0@t+1h")
+        assert ctx.take("exec-crash") is None  # not armed yet
+
+    def test_take_spec_enforces_target(self):
+        # the executor's timed-fault threads go through take_spec directly:
+        # a fault targeted at another task must not fire here
+        ctx = ctx_for("exec-crash:worker:1", identity="worker:0")
+        (f,) = ctx.schedule.faults
+        assert ctx.take_spec(f) is None
+        ctx1 = ctx_for("exec-crash:worker:1", identity="worker:1")
+        assert ctx1.take_spec(ctx1.schedule.faults[0]) is not None
+
+    def test_injections_are_logged(self, tmp_path):
+        ctx = ctx_for("hb-stall:worker:0", staging=str(tmp_path))
+        ctx.take("hb-stall")
+        (log,) = [f for f in os.listdir(tmp_path / "chaos") if f.endswith(".jsonl")]
+        assert "worker_0" in log
+        assert ctx.injected[0]["kind"] == "hb-stall"
+
+
+# ---------------------------------------------------------------------------
+# rpc hardening: exponential backoff + full jitter + overall deadline
+# ---------------------------------------------------------------------------
+def _dead_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]  # closed on exit → connection refused
+
+
+class TestRetryBackoff:
+    def test_exponential_backoff_with_jitter_and_cap(self, monkeypatch):
+        sleeps = []
+        import tony_tpu.cluster.rpc as rpc_mod
+
+        monkeypatch.setattr(rpc_mod.time, "sleep", sleeps.append)
+        c = RpcClient("127.0.0.1", _dead_port())
+        with pytest.raises(RpcError, match="failed after 8 retries"):
+            c.call_with_retry("ping", retries=8, delay_s=0.05, max_delay_s=0.4)
+        assert len(sleeps) == 7  # no sleep after the final attempt
+        for i, s in enumerate(sleeps):
+            assert 0 <= s <= min(0.4, 0.05 * 2**i) + 1e-9
+
+    def test_overall_deadline_bounds_wall_time(self):
+        c = RpcClient("127.0.0.1", _dead_port())
+        t0 = time.monotonic()
+        with pytest.raises(RpcError, match="deadline"):
+            c.call_with_retry("ping", retries=10_000, delay_s=0.01, deadline_s=0.3)
+        assert time.monotonic() - t0 < 5
+
+    def test_success_path_unchanged(self):
+        srv = RpcServer()
+        srv.register("ping", lambda: "pong")
+        srv.start()
+        try:
+            host, port = srv.address
+            assert RpcClient(host, port).call_with_retry("ping", retries=3) == "pong"
+        finally:
+            srv.stop()
+
+
+class TestRpcChaos:
+    @pytest.fixture()
+    def server(self):
+        srv = RpcServer()
+        srv.register("echo", lambda **kw: kw)
+        srv.start()
+        yield srv
+        srv.stop()
+
+    def _client(self, server, spec, seed=0):
+        host, port = server.address
+        return RpcClient(host, port, chaos=ctx_for(spec, seed))
+
+    def test_drop_fails_the_call(self, server):
+        c = self._client(server, "rpc-drop:p=1")
+        with pytest.raises(ConnectionError, match="chaos rpc-drop"):
+            c.call("echo", a=1)
+
+    def test_delay_is_injected_but_call_succeeds(self, server):
+        c = self._client(server, "rpc-delay:p=1:ms=10")
+        assert c.call("echo", a=1) == {"a": 1}
+        assert [r["kind"] for r in c.chaos.injected] == ["rpc-delay"]
+
+    def test_sever_loses_response_and_reconnect_recovers(self, server):
+        # p=0.5: some calls get severed mid-call; retry must always recover
+        c = self._client(server, "rpc-sever:p=0.5", seed=3)
+        for i in range(20):
+            assert c.call_with_retry("echo", retries=10, delay_s=0.01, i=i) == {"i": i}
+        assert any(r["kind"] == "rpc-sever" for r in c.chaos.injected)
+
+    def test_retry_rides_through_seeded_drops(self, server):
+        c = self._client(server, "rpc-drop:p=0.5", seed=11)
+        for i in range(10):
+            assert c.call_with_retry("echo", retries=30, delay_s=0.01, i=i) == {"i": i}
+        assert any(r["kind"] == "rpc-drop" for r in c.chaos.injected)
+
+
+# ---------------------------------------------------------------------------
+# container faults at the RM poll_exited seam
+# ---------------------------------------------------------------------------
+class _FakeRM:
+    def __init__(self, containers):
+        self.live = containers
+        self.killed = []
+
+    def _live_containers(self):
+        return self.live
+
+    def kill_container(self, c):
+        self.killed.append(c.id)
+
+
+def _container(cid, job, idx):
+    from tony_tpu.cluster.resources import Container, Resources
+
+    return Container(id=cid, host="h", resources=Resources(), job_type=job, task_index=idx)
+
+
+class TestContainerFaults:
+    def test_node_loss_respects_target(self):
+        rm = _FakeRM([_container("c0", "worker", 0), _container("c1", "worker", 1)])
+        exits = ctx_for("node-loss:worker:1").perturb_container_exits(rm, {})
+        assert exits == {"c1": constants.EXIT_NODE_LOST}
+        assert rm.killed == ["c1"]
+
+    def test_untargeted_node_loss_kills_all(self):
+        rm = _FakeRM([_container("c0", "worker", 0), _container("c1", "ps", 0)])
+        exits = ctx_for("node-loss").perturb_container_exits(rm, {})
+        assert exits == {"c0": constants.EXIT_NODE_LOST, "c1": constants.EXIT_NODE_LOST}
+
+    def test_preempt_targets_and_is_budget_exempt_code(self):
+        rm = _FakeRM([_container("c0", "worker", 0), _container("c1", "worker", 1)])
+        exits = ctx_for("preempt:worker:0").perturb_container_exits(rm, {})
+        assert exits == {"c0": constants.EXIT_PREEMPTED}
+
+
+# ---------------------------------------------------------------------------
+# stale-epoch fencing: get_cluster_spec now fenced like every executor RPC
+# ---------------------------------------------------------------------------
+class TestStaleEpochFencing:
+    def test_spec_fenced_by_gang_epoch(self, tmp_path):
+        from tony_tpu.cluster.appmaster import ApplicationMaster
+
+        cfg = TonyConfig({"tony.worker.instances": "1"})
+        am = ApplicationMaster(cfg, "app_fence_test", str(tmp_path / "stage"))
+        try:
+            am.register_worker_spec("worker", 0, "127.0.0.1", 1234, attempt=0)
+            resp = am.get_cluster_spec("worker", 0, attempt=0)
+            assert resp["spec"] == {"worker": ["127.0.0.1:1234"]}
+            # a gang restart bumps the epoch; the old executor's identity recurs
+            am._restart_attempt = 1
+            resp = am.get_cluster_spec("worker", 0, attempt=0)
+            assert resp == {"spec": None, "stale": True}
+            assert am.register_execution_result("worker", 0, exit_code=0, attempt=0)["stale"]
+            assert am.task_executor_heartbeat("worker", 0, attempt=0)["stale"]
+        finally:
+            am.rpc.stop()
+            am.events.stop()
+            am.rm.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# corrupt-checkpoint fallback (restore_or_init hardening)
+# ---------------------------------------------------------------------------
+class TestCheckpointFallback:
+    @staticmethod
+    def _save_steps(d, steps):
+        import jax.numpy as jnp
+
+        from tony_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(d, use_async=False)
+        for s in steps:
+            mgr.save(s, {"w": jnp.full((4,), float(s))}, force=True)
+        mgr.wait()
+        mgr.close()
+
+    def test_falls_back_to_newest_intact_step(self, tmp_path):
+        import jax.numpy as jnp
+
+        from tony_tpu.train.checkpoint import restore_or_init
+
+        d = str(tmp_path / "ckpt")
+        self._save_steps(d, [1, 2])
+        assert corrupt_latest_checkpoint(d) == 2
+        state, mgr, step = restore_or_init(d, lambda: {"w": jnp.zeros((4,))})
+        try:
+            assert step == 1
+            assert float(state["w"][0]) == 1.0
+            # the torn step is quarantined: latest_step no longer reports it
+            assert mgr.latest_step() == 1
+            assert os.path.isdir(os.path.join(d, ".corrupt-2"))
+        finally:
+            mgr.close()
+
+    def test_quarantine_race_with_peer_worker_is_benign(self, tmp_path):
+        # gang workers share the ckpt dir and quarantine concurrently: losing
+        # the rename race (src already gone) must not crash the worker
+        from tony_tpu.train.checkpoint import _quarantine_step
+
+        d = tmp_path / "ckpt"
+        (d / "4").mkdir(parents=True)
+        _quarantine_step(str(d), 4)
+        assert (d / ".corrupt-4").is_dir()
+        _quarantine_step(str(d), 4)  # peer already moved it: no-op, no raise
+
+    def test_quarantine_replaces_stale_quarantine_dir(self, tmp_path):
+        from tony_tpu.train.checkpoint import _quarantine_step
+
+        d = tmp_path / "ckpt"
+        (d / ".corrupt-4" / "old").mkdir(parents=True)  # leftover, non-empty
+        (d / "4").mkdir()
+        _quarantine_step(str(d), 4)
+        assert not (d / "4").exists()
+        assert not (d / ".corrupt-4" / "old").exists()
+
+    def test_all_corrupt_initializes_fresh(self, tmp_path):
+        import jax.numpy as jnp
+
+        from tony_tpu.train.checkpoint import restore_or_init
+
+        d = str(tmp_path / "ckpt")
+        self._save_steps(d, [1])
+        corrupt_latest_checkpoint(d, mode="garbage")
+        state, mgr, step = restore_or_init(d, lambda: {"w": jnp.zeros((4,))})
+        try:
+            assert step == 0
+            assert float(state["w"][0]) == 0.0
+        finally:
+            mgr.close()
+
+    def test_env_gated_injection_tears_latest(self, tmp_path, monkeypatch):
+        from tony_tpu.chaos import maybe_corrupt_checkpoint
+
+        d = str(tmp_path / "ckpt")
+        self._save_steps(d, [3])
+        # no schedule in env → strict no-op
+        assert maybe_corrupt_checkpoint(d) is None
+        monkeypatch.setenv(constants.ENV_CHAOS_SPEC, "ckpt-corrupt:latest")
+        monkeypatch.setenv(constants.ENV_CHAOS_SEED, "5")
+        monkeypatch.setenv(constants.ENV_STAGING_DIR, str(tmp_path))
+        assert maybe_corrupt_checkpoint(d) == 3
+        # once per job: the latch is spent
+        assert maybe_corrupt_checkpoint(d) is None
+
+
+# ---------------------------------------------------------------------------
+# recovery-path E2E: one fault per path (fast, tier-1)
+# ---------------------------------------------------------------------------
+@pytest.mark.e2e
+class TestChaosRecoveryE2E:
+    def test_heartbeat_stall_triggers_gang_restart(self, tmp_tony_root):
+        # attempt 0 is wedged by hb-stall → LOST → whole-gang restart; the
+        # once-per-job latch keeps attempt 1 healthy and it exits 0
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {
+                "tony.worker.instances": "1",
+                keys.EXECUTES: fixture_cmd("lost_then_ok.py"),
+                keys.TASK_MAX_MISSED_HEARTBEATS: "3",
+                keys.TASK_RESTART_ON_FAILURE: "true",
+                keys.CHAOS_SPEC: "hb-stall:worker:0",
+                keys.CHAOS_SEED: "13",
+            },
+        )
+        assert final == JobStatus.SUCCEEDED, handle.final_status()
+        assert handle.final_status()["restart_attempt"] == 1
+
+    def test_execution_timeout_gets_own_exit_code(self, tmp_tony_root):
+        final, _, handle = run_job(
+            tmp_tony_root,
+            {
+                "tony.worker.instances": "1",
+                keys.EXECUTES: fixture_cmd("forever.py"),
+                keys.TASK_EXECUTOR_EXECUTION_TIMEOUT_MS: "1000",
+            },
+        )
+        assert final == JobStatus.FAILED
+        task = handle.final_status()["tasks"][0]
+        assert task["exit_code"] == constants.EXIT_EXECUTION_TIMEOUT
+        history_root = os.path.join(str(tmp_tony_root), "history")
+        finished = [
+            e for e in history.read_events(history_root, handle.app_id)
+            if e.type.value == "TASK_FINISHED"
+            and e.payload.get("exit_code") == constants.EXIT_EXECUTION_TIMEOUT
+        ]
+        assert finished and "execution timeout" in finished[0].payload["reason"]
+
+    def test_chaos_cli_asserts_invariants(self, tmp_tony_root, capsys):
+        from tony_tpu.cli.chaos import main as chaos_main
+
+        rc = chaos_main([
+            "--spec", "rpc-delay:p=0.3:ms=5",
+            "--seed", "11",
+            "--executes", fixture_cmd("exit_0.py"),
+            "--workers", "1",
+            "--conf", f"{keys.STAGING_ROOT}={tmp_tony_root}",
+        ] + [f"--conf={k}={v}" for k, v in FAST.items()])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "invariants: OK" in out
+        assert "gang epochs: 1" in out
+
+
+# ---------------------------------------------------------------------------
+# seeded multi-fault soak (slow): crash + torn checkpoint + rpc noise
+# ---------------------------------------------------------------------------
+@pytest.mark.e2e
+@pytest.mark.slow
+class TestMultiFaultSoak:
+    def test_soak_resumes_through_torn_checkpoint(self, tmp_tony_root):
+        from tony_tpu.cli.chaos import _find_orphans, verify_chaos_run
+
+        spec = "rpc-drop:p=0.02;ckpt-corrupt:latest"
+        cfg = TonyConfig({
+            **FAST,
+            keys.STAGING_ROOT: str(tmp_tony_root),
+            "tony.worker.instances": "1",
+            keys.EXECUTES: fixture_cmd("chaos_train.py"),
+            keys.TASK_RESTART_ON_FAILURE: "true",
+            keys.TASK_MAX_MISSED_HEARTBEATS: "100",  # jax compile outlasts the fast hb budget
+            keys.CHAOS_SPEC: spec,
+            keys.CHAOS_SEED: "20260803",
+        })
+        from tony_tpu.cluster.client import Client
+
+        client = Client(cfg)
+        handle = client.submit()
+        final = client.monitor_application(handle, quiet=True)
+        assert final == JobStatus.SUCCEEDED, handle.final_status()
+
+        # the relaunched attempt fell back past the torn step 4 to step 2
+        log = os.path.join(str(tmp_tony_root), handle.app_id, "logs", "worker_0_r1", "stdout.log")
+        with open(log) as f:
+            out = f.read()
+        assert "resumed from checkpoint step 2" in out, out
+        assert "soak resume run completed to step 8" in out, out
+
+        failures, info = verify_chaos_run(handle, cfg)
+        assert not failures, failures
+        assert info["gang_epochs"] == 2
+        assert not _find_orphans(handle.app_id)

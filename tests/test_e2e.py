@@ -172,15 +172,17 @@ class TestTorchRuntimeDataPlane:
 
 @pytest.mark.e2e
 class TestFailureDetection:
-    def test_heartbeat_loss_marks_task_lost(self, tmp_tony_root, monkeypatch):
-        # fault injection: executor suppresses heartbeats → AM must declare LOST
-        monkeypatch.setenv("TONY_TEST_SUPPRESS_HEARTBEAT", "1")
+    def test_heartbeat_loss_marks_task_lost(self, tmp_tony_root):
+        # chaos fault injection (tony.chaos.*): the hb-stall fault wedges the
+        # executor — heartbeats stop while its process lives → AM declares LOST
         final, _, handle = run_job(
             tmp_tony_root,
             {
                 "tony.worker.instances": "1",
                 keys.EXECUTES: fixture_cmd("forever.py"),
                 keys.TASK_MAX_MISSED_HEARTBEATS: "3",
+                keys.CHAOS_SPEC: "hb-stall:worker:0",
+                keys.CHAOS_SEED: "7",
             },
         )
         assert final == JobStatus.FAILED

@@ -1,0 +1,22 @@
+"""Deterministic, seed-driven fault injection for the cluster control plane.
+
+Chaos-engineering discipline (Basiri et al., IEEE Software 2016) applied to
+the TonY recovery machinery: a ``FaultSchedule`` parsed from
+``tony.chaos.spec`` drives seeded injection points wired into the real code
+paths (rpc, executor, resource managers, checkpoint restore). Everything is a
+no-op unless a schedule is configured. See docs/fault-tolerance.md.
+"""
+
+from tony_tpu.chaos.context import ChaosContext
+from tony_tpu.chaos.inject import corrupt_latest_checkpoint, maybe_corrupt_checkpoint
+from tony_tpu.chaos.schedule import CONTAINER_FAULTS, FAULT_KINDS, FaultSchedule, FaultSpec
+
+__all__ = [
+    "ChaosContext",
+    "FaultSchedule",
+    "FaultSpec",
+    "FAULT_KINDS",
+    "CONTAINER_FAULTS",
+    "corrupt_latest_checkpoint",
+    "maybe_corrupt_checkpoint",
+]

@@ -1,0 +1,229 @@
+"""Per-process chaos runtime: seeded decisions, once-latches, injection log.
+
+Every process in a chaos run (AM, each executor supervisor, each training
+child) builds one :class:`ChaosContext` from the frozen config (control-plane
+processes) or from the ``TONY_CHAOS_*`` env contract (the training child).
+``from_config``/``from_env`` return ``None`` when no schedule is configured,
+and every injection point guards on that — the production path pays one
+``is None`` check and nothing else.
+
+Determinism: each (seed, identity, kind) triple derives its own PRNG, so a
+process's decision stream for a fault kind is a pure function of the run seed
+and the order of its own queries — re-running the same schedule with the same
+seed reproduces the same injected-fault sequence (asserted in
+tests/test_chaos.py).
+
+Once-semantics: probability faults (``p=``) draw on every query and never
+latch. All other faults fire **once per job**, latched through a marker file
+under ``<staging>/chaos/fired/`` so the latch survives gang restarts — an
+``exec-crash`` must kill attempt 0, not every attempt forever.
+
+Every injection is appended to ``<staging>/chaos/injections-<identity>.jsonl``
+(and to the in-memory ``injected`` list) so ``tony chaos`` can report exactly
+what a run suffered.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Mapping
+
+from tony_tpu import constants
+from tony_tpu.chaos.schedule import CONTAINER_FAULTS, FaultSchedule, FaultSpec
+
+
+class ChaosContext:
+    def __init__(self, schedule: FaultSchedule, identity: str, staging_dir: str | None = None):
+        self.schedule = schedule
+        self.identity = identity
+        self.task = _parse_task(identity)
+        self.injected: list[dict[str, Any]] = []
+        self._staging = staging_dir
+        self._started = time.monotonic()
+        self._lock = threading.Lock()
+        self._rngs: dict[str, random.Random] = {}
+        self._latched: set[str] = set()
+        self._log_path: str | None = None
+        if staging_dir:
+            log_dir = os.path.join(staging_dir, "chaos")
+            try:
+                os.makedirs(log_dir, exist_ok=True)
+                self._log_path = os.path.join(
+                    log_dir, f"injections-{identity.replace(':', '_').replace(os.sep, '_')}.jsonl"
+                )
+            except OSError:
+                self._log_path = None  # chaos logging is best-effort, never fatal
+
+    # ------------------------------------------------------------ factories
+    @classmethod
+    def from_config(cls, config, identity: str, staging_dir: str | None = None) -> "ChaosContext | None":
+        """Build from the frozen job config; None when chaos is not configured."""
+        from tony_tpu.config import keys
+
+        spec = config.get(keys.CHAOS_SPEC) or ""
+        if not spec.strip():
+            return None
+        return cls(FaultSchedule.parse(spec, config.get_int(keys.CHAOS_SEED, 0)), identity, staging_dir)
+
+    @classmethod
+    def from_env(cls, env: Mapping[str, str] | None = None) -> "ChaosContext | None":
+        """Build from the child-process env contract (TONY_CHAOS_SPEC/SEED)."""
+        env = os.environ if env is None else env
+        spec = env.get(constants.ENV_CHAOS_SPEC, "")
+        if not spec.strip():
+            return None
+        try:
+            seed = int(env.get(constants.ENV_CHAOS_SEED, "0") or 0)
+        except ValueError:
+            seed = 0
+        job = env.get(constants.ENV_JOB_NAME)
+        idx = env.get(constants.ENV_TASK_INDEX)
+        identity = f"{job}:{idx}" if job and idx is not None else "proc"
+        return cls(FaultSchedule.parse(spec, seed), identity, staging_dir=env.get(constants.ENV_STAGING_DIR) or None)
+
+    # ------------------------------------------------------------- decisions
+    def elapsed_ms(self) -> float:
+        return (time.monotonic() - self._started) * 1000
+
+    def take(self, kind: str, trigger: str | None = None, detail: dict[str, Any] | None = None) -> FaultSpec | None:
+        """The single decision gate: the first armed fault of ``kind`` at this
+        lifecycle point, or None. A returned fault has been recorded (and, for
+        non-probability faults, latched once-per-job) — apply it."""
+        for f in self.schedule.faults:
+            if f.kind != kind or f.trigger != trigger:
+                continue
+            got = self.take_spec(f, detail=detail)
+            if got is not None:
+                return got
+        return None
+
+    def take_spec(self, f: FaultSpec, detail: dict[str, Any] | None = None) -> FaultSpec | None:
+        """Gate one specific fault: target match, time-arming, probability
+        draw, once-latch. (Container-fault targets name the victim container,
+        checked by the applier, not the injecting process.)"""
+        if f.kind not in CONTAINER_FAULTS and f.target is not None and f.target != self.task:
+            return None
+        with self._lock:
+            if f.delay_ms and self.elapsed_ms() < f.delay_ms:
+                return None
+            p = f.params.get("p")
+            if p is not None:
+                if self._rng_locked(f.kind).random() >= p:
+                    return None
+            else:
+                if f.key in self._latched or not self._latch_global_locked(f):
+                    return None
+                self._latched.add(f.key)
+            self._record_locked(f, detail)
+            return f
+
+    def _rng_locked(self, kind: str) -> random.Random:
+        r = self._rngs.get(kind)
+        if r is None:
+            h = hashlib.sha256(f"{self.schedule.seed}:{self.identity}:{kind}".encode()).digest()
+            r = self._rngs[kind] = random.Random(int.from_bytes(h[:8], "big"))
+        return r
+
+    def _latch_global_locked(self, f: FaultSpec) -> bool:
+        """Once-per-JOB latch: a marker under <staging>/chaos/fired/ shared by
+        every process and every gang attempt. True exactly once."""
+        if not self._staging:
+            return True  # no shared dir: in-process latch only
+        path = os.path.join(
+            self._staging, "chaos", "fired", hashlib.sha1(f.key.encode()).hexdigest()
+        )
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        except OSError:
+            return True  # unwritable staging: degrade to the in-process latch
+        with os.fdopen(fd, "w") as fh:
+            fh.write(f"{self.identity} {int(time.time() * 1000)}\n")
+        return True
+
+    def _record_locked(self, f: FaultSpec, detail: dict[str, Any] | None) -> None:
+        rec = {
+            "ts_ms": int(time.time() * 1000),
+            "identity": self.identity,
+            "kind": f.kind,
+            "fault": f.key,
+        }
+        if detail:
+            rec.update(detail)
+        self.injected.append(rec)
+        if self._log_path:
+            try:
+                with open(self._log_path, "a") as fh:
+                    fh.write(json.dumps(rec) + "\n")
+            except OSError:
+                pass
+
+    # ------------------------------------------------------ rpc client seam
+    def rpc_before_send(self, method: str, timeout_s: float) -> None:
+        """Outbound-call faults, applied inside RpcClient.call's attempt loop.
+        Raises ConnectionError/TimeoutError to simulate the failure."""
+        f = self.take("rpc-delay", detail={"method": method})
+        if f is not None:
+            time.sleep(f.ms(default=200) / 1000)
+        f = self.take("rpc-drop", detail={"method": method})
+        if f is not None:
+            raise ConnectionResetError(f"chaos rpc-drop: {method}")
+        f = self.take("rpc-blackhole", detail={"method": method})
+        if f is not None:
+            time.sleep(min(f.ms(default=int(timeout_s * 1000)) / 1000, timeout_s))
+            raise TimeoutError(f"chaos rpc-blackhole: {method}")
+
+    def rpc_sever_after_send(self, method: str) -> bool:
+        """True → the caller closes the socket after sending, losing the
+        response mid-call (the server may have executed the method)."""
+        return self.take("rpc-sever", detail={"method": method}) is not None
+
+    # ------------------------------------------ resource-manager (AM) seam
+    def perturb_container_exits(self, rm, exits: dict[str, int]) -> dict[str, int]:
+        """node-loss / preempt faults applied at the RM's poll_exited seam:
+        victims are killed through the real kill path and surface as synthetic
+        exit codes, exactly as a dead node / pool preemption would."""
+        live = rm._live_containers()
+        if not live:
+            return exits
+        for f in self.schedule.of_kind("node-loss"):
+            victims = [
+                c for c in live
+                if f.target is None or (c.job_type, c.task_index) == f.target
+            ]
+            if not victims:
+                continue
+            got = self.take_spec(f, detail={"containers": [c.id for c in victims]})
+            if got is None:
+                continue
+            for c in victims:
+                rm.kill_container(c)
+                exits.setdefault(c.id, constants.EXIT_NODE_LOST)
+        for f in self.schedule.of_kind("preempt"):
+            victims = [
+                c for c in live
+                if f.target is None or (c.job_type, c.task_index) == f.target
+            ]
+            if not victims:
+                continue
+            got = self.take_spec(f, detail={"containers": [c.id for c in victims]})
+            if got is None:
+                continue
+            for c in victims:
+                rm.kill_container(c)
+                exits.setdefault(c.id, constants.EXIT_PREEMPTED)
+        return exits
+
+
+def _parse_task(identity: str) -> tuple[str, int] | None:
+    job, _, idx = identity.partition(":")
+    if job and idx.isdigit():
+        return (job, int(idx))
+    return None

@@ -1,0 +1,62 @@
+"""Artifact-level injections: checkpoint corruption.
+
+``corrupt_latest_checkpoint`` tears the newest step of an Orbax checkpoint
+directory the way a crash mid-write would (files truncated to zero, or
+garbled with ``mode="garbage"``). ``maybe_corrupt_checkpoint`` is the
+env-gated hook ``restore_or_init`` calls before its first restore: a no-op
+unless the process carries a ``ckpt-corrupt`` fault, in which case the tear
+happens exactly once per job (the chaos once-latch) and the hardened restore
+path must fall back to the newest intact step.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tony_tpu.chaos.context import ChaosContext
+
+
+def _step_dirs(directory: str) -> list[int]:
+    try:
+        return sorted(int(name) for name in os.listdir(directory) if name.isdigit())
+    except OSError:
+        return []
+
+
+def corrupt_latest_checkpoint(directory: str, mode: str = "truncate") -> int | None:
+    """Tear every file of the newest step dir; returns the step, or None when
+    there is nothing to corrupt."""
+    steps = _step_dirs(directory)
+    if not steps:
+        return None
+    step = steps[-1]
+    root = os.path.join(directory, str(step))
+    for dirpath, _, files in os.walk(root):
+        for fn in files:
+            path = os.path.join(dirpath, fn)
+            try:
+                if mode == "garbage":
+                    with open(path, "wb") as fh:
+                        fh.write(b"\xde\xad\xbe\xef")
+                else:
+                    with open(path, "wb"):
+                        pass  # truncate to zero: a torn in-flight write
+            except OSError:
+                continue
+    return step
+
+
+def maybe_corrupt_checkpoint(directory: str) -> int | None:
+    """The restore_or_init injection point. Fires the armed ``ckpt-corrupt``
+    fault (env contract: TONY_CHAOS_SPEC/SEED) against ``directory`` when a
+    checkpoint exists to corrupt; returns the torn step or None."""
+    ctx = ChaosContext.from_env()
+    if ctx is None:
+        return None
+    if not _step_dirs(directory):
+        return None  # nothing to corrupt yet: don't spend the once-per-job latch
+    f = ctx.take("ckpt-corrupt", detail={"directory": directory})
+    if f is None:
+        return None
+    mode = f.args[1] if len(f.args) > 1 else "truncate"
+    return corrupt_latest_checkpoint(directory, mode=mode)

@@ -1,0 +1,145 @@
+"""Fault-schedule grammar for the deterministic chaos layer.
+
+A schedule (``tony.chaos.spec``) is a ``;``-separated list of fault entries:
+
+    rpc-drop:p=0.05;exec-crash:worker:1@gang_complete;hb-stall:worker:0@t+5s;ckpt-corrupt:latest
+
+Each entry is ``kind[:<job>:<index>][:k=v ...][:arg ...][@trigger]`` where
+
+- ``kind`` is one of :data:`FAULT_KINDS`;
+- ``<job>:<index>`` targets one task (``worker:1``); untargeted faults apply
+  to any matching process (or, for container faults, every live container);
+- ``k=v`` tokens are numeric parameters (``p`` = per-event probability,
+  ``ms`` = duration);
+- bare tokens are positional arguments (``ckpt-corrupt:latest``);
+- ``@t+5s`` arms the fault 5 s after the injecting process starts;
+  ``@gang_complete`` / ``@registered`` tie it to a lifecycle point instead.
+
+Entries parse to :class:`FaultSpec` rows inside a :class:`FaultSchedule`
+carrying the run's seed — the pair (spec string, seed) fully determines every
+injection decision (see context.py), which is what makes a chaos run
+reproducible.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from tony_tpu.config.config import parse_time_ms
+
+#: Every fault kind the injection points understand.
+FAULT_KINDS = frozenset({
+    # cluster/rpc.py — client-side frame faults
+    "rpc-drop",        # the frame never leaves: the call fails with ConnectionError
+    "rpc-delay",       # the call is delayed by `ms` before being sent
+    "rpc-blackhole",   # sent into the void: blocks ~the socket timeout, then times out
+    "rpc-sever",       # connection closed after send, before the response arrives
+    # cluster/executor.py — supervisor/child faults
+    "exec-crash",      # the executor dies abruptly (container crash)
+    "exec-hang",       # the child is SIGSTOPped (or the barrier wedges pre-child)
+    "hb-stall",        # heartbeats stop while the process lives (wedged executor)
+    "reg-slow",        # registration delayed by `ms`
+    # cluster/resources.py + cluster/pool.py — container/pool faults
+    "node-loss",       # every live container dies with EXIT_NODE_LOST
+    "preempt",         # targeted containers die with EXIT_PREEMPTED (budget-exempt)
+    "capacity-flap",   # a capacity probe sees an empty pool (downsize hysteresis test)
+    # train/checkpoint.py — artifact faults
+    "ckpt-corrupt",    # the newest checkpoint is torn (truncated/garbled) before restore
+})
+
+#: Kinds whose target names the *victim container*, not the injecting process
+#: (the AM applies them at the ResourceManager seam).
+CONTAINER_FAULTS = frozenset({"node-loss", "preempt"})
+
+_TARGET_JOB = re.compile(r"^[A-Za-z][A-Za-z0-9_\-]*$")
+
+
+@dataclass
+class FaultSpec:
+    """One parsed fault entry."""
+
+    kind: str
+    target: tuple[str, int] | None = None  # (job_type, index); None = any
+    trigger: str | None = None             # lifecycle point ("gang_complete", ...)
+    delay_ms: int = 0                      # from "@t+5s": armed this long after process start
+    args: tuple[str, ...] = ()             # positional tokens ("latest", ...)
+    params: dict[str, float] = field(default_factory=dict)  # k=v tokens (p, ms, ...)
+    entry: str = ""                        # the original entry text (canonical key)
+
+    @property
+    def key(self) -> str:
+        """Stable identity used for once-per-job latches and injection logs."""
+        return self.entry or self.kind
+
+    def ms(self, default: int) -> int:
+        """The `ms` duration parameter, defaulted."""
+        v = self.params.get("ms")
+        return int(v) if v is not None else default
+
+
+def _parse_entry(entry: str) -> FaultSpec:
+    text = entry.strip()
+    body, trigger, delay_ms = text, None, 0
+    at = text.rfind("@")
+    if at != -1:
+        body, trig = text[:at], text[at + 1:].strip()
+        if trig.startswith("t+"):
+            delay_ms = parse_time_ms(trig[2:])
+        elif trig:
+            trigger = trig
+        else:
+            raise ValueError(f"empty trigger in fault entry {text!r}")
+    tokens = [t.strip() for t in body.split(":")]
+    kind = tokens[0]
+    if kind not in FAULT_KINDS:
+        raise ValueError(
+            f"unknown fault kind {kind!r} in {text!r} (known: {', '.join(sorted(FAULT_KINDS))})"
+        )
+    target: tuple[str, int] | None = None
+    args: list[str] = []
+    params: dict[str, float] = {}
+    rest = tokens[1:]
+    i = 0
+    while i < len(rest):
+        tok = rest[i]
+        if "=" in tok:
+            k, _, v = tok.partition("=")
+            try:
+                params[k] = float(v)
+            except ValueError:
+                raise ValueError(f"non-numeric parameter {tok!r} in fault entry {text!r}") from None
+        elif (
+            target is None
+            and i + 1 < len(rest)
+            and rest[i + 1].isdigit()
+            and _TARGET_JOB.match(tok)
+        ):
+            target = (tok, int(rest[i + 1]))
+            i += 1
+        elif tok:
+            args.append(tok)
+        i += 1
+    p = params.get("p")
+    if p is not None and not 0 <= p <= 1:
+        raise ValueError(f"probability p={p} out of [0, 1] in fault entry {text!r}")
+    return FaultSpec(kind, target, trigger, delay_ms, tuple(args), params, entry=text)
+
+
+@dataclass
+class FaultSchedule:
+    """The parsed ``tony.chaos.spec`` plus the run seed."""
+
+    faults: tuple[FaultSpec, ...]
+    seed: int = 0
+    spec: str = ""
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultSchedule":
+        faults = tuple(
+            _parse_entry(e) for e in (spec or "").split(";") if e.strip()
+        )
+        return cls(faults=faults, seed=seed, spec=spec or "")
+
+    def of_kind(self, kind: str) -> tuple[FaultSpec, ...]:
+        return tuple(f for f in self.faults if f.kind == kind)

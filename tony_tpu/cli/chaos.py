@@ -1,0 +1,210 @@
+"""``tony chaos``: run a job under a deterministic fault schedule and assert
+job-level invariants afterwards.
+
+The chaos-engineering loop (docs/fault-tolerance.md): pick a fault schedule
+and a seed, run the job, and let the tool check what must ALWAYS hold, faults
+or not:
+
+- the job reaches a clean final verdict (SUCCEEDED / FAILED / KILLED, with a
+  finalized ``am_status.json``);
+- no orphan processes survive the job (nothing on this host still carries the
+  app id in its environment);
+- ``on_gang_complete`` fired exactly once per gang epoch (rank assignment is
+  not idempotent);
+- the ``.jhist`` history file was finalized into ``finished/``;
+- (with ``--expect-resume``) a restarted gang resumed from a checkpoint.
+
+Re-running with the same ``--spec`` and ``--seed`` reproduces the same
+injected-fault sequence; the per-process injection logs under
+``<staging>/chaos/`` show exactly what the run suffered.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any
+
+from tony_tpu.chaos import FaultSchedule
+from tony_tpu.config import TonyConfig, keys
+
+
+def verify_chaos_run(handle, config: TonyConfig) -> tuple[list[str], dict[str, Any]]:
+    """Check the job-level invariants; returns (failures, report_info)."""
+    from tony_tpu.cluster import history
+
+    failures: list[str] = []
+    info: dict[str, Any] = {}
+
+    status = handle.final_status()
+    if status is None:
+        failures.append("no final status: the AM never wrote am_status.json")
+        return failures, info
+    info["status"] = status.get("status")
+    if status.get("status") not in ("SUCCEEDED", "FAILED", "KILLED"):
+        failures.append(f"unclean final verdict: {status.get('status')!r}")
+
+    orphans = _find_orphans(handle.app_id)
+    info["orphans"] = orphans
+    if orphans:
+        failures.append(f"orphan processes survived the job: pids {orphans}")
+
+    history_root = config.get(keys.HISTORY_LOCATION) or os.path.join(
+        os.path.dirname(handle.staging_dir.rstrip("/")), "history"
+    )
+    jobs = {j.app_id for j in history.list_finished_jobs(history_root)}
+    if handle.app_id not in jobs:
+        failures.append("history .jhist was not finalized into finished/")
+    else:
+        events = history.read_events(history_root, handle.app_id)
+        epochs, completes_this_epoch = 1, 0
+        for ev in events:
+            if ev.type.value == "GANG_COMPLETE":
+                completes_this_epoch += 1
+                if completes_this_epoch > 1:
+                    failures.append(
+                        f"on_gang_complete fired {completes_this_epoch} times in gang epoch {epochs - 1}"
+                    )
+            elif ev.type.value == "HEARTBEAT_LOST" and str(
+                ev.payload.get("reason", "")
+            ).startswith("gang restart"):
+                epochs += 1
+                completes_this_epoch = 0
+        info["gang_epochs"] = epochs
+
+    resumed = _resumed_steps(handle.staging_dir)
+    info["resumed_steps"] = resumed
+    return failures, info
+
+
+def _find_orphans(app_id: str, settle_s: float = 3.0) -> list[int]:
+    """Pids (other than ours) whose environment still carries this app id —
+    processes the teardown should have reaped. /proc scan; skipped silently
+    on hosts without it."""
+    if not os.path.isdir("/proc"):
+        return []
+    needle = f"TONY_APP_ID={app_id}".encode()
+    deadline = time.monotonic() + settle_s
+    while True:
+        orphans = []
+        for name in os.listdir("/proc"):
+            if not name.isdigit() or int(name) == os.getpid():
+                continue
+            try:
+                with open(f"/proc/{name}/environ", "rb") as f:
+                    if needle in f.read():
+                        orphans.append(int(name))
+            except OSError:
+                continue
+        if not orphans or time.monotonic() > deadline:
+            return orphans
+        time.sleep(0.2)  # give SIGTERM grace windows a moment to finish
+
+
+def _resumed_steps(staging_dir: str) -> list[int]:
+    """Checkpoint-resume evidence from task stdout logs ("resumed from
+    checkpoint step N", printed by the training loop)."""
+    steps = []
+    for dirpath, _, files in os.walk(os.path.join(staging_dir, "logs")):
+        for fn in files:
+            if fn != "stdout.log":
+                continue
+            try:
+                with open(os.path.join(dirpath, fn), errors="replace") as f:
+                    for line in f:
+                        if "resumed from checkpoint step" in line:
+                            steps.append(int(line.rsplit("step", 1)[1].strip()))
+            except (OSError, ValueError):
+                continue
+    return sorted(steps)
+
+
+def _injection_report(staging_dir: str) -> dict[str, int]:
+    """kind → count over every process's injection log."""
+    counts: dict[str, int] = {}
+    chaos_dir = os.path.join(staging_dir, "chaos")
+    if not os.path.isdir(chaos_dir):
+        return counts
+    for fn in sorted(os.listdir(chaos_dir)):
+        if not fn.endswith(".jsonl"):
+            continue
+        try:
+            with open(os.path.join(chaos_dir, fn)) as f:
+                for line in f:
+                    try:
+                        kind = json.loads(line).get("kind", "?")
+                    except ValueError:
+                        continue
+                    counts[kind] = counts.get(kind, 0) + 1
+        except OSError:
+            continue
+    return counts
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tony chaos",
+        description="run a job under a deterministic fault schedule and assert job-level invariants",
+    )
+    p.add_argument("--spec", required=True,
+                   help='fault schedule, e.g. "rpc-drop:p=0.05;exec-crash:worker:1@gang_complete"')
+    p.add_argument("--seed", type=int, default=0,
+                   help="injection PRNG seed: same spec+seed reproduces the same fault sequence")
+    p.add_argument("--executes", help="command to run in each task container")
+    p.add_argument("--conf_file", help="job config file (json/toml/hadoop-xml)")
+    p.add_argument("--conf", action="append", default=[], help="key=value override (repeatable)")
+    p.add_argument("--workers", type=int, default=0, help="shortcut for worker instance count")
+    p.add_argument("--expect-resume", action="store_true",
+                   help="fail unless a restarted gang resumed from a checkpoint")
+    args = p.parse_args(argv)
+
+    try:
+        FaultSchedule.parse(args.spec, args.seed)  # validate the grammar before submitting
+    except ValueError as e:
+        print(f"tony chaos: bad --spec: {e}", file=sys.stderr)
+        return 2
+
+    from tony_tpu.cluster.client import Client
+
+    config = TonyConfig.from_layers(conf_file=args.conf_file, conf_args=args.conf)
+    if args.executes:
+        config.set(keys.EXECUTES, args.executes)
+    if args.workers:
+        config.set(keys.jobtype_key("worker", keys.INSTANCES_SUFFIX), str(args.workers))
+    config.set(keys.CHAOS_SPEC, args.spec)
+    config.set(keys.CHAOS_SEED, str(args.seed))
+
+    client = Client(config)
+    handle = client.submit()
+    print(f"[tony-chaos] submitted {handle.app_id} under schedule {args.spec!r} (seed {args.seed})")
+    final = client.monitor_application(handle, quiet=True)
+    print(f"[tony-chaos] job finished: {final.name}")
+
+    failures, info = verify_chaos_run(handle, config)
+    injections = _injection_report(handle.staging_dir)
+    if injections:
+        print("[tony-chaos] injected faults: "
+              + ", ".join(f"{k}x{n}" for k, n in sorted(injections.items())))
+    else:
+        print("[tony-chaos] injected faults: none fired")
+    if info.get("resumed_steps"):
+        print(f"[tony-chaos] checkpoint resumes at steps: {info['resumed_steps']}")
+    elif args.expect_resume:
+        failures.append("--expect-resume: no task resumed from a checkpoint")
+    print(f"[tony-chaos] gang epochs: {info.get('gang_epochs', 1)}")
+
+    if failures:
+        for fail in failures:
+            print(f"[tony-chaos] INVARIANT VIOLATED: {fail}", file=sys.stderr)
+        print(f"[tony-chaos] invariants: FAILED ({len(failures)})")
+        return 1
+    print("[tony-chaos] invariants: OK "
+          f"(reproduce with --spec '{args.spec}' --seed {args.seed})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,12 @@ def _cmd_lint(argv: list[str]) -> int:
     return lint_main(argv)
 
 
+def _cmd_chaos(argv: list[str]) -> int:
+    from tony_tpu.cli.chaos import main as chaos_main
+
+    return chaos_main(argv)
+
+
 def _cmd_mini(argv: list[str]) -> int:
     """Self-contained sandbox: submit a smoke gang against the local resource
     manager and print the verdict + history location.
@@ -237,13 +243,14 @@ _COMMANDS = {
     "mini": _cmd_mini,
     "data-prep": _cmd_data_prep,
     "lint": _cmd_lint,
+    "chaos": _cmd_chaos,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|portal|notebook|serve|mini|data-prep|lint} [options]\n")
+        print("usage: tony {submit|pool|history|portal|notebook|serve|mini|data-prep|lint|chaos} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    list finished jobs / dump one job's events")
@@ -253,6 +260,7 @@ def main(argv: list[str] | None = None) -> int:
         print("  mini       one-command local sandbox (smoke gang, optional --distributed)")
         print("  data-prep  tokenize text files into TONYTOK training shards")
         print("  lint       run the AST static-analysis suite (config/jit/lock/mesh discipline)")
+        print("  chaos      run a job under a seeded fault schedule and assert recovery invariants")
         return 0
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:

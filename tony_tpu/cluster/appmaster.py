@@ -27,6 +27,7 @@ import time
 from typing import Any
 
 from tony_tpu import constants
+from tony_tpu.chaos import ChaosContext
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.cluster import history
 from tony_tpu.cluster.events import EventHandler, EventType
@@ -110,7 +111,11 @@ class ApplicationMaster:
         self.config = config
         self.app_id = app_id
         self.staging_dir = staging_dir
+        # fault injection (tony.chaos.*): None — and zero-cost — unless
+        # configured; container faults ride the RM's poll_exited seam
+        self.chaos = ChaosContext.from_config(config, identity="am", staging_dir=staging_dir)
         self.rm = rm or build_resource_manager(config, app_id)
+        self.rm.chaos = self.chaos
         self.runtime = get_runtime(config)
         self.session = Session(config)
         self.scheduler = TaskScheduler(config, self.session, self.rm)
@@ -173,24 +178,35 @@ class ApplicationMaster:
             self.events.emit(EventType.GANG_COMPLETE, tasks=session.total_tasks())
         return {"spec_complete": complete}
 
-    def get_cluster_spec(self, job_name: str, index: int) -> dict[str, Any]:
-        spec = self.session.cluster_spec()
+    def get_cluster_spec(self, job_name: str, index: int, attempt: int = 0) -> dict[str, Any]:
+        # epoch-fenced like every other executor-facing RPC: a dying executor
+        # from a killed gang epoch must never receive the NEW gang's spec and
+        # proceed with the wrong ranks
+        session = self._fenced_session(attempt)
+        if session is None:
+            return {"spec": None, "stale": True}
+        spec = session.cluster_spec()
         if spec is None or not self._gang_complete_fired:
             return {"spec": None}
         return {
             "spec": spec,
-            "extra_env": self.runtime.am_extra_env(self.session, job_name, index),
+            "extra_env": self.runtime.am_extra_env(session, job_name, index),
             "restart_attempt": self._restart_attempt,
         }
 
     def register_execution_result(
-        self, job_name: str, index: int, exit_code: int, attempt: int = 0
+        self, job_name: str, index: int, exit_code: int, attempt: int = 0, reason: str = ""
     ) -> dict[str, Any]:
         session = self._fenced_session(attempt)
         if session is None:
             return {"ack": False, "stale": True}
         session.on_task_completed(job_name, index, exit_code)
-        self.events.emit(EventType.TASK_FINISHED, task=f"{job_name}:{index}", exit_code=exit_code)
+        payload: dict[str, Any] = {"task": f"{job_name}:{index}", "exit_code": exit_code}
+        if reason:
+            # e.g. "execution timeout": lets the .jhist distinguish an
+            # executor-enforced kill from a user-code failure
+            payload["reason"] = reason
+        self.events.emit(EventType.TASK_FINISHED, **payload)
         return {"ack": True}
 
     def register_tensorboard_url(self, url: str) -> dict[str, Any]:
@@ -383,6 +399,8 @@ class ApplicationMaster:
         # ONE capacity snapshot: totals derived from the same node list the
         # placement check uses (two RPCs would race a node dying in between)
         nodes = self.rm.node_capacities()
+        if self.chaos is not None and self.chaos.take("capacity-flap") is not None:
+            nodes = []  # this probe sees an empty pool; the hysteresis below must absorb the blip
         if nodes is not None:
             from tony_tpu.cluster.resources import Resources
 
@@ -652,6 +670,7 @@ class ApplicationMaster:
                 "started_ms": self.started_ms,
                 "completed_ms": completed_ms,
                 "tensorboard_url": self.tensorboard_url,
+                "restart_attempt": self._restart_attempt,
                 "tasks": self.session.task_infos(),
             },
         )

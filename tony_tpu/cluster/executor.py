@@ -20,6 +20,7 @@ import threading
 import time
 
 from tony_tpu import constants
+from tony_tpu.chaos import ChaosContext
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.cluster.metrics import MetricsSampler
 from tony_tpu.cluster.rpc import RpcClient, RpcError
@@ -52,10 +53,16 @@ class TaskExecutor:
         self.index = int(env[constants.ENV_TASK_INDEX])
         am_host = env.get(constants.ENV_AM_HOST, "127.0.0.1")
         self.config = TonyConfig.load_final(os.path.join(self.staging_dir, constants.TONY_FINAL_CONF))
+        # fault injection (tony.chaos.*, docs/fault-tolerance.md): None —
+        # and zero-cost — unless a schedule is configured
+        self.chaos = ChaosContext.from_config(
+            self.config, identity=f"{self.job_name}:{self.index}", staging_dir=self.staging_dir
+        )
         self.rpc = RpcClient(
             am_host,
             int(env[constants.ENV_AM_PORT]),
             secret=env.get(constants.ENV_AM_SECRET, ""),
+            chaos=self.chaos,
         )
         self.runtime = get_runtime(self.config)
         self.attempt = int(env.get("TONY_RESTART_ATTEMPT", "0"))  # gang-epoch fence
@@ -70,10 +77,15 @@ class TaskExecutor:
     # -- gang barrier ------------------------------------------------------
     def register(self) -> None:
         timeout_ms = self.config.get_time_ms(keys.TASK_EXECUTOR_REGISTRATION_TIMEOUT_MS, 60_000)
+        if self.chaos is not None:
+            f = self.chaos.take("reg-slow")
+            if f is not None:
+                time.sleep(f.ms(default=1000) / 1000)
         self.rpc.call_with_retry(
             "register_worker_spec",
             retries=max(int(timeout_ms / 200), 1),
             delay_s=0.2,
+            deadline_s=timeout_ms / 1000,
             job_name=self.job_name,
             index=self.index,
             host=self.host,
@@ -86,8 +98,17 @@ class TaskExecutor:
         deadline = time.time() + self.config.get_time_ms(keys.AM_GANG_TIMEOUT_MS, 300_000) / 1000
         while time.time() < deadline:
             resp = self.rpc.call_with_retry(
-                "get_cluster_spec", job_name=self.job_name, index=self.index
+                "get_cluster_spec", job_name=self.job_name, index=self.index,
+                attempt=self.attempt,
             )
+            if resp.get("stale"):
+                # our gang epoch was killed and replaced while we were still
+                # starting: the new gang reuses our (job, index) identity, so
+                # proceeding would mean running with another epoch's ranks
+                raise RuntimeError(
+                    f"gang epoch {self.attempt} superseded while awaiting the "
+                    "cluster spec — aborting this executor"
+                )
             if resp.get("spec") is not None:
                 return resp["spec"], resp.get("extra_env") or {}
             time.sleep(0.2)
@@ -125,6 +146,11 @@ class TaskExecutor:
         pybin = self.config.get(keys.PYTHON_BINARY_PATH)
         if pybin:
             env["PYTHON_BINARY"] = pybin
+        if self.chaos is not None:
+            # child-process chaos contract: the training loop's injection
+            # points (checkpoint restore) read the schedule from env
+            env[constants.ENV_CHAOS_SPEC] = self.config.get(keys.CHAOS_SPEC) or ""
+            env[constants.ENV_CHAOS_SEED] = str(self.config.get_int(keys.CHAOS_SEED, 0))
         if self.config.get_bool(keys.TASK_PROFILE):
             from tony_tpu.train import profiling
 
@@ -221,7 +247,12 @@ class TaskExecutor:
     def _heartbeat_loop(self) -> None:
         interval = self.config.get_time_ms(keys.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000
         max_missed = self.config.get_int(keys.TASK_MAX_MISSED_HEARTBEATS, 25)
+        stalled = False  # chaos hb-stall: a wedged executor — alive but silent
         while not self._stop.wait(interval):
+            if not stalled and self.chaos is not None and self.chaos.take("hb-stall") is not None:
+                stalled = True
+            if stalled:
+                continue
             try:
                 self.rpc.call(
                     "task_executor_heartbeat",
@@ -278,6 +309,47 @@ class TaskExecutor:
         except (OSError, ValueError):
             return None
 
+    # -- chaos lifecycle points (no-ops unless tony.chaos.spec is set) ------
+    def _chaos_point(self, trigger: str) -> None:
+        """Fire exec faults tied to a lifecycle trigger (@registered,
+        @gang_complete)."""
+        if self.chaos is None:
+            return
+        if self.chaos.take("exec-crash", trigger=trigger) is not None:
+            self._kill_child()
+            os._exit(constants.EXIT_FAILURE)
+        if self.chaos.take("exec-hang", trigger=trigger) is not None:
+            while True:  # wedge here forever; heartbeats keep flowing
+                time.sleep(3600)
+
+    def _start_chaos_timers(self) -> None:
+        """Arm trigger-less exec faults: ``@t+5s`` fires that long after
+        executor start, no delay at all fires right after child launch.
+        Each fires at most once per job (chaos once-latch)."""
+        if self.chaos is None:
+            return
+        for f in self.chaos.schedule.faults:
+            if f.kind in ("exec-crash", "exec-hang") and f.trigger is None:
+                threading.Thread(
+                    target=self._timed_exec_fault, args=(f,), name=f"chaos-{f.kind}", daemon=True
+                ).start()
+
+    def _timed_exec_fault(self, f) -> None:
+        time.sleep(max(f.delay_ms / 1000 - self.chaos.elapsed_ms() / 1000, 0))
+        if self.chaos.take_spec(f) is None:
+            return  # not this task's fault, or already fired in a prior attempt
+        if f.kind == "exec-crash":
+            self._kill_child()
+            os._exit(constants.EXIT_FAILURE)
+        # exec-hang: SIGSTOP the child's process group — it stops making
+        # progress while this supervisor stays alive and heartbeating, the
+        # classic wedged-worker failure mode
+        if self.child and self.child.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.child.pid), signal.SIGSTOP)
+            except ProcessLookupError:
+                pass
+
     def _kill_child(self) -> None:
         grace_s = self.config.get_time_ms(keys.TASK_KILL_GRACE_MS, 3000) / 1000
         if self.child and self.child.poll() is None:
@@ -295,14 +367,15 @@ class TaskExecutor:
         signal.signal(signal.SIGTERM, lambda *_: (_sigterm(self)))
         try:
             self.register()
+            self._chaos_point("registered")
             # heartbeat starts at registration, not child launch: the gang
             # barrier can legitimately outlast the liveness window (dependency-
             # gated types, slow containers) and REGISTERED tasks are monitored.
-            # fault-injection hook (test-only; SURVEY.md §5.3): simulate a
-            # wedged executor whose heartbeats stop while its process lives.
-            if not os.environ.get("TONY_TEST_SUPPRESS_HEARTBEAT"):
-                threading.Thread(target=self._heartbeat_loop, name="heartbeat", daemon=True).start()
+            # (A wedged executor whose heartbeats stop while its process lives
+            # is simulated by the chaos `hb-stall` fault inside the loop.)
+            threading.Thread(target=self._heartbeat_loop, name="heartbeat", daemon=True).start()
             spec, extra_env = self.await_cluster_spec()
+            self._chaos_point("gang_complete")
             command = self.resolve_command()
             env = self.build_child_env(spec, extra_env)
         except Exception as e:  # registration/barrier failure
@@ -320,6 +393,7 @@ class TaskExecutor:
             return constants.EXIT_EXECUTOR_REGISTRATION_FAILED
 
         self.child = self.launch_child(command, env)
+        self._start_chaos_timers()
         threading.Thread(target=self._metrics_loop, name="metrics", daemon=True).start()
 
         if self.job_name in (constants.TENSORBOARD_JOB_NAME, constants.NOTEBOOK_JOB_NAME):
@@ -338,19 +412,24 @@ class TaskExecutor:
                 pass
 
         timeout_ms = self.config.get_time_ms(keys.TASK_EXECUTOR_EXECUTION_TIMEOUT_MS, 0)
+        reason = ""
         try:
             rc = self.child.wait(timeout=timeout_ms / 1000 if timeout_ms else None)
         except subprocess.TimeoutExpired:
             self._kill_child()
-            rc = constants.EXIT_FAILURE
+            rc = constants.EXIT_EXECUTION_TIMEOUT
+            reason = f"execution timeout: killed after {timeout_ms}ms (tony.task.execution-timeout-ms)"
+            print(f"[tony-executor] {reason}", file=sys.stderr, flush=True)
         self._stop.set()
         try:
             self.rpc.call_with_retry(
                 "register_execution_result",
                 retries=10,
+                deadline_s=30,
                 job_name=self.job_name,
                 index=self.index,
                 exit_code=rc,
+                reason=reason,
                 attempt=self.attempt,
             )
         except RpcError:

@@ -1083,11 +1083,21 @@ class RemoteResourceManager(ResourceManager):
             log_dir=log_dir,
         )
 
+    def _live_containers(self) -> list[Container]:
+        with self._lock:
+            return [c for c, _, _ in self._containers.values()]
+
     def poll_exited(self) -> dict[str, int]:
         try:
-            return {cid: int(rc) for cid, rc in self.rm.call("poll_exited", app_id=self.app_id).items()}
+            exits = {cid: int(rc) for cid, rc in self.rm.call("poll_exited", app_id=self.app_id).items()}
         except (RpcError, OSError):
             return {}
+        if self.chaos is not None:
+            # chaos node-loss / preempt against a remote pool: the kill rides
+            # the real AM→agent path, the exit code is synthesized here (the
+            # same seam the in-process RMs use)
+            exits = self.chaos.perturb_container_exits(self, exits)
+        return exits
 
     def kill_container(self, container: Container) -> None:
         with self._lock:

@@ -244,6 +244,10 @@ class ResourceManager(ABC):
     interchangeable (SURVEY.md §7 hard part (a)).
     """
 
+    #: optional fault-injection context (tony.chaos.*), assigned by the AM;
+    #: container faults (node-loss, preempt) apply at the poll_exited seam
+    chaos = None
+
     def register_app(self, queue: str, priority: int, demand: "Resources") -> None:
         """Announce the app's queue, priority, and TOTAL gang demand to the
         pool (ApplicationSubmissionContext analog). In-process pools are
@@ -402,7 +406,12 @@ class ProcessContainerMixin:
         self.launcher.start(container.id, command, env, log_dir)
 
     def poll_exited(self) -> dict[str, int]:
-        return self.launcher.poll_exited()
+        exits = self.launcher.poll_exited()
+        if self.chaos is not None:
+            # chaos node-loss / preempt: victims die through the real kill
+            # path and surface here as synthetic cluster exit codes
+            exits = self.chaos.perturb_container_exits(self, exits)
+        return exits
 
     def kill_container(self, container: Container) -> None:
         self.launcher.kill(container.id)

@@ -14,12 +14,16 @@ Response: {"ok": true, "result": ...} | {"ok": false, "error": str}
 from __future__ import annotations
 
 import json
+import random
 import socket
 import socketserver
 import struct
 import threading
 import time
-from typing import Any, Callable
+from typing import TYPE_CHECKING, Any, Callable
+
+if TYPE_CHECKING:
+    from tony_tpu.chaos import ChaosContext
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
@@ -123,9 +127,19 @@ class RpcClient:
     (ApplicationRpcClient analog; executors and the monitoring client use it.)
     """
 
-    def __init__(self, host: str, port: int, secret: str = "", timeout_s: float = 10.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        secret: str = "",
+        timeout_s: float = 10.0,
+        chaos: "ChaosContext | None" = None,
+    ):
         self.host, self.port, self.secret = host, port, secret
         self.timeout_s = timeout_s
+        #: optional fault-injection context (tony.chaos.*); None on the
+        #: production path — every injection is guarded on it
+        self.chaos = chaos
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
 
@@ -148,8 +162,13 @@ class RpcClient:
         with self._lock:
             for attempt in (0, 1):  # one transparent reconnect on a stale socket
                 try:
+                    if self.chaos is not None:
+                        # may sleep (rpc-delay) or raise (rpc-drop/blackhole)
+                        self.chaos.rpc_before_send(method, self.timeout_s)
                     sock = self._connect()
                     _send_frame(sock, {"method": method, "params": params, "auth": self.secret})
+                    if self.chaos is not None and self.chaos.rpc_sever_after_send(method):
+                        sock.close()  # response lost mid-call (server may have executed)
                     resp = _recv_frame(sock)
                     break
                 except (ConnectionError, OSError):
@@ -161,16 +180,43 @@ class RpcClient:
             return resp.get("result")
 
     def call_with_retry(
-        self, method: str, *, retries: int = 30, delay_s: float = 0.2, **params: Any
+        self,
+        method: str,
+        *,
+        retries: int = 30,
+        delay_s: float = 0.2,
+        max_delay_s: float = 2.0,
+        deadline_s: float | None = None,
+        **params: Any,
     ) -> Any:
-        """Retry through AM startup races / transient connect failures."""
+        """Retry through AM startup races / transient connect failures.
+
+        Exponential backoff with FULL jitter (sleep ~ U[0, min(max_delay_s,
+        delay_s * 2^attempt)]) so a restarted gang's executors don't hammer a
+        recovering AM in lockstep, bounded by ``deadline_s`` of overall wall
+        time when given — a caller with a contract timeout (registration,
+        final-result report) fails crisply instead of retrying past it.
+        """
+        start = time.monotonic()
         last: Exception | None = None
-        for _ in range(retries):
+        for attempt in range(retries):
             try:
                 return self.call(method, **params)
             except (ConnectionError, OSError, RpcError) as e:
                 last = e
-                time.sleep(delay_s)
+                if attempt + 1 >= retries:
+                    break
+                cap = min(max_delay_s, delay_s * (2 ** min(attempt, 32)))
+                sleep = random.uniform(0, cap)
+                if deadline_s is not None:
+                    remaining = deadline_s - (time.monotonic() - start)
+                    if remaining <= 0:
+                        raise RpcError(
+                            f"{method} deadline {deadline_s:.1f}s exceeded "
+                            f"after {attempt + 1} attempts: {last}"
+                        ) from last
+                    sleep = min(sleep, remaining)
+                time.sleep(sleep)
         raise RpcError(f"{method} failed after {retries} retries: {last}")
 
 

@@ -133,6 +133,17 @@ HISTORY_MOVE_INTERVAL_MS = "tony.history.move-interval-ms"
 PORTAL_PORT = "tony.portal.port"
 
 # ---------------------------------------------------------------------------
+# tony.chaos.* — deterministic fault injection (docs/fault-tolerance.md)
+# ---------------------------------------------------------------------------
+# Fault schedule, e.g. "rpc-drop:p=0.05;exec-crash:worker:1@gang_complete";
+# empty (the default) disables every injection point. Grammar in
+# tony_tpu/chaos/schedule.py.
+CHAOS_SPEC = "tony.chaos.spec"
+# Seed for the injection PRNGs: the same (spec, seed) pair reproduces the
+# same injected-fault sequence exactly.
+CHAOS_SEED = "tony.chaos.seed"
+
+# ---------------------------------------------------------------------------
 # tony.checkpoint.* — gang-restart-from-checkpoint (rebuild-only; SURVEY §5.3/5.4)
 # ---------------------------------------------------------------------------
 CHECKPOINT_DIR = "tony.checkpoint.dir"
@@ -207,6 +218,9 @@ DEFAULTS: dict[str, str] = {
     HISTORY_LOCATION: "",            # empty → <staging-root>/history
     HISTORY_MOVE_INTERVAL_MS: "1000",
     PORTAL_PORT: "28080",
+
+    CHAOS_SPEC: "",
+    CHAOS_SEED: "0",
 
     CHECKPOINT_DIR: "",
     CHECKPOINT_INTERVAL_STEPS: "0",

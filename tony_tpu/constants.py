@@ -57,6 +57,8 @@ ENV_TRAIN_METRICS_FILE = "TONY_TRAIN_METRICS_FILE"
 ENV_KILL_GRACE_MS = "TONY_KILL_GRACE_MS"  # SIGTERM→SIGKILL window for this container (tony.task.kill-grace-ms)
 ENV_CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"            # from tony.checkpoint.dir
 ENV_CHECKPOINT_INTERVAL = "TONY_CHECKPOINT_INTERVAL"  # from tony.checkpoint.interval-steps
+ENV_CHAOS_SPEC = "TONY_CHAOS_SPEC"    # from tony.chaos.spec (child-process chaos contract)
+ENV_CHAOS_SEED = "TONY_CHAOS_SEED"    # from tony.chaos.seed
 ENV_NOTEBOOK_PORT = "NOTEBOOK_PORT"     # notebook task port (proxied by submitter)
 
 # ---------------------------------------------------------------------------
@@ -116,6 +118,9 @@ EXIT_FAILURE = 1
 EXIT_AM_ERROR = 10
 EXIT_EXECUTOR_REGISTRATION_FAILED = 11
 EXIT_HEARTBEAT_LOST = 12
+# the executor killed the user process at tony.task.execution-timeout-ms:
+# distinct from EXIT_FAILURE so .jhist separates timeouts from user-code crashes
+EXIT_EXECUTION_TIMEOUT = 13
 EXIT_KILLED = 137
 EXIT_NODE_LOST = -100   # container's host agent died (YARN ContainerExitStatus.ABORTED analog)
 # pool preempted the container for a higher-priority app (the YARN

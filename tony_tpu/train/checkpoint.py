@@ -10,6 +10,7 @@ latest-step restore with the target sharding applied on load.
 from __future__ import annotations
 
 import os
+import sys
 from typing import Any
 
 import jax
@@ -80,14 +81,58 @@ def restore_or_init(
     """The gang-restart resume path: (state, manager, start_step).
 
     With no ckpt_dir configured → (init_fn(), None, 0). With one configured,
-    restores the latest checkpoint if present, else initializes fresh.
+    restores the newest INTACT checkpoint if present, else initializes fresh.
+
+    Corruption-tolerant: a torn latest checkpoint (the writer crashed
+    mid-write, the node died, a chaos ``ckpt-corrupt`` fault fired) must not
+    crash the whole restarted gang — a step whose restore fails is
+    quarantined (renamed to ``.corrupt-<step>``, invisible to Orbax but kept
+    for forensics) and the next-newest step is tried, down to a fresh init.
     """
     if not ckpt_dir:
         return init_fn(), None, 0
-    mgr = CheckpointManager(ckpt_dir, max_to_keep=max_to_keep, use_async=use_async)
+    from tony_tpu.chaos import maybe_corrupt_checkpoint
+
+    maybe_corrupt_checkpoint(ckpt_dir)  # no-op unless a chaos fault is armed via env
     state = init_fn()
-    step = mgr.latest_step()
-    if step is not None:
-        state = mgr.restore(state)
-        return state, mgr, int(step)
-    return state, mgr, 0
+    while True:
+        # a fresh manager per attempt: Orbax caches its step list at init,
+        # and a quarantined step must disappear from it before the next try
+        mgr = CheckpointManager(ckpt_dir, max_to_keep=max_to_keep, use_async=use_async)
+        step = mgr.latest_step()
+        if step is None:
+            return state, mgr, 0
+        try:
+            return mgr.restore(state, step=step), mgr, int(step)
+        except Exception as e:  # noqa: BLE001 — any torn artifact must fall back, not crash
+            print(
+                f"[ckpt] restore of step {step} failed ({type(e).__name__}: {e}); "
+                f"quarantining it and falling back to the previous step",
+                file=sys.stderr,
+                flush=True,
+            )
+            mgr.close()
+            _quarantine_step(ckpt_dir, int(step))
+
+
+def _quarantine_step(ckpt_dir: str, step: int) -> None:
+    """Move a corrupt step dir out of Orbax's sight (non-numeric name), kept
+    on disk for post-mortem. Gang workers share the checkpoint dir and all
+    hit the torn step concurrently on a restart — losing the rename race to a
+    peer is success, not an error. Raises only when the move persistently
+    fails — retrying the same corrupt step forever would be worse."""
+    src = os.path.join(ckpt_dir, str(step))
+    dst = os.path.join(ckpt_dir, f".corrupt-{step}")
+    try:
+        os.rename(src, dst)
+    except FileNotFoundError:
+        return  # a peer gang worker already quarantined this step
+    except OSError:
+        # leftover quarantine dir from an earlier incident: replace it
+        import shutil
+
+        shutil.rmtree(dst, ignore_errors=True)
+        try:
+            os.rename(src, dst)
+        except FileNotFoundError:
+            return
