@@ -1,0 +1,471 @@
+"""Observability suite: span model, RPC trace propagation + overhead,
+metrics registry / Prometheus exposition, forward-compat event reads,
+sparkline hardening, `tony trace` reconstruction, and the full e2e
+acceptance path (traced chaos job → merged Chrome timeline → /metrics).
+"""
+
+import http.client
+import json
+import math
+import os
+import socket
+import sys
+import threading
+
+import pytest
+
+from tony_tpu.cluster.events import Event, EventType, UnknownEventType
+from tony_tpu.cluster.rpc import RpcClient, RpcServer, _recv_frame, _send_frame
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import trace as obs_trace
+from tony_tpu.obs.metrics import MetricsRegistry, render_merged
+from tony_tpu.portal.server import _sparkline
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+@pytest.fixture()
+def tracer(tmp_path):
+    tr = obs_trace.init_tracing("app-test", "tester", str(tmp_path))
+    yield tr
+    obs_trace.shutdown()
+
+
+def read_spans(tmp_path, identity="tester"):
+    path = os.path.join(str(tmp_path), f"{identity}.spans.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.mark.obs
+class TestSpanModel:
+    def test_nested_spans_parent_links_and_sink(self, tracer, tmp_path):
+        with tracer.span("outer", kind="internal", answer=42) as outer:
+            with tracer.span("inner") as inner:
+                inner.add_event("tick", n=1)
+        spans = read_spans(tmp_path)
+        # inner finished (and was written) first
+        assert [s["name"] for s in spans] == ["inner", "outer"]
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+        assert by_name["outer"]["parent_id"] is None
+        assert by_name["outer"]["trace_id"] == by_name["inner"]["trace_id"] == "app-test"
+        assert by_name["outer"]["attrs"] == {"answer": 42}
+        assert by_name["inner"]["events"][0]["name"] == "tick"
+        assert by_name["inner"]["end_ms"] >= by_name["inner"]["start_ms"]
+
+    def test_root_parent_fallback_for_bare_threads(self, tracer, tmp_path):
+        tracer.root_parent = "feedfacefeedface"
+        with tracer.span("orphan"):
+            pass
+        assert read_spans(tmp_path)[0]["parent_id"] == "feedfacefeedface"
+
+    def test_error_status_on_exception(self, tracer, tmp_path):
+        with pytest.raises(RuntimeError):
+            with tracer.span("boom"):
+                raise RuntimeError("x")
+        assert read_spans(tmp_path)[0]["status"] == "error"
+
+    def test_add_event_is_noop_when_disabled(self):
+        assert obs_trace.get() is None
+        obs_trace.add_event("nobody-home", x=1)  # must not raise
+        assert obs_trace.current_span() is None
+
+    def test_maybe_span_disabled_is_shared_noop(self):
+        assert obs_trace.get() is None
+        ctx1 = obs_trace.maybe_span("a")
+        ctx2 = obs_trace.maybe_span("b", kind="server", attr=1)
+        assert ctx1 is ctx2  # one shared object: zero allocation per hook
+        with ctx1:
+            pass
+
+
+@pytest.fixture()
+def echo_server():
+    srv = RpcServer(secret="s3cret")
+    srv.register("echo", lambda **kw: kw)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.mark.obs
+class TestRpcTracing:
+    def test_disabled_round_trip_allocates_no_spans(self, echo_server, monkeypatch):
+        """Tracing off (the default): the RPC round trip must construct zero
+        Span objects and put no trace field on the wire."""
+        assert obs_trace.get() is None
+        sent = []
+        real_send = _send_frame
+
+        def spy_send(sock, obj):
+            sent.append(obj)
+            real_send(sock, obj)
+
+        def no_spans(*a, **kw):
+            raise AssertionError("Span allocated on the disabled fast path")
+
+        monkeypatch.setattr("tony_tpu.cluster.rpc._send_frame", spy_send)
+        monkeypatch.setattr(obs_trace.Span, "__init__", no_spans)
+        host, port = echo_server.address
+        cli = RpcClient(host, port, secret="s3cret")
+        assert cli.call("echo", a=1) == {"a": 1}
+        cli.close()
+        req = next(o for o in sent if isinstance(o, dict) and o.get("method") == "echo")
+        assert "trace" not in req
+
+    def test_enabled_spans_survive_frame_codec_and_link(self, echo_server, tmp_path):
+        """Client + server share this process: both spans land in the sink,
+        the server span's parent is the client span carried IN the frame."""
+        tr = obs_trace.init_tracing("app-rpc", "both", str(tmp_path))
+        try:
+            host, port = echo_server.address
+            cli = RpcClient(host, port, secret="s3cret")
+            with tr.span("root"):
+                assert cli.call("echo", x="y") == {"x": "y"}
+            cli.close()
+        finally:
+            obs_trace.shutdown()
+        by_name = {s["name"]: s for s in read_spans(tmp_path, "both")}
+        client_span = by_name["rpc.client:echo"]
+        server_span = by_name["rpc.server:echo"]
+        root = by_name["root"]
+        assert client_span["parent_id"] == root["span_id"]
+        assert server_span["parent_id"] == client_span["span_id"]  # crossed the wire
+        assert server_span["kind"] == "server" and client_span["kind"] == "client"
+        assert server_span["trace_id"] == "app-rpc"
+
+    def test_server_ignores_trace_field_when_disabled(self, echo_server):
+        """Forward compat: a frame carrying trace context is served normally
+        by a server whose tracing is off."""
+        assert obs_trace.get() is None
+        host, port = echo_server.address
+        with socket.create_connection((host, port), timeout=5) as sock:
+            _send_frame(sock, {
+                "method": "echo", "params": {"k": 1}, "auth": "s3cret",
+                "trace": {"t": "someone-elses-trace", "s": "abcd" * 4},
+            })
+            resp = _recv_frame(sock)
+        assert resp == {"ok": True, "result": {"k": 1}}
+
+    def test_rpc_latency_metrics_recorded(self, echo_server):
+        from tony_tpu.cluster.rpc import _CLIENT_LATENCY
+        key = ("echo",)
+        before = _CLIENT_LATENCY._children.get(key, {}).get("count", 0)
+        host, port = echo_server.address
+        cli = RpcClient(host, port, secret="s3cret")
+        cli.call("echo", a=1)
+        cli.close()
+        assert _CLIENT_LATENCY._children[key]["count"] == before + 1
+
+
+@pytest.mark.obs
+class TestMetricsRegistry:
+    def test_counter_gauge_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_total", "help", labelnames=("method",))
+        c.inc(method="a")
+        c.inc(2, method="a")
+        c.inc(method="b")
+        g = reg.gauge("t_gauge")
+        g.set(1.5)
+        assert c.value(method="a") == 3
+        assert g.value() == 1.5
+        with pytest.raises(ValueError):
+            c.inc(wrong="label")
+
+    def test_reregistration_returns_same_metric(self):
+        reg = MetricsRegistry()
+        assert reg.counter("x_total") is reg.counter("x_total")
+        with pytest.raises(ValueError):
+            reg.gauge("x_total")
+
+    def test_histogram_buckets_monotone_and_consistent(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "x", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.005, 0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        text = render_merged([(reg.snapshot(), {})])
+        counts = []
+        for line in text.splitlines():
+            if line.startswith("lat_seconds_bucket"):
+                counts.append(int(line.rsplit(" ", 1)[1]))
+        assert counts == sorted(counts), f"bucket counts must be cumulative-monotone: {counts}"
+        assert len(counts) == 4  # 3 finite buckets + +Inf
+        assert counts[-1] == 6  # +Inf == total count
+        assert 'le="+Inf"' in text
+        assert "lat_seconds_count 6" in text
+        assert "lat_seconds_sum" in text
+
+    def test_render_merged_applies_extra_labels(self):
+        reg = MetricsRegistry()
+        reg.counter("y_total", "h", labelnames=("method",)).inc(method="m")
+        text = render_merged([(reg.snapshot(), {"app": "application_1_ab"})])
+        assert 'y_total{method="m",app="application_1_ab"} 1' in text
+        # one TYPE header even when two groups carry the same metric
+        two = render_merged([
+            (reg.snapshot(), {"app": "a1"}), (reg.snapshot(), {"app": "a2"}),
+        ])
+        assert two.count("# TYPE y_total counter") == 1
+        assert 'app="a1"' in two and 'app="a2"' in two
+
+    def test_set_enabled_false_noops(self):
+        reg = MetricsRegistry()
+        c = reg.counter("z_total")
+        obs_metrics.set_enabled(False)
+        try:
+            c.inc()
+            assert c.value() == 0
+        finally:
+            obs_metrics.set_enabled(True)
+        c.inc()
+        assert c.value() == 1
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("esc_total", labelnames=("m",)).inc(m='ba"ck\\slash\nnl')
+        text = render_merged([(reg.snapshot(), {})])
+        assert r'm="ba\"ck\\slash\nnl"' in text
+
+
+@pytest.mark.obs
+class TestEventForwardCompat:
+    def test_unknown_event_type_tolerated(self):
+        line = json.dumps({
+            "type": "TRACE_SNAPSHOT_FROM_THE_FUTURE",
+            "timestamp_ms": 123,
+            "payload": {"spans": 7},
+        })
+        ev = Event.from_json(line)
+        assert isinstance(ev.type, UnknownEventType)
+        assert ev.type.value == "TRACE_SNAPSHOT_FROM_THE_FUTURE"
+        assert ev.type.name == "TRACE_SNAPSHOT_FROM_THE_FUTURE"
+        assert ev.payload == {"spans": 7}
+        # and it round-trips byte-compatibly
+        assert json.loads(ev.to_json())["type"] == "TRACE_SNAPSHOT_FROM_THE_FUTURE"
+
+    def test_known_event_type_still_enum(self):
+        ev = Event.from_json(Event(EventType.GANG_COMPLETE, {"tasks": 2}).to_json())
+        assert ev.type is EventType.GANG_COMPLETE
+
+    def test_unknown_type_equality_and_hash(self):
+        a, b = UnknownEventType("X_EVENT"), UnknownEventType("X_EVENT")
+        assert a == b and hash(a) == hash(b)
+        assert a != UnknownEventType("Y_EVENT")
+
+
+@pytest.mark.obs
+class TestSparkline:
+    def test_non_finite_values_filtered(self):
+        svg = _sparkline([1.0, float("nan"), 2.0, float("inf"), 3.0], "loss")
+        assert "<svg" in svg
+        assert "nan" not in svg.lower() and "inf" not in svg.lower()
+
+    def test_fewer_than_two_finite_points_skips_chart(self):
+        assert _sparkline([float("nan"), float("inf")], "loss") == ""
+        assert _sparkline([1.0, float("nan")], "loss") == ""
+        assert _sparkline([], "loss") == ""
+
+    def test_all_finite_unchanged(self):
+        svg = _sparkline([1.0, 2.0, 0.5], "loss")
+        assert "<polyline" in svg and "0.5" in svg
+
+
+def _make_span(name, identity, span_id, parent_id, start_ms, end_ms, **kw):
+    return {
+        "name": name, "trace_id": "app-cli", "span_id": span_id,
+        "parent_id": parent_id, "kind": kw.pop("kind", "internal"),
+        "identity": identity, "thread": kw.pop("thread", 1),
+        "start_ms": start_ms, "end_ms": end_ms, "status": "ok", **kw,
+    }
+
+
+@pytest.mark.obs
+class TestTraceCli:
+    def _write_fixture_trace(self, trace_dir):
+        os.makedirs(trace_dir, exist_ok=True)
+        client = [_make_span("client.submit", "client", "c1", None, 1000.0, 1500.0)]
+        am = [
+            _make_span("am.run", "am", "a1", "c1", 1200.0, 9000.0),
+            _make_span("am.queue_wait", "am", "a2", "a1", 1300.0, 2300.0),
+        ]
+        worker = [
+            _make_span("executor.run", "worker:0", "w1", "a1", 2500.0, 8000.0),
+            _make_span(
+                "executor.register", "worker:0", "w2", "w1", 2600.0, 2700.0,
+                events=[{"name": "chaos.rpc-delay", "ts_ms": 2650.0,
+                         "attrs": {"fault": "rpc-delay:worker:0"}}],
+            ),
+        ]
+        for ident, spans in [("client", client), ("am", am), ("worker_0", worker)]:
+            with open(os.path.join(trace_dir, f"{ident}.spans.jsonl"), "w") as f:
+                for s in spans:
+                    f.write(json.dumps(s) + "\n")
+                f.write("{corrupt json\n")  # torn tail line must be skipped
+
+    def test_merge_summary_and_chrome_json(self, tmp_path, capsys):
+        from tony_tpu.cli.trace import load_spans, main as trace_main, summarize, to_chrome
+
+        trace_dir = os.path.join(str(tmp_path), "app1", "trace")
+        self._write_fixture_trace(trace_dir)
+        spans = load_spans(trace_dir)
+        assert len(spans) == 5
+        chrome = to_chrome(spans)
+        json.dumps(chrome)  # must be valid JSON
+        events = chrome["traceEvents"]
+        names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+        assert names == {"client", "am", "worker:0"}
+        # X events with µs timestamps, instant event for the chaos annotation
+        xs = {e["name"]: e for e in events if e["ph"] == "X"}
+        assert xs["client.submit"]["ts"] == 1000.0 * 1000
+        assert any(e["ph"] == "i" and e["name"] == "chaos.rpc-delay" for e in events)
+        # cross-process parent links become flow arrows
+        assert any(e["ph"] == "s" for e in events) and any(e["ph"] == "f" for e in events)
+
+        text = summarize(spans)
+        assert "queue wait" in text and "1.00s" in text
+        assert "chaos.rpc-delay" in text
+        assert "registration barrier" in text
+
+        # CLI end-to-end: writes trace.json next to the spans
+        rc = trace_main(["app1", "--staging", str(tmp_path)])
+        assert rc == 0
+        out_path = os.path.join(trace_dir, "trace.json")
+        assert os.path.exists(out_path)
+        assert json.load(open(out_path))["traceEvents"]
+        assert "critical path" in capsys.readouterr().out
+
+    def test_no_spans_returns_error(self, tmp_path, capsys):
+        from tony_tpu.cli.trace import main as trace_main
+
+        assert trace_main(["missing-app", "--staging", str(tmp_path)]) == 1
+
+
+FAST = {
+    "tony.am.monitor-interval-ms": "50",
+    "tony.task.heartbeat-interval-ms": "100",
+    "tony.am.gang-timeout-ms": "30000",
+}
+
+
+@pytest.mark.obs
+@pytest.mark.e2e
+class TestTracedJobEndToEnd:
+    """The acceptance path: a real traced job under a chaos fault yields a
+    causally-linked client→AM→executor chain, a chaos-annotated span, a
+    `tony trace` merge, and a /metrics exposition with non-zero RPC latency
+    histogram counts."""
+
+    def test_traced_chaos_job_timeline_and_metrics(self, tmp_path, tmp_tony_root):
+        from tony_tpu.cli.trace import load_spans, summarize, to_chrome
+        from tony_tpu.cluster.client import Client
+        from tony_tpu.cluster.session import JobStatus
+        from tony_tpu.config import TonyConfig, keys
+        from tony_tpu.portal.server import serve
+
+        cfg = TonyConfig({
+            **FAST,
+            keys.STAGING_ROOT: str(tmp_tony_root),
+            "tony.worker.instances": "1",
+            keys.EXECUTES: f"{sys.executable} {os.path.join(FIXTURES, 'exit_0.py')}",
+            keys.TRACE_ENABLED: "true",
+            # deterministic once-latched fault: the executor's first RPC is
+            # delayed 50ms inside its open rpc.client span
+            keys.CHAOS_SPEC: "rpc-delay:worker:0:ms=50",
+            keys.CHAOS_SEED: "11",
+        })
+        client = Client(cfg)
+        handle = client.submit()
+        try:
+            final = client.monitor_application(handle, quiet=True)
+            assert final == JobStatus.SUCCEEDED, handle.final_status()
+
+            trace_dir = os.path.join(handle.staging_dir, "trace")
+            spans = load_spans(trace_dir)
+            idents = {s["identity"] for s in spans}
+            assert {"client", "am", "worker:0"} <= idents, idents
+
+            # causal chain client.submit → am.run → executor.run
+            by_id = {s["span_id"]: s for s in spans}
+            submit = next(s for s in spans if s["name"] == "client.submit")
+            am_run = next(s for s in spans if s["name"] == "am.run")
+            ex_run = next(s for s in spans if s["name"] == "executor.run")
+            assert am_run["parent_id"] == submit["span_id"]
+            assert ex_run["parent_id"] == am_run["span_id"]
+            assert submit["trace_id"] == am_run["trace_id"] == ex_run["trace_id"]
+
+            # RPC boundary link: some server span's parent is a client span
+            # recorded by ANOTHER process (in-band context propagation)
+            crossed = [
+                s for s in spans
+                if s["name"].startswith("rpc.server:")
+                and by_id.get(s["parent_id"], {}).get("identity") not in (None, s["identity"])
+            ]
+            assert crossed, "no cross-process rpc parent links resolved"
+
+            # the chaos injection rides as an event on the span it perturbed
+            chaos_spans = [
+                s for s in spans
+                if any(str(e.get("name", "")).startswith("chaos.") for e in s.get("events") or [])
+            ]
+            assert chaos_spans, "chaos fault not annotated on any span"
+            assert chaos_spans[0]["identity"] == "worker:0"
+
+            # merged Chrome trace is valid and carries the chain + annotation
+            chrome = to_chrome(spans)
+            blob = json.dumps(chrome)
+            assert json.loads(blob)["traceEvents"]
+            assert any(
+                e.get("ph") == "i" and str(e.get("name", "")).startswith("chaos.")
+                for e in chrome["traceEvents"]
+            )
+            assert "chaos" in summarize(spans)
+
+            # portal /metrics: parseable Prometheus text with a non-zero RPC
+            # latency histogram (this process ran the submit/monitor client)
+            server = serve(
+                os.path.join(str(tmp_tony_root), "history"), port=0,
+                staging_root=str(tmp_tony_root),
+            )
+            t = threading.Thread(target=server.serve_forever, daemon=True)
+            t.start()
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", server.server_address[1], timeout=10)
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                assert resp.status == 200
+                assert resp.getheader("Content-Type", "").startswith("text/plain")
+                text = resp.read().decode()
+            finally:
+                server.shutdown()
+                server.server_close()
+            for line in text.splitlines():  # exposition-format sanity
+                assert line.startswith("#") or " " in line
+            assert "# TYPE tony_rpc_client_latency_seconds histogram" in text
+            counts = [
+                int(line.rsplit(" ", 1)[1])
+                for line in text.splitlines()
+                if line.startswith("tony_rpc_client_latency_seconds_count")
+            ]
+            assert counts and sum(counts) > 0, "rpc latency histogram has zero counts"
+        finally:
+            obs_trace.shutdown()  # the in-process client installed a tracer
+
+
+@pytest.mark.obs
+class TestOverheadContract:
+    def test_disabled_costs_one_none_check(self):
+        """The documented contract: with tracing off, maybe_span/add_event
+        perform no allocation and Span construction is never reached."""
+        assert obs_trace.get() is None
+        ctx = obs_trace.maybe_span("hot-path")
+        for _ in range(3):
+            with ctx:
+                obs_trace.add_event("nope")
+        assert obs_trace.current_span() is None
+
+    def test_math_isfinite_guard(self):
+        # regression guard for the sparkline fix's helper usage
+        assert math.isfinite(1.0) and not math.isfinite(float("nan"))

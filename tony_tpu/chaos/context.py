@@ -35,6 +35,11 @@ from typing import Any, Mapping
 
 from tony_tpu import constants
 from tony_tpu.chaos.schedule import CONTAINER_FAULTS, FaultSchedule, FaultSpec
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import trace as obs_trace
+
+_INJECTIONS = obs_metrics.counter(
+    "tony_chaos_injections_total", "chaos faults actually injected", labelnames=("kind",))
 
 
 class ChaosContext:
@@ -158,6 +163,11 @@ class ChaosContext:
         if detail:
             rec.update(detail)
         self.injected.append(rec)
+        _INJECTIONS.inc(kind=f.kind)
+        # annotate the span this fault perturbs (e.g. rpc-drop fires inside
+        # the open rpc.client span) so `tony trace` shows the injection on
+        # the affected timeline slice; no-op when tracing is off
+        obs_trace.add_event(f"chaos.{f.kind}", fault=f.key, identity=self.identity)
         if self._log_path:
             try:
                 with open(self._log_path, "a") as fh:

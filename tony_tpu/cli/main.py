@@ -83,6 +83,12 @@ def _cmd_chaos(argv: list[str]) -> int:
     return chaos_main(argv)
 
 
+def _cmd_trace(argv: list[str]) -> int:
+    from tony_tpu.cli.trace import main as trace_main
+
+    return trace_main(argv)
+
+
 def _cmd_mini(argv: list[str]) -> int:
     """Self-contained sandbox: submit a smoke gang against the local resource
     manager and print the verdict + history location.
@@ -244,13 +250,14 @@ _COMMANDS = {
     "data-prep": _cmd_data_prep,
     "lint": _cmd_lint,
     "chaos": _cmd_chaos,
+    "trace": _cmd_trace,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|portal|notebook|serve|mini|data-prep|lint|chaos} [options]\n")
+        print("usage: tony {submit|pool|history|portal|notebook|serve|mini|data-prep|lint|chaos|trace} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    list finished jobs / dump one job's events")
@@ -261,6 +268,7 @@ def main(argv: list[str] | None = None) -> int:
         print("  data-prep  tokenize text files into TONYTOK training shards")
         print("  lint       run the AST static-analysis suite (config/jit/lock/mesh discipline)")
         print("  chaos      run a job under a seeded fault schedule and assert recovery invariants")
+        print("  trace      merge a traced job's spans into a Chrome/Perfetto timeline + summary")
         return 0
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:

@@ -1,0 +1,235 @@
+"""``tony trace <app_id>`` — reconstruct a job's distributed timeline.
+
+Merges the per-process span JSONL files every traced process appended under
+``<staging>/<app_id>/trace/`` (client, AM, each executor, each training
+child — obs/trace.py) into one Chrome trace-event JSON viewable in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``, plus a text critical-path
+summary: scheduler queue wait, the gang registration barrier, per-worker
+first-step (compile) time, checkpoint work, gang-restart epochs, and every
+chaos injection annotated on the span it perturbed.
+
+Mapping: one trace "process" per tony process identity (client / am /
+worker:N / worker:N:train), spans become complete ("X") events on their
+recording thread's lane, span point-events become instant ("i") events, and
+cross-process parent links become flow arrows ("s"/"f").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any
+
+from tony_tpu import constants
+
+
+def load_spans(trace_dir: str) -> list[dict[str, Any]]:
+    """All spans from every ``*.spans.jsonl`` under ``trace_dir``, sorted by
+    start time. Malformed lines (a process killed mid-write) are skipped."""
+    spans: list[dict[str, Any]] = []
+    if not os.path.isdir(trace_dir):
+        return spans
+    for fn in sorted(os.listdir(trace_dir)):
+        if not fn.endswith(".spans.jsonl"):
+            continue
+        with open(os.path.join(trace_dir, fn), errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(d, dict) and "span_id" in d and "start_ms" in d:
+                    spans.append(d)
+    spans.sort(key=lambda s: s.get("start_ms", 0.0))
+    return spans
+
+
+def to_chrome(spans: list[dict[str, Any]]) -> dict[str, Any]:
+    """Chrome trace-event JSON (Perfetto-viewable) from merged spans."""
+    identities: list[str] = []
+    for s in spans:
+        ident = s.get("identity", "?")
+        if ident not in identities:
+            identities.append(ident)
+    pid_of = {ident: i + 1 for i, ident in enumerate(identities)}
+    by_id = {s["span_id"]: s for s in spans}
+
+    events: list[dict[str, Any]] = []
+    for ident, pid in pid_of.items():
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": ident},
+        })
+    for s in spans:
+        pid = pid_of[s.get("identity", "?")]
+        tid = int(s.get("thread", 0)) % 10_000_000  # keep lanes readable
+        start_us = s["start_ms"] * 1000.0
+        dur_us = max((s.get("end_ms", s["start_ms"]) - s["start_ms"]) * 1000.0, 1.0)
+        events.append({
+            "ph": "X", "name": s.get("name", "?"), "cat": s.get("kind", "internal"),
+            "ts": start_us, "dur": dur_us, "pid": pid, "tid": tid,
+            "args": {
+                "span_id": s["span_id"],
+                "parent_id": s.get("parent_id"),
+                "status": s.get("status", "ok"),
+                **(s.get("attrs") or {}),
+            },
+        })
+        for ev in s.get("events") or []:
+            events.append({
+                "ph": "i", "name": ev.get("name", "?"), "cat": "event", "s": "t",
+                "ts": ev.get("ts_ms", s["start_ms"]) * 1000.0, "pid": pid, "tid": tid,
+                "args": ev.get("attrs") or {},
+            })
+        # cross-process causality as a flow arrow parent → child
+        parent = by_id.get(s.get("parent_id") or "")
+        if parent is not None and parent.get("identity") != s.get("identity"):
+            ppid = pid_of[parent.get("identity", "?")]
+            ptid = int(parent.get("thread", 0)) % 10_000_000
+            flow = {"cat": "trace", "name": "parent", "id": s["span_id"]}
+            events.append({**flow, "ph": "s", "ts": parent["start_ms"] * 1000.0,
+                           "pid": ppid, "tid": ptid})
+            events.append({**flow, "ph": "f", "bp": "e", "ts": start_us,
+                           "pid": pid, "tid": tid})
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"trace_id": spans[0].get("trace_id") if spans else None},
+    }
+
+
+def _dur_s(s: dict[str, Any]) -> float:
+    return max(s.get("end_ms", s["start_ms"]) - s["start_ms"], 0.0) / 1000.0
+
+
+def summarize(spans: list[dict[str, Any]]) -> str:
+    """Text critical-path summary of a merged trace."""
+    if not spans:
+        return "no spans found"
+    by_name: dict[str, list[dict[str, Any]]] = {}
+    for s in spans:
+        by_name.setdefault(s.get("name", "?"), []).append(s)
+    t0 = min(s["start_ms"] for s in spans)
+    t1 = max(s.get("end_ms", s["start_ms"]) for s in spans)
+    lines = [
+        f"trace {spans[0].get('trace_id')}: {len(spans)} spans from "
+        f"{len({s.get('identity') for s in spans})} processes, "
+        f"wall {(t1 - t0) / 1000.0:.2f}s",
+        "",
+        "critical path:",
+    ]
+
+    def item(label: str, text: str) -> None:
+        lines.append(f"  {label:<28} {text}")
+
+    queue = by_name.get("am.queue_wait", [])
+    item("scheduler queue wait", f"{sum(_dur_s(s) for s in queue):.2f}s "
+                                 f"({len(queue)} episode(s))" if queue else "none")
+    regs = by_name.get("executor.register", [])
+    if regs:
+        barrier_s = (max(s.get("end_ms", s["start_ms"]) for s in regs)
+                     - min(s["start_ms"] for s in regs)) / 1000.0
+        item("registration barrier", f"{barrier_s:.2f}s across {len(regs)} executor(s)")
+    else:
+        item("registration barrier", "no executor.register spans")
+    firsts = by_name.get("train.first_step", [])
+    if firsts:
+        worst = max(firsts, key=_dur_s)
+        item("first-step compile", f"max {_dur_s(worst):.2f}s ({worst.get('identity')})")
+    ckpts = by_name.get("ckpt.save", []) + by_name.get("ckpt.restore", [])
+    if ckpts:
+        item("checkpoint work", f"{sum(_dur_s(s) for s in ckpts):.2f}s "
+                                f"over {len(ckpts)} save/restore span(s)")
+    restarts = by_name.get("am.gang_restart", [])
+    if restarts:
+        reasons = "; ".join(
+            str((s.get("attrs") or {}).get("reason", "?")) for s in restarts
+        )
+        item("gang restarts", f"{len(restarts)} ({reasons})")
+    else:
+        item("gang restarts", "none")
+
+    chaos = [
+        (s, ev)
+        for s in spans
+        for ev in (s.get("events") or [])
+        if str(ev.get("name", "")).startswith("chaos.")
+    ]
+    if chaos:
+        lines.append("")
+        lines.append("chaos injections (annotated on the spans they perturbed):")
+        for s, ev in chaos:
+            lines.append(
+                f"  {ev['name']:<20} on {s.get('identity')}/{s.get('name')} "
+                f"at +{(ev.get('ts_ms', s['start_ms']) - t0) / 1000.0:.2f}s"
+            )
+
+    lines.append("")
+    lines.append("longest spans:")
+    for s in sorted(spans, key=_dur_s, reverse=True)[:5]:
+        lines.append(f"  {_dur_s(s):8.2f}s  {s.get('identity')}/{s.get('name')}")
+    return "\n".join(lines)
+
+
+def _configured_trace_dir(staging: str, app_id: str) -> str | None:
+    """The job's ``tony.trace.dir`` override from its frozen config, or None
+    (unset, or no frozen config found)."""
+    path = os.path.join(staging, app_id, constants.TONY_FINAL_CONF)
+    try:
+        from tony_tpu.config import TonyConfig, keys
+
+        return TonyConfig.load_final(path).get(keys.TRACE_DIR) or None
+    except (OSError, ValueError):
+        return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tony trace",
+        description="merge a traced job's span files into a Chrome trace-event "
+                    "timeline + critical-path summary (tony.trace.enabled=true)",
+    )
+    p.add_argument("app_id", help="application id (staging dir name)")
+    p.add_argument("--staging", default=None,
+                   help="staging root holding <app_id>/trace/ (default: $TONY_ROOT)")
+    p.add_argument("--trace-dir", default=None,
+                   help="span directory override (default: the job's "
+                        "tony.trace.dir from its frozen config, else "
+                        "<staging>/<app_id>/trace)")
+    p.add_argument("--out", default=None,
+                   help="Chrome trace JSON output path "
+                        "(default: <staging>/<app_id>/trace/trace.json; '-' for stdout)")
+    p.add_argument("--no-summary", action="store_true", help="skip the text summary")
+    args = p.parse_args(argv)
+
+    staging = args.staging or constants.default_tony_root()
+    trace_dir = args.trace_dir or _configured_trace_dir(staging, args.app_id) \
+        or os.path.join(staging, args.app_id, "trace")
+    spans = load_spans(trace_dir)
+    if not spans:
+        print(f"no spans under {trace_dir} — was the job run with "
+              f"tony.trace.enabled=true?")
+        return 1
+    chrome = to_chrome(spans)
+    if args.out == "-":
+        print(json.dumps(chrome))
+    else:
+        out = args.out or os.path.join(trace_dir, "trace.json")
+        with open(out, "w") as f:
+            json.dump(chrome, f)
+        print(f"[tony-trace] wrote {len(chrome['traceEvents'])} events to {out} "
+              "(open in https://ui.perfetto.dev or chrome://tracing)")
+    if not args.no_summary:
+        print()
+        print(summarize(spans))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

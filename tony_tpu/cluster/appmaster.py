@@ -30,6 +30,8 @@ from tony_tpu import constants
 from tony_tpu.chaos import ChaosContext
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.cluster import history
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import trace as obs_trace
 from tony_tpu.cluster.events import EventHandler, EventType
 from tony_tpu.cluster.resources import (
     AllocationError,
@@ -44,6 +46,13 @@ from tony_tpu.cluster.session import JobStatus, Session, TaskStatus
 from tony_tpu.runtime import get_runtime
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_QUEUE_WAIT = obs_metrics.histogram(
+    "tony_scheduler_queue_wait_seconds",
+    "time a gang spent queued behind other tenants before admission",
+    buckets=obs_metrics.WAIT_BUCKETS)
+_GANG_RESTARTS = obs_metrics.counter(
+    "tony_gang_restarts_total", "whole-gang restarts (failure, preemption, capacity loss)")
 
 
 def build_resource_manager(config: TonyConfig, app_id: str = "") -> ResourceManager:
@@ -111,6 +120,16 @@ class ApplicationMaster:
         self.config = config
         self.app_id = app_id
         self.staging_dir = staging_dir
+        obs_metrics.set_enabled(config.get_bool(keys.METRICS_ENABLED, True))
+        # tracing (tony.trace.*): None — and zero-cost — unless enabled; the
+        # root span parent arrives from the submitting client via env
+        self.tracer = obs_trace.init_from_config(
+            config, identity="am", staging_dir=staging_dir, app_id=app_id,
+            parent_id=os.environ.get(constants.ENV_TRACE_PARENT),
+        )
+        self._root_span: obs_trace.Span | None = None
+        self._root_token = None
+        self._queue_wait_started: float | None = None
         # fault injection (tony.chaos.*): None — and zero-cost — unless
         # configured; container faults ride the RM's poll_exited seam
         self.chaos = ChaosContext.from_config(config, identity="am", staging_dir=staging_dir)
@@ -261,8 +280,32 @@ class ApplicationMaster:
             session.get_task(job_name, index).metrics = metrics
         return {"ack": True}
 
+    def get_metrics(self) -> dict[str, Any]:
+        """This AM process's metrics-registry snapshot (obs/metrics.py) plus
+        the latest registry snapshot each executor piggybacked on its metrics
+        push — the portal merges them into /metrics under app=<id> (and
+        task=<job:idx> for the executor groups)."""
+        tasks: dict[str, Any] = {}
+        for t in self.session.task_infos():
+            obs = (t.get("metrics") or {}).get("obs_metrics")
+            if obs:
+                tasks[f"{t['name']}:{t['index']}"] = obs
+        return {
+            "app_id": self.app_id,
+            "identity": "am",
+            "metrics": obs_metrics.REGISTRY.snapshot(),
+            "tasks": tasks,
+        }
+
     # ------------------------------------------------------------ lifecycle
     def prepare(self) -> None:
+        if self.tracer is not None:
+            # the root span stays open for the AM's whole life (ended in
+            # stop()); re-pointing root_parent at it makes every span opened
+            # on a bare thread (RPC handlers, monitor loop) nest under it
+            self._root_span, self._root_token = self.tracer.start_span("am.run")
+            self._root_span.set(app_id=self.app_id)
+            self.tracer.root_parent = self._root_span.span_id
         self.runtime.validate()
         self.rpc.register_object(self, APPLICATION_RPC_METHODS)
         self.rpc.start()
@@ -289,6 +332,26 @@ class ApplicationMaster:
         self.session.job_status = JobStatus.RUNNING
 
     def _launch_type(self, job_type: str) -> None:
+        if self.tracer is None:
+            return self._launch_type_spanned(job_type)
+        sp, token = self.tracer.start_span("am.launch")
+        sp.set(job_type=job_type, attempt=self._restart_attempt)
+        try:
+            result = self._launch_type_spanned(job_type)
+        except AllocationPending:
+            # expected control flow while queued behind other tenants — the
+            # monitor loop retries every tick, and one error span per tick
+            # would bury the timeline (the wait itself is the am.queue_wait
+            # span); drop this span unwritten
+            self.tracer.discard_span(sp, token)
+            raise
+        except BaseException:
+            self.tracer.end_span(sp, token, status="error")
+            raise
+        self.tracer.end_span(sp, token)
+        return result
+
+    def _launch_type_spanned(self, job_type: str) -> None:
         for container in self.scheduler.allocate_type(job_type):
             task = self.session.get_task(job_type, container.task_index)
             task.status = TaskStatus.SCHEDULED
@@ -335,6 +398,10 @@ class ApplicationMaster:
                 "PYTHONPATH": _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", ""),
             }
         )
+        if self.tracer is not None and self._root_span is not None:
+            # executor root spans link under am.run (trace dir + enablement
+            # come from the frozen config the executor loads itself)
+            env[constants.ENV_TRACE_PARENT] = self._root_span.span_id
         cmd = [sys.executable, "-u", "-m", "tony_tpu.cluster.executor"]
         if self.config.get_bool(keys.DOCKER_ENABLED):
             # YARN docker-runtime env passthrough analog: the RM (NM analog)
@@ -483,6 +550,14 @@ class ApplicationMaster:
             self._failures_seen += 1
             if self._failures_seen > budget:
                 return False
+        _GANG_RESTARTS.inc()
+        with obs_trace.maybe_span(
+            "am.gang_restart", reason=reason,
+            attempt=self._restart_attempt + 1, preempted=preempted,
+        ):
+            return self._restart_gang_spanned(reason, shrink)
+
+    def _restart_gang_spanned(self, reason: str, shrink: dict[str, int] | None) -> bool:
         self.events.emit(EventType.HEARTBEAT_LOST, reason=f"gang restart: {reason}")
         self._kill_all_containers()
         for c in list(self._containers.values()):
@@ -530,11 +605,22 @@ class ApplicationMaster:
                 if self._queue_waiting:
                     self._queue_waiting = False
                     self.events.emit(EventType.QUEUE_WAIT, state="admitted")
+                    if self._queue_wait_started is not None:
+                        waited_s = time.monotonic() - self._queue_wait_started
+                        self._queue_wait_started = None
+                        _QUEUE_WAIT.observe(waited_s)
+                        if self.tracer is not None:
+                            # reconstruct the wait episode as one span (its
+                            # start is backdated to when queueing began) so
+                            # `tony trace` can put queue wait on the timeline
+                            with self.tracer.span("am.queue_wait") as sp:
+                                sp.start_ms -= waited_s * 1000.0
             except AllocationPending as e:
                 # queued behind other tenants: wait (don't fail) and retry
                 # the whole type next tick; emit one event per wait episode
                 if not self._queue_waiting:
                     self._queue_waiting = True
+                    self._queue_wait_started = time.monotonic()
                     self.events.emit(EventType.QUEUE_WAIT, state="waiting", reason=str(e))
                 # mid-wait elastic check (throttled): if capacity was lost
                 # for good while we queued, shrink instead of waiting forever
@@ -573,7 +659,13 @@ class ApplicationMaster:
             if now - last_metrics_emit >= metrics_every_s:
                 last_metrics_emit = now
                 snap = [
-                    {"task": f"{t['name']}:{t['index']}", "metrics": t["metrics"]}
+                    # obs_metrics (the executor's piggybacked registry) is
+                    # exposition-only — snapshotting it into the .jhist would
+                    # bloat every event with full histogram state
+                    {
+                        "task": f"{t['name']}:{t['index']}",
+                        "metrics": {k: v for k, v in t["metrics"].items() if k != "obs_metrics"},
+                    }
                     for t in self.session.task_infos()
                     if t.get("metrics")
                 ]
@@ -661,6 +753,14 @@ class ApplicationMaster:
             )
         except OSError:
             pass  # history must never change the job verdict
+        if self.tracer is not None and self._root_span is not None:
+            # flush am.run BEFORE am_status.json: the status file is the
+            # client's completion signal, and a `tony trace` run the moment
+            # monitor_application returns must find the root span on disk
+            self._root_span.set(status=final.value, restart_attempts=self._restart_attempt)
+            self.tracer.end_span(self._root_span, self._root_token)
+            self._root_span = None
+            obs_trace.shutdown()
         _atomic_write_json(
             os.path.join(self.staging_dir, "am_status.json"),
             {

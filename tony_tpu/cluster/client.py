@@ -27,6 +27,8 @@ from tony_tpu import constants
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.cluster.rpc import RpcClient, RpcError
 from tony_tpu.cluster.session import JobStatus
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import trace as obs_trace
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -105,9 +107,25 @@ class Client:
             self.config.freeze()
         self.config.write_final(staging_dir)
 
+        obs_metrics.set_enabled(self.config.get_bool(keys.METRICS_ENABLED, True))
+        # tracing (tony.trace.*): the submit span becomes the whole trace's
+        # root — the AM links under it via TONY_TRACE_PARENT, executors under
+        # the AM, training children under their executor
+        tracer = obs_trace.init_from_config(
+            self.config, identity="client", staging_dir=staging_dir, app_id=app_id
+        )
+        submit_span = submit_token = None
+        if tracer is not None:
+            submit_span, submit_token = tracer.start_span("client.submit", kind="client")
+            submit_span.set(app_id=app_id)
+            # later client spans (monitor polls) nest under the submit span
+            tracer.root_parent = submit_span.span_id
+
         # launch the AM as a detached process (process boundary #1)
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        if submit_span is not None:
+            env[constants.ENV_TRACE_PARENT] = submit_span.span_id
         with open(os.path.join(staging_dir, "am.log"), "ab") as am_log:
             proc = subprocess.Popen(
                 [
@@ -125,6 +143,8 @@ class Client:
                 stderr=subprocess.STDOUT,
                 start_new_session=True,
             )
+        if tracer is not None:
+            tracer.end_span(submit_span, submit_token)
         self._notify("app_id", app_id)
         return ApplicationHandle(app_id, staging_dir, proc)
 

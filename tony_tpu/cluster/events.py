@@ -38,9 +38,39 @@ class EventType(enum.Enum):
     APPLICATION_FINISHED = "APPLICATION_FINISHED"
 
 
+class UnknownEventType:
+    """Forward-compat stand-in for an event type this build doesn't declare.
+
+    A ``.jhist`` written by a NEWER tony (e.g. carrying trace/metrics
+    snapshot events) must stay readable by older portals and ``tony
+    history`` — refusing the whole file over one unrecognized type would
+    break every rolling upgrade. Mirrors the ``EventType`` surface readers
+    touch (``.value``/``.name``, equality, hashing) so event consumers work
+    unchanged.
+    """
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: str):
+        self.value = value
+
+    @property
+    def name(self) -> str:
+        return self.value
+
+    def __eq__(self, other: object) -> bool:
+        return getattr(other, "value", None) == self.value
+
+    def __hash__(self) -> int:
+        return hash(self.value)
+
+    def __repr__(self) -> str:
+        return f"UnknownEventType({self.value!r})"
+
+
 @dataclass
 class Event:
-    type: EventType
+    type: "EventType | UnknownEventType"
     payload: dict[str, Any] = field(default_factory=dict)
     timestamp_ms: int = 0
 
@@ -56,7 +86,12 @@ class Event:
     @classmethod
     def from_json(cls, line: str) -> "Event":
         d = json.loads(line)
-        return cls(EventType(d["type"]), d.get("payload", {}), d.get("timestamp_ms", 0))
+        raw = d.get("type", "")
+        try:
+            etype: "EventType | UnknownEventType" = EventType(raw)
+        except ValueError:
+            etype = UnknownEventType(raw)  # tolerate newer writers
+        return cls(etype, d.get("payload", {}), d.get("timestamp_ms", 0))
 
 
 class EventHandler:

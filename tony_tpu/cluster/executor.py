@@ -24,7 +24,12 @@ from tony_tpu.chaos import ChaosContext
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.cluster.metrics import MetricsSampler
 from tony_tpu.cluster.rpc import RpcClient, RpcError
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import trace as obs_trace
 from tony_tpu.runtime import get_runtime
+
+_HB_RTT = obs_metrics.histogram(
+    "tony_heartbeat_rtt_seconds", "executor → AM heartbeat round-trip time")
 
 
 def pick_free_port(host: str = "127.0.0.1") -> int:
@@ -53,6 +58,16 @@ class TaskExecutor:
         self.index = int(env[constants.ENV_TASK_INDEX])
         am_host = env.get(constants.ENV_AM_HOST, "127.0.0.1")
         self.config = TonyConfig.load_final(os.path.join(self.staging_dir, constants.TONY_FINAL_CONF))
+        obs_metrics.set_enabled(self.config.get_bool(keys.METRICS_ENABLED, True))
+        # tracing (tony.trace.*): the root span parents under the AM's via
+        # TONY_TRACE_PARENT; None — and zero-cost — unless enabled
+        self.tracer = obs_trace.init_from_config(
+            self.config, identity=f"{self.job_name}:{self.index}",
+            staging_dir=self.staging_dir, app_id=self.app_id,
+            parent_id=env.get(constants.ENV_TRACE_PARENT),
+        )
+        self._root_span: obs_trace.Span | None = None
+        self._root_token = None
         # fault injection (tony.chaos.*, docs/fault-tolerance.md): None —
         # and zero-cost — unless a schedule is configured
         self.chaos = ChaosContext.from_config(
@@ -151,6 +166,15 @@ class TaskExecutor:
             # points (checkpoint restore) read the schedule from env
             env[constants.ENV_CHAOS_SPEC] = self.config.get(keys.CHAOS_SPEC) or ""
             env[constants.ENV_CHAOS_SEED] = str(self.config.get_int(keys.CHAOS_SEED, 0))
+        if self.tracer is not None:
+            # child-process tracing contract (train loop + checkpoint spans):
+            # the child's root span links under this executor's
+            env[constants.ENV_TRACE_ENABLED] = "1"
+            env[constants.ENV_TRACE_DIR] = self.tracer.trace_dir
+            if self._root_span is not None:
+                env[constants.ENV_TRACE_PARENT] = self._root_span.span_id
+        if not self.config.get_bool(keys.METRICS_ENABLED, True):
+            env[constants.ENV_METRICS_ENABLED] = "0"  # child honors the job's opt-out
         if self.config.get_bool(keys.TASK_PROFILE):
             from tony_tpu.train import profiling
 
@@ -227,10 +251,11 @@ class TaskExecutor:
         # is still compiling
         path = getattr(self, "_train_metrics_path", None)
         if path:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+            for stale in (path, path + ".obs"):
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
         cwd = None
         src_dir = self.config.get(keys.SRC_DIR)
         if src_dir:
@@ -254,12 +279,14 @@ class TaskExecutor:
             if stalled:
                 continue
             try:
+                t0 = time.perf_counter()
                 self.rpc.call(
                     "task_executor_heartbeat",
                     job_name=self.job_name,
                     index=self.index,
                     attempt=self.attempt,
                 )
+                _HB_RTT.observe(time.perf_counter() - t0)
                 self._hb_failures = 0
             except (RpcError, OSError):
                 self._hb_failures += 1
@@ -284,6 +311,16 @@ class TaskExecutor:
                 train = self._read_train_metrics()
                 if train is not None:
                     m["train"] = train
+                # piggyback this process's metrics registry (heartbeat RTT,
+                # rpc client latency, ...) on the push — plus the training
+                # child's snapshot (checkpoint/step-time instruments) dropped
+                # next to its step report: executors have no exposition
+                # endpoint, so the AM re-exports these per task through
+                # get_metrics → portal /metrics
+                obs_snap = [e for e in obs_metrics.REGISTRY.snapshot() if e["samples"]]
+                obs_snap.extend(self._read_child_obs_metrics() or [])
+                if obs_snap:
+                    m["obs_metrics"] = obs_snap
                 self.rpc.call(
                     "push_metrics",
                     job_name=self.job_name,
@@ -293,6 +330,21 @@ class TaskExecutor:
                 )
             except (RpcError, OSError):
                 pass  # metrics are best-effort; liveness is the heartbeat's job
+
+    def _read_child_obs_metrics(self):
+        """The training child's metrics-registry snapshot (atomic drop at
+        <train-metrics-file>.obs, loop.py _drop_obs_metrics), or None."""
+        path = getattr(self, "_train_metrics_path", None)
+        if not path:
+            return None
+        try:
+            import json as _json
+
+            with open(path + ".obs") as f:
+                snap = _json.load(f)
+            return snap if isinstance(snap, list) else None
+        except (OSError, ValueError):
+            return None
 
     def _read_train_metrics(self):
         """Latest step report the training loop dropped (atomic rename
@@ -364,9 +416,30 @@ class TaskExecutor:
 
     # -- main --------------------------------------------------------------
     def run(self) -> int:
+        if self.tracer is None:
+            return self._run_supervised()
+        # root span for this executor's whole life, ended on the way out;
+        # root_parent re-points at it so the heartbeat/metrics threads'
+        # RPC spans nest under it (os._exit paths lose only open spans)
+        self._root_span, self._root_token = self.tracer.start_span("executor.run")
+        self._root_span.set(task=f"{self.job_name}:{self.index}", attempt=self.attempt)
+        self.tracer.root_parent = self._root_span.span_id
+        rc: int | None = None
+        try:
+            rc = self._run_supervised()
+            return rc
+        finally:
+            self._root_span.set(exit_code=rc)
+            self.tracer.end_span(
+                self._root_span, self._root_token, status="ok" if rc == 0 else "error"
+            )
+            obs_trace.shutdown()
+
+    def _run_supervised(self) -> int:
         signal.signal(signal.SIGTERM, lambda *_: (_sigterm(self)))
         try:
-            self.register()
+            with obs_trace.maybe_span("executor.register"):
+                self.register()
             self._chaos_point("registered")
             # heartbeat starts at registration, not child launch: the gang
             # barrier can legitimately outlast the liveness window (dependency-
@@ -374,7 +447,8 @@ class TaskExecutor:
             # (A wedged executor whose heartbeats stop while its process lives
             # is simulated by the chaos `hb-stall` fault inside the loop.)
             threading.Thread(target=self._heartbeat_loop, name="heartbeat", daemon=True).start()
-            spec, extra_env = self.await_cluster_spec()
+            with obs_trace.maybe_span("executor.await_spec"):
+                spec, extra_env = self.await_cluster_spec()
             self._chaos_point("gang_complete")
             command = self.resolve_command()
             env = self.build_child_env(spec, extra_env)
@@ -413,13 +487,15 @@ class TaskExecutor:
 
         timeout_ms = self.config.get_time_ms(keys.TASK_EXECUTOR_EXECUTION_TIMEOUT_MS, 0)
         reason = ""
-        try:
-            rc = self.child.wait(timeout=timeout_ms / 1000 if timeout_ms else None)
-        except subprocess.TimeoutExpired:
-            self._kill_child()
-            rc = constants.EXIT_EXECUTION_TIMEOUT
-            reason = f"execution timeout: killed after {timeout_ms}ms (tony.task.execution-timeout-ms)"
-            print(f"[tony-executor] {reason}", file=sys.stderr, flush=True)
+        with obs_trace.maybe_span("executor.child", pid=self.child.pid):
+            try:
+                rc = self.child.wait(timeout=timeout_ms / 1000 if timeout_ms else None)
+            except subprocess.TimeoutExpired:
+                self._kill_child()
+                rc = constants.EXIT_EXECUTION_TIMEOUT
+                reason = f"execution timeout: killed after {timeout_ms}ms (tony.task.execution-timeout-ms)"
+                print(f"[tony-executor] {reason}", file=sys.stderr, flush=True)
+            obs_trace.add_event("child.exited", exit_code=rc)
         self._stop.set()
         try:
             self.rpc.call_with_retry(

@@ -51,6 +51,7 @@ from tony_tpu.cluster.resources import (
     SliceSpec,
 )
 from tony_tpu.cluster.rpc import RpcClient, RpcError, RpcServer
+from tony_tpu.obs import metrics as obs_metrics
 
 POOL_RPC_METHODS = [
     "register_node",
@@ -63,7 +64,15 @@ POOL_RPC_METHODS = [
     "request_kill",
     "pool_status",
     "cluster_capacity",
+    "pool_metrics",
 ]
+
+_POOL_ADMISSIONS = obs_metrics.counter(
+    "tony_pool_admissions_total", "apps admitted by the capacity scheduler", labelnames=("queue",))
+_POOL_EVICTIONS = obs_metrics.counter(
+    "tony_pool_evictions_total", "apps preempted back to waiting", labelnames=("queue",))
+_POOL_ALLOCATE_QUEUED = obs_metrics.counter(
+    "tony_pool_allocate_queued_total", "allocate() calls answered with wait (queued)")
 
 _RUNNING, _EXITED, _RELEASED = "RUNNING", "EXITED", "RELEASED"
 
@@ -381,6 +390,7 @@ class PoolService:
                         f"budget {budget_s:.1f}s) — pool agents look permanently "
                         f"dead; cannot host {job_type}:{task_index}"
                     )
+                _POOL_ALLOCATE_QUEUED.inc()
                 return {
                     "wait": True, "queue": "", "position": 0,
                     "reason": "all pool nodes currently unreachable",
@@ -446,6 +456,7 @@ class PoolService:
                     if a.queue == app.queue and not a.admitted
                 ]
                 waiting.sort(key=lambda a: a.sort_key)
+                _POOL_ALLOCATE_QUEUED.inc()
                 return {
                     "wait": True,
                     "queue": app.queue,
@@ -500,6 +511,7 @@ class PoolService:
             # ADMITTED but nothing fits right now (other tenants' containers
             # still draining, or fragmentation): transient — the app keeps
             # its claim and the AM retries. Never-fit asks were rejected above.
+            _POOL_ALLOCATE_QUEUED.inc()
             return {
                 "wait": True,
                 "queue": app.queue,
@@ -543,6 +555,12 @@ class PoolService:
             if rec is not None:
                 self._request_kill_locked(rec)
         return {"ack": True}
+
+    def pool_metrics(self) -> dict[str, Any]:
+        """This pool-service process's metrics-registry snapshot
+        (obs/metrics.py) — scrapeable through any RPC client, same shape as
+        the AM's ``get_metrics``."""
+        return {"identity": "pool", "metrics": obs_metrics.REGISTRY.snapshot()}
 
     def pool_status(self) -> dict[str, Any]:
         with self._lock:
@@ -674,6 +692,7 @@ class PoolService:
 
         def admit(app: _App) -> None:
             app.admitted, app.preempted = True, False
+            _POOL_ADMISSIONS.inc(queue=app.queue)
             d = demand_of(app)
             for i in range(3):
                 free[i] -= d[i]
@@ -798,6 +817,7 @@ class PoolService:
         preemption so the AM's failure budget is never charged)."""
         c = self._claim_locked(v)
         v.admitted, v.preempted = False, True
+        _POOL_EVICTIONS.inc(queue=v.queue)
         v.wait_since = time.monotonic()
         claims.pop(v.app_id, None)
         for i in range(3):

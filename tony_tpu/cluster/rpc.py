@@ -7,8 +7,16 @@ threaded server with a shared-secret auth token is the idiomatic analog; the
 data plane never touches this path (it rides ICI/DCN inside XLA).
 
 Wire format: 4-byte big-endian length, then a UTF-8 JSON object.
-Request:  {"method": str, "params": {...}, "auth": str}
+Request:  {"method": str, "params": {...}, "auth": str[, "trace": {"t","s"}]}
 Response: {"ok": true, "result": ...} | {"ok": false, "error": str}
+
+Observability (docs/observability.md): when tracing is enabled the client
+injects its span context as the optional ``trace`` field and the server
+parents its handler span on it — causal links cross the RPC boundary in-band.
+Old servers ignore the extra field; when tracing is off (the default) the
+request is byte-identical to before and no span is allocated. Latency
+histograms and retry counters record into the process metrics registry
+unconditionally (control-plane rate).
 """
 
 from __future__ import annotations
@@ -22,11 +30,33 @@ import threading
 import time
 from typing import TYPE_CHECKING, Any, Callable
 
+from tony_tpu.obs import metrics as _metrics
+from tony_tpu.obs import trace as _trace
+
 if TYPE_CHECKING:
     from tony_tpu.chaos import ChaosContext
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
+
+_CLIENT_LATENCY = _metrics.histogram(
+    "tony_rpc_client_latency_seconds",
+    "RPC client round-trip latency (successful calls)", labelnames=("method",))
+_CLIENT_ERRORS = _metrics.counter(
+    "tony_rpc_client_errors_total",
+    "RPC client calls that raised (connect/transport/remote error)", labelnames=("method",))
+_SERVER_LATENCY = _metrics.histogram(
+    "tony_rpc_server_latency_seconds",
+    "RPC server dispatch latency (auth + handler)", labelnames=("method",))
+_SERVER_ERRORS = _metrics.counter(
+    "tony_rpc_server_errors_total",
+    "RPC dispatches answered with an error frame", labelnames=("method",))
+_RETRY_ATTEMPTS = _metrics.counter(
+    "tony_rpc_retry_attempts_total",
+    "failed attempts inside call_with_retry", labelnames=("method",))
+_RETRY_BACKOFF = _metrics.counter(
+    "tony_rpc_retry_backoff_seconds_total",
+    "total backoff sleep inside call_with_retry", labelnames=("method",))
 
 
 class RpcError(RuntimeError):
@@ -87,16 +117,31 @@ class RpcServer:
         self._thread = threading.Thread(target=self._server.serve_forever, name="rpc-server", daemon=True)
 
     def _dispatch(self, req: Any) -> dict[str, Any]:
+        t0 = time.perf_counter()
+        name = ""
         try:
             if not isinstance(req, dict):
                 raise RpcError("malformed request")
             if self._secret and req.get("auth") != self._secret:
                 raise RpcError("authentication failed")
-            method = self._methods.get(req.get("method", ""))
+            name = req.get("method", "")
+            method = self._methods.get(name)
             if method is None:
-                raise RpcError(f"unknown method: {req.get('method')!r}")
-            return {"ok": True, "result": method(**(req.get("params") or {}))}
+                raise RpcError(f"unknown method: {name!r}")
+            params = req.get("params") or {}
+            tr = _trace.get()
+            if tr is None:  # disabled: the incoming trace field (if any) is ignored
+                result = method(**params)
+            else:
+                ctx = req.get("trace") or {}
+                with tr.span(f"rpc.server:{name}", kind="server",
+                             parent_id=ctx.get("s")):
+                    result = method(**params)
+            _SERVER_LATENCY.observe(time.perf_counter() - t0, method=name)
+            return {"ok": True, "result": result}
         except Exception as e:  # noqa: BLE001 — fault isolation at the RPC boundary
+            _SERVER_ERRORS.inc(method=name or "?")
+            _SERVER_LATENCY.observe(time.perf_counter() - t0, method=name or "?")
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
     def register(self, name: str, fn: Callable[..., Any]) -> None:
@@ -159,25 +204,46 @@ class RpcClient:
                     self._sock = None
 
     def call(self, method: str, **params: Any) -> Any:
-        with self._lock:
-            for attempt in (0, 1):  # one transparent reconnect on a stale socket
-                try:
-                    if self.chaos is not None:
-                        # may sleep (rpc-delay) or raise (rpc-drop/blackhole)
-                        self.chaos.rpc_before_send(method, self.timeout_s)
-                    sock = self._connect()
-                    _send_frame(sock, {"method": method, "params": params, "auth": self.secret})
-                    if self.chaos is not None and self.chaos.rpc_sever_after_send(method):
-                        sock.close()  # response lost mid-call (server may have executed)
-                    resp = _recv_frame(sock)
-                    break
-                except (ConnectionError, OSError):
-                    self._sock = None
-                    if attempt:
-                        raise
-            if not resp.get("ok"):
-                raise RpcError(resp.get("error", "unknown remote error"))
-            return resp.get("result")
+        tr = _trace.get()
+        if tr is None:  # disabled fast path: no span objects, no trace field
+            return self._observed_call(method, params, None)
+        with tr.span(f"rpc.client:{method}", kind="client") as sp:
+            return self._observed_call(
+                method, params, {"t": sp.trace_id, "s": sp.span_id}
+            )
+
+    def _observed_call(
+        self, method: str, params: dict[str, Any], trace_ctx: dict[str, str] | None
+    ) -> Any:
+        t0 = time.perf_counter()
+        try:
+            with self._lock:
+                for attempt in (0, 1):  # one transparent reconnect on a stale socket
+                    try:
+                        if self.chaos is not None:
+                            # may sleep (rpc-delay) or raise (rpc-drop/blackhole)
+                            self.chaos.rpc_before_send(method, self.timeout_s)
+                        sock = self._connect()
+                        req: dict[str, Any] = {"method": method, "params": params, "auth": self.secret}
+                        if trace_ctx is not None:
+                            req["trace"] = trace_ctx
+                        _send_frame(sock, req)
+                        if self.chaos is not None and self.chaos.rpc_sever_after_send(method):
+                            sock.close()  # response lost mid-call (server may have executed)
+                        resp = _recv_frame(sock)
+                        break
+                    except (ConnectionError, OSError):
+                        self._sock = None
+                        if attempt:
+                            raise
+                if not resp.get("ok"):
+                    raise RpcError(resp.get("error", "unknown remote error"))
+                result = resp.get("result")
+        except Exception:
+            _CLIENT_ERRORS.inc(method=method)
+            raise
+        _CLIENT_LATENCY.observe(time.perf_counter() - t0, method=method)
+        return result
 
     def call_with_retry(
         self,
@@ -204,6 +270,8 @@ class RpcClient:
                 return self.call(method, **params)
             except (ConnectionError, OSError, RpcError) as e:
                 last = e
+                _RETRY_ATTEMPTS.inc(method=method)
+                _trace.add_event("rpc.retry", method=method, attempt=attempt, error=str(e)[:200])
                 if attempt + 1 >= retries:
                     break
                 cap = min(max_delay_s, delay_s * (2 ** min(attempt, 32)))
@@ -216,6 +284,7 @@ class RpcClient:
                             f"after {attempt + 1} attempts: {last}"
                         ) from last
                     sleep = min(sleep, remaining)
+                _RETRY_BACKOFF.inc(sleep, method=method)
                 time.sleep(sleep)
         raise RpcError(f"{method} failed after {retries} retries: {last}")
 
@@ -232,4 +301,5 @@ APPLICATION_RPC_METHODS = [
     "get_application_status",
     "finish_application",
     "push_metrics",          # MetricsRpc analog
+    "get_metrics",           # process metrics-registry snapshot (obs/metrics.py)
 ]

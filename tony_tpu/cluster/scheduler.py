@@ -25,6 +25,12 @@ from tony_tpu.cluster.resources import (
     Resources,
 )
 from tony_tpu.cluster.session import Session
+from tony_tpu.obs import metrics as obs_metrics
+
+_ALLOCATE_SECONDS = obs_metrics.histogram(
+    "tony_scheduler_allocate_seconds",
+    "whole-gang allocation latency per job type (successful gangs)",
+    labelnames=("job_type",))
 
 
 @dataclass
@@ -115,6 +121,7 @@ class TaskScheduler:
         """
         plan = self.plans[job_type]
         got: list[Container] = []
+        t0 = time.perf_counter()
         try:
             for i in range(plan.instances):
                 got.append(self.rm.allocate(job_type, i, plan.resources))
@@ -122,6 +129,7 @@ class TaskScheduler:
             for c in got:
                 self.rm.release(c)
             raise
+        _ALLOCATE_SECONDS.observe(time.perf_counter() - t0, job_type=job_type)
         plan.launched = True
         return got
 

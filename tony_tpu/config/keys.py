@@ -144,6 +144,22 @@ CHAOS_SPEC = "tony.chaos.spec"
 CHAOS_SEED = "tony.chaos.seed"
 
 # ---------------------------------------------------------------------------
+# tony.trace.* / tony.metrics.* — observability (docs/observability.md)
+# ---------------------------------------------------------------------------
+# Distributed tracing: one trace per job (trace_id = app_id), spans appended
+# to <staging>/trace/<identity>.spans.jsonl per process, context propagated
+# in-band through RPC frames and via TONY_TRACE_PARENT across process spawns.
+# Disabled (the default) costs one None check per hook and allocates nothing.
+TRACE_ENABLED = "tony.trace.enabled"
+# Span sink directory override; empty → <staging>/trace
+TRACE_DIR = "tony.trace.dir"
+# Process-wide metrics registry (RPC latency histograms, retry/backoff
+# counters, heartbeat RTT, queue wait, checkpoint durations, sampled train
+# step time) — exposed at the portal's /metrics (Prometheus text) and the
+# AM's get_metrics RPC. false turns every recording call into a no-op.
+METRICS_ENABLED = "tony.metrics.enabled"
+
+# ---------------------------------------------------------------------------
 # tony.checkpoint.* — gang-restart-from-checkpoint (rebuild-only; SURVEY §5.3/5.4)
 # ---------------------------------------------------------------------------
 CHECKPOINT_DIR = "tony.checkpoint.dir"
@@ -221,6 +237,10 @@ DEFAULTS: dict[str, str] = {
 
     CHAOS_SPEC: "",
     CHAOS_SEED: "0",
+
+    TRACE_ENABLED: "false",
+    TRACE_DIR: "",                   # empty → <staging>/trace
+    METRICS_ENABLED: "true",
 
     CHECKPOINT_DIR: "",
     CHECKPOINT_INTERVAL_STEPS: "0",

@@ -59,6 +59,12 @@ ENV_CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"            # from tony.checkpoint.dir
 ENV_CHECKPOINT_INTERVAL = "TONY_CHECKPOINT_INTERVAL"  # from tony.checkpoint.interval-steps
 ENV_CHAOS_SPEC = "TONY_CHAOS_SPEC"    # from tony.chaos.spec (child-process chaos contract)
 ENV_CHAOS_SEED = "TONY_CHAOS_SEED"    # from tony.chaos.seed
+# Tracing contract across process spawns (tony.trace.*, docs/observability.md):
+# parents export these so the child's root span links under theirs
+ENV_TRACE_ENABLED = "TONY_TRACE_ENABLED"  # "1" → tracing on in this process tree
+ENV_TRACE_DIR = "TONY_TRACE_DIR"          # span JSONL sink dir (<staging>/trace)
+ENV_TRACE_PARENT = "TONY_TRACE_PARENT"    # parent span id for this process's root span
+ENV_METRICS_ENABLED = "TONY_METRICS_ENABLED"  # "0" → child metrics recording off (tony.metrics.enabled)
 ENV_NOTEBOOK_PORT = "NOTEBOOK_PORT"     # notebook task port (proxied by submitter)
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,19 @@
+"""Observability: distributed tracing (trace.py) + metrics registry (metrics.py).
+
+Docs: docs/observability.md. Disabled tracing (the default) costs one None
+check per hook; metrics recording is gated by ``tony.metrics.enabled``.
+"""
+
+from tony_tpu.obs import metrics, trace
+from tony_tpu.obs.metrics import REGISTRY, MetricsRegistry, render_merged
+from tony_tpu.obs.trace import Span, Tracer
+
+__all__ = [
+    "metrics",
+    "trace",
+    "REGISTRY",
+    "MetricsRegistry",
+    "render_merged",
+    "Span",
+    "Tracer",
+]

@@ -25,6 +25,7 @@ from __future__ import annotations
 import argparse
 import html
 import json
+import math
 import os
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse
@@ -32,6 +33,7 @@ from urllib.parse import urlparse
 from tony_tpu import constants
 from tony_tpu.cluster import history
 from tony_tpu.cluster.events import Event
+from tony_tpu.obs.metrics import REGISTRY, render_merged
 
 _STYLE = """
 body{font-family:system-ui,sans-serif;margin:2em;color:#222}
@@ -50,12 +52,19 @@ def _page(title: str, body: str) -> bytes:
     return (
         f"<!doctype html><html><head><title>{html.escape(title)}</title>"
         f"<style>{_STYLE}</style></head><body><h1>{html.escape(title)}</h1>"
-        f'<p><a href="/">← jobs</a> · <a href="/pool">pool</a></p>{body}</body></html>'
+        f'<p><a href="/">← jobs</a> · <a href="/pool">pool</a> · '
+        f'<a href="/metrics">metrics</a></p>{body}</body></html>'
     ).encode()
 
 
 def _sparkline(values: list[float], label: str, w: int = 220, h: int = 48) -> str:
-    """Inline SVG polyline — no JS, renders anywhere."""
+    """Inline SVG polyline — no JS, renders anywhere.
+
+    Non-finite values (NaN/inf loss from a diverged run) are dropped first:
+    they would poison min/max and emit a broken SVG point list. Fewer than 2
+    finite points → no chart.
+    """
+    values = [v for v in values if math.isfinite(v)]
     if len(values) < 2:
         return ""
     lo, hi = min(values), max(values)
@@ -93,6 +102,13 @@ class PortalHandler(BaseHTTPRequestHandler):
         try:
             if path == "":
                 self._send(self._job_list())
+            elif path == "/metrics":
+                # Prometheus exposition: this portal's registry + every
+                # running AM's (get_metrics RPC), labeled app=<id>
+                self._send(
+                    self._metrics_text().encode(),
+                    ctype="text/plain; version=0.0.4; charset=utf-8",
+                )
             elif path == "/pool":
                 self._send(self._pool_page())
             elif path.startswith("/job/"):
@@ -142,6 +158,27 @@ class PortalHandler(BaseHTTPRequestHandler):
             return RpcClient(info["host"], info["port"], info.get("secret", ""), timeout_s=2.0)
         except (OSError, ValueError, KeyError):
             return None
+
+    def _metrics_text(self) -> str:
+        """Merged Prometheus exposition: own registry (no extra labels) +
+        each running AM's snapshot under app=<id>. AMs that vanish between
+        the listing and the call are skipped (best-effort, like every other
+        live view here)."""
+        groups = [(REGISTRY.snapshot(), {})]
+        for app_id in self._running_ids():
+            cli = self._am_client(app_id)
+            if cli is None:
+                continue
+            try:
+                snap = cli.call("get_metrics")
+                groups.append((snap.get("metrics") or [], {"app": app_id}))
+                for task_id, tsnap in (snap.get("tasks") or {}).items():
+                    groups.append((tsnap, {"app": app_id, "task": task_id}))
+            except Exception:  # noqa: BLE001 — AM may have just exited
+                pass
+            finally:
+                cli.close()
+        return render_merged(groups)
 
     def _pool_status(self):
         if not self.pool_addr:

@@ -11,9 +11,19 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 from typing import Any
 
 import jax
+
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import trace as obs_trace
+
+_SAVE_SECONDS = obs_metrics.histogram(
+    "tony_checkpoint_save_seconds",
+    "checkpoint save-dispatch latency (async saves exclude background writes)")
+_RESTORE_SECONDS = obs_metrics.histogram(
+    "tony_checkpoint_restore_seconds", "checkpoint restore latency")
 
 
 class CheckpointManager:
@@ -44,7 +54,12 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
-        return self._mgr.save(step, args=self._ocp.args.StandardSave(state), force=force)
+        t0 = time.perf_counter()
+        with obs_trace.maybe_span("ckpt.save", step=step):
+            saved = self._mgr.save(step, args=self._ocp.args.StandardSave(state), force=force)
+        if saved:
+            _SAVE_SECONDS.observe(time.perf_counter() - t0)
+        return saved
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -55,8 +70,12 @@ class CheckpointManager:
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        abstract = jax.tree.map(_abstractify, state_like)
-        return self._mgr.restore(step, args=self._ocp.args.StandardRestore(abstract))
+        t0 = time.perf_counter()
+        with obs_trace.maybe_span("ckpt.restore", step=int(step)):
+            abstract = jax.tree.map(_abstractify, state_like)
+            restored = self._mgr.restore(step, args=self._ocp.args.StandardRestore(abstract))
+        _RESTORE_SECONDS.observe(time.perf_counter() - t0)
+        return restored
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -19,6 +19,8 @@ from dataclasses import dataclass
 import jax
 
 from tony_tpu import constants
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import trace as obs_trace
 from tony_tpu.parallel import MeshSpec
 from tony_tpu.runtime import init_distributed
 from tony_tpu.train.checkpoint import restore_or_init
@@ -31,6 +33,13 @@ from tony_tpu.train.trainer import (
     make_train_step,
     sharded_init,
 )
+
+_FIRST_STEP_SECONDS = obs_metrics.gauge(
+    "tony_train_first_step_seconds",
+    "wall time of the first executed step (XLA compile + first run)")
+_STEP_SECONDS = obs_metrics.histogram(
+    "tony_train_step_seconds",
+    "mean per-step wall time, sampled once per logging window")
 
 
 @dataclass(frozen=True)
@@ -80,12 +89,57 @@ def _drop_train_metrics(line: dict) -> None:
         pass
 
 
+def _drop_obs_metrics() -> None:
+    """Atomically publish this child's non-empty metrics-registry snapshot
+    next to the step report (<train-metrics-file>.obs): the executor merges
+    it into its push_metrics piggyback so checkpoint/step-time instruments
+    reach the AM's get_metrics and the portal's /metrics. No-op outside a
+    tony container; never raises."""
+    path = os.environ.get(constants.ENV_TRAIN_METRICS_FILE)
+    if not path:
+        return
+    snap = [m for m in obs_metrics.REGISTRY.snapshot() if m["samples"]]
+    if not snap:
+        return
+    try:
+        tmp = path + ".obs.tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, path + ".obs")
+    except OSError:
+        pass
+
+
 def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
     """Generic decoder-LM pretraining loop (llama/mixtral modules).
 
     model_module must expose init/loss_fn/sharding_rules/synthetic_batch and
     the config flops_per_token(). Returns the final metrics dict.
+
+    Under a traced tony job (TONY_TRACE_* env from the executor) the whole
+    run is one span with first-step (compile) and checkpoint child spans;
+    outside a container the tracer is None and nothing is recorded.
     """
+    if os.environ.get(constants.ENV_METRICS_ENABLED) == "0":
+        obs_metrics.set_enabled(False)  # the job opted out (tony.metrics.enabled)
+    tracer = obs_trace.init_from_env()
+    if tracer is None:
+        return _run_lm_training(model_module, model_cfg, loop, None)
+    root, token = tracer.start_span("train.run")
+    root.set(steps=loop.steps, batch_size=loop.batch_size)
+    tracer.root_parent = root.span_id
+    try:
+        result = _run_lm_training(model_module, model_cfg, loop, tracer)
+    except BaseException:
+        tracer.end_span(root, token, status="error")
+        obs_trace.shutdown()
+        raise
+    tracer.end_span(root, token)
+    obs_trace.shutdown()
+    return result
+
+
+def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
     if loop.stage_axis > 1 and not hasattr(model_module, "pp_value_and_grad"):
         # fail in milliseconds, not after a multi-GB sharded init/restore
         raise ValueError(
@@ -209,6 +263,10 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
     metrics: dict = {}
     profiler = StepProfiler()  # no-op unless the executor exported TONY_PROFILE_DIR
     meter.start()
+    # sampled step timing: one histogram observation (mean step wall time)
+    # per logging window — the hot loop itself pays two int compares
+    window_t0 = time.perf_counter()
+    window_step0 = start_step
     try:
         for step in range(start_step, loop.steps):
             profiler.step(step)
@@ -227,7 +285,20 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
                 batch = model_module.synthetic_batch(
                     jax.random.fold_in(key, step), loop.batch_size, loop.seq_len, model_cfg
                 )
+            first = step == start_step
+            if first:
+                t_first = time.perf_counter()
             state, metrics = step_fn(state, batch)
+            if first:
+                # the first executed step is dominated by XLA compilation —
+                # the critical-path item `tony trace` reports per worker
+                jax.block_until_ready(metrics["loss"])
+                first_s = time.perf_counter() - t_first
+                _FIRST_STEP_SECONDS.set(first_s)
+                if tracer is not None:
+                    with tracer.span("train.first_step", step=step) as sp:
+                        sp.start_ms -= first_s * 1000.0
+                window_t0, window_step0 = time.perf_counter(), step + 1
             meter.step()
             if (step + 1) % loop.log_every == 0 or step + 1 == loop.steps:
                 jax.block_until_ready(metrics["loss"])
@@ -242,6 +313,11 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
                 }
                 print(json.dumps(line), flush=True)
                 _drop_train_metrics(line)
+                n_window = step + 1 - window_step0
+                if n_window > 0:
+                    _STEP_SECONDS.observe((time.perf_counter() - window_t0) / n_window)
+                window_t0, window_step0 = time.perf_counter(), step + 1
+                _drop_obs_metrics()  # after observe: the window's sample ships with it
                 meter.start()
             if (
                 ckpt_mgr is not None
@@ -262,6 +338,7 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
             ckpt_mgr.save(loop.steps, state, force=True)
         ckpt_mgr.wait()
         ckpt_mgr.close()
+    _drop_obs_metrics()  # final flush: last window + final checkpoint sample
     return {k: float(v) for k, v in metrics.items() if hasattr(v, "item") or isinstance(v, (int, float))}
 
 
