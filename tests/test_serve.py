@@ -8,6 +8,7 @@ and kill drains gracefully.
 """
 
 import json
+import os
 import sys
 import threading
 import time
@@ -253,6 +254,159 @@ class TestEngineServer:
                 finished.add(rid)
         assert finished == {r1}
         assert seen[r1] == eng.done[r1]
+
+
+class TestEngineServerDrain:
+    """The drain contract (the CLI docstring's promise, now asserted):
+    SIGTERM → in-flight streaming requests FINISH, new admissions are
+    refused, exit code 0."""
+
+    def test_facade_drain_finishes_in_flight_work(self):
+        srv = EngineServer(tiny_engine(num_slots=2, max_len=128)).start()
+        out = srv.submit([1, 2, 3], max_tokens=20)
+        # wait until the request is actually decoding (first tokens flowed)
+        kind, payload = out.get(timeout=120)
+        assert kind == "tokens", payload
+        got = list(payload)
+        done = {}
+        stopper = threading.Thread(
+            target=lambda: done.update(clean=srv.stop(timeout_s=60)), daemon=True)
+        stopper.start()
+        # the in-flight stream must run to completion THROUGH the drain
+        while True:
+            kind, payload = out.get(timeout=120)
+            assert kind != "error", payload
+            if kind == "done":
+                assert len(payload) == 20
+                break
+            got.extend(payload)
+        stopper.join(timeout=90)
+        assert done.get("clean") is True  # drain completed inside its budget
+        refused = srv.submit([4], max_tokens=1)
+        kind, payload = refused.get(timeout=10)
+        assert kind == "error" and "draining" in payload
+
+    @pytest.mark.e2e
+    def test_sigterm_drains_streaming_request_and_exits_zero(self, tmp_path):
+        """The real process contract: run serving_http standalone, SIGTERM it
+        mid-stream, read the stream to completion, and take exit code 0."""
+        import signal
+        import subprocess
+
+        url_file = tmp_path / "url"
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-m", "tony_tpu.models.serving_http",
+             "--preset", "tiny", "--slots", "2", "--max-len", "256",
+             "--decode-chunk", "4", "--host", "127.0.0.1",
+             "--url-file", str(url_file)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            # generous SIGTERM→SIGKILL window: the drain must finish the
+            # 200-token stream even on a loaded CI box
+            env={**os.environ, constants.ENV_KILL_GRACE_MS: "60000"},
+        )
+        try:
+            deadline = time.time() + 180
+            while time.time() < deadline and not url_file.exists():
+                assert proc.poll() is None, proc.stdout.read().decode()
+                time.sleep(0.2)
+            assert url_file.exists(), "server never wrote its URL"
+            url = url_file.read_text().strip()
+
+            req = urllib.request.Request(
+                url + "/v1/completions",
+                json.dumps({"prompt_tokens": [1, 2], "max_tokens": 200,
+                            "stream": True}).encode(),
+                {"Content-Type": "application/json"},
+            )
+            resp = urllib.request.urlopen(req, timeout=120)
+            events = []
+            # after the first chunk arrives, the request is in flight: drain
+            line = resp.readline().decode().strip()
+            while line == "":
+                line = resp.readline().decode().strip()
+            assert line.startswith("data: ")
+            events.append(json.loads(line[6:]))
+            proc.send_signal(signal.SIGTERM)
+
+            # new admissions are refused while the stream is still live
+            code = None
+            refuse_deadline = time.time() + 30
+            while time.time() < refuse_deadline:
+                try:
+                    status, body = post_raw(url + "/v1/completions",
+                                            {"prompt_tokens": [9], "max_tokens": 1},
+                                            timeout=30)
+                except Exception:  # noqa: BLE001 — server may already be gone
+                    break
+                if status == 503 and "draining" in body["error"]:
+                    code = status
+                    break
+                time.sleep(0.05)
+            assert code == 503, "drain never started refusing admissions"
+
+            # ... and the in-flight stream runs to completion
+            for line in resp:
+                line = line.decode().strip()
+                if line.startswith("data: "):
+                    events.append(json.loads(line[6:]))
+                    if events[-1].get("finished"):
+                        break
+            assert events[-1].get("finished") and len(events[-1]["tokens"]) == 200
+            assert proc.wait(timeout=60) == 0  # graceful drain exits clean
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+
+class TestServingInstruments:
+    """Satellite of PR 3's obs wiring: EngineServer records queue depth,
+    TTFT, per-token latency, and delivered tokens into the process metrics
+    registry (the same registry the .obs drop ships to /metrics)."""
+
+    @staticmethod
+    def _snap(name):
+        from tony_tpu.obs import metrics as obs_metrics
+
+        for m in obs_metrics.REGISTRY.snapshot():
+            if m["name"] == name:
+                return m["samples"]
+        return []
+
+    @classmethod
+    def _hist_count(cls, name):
+        return sum(s["count"] for s in cls._snap(name))
+
+    @classmethod
+    def _counter(cls, name, **labels):
+        for s in cls._snap(name):
+            if all(s["labels"].get(k) == str(v) for k, v in labels.items()):
+                return s["value"]
+        return 0.0
+
+    def test_request_lifecycle_reaches_registry(self):
+        ttft0 = self._hist_count("tony_serve_ttft_seconds")
+        tok0 = self._hist_count("tony_serve_token_latency_seconds")
+        done0 = self._counter("tony_serve_requests_total", outcome="done")
+        delivered0 = self._counter("tony_serve_tokens_delivered_total")
+
+        srv = EngineServer(tiny_engine()).start()
+        httpd, url = http_server(srv)
+        try:
+            # 2 chunks (8 tokens / decode_chunk 4): TTFT once, token-latency
+            # at least once, delivered counts the client-visible bytes
+            r = post(url + "/v1/completions",
+                     {"prompt_tokens": [1, 2, 3], "max_tokens": 8})
+            assert r["finished"] and len(r["tokens"]) == 8
+        finally:
+            httpd.shutdown()
+            srv.stop()
+        assert self._hist_count("tony_serve_ttft_seconds") == ttft0 + 1
+        assert self._hist_count("tony_serve_token_latency_seconds") >= tok0 + 1
+        assert self._counter("tony_serve_requests_total", outcome="done") == done0 + 1
+        assert self._counter("tony_serve_tokens_delivered_total") == delivered0 + 8
+        # the queue-depth gauge exists (set every engine tick)
+        assert self._snap("tony_serve_queue_depth"), "queue-depth gauge never set"
 
 
 # ---------------------------------------------------------------------------

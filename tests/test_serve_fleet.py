@@ -1,0 +1,856 @@
+"""Replicated serving control plane (tony_tpu/serve; docs/serving.md).
+
+Unit layer: fake replicas (configurable stdlib HTTP servers) + a fake AM
+drive the health state machine, the router's balancing/failover/hedging,
+and the autoscaler's decision core — no engine, no job spine.
+
+E2E layer (the headline): a 2-replica ``tony serve`` fleet under continuous
+client load; ``exec-crash`` kills one replica via ``tony.chaos.spec``; the
+router retries/fails over so ZERO client requests fail, the gang restarts
+the replica, the autoscaler's view reconverges, and the job trace +
+portal ``/metrics`` carry the router spans and per-replica serving
+instruments for the whole episode. Plus ``resize_jobtype`` driving the
+AM's elastic rebuild on a plain fixture gang.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.cli.notebook import TaskUrlUnavailable, wait_for_task_url
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import trace as obs_trace
+from tony_tpu.serve import (
+    AutoscalePolicy,
+    Autoscaler,
+    FleetRouter,
+    HealthMonitor,
+    Replica,
+    ReplicaState,
+)
+from tony_tpu.serve.health import FleetSignals
+
+pytestmark = pytest.mark.serve
+
+
+# ---------------------------------------------------------------------------
+# fakes: replica HTTP server + AM RPC surface
+# ---------------------------------------------------------------------------
+class _FakeReplicaHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a) -> None:
+        pass
+
+    def _json(self, code, obj, headers=None):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        cfg = self.server.cfg
+        if self.path == "/stats":
+            self._json(200, {
+                "healthy": cfg["healthy"], "draining": cfg["draining"],
+                "queue_depth": cfg["queue_depth"],
+                "slots_active": cfg["slots_active"], "slots_total": cfg["slots_total"],
+                "requests_done": cfg["hits"], "tokens_out": 0, "tokens_delivered": 0,
+            })
+        else:
+            self._json(404, {"error": "not found"})
+
+    def do_POST(self):  # noqa: N802
+        cfg = self.server.cfg
+        n = int(self.headers.get("Content-Length") or 0)
+        req = json.loads(self.rfile.read(n) or b"{}")
+        cfg["hits"] += 1
+        if cfg["delay_s"]:
+            time.sleep(cfg["delay_s"])
+        if cfg["status"] != 200:
+            self._json(cfg["status"], {"error": cfg["error"]})
+            return
+        if req.get("stream"):
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.end_headers()
+            for chunk in ([1, 2], [3, 4]):
+                self.wfile.write(b"data: " + json.dumps({"tokens": chunk}).encode() + b"\n\n")
+                self.wfile.flush()
+            self.wfile.write(
+                b"data: " + json.dumps({"finished": True, "tokens": [1, 2, 3, 4]}).encode() + b"\n\n")
+            self.wfile.flush()
+        else:
+            self._json(200, {"tokens": cfg["tokens"], "finished": True})
+
+
+class FakeReplica:
+    def __init__(self, **cfg):
+        self.cfg = dict(healthy=True, draining=False, queue_depth=0, slots_active=0,
+                        slots_total=8, delay_s=0.0, status=200, error="injected",
+                        tokens=[1, 2, 3], hits=0)
+        self.cfg.update(cfg)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), _FakeReplicaHandler)
+        self.httpd.cfg = self.cfg
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class FakeAM:
+    """The two RPCs the health monitor uses + the autoscaler's lever."""
+
+    def __init__(self):
+        self.tasks = {}
+        self.attempt = 0
+        self.resizes = []
+
+    def set_replica(self, idx, url, status="RUNNING"):
+        self.tasks[idx] = {"name": "serve", "index": idx, "url": url, "status": status}
+
+    def drop_replica(self, idx):
+        self.tasks.pop(idx, None)
+
+    def call(self, method, **kw):
+        if method == "get_application_status":
+            return {"restart_attempt": self.attempt}
+        if method == "get_task_infos":
+            return list(self.tasks.values())
+        if method == "resize_jobtype":
+            self.resizes.append((kw["job_name"], kw["instances"]))
+            return {"ack": True, "current": kw["instances"]}
+        raise AssertionError(f"unexpected AM call {method}")
+
+
+def make_health(am, **kw):
+    kw.setdefault("interval_s", 999)  # tests drive tick() by hand
+    kw.setdefault("fail_threshold", 2)
+    kw.setdefault("probe_timeout_s", 1.0)
+    return HealthMonitor(am.call, **kw)
+
+
+def dead_url():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+def post_router(url, obj, timeout=30):
+    req = urllib.request.Request(
+        url + "/v1/completions", json.dumps(obj).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# health: state machine + endpoint re-resolution
+# ---------------------------------------------------------------------------
+class TestHealthStateMachine:
+    def test_probe_flips_unknown_to_healthy_and_reads_stats(self):
+        rep, am = FakeReplica(queue_depth=3, slots_active=4), FakeAM()
+        am.set_replica(0, rep.url)
+        h = make_health(am)
+        try:
+            h._resolve()
+            assert h.replicas[0].state == ReplicaState.UNKNOWN
+            h.tick()
+            r = h.replicas[0]
+            assert r.state == ReplicaState.HEALTHY
+            assert r.stats["queue_depth"] == 3
+            sig = h.fleet_signals()
+            assert sig.replicas_healthy == 1 and sig.queue_depth == 3
+            assert sig.slots_active == 4 and sig.slots_total == 8
+        finally:
+            rep.close()
+
+    def test_draining_and_fatal_states(self):
+        rep, am = FakeReplica(), FakeAM()
+        am.set_replica(0, rep.url)
+        h = make_health(am)
+        try:
+            h.tick()
+            assert h.replicas[0].state == ReplicaState.HEALTHY
+            rep.cfg["draining"] = True
+            h.tick()
+            assert h.replicas[0].state == ReplicaState.DRAINING
+            rep.cfg["draining"] = False
+            rep.cfg["healthy"] = False  # fatal engine error
+            h.tick()
+            assert h.replicas[0].state == ReplicaState.DOWN  # immediate, no budget
+        finally:
+            rep.close()
+
+    def test_probe_failures_down_after_threshold_then_recover(self):
+        rep, am = FakeReplica(), FakeAM()
+        am.set_replica(0, dead_url())
+        h = make_health(am)  # fail_threshold=2
+        h.tick()
+        assert h.replicas[0].state == ReplicaState.UNKNOWN  # 1 miss: not yet
+        h.tick()
+        assert h.replicas[0].state == ReplicaState.DOWN
+        # endpoint re-registers somewhere alive → next tick recovers
+        am.set_replica(0, rep.url)
+        try:
+            h.tick()
+            assert h.replicas[0].state == ReplicaState.HEALTHY
+        finally:
+            rep.close()
+
+    def test_passive_hard_failure_is_immediate_down(self):
+        rep, am = FakeReplica(), FakeAM()
+        am.set_replica(0, rep.url)
+        h = make_health(am)
+        try:
+            h.tick()
+            r = h.replicas[0]
+            h.report_failure(r, hard=True)
+            assert r.state == ReplicaState.DOWN
+            h.tick()  # active probe against the live server resurrects it
+            assert h.replicas[0].state == ReplicaState.HEALTHY
+        finally:
+            rep.close()
+
+    def test_gang_restart_invalidates_urls_and_reresolves(self):
+        rep, am = FakeReplica(), FakeAM()
+        am.set_replica(0, dead_url())  # pre-restart URL, process gone
+        h = make_health(am)
+        h._resolve()
+        h.replicas[0].state = ReplicaState.HEALTHY  # pretend it was fine
+        am.attempt = 1
+        h._resolve()
+        # attempt bump: the old URL is dead even if its port answers
+        assert h.replicas[0].attempt == 1
+        assert h.replicas[0].state == ReplicaState.UNKNOWN  # fresh entry for new epoch
+        am.set_replica(0, rep.url)
+        try:
+            h.tick()
+            assert h.replicas[0].url == rep.url
+            assert h.replicas[0].state == ReplicaState.HEALTHY
+        finally:
+            rep.close()
+
+    def test_report_success_never_resurrects_stale_epoch(self):
+        """After a gang restart bumps the attempt, a completing in-flight
+        request on the OLD endpoint must not flip it back to routable."""
+        am = FakeAM()
+        h = make_health(am)
+        r = Replica(index=0, url=dead_url(), attempt=0, state=ReplicaState.DOWN)
+        h.replicas[0] = r
+        h.restart_attempt = 1  # new epoch: r's URL belongs to the dead gang
+        h.report_success(r)
+        assert r.state == ReplicaState.DOWN
+        # current-epoch replicas DO resurrect
+        r2 = Replica(index=1, url=dead_url(), attempt=1, state=ReplicaState.DOWN)
+        h.replicas[1] = r2
+        h.report_success(r2)
+        assert r2.state == ReplicaState.HEALTHY
+
+    def test_scaled_down_index_is_forgotten(self):
+        am = FakeAM()
+        am.set_replica(0, dead_url())
+        am.set_replica(1, dead_url())
+        h = make_health(am)
+        h._resolve()
+        assert set(h.replicas) == {0, 1}
+        am.drop_replica(1)  # fleet resized 2 → 1
+        h._resolve()
+        assert set(h.replicas) == {0}
+
+
+# ---------------------------------------------------------------------------
+# router: balancing, failover, passthrough, streaming, hedging
+# ---------------------------------------------------------------------------
+def make_router(h, **kw):
+    kw.setdefault("failover_deadline_s", 10.0)
+    return FleetRouter(h, **kw).start()
+
+
+def inject(h, idx, url, state=ReplicaState.HEALTHY, outstanding=0):
+    r = Replica(index=idx, url=url, state=state)
+    r.outstanding = outstanding
+    h.replicas[idx] = r
+    return r
+
+
+class TestRouter:
+    def test_least_outstanding_balancing(self):
+        a, b, am = FakeReplica(tokens=[1]), FakeReplica(tokens=[2]), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            inject(h, 0, a.url, outstanding=5)
+            inject(h, 1, b.url, outstanding=0)
+            code, hdrs, body = post_router(router.url, {"prompt_tokens": [1]})
+            assert code == 200 and body["tokens"] == [2]
+            assert hdrs["X-Tony-Replica"] == "1"
+        finally:
+            router.stop()
+            a.close()
+            b.close()
+
+    def test_failover_retries_on_live_replica_zero_client_failures(self):
+        b, am = FakeReplica(tokens=[7, 8]), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            # replica 0 looks HEALTHY but its process is gone (crash window
+            # between health ticks) — ties break toward index 0, so the
+            # router tries it FIRST, hard-fails, and fails over to 1
+            inject(h, 0, dead_url())
+            inject(h, 1, b.url)
+            retries0 = _counter_value("tony_router_retries_total")
+            code, hdrs, body = post_router(router.url, {"prompt_tokens": [1]})
+            assert code == 200 and body["tokens"] == [7, 8]
+            assert hdrs["X-Tony-Replica"] == "1"
+            assert h.replicas[0].state == ReplicaState.DOWN  # passive hard mark
+            assert _counter_value("tony_router_retries_total") == retries0 + 1
+        finally:
+            router.stop()
+            b.close()
+
+    def test_client_errors_forwarded_not_retried(self):
+        a, am = FakeReplica(status=400, error="empty prompt"), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            inject(h, 0, a.url)
+            code, _, body = post_router(router.url, {"prompt_tokens": []})
+            assert code == 400 and "empty prompt" in body["error"]
+            assert a.cfg["hits"] == 1  # exactly one attempt
+            assert h.replicas[0].state == ReplicaState.HEALTHY  # not a replica failure
+        finally:
+            router.stop()
+            a.close()
+
+    def test_504_deadline_forwarded_not_retried(self):
+        """504 is the replica's verdict on the CLIENT's deadline — forward
+        it verbatim; retrying would restart the deadline clock elsewhere
+        and mark a healthy replica down."""
+        a, am = FakeReplica(status=504, error="deadline exceeded"), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            inject(h, 0, a.url)
+            code, _, body = post_router(router.url, {"prompt_tokens": [1]})
+            assert code == 504 and "deadline" in body["error"]
+            assert a.cfg["hits"] == 1
+            assert h.replicas[0].state == ReplicaState.HEALTHY
+        finally:
+            router.stop()
+            a.close()
+
+    def test_5xx_soft_failures_exhaust_to_502(self):
+        a, am = FakeReplica(status=500, error="boom"), FakeAM()
+        h = make_health(am, fail_threshold=100)  # keep it HEALTHY: retries hit it
+        router = make_router(h, retries=2)
+        try:
+            inject(h, 0, a.url)
+            code, _, body = post_router(router.url, {"prompt_tokens": [1]})
+            assert code == 502 and "replicas failing" in body["error"]
+            assert a.cfg["hits"] == 3  # initial + 2 retries
+        finally:
+            router.stop()
+            a.close()
+
+    def test_streaming_relayed_verbatim(self):
+        a, am = FakeReplica(), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            inject(h, 0, a.url)
+            req = urllib.request.Request(
+                router.url + "/v1/completions",
+                json.dumps({"prompt_tokens": [1], "stream": True}).encode(),
+                {"Content-Type": "application/json"})
+            events = []
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.headers["Content-Type"].startswith("text/event-stream")
+                assert resp.headers["X-Tony-Replica"] == "0"
+                for line in resp:
+                    line = line.decode().strip()
+                    if line.startswith("data: "):
+                        events.append(json.loads(line[6:]))
+                        if events[-1].get("finished"):
+                            break
+            assert [e.get("tokens") for e in events] == [[1, 2], [3, 4], [1, 2, 3, 4]]
+        finally:
+            router.stop()
+            a.close()
+
+    def test_fleet_down_waits_for_recovery_instead_of_failing(self):
+        am = FakeAM()
+        h = HealthMonitor(am.call, interval_s=0.05, fail_threshold=1)
+        h.start()
+        router = make_router(h, failover_deadline_s=15.0)
+        rep = FakeReplica(tokens=[5])
+        try:
+            result = {}
+
+            def client():
+                result["r"] = post_router(router.url, {"prompt_tokens": [1]}, timeout=30)
+
+            t = threading.Thread(target=client, daemon=True)
+            t.start()  # no replicas registered yet: the router must WAIT
+            time.sleep(0.5)
+            assert "r" not in result
+            am.set_replica(0, rep.url)  # the gang came (back) up
+            t.join(timeout=20)
+            code, hdrs, body = result["r"]
+            assert code == 200 and body["tokens"] == [5]
+        finally:
+            h.stop()
+            router.stop()
+            rep.close()
+
+    def test_unavailable_after_deadline_is_503(self):
+        am = FakeAM()
+        h = make_health(am)
+        router = make_router(h, failover_deadline_s=0.5)
+        try:
+            code, _, body = post_router(router.url, {"prompt_tokens": [1]}, timeout=10)
+            assert code == 503 and "no healthy replica" in body["error"]
+        finally:
+            router.stop()
+
+    def test_hedge_fires_and_second_replica_wins(self):
+        slow = FakeReplica(delay_s=2.0, tokens=[1])
+        fast = FakeReplica(tokens=[2])
+        am = FakeAM()
+        h = make_health(am)
+        router = make_router(h, hedge_percentile=95.0, hedge_min_s=0.1)
+        try:
+            # seed the latency window so a percentile exists
+            for _ in range(30):
+                router._latencies.observe(0.01)
+            inject(h, 0, slow.url)
+            inject(h, 1, fast.url, outstanding=1)  # primary pick = 0 (slow)
+            hedges0 = _counter_value("tony_router_hedges_total")
+            wins0 = _counter_value("tony_router_hedge_wins_total")
+            t0 = time.monotonic()
+            code, hdrs, body = post_router(router.url, {"prompt_tokens": [1]})
+            took = time.monotonic() - t0
+            assert code == 200 and body["tokens"] == [2]
+            assert hdrs["X-Tony-Replica"] == "1"
+            assert took < 1.5, "hedge should beat the slow primary"
+            assert _counter_value("tony_router_hedges_total") == hedges0 + 1
+            assert _counter_value("tony_router_hedge_wins_total") == wins0 + 1
+        finally:
+            router.stop()
+            time.sleep(0)  # let the losing leg settle before closing
+            slow.close()
+            fast.close()
+
+    def test_fleet_and_stats_pages(self):
+        a, am = FakeReplica(), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            am.set_replica(0, a.url)
+            h.tick()
+            with urllib.request.urlopen(router.url + "/fleet", timeout=10) as resp:
+                fleet = json.loads(resp.read())
+            assert fleet["replicas"][0]["state"] == "HEALTHY"
+            with urllib.request.urlopen(router.url + "/stats", timeout=10) as resp:
+                stats = json.loads(resp.read())
+            assert stats["fleet"]["slots_total"] == 8
+            assert "retries" in stats["router"]
+            with urllib.request.urlopen(router.url + "/healthz", timeout=10) as resp:
+                assert json.loads(resp.read())["ok"] is True
+        finally:
+            router.stop()
+            a.close()
+
+    def test_disabled_tracing_hot_path_is_allocation_free(self, monkeypatch):
+        """Like obs's contract: with tracing off (the default), routing a
+        request must never construct a Span."""
+        assert obs_trace.get() is None
+
+        def no_spans(*a, **kw):
+            raise AssertionError("Span allocated on the disabled fast path")
+
+        monkeypatch.setattr(obs_trace.Span, "__init__", no_spans)
+        a, am = FakeReplica(tokens=[3]), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            inject(h, 0, a.url)
+            code, _, body = post_router(router.url, {"prompt_tokens": [1]})
+            assert code == 200 and body["tokens"] == [3]
+        finally:
+            router.stop()
+            a.close()
+
+
+def _counter_value(name, **labels):
+    for m in obs_metrics.REGISTRY.snapshot():
+        if m["name"] == name:
+            for s in m["samples"]:
+                if s["labels"] == {k: str(v) for k, v in labels.items()}:
+                    return s["value"]
+            return 0.0
+    return 0.0
+
+
+def _histogram_count(name, **labels):
+    for m in obs_metrics.REGISTRY.snapshot():
+        if m["name"] == name:
+            return sum(s["count"] for s in m["samples"]
+                       if all(s["labels"].get(k) == str(v) for k, v in labels.items()))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: decision core + lever
+# ---------------------------------------------------------------------------
+def sig(healthy=2, queue=0, active=0, total=16, known=None):
+    return FleetSignals(
+        replicas_known=known if known is not None else healthy,
+        replicas_healthy=healthy, queue_depth=queue,
+        slots_active=active, slots_total=total)
+
+
+class TestAutoscaler:
+    def _scaler(self, am=None, **policy):
+        p = AutoscalePolicy(**{**dict(min_replicas=1, max_replicas=4,
+                                      scale_up_ticks=2, scale_down_ticks=3), **policy})
+        am = am or FakeAM()
+        h = make_health(am)
+        return Autoscaler(h, lambda job, n: am.call(
+            "resize_jobtype", job_name=job, instances=n), p), am
+
+    def test_queue_pressure_scales_up_after_hysteresis(self):
+        a, _ = self._scaler()
+        assert a.decide(2, sig(queue=20)) == 2  # tick 1: hold
+        assert a.decide(2, sig(queue=20)) == 3  # tick 2: fire
+
+    def test_utilization_scales_up(self):
+        a, _ = self._scaler()
+        assert a.decide(2, sig(active=15, total=16)) == 2
+        assert a.decide(2, sig(active=15, total=16)) == 3
+
+    def test_ceiling_and_floor_clamp(self):
+        a, _ = self._scaler(max_replicas=2)
+        a.decide(2, sig(queue=50))
+        assert a.decide(2, sig(queue=50)) == 2  # at ceiling: hold
+        b, _ = self._scaler(min_replicas=2)
+        for _ in range(10):
+            target = b.decide(2, sig(queue=0, active=0))
+        assert target == 2  # at floor: hold
+
+    def test_scale_down_needs_longer_hysteresis_and_idle(self):
+        a, _ = self._scaler()
+        assert a.decide(3, sig(healthy=3, queue=0, active=0)) == 3
+        assert a.decide(3, sig(healthy=3, queue=0, active=0)) == 3
+        assert a.decide(3, sig(healthy=3, queue=0, active=0)) == 2  # tick 3
+
+    def test_mixed_signals_reset_hysteresis(self):
+        a, _ = self._scaler()
+        a.decide(2, sig(queue=20))
+        a.decide(2, sig(queue=0))  # pressure vanished
+        assert a.decide(2, sig(queue=20)) == 2  # counter restarted
+
+    def test_no_decision_while_fleet_down(self):
+        a, _ = self._scaler()
+        a.decide(2, sig(queue=50))  # up_ticks=1
+        assert a.decide(2, sig(healthy=0, queue=0)) == 2
+        assert a.decide(2, sig(queue=50)) == 2  # hysteresis was reset
+
+    def test_tick_drives_the_am_lever(self):
+        am = FakeAM()
+        h = make_health(am)
+        p = AutoscalePolicy(min_replicas=1, max_replicas=4, scale_up_ticks=1)
+        a = Autoscaler(h, lambda job, n: am.call(
+            "resize_jobtype", job_name=job, instances=n), p)
+        inject(h, 0, dead_url()).stats = {"queue_depth": 50, "slots_active": 8,
+                                          "slots_total": 8}
+        a.tick()
+        assert am.resizes == [("serve", 2)]
+        assert a.target == 2
+
+
+# ---------------------------------------------------------------------------
+# wait_for_task_url: typed outcomes (was: None for both)
+# ---------------------------------------------------------------------------
+class _FakeHandle:
+    def __init__(self, status=None):
+        self._status = status
+
+    def final_status(self):
+        return self._status
+
+    def rpc(self, timeout_s=0):
+        return None
+
+
+class TestWaitForTaskUrlTyped:
+    def test_finished_job_raises_with_verdict(self):
+        handle = _FakeHandle({"status": "FAILED", "reason": "allocation error"})
+        with pytest.raises(TaskUrlUnavailable) as ei:
+            wait_for_task_url(handle, "serve", timeout_s=5)
+        assert ei.value.reason == "finished"
+        assert "FAILED" in str(ei.value) and "allocation error" in str(ei.value)
+        assert ei.value.final_status["status"] == "FAILED"
+
+    def test_timeout_raises_distinctly(self):
+        with pytest.raises(TaskUrlUnavailable) as ei:
+            wait_for_task_url(_FakeHandle(None), "serve", timeout_s=0.3, poll_s=0.05)
+        assert ei.value.reason == "timeout"
+        assert "did not register" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# E2E: resize_jobtype rebuilds a live gang (fixture spine, no engine)
+# ---------------------------------------------------------------------------
+from tests.test_e2e import FAST, fixture_cmd  # noqa: E402
+
+from tony_tpu.cluster.client import Client  # noqa: E402
+from tony_tpu.cluster.session import JobStatus  # noqa: E402
+
+
+def _wait(pred, timeout_s=60, poll_s=0.1):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll_s)
+    return None
+
+
+@pytest.mark.e2e
+class TestResizeJobtypeE2E:
+    def test_resize_grows_and_validates(self, tmp_tony_root):
+        cfg = TonyConfig({
+            **FAST,
+            keys.STAGING_ROOT: str(tmp_tony_root),
+            "tony.worker.instances": "1",
+            keys.EXECUTES: fixture_cmd("forever.py"),
+        })
+        client = Client(cfg)
+        handle = client.submit()
+        try:
+            rpc = handle.rpc()
+            assert rpc is not None
+
+            def running_workers():
+                infos = rpc.call("get_task_infos")
+                up = [t for t in infos if t["status"] == "RUNNING"]
+                return up if len(up) == len(infos) else None
+
+            assert _wait(running_workers), "initial worker never ran"
+
+            r = rpc.call("resize_jobtype", job_name="nope", instances=2)
+            assert not r["ack"] and "unknown job type" in r["error"]
+            r = rpc.call("resize_jobtype", job_name="worker", instances=0)
+            assert not r["ack"]
+            r = rpc.call("resize_jobtype", job_name="worker", instances=1)
+            assert r["ack"] and r.get("noop")
+
+            r = rpc.call("resize_jobtype", job_name="worker", instances=2)
+            assert r["ack"] and r["current"] == 1
+
+            def two_running():
+                infos = rpc.call("get_task_infos")
+                return infos if (
+                    len(infos) == 2 and all(t["status"] == "RUNNING" for t in infos)
+                ) else None
+
+            assert _wait(two_running, timeout_s=90), "resize to 2 never converged"
+            status = rpc.call("get_application_status")
+            assert status["restart_attempt"] == 1  # rebuild, not re-submission
+        finally:
+            Client.kill(handle)
+        final = client.monitor_application(handle, quiet=True)
+        assert final == JobStatus.KILLED
+
+
+# ---------------------------------------------------------------------------
+# E2E headline: 2-replica fleet + chaos exec-crash under continuous load
+# ---------------------------------------------------------------------------
+@pytest.mark.e2e
+@pytest.mark.chaos
+class TestFleetChaosE2E:
+    def test_replica_crash_is_not_client_visible(self, tmp_tony_root):
+        from tony_tpu.cli.serve import _fleet_am_client, build_serve_config
+        from tony_tpu.portal import server as portal
+
+        conf, _ = build_serve_config([
+            "--replicas", "2", "--slots", "2", "--max_len", "64",
+            "--decode_chunk", "4",
+        ])
+        conf.set(keys.STAGING_ROOT, str(tmp_tony_root))
+        for k, v in FAST.items():
+            conf.set(k, v)
+        conf.set(keys.TASK_HEARTBEAT_INTERVAL_MS, "200")
+        conf.set(keys.TASK_METRICS_INTERVAL_MS, "500")
+        conf.set(keys.TRACE_ENABLED, "true")
+        # the latch fires once: attempt 0's replica 0 crashes mid-load, the
+        # restarted gang stays healthy
+        conf.set(keys.CHAOS_SPEC, "exec-crash:serve:0@t+25s")
+        conf.set(keys.CHAOS_SEED, "7")
+        assert conf.get_bool(keys.TASK_RESTART_ON_FAILURE)  # serve default
+
+        client = Client(conf)
+        handle = client.submit()
+        health = router = None
+        failures: list = []
+        try:
+            wait_for_task_url(handle, constants.SERVE_JOB_NAME, timeout_s=180)
+            fleet_rpc = _fleet_am_client(handle)
+            assert fleet_rpc is not None
+            health = HealthMonitor(fleet_rpc.call, interval_s=0.2, fail_threshold=2)
+            health.tick()
+            health.start()
+            router = FleetRouter(health, failover_deadline_s=120.0).start()
+
+            ok = [0]
+            observed_down = threading.Event()
+            stop_load = threading.Event()
+
+            def load():
+                i = 0
+                while not stop_load.is_set():
+                    i += 1
+                    try:
+                        code, _, body = post_router(
+                            router.url,
+                            {"prompt_tokens": [1 + (i % 5), 2, 3], "max_tokens": 4},
+                            timeout=150)
+                    except Exception as e:  # noqa: BLE001 — a failure IS the signal
+                        failures.append(repr(e))
+                        continue
+                    if code == 200 and body.get("finished"):
+                        ok[0] += 1
+                    else:
+                        failures.append((code, body))
+
+            def watch():
+                while not stop_load.is_set():
+                    if any(r.state == ReplicaState.DOWN for r in health.snapshot()):
+                        observed_down.set()
+                    time.sleep(0.05)
+
+            threads = [threading.Thread(target=load, daemon=True),
+                       threading.Thread(target=watch, daemon=True)]
+            for t in threads:
+                t.start()
+
+            # phase 1: the crash lands (gang restart bumps the attempt)
+            assert _wait(
+                lambda: (handle.rpc().call("get_application_status")
+                         .get("restart_attempt", 0) >= 1) or None,
+                timeout_s=120,
+            ), "chaos exec-crash never triggered a gang restart"
+            assert observed_down.wait(timeout=60), "health never observed the outage"
+
+            # phase 2: the fleet reconverges — 2 replicas healthy again
+            assert _wait(
+                lambda: health.fleet_signals().replicas_healthy == 2 or None,
+                timeout_s=150,
+            ), f"fleet never recovered: {health.fleet_info()}"
+            served_after = ok[0]
+            assert _wait(lambda: ok[0] > served_after + 3 or None, timeout_s=60), \
+                "no successful requests after recovery"
+            stop_load.set()
+            for t in threads:
+                t.join(timeout=160)
+
+            # ZERO client-visible failures across the whole episode
+            assert not failures, failures[:5]
+            assert ok[0] > 0
+
+            # the autoscaler's view reconverges on the restarted fleet
+            resizes: list = []
+            scaler = Autoscaler(
+                health, lambda job, n: resizes.append((job, n)),
+                AutoscalePolicy(min_replicas=1, max_replicas=3,
+                                scale_down_utilization=0.0),  # idle ≠ shrink here
+            )
+            scaler.tick()
+            sig2 = health.fleet_signals()
+            assert sig2.replicas_known == 2 and sig2.replicas_healthy == 2
+            assert resizes == []  # steady state: no resize issued
+
+            # /metrics (the portal's, scraped live) shows the router counters
+            # pushed via push_client_metrics AND the replicas' serving
+            # instruments (executor piggyback of the .obs drop)
+            snap = [m for m in obs_metrics.REGISTRY.snapshot() if m["samples"]]
+            fleet_rpc.call("push_client_metrics", identity="router", metrics=snap)
+            history_root = os.path.join(str(tmp_tony_root), "history")
+            psrv = portal.serve(history_root, port=0, staging_root=str(tmp_tony_root))
+            threading.Thread(target=psrv.serve_forever, daemon=True).start()
+            try:
+                pport = psrv.server_address[1]
+
+                def scrape():
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{pport}/metrics", timeout=10
+                    ) as resp:
+                        return resp.read().decode()
+
+                text = _wait(
+                    lambda: (lambda t: t if (
+                        "tony_router_requests_total" in t
+                        and "tony_serve_ttft_seconds" in t) else None)(scrape()),
+                    timeout_s=30, poll_s=1.0,
+                )
+                assert text, "portal /metrics never showed router + serving instruments"
+                assert f'app="{handle.app_id}"' in text
+                assert 'task="router"' in text
+            finally:
+                psrv.shutdown()
+                psrv.server_close()
+        finally:
+            if router is not None:
+                router.stop()
+            if health is not None:
+                health.stop()
+            Client.kill(handle)
+            final = client.monitor_application(handle, quiet=True)
+            obs_trace.shutdown()  # the submit() call installed a client tracer
+        assert final == JobStatus.KILLED
+
+        # the job trace carries the router→replica spans and the restart
+        trace_dir = os.path.join(str(tmp_tony_root), handle.app_id, "trace")
+        spans = []
+        for fn in os.listdir(trace_dir):
+            if fn.endswith(".spans.jsonl"):
+                with open(os.path.join(trace_dir, fn)) as f:
+                    spans += [json.loads(line) for line in f if line.strip()]
+        names = {s["name"] for s in spans}
+        assert "router.request" in names, sorted(names)
+        assert "router.attempt" in names
+        assert "am.gang_restart" in names
+        # router spans join the ONE job trace (trace_id = app id)
+        assert all(s["trace_id"] == handle.app_id for s in spans)
+
+        # `tony trace` renders the episode end-to-end
+        from tony_tpu.cli.trace import main as trace_main
+
+        out_path = os.path.join(str(tmp_tony_root), "trace.json")
+        rc = trace_main([handle.app_id, "--staging", str(tmp_tony_root),
+                         "--out", out_path])
+        assert rc == 0 and os.path.exists(out_path)

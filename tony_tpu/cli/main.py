@@ -263,7 +263,7 @@ def main(argv: list[str] | None = None) -> int:
         print("  history    list finished jobs / dump one job's events")
         print("  portal     serve the history web portal")
         print("  notebook   launch an interactive notebook container + local proxy")
-        print("  serve      run the inference engine as an AM-supervised HTTP endpoint")
+        print("  serve      run a replicated inference fleet (router + health + autoscaler) as an AM-supervised job")
         print("  mini       one-command local sandbox (smoke gang, optional --distributed)")
         print("  data-prep  tokenize text files into TONYTOK training shards")
         print("  lint       run the AST static-analysis suite (config/jit/lock/mesh discipline)")

@@ -27,17 +27,49 @@ DEFAULT_NOTEBOOK_CMD = (
 )
 
 
+class TaskUrlUnavailable(RuntimeError):
+    """``wait_for_task_url`` could not produce an endpoint.
+
+    ``reason`` distinguishes the two historically-conflated outcomes (both
+    used to come back as a bare ``None``):
+
+    - ``"finished"`` — the job reached a terminal state before the task ever
+      registered a URL (``final_status`` carries the AM's verdict: crash,
+      failed allocation, immediate kill). Waiting longer can never help.
+    - ``"timeout"`` — the job is still alive but the URL did not register
+      within ``timeout_s`` (slow start, gang queued behind other tenants).
+      A longer ``--url_timeout_s`` might.
+    """
+
+    def __init__(self, job_name: str, reason: str, timeout_s: float,
+                 final_status: dict | None = None):
+        self.job_name = job_name
+        self.reason = reason  # "finished" | "timeout"
+        self.final_status = final_status
+        if reason == "finished":
+            verdict = (final_status or {}).get("status", "?")
+            detail = (final_status or {}).get("reason")
+            msg = (f"job finished ({verdict}) before task {job_name!r} registered a URL"
+                   + (f": {detail}" if detail else ""))
+        else:
+            msg = f"task {job_name!r} did not register a URL within {timeout_s:.0f}s"
+        super().__init__(msg)
+
+
 def wait_for_task_url(
     handle, job_name: str, timeout_s: float = 120.0, poll_s: float = 0.3
-) -> tuple[str, int] | None:
+) -> tuple[str, int]:
     """Poll the AM until a ``job_name`` task registers its URL → (host, port).
     Shared by the notebook proxy and ``tony serve`` (both ride the §3.4
-    register_task_url path)."""
+    register_task_url path). Raises :class:`TaskUrlUnavailable` — with
+    ``reason`` "finished" or "timeout" — instead of ever returning None, so
+    callers can tell a dead job from a slow one."""
     deadline = time.time() + timeout_s
     while time.time() < deadline:
         status = handle.final_status()
         if status is not None:
-            return None  # job already over — nothing to reach
+            # job already over — nothing to reach, and retrying is futile
+            raise TaskUrlUnavailable(job_name, "finished", timeout_s, final_status=status)
         rpc = handle.rpc(timeout_s=5.0)
         if rpc is not None:
             try:
@@ -48,12 +80,12 @@ def wait_for_task_url(
             except Exception:  # noqa: BLE001 — AM may still be starting
                 pass
         time.sleep(poll_s)
-    return None
+    raise TaskUrlUnavailable(job_name, "timeout", timeout_s)
 
 
 def wait_for_notebook_url(
     handle, timeout_s: float = 120.0, poll_s: float = 0.3
-) -> tuple[str, int] | None:
+) -> tuple[str, int]:
     return wait_for_task_url(handle, constants.NOTEBOOK_JOB_NAME, timeout_s, poll_s)
 
 
@@ -73,8 +105,10 @@ def submit_notebook(
         Client.kill(handle)
         client.monitor_application(handle, quiet=True)
         return constants.EXIT_KILLED
-    if target is None:
-        print("[tony-notebook] notebook never registered a URL", file=sys.stderr)
+    except TaskUrlUnavailable as e:
+        # say WHICH failure this was: a dead job (look at its verdict/logs)
+        # reads nothing like a slow one (raise --url_timeout_s)
+        print(f"[tony-notebook] {e}", file=sys.stderr)
         Client.kill(handle)
         client.monitor_application(handle, quiet=True)
         return constants.EXIT_FAILURE

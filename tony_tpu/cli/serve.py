@@ -1,18 +1,24 @@
 """``tony serve``: run the inference engine as an AM-supervised job.
 
-The reference's interactive-service shape (SURVEY.md §3.4: a one-task
-jobtype that registers its URL with the AM so the submitter can reach it —
-the NotebookSubmitter path) applied to serving: submits a single ``serve``
-task running the continuous-batching HTTP server
-(tony_tpu/models/serving_http.py), waits for the endpoint URL to register,
-prints it, and supervises until the job ends or Ctrl-C kills it. The server
-pushes engine throughput through the executor's metrics loop, so
-``tony portal`` charts tok/s, active slots, and queue depth live.
+The reference's interactive-service shape (SURVEY.md §3.4: a jobtype that
+registers its URL with the AM so the submitter can reach it — the
+NotebookSubmitter path) applied to serving, now replicated: ``--replicas N``
+submits N ``serve`` tasks each running the continuous-batching HTTP server
+(tony_tpu/models/serving_http.py), then runs the **fleet control plane**
+(tony_tpu/serve/) in this process:
+
+- a :class:`FleetRouter` front door (least-outstanding balancing, retry /
+  failover across replicas, optional tail hedging) — the printed endpoint;
+- a :class:`HealthMonitor` (AM-registry endpoint discovery that re-resolves
+  across gang restarts + active/passive per-replica health);
+- an :class:`Autoscaler` when ``tony.serve.max-replicas`` > 0, retargeting
+  the replica count through the AM's ``resize_jobtype`` elastic path.
 
 Because it is an ordinary job, everything the orchestrator gives training
-jobs applies: pool queues/priority/preemption, restart-on-failure, history,
-and the portal. Kill → SIGTERM → the server drains (stops admitting,
-finishes in-flight requests) and exits 0.
+jobs applies: pool queues/priority/preemption, restart-on-failure (enabled
+by default here — a crashed replica gang-restarts while the router masks
+the blip), history, tracing, and the portal. Kill → SIGTERM → each server
+drains (stops admitting, finishes in-flight requests) and exits 0.
 """
 
 from __future__ import annotations
@@ -20,12 +26,15 @@ from __future__ import annotations
 import argparse
 import shlex
 import sys
+import threading
 
 from tony_tpu import constants
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.cluster.client import Client
+from tony_tpu.cluster.rpc import RpcClient
 from tony_tpu.cluster.session import JobStatus
-from tony_tpu.cli.notebook import wait_for_task_url
+from tony_tpu.cli.notebook import TaskUrlUnavailable, wait_for_task_url
+from tony_tpu.obs import metrics as obs_metrics
 
 # flags forwarded verbatim to the serving_http process
 _ENGINE_FLAGS = (
@@ -40,6 +49,21 @@ def build_serve_config(argv: list[str]) -> tuple[TonyConfig, argparse.Namespace]
     p = argparse.ArgumentParser(prog="tony serve", description=__doc__)
     p.add_argument("--conf_file", default=None)
     p.add_argument("--conf", action="append", default=[], metavar="K=V")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve task instances behind the fleet router")
+    p.add_argument("--min_replicas", type=int, default=None,
+                   help="autoscaler floor (tony.serve.min-replicas)")
+    p.add_argument("--max_replicas", type=int, default=None,
+                   help="autoscaler ceiling; > 0 enables autoscaling "
+                        "(tony.serve.max-replicas)")
+    p.add_argument("--router_port", type=int, default=None,
+                   help="fleet router listen port (tony.serve.router.port; 0 = free)")
+    p.add_argument("--hedge_percentile", type=float, default=None,
+                   help="hedge non-streaming requests past this latency "
+                        "percentile (tony.serve.hedge-percentile; 0 = off)")
+    p.add_argument("--no_router", action="store_true",
+                   help="print the first replica's endpoint instead of "
+                        "running the fleet router (single-replica debugging)")
     p.add_argument("--preset", default="tiny")
     p.add_argument("--hf", default="", help="HuggingFace checkpoint dir")
     p.add_argument("--tokenizer", default="", help="tokenizer dir for text prompts")
@@ -68,7 +92,7 @@ def build_serve_config(argv: list[str]) -> tuple[TonyConfig, argparse.Namespace]
     p.add_argument("--top_k", type=int, default=0)
     p.add_argument("--eos_id", type=int, default=-1)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--port", type=int, default=0, help="endpoint port (0 = free)")
+    p.add_argument("--port", type=int, default=0, help="replica endpoint port (0 = free)")
     p.add_argument("--url_timeout_s", type=float, default=180.0)
     args = p.parse_args(argv)
 
@@ -80,20 +104,71 @@ def build_serve_config(argv: list[str]) -> tuple[TonyConfig, argparse.Namespace]
     if args.int8:
         cmd.append("--int8")
     config = TonyConfig.from_layers(conf_file=args.conf_file, conf_args=args.conf)
-    config.set(keys.jobtype_key(constants.SERVE_JOB_NAME, keys.INSTANCES_SUFFIX), "1")
+    if args.replicas < 1:
+        raise SystemExit("tony serve: --replicas must be >= 1")
+    config.set(
+        keys.jobtype_key(constants.SERVE_JOB_NAME, keys.INSTANCES_SUFFIX),
+        str(args.replicas),
+    )
     config.set(
         keys.jobtype_key(constants.SERVE_JOB_NAME, keys.COMMAND_SUFFIX),
         shlex.join(cmd),
     )
+    # a crashed replica should gang-restart behind the router, not fail the
+    # job — unless the user explicitly configured otherwise (defaults are
+    # pre-merged into the config, so probe the user layers alone)
+    user_layers = TonyConfig(with_defaults=False)
+    if args.conf_file:
+        user_layers.load_file(args.conf_file)
+    user_layers.set_kv_args(args.conf)
+    if keys.TASK_RESTART_ON_FAILURE not in user_layers:
+        config.set(keys.TASK_RESTART_ON_FAILURE, "true")
+    for flag, key in (
+        ("min_replicas", keys.SERVE_MIN_REPLICAS),
+        ("max_replicas", keys.SERVE_MAX_REPLICAS),
+        ("router_port", keys.SERVE_ROUTER_PORT),
+        ("hedge_percentile", keys.SERVE_HEDGE_PERCENTILE),
+    ):
+        v = getattr(args, flag)
+        if v is not None:
+            config.set(key, str(v))
     return config, args
 
 
-def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0) -> int:
+def _fleet_am_client(handle) -> RpcClient | None:
+    """A DEDICATED RpcClient for the fleet control plane (health + autoscaler
+    + metrics push), so its polling never serializes behind the monitor
+    thread's shared ``handle.rpc()`` connection."""
+    shared = handle.rpc(timeout_s=30.0)
+    if shared is None:
+        return None
+    return RpcClient(shared.host, shared.port, secret=shared.secret, timeout_s=5.0)
+
+
+def _push_router_metrics_loop(rpc: RpcClient, stop: threading.Event,
+                              interval_s: float = 2.0) -> None:
+    """Ship this process's metrics registry (router request/retry/hedge
+    counters, per-replica latency histograms, autoscaler decisions) to the
+    AM, which re-exports it through ``get_metrics`` → portal ``/metrics``."""
+    while not stop.wait(interval_s):
+        try:
+            snap = [m for m in obs_metrics.REGISTRY.snapshot() if m["samples"]]
+            if snap:
+                rpc.call("push_client_metrics", identity="router", metrics=snap)
+        except Exception:  # noqa: BLE001 — exposition is best-effort
+            pass
+
+
+def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0,
+                 no_router: bool = False) -> int:
+    from tony_tpu.serve import AutoscalePolicy, Autoscaler, FleetRouter, HealthMonitor
+
+    replicas = config.instances(constants.SERVE_JOB_NAME)
     client = Client(config)
     handle = client.submit()
-    print(f"[tony-serve] submitted {handle.app_id}", flush=True)
+    print(f"[tony-serve] submitted {handle.app_id} ({replicas} replica(s))", flush=True)
     try:
-        target = wait_for_task_url(
+        first = wait_for_task_url(
             handle, constants.SERVE_JOB_NAME, timeout_s=url_timeout_s
         )
     except KeyboardInterrupt:
@@ -101,16 +176,98 @@ def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0) -> int:
         Client.kill(handle)
         client.monitor_application(handle, quiet=True)
         return constants.EXIT_KILLED
-    if target is None:
-        print("[tony-serve] endpoint never registered a URL", file=sys.stderr)
+    except TaskUrlUnavailable as e:
+        # "finished" (job died — see its verdict) and "timeout" (still
+        # queued/compiling — raise --url_timeout_s) need different fixes
+        print(f"[tony-serve] {e}", file=sys.stderr)
         Client.kill(handle)
         client.monitor_application(handle, quiet=True)
         return constants.EXIT_FAILURE
+
+    if no_router:
+        print(
+            f"[tony-serve] endpoint http://{first[0]}:{first[1]} "
+            f"(POST /v1/completions; GET /stats, /healthz)",
+            flush=True,
+        )
+        return _monitor_to_exit(client, handle)
+
+    try:
+        fleet_rpc = _fleet_am_client(handle)
+    except KeyboardInterrupt:
+        print("[tony-serve] interrupt — killing serving job", flush=True)
+        Client.kill(handle)
+        client.monitor_application(handle, quiet=True)
+        return constants.EXIT_KILLED
+    if fleet_rpc is None:
+        print("[tony-serve] AM vanished before the fleet came up", file=sys.stderr)
+        Client.kill(handle)
+        client.monitor_application(handle, quiet=True)
+        return constants.EXIT_FAILURE
+    health = HealthMonitor(
+        fleet_rpc.call,
+        job_name=constants.SERVE_JOB_NAME,
+        interval_s=config.get_time_ms(keys.SERVE_HEALTH_INTERVAL_MS, 1000) / 1000,
+        fail_threshold=config.get_int(keys.SERVE_HEALTH_FAIL_THRESHOLD, 3),
+    )
+    try:
+        health.tick()  # synchronous first resolve: the router starts with a fleet view
+    except KeyboardInterrupt:
+        print("[tony-serve] interrupt — killing serving job", flush=True)
+        Client.kill(handle)
+        client.monitor_application(handle, quiet=True)
+        return constants.EXIT_KILLED
+    health.start()
+    router = FleetRouter(
+        health,
+        port=config.get_int(keys.SERVE_ROUTER_PORT, 0),
+        retries=config.get_int(keys.SERVE_ROUTER_RETRIES, 3),
+        failover_deadline_s=config.get_time_ms(keys.SERVE_FAILOVER_DEADLINE_MS, 120_000) / 1000,
+        hedge_percentile=config.get_float(keys.SERVE_HEDGE_PERCENTILE, 0.0),
+        hedge_min_s=config.get_time_ms(keys.SERVE_HEDGE_MIN_MS, 50) / 1000,
+    ).start()
+    autoscaler = None
+    max_replicas = config.get_int(keys.SERVE_MAX_REPLICAS, 0)
+    if max_replicas > 0:
+        policy = AutoscalePolicy(
+            min_replicas=max(config.get_int(keys.SERVE_MIN_REPLICAS, 0), 1),
+            max_replicas=max_replicas,
+            scale_up_queue_depth=config.get_float(keys.SERVE_SCALE_UP_QUEUE_DEPTH, 4.0),
+            scale_up_utilization=config.get_float(keys.SERVE_SCALE_UP_UTILIZATION, 0.85),
+            scale_down_utilization=config.get_float(keys.SERVE_SCALE_DOWN_UTILIZATION, 0.25),
+            scale_up_ticks=config.get_int(keys.SERVE_SCALE_UP_TICKS, 2),
+            scale_down_ticks=config.get_int(keys.SERVE_SCALE_DOWN_TICKS, 6),
+        )
+        autoscaler = Autoscaler(
+            health,
+            lambda job, n: fleet_rpc.call("resize_jobtype", job_name=job, instances=n),
+            policy,
+            job_name=constants.SERVE_JOB_NAME,
+            interval_s=config.get_time_ms(keys.SERVE_AUTOSCALE_INTERVAL_MS, 5000) / 1000,
+        ).start()
+    stop_push = threading.Event()
+    threading.Thread(
+        target=_push_router_metrics_loop, args=(fleet_rpc, stop_push), daemon=True
+    ).start()
     print(
-        f"[tony-serve] endpoint http://{target[0]}:{target[1]} "
-        f"(POST /v1/completions; GET /stats, /healthz)",
+        f"[tony-serve] fleet router {router.url} → {replicas} replica(s) "
+        f"(POST /v1/completions; GET /stats, /healthz, /fleet"
+        + (f"; autoscale [{policy.min_replicas},{policy.max_replicas}]" if autoscaler else "")
+        + ")",
         flush=True,
     )
+    try:
+        return _monitor_to_exit(client, handle)
+    finally:
+        stop_push.set()
+        if autoscaler is not None:
+            autoscaler.stop()
+        health.stop()
+        router.stop()
+        fleet_rpc.close()
+
+
+def _monitor_to_exit(client: Client, handle) -> int:
     try:
         final = client.monitor_application(handle, quiet=True)
     except KeyboardInterrupt:
@@ -126,7 +283,9 @@ def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     config, args = build_serve_config(list(sys.argv[1:] if argv is None else argv))
-    return submit_serve(config, url_timeout_s=args.url_timeout_s)
+    return submit_serve(
+        config, url_timeout_s=args.url_timeout_s, no_router=args.no_router
+    )
 
 
 if __name__ == "__main__":

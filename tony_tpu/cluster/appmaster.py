@@ -40,7 +40,12 @@ from tony_tpu.cluster.resources import (
     LocalResourceManager,
     ResourceManager,
 )
-from tony_tpu.cluster.scheduler import DependencyTimeout, TaskScheduler, plan_downsize
+from tony_tpu.cluster.scheduler import (
+    DependencyTimeout,
+    TaskScheduler,
+    gang_fits,
+    plan_downsize,
+)
 from tony_tpu.cluster.rpc import APPLICATION_RPC_METHODS, RpcServer
 from tony_tpu.cluster.session import JobStatus, Session, TaskStatus
 from tony_tpu.runtime import get_runtime
@@ -53,6 +58,10 @@ _QUEUE_WAIT = obs_metrics.histogram(
     buckets=obs_metrics.WAIT_BUCKETS)
 _GANG_RESTARTS = obs_metrics.counter(
     "tony_gang_restarts_total", "whole-gang restarts (failure, preemption, capacity loss)")
+_GANG_RESIZES = obs_metrics.counter(
+    "tony_gang_resizes_total",
+    "requested elastic resizes by outcome (applied, rejected, noop)",
+    labelnames=("outcome",))
 
 
 def build_resource_manager(config: TonyConfig, app_id: str = "") -> ResourceManager:
@@ -155,7 +164,14 @@ class ApplicationMaster:
         self._failures_seen = 0
         self._gang_complete_fired = False
         self._queue_waiting = False
-        self._shrunk: dict[str, int] = {}   # elastic downsize: type → instances
+        self._resized: dict[str, int] = {}  # elastic resize: type → instances
+        # externally-requested resizes (resize_jobtype RPC — the serving
+        # autoscaler's lever) awaiting application by the monitor loop; the
+        # RPC handler must never drive the restart machinery itself. Keyed
+        # by jobtype so concurrent resizes of different types never clobber
+        # an acknowledged-but-unapplied request.
+        self._pending_resize: dict[str, int] = {}
+        self._client_obs: dict[str, Any] = {}  # submitter-side registries (fleet router)
         self._last_capacity_probe = 0.0
         self._capacity_short_since: float | None = None  # downsize hysteresis
         # guards (attempt, session) as one unit: RPC handlers capture both
@@ -280,16 +296,49 @@ class ApplicationMaster:
             session.get_task(job_name, index).metrics = metrics
         return {"ack": True}
 
+    def push_client_metrics(self, identity: str, metrics: Any) -> dict[str, Any]:
+        """Submitter-side processes with no executor (the fleet router runs in
+        the ``tony serve`` client) push their metrics-registry snapshots here;
+        ``get_metrics`` re-exports them like executor piggybacks, so router
+        request/retry/hedge counters reach the portal's /metrics."""
+        if not isinstance(identity, str) or not identity or len(identity) > 64:
+            return {"ack": False}
+        self._client_obs[identity] = metrics
+        return {"ack": True}
+
+    def resize_jobtype(self, job_name: str, instances: int) -> dict[str, Any]:
+        """Elastic-resize request (the serving autoscaler's lever): retarget
+        ``tony.<job_name>.instances`` without re-submitting. The monitor loop
+        applies it via the existing rebuild path — in place while queued, or
+        a budget-exempt whole-gang restart while running (replicas restore /
+        re-register onto the new fleet size; the router masks the blip)."""
+        n = int(instances)
+        if job_name not in self.config.job_types():
+            return {"ack": False, "error": f"unknown job type {job_name!r}"}
+        if n < 1:
+            return {"ack": False, "error": f"instances must be >= 1, got {n}"}
+        with self._epoch_lock:
+            current = self._effective_config().instances(job_name)
+            if n == current:
+                self._pending_resize.pop(job_name, None)
+                _GANG_RESIZES.inc(outcome="noop")
+                return {"ack": True, "current": current, "noop": True}
+            self._pending_resize[job_name] = n
+        return {"ack": True, "current": current}
+
     def get_metrics(self) -> dict[str, Any]:
         """This AM process's metrics-registry snapshot (obs/metrics.py) plus
         the latest registry snapshot each executor piggybacked on its metrics
         push — the portal merges them into /metrics under app=<id> (and
-        task=<job:idx> for the executor groups)."""
+        task=<job:idx> for the executor groups). Submitter-side snapshots
+        pushed via ``push_client_metrics`` (fleet router) ride the same dict
+        under their identity."""
         tasks: dict[str, Any] = {}
         for t in self.session.task_infos():
             obs = (t.get("metrics") or {}).get("obs_metrics")
             if obs:
                 tasks[f"{t['name']}:{t['index']}"] = obs
+        tasks.update(self._client_obs)
         return {
             "app_id": self.app_id,
             "identity": "am",
@@ -438,14 +487,15 @@ class ApplicationMaster:
                     EventType.TASK_FINISHED, task=task.id, exit_code=rc, source="container-exit"
                 )
 
-    # ------------------------------------------------- elastic gang shrink
+    # ------------------------------------------------- elastic gang resize
     def _effective_config(self) -> TonyConfig:
-        """The job config with any elastic downsize applied to the per-type
-        instance counts (everything else untouched)."""
-        if not self._shrunk:
+        """The job config with any elastic resize (capacity-loss shrink or
+        autoscaler retarget) applied to the per-type instance counts
+        (everything else untouched)."""
+        if not self._resized:
             return self.config
         d = self.config.to_dict()
-        for t, n in self._shrunk.items():
+        for t, n in self._resized.items():
             d[keys.jobtype_key(t, keys.INSTANCES_SUFFIX)] = str(n)
         return TonyConfig(d)
 
@@ -498,15 +548,15 @@ class ApplicationMaster:
             return None
         return plan
 
-    def _announce_downsize(self, shrink: dict[str, int], reason: str) -> None:
+    def _announce_resize(self, resize: dict[str, int], reason: str) -> None:
         cfg = self._effective_config()
         self.events.emit(
             EventType.GANG_RESIZED,
             instances={t: cfg.instances(t) for t in cfg.job_types()},
-            shrunk=shrink,
+            resized=resize,
             reason=reason,
         )
-        # shrunken demand re-registers with the pool so queue admission
+        # resized demand re-registers with the pool so queue admission
         # evaluates the gang the AM will actually ask for
         self.rm.register_app(
             queue=self.config.get(keys.APPLICATION_QUEUE) or "default",
@@ -514,21 +564,74 @@ class ApplicationMaster:
             demand=self.scheduler.total_demand(),
         )
 
-    def _downsize_while_queued(self, shrink: dict[str, int]) -> None:
+    def _resize_while_queued(self, resize: dict[str, int], reason: str) -> None:
         """A gang waiting in pool admission with NOTHING running re-plans in
-        place when capacity was permanently lost mid-wait (the node died
-        while we were queued — the restart path below never fires)."""
+        place — capacity permanently lost mid-wait, or an autoscaler retarget
+        arriving before admission (the restart path below never fires)."""
         with self._epoch_lock:
-            self._shrunk.update(shrink)
+            self._resized.update(resize)
             cfg = self._effective_config()
             self.session = Session(cfg)
             self.session.job_status = JobStatus.RUNNING
             self.scheduler = TaskScheduler(cfg, self.session, self.rm)
-        self._announce_downsize(shrink, "capacity lost while queued")
+        self._announce_resize(resize, reason)
+
+    def _apply_pending_resize(self) -> None:
+        """Apply a ``resize_jobtype`` request from the monitor loop (the one
+        thread allowed to drive the restart machinery). Grows are guarded by
+        the same fits-and-places check the downsize planner uses: a scale-up
+        the pool cannot place is rejected with an event, not allowed to take
+        a serving fleet down into an endless queue wait."""
+        with self._epoch_lock:
+            pending, self._pending_resize = self._pending_resize, {}
+        if not pending:
+            return
+        cfg = self._effective_config()
+        resize = {t: n for t, n in pending.items() if n != cfg.instances(t)}
+        if not resize:
+            _GANG_RESIZES.inc(outcome="noop")
+            return
+        grows = {t: n for t, n in resize.items() if n > cfg.instances(t)}
+        if grows:
+            nodes = self.rm.node_capacities()
+            if nodes is not None:
+                from tony_tpu.cluster.resources import Resources
+
+                cap = Resources(
+                    memory_bytes=sum(x.memory_bytes for x in nodes),
+                    vcores=sum(x.vcores for x in nodes),
+                    chips=sum(x.chips for x in nodes),
+                )
+            else:
+                cap = self.rm.total_capacity()
+            if cap is not None:
+                counts = {t: cfg.instances(t) for t in cfg.job_types()}
+                counts.update(resize)
+                per_instance = {t: self.scheduler.plans[t].resources for t in counts}
+                if not gang_fits(counts, per_instance, cap, nodes=nodes):
+                    _GANG_RESIZES.inc(outcome="rejected")
+                    self.events.emit(
+                        EventType.GANG_RESIZED,
+                        rejected=True,
+                        resized=resize,
+                        reason=f"scale-up to {grows} does not fit alive capacity",
+                    )
+                    return
+        _GANG_RESIZES.inc(outcome="applied")
+        reason = "resize " + ", ".join(
+            f"{t}: {cfg.instances(t)}→{n}" for t, n in sorted(resize.items()))
+        if not self._containers:
+            self._resize_while_queued(resize, reason)
+        else:
+            # budget-exempt like preemption: a requested resize is a cluster
+            # action, not a job failure
+            self._maybe_restart_gang(
+                reason, exit_code=constants.EXIT_PREEMPTED, resize=resize
+            )
 
     def _maybe_restart_gang(
         self, reason: str, exit_code: int | None = None,
-        shrink: dict[str, int] | None = None,
+        resize: dict[str, int] | None = None,
     ) -> bool:
         """Whole-gang restart from checkpoint (rebuild-only elasticity).
 
@@ -555,20 +658,23 @@ class ApplicationMaster:
             "am.gang_restart", reason=reason,
             attempt=self._restart_attempt + 1, preempted=preempted,
         ):
-            return self._restart_gang_spanned(reason, shrink)
+            return self._restart_gang_spanned(reason, resize)
 
-    def _restart_gang_spanned(self, reason: str, shrink: dict[str, int] | None) -> bool:
+    def _restart_gang_spanned(self, reason: str, resize: dict[str, int] | None) -> bool:
         self.events.emit(EventType.HEARTBEAT_LOST, reason=f"gang restart: {reason}")
         self._kill_all_containers()
         for c in list(self._containers.values()):
             self.rm.release(c)
         self._containers.clear()
         self._by_task.clear()
-        if shrink is None:  # a caller may pass the plan it already computed
-            shrink = self._plan_gang_downsize()
+        announce = resize is not None
+        if resize is None:  # a caller may pass the plan it already computed
+            resize = self._plan_gang_downsize()
+            announce = bool(resize)
+            reason = f"capacity lost: {reason}"
         with self._epoch_lock:  # atomic with _fenced_session's capture
-            if shrink:
-                self._shrunk.update(shrink)
+            if resize:
+                self._resized.update(resize)
             cfg = self._effective_config()
             self._restart_attempt += 1
             self._gang_complete_fired = False
@@ -576,8 +682,8 @@ class ApplicationMaster:
             self.session = Session(cfg)
             self.session.job_status = JobStatus.RUNNING
             self.scheduler = TaskScheduler(cfg, self.session, self.rm)
-        if shrink:
-            self._announce_downsize(shrink, f"capacity lost: {reason}")
+        if announce:
+            self._announce_resize(resize, reason)
         return True
 
     def run(self) -> JobStatus:
@@ -597,6 +703,9 @@ class ApplicationMaster:
                     self.session.mark_killed(t)
                 self.session.job_status = JobStatus.KILLED
                 break
+
+            # 0. externally-requested elastic resize (serving autoscaler)
+            self._apply_pending_resize()
 
             # 1. launch job types whose dependencies are satisfied
             try:
@@ -629,7 +738,7 @@ class ApplicationMaster:
                     self._last_capacity_probe = now
                     plan = self._plan_gang_downsize()
                     if plan and not self._containers:
-                        self._downsize_while_queued(plan)
+                        self._resize_while_queued(plan, "capacity lost while queued")
                     elif plan:
                         # PARTIALLY-allocated gang (some containers running,
                         # the rest waiting on capacity that died): the only
@@ -641,7 +750,7 @@ class ApplicationMaster:
                         self._maybe_restart_gang(
                             "capacity lost while partially allocated",
                             exit_code=constants.EXIT_PREEMPTED,
-                            shrink=plan,
+                            resize=plan,
                         )
             except (DependencyTimeout, AllocationError) as e:
                 self._fail(str(e))

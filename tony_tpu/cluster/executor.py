@@ -368,7 +368,7 @@ class TaskExecutor:
         if self.chaos is None:
             return
         if self.chaos.take("exec-crash", trigger=trigger) is not None:
-            self._kill_child()
+            self._kill_child_abruptly()
             os._exit(constants.EXIT_FAILURE)
         if self.chaos.take("exec-hang", trigger=trigger) is not None:
             while True:  # wedge here forever; heartbeats keep flowing
@@ -391,7 +391,7 @@ class TaskExecutor:
         if self.chaos.take_spec(f) is None:
             return  # not this task's fault, or already fired in a prior attempt
         if f.kind == "exec-crash":
-            self._kill_child()
+            self._kill_child_abruptly()
             os._exit(constants.EXIT_FAILURE)
         # exec-hang: SIGSTOP the child's process group — it stops making
         # progress while this supervisor stays alive and heartbeating, the
@@ -411,6 +411,17 @@ class TaskExecutor:
                     self.child.wait(timeout=grace_s)
                 except subprocess.TimeoutExpired:
                     os.killpg(os.getpgid(self.child.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    def _kill_child_abruptly(self) -> None:
+        """SIGKILL, no grace — the exec-crash fidelity path. The graceful
+        kill would let a well-behaved child (a draining serve engine) exit 0
+        and the supervisor report SUCCESS before dying, turning an injected
+        crash into a clean completion the AM never restarts."""
+        if self.child and self.child.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.child.pid), signal.SIGKILL)
             except ProcessLookupError:
                 pass
 

@@ -302,4 +302,6 @@ APPLICATION_RPC_METHODS = [
     "finish_application",
     "push_metrics",          # MetricsRpc analog
     "get_metrics",           # process metrics-registry snapshot (obs/metrics.py)
+    "push_client_metrics",   # submitter-side registry (fleet router) re-exported by get_metrics
+    "resize_jobtype",        # elastic retarget of tony.<type>.instances (serve autoscaler)
 ]

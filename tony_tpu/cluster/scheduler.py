@@ -151,6 +151,53 @@ def _next_lower_divisor(orig: int, below: int, floor: int) -> int | None:
     return None
 
 
+def gang_demand(counts: dict[str, int], per_instance: dict[str, Resources]) -> Resources:
+    """Aggregate resource demand of a gang given per-type instance counts."""
+    return Resources(
+        memory_bytes=sum(counts[t] * per_instance[t].memory_bytes for t in counts),
+        vcores=sum(counts[t] * per_instance[t].vcores for t in counts),
+        chips=sum(counts[t] * per_instance[t].chips for t in counts),
+    )
+
+
+def gang_fits(
+    counts: dict[str, int],
+    per_instance: dict[str, Resources],
+    capacity: Resources,
+    nodes: list[Resources] | None = None,
+) -> bool:
+    """Would a gang of ``counts`` fit the pool? Aggregate totals always; when
+    per-node capacities are given, also a first-fit-decreasing PLACEMENT onto
+    the nodes — a 4-worker x 3g gang does NOT fit three 4g nodes even though
+    12g <= 12g. Shared by the elastic-downsize planner and the AM's
+    resize-grow guard (a replica scale-up that cannot place must be rejected,
+    not allowed to take the whole fleet down into an endless queue wait)."""
+    d = gang_demand(counts, per_instance)
+    if not (
+        d.memory_bytes <= capacity.memory_bytes
+        and d.vcores <= capacity.vcores
+        and d.chips <= capacity.chips
+    ):
+        return False
+    if nodes is None:
+        return True
+    free = [[n.memory_bytes, n.vcores, n.chips] for n in nodes]
+    inst: list[Resources] = []
+    for t, n in counts.items():
+        inst.extend([per_instance[t]] * n)
+    inst.sort(key=lambda r: (r.memory_bytes, r.chips, r.vcores), reverse=True)
+    for r in inst:
+        for f in free:
+            if f[0] >= r.memory_bytes and f[1] >= r.vcores and f[2] >= r.chips:
+                f[0] -= r.memory_bytes
+                f[1] -= r.vcores
+                f[2] -= r.chips
+                break
+        else:
+            return False
+    return True
+
+
 def plan_downsize(
     counts: dict[str, int],
     per_instance: dict[str, Resources],
@@ -180,38 +227,8 @@ def plan_downsize(
     (ties: largest count), so multi-type gangs shrink evenly.
     """
 
-    def demand(c: dict[str, int]) -> Resources:
-        return Resources(
-            memory_bytes=sum(c[t] * per_instance[t].memory_bytes for t in c),
-            vcores=sum(c[t] * per_instance[t].vcores for t in c),
-            chips=sum(c[t] * per_instance[t].chips for t in c),
-        )
-
     def fits(c: dict[str, int]) -> bool:
-        d = demand(c)
-        if not (
-            d.memory_bytes <= capacity.memory_bytes
-            and d.vcores <= capacity.vcores
-            and d.chips <= capacity.chips
-        ):
-            return False
-        if nodes is None:
-            return True
-        free = [[n.memory_bytes, n.vcores, n.chips] for n in nodes]
-        inst: list[Resources] = []
-        for t, n in c.items():
-            inst.extend([per_instance[t]] * n)
-        inst.sort(key=lambda r: (r.memory_bytes, r.chips, r.vcores), reverse=True)
-        for r in inst:
-            for f in free:
-                if f[0] >= r.memory_bytes and f[1] >= r.vcores and f[2] >= r.chips:
-                    f[0] -= r.memory_bytes
-                    f[1] -= r.vcores
-                    f[2] -= r.chips
-                    break
-            else:
-                return False
-        return True
+        return gang_fits(c, per_instance, capacity, nodes=nodes)
 
     now = dict(counts)
     if fits(now):

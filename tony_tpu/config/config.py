@@ -126,6 +126,10 @@ class TonyConfig:
         v = self._data.get(key)
         return int(v) if v not in (None, "") else default
 
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self._data.get(key)
+        return float(v) if v not in (None, "") else default
+
     def get_bool(self, key: str, default: bool = False) -> bool:
         v = self._data.get(key)
         if v in (None, ""):

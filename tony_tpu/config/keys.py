@@ -133,6 +133,44 @@ HISTORY_MOVE_INTERVAL_MS = "tony.history.move-interval-ms"
 PORTAL_PORT = "tony.portal.port"
 
 # ---------------------------------------------------------------------------
+# tony.serve.* — replicated serving control plane (docs/serving.md)
+# ---------------------------------------------------------------------------
+# Replica autoscaling bounds for the ``serve`` jobtype. max-replicas > 0
+# enables the autoscaler (runs next to the fleet router in the submitting
+# `tony serve` process); min-replicas is its floor. Scaling drives the AM's
+# elastic-resize path (``resize_jobtype`` RPC → session/scheduler rebuild),
+# never a re-submission.
+SERVE_MIN_REPLICAS = "tony.serve.min-replicas"
+SERVE_MAX_REPLICAS = "tony.serve.max-replicas"
+SERVE_AUTOSCALE_INTERVAL_MS = "tony.serve.autoscale-interval-ms"
+# Scale-up triggers: mean engine admission-queue depth per healthy replica,
+# or fleet slot utilization above the high watermark (whichever fires first,
+# sustained for the up-hysteresis ticks).
+SERVE_SCALE_UP_QUEUE_DEPTH = "tony.serve.scale-up-queue-depth"
+SERVE_SCALE_UP_UTILIZATION = "tony.serve.scale-up-utilization"
+# Scale-down trigger: empty queues AND fleet slot utilization below the low
+# watermark, sustained for the down-hysteresis ticks (longer than up: adding
+# capacity is cheap, a restart to remove it is not).
+SERVE_SCALE_DOWN_UTILIZATION = "tony.serve.scale-down-utilization"
+SERVE_SCALE_UP_TICKS = "tony.serve.scale-up-ticks"
+SERVE_SCALE_DOWN_TICKS = "tony.serve.scale-down-ticks"
+# Fleet router (the HTTP front door the submitter runs).
+SERVE_ROUTER_PORT = "tony.serve.router.port"          # 0 = ephemeral
+SERVE_ROUTER_RETRIES = "tony.serve.router.retries"    # failover attempts before waiting
+# How long the router keeps retrying/waiting for a healthy replica before a
+# request is answered 503 — sized to cover a whole-gang restart (replica
+# relaunch + engine compile), so a replica crash is not client-visible.
+SERVE_FAILOVER_DEADLINE_MS = "tony.serve.failover-deadline-ms"
+# Hedging (non-streaming requests only): p>0 duplicates a request to a second
+# replica once it outlives the p-th percentile of recent router latencies
+# (floored at hedge-min-ms); first response wins. 0 disables.
+SERVE_HEDGE_PERCENTILE = "tony.serve.hedge-percentile"
+SERVE_HEDGE_MIN_MS = "tony.serve.hedge-min-ms"
+# Active health checks against each replica's /stats endpoint.
+SERVE_HEALTH_INTERVAL_MS = "tony.serve.health-interval-ms"
+SERVE_HEALTH_FAIL_THRESHOLD = "tony.serve.health-fail-threshold"
+
+# ---------------------------------------------------------------------------
 # tony.chaos.* — deterministic fault injection (docs/fault-tolerance.md)
 # ---------------------------------------------------------------------------
 # Fault schedule, e.g. "rpc-drop:p=0.05;exec-crash:worker:1@gang_complete";
@@ -234,6 +272,22 @@ DEFAULTS: dict[str, str] = {
     HISTORY_LOCATION: "",            # empty → <staging-root>/history
     HISTORY_MOVE_INTERVAL_MS: "1000",
     PORTAL_PORT: "28080",
+
+    SERVE_MIN_REPLICAS: "0",
+    SERVE_MAX_REPLICAS: "0",
+    SERVE_AUTOSCALE_INTERVAL_MS: "5000",
+    SERVE_SCALE_UP_QUEUE_DEPTH: "4",
+    SERVE_SCALE_UP_UTILIZATION: "0.85",
+    SERVE_SCALE_DOWN_UTILIZATION: "0.25",
+    SERVE_SCALE_UP_TICKS: "2",
+    SERVE_SCALE_DOWN_TICKS: "6",
+    SERVE_ROUTER_PORT: "0",
+    SERVE_ROUTER_RETRIES: "3",
+    SERVE_FAILOVER_DEADLINE_MS: "120000",
+    SERVE_HEDGE_PERCENTILE: "0",
+    SERVE_HEDGE_MIN_MS: "50",
+    SERVE_HEALTH_INTERVAL_MS: "1000",
+    SERVE_HEALTH_FAIL_THRESHOLD: "3",
 
     CHAOS_SPEC: "",
     CHAOS_SEED: "0",

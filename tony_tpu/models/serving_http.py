@@ -45,6 +45,25 @@ import jax
 from tony_tpu import constants
 from tony_tpu.models.llama import PRESETS, init
 from tony_tpu.models.serving import ContinuousBatcher
+from tony_tpu.obs import metrics as obs_metrics
+
+# Serving instruments (obs registry, satellite of the training child's:
+# snapshots drop at <train-metrics-file>.obs and ride the executor's
+# push_metrics piggyback to the AM's get_metrics → the portal's /metrics).
+_QUEUE_DEPTH = obs_metrics.gauge(
+    "tony_serve_queue_depth",
+    "engine admission + staging queue depth (requests waiting for a slot)")
+_TTFT = obs_metrics.histogram(
+    "tony_serve_ttft_seconds",
+    "time from request submission to its first generated-token fanout")
+_TOKEN_LATENCY = obs_metrics.histogram(
+    "tony_serve_token_latency_seconds",
+    "per-token decode latency (chunk interval / tokens in the chunk)")
+_DELIVERED = obs_metrics.counter(
+    "tony_serve_tokens_delivered_total", "tokens actually written to client sockets")
+_REQUESTS_DONE = obs_metrics.counter(
+    "tony_serve_requests_total", "finished engine requests by outcome",
+    labelnames=("outcome",))
 
 
 class RequestStream:
@@ -53,11 +72,16 @@ class RequestStream:
     the client-disconnect/deadline path: the engine thread picks the flag
     up within one decode chunk and frees the slot/pages."""
 
-    __slots__ = ("q", "cancelled")
+    __slots__ = ("q", "cancelled", "submitted_s", "last_fanout_s")
 
     def __init__(self, maxsize: int = 0):
         self.q: queue.Queue = queue.Queue(maxsize)
         self.cancelled = threading.Event()
+        # instrument timestamps (engine-thread only): TTFT measures from
+        # SUBMISSION, so admission-queue wait is included — the number a
+        # client actually experiences
+        self.submitted_s = time.time()
+        self.last_fanout_s = 0.0
 
     def get(self, timeout: float | None = None):
         return self.q.get(timeout=timeout)
@@ -115,6 +139,7 @@ class EngineServer:
     def add_delivered(self, n: int) -> None:
         with self._delivered_lock:
             self.tokens_delivered += n
+        _DELIVERED.inc(n)
 
     def start(self) -> "EngineServer":
         self._thread.start()
@@ -147,13 +172,21 @@ class EngineServer:
                 out.put(("error", "overloaded: admission queue full"))
         return out
 
+    def _queue_depth(self) -> int:
+        """Requests waiting for a slot: engine pending + staged prefills +
+        the admission inbox. THE definition of queue depth — /stats (what
+        the fleet health monitor and autoscaler consume) and the
+        tony_serve_queue_depth gauge must never diverge."""
+        eng = self.engine
+        return len(eng.pending) + len(eng._staged) + self._inbox.qsize()
+
     def stats(self) -> dict[str, Any]:
         eng = self.engine
         up = max(time.time() - self.started_s, 1e-9)
         return {
             "slots_total": eng.S,
             "slots_active": len(eng.running),
-            "queue_depth": len(eng.pending) + len(eng._staged) + self._inbox.qsize(),
+            "queue_depth": self._queue_depth(),
             "requests_done": self.requests_done,
             "requests_cancelled": self.requests_cancelled,
             "tokens_out": self.tokens_out,
@@ -190,6 +223,8 @@ class EngineServer:
 
             self.error = e
             traceback.print_exc()
+            if self._streams:
+                _REQUESTS_DONE.inc(len(self._streams), outcome="error")
             for out in self._streams.values():
                 self._finish_stream(out, ("error", f"engine failed: {e}"))
             self._streams.clear()
@@ -244,6 +279,7 @@ class EngineServer:
                      else "cancelled: consumer stopped draining"),
                 )
                 self.requests_cancelled += 1
+                _REQUESTS_DONE.inc(outcome="cancelled")
                 del self._streams[rid]
                 self._deadlines.pop(rid, None)
 
@@ -267,6 +303,7 @@ class EngineServer:
                 if deadline and time.time() > deadline:
                     out.put(("error", "deadline exceeded"))
                     self.requests_cancelled += 1
+                    _REQUESTS_DONE.inc(outcome="cancelled")
                     continue  # expired while queued in the inbox
                 try:
                     rid = eng.submit(prompt, max_tokens, **sampling)
@@ -277,15 +314,24 @@ class EngineServer:
                 if deadline:
                     self._deadlines[rid] = deadline
             self._sweep_cancellations()
+            _QUEUE_DEPTH.set(self._queue_depth())
             had_work = eng.step()
+            now_s = time.time()
             for rid, (toks, done) in eng.drain_stream().items():
                 out = self._streams.get(rid)
                 final = eng.done.pop(rid, None) if done else None
                 if out is None:
                     continue
+                if toks:
+                    if out.last_fanout_s:
+                        _TOKEN_LATENCY.observe((now_s - out.last_fanout_s) / len(toks))
+                    else:
+                        _TTFT.observe(now_s - out.submitted_s)
+                    out.last_fanout_s = now_s
                 self.tokens_out += len(toks)
                 if done:
                     self.requests_done += 1
+                    _REQUESTS_DONE.inc(outcome="done")
                     # terminal event via the non-blocking evict-then-put: a
                     # full queue (consumer stalled since the last chunk) must
                     # not block the ONE engine thread on out.put()
@@ -481,7 +527,12 @@ def _register_with_am(url: str) -> None:
 def _metrics_pump(srv: EngineServer, stop: threading.Event, interval_s: float = 2.0) -> None:
     """Drop engine stats into ENV_TRAIN_METRICS_FILE (atomic rename) — the
     executor's metrics loop ships them to the AM, so the portal charts
-    serving throughput with the machinery training already uses."""
+    serving throughput with the machinery training already uses. The obs
+    metrics-registry snapshot (queue-depth gauge, TTFT / per-token-latency
+    histograms, delivered-tokens counter) drops next to it at
+    ``<train-metrics-file>.obs`` — the same contract as the training child's
+    loop.py — so serving instruments reach the executor's push_metrics
+    piggyback and the portal's /metrics."""
     path = os.environ.get(constants.ENV_TRAIN_METRICS_FILE)
     if not path:
         return
@@ -508,6 +559,15 @@ def _metrics_pump(srv: EngineServer, stop: threading.Event, interval_s: float = 
             os.replace(tmp, path)
         except OSError:
             pass
+        snap = [m for m in obs_metrics.REGISTRY.snapshot() if m["samples"]]
+        if snap:
+            try:
+                tmp = path + ".obs.tmp"
+                with open(tmp, "w") as f:
+                    json.dump(snap, f)
+                os.replace(tmp, path + ".obs")
+            except OSError:
+                pass
 
 
 def _resolve_kv(args) -> str:
@@ -616,6 +676,8 @@ def main(argv: list[str] | None = None) -> int:
                         "may override via the timeout_s body field")
     args = p.parse_args(argv)
 
+    if os.environ.get(constants.ENV_METRICS_ENABLED) == "0":
+        obs_metrics.set_enabled(False)  # job opted out (tony.metrics.enabled)
     done = threading.Event()
     srv = EngineServer(
         build_engine(args), on_fatal=done.set,

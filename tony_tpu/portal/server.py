@@ -230,7 +230,9 @@ class PortalHandler(BaseHTTPRequestHandler):
         return _page("tony-tpu jobs", "".join(sections))
 
     def _metrics_charts(self, evs: list[Event]) -> str:
-        """METRICS_SNAPSHOT series → per-task loss/tok-s/MFU sparklines."""
+        """METRICS_SNAPSHOT series → per-task sparklines. Training tasks
+        chart loss/tok-s/MFU; serve replicas push tokens_per_s/queue_depth/
+        slots_active through the same pipe (serving_http _metrics_pump)."""
         series: dict[str, dict[str, list[float]]] = {}
         for ev in evs:
             if ev.type.value != "METRICS_SNAPSHOT":
@@ -238,7 +240,8 @@ class PortalHandler(BaseHTTPRequestHandler):
             for entry in ev.payload.get("tasks", []):
                 train = (entry.get("metrics") or {}).get("train") or {}
                 per = series.setdefault(entry.get("task", "?"), {})
-                for k in ("loss", "tokens_per_sec", "mfu"):
+                for k in ("loss", "tokens_per_sec", "mfu",
+                          "tokens_per_s", "queue_depth", "slots_active"):
                     if isinstance(train.get(k), (int, float)):
                         per.setdefault(k, []).append(float(train[k]))
         if not series:
@@ -250,7 +253,7 @@ class PortalHandler(BaseHTTPRequestHandler):
             )
             if charts:
                 blocks.append(f"<p><b>{html.escape(task)}</b><br>{charts}</p>")
-        return "<h2>training metrics</h2>" + "".join(blocks) if blocks else ""
+        return "<h2>task metrics</h2>" + "".join(blocks) if blocks else ""
 
     def _live_table(self, app_id: str) -> str:
         cli = self._am_client(app_id)

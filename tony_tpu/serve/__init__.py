@@ -1,0 +1,30 @@
+"""Replicated serving control plane (docs/serving.md, "Replicated serving").
+
+``tony serve --replicas N`` turns the single AM-supervised inference task
+into a fault-tolerant fleet: N ``serve`` replicas under the ordinary gang
+machinery, fronted by three submitter-side pieces —
+
+- :class:`~tony_tpu.serve.router.FleetRouter`: HTTP front door with
+  least-outstanding balancing, health-checked failover/retry, and optional
+  tail hedging;
+- :class:`~tony_tpu.serve.health.HealthMonitor`: AM-registry endpoint
+  discovery (re-resolves across gang restarts) + active/passive per-replica
+  health (healthy → draining → down);
+- :class:`~tony_tpu.serve.autoscaler.Autoscaler`: queue-depth /
+  slot-utilization driven replica retargeting through the AM's
+  ``resize_jobtype`` elastic-rebuild path.
+"""
+
+from tony_tpu.serve.autoscaler import AutoscalePolicy, Autoscaler
+from tony_tpu.serve.health import FleetSignals, HealthMonitor, Replica, ReplicaState
+from tony_tpu.serve.router import FleetRouter
+
+__all__ = [
+    "AutoscalePolicy",
+    "Autoscaler",
+    "FleetRouter",
+    "FleetSignals",
+    "HealthMonitor",
+    "Replica",
+    "ReplicaState",
+]

@@ -1,0 +1,140 @@
+"""Replica autoscaler: engine load signals → the AM's elastic-resize lever.
+
+Runs next to the fleet router in the submitting process, sampling the
+:class:`~tony_tpu.serve.health.HealthMonitor`'s aggregated ``/stats`` view
+every ``tony.serve.autoscale-interval-ms``:
+
+- **scale up** (+1) when the mean admission-queue depth per healthy replica
+  exceeds ``scale-up-queue-depth`` OR fleet slot utilization exceeds
+  ``scale-up-utilization``, sustained for ``scale-up-ticks`` samples;
+- **scale down** (−1) when the fleet queue is empty AND utilization is below
+  ``scale-down-utilization``, sustained for ``scale-down-ticks`` samples
+  (longer than up: adding capacity is cheap, removing it costs a rebuild);
+- clamped to [``min-replicas``, ``max-replicas``]; no decision while the
+  fleet is mid-restart (zero healthy replicas says nothing about load).
+
+Decisions call the AM's ``resize_jobtype`` RPC — the same rebuild path
+capacity-loss downsizing uses — never a re-submission, so queue placement,
+history, and the trace all stay with the one application. The current
+replica count is re-read from the health monitor's fleet view each tick, so
+an AM-side resize from another cause (capacity loss) reconverges instead of
+fighting the autoscaler's stale notion of "current".
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import trace as obs_trace
+from tony_tpu.serve.health import FleetSignals, HealthMonitor
+
+_DECISIONS = obs_metrics.counter(
+    "tony_serve_autoscale_decisions_total",
+    "autoscaler resize decisions by direction", labelnames=("direction",))
+_TARGET = obs_metrics.gauge(
+    "tony_serve_target_replicas", "autoscaler's current replica target")
+
+
+@dataclass
+class AutoscalePolicy:
+    """Pure decision parameters (tony.serve.* keys)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    scale_up_queue_depth: float = 4.0
+    scale_up_utilization: float = 0.85
+    scale_down_utilization: float = 0.25
+    scale_up_ticks: int = 2
+    scale_down_ticks: int = 6
+
+
+class Autoscaler:
+    """Threaded driver over a pure :meth:`decide` core.
+
+    ``resize(job_name, instances)`` is the AM lever (tests inject a fake);
+    production passes ``lambda job, n: rpc.call("resize_jobtype",
+    job_name=job, instances=n)``.
+    """
+
+    def __init__(
+        self,
+        health: HealthMonitor,
+        resize: Callable[[str, int], Any],
+        policy: AutoscalePolicy,
+        job_name: str = "serve",
+        interval_s: float = 5.0,
+    ):
+        self.health = health
+        self._resize = resize
+        self.policy = policy
+        self.job_name = job_name
+        self.interval_s = interval_s
+        self._up_ticks = 0
+        self._down_ticks = 0
+        self.target: int | None = None  # last requested target (None: no request yet)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-autoscaler", daemon=True)
+
+    def start(self) -> "Autoscaler":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — AM restarting is routine here
+                pass
+
+    # ------------------------------------------------------------- decision
+    def decide(self, current: int, sig: FleetSignals) -> int:
+        """Next replica target given the fleet's load signals. Mutates the
+        hysteresis tick counters; returns ``current`` for "hold"."""
+        p = self.policy
+        if sig.replicas_healthy == 0:
+            # mid-restart / fleet down: no signal, no decision — and reset
+            # hysteresis so stale pressure doesn't fire on the first sample
+            # after recovery
+            self._up_ticks = self._down_ticks = 0
+            return current
+        queue_per_replica = sig.queue_depth / sig.replicas_healthy
+        want_up = (
+            queue_per_replica > p.scale_up_queue_depth
+            or sig.utilization > p.scale_up_utilization
+        )
+        want_down = sig.queue_depth == 0 and sig.utilization < p.scale_down_utilization
+        self._up_ticks = self._up_ticks + 1 if want_up else 0
+        self._down_ticks = self._down_ticks + 1 if want_down else 0
+        if self._up_ticks >= p.scale_up_ticks:
+            self._up_ticks = 0
+            return min(current + 1, max(p.max_replicas, p.min_replicas, 1))
+        if self._down_ticks >= p.scale_down_ticks:
+            self._down_ticks = 0
+            return max(current - 1, max(p.min_replicas, 1))
+        return current
+
+    def tick(self) -> None:
+        sig = self.health.fleet_signals()
+        current = sig.replicas_known or (self.target or 0)
+        if current == 0:
+            return  # nothing resolved yet
+        target = self.decide(current, sig)
+        _TARGET.set(target)
+        if target == current:
+            return
+        direction = "up" if target > current else "down"
+        _DECISIONS.inc(direction=direction)
+        obs_trace.add_event(
+            "autoscale.decision", direction=direction,
+            current=current, target=target,
+            queue_depth=sig.queue_depth, utilization=round(sig.utilization, 3),
+        )
+        self.target = target
+        self._resize(self.job_name, target)

@@ -1,0 +1,285 @@
+"""Replica discovery + health for the serving fleet.
+
+The fleet's membership truth is the AM (§3.4 URL registration: every
+``serve`` replica registers its endpoint through ``register_task_url``, the
+same path ``tony serve`` used for its single task). :class:`HealthMonitor`
+polls ``get_task_infos`` to discover/refresh replica endpoints — so a gang
+restart (new URLs, bumped ``restart_attempt``) re-resolves automatically —
+and layers a per-replica health state machine on top:
+
+    UNKNOWN ──probe ok──▶ HEALTHY ──/stats draining──▶ DRAINING
+       ▲                    │  ▲                          │
+       └──new attempt──┐    │  └──probe recovers──┐       │
+                       ▼    ▼                     │       ▼
+                      DOWN ◀──────────────────────┴── (probe fails)
+
+- **active**: every tick, GET each replica's ``/stats`` (the engine server's
+  counters endpoint). ``healthy: false`` (fatal engine error) → DOWN
+  immediately; connection failures → DOWN after ``fail_threshold``
+  consecutive misses; ``draining: true`` (SIGTERM received) → DRAINING.
+- **passive**: the router reports request-level failures
+  (:meth:`HealthMonitor.report_failure`) which count against the same
+  threshold, and successes (:meth:`report_success`) which reset it — a
+  replica that silently blackholes requests goes DOWN between probes.
+
+DOWN and DRAINING replicas take no new requests; a successful probe (the
+restarted replica came back) returns them to HEALTHY. The monitor also
+aggregates the autoscaler's input signals (queue depth, slot utilization)
+from the same ``/stats`` payloads — one poll feeds routing, scaling, and
+the ``/fleet`` status page.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tony_tpu.obs import metrics as obs_metrics
+
+_REPLICAS = obs_metrics.gauge(
+    "tony_router_replicas", "fleet replicas by health state", labelnames=("state",))
+_RESOLVES = obs_metrics.counter(
+    "tony_router_endpoint_resolves_total",
+    "replica endpoint (re-)resolutions from the AM's task registry")
+
+
+class ReplicaState(enum.Enum):
+    UNKNOWN = "UNKNOWN"      # endpoint known, no probe verdict yet
+    HEALTHY = "HEALTHY"
+    DRAINING = "DRAINING"    # engine refusing admissions (SIGTERM drain)
+    DOWN = "DOWN"
+
+    @property
+    def routable(self) -> bool:
+        """May the router send NEW requests here? UNKNOWN is optimistically
+        routable only as a last resort (see FleetRouter._pick)."""
+        return self == ReplicaState.HEALTHY
+
+
+@dataclass
+class Replica:
+    """One serve task's endpoint + health view."""
+
+    index: int
+    url: str                              # "http://host:port"
+    attempt: int = 0                      # gang epoch the URL registered in
+    state: ReplicaState = ReplicaState.UNKNOWN
+    failures: int = 0                     # consecutive probe/request failures
+    outstanding: int = 0                  # in-flight router requests (router-maintained)
+    stats: dict[str, Any] = field(default_factory=dict)  # last /stats payload
+    last_probe_ms: float = 0.0
+
+    @property
+    def id(self) -> str:
+        return f"serve:{self.index}"
+
+    def to_info(self) -> dict[str, Any]:
+        return {
+            "index": self.index,
+            "url": self.url,
+            "attempt": self.attempt,
+            "state": self.state.value,
+            "failures": self.failures,
+            "outstanding": self.outstanding,
+            "queue_depth": self.stats.get("queue_depth"),
+            "slots_active": self.stats.get("slots_active"),
+            "slots_total": self.stats.get("slots_total"),
+        }
+
+
+@dataclass
+class FleetSignals:
+    """Aggregated autoscaler inputs (healthy replicas only)."""
+
+    replicas_known: int = 0
+    replicas_healthy: int = 0
+    queue_depth: int = 0      # summed engine admission+staging queues
+    slots_active: int = 0
+    slots_total: int = 0
+
+    @property
+    def utilization(self) -> float:
+        return self.slots_active / self.slots_total if self.slots_total else 0.0
+
+
+class HealthMonitor:
+    """Background discovery + health loop over one job's serve replicas.
+
+    ``am_call(method, **params)`` is the AM RPC surface (tests inject a
+    fake); probing uses plain HTTP against each replica's ``/stats``.
+    """
+
+    def __init__(
+        self,
+        am_call: Callable[..., Any],
+        job_name: str = "serve",
+        interval_s: float = 1.0,
+        fail_threshold: int = 3,
+        probe_timeout_s: float = 2.0,
+    ):
+        self._am_call = am_call
+        self.job_name = job_name
+        self.interval_s = interval_s
+        self.fail_threshold = max(int(fail_threshold), 1)
+        self.probe_timeout_s = probe_timeout_s
+        self.lock = threading.Lock()
+        self.replicas: dict[int, Replica] = {}
+        self.restart_attempt = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name="fleet-health", daemon=True)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "HealthMonitor":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def tick(self) -> None:
+        """One resolve+probe pass (the loop body; tests drive it directly)."""
+        self._resolve()
+        for replica in self.snapshot():
+            self._probe(replica)
+        self._export_gauges()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — health must outlive AM blips
+                pass
+
+    # ------------------------------------------------------------ discovery
+    def _resolve(self) -> None:
+        """Refresh endpoints from the AM. A bumped ``restart_attempt`` (gang
+        restart) invalidates every known URL — the old processes are dead
+        even if their ports answer; replicas go DOWN until the new epoch's
+        registrations arrive. Indices that vanished (scale-down) drop."""
+        try:
+            status = self._am_call("get_application_status")
+            infos = self._am_call("get_task_infos")
+        except Exception:  # noqa: BLE001 — AM restarting / unreachable
+            return
+        attempt = int(status.get("restart_attempt") or 0)
+        present: set[int] = set()
+        seen: dict[int, str] = {}
+        for info in infos:
+            if info.get("name") != self.job_name:
+                continue
+            idx = int(info["index"])
+            present.add(idx)  # the current session HAS this task (any status)
+            url = info.get("url")
+            if url and info.get("status") not in ("FAILED", "KILLED", "LOST"):
+                seen[idx] = url
+        with self.lock:
+            if attempt != self.restart_attempt:
+                self.restart_attempt = attempt
+                for r in self.replicas.values():
+                    r.state = ReplicaState.DOWN  # stale epoch: URL is dead
+            for idx in list(self.replicas):
+                if idx not in present:
+                    # resized away entirely (the session no longer declares
+                    # the index); mid-restart tasks stay listed (status NEW),
+                    # so an outage keeps its DOWN entry visible in /fleet
+                    del self.replicas[idx]
+            for idx, url in seen.items():
+                r = self.replicas.get(idx)
+                if r is None or r.url != url or r.attempt != attempt:
+                    _RESOLVES.inc()
+                    self.replicas[idx] = Replica(index=idx, url=url, attempt=attempt)
+
+    # ------------------------------------------------------------- probing
+    def _probe(self, replica: Replica) -> None:
+        try:
+            with urllib.request.urlopen(
+                replica.url + "/stats", timeout=self.probe_timeout_s
+            ) as resp:
+                payload = json.loads(resp.read())
+        except Exception:  # noqa: BLE001 — any transport/parse failure is a miss
+            self._count_failure(replica)
+            return
+        with self.lock:
+            replica.last_probe_ms = time.time() * 1000
+            replica.stats = payload
+            if replica.attempt != self.restart_attempt:
+                # stale-epoch endpoint still answering inside the SIGTERM
+                # window: its process is condemned — never flip it routable
+                replica.state = ReplicaState.DOWN
+            elif not payload.get("healthy", True):
+                replica.state = ReplicaState.DOWN  # fatal engine error: no retry budget
+                replica.failures = self.fail_threshold
+            elif payload.get("draining"):
+                replica.state = ReplicaState.DRAINING
+                replica.failures = 0
+            else:
+                replica.state = ReplicaState.HEALTHY
+                replica.failures = 0
+
+    def _count_failure(self, replica: Replica) -> None:
+        with self.lock:
+            replica.failures += 1
+            if replica.failures >= self.fail_threshold:
+                replica.state = ReplicaState.DOWN
+
+    # ----------------------------------------------------- passive marking
+    def report_failure(self, replica: Replica, hard: bool = False) -> None:
+        """Router-observed failure. ``hard`` (connection refused/reset — the
+        process is gone) marks DOWN immediately; soft failures (5xx) count
+        against the probe threshold."""
+        if hard:
+            with self.lock:
+                replica.failures = max(replica.failures, self.fail_threshold)
+                replica.state = ReplicaState.DOWN
+        else:
+            self._count_failure(replica)
+
+    def report_success(self, replica: Replica) -> None:
+        with self.lock:
+            replica.failures = 0
+            # never resurrect a stale-epoch replica: after a gang restart
+            # bumps the attempt, a completing in-flight request on the OLD
+            # (dying) endpoint must not flip it back to routable
+            if (replica.state == ReplicaState.DOWN
+                    and replica.attempt == self.restart_attempt):
+                replica.state = ReplicaState.HEALTHY
+
+    # ------------------------------------------------------------- queries
+    def snapshot(self) -> list[Replica]:
+        with self.lock:
+            return sorted(self.replicas.values(), key=lambda r: r.index)
+
+    def fleet_signals(self) -> FleetSignals:
+        sig = FleetSignals()
+        with self.lock:
+            for r in self.replicas.values():
+                sig.replicas_known += 1
+                if r.state != ReplicaState.HEALTHY:
+                    continue
+                sig.replicas_healthy += 1
+                st = r.stats
+                sig.queue_depth += int(st.get("queue_depth") or 0)
+                sig.slots_active += int(st.get("slots_active") or 0)
+                sig.slots_total += int(st.get("slots_total") or 0)
+        return sig
+
+    def fleet_info(self) -> dict[str, Any]:
+        with self.lock:
+            return {
+                "job": self.job_name,
+                "restart_attempt": self.restart_attempt,
+                "replicas": [r.to_info() for r in
+                             sorted(self.replicas.values(), key=lambda r: r.index)],
+            }
+
+    def _export_gauges(self) -> None:
+        counts = dict.fromkeys(ReplicaState, 0)
+        for r in self.snapshot():
+            counts[r.state] += 1
+        for state, n in counts.items():
+            _REPLICAS.set(n, state=state.value.lower())

@@ -1,0 +1,467 @@
+"""Fleet router: the HTTP front door of a replicated ``tony serve`` job.
+
+Runs in the submitting process (the notebook-proxy shape, SURVEY.md §3.4:
+the submitter terminates user traffic locally and reaches the containers
+through AM-registered URLs), in front of N ``serve`` replicas:
+
+- **balancing**: least-outstanding-requests over HEALTHY replicas (ties →
+  lowest index). UNKNOWN replicas (no probe verdict yet) are picked only
+  when nothing HEALTHY exists — optimistic first-touch after a restart.
+- **failover**: a replica-level failure (connect refused/reset, response
+  5xx) marks the replica through the :class:`HealthMonitor` and retries the
+  request on another replica — engine requests are stateless, so
+  completions are idempotent and safe to replay as long as no response
+  byte has reached the client. When the whole fleet is down (gang restart
+  in flight) the router WAITS for a replica to return, bounded by
+  ``tony.serve.failover-deadline-ms`` — a replica crash costs the client
+  latency, never an error.
+- **hedging** (optional, non-streaming only): once an in-flight request
+  outlives the p-th percentile of recent latencies
+  (``tony.serve.hedge-percentile``, floored at ``hedge-min-ms``), the same
+  request is fired at a second replica and the first response wins — the
+  tail of a slow/overloaded replica stops defining the fleet's tail.
+
+Client-level outcomes (400 bad request, 404, 429 overloaded, 504 deadline)
+are forwarded verbatim, never retried. Responses carry ``X-Tony-Replica``
+with the serving replica's index.
+
+Observability: every request runs under a ``router.request`` span with one
+``router.attempt`` child per replica try (job trace, joined via the
+submit-span parent); request/retry/hedge counters and per-replica latency
+histograms record into the process ``obs`` registry, which the submitter
+pushes to the AM (``push_client_metrics``) for the portal's ``/metrics``.
+Tracing disabled (the default) stays allocation-free on the hot path.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib.parse import urlsplit
+
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import trace as obs_trace
+from tony_tpu.serve.health import HealthMonitor, Replica, ReplicaState
+
+_REQUESTS = obs_metrics.counter(
+    "tony_router_requests_total", "routed requests by outcome", labelnames=("outcome",))
+_RETRIES = obs_metrics.counter(
+    "tony_router_retries_total", "replica failovers (request replayed on another replica)")
+_HEDGES = obs_metrics.counter(
+    "tony_router_hedges_total", "hedge requests fired at a second replica")
+_HEDGE_WINS = obs_metrics.counter(
+    "tony_router_hedge_wins_total", "hedged requests won by the second replica")
+_REPLICA_LATENCY = obs_metrics.histogram(
+    "tony_router_replica_latency_seconds",
+    "per-replica request latency through the router", labelnames=("replica",))
+
+#: headers copied from the winning replica response to the client
+_FORWARD_HEADERS = ("Content-Type", "Retry-After", "Cache-Control")
+
+
+class _AttemptFailed(Exception):
+    """Replica-level failure (retryable on another replica)."""
+
+    def __init__(self, replica: Replica, reason: str, hard: bool):
+        super().__init__(reason)
+        self.replica = replica
+        self.hard = hard  # connection-level (process gone) vs 5xx
+
+
+class _Latencies:
+    """Rolling window of recent non-streaming latencies → hedge threshold."""
+
+    def __init__(self, size: int = 512, min_samples: int = 20):
+        self._lock = threading.Lock()
+        self._window: list[float] = []
+        self._size = size
+        self._min_samples = min_samples
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._window.append(seconds)
+            if len(self._window) > self._size:
+                del self._window[: len(self._window) - self._size]
+
+    def percentile(self, p: float) -> float | None:
+        with self._lock:
+            if len(self._window) < self._min_samples:
+                return None
+            xs = sorted(self._window)
+        i = min(int(len(xs) * p / 100.0), len(xs) - 1)
+        return xs[i]
+
+
+class FleetRouter:
+    """HTTP front door over a :class:`HealthMonitor`'s fleet view."""
+
+    def __init__(
+        self,
+        health: HealthMonitor,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        retries: int = 3,
+        failover_deadline_s: float = 120.0,
+        hedge_percentile: float = 0.0,
+        hedge_min_s: float = 0.05,
+        connect_timeout_s: float = 5.0,
+        replica_timeout_s: float = 300.0,
+    ):
+        self.health = health
+        self.retries = max(int(retries), 0)
+        self.failover_deadline_s = failover_deadline_s
+        self.hedge_percentile = hedge_percentile
+        self.hedge_min_s = hedge_min_s
+        # connect is bounded TIGHT (a silently-dead host must fail over in
+        # seconds, not hold the client for the full read budget); the read
+        # timeout stays long — buffered long completions are legitimate
+        self.connect_timeout_s = connect_timeout_s
+        self.replica_timeout_s = replica_timeout_s
+        self.started_s = time.time()
+        self._latencies = _Latencies()
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a) -> None:  # quiet
+                pass
+
+            def do_GET(self) -> None:  # noqa: N802
+                router._handle_get(self)
+
+            def do_POST(self) -> None:  # noqa: N802
+                router._handle_post(self)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-router", daemon=True)
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FleetRouter":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ----------------------------------------------------------- GET pages
+    def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
+        if h.path == "/healthz":
+            sig = self.health.fleet_signals()
+            _reply_json(h, 200 if sig.replicas_healthy else 503, {
+                "ok": sig.replicas_healthy > 0,
+                "replicas_healthy": sig.replicas_healthy,
+                "replicas_known": sig.replicas_known,
+            })
+        elif h.path == "/stats":
+            _reply_json(h, 200, self.stats())
+        elif h.path == "/fleet":
+            _reply_json(h, 200, self.health.fleet_info())
+        else:
+            _reply_json(h, 404, {"error": "not found"})
+
+    def stats(self) -> dict[str, Any]:
+        """Aggregated fleet counters + router-level totals."""
+        agg: dict[str, float] = {}
+        per_replica = []
+        for r in self.health.snapshot():
+            per_replica.append(r.to_info())
+            if r.state == ReplicaState.HEALTHY:
+                for k in ("slots_total", "slots_active", "queue_depth",
+                          "requests_done", "tokens_out", "tokens_delivered"):
+                    v = r.stats.get(k)
+                    if isinstance(v, (int, float)):
+                        agg[k] = agg.get(k, 0) + v
+        return {
+            "router": {
+                "uptime_s": round(time.time() - self.started_s, 1),
+                "requests_ok": _REQUESTS.value(outcome="ok"),
+                "requests_forwarded": _REQUESTS.value(outcome="forwarded"),
+                "requests_unavailable": _REQUESTS.value(outcome="unavailable"),
+                "retries": _RETRIES.value(),
+                "hedges": _HEDGES.value(),
+                "hedge_wins": _HEDGE_WINS.value(),
+            },
+            "fleet": agg,
+            "replicas": per_replica,
+        }
+
+    # --------------------------------------------------------- POST → proxy
+    def _handle_post(self, h: BaseHTTPRequestHandler) -> None:
+        length = int(h.headers.get("Content-Length") or 0)
+        body = h.rfile.read(length) if length else b""
+        stream = False
+        try:
+            stream = bool(json.loads(body or b"{}").get("stream", False))
+        except ValueError:
+            pass  # the replica will answer 400; route it through anyway
+        with obs_trace.maybe_span("router.request", path=h.path, stream=stream):
+            self._route(h, h.path, body, stream)
+
+    def _route(self, h: BaseHTTPRequestHandler, path: str, body: bytes, stream: bool) -> None:
+        deadline = time.monotonic() + self.failover_deadline_s
+        tried: set[int] = set()
+        soft_failovers = 0
+        while True:
+            replica = self._pick(tried)
+            if replica is None:
+                if tried:
+                    tried.clear()  # every routable replica tried: start over
+                    continue
+                if time.monotonic() >= deadline:
+                    _REQUESTS.inc(outcome="unavailable")
+                    _reply_json(h, 503, {"error": "no healthy replica "
+                                         f"(waited {self.failover_deadline_s:.0f}s)"})
+                    return
+                # whole fleet down (gang restart in flight): wait for the
+                # health monitor to resolve the relaunched endpoints
+                time.sleep(0.1)
+                continue
+            try:
+                if stream:
+                    self._attempt_stream(h, replica, path, body)
+                else:
+                    status, headers, payload = self._attempt_hedged(replica, tried, path, body)
+                    _relay(h, status, headers, payload)
+                    _REQUESTS.inc(outcome="ok" if status == 200 else "forwarded")
+                return
+            except _AttemptFailed as e:
+                # (the failure was already reported to the HealthMonitor at
+                # the raise site — hedge legs report even when discarded)
+                obs_trace.add_event(
+                    "router.failover", replica=e.replica.index, reason=str(e)[:200])
+                tried.add(e.replica.index)
+                _RETRIES.inc()
+                # only SOFT failovers (replica up but erroring) consume the
+                # retry budget; hard (connection) failures wait out the
+                # restart, bounded by the deadline above — a crash-window
+                # hard failover must never pre-spend the 5xx budget
+                if not e.hard:
+                    soft_failovers += 1
+                    if soft_failovers > self.retries:
+                        # replaying a systematic failure forever would only
+                        # amplify it
+                        _REQUESTS.inc(outcome="failed")
+                        _reply_json(h, 502, {"error": f"replicas failing: {e}"})
+                        return
+
+    # ------------------------------------------------------------ selection
+    def _pick(self, exclude: set[int]) -> Replica | None:
+        """Least-outstanding HEALTHY replica; UNKNOWN (no probe verdict yet —
+        e.g. just relaunched) only when nothing is HEALTHY."""
+        snap = self.health.snapshot()
+        for state in (ReplicaState.HEALTHY, ReplicaState.UNKNOWN):
+            cands = [r for r in snap if r.state == state and r.index not in exclude]
+            if cands:
+                return min(cands, key=lambda r: (r.outstanding, r.index))
+        return None
+
+    # ------------------------------------------------------------- attempts
+    def _fail(self, replica: Replica, reason: str, hard: bool) -> _AttemptFailed:
+        """Build an _AttemptFailed AND report it to the HealthMonitor at the
+        raise site — so hedge legs whose exception is discarded (the other
+        leg won) still mark their replica."""
+        self.health.report_failure(replica, hard=hard)
+        return _AttemptFailed(replica, reason, hard)
+
+    def _open(self, replica: Replica, path: str, body: bytes):
+        """One POST to a replica → live (conn, response). Connection-level
+        failures raise _AttemptFailed(hard=True)."""
+        parts = urlsplit(replica.url)
+        try:
+            conn = http.client.HTTPConnection(
+                parts.hostname, parts.port, timeout=self.connect_timeout_s)
+            conn.connect()
+            conn.sock.settimeout(self.replica_timeout_s)
+            conn.request("POST", path, body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+        except (ConnectionError, OSError) as e:
+            raise self._fail(replica, f"connect/send failed: {e}", hard=True) from e
+        # 504 is the REPLICA's verdict on the client's own deadline
+        # (serving_http maps "deadline exceeded" to 504): a client-level
+        # outcome to forward verbatim, not a replica failure — retrying would
+        # restart the deadline clock on another replica and answer 502
+        if resp.status >= 500 and resp.status != 504:
+            payload = resp.read()
+            conn.close()
+            raise self._fail(
+                replica, f"replica answered {resp.status}: {payload[:200]!r}", hard=False)
+        return conn, resp
+
+    def _attempt_once(self, replica: Replica, path: str, body: bytes) -> tuple[int, dict, bytes]:
+        """Buffered (non-streaming) attempt; returns (status, headers, body)."""
+        with self.health.lock:
+            replica.outstanding += 1
+        t0 = time.perf_counter()
+        try:
+            with obs_trace.maybe_span("router.attempt", replica=replica.index):
+                conn, resp = self._open(replica, path, body)
+                try:
+                    payload = resp.read()
+                except (ConnectionError, OSError) as e:
+                    raise self._fail(replica, f"read failed: {e}", hard=True) from e
+                finally:
+                    conn.close()
+        finally:
+            with self.health.lock:
+                replica.outstanding -= 1
+        took = time.perf_counter() - t0
+        _REPLICA_LATENCY.observe(took, replica=str(replica.index))
+        if resp.status == 200:
+            self._latencies.observe(took)
+        self.health.report_success(replica)
+        headers = {k: resp.headers[k] for k in _FORWARD_HEADERS if resp.headers.get(k)}
+        headers["X-Tony-Replica"] = str(replica.index)
+        return resp.status, headers, payload
+
+    def _attempt_hedged(
+        self, replica: Replica, tried: set[int], path: str, body: bytes
+    ) -> tuple[int, dict, bytes]:
+        """Non-streaming attempt with optional tail hedging. The primary
+        failure mode propagates as _AttemptFailed only when no hedge is in
+        flight or the hedge failed too."""
+        threshold = None
+        if self.hedge_percentile > 0:
+            p = self._latencies.percentile(self.hedge_percentile)
+            if p is not None:
+                threshold = max(p, self.hedge_min_s)
+        if threshold is None:
+            return self._attempt_once(replica, path, body)
+
+        results: "queue.Queue[tuple[bool, Any, Replica]]" = queue.Queue()
+
+        def run(r: Replica) -> None:
+            try:
+                results.put((True, self._attempt_once(r, path, body), r))
+            except _AttemptFailed as e:
+                results.put((False, e, r))
+
+        threading.Thread(target=run, args=(replica,), daemon=True).start()
+        in_flight = 1
+        hedge_fired = False
+        try:
+            ok, payload, who = results.get(timeout=threshold)
+        except queue.Empty:
+            backup = self._pick_hedge(exclude=tried | {replica.index})
+            if backup is not None:
+                _HEDGES.inc()
+                hedge_fired = True
+                obs_trace.add_event("router.hedge", primary=replica.index,
+                                    backup=backup.index)
+                threading.Thread(target=run, args=(backup,), daemon=True).start()
+                in_flight += 1
+            ok, payload, who = results.get()
+        in_flight -= 1
+        if not ok and in_flight:
+            # first finisher failed (already health-reported at the raise
+            # site); exclude it from this request and give the other leg
+            # its chance
+            tried.add(payload.replica.index)
+            ok, payload, who = results.get()
+            in_flight -= 1
+        if not ok:
+            raise payload  # _AttemptFailed from the losing leg
+        if hedge_fired and who is not replica:
+            _HEDGE_WINS.inc()
+        return payload
+
+    def _pick_hedge(self, exclude: set[int]) -> Replica | None:
+        healthy = [r for r in self.health.snapshot()
+                   if r.state == ReplicaState.HEALTHY and r.index not in exclude]
+        return min(healthy, key=lambda r: (r.outstanding, r.index)) if healthy else None
+
+    # ------------------------------------------------------------ streaming
+    def _attempt_stream(
+        self, h: BaseHTTPRequestHandler, replica: Replica, path: str, body: bytes
+    ) -> None:
+        """SSE relay. Retryable only until the response status is known; once
+        bytes flow to the client a replica death truncates the stream (the
+        client sees the connection close, exactly as if it held the replica
+        connection itself)."""
+        with self.health.lock:
+            replica.outstanding += 1
+        t0 = time.perf_counter()
+        try:
+            with obs_trace.maybe_span("router.attempt", replica=replica.index, stream=True):
+                conn, resp = self._open(replica, path, body)
+                try:
+                    if not (resp.headers.get("Content-Type") or "").startswith(
+                        "text/event-stream"
+                    ):
+                        # non-streaming reply to a stream request (400, 429,
+                        # 503-draining...): buffered forward, still retryable
+                        try:
+                            payload = resp.read()
+                        except (ConnectionError, OSError) as e:
+                            raise self._fail(
+                                replica, f"read failed: {e}", hard=True) from e
+                        headers = {k: resp.headers[k] for k in _FORWARD_HEADERS
+                                   if resp.headers.get(k)}
+                        headers["X-Tony-Replica"] = str(replica.index)
+                        _relay(h, resp.status, headers, payload)
+                        _REQUESTS.inc(outcome="ok" if resp.status == 200 else "forwarded")
+                        self.health.report_success(replica)
+                        return
+                    h.send_response(200)
+                    h.send_header("Content-Type", resp.headers["Content-Type"])
+                    h.send_header("Cache-Control", "no-cache")
+                    h.send_header("X-Tony-Replica", str(replica.index))
+                    h.end_headers()
+                    while True:
+                        try:
+                            chunk = resp.read1(8192)
+                        except (ConnectionError, OSError):
+                            # replica died mid-stream: the client sees the
+                            # truncated stream; mark the replica so the next
+                            # request doesn't need the active probe to notice
+                            self.health.report_failure(replica, hard=True)
+                            _REQUESTS.inc(outcome="truncated")
+                            return
+                        if not chunk:
+                            break
+                        try:
+                            h.wfile.write(chunk)
+                            h.wfile.flush()
+                        except OSError:
+                            conn.close()  # client went away: cancel upstream
+                            _REQUESTS.inc(outcome="client_disconnect")
+                            return
+                    _REQUESTS.inc(outcome="ok")
+                    self.health.report_success(replica)
+                finally:
+                    conn.close()
+        finally:
+            with self.health.lock:
+                replica.outstanding -= 1
+            _REPLICA_LATENCY.observe(
+                time.perf_counter() - t0, replica=str(replica.index))
+
+
+# ---------------------------------------------------------------- helpers
+def _reply_json(h: BaseHTTPRequestHandler, status: int, obj: Any) -> None:
+    body = json.dumps(obj).encode()
+    h.send_response(status)
+    h.send_header("Content-Type", "application/json")
+    h.send_header("Content-Length", str(len(body)))
+    h.end_headers()
+    h.wfile.write(body)
+
+
+def _relay(h: BaseHTTPRequestHandler, status: int, headers: dict, body: bytes) -> None:
+    h.send_response(status)
+    for k, v in headers.items():
+        h.send_header(k, v)
+    h.send_header("Content-Length", str(len(body)))
+    h.end_headers()
+    h.wfile.write(body)
