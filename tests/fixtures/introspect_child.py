@@ -1,0 +1,118 @@
+"""Fake training child for the introspection e2e (tests/test_introspect.py).
+
+Exercises every framework contract a real ``run_lm_training`` child does —
+StepProfiler (incl. the on-demand control-file plane), structured logging,
+the train-metrics + ``.obs`` registry drops, tracing — but with a plain
+sleep loop instead of XLA work, so the gang is live within a second and the
+mid-run ``tony profile`` / ``tony logs -f`` / ``tony top`` round trips are
+fast and deterministic. Exits 0 when ``<staging>/stop`` appears.
+"""
+
+import json
+import os
+import sys
+import time
+import types
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+# This gang exercises the distributed relay plane — control file in, done
+# file + artifacts out, RPC reports, merged logs — not XLA: a stub
+# ``jax.profiler`` stands in for the real one (whose cold import would
+# dominate the test clock), writing a real artifact file per capture. The
+# genuine ``jax.profiler`` start/stop path and artifact readability are
+# covered in-process by tests/test_profiling.py.
+class _StubProfiler:
+    _dir = ""
+
+    def start_trace(self, out_dir):
+        self._dir = out_dir
+
+    def stop_trace(self):
+        os.makedirs(self._dir, exist_ok=True)
+        with open(os.path.join(self._dir, "trace.json"), "w") as f:
+            json.dump({"stub": True}, f)
+
+    def save_device_memory_profile(self, path):
+        with open(path, "w") as f:
+            f.write("stub")
+
+
+_fake_jax = types.ModuleType("jax")
+_fake_jax.profiler = _StubProfiler()
+sys.modules.setdefault("jax", _fake_jax)
+
+
+def _load_step_profiler():
+    """StepProfiler straight from its file — ``tony_tpu.train``'s package
+    init pulls the trainer (and with it the real jax) this gang exists to
+    avoid paying for."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "tony_tpu", "train", "profiling.py",
+    )
+    spec = importlib.util.spec_from_file_location("_introspect_profiling", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.StepProfiler
+
+from tony_tpu import constants  # noqa: E402
+from tony_tpu.obs import logging as obs_log  # noqa: E402
+from tony_tpu.obs import metrics as obs_metrics  # noqa: E402
+from tony_tpu.obs import trace as obs_trace  # noqa: E402
+StepProfiler = _load_step_profiler()  # noqa: E402
+
+obs_log.init_from_env()
+tracer = obs_trace.init_from_env()
+root = token = None
+if tracer is not None:
+    root, token = tracer.start_span("train.run")
+    tracer.root_parent = root.span_id
+
+step_seconds = obs_metrics.histogram(
+    "tony_train_step_seconds",
+    "mean per-step wall time, sampled once per logging window")
+metrics_path = os.environ.get(constants.ENV_TRAIN_METRICS_FILE, "")
+stop_file = os.path.join(os.environ["TONY_STAGING_DIR"], "stop")
+profiler = StepProfiler()
+
+
+def drop(line):
+    if not metrics_path:
+        return
+    tmp = metrics_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(line, f)
+    os.replace(tmp, metrics_path)
+    snap = [m for m in obs_metrics.REGISTRY.snapshot() if m["samples"]]
+    if snap:
+        tmp = metrics_path + ".obs.tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, metrics_path + ".obs")
+
+
+t0 = time.perf_counter()
+try:
+    for step in range(2000):
+        profiler.step(step)
+        time.sleep(0.02)
+        now = time.perf_counter()
+        step_seconds.observe(now - t0)
+        t0 = now
+        if (step + 1) % 5 == 0:
+            line = {"step": step + 1, "loss": round(2.5 - step * 1e-3, 4),
+                    "tokens_per_sec": 123.4, "mfu": 0.1}
+            obs_log.info(json.dumps(line), **line)
+            drop(line)
+        if os.path.exists(stop_file):
+            break
+finally:
+    profiler.stop()
+if tracer is not None:
+    tracer.end_span(root, token)
+    obs_trace.shutdown()
+print("introspect child done", flush=True)

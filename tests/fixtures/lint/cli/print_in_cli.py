@@ -1,0 +1,6 @@
+"""Under a cli/ path segment: stdout IS the product — exempt wholesale."""
+
+
+def main():
+    print("usage: whatever")
+    return 0

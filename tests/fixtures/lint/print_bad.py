@@ -1,0 +1,22 @@
+"""print-discipline fixtures: bare prints in library code."""
+
+
+def noisy(x):
+    print("step", x)
+    return x + 1
+
+
+def contract(manifest):
+    import json
+
+    print(json.dumps(manifest))  # lint: disable=print-discipline — stdout contract
+    return 0
+
+
+def logged(msg):
+    from tony_tpu.obs import logging as obs_logging
+
+    obs_logging.info(msg)  # the blessed route — not a finding
+
+
+print("module-level banner")

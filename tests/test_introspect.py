@@ -1,0 +1,817 @@
+"""Live-introspection suite: structured logging (sink, bridge, merge,
+follow, free disabled path), the on-demand profile plane (coordinator,
+courier, runtime-armed StepProfiler, AM handlers, typed already-profiling
+error), `tony logs` / `tony top` CLI surfaces, portal scrape-failure
+degradation, and the headline e2e — a live fixture gang profiled, log-tailed
+and `top`ped mid-run with no resubmit.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.obs import introspect as obs_introspect
+from tony_tpu.obs import logging as obs_log
+from tony_tpu.obs import trace as obs_trace
+from tony_tpu.obs.introspect import (
+    AlreadyProfilingError,
+    ProfileCoordinator,
+    ProfileCourier,
+    build_top_rows,
+)
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+@pytest.fixture(autouse=True)
+def _logger_isolation():
+    """Each test starts and ends with no process-global logger installed."""
+    obs_log.shutdown()
+    yield
+    obs_log.shutdown()
+
+
+# ---------------------------------------------------------------- logging
+@pytest.mark.obs
+class TestJsonLogger:
+    def test_records_carry_identity_epoch_and_fields(self, tmp_path):
+        obs_log.init_logging("worker:0", str(tmp_path), epoch=2)
+        obs_log.info("hello", step=7)
+        obs_log.warning("uh oh")
+        obs_log.shutdown()
+        recs = obs_log.read_records(str(tmp_path))
+        assert [r["level"] for r in recs] == ["info", "warning"]
+        assert recs[0]["identity"] == "worker:0"
+        assert recs[0]["epoch"] == 2
+        assert recs[0]["step"] == 7
+        assert recs[0]["msg"] == "hello"
+        assert recs[1]["ts_ms"] >= recs[0]["ts_ms"]
+
+    def test_echo_matches_print_behavior(self, tmp_path, capsys):
+        obs_log.init_logging("am", str(tmp_path))
+        obs_log.info("[tony] to stdout")
+        obs_log.error("[tony] to stderr")
+        out = capsys.readouterr()
+        assert out.out == "[tony] to stdout\n"
+        assert out.err == "[tony] to stderr\n"
+
+    def test_echo_only_fallback_without_logger(self, capsys):
+        assert obs_log.get() is None
+        obs_log.info("still visible")
+        assert capsys.readouterr().out == "still visible\n"
+
+    def test_below_level_builds_nothing(self, tmp_path, monkeypatch, capsys):
+        """The acceptance contract: at the default info level, debug() must
+        allocate no record, write nothing, and echo nothing — mirroring the
+        disabled-tracing zero-allocation assert of PR 3."""
+        obs_log.init_logging("worker:0", str(tmp_path))
+
+        def boom(*a, **kw):
+            raise AssertionError("record built on the sub-level fast path")
+
+        monkeypatch.setattr(obs_log.JsonLogger, "_emit", boom)
+        obs_log.debug("invisible", huge_field="x" * 1000)
+        assert capsys.readouterr().out == ""
+        # same for the no-logger default path
+        obs_log.shutdown()
+        obs_log.debug("also invisible")
+        assert capsys.readouterr().out == ""
+
+    def test_span_correlation_when_tracing(self, tmp_path):
+        obs_log.init_logging("worker:0", str(tmp_path / "logs"))
+        tr = obs_trace.init_tracing("app-x", "worker:0", str(tmp_path / "trace"))
+        try:
+            with tr.span("outer") as sp:
+                obs_log.info("inside the span")
+                span_id = sp.span_id
+        finally:
+            obs_trace.shutdown()
+        rec = obs_log.read_records(str(tmp_path / "logs"))[0]
+        assert rec["span"] == span_id
+
+    def test_reserved_fields_never_shadowed(self, tmp_path):
+        obs_log.init_logging("real-identity", str(tmp_path))
+        obs_log.info("msg", identity="spoof", ts_ms=0)
+        obs_log.shutdown()
+        rec = obs_log.read_records(str(tmp_path))[0]
+        assert rec["identity"] == "real-identity"
+        assert rec["ts_ms"] > 0
+
+    def test_stdlib_bridge_forwards_into_sink(self, tmp_path, capsys):
+        import logging as stdlib_logging
+
+        obs_log.init_logging("am", str(tmp_path))
+        stdlib_logging.getLogger("third.party").warning("from stdlib")
+        obs_log.shutdown()
+        recs = [r for r in obs_log.read_records(str(tmp_path))
+                if r.get("logger") == "third.party"]
+        assert recs and recs[0]["msg"] == "from stdlib"
+        assert "from stdlib" not in capsys.readouterr().out  # bridge never echoes
+
+    def test_read_records_merges_files_in_timestamp_order(self, tmp_path):
+        for ident, ts in [("am", 3.0), ("worker_0", 1.0), ("worker_0_train", 2.0)]:
+            with open(tmp_path / f"{ident}{obs_log.LOG_SUFFIX}", "w") as f:
+                f.write(json.dumps({"ts_ms": ts, "msg": ident, "identity": ident}) + "\n")
+            # torn tail line is tolerated
+            with open(tmp_path / f"{ident}{obs_log.LOG_SUFFIX}", "a") as f:
+                f.write('{"torn": ')
+        recs = obs_log.read_records(str(tmp_path))
+        assert [r["msg"] for r in recs] == ["worker_0", "worker_0_train", "am"]
+
+    def test_follower_is_incremental_and_discovers_new_files(self, tmp_path):
+        follower = obs_log.LogFollower(str(tmp_path))
+        assert follower.poll() == []
+        obs_log.init_logging("am", str(tmp_path))
+        obs_log.info("first")
+        assert [r["msg"] for r in follower.poll()] == ["first"]
+        assert follower.poll() == []
+        obs_log.init_logging("worker:0", str(tmp_path))  # a new file appears
+        obs_log.info("second")
+        assert [r["msg"] for r in follower.poll()] == ["second"]
+
+    def test_format_record(self):
+        line = obs_log.format_record(
+            {"ts_ms": 0.0, "level": "info", "identity": "worker:0",
+             "msg": "hi", "step": 3}
+        )
+        assert "[worker:0]" in line and "INFO" in line and "hi" in line
+        assert "step=3" in line
+
+    def test_echo_threshold_independent_of_sink_level(self, tmp_path, capsys):
+        """tony.log.level governs only the JSONL sink: a level=error job
+        still prints its submit/monitor lines exactly like the print calls
+        the helpers replaced, and a level=debug job does not spam the
+        console with sink-only debug records."""
+        obs_log.init_logging("client", str(tmp_path), level=obs_log.ERROR)
+        obs_log.info("[tony] task worker:0 → RUNNING")
+        assert capsys.readouterr().out == "[tony] task worker:0 → RUNNING\n"
+        assert obs_log.read_records(str(tmp_path)) == []  # below sink level
+        obs_log.init_logging("child", str(tmp_path), level=obs_log.DEBUG)
+        obs_log.debug("sink only")
+        assert capsys.readouterr().out == ""
+        assert [r["msg"] for r in obs_log.read_records(str(tmp_path))] == ["sink only"]
+
+    def test_sink_io_failure_never_raises(self, tmp_path, monkeypatch):
+        lg = obs_log.init_logging("am", str(tmp_path))
+
+        def full_disk(_):
+            raise OSError(28, "No space left on device")
+
+        monkeypatch.setattr(lg._file, "write", full_disk)
+        obs_log.info("must not propagate")  # ENOSPC is swallowed
+
+    def test_resolve_log_dir_honors_frozen_config_override(self, tmp_path):
+        from tony_tpu.config import TonyConfig, keys
+
+        app_dir = tmp_path / "app9"
+        app_dir.mkdir()
+        assert obs_log.resolve_log_dir(str(tmp_path), "app9") == str(app_dir / "logs")
+        cfg = TonyConfig({"tony.worker.instances": "1",
+                          keys.LOG_DIR: "/shared/logs"})
+        cfg.freeze()
+        cfg.write_final(str(app_dir))
+        assert obs_log.resolve_log_dir(str(tmp_path), "app9") == "/shared/logs"
+
+    def test_init_from_env_contract(self, tmp_path):
+        env = {
+            constants.ENV_LOG_DIR: str(tmp_path),
+            constants.ENV_LOG_LEVEL: "warning",
+            constants.ENV_JOB_NAME: "worker",
+            constants.ENV_TASK_INDEX: "1",
+            "TONY_RESTART_ATTEMPT": "3",
+        }
+        lg = obs_log.init_from_env(env)
+        assert lg is not None
+        assert lg.identity == "worker:1:train"
+        assert lg.level == obs_log.WARNING
+        assert lg.epoch == 3
+        # a co-scheduled non-training child labels itself by role — a serve
+        # engine's records must not masquerade as a training process
+        assert obs_log.init_from_env(env, role="serve").identity == "worker:1:serve"
+        assert obs_log.init_from_env({}) is None
+
+    def test_tail_records_bounds_work_per_file(self, tmp_path):
+        """The portal pages read only file tails: a huge aggregate costs a
+        bounded read, and the newest `limit` records still come out merged
+        in timestamp order."""
+        with open(tmp_path / f"big{obs_log.LOG_SUFFIX}", "w") as f:
+            for i in range(2000):
+                f.write(json.dumps({"ts_ms": float(i), "msg": f"b{i}",
+                                    "identity": "big"}) + "\n")
+        with open(tmp_path / f"small{obs_log.LOG_SUFFIX}", "w") as f:
+            f.write(json.dumps({"ts_ms": 1998.5, "msg": "s", "identity": "small"}) + "\n")
+        recs = obs_log.tail_records(str(tmp_path), limit=3)
+        assert [r["msg"] for r in recs] == ["b1998", "s", "b1999"]
+        # a tail seek landing mid-line drops the partial, keeps the rest
+        recs = obs_log.tail_records(str(tmp_path), limit=5,
+                                    max_bytes_per_file=100)
+        assert recs and all(r["msg"] for r in recs)
+
+
+# ------------------------------------------------- coordinator and courier
+@pytest.mark.obs
+class TestProfileCoordinator:
+    def test_lifecycle_and_typed_concurrency_error(self):
+        c = ProfileCoordinator()
+        with pytest.raises(RuntimeError):
+            c.start([], 3, False)  # no tasks → refuse
+        r = c.start(["worker:0", "worker:1"], 3, False)
+        with pytest.raises(AlreadyProfilingError):
+            c.start(["worker:0"], 3, False)
+        assert c.pending_for("worker:0")["req_id"] == r["req_id"]
+        assert c.pending_for("worker:9") is None
+        assert c.report("worker:0", r["req_id"], "captured", dir="/a") == (True, False)
+        assert c.pending_for("worker:0") is None  # terminal → no redelivery
+        assert c.report("worker:1", r["req_id"], "error", error="boom") == (True, True)
+        st = c.status()
+        assert st["complete"]
+        assert st["tasks"]["worker:0"]["status"] == "captured"
+        assert st["tasks"]["worker:1"]["error"] == "boom"
+        # complete → a new request is allowed
+        c.start(["worker:0"], 1, True)
+
+    def test_report_rejects_unknown_request_and_task(self):
+        c = ProfileCoordinator()
+        r = c.start(["worker:0"], 2, False)
+        assert c.report("worker:0", "bogus", "captured") == (False, False)
+        assert c.report("worker:7", r["req_id"], "captured") == (False, False)
+        assert c.report("worker:0", r["req_id"], "weird-status") == (False, False)
+
+    def test_stale_unreported_request_expires_instead_of_wedging(self):
+        """A target whose child never runs a StepProfiler (raw shell
+        command, serve replica) never reports; the single slot must not be
+        bricked for the job's lifetime — the next start past the TTL fails
+        the ghost request and proceeds."""
+        c = ProfileCoordinator(stale_after_s=0.01)
+        r1 = c.start(["worker:0"], 2, False)
+        time.sleep(0.02)
+        r2 = c.start(["worker:0"], 2, False)  # expired → allowed
+        assert r2["req_id"] != r1["req_id"]
+        st = c.status(r2["req_id"])
+        assert st is not None and not st["complete"]
+        # inside the TTL it still refuses, and says when the slot frees up
+        c2 = ProfileCoordinator(stale_after_s=60)
+        c2.start(["w:0"], 1, False)
+        with pytest.raises(AlreadyProfilingError, match="expire"):
+            c2.start(["w:0"], 1, False)
+
+    def test_abort_fails_outstanding_tasks(self):
+        c = ProfileCoordinator()
+        r = c.start(["worker:0", "worker:1"], 2, False)
+        c.report("worker:0", r["req_id"], "captured")
+        c.abort("gang restarted")
+        st = c.status()
+        assert st["complete"]
+        assert st["tasks"]["worker:0"]["status"] == "captured"  # kept
+        assert st["tasks"]["worker:1"]["status"] == "error"
+        assert "gang restarted" in st["tasks"]["worker:1"]["error"]
+        c.start(["worker:0"], 1, False)  # unblocked
+
+
+class _FakeJaxProfiler:
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, d):
+        self.calls.append(("start", d))
+        with open(os.path.join(d, "t.xplane.pb"), "w") as f:
+            f.write("x")
+
+    def stop_trace(self):
+        self.calls.append(("stop", None))
+
+    def save_device_memory_profile(self, path):
+        with open(path, "w") as f:
+            f.write("mem")
+
+
+@pytest.mark.obs
+class TestOnDemandCapturePlane:
+    """Courier ↔ StepProfiler relay over the real control/done files."""
+
+    def _profiler(self, tmp_path, monkeypatch):
+        import jax
+
+        from tony_tpu.train import profiling
+
+        fake = _FakeJaxProfiler()
+        monkeypatch.setattr(jax, "profiler", fake)
+        metrics_path = os.path.join(str(tmp_path), "worker_0.json")
+        p = profiling.StepProfiler(env={
+            constants.ENV_TRAIN_METRICS_FILE: metrics_path,
+            profiling.ENV_PROFILE_POLL_MS: "1",
+        })
+        return p, fake, metrics_path
+
+    def test_full_relay_round_trip(self, tmp_path, monkeypatch):
+        p, fake, metrics_path = self._profiler(tmp_path, monkeypatch)
+        reports = []
+        courier = ProfileCourier(str(tmp_path), "worker", 0,
+                                 lambda **kw: reports.append(kw))
+        courier.handle({"req_id": "r1", "num_steps": 2, "memory": True},
+                       metrics_path)
+        assert reports[0]["status"] == "delivered"
+        time.sleep(0.005)
+        p.step(10)  # arms at this boundary
+        assert p._request is not None
+        p.step(11)
+        p.step(12)  # 10+2 reached → finalize
+        assert p._request is None
+        courier.handle(None, metrics_path)  # sees the done record
+        final = reports[-1]
+        assert final["status"] == "captured"
+        assert final["summary"]["steps_captured"] == 2
+        assert len(final["summary"]["step_times_ms"]) == 2
+        assert not final["summary"].get("truncated")
+        assert "t.xplane.pb" in final["artifacts"]
+        assert "memory.prof" in final["artifacts"]
+        assert os.path.isdir(final["dir"])
+        assert fake.calls[0][0] == "start" and fake.calls[1][0] == "stop"
+        # redelivery of the same req_id is a no-op (idempotent)
+        courier.handle({"req_id": "r1", "num_steps": 2}, metrics_path)
+        assert [r["status"] for r in reports] == ["delivered", "captured"]
+
+    def test_stop_finalizes_truncated_capture(self, tmp_path, monkeypatch):
+        p, fake, metrics_path = self._profiler(tmp_path, monkeypatch)
+        out_dir = os.path.join(str(tmp_path), "prof")
+        obs_introspect.write_json_atomic(
+            metrics_path + obs_introspect.CONTROL_SUFFIX,
+            {"req_id": "r2", "num_steps": 1000, "dir": out_dir},
+        )
+        time.sleep(0.005)
+        p.step(0)
+        p.step(1)
+        assert p._request is not None
+        p.stop()  # training ended inside the window (the loop's finally)
+        assert p._request is None
+        done = obs_introspect.read_json(
+            metrics_path + obs_introspect.DONE_SUFFIX
+        )
+        assert done["ok"] and done["truncated"]
+        assert done["steps_captured"] == 1
+        assert ("stop", None) in fake.calls  # the trace was terminated
+
+    def test_capture_failure_reports_error_not_crash(self, tmp_path, monkeypatch):
+        import jax
+
+        from tony_tpu.train import profiling
+
+        class Exploding:
+            def start_trace(self, d):
+                raise RuntimeError("no backend")
+
+        monkeypatch.setattr(jax, "profiler", Exploding())
+        metrics_path = os.path.join(str(tmp_path), "w.json")
+        p = profiling.StepProfiler(env={
+            constants.ENV_TRAIN_METRICS_FILE: metrics_path,
+            profiling.ENV_PROFILE_POLL_MS: "1",
+        })
+        obs_introspect.write_json_atomic(
+            metrics_path + obs_introspect.CONTROL_SUFFIX,
+            {"req_id": "r3", "num_steps": 2},
+        )
+        time.sleep(0.005)
+        p.step(0)  # must not raise
+        done = obs_introspect.read_json(metrics_path + obs_introspect.DONE_SUFFIX)
+        assert done["req_id"] == "r3" and not done["ok"]
+        assert "no backend" in done["error"]
+
+    def test_unarmed_hot_path_does_no_control_io(self, monkeypatch):
+        """Profiling not armed (no tony container): step() touches no files
+        and allocates no capture state — the acceptance's free-path clause."""
+        from tony_tpu.train import profiling
+
+        def boom(*a, **kw):
+            raise AssertionError("control-file I/O on the unarmed fast path")
+
+        monkeypatch.setattr(obs_introspect, "read_json", boom)
+        p = profiling.StepProfiler(env={})
+        for step in range(100):
+            p.step(step)
+        assert p._request is None and not p.active
+
+    def test_poll_is_time_throttled(self, tmp_path, monkeypatch):
+        from tony_tpu.train import profiling
+
+        calls = []
+        monkeypatch.setattr(obs_introspect, "read_json",
+                            lambda path: calls.append(path))
+        metrics_path = os.path.join(str(tmp_path), "w.json")
+        p = profiling.StepProfiler(env={
+            constants.ENV_TRAIN_METRICS_FILE: metrics_path,
+            profiling.ENV_PROFILE_POLL_MS: "60000",
+        })
+        for step in range(50):
+            p.step(step)
+        assert len(calls) == 1  # one stat per poll window, not per step
+
+
+# --------------------------------------------------------- AM RPC handlers
+@pytest.mark.obs
+class TestAmProfileHandlers:
+    def _am(self, tmp_path):
+        from tony_tpu.cluster.appmaster import ApplicationMaster
+        from tony_tpu.config import TonyConfig
+
+        cfg = TonyConfig({"tony.worker.instances": "2"})
+        cfg.freeze()
+        staging = tmp_path / "stage"
+        staging.mkdir()
+        return ApplicationMaster(cfg, "app_prof", str(staging))
+
+    def test_handlers_and_heartbeat_piggyback(self, tmp_path):
+        from tony_tpu.cluster.session import TaskStatus
+
+        am = self._am(tmp_path)
+        try:
+            with pytest.raises(RuntimeError):
+                am.start_profile()  # nothing RUNNING yet
+            for t in am.session.all_tasks():
+                t.status = TaskStatus.RUNNING
+            r = am.start_profile(steps=4)
+            assert sorted(r["tasks"]) == ["worker:0", "worker:1"]
+            hb = am.task_executor_heartbeat("worker", 0)
+            assert hb["profile"] == {"req_id": r["req_id"], "num_steps": 4,
+                                     "memory": False}
+            with pytest.raises(AlreadyProfilingError):
+                am.start_profile()
+            # stale-epoch reports are fenced like every executor RPC
+            stale = am.report_profile_status(
+                "worker", 0, r["req_id"], "captured", attempt=7)
+            assert stale == {"ack": False, "stale": True}
+            am.report_profile_status("worker", 0, r["req_id"], "captured",
+                                     dir="/d", artifacts=["a.pb"])
+            am.report_profile_status("worker", 1, r["req_id"], "captured")
+            st = am.get_profile_status()["profile"]
+            assert st["complete"]
+            assert "profile" not in am.task_executor_heartbeat("worker", 0)
+            am.start_profile(steps=1)  # slot free again
+        finally:
+            am.events.stop()
+            am.rm.shutdown()
+
+    def test_gang_restart_aborts_inflight_capture(self, tmp_path):
+        from tony_tpu.cluster.session import TaskStatus
+
+        am = self._am(tmp_path)
+        try:
+            for t in am.session.all_tasks():
+                t.status = TaskStatus.RUNNING
+            r = am.start_profile(steps=2)
+            am._restart_gang_spanned("test restart", None)
+            st = am.get_profile_status(r["req_id"])["profile"]
+            assert st["complete"]
+            assert all(e["status"] == "error" for e in st["tasks"].values())
+        finally:
+            am.events.stop()
+            am.rm.shutdown()
+
+
+# --------------------------------------------------------------- tony top
+@pytest.mark.obs
+class TestTopSynthesis:
+    def test_rows_from_infos_and_obs_snapshots(self):
+        infos = [{
+            "name": "worker", "index": 0, "status": "RUNNING",
+            "last_heartbeat_ms": 1_000_000.0,
+            "metrics": {"train": {"step": 40, "loss": 2.5,
+                                  "tokens_per_sec": 1234.5, "mfu": 0.41}},
+        }]
+        obs = {"worker:0": [
+            {"name": "tony_train_step_seconds", "type": "histogram",
+             "samples": [{"labels": {}, "counts": [5, 0], "sum": 2.5, "count": 5}]},
+            {"name": "tony_serve_queue_depth", "type": "gauge",
+             "samples": [{"labels": {}, "value": 3.0}]},
+            {"name": "tony_serve_ttft_seconds", "type": "histogram",
+             "samples": [{"labels": {}, "counts": [4], "sum": 0.8, "count": 4}]},
+        ]}
+        rows = build_top_rows(infos, obs, now_ms=1_000_500.0)
+        r = rows[0]
+        assert r["task"] == "worker:0" and r["state"] == "RUNNING"
+        assert r["step"] == 40 and r["tokens_per_s"] == 1234.5
+        assert r["steps_per_s"] == pytest.approx(2.0)  # 5 samples / 2.5s
+        assert r["queue_depth"] == 3.0
+        assert r["ttft_s"] == pytest.approx(0.2)
+        assert r["hb_age_s"] == pytest.approx(0.5)
+
+    def test_step_rate_is_live_between_frames(self):
+        """With the previous frame's stats the rate is the snapshot delta —
+        a job that slows down shows the slowdown instead of its lifetime
+        average — and a stalled job reads 0."""
+        from tony_tpu.obs.introspect import step_stats_by_task
+
+        def snap(count, total):
+            return {"worker:0": [
+                {"name": "tony_train_step_seconds", "type": "histogram",
+                 "samples": [{"labels": {}, "count": count, "sum": total}]},
+            ]}
+
+        infos = [{"name": "worker", "index": 0, "status": "RUNNING",
+                  "metrics": {"train": {"step": 1}}}]
+        # an hour at 5 step/s, then 2 more frames' steps at 0.5 step/s
+        prev = step_stats_by_task(infos, snap(18000, 3600.0))
+        rows = build_top_rows(infos, snap(18002, 3604.0), prev_step_stats=prev)
+        assert rows[0]["steps_per_s"] == pytest.approx(0.5)
+        # no new observations since the last frame → live rate 0, not avg
+        prev = step_stats_by_task(infos, snap(18002, 3604.0))
+        rows = build_top_rows(infos, snap(18002, 3604.0), prev_step_stats=prev)
+        assert rows[0]["steps_per_s"] == 0.0
+        # child restarted (histogram reset): fall back to lifetime average
+        rows = build_top_rows(infos, snap(10, 5.0), prev_step_stats=prev)
+        assert rows[0]["steps_per_s"] == pytest.approx(2.0)
+
+    def test_render_contains_live_columns(self):
+        from tony_tpu.cli.introspect import render_top
+
+        rows = build_top_rows(
+            [{"name": "worker", "index": 0, "status": "RUNNING",
+              "metrics": {"train": {"step": 7}}}], {}
+        )
+        frame = render_top({"app_id": "app_x", "state": "RUNNING",
+                            "restart_attempt": 1}, rows)
+        assert "app_x" in frame and "attempt 1" in frame
+        assert "STEP/S" in frame and "HB AGE" in frame
+        assert re.search(r"worker:0\s+RUNNING\s+7", frame)
+
+
+# ----------------------------------------------------------- tony profile
+@pytest.mark.obs
+class TestProfileCli:
+    def test_finalized_job_exits_promptly_not_full_timeout(self, tmp_path, capsys):
+        """A job that finalizes mid-capture must not make `tony profile`
+        spin out its whole --timeout retrying a dead AM: the poll loop
+        consults am_status.json exactly like `tony logs` / `tony top`."""
+        from tony_tpu.cli.introspect import main_profile
+        from tony_tpu.cluster.rpc import RpcServer
+
+        app_dir = tmp_path / "app1"
+        app_dir.mkdir()
+        srv = RpcServer()
+        srv.register("start_profile", lambda steps=None, memory=False: {
+            "req_id": "r1", "num_steps": 2, "tasks": ["worker:0"]})
+        srv.start()
+        host, port = srv.address
+        (app_dir / "am_info.json").write_text(json.dumps(
+            {"host": host, "port": port, "secret": ""}))
+        (app_dir / "am_status.json").write_text(json.dumps({"status": "SUCCEEDED"}))
+        srv_stopper = threading.Timer(0.2, srv.stop)  # AM dies after accepting
+        srv_stopper.start()
+        t0 = time.monotonic()
+        rc = main_profile(["app1", "--staging", str(tmp_path), "--timeout", "30"])
+        srv_stopper.join()
+        assert rc == 1
+        assert time.monotonic() - t0 < 10, "spun toward --timeout instead"
+        assert "finalized" in capsys.readouterr().err
+
+
+# -------------------------------------------------------------- tony logs
+@pytest.mark.obs
+class TestLogsCli:
+    def _write_logs(self, log_dir):
+        os.makedirs(log_dir, exist_ok=True)
+        rows = [
+            ("am", 1.0, "info", "gang complete"),
+            ("worker_0", 2.0, "info", "child launched"),
+            ("worker_0_train", 3.0, "debug", "step 1"),
+            ("worker_0_train", 4.0, "error", "loss went NaN"),
+            ("worker_1", 5.0, "info", "child launched"),
+        ]
+        for ident, ts, level, msg in rows:
+            with open(os.path.join(log_dir, ident + obs_log.LOG_SUFFIX), "a") as f:
+                identity = ident.replace("_0", ":0").replace("_1", ":1").replace(":0_train", ":0:train")
+                f.write(json.dumps({"ts_ms": ts, "level": level,
+                                    "identity": identity, "msg": msg}) + "\n")
+
+    def test_merge_order_and_filters(self, tmp_path, capsys):
+        from tony_tpu.cli.introspect import main_logs
+
+        log_dir = os.path.join(str(tmp_path), "app1", "logs")
+        self._write_logs(log_dir)
+        assert main_logs(["app1", "--staging", str(tmp_path)]) == 0
+        out = capsys.readouterr().out.splitlines()
+        msgs = [line.split(None, 3)[-1] for line in out]
+        assert msgs == ["gang complete", "child launched", "step 1",
+                        "loss went NaN", "child launched"]  # ts order
+        # --task matches the executor AND its training child
+        assert main_logs(["app1", "--staging", str(tmp_path),
+                          "--task", "worker:0"]) == 0
+        out = capsys.readouterr().out
+        assert "gang complete" not in out and "step 1" in out
+        # --grep and --level
+        assert main_logs(["app1", "--staging", str(tmp_path),
+                          "--grep", "NaN"]) == 0
+        assert "loss went NaN" in capsys.readouterr().out
+        assert main_logs(["app1", "--staging", str(tmp_path),
+                          "--level", "error"]) == 0
+        out = capsys.readouterr().out
+        assert "loss went NaN" in out and "gang complete" not in out
+
+    def test_no_records_is_an_error(self, tmp_path, capsys):
+        from tony_tpu.cli.introspect import main_logs
+
+        assert main_logs(["ghost", "--staging", str(tmp_path)]) == 1
+        # -f on a nonexistent app must error out, not spin forever waiting
+        # for an am_status.json that can never appear
+        assert main_logs(["ghost", "--staging", str(tmp_path), "-f"]) == 1
+
+    def test_follow_exits_when_job_finalizes(self, tmp_path, capsys):
+        from tony_tpu.cli.introspect import main_logs
+
+        app_dir = os.path.join(str(tmp_path), "app2")
+        self._write_logs(os.path.join(app_dir, "logs"))
+        with open(os.path.join(app_dir, "am_status.json"), "w") as f:
+            json.dump({"status": "SUCCEEDED"}, f)
+        t0 = time.monotonic()
+        rc = main_logs(["app2", "--staging", str(tmp_path), "-f"])
+        assert rc == 0
+        assert time.monotonic() - t0 < 10
+        assert "loss went NaN" in capsys.readouterr().out
+        # documented contract: -f exits 0 when the job finalizes, even when
+        # no record passed the filters (an over-narrow --grep is not a
+        # job failure)
+        rc = main_logs(["app2", "--staging", str(tmp_path), "-f",
+                        "--grep", "no-such-pattern-anywhere"])
+        assert rc == 0
+        assert capsys.readouterr().out == ""
+
+
+# ----------------------------------------------------- portal degradation
+@pytest.mark.obs
+class TestPortalScrapeDegradation:
+    def test_dead_am_is_skipped_and_counted(self, tmp_path):
+        from tony_tpu.portal.server import PortalHandler, _SCRAPE_FAILURES
+
+        history = tmp_path / "history" / constants.HISTORY_INTERMEDIATE_DIR
+        history.mkdir(parents=True)
+        (history / ("app_dead" + constants.HISTORY_SUFFIX)).write_text("")
+        staging = tmp_path / "app_dead"
+        staging.mkdir()
+        # am_info.json pointing at a port nothing listens on
+        (staging / constants.AM_INFO_FILE).write_text(json.dumps(
+            {"host": "127.0.0.1", "port": 1, "secret": "s"}
+        ))
+        handler = PortalHandler.__new__(PortalHandler)  # no socket plumbing
+        handler.history_root = str(tmp_path / "history")
+        handler.staging_root = str(tmp_path)
+        before = _SCRAPE_FAILURES.value(app="app_dead")
+        text = handler._metrics_text()
+        assert _SCRAPE_FAILURES.value(app="app_dead") == before + 1
+        # the exposition survived AND carries the failure counter
+        assert 'tony_portal_scrape_failures_total{app="app_dead"}' in text
+
+
+FAST = {
+    "tony.am.monitor-interval-ms": "50",
+    "tony.task.heartbeat-interval-ms": "100",
+    "tony.task.metrics-interval-ms": "200",
+    "tony.am.gang-timeout-ms": "60000",
+    "tony.profile.poll-interval-ms": "50",
+}
+
+
+# ------------------------------------------------------------ headline e2e
+@pytest.mark.obs
+@pytest.mark.e2e
+class TestLiveIntrospectionEndToEnd:
+    """The acceptance path: a running fixture gang is profiled on demand
+    (per-task confirmations + artifacts, no resubmit), its merged logs are
+    streamed with `tony logs -f` (AM + executor + training child, timestamp
+    order), and `tony top` renders a live snapshot with a step rate — while
+    a second concurrent start_profile gets the typed error."""
+
+    def test_profile_logs_top_against_live_gang(self, tmp_tony_root, capsys):
+        from tony_tpu.cli.introspect import main_profile, main_top
+        from tony_tpu.cli.trace import load_spans
+        from tony_tpu.cluster.client import Client
+        from tony_tpu.cluster.rpc import RpcError
+        from tony_tpu.cluster.session import JobStatus
+        from tony_tpu.config import TonyConfig, keys
+
+        cfg = TonyConfig({
+            **FAST,
+            keys.STAGING_ROOT: str(tmp_tony_root),
+            "tony.worker.instances": "2",
+            keys.EXECUTES:
+                f"{sys.executable} {os.path.join(FIXTURES, 'introspect_child.py')}",
+            keys.TRACE_ENABLED: "true",
+        })
+        client = Client(cfg)
+        handle = client.submit()
+        logs_proc = None
+        try:
+            rpc = handle.rpc(timeout_s=30)
+            assert rpc is not None, "AM never advertised"
+            deadline = time.time() + 45
+            while time.time() < deadline:
+                infos = rpc.call("get_task_infos")
+                if infos and all(
+                    t["status"] == "RUNNING" and (t.get("metrics") or {}).get("train")
+                    for t in infos
+                ):
+                    break
+                time.sleep(0.2)
+            else:
+                pytest.fail(f"gang never went live: {rpc.call('get_task_infos')}")
+
+            # -------- tony profile mid-run (blocks until both report) ----
+            result: dict = {}
+            th = threading.Thread(target=lambda: result.update(rc=main_profile(
+                [handle.app_id, "--steps", "3", "--staging", str(tmp_tony_root),
+                 "--timeout", "60"]
+            )))
+            th.start()
+            # a second start_profile while the first is in flight → typed error
+            while time.time() < deadline:
+                if rpc.call("get_profile_status")["profile"] is not None:
+                    break
+                time.sleep(0.05)
+            with pytest.raises(RpcError, match="AlreadyProfilingError"):
+                rpc.call("start_profile", steps=3)
+            th.join(90)
+            assert not th.is_alive(), "tony profile never returned"
+            assert result.get("rc") == 0, "tony profile reported failure"
+
+            status = rpc.call("get_profile_status")["profile"]
+            assert status["complete"]
+            assert sorted(status["tasks"]) == ["worker:0", "worker:1"]
+            for tid, entry in status["tasks"].items():
+                assert entry["status"] == "captured", (tid, entry)
+                assert entry["artifacts"], f"{tid} captured no artifacts"
+                assert entry["summary"]["steps_captured"] >= 3
+                # artifacts really exist under <staging>/profile/<identity>/
+                for rel in entry["artifacts"]:
+                    assert os.path.exists(os.path.join(entry["dir"], rel))
+                assert entry["dir"].startswith(
+                    os.path.join(handle.staging_dir, "profile")
+                )
+            profile_out = capsys.readouterr().out
+            assert "captured" in profile_out
+            assert "mean" in profile_out  # step-time summary printed
+
+            # -------- tony top: live snapshot with a step rate -----------
+            assert main_top([handle.app_id, "--staging", str(tmp_tony_root),
+                             "--once"]) == 0
+            frame = capsys.readouterr().out
+            assert re.search(r"worker:0\s+RUNNING", frame)
+            assert re.search(r"worker:1\s+RUNNING", frame)
+            # live step rate from the piggybacked step-time histogram
+            m = re.search(r"worker:0\s+RUNNING\s+\d+\s+\S+\s+\S+\s+(\d+\.\d+)", frame)
+            assert m, f"no step rate in frame:\n{frame}"
+            assert float(m.group(1)) > 0
+
+            # -------- tony logs -f: stream during the run ----------------
+            repo_root = os.path.dirname(os.path.dirname(FIXTURES))
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+            logs_proc = subprocess.Popen(
+                [sys.executable, "-m", "tony_tpu.cli.main", "logs",
+                 handle.app_id, "-f", "--staging", str(tmp_tony_root)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            )
+            time.sleep(1.0)  # let the follower stream a first batch mid-run
+
+            # -------- wind the job down ----------------------------------
+            with open(os.path.join(handle.staging_dir, "stop"), "w"):
+                pass
+            final = client.monitor_application(handle, quiet=True)
+            assert final == JobStatus.SUCCEEDED, handle.final_status()
+
+            out, _ = logs_proc.communicate(timeout=60)
+            text = out.decode()
+            assert "[am]" in text                  # AM records
+            assert "[worker:0]" in text            # executor records
+            assert "[worker:0:train]" in text      # training-child records
+            assert "[worker:1:train]" in text
+
+            # merged (non-follow) view is strictly timestamp-ordered across
+            # AM + executors + children
+            records = obs_log.read_records(
+                os.path.join(handle.staging_dir, "logs"))
+            idents = {r["identity"] for r in records}
+            assert {"am", "worker:0", "worker:0:train", "worker:1:train"} <= idents
+            ts = [r["ts_ms"] for r in records]
+            assert ts == sorted(ts)
+
+            # -------- capture spans visible in tony trace ----------------
+            spans = load_spans(os.path.join(handle.staging_dir, "trace"))
+            captures = [s for s in spans if s["name"] == "profile.capture"]
+            assert {s["identity"] for s in captures} == {
+                "worker:0:train", "worker:1:train"
+            }
+            assert all(s["end_ms"] >= s["start_ms"] for s in captures)
+        finally:
+            if logs_proc is not None and logs_proc.poll() is None:
+                logs_proc.kill()
+            try:
+                with open(os.path.join(handle.staging_dir, "stop"), "w"):
+                    pass
+            except OSError:
+                pass
+            obs_trace.shutdown()  # the in-process client installed a tracer

@@ -127,6 +127,35 @@ def test_mesh_axes_real_registry_covers_canonical_axes():
     assert checker.declared == {"data", "fsdp", "model", "context", "expert", "stage"}
 
 
+# --------------------------------------------------------- print-discipline
+def test_print_discipline_true_positives():
+    findings = run_lint("print_bad.py", checks={"print-discipline"})
+    # the inline-suppressed stdout contract (line 12) and the obs_logging
+    # route are absent; the bare calls are flagged
+    assert lines_of(findings, "print-discipline") == [5, 22]
+    assert "tony_tpu.obs.logging" in findings[0].message
+
+
+def test_print_discipline_exempts_cli_paths():
+    findings = run_lint(
+        os.path.join("cli", "print_in_cli.py"), checks={"print-discipline"}
+    )
+    assert findings == []
+
+
+def test_print_discipline_library_is_clean():
+    """The ratchet this checker enforces: every bare print left in tony_tpu/
+    (outside cli/) is either converted to obs_logging or carries an inline
+    justification — also covered by tests/test_lint_clean.py over the whole
+    package."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    analyzer = Analyzer(
+        [c for c in all_checkers() if c.name == "print-discipline"], root=repo
+    )
+    findings = analyzer.run([os.path.join(repo, "tony_tpu")])
+    assert findings == [], [f"{f.path}:{f.line}" for f in findings]
+
+
 # -------------------------------------------------------------- CLI contract
 def test_cli_exit_0_clean_json(tmp_path, capsys):
     clean = tmp_path / "clean.py"
@@ -191,7 +220,7 @@ def test_cli_registered_in_tony_main(capsys):
     assert rc == 0
     for name in (
         "config-keys", "jit-purity", "donation-safety",
-        "lock-discipline", "mesh-axes",
+        "lock-discipline", "mesh-axes", "print-discipline",
     ):
         assert name in out
 

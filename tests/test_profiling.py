@@ -56,6 +56,37 @@ class TestStepProfiler:
         p.stop()  # idempotent
         assert fake.calls.count(("stop", None)) == 1
 
+    def test_short_run_artifact_is_terminated_and_readable(self, tmp_path):
+        """Training that finishes before start_step + num_steps used to leave
+        the trace unterminated; the train-loop `finally` now stops it — with
+        the REAL jax profiler, the capture directory must hold a complete,
+        readable artifact after stop()."""
+        import gzip
+
+        import jax.numpy as jnp
+
+        trace_dir = tmp_path / "trace"
+        p = StepProfiler(env={
+            profiling.ENV_PROFILE_DIR: str(trace_dir),
+            profiling.ENV_PROFILE_START_STEP: "0",
+            profiling.ENV_PROFILE_NUM_STEPS: "1000",  # run ends long before
+        })
+        p.step(0)
+        assert p.active
+        (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+        p.stop()  # what the loop's finally does
+        assert p.done and not p.active
+        artifacts = [
+            os.path.join(root, f)
+            for root, _, files in os.walk(trace_dir)
+            for f in files
+        ]
+        xplanes = [a for a in artifacts if a.endswith(".xplane.pb")]
+        assert xplanes and os.path.getsize(xplanes[0]) > 0, artifacts
+        for gz in (a for a in artifacts if a.endswith(".trace.json.gz")):
+            with gzip.open(gz) as f:  # terminated, not torn: gzip readable
+                assert f.read(16)
+
 
 class TestExecutorEnvWiring:
     def test_profile_env_injected(self, monkeypatch, tmp_path):
@@ -82,3 +113,52 @@ class TestExecutorEnvWiring:
         child_env = ex.build_child_env({"worker": ["h:1"]}, {})
         assert child_env[profiling.ENV_PROFILE_DIR].endswith(os.path.join("profile", "worker_0"))
         assert child_env[profiling.ENV_PROFILE_START_STEP] == "7"
+
+    def test_introspection_env_injected(self, tmp_path):
+        """The on-demand + logging contracts ride the same env channel: the
+        control-file poll throttle and the structured-log sink/level."""
+        from tony_tpu.cluster.executor import TaskExecutor
+
+        staging = tmp_path / "stage"
+        staging.mkdir()
+        cfg = TonyConfig({
+            "tony.worker.instances": "1",
+            keys.PROFILE_POLL_INTERVAL_MS: "250",
+            keys.LOG_LEVEL: "debug",
+        })
+        cfg.freeze()
+        cfg.write_final(str(staging))
+        env = {
+            constants.ENV_APP_ID: "app",
+            constants.ENV_STAGING_DIR: str(staging),
+            constants.ENV_JOB_NAME: "worker",
+            constants.ENV_TASK_INDEX: "0",
+            constants.ENV_AM_PORT: "1",
+        }
+        ex = TaskExecutor(env=env)
+        child_env = ex.build_child_env({"worker": ["h:1"]}, {})
+        assert child_env[profiling.ENV_PROFILE_POLL_MS] == "250"
+        assert child_env[constants.ENV_LOG_DIR] == os.path.join(str(staging), "logs")
+        assert child_env[constants.ENV_LOG_LEVEL] == "debug"
+
+    def test_log_level_off_skips_child_contract(self, tmp_path):
+        from tony_tpu.cluster.executor import TaskExecutor
+
+        staging = tmp_path / "stage"
+        staging.mkdir()
+        cfg = TonyConfig({
+            "tony.worker.instances": "1",
+            keys.LOG_LEVEL: "off",
+        })
+        cfg.freeze()
+        cfg.write_final(str(staging))
+        env = {
+            constants.ENV_APP_ID: "app",
+            constants.ENV_STAGING_DIR: str(staging),
+            constants.ENV_JOB_NAME: "worker",
+            constants.ENV_TASK_INDEX: "0",
+            constants.ENV_AM_PORT: "1",
+        }
+        ex = TaskExecutor(env=env)
+        child_env = ex.build_child_env({"worker": ["h:1"]}, {})
+        assert constants.ENV_LOG_DIR not in child_env

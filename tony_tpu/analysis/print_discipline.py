@@ -1,0 +1,52 @@
+"""print-discipline: library code routes output through the structured logger.
+
+With the aggregated-logging plane in place (obs/logging.py, ``tony logs``),
+a bare ``print()`` in library code is a record that never reaches the
+job-wide ``<staging>/logs`` aggregate — invisible to ``tony logs``, missing
+the identity/epoch/span correlation, and un-filterable by level. The
+``tony_tpu.obs.logging`` helpers echo to the console exactly like the print
+they replace, so there is no console-UX reason to keep the bare call.
+
+Exempt by path: ``cli/`` (interactive front ends where stdout IS the
+product), tests and fixtures, ``examples/``, and docs. Deliberate stdout
+contracts in library code (e.g. a command whose output is machine-parsed
+JSON) carry an inline ``# lint: disable=print-discipline — <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from tony_tpu.analysis.analyzer import Checker, Finding, Module
+
+#: any path segment here exempts the whole file
+EXEMPT_PARTS = frozenset({"cli", "tests", "fixtures", "examples", "docs"})
+
+
+class PrintDisciplineChecker(Checker):
+    name = "print-discipline"
+    description = (
+        "library code emits output via tony_tpu.obs.logging (aggregated, "
+        "correlated, leveled), not bare print()"
+    )
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        parts = set(os.path.normpath(module.path).split(os.sep))
+        if parts & EXEMPT_PARTS:
+            return
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                yield self.finding(
+                    module, node,
+                    "bare print() in library code — use tony_tpu.obs.logging "
+                    "(info/warning/error echo to the console AND land in the "
+                    "<staging>/logs aggregate `tony logs` merges); a "
+                    "deliberate stdout contract takes an inline "
+                    "`# lint: disable=print-discipline — <why>`",
+                )

@@ -1,0 +1,340 @@
+"""Live-introspection subcommands: ``tony profile`` / ``tony logs`` / ``tony top``.
+
+All three target a job by application id and staging root (``$TONY_ROOT`` by
+default), the same resolution ``tony trace`` uses:
+
+- ``tony profile <app_id> [--steps N] [--memory]`` — arm an on-demand
+  ``jax.profiler`` capture on every live tracked task of a RUNNING job (no
+  resubmit), block until each gang member reports, then print the artifact
+  paths and a step-time summary (obs/introspect.py is the plumbing).
+- ``tony logs <app_id> [-f] [--task job:idx] [--grep PAT]`` — merge the
+  per-process structured-log JSONL files under ``<staging>/<app_id>/logs``
+  into one timestamp-ordered stream; ``-f`` tails until the job finalizes.
+- ``tony top <app_id>`` — a refreshing status table synthesized from the
+  AM's ``get_task_infos`` + ``get_metrics`` (per-task state, step, loss,
+  live step rate from the piggybacked step-time histogram, serve queue
+  depth / TTFT, heartbeat age).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+from typing import Any
+
+from tony_tpu import constants
+from tony_tpu.obs import introspect as obs_introspect
+from tony_tpu.obs import logging as obs_logging
+
+
+def _pipe_closed() -> int:
+    """Downstream reader went away (`tony logs ... | head`): that is a
+    normal way to consume a stream, not an error. Point stdout at devnull so
+    the interpreter's exit-time flush doesn't raise a second time."""
+    try:
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+    except OSError:
+        pass
+    return 0
+
+
+def _am_rpc(staging: str, app_id: str):
+    """RpcClient for the job's AM from its am_info.json advertisement, or
+    None (job finished / never started)."""
+    info_path = os.path.join(staging, app_id, constants.AM_INFO_FILE)
+    try:
+        with open(info_path) as f:
+            info = json.load(f)
+        from tony_tpu.cluster.rpc import RpcClient
+
+        return RpcClient(info["host"], info["port"], secret=info.get("secret", ""),
+                         timeout_s=5.0)
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def _final_status(staging: str, app_id: str) -> dict[str, Any] | None:
+    try:
+        with open(os.path.join(staging, app_id, "am_status.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ----------------------------------------------------------- tony profile
+def _fmt_step_times(summary: dict[str, Any] | None) -> str:
+    times = (summary or {}).get("step_times_ms") or []
+    if not times:
+        return ""
+    mean = sum(times) / len(times)
+    return (f"{len(times)} step(s): mean {mean:.1f}ms, "
+            f"min {min(times):.1f}ms, max {max(times):.1f}ms"
+            + (" (truncated)" if (summary or {}).get("truncated") else ""))
+
+
+def main_profile(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tony profile",
+        description="capture a jax.profiler trace on a RUNNING job's workers "
+                    "at the next step boundary — no resubmit "
+                    "(docs/observability.md)",
+    )
+    p.add_argument("app_id", help="application id (staging dir name)")
+    p.add_argument("--steps", type=int, default=None,
+                   help="steps to capture (default: the job's tony.profile.steps)")
+    p.add_argument("--memory", action="store_true",
+                   help="also save a device memory profile per worker")
+    p.add_argument("--staging", default=None,
+                   help="staging root holding <app_id>/ (default: $TONY_ROOT)")
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="seconds to wait for every gang member to report")
+    args = p.parse_args(argv)
+
+    staging = args.staging or constants.default_tony_root()
+    cli = _am_rpc(staging, args.app_id)
+    if cli is None:
+        print(f"no running AM for {args.app_id} under {staging} — "
+              "is the job still running?", file=sys.stderr)
+        return 1
+    from tony_tpu.cluster.rpc import RpcError
+
+    try:
+        resp = cli.call("start_profile", steps=args.steps, memory=args.memory)
+    except RpcError as e:
+        if "AlreadyProfilingError" in str(e):
+            print(f"tony profile: {e}", file=sys.stderr)
+            return 2
+        print(f"tony profile: start_profile failed: {e}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"tony profile: cannot reach the AM: {e}", file=sys.stderr)
+        return 1
+
+    req_id = resp["req_id"]
+    print(f"[tony-profile] capture {req_id}: {resp['num_steps']} step(s) on "
+          f"{len(resp['tasks'])} task(s) — waiting for reports")
+    deadline = time.time() + args.timeout
+    status: dict[str, Any] | None = None
+    while time.time() < deadline:
+        try:
+            status = cli.call("get_profile_status", req_id=req_id).get("profile")
+        except (RpcError, OSError):
+            status = None  # AM may be mid-restart; keep trying until deadline
+            final = _final_status(staging, args.app_id)
+            if final is not None:
+                cli.close()
+                print(f"tony profile: job finalized "
+                      f"({final.get('status', '?')}) before capture {req_id} "
+                      "completed — nothing to report", file=sys.stderr)
+                return 1
+        if status and status.get("complete"):
+            break
+        time.sleep(0.3)
+    cli.close()
+
+    if not status:
+        print(f"tony profile: no status for capture {req_id} "
+              f"(AM unreachable past --timeout)", file=sys.stderr)
+        return 1
+    ok = True
+    for tid, entry in sorted((status.get("tasks") or {}).items()):
+        st = entry.get("status")
+        if st == obs_introspect.CAPTURED:
+            print(f"  {tid:<16s} captured  {entry.get('dir', '')}")
+            summary = _fmt_step_times(entry.get("summary"))
+            if summary:
+                print(f"  {'':<16s}           {summary}")
+            for a in entry.get("artifacts") or []:
+                print(f"  {'':<16s}           - {a}")
+        else:
+            ok = False
+            print(f"  {tid:<16s} {st or '?'}"
+                  + (f"  {entry.get('error')}" if entry.get("error") else ""))
+    if not status.get("complete"):
+        print(f"tony profile: timed out after {args.timeout:.0f}s with "
+              "task(s) still pending", file=sys.stderr)
+        return 1
+    return 0 if ok else 1
+
+
+# -------------------------------------------------------------- tony logs
+def _record_filter(args) -> "callable":
+    pattern = re.compile(args.grep) if args.grep else None
+    min_level = obs_logging.level_from_name(args.level, obs_logging.DEBUG)
+
+    def keep(rec: dict[str, Any]) -> bool:
+        if args.task:
+            ident = str(rec.get("identity", ""))
+            if ident != args.task and not ident.startswith(args.task + ":"):
+                return False
+        if obs_logging.level_from_name(str(rec.get("level"))) < min_level:
+            return False
+        if pattern is not None and not pattern.search(str(rec.get("msg", ""))):
+            return False
+        return True
+
+    return keep
+
+
+def main_logs(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tony logs",
+        description="merge a job's per-process structured logs into one "
+                    "timestamp-ordered stream (docs/observability.md)",
+    )
+    p.add_argument("app_id", help="application id (staging dir name)")
+    p.add_argument("-f", "--follow", action="store_true",
+                   help="keep tailing; exits when the job finalizes")
+    p.add_argument("--task", default="",
+                   help="only this task's processes, e.g. worker:0 "
+                        "(matches the executor and its training child)")
+    p.add_argument("--grep", default="", help="regex filter on the message")
+    p.add_argument("--level", default="",
+                   help="minimum level (debug|info|warning|error)")
+    p.add_argument("--staging", default=None,
+                   help="staging root holding <app_id>/logs (default: $TONY_ROOT)")
+    args = p.parse_args(argv)
+
+    staging = args.staging or constants.default_tony_root()
+    log_dir = obs_logging.resolve_log_dir(staging, args.app_id)
+    keep = _record_filter(args)
+    if args.follow and not os.path.isdir(os.path.join(staging, args.app_id)):
+        # -f on a typo'd app id would otherwise spin forever waiting for an
+        # am_status.json that can never appear
+        print(f"no application {args.app_id} under {staging}", file=sys.stderr)
+        return 1
+
+    if not args.follow:
+        records = [r for r in obs_logging.read_records(log_dir) if keep(r)]
+        if not records:
+            print(f"no structured log records under {log_dir}", file=sys.stderr)
+            return 1
+        try:
+            for line in obs_logging.iter_formatted(records):
+                print(line)
+        except BrokenPipeError:
+            return _pipe_closed()
+        return 0
+
+    follower = obs_logging.LogFollower(log_dir)
+    quiet_since: float | None = None
+    while True:
+        batch = [r for r in follower.poll() if keep(r)]
+        try:
+            for line in obs_logging.iter_formatted(batch):
+                print(line, flush=True)
+        except BrokenPipeError:
+            return _pipe_closed()
+        if batch:
+            quiet_since = None
+        elif _final_status(staging, args.app_id) is not None:
+            # job finalized: drain whatever lands for a grace window, then
+            # stop. Exits 0 even when nothing passed the filters — the
+            # documented contract is "-f exits 0 when the job finalizes",
+            # and an over-narrow --grep is not a job failure
+            now = time.monotonic()
+            if quiet_since is None:
+                quiet_since = now
+            elif now - quiet_since > 1.0:
+                return 0
+        try:
+            time.sleep(0.25)
+        except KeyboardInterrupt:
+            return 0
+
+
+# --------------------------------------------------------------- tony top
+def _fmt(v: Any, spec: str = "") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return format(v, spec or ".2f")
+    return str(v)
+
+
+def render_top(app: dict[str, Any], rows: list[dict[str, Any]]) -> str:
+    """One snapshot frame: application header + a row per task."""
+    lines = [
+        f"{app.get('app_id', '?')}  {app.get('state', '?')}  "
+        f"attempt {app.get('restart_attempt', 0)}"
+        + (f"  ({app.get('reason')})" if app.get("reason") else ""),
+        "",
+        f"{'TASK':<14s} {'STATE':<11s} {'STEP':>6s} {'LOSS':>8s} "
+        f"{'TOK/S':>9s} {'STEP/S':>7s} {'MFU':>6s} {'QUEUE':>6s} "
+        f"{'TTFT':>7s} {'HB AGE':>7s}",
+    ]
+    for r in rows:
+        lines.append(
+            f"{r['task']:<14s} {str(r['state']):<11s} "
+            f"{_fmt(r['step'], 'd'):>6s} {_fmt(r['loss'], '.4f'):>8s} "
+            f"{_fmt(r['tokens_per_s'], '.1f'):>9s} "
+            f"{_fmt(r['steps_per_s'], '.2f'):>7s} "
+            f"{_fmt(r['mfu'], '.3f'):>6s} "
+            f"{_fmt(r['queue_depth'], '.0f'):>6s} "
+            f"{_fmt(r['ttft_s'], '.3f'):>7s} "
+            f"{_fmt(r['hb_age_s'], '.1f'):>6s}s"
+        )
+    return "\n".join(lines)
+
+
+def main_top(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tony top",
+        description="refreshing live status of a running job "
+                    "(per-task state, step rate, queue depth, heartbeat age)",
+    )
+    p.add_argument("app_id", help="application id (staging dir name)")
+    p.add_argument("--staging", default=None,
+                   help="staging root holding <app_id>/ (default: $TONY_ROOT)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period, seconds")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit (no screen clearing)")
+    args = p.parse_args(argv)
+
+    staging = args.staging or constants.default_tony_root()
+    from tony_tpu.cluster.rpc import RpcError
+
+    first = True
+    prev_stats: dict[str, tuple[int, float]] = {}
+    while True:
+        final = _final_status(staging, args.app_id)
+        if final is not None:
+            print(f"{args.app_id} finished: {final.get('status')}"
+                  + (f" ({final.get('reason')})" if final.get("reason") else ""))
+            return 0
+        cli = _am_rpc(staging, args.app_id)
+        if cli is None:
+            print(f"no running AM for {args.app_id} under {staging}", file=sys.stderr)
+            return 1
+        try:
+            app = cli.call("get_application_status")
+            infos = cli.call("get_task_infos")
+            metrics = cli.call("get_metrics")
+        except (RpcError, OSError) as e:
+            print(f"tony top: AM unreachable: {e}", file=sys.stderr)
+            return 1
+        finally:
+            cli.close()
+        task_obs = metrics.get("tasks") or {}
+        rows = obs_introspect.build_top_rows(
+            infos, task_obs, prev_step_stats=prev_stats or None)
+        prev_stats = obs_introspect.step_stats_by_task(infos, task_obs)
+        try:
+            if not args.once and not first:
+                print("\x1b[2J\x1b[H", end="")  # clear + home between frames
+            print(render_top(app, rows), flush=True)
+        except BrokenPipeError:
+            return _pipe_closed()
+        if args.once:
+            return 0
+        first = False
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0

@@ -89,6 +89,24 @@ def _cmd_trace(argv: list[str]) -> int:
     return trace_main(argv)
 
 
+def _cmd_profile(argv: list[str]) -> int:
+    from tony_tpu.cli.introspect import main_profile
+
+    return main_profile(argv)
+
+
+def _cmd_logs(argv: list[str]) -> int:
+    from tony_tpu.cli.introspect import main_logs
+
+    return main_logs(argv)
+
+
+def _cmd_top(argv: list[str]) -> int:
+    from tony_tpu.cli.introspect import main_top
+
+    return main_top(argv)
+
+
 def _cmd_mini(argv: list[str]) -> int:
     """Self-contained sandbox: submit a smoke gang against the local resource
     manager and print the verdict + history location.
@@ -251,13 +269,16 @@ _COMMANDS = {
     "lint": _cmd_lint,
     "chaos": _cmd_chaos,
     "trace": _cmd_trace,
+    "profile": _cmd_profile,
+    "logs": _cmd_logs,
+    "top": _cmd_top,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|portal|notebook|serve|mini|data-prep|lint|chaos|trace} [options]\n")
+        print("usage: tony {submit|pool|history|portal|notebook|serve|mini|data-prep|lint|chaos|trace|profile|logs|top} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    list finished jobs / dump one job's events")
@@ -269,6 +290,9 @@ def main(argv: list[str] | None = None) -> int:
         print("  lint       run the AST static-analysis suite (config/jit/lock/mesh discipline)")
         print("  chaos      run a job under a seeded fault schedule and assert recovery invariants")
         print("  trace      merge a traced job's spans into a Chrome/Perfetto timeline + summary")
+        print("  profile    capture a jax.profiler trace on a RUNNING job's workers (no resubmit)")
+        print("  logs       merge/tail a job's per-process structured logs in timestamp order")
+        print("  top        refreshing live status view (per-task state, step rate, heartbeat age)")
         return 0
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:

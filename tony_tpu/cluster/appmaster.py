@@ -30,6 +30,8 @@ from tony_tpu import constants
 from tony_tpu.chaos import ChaosContext
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.cluster import history
+from tony_tpu.obs import introspect as obs_introspect
+from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
 from tony_tpu.obs import trace as obs_trace
 from tony_tpu.cluster.events import EventHandler, EventType
@@ -62,6 +64,10 @@ _GANG_RESIZES = obs_metrics.counter(
     "tony_gang_resizes_total",
     "requested elastic resizes by outcome (applied, rejected, noop)",
     labelnames=("outcome",))
+_PROFILE_REPORTS = obs_metrics.counter(
+    "tony_profile_reports_total",
+    "per-task on-demand capture reports by status (delivered, captured, error)",
+    labelnames=("status",))
 
 
 def build_resource_manager(config: TonyConfig, app_id: str = "") -> ResourceManager:
@@ -130,6 +136,10 @@ class ApplicationMaster:
         self.app_id = app_id
         self.staging_dir = staging_dir
         obs_metrics.set_enabled(config.get_bool(keys.METRICS_ENABLED, True))
+        # structured logging (tony.log.*): JSONL records under <staging>/logs
+        # that `tony logs` merges with every other process's; the console
+        # echo keeps am.log human-readable exactly as before
+        obs_logging.init_from_config(config, identity="am", staging_dir=staging_dir)
         # tracing (tony.trace.*): None — and zero-cost — unless enabled; the
         # root span parent arrives from the submitting client via env
         self.tracer = obs_trace.init_from_config(
@@ -172,6 +182,9 @@ class ApplicationMaster:
         # an acknowledged-but-unapplied request.
         self._pending_resize: dict[str, int] = {}
         self._client_obs: dict[str, Any] = {}  # submitter-side registries (fleet router)
+        # on-demand profiler capture (tony profile): single-slot request
+        # state machine, internally locked — RPC handler threads race on it
+        self._profile = obs_introspect.ProfileCoordinator()
         self._last_capacity_probe = 0.0
         self._capacity_short_since: float | None = None  # downsize hysteresis
         # guards (attempt, session) as one unit: RPC handlers capture both
@@ -266,7 +279,14 @@ class ApplicationMaster:
         if session is None:
             return {"ack": False, "stale": True}
         session.on_heartbeat(job_name, index)
-        return {"ack": True}
+        resp: dict[str, Any] = {"ack": True}
+        # the AM cannot push to executors, but they knock every heartbeat:
+        # an in-flight capture request rides back on the response until the
+        # task reports a terminal status (the courier dedups by req_id)
+        profile = self._profile.pending_for(f"{job_name}:{index}")
+        if profile is not None:
+            resp["profile"] = profile
+        return resp
 
     def get_task_infos(self) -> list[dict[str, Any]]:
         return self.session.task_infos()
@@ -326,6 +346,64 @@ class ApplicationMaster:
             self._pending_resize[job_name] = n
         return {"ack": True, "current": current}
 
+    def start_profile(self, steps: int | None = None, memory: bool = False) -> dict[str, Any]:
+        """Arm an on-demand profiler capture (``tony profile <app_id>``): fan
+        the request out to every live tracked task via the heartbeat
+        piggyback. One capture may be in flight at a time — a concurrent
+        request fails with the typed AlreadyProfilingError in the RPC error
+        frame."""
+        num_steps = int(steps or self.config.get_int(keys.PROFILE_STEPS, 5))
+        capture_memory = bool(memory) or self.config.get_bool(keys.PROFILE_MEMORY)
+        untracked = self.session.untracked
+        targets = [
+            f"{i['name']}:{i['index']}"
+            for i in self.session.task_infos()
+            if i["name"] not in untracked
+            and i["status"] in (TaskStatus.REGISTERED.value, TaskStatus.RUNNING.value)
+        ]
+        result = self._profile.start(targets, num_steps, capture_memory)
+        self.events.emit(
+            EventType.PROFILE_REQUESTED,
+            req_id=result["req_id"], num_steps=num_steps, tasks=result["tasks"],
+        )
+        obs_logging.info(
+            f"[tony-am] profile {result['req_id']}: capturing {num_steps} "
+            f"step(s) on {len(result['tasks'])} task(s)"
+        )
+        return result
+
+    def get_profile_status(self, req_id: str = "") -> dict[str, Any]:
+        """The current/last capture request's per-task status (the surface
+        ``tony profile`` blocks on)."""
+        return {"profile": self._profile.status(req_id)}
+
+    def report_profile_status(
+        self, job_name: str, index: int, req_id: str, status: str,
+        dir: str = "", artifacts: list[str] | None = None,
+        summary: dict[str, Any] | None = None, error: str = "", attempt: int = 0,
+    ) -> dict[str, Any]:
+        """Executors report capture progress (delivered → captured/error)."""
+        if self._fenced_session(attempt) is None:
+            return {"ack": False, "stale": True}
+        acked, completed = self._profile.report(
+            f"{job_name}:{index}", req_id, status,
+            dir=dir, artifacts=artifacts, summary=summary, error=error or None,
+        )
+        if acked:
+            _PROFILE_REPORTS.inc(status=status)
+        if completed:
+            st = self._profile.status(req_id) or {}
+            self.events.emit(
+                EventType.PROFILE_FINISHED,
+                req_id=req_id,
+                tasks={
+                    tid: e.get("status")
+                    for tid, e in (st.get("tasks") or {}).items()
+                },
+            )
+            obs_logging.info(f"[tony-am] profile {req_id}: all tasks reported")
+        return {"ack": acked}
+
     def get_metrics(self) -> dict[str, Any]:
         """This AM process's metrics-registry snapshot (obs/metrics.py) plus
         the latest registry snapshot each executor piggybacked on its metrics
@@ -379,6 +457,10 @@ class ApplicationMaster:
         # (delegation-token analog) and pollers race the rename
         _atomic_write_json(info_path, info, mode=0o600)
         self.session.job_status = JobStatus.RUNNING
+        obs_logging.info(
+            f"[tony-am] application {self.app_id} running "
+            f"({self.session.total_tasks()} task(s), rpc {host}:{port})"
+        )
 
     def _launch_type(self, job_type: str) -> None:
         if self.tracer is None:
@@ -662,6 +744,12 @@ class ApplicationMaster:
 
     def _restart_gang_spanned(self, reason: str, resize: dict[str, int] | None) -> bool:
         self.events.emit(EventType.HEARTBEAT_LOST, reason=f"gang restart: {reason}")
+        # an in-flight capture can never complete across the restart: the
+        # children that would have captured are being killed, and relaunch
+        # clears their control files — fail it now so the next `tony
+        # profile` isn't blocked by a ghost request
+        self._profile.abort(f"gang restarted: {reason}")
+        obs_logging.warning(f"[tony-am] gang restart: {reason}")
         self._kill_all_containers()
         for c in list(self._containers.values()):
             self.rm.release(c)
@@ -682,6 +770,9 @@ class ApplicationMaster:
             self.session = Session(cfg)
             self.session.job_status = JobStatus.RUNNING
             self.scheduler = TaskScheduler(cfg, self.session, self.rm)
+        lg = obs_logging.get()
+        if lg is not None:
+            lg.epoch = self._restart_attempt  # stamp the new gang epoch on records
         if announce:
             self._announce_resize(resize, reason)
         return True
@@ -843,6 +934,7 @@ class ApplicationMaster:
     def stop(self) -> JobStatus:
         final = self.session.reduce_final_status()
         completed_ms = int(time.time() * 1000)
+        obs_logging.info(f"[tony-am] application {self.app_id} finished: {final.value}")
         self.events.emit(
             EventType.APPLICATION_FINISHED,
             status=final.value,

@@ -27,6 +27,7 @@ from tony_tpu import constants
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.cluster.rpc import RpcClient, RpcError
 from tony_tpu.cluster.session import JobStatus
+from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
 from tony_tpu.obs import trace as obs_trace
 
@@ -108,6 +109,9 @@ class Client:
         self.config.write_final(staging_dir)
 
         obs_metrics.set_enabled(self.config.get_bool(keys.METRICS_ENABLED, True))
+        # structured logging (tony.log.*): the submitter's records join the
+        # job's <staging>/logs aggregate; console output is unchanged (echo)
+        obs_logging.init_from_config(self.config, identity="client", staging_dir=staging_dir)
         # tracing (tony.trace.*): the submit span becomes the whole trace's
         # root — the AM links under it via TONY_TRACE_PARENT, executors under
         # the AM, training children under their executor
@@ -176,7 +180,7 @@ class Client:
                 retried = self._maybe_retry_am(handle)
                 if retried is None:
                     if not quiet:
-                        print(f"[tony] AM for {handle.app_id} died without final status → FAILED")
+                        obs_logging.error(f"[tony] AM for {handle.app_id} died without final status → FAILED")
                         _print_am_log_tail(handle)
                     return JobStatus.FAILED
                 handle, rpc = retried
@@ -195,14 +199,15 @@ class Client:
                     self._notify("task_transition", info)
                     if not quiet:
                         loc = f" on {info['host']}:{info['port']}" if info.get("host") else ""
-                        print(f"[tony] task {tid} → {st}{loc}" +
-                              (f" (logs: {info['log_dir']})"
+                        obs_logging.info(
+                            f"[tony] task {tid} → {st}{loc}"
+                            + (f" (logs: {info['log_dir']})"
                                if st in ("FAILED", "LOST") and info.get("log_dir") else ""))
             if app.get("tensorboard_url") and not tb_reported:
                 tb_reported = True
                 self._notify("tensorboard_url", app["tensorboard_url"])
                 if not quiet:
-                    print(f"[tony] tensorboard at {app['tensorboard_url']}")
+                    obs_logging.info(f"[tony] tensorboard at {app['tensorboard_url']}")
             time.sleep(0.3)
 
     def _maybe_retry_am(self, handle: ApplicationHandle) -> tuple[ApplicationHandle, RpcClient | None] | None:
@@ -232,7 +237,7 @@ class Client:
         """submit + monitor; exit code = job verdict (reference main flow)."""
         handle = self.submit()
         if not quiet:
-            print(f"[tony] submitted {handle.app_id} (staging: {handle.staging_dir})")
+            obs_logging.info(f"[tony] submitted {handle.app_id} (staging: {handle.staging_dir})")
         final = self.monitor_application(handle, quiet=quiet)
         return constants.EXIT_SUCCESS if final == JobStatus.SUCCEEDED else constants.EXIT_FAILURE
 
@@ -249,22 +254,24 @@ class Client:
 
 
 def _print_am_log_tail(handle: ApplicationHandle, lines: int = 15) -> None:
+    # error level like the "AM died" headline that precedes it, so the whole
+    # forensic block lands on one stream (stderr) instead of splitting
     path = os.path.join(handle.staging_dir, "am.log")
     if os.path.exists(path):
         with open(path, errors="replace") as f:
             tail = f.readlines()[-lines:]
         if tail:
-            print(f"[tony] last {len(tail)} lines of {path}:")
+            obs_logging.error(f"[tony] last {len(tail)} lines of {path}:")
             for line in tail:
-                print(f"[tony-am] {line.rstrip()}")
+                obs_logging.error(f"[tony-am] {line.rstrip()}")
 
 
 def _print_final(handle: ApplicationHandle, status: dict[str, Any]) -> None:
-    print(f"[tony] application {handle.app_id} finished: {status['status']}")
+    obs_logging.info(f"[tony] application {handle.app_id} finished: {status['status']}")
     if status.get("reason"):
-        print(f"[tony]   reason: {status['reason']}")
+        obs_logging.info(f"[tony]   reason: {status['reason']}")
     for t in status.get("tasks", []):
-        print(
+        obs_logging.info(
             f"[tony]   {t['name']}:{t['index']} {t['status']}"
             + (f" exit={t['exit_code']}" if t.get("exit_code") is not None else "")
         )

@@ -35,6 +35,8 @@ class EventType(enum.Enum):
     GANG_RESIZED = "GANG_RESIZED"
     TASK_URL_REGISTERED = "TASK_URL_REGISTERED"
     METRICS_SNAPSHOT = "METRICS_SNAPSHOT"
+    PROFILE_REQUESTED = "PROFILE_REQUESTED"    # on-demand capture fan-out began
+    PROFILE_FINISHED = "PROFILE_FINISHED"      # every targeted task reported
     APPLICATION_FINISHED = "APPLICATION_FINISHED"
 
 

@@ -24,6 +24,8 @@ from tony_tpu.chaos import ChaosContext
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.cluster.metrics import MetricsSampler
 from tony_tpu.cluster.rpc import RpcClient, RpcError
+from tony_tpu.obs import introspect as obs_introspect
+from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
 from tony_tpu.obs import trace as obs_trace
 from tony_tpu.runtime import get_runtime
@@ -59,6 +61,13 @@ class TaskExecutor:
         am_host = env.get(constants.ENV_AM_HOST, "127.0.0.1")
         self.config = TonyConfig.load_final(os.path.join(self.staging_dir, constants.TONY_FINAL_CONF))
         obs_metrics.set_enabled(self.config.get_bool(keys.METRICS_ENABLED, True))
+        self.attempt = int(env.get("TONY_RESTART_ATTEMPT", "0"))  # gang-epoch fence
+        # structured logging (tony.log.*): this supervisor's records join the
+        # job-wide <staging>/logs aggregate `tony logs` merges
+        obs_logging.init_from_config(
+            self.config, identity=f"{self.job_name}:{self.index}",
+            staging_dir=self.staging_dir, epoch=self.attempt,
+        )
         # tracing (tony.trace.*): the root span parents under the AM's via
         # TONY_TRACE_PARENT; None — and zero-cost — unless enabled
         self.tracer = obs_trace.init_from_config(
@@ -80,7 +89,6 @@ class TaskExecutor:
             chaos=self.chaos,
         )
         self.runtime = get_runtime(self.config)
-        self.attempt = int(env.get("TONY_RESTART_ATTEMPT", "0"))  # gang-epoch fence
         # THIS task's rendezvous address — the executor's own host, not the
         # AM's (they differ on any multi-host pool).
         self.host = env.get("TONY_EXECUTOR_HOST") or _own_host(am_host)
@@ -88,6 +96,12 @@ class TaskExecutor:
         self.child: subprocess.Popen | None = None
         self._stop = threading.Event()
         self._hb_failures = 0
+        # on-demand profile relay (tony profile): control file out to the
+        # child, done file back, status reported over RPC — driven entirely
+        # from the heartbeat thread
+        self._profile_courier = obs_introspect.ProfileCourier(
+            self.staging_dir, self.job_name, self.index, self._report_profile
+        )
 
     # -- gang barrier ------------------------------------------------------
     def register(self) -> None:
@@ -175,14 +189,25 @@ class TaskExecutor:
                 env[constants.ENV_TRACE_PARENT] = self._root_span.span_id
         if not self.config.get_bool(keys.METRICS_ENABLED, True):
             env[constants.ENV_METRICS_ENABLED] = "0"  # child honors the job's opt-out
+        # child-process structured-logging contract: records land in the same
+        # <staging>/logs aggregate as this supervisor's (tony logs merges them)
+        log_level = self.config.get(keys.LOG_LEVEL) or "info"
+        if log_level.lower() != "off":
+            env[constants.ENV_LOG_DIR] = self.config.get(keys.LOG_DIR) or os.path.join(
+                self.staging_dir, "logs"
+            )
+            env[constants.ENV_LOG_LEVEL] = log_level
+        # on-demand profile contract: how often the child stats the control
+        # file the courier drops next to the train-metrics path
+        env[constants.ENV_PROFILE_POLL_MS] = str(
+            self.config.get_time_ms(keys.PROFILE_POLL_INTERVAL_MS, 500)
+        )
         if self.config.get_bool(keys.TASK_PROFILE):
-            from tony_tpu.train import profiling
-
-            env[profiling.ENV_PROFILE_DIR] = os.path.join(
+            env[constants.ENV_PROFILE_DIR] = os.path.join(
                 self.staging_dir, "profile", f"{self.job_name}_{self.index}"
             )
-            env[profiling.ENV_PROFILE_START_STEP] = self.config.get(keys.TASK_PROFILE_START_STEP)
-            env[profiling.ENV_PROFILE_NUM_STEPS] = self.config.get(keys.TASK_PROFILE_NUM_STEPS)
+            env[constants.ENV_PROFILE_START_STEP] = self.config.get(keys.TASK_PROFILE_START_STEP)
+            env[constants.ENV_PROFILE_NUM_STEPS] = self.config.get(keys.TASK_PROFILE_NUM_STEPS)
         # train-side throughput metrics contract: the loop writes its step
         # report (loss/tokens_per_sec/mfu) here; the metrics push loop
         # attaches it so the AM/portal see TRAINING progress, not just
@@ -248,10 +273,16 @@ class TaskExecutor:
         stdio inherits the container's captured stdout/stderr."""
         # clear any previous attempt's train-metrics drop: a stale step
         # report must not masquerade as live progress while the new child
-        # is still compiling
+        # is still compiling (likewise a stale profile control/done pair —
+        # the new child must not re-arm a dead request)
         path = getattr(self, "_train_metrics_path", None)
         if path:
-            for stale in (path, path + ".obs"):
+            for stale in (
+                path,
+                path + ".obs",
+                path + obs_introspect.CONTROL_SUFFIX,
+                path + obs_introspect.DONE_SUFFIX,
+            ):
                 try:
                     os.unlink(stale)
                 except OSError:
@@ -280,7 +311,7 @@ class TaskExecutor:
                 continue
             try:
                 t0 = time.perf_counter()
-                self.rpc.call(
+                resp = self.rpc.call(
                     "task_executor_heartbeat",
                     job_name=self.job_name,
                     index=self.index,
@@ -288,6 +319,12 @@ class TaskExecutor:
                 )
                 _HB_RTT.observe(time.perf_counter() - t0)
                 self._hb_failures = 0
+                # on-demand profile piggyback: relay a pending capture
+                # request to the child / report its done record back
+                self._profile_courier.handle(
+                    resp.get("profile") if isinstance(resp, dict) else None,
+                    getattr(self, "_train_metrics_path", None),
+                )
             except (RpcError, OSError):
                 self._hb_failures += 1
                 if self._hb_failures > max_missed:
@@ -330,6 +367,18 @@ class TaskExecutor:
                 )
             except (RpcError, OSError):
                 pass  # metrics are best-effort; liveness is the heartbeat's job
+
+    def _report_profile(self, **params) -> None:
+        """Courier callback: capture status back to the AM. Raises on RPC
+        failure so the courier retries on a later heartbeat instead of
+        marking the request reported."""
+        self.rpc.call(
+            "report_profile_status",
+            job_name=self.job_name,
+            index=self.index,
+            attempt=self.attempt,
+            **params,
+        )
 
     def _read_child_obs_metrics(self):
         """The training child's metrics-registry snapshot (atomic drop at
@@ -464,7 +513,7 @@ class TaskExecutor:
             command = self.resolve_command()
             env = self.build_child_env(spec, extra_env)
         except Exception as e:  # registration/barrier failure
-            print(f"[tony-executor] startup failed: {e}", file=sys.stderr, flush=True)
+            obs_logging.error(f"[tony-executor] startup failed: {e}")
             try:
                 self.rpc.call(
                     "register_execution_result",
@@ -478,6 +527,10 @@ class TaskExecutor:
             return constants.EXIT_EXECUTOR_REGISTRATION_FAILED
 
         self.child = self.launch_child(command, env)
+        obs_logging.info(
+            f"[tony-executor] {self.job_name}:{self.index} launched child",
+            pid=self.child.pid,
+        )
         self._start_chaos_timers()
         threading.Thread(target=self._metrics_loop, name="metrics", daemon=True).start()
 
@@ -505,9 +558,20 @@ class TaskExecutor:
                 self._kill_child()
                 rc = constants.EXIT_EXECUTION_TIMEOUT
                 reason = f"execution timeout: killed after {timeout_ms}ms (tony.task.execution-timeout-ms)"
-                print(f"[tony-executor] {reason}", file=sys.stderr, flush=True)
+                obs_logging.error(f"[tony-executor] {reason}")
             obs_trace.add_event("child.exited", exit_code=rc)
+        obs_logging.info(
+            f"[tony-executor] {self.job_name}:{self.index} child exited",
+            exit_code=rc,
+        )
         self._stop.set()
+        try:
+            # final courier sweep: a capture the child finalized in its
+            # `finally` (truncated by end-of-training) races the heartbeat
+            # loop we just stopped — the done file must still be reported
+            self._profile_courier.handle(None, getattr(self, "_train_metrics_path", None))
+        except (RpcError, OSError):
+            pass  # the AM-side request expires; artifacts remain on disk
         try:
             self.rpc.call_with_retry(
                 "register_execution_result",

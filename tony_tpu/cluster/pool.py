@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from tony_tpu import constants
+from tony_tpu.obs import logging as obs_logging
 from tony_tpu.cluster.resources import (
     AllocationError,
     AllocationPending,
@@ -1185,7 +1186,7 @@ def main(argv: list[str] | None = None) -> int:
         with open(tmp, "w") as f:
             json.dump({"host": host, "port": port}, f)
         os.replace(tmp, args.info_file)
-    print(f"[tony-pool] serving on {host}:{port}", flush=True)
+    obs_logging.info(f"[tony-pool] serving on {host}:{port}")
     done = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: done.set())
     signal.signal(signal.SIGINT, lambda *_: done.set())

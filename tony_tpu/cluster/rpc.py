@@ -304,4 +304,7 @@ APPLICATION_RPC_METHODS = [
     "get_metrics",           # process metrics-registry snapshot (obs/metrics.py)
     "push_client_metrics",   # submitter-side registry (fleet router) re-exported by get_metrics
     "resize_jobtype",        # elastic retarget of tony.<type>.instances (serve autoscaler)
+    "start_profile",         # arm an on-demand profiler capture (tony profile)
+    "get_profile_status",    # per-task capture status for the in-flight request
+    "report_profile_status", # executors report delivery/capture back to the AM
 ]

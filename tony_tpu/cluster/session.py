@@ -84,6 +84,7 @@ class Task:
             "exit_code": self.exit_code,
             "start_time_ms": self.start_time_ms,
             "end_time_ms": self.end_time_ms,
+            "last_heartbeat_ms": self.last_heartbeat_ms,
             "metrics": dict(self.metrics),
             "log_dir": self.log_dir,
             "chip_coords": [list(c) for c in self.chip_coords],

@@ -171,6 +171,28 @@ SERVE_HEALTH_INTERVAL_MS = "tony.serve.health-interval-ms"
 SERVE_HEALTH_FAIL_THRESHOLD = "tony.serve.health-fail-threshold"
 
 # ---------------------------------------------------------------------------
+# tony.profile.* — ON-DEMAND profiler capture (docs/observability.md)
+# ---------------------------------------------------------------------------
+# `tony profile <app_id>` asks a RUNNING job's workers to capture a
+# jax.profiler trace at the next step boundary — no resubmit, unlike the
+# submit-time `tony.task.profile` window. These keys set the defaults the
+# AM applies when the CLI omits the flags, and the contract knobs.
+PROFILE_STEPS = "tony.profile.steps"            # default capture window (steps)
+PROFILE_MEMORY = "tony.profile.memory"          # also save a device memory profile
+# How often (at most) the training child stats the control file for a new
+# capture request — the only recurring cost of the on-demand plane when idle.
+PROFILE_POLL_INTERVAL_MS = "tony.profile.poll-interval-ms"
+
+# ---------------------------------------------------------------------------
+# tony.log.* — aggregated structured logging (docs/observability.md)
+# ---------------------------------------------------------------------------
+# Every job process (client, AM, executors, training children) appends JSONL
+# records to <staging>/logs/<identity>.log.jsonl; `tony logs <app_id>` merges
+# and tails them in timestamp order. Records below the level are never built.
+LOG_LEVEL = "tony.log.level"                    # debug|info|warning|error|off
+LOG_DIR = "tony.log.dir"                        # sink override; empty → <staging>/logs
+
+# ---------------------------------------------------------------------------
 # tony.chaos.* — deterministic fault injection (docs/fault-tolerance.md)
 # ---------------------------------------------------------------------------
 # Fault schedule, e.g. "rpc-drop:p=0.05;exec-crash:worker:1@gang_complete";
@@ -288,6 +310,13 @@ DEFAULTS: dict[str, str] = {
     SERVE_HEDGE_MIN_MS: "50",
     SERVE_HEALTH_INTERVAL_MS: "1000",
     SERVE_HEALTH_FAIL_THRESHOLD: "3",
+
+    PROFILE_STEPS: "5",
+    PROFILE_MEMORY: "false",
+    PROFILE_POLL_INTERVAL_MS: "500",
+
+    LOG_LEVEL: "info",
+    LOG_DIR: "",                     # empty → <staging>/logs
 
     CHAOS_SPEC: "",
     CHAOS_SEED: "0",

@@ -65,6 +65,21 @@ ENV_TRACE_ENABLED = "TONY_TRACE_ENABLED"  # "1" → tracing on in this process t
 ENV_TRACE_DIR = "TONY_TRACE_DIR"          # span JSONL sink dir (<staging>/trace)
 ENV_TRACE_PARENT = "TONY_TRACE_PARENT"    # parent span id for this process's root span
 ENV_METRICS_ENABLED = "TONY_METRICS_ENABLED"  # "0" → child metrics recording off (tony.metrics.enabled)
+# Structured-logging contract across process spawns (tony.log.*): the
+# executor exports these so the training child's JSONL records land in the
+# same <staging>/logs/ aggregate `tony logs` merges
+ENV_LOG_DIR = "TONY_LOG_DIR"            # log JSONL sink dir (<staging>/logs)
+ENV_LOG_LEVEL = "TONY_LOG_LEVEL"        # debug|info|warning|error|off
+# Profiling contract across process spawns (tony.profile.* / tony.task.
+# profile): the executor exports these for the training child's StepProfiler.
+# They live here — not train/profiling.py — so the executor supervisor can
+# export them without importing the train package (whose init pulls the
+# trainer, and with it jax).
+ENV_PROFILE_DIR = "TONY_PROFILE_DIR"                  # static-window artifact dir
+ENV_PROFILE_START_STEP = "TONY_PROFILE_START_STEP"    # static window start
+ENV_PROFILE_NUM_STEPS = "TONY_PROFILE_NUM_STEPS"      # static window length
+# how often (at most) the on-demand control file is stat'ed, ms
+ENV_PROFILE_POLL_MS = "TONY_PROFILE_POLL_MS"
 ENV_NOTEBOOK_PORT = "NOTEBOOK_PORT"     # notebook task port (proxied by submitter)
 
 # ---------------------------------------------------------------------------

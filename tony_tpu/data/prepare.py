@@ -102,7 +102,7 @@ def main(argv: list[str] | None = None) -> int:
     manifest = prepare_corpus(
         args.inputs, args.out, tokenizer=args.tokenizer, shard_tokens=args.shard_tokens
     )
-    print(json.dumps(manifest))
+    print(json.dumps(manifest))  # lint: disable=print-discipline — the manifest on stdout IS the output
     return 0
 
 

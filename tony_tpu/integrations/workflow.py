@@ -18,6 +18,7 @@ import json
 import sys
 
 from tony_tpu.config import TonyConfig, keys
+from tony_tpu.obs import logging as obs_logging
 
 # Convenience shorthands a workflow step may use instead of full tony.* keys
 # (reference TonyJob maps Azkaban's job props the same way).
@@ -73,8 +74,7 @@ def main(argv: list[str] | None = None) -> int:
     (props.json: flat string map, the engine's rendered step properties)."""
     argv = list(sys.argv[1:] if argv is None else argv)
     if len(argv) != 2:
-        print("usage: python -m tony_tpu.integrations.workflow <job-name> <props.json>",
-              file=sys.stderr)
+        obs_logging.error("usage: python -m tony_tpu.integrations.workflow <job-name> <props.json>")
         return 2
     with open(argv[1]) as f:
         props = {str(k): str(v) for k, v in json.load(f).items()}

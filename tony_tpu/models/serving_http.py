@@ -45,6 +45,7 @@ import jax
 from tony_tpu import constants
 from tony_tpu.models.llama import PRESETS, init
 from tony_tpu.models.serving import ContinuousBatcher
+from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
 
 # Serving instruments (obs registry, satellite of the training child's:
@@ -589,10 +590,10 @@ def _resolve_kv(args) -> str:
     elif backend not in ("tpu", "axon"):
         return "dense"  # gpu/rocm/cpu: the paged decode kernel is TPU-only
     if args.page_len <= 0 or args.max_len % args.page_len:
-        print(f"[tony-serve] kv defaulting to dense: max_len {args.max_len} "
-              f"is not a positive multiple of page_len {args.page_len} "
-              f"(pass --kv paged --page_len <divisor> for paged)",
-              file=sys.stderr, flush=True)
+        obs_logging.warning(
+            f"[tony-serve] kv defaulting to dense: max_len {args.max_len} "
+            f"is not a positive multiple of page_len {args.page_len} "
+            f"(pass --kv paged --page_len <divisor> for paged)")
         return "dense"
     return "paged"
 
@@ -636,6 +637,9 @@ def build_engine(args) -> ContinuousBatcher:
 
 
 def main(argv: list[str] | None = None) -> int:
+    # under a tony container the executor exports the structured-logging
+    # contract; outside it the helpers echo to the console only
+    obs_logging.init_from_env(role="serve")
     p = argparse.ArgumentParser(
         prog="tony-serve", description="continuous-batching HTTP inference server"
     )
@@ -718,11 +722,11 @@ def main(argv: list[str] | None = None) -> int:
     signal.signal(signal.SIGTERM, _drain)
     signal.signal(signal.SIGINT, _drain)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    print(f"[tony-serve] {url} preset={args.preset} slots={args.slots} "
-          f"max_len={args.max_len}", flush=True)
+    obs_logging.info(f"[tony-serve] {url} preset={args.preset} slots={args.slots} "
+                     f"max_len={args.max_len}")
     done.wait()
     if srv.error is not None:
-        print(f"[tony-serve] engine failed: {srv.error}", file=sys.stderr, flush=True)
+        obs_logging.error(f"[tony-serve] engine failed: {srv.error}")
         httpd.shutdown()
         return 1
     # graceful drain: refuse new work, finish in-flight, then exit 0. The
@@ -730,10 +734,10 @@ def main(argv: list[str] | None = None) -> int:
     # (tony.task.kill-grace-ms) minus a margin for teardown itself.
     grace_ms = float(os.environ.get(constants.ENV_KILL_GRACE_MS, "0") or 0)
     budget_s = max(grace_ms / 1000 - 1.0, 2.0) if grace_ms else 10.0
-    print(f"[tony-serve] draining (budget {budget_s:.0f}s)", flush=True)
+    obs_logging.info(f"[tony-serve] draining (budget {budget_s:.0f}s)")
     if not srv.stop(timeout_s=budget_s):
-        print(f"[tony-serve] drain timed out with {len(srv._streams)} "
-              f"request(s) in flight — truncating", file=sys.stderr, flush=True)
+        obs_logging.warning(f"[tony-serve] drain timed out with {len(srv._streams)} "
+                            f"request(s) in flight — truncating")
     stop_metrics.set()
     httpd.shutdown()
     return 0

@@ -1,16 +1,25 @@
-"""Observability: distributed tracing (trace.py) + metrics registry (metrics.py).
+"""Observability: distributed tracing (trace.py), metrics registry
+(metrics.py), structured logging (logging.py), and the live-introspection
+plane behind ``tony profile`` / ``tony top`` (introspect.py).
 
 Docs: docs/observability.md. Disabled tracing (the default) costs one None
-check per hook; metrics recording is gated by ``tony.metrics.enabled``.
+check per hook; metrics recording is gated by ``tony.metrics.enabled``;
+log records below ``tony.log.level`` are never built.
 """
 
-from tony_tpu.obs import metrics, trace
+from tony_tpu.obs import introspect, logging, metrics, trace
+from tony_tpu.obs.introspect import AlreadyProfilingError
+from tony_tpu.obs.logging import JsonLogger
 from tony_tpu.obs.metrics import REGISTRY, MetricsRegistry, render_merged
 from tony_tpu.obs.trace import Span, Tracer
 
 __all__ = [
+    "introspect",
+    "logging",
     "metrics",
     "trace",
+    "AlreadyProfilingError",
+    "JsonLogger",
     "REGISTRY",
     "MetricsRegistry",
     "render_merged",

@@ -1,0 +1,378 @@
+"""Live job introspection: the plumbing behind ``tony profile`` / ``tony top``.
+
+The reference's only answer to "what is this job doing right now?" was the
+TensorBoard sidecar (SURVEY.md §5.1). This module turns the existing
+AM↔executor↔training-child plumbing into an on-demand introspection plane:
+
+- **AM side** — :class:`ProfileCoordinator` owns the single in-flight
+  capture request: ``start_profile`` creates it (a second concurrent request
+  raises the typed :class:`AlreadyProfilingError`), the heartbeat RPC
+  piggybacks it out to each targeted executor, and
+  ``report_profile_status`` folds per-task delivery/capture results back in.
+- **Executor side** — :class:`ProfileCourier` relays a piggybacked request
+  to the training child by atomically writing a **control file** next to the
+  ``<train-metrics-file>`` drop (the established executor↔child piggyback
+  contract), then watches for the child's **done file** and reports the
+  capture result (artifacts + step-time summary) back over RPC.
+- **Child side** — ``StepProfiler`` (train/profiling.py) polls the control
+  file at step boundaries and runs the actual ``jax.profiler`` capture.
+- **`tony top`** — helpers that synthesize one status row per task from the
+  AM's ``get_task_infos`` + ``get_metrics`` payloads (step rate from the
+  piggybacked step-time histogram, queue depth / TTFT for serve replicas,
+  heartbeat age).
+
+File contract next to ``<train-metrics-file>``:
+
+========================  ====================================================
+``<metrics>.profile``      control file the executor writes:
+                           ``{"req_id", "num_steps", "memory", "dir"}``
+``<metrics>.profile.done`` result the child writes after ``stop_trace``:
+                           ``{"req_id", "ok", "dir", "artifacts",
+                           "steps_captured", "step_times_ms", "truncated",
+                           "error"}``
+========================  ====================================================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+CONTROL_SUFFIX = ".profile"
+DONE_SUFFIX = ".profile.done"
+
+#: per-task capture states, in lifecycle order
+PENDING, DELIVERED, CAPTURED, FAILED = "pending", "delivered", "captured", "error"
+_TERMINAL = (CAPTURED, FAILED)
+
+
+class AlreadyProfilingError(RuntimeError):
+    """A capture request is already in flight for this application.
+
+    Raised by the AM's ``start_profile`` handler; the name crosses the RPC
+    boundary in the error frame (``"AlreadyProfilingError: ..."``) so the
+    CLI — and tests — can distinguish it from transport failures.
+    """
+
+
+def write_json_atomic(path: str, obj: Any) -> None:
+    """tmp + rename so a reader never sees a torn file (same discipline as
+    the train-metrics drop)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def read_json(path: str) -> dict[str, Any] | None:
+    """The JSON object at ``path``, or None (missing / torn / not a dict)."""
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return d if isinstance(d, dict) else None
+
+
+# --------------------------------------------------------------- AM side
+class ProfileCoordinator:
+    """The AM's single-slot capture request state machine.
+
+    One request may be in flight at a time (``jax.profiler`` cannot nest
+    traces, and overlapping windows would make the artifacts lie); a second
+    ``start`` while one is live raises :class:`AlreadyProfilingError`. A
+    request whose tasks never report — a target without a ``StepProfiler``
+    in its child (a raw shell command, a serve replica), or a child that
+    died without its executor noticing the done file — would otherwise wedge
+    the slot for the job's lifetime, so an in-flight request older than
+    ``stale_after_s`` is auto-failed by the next ``start``. All mutation
+    happens under the internal lock — the RPC handler threads and the
+    monitor loop race on this object.
+    """
+
+    def __init__(self, stale_after_s: float = 600.0) -> None:
+        self._lock = threading.Lock()
+        self._req: dict[str, Any] | None = None  # current/last request
+        self.stale_after_s = stale_after_s
+
+    def start(self, task_ids: list[str], num_steps: int, memory: bool) -> dict[str, Any]:
+        if num_steps < 1:
+            raise ValueError(f"num_steps must be >= 1, got {num_steps}")
+        if not task_ids:
+            raise RuntimeError("no running tracked tasks to profile")
+        with self._lock:
+            if self._req is not None and not self._req["complete"]:
+                age_s = (time.time() * 1000 - self._req["started_ms"]) / 1000
+                if age_s <= self.stale_after_s:
+                    raise AlreadyProfilingError(
+                        f"capture {self._req['req_id']} still in flight "
+                        f"({self._progress_locked()}) — wait for it or re-run "
+                        f"later (unreported requests expire after "
+                        f"{self.stale_after_s:.0f}s)"
+                    )
+                # expired: some target never reported (e.g. its child runs no
+                # StepProfiler) — fail it rather than brick the slot forever
+                self._abort_locked(
+                    f"expired: no report within {self.stale_after_s:.0f}s"
+                )
+            req_id = os.urandom(4).hex()
+            self._req = {
+                "req_id": req_id,
+                "num_steps": int(num_steps),
+                "memory": bool(memory),
+                "started_ms": int(time.time() * 1000),
+                "complete": False,
+                "tasks": {tid: {"status": PENDING} for tid in task_ids},
+            }
+            return {"req_id": req_id, "num_steps": int(num_steps), "tasks": list(task_ids)}
+
+    def _progress_locked(self) -> str:
+        assert self._req is not None
+        done = sum(1 for t in self._req["tasks"].values() if t["status"] in _TERMINAL)
+        return f"{done}/{len(self._req['tasks'])} tasks reported"
+
+    def pending_for(self, task_id: str) -> dict[str, Any] | None:
+        """The heartbeat piggyback: the request this task should (still) act
+        on, or None. Re-sent until the task reports a terminal status — the
+        courier dedups by req_id, so redelivery is idempotent."""
+        with self._lock:
+            req = self._req
+            if req is None or req["complete"]:
+                return None
+            entry = req["tasks"].get(task_id)
+            if entry is None or entry["status"] in _TERMINAL:
+                return None
+            return {
+                "req_id": req["req_id"],
+                "num_steps": req["num_steps"],
+                "memory": req["memory"],
+            }
+
+    def report(self, task_id: str, req_id: str, status: str,
+               **extra: Any) -> tuple[bool, bool]:
+        """Fold one task's status in. Returns ``(acked, completed_now)`` —
+        ``completed_now`` is True exactly once, when this report was the
+        last outstanding one (the caller emits the PROFILE_FINISHED event
+        outside the lock)."""
+        if status not in (PENDING, DELIVERED, CAPTURED, FAILED):
+            return False, False
+        with self._lock:
+            req = self._req
+            if req is None or req["req_id"] != req_id:
+                return False, False
+            entry = req["tasks"].get(task_id)
+            if entry is None:
+                return False, False
+            entry["status"] = status
+            for k, v in extra.items():
+                if v is not None:
+                    entry[k] = v
+            if status in _TERMINAL and not req["complete"] and all(
+                t["status"] in _TERMINAL for t in req["tasks"].values()
+            ):
+                req["complete"] = True
+                return True, True
+            return True, False
+
+    def abort(self, reason: str) -> None:
+        """Fail every non-terminal task (gang restart: the children that
+        would have captured are gone; their control files are cleared at
+        relaunch). Unblocks the next ``start``."""
+        with self._lock:
+            self._abort_locked(reason)
+
+    def _abort_locked(self, reason: str) -> None:
+        req = self._req
+        if req is None or req["complete"]:
+            return
+        for entry in req["tasks"].values():
+            if entry["status"] not in _TERMINAL:
+                entry["status"] = FAILED
+                entry["error"] = reason
+        req["complete"] = True
+
+    def status(self, req_id: str = "") -> dict[str, Any] | None:
+        """Deep-copied view of the current/last request (RPC payload)."""
+        with self._lock:
+            req = self._req
+            if req is None or (req_id and req["req_id"] != req_id):
+                return None
+            return {
+                **{k: v for k, v in req.items() if k != "tasks"},
+                "tasks": {tid: dict(e) for tid, e in req["tasks"].items()},
+            }
+
+
+# ---------------------------------------------------------- executor side
+class ProfileCourier:
+    """Executor-side relay: control file out, done file in, status back.
+
+    Driven from the heartbeat loop: ``handle(piggyback)`` is called with the
+    ``profile`` field of each heartbeat response (or None). The executor's
+    final sweep after child exit calls ``handle(None, ...)`` from the main
+    thread — possibly concurrent with the heartbeat iteration already in
+    flight when ``_stop`` was set — so ``handle`` is atomic under an internal
+    lock (one caller reports a done record; the other sees it already
+    cleared)."""
+
+    def __init__(self, staging_dir: str, job_name: str, index: int,
+                 report: Callable[..., Any]):
+        self.staging_dir = staging_dir
+        self.job_name = job_name
+        self.index = index
+        #: report(req_id=..., status=..., **extra) → AM (exceptions are the
+        #: caller's problem; the heartbeat loop already tolerates RPC churn)
+        self._report = report
+        self._lock = threading.Lock()
+        self._outstanding: dict[str, str] | None = None  # req being captured
+        self._reported: set[str] = set()                 # req_ids fully reported
+
+    def artifact_dir(self, req_id: str) -> str:
+        return os.path.join(
+            self.staging_dir, "profile", f"{self.job_name}_{self.index}", req_id
+        )
+
+    def handle(self, piggyback: Mapping[str, Any] | None,
+               metrics_path: str | None) -> None:
+        with self._lock:
+            if self._outstanding is not None:
+                self._check_done_locked()
+            if not piggyback or not metrics_path:
+                return  # nothing requested, or the child is not launched yet
+            req_id = str(piggyback.get("req_id") or "")
+            if (
+                not req_id
+                or req_id in self._reported
+                or (self._outstanding is not None and self._outstanding["req_id"] == req_id)
+            ):
+                return
+            out_dir = self.artifact_dir(req_id)
+            write_json_atomic(metrics_path + CONTROL_SUFFIX, {
+                "req_id": req_id,
+                "num_steps": int(piggyback.get("num_steps", 5) or 5),
+                "memory": bool(piggyback.get("memory")),
+                "dir": out_dir,
+            })
+            self._outstanding = {
+                "req_id": req_id,
+                "done": metrics_path + DONE_SUFFIX,
+                "dir": out_dir,
+            }
+            self._report(req_id=req_id, status=DELIVERED)
+
+    def _check_done_locked(self) -> None:
+        assert self._outstanding is not None
+        done = read_json(self._outstanding["done"])
+        if done is None or done.get("req_id") != self._outstanding["req_id"]:
+            return
+        req_id = self._outstanding["req_id"]
+        self._report(
+            req_id=req_id,
+            status=CAPTURED if done.get("ok") else FAILED,
+            dir=done.get("dir") or self._outstanding["dir"],
+            artifacts=done.get("artifacts") or [],
+            summary={
+                k: done.get(k)
+                for k in ("steps_captured", "step_times_ms", "truncated")
+                if done.get(k) is not None
+            },
+            error=done.get("error") or "",
+        )
+        self._reported.add(req_id)
+        self._outstanding = None
+
+
+# ------------------------------------------------------- `tony top` rows
+def metric_value(snapshot: list[dict[str, Any]] | None, name: str) -> float | None:
+    """First sample value of a counter/gauge in a registry snapshot."""
+    for m in snapshot or []:
+        if m.get("name") == name:
+            for s in m.get("samples", []):
+                if "value" in s:
+                    return float(s["value"])
+    return None
+
+
+def histogram_stats(snapshot: list[dict[str, Any]] | None,
+                    name: str) -> tuple[int, float] | None:
+    """Summed ``(count, sum)`` across a histogram's label children."""
+    for m in snapshot or []:
+        if m.get("name") == name and m.get("type") == "histogram":
+            count, total = 0, 0.0
+            for s in m.get("samples", []):
+                count += int(s.get("count", 0))
+                total += float(s.get("sum", 0.0))
+            return (count, total) if count else None
+    return None
+
+
+def step_stats_by_task(infos: list[dict[str, Any]],
+                       task_obs: Mapping[str, Any]) -> dict[str, tuple[int, float]]:
+    """Per-task cumulative ``(count, sum)`` of ``tony_train_step_seconds`` —
+    the state a refreshing caller keeps between frames so
+    :func:`build_top_rows` can turn the cumulative histogram into a live
+    rate."""
+    out: dict[str, tuple[int, float]] = {}
+    for t in infos:
+        tid = f"{t['name']}:{t['index']}"
+        stats = histogram_stats(task_obs.get(tid), "tony_train_step_seconds")
+        if stats is not None:
+            out[tid] = stats
+    return out
+
+
+def build_top_rows(infos: list[dict[str, Any]],
+                   task_obs: Mapping[str, Any],
+                   now_ms: float | None = None,
+                   prev_step_stats: Mapping[str, tuple[int, float]] | None = None,
+                   ) -> list[dict[str, Any]]:
+    """One display row per task, synthesized from ``get_task_infos`` and the
+    per-task registry snapshots of ``get_metrics``.
+
+    - ``steps_per_s``: from the piggybacked ``tony_train_step_seconds``
+      histogram. With ``prev_step_stats`` (the previous frame's
+      :func:`step_stats_by_task`) the rate is the delta between snapshots —
+      genuinely live, so a job that slows down shows the slowdown; on the
+      first frame (or ``--once``) it falls back to the lifetime average;
+    - ``queue_depth`` / ``ttft_s``: serve-replica instruments when present;
+    - ``hb_age_s``: seconds since the last executor heartbeat.
+    """
+    now_ms = time.time() * 1000.0 if now_ms is None else now_ms
+    rows: list[dict[str, Any]] = []
+    for t in infos:
+        tid = f"{t['name']}:{t['index']}"
+        train = (t.get("metrics") or {}).get("train") or {}
+        obs = task_obs.get(tid)
+        row: dict[str, Any] = {
+            "task": tid,
+            "state": t.get("status", "?"),
+            "step": train.get("step"),
+            "loss": train.get("loss"),
+            "tokens_per_s": train.get("tokens_per_sec", train.get("tokens_per_s")),
+            "mfu": train.get("mfu"),
+            "steps_per_s": None,
+            "queue_depth": metric_value(obs, "tony_serve_queue_depth"),
+            "ttft_s": None,
+            "hb_age_s": None,
+        }
+        stats = histogram_stats(obs, "tony_train_step_seconds")
+        if stats is not None:
+            prev = (prev_step_stats or {}).get(tid)
+            if prev is not None and stats[0] >= prev[0]:
+                dcount, dsum = stats[0] - prev[0], stats[1] - prev[1]
+                # no new steps since the last frame IS the live answer: 0
+                row["steps_per_s"] = dcount / dsum if dsum > 0 else 0.0
+            elif stats[1] > 0:
+                row["steps_per_s"] = stats[0] / stats[1]
+        ttft = histogram_stats(obs, "tony_serve_ttft_seconds")
+        if ttft is not None and ttft[0] > 0:
+            row["ttft_s"] = ttft[1] / ttft[0]
+        hb = t.get("last_heartbeat_ms") or 0
+        if hb:
+            row["hb_age_s"] = max(now_ms - float(hb), 0.0) / 1000.0
+        rows.append(row)
+    return rows

@@ -1,0 +1,396 @@
+"""Structured per-process logging for the control plane and training child.
+
+The other half of crash forensics after tracing (obs/trace.py): every job
+process — submitting client, AM, each executor, each training child — owns
+one process-global :class:`JsonLogger` that appends one JSON object per
+record to ``<staging>/logs/<identity>.log.jsonl``. ``tony logs <app_id>``
+(cli/introspect.py) merges and tails those files in timestamp order, so a
+dead gang's story is one command instead of a per-file scavenger hunt.
+
+Records carry correlation for free: the process identity, the gang restart
+``epoch``, and — when tracing is on — the ``span`` id currently open on the
+logging thread, so a log line can be placed on the ``tony trace`` timeline.
+
+The module-level helpers (:func:`debug` … :func:`error`) are the library's
+print replacement. Contract:
+
+- **below the active level is free**: the level compare happens before any
+  record dict, JSON, or I/O exists (``debug()`` at the default ``info``
+  level allocates nothing — asserted by tests/test_introspect.py);
+- **at or above the level**, the record is written to the JSONL sink (when
+  a logger is installed) AND echoed human-readably to stdout (stderr for
+  warning/error), so container-captured logs and CLI output look exactly
+  like the ``print`` calls they replaced;
+- with **no logger installed** (library use outside a tony container) the
+  helpers degrade to the echo alone.
+
+A stdlib ``logging`` bridge forwards third-party records into the same sink
+(no echo — stdlib handlers already own the console).
+"""
+
+from __future__ import annotations
+
+import json
+import logging as _stdlib_logging
+import os
+import sys
+import threading
+import time
+from typing import Any, Iterator, Mapping
+
+from tony_tpu import constants
+from tony_tpu.obs import trace as _trace
+
+DEBUG, INFO, WARNING, ERROR = 10, 20, 30, 40
+OFF = 100  # above every level: the sink writes nothing
+
+_LEVEL_NAMES = {DEBUG: "debug", INFO: "info", WARNING: "warning", ERROR: "error"}
+_LEVELS_BY_NAME = {v: k for k, v in _LEVEL_NAMES.items()}
+_LEVELS_BY_NAME["off"] = OFF
+
+#: record keys the logger owns; extra fields never shadow them
+_RESERVED = frozenset({"ts_ms", "level", "identity", "msg", "epoch", "span"})
+
+_logger: "JsonLogger | None" = None
+#: echo threshold when no logger is installed (library use outside tony)
+_DEFAULT_LEVEL = INFO
+
+LOG_SUFFIX = ".log.jsonl"
+
+
+def level_from_name(name: str | None, default: int = INFO) -> int:
+    return _LEVELS_BY_NAME.get((name or "").strip().lower(), default)
+
+
+def get() -> "JsonLogger | None":
+    """The process-global logger, or None (echo-only fallback)."""
+    return _logger
+
+
+def _safe_identity(identity: str) -> str:
+    return identity.replace(":", "_").replace(os.sep, "_")
+
+
+class JsonLogger:
+    """Per-process JSONL sink (one file per process identity).
+
+    Line-buffered append like the span sink: an ``os._exit`` or SIGKILL
+    loses at most the record being formatted. Restart attempts of the same
+    identity append to the same file; the gang epoch rides in each record.
+    """
+
+    def __init__(self, identity: str, log_dir: str, level: int = INFO,
+                 epoch: int = 0, echo: bool = True):
+        self.identity = identity
+        self.level = level
+        #: gang restart attempt stamped on every record (the AM bumps its
+        #: own on each whole-gang restart)
+        self.epoch = epoch
+        self.echo = echo
+        self.log_dir = log_dir
+        self._lock = threading.Lock()
+        os.makedirs(log_dir, exist_ok=True)
+        self.path = os.path.join(log_dir, _safe_identity(identity) + LOG_SUFFIX)
+        self._file = open(self.path, "a", buffering=1)
+
+    def log(self, level: int, msg: str, fields: Mapping[str, Any] | None = None) -> None:
+        if level < self.level:
+            return
+        self._emit(level, msg, fields)
+
+    def _emit(self, level: int, msg: str, fields: Mapping[str, Any] | None) -> None:
+        rec: dict[str, Any] = {
+            "ts_ms": round(time.time() * 1000.0, 3),
+            "level": _LEVEL_NAMES.get(level, str(level)),
+            "identity": self.identity,
+            "msg": str(msg),
+        }
+        if self.epoch:
+            rec["epoch"] = self.epoch
+        span = _trace.current_span()
+        if span is not None:
+            rec["span"] = span.span_id
+        if fields:
+            for k, v in fields.items():
+                if k not in _RESERVED:
+                    rec[k] = v
+        try:
+            line = json.dumps(rec, default=str)
+        except (TypeError, ValueError):
+            return  # a log record must never take the process down
+        with self._lock:
+            try:
+                self._file.write(line + "\n")
+            except (OSError, ValueError):
+                # disk full / IO error / closed mid-teardown: logging is
+                # best-effort by contract and must never take the process down
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+
+
+# ------------------------------------------------------------- module API
+def _log(level: int, msg: str, fields: dict[str, Any]) -> None:
+    # The echo threshold is FIXED at info: console behavior is always
+    # exactly the print calls these helpers replaced, regardless of
+    # ``tony.log.level`` — that knob governs only the JSONL sink. (A
+    # level=error job still prints its submit/monitor lines; a level=debug
+    # job does not spam the console with sink-only debug records.)
+    lg = _logger
+    sink = lg is not None and level >= lg.level
+    echo = level >= _DEFAULT_LEVEL and (lg is None or lg.echo)
+    if not sink and not echo:
+        return  # the free path: sub-threshold calls build nothing
+    if sink:
+        lg._emit(level, msg, fields)
+    if echo:
+        stream = sys.stdout if level < WARNING else sys.stderr
+        print(msg, file=stream, flush=True)  # lint: disable=print-discipline — the echo sink IS the logger
+
+
+def debug(msg: str, **fields: Any) -> None:
+    _log(DEBUG, msg, fields)
+
+
+def info(msg: str, **fields: Any) -> None:
+    _log(INFO, msg, fields)
+
+
+def warning(msg: str, **fields: Any) -> None:
+    _log(WARNING, msg, fields)
+
+
+def error(msg: str, **fields: Any) -> None:
+    _log(ERROR, msg, fields)
+
+
+# ---------------------------------------------------------- stdlib bridge
+class _StdlibBridge(_stdlib_logging.Handler):
+    """Forwards stdlib-logging records into the tony sink (no echo: stdlib
+    handlers already own the console for those records)."""
+
+    def emit(self, record: _stdlib_logging.LogRecord) -> None:
+        lg = _logger
+        if lg is None:
+            return
+        level = (record.levelno // 10) * 10
+        level = min(max(level, DEBUG), ERROR)
+        if level < lg.level:
+            return
+        try:
+            lg._emit(level, record.getMessage(), {"logger": record.name})
+        except Exception:  # noqa: BLE001 — logging must never raise into user code
+            pass
+
+
+_bridge: _StdlibBridge | None = None
+
+
+def _install_bridge() -> None:
+    global _bridge
+    if _bridge is None:
+        _bridge = _StdlibBridge()
+        _stdlib_logging.getLogger().addHandler(_bridge)
+
+
+def _remove_bridge() -> None:
+    global _bridge
+    if _bridge is not None:
+        _stdlib_logging.getLogger().removeHandler(_bridge)
+        _bridge = None
+
+
+# -------------------------------------------------------------- factories
+def init_logging(identity: str, log_dir: str, level: int = INFO,
+                 epoch: int = 0, echo: bool = True) -> JsonLogger:
+    """Install the process-global logger (replacing any previous one) and
+    the stdlib bridge."""
+    global _logger
+    if _logger is not None:
+        _logger.close()
+    _logger = JsonLogger(identity, log_dir, level=level, epoch=epoch, echo=echo)
+    _install_bridge()
+    return _logger
+
+
+def init_from_config(config, identity: str, staging_dir: str,
+                     epoch: int = 0) -> JsonLogger | None:
+    """Control-plane processes (client, AM, executor): sink + level from the
+    frozen job config. ``tony.log.level=off`` skips the sink entirely (the
+    echo fallback keeps console output identical)."""
+    from tony_tpu.config import keys
+
+    level = level_from_name(config.get(keys.LOG_LEVEL))
+    if level >= OFF:
+        return None
+    log_dir = config.get(keys.LOG_DIR) or os.path.join(staging_dir, "logs")
+    return init_logging(identity, log_dir, level=level, epoch=epoch)
+
+
+def init_from_env(env: Mapping[str, str] | None = None,
+                  role: str = "train") -> JsonLogger | None:
+    """The executor-launched child's contract: the executor exports
+    TONY_LOG_DIR / TONY_LOG_LEVEL. None — and echo-only behavior — otherwise
+    (also the library path outside a tony container). ``role`` is the
+    identity suffix distinguishing co-scheduled child kinds in the aggregate
+    (the training loop keeps the default; a serve engine passes "serve")."""
+    env = os.environ if env is None else env
+    log_dir = env.get(constants.ENV_LOG_DIR, "")
+    if not log_dir:
+        return None
+    level = level_from_name(env.get(constants.ENV_LOG_LEVEL))
+    if level >= OFF:
+        return None
+    job = env.get(constants.ENV_JOB_NAME)
+    idx = env.get(constants.ENV_TASK_INDEX)
+    identity = f"{job}:{idx}:{role}" if job and idx is not None else "proc"
+    epoch = int(env.get("TONY_RESTART_ATTEMPT", "0") or 0)
+    return init_logging(identity, log_dir, level=level, epoch=epoch)
+
+
+def shutdown() -> None:
+    """Close and uninstall the process-global logger (idempotent)."""
+    global _logger
+    _remove_bridge()
+    if _logger is not None:
+        _logger.close()
+        _logger = None
+
+
+# ------------------------------------------------------------ aggregation
+def resolve_log_dir(staging: str, app_id: str) -> str:
+    """Where the job's aggregate lives: the ``tony.log.dir`` override from
+    its frozen config when set, else ``<staging>/<app_id>/logs``. Shared by
+    every reader surface (`tony logs`, the portal pages) so they never
+    disagree with the writers."""
+    conf_path = os.path.join(staging, app_id, constants.TONY_FINAL_CONF)
+    try:
+        from tony_tpu.config import TonyConfig, keys
+
+        override = TonyConfig.load_final(conf_path).get(keys.LOG_DIR)
+    except (OSError, ValueError):
+        override = None
+    return override or os.path.join(staging, app_id, "logs")
+
+
+def read_records(log_dir: str) -> list[dict[str, Any]]:
+    """Every record from every ``*.log.jsonl`` under ``log_dir``, merged and
+    sorted by timestamp. Malformed lines (a process killed mid-write) are
+    skipped — same tolerance as the span reader."""
+    records: list[dict[str, Any]] = []
+    if not os.path.isdir(log_dir):
+        return records
+    for fn in sorted(os.listdir(log_dir)):
+        if not fn.endswith(LOG_SUFFIX):
+            continue
+        with open(os.path.join(log_dir, fn), errors="replace") as f:
+            for line in f:
+                rec = _parse_record(line)
+                if rec is not None:
+                    records.append(rec)
+    records.sort(key=lambda r: r.get("ts_ms", 0.0))
+    return records
+
+
+def tail_records(log_dir: str, limit: int = 500,
+                 max_bytes_per_file: int = 1 << 20) -> list[dict[str, Any]]:
+    """The newest ``limit`` records across the aggregate, reading at most
+    ``max_bytes_per_file`` from the tail of each file — bounded work however
+    large a long-running job's logs grow (the portal pages use this;
+    ``tony logs`` without ``-f`` still reads everything by design)."""
+    records: list[dict[str, Any]] = []
+    if not os.path.isdir(log_dir):
+        return records
+    for fn in sorted(os.listdir(log_dir)):
+        if not fn.endswith(LOG_SUFFIX):
+            continue
+        path = os.path.join(log_dir, fn)
+        try:
+            size = os.path.getsize(path)
+            with open(path, errors="replace") as f:
+                if size > max_bytes_per_file:
+                    f.seek(size - max_bytes_per_file)
+                    f.readline()  # drop the partial line the seek landed in
+                lines = f.readlines()
+        except OSError:
+            continue
+        parsed = (_parse_record(line) for line in lines[-limit:])
+        records.extend(r for r in parsed if r is not None)
+    records.sort(key=lambda r: r.get("ts_ms", 0.0))
+    return records[-limit:] if limit else records
+
+
+def _parse_record(line: str) -> dict[str, Any] | None:
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        d = json.loads(line)
+    except ValueError:
+        return None
+    return d if isinstance(d, dict) and "msg" in d else None
+
+
+class LogFollower:
+    """Incremental reader for ``tony logs -f``: remembers per-file offsets,
+    discovers files that appear later (a restarted task's first record), and
+    yields each poll's new records sorted by timestamp."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._offsets: dict[str, int] = {}
+        self._partial: dict[str, str] = {}
+
+    def poll(self) -> list[dict[str, Any]]:
+        records: list[dict[str, Any]] = []
+        if not os.path.isdir(self.log_dir):
+            return records
+        for fn in sorted(os.listdir(self.log_dir)):
+            if not fn.endswith(LOG_SUFFIX):
+                continue
+            path = os.path.join(self.log_dir, fn)
+            try:
+                with open(path, errors="replace") as f:
+                    f.seek(self._offsets.get(fn, 0))
+                    chunk = f.read()
+                    self._offsets[fn] = f.tell()
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            buf = self._partial.pop(fn, "") + chunk
+            lines = buf.split("\n")
+            if buf and not buf.endswith("\n"):
+                self._partial[fn] = lines.pop()  # torn tail: wait for the rest
+            else:
+                lines = lines[:-1] if lines and lines[-1] == "" else lines
+            for line in lines:
+                rec = _parse_record(line)
+                if rec is not None:
+                    records.append(rec)
+        records.sort(key=lambda r: r.get("ts_ms", 0.0))
+        return records
+
+
+def format_record(rec: Mapping[str, Any]) -> str:
+    """One human line: ``HH:MM:SS.mmm [identity] LEVEL msg k=v ...``."""
+    ts_ms = float(rec.get("ts_ms", 0.0))
+    hhmmss = time.strftime("%H:%M:%S", time.localtime(ts_ms / 1000.0))
+    frac = int(ts_ms % 1000)
+    extras = " ".join(
+        f"{k}={v}" for k, v in rec.items() if k not in _RESERVED
+    )
+    level = str(rec.get("level", "info")).upper()
+    line = (f"{hhmmss}.{frac:03d} [{rec.get('identity', '?')}] "
+            f"{level:<7s} {rec.get('msg', '')}")
+    return f"{line}  {extras}" if extras else line
+
+
+def iter_formatted(records: list[dict[str, Any]]) -> Iterator[str]:
+    for rec in records:
+        yield format_record(rec)

@@ -33,7 +33,14 @@ from urllib.parse import urlparse
 from tony_tpu import constants
 from tony_tpu.cluster import history
 from tony_tpu.cluster.events import Event
+from tony_tpu.obs import logging as obs_logging
+from tony_tpu.obs import metrics as obs_metrics
 from tony_tpu.obs.metrics import REGISTRY, render_merged
+
+_SCRAPE_FAILURES = obs_metrics.counter(
+    "tony_portal_scrape_failures_total",
+    "running-AM get_metrics scrapes that failed (the app is skipped, the "
+    "exposition survives)", labelnames=("app",))
 
 _STYLE = """
 body{font-family:system-ui,sans-serif;margin:2em;color:#222}
@@ -116,8 +123,24 @@ class PortalHandler(BaseHTTPRequestHandler):
                 app_id = parts[2]
                 if len(parts) > 3 and parts[3] == "config":
                     self._send(self._job_config(app_id))
+                elif len(parts) > 3 and parts[3] == "logs":
+                    self._send(self._job_logs(app_id))
+                elif len(parts) > 3 and parts[3] == "profile":
+                    self._send(self._job_profile(app_id))
                 else:
                     self._send(self._job_detail(app_id))
+            elif path.startswith("/api/logs/"):
+                app_id = path.split("/")[3]
+                self._send(
+                    json.dumps(self._log_records(app_id)).encode(),
+                    ctype="application/json",
+                )
+            elif path.startswith("/api/profile/"):
+                app_id = path.split("/")[3]
+                self._send(
+                    json.dumps(self._profile_listing(app_id)).encode(),
+                    ctype="application/json",
+                )
             elif path == "/api/jobs":
                 jobs = [vars(j) for j in history.list_finished_jobs(self.history_root)]
                 jobs += [
@@ -161,10 +184,11 @@ class PortalHandler(BaseHTTPRequestHandler):
 
     def _metrics_text(self) -> str:
         """Merged Prometheus exposition: own registry (no extra labels) +
-        each running AM's snapshot under app=<id>. AMs that vanish between
-        the listing and the call are skipped (best-effort, like every other
-        live view here)."""
-        groups = [(REGISTRY.snapshot(), {})]
+        each running AM's snapshot under app=<id>. An AM that dies between
+        the listing and the call degrades to skipping that app — counted in
+        ``tony_portal_scrape_failures_total{app=...}`` — never to failing
+        the whole exposition."""
+        groups: list = []
         for app_id in self._running_ids():
             cli = self._am_client(app_id)
             if cli is None:
@@ -175,9 +199,12 @@ class PortalHandler(BaseHTTPRequestHandler):
                 for task_id, tsnap in (snap.get("tasks") or {}).items():
                     groups.append((tsnap, {"app": app_id, "task": task_id}))
             except Exception:  # noqa: BLE001 — AM may have just exited
-                pass
+                _SCRAPE_FAILURES.inc(app=app_id)
             finally:
                 cli.close()
+        # own registry snapshotted AFTER the scrape loop, so a failure
+        # counted just above is visible in THIS exposition, not the next
+        groups.insert(0, (REGISTRY.snapshot(), {}))
         return render_merged(groups)
 
     def _pool_status(self):
@@ -196,7 +223,67 @@ class PortalHandler(BaseHTTPRequestHandler):
         except Exception:  # noqa: BLE001 — pool may be down; render that
             return None
 
+    def _log_records(self, app_id: str) -> list[dict]:
+        """The newest records of the job's merged structured-log aggregate
+        (obs/logging.py JSONL; honors the job's tony.log.dir override like
+        `tony logs`). Tail-bounded so a huge debug-level aggregate can't
+        stall the single-threaded portal on every page hit."""
+        if not self.staging_root:
+            return []
+        return obs_logging.tail_records(
+            obs_logging.resolve_log_dir(self.staging_root, app_id), limit=500
+        )
+
+    def _profile_listing(self, app_id: str) -> list[dict]:
+        """Profiler artifacts under <staging>/<app_id>/profile, flattened to
+        {path (relative), size} entries — both the submit-time window's and
+        on-demand captures'."""
+        if not self.staging_root:
+            return []
+        root = os.path.join(self.staging_root, app_id, "profile")
+        out = []
+        for dirpath, _, files in os.walk(root):
+            for fn in sorted(files):
+                full = os.path.join(dirpath, fn)
+                try:
+                    size = os.path.getsize(full)
+                except OSError:
+                    continue
+                out.append({"path": os.path.relpath(full, root), "size": size})
+        out.sort(key=lambda e: e["path"])
+        return out
+
     # -- pages --------------------------------------------------------------
+
+    def _job_logs(self, app_id: str) -> bytes:
+        records = self._log_records(app_id)
+        if not records:
+            return _page(f"{app_id} logs",
+                         "<p>no structured logs (tony.log.level=off, or the "
+                         "job predates the aggregate)</p>")
+        body = (
+            f"<p>newest {len(records)} record(s) "
+            f'(<a href="/api/logs/{html.escape(app_id)}">json</a>)</p><pre>'
+            + "\n".join(html.escape(line)
+                        for line in obs_logging.iter_formatted(records))
+            + "</pre>"
+        )
+        return _page(f"{app_id} logs", body)
+
+    def _job_profile(self, app_id: str) -> bytes:
+        entries = self._profile_listing(app_id)
+        if not entries:
+            return _page(f"{app_id} profile",
+                         "<p>no profiler artifacts (run <code>tony profile "
+                         f"{html.escape(app_id)}</code> against the live job)</p>")
+        rows = "".join(
+            f"<tr><td>{html.escape(e['path'])}</td><td>{e['size']}</td></tr>"
+            for e in entries
+        )
+        body = ("<table><tr><th>artifact</th><th>bytes</th></tr>" + rows
+                + "</table><p>view with TensorBoard's profile plugin "
+                "pointed at the capture directory</p>")
+        return _page(f"{app_id} profile", body)
 
     def _job_list(self) -> bytes:
         sections = []
@@ -309,6 +396,8 @@ class PortalHandler(BaseHTTPRequestHandler):
         )
         body = (
             f'<p><a href="/job/{app_id}/config">frozen config</a>'
+            f' · <a href="/job/{app_id}/logs">logs</a>'
+            f' · <a href="/job/{app_id}/profile">profile artifacts</a>'
             + (" · <b>LIVE</b>" if live else "")
             + "</p>"
             + tasks_html
@@ -399,8 +488,8 @@ def main(argv: list[str] | None = None) -> int:
     root = args.root or os.path.join(constants.default_tony_root(), "history")
     staging = args.staging or os.path.dirname(root.rstrip("/"))
     server = serve(root, args.port, staging, args.pool)
-    print(f"[tony-portal] serving {root} on http://0.0.0.0:{args.port}"
-          + (f" (pool {args.pool})" if args.pool else ""))
+    obs_logging.info(f"[tony-portal] serving {root} on http://0.0.0.0:{args.port}"
+                     + (f" (pool {args.pool})" if args.pool else ""))
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -10,12 +10,12 @@ latest-step restore with the target sharding applied on load.
 from __future__ import annotations
 
 import os
-import sys
 import time
 from typing import Any
 
 import jax
 
+from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
 from tony_tpu.obs import trace as obs_trace
 
@@ -124,11 +124,10 @@ def restore_or_init(
         try:
             return mgr.restore(state, step=step), mgr, int(step)
         except Exception as e:  # noqa: BLE001 — any torn artifact must fall back, not crash
-            print(
+            obs_logging.warning(
                 f"[ckpt] restore of step {step} failed ({type(e).__name__}: {e}); "
                 f"quarantining it and falling back to the previous step",
-                file=sys.stderr,
-                flush=True,
+                step=int(step),
             )
             mgr.close()
             _quarantine_step(ckpt_dir, int(step))

@@ -19,6 +19,7 @@ from dataclasses import dataclass
 import jax
 
 from tony_tpu import constants
+from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
 from tony_tpu.obs import trace as obs_trace
 from tony_tpu.parallel import MeshSpec
@@ -122,6 +123,9 @@ def run_lm_training(model_module, model_cfg, loop: LoopConfig) -> dict:
     """
     if os.environ.get(constants.ENV_METRICS_ENABLED) == "0":
         obs_metrics.set_enabled(False)  # the job opted out (tony.metrics.enabled)
+    # structured logging (tony.log.*): this child's records join the job-wide
+    # <staging>/logs aggregate; outside a container the helpers echo only
+    obs_logging.init_from_env()
     tracer = obs_trace.init_from_env()
     if tracer is None:
         return _run_lm_training(model_module, model_cfg, loop, None)
@@ -181,7 +185,7 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
 
     state, ckpt_mgr, start_step = restore_or_init(loop.checkpoint_dir or None, init_state)
     if start_step:
-        print(f"[train] resumed from checkpoint step {start_step}", flush=True)
+        obs_logging.info(f"[train] resumed from checkpoint step {start_step}", step=start_step)
 
     if loop.stage_axis > 1:
         # pipeline parallelism: the 1F1B schedule produces its own gradients
@@ -241,8 +245,8 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
             shard_id=jax.process_index(), num_shards=procs,
             seed=loop.data_seed, start_index=start_step,
         )
-        print(f"[train] data: {len(paths)} shards, {loader.total_tokens} tokens, "
-              f"native={loader.is_native}", flush=True)
+        obs_logging.info(f"[train] data: {len(paths)} shards, {loader.total_tokens} tokens, "
+                         f"native={loader.is_native}")
 
     assemble = None
     if procs > 1:
@@ -311,7 +315,7 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
                     "mfu": round(report["mfu"], 4),
                     "time": time.strftime("%H:%M:%S"),
                 }
-                print(json.dumps(line), flush=True)
+                obs_logging.info(json.dumps(line), **line)
                 _drop_train_metrics(line)
                 n_window = step + 1 - window_step0
                 if n_window > 0:
@@ -367,10 +371,9 @@ def parse_loop_args(argv: list[str] | None = None) -> tuple[LoopConfig, dict]:
     except ValueError:
         # a malformed tony.checkpoint.interval-steps must not crash every
         # worker at argparse-construction time; fall back to "final only"
-        print(
+        obs_logging.warning(
             f"[train] ignoring non-integer {constants.ENV_CHECKPOINT_INTERVAL}="
-            f"{os.environ[constants.ENV_CHECKPOINT_INTERVAL]!r}",
-            file=sys.stderr,
+            f"{os.environ[constants.ENV_CHECKPOINT_INTERVAL]!r}"
         )
         env_interval = 0
     p.add_argument("--checkpoint_every", type=int, default=env_interval)

@@ -2,32 +2,60 @@
 
 The reference's only profiling story is scheduling a ``tensorboard`` task and
 registering its URL (SURVEY.md §5.1); trace capture itself lived inside the
-user's TF. Here the framework owns it: when a job is submitted with
-``tony.task.profile=true``, each executor exports ``TONY_PROFILE_DIR`` and the
-training loop captures a ``jax.profiler`` trace for a step window into that
-directory — viewable with TensorBoard's profile plugin (including via the
-``tensorboard`` sidecar task type, whose URL the AM registers).
+user's TF. Here the framework owns it, two ways:
+
+- **Submit-time window** (``tony.task.profile=true``): each executor exports
+  ``TONY_PROFILE_DIR`` and the training loop captures a ``jax.profiler``
+  trace for a fixed step window into that directory.
+- **On-demand** (``tony profile <app_id>``, docs/observability.md): a RUNNING
+  job is asked to capture with no resubmit. The executor relays the request
+  by writing a control file next to ``<train-metrics-file>`` (the established
+  piggyback contract; obs/introspect.py); :class:`StepProfiler` polls for it
+  at step boundaries — a time-throttled ``stat``, nothing allocated while
+  unarmed — arms at the next boundary, captures N steps (plus an optional
+  device memory profile), records per-step wall times, and drops a done file
+  the executor reports back through the AM.
+
+Artifacts are TensorBoard-profile-plugin viewable either way (including via
+the ``tensorboard`` sidecar task type, whose URL the AM registers).
 """
 
 from __future__ import annotations
 
+import json
 import os
+import time
 
-ENV_PROFILE_DIR = "TONY_PROFILE_DIR"
-ENV_PROFILE_START_STEP = "TONY_PROFILE_START_STEP"
-ENV_PROFILE_NUM_STEPS = "TONY_PROFILE_NUM_STEPS"
+from tony_tpu import constants
+from tony_tpu.obs import introspect as _introspect
+from tony_tpu.obs import trace as obs_trace
+
+#: the env names are defined in constants so the executor supervisor can
+#: export them without importing this package (and with it jax)
+ENV_PROFILE_DIR = constants.ENV_PROFILE_DIR
+ENV_PROFILE_START_STEP = constants.ENV_PROFILE_START_STEP
+ENV_PROFILE_NUM_STEPS = constants.ENV_PROFILE_NUM_STEPS
+ENV_PROFILE_POLL_MS = constants.ENV_PROFILE_POLL_MS
 
 
 class StepProfiler:
-    """Captures a ``jax.profiler`` trace over a window of training steps.
+    """Captures ``jax.profiler`` traces over windows of training steps.
 
     Driven from env (the executor↔user-process contract) so any training
     program run under tony profiles without code changes beyond calling
     ``step()`` once per iteration — the framework's own loop does.
 
-    Window semantics: trace starts when ``step() `` is called with
+    Static window semantics: trace starts when ``step()`` is called with
     ``step == start_step`` and stops ``num_steps`` steps later (default:
     start at 3 — past compile — for 5 steps).
+
+    On-demand semantics: when a control file appears next to the
+    train-metrics drop, the capture arms at the next step boundary, runs for
+    the requested number of steps (wall-timing each), then finalizes into the
+    requested artifact directory and writes the done record. ``stop()`` —
+    called from the train-loop ``finally`` — finalizes a capture the run
+    ended inside of, so the trace file is never left unterminated and the
+    done record always lands (marked ``truncated``).
     """
 
     def __init__(self, env: dict[str, str] | None = None):
@@ -37,6 +65,22 @@ class StepProfiler:
         self.num_steps = int(env.get(ENV_PROFILE_NUM_STEPS, "5"))
         self.active = False
         self.done = False
+        # on-demand plane: armed only inside a tony container (the executor
+        # exported the train-metrics path the control file sits next to)
+        metrics_path = env.get(constants.ENV_TRAIN_METRICS_FILE) or ""
+        self.control_path = metrics_path + _introspect.CONTROL_SUFFIX if metrics_path else ""
+        self.done_path = metrics_path + _introspect.DONE_SUFFIX if metrics_path else ""
+        try:
+            poll_ms = float(env.get(ENV_PROFILE_POLL_MS, "500") or "500")
+        except ValueError:
+            poll_ms = 500.0
+        self._poll_s = max(poll_ms, 1.0) / 1000.0
+        self._next_poll = 0.0
+        self._request: dict | None = None   # the armed on-demand capture
+        self._handled: set[str] = set()     # req_ids already acted on
+        self._step_times_ms: list[float] = []
+        self._last_step_t = 0.0
+        self._span = None                   # (Span, token) while capturing
 
     @property
     def enabled(self) -> bool:
@@ -44,13 +88,21 @@ class StepProfiler:
 
     def step(self, step: int) -> None:
         """Call once per training step (before or after the step body)."""
+        if self._request is not None:
+            self._on_demand_step(step)
+        elif self.control_path:
+            now = time.monotonic()
+            if now >= self._next_poll:
+                self._next_poll = now + self._poll_s
+                self._maybe_arm(step)
         if not self.enabled or self.done:
             return
-        if not self.active and step >= self.start_step:
+        if not self.active and self._request is None and step >= self.start_step:
             self._start()
         elif self.active and step >= self.start_step + self.num_steps:
             self.stop()
 
+    # -- static window -----------------------------------------------------
     def _start(self) -> None:
         import jax
 
@@ -59,7 +111,11 @@ class StepProfiler:
         self.active = True
 
     def stop(self) -> None:
-        """Idempotent; also the end-of-training flush for short runs."""
+        """Idempotent; also the end-of-training flush for short runs — and
+        for an on-demand capture the run ended inside of (the train-loop
+        ``finally`` calls this, so neither window leaks an open trace)."""
+        if self._request is not None:
+            self._finalize_on_demand(truncated=True)
         if not self.active:
             return
         import jax
@@ -67,3 +123,100 @@ class StepProfiler:
         jax.profiler.stop_trace()
         self.active = False
         self.done = True
+
+    # -- on-demand capture -------------------------------------------------
+    def _maybe_arm(self, step: int) -> None:
+        req = _introspect.read_json(self.control_path)
+        if req is None:
+            return
+        req_id = str(req.get("req_id") or "")
+        if not req_id or req_id in self._handled:
+            return
+        if self.active:
+            return  # a static window is live; retry once it closes
+        self._handled.add(req_id)
+        num_steps = max(int(req.get("num_steps", 5) or 5), 1)
+        out_dir = req.get("dir") or os.path.join(
+            os.path.dirname(self.control_path), "profile", req_id
+        )
+        try:
+            import jax
+
+            os.makedirs(out_dir, exist_ok=True)
+            jax.profiler.start_trace(out_dir)
+        except Exception as e:  # noqa: BLE001 — capture failure must not kill training
+            self._write_done(req_id, out_dir, ok=False,
+                             error=f"{type(e).__name__}: {e}")
+            return
+        self._request = {
+            "req_id": req_id,
+            "dir": out_dir,
+            "num_steps": num_steps,
+            "memory": bool(req.get("memory")),
+            "start_step": step,
+        }
+        self._step_times_ms = []
+        self._last_step_t = time.perf_counter()
+        tracer = obs_trace.get()
+        if tracer is not None:
+            span, token = tracer.start_span("profile.capture")
+            span.set(req_id=req_id, num_steps=num_steps)
+            self._span = (span, token)
+
+    def _on_demand_step(self, step: int) -> None:
+        now = time.perf_counter()
+        self._step_times_ms.append((now - self._last_step_t) * 1000.0)
+        self._last_step_t = now
+        req = self._request
+        assert req is not None
+        if step >= req["start_step"] + req["num_steps"]:
+            self._finalize_on_demand(truncated=False)
+
+    def _finalize_on_demand(self, truncated: bool) -> None:
+        req = self._request
+        if req is None:
+            return
+        self._request = None
+        error = ""
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            if req["memory"]:
+                jax.profiler.save_device_memory_profile(
+                    os.path.join(req["dir"], "memory.prof")
+                )
+        except Exception as e:  # noqa: BLE001 — capture failure must not kill training
+            error = f"{type(e).__name__}: {e}"
+        self._write_done(
+            req["req_id"], req["dir"],
+            ok=not error,
+            error=error,
+            steps_captured=len(self._step_times_ms),
+            step_times_ms=[round(t, 3) for t in self._step_times_ms],
+            truncated=truncated,
+        )
+        if self._span is not None:
+            span, token = self._span
+            self._span = None
+            span.set(truncated=truncated)
+            tracer = obs_trace.get()
+            if tracer is not None:
+                tracer.end_span(span, token, status="error" if error else "ok")
+
+    def _write_done(self, req_id: str, out_dir: str, ok: bool, error: str = "",
+                    **extra) -> None:
+        artifacts = []
+        for root, _, files in os.walk(out_dir):
+            for fn in files:
+                artifacts.append(
+                    os.path.relpath(os.path.join(root, fn), out_dir)
+                )
+        payload = {
+            "req_id": req_id, "ok": ok, "dir": out_dir,
+            "artifacts": sorted(artifacts), "error": error, **extra,
+        }
+        try:
+            _introspect.write_json_atomic(self.done_path, payload)
+        except OSError:
+            pass  # reporting is best-effort; the artifacts are on disk
