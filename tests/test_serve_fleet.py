@@ -662,10 +662,14 @@ class TestResizeJobtypeE2E:
 
             assert _wait(running_workers), "initial worker never ran"
 
-            r = rpc.call("resize_jobtype", job_name="nope", instances=2)
-            assert not r["ack"] and "unknown job type" in r["error"]
-            r = rpc.call("resize_jobtype", job_name="worker", instances=0)
-            assert not r["ack"]
+            from tony_tpu.cluster.rpc import RpcError
+
+            # invalid requests are the TYPED InvalidResizeError through the
+            # RPC error frame, not a generic {"ack": False} payload
+            with pytest.raises(RpcError, match="InvalidResizeError.*unknown job type"):
+                rpc.call("resize_jobtype", job_name="nope", instances=2)
+            with pytest.raises(RpcError, match="InvalidResizeError.*>= 1"):
+                rpc.call("resize_jobtype", job_name="worker", instances=0)
             r = rpc.call("resize_jobtype", job_name="worker", instances=1)
             assert r["ack"] and r.get("noop")
 

@@ -50,6 +50,7 @@ class ChaosContext:
         self.injected: list[dict[str, Any]] = []
         self._staging = staging_dir
         self._started = time.monotonic()
+        self._progress_step = 0  # highest training step reported (set_progress)
         self._lock = threading.Lock()
         self._rngs: dict[str, random.Random] = {}
         self._latched: set[str] = set()
@@ -95,6 +96,18 @@ class ChaosContext:
     def elapsed_ms(self) -> float:
         return (time.monotonic() - self._started) * 1000
 
+    def set_progress(self, step: int) -> None:
+        """Latest TRAINING step the job has reported (the AM feeds this from
+        the executors' pushed metrics each monitor tick). ``@step+N``-gated
+        faults stay unarmed until it reaches N — a "preempt K workers
+        mid-run" schedule then fires against real progress (after the step-N
+        checkpoint exists) instead of guessing a wall-clock delay. The step
+        counter only moves forward: a gang restart resetting the reported
+        step must not re-arm a gate that already opened."""
+        with self._lock:
+            if step > self._progress_step:
+                self._progress_step = step
+
     def take(self, kind: str, trigger: str | None = None, detail: dict[str, Any] | None = None) -> FaultSpec | None:
         """The single decision gate: the first armed fault of ``kind`` at this
         lifecycle point, or None. A returned fault has been recorded (and, for
@@ -115,6 +128,8 @@ class ChaosContext:
             return None
         with self._lock:
             if f.delay_ms and self.elapsed_ms() < f.delay_ms:
+                return None
+            if f.step_gate and self._progress_step < f.step_gate:
                 return None
             p = f.params.get("p")
             if p is not None:
@@ -203,6 +218,11 @@ class ChaosContext:
         live = rm._live_containers()
         if not live:
             return exits
+        # fidelity: a preempted container / dead node gets NO drain grace —
+        # and the graceful kill would block this (monitor-loop) caller for
+        # the whole grace window per victim, letting survivors train seconds
+        # past the fault. RMs without an abrupt path fall back to kill_container.
+        kill = getattr(rm, "kill_container_abrupt", None) or rm.kill_container
         for f in self.schedule.of_kind("node-loss"):
             victims = [
                 c for c in live
@@ -214,7 +234,7 @@ class ChaosContext:
             if got is None:
                 continue
             for c in victims:
-                rm.kill_container(c)
+                kill(c)
                 exits.setdefault(c.id, constants.EXIT_NODE_LOST)
         for f in self.schedule.of_kind("preempt"):
             victims = [
@@ -227,7 +247,7 @@ class ChaosContext:
             if got is None:
                 continue
             for c in victims:
-                rm.kill_container(c)
+                kill(c)
                 exits.setdefault(c.id, constants.EXIT_PREEMPTED)
         return exits
 

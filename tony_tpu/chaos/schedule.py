@@ -13,7 +13,11 @@ Each entry is ``kind[:<job>:<index>][:k=v ...][:arg ...][@trigger]`` where
   ``ms`` = duration);
 - bare tokens are positional arguments (``ckpt-corrupt:latest``);
 - ``@t+5s`` arms the fault 5 s after the injecting process starts;
-  ``@gang_complete`` / ``@registered`` tie it to a lifecycle point instead.
+  ``@step+4`` arms it once the job's reported TRAINING step reaches 4
+  (container faults only — the AM gates on the metrics the executors push,
+  so a "preempt K workers mid-run" schedule fires against progress, not
+  wall time); ``@gang_complete`` / ``@registered`` tie it to a lifecycle
+  point instead.
 
 Entries parse to :class:`FaultSpec` rows inside a :class:`FaultSchedule`
 carrying the run's seed — the pair (spec string, seed) fully determines every
@@ -63,6 +67,7 @@ class FaultSpec:
     target: tuple[str, int] | None = None  # (job_type, index); None = any
     trigger: str | None = None             # lifecycle point ("gang_complete", ...)
     delay_ms: int = 0                      # from "@t+5s": armed this long after process start
+    step_gate: int = 0                     # from "@step+4": armed once the job reports this step
     args: tuple[str, ...] = ()             # positional tokens ("latest", ...)
     params: dict[str, float] = field(default_factory=dict)  # k=v tokens (p, ms, ...)
     entry: str = ""                        # the original entry text (canonical key)
@@ -80,12 +85,19 @@ class FaultSpec:
 
 def _parse_entry(entry: str) -> FaultSpec:
     text = entry.strip()
-    body, trigger, delay_ms = text, None, 0
+    body, trigger, delay_ms, step_gate = text, None, 0, 0
     at = text.rfind("@")
     if at != -1:
         body, trig = text[:at], text[at + 1:].strip()
         if trig.startswith("t+"):
             delay_ms = parse_time_ms(trig[2:])
+        elif trig.startswith("step+"):
+            try:
+                step_gate = int(trig[5:])
+            except ValueError:
+                raise ValueError(f"non-integer step gate in fault entry {text!r}") from None
+            if step_gate < 1:
+                raise ValueError(f"step gate must be >= 1 in fault entry {text!r}")
         elif trig:
             trigger = trig
         else:
@@ -123,7 +135,12 @@ def _parse_entry(entry: str) -> FaultSpec:
     p = params.get("p")
     if p is not None and not 0 <= p <= 1:
         raise ValueError(f"probability p={p} out of [0, 1] in fault entry {text!r}")
-    return FaultSpec(kind, target, trigger, delay_ms, tuple(args), params, entry=text)
+    if step_gate and kind not in CONTAINER_FAULTS:
+        raise ValueError(
+            f"@step+N gates are container faults only ({', '.join(sorted(CONTAINER_FAULTS))}) "
+            f"— only the AM sees the job's reported step — in fault entry {text!r}"
+        )
+    return FaultSpec(kind, target, trigger, delay_ms, step_gate, tuple(args), params, entry=text)
 
 
 @dataclass

@@ -61,6 +61,7 @@ def verify_chaos_run(handle, config: TonyConfig) -> tuple[list[str], dict[str, A
     else:
         events = history.read_events(history_root, handle.app_id)
         epochs, completes_this_epoch = 1, 0
+        resizes: list[dict[str, Any]] = []
         for ev in events:
             if ev.type.value == "GANG_COMPLETE":
                 completes_this_epoch += 1
@@ -73,7 +74,10 @@ def verify_chaos_run(handle, config: TonyConfig) -> tuple[list[str], dict[str, A
             ).startswith("gang restart"):
                 epochs += 1
                 completes_this_epoch = 0
+            elif ev.type.value == "GANG_RESIZED" and not ev.payload.get("rejected"):
+                resizes.append(ev.payload)
         info["gang_epochs"] = epochs
+        info["resizes"] = resizes
 
     resumed = _resumed_steps(handle.staging_dir)
     info["resumed_steps"] = resumed
@@ -159,7 +163,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--workers", type=int, default=0, help="shortcut for worker instance count")
     p.add_argument("--expect-resume", action="store_true",
                    help="fail unless a restarted gang resumed from a checkpoint")
+    p.add_argument("--expect-resize", metavar="TYPE=N", default="",
+                   help="fail unless an elastic resize landed the jobtype at N "
+                        "instances (e.g. worker=2 for a shrink-on-preempt run)")
     args = p.parse_args(argv)
+
+    expect_resize: tuple[str, int] | None = None
+    if args.expect_resize:
+        jobtype, _, n = args.expect_resize.partition("=")
+        if not jobtype or not n.isdigit() or int(n) < 1:
+            print(f"tony chaos: bad --expect-resize {args.expect_resize!r} "
+                  "(want TYPE=N with N >= 1)", file=sys.stderr)
+            return 2
+        expect_resize = (jobtype, int(n))
 
     try:
         FaultSchedule.parse(args.spec, args.seed)  # validate the grammar before submitting
@@ -194,6 +210,20 @@ def main(argv: list[str] | None = None) -> int:
         print(f"[tony-chaos] checkpoint resumes at steps: {info['resumed_steps']}")
     elif args.expect_resume:
         failures.append("--expect-resume: no task resumed from a checkpoint")
+    for rz in info.get("resizes") or []:
+        print(f"[tony-chaos] gang resized: {rz.get('resized')} "
+              f"(trigger={rz.get('trigger', '?')}, now {rz.get('instances')})")
+    if expect_resize is not None:
+        jobtype, n = expect_resize
+        landed = [
+            rz for rz in info.get("resizes") or []
+            if (rz.get("instances") or {}).get(jobtype) == n
+        ]
+        if not landed:
+            failures.append(
+                f"--expect-resize: no elastic resize landed {jobtype} at {n} "
+                f"instance(s) (saw: {[rz.get('instances') for rz in info.get('resizes') or []]})"
+            )
     print(f"[tony-chaos] gang epochs: {info.get('gang_epochs', 1)}")
 
     if failures:

@@ -1,0 +1,73 @@
+"""``tony resize``: retarget a RUNNING job's per-type instance count.
+
+The manual lever on the same elastic path the serving autoscaler and the
+AM's shrink-on-preempt logic drive (``resize_jobtype`` RPC →
+session/scheduler rebuild, docs/fault-tolerance.md "Elastic training"):
+
+    tony resize <app_id> worker 2
+
+Invalid requests (unknown jobtype, target < 1, outside the
+``tony.elastic.*`` bounds, a conflicting resize already pending) surface as
+the typed ``InvalidResizeError`` the AM raises through the RPC error frame —
+exit code 2, distinct from transport failures (exit 1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tony_tpu import constants
+from tony_tpu.cli.introspect import _am_rpc
+
+
+def main_resize(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tony resize",
+        description="resize one jobtype of a RUNNING job through the AM's "
+                    "elastic path (no re-submission)",
+    )
+    p.add_argument("app_id", help="application id (staging dir name)")
+    p.add_argument("jobtype", help="job type to resize, e.g. worker")
+    p.add_argument("instances", type=int, help="target instance count")
+    p.add_argument("--staging", default=None,
+                   help="staging root holding <app_id>/ (default: $TONY_ROOT)")
+    args = p.parse_args(argv)
+
+    staging = args.staging or constants.default_tony_root()
+    cli = _am_rpc(staging, args.app_id)
+    if cli is None:
+        print(f"no running AM for {args.app_id} under {staging} — "
+              "is the job still running?", file=sys.stderr)
+        return 1
+    from tony_tpu.cluster.rpc import RpcError
+
+    try:
+        resp = cli.call("resize_jobtype", job_name=args.jobtype,
+                        instances=args.instances)
+    except RpcError as e:
+        if "InvalidResizeError" in str(e):
+            # the AM's typed verdict: the request itself is wrong, not the
+            # transport — print it verbatim so the caller can fix the ask
+            print(f"tony resize: rejected: {e}", file=sys.stderr)
+            return 2
+        print(f"tony resize: resize_jobtype failed: {e}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"tony resize: cannot reach the AM: {e}", file=sys.stderr)
+        return 1
+    finally:
+        cli.close()
+
+    if resp.get("noop"):
+        print(f"[tony-resize] {args.jobtype} already at "
+              f"{resp.get('current')} instance(s) — nothing to do")
+        return 0
+    print(f"[tony-resize] {args.jobtype}: {resp.get('current')} → "
+          f"{args.instances} accepted; the AM applies it on its next "
+          "monitor tick (checkpoint-resume rebuild while running)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main_resize())

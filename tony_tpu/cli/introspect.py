@@ -323,7 +323,8 @@ def main_top(argv: list[str] | None = None) -> int:
             cli.close()
         task_obs = metrics.get("tasks") or {}
         rows = obs_introspect.build_top_rows(
-            infos, task_obs, prev_step_stats=prev_stats or None)
+            infos, task_obs, prev_step_stats=prev_stats or None,
+            instances=app.get("instances"))
         prev_stats = obs_introspect.step_stats_by_task(infos, task_obs)
         try:
             if not args.once and not first:

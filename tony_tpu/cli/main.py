@@ -107,6 +107,12 @@ def _cmd_top(argv: list[str]) -> int:
     return main_top(argv)
 
 
+def _cmd_resize(argv: list[str]) -> int:
+    from tony_tpu.cli.elastic import main_resize
+
+    return main_resize(argv)
+
+
 def _cmd_mini(argv: list[str]) -> int:
     """Self-contained sandbox: submit a smoke gang against the local resource
     manager and print the verdict + history location.
@@ -272,13 +278,14 @@ _COMMANDS = {
     "profile": _cmd_profile,
     "logs": _cmd_logs,
     "top": _cmd_top,
+    "resize": _cmd_resize,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|portal|notebook|serve|mini|data-prep|lint|chaos|trace|profile|logs|top} [options]\n")
+        print("usage: tony {submit|pool|history|portal|notebook|serve|mini|data-prep|lint|chaos|trace|profile|logs|top|resize} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    list finished jobs / dump one job's events")
@@ -293,6 +300,7 @@ def main(argv: list[str] | None = None) -> int:
         print("  profile    capture a jax.profiler trace on a RUNNING job's workers (no resubmit)")
         print("  logs       merge/tail a job's per-process structured logs in timestamp order")
         print("  top        refreshing live status view (per-task state, step rate, heartbeat age)")
+        print("  resize     retarget a RUNNING job's per-type instance count (elastic rebuild)")
         return 0
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:

@@ -47,6 +47,7 @@ from tony_tpu.cluster.scheduler import (
     TaskScheduler,
     gang_fits,
     plan_downsize,
+    plan_preempt_shrink,
 )
 from tony_tpu.cluster.rpc import APPLICATION_RPC_METHODS, RpcServer
 from tony_tpu.cluster.session import JobStatus, Session, TaskStatus
@@ -68,6 +69,20 @@ _PROFILE_REPORTS = obs_metrics.counter(
     "tony_profile_reports_total",
     "per-task on-demand capture reports by status (delivered, captured, error)",
     labelnames=("status",))
+_ELASTIC_RESIZES = obs_metrics.counter(
+    "tony_elastic_resizes_total",
+    "applied elastic resizes by direction (grow, shrink, mixed) and trigger "
+    "(rpc, preempt, capacity)",
+    labelnames=("direction", "trigger"))
+
+
+class InvalidResizeError(ValueError):
+    """A ``resize_jobtype`` request that can never be applied: unknown
+    jobtype, target < 1, outside the ``tony.elastic.*`` bounds, or a
+    conflicting resize for the same jobtype already pending. Reaches remote
+    callers BY NAME through the RPC error frame (like AlreadyProfilingError),
+    so ``tony resize`` / ``tony serve`` can distinguish a rejected request
+    from a transport failure."""
 
 
 def build_resource_manager(config: TonyConfig, app_id: str = "") -> ResourceManager:
@@ -152,6 +167,9 @@ class ApplicationMaster:
         # fault injection (tony.chaos.*): None — and zero-cost — unless
         # configured; container faults ride the RM's poll_exited seam
         self.chaos = ChaosContext.from_config(config, identity="am", staging_dir=staging_dir)
+        # @step+N gates need the per-tick progress scan; other schedules don't
+        self._chaos_step_gated = self.chaos is not None and any(
+            f.step_gate for f in self.chaos.schedule.faults)
         self.rm = rm or build_resource_manager(config, app_id)
         self.rm.chaos = self.chaos
         self.runtime = get_runtime(config)
@@ -182,6 +200,13 @@ class ApplicationMaster:
         # an acknowledged-but-unapplied request.
         self._pending_resize: dict[str, int] = {}
         self._client_obs: dict[str, Any] = {}  # submitter-side registries (fleet router)
+        # hot spares (tony.elastic.spares): pre-allocated, pre-registered
+        # executors of the elastic jobtype parked next to the gang. spare_id →
+        # {"container", "ready", "assignment"}; assignment != None means the
+        # spare was promoted into a gang slot and is no longer spare capacity.
+        self._spares: dict[str, dict[str, Any]] = {}
+        self._spare_seq = 0
+        self._last_spare_topup = 0.0
         # on-demand profiler capture (tony profile): single-slot request
         # state machine, internally locked — RPC handler threads race on it
         self._profile = obs_introspect.ProfileCoordinator()
@@ -293,6 +318,7 @@ class ApplicationMaster:
 
     def get_application_status(self) -> dict[str, Any]:
         st = self.session.job_status
+        cfg = self._effective_config()
         return {
             "app_id": self.app_id,
             "state": st.value,
@@ -300,6 +326,10 @@ class ApplicationMaster:
             "reason": self.session.failure_reason,
             "tensorboard_url": self.tensorboard_url,
             "restart_attempt": self._restart_attempt,
+            # effective per-type instance counts AFTER any elastic resize —
+            # `tony top` / the portal drop task rows a shrink removed instead
+            # of showing them dead forever
+            "instances": {t: cfg.instances(t) for t in cfg.job_types()},
         }
 
     def finish_application(self) -> dict[str, Any]:
@@ -327,24 +357,97 @@ class ApplicationMaster:
         return {"ack": True}
 
     def resize_jobtype(self, job_name: str, instances: int) -> dict[str, Any]:
-        """Elastic-resize request (the serving autoscaler's lever): retarget
-        ``tony.<job_name>.instances`` without re-submitting. The monitor loop
-        applies it via the existing rebuild path — in place while queued, or
-        a budget-exempt whole-gang restart while running (replicas restore /
-        re-register onto the new fleet size; the router masks the blip)."""
+        """Elastic-resize request (the serving autoscaler's / ``tony
+        resize``'s lever): retarget ``tony.<job_name>.instances`` without
+        re-submitting. The monitor loop applies it via the existing rebuild
+        path — in place while queued, or a budget-exempt whole-gang restart
+        while running (workers restore the checkpoint onto the resized mesh;
+        serve replicas re-register onto the new fleet size).
+
+        Invalid requests raise the typed :class:`InvalidResizeError` through
+        the RPC error frame instead of a generic error payload."""
         n = int(instances)
         if job_name not in self.config.job_types():
-            return {"ack": False, "error": f"unknown job type {job_name!r}"}
+            raise InvalidResizeError(
+                f"unknown job type {job_name!r} "
+                f"(declared: {', '.join(sorted(self.config.job_types()))})"
+            )
         if n < 1:
-            return {"ack": False, "error": f"instances must be >= 1, got {n}"}
+            raise InvalidResizeError(f"target instances must be >= 1, got {n}")
+        if job_name == self._elastic_jobtype():
+            floor = self.config.get_int(keys.ELASTIC_MIN_WORKERS, 0)
+            ceiling = self.config.get_int(keys.ELASTIC_MAX_WORKERS, 0)
+            if floor and n < floor:
+                raise InvalidResizeError(
+                    f"target {n} below tony.elastic.min-workers={floor}")
+            if ceiling and n > ceiling:
+                raise InvalidResizeError(
+                    f"target {n} above tony.elastic.max-workers={ceiling}")
         with self._epoch_lock:
             current = self._effective_config().instances(job_name)
             if n == current:
-                self._pending_resize.pop(job_name, None)
+                cancelled = self._pending_resize.pop(job_name, None)
                 _GANG_RESIZES.inc(outcome="noop")
-                return {"ack": True, "current": current, "noop": True}
+                if cancelled is None:
+                    return {"ack": True, "current": current, "noop": True}
+                # asking for the CURRENT size is the explicit way to abort an
+                # acked-but-unapplied resize — report the cancellation rather
+                # than silently making the first caller's ack a lie
+                obs_logging.info(
+                    f"[tony-am] resize {job_name}→{cancelled} cancelled by a "
+                    f"request for the current size {current}")
+                return {"ack": True, "current": current, "noop": True,
+                        "cancelled_pending": cancelled}
+            pending = self._pending_resize.get(job_name)
+            if pending is not None and pending != n:
+                # acknowledged-but-unapplied request in flight: silently
+                # clobbering it would make the first caller's ack a lie
+                raise InvalidResizeError(
+                    f"a resize of {job_name!r} to {pending} is already "
+                    "pending; retry after it applies")
             self._pending_resize[job_name] = n
         return {"ack": True, "current": current}
+
+    # ------------------------------------------------------------ hot spares
+    def register_spare(self, spare_id: str, host: str, port: int) -> dict[str, Any]:
+        """A hot-spare executor (``tony.elastic.spares``) announces it is up
+        and parked: from here, promoting it into a gang slot costs a spec
+        re-fence instead of container allocation + executor startup."""
+        with self._epoch_lock:
+            sp = self._spares.get(spare_id)
+            if sp is None:
+                return {"ack": False, "stale": True}  # reaped spare: executor exits
+            sp["ready"] = True
+        self.events.emit(EventType.SPARE_READY, spare=spare_id, host=host, port=port)
+        obs_logging.info(f"[tony-am] hot spare {spare_id} ready on {host}:{port}")
+        return {"ack": True}
+
+    def poll_spare_assignment(self, spare_id: str) -> dict[str, Any]:
+        """Parked spares poll for a promotion. ``stale`` → the spare was
+        reaped (job ending, or its generation was dropped) and must exit;
+        a non-None assignment carries the (job, index, attempt) identity the
+        executor adopts before walking the normal register→barrier path."""
+        with self._epoch_lock:
+            sp = self._spares.get(spare_id)
+            if sp is None:
+                return {"stale": True}
+            return {"assignment": sp.get("assignment")}
+
+    def _elastic_jobtype(self) -> str:
+        return self.config.get(keys.ELASTIC_JOBTYPE) or constants.WORKER_JOB_NAME
+
+    def _elastic_floors(self) -> dict[str, int]:
+        """Per-type shrink floors: ``tony.<type>.min-instances`` merged with
+        ``tony.elastic.min-workers`` for the elastic jobtype (either spelling
+        enables elasticity for the training data axis)."""
+        floors = {
+            t: self.config.get_int(keys.jobtype_key(t, keys.MIN_INSTANCES_SUFFIX), 0)
+            for t in self.config.job_types()
+        }
+        et = self._elastic_jobtype()
+        if et in floors:
+            floors[et] = max(floors[et], self.config.get_int(keys.ELASTIC_MIN_WORKERS, 0))
+        return floors
 
     def start_profile(self, steps: int | None = None, memory: bool = False) -> dict[str, Any]:
         """Arm an on-demand profiler capture (``tony profile <app_id>``): fan
@@ -483,7 +586,28 @@ class ApplicationMaster:
         return result
 
     def _launch_type_spanned(self, job_type: str) -> None:
-        for container in self.scheduler.allocate_type(job_type):
+        # hot-spare promotion: slots covered by a ready spare skip container
+        # allocation AND executor startup — the parked executor adopts the
+        # slot identity and walks straight into the gang barrier
+        spare_slots: dict[int, str] = {}
+        if job_type == self._elastic_jobtype():
+            with self._epoch_lock:
+                ready = [
+                    sid for sid, sp in sorted(self._spares.items())
+                    if sp.get("ready") and sp.get("assignment") is None
+                ]
+            n = self.scheduler.plans[job_type].instances
+            # highest indices first, and NEVER index 0: the coordinator /
+            # chief-like rank always gets a deliberately-placed fresh
+            # container, however many spares are parked
+            for k, sid in enumerate(ready[:max(n - 1, 0)]):
+                spare_slots[n - 1 - k] = sid
+        containers = self.scheduler.allocate_type(job_type, skip_indices=set(spare_slots))
+        # fresh allocations succeeded (no AllocationPending escape) — binding
+        # the spares now means a queued gang never strands a consumed spare
+        for idx in sorted(spare_slots):
+            self._bind_spare(spare_slots[idx], job_type, idx)
+        for container in containers:
             task = self.session.get_task(job_type, container.task_index)
             task.status = TaskStatus.SCHEDULED
             task.container_id = container.id
@@ -501,15 +625,55 @@ class ApplicationMaster:
         if self._gang_started_ms is None:
             self._gang_started_ms = time.time() * 1000
 
-    def _start_executor(self, container: Container) -> None:
-        log_dir = os.path.join(
-            self.staging_dir,
-            constants.TASK_LOG_DIRNAME,
-            f"{container.job_type}_{container.task_index}"
-            + (f"_r{self._restart_attempt}" if self._restart_attempt else ""),
+    def _bind_spare(self, spare_id: str, job_type: str, index: int) -> None:
+        """Promote a parked spare into gang slot (job_type, index): its
+        container becomes the task's container and its next assignment poll
+        hands it the identity + gang epoch to register under."""
+        with self._epoch_lock:
+            sp = self._spares[spare_id]
+            container = sp["container"]
+            container.job_type = job_type
+            container.task_index = index
+            sp["assignment"] = {
+                "job_name": job_type, "index": index, "attempt": self._restart_attempt,
+            }
+        task = self.session.get_task(job_type, index)
+        task.status = TaskStatus.SCHEDULED
+        task.container_id = container.id
+        task.chip_coords = container.chip_coords
+        task.start_time_ms = int(time.time() * 1000)
+        # the promoted executor keeps writing where it was launched: point
+        # the task's log attribution at the spare's directory
+        task.log_dir = os.path.join(
+            self.staging_dir, constants.TASK_LOG_DIRNAME, f"spare_{spare_id}")
+        self._containers[container.id] = container
+        self._by_task[(job_type, index)] = container
+        self.events.emit(
+            EventType.SPARE_PROMOTED,
+            spare=spare_id, task=f"{job_type}:{index}", container=container.id,
         )
-        task = self.session.get_task(container.job_type, container.task_index)
-        task.log_dir = log_dir
+        self.events.emit(
+            EventType.TASK_STARTED,
+            task=task.id, container=container.id,
+            chips=len(container.chip_coords), spare=spare_id,
+        )
+        obs_logging.info(
+            f"[tony-am] promoted hot spare {spare_id} → {job_type}:{index}")
+
+    def _start_executor(self, container: Container, spare_id: str | None = None) -> None:
+        if spare_id is not None:
+            log_dir = os.path.join(
+                self.staging_dir, constants.TASK_LOG_DIRNAME, f"spare_{spare_id}"
+            )
+        else:
+            log_dir = os.path.join(
+                self.staging_dir,
+                constants.TASK_LOG_DIRNAME,
+                f"{container.job_type}_{container.task_index}"
+                + (f"_r{self._restart_attempt}" if self._restart_attempt else ""),
+            )
+            task = self.session.get_task(container.job_type, container.task_index)
+            task.log_dir = log_dir
         host, port = self.rpc.address
         env = dict(os.environ)
         env.update(container.device_env())
@@ -529,6 +693,10 @@ class ApplicationMaster:
                 "PYTHONPATH": _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", ""),
             }
         )
+        if spare_id is not None:
+            # spare contract: the executor parks after registering and waits
+            # for a promotion instead of joining the gang as (job, index)
+            env[constants.ENV_SPARE_ID] = spare_id
         if self.tracer is not None and self._root_span is not None:
             # executor root spans link under am.run (trace dir + enablement
             # come from the frozen config the executor loads itself)
@@ -561,6 +729,7 @@ class ApplicationMaster:
         for cid, rc in self.rm.poll_exited().items():
             c = self._containers.get(cid)
             if c is None:
+                self._reap_dead_spare(cid, rc)
                 continue
             task = self.session.get_task(c.job_type, c.task_index)
             if not task.status.terminal:
@@ -589,10 +758,7 @@ class ApplicationMaster:
         per-type counts. None → keep the current size (fits, no floors,
         capacity unknown, or the shortfall is younger than the downsize
         grace — a blip must not permanently halve the gang)."""
-        floors = {
-            t: self.config.get_int(keys.jobtype_key(t, keys.MIN_INSTANCES_SUFFIX), 0)
-            for t in self.config.job_types()
-        }
+        floors = self._elastic_floors()
         if not any(floors.values()):
             return None  # elasticity not enabled for any type
         # ONE capacity snapshot: totals derived from the same node list the
@@ -630,33 +796,54 @@ class ApplicationMaster:
             return None
         return plan
 
-    def _announce_resize(self, resize: dict[str, int], reason: str) -> None:
+    def _announce_resize(
+        self, resize: dict[str, int], reason: str,
+        trigger: str = "capacity", old: dict[str, int] | None = None,
+    ) -> None:
         cfg = self._effective_config()
-        self.events.emit(
-            EventType.GANG_RESIZED,
-            instances={t: cfg.instances(t) for t in cfg.job_types()},
-            resized=resize,
-            reason=reason,
-        )
-        # resized demand re-registers with the pool so queue admission
-        # evaluates the gang the AM will actually ask for
-        self.rm.register_app(
-            queue=self.config.get(keys.APPLICATION_QUEUE) or "default",
-            priority=self.config.get_int(keys.APPLICATION_PRIORITY, 0),
-            demand=self.scheduler.total_demand(),
-        )
+        if old:
+            deltas = [resize[t] - old.get(t, resize[t]) for t in resize]
+            if all(d < 0 for d in deltas):
+                direction = "shrink"
+            elif all(d > 0 for d in deltas):
+                direction = "grow"
+            else:
+                direction = "mixed"
+            _ELASTIC_RESIZES.inc(direction=direction, trigger=trigger)
+        # the resize episode as a trace span: attrs carry what moved and why,
+        # the enclosing am.gang_restart span (when restarting) carries the cost
+        with obs_trace.maybe_span("am.resize", trigger=trigger, reason=reason,
+                                  resized=dict(resize)):
+            self.events.emit(
+                EventType.GANG_RESIZED,
+                instances={t: cfg.instances(t) for t in cfg.job_types()},
+                resized=resize,
+                reason=reason,
+                trigger=trigger,
+            )
+            # resized demand re-registers with the pool so queue admission
+            # evaluates the gang the AM will actually ask for
+            self.rm.register_app(
+                queue=self.config.get(keys.APPLICATION_QUEUE) or "default",
+                priority=self.config.get_int(keys.APPLICATION_PRIORITY, 0),
+                demand=self.scheduler.total_demand(),
+            )
 
-    def _resize_while_queued(self, resize: dict[str, int], reason: str) -> None:
+    def _resize_while_queued(
+        self, resize: dict[str, int], reason: str, trigger: str = "capacity"
+    ) -> None:
         """A gang waiting in pool admission with NOTHING running re-plans in
         place — capacity permanently lost mid-wait, or an autoscaler retarget
         arriving before admission (the restart path below never fires)."""
         with self._epoch_lock:
+            old_cfg = self._effective_config()
+            old = {t: old_cfg.instances(t) for t in resize}
             self._resized.update(resize)
             cfg = self._effective_config()
             self.session = Session(cfg)
             self.session.job_status = JobStatus.RUNNING
             self.scheduler = TaskScheduler(cfg, self.session, self.rm)
-        self._announce_resize(resize, reason)
+        self._announce_resize(resize, reason, trigger=trigger, old=old)
 
     def _apply_pending_resize(self) -> None:
         """Apply a ``resize_jobtype`` request from the monitor loop (the one
@@ -703,17 +890,111 @@ class ApplicationMaster:
         reason = "resize " + ", ".join(
             f"{t}: {cfg.instances(t)}→{n}" for t, n in sorted(resize.items()))
         if not self._containers:
-            self._resize_while_queued(resize, reason)
+            self._resize_while_queued(resize, reason, trigger="rpc")
         else:
             # budget-exempt like preemption: a requested resize is a cluster
             # action, not a job failure
             self._maybe_restart_gang(
-                reason, exit_code=constants.EXIT_PREEMPTED, resize=resize
+                reason, exit_code=constants.EXIT_PREEMPTED, resize=resize,
+                trigger="rpc",
             )
+
+    def _plan_preempt_shrink(self) -> dict[str, int] | None:
+        """Shrink-on-preempt (``tony.elastic.shrink-on-preempt``): when the
+        pool took K of the elastic type's workers, re-form the survivors at
+        the largest divisor count >= the elastic floor instead of re-queuing
+        the full gang and waiting for capacity that may never come back.
+        None → respond to the preemption the classic way (full-size restart
+        through pool admission)."""
+        if not self.config.get_bool(keys.ELASTIC_SHRINK_ON_PREEMPT):
+            return None
+        et = self._elastic_jobtype()
+        cfg = self._effective_config()
+        if et not in cfg.job_types():
+            return None
+        current = cfg.instances(et)
+        with self.session.lock:
+            preempted = sum(
+                1 for t in self.session.tasks.get(et, [])
+                if t.exit_code == constants.EXIT_PREEMPTED
+            )
+        floor = self._elastic_floors().get(et, 0)
+        target = plan_preempt_shrink(current, current, preempted, floor)
+        if target is None:
+            return None
+        return {et: target}
+
+    def _maintain_spares(self) -> None:
+        """Keep ``tony.elastic.spares`` parked executors of the elastic type
+        next to the gang (throttled; the gang always outranks spares — a
+        shortage just skips the top-up until capacity frees up)."""
+        target = self.config.get_int(keys.ELASTIC_SPARES, 0)
+        if target <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_spare_topup < 1.0:
+            return
+        self._last_spare_topup = now
+        et = self._elastic_jobtype()
+        plan = self.scheduler.plans.get(et)
+        if plan is None or not plan.launched:
+            return  # never hold spare capacity while the main gang still waits
+        with self._epoch_lock:
+            parked = sum(
+                1 for sp in self._spares.values() if sp.get("assignment") is None
+            )
+        for _ in range(target - parked):
+            try:
+                container = self.rm.allocate(et, -(self._spare_seq + 1), plan.resources)
+            except (AllocationError, AllocationPending):
+                return  # spares are opportunistic: retry on a later tick
+            self._spare_seq += 1
+            spare_id = f"spare-{self._spare_seq}"
+            with self._epoch_lock:
+                self._spares[spare_id] = {
+                    "container": container, "ready": False, "assignment": None,
+                }
+            self._start_executor(container, spare_id=spare_id)
+            obs_logging.info(f"[tony-am] launched hot spare {spare_id} ({et})")
+
+    def _reap_dead_spare(self, container_id: str, exit_code: int) -> None:
+        """A PARKED spare's container died (crash, node loss): release it so
+        the top-up loop replaces it instead of counting a corpse as spare
+        capacity. Promoted spares are ordinary gang containers and never
+        reach here."""
+        with self._epoch_lock:
+            hit = next(
+                (
+                    (sid, sp) for sid, sp in self._spares.items()
+                    if sp.get("assignment") is None and sp["container"].id == container_id
+                ),
+                None,
+            )
+            if hit is None:
+                return
+            sid, sp = hit
+            del self._spares[sid]
+        self.rm.release(sp["container"])
+        obs_logging.warning(
+            f"[tony-am] hot spare {sid} died while parked (exit {exit_code})")
+
+    def _kill_all_spares(self) -> None:
+        """Teardown: reap parked spares (promoted ones are ordinary gang
+        containers and die through ``_kill_all_containers``)."""
+        with self._epoch_lock:
+            parked = {
+                sid: sp for sid, sp in self._spares.items()
+                if sp.get("assignment") is None
+            }
+            for sid in parked:
+                del self._spares[sid]
+        for sp in parked.values():
+            self.rm.kill_container(sp["container"])
+            self.rm.release(sp["container"])
 
     def _maybe_restart_gang(
         self, reason: str, exit_code: int | None = None,
-        resize: dict[str, int] | None = None,
+        resize: dict[str, int] | None = None, trigger: str = "capacity",
     ) -> bool:
         """Whole-gang restart from checkpoint (rebuild-only elasticity).
 
@@ -740,9 +1021,11 @@ class ApplicationMaster:
             "am.gang_restart", reason=reason,
             attempt=self._restart_attempt + 1, preempted=preempted,
         ):
-            return self._restart_gang_spanned(reason, resize)
+            return self._restart_gang_spanned(reason, resize, trigger)
 
-    def _restart_gang_spanned(self, reason: str, resize: dict[str, int] | None) -> bool:
+    def _restart_gang_spanned(
+        self, reason: str, resize: dict[str, int] | None, trigger: str = "capacity"
+    ) -> bool:
         self.events.emit(EventType.HEARTBEAT_LOST, reason=f"gang restart: {reason}")
         # an in-flight capture can never complete across the restart: the
         # children that would have captured are being killed, and relaunch
@@ -761,6 +1044,8 @@ class ApplicationMaster:
             announce = bool(resize)
             reason = f"capacity lost: {reason}"
         with self._epoch_lock:  # atomic with _fenced_session's capture
+            old_cfg = self._effective_config()
+            old = {t: old_cfg.instances(t) for t in (resize or {})}
             if resize:
                 self._resized.update(resize)
             cfg = self._effective_config()
@@ -770,11 +1055,19 @@ class ApplicationMaster:
             self.session = Session(cfg)
             self.session.job_status = JobStatus.RUNNING
             self.scheduler = TaskScheduler(cfg, self.session, self.rm)
+            # promoted spares died with the gang they joined (their containers
+            # were just killed above); parked spares survive the restart —
+            # that is the whole point: the relaunch can promote them without
+            # touching the allocator
+            self._spares = {
+                sid: sp for sid, sp in self._spares.items()
+                if sp.get("assignment") is None
+            }
         lg = obs_logging.get()
         if lg is not None:
             lg.epoch = self._restart_attempt  # stamp the new gang epoch on records
         if announce:
-            self._announce_resize(resize, reason)
+            self._announce_resize(resize, reason, trigger=trigger, old=old)
         return True
 
     def run(self) -> JobStatus:
@@ -795,8 +1088,20 @@ class ApplicationMaster:
                 self.session.job_status = JobStatus.KILLED
                 break
 
-            # 0. externally-requested elastic resize (serving autoscaler)
+            # 0. externally-requested elastic resize (autoscaler / tony
+            # resize), then hot-spare top-up for the elastic jobtype
             self._apply_pending_resize()
+            self._maintain_spares()
+            if self._chaos_step_gated:
+                # progress feed for @step+N-gated container faults: the max
+                # TRAINING step any executor has pushed
+                step = 0
+                for t in self.session.task_infos():
+                    s = ((t.get("metrics") or {}).get("train") or {}).get("step")
+                    if isinstance(s, (int, float)):
+                        step = max(step, int(s))
+                if step:
+                    self.chaos.set_progress(step)
 
             # 1. launch job types whose dependencies are satisfied
             try:
@@ -900,11 +1205,20 @@ class ApplicationMaster:
                 self._kill_all_containers()
                 break
 
-            # 5. fail-fast on tracked failure (or gang-restart if enabled)
+            # 5. fail-fast on tracked failure (or gang-restart if enabled).
+            # Preempted workers may additionally SHRINK the elastic data axis
+            # (tony.elastic.shrink-on-preempt) so the survivors resume from
+            # checkpoint now instead of re-queuing the full gang.
             failed = self.session.any_tracked_failed()
             if failed is not None:
+                resize, trigger = None, "capacity"
+                if failed.exit_code == constants.EXIT_PREEMPTED:
+                    resize = self._plan_preempt_shrink()
+                    if resize:
+                        trigger = "preempt"
                 if self._maybe_restart_gang(
-                    f"task {failed.id} {failed.status.value}", failed.exit_code
+                    f"task {failed.id} {failed.status.value}", failed.exit_code,
+                    resize=resize, trigger=trigger,
                 ):
                     continue
                 self._fail(f"tracked task {failed.id} {failed.status.value} "
@@ -932,6 +1246,7 @@ class ApplicationMaster:
         return self.stop()
 
     def stop(self) -> JobStatus:
+        self._kill_all_spares()  # parked spares must not outlive the job
         final = self.session.reduce_final_status()
         completed_ms = int(time.time() * 1000)
         obs_logging.info(f"[tony-am] application {self.app_id} finished: {final.value}")

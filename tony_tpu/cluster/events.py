@@ -33,6 +33,8 @@ class EventType(enum.Enum):
     QUEUE_WAIT = "QUEUE_WAIT"
     GANG_COMPLETE = "GANG_COMPLETE"
     GANG_RESIZED = "GANG_RESIZED"
+    SPARE_READY = "SPARE_READY"        # hot-spare executor pre-registered with the AM
+    SPARE_PROMOTED = "SPARE_PROMOTED"  # spare bound to a gang slot (skipped allocation)
     TASK_URL_REGISTERED = "TASK_URL_REGISTERED"
     METRICS_SNAPSHOT = "METRICS_SNAPSHOT"
     PROFILE_REQUESTED = "PROFILE_REQUESTED"    # on-demand capture fan-out began

@@ -96,11 +96,80 @@ class TaskExecutor:
         self.child: subprocess.Popen | None = None
         self._stop = threading.Event()
         self._hb_failures = 0
+        # hot-spare contract (tony.elastic.spares): set → park after
+        # register_spare and wait for a gang-slot promotion instead of
+        # registering as (job_name, index) right away
+        self.spare_id = env.get(constants.ENV_SPARE_ID) or None
         # on-demand profile relay (tony profile): control file out to the
         # child, done file back, status reported over RPC — driven entirely
         # from the heartbeat thread
         self._profile_courier = obs_introspect.ProfileCourier(
             self.staging_dir, self.job_name, self.index, self._report_profile
+        )
+
+    # -- hot-spare parking -------------------------------------------------
+    def _park_as_spare(self) -> bool:
+        """Announce this executor as a parked spare, then poll until the AM
+        promotes it into a gang slot (adopt that identity and return True)
+        or reaps it (return False → clean exit). The whole point of a spare
+        is that everything up to here — container allocation, process start,
+        registration round-trip — is already paid when a grow or a
+        preemption replacement needs a worker."""
+        resp = self.rpc.call_with_retry(
+            "register_spare", retries=30, delay_s=0.2, deadline_s=30,
+            spare_id=self.spare_id, host=self.host, port=self.port,
+        )
+        if not resp.get("ack"):
+            return False  # reaped before we even announced
+        obs_logging.info(f"[tony-executor] spare {self.spare_id} parked")
+        poll_s = 0.25
+        # same AM-outage tolerance the gang heartbeat loop gets: the
+        # missed-heartbeat budget is denominated in heartbeat INTERVALS
+        # (~1 s each), not in these faster polls
+        hb_s = self.config.get_time_ms(keys.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000
+        tolerance_s = self.config.get_int(keys.TASK_MAX_MISSED_HEARTBEATS, 25) * hb_s
+        unreachable_since: float | None = None
+        while True:
+            try:
+                resp = self.rpc.call("poll_spare_assignment", spare_id=self.spare_id)
+                unreachable_since = None
+            except (RpcError, OSError):
+                now = time.monotonic()
+                if unreachable_since is None:
+                    unreachable_since = now
+                elif now - unreachable_since > tolerance_s:
+                    return False  # AM is gone: a spare must not become an orphan
+                time.sleep(poll_s)
+                continue
+            if resp.get("stale"):
+                return False
+            assignment = resp.get("assignment")
+            if assignment:
+                self._adopt_assignment(assignment)
+                return True
+            time.sleep(poll_s)
+
+    def _adopt_assignment(self, assignment: dict) -> None:
+        """Become gang member (job_name, index) of the assigned gang epoch:
+        the env/courier/logging identity follows so the child contract
+        (metrics file, TONY_RESTART_ATTEMPT, JOB_NAME/TASK_INDEX) is
+        indistinguishable from a freshly launched executor's."""
+        self.job_name = str(assignment["job_name"])
+        self.index = int(assignment["index"])
+        self.attempt = int(assignment.get("attempt", 0))
+        os.environ[constants.ENV_JOB_NAME] = self.job_name
+        os.environ[constants.ENV_TASK_INDEX] = str(self.index)
+        os.environ["TONY_RESTART_ATTEMPT"] = str(self.attempt)
+        self._profile_courier = obs_introspect.ProfileCourier(
+            self.staging_dir, self.job_name, self.index, self._report_profile
+        )
+        lg = obs_logging.get()
+        if lg is not None:
+            lg.identity = f"{self.job_name}:{self.index}"
+            lg.epoch = self.attempt
+        obs_logging.info(
+            f"[tony-executor] spare {self.spare_id} promoted → "
+            f"{self.job_name}:{self.index} (attempt {self.attempt})"
         )
 
     # -- gang barrier ------------------------------------------------------
@@ -497,6 +566,16 @@ class TaskExecutor:
 
     def _run_supervised(self) -> int:
         signal.signal(signal.SIGTERM, lambda *_: (_sigterm(self)))
+        if self.spare_id is not None:
+            try:
+                with obs_trace.maybe_span("executor.spare_park", spare=self.spare_id):
+                    promoted = self._park_as_spare()
+            except (RpcError, OSError) as e:
+                obs_logging.error(f"[tony-executor] spare {self.spare_id} parking failed: {e}")
+                return constants.EXIT_EXECUTOR_REGISTRATION_FAILED
+            if not promoted:
+                obs_logging.info(f"[tony-executor] spare {self.spare_id} reaped unpromoted")
+                return constants.EXIT_SUCCESS
         try:
             with obs_trace.maybe_span("executor.register"):
                 self.register()

@@ -351,17 +351,27 @@ class ContainerLauncher:
                     self._reported.add(cid)
         return out
 
-    def kill(self, container_id: str, wait: bool = True) -> None:
+    def kill(self, container_id: str, wait: bool = True, force: bool = False) -> None:
         """SIGTERM the container's process group, escalating to SIGKILL after
         the container's grace window (tony.task.kill-grace-ms; default 3 s).
         ``wait=False`` runs the grace/escalation in a background thread — the
         node agent's heartbeat loop must never block on a container's
         teardown (a synchronous multi-second wait exceeds the liveness
-        window and gets the whole NODE declared dead)."""
+        window and gets the whole NODE declared dead). ``force=True`` skips
+        the drain entirely (immediate SIGKILL): pool preemption and node
+        death give no grace, and the chaos faults that simulate them must
+        not either."""
         with self._lock:
             proc = self._procs.get(container_id)
             grace_s = self._grace_s.get(container_id, 3.0)
         if not proc or proc.poll() is not None:
+            return
+        if force:
+            # the cgroup-kill analog: cross setsid boundaries (the executor
+            # starts the user child in its own session, so a plain killpg
+            # would orphan it — the graceful path relies on the executor's
+            # SIGTERM handler to reap the child, which SIGKILL never runs)
+            _kill_process_tree(proc.pid)
             return
         try:
             pgid = os.getpgid(proc.pid)
@@ -392,6 +402,43 @@ class ContainerLauncher:
             self.kill(cid, wait=wait)
 
 
+def _kill_process_tree(pid: int) -> None:
+    """SIGKILL ``pid`` and every descendant, crossing process-group/session
+    boundaries — what a container-runtime cgroup kill (pool preemption, node
+    death) does to the whole container subtree. /proc walk; on hosts without
+    /proc only the root's process group is killed."""
+    pgids = set()
+    try:
+        pgids.add(os.getpgid(pid))
+    except ProcessLookupError:
+        pass
+    try:
+        children: dict[int, list[tuple[int, int]]] = {}
+        for name in os.listdir("/proc"):
+            if not name.isdigit():
+                continue
+            try:
+                with open(f"/proc/{name}/stat") as f:
+                    # field 2 (comm) may contain spaces/parens: split after it
+                    rest = f.read().rsplit(")", 1)[1].split()
+                ppid, pgid = int(rest[1]), int(rest[2])
+            except (OSError, IndexError, ValueError):
+                continue
+            children.setdefault(ppid, []).append((int(name), pgid))
+        stack = [pid]
+        while stack:
+            for cpid, pgid in children.get(stack.pop(), ()):
+                pgids.add(pgid)
+                stack.append(cpid)
+    except OSError:
+        pass
+    for pgid in pgids:
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
 class ProcessContainerMixin:
     """RM-facing adapter over a local ``ContainerLauncher``: the in-process
     deployments (single-host RM, multi-slice pool emulation) launch through
@@ -415,6 +462,13 @@ class ProcessContainerMixin:
 
     def kill_container(self, container: Container) -> None:
         self.launcher.kill(container.id)
+
+    def kill_container_abrupt(self, container: Container) -> None:
+        """Chaos node-loss/preempt fidelity: a preempted container or a dead
+        node never drains politely — SIGKILL the process group outright
+        (the graceful path would also block the caller for the full grace
+        window per victim, letting survivors run seconds past the fault)."""
+        self.launcher.kill(container.id, force=True)
 
     def _live_containers(self) -> list[Container]:
         raise NotImplementedError

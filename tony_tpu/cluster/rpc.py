@@ -303,7 +303,9 @@ APPLICATION_RPC_METHODS = [
     "push_metrics",          # MetricsRpc analog
     "get_metrics",           # process metrics-registry snapshot (obs/metrics.py)
     "push_client_metrics",   # submitter-side registry (fleet router) re-exported by get_metrics
-    "resize_jobtype",        # elastic retarget of tony.<type>.instances (serve autoscaler)
+    "resize_jobtype",        # elastic retarget of tony.<type>.instances (autoscaler / tony resize)
+    "register_spare",        # hot-spare executor announces itself (tony.elastic.spares)
+    "poll_spare_assignment", # parked spare polls for a gang-slot promotion
     "start_profile",         # arm an on-demand profiler capture (tony profile)
     "get_profile_status",    # per-task capture status for the in-flight request
     "report_profile_status", # executors report delivery/capture back to the AM

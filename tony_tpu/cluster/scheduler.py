@@ -111,19 +111,26 @@ class TaskScheduler:
         return all(p.launched for p in self.plans.values())
 
     # -- allocation --------------------------------------------------------
-    def allocate_type(self, job_type: str) -> list[Container]:
+    def allocate_type(self, job_type: str, skip_indices: set[int] | None = None) -> list[Container]:
         """Allocate every instance of a type as one gang; all-or-nothing.
 
         AllocationError (never fits) fails the job. AllocationPending
         (queued behind other tenants) releases the partial gang — holding
         half a gang while waiting would deadlock against another waiter —
         and propagates so the AM retries the whole type on its next tick.
+
+        ``skip_indices``: instances already covered by another container
+        source (the AM's hot-spare promotion) — they are part of the gang
+        but need no fresh allocation here.
         """
         plan = self.plans[job_type]
+        skip = skip_indices or set()
         got: list[Container] = []
         t0 = time.perf_counter()
         try:
             for i in range(plan.instances):
+                if i in skip:
+                    continue
                 got.append(self.rm.allocate(job_type, i, plan.resources))
         except (AllocationError, AllocationPending):
             for c in got:
@@ -149,6 +156,29 @@ def _next_lower_divisor(orig: int, below: int, floor: int) -> int | None:
         if orig % n == 0:
             return n
     return None
+
+
+def plan_preempt_shrink(configured: int, current: int, preempted: int, floor: int) -> int | None:
+    """The shrink-on-preempt DECISION (``tony.elastic.shrink-on-preempt``):
+    ``preempted`` of the elastic type's ``current`` instances were taken by
+    the pool — return the instance count the survivors should re-form at, or
+    None when shrinking cannot help and the gang should re-queue at full
+    size (elasticity off via ``floor=0``, nothing actually lost, or even the
+    floor gang needs more workers than survived).
+
+    The target is always a DIVISOR of the ``configured`` count (4 → 2 → 1,
+    never 4 → 3) so the global batch and device mesh stay divisible across
+    the resize — the same rule :func:`plan_downsize` applies to capacity
+    loss."""
+    if floor < 1 or preempted < 1:
+        return None
+    survivors = current - preempted
+    if survivors < floor:
+        return None  # not enough left even for the floor gang: re-queue
+    target = _next_lower_divisor(configured, min(survivors, current - 1) + 1, floor)
+    if target is None or target >= current:
+        return None
+    return target
 
 
 def gang_demand(counts: dict[str, int], per_instance: dict[str, Resources]) -> Resources:

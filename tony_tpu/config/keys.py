@@ -133,6 +133,32 @@ HISTORY_MOVE_INTERVAL_MS = "tony.history.move-interval-ms"
 PORTAL_PORT = "tony.portal.port"
 
 # ---------------------------------------------------------------------------
+# tony.elastic.* — elastic training (docs/fault-tolerance.md)
+# ---------------------------------------------------------------------------
+# Which jobtype is the data-parallel axis the AM may resize live (shrink on
+# preemption/capacity loss, grow/shrink on resize_jobtype). The workers of
+# this type restore the checkpoint onto the resized mesh and the loader's
+# global-order draw keeps the sample stream exact (keep the GLOBAL batch
+# constant across sizes).
+ELASTIC_JOBTYPE = "tony.elastic.jobtype"
+# Shrink floor for the elastic jobtype; 0 (the default) disables elastic
+# shrinking entirely (equivalent to leaving tony.<type>.min-instances unset).
+ELASTIC_MIN_WORKERS = "tony.elastic.min-workers"
+# Grow ceiling for resize_jobtype on the elastic jobtype; 0 = no ceiling
+# beyond what the pool can place.
+ELASTIC_MAX_WORKERS = "tony.elastic.max-workers"
+# Preemption response: instead of re-queuing the FULL gang and waiting for
+# the pool to give the capacity back, shrink the elastic jobtype to the
+# largest divisor count the surviving workers can form (>= min-workers) and
+# resume from the latest checkpoint immediately.
+ELASTIC_SHRINK_ON_PREEMPT = "tony.elastic.shrink-on-preempt"
+# Hot spares: keep this many pre-registered spare executors of the elastic
+# jobtype parked next to the gang. A grow or preemption-replacement promotes
+# a spare — skipping container allocation and executor startup — cutting the
+# restart epoch from a full relaunch to a spec re-fence.
+ELASTIC_SPARES = "tony.elastic.spares"
+
+# ---------------------------------------------------------------------------
 # tony.serve.* — replicated serving control plane (docs/serving.md)
 # ---------------------------------------------------------------------------
 # Replica autoscaling bounds for the ``serve`` jobtype. max-replicas > 0
@@ -294,6 +320,12 @@ DEFAULTS: dict[str, str] = {
     HISTORY_LOCATION: "",            # empty → <staging-root>/history
     HISTORY_MOVE_INTERVAL_MS: "1000",
     PORTAL_PORT: "28080",
+
+    ELASTIC_JOBTYPE: "worker",
+    ELASTIC_MIN_WORKERS: "0",
+    ELASTIC_MAX_WORKERS: "0",
+    ELASTIC_SHRINK_ON_PREEMPT: "false",
+    ELASTIC_SPARES: "0",
 
     SERVE_MIN_REPLICAS: "0",
     SERVE_MAX_REPLICAS: "0",

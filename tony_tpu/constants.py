@@ -81,6 +81,10 @@ ENV_PROFILE_NUM_STEPS = "TONY_PROFILE_NUM_STEPS"      # static window length
 # how often (at most) the on-demand control file is stat'ed, ms
 ENV_PROFILE_POLL_MS = "TONY_PROFILE_POLL_MS"
 ENV_NOTEBOOK_PORT = "NOTEBOOK_PORT"     # notebook task port (proxied by submitter)
+# Hot-spare contract (tony.elastic.spares): set → this executor parks after
+# register_spare and polls for a gang-slot assignment instead of joining as
+# the (JOB_NAME, TASK_INDEX) identity it was nominally launched with
+ENV_SPARE_ID = "TONY_SPARE_ID"
 
 # ---------------------------------------------------------------------------
 # Env-var contract: framework rendezvous (runtime adapters, SURVEY.md §2.2)

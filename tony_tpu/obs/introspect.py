@@ -325,10 +325,36 @@ def step_stats_by_task(infos: list[dict[str, Any]],
     return out
 
 
+def visible_task_infos(infos: list[dict[str, Any]],
+                       instances: Mapping[str, int] | None,
+                       ) -> list[dict[str, Any]]:
+    """The single resized-away rule behind ``tony top`` and the portal task
+    table: tasks an elastic shrink removed — ``index >= instances[name]``,
+    with ``instances`` the effective per-type counts from
+    ``get_application_status`` — are dropped once terminal (they are not
+    dead tasks, the resize retired their slots) and relabeled
+    ``resized-away`` while teardown is still finishing."""
+    from tony_tpu.cluster.session import TaskStatus
+
+    if not instances:
+        return list(infos)
+    terminal = {s.value for s in TaskStatus if s.terminal}
+    visible: list[dict[str, Any]] = []
+    for t in infos:
+        n = instances.get(t["name"])
+        if n is not None and int(t["index"]) >= int(n):
+            if str(t.get("status", "")) in terminal:
+                continue
+            t = dict(t, status="resized-away")
+        visible.append(t)
+    return visible
+
+
 def build_top_rows(infos: list[dict[str, Any]],
                    task_obs: Mapping[str, Any],
                    now_ms: float | None = None,
                    prev_step_stats: Mapping[str, tuple[int, float]] | None = None,
+                   instances: Mapping[str, int] | None = None,
                    ) -> list[dict[str, Any]]:
     """One display row per task, synthesized from ``get_task_infos`` and the
     per-task registry snapshots of ``get_metrics``.
@@ -339,11 +365,15 @@ def build_top_rows(infos: list[dict[str, Any]],
       genuinely live, so a job that slows down shows the slowdown; on the
       first frame (or ``--once``) it falls back to the lifetime average;
     - ``queue_depth`` / ``ttft_s``: serve-replica instruments when present;
-    - ``hb_age_s``: seconds since the last executor heartbeat.
+    - ``hb_age_s``: seconds since the last executor heartbeat;
+    - ``instances``: the :func:`visible_task_infos` resized-away rule —
+      tasks an elastic shrink removed are dropped instead of rendering as
+      dead forever; a task the resize is still tearing down shows as
+      ``resized-away`` until its row disappears.
     """
     now_ms = time.time() * 1000.0 if now_ms is None else now_ms
     rows: list[dict[str, Any]] = []
-    for t in infos:
+    for t in visible_task_infos(infos, instances):
         tid = f"{t['name']}:{t['index']}"
         train = (t.get("metrics") or {}).get("train") or {}
         obs = task_obs.get(tid)

@@ -33,6 +33,7 @@ from urllib.parse import urlparse
 from tony_tpu import constants
 from tony_tpu.cluster import history
 from tony_tpu.cluster.events import Event
+from tony_tpu.obs import introspect as obs_introspect
 from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
 from tony_tpu.obs.metrics import REGISTRY, render_merged
@@ -353,12 +354,16 @@ class PortalHandler(BaseHTTPRequestHandler):
             return ""
         finally:
             cli.close()
+        # tasks an elastic shrink removed must not render as dead forever:
+        # the same drop-terminal / mark-resized-away rule tony top applies
+        visible = obs_introspect.visible_task_infos(
+            infos, status.get("instances") or {})
         rows = "".join(
             f"<tr><td>{html.escape(str(t['name']))}:{html.escape(str(t['index']))}</td>"
             f'<td class="{html.escape(str(t["status"]))}">{html.escape(str(t["status"]))}</td>'
             f"<td>{html.escape(str(t.get('host') or ''))}</td>"
             f"<td>{html.escape(json.dumps((t.get('metrics') or {}).get('train') or {})[:120])}</td></tr>"
-            for t in infos
+            for t in visible
         )
         return (
             f"<h2>live (AM state: {html.escape(str(status.get('state')))}"

@@ -27,6 +27,7 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Callable
 
+from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
 from tony_tpu.obs import trace as obs_trace
 from tony_tpu.serve.health import FleetSignals, HealthMonitor
@@ -136,5 +137,16 @@ class Autoscaler:
             current=current, target=target,
             queue_depth=sig.queue_depth, utilization=round(sig.utilization, 3),
         )
+        try:
+            self._resize(self.job_name, target)
+        except Exception as e:  # noqa: BLE001 — typed rejection vs transport churn
+            if "InvalidResizeError" in str(e):
+                # the AM's typed verdict (out of tony.elastic.* bounds, or a
+                # resize is already pending): surface it and hold the old
+                # target — re-deciding next tick is correct either way
+                obs_logging.warning(
+                    f"[tony-serve] autoscaler resize {self.job_name}→{target} "
+                    f"rejected: {e}")
+                return
+            raise  # transport failure: the loop's catch-all retries next tick
         self.target = target
-        self._resize(self.job_name, target)

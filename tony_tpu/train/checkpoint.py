@@ -5,6 +5,14 @@ restarted gangs). Here checkpoint/resume is part of the framework because the
 AM's gang-restart elasticity (appmaster.py) is only useful if a restarted gang
 resumes: Orbax async sharded save (per-host writes, non-blocking train loop) +
 latest-step restore with the target sharding applied on load.
+
+Cross-topology restore (the elastic-training contract,
+docs/fault-tolerance.md): a checkpoint written on mesh ``{data: N}`` restores
+onto ``{data: M}`` for any M — ``restore`` never trusts the sharding recorded
+IN the checkpoint, it always imposes the sharding of the caller's
+``state_like`` (the state the resized gang just ``sharded_init``-ed on its
+OWN mesh), so the arrays land resharded for the new topology in one pass.
+Asserted 4-way → 2-way → 1-way in tests/test_elastic.py.
 """
 
 from __future__ import annotations
@@ -66,7 +74,13 @@ class CheckpointManager:
 
     def restore(self, state_like: Any, step: int | None = None) -> Any:
         """Restore into the sharding/structure of ``state_like`` (an abstract
-        or concrete pytree; concrete shardings are honored on load)."""
+        or concrete pytree; concrete shardings are honored on load).
+
+        The TARGET sharding always wins over whatever sharding the
+        checkpoint was written under — this is what lets an elastically
+        resized gang restore a ``{data: N}`` checkpoint onto its ``{data:
+        M}`` mesh directly (re-sharding happens inside the Orbax load, no
+        full-size intermediate materialization on any one host)."""
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")

@@ -232,6 +232,7 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
         from pathlib import Path
 
         from tony_tpu.data import TokenLoader
+        from tony_tpu.data.dataset import ConsumptionCursor
 
         paths = sorted(Path(loop.data_dir).glob("*.tonytok"))
         # exact replay on resume: the draw is a pure function of
@@ -239,7 +240,20 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
         # FIXED and starting the loader at the resumed step replays the
         # uninterrupted stream — no sample repeated or skipped — even when
         # the gang restarted at a DIFFERENT size (global-order contract,
-        # data/native.py)
+        # data/native.py). The consumption cursor persisted next to each
+        # checkpoint proves the resumed stream IS the checkpointed one: a
+        # silently changed global batch or seed fails here instead of
+        # double-consuming or dropping samples across the resize.
+        if start_step and loop.checkpoint_dir:
+            cursor = ConsumptionCursor.load(loop.checkpoint_dir, start_step)
+            if cursor is not None:
+                cursor.validate_resume(loop.batch_size, loop.data_seed, start_step)
+                obs_logging.info(
+                    f"[train] data cursor validated: resuming the global "
+                    f"stream at batch {start_step} "
+                    f"(written at world size {cursor.world_size}, now {procs})",
+                    step=start_step,
+                )
         loader = TokenLoader(
             paths, local_rows, loop.seq_len,
             shard_id=jax.process_index(), num_shards=procs,
@@ -247,6 +261,19 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
         )
         obs_logging.info(f"[train] data: {len(paths)} shards, {loader.total_tokens} tokens, "
                          f"native={loader.is_native}")
+
+        def drop_cursor(next_batch: int) -> None:
+            # rank 0 persists the consumption position with every checkpoint
+            if jax.process_index() == 0:
+                ConsumptionCursor(
+                    global_batch_index=next_batch,
+                    global_batch_size=loop.batch_size,
+                    seed=loop.data_seed,
+                    world_size=procs,
+                ).save(loop.checkpoint_dir)
+    else:
+        def drop_cursor(next_batch: int) -> None:
+            pass
 
     assemble = None
     if procs > 1:
@@ -329,6 +356,7 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
                 and (step + 1) % loop.checkpoint_every == 0
             ):
                 ckpt_mgr.save(step + 1, state)
+                drop_cursor(step + 1)
     finally:
         # a failed step/save must not leak the loader's native prefetch
         # threads + mmapped shards (gang restarts re-enter this function
@@ -340,6 +368,7 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
         # skip if this step is already on disk (resume that ran no new steps)
         if ckpt_mgr.latest_step() != loop.steps:
             ckpt_mgr.save(loop.steps, state, force=True)
+            drop_cursor(loop.steps)
         ckpt_mgr.wait()
         ckpt_mgr.close()
     _drop_obs_metrics()  # final flush: last window + final checkpoint sample
