@@ -1,0 +1,35 @@
+"""Takeover workload: count steps, publish train metrics, log every step.
+
+A deliberately jax-free trainer for the control-plane chaos suite: each step
+it atomically drops ``{"step": N, "loss": ...}`` at $TONY_TRAIN_METRICS_FILE
+(the executor's metrics push feeds it to the AM, arming ``@step+N``-gated
+faults like ``am-crash@step+3``) and appends the step to a per-task log in
+<out_dir>. The log is the test's evidence: exactly one ``start`` line means
+the child never restarted across the AM takeover, and the recorded step
+sequence must be strictly 1..N — monotonic, no regression, no replay.
+
+Usage: takeover_train.py <steps> <out_dir>
+"""
+
+import json
+import os
+import sys
+import time
+
+steps, out_dir = int(sys.argv[1]), sys.argv[2]
+metrics_path = os.environ["TONY_TRAIN_METRICS_FILE"]
+idx = os.environ["TASK_INDEX"]
+attempt = os.environ.get("TONY_RESTART_ATTEMPT", "0")
+os.makedirs(out_dir, exist_ok=True)
+
+with open(os.path.join(out_dir, f"steps-{idx}.log"), "a", buffering=1) as log:
+    log.write(f"start attempt={attempt} pid={os.getpid()}\n")
+    for s in range(1, steps + 1):
+        tmp = metrics_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": s, "loss": 1.0 / s}, f)
+        os.replace(tmp, metrics_path)
+        log.write(f"step {s}\n")
+        time.sleep(0.15)
+
+print(f"fixture: takeover worker {idx} completed {steps} steps")

@@ -415,15 +415,18 @@ class TestChaosRecoveryE2E:
 
 
 # ---------------------------------------------------------------------------
-# seeded multi-fault soak (slow): crash + torn checkpoint + rpc noise
+# seeded multi-fault soak (slow): AM SIGKILL + crash + torn ckpt + rpc noise
 # ---------------------------------------------------------------------------
 @pytest.mark.e2e
 @pytest.mark.slow
 class TestMultiFaultSoak:
-    def test_soak_resumes_through_torn_checkpoint(self, tmp_tony_root):
+    def test_soak_resumes_through_torn_checkpoint_and_am_crash(self, tmp_tony_root):
         from tony_tpu.cli.chaos import _find_orphans, verify_chaos_run
 
-        spec = "rpc-drop:p=0.02;ckpt-corrupt:latest"
+        # am-crash rides along with the executor/rpc faults: the control
+        # plane dies mid-run (work-preserving takeover adopts the gang) AND
+        # the data plane still crashes + tears its checkpoint afterwards
+        spec = "rpc-drop:p=0.02;ckpt-corrupt:latest;am-crash@t+2s"
         cfg = TonyConfig({
             **FAST,
             keys.STAGING_ROOT: str(tmp_tony_root),
@@ -431,6 +434,7 @@ class TestMultiFaultSoak:
             keys.EXECUTES: fixture_cmd("chaos_train.py"),
             keys.TASK_RESTART_ON_FAILURE: "true",
             keys.TASK_MAX_MISSED_HEARTBEATS: "100",  # jax compile outlasts the fast hb budget
+            keys.AM_RETRY_COUNT: "1",
             keys.CHAOS_SPEC: spec,
             keys.CHAOS_SEED: "20260803",
         })
@@ -450,5 +454,7 @@ class TestMultiFaultSoak:
 
         failures, info = verify_chaos_run(handle, cfg)
         assert not failures, failures
-        assert info["gang_epochs"] == 2
+        assert info["gang_epochs"] == 2  # the takeover consumed NO gang epoch
+        assert info["takeovers"] == 1 and not info["takeovers_degraded"]
+        assert handle.final_status()["am_attempt"] == 1
         assert not _find_orphans(handle.app_id)

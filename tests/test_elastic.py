@@ -66,8 +66,9 @@ class TestStepGatedFaults:
         assert f.step_gate == 4 and f.delay_ms == 0 and f.trigger is None
         assert f.target == ("worker", 3)
 
-    def test_step_gate_is_container_faults_only(self):
-        with pytest.raises(ValueError, match="container faults only"):
+    def test_step_gate_is_am_decided_faults_only(self):
+        # container faults + am-crash: the AM is the only process fed steps
+        with pytest.raises(ValueError, match="AM-decided faults only"):
             FaultSchedule.parse("rpc-drop:p=1@step+2")
 
     def test_bad_step_gates_rejected(self):

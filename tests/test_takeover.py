@@ -1,0 +1,592 @@
+"""Work-preserving control-plane restart suite (docs/fault-tolerance.md
+"Control-plane failures").
+
+The AM and pool service die by SIGKILL (`am-crash` / `pool-crash`) and their
+successors must ADOPT the live work: journal units, AM replay semantics,
+resync fencing, container adoption (local + remote pools), pool queue
+recovery through agent re-registration, and the headline e2e — a 2-worker
+training gang whose AM is SIGKILLed mid-run finishes SUCCEEDED with zero
+executor restarts and a strictly monotonic step counter, asserted under
+``tony chaos --expect-takeover``. The corrupt-journal case degrades loudly
+to a full gang restart.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.chaos import FaultSchedule
+from tony_tpu.cluster import history
+from tony_tpu.cluster.appmaster import ApplicationMaster, _replay_am_journal
+from tony_tpu.cluster.journal import Journal, JournalError, read_journal
+from tony_tpu.cluster.pool import PoolService, RemoteResourceManager
+from tony_tpu.cluster.resources import ContainerLauncher, LocalResourceManager, Resources
+from tony_tpu.cluster.session import JobStatus
+from tony_tpu.config import TonyConfig, keys
+
+from tests.test_e2e import FAST, fixture_cmd
+
+pytestmark = [pytest.mark.chaos, pytest.mark.elastic]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# journal substrate
+# ---------------------------------------------------------------------------
+class TestJournal:
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        j = Journal(p)
+        j.append("epoch", attempt=0, resized={})
+        j.append("registered", job="worker", index=1, host="h", port=9)
+        j.close()
+        recs = read_journal(p)
+        assert [r["t"] for r in recs] == ["epoch", "registered"]
+        assert recs[1]["index"] == 1
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        j = Journal(p)
+        j.append("epoch", attempt=0)
+        j.append("gang_complete")
+        j.close()
+        with open(p, "a") as f:
+            f.write('{"t": "registered", "job": "wor')  # SIGKILL mid-append
+        recs = read_journal(p)
+        assert [r["t"] for r in recs] == ["epoch", "gang_complete"]
+
+    def test_mid_file_garbage_is_corrupt(self, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        with open(p, "w") as f:
+            f.write('{"t": "epoch", "attempt": 0}\n')
+            f.write("}{ definitely not json\n")
+            f.write('{"t": "gang_complete"}\n')
+        with pytest.raises(JournalError, match="corrupt journal record at line 2"):
+            read_journal(p)
+
+    def test_missing_and_empty(self, tmp_path):
+        with pytest.raises(JournalError, match="missing"):
+            read_journal(str(tmp_path / "nope.jsonl"))
+        p = str(tmp_path / "empty.jsonl")
+        open(p, "w").close()
+        with pytest.raises(JournalError, match="empty"):
+            read_journal(p)
+
+    def test_append_survives_closed_file(self, tmp_path):
+        # teardown race: a late append must never raise into the caller
+        j = Journal(str(tmp_path / "j.jsonl"))
+        j.close()
+        j.append("epoch", attempt=0)  # no raise
+
+
+# ---------------------------------------------------------------------------
+# AM journal replay semantics
+# ---------------------------------------------------------------------------
+def _rec(t, **kw):
+    return {"t": t, **kw}
+
+
+class TestAmJournalReplay:
+    def test_basic_state(self):
+        st = _replay_am_journal([
+            _rec("epoch", attempt=0, resized={}),
+            _rec("registered", job="worker", index=0, host="h0", port=1),
+            _rec("registered", job="worker", index=1, host="h1", port=2),
+            _rec("gang_complete"),
+            _rec("task_started", job="worker", index=0, cid="c0",
+                 log_dir="/l", started_ms=5, container={"id": "c0"}),
+            _rec("task_done", job="worker", index=1, exit_code=0),
+            _rec("chaos_step", step=4),
+            _rec("failures", n=2),
+        ])
+        assert st.attempt == 0 and st.gang_complete
+        assert st.registered == {("worker", 0): ("h0", 1), ("worker", 1): ("h1", 2)}
+        assert st.done == {("worker", 1): 0}
+        assert list(st.containers) == ["c0"]
+        assert st.chaos_step == 4 and st.failures == 2
+
+    def test_epoch_supersedes_task_records(self):
+        st = _replay_am_journal([
+            _rec("epoch", attempt=0, resized={}),
+            _rec("registered", job="worker", index=0, host="old", port=1),
+            _rec("task_started", job="worker", index=0, cid="c0", container={}),
+            _rec("gang_complete"),
+            _rec("failures", n=1),
+            _rec("epoch", attempt=1, resized={"worker": 2}),
+            _rec("registered", job="worker", index=0, host="new", port=9),
+        ])
+        assert st.attempt == 1 and st.resized == {"worker": 2}
+        assert st.registered == {("worker", 0): ("new", 9)}
+        assert not st.containers and not st.gang_complete
+        assert st.failures == 1  # cross-epoch: the budget survives restarts
+
+    def test_pending_resize_last_wins(self):
+        st = _replay_am_journal([
+            _rec("epoch", attempt=0, resized={}),
+            _rec("pending_resize", resizes={"serve": 4}),
+            _rec("pending_resize", resizes={}),
+        ])
+        assert st.pending == {}
+
+    def test_no_epoch_record_is_error(self):
+        with pytest.raises(JournalError, match="no epoch record"):
+            _replay_am_journal([_rec("gang_complete")])
+
+    def test_unknown_record_type_is_error(self):
+        with pytest.raises(JournalError, match="unknown journal record"):
+            _replay_am_journal([_rec("epoch", attempt=0), _rec("from_the_future")])
+
+
+# ---------------------------------------------------------------------------
+# resync fencing: only an AM that actually adopted accepts re-attaches
+# ---------------------------------------------------------------------------
+class TestResyncFencing:
+    @pytest.fixture()
+    def am(self, tmp_path):
+        cfg = TonyConfig({"tony.worker.instances": "1"})
+        am = ApplicationMaster(cfg, "app_resync_test", str(tmp_path / "stage"))
+        yield am
+        am.rpc.stop()
+        am.events.stop()
+        am.rm.shutdown()
+
+    def test_non_takeover_am_rejects_resync(self, am):
+        am.register_worker_spec("worker", 0, "127.0.0.1", 1234, attempt=0)
+        assert am.resync_task("worker", 0, "127.0.0.1", 1234, attempt=0)["stale"]
+
+    def test_adopted_am_accepts_and_refreshes_heartbeat(self, am):
+        am.register_worker_spec("worker", 0, "127.0.0.1", 1234, attempt=0)
+        am._takeover_outcome = "adopted"
+        task = am.session.get_task("worker", 0)
+        task.last_heartbeat_ms = 1.0  # ancient: about to be declared dead
+        resp = am.resync_task("worker", 0, "127.0.0.1", 4321, attempt=0)
+        assert resp["ack"]
+        assert task.port == 4321
+        assert task.last_heartbeat_ms > 1.0
+        assert not am.session.find_dead_tasks(100, 3)
+
+    def test_adopted_am_fences_stale_epoch_and_unknown_task(self, am):
+        am._takeover_outcome = "adopted"
+        am._restart_attempt = 2
+        assert am.resync_task("worker", 0, "h", 1, attempt=1)["stale"]
+        assert am.resync_task("ghost", 7, "h", 1, attempt=2)["stale"]
+
+
+# ---------------------------------------------------------------------------
+# container adoption: launcher pid tracking + local RM re-accounting
+# ---------------------------------------------------------------------------
+def _spawn_detached() -> int:
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"],
+        start_new_session=True,
+    )
+    return proc.pid
+
+
+class TestAdoptedContainers:
+    def test_launcher_adopts_probes_and_kills(self):
+        pid = _spawn_detached()
+        launcher = ContainerLauncher()
+        launcher.adopt("c_adopt", pid, grace_s=0.2)
+        assert "c_adopt" in launcher.live_ids()
+        assert launcher.poll_exited() == {}
+        launcher.kill("c_adopt", force=True)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            exited = launcher.poll_exited()
+            if exited:
+                break
+            time.sleep(0.05)
+        assert exited == {"c_adopt": constants.EXIT_ADOPTED_UNKNOWN}
+        assert "c_adopt" not in launcher.live_ids()
+
+    def test_dead_pid_surfaces_on_first_poll(self):
+        launcher = ContainerLauncher()
+        launcher.adopt("c_gone", 2**22 + os.getpid())  # almost surely no such pid
+        assert launcher.poll_exited() == {"c_gone": constants.EXIT_ADOPTED_UNKNOWN}
+
+    def test_local_rm_adoption_reaccounts(self):
+        rm1 = LocalResourceManager("local:cpu,2x2")
+        res = Resources(memory_bytes=1 << 30, vcores=2, chips=2)
+        c = rm1.allocate("worker", 0, res)
+        pid = _spawn_detached()
+        # stand in for a real start_container: the launcher only needs a pid
+        rm1.launcher.adopt(c.id, pid)
+        rec = rm1.journal_info(c)
+        assert rec is not None and rec["pid"] == pid and rec["job_type"] == "worker"
+
+        rm2 = LocalResourceManager("local:cpu,2x2")
+        c2 = rm2.adopt_container(rec)
+        assert c2 is not None and c2.id == c.id and c2.chip_coords == c.chip_coords
+        assert rm2.host.used_memory == 1 << 30 and rm2.host.used_vcores == 2
+        assert rm2.grid.free == 2
+        # double adoption = the journal disagrees with the world → refused
+        assert rm2.adopt_container(rec) is None
+        # adopted containers die through the normal kill path
+        rm2.kill_container(c2)
+        rm2.release(c2)
+        assert rm2.host.used_memory == 0 and rm2.grid.free == 4
+        if _alive(pid):
+            os.kill(pid, signal.SIGKILL)
+
+    def test_remote_rm_adoption_against_live_pool(self):
+        svc = PoolService()
+        try:
+            svc.register_node(name="n0", host="127.0.0.1", port=1,
+                              memory_bytes=4 << 30, vcores=8, live=[])
+            svc.rpc.start()
+            host, port = svc.address
+            rm1 = RemoteResourceManager(host, port, app_id="app_adopt")
+            c = rm1.allocate("worker", 0, Resources(memory_bytes=1 << 30, vcores=1))
+            rec = rm1.journal_info(c)
+            assert rec is not None and rec["agent_host"] == "127.0.0.1"
+            # the successor AM process: same app id, fresh client state
+            rm2 = RemoteResourceManager(host, port, app_id="app_adopt")
+            c2 = rm2.adopt_container(rec)
+            assert c2 is not None and c2.id == c.id
+            # the pool still holds the allocation; release flows through it
+            rm2.release(c2)
+            assert c.id not in svc._containers
+            rm1.rm.close()
+            rm2.shutdown()
+        finally:
+            svc.stop()
+
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# grammar: the control-plane fault kinds
+# ---------------------------------------------------------------------------
+class TestControlPlaneGrammar:
+    def test_am_crash_and_pool_crash_parse(self):
+        s = FaultSchedule.parse("am-crash@step+3;pool-crash@t+2s")
+        assert [f.kind for f in s.faults] == ["am-crash", "pool-crash"]
+        assert s.faults[0].step_gate == 3
+        assert s.faults[1].delay_ms == 2000
+
+    def test_step_gate_rejected_for_pool_crash(self):
+        # pool-crash is decided in the pool service, which never sees steps
+        with pytest.raises(ValueError, match="AM-decided"):
+            FaultSchedule.parse("pool-crash@step+3")
+
+
+# ---------------------------------------------------------------------------
+# pool recovery: journal + agent re-registration (satellite: agent.py:154)
+# ---------------------------------------------------------------------------
+class TestPoolRecovery:
+    def _pool(self, tmp_path, **kw):
+        return PoolService(journal_path=str(tmp_path / "pool.jsonl"), **kw)
+
+    def test_restart_preserves_queue_state_and_containers(self, tmp_path):
+        svc = self._pool(tmp_path, queues={"prod": 0.5, "dev": 0.5})
+        svc.register_node(name="n0", host="h", port=1,
+                          memory_bytes=4 << 30, vcores=8, live=[])
+        svc.register_app(app_id="running", queue="prod",
+                         memory_bytes=3 << 30, vcores=2)
+        got = svc.allocate("running", "worker", 0, 3 << 30, 2, 0)
+        cid = got["id"]
+        # a second app that cannot fit yet: admitted=False, waiting in dev
+        svc.register_app(app_id="waiting", queue="dev",
+                         memory_bytes=3 << 30, vcores=2)
+        assert svc._apps["running"].admitted and not svc._apps["waiting"].admitted
+        svc.stop()
+
+        svc2 = self._pool(tmp_path, queues={"prod": 0.5, "dev": 0.5})
+        try:
+            # admitted app stays admitted (not re-admitted from scratch — its
+            # claim survives), waiting app is still waiting, seq order kept
+            assert svc2._apps["running"].admitted
+            assert not svc2._apps["waiting"].admitted
+            assert svc2._apps["running"].seq < svc2._apps["waiting"].seq
+            assert svc2._containers[cid]["state"] == "RUNNING"
+            # agent re-registration re-adopts the live container: accounting
+            # restored, nothing in the kill list, and the running app is NOT
+            # evicted by the post-registration scheduling pass
+            resp = svc2.register_node(name="n0", host="h", port=1,
+                                      memory_bytes=4 << 30, vcores=8, live=[cid])
+            assert resp["kill"] == []
+            assert svc2._nodes["n0"].used_memory == 3 << 30
+            assert svc2._apps["running"].admitted and not svc2._apps["running"].preempted
+            # a second allocate for the admitted app is NOT double-admitted —
+            # it simply keeps allocating under its surviving claim
+            assert svc2._held_locked("running") == (3 << 30, 2, 0)
+        finally:
+            svc2.stop()
+
+    def test_journal_less_pool_kills_unknown_containers(self, tmp_path):
+        svc = PoolService()  # no journal: recognizes nothing after "restart"
+        try:
+            resp = svc.register_node(name="n0", host="h", port=1,
+                                     memory_bytes=4 << 30, vcores=8,
+                                     live=["container_orphan"])
+            assert resp["kill"] == ["container_orphan"]
+        finally:
+            svc.stop()
+
+    def test_agent_restart_writes_off_its_dead_containers(self, tmp_path):
+        svc = self._pool(tmp_path)
+        try:
+            svc.register_node(name="n0", host="h", port=1,
+                              memory_bytes=4 << 30, vcores=8, live=[])
+            got = svc.allocate("app", "worker", 0, 1 << 30, 1, 0)
+            cid = got["id"]
+            # agent process restarted: it re-registers with an EMPTY live
+            # list — the container it was running died with it
+            resp = svc.register_node(name="n0", host="h", port=1,
+                                     memory_bytes=4 << 30, vcores=8, live=[])
+            assert resp["kill"] == []
+            assert svc.poll_exited("app") == {cid: constants.EXIT_NODE_LOST}
+            assert svc._nodes["n0"].used_memory == 0
+        finally:
+            svc.stop()
+
+    def test_unlaunched_container_survives_recovery_reconcile(self, tmp_path):
+        # allocated-but-not-yet-launched (never seen live by any agent): a
+        # post-recovery agent registration must NOT write it off — the AM may
+        # still launch it
+        svc = self._pool(tmp_path)
+        svc.register_node(name="n0", host="h", port=1,
+                          memory_bytes=4 << 30, vcores=8, live=[])
+        got = svc.allocate("app", "worker", 0, 1 << 30, 1, 0)
+        cid = got["id"]
+        svc.stop()
+        svc2 = self._pool(tmp_path)
+        try:
+            resp = svc2.register_node(name="n0", host="h", port=1,
+                                      memory_bytes=4 << 30, vcores=8, live=[])
+            assert resp["kill"] == []
+            assert svc2._containers[cid]["state"] == "RUNNING"
+            assert svc2.poll_exited("app") == {}
+            # its claim is re-accounted even though no agent reported it live
+            # yet — allocate() must not double-book the unlaunched container
+            assert svc2._nodes["n0"].used_memory == 1 << 30
+        finally:
+            svc2.stop()
+
+    def test_failed_recovery_starts_empty_not_half_replayed(self, tmp_path):
+        jp = tmp_path / "pool.jsonl"
+        # a valid app record followed by mid-file garbage: adopting the half-
+        # replayed state would be fiction — the pool must start EMPTY (and
+        # must start: no exception escapes __init__)
+        jp.write_text(
+            '{"t": "app", "app_id": "a", "queue": "default", "seq": 0, '
+            '"admitted": true, "priority": 0, "preempted": false, '
+            '"demand_memory": 1, "demand_vcores": 1, "demand_chips": 0}\n'
+            "}{ garbage\n"
+            '{"t": "app_removed", "app_id": "a"}\n'
+        )
+        svc = PoolService(journal_path=str(jp))
+        try:
+            assert svc._apps == {} and svc._containers == {}
+        finally:
+            svc.stop()
+        # same for a structurally-broken record (KeyError, not JournalError)
+        jp2 = tmp_path / "pool2.jsonl"
+        jp2.write_text('{"t": "app", "app_id": "a"}\n')  # no queue/seq
+        svc2 = PoolService(journal_path=str(jp2))
+        try:
+            assert svc2._apps == {}
+        finally:
+            svc2.stop()
+
+    def test_backstop_kill_survives_pool_restart(self, tmp_path):
+        svc = self._pool(tmp_path)
+        svc.register_node(name="n0", host="h", port=1,
+                          memory_bytes=4 << 30, vcores=8, live=[])
+        got = svc.allocate("app", "worker", 0, 1 << 30, 1, 0)
+        cid = got["id"]
+        svc.node_heartbeat("n0", exited={}, live=[cid])  # seen live
+        svc.stop()
+        svc2 = self._pool(tmp_path)
+        try:
+            # the AM's backstop kill lands while the node is still away:
+            # the order must not be dropped — with work-preserving
+            # re-adoption nothing else would ever terminate this container
+            svc2.request_kill(cid)
+            resp = svc2.register_node(name="n0", host="h", port=1,
+                                      memory_bytes=4 << 30, vcores=8, live=[cid])
+            assert cid in resp["kill"]
+            # the claim stays accounted until the agent reports the exit
+            assert svc2._nodes["n0"].used_memory == 1 << 30
+        finally:
+            svc2.stop()
+
+    def test_undelivered_exits_survive_restart_once(self, tmp_path):
+        svc = self._pool(tmp_path)
+        svc.register_node(name="n0", host="h", port=1,
+                          memory_bytes=4 << 30, vcores=8, live=[])
+        a = svc.allocate("app", "worker", 0, 1 << 30, 1, 0)["id"]
+        b = svc.allocate("app", "worker", 1, 1 << 30, 1, 0)["id"]
+        svc.node_heartbeat("n0", exited={a: 1}, live=[b])
+        # exit recorded but NOT polled before the crash → re-delivered after
+        svc.stop()
+        svc2 = self._pool(tmp_path)
+        assert svc2.poll_exited("app") == {a: 1}
+        svc2.stop()
+        # ... and the poll was journaled: a second restart re-delivers nothing
+        svc3 = self._pool(tmp_path)
+        try:
+            assert svc3.poll_exited("app") == {}
+        finally:
+            svc3.stop()
+
+    @pytest.mark.e2e
+    def test_pool_crash_fault_sigkills_the_daemon(self, tmp_path):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        info = str(tmp_path / "pool_info.json")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tony_tpu.cluster.pool",
+             "--port", "0", "--info-file", info,
+             "--journal-file", str(tmp_path / "pool.jsonl"),
+             "--conf", "tony.chaos.spec=pool-crash",
+             "--heartbeat-ms", "100"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+        try:
+            rc = proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert rc == -signal.SIGKILL  # fidelity: abrupt death, no drain
+
+
+# ---------------------------------------------------------------------------
+# satellite: no bare json.dump to a final path in cluster/ — a SIGKILL
+# (am-crash / pool-crash) mid-write must never truncate a status/control
+# file another process reads
+# ---------------------------------------------------------------------------
+class TestAtomicWriteDiscipline:
+    def test_every_cluster_json_dump_goes_through_a_tmp_file(self):
+        import tony_tpu.cluster as cluster_pkg
+
+        root = os.path.dirname(os.path.abspath(cluster_pkg.__file__))
+        offenders = []
+        for fn in sorted(os.listdir(root)):
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(root, fn)) as f:
+                lines = f.read().splitlines()
+            for i, line in enumerate(lines):
+                if not re.search(r"\bjson\.dump\(", line):
+                    continue  # json.dumps (string form) is fine anywhere
+                window = "\n".join(lines[max(0, i - 6): i + 1])
+                if "tmp" not in window:
+                    offenders.append(f"{fn}:{i + 1}")
+        assert not offenders, (
+            "bare json.dump to a final path (no write-tmp-then-os.replace "
+            f"within 6 lines) in cluster/: {offenders}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# headline e2e: AM SIGKILLed mid-run → adopted, zero restarts, SUCCEEDED
+# ---------------------------------------------------------------------------
+TAKEOVER_CONF = {
+    keys.AM_RETRY_COUNT: "2",
+    keys.TASK_METRICS_INTERVAL_MS: "100",   # fast step feed for @step+N
+    keys.TASK_MAX_MISSED_HEARTBEATS: "60",  # 6 s outage budget at 100 ms beats
+}
+
+
+@pytest.mark.e2e
+class TestTakeoverE2E:
+    def test_am_sigkill_mid_run_adopts_gang_zero_restarts(
+        self, tmp_tony_root, tmp_path, capsys
+    ):
+        from tony_tpu.cli.chaos import main as chaos_main
+
+        out_dir = tmp_path / "steps"
+        steps = 20
+        rc = chaos_main([
+            "--spec", "am-crash@step+3",
+            "--seed", "7",
+            "--executes", f"{fixture_cmd('takeover_train.py')} {steps} {out_dir}",
+            "--workers", "2",
+            "--expect-takeover",
+            "--conf", f"{keys.STAGING_ROOT}={tmp_tony_root}",
+        ] + [f"--conf={k}={v}" for k, v in {**FAST, **TAKEOVER_CONF}.items()])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "invariants: OK" in out
+        assert "AM takeovers: 1 adopted" in out
+        assert "gang epochs: 1" in out  # ZERO executor restarts
+
+        # per-worker: the child never restarted and the step counter is
+        # strictly monotonic across the takeover (no regression, no replay)
+        for idx in ("0", "1"):
+            lines = (out_dir / f"steps-{idx}.log").read_text().splitlines()
+            starts = [ln for ln in lines if ln.startswith("start ")]
+            assert len(starts) == 1 and "attempt=0" in starts[0], lines
+            seen = [int(ln.split()[1]) for ln in lines if ln.startswith("step ")]
+            assert seen == list(range(1, steps + 1)), seen
+
+        (app_dir,) = [d for d in os.listdir(tmp_tony_root)
+                      if d.startswith("application_")]
+        with open(os.path.join(tmp_tony_root, app_dir, "am_status.json")) as f:
+            status = json.load(f)
+        assert status["status"] == "SUCCEEDED"
+        assert status["am_attempt"] == 1 and status["takeover"] == "adopted"
+        assert status["restart_attempt"] == 0
+        # the takeover is on the event stream exactly once, never degraded
+        evs = [e.type.value for e in history.read_events(
+            os.path.join(str(tmp_tony_root), "history"), app_dir)]
+        assert evs.count("AM_TAKEOVER") == 1
+        assert "AM_TAKEOVER_DEGRADED" not in evs
+        assert "TASK_RESYNCED" in evs
+
+    def test_corrupt_journal_degrades_to_full_gang_restart(
+        self, tmp_tony_root, tmp_path
+    ):
+        from tony_tpu.cli.chaos import _find_orphans
+        from tony_tpu.cluster.client import Client
+
+        out_dir = tmp_path / "steps"
+        cfg = TonyConfig({
+            **FAST, **TAKEOVER_CONF,
+            keys.STAGING_ROOT: str(tmp_tony_root),
+            "tony.worker.instances": "1",
+            keys.EXECUTES: f"{fixture_cmd('takeover_train.py')} 10 {out_dir}",
+            keys.CHAOS_SPEC: "am-crash@step+2",
+            keys.CHAOS_SEED: "3",
+        })
+        client = Client(cfg)
+        handle = client.submit()
+        assert handle.am_process.wait(timeout=90) == -signal.SIGKILL
+        # corrupt the journal MID-FILE (not a torn tail): takeover must
+        # refuse to adopt fiction and degrade loudly
+        jpath = os.path.join(handle.staging_dir, constants.AM_JOURNAL_FILE)
+        lines = open(jpath).read().splitlines()
+        assert len(lines) >= 2, lines
+        with open(jpath, "w") as f:
+            f.write(lines[0] + "\n}{corrupt\n" + "\n".join(lines[1:]) + "\n")
+
+        final = client.monitor_application(handle, quiet=True)
+        assert final == JobStatus.SUCCEEDED, handle.final_status()
+        evs = [e.type.value for e in history.read_events(
+            os.path.join(str(tmp_tony_root), "history"), handle.app_id)]
+        assert "AM_TAKEOVER_DEGRADED" in evs
+        assert "AM_TAKEOVER" not in evs
+        # full gang restart: the worker ran twice (and only twice)
+        lines = (out_dir / "steps-0.log").read_text().splitlines()
+        starts = [ln for ln in lines if ln.startswith("start ")]
+        assert len(starts) == 2, lines
+        # and the degraded path leaked no orphans
+        assert not _find_orphans(handle.app_id)
+        status = handle.final_status()
+        assert status["am_attempt"] == 1 and status["takeover"] == "degraded"

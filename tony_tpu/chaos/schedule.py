@@ -14,9 +14,10 @@ Each entry is ``kind[:<job>:<index>][:k=v ...][:arg ...][@trigger]`` where
 - bare tokens are positional arguments (``ckpt-corrupt:latest``);
 - ``@t+5s`` arms the fault 5 s after the injecting process starts;
   ``@step+4`` arms it once the job's reported TRAINING step reaches 4
-  (container faults only — the AM gates on the metrics the executors push,
-  so a "preempt K workers mid-run" schedule fires against progress, not
-  wall time); ``@gang_complete`` / ``@registered`` tie it to a lifecycle
+  (AM-decided faults only: container faults and ``am-crash`` — the AM gates
+  on the metrics the executors push, so a "preempt K workers mid-run" or
+  "SIGKILL the AM mid-run" schedule fires against progress, not wall
+  time); ``@gang_complete`` / ``@registered`` tie it to a lifecycle
   point instead.
 
 Entries parse to :class:`FaultSpec` rows inside a :class:`FaultSchedule`
@@ -48,6 +49,9 @@ FAULT_KINDS = frozenset({
     "node-loss",       # every live container dies with EXIT_NODE_LOST
     "preempt",         # targeted containers die with EXIT_PREEMPTED (budget-exempt)
     "capacity-flap",   # a capacity probe sees an empty pool (downsize hysteresis test)
+    # cluster/appmaster.py + cluster/pool.py — CONTROL-PLANE faults
+    "am-crash",        # the AM SIGKILLs itself (work-preserving takeover / AM-retry path)
+    "pool-crash",      # the pool-service RM daemon SIGKILLs itself (journal recovery path)
     # train/checkpoint.py — artifact faults
     "ckpt-corrupt",    # the newest checkpoint is torn (truncated/garbled) before restore
 })
@@ -55,6 +59,11 @@ FAULT_KINDS = frozenset({
 #: Kinds whose target names the *victim container*, not the injecting process
 #: (the AM applies them at the ResourceManager seam).
 CONTAINER_FAULTS = frozenset({"node-loss", "preempt"})
+
+#: Kinds that may gate on the job's reported training step (``@step+N``):
+#: container faults and the AM's own crash — both are decided in the AM,
+#: the only process fed the executors' pushed step metrics.
+STEP_GATED_FAULTS = CONTAINER_FAULTS | frozenset({"am-crash"})
 
 _TARGET_JOB = re.compile(r"^[A-Za-z][A-Za-z0-9_\-]*$")
 
@@ -135,9 +144,9 @@ def _parse_entry(entry: str) -> FaultSpec:
     p = params.get("p")
     if p is not None and not 0 <= p <= 1:
         raise ValueError(f"probability p={p} out of [0, 1] in fault entry {text!r}")
-    if step_gate and kind not in CONTAINER_FAULTS:
+    if step_gate and kind not in STEP_GATED_FAULTS:
         raise ValueError(
-            f"@step+N gates are container faults only ({', '.join(sorted(CONTAINER_FAULTS))}) "
+            f"@step+N gates are AM-decided faults only ({', '.join(sorted(STEP_GATED_FAULTS))}) "
             f"— only the AM sees the job's reported step — in fault entry {text!r}"
         )
     return FaultSpec(kind, target, trigger, delay_ms, step_gate, tuple(args), params, entry=text)

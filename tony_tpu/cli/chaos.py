@@ -12,7 +12,9 @@ or not:
 - ``on_gang_complete`` fired exactly once per gang epoch (rank assignment is
   not idempotent);
 - the ``.jhist`` history file was finalized into ``finished/``;
-- (with ``--expect-resume``) a restarted gang resumed from a checkpoint.
+- (with ``--expect-resume``) a restarted gang resumed from a checkpoint;
+- (with ``--expect-takeover``) a SIGKILLed AM's relaunch ADOPTED the live
+  gang (work-preserving takeover) and nothing degraded to a full restart.
 
 Re-running with the same ``--spec`` and ``--seed`` reproduces the same
 injected-fault sequence; the per-process injection logs under
@@ -62,6 +64,7 @@ def verify_chaos_run(handle, config: TonyConfig) -> tuple[list[str], dict[str, A
         events = history.read_events(history_root, handle.app_id)
         epochs, completes_this_epoch = 1, 0
         resizes: list[dict[str, Any]] = []
+        takeovers, takeovers_degraded = 0, 0
         for ev in events:
             if ev.type.value == "GANG_COMPLETE":
                 completes_this_epoch += 1
@@ -76,8 +79,18 @@ def verify_chaos_run(handle, config: TonyConfig) -> tuple[list[str], dict[str, A
                 completes_this_epoch = 0
             elif ev.type.value == "GANG_RESIZED" and not ev.payload.get("rejected"):
                 resizes.append(ev.payload)
+            elif ev.type.value == "AM_TAKEOVER":
+                takeovers += 1
+            elif ev.type.value == "AM_TAKEOVER_DEGRADED":
+                # degraded = a fresh gang epoch (full restart) with no
+                # "gang restart" HEARTBEAT_LOST marker in the stream
+                takeovers_degraded += 1
+                epochs += 1
+                completes_this_epoch = 0
         info["gang_epochs"] = epochs
         info["resizes"] = resizes
+        info["takeovers"] = takeovers
+        info["takeovers_degraded"] = takeovers_degraded
 
     resumed = _resumed_steps(handle.staging_dir)
     info["resumed_steps"] = resumed
@@ -166,6 +179,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--expect-resize", metavar="TYPE=N", default="",
                    help="fail unless an elastic resize landed the jobtype at N "
                         "instances (e.g. worker=2 for a shrink-on-preempt run)")
+    p.add_argument("--expect-takeover", action="store_true",
+                   help="fail unless a relaunched AM ADOPTED the live gang "
+                        "(work-preserving takeover) and no takeover degraded "
+                        "to a full restart")
     args = p.parse_args(argv)
 
     expect_resize: tuple[str, int] | None = None
@@ -210,6 +227,19 @@ def main(argv: list[str] | None = None) -> int:
         print(f"[tony-chaos] checkpoint resumes at steps: {info['resumed_steps']}")
     elif args.expect_resume:
         failures.append("--expect-resume: no task resumed from a checkpoint")
+    if info.get("takeovers"):
+        print(f"[tony-chaos] AM takeovers: {info['takeovers']} adopted"
+              + (f", {info['takeovers_degraded']} degraded"
+                 if info.get("takeovers_degraded") else ""))
+    elif info.get("takeovers_degraded"):
+        print(f"[tony-chaos] AM takeovers: {info['takeovers_degraded']} degraded")
+    if args.expect_takeover:
+        if not info.get("takeovers"):
+            failures.append("--expect-takeover: no AM takeover adopted the gang")
+        if info.get("takeovers_degraded"):
+            failures.append(
+                f"--expect-takeover: {info['takeovers_degraded']} takeover(s) "
+                "degraded to a full gang restart")
     for rz in info.get("resizes") or []:
         print(f"[tony-chaos] gang resized: {rz.get('resized')} "
               f"(trigger={rz.get('trigger', '?')}, now {rz.get('instances')})")

@@ -262,6 +262,11 @@ def render_top(app: dict[str, Any], rows: list[dict[str, Any]]) -> str:
     lines = [
         f"{app.get('app_id', '?')}  {app.get('state', '?')}  "
         f"attempt {app.get('restart_attempt', 0)}"
+        # a takeover must be visible to the operator: which AM attempt is
+        # serving, and whether it adopted the gang or restarted it
+        + (f"  am-attempt {app.get('am_attempt')}"
+           + (f" ({app.get('takeover')})" if app.get("takeover") else "")
+           if app.get("am_attempt") else "")
         + (f"  ({app.get('reason')})" if app.get("reason") else ""),
         "",
         f"{'TASK':<14s} {'STATE':<11s} {'STEP':>6s} {'LOSS':>8s} "

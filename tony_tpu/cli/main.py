@@ -198,15 +198,30 @@ def _cmd_pool(argv: list[str]) -> int:
     p.add_argument("--preemption-grace-ms", type=int, default=0,
                    help="wait this long before cross-queue reclaim evicts borrowers "
                         "(tony.pool.preemption.grace-ms)")
+    p.add_argument("--journal-file", default="",
+                   help="recovery journal (tony.pool.journal.file): a restarted "
+                        "pool replays it and re-adopts live work instead of "
+                        "forgetting every admitted app")
     args = p.parse_args(argv)
 
     from tony_tpu.cluster.pool import parse_queue_spec
 
+    if not args.journal_file:
+        # honor the documented config key like pool.main does: the dev
+        # helper must not silently disable journaling an operator configured
+        site = os.path.join(os.getcwd(), constants.TONY_SITE_CONF)
+        if os.path.exists(site):
+            from tony_tpu.config import TonyConfig, keys as _keys
+
+            args.journal_file = (
+                TonyConfig.from_layers(site_file=site).get(_keys.POOL_JOURNAL_FILE) or ""
+            )
     secret = os.environ.get(constants.ENV_POOL_SECRET) or secrets.token_hex(16)
     svc = PoolService(port=args.port, secret=secret,
                       queues=parse_queue_spec(args.queues),
                       preemption=args.preemption,
-                      preemption_grace_ms=args.preemption_grace_ms)
+                      preemption_grace_ms=args.preemption_grace_ms,
+                      journal_path=args.journal_file or None)
     svc.start()
     host, port = svc.address
 

@@ -131,10 +131,18 @@ class NodeAgent:
             slice_id=self.slice_id,
             slice_spec=self.slice_spec,
             chips=[list(c) for c in self.chip_coords],
+            # work-preserving RM restart: announce what is STILL RUNNING here.
+            # A journal-recovering pool re-adopts the containers it recognizes;
+            # the response's kill list names the ones it does not (orphans of
+            # a forgotten epoch) — a journal-less pool recognizes nothing and
+            # the old kill-everything semantics fall out of that naturally.
+            live=self.launcher.live_ids(),
         )
         hb = resp.get("heartbeat_interval_ms")
         if hb:
             self.heartbeat_interval_s = int(hb) / 1000
+        for cid in resp.get("kill", []):
+            self.launcher.kill(cid, wait=False)
 
     def run(self) -> None:
         self.rpc.start()
@@ -152,10 +160,11 @@ class NodeAgent:
                 pending_exits = {}  # delivered; a failed call retries next beat
                 if resp.get("unknown_node"):
                     # RM restarted (or we were declared dead and came back):
-                    # containers from the previous epoch are orphans — kill
-                    # them and start clean, then re-register. wait=False: N
-                    # sequential 3 s graces would blow the liveness window
-                    self.launcher.kill_all(wait=False)
+                    # re-register carrying the live container list — a pool
+                    # that recovered its journal ADOPTS them (the containers
+                    # keep running, work preserved); one that didn't answers
+                    # with a kill list naming every orphan, restoring the old
+                    # kill-and-start-clean behavior
                     self._register()
                 for cid in resp.get("kill", []):
                     # NEVER block the heartbeat loop on teardown grace: a

@@ -22,6 +22,7 @@ import argparse
 import json
 import os
 import secrets as _secrets
+import signal
 import sys
 import time
 from typing import Any
@@ -30,6 +31,7 @@ from tony_tpu import constants
 from tony_tpu.chaos import ChaosContext
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.cluster import history
+from tony_tpu.cluster.journal import Journal, JournalError, read_journal
 from tony_tpu.obs import introspect as obs_introspect
 from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
@@ -52,6 +54,7 @@ from tony_tpu.cluster.scheduler import (
 from tony_tpu.cluster.rpc import APPLICATION_RPC_METHODS, RpcServer
 from tony_tpu.cluster.session import JobStatus, Session, TaskStatus
 from tony_tpu.runtime import get_runtime
+from tony_tpu.runtime.base import FrameworkRuntime
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -74,6 +77,14 @@ _ELASTIC_RESIZES = obs_metrics.counter(
     "applied elastic resizes by direction (grow, shrink, mixed) and trigger "
     "(rpc, preempt, capacity)",
     labelnames=("direction", "trigger"))
+_AM_TAKEOVERS = obs_metrics.counter(
+    "tony_am_takeovers_total",
+    "relaunched-AM takeover attempts by outcome (adopted: live gang kept "
+    "running; degraded: journal missing/corrupt, full gang restart)",
+    labelnames=("outcome",))
+_TAKEOVER_SECONDS = obs_metrics.histogram(
+    "tony_am_takeover_duration_seconds",
+    "journal replay + gang adoption latency of a successful AM takeover")
 
 
 class InvalidResizeError(ValueError):
@@ -139,6 +150,72 @@ def _pool_credential(config: TonyConfig) -> str:
     return secret
 
 
+class _JournalState:
+    """Recoverable AM state reconstructed from the takeover journal."""
+
+    def __init__(self) -> None:
+        self.attempt = 0                                      # gang epoch
+        self.resized: dict[str, int] = {}                     # elastic resizes applied
+        self.pending: dict[str, int] = {}                     # acked-unapplied resizes
+        self.failures = 0                                     # spent restart budget
+        self.gang_complete = False
+        self.chaos_step = 0                                   # @step+N watermark
+        self.registered: dict[tuple[str, int], tuple[str, int]] = {}
+        self.done: dict[tuple[str, int], int] = {}
+        self.containers: dict[str, dict[str, Any]] = {}       # cid → task_started rec
+
+    def _reset_epoch(self, attempt: int, resized: dict[str, int]) -> None:
+        self.attempt = attempt
+        self.resized = resized
+        self.gang_complete = False
+        self.registered = {}
+        self.done = {}
+        self.containers = {}
+
+
+def _replay_am_journal(records: list[dict[str, Any]]) -> _JournalState:
+    """Fold journal records into the state a takeover AM adopts.
+
+    Each ``epoch`` record marks a session rebuild (gang restart / queued
+    resize): everything task-scoped before it is obsolete. Cross-epoch
+    state (failure budget, pending resizes, chaos watermark) accumulates
+    with last-record-wins semantics.
+    """
+    state = _JournalState()
+    saw_epoch = False
+    for rec in records:
+        t = rec.get("t")
+        if t == "epoch":
+            saw_epoch = True
+            state._reset_epoch(int(rec.get("attempt", 0)),
+                               {k: int(v) for k, v in (rec.get("resized") or {}).items()})
+        elif t == "registered":
+            state.registered[(str(rec["job"]), int(rec["index"]))] = (
+                str(rec["host"]), int(rec["port"]))
+        elif t == "gang_complete":
+            state.gang_complete = True
+        elif t == "task_started":
+            state.containers[str(rec["cid"])] = rec
+        elif t == "task_done":
+            state.done[(str(rec["job"]), int(rec["index"]))] = int(rec["exit_code"])
+        elif t == "pending_resize":
+            state.pending = {k: int(v) for k, v in (rec.get("resizes") or {}).items()}
+        elif t == "failures":
+            state.failures = int(rec.get("n", 0))
+        elif t == "chaos_step":
+            state.chaos_step = max(state.chaos_step, int(rec.get("step", 0)))
+        elif t == "takeover":
+            pass  # informational: a predecessor attempt adopted successfully
+        else:
+            # an unknown record type means a NEWER tony wrote this journal —
+            # adopting a state we only half understand risks silent data
+            # loss, which is exactly what the degraded path is for
+            raise JournalError(f"unknown journal record type {t!r}")
+    if not saw_epoch:
+        raise JournalError("journal carries no epoch record")
+    return state
+
+
 class ApplicationMaster:
     def __init__(
         self,
@@ -146,10 +223,24 @@ class ApplicationMaster:
         app_id: str,
         staging_dir: str,
         rm: ResourceManager | None = None,
+        takeover: bool = False,
+        am_attempt: int = 0,
     ):
         self.config = config
         self.app_id = app_id
         self.staging_dir = staging_dir
+        # work-preserving restart (tony.am.takeover.enabled): this process
+        # journals its recoverable state; a retried attempt launched with
+        # --takeover replays the journal and ADOPTS the live gang
+        self.am_attempt = am_attempt
+        self._takeover_enabled = config.get_bool(keys.AM_TAKEOVER_ENABLED, True)
+        self._takeover_requested = takeover and self._takeover_enabled
+        self._takeover_outcome: str | None = None  # "adopted" | "degraded" | None
+        self._journal: Journal | None = (
+            Journal(os.path.join(staging_dir, constants.AM_JOURNAL_FILE))
+            if self._takeover_enabled else None
+        )
+        self._journal_chaos_step = 0
         obs_metrics.set_enabled(config.get_bool(keys.METRICS_ENABLED, True))
         # structured logging (tony.log.*): JSONL records under <staging>/logs
         # that `tony logs` merges with every other process's; the console
@@ -218,6 +309,14 @@ class ApplicationMaster:
 
         self._epoch_lock = threading.Lock()
 
+    # ------------------------------------------------------ takeover journal
+    def _jlog(self, t: str, **fields: Any) -> None:
+        """Durably journal a recoverable state transition (fsync'd): the
+        record vocabulary _replay_am_journal understands. No-op when
+        takeover is disabled."""
+        if self._journal is not None:
+            self._journal.append(t, **fields)
+
     # ------------------------------------------------------------------ rpc
     def _fenced_session(self, attempt: int) -> Session | None:
         """Fence RPCs from executors of a killed previous gang attempt: their
@@ -235,6 +334,7 @@ class ApplicationMaster:
         if session is None:
             return {"spec_complete": False, "stale": True}
         session.register_worker_spec(job_name, index, host, port)
+        self._jlog("registered", job=job_name, index=index, host=host, port=port)
         self.events.emit(EventType.TASK_REGISTERED, task=f"{job_name}:{index}", host=host, port=port)
         complete = session.cluster_spec_complete()
         fire = False
@@ -248,8 +348,36 @@ class ApplicationMaster:
                     fire = True
         if fire:
             self.runtime.on_gang_complete(session)
+            self._jlog("gang_complete")
             self.events.emit(EventType.GANG_COMPLETE, tasks=session.total_tasks())
         return {"spec_complete": complete}
+
+    def resync_task(
+        self, job_name: str, index: int, host: str, port: int, attempt: int = 0
+    ) -> dict[str, Any]:
+        """Post-takeover re-attach: an executor that lost its AM and found a
+        refreshed ``am_info`` endpoint announces it is still alive (idempotent,
+        epoch-fenced like ``get_cluster_spec``). Only an AM that actually
+        ADOPTED the gang accepts — on the degraded path the old gang epoch is
+        over, and ``stale`` tells the orphaned executor to kill its child and
+        exit instead of poisoning the fresh gang's identities."""
+        if self._takeover_outcome != "adopted":
+            return {"ack": False, "stale": True}
+        session = self._fenced_session(attempt)
+        if session is None:
+            return {"ack": False, "stale": True}
+        try:
+            with session.lock:
+                task = session.get_task(job_name, index)
+                task.host, task.port = host, port
+                if not task.status.terminal:
+                    task.last_heartbeat_ms = time.time() * 1000
+                    task.missed_heartbeats = 0
+        except KeyError:
+            return {"ack": False, "stale": True}
+        self.events.emit(EventType.TASK_RESYNCED, task=f"{job_name}:{index}")
+        obs_logging.info(f"[tony-am] task {job_name}:{index} re-synced after takeover")
+        return {"ack": True}
 
     def get_cluster_spec(self, job_name: str, index: int, attempt: int = 0) -> dict[str, Any]:
         # epoch-fenced like every other executor-facing RPC: a dying executor
@@ -274,6 +402,7 @@ class ApplicationMaster:
         if session is None:
             return {"ack": False, "stale": True}
         session.on_task_completed(job_name, index, exit_code)
+        self._jlog("task_done", job=job_name, index=index, exit_code=exit_code)
         payload: dict[str, Any] = {"task": f"{job_name}:{index}", "exit_code": exit_code}
         if reason:
             # e.g. "execution timeout": lets the .jhist distinguish an
@@ -326,6 +455,11 @@ class ApplicationMaster:
             "reason": self.session.failure_reason,
             "tensorboard_url": self.tensorboard_url,
             "restart_attempt": self._restart_attempt,
+            # which AM attempt is serving (0 = the original), and whether it
+            # adopted the gang or degraded — a takeover must be visible to
+            # the submitter (monitor output, tony top, portal), not silent
+            "am_attempt": self.am_attempt,
+            "takeover": self._takeover_outcome,
             # effective per-type instance counts AFTER any elastic resize —
             # `tony top` / the portal drop task rows a shrink removed instead
             # of showing them dead forever
@@ -387,6 +521,8 @@ class ApplicationMaster:
             current = self._effective_config().instances(job_name)
             if n == current:
                 cancelled = self._pending_resize.pop(job_name, None)
+                if cancelled is not None:
+                    self._jlog("pending_resize", resizes=dict(self._pending_resize))
                 _GANG_RESIZES.inc(outcome="noop")
                 if cancelled is None:
                     return {"ack": True, "current": current, "noop": True}
@@ -406,6 +542,7 @@ class ApplicationMaster:
                     f"a resize of {job_name!r} to {pending} is already "
                     "pending; retry after it applies")
             self._pending_resize[job_name] = n
+            self._jlog("pending_resize", resizes=dict(self._pending_resize))
         return {"ack": True, "current": current}
 
     # ------------------------------------------------------------ hot spares
@@ -540,30 +677,224 @@ class ApplicationMaster:
         self.rpc.register_object(self, APPLICATION_RPC_METHODS)
         self.rpc.start()
         self.events.start()
+        adopted = False
+        if self._takeover_requested:
+            adopted = self._perform_takeover()
         # announce queue/priority/whole-gang demand to the pool (the
         # ApplicationSubmissionContext analog): multi-tenant pools queue us
-        # when capacity is short instead of failing the job
+        # when capacity is short instead of failing the job. After a takeover
+        # this re-registers the (possibly resized) demand under the same app
+        # id — the pool's claims carry over with the live containers.
         self.rm.register_app(
             queue=self.config.get(keys.APPLICATION_QUEUE) or "default",
             priority=self.config.get_int(keys.APPLICATION_PRIORITY, 0),
             demand=self.scheduler.total_demand(),
         )
-        self.events.emit(
-            EventType.APPLICATION_INITED,
-            app_id=self.app_id,
-            job_types={t: self.config.instances(t) for t in self.config.job_types()},
-        )
+        if not adopted:
+            # fresh gang epoch (initial start, or degraded takeover): every
+            # journal record before this one is obsolete for future replays.
+            # failures/pending_resize are CROSS-epoch (last record wins), so
+            # a degraded reset must re-journal them explicitly — otherwise a
+            # later takeover would resurrect the pre-degrade budget/resize.
+            self._jlog("epoch", attempt=self._restart_attempt, resized=dict(self._resized))
+            self._jlog("failures", n=self._failures_seen)
+            self._jlog("pending_resize", resizes=dict(self._pending_resize))
+        if self.am_attempt == 0:
+            self.events.emit(
+                EventType.APPLICATION_INITED,
+                app_id=self.app_id,
+                job_types={t: self.config.instances(t) for t in self.config.job_types()},
+            )
         host, port = self.rpc.address
         info = {"host": host, "port": port, "secret": self.secret, "pid": os.getpid()}
         info_path = os.path.join(self.staging_dir, constants.AM_INFO_FILE)
         # mode set before publication: the file carries the RPC secret
-        # (delegation-token analog) and pollers race the rename
+        # (delegation-token analog) and pollers race the rename. Published
+        # AFTER any takeover recovery: an executor re-resolving the AM must
+        # only ever reach a session that is ready to resync it.
         _atomic_write_json(info_path, info, mode=0o600)
         self.session.job_status = JobStatus.RUNNING
         obs_logging.info(
             f"[tony-am] application {self.app_id} running "
-            f"({self.session.total_tasks()} task(s), rpc {host}:{port})"
+            f"({self.session.total_tasks()} task(s), rpc {host}:{port}"
+            + (f", am attempt {self.am_attempt}" if self.am_attempt else "")
+            + ")"
         )
+
+    # ------------------------------------------------- work-preserving takeover
+    def _perform_takeover(self) -> bool:
+        """Replay the predecessor AM's journal and adopt its live gang.
+
+        Success → the executors ride out the outage on their missed-heartbeat
+        budget, re-resolve this AM from the refreshed ``am_info``, and resync
+        — the training children never stop. Any failure (journal missing or
+        corrupt, un-adoptable container, config mismatch) degrades LOUDLY to
+        today's full gang restart: the stale gang is killed outright and the
+        job resumes from its latest checkpoint, with AM_TAKEOVER_DEGRADED in
+        the event stream."""
+        t0 = time.perf_counter()
+        with obs_trace.maybe_span("am.takeover", am_attempt=self.am_attempt):
+            try:
+                state = _replay_am_journal(
+                    read_journal(os.path.join(self.staging_dir, constants.AM_JOURNAL_FILE))
+                )
+                self._adopt_state(state)
+            except Exception as e:  # noqa: BLE001 — ANY replay fault degrades, never hangs
+                reason = f"{type(e).__name__}: {e}"
+                obs_logging.error(
+                    f"[tony-am] takeover degraded — {reason}; "
+                    "killing the stale gang and falling back to a full restart")
+                self._kill_stale_gang()
+                self._reset_fresh()
+                _AM_TAKEOVERS.inc(outcome="degraded")
+                self._takeover_outcome = "degraded"
+                self.events.emit(
+                    EventType.AM_TAKEOVER_DEGRADED,
+                    am_attempt=self.am_attempt, reason=reason,
+                )
+                obs_trace.add_event("am.takeover_degraded", reason=reason)
+                return False
+            _AM_TAKEOVERS.inc(outcome="adopted")
+            _TAKEOVER_SECONDS.observe(time.perf_counter() - t0)
+            self._takeover_outcome = "adopted"
+            self._jlog("takeover", am_attempt=self.am_attempt)
+            self.events.emit(
+                EventType.AM_TAKEOVER,
+                am_attempt=self.am_attempt,
+                attempt=self._restart_attempt,
+                containers=len(self._containers),
+                registered=self.session.registered_count(),
+            )
+            obs_logging.info(
+                f"[tony-am] attempt {self.am_attempt} adopted the live gang: "
+                f"{len(self._containers)} container(s), "
+                f"{self.session.registered_count()} registered task(s), "
+                f"gang epoch {self._restart_attempt}")
+            return True
+
+    def _adopt_state(self, state: "_JournalState") -> None:
+        """Rebuild session/scheduler/container tracking from a replayed
+        journal, committing only when EVERY piece adopted cleanly."""
+        if type(self.runtime).on_gang_complete is not FrameworkRuntime.on_gang_complete:
+            # a runtime that rebuilds gang state on completion (the horovod
+            # driver) cannot be adopted: the executors hold rendezvous env
+            # pointing at a process that died with the old AM
+            raise RuntimeError(
+                f"runtime {type(self.runtime).__name__} rebuilds state on gang "
+                "completion and cannot survive an AM swap")
+        self._resized = dict(state.resized)
+        cfg = self._effective_config()
+        session = Session(cfg)
+        session.job_status = JobStatus.RUNNING
+        scheduler = TaskScheduler(cfg, session, self.rm)
+        for (job, idx), (host, port) in state.registered.items():
+            session.register_worker_spec(job, idx, host, port)  # KeyError → degrade
+        for (job, idx), rc in state.done.items():
+            session.on_task_completed(job, idx, rc)
+        containers: dict[str, Container] = {}
+        by_task: dict[tuple[str, int], Container] = {}
+        adopted: list[Container] = []
+        try:
+            for rec in state.containers.values():
+                job, idx = rec["job"], int(rec["index"])
+                task = session.get_task(job, idx)
+                if task.status.terminal:
+                    continue  # already finished: its process is gone; nothing to track
+                c = self.rm.adopt_container(rec.get("container") or {})
+                if c is None:
+                    raise RuntimeError(
+                        f"resource manager could not adopt container "
+                        f"{(rec.get('container') or {}).get('id')} for {job}:{idx}")
+                adopted.append(c)
+                if task.status == TaskStatus.NEW:
+                    task.status = TaskStatus.SCHEDULED
+                task.container_id = c.id
+                task.chip_coords = c.chip_coords
+                task.log_dir = rec.get("log_dir")
+                task.start_time_ms = int(rec.get("started_ms") or 0)
+                containers[c.id] = c
+                by_task[(job, idx)] = c
+            for job_type, plan in scheduler.plans.items():
+                covered = [
+                    (job_type, i) in by_task
+                    or session.get_task(job_type, i).status.terminal
+                    for i in range(plan.instances)
+                ]
+                if all(covered):
+                    plan.launched = True
+                elif any((job_type, i) in by_task for i in range(plan.instances)):
+                    # allocate_type is all-or-nothing: a half-launched wave
+                    # cannot be completed piecemeal — degrade to a restart
+                    raise RuntimeError(f"type {job_type!r} was mid-launch when the AM died")
+        except Exception:
+            for c in adopted:
+                try:
+                    self.rm.kill_container(c)
+                    self.rm.release(c)
+                except Exception:  # noqa: BLE001 — best-effort unwind before degrading
+                    pass
+            raise
+        with self._epoch_lock:
+            self._restart_attempt = state.attempt
+            self._pending_resize = dict(state.pending)
+            self._failures_seen = state.failures
+            self._gang_complete_fired = state.gang_complete
+            self.session = session
+            self.scheduler = scheduler
+            self._containers = containers
+            self._by_task = by_task
+        if any(p.launched for p in scheduler.plans.values()) and not session.cluster_spec_complete():
+            self._gang_started_ms = time.time() * 1000  # restart the barrier clock
+        if self.chaos is not None and state.chaos_step:
+            # @step+N gates that already opened must not re-arm, and ones
+            # still closed keep their watermark across the AM swap
+            self.chaos.set_progress(state.chaos_step)
+        self._journal_chaos_step = state.chaos_step
+        lg = obs_logging.get()
+        if lg is not None:
+            lg.epoch = self._restart_attempt
+
+    def _reset_fresh(self) -> None:
+        """Degraded takeover: back to the configured gang, attempt 0 — the
+        exact state a pre-takeover AM retry would have started from."""
+        with self._epoch_lock:
+            self._resized = {}
+            self._pending_resize = {}
+            self._restart_attempt = 0
+            self._failures_seen = 0
+            self._gang_complete_fired = False
+            self._gang_started_ms = None
+            self.session = Session(self.config)
+            self.scheduler = TaskScheduler(self.config, self.session, self.rm)
+            self._containers = {}
+            self._by_task = {}
+
+    def _kill_stale_gang(self) -> None:
+        """Degraded-path teardown of the predecessor's gang: remote pools
+        release everything held under this app id, and every local process
+        still carrying the app id in its environment (executors + their
+        children, launched by the dead AM) is killed outright. Without this,
+        the fresh gang would race the orphans for ports, checkpoints, and
+        (job, index) identities."""
+        try:
+            self.rm.reclaim_orphans()
+        except Exception as e:  # noqa: BLE001 — reclaim is best-effort
+            obs_logging.warning(f"[tony-am] pool reclaim during degraded takeover failed: {e}")
+        if not os.path.isdir("/proc"):
+            return
+        from tony_tpu.cluster.resources import _kill_process_tree
+
+        needle = f"{constants.ENV_APP_ID}={self.app_id}".encode()
+        for name in os.listdir("/proc"):
+            if not name.isdigit() or int(name) == os.getpid():
+                continue
+            try:
+                with open(f"/proc/{name}/environ", "rb") as f:
+                    if needle not in f.read():
+                        continue
+            except OSError:
+                continue
+            _kill_process_tree(int(name))
 
     def _launch_type(self, job_type: str) -> None:
         if self.tracer is None:
@@ -616,6 +947,7 @@ class ApplicationMaster:
             self._containers[container.id] = container
             self._by_task[(job_type, container.task_index)] = container
             self._start_executor(container)
+            self._journal_task_started(container, task.log_dir)
             self.events.emit(
                 EventType.TASK_STARTED,
                 task=task.id,
@@ -648,6 +980,7 @@ class ApplicationMaster:
             self.staging_dir, constants.TASK_LOG_DIRNAME, f"spare_{spare_id}")
         self._containers[container.id] = container
         self._by_task[(job_type, index)] = container
+        self._journal_task_started(container, task.log_dir)
         self.events.emit(
             EventType.SPARE_PROMOTED,
             spare=spare_id, task=f"{job_type}:{index}", container=container.id,
@@ -659,6 +992,21 @@ class ApplicationMaster:
         )
         obs_logging.info(
             f"[tony-am] promoted hot spare {spare_id} → {job_type}:{index}")
+
+    def _journal_task_started(self, container: Container, log_dir: str | None) -> None:
+        """Durably record a gang slot's live container so a takeover attempt
+        can adopt it. An RM that cannot describe the container (no pid — not
+        yet started) journals nothing: a takeover then sees the type as
+        mid-launch and degrades rather than guessing."""
+        info = self.rm.journal_info(container)
+        if info is None:
+            return
+        self._jlog(
+            "task_started",
+            job=container.job_type, index=container.task_index,
+            cid=container.id, log_dir=log_dir,
+            started_ms=int(time.time() * 1000), container=info,
+        )
 
     def _start_executor(self, container: Container, spare_id: str | None = None) -> None:
         if spare_id is not None:
@@ -734,6 +1082,7 @@ class ApplicationMaster:
             task = self.session.get_task(c.job_type, c.task_index)
             if not task.status.terminal:
                 self.session.on_task_completed(c.job_type, c.task_index, rc)
+                self._jlog("task_done", job=c.job_type, index=c.task_index, exit_code=rc)
                 self.events.emit(
                     EventType.TASK_FINISHED, task=task.id, exit_code=rc, source="container-exit"
                 )
@@ -843,6 +1192,9 @@ class ApplicationMaster:
             self.session = Session(cfg)
             self.session.job_status = JobStatus.RUNNING
             self.scheduler = TaskScheduler(cfg, self.session, self.rm)
+        # session rebuilt → prior registrations/containers are obsolete for
+        # a takeover: a fresh epoch record supersedes them in the journal
+        self._jlog("epoch", attempt=self._restart_attempt, resized=dict(self._resized))
         self._announce_resize(resize, reason, trigger=trigger, old=old)
 
     def _apply_pending_resize(self) -> None:
@@ -855,6 +1207,7 @@ class ApplicationMaster:
             pending, self._pending_resize = self._pending_resize, {}
         if not pending:
             return
+        self._jlog("pending_resize", resizes={})
         cfg = self._effective_config()
         resize = {t: n for t, n in pending.items() if n != cfg.instances(t)}
         if not resize:
@@ -1014,6 +1367,9 @@ class ApplicationMaster:
                 return False
             budget = self.config.get_int(keys.TASK_MAX_TOTAL_INSTANCE_FAILURES, 0)
             self._failures_seen += 1
+            # durable: a takeover AM must inherit the spent failure budget,
+            # or an AM crash would hand every job a fresh allowance
+            self._jlog("failures", n=self._failures_seen)
             if self._failures_seen > budget:
                 return False
         _GANG_RESTARTS.inc()
@@ -1066,6 +1422,9 @@ class ApplicationMaster:
         lg = obs_logging.get()
         if lg is not None:
             lg.epoch = self._restart_attempt  # stamp the new gang epoch on records
+        # the epoch record supersedes every registration/container record
+        # before it: a takeover after this restart adopts only the new gang
+        self._jlog("epoch", attempt=self._restart_attempt, resized=dict(self._resized))
         if announce:
             self._announce_resize(resize, reason, trigger=trigger, old=old)
         return True
@@ -1102,6 +1461,17 @@ class ApplicationMaster:
                         step = max(step, int(s))
                 if step:
                     self.chaos.set_progress(step)
+                    if step > self._journal_chaos_step:
+                        # durable watermark: a takeover AM must not re-arm
+                        # @step+N gates the dead AM already walked past
+                        self._journal_chaos_step = step
+                        self._jlog("chaos_step", step=step)
+            if self.chaos is not None and self.chaos.take("am-crash") is not None:
+                # control-plane death fidelity (same rule as container kills):
+                # no stop(), no status file, no event flush — SIGKILL this
+                # very process mid-loop. Recovery is the client's AM retry,
+                # which replays the journal and adopts the gang.
+                os.kill(os.getpid(), signal.SIGKILL)
 
             # 1. launch job types whose dependencies are satisfied
             try:
@@ -1287,11 +1657,15 @@ class ApplicationMaster:
                 "completed_ms": completed_ms,
                 "tensorboard_url": self.tensorboard_url,
                 "restart_attempt": self._restart_attempt,
+                "am_attempt": self.am_attempt,
+                "takeover": self._takeover_outcome,
                 "tasks": self.session.task_infos(),
             },
         )
         self.rpc.stop()
         self.rm.shutdown()
+        if self._journal is not None:
+            self._journal.close()
         return final
 
 
@@ -1311,9 +1685,16 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tony-am")
     p.add_argument("--app-id", required=True)
     p.add_argument("--staging-dir", required=True)
+    p.add_argument("--takeover", action="store_true",
+                   help="replay am_journal.jsonl and adopt the live gang "
+                        "(AM-retry path; degrades to a full restart on a "
+                        "missing/corrupt journal)")
+    p.add_argument("--am-attempt", type=int, default=0,
+                   help="which AM attempt this is (0 = original launch)")
     args = p.parse_args(argv)
     config = TonyConfig.load_final(os.path.join(args.staging_dir, constants.TONY_FINAL_CONF))
-    am = ApplicationMaster(config, args.app_id, args.staging_dir)
+    am = ApplicationMaster(config, args.app_id, args.staging_dir,
+                           takeover=args.takeover, am_attempt=args.am_attempt)
     am.prepare()
     final = am.run()
     return constants.EXIT_SUCCESS if final == JobStatus.SUCCEEDED else constants.EXIT_FAILURE

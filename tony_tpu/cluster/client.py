@@ -157,6 +157,7 @@ class Client:
         """Poll task transitions until a final status (reference monitor loop)."""
         last_state: dict[str, str] = {}
         tb_reported = False
+        am_attempt_seen = 0
         rpc = handle.rpc()
         while True:
             status = handle.final_status()
@@ -191,6 +192,20 @@ class Client:
             except (RpcError, OSError):
                 time.sleep(0.3)
                 continue
+            am_attempt = int(app.get("am_attempt") or 0)
+            if am_attempt != am_attempt_seen:
+                # a takeover must be visible to the submitter, not silent
+                am_attempt_seen = am_attempt
+                outcome = app.get("takeover")
+                self._notify("am_attempt", {"am_attempt": am_attempt, "takeover": outcome})
+                if not quiet:
+                    obs_logging.info(
+                        f"[tony] AM attempt {am_attempt} "
+                        + ("adopted the running gang (work-preserving takeover)"
+                           if outcome == "adopted"
+                           else "restarted the gang (takeover degraded)"
+                           if outcome == "degraded"
+                           else "is serving"))
             for info in infos:
                 tid = f"{info['name']}:{info['index']}"
                 st = info["status"]
@@ -211,26 +226,36 @@ class Client:
             time.sleep(0.3)
 
     def _maybe_retry_am(self, handle: ApplicationHandle) -> tuple[ApplicationHandle, RpcClient | None] | None:
-        """AM-retry path (SURVEY.md §3.5): relaunch the AM, whole gang restarts."""
+        """AM-retry path (SURVEY.md §3.5), now work-preserving: the new
+        attempt launches in ``--takeover`` mode, replays ``am_journal.jsonl``
+        and ADOPTS the live gang — executors re-resolve the refreshed
+        ``am_info`` and resync, the training children never stop. Only a
+        missing/corrupt journal degrades (loudly, `AM_TAKEOVER_DEGRADED`) to
+        the old whole-gang restart."""
         retries = self.config.get_int(keys.AM_RETRY_COUNT, 0)
         attempt = getattr(handle, "_am_attempt", 0)
         if attempt >= retries:
             return None
+        next_attempt = attempt + 1
         for stale in (handle.am_info_path,):
             try:
                 os.remove(stale)
             except OSError:
                 pass
+        obs_logging.warning(
+            f"[tony] AM for {handle.app_id} died (attempt {attempt}); "
+            f"relaunching attempt {next_attempt} in takeover mode")
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
-        with open(os.path.join(handle.staging_dir, f"am_attempt{attempt + 1}.log"), "ab") as am_log:
+        with open(os.path.join(handle.staging_dir, f"am_attempt{next_attempt}.log"), "ab") as am_log:
             proc = subprocess.Popen(
                 [sys.executable, "-u", "-m", "tony_tpu.cluster.appmaster",
-                 "--app-id", handle.app_id, "--staging-dir", handle.staging_dir],
+                 "--app-id", handle.app_id, "--staging-dir", handle.staging_dir,
+                 "--takeover", "--am-attempt", str(next_attempt)],
                 env=env, stdout=am_log, stderr=subprocess.STDOUT, start_new_session=True,
             )
         new_handle = ApplicationHandle(handle.app_id, handle.staging_dir, proc)
-        new_handle._am_attempt = attempt + 1  # type: ignore[attr-defined]
+        new_handle._am_attempt = next_attempt  # type: ignore[attr-defined]
         return new_handle, new_handle.rpc()
 
     def run(self, quiet: bool = False) -> int:
@@ -270,6 +295,10 @@ def _print_final(handle: ApplicationHandle, status: dict[str, Any]) -> None:
     obs_logging.info(f"[tony] application {handle.app_id} finished: {status['status']}")
     if status.get("reason"):
         obs_logging.info(f"[tony]   reason: {status['reason']}")
+    if status.get("am_attempt"):
+        obs_logging.info(
+            f"[tony]   served by AM attempt {status['am_attempt']}"
+            + (f" ({status['takeover']} takeover)" if status.get("takeover") else ""))
     for t in status.get("tasks", []):
         obs_logging.info(
             f"[tony]   {t['name']}:{t['index']} {t['status']}"

@@ -30,6 +30,9 @@ class EventType(enum.Enum):
     TASK_REGISTERED = "TASK_REGISTERED"
     TASK_FINISHED = "TASK_FINISHED"
     HEARTBEAT_LOST = "HEARTBEAT_LOST"
+    AM_TAKEOVER = "AM_TAKEOVER"                    # relaunched AM adopted the live gang (work-preserving restart)
+    AM_TAKEOVER_DEGRADED = "AM_TAKEOVER_DEGRADED"  # journal missing/corrupt → full gang restart fallback
+    TASK_RESYNCED = "TASK_RESYNCED"                # executor re-attached to a takeover AM's refreshed endpoint
     QUEUE_WAIT = "QUEUE_WAIT"
     GANG_COMPLETE = "GANG_COMPLETE"
     GANG_RESIZED = "GANG_RESIZED"

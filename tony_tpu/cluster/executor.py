@@ -11,6 +11,7 @@ executor never touches tensors.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import socket
@@ -96,6 +97,10 @@ class TaskExecutor:
         self.child: subprocess.Popen | None = None
         self._stop = threading.Event()
         self._hb_failures = 0
+        # AM endpoint re-resolution (work-preserving takeover): True once the
+        # CURRENT rpc target has acknowledged this executor — the env-provided
+        # AM did at registration; a takeover AM must ack a resync_task first
+        self._am_synced = True
         # hot-spare contract (tony.elastic.spares): set → park after
         # register_spare and wait for a gang-slot promotion instead of
         # registering as (job_name, index) right away
@@ -106,6 +111,76 @@ class TaskExecutor:
         self._profile_courier = obs_introspect.ProfileCourier(
             self.staging_dir, self.job_name, self.index, self._report_profile
         )
+
+    # -- AM endpoint re-resolution (work-preserving takeover) ---------------
+    def _read_am_info(self) -> tuple[str, int, str] | None:
+        """The staging dir's current AM advertisement, or None (missing — the
+        AM is between attempts — or torn mid-read)."""
+        try:
+            with open(os.path.join(self.staging_dir, constants.AM_INFO_FILE)) as f:
+                info = json.load(f)
+            return str(info["host"]), int(info["port"]), str(info.get("secret", ""))
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def _resolve_am_move(self) -> bool:
+        """The AM stopped answering: check whether a takeover attempt has
+        republished ``am_info`` with a fresh endpoint, and if so re-attach.
+
+        Returns True only when a resync against the (re)resolved endpoint was
+        acknowledged — the caller may then reset its failure accounting. A
+        ``stale`` answer means this gang epoch is over (degraded takeover):
+        kill the child and exit rather than poison the replacement gang."""
+        info = self._read_am_info()
+        if info is None:
+            return False
+        current = (self.rpc.host, self.rpc.port, self.rpc.secret)
+        if info == current and self._am_synced:
+            return False  # same AM, just unreachable: keep riding the budget
+        if info != current:
+            obs_logging.info(
+                f"[tony-executor] {self.job_name}:{self.index} re-resolving AM "
+                f"→ {info[0]}:{info[1]}")
+            self.rpc.retarget(*info)
+            self._am_synced = False
+        try:
+            resp = self.rpc.call(
+                "resync_task", job_name=self.job_name, index=self.index,
+                host=self.host, port=self.port, attempt=self.attempt,
+            )
+        except (RpcError, OSError):
+            return False  # new AM not serving yet: retry on the next beat
+        if resp.get("stale"):
+            obs_logging.error(
+                f"[tony-executor] {self.job_name}:{self.index} superseded by a "
+                "degraded AM takeover — killing child and exiting")
+            self._kill_child()
+            os._exit(constants.EXIT_HEARTBEAT_LOST)
+        self._am_synced = True
+        obs_logging.info(
+            f"[tony-executor] {self.job_name}:{self.index} re-synced with the "
+            f"takeover AM at {self.rpc.host}:{self.rpc.port}")
+        return True
+
+    def _am_call_resilient(self, method: str, deadline_s: float, **params):
+        """``call_with_retry`` in bounded bursts with AM re-resolution in
+        between: registration, spec polling, and the final result report must
+        survive an AM takeover mid-call, not just transient flakes."""
+        start = time.monotonic()
+        last: Exception | None = None
+        while True:
+            remaining = deadline_s - (time.monotonic() - start)
+            if remaining <= 0:
+                raise RpcError(
+                    f"{method}: AM unreachable for {deadline_s:.0f}s "
+                    f"(even across endpoint re-resolution): {last}")
+            try:
+                return self.rpc.call_with_retry(
+                    method, retries=10, delay_s=0.2,
+                    deadline_s=max(min(remaining, 3.0), 0.5), **params)
+            except (RpcError, OSError) as e:
+                last = e
+                self._resolve_am_move()
 
     # -- hot-spare parking -------------------------------------------------
     def _park_as_spare(self) -> bool:
@@ -134,6 +209,12 @@ class TaskExecutor:
                 resp = self.rpc.call("poll_spare_assignment", spare_id=self.spare_id)
                 unreachable_since = None
             except (RpcError, OSError):
+                # a takeover AM does not adopt parked spares: retarget so the
+                # next poll reaches it, gets `stale`, and this spare exits
+                # cleanly (the new AM's top-up loop launches replacements)
+                info = self._read_am_info()
+                if info is not None and info != (self.rpc.host, self.rpc.port, self.rpc.secret):
+                    self.rpc.retarget(*info)
                 now = time.monotonic()
                 if unreachable_since is None:
                     unreachable_since = now
@@ -179,10 +260,8 @@ class TaskExecutor:
             f = self.chaos.take("reg-slow")
             if f is not None:
                 time.sleep(f.ms(default=1000) / 1000)
-        self.rpc.call_with_retry(
+        self._am_call_resilient(
             "register_worker_spec",
-            retries=max(int(timeout_ms / 200), 1),
-            delay_s=0.2,
             deadline_s=timeout_ms / 1000,
             job_name=self.job_name,
             index=self.index,
@@ -195,10 +274,17 @@ class TaskExecutor:
         """Poll until the AM has the complete gang (SURVEY.md §3.2)."""
         deadline = time.time() + self.config.get_time_ms(keys.AM_GANG_TIMEOUT_MS, 300_000) / 1000
         while time.time() < deadline:
-            resp = self.rpc.call_with_retry(
-                "get_cluster_spec", job_name=self.job_name, index=self.index,
-                attempt=self.attempt,
-            )
+            try:
+                resp = self.rpc.call_with_retry(
+                    "get_cluster_spec", retries=5, delay_s=0.2, deadline_s=2.0,
+                    job_name=self.job_name, index=self.index,
+                    attempt=self.attempt,
+                )
+            except (RpcError, OSError):
+                # the AM may have MOVED (takeover) while we waited at the
+                # barrier — re-resolve and keep polling inside the deadline
+                self._resolve_am_move()
+                continue
             if resp.get("stale"):
                 # our gang epoch was killed and replaced while we were still
                 # starting: the new gang reuses our (job, index) identity, so
@@ -396,6 +482,11 @@ class TaskExecutor:
                 )
             except (RpcError, OSError):
                 self._hb_failures += 1
+                if self._resolve_am_move():
+                    # a takeover AM adopted us: the outage is over, the budget
+                    # restarts — the child never noticed
+                    self._hb_failures = 0
+                    continue
                 if self._hb_failures > max_missed:
                     # AM is gone: orphaned container must not outlive the job
                     self._kill_child()
@@ -652,9 +743,11 @@ class TaskExecutor:
         except (RpcError, OSError):
             pass  # the AM-side request expires; artifacts remain on disk
         try:
-            self.rpc.call_with_retry(
+            # resilient: the AM may be mid-takeover exactly when the child
+            # finishes — the report must chase the refreshed endpoint or the
+            # adopted-container backstop would misread this exit as a failure
+            self._am_call_resilient(
                 "register_execution_result",
-                retries=10,
                 deadline_s=30,
                 job_name=self.job_name,
                 index=self.index,

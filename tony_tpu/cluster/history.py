@@ -75,8 +75,14 @@ def finalize_history(
     dest = os.path.join(dest_dir, name)
     shutil.move(intermediate_path, dest)
     if config_snapshot is not None:
-        with open(os.path.join(dest_dir, constants.CONFIG_SNAPSHOT_FILE), "w") as f:
+        # write-tmp-then-replace: a SIGKILL mid-write (am-crash lands exactly
+        # here when the AM dies finalizing) must never leave a torn
+        # config.json for the portal/history readers
+        cfg_path = os.path.join(dest_dir, constants.CONFIG_SNAPSHOT_FILE)
+        tmp = cfg_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(config_snapshot, f, indent=1, sort_keys=True)
+        os.replace(tmp, cfg_path)
     return dest
 
 

@@ -1,0 +1,113 @@
+"""Crash-safe control-plane journals (work-preserving restart substrate).
+
+The AM and the pool service are processes that can die at any instruction
+(SIGKILL — the chaos ``am-crash`` / ``pool-crash`` faults are exactly that),
+yet their *recoverable* state must survive into a successor process that
+adopts the live work instead of rebuilding it (docs/fault-tolerance.md
+"Control-plane failures"). The carrier is an append-only JSONL journal:
+
+- every record is one line, written with ``flush`` + ``fsync`` before the
+  state transition is considered durable — a successor never replays a
+  transition the predecessor had not fully persisted;
+- a SIGKILL mid-append can only tear the FINAL line (appends are sequential
+  within one process, and a killed process appends nothing further), so the
+  reader tolerates exactly that: an unparseable last record is dropped as an
+  expected torn tail, while garbage anywhere *before* the tail means the
+  file is not a journal we wrote — :class:`JournalError`, and the caller
+  degrades loudly (the AM falls back to a full gang restart, the pool starts
+  empty) instead of adopting fiction.
+
+Record shape: ``{"t": "<type>", ...fields}``. The record vocabulary is owned
+by the writer (appmaster.py / pool.py); this module only knows lines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any
+
+
+class JournalError(RuntimeError):
+    """The journal is missing, empty, or corrupt before its final record —
+    the caller must degrade to its journal-less recovery path (loudly)."""
+
+
+class Journal:
+    """Append-only fsync'd JSONL writer.
+
+    Appends are best-effort after open: a full disk must degrade the NEXT
+    takeover (the reader sees a torn/stale journal), never take down the
+    control plane that is still serving the live gang.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+        self._failed = False
+
+    def append(self, t: str, **fields: Any) -> None:
+        line = json.dumps({"t": t, **fields}, sort_keys=True)
+        with self._lock:
+            try:
+                self._f.write(line + "\n")
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._failed = False
+            except (OSError, ValueError):
+                # ValueError: closed file (late append during teardown races)
+                if not self._failed:
+                    # once per failure streak — a full disk must be VISIBLE
+                    # (the next takeover will degrade on this journal)
+                    from tony_tpu.obs import logging as obs_logging
+
+                    obs_logging.warning(
+                        f"[tony-journal] append to {self.path} failed — a "
+                        "successor's recovery from this journal may degrade")
+                self._failed = True
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+def read_journal(path: str) -> list[dict[str, Any]]:
+    """Every intact record, in append order.
+
+    Raises :class:`JournalError` when the journal is missing/empty or has an
+    unparseable record anywhere before the final line; an unparseable FINAL
+    record (the predecessor was SIGKILLed mid-append) is silently dropped —
+    its transition never became durable.
+    """
+    if not os.path.exists(path):
+        raise JournalError(f"journal missing: {path}")
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            lines = f.read().split("\n")
+    except OSError as e:
+        raise JournalError(f"journal unreadable: {e}") from e
+    body = [(i, ln) for i, ln in enumerate(lines) if ln.strip()]
+    records: list[dict[str, Any]] = []
+    for pos, (lineno, line) in enumerate(body):
+        try:
+            rec = json.loads(line)
+            if not isinstance(rec, dict) or "t" not in rec:
+                raise ValueError("not a journal record")
+        except ValueError as e:
+            if pos == len(body) - 1:
+                break  # torn tail: the crash interrupted this very append
+            raise JournalError(
+                f"corrupt journal record at line {lineno + 1} of {path}: {e}"
+            ) from None
+        records.append(rec)
+    if not records:
+        raise JournalError(f"journal empty: {path}")
+    return records

@@ -43,6 +43,7 @@ from typing import Any
 
 from tony_tpu import constants
 from tony_tpu.obs import logging as obs_logging
+from tony_tpu.cluster.journal import Journal, JournalError, read_journal
 from tony_tpu.cluster.resources import (
     AllocationError,
     AllocationPending,
@@ -50,6 +51,8 @@ from tony_tpu.cluster.resources import (
     ResourceManager,
     Resources,
     SliceSpec,
+    container_from_record,
+    container_to_record,
 )
 from tony_tpu.cluster.rpc import RpcClient, RpcError, RpcServer
 from tony_tpu.obs import metrics as obs_metrics
@@ -209,6 +212,8 @@ class PoolService:
         queues: dict[str, float] | None = None,
         preemption: bool = False,
         preemption_grace_ms: int = 0,
+        journal_path: str | None = None,
+        chaos=None,
     ):
         self.heartbeat_interval_ms = heartbeat_interval_ms
         self.max_missed = max_missed_heartbeats
@@ -220,6 +225,8 @@ class PoolService:
         # about to finish, a gang mid-restart — don't trigger kills in
         # other queues
         self.preemption_grace_ms = preemption_grace_ms
+        #: optional fault-injection context (pool-crash); None in production
+        self.chaos = chaos
         self._nodes: dict[str, _Node] = {}
         self._containers: dict[str, dict[str, Any]] = {}   # cid → record
         self._app_exits: dict[str, dict[str, int]] = {}    # app → {cid: rc}
@@ -229,9 +236,107 @@ class PoolService:
         self._all_dead_since: float | None = None          # allocate() saw 0 alive
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # work-preserving restart (tony.pool.journal.file): registrations,
+        # admissions, and allocations are journaled so a restarted pool
+        # rebuilds its queue state and re-adopts live containers from agent
+        # re-registration instead of forgetting every admitted app
+        self._journal: Journal | None = None
+        if journal_path:
+            if os.path.exists(journal_path):
+                try:
+                    with self._lock:
+                        self._recover_from_journal_locked(read_journal(journal_path))
+                    obs_logging.info(
+                        f"[tony-pool] recovered from journal: "
+                        f"{len(self._apps)} app(s), "
+                        f"{sum(1 for r in self._containers.values() if r['state'] == _RUNNING)} "
+                        "live container record(s) awaiting agent re-registration")
+                except Exception as e:  # noqa: BLE001 — ANY replay fault degrades, never refuses to start
+                    # loud degrade to EMPTY state (a half-replayed journal is
+                    # fiction — an agent could get its orphans re-adopted
+                    # against it): agents re-register and kill the orphans,
+                    # the pre-journal behavior
+                    obs_logging.error(f"[tony-pool] journal unusable — starting empty: {e}")
+                    with self._lock:
+                        self._apps = {}
+                        self._containers = {}
+                        self._app_exits = {}
+                        self._app_seq = itertools.count()
+            self._journal = Journal(journal_path)
         self.rpc = RpcServer(host=bind_host, port=port, secret=secret)
         self.rpc.register_object(self, POOL_RPC_METHODS)
         self._monitor = threading.Thread(target=self._liveness_loop, name="pool-liveness", daemon=True)
+
+    # ------------------------------------------------------ recovery journal
+    def _jlog_locked(self, t: str, **fields: Any) -> None:
+        if self._journal is not None:
+            self._journal.append(t, **fields)
+
+    def _journal_app_locked(self, app: _App) -> None:
+        """Full app row (last record wins on replay) — written on every
+        registration/admission/eviction state change."""
+        self._jlog_locked(
+            "app", app_id=app.app_id, queue=app.queue, priority=app.priority,
+            seq=app.seq, admitted=app.admitted, preempted=app.preempted,
+            demand_memory=app.demand_memory, demand_vcores=app.demand_vcores,
+            demand_chips=app.demand_chips,
+        )
+
+    def _recover_from_journal_locked(self, records: list[dict[str, Any]]) -> None:
+        """Rebuild apps/containers/undelivered-exits from the journal. Nodes
+        are runtime state: they re-register on their next heartbeat (the
+        agent's ``unknown_node`` path) carrying their live container ids, and
+        ``register_node`` re-applies the accounting for records replayed
+        here. A waiting app admitted pre-crash stays admitted (never
+        double-admitted); a running app keeps its claim and is not evicted."""
+        max_seq = -1
+        for rec in records:
+            t = rec.get("t")
+            if t == "app":
+                app = _App(
+                    app_id=str(rec["app_id"]),
+                    queue=str(rec["queue"]),
+                    priority=int(rec.get("priority", 0)),
+                    seq=int(rec.get("seq", 0)),
+                    admitted=bool(rec.get("admitted")),
+                    preempted=bool(rec.get("preempted")),
+                    demand_memory=int(rec.get("demand_memory", 0)),
+                    demand_vcores=int(rec.get("demand_vcores", 0)),
+                    demand_chips=int(rec.get("demand_chips", 0)),
+                )
+                if app.queue not in self.queues:
+                    # queue config changed across the restart: park the app in
+                    # the first declared queue rather than refusing recovery
+                    app.queue = "default" if "default" in self.queues else next(iter(self.queues))
+                max_seq = max(max_seq, app.seq)
+                self._apps[app.app_id] = app
+            elif t == "app_removed":
+                self._apps.pop(str(rec["app_id"]), None)
+                self._app_exits.pop(str(rec["app_id"]), None)
+            elif t == "container":
+                crec = dict(rec["rec"])
+                crec.pop("seen_live", None)  # must be re-observed by a live agent
+                self._containers[crec["id"]] = crec
+            elif t == "seen":
+                crec = self._containers.get(str(rec["cid"]))
+                if crec is not None:
+                    crec["seen_live"] = True
+            elif t == "kill_requested":
+                crec = self._containers.get(str(rec["cid"]))
+                if crec is not None:
+                    crec["kill_requested"] = True
+            elif t == "exited":
+                crec = self._containers.get(str(rec["cid"]))
+                if crec is not None and crec["state"] == _RUNNING:
+                    crec["state"] = _EXITED
+                    self._app_exits.setdefault(crec["app_id"], {})[crec["id"]] = int(rec["rc"])
+            elif t == "released":
+                self._containers.pop(str(rec["cid"]), None)
+            elif t == "polled":
+                self._app_exits.pop(str(rec["app_id"]), None)
+            else:
+                raise JournalError(f"unknown pool journal record type {t!r}")
+        self._app_seq = itertools.count(max_seq + 1)
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -241,6 +346,8 @@ class PoolService:
     def stop(self) -> None:
         self._stop.set()
         self.rpc.stop()
+        if self._journal is not None:
+            self._journal.close()
 
     @property
     def address(self) -> tuple[str, int]:
@@ -257,8 +364,18 @@ class PoolService:
         slice_id: int = -1,
         slice_spec: str = "",
         chips: list[list[int]] | None = None,
+        live: list[str] | None = None,
     ) -> dict[str, Any]:
+        """Agent (re-)registration, now container-preserving: ``live`` names
+        the container ids the agent is still running. Containers the pool
+        recognizes (including ones replayed from the recovery journal after a
+        pool restart) are RE-ADOPTED — their accounting is applied to the
+        fresh node object and they keep running. Containers the pool does
+        NOT recognize are orphans of a forgotten epoch and come back in the
+        ``kill`` list; a pool with no journal therefore recognizes nothing
+        and the agent kills everything — exactly the pre-journal behavior."""
         coords = tuple((int(r), int(c)) for r, c in (chips or []))
+        live_set = set(live or [])
         with self._lock:
             # validate FIRST: a rejected registration must not disturb a
             # healthy node's bookkeeping (same-name check excluded — a valid
@@ -280,19 +397,66 @@ class PoolService:
                             f"chips of {name} collide with {other.name} in slice {slice_id}"
                         )
             old = self._nodes.get(name)
-            if old is not None:
-                # agent restart: everything it was running is gone
-                self._mark_node_lost_locked(old, reason="re-registered")
+            for cid, rec in list(self._containers.items()):
+                if rec["node"] != name or rec["state"] != _RUNNING or cid in live_set:
+                    continue
+                # gone from the agent's live list: written off IF we knew the
+                # node before (agent restart: its processes died with it) or
+                # an agent once reported the container live (journal replay +
+                # genuine death while the pool was down). A journaled record
+                # never seen live is an allocated-not-yet-launched container
+                # — the AM may still start it; leave it RUNNING.
+                if old is not None or rec.get("seen_live"):
+                    self._record_exit_locked(cid, constants.EXIT_NODE_LOST)
             # a live node clears the all-dead escalation clock — otherwise a
             # stale timestamp from a PAST outage would fail the next brief
             # blip instantly instead of granting its liveness-budget grace
             self._all_dead_since = None
-            self._nodes[name] = _Node(
+            node = _Node(
                 name=name, host=host, port=port,
                 memory_bytes=int(memory_bytes), vcores=int(vcores),
                 slice_id=int(slice_id), slice_spec=slice_spec, chips=coords,
             )
-        return {"ack": True, "heartbeat_interval_ms": self.heartbeat_interval_ms}
+            self._nodes[name] = node
+            if old is not None:
+                # undelivered kill orders must survive the node-object swap:
+                # with work-preserving re-adoption nothing else culls them
+                node.pending_kills = list(old.pending_kills)
+            kills: list[str] = []
+            for cid, rec in self._containers.items():
+                # re-account EVERY record still RUNNING on this node — both
+                # the agent-confirmed live ones and allocated-not-yet-launched
+                # ones (never seen live): their claim is real either way, or
+                # allocate() would double-book the chips and the eventual
+                # exit would drive the accounting negative
+                if rec["state"] != _RUNNING or rec["node"] != name:
+                    continue
+                node.used_memory += rec["memory_bytes"]
+                node.used_vcores += rec["vcores"]
+                node.used_chips.update(tuple(c) for c in rec["chips"])
+                if cid in live_set:
+                    if not rec.get("seen_live"):
+                        rec["seen_live"] = True
+                        self._jlog_locked("seen", cid=cid)
+                    if rec.get("kill_requested"):
+                        # a backstop kill arrived while this node was away:
+                        # deliver it now instead of resurrecting the victim
+                        kills.append(cid)
+            # live containers the pool has NO record of: orphans of an epoch
+            # this pool never knew — the agent kills them
+            kills.extend(
+                cid for cid in sorted(live_set)
+                if not (
+                    (rec := self._containers.get(cid)) is not None
+                    and rec["state"] == _RUNNING and rec["node"] == name
+                )
+            )
+            self._schedule_locked()
+        return {
+            "ack": True,
+            "heartbeat_interval_ms": self.heartbeat_interval_ms,
+            "kill": kills,
+        }
 
     def node_heartbeat(
         self, name: str, exited: dict[str, int] | None = None, live: list[str] | None = None
@@ -318,7 +482,12 @@ class PoolService:
                     if rec["node"] != name or rec["state"] != _RUNNING:
                         continue
                     if cid in live_set:
-                        rec["seen_live"] = True
+                        if not rec.get("seen_live"):
+                            rec["seen_live"] = True
+                            # durable: after a pool restart, only containers
+                            # an agent once reported live may be written off
+                            # when missing from a re-registration
+                            self._jlog_locked("seen", cid=cid)
                     elif rec.get("seen_live") and cid not in (exited or {}):
                         self._record_exit_locked(cid, constants.EXIT_NODE_LOST)
             kills, node.pending_kills = node.pending_kills, []
@@ -356,6 +525,7 @@ class PoolService:
             app.demand_vcores = int(vcores)
             app.demand_chips = int(chips)
             self._schedule_locked()
+            self._journal_app_locked(app)
             return {"ack": True, "queue": queue, "admitted": app.admitted}
 
     def allocate(
@@ -434,9 +604,12 @@ class PoolService:
             # demand learns the observed gang size (auto-registered apps
             # under-claim; held+ask is exact once the gang allocates serially)
             held = self._held_locked(app_id)
+            before = (app.demand_memory, app.demand_vcores, app.demand_chips)
             app.demand_memory = max(app.demand_memory, held[0] + memory_bytes)
             app.demand_vcores = max(app.demand_vcores, held[1] + vcores)
             app.demand_chips = max(app.demand_chips, held[2] + chips)
+            if (app.demand_memory, app.demand_vcores, app.demand_chips) != before:
+                self._journal_app_locked(app)
             if not app.admitted:
                 self._schedule_locked()
             if not app.admitted:
@@ -504,6 +677,7 @@ class PoolService:
                     "state": _RUNNING,
                 }
                 self._containers[cid] = rec
+                self._jlog_locked("container", rec=dict(rec))
                 return {
                     **rec,
                     "agent_host": node.host, "agent_port": node.port,
@@ -541,12 +715,17 @@ class PoolService:
                     self._release_locked(cid)
             self._app_exits.pop(app_id, None)
             self._apps.pop(app_id, None)  # app done: leave the queue entirely
+            self._jlog_locked("app_removed", app_id=app_id)
             self._schedule_locked()
         return {"ack": True}
 
     def poll_exited(self, app_id: str) -> dict[str, int]:
         with self._lock:
-            return self._app_exits.pop(app_id, {})
+            exits = self._app_exits.pop(app_id, {})
+            if exits:
+                # delivered: a restarted pool must not re-deliver these
+                self._jlog_locked("polled", app_id=app_id)
+            return exits
 
     def request_kill(self, container_id: str) -> dict[str, Any]:
         """Backstop kill path when the AM cannot reach the agent directly:
@@ -694,6 +873,7 @@ class PoolService:
         def admit(app: _App) -> None:
             app.admitted, app.preempted = True, False
             _POOL_ADMISSIONS.inc(queue=app.queue)
+            self._journal_app_locked(app)
             d = demand_of(app)
             for i in range(3):
                 free[i] -= d[i]
@@ -819,6 +999,7 @@ class PoolService:
         c = self._claim_locked(v)
         v.admitted, v.preempted = False, True
         _POOL_EVICTIONS.inc(queue=v.queue)
+        self._journal_app_locked(v)
         v.wait_since = time.monotonic()
         claims.pop(v.app_id, None)
         for i in range(3):
@@ -903,9 +1084,18 @@ class PoolService:
 
     # -------------------------------------------------------------- internal
     def _request_kill_locked(self, rec: dict[str, Any]) -> None:
+        if rec["state"] != _RUNNING:
+            return
         node = self._nodes.get(rec["node"])
-        if node is not None and node.alive and rec["state"] == _RUNNING:
+        if node is not None and node.alive:
             node.pending_kills.append(rec["id"])
+        elif not rec.get("kill_requested"):
+            # node currently away (pool mid-recovery, agent partitioned):
+            # the order must not be silently dropped — with work-preserving
+            # re-adoption nothing else would ever kill this container. Mark
+            # the record (durably) and deliver at re-registration.
+            rec["kill_requested"] = True
+            self._jlog_locked("kill_requested", cid=rec["id"])
 
     def _free_locked(self, rec: dict[str, Any]) -> None:
         node = self._nodes.get(rec["node"])
@@ -926,10 +1116,13 @@ class PoolService:
         rec["state"] = _EXITED
         self._free_locked(rec)
         self._app_exits.setdefault(rec["app_id"], {})[cid] = rc
+        self._jlog_locked("exited", cid=cid, rc=rc)
         self._schedule_locked()
 
     def _release_locked(self, cid: str) -> None:
         rec = self._containers.pop(cid, None)
+        if rec is not None:
+            self._jlog_locked("released", cid=cid)
         if rec is not None and rec["state"] == _RUNNING:
             self._free_locked(rec)
 
@@ -942,6 +1135,10 @@ class PoolService:
     def _liveness_loop(self) -> None:
         timeout_s = self.heartbeat_interval_ms * self.max_missed / 1000
         while not self._stop.wait(self.heartbeat_interval_ms / 1000 / 2):
+            if self.chaos is not None and self.chaos.take("pool-crash") is not None:
+                # control-plane death fidelity: SIGKILL, no drain, no final
+                # journal record beyond what each transition already fsync'd
+                os.kill(os.getpid(), signal.SIGKILL)
             now = time.monotonic()
             with self._lock:
                 for node in self._nodes.values():
@@ -1108,6 +1305,41 @@ class RemoteResourceManager(ResourceManager):
         with self._lock:
             return [c for c, _, _ in self._containers.values()]
 
+    def journal_info(self, container: Container) -> dict | None:
+        with self._lock:
+            entry = self._containers.get(container.id)
+        if entry is None:
+            return None
+        _, (agent_host, agent_port), slice_id = entry
+        return {
+            **container_to_record(container),
+            "agent_host": agent_host, "agent_port": agent_port,
+            "slice_id": slice_id,
+        }
+
+    def adopt_container(self, record: dict) -> Container | None:
+        """Takeover adoption against a remote pool: the POOL survived and
+        still holds the allocation under this app id — only this client-side
+        tracking (container → owning agent) needs rebuilding."""
+        agent_host, agent_port = record.get("agent_host"), record.get("agent_port")
+        if not agent_host or not agent_port:
+            return None
+        c = container_from_record(record)
+        with self._lock:
+            self._containers[c.id] = (
+                c, (str(agent_host), int(agent_port)), int(record.get("slice_id", -1)),
+            )
+        return c
+
+    def reclaim_orphans(self) -> None:
+        """Degraded takeover: release (and kill, via the agents' heartbeat
+        kill orders) everything the pool still holds for this app id before
+        the fresh gang allocates."""
+        try:
+            self.rm.call("release_all", app_id=self.app_id)
+        except (RpcError, OSError):
+            pass  # pool unreachable: allocation conflicts will surface loudly
+
     def poll_exited(self) -> dict[str, int]:
         try:
             exits = {cid: int(rc) for cid, rc in self.rm.call("poll_exited", app_id=self.app_id).items()}
@@ -1163,8 +1395,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--max-missed", type=int, default=None,
                    help="overrides tony.node.max-missed-heartbeats")
     p.add_argument("--info-file", default="", help="write host/port JSON here once serving")
+    p.add_argument("--journal-file", default=None,
+                   help="recovery journal path (overrides tony.pool.journal.file); "
+                        "a restarted pool replays it and re-adopts live work")
     args = p.parse_args(argv)
     config = TonyConfig.from_layers(conf_file=args.conf_file, conf_args=args.conf)
+    from tony_tpu.chaos import ChaosContext
+
     svc = PoolService(
         bind_host=args.bind_host,
         port=args.port,
@@ -1178,6 +1415,10 @@ def main(argv: list[str] | None = None) -> int:
         queues=parse_queue_spec(config.get(keys.POOL_QUEUES) or "default=1.0"),
         preemption=config.get_bool(keys.POOL_PREEMPTION_ENABLED),
         preemption_grace_ms=config.get_time_ms(keys.POOL_PREEMPTION_GRACE_MS, 0),
+        journal_path=args.journal_file
+        if args.journal_file is not None
+        else (config.get(keys.POOL_JOURNAL_FILE) or None),
+        chaos=ChaosContext.from_config(config, identity="pool"),
     )
     svc.start()
     host, port = svc.address

@@ -21,6 +21,7 @@ import os
 import signal
 import subprocess
 import threading
+import time
 import uuid
 from abc import ABC, abstractmethod
 from dataclasses import dataclass
@@ -122,6 +123,43 @@ class Container:
         return env
 
 
+def container_to_record(container: "Container") -> dict:
+    """JSON-serializable form of a Container for the AM's takeover journal
+    (rebuilt by :func:`container_from_record` in the successor AM)."""
+    return {
+        "id": container.id,
+        "host": container.host,
+        "resources": {
+            "memory_bytes": container.resources.memory_bytes,
+            "vcores": container.resources.vcores,
+            "chips": container.resources.chips,
+        },
+        "chip_coords": [list(c) for c in container.chip_coords],
+        "slice_name": container.slice_name,
+        "slice_topology": list(container.slice_topology),
+        "job_type": container.job_type,
+        "task_index": container.task_index,
+    }
+
+
+def container_from_record(record: dict) -> "Container":
+    res = record.get("resources") or {}
+    return Container(
+        id=record["id"],
+        host=record.get("host", ""),
+        resources=Resources(
+            memory_bytes=int(res.get("memory_bytes", 0)),
+            vcores=int(res.get("vcores", 0)),
+            chips=int(res.get("chips", 0)),
+        ),
+        chip_coords=tuple((int(r), int(c)) for r, c in record.get("chip_coords", [])),
+        slice_name=record.get("slice_name", ""),
+        slice_topology=tuple(record.get("slice_topology") or (0, 0)),  # type: ignore[arg-type]
+        job_type=record.get("job_type", ""),
+        task_index=int(record.get("task_index", -1)),
+    )
+
+
 class AllocationError(RuntimeError):
     """The ask can NEVER be satisfied by this pool (or the pool has no
     nodes): the job fails. Transient shortage raises AllocationPending."""
@@ -184,6 +222,20 @@ class ChipGrid:
             if got is not None:
                 return got
         return None
+
+    def occupy(self, coords: tuple[tuple[int, int], ...]) -> bool:
+        """Mark SPECIFIC coords used — re-accounting a container ADOPTED from
+        a dead AM's journal, whose placement already exists in the world.
+        False (nothing marked) when any coord is already taken: the journal
+        disagrees with this grid, so the adoption must fail."""
+        coords = tuple((int(r), int(c)) for r, c in coords)
+        with self._lock:
+            if any(not (0 <= r < self.rows and 0 <= c < self.cols) for r, c in coords):
+                return False
+            if self._used.intersection(coords):
+                return False
+            self._used.update(coords)
+            return True
 
     def release(self, coords: tuple[tuple[int, int], ...]) -> None:
         with self._lock:
@@ -273,6 +325,26 @@ class ResourceManager(ABC):
         hosts even though the sums agree."""
         return None
 
+    def journal_info(self, container: Container) -> dict | None:
+        """Serializable adoption record the AM writes to its takeover journal
+        so a SUCCESSOR AM process can re-adopt this live container without
+        restarting it (``adopt_container``). None → this RM cannot support
+        adoption and a takeover attempt must degrade to a full gang restart."""
+        return None
+
+    def adopt_container(self, record: dict) -> Container | None:
+        """Re-track a container a PREVIOUS AM process allocated (from its
+        journal's ``journal_info`` record): rebuild accounting and liveness
+        tracking without launching anything. None → unadoptable (takeover
+        degrades)."""
+        return None
+
+    def reclaim_orphans(self) -> None:
+        """Degraded-takeover backstop: kill/release everything the pool still
+        holds for this app. Remote pools implement it (release_all); for
+        in-process RMs the dead AM's local children are reaped by the
+        caller's /proc sweep — nothing to do here."""
+
     @abstractmethod
     def release(self, container: Container) -> None: ...
 
@@ -307,6 +379,11 @@ class ContainerLauncher:
 
     def __init__(self) -> None:
         self._procs: dict[str, subprocess.Popen] = {}
+        # containers ADOPTED from a dead AM's journal: tracked by bare pid —
+        # they are init's children now, so exit codes are unknowable and
+        # liveness is a kill(pid, 0) probe, not a wait(). None = known dead
+        # at adoption (pid vanished or was recycled during the outage).
+        self._adopted: dict[str, int | None] = {}
         self._grace_s: dict[str, float] = {}
         self._reported: set[str] = set()
         self._lock = threading.Lock()
@@ -339,6 +416,35 @@ class ContainerLauncher:
             self._procs[container_id] = proc
             self._grace_s[container_id] = grace_s
 
+    def adopt(
+        self, container_id: str, pid: int, grace_s: float = 3.0,
+        start_ticks: int | None = None,
+    ) -> None:
+        """Track a container launched by a DEAD predecessor process (AM
+        takeover): the subprocess was re-parented to init, so this launcher
+        can only probe/kill it by pid. The pid may already be gone — the
+        first ``poll_exited`` then reports it with the unknowable-exit code
+        and the AM's normal failure machinery takes over.
+
+        ``start_ticks`` (the journaled /proc start time) guards against pid
+        reuse during the AM outage: a recycled pid would otherwise make this
+        launcher probe — and eventually SIGKILL — a stranger process."""
+        tracked: int | None = int(pid)
+        if start_ticks is not None:
+            actual = _pid_start_ticks(tracked)
+            if actual is not None and actual != int(start_ticks):
+                tracked = None  # pid recycled: the real container is gone
+        with self._lock:
+            self._adopted[container_id] = tracked
+            self._grace_s[container_id] = grace_s
+
+    def pid_of(self, container_id: str) -> int | None:
+        with self._lock:
+            proc = self._procs.get(container_id)
+            if proc is not None:
+                return proc.pid
+            return self._adopted.get(container_id)
+
     def poll_exited(self) -> dict[str, int]:
         out: dict[str, int] = {}
         with self._lock:
@@ -349,6 +455,15 @@ class ContainerLauncher:
                 if rc is not None:
                     out[cid] = rc
                     self._reported.add(cid)
+            for cid, pid in self._adopted.items():
+                if cid in self._reported or (pid is not None and _pid_alive(pid)):
+                    continue
+                # init reaped the real exit status with the dead AM; the
+                # executor's RPC result report (which rides out the takeover)
+                # is the authoritative record — this code is only the
+                # silent-death backstop
+                out[cid] = constants.EXIT_ADOPTED_UNKNOWN
+                self._reported.add(cid)
         return out
 
     def kill(self, container_id: str, wait: bool = True, force: bool = False) -> None:
@@ -363,8 +478,13 @@ class ContainerLauncher:
         not either."""
         with self._lock:
             proc = self._procs.get(container_id)
+            adopted_pid = self._adopted.get(container_id)
             grace_s = self._grace_s.get(container_id, 3.0)
-        if not proc or proc.poll() is not None:
+        if proc is None:
+            if adopted_pid is not None:
+                _kill_adopted(adopted_pid, grace_s, wait=wait, force=force)
+            return
+        if proc.poll() is not None:
             return
         if force:
             # the cgroup-kill analog: cross setsid boundaries (the executor
@@ -395,11 +515,78 @@ class ContainerLauncher:
 
     def live_ids(self) -> list[str]:
         with self._lock:
-            return [cid for cid, p in self._procs.items() if p.poll() is None]
+            live = [cid for cid, p in self._procs.items() if p.poll() is None]
+            live += [
+                cid for cid, pid in self._adopted.items()
+                if pid is not None and _pid_alive(pid)
+            ]
+            return live
 
     def kill_all(self, wait: bool = True) -> None:
         for cid in self.live_ids():
             self.kill(cid, wait=wait)
+
+
+def _pid_start_ticks(pid: int) -> int | None:
+    """The process's start time in clock ticks (/proc stat field 22) — the
+    (pid, start_ticks) pair is a unique process identity on this boot, which
+    is what makes adopting a bare pid across an AM swap safe against pid
+    reuse. None where /proc is unavailable (the guard degrades to pid-only)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return int(f.read().rsplit(")", 1)[1].split()[19])
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    try:
+        # a zombie answers kill(pid, 0) but is dead — it just awaits a reap
+        # by whoever inherited it (init for adopted containers)
+        with open(f"/proc/{pid}/stat") as f:
+            if f.read().rsplit(")", 1)[1].split()[0] == "Z":
+                return False
+    except (OSError, IndexError):
+        pass
+    return True
+
+
+def _kill_adopted(pid: int, grace_s: float, wait: bool, force: bool) -> None:
+    """Kill an adopted (non-child) container by pid: same SIGTERM → grace →
+    SIGKILL contract as the Popen path, with liveness probed via kill(pid, 0)
+    since there is no child handle to wait() on."""
+    if not _pid_alive(pid):
+        return
+    if force:
+        _kill_process_tree(pid)
+        return
+    try:
+        pgid = os.getpgid(pid)
+        os.killpg(pgid, signal.SIGTERM)
+    except ProcessLookupError:
+        return
+
+    def escalate() -> None:
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline:
+            if not _pid_alive(pid):
+                return
+            time.sleep(0.05)
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    if wait:
+        escalate()
+    else:
+        threading.Thread(target=escalate, daemon=True).start()
 
 
 def _kill_process_tree(pid: int) -> None:
@@ -545,6 +732,42 @@ class LocalResourceManager(ProcessContainerMixin, ResourceManager):
 
     def node_capacities(self) -> list[Resources]:
         return [self.total_capacity()]
+
+    def journal_info(self, container: Container) -> dict | None:
+        pid = self.launcher.pid_of(container.id)
+        if pid is None:
+            return None  # allocated but never started: nothing to adopt
+        with self._lock:
+            grace_s = self.launcher._grace_s.get(container.id, 3.0)
+        return {
+            **container_to_record(container), "pid": pid, "grace_s": grace_s,
+            # (pid, start_ticks) is the unique identity the adopting AM
+            # verifies — a pid recycled during the outage must not be probed
+            "pid_start": _pid_start_ticks(pid),
+        }
+
+    def adopt_container(self, record: dict) -> Container | None:
+        pid = record.get("pid")
+        if not pid:
+            return None
+        c = container_from_record(record)
+        with self._lock:
+            if self.host.used_memory + c.resources.memory_bytes > self.host.memory_bytes:
+                return None
+            if self.host.used_vcores + c.resources.vcores > self.host.vcores:
+                return None
+            if c.chip_coords and not self.grid.occupy(c.chip_coords):
+                return None
+            self.host.used_memory += c.resources.memory_bytes
+            self.host.used_vcores += c.resources.vcores
+            self._containers[c.id] = c
+        # liveness by pid probe: a pid that already died (or was recycled —
+        # start_ticks mismatch) surfaces on the first poll_exited as
+        # EXIT_ADOPTED_UNKNOWN — adoption still succeeds so the normal
+        # failure machinery (not a degraded takeover) handles it
+        self.launcher.adopt(c.id, int(pid), float(record.get("grace_s", 3.0)),
+                            start_ticks=record.get("pid_start"))
+        return c
 
     def _live_containers(self) -> list[Container]:
         with self._lock:
@@ -751,6 +974,54 @@ class MultiSliceResourceManager(ProcessContainerMixin, ResourceManager):
                     chips=base + (1 if i < rem else 0),
                 ))
         return out
+
+    def journal_info(self, container: Container) -> dict | None:
+        pid = self.launcher.pid_of(container.id)
+        with self._lock:
+            entry = self._containers.get(container.id)
+            grace_s = self.launcher._grace_s.get(container.id, 3.0)
+        if pid is None or entry is None:
+            return None
+        _, slice_id, charges = entry
+        sl = self.slices[slice_id]
+        return {
+            **container_to_record(container),
+            "pid": pid,
+            "pid_start": _pid_start_ticks(pid),
+            "grace_s": grace_s,
+            "slice_id": slice_id,
+            "charges": [
+                [sl.hosts.index(h), mem, vc] for h, (mem, vc) in charges.items()
+            ],
+        }
+
+    def adopt_container(self, record: dict) -> Container | None:
+        pid = record.get("pid")
+        sid = record.get("slice_id")
+        if not pid or sid is None or not 0 <= int(sid) < len(self.slices):
+            return None
+        c = container_from_record(record)
+        sl = self.slices[int(sid)]
+        with self._lock:
+            charges: dict[_Host, tuple[int, int]] = {}
+            for hidx, mem, vc in record.get("charges", []):
+                if not 0 <= int(hidx) < len(sl.hosts):
+                    return None
+                charges[sl.hosts[int(hidx)]] = (int(mem), int(vc))
+            if any(
+                h.used_memory + mem > h.memory_bytes or h.used_vcores + vc > h.vcores
+                for h, (mem, vc) in charges.items()
+            ):
+                return None
+            if c.chip_coords and not sl.grid.occupy(c.chip_coords):
+                return None
+            for h, (mem, vc) in charges.items():
+                h.used_memory += mem
+                h.used_vcores += vc
+            self._containers[c.id] = (c, int(sid), charges)
+        self.launcher.adopt(c.id, int(pid), float(record.get("grace_s", 3.0)),
+                            start_ticks=record.get("pid_start"))
+        return c
 
     def gang_slice_span(self) -> list[int]:
         """Slice ids the gang's allocations occupy — the job's DCN span.

@@ -203,6 +203,21 @@ class RpcClient:
                 finally:
                     self._sock = None
 
+    def retarget(self, host: str, port: int, secret: str | None = None) -> None:
+        """Re-point this client at a MOVED server (work-preserving AM
+        takeover republishes ``am_info`` with a fresh port + secret). The
+        stale socket is dropped; the next call reconnects to the new
+        address. Thread-safe against in-flight calls (same lock)."""
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+            self.host, self.port = host, int(port)
+            if secret is not None:
+                self.secret = secret
+
     def call(self, method: str, **params: Any) -> Any:
         tr = _trace.get()
         if tr is None:  # disabled fast path: no span objects, no trace field
@@ -294,6 +309,7 @@ APPLICATION_RPC_METHODS = [
     "register_worker_spec",
     "get_cluster_spec",
     "register_execution_result",
+    "resync_task",           # post-takeover re-attach (idempotent, epoch-fenced)
     "register_tensorboard_url",
     "register_task_url",
     "task_executor_heartbeat",

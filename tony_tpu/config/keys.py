@@ -38,6 +38,14 @@ APPLICATION_TAGS = "tony.application.tags"
 # tony.am.* — application master
 # ---------------------------------------------------------------------------
 AM_RETRY_COUNT = "tony.am.retry-count"
+# Work-preserving AM restart (docs/fault-tolerance.md "Control-plane
+# failures"): the AM journals its recoverable state (gang epoch, per-task
+# registrations, container map, pending resizes, chaos progress) to
+# <staging>/am_journal.jsonl, and a retried AM attempt replays it to ADOPT
+# the live gang — executors ride out the outage on their missed-heartbeat
+# budget and re-sync, the training children never stop. false restores the
+# pre-takeover behavior: every AM retry is a full gang restart.
+AM_TAKEOVER_ENABLED = "tony.am.takeover.enabled"
 AM_RPC_PORT = "tony.am.rpc.port"                  # 0 = ephemeral
 AM_GANG_TIMEOUT_MS = "tony.am.gang-timeout-ms"    # max wait for full gang registration
 AM_MONITOR_INTERVAL_MS = "tony.am.monitor-interval-ms"
@@ -124,6 +132,14 @@ POOL_PREEMPTION_ENABLED = "tony.pool.preemption.enabled"
 # before the scheduler evicts over-share borrowers from OTHER queues
 # (same-queue priority preemption has no grace — it is an explicit ranking).
 POOL_PREEMPTION_GRACE_MS = "tony.pool.preemption.grace-ms"
+# Pool-service recovery journal (docs/fault-tolerance.md "Control-plane
+# failures"): app registrations/admissions/allocations are journaled here so
+# a restarted pool rebuilds its queue state (admitted apps stay admitted,
+# waiting apps keep their place) and re-adopts live containers from agent
+# re-registration instead of forgetting every admitted app. Empty (the
+# default) disables journaling — a restarted pool starts empty and agents
+# kill the orphaned containers, the pre-journal behavior.
+POOL_JOURNAL_FILE = "tony.pool.journal.file"
 
 # ---------------------------------------------------------------------------
 # tony.history.* / tony.portal.* — events, history, portal
@@ -279,6 +295,7 @@ DEFAULTS: dict[str, str] = {
     APPLICATION_TAGS: "",
 
     AM_RETRY_COUNT: "0",
+    AM_TAKEOVER_ENABLED: "true",
     AM_RPC_PORT: "0",
     AM_GANG_TIMEOUT_MS: "300000",
     AM_MONITOR_INTERVAL_MS: "200",
@@ -316,6 +333,7 @@ DEFAULTS: dict[str, str] = {
     POOL_QUEUES: "default=1.0",
     POOL_PREEMPTION_ENABLED: "false",
     POOL_PREEMPTION_GRACE_MS: "0",
+    POOL_JOURNAL_FILE: "",
 
     HISTORY_LOCATION: "",            # empty → <staging-root>/history
     HISTORY_MOVE_INTERVAL_MS: "1000",

@@ -18,6 +18,7 @@ TONY_DEFAULT_CONF = "tony-default.json"  # packaged defaults (tony-default.xml a
 TONY_SITE_CONF = "tony-site.json"       # cluster-level overrides
 TONY_STAGING_DIRNAME = ".tony"          # per-app staging root
 AM_INFO_FILE = "am_info.json"           # AM host/port/secret advertisement (YARN report analog)
+AM_JOURNAL_FILE = "am_journal.jsonl"    # AM recoverable-state journal (work-preserving takeover)
 POOL_INFO_FILE = "pool_info.json"       # pool-service host/port advertisement (RM address analog)
 CONFIG_SNAPSHOT_FILE = "config.json"    # job conf written alongside history (HistoryFileUtils)
 HISTORY_SUFFIX = ".jhist"               # history event file suffix (Avro .jhist analog → JSONL)
@@ -152,6 +153,12 @@ EXIT_NODE_LOST = -100   # container's host agent died (YARN ContainerExitStatus.
 # ContainerExitStatus.PREEMPTED analog; not a job failure — excluded
 # from restart budgets)
 EXIT_PREEMPTED = -102
+# a container ADOPTED across a work-preserving AM takeover died while the
+# AM was away: it re-parented to init when the old AM was SIGKILLed, so its
+# real exit status was reaped and is unknowable. Only the silent-death
+# backstop — the executor's RPC result report (which rides out the takeover)
+# is the authoritative record and lands first on every healthy exit.
+EXIT_ADOPTED_UNKNOWN = -103
 
 # Distributed-mode values
 DISTRIBUTED_MODE_GANG = "GANG"

@@ -183,26 +183,48 @@ class PortalHandler(BaseHTTPRequestHandler):
         except (OSError, ValueError, KeyError):
             return None
 
+    def _am_call(self, app_id: str, *methods: str) -> list | None:
+        """Call the app's AM, re-resolving a MOVED endpoint once: a
+        work-preserving takeover can republish ``am_info`` with a fresh
+        port/secret between the listing and this call — the stale client
+        fails, the re-read reaches the adopting AM. Returns the per-method
+        results, or None (no AM / both attempts failed — the second failure
+        propagates to the caller's accounting)."""
+        last: Exception | None = None
+        for attempt in (0, 1):
+            cli = self._am_client(app_id)
+            if cli is None:
+                if last is not None:
+                    raise last
+                return None
+            try:
+                return [cli.call(m) for m in methods]
+            except Exception as e:  # noqa: BLE001 — AM may have just exited or moved
+                last = e
+            finally:
+                cli.close()
+        raise last  # type: ignore[misc]
+
     def _metrics_text(self) -> str:
         """Merged Prometheus exposition: own registry (no extra labels) +
         each running AM's snapshot under app=<id>. An AM that dies between
         the listing and the call degrades to skipping that app — counted in
         ``tony_portal_scrape_failures_total{app=...}`` — never to failing
-        the whole exposition."""
+        the whole exposition; an AM that merely MOVED (takeover) is
+        re-resolved mid-scrape and still exported."""
         groups: list = []
         for app_id in self._running_ids():
-            cli = self._am_client(app_id)
-            if cli is None:
-                continue
             try:
-                snap = cli.call("get_metrics")
-                groups.append((snap.get("metrics") or [], {"app": app_id}))
-                for task_id, tsnap in (snap.get("tasks") or {}).items():
-                    groups.append((tsnap, {"app": app_id, "task": task_id}))
-            except Exception:  # noqa: BLE001 — AM may have just exited
+                got = self._am_call(app_id, "get_metrics")
+            except Exception:  # noqa: BLE001 — AM gone even after re-resolution
                 _SCRAPE_FAILURES.inc(app=app_id)
-            finally:
-                cli.close()
+                continue
+            if got is None:
+                continue
+            (snap,) = got
+            groups.append((snap.get("metrics") or [], {"app": app_id}))
+            for task_id, tsnap in (snap.get("tasks") or {}).items():
+                groups.append((tsnap, {"app": app_id, "task": task_id}))
         # own registry snapshotted AFTER the scrape loop, so a failure
         # counted just above is visible in THIS exposition, not the next
         groups.insert(0, (REGISTRY.snapshot(), {}))
@@ -344,16 +366,13 @@ class PortalHandler(BaseHTTPRequestHandler):
         return "<h2>task metrics</h2>" + "".join(blocks) if blocks else ""
 
     def _live_table(self, app_id: str) -> str:
-        cli = self._am_client(app_id)
-        if cli is None:
-            return ""
         try:
-            status = cli.call("get_application_status")
-            infos = cli.call("get_task_infos")
-        except Exception:  # noqa: BLE001 — AM may have just exited
+            got = self._am_call(app_id, "get_application_status", "get_task_infos")
+        except Exception:  # noqa: BLE001 — AM gone even after re-resolution
             return ""
-        finally:
-            cli.close()
+        if got is None:
+            return ""
+        status, infos = got
         # tasks an elastic shrink removed must not render as dead forever:
         # the same drop-terminal / mark-resized-away rule tony top applies
         visible = obs_introspect.visible_task_infos(
@@ -365,9 +384,14 @@ class PortalHandler(BaseHTTPRequestHandler):
             f"<td>{html.escape(json.dumps((t.get('metrics') or {}).get('train') or {})[:120])}</td></tr>"
             for t in visible
         )
+        am_note = ""
+        if status.get("am_attempt"):
+            am_note = (f", am attempt {status.get('am_attempt')}"
+                       + (f" [{html.escape(str(status.get('takeover')))}]"
+                          if status.get("takeover") else ""))
         return (
             f"<h2>live (AM state: {html.escape(str(status.get('state')))}"
-            f", attempt {status.get('restart_attempt', 0)})</h2>"
+            f", attempt {status.get('restart_attempt', 0)}{am_note})</h2>"
             f"<table><tr><th>task</th><th>status</th><th>host</th><th>train</th></tr>{rows}</table>"
         )
 
