@@ -1,0 +1,32 @@
+"""History-server workload: quick jax-free steps publishing train metrics.
+
+Each step it atomically drops ``{"step": N, "loss": ..., "mfu": ...,
+"tokens_per_sec": ...}`` at $TONY_TRAIN_METRICS_FILE; the executor's metrics
+push feeds the AM, whose METRICS_SNAPSHOT events become the series the
+history server distills — so the e2e can assert a real MFU trend across two
+ingested runs.
+
+Usage: history_train.py <steps> <mfu_base>
+"""
+
+import json
+import os
+import sys
+import time
+
+steps, mfu_base = int(sys.argv[1]), float(sys.argv[2])
+metrics_path = os.environ["TONY_TRAIN_METRICS_FILE"]
+
+for s in range(1, steps + 1):
+    tmp = metrics_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({
+            "step": s,
+            "loss": round(2.0 / s, 4),
+            "mfu": round(mfu_base + 0.002 * s, 4),
+            "tokens_per_sec": 1000.0 + 10 * s,
+        }, f)
+    os.replace(tmp, metrics_path)
+    time.sleep(0.12)
+
+print(f"fixture: history worker finished {steps} steps")

@@ -1,0 +1,88 @@
+"""``tony bench --gate`` as a repo check (tier-1, docs/history.md).
+
+Every checked-in ``BENCH_*.json`` must satisfy the gate schema, and the
+current trajectory must pass its own gate — a PR that lands a regressed
+bench record (or a malformed one) fails here, which is the whole point of
+turning the perf history into an enforced contract (ROADMAP item 5).
+"""
+
+import json
+import os
+
+import pytest
+
+from tony_tpu.histserver import gate
+
+pytestmark = [pytest.mark.history]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _trajectory():
+    traj = gate.load_trajectory(REPO_ROOT)
+    assert traj, "no checked-in BENCH_*.json trajectory"
+    return traj
+
+
+class TestCheckedInTrajectory:
+    def test_every_record_satisfies_the_gate_schema(self):
+        for fname, rec in _trajectory():
+            errors = gate.validate_record(rec, wrapper=True)
+            assert not errors, f"{fname}: {errors}"
+
+    def test_rounds_are_ordered_and_unique(self):
+        rounds = [rec["n"] for _, rec in _trajectory()]
+        assert rounds == sorted(rounds)
+        assert len(set(rounds)) == len(rounds)
+
+    def test_gate_passes_on_current_trajectory(self):
+        """The newest checked-in record vs the rest of the trajectory: the
+        repo's own perf history must satisfy its own contract."""
+        traj = _trajectory()
+        result = gate.evaluate(traj[-1][1], traj)
+        assert result.passed, "\n" + result.render()
+
+    def test_gate_cli_passes_on_current_trajectory(self, capsys):
+        from tony_tpu.cli.history import main_bench
+
+        assert main_bench(["--gate", "--trajectory-dir", REPO_ROOT]) == 0
+        assert "PASS" in capsys.readouterr().out
+
+    def test_gate_cli_fails_on_synthetic_regression(self, tmp_path, capsys):
+        from tony_tpu.cli.history import main_bench
+
+        traj = _trajectory()
+        regressed = json.loads(json.dumps(traj[-1][1]))  # deep copy
+        regressed["parsed"]["value"] *= 0.8
+        regressed["parsed"]["vs_baseline"] *= 0.8
+        path = tmp_path / "regressed.json"
+        path.write_text(json.dumps(regressed))
+        assert main_bench(["--gate", "--trajectory-dir", REPO_ROOT,
+                           "--record", str(path)]) == 1
+        assert "REGRESSION" in capsys.readouterr().out
+
+    def test_gate_cli_rejects_malformed_record(self, tmp_path, capsys):
+        from tony_tpu.cli.history import main_bench
+
+        path = tmp_path / "bad.json"
+        path.write_text(json.dumps({"parsed": {"metric": "m"}}))
+        assert main_bench(["--gate", "--trajectory-dir", REPO_ROOT,
+                           "--record", str(path)]) == 2
+        assert "gate schema" in capsys.readouterr().err
+
+    def test_raw_bench_line_is_gateable(self, capsys):
+        """`python bench.py | tony bench --gate --record -`: a raw bench
+        output line (no wrapper) gates directly."""
+        from tony_tpu.cli.history import main_bench
+
+        traj = _trajectory()
+        raw = dict(gate.parsed_of(traj[-1][1]))
+        import io
+        import sys as _sys
+
+        stdin, _sys.stdin = _sys.stdin, io.StringIO(json.dumps(raw))
+        try:
+            assert main_bench(["--gate", "--trajectory-dir", REPO_ROOT,
+                               "--record", "-"]) == 0
+        finally:
+            _sys.stdin = stdin

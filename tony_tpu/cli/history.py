@@ -1,0 +1,369 @@
+"""History subcommands: ``tony history …`` and ``tony bench --gate``.
+
+- ``tony history list``              — ingested jobs from the store (falls
+  back to a filesystem scan of ``finished/`` when no store exists yet)
+- ``tony history show <app_id>``     — one job's distilled record (inline
+  distillation when the job is finalized but not yet ingested)
+- ``tony history compare <ids…>``    — side-by-side metric table
+- ``tony history ingest``            — one-shot inline ingestion sweep (the
+  daemonless path; the daemon is ``tony history-server``)
+- ``tony history gc [--dry-run]``    — remove ingested jobs' raw staging
+  dirs past ``tony.history.retention-days`` (never live/un-ingested jobs)
+- ``tony bench --gate``              — diff a bench record against the
+  checked-in ``BENCH_*`` trajectory; exit 1 on regression
+
+Legacy spellings keep working: bare ``tony history`` lists, ``tony history
+<app_id>`` dumps that job's raw event stream (the pre-store behavior).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+from tony_tpu import constants
+from tony_tpu.histserver import gate as _gate
+from tony_tpu.histserver import ingest as _ingest
+from tony_tpu.histserver.server import default_store_path
+from tony_tpu.histserver.store import HistoryStore
+from tony_tpu.obs import artifacts as obs_artifacts
+
+#: compare/show rows: (label, job-row key or summary metric, summary stat)
+_COMPARE_ROWS: list[tuple[str, str, str | None]] = [
+    ("status", "status", None),
+    ("duration_s", "duration_ms", None),
+    ("tasks", "tasks", None),
+    ("gang_epochs", "gang_epochs", None),
+    ("resizes", "resizes", None),
+    ("takeovers", "takeovers", None),
+    ("queue_wait_s", "queue_wait_s", None),
+    ("mfu_p50", "mfu", "p50"),
+    ("tokens_per_sec_p50", "tokens_per_sec", "p50"),
+    ("step_time_ms_p50", "step_time_ms", "p50"),
+    ("loss_last", "loss", "last"),
+]
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--staging", default=None,
+                   help="staging root (default: $TONY_ROOT)")
+    p.add_argument("--store", default=None,
+                   help="history store path (tony.history.store; default "
+                        "<staging>/history/history.sqlite)")
+
+
+def _resolve(args) -> tuple[str, str]:
+    staging = args.staging or constants.default_tony_root()
+    store = args.store or default_store_path(staging)
+    return staging, store
+
+
+def _job_record(store: HistoryStore | None, staging: str, app_id: str) -> dict[str, Any] | None:
+    """The job's store row, or an inline distillation for a finalized job
+    that has not been ingested yet (marked ``not_ingested``)."""
+    if store is not None:
+        row = store.get_job(app_id)
+        if row is not None:
+            return row
+    art = obs_artifacts.index(staging, app_id)
+    if art.jhist_path is None:
+        return None
+    try:
+        job, series, summary = _ingest.distill(art)
+    except ValueError:
+        return None
+    job["summary"] = summary
+    job["not_ingested"] = True
+    return job
+
+
+def _fmt_cell(job: dict[str, Any], key: str, stat: str | None) -> str:
+    if stat is None:
+        v = job.get(key)
+        if key == "duration_ms":
+            return f"{(v or 0) / 1000.0:.1f}"
+        return "-" if v is None else str(v)
+    v = (job.get("summary") or {}).get(key)
+    v = (v or {}).get(stat)
+    return "-" if v is None else f"{v:.4g}"
+
+
+# ------------------------------------------------------------ subcommands
+def _cmd_list(args) -> int:
+    staging, store_path = _resolve(args)
+    if os.path.exists(store_path):
+        store = HistoryStore(store_path)
+        try:
+            jobs = store.list_jobs()
+        finally:
+            store.close()
+        if not jobs:
+            print(f"no ingested jobs in {store_path}")
+            return 0
+        for j in jobs:
+            flags = " incomplete" if j["incomplete"] else ""
+            print(f"{j['app_id']}  {j['status']:9s}  "
+                  f"{j['duration_ms'] / 1000.0:8.1f}s  user={j['user'] or '-'}"
+                  f"  epochs={j['gang_epochs']} resizes={j['resizes']}"
+                  f" takeovers={j['takeovers']}{flags}")
+        return 0
+    # no store yet: the filesystem listing is still the truth
+    hist_root = os.path.join(staging, "history")
+    jobs_fs = obs_artifacts.finished_jobs(hist_root)
+    if not jobs_fs:
+        print(f"no finished jobs under {hist_root} (and no store at {store_path})")
+        return 0
+    for h in jobs_fs:
+        dur_s = max(h.completed_ms - h.started_ms, 0) / 1000
+        print(f"{h.app_id}  {h.status:9s}  {dur_s:8.1f}s  user={h.user}  (not ingested)")
+    return 0
+
+
+def _cmd_show(args) -> int:
+    staging, store_path = _resolve(args)
+    store = HistoryStore(store_path) if os.path.exists(store_path) else None
+    try:
+        job = _job_record(store, staging, args.app_id)
+        if job is None:
+            print(f"no history for {args.app_id} under {staging}", file=sys.stderr)
+            return 1
+        print(f"{job['app_id']}  {job['status']}"
+              + ("  [incomplete]" if job.get("incomplete") else "")
+              + ("  [not ingested]" if job.get("not_ingested") else ""))
+        for label, key, stat in _COMPARE_ROWS[1:]:
+            print(f"  {label:<22s} {_fmt_cell(job, key, stat)}")
+        summary = job.get("summary") or {}
+        reason = summary.get("reason")
+        if reason:
+            print(f"  {'reason':<22s} {reason}")
+        series = sorted(k for k, v in summary.items() if isinstance(v, dict) and "p50" in v)
+        if series:
+            print(f"  {'series':<22s} {', '.join(series)}")
+        if args.events:
+            art = obs_artifacts.index(staging, args.app_id)
+            evs, complete = art.read_events()
+            for ev in evs:
+                print(ev.to_json())
+            if not complete:
+                print("# (event stream incomplete: torn/truncated .jhist)",
+                      file=sys.stderr)
+        return 0
+    finally:
+        if store is not None:
+            store.close()
+
+
+def _cmd_compare(args) -> int:
+    staging, store_path = _resolve(args)
+    store = HistoryStore(store_path) if os.path.exists(store_path) else None
+    try:
+        jobs = []
+        for app_id in args.app_ids:
+            job = _job_record(store, staging, app_id)
+            if job is None:
+                print(f"no history for {app_id} under {staging}", file=sys.stderr)
+                return 1
+            jobs.append(job)
+        width = max(14, *(len(j["app_id"]) for j in jobs))
+        header = f"{'metric':<22s} " + " ".join(f"{j['app_id']:>{width}s}" for j in jobs)
+        print(header)
+        for label, key, stat in _COMPARE_ROWS:
+            cells = " ".join(f"{_fmt_cell(j, key, stat):>{width}s}" for j in jobs)
+            print(f"{label:<22s} {cells}")
+        return 0
+    finally:
+        if store is not None:
+            store.close()
+
+
+def _cmd_ingest(args) -> int:
+    staging, store_path = _resolve(args)
+    store = HistoryStore(store_path)
+    try:
+        counts = _ingest.sweep(store, [staging], retention_days=args.retention_days)
+        print(f"[tony-history] ingest sweep over {staging}: "
+              + ", ".join(f"{k}={v}" for k, v in counts.items() if v)
+              + f" (store: {store_path})")
+        return 0 if not counts["errors"] else 1
+    finally:
+        store.close()
+
+
+def _cmd_gc(args) -> int:
+    staging, store_path = _resolve(args)
+    if args.retention_days <= 0:
+        print("tony history gc: --retention-days must be > 0 "
+              "(tony.history.retention-days)", file=sys.stderr)
+        return 2
+    if not os.path.exists(store_path):
+        print(f"tony history gc: no store at {store_path} — ingest first "
+              "(un-ingested jobs are never GC'd)", file=sys.stderr)
+        return 1
+    store = HistoryStore(store_path)
+    try:
+        removed = _ingest.gc_staging(
+            store, staging, args.retention_days, dry_run=args.dry_run)
+        verb = "would remove" if args.dry_run else "removed"
+        for app_id, path in removed:
+            print(f"[tony-history] {verb} {path} ({app_id})")
+        print(f"[tony-history] gc {verb} {len(removed)} staging dir(s)")
+        return 0
+    finally:
+        store.close()
+
+
+def _site_retention_default() -> float:
+    """``tony.history.retention-days`` from tony-site.json, for the CLI
+    default (flags still win)."""
+    site = os.path.join(os.getcwd(), constants.TONY_SITE_CONF)
+    if not os.path.exists(site):
+        return 0.0
+    try:
+        from tony_tpu.config import TonyConfig, keys
+
+        return float(TonyConfig.from_layers(site_file=site).get(keys.HISTORY_RETENTION_DAYS) or 0)
+    except (OSError, ValueError):
+        return 0.0
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(argv or [])
+    sub = argv[0] if argv and not argv[0].startswith("-") else None
+    known = {"list", "show", "compare", "ingest", "gc"}
+    if sub is None:
+        sub, rest = "list", argv
+    elif sub in known:
+        rest = argv[1:]
+    else:
+        # legacy spelling: `tony history <app_id>` dumps the raw events
+        sub, rest = "show", [argv[0], "--events", *argv[1:]]
+
+    p = argparse.ArgumentParser(prog=f"tony history {sub}")
+    _add_common(p)
+    if sub == "show":
+        p.add_argument("app_id")
+        p.add_argument("--events", action="store_true",
+                       help="also dump the raw .jhist event stream")
+        p.add_argument("--root", dest="legacy_root", default=None,
+                       help=argparse.SUPPRESS)  # pre-store flag, tolerated
+        return _run_legacy_root(p, rest, _cmd_show)
+    if sub == "compare":
+        p.add_argument("app_ids", nargs="+")
+        return _cmd_compare(p.parse_args(rest))
+    if sub == "ingest":
+        p.add_argument("--retention-days", type=float, default=_site_retention_default())
+        return _cmd_ingest(p.parse_args(rest))
+    if sub == "gc":
+        p.add_argument("--retention-days", type=float, default=_site_retention_default())
+        p.add_argument("--dry-run", action="store_true",
+                       help="print what would be removed, remove nothing")
+        return _cmd_gc(p.parse_args(rest))
+    p.add_argument("--root", dest="legacy_root", default=None,
+                   help=argparse.SUPPRESS)
+    # flag-first legacy spelling: `tony history --root <dir> <app_id>` — the
+    # pre-store parser took an optional positional alongside --root
+    p.add_argument("legacy_app_id", nargs="?", help=argparse.SUPPRESS)
+
+    def run_list(args) -> int:
+        if args.legacy_app_id:
+            args.app_id, args.events = args.legacy_app_id, True
+            return _cmd_show(args)
+        return _cmd_list(args)
+
+    return _run_legacy_root(p, rest, run_list)
+
+
+def _run_legacy_root(p: argparse.ArgumentParser, rest: list[str], fn) -> int:
+    """The pre-store ``--root HISTORY_DIR`` flag named the history tree, not
+    the staging root — map it to the staging parent so old invocations keep
+    resolving the same files."""
+    args = p.parse_args(rest)
+    if getattr(args, "legacy_root", None) and not args.staging:
+        args.staging = os.path.dirname(args.legacy_root.rstrip("/")) or args.legacy_root
+    return fn(args)
+
+
+# ----------------------------------------------------------------- bench
+def main_bench(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tony bench",
+        description="perf-regression gate over the checked-in BENCH_* "
+                    "trajectory (docs/history.md); measurement itself is "
+                    "`python bench.py`")
+    p.add_argument("--gate", action="store_true",
+                   help="diff a bench record against the trajectory; exit 1 "
+                        "on regression")
+    p.add_argument("--record", default=None,
+                   help="current bench record: a BENCH_*.json wrapper or a "
+                        "raw bench.py JSON line ('-' reads stdin). Default: "
+                        "the newest trajectory record (self-check mode)")
+    p.add_argument("--trajectory-dir", default=os.getcwd(),
+                   help="directory holding BENCH_*.json (default: cwd)")
+    p.add_argument("--tolerance-pct", type=float, default=_gate.DEFAULT_TOLERANCE_PCT,
+                   help="allowed drop vs the trajectory best, percent")
+    p.add_argument("--threshold", action="append", default=[],
+                   metavar="METRIC=PCT",
+                   help="per-metric threshold override (repeatable)")
+    args = p.parse_args(argv)
+
+    if not args.gate:
+        print("tony bench: measurement runs via `python bench.py`; this "
+              "command gates records (--gate)", file=sys.stderr)
+        return 2
+
+    try:
+        trajectory = _gate.load_trajectory(args.trajectory_dir)
+    except (OSError, ValueError) as e:
+        print(f"tony bench --gate: unreadable trajectory under "
+              f"{args.trajectory_dir}: {e}", file=sys.stderr)
+        return 2
+    if not trajectory:
+        print(f"tony bench --gate: no BENCH_*.json under {args.trajectory_dir}",
+              file=sys.stderr)
+        return 2
+    schema_errors = []
+    for fname, rec in trajectory:
+        for err in _gate.validate_record(rec, wrapper=True):
+            schema_errors.append(f"{fname}: {err}")
+    if schema_errors:
+        print("tony bench --gate: trajectory fails the gate schema:", file=sys.stderr)
+        for err in schema_errors:
+            print(f"  {err}", file=sys.stderr)
+        return 2
+
+    if args.record:
+        try:
+            if args.record == "-":
+                current = json.load(sys.stdin)
+            else:
+                with open(args.record) as f:
+                    current = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"tony bench --gate: unreadable --record: {e}", file=sys.stderr)
+            return 2
+        errs = _gate.validate_record(current, wrapper="parsed" in current)
+        if errs:
+            print("tony bench --gate: record fails the gate schema:", file=sys.stderr)
+            for err in errs:
+                print(f"  {err}", file=sys.stderr)
+            return 2
+    else:
+        current = trajectory[-1][1]  # newest round vs the rest (self-check)
+
+    try:
+        per_metric = _gate.parse_thresholds(args.threshold)
+    except ValueError as e:
+        print(f"tony bench --gate: {e}", file=sys.stderr)
+        return 2
+    result = _gate.evaluate(current, trajectory,
+                            tolerance_pct=args.tolerance_pct,
+                            per_metric_pct=per_metric)
+    print(result.render())
+    return 0 if result.passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,6 @@ default), the same resolution ``tony trace`` uses:
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import re
 import sys
@@ -27,6 +26,7 @@ import time
 from typing import Any
 
 from tony_tpu import constants
+from tony_tpu.obs import artifacts as obs_artifacts
 from tony_tpu.obs import introspect as obs_introspect
 from tony_tpu.obs import logging as obs_logging
 
@@ -44,26 +44,21 @@ def _pipe_closed() -> int:
 
 
 def _am_rpc(staging: str, app_id: str):
-    """RpcClient for the job's AM from its am_info.json advertisement, or
-    None (job finished / never started)."""
-    info_path = os.path.join(staging, app_id, constants.AM_INFO_FILE)
-    try:
-        with open(info_path) as f:
-            info = json.load(f)
-        from tony_tpu.cluster.rpc import RpcClient
-
-        return RpcClient(info["host"], info["port"], secret=info.get("secret", ""),
-                         timeout_s=5.0)
-    except (OSError, ValueError, KeyError):
-        return None
+    """RpcClient for the job's AM (artifact-index resolution), or None
+    (job finished / never started)."""
+    return obs_artifacts.index(staging, app_id).am_client(timeout_s=5.0)
 
 
 def _final_status(staging: str, app_id: str) -> dict[str, Any] | None:
-    try:
-        with open(os.path.join(staging, app_id, "am_status.json")) as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return None
+    return obs_artifacts.index(staging, app_id).am_status()
+
+
+def _history_hint(staging: str, app_id: str) -> str:
+    """Where a finalized job's story continues: its ingested history entry
+    (``tony history show``) instead of a dead-AM scrape failure."""
+    art = obs_artifacts.index(staging, app_id)
+    suffix = "" if art.finalized else " (finalizing)"
+    return f"history: tony history show {app_id}{suffix}"
 
 
 # ----------------------------------------------------------- tony profile
@@ -201,11 +196,11 @@ def main_logs(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
 
     staging = args.staging or constants.default_tony_root()
-    log_dir = obs_logging.resolve_log_dir(staging, args.app_id)
+    log_dir = obs_artifacts.index(staging, args.app_id).log_dir
     keep = _record_filter(args)
     if args.follow and not os.path.isdir(os.path.join(staging, args.app_id)):
-        # -f on a typo'd app id would otherwise spin forever waiting for an
-        # am_status.json that can never appear
+        # -f on a typo'd app id would otherwise spin forever waiting for a
+        # final status that can never appear
         print(f"no application {args.app_id} under {staging}", file=sys.stderr)
         return 1
 
@@ -312,6 +307,7 @@ def main_top(argv: list[str] | None = None) -> int:
         if final is not None:
             print(f"{args.app_id} finished: {final.get('status')}"
                   + (f" ({final.get('reason')})" if final.get("reason") else ""))
+            print(_history_hint(staging, args.app_id))
             return 0
         cli = _am_rpc(staging, args.app_id)
         if cli is None:
@@ -322,6 +318,13 @@ def main_top(argv: list[str] | None = None) -> int:
             infos = cli.call("get_task_infos")
             metrics = cli.call("get_metrics")
         except (RpcError, OSError) as e:
+            # the AM exits between the liveness probe and the scrape when the
+            # job finalizes: that is a finished job, not a scrape failure
+            final = _final_status(staging, args.app_id)
+            if final is not None:
+                print(f"{args.app_id} finished: {final.get('status')}")
+                print(_history_hint(staging, args.app_id))
+                return 0
             print(f"tony top: AM unreachable: {e}", file=sys.stderr)
             return 1
         finally:

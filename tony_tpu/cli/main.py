@@ -23,28 +23,21 @@ def _cmd_submit(argv: list[str]) -> int:
 
 
 def _cmd_history(argv: list[str]) -> int:
-    import argparse
-    import os
+    from tony_tpu.cli.history import main as history_main
 
-    from tony_tpu.cluster import history
+    return history_main(argv)
 
-    p = argparse.ArgumentParser(prog="tony history")
-    p.add_argument("--root", default=None, help="history root (default: $TONY_ROOT/history)")
-    p.add_argument("app_id", nargs="?", help="show events for one application")
-    args = p.parse_args(argv)
-    root = args.root or os.path.join(constants.default_tony_root(), "history")
-    if args.app_id:
-        for ev in history.read_events(root, args.app_id):
-            print(ev.to_json())
-        return 0
-    jobs = history.list_finished_jobs(root)
-    if not jobs:
-        print(f"no finished jobs under {root}")
-        return 0
-    for j in jobs:
-        dur_s = max(j.completed_ms - j.started_ms, 0) / 1000
-        print(f"{j.app_id}  {j.status:9s}  {dur_s:8.1f}s  user={j.user}")
-    return 0
+
+def _cmd_history_server(argv: list[str]) -> int:
+    from tony_tpu.histserver.server import main as server_main
+
+    return server_main(argv)
+
+
+def _cmd_bench(argv: list[str]) -> int:
+    from tony_tpu.cli.history import main_bench
+
+    return main_bench(argv)
 
 
 def _cmd_portal(argv: list[str]) -> int:
@@ -282,6 +275,8 @@ _COMMANDS = {
     "submit": _cmd_submit,
     "pool": _cmd_pool,
     "history": _cmd_history,
+    "history-server": _cmd_history_server,
+    "bench": _cmd_bench,
     "portal": _cmd_portal,
     "notebook": _cmd_notebook,
     "serve": _cmd_serve,
@@ -300,10 +295,12 @@ _COMMANDS = {
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|portal|notebook|serve|mini|data-prep|lint|chaos|trace|profile|logs|top|resize} [options]\n")
+        print("usage: tony {submit|pool|history|history-server|bench|portal|notebook|serve|mini|data-prep|lint|chaos|trace|profile|logs|top|resize} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
-        print("  history    list finished jobs / dump one job's events")
+        print("  history    query the persistent history tier (list|show|compare|ingest|gc)")
+        print("  history-server  run the history daemon: ingest finalized jobs, serve the query API")
+        print("  bench      perf-regression gate over the checked-in BENCH_* trajectory (--gate)")
         print("  portal     serve the history web portal")
         print("  notebook   launch an interactive notebook container + local proxy")
         print("  serve      run a replicated inference fleet (router + health + autoscaler) as an AM-supervised job")

@@ -22,30 +22,11 @@ import os
 from typing import Any
 
 from tony_tpu import constants
+from tony_tpu.obs import artifacts as obs_artifacts
 
-
-def load_spans(trace_dir: str) -> list[dict[str, Any]]:
-    """All spans from every ``*.spans.jsonl`` under ``trace_dir``, sorted by
-    start time. Malformed lines (a process killed mid-write) are skipped."""
-    spans: list[dict[str, Any]] = []
-    if not os.path.isdir(trace_dir):
-        return spans
-    for fn in sorted(os.listdir(trace_dir)):
-        if not fn.endswith(".spans.jsonl"):
-            continue
-        with open(os.path.join(trace_dir, fn), errors="replace") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    d = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(d, dict) and "span_id" in d and "start_ms" in d:
-                    spans.append(d)
-    spans.sort(key=lambda s: s.get("start_ms", 0.0))
-    return spans
+# span discovery lives in the shared artifact index (obs/artifacts.py);
+# re-exported here for the established import path
+load_spans = obs_artifacts.load_spans
 
 
 def to_chrome(spans: list[dict[str, Any]]) -> dict[str, Any]:
@@ -175,18 +156,6 @@ def summarize(spans: list[dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
-def _configured_trace_dir(staging: str, app_id: str) -> str | None:
-    """The job's ``tony.trace.dir`` override from its frozen config, or None
-    (unset, or no frozen config found)."""
-    path = os.path.join(staging, app_id, constants.TONY_FINAL_CONF)
-    try:
-        from tony_tpu.config import TonyConfig, keys
-
-        return TonyConfig.load_final(path).get(keys.TRACE_DIR) or None
-    except (OSError, ValueError):
-        return None
-
-
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="tony trace",
@@ -207,8 +176,8 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
 
     staging = args.staging or constants.default_tony_root()
-    trace_dir = args.trace_dir or _configured_trace_dir(staging, args.app_id) \
-        or os.path.join(staging, args.app_id, "trace")
+    # the artifact index owns discovery (tony.trace.dir override included)
+    trace_dir = args.trace_dir or obs_artifacts.index(staging, args.app_id).trace_dir
     spans = load_spans(trace_dir)
     if not spans:
         print(f"no spans under {trace_dir} — was the job run with "

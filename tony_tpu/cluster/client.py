@@ -295,6 +295,9 @@ def _print_final(handle: ApplicationHandle, status: dict[str, Any]) -> None:
     obs_logging.info(f"[tony] application {handle.app_id} finished: {status['status']}")
     if status.get("reason"):
         obs_logging.info(f"[tony]   reason: {status['reason']}")
+    # the finalized artifacts' story continues in the history tier — point
+    # there instead of leaving the dead AM as the last address
+    obs_logging.info(f"[tony]   history: tony history show {handle.app_id}")
     if status.get("am_attempt"):
         obs_logging.info(
             f"[tony]   served by AM attempt {status['am_attempt']}"

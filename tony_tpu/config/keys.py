@@ -142,10 +142,26 @@ POOL_PREEMPTION_GRACE_MS = "tony.pool.preemption.grace-ms"
 POOL_JOURNAL_FILE = "tony.pool.journal.file"
 
 # ---------------------------------------------------------------------------
-# tony.history.* / tony.portal.* — events, history, portal
+# tony.history.* / tony.portal.* — events, history, portal, history server
 # ---------------------------------------------------------------------------
 HISTORY_LOCATION = "tony.history.location"
 HISTORY_MOVE_INTERVAL_MS = "tony.history.move-interval-ms"
+# Persistent history tier (docs/history.md): the `tony history-server`
+# daemon ingests finalized jobs' artifacts into a SQLite store and serves a
+# query API; `tony history ingest` is the inline one-shot path.
+HISTORY_STORE = "tony.history.store"                # sqlite path; empty → <history>/history.sqlite
+HISTORY_SERVER_PORT = "tony.history.server.port"    # daemon HTTP port (0 = ephemeral)
+HISTORY_SCAN_INTERVAL_MS = "tony.history.scan-interval-ms"  # ingestion sweep cadence
+# Retention window, days: store rows past it are purged each sweep, and
+# `tony history gc` (or the daemon with gc enabled) removes ingested jobs'
+# raw staging dirs past it. 0 (the default) keeps everything forever.
+HISTORY_RETENTION_DAYS = "tony.history.retention-days"
+# Series compaction: at most this many evenly-strided points are stored per
+# (job, metric) series — bounds the store however long a job ran.
+HISTORY_MAX_SERIES_POINTS = "tony.history.max-series-points"
+# Let the DAEMON's sweep also GC raw staging dirs past retention (the CLI
+# `tony history gc` works regardless). Never touches live/un-ingested jobs.
+HISTORY_GC_ENABLED = "tony.history.gc.enabled"
 PORTAL_PORT = "tony.portal.port"
 
 # ---------------------------------------------------------------------------
@@ -337,6 +353,12 @@ DEFAULTS: dict[str, str] = {
 
     HISTORY_LOCATION: "",            # empty → <staging-root>/history
     HISTORY_MOVE_INTERVAL_MS: "1000",
+    HISTORY_STORE: "",               # empty → <history>/history.sqlite
+    HISTORY_SERVER_PORT: "28081",
+    HISTORY_SCAN_INTERVAL_MS: "2000",
+    HISTORY_RETENTION_DAYS: "0",
+    HISTORY_MAX_SERIES_POINTS: "512",
+    HISTORY_GC_ENABLED: "false",
     PORTAL_PORT: "28080",
 
     ELASTIC_JOBTYPE: "worker",

@@ -1,0 +1,33 @@
+"""Persistent history tier: the reference's dedicated history server
+(``tony-history-server``, PAPER.md §0 layer map) rebuilt for this framework.
+
+Finalized jobs' on-disk artifacts — the ``.jhist`` event stream, span JSONL,
+metrics snapshots, profile captures — are write-only archaeology the moment
+the AM exits; this package turns them into a queryable, retained store:
+
+- ``store.py``   — SQLite-backed job/series store with retention + compaction
+- ``ingest.py``  — artifact-index-driven distiller (torn-file tolerant) and
+  the staging-root sweep / GC the daemon and ``tony history ingest|gc`` share
+- ``server.py``  — the ``tony history-server`` daemon: background sweep +
+  HTTP query API with its own ``/metrics`` and ``/healthz``
+- ``gate.py``    — the ``tony bench --gate`` perf-regression contract over
+  the checked-in ``BENCH_*.json`` trajectory
+
+Docs: docs/history.md. Config: the ``tony.history.*`` keys in
+config/keys.py.
+"""
+
+from tony_tpu.histserver.store import HistoryStore
+
+__all__ = ["HistoryStore", "HistoryServer"]
+
+
+def __getattr__(name):
+    # HistoryServer is daemon-only: importing it registers the daemon's
+    # metrics into the process-global registry, which a store-only consumer
+    # (the portal's /history pages, the CLI) must not do — lazy by PEP 562
+    if name == "HistoryServer":
+        from tony_tpu.histserver.server import HistoryServer
+
+        return HistoryServer
+    raise AttributeError(name)

@@ -1,0 +1,217 @@
+"""``tony bench --gate``: the perf trajectory as an enforced contract.
+
+The repo accumulates one ``BENCH_<round>.json`` per benchmarked round — a
+wrapper ``{"n": <round>, "rc": <exit>, "parsed": {<one bench.py JSON line>}}``
+whose ``parsed`` record carries the headline metric (``value``, MFU),
+throughput (``tokens_per_sec``), step time, and the kernel-smoke verdict.
+Until now that trajectory was advisory; the gate makes it fail-stop:
+
+- :func:`validate_record` — the gate schema every checked-in ``BENCH_*``
+  must satisfy (asserted tier-1 by tests/test_bench_gate.py);
+- :func:`evaluate` — diff a current record against the trajectory's best
+  per metric with per-metric thresholds; a drop beyond threshold (or a
+  kernel-smoke failure) is a regression and the CLI exits nonzero.
+
+Direction matters: ``value``/``tokens_per_sec`` regress downward,
+``step_time_ms`` regresses upward. The reference point is the trajectory's
+BEST, not its latest — a slow round must not ratchet the contract down.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+#: gated metrics → direction (+1 higher-is-better, -1 lower-is-better)
+GATE_METRICS: dict[str, int] = {
+    "value": +1,            # the headline metric (MFU for the train bench)
+    "vs_baseline": +1,
+    "tokens_per_sec": +1,
+    "step_time_ms": -1,
+}
+
+#: default allowed drop, percent of the trajectory's best
+DEFAULT_TOLERANCE_PCT = 5.0
+
+_REQUIRED_PARSED = ("metric", "value", "unit", "vs_baseline")
+
+
+def parsed_of(record: dict[str, Any]) -> dict[str, Any]:
+    """The bench line inside a BENCH wrapper, or the record itself when it
+    already IS a raw ``bench.py`` output line."""
+    inner = record.get("parsed")
+    return inner if isinstance(inner, dict) else record
+
+
+def validate_record(record: dict[str, Any], *, wrapper: bool = True) -> list[str]:
+    """Gate-schema errors for one record (empty = valid).
+
+    ``wrapper=True`` additionally checks the BENCH_* file shape (round
+    number ``n``, exit code ``rc``).
+    """
+    errors: list[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if wrapper:
+        if not isinstance(record.get("n"), int):
+            errors.append("missing/odd round number 'n'")
+        if record.get("rc") not in (0,):
+            errors.append(f"bench run exit code rc={record.get('rc')!r} (want 0)")
+        if not isinstance(record.get("parsed"), dict):
+            errors.append("missing 'parsed' bench line")
+            return errors
+    p = parsed_of(record)
+    for key in _REQUIRED_PARSED:
+        if key not in p:
+            errors.append(f"parsed record missing {key!r}")
+    for key in ("value", "vs_baseline"):
+        v = p.get(key)
+        if key in p and not (isinstance(v, (int, float)) and math.isfinite(v)):
+            errors.append(f"parsed {key!r} is not a finite number: {v!r}")
+    if not isinstance(p.get("metric", ""), str):
+        errors.append("parsed 'metric' is not a string")
+    smoke = p.get("kernel_smoke")
+    if smoke is not None and smoke_fraction(smoke) is None:
+        errors.append(f"kernel_smoke not 'passed/total': {smoke!r}")
+    return errors
+
+
+def smoke_fraction(smoke: Any) -> float | None:
+    """``"8/8"`` → 1.0; None when unparseable."""
+    try:
+        passed, _, total = str(smoke).partition("/")
+        t = int(total)
+        return int(passed) / t if t > 0 else None
+    except (ValueError, ZeroDivisionError):
+        return None
+
+
+def load_trajectory(directory: str, pattern: str = "BENCH_*.json") -> list[tuple[str, dict[str, Any]]]:
+    """Checked-in trajectory records, ordered by round number: ``(filename,
+    wrapper_record)`` pairs. Unreadable files raise — a corrupt trajectory
+    is a gate failure, not something to silently skip."""
+    out: list[tuple[str, dict[str, Any]]] = []
+    for path in sorted(glob.glob(os.path.join(directory, pattern))):
+        with open(path) as f:
+            out.append((os.path.basename(path), json.load(f)))
+    out.sort(key=lambda e: (e[1].get("n") if isinstance(e[1].get("n"), int) else 0, e[0]))
+    return out
+
+
+@dataclass
+class GateCheck:
+    metric: str
+    current: float | None
+    reference: float | None
+    reference_from: str
+    threshold_pct: float
+    direction: int
+    passed: bool
+    note: str = ""
+
+
+@dataclass
+class GateResult:
+    passed: bool
+    checks: list[GateCheck] = field(default_factory=list)
+
+    def render(self) -> str:
+        lines = []
+        for c in self.checks:
+            verdict = "ok  " if c.passed else "FAIL"
+            cur = "-" if c.current is None else f"{c.current:.6g}"
+            ref = "-" if c.reference is None else f"{c.reference:.6g}"
+            arrow = "↑" if c.direction > 0 else "↓"
+            lines.append(
+                f"  [{verdict}] {c.metric:<16s} current={cur:<12s} "
+                f"best={ref:<12s} ({c.reference_from}) "
+                f"tol={c.threshold_pct:.1f}% {arrow}"
+                + (f"  {c.note}" if c.note else ""))
+        lines.append("gate: " + ("PASS" if self.passed else "REGRESSION"))
+        return "\n".join(lines)
+
+
+def evaluate(
+    current: dict[str, Any],
+    trajectory: list[tuple[str, dict[str, Any]]],
+    tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
+    per_metric_pct: dict[str, float] | None = None,
+) -> GateResult:
+    """Diff ``current`` (wrapper or raw bench line) against the trajectory.
+
+    A metric regresses when it moves against its direction by more than its
+    threshold relative to the trajectory's best; metrics absent from either
+    side are skipped (a CPU-distilled record has no kernel smoke, an old
+    round has no step_time). Comparisons only happen within the same
+    headline ``metric`` name — a preset change starts a fresh trajectory.
+    """
+    per_metric_pct = per_metric_pct or {}
+    cur = parsed_of(current)
+    cur_name = cur.get("metric")
+    peers = [
+        (fname, parsed_of(rec)) for fname, rec in trajectory
+        if parsed_of(rec).get("metric") == cur_name
+        # self-comparison guard: gating the newest checked-in record against
+        # the trajectory must diff it against the OTHERS
+        and parsed_of(rec) is not cur and parsed_of(rec) != cur
+    ]
+    checks: list[GateCheck] = []
+
+    for metric, direction in GATE_METRICS.items():
+        cv = cur.get(metric)
+        if not isinstance(cv, (int, float)) or not math.isfinite(cv):
+            continue
+        best: float | None = None
+        best_from = "-"
+        for fname, p in peers:
+            v = p.get(metric)
+            if not isinstance(v, (int, float)) or not math.isfinite(v):
+                continue
+            if best is None or (direction > 0 and v > best) or (direction < 0 and v < best):
+                best, best_from = float(v), fname
+        if best is None:
+            continue  # nothing comparable in the trajectory
+        pct = per_metric_pct.get(metric, tolerance_pct)
+        allowed = abs(best) * pct / 100.0
+        drop = (best - cv) if direction > 0 else (cv - best)
+        checks.append(GateCheck(
+            metric=metric, current=float(cv), reference=best,
+            reference_from=best_from, threshold_pct=pct, direction=direction,
+            passed=drop <= allowed,
+            note="" if drop <= allowed else
+            f"regressed {drop / abs(best) * 100.0:.2f}% past the {pct:.1f}% threshold"))
+
+    frac = smoke_fraction(cur.get("kernel_smoke")) if "kernel_smoke" in cur else None
+    if frac is not None:
+        checks.append(GateCheck(
+            metric="kernel_smoke", current=frac, reference=1.0,
+            reference_from="contract", threshold_pct=0.0, direction=+1,
+            passed=frac >= 1.0,
+            note="" if frac >= 1.0 else "on-chip kernel smoke failures"))
+
+    if not any(c.metric in GATE_METRICS for c in checks):
+        # a fresh trajectory (first-ever record, or a preset change that
+        # renamed the headline metric) has no reference to regress against:
+        # that is a pass-with-note, not a failure — the record already
+        # passed the gate schema, and it BECOMES the trajectory to beat
+        checks.append(GateCheck(
+            metric=cur_name or "?", current=None, reference=None,
+            reference_from="-", threshold_pct=tolerance_pct, direction=+1,
+            passed=True,
+            note="no comparable trajectory records — fresh trajectory, nothing to diff"))
+    return GateResult(passed=all(c.passed for c in checks), checks=checks)
+
+
+def parse_thresholds(specs: list[str]) -> dict[str, float]:
+    """``["value=2", "step_time_ms=10"]`` → per-metric threshold percents."""
+    out: dict[str, float] = {}
+    for spec in specs:
+        metric, _, pct = spec.partition("=")
+        if not metric or not pct:
+            raise ValueError(f"bad --threshold {spec!r} (want metric=percent)")
+        out[metric.strip()] = float(pct)
+    return out

@@ -1,0 +1,281 @@
+"""Distill a finalized job's artifacts into the history store.
+
+The pipeline the daemon (server.py), ``tony history ingest``, and ``tony
+history gc`` share. Everything resolves through the artifact index
+(obs/artifacts.py) — ingestion has no discovery walk of its own:
+
+- :func:`distill` reads the ``.jhist`` event stream with torn-file
+  tolerance (a job killed mid-write ingests its intact prefix and is marked
+  ``incomplete``), distills per-job series from ``METRICS_SNAPSHOT`` events
+  (plus a derived ``step_time_ms`` from step/timestamp deltas), counts gang
+  epochs / resizes / takeovers, pairs ``QUEUE_WAIT`` episodes into a queue
+  wait total, and — when the job was traced — folds checkpoint/first-step
+  span totals into the summary.
+- :func:`ingest_job` writes one job idempotently (re-ingest converges).
+- :func:`sweep` scans staging roots for finalized-but-not-yet-ingested jobs
+  (mtime change ⇒ re-ingest) and applies retention.
+- :func:`gc_staging` removes raw staging dirs for jobs already ingested and
+  older than the retention window — never live or un-ingested jobs.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import shutil
+import time
+from typing import Any
+
+from tony_tpu.obs import artifacts as obs_artifacts
+from tony_tpu.obs import logging as obs_logging
+from tony_tpu.histserver.store import HistoryStore
+
+#: train/serve metric keys distilled into per-job series (train loop's step
+#: report and the serve engine's metrics pump both ride METRICS_SNAPSHOT)
+SERIES_KEYS = (
+    "loss", "tokens_per_sec", "mfu", "grad_norm",
+    "tokens_per_s", "queue_depth", "slots_active", "ttft_s",
+)
+
+#: summary percentiles computed per series
+_PERCENTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return math.nan
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def summarize_series(points: list[tuple[int, float]]) -> dict[str, float]:
+    """Percentile summary of one series: the trend charts' per-job scalar."""
+    vals = sorted(v for _, v in points)
+    out = {name: _percentile(vals, q) for name, q in _PERCENTILES}
+    out["min"] = vals[0]
+    out["max"] = vals[-1]
+    out["last"] = points[-1][1]
+    out["count"] = float(len(vals))
+    return out
+
+
+def distill(art: obs_artifacts.JobArtifacts) -> tuple[dict[str, Any], dict, dict]:
+    """``(job_row, series, summary)`` from one job's artifacts.
+
+    Raises ``ValueError`` only when there is nothing to ingest at all (no
+    ``.jhist`` and no parsed history filename) — every degraded state short
+    of that ingests as ``incomplete``.
+    """
+    events, complete = art.read_events()
+    hist = art.history_file
+    if hist is None and not events:
+        raise ValueError(f"{art.app_id}: no history artifacts to ingest")
+
+    series: dict[str, list[tuple[int, float]]] = {}
+    gang_epochs = resizes = takeovers = 0
+    queue_wait_s = 0.0
+    wait_started_ms: int | None = None
+    status = reason = None
+    tasks = 0
+    last_steps: dict[str, tuple[int, float]] = {}  # task -> (step, ts_ms)
+
+    for ev in events:
+        t = ev.type.value
+        if t == "METRICS_SNAPSHOT":
+            per_key: dict[str, list[float]] = {}
+            step_times: list[float] = []
+            for entry in ev.payload.get("tasks", []):
+                train = (entry.get("metrics") or {}).get("train") or {}
+                for k in SERIES_KEYS:
+                    v = train.get(k)
+                    if isinstance(v, (int, float)) and math.isfinite(v):
+                        per_key.setdefault(k, []).append(float(v))
+                # derived step time: wall delta / step delta between this
+                # task's consecutive snapshots (the registry's step-time
+                # histogram is deliberately stripped from the .jhist)
+                step = train.get("step")
+                if isinstance(step, int):
+                    prev = last_steps.get(entry.get("task", "?"))
+                    if prev is not None and step > prev[0] and ev.timestamp_ms > prev[1]:
+                        step_times.append(
+                            (ev.timestamp_ms - prev[1]) / (step - prev[0]))
+                    last_steps[entry.get("task", "?")] = (step, ev.timestamp_ms)
+            if step_times:
+                per_key["step_time_ms"] = step_times
+            for k, vals in per_key.items():
+                series.setdefault(k, []).append(
+                    (ev.timestamp_ms, sum(vals) / len(vals)))
+        elif t == "GANG_COMPLETE":
+            gang_epochs += 1
+        elif t == "GANG_RESIZED":
+            resizes += 1
+        elif t in ("AM_TAKEOVER", "AM_TAKEOVER_DEGRADED"):
+            takeovers += 1
+        elif t == "QUEUE_WAIT":
+            if ev.payload.get("state") == "waiting":
+                wait_started_ms = ev.timestamp_ms
+            elif ev.payload.get("state") == "admitted" and wait_started_ms is not None:
+                waited = max(ev.timestamp_ms - wait_started_ms, 0) / 1000.0
+                queue_wait_s += waited
+                series.setdefault("queue_wait_s", []).append(
+                    (ev.timestamp_ms, waited))
+                wait_started_ms = None
+        elif t == "APPLICATION_FINISHED":
+            status = ev.payload.get("status")
+            reason = ev.payload.get("reason")
+            tasks = len(ev.payload.get("tasks") or [])
+
+    summary: dict[str, Any] = {
+        k: summarize_series(pts) for k, pts in series.items() if pts
+    }
+    if reason:
+        summary["reason"] = str(reason)
+
+    # traced jobs: fold checkpoint / compile / queue span totals in (the
+    # shared span reader tolerates torn span files the same way)
+    spans = obs_artifacts.load_spans(art.trace_dir)
+    if spans:
+        def total(names: tuple[str, ...]) -> float:
+            return sum(
+                max(s.get("end_ms", s["start_ms"]) - s["start_ms"], 0.0) / 1000.0
+                for s in spans if s.get("name") in names)
+
+        ckpt_s = total(("ckpt.save", "ckpt.restore"))
+        if ckpt_s:
+            summary["ckpt_s"] = {"total": ckpt_s}
+        firsts = [
+            max(s.get("end_ms", s["start_ms"]) - s["start_ms"], 0.0) / 1000.0
+            for s in spans if s.get("name") == "train.first_step"]
+        if firsts:
+            summary["first_step_s"] = {"max": max(firsts)}
+
+    started_ms = hist.started_ms if hist else (events[0].timestamp_ms if events else 0)
+    completed_ms = hist.completed_ms if hist else (events[-1].timestamp_ms if events else 0)
+    job = {
+        "app_id": art.app_id,
+        # the encoded filename is the finalization authority; the event
+        # stream's APPLICATION_FINISHED may be missing from a torn file
+        "status": (hist.status if hist else None) or status or "UNKNOWN",
+        "user": hist.user if hist else "",
+        "started_ms": started_ms,
+        "completed_ms": completed_ms,
+        "duration_ms": max(completed_ms - started_ms, 0),
+        "incomplete": not complete,
+        "tasks": tasks,
+        "gang_epochs": gang_epochs,
+        "resizes": resizes,
+        "takeovers": takeovers,
+        "queue_wait_s": round(queue_wait_s, 3),
+        "staging_dir": art.staging_dir,
+        "source_path": art.jhist_path or "",
+        "source_mtime_ns": _mtime_ns(art.jhist_path),
+    }
+    return job, series, summary
+
+
+def _mtime_ns(path: str | None) -> int:
+    if not path:
+        return 0
+    try:
+        return os.stat(path).st_mtime_ns
+    except OSError:
+        return 0
+
+
+def _config_snapshot(art: obs_artifacts.JobArtifacts) -> dict[str, Any]:
+    if not art.config_snapshot_path:
+        return {}
+    try:
+        import json
+
+        with open(art.config_snapshot_path) as f:
+            cfg = json.load(f)
+        return cfg if isinstance(cfg, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def ingest_job(store: HistoryStore, art: obs_artifacts.JobArtifacts) -> str:
+    """Ingest one finalized job; returns the outcome (``ingested`` /
+    ``unchanged`` / ``skipped``). Torn or truncated artifacts ingest as
+    ``incomplete`` rather than raising (satellite contract)."""
+    if not art.finalized:
+        return "skipped"  # live or never-started: not ours to touch
+    known = store.source_mtime_ns(art.app_id)
+    if known is not None and known == _mtime_ns(art.jhist_path):
+        return "unchanged"
+    job, series, summary = distill(art)
+    store.put_job(job, series=series, summary=summary, config=_config_snapshot(art))
+    return "ingested"
+
+
+def sweep(
+    store: HistoryStore,
+    staging_roots: list[str],
+    retention_days: float = 0.0,
+    now_ms: int | None = None,
+) -> dict[str, int]:
+    """One ingestion pass over every staging root: ingest finalized jobs
+    (new or changed), then apply retention. Returns outcome counts."""
+    counts = {"ingested": 0, "unchanged": 0, "skipped": 0, "expired": 0,
+              "errors": 0, "purged": 0}
+    now = now_ms if now_ms is not None else int(time.time() * 1000)
+    cutoff = now - int(retention_days * 86_400_000) if retention_days > 0 else None
+    for root in staging_roots:
+        # one walk of the finished tree per root (not per job): jobs whose
+        # staging dir was GC'd still exist only here, so the map is both the
+        # lookup shortcut and the fresh-store rebuild source
+        finished = obs_artifacts.finished_index(os.path.join(root, "history"))
+        ids = obs_artifacts.staged_ids(root)
+        ids += [a for a in sorted(finished) if a not in ids]
+        for app_id in ids:
+            hint = finished.get(app_id)
+            # never ingest work retention would purge right back out — the
+            # finished .jhist outlives the store row by design, and the
+            # ingest→purge cycle would otherwise repeat every sweep forever
+            if cutoff is not None and hint is not None and hint[1].completed_ms < cutoff:
+                counts["expired"] += 1
+                continue
+            try:
+                art = obs_artifacts.index(root, app_id, finished=hint)
+                counts[ingest_job(store, art)] += 1
+            except Exception as e:  # noqa: BLE001 — one bad job must not stall the sweep
+                counts["errors"] += 1
+                obs_logging.warning(
+                    f"[tony-history] ingest of {app_id} failed: {type(e).__name__}: {e}")
+    if cutoff is not None:
+        counts["purged"] = len(store.purge_older_than(cutoff))
+    return counts
+
+
+def gc_staging(
+    store: HistoryStore,
+    staging_root: str,
+    retention_days: float,
+    dry_run: bool = False,
+    now_ms: int | None = None,
+) -> list[tuple[str, str]]:
+    """Remove raw staging dirs for jobs that are (a) ingested, (b) finalized
+    on disk, and (c) completed more than ``retention_days`` ago. Live jobs
+    (no finished ``.jhist``) and un-ingested jobs are NEVER touched; the
+    finished history tree itself is preserved (the store is a distillation,
+    the ``.jhist`` stays the forensic record). Returns ``(app_id, path)``
+    pairs removed (or would-be removed under ``dry_run``)."""
+    if retention_days <= 0:
+        return []
+    now = now_ms if now_ms is not None else int(time.time() * 1000)
+    cutoff = now - int(retention_days * 86_400_000)
+    removed: list[tuple[str, str]] = []
+    for app_id in obs_artifacts.staged_ids(staging_root):
+        art = obs_artifacts.index(staging_root, app_id)
+        if not art.finalized:
+            continue  # live (or unfinalized): never GC'd
+        row = store.get_job(app_id)
+        if row is None:
+            continue  # un-ingested: the raw artifacts are the only record
+        if not row.get("completed_ms") or row["completed_ms"] >= cutoff:
+            continue
+        removed.append((app_id, art.staging_dir))
+        if not dry_run:
+            shutil.rmtree(art.staging_dir, ignore_errors=True)
+    return removed

@@ -1,0 +1,279 @@
+"""The ``tony history-server`` daemon: sweep thread + HTTP query API.
+
+Long-lived analog of the reference's dedicated history server (PAPER.md §0):
+watches one or more staging roots, ingests finalized jobs into the SQLite
+store on a fixed cadence (torn-file tolerant, idempotent), applies retention
+and the optional staging-dir GC, and serves a JSON query API:
+
+- ``GET /healthz``                    — liveness + store size + last sweep
+- ``GET /metrics``                    — its own Prometheus exposition
+- ``GET /api/jobs``                   — ingested job rows, newest first
+- ``GET /api/job/<app_id>``           — one row + summary + series names
+- ``GET /api/series/<app_id>/<m>``    — one distilled series
+- ``GET /api/trend/<metric>``         — cross-job trend points
+- ``GET /``                           — minimal HTML index (the portal's
+  ``/history`` pages are the real dashboards)
+
+Stdlib http.server, same rationale as the portal: an ops surface, not a
+control-plane dependency.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+from tony_tpu import constants
+from tony_tpu.histserver import ingest as _ingest
+from tony_tpu.histserver.store import HistoryStore
+from tony_tpu.obs import logging as obs_logging
+from tony_tpu.obs import metrics as obs_metrics
+
+_INGESTS = obs_metrics.counter(
+    "tony_history_ingests_total",
+    "sweep ingestion outcomes (ingested/unchanged/skipped/expired/errors/purged)",
+    labelnames=("outcome",))
+_SWEEP_SECONDS = obs_metrics.histogram(
+    "tony_history_sweep_seconds", "wall time of one ingestion sweep")
+_JOBS_GAUGE = obs_metrics.gauge(
+    "tony_history_jobs", "jobs currently in the history store")
+_GC_REMOVED = obs_metrics.counter(
+    "tony_history_gc_removed_total", "staging dirs removed by the GC sweep")
+
+
+def default_store_path(staging_root: str) -> str:
+    """Where the store lives when ``tony.history.store`` is unset: next to
+    the finished history tree."""
+    return os.path.join(staging_root, "history", "history.sqlite")
+
+
+class HistoryServer:
+    """Background sweep + HTTP API over one :class:`HistoryStore`."""
+
+    def __init__(
+        self,
+        staging_roots: list[str],
+        store_path: str | None = None,
+        port: int = 0,
+        scan_interval_s: float = 2.0,
+        retention_days: float = 0.0,
+        max_series_points: int = 512,
+        gc_enabled: bool = False,
+    ):
+        if not staging_roots:
+            raise ValueError("history server needs at least one staging root")
+        self.staging_roots = [r.rstrip("/") for r in staging_roots]
+        self.store = HistoryStore(
+            store_path or default_store_path(self.staging_roots[0]),
+            max_series_points=max_series_points)
+        self.scan_interval_s = scan_interval_s
+        self.retention_days = retention_days
+        self.gc_enabled = gc_enabled
+        self._stop = threading.Event()
+        self._last_sweep_ms = 0
+        self._sweeps = 0
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:  # quiet
+                pass
+
+            def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+                outer._handle(self)
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+
+        self._http = Server(("0.0.0.0", port), Handler)
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, name="history-sweep", daemon=True)
+        self._serve_thread = threading.Thread(
+            target=self._http.serve_forever, name="history-http", daemon=True)
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._http.server_address[:2]
+
+    def start(self) -> None:
+        self.sweep_once()  # a query right after start sees existing jobs
+        self._sweeper.start()
+        self._serve_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._http.shutdown()
+        self._http.server_close()
+        if self._sweeper.is_alive():
+            self._sweeper.join(timeout=10)
+        self.store.close()
+
+    def sweep_once(self) -> dict[str, int]:
+        t0 = time.perf_counter()
+        counts = _ingest.sweep(
+            self.store, self.staging_roots, retention_days=self.retention_days)
+        if self.gc_enabled and self.retention_days > 0:
+            for root in self.staging_roots:
+                removed = _ingest.gc_staging(self.store, root, self.retention_days)
+                if removed:
+                    _GC_REMOVED.inc(len(removed))
+        for outcome, n in counts.items():
+            if n:
+                _INGESTS.inc(n, outcome=outcome)
+        _SWEEP_SECONDS.observe(time.perf_counter() - t0)
+        _JOBS_GAUGE.set(self.store.count())
+        self._last_sweep_ms = int(time.time() * 1000)
+        self._sweeps += 1
+        return counts
+
+    def _sweep_loop(self) -> None:
+        while not self._stop.wait(self.scan_interval_s):
+            try:
+                self.sweep_once()
+            except Exception as e:  # noqa: BLE001 — the daemon must outlive one bad sweep
+                obs_logging.warning(f"[tony-history] sweep failed: {type(e).__name__}: {e}")
+
+    # ------------------------------------------------------------- handlers
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        path = urlparse(req.path).path.rstrip("/")
+        try:
+            if path == "/healthz":
+                self._json(req, {
+                    "ok": True,
+                    "jobs": self.store.count(),
+                    "sweeps": self._sweeps,
+                    "last_sweep_ms": self._last_sweep_ms,
+                    "staging_roots": self.staging_roots,
+                })
+            elif path == "/metrics":
+                body = obs_metrics.REGISTRY.render().encode()
+                self._raw(req, body, "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/api/jobs":
+                self._json(req, self.store.list_jobs())
+            elif path.startswith("/api/job/"):
+                app_id = path.split("/")[3]
+                job = self.store.get_job(app_id)
+                if job is None:
+                    self._json(req, {"error": f"{app_id} not ingested"}, status=404)
+                else:
+                    job["series"] = self.store.series_names(app_id)
+                    self._json(req, job)
+            elif path.startswith("/api/series/"):
+                parts = path.split("/")
+                app_id, metric = parts[3], parts[4]
+                self._json(req, self.store.series(app_id, metric))
+            elif path.startswith("/api/trend/"):
+                self._json(req, self.store.trend(path.split("/")[3]))
+            elif path == "":
+                self._raw(req, self._index_page(), "text/html")
+            else:
+                self._json(req, {"error": "not found"}, status=404)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001 — one bad request must not kill the daemon
+            try:
+                self._json(req, {"error": f"{type(e).__name__}: {e}"}, status=500)
+            except OSError:
+                pass
+
+    @staticmethod
+    def _raw(req: BaseHTTPRequestHandler, body: bytes, ctype: str, status: int = 200) -> None:
+        req.send_response(status)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    @classmethod
+    def _json(cls, req: BaseHTTPRequestHandler, obj, status: int = 200) -> None:
+        cls._raw(req, json.dumps(obj).encode(), "application/json", status=status)
+
+    def _index_page(self) -> bytes:
+        import html as _html
+
+        rows = "".join(
+            f"<tr><td><a href=\"/api/job/{_html.escape(j['app_id'])}\">"
+            f"{_html.escape(j['app_id'])}</a></td>"
+            f"<td>{_html.escape(j['status'])}{' (incomplete)' if j['incomplete'] else ''}</td>"
+            f"<td>{j['duration_ms'] / 1000.0:.1f}s</td><td>{j['gang_epochs']}</td>"
+            f"<td>{j['resizes']}</td><td>{j['takeovers']}</td></tr>"
+            for j in self.store.list_jobs(limit=200))
+        return (
+            "<!doctype html><html><head><title>tony history server</title></head>"
+            "<body><h1>tony history server</h1>"
+            f"<p>{self.store.count()} ingested job(s) · "
+            '<a href="/api/jobs">jobs json</a> · <a href="/healthz">healthz</a> · '
+            '<a href="/metrics">metrics</a></p>'
+            "<table border=1><tr><th>application</th><th>status</th><th>duration</th>"
+            "<th>epochs</th><th>resizes</th><th>takeovers</th></tr>"
+            + rows + "</table></body></html>").encode()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tony history-server",
+        description="persistent history daemon: ingest finalized jobs into a "
+                    "queryable store (docs/history.md)")
+    p.add_argument("--staging", action="append", default=[],
+                   help="staging root to watch (repeatable; default $TONY_ROOT)")
+    p.add_argument("--store", default=None,
+                   help="SQLite store path (tony.history.store; default "
+                        "<staging>/history/history.sqlite)")
+    p.add_argument("--port", type=int, default=None,
+                   help="HTTP port (tony.history.server.port)")
+    p.add_argument("--scan-interval-ms", type=int, default=None,
+                   help="sweep cadence (tony.history.scan-interval-ms)")
+    p.add_argument("--retention-days", type=float, default=None,
+                   help="drop store rows older than this (tony.history.retention-days; "
+                        "0 keeps forever)")
+    p.add_argument("--gc", action="store_true",
+                   help="also remove ingested jobs' raw staging dirs past "
+                        "retention (tony.history.gc.enabled)")
+    args = p.parse_args(argv)
+
+    # flags override tony-site.json which overrides defaults — the same
+    # layering the pool daemon applies
+    from tony_tpu.config import TonyConfig, keys
+
+    site = os.path.join(os.getcwd(), constants.TONY_SITE_CONF)
+    cfg = TonyConfig.from_layers(site_file=site if os.path.exists(site) else None)
+    roots = args.staging or [constants.default_tony_root()]
+    port = args.port if args.port is not None else cfg.get_int(keys.HISTORY_SERVER_PORT, 28081)
+    scan_ms = (args.scan_interval_ms if args.scan_interval_ms is not None
+               else cfg.get_time_ms(keys.HISTORY_SCAN_INTERVAL_MS, 2000))
+    retention = (args.retention_days if args.retention_days is not None
+                 else float(cfg.get(keys.HISTORY_RETENTION_DAYS) or 0))
+    server = HistoryServer(
+        staging_roots=roots,
+        store_path=args.store or cfg.get(keys.HISTORY_STORE) or None,
+        port=port,
+        scan_interval_s=scan_ms / 1000.0,
+        retention_days=retention,
+        max_series_points=cfg.get_int(keys.HISTORY_MAX_SERIES_POINTS, 512),
+        gc_enabled=args.gc or cfg.get_bool(keys.HISTORY_GC_ENABLED, False),
+    )
+    server.start()
+    host, bound = server.address
+    obs_logging.info(
+        f"[tony-history] serving {', '.join(roots)} on http://{host}:{bound} "
+        f"(store {server.store.path})")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

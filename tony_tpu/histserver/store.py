@@ -1,0 +1,235 @@
+"""SQLite-backed history store: one row per ingested job + distilled series.
+
+The persistence layer under the history server (docs/history.md). Schema:
+
+- ``jobs``: verdict, timings, gang counters (epochs / resizes / takeovers),
+  queue wait, an ``incomplete`` flag (torn/truncated ``.jhist``), the
+  distilled per-metric ``summary`` percentiles, and the job's frozen config
+  snapshot — everything ``tony history list|show|compare`` and the portal's
+  ``/history`` trend pages read.
+- ``series``: per-job time series (MFU, loss, tokens/s, queue depth, …)
+  distilled from ``METRICS_SNAPSHOT`` events, compacted to at most
+  ``max_series_points`` evenly-strided points per (job, metric) at write
+  time (``tony.history.max-series-points``).
+
+Writes are idempotent by construction: :meth:`HistoryStore.put_job` replaces
+the job row and its series in one transaction, so re-ingesting a job (the
+sweep after a restart, or ``tony history ingest`` run twice) converges
+instead of duplicating. Retention (``tony.history.retention-days``) is
+:meth:`purge_older_than` — the daemon applies it on its sweep cadence.
+
+SQLite is stdlib, single-file, and crash-safe under WAL — the right weight
+for a control-plane store that sees one write per finished job.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS jobs (
+  app_id TEXT PRIMARY KEY,
+  status TEXT NOT NULL,
+  user TEXT DEFAULT '',
+  started_ms INTEGER DEFAULT 0,
+  completed_ms INTEGER DEFAULT 0,
+  duration_ms INTEGER DEFAULT 0,
+  incomplete INTEGER DEFAULT 0,
+  tasks INTEGER DEFAULT 0,
+  gang_epochs INTEGER DEFAULT 0,
+  resizes INTEGER DEFAULT 0,
+  takeovers INTEGER DEFAULT 0,
+  queue_wait_s REAL DEFAULT 0.0,
+  staging_dir TEXT DEFAULT '',
+  source_path TEXT DEFAULT '',
+  source_mtime_ns INTEGER DEFAULT 0,
+  ingested_ms INTEGER DEFAULT 0,
+  summary TEXT DEFAULT '{}',
+  config TEXT DEFAULT '{}'
+);
+CREATE TABLE IF NOT EXISTS series (
+  app_id TEXT NOT NULL,
+  metric TEXT NOT NULL,
+  seq INTEGER NOT NULL,
+  ts_ms INTEGER DEFAULT 0,
+  value REAL NOT NULL,
+  PRIMARY KEY (app_id, metric, seq)
+);
+CREATE INDEX IF NOT EXISTS series_by_metric ON series (metric, app_id);
+"""
+
+#: jobs columns callers may pass into put_job (summary/config are JSON'd)
+_JOB_FIELDS = (
+    "app_id", "status", "user", "started_ms", "completed_ms", "duration_ms",
+    "incomplete", "tasks", "gang_epochs", "resizes", "takeovers",
+    "queue_wait_s", "staging_dir", "source_path", "source_mtime_ns",
+)
+
+
+def compact_series(points: list[tuple[int, float]], max_points: int) -> list[tuple[int, float]]:
+    """Downsample to at most ``max_points`` by even striding, always keeping
+    the first and last point (trend endpoints are what cross-job charts
+    anchor on). ``max_points`` < 2 disables compaction."""
+    if max_points < 2 or len(points) <= max_points:
+        return points
+    step = (len(points) - 1) / (max_points - 1)
+    picked = [points[round(i * step)] for i in range(max_points - 1)]
+    picked.append(points[-1])
+    return picked
+
+
+class HistoryStore:
+    """Thread-safe wrapper around one SQLite database file (or ':memory:')."""
+
+    def __init__(self, path: str, max_series_points: int = 512):
+        self.path = path
+        self.max_series_points = max_series_points
+        parent = os.path.dirname(path)
+        if parent and path != ":memory:":
+            os.makedirs(parent, exist_ok=True)
+        # one connection, serialized by our lock: the store sees one write
+        # per finished job and low-rate reads — simplicity over pooling
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.row_factory = sqlite3.Row
+        self._lock = threading.Lock()
+        with self._lock:
+            if path != ":memory:":
+                self._db.execute("PRAGMA journal_mode=WAL")
+            self._db.executescript(_SCHEMA)
+            self._db.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+    # ------------------------------------------------------------- writes
+    def put_job(
+        self,
+        job: dict[str, Any],
+        series: dict[str, list[tuple[int, float]]] | None = None,
+        summary: dict[str, Any] | None = None,
+        config: dict[str, Any] | None = None,
+    ) -> None:
+        """Insert or REPLACE one job and its series atomically (idempotent
+        re-ingest: running this twice for the same app converges)."""
+        # absent fields are omitted so the column DEFAULTs apply (an explicit
+        # None would insert NULL over them)
+        row = {f: job[f] for f in _JOB_FIELDS if job.get(f) is not None}
+        if not row.get("app_id") or not row.get("status"):
+            raise ValueError("put_job requires app_id and status")
+        row["incomplete"] = int(bool(row.get("incomplete")))
+        row["ingested_ms"] = int(time.time() * 1000)
+        row["summary"] = json.dumps(summary or {}, sort_keys=True)
+        row["config"] = json.dumps(config or {}, sort_keys=True)
+        cols = ", ".join(row)
+        qs = ", ".join("?" for _ in row)
+        with self._lock:
+            try:
+                self._db.execute(
+                    f"INSERT OR REPLACE INTO jobs ({cols}) VALUES ({qs})",
+                    tuple(row.values()))
+                self._db.execute("DELETE FROM series WHERE app_id = ?", (row["app_id"],))
+                for metric, points in (series or {}).items():
+                    pts = compact_series(points, self.max_series_points)
+                    self._db.executemany(
+                        "INSERT OR REPLACE INTO series (app_id, metric, seq, ts_ms, value) "
+                        "VALUES (?, ?, ?, ?, ?)",
+                        [(row["app_id"], metric, i, int(ts), float(v))
+                         for i, (ts, v) in enumerate(pts)])
+                self._db.commit()
+            except Exception:
+                self._db.rollback()
+                raise
+
+    def purge_older_than(self, cutoff_ms: int) -> list[str]:
+        """Drop jobs (and their series) completed before ``cutoff_ms``;
+        returns the purged app ids (retention enforcement)."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT app_id FROM jobs WHERE completed_ms > 0 AND completed_ms < ?",
+                (cutoff_ms,)).fetchall()
+            ids = [r["app_id"] for r in rows]
+            if ids:
+                qs = ",".join("?" for _ in ids)
+                self._db.execute(f"DELETE FROM series WHERE app_id IN ({qs})", ids)
+                self._db.execute(f"DELETE FROM jobs WHERE app_id IN ({qs})", ids)
+                self._db.commit()
+            return ids
+
+    # -------------------------------------------------------------- reads
+    @staticmethod
+    def _job_dict(row: sqlite3.Row) -> dict[str, Any]:
+        d = dict(row)
+        for k in ("summary", "config"):
+            try:
+                d[k] = json.loads(d.get(k) or "{}")
+            except ValueError:
+                d[k] = {}
+        d["incomplete"] = bool(d.get("incomplete"))
+        return d
+
+    def get_job(self, app_id: str) -> dict[str, Any] | None:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT * FROM jobs WHERE app_id = ?", (app_id,)).fetchone()
+        return self._job_dict(row) if row else None
+
+    def list_jobs(self, limit: int = 0) -> list[dict[str, Any]]:
+        """All jobs, newest completion first."""
+        q = "SELECT * FROM jobs ORDER BY completed_ms DESC, app_id DESC"
+        if limit:
+            q += f" LIMIT {int(limit)}"
+        with self._lock:
+            rows = self._db.execute(q).fetchall()
+        return [self._job_dict(r) for r in rows]
+
+    def count(self) -> int:
+        with self._lock:
+            return int(self._db.execute("SELECT COUNT(*) FROM jobs").fetchone()[0])
+
+    def source_mtime_ns(self, app_id: str) -> int | None:
+        """The ingested source file's mtime, for sweep change detection."""
+        with self._lock:
+            row = self._db.execute(
+                "SELECT source_mtime_ns FROM jobs WHERE app_id = ?", (app_id,)).fetchone()
+        return int(row[0]) if row else None
+
+    def series(self, app_id: str, metric: str) -> list[tuple[int, float]]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT ts_ms, value FROM series WHERE app_id = ? AND metric = ? "
+                "ORDER BY seq", (app_id, metric)).fetchall()
+        return [(int(r["ts_ms"]), float(r["value"])) for r in rows]
+
+    def series_names(self, app_id: str) -> list[str]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT DISTINCT metric FROM series WHERE app_id = ? ORDER BY metric",
+                (app_id,)).fetchall()
+        return [r["metric"] for r in rows]
+
+    def trend(self, metric: str, stat: str = "p50") -> list[dict[str, Any]]:
+        """Cross-job trend: one ``{app_id, completed_ms, value}`` point per
+        job that distilled ``metric``, oldest completion first — the
+        portal's runs-over-time charts. ``stat`` picks the summary
+        percentile (``p50``/``p90``/``last``/``max``…); job-level counters
+        (``gang_epochs``/``resizes``/``takeovers``/``queue_wait_s``/
+        ``duration_ms``) come straight off the row."""
+        out: list[dict[str, Any]] = []
+        for job in sorted(self.list_jobs(), key=lambda j: (j["completed_ms"], j["app_id"])):
+            if metric in ("gang_epochs", "resizes", "takeovers",
+                          "queue_wait_s", "duration_ms"):
+                value: Any = job.get(metric)
+            else:
+                value = (job.get("summary", {}).get(metric) or {}).get(stat)
+            if value is None:
+                continue
+            out.append({"app_id": job["app_id"],
+                        "completed_ms": job["completed_ms"],
+                        "value": float(value)})
+        return out

@@ -1,0 +1,327 @@
+"""The one artifact index: where every per-job on-disk artifact lives.
+
+Analog of the reference history server's ``HistoryFileUtils`` path logic
+grown into a shared index (SURVEY.md §2.1): given a staging root and an
+application id, this module — and only this module — knows where the job's
+``.jhist`` (intermediate or finished), frozen config, ``am_info.json`` /
+``am_status.json``, structured-log JSONL aggregate, span JSONL trace dir,
+profiler captures, and train-metrics drops live, and whether the job has
+finalized. Portal scrape, ``tony trace``, ``tony logs``/``tony top``, and
+the history server's ingestion all resolve artifacts through it; a consumer
+re-implementing its own discovery walk is a regression (asserted by a
+grep-style test in tests/test_history_server.py).
+
+Per-job overrides (``tony.history.location``, ``tony.log.dir``,
+``tony.trace.dir``) come from the job's frozen config snapshot, so readers
+never disagree with the writers that honored the same keys.
+
+``read_history_events`` applies the journal reader discipline
+(cluster/journal.py) to ``.jhist`` files: a job killed mid-write can only
+tear the tail of an append-only JSONL stream, so the intact prefix is
+returned and the torn/truncated state is reported as ``complete=False``
+instead of raising — the history server ingests such jobs as ``incomplete``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any
+
+from tony_tpu import constants
+from tony_tpu.cluster import history
+from tony_tpu.cluster.events import Event
+
+if TYPE_CHECKING:
+    from tony_tpu.cluster.rpc import RpcClient
+
+
+@dataclass
+class JobArtifacts:
+    """Every artifact location for one application, resolved once."""
+
+    app_id: str
+    staging_root: str
+    staging_dir: str            # <staging_root>/<app_id>
+    history_root: str           # tony.history.location or <staging_root>/history
+    frozen_config_path: str     # <staging_dir>/tony-final.json (client-written)
+    am_info_path: str           # live AM advertisement (host/port/secret)
+    am_status_path: str         # final verdict (written once, atomically)
+    log_dir: str                # structured-log JSONL aggregate (tony.log.dir override)
+    trace_dir: str              # span JSONL sink (tony.trace.dir override)
+    profile_dir: str            # jax.profiler captures (static + on-demand)
+    metrics_dir: str            # executor train-metrics drops (+ .obs snapshots)
+    jhist_path: str | None      # finished .jhist if finalized, else intermediate, else None
+    finalized: bool             # a finished .jhist exists for this app
+    history_file: "history.HistoryFileName | None"  # parsed finished-filename fields
+    config_snapshot_path: str | None  # finished-dir config.json (finalized only)
+
+    # -- live/terminal state -------------------------------------------------
+
+    def am_status(self) -> dict[str, Any] | None:
+        """The final ``am_status.json`` verdict, or None (job still running
+        or never started)."""
+        try:
+            with open(self.am_status_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def am_client(self, timeout_s: float = 5.0) -> "RpcClient | None":
+        """RpcClient for the job's live AM from its ``am_info.json``
+        advertisement, or None (no AM / unreadable advertisement). A
+        work-preserving takeover republishes the file with a fresh
+        port+secret — callers re-resolving through this method reach the
+        adopting AM."""
+        try:
+            with open(self.am_info_path) as f:
+                info = json.load(f)
+            from tony_tpu.cluster.rpc import RpcClient
+
+            return RpcClient(info["host"], info["port"],
+                             secret=info.get("secret", ""), timeout_s=timeout_s)
+        except (OSError, ValueError, KeyError):
+            return None
+
+    # -- event stream --------------------------------------------------------
+
+    def read_events(self) -> tuple[list[Event], bool]:
+        """The job's ``.jhist`` event stream with torn-file tolerance:
+        ``(events, complete)`` where ``complete`` is False when the file is
+        missing, truncated, or torn (see :func:`read_history_events`)."""
+        if self.jhist_path is None:
+            return [], False
+        return read_history_events(self.jhist_path)
+
+    # -- profiler artifacts --------------------------------------------------
+
+    def profile_listing(self) -> list[dict[str, Any]]:
+        """Profiler artifacts flattened to ``{path (relative), size}``
+        entries — both the submit-time window's and on-demand captures'."""
+        out: list[dict[str, Any]] = []
+        for dirpath, _, files in os.walk(self.profile_dir):
+            for fn in sorted(files):
+                full = os.path.join(dirpath, fn)
+                try:
+                    size = os.path.getsize(full)
+                except OSError:
+                    continue
+                out.append({"path": os.path.relpath(full, self.profile_dir), "size": size})
+        out.sort(key=lambda e: e["path"])
+        return out
+
+
+def _frozen_config(staging_dir: str):
+    """The job's frozen config, or None (not submitted through the client,
+    or the snapshot is unreadable)."""
+    path = os.path.join(staging_dir, constants.TONY_FINAL_CONF)
+    try:
+        from tony_tpu.config import TonyConfig
+
+        return TonyConfig.load_final(path)
+    except (OSError, ValueError):
+        return None
+
+
+def _find_finished(history_root: str, app_id: str) -> tuple[str, "history.HistoryFileName"] | None:
+    """The finished ``.jhist`` (path, parsed filename) for one app, or None.
+
+    Walks only ``finished/`` subtrees whose leaf directory is the app id —
+    the yyyy/MM/dd layout means one terminal directory per app. Bulk
+    consumers (the ingestion sweep) should walk once via
+    :func:`finished_index` and pass entries through ``index(...,
+    finished=...)`` instead of paying this walk per job.
+    """
+    root = os.path.join(history_root, constants.HISTORY_FINISHED_DIR)
+    for dirpath, dirnames, filenames in os.walk(root):
+        if os.path.basename(dirpath) != app_id:
+            continue
+        dirnames.clear()  # app dirs are leaves
+        for fn in filenames:
+            if fn.endswith(constants.HISTORY_SUFFIX):
+                try:
+                    return os.path.join(dirpath, fn), history.HistoryFileName.parse(fn)
+                except ValueError:
+                    continue
+    return None
+
+
+def finished_index(history_root: str) -> dict[str, tuple[str, "history.HistoryFileName"]]:
+    """One walk of ``finished/`` → ``app_id → (jhist_path, parsed name)``.
+
+    The sweep-side complement of :func:`_find_finished`: resolving N jobs
+    against a shared history tree costs one tree walk, not N.
+    """
+    out: dict[str, tuple[str, "history.HistoryFileName"]] = {}
+    root = os.path.join(history_root, constants.HISTORY_FINISHED_DIR)
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            if fn.endswith(constants.HISTORY_SUFFIX):
+                try:
+                    parsed = history.HistoryFileName.parse(fn)
+                except ValueError:
+                    continue
+                out[parsed.app_id] = (os.path.join(dirpath, fn), parsed)
+    return out
+
+
+def index(
+    staging_root: str,
+    app_id: str,
+    history_root: str | None = None,
+    finished: tuple[str, "history.HistoryFileName"] | None = None,
+) -> JobArtifacts:
+    """Resolve every artifact location for ``app_id`` under ``staging_root``.
+
+    ``history_root`` overrides the resolution (a portal serving one history
+    tree for many staging roots); by default it comes from the job's frozen
+    config (``tony.history.location``) with the AM's fallback of
+    ``<staging_root>/history``. ``finished`` short-circuits the finished-
+    tree lookup with a :func:`finished_index` entry (bulk callers).
+    """
+    staging_root = staging_root.rstrip("/") if staging_root else staging_root
+    staging_dir = os.path.join(staging_root, app_id)
+    cfg = _frozen_config(staging_dir)
+
+    log_dir = os.path.join(staging_dir, constants.TASK_LOG_DIRNAME)
+    trace_dir = os.path.join(staging_dir, "trace")
+    resolved_history = history_root
+    if cfg is not None:
+        from tony_tpu.config import keys
+
+        log_dir = cfg.get(keys.LOG_DIR) or log_dir
+        trace_dir = cfg.get(keys.TRACE_DIR) or trace_dir
+        if resolved_history is None:
+            resolved_history = cfg.get(keys.HISTORY_LOCATION) or None
+    if resolved_history is None:
+        resolved_history = os.path.join(staging_root, "history")
+
+    if finished is None:
+        finished = _find_finished(resolved_history, app_id)
+    if finished is not None:
+        jhist_path: str | None = finished[0]
+        hist_file: "history.HistoryFileName | None" = finished[1]
+        config_snapshot: str | None = os.path.join(
+            os.path.dirname(finished[0]), constants.CONFIG_SNAPSHOT_FILE)
+        finalized = True
+    else:
+        hist_file, config_snapshot, finalized = None, None, False
+        inter = os.path.join(resolved_history, constants.HISTORY_INTERMEDIATE_DIR,
+                             app_id + constants.HISTORY_SUFFIX)
+        jhist_path = inter if os.path.exists(inter) else None
+
+    return JobArtifacts(
+        app_id=app_id,
+        staging_root=staging_root,
+        staging_dir=staging_dir,
+        history_root=resolved_history,
+        frozen_config_path=os.path.join(staging_dir, constants.TONY_FINAL_CONF),
+        am_info_path=os.path.join(staging_dir, constants.AM_INFO_FILE),
+        am_status_path=os.path.join(staging_dir, "am_status.json"),
+        log_dir=log_dir,
+        trace_dir=trace_dir,
+        profile_dir=os.path.join(staging_dir, "profile"),
+        metrics_dir=os.path.join(staging_dir, "metrics"),
+        jhist_path=jhist_path,
+        finalized=finalized,
+        history_file=hist_file,
+        config_snapshot_path=config_snapshot,
+    )
+
+
+# ---------------------------------------------------------------- listings
+def running_ids(history_root: str) -> list[str]:
+    """Applications with an intermediate ``.jhist`` (the AM streams events
+    there until finalization) — the portal's RUNNING list."""
+    d = os.path.join(history_root, constants.HISTORY_INTERMEDIATE_DIR)
+    if not os.path.isdir(d):
+        return []
+    suf = constants.HISTORY_SUFFIX
+    return sorted(f[: -len(suf)] for f in os.listdir(d) if f.endswith(suf))
+
+
+def finished_jobs(history_root: str) -> list["history.HistoryFileName"]:
+    """Finished jobs under ``history_root``, newest first (codec in
+    cluster/history.py)."""
+    return history.list_finished_jobs(history_root)
+
+
+def staged_ids(staging_root: str) -> list[str]:
+    """Application ids with a staging directory under ``staging_root`` —
+    the ingestion sweep's discovery surface (jobs whose staging dir was
+    already GC'd are found through :func:`finished_jobs` instead)."""
+    try:
+        entries = os.listdir(staging_root)
+    except OSError:
+        return []
+    out = []
+    for name in sorted(entries):
+        d = os.path.join(staging_root, name)
+        if not os.path.isdir(d):
+            continue
+        # a staging dir is recognizable by the client/AM artifacts in it
+        if (os.path.exists(os.path.join(d, constants.TONY_FINAL_CONF))
+                or os.path.exists(os.path.join(d, constants.AM_INFO_FILE))
+                or os.path.exists(os.path.join(d, "am_status.json"))):
+            out.append(name)
+    return out
+
+
+# ---------------------------------------------------------- event reading
+def read_history_events(path: str) -> tuple[list[Event], bool]:
+    """Every intact event from a ``.jhist``, plus a completeness verdict.
+
+    Journal-reader discipline (cluster/journal.py): the writer appends
+    sequentially, so a SIGKILL mid-write can only tear the FINAL line — an
+    unparseable or truncated tail is dropped and reported as incomplete, not
+    raised. Garbage anywhere before the tail would mean the file was
+    corrupted some other way; the intact PREFIX is still returned (history
+    is forensics — partial evidence beats none) with ``complete=False``.
+    ``complete`` also requires a terminal ``APPLICATION_FINISHED`` event:
+    a job killed between steps never tore a line, yet its history is still
+    missing its verdict.
+    """
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            lines = f.read().split("\n")
+    except OSError:
+        return [], False
+    events: list[Event] = []
+    torn = False
+    for line in lines:
+        if not line.strip():
+            continue
+        try:
+            ev = Event.from_json(line)
+        except (ValueError, AttributeError, TypeError):
+            torn = True
+            break  # keep the intact prefix; everything after is suspect
+        events.append(ev)
+    finished = any(e.type.value == "APPLICATION_FINISHED" for e in events)
+    return events, (not torn) and finished
+
+
+def load_spans(trace_dir: str) -> list[dict[str, Any]]:
+    """All spans from every ``*.spans.jsonl`` under ``trace_dir``, sorted by
+    start time. Malformed lines (a process killed mid-write) are skipped —
+    the span-file analog of :func:`read_history_events`'s tolerance."""
+    spans: list[dict[str, Any]] = []
+    if not os.path.isdir(trace_dir):
+        return spans
+    for fn in sorted(os.listdir(trace_dir)):
+        if not fn.endswith(".spans.jsonl"):
+            continue
+        with open(os.path.join(trace_dir, fn), errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(d, dict) and "span_id" in d and "start_ms" in d:
+                    spans.append(d)
+    spans.sort(key=lambda s: s.get("start_ms", 0.0))
+    return spans

@@ -31,8 +31,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse
 
 from tony_tpu import constants
-from tony_tpu.cluster import history
 from tony_tpu.cluster.events import Event
+from tony_tpu.obs import artifacts as obs_artifacts
 from tony_tpu.obs import introspect as obs_introspect
 from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
@@ -60,7 +60,8 @@ def _page(title: str, body: str) -> bytes:
     return (
         f"<!doctype html><html><head><title>{html.escape(title)}</title>"
         f"<style>{_STYLE}</style></head><body><h1>{html.escape(title)}</h1>"
-        f'<p><a href="/">← jobs</a> · <a href="/pool">pool</a> · '
+        f'<p><a href="/">← jobs</a> · <a href="/history">history</a> · '
+        f'<a href="/pool">pool</a> · '
         f'<a href="/metrics">metrics</a></p>{body}</body></html>'
     ).encode()
 
@@ -90,10 +91,16 @@ def _sparkline(values: list[float], label: str, w: int = 220, h: int = 48) -> st
     )
 
 
+def _hist_cell(job: dict, metric: str, stat: str = "p50") -> str:
+    v = ((job.get("summary") or {}).get(metric) or {}).get(stat)
+    return "-" if v is None else f"{v:.4g}"
+
+
 class PortalHandler(BaseHTTPRequestHandler):
     history_root = ""
     staging_root = ""       # where <app_id>/am_info.json lives (TONY_ROOT)
     pool_addr = ""          # "host:port" of a pool service, optional
+    history_db = ""         # history-server store; "" → <history_root>/history.sqlite
 
     def log_message(self, *args) -> None:  # quiet
         pass
@@ -119,6 +126,22 @@ class PortalHandler(BaseHTTPRequestHandler):
                 )
             elif path == "/pool":
                 self._send(self._pool_page())
+            elif path == "/history":
+                self._send(self._history_index())
+            elif path.startswith("/history/"):
+                self._send(self._history_job(path.split("/")[2]))
+            elif path == "/api/history/jobs":
+                store = self._store()
+                jobs = store.list_jobs() if store else []
+                if store:
+                    store.close()
+                self._send(json.dumps(jobs).encode(), ctype="application/json")
+            elif path.startswith("/api/history/trend/"):
+                store = self._store()
+                trend = store.trend(path.split("/")[4]) if store else []
+                if store:
+                    store.close()
+                self._send(json.dumps(trend).encode(), ctype="application/json")
             elif path.startswith("/job/"):
                 parts = path.split("/")
                 app_id = parts[2]
@@ -143,7 +166,7 @@ class PortalHandler(BaseHTTPRequestHandler):
                     ctype="application/json",
                 )
             elif path == "/api/jobs":
-                jobs = [vars(j) for j in history.list_finished_jobs(self.history_root)]
+                jobs = [vars(j) for j in obs_artifacts.finished_jobs(self.history_root)]
                 jobs += [
                     {"app_id": a, "status": "RUNNING"} for a in self._running_ids()
                 ]
@@ -160,28 +183,19 @@ class PortalHandler(BaseHTTPRequestHandler):
 
     # -- data helpers -------------------------------------------------------
 
+    def _art(self, app_id: str) -> obs_artifacts.JobArtifacts:
+        """The job's artifact index, pinned to this portal's history tree."""
+        return obs_artifacts.index(
+            self.staging_root, app_id, history_root=self.history_root)
+
     def _running_ids(self) -> list[str]:
-        d = os.path.join(self.history_root, constants.HISTORY_INTERMEDIATE_DIR)
-        if not os.path.isdir(d):
-            return []
-        suf = constants.HISTORY_SUFFIX
-        return sorted(
-            f[: -len(suf)] for f in os.listdir(d) if f.endswith(suf)
-        )
+        return obs_artifacts.running_ids(self.history_root)
 
     def _am_client(self, app_id: str):
         """RpcClient for a running job's AM, or None (best-effort)."""
         if not self.staging_root:
             return None
-        info_path = os.path.join(self.staging_root, app_id, constants.AM_INFO_FILE)
-        try:
-            with open(info_path) as f:
-                info = json.load(f)
-            from tony_tpu.cluster.rpc import RpcClient
-
-            return RpcClient(info["host"], info["port"], info.get("secret", ""), timeout_s=2.0)
-        except (OSError, ValueError, KeyError):
-            return None
+        return self._art(app_id).am_client(timeout_s=2.0)
 
     def _am_call(self, app_id: str, *methods: str) -> list | None:
         """Call the app's AM, re-resolving a MOVED endpoint once: a
@@ -253,30 +267,116 @@ class PortalHandler(BaseHTTPRequestHandler):
         stall the single-threaded portal on every page hit."""
         if not self.staging_root:
             return []
-        return obs_logging.tail_records(
-            obs_logging.resolve_log_dir(self.staging_root, app_id), limit=500
-        )
+        return obs_logging.tail_records(self._art(app_id).log_dir, limit=500)
 
     def _profile_listing(self, app_id: str) -> list[dict]:
-        """Profiler artifacts under <staging>/<app_id>/profile, flattened to
-        {path (relative), size} entries — both the submit-time window's and
-        on-demand captures'."""
+        """Profiler artifacts flattened to {path (relative), size} entries —
+        both the submit-time window's and on-demand captures'."""
         if not self.staging_root:
             return []
-        root = os.path.join(self.staging_root, app_id, "profile")
-        out = []
-        for dirpath, _, files in os.walk(root):
-            for fn in sorted(files):
-                full = os.path.join(dirpath, fn)
-                try:
-                    size = os.path.getsize(full)
-                except OSError:
-                    continue
-                out.append({"path": os.path.relpath(full, root), "size": size})
-        out.sort(key=lambda e: e["path"])
-        return out
+        return self._art(app_id).profile_listing()
+
+    def _store(self):
+        """The history-server store behind the /history pages, or None (no
+        store yet — run `tony history ingest` or the daemon). Opened per
+        request: SQLite reads are cheap and this keeps the handler
+        thread-safe without a shared connection."""
+        path = self.history_db or os.path.join(self.history_root, "history.sqlite")
+        if not os.path.exists(path):
+            return None
+        from tony_tpu.histserver.store import HistoryStore
+
+        return HistoryStore(path)
 
     # -- pages --------------------------------------------------------------
+
+    #: cross-job trend charts on /history: (label, trend metric)
+    _TRENDS = (
+        ("mfu (p50)", "mfu"),
+        ("step_time_ms (p50)", "step_time_ms"),
+        ("tokens_per_sec (p50)", "tokens_per_sec"),
+        ("queue_wait_s", "queue_wait_s"),
+        ("gang_epochs", "gang_epochs"),
+        ("resizes", "resizes"),
+        ("takeovers", "takeovers"),
+    )
+
+    def _history_index(self) -> bytes:
+        store = self._store()
+        if store is None:
+            return _page("history", "<p>no history store — run <code>tony "
+                         "history ingest</code> or <code>tony history-server"
+                         "</code> against this staging root</p>")
+        try:
+            jobs = store.list_jobs()
+            charts = "".join(
+                _sparkline([p["value"] for p in store.trend(metric)], label)
+                for label, metric in self._TRENDS
+            )
+            rows = "".join(
+                f'<tr><td><a href="/history/{html.escape(j["app_id"])}">'
+                f'{html.escape(j["app_id"])}</a></td>'
+                f'<td class="{html.escape(j["status"])}">{html.escape(j["status"])}'
+                f'{" (incomplete)" if j["incomplete"] else ""}</td>'
+                f'<td>{j["duration_ms"] / 1000.0:.1f}s</td>'
+                f'<td>{_hist_cell(j, "mfu")}</td>'
+                f'<td>{_hist_cell(j, "step_time_ms")}</td>'
+                f'<td>{j["queue_wait_s"]:.1f}s</td>'
+                f'<td>{j["gang_epochs"]}</td><td>{j["resizes"]}</td>'
+                f'<td>{j["takeovers"]}</td></tr>'
+                for j in jobs
+            )
+            body = (
+                f"<p>{len(jobs)} ingested job(s) "
+                '(<a href="/api/history/jobs">json</a>)</p>'
+                + (f"<h2>trends across runs</h2><p>{charts}</p>" if charts else "")
+                + "<h2>ingested jobs</h2>"
+                "<table><tr><th>application</th><th>status</th><th>duration</th>"
+                "<th>mfu p50</th><th>step ms p50</th><th>queue wait</th>"
+                f"<th>epochs</th><th>resizes</th><th>takeovers</th></tr>{rows}</table>"
+            )
+            return _page("job history", body)
+        finally:
+            store.close()
+
+    def _history_job(self, app_id: str) -> bytes:
+        store = self._store()
+        if store is None:
+            return _page(f"{app_id} history", "<p>no history store</p>")
+        try:
+            job = store.get_job(app_id)
+            if job is None:
+                return _page(f"{app_id} history",
+                             f"<p>{html.escape(app_id)} is not ingested "
+                             "(still running, or the sweep has not seen it)</p>")
+            summary = job.get("summary") or {}
+            srows = "".join(
+                f"<tr><td>{html.escape(metric)}</td>"
+                + "".join(f"<td>{stats.get(k, float('nan')):.4g}</td>"
+                          for k in ("p50", "p90", "p99", "min", "max", "last"))
+                + "</tr>"
+                for metric, stats in sorted(summary.items())
+                if isinstance(stats, dict) and "p50" in stats
+            )
+            charts = "".join(
+                _sparkline([v for _, v in store.series(app_id, m)], m)
+                for m in store.series_names(app_id)
+            )
+            body = (
+                f'<p><a href="/job/{html.escape(app_id)}">event timeline</a> · '
+                f'{html.escape(job["status"])}'
+                f'{" (incomplete ingest: torn/truncated .jhist)" if job["incomplete"] else ""}'
+                f' · {job["duration_ms"] / 1000.0:.1f}s · {job["tasks"]} task(s)'
+                f' · epochs {job["gang_epochs"]} · resizes {job["resizes"]}'
+                f' · takeovers {job["takeovers"]}</p>'
+                + (f"<h2>series</h2><p>{charts}</p>" if charts else "")
+                + ("<h2>summary</h2><table><tr><th>metric</th><th>p50</th><th>p90</th>"
+                   f"<th>p99</th><th>min</th><th>max</th><th>last</th></tr>{srows}</table>"
+                   if srows else "")
+            )
+            return _page(f"{app_id} history", body)
+        finally:
+            store.close()
 
     def _job_logs(self, app_id: str) -> bytes:
         records = self._log_records(app_id)
@@ -322,7 +422,7 @@ class PortalHandler(BaseHTTPRequestHandler):
                 + rows + "</table>"
             )
         rows = []
-        for j in history.list_finished_jobs(self.history_root):
+        for j in obs_artifacts.finished_jobs(self.history_root):
             dur = max(j.completed_ms - j.started_ms, 0) / 1000
             rows.append(
                 f'<tr><td><a href="/job/{j.app_id}">{html.escape(j.app_id)}</a></td>'
@@ -396,10 +496,9 @@ class PortalHandler(BaseHTTPRequestHandler):
         )
 
     def _job_detail(self, app_id: str) -> bytes:
-        live = app_id not in {
-            j.app_id for j in history.list_finished_jobs(self.history_root)
-        }
-        evs = history.read_events(self.history_root, app_id)  # falls back to intermediate
+        art = self._art(app_id)
+        live = not art.finalized
+        evs, _complete = art.read_events()  # falls back to intermediate
         if not evs:
             return _page(app_id, "<p>no events found</p>")
         tasks_html = self._live_table(app_id) if live else ""
@@ -427,6 +526,9 @@ class PortalHandler(BaseHTTPRequestHandler):
             f'<p><a href="/job/{app_id}/config">frozen config</a>'
             f' · <a href="/job/{app_id}/logs">logs</a>'
             f' · <a href="/job/{app_id}/profile">profile artifacts</a>'
+            # a finalized job's story continues in the history store — link
+            # the entry instead of leaving a dead-AM scrape as the only view
+            + (f' · <a href="/history/{app_id}">history entry</a>' if not live else "")
             + (" · <b>LIVE</b>" if live else "")
             + "</p>"
             + tasks_html
@@ -481,25 +583,22 @@ class PortalHandler(BaseHTTPRequestHandler):
         return _page(f"pool {self.pool_addr}", body)
 
     def _job_config(self, app_id: str) -> bytes:
-        for j in history.list_finished_jobs(self.history_root):
-            if j.app_id == app_id:
-                path = os.path.join(
-                    history.finished_dir(self.history_root, app_id, j.completed_ms),
-                    constants.CONFIG_SNAPSHOT_FILE,
-                )
-                if os.path.exists(path):
-                    cfg = json.load(open(path))
-                    body = "<pre>" + html.escape(json.dumps(cfg, indent=1, sort_keys=True)) + "</pre>"
-                    return _page(f"{app_id} config", body)
+        path = self._art(app_id).config_snapshot_path
+        if path and os.path.exists(path):
+            cfg = json.load(open(path))
+            body = "<pre>" + html.escape(json.dumps(cfg, indent=1, sort_keys=True)) + "</pre>"
+            return _page(f"{app_id} config", body)
         return _page(app_id, "<p>no config snapshot</p>")
 
 
 def serve(
-    history_root: str, port: int = 28080, staging_root: str = "", pool: str = ""
+    history_root: str, port: int = 28080, staging_root: str = "", pool: str = "",
+    history_db: str = "",
 ) -> ThreadingHTTPServer:
     handler = type(
         "Handler", (PortalHandler,),
-        {"history_root": history_root, "staging_root": staging_root, "pool_addr": pool},
+        {"history_root": history_root, "staging_root": staging_root,
+         "pool_addr": pool, "history_db": history_db},
     )
     server = ThreadingHTTPServer(("0.0.0.0", port), handler)
     return server
@@ -512,11 +611,14 @@ def main(argv: list[str] | None = None) -> int:
                    help="staging root holding <app_id>/am_info.json for the "
                         "live view (default: parent of --root)")
     p.add_argument("--pool", default="", help="pool service host:port for /pool")
+    p.add_argument("--history-db", default="",
+                   help="history-server store behind /history "
+                        "(tony.history.store; default <root>/history.sqlite)")
     p.add_argument("--port", type=int, default=28080)
     args = p.parse_args(argv)
     root = args.root or os.path.join(constants.default_tony_root(), "history")
     staging = args.staging or os.path.dirname(root.rstrip("/"))
-    server = serve(root, args.port, staging, args.pool)
+    server = serve(root, args.port, staging, args.pool, history_db=args.history_db)
     obs_logging.info(f"[tony-portal] serving {root} on http://0.0.0.0:{args.port}"
                      + (f" (pool {args.pool})" if args.pool else ""))
     try:
