@@ -1,0 +1,20 @@
+"""metrics-discipline fixtures: unprefixed + undocumented instruments."""
+
+from tony_tpu.obs import metrics as obs_metrics
+
+_OK = obs_metrics.counter(
+    "tony_rpc_client_errors_total", "documented name — not a finding")
+
+_BAD_PREFIX = obs_metrics.counter(
+    "rpc_errors_total", "missing the tony_ prefix")
+
+_UNDOCUMENTED = obs_metrics.gauge(
+    "tony_fixture_only_gauge", "prefixed but absent from the docs table")
+
+_SUPPRESSED = obs_metrics.histogram(  # lint: disable=metrics-discipline — fixture scratch
+    "scratch_latency_seconds", "deliberately off-registry")
+
+
+def dynamic(name):
+    # dynamic names cannot be checked statically — not a finding
+    return obs_metrics.counter(name, "runtime-chosen")

@@ -156,6 +156,27 @@ def test_print_discipline_library_is_clean():
     assert findings == [], [f"{f.path}:{f.line}" for f in findings]
 
 
+# --------------------------------------------------------- metrics-discipline
+def test_metrics_discipline_flags_prefix_and_docs_drift():
+    findings = run_lint("metrics_bad.py", checks={"metrics-discipline"})
+    # documented name (5), suppressed (14), and dynamic-name (19) are absent
+    assert lines_of(findings, "metrics-discipline") == [8, 11]
+    assert "tony_ prefix" in findings[0].message
+    assert "docs/observability.md" in findings[1].message
+
+
+def test_metrics_discipline_library_is_clean():
+    """The ratchet: every instrument registered in tony_tpu/ is prefixed
+    AND has a row in docs/observability.md's table — new metrics cannot
+    land undocumented (the drift that made the trace summary stale)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    analyzer = Analyzer(
+        [c for c in all_checkers() if c.name == "metrics-discipline"], root=repo
+    )
+    findings = analyzer.run([os.path.join(repo, "tony_tpu")])
+    assert findings == [], [f"{f.path}:{f.line}: {f.message}" for f in findings]
+
+
 # -------------------------------------------------------------- CLI contract
 def test_cli_exit_0_clean_json(tmp_path, capsys):
     clean = tmp_path / "clean.py"

@@ -331,6 +331,7 @@ def all_checkers() -> list[Checker]:
     from tony_tpu.analysis.jit_purity import JitPurityChecker
     from tony_tpu.analysis.locks import LockDisciplineChecker
     from tony_tpu.analysis.mesh_axes import MeshAxisChecker
+    from tony_tpu.analysis.metrics_discipline import MetricsDisciplineChecker
     from tony_tpu.analysis.print_discipline import PrintDisciplineChecker
 
     return [
@@ -340,4 +341,5 @@ def all_checkers() -> list[Checker]:
         LockDisciplineChecker(),
         MeshAxisChecker(),
         PrintDisciplineChecker(),
+        MetricsDisciplineChecker(),
     ]

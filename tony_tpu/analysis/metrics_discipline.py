@@ -1,0 +1,117 @@
+"""metrics-discipline: instruments are namespaced AND documented.
+
+Every metric registered through the obs registry (``obs_metrics.counter`` /
+``gauge`` / ``histogram``, or the module-level helpers imported from
+``tony_tpu.obs.metrics``) must
+
+1. carry the ``tony_`` prefix — the exposition merges many processes' groups
+   under one scrape; an unprefixed name collides with whatever else the
+   operator's Prometheus ingests, and
+2. appear in docs/observability.md's instrument table — the drift this
+   catches is real: the `tony trace` critical-path summary went stale for
+   two PRs because new episode instruments/spans landed without the docs
+   (and the summary they anchor) following.
+
+Exempt by path: tests, fixtures, examples, docs. A deliberate off-registry
+name carries an inline ``# lint: disable=metrics-discipline — <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable
+
+from tony_tpu.analysis.analyzer import Checker, Finding, Module, dotted_name
+
+EXEMPT_PARTS = frozenset({"tests", "fixtures", "examples", "docs"})
+
+#: registry factory method names (obs/metrics.py module helpers and
+#: MetricsRegistry methods share them)
+_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+
+_DOC_RELPATH = os.path.join("docs", "observability.md")
+_NAME_RE = re.compile(r"`(tony_[a-z0-9_]+)`")
+
+
+def _documented_names(start: str) -> "set[str] | None":
+    """All backticked ``tony_*`` instrument names in docs/observability.md,
+    found by walking up from ``start``; None when the doc is missing (a
+    vendored checkout without docs — the prefix rule still applies)."""
+    d = os.path.dirname(os.path.abspath(start))
+    for _ in range(12):
+        doc = os.path.join(d, _DOC_RELPATH)
+        if os.path.exists(doc):
+            try:
+                with open(doc, encoding="utf-8") as f:
+                    return set(_NAME_RE.findall(f.read()))
+            except OSError:
+                return None
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
+class MetricsDisciplineChecker(Checker):
+    name = "metrics-discipline"
+    description = (
+        "registered instruments use the tony_ prefix and appear in "
+        "docs/observability.md's instrument table"
+    )
+
+    def __init__(self) -> None:
+        self._doc_names: "set[str] | None" = None
+        self._doc_loaded = False
+
+    def _registration_name(self, node: ast.Call) -> str | None:
+        """The literal instrument name of a registry factory call, or None
+        (not a registration / dynamic name)."""
+        func = node.func
+        called = None
+        if isinstance(func, ast.Attribute) and func.attr in _FACTORIES:
+            recv = dotted_name(func.value)
+            # obs_metrics.counter(...), metrics.gauge(...), REGISTRY.histogram(...)
+            if recv and (recv.split(".")[-1].lower().endswith("metrics")
+                         or recv == "REGISTRY" or recv.endswith(".REGISTRY")):
+                called = func.attr
+        elif isinstance(func, ast.Name) and func.id in _FACTORIES:
+            called = func.id  # from tony_tpu.obs.metrics import counter
+        if called is None or not node.args:
+            return None
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+        return None
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        parts = set(os.path.normpath(module.path).split(os.sep))
+        if parts & EXEMPT_PARTS:
+            return
+        if module.abspath.replace(os.sep, "/").endswith("tony_tpu/obs/metrics.py"):
+            return  # the registry itself (generic helpers, no instruments)
+        if not self._doc_loaded:
+            self._doc_loaded = True
+            self._doc_names = _documented_names(module.abspath)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._registration_name(node)
+            if name is None:
+                continue
+            if not name.startswith("tony_"):
+                yield self.finding(
+                    module, node,
+                    f"instrument {name!r} lacks the tony_ prefix — the "
+                    "merged exposition shares a namespace with everything "
+                    "else the operator's Prometheus scrapes",
+                )
+            elif self._doc_names is not None and name not in self._doc_names:
+                yield self.finding(
+                    module, node,
+                    f"instrument {name!r} is not in docs/observability.md's "
+                    "instrument table — undocumented metrics are how the "
+                    "trace summary went stale; add a row (name in backticks)",
+                )

@@ -40,6 +40,9 @@ _COMPARE_ROWS: list[tuple[str, str, str | None]] = [
     ("resizes", "resizes", None),
     ("takeovers", "takeovers", None),
     ("queue_wait_s", "queue_wait_s", None),
+    ("goodput_s", "goodput_s", None),
+    ("badput_s", "badput_s", None),
+    ("goodput_fraction", "goodput_fraction", None),
     ("mfu_p50", "mfu", "p50"),
     ("tokens_per_sec_p50", "tokens_per_sec", "p50"),
     ("step_time_ms_p50", "step_time_ms", "p50"),
@@ -307,6 +310,14 @@ def main_bench(argv: list[str] | None = None) -> int:
     p.add_argument("--threshold", action="append", default=[],
                    metavar="METRIC=PCT",
                    help="per-metric threshold override (repeatable)")
+    p.add_argument("--goodput-floor", type=float, default=None,
+                   help="also gate a job's goodput fraction (obs/goodput.py "
+                        "ledger): fail when --goodput-app's productive "
+                        "fraction is below this (0..1)")
+    p.add_argument("--goodput-app", default=None,
+                   help="application id whose ledger --goodput-floor gates")
+    p.add_argument("--staging", default=None,
+                   help="staging root for --goodput-app (default: $TONY_ROOT)")
     args = p.parse_args(argv)
 
     if not args.gate:
@@ -362,7 +373,39 @@ def main_bench(argv: list[str] | None = None) -> int:
                             tolerance_pct=args.tolerance_pct,
                             per_metric_pct=per_metric)
     print(result.render())
-    return 0 if result.passed else 1
+    rc = 0 if result.passed else 1
+
+    # optional goodput gate: a run that hit its perf numbers by burning the
+    # cluster (restarts, queue thrash) still fails the contract
+    if args.goodput_floor is not None:
+        if not args.goodput_app:
+            print("tony bench --gate: --goodput-floor needs --goodput-app",
+                  file=sys.stderr)
+            return 2
+        from tony_tpu.obs import goodput as _goodput
+
+        staging = args.staging or constants.default_tony_root()
+        art = obs_artifacts.index(staging, args.goodput_app)
+        events, _complete = art.read_events()
+        if not events:
+            print(f"tony bench --gate: no history events for "
+                  f"{args.goodput_app} under {staging}", file=sys.stderr)
+            return 2
+        import time as _time
+
+        ledger = _goodput.build_ledger(
+            args.goodput_app, events, obs_artifacts.load_spans(art.trace_dir),
+            now_ms=int(_time.time() * 1000))
+        frac = ledger.goodput_fraction
+        if frac < args.goodput_floor:
+            print(f"GOODPUT REGRESSION: {args.goodput_app} productive "
+                  f"fraction {frac:.3f} < floor {args.goodput_floor:.3f} "
+                  f"(badput: {ledger.badput_ms()})")
+            rc = 1
+        else:
+            print(f"goodput gate OK: {args.goodput_app} {frac:.3f} >= "
+                  f"{args.goodput_floor:.3f}")
+    return rc
 
 
 if __name__ == "__main__":

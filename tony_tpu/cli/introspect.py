@@ -252,8 +252,16 @@ def _fmt(v: Any, spec: str = "") -> str:
     return str(v)
 
 
-def render_top(app: dict[str, Any], rows: list[dict[str, Any]]) -> str:
-    """One snapshot frame: application header + a row per task."""
+def render_top(app: dict[str, Any], rows: list[dict[str, Any]],
+               goodput: dict[str, Any] | None = None) -> str:
+    """One snapshot frame: application header + a row per task. ``goodput``
+    is the AM's ``get_goodput`` payload when available — it puts the live
+    trailing-window goodput fraction in the header, a per-rank SKEW column
+    (step time / gang median) in the table, and flags stragglers."""
+    skew = (goodput or {}).get("skew") or {}
+    stragglers = set((goodput or {}).get("stragglers") or ())
+    window_frac = (goodput or {}).get("window_fraction")
+    active_alerts = (goodput or {}).get("alerts") or []
     lines = [
         f"{app.get('app_id', '?')}  {app.get('state', '?')}  "
         f"attempt {app.get('restart_attempt', 0)}"
@@ -262,13 +270,18 @@ def render_top(app: dict[str, Any], rows: list[dict[str, Any]]) -> str:
         + (f"  am-attempt {app.get('am_attempt')}"
            + (f" ({app.get('takeover')})" if app.get("takeover") else "")
            if app.get("am_attempt") else "")
+        + (f"  goodput {window_frac:.0%}" if window_frac is not None else "")
+        + (f"  ALERTS: {', '.join(a['rule'] for a in active_alerts)}"
+           if active_alerts else "")
         + (f"  ({app.get('reason')})" if app.get("reason") else ""),
         "",
         f"{'TASK':<14s} {'STATE':<11s} {'STEP':>6s} {'LOSS':>8s} "
         f"{'TOK/S':>9s} {'STEP/S':>7s} {'MFU':>6s} {'QUEUE':>6s} "
-        f"{'TTFT':>7s} {'HB AGE':>7s}",
+        f"{'TTFT':>7s} {'HB AGE':>7s} {'SKEW':>6s}",
     ]
     for r in rows:
+        ratio = skew.get(r["task"])
+        skew_cell = "-" if ratio is None else f"{ratio:.2f}x"
         lines.append(
             f"{r['task']:<14s} {str(r['state']):<11s} "
             f"{_fmt(r['step'], 'd'):>6s} {_fmt(r['loss'], '.4f'):>8s} "
@@ -277,7 +290,9 @@ def render_top(app: dict[str, Any], rows: list[dict[str, Any]]) -> str:
             f"{_fmt(r['mfu'], '.3f'):>6s} "
             f"{_fmt(r['queue_depth'], '.0f'):>6s} "
             f"{_fmt(r['ttft_s'], '.3f'):>7s} "
-            f"{_fmt(r['hb_age_s'], '.1f'):>6s}s"
+            f"{_fmt(r['hb_age_s'], '.1f'):>6s}s "
+            f"{skew_cell:>6s}"
+            + ("  << STRAGGLER" if r["task"] in stragglers else "")
         )
     return "\n".join(lines)
 
@@ -317,6 +332,10 @@ def main_top(argv: list[str] | None = None) -> int:
             app = cli.call("get_application_status")
             infos = cli.call("get_task_infos")
             metrics = cli.call("get_metrics")
+            try:
+                goodput = cli.call("get_goodput")
+            except (RpcError, OSError):
+                goodput = None  # pre-goodput AM: the rest of the frame stands
         except (RpcError, OSError) as e:
             # the AM exits between the liveness probe and the scrape when the
             # job finalizes: that is a finished job, not a scrape failure
@@ -337,7 +356,7 @@ def main_top(argv: list[str] | None = None) -> int:
         try:
             if not args.once and not first:
                 print("\x1b[2J\x1b[H", end="")  # clear + home between frames
-            print(render_top(app, rows), flush=True)
+            print(render_top(app, rows, goodput=goodput), flush=True)
         except BrokenPipeError:
             return _pipe_closed()
         if args.once:

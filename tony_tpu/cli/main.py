@@ -106,6 +106,12 @@ def _cmd_resize(argv: list[str]) -> int:
     return main_resize(argv)
 
 
+def _cmd_goodput(argv: list[str]) -> int:
+    from tony_tpu.cli.goodput import main as goodput_main
+
+    return goodput_main(argv)
+
+
 def _cmd_mini(argv: list[str]) -> int:
     """Self-contained sandbox: submit a smoke gang against the local resource
     manager and print the verdict + history location.
@@ -289,13 +295,14 @@ _COMMANDS = {
     "logs": _cmd_logs,
     "top": _cmd_top,
     "resize": _cmd_resize,
+    "goodput": _cmd_goodput,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|history-server|bench|portal|notebook|serve|mini|data-prep|lint|chaos|trace|profile|logs|top|resize} [options]\n")
+        print("usage: tony {submit|pool|history|history-server|bench|portal|notebook|serve|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    query the persistent history tier (list|show|compare|ingest|gc)")
@@ -313,6 +320,7 @@ def main(argv: list[str] | None = None) -> int:
         print("  logs       merge/tail a job's per-process structured logs in timestamp order")
         print("  top        refreshing live status view (per-task state, step rate, heartbeat age)")
         print("  resize     retarget a RUNNING job's per-type instance count (elastic rebuild)")
+        print("  goodput    exact goodput/badput phase accounting + straggler skew + alert history")
         return 0
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:

@@ -133,6 +133,24 @@ def summarize(spans: list[dict[str, Any]]) -> str:
         item("gang restarts", f"{len(restarts)} ({reasons})")
     else:
         item("gang restarts", "none")
+    # control-plane episodes added after the original summary (PRs 6-7):
+    # without them the printed breakdown disagrees with the goodput ledger
+    resizes = by_name.get("am.resize", [])
+    if resizes:
+        moves = "; ".join(
+            f"{(s.get('attrs') or {}).get('trigger', '?')}: "
+            f"{(s.get('attrs') or {}).get('resized', {})}"
+            for s in resizes
+        )
+        item("resize episodes", f"{sum(_dur_s(s) for s in resizes):.2f}s "
+                                f"over {len(resizes)} ({moves})")
+    takeovers = by_name.get("am.takeover", [])
+    if takeovers:
+        item("AM takeovers",
+             f"{sum(_dur_s(s) for s in takeovers):.2f}s over {len(takeovers)} "
+             f"(attempt(s) "
+             + ", ".join(str((s.get("attrs") or {}).get("am_attempt", "?"))
+                         for s in takeovers) + ")")
 
     chaos = [
         (s, ev)

@@ -32,6 +32,8 @@ from tony_tpu.chaos import ChaosContext
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.cluster import history
 from tony_tpu.cluster.journal import Journal, JournalError, read_journal
+from tony_tpu.obs import alerts as obs_alerts
+from tony_tpu.obs import goodput as obs_goodput
 from tony_tpu.obs import introspect as obs_introspect
 from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
@@ -85,6 +87,18 @@ _AM_TAKEOVERS = obs_metrics.counter(
 _TAKEOVER_SECONDS = obs_metrics.histogram(
     "tony_am_takeover_duration_seconds",
     "journal replay + gang adoption latency of a successful AM takeover")
+_STRAGGLER_COUNT = obs_metrics.gauge(
+    "tony_straggler_count",
+    "ranks currently flagged as stragglers (step time persistently over the "
+    "gang median by tony.goodput.straggler-factor)")
+_STRAGGLER_SKEW = obs_metrics.gauge(
+    "tony_straggler_skew_ratio",
+    "per-rank step-time / gang-median ratio from the last goodput tick",
+    labelnames=("task",))
+_GOODPUT_FRACTION = obs_metrics.gauge(
+    "tony_goodput_fraction",
+    "productive fraction of wall-clock over the trailing "
+    "tony.goodput.window-ms (obs/goodput.py phase ledger)")
 
 
 class InvalidResizeError(ValueError):
@@ -301,6 +315,28 @@ class ApplicationMaster:
         # on-demand profiler capture (tony profile): single-slot request
         # state machine, internally locked — RPC handler threads race on it
         self._profile = obs_introspect.ProfileCoordinator()
+        # goodput accounting plane (tony.goodput.*): the monitor loop's
+        # throttled tick classifies wall-time, watches for stragglers, and
+        # evaluates the declarative tony.alerts.* rules
+        self._goodput_enabled = config.get_bool(keys.GOODPUT_ENABLED, True)
+        self._goodput_interval_s = config.get_time_ms(keys.GOODPUT_INTERVAL_MS, 5000) / 1000
+        self._goodput_window_ms = config.get_time_ms(keys.GOODPUT_WINDOW_MS, 60_000)
+        self._straggler = obs_goodput.StragglerDetector(
+            factor=float(config.get(keys.GOODPUT_STRAGGLER_FACTOR) or 1.5),
+            min_checks=config.get_int(keys.GOODPUT_STRAGGLER_CHECKS, 3),
+        )
+        self._alerts = obs_alerts.AlertEngine(
+            obs_alerts.rules_from_config(config),  # ValueError → fail LOUD at start
+            sink=obs_alerts.AlertSink(
+                config.get(keys.ALERTS_SINK) or os.path.join(staging_dir, "alerts.jsonl"),
+                config.get(keys.ALERTS_WEBHOOK) or None,
+            ),
+            app_id=app_id,
+        )
+        self._last_goodput_tick = 0.0
+        # incremental .jhist reader: the tick/RPC pay O(new events), not a
+        # full re-parse of a multi-day job's history every few seconds
+        self._jhist = obs_goodput.JhistFollower(self.events.intermediate_path)
         self._last_capacity_probe = 0.0
         self._capacity_short_since: float | None = None  # downsize hysteresis
         # guards (attempt, session) as one unit: RPC handlers capture both
@@ -401,14 +437,23 @@ class ApplicationMaster:
         session = self._fenced_session(attempt)
         if session is None:
             return {"ack": False, "stale": True}
-        session.on_task_completed(job_name, index, exit_code)
-        self._jlog("task_done", job=job_name, index=index, exit_code=exit_code)
+        try:
+            with session.lock:
+                session.get_task(job_name, index)
+        except KeyError:
+            return {"ack": False}
         payload: dict[str, Any] = {"task": f"{job_name}:{index}", "exit_code": exit_code}
         if reason:
             # e.g. "execution timeout": lets the .jhist distinguish an
             # executor-enforced kill from a user-code failure
             payload["reason"] = reason
+        # event queued BEFORE the task flips terminal: the monitor loop
+        # breaks the instant the LAST tracked task is terminal, and stop()'s
+        # APPLICATION_FINISHED + queue sentinel would race ahead of an
+        # emit-after — losing the final task's finish record from the .jhist
         self.events.emit(EventType.TASK_FINISHED, **payload)
+        session.on_task_completed(job_name, index, exit_code)
+        self._jlog("task_done", job=job_name, index=index, exit_code=exit_code)
         return {"ack": True}
 
     def register_tensorboard_url(self, url: str) -> dict[str, Any]:
@@ -662,6 +707,130 @@ class ApplicationMaster:
             "identity": "am",
             "metrics": obs_metrics.REGISTRY.snapshot(),
             "tasks": tasks,
+        }
+
+    # --------------------------------------------------- goodput accounting
+    def _live_ledger(self) -> "obs_goodput.Ledger | None":
+        """The job-so-far phase ledger from this AM's own artifacts: the
+        incrementally-followed intermediate ``.jhist`` (events already
+        flushed by the handler thread) plus the span sink when traced. None
+        when nothing has been written yet."""
+        events = self._jhist.poll()
+        if not events:
+            return None
+        spans: list[dict[str, Any]] = []
+        if self.tracer is not None:
+            from tony_tpu.obs import artifacts as obs_artifacts
+
+            spans = obs_artifacts.load_spans(self.tracer.trace_dir)
+        return obs_goodput.build_ledger(
+            self.app_id, events, spans, now_ms=int(time.time() * 1000))
+
+    def _alert_values(
+        self, infos: list[dict[str, Any]], task_obs: dict[str, Any],
+        ledger: "obs_goodput.Ledger | None",
+    ) -> dict[str, float | None]:
+        """Current value per configured rule (None = no data this tick)."""
+        values: dict[str, float | None] = {}
+        rule_names = {r.name for r in self._alerts.rules}
+        if "goodput-floor" in rule_names:
+            values["goodput-floor"] = (
+                ledger.window_fraction(self._goodput_window_ms)
+                if ledger is not None else None)
+        if "step-time-p99-ms" in rule_names:
+            p99_s = obs_goodput.histogram_percentile(
+                task_obs.values(), "tony_train_step_seconds", 0.99)
+            values["step-time-p99-ms"] = p99_s * 1000.0 if p99_s is not None else None
+        if "heartbeat-age-ms" in rule_names:
+            now_ms = time.time() * 1000
+            ages = [
+                now_ms - float(t["last_heartbeat_ms"])
+                for t in infos
+                if t.get("last_heartbeat_ms")
+                and t.get("status") in (TaskStatus.REGISTERED.value, TaskStatus.RUNNING.value)
+            ]
+            values["heartbeat-age-ms"] = max(ages) if ages else None
+        if "queue-depth" in rule_names:
+            depths = [
+                obs_introspect.metric_value(obs, "tony_serve_queue_depth")
+                for obs in task_obs.values()
+            ]
+            depths = [d for d in depths if d is not None]
+            values["queue-depth"] = max(depths) if depths else None
+        return values
+
+    def _goodput_tick(self) -> None:
+        """Throttled straggler + alert evaluation from the monitor loop (the
+        same piggybacked state every other introspection surface reads)."""
+        if not self._goodput_enabled:
+            return
+        now = time.monotonic()
+        if now - self._last_goodput_tick < self._goodput_interval_s:
+            return
+        self._last_goodput_tick = now
+        infos = self.session.task_infos()
+        task_obs = {
+            f"{t['name']}:{t['index']}": (t.get("metrics") or {}).get("obs_metrics")
+            for t in infos
+        }
+        # only LIVE ranks feed the detector: a finished task's frozen stats
+        # would otherwise read as an ever-growing stall
+        live = [
+            t for t in infos
+            if t.get("status") in (TaskStatus.REGISTERED.value, TaskStatus.RUNNING.value)
+        ]
+        for action, task, ratio, median in self._straggler.observe(
+            obs_introspect.step_stats_by_task(live, task_obs)
+        ):
+            if action == "detected":
+                self.events.emit(
+                    EventType.STRAGGLER_DETECTED,
+                    task=task, ratio=round(ratio, 3),
+                    median_step_s=round(median, 4),
+                    factor=self._straggler.factor,
+                )
+                obs_logging.warning(
+                    f"[tony-am] straggler: {task} step time {ratio:.2f}x the "
+                    f"gang median ({median * 1000:.1f}ms)")
+            else:
+                self.events.emit(
+                    EventType.STRAGGLER_RESOLVED, task=task, ratio=round(ratio, 3))
+                obs_logging.info(f"[tony-am] straggler resolved: {task}")
+        _STRAGGLER_COUNT.set(len(self._straggler.flagged))
+        for task, ratio in self._straggler.skew.items():
+            _STRAGGLER_SKEW.set(round(ratio, 4), task=task)
+        # the gauge is the tick's contract, alert rule or not — dashboards
+        # scrape it on healthy jobs too
+        ledger = self._live_ledger()
+        if ledger is not None:
+            _GOODPUT_FRACTION.set(
+                round(ledger.window_fraction(self._goodput_window_ms), 6))
+        if self._alerts.rules:
+            for rec in self._alerts.evaluate(
+                self._alert_values(infos, task_obs, ledger)
+            ):
+                etype = (EventType.ALERT_FIRED if rec["state"] == "fired"
+                         else EventType.ALERT_RESOLVED)
+                self.events.emit(
+                    etype, **{k: v for k, v in rec.items() if k != "app_id"})
+                obs_logging.warning(
+                    f"[tony-am] alert {rec['rule']} {rec['state']}: "
+                    f"value {rec.get('value')} vs threshold {rec.get('threshold')}")
+
+    def get_goodput(self) -> dict[str, Any]:
+        """Live goodput surface (`tony goodput` / `tony top` / portal): the
+        job-so-far ledger, the trailing-window fraction, per-rank skew, and
+        the active alerts."""
+        ledger = self._live_ledger() if self._goodput_enabled else None
+        return {
+            "goodput": ledger.to_dict() if ledger is not None else None,
+            "window_ms": self._goodput_window_ms,
+            "window_fraction": (
+                ledger.window_fraction(self._goodput_window_ms)
+                if ledger is not None else None),
+            "skew": {t: round(r, 4) for t, r in sorted(self._straggler.skew.items())},
+            "stragglers": sorted(self._straggler.flagged),
+            "alerts": self._alerts.active(),
         }
 
     # ------------------------------------------------------------ lifecycle
@@ -1081,11 +1250,13 @@ class ApplicationMaster:
                 continue
             task = self.session.get_task(c.job_type, c.task_index)
             if not task.status.terminal:
-                self.session.on_task_completed(c.job_type, c.task_index, rc)
-                self._jlog("task_done", job=c.job_type, index=c.task_index, exit_code=rc)
+                # emit before the terminal flip (same shutdown race as
+                # register_execution_result)
                 self.events.emit(
                     EventType.TASK_FINISHED, task=task.id, exit_code=rc, source="container-exit"
                 )
+                self.session.on_task_completed(c.job_type, c.task_index, rc)
+                self._jlog("task_done", job=c.job_type, index=c.task_index, exit_code=rc)
 
     # ------------------------------------------------- elastic gang resize
     def _effective_config(self) -> TonyConfig:
@@ -1555,6 +1726,10 @@ class ApplicationMaster:
                     last_snapshot_key = key
                     self.events.emit(EventType.METRICS_SNAPSHOT, tasks=snap)
 
+            # 2c. goodput tick (throttled): straggler skew off the piggybacked
+            # step-time histograms + the declarative tony.alerts.* rules
+            self._goodput_tick()
+
             # 3. heartbeat liveness
             for t in self.session.find_dead_tasks(hb_interval, hb_max_missed):
                 self.session.mark_lost(t)
@@ -1619,6 +1794,12 @@ class ApplicationMaster:
         self._kill_all_spares()  # parked spares must not outlive the job
         final = self.session.reduce_final_status()
         completed_ms = int(time.time() * 1000)
+        # a finished job's alerts are no longer actionable: resolve them into
+        # the event stream + sink instead of leaving ghosts firing forever
+        for rec in self._alerts.resolve_all("job finalized"):
+            self.events.emit(
+                EventType.ALERT_RESOLVED,
+                **{k: v for k, v in rec.items() if k != "app_id"})
         obs_logging.info(f"[tony-am] application {self.app_id} finished: {final.value}")
         self.events.emit(
             EventType.APPLICATION_FINISHED,

@@ -42,6 +42,10 @@ class EventType(enum.Enum):
     METRICS_SNAPSHOT = "METRICS_SNAPSHOT"
     PROFILE_REQUESTED = "PROFILE_REQUESTED"    # on-demand capture fan-out began
     PROFILE_FINISHED = "PROFILE_FINISHED"      # every targeted task reported
+    STRAGGLER_DETECTED = "STRAGGLER_DETECTED"  # rank's step time persistently over the gang median
+    STRAGGLER_RESOLVED = "STRAGGLER_RESOLVED"  # flagged rank back under the skew factor (or gone)
+    ALERT_FIRED = "ALERT_FIRED"                # a tony.alerts.* rule crossed its threshold
+    ALERT_RESOLVED = "ALERT_RESOLVED"          # the rule's signal recovered (or the job finalized)
     APPLICATION_FINISHED = "APPLICATION_FINISHED"
 
 

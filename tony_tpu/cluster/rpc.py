@@ -325,4 +325,5 @@ APPLICATION_RPC_METHODS = [
     "start_profile",         # arm an on-demand profiler capture (tony profile)
     "get_profile_status",    # per-task capture status for the in-flight request
     "report_profile_status", # executors report delivery/capture back to the AM
+    "get_goodput",           # live goodput ledger + straggler skew + active alerts
 ]

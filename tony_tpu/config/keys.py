@@ -278,6 +278,34 @@ TRACE_DIR = "tony.trace.dir"
 METRICS_ENABLED = "tony.metrics.enabled"
 
 # ---------------------------------------------------------------------------
+# tony.goodput.* — goodput accounting + straggler detection (docs/observability.md)
+# ---------------------------------------------------------------------------
+# The AM's goodput tick: classifies wall-time into phases (obs/goodput.py),
+# feeds the straggler detector from the piggybacked per-task step-time
+# histograms, and evaluates the tony.alerts.* rules. false turns the whole
+# plane off (no tick, no events, no gauges).
+GOODPUT_ENABLED = "tony.goodput.enabled"
+GOODPUT_INTERVAL_MS = "tony.goodput.interval-ms"      # tick cadence
+# Trailing window the LIVE goodput value (alert input, tony top header) is
+# computed over — cumulative goodput can never recover from one early stall;
+# a windowed value resolves once the job is productive again.
+GOODPUT_WINDOW_MS = "tony.goodput.window-ms"
+# A rank is a straggler when its step time stays >= factor × the gang median
+# for `checks` consecutive goodput ticks (needs >= 3 reporting ranks).
+GOODPUT_STRAGGLER_FACTOR = "tony.goodput.straggler-factor"
+GOODPUT_STRAGGLER_CHECKS = "tony.goodput.straggler-checks"
+
+# ---------------------------------------------------------------------------
+# tony.alerts.* — declarative alert rules (obs/alerts.py; empty = disabled)
+# ---------------------------------------------------------------------------
+ALERTS_GOODPUT_FLOOR = "tony.alerts.goodput-floor"        # fires while windowed goodput < this
+ALERTS_STEP_TIME_P99_MS = "tony.alerts.step-time-p99-ms"  # fires while step-time p99 > this
+ALERTS_HEARTBEAT_AGE_MS = "tony.alerts.heartbeat-age-ms"  # fires while any task heartbeat older
+ALERTS_QUEUE_DEPTH = "tony.alerts.queue-depth"            # fires while any serve queue deeper
+ALERTS_SINK = "tony.alerts.sink"        # transition JSONL; empty → <staging>/alerts.jsonl
+ALERTS_WEBHOOK = "tony.alerts.webhook"  # optional URL POSTed each transition
+
+# ---------------------------------------------------------------------------
 # tony.checkpoint.* — gang-restart-from-checkpoint (rebuild-only; SURVEY §5.3/5.4)
 # ---------------------------------------------------------------------------
 CHECKPOINT_DIR = "tony.checkpoint.dir"
@@ -396,6 +424,19 @@ DEFAULTS: dict[str, str] = {
     TRACE_ENABLED: "false",
     TRACE_DIR: "",                   # empty → <staging>/trace
     METRICS_ENABLED: "true",
+
+    GOODPUT_ENABLED: "true",
+    GOODPUT_INTERVAL_MS: "5000",
+    GOODPUT_WINDOW_MS: "60000",
+    GOODPUT_STRAGGLER_FACTOR: "1.5",
+    GOODPUT_STRAGGLER_CHECKS: "3",
+
+    ALERTS_GOODPUT_FLOOR: "",
+    ALERTS_STEP_TIME_P99_MS: "",
+    ALERTS_HEARTBEAT_AGE_MS: "",
+    ALERTS_QUEUE_DEPTH: "",
+    ALERTS_SINK: "",
+    ALERTS_WEBHOOK: "",
 
     CHECKPOINT_DIR: "",
     CHECKPOINT_INTERVAL_STEPS: "0",

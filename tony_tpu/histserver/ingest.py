@@ -27,6 +27,7 @@ import time
 from typing import Any
 
 from tony_tpu.obs import artifacts as obs_artifacts
+from tony_tpu.obs import goodput as obs_goodput
 from tony_tpu.obs import logging as obs_logging
 from tony_tpu.histserver.store import HistoryStore
 
@@ -134,6 +135,43 @@ def distill(art: obs_artifacts.JobArtifacts) -> tuple[dict[str, Any], dict, dict
     # traced jobs: fold checkpoint / compile / queue span totals in (the
     # shared span reader tolerates torn span files the same way)
     spans = obs_artifacts.load_spans(art.trace_dir)
+    # goodput accounting: the exact phase partition (obs/goodput.py) becomes
+    # two job columns (trend-able across runs) + the full phase breakdown
+    # and alert/straggler history in the summary
+    try:
+        ledger = obs_goodput.build_ledger(
+            art.app_id, events, spans,
+            now_ms=events[-1].timestamp_ms if events else 0)
+        summary["goodput"] = {
+            "fraction": round(ledger.goodput_fraction, 6),
+            "phases_ms": dict(ledger.phases_ms),
+        }
+        skew = ledger.skew_by_task()
+        if skew:
+            summary["goodput"]["skew_by_task"] = {
+                t: round(r, 4) for t, r in skew.items()}
+        goodput_s = round(ledger.phases_ms.get("productive", 0) / 1000.0, 3)
+        badput_s = round(sum(ledger.badput_ms().values()) / 1000.0, 3)
+        goodput_fraction = round(ledger.goodput_fraction, 6)
+    except Exception as e:  # noqa: BLE001 — a degenerate stream still ingests
+        obs_logging.warning(
+            f"[tony-history] goodput ledger for {art.app_id} failed: {e}")
+        goodput_s, badput_s, goodput_fraction = 0.0, 0.0, 0.0
+    alert_hist = [
+        {"state": ("fired" if ev.type.value == "ALERT_FIRED" else "resolved"),
+         "ts_ms": ev.timestamp_ms,
+         "rule": ev.payload.get("rule"), "value": ev.payload.get("value")}
+        for ev in events
+        if ev.type.value in ("ALERT_FIRED", "ALERT_RESOLVED")
+    ]
+    if alert_hist:
+        summary["alerts"] = alert_hist
+    stragglers = sorted({
+        str(ev.payload.get("task")) for ev in events
+        if ev.type.value == "STRAGGLER_DETECTED"
+    })
+    if stragglers:
+        summary["stragglers"] = stragglers
     if spans:
         def total(names: tuple[str, ...]) -> float:
             return sum(
@@ -166,6 +204,9 @@ def distill(art: obs_artifacts.JobArtifacts) -> tuple[dict[str, Any], dict, dict
         "resizes": resizes,
         "takeovers": takeovers,
         "queue_wait_s": round(queue_wait_s, 3),
+        "goodput_s": goodput_s,
+        "badput_s": badput_s,
+        "goodput_fraction": goodput_fraction,
         "staging_dir": art.staging_dir,
         "source_path": art.jhist_path or "",
         "source_mtime_ns": _mtime_ns(art.jhist_path),
@@ -214,9 +255,13 @@ def sweep(
     staging_roots: list[str],
     retention_days: float = 0.0,
     now_ms: int | None = None,
+    on_ingested=None,
 ) -> dict[str, int]:
     """One ingestion pass over every staging root: ingest finalized jobs
-    (new or changed), then apply retention. Returns outcome counts."""
+    (new or changed), then apply retention. Returns outcome counts.
+    ``on_ingested(app_id, artifacts)`` fires for each newly-(re)ingested job
+    — the daemon hangs its finalized-job alert evaluation there; a hook
+    failure counts as that job's error, never stalls the sweep."""
     counts = {"ingested": 0, "unchanged": 0, "skipped": 0, "expired": 0,
               "errors": 0, "purged": 0}
     now = now_ms if now_ms is not None else int(time.time() * 1000)
@@ -238,7 +283,12 @@ def sweep(
                 continue
             try:
                 art = obs_artifacts.index(root, app_id, finished=hint)
-                counts[ingest_job(store, art)] += 1
+                outcome = ingest_job(store, art)
+                if outcome == "ingested" and on_ingested is not None:
+                    on_ingested(app_id, art)
+                # counted only after the hook: a raising hook is THIS job's
+                # error, not an extra outcome on top of "ingested"
+                counts[outcome] += 1
             except Exception as e:  # noqa: BLE001 — one bad job must not stall the sweep
                 counts["errors"] += 1
                 obs_logging.warning(

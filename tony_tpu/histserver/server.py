@@ -44,6 +44,12 @@ _JOBS_GAUGE = obs_metrics.gauge(
     "tony_history_jobs", "jobs currently in the history store")
 _GC_REMOVED = obs_metrics.counter(
     "tony_history_gc_removed_total", "staging dirs removed by the GC sweep")
+_ALERT_EVALS = obs_metrics.counter(
+    "tony_history_alert_evals_total",
+    "finalized-job alert-rule evaluations by outcome (fired: the job ended "
+    "in breach of a configured rule; ok: rules held; none: no rules "
+    "configured; error: evaluation failed)",
+    labelnames=("outcome",))
 
 
 def default_store_path(staging_root: str) -> str:
@@ -117,7 +123,8 @@ class HistoryServer:
     def sweep_once(self) -> dict[str, int]:
         t0 = time.perf_counter()
         counts = _ingest.sweep(
-            self.store, self.staging_roots, retention_days=self.retention_days)
+            self.store, self.staging_roots, retention_days=self.retention_days,
+            on_ingested=self._evaluate_final_alerts)
         if self.gc_enabled and self.retention_days > 0:
             for root in self.staging_roots:
                 removed = _ingest.gc_staging(self.store, root, self.retention_days)
@@ -131,6 +138,32 @@ class HistoryServer:
         self._last_sweep_ms = int(time.time() * 1000)
         self._sweeps += 1
         return counts
+
+    def _evaluate_final_alerts(self, app_id: str, art) -> None:
+        """Finalized-job alert pass: re-evaluate the job's own
+        ``tony.alerts.goodput-floor`` against its FINAL ledger — the
+        cross-job safety net behind the AM's live evaluation (a job whose AM
+        died before resolving, or that ran with goodput disabled, is still
+        caught here). Counted in ``tony_history_alert_evals_total``."""
+        try:
+            from tony_tpu.config import TonyConfig, keys
+
+            row = self.store.get_job(app_id) or {}
+            cfg = TonyConfig(dict(row.get("config") or {}))
+            floor_raw = cfg.get(keys.ALERTS_GOODPUT_FLOOR)
+            if floor_raw in (None, ""):
+                _ALERT_EVALS.inc(outcome="none")
+                return
+            fired = float(row.get("goodput_fraction") or 0.0) < float(floor_raw)
+            _ALERT_EVALS.inc(outcome="fired" if fired else "ok")
+            if fired:
+                obs_logging.warning(
+                    f"[tony-history] {app_id} finished below its goodput "
+                    f"floor: {row.get('goodput_fraction')} < {floor_raw}")
+        except Exception as e:  # noqa: BLE001 — a bad config snapshot is that job's problem
+            _ALERT_EVALS.inc(outcome="error")
+            obs_logging.warning(
+                f"[tony-history] alert evaluation for {app_id} failed: {e}")
 
     def _sweep_loop(self) -> None:
         while not self._stop.wait(self.scan_interval_s):
@@ -201,7 +234,9 @@ class HistoryServer:
             f"<tr><td><a href=\"/api/job/{_html.escape(j['app_id'])}\">"
             f"{_html.escape(j['app_id'])}</a></td>"
             f"<td>{_html.escape(j['status'])}{' (incomplete)' if j['incomplete'] else ''}</td>"
-            f"<td>{j['duration_ms'] / 1000.0:.1f}s</td><td>{j['gang_epochs']}</td>"
+            f"<td>{j['duration_ms'] / 1000.0:.1f}s</td>"
+            f"<td>{j.get('goodput_fraction', 0) or 0:.1%}</td>"
+            f"<td>{j['gang_epochs']}</td>"
             f"<td>{j['resizes']}</td><td>{j['takeovers']}</td></tr>"
             for j in self.store.list_jobs(limit=200))
         return (
@@ -211,7 +246,7 @@ class HistoryServer:
             '<a href="/api/jobs">jobs json</a> · <a href="/healthz">healthz</a> · '
             '<a href="/metrics">metrics</a></p>'
             "<table border=1><tr><th>application</th><th>status</th><th>duration</th>"
-            "<th>epochs</th><th>resizes</th><th>takeovers</th></tr>"
+            "<th>goodput</th><th>epochs</th><th>resizes</th><th>takeovers</th></tr>"
             + rows + "</table></body></html>").encode()
 
 

@@ -45,6 +45,9 @@ CREATE TABLE IF NOT EXISTS jobs (
   resizes INTEGER DEFAULT 0,
   takeovers INTEGER DEFAULT 0,
   queue_wait_s REAL DEFAULT 0.0,
+  goodput_s REAL DEFAULT 0.0,
+  badput_s REAL DEFAULT 0.0,
+  goodput_fraction REAL DEFAULT 0.0,
   staging_dir TEXT DEFAULT '',
   source_path TEXT DEFAULT '',
   source_mtime_ns INTEGER DEFAULT 0,
@@ -67,7 +70,8 @@ CREATE INDEX IF NOT EXISTS series_by_metric ON series (metric, app_id);
 _JOB_FIELDS = (
     "app_id", "status", "user", "started_ms", "completed_ms", "duration_ms",
     "incomplete", "tasks", "gang_epochs", "resizes", "takeovers",
-    "queue_wait_s", "staging_dir", "source_path", "source_mtime_ns",
+    "queue_wait_s", "goodput_s", "badput_s", "goodput_fraction",
+    "staging_dir", "source_path", "source_mtime_ns",
 )
 
 
@@ -101,6 +105,14 @@ class HistoryStore:
             if path != ":memory:":
                 self._db.execute("PRAGMA journal_mode=WAL")
             self._db.executescript(_SCHEMA)
+            # migrate pre-goodput stores in place: CREATE IF NOT EXISTS
+            # never adds columns to an existing table
+            have = {r["name"] for r in self._db.execute("PRAGMA table_info(jobs)")}
+            for col, decl in (("goodput_s", "REAL DEFAULT 0.0"),
+                              ("badput_s", "REAL DEFAULT 0.0"),
+                              ("goodput_fraction", "REAL DEFAULT 0.0")):
+                if col not in have:
+                    self._db.execute(f"ALTER TABLE jobs ADD COLUMN {col} {decl}")
             self._db.commit()
 
     def close(self) -> None:
@@ -223,7 +235,8 @@ class HistoryStore:
         out: list[dict[str, Any]] = []
         for job in sorted(self.list_jobs(), key=lambda j: (j["completed_ms"], j["app_id"])):
             if metric in ("gang_epochs", "resizes", "takeovers",
-                          "queue_wait_s", "duration_ms"):
+                          "queue_wait_s", "duration_ms",
+                          "goodput_s", "badput_s", "goodput_fraction"):
                 value: Any = job.get(metric)
             else:
                 value = (job.get("summary", {}).get(metric) or {}).get(stat)

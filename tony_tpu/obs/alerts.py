@@ -1,0 +1,217 @@
+"""Declarative alert rules over the live goodput/health signals.
+
+The decision layer on top of three generations of telemetry: operators
+declare thresholds as plain ``tony.alerts.*`` config keys and the engine
+turns signal crossings into ``ALERT_FIRED`` / ``ALERT_RESOLVED`` events, a
+``tony_alerts_active`` gauge, and a pluggable sink (JSONL file + optional
+webhook). Rules are **per job** — they ride the frozen config like every
+other ``tony.*`` knob:
+
+=================================  ==========================================
+``tony.alerts.goodput-floor``      fires while the trailing-window goodput
+                                   fraction (obs/goodput.py,
+                                   ``tony.goodput.window-ms``) is BELOW this
+``tony.alerts.step-time-p99-ms``   fires while the gang's step-time p99
+                                   (merged ``tony_train_step_seconds``
+                                   histograms) is ABOVE this
+``tony.alerts.heartbeat-age-ms``   fires while any live task's last
+                                   heartbeat is older than this
+``tony.alerts.queue-depth``        fires while any serve replica's admission
+                                   queue is deeper than this
+=================================  ==========================================
+
+Empty (the default) disables a rule. The engine is deliberately edge-
+triggered state, not a stream processor: :meth:`AlertEngine.evaluate` takes
+the current value per rule (None = no data, state unchanged) and returns
+only the TRANSITIONS — the caller (the AM's goodput tick, the history
+server's finalized-job sweep) owns when to sample and what to do with a
+transition. The sink is best-effort by contract: a full disk or a dead
+webhook must never take down the control plane.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+from tony_tpu.obs import logging as obs_logging
+from tony_tpu.obs import metrics as obs_metrics
+
+_ACTIVE = obs_metrics.gauge(
+    "tony_alerts_active", "alert rules currently firing for this job")
+_TRANSITIONS = obs_metrics.counter(
+    "tony_alerts_transitions_total",
+    "alert state transitions by rule and action (fired, resolved)",
+    labelnames=("rule", "action"))
+
+#: rule vocabulary: name → (direction, unit). ``below`` fires when
+#: value < threshold; ``above`` when value > threshold.
+RULES: dict[str, tuple[str, str]] = {
+    "goodput-floor": ("below", "fraction"),
+    "step-time-p99-ms": ("above", "ms"),
+    "heartbeat-age-ms": ("above", "ms"),
+    "queue-depth": ("above", "requests"),
+}
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    name: str          # one of RULES
+    threshold: float
+    direction: str     # "below" | "above"
+    unit: str = ""
+
+    def breached(self, value: float) -> bool:
+        return value < self.threshold if self.direction == "below" else value > self.threshold
+
+
+def rules_from_config(config) -> list[AlertRule]:
+    """Parse the ``tony.alerts.*`` keys into rules; unset/empty keys are
+    disabled, unparseable values are a loud no (config mistakes must not
+    silently disable monitoring)."""
+    from tony_tpu.config import keys
+
+    declared = {
+        "goodput-floor": keys.ALERTS_GOODPUT_FLOOR,
+        "step-time-p99-ms": keys.ALERTS_STEP_TIME_P99_MS,
+        "heartbeat-age-ms": keys.ALERTS_HEARTBEAT_AGE_MS,
+        "queue-depth": keys.ALERTS_QUEUE_DEPTH,
+    }
+    out: list[AlertRule] = []
+    for name, (direction, unit) in RULES.items():
+        raw = config.get(declared[name])
+        if raw in (None, ""):
+            continue
+        try:
+            threshold = float(raw)
+        except ValueError as e:
+            raise ValueError(f"tony.alerts.{name}={raw!r} is not a number") from e
+        out.append(AlertRule(name, threshold, direction, unit))
+    return out
+
+
+class AlertSink:
+    """Where transitions go besides the event stream: an append-only JSONL
+    file (same torn-tail discipline as every other artifact) and an optional
+    webhook POSTing each transition as JSON. Both best-effort."""
+
+    def __init__(self, jsonl_path: str | None = None,
+                 webhook_url: str | None = None, timeout_s: float = 2.0):
+        self.jsonl_path = jsonl_path or None
+        self.webhook_url = webhook_url or None
+        self.timeout_s = timeout_s
+
+    def deliver(self, record: Mapping[str, Any]) -> None:
+        if self.jsonl_path:
+            try:
+                with open(self.jsonl_path, "a") as f:
+                    f.write(json.dumps(record) + "\n")
+            except OSError as e:
+                obs_logging.warning(f"[tony-alerts] sink write failed: {e}")
+        if self.webhook_url:
+            try:
+                import urllib.request
+
+                req = urllib.request.Request(
+                    self.webhook_url,
+                    data=json.dumps(record).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=self.timeout_s).close()
+            except Exception as e:  # noqa: BLE001 — a dead webhook is not our outage
+                obs_logging.warning(f"[tony-alerts] webhook delivery failed: {e}")
+
+
+class AlertEngine:
+    """Edge-triggered rule evaluation: tracks which rules are firing and
+    reports only the transitions."""
+
+    def __init__(self, rules: list[AlertRule], sink: AlertSink | None = None,
+                 app_id: str = ""):
+        self.rules = list(rules)
+        self.sink = sink
+        self.app_id = app_id
+        self._active: dict[str, dict[str, Any]] = {}   # rule name → fired record
+
+    def active(self) -> list[dict[str, Any]]:
+        """Currently-firing alerts (fired record + last observed value)."""
+        return [dict(rec) for _, rec in sorted(self._active.items())]
+
+    def evaluate(
+        self, values: Mapping[str, float | None], now_ms: int | None = None
+    ) -> list[dict[str, Any]]:
+        """One sample per rule name (None = no data this tick: state holds —
+        a scrape gap must neither fire nor resolve anything). Returns the
+        transition records, each already delivered to the sink."""
+        now = int(now_ms if now_ms is not None else time.time() * 1000)
+        transitions: list[dict[str, Any]] = []
+        for rule in self.rules:
+            value = values.get(rule.name)
+            if value is None:
+                continue
+            firing = rule.breached(float(value))
+            was = rule.name in self._active
+            if firing and not was:
+                rec = {
+                    "app_id": self.app_id,
+                    "rule": rule.name,
+                    "state": "fired",
+                    "value": float(value),
+                    "threshold": rule.threshold,
+                    "direction": rule.direction,
+                    "unit": rule.unit,
+                    "ts_ms": now,
+                }
+                self._active[rule.name] = dict(rec, state="firing")
+                transitions.append(rec)
+                _TRANSITIONS.inc(rule=rule.name, action="fired")
+            elif not firing and was:
+                fired = self._active.pop(rule.name)
+                rec = {
+                    "app_id": self.app_id,
+                    "rule": rule.name,
+                    "state": "resolved",
+                    "value": float(value),
+                    "threshold": rule.threshold,
+                    "direction": rule.direction,
+                    "unit": rule.unit,
+                    "ts_ms": now,
+                    "active_ms": max(now - int(fired.get("ts_ms", now)), 0),
+                }
+                transitions.append(rec)
+                _TRANSITIONS.inc(rule=rule.name, action="resolved")
+            elif firing:
+                self._active[rule.name]["value"] = float(value)
+        _ACTIVE.set(len(self._active))
+        if self.sink is not None:
+            for rec in transitions:
+                self.sink.deliver(rec)
+        return transitions
+
+    def resolve_all(self, reason: str, now_ms: int | None = None) -> list[dict[str, Any]]:
+        """Finalization: a finished job's alerts are no longer actionable —
+        resolve them loudly rather than leaving ghosts in the sink."""
+        now = int(now_ms if now_ms is not None else time.time() * 1000)
+        transitions = []
+        for name, fired in sorted(self._active.items()):
+            rec = {
+                "app_id": self.app_id,
+                "rule": name,
+                "state": "resolved",
+                "reason": reason,
+                "threshold": fired.get("threshold"),
+                "direction": fired.get("direction"),
+                "unit": fired.get("unit"),
+                "ts_ms": now,
+                "active_ms": max(now - int(fired.get("ts_ms", now)), 0),
+            }
+            transitions.append(rec)
+            _TRANSITIONS.inc(rule=name, action="resolved")
+        self._active.clear()
+        _ACTIVE.set(0)
+        if self.sink is not None:
+            for rec in transitions:
+                self.sink.deliver(rec)
+        return transitions

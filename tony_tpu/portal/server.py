@@ -33,6 +33,7 @@ from urllib.parse import urlparse
 from tony_tpu import constants
 from tony_tpu.cluster.events import Event
 from tony_tpu.obs import artifacts as obs_artifacts
+from tony_tpu.obs import goodput as obs_goodput
 from tony_tpu.obs import introspect as obs_introspect
 from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
@@ -61,7 +62,7 @@ def _page(title: str, body: str) -> bytes:
         f"<!doctype html><html><head><title>{html.escape(title)}</title>"
         f"<style>{_STYLE}</style></head><body><h1>{html.escape(title)}</h1>"
         f'<p><a href="/">← jobs</a> · <a href="/history">history</a> · '
-        f'<a href="/pool">pool</a> · '
+        f'<a href="/alerts">alerts</a> · <a href="/pool">pool</a> · '
         f'<a href="/metrics">metrics</a></p>{body}</body></html>'
     ).encode()
 
@@ -126,6 +127,8 @@ class PortalHandler(BaseHTTPRequestHandler):
                 )
             elif path == "/pool":
                 self._send(self._pool_page())
+            elif path == "/alerts":
+                self._send(self._alerts_page())
             elif path == "/history":
                 self._send(self._history_index())
             elif path.startswith("/history/"):
@@ -151,8 +154,21 @@ class PortalHandler(BaseHTTPRequestHandler):
                     self._send(self._job_logs(app_id))
                 elif len(parts) > 3 and parts[3] == "profile":
                     self._send(self._job_profile(app_id))
+                elif len(parts) > 3 and parts[3] == "goodput":
+                    self._send(self._job_goodput(app_id))
                 else:
                     self._send(self._job_detail(app_id))
+            elif path.startswith("/api/goodput/"):
+                app_id = path.split("/")[3]
+                self._send(
+                    json.dumps(self._goodput_payload(app_id)).encode(),
+                    ctype="application/json",
+                )
+            elif path == "/api/alerts":
+                self._send(
+                    json.dumps(self._fleet_alerts()).encode(),
+                    ctype="application/json",
+                )
             elif path.startswith("/api/logs/"):
                 app_id = path.split("/")[3]
                 self._send(
@@ -276,6 +292,56 @@ class PortalHandler(BaseHTTPRequestHandler):
             return []
         return self._art(app_id).profile_listing()
 
+    def _goodput_payload(self, app_id: str) -> dict:
+        """Phase ledger + live skew/alerts for one job — same resolution
+        `tony goodput` uses: artifacts for the ledger, the AM's
+        ``get_goodput`` RPC (best-effort) for the live extras."""
+        import time as _time
+
+        art = self._art(app_id)
+        events, _complete = art.read_events()
+        if not events:
+            return {"app_id": app_id, "error": "no history events"}
+        spans = obs_artifacts.load_spans(art.trace_dir)
+        ledger = obs_goodput.build_ledger(
+            app_id, events, spans, now_ms=int(_time.time() * 1000))
+        live = None
+        if ledger.live:
+            try:
+                got = self._am_call(app_id, "get_goodput")
+                live = got[0] if got else None
+            except Exception:  # noqa: BLE001 — AM gone: the ledger still answers
+                live = None
+        alert_events = [
+            {"state": ("fired" if ev.type.value == "ALERT_FIRED" else "resolved"),
+             "ts_ms": ev.timestamp_ms, **ev.payload}
+            for ev in events
+            if ev.type.value in ("ALERT_FIRED", "ALERT_RESOLVED")
+        ]
+        stragglers = obs_goodput.flagged_stragglers(events)
+        return {
+            **ledger.to_dict(),
+            "live_view": live,
+            "alert_events": alert_events,
+            "stragglers": (live or {}).get("stragglers") or stragglers,
+        }
+
+    def _fleet_alerts(self) -> list[dict]:
+        """Active alerts + flagged stragglers across every RUNNING job."""
+        out = []
+        for app_id in self._running_ids():
+            payload = self._goodput_payload(app_id)
+            live = payload.get("live_view") or {}
+            out.append({
+                "app_id": app_id,
+                "goodput_fraction": payload.get("goodput_fraction"),
+                "window_fraction": live.get("window_fraction"),
+                "active": live.get("alerts") or [],
+                "stragglers": payload.get("stragglers") or [],
+                "alert_events": payload.get("alert_events") or [],
+            })
+        return out
+
     def _store(self):
         """The history-server store behind the /history pages, or None (no
         store yet — run `tony history ingest` or the daemon). Opened per
@@ -292,6 +358,7 @@ class PortalHandler(BaseHTTPRequestHandler):
 
     #: cross-job trend charts on /history: (label, trend metric)
     _TRENDS = (
+        ("goodput", "goodput_fraction"),
         ("mfu (p50)", "mfu"),
         ("step_time_ms (p50)", "step_time_ms"),
         ("tokens_per_sec (p50)", "tokens_per_sec"),
@@ -319,6 +386,7 @@ class PortalHandler(BaseHTTPRequestHandler):
                 f'<td class="{html.escape(j["status"])}">{html.escape(j["status"])}'
                 f'{" (incomplete)" if j["incomplete"] else ""}</td>'
                 f'<td>{j["duration_ms"] / 1000.0:.1f}s</td>'
+                f'<td>{j.get("goodput_fraction", 0) or 0:.1%}</td>'
                 f'<td>{_hist_cell(j, "mfu")}</td>'
                 f'<td>{_hist_cell(j, "step_time_ms")}</td>'
                 f'<td>{j["queue_wait_s"]:.1f}s</td>'
@@ -332,7 +400,7 @@ class PortalHandler(BaseHTTPRequestHandler):
                 + (f"<h2>trends across runs</h2><p>{charts}</p>" if charts else "")
                 + "<h2>ingested jobs</h2>"
                 "<table><tr><th>application</th><th>status</th><th>duration</th>"
-                "<th>mfu p50</th><th>step ms p50</th><th>queue wait</th>"
+                "<th>goodput</th><th>mfu p50</th><th>step ms p50</th><th>queue wait</th>"
                 f"<th>epochs</th><th>resizes</th><th>takeovers</th></tr>{rows}</table>"
             )
             return _page("job history", body)
@@ -377,6 +445,111 @@ class PortalHandler(BaseHTTPRequestHandler):
             return _page(f"{app_id} history", body)
         finally:
             store.close()
+
+    def _job_goodput(self, app_id: str) -> bytes:
+        payload = self._goodput_payload(app_id)
+        if payload.get("error"):
+            return _page(f"{app_id} goodput",
+                         f"<p>{html.escape(payload['error'])}</p>")
+        wall = payload.get("wall_ms") or 0
+        phases = payload.get("phases_ms") or {}
+        rows = "".join(
+            f"<tr><td>{html.escape(ph)}</td><td>{phases[ph] / 1000.0:.2f}s</td>"
+            f"<td>{(phases[ph] / wall if wall else 0):.1%}</td></tr>"
+            for ph in obs_goodput.PHASE_ORDER if phases.get(ph)
+        )
+        skew = payload.get("skew_by_task") or {}
+        live = payload.get("live_view") or {}
+        if live.get("skew"):
+            skew = live["skew"]
+        stragglers = set(payload.get("stragglers") or [])
+        skew_rows = "".join(
+            f"<tr><td>{html.escape(t)}</td><td>{r:.2f}x</td>"
+            f"<td>{'STRAGGLER' if t in stragglers else ''}</td></tr>"
+            for t, r in sorted(skew.items())
+        )
+        arow = "".join(
+            f"<tr><td>{e['ts_ms']}</td><td class=\"{'FAILED' if e['state'] == 'fired' else 'SUCCEEDED'}\">"
+            f"{e['state']}</td><td>{html.escape(str(e.get('rule', '')))}</td>"
+            f"<td>{e.get('value', '')}</td><td>{e.get('threshold', '')}</td></tr>"
+            for e in payload.get("alert_events") or []
+        )
+        body = (
+            f"<p>goodput <b>{payload.get('goodput_fraction', 0):.1%}</b> of "
+            f"{wall / 1000.0:.1f}s wall"
+            + (f" · trailing window {live['window_fraction']:.1%}"
+               if live.get("window_fraction") is not None else "")
+            + f" · {payload.get('restarts', 0)} restart(s)"
+              f" · {payload.get('resizes', 0)} resize(s)"
+              f" · {payload.get('takeovers', 0)} takeover(s)"
+            + f' · <a href="/api/goodput/{html.escape(app_id)}">json</a></p>'
+            "<h2>phase ledger</h2>"
+            f"<table><tr><th>phase</th><th>time</th><th>share</th></tr>{rows}</table>"
+            + (f"<h2>per-rank skew</h2><table><tr><th>task</th><th>vs median"
+               f"</th><th></th></tr>{skew_rows}</table>" if skew_rows else "")
+            + (f"<h2>alert transitions</h2><table><tr><th>ts</th><th>state</th>"
+               f"<th>rule</th><th>value</th><th>threshold</th></tr>{arow}</table>"
+               if arow else "")
+        )
+        return _page(f"{app_id} goodput", body)
+
+    def _alerts_page(self) -> bytes:
+        entries = self._fleet_alerts()
+        blocks = []
+        for e in entries:
+            active = e["active"]
+            rows = "".join(
+                f"<tr><td class=\"FAILED\">firing</td>"
+                f"<td>{html.escape(str(a.get('rule', '')))}</td>"
+                f"<td>{a.get('value', '')}</td><td>{a.get('threshold', '')}</td></tr>"
+                for a in active
+            ) + "".join(
+                f"<tr><td>{ev['state']}</td><td>{html.escape(str(ev.get('rule', '')))}</td>"
+                f"<td>{ev.get('value', '')}</td><td>{ev.get('threshold', '')}</td></tr>"
+                for ev in e["alert_events"]
+                if ev["state"] == "resolved"
+            )
+            stragglers = ", ".join(map(html.escape, e["stragglers"])) or "none"
+            gp = e.get("window_fraction")
+            gp = e.get("goodput_fraction") if gp is None else gp
+            blocks.append(
+                f'<h2><a href="/job/{html.escape(e["app_id"])}/goodput">'
+                f'{html.escape(e["app_id"])}</a>'
+                + (f" — goodput {gp:.1%}" if gp is not None else "")
+                + (' — <b class="FAILED">ALERTING</b>' if active else "")
+                + f"</h2><p>stragglers: {stragglers}</p>"
+                + (f"<table><tr><th>state</th><th>rule</th><th>value</th>"
+                   f"<th>threshold</th></tr>{rows}</table>" if rows else
+                   "<p>no alert activity</p>")
+            )
+        if not blocks:
+            blocks.append("<p>no running jobs</p>")
+        # finalized jobs with alert history, from the ingested store: the
+        # fleet page answers "what alerted recently" even after the AMs died
+        store = self._store()
+        if store is not None:
+            try:
+                rows = []
+                for j in store.list_jobs(limit=100):
+                    hist = (j.get("summary") or {}).get("alerts") or []
+                    for h in hist:
+                        rows.append(
+                            f'<tr><td><a href="/history/{html.escape(j["app_id"])}">'
+                            f'{html.escape(j["app_id"])}</a></td>'
+                            f"<td>{h.get('ts_ms', '')}</td>"
+                            f"<td class=\"{'FAILED' if h.get('state') == 'fired' else 'SUCCEEDED'}\">"
+                            f"{html.escape(str(h.get('state', '')))}</td>"
+                            f"<td>{html.escape(str(h.get('rule', '')))}</td>"
+                            f"<td>{h.get('value', '')}</td></tr>")
+                if rows:
+                    blocks.append(
+                        "<h2>finalized jobs with alert history</h2>"
+                        "<table><tr><th>application</th><th>ts</th><th>state</th>"
+                        "<th>rule</th><th>value</th></tr>" + "".join(rows) + "</table>")
+            finally:
+                store.close()
+        return _page("fleet alerts", '<p><a href="/api/alerts">json</a></p>'
+                     + "".join(blocks))
 
     def _job_logs(self, app_id: str) -> bytes:
         records = self._log_records(app_id)
@@ -526,6 +699,7 @@ class PortalHandler(BaseHTTPRequestHandler):
             f'<p><a href="/job/{app_id}/config">frozen config</a>'
             f' · <a href="/job/{app_id}/logs">logs</a>'
             f' · <a href="/job/{app_id}/profile">profile artifacts</a>'
+            f' · <a href="/job/{app_id}/goodput">goodput</a>'
             # a finalized job's story continues in the history store — link
             # the entry instead of leaving a dead-AM scrape as the only view
             + (f' · <a href="/history/{app_id}">history entry</a>' if not live else "")
