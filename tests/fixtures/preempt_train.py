@@ -1,0 +1,88 @@
+"""Fixture: cooperative-preemption victim (checkpoint-then-yield headline).
+
+A small gang that trains "forever" on attempt 0 (a 50x step budget — it
+exists to BE preempted) and to the target step count once resumed. Rank 0
+runs a real Orbax train state through ``restore_or_init`` with NO periodic
+checkpoints — the only mid-run save is the urgent one the pool's drain
+triggers through :class:`tony_tpu.train.checkpoint.UrgentSaveSignal` (the
+exact class the production train loop polls), so the resumed step PROVES
+whether the eviction was cooperative:
+
+- drain path: resume step == the urgent checkpoint (> 0);
+- kill path (drain-ms 0): resume step == 0, and the whole first attempt is
+  the ``restart_rework`` the goodput ledger must meter.
+
+Non-checkpointing ranks acknowledge the drain with their current step (their
+state lives in rank 0's checkpoint), so the AM's all-ranks yield gate is
+exercised at world > 1 too.
+
+Every rank publishes its step to $TONY_TRAIN_METRICS_FILE each tick (the
+piggyback the AM snapshots into the .jhist — the rework derivation reads
+exactly these), and rank 0 publishes its resume step to
+``<shared>/resume-<attempt>.json`` for the test's assertions.
+
+Usage: preempt_train.py <shared_dir> <steps> <step_ms>
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+from tony_tpu import constants  # noqa: E402
+from tony_tpu.train.checkpoint import UrgentSaveSignal, restore_or_init  # noqa: E402
+
+shared, target_steps, step_ms = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+attempt = int(os.environ.get("TONY_RESTART_ATTEMPT", "0"))
+rank = int(os.environ[constants.ENV_TASK_INDEX])
+os.makedirs(shared, exist_ok=True)
+ckpt_dir = os.path.join(shared, "ckpt")
+metrics_file = os.environ.get(constants.ENV_TRAIN_METRICS_FILE)
+
+# attempt 0 exists to be preempted; resumed attempts finish the job
+steps = target_steps * 50 if attempt == 0 else target_steps
+
+
+def publish(path, obj):
+    tmp = f"{path}.tmp{rank}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+if rank == 0:
+    state, mgr, start = restore_or_init(
+        ckpt_dir, lambda: {"w": np.zeros(4, np.float64)}, use_async=False)
+    if start:
+        print(f"[train] resumed from checkpoint step {start}", flush=True)
+    publish(os.path.join(shared, f"resume-{attempt}.json"), {"step": start})
+else:
+    state, mgr, start = None, None, 0
+
+urgent = UrgentSaveSignal()
+for t in range(start, steps):
+    time.sleep(step_ms / 1000.0)
+    if rank == 0:
+        state["w"] = state["w"] * 0.9 + 0.1 * (t + 1)
+    if metrics_file:
+        publish(metrics_file, {"step": t + 1, "loss": round(1.0 / (t + 1), 4)})
+    publish(os.path.join(shared, f"step-r{rank}.json"), {"step": t + 1})
+    req = urgent.poll()
+    if req is not None:
+        if mgr is not None:
+            # the urgent pre-preemption save: the ONLY mid-run checkpoint
+            mgr.save(t + 1, state, force=True)
+            mgr.wait()
+            print(f"[train] urgent checkpoint at step {t + 1}", flush=True)
+        urgent.acknowledge(req, t + 1)
+
+if mgr is not None:
+    mgr.close()
+print(f"preempt_train attempt {attempt} rank {rank} finished at step {steps}", flush=True)
+sys.exit(0)

@@ -79,6 +79,16 @@ def verify_chaos_run(handle, config: TonyConfig) -> tuple[list[str], dict[str, A
                 completes_this_epoch = 0
             elif ev.type.value == "GANG_RESIZED" and not ev.payload.get("rejected"):
                 resizes.append(ev.payload)
+            elif ev.type.value == "PREEMPTION_REQUESTED":
+                info["preempt_requested"] = info.get("preempt_requested", 0) + 1
+            elif ev.type.value == "PREEMPTION_YIELDED":
+                info["preempt_yielded"] = info.get("preempt_yielded", 0) + 1
+                saved = ev.payload.get("saved_steps") or {}
+                if ev.payload.get("cooperative") and saved:
+                    info.setdefault("preempt_saved_steps", {}).update(
+                        {str(k): int(v) for k, v in saved.items()})
+            elif ev.type.value == "PREEMPTION_ESCALATED":
+                info["preempt_escalated"] = info.get("preempt_escalated", 0) + 1
             elif ev.type.value == "AM_TAKEOVER":
                 takeovers += 1
             elif ev.type.value == "AM_TAKEOVER_DEGRADED":
@@ -183,6 +193,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="fail unless a relaunched AM ADOPTED the live gang "
                         "(work-preserving takeover) and no takeover degraded "
                         "to a full restart")
+    p.add_argument("--expect-preempt-drain", action="store_true",
+                   help="fail unless a pool preemption drained cooperatively: "
+                        "the victim urgent-checkpointed (PREEMPTION_YIELDED "
+                        "with saved steps) BEFORE dying, and nothing escalated "
+                        "to the kill path")
     args = p.parse_args(argv)
 
     expect_resize: tuple[str, int] | None = None
@@ -240,6 +255,23 @@ def main(argv: list[str] | None = None) -> int:
             failures.append(
                 f"--expect-takeover: {info['takeovers_degraded']} takeover(s) "
                 "degraded to a full gang restart")
+    if info.get("preempt_requested"):
+        print(f"[tony-chaos] pool preemptions: {info['preempt_requested']} "
+              f"requested, {info.get('preempt_yielded', 0)} yielded, "
+              f"{info.get('preempt_escalated', 0)} escalated"
+              + (f"; urgent checkpoints at {info['preempt_saved_steps']}"
+                 if info.get("preempt_saved_steps") else ""))
+    if args.expect_preempt_drain:
+        if not info.get("preempt_requested"):
+            failures.append("--expect-preempt-drain: the pool never requested a drain")
+        elif not info.get("preempt_saved_steps"):
+            failures.append(
+                "--expect-preempt-drain: no victim urgent-checkpointed before "
+                "yielding (PREEMPTION_YIELDED carried no saved steps)")
+        if info.get("preempt_escalated"):
+            failures.append(
+                f"--expect-preempt-drain: {info['preempt_escalated']} "
+                "preemption(s) escalated to the kill path")
     for rz in info.get("resizes") or []:
         print(f"[tony-chaos] gang resized: {rz.get('resized')} "
               f"(trigger={rz.get('trigger', '?')}, now {rz.get('instances')})")

@@ -112,6 +112,12 @@ def _cmd_goodput(argv: list[str]) -> int:
     return goodput_main(argv)
 
 
+def _cmd_sim(argv: list[str]) -> int:
+    from tony_tpu.cli.sim import main as sim_main
+
+    return sim_main(argv)
+
+
 def _cmd_mini(argv: list[str]) -> int:
     """Self-contained sandbox: submit a smoke gang against the local resource
     manager and print the verdict + history location.
@@ -197,6 +203,16 @@ def _cmd_pool(argv: list[str]) -> int:
     p.add_argument("--preemption-grace-ms", type=int, default=0,
                    help="wait this long before cross-queue reclaim evicts borrowers "
                         "(tony.pool.preemption.grace-ms)")
+    p.add_argument("--preemption-drain-ms", type=int, default=0,
+                   help="cooperative drain window before eviction kills fire — the "
+                        "victim checkpoints and yields inside it "
+                        "(tony.pool.preemption.drain-ms; 0 = immediate kill)")
+    p.add_argument("--preemption-min-runtime-ms", type=int, default=0,
+                   help="a just-admitted app is not evictable for this long "
+                        "(tony.pool.preemption.min-runtime-ms)")
+    p.add_argument("--preemption-budget", type=int, default=0,
+                   help="max evictions/shrinks a queue may cause per window "
+                        "(tony.pool.preemption.budget; 0 = unlimited)")
     p.add_argument("--journal-file", default="",
                    help="recovery journal (tony.pool.journal.file): a restarted "
                         "pool replays it and re-adopts live work instead of "
@@ -220,6 +236,9 @@ def _cmd_pool(argv: list[str]) -> int:
                       queues=parse_queue_spec(args.queues),
                       preemption=args.preemption,
                       preemption_grace_ms=args.preemption_grace_ms,
+                      preemption_drain_ms=args.preemption_drain_ms,
+                      preemption_min_runtime_ms=args.preemption_min_runtime_ms,
+                      preemption_budget=args.preemption_budget,
                       journal_path=args.journal_file or None)
     svc.start()
     host, port = svc.address
@@ -296,13 +315,14 @@ _COMMANDS = {
     "top": _cmd_top,
     "resize": _cmd_resize,
     "goodput": _cmd_goodput,
+    "sim": _cmd_sim,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|history-server|bench|portal|notebook|serve|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput} [options]\n")
+        print("usage: tony {submit|pool|history|history-server|bench|portal|notebook|serve|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput|sim} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    query the persistent history tier (list|show|compare|ingest|gc)")
@@ -321,6 +341,7 @@ def main(argv: list[str] | None = None) -> int:
         print("  top        refreshing live status view (per-task state, step rate, heartbeat age)")
         print("  resize     retarget a RUNNING job's per-type instance count (elastic rebuild)")
         print("  goodput    exact goodput/badput phase accounting + straggler skew + alert history")
+        print("  sim        replay seeded synthetic arrivals against the live scheduler policy (invariant check)")
         return 0
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:

@@ -144,6 +144,16 @@ def summarize(spans: list[dict[str, Any]]) -> str:
         )
         item("resize episodes", f"{sum(_dur_s(s) for s in resizes):.2f}s "
                                 f"over {len(resizes)} ({moves})")
+    drains = by_name.get("am.preempt_drain", [])
+    if drains:
+        kinds = "; ".join(
+            f"{(s.get('attrs') or {}).get('mode', '?')}"
+            + ("" if (s.get("attrs") or {}).get("cooperative") else " (escalation risk)")
+            for s in drains
+        )
+        item("preemption drains",
+             f"{sum(_dur_s(s) for s in drains):.2f}s over {len(drains)} "
+             f"episode(s) ({kinds})")
     takeovers = by_name.get("am.takeover", [])
     if takeovers:
         item("AM takeovers",

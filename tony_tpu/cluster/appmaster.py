@@ -315,6 +315,12 @@ class ApplicationMaster:
         # on-demand profiler capture (tony profile): single-slot request
         # state machine, internally locked — RPC handler threads race on it
         self._profile = obs_introspect.ProfileCoordinator()
+        # cooperative preemption (docs/scheduling.md): the pool's drain /
+        # shrink notice and this AM's response to it — urgent-checkpoint
+        # fan-out over the heartbeat piggyback, then yield. Guarded by
+        # _epoch_lock: heartbeat/report handler threads race the monitor loop.
+        self._drain: dict[str, Any] | None = None
+        self._drain_handled: set[str] = set()  # req_ids already acted on
         # goodput accounting plane (tony.goodput.*): the monitor loop's
         # throttled tick classifies wall-time, watches for stragglers, and
         # evaluates the declarative tony.alerts.* rules
@@ -485,7 +491,39 @@ class ApplicationMaster:
         profile = self._profile.pending_for(f"{job_name}:{index}")
         if profile is not None:
             resp["profile"] = profile
+        with self._epoch_lock:
+            drain = self._drain
+            tid = f"{job_name}:{index}"
+            if (
+                drain is not None
+                and tid in drain["targets"]  # only the captured target set:
+                # a task appearing mid-drain (promoted spare, untracked
+                # sidecar) is not waited on and must not pay a forced save
+                and tid not in drain["acks"]
+            ):
+                # urgent-checkpoint fan-out: re-sent until the task's saved
+                # step is reported (the courier dedups by req_id)
+                resp["drain"] = {"req_id": drain["req_id"]}
         return resp
+
+    def report_drain_saved(
+        self, job_name: str, index: int, req_id: str, step: int = 0, attempt: int = 0
+    ) -> dict[str, Any]:
+        """A task's urgent pre-preemption checkpoint landed (drain courier):
+        record which step is safe. The monitor loop yields the gang once
+        every live tracked task has reported (or at the drain margin)."""
+        if self._fenced_session(attempt) is None:
+            return {"ack": False, "stale": True}
+        with self._epoch_lock:
+            drain = self._drain
+            tid = f"{job_name}:{index}"
+            if drain is None or drain["req_id"] != req_id or tid not in drain["targets"]:
+                return {"ack": False}
+            drain["acks"][tid] = int(step)
+        obs_logging.info(
+            f"[tony-am] {job_name}:{index} urgent-checkpointed step {step} "
+            f"for preemption {req_id}")
+        return {"ack": True}
 
     def get_task_infos(self) -> list[dict[str, Any]]:
         return self.session.task_infos()
@@ -617,6 +655,27 @@ class ApplicationMaster:
 
     def _elastic_jobtype(self) -> str:
         return self.config.get(keys.ELASTIC_JOBTYPE) or constants.WORKER_JOB_NAME
+
+    def _register_with_pool(self) -> None:
+        """Announce queue/priority/whole-gang demand to the pool, plus the
+        elastic partial-reclaim contract (what one shed worker frees and how
+        many the gang may shed) so the pool can ask this job to SHRINK
+        instead of whole-gang-evicting it under reclaim pressure."""
+        unit, slack = None, 0
+        if self.config.get_bool(keys.ELASTIC_SHRINK_ON_PREEMPT):
+            et = self._elastic_jobtype()
+            plan = self.scheduler.plans.get(et)
+            floor = self._elastic_floors().get(et, 0)
+            if plan is not None and floor >= 1:
+                unit = plan.resources
+                slack = max(self._effective_config().instances(et) - floor, 0)
+        self.rm.register_app(
+            queue=self.config.get(keys.APPLICATION_QUEUE) or "default",
+            priority=self.config.get_int(keys.APPLICATION_PRIORITY, 0),
+            demand=self.scheduler.total_demand(),
+            elastic_unit=unit,
+            elastic_slack=slack,
+        )
 
     def _elastic_floors(self) -> dict[str, int]:
         """Per-type shrink floors: ``tony.<type>.min-instances`` merged with
@@ -854,11 +913,7 @@ class ApplicationMaster:
         # when capacity is short instead of failing the job. After a takeover
         # this re-registers the (possibly resized) demand under the same app
         # id — the pool's claims carry over with the live containers.
-        self.rm.register_app(
-            queue=self.config.get(keys.APPLICATION_QUEUE) or "default",
-            priority=self.config.get_int(keys.APPLICATION_PRIORITY, 0),
-            demand=self.scheduler.total_demand(),
-        )
+        self._register_with_pool()
         if not adopted:
             # fresh gang epoch (initial start, or degraded takeover): every
             # journal record before this one is obsolete for future replays.
@@ -1343,11 +1398,7 @@ class ApplicationMaster:
             )
             # resized demand re-registers with the pool so queue admission
             # evaluates the gang the AM will actually ask for
-            self.rm.register_app(
-                queue=self.config.get(keys.APPLICATION_QUEUE) or "default",
-                priority=self.config.get_int(keys.APPLICATION_PRIORITY, 0),
-                demand=self.scheduler.total_demand(),
-            )
+            self._register_with_pool()
 
     def _resize_while_queued(
         self, resize: dict[str, int], reason: str, trigger: str = "capacity"
@@ -1447,6 +1498,150 @@ class ApplicationMaster:
         if target is None:
             return None
         return {et: target}
+
+    # -------------------------------------------- cooperative preemption
+    def _plan_drain_shrink(self, workers: int) -> dict[str, int] | None:
+        """The pool asked this job to shed ``workers`` elastic workers
+        (partial reclaim): the divisor-preserving target the survivors
+        re-form at (same rule as shrink-on-preempt — batch/mesh divisibility
+        must survive), or None when the ask cannot be honored (elasticity
+        off, floor too high) and the pool should escalate."""
+        if not self.config.get_bool(keys.ELASTIC_SHRINK_ON_PREEMPT):
+            return None
+        et = self._elastic_jobtype()
+        cfg = self._effective_config()
+        if et not in cfg.job_types():
+            return None
+        current = cfg.instances(et)
+        floor = self._elastic_floors().get(et, 0)
+        target = plan_preempt_shrink(current, current, max(int(workers), 1), floor)
+        if target is None:
+            return None
+        return {et: target}
+
+    def _poll_preemption_notice(self) -> None:
+        """Read the pool's cooperative-preemption piggyback (rode the
+        ``poll_exited`` the monitor loop just made) and open a drain episode:
+        emit PREEMPTION_REQUESTED and start the urgent-checkpoint fan-out
+        over the heartbeat responses."""
+        notice = self.rm.poll_preemption()
+        if not notice:
+            return
+        cancelled = notice.get("cancelled")
+        if cancelled:
+            hit = False
+            with self._epoch_lock:
+                if self._drain is not None and self._drain["req_id"] == cancelled:
+                    self._drain = None
+                    hit = True
+            if hit:
+                # the terminating event matters beyond logging: it closes
+                # the goodput ledger's preempt_drain window — without it
+                # everything after the cancellation would classify as drain
+                self.events.emit(
+                    EventType.PREEMPTION_CANCELLED, req_id=cancelled)
+                obs_logging.info(
+                    f"[tony-am] preemption {cancelled} cancelled by the pool "
+                    "(re-admitted before yielding) — resuming normally")
+            return
+        req_id = str(notice.get("req_id") or "")
+        if not req_id or req_id in self._drain_handled:
+            return
+        with self._epoch_lock:
+            if self._drain is not None:
+                return  # one episode at a time; the pool re-sends until resolved
+        mode = str(notice.get("mode") or "drain")
+        deadline_s = max(int(notice.get("deadline_ms") or 0), 0) / 1000
+        shrink_workers = int(notice.get("shrink_workers") or 0)
+        resize = self._plan_drain_shrink(shrink_workers) if mode == "shrink" else None
+        untracked = self.session.untracked
+        targets = {
+            f"{i['name']}:{i['index']}"
+            for i in self.session.task_infos()
+            if i["name"] not in untracked
+            and i["status"] in (TaskStatus.REGISTERED.value, TaskStatus.RUNNING.value)
+        }
+        # yield early enough that the release beats the pool's kill deadline:
+        # two heartbeats of margin (the fan-out and the ack each ride one)
+        hb_s = self.config.get_time_ms(keys.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000
+        now = time.monotonic()
+        with self._epoch_lock:
+            self._drain = {
+                "req_id": req_id, "mode": mode, "resize": resize,
+                "workers": shrink_workers, "targets": targets, "acks": {},
+                "t0": now,
+                "yield_by": now + max(deadline_s - 2 * hb_s, deadline_s * 0.5),
+                "done": False,
+            }
+        self._drain_handled.add(req_id)
+        self.events.emit(
+            EventType.PREEMPTION_REQUESTED,
+            req_id=req_id, mode=mode, deadline_ms=int(deadline_s * 1000),
+            shrink_workers=shrink_workers,
+            resize=resize, targets=sorted(targets),
+        )
+        obs_logging.warning(
+            f"[tony-am] pool preemption {req_id}: {mode}"
+            + (f" {shrink_workers} worker(s) → {resize}" if mode == "shrink" else "")
+            + f", deadline {deadline_s:.1f}s — urgent-checkpointing "
+            f"{len(targets)} task(s)")
+
+    def _drive_drain(self) -> None:
+        """Yield once every targeted task's urgent checkpoint landed (or at
+        the margin before the pool's kill deadline): emit PREEMPTION_YIELDED
+        with the saved steps and release the gang — a budget-exempt restart
+        that re-queues through admission (drain) or re-forms the survivors
+        at the shrunken size (shrink)."""
+        with self._epoch_lock:
+            drain = self._drain
+            if drain is None or drain["done"]:
+                return
+            now = time.monotonic()
+            cooperative = drain["targets"] <= set(drain["acks"])
+            if not cooperative and now < drain["yield_by"]:
+                return
+            if drain["mode"] == "shrink" and drain["resize"] is None:
+                # cannot honor the shrink (divisor/floor says no): the
+                # checkpoints are fresh, but the decision is the pool's —
+                # hold and let the deadline escalate to a whole-gang evict
+                drain["done"] = True
+                obs_logging.warning(
+                    f"[tony-am] cannot shed {drain['workers']} worker(s) "
+                    "(no divisor target above the elastic floor) — awaiting "
+                    "pool escalation with checkpoints fresh")
+                return
+            self._drain = None
+        waited_s = now - drain["t0"]
+        if self.tracer is not None:
+            # the drain episode as one backdated span (same reconstruction
+            # as am.queue_wait) so `tony trace` puts it on the timeline
+            with self.tracer.span("am.preempt_drain") as sp:
+                sp.start_ms -= waited_s * 1000.0
+                sp.set(mode=drain["mode"], cooperative=cooperative,
+                       req_id=drain["req_id"])
+        self.events.emit(
+            EventType.PREEMPTION_YIELDED,
+            req_id=drain["req_id"], mode=drain["mode"],
+            cooperative=cooperative, saved_steps=drain["acks"],
+            waited_ms=int(waited_s * 1000),
+        )
+        progress = (
+            "all" if cooperative else f"{len(drain['acks'])}/{len(drain['targets'])}"
+        )
+        obs_logging.warning(
+            f"[tony-am] yielding to preemption {drain['req_id']} "
+            f"({progress} task(s) checkpointed in {waited_s:.1f}s)")
+        if drain["mode"] == "shrink":
+            self._maybe_restart_gang(
+                f"pool partial reclaim: shedding to {drain['resize']}",
+                exit_code=constants.EXIT_PREEMPTED,
+                resize=drain["resize"], trigger="preempt",
+            )
+        else:
+            self._maybe_restart_gang(
+                f"preempted (cooperative drain {drain['req_id']})",
+                exit_code=constants.EXIT_PREEMPTED,
+            )
 
     def _maintain_spares(self) -> None:
         """Keep ``tony.elastic.spares`` parked executors of the elastic type
@@ -1571,6 +1766,10 @@ class ApplicationMaster:
             announce = bool(resize)
             reason = f"capacity lost: {reason}"
         with self._epoch_lock:  # atomic with _fenced_session's capture
+            # whatever drove this restart, the old gang's drain episode is
+            # over: its acks reference tasks that no longer exist, and a
+            # stale episode must not yield the NEW gang later
+            self._drain = None
             old_cfg = self._effective_config()
             old = {t: old_cfg.instances(t) for t in (resize or {})}
             if resize:
@@ -1697,6 +1896,12 @@ class ApplicationMaster:
             # 2. container exits (catches silent executor death)
             self._handle_container_exits()
 
+            # 2a. cooperative preemption: drain/shrink notices piggyback on
+            # the poll above; urgent-checkpoint then yield inside the
+            # pool's deadline (docs/scheduling.md state machine)
+            self._poll_preemption_notice()
+            self._drive_drain()
+
             # 2b. periodic METRICS_SNAPSHOT into the .jhist: executors push
             # metrics over RPC onto TaskInfo; snapshotting them into the
             # event stream gives the portal (live view + finished-job
@@ -1758,6 +1963,21 @@ class ApplicationMaster:
             if failed is not None:
                 resize, trigger = None, "capacity"
                 if failed.exit_code == constants.EXIT_PREEMPTED:
+                    with self._epoch_lock:
+                        drain, self._drain = self._drain, None
+                    if drain is not None:
+                        # the pool killed us before (or while) we yielded:
+                        # record the escalation — the urgent checkpoints that
+                        # DID land still bound the rework
+                        self.events.emit(
+                            EventType.PREEMPTION_ESCALATED,
+                            req_id=drain["req_id"], mode=drain["mode"],
+                            saved_steps=drain["acks"],
+                        )
+                        obs_logging.warning(
+                            f"[tony-am] preemption {drain['req_id']} escalated "
+                            f"by the pool ({len(drain['acks'])}/"
+                            f"{len(drain['targets'])} task(s) had checkpointed)")
                     resize = self._plan_preempt_shrink()
                     if resize:
                         trigger = "preempt"

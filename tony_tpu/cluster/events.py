@@ -34,6 +34,15 @@ class EventType(enum.Enum):
     AM_TAKEOVER_DEGRADED = "AM_TAKEOVER_DEGRADED"  # journal missing/corrupt → full gang restart fallback
     TASK_RESYNCED = "TASK_RESYNCED"                # executor re-attached to a takeover AM's refreshed endpoint
     QUEUE_WAIT = "QUEUE_WAIT"
+    # cooperative preemption (docs/scheduling.md): the pool asked this job to
+    # drain (checkpoint-then-yield) or shrink; YIELDED records the urgent
+    # checkpoint + voluntary teardown, ESCALATED records the pool killing a
+    # victim that missed the drain deadline, CANCELLED records the pool
+    # withdrawing the request (victim re-admitted before yielding)
+    PREEMPTION_REQUESTED = "PREEMPTION_REQUESTED"
+    PREEMPTION_YIELDED = "PREEMPTION_YIELDED"
+    PREEMPTION_ESCALATED = "PREEMPTION_ESCALATED"
+    PREEMPTION_CANCELLED = "PREEMPTION_CANCELLED"
     GANG_COMPLETE = "GANG_COMPLETE"
     GANG_RESIZED = "GANG_RESIZED"
     SPARE_READY = "SPARE_READY"        # hot-spare executor pre-registered with the AM

@@ -111,6 +111,10 @@ class TaskExecutor:
         self._profile_courier = obs_introspect.ProfileCourier(
             self.staging_dir, self.job_name, self.index, self._report_profile
         )
+        # cooperative-preemption relay (docs/scheduling.md): urgent-checkpoint
+        # request out to the child, saved-step report back — same
+        # heartbeat-driven control/done file contract as the profile courier
+        self._drain_courier = obs_introspect.DrainCourier(self._report_drain)
 
     # -- AM endpoint re-resolution (work-preserving takeover) ---------------
     def _read_am_info(self) -> tuple[str, int, str] | None:
@@ -244,6 +248,7 @@ class TaskExecutor:
         self._profile_courier = obs_introspect.ProfileCourier(
             self.staging_dir, self.job_name, self.index, self._report_profile
         )
+        self._drain_courier = obs_introspect.DrainCourier(self._report_drain)
         lg = obs_logging.get()
         if lg is not None:
             lg.identity = f"{self.job_name}:{self.index}"
@@ -437,6 +442,8 @@ class TaskExecutor:
                 path + ".obs",
                 path + obs_introspect.CONTROL_SUFFIX,
                 path + obs_introspect.DONE_SUFFIX,
+                path + obs_introspect.DRAIN_CONTROL_SUFFIX,
+                path + obs_introspect.DRAIN_DONE_SUFFIX,
             ):
                 try:
                     os.unlink(stale)
@@ -478,6 +485,10 @@ class TaskExecutor:
                 # request to the child / report its done record back
                 self._profile_courier.handle(
                     resp.get("profile") if isinstance(resp, dict) else None,
+                    getattr(self, "_train_metrics_path", None),
+                )
+                self._drain_courier.handle(
+                    resp.get("drain") if isinstance(resp, dict) else None,
                     getattr(self, "_train_metrics_path", None),
                 )
             except (RpcError, OSError):
@@ -534,6 +545,18 @@ class TaskExecutor:
         marking the request reported."""
         self.rpc.call(
             "report_profile_status",
+            job_name=self.job_name,
+            index=self.index,
+            attempt=self.attempt,
+            **params,
+        )
+
+    def _report_drain(self, **params) -> None:
+        """Drain-courier callback: the child's urgent checkpoint landed —
+        tell the AM which step is safe so it can yield. Raises on RPC
+        failure so the courier retries on a later heartbeat."""
+        self.rpc.call(
+            "report_drain_saved",
             job_name=self.job_name,
             index=self.index,
             attempt=self.attempt,

@@ -1,0 +1,497 @@
+"""Pure admission/preemption policy — the capacity scheduler's brain.
+
+This module is the SINGLE implementation of the pool's multi-tenant
+scheduling decision (admission, same-queue priority preemption, cross-queue
+capacity reclaim, shrink-based partial reclaim, and the anti-thrash guards).
+It is deliberately pure: no locks, no journal, no metrics, no RPC — just
+application views in, a :class:`Decision` out — and the clock is injected,
+so the exact code the live ``PoolService`` (cluster/pool.py) runs is also
+driven by the ``tony sim`` discrete-event simulator (cluster/sim.py) over
+thousands of seeded synthetic arrivals. The fairness/starvation/eviction
+invariants the simulator asserts therefore hold for the production policy
+by construction, not by analogy — the same pattern chaos engineering used
+to make gang recovery provable (docs/scheduling.md).
+
+Semantics carried over from the original in-pool implementation:
+
+- **Claims-based admission**: an admitted app reserves elementwise
+  ``max(demand, held)``, so admission is all-or-nothing at GANG granularity
+  and two half-allocated gangs can never deadlock each other.
+- **Within a queue**: priority desc, then FIFO. **Across queues**: least
+  relative usage (claim/share) first. A queue may borrow beyond its share
+  while no other queue has waiters, and every queue may always run at least
+  one app (no share-induced starvation).
+- **Same-queue priority preemption**: a waiting head may evict
+  strictly-lower-priority admitted apps from its OWN queue; the evict+admit
+  is atomic so the freed claims can never leak to another queue's head.
+- **Cross-queue reclaim**: an under-share head may reclaim from queues that
+  borrowed beyond their share — shrinking elastic borrowers by K workers
+  first (partial reclaim), whole-gang-evicting only when shrink cannot free
+  enough; eviction stops the moment a victim queue is no longer over its
+  share; a queue at or under its share is never touched.
+
+New here (the cooperative-preemption guards, docs/scheduling.md):
+
+- **Minimum-runtime protection** (``min_runtime_ms``): a just-admitted app
+  is not evictable (or shrinkable) until it has run for the window —
+  B-evicts-A-then-A-evicts-B ping-pong is structurally impossible because
+  the re-admitted app is protected exactly when its evictor is freshly
+  admitted too.
+- **Per-queue preemption budget** (``eviction_budget`` per
+  ``budget_window_ms``): a queue may CAUSE at most this many
+  evictions/shrinks per rolling window; an exhausted aggressor queue's
+  heads simply wait for free capacity like anyone else.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+Vec = tuple[int, int, int]  # (memory_bytes, vcores, chips)
+
+
+def validate_queue_shares(queues: dict[str, float]) -> None:
+    """Shares are GUARANTEES — they cannot oversubscribe the pool. YARN's
+    capacity scheduler rejects capacities that don't fit 100% for the same
+    reason: with prod=0.9,dev=0.9 the over-share gate almost never fires and
+    the operator's 'guarantee' silently degrades to FIFO."""
+    bad = [(q, f) for q, f in queues.items() if not 0 < f <= 1]
+    if bad:
+        raise ValueError(f"queue shares must each be in (0, 1]: {bad}")
+    total = sum(queues.values())
+    if total > 1.0 + 1e-9:
+        raise ValueError(
+            f"queue shares sum to {total:g} > 1 — guarantees would "
+            f"oversubscribe the pool: {queues}"
+        )
+
+
+@dataclass
+class AppView:
+    """One tenant application as the policy sees it.
+
+    The live pool builds these fresh each scheduling pass from its canonical
+    records; the simulator keeps them AS its canonical records. The policy
+    mutates the views in place exactly as the decision it returns should be
+    applied (``admitted``/``preempted`` flips, shrink-reduced ``demand``),
+    so a simulator needs no second application step.
+    """
+
+    app_id: str
+    queue: str
+    priority: int = 0
+    seq: int = 0
+    demand: Vec = (0, 0, 0)
+    held: Vec = (0, 0, 0)
+    admitted: bool = False
+    preempted: bool = False    # demoted by preemption; re-queues via allocate
+    #: when this app last STARTED waiting (policy-clock seconds) — the
+    #: cross-queue reclaim grace is measured from here
+    wait_since: float = 0.0
+    #: when this app was last admitted (policy-clock seconds) — the
+    #: minimum-runtime protection is measured from here
+    admitted_at: float = 0.0
+    #: resources one shed worker of the elastic jobtype frees (zero vector →
+    #: the app is not elastically shrinkable)
+    elastic_unit: Vec = (0, 0, 0)
+    #: how many workers the app may shed (current - elastic floor)
+    elastic_slack: int = 0
+    #: a shrink was requested and has not yet been shed: the app is excluded
+    #: from further preemption until it resolves (or escalates)
+    shrink_pending: bool = False
+
+    @property
+    def sort_key(self) -> tuple[int, int]:
+        return (-self.priority, self.seq)  # higher priority first, then FIFO
+
+    def claim(self) -> Vec:
+        return tuple(max(d, h) for d, h in zip(self.demand, self.held))  # type: ignore[return-value]
+
+
+@dataclass
+class Eviction:
+    """Whole-gang eviction of ``app_id``, charged to ``for_app``'s queue."""
+
+    app_id: str
+    for_app: str
+
+
+@dataclass
+class Shrink:
+    """Partial reclaim: ask ``app_id``'s AM to shed ``workers`` elastic
+    workers (each freeing its ``elastic_unit``), charged to ``for_app``."""
+
+    app_id: str
+    workers: int
+    for_app: str
+
+
+@dataclass
+class Decision:
+    """One scheduling pass's committed actions, in application order:
+    shrinks and evictions first (they funded the admissions), then admits."""
+
+    admit: list[str] = field(default_factory=list)
+    evict: list[Eviction] = field(default_factory=list)
+    shrink: list[Shrink] = field(default_factory=list)
+
+    def empty(self) -> bool:
+        return not (self.admit or self.evict or self.shrink)
+
+
+class PreemptionPolicy:
+    """The capacity-scheduler decision, clock-injectable and stateful only
+    in the per-queue eviction budget (a rolling log of charged evictions)."""
+
+    def __init__(
+        self,
+        queues: dict[str, float],
+        *,
+        preemption: bool = False,
+        grace_ms: int = 0,
+        min_runtime_ms: int = 0,
+        eviction_budget: int = 0,
+        budget_window_ms: int = 60_000,
+        clock=time.monotonic,
+    ):
+        validate_queue_shares(queues)
+        self.queues = dict(queues)
+        self.preemption = preemption
+        # cross-queue reclaim fires only for heads waiting at least this
+        # long (tony.pool.preemption.grace-ms): transient waits — an app
+        # about to finish, a gang mid-restart — don't trigger kills in
+        # other queues
+        self.grace_ms = grace_ms
+        self.min_runtime_ms = min_runtime_ms
+        self.eviction_budget = eviction_budget
+        self.budget_window_ms = budget_window_ms
+        self.clock = clock
+        self._charges: dict[str, list[float]] = {}  # aggressor queue → times
+
+    # ------------------------------------------------------------ guards
+    def _protected(self, app: AppView, now: float) -> bool:
+        """Minimum-runtime protection: a freshly-admitted app may not be a
+        preemption victim until it has run for min_runtime_ms."""
+        return (
+            self.min_runtime_ms > 0
+            and app.admitted
+            and now - app.admitted_at < self.min_runtime_ms / 1000.0
+        )
+
+    def _budget_remaining(self, queue: str, now: float) -> int:
+        if self.eviction_budget <= 0:
+            return 1 << 30  # unlimited
+        window_s = self.budget_window_ms / 1000.0
+        log = [t for t in self._charges.get(queue, []) if now - t < window_s]
+        self._charges[queue] = log
+        return self.eviction_budget - len(log)
+
+    def _charge(self, queue: str, n: int, now: float) -> None:
+        if self.eviction_budget > 0:
+            self._charges.setdefault(queue, []).extend([now] * n)
+
+    # --------------------------------------------------------- scheduling
+    @staticmethod
+    def _fits(free: list[int], demand: Vec) -> bool:
+        return all(f >= d for f, d in zip(free, demand))
+
+    def schedule(self, apps: list[AppView], totals: Vec) -> Decision:
+        """One admission pass over the current world state.
+
+        Mutates the views as the returned decision prescribes; the caller
+        applies the same transitions (in decision order) to its canonical
+        state — journaling, metrics, kill/drain initiation are the caller's.
+        """
+        decision = Decision()
+        if not any(totals):
+            return decision  # no capacity registered yet — everything waits
+        primary = 2 if totals[2] > 0 else 0  # chips when the pool has chips
+        now = self.clock()
+        claims = {a.app_id: a.claim() for a in apps if a.admitted}
+        free = [t - sum(c[i] for c in claims.values()) for i, t in enumerate(totals)]
+        queue_used: dict[str, int] = {q: 0 for q in self.queues}
+        for a in apps:
+            if a.admitted:
+                queue_used[a.queue] = queue_used.get(a.queue, 0) + claims[a.app_id][primary]
+
+        def waiting_in(q: str) -> list[AppView]:
+            return sorted(
+                (a for a in apps if a.queue == q and not a.admitted),
+                key=lambda a: a.sort_key,
+            )
+
+        def admit(app: AppView) -> None:
+            app.admitted, app.preempted = True, False
+            app.admitted_at = now
+            decision.admit.append(app.app_id)
+            for i in range(3):
+                free[i] -= app.demand[i]
+            queue_used[app.queue] = queue_used.get(app.queue, 0) + app.demand[primary]
+
+        while True:
+            eligible: list[tuple[float, tuple[int, int], AppView]] = []
+            blocked_heads: list[AppView] = []
+            for q, share in self.queues.items():
+                heads = waiting_in(q)
+                if not heads:
+                    continue
+                head = heads[0]
+                if not self._fits(free, head.demand):
+                    blocked_heads.append(head)
+                    continue
+                others_waiting = any(
+                    a for a in apps if not a.admitted and a.queue != q
+                )
+                cap = share * totals[primary]
+                over_share = queue_used.get(q, 0) + head.demand[primary] > cap
+                if over_share and others_waiting and queue_used.get(q, 0) > 0:
+                    # queue is over its share while others wait (elastic
+                    # borrowing only applies to an otherwise-idle pool; a
+                    # queue's FIRST app always may run)
+                    blocked_heads.append(head)
+                    continue
+                eligible.append((queue_used.get(q, 0) / share, head.sort_key, head))
+            if eligible:
+                eligible.sort(key=lambda e: (e[0], e[1]))
+                admit(eligible[0][2])
+                continue
+            if self.preemption and blocked_heads:
+                blocked_heads.sort(key=lambda a: a.sort_key)
+                if self._preempt_for(
+                    blocked_heads[0], apps, free, queue_used, primary, totals,
+                    admit, decision, now,
+                ):
+                    continue
+                # same-queue priority preemption didn't help: try restoring
+                # the CAPACITY GUARANTEE — an under-share head may reclaim
+                # from queues that borrowed beyond their share, shrinking
+                # elastic borrowers before whole-gang-evicting anyone
+                if any(
+                    self._reclaim_across_queues(
+                        h, apps, free, queue_used, primary, totals,
+                        admit, decision, now, allow_shrink=True,
+                    )
+                    or self._reclaim_across_queues(
+                        h, apps, free, queue_used, primary, totals,
+                        admit, decision, now, allow_shrink=False,
+                    )
+                    for h in blocked_heads
+                ):
+                    continue
+            return decision
+
+    def _preempt_for(
+        self,
+        cand: AppView,
+        apps: list[AppView],
+        free: list[int],
+        queue_used: dict[str, int],
+        primary: int,
+        totals: Vec,
+        admit,
+        decision: Decision,
+        now: float,
+    ) -> bool:
+        """Evict strictly-lower-priority admitted apps from ``cand``'s own
+        queue (lowest priority, newest first) and admit ``cand`` in the SAME
+        action. The atomic evict+admit matters: if the freed claims went back
+        to the general pool, the next admission pass could hand them to
+        another queue's head and the eviction would cascade (or be wasted) —
+        victims are evicted exactly for the app that takes their place.
+
+        Share gate: evicting same-queue victims cannot grow the queue's
+        usage, but the part of ``cand``'s demand NOT covered by the victims'
+        freed claims must pass the same over-share rule as normal admission
+        — preemption overrides priority inside a queue, never the queue's
+        capacity contract with other tenants."""
+        victims = sorted(
+            (a for a in apps
+             if a.admitted and a.queue == cand.queue and a.priority < cand.priority
+             and not a.shrink_pending and not self._protected(a, now)),
+            key=lambda a: (a.priority, -a.seq),
+        )
+        demand = cand.demand
+        chosen: list[AppView] = []
+        trial = list(free)
+        freed_primary = 0
+        for v in victims:
+            if self._fits(trial, demand):
+                break
+            c = v.claim()
+            for i in range(3):
+                trial[i] += c[i]
+            freed_primary += c[primary]
+            chosen.append(v)
+        if not chosen or not self._fits(trial, demand):
+            return False
+        net_growth = demand[primary] - freed_primary
+        if net_growth > 0:
+            others_waiting = any(
+                a for a in apps if not a.admitted and a.queue != cand.queue
+            )
+            used_after = queue_used.get(cand.queue, 0) - freed_primary
+            cap = self.queues.get(cand.queue, 1.0) * totals[primary]
+            if others_waiting and used_after > 0 and used_after + demand[primary] > cap:
+                return False
+        if len(chosen) > self._budget_remaining(cand.queue, now):
+            return False  # aggressor queue spent its preemption budget: wait
+        self._charge(cand.queue, len(chosen), now)
+        for v in chosen:
+            self._do_evict(v, cand, free, queue_used, primary, decision, now)
+        admit(cand)
+        return True
+
+    def _do_evict(
+        self,
+        v: AppView,
+        cand: AppView,
+        free: list[int],
+        queue_used: dict[str, int],
+        primary: int,
+        decision: Decision,
+        now: float,
+    ) -> None:
+        """Demote an admitted app back to waiting and return its claim to
+        the pass-local pool. The caller (pool: drain/kill its containers;
+        sim: schedule its death) acts on the recorded eviction."""
+        c = v.claim()
+        v.admitted, v.preempted = False, True
+        v.wait_since = now
+        for i in range(3):
+            free[i] += c[i]
+        queue_used[v.queue] -= c[primary]
+        decision.evict.append(Eviction(app_id=v.app_id, for_app=cand.app_id))
+
+    def _reclaim_across_queues(
+        self,
+        cand: AppView,
+        apps: list[AppView],
+        free: list[int],
+        queue_used: dict[str, int],
+        primary: int,
+        totals: Vec,
+        admit,
+        decision: Decision,
+        now: float,
+        allow_shrink: bool,
+    ) -> bool:
+        """Cross-queue capacity reclaim (the YARN capacity-scheduler
+        guarantee): a waiting head whose queue is UNDER its share may evict
+        apps from queues that borrowed BEYOND their share — otherwise a long
+        borrower admitted on an idle pool locks the guaranteed queue out for
+        its whole duration and the share is decorative exactly when it
+        matters.
+
+        Rules, all enforced on a trial copy before anything commits
+        (all-or-nothing, same structure as ``_preempt_for``):
+        - reclaim only RESTORES the guarantee: admitting ``cand`` must keep
+          its queue within its own share (borrowing beyond share rides free
+          capacity only, never other queues' evictions);
+        - victims come only from queues currently OVER their share, most
+          over-share queue first, and reclaim stops the moment a victim
+          queue is no longer over its share — a queue AT or UNDER its share
+          is never touched;
+        - **partial reclaim first** (``allow_shrink``): an elastic victim is
+          asked to shed K workers — just enough, never below the victim
+          queue's share — instead of dying whole; whole-gang eviction is the
+          fallback when shrink cannot free enough (the caller retries with
+          ``allow_shrink=False``). A whole-gang eviction may still land the
+          borrower below its share (a 3 GB app over a 2 GB share evicts
+          whole): that app only ever ran by borrowing, and it re-queues
+          with under-share priority like any waiter;
+        - within a victim queue: lowest priority first, newest first — the
+          newest borrowers repay first;
+        - grace (``tony.pool.preemption.grace-ms``): only heads waiting at
+          least this long trigger cross-queue reclaim;
+        - minimum-runtime protection and the aggressor queue's eviction
+          budget apply (anti-thrash, class docstring).
+        """
+        demand = cand.demand
+        cap_cand = self.queues.get(cand.queue, 1.0) * totals[primary]
+        if queue_used.get(cand.queue, 0) + demand[primary] > cap_cand:
+            return False  # head would overshoot its own guarantee
+        if now - cand.wait_since < self.grace_ms / 1000.0:
+            return False
+        trial = list(free)
+        trial_used = dict(queue_used)
+        chosen: list[AppView] = []
+        shrinks: dict[str, int] = {}          # app_id → workers to shed
+        slack_left = {a.app_id: a.elastic_slack for a in apps}
+        by_id = {a.app_id: a for a in apps}
+        while not self._fits(trial, demand):
+            # most over-share queue first (by primary-dimension excess)
+            best: tuple[float, AppView] | None = None
+            for q, share in self.queues.items():
+                if q == cand.queue:
+                    continue
+                excess = trial_used.get(q, 0) - share * totals[primary]
+                if excess <= 0:
+                    continue  # at or under share: protected from reclaim
+                victims = sorted(
+                    (a for a in apps
+                     if a.admitted and a.queue == q and a not in chosen
+                     # an app shrunk earlier THIS pass is settled: shedding
+                     # took it as far as its slack allows, and shrinking and
+                     # whole-evicting the same app would double-free it (the
+                     # pure-evict fallback pass may still evict it whole)
+                     and a.app_id not in shrinks
+                     and not a.shrink_pending and not self._protected(a, now)),
+                    key=lambda a: (a.priority, -a.seq),
+                )
+                if victims and (best is None or excess > best[0]):
+                    best = (excess, victims[0])
+            if best is None:
+                return False  # no eligible borrower left and cand still unfit
+            excess, v = best
+            unit = v.elastic_unit
+            deficit_dims = [
+                i for i in range(3) if unit[i] > 0 and demand[i] - trial[i] > 0
+            ]
+            if allow_shrink and slack_left.get(v.app_id, 0) > 0 and deficit_dims:
+                # partial reclaim: shed the fewest workers that cover the
+                # remaining deficit in every dimension a worker frees,
+                # capped by the victim's slack and by its queue's excess —
+                # FLOOR division, so shrink never digs the queue below its
+                # share (a fractional-unit remainder is left for whole-gang
+                # eviction, which IS allowed to straddle the share line)
+                deficit_k = max(
+                    -(-(demand[i] - trial[i]) // unit[i]) for i in deficit_dims
+                )
+                k = min(
+                    slack_left[v.app_id],
+                    deficit_k,
+                    int(excess // unit[primary]) if unit[primary] > 0 else deficit_k,
+                )
+                if k >= 1:
+                    shrinks[v.app_id] = shrinks.get(v.app_id, 0) + k
+                    slack_left[v.app_id] -= k
+                    for i in range(3):
+                        trial[i] += k * unit[i]
+                    trial_used[v.queue] -= k * unit[primary]
+                    continue
+                # a worker sheds nothing useful for this deficit: fall
+                # through to whole-gang eviction of this victim
+            c = v.claim()
+            for i in range(3):
+                trial[i] += c[i]
+            trial_used[v.queue] -= c[primary]
+            chosen.append(v)
+        disruptions = len(chosen) + len(shrinks)
+        if disruptions > self._budget_remaining(cand.queue, now):
+            return False  # aggressor queue spent its preemption budget: wait
+        self._charge(cand.queue, disruptions, now)
+        for app_id, k in shrinks.items():
+            v = by_id[app_id]
+            unit = v.elastic_unit
+            v.demand = tuple(max(d - k * u, 0) for d, u in zip(v.demand, unit))  # type: ignore[assignment]
+            v.elastic_slack -= k
+            v.shrink_pending = True
+            for i in range(3):
+                free[i] += k * unit[i]
+            queue_used[v.queue] -= k * unit[primary]
+            decision.shrink.append(Shrink(app_id=app_id, workers=k, for_app=cand.app_id))
+        for v in chosen:
+            self._do_evict(v, cand, free, queue_used, primary, decision, now)
+        admit(cand)
+        return True

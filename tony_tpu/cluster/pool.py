@@ -44,6 +44,11 @@ from typing import Any
 from tony_tpu import constants
 from tony_tpu.obs import logging as obs_logging
 from tony_tpu.cluster.journal import Journal, JournalError, read_journal
+from tony_tpu.cluster.policy import (
+    AppView,
+    PreemptionPolicy,
+    validate_queue_shares as _validate_queue_shares,
+)
 from tony_tpu.cluster.resources import (
     AllocationError,
     AllocationPending,
@@ -77,6 +82,16 @@ _POOL_EVICTIONS = obs_metrics.counter(
     "tony_pool_evictions_total", "apps preempted back to waiting", labelnames=("queue",))
 _POOL_ALLOCATE_QUEUED = obs_metrics.counter(
     "tony_pool_allocate_queued_total", "allocate() calls answered with wait (queued)")
+_POOL_PREEMPTIONS = obs_metrics.counter(
+    "tony_pool_preemptions_total",
+    "preemption outcomes by mode: drain (victim checkpointed and yielded "
+    "inside the deadline), kill (immediate or escalated kill path), shrink "
+    "(elastic victim shed workers instead of dying whole)",
+    labelnames=("mode",))
+_POOL_DRAIN_SECONDS = obs_metrics.histogram(
+    "tony_pool_drain_duration_seconds",
+    "eviction-to-resolution latency of cooperative drain/shrink episodes",
+    buckets=obs_metrics.WAIT_BUCKETS)
 
 _RUNNING, _EXITED, _RELEASED = "RUNNING", "EXITED", "RELEASED"
 
@@ -106,22 +121,6 @@ def parse_queue_spec(spec: str) -> dict[str, float]:
     return queues
 
 
-def _validate_queue_shares(queues: dict[str, float]) -> None:
-    """Shares are GUARANTEES — they cannot oversubscribe the pool. YARN's
-    capacity scheduler rejects capacities that don't fit 100% for the same
-    reason: with prod=0.9,dev=0.9 the over-share gate almost never fires and
-    the operator's 'guarantee' silently degrades to FIFO."""
-    bad = [(q, f) for q, f in queues.items() if not 0 < f <= 1]
-    if bad:
-        raise ValueError(f"queue shares must each be in (0, 1]: {bad}")
-    total = sum(queues.values())
-    if total > 1.0 + 1e-9:
-        raise ValueError(
-            f"queue shares sum to {total:g} > 1 — guarantees would "
-            f"oversubscribe the pool: {queues}"
-        )
-
-
 @dataclass(eq=False)
 class _App:
     """One tenant application and its queue/admission state.
@@ -131,6 +130,10 @@ class _App:
     (re)allocated, so an app mid-gang-restart keeps its capacity and two
     half-allocated gangs can never deadlock each other. Waiting apps hold
     nothing and retry through ``allocate`` until the scheduler admits them.
+
+    The admission/preemption DECISION over these records lives in
+    cluster/policy.py (pure, clock-injectable, shared with ``tony sim``);
+    this record only carries the state the policy views are built from.
     """
 
     app_id: str
@@ -143,8 +146,19 @@ class _App:
     admitted: bool = False
     preempted: bool = False    # demoted by preemption; re-queues via allocate
     # when this app last STARTED waiting (registration or eviction) — the
-    # cross-queue reclaim grace is measured from here
+    # cross-queue reclaim grace is measured from here. wait_unix is the
+    # wall-clock twin journaled so a pool restart preserves the waiting AGE
+    # instead of silently restarting every waiter's grace clock.
     wait_since: float = field(default_factory=time.monotonic)
+    wait_unix: float = field(default_factory=time.time)
+    # when this app was last admitted — the minimum-runtime protection
+    # (tony.pool.preemption.min-runtime-ms) is measured from here
+    admitted_at: float = 0.0
+    admitted_unix: float = 0.0
+    # elastic partial-reclaim contract the AM registered: resources one shed
+    # worker frees, and how many workers the app may shed (0 → not elastic)
+    elastic_unit: tuple[int, int, int] = (0, 0, 0)
+    elastic_slack: int = 0
 
     @property
     def sort_key(self) -> tuple[int, int]:
@@ -212,6 +226,10 @@ class PoolService:
         queues: dict[str, float] | None = None,
         preemption: bool = False,
         preemption_grace_ms: int = 0,
+        preemption_drain_ms: int = 0,
+        preemption_min_runtime_ms: int = 0,
+        preemption_budget: int = 0,
+        preemption_budget_window_ms: int = 60_000,
         journal_path: str | None = None,
         chaos=None,
     ):
@@ -220,11 +238,22 @@ class PoolService:
         self.queues = dict(queues) if queues else {"default": 1.0}
         _validate_queue_shares(self.queues)
         self.preemption = preemption
-        # cross-queue reclaim fires only for heads waiting at least this
-        # long (tony.pool.preemption.grace-ms): transient waits — an app
-        # about to finish, a gang mid-restart — don't trigger kills in
-        # other queues
         self.preemption_grace_ms = preemption_grace_ms
+        # cooperative drain window (tony.pool.preemption.drain-ms): eviction
+        # becomes two-phase — the victim learns it is DRAINING through its
+        # poll path, urgent-checkpoints, and yields; kills fire only at this
+        # deadline. 0 → the classic immediate kill path.
+        self.preemption_drain_ms = preemption_drain_ms
+        # the decision itself is the pure policy module — the same code
+        # `tony sim` drives over thousands of synthetic arrivals
+        self._policy = PreemptionPolicy(
+            self.queues,
+            preemption=preemption,
+            grace_ms=preemption_grace_ms,
+            min_runtime_ms=preemption_min_runtime_ms,
+            eviction_budget=preemption_budget,
+            budget_window_ms=preemption_budget_window_ms,
+        )
         #: optional fault-injection context (pool-crash); None in production
         self.chaos = chaos
         self._nodes: dict[str, _Node] = {}
@@ -234,6 +263,12 @@ class PoolService:
         self._app_seq = itertools.count()
         self._preempt_cids: set[str] = set()               # kills we initiated
         self._all_dead_since: float | None = None          # allocate() saw 0 alive
+        # in-flight drain/shrink episodes: app_id → {req_id, mode, workers,
+        # deadline (monotonic), t0 (monotonic), escalated}
+        self._drains: dict[str, dict[str, Any]] = {}
+        # one-shot cancellation notices (drain victim re-admitted before it
+        # yielded): app_id → req_id, delivered on the app's next poll
+        self._cancelled: dict[str, str] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         # work-preserving restart (tony.pool.journal.file): registrations,
@@ -274,12 +309,18 @@ class PoolService:
 
     def _journal_app_locked(self, app: _App) -> None:
         """Full app row (last record wins on replay) — written on every
-        registration/admission/eviction state change."""
+        registration/admission/eviction state change. Waiting/admitted ages
+        are journaled as WALL-CLOCK instants so a restarted pool restores
+        them (monotonic clocks don't survive the process): without this,
+        every pool restart silently restarted the cross-queue reclaim grace
+        for every waiting app."""
         self._jlog_locked(
             "app", app_id=app.app_id, queue=app.queue, priority=app.priority,
             seq=app.seq, admitted=app.admitted, preempted=app.preempted,
             demand_memory=app.demand_memory, demand_vcores=app.demand_vcores,
             demand_chips=app.demand_chips,
+            wait_unix=app.wait_unix, admitted_unix=app.admitted_unix,
+            elastic_unit=list(app.elastic_unit), elastic_slack=app.elastic_slack,
         )
 
     def _recover_from_journal_locked(self, records: list[dict[str, Any]]) -> None:
@@ -290,9 +331,20 @@ class PoolService:
         here. A waiting app admitted pre-crash stays admitted (never
         double-admitted); a running app keeps its claim and is not evicted."""
         max_seq = -1
+        now_mono, now_unix = time.monotonic(), time.time()
+
+        def rebase(unix: float) -> float:
+            """Wall-clock instant → this process's monotonic clock, so a
+            journaled waiting/admitted AGE (or pending drain deadline)
+            survives the restart. May be before this process started —
+            negative offsets are fine, only differences are compared."""
+            return now_mono + (unix - now_unix) if unix else 0.0
+
         for rec in records:
             t = rec.get("t")
             if t == "app":
+                wait_unix = float(rec.get("wait_unix") or now_unix)
+                admitted_unix = float(rec.get("admitted_unix") or 0.0)
                 app = _App(
                     app_id=str(rec["app_id"]),
                     queue=str(rec["queue"]),
@@ -303,6 +355,12 @@ class PoolService:
                     demand_memory=int(rec.get("demand_memory", 0)),
                     demand_vcores=int(rec.get("demand_vcores", 0)),
                     demand_chips=int(rec.get("demand_chips", 0)),
+                    wait_since=rebase(wait_unix) or now_mono,
+                    wait_unix=wait_unix,
+                    admitted_at=rebase(admitted_unix),
+                    admitted_unix=admitted_unix,
+                    elastic_unit=tuple(int(x) for x in (rec.get("elastic_unit") or (0, 0, 0))),
+                    elastic_slack=int(rec.get("elastic_slack", 0)),
                 )
                 if app.queue not in self.queues:
                     # queue config changed across the restart: park the app in
@@ -334,6 +392,27 @@ class PoolService:
                 self._containers.pop(str(rec["cid"]), None)
             elif t == "polled":
                 self._app_exits.pop(str(rec["app_id"]), None)
+            elif t == "drain":
+                # in-flight drain/shrink episode: rebase the deadline onto
+                # this process's clock so the escalation still fires — a pool
+                # restart mid-drain must not leave a demoted victim's
+                # containers running forever
+                self._drains[str(rec["app_id"])] = {
+                    "req_id": str(rec["req_id"]),
+                    "mode": str(rec.get("mode", "drain")),
+                    "workers": int(rec.get("workers", 0)),
+                    "target_primary": int(rec.get("target_primary", 0)),
+                    "undo_demand": [int(x) for x in (rec.get("undo_demand") or (0, 0, 0))],
+                    "reduced_demand": (
+                        [int(x) for x in rec["reduced_demand"]]
+                        if rec.get("reduced_demand") else None
+                    ),
+                    "deadline": rebase(float(rec.get("deadline_unix") or now_unix)),
+                    "t0": rebase(float(rec.get("t0_unix") or now_unix)),
+                    "escalated": False,
+                }
+            elif t == "drain_done":
+                self._drains.pop(str(rec["app_id"]), None)
             else:
                 raise JournalError(f"unknown pool journal record type {t!r}")
         self._app_seq = itertools.count(max_seq + 1)
@@ -502,12 +581,20 @@ class PoolService:
         memory_bytes: int = 0,
         vcores: int = 0,
         chips: int = 0,
+        elastic_unit: list[int] | None = None,
+        elastic_slack: int = 0,
     ) -> dict[str, Any]:
         """ApplicationSubmissionContext analog: the AM announces its queue,
         priority, and TOTAL gang demand before allocating. Admission (the
         YARN capacity-queue behavior ``tony.application.queue`` configures)
         is decided from these demands: apps WAIT when the pool is busy
-        instead of failing."""
+        instead of failing.
+
+        ``elastic_unit``/``elastic_slack`` advertise the partial-reclaim
+        contract: the resources one shed worker of the app's elastic jobtype
+        frees, and how many workers it may shed (current minus the elastic
+        floor). A reclaiming under-share head can then ask this app to
+        SHRINK instead of whole-gang-evicting it (docs/scheduling.md)."""
         if queue not in self.queues:
             raise ValueError(
                 f"unknown queue {queue!r}: pool queues are {sorted(self.queues)} "
@@ -524,6 +611,8 @@ class PoolService:
             app.demand_memory = int(memory_bytes)
             app.demand_vcores = int(vcores)
             app.demand_chips = int(chips)
+            app.elastic_unit = tuple(int(x) for x in (elastic_unit or (0, 0, 0)))
+            app.elastic_slack = max(int(elastic_slack), 0)
             self._schedule_locked()
             self._journal_app_locked(app)
             return {"ack": True, "queue": queue, "admitted": app.admitted}
@@ -715,17 +804,29 @@ class PoolService:
                     self._release_locked(cid)
             self._app_exits.pop(app_id, None)
             self._apps.pop(app_id, None)  # app done: leave the queue entirely
+            self._cancelled.pop(app_id, None)
+            if self._drains.pop(app_id, None) is not None:
+                # the app left the pool mid-drain (finished, or torn down):
+                # the episode is over either way
+                self._jlog_locked("drain_done", app_id=app_id)
             self._jlog_locked("app_removed", app_id=app_id)
             self._schedule_locked()
         return {"ack": True}
 
-    def poll_exited(self, app_id: str) -> dict[str, int]:
+    def poll_exited(self, app_id: str, with_preempt: bool = False) -> dict[str, Any]:
+        """Undelivered container exits for ``app_id``. With ``with_preempt``
+        (the RemoteResourceManager spelling) the response is
+        ``{"exits": {...}, "preempt": notice|None}`` — the cooperative-drain
+        notice rides the poll the AM already makes every monitor tick, so a
+        victim learns it is DRAINING with no new RPC round-trip."""
         with self._lock:
             exits = self._app_exits.pop(app_id, {})
             if exits:
                 # delivered: a restarted pool must not re-deliver these
                 self._jlog_locked("polled", app_id=app_id)
-            return exits
+            if not with_preempt:
+                return exits
+            return {"exits": exits, "preempt": self._preempt_notice_locked(app_id)}
 
     def request_kill(self, container_id: str) -> dict[str, Any]:
         """Backstop kill path when the AM cannot reach the agent directly:
@@ -744,6 +845,51 @@ class PoolService:
 
     def pool_status(self) -> dict[str, Any]:
         with self._lock:
+            totals = self._totals_locked()
+            primary = 2 if totals[2] > 0 else 0
+            now = time.monotonic()
+
+            def queue_status(q: str, share: float) -> dict[str, Any]:
+                used = sum(
+                    self._claim_locked(a)[primary]
+                    for a in self._apps.values()
+                    if a.queue == q and a.admitted
+                )
+                return {
+                    "share": share,
+                    # used-vs-share in the primary capacity dimension: the
+                    # portal's share-utilization bars and any "is my
+                    # guarantee honored" question read straight off these
+                    "used": used,
+                    "share_capacity": int(share * totals[primary]),
+                    "admitted": sorted(
+                        (
+                            {
+                                "app_id": a.app_id, "priority": a.priority,
+                                "held_chips": self._held_locked(a.app_id)[2],
+                                "held_memory": self._held_locked(a.app_id)[0],
+                                "draining": a.app_id in self._drains,
+                            }
+                            for a in self._apps.values()
+                            if a.queue == q and a.admitted
+                        ),
+                        key=lambda e: e["app_id"],
+                    ),
+                    "waiting": [
+                        {
+                            "app_id": a.app_id, "priority": a.priority,
+                            "position": i, "preempted": a.preempted,
+                            "waiting_s": round(max(now - a.wait_since, 0.0), 3),
+                            "draining": a.app_id in self._drains,
+                        }
+                        for i, a in enumerate(sorted(
+                            (a for a in self._apps.values()
+                             if a.queue == q and not a.admitted),
+                            key=lambda a: a.sort_key,
+                        ))
+                    ],
+                }
+
             return {
                 "nodes": [
                     {
@@ -757,36 +903,12 @@ class PoolService:
                 "containers_running": sum(
                     1 for r in self._containers.values() if r["state"] == _RUNNING
                 ),
+                "primary_dimension": ("memory_bytes", "vcores", "chips")[primary],
                 "queues": {
-                    q: {
-                        "share": share,
-                        "admitted": sorted(
-                            (
-                                {
-                                    "app_id": a.app_id, "priority": a.priority,
-                                    "held_chips": self._held_locked(a.app_id)[2],
-                                    "held_memory": self._held_locked(a.app_id)[0],
-                                }
-                                for a in self._apps.values()
-                                if a.queue == q and a.admitted
-                            ),
-                            key=lambda e: e["app_id"],
-                        ),
-                        "waiting": [
-                            {
-                                "app_id": a.app_id, "priority": a.priority,
-                                "position": i, "preempted": a.preempted,
-                            }
-                            for i, a in enumerate(sorted(
-                                (a for a in self._apps.values()
-                                 if a.queue == q and not a.admitted),
-                                key=lambda a: a.sort_key,
-                            ))
-                        ],
-                    }
-                    for q, share in self.queues.items()
+                    q: queue_status(q, share) for q, share in self.queues.items()
                 },
                 "preemption": self.preemption,
+                "drains_active": len(self._drains),
             }
 
     def cluster_capacity(self) -> dict[str, int]:
@@ -836,251 +958,249 @@ class PoolService:
             max(app.demand_chips, held[2]),
         )
 
-    @staticmethod
-    def _fits(free: list[int], demand: tuple[int, int, int]) -> bool:
-        return all(f >= d for f, d in zip(free, demand))
-
     def _schedule_locked(self) -> None:
-        """Admit waiting apps (the capacity-scheduler decision).
+        """One admission pass: build policy views of the current world, run
+        the pure :class:`PreemptionPolicy` (cluster/policy.py — the exact
+        code ``tony sim`` proves invariants over), and apply its decision.
 
-        Claims-based: each admitted app reserves max(demand, held), so
-        admission is all-or-nothing at GANG granularity — two apps can never
-        interleave half-gangs into a deadlock. Within a queue: priority desc,
-        then FIFO. Across queues: least relative usage (claim/share) first.
-        A queue may exceed its share while no other queue has waiters, and
-        every queue may always run at least one app (no share-induced
-        starvation). With preemption on, a waiting app may evict
-        strictly-lower-priority admitted apps from its own queue.
-        """
+        The policy owns the WHOLE decision (claims-based admission, queue
+        shares, priority preemption, cross-queue reclaim with shrink-first
+        partial reclaim, anti-thrash guards); this method owns only the
+        mechanics — journaling, metrics, and initiating drains/kills."""
         totals = self._totals_locked()
-        if not any(totals):
-            return  # no capacity registered yet — everything waits
-        primary = 2 if totals[2] > 0 else 0  # chips when the pool has chips
-        demand_of = lambda a: (a.demand_memory, a.demand_vcores, a.demand_chips)  # noqa: E731
-        claims = {a.app_id: self._claim_locked(a) for a in self._apps.values() if a.admitted}
-        free = [t - sum(c[i] for c in claims.values()) for i, t in enumerate(totals)]
-        queue_used: dict[str, int] = {q: 0 for q in self.queues}
-        for a in self._apps.values():
-            if a.admitted:
-                queue_used[a.queue] = queue_used.get(a.queue, 0) + claims[a.app_id][primary]
-
-        def waiting_in(q: str) -> list[_App]:
-            return sorted(
-                (a for a in self._apps.values() if a.queue == q and not a.admitted),
-                key=lambda a: a.sort_key,
+        views = [
+            AppView(
+                app_id=a.app_id, queue=a.queue, priority=a.priority, seq=a.seq,
+                demand=(a.demand_memory, a.demand_vcores, a.demand_chips),
+                held=self._held_locked(a.app_id),
+                admitted=a.admitted, preempted=a.preempted,
+                wait_since=a.wait_since, admitted_at=a.admitted_at,
+                elastic_unit=a.elastic_unit, elastic_slack=a.elastic_slack,
+                shrink_pending=(
+                    a.app_id in self._drains
+                    and self._drains[a.app_id]["mode"] == "shrink"
+                ),
             )
+            for a in self._apps.values()
+        ]
+        decision = self._policy.schedule(views, totals)
+        for sh in decision.shrink:
+            self._apply_shrink_locked(sh)
+        for ev in decision.evict:
+            self._apply_evict_locked(ev)
+        for app_id in decision.admit:
+            self._apply_admit_locked(app_id)
 
-        def admit(app: _App) -> None:
-            app.admitted, app.preempted = True, False
-            _POOL_ADMISSIONS.inc(queue=app.queue)
-            self._journal_app_locked(app)
-            d = demand_of(app)
-            for i in range(3):
-                free[i] -= d[i]
-            queue_used[app.queue] = queue_used.get(app.queue, 0) + d[primary]
+    # -------------------------------------------- decision application
+    def _apply_admit_locked(self, app_id: str) -> None:
+        app = self._apps[app_id]
+        app.admitted, app.preempted = True, False
+        app.admitted_at = time.monotonic()
+        app.admitted_unix = time.time()
+        _POOL_ADMISSIONS.inc(queue=app.queue)
+        entry = self._drains.get(app_id)
+        if entry is not None and entry["mode"] == "drain":
+            # a drain victim re-admitted before it yielded (capacity freed
+            # elsewhere): the eviction is moot — cancel the drain instead of
+            # letting the deadline kill an app that may keep running
+            self._drains.pop(app_id, None)
+            self._cancelled[app_id] = entry["req_id"]
+            self._jlog_locked("drain_done", app_id=app_id)
+            obs_logging.info(
+                f"[tony-pool] drain of {app_id} cancelled: re-admitted before yielding")
+        self._journal_app_locked(app)
 
-        while True:
-            eligible: list[tuple[float, tuple[int, int], _App]] = []
-            blocked_heads: list[_App] = []
-            for q, share in self.queues.items():
-                heads = waiting_in(q)
-                if not heads:
-                    continue
-                head = heads[0]
-                if not self._fits(free, demand_of(head)):
-                    blocked_heads.append(head)
-                    continue
-                others_waiting = any(
-                    a for a in self._apps.values() if not a.admitted and a.queue != q
-                )
-                cap = share * totals[primary]
-                over_share = queue_used.get(q, 0) + demand_of(head)[primary] > cap
-                if over_share and others_waiting and queue_used.get(q, 0) > 0:
-                    # queue is over its share while others wait (elastic
-                    # borrowing only applies to an otherwise-idle pool; a
-                    # queue's FIRST app always may run)
-                    blocked_heads.append(head)
-                    continue
-                eligible.append((queue_used.get(q, 0) / share, head.sort_key, head))
-            if eligible:
-                eligible.sort(key=lambda e: (e[0], e[1]))
-                admit(eligible[0][2])
-                continue
-            if self.preemption and blocked_heads:
-                blocked_heads.sort(key=lambda a: a.sort_key)
-                if self._preempt_for_locked(
-                    blocked_heads[0], free, claims, queue_used, primary, totals, admit
-                ):
-                    continue
-                # same-queue priority preemption didn't help: try restoring
-                # the CAPACITY GUARANTEE — an under-share head may reclaim
-                # from queues that borrowed beyond their share
-                if any(
-                    self._reclaim_across_queues_locked(
-                        h, free, claims, queue_used, primary, totals, admit
-                    )
-                    for h in blocked_heads
-                ):
-                    continue
-            return
-
-    def _preempt_for_locked(
-        self,
-        cand: _App,
-        free: list[int],
-        claims: dict[str, tuple[int, int, int]],
-        queue_used: dict[str, int],
-        primary: int,
-        totals: tuple[int, int, int],
-        admit,
-    ) -> bool:
-        """Evict strictly-lower-priority admitted apps from ``cand``'s own
-        queue (lowest priority, newest first) and admit ``cand`` in the SAME
-        action. The atomic evict+admit matters: if the freed claims went back
-        to the general pool, the next admission pass could hand them to
-        another queue's head and the eviction would cascade (or be wasted) —
-        victims are evicted exactly for the app that takes their place.
-        Kills ride the agents' heartbeats; the claim swap is immediate, so
-        ``cand``'s allocations simply wait out the drain.
-
-        Share gate: evicting same-queue victims cannot grow the queue's
-        usage, but the part of ``cand``'s demand NOT covered by the victims'
-        freed claims must pass the same over-share rule as normal admission
-        — preemption overrides priority inside a queue, never the queue's
-        capacity contract with other tenants."""
-        victims = sorted(
-            (a for a in self._apps.values()
-             if a.admitted and a.queue == cand.queue and a.priority < cand.priority),
-            key=lambda a: (a.priority, -a.seq),
-        )
-        demand = (cand.demand_memory, cand.demand_vcores, cand.demand_chips)
-        chosen: list[_App] = []
-        trial = list(free)
-        freed_primary = 0
-        for v in victims:
-            if self._fits(trial, demand):
-                break
-            # canonical claim, not the pass-local dict: apps admitted earlier
-            # in THIS scheduling pass (incl. by a prior preemption) are
-            # missing from it, and their claim is simply their demand
-            c = self._claim_locked(v)
-            for i in range(3):
-                trial[i] += c[i]
-            freed_primary += c[primary]
-            chosen.append(v)
-        if not chosen or not self._fits(trial, demand):
-            return False
-        net_growth = demand[primary] - freed_primary
-        if net_growth > 0:
-            others_waiting = any(
-                a for a in self._apps.values()
-                if not a.admitted and a.queue != cand.queue
-            )
-            used_after = queue_used.get(cand.queue, 0) - freed_primary
-            cap = self.queues.get(cand.queue, 1.0) * totals[primary]
-            if others_waiting and used_after > 0 and used_after + demand[primary] > cap:
-                return False
-        for v in chosen:
-            self._evict_locked(v, free, claims, queue_used, primary)
-        admit(cand)
-        return True
-
-    def _evict_locked(
-        self,
-        v: _App,
-        free: list[int],
-        claims: dict[str, tuple[int, int, int]],
-        queue_used: dict[str, int],
-        primary: int,
-    ) -> None:
-        """Demote an admitted app back to waiting, return its claim to the
-        pass-local pool, and kill its running containers (marked as
-        preemption so the AM's failure budget is never charged)."""
-        c = self._claim_locked(v)
+    def _apply_evict_locked(self, ev) -> None:
+        """Demote an admitted app back to waiting (the policy already chose
+        it; claims moved in the same pass) and start the two-phase drain:
+        with ``tony.pool.preemption.drain-ms`` > 0 the victim learns it is
+        DRAINING through its poll path, urgent-checkpoints, and yields —
+        kills fire only at the deadline. drain-ms 0 keeps the classic
+        immediate kill path."""
+        v = self._apps[ev.app_id]
         v.admitted, v.preempted = False, True
+        v.wait_since = time.monotonic()
+        v.wait_unix = time.time()
         _POOL_EVICTIONS.inc(queue=v.queue)
         self._journal_app_locked(v)
-        v.wait_since = time.monotonic()
-        claims.pop(v.app_id, None)
-        for i in range(3):
-            free[i] += c[i]
-        queue_used[v.queue] -= c[primary]
-        for cid, rec in self._containers.items():
-            if rec["app_id"] == v.app_id and rec["state"] == _RUNNING:
-                self._preempt_cids.add(cid)
+        running = [
+            rec for rec in self._containers.values()
+            if rec["app_id"] == v.app_id and rec["state"] == _RUNNING
+        ]
+        # a new eviction supersedes any stale cancellation from a previous
+        # drain episode of this app
+        self._cancelled.pop(v.app_id, None)
+        if not running:
+            return  # nothing to drain or kill (e.g. evicted mid-gang-restart)
+        if self.preemption_drain_ms > 0:
+            now = time.monotonic()
+            entry = {
+                "req_id": f"pre-{uuid.uuid4().hex[:8]}",
+                "mode": "drain", "workers": 0, "target_primary": 0,
+                "deadline": now + self.preemption_drain_ms / 1000,
+                "t0": now, "escalated": False,
+            }
+            self._drains[v.app_id] = entry
+            self._jlog_locked(
+                "drain", app_id=v.app_id, req_id=entry["req_id"], mode="drain",
+                workers=0, target_primary=0,
+                deadline_unix=time.time() + self.preemption_drain_ms / 1000,
+                t0_unix=time.time(),
+            )
+            obs_logging.info(
+                f"[tony-pool] draining {v.app_id} for {ev.for_app} "
+                f"(checkpoint-then-yield, deadline {self.preemption_drain_ms}ms)")
+        else:
+            for rec in running:
+                self._preempt_cids.add(rec["id"])
                 self._request_kill_locked(rec)
+            _POOL_PREEMPTIONS.inc(mode="kill")
 
-    def _reclaim_across_queues_locked(
-        self,
-        cand: _App,
-        free: list[int],
-        claims: dict[str, tuple[int, int, int]],
-        queue_used: dict[str, int],
-        primary: int,
-        totals: tuple[int, int, int],
-        admit,
-    ) -> bool:
-        """Cross-queue capacity reclaim (the YARN capacity-scheduler
-        guarantee, VERDICT r4 #2): a waiting head whose queue is UNDER its
-        share may evict apps from queues that borrowed BEYOND their share —
-        otherwise a long borrower admitted on an idle pool locks the
-        guaranteed queue out for its whole duration and the share is
-        decorative exactly when it matters.
+    def _apply_shrink_locked(self, sh) -> None:
+        """Partial reclaim: reduce the victim's registered demand by the
+        shed workers' resources and ask its AM (through the poll path) to
+        shrink the elastic jobtype by K. The freed claim funds the head
+        admitted in the same pass; escalation whole-gang-evicts at the
+        deadline if the AM never sheds."""
+        v = self._apps[sh.app_id]
+        self._cancelled.pop(v.app_id, None)  # superseded by the new episode
+        unit = v.elastic_unit
+        v.demand_memory = max(v.demand_memory - sh.workers * unit[0], 0)
+        v.demand_vcores = max(v.demand_vcores - sh.workers * unit[1], 0)
+        v.demand_chips = max(v.demand_chips - sh.workers * unit[2], 0)
+        v.elastic_slack = max(v.elastic_slack - sh.workers, 0)
+        primary = 2 if self._totals_locked()[2] > 0 else 0
+        target = (v.demand_memory, v.demand_vcores, v.demand_chips)[primary]
+        now = time.monotonic()
+        # shrink always gets a drain window, even with drain-ms 0: the shed
+        # itself is a checkpoint-resume rebuild and needs a moment — but the
+        # window is bounded, so a non-cooperative AM still escalates
+        drain_s = max(self.preemption_drain_ms, 10_000) / 1000
+        entry = {
+            "req_id": f"pre-{uuid.uuid4().hex[:8]}",
+            "mode": "shrink", "workers": sh.workers, "target_primary": target,
+            # escalation must UNDO the demand reduction (the shed never
+            # landed — a fictional smaller demand could get the victim
+            # re-admitted undersized and oversubscribe the claims) — but
+            # only while demand still equals what this shrink set: an AM
+            # that re-registered since owns its demand
+            "undo_demand": [sh.workers * unit[0], sh.workers * unit[1],
+                            sh.workers * unit[2]],
+            "reduced_demand": [v.demand_memory, v.demand_vcores, v.demand_chips],
+            "deadline": now + drain_s, "t0": now, "escalated": False,
+        }
+        self._drains[v.app_id] = entry
+        self._journal_app_locked(v)
+        self._jlog_locked(
+            "drain", app_id=v.app_id, req_id=entry["req_id"], mode="shrink",
+            workers=sh.workers, target_primary=target,
+            undo_demand=list(entry["undo_demand"]),
+            reduced_demand=list(entry["reduced_demand"]),
+            deadline_unix=time.time() + drain_s, t0_unix=time.time(),
+        )
+        obs_logging.info(
+            f"[tony-pool] asking {v.app_id} to shrink by {sh.workers} elastic "
+            f"worker(s) for {sh.for_app} (partial reclaim, deadline {drain_s:.0f}s)")
 
-        Rules, all enforced on a trial copy before any eviction happens
-        (all-or-nothing, same structure as ``_preempt_for_locked``):
-        - reclaim only RESTORES the guarantee: admitting ``cand`` must keep
-          its queue within its own share (borrowing beyond share rides free
-          capacity only, never other queues' evictions);
-        - victims come only from queues currently OVER their share, most
-          over-share queue first, and eviction stops the moment a victim
-          queue is no longer over its share — a queue AT or UNDER its share
-          is never touched. Granularity is whole gangs, so the LAST
-          eviction may land the borrower below its share (a 3 GB app over
-          a 2 GB share evicts whole): that app only ever ran by borrowing,
-          and it re-queues with under-share priority like any waiter;
-        - within a victim queue: lowest priority first, newest first — the
-          newest borrowers repay first;
-        - grace (``tony.pool.preemption.grace-ms``): only heads waiting at
-          least this long trigger cross-queue kills.
-        """
-        demand = (cand.demand_memory, cand.demand_vcores, cand.demand_chips)
-        cap_cand = self.queues.get(cand.queue, 1.0) * totals[primary]
-        if queue_used.get(cand.queue, 0) + demand[primary] > cap_cand:
-            return False  # head would overshoot its own guarantee
-        if time.monotonic() - cand.wait_since < self.preemption_grace_ms / 1000:
-            return False
-        trial = list(free)
-        trial_used = dict(queue_used)
-        chosen: list[_App] = []
-        while not self._fits(trial, demand):
-            # most over-share queue first (by primary-dimension excess)
-            best: tuple[int, _App] | None = None
-            for q, share in self.queues.items():
-                if q == cand.queue:
-                    continue
-                excess = trial_used.get(q, 0) - share * totals[primary]
-                if excess <= 0:
-                    continue  # at or under share: protected from reclaim
-                apps = sorted(
-                    (a for a in self._apps.values()
-                     if a.admitted and a.queue == q and a not in chosen),
-                    key=lambda a: (a.priority, -a.seq),
-                )
-                if apps and (best is None or excess > best[0]):
-                    best = (excess, apps[0])
-            if best is None:
-                return False  # no eligible borrower left and cand still unfit
-            v = best[1]
-            c = self._claim_locked(v)
-            for i in range(3):
-                trial[i] += c[i]
-            trial_used[v.queue] -= c[primary]
-            chosen.append(v)
-        for v in chosen:
-            self._evict_locked(v, free, claims, queue_used, primary)
-        admit(cand)
-        return True
+    # ------------------------------------------------ drain lifecycle
+    def _preempt_notice_locked(self, app_id: str) -> dict[str, Any] | None:
+        """The piggyback ``poll_exited`` carries back to a victim AM: the
+        in-flight drain/shrink request, or a cancellation. Both are
+        delivered at-least-once (re-sent every poll until superseded or the
+        app leaves the pool): a response lost in transit must not leave the
+        AM acting on a drain the pool already cancelled — the AM's handler
+        is idempotent by req_id either way."""
+        entry = self._drains.get(app_id)
+        if entry is not None and not entry["escalated"]:
+            return {
+                "req_id": entry["req_id"],
+                "mode": entry["mode"],
+                "deadline_ms": max(int((entry["deadline"] - time.monotonic()) * 1000), 0),
+                "shrink_workers": entry["workers"],
+            }
+        req_id = self._cancelled.get(app_id)
+        if req_id is not None:
+            return {"cancelled": req_id}
+        return None
+
+    def _resolve_drain_locked(self, app_id: str, *, mode: str) -> None:
+        entry = self._drains.pop(app_id, None)
+        if entry is None:
+            return
+        self._jlog_locked("drain_done", app_id=app_id)
+        _POOL_PREEMPTIONS.inc(mode=mode)
+        if mode in ("drain", "shrink"):
+            _POOL_DRAIN_SECONDS.observe(time.monotonic() - entry["t0"])
+            obs_logging.info(
+                f"[tony-pool] {app_id} {'yielded' if mode == 'drain' else 'shed workers'} "
+                f"cooperatively after {time.monotonic() - entry['t0']:.1f}s")
+
+    def _check_drains_locked(self) -> None:
+        """Resolve drain/shrink episodes whose victims complied: a draining
+        app with no RUNNING containers yielded; a shrinking app whose held
+        primary capacity dropped to its reduced demand shed. Called from the
+        container exit/release paths (the transitions that free capacity)."""
+        primary = 2 if self._totals_locked()[2] > 0 else 0
+        for app_id, entry in list(self._drains.items()):
+            if entry["escalated"]:
+                continue
+            held = self._held_locked(app_id)
+            if entry["mode"] == "drain":
+                if not any(
+                    rec["app_id"] == app_id and rec["state"] == _RUNNING
+                    for rec in self._containers.values()
+                ):
+                    self._resolve_drain_locked(app_id, mode="drain")
+            elif held[primary] <= entry["target_primary"]:
+                self._resolve_drain_locked(app_id, mode="shrink")
+
+    def _escalate_drains_locked(self) -> None:
+        """Deadline enforcement (liveness loop): a victim that neither
+        yielded nor shed by ``tony.pool.preemption.drain-ms`` gets the
+        classic kill path — cooperation is an optimization, never a veto."""
+        now = time.monotonic()
+        for app_id, entry in list(self._drains.items()):
+            if self._drains.get(app_id) is not entry:
+                # a nested _schedule_locked() from an earlier escalation this
+                # tick re-admitted (and cancelled) this victim: killing it
+                # off the stale snapshot would defeat the cancellation
+                continue
+            if entry["escalated"] or now < entry["deadline"]:
+                continue
+            entry["escalated"] = True
+            if entry["mode"] == "shrink":
+                # the partial reclaim failed: fall back to the whole-gang
+                # eviction the shrink was trying to avoid — and restore the
+                # pre-shrink demand, which never actually shrank
+                v = self._apps.get(app_id)
+                if v is not None and v.admitted:
+                    current = (v.demand_memory, v.demand_vcores, v.demand_chips)
+                    if current == tuple(entry.get("reduced_demand") or current):
+                        # demand untouched since the shrink was issued: the
+                        # reduction is fiction, restore it. An AM that
+                        # re-registered meanwhile (its rebuild in flight)
+                        # owns its demand — inflating it would be worse.
+                        undo = entry.get("undo_demand") or (0, 0, 0)
+                        v.demand_memory += int(undo[0])
+                        v.demand_vcores += int(undo[1])
+                        v.demand_chips += int(undo[2])
+                        v.elastic_slack += int(entry.get("workers", 0))
+                    v.admitted, v.preempted = False, True
+                    v.wait_since = time.monotonic()
+                    v.wait_unix = time.time()
+                    _POOL_EVICTIONS.inc(queue=v.queue)
+                    self._journal_app_locked(v)
+            obs_logging.warning(
+                f"[tony-pool] {entry['mode']} of {app_id} escalated to kill "
+                f"after {now - entry['t0']:.1f}s (deadline passed)")
+            for rec in self._containers.values():
+                if rec["app_id"] == app_id and rec["state"] == _RUNNING:
+                    self._preempt_cids.add(rec["id"])
+                    self._request_kill_locked(rec)
+            self._drains.pop(app_id, None)
+            self._jlog_locked("drain_done", app_id=app_id)
+            _POOL_PREEMPTIONS.inc(mode="kill")
+            self._schedule_locked()
 
     # -------------------------------------------------------------- internal
     def _request_kill_locked(self, rec: dict[str, Any]) -> None:
@@ -1117,6 +1237,7 @@ class PoolService:
         self._free_locked(rec)
         self._app_exits.setdefault(rec["app_id"], {})[cid] = rc
         self._jlog_locked("exited", cid=cid, rc=rc)
+        self._check_drains_locked()
         self._schedule_locked()
 
     def _release_locked(self, cid: str) -> None:
@@ -1125,6 +1246,9 @@ class PoolService:
             self._jlog_locked("released", cid=cid)
         if rec is not None and rec["state"] == _RUNNING:
             self._free_locked(rec)
+            # a cooperative victim yields by releasing its containers (the
+            # AM's gang restart): resolve the drain the moment it completes
+            self._check_drains_locked()
 
     def _mark_node_lost_locked(self, node: _Node, reason: str) -> None:
         node.alive = False
@@ -1144,6 +1268,9 @@ class PoolService:
                 for node in self._nodes.values():
                     if node.alive and now - node.last_heartbeat > timeout_s:
                         self._mark_node_lost_locked(node, reason="missed heartbeats")
+                # cooperative-drain deadline enforcement: victims that never
+                # yielded/shed get the classic kill path
+                self._escalate_drains_locked()
 
 
 class RemoteResourceManager(ResourceManager):
@@ -1162,6 +1289,10 @@ class RemoteResourceManager(ResourceManager):
         self._agents: dict[tuple[str, int], RpcClient] = {}
         self._containers: dict[str, tuple[Container, tuple[str, int], int]] = {}
         self._span: list[int] | None = None
+        self._preempt_notice: dict[str, Any] | None = None  # piggybacked on poll_exited
+        # pre-drain pool service: rejects the cooperative-preemption kwargs
+        # with a TypeError error frame — detected once, then spoken legacy
+        self._legacy_pool = False
         self._lock = threading.Lock()
 
     def _agent(self, addr: tuple[str, int]) -> RpcClient:
@@ -1171,9 +1302,16 @@ class RemoteResourceManager(ResourceManager):
                 cli = self._agents[addr] = RpcClient(addr[0], addr[1], secret=self.secret)
             return cli
 
-    def register_app(self, queue: str, priority: int, demand: Resources) -> None:
-        self.rm.call(
-            "register_app",
+    @staticmethod
+    def _is_unknown_kwarg(e: Exception) -> bool:
+        """An old pool's error frame for a parameter it doesn't know."""
+        return "TypeError" in str(e) and "unexpected keyword" in str(e)
+
+    def register_app(
+        self, queue: str, priority: int, demand: Resources,
+        elastic_unit: Resources | None = None, elastic_slack: int = 0,
+    ) -> None:
+        base = dict(
             app_id=self.app_id,
             queue=queue,
             priority=priority,
@@ -1181,6 +1319,23 @@ class RemoteResourceManager(ResourceManager):
             vcores=demand.vcores,
             chips=demand.chips,
         )
+        if not self._legacy_pool:
+            try:
+                self.rm.call(
+                    "register_app", **base,
+                    elastic_unit=(
+                        [elastic_unit.memory_bytes, elastic_unit.vcores,
+                         elastic_unit.chips]
+                        if elastic_unit is not None else [0, 0, 0]
+                    ),
+                    elastic_slack=int(elastic_slack),
+                )
+                return
+            except RpcError as e:
+                if not self._is_unknown_kwarg(e):
+                    raise
+                self._legacy_pool = True  # pre-drain pool: speak legacy from here
+        self.rm.call("register_app", **base)
 
     def total_capacity(self) -> Resources | None:
         try:
@@ -1342,15 +1497,44 @@ class RemoteResourceManager(ResourceManager):
 
     def poll_exited(self) -> dict[str, int]:
         try:
-            exits = {cid: int(rc) for cid, rc in self.rm.call("poll_exited", app_id=self.app_id).items()}
+            if self._legacy_pool:
+                got = self.rm.call("poll_exited", app_id=self.app_id)
+            else:
+                try:
+                    got = self.rm.call(
+                        "poll_exited", app_id=self.app_id, with_preempt=True)
+                except RpcError as e:
+                    # a pre-drain pool rejects the kwarg itself — without
+                    # this fallback every poll would error and container
+                    # exits would never be delivered for the life of the skew
+                    if not self._is_unknown_kwarg(e):
+                        raise
+                    self._legacy_pool = True
+                    got = self.rm.call("poll_exited", app_id=self.app_id)
         except (RpcError, OSError):
             return {}
+        if isinstance(got, dict) and "exits" in got:
+            # cooperative-preemption piggyback: the pool's drain/shrink
+            # notice rides the poll the AM already makes every tick
+            with self._lock:
+                self._preempt_notice = got.get("preempt") or None
+            exits = {cid: int(rc) for cid, rc in (got.get("exits") or {}).items()}
+        else:
+            # legacy pool: a flat {cid: rc} map and no notices
+            exits = {cid: int(rc) for cid, rc in got.items()}
         if self.chaos is not None:
             # chaos node-loss / preempt against a remote pool: the kill rides
             # the real AM→agent path, the exit code is synthesized here (the
             # same seam the in-process RMs use)
             exits = self.chaos.perturb_container_exits(self, exits)
         return exits
+
+    def poll_preemption(self) -> dict[str, Any] | None:
+        """The drain/shrink notice (or cancellation) piggybacked on the most
+        recent ``poll_exited`` — the AM's monitor loop reads it right after
+        handling container exits."""
+        with self._lock:
+            return self._preempt_notice
 
     def kill_container(self, container: Container) -> None:
         with self._lock:
@@ -1415,6 +1599,10 @@ def main(argv: list[str] | None = None) -> int:
         queues=parse_queue_spec(config.get(keys.POOL_QUEUES) or "default=1.0"),
         preemption=config.get_bool(keys.POOL_PREEMPTION_ENABLED),
         preemption_grace_ms=config.get_time_ms(keys.POOL_PREEMPTION_GRACE_MS, 0),
+        preemption_drain_ms=config.get_time_ms(keys.POOL_PREEMPTION_DRAIN_MS, 0),
+        preemption_min_runtime_ms=config.get_time_ms(keys.POOL_PREEMPTION_MIN_RUNTIME_MS, 0),
+        preemption_budget=config.get_int(keys.POOL_PREEMPTION_BUDGET, 0),
+        preemption_budget_window_ms=config.get_time_ms(keys.POOL_PREEMPTION_BUDGET_WINDOW_MS, 60_000),
         journal_path=args.journal_file
         if args.journal_file is not None
         else (config.get(keys.POOL_JOURNAL_FILE) or None),

@@ -300,10 +300,23 @@ class ResourceManager(ABC):
     #: container faults (node-loss, preempt) apply at the poll_exited seam
     chaos = None
 
-    def register_app(self, queue: str, priority: int, demand: "Resources") -> None:
+    def register_app(
+        self, queue: str, priority: int, demand: "Resources",
+        elastic_unit: "Resources | None" = None, elastic_slack: int = 0,
+    ) -> None:
         """Announce the app's queue, priority, and TOTAL gang demand to the
-        pool (ApplicationSubmissionContext analog). In-process pools are
-        single-tenant — only the remote pool service consumes this."""
+        pool (ApplicationSubmissionContext analog), plus the elastic
+        partial-reclaim contract (resources one shed worker frees, and how
+        many workers the app may shed — zero when not elastic). In-process
+        pools are single-tenant — only the remote pool service consumes
+        this."""
+
+    def poll_preemption(self) -> "dict | None":
+        """The pool's cooperative-preemption notice for this app (drain /
+        shrink request, or a cancellation), observed on the most recent
+        ``poll_exited``. None for single-tenant in-process pools — only the
+        remote pool service preempts cooperatively."""
+        return None
 
     @abstractmethod
     def allocate(self, job_type: str, task_index: int, resources: Resources) -> Container:

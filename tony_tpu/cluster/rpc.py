@@ -325,5 +325,6 @@ APPLICATION_RPC_METHODS = [
     "start_profile",         # arm an on-demand profiler capture (tony profile)
     "get_profile_status",    # per-task capture status for the in-flight request
     "report_profile_status", # executors report delivery/capture back to the AM
+    "report_drain_saved",    # executors report the child's urgent pre-preemption checkpoint
     "get_goodput",           # live goodput ledger + straggler skew + active alerts
 ]

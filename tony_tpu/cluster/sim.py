@@ -1,0 +1,556 @@
+"""Discrete-event scheduler simulator: prove the pool policy, no TPUs needed.
+
+``tony sim`` replays thousands of seeded synthetic job arrivals against the
+EXACT :class:`~tony_tpu.cluster.policy.PreemptionPolicy` the live
+``PoolService`` runs (cluster/pool.py imports the same class — a parity test
+greps for re-divergence), with a virtual clock injected so a 10-hour trace
+simulates in milliseconds. After every event the simulator asserts the
+invariants that make the policy's fairness PROVABLE rather than anecdotal
+(docs/scheduling.md):
+
+- **no-oversubscription** — admitted demand claims never exceed pool
+  capacity, in any dimension, at any instant;
+- **no-starvation** — every job eventually completes (the run ends with an
+  empty pool; a livelocked policy would leave waiters forever);
+- **share-restoration** — an under-share head whose demand fits its own
+  guarantee is admitted within ``grace + drain`` of starting to wait (plus
+  one decision latency), preemption enabled;
+- **eviction-budget** — a queue never causes more evictions/shrinks per
+  rolling window than ``tony.pool.preemption.budget`` allows, and no single
+  admission evicts more apps than were admitted at decision time;
+- **work-conservation** — the pool is never left idle while a waiter's
+  demand fits the EMPTY pool (modulo the share gate, which the policy loop
+  discharges by construction).
+
+The simulated world mirrors the live pool's semantics: claims move at
+eviction time while physical occupancy frees only when the victim actually
+dies (drain deadline, or earlier if the victim is cooperative); a
+cooperative victim checkpoints at yield time and loses nothing, a
+non-cooperative one is killed at the deadline and replays the work since its
+last periodic checkpoint (the ``restart_rework`` the goodput ledger meters);
+an elastic victim asked to shrink sheds workers after a short rebuild and
+keeps running at reduced size.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import random
+import zlib
+from dataclasses import dataclass, field
+from typing import Any
+
+from tony_tpu.cluster.policy import AppView, Decision, PreemptionPolicy, Vec
+
+
+@dataclass
+class SimJob:
+    """One synthetic arrival."""
+
+    app_id: str
+    queue: str
+    arrival_s: float
+    work_s: float                      # productive seconds to complete
+    demand: Vec
+    priority: int = 0
+    cooperative: bool = True           # yields (with a checkpoint) inside the drain
+    checkpoint_every_s: float = 60.0   # periodic checkpoint cadence (kill-path rework)
+    elastic_unit: Vec = (0, 0, 0)
+    elastic_slack: int = 0
+
+
+@dataclass
+class _JobState:
+    job: SimJob
+    view: AppView
+    remaining_s: float
+    arrived: bool = False
+    started_at: float | None = None    # running since (None → not occupying)
+    expected_done_at: float = -1.0     # stale-completion fence across evictions
+    checkpointed_s: float = 0.0        # work safely on disk
+    wait_started: float | None = None
+    #: since when the share-restoration contract has CONTINUOUSLY applied to
+    #: this app (queue head, within guarantee, deficit covered by other
+    #: queues' over-share borrowing) — the invariant's clock
+    restorable_since: float | None = None
+    waited_total_s: float = 0.0
+    evictions: int = 0
+    shrinks: int = 0
+    rework_s: float = 0.0
+    done_at: float | None = None
+    dying_until: float | None = None   # evicted: physical release at this time
+
+
+@dataclass
+class SimReport:
+    seed: int
+    jobs: int
+    completed: int
+    violations: list[str] = field(default_factory=list)
+    evictions: int = 0
+    evictions_cooperative: int = 0
+    evictions_killed: int = 0
+    shrinks: int = 0
+    total_rework_s: float = 0.0
+    max_wait_s: float = 0.0
+    wall_s: float = 0.0
+    utilization: float = 0.0           # busy primary-capacity-seconds / total
+
+    def ok(self) -> bool:
+        return not self.violations and self.completed == self.jobs
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self.__dict__)
+
+
+class PoolSimulator:
+    """Event-driven replay of arrivals/completions/evictions/drains against
+    the shared policy. All times are virtual seconds from 0."""
+
+    def __init__(
+        self,
+        queues: dict[str, float],
+        totals: Vec,
+        *,
+        preemption: bool = True,
+        grace_ms: int = 0,
+        drain_ms: int = 5_000,
+        min_runtime_ms: int = 0,
+        eviction_budget: int = 0,
+        budget_window_ms: int = 60_000,
+        coop_yield_s: float = 1.0,      # a cooperative victim's checkpoint+yield latency
+        shrink_rebuild_s: float = 2.0,  # an elastic victim's shed/rebuild latency
+        seed: int = 0,
+    ):
+        self.now = 0.0
+        self.queues = dict(queues)
+        self.totals = totals
+        self.drain_s = drain_ms / 1000.0
+        self.grace_s = grace_ms / 1000.0
+        self.coop_yield_s = coop_yield_s
+        self.shrink_rebuild_s = shrink_rebuild_s
+        self.eviction_budget = eviction_budget
+        self.budget_window_ms = budget_window_ms
+        self.policy = PreemptionPolicy(
+            queues,
+            preemption=preemption,
+            grace_ms=grace_ms,
+            min_runtime_ms=min_runtime_ms,
+            eviction_budget=eviction_budget,
+            budget_window_ms=budget_window_ms,
+            clock=lambda: self.now,
+        )
+        self.seed = seed
+        self._events: list[tuple[float, int, str, str]] = []  # (t, seq, kind, app_id)
+        self._seq = 0
+        self._jobs: dict[str, _JobState] = {}
+        # arrived-and-unfinished jobs: the per-event working set (the policy
+        # views and the invariant sweeps must not rescan thousands of done
+        # or future jobs on every event)
+        self._active: dict[str, _JobState] = {}
+        self._tick_pending = False
+        self._stagnant_ticks = 0
+        self._charge_log: list[tuple[float, str]] = []        # (t, aggressor queue)
+        self.report = SimReport(seed=seed, jobs=0, completed=0)
+        self._busy_primary_s = 0.0
+        self._last_t = 0.0
+
+    # ------------------------------------------------------------- plumbing
+    def _push(self, t: float, kind: str, app_id: str) -> None:
+        self._seq += 1
+        heapq.heappush(self._events, (t, self._seq, kind, app_id))
+
+    @property
+    def _primary(self) -> int:
+        return 2 if self.totals[2] > 0 else 0
+
+    def _occupancy(self) -> Vec:
+        """Physical usage: running jobs plus evicted-but-not-yet-dead ones
+        (their containers still hold nodes, exactly like the live pool)."""
+        used = [0, 0, 0]
+        for st in self._active.values():
+            if st.started_at is not None or st.dying_until is not None:
+                for i in range(3):
+                    used[i] += st.view.held[i]
+        return tuple(used)  # type: ignore[return-value]
+
+    def _accrue_busy(self, t: float) -> None:
+        self._busy_primary_s += self._occupancy()[self._primary] * (t - self._last_t)
+        self._last_t = t
+
+    # ------------------------------------------------------------ lifecycle
+    def run(self, jobs: list[SimJob], horizon_s: float = 10_000_000.0) -> SimReport:
+        self.report.jobs = len(jobs)
+        for j in jobs:
+            self._jobs[j.app_id] = _JobState(
+                job=j,
+                view=AppView(
+                    app_id=j.app_id, queue=j.queue, priority=j.priority,
+                    demand=j.demand, elastic_unit=j.elastic_unit,
+                    elastic_slack=j.elastic_slack,
+                ),
+                remaining_s=j.work_s,
+            )
+            self._push(j.arrival_s, "arrive", j.app_id)
+        while self._events:
+            t, _, kind, app_id = heapq.heappop(self._events)
+            if t > horizon_s:
+                self.report.violations.append(
+                    f"horizon exceeded at {t:.0f}s with {kind}:{app_id} pending")
+                break
+            self._accrue_busy(t)
+            self.now = t
+            if kind == "tick":
+                self._stagnant_ticks += 1
+                if self._stagnant_ticks > 600:
+                    # ten virtual minutes of ticks with no other event: the
+                    # remaining waiters are starved/livelocked — report it
+                    # instead of simulating to the horizon
+                    self.report.violations.append(
+                        f"livelock: no progress for {self._stagnant_ticks} "
+                        f"consecutive ticks at t={self.now:.0f}s")
+                    break
+            else:
+                self._stagnant_ticks = 0
+            getattr(self, f"_on_{kind}")(app_id)
+            if not self._schedule().empty():
+                self._stagnant_ticks = 0  # a tick that admitted IS progress
+            self._check_invariants()
+            # the live pool re-runs admission on every AM allocate retry; the
+            # sim's analog is a 1 Hz tick while anyone waits, so decisions
+            # deferred by grace / minimum-runtime protection / a draining
+            # victim are revisited instead of waiting for the next arrival
+            if not self._tick_pending and any(
+                not st.view.admitted for st in self._active.values()
+            ):
+                self._tick_pending = True
+                self._push(self.now + 1.0, "tick", "")
+        self.report.wall_s = self.now
+        self.report.completed = sum(
+            1 for st in self._jobs.values() if st.done_at is not None)
+        if self.report.completed != self.report.jobs:
+            stuck = sorted(
+                st.view.app_id for st in self._jobs.values() if st.done_at is None)
+            self.report.violations.append(
+                f"starvation: {len(stuck)} job(s) never completed: {stuck[:5]}...")
+        total = self.totals[self._primary] * max(self.now, 1e-9)
+        self.report.utilization = round(self._busy_primary_s / total, 4)
+        self.report.total_rework_s = round(
+            sum(st.rework_s for st in self._jobs.values()), 3)
+        self.report.max_wait_s = round(
+            max((st.waited_total_s for st in self._jobs.values()), default=0.0), 3)
+        return self.report
+
+    # ------------------------------------------------------------ event handlers
+    def _on_arrive(self, app_id: str) -> None:
+        st = self._jobs[app_id]
+        st.arrived = True
+        self._active[app_id] = st
+        st.view.seq = self._seq  # arrival order IS the FIFO order
+        st.view.wait_since = self.now
+        st.wait_started = self.now
+
+    def _on_tick(self, app_id: str) -> None:
+        self._tick_pending = False  # the run loop's _schedule does the work
+
+    def _on_complete(self, app_id: str) -> None:
+        st = self._jobs[app_id]
+        if (
+            st.started_at is None
+            or st.done_at is not None
+            or abs(self.now - st.expected_done_at) > 1e-6
+        ):
+            return  # stale completion (the job was evicted and resumed since)
+        st.remaining_s = 0.0
+        st.done_at = self.now
+        st.started_at = None
+        st.view.admitted = False
+        st.view.held = (0, 0, 0)
+        self._active.pop(app_id, None)
+
+    def _on_die(self, app_id: str) -> None:
+        """An evicted victim's containers actually exit: cooperative yield
+        (checkpoint fresh, no rework) or deadline kill (replay since the last
+        periodic checkpoint)."""
+        st = self._jobs[app_id]
+        if st.dying_until is None:
+            return  # already dead (or finished) — stale event
+        cooperative = st.job.cooperative and self.drain_s >= self.coop_yield_s
+        if cooperative:
+            self.report.evictions_cooperative += 1
+        else:
+            self.report.evictions_killed += 1
+            done = st.job.work_s - st.remaining_s
+            ck = st.job.checkpoint_every_s
+            checkpointed = (done // ck) * ck if ck > 0 else 0.0
+            lost = done - max(checkpointed, st.checkpointed_s)
+            st.remaining_s += lost
+            st.rework_s += lost
+        st.dying_until = None
+        st.view.held = (0, 0, 0)
+
+    def _on_shed(self, app_id: str) -> None:
+        """An elastic victim finishes its shrink rebuild: physical occupancy
+        drops to the reduced demand; the job keeps running (slower —
+        remaining work scales with the lost workers)."""
+        st = self._jobs[app_id]
+        if st.started_at is None or st.done_at is not None:
+            return  # was evicted whole (or finished) before the shed landed
+        # bank the progress of the current run segment before rescaling
+        st.remaining_s = max(st.remaining_s - (self.now - st.started_at), 0.0)
+        old = st.view.held
+        new = st.view.demand  # reduced by the policy at shrink time
+        if old[self._primary] > 0 and new[self._primary] > 0:
+            st.remaining_s *= old[self._primary] / new[self._primary]
+        st.view.held = new
+        st.view.shrink_pending = False
+        st.shrinks += 1
+        self.report.shrinks += 1
+        self._reschedule_completion(st)
+
+    # ------------------------------------------------------------ scheduling
+    def _reschedule_completion(self, st: _JobState) -> None:
+        st.started_at = self.now
+        st.expected_done_at = self.now + st.remaining_s
+        self._push(st.expected_done_at, "complete", st.view.app_id)
+
+    def _schedule(self) -> Decision:
+        views = [
+            st.view for st in self._active.values()
+            if st.view.admitted or st.dying_until is None
+        ]
+        decision = self.policy.schedule(views, self.totals)
+        for sh in decision.shrink:
+            self._charge_log.append((self.now, self._jobs[sh.for_app].view.queue))
+            self._push(self.now + self.shrink_rebuild_s, "shed", sh.app_id)
+        for ev in decision.evict:
+            st = self._jobs[ev.app_id]
+            self.report.evictions += 1
+            st.evictions += 1
+            self._charge_log.append((self.now, self._jobs[ev.for_app].view.queue))
+            if st.started_at is not None:
+                st.remaining_s = max(st.remaining_s - (self.now - st.started_at), 0.0)
+            st.started_at = None
+            # cooperative victims yield (checkpoint fresh) well inside the
+            # drain; non-cooperative ones occupy nodes until the deadline
+            coop = st.job.cooperative and self.drain_s >= self.coop_yield_s
+            death = self.now + (min(self.coop_yield_s, self.drain_s) if coop else self.drain_s)
+            if coop:
+                st.checkpointed_s = st.job.work_s - st.remaining_s
+            st.dying_until = death
+            st.wait_started = self.now
+            self._push(death, "die", ev.app_id)
+        for app_id in decision.admit:
+            st = self._jobs[app_id]
+            if st.wait_started is not None:
+                st.waited_total_s += self.now - st.wait_started
+                st.wait_started = None
+            # physical start: the sim starts work immediately on admission
+            # (claims == occupancy for the admittee; a dying victim's nodes
+            # overlap transiently, exactly like the live pool's drain)
+            st.view.held = st.view.demand
+            self._reschedule_completion(st)
+        return decision
+
+    # ------------------------------------------------------------ invariants
+    def _check_invariants(self) -> None:
+        rep = self.report
+        # 1. admitted demand claims never oversubscribe capacity
+        admitted_active = [st for st in self._active.values() if st.view.admitted]
+        for i in range(3):
+            claimed = sum(st.view.demand[i] for st in admitted_active)
+            if claimed > self.totals[i]:
+                rep.violations.append(
+                    f"oversubscription at t={self.now:.1f}s dim {i}: "
+                    f"{claimed} > {self.totals[i]}")
+        # 2. share-restoration: a waiting QUEUE HEAD within its guarantee,
+        # whose deficit is covered by other queues' over-share borrowing,
+        # is admitted within grace + drain + min-runtime protection (+ one
+        # coop yield and one sim decision tick). The clock runs only while
+        # the condition holds CONTINUOUSLY — waiting behind one's own queue,
+        # or on queues within their shares, is legitimate queueing, not a
+        # broken guarantee.
+        bound = (
+            self.grace_s + self.drain_s + self.coop_yield_s
+            + self.policy.min_runtime_ms / 1000.0 + 2.0
+        )
+        if self.policy.preemption and self.eviction_budget <= 0:
+            p = self._primary
+            active = list(self._active.values())
+            used_by_q = {q: 0 for q in self.queues}
+            for st in active:
+                if st.view.admitted:
+                    used_by_q[st.view.queue] = (
+                        used_by_q.get(st.view.queue, 0) + st.view.claim()[p])
+            free_p = self.totals[p] - sum(used_by_q.values())
+            excess_elsewhere = {
+                q: sum(
+                    max(used_by_q.get(qq, 0) - self.queues[qq] * self.totals[p], 0)
+                    for qq in self.queues if qq != q
+                )
+                for q in self.queues
+            }
+            heads: dict[str, _JobState] = {}
+            for st in sorted(active, key=lambda s: s.view.sort_key):
+                if not st.view.admitted and st.dying_until is None:
+                    heads.setdefault(st.view.queue, st)
+            head_set = set(id(h) for h in heads.values())
+            for st in active:
+                if id(st) not in head_set:
+                    st.restorable_since = None
+                    continue
+                q = st.view.queue
+                d = st.view.demand[p]
+                restorable = (
+                    used_by_q.get(q, 0) + d <= self.queues[q] * self.totals[p] + 1e-9
+                    and free_p + excess_elsewhere[q] >= d
+                    and free_p < d  # a head that plainly fits is invariant 5's job
+                )
+                if not restorable:
+                    st.restorable_since = None
+                elif st.restorable_since is None:
+                    st.restorable_since = self.now
+                elif self.now - st.restorable_since > bound:
+                    rep.violations.append(
+                        f"share-restoration: head {st.view.app_id} of {q!r} "
+                        f"(under-share, deficit reclaimable) waited "
+                        f"{self.now - st.restorable_since:.1f}s > bound {bound:.1f}s")
+                    st.restorable_since = None  # report once per episode
+        # 3. eviction budget respected per rolling window
+        if self.eviction_budget > 0:
+            window = self.budget_window_ms / 1000.0
+            for q in self.queues:
+                recent = [t for t, qq in self._charge_log if qq == q and self.now - t < window]
+                if len(recent) > self.eviction_budget:
+                    rep.violations.append(
+                        f"budget: queue {q!r} caused {len(recent)} disruptions "
+                        f"inside {window:.0f}s (budget {self.eviction_budget})")
+        # 4. work conservation: never idle while a waiter fits the EMPTY pool
+        # and nothing is still draining toward it
+        dying = [st for st in self._active.values() if st.dying_until is not None]
+        if not admitted_active and not dying:
+            for st in self._active.values():
+                if st.wait_started is not None and all(
+                    d <= t for d, t in zip(st.view.demand, self.totals)
+                ):
+                    rep.violations.append(
+                        f"work-conservation: pool idle at t={self.now:.1f}s while "
+                        f"{st.view.app_id} (fits empty pool) waits")
+                    break
+
+
+# ---------------------------------------------------------------------------
+# seeded synthetic workload mixes (tony sim --mix ...)
+# ---------------------------------------------------------------------------
+GB = 1024 ** 3
+
+MIXES = ("batch", "bursty", "elastic", "priority")
+
+
+def generate_jobs(
+    mix: str, n: int, queues: dict[str, float], seed: int
+) -> list[SimJob]:
+    """``n`` seeded arrivals shaped by the named mix. Deterministic per
+    (mix, n, queues, seed) ACROSS processes — the whole point is
+    reproducible counterexamples, and ``hash()`` is salted per interpreter."""
+    rng = random.Random((zlib.crc32(mix.encode()) & 0xFFFF) * 1_000_003 + seed)
+    qnames = sorted(queues)
+    jobs: list[SimJob] = []
+    t = 0.0
+    # every mix targets an offered load of ~0.7-0.85 of the default 8 GB
+    # pool: a stable system whose queues form and drain — a permanently
+    # overloaded pool has unbounded waits by arithmetic, not by policy bug
+    for i in range(n):
+        if mix == "batch":
+            t += rng.expovariate(1 / 10.0)
+            work = rng.uniform(10, 50)
+            demand = (rng.choice([1, 2, 3]) * GB, rng.choice([1, 2]), 0)
+            prio, elastic = 0, False
+        elif mix == "bursty":
+            # arrival bursts: long quiet stretches then 5-15 jobs at once
+            if i % rng.randint(5, 15) == 0:
+                t += rng.expovariate(1 / 90.0)
+            work = rng.uniform(5, 30)
+            demand = (rng.choice([1, 2, 4]) * GB, 1, 0)
+            prio, elastic = rng.choice([0, 0, 0, 5]), False
+        elif mix == "elastic":
+            t += rng.expovariate(1 / 20.0)
+            work = rng.uniform(20, 60)
+            workers = rng.choice([2, 4])
+            demand = (workers * GB, workers, 0)
+            prio, elastic = 0, rng.random() < 0.6
+        elif mix == "priority":
+            t += rng.expovariate(1 / 6.0)
+            work = rng.uniform(10, 40)
+            demand = (rng.choice([1, 2]) * GB, 1, 0)
+            prio, elastic = rng.choice([0, 1, 5, 9]), False
+        else:
+            raise ValueError(f"unknown mix {mix!r} (choose from {MIXES})")
+        queue = rng.choice(qnames)
+        unit = (GB, 1, 0) if elastic else (0, 0, 0)
+        slack = (demand[0] // GB - 1) if elastic else 0
+        jobs.append(SimJob(
+            app_id=f"{mix}-{i:05d}",
+            queue=queue,
+            arrival_s=round(t, 3),
+            work_s=round(work, 3),
+            demand=demand,
+            priority=prio,
+            cooperative=rng.random() < 0.8,
+            checkpoint_every_s=rng.choice([30.0, 60.0, 120.0]),
+            elastic_unit=unit,
+            elastic_slack=int(slack),
+        ))
+    return jobs
+
+
+def run_mix(
+    mix: str,
+    n: int = 1000,
+    *,
+    queues: dict[str, float] | None = None,
+    # vcores deliberately ample: queue shares guarantee the PRIMARY dimension
+    # (memory here, chips on a TPU pool) — a workload that binds on a
+    # secondary dimension is outside the share-restoration contract
+    totals: Vec = (8 * GB, 256, 0),
+    seed: int = 0,
+    preemption: bool = True,
+    grace_ms: int = 2_000,
+    drain_ms: int = 5_000,
+    min_runtime_ms: int = 3_000,
+    eviction_budget: int = 0,
+    budget_window_ms: int = 60_000,
+) -> SimReport:
+    """One seeded simulation over ``n`` arrivals of the named mix — the unit
+    tier-1 asserts invariants over, and what ``tony sim`` wraps."""
+    queues = queues or {"prod": 0.6, "dev": 0.4}
+    sim = PoolSimulator(
+        queues, totals,
+        preemption=preemption, grace_ms=grace_ms, drain_ms=drain_ms,
+        min_runtime_ms=min_runtime_ms, eviction_budget=eviction_budget,
+        budget_window_ms=budget_window_ms, seed=seed,
+    )
+    return sim.run(generate_jobs(mix, n, queues, seed))
+
+
+def render_report(report: SimReport, as_json: bool = False) -> str:
+    if as_json:
+        return json.dumps(report.to_dict(), indent=1)
+    lines = [
+        f"sim seed {report.seed}: {report.completed}/{report.jobs} jobs completed "
+        f"over {report.wall_s:.0f} virtual seconds",
+        f"  utilization (primary dim): {report.utilization:.1%}",
+        f"  evictions: {report.evictions} "
+        f"({report.evictions_cooperative} cooperative yield, "
+        f"{report.evictions_killed} deadline kill), shrinks: {report.shrinks}",
+        f"  rework replayed after kills: {report.total_rework_s:.1f}s",
+        f"  max wait: {report.max_wait_s:.1f}s",
+    ]
+    if report.violations:
+        lines.append(f"  INVARIANT VIOLATIONS ({len(report.violations)}):")
+        lines.extend(f"    - {v}" for v in report.violations[:20])
+    else:
+        lines.append("  invariants: OK (no-oversubscription, no-starvation, "
+                     "share-restoration, eviction-budget, work-conservation)")
+    return "\n".join(lines)

@@ -132,6 +132,20 @@ POOL_PREEMPTION_ENABLED = "tony.pool.preemption.enabled"
 # before the scheduler evicts over-share borrowers from OTHER queues
 # (same-queue priority preemption has no grace — it is an explicit ranking).
 POOL_PREEMPTION_GRACE_MS = "tony.pool.preemption.grace-ms"
+# Cooperative drain window (docs/scheduling.md): eviction becomes two-phase —
+# the victim AM learns it is DRAINING through its poll path, triggers an
+# urgent checkpoint, and yields; the pool escalates to the kill path only at
+# this deadline. 0 (the default) keeps the classic immediate kill.
+POOL_PREEMPTION_DRAIN_MS = "tony.pool.preemption.drain-ms"
+# Anti-thrash guard: a just-admitted app is not evictable (or shrinkable)
+# until it has run this long — evict→admit→evict ping-pong is structurally
+# impossible. 0 disables the protection.
+POOL_PREEMPTION_MIN_RUNTIME_MS = "tony.pool.preemption.min-runtime-ms"
+# Anti-thrash guard: a queue may CAUSE at most this many evictions/shrinks
+# per budget window; an exhausted aggressor's heads wait for free capacity
+# like anyone else. 0 = unlimited.
+POOL_PREEMPTION_BUDGET = "tony.pool.preemption.budget"
+POOL_PREEMPTION_BUDGET_WINDOW_MS = "tony.pool.preemption.budget-window-ms"
 # Pool-service recovery journal (docs/fault-tolerance.md "Control-plane
 # failures"): app registrations/admissions/allocations are journaled here so
 # a restarted pool rebuilds its queue state (admitted apps stay admitted,
@@ -377,6 +391,10 @@ DEFAULTS: dict[str, str] = {
     POOL_QUEUES: "default=1.0",
     POOL_PREEMPTION_ENABLED: "false",
     POOL_PREEMPTION_GRACE_MS: "0",
+    POOL_PREEMPTION_DRAIN_MS: "0",
+    POOL_PREEMPTION_MIN_RUNTIME_MS: "0",
+    POOL_PREEMPTION_BUDGET: "0",
+    POOL_PREEMPTION_BUDGET_WINDOW_MS: "60s",
     POOL_JOURNAL_FILE: "",
 
     HISTORY_LOCATION: "",            # empty → <staging-root>/history

@@ -53,7 +53,8 @@ from typing import Any, Iterable, Mapping
 #: the badput breakdown
 PHASE_ORDER = (
     "productive", "queue_wait", "startup", "registration", "compile",
-    "checkpoint", "restart_rework", "resize", "takeover", "drain", "other",
+    "checkpoint", "restart_rework", "preempt_drain", "resize", "takeover",
+    "drain", "other",
 )
 
 #: claim priorities: when claims overlap, the highest wins for that instant.
@@ -63,6 +64,11 @@ _PRIORITY = {
     "takeover": 90,
     "checkpoint": 80,
     "restart_rework": 70,
+    # cooperative-preemption drain window (PREEMPTION_REQUESTED → YIELDED/
+    # ESCALATED): wider than the urgent ckpt.save inside it (which wins),
+    # narrower than rework — the window is real badput the operator tunes
+    # with tony.pool.preemption.drain-ms, not "other"
+    "preempt_drain": 65,
     "queue_wait": 60,
     "compile": 50,
     "registration": 45,
@@ -370,6 +376,23 @@ def build_ledger(
         )
         if lost_from is not None and lost_from < rt:
             claim("restart_rework", lost_from, rt)
+
+    # ---- cooperative-preemption drain windows: request → yield/escalate
+    # (an unterminated window ends at the next restart marker — the yield IS
+    # the restart — or t1 for a live job mid-drain)
+    drain_resolutions = [
+        ev.timestamp_ms for ev in events
+        if _ev_type(ev) in (
+            "PREEMPTION_YIELDED", "PREEMPTION_ESCALATED", "PREEMPTION_CANCELLED")
+    ]
+    for ev in events:
+        if _ev_type(ev) != "PREEMPTION_REQUESTED":
+            continue
+        end = next_at_or_after(
+            drain_resolutions, ev.timestamp_ms,
+            next_at_or_after(restarts, ev.timestamp_ms, t1),
+        )
+        claim("preempt_drain", ev.timestamp_ms, end)
 
     # ---- drain: after the last evidence of work — the last task finish, or
     # the last metrics snapshot when one outlives it (the final task's

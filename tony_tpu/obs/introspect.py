@@ -43,6 +43,11 @@ from typing import Any, Callable, Mapping
 
 CONTROL_SUFFIX = ".profile"
 DONE_SUFFIX = ".profile.done"
+#: cooperative-preemption urgent-checkpoint relay (docs/scheduling.md): the
+#: executor drops the control file next to the train-metrics path, the child
+#: force-saves at the next step boundary and answers with the done file
+DRAIN_CONTROL_SUFFIX = ".drain"
+DRAIN_DONE_SUFFIX = ".drain.done"
 
 #: per-task capture states, in lifecycle order
 PENDING, DELIVERED, CAPTURED, FAILED = "pending", "delivered", "captured", "error"
@@ -284,6 +289,46 @@ class ProfileCourier:
         )
         self._reported.add(req_id)
         self._outstanding = None
+
+
+class DrainCourier:
+    """Executor-side urgent-checkpoint relay for cooperative preemption.
+
+    Mirrors :class:`ProfileCourier`'s control/done file contract, driven
+    from the same heartbeat loop: when a heartbeat response piggybacks a
+    ``drain`` request, the courier drops ``<metrics>.drain``
+    (``{"req_id"}``) for the child's
+    :class:`~tony_tpu.train.checkpoint.UrgentSaveSignal`; once the child
+    answers with ``<metrics>.drain.done`` (``{"req_id", "step"}``) the
+    courier reports the saved step back over RPC (``report_drain_saved``)
+    exactly once. Tasks whose child runs no training loop (a raw shell
+    command) simply never answer — the AM's yield deadline covers them."""
+
+    def __init__(self, report: Callable[..., Any]):
+        #: report(req_id=..., step=...) → AM (exceptions are the caller's
+        #: problem; the heartbeat loop already tolerates RPC churn)
+        self._report = report
+        self._lock = threading.Lock()
+        self._outstanding: str | None = None   # req_id written, awaiting done
+        self._reported: set[str] = set()
+
+    def handle(self, piggyback: Mapping[str, Any] | None,
+               metrics_path: str | None) -> None:
+        with self._lock:
+            if self._outstanding is not None and metrics_path:
+                done = read_json(metrics_path + DRAIN_DONE_SUFFIX)
+                if done is not None and done.get("req_id") == self._outstanding:
+                    req_id = self._outstanding
+                    self._report(req_id=req_id, step=int(done.get("step") or 0))
+                    self._reported.add(req_id)
+                    self._outstanding = None
+            if not piggyback or not metrics_path:
+                return
+            req_id = str(piggyback.get("req_id") or "")
+            if not req_id or req_id in self._reported or req_id == self._outstanding:
+                return
+            write_json_atomic(metrics_path + DRAIN_CONTROL_SUFFIX, {"req_id": req_id})
+            self._outstanding = req_id
 
 
 # ------------------------------------------------------- `tony top` rows

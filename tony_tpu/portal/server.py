@@ -67,6 +67,33 @@ def _page(title: str, body: str) -> bytes:
     ).encode()
 
 
+def _share_bar(q: dict, w: int = 160) -> str:
+    """Share-utilization bar for one pool queue: used claim vs the share
+    GUARANTEE in the pool's primary capacity dimension. Over-guarantee
+    (elastic borrowing) renders amber past the guarantee mark so reclaim
+    pressure is visible at a glance."""
+    cap = int(q.get("share_capacity") or 0)
+    used = int(q.get("used") or 0)
+    if cap <= 0:
+        return "—"
+    frac = used / cap
+    # the bar spans max(used, guarantee): green up to the guarantee, red for
+    # the borrowed excess — the guarantee mark stays at a fixed fraction
+    span = max(frac, 1.0)
+    green = min(frac, 1.0) / span * w
+    red = max(frac - 1.0, 0.0) / span * w
+    return (
+        f'<span style="display:inline-block;width:{w}px;height:10px;'
+        f'background:#eee;border:1px solid #ccc;vertical-align:middle;'
+        f'white-space:nowrap;overflow:hidden">'
+        f'<span style="display:inline-block;width:{green:.0f}px;height:10px;'
+        f'background:#4a4;vertical-align:top"></span>'
+        + (f'<span style="display:inline-block;width:{red:.0f}px;height:10px;'
+           f'background:#e33;vertical-align:top"></span>' if red >= 1 else "")
+        + f"</span> {frac:.0%}"
+    )
+
+
 def _sparkline(values: list[float], label: str, w: int = 220, h: int = 48) -> str:
     """Inline SVG polyline — no JS, renders anywhere.
 
@@ -738,21 +765,28 @@ class PortalHandler(BaseHTTPRequestHandler):
                 admitted = ", ".join(
                     f"{html.escape(a['app_id'])} (p{a['priority']}, "
                     f"{a['held_chips']}ch/{a['held_memory'] // (1 << 20)}MiB)"
+                    + (" [draining]" if a.get("draining") else "")
                     for a in q.get("admitted", [])
                 ) or "—"
                 waiting = ", ".join(
                     f"#{w['position']} {html.escape(w['app_id'])} (p{w['priority']})"
-                    + (" [preempted]" if w.get("preempted") else "")
+                    + (f" {w['waiting_s']:.0f}s" if w.get("waiting_s") is not None else "")
+                    + (" [draining]" if w.get("draining")
+                       else " [preempted]" if w.get("preempted") else "")
                     for w in q.get("waiting", [])
                 ) or "—"
                 qrows.append(
                     f"<tr><td>{html.escape(qname)}</td><td>{q.get('share', 1.0):.0%}</td>"
+                    f"<td>{_share_bar(q)}</td>"
                     f"<td>{admitted}</td><td>{waiting}</td></tr>"
                 )
             body += (
-                f"<h3>queues{' (preemption on)' if st.get('preemption') else ''}</h3>"
-                "<table><tr><th>queue</th><th>share</th><th>admitted</th>"
-                f"<th>waiting</th></tr>{''.join(qrows)}</table>"
+                f"<h3>queues{' (preemption on)' if st.get('preemption') else ''}"
+                + (f" · {st['drains_active']} drain(s) in flight"
+                   if st.get("drains_active") else "")
+                + "</h3>"
+                "<table><tr><th>queue</th><th>share</th><th>used / guarantee</th>"
+                f"<th>admitted</th><th>waiting</th></tr>{''.join(qrows)}</table>"
             )
         return _page(f"pool {self.pool_addr}", body)
 

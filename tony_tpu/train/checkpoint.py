@@ -147,6 +147,67 @@ def restore_or_init(
             _quarantine_step(ckpt_dir, int(step))
 
 
+class UrgentSaveSignal:
+    """Child-side half of the checkpoint-then-yield drain contract
+    (docs/scheduling.md): polls ``<TONY_TRAIN_METRICS_FILE>.drain`` (the
+    control file the executor's DrainCourier drops when the pool asks this
+    job to drain or shrink) at step boundaries, throttled to one monotonic
+    compare per step when idle — the same cadence discipline as the
+    on-demand profiler's control poll (``tony.profile.poll-interval-ms``).
+
+    The training loop calls :meth:`poll` each step; on a new request it
+    force-saves through the existing ``CheckpointManager``, then calls
+    :meth:`acknowledge` with the saved step. The loop KEEPS STEPPING after
+    acknowledging — yielding is the AM's move (it kills the gang once every
+    rank's checkpoint landed), so the few extra steps are exactly the
+    bounded rework the goodput ledger meters."""
+
+    def __init__(self) -> None:
+        # the shared file contract lives in obs/introspect.py (suffixes +
+        # torn-tolerant read + atomic write), same as the profile relay
+        from tony_tpu import constants
+        from tony_tpu.obs import introspect as _introspect
+
+        self._introspect = _introspect
+        self._path = os.environ.get(constants.ENV_TRAIN_METRICS_FILE, "")
+        try:
+            poll_ms = int(os.environ.get(constants.ENV_PROFILE_POLL_MS, "500") or 500)
+        except ValueError:
+            poll_ms = 500
+        self._interval_s = max(poll_ms, 50) / 1000.0
+        self._next_poll = 0.0
+        self._handled: set[str] = set()
+
+    def poll(self) -> str | None:
+        """The pending request id, at most once per request; None when idle
+        (the overwhelmingly common case costs one clock read)."""
+        if not self._path:
+            return None
+        now = time.monotonic()
+        if now < self._next_poll:
+            return None
+        self._next_poll = now + self._interval_s
+        ctl = self._introspect.read_json(
+            self._path + self._introspect.DRAIN_CONTROL_SUFFIX)
+        req_id = str((ctl or {}).get("req_id") or "")
+        if not req_id or req_id in self._handled:
+            return None
+        self._handled.add(req_id)
+        return req_id
+
+    def acknowledge(self, req_id: str, step: int) -> None:
+        """Atomically publish the done file the courier reports back."""
+        if not self._path:
+            return
+        try:
+            self._introspect.write_json_atomic(
+                self._path + self._introspect.DRAIN_DONE_SUFFIX,
+                {"req_id": req_id, "step": int(step)},
+            )
+        except OSError:
+            pass  # best-effort: the AM's yield deadline covers a lost ack
+
+
 def _quarantine_step(ckpt_dir: str, step: int) -> None:
     """Move a corrupt step dir out of Orbax's sight (non-numeric name), kept
     on disk for post-mortem. Gang workers share the checkpoint dir and all

@@ -24,7 +24,7 @@ from tony_tpu.obs import metrics as obs_metrics
 from tony_tpu.obs import trace as obs_trace
 from tony_tpu.parallel import MeshSpec
 from tony_tpu.runtime import init_distributed
-from tony_tpu.train.checkpoint import restore_or_init
+from tony_tpu.train.checkpoint import UrgentSaveSignal, restore_or_init
 from tony_tpu.train.metrics import detect_peak_flops, flops_per_token_for_batch
 from tony_tpu.train.profiling import StepProfiler
 from tony_tpu.train.trainer import (
@@ -293,6 +293,7 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
 
     metrics: dict = {}
     profiler = StepProfiler()  # no-op unless the executor exported TONY_PROFILE_DIR
+    urgent = UrgentSaveSignal()  # cooperative-preemption checkpoint trigger
     meter.start()
     # sampled step timing: one histogram observation (mean step wall time)
     # per logging window — the hot loop itself pays two int compares
@@ -350,6 +351,7 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
                 window_t0, window_step0 = time.perf_counter(), step + 1
                 _drop_obs_metrics()  # after observe: the window's sample ships with it
                 meter.start()
+            saved_this_step = False
             if (
                 ckpt_mgr is not None
                 and loop.checkpoint_every
@@ -357,6 +359,22 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
             ):
                 ckpt_mgr.save(step + 1, state)
                 drop_cursor(step + 1)
+                saved_this_step = True
+            if ckpt_mgr is not None and (drain_req := urgent.poll()) is not None:
+                # the pool is preempting this job (checkpoint-then-yield):
+                # force-save NOW — synchronously, the gang dies the moment
+                # every rank acknowledges — so the resumed gang loses only
+                # the steps between this one and the kill. A periodic save
+                # of this very step is not rewritten, just drained.
+                obs_logging.warning(
+                    f"[train] urgent pre-preemption checkpoint at step {step + 1}",
+                    step=step + 1,
+                )
+                if not saved_this_step:
+                    ckpt_mgr.save(step + 1, state, force=True)
+                    drop_cursor(step + 1)
+                ckpt_mgr.wait()
+                urgent.acknowledge(drain_req, step + 1)
     finally:
         # a failed step/save must not leak the loader's native prefetch
         # threads + mmapped shards (gang restarts re-enter this function
