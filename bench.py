@@ -16,6 +16,7 @@ import argparse
 import dataclasses
 import functools
 import json
+import os
 import sys
 import time
 
@@ -68,6 +69,8 @@ def run_bench(
     ce_chunk: int | None = None,
     mu_dtype: str = "",
     moe_dispatch: str | None = None,
+    sync_every_step: bool = False,
+    profile_dir: str | None = None,
 ) -> dict:
     import jax
 
@@ -128,15 +131,30 @@ def run_bench(
         peak_flops=detect_peak_flops(),
     )
     meter.start()
-    for _ in range(steps):
-        state, metrics = step_fn(state, batch_data)
-        # hard host sync EVERY step: on the axon backend, async dispatch runs
-        # ahead of block_until_ready and reports non-physical step times; a
-        # per-step scalar fetch is the honest (slightly pessimistic) measure.
+    if sync_every_step:
+        # the r1–r5 measurement loop, kept as the BEFORE control: a hard
+        # host sync every step fetches the loss scalar and stalls dispatch
+        # until the device drains — each sync also pays the tunneled
+        # backend's host⇄device round trip ON the step path.
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch_data)
+            loss_val = float(metrics["loss"])  # lint: disable=host-sync — this IS the control being measured
+            meter.step()
+    else:
+        # pipelined dispatch: steps are enqueued back to back (device-side
+        # execution is already serialized by the donated-state dependency),
+        # and ONE final block_until_ready proves every enqueued step
+        # physically finished before the meter reads the clock. Same total
+        # device work, no per-step host round trip — the aggregate time is
+        # the honest steady-state measure; the per-step control run above
+        # is what async dispatch would misreport WITHOUT the final sync.
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch_data)
+            meter.step()
+        jax.block_until_ready(metrics["loss"])
         loss_val = float(metrics["loss"])
-        meter.step()
     r = meter.report()
-    return {
+    out = {
         "preset": preset,
         "model": model.__name__.rsplit(".", 1)[-1],
         "model_params": cfg.num_params(),
@@ -148,6 +166,30 @@ def run_bench(
         "loss": loss_val,
         **{k: round(v, 4) for k, v in r.items()},
     }
+    if profile_dir:
+        # provenance capture (AFTER measurement, so the trace overhead never
+        # skews the numbers): a short jax.profiler window of this exact
+        # step/sync regime, referenced from the BENCH_* payload
+        mode = "sync_per_step" if sync_every_step else "pipelined"
+        out_dir = os.path.join(profile_dir, mode)
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            jax.profiler.start_trace(out_dir)
+            try:
+                for _ in range(3):
+                    state, metrics = step_fn(state, batch_data)
+                    if sync_every_step:
+                        float(metrics["loss"])  # lint: disable=host-sync — profiled control regime
+                jax.block_until_ready(metrics["loss"])
+            finally:
+                # a failed capture must not leave the profiler armed — it
+                # would skew every later measurement run in this process
+                jax.profiler.stop_trace()
+            out["profile_dir"] = out_dir
+        except Exception as e:  # noqa: BLE001 — provenance is best-effort
+            print(f"[bench] profile capture failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -393,6 +435,12 @@ def main() -> int:
     p.add_argument("--moe-dispatch", default=None,
                    choices=["ragged", "ragged_xla", "gather", "dense"],
                    help="override the MoE dispatch scheme (moe preset only)")
+    p.add_argument("--profile-dir", default="profiles/bench",
+                   help="where the before/after provenance traces land "
+                        "(referenced from the output payload)")
+    p.add_argument("--no-profile", action="store_true",
+                   help="skip the profile captures and the per-step-sync "
+                        "control run (faster; payload loses provenance)")
     args = p.parse_args()
 
     import jax
@@ -425,16 +473,31 @@ def main() -> int:
     last_err = None
     for attempt in attempts:
         try:
+            prof = None if args.no_profile else os.path.join(args.profile_dir, attempt)
+            # BEFORE control: the legacy per-step-sync measurement loop, one
+            # run — the same binary/config measured the r1–r5 way, so the
+            # payload itself proves how much the pipelined loop moved
+            control = None
+            if not args.no_profile:
+                control = run_bench(
+                    attempt, args.steps, args.warmup, args.batch, args.seq,
+                    args.remat_policy, args.ce_chunk, args.mu_dtype,
+                    args.moe_dispatch, sync_every_step=True, profile_dir=prof,
+                )
             # median-of-N: the compile is cached after run 1, so extra runs
             # cost only measurement steps; the median absorbs the tunneled
             # backend's ambient drift (r3 weak #7)
             runs = [
                 run_bench(
                     attempt, args.steps, args.warmup, args.batch, args.seq,
-                    args.remat_policy, args.ce_chunk, args.mu_dtype, args.moe_dispatch,
+                    args.remat_policy, args.ce_chunk, args.mu_dtype,
+                    args.moe_dispatch,
+                    profile_dir=prof if i == max(repeats, 1) - 1 else None,
                 )
-                for _ in range(max(repeats, 1))
+                for i in range(max(repeats, 1))
             ]
+            after_profile = next(
+                (x["profile_dir"] for x in runs if "profile_dir" in x), None)
             runs.sort(key=lambda r: r["mfu"])
             r = runs[len(runs) // 2]
             out = {
@@ -443,8 +506,18 @@ def main() -> int:
                 "unit": "mfu",
                 "vs_baseline": round(r["mfu"] / NORTH_STAR_MFU, 4),
                 "runs_mfu": [x["mfu"] for x in runs],
-                **{k: v for k, v in r.items() if k not in ("mfu",)},
+                **{k: v for k, v in r.items() if k not in ("mfu", "profile_dir")},
             }
+            if control is not None:
+                out["control_sync_per_step"] = {
+                    "mfu": control["mfu"], "step_time_ms": control["step_time_ms"],
+                }
+            if control is not None or after_profile is not None:
+                out["profile"] = {
+                    **({"before": control["profile_dir"]}
+                       if control and "profile_dir" in control else {}),
+                    **({"after": after_profile} if after_profile else {}),
+                }
             if smoke is not None:
                 out["kernel_smoke"] = f"{smoke['passed']}/{smoke['total']}"
                 if smoke["failures"]:

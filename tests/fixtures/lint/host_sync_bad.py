@@ -1,0 +1,31 @@
+"""host-sync true positives: unconditional syncs inside step loops."""
+
+
+def bench_loop(step_fn, state, batch, steps):
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+        loss = float(metrics["loss"])
+    return loss
+
+
+def train_loop(step_fn, state, batches, jax):
+    for step in range(10):
+        state, metrics = step_fn(state, batches[step])
+        metrics["loss"].item()
+        jax.block_until_ready(metrics["grad_norm"])
+    return state
+
+
+def nested_syncs(step_fn, state, batch, steps, jax, log):
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+        log(float(jax.device_get(metrics["loss"])))
+    return state
+
+
+def sync_hiding_in_a_condition(step_fn, state, batch, steps):
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+        if float(metrics["loss"]) > 8.0:
+            break
+    return state

@@ -1,0 +1,37 @@
+"""host-sync clean patterns: throttled, suppressed, literal, non-step loops."""
+
+
+def throttled(step_fn, state, batches, log_every):
+    for step in range(10):
+        state, metrics = step_fn(state, batches[step])
+        if (step + 1) % log_every == 0:
+            report = float(metrics["loss"])
+    return report
+
+
+def deliberate(step_fn, state, batch, steps):
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+        loss = float(metrics["loss"])  # lint: disable=host-sync — lockstep measurement control
+    return loss
+
+
+def literals_are_fine(step_fn, state, batch, steps):
+    for _ in range(steps):
+        state, _metrics = step_fn(state, batch)
+        pad = int(8)
+    return pad
+
+
+def not_a_step_loop(values):
+    total = 0.0
+    for v in values:
+        total += float(v)
+    return total
+
+
+def sync_after_the_loop(step_fn, state, batch, steps, jax):
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    return float(metrics["loss"])

@@ -52,6 +52,18 @@ class TestLayering:
         assert cfg.get(keys.APPLICATION_FRAMEWORK) == "jax"
         assert cfg.get_int(keys.TASK_MAX_MISSED_HEARTBEATS) == 25
 
+    def test_train_and_tune_keys_registered_with_defaults(self):
+        """The r11 step-path knobs (docs/performance.md): registered,
+        defaulted, and typed the way the executor reads them."""
+        cfg = TonyConfig()
+        assert cfg.get_int(keys.TRAIN_PREFETCH_DEPTH) == 2
+        assert cfg.get_time_ms(keys.TRAIN_INPUT_WAIT_SPAN_MS) == 25
+        assert cfg.get(keys.TUNE_CACHE_FILE) == ""     # → env/per-user default
+        assert cfg.get_bool(keys.TUNE_ENABLED) is True
+        for k in (keys.TRAIN_PREFETCH_DEPTH, keys.TRAIN_INPUT_WAIT_SPAN_MS,
+                  keys.TUNE_CACHE_FILE, keys.TUNE_ENABLED):
+            assert k in keys.DEFAULTS
+
     def test_layer_order_later_wins(self, tmp_path):
         site = tmp_path / "site.json"
         site.write_text(json.dumps({keys.APPLICATION_QUEUE: "prod", keys.AM_RETRY_COUNT: "2"}))

@@ -177,6 +177,25 @@ def test_metrics_discipline_library_is_clean():
     assert findings == [], [f"{f.path}:{f.line}: {f.message}" for f in findings]
 
 
+# ------------------------------------------------------------------ host-sync
+def test_host_sync_true_positives():
+    findings = run_lint("host_sync_bad.py", checks={"host-sync"})
+    # line 22 twice: float(jax.device_get(...)) is TWO syncs — a flagged
+    # call's arguments are still walked, so fixing only the outer one
+    # cannot re-lint clean. Line 29: an `if` BODY is conditional but its
+    # TEST evaluates every iteration — `if float(loss) > 8.0` still syncs.
+    assert lines_of(findings, "host-sync") == [7, 14, 15, 22, 22, 29]
+    assert "unconditional device sync in a step loop" in findings[0].message
+
+
+def test_host_sync_clean_patterns():
+    """Throttled (window `if`), suppressed, literal-arg, non-step loops, and
+    sync-after-the-loop are all out of scope — the checker targets exactly
+    the per-step-sync bug class, nothing broader."""
+    findings = run_lint("host_sync_good.py", checks={"host-sync"})
+    assert findings == []
+
+
 # -------------------------------------------------------------- CLI contract
 def test_cli_exit_0_clean_json(tmp_path, capsys):
     clean = tmp_path / "clean.py"

@@ -20,6 +20,20 @@ def test_tony_tpu_lints_clean(capsys):
     assert rc == 0, f"tony lint found regressions in tony_tpu/:\n{out}"
 
 
+def test_repo_hot_loops_stay_sync_clean(capsys):
+    """The host-sync ratchet over the step paths OUTSIDE the package too:
+    bench.py's measurement loops (the repo's own MFU number) must never
+    regrow an unconditional per-step host sync — the bug class that cost
+    measurable step time through r5 (docs/performance.md)."""
+    rc = lint_main([
+        os.path.join(repo_root(), "bench.py"),
+        os.path.join(repo_root(), "tony_tpu", "train"),
+        "--checks", "host-sync", "--no-baseline",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, f"host-sync regressions on the hot loops:\n{out}"
+
+
 def test_checked_in_baseline_is_empty():
     path = default_baseline_path()
     assert os.path.exists(path), "the .lint-baseline.json ratchet file is gone"

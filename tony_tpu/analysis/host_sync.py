@@ -1,0 +1,124 @@
+"""host-sync: no unconditional device sync inside a step loop.
+
+A training/measurement step loop keeps the device busy only while the host
+stays ahead of it: JAX dispatch is asynchronous, so the device pipelines
+step N+1's launch behind step N's compute — until the host touches a device
+value. ``float(loss)``, ``.item()``, ``.tolist()``, ``jax.device_get`` and
+``jax.block_until_ready`` all block the host until the device drains, and
+doing that EVERY step serializes dispatch against compute (on a tunneled
+backend each one also pays a host⇄device round trip). The repo's own hot
+loops lost measurable MFU to exactly this (bench.py's per-step
+``float(metrics["loss"])``; see docs/performance.md).
+
+The discipline this checker enforces: syncs inside a step loop must be
+**throttled** — nested under an ``if`` (a logging window like
+``(step + 1) % log_every == 0``, a first-step branch, an error path) — or
+moved off the loop entirely (sync once after the loop; fetch step N−1's
+value while step N computes). An *unconditional* sync-forcing call in the
+loop body is flagged.
+
+Step loops are recognized syntactically: a ``for`` loop whose target binds a
+name containing ``step``, or whose iterable's source mentions ``step``
+(``range(start_step, loop.steps)``, ``range(steps)``, ...). Other loops are
+out of scope — a data-prep loop over files may convert floats freely.
+
+Deliberate per-step syncs (e.g. a lockstep-handshake test fixture) carry an
+inline ``# lint: disable=host-sync — <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tony_tpu.analysis.analyzer import Checker, Finding, Module, dotted_name
+
+#: bare-name calls that force a transfer when handed a device value
+_SYNC_NAME_CALLS = frozenset({"float", "int", "bool"})
+#: attribute/method tails that force a sync on jax arrays
+_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+#: fully-dotted calls that force a sync / host materialization
+_SYNC_DOTTED = frozenset({
+    "jax.block_until_ready", "jax.device_get",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jnp.asarray", "jax.numpy.asarray",
+})
+
+
+def _is_step_loop(node: ast.For, source: str) -> bool:
+    """A loop driving training/measurement steps, by naming convention."""
+    for el in ast.walk(node.target):
+        if isinstance(el, ast.Name) and "step" in el.id.lower():
+            return True
+    try:
+        it = ast.get_source_segment(source, node.iter) or ""
+    except Exception:  # noqa: BLE001 — source slicing is best-effort
+        it = ""
+    return "step" in it.lower()
+
+
+def _sync_call_reason(node: ast.Call) -> str | None:
+    """Why this call forces a host⇄device sync, or None."""
+    func = node.func
+    if isinstance(func, ast.Name) and func.id in _SYNC_NAME_CALLS:
+        # float(0.5) / int("3") literals can't hold device values
+        if node.args and not isinstance(node.args[0], ast.Constant):
+            return f"{func.id}() materializes its argument on the host"
+        return None
+    name = dotted_name(func)
+    if name in _SYNC_DOTTED:
+        return f"{name}() forces a device transfer"
+    if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS:
+        return f".{func.attr}() blocks until the device catches up"
+    return None
+
+
+class HostSyncChecker(Checker):
+    name = "host-sync"
+    description = (
+        "no unconditional host⇄device sync (float/.item/device_get/"
+        "block_until_ready) inside a step loop — throttle it behind a "
+        "window `if` or move it off the step path"
+    )
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.For) and _is_step_loop(node, module.source):
+                yield from self._check_loop(module, node)
+
+    def _check_loop(self, module: Module, loop: ast.For) -> Iterable[Finding]:
+        """Walk the loop body, skipping anything conditional (If/Try/While
+        branches run a data-dependent subset of iterations — that IS the
+        throttling idiom) and nested defs/loops (nested step loops are
+        visited by the outer walk on their own)."""
+        stack = list(loop.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.If, ast.While)):
+                # the BODY is conditional (that is the throttling idiom),
+                # but the TEST expression evaluates every iteration — a
+                # sync hiding in `if float(loss) > 8.0:` is still per-step
+                stack.append(node.test)
+                continue
+            if isinstance(node, ast.For):
+                stack.append(node.iter)  # evaluated once per outer iteration
+                continue
+            if isinstance(node, (ast.Try, ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                reason = _sync_call_reason(node)
+                if reason is not None:
+                    yield self.finding(
+                        module, node,
+                        f"unconditional device sync in a step loop: {reason} "
+                        f"every iteration, serializing host dispatch against "
+                        f"device compute — throttle it behind a logging-"
+                        f"window `if`, or sync once after the loop; a "
+                        f"deliberate per-step sync takes an inline "
+                        f"`# lint: disable=host-sync — <why>`",
+                    )
+                    # fall through: a flagged call's ARGUMENTS are still
+                    # walked — float(jax.device_get(x)) is two syncs, and
+                    # fixing only the outer one must not re-lint clean
+            stack.extend(ast.iter_child_nodes(node))

@@ -70,6 +70,12 @@ def _cmd_lint(argv: list[str]) -> int:
     return lint_main(argv)
 
 
+def _cmd_tune(argv: list[str]) -> int:
+    from tony_tpu.cli.tune import main as tune_main
+
+    return tune_main(argv)
+
+
 def _cmd_chaos(argv: list[str]) -> int:
     from tony_tpu.cli.chaos import main as chaos_main
 
@@ -316,13 +322,14 @@ _COMMANDS = {
     "resize": _cmd_resize,
     "goodput": _cmd_goodput,
     "sim": _cmd_sim,
+    "tune": _cmd_tune,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|history-server|bench|portal|notebook|serve|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput|sim} [options]\n")
+        print("usage: tony {submit|pool|history|history-server|bench|portal|notebook|serve|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput|sim|tune} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    query the persistent history tier (list|show|compare|ingest|gc)")
@@ -342,6 +349,7 @@ def main(argv: list[str] | None = None) -> int:
         print("  resize     retarget a RUNNING job's per-type instance count (elastic rebuild)")
         print("  goodput    exact goodput/badput phase accounting + straggler skew + alert history")
         print("  sim        replay seeded synthetic arrivals against the live scheduler policy (invariant check)")
+        print("  tune       autotune Pallas kernel block sizes on this backend into the on-disk cache")
         return 0
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:

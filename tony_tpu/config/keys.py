@@ -320,6 +320,30 @@ ALERTS_SINK = "tony.alerts.sink"        # transition JSONL; empty → <staging>/
 ALERTS_WEBHOOK = "tony.alerts.webhook"  # optional URL POSTed each transition
 
 # ---------------------------------------------------------------------------
+# tony.train.* — step-path knobs of the framework train loop (docs/performance.md)
+# ---------------------------------------------------------------------------
+# Input-pipeline lookahead: batch N+1 is assembled (loader read / synthetic
+# draw + host-to-device transfer) on a background thread while the device
+# runs step N (train/input_pipeline.py). 0 restores synchronous per-step
+# assembly; >2 rarely helps (the queue only hides assembly jitter).
+TRAIN_PREFETCH_DEPTH = "tony.train.prefetch-depth"
+# A step-loop stall on the input pipeline at or above this emits a
+# train.input_wait span, so the goodput ledger's input_wait phase charges it
+# precisely; sub-floor waits stay inside productive (they are noise).
+TRAIN_INPUT_WAIT_SPAN_MS = "tony.train.input-wait-span-ms"
+
+# ---------------------------------------------------------------------------
+# tony.tune.* — Pallas kernel autotuner (ops/tune.py, docs/performance.md)
+# ---------------------------------------------------------------------------
+# Cache of measured block-size winners keyed by (op, device kind, shape,
+# dtype); `tony tune` writes it, every kernel entry point consults it at
+# trace time. Empty → $TONY_TUNE_CACHE or ~/.cache/tony-tpu/tune.json.
+TUNE_CACHE_FILE = "tony.tune.cache-file"
+# false → kernels ignore the cache (module-constant defaults only); the
+# per-job kill switch when a tuning looks implicated in a regression.
+TUNE_ENABLED = "tony.tune.enabled"
+
+# ---------------------------------------------------------------------------
 # tony.checkpoint.* — gang-restart-from-checkpoint (rebuild-only; SURVEY §5.3/5.4)
 # ---------------------------------------------------------------------------
 CHECKPOINT_DIR = "tony.checkpoint.dir"
@@ -455,6 +479,12 @@ DEFAULTS: dict[str, str] = {
     ALERTS_QUEUE_DEPTH: "",
     ALERTS_SINK: "",
     ALERTS_WEBHOOK: "",
+
+    TRAIN_PREFETCH_DEPTH: "2",
+    TRAIN_INPUT_WAIT_SPAN_MS: "25",
+
+    TUNE_CACHE_FILE: "",
+    TUNE_ENABLED: "true",
 
     CHECKPOINT_DIR: "",
     CHECKPOINT_INTERVAL_STEPS: "0",

@@ -81,6 +81,17 @@ ENV_PROFILE_START_STEP = "TONY_PROFILE_START_STEP"    # static window start
 ENV_PROFILE_NUM_STEPS = "TONY_PROFILE_NUM_STEPS"      # static window length
 # how often (at most) the on-demand control file is stat'ed, ms
 ENV_PROFILE_POLL_MS = "TONY_PROFILE_POLL_MS"
+# Input-pipeline contract (tony.train.*, docs/performance.md): lookahead
+# depth for the overlapped batch assembly (0 = synchronous) and the minimum
+# blocked-on-input stall that emits a train.input_wait span for the goodput
+# ledger's input_wait phase.
+ENV_PREFETCH_DEPTH = "TONY_PREFETCH_DEPTH"            # from tony.train.prefetch-depth
+ENV_INPUT_WAIT_SPAN_MS = "TONY_INPUT_WAIT_SPAN_MS"    # from tony.train.input-wait-span-ms
+# Kernel-autotuner contract (tony.tune.*, docs/performance.md): the tuned
+# block-size cache file every kernel entry point consults at trace time
+# (ops/tune.py), and the kill switch that ignores it.
+ENV_TUNE_CACHE = "TONY_TUNE_CACHE"                    # from tony.tune.cache-file
+ENV_TUNE_DISABLE = "TONY_TUNE_DISABLE"                # "1" → ignore the cache
 ENV_NOTEBOOK_PORT = "NOTEBOOK_PORT"     # notebook task port (proxied by submitter)
 # Hot-spare contract (tony.elastic.spares): set → this executor parks after
 # register_spare and polls for a gang-slot assignment instead of joining as

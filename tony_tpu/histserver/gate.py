@@ -37,6 +37,10 @@ GATE_METRICS: dict[str, int] = {
 #: default allowed drop, percent of the trajectory's best
 DEFAULT_TOLERANCE_PCT = 5.0
 
+#: relative headline-metric delta below which a round "didn't move" vs the
+#: prior round (the anti-gate-without-movement warning)
+MOVEMENT_EPSILON = 0.001
+
 _REQUIRED_PARSED = ("metric", "value", "unit", "vs_baseline")
 
 
@@ -184,6 +188,49 @@ def evaluate(
             passed=drop <= allowed,
             note="" if drop <= allowed else
             f"regressed {drop / abs(best) * 100.0:.2f}% past the {pct:.1f}% threshold"))
+
+    # anti-"gate-without-movement" (ROADMAP item 2): a perf-lane round that
+    # gates green with the headline metric sitting exactly where the prior
+    # round left it is a no-op round — warn loudly (non-failing: an infra
+    # round may legitimately hold the line, but it must be a visible choice).
+    prior = peers[-1] if peers else None
+    cv = cur.get("value")
+    # a trajectory record whose parsed content EQUALS the current record is
+    # the canonical no-movement offense (a copied round) — the peers filter
+    # above drops it as a self-comparison, which would otherwise silently
+    # defeat this very check, so detect it by content first
+    dup = next(
+        (fname for fname, rec in trajectory
+         if parsed_of(rec) is not cur and parsed_of(rec) == cur), None)
+    if dup is not None and isinstance(cv, (int, float)) and math.isfinite(cv):
+        checks.append(GateCheck(
+            metric="movement", current=float(cv), reference=float(cv),
+            reference_from=dup, threshold_pct=MOVEMENT_EPSILON * 100,
+            direction=+1, passed=True,
+            note=f"WARNING: record is content-identical to {dup} — "
+                 "gate-without-movement (perf rounds must move the number "
+                 "or say why not)"))
+    elif prior is not None and isinstance(cv, (int, float)) and math.isfinite(cv):
+        pv = prior[1].get("value")
+        if (isinstance(pv, (int, float)) and math.isfinite(pv) and pv != 0
+                and abs(cv - pv) / abs(pv) < MOVEMENT_EPSILON):
+            checks.append(GateCheck(
+                metric="movement", current=float(cv), reference=float(pv),
+                reference_from=prior[0], threshold_pct=MOVEMENT_EPSILON * 100,
+                direction=+1, passed=True,
+                note="WARNING: headline metric unchanged vs the prior round "
+                     "— gate-without-movement (perf rounds must move the "
+                     "number or say why not)"))
+
+    # perf provenance: a perf-lane record should carry its before/after
+    # profile artifact references (bench.py --profile-dir captures them)
+    if "kernel_smoke" in cur and "profile" not in cur:
+        checks.append(GateCheck(
+            metric="provenance", current=None, reference=None,
+            reference_from="-", threshold_pct=0.0, direction=+1, passed=True,
+            note="WARNING: no 'profile' artifact reference in the record — "
+                 "perf rounds attach before/after captures "
+                 "(bench.py records them by default)"))
 
     frac = smoke_fraction(cur.get("kernel_smoke")) if "kernel_smoke" in cur else None
     if frac is not None:

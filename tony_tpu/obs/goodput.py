@@ -17,6 +17,8 @@ into an **exact partition** of ``[t0, t1]`` into phases:
                     traced, else estimated to the first step evidence)
 ``productive``      steps actually advancing the job — THE goodput
 ``checkpoint``      checkpoint save work on the step path (ckpt.save spans)
+``input_wait``      step loop blocked on the input pipeline
+                    (train.input_wait spans, train/input_pipeline.py)
 ``restart_rework``  work the job had already done and lost to a restart:
                     the time between the last checkpointed step and the
                     failure, re-derived from the step reports of adjacent
@@ -53,8 +55,8 @@ from typing import Any, Iterable, Mapping
 #: the badput breakdown
 PHASE_ORDER = (
     "productive", "queue_wait", "startup", "registration", "compile",
-    "checkpoint", "restart_rework", "preempt_drain", "resize", "takeover",
-    "drain", "other",
+    "checkpoint", "input_wait", "restart_rework", "preempt_drain", "resize",
+    "takeover", "drain", "other",
 )
 
 #: claim priorities: when claims overlap, the highest wins for that instant.
@@ -63,6 +65,11 @@ PHASE_ORDER = (
 _PRIORITY = {
     "takeover": 90,
     "checkpoint": 80,
+    # step loop blocked on the input pipeline (train.input_wait spans,
+    # train/input_pipeline.py): narrow precise claims like checkpoint —
+    # inside a live gang window, badput the operator tunes with
+    # tony.train.prefetch-depth rather than "productive" dilution
+    "input_wait": 75,
     "restart_rework": 70,
     # cooperative-preemption drain window (PREEMPTION_REQUESTED → YIELDED/
     # ESCALATED): wider than the urgent ckpt.save inside it (which wins),
@@ -346,6 +353,14 @@ def build_ledger(
         if s.get("name") == "ckpt.save":
             start, end = _span_ms(s)
             claim("checkpoint", start, end)
+
+    # ---- input wait: step-loop stalls on the input pipeline (backdated
+    # spans the prefetcher emits for waits past its span floor; sub-floor
+    # waits stay inside productive — they are noise, not a phase)
+    for s in spans:
+        if s.get("name") == "train.input_wait":
+            start, end = _span_ms(s)
+            claim("input_wait", start, end)
 
     # ---- takeover: journal replay + adoption (traced); without a span the
     # event is an instant and contributes no width

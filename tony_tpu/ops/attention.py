@@ -262,7 +262,7 @@ def flash_attention(
         raise ValueError(f"n_heads {H} must be divisible by n_kv_heads {Hkv}")
     if segment_ids is not None and Tq != Tk:
         raise ValueError(f"segment_ids requires Tq == Tk, got {Tq} vs {Tk}")
-    auto_bq, auto_bk = _block_sizes(Tq, Tk)
+    auto_bq, auto_bk = _tuned_blocks("flash_fwd", q, Hkv, Tk)
     block_q = auto_bq if block_q is None else min(block_q, Tq)
     block_k = auto_bk if block_k is None else min(block_k, Tk)
     # awkward lengths (e.g. 257) make _block_sizes halve to degenerate
@@ -725,6 +725,28 @@ def _block_sizes(Tq: int, Tk: int) -> tuple[int, int]:
     return bq, bk
 
 
+def _tuned_blocks(op: str, q: jax.Array, kv_heads: int, Tk: int) -> tuple[int, int]:
+    """Autotuner-aware block sizes: an ops/tune.py cache hit for this exact
+    (device, geometry, dtype) — validated against the kernels' lowering
+    preconditions, so a stale entry degrades to the default instead of a
+    Mosaic failure — else the tuned module constants via ``_block_sizes``.
+    Trace-time only (the blocks are static kernel parameters)."""
+    B, H, Tq, D = (int(d) for d in q.shape)
+    if "TONY_FLASH_BQ" in os.environ or "TONY_FLASH_BK" in os.environ:
+        # an EXPLICIT env override is the operator's debugging lever — it
+        # must beat the tune cache (which otherwise wins silently)
+        return _block_sizes(Tq, Tk)
+    from tony_tpu.ops import tune
+
+    params = tune.lookup(op, (B, H, int(kv_heads), Tq, int(Tk), D), str(q.dtype))
+    if params:
+        bq, bk = int(params.get("block_q", 0)), int(params.get("block_k", 0))
+        if (bq >= 8 and bk >= 128 and not (bq % 8 or bk % 128)
+                and not (Tq % bq or Tk % bk)):
+            return bq, bk
+    return _block_sizes(Tq, Tk)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_trainable(q, k, v, causal, window=0):
     return flash_attention(q, k, v, causal=causal, window=window)
@@ -734,7 +756,7 @@ def _flash_fwd(q, k, v, causal, window):
     from jax.ad_checkpoint import checkpoint_name
 
     Tq, Tk = q.shape[2], k.shape[2]
-    bq, bk = _block_sizes(Tq, Tk)
+    bq, bk = _tuned_blocks("flash_fwd", q, k.shape[1], Tk)
     o, lse = _flash_fwd_lanes(q, k, v, causal, bq, bk, None, window)
     # Named so a remat policy can pin JUST the kernel outputs
     # (save_only_these_names("flash_o", "flash_lse")): the backward then
@@ -747,7 +769,7 @@ def _flash_fwd(q, k, v, causal, window):
 def _flash_bwd(causal, window, res, g):
     q, k, v, o, lse = res
     Tq, Tk = q.shape[2], k.shape[2]
-    bq, bk = _block_sizes(Tq, Tk)
+    bq, bk = _tuned_blocks("flash_bwd", q, k.shape[1], Tk)
     return _flash_bwd_impl(q, k, v, o, lse, g, causal, bq, bk, None, window)
 
 
@@ -757,8 +779,7 @@ _flash_trainable.defvjp(_flash_fwd, _flash_bwd)
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _flash_trainable_seg(q, k, v, seg, causal, window=0):
     """Packed-sequence variant: seg [B, T] int; cotangent for seg is float0."""
-    B, H, Tq, D = q.shape
-    bq, bk = _block_sizes(Tq, k.shape[2])
+    bq, bk = _tuned_blocks("flash_fwd", q, k.shape[1], k.shape[2])
     return _flash_fwd_impl(q, k, v, causal, bq, bk, seg, window)[0]
 
 
@@ -766,7 +787,7 @@ def _flash_seg_fwd(q, k, v, seg, causal, window):
     from jax.ad_checkpoint import checkpoint_name
 
     Tq, Tk = q.shape[2], k.shape[2]
-    bq, bk = _block_sizes(Tq, Tk)
+    bq, bk = _tuned_blocks("flash_fwd", q, k.shape[1], Tk)
     o, lse = _flash_fwd_lanes(q, k, v, causal, bq, bk, seg, window)
     o = checkpoint_name(o, "flash_o")
     lse = checkpoint_name(lse, "flash_lse")
@@ -778,7 +799,7 @@ def _flash_seg_bwd(causal, window, res, g):
 
     q, k, v, seg, o, lse = res
     Tq, Tk = q.shape[2], k.shape[2]
-    bq, bk = _block_sizes(Tq, Tk)
+    bq, bk = _tuned_blocks("flash_bwd", q, k.shape[1], Tk)
     dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, g, causal, bq, bk, seg, window)
     return dq, dk, dv, np.zeros(seg.shape, jax.dtypes.float0)
 

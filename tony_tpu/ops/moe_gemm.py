@@ -76,6 +76,27 @@ if TILE_M > TILE_M_BWD and TILE_M % TILE_M_BWD:
 # passing an explicitly larger ``tile=`` still gets the coarser bwd split.
 
 
+def tuned_tile(E: int, D: int, F: int, dtype) -> int:
+    """``TILE_M``, overridden by an ops/tune.py cache hit for this expert
+    geometry on this device. Validated against the row-tile preconditions
+    (positive multiple of 8; splittable by TILE_M_BWD when larger) so a
+    stale cache entry degrades to the default instead of failing lowering.
+    Callers pick the tile ONCE per MoE layer call (parallel/expert.py) —
+    it also sets the routing's group padding, so it must be chosen before
+    route_ragged, not inside the kernel."""
+    if "TONY_MOE_TILE" in os.environ:
+        # an EXPLICIT env override is the operator's debugging lever — it
+        # must beat the tune cache (which otherwise wins silently)
+        return TILE_M
+    from tony_tpu.ops import tune
+
+    params = tune.lookup("moe_gemm", (E, D, F), str(dtype))
+    t = int(params.get("tile", 0)) if params else 0
+    if t < 8 or t % 8 or (t > TILE_M_BWD and t % TILE_M_BWD):
+        return TILE_M
+    return t
+
+
 def _silu(x):
     return x * jax.nn.sigmoid(x)
 

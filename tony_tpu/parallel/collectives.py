@@ -70,6 +70,19 @@ def moe_all_to_all(tokens: jax.Array, axis_name: str) -> jax.Array:
     return all_to_all(tokens, axis_name, split_axis=0, concat_axis=0)
 
 
-def stop_transfer_if_single(axis_name: str, x: jax.Array) -> jax.Array:
-    """No-op guard for size-1 axes (lets one code path serve all mesh shapes)."""
-    return x if axis_size(axis_name) > 1 else x
+def stop_transfer_if_single(transfer, axis_name: str, x: jax.Array, /, *args, **kwargs) -> jax.Array:
+    """Apply ``transfer(x, axis_name, ...)`` unless the axis has size 1
+    (lets one code path serve all mesh shapes).
+
+    A size-1 ``ppermute``/``all_to_all`` is mathematically the identity but
+    still lowers to a real collective — a launch (and on some backends an
+    ICI round trip) per call that XLA does not always elide. Skipping it
+    here keeps single-shard meshes (the 1-chip bench, CPU tests, a context
+    axis collapsed by an elastic shrink) off the collective path entirely.
+
+    The axis size is static under ``shard_map``, so the branch resolves at
+    trace time — no ``lax.cond`` in the compiled program.
+    """
+    if axis_size(axis_name) <= 1:
+        return x
+    return transfer(x, axis_name, *args, **kwargs)

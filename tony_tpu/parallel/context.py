@@ -85,9 +85,11 @@ def ring_attention(
         o_b, m_b, l_b = _block_attn(qf, k_blk.astype(jnp.float32), v_blk, mask_for(src), scale)
         o, m, l = _merge(o, m, l, o_b, m_b, l_b)
         # rotate KV to the next rank for the following step (last rotate is
-        # redundant but keeps the loop uniform; XLA overlaps it with the merge)
-        k_blk = collectives.rotate(k_blk, axis_name)
-        v_blk = collectives.rotate(v_blk, axis_name)
+        # redundant but keeps the loop uniform; XLA overlaps it with the
+        # merge). On a size-1 ring the rotate is the identity — the guard
+        # keeps the single-shard path free of ppermute launches.
+        k_blk = collectives.stop_transfer_if_single(collectives.rotate, axis_name, k_blk)
+        v_blk = collectives.stop_transfer_if_single(collectives.rotate, axis_name, v_blk)
         return (o, m, l, k_blk, v_blk), None
 
     o0 = jnp.zeros((B, H, Tl, D), jnp.float32)
@@ -119,11 +121,15 @@ def ulysses_attention(
         attn_fn = partial(attention_reference, causal=causal)
 
     def seq_to_heads(x):  # [B,H,Tl,D] → [B,H/n,T,D]
-        x = collectives.all_to_all(x, axis_name, split_axis=1, concat_axis=2)
-        return x
+        # size-1 axis: shape-preserving identity — skip the collective
+        return collectives.stop_transfer_if_single(
+            collectives.all_to_all, axis_name, x, split_axis=1, concat_axis=2
+        )
 
     def heads_to_seq(x):  # [B,H/n,T,D] → [B,H,Tl,D]
-        return collectives.all_to_all(x, axis_name, split_axis=2, concat_axis=1)
+        return collectives.stop_transfer_if_single(
+            collectives.all_to_all, axis_name, x, split_axis=2, concat_axis=1
+        )
 
     out = attn_fn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v))
     return heads_to_seq(out)

@@ -430,7 +430,7 @@ def _ragged_expert_ffn_ep(
     from tony_tpu.ops import moe_gemm
 
     tile = (
-        moe_gemm.TILE_M
+        moe_gemm.tuned_tile(cfg.num_experts, D, w_gate.shape[-1], x.dtype)
         if _kernel_eligible(cfg, D, w_gate.shape[-1], x.dtype)
         else None
     )
@@ -530,7 +530,7 @@ def _ragged_expert_ffn(x, router_w, w_gate, w_up, w_down, cfg: MoEConfig, token_
     # geometry is MXU-aligned and we're on a TPU backend (or the interpret
     # harness); otherwise three jax.lax.ragged_dot grouped GEMMs
     tile = (
-        moe_gemm.TILE_M
+        moe_gemm.tuned_tile(cfg.num_experts, D, w_gate.shape[-1], dtype)
         if _kernel_eligible(cfg, D, w_gate.shape[-1], dtype)
         else None
     )

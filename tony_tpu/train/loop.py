@@ -25,6 +25,7 @@ from tony_tpu.obs import trace as obs_trace
 from tony_tpu.parallel import MeshSpec
 from tony_tpu.runtime import init_distributed
 from tony_tpu.train.checkpoint import UrgentSaveSignal, restore_or_init
+from tony_tpu.train.input_pipeline import InputPipeline
 from tony_tpu.train.metrics import detect_peak_flops, flops_per_token_for_batch
 from tony_tpu.train.profiling import StepProfiler
 from tony_tpu.train.trainer import (
@@ -70,6 +71,12 @@ class LoopConfig:
     pp_chunks: int = 1         # >1: interleaved virtual stages per device
     data_dir: str = ""  # dir of *.tonytok shards; empty → synthetic batches
     data_seed: int = 0  # window-draw seed; FIXED across restarts (replay)
+    #: input-pipeline lookahead: batch N+1 is assembled (loader read /
+    #: synthetic draw + device transfer) on a background thread while the
+    #: device runs step N (train/input_pipeline.py). -1 → the executor's
+    #: tony.train.prefetch-depth (TONY_PREFETCH_DEPTH env; 2 outside a
+    #: container); 0 → synchronous per-step assembly (the legacy path).
+    prefetch_depth: int = -1
 
 
 def _drop_train_metrics(line: dict) -> None:
@@ -291,9 +298,38 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
                 batch_sharding, np.asarray(local)
             )
 
+    def make_batch(step: int):
+        """Pure-enough batch assembly for one step — the single definition
+        both the synchronous and the overlapped pipeline paths run, so the
+        fed batch sequence is bit-identical either way (the loader is only
+        ever called from one thread, in step order)."""
+        if loader is not None:
+            local = loader.next()
+            return {
+                "tokens": assemble(local) if assemble else jax.numpy.asarray(local)
+            }
+        if assemble is not None:
+            local = model_module.synthetic_batch(
+                jax.random.fold_in(jax.random.fold_in(key, step), jax.process_index()),
+                local_rows, loop.seq_len, model_cfg,
+            )
+            return {k: assemble(v) for k, v in local.items()}
+        return model_module.synthetic_batch(
+            jax.random.fold_in(key, step), loop.batch_size, loop.seq_len, model_cfg
+        )
+
     metrics: dict = {}
     profiler = StepProfiler()  # no-op unless the executor exported TONY_PROFILE_DIR
     urgent = UrgentSaveSignal()  # cooperative-preemption checkpoint trigger
+    pipeline = InputPipeline(
+        make_batch, start_step, loop.steps,
+        depth=None if loop.prefetch_depth < 0 else loop.prefetch_depth,
+        tracer=tracer,
+    )
+    if pipeline.overlapped:
+        obs_logging.info(
+            f"[train] input pipeline: overlapped, depth {pipeline.depth}"
+        )
     meter.start()
     # sampled step timing: one histogram observation (mean step wall time)
     # per logging window — the hot loop itself pays two int compares
@@ -302,21 +338,7 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
     try:
         for step in range(start_step, loop.steps):
             profiler.step(step)
-            if loader is not None:
-                local = loader.next()
-                batch = {
-                    "tokens": assemble(local) if assemble else jax.numpy.asarray(local)
-                }
-            elif assemble is not None:
-                local = model_module.synthetic_batch(
-                    jax.random.fold_in(jax.random.fold_in(key, step), jax.process_index()),
-                    local_rows, loop.seq_len, model_cfg,
-                )
-                batch = {k: assemble(v) for k, v in local.items()}
-            else:
-                batch = model_module.synthetic_batch(
-                    jax.random.fold_in(key, step), loop.batch_size, loop.seq_len, model_cfg
-                )
+            batch = pipeline.next(step)
             first = step == start_step
             if first:
                 t_first = time.perf_counter()
@@ -376,11 +398,22 @@ def _run_lm_training(model_module, model_cfg, loop: LoopConfig, tracer) -> dict:
                 ckpt_mgr.wait()
                 urgent.acknowledge(drain_req, step + 1)
     finally:
-        # a failed step/save must not leak the loader's native prefetch
-        # threads + mmapped shards (gang restarts re-enter this function
-        # in the same process) nor a dangling profiler capture
+        # a failed step/save must not leak the input-pipeline thread, the
+        # loader's native prefetch threads + mmapped shards (gang restarts
+        # re-enter this function in the same process) nor a dangling
+        # profiler capture; pipeline first — its producer calls the loader
+        producer_dead = pipeline.close()
         if loader is not None:
-            loader.close()
+            if producer_dead:
+                loader.close()
+            else:
+                # the producer is still inside a stalled loader read:
+                # unmapping the shards under it would segfault — leak the
+                # loader (daemon thread dies with the process) and say so
+                obs_logging.warning(
+                    "[train] input-pipeline producer did not exit within the "
+                    "close deadline; leaving the data loader open"
+                )
         profiler.stop()  # flush if the run ended inside the capture window
     if ckpt_mgr is not None:
         # skip if this step is already on disk (resume that ran no new steps)
@@ -437,6 +470,9 @@ def parse_loop_args(argv: list[str] | None = None) -> tuple[LoopConfig, dict]:
                         "llama family)")
     p.add_argument("--data_dir", default="")
     p.add_argument("--data_seed", type=int, default=0)
+    p.add_argument("--prefetch_depth", type=int, default=-1,
+                   help="input-pipeline lookahead; -1 = tony.train.prefetch-"
+                        "depth via env (default 2), 0 = synchronous assembly")
     p.add_argument("--preset", default="tiny")
     args = p.parse_args(argv if argv is not None else sys.argv[1:])
     d = vars(args)
