@@ -1,0 +1,44 @@
+"""Fixture: a drain-aware long-runner for per-task drain tests.
+
+Parks forever, polling ``$TONY_TRAIN_METRICS_FILE.drain`` (the control file
+the executor's DrainCourier drops) exactly like serving_http's drain
+watcher; on a notice it immediately publishes ``.drain.done`` with a fixed
+step and keeps parking — the AM-side ``request_task_drain`` episode should
+then read ``drained: true`` while the process stays alive (yielding is the
+caller's move). A metrics heartbeat publishes a step so the courier
+machinery has a metrics path to hang the control file on.
+
+Usage: drain_echo.py [ack_step]
+"""
+
+import json
+import os
+import sys
+import time
+
+METRICS = os.environ.get("TONY_TRAIN_METRICS_FILE", "")
+ACK_STEP = int(sys.argv[1]) if len(sys.argv) > 1 else 7
+
+
+def write_atomic(path, obj):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+step = 0
+acked = set()
+while True:
+    step += 1
+    if METRICS:
+        write_atomic(METRICS, {"step": step})
+        try:
+            with open(METRICS + ".drain") as f:
+                req_id = json.load(f).get("req_id")
+        except (OSError, ValueError):
+            req_id = None
+        if req_id and req_id not in acked:
+            acked.add(req_id)
+            write_atomic(METRICS + ".drain.done", {"req_id": req_id, "step": ACK_STEP})
+    time.sleep(0.1)

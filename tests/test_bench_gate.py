@@ -86,3 +86,74 @@ class TestCheckedInTrajectory:
                                "--record", "-"]) == 0
         finally:
             _sys.stdin = stdin
+
+
+def _serve_trajectory():
+    traj = gate.load_trajectory(REPO_ROOT, "SERVE_BENCH_*.json")
+    assert traj, "no checked-in SERVE_BENCH_*.json trajectory"
+    return traj
+
+
+class TestServeBenchFamily:
+    """The SERVE_BENCH family (`tony loadtest` records, docs/serving.md):
+    same wrapper schema, its own headline metric and trajectory, plus the
+    serve-specific gated directions (ttft_p99_ms regresses UPWARD)."""
+
+    def test_family_patterns_do_not_collide(self):
+        train = {name for name, _ in gate.load_trajectory(REPO_ROOT)}
+        serve = {name for name, _ in _serve_trajectory()}
+        assert not train & serve
+        assert all(n.startswith("SERVE_BENCH_") for n in serve)
+
+    def test_every_record_satisfies_the_gate_schema(self):
+        for fname, rec in _serve_trajectory():
+            errors = gate.validate_record(rec, wrapper=True)
+            assert not errors, f"{fname}: {errors}"
+            p = gate.parsed_of(rec)
+            assert p["metric"] == "serve_tokens_per_sec"
+            # the serve headline extras every record must carry
+            for key in ("tokens_per_sec", "ttft_p99_ms", "requests_failed"):
+                assert key in p, f"{fname}: missing {key}"
+            assert p["requests_failed"] == 0, \
+                f"{fname}: a record with client-visible failures is not gateable"
+
+    def test_gate_directions_cover_the_serve_headline(self):
+        assert gate.GATE_METRICS.get("ttft_p99_ms") == -1
+        assert gate.GATE_METRICS.get("tokens_per_sec") == +1
+
+    def test_gate_cli_passes_on_serve_trajectory(self, capsys):
+        from tony_tpu.cli.history import main_bench
+
+        assert main_bench(["--gate", "--trajectory-dir", REPO_ROOT,
+                           "--pattern", "SERVE_BENCH_*.json"]) == 0
+        assert "PASS" in capsys.readouterr().out
+
+    def test_gate_cli_fails_on_regressed_serve_record(self, tmp_path, capsys):
+        """Throughput dropping OR the TTFT tail growing past tolerance must
+        fail the gate — direction matters per metric."""
+        from tony_tpu.cli.history import main_bench
+
+        traj = _serve_trajectory()
+        for mutate in (
+            lambda p: p.update(value=p["value"] * 0.5,
+                               tokens_per_sec=p["tokens_per_sec"] * 0.5,
+                               vs_baseline=p["vs_baseline"] * 0.5),
+            lambda p: p.update(ttft_p99_ms=p["ttft_p99_ms"] * 2.0),
+        ):
+            regressed = json.loads(json.dumps(traj[-1][1]))
+            regressed["n"] = traj[-1][1]["n"] + 1
+            mutate(regressed["parsed"])
+            path = tmp_path / "regressed.json"
+            path.write_text(json.dumps(regressed))
+            assert main_bench(["--gate", "--trajectory-dir", REPO_ROOT,
+                               "--pattern", "SERVE_BENCH_*.json",
+                               "--record", str(path)]) == 1
+            assert "REGRESSION" in capsys.readouterr().out
+
+    def test_serve_records_do_not_gate_against_the_train_family(self):
+        """Trajectories compare within one `metric` name only: the serve
+        record diffs against nothing in the BENCH_* family."""
+        serve_rec = _serve_trajectory()[-1][1]
+        result = gate.evaluate(serve_rec, gate.load_trajectory(REPO_ROOT))
+        assert result.passed
+        assert any("fresh trajectory" in c.note for c in result.checks)

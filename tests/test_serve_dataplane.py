@@ -1,0 +1,903 @@
+"""Preemption-safe serving data plane (tony_tpu/serve; docs/serving.md).
+
+Three layers on top of PR's fleet control plane:
+
+- **Session affinity** (`serve/sessions.py` + router wiring): X-Tony-Session
+  pins, TTL/LRU hygiene, prompt-prefix hints, and the failover contract — a
+  pinned replica dying mid-session re-pins EXACTLY once with zero
+  client-visible failures, counted as lost reuse.
+- **Drain-aware lifecycle**: the EngineServer's submit-vs-drain race stays
+  serialized; the autoscaler drains its scale-down victim through the AM's
+  ``request_task_drain`` (DrainCourier contract) before ``resize_jobtype``;
+  a live gang answers the per-task drain RPC end to end.
+- **`tony loadtest`** (`serve/loadgen.py`): open-loop multi-session load,
+  TTFT/latency percentiles, reuse-loss accounting, and the gated
+  SERVE_BENCH record family.
+
+Headline E2E: a 2-replica paged-KV fleet under `tony loadtest` with
+multi-turn sessions shows prefix hits on pinned turns; a chaos
+``preempt-drain`` notice mid-load drives the full DrainCourier fan-out —
+replicas finish in-flight streams, ack, park, the AM yields cooperatively,
+the gang restarts, sessions re-pin — with ZERO client-visible failures;
+then an autoscaler scale-down drains its victim before removal.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.histserver import gate as bench_gate
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.serve import (
+    AutoscalePolicy,
+    Autoscaler,
+    FleetRouter,
+    HealthMonitor,
+    Replica,
+    ReplicaState,
+    SessionTable,
+)
+from tony_tpu.serve.loadgen import (
+    LoadGenerator,
+    LoadReport,
+    LoadSpec,
+    Turn,
+    parse_prompt_mix,
+    percentile,
+)
+from tony_tpu.serve.sessions import prefix_fingerprint
+
+# the fleet fakes (replica HTTP server + AM surface) are shared with the
+# control-plane suite — same contract, different behaviors under test
+from tests.test_serve_fleet import (  # noqa: E402
+    FakeAM,
+    FakeReplica,
+    _counter_value,
+    dead_url,
+    make_health,
+    make_router,
+    inject,
+    post_router,
+)
+
+pytestmark = pytest.mark.serve
+
+
+# ---------------------------------------------------------------------------
+# SessionTable: pins, TTL, LRU, hints, re-pin accounting
+# ---------------------------------------------------------------------------
+class TestSessionTable:
+    def test_pin_and_lookup_roundtrip(self):
+        t = SessionTable(ttl_s=60, max_sessions=10)
+        t.pin("s1", 2, [1, 2, 3])
+        pin = t.lookup("s1")
+        assert pin is not None and pin.replica_index == 2 and pin.repins == 0
+
+    def test_repin_counts_exactly_once_per_move(self):
+        t = SessionTable()
+        before = _counter_value("tony_router_session_repins_total")
+        t.pin("s", 0)
+        t.pin("s", 0)  # same replica: not a re-pin
+        assert _counter_value("tony_router_session_repins_total") == before
+        t.pin("s", 1)  # moved: one re-pin
+        assert _counter_value("tony_router_session_repins_total") == before + 1
+        assert t.lookup("s").repins == 1
+
+    def test_ttl_expires_idle_sessions(self):
+        t = SessionTable(ttl_s=0.05)
+        t.pin("s", 0)
+        assert t.lookup("s") is not None
+        time.sleep(0.08)
+        assert t.lookup("s") is None  # lazy expiry on lookup
+        t.pin("x", 1)
+        time.sleep(0.08)
+        assert t.sweep() == 1 and len(t) == 0
+
+    def test_lru_cap_evicts_oldest(self):
+        t = SessionTable(max_sessions=2)
+        t.pin("a", 0)
+        t.pin("b", 1)
+        t.lookup("a")  # refresh a: b becomes LRU
+        t.pin("c", 2)
+        assert t.lookup("b") is None
+        assert t.lookup("a") is not None and t.lookup("c") is not None
+
+    def test_prefix_hint_steers_matching_prompts(self):
+        t = SessionTable(prefix_span=4)
+        t.pin("s1", 3, [9, 9, 9, 9, 1])
+        assert t.hint([9, 9, 9, 9, 77]) == 3     # same leading span
+        assert t.hint([9, 9, 9, 8, 77]) is None  # differs inside the span
+        assert t.hint([9, 9]) is None            # shorter than the span
+        assert prefix_fingerprint([1, 2], 4) is None
+
+    def test_malformed_tokens_fingerprint_as_none(self):
+        """Garbage prompt_tokens are the REPLICA's 400 to answer — the
+        session table must not crash the router request on them."""
+        t = SessionTable(prefix_span=2)
+        for bad in (["x", "y", "z"], [2**80, 1, 2], [None, 1, 2], [1.5, "a"]):
+            assert prefix_fingerprint(bad, 2) is None
+            pin = t.pin(f"s-{bad!r}", 0, bad)  # no raise
+            assert pin.prefix is None
+            assert t.hint(bad) is None
+
+    def test_shared_hint_survives_one_sessions_eviction(self):
+        """N sessions share a system-prompt fingerprint: one expiring must
+        not blind new sessions while the others keep the pages warm."""
+        t = SessionTable(ttl_s=60, prefix_span=2)
+        t.pin("a", 1, [5, 5, 1])
+        t.pin("b", 1, [5, 5, 2])
+        t._evict_locked("a")
+        assert t.hint([5, 5, 9]) == 1   # b still carries it
+        t._evict_locked("b")
+        assert t.hint([5, 5, 9]) is None  # last carrier gone
+
+    def test_drop_replica_clears_hints_not_pins(self):
+        t = SessionTable(prefix_span=2)
+        t.pin("s1", 1, [5, 5, 5])
+        assert t.hint([5, 5, 9]) == 1
+        assert t.drop_replica(1) == 1
+        assert t.hint([5, 5, 9]) is None
+        assert t.lookup("s1") is not None  # the pin re-pins lazily instead
+
+
+# ---------------------------------------------------------------------------
+# Router affinity: stickiness, hint routing, failover re-pin
+# ---------------------------------------------------------------------------
+def post_session(url, obj, session, timeout=30):
+    req = urllib.request.Request(
+        url + "/v1/completions", json.dumps(obj).encode(),
+        {"Content-Type": "application/json", "X-Tony-Session": session})
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+class TestRouterAffinity:
+    def test_session_sticks_despite_outstanding_imbalance(self):
+        a, b, am = FakeReplica(tokens=[1]), FakeReplica(tokens=[2]), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            inject(h, 0, a.url, outstanding=0)
+            inject(h, 1, b.url, outstanding=0)
+            _, hdrs, _ = post_session(router.url, {"prompt_tokens": [1]}, "conv-1")
+            first = hdrs["X-Tony-Replica"]
+            # load now makes the OTHER replica the least-outstanding pick;
+            # the pin must win anyway
+            h.replicas[int(first)].outstanding = 50
+            for _ in range(3):
+                _, hdrs, _ = post_session(router.url, {"prompt_tokens": [1]}, "conv-1")
+                assert hdrs["X-Tony-Replica"] == first
+            # a session-less request DOES follow least-outstanding
+            _, hdrs, _ = post_router(router.url, {"prompt_tokens": [1]})
+            assert hdrs["X-Tony-Replica"] != first
+        finally:
+            router.stop()
+            a.close()
+            b.close()
+
+    def test_new_session_with_shared_prefix_follows_hint(self):
+        a, b, am = FakeReplica(), FakeReplica(), FakeAM()
+        h = make_health(am)
+        router = make_router(
+            h, sessions=SessionTable(prefix_span=4))
+        try:
+            inject(h, 0, a.url)
+            inject(h, 1, b.url)
+            shared = [7, 7, 7, 7]
+            _, hdrs, _ = post_session(
+                router.url, {"prompt_tokens": shared + [1]}, "conv-a")
+            pinned = hdrs["X-Tony-Replica"]
+            # make the pinned replica the WORSE least-outstanding pick
+            h.replicas[int(pinned)].outstanding = 50
+            _, hdrs, _ = post_session(
+                router.url, {"prompt_tokens": shared + [2]}, "conv-b")
+            assert hdrs["X-Tony-Replica"] == pinned  # hint beat the balance
+        finally:
+            router.stop()
+            a.close()
+            b.close()
+
+    def test_pinned_replica_death_repins_exactly_once_zero_failures(self):
+        """The satellite contract: a pinned replica dying mid-session must
+        re-pin exactly once and the client must never see a failure."""
+        b, am = FakeReplica(tokens=[7]), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            # pin conv-x to replica 0 (ties break to index 0)
+            a = FakeReplica(tokens=[5])
+            inject(h, 0, a.url)
+            inject(h, 1, b.url)
+            code, hdrs, _ = post_session(router.url, {"prompt_tokens": [1]}, "conv-x")
+            assert code == 200 and hdrs["X-Tony-Replica"] == "0"
+            # replica 0's process dies between health ticks
+            a.close()
+            repins0 = _counter_value("tony_router_session_repins_total")
+            for _ in range(4):  # several turns: only the FIRST re-pins
+                code, hdrs, body = post_session(
+                    router.url, {"prompt_tokens": [1]}, "conv-x")
+                assert code == 200 and body["tokens"] == [7]
+                assert hdrs["X-Tony-Replica"] == "1"
+            assert _counter_value("tony_router_session_repins_total") == repins0 + 1
+        finally:
+            router.stop()
+            b.close()
+
+    def test_draining_replica_sheds_sessions(self):
+        a, b, am = FakeReplica(tokens=[5]), FakeReplica(tokens=[7]), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            am.set_replica(0, a.url)
+            am.set_replica(1, b.url)
+            h.tick()
+            _, hdrs, _ = post_session(router.url, {"prompt_tokens": [1]}, "conv-d")
+            pinned = int(hdrs["X-Tony-Replica"])
+            (a if pinned == 0 else b).cfg["draining"] = True
+            h.tick()
+            assert h.replicas[pinned].state == ReplicaState.DRAINING
+            code, hdrs, _ = post_session(router.url, {"prompt_tokens": [1]}, "conv-d")
+            assert code == 200 and int(hdrs["X-Tony-Replica"]) == 1 - pinned
+        finally:
+            router.stop()
+            a.close()
+            b.close()
+
+    def test_malformed_body_with_session_header_forwards_replica_400(self):
+        a, am = FakeReplica(status=400, error="empty prompt"), FakeAM()
+        h = make_health(am)
+        router = make_router(h, sessions=SessionTable(prefix_span=2))
+        try:
+            inject(h, 0, a.url)
+            req = urllib.request.Request(
+                router.url + "/v1/completions",
+                json.dumps({"prompt_tokens": ["x", "y"]}).encode(),
+                {"Content-Type": "application/json", "X-Tony-Session": "bad"})
+            try:
+                resp = urllib.request.urlopen(req, timeout=10)
+                code = resp.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+            assert code == 400  # the replica's verdict, not a dropped socket
+        finally:
+            router.stop()
+            a.close()
+
+    def test_sessions_page_lists_pins(self):
+        a, am = FakeReplica(), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            inject(h, 0, a.url)
+            post_session(router.url, {"prompt_tokens": [1]}, "conv-page")
+            with urllib.request.urlopen(router.url + "/sessions", timeout=10) as resp:
+                page = json.loads(resp.read())
+            assert page["sessions"] >= 1
+            assert any(p["session"] == "conv-page" for p in page["recent"])
+            with urllib.request.urlopen(router.url + "/stats", timeout=10) as resp:
+                stats = json.loads(resp.read())
+            assert "sessions" in stats["router"]
+            assert "session_repins" in stats["router"]
+        finally:
+            router.stop()
+            a.close()
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler: drain-before-scale-down
+# ---------------------------------------------------------------------------
+def _sig(healthy=2, queue=0, active=0, total=16):
+    from tony_tpu.serve.health import FleetSignals
+
+    return FleetSignals(replicas_known=healthy, replicas_healthy=healthy,
+                        queue_depth=queue, slots_active=active, slots_total=total)
+
+
+class _FakeDrainAM:
+    """resize + request_task_drain levers with scripted drain acks."""
+
+    def __init__(self, drained_after=1):
+        self.resizes = []
+        self.drain_calls = []
+        self.drained_after = drained_after
+
+    def resize(self, job, n):
+        self.resizes.append((job, n))
+
+    def drain(self, job, idx):
+        self.drain_calls.append((job, idx))
+        return {"ack": True, "req_id": "d1",
+                "drained": len(self.drain_calls) >= self.drained_after}
+
+
+def _scaler(am, health=None, drained_after=1, drain_timeout_s=30.0, **policy):
+    p = AutoscalePolicy(**{**dict(min_replicas=1, max_replicas=4,
+                                  scale_up_ticks=1, scale_down_ticks=1), **policy})
+    h = health or make_health(FakeAM())
+    return Autoscaler(h, am.resize, p, drain=am.drain,
+                      drain_timeout_s=drain_timeout_s)
+
+
+class TestAutoscalerDrainAware:
+    def test_scale_down_drains_victim_before_resize(self):
+        am = _FakeDrainAM(drained_after=2)
+        a = _scaler(am)
+        a.target = 3
+        h = a.health
+        for i in range(3):
+            inject(h, i, dead_url()).stats = {}
+        # decide() → down; first tick issues the drain, resize NOT yet
+        a.tick()
+        assert am.drain_calls == [("serve", 2)]  # victim = highest index
+        assert am.resizes == []
+        assert a.pending_down is not None
+        # second tick: the drain ack landed → resize fires
+        a.tick()
+        assert am.resizes == [("serve", 2)]
+        assert a.pending_down is None
+
+    def test_health_draining_state_also_releases_the_resize(self):
+        am = _FakeDrainAM(drained_after=99)  # RPC never acks
+        a = _scaler(am)
+        h = a.health
+        for i in range(2):
+            inject(h, i, dead_url()).stats = {}
+        a.tick()
+        assert am.resizes == []
+        # the victim flips DRAINING in the fleet view (stopped admitting)
+        h.replicas[1].state = ReplicaState.DRAINING
+        a.tick()
+        assert am.resizes == [("serve", 1)]
+
+    def test_drain_timeout_resizes_anyway(self):
+        am = _FakeDrainAM(drained_after=99)
+        a = _scaler(am, drain_timeout_s=0.0)  # immediate deadline
+        h = a.health
+        for i in range(2):
+            inject(h, i, dead_url()).stats = {}
+        a.tick()  # issues drain; deadline already passed → resize
+        assert am.resizes == [("serve", 1)]
+        assert a.pending_down is None
+
+    def test_scale_up_mid_drain_completes_shrink_first(self):
+        """An in-flight victim drain is irreversible (the replica already
+        stopped admitting and the AM re-sends the notice until acked), so
+        returning pressure must NOT strand it half-drained: the shrink
+        carries through, THEN the ordinary path scales back up."""
+        am = _FakeDrainAM(drained_after=2)
+        a = _scaler(am, scale_up_ticks=1)
+        h = a.health
+        for i in range(2):
+            inject(h, i, dead_url()).stats = {}
+        a.tick()
+        assert a.pending_down is not None and am.resizes == []
+        # queue pressure returns mid-drain
+        for i in range(2):
+            h.replicas[i].stats = {"queue_depth": 100, "slots_active": 8,
+                                   "slots_total": 8}
+        a.tick()  # drain acked (2nd poll) → the shrink completes
+        assert am.resizes == [("serve", 1)]
+        assert a.pending_down is None
+        # fleet view converges to 1 replica post-rebuild; pressure persists
+        del h.replicas[1]
+        a.tick()
+        assert am.resizes[-1] == ("serve", 2)  # scaled back up immediately
+
+    def test_external_shrink_supersedes_pending_drain(self):
+        am = _FakeDrainAM(drained_after=99)
+        a = _scaler(am)
+        h = a.health
+        for i in range(2):
+            inject(h, i, dead_url()).stats = {}
+        a.tick()
+        assert a.pending_down is not None
+        # capacity loss / tony resize already took the fleet to the target
+        del h.replicas[1]
+        a.tick()
+        assert a.pending_down is None
+        assert am.resizes == []  # nothing left for the autoscaler to do
+
+    def test_without_drain_lever_resize_is_direct(self):
+        am = _FakeDrainAM()
+        p = AutoscalePolicy(min_replicas=1, max_replicas=4, scale_down_ticks=1)
+        a = Autoscaler(make_health(FakeAM()), am.resize, p)  # no drain=
+        for i in range(2):
+            inject(a.health, i, dead_url()).stats = {}
+        a.tick()
+        assert am.resizes == [("serve", 1)] and am.drain_calls == []
+
+
+# ---------------------------------------------------------------------------
+# EngineServer: the submit-vs-drain race stays serialized
+# ---------------------------------------------------------------------------
+class TestSubmitVsDrainRace:
+    def test_every_submit_racing_a_drain_gets_a_terminal_event(self):
+        """Hammer submit() from many threads while stop() drains: every
+        stream must end in a terminal event — tokens then done, or the
+        draining error — and none may be left dangling in an inbox nobody
+        reads (the _admit_lock serialization under test)."""
+        from tests.test_serve import tiny_engine
+        from tony_tpu.models.serving_http import EngineServer
+
+        srv = EngineServer(tiny_engine()).start()
+        streams, lock = [], threading.Lock()
+        go = threading.Event()
+        stop_submitting = threading.Event()
+
+        def spam():
+            go.wait()
+            while not stop_submitting.is_set():
+                out = srv.submit([1, 2, 3], 4)
+                with lock:
+                    streams.append(out)
+
+        threads = [threading.Thread(target=spam, daemon=True) for _ in range(4)]
+        for t in threads:
+            t.start()
+        go.set()
+        time.sleep(0.15)  # submissions in flight on all threads
+        assert srv.stop(timeout_s=60)
+        stop_submitting.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert streams
+        outcomes = {"done": 0, "draining": 0, "overloaded": 0}
+        for out in streams:
+            # walk the stream to its terminal event; a dangling stream
+            # (enqueued after the refuse-sweep, never answered) hangs HERE
+            while True:
+                kind, payload = out.get(timeout=5)
+                if kind == "done":
+                    outcomes["done"] += 1
+                    break
+                if kind == "error":
+                    # load shedding ("overloaded") is the only other legal
+                    # refusal — anything else is a broken drain
+                    assert "draining" in payload or "overloaded" in payload, payload
+                    outcomes["draining" if "draining" in payload
+                             else "overloaded"] += 1
+                    break
+        assert outcomes["draining"] > 0  # the race window was actually hit
+
+    def test_post_drain_submissions_refused_immediately(self):
+        from tests.test_serve import tiny_engine
+        from tony_tpu.models.serving_http import EngineServer
+
+        srv = EngineServer(tiny_engine()).start()
+        assert srv.stop(timeout_s=30)
+        kind, payload = srv.submit([1], 4).get(timeout=5)
+        assert kind == "error" and "draining" in payload
+
+
+# ---------------------------------------------------------------------------
+# Loadgen: mix parsing, percentiles, report/record, live run over fakes
+# ---------------------------------------------------------------------------
+class TestLoadgenUnits:
+    def test_prompt_mix_parsing(self):
+        assert parse_prompt_mix("16:0.5,64:0.5") == [(16, 0.5), (64, 0.5)]
+        assert parse_prompt_mix("32") == [(32, 1.0)]
+        with pytest.raises(ValueError):
+            parse_prompt_mix("")
+        with pytest.raises(ValueError):
+            parse_prompt_mix("0:1")
+        with pytest.raises(ValueError):
+            parse_prompt_mix("16:-1")
+
+    def test_percentile_nearest_rank(self):
+        xs = [float(i) for i in range(1, 101)]
+        assert percentile(xs, 50) == 51.0
+        assert percentile(xs, 99) == 100.0
+        assert percentile([], 99) == 0.0
+
+    def _report(self):
+        spec = LoadSpec(url="http://x", sessions=2, turns=2)
+        turns = [
+            Turn(0, 0, True, 200, replica="0", tokens=8, ttft_ms=10, latency_ms=40,
+                 pinned=False),
+            Turn(0, 1, True, 200, replica="0", tokens=8, ttft_ms=5, latency_ms=30,
+                 pinned=True),
+            Turn(1, 0, True, 200, replica="1", tokens=8, ttft_ms=12, latency_ms=45),
+            Turn(1, 1, False, 503, error="boom"),
+        ]
+        return LoadReport(spec=spec, turns=turns, wall_s=2.0)
+
+    def test_report_aggregates(self):
+        d = self._report().to_dict()
+        assert d["requests_ok"] == 3 and d["requests_failed"] == 1
+        assert d["tokens_total"] == 24 and d["tokens_per_sec"] == 12.0
+        assert d["ttft_p99_ms"] == 12
+        assert d["pinned_followup_turns"] == 1 and d["followup_turns"] == 1
+        assert d["first_errors"][0]["error"] == "boom"
+
+    def test_bench_record_satisfies_the_gate_schema(self):
+        rec = self._report().to_bench_record(1)
+        assert bench_gate.validate_record(rec, wrapper=True) == []
+        p = rec["parsed"]
+        assert p["metric"] == "serve_tokens_per_sec"
+        assert p["value"] == p["tokens_per_sec"] == 12.0
+        assert p["vs_baseline"] == 1.0
+        assert p["ttft_p99_ms"] == 12
+        rec2 = self._report().to_bench_record(2, baseline_tokens_per_sec=24.0)
+        assert rec2["parsed"]["vs_baseline"] == 0.5
+
+    def test_ttft_regression_fails_the_gate(self):
+        """The SERVE_BENCH direction: ttft_p99_ms regresses UPWARD."""
+        good = self._report().to_bench_record(1)
+        regressed = json.loads(json.dumps(good))
+        regressed["n"] = 2
+        regressed["parsed"]["ttft_p99_ms"] *= 3.0
+        result = bench_gate.evaluate(regressed, [("SERVE_BENCH_r01.json", good)])
+        assert not result.passed
+        failing = [c.metric for c in result.checks if not c.passed]
+        assert failing == ["ttft_p99_ms"]
+        # while a faster record passes
+        better = json.loads(json.dumps(good))
+        better["n"] = 2
+        better["parsed"]["ttft_p99_ms"] /= 2.0
+        assert bench_gate.evaluate(better, [("SERVE_BENCH_r01.json", good)]).passed
+
+    def test_open_loop_run_over_fake_fleet(self):
+        """End to end over the router + fake replicas: sessions stick,
+        turns chain, the report carries TTFT and the repin ledger."""
+        a, b, am = FakeReplica(tokens=[1, 2]), FakeReplica(tokens=[3, 4]), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            inject(h, 0, a.url)
+            inject(h, 1, b.url)
+            spec = LoadSpec(url=router.url, rate=50.0, sessions=4, turns=3,
+                            prompt_mix=[(8, 1.0)], max_tokens=4, stream=True,
+                            timeout_s=30.0, seed=3)
+            report = LoadGenerator(spec).run()
+            d = report.to_dict()
+            assert d["requests_failed"] == 0 and d["requests_ok"] == 12
+            assert d["tokens_total"] == 12 * 4  # fake streams 4 tokens
+            assert d["ttft_p99_ms"] > 0
+            # affinity held: every follow-up turn hit the pinned replica
+            assert d["followup_turns"] == 8
+            assert d["pinned_followup_turns"] == 8
+            assert d.get("session_repins") == 0
+            rec = report.to_bench_record(1)
+            assert bench_gate.validate_record(rec, wrapper=True) == []
+        finally:
+            router.stop()
+            a.close()
+            b.close()
+
+    def test_non_streaming_run(self):
+        a, am = FakeReplica(tokens=[5]), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            inject(h, 0, a.url)
+            spec = LoadSpec(url=router.url, rate=100.0, sessions=2, turns=2,
+                            prompt_mix=[(4, 1.0)], max_tokens=2, stream=False,
+                            timeout_s=30.0)
+            d = LoadGenerator(spec).run().to_dict()
+            assert d["requests_failed"] == 0 and d["requests_ok"] == 4
+        finally:
+            router.stop()
+            a.close()
+
+    def test_loadtest_cli_reports_and_writes_record(self, tmp_path, capsys):
+        from tony_tpu.cli.loadtest import main as loadtest_main
+
+        a, am = FakeReplica(tokens=[9]), FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            inject(h, 0, a.url)
+            rec_path = tmp_path / "SERVE_BENCH_r09.json"
+            rc = loadtest_main([
+                "--url", router.url, "--sessions", "2", "--turns", "2",
+                "--rate", "100", "--prompt-mix", "4:1", "--max-tokens", "2",
+                "--bench-record", str(rec_path), "--round", "9",
+            ])
+            assert rc == 0
+            rec = json.loads(rec_path.read_text())
+            assert bench_gate.validate_record(rec, wrapper=True) == []
+            assert rec["n"] == 9
+            out = capsys.readouterr().out
+            assert "tokens_per_sec" in out
+        finally:
+            router.stop()
+            a.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: the preempt-drain fault kind parses and synthesizes a notice
+# ---------------------------------------------------------------------------
+class TestPreemptDrainFault:
+    def test_spec_parses_and_notice_shape(self):
+        from tony_tpu.chaos import ChaosContext, FaultSchedule
+
+        sched = FaultSchedule.parse("preempt-drain:ms=5000", seed=1)
+        ctx = ChaosContext(schedule=sched, identity="am")
+        notice = ctx.poll_preempt_notice()
+        assert notice is not None
+        assert notice["mode"] == "drain" and notice["deadline_ms"] == 5000
+        assert notice["req_id"].startswith("chaos-")
+        assert ctx.poll_preempt_notice() is None  # once-per-job latch
+
+    def test_step_gate_holds_until_progress(self):
+        from tony_tpu.chaos import ChaosContext, FaultSchedule
+
+        sched = FaultSchedule.parse("preempt-drain@step+5", seed=1)
+        ctx = ChaosContext(schedule=sched, identity="am")
+        assert ctx.poll_preempt_notice() is None
+        ctx.set_progress(5)
+        assert ctx.poll_preempt_notice() is not None
+
+
+# ---------------------------------------------------------------------------
+# E2E: request_task_drain over a live gang (DrainCourier round trip)
+# ---------------------------------------------------------------------------
+from tests.test_e2e import FAST, fixture_cmd  # noqa: E402
+
+from tony_tpu.cluster.client import Client  # noqa: E402
+from tony_tpu.cluster.session import JobStatus  # noqa: E402
+
+
+def _wait(pred, timeout_s=60, poll_s=0.1):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll_s)
+    return None
+
+
+@pytest.mark.e2e
+class TestRequestTaskDrainE2E:
+    def test_per_task_drain_round_trip(self, tmp_tony_root):
+        """request_task_drain → heartbeat piggyback → DrainCourier control
+        file → the (drain-aware) child acks → drained:true over RPC, while
+        the task keeps running (yielding is the caller's move)."""
+        cfg = TonyConfig({
+            **FAST,
+            keys.STAGING_ROOT: str(tmp_tony_root),
+            keys.TASK_METRICS_INTERVAL_MS: "200",
+            keys.PROFILE_POLL_INTERVAL_MS: "100",
+            "tony.worker.instances": "2",
+            keys.EXECUTES: fixture_cmd("drain_echo.py"),
+        })
+        client = Client(cfg)
+        handle = client.submit()
+        try:
+            rpc = handle.rpc()
+            assert rpc is not None
+
+            def all_running():
+                infos = rpc.call("get_task_infos")
+                up = [t for t in infos if t["status"] == "RUNNING"]
+                return up if len(up) == 2 else None
+
+            assert _wait(all_running), "gang never ran"
+            r = rpc.call("request_task_drain", job_name="worker", index=1)
+            assert r["ack"] and r["drained"] is False
+            req_id = r["req_id"]
+
+            def drained():
+                got = rpc.call("request_task_drain", job_name="worker", index=1)
+                return got if got.get("drained") else None
+
+            got = _wait(drained, timeout_s=30)
+            assert got, "drain ack never landed"
+            assert got["req_id"] == req_id  # same episode, idempotent
+            assert got["step"] == 7         # the fixture's ack step
+            # the drained task is STILL RUNNING (parked) — and the OTHER
+            # task was never asked to drain
+            infos = rpc.call("get_task_infos")
+            assert all(t["status"] == "RUNNING" for t in infos)
+            r0 = rpc.call("request_task_drain", job_name="worker", index=0)
+            assert r0["drained"] is False
+            # unknown task → typed refusal, not a silent episode
+            bad = rpc.call("request_task_drain", job_name="worker", index=9)
+            assert bad["ack"] is False
+        finally:
+            Client.kill(handle)
+        assert client.monitor_application(handle, quiet=True) == JobStatus.KILLED
+
+
+# ---------------------------------------------------------------------------
+# E2E headline: fleet + loadtest + chaos preempt-drain + drained scale-down
+# ---------------------------------------------------------------------------
+@pytest.mark.e2e
+@pytest.mark.chaos
+class TestServeDataPlaneE2E:
+    def test_loadtest_affinity_preemption_and_drained_scale_down(
+        self, tmp_tony_root
+    ):
+        from tony_tpu.cli.serve import _fleet_am_client, build_serve_config
+        from tony_tpu.cluster import history
+
+        conf, _ = build_serve_config([
+            "--replicas", "2", "--slots", "2", "--max_len", "64",
+            "--decode_chunk", "4", "--kv", "paged", "--page_len", "8",
+        ])
+        conf.set(keys.STAGING_ROOT, str(tmp_tony_root))
+        for k, v in FAST.items():
+            conf.set(k, v)
+        conf.set(keys.TASK_HEARTBEAT_INTERVAL_MS, "200")
+        conf.set(keys.TASK_METRICS_INTERVAL_MS, "300")
+        # cooperative preemption mid-load: the notice arms once a replica's
+        # metrics pump reports step 3 (~6s of live serving) — i.e. while the
+        # loadtest below is in flight
+        conf.set(keys.CHAOS_SPEC, "preempt-drain:ms=45000@step+3")
+        conf.set(keys.CHAOS_SEED, "5")
+
+        client = Client(conf)
+        handle = client.submit()
+        health = router = None
+        try:
+            from tony_tpu.cli.notebook import wait_for_task_url
+
+            wait_for_task_url(handle, constants.SERVE_JOB_NAME, timeout_s=240)
+            fleet_rpc = _fleet_am_client(handle)
+            assert fleet_rpc is not None
+            health = HealthMonitor(fleet_rpc.call, interval_s=0.2, fail_threshold=2)
+            health.tick()
+            health.start()
+            router = FleetRouter(
+                health, failover_deadline_s=180.0,
+                sessions=SessionTable(prefix_span=8),
+            ).start()
+            assert _wait(
+                lambda: health.fleet_signals().replicas_healthy == 2 or None,
+                timeout_s=120,
+            ), f"fleet never came up: {health.fleet_info()}"
+
+            # ---- load: multi-turn pinned sessions with a shared prefix;
+            # open-loop arrivals spread across ~30s so the preempt-drain
+            # (armed at metrics step 3) lands mid-load
+            spec = LoadSpec(
+                url=router.url, rate=0.35, sessions=8, turns=3,
+                prompt_mix=[(16, 1.0)], max_tokens=4, stream=True,
+                shared_prefix=8, turn_tokens=4, timeout_s=200.0, seed=11,
+            )
+            gen = LoadGenerator(spec)
+            report_box = {}
+
+            def run_load():
+                report_box["r"] = gen.run()
+
+            load_thread = threading.Thread(target=run_load, daemon=True)
+            load_thread.start()
+
+            # ---- the cooperative preemption episode lands mid-load
+            observed_draining = threading.Event()
+
+            def watch():
+                while not report_box.get("r"):
+                    if any(r.state == ReplicaState.DRAINING
+                           for r in health.snapshot()):
+                        observed_draining.set()
+                    time.sleep(0.05)
+
+            threading.Thread(target=watch, daemon=True).start()
+            assert _wait(
+                lambda: (handle.rpc().call("get_application_status")
+                         .get("restart_attempt", 0) >= 1) or None,
+                timeout_s=180,
+            ), "preempt-drain never yielded the gang"
+            assert observed_draining.wait(timeout=30), \
+                "no replica was ever observed DRAINING (fan-out missed?)"
+            assert _wait(
+                lambda: health.fleet_signals().replicas_healthy == 2 or None,
+                timeout_s=180,
+            ), f"fleet never recovered: {health.fleet_info()}"
+
+            load_thread.join(timeout=300)
+            report = report_box.get("r")
+            assert report is not None, "loadtest never finished"
+            d = report.to_dict()
+            # ZERO client-visible failures across the whole episode
+            assert d["requests_failed"] == 0, d.get("first_errors")
+            assert d["requests_ok"] == spec.sessions * spec.turns
+            # prefix reuse on pinned turns: warm pages were actually hit
+            assert d.get("prefix_hit_tokens", 0) > 0, d
+            assert d["pinned_followup_turns"] > 0
+
+            # the drain episode is in the history: requested AND yielded
+            # cooperatively, with BOTH replicas' courier acks recorded
+            def drain_events():
+                evs = history.read_events(
+                    os.path.join(str(tmp_tony_root), "history"), handle.app_id)
+                types = [e.type.value for e in evs]
+                return evs if ("PREEMPTION_REQUESTED" in types
+                               and "PREEMPTION_YIELDED" in types) else None
+
+            evs = _wait(drain_events, timeout_s=30)
+            assert evs, "drain episode missing from the event stream"
+            yielded = next(e for e in evs if e.type.value == "PREEMPTION_YIELDED")
+            assert yielded.payload.get("cooperative") is True
+            saved = yielded.payload.get("saved_steps") or {}
+            assert set(saved) == {"serve:0", "serve:1"}
+
+            # ---- autoscaler scale-down drains the victim BEFORE resizing
+            resize_order: list = []
+            scaler = Autoscaler(
+                health,
+                lambda job, n: (resize_order.append(("resize", n)),
+                                fleet_rpc.call("resize_jobtype",
+                                               job_name=job, instances=n))[1],
+                AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                scale_down_utilization=1.0, scale_down_ticks=1),
+                drain=lambda job, i: (resize_order.append(("drain", i)),
+                                      fleet_rpc.call("request_task_drain",
+                                                     job_name=job, index=i))[1],
+                drain_timeout_s=60.0,
+            )
+            deadline = time.time() + 90
+            while time.time() < deadline and not any(
+                kind == "resize" for kind, _ in resize_order
+            ):
+                scaler.tick()
+                time.sleep(0.5)
+            assert ("drain", 1) in resize_order
+            assert ("resize", 1) in resize_order
+            assert resize_order.index(("drain", 1)) < resize_order.index(("resize", 1))
+            # sessions pinned to the drained victim re-pinned (lost reuse is
+            # observable) at some point during the episode
+            repins = router.sessions and _counter_value(
+                "tony_router_session_repins_total")
+            assert repins is not None
+            # fleet reconverges at 1 replica
+            assert _wait(
+                lambda: (health.fleet_signals().replicas_known == 1
+                         and health.fleet_signals().replicas_healthy == 1) or None,
+                timeout_s=180,
+            ), f"scale-down never converged: {health.fleet_info()}"
+        finally:
+            if router is not None:
+                router.stop()
+            if health is not None:
+                health.stop()
+            Client.kill(handle)
+            final = client.monitor_application(handle, quiet=True)
+            from tony_tpu.obs import trace as obs_trace
+
+            obs_trace.shutdown()
+        assert final == JobStatus.KILLED
+
+
+# ---------------------------------------------------------------------------
+# Slow soak: 100+ concurrent streams through one replica
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestLoadSoak:
+    def test_100_plus_streams_sustained(self):
+        """The ROADMAP item-1 workload: 100+ concurrent streaming sessions
+        against a live EngineServer behind the router — sustained tokens/s
+        and a full-percentile report with zero failures."""
+        from tests.test_serve import http_server, tiny_engine
+        from tony_tpu.models.serving_http import EngineServer
+
+        srv = EngineServer(tiny_engine(num_slots=8, max_len=64),
+                           max_queue=1024).start()
+        httpd, url = http_server(srv)
+        am = FakeAM()
+        h = make_health(am)
+        router = make_router(h)
+        try:
+            inject(h, 0, url)
+            spec = LoadSpec(url=router.url, rate=40.0, sessions=120, turns=1,
+                            prompt_mix=[(8, 0.7), (16, 0.3)], max_tokens=8,
+                            stream=True, timeout_s=600.0, seed=1)
+            report = LoadGenerator(spec).run()
+            d = report.to_dict()
+            assert d["requests_failed"] == 0, d.get("first_errors")
+            assert d["requests_ok"] == 120
+            assert d["tokens_per_sec"] > 0 and d["ttft_p99_ms"] > 0
+        finally:
+            router.stop()
+            httpd.shutdown()
+            srv.stop(timeout_s=30)

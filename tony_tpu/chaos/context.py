@@ -211,6 +211,23 @@ class ChaosContext:
         return self.take("rpc-sever", detail={"method": method}) is not None
 
     # ------------------------------------------ resource-manager (AM) seam
+    def poll_preempt_notice(self) -> "dict[str, Any] | None":
+        """``preempt-drain`` fault at the AM's ``poll_preemption`` seam:
+        synthesize the pool's COOPERATIVE drain notice (same shape the pool
+        service piggybacks on ``poll_exited``), so a single-tenant run — the
+        in-process RM, which never preempts — exercises the whole
+        checkpoint-then-yield machinery: heartbeat fan-out, DrainCourier,
+        urgent save / serving drain, cooperative yield. Fires once (the
+        standard once-per-job latch); ``ms=`` sets the deadline."""
+        f = self.take("preempt-drain")
+        if f is None:
+            return None
+        return {
+            "req_id": f"chaos-{f.key}",
+            "mode": "drain",
+            "deadline_ms": f.ms(default=20_000),
+        }
+
     def perturb_container_exits(self, rm, exits: dict[str, int]) -> dict[str, int]:
         """node-loss / preempt faults applied at the RM's poll_exited seam:
         victims are killed through the real kill path and surface as synthetic

@@ -48,6 +48,9 @@ FAULT_KINDS = frozenset({
     # cluster/resources.py + cluster/pool.py — container/pool faults
     "node-loss",       # every live container dies with EXIT_NODE_LOST
     "preempt",         # targeted containers die with EXIT_PREEMPTED (budget-exempt)
+    "preempt-drain",   # a COOPERATIVE pool drain notice (checkpoint-then-yield
+                       # machinery end to end, no pool service needed); ms= sets
+                       # the synthesized deadline (default 20s)
     "capacity-flap",   # a capacity probe sees an empty pool (downsize hysteresis test)
     # cluster/appmaster.py + cluster/pool.py — CONTROL-PLANE faults
     "am-crash",        # the AM SIGKILLs itself (work-preserving takeover / AM-retry path)
@@ -61,9 +64,10 @@ FAULT_KINDS = frozenset({
 CONTAINER_FAULTS = frozenset({"node-loss", "preempt"})
 
 #: Kinds that may gate on the job's reported training step (``@step+N``):
-#: container faults and the AM's own crash — both are decided in the AM,
-#: the only process fed the executors' pushed step metrics.
-STEP_GATED_FAULTS = CONTAINER_FAULTS | frozenset({"am-crash"})
+#: container faults, the cooperative drain notice, and the AM's own crash —
+#: all decided in the AM, the only process fed the executors' pushed step
+#: metrics.
+STEP_GATED_FAULTS = CONTAINER_FAULTS | frozenset({"am-crash", "preempt-drain"})
 
 _TARGET_JOB = re.compile(r"^[A-Za-z][A-Za-z0-9_\-]*$")
 

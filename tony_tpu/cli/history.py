@@ -305,6 +305,9 @@ def main_bench(argv: list[str] | None = None) -> int:
                         "the newest trajectory record (self-check mode)")
     p.add_argument("--trajectory-dir", default=os.getcwd(),
                    help="directory holding BENCH_*.json (default: cwd)")
+    p.add_argument("--pattern", default="BENCH_*.json",
+                   help="trajectory file family (e.g. 'SERVE_BENCH_*.json' "
+                        "for the tony loadtest records)")
     p.add_argument("--tolerance-pct", type=float, default=_gate.DEFAULT_TOLERANCE_PCT,
                    help="allowed drop vs the trajectory best, percent")
     p.add_argument("--threshold", action="append", default=[],
@@ -326,13 +329,13 @@ def main_bench(argv: list[str] | None = None) -> int:
         return 2
 
     try:
-        trajectory = _gate.load_trajectory(args.trajectory_dir)
+        trajectory = _gate.load_trajectory(args.trajectory_dir, args.pattern)
     except (OSError, ValueError) as e:
         print(f"tony bench --gate: unreadable trajectory under "
               f"{args.trajectory_dir}: {e}", file=sys.stderr)
         return 2
     if not trajectory:
-        print(f"tony bench --gate: no BENCH_*.json under {args.trajectory_dir}",
+        print(f"tony bench --gate: no {args.pattern} under {args.trajectory_dir}",
               file=sys.stderr)
         return 2
     schema_errors = []

@@ -1,0 +1,122 @@
+"""``tony loadtest``: drive a serving endpoint with open-loop session load.
+
+The measurement half of the serving data plane (docs/serving.md run-book):
+points :class:`~tony_tpu.serve.loadgen.LoadGenerator` at a fleet router (or
+a bare replica), prints the aggregate report, and optionally emits the
+``SERVE_BENCH_r<N>.json`` record ``tony bench --gate --pattern
+'SERVE_BENCH_*.json'`` enforces.
+
+    tony loadtest --url http://127.0.0.1:8433 --sessions 32 --turns 4
+    tony loadtest --url ... --bench-record SERVE_BENCH_r02.json --round 2 \
+        --baseline 450
+
+Defaults come from ``tony.serve.loadtest.*`` (overridable per-flag or via
+``--conf``); exit status is nonzero when any request failed — a loadtest
+with client-visible errors is a failed run, whatever the throughput says.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.serve.loadgen import LoadGenerator, LoadSpec, parse_prompt_mix
+
+
+def build_spec(argv: list[str]) -> tuple[LoadSpec, argparse.Namespace]:
+    p = argparse.ArgumentParser(prog="tony loadtest", description=__doc__)
+    p.add_argument("--url", required=True,
+                   help="fleet router (or single replica) base URL")
+    p.add_argument("--conf_file", default=None)
+    p.add_argument("--conf", action="append", default=[], metavar="K=V")
+    p.add_argument("--rate", type=float, default=None,
+                   help="open-loop session arrivals per second "
+                        "(tony.serve.loadtest.rate)")
+    p.add_argument("--sessions", type=int, default=None,
+                   help="total sessions (tony.serve.loadtest.sessions)")
+    p.add_argument("--turns", type=int, default=None,
+                   help="requests per session, each extending the last "
+                        "(tony.serve.loadtest.turns)")
+    p.add_argument("--prompt-mix", default=None,
+                   help="first-turn prompt lengths, 'len:weight,...' "
+                        "(tony.serve.loadtest.prompt-mix)")
+    p.add_argument("--max-tokens", type=int, default=None,
+                   help="generated tokens per turn (tony.serve.loadtest.max-tokens)")
+    p.add_argument("--no-stream", action="store_true",
+                   help="buffered completions instead of SSE "
+                        "(tony.serve.loadtest.stream=false)")
+    p.add_argument("--shared-prefix", type=int, default=0,
+                   help="leading tokens shared by EVERY session "
+                        "(cross-session prefix-reuse probe)")
+    p.add_argument("--timeout-s", type=float, default=120.0,
+                   help="per-request client deadline")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="",
+                   help="write the full JSON report here")
+    p.add_argument("--bench-record", default="",
+                   help="write a SERVE_BENCH wrapper record here "
+                        "(gate it with tony bench --gate --pattern)")
+    p.add_argument("--round", type=int, default=1,
+                   help="round number for --bench-record")
+    p.add_argument("--baseline", type=float, default=None,
+                   help="baseline tokens/s for the record's vs_baseline "
+                        "(default: 1.0x — a fresh trajectory)")
+    args = p.parse_args(argv)
+
+    config = TonyConfig.from_layers(conf_file=args.conf_file, conf_args=args.conf)
+    stream = not args.no_stream and config.get_bool(keys.SERVE_LOADTEST_STREAM)
+    spec = LoadSpec(
+        url=args.url.rstrip("/"),
+        rate=args.rate if args.rate is not None
+        else config.get_float(keys.SERVE_LOADTEST_RATE, 4.0),
+        sessions=args.sessions if args.sessions is not None
+        else config.get_int(keys.SERVE_LOADTEST_SESSIONS, 16),
+        turns=args.turns if args.turns is not None
+        else config.get_int(keys.SERVE_LOADTEST_TURNS, 3),
+        prompt_mix=parse_prompt_mix(
+            args.prompt_mix if args.prompt_mix is not None
+            else config.get(keys.SERVE_LOADTEST_PROMPT_MIX) or "16:1"),
+        max_tokens=args.max_tokens if args.max_tokens is not None
+        else config.get_int(keys.SERVE_LOADTEST_MAX_TOKENS, 16),
+        stream=stream,
+        shared_prefix=args.shared_prefix,
+        timeout_s=args.timeout_s,
+        seed=args.seed,
+    )
+    if spec.sessions < 1 or spec.turns < 1:
+        raise SystemExit("tony loadtest: --sessions and --turns must be >= 1")
+    return spec, args
+
+
+def main(argv: list[str] | None = None) -> int:
+    try:
+        spec, args = build_spec(list(sys.argv[1:] if argv is None else argv))
+    except ValueError as e:
+        print(f"tony loadtest: {e}", file=sys.stderr)
+        return 2
+    print(f"[tony-loadtest] {spec.url}: {spec.sessions} session(s) x "
+          f"{spec.turns} turn(s) at {spec.rate}/s "
+          f"({'SSE' if spec.stream else 'buffered'})", flush=True)
+    report = LoadGenerator(spec).run()
+    d = report.to_dict()
+    print(json.dumps(d, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(d, f, indent=2)
+    if args.bench_record:
+        rec = report.to_bench_record(args.round, args.baseline)
+        with open(args.bench_record, "w") as f:
+            json.dump(rec, f, indent=2)
+        print(f"[tony-loadtest] bench record → {args.bench_record} "
+              f"(gate: tony bench --gate --pattern 'SERVE_BENCH_*.json')")
+    if d["requests_failed"]:
+        print(f"[tony-loadtest] {d['requests_failed']} request(s) FAILED",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -124,6 +124,12 @@ def _cmd_sim(argv: list[str]) -> int:
     return sim_main(argv)
 
 
+def _cmd_loadtest(argv: list[str]) -> int:
+    from tony_tpu.cli.loadtest import main as loadtest_main
+
+    return loadtest_main(argv)
+
+
 def _cmd_mini(argv: list[str]) -> int:
     """Self-contained sandbox: submit a smoke gang against the local resource
     manager and print the verdict + history location.
@@ -323,13 +329,14 @@ _COMMANDS = {
     "goodput": _cmd_goodput,
     "sim": _cmd_sim,
     "tune": _cmd_tune,
+    "loadtest": _cmd_loadtest,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|history-server|bench|portal|notebook|serve|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput|sim|tune} [options]\n")
+        print("usage: tony {submit|pool|history|history-server|bench|portal|notebook|serve|loadtest|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput|sim|tune} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    query the persistent history tier (list|show|compare|ingest|gc)")
@@ -338,6 +345,7 @@ def main(argv: list[str] | None = None) -> int:
         print("  portal     serve the history web portal")
         print("  notebook   launch an interactive notebook container + local proxy")
         print("  serve      run a replicated inference fleet (router + health + autoscaler) as an AM-supervised job")
+        print("  loadtest   open-loop multi-session load harness against a serving endpoint (SERVE_BENCH records)")
         print("  mini       one-command local sandbox (smoke gang, optional --distributed)")
         print("  data-prep  tokenize text files into TONYTOK training shards")
         print("  lint       run the AST static-analysis suite (config/jit/lock/mesh discipline)")

@@ -161,7 +161,13 @@ def _push_router_metrics_loop(rpc: RpcClient, stop: threading.Event,
 
 def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0,
                  no_router: bool = False) -> int:
-    from tony_tpu.serve import AutoscalePolicy, Autoscaler, FleetRouter, HealthMonitor
+    from tony_tpu.serve import (
+        AutoscalePolicy,
+        Autoscaler,
+        FleetRouter,
+        HealthMonitor,
+        SessionTable,
+    )
 
     replicas = config.instances(constants.SERVE_JOB_NAME)
     client = Client(config)
@@ -225,6 +231,11 @@ def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0,
         failover_deadline_s=config.get_time_ms(keys.SERVE_FAILOVER_DEADLINE_MS, 120_000) / 1000,
         hedge_percentile=config.get_float(keys.SERVE_HEDGE_PERCENTILE, 0.0),
         hedge_min_s=config.get_time_ms(keys.SERVE_HEDGE_MIN_MS, 50) / 1000,
+        sessions=SessionTable(
+            ttl_s=config.get_time_ms(keys.SERVE_SESSION_TTL_MS, 600_000) / 1000,
+            max_sessions=config.get_int(keys.SERVE_SESSION_MAX_SESSIONS, 10_000),
+            prefix_span=config.get_int(keys.SERVE_SESSION_PREFIX_SPAN, 256),
+        ),
     ).start()
     autoscaler = None
     max_replicas = config.get_int(keys.SERVE_MAX_REPLICAS, 0)
@@ -244,6 +255,12 @@ def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0,
             policy,
             job_name=constants.SERVE_JOB_NAME,
             interval_s=config.get_time_ms(keys.SERVE_AUTOSCALE_INTERVAL_MS, 5000) / 1000,
+            # drain-aware scale-down: the victim stops admitting and finishes
+            # in-flight streams (DrainCourier contract) before the resize
+            drain=lambda job, i: fleet_rpc.call(
+                "request_task_drain", job_name=job, index=i),
+            drain_timeout_s=config.get_time_ms(
+                keys.SERVE_SCALE_DOWN_DRAIN_MS, 10_000) / 1000,
         ).start()
     stop_push = threading.Event()
     threading.Thread(

@@ -321,6 +321,12 @@ class ApplicationMaster:
         # _epoch_lock: heartbeat/report handler threads race the monitor loop.
         self._drain: dict[str, Any] | None = None
         self._drain_handled: set[str] = set()  # req_ids already acted on
+        # per-task drain episodes (request_task_drain): the serving
+        # autoscaler's pre-scale-down lever — one task is asked to drain over
+        # the same heartbeat/DrainCourier contract the gang-wide preemption
+        # fan-out uses; {task_id: {"req_id", "step"}} with step None until
+        # the task's done-file ack lands via report_drain_saved
+        self._task_drains: dict[str, dict[str, Any]] = {}
         # goodput accounting plane (tony.goodput.*): the monitor loop's
         # throttled tick classifies wall-time, watches for stragglers, and
         # evaluates the declarative tony.alerts.* rules
@@ -504,6 +510,12 @@ class ApplicationMaster:
                 # urgent-checkpoint fan-out: re-sent until the task's saved
                 # step is reported (the courier dedups by req_id)
                 resp["drain"] = {"req_id": drain["req_id"]}
+            if "drain" not in resp:
+                # per-task drain (autoscaler pre-scale-down): same courier
+                # contract, one task only — a gang-wide episode outranks it
+                td = self._task_drains.get(tid)
+                if td is not None and td["step"] is None:
+                    resp["drain"] = {"req_id": td["req_id"]}
         return resp
 
     def report_drain_saved(
@@ -517,13 +529,50 @@ class ApplicationMaster:
         with self._epoch_lock:
             drain = self._drain
             tid = f"{job_name}:{index}"
-            if drain is None or drain["req_id"] != req_id or tid not in drain["targets"]:
-                return {"ack": False}
-            drain["acks"][tid] = int(step)
+            if (drain is not None and drain["req_id"] == req_id
+                    and tid in drain["targets"]):
+                drain["acks"][tid] = int(step)
+            else:
+                td = self._task_drains.get(tid)
+                if td is None or td["req_id"] != req_id:
+                    return {"ack": False}
+                td["step"] = int(step)  # per-task drain (scale-down) acked
         obs_logging.info(
-            f"[tony-am] {job_name}:{index} urgent-checkpointed step {step} "
-            f"for preemption {req_id}")
+            f"[tony-am] {job_name}:{index} drained at step {step} "
+            f"for request {req_id}")
         return {"ack": True}
+
+    def request_task_drain(self, job_name: str, index: int) -> dict[str, Any]:
+        """Ask ONE task to drain (stop admitting, finish in-flight work, ack
+        through the DrainCourier done-file) — the serving autoscaler calls
+        this before ``resize_jobtype`` removes a replica, so scale-down
+        stops being an abrupt kill. Idempotent: repeated calls poll the same
+        episode; callers resize once ``drained`` flips true (or their own
+        deadline passes). The episode is cleared by the resize's gang
+        rebuild like every other drain state."""
+        tid = f"{job_name}:{index}"
+        try:
+            with self.session.lock:
+                self.session.get_task(job_name, index)
+        except KeyError:
+            return {"ack": False, "error": f"unknown task {tid}"}
+        with self._epoch_lock:
+            td = self._task_drains.get(tid)
+            if td is None:
+                td = {
+                    "req_id": f"taskdrain-{self._restart_attempt}-{tid}",
+                    "step": None,
+                }
+                self._task_drains[tid] = td
+                obs_logging.info(
+                    f"[tony-am] task drain requested for {tid} "
+                    f"({td['req_id']}) — fanning out on its heartbeat")
+            return {
+                "ack": True,
+                "req_id": td["req_id"],
+                "drained": td["step"] is not None,
+                "step": td["step"],
+            }
 
     def get_task_infos(self) -> list[dict[str, Any]]:
         return self.session.task_infos()
@@ -1525,6 +1574,10 @@ class ApplicationMaster:
         emit PREEMPTION_REQUESTED and start the urgent-checkpoint fan-out
         over the heartbeat responses."""
         notice = self.rm.poll_preemption()
+        if not notice and self.chaos is not None:
+            # chaos preempt-drain: a synthesized cooperative notice drives
+            # the identical fan-out/yield path on pools that never preempt
+            notice = self.chaos.poll_preempt_notice()
         if not notice:
             return
         cancelled = notice.get("cancelled")
@@ -1770,6 +1823,7 @@ class ApplicationMaster:
             # over: its acks reference tasks that no longer exist, and a
             # stale episode must not yield the NEW gang later
             self._drain = None
+            self._task_drains.clear()  # per-task (scale-down) episodes too
             old_cfg = self._effective_config()
             old = {t: old_cfg.instances(t) for t in (resize or {})}
             if resize:

@@ -326,5 +326,6 @@ APPLICATION_RPC_METHODS = [
     "get_profile_status",    # per-task capture status for the in-flight request
     "report_profile_status", # executors report delivery/capture back to the AM
     "report_drain_saved",    # executors report the child's urgent pre-preemption checkpoint
+    "request_task_drain",    # drain ONE task (autoscaler pre-scale-down lever); idempotent poll
     "get_goodput",           # live goodput ledger + straggler skew + active alerts
 ]

@@ -241,6 +241,27 @@ SERVE_HEDGE_MIN_MS = "tony.serve.hedge-min-ms"
 # Active health checks against each replica's /stats endpoint.
 SERVE_HEALTH_INTERVAL_MS = "tony.serve.health-interval-ms"
 SERVE_HEALTH_FAIL_THRESHOLD = "tony.serve.health-fail-threshold"
+# Session affinity (X-Tony-Session → replica pins, serve/sessions.py):
+# idle pins expire after ttl-ms; the table is LRU-capped at max-sessions;
+# prefix-span is how many leading prompt tokens the cross-session prefix
+# hint fingerprints (match the engine's page_len so a hint implies at least
+# one warm cache page; 0 disables hints).
+SERVE_SESSION_TTL_MS = "tony.serve.session.ttl-ms"
+SERVE_SESSION_MAX_SESSIONS = "tony.serve.session.max-sessions"
+SERVE_SESSION_PREFIX_SPAN = "tony.serve.session.prefix-span"
+# Drain-aware scale-down: before resize_jobtype removes the victim replica,
+# the autoscaler asks it to drain (request_task_drain → DrainCourier) and
+# waits up to this long for the ack before shrinking anyway.
+SERVE_SCALE_DOWN_DRAIN_MS = "tony.serve.scale-down-drain-ms"
+# ``tony loadtest`` defaults (serve/loadgen.py): open-loop session arrival
+# rate (sessions/s), session count, turns per session, prompt-length mix
+# ("len:weight,len:weight"), and generated tokens per turn.
+SERVE_LOADTEST_RATE = "tony.serve.loadtest.rate"
+SERVE_LOADTEST_SESSIONS = "tony.serve.loadtest.sessions"
+SERVE_LOADTEST_TURNS = "tony.serve.loadtest.turns"
+SERVE_LOADTEST_PROMPT_MIX = "tony.serve.loadtest.prompt-mix"
+SERVE_LOADTEST_MAX_TOKENS = "tony.serve.loadtest.max-tokens"
+SERVE_LOADTEST_STREAM = "tony.serve.loadtest.stream"
 
 # ---------------------------------------------------------------------------
 # tony.profile.* — ON-DEMAND profiler capture (docs/observability.md)
@@ -452,6 +473,16 @@ DEFAULTS: dict[str, str] = {
     SERVE_HEDGE_MIN_MS: "50",
     SERVE_HEALTH_INTERVAL_MS: "1000",
     SERVE_HEALTH_FAIL_THRESHOLD: "3",
+    SERVE_SESSION_TTL_MS: "600000",
+    SERVE_SESSION_MAX_SESSIONS: "10000",
+    SERVE_SESSION_PREFIX_SPAN: "256",
+    SERVE_SCALE_DOWN_DRAIN_MS: "10000",
+    SERVE_LOADTEST_RATE: "4",
+    SERVE_LOADTEST_SESSIONS: "16",
+    SERVE_LOADTEST_TURNS: "3",
+    SERVE_LOADTEST_PROMPT_MIX: "16:0.5,64:0.3,256:0.2",
+    SERVE_LOADTEST_MAX_TOKENS: "16",
+    SERVE_LOADTEST_STREAM: "true",
 
     PROFILE_STEPS: "5",
     PROFILE_MEMORY: "false",

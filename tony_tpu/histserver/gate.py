@@ -26,12 +26,18 @@ import os
 from dataclasses import dataclass, field
 from typing import Any
 
-#: gated metrics → direction (+1 higher-is-better, -1 lower-is-better)
+#: gated metrics → direction (+1 higher-is-better, -1 lower-is-better).
+#: Families share this table: a record only gates the metrics it carries
+#: (the train bench has step_time_ms, the SERVE_BENCH family has the TTFT
+#: tails) and trajectories never cross metric names, so adding a family
+#: means adding its headline directions here, nothing else.
 GATE_METRICS: dict[str, int] = {
-    "value": +1,            # the headline metric (MFU for the train bench)
+    "value": +1,            # the headline metric (MFU / serve tokens/s)
     "vs_baseline": +1,
     "tokens_per_sec": +1,
     "step_time_ms": -1,
+    "ttft_p99_ms": -1,      # SERVE_BENCH: tail time-to-first-token
+    "ttft_p95_ms": -1,
 }
 
 #: default allowed drop, percent of the trajectory's best

@@ -19,7 +19,16 @@ long-running, AM-supervised task that:
   engine throughput into ENV_TRAIN_METRICS_FILE so the executor's existing
   metrics loop feeds the portal;
 - drains on SIGTERM: stops admitting, finishes the in-flight decode chunk,
-  answers in-flight streams, exits 0.
+  answers in-flight streams, exits 0;
+- drains on a **cooperative-preemption notice** the same way: a watcher
+  thread polls ``<TONY_TRAIN_METRICS_FILE>.drain`` — the control file the
+  executor's DrainCourier drops when the pool asks this gang to drain —
+  exactly like the training loop's UrgentSaveSignal. On a notice the server
+  flips ``draining`` (the fleet HealthMonitor sheds it from routing and the
+  SessionTable re-pins its sessions), finishes in-flight streams, publishes
+  ``.drain.done`` (the courier reports ``report_drain_saved``), and exits
+  clean inside the pool's deadline — serving survives preemption as
+  gracefully as training does.
 
 Threading model: HTTP handler threads only ever touch thread-safe queues;
 ONE engine thread owns the batcher (submit → step → drain_stream), so the
@@ -65,6 +74,9 @@ _DELIVERED = obs_metrics.counter(
 _REQUESTS_DONE = obs_metrics.counter(
     "tony_serve_requests_total", "finished engine requests by outcome",
     labelnames=("outcome",))
+_PREFIX_HITS = obs_metrics.counter(
+    "tony_serve_prefix_hit_tokens_total",
+    "prompt tokens whose prefill was skipped via paged prefix-cache hits")
 
 
 class RequestStream:
@@ -133,6 +145,7 @@ class EngineServer:
         self.tokens_delivered = 0   # actually written to a client socket
         self.requests_done = 0
         self.requests_cancelled = 0
+        self._prefix_hits_exported = 0  # engine-thread watermark → registry delta
         # delivered is the ONE counter with multiple writers (every HTTP
         # handler thread); unsynchronized += would lose updates
         self._delivered_lock = threading.Lock()
@@ -317,6 +330,14 @@ class EngineServer:
             self._sweep_cancellations()
             _QUEUE_DEPTH.set(self._queue_depth())
             had_work = eng.step()
+            # export the engine's prefix-reuse win as a REAL instrument, not
+            # a /stats-payload-only field: the loadtest harness and the
+            # portal read the registry, and "reuse happened" must be
+            # observable wherever tony_serve_* metrics flow
+            hits = getattr(eng, "prefix_hit_tokens", 0)
+            if hits > self._prefix_hits_exported:
+                _PREFIX_HITS.inc(hits - self._prefix_hits_exported)
+                self._prefix_hits_exported = hits
             now_s = time.time()
             for rid, (toks, done) in eng.drain_stream().items():
                 out = self._streams.get(rid)
@@ -571,6 +592,74 @@ def _metrics_pump(srv: EngineServer, stop: threading.Event, interval_s: float = 
                 pass
 
 
+def _drain_watch(srv: EngineServer, stop: threading.Event,
+                 budget_s: float = 10.0) -> None:
+    """Replica half of the cooperative-preemption drain contract
+    (docs/scheduling.md): poll ``<TONY_TRAIN_METRICS_FILE>.drain`` — the
+    control file the executor's DrainCourier drops when the AM's heartbeat
+    fan-out reaches this task — at the same cadence UrgentSaveSignal uses.
+
+    On a notice: stop admitting (``/stats`` flips ``draining`` so the fleet
+    HealthMonitor sheds this replica and the SessionTable re-pins its
+    sessions), finish in-flight streams (``EngineServer.stop``), then ack
+    via :func:`_ack_drain` so the courier reports ``report_drain_saved``
+    and the AM can yield without burning its margin. Like the training
+    loop after UrgentSaveSignal, the process then PARKS — yielding is the
+    AM's move; its SIGTERM finds an already-drained server and the exit is
+    immediate and clean, well inside the deadline."""
+    from tony_tpu.obs import introspect
+
+    path = os.environ.get(constants.ENV_TRAIN_METRICS_FILE)
+    if not path:
+        return
+    try:
+        poll_ms = int(os.environ.get(constants.ENV_PROFILE_POLL_MS, "500") or 500)
+    except ValueError:
+        poll_ms = 500
+    interval_s = max(poll_ms, 50) / 1000.0
+    acked: set[str] = set()
+    while not stop.wait(interval_s):
+        ctl = introspect.read_json(path + introspect.DRAIN_CONTROL_SUFFIX)
+        req_id = str((ctl or {}).get("req_id") or "")
+        if not req_id or req_id in acked:
+            continue
+        if not acked:
+            obs_logging.warning(
+                f"[tony-serve] drain notice {req_id} (cooperative preemption) "
+                "— refusing new admissions, finishing in-flight streams")
+            if not srv.stop(timeout_s=budget_s):
+                obs_logging.warning(
+                    f"[tony-serve] drain {req_id} timed out with "
+                    f"{len(srv._streams)} request(s) in flight — truncating")
+        # later requests against an already-drained server (a gang-wide
+        # preemption following a scale-down drain) ack instantly — stop()
+        # is idempotent and the AM must not burn its margin waiting
+        _ack_drain(req_id, step=srv.requests_done)
+        acked.add(req_id)
+        obs_logging.info(
+            f"[tony-serve] drain {req_id} acknowledged "
+            f"({srv.requests_done} request(s) completed) — parked, "
+            "awaiting the AM's yield")
+
+
+def _ack_drain(req_id: str, step: int) -> None:
+    """Publish the drain done-file (atomic) the courier reports back. For a
+    serving replica the 'saved step' is the completed-request count — there
+    is no checkpoint to land, the state that matters (in-flight streams) is
+    already drained by the time this is called."""
+    from tony_tpu.obs import introspect
+
+    path = os.environ.get(constants.ENV_TRAIN_METRICS_FILE)
+    if not path:
+        return
+    try:
+        introspect.write_json_atomic(
+            path + introspect.DRAIN_DONE_SUFFIX,
+            {"req_id": req_id, "step": int(step)})
+    except OSError:
+        pass  # best-effort: the AM's yield margin covers a lost ack
+
+
 def _resolve_kv(args) -> str:
     """Resolve ``--kv`` when unset. Defaults to paged (shared-prefix wins,
     3x slot capacity at equal HBM, decode at parity — BASELINE.md r5) but
@@ -721,6 +810,15 @@ def main(argv: list[str] | None = None) -> int:
 
     signal.signal(signal.SIGTERM, _drain)
     signal.signal(signal.SIGINT, _drain)
+    # drain budget for SIGTERM and preemption notices alike: the container's
+    # SIGTERM→SIGKILL window (tony.task.kill-grace-ms) minus teardown margin
+    grace_ms = float(os.environ.get(constants.ENV_KILL_GRACE_MS, "0") or 0)
+    budget_s = max(grace_ms / 1000 - 1.0, 2.0) if grace_ms else 10.0
+    # cooperative-preemption watcher: DrainCourier notice → drain, ack, park
+    stop_drain_watch = threading.Event()
+    threading.Thread(
+        target=_drain_watch, args=(srv, stop_drain_watch, budget_s), daemon=True
+    ).start()
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     obs_logging.info(f"[tony-serve] {url} preset={args.preset} slots={args.slots} "
                      f"max_len={args.max_len}")
@@ -729,15 +827,12 @@ def main(argv: list[str] | None = None) -> int:
         obs_logging.error(f"[tony-serve] engine failed: {srv.error}")
         httpd.shutdown()
         return 1
-    # graceful drain: refuse new work, finish in-flight, then exit 0. The
-    # budget is the container's SIGTERM→SIGKILL window
-    # (tony.task.kill-grace-ms) minus a margin for teardown itself.
-    grace_ms = float(os.environ.get(constants.ENV_KILL_GRACE_MS, "0") or 0)
-    budget_s = max(grace_ms / 1000 - 1.0, 2.0) if grace_ms else 10.0
+    # graceful drain: refuse new work, finish in-flight, then exit 0.
     obs_logging.info(f"[tony-serve] draining (budget {budget_s:.0f}s)")
     if not srv.stop(timeout_s=budget_s):
         obs_logging.warning(f"[tony-serve] drain timed out with {len(srv._streams)} "
                             f"request(s) in flight — truncating")
+    stop_drain_watch.set()
     stop_metrics.set()
     httpd.shutdown()
     return 0

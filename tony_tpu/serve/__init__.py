@@ -2,22 +2,32 @@
 
 ``tony serve --replicas N`` turns the single AM-supervised inference task
 into a fault-tolerant fleet: N ``serve`` replicas under the ordinary gang
-machinery, fronted by three submitter-side pieces —
+machinery, fronted by submitter-side pieces —
 
 - :class:`~tony_tpu.serve.router.FleetRouter`: HTTP front door with
-  least-outstanding balancing, health-checked failover/retry, and optional
-  tail hedging;
+  session-affinity + least-outstanding balancing, health-checked
+  failover/retry, and optional tail hedging;
+- :class:`~tony_tpu.serve.sessions.SessionTable`: ``X-Tony-Session`` →
+  replica pins (TTL + LRU, prompt-prefix hints) so the engine's paged
+  prefix cache hits across multi-turn conversations and survives failover
+  by re-pinning exactly once;
 - :class:`~tony_tpu.serve.health.HealthMonitor`: AM-registry endpoint
   discovery (re-resolves across gang restarts) + active/passive per-replica
   health (healthy → draining → down);
 - :class:`~tony_tpu.serve.autoscaler.Autoscaler`: queue-depth /
   slot-utilization driven replica retargeting through the AM's
-  ``resize_jobtype`` elastic-rebuild path.
+  ``resize_jobtype`` elastic-rebuild path, draining the victim replica
+  (DrainCourier contract) before a scale-down removes it;
+- :class:`~tony_tpu.serve.loadgen.LoadGenerator`: open-loop multi-session
+  load harness behind ``tony loadtest`` — sustained tokens/s, TTFT/token
+  latency percentiles, reuse-loss accounting, and the gated
+  ``SERVE_BENCH_*`` record family.
 """
 
 from tony_tpu.serve.autoscaler import AutoscalePolicy, Autoscaler
 from tony_tpu.serve.health import FleetSignals, HealthMonitor, Replica, ReplicaState
 from tony_tpu.serve.router import FleetRouter
+from tony_tpu.serve.sessions import SessionPin, SessionTable
 
 __all__ = [
     "AutoscalePolicy",
@@ -27,4 +37,6 @@ __all__ = [
     "HealthMonitor",
     "Replica",
     "ReplicaState",
+    "SessionPin",
+    "SessionTable",
 ]

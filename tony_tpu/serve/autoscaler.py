@@ -19,24 +19,42 @@ history, and the trace all stay with the one application. The current
 replica count is re-read from the health monitor's fleet view each tick, so
 an AM-side resize from another cause (capacity loss) reconverges instead of
 fighting the autoscaler's stale notion of "current".
+
+Scale-down is **drain-aware** when a ``drain`` lever is wired (the AM's
+``request_task_drain`` RPC): before ``resize_jobtype`` removes the victim —
+the highest-index replica, the one a shrink retires — the autoscaler asks it
+to drain over the same heartbeat/DrainCourier contract pool preemption uses.
+The replica stops admitting (the HealthMonitor flips it DRAINING, routing
+sheds it, the SessionTable re-pins its sessions), finishes in-flight
+streams, and acks; only then (or at ``scale-down-drain-ms``) does the resize
+fire. An in-flight victim drain always carries through to its resize — the
+drain is irreversible at the replica (stop-admit is terminal) and the AM
+re-sends an un-acked notice every heartbeat, so abandoning it would strand
+one permanently-DRAINING replica; pressure returning mid-drain simply scales
+back up through the ordinary path after the (bounded) shrink completes.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable
 
 from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
 from tony_tpu.obs import trace as obs_trace
-from tony_tpu.serve.health import FleetSignals, HealthMonitor
+from tony_tpu.serve.health import FleetSignals, HealthMonitor, ReplicaState
 
 _DECISIONS = obs_metrics.counter(
     "tony_serve_autoscale_decisions_total",
     "autoscaler resize decisions by direction", labelnames=("direction",))
 _TARGET = obs_metrics.gauge(
     "tony_serve_target_replicas", "autoscaler's current replica target")
+_DOWN_DRAINS = obs_metrics.counter(
+    "tony_serve_scale_down_drains_total",
+    "scale-down victim drains by how they resolved "
+    "(drained / timeout / superseded)", labelnames=("outcome",))
 
 
 @dataclass
@@ -67,15 +85,24 @@ class Autoscaler:
         policy: AutoscalePolicy,
         job_name: str = "serve",
         interval_s: float = 5.0,
+        drain: Callable[[str, int], Any] | None = None,
+        drain_timeout_s: float = 10.0,
     ):
         self.health = health
         self._resize = resize
+        #: drain(job_name, index) → {"drained": bool, ...} — the AM's
+        #: request_task_drain lever (idempotent poll). None → legacy abrupt
+        #: scale-down (resize without draining the victim first).
+        self._drain = drain
+        self.drain_timeout_s = drain_timeout_s
         self.policy = policy
         self.job_name = job_name
         self.interval_s = interval_s
         self._up_ticks = 0
         self._down_ticks = 0
         self.target: int | None = None  # last requested target (None: no request yet)
+        #: in-flight drain-then-shrink: {"victim", "target", "deadline"}
+        self.pending_down: dict[str, Any] | None = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="serve-autoscaler", daemon=True)
@@ -128,6 +155,17 @@ class Autoscaler:
             return  # nothing resolved yet
         target = self.decide(current, sig)
         _TARGET.set(target)
+        if self.pending_down is not None:
+            # carry the shrink through even if pressure returned: the drain
+            # request is already in flight (the AM re-sends an un-acked
+            # notice every heartbeat, and a drained replica cannot un-drain
+            # — EngineServer.stop is terminal), so "cancelling" here would
+            # strand one permanently-DRAINING replica that still counts as
+            # capacity. The window is bounded by drain_timeout_s; returning
+            # pressure scales back up through the ordinary path right after
+            # the rebuild.
+            self._drive_pending_down(current)
+            return
         if target == current:
             return
         direction = "up" if target > current else "down"
@@ -137,6 +175,68 @@ class Autoscaler:
             current=current, target=target,
             queue_depth=sig.queue_depth, utilization=round(sig.utilization, 3),
         )
+        if direction == "down" and self._drain is not None:
+            # drain-aware shrink: the resize retires the HIGHEST index —
+            # ask exactly that replica to drain first, then shrink
+            victim = current - 1
+            self.pending_down = {
+                "victim": victim, "target": target,
+                "deadline": time.monotonic() + self.drain_timeout_s,
+            }
+            obs_trace.add_event(
+                "autoscale.drain_victim", victim=victim, target=target)
+            obs_logging.info(
+                f"[tony-serve] scale-down to {target}: draining "
+                f"{self.job_name}:{victim} before removal")
+            self._drive_pending_down(current)
+            return
+        self._do_resize(target)
+
+    def _drive_pending_down(self, current: int) -> None:
+        """One poll of an in-flight drain-then-shrink: re-issue the
+        (idempotent) drain request, and resize once the victim acked — or
+        when it reads DRAINING in the fleet view (belt for replicas that
+        stop admitting but keep streams open past this poll), or at the
+        drain deadline (a wedged victim must not pin capacity forever)."""
+        pd = self.pending_down
+        assert pd is not None
+        if current <= pd["target"]:
+            # another actor (capacity loss, tony resize) already shrank past
+            # our target: nothing left to do
+            _DOWN_DRAINS.inc(outcome="superseded")
+            self.pending_down = None
+            return
+        drained = False
+        try:
+            resp = self._drain(self.job_name, pd["victim"])
+            drained = bool(resp and resp.get("drained"))
+        except Exception as e:  # noqa: BLE001 — transport churn: retry next tick
+            obs_logging.warning(
+                f"[tony-serve] drain poll for {self.job_name}:{pd['victim']} "
+                f"failed ({e}); retrying")
+        if not drained:
+            for r in self.health.snapshot():
+                if r.index == pd["victim"] and r.state in (
+                    ReplicaState.DRAINING, ReplicaState.DOWN
+                ):
+                    # stopped admitting (or already exited post-drain):
+                    # routing has shed it, sessions re-pinned
+                    drained = True
+                    break
+        timed_out = time.monotonic() >= pd["deadline"]
+        if not drained and not timed_out:
+            return  # keep waiting; poll again next tick
+        if drained:
+            _DOWN_DRAINS.inc(outcome="drained")
+        else:
+            _DOWN_DRAINS.inc(outcome="timeout")
+            obs_logging.warning(
+                f"[tony-serve] drain of {self.job_name}:{pd['victim']} timed "
+                f"out after {self.drain_timeout_s:.0f}s — resizing anyway")
+        self.pending_down = None
+        self._do_resize(pd["target"])
+
+    def _do_resize(self, target: int) -> None:
         try:
             self._resize(self.job_name, target)
         except Exception as e:  # noqa: BLE001 — typed rejection vs transport churn

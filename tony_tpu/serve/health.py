@@ -239,6 +239,17 @@ class HealthMonitor:
         else:
             self._count_failure(replica)
 
+    def report_draining(self, replica: Replica) -> None:
+        """Router-observed drain refusal (503 "server is draining"): the
+        replica is mid-lifecycle, not failing — shed it from routing NOW
+        instead of waiting for the next active probe to flip it. The probe
+        keeps owning recovery (a drained-then-restarted replica flips back
+        HEALTHY the usual way)."""
+        with self.lock:
+            if replica.attempt == self.restart_attempt:
+                replica.state = ReplicaState.DRAINING
+                replica.failures = 0
+
     def report_success(self, replica: Replica) -> None:
         with self.lock:
             replica.failures = 0

@@ -1,0 +1,370 @@
+"""Open-loop multi-session load generator: the measurement half of the
+serving data plane (``tony loadtest``).
+
+The fleet can now pin sessions, drain replicas, and survive preemption —
+none of which counts for anything until a harness measures sustained
+tokens/s and tail TTFT under concurrent load and a gate holds the line.
+This module is that harness:
+
+- **open loop**: sessions arrive on a fixed-rate schedule (``rate``/s)
+  regardless of how fast earlier ones complete — a slow fleet builds queue
+  depth and its p99 shows it, instead of the closed-loop trap where a slow
+  server throttles its own load generator into flattering numbers;
+- **multi-session, multi-turn**: every session carries ``X-Tony-Session``
+  and each turn's prompt extends the previous turn (prompt + generated
+  tokens + fresh user tokens), exactly the shape the SessionTable + paged
+  prefix cache are built for — pinned turns hit warm pages, and a mid-run
+  failover shows up as re-pins (lost reuse), not errors;
+- **prompt-length mix**: first-turn lengths draw from a weighted mix
+  (``"16:0.5,64:0.3,256:0.2"``) so the fleet sees realistic prefill
+  variance; an optional shared leading span exercises cross-session prefix
+  reuse;
+- **reported**: sustained tokens/s, TTFT and per-token-latency percentiles,
+  error/re-pin/prefix-hit counts, and a ``SERVE_BENCH_*.json`` record
+  (``tokens_per_sec`` ↑, ``ttft_p99_ms`` ↓) that ``tony bench --gate``
+  enforces — the serving analogue of the MFU trajectory.
+
+Everything is stdlib (threads + http.client): the harness must run anywhere
+the router runs, including inside tier-1 CI against a CPU fleet.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any
+from urllib.parse import urlsplit
+
+#: the SERVE_BENCH family's headline metric name (gate trajectories compare
+#: within one metric name only — this never collides with the train bench)
+SERVE_BENCH_METRIC = "serve_tokens_per_sec"
+
+
+def parse_prompt_mix(spec: str) -> list[tuple[int, float]]:
+    """``"16:0.5,64:0.3,256:0.2"`` → [(16, .5), (64, .3), (256, .2)].
+    Weights need not sum to 1 (they are normalized at draw time)."""
+    out: list[tuple[int, float]] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        length, _, weight = part.partition(":")
+        n = int(length)
+        w = float(weight) if weight else 1.0
+        if n <= 0 or w < 0:
+            raise ValueError(f"bad prompt-mix entry {part!r} (want len:weight, len>0, weight>=0)")
+        out.append((n, w))
+    if not out or not any(w > 0 for _, w in out):
+        raise ValueError(f"empty/zero-weight prompt mix {spec!r}")
+    return out
+
+
+def percentile(xs: list[float], p: float) -> float:
+    """Nearest-rank percentile (0 for an empty list — absent metrics are
+    dropped from the record before they reach the gate)."""
+    if not xs:
+        return 0.0
+    ys = sorted(xs)
+    i = min(int(len(ys) * p / 100.0), len(ys) - 1)
+    return ys[i]
+
+
+@dataclass
+class LoadSpec:
+    """One loadtest run's parameters (CLI flags / tony.serve.loadtest.*)."""
+
+    url: str
+    rate: float = 4.0          # session arrivals per second (open loop)
+    sessions: int = 16
+    turns: int = 3
+    prompt_mix: list[tuple[int, float]] = field(
+        default_factory=lambda: [(16, 0.5), (64, 0.3), (256, 0.2)])
+    max_tokens: int = 16
+    stream: bool = True
+    shared_prefix: int = 0     # leading tokens shared by EVERY session
+    turn_tokens: int = 8       # fresh "user" tokens appended per follow-up turn
+    vocab: int = 1000          # token id range for synthetic prompts
+    timeout_s: float = 120.0   # per-request client deadline
+    seed: int = 0
+
+
+@dataclass
+class Turn:
+    """One request's measured outcome."""
+
+    session: int
+    turn: int
+    ok: bool
+    status: int = 0
+    error: str = ""
+    replica: str = ""
+    tokens: int = 0
+    ttft_ms: float = 0.0       # first generated-token fanout (stream) / full reply
+    latency_ms: float = 0.0
+    pinned: bool = False       # same replica as the session's previous turn
+
+
+@dataclass
+class LoadReport:
+    """Aggregated run outcome + the SERVE_BENCH record emitter."""
+
+    spec: LoadSpec
+    turns: list[Turn]
+    wall_s: float
+    router_before: dict[str, Any] | None = None
+    router_after: dict[str, Any] | None = None
+
+    # ------------------------------------------------------------ derived
+    @property
+    def ok_turns(self) -> list[Turn]:
+        return [t for t in self.turns if t.ok]
+
+    @property
+    def errors(self) -> list[Turn]:
+        return [t for t in self.turns if not t.ok]
+
+    @property
+    def tokens_total(self) -> int:
+        return sum(t.tokens for t in self.ok_turns)
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.tokens_total / self.wall_s if self.wall_s > 0 else 0.0
+
+    def _router_delta(self, *path: str) -> float | None:
+        """after - before for one /stats field; None when unmeasurable —
+        absent on either side, or NEGATIVE (the fleet aggregate only sums
+        HEALTHY replicas and per-replica counters reset on restart, so a
+        run spanning a drain/failover can shrink the aggregate; a garbage
+        delta must not reach a checked-in SERVE_BENCH record)."""
+        a, b = self.router_before, self.router_after
+        for key in path:
+            a = a.get(key) if isinstance(a, dict) else None
+            b = b.get(key) if isinstance(b, dict) else None
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            delta = float(b) - float(a)
+            return delta if delta >= 0 else None
+        return None
+
+    def to_dict(self) -> dict[str, Any]:
+        ttfts = [t.ttft_ms for t in self.ok_turns if t.ttft_ms > 0]
+        lats = [t.latency_ms for t in self.ok_turns]
+        tok_lat = [
+            (t.latency_ms - t.ttft_ms) / (t.tokens - 1)
+            for t in self.ok_turns if t.tokens > 1 and t.ttft_ms > 0
+        ]
+        followups = [t for t in self.ok_turns if t.turn > 0]
+        out: dict[str, Any] = {
+            "sessions": self.spec.sessions,
+            "turns_per_session": self.spec.turns,
+            "stream": self.spec.stream,
+            "rate_per_s": self.spec.rate,
+            "wall_s": round(self.wall_s, 3),
+            "requests_ok": len(self.ok_turns),
+            "requests_failed": len(self.errors),
+            "tokens_total": self.tokens_total,
+            "tokens_per_sec": round(self.tokens_per_sec, 2),
+            "ttft_p50_ms": round(percentile(ttfts, 50), 2),
+            "ttft_p95_ms": round(percentile(ttfts, 95), 2),
+            "ttft_p99_ms": round(percentile(ttfts, 99), 2),
+            "latency_p50_ms": round(percentile(lats, 50), 2),
+            "latency_p99_ms": round(percentile(lats, 99), 2),
+            "token_latency_p50_ms": round(percentile(tok_lat, 50), 3),
+            "pinned_followup_turns": sum(1 for t in followups if t.pinned),
+            "followup_turns": len(followups),
+        }
+        repins = self._router_delta("router", "session_repins")
+        if repins is not None:
+            out["session_repins"] = int(repins)  # reuse LOST to failover
+        hits = self._router_delta("fleet", "prefix_hit_tokens")
+        if hits is not None:
+            out["prefix_hit_tokens"] = int(hits)
+        if self.errors:
+            out["first_errors"] = [
+                {"session": t.session, "turn": t.turn,
+                 "status": t.status, "error": t.error[:200]}
+                for t in self.errors[:5]
+            ]
+        return out
+
+    def to_bench_record(self, round_n: int, baseline_tokens_per_sec: float | None = None,
+                        rc: int = 0) -> dict[str, Any]:
+        """The ``SERVE_BENCH_r<N>.json`` wrapper ``tony bench --gate``
+        enforces: headline = sustained tokens/s (↑), with ``ttft_p99_ms``
+        gated downward alongside it."""
+        d = self.to_dict()
+        vs = (self.tokens_per_sec / baseline_tokens_per_sec
+              if baseline_tokens_per_sec else 1.0)
+        parsed = {
+            "metric": SERVE_BENCH_METRIC,
+            "value": round(self.tokens_per_sec, 2),
+            "unit": "tok/s",
+            "vs_baseline": round(vs, 4),
+            **{k: d[k] for k in (
+                "tokens_per_sec", "ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
+                "token_latency_p50_ms", "requests_ok", "requests_failed",
+                "sessions", "turns_per_session", "stream", "rate_per_s",
+                "wall_s",
+            )},
+        }
+        for opt in ("session_repins", "prefix_hit_tokens"):
+            if opt in d:
+                parsed[opt] = d[opt]
+        return {"n": int(round_n), "rc": int(rc), "parsed": parsed}
+
+
+class LoadGenerator:
+    """Threaded open-loop driver over one :class:`LoadSpec`."""
+
+    def __init__(self, spec: LoadSpec):
+        self.spec = spec
+        self._results: list[Turn] = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ plumbing
+    def _router_stats(self) -> dict[str, Any] | None:
+        try:
+            with urllib.request.urlopen(self.spec.url + "/stats", timeout=10) as resp:
+                return json.loads(resp.read())
+        except Exception:  # noqa: BLE001 — a bare replica has /stats too, but
+            return None    # reuse-loss accounting is best-effort either way
+
+    def _post(self, body: dict[str, Any], session_id: str) -> tuple[int, dict, Any]:
+        """One POST /v1/completions. Returns (status, headers, parsed-or-
+        stream-handle); streaming responses return the live HTTPResponse."""
+        parts = urlsplit(self.spec.url)
+        conn = http.client.HTTPConnection(
+            parts.hostname, parts.port, timeout=self.spec.timeout_s)
+        payload = json.dumps(body).encode()
+        conn.request("POST", "/v1/completions", payload, {
+            "Content-Type": "application/json",
+            "X-Tony-Session": session_id,
+        })
+        resp = conn.getresponse()
+        headers = {k: v for k, v in resp.getheaders()}
+        if (headers.get("Content-Type") or "").startswith("text/event-stream"):
+            return resp.status, headers, (conn, resp)
+        data = resp.read()
+        conn.close()
+        try:
+            return resp.status, headers, json.loads(data)
+        except ValueError:
+            return resp.status, headers, {"error": data[:200].decode("latin-1")}
+
+    # ------------------------------------------------------------- session
+    def _run_session(self, idx: int, start_at: float, t0: float,
+                     rng: random.Random) -> None:
+        # wait for this session's open-loop arrival slot
+        delay = start_at - (time.monotonic() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        spec = self.spec
+        session_id = f"lt-{spec.seed}-{idx}"
+        lengths = [n for n, _ in spec.prompt_mix]
+        weights = [w for _, w in spec.prompt_mix]
+        first_len = rng.choices(lengths, weights=weights, k=1)[0]
+        shared = list(range(1, spec.shared_prefix + 1))
+        prompt = shared + [
+            rng.randrange(1, spec.vocab)
+            for _ in range(max(first_len - len(shared), 1))
+        ]
+        last_replica = ""
+        for turn in range(spec.turns):
+            result = Turn(session=idx, turn=turn, ok=False)
+            req = {
+                "prompt_tokens": prompt,
+                "max_tokens": spec.max_tokens,
+                "stream": spec.stream,
+            }
+            t_start = time.monotonic()
+            try:
+                status, headers, payload = self._post(req, session_id)
+                result.status = status
+                result.replica = headers.get("X-Tony-Replica", "")
+                if spec.stream and isinstance(payload, tuple):
+                    conn, resp = payload
+                    try:
+                        toks = self._drain_sse(resp, result, t_start)
+                    finally:
+                        conn.close()
+                elif status == 200 and isinstance(payload, dict):
+                    toks = list(payload.get("tokens") or [])
+                    result.ttft_ms = (time.monotonic() - t_start) * 1000
+                else:
+                    toks = None
+                    result.error = str((payload or {}).get("error", f"HTTP {status}"))
+                if toks is not None:
+                    result.latency_ms = (time.monotonic() - t_start) * 1000
+                    result.tokens = len(toks)
+                    result.ok = True
+                    result.pinned = bool(last_replica) and result.replica == last_replica
+                    last_replica = result.replica or last_replica
+                    # multi-turn growth: next prompt = this conversation so
+                    # far + fresh user tokens — the prefix the pin keeps warm
+                    prompt = prompt + toks + [
+                        rng.randrange(1, spec.vocab) for _ in range(spec.turn_tokens)
+                    ]
+            except Exception as e:  # noqa: BLE001 — an error IS a data point
+                result.error = repr(e)
+                result.latency_ms = (time.monotonic() - t_start) * 1000
+            with self._lock:
+                self._results.append(result)
+
+    def _drain_sse(self, resp, result: Turn, t_start: float) -> list[int] | None:
+        """Consume one SSE stream; fills ttft on the first token event.
+        Returns the final token list, or None on an in-stream error."""
+        final: list[int] | None = None
+        first = True
+        buf = b""
+        while True:
+            chunk = resp.read1(8192)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                event, buf = buf.split(b"\n\n", 1)
+                line = event.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                obj = json.loads(line[6:])
+                if obj.get("error"):
+                    result.error = str(obj["error"])
+                    return None
+                if first and obj.get("tokens"):
+                    result.ttft_ms = (time.monotonic() - t_start) * 1000
+                    first = False
+                if obj.get("finished"):
+                    final = list(obj.get("tokens") or [])
+                    return final
+        if final is None:
+            result.error = "stream truncated (no finished event)"
+        return final
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> LoadReport:
+        spec = self.spec
+        before = self._router_stats()
+        rngs = [random.Random((spec.seed << 20) ^ i) for i in range(spec.sessions)]
+        t0 = time.monotonic()
+        threads = [
+            threading.Thread(
+                target=self._run_session,
+                args=(i, i / spec.rate if spec.rate > 0 else 0.0, t0, rngs[i]),
+                name=f"loadgen-{i}", daemon=True)
+            for i in range(spec.sessions)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        after = self._router_stats()
+        with self._lock:
+            results = sorted(self._results, key=lambda r: (r.session, r.turn))
+        return LoadReport(spec=spec, turns=results, wall_s=wall,
+                          router_before=before, router_after=after)

@@ -7,6 +7,15 @@ through AM-registered URLs), in front of N ``serve`` replicas:
 - **balancing**: least-outstanding-requests over HEALTHY replicas (ties →
   lowest index). UNKNOWN replicas (no probe verdict yet) are picked only
   when nothing HEALTHY exists — optimistic first-touch after a restart.
+- **session affinity** (:mod:`tony_tpu.serve.sessions`): requests carrying
+  ``X-Tony-Session`` stick to the replica that served the session's first
+  turn while it stays routable, so the engine's paged prefix cache actually
+  hits across a multi-turn conversation; new sessions whose prompt shares a
+  known leading page are steered to the replica already holding it. A
+  pinned replica going un-routable (crash, DRAINING, scale-down) re-pins
+  the session on its next turn — exactly once, counted by
+  ``tony_router_session_repins_total`` because a re-pin is one lost warm
+  prefill.
 - **failover**: a replica-level failure (connect refused/reset, response
   5xx) marks the replica through the :class:`HealthMonitor` and retries the
   request on another replica — engine requests are stateless, so
@@ -47,6 +56,8 @@ from urllib.parse import urlsplit
 from tony_tpu.obs import metrics as obs_metrics
 from tony_tpu.obs import trace as obs_trace
 from tony_tpu.serve.health import HealthMonitor, Replica, ReplicaState
+from tony_tpu.serve import sessions as sessions_mod
+from tony_tpu.serve.sessions import SessionTable
 
 _REQUESTS = obs_metrics.counter(
     "tony_router_requests_total", "routed requests by outcome", labelnames=("outcome",))
@@ -111,8 +122,12 @@ class FleetRouter:
         hedge_min_s: float = 0.05,
         connect_timeout_s: float = 5.0,
         replica_timeout_s: float = 300.0,
+        sessions: SessionTable | None = None,
     ):
         self.health = health
+        #: session-affinity table (None → a default-config table; pass an
+        #: explicitly-configured one from tony.serve.session.* keys)
+        self.sessions = sessions if sessions is not None else SessionTable()
         self.retries = max(int(retries), 0)
         self.failover_deadline_s = failover_deadline_s
         self.hedge_percentile = hedge_percentile
@@ -167,18 +182,22 @@ class FleetRouter:
             _reply_json(h, 200, self.stats())
         elif h.path == "/fleet":
             _reply_json(h, 200, self.health.fleet_info())
+        elif h.path == "/sessions":
+            _reply_json(h, 200, self.sessions.to_info())
         else:
             _reply_json(h, 404, {"error": "not found"})
 
     def stats(self) -> dict[str, Any]:
         """Aggregated fleet counters + router-level totals."""
+        self.sessions.sweep()  # opportunistic TTL pass (lookup expires lazily too)
         agg: dict[str, float] = {}
         per_replica = []
         for r in self.health.snapshot():
             per_replica.append(r.to_info())
             if r.state == ReplicaState.HEALTHY:
                 for k in ("slots_total", "slots_active", "queue_depth",
-                          "requests_done", "tokens_out", "tokens_delivered"):
+                          "requests_done", "tokens_out", "tokens_delivered",
+                          "prefix_hit_tokens"):
                     v = r.stats.get(k)
                     if isinstance(v, (int, float)):
                         agg[k] = agg.get(k, 0) + v
@@ -191,6 +210,8 @@ class FleetRouter:
                 "retries": _RETRIES.value(),
                 "hedges": _HEDGES.value(),
                 "hedge_wins": _HEDGE_WINS.value(),
+                "sessions": len(self.sessions),
+                "session_repins": sessions_mod.repins_total(),
             },
             "fleet": agg,
             "replicas": per_replica,
@@ -201,19 +222,28 @@ class FleetRouter:
         length = int(h.headers.get("Content-Length") or 0)
         body = h.rfile.read(length) if length else b""
         stream = False
+        prompt_tokens = None
         try:
-            stream = bool(json.loads(body or b"{}").get("stream", False))
-        except ValueError:
+            req = json.loads(body or b"{}")
+            stream = bool(req.get("stream", False))
+            pt = req.get("prompt_tokens")
+            if isinstance(pt, list):
+                prompt_tokens = pt
+        except (ValueError, AttributeError):
             pass  # the replica will answer 400; route it through anyway
-        with obs_trace.maybe_span("router.request", path=h.path, stream=stream):
-            self._route(h, h.path, body, stream)
+        session_id = (h.headers.get("X-Tony-Session") or "").strip() or None
+        with obs_trace.maybe_span("router.request", path=h.path, stream=stream,
+                                  session=session_id):
+            self._route(h, h.path, body, stream, session_id, prompt_tokens)
 
-    def _route(self, h: BaseHTTPRequestHandler, path: str, body: bytes, stream: bool) -> None:
+    def _route(self, h: BaseHTTPRequestHandler, path: str, body: bytes, stream: bool,
+               session_id: str | None = None,
+               prompt_tokens: list[int] | None = None) -> None:
         deadline = time.monotonic() + self.failover_deadline_s
         tried: set[int] = set()
         soft_failovers = 0
         while True:
-            replica = self._pick(tried)
+            replica = self._pick(tried, session_id, prompt_tokens)
             if replica is None:
                 if tried:
                     tried.clear()  # every routable replica tried: start over
@@ -256,15 +286,51 @@ class FleetRouter:
                         return
 
     # ------------------------------------------------------------ selection
-    def _pick(self, exclude: set[int]) -> Replica | None:
-        """Least-outstanding HEALTHY replica; UNKNOWN (no probe verdict yet —
-        e.g. just relaunched) only when nothing is HEALTHY."""
+    def _pick(self, exclude: set[int], session_id: str | None = None,
+              prompt_tokens: list[int] | None = None) -> Replica | None:
+        """Session-pinned replica first (while routable and untried), then
+        least-outstanding HEALTHY; UNKNOWN (no probe verdict yet — e.g. just
+        relaunched) only when nothing is HEALTHY. A sessionful pick updates
+        the SessionTable: first turn pins, a failover pick re-pins (counted
+        — each re-pin is one lost warm prefill)."""
         snap = self.health.snapshot()
-        for state in (ReplicaState.HEALTHY, ReplicaState.UNKNOWN):
-            cands = [r for r in snap if r.state == state and r.index not in exclude]
-            if cands:
-                return min(cands, key=lambda r: (r.outstanding, r.index))
-        return None
+        by_index = {r.index: r for r in snap}
+        pin = self.sessions.lookup(session_id) if session_id else None
+        if pin is not None:
+            r = by_index.get(pin.replica_index)
+            if r is not None and r.state.routable and r.index not in exclude:
+                self.sessions.record_route("pinned")
+                return r
+        chosen = None
+        outcome = "new"
+        if pin is None and session_id:
+            # brand-new session: steer a shared leading page (system prompt)
+            # to the replica already holding it — hint only, never forced
+            hinted = self.sessions.hint(prompt_tokens)
+            if hinted is not None and hinted not in exclude:
+                r = by_index.get(hinted)
+                if r is not None and r.state == ReplicaState.HEALTHY:
+                    chosen, outcome = r, "hinted"
+        if chosen is None:
+            for state in (ReplicaState.HEALTHY, ReplicaState.UNKNOWN):
+                cands = [r for r in snap if r.state == state and r.index not in exclude]
+                if cands:
+                    chosen = min(cands, key=lambda r: (r.outstanding, r.index))
+                    break
+        if chosen is None:
+            return None
+        if session_id:
+            if pin is not None and chosen.index != pin.replica_index:
+                outcome = "repinned"
+                obs_trace.add_event("router.session_repin", session=session_id,
+                                    old=pin.replica_index, new=chosen.index)
+            elif pin is not None:
+                # same replica re-chosen through the fallback (e.g. the whole
+                # fleet is UNKNOWN mid-restart): the pin held, not a re-pin
+                outcome = "pinned"
+            self.sessions.pin(session_id, chosen.index, prompt_tokens)
+            self.sessions.record_route(outcome)
+        return chosen
 
     # ------------------------------------------------------------- attempts
     def _fail(self, replica: Replica, reason: str, hard: bool) -> _AttemptFailed:
@@ -272,6 +338,10 @@ class FleetRouter:
         raise site — so hedge legs whose exception is discarded (the other
         leg won) still mark their replica."""
         self.health.report_failure(replica, hard=hard)
+        if hard:
+            # the process is gone: its warm prefixes went with it — stop
+            # steering NEW sessions there (existing pins re-pin lazily)
+            self.sessions.drop_replica(replica.index)
         return _AttemptFailed(replica, reason, hard)
 
     def _open(self, replica: Replica, path: str, body: bytes):
@@ -295,6 +365,15 @@ class FleetRouter:
         if resp.status >= 500 and resp.status != 504:
             payload = resp.read()
             conn.close()
+            if resp.status == 503 and b"draining" in payload:
+                # lifecycle, not failure: the replica is refusing admissions
+                # while it drains (preemption notice / scale-down victim /
+                # SIGTERM window). Shed it and retry elsewhere WITHOUT
+                # consuming the soft-failover budget — a drain must never
+                # become a client-visible 502, and marking it DOWN would
+                # misread an orderly handoff as an outage.
+                self.health.report_draining(replica)
+                raise _AttemptFailed(replica, "replica draining", hard=True)
             raise self._fail(
                 replica, f"replica answered {resp.status}: {payload[:200]!r}", hard=False)
         return conn, resp

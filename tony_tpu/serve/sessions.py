@@ -1,0 +1,235 @@
+"""Session-affinity table: pin a conversation to the replica that holds its KV.
+
+The engine's paged prefix cache (models/paged_cache.py) makes turn N+1 of a
+conversation nearly free — *on the replica that served turns 1..N*. The
+fleet router's least-outstanding balancing is blind to that: it scatters a
+session's turns across replicas and every turn pays a cold prefill. The
+:class:`SessionTable` closes the gap:
+
+- a client that sends ``X-Tony-Session: <id>`` is **pinned** to the replica
+  that served its first turn; while that replica stays routable every later
+  turn lands on the warm prefix cache;
+- entries expire after ``tony.serve.session.ttl-ms`` of inactivity and the
+  table is LRU-capped at ``tony.serve.session.max-sessions`` — a session
+  table must never become the fleet's memory leak;
+- **prompt-prefix-hash hints**: each pin remembers a hash of the prompt's
+  leading page (the same page granularity the engine's prefix cache keys
+  on). A NEW session whose first prompt shares that prefix (shared system
+  prompt, few-shot header) is steered to a replica already holding it, so
+  cross-session sharing survives the router too;
+- **failover re-pin**: when a pinned replica stops being routable (crash,
+  DRAINING under a preemption drain, scale-down) the next turn re-pins to a
+  live replica — exactly once per failover, counted by
+  ``tony_router_session_repins_total`` because every re-pin is lost KV reuse
+  (the new replica pays one cold prefill) that capacity planning should see.
+
+Thread safety: one lock around the table; the router calls from its HTTP
+handler threads. All decisions are O(1) dict/OrderedDict operations — this
+sits on the request hot path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any
+
+from tony_tpu.obs import metrics as obs_metrics
+
+_REPINS = obs_metrics.counter(
+    "tony_router_session_repins_total",
+    "sessions re-pinned after their replica stopped being routable "
+    "(each re-pin is one lost warm-prefix hit)")
+_SESSIONS = obs_metrics.gauge(
+    "tony_router_sessions", "live (unexpired) session pins in the router")
+_AFFINITY = obs_metrics.counter(
+    "tony_router_session_routes_total",
+    "session-routed requests by how the replica was chosen",
+    labelnames=("outcome",))  # pinned | repinned | new | hinted
+
+
+def repins_total() -> float:
+    """Lifetime re-pin count (the /stats payload's reuse-loss figure — the
+    loadtest harness diffs it across a run)."""
+    return _REPINS.value()
+
+
+def prefix_fingerprint(prompt_tokens: list[int], span: int) -> str | None:
+    """Content hash of the prompt's first ``span`` tokens (None when the
+    prompt is shorter — too little shared material to steer on). Matches the
+    engine's page-granular prefix keys in spirit: two prompts with the same
+    fingerprint share at least one full cache page on a replica. Malformed
+    tokens (non-ints, out of 64-bit range — the replica's 400 to answer,
+    not ours to crash on) fingerprint as None."""
+    if span <= 0 or len(prompt_tokens) < span:
+        return None
+    h = hashlib.sha256()
+    try:
+        h.update(b"".join(int(t).to_bytes(8, "little", signed=True)
+                          for t in prompt_tokens[:span]))
+    except (TypeError, ValueError, OverflowError):
+        return None
+    return h.hexdigest()
+
+
+@dataclass
+class SessionPin:
+    """One session's affinity record."""
+
+    session_id: str
+    replica_index: int
+    last_used_s: float
+    prefix: str | None = None  # fingerprint of the session's first prompt page
+    repins: int = 0
+
+    def to_info(self) -> dict[str, Any]:
+        return {
+            "session": self.session_id,
+            "replica": self.replica_index,
+            "idle_s": round(time.time() - self.last_used_s, 1),
+            "repins": self.repins,
+        }
+
+
+class SessionTable:
+    """TTL + LRU map of session id → pinned replica, with prefix hints."""
+
+    def __init__(self, ttl_s: float = 600.0, max_sessions: int = 10_000,
+                 prefix_span: int = 256):
+        self.ttl_s = max(float(ttl_s), 0.0)
+        self.max_sessions = max(int(max_sessions), 1)
+        self.prefix_span = int(prefix_span)
+        self._lock = threading.Lock()
+        #: insertion/recency order IS the LRU order (move_to_end on touch)
+        self._pins: "OrderedDict[str, SessionPin]" = OrderedDict()
+        #: prefix fingerprint → replica index of the most recent pin that
+        #: carried it (hint only — never authoritative, never re-pinned)
+        self._prefix_owner: dict[str, int] = {}
+        #: fingerprint → count of LIVE pins carrying it; the hint survives
+        #: until the last such pin is evicted (one session of N sharing a
+        #: system prompt expiring must not blind new sessions to the other
+        #: N-1 keeping the pages warm)
+        self._prefix_live: dict[str, int] = {}
+
+    # ------------------------------------------------------------- routing
+    def lookup(self, session_id: str) -> SessionPin | None:
+        """The live pin for a session (touches LRU recency), or None
+        (unknown / expired)."""
+        now = time.time()
+        with self._lock:
+            pin = self._pins.get(session_id)
+            if pin is None:
+                return None
+            if self.ttl_s and now - pin.last_used_s > self.ttl_s:
+                self._evict_locked(session_id)
+                return None
+            pin.last_used_s = now
+            self._pins.move_to_end(session_id)
+            return pin
+
+    def pin(self, session_id: str, replica_index: int,
+            prompt_tokens: list[int] | None = None) -> SessionPin:
+        """Pin (or move) a session to ``replica_index``. A move of an
+        existing pin is a failover re-pin: counted, because the new replica
+        pays the cold prefill the pin existed to avoid."""
+        now = time.time()
+        with self._lock:
+            pin = self._pins.get(session_id)
+            if pin is not None and self.ttl_s and now - pin.last_used_s > self.ttl_s:
+                self._evict_locked(session_id)
+                pin = None
+            if pin is None:
+                pin = SessionPin(session_id, replica_index, now)
+                if prompt_tokens:
+                    pin.prefix = prefix_fingerprint(prompt_tokens, self.prefix_span)
+                self._pins[session_id] = pin
+                if pin.prefix is not None:
+                    self._prefix_live[pin.prefix] = (
+                        self._prefix_live.get(pin.prefix, 0) + 1)
+                while len(self._pins) > self.max_sessions:
+                    self._evict_locked(next(iter(self._pins)))
+            elif pin.replica_index != replica_index:
+                pin.replica_index = replica_index
+                pin.repins += 1
+                _REPINS.inc()
+            pin.last_used_s = now
+            self._pins.move_to_end(session_id)
+            if pin.prefix is not None:
+                self._prefix_owner[pin.prefix] = replica_index
+            _SESSIONS.set(len(self._pins))
+            return pin
+
+    def hint(self, prompt_tokens: list[int] | None) -> int | None:
+        """Replica index that most recently pinned a session with this
+        prompt's leading-page fingerprint, or None. Used only for brand-new
+        sessions: shared system prompts land where the prefix is warm."""
+        if not prompt_tokens:
+            return None
+        fp = prefix_fingerprint(prompt_tokens, self.prefix_span)
+        if fp is None:
+            return None
+        with self._lock:
+            return self._prefix_owner.get(fp)
+
+    def record_route(self, outcome: str) -> None:
+        """Exposition of how a session request was routed
+        (pinned/repinned/new/hinted)."""
+        _AFFINITY.inc(outcome=outcome)
+
+    # --------------------------------------------------------- maintenance
+    def drop_replica(self, replica_index: int) -> int:
+        """Forget prefix hints pointing at a replica that left the fleet
+        (scale-down, gang restart). Pins stay — their next turn re-pins and
+        is counted — but hints must not steer NEW sessions at a corpse.
+        Returns the number of hints dropped."""
+        with self._lock:
+            stale = [fp for fp, idx in self._prefix_owner.items()
+                     if idx == replica_index]
+            for fp in stale:
+                del self._prefix_owner[fp]
+            return len(stale)
+
+    def sweep(self) -> int:
+        """Expire idle sessions (TTL); returns how many were evicted. The
+        router calls this opportunistically — correctness never depends on
+        it because lookup() expires lazily."""
+        if not self.ttl_s:
+            return 0
+        now = time.time()
+        with self._lock:
+            dead = [sid for sid, pin in self._pins.items()
+                    if now - pin.last_used_s > self.ttl_s]
+            for sid in dead:
+                self._evict_locked(sid)
+            _SESSIONS.set(len(self._pins))
+            return len(dead)
+
+    def _evict_locked(self, session_id: str) -> None:
+        pin = self._pins.pop(session_id, None)
+        if pin is not None and pin.prefix is not None:
+            # the hint outlives THIS pin while any other live session still
+            # carries the fingerprint — their pins keep the pages warm
+            left = self._prefix_live.get(pin.prefix, 1) - 1
+            if left > 0:
+                self._prefix_live[pin.prefix] = left
+            else:
+                self._prefix_live.pop(pin.prefix, None)
+                self._prefix_owner.pop(pin.prefix, None)
+
+    # ------------------------------------------------------------- queries
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pins)
+
+    def to_info(self, limit: int = 50) -> dict[str, Any]:
+        with self._lock:
+            pins = list(self._pins.values())
+        return {
+            "sessions": len(pins),
+            "ttl_s": self.ttl_s,
+            "max_sessions": self.max_sessions,
+            "recent": [p.to_info() for p in pins[-limit:]],
+        }
