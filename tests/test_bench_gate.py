@@ -157,3 +157,110 @@ class TestServeBenchFamily:
         result = gate.evaluate(serve_rec, gate.load_trajectory(REPO_ROOT))
         assert result.passed
         assert any("fresh trajectory" in c.note for c in result.checks)
+
+
+def _cbench_trajectory():
+    traj = gate.load_trajectory(REPO_ROOT, "CBENCH_*.json")
+    assert traj, "no checked-in CBENCH_*.json trajectory"
+    return traj
+
+
+class TestCbenchFamily:
+    """The CBENCH family (`tony cbench` records, docs/performance.md
+    "Control-plane scalability"): same wrapper schema, its own headline
+    metric ("weighted decisions/sec" — the geometric mean of the five
+    control-plane throughputs), and per-benchmark gated directions (the
+    journal-replay wall and latency tails regress UPWARD)."""
+
+    def test_family_patterns_do_not_collide(self):
+        train = {name for name, _ in gate.load_trajectory(REPO_ROOT)}
+        serve = {name for name, _ in gate.load_trajectory(REPO_ROOT, "SERVE_BENCH_*.json")}
+        cb = {name for name, _ in _cbench_trajectory()}
+        assert not cb & (train | serve)
+        assert all(n.startswith("CBENCH_") for n in cb)
+
+    def test_every_record_satisfies_the_gate_schema(self):
+        for fname, rec in _cbench_trajectory():
+            errors = gate.validate_record(rec, wrapper=True)
+            assert not errors, f"{fname}: {errors}"
+            p = gate.parsed_of(rec)
+            assert p["metric"] == "control_plane_ops_per_sec"
+            # every record carries all five benchmarks + its provenance
+            for key in ("sched_decisions_per_sec", "heartbeats_per_sec",
+                        "journal_replay_ms", "journal_records_per_sec",
+                        "sweep_jobs_per_sec", "resweep_ms",
+                        "portal_scrape_ms", "portal_ams_per_sec"):
+                assert key in p, f"{fname}: missing {key}"
+            assert isinstance(p.get("sizes"), dict), f"{fname}: no sizes block"
+
+    def test_gate_directions_cover_the_cbench_metrics(self):
+        assert gate.GATE_METRICS.get("journal_replay_ms") == -1
+        assert gate.GATE_METRICS.get("heartbeat_churn_p99_ms") == -1
+        assert gate.GATE_METRICS.get("heartbeats_per_sec") == +1
+        assert gate.GATE_METRICS.get("portal_ams_per_sec") == +1
+        assert gate.GATE_METRICS.get("sweep_jobs_per_sec") == +1
+
+    def test_trajectory_shows_the_fixes_moving_the_numbers(self):
+        """Acceptance: r02 (post-fix) strictly better than r01 (baseline) on
+        the headline metric AND on journal-replay wall-time — the round
+        pair is the measured proof the refactors paid off."""
+        by_round = {rec["n"]: gate.parsed_of(rec) for _, rec in _cbench_trajectory()}
+        r01, r02 = by_round[1], by_round[2]
+        assert r02["value"] > r01["value"]
+        assert r02["journal_replay_ms"] < r01["journal_replay_ms"]
+        assert r02["vs_baseline"] > 1.0
+
+    def test_gate_cli_passes_on_cbench_trajectory(self, capsys):
+        from tony_tpu.cli.history import main_bench
+
+        assert main_bench(["--gate", "--trajectory-dir", REPO_ROOT,
+                           "--pattern", "CBENCH_*.json"]) == 0
+        assert "PASS" in capsys.readouterr().out
+
+    def test_gate_cli_fails_on_regressed_cbench_record(self, tmp_path, capsys):
+        """The headline dropping OR the journal-replay wall growing past
+        tolerance must fail the gate — direction matters per metric."""
+        from tony_tpu.cli.history import main_bench
+
+        traj = _cbench_trajectory()
+        for mutate in (
+            lambda p: p.update(value=p["value"] * 0.5,
+                               vs_baseline=p["vs_baseline"] * 0.5),
+            lambda p: p.update(journal_replay_ms=p["journal_replay_ms"] * 3.0),
+            lambda p: p.update(heartbeats_per_sec=p["heartbeats_per_sec"] * 0.5),
+        ):
+            regressed = json.loads(json.dumps(traj[-1][1]))
+            regressed["n"] = traj[-1][1]["n"] + 1
+            mutate(regressed["parsed"])
+            path = tmp_path / "regressed.json"
+            path.write_text(json.dumps(regressed))
+            assert main_bench(["--gate", "--trajectory-dir", REPO_ROOT,
+                               "--pattern", "CBENCH_*.json",
+                               "--record", str(path)]) == 1
+            assert "REGRESSION" in capsys.readouterr().out
+
+    def test_provenance_warning_when_sizes_missing(self):
+        """A cbench record without its tony.cbench.* sizes cannot be
+        compared against the trajectory — the gate must say so (the same
+        discipline as the profile-provenance warning for MFU rounds)."""
+        traj = _cbench_trajectory()
+        naked = json.loads(json.dumps(traj[-1][1]))
+        naked["parsed"].pop("sizes", None)
+        naked["n"] = traj[-1][1]["n"] + 1
+        result = gate.evaluate(naked, traj)
+        assert any(c.metric == "provenance" and "sizes" in c.note
+                   for c in result.checks)
+
+    def test_movement_warning_on_copied_cbench_round(self):
+        """The anti-gate-without-movement check covers this family too: a
+        content-identical copy of the latest round warns loudly."""
+        traj = _cbench_trajectory()
+        copied = json.loads(json.dumps(traj[-1][1]))
+        result = gate.evaluate(copied, traj)
+        assert any("gate-without-movement" in c.note for c in result.checks)
+
+    def test_cbench_records_do_not_gate_against_other_families(self):
+        cb_rec = _cbench_trajectory()[-1][1]
+        result = gate.evaluate(cb_rec, gate.load_trajectory(REPO_ROOT))
+        assert result.passed
+        assert any("fresh trajectory" in c.note for c in result.checks)

@@ -1,0 +1,521 @@
+"""Control-plane benchmark suite (`tony cbench`, docs/performance.md
+"Control-plane scalability").
+
+Tier-1 runs scaled-down rounds of the five microbenchmarks asserting the
+same invariants the checked-in full-scale ``CBENCH_r<N>.json`` records were
+produced under — plus the deterministic contracts behind the fixes the
+baseline round forced: the heartbeat handler never serializes on the session
+lock, journal compaction keeps replay O(live state) with crash-safe snapshot
+semantics, the sweep's unchanged-job fast path, and the portal's O(changed)
+scrape cache. Full-scale sizes run behind ``-m slow``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.cluster import cbench
+from tony_tpu.cluster.cbench import CbenchSizes, write_pool_history
+from tony_tpu.cluster.journal import Journal, JournalError, iter_journal, read_journal
+
+pytestmark = [pytest.mark.cbench]
+
+#: tier-1 scale: seconds, not minutes — same invariants as the full rounds
+TINY = CbenchSizes(
+    apps=150, queues=4, executors=24, heartbeat_seconds=0.4,
+    journal_records=600, journal_live_apps=6, history_jobs=25,
+    portal_ams=4, seed=7,
+)
+
+
+# ---------------------------------------------------------------- scheduler
+class TestSchedulerBench:
+    def test_seeded_world_reproduces(self):
+        _, views_a, totals_a = cbench._scheduler_world(TINY)
+        _, views_b, totals_b = cbench._scheduler_world(TINY)
+        assert totals_a == totals_b
+        assert [(v.app_id, v.queue, v.demand, v.admitted) for v in views_a] \
+            == [(v.app_id, v.queue, v.demand, v.admitted) for v in views_b]
+
+    def test_bench_scheduler_invariants(self):
+        got = cbench.bench_scheduler(TINY, passes=6)
+        assert got["sched_decisions_per_sec"] > 0
+        assert 0 < got["sched_decision_p50_ms"] <= got["sched_decision_p99_ms"]
+        # the seeded world leaves real work on the table: a pass admits some
+        assert got["sched_admitted_per_pass"] > 0
+
+
+# ------------------------------------------------------- heartbeat fan-in
+class TestHeartbeatFanIn:
+    def test_bench_heartbeats_smoke(self, tmp_path):
+        got = cbench.bench_heartbeats(TINY, str(tmp_path), threads=2)
+        assert got["heartbeats_per_sec"] > 0
+        assert 0 < got["heartbeat_p50_ms"] <= got["heartbeat_p99_ms"]
+        assert got["heartbeat_churn_p99_ms"] > 0
+
+    def test_handler_does_not_serialize_on_the_session_lock(self, tmp_path):
+        """The epoch-lock/session-lock decoupling, asserted deterministically
+        (acceptance: handler p99 unaffected by monitor-loop activity): with
+        the session lock HELD — a monitor-loop snapshot in progress — a
+        heartbeat must still answer, because the beat lands in the lock-free
+        ledger. Pre-decoupling this call blocked until the lock released."""
+        from tony_tpu.cluster.rpc import RpcClient
+
+        sizes = CbenchSizes(executors=4, seed=1)
+        am = cbench._bench_am(sizes, str(tmp_path))
+        try:
+            host, port = am.rpc.address
+            cli = RpcClient(host, port, secret=am.secret, timeout_s=5.0)
+            try:
+                # first beat flips REGISTERED→RUNNING (the one lock touch)
+                assert cli.call("task_executor_heartbeat",
+                                job_name="worker", index=1, attempt=0)["ack"]
+                with am.session.lock:
+                    t0 = time.perf_counter()
+                    resp = cli.call("task_executor_heartbeat",
+                                    job_name="worker", index=1, attempt=0)
+                    held_latency = time.perf_counter() - t0
+                assert resp["ack"]
+                assert held_latency < 2.0
+                # the ledger's beat is visible to lock-holding readers
+                infos = {f"{t['name']}:{t['index']}": t for t in am.session.task_infos()}
+                assert infos["worker:1"]["last_heartbeat_ms"] > 0
+            finally:
+                cli.close()
+        finally:
+            am.rpc.stop()
+
+    def test_stale_epoch_still_fenced(self, tmp_path):
+        """The single-acquisition rewrite must keep the epoch fence: a beat
+        from a killed gang epoch is rejected, never recorded."""
+        from tony_tpu.cluster.rpc import RpcClient
+
+        am = cbench._bench_am(CbenchSizes(executors=2, seed=1), str(tmp_path))
+        try:
+            host, port = am.rpc.address
+            cli = RpcClient(host, port, secret=am.secret, timeout_s=5.0)
+            try:
+                got = cli.call("task_executor_heartbeat",
+                               job_name="worker", index=0, attempt=99)
+                assert got == {"ack": False, "stale": True}
+            finally:
+                cli.close()
+        finally:
+            am.rpc.stop()
+
+
+# ------------------------------------------------- journal reader/compaction
+class TestIterJournal:
+    def test_streams_the_same_records_read_journal_returns(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = Journal(path)
+        for i in range(50):
+            j.append("rec", i=i)
+        j.close()
+        streamed = list(iter_journal(path))
+        assert streamed == read_journal(path)
+        assert [r["i"] for r in streamed] == list(range(50))
+
+    def test_torn_tail_dropped_corrupt_middle_raises(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        with open(path, "w") as f:
+            f.write('{"t": "a"}\n{"t": "b"}\n{"t": "c", "x"')  # torn mid-append
+        assert [r["t"] for r in iter_journal(path)] == ["a", "b"]
+        with open(path, "w") as f:
+            f.write('{"t": "a"}\ngarbage\n{"t": "c"}\n')
+        with pytest.raises(JournalError, match="corrupt"):
+            list(iter_journal(path))
+
+    def test_missing_and_empty_raise(self, tmp_path):
+        with pytest.raises(JournalError, match="missing"):
+            list(iter_journal(str(tmp_path / "nope.jsonl")))
+        path = str(tmp_path / "empty.jsonl")
+        Journal(path).close()
+        with pytest.raises(JournalError, match="empty"):
+            list(iter_journal(path))
+
+
+class TestJournalCompaction:
+    def test_compact_rotates_to_one_snapshot_record(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = Journal(path)
+        for i in range(100):
+            j.append("old", i=i)
+        assert j.appends_since_compact == 100
+        assert j.compact([{"t": "live", "n": 1}])
+        assert j.appends_since_compact == 0
+        j.append("tail", i=0)
+        j.close()
+        records = read_journal(path)
+        assert [r["t"] for r in records] == ["snapshot", "tail"]
+        assert records[0]["records"] == [{"t": "live", "n": 1}]
+        with open(path) as f:
+            assert sum(1 for line in f if line.strip()) == 2
+
+    def test_torn_snapshot_append_falls_back_to_pre_snapshot_tail(self, tmp_path):
+        """A SIGKILL tearing the snapshot append itself (phase 1 of compact)
+        must recover from the intact pre-snapshot history — loud, never a
+        half-applied snapshot."""
+        path = str(tmp_path / "j.jsonl")
+        j = Journal(path)
+        for i in range(5):
+            j.append("old", i=i)
+        j.close()
+        snapshot_line = json.dumps(
+            {"t": "snapshot", "records": [{"t": "live"}]}, sort_keys=True)
+        with open(path, "a") as f:
+            f.write(snapshot_line[: len(snapshot_line) // 2])  # torn mid-write
+        records = read_journal(path)
+        assert [r["t"] for r in records] == ["old"] * 5
+
+    def test_stale_snapshot_is_refused_by_the_append_token(self, tmp_path):
+        """The AM's optimistic-concurrency contract: a snapshot built before
+        an append landed must NOT be written — the interleaved record would
+        sort before it and be discarded by the replay barrier (a takeover
+        would silently lose the transition)."""
+        path = str(tmp_path / "j.jsonl")
+        j = Journal(path)
+        j.append("old", i=0)
+        token = j.total_appends
+        recs = [{"t": "live", "snapshot_of": 1}]  # built "now"...
+        j.append("raced", i=1)  # ...but an RPC handler appended meanwhile
+        assert j.compact(recs, expected_total=token) is False
+        assert [r["t"] for r in read_journal(path)] == ["old", "raced"]
+        # with a fresh token the same snapshot goes through
+        assert j.compact(recs, expected_total=j.total_appends) is True
+        j.close()
+        assert [r["t"] for r in read_journal(path)] == ["snapshot"]
+
+    def test_concurrent_appends_never_tear_the_journal(self, tmp_path):
+        """Appends racing compactions: every surviving record parses, the
+        stream stays valid, and every record appended AFTER the last
+        snapshot survives verbatim (the compaction lock's contract)."""
+        path = str(tmp_path / "j.jsonl")
+        j = Journal(path)
+        stop = threading.Event()
+        appended: list[int] = []
+
+        def writer() -> None:
+            i = 0
+            while not stop.is_set():
+                j.append("rec", i=i)
+                appended.append(i)
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for gen in range(5):
+                time.sleep(0.02)
+                assert j.compact([{"t": "gen", "n": gen}])
+        finally:
+            stop.set()
+            t.join()
+        j.close()
+        records = read_journal(path)  # parses end to end: nothing torn
+        last_snap = max(i for i, r in enumerate(records) if r["t"] == "snapshot")
+        tail = [r["i"] for r in records[last_snap + 1:]]
+        assert tail == sorted(tail)
+        assert set(tail) <= set(appended)
+
+
+# -------------------------------------------- pool journal replay benchmark
+class TestJournalReplayBench:
+    def test_write_pool_history_is_seeded_and_replayable(self, tmp_path):
+        from tony_tpu.cluster.pool import PoolService
+
+        path = str(tmp_path / "pool.jsonl")
+        write_pool_history(path, records=300, live_apps=5, seed=3)
+        svc = PoolService(journal_path=path, port=0)
+        try:
+            assert {a for a in svc._apps} >= {f"live_{i:05d}" for i in range(5)}
+            running = [r for r in svc._containers.values() if r["state"] == "RUNNING"]
+            assert len(running) == 5
+        finally:
+            svc.stop()
+
+    def test_compacted_history_replays_to_the_same_live_state(self, tmp_path):
+        from tony_tpu.cluster.pool import PoolService
+
+        plain, compacted = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        write_pool_history(plain, records=800, live_apps=6, seed=3)
+        write_pool_history(compacted, records=800, live_apps=6, seed=3,
+                           compact_every=100)
+        states = []
+        for path in (plain, compacted):
+            svc = PoolService(journal_path=path, port=0)
+            try:
+                # FULL state, not a field subset: any drift between the
+                # generator's _PoolShadow vocabulary and the real
+                # _snapshot_records_locked/_recover_from_journal_locked pair
+                # must fail here, not silently skew the benchmark workload
+                apps = {
+                    a.app_id: (a.queue, a.priority, a.seq, a.admitted,
+                               a.preempted, a.demand_memory, a.demand_vcores,
+                               a.demand_chips, list(a.elastic_unit),
+                               a.elastic_slack)
+                    for a in svc._apps.values()
+                }
+                conts = {c: {k: v for k, v in rec.items()}
+                         for c, rec in svc._containers.items()}
+                exits = {k: dict(v) for k, v in svc._app_exits.items()}
+                states.append((apps, conts, exits))
+            finally:
+                svc.stop()
+        assert states[0] == states[1]
+
+    def test_replay_is_o_live_state(self, tmp_path):
+        """Acceptance: a long history with a fixed live set replays within a
+        small constant factor of a short one — asserted on the compacted
+        file's RECORD COUNT (deterministic) and, loosely, on wall time."""
+        from tony_tpu.cluster.pool import PoolService
+
+        long_p, short_p = str(tmp_path / "long.jsonl"), str(tmp_path / "short.jsonl")
+        write_pool_history(long_p, records=6_000, live_apps=20, seed=5,
+                           compact_every=300)
+        write_pool_history(short_p, records=600, live_apps=20, seed=5)
+
+        def lines(p: str) -> int:
+            with open(p) as f:
+                return sum(1 for line in f if line.strip())
+
+        assert lines(long_p) <= lines(short_p)  # 10x the history, smaller file
+
+        def replay_s(p: str) -> float:
+            t0 = time.perf_counter()
+            svc = PoolService(journal_path=p, port=0)
+            dt = time.perf_counter() - t0
+            live = len([a for a in svc._apps if a.startswith("live_")])
+            svc.stop()
+            assert live == 20
+            return dt
+
+        t_long, t_short = replay_s(long_p), replay_s(short_p)
+        assert t_long < t_short * 8 + 0.25  # constant factor, noise-padded
+
+    @pytest.mark.slow
+    def test_replay_is_o_live_state_full_scale(self, tmp_path):
+        """The acceptance sizes verbatim: 100k records / 200 live apps vs a
+        1k-record history with the same live set."""
+        from tony_tpu.cluster.pool import PoolService
+
+        long_p, short_p = str(tmp_path / "long.jsonl"), str(tmp_path / "short.jsonl")
+        write_pool_history(long_p, records=100_000, live_apps=200, seed=5,
+                           compact_every=5_000)
+        write_pool_history(short_p, records=1_000, live_apps=200, seed=5)
+
+        def replay_s(p: str) -> float:
+            t0 = time.perf_counter()
+            svc = PoolService(journal_path=p, port=0)
+            dt = time.perf_counter() - t0
+            assert len([a for a in svc._apps if a.startswith("live_")]) == 200
+            svc.stop()
+            return dt
+
+        assert replay_s(long_p) < replay_s(short_p) * 8 + 0.5
+
+
+# ------------------------------------------------------------ history sweep
+class TestHistorySweepBench:
+    def test_sweep_then_resweep_converges(self, tmp_path):
+        from tony_tpu.histserver.ingest import sweep
+        from tony_tpu.histserver.store import HistoryStore
+
+        staging = str(tmp_path / "staging")
+        os.makedirs(staging)
+        cbench.make_history_fixtures(staging, 12, seed=2)
+        store = HistoryStore(str(tmp_path / "h.sqlite"))
+        try:
+            first = sweep(store, [staging])
+            assert first["ingested"] == 12 and not first["errors"]
+            second = sweep(store, [staging])
+            assert second["unchanged"] == 12 and second["ingested"] == 0
+            # a changed .jhist re-ingests (the fast path keys on mtime)
+            hist = []
+            for dirpath, _, files in os.walk(os.path.join(staging, "history")):
+                hist += [os.path.join(dirpath, f) for f in files if f.endswith(".jhist")]
+            os.utime(hist[0], ns=(time.time_ns(), time.time_ns()))
+            third = sweep(store, [staging])
+            assert third["ingested"] == 1 and third["unchanged"] == 11
+        finally:
+            store.close()
+
+    def test_bench_history_sweep_smoke(self, tmp_path):
+        got = cbench.bench_history_sweep(TINY, str(tmp_path))
+        assert got["sweep_jobs_per_sec"] > 0
+        assert got["resweep_ms"] > 0
+
+
+# ------------------------------------------------------------ portal scrape
+def _portal_world(tmp_path, ams: int, stubs: int = 2):
+    """``ams`` running apps whose am_info points at ``stubs`` live stub
+    servers that count their get_metrics calls."""
+    from tony_tpu.cluster.rpc import RpcServer
+
+    staging = str(tmp_path / "staging")
+    inter = os.path.join(staging, "history", constants.HISTORY_INTERMEDIATE_DIR)
+    os.makedirs(inter)
+    calls = [0] * stubs
+    servers = []
+    for s in range(stubs):
+        srv = RpcServer(port=0, secret="t")
+
+        def get_metrics(slot=s):
+            calls[slot] += 1
+            return {"identity": "am", "metrics": [], "tasks": {}}
+
+        srv.register("get_metrics", get_metrics)
+        srv.start()
+        servers.append(srv)
+    for i in range(ams):
+        app = f"app_{i:03d}"
+        host, port = servers[i % stubs].address
+        os.makedirs(os.path.join(staging, app))
+        with open(os.path.join(staging, app, constants.AM_INFO_FILE), "w") as f:
+            json.dump({"host": host, "port": port, "secret": "t"}, f)
+        with open(os.path.join(inter, app + constants.HISTORY_SUFFIX), "w") as f:
+            f.write("")
+    return staging, servers, calls
+
+
+class TestPortalScrapeCache:
+    def _scrape(self, httpd) -> str:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/metrics"
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.read().decode()
+
+    def test_default_ttl_zero_scrapes_every_time(self, tmp_path):
+        from tony_tpu.portal.server import serve
+
+        staging, servers, calls = _portal_world(tmp_path, ams=4)
+        httpd = serve(os.path.join(staging, "history"), 0, staging_root=staging)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            self._scrape(httpd)
+            first = sum(calls)
+            self._scrape(httpd)
+            assert sum(calls) == first * 2  # no cache at default config
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            t.join()
+            for srv in servers:
+                srv.stop()
+
+    def test_ttl_serves_cached_groups_with_age_label(self, tmp_path):
+        from tony_tpu.portal.server import serve
+
+        staging, servers, calls = _portal_world(tmp_path, ams=4)
+        httpd = serve(os.path.join(staging, "history"), 0, staging_root=staging,
+                      scrape_ttl_ms=60_000)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            self._scrape(httpd)
+            knocked = sum(calls)
+            assert knocked == 4
+            body = self._scrape(httpd)
+            assert sum(calls) == knocked  # O(changed): nothing moved, no knocks
+            assert "tony_portal_scrape_age_seconds" in body
+            assert 'app="app_000"' in body  # cached groups still exported
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            t.join()
+            for srv in servers:
+                srv.stop()
+
+    def test_moved_am_info_invalidates_its_entry_only(self, tmp_path):
+        from tony_tpu.portal.server import serve
+
+        staging, servers, calls = _portal_world(tmp_path, ams=4, stubs=2)
+        httpd = serve(os.path.join(staging, "history"), 0, staging_root=staging,
+                      scrape_ttl_ms=60_000)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            self._scrape(httpd)
+            before = list(calls)
+            # a takeover republishes app_000's am_info (content length moves
+            # too, so the (mtime, size) key changes even on coarse clocks)
+            host, port = servers[0].address
+            with open(os.path.join(staging, "app_000", constants.AM_INFO_FILE), "w") as f:
+                json.dump({"host": host, "port": port, "secret": "t",
+                           "pid": 12345}, f)
+            self._scrape(httpd)
+            # app_000 lives on stub 0: exactly one extra knock, and stub 1's
+            # apps were all served from cache
+            assert calls[0] == before[0] + 1
+            assert calls[1] == before[1]
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            t.join()
+            for srv in servers:
+                srv.stop()
+
+    def test_finished_app_drops_its_cache_entry_and_age_series(self, tmp_path):
+        """An app leaving the RUNNING list must not pin its cached groups OR
+        its scrape-age gauge series forever (unbounded label cardinality on
+        a long-lived portal)."""
+        from tony_tpu.portal.server import serve
+
+        staging, servers, _calls = _portal_world(tmp_path, ams=2)
+        httpd = serve(os.path.join(staging, "history"), 0, staging_root=staging,
+                      scrape_ttl_ms=60_000)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            self._scrape(httpd)
+            # app_001 finalizes: its intermediate .jhist is gone
+            os.remove(os.path.join(staging, "history",
+                                   constants.HISTORY_INTERMEDIATE_DIR,
+                                   "app_001" + constants.HISTORY_SUFFIX))
+            body = self._scrape(httpd)
+            assert 'tony_portal_scrape_age_seconds{app="app_001"}' not in body
+            assert 'app="app_000"' in body  # the live app is unaffected
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            t.join()
+            for srv in servers:
+                srv.stop()
+
+    def test_bench_portal_scrape_smoke(self, tmp_path):
+        got = cbench.bench_portal_scrape(TINY, str(tmp_path), stub_servers=2,
+                                         scrapes=2)
+        assert got["portal_scrape_ms"] > 0
+        assert got["portal_ams_per_sec"] > 0
+
+
+# ------------------------------------------------------------- CLI + record
+class TestCbenchCli:
+    def test_cli_emits_a_gateable_record(self, tmp_path):
+        from tony_tpu.cli.cbench import main
+        from tony_tpu.histserver import gate
+
+        record = str(tmp_path / "CBENCH_r99.json")
+        rc = main([
+            "--apps", "60", "--queues", "3", "--executors", "6",
+            "--heartbeat-seconds", "0.2", "--records", "200",
+            "--live-apps", "3", "--jobs", "6", "--ams", "3",
+            "--workdir", str(tmp_path / "work"),
+            "--bench-record", record, "--round", "99", "--baseline", "1.0",
+        ])
+        assert rc == 0
+        with open(record) as f:
+            rec = json.load(f)
+        assert gate.validate_record(rec, wrapper=True) == []
+        parsed = gate.parsed_of(rec)
+        assert parsed["metric"] == "control_plane_ops_per_sec"
+        assert isinstance(parsed["sizes"], dict)
+        for key in cbench.HEADLINE_COMPONENTS:
+            assert parsed[key] > 0

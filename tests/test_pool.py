@@ -176,6 +176,121 @@ class TestPoolModel:
 
 
 # ---------------------------------------------------------------------------
+# Unit: incremental journal compaction (tony.pool.journal.compact-every)
+# ---------------------------------------------------------------------------
+class TestPoolJournalCompaction:
+    """Snapshot+rotate compaction (docs/performance.md "Control-plane
+    scalability"): replay of a compacted journal must be EQUIVALENT to the
+    state the writer held — proven property-style over seeded op histories —
+    while the on-disk file stays O(live state)."""
+
+    def _drive(self, svc, seed, ops=120):
+        """Seeded register/allocate/exit/release churn through the REAL pool
+        methods (every one journals through _jlog_locked, so compaction
+        triggers on the production path). Biased to leave live state."""
+        import random
+
+        rng = random.Random(seed)
+        svc.register_node("n0", "127.0.0.1", 1,
+                          memory_bytes=1 << 40, vcores=4096)
+        live = {}
+        for i in range(ops):
+            r = rng.random()
+            if r < 0.6 or not live:
+                app = f"app_{i}"
+                svc.register_app(app, queue="default",
+                                 priority=rng.randrange(3),
+                                 memory_bytes=1 << 20, vcores=1)
+                got = svc.allocate(app, "worker", 0,
+                                   memory_bytes=1 << 20, vcores=1)
+                if "id" in got:
+                    live[app] = got["id"]
+            elif r < 0.85:
+                app, cid = rng.choice(sorted(live.items()))
+                svc.node_heartbeat("n0", exited={cid: 0})
+                if rng.random() < 0.5:
+                    svc.poll_exited(app)  # some exits delivered, some pending
+                if rng.random() < 0.4:
+                    svc.release(app, cid)  # some exited containers released
+                del live[app]
+            else:
+                app, cid = rng.choice(sorted(live.items()))
+                svc.release_all(app)
+                del live[app]
+        return live
+
+    @staticmethod
+    def _state(svc):
+        apps = {
+            a.app_id: (a.queue, a.priority, a.seq, a.admitted, a.preempted,
+                       a.demand_memory, a.demand_vcores, a.demand_chips,
+                       round(a.wait_unix, 3), round(a.admitted_unix, 3))
+            for a in svc._apps.values()
+        }
+        conts = {
+            cid: {k: v for k, v in rec.items() if k != "seen_live"}
+            for cid, rec in svc._containers.items()
+        }
+        return apps, conts, {k: dict(v) for k, v in svc._app_exits.items()}
+
+    @pytest.mark.parametrize("compact_every", [0, 20])
+    def test_replay_fidelity_with_and_without_compaction(self, tmp_path, compact_every):
+        path = str(tmp_path / "pool.jsonl")
+        svc = PoolService(journal_path=path,
+                          journal_compact_every=compact_every, port=0)
+        live = self._drive(svc, seed=11)
+        assert live  # the scenario must actually cover live containers
+        before = self._state(svc)
+        svc.stop()
+        restarted = PoolService(journal_path=path, port=0)
+        try:
+            assert self._state(restarted) == before
+        finally:
+            restarted.stop()
+
+    def test_compaction_bounds_the_file(self, tmp_path):
+        plain, compacted = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+
+        def lines(p):
+            with open(p) as f:
+                return sum(1 for line in f if line.strip())
+
+        s1 = PoolService(journal_path=plain, port=0)
+        self._drive(s1, seed=3, ops=200)
+        s1.stop()
+        s2 = PoolService(journal_path=compacted, journal_compact_every=25, port=0)
+        self._drive(s2, seed=3, ops=200)
+        s2.stop()
+        assert lines(compacted) < lines(plain) / 3
+
+    def test_drain_episode_survives_compaction(self, tmp_path):
+        """In-flight drain/shrink state is part of the snapshot: a pool that
+        compacts mid-drain and then restarts must still escalate the
+        episode (deadline rebased onto the new process's clock)."""
+        path = str(tmp_path / "pool.jsonl")
+        svc = PoolService(journal_path=path, journal_compact_every=1, port=0)
+        with svc._lock:
+            svc._drains["victim"] = {
+                "req_id": "pre-test1", "mode": "drain", "workers": 0,
+                "target_primary": 0,
+                "deadline": time.monotonic() + 30.0,
+                "t0": time.monotonic() - 2.0, "escalated": False,
+            }
+            # any journaled transition now triggers a compaction that must
+            # fold the drain into the snapshot
+            svc._jlog_locked("app_removed", app_id="nobody")
+        svc.stop()
+        restarted = PoolService(journal_path=path, port=0)
+        try:
+            entry = restarted._drains["victim"]
+            assert entry["req_id"] == "pre-test1"
+            remaining = entry["deadline"] - time.monotonic()
+            assert 20.0 < remaining < 31.0  # rebased, not reset
+        finally:
+            restarted.stop()
+
+
+# ---------------------------------------------------------------------------
 # E2E: pool service + ≥2 agent PROCESSES on loopback, full submit spine
 # ---------------------------------------------------------------------------
 def spawn_agent(rm_addr, name, tmp, memory="4g", extra=()):

@@ -1,0 +1,106 @@
+"""``tony cbench``: control-plane microbenchmarks + the gated record.
+
+The measurement half of ROADMAP item 4 (docs/performance.md "Control-plane
+scalability"): runs the five seeded in-process benchmarks in
+``tony_tpu/cluster/cbench.py`` — scheduler decision latency, AM heartbeat
+fan-in, pool-journal replay, history sweep, portal scrape — and optionally
+emits the ``CBENCH_r<N>.json`` record ``tony bench --gate --pattern
+'CBENCH_*.json'`` enforces.
+
+    tony cbench                                  # full scale, report only
+    tony cbench --scale 0.01                     # quick smoke
+    tony cbench --bench-record CBENCH_r03.json --round 3 --baseline 1234.5
+
+Sizes come from ``tony.cbench.*`` (overridable per-flag or via ``--conf``);
+no TPUs, no subprocesses — everything runs in this process against the real
+implementations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+
+from tony_tpu.cluster.cbench import CbenchSizes, run_all, wrap_record
+from tony_tpu.config import TonyConfig, keys
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tony cbench", description=__doc__)
+    p.add_argument("--conf_file", default=None)
+    p.add_argument("--conf", action="append", default=[], metavar="K=V")
+    p.add_argument("--apps", type=int, default=None,
+                   help="queued apps in the scheduler bench (tony.cbench.apps)")
+    p.add_argument("--queues", type=int, default=None,
+                   help="queues the apps spread over (tony.cbench.queues)")
+    p.add_argument("--executors", type=int, default=None,
+                   help="simulated executors in the heartbeat fan-in "
+                        "(tony.cbench.executors)")
+    p.add_argument("--heartbeat-seconds", type=float, default=None,
+                   help="sustained-knock window per phase "
+                        "(tony.cbench.heartbeat-seconds)")
+    p.add_argument("--records", type=int, default=None,
+                   help="pool-journal history length (tony.cbench.journal-records)")
+    p.add_argument("--live-apps", type=int, default=None,
+                   help="live apps the replay rebuilds (tony.cbench.journal-live-apps)")
+    p.add_argument("--jobs", type=int, default=None,
+                   help="finalized fixture jobs the sweep ingests "
+                        "(tony.cbench.history-jobs)")
+    p.add_argument("--ams", type=int, default=None,
+                   help="registered AMs the portal scrapes (tony.cbench.portal-ams)")
+    p.add_argument("--seed", type=int, default=None, help="tony.cbench.seed")
+    p.add_argument("--scale", type=float, default=1.0,
+                   help="proportionally shrink every size (0.01 ≈ a smoke run)")
+    p.add_argument("--workdir", default="",
+                   help="scratch directory (default: a fresh temp dir)")
+    p.add_argument("--out", default="", help="write the parsed JSON report here")
+    p.add_argument("--bench-record", default="",
+                   help="write a CBENCH wrapper record here "
+                        "(gate it with tony bench --gate --pattern 'CBENCH_*.json')")
+    p.add_argument("--round", type=int, default=1,
+                   help="round number for --bench-record")
+    p.add_argument("--baseline", type=float, default=None,
+                   help="round-1 headline value for vs_baseline "
+                        "(default: 1.0x — a fresh trajectory)")
+    args = p.parse_args(list(sys.argv[1:] if argv is None else argv))
+
+    config = TonyConfig.from_layers(conf_file=args.conf_file, conf_args=args.conf)
+    sizes = CbenchSizes.from_config(config)
+    overrides = {
+        "apps": args.apps, "queues": args.queues, "executors": args.executors,
+        "heartbeat_seconds": args.heartbeat_seconds,
+        "journal_records": args.records, "journal_live_apps": args.live_apps,
+        "history_jobs": args.jobs, "portal_ams": args.ams, "seed": args.seed,
+    }
+    from dataclasses import replace
+
+    sizes = replace(sizes, **{k: v for k, v in overrides.items() if v is not None})
+    if args.scale != 1.0:
+        sizes = sizes.scaled(args.scale)
+    print(f"[tony-cbench] sizes: {sizes}", flush=True)
+
+    def run(workdir: str) -> dict:
+        return run_all(sizes, workdir, log=lambda m: print(m, flush=True))
+
+    if args.workdir:
+        parsed = run(args.workdir)
+    else:
+        with tempfile.TemporaryDirectory(prefix="tony-cbench-") as workdir:
+            parsed = run(workdir)
+    print(json.dumps(parsed, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(parsed, f, indent=2)
+    if args.bench_record:
+        rec = wrap_record(parsed, args.round, args.baseline)
+        with open(args.bench_record, "w") as f:
+            json.dump(rec, f, indent=2)
+        print(f"[tony-cbench] bench record → {args.bench_record} "
+              f"(gate: tony bench --gate --pattern 'CBENCH_*.json')")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -308,8 +308,11 @@ def main_bench(argv: list[str] | None = None) -> int:
     p.add_argument("--pattern", default="BENCH_*.json",
                    help="trajectory file family (e.g. 'SERVE_BENCH_*.json' "
                         "for the tony loadtest records)")
-    p.add_argument("--tolerance-pct", type=float, default=_gate.DEFAULT_TOLERANCE_PCT,
-                   help="allowed drop vs the trajectory best, percent")
+    p.add_argument("--tolerance-pct", type=float, default=None,
+                   help="allowed drop vs the trajectory best, percent — when "
+                        "set it applies to every metric, replacing the "
+                        "built-in per-metric bands (default: 5, with wider "
+                        "bands for noisy cbench latency tails)")
     p.add_argument("--threshold", action="append", default=[],
                    metavar="METRIC=PCT",
                    help="per-metric threshold override (repeatable)")

@@ -130,6 +130,12 @@ def _cmd_loadtest(argv: list[str]) -> int:
     return loadtest_main(argv)
 
 
+def _cmd_cbench(argv: list[str]) -> int:
+    from tony_tpu.cli.cbench import main as cbench_main
+
+    return cbench_main(argv)
+
+
 def _cmd_mini(argv: list[str]) -> int:
     """Self-contained sandbox: submit a smoke gang against the local resource
     manager and print the verdict + history location.
@@ -330,18 +336,20 @@ _COMMANDS = {
     "sim": _cmd_sim,
     "tune": _cmd_tune,
     "loadtest": _cmd_loadtest,
+    "cbench": _cmd_cbench,
 }
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|history-server|bench|portal|notebook|serve|loadtest|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput|sim|tune} [options]\n")
+        print("usage: tony {submit|pool|history|history-server|bench|cbench|portal|notebook|serve|loadtest|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput|sim|tune} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    query the persistent history tier (list|show|compare|ingest|gc)")
         print("  history-server  run the history daemon: ingest finalized jobs, serve the query API")
         print("  bench      perf-regression gate over the checked-in BENCH_* trajectory (--gate)")
+        print("  cbench     control-plane microbenchmarks at thousand-node scale (CBENCH records)")
         print("  portal     serve the history web portal")
         print("  notebook   launch an interactive notebook container + local proxy")
         print("  serve      run a replicated inference fleet (router + health + autoscaler) as an AM-supervised job")

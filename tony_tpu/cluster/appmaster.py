@@ -31,7 +31,12 @@ from tony_tpu import constants
 from tony_tpu.chaos import ChaosContext
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.cluster import history
-from tony_tpu.cluster.journal import Journal, JournalError, read_journal
+from tony_tpu.cluster.journal import (
+    SNAPSHOT_RECORD,
+    Journal,
+    JournalError,
+    iter_journal,
+)
 from tony_tpu.obs import alerts as obs_alerts
 from tony_tpu.obs import goodput as obs_goodput
 from tony_tpu.obs import introspect as obs_introspect
@@ -187,19 +192,28 @@ class _JournalState:
         self.containers = {}
 
 
-def _replay_am_journal(records: list[dict[str, Any]]) -> _JournalState:
-    """Fold journal records into the state a takeover AM adopts.
+def _replay_am_journal(records) -> _JournalState:
+    """Fold journal records (any iterable — takeover streams them) into the
+    state a takeover AM adopts.
 
     Each ``epoch`` record marks a session rebuild (gang restart / queued
     resize): everything task-scoped before it is obsolete. Cross-epoch
     state (failure budget, pending resizes, chaos watermark) accumulates
-    with last-record-wins semantics.
+    with last-record-wins semantics. A compaction ``snapshot`` record is a
+    barrier: everything before it is folded history — replay resets and
+    folds the embedded records (which carry their own epoch) instead.
     """
     state = _JournalState()
     saw_epoch = False
     for rec in records:
         t = rec.get("t")
-        if t == "epoch":
+        if t == SNAPSHOT_RECORD:
+            inner = rec.get("records")
+            if not isinstance(inner, list):
+                raise JournalError("snapshot record carries no records")
+            state = _replay_am_journal(inner)  # raises unless it has an epoch
+            saw_epoch = True
+        elif t == "epoch":
             saw_epoch = True
             state._reset_epoch(int(rec.get("attempt", 0)),
                                {k: int(v) for k, v in (rec.get("resized") or {}).items()})
@@ -254,6 +268,11 @@ class ApplicationMaster:
             Journal(os.path.join(staging_dir, constants.AM_JOURNAL_FILE))
             if self._takeover_enabled else None
         )
+        # takeover-journal compaction (tony.am.journal.compact-every): the
+        # monitor loop — never an RPC handler — folds the recoverable state
+        # into a snapshot record and rotates once this many appends pile up.
+        # 0 (the default) keeps the append-forever behavior.
+        self._journal_compact_every = config.get_int(keys.AM_JOURNAL_COMPACT_EVERY, 0)
         self._journal_chaos_step = 0
         obs_metrics.set_enabled(config.get_bool(keys.METRICS_ENABLED, True))
         # structured logging (tony.log.*): JSONL records under <staging>/logs
@@ -364,6 +383,70 @@ class ApplicationMaster:
         takeover is disabled."""
         if self._journal is not None:
             self._journal.append(t, **fields)
+
+    def _journal_snapshot_records(self) -> list[dict[str, Any]]:
+        """The minimal record list that replays to the CURRENT recoverable
+        state — the vocabulary ``_replay_am_journal`` folds, captured
+        atomically under the epoch lock (+ session lock for task fields).
+        A container the RM cannot describe (mid-launch, no pid yet) is
+        omitted, the same degrade-on-takeover stance ``_journal_task_started``
+        takes."""
+        with self._epoch_lock:
+            session = self.session
+            recs: list[dict[str, Any]] = [
+                {"t": "epoch", "attempt": self._restart_attempt,
+                 "resized": dict(self._resized)},
+                {"t": "failures", "n": self._failures_seen},
+                {"t": "pending_resize", "resizes": dict(self._pending_resize)},
+            ]
+            if self._journal_chaos_step:
+                recs.append({"t": "chaos_step", "step": self._journal_chaos_step})
+            with session.lock:
+                for task in session.all_tasks():
+                    if task.host and task.port:
+                        recs.append({"t": "registered", "job": task.job_name,
+                                     "index": task.index, "host": task.host,
+                                     "port": task.port})
+                if self._gang_complete_fired:
+                    recs.append({"t": "gang_complete"})
+                for (job, idx), c in self._by_task.items():
+                    task = session.get_task(job, idx)
+                    if task.status.terminal:
+                        continue
+                    info = self.rm.journal_info(c)
+                    if info is None:
+                        continue
+                    recs.append({"t": "task_started", "job": job, "index": idx,
+                                 "cid": c.id, "log_dir": task.log_dir,
+                                 "started_ms": task.start_time_ms,
+                                 "container": info})
+                for task in session.all_tasks():
+                    if task.status.terminal and task.exit_code is not None:
+                        recs.append({"t": "task_done", "job": task.job_name,
+                                     "index": task.index,
+                                     "exit_code": task.exit_code})
+        return recs
+
+    def _maybe_compact_journal(self) -> None:
+        """Monitor-loop compaction tick: snapshot + rotate the takeover
+        journal once enough appends piled up (tony.am.journal.compact-every;
+        docs/performance.md "Control-plane scalability"). Runs only here so
+        the snapshot builder may take the epoch lock without deadlocking the
+        RPC handlers that journal while holding it."""
+        if (
+            self._journal is None
+            or self._journal_compact_every <= 0
+            or self._journal.appends_since_compact < self._journal_compact_every
+        ):
+            return
+        # optimistic: RPC handlers journal WITHOUT the locks the snapshot is
+        # built under, so an append racing the build would sort before the
+        # stale snapshot and be discarded by the replay barrier. The token
+        # makes compact a no-op in that case — retried next tick, when the
+        # burst has usually quiesced.
+        expected = self._journal.total_appends
+        self._journal.compact(self._journal_snapshot_records(),
+                              expected_total=expected)
 
     # ------------------------------------------------------------------ rpc
     def _fenced_session(self, attempt: int) -> Session | None:
@@ -486,20 +569,19 @@ class ApplicationMaster:
         return {"ack": True}
 
     def task_executor_heartbeat(self, job_name: str, index: int, attempt: int = 0) -> dict[str, Any]:
-        session = self._fenced_session(attempt)
-        if session is None:
-            return {"ack": False, "stale": True}
-        session.on_heartbeat(job_name, index)
-        resp: dict[str, Any] = {"ack": True}
-        # the AM cannot push to executors, but they knock every heartbeat:
-        # an in-flight capture request rides back on the response until the
-        # task reports a terminal status (the courier dedups by req_id)
-        profile = self._profile.pending_for(f"{job_name}:{index}")
-        if profile is not None:
-            resp["profile"] = profile
+        tid = f"{job_name}:{index}"
+        # ONE epoch-lock acquisition capturing (session, drain piggyback)
+        # atomically; the beat itself then lands in the session's lock-free
+        # heartbeat ledger (docs/performance.md "Control-plane scalability").
+        # At thousand-executor fan-in this handler is the AM's hottest path:
+        # it must never serialize behind the monitor loop's whole-gang
+        # snapshots or a second lock round-trip.
         with self._epoch_lock:
+            if attempt != self._restart_attempt:
+                return {"ack": False, "stale": True}
+            session = self.session
             drain = self._drain
-            tid = f"{job_name}:{index}"
+            drain_payload: dict[str, Any] | None = None
             if (
                 drain is not None
                 and tid in drain["targets"]  # only the captured target set:
@@ -509,13 +591,23 @@ class ApplicationMaster:
             ):
                 # urgent-checkpoint fan-out: re-sent until the task's saved
                 # step is reported (the courier dedups by req_id)
-                resp["drain"] = {"req_id": drain["req_id"]}
-            if "drain" not in resp:
+                drain_payload = {"req_id": drain["req_id"]}
+            else:
                 # per-task drain (autoscaler pre-scale-down): same courier
                 # contract, one task only — a gang-wide episode outranks it
                 td = self._task_drains.get(tid)
                 if td is not None and td["step"] is None:
-                    resp["drain"] = {"req_id": td["req_id"]}
+                    drain_payload = {"req_id": td["req_id"]}
+        session.on_heartbeat(job_name, index)
+        resp: dict[str, Any] = {"ack": True}
+        # the AM cannot push to executors, but they knock every heartbeat:
+        # an in-flight capture request rides back on the response until the
+        # task reports a terminal status (the courier dedups by req_id)
+        profile = self._profile.pending_for(tid)
+        if profile is not None:
+            resp["profile"] = profile
+        if drain_payload is not None:
+            resp["drain"] = drain_payload
         return resp
 
     def report_drain_saved(
@@ -1008,8 +1100,10 @@ class ApplicationMaster:
         t0 = time.perf_counter()
         with obs_trace.maybe_span("am.takeover", am_attempt=self.am_attempt):
             try:
+                # streamed, not materialized: a long job's journal may carry
+                # hundreds of thousands of records between compactions
                 state = _replay_am_journal(
-                    read_journal(os.path.join(self.staging_dir, constants.AM_JOURNAL_FILE))
+                    iter_journal(os.path.join(self.staging_dir, constants.AM_JOURNAL_FILE))
                 )
                 self._adopt_state(state)
             except Exception as e:  # noqa: BLE001 — ANY replay fault degrades, never hangs
@@ -1872,9 +1966,11 @@ class ApplicationMaster:
                 break
 
             # 0. externally-requested elastic resize (autoscaler / tony
-            # resize), then hot-spare top-up for the elastic jobtype
+            # resize), then hot-spare top-up for the elastic jobtype, then
+            # (when enabled) takeover-journal compaction
             self._apply_pending_resize()
             self._maintain_spares()
+            self._maybe_compact_journal()
             if self._chaos_step_gated:
                 # progress feed for @step+N-gated container faults: the max
                 # TRAINING step any executor has pushed

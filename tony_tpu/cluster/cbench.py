@@ -1,0 +1,617 @@
+"""Control-plane microbenchmarks: the thousand-node story, measured.
+
+ROADMAP item 4: the simulator proves the scheduler's *decisions* are right at
+1000 arrivals, but nothing measured how FAST the control plane is — scheduler
+decision latency, AM heartbeat fan-in, pool-journal replay, history sweep,
+portal scrape were all unbenchmarked and unguarded. This module is the
+measurement half of that arc: five seeded, in-process, no-TPU benchmarks that
+drive the REAL implementations (the live :class:`PreemptionPolicy`, a live
+:class:`RpcServer` fronting a real :class:`ApplicationMaster`, the real pool
+journal replay, the real ingestion sweep, the real portal ``/metrics`` path)
+and emit one ``CBENCH_r<N>.json`` round the same ``tony bench --gate``
+discipline enforces for MFU and serving throughput (docs/performance.md
+"Control-plane scalability").
+
+Every benchmark is sized by ``tony.cbench.*`` (full-scale defaults: 10k
+queued apps, 1k executors, 100k journal records, 10k finalized jobs, 500
+registered AMs); tier-1 tests run scaled-down sizes asserting the same
+invariants. Every random draw comes from a seed so rounds are comparable.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import random
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, asdict, replace
+from typing import Any
+
+from tony_tpu.cluster.journal import Journal
+from tony_tpu.cluster.policy import AppView, PreemptionPolicy
+from tony_tpu.config import TonyConfig, keys
+from tony_tpu.serve.loadgen import percentile as _percentile_of  # nearest-rank, shared
+
+
+# --------------------------------------------------------------------- sizes
+@dataclass(frozen=True)
+class CbenchSizes:
+    """Benchmark scale (``tony.cbench.*``). The checked-in rounds use the
+    full-scale defaults; tier-1 asserts the same invariants scaled down."""
+
+    apps: int = 10_000            # queued apps in the scheduler bench
+    queues: int = 8               # queues they spread over
+    executors: int = 1_000        # simulated executors knocking the AM
+    heartbeat_seconds: float = 5.0  # sustained-knock window per phase
+    journal_records: int = 100_000  # pool-journal history length
+    journal_live_apps: int = 200  # live apps the replay must rebuild
+    history_jobs: int = 10_000    # finalized fixture jobs the sweep ingests
+    portal_ams: int = 500         # registered AMs the portal scrapes
+    seed: int = 0
+
+    @classmethod
+    def from_config(cls, config: TonyConfig) -> "CbenchSizes":
+        return cls(
+            apps=config.get_int(keys.CBENCH_APPS, 10_000),
+            queues=config.get_int(keys.CBENCH_QUEUES, 8),
+            executors=config.get_int(keys.CBENCH_EXECUTORS, 1_000),
+            heartbeat_seconds=config.get_float(keys.CBENCH_HEARTBEAT_SECONDS, 5.0),
+            journal_records=config.get_int(keys.CBENCH_JOURNAL_RECORDS, 100_000),
+            journal_live_apps=config.get_int(keys.CBENCH_JOURNAL_LIVE_APPS, 200),
+            history_jobs=config.get_int(keys.CBENCH_HISTORY_JOBS, 10_000),
+            portal_ams=config.get_int(keys.CBENCH_PORTAL_AMS, 500),
+            seed=config.get_int(keys.CBENCH_SEED, 0),
+        )
+
+    def scaled(self, factor: float) -> "CbenchSizes":
+        """A proportionally smaller run (tier-1 uses ~1/100 scale)."""
+        return replace(
+            self,
+            apps=max(int(self.apps * factor), 50),
+            executors=max(int(self.executors * factor), 8),
+            heartbeat_seconds=max(self.heartbeat_seconds * factor * 10, 0.5),
+            journal_records=max(int(self.journal_records * factor), 500),
+            journal_live_apps=max(int(self.journal_live_apps * factor), 5),
+            history_jobs=max(int(self.history_jobs * factor), 20),
+            portal_ams=max(int(self.portal_ams * factor), 4),
+        )
+
+
+def _percentile(vals: list[float], q: float) -> float:
+    """Nearest-rank percentile, q in [0, 1] — delegates to the one shared
+    implementation (serve/loadgen.py) so the statistic cannot drift."""
+    return _percentile_of(vals, q * 100.0)
+
+
+# -------------------------------------------------- 1. scheduler decisions
+def _scheduler_world(sizes: CbenchSizes) -> tuple[PreemptionPolicy, list[AppView], tuple[int, int, int]]:
+    """A seeded 10k-app world the policy must re-decide from scratch: ~70% of
+    the primary dimension held by admitted apps, thousands more waiting
+    across every queue with spread priorities and wait ages."""
+    rng = random.Random(sizes.seed)
+    share = int(1.0 / sizes.queues * 1e6) / 1e6  # truncate: sum never exceeds 1
+    queues = {f"q{i}": share for i in range(sizes.queues)}
+    policy = PreemptionPolicy(
+        queues, preemption=True, grace_ms=5_000, min_runtime_ms=10_000,
+        eviction_budget=0,
+    )
+    total_chips = max(sizes.apps // 2, 64)
+    totals = (total_chips << 30, total_chips * 8, total_chips)
+    now = time.monotonic()
+    views: list[AppView] = []
+    held_budget = int(total_chips * 0.7)
+    for i in range(sizes.apps):
+        chips = rng.randint(1, 8)
+        demand = (chips << 30, chips * 2, chips)
+        admitted = held_budget - chips >= 0 and rng.random() < 0.35
+        if admitted:
+            held_budget -= chips
+        views.append(AppView(
+            app_id=f"app_{i:06d}",
+            queue=f"q{rng.randrange(sizes.queues)}",
+            priority=rng.randrange(5),
+            seq=i,
+            demand=demand,
+            held=demand if admitted else (0, 0, 0),
+            admitted=admitted,
+            wait_since=now - rng.uniform(0.0, 600.0),
+            admitted_at=now - rng.uniform(0.0, 1200.0) if admitted else 0.0,
+            elastic_unit=(1 << 30, 2, 1) if rng.random() < 0.2 else (0, 0, 0),
+            elastic_slack=rng.randrange(4),
+        ))
+    return policy, views, totals
+
+
+def bench_scheduler(sizes: CbenchSizes, passes: int = 25) -> dict[str, Any]:
+    """:meth:`PreemptionPolicy.schedule` latency over the seeded world. Each
+    pass re-decides from an identical fresh copy (the policy mutates views in
+    place), so every measurement does the same work. One unmeasured warm-up
+    pass, and the collector is parked during the timed region (a GC cycle
+    over the 10k fresh view objects would land in whichever pass it likes —
+    that is the interpreter's noise, not the policy's tail)."""
+    import gc
+
+    policy, template, totals = _scheduler_world(sizes)
+    times: list[float] = []
+    admitted = 0
+    for i in range(passes + 1):
+        views = [replace(v) for v in template]  # copy cost outside the timer
+        gc.collect()
+        gc.disable()
+        try:
+            t0 = time.perf_counter()
+            decision = policy.schedule(views, totals)
+            dt = time.perf_counter() - t0
+        finally:
+            gc.enable()
+        if i > 0:  # pass 0 is warm-up
+            times.append(dt)
+        admitted = len(decision.admit)
+        policy._charges.clear()  # identical budget state every pass
+    times.sort()
+    total = sum(times)
+    return {
+        "sched_decisions_per_sec": round(passes / total, 3),
+        "sched_decision_p50_ms": round(_percentile(times, 0.50) * 1000, 3),
+        "sched_decision_p99_ms": round(_percentile(times, 0.99) * 1000, 3),
+        "sched_admitted_per_pass": admitted,
+    }
+
+
+# ------------------------------------------------- 2. AM heartbeat fan-in
+def _bench_am(sizes: CbenchSizes, staging_dir: str):
+    """A real :class:`ApplicationMaster` with ``executors`` registered tasks
+    serving its RPC surface — exactly the process a thousand-node gang
+    knocks, minus containers (no TPUs, no children)."""
+    from tony_tpu.cluster.appmaster import ApplicationMaster
+    from tony_tpu.cluster.rpc import APPLICATION_RPC_METHODS
+
+    config = TonyConfig({
+        keys.APPLICATION_FRAMEWORK: "generic",
+        keys.jobtype_key("worker", keys.INSTANCES_SUFFIX): str(sizes.executors),
+        keys.AM_TAKEOVER_ENABLED: "false",   # no journal noise in the timing
+        keys.GOODPUT_ENABLED: "false",
+        keys.LOG_LEVEL: "error",
+    })
+    am = ApplicationMaster(config, "cbench_hb", staging_dir)
+    for i in range(sizes.executors):
+        am.register_worker_spec("worker", i, "127.0.0.1", 20_000 + i)
+    # arm an on-demand capture so every heartbeat response exercises the real
+    # piggyback-courier path (profile request riding back until reported)
+    am.start_profile(steps=1)
+    am.rpc.register_object(am, APPLICATION_RPC_METHODS)
+    am.rpc.start()
+    return am
+
+
+def _knock(am, sizes: CbenchSizes, duration_s: float, threads: int) -> list[float]:
+    """``threads`` persistent RPC clients round-robin the executor identities
+    against ``task_executor_heartbeat`` for ``duration_s``; returns every
+    call's client-observed latency."""
+    from tony_tpu.cluster.rpc import RpcClient
+
+    host, port = am.rpc.address
+    lat: list[list[float]] = [[] for _ in range(threads)]
+    errors: list[BaseException] = []
+    stop = time.monotonic() + duration_s
+
+    def worker(slot: int) -> None:
+        cli = RpcClient(host, port, secret=am.secret, timeout_s=10.0)
+        ids = range(slot, sizes.executors, threads)
+        try:
+            while time.monotonic() < stop:
+                for idx in ids:
+                    t0 = time.perf_counter()
+                    cli.call("task_executor_heartbeat",
+                             job_name="worker", index=idx, attempt=0)
+                    lat[slot].append(time.perf_counter() - t0)
+                    if time.monotonic() >= stop:
+                        break
+        except BaseException as e:  # noqa: BLE001 — re-raised on the bench thread
+            errors.append(e)
+        finally:
+            cli.close()
+
+    ts = [threading.Thread(target=worker, args=(i,), daemon=True) for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errors:
+        # a dead knocker would silently truncate the sample and publish an
+        # under-reported gated record — a benchmark against a healthy
+        # in-process AM must fail loudly instead
+        raise RuntimeError(
+            f"{len(errors)}/{threads} heartbeat knocker(s) died: {errors[0]!r}"
+        ) from errors[0]
+    return [v for per in lat for v in per]
+
+
+def bench_heartbeats(sizes: CbenchSizes, workdir: str, threads: int = 4) -> dict[str, Any]:
+    """Sustained heartbeat fan-in against a live AM, twice: once quiet, once
+    with a churn thread doing exactly what the monitor loop does every tick
+    (full task-info snapshots + liveness scans). The churn phase is the
+    epoch-lock/session-lock decoupling's proof: handler p99 must not move.
+
+    The executor identities round-robin over a few persistent connections
+    rather than one thread each: past the core count, extra CPython client
+    threads convoy on the GIL and the benchmark measures the interpreter's
+    scheduler instead of the AM's handler."""
+    threads = min(threads, max(sizes.executors, 1))
+    staging = os.path.join(workdir, "hb_staging")
+    os.makedirs(staging, exist_ok=True)
+    am = _bench_am(sizes, staging)
+    try:
+        quiet = sorted(_knock(am, sizes, sizes.heartbeat_seconds, threads))
+        churn_stop = threading.Event()
+
+        def churn() -> None:
+            # the monitor loop's work at ~10x its production cadence (the
+            # real loop ticks every tony.am.monitor-interval-ms=200ms): each
+            # iteration holds the session lock for a whole-gang snapshot +
+            # liveness scan. The sleep keeps this a LOCK-contention probe —
+            # a spin loop would just measure two threads fighting the GIL.
+            while not churn_stop.is_set():
+                am.session.task_infos()
+                am.session.find_dead_tasks(1000, 25)
+                time.sleep(0.02)
+
+        churner = threading.Thread(target=churn, daemon=True)
+        churner.start()
+        try:
+            churned = sorted(_knock(am, sizes, sizes.heartbeat_seconds, threads))
+        finally:
+            churn_stop.set()
+            churner.join()
+    finally:
+        am.rpc.stop()
+    return {
+        "heartbeats_per_sec": round(len(quiet) / sizes.heartbeat_seconds, 1),
+        "heartbeat_p50_ms": round(_percentile(quiet, 0.50) * 1000, 3),
+        "heartbeat_p99_ms": round(_percentile(quiet, 0.99) * 1000, 3),
+        "heartbeat_churn_p99_ms": round(_percentile(churned, 0.99) * 1000, 3),
+    }
+
+
+# ------------------------------------------------ 3. pool-journal replay
+def write_pool_history(
+    path: str, records: int, live_apps: int, seed: int,
+    compact_every: int = 0,
+) -> int:
+    """A seeded pool journal: ``live_apps`` long-lived apps (each holding one
+    container an agent has confirmed live), then app-lifecycle churn —
+    register → allocate → exit → deliver → release → leave — until the
+    history totals ``records`` appends. Returns the append count.
+
+    With ``compact_every`` > 0 the writer folds the live state into a
+    snapshot record and rotates at that cadence — the same code path the
+    pool service itself uses (``tony.pool.journal.compact-every``) — so the
+    on-disk journal stays O(live state) however long the history.
+    """
+    rng = random.Random(seed)
+    journal = Journal(path)
+    shadow = _PoolShadow()
+    written = 0
+    seq = 0
+
+    def emit(t: str, **fields: Any) -> None:
+        nonlocal written
+        journal.append(t, **fields)
+        shadow.fold(t, fields)
+        written += 1
+        if compact_every > 0 and journal.appends_since_compact >= compact_every:
+            journal.compact(shadow.snapshot_records())
+
+    def app_row(app_id: str, admitted: bool) -> dict[str, Any]:
+        nonlocal seq
+        seq += 1
+        return dict(
+            app_id=app_id, queue="default", priority=rng.randrange(3),
+            seq=seq, admitted=admitted, preempted=False,
+            demand_memory=1 << 30, demand_vcores=2, demand_chips=1,
+            wait_unix=time.time(), admitted_unix=time.time() if admitted else 0.0,
+            elastic_unit=[0, 0, 0], elastic_slack=0,
+        )
+
+    def container_rec(cid: str, app_id: str) -> dict[str, Any]:
+        return dict(
+            id=cid, app_id=app_id, job_type="worker",
+            task_index=0, node=f"node{rng.randrange(16)}",
+            memory_bytes=1 << 30, vcores=2,
+            chips=[[0, rng.randrange(4)]], slice_id=0, state="RUNNING",
+        )
+
+    for i in range(live_apps):
+        app_id = f"live_{i:05d}"
+        emit("app", **app_row(app_id, admitted=True))
+        emit("container", rec=container_rec(f"container_live_{i:05d}", app_id))
+        emit("seen", cid=f"container_live_{i:05d}")
+    i = 0
+    while written < records:
+        app_id = f"churn_{i:07d}"
+        cid = f"container_churn_{i:07d}"
+        emit("app", **app_row(app_id, admitted=True))
+        emit("container", rec=container_rec(cid, app_id))
+        emit("seen", cid=cid)
+        emit("exited", cid=cid, rc=0)
+        emit("polled", app_id=app_id)
+        emit("released", cid=cid)
+        emit("app_removed", app_id=app_id)
+        i += 1
+    journal.close()
+    return written
+
+
+class _PoolShadow:
+    """Folds the synthetic history exactly the way pool replay does, so the
+    generator can hand :meth:`Journal.compact` the same snapshot-record
+    vocabulary :meth:`PoolService._snapshot_records_locked` produces."""
+
+    def __init__(self) -> None:
+        self.apps: dict[str, dict[str, Any]] = {}
+        self.containers: dict[str, dict[str, Any]] = {}
+        self.exits: dict[str, dict[str, int]] = {}
+
+    def fold(self, t: str, fields: dict[str, Any]) -> None:
+        if t == "app":
+            self.apps[fields["app_id"]] = dict(fields)
+        elif t == "app_removed":
+            self.apps.pop(fields["app_id"], None)
+            self.exits.pop(fields["app_id"], None)
+        elif t == "container":
+            self.containers[fields["rec"]["id"]] = dict(fields["rec"])
+        elif t == "seen":
+            rec = self.containers.get(fields["cid"])
+            if rec is not None:
+                rec["seen_live"] = True
+        elif t == "exited":
+            rec = self.containers.get(fields["cid"])
+            if rec is not None and rec["state"] == "RUNNING":
+                rec["state"] = "EXITED"
+                self.exits.setdefault(rec["app_id"], {})[rec["id"]] = fields["rc"]
+        elif t == "polled":
+            self.exits.pop(fields["app_id"], None)
+        elif t == "released":
+            self.containers.pop(fields["cid"], None)
+
+    def snapshot_records(self) -> list[dict[str, Any]]:
+        out: list[dict[str, Any]] = []
+        for fields in self.apps.values():
+            out.append({"t": "app", **fields})
+        for rec in self.containers.values():
+            pending = self.exits.get(rec["app_id"], {}).get(rec["id"])
+            body = {k: v for k, v in rec.items() if k != "seen_live"}
+            if pending is not None:
+                body["state"] = "RUNNING"
+            out.append({"t": "container", "rec": body})
+            if rec.get("seen_live"):
+                out.append({"t": "seen", "cid": rec["id"]})
+            if pending is not None:
+                out.append({"t": "exited", "cid": rec["id"], "rc": pending})
+        return out
+
+
+def bench_journal_replay(sizes: CbenchSizes, workdir: str) -> dict[str, Any]:
+    """Pool restart cost: wall time for a fresh :class:`PoolService` to
+    recover the seeded ``journal_records``-append history. Compaction keeps
+    the on-disk file O(live state); the benchmark reports both the replay
+    wall and the file's record count so the gate can watch each."""
+    from tony_tpu.cluster.pool import PoolService
+
+    path = os.path.join(workdir, "pool_journal.jsonl")
+    write_pool_history(
+        path, sizes.journal_records, sizes.journal_live_apps, sizes.seed,
+        compact_every=5_000,
+    )
+    with open(path, encoding="utf-8") as f:
+        file_records = sum(1 for line in f if line.strip())
+    t0 = time.perf_counter()
+    svc = PoolService(journal_path=path, port=0)
+    replay_s = time.perf_counter() - t0
+    live = len(svc._apps)
+    svc.stop()
+    return {
+        "journal_replay_ms": round(replay_s * 1000, 3),
+        "journal_records_per_sec": round(sizes.journal_records / replay_s, 1),
+        "journal_file_records": file_records,
+        "journal_live_apps": live,
+    }
+
+
+# ------------------------------------------------- 4. history-server sweep
+def make_history_fixtures(staging_root: str, jobs: int, seed: int) -> None:
+    """``jobs`` minimal finalized fixture jobs under ``staging_root``: a
+    finished ``.jhist`` (APPLICATION_FINISHED + one metrics snapshot) in the
+    real ``finished/yyyy/MM/dd/<app>/`` layout."""
+    from tony_tpu.cluster import history as cluster_history
+
+    rng = random.Random(seed)
+    hist_root = os.path.join(staging_root, "history")
+    now_ms = int(time.time() * 1000)
+    for i in range(jobs):
+        app_id = f"bench_job_{i:06d}"
+        completed = now_ms - rng.randrange(86_400_000)
+        started = completed - rng.randrange(600_000)
+        d = cluster_history.finished_dir(hist_root, app_id, completed)
+        os.makedirs(d, exist_ok=True)
+        name = cluster_history.HistoryFileName(
+            app_id, started, completed, "bench", "SUCCEEDED").render()
+        events = [
+            {"type": "APPLICATION_INITED", "timestamp_ms": started,
+             "payload": {"app_id": app_id, "job_types": {"worker": 1}}},
+            {"type": "METRICS_SNAPSHOT", "timestamp_ms": started + 1000,
+             "payload": {"tasks": [{"task": "worker:0", "metrics": {"train": {
+                 "loss": round(rng.uniform(1.0, 4.0), 4),
+                 "tokens_per_sec": round(rng.uniform(1e3, 1e5), 1),
+                 "step": 10}}}]}},
+            {"type": "APPLICATION_FINISHED", "timestamp_ms": completed,
+             "payload": {"status": "SUCCEEDED", "reason": None,
+                         "tasks": [{"name": "worker", "index": 0,
+                                    "status": "SUCCEEDED", "exit_code": 0}]}},
+        ]
+        with open(os.path.join(d, name), "w", encoding="utf-8") as f:
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+
+
+def bench_history_sweep(sizes: CbenchSizes, workdir: str) -> dict[str, Any]:
+    """One full ingestion sweep over ``history_jobs`` finalized fixture jobs
+    (jobs/sec), then the unchanged re-sweep — the cost a deployment pays
+    every ``tony.history.scan-interval-ms`` forever after."""
+    from tony_tpu.histserver.ingest import sweep
+    from tony_tpu.histserver.store import HistoryStore
+
+    staging_root = os.path.join(workdir, "sweep_staging")
+    os.makedirs(staging_root, exist_ok=True)
+    make_history_fixtures(staging_root, sizes.history_jobs, sizes.seed)
+    store = HistoryStore(os.path.join(workdir, "sweep_history.sqlite"))
+    try:
+        t0 = time.perf_counter()
+        counts = sweep(store, [staging_root])
+        sweep_s = time.perf_counter() - t0
+        if counts["ingested"] != sizes.history_jobs or counts["errors"]:
+            raise RuntimeError(f"sweep did not ingest cleanly: {counts}")
+        t0 = time.perf_counter()
+        counts2 = sweep(store, [staging_root])
+        resweep_s = time.perf_counter() - t0
+        if counts2["unchanged"] != sizes.history_jobs:
+            raise RuntimeError(f"re-sweep did not converge: {counts2}")
+    finally:
+        store.close()
+    return {
+        "sweep_jobs_per_sec": round(sizes.history_jobs / sweep_s, 1),
+        "sweep_ms": round(sweep_s * 1000, 1),
+        "resweep_ms": round(resweep_s * 1000, 1),
+    }
+
+
+# --------------------------------------------------- 5. portal scrape
+def bench_portal_scrape(
+    sizes: CbenchSizes, workdir: str, stub_servers: int = 8, scrapes: int = 3,
+) -> dict[str, Any]:
+    """The portal's ``/metrics`` exposition with ``portal_ams`` running AMs
+    registered: every app has an intermediate ``.jhist`` (the RUNNING list)
+    and an ``am_info.json`` pointing at a live stub ``get_metrics`` endpoint.
+    Reports the first (cold) scrape and the repeat — with the O(changed)
+    scrape cache enabled the repeat serves cached groups with an age label
+    instead of re-knocking 500 AMs."""
+    from tony_tpu import constants
+    from tony_tpu.cluster.rpc import RpcServer
+    from tony_tpu.obs import metrics as obs_metrics
+    from tony_tpu.portal import server as portal_server
+
+    staging = os.path.join(workdir, "portal_staging")
+    hist_root = os.path.join(staging, "history")
+    inter = os.path.join(hist_root, constants.HISTORY_INTERMEDIATE_DIR)
+    os.makedirs(inter, exist_ok=True)
+    snapshot = [e for e in obs_metrics.REGISTRY.snapshot() if e["samples"]][:8]
+    servers: list[RpcServer] = []
+    for _ in range(min(stub_servers, max(sizes.portal_ams, 1))):
+        srv = RpcServer(port=0, secret="cbench")
+        srv.register("get_metrics", lambda snap=snapshot: {
+            "identity": "am", "metrics": snap, "tasks": {}})
+        srv.start()
+        servers.append(srv)
+    try:
+        for i in range(sizes.portal_ams):
+            app_id = f"bench_am_{i:04d}"
+            host, port = servers[i % len(servers)].address
+            d = os.path.join(staging, app_id)
+            os.makedirs(d, exist_ok=True)
+            info_path = os.path.join(d, constants.AM_INFO_FILE)
+            tmp = info_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"host": host, "port": port, "secret": "cbench"}, f)
+            os.replace(tmp, info_path)
+            with open(os.path.join(inter, app_id + constants.HISTORY_SUFFIX), "w") as f:
+                f.write("")
+        httpd = portal_server.serve(
+            hist_root, 0, staging_root=staging,
+            scrape_ttl_ms=60_000,  # the O(changed) cache under measurement
+        )
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}/metrics"
+            times: list[float] = []
+            body = b""
+            for _ in range(max(scrapes, 2)):
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(url, timeout=120) as resp:
+                    body = resp.read()
+                times.append(time.perf_counter() - t0)
+            if not body:
+                raise RuntimeError("portal scrape returned an empty exposition")
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            thread.join()
+    finally:
+        for srv in servers:
+            srv.stop()
+    rescrape_s = min(times[1:])
+    return {
+        "portal_scrape_ms": round(times[0] * 1000, 3),
+        "portal_rescrape_ms": round(rescrape_s * 1000, 3),
+        "portal_ams_per_sec": round(sizes.portal_ams / rescrape_s, 1),
+    }
+
+
+# ------------------------------------------------------------- composition
+#: (record key, benchmark fn) of the five microbenchmarks, in run order
+BENCHMARKS = (
+    ("scheduler", bench_scheduler),
+    ("heartbeats", bench_heartbeats),
+    ("journal", bench_journal_replay),
+    ("sweep", bench_history_sweep),
+    ("portal", bench_portal_scrape),
+)
+
+#: parsed-record throughputs the headline composes (geometric mean): one
+#: per benchmark, all higher-is-better
+HEADLINE_COMPONENTS = (
+    "sched_decisions_per_sec",
+    "heartbeats_per_sec",
+    "journal_records_per_sec",
+    "sweep_jobs_per_sec",
+    "portal_ams_per_sec",
+)
+
+
+def run_all(sizes: CbenchSizes, workdir: str, log=print) -> dict[str, Any]:
+    """All five benchmarks → one parsed CBENCH record. The headline ``value``
+    is the geometric mean of the five per-benchmark throughputs ("weighted
+    decisions/sec"): any control-plane path regressing drags it down, and no
+    single huge number can mask a slow one."""
+    parsed: dict[str, Any] = {}
+    for name, fn in BENCHMARKS:
+        t0 = time.perf_counter()
+        if fn is bench_scheduler:
+            result = fn(sizes)
+        else:
+            result = fn(sizes, workdir)
+        parsed.update(result)
+        log(f"[tony-cbench] {name}: "
+            + ", ".join(f"{k}={v}" for k, v in result.items())
+            + f" ({time.perf_counter() - t0:.1f}s)")
+    value = math.exp(
+        sum(math.log(max(float(parsed[k]), 1e-9)) for k in HEADLINE_COMPONENTS)
+        / len(HEADLINE_COMPONENTS)
+    )
+    parsed.update(
+        metric="control_plane_ops_per_sec",
+        value=round(value, 2),
+        unit="ops/s",
+        sizes=asdict(sizes),
+    )
+    return parsed
+
+
+def wrap_record(parsed: dict[str, Any], round_n: int, baseline: float | None) -> dict[str, Any]:
+    """The ``CBENCH_r<N>.json`` wrapper (same shape the gate enforces for
+    every family). ``baseline`` is round 1's headline value; None → 1.0x."""
+    vs = parsed["value"] / baseline if baseline else 1.0
+    return {"n": round_n, "rc": 0, "parsed": {**parsed, "vs_baseline": round(vs, 4)}}

@@ -480,8 +480,30 @@ class TaskExecutor:
     def _heartbeat_loop(self) -> None:
         interval = self.config.get_time_ms(keys.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000
         max_missed = self.config.get_int(keys.TASK_MAX_MISSED_HEARTBEATS, 25)
+        # interval backoff (tony.heartbeat.backoff-*): a thousand-executor
+        # gang launched together beats in lockstep — every interval, one
+        # synchronized knock wave hits the AM's RPC server. A per-task
+        # seeded jitter de-phases the waves. A stretched gap can span up to
+        # (1 + pct) intervals, so between beats the AM's missed counter
+        # peaks up to pct intervals higher than without jitter — keep pct
+        # well under max-missed (trivial at the defaults: 0.25 vs 25).
+        # Off by default.
+        jitter_rng = None
+        jitter_pct = 0.0
+        if self.config.get_bool(keys.HEARTBEAT_BACKOFF_ENABLED):
+            import random
+
+            jitter_pct = max(
+                self.config.get_float(keys.HEARTBEAT_BACKOFF_JITTER_PCT, 0.25), 0.0)
+            jitter_rng = random.Random(f"{self.app_id}:{self.job_name}:{self.index}")
+
+        def wait_s() -> float:
+            if jitter_rng is None:
+                return interval
+            return interval * (1.0 + jitter_rng.uniform(0.0, jitter_pct))
+
         stalled = False  # chaos hb-stall: a wedged executor — alive but silent
-        while not self._stop.wait(interval):
+        while not self._stop.wait(wait_s()):
             if not stalled and self.chaos is not None and self.chaos.take("hb-stall") is not None:
                 stalled = True
             if stalled:

@@ -18,7 +18,18 @@ adopts the live work instead of rebuilding it (docs/fault-tolerance.md
   empty) instead of adopting fiction.
 
 Record shape: ``{"t": "<type>", ...fields}``. The record vocabulary is owned
-by the writer (appmaster.py / pool.py); this module only knows lines.
+by the writer (appmaster.py / pool.py); this module only knows lines — with
+ONE mechanical exception, incremental compaction (docs/performance.md
+"Control-plane scalability"): :meth:`Journal.compact` folds the caller's
+live state into a single ``{"t": "snapshot", "records": [...]}`` record and
+rotates the file down to it, so restart replay is O(live state), not
+O(everything that ever happened). The snapshot's embedded records use the
+writer's own vocabulary, and the writer's replay resets its accumulated
+state when it meets one — replay-with-snapshot is therefore equivalent to
+replay-without by the writer's own folding rules (asserted property-style in
+tests). A reader that predates snapshots fails loudly on the unknown record
+type and degrades, exactly the contract for any journal written by a newer
+tony.
 """
 
 from __future__ import annotations
@@ -26,7 +37,16 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Any
+from typing import Any, Iterator
+
+from tony_tpu.obs import metrics as _metrics
+
+#: the one record type this module owns: compaction's folded-state carrier
+SNAPSHOT_RECORD = "snapshot"
+
+_COMPACTIONS = _metrics.counter(
+    "tony_journal_compactions_total",
+    "journal snapshot+rotate compactions (pool and AM takeover journals)")
 
 
 class JournalError(RuntimeError):
@@ -50,26 +70,109 @@ class Journal:
         self._f = open(path, "a", encoding="utf-8")
         self._lock = threading.Lock()
         self._failed = False
+        #: appends since the last :meth:`compact` (or open) — the writer's
+        #: compaction trigger (``tony.{pool,am}.journal.compact-every``)
+        self.appends_since_compact = 0
+        #: lifetime successful appends — :meth:`compact`'s optimistic
+        #: concurrency token for writers whose appends are NOT all serialized
+        #: under one state lock (the AM)
+        self.total_appends = 0
 
     def append(self, t: str, **fields: Any) -> None:
         line = json.dumps({"t": t, **fields}, sort_keys=True)
         with self._lock:
-            try:
-                self._f.write(line + "\n")
-                self._f.flush()
-                os.fsync(self._f.fileno())
-                self._failed = False
-            except (OSError, ValueError):
-                # ValueError: closed file (late append during teardown races)
-                if not self._failed:
-                    # once per failure streak — a full disk must be VISIBLE
-                    # (the next takeover will degrade on this journal)
-                    from tony_tpu.obs import logging as obs_logging
+            if self._append_line_locked(line):
+                self.appends_since_compact += 1
+                self.total_appends += 1
 
-                    obs_logging.warning(
-                        f"[tony-journal] append to {self.path} failed — a "
-                        "successor's recovery from this journal may degrade")
-                self._failed = True
+    def _append_line_locked(self, line: str) -> bool:
+        try:
+            self._f.write(line + "\n")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._failed = False
+            return True
+        except (OSError, ValueError):
+            # ValueError: closed file (late append during teardown races)
+            if not self._failed:
+                # once per failure streak — a full disk must be VISIBLE
+                # (the next takeover will degrade on this journal)
+                from tony_tpu.obs import logging as obs_logging
+
+                obs_logging.warning(
+                    f"[tony-journal] append to {self.path} failed — a "
+                    "successor's recovery from this journal may degrade")
+            self._failed = True
+            return False
+
+    def compact(self, records: list[dict[str, Any]],
+                expected_total: int | None = None) -> bool:
+        """Fold the caller's live state into one durable snapshot record,
+        then rotate the file down to just that record.
+
+        ``expected_total`` is the optimistic-concurrency token for writers
+        whose appends are not all serialized under one state lock (the AM:
+        RPC handlers journal without the monitor loop's locks): pass
+        :attr:`total_appends` as read BEFORE building ``records``, and the
+        compaction is skipped (returns False, nothing written) if any append
+        landed since — an interleaved record would otherwise sort before the
+        stale snapshot and be silently discarded by the replay barrier. The
+        caller simply retries on a later tick. Writers that hold their state
+        lock across build+compact (the pool) pass None.
+
+        Two-phase, each safe to die in:
+
+        1. APPEND ``{"t": "snapshot", "records": [...]}`` with the same
+           flush+fsync contract as any record. From this instant replay
+           resets at the snapshot; a SIGKILL tearing this very append
+           leaves a torn FINAL line the reader silently drops — recovery
+           falls back to the intact pre-snapshot tail, never a
+           half-applied snapshot.
+        2. Rewrite the file to only that line (write-tmp → fsync → atomic
+           replace) and swap the append handle. A crash anywhere here
+           leaves either the old file (snapshot appended at its tail) or
+           the rotated one — both replay to the identical state; failure
+           only costs disk space, so it is best-effort like append.
+
+        Holds the journal lock throughout: records appended concurrently
+        land strictly before the snapshot (folded into the caller's state
+        it captured under its own lock) or strictly after rotation.
+        """
+        line = json.dumps(
+            {"t": SNAPSHOT_RECORD, "records": records}, sort_keys=True)
+        with self._lock:
+            if expected_total is not None and self.total_appends != expected_total:
+                return False  # an append raced the snapshot build: stale
+            if not self._append_line_locked(line):
+                # degraded sink (disk full): re-arm the cadence instead of
+                # leaving the trigger latched — otherwise EVERY subsequent
+                # journaled transition would rebuild + serialize the whole
+                # live state under the writer's lock, turning the exact
+                # failure mode the best-effort journal is meant to ride out
+                # cheaply into an O(state)-per-append stall
+                self.appends_since_compact = 0
+                return False
+            # replay is O(live) from here even if rotation fails below
+            self.appends_since_compact = 0
+            _COMPACTIONS.inc()
+            tmp = self.path + ".compact.tmp"
+            try:
+                with open(tmp, "w", encoding="utf-8") as tf:
+                    tf.write(line + "\n")
+                    tf.flush()
+                    os.fsync(tf.fileno())
+                os.replace(tmp, self.path)
+            except OSError:
+                return True  # snapshot durable; rotation skipped (space only)
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            try:
+                self._f = open(self.path, "a", encoding="utf-8")
+            except OSError:
+                self._failed = True  # further appends will warn + no-op
+            return True
 
     def close(self) -> None:
         with self._lock:
@@ -79,35 +182,63 @@ class Journal:
                 pass
 
 
-def read_journal(path: str) -> list[dict[str, Any]]:
-    """Every intact record, in append order.
+def _parse_record(lineno: int, line: str, path: str, final: bool) -> dict[str, Any] | None:
+    try:
+        rec = json.loads(line)
+        if not isinstance(rec, dict) or "t" not in rec:
+            raise ValueError("not a journal record")
+    except ValueError as e:
+        if final:
+            return None  # torn tail: the crash interrupted this very append
+        raise JournalError(
+            f"corrupt journal record at line {lineno} of {path}: {e}"
+        ) from None
+    return rec
 
-    Raises :class:`JournalError` when the journal is missing/empty or has an
+
+def iter_journal(path: str) -> Iterator[dict[str, Any]]:
+    """Every intact record, in append order, streamed one line at a time —
+    memory stays flat however long the history (the pool/AM replay loops
+    fold 100k-record journals without materializing them).
+
+    Same contract as :func:`read_journal`, raised lazily during iteration:
+    :class:`JournalError` when the journal is missing/empty or has an
     unparseable record anywhere before the final line; an unparseable FINAL
     record (the predecessor was SIGKILLed mid-append) is silently dropped —
-    its transition never became durable.
+    its transition never became durable. Consumers folding incrementally
+    must treat ANY raise as a degraded journal (both replay paths already
+    rebuild from scratch on any fault).
     """
     if not os.path.exists(path):
         raise JournalError(f"journal missing: {path}")
     try:
-        with open(path, encoding="utf-8", errors="replace") as f:
-            lines = f.read().split("\n")
+        f = open(path, encoding="utf-8", errors="replace")
     except OSError as e:
         raise JournalError(f"journal unreadable: {e}") from e
-    body = [(i, ln) for i, ln in enumerate(lines) if ln.strip()]
-    records: list[dict[str, Any]] = []
-    for pos, (lineno, line) in enumerate(body):
+    yielded = False
+    with f:
+        prev: tuple[int, str] | None = None
         try:
-            rec = json.loads(line)
-            if not isinstance(rec, dict) or "t" not in rec:
-                raise ValueError("not a journal record")
-        except ValueError as e:
-            if pos == len(body) - 1:
-                break  # torn tail: the crash interrupted this very append
-            raise JournalError(
-                f"corrupt journal record at line {lineno + 1} of {path}: {e}"
-            ) from None
-        records.append(rec)
-    if not records:
+            for lineno, line in enumerate(f, start=1):
+                if not line.strip():
+                    continue
+                if prev is not None:
+                    yield _parse_record(prev[0], prev[1], path, final=False)  # type: ignore[misc]
+                    yielded = True
+                prev = (lineno, line)
+        except OSError as e:
+            raise JournalError(f"journal unreadable: {e}") from e
+        if prev is not None:
+            rec = _parse_record(prev[0], prev[1], path, final=True)
+            if rec is not None:
+                yield rec
+                yielded = True
+    if not yielded:
         raise JournalError(f"journal empty: {path}")
-    return records
+
+
+def read_journal(path: str) -> list[dict[str, Any]]:
+    """Every intact record, in append order, as one list (thin wrapper over
+    :func:`iter_journal` for callers that want the whole history; the
+    replay loops stream instead)."""
+    return list(iter_journal(path))

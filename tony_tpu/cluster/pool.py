@@ -43,7 +43,12 @@ from typing import Any
 
 from tony_tpu import constants
 from tony_tpu.obs import logging as obs_logging
-from tony_tpu.cluster.journal import Journal, JournalError, read_journal
+from tony_tpu.cluster.journal import (
+    SNAPSHOT_RECORD,
+    Journal,
+    JournalError,
+    iter_journal,
+)
 from tony_tpu.cluster.policy import (
     AppView,
     PreemptionPolicy,
@@ -231,6 +236,7 @@ class PoolService:
         preemption_budget: int = 0,
         preemption_budget_window_ms: int = 60_000,
         journal_path: str | None = None,
+        journal_compact_every: int = 0,
         chaos=None,
     ):
         self.heartbeat_interval_ms = heartbeat_interval_ms
@@ -275,12 +281,19 @@ class PoolService:
         # admissions, and allocations are journaled so a restarted pool
         # rebuilds its queue state and re-adopts live containers from agent
         # re-registration instead of forgetting every admitted app
+        # incremental compaction (tony.pool.journal.compact-every): once this
+        # many records pile up past the last snapshot, the live state is
+        # folded into one snapshot record and the file rotates — replay is
+        # O(live state), not O(history). 0 keeps append-forever.
+        self._journal_compact_every = max(int(journal_compact_every), 0)
         self._journal: Journal | None = None
         if journal_path:
             if os.path.exists(journal_path):
                 try:
                     with self._lock:
-                        self._recover_from_journal_locked(read_journal(journal_path))
+                        # streamed: a 100k-record history folds record by
+                        # record without ever materializing as a list
+                        self._recover_from_journal_locked(iter_journal(journal_path))
                     obs_logging.info(
                         f"[tony-pool] recovered from journal: "
                         f"{len(self._apps)} app(s), "
@@ -296,6 +309,7 @@ class PoolService:
                         self._apps = {}
                         self._containers = {}
                         self._app_exits = {}
+                        self._drains = {}
                         self._app_seq = itertools.count()
             self._journal = Journal(journal_path)
         self.rpc = RpcServer(host=bind_host, port=port, secret=secret)
@@ -304,8 +318,87 @@ class PoolService:
 
     # ------------------------------------------------------ recovery journal
     def _jlog_locked(self, t: str, **fields: Any) -> None:
-        if self._journal is not None:
-            self._journal.append(t, **fields)
+        if self._journal is None:
+            return
+        self._journal.append(t, **fields)
+        if (
+            self._journal_compact_every > 0
+            and self._journal.appends_since_compact >= self._journal_compact_every
+        ):
+            # fold live state into a snapshot + rotate (docs/performance.md
+            # "Control-plane scalability"): safe here because every _jlog
+            # caller already holds self._lock, so the snapshot is consistent
+            # with the record just appended. Deliberately inline rather than
+            # deferred to the liveness loop: the compact would hold this same
+            # lock wherever it ran, so concurrent RPCs stall identically
+            # either way — unlike the AM, where deferral to the monitor loop
+            # is about lock ORDER (RPC handlers journal without the epoch
+            # lock), not latency. Cost is amortized: O(live state) + two
+            # fsyncs once per compact-every appends, tuned by the operator.
+            self._journal.compact(self._snapshot_records_locked())
+
+    def _snapshot_records_locked(self) -> list[dict[str, Any]]:
+        """The live state as replayable records (the journal's own
+        vocabulary): app rows, container records (+ their seen/kill flags),
+        undelivered exits, in-flight drains. History that no longer matters
+        — released containers, removed apps, delivered exits — is exactly
+        what compaction sheds. Replaying [snapshot] is equivalent to
+        replaying the full history it folds (asserted property-style in
+        tests/test_pool.py)."""
+        now_mono, now_unix = time.monotonic(), time.time()
+        recs: list[dict[str, Any]] = []
+        for app in self._apps.values():
+            recs.append({
+                "t": "app", "app_id": app.app_id, "queue": app.queue,
+                "priority": app.priority, "seq": app.seq,
+                "admitted": app.admitted, "preempted": app.preempted,
+                "demand_memory": app.demand_memory,
+                "demand_vcores": app.demand_vcores,
+                "demand_chips": app.demand_chips,
+                "wait_unix": app.wait_unix, "admitted_unix": app.admitted_unix,
+                "elastic_unit": list(app.elastic_unit),
+                "elastic_slack": app.elastic_slack,
+            })
+        for cid, rec in self._containers.items():
+            pending = self._app_exits.get(rec["app_id"], {}).get(cid)
+            body = {k: v for k, v in rec.items()
+                    if k not in ("seen_live", "kill_requested")}
+            if pending is not None:
+                body["state"] = _RUNNING  # the exited record below re-applies it
+            recs.append({"t": "container", "rec": body})
+            if rec.get("seen_live"):
+                recs.append({"t": "seen", "cid": cid})
+            if rec.get("kill_requested"):
+                recs.append({"t": "kill_requested", "cid": cid})
+            if pending is not None:
+                recs.append({"t": "exited", "cid": cid, "rc": int(pending)})
+        # undelivered exits whose container was already released: replay
+        # needs the container row to exist when the exit lands, then drops it
+        for app_id, exits in self._app_exits.items():
+            for cid, rc in exits.items():
+                if cid in self._containers:
+                    continue
+                recs.append({"t": "container", "rec": {
+                    "id": cid, "app_id": app_id, "job_type": "",
+                    "task_index": 0, "node": "", "memory_bytes": 0,
+                    "vcores": 0, "chips": [], "slice_id": -1,
+                    "state": _RUNNING,
+                }})
+                recs.append({"t": "exited", "cid": cid, "rc": int(rc)})
+                recs.append({"t": "released", "cid": cid})
+        for app_id, entry in self._drains.items():
+            rec = {
+                "t": "drain", "app_id": app_id, "req_id": entry["req_id"],
+                "mode": entry["mode"], "workers": entry["workers"],
+                "target_primary": entry.get("target_primary", 0),
+                "undo_demand": [int(x) for x in (entry.get("undo_demand") or (0, 0, 0))],
+                "deadline_unix": now_unix + (entry["deadline"] - now_mono),
+                "t0_unix": now_unix + (entry["t0"] - now_mono),
+            }
+            if entry.get("reduced_demand"):
+                rec["reduced_demand"] = [int(x) for x in entry["reduced_demand"]]
+            recs.append(rec)
+        return recs
 
     def _journal_app_locked(self, app: _App) -> None:
         """Full app row (last record wins on replay) — written on every
@@ -323,13 +416,19 @@ class PoolService:
             elastic_unit=list(app.elastic_unit), elastic_slack=app.elastic_slack,
         )
 
-    def _recover_from_journal_locked(self, records: list[dict[str, Any]]) -> None:
-        """Rebuild apps/containers/undelivered-exits from the journal. Nodes
-        are runtime state: they re-register on their next heartbeat (the
-        agent's ``unknown_node`` path) carrying their live container ids, and
-        ``register_node`` re-applies the accounting for records replayed
-        here. A waiting app admitted pre-crash stays admitted (never
-        double-admitted); a running app keeps its claim and is not evicted."""
+    def _recover_from_journal_locked(self, records) -> None:
+        """Rebuild apps/containers/undelivered-exits from the journal (any
+        iterable — recovery streams it). Nodes are runtime state: they
+        re-register on their next heartbeat (the agent's ``unknown_node``
+        path) carrying their live container ids, and ``register_node``
+        re-applies the accounting for records replayed here. A waiting app
+        admitted pre-crash stays admitted (never double-admitted); a running
+        app keeps its claim and is not evicted.
+
+        A compaction ``snapshot`` record is a barrier: everything folded so
+        far is superseded history — state resets and the embedded records
+        (same vocabulary, written by ``_snapshot_records_locked``) fold in
+        its place."""
         max_seq = -1
         now_mono, now_unix = time.monotonic(), time.time()
 
@@ -340,9 +439,17 @@ class PoolService:
             negative offsets are fine, only differences are compared."""
             return now_mono + (unix - now_unix) if unix else 0.0
 
-        for rec in records:
+        for rec in self._expand_snapshots(records):
             t = rec.get("t")
-            if t == "app":
+            if t == SNAPSHOT_RECORD:
+                # barrier emitted by _expand_snapshots BEFORE the embedded
+                # records: drop everything folded so far
+                self._apps.clear()
+                self._containers.clear()
+                self._app_exits.clear()
+                self._drains.clear()
+                max_seq = -1
+            elif t == "app":
                 wait_unix = float(rec.get("wait_unix") or now_unix)
                 admitted_unix = float(rec.get("admitted_unix") or 0.0)
                 app = _App(
@@ -416,6 +523,25 @@ class PoolService:
             else:
                 raise JournalError(f"unknown pool journal record type {t!r}")
         self._app_seq = itertools.count(max_seq + 1)
+
+    @staticmethod
+    def _expand_snapshots(records):
+        """Flatten compaction snapshots for the replay fold: each snapshot
+        record is re-emitted as a bare barrier marker (the fold resets on
+        it) followed by its embedded records. Nested or malformed snapshot
+        contents are a corrupt journal — degrade, never half-replay."""
+        for rec in records:
+            if rec.get("t") == SNAPSHOT_RECORD:
+                inner = rec.get("records")
+                if not isinstance(inner, list):
+                    raise JournalError("snapshot record carries no records")
+                yield {"t": SNAPSHOT_RECORD}
+                for r in inner:
+                    if not isinstance(r, dict) or r.get("t") == SNAPSHOT_RECORD:
+                        raise JournalError("malformed snapshot contents")
+                    yield r
+            else:
+                yield rec
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -1606,6 +1732,7 @@ def main(argv: list[str] | None = None) -> int:
         journal_path=args.journal_file
         if args.journal_file is not None
         else (config.get(keys.POOL_JOURNAL_FILE) or None),
+        journal_compact_every=config.get_int(keys.POOL_JOURNAL_COMPACT_EVERY, 0),
         chaos=ChaosContext.from_config(config, identity="pool"),
     )
     svc.start()

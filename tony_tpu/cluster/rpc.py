@@ -57,6 +57,11 @@ _RETRY_ATTEMPTS = _metrics.counter(
 _RETRY_BACKOFF = _metrics.counter(
     "tony_rpc_retry_backoff_seconds_total",
     "total backoff sleep inside call_with_retry", labelnames=("method",))
+_RECONNECTS = _metrics.counter(
+    "tony_rpc_reconnects_total",
+    "client sockets re-established transparently after a broken/stale "
+    "persistent connection (each is a fresh TCP handshake the server pays)",
+    labelnames=("method",))
 
 
 class RpcError(RuntimeError):
@@ -167,9 +172,16 @@ class RpcServer:
 
 
 class RpcClient:
-    """Blocking client with per-call reconnect-on-failure and retry helpers.
+    """Blocking client over ONE persistent connection, with transparent
+    broken-pipe reconnect and retry helpers.
 
-    (ApplicationRpcClient analog; executors and the monitoring client use it.)
+    (ApplicationRpcClient analog; executors and the monitoring client use
+    it.) The socket opened by the first call is reused for every subsequent
+    call — the server's handler loop serves many calls per connection — so
+    the per-second heartbeat path costs one TCP handshake per executor
+    LIFETIME, not per beat. A call that finds the cached socket dead (AM
+    restarted, idle timeout, connection reset) reconnects once and retries
+    transparently, counted in ``tony_rpc_reconnects_total``.
     """
 
     def __init__(
@@ -233,7 +245,9 @@ class RpcClient:
         t0 = time.perf_counter()
         try:
             with self._lock:
+                reconnecting = False
                 for attempt in (0, 1):  # one transparent reconnect on a stale socket
+                    had_cached = self._sock is not None
                     try:
                         if self.chaos is not None:
                             # may sleep (rpc-delay) or raise (rpc-drop/blackhole)
@@ -246,11 +260,18 @@ class RpcClient:
                         if self.chaos is not None and self.chaos.rpc_sever_after_send(method):
                             sock.close()  # response lost mid-call (server may have executed)
                         resp = _recv_frame(sock)
+                        if reconnecting:
+                            # only now was a broken PERSISTENT connection
+                            # actually re-established — initial-connect
+                            # failures and failed retries are not handshakes
+                            # the server paid
+                            _RECONNECTS.inc(method=method)
                         break
                     except (ConnectionError, OSError):
                         self._sock = None
                         if attempt:
                             raise
+                        reconnecting = had_cached
                 if not resp.get("ok"):
                     raise RpcError(resp.get("error", "unknown remote error"))
                 result = resp.get("result")

@@ -106,6 +106,20 @@ class Session:
         for jobtype in config.job_types():
             n = config.instances(jobtype)
             self.tasks[jobtype] = [Task(jobtype, i) for i in range(n)]
+        # lock-free heartbeat ledger (docs/performance.md "Control-plane
+        # scalability"): the hottest control-plane write — one beat per task
+        # per second, thousands at gang scale — lands as one GIL-atomic dict
+        # store instead of serializing on the session lock behind whole-gang
+        # snapshots (task_infos) and the monitor loop's scans. Lock-holding
+        # readers fold it into the Task fields (max-wins, so a concurrent
+        # resync can never be regressed) before any liveness decision. The
+        # ledger is pre-populated with EVERY task key so a beat is always a
+        # value replacement, never a structural insert — readers may iterate
+        # it without a lock and without snapshot-vs-insert races. It dies
+        # with the Session on gang rebuild.
+        self._heartbeats: dict[tuple[str, int], float] = {
+            (t.job_name, t.index): 0.0 for t in self.all_tasks()
+        }
 
     # -- lookup ------------------------------------------------------------
     def get_task(self, job_name: str, index: int) -> Task:
@@ -122,6 +136,7 @@ class Session:
 
     def task_infos(self) -> list[dict[str, Any]]:
         with self.lock:
+            self._absorb_heartbeats_locked()
             return [t.to_info() for t in self.all_tasks()]
 
     # -- registration / the gang barrier (SURVEY §3.2) ---------------------
@@ -157,18 +172,37 @@ class Session:
 
     # -- liveness ----------------------------------------------------------
     def on_heartbeat(self, job_name: str, index: int) -> None:
-        with self.lock:
-            t = self.get_task(job_name, index)
-            t.last_heartbeat_ms = time.time() * 1000
-            t.missed_heartbeats = 0
-            if t.status == TaskStatus.REGISTERED:
-                t.status = TaskStatus.RUNNING
+        """Record a beat WITHOUT the session lock: ``self.tasks`` is never
+        structurally modified after construction (gang changes swap the
+        whole Session), so the lookup is safe, and the ledger store is one
+        GIL-atomic assignment. Only the rare REGISTERED→RUNNING flip (once
+        per task per gang epoch) takes the lock, double-checked under it."""
+        t = self.get_task(job_name, index)  # unknown task raises, as ever
+        self._heartbeats[(job_name, index)] = time.time() * 1000
+        if t.status == TaskStatus.REGISTERED:
+            with self.lock:
+                if t.status == TaskStatus.REGISTERED:
+                    t.status = TaskStatus.RUNNING
+
+    def _absorb_heartbeats_locked(self) -> None:
+        """Fold the lock-free ledger into the Task fields (max-wins so a
+        concurrent ``resync_task`` refresh is never regressed). The ledger's
+        key set is fixed at construction (beats only replace values), so
+        iterating here can never race a structural insert; entries are kept,
+        not drained — deleting would race a concurrent beat into a lost
+        update."""
+        for (job, idx), ms in self._heartbeats.items():
+            if ms and ms > self.tasks[job][idx].last_heartbeat_ms:
+                t = self.tasks[job][idx]
+                t.last_heartbeat_ms = ms
+                t.missed_heartbeats = 0
 
     def find_dead_tasks(self, heartbeat_interval_ms: int, max_missed: int) -> list[Task]:
         """Tasks whose heartbeats stopped (mark LOST). Reference: AM hb monitor."""
         now = time.time() * 1000
         dead = []
         with self.lock:
+            self._absorb_heartbeats_locked()
             for t in self.all_tasks():
                 if t.status in (TaskStatus.REGISTERED, TaskStatus.RUNNING) and t.last_heartbeat_ms:
                     missed = (now - t.last_heartbeat_ms) / max(heartbeat_interval_ms, 1)

@@ -46,6 +46,10 @@ AM_RETRY_COUNT = "tony.am.retry-count"
 # budget and re-sync, the training children never stop. false restores the
 # pre-takeover behavior: every AM retry is a full gang restart.
 AM_TAKEOVER_ENABLED = "tony.am.takeover.enabled"
+# Takeover-journal compaction, same contract as tony.pool.journal.compact-every:
+# after this many appends the monitor loop folds the recoverable state into a
+# snapshot record and rotates am_journal.jsonl. 0 (default) never compacts.
+AM_JOURNAL_COMPACT_EVERY = "tony.am.journal.compact-every"
 AM_RPC_PORT = "tony.am.rpc.port"                  # 0 = ephemeral
 AM_GANG_TIMEOUT_MS = "tony.am.gang-timeout-ms"    # max wait for full gang registration
 AM_MONITOR_INTERVAL_MS = "tony.am.monitor-interval-ms"
@@ -118,6 +122,20 @@ TPU_ICI_STRICT = "tony.tpu.ici-strict"          # never split a slice across DCN
 TPU_CHIPS_PER_HOST = "tony.tpu.chips-per-host"
 
 # ---------------------------------------------------------------------------
+# tony.heartbeat.* — executor → AM heartbeat shaping (docs/performance.md
+# "Control-plane scalability"): a thousand-executor gang whose supervisors
+# all beat on the same whole-second boundary knocks the AM in lockstep;
+# per-beat jitter spreads the fan-in. A stretched gap can span up to
+# (1 + pct) of the AM's missed-heartbeat intervals, so the false-positive
+# margin shrinks by up to pct intervals — keep pct well under
+# tony.task.max-missed-heartbeats (trivial at the defaults: 0.25 vs 25).
+# ---------------------------------------------------------------------------
+HEARTBEAT_BACKOFF_ENABLED = "tony.heartbeat.backoff-enabled"
+# Each beat waits interval * (1 + U[0, pct]) from a per-task seeded RNG —
+# deterministic per identity, decorrelated across the gang.
+HEARTBEAT_BACKOFF_JITTER_PCT = "tony.heartbeat.backoff-jitter-pct"
+
+# ---------------------------------------------------------------------------
 # tony.node.* — host-agent liveness (pool-service ↔ NodeAgent contract)
 # ---------------------------------------------------------------------------
 NODE_HEARTBEAT_INTERVAL_MS = "tony.node.heartbeat-interval-ms"
@@ -154,6 +172,12 @@ POOL_PREEMPTION_BUDGET_WINDOW_MS = "tony.pool.preemption.budget-window-ms"
 # default) disables journaling — a restarted pool starts empty and agents
 # kill the orphaned containers, the pre-journal behavior.
 POOL_JOURNAL_FILE = "tony.pool.journal.file"
+# Incremental journal compaction (docs/performance.md "Control-plane
+# scalability"): after this many appended records the pool folds its live
+# state into one durable snapshot record and rotates the file, so restart
+# replay is O(live apps + containers), not O(everything that ever happened).
+# 0 (the default) never compacts — the pre-compaction behavior exactly.
+POOL_JOURNAL_COMPACT_EVERY = "tony.pool.journal.compact-every"
 
 # ---------------------------------------------------------------------------
 # tony.history.* / tony.portal.* — events, history, portal, history server
@@ -177,6 +201,13 @@ HISTORY_MAX_SERIES_POINTS = "tony.history.max-series-points"
 # `tony history gc` works regardless). Never touches live/un-ingested jobs.
 HISTORY_GC_ENABLED = "tony.history.gc.enabled"
 PORTAL_PORT = "tony.portal.port"
+# O(changed) portal scrape (docs/performance.md "Control-plane scalability"):
+# a running AM's get_metrics result is cached and re-served for up to this
+# long, re-scraped early only when the AM's am_info.json moved (takeover).
+# Stale entries are exported with a `tony_portal_scrape_age_seconds` label so
+# dashboards can see they are cached. 0 (the default) scrapes every AM on
+# every exposition — the pre-cache behavior exactly.
+PORTAL_SCRAPE_TTL_MS = "tony.portal.scrape-ttl-ms"
 
 # ---------------------------------------------------------------------------
 # tony.elastic.* — elastic training (docs/fault-tolerance.md)
@@ -262,6 +293,22 @@ SERVE_LOADTEST_TURNS = "tony.serve.loadtest.turns"
 SERVE_LOADTEST_PROMPT_MIX = "tony.serve.loadtest.prompt-mix"
 SERVE_LOADTEST_MAX_TOKENS = "tony.serve.loadtest.max-tokens"
 SERVE_LOADTEST_STREAM = "tony.serve.loadtest.stream"
+
+# ---------------------------------------------------------------------------
+# tony.cbench.* — control-plane benchmark sizes (`tony cbench`,
+# docs/performance.md "Control-plane scalability"). These parameterize the
+# five seeded in-process microbenchmarks; the checked-in CBENCH_r<N>.json
+# rounds are produced at the full-scale defaults, tier-1 runs scaled down.
+# ---------------------------------------------------------------------------
+CBENCH_APPS = "tony.cbench.apps"                    # queued apps in the scheduler bench
+CBENCH_QUEUES = "tony.cbench.queues"                # queues the apps spread over
+CBENCH_EXECUTORS = "tony.cbench.executors"          # simulated executors in the heartbeat fan-in
+CBENCH_HEARTBEAT_SECONDS = "tony.cbench.heartbeat-seconds"  # sustained-knock window per phase
+CBENCH_JOURNAL_RECORDS = "tony.cbench.journal-records"      # pool-journal history length
+CBENCH_JOURNAL_LIVE_APPS = "tony.cbench.journal-live-apps"  # live apps the replay must rebuild
+CBENCH_HISTORY_JOBS = "tony.cbench.history-jobs"    # finalized fixture jobs the sweep ingests
+CBENCH_PORTAL_AMS = "tony.cbench.portal-ams"        # registered AMs the portal scrapes
+CBENCH_SEED = "tony.cbench.seed"                    # every benchmark draw is seeded from this
 
 # ---------------------------------------------------------------------------
 # tony.profile.* — ON-DEMAND profiler capture (docs/observability.md)
@@ -399,6 +446,7 @@ DEFAULTS: dict[str, str] = {
 
     AM_RETRY_COUNT: "0",
     AM_TAKEOVER_ENABLED: "true",
+    AM_JOURNAL_COMPACT_EVERY: "0",
     AM_RPC_PORT: "0",
     AM_GANG_TIMEOUT_MS: "300000",
     AM_MONITOR_INTERVAL_MS: "200",
@@ -430,6 +478,9 @@ DEFAULTS: dict[str, str] = {
     TPU_ICI_STRICT: "true",
     TPU_CHIPS_PER_HOST: "4",
 
+    HEARTBEAT_BACKOFF_ENABLED: "false",
+    HEARTBEAT_BACKOFF_JITTER_PCT: "0.25",
+
     NODE_HEARTBEAT_INTERVAL_MS: "1000",
     NODE_MAX_MISSED_HEARTBEATS: "10",
 
@@ -441,6 +492,7 @@ DEFAULTS: dict[str, str] = {
     POOL_PREEMPTION_BUDGET: "0",
     POOL_PREEMPTION_BUDGET_WINDOW_MS: "60s",
     POOL_JOURNAL_FILE: "",
+    POOL_JOURNAL_COMPACT_EVERY: "0",
 
     HISTORY_LOCATION: "",            # empty → <staging-root>/history
     HISTORY_MOVE_INTERVAL_MS: "1000",
@@ -451,6 +503,7 @@ DEFAULTS: dict[str, str] = {
     HISTORY_MAX_SERIES_POINTS: "512",
     HISTORY_GC_ENABLED: "false",
     PORTAL_PORT: "28080",
+    PORTAL_SCRAPE_TTL_MS: "0",
 
     ELASTIC_JOBTYPE: "worker",
     ELASTIC_MIN_WORKERS: "0",
@@ -483,6 +536,16 @@ DEFAULTS: dict[str, str] = {
     SERVE_LOADTEST_PROMPT_MIX: "16:0.5,64:0.3,256:0.2",
     SERVE_LOADTEST_MAX_TOKENS: "16",
     SERVE_LOADTEST_STREAM: "true",
+
+    CBENCH_APPS: "10000",
+    CBENCH_QUEUES: "8",
+    CBENCH_EXECUTORS: "1000",
+    CBENCH_HEARTBEAT_SECONDS: "5",
+    CBENCH_JOURNAL_RECORDS: "100000",
+    CBENCH_JOURNAL_LIVE_APPS: "200",
+    CBENCH_HISTORY_JOBS: "10000",
+    CBENCH_PORTAL_AMS: "500",
+    CBENCH_SEED: "0",
 
     PROFILE_STEPS: "5",
     PROFILE_MEMORY: "false",

@@ -266,6 +266,11 @@ def sweep(
               "errors": 0, "purged": 0}
     now = now_ms if now_ms is not None else int(time.time() * 1000)
     cutoff = now - int(retention_days * 86_400_000) if retention_days > 0 else None
+    # O(changed) fast path (docs/performance.md "Control-plane scalability"):
+    # one query for every ingested job's source mtime, so the steady-state
+    # re-sweep — thousands of already-ingested jobs, nothing new — costs a
+    # stat per job instead of a store query + full artifact-index resolution
+    known_mtimes = store.source_mtimes()
     for root in staging_roots:
         # one walk of the finished tree per root (not per job): jobs whose
         # staging dir was GC'd still exist only here, so the map is both the
@@ -280,6 +285,12 @@ def sweep(
             # ingest→purge cycle would otherwise repeat every sweep forever
             if cutoff is not None and hint is not None and hint[1].completed_ms < cutoff:
                 counts["expired"] += 1
+                continue
+            if (
+                hint is not None
+                and known_mtimes.get(app_id) == _mtime_ns(hint[0])
+            ):
+                counts["unchanged"] += 1
                 continue
             try:
                 art = obs_artifacts.index(root, app_id, finished=hint)

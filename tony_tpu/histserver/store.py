@@ -211,6 +211,16 @@ class HistoryStore:
                 "SELECT source_mtime_ns FROM jobs WHERE app_id = ?", (app_id,)).fetchone()
         return int(row[0]) if row else None
 
+    def source_mtimes(self) -> dict[str, int]:
+        """Every ingested job's source mtime in ONE query — the sweep's
+        unchanged-job fast path (docs/performance.md "Control-plane
+        scalability"): re-sweeping a 10k-job store must not pay one lookup
+        query plus one artifact-index resolution per already-ingested job."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT app_id, source_mtime_ns FROM jobs").fetchall()
+        return {str(r["app_id"]): int(r["source_mtime_ns"]) for r in rows}
+
     def series(self, app_id: str, metric: str) -> list[tuple[int, float]]:
         with self._lock:
             rows = self._db.execute(

@@ -230,6 +230,15 @@ def index(
     )
 
 
+def am_info_path(staging_root: str, app_id: str) -> str:
+    """The live-AM advertisement path WITHOUT full artifact resolution —
+    for hot per-scrape freshness checks (the portal's O(changed) cache keys
+    on this file's identity for every running app on every exposition;
+    paying :func:`index`'s config reads per app per scrape would be the
+    overhead the cache exists to avoid)."""
+    return os.path.join(staging_root.rstrip("/"), app_id, constants.AM_INFO_FILE)
+
+
 # ---------------------------------------------------------------- listings
 def running_ids(history_root: str) -> list[str]:
     """Applications with an intermediate ``.jhist`` (the AM streams events

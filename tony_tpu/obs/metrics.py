@@ -67,6 +67,15 @@ class _Metric:
             )
         return tuple(str(labels[n]) for n in self.labelnames)
 
+    def remove(self, **labels: Any) -> None:
+        """Drop one label child from the exposition. For bounded-lifetime
+        label values (e.g. the portal's per-app scrape-age gauge): without
+        removal, every value ever labeled stays a frozen series forever —
+        unbounded cardinality and permanently stale samples."""
+        key = self._key(labels)
+        with self._lock:
+            self._children.pop(key, None)
+
     def _label_dicts(self) -> "list[tuple[tuple[str, ...], Any]]":
         with self._lock:
             # deep-copy histogram children: observe() mutates them under

@@ -43,6 +43,11 @@ _SCRAPE_FAILURES = obs_metrics.counter(
     "tony_portal_scrape_failures_total",
     "running-AM get_metrics scrapes that failed (the app is skipped, the "
     "exposition survives)", labelnames=("app",))
+_SCRAPE_AGE = obs_metrics.gauge(
+    "tony_portal_scrape_age_seconds",
+    "age of the served scrape result per app when the O(changed) scrape "
+    "cache answered (tony.portal.scrape-ttl-ms); 0 = freshly scraped",
+    labelnames=("app",))
 
 _STYLE = """
 body{font-family:system-ui,sans-serif;margin:2em;color:#222}
@@ -129,6 +134,13 @@ class PortalHandler(BaseHTTPRequestHandler):
     staging_root = ""       # where <app_id>/am_info.json lives (TONY_ROOT)
     pool_addr = ""          # "host:port" of a pool service, optional
     history_db = ""         # history-server store; "" → <history_root>/history.sqlite
+    # O(changed) scrape cache (tony.portal.scrape-ttl-ms, performance.md
+    # "Control-plane scalability"): 0 → scrape every AM on every /metrics.
+    # The cache dict + lock are installed per portal instance by serve()
+    # (handler objects are per-request; state must live on the class).
+    scrape_ttl_ms = 0
+    scrape_cache: "dict | None" = None
+    scrape_lock = None
 
     def log_message(self, *args) -> None:  # quiet
         pass
@@ -262,26 +274,86 @@ class PortalHandler(BaseHTTPRequestHandler):
                 cli.close()
         raise last  # type: ignore[misc]
 
+    def _am_info_key(self, app_id: str):
+        """Cache-freshness key for one AM: its advertisement file's identity
+        (resolved through the artifact index's lightweight helper). A
+        work-preserving takeover republishes the file (fresh port/secret),
+        so a moved AM invalidates its cache entry immediately — the TTL only
+        bounds staleness for an AM whose advertisement did NOT move."""
+        try:
+            st = os.stat(obs_artifacts.am_info_path(self.staging_root, app_id))
+            return (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None
+
+    def _am_groups(self, app_id: str) -> list:
+        """One AM's exposition groups, freshly scraped (may raise)."""
+        got = self._am_call(app_id, "get_metrics")
+        if got is None:
+            return []
+        (snap,) = got
+        groups: list = [(snap.get("metrics") or [], {"app": app_id})]
+        for task_id, tsnap in (snap.get("tasks") or {}).items():
+            groups.append((tsnap, {"app": app_id, "task": task_id}))
+        return groups
+
     def _metrics_text(self) -> str:
         """Merged Prometheus exposition: own registry (no extra labels) +
         each running AM's snapshot under app=<id>. An AM that dies between
         the listing and the call degrades to skipping that app — counted in
         ``tony_portal_scrape_failures_total{app=...}`` — never to failing
         the whole exposition; an AM that merely MOVED (takeover) is
-        re-resolved mid-scrape and still exported."""
+        re-resolved mid-scrape and still exported.
+
+        With ``tony.portal.scrape-ttl-ms`` > 0 the scrape is O(changed): an
+        AM whose ``am_info.json`` did not move is re-served from cache for
+        up to the TTL — with its age exported as
+        ``tony_portal_scrape_age_seconds{app=...}`` — so a 500-AM fleet
+        costs 500 RPC knocks once per TTL, not once per exposition."""
+        import time as _time
+
         groups: list = []
-        for app_id in self._running_ids():
+        ttl_s = (self.scrape_ttl_ms or 0) / 1000.0
+        cache = self.scrape_cache if ttl_s > 0 and self.scrape_cache is not None else None
+        now = _time.monotonic()
+        running = self._running_ids()
+        for app_id in running:
+            key = self._am_info_key(app_id) if cache is not None else None
+            if cache is not None:
+                with self.scrape_lock:
+                    entry = cache.get(app_id)
+                if (entry is not None and entry["key"] == key
+                        and now - entry["ts"] < ttl_s):
+                    _SCRAPE_AGE.set(round(now - entry["ts"], 3), app=app_id)
+                    groups.extend(entry["groups"])
+                    continue
             try:
-                got = self._am_call(app_id, "get_metrics")
+                app_groups = self._am_groups(app_id)
             except Exception:  # noqa: BLE001 — AM gone even after re-resolution
                 _SCRAPE_FAILURES.inc(app=app_id)
+                if cache is not None:
+                    # nothing is exported for this app this pass — a frozen
+                    # age series would claim cached data is being served
+                    with self.scrape_lock:
+                        cache.pop(app_id, None)
+                    _SCRAPE_AGE.remove(app=app_id)
                 continue
-            if got is None:
+            if not app_groups:
                 continue
-            (snap,) = got
-            groups.append((snap.get("metrics") or [], {"app": app_id}))
-            for task_id, tsnap in (snap.get("tasks") or {}).items():
-                groups.append((tsnap, {"app": app_id, "task": task_id}))
+            if cache is not None:
+                with self.scrape_lock:
+                    cache[app_id] = {"key": key, "ts": now, "groups": app_groups}
+                _SCRAPE_AGE.set(0.0, app=app_id)
+            groups.extend(app_groups)
+        if cache is not None:
+            # finalized jobs leave the RUNNING list; their entries must not
+            # pin dead scrape results (or their age gauge series) forever
+            with self.scrape_lock:
+                gone_apps = set(cache) - set(running)
+                for gone in gone_apps:
+                    del cache[gone]
+            for gone in gone_apps:
+                _SCRAPE_AGE.remove(app=gone)
         # own registry snapshotted AFTER the scrape loop, so a failure
         # counted just above is visible in THIS exposition, not the next
         groups.insert(0, (REGISTRY.snapshot(), {}))
@@ -801,12 +873,18 @@ class PortalHandler(BaseHTTPRequestHandler):
 
 def serve(
     history_root: str, port: int = 28080, staging_root: str = "", pool: str = "",
-    history_db: str = "",
+    history_db: str = "", scrape_ttl_ms: int = 0,
 ) -> ThreadingHTTPServer:
+    import threading
+
     handler = type(
         "Handler", (PortalHandler,),
         {"history_root": history_root, "staging_root": staging_root,
-         "pool_addr": pool, "history_db": history_db},
+         "pool_addr": pool, "history_db": history_db,
+         # per-portal scrape cache: handler objects are per-request, so the
+         # cache + its lock live on this portal instance's handler class
+         "scrape_ttl_ms": int(scrape_ttl_ms), "scrape_cache": {},
+         "scrape_lock": threading.Lock()},
     )
     server = ThreadingHTTPServer(("0.0.0.0", port), handler)
     return server
@@ -823,10 +901,28 @@ def main(argv: list[str] | None = None) -> int:
                    help="history-server store behind /history "
                         "(tony.history.store; default <root>/history.sqlite)")
     p.add_argument("--port", type=int, default=28080)
+    p.add_argument("--scrape-ttl-ms", type=int, default=None,
+                   help="O(changed) /metrics scrape: serve a running AM's "
+                        "cached get_metrics result for up to this long, "
+                        "re-scraping early only when its am_info.json moved "
+                        "(tony.portal.scrape-ttl-ms; default 0 = always fresh)")
     args = p.parse_args(argv)
     root = args.root or os.path.join(constants.default_tony_root(), "history")
     staging = args.staging or os.path.dirname(root.rstrip("/"))
-    server = serve(root, args.port, staging, args.pool, history_db=args.history_db)
+    ttl = args.scrape_ttl_ms
+    if ttl is None:
+        ttl = 0
+        site = os.path.join(os.getcwd(), constants.TONY_SITE_CONF)
+        if os.path.exists(site):
+            try:
+                from tony_tpu.config import TonyConfig, keys
+
+                ttl = TonyConfig.from_layers(site_file=site).get_time_ms(
+                    keys.PORTAL_SCRAPE_TTL_MS, 0)
+            except (OSError, ValueError):
+                ttl = 0
+    server = serve(root, args.port, staging, args.pool,
+                   history_db=args.history_db, scrape_ttl_ms=ttl)
     obs_logging.info(f"[tony-portal] serving {root} on http://0.0.0.0:{args.port}"
                      + (f" (pool {args.pool})" if args.pool else ""))
     try:
