@@ -259,6 +259,35 @@ class TestCbenchFamily:
         result = gate.evaluate(copied, traj)
         assert any("gate-without-movement" in c.note for c in result.checks)
 
+    def test_machine_fingerprint_scopes_comparisons(self):
+        """Machine provenance (r14): control-plane lanes are CPU-bound, so
+        a record gates only against same-fingerprint peers — a same-box
+        drop is a real regression, a cross-box delta is a visible note,
+        never a reference in either direction."""
+        def rec(n, value, hps, cpus):
+            return {"n": n, "rc": 0, "parsed": {
+                "metric": "control_plane_ops_per_sec", "value": value,
+                "unit": "ops/s", "vs_baseline": 1.0,
+                "heartbeats_per_sec": hps, "sizes": {"apps": 1},
+                "machine": {"cpus": cpus, "arch": "x86_64"}}}
+        fast_box = [("CBENCH_r91.json", rec(1, 100.0, 1500.0, 8))]
+        # same machine, halved heartbeat throughput: a real regression
+        same = rec(2, 101.0, 750.0, 8)
+        assert not gate.evaluate(same, fast_box).passed
+        # different machine: not a regression reference — pass, with the
+        # skipped rounds surfaced loudly
+        moved = rec(2, 50.0, 750.0, 2)
+        result = gate.evaluate(moved, fast_box)
+        assert result.passed
+        assert any("different hardware" in c.note for c in result.checks)
+        # records WITHOUT fingerprints keep comparing with each other (the
+        # pre-provenance trajectory stays self-consistent)
+        bare = rec(1, 100.0, 1500.0, 8)
+        bare["parsed"].pop("machine")
+        bare2 = rec(2, 101.0, 700.0, 8)
+        bare2["parsed"].pop("machine")
+        assert not gate.evaluate(bare2, [("CBENCH_r92.json", bare)]).passed
+
     def test_cbench_records_do_not_gate_against_other_families(self):
         cb_rec = _cbench_trajectory()[-1][1]
         result = gate.evaluate(cb_rec, gate.load_trajectory(REPO_ROOT))

@@ -50,6 +50,26 @@ class TestSchedulerBench:
         assert 0 < got["sched_decision_p50_ms"] <= got["sched_decision_p99_ms"]
         # the seeded world leaves real work on the table: a pass admits some
         assert got["sched_admitted_per_pass"] > 0
+        # provenance: the record names which implementation it measured
+        assert got["sched_policy"] == "indexed"
+        # steady-state sub-bench (r14): 100 delta-fed passes over a
+        # persistent WorldIndex — present, positive, and consistent
+        assert got["sched_incremental_p50_ms"] > 0
+        assert got["sched_incremental_passes_per_sec"] > 0
+
+    def test_bench_scheduler_reference_impl(self):
+        """The kill-switch spelling runs the reference pass (and has no
+        steady-state sub-bench — there is no persistent world to measure)."""
+        got = cbench.bench_scheduler(TINY, passes=2, policy_impl="reference")
+        assert got["sched_policy"] == "reference"
+        assert "sched_incremental_p50_ms" not in got
+
+    def test_cold_pass_decisions_match_reference(self):
+        """The benchmark world itself is a parity fixture: both
+        implementations admit the same apps from the same seeded world."""
+        a = cbench.bench_scheduler(TINY, passes=2)
+        b = cbench.bench_scheduler(TINY, passes=2, policy_impl="reference")
+        assert a["sched_admitted_per_pass"] == b["sched_admitted_per_pass"]
 
 
 # ------------------------------------------------------- heartbeat fan-in

@@ -52,6 +52,15 @@ class TestLayering:
         assert cfg.get(keys.APPLICATION_FRAMEWORK) == "jax"
         assert cfg.get_int(keys.TASK_MAX_MISSED_HEARTBEATS) == 25
 
+    def test_scheduler_indexed_key_defaults_true(self):
+        """The r14 kill switch (docs/performance.md "Scheduler pass"):
+        indexed scheduling is the default — parity-proven identical
+        semantics — and false restores the reference policy verbatim."""
+        cfg = TonyConfig()
+        assert cfg.get_bool(keys.POOL_SCHEDULER_INDEXED) is True
+        flipped = TonyConfig({keys.POOL_SCHEDULER_INDEXED: "false"})
+        assert flipped.get_bool(keys.POOL_SCHEDULER_INDEXED) is False
+
     def test_train_and_tune_keys_registered_with_defaults(self):
         """The r11 step-path knobs (docs/performance.md): registered,
         defaulted, and typed the way the executor reads them."""

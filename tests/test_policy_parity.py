@@ -1,0 +1,409 @@
+"""Indexed ↔ reference scheduler parity (docs/performance.md "Scheduler
+pass").
+
+PR 14 rebuilt `PreemptionPolicy.schedule` around indices; the hard contract
+is DECISION-TRACE EQUALITY with the kept :class:`ReferencePolicy` oracle.
+Three layers prove it:
+
+- a property suite over thousands of seeded random worlds — mixed shares,
+  priorities, budgets, grace, min-runtime protection, elastic contracts,
+  shrink histories, unknown queues, held>demand claims — asserting the two
+  implementations return equal :class:`Decision`\\s, mutate their views
+  identically, and leave identical budget charge logs; plus adversarial
+  orderings where queue heads tie on ``(used/share, sort_key)`` and where
+  duplicate seqs force the stable-sort tiebreak;
+- :class:`WorldIndex` consistency — after every simulator event the index's
+  heaps/victim orders/counters/claim sums are audited against a brute-force
+  recompute, and lazily-deleted entries can never resurface;
+- the end-to-end half: ``run_parity`` (and the ``tony sim --parity`` CLI)
+  replays every arrival mix through both policies and diffs decision traces
+  event-by-event.
+
+Plus the pool-level incrementality contract: an unchanged-world tick builds
+zero views and skips the pass outright, and the
+``tony.pool.scheduler.indexed=false`` kill switch restores the reference
+implementation verbatim.
+"""
+
+import random
+from dataclasses import replace
+
+import pytest
+
+from tony_tpu.cluster.policy import (
+    AppView,
+    PreemptionPolicy,
+    ReferencePolicy,
+    WorldIndex,
+    make_policy,
+)
+from tony_tpu.cluster.sim import (
+    GB,
+    MIXES,
+    PoolSimulator,
+    diff_traces,
+    generate_jobs,
+    run_parity,
+)
+
+pytestmark = pytest.mark.sched
+
+NOW = 1000.0  # the injected policy clock for every generated world
+
+
+def make_world(seed: int):
+    """One seeded random world: (queues, totals, views, policy kwargs).
+
+    Deliberately hostile: unknown queues, held exceeding demand, protected
+    and shrink-pending victims, elastic slack, tight budgets, zero-demand
+    dimensions — every guard the pass consults gets exercised."""
+    rng = random.Random(seed)
+    nq = rng.randint(1, 4)
+    shares = [rng.choice([0.1, 0.2, 0.25, 0.3, 0.5]) for _ in range(nq)]
+    s = sum(shares)
+    if s > 1.0:
+        shares = [int(x / s * 1e6) / 1e6 for x in shares]  # truncate, never > 1
+    queues = {f"q{i}": shares[i] for i in range(nq)}
+    chips = rng.choice([0, rng.randint(4, 32)])
+    totals = (rng.randint(4, 64) << 30, rng.randint(8, 128), chips)
+    views = []
+    for i in range(rng.randint(3, 40)):
+        d = (rng.randint(0, 8) << 30, rng.randint(0, 8),
+             rng.randint(0, 6) if chips else 0)
+        admitted = rng.random() < 0.4
+        held = tuple(
+            x + ((rng.randint(0, 2) << 30) if j == 0 else rng.randint(0, 2))
+            if rng.random() < 0.2 else (x if rng.random() < 0.7 else 0)
+            for j, x in enumerate(d)
+        )
+        elastic = rng.random() < 0.4
+        views.append(AppView(
+            app_id=f"a{i}",
+            queue=f"q{rng.randrange(nq)}" if rng.random() < 0.9 else "ghost",
+            priority=rng.choice([0, 0, 1, 2, 5]),
+            seq=i,
+            demand=d,
+            held=held if admitted else (held if rng.random() < 0.2 else (0, 0, 0)),
+            admitted=admitted,
+            preempted=rng.random() < 0.1,
+            wait_since=NOW - rng.uniform(0, 20),
+            admitted_at=NOW - rng.uniform(0, 30) if admitted else 0.0,
+            elastic_unit=(1 << 30, 1, 1 if chips else 0) if elastic else (0, 0, 0),
+            elastic_slack=rng.randint(0, 3) if elastic else 0,
+            shrink_pending=rng.random() < 0.1,
+        ))
+    kwargs = dict(
+        preemption=rng.random() < 0.8,
+        grace_ms=rng.choice([0, 1_000, 5_000]),
+        min_runtime_ms=rng.choice([0, 2_000, 10_000]),
+        eviction_budget=rng.choice([0, 0, 1, 3]),
+        budget_window_ms=60_000,
+        clock=lambda: NOW,
+    )
+    return queues, totals, views, kwargs
+
+
+def assert_parity(queues, totals, views, kwargs):
+    va = [replace(v) for v in views]
+    vb = [replace(v) for v in views]
+    ref = ReferencePolicy(queues, **kwargs)
+    idx = PreemptionPolicy(queues, **kwargs)
+    da = ref.schedule(va, totals)
+    db = idx.schedule(vb, totals)
+    assert da == db, f"decisions diverge:\n ref: {da}\n idx: {db}"
+    assert va == vb, "view mutations diverge: " + "; ".join(
+        f"{x} != {y}" for x, y in zip(va, vb) if x != y)
+    assert ref._charges == idx._charges, "budget charge logs diverge"
+    return da
+
+
+# ---------------------------------------------------------------------------
+# decision-equality property suite
+# ---------------------------------------------------------------------------
+class TestDecisionEquality:
+    def test_2000_seeded_worlds(self):
+        """The headline contract: 2000+ random worlds, byte-identical
+        decisions, identical view mutations, identical charge logs."""
+        nonempty = 0
+        for seed in range(2200):
+            queues, totals, views, kwargs = make_world(seed)
+            decision = assert_parity(queues, totals, views, kwargs)
+            if not decision.empty():
+                nonempty += 1
+        # the suite must actually exercise decisions, not vacuous worlds
+        assert nonempty > 500
+
+    def test_heads_tying_on_ratio_break_by_sort_key(self):
+        """Adversarial ordering: two queues with equal shares and equal
+        (zero) usage — eligibility ratios tie exactly; (priority, seq) must
+        decide, identically in both implementations."""
+        queues = {"qa": 0.5, "qb": 0.5}
+        totals = (8 << 30, 16, 0)
+        views = [
+            AppView(app_id="late-hi", queue="qa", priority=5, seq=10,
+                    demand=(1 << 30, 1, 0), wait_since=NOW - 10),
+            AppView(app_id="early-lo", queue="qb", priority=0, seq=1,
+                    demand=(1 << 30, 1, 0), wait_since=NOW - 10),
+        ]
+        d = assert_parity(queues, totals, views,
+                          dict(preemption=True, clock=lambda: NOW))
+        # higher priority wins the tie despite the later seq
+        assert d.admit[0] == "late-hi"
+
+    def test_equal_nonzero_usage_ratio_tie(self):
+        """Ratio ties with NONZERO usage: both queues at the same used/share
+        — admit order must still be identical (and FIFO within priority)."""
+        queues = {"qa": 0.5, "qb": 0.5}
+        totals = (8 << 30, 64, 0)
+        views = [
+            AppView(app_id="run-a", queue="qa", seq=0, admitted=True,
+                    demand=(2 << 30, 1, 0), held=(2 << 30, 1, 0),
+                    admitted_at=NOW - 100),
+            AppView(app_id="run-b", queue="qb", seq=1, admitted=True,
+                    demand=(2 << 30, 1, 0), held=(2 << 30, 1, 0),
+                    admitted_at=NOW - 100),
+            AppView(app_id="wait-b", queue="qb", seq=2,
+                    demand=(1 << 30, 1, 0), wait_since=NOW - 10),
+            AppView(app_id="wait-a", queue="qa", seq=3,
+                    demand=(1 << 30, 1, 0), wait_since=NOW - 10),
+        ]
+        d = assert_parity(queues, totals, views,
+                          dict(preemption=True, clock=lambda: NOW))
+        assert d.admit == ["wait-b", "wait-a"]  # equal ratios → FIFO by seq
+
+    def test_duplicate_seq_stable_order(self):
+        """Two same-queue waiters with IDENTICAL sort keys: the reference's
+        stable sort admits them in list order — the index's insertion-order
+        tiebreak must reproduce exactly that."""
+        queues = {"q": 1.0}
+        totals = (4 << 30, 8, 0)
+        views = [
+            AppView(app_id="first", queue="q", priority=1, seq=7,
+                    demand=(1 << 30, 1, 0), wait_since=NOW - 5),
+            AppView(app_id="second", queue="q", priority=1, seq=7,
+                    demand=(1 << 30, 1, 0), wait_since=NOW - 5),
+        ]
+        d = assert_parity(queues, totals, views,
+                          dict(preemption=True, clock=lambda: NOW))
+        assert d.admit == ["first", "second"]
+
+    def test_duplicate_seq_worlds(self):
+        """400 worlds with seqs drawn from {0..3}: sort keys collide
+        constantly, so every tie falls to the stable-order tiebreak —
+        including apps admitted then evicted mid-pass, whose sticky
+        insertion rank must restore their original stable position."""
+        for seed in range(400):
+            queues, totals, views, kwargs = make_world(seed + 10_000)
+            rng = random.Random(seed)
+            for v in views:
+                v.seq = rng.randrange(4)
+            assert_parity(queues, totals, views, kwargs)
+
+    def test_budget_and_protection_worlds(self):
+        """Focused re-run of the property over parameter corners the random
+        mix visits rarely: budget=1 with many would-be victims, and
+        min-runtime protecting every victim."""
+        for seed in range(300):
+            queues, totals, views, kwargs = make_world(seed)
+            kwargs.update(preemption=True, eviction_budget=1)
+            assert_parity(queues, totals, views, kwargs)
+            kwargs.update(eviction_budget=0, min_runtime_ms=10_000_000)
+            assert_parity(queues, totals, views, kwargs)
+
+
+# ---------------------------------------------------------------------------
+# WorldIndex consistency
+# ---------------------------------------------------------------------------
+class TestWorldIndex:
+    def test_lazy_deleted_entries_never_resurface(self):
+        w = WorldIndex()
+        v = AppView(app_id="a", queue="q", seq=1, demand=(1, 1, 0))
+        w.adopt(v)
+        assert w.head("q") is v
+        w.remove("a")
+        assert w.head("q") is None
+        # re-adopt the SAME object (the simulator's die→requeue path): the
+        # stale first-life entry must not satisfy head() twice
+        w.adopt(v)
+        assert w.head("q") is v
+        v.admitted = True
+        w.note_admitted(v)
+        assert w.head("q") is None
+        assert [x.app_id for x in w.victims_iter("q")] == ["a"]
+        v.admitted = False
+        w.note_evicted(v)
+        assert w.head("q") is v
+        assert list(w.victims_iter("q")) == []
+        assert w.audit([v]) == []
+
+    def test_upsert_rebuckets_and_reaccounts(self):
+        w = WorldIndex()
+        fields = dict(queue="qa", priority=0, seq=1, demand=(4, 2, 0),
+                      held=(0, 0, 0), admitted=False, preempted=False,
+                      wait_since=0.0, admitted_at=0.0,
+                      elastic_unit=(0, 0, 0), elastic_slack=0,
+                      shrink_pending=False)
+        w.upsert("a", **fields)
+        assert w.waiting_count("qa") == 1 and w.claims == [0, 0, 0]
+        ver = w.version
+        w.upsert("a", **fields)  # no-op: version must not move
+        assert w.version == ver
+        w.upsert("a", **{**fields, "admitted": True, "held": (6, 1, 0)})
+        assert w.waiting_count("qa") == 0
+        assert w.claims == [6, 2, 0]  # elementwise max(demand, held)
+        w.upsert("a", **{**fields, "admitted": True, "held": (6, 1, 0), "queue": "qb"})
+        assert w.queue_claims["qa"] == [0, 0, 0]
+        assert w.queue_claims["qb"] == [6, 2, 0]
+        v = w.views["a"]
+        assert w.audit([v]) == []
+        w.remove("a")
+        assert w.audit([]) == []
+        assert w.claims == [0, 0, 0]
+
+    def test_audit_catches_a_cooked_index(self):
+        """Prove the auditor audits: silently flipping a view's admitted
+        flag (bypassing the choke points) must be reported."""
+        w = WorldIndex()
+        v = AppView(app_id="a", queue="q", seq=1, demand=(1, 0, 0))
+        w.adopt(v)
+        v.admitted = True  # mutation NOT flowed through note_admitted
+        assert w.audit([v]) != []
+
+    @pytest.mark.parametrize("mix", MIXES)
+    @pytest.mark.parametrize("chips", [0, 12])
+    def test_index_consistent_after_every_sim_event(self, mix, chips):
+        """The simulator feeds the WorldIndex through every event handler;
+        audit() recomputes heaps/counters/claims brute-force after EACH
+        event — thousands of arrival/admit/evict/die/shed transitions.
+        Chip-bearing totals matter: chips flip the primary share dimension
+        and make evict-AND-readmit-in-one-pass decisions common (an
+        overshooting preemption refits its own victim), the path where a
+        membership bug once hid."""
+        queues = {"prod": 0.5, "dev": 0.3, "batch": 0.2}
+        sim = PoolSimulator(
+            queues, (8 * GB, 256, chips), preemption=True, grace_ms=2_000,
+            drain_ms=5_000, min_runtime_ms=3_000, seed=18, verify_index=True,
+        )
+        report = sim.run(generate_jobs(mix, 250, queues, 18))
+        assert report.ok(), report.violations[:5]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: all four mixes through both policies (the --parity contract)
+# ---------------------------------------------------------------------------
+class TestSimParity:
+    @pytest.mark.parametrize("mix", MIXES)
+    def test_mix_parity_1000_arrivals(self, mix):
+        idx_rep, ref_rep, diff = run_parity(mix, 1000, seed=0)
+        assert diff is None, diff
+        assert idx_rep.ok(), idx_rep.violations[:5]
+        assert ref_rep.ok(), ref_rep.violations[:5]
+
+    @pytest.mark.parametrize("mix", MIXES)
+    def test_mix_parity_chip_primary(self, mix):
+        """Chips as the primary share dimension (and the
+        evict-then-readmit-in-one-pass decisions it provokes) must hold
+        trace parity too."""
+        queues = {"prod": 0.5, "dev": 0.3, "batch": 0.2}
+        idx_rep, ref_rep, diff = run_parity(
+            mix, 400, seed=18, queues=queues, totals=(8 * GB, 256, 12))
+        assert diff is None, diff
+
+    def test_diff_traces_reports_first_divergence(self):
+        a = [(3, "arrive", "x", 1.0, ("x",), (), ())]
+        b = [(3, "arrive", "x", 1.0, ("y",), (), ())]
+        msg = diff_traces(a, b)
+        assert msg is not None and "event 3" in msg and "x" in msg and "y" in msg
+        assert diff_traces(a, list(a)) is None
+        msg = diff_traces(a, a + [(9, "tick", "", 2.0, ("z",), (), ())])
+        assert "lengths differ" in msg and "event 9" in msg
+
+    def test_parity_cli_all_mixes(self, capsys):
+        from tony_tpu.cli.sim import main as sim_main
+
+        rc = sim_main(["--parity", "--jobs", "150", "--seed", "5"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.count("parity OK") == len(MIXES)
+
+    def test_sim_policy_flag_reference(self, capsys):
+        from tony_tpu.cli.sim import main as sim_main
+
+        rc = sim_main(["--mix", "batch", "--jobs", "120", "--seed", "2",
+                       "--policy", "reference"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "invariants: OK" in out
+
+
+# ---------------------------------------------------------------------------
+# pool-level incrementality + the kill switch
+# ---------------------------------------------------------------------------
+class TestPoolWorldIndex:
+    def test_unchanged_world_tick_does_zero_view_rebuilds(self):
+        from tony_tpu.cluster.pool import PoolService
+
+        svc = PoolService(secret="s", queues={"default": 1.0})
+        try:
+            svc.register_node("n0", "127.0.0.1", 1,
+                              memory_bytes=8 * GB, vcores=16)
+            svc.register_app("app_a", queue="default",
+                             memory_bytes=GB, vcores=1)
+            svc.register_app("app_b", queue="default",
+                             memory_bytes=GB, vcores=1)
+            world = svc._world
+            assert world is not None
+            passes = []
+            orig = svc._policy.schedule_world
+            svc._policy.schedule_world = (
+                lambda *a, **k: (passes.append(1), orig(*a, **k))[1])
+            created, version = world.views_created, world.version
+            with svc._lock:
+                svc._schedule_locked()  # settles: empty decision recorded
+            with svc._lock:
+                svc._schedule_locked()  # unchanged world: skipped outright
+                svc._schedule_locked()
+            assert world.views_created == created  # zero view rebuilds
+            assert world.version == version
+            assert len(passes) == 1  # only the settling pass actually ran
+        finally:
+            svc.stop()
+
+    def test_world_views_track_canonical_state(self):
+        from tony_tpu.cluster.pool import PoolService
+
+        svc = PoolService(secret="s", queues={"default": 1.0})
+        try:
+            svc.register_node("n0", "127.0.0.1", 1,
+                              memory_bytes=4 * GB, vcores=8)
+            svc.register_app("app_a", queue="default",
+                             memory_bytes=GB, vcores=1)
+            got = svc.allocate("app_a", "worker", 0, GB, 1)
+            assert "id" in got
+            v = svc._world.views["app_a"]
+            assert v.admitted and v.held == (GB, 1, 0)
+            svc.release("app_a", got["id"])
+            assert svc._world.views["app_a"].held == (0, 0, 0)
+            svc.release_all("app_a")
+            assert "app_a" not in svc._world.views
+        finally:
+            svc.stop()
+
+    def test_kill_switch_restores_reference_policy(self):
+        from tony_tpu.cluster.pool import PoolService
+
+        svc = PoolService(secret="s", queues={"default": 1.0},
+                          scheduler_indexed=False)
+        try:
+            assert type(svc._policy) is ReferencePolicy
+            assert svc._world is None
+            svc.register_node("n0", "127.0.0.1", 1,
+                              memory_bytes=4 * GB, vcores=8)
+            got = svc.register_app("app_a", queue="default",
+                                   memory_bytes=GB, vcores=1)
+            assert got["admitted"]  # the reference path still schedules
+        finally:
+            svc.stop()
+
+    def test_make_policy_rejects_unknown_impl(self):
+        with pytest.raises(ValueError, match="unknown policy"):
+            make_policy("quantum", {"default": 1.0})

@@ -243,15 +243,17 @@ class TestSimulatorInvariants:
     def test_invariant_checker_catches_a_broken_policy(self, monkeypatch):
         """Prove the checker checks: a policy that admits everyone blindly
         must trip the no-oversubscription invariant."""
-        def admit_everyone(self, apps, totals):
+        def admit_everyone(self, world, totals):
+            # schedule_world is the sim's entry point (the persistent-index
+            # path); a blind admit must still trip the checker
             d = pol.Decision()
-            for a in apps:
+            for a in world.views.values():
                 if not a.admitted:
                     a.admitted = True
                     d.admit.append(a.app_id)
             return d
 
-        monkeypatch.setattr(PreemptionPolicy, "schedule", admit_everyone)
+        monkeypatch.setattr(PreemptionPolicy, "schedule_world", admit_everyone)
         report = run_mix("batch", 50, seed=0)
         assert any("oversubscription" in v for v in report.violations)
 
@@ -290,6 +292,16 @@ class TestPolicyParity:
             "blocked_heads",
             "over_share",
             "freed_primary",
+            # r14 indexed-pass internals: the pool feeds the WorldIndex
+            # deltas and applies decisions — it must never grow its own
+            # head-selection, victim-walk, or eligibility logic
+            "waiting_in",
+            "others_waiting",
+            "victims_iter",
+            "deficit_dims",
+            "slack_left",
+            "note_admitted",
+            "note_evicted",
         ):
             assert forbidden not in src, (
                 f"{forbidden!r} found in pool.py — the scheduling algorithm "

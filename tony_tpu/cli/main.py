@@ -235,20 +235,31 @@ def _cmd_pool(argv: list[str]) -> int:
                    help="recovery journal (tony.pool.journal.file): a restarted "
                         "pool replays it and re-adopts live work instead of "
                         "forgetting every admitted app")
+    p.add_argument("--scheduler", default=None, choices=("indexed", "reference"),
+                   help="scheduler pass implementation (tony.pool.scheduler.indexed): "
+                        "'indexed' evaluates over incrementally-maintained indices, "
+                        "'reference' is the full-rescan oracle — identical decisions "
+                        "either way (tony sim --parity proves it). Default: the "
+                        "config key (site file honored), i.e. indexed")
     args = p.parse_args(argv)
 
     from tony_tpu.cluster.pool import parse_queue_spec
 
-    if not args.journal_file:
-        # honor the documented config key like pool.main does: the dev
-        # helper must not silently disable journaling an operator configured
+    scheduler_indexed = args.scheduler != "reference"
+    if not args.journal_file or args.scheduler is None:
+        # honor the documented config keys like pool.main does: the dev
+        # helper must not silently disable journaling — or un-flip the
+        # scheduler kill switch — an operator configured in the site file
         site = os.path.join(os.getcwd(), constants.TONY_SITE_CONF)
         if os.path.exists(site):
             from tony_tpu.config import TonyConfig, keys as _keys
 
-            args.journal_file = (
-                TonyConfig.from_layers(site_file=site).get(_keys.POOL_JOURNAL_FILE) or ""
-            )
+            site_conf = TonyConfig.from_layers(site_file=site)
+            if not args.journal_file:
+                args.journal_file = site_conf.get(_keys.POOL_JOURNAL_FILE) or ""
+            if args.scheduler is None:
+                scheduler_indexed = site_conf.get_bool(
+                    _keys.POOL_SCHEDULER_INDEXED, True)
     secret = os.environ.get(constants.ENV_POOL_SECRET) or secrets.token_hex(16)
     svc = PoolService(port=args.port, secret=secret,
                       queues=parse_queue_spec(args.queues),
@@ -257,7 +268,8 @@ def _cmd_pool(argv: list[str]) -> int:
                       preemption_drain_ms=args.preemption_drain_ms,
                       preemption_min_runtime_ms=args.preemption_min_runtime_ms,
                       preemption_budget=args.preemption_budget,
-                      journal_path=args.journal_file or None)
+                      journal_path=args.journal_file or None,
+                      scheduler_indexed=scheduler_indexed)
     svc.start()
     host, port = svc.address
 

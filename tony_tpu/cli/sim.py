@@ -9,7 +9,15 @@ preemption configuration BEFORE pointing real jobs at it:
     tony sim --mix bursty --jobs 2000 --seed 7 \\
         --queues "prod=0.6,dev=0.4" --drain-ms 15000 --min-runtime-ms 30000
 
-Exit code 0 = every job completed and every invariant held; 1 = a violation
+Parity mode (docs/scheduling.md "Parity mode") replays seeded mixes through
+BOTH scheduler implementations — the default indexed pass and the kept
+:class:`ReferencePolicy` oracle — and diffs their decision traces
+event-by-event, exiting nonzero on the first divergence:
+
+    tony sim --parity --jobs 1000          # all four mixes, both policies
+
+Exit code 0 = every job completed and every invariant held (and, with
+--parity, both policies decided identically); 1 = a violation or divergence
 (the report names it, and the seed reproduces it exactly); 2 = usage error.
 """
 
@@ -19,7 +27,14 @@ import argparse
 import sys
 
 from tony_tpu.cluster.pool import parse_queue_spec
-from tony_tpu.cluster.sim import GB, MIXES, PoolSimulator, generate_jobs, render_report
+from tony_tpu.cluster.sim import (
+    GB,
+    MIXES,
+    PoolSimulator,
+    generate_jobs,
+    render_report,
+    run_parity,
+)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -52,6 +67,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="tony.pool.preemption.budget (0 = unlimited)")
     p.add_argument("--budget-window-ms", type=int, default=60_000,
                    help="tony.pool.preemption.budget-window-ms")
+    p.add_argument("--policy", default="indexed", choices=("indexed", "reference"),
+                   help="scheduler pass implementation to drive "
+                        "(tony.pool.scheduler.indexed)")
+    p.add_argument("--parity", action="store_true",
+                   help="replay ALL mixes through BOTH policy implementations "
+                        "and diff decision traces event-by-event; exits 1 on "
+                        "the first divergence, printing both decisions")
     p.add_argument("--json", action="store_true", help="machine-readable report")
     args = p.parse_args(argv)
 
@@ -64,6 +86,28 @@ def main(argv: list[str] | None = None) -> int:
         print("tony sim: --jobs must be >= 1", file=sys.stderr)
         return 2
     totals = (int(args.memory * GB), int(args.vcores), int(args.chips))
+    if args.parity:
+        rc = 0
+        for mix in MIXES:
+            idx_rep, ref_rep, diff = run_parity(
+                mix, args.jobs, queues=queues, totals=totals, seed=args.seed,
+                preemption=not args.no_preemption,
+                grace_ms=args.grace_ms, drain_ms=args.drain_ms,
+                min_runtime_ms=args.min_runtime_ms,
+                eviction_budget=args.budget,
+                budget_window_ms=args.budget_window_ms,
+            )
+            if diff is not None:
+                print(f"parity FAIL [{mix}]: {diff}")
+                return 1
+            ok = idx_rep.ok() and ref_rep.ok()
+            print(f"parity OK [{mix}]: {args.jobs} arrivals, "
+                  f"{idx_rep.evictions} evictions, {idx_rep.shrinks} shrinks, "
+                  f"decision traces identical"
+                  + ("" if ok else " (invariant violations — see --mix run)"))
+            if not ok:
+                rc = 1
+        return rc
     sim = PoolSimulator(
         queues, totals,
         preemption=not args.no_preemption,
@@ -73,6 +117,7 @@ def main(argv: list[str] | None = None) -> int:
         eviction_budget=args.budget,
         budget_window_ms=args.budget_window_ms,
         seed=args.seed,
+        policy_impl=args.policy,
     )
     report = sim.run(generate_jobs(args.mix, args.jobs, queues, args.seed))
     print(render_report(report, as_json=args.json))

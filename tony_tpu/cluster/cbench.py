@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import platform
 import random
 import threading
 import time
@@ -31,7 +32,7 @@ from dataclasses import dataclass, asdict, replace
 from typing import Any
 
 from tony_tpu.cluster.journal import Journal
-from tony_tpu.cluster.policy import AppView, PreemptionPolicy
+from tony_tpu.cluster.policy import AppView, WorldIndex, make_policy
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.serve.loadgen import percentile as _percentile_of  # nearest-rank, shared
 
@@ -87,16 +88,16 @@ def _percentile(vals: list[float], q: float) -> float:
 
 
 # -------------------------------------------------- 1. scheduler decisions
-def _scheduler_world(sizes: CbenchSizes) -> tuple[PreemptionPolicy, list[AppView], tuple[int, int, int]]:
+def _scheduler_world(sizes: CbenchSizes, policy_impl: str = "indexed"):
     """A seeded 10k-app world the policy must re-decide from scratch: ~70% of
     the primary dimension held by admitted apps, thousands more waiting
     across every queue with spread priorities and wait ages."""
     rng = random.Random(sizes.seed)
     share = int(1.0 / sizes.queues * 1e6) / 1e6  # truncate: sum never exceeds 1
     queues = {f"q{i}": share for i in range(sizes.queues)}
-    policy = PreemptionPolicy(
-        queues, preemption=True, grace_ms=5_000, min_runtime_ms=10_000,
-        eviction_budget=0,
+    policy = make_policy(
+        policy_impl, queues, preemption=True, grace_ms=5_000,
+        min_runtime_ms=10_000, eviction_budget=0,
     )
     total_chips = max(sizes.apps // 2, 64)
     totals = (total_chips << 30, total_chips * 8, total_chips)
@@ -125,16 +126,31 @@ def _scheduler_world(sizes: CbenchSizes) -> tuple[PreemptionPolicy, list[AppView
     return policy, views, totals
 
 
-def bench_scheduler(sizes: CbenchSizes, passes: int = 25) -> dict[str, Any]:
-    """:meth:`PreemptionPolicy.schedule` latency over the seeded world. Each
-    pass re-decides from an identical fresh copy (the policy mutates views in
-    place), so every measurement does the same work. One unmeasured warm-up
-    pass, and the collector is parked during the timed region (a GC cycle
-    over the 10k fresh view objects would land in whichever pass it likes —
-    that is the interpreter's noise, not the policy's tail)."""
+def bench_scheduler(
+    sizes: CbenchSizes, passes: int = 25, policy_impl: str = "indexed",
+) -> dict[str, Any]:
+    """Scheduler-pass latency over the seeded world, two regimes:
+
+    **Cold** — ``schedule`` re-decides an identical fresh copy of the whole
+    world each pass (the policy mutates views in place), so every
+    measurement does the same work. One unmeasured warm-up pass, and the
+    collector is parked during the timed region (a GC cycle over the 10k
+    fresh view objects would land in whichever pass it likes — that is the
+    interpreter's noise, not the policy's tail).
+
+    **Steady-state** (indexed only) — after one cold pass settles a
+    persistent :class:`WorldIndex`, 100 repeated passes each preceded by a
+    few seeded deltas (arrivals + exits, the live pool's tick shape)
+    measure the cross-pass incrementality: ``sched_incremental_p50_ms`` is
+    what an allocate-retry tick actually costs a loaded pool, and the gate
+    watches it so the O(changed) path can't silently regress.
+
+    ``sched_policy`` records which implementation ran (provenance — an
+    indexed and a reference round are different benchmarks wearing the same
+    name)."""
     import gc
 
-    policy, template, totals = _scheduler_world(sizes)
+    policy, template, totals = _scheduler_world(sizes, policy_impl)
     times: list[float] = []
     admitted = 0
     for i in range(passes + 1):
@@ -153,11 +169,68 @@ def bench_scheduler(sizes: CbenchSizes, passes: int = 25) -> dict[str, Any]:
         policy._charges.clear()  # identical budget state every pass
     times.sort()
     total = sum(times)
-    return {
+    result = {
         "sched_decisions_per_sec": round(passes / total, 3),
         "sched_decision_p50_ms": round(_percentile(times, 0.50) * 1000, 3),
         "sched_decision_p99_ms": round(_percentile(times, 0.99) * 1000, 3),
         "sched_admitted_per_pass": admitted,
+        "sched_policy": policy_impl,
+    }
+    if hasattr(policy, "schedule_world"):
+        result.update(_bench_scheduler_steady_state(policy, template, totals, sizes))
+    return result
+
+
+def _bench_scheduler_steady_state(
+    policy, template: list[AppView], totals, sizes: CbenchSizes, ticks: int = 100,
+) -> dict[str, Any]:
+    """The cross-pass sub-bench: one cold pass over a persistent world, then
+    ``ticks`` passes with a few seeded arrivals/exits applied between them —
+    every delta flows through the same WorldIndex choke points the live pool
+    feeds."""
+    import gc
+
+    views = [replace(v) for v in template]
+    world = WorldIndex.of_views(views)
+    policy.schedule_world(world, totals)  # the cold pass settles the world
+    policy._charges.clear()
+    rng = random.Random(sizes.seed + 1)
+    now = time.monotonic()
+    seq = len(views)
+    admitted_pool = sorted(world._claim_of)
+    times: list[float] = []
+    gc.collect()
+    gc.disable()
+    try:
+        for _ in range(ticks):
+            for _ in range(3):  # a few arrivals...
+                chips = rng.randint(1, 8)
+                seq += 1
+                world.upsert(
+                    f"delta_{seq:06d}",
+                    queue=f"q{rng.randrange(sizes.queues)}",
+                    priority=rng.randrange(5), seq=seq,
+                    demand=(chips << 30, chips * 2, chips), held=(0, 0, 0),
+                    admitted=False, preempted=False,
+                    wait_since=now - 600.0, admitted_at=0.0,
+                    elastic_unit=(0, 0, 0), elastic_slack=0,
+                    shrink_pending=False,
+                )
+            for _ in range(2):  # ...and exits of admitted apps per tick
+                if admitted_pool:
+                    world.remove(admitted_pool.pop(rng.randrange(len(admitted_pool))))
+            t0 = time.perf_counter()
+            policy.schedule_world(world, totals)
+            times.append(time.perf_counter() - t0)
+            policy._charges.clear()
+            # newly admitted apps become tomorrow's exit candidates
+            admitted_pool = sorted(world._claim_of)
+    finally:
+        gc.enable()
+    times.sort()
+    return {
+        "sched_incremental_p50_ms": round(_percentile(times, 0.50) * 1000, 3),
+        "sched_incremental_passes_per_sec": round(len(times) / sum(times), 1),
     }
 
 
@@ -606,6 +679,14 @@ def run_all(sizes: CbenchSizes, workdir: str, log=print) -> dict[str, Any]:
         value=round(value, 2),
         unit="ops/s",
         sizes=asdict(sizes),
+        # machine provenance: control-plane throughputs are CPU-bound, so a
+        # record from a 2-core CI allocation and one from an 8-core box are
+        # different benchmarks wearing the same name — the gate only
+        # regresses a record against same-fingerprint peers (histserver/
+        # gate.py), exactly the sizes-provenance discipline for hardware.
+        # Deliberately coarse (core count + ISA, not the kernel string): a
+        # routine kernel patch must not orphan the whole trajectory
+        machine={"cpus": os.cpu_count() or 0, "arch": platform.machine()},
     )
     return parsed
 

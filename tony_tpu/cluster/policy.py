@@ -12,6 +12,22 @@ invariants the simulator asserts therefore hold for the production policy
 by construction, not by analogy — the same pattern chaos engineering used
 to make gang recovery provable (docs/scheduling.md).
 
+Two implementations of ONE algorithm (docs/performance.md "Scheduler pass"):
+
+- :class:`PreemptionPolicy` (default, ``tony.pool.scheduler.indexed=true``)
+  evaluates the pass over a :class:`WorldIndex` — per-queue lazy-deleted
+  heaps of waiting apps (heads pop in O(log n)), O(1) waiting counters (so
+  ``others_waiting`` is a counter compare, not a scan), incrementally
+  maintained claim aggregates, and per-queue victim orders over admitted
+  apps — so a 10k-app pass costs tens of milliseconds instead of seconds,
+  and a host that feeds the index deltas (the live pool) pays O(changed)
+  per steady-state pass instead of rebuilding the world every tick.
+- :class:`ReferencePolicy` is the original full-rescan pass, kept verbatim
+  as the oracle: the decision-equality property suite
+  (tests/test_policy_parity.py) and ``tony sim --parity`` assert both
+  implementations produce byte-identical :class:`Decision`\\s over seeded
+  worlds, so the indexed rewrite can never drift semantically.
+
 Semantics carried over from the original in-pool implementation:
 
 - **Claims-based admission**: an admitted app reserves elementwise
@@ -30,7 +46,7 @@ Semantics carried over from the original in-pool implementation:
   enough; eviction stops the moment a victim queue is no longer over its
   share; a queue at or under its share is never touched.
 
-New here (the cooperative-preemption guards, docs/scheduling.md):
+And the cooperative-preemption guards (docs/scheduling.md):
 
 - **Minimum-runtime protection** (``min_runtime_ms``): a just-admitted app
   is not evictable (or shrinkable) until it has run for the window —
@@ -45,8 +61,11 @@ New here (the cooperative-preemption guards, docs/scheduling.md):
 
 from __future__ import annotations
 
+import bisect
+import heapq
 import time
 from dataclasses import dataclass, field
+from typing import Any, Iterable, Iterator
 
 Vec = tuple[int, int, int]  # (memory_bytes, vcores, chips)
 
@@ -71,11 +90,12 @@ def validate_queue_shares(queues: dict[str, float]) -> None:
 class AppView:
     """One tenant application as the policy sees it.
 
-    The live pool builds these fresh each scheduling pass from its canonical
-    records; the simulator keeps them AS its canonical records. The policy
-    mutates the views in place exactly as the decision it returns should be
-    applied (``admitted``/``preempted`` flips, shrink-reduced ``demand``),
-    so a simulator needs no second application step.
+    The live pool keeps these as members of its :class:`WorldIndex` (built
+    once, updated by deltas); the simulator keeps them AS its canonical
+    records. The policy mutates the views in place exactly as the decision
+    it returns should be applied (``admitted``/``preempted`` flips,
+    shrink-reduced ``demand``), so a simulator needs no second application
+    step.
     """
 
     app_id: str
@@ -140,9 +160,373 @@ class Decision:
         return not (self.admit or self.evict or self.shrink)
 
 
-class PreemptionPolicy:
-    """The capacity-scheduler decision, clock-injectable and stateful only
-    in the per-queue eviction budget (a rolling log of charged evictions)."""
+# ---------------------------------------------------------------------------
+# WorldIndex: the incrementally-maintained view of the scheduling world
+# ---------------------------------------------------------------------------
+class WorldIndex:
+    """Scheduling indices over :class:`AppView`\\s, maintained by deltas.
+
+    The structures the pass needs answered fast, each updated in O(log n)
+    through the choke points every mutation already flows through:
+
+    - per-queue min-heap of WAITING apps keyed by ``sort_key`` (lazy
+      deletion: stale entries are skipped at ``head()`` time, compacted when
+      garbage outgrows the live set) — the queue head pops in O(log n);
+    - per-queue waiting COUNTERS plus a global total, so ``others_waiting``
+      is one subtraction instead of a full-list scan;
+    - global and per-queue CLAIM sums over admitted apps (elementwise
+      ``max(demand, held)``), so pass-start ``free``/``queue_used`` are a
+      copy, not a recompute;
+    - per-queue VICTIM order over admitted apps, sorted ``(priority,
+      -seq)`` (lowest priority, newest first — exactly the eviction order
+      both preemption paths want), also lazily deleted.
+
+    Entry validity is (generation, object identity): every bucket transition
+    bumps the app's generation, and a removed-then-re-registered app id gets
+    a fresh view object, so a lazily-deleted entry can never resurface —
+    asserted brute-force by :meth:`audit` after every simulator event in the
+    index-consistency suite.
+
+    Hosts feed deltas through :meth:`upsert`/:meth:`remove` (the live pool's
+    register/allocate/exit/release/drain choke points, the simulator's event
+    handlers); the policy's own in-pass mutations arrive through
+    :meth:`note_admitted`/:meth:`note_evicted`/:meth:`note_shrunk`.
+    ``version`` counts every observable change — a pass over an unchanged
+    world can be skipped entirely (see ``PreemptionPolicy.last_wake_at``).
+    """
+
+    def __init__(self) -> None:
+        self.views: dict[str, AppView] = {}
+        #: bumped on every observable change (upsert/remove/note_*/touch)
+        self.version = 0
+        #: AppView constructions performed by this index — the pool's
+        #: "an unchanged tick does zero view rebuilds" test reads this
+        self.views_created = 0
+        #: Σ claim() over admitted apps (what pass-start ``free`` subtracts)
+        self.claims: list[int] = [0, 0, 0]
+        self.queue_claims: dict[str, list[int]] = {}
+        self._claim_of: dict[str, tuple[str, Vec]] = {}  # app → (queue, vec)
+        self._waiting: dict[str, list] = {}      # queue → heap of (key, ins, gen, view)
+        self._waiting_n: dict[str, int] = {}
+        self.waiting_total = 0
+        self._victims: dict[str, list] = {}      # queue → sorted (prio, -seq, ins, gen, view)
+        self._vdead: dict[str, int] = {}
+        self._gen: dict[str, int] = {}
+        # entry tiebreaker: hosts assign unique seqs (sort keys never tie),
+        # but entries still carry a per-app insertion rank so heap/insort
+        # comparisons can never reach the AppView objects. The rank is
+        # STICKY for the app's lifetime (assigned at first sight, reused on
+        # every re-bucket): the reference breaks sort-key ties by stable
+        # position in the apps list, and an app evicted-then-re-queued
+        # keeps that position — so must its entries here.
+        self._ins = 0
+        self._ins_of: dict[str, int] = {}
+
+    # ------------------------------------------------------------- plumbing
+    def _bump(self, app_id: str) -> int:
+        g = self._gen.get(app_id, 0) + 1
+        self._gen[app_id] = g
+        return g
+
+    def _rank(self, app_id: str) -> int:
+        r = self._ins_of.get(app_id)
+        if r is None:
+            self._ins += 1
+            r = self._ins_of[app_id] = self._ins
+        return r
+
+    def _valid(self, gen: int, view: AppView) -> bool:
+        return gen == self._gen.get(view.app_id) and self.views.get(view.app_id) is view
+
+    @classmethod
+    def of_views(cls, views: Iterable[AppView]) -> "WorldIndex":
+        """Bulk-build from an existing view list (adopts the objects — the
+        in-place mutation contract of ``schedule()`` is preserved)."""
+        w = cls()
+        for ins, v in enumerate(views):
+            w.views[v.app_id] = v
+            w._gen[v.app_id] = 1
+            w._ins_of[v.app_id] = ins
+            if v.admitted:
+                c = v.claim()
+                w._claim_of[v.app_id] = (v.queue, c)
+                qc = w.queue_claims.setdefault(v.queue, [0, 0, 0])
+                for i in range(3):
+                    w.claims[i] += c[i]
+                    qc[i] += c[i]
+                w._victims.setdefault(v.queue, []).append((v.priority, -v.seq, ins, 1, v))
+            else:
+                w._waiting.setdefault(v.queue, []).append((v.sort_key, ins, 1, v))
+                w._waiting_n[v.queue] = w._waiting_n.get(v.queue, 0) + 1
+                w.waiting_total += 1
+        w._ins = len(w.views)
+        for lst in w._victims.values():
+            lst.sort(key=lambda e: e[:3])
+        for h in w._waiting.values():
+            heapq.heapify(h)
+        return w
+
+    # ---------------------------------------------------------- bucket moves
+    def _waiting_insert(self, v: AppView) -> None:
+        gen = self._bump(v.app_id)
+        heap = self._waiting.setdefault(v.queue, [])
+        heapq.heappush(heap, (v.sort_key, self._rank(v.app_id), gen, v))
+        n = self._waiting_n.get(v.queue, 0) + 1
+        self._waiting_n[v.queue] = n
+        self.waiting_total += 1
+        if len(heap) > 2 * n + 64:
+            live = [e for e in heap if self._valid(e[2], e[3])]
+            heapq.heapify(live)
+            self._waiting[v.queue] = live
+
+    def _waiting_remove(self, v: AppView) -> None:
+        self._bump(v.app_id)  # entry goes stale; head() skips it
+        self._waiting_n[v.queue] = self._waiting_n.get(v.queue, 0) - 1
+        self.waiting_total -= 1
+
+    def _victims_insert(self, v: AppView) -> None:
+        gen = self._bump(v.app_id)
+        bisect.insort(
+            self._victims.setdefault(v.queue, []),
+            (v.priority, -v.seq, self._rank(v.app_id), gen, v),
+            key=lambda e: e[:3],
+        )
+
+    def _victims_remove(self, v: AppView) -> None:
+        self._bump(v.app_id)
+        self._vdead[v.queue] = self._vdead.get(v.queue, 0) + 1
+
+    def _account(self, v: AppView) -> None:
+        """Reconcile the claim sums with the view's current fields."""
+        cur = self._claim_of.get(v.app_id)
+        if v.admitted:
+            new = v.claim()
+            if cur is not None:
+                q0, c0 = cur
+                if q0 == v.queue and c0 == new:
+                    return
+                qc = self.queue_claims[q0]
+                for i in range(3):
+                    self.claims[i] -= c0[i]
+                    qc[i] -= c0[i]
+            qc = self.queue_claims.setdefault(v.queue, [0, 0, 0])
+            for i in range(3):
+                self.claims[i] += new[i]
+                qc[i] += new[i]
+            self._claim_of[v.app_id] = (v.queue, new)
+        elif cur is not None:
+            q0, c0 = cur
+            qc = self.queue_claims[q0]
+            for i in range(3):
+                self.claims[i] -= c0[i]
+                qc[i] -= c0[i]
+            del self._claim_of[v.app_id]
+
+    # ------------------------------------------------------------ pass reads
+    def head(self, q: str) -> AppView | None:
+        """Highest-priority, oldest waiting app of queue ``q`` (or None) —
+        stale heap tops are discarded on the way."""
+        heap = self._waiting.get(q)
+        while heap:
+            _, _, gen, v = heap[0]
+            if self._valid(gen, v):
+                return v
+            heapq.heappop(heap)
+        return None
+
+    def waiting_count(self, q: str) -> int:
+        return self._waiting_n.get(q, 0)
+
+    def victims_iter(self, q: str) -> Iterator[AppView]:
+        """Admitted apps of queue ``q`` in eviction order (lowest priority
+        first, then newest first), stale entries skipped; compacts first
+        when garbage outgrows the live half."""
+        lst = self._victims.get(q)
+        if not lst:
+            return iter(())
+        if self._vdead.get(q, 0) * 2 > len(lst):
+            lst = [e for e in lst if self._valid(e[3], e[4])]
+            self._victims[q] = lst
+            self._vdead[q] = 0
+
+        def it():
+            for _, _, _, gen, v in lst:
+                if self._valid(gen, v):
+                    yield v
+        return it()
+
+    # -------------------------------------------- policy in-pass choke points
+    def note_admitted(self, v: AppView) -> None:
+        self._waiting_remove(v)
+        self._victims_insert(v)
+        self._account(v)
+        self.version += 1
+
+    def note_evicted(self, v: AppView) -> None:
+        self._victims_remove(v)
+        self._waiting_insert(v)
+        self._account(v)
+        self.version += 1
+
+    def note_shrunk(self, v: AppView) -> None:
+        self._account(v)  # demand changed; bucket did not
+        self.version += 1
+
+    # --------------------------------------------------- host-facing deltas
+    def upsert(self, app_id: str, **fields: Any) -> AppView:
+        """Create or reconcile one app's view. Unknown apps are registered;
+        known apps have only the CHANGED fields applied, re-bucketing /
+        re-accounting as needed. A no-op upsert (all fields equal) does not
+        bump ``version``."""
+        v = self.views.get(app_id)
+        if v is None:
+            v = AppView(app_id=app_id, **fields)
+            self.views[app_id] = v
+            self.views_created += 1
+            if v.admitted:
+                self._victims_insert(v)
+                self._account(v)
+            else:
+                self._waiting_insert(v)
+            self.version += 1
+            return v
+        changed = [k for k, val in fields.items() if getattr(v, k) != val]
+        if not changed:
+            return v
+        rebucket = any(k in ("queue", "priority", "seq", "admitted") for k in changed)
+        if rebucket:
+            if v.admitted:
+                self._victims_remove(v)
+            else:
+                self._waiting_remove(v)
+        for k in changed:
+            setattr(v, k, fields[k])
+        if rebucket:
+            if v.admitted:
+                self._victims_insert(v)
+            else:
+                self._waiting_insert(v)
+        self._account(v)
+        self.version += 1
+        return v
+
+    def remove(self, app_id: str) -> None:
+        v = self.views.pop(app_id, None)
+        if v is None:
+            return
+        if v.admitted:
+            self._victims_remove(v)
+            q0, c0 = self._claim_of.pop(app_id)
+            qc = self.queue_claims[q0]
+            for i in range(3):
+                self.claims[i] -= c0[i]
+                qc[i] -= c0[i]
+        else:
+            self._waiting_remove(v)
+        # the generation stays monotonic (never reset) so a removed view
+        # RE-ADOPTED under the same id — the simulator re-enlists the same
+        # object after an evicted victim finishes dying — can never match a
+        # straggler entry from its earlier life; the identity check guards
+        # the other direction (same id, fresh object). The insertion rank
+        # IS dropped: a fresh registration appends at the end of the host's
+        # record dict, and the stable-sort tiebreak must follow it there.
+        self._bump(app_id)
+        self._ins_of.pop(app_id, None)
+        self.version += 1
+
+    def adopt(self, view: AppView) -> None:
+        """Enlist an EXISTING view object (the simulator's canonical
+        records) instead of constructing one — the policy's in-place
+        mutation contract then applies to the caller's object directly."""
+        if view.app_id in self.views:
+            self.remove(view.app_id)
+        self.views[view.app_id] = view
+        if view.admitted:
+            self._victims_insert(view)
+            self._account(view)
+        else:
+            self._waiting_insert(view)
+        self.version += 1
+
+    def reaccount(self, view: AppView) -> None:
+        """The caller mutated a member view's claim inputs (``held``, a
+        landed shrink) without changing its bucket — reconcile the sums."""
+        self._account(view)
+        self.version += 1
+
+    def touch(self) -> None:
+        """World changed outside the views (pool totals: node registered or
+        lost) — invalidates any cached no-decision conclusion."""
+        self.version += 1
+
+    # ------------------------------------------------------------ diagnostics
+    def audit(self, expected: Iterable[AppView]) -> list[str]:
+        """Brute-force consistency check against the authoritative view set
+        (the index-consistency test suite runs this after every simulator
+        event). Returns human-readable discrepancies; [] = consistent."""
+        errs: list[str] = []
+        exp = {v.app_id: v for v in expected}
+        if set(exp) != set(self.views):
+            errs.append(f"membership: index={sorted(self.views)} expected={sorted(exp)}")
+            return errs
+        for app_id, v in exp.items():
+            if self.views[app_id] is not v:
+                errs.append(f"{app_id}: index holds a different object")
+        claims = [0, 0, 0]
+        queue_claims: dict[str, list[int]] = {}
+        waiting_n: dict[str, int] = {}
+        for v in exp.values():
+            if v.admitted:
+                c = v.claim()
+                qc = queue_claims.setdefault(v.queue, [0, 0, 0])
+                for i in range(3):
+                    claims[i] += c[i]
+                    qc[i] += c[i]
+            else:
+                waiting_n[v.queue] = waiting_n.get(v.queue, 0) + 1
+        if claims != self.claims:
+            errs.append(f"claims: index={self.claims} expected={claims}")
+        for q, qc in queue_claims.items():
+            if self.queue_claims.get(q, [0, 0, 0]) != qc:
+                errs.append(f"queue_claims[{q}]: index={self.queue_claims.get(q)} expected={qc}")
+        for q, qc in self.queue_claims.items():
+            if any(qc) and q not in queue_claims:
+                errs.append(f"queue_claims[{q}]: stale nonzero {qc}")
+        if self.waiting_total != sum(waiting_n.values()):
+            errs.append(f"waiting_total: index={self.waiting_total} "
+                        f"expected={sum(waiting_n.values())}")
+        queues = set(waiting_n) | set(self._waiting_n) | set(self._victims) | set(self._waiting)
+        for q in queues:
+            if self._waiting_n.get(q, 0) != waiting_n.get(q, 0):
+                errs.append(f"waiting_n[{q}]: index={self._waiting_n.get(q, 0)} "
+                            f"expected={waiting_n.get(q, 0)}")
+            live = [e[3] for e in self._waiting.get(q, []) if self._valid(e[2], e[3])]
+            want = {v.app_id for v in exp.values() if v.queue == q and not v.admitted}
+            if {v.app_id for v in live} != want:
+                errs.append(f"waiting[{q}]: live entries {sorted(v.app_id for v in live)} "
+                            f"!= expected {sorted(want)}")
+            expected_head = min(
+                (v for v in exp.values() if v.queue == q and not v.admitted),
+                key=lambda v: v.sort_key, default=None)
+            got_head = self.head(q)
+            if (got_head.app_id if got_head else None) != (
+                    expected_head.app_id if expected_head else None):
+                errs.append(f"head[{q}]: index={got_head} expected={expected_head}")
+            vics = [v.app_id for v in self.victims_iter(q)]
+            want_vics = [v.app_id for v in sorted(
+                (v for v in exp.values() if v.queue == q and v.admitted),
+                key=lambda v: (v.priority, -v.seq))]
+            if vics != want_vics:
+                errs.append(f"victims[{q}]: index={vics} expected={want_vics}")
+        return errs
+
+
+# ---------------------------------------------------------------------------
+# Shared policy core: construction + the anti-thrash guards
+# ---------------------------------------------------------------------------
+class _PolicyCore:
+    """Guards and configuration shared by both implementations, stateful
+    only in the per-queue eviction budget (a rolling log of charges)."""
 
     def __init__(
         self,
@@ -191,10 +575,45 @@ class PreemptionPolicy:
         if self.eviction_budget > 0:
             self._charges.setdefault(queue, []).extend([now] * n)
 
-    # --------------------------------------------------------- scheduling
     @staticmethod
     def _fits(free: list[int], demand: Vec) -> bool:
         return all(f >= d for f, d in zip(free, demand))
+
+
+# ---------------------------------------------------------------------------
+# ReferencePolicy: the original full-rescan pass, kept as the parity oracle
+# ---------------------------------------------------------------------------
+class _WaitingCounts:
+    """O(1) ``others_waiting`` for the reference pass: the original
+    recomputed ``any(a for a in apps if not a.admitted and a.queue != q)``
+    per queue per admit iteration — a full scan that made the ORACLE itself
+    quadratic. Hoisted into counters maintained at the admit/evict choke
+    points; pure bookkeeping, zero effect on decisions."""
+
+    def __init__(self, apps: list[AppView]):
+        self.by_queue: dict[str, int] = {}
+        for a in apps:
+            if not a.admitted:
+                self.by_queue[a.queue] = self.by_queue.get(a.queue, 0) + 1
+        self.total = sum(self.by_queue.values())
+
+    def admitted(self, a: AppView) -> None:
+        self.by_queue[a.queue] -= 1
+        self.total -= 1
+
+    def evicted(self, a: AppView) -> None:
+        self.by_queue[a.queue] = self.by_queue.get(a.queue, 0) + 1
+        self.total += 1
+
+    def elsewhere(self, q: str) -> bool:
+        return self.total - self.by_queue.get(q, 0) > 0
+
+
+class ReferencePolicy(_PolicyCore):
+    """The original O(admits × queues × n log n) pass. Not the default —
+    kept as the executable specification the indexed implementation is
+    property-tested against, and as the ``tony.pool.scheduler.indexed=false``
+    kill switch's target."""
 
     def schedule(self, apps: list[AppView], totals: Vec) -> Decision:
         """One admission pass over the current world state.
@@ -214,6 +633,7 @@ class PreemptionPolicy:
         for a in apps:
             if a.admitted:
                 queue_used[a.queue] = queue_used.get(a.queue, 0) + claims[a.app_id][primary]
+        counts = _WaitingCounts(apps)
 
         def waiting_in(q: str) -> list[AppView]:
             return sorted(
@@ -228,6 +648,7 @@ class PreemptionPolicy:
             for i in range(3):
                 free[i] -= app.demand[i]
             queue_used[app.queue] = queue_used.get(app.queue, 0) + app.demand[primary]
+            counts.admitted(app)
 
         while True:
             eligible: list[tuple[float, tuple[int, int], AppView]] = []
@@ -240,9 +661,7 @@ class PreemptionPolicy:
                 if not self._fits(free, head.demand):
                     blocked_heads.append(head)
                     continue
-                others_waiting = any(
-                    a for a in apps if not a.admitted and a.queue != q
-                )
+                others_waiting = counts.elsewhere(q)
                 cap = share * totals[primary]
                 over_share = queue_used.get(q, 0) + head.demand[primary] > cap
                 if over_share and others_waiting and queue_used.get(q, 0) > 0:
@@ -260,7 +679,7 @@ class PreemptionPolicy:
                 blocked_heads.sort(key=lambda a: a.sort_key)
                 if self._preempt_for(
                     blocked_heads[0], apps, free, queue_used, primary, totals,
-                    admit, decision, now,
+                    admit, decision, now, counts,
                 ):
                     continue
                 # same-queue priority preemption didn't help: try restoring
@@ -270,11 +689,11 @@ class PreemptionPolicy:
                 if any(
                     self._reclaim_across_queues(
                         h, apps, free, queue_used, primary, totals,
-                        admit, decision, now, allow_shrink=True,
+                        admit, decision, now, counts, allow_shrink=True,
                     )
                     or self._reclaim_across_queues(
                         h, apps, free, queue_used, primary, totals,
-                        admit, decision, now, allow_shrink=False,
+                        admit, decision, now, counts, allow_shrink=False,
                     )
                     for h in blocked_heads
                 ):
@@ -292,6 +711,7 @@ class PreemptionPolicy:
         admit,
         decision: Decision,
         now: float,
+        counts: _WaitingCounts,
     ) -> bool:
         """Evict strictly-lower-priority admitted apps from ``cand``'s own
         queue (lowest priority, newest first) and admit ``cand`` in the SAME
@@ -327,9 +747,7 @@ class PreemptionPolicy:
             return False
         net_growth = demand[primary] - freed_primary
         if net_growth > 0:
-            others_waiting = any(
-                a for a in apps if not a.admitted and a.queue != cand.queue
-            )
+            others_waiting = counts.elsewhere(cand.queue)
             used_after = queue_used.get(cand.queue, 0) - freed_primary
             cap = self.queues.get(cand.queue, 1.0) * totals[primary]
             if others_waiting and used_after > 0 and used_after + demand[primary] > cap:
@@ -338,7 +756,7 @@ class PreemptionPolicy:
             return False  # aggressor queue spent its preemption budget: wait
         self._charge(cand.queue, len(chosen), now)
         for v in chosen:
-            self._do_evict(v, cand, free, queue_used, primary, decision, now)
+            self._do_evict(v, cand, free, queue_used, primary, decision, now, counts)
         admit(cand)
         return True
 
@@ -351,6 +769,7 @@ class PreemptionPolicy:
         primary: int,
         decision: Decision,
         now: float,
+        counts: _WaitingCounts,
     ) -> None:
         """Demote an admitted app back to waiting and return its claim to
         the pass-local pool. The caller (pool: drain/kill its containers;
@@ -362,6 +781,7 @@ class PreemptionPolicy:
             free[i] += c[i]
         queue_used[v.queue] -= c[primary]
         decision.evict.append(Eviction(app_id=v.app_id, for_app=cand.app_id))
+        counts.evicted(v)
 
     def _reclaim_across_queues(
         self,
@@ -374,6 +794,7 @@ class PreemptionPolicy:
         admit,
         decision: Decision,
         now: float,
+        counts: _WaitingCounts,
         allow_shrink: bool,
     ) -> bool:
         """Cross-queue capacity reclaim (the YARN capacity-scheduler
@@ -492,6 +913,328 @@ class PreemptionPolicy:
             queue_used[v.queue] -= k * unit[primary]
             decision.shrink.append(Shrink(app_id=app_id, workers=k, for_app=cand.app_id))
         for v in chosen:
-            self._do_evict(v, cand, free, queue_used, primary, decision, now)
+            self._do_evict(v, cand, free, queue_used, primary, decision, now, counts)
         admit(cand)
         return True
+
+
+# ---------------------------------------------------------------------------
+# PreemptionPolicy: the indexed pass (the default implementation)
+# ---------------------------------------------------------------------------
+class PreemptionPolicy(_PolicyCore):
+    """The capacity-scheduler decision evaluated over a :class:`WorldIndex`.
+
+    Same inputs, same mutations, byte-identical :class:`Decision`\\s as
+    :class:`ReferencePolicy` (the property-tested contract) — but each admit
+    iteration reads heap heads and counters instead of re-scanning and
+    re-sorting every view, and both preemption paths walk maintained victim
+    orders instead of re-filtering all admitted apps. ``schedule`` builds a
+    transient index per call (the simulator's usage); a host that KEEPS a
+    ``WorldIndex`` and feeds it deltas calls :meth:`schedule_world` and pays
+    O(changed) per steady-state pass (the live pool's usage,
+    docs/performance.md "Scheduler pass").
+
+    After a pass that returned an empty decision, ``last_wake_at`` tells the
+    host when the verdict could change WITHOUT a world delta (the earliest
+    grace/min-runtime/budget-window expiry consulted): ``None`` means the
+    outcome is pure world-state — the host may skip re-evaluating until the
+    index's ``version`` moves, which is what makes an idle pool tick cost
+    microseconds."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        #: earliest policy-clock instant a time-gated guard consulted by the
+        #: last pass will expire (None → last pass was time-independent)
+        self.last_wake_at: float | None = None
+
+    def _wake(self, t: float) -> None:
+        if self.last_wake_at is None or t < self.last_wake_at:
+            self.last_wake_at = t
+
+    def _note_protected(self, app: AppView, now: float) -> bool:
+        if self._protected(app, now):
+            self._wake(app.admitted_at + self.min_runtime_ms / 1000.0)
+            return True
+        return False
+
+    def _wake_budget(self, queue: str, now: float) -> None:
+        if self.eviction_budget > 0:
+            log = self._charges.get(queue)
+            if log:
+                self._wake(min(log) + self.budget_window_ms / 1000.0)
+
+    def schedule(self, apps: list[AppView], totals: Vec) -> Decision:
+        """One admission pass over a transient index of ``apps`` (built per
+        call — the list's view objects are adopted and mutated in place, the
+        same contract as the reference)."""
+        return self.schedule_world(WorldIndex.of_views(apps), totals)
+
+    def schedule_world(self, world: WorldIndex, totals: Vec) -> Decision:
+        """One admission pass over a maintained :class:`WorldIndex`. The
+        pass mutates the world's views AND its indices through the admit/
+        evict/shrink choke points, so the index stays consistent for the
+        next pass without a rebuild."""
+        decision = Decision()
+        self.last_wake_at = None
+        if not any(totals):
+            return decision  # no capacity registered yet — everything waits
+        primary = 2 if totals[2] > 0 else 0  # chips when the pool has chips
+        now = self.clock()
+        # pass-local working state, copied off the maintained aggregates
+        # (pass-start cost: O(queues), not O(apps))
+        free = [t - c for t, c in zip(totals, world.claims)]
+        queue_used: dict[str, int] = {q: 0 for q in self.queues}
+        for q, qc in world.queue_claims.items():
+            if qc[primary]:
+                queue_used[q] = queue_used.get(q, 0) + qc[primary]
+
+        def admit(app: AppView) -> None:
+            app.admitted, app.preempted = True, False
+            app.admitted_at = now
+            decision.admit.append(app.app_id)
+            for i in range(3):
+                free[i] -= app.demand[i]
+            queue_used[app.queue] = queue_used.get(app.queue, 0) + app.demand[primary]
+            world.note_admitted(app)
+
+        def do_evict(v: AppView, cand: AppView) -> None:
+            c = v.claim()
+            v.admitted, v.preempted = False, True
+            v.wait_since = now
+            for i in range(3):
+                free[i] += c[i]
+            queue_used[v.queue] -= c[primary]
+            decision.evict.append(Eviction(app_id=v.app_id, for_app=cand.app_id))
+            world.note_evicted(v)
+
+        while True:
+            best: tuple[tuple[float, tuple[int, int]], AppView] | None = None
+            blocked_heads: list[AppView] = []
+            for q, share in self.queues.items():
+                head = world.head(q)
+                if head is None:
+                    continue
+                if not self._fits(free, head.demand):
+                    blocked_heads.append(head)
+                    continue
+                used = queue_used.get(q, 0)
+                others_waiting = world.waiting_total - world.waiting_count(q) > 0
+                cap = share * totals[primary]
+                over_share = used + head.demand[primary] > cap
+                if over_share and others_waiting and used > 0:
+                    # queue is over its share while others wait (elastic
+                    # borrowing only applies to an otherwise-idle pool; a
+                    # queue's FIRST app always may run)
+                    blocked_heads.append(head)
+                    continue
+                key = (used / share, head.sort_key)
+                if best is None or key < best[0]:
+                    best = (key, head)
+            if best is not None:
+                admit(best[1])
+                continue
+            if self.preemption and blocked_heads:
+                blocked_heads.sort(key=lambda a: a.sort_key)
+                if self._preempt_for(
+                    blocked_heads[0], world, free, queue_used, primary, totals,
+                    admit, do_evict, now,
+                ):
+                    continue
+                if any(
+                    self._reclaim_across_queues(
+                        h, world, free, queue_used, primary, totals,
+                        admit, do_evict, decision, now, allow_shrink=True,
+                    )
+                    or self._reclaim_across_queues(
+                        h, world, free, queue_used, primary, totals,
+                        admit, do_evict, decision, now, allow_shrink=False,
+                    )
+                    for h in blocked_heads
+                ):
+                    continue
+            return decision
+
+    def _preempt_for(
+        self,
+        cand: AppView,
+        world: WorldIndex,
+        free: list[int],
+        queue_used: dict[str, int],
+        primary: int,
+        totals: Vec,
+        admit,
+        do_evict,
+        now: float,
+    ) -> bool:
+        """Same-queue priority preemption over the maintained victim order
+        (see ``ReferencePolicy._preempt_for`` for the full semantics). The
+        victim walk stops at the first admitted app whose priority reaches
+        ``cand``'s — everything after it in (priority, -seq) order is
+        ineligible by construction."""
+        demand = cand.demand
+        chosen: list[AppView] = []
+        trial = list(free)
+        freed_primary = 0
+        for v in world.victims_iter(cand.queue):
+            if v.priority >= cand.priority:
+                break
+            if v.shrink_pending or self._note_protected(v, now):
+                continue
+            if self._fits(trial, demand):
+                break
+            c = v.claim()
+            for i in range(3):
+                trial[i] += c[i]
+            freed_primary += c[primary]
+            chosen.append(v)
+        if not chosen or not self._fits(trial, demand):
+            return False
+        net_growth = demand[primary] - freed_primary
+        if net_growth > 0:
+            others_waiting = world.waiting_total - world.waiting_count(cand.queue) > 0
+            used_after = queue_used.get(cand.queue, 0) - freed_primary
+            cap = self.queues.get(cand.queue, 1.0) * totals[primary]
+            if others_waiting and used_after > 0 and used_after + demand[primary] > cap:
+                return False
+        if len(chosen) > self._budget_remaining(cand.queue, now):
+            self._wake_budget(cand.queue, now)
+            return False  # aggressor queue spent its preemption budget: wait
+        self._charge(cand.queue, len(chosen), now)
+        for v in chosen:
+            do_evict(v, cand)
+        admit(cand)
+        return True
+
+    def _reclaim_across_queues(
+        self,
+        cand: AppView,
+        world: WorldIndex,
+        free: list[int],
+        queue_used: dict[str, int],
+        primary: int,
+        totals: Vec,
+        admit,
+        do_evict,
+        decision: Decision,
+        now: float,
+        allow_shrink: bool,
+    ) -> bool:
+        """Cross-queue reclaim over the maintained victim orders (see
+        ``ReferencePolicy._reclaim_across_queues`` for the full semantics —
+        rules and outcome are identical; only the victim lookup changed
+        from sort-everything to walk-the-index)."""
+        demand = cand.demand
+        cap_cand = self.queues.get(cand.queue, 1.0) * totals[primary]
+        if queue_used.get(cand.queue, 0) + demand[primary] > cap_cand:
+            return False  # head would overshoot its own guarantee
+        if now - cand.wait_since < self.grace_ms / 1000.0:
+            self._wake(cand.wait_since + self.grace_ms / 1000.0)
+            return False
+        trial = list(free)
+        trial_used = dict(queue_used)
+        chosen: list[AppView] = []
+        chosen_ids: set[str] = set()
+        shrinks: dict[str, int] = {}          # app_id → workers to shed
+        slack_left: dict[str, int] = {}       # lazily seeded from the views
+        while not self._fits(trial, demand):
+            # most over-share queue first (by primary-dimension excess)
+            best: tuple[float, AppView] | None = None
+            for q, share in self.queues.items():
+                if q == cand.queue:
+                    continue
+                excess = trial_used.get(q, 0) - share * totals[primary]
+                if excess <= 0:
+                    continue  # at or under share: protected from reclaim
+                victim: AppView | None = None
+                for v in world.victims_iter(q):
+                    # an app shrunk earlier THIS pass is settled: shedding
+                    # took it as far as its slack allows, and shrinking and
+                    # whole-evicting the same app would double-free it (the
+                    # pure-evict fallback pass may still evict it whole)
+                    if (v.app_id in chosen_ids or v.app_id in shrinks
+                            or v.shrink_pending or self._note_protected(v, now)):
+                        continue
+                    victim = v
+                    break
+                if victim is not None and (best is None or excess > best[0]):
+                    best = (excess, victim)
+            if best is None:
+                return False  # no eligible borrower left and cand still unfit
+            excess, v = best
+            unit = v.elastic_unit
+            deficit_dims = [
+                i for i in range(3) if unit[i] > 0 and demand[i] - trial[i] > 0
+            ]
+            if allow_shrink and slack_left.get(v.app_id, v.elastic_slack) > 0 and deficit_dims:
+                # partial reclaim: shed the fewest workers that cover the
+                # remaining deficit in every dimension a worker frees,
+                # capped by the victim's slack and by its queue's excess —
+                # FLOOR division, so shrink never digs the queue below its
+                # share (a fractional-unit remainder is left for whole-gang
+                # eviction, which IS allowed to straddle the share line)
+                deficit_k = max(
+                    -(-(demand[i] - trial[i]) // unit[i]) for i in deficit_dims
+                )
+                k = min(
+                    slack_left.get(v.app_id, v.elastic_slack),
+                    deficit_k,
+                    int(excess // unit[primary]) if unit[primary] > 0 else deficit_k,
+                )
+                if k >= 1:
+                    shrinks[v.app_id] = shrinks.get(v.app_id, 0) + k
+                    slack_left[v.app_id] = slack_left.get(v.app_id, v.elastic_slack) - k
+                    for i in range(3):
+                        trial[i] += k * unit[i]
+                    trial_used[v.queue] -= k * unit[primary]
+                    continue
+                # a worker sheds nothing useful for this deficit: fall
+                # through to whole-gang eviction of this victim
+            c = v.claim()
+            for i in range(3):
+                trial[i] += c[i]
+            trial_used[v.queue] -= c[primary]
+            chosen.append(v)
+            chosen_ids.add(v.app_id)
+        disruptions = len(chosen) + len(shrinks)
+        if disruptions > self._budget_remaining(cand.queue, now):
+            self._wake_budget(cand.queue, now)
+            return False  # aggressor queue spent its preemption budget: wait
+        self._charge(cand.queue, disruptions, now)
+        for app_id, k in shrinks.items():
+            v = world.views[app_id]
+            unit = v.elastic_unit
+            v.demand = tuple(max(d - k * u, 0) for d, u in zip(v.demand, unit))  # type: ignore[assignment]
+            v.elastic_slack -= k
+            v.shrink_pending = True
+            for i in range(3):
+                free[i] += k * unit[i]
+            queue_used[v.queue] -= k * unit[primary]
+            decision.shrink.append(Shrink(app_id=app_id, workers=k, for_app=cand.app_id))
+            world.note_shrunk(v)
+        for v in chosen:
+            do_evict(v, cand)
+        admit(cand)
+        return True
+
+
+#: importable alias: the indexed implementation IS the default policy class
+IndexedPolicy = PreemptionPolicy
+
+#: ``tony.pool.scheduler.indexed`` / ``tony sim --policy`` spellings
+POLICY_IMPLS: dict[str, type[_PolicyCore]] = {
+    "indexed": PreemptionPolicy,
+    "reference": ReferencePolicy,
+}
+
+
+def make_policy(impl: str, queues: dict[str, float], **kwargs) -> _PolicyCore:
+    """Construct the named implementation (``indexed``/``reference``) —
+    the kill-switch seam the pool, the simulator, and cbench all share."""
+    try:
+        cls = POLICY_IMPLS[impl]
+    except KeyError:
+        raise ValueError(
+            f"unknown policy implementation {impl!r} (choose from "
+            f"{sorted(POLICY_IMPLS)})"
+        ) from None
+    return cls(queues, **kwargs)

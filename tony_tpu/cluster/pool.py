@@ -52,6 +52,8 @@ from tony_tpu.cluster.journal import (
 from tony_tpu.cluster.policy import (
     AppView,
     PreemptionPolicy,
+    WorldIndex,
+    make_policy,
     validate_queue_shares as _validate_queue_shares,
 )
 from tony_tpu.cluster.resources import (
@@ -237,6 +239,7 @@ class PoolService:
         preemption_budget_window_ms: int = 60_000,
         journal_path: str | None = None,
         journal_compact_every: int = 0,
+        scheduler_indexed: bool = True,
         chaos=None,
     ):
         self.heartbeat_interval_ms = heartbeat_interval_ms
@@ -251,8 +254,12 @@ class PoolService:
         # deadline. 0 → the classic immediate kill path.
         self.preemption_drain_ms = preemption_drain_ms
         # the decision itself is the pure policy module — the same code
-        # `tony sim` drives over thousands of synthetic arrivals
-        self._policy = PreemptionPolicy(
+        # `tony sim` drives over thousands of synthetic arrivals. Default is
+        # the indexed implementation over a delta-fed WorldIndex;
+        # tony.pool.scheduler.indexed=false restores the reference pass
+        # (identical semantics, full world rescan per pass)
+        self._policy = make_policy(
+            "indexed" if scheduler_indexed else "reference",
             self.queues,
             preemption=preemption,
             grace_ms=preemption_grace_ms,
@@ -260,6 +267,19 @@ class PoolService:
             eviction_budget=preemption_budget,
             budget_window_ms=preemption_budget_window_ms,
         )
+        # cross-pass incrementality (docs/performance.md "Scheduler pass"):
+        # the index holds one persistent AppView per app, updated by deltas
+        # at the same choke points that journal — a scheduling pass reads
+        # maintained heads/counters/claim sums instead of rebuilding every
+        # view, and a tick over an unchanged world is skipped outright
+        self._world: WorldIndex | None = WorldIndex() if scheduler_indexed else None
+        self._sched_seen_version = -1
+        self._sched_last_empty = False
+        self._sched_wake_at: float | None = None
+        # held resources per app over RUNNING containers, maintained at the
+        # container create/exit/release transitions so neither the policy
+        # views nor pool_status rescan every container record
+        self._app_held: dict[str, list[int]] = {}
         #: optional fault-injection context (pool-crash); None in production
         self.chaos = chaos
         self._nodes: dict[str, _Node] = {}
@@ -294,6 +314,7 @@ class PoolService:
                         # streamed: a 100k-record history folds record by
                         # record without ever materializing as a list
                         self._recover_from_journal_locked(iter_journal(journal_path))
+                        self._rebuild_derived_locked()
                     obs_logging.info(
                         f"[tony-pool] recovered from journal: "
                         f"{len(self._apps)} app(s), "
@@ -311,6 +332,7 @@ class PoolService:
                         self._app_exits = {}
                         self._drains = {}
                         self._app_seq = itertools.count()
+                        self._rebuild_derived_locked()
             self._journal = Journal(journal_path)
         self.rpc = RpcServer(host=bind_host, port=port, secret=secret)
         self.rpc.register_object(self, POOL_RPC_METHODS)
@@ -656,6 +678,8 @@ class PoolService:
                     and rec["state"] == _RUNNING and rec["node"] == name
                 )
             )
+            if self._world is not None:
+                self._world.touch()  # pool totals moved with the node set
             self._schedule_locked()
         return {
             "ack": True,
@@ -739,6 +763,7 @@ class PoolService:
             app.demand_chips = int(chips)
             app.elastic_unit = tuple(int(x) for x in (elastic_unit or (0, 0, 0)))
             app.elastic_slack = max(int(elastic_slack), 0)
+            self._world_upsert_locked(app)
             self._schedule_locked()
             self._journal_app_locked(app)
             return {"ack": True, "queue": queue, "admitted": app.admitted}
@@ -825,6 +850,7 @@ class PoolService:
             app.demand_chips = max(app.demand_chips, held[2] + chips)
             if (app.demand_memory, app.demand_vcores, app.demand_chips) != before:
                 self._journal_app_locked(app)
+            self._world_upsert_locked(app)
             if not app.admitted:
                 self._schedule_locked()
             if not app.admitted:
@@ -892,6 +918,7 @@ class PoolService:
                     "state": _RUNNING,
                 }
                 self._containers[cid] = rec
+                self._held_add_locked(app_id, int(memory_bytes), int(vcores), len(coords))
                 self._jlog_locked("container", rec=dict(rec))
                 return {
                     **rec,
@@ -930,6 +957,8 @@ class PoolService:
                     self._release_locked(cid)
             self._app_exits.pop(app_id, None)
             self._apps.pop(app_id, None)  # app done: leave the queue entirely
+            if self._world is not None:
+                self._world.remove(app_id)
             self._cancelled.pop(app_id, None)
             if self._drains.pop(app_id, None) is not None:
                 # the app left the pool mid-drain (finished, or torn down):
@@ -1034,6 +1063,7 @@ class PoolService:
                     q: queue_status(q, share) for q, share in self.queues.items()
                 },
                 "preemption": self.preemption,
+                "scheduler": "indexed" if self._world is not None else "reference",
                 "drains_active": len(self._drains),
             }
 
@@ -1068,13 +1098,71 @@ class PoolService:
         )
 
     def _held_locked(self, app_id: str) -> tuple[int, int, int]:
-        mem = vc = ch = 0
+        h = self._app_held.get(app_id)
+        return (h[0], h[1], h[2]) if h else (0, 0, 0)
+
+    def _held_add_locked(self, app_id: str, mem: int, vc: int, chips: int) -> None:
+        """Container create/exit/release delta to the app's held totals (the
+        incremental twin of scanning every RUNNING container record)."""
+        h = self._app_held.setdefault(app_id, [0, 0, 0])
+        h[0] += mem
+        h[1] += vc
+        h[2] += chips
+        if not any(h):
+            self._app_held.pop(app_id, None)
+        app = self._apps.get(app_id)
+        if app is not None:
+            self._world_upsert_locked(app)
+
+    def _policy_fields_locked(self, app: _App) -> dict[str, Any]:
+        """One app's scheduling-relevant state as AppView fields — the ONE
+        mapping both scheduler paths consume (the WorldIndex delta feed and
+        the reference branch's per-pass view rebuild), so they cannot
+        drift."""
+        return dict(
+            queue=app.queue,
+            priority=app.priority,
+            seq=app.seq,
+            demand=(app.demand_memory, app.demand_vcores, app.demand_chips),
+            held=self._held_locked(app.app_id),
+            admitted=app.admitted,
+            preempted=app.preempted,
+            wait_since=app.wait_since,
+            admitted_at=app.admitted_at,
+            elastic_unit=app.elastic_unit,
+            elastic_slack=app.elastic_slack,
+            shrink_pending=(
+                app.app_id in self._drains
+                and self._drains[app.app_id]["mode"] == "shrink"
+            ),
+        )
+
+    def _world_upsert_locked(self, app: _App) -> None:
+        """Reconcile one app's WorldIndex view with its canonical record —
+        called from every choke point that mutates scheduling-relevant app
+        state (register/admit/evict/shrink/held/drain transitions). A no-op
+        when nothing actually changed, so the index's version only moves on
+        real deltas."""
+        if self._world is None:
+            return
+        self._world.upsert(app.app_id, **self._policy_fields_locked(app))
+
+    def _rebuild_derived_locked(self) -> None:
+        """Recompute held totals and the WorldIndex wholesale — journal
+        recovery (and its loud degrade) is the one place the world changes
+        by more than a delta."""
+        self._app_held = {}
         for rec in self._containers.values():
-            if rec["app_id"] == app_id and rec["state"] == _RUNNING:
-                mem += rec["memory_bytes"]
-                vc += rec["vcores"]
-                ch += len(rec["chips"])
-        return mem, vc, ch
+            if rec["state"] == _RUNNING:
+                h = self._app_held.setdefault(rec["app_id"], [0, 0, 0])
+                h[0] += rec["memory_bytes"]
+                h[1] += rec["vcores"]
+                h[2] += len(rec["chips"])
+        if self._world is not None:
+            self._world = WorldIndex()
+            self._sched_seen_version = -1
+            for app in self._apps.values():
+                self._world_upsert_locked(app)
 
     def _claim_locked(self, app: _App) -> tuple[int, int, int]:
         held = self._held_locked(app.app_id)
@@ -1085,37 +1173,52 @@ class PoolService:
         )
 
     def _schedule_locked(self) -> None:
-        """One admission pass: build policy views of the current world, run
-        the pure :class:`PreemptionPolicy` (cluster/policy.py — the exact
-        code ``tony sim`` proves invariants over), and apply its decision.
+        """One admission pass: run the pure policy (cluster/policy.py — the
+        exact code ``tony sim`` proves invariants over) and apply its
+        decision.
 
         The policy owns the WHOLE decision (claims-based admission, queue
         shares, priority preemption, cross-queue reclaim with shrink-first
         partial reclaim, anti-thrash guards); this method owns only the
-        mechanics — journaling, metrics, and initiating drains/kills."""
-        totals = self._totals_locked()
-        views = [
-            AppView(
-                app_id=a.app_id, queue=a.queue, priority=a.priority, seq=a.seq,
-                demand=(a.demand_memory, a.demand_vcores, a.demand_chips),
-                held=self._held_locked(a.app_id),
-                admitted=a.admitted, preempted=a.preempted,
-                wait_since=a.wait_since, admitted_at=a.admitted_at,
-                elastic_unit=a.elastic_unit, elastic_slack=a.elastic_slack,
-                shrink_pending=(
-                    a.app_id in self._drains
-                    and self._drains[a.app_id]["mode"] == "shrink"
-                ),
-            )
-            for a in self._apps.values()
-        ]
-        decision = self._policy.schedule(views, totals)
+        mechanics — journaling, metrics, and initiating drains/kills.
+
+        Indexed path (the default): the pass reads the delta-maintained
+        :class:`WorldIndex` — no view rebuilds, no held rescans — and when
+        the world hasn't changed since a pass that decided nothing (and no
+        grace/min-runtime/budget window consulted by that pass has expired,
+        ``last_wake_at``), the tick is skipped outright: an idle pool pays
+        microseconds per allocate retry instead of a full pass."""
+        if self._world is not None:
+            # skip BEFORE the O(alive nodes) totals scan: node-set changes
+            # bump the world version (touch()), so the check is complete
+            # without recomputing totals — the idle tick really is O(1)
+            if (
+                self._world.version == self._sched_seen_version
+                and self._sched_last_empty
+                and (self._sched_wake_at is None
+                     or time.monotonic() < self._sched_wake_at)
+            ):
+                return
+            decision = self._policy.schedule_world(self._world, self._totals_locked())
+            self._sched_wake_at = self._policy.last_wake_at
+        else:
+            views = [
+                AppView(app_id=a.app_id, **self._policy_fields_locked(a))
+                for a in self._apps.values()
+            ]
+            decision = self._policy.schedule(views, self._totals_locked())
         for sh in decision.shrink:
             self._apply_shrink_locked(sh)
         for ev in decision.evict:
             self._apply_evict_locked(ev)
         for app_id in decision.admit:
             self._apply_admit_locked(app_id)
+        if self._world is not None:
+            # recorded AFTER applying: the _apply_* choke points sync the
+            # canonical records back into the index (authoritative clocks,
+            # drain bookkeeping), and only their final version counts as seen
+            self._sched_seen_version = self._world.version
+            self._sched_last_empty = decision.empty()
 
     # -------------------------------------------- decision application
     def _apply_admit_locked(self, app_id: str) -> None:
@@ -1134,6 +1237,7 @@ class PoolService:
             self._jlog_locked("drain_done", app_id=app_id)
             obs_logging.info(
                 f"[tony-pool] drain of {app_id} cancelled: re-admitted before yielding")
+        self._world_upsert_locked(app)
         self._journal_app_locked(app)
 
     def _apply_evict_locked(self, ev) -> None:
@@ -1148,6 +1252,7 @@ class PoolService:
         v.wait_since = time.monotonic()
         v.wait_unix = time.time()
         _POOL_EVICTIONS.inc(queue=v.queue)
+        self._world_upsert_locked(v)
         self._journal_app_locked(v)
         running = [
             rec for rec in self._containers.values()
@@ -1216,6 +1321,7 @@ class PoolService:
             "deadline": now + drain_s, "t0": now, "escalated": False,
         }
         self._drains[v.app_id] = entry
+        self._world_upsert_locked(v)
         self._journal_app_locked(v)
         self._jlog_locked(
             "drain", app_id=v.app_id, req_id=entry["req_id"], mode="shrink",
@@ -1253,6 +1359,9 @@ class PoolService:
         entry = self._drains.pop(app_id, None)
         if entry is None:
             return
+        app = self._apps.get(app_id)
+        if app is not None:
+            self._world_upsert_locked(app)  # shrink_pending cleared
         self._jlog_locked("drain_done", app_id=app_id)
         _POOL_PREEMPTIONS.inc(mode=mode)
         if mode in ("drain", "shrink"):
@@ -1316,6 +1425,7 @@ class PoolService:
                     v.wait_unix = time.time()
                     _POOL_EVICTIONS.inc(queue=v.queue)
                     self._journal_app_locked(v)
+                    self._world_upsert_locked(v)
             obs_logging.warning(
                 f"[tony-pool] {entry['mode']} of {app_id} escalated to kill "
                 f"after {now - entry['t0']:.1f}s (deadline passed)")
@@ -1324,6 +1434,9 @@ class PoolService:
                     self._preempt_cids.add(rec["id"])
                     self._request_kill_locked(rec)
             self._drains.pop(app_id, None)
+            app = self._apps.get(app_id)
+            if app is not None:
+                self._world_upsert_locked(app)  # shrink_pending cleared
             self._jlog_locked("drain_done", app_id=app_id)
             _POOL_PREEMPTIONS.inc(mode="kill")
             self._schedule_locked()
@@ -1361,6 +1474,8 @@ class PoolService:
             rc = constants.EXIT_PREEMPTED
         rec["state"] = _EXITED
         self._free_locked(rec)
+        self._held_add_locked(
+            rec["app_id"], -rec["memory_bytes"], -rec["vcores"], -len(rec["chips"]))
         self._app_exits.setdefault(rec["app_id"], {})[cid] = rc
         self._jlog_locked("exited", cid=cid, rc=rc)
         self._check_drains_locked()
@@ -1372,12 +1487,16 @@ class PoolService:
             self._jlog_locked("released", cid=cid)
         if rec is not None and rec["state"] == _RUNNING:
             self._free_locked(rec)
+            self._held_add_locked(
+                rec["app_id"], -rec["memory_bytes"], -rec["vcores"], -len(rec["chips"]))
             # a cooperative victim yields by releasing its containers (the
             # AM's gang restart): resolve the drain the moment it completes
             self._check_drains_locked()
 
     def _mark_node_lost_locked(self, node: _Node, reason: str) -> None:
         node.alive = False
+        if self._world is not None:
+            self._world.touch()  # pool totals shrank with the node
         for cid, rec in self._containers.items():
             if rec["node"] == node.name and rec["state"] == _RUNNING:
                 self._record_exit_locked(cid, constants.EXIT_NODE_LOST)
@@ -1733,6 +1852,7 @@ def main(argv: list[str] | None = None) -> int:
         if args.journal_file is not None
         else (config.get(keys.POOL_JOURNAL_FILE) or None),
         journal_compact_every=config.get_int(keys.POOL_JOURNAL_COMPACT_EVERY, 0),
+        scheduler_indexed=config.get_bool(keys.POOL_SCHEDULER_INDEXED, True),
         chaos=ChaosContext.from_config(config, identity="pool"),
     )
     svc.start()

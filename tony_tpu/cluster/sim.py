@@ -4,7 +4,12 @@
 EXACT :class:`~tony_tpu.cluster.policy.PreemptionPolicy` the live
 ``PoolService`` runs (cluster/pool.py imports the same class — a parity test
 greps for re-divergence), with a virtual clock injected so a 10-hour trace
-simulates in milliseconds. After every event the simulator asserts the
+simulates in milliseconds. The indexed policy (the default) runs over a
+persistent :class:`~tony_tpu.cluster.policy.WorldIndex` fed by the event
+handlers — the same cross-pass incrementality the live pool uses — and
+``tony sim --parity`` replays every mix through BOTH the indexed and the
+kept :class:`~tony_tpu.cluster.policy.ReferencePolicy`, diffing decision
+traces event-by-event (docs/scheduling.md "Parity mode"). After every event the simulator asserts the
 invariants that make the policy's fairness PROVABLE rather than anecdotal
 (docs/scheduling.md):
 
@@ -41,7 +46,14 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Any
 
-from tony_tpu.cluster.policy import AppView, Decision, PreemptionPolicy, Vec
+from tony_tpu.cluster.policy import (
+    AppView,
+    Decision,
+    PreemptionPolicy,
+    Vec,
+    WorldIndex,
+    make_policy,
+)
 
 
 @dataclass
@@ -122,6 +134,9 @@ class PoolSimulator:
         coop_yield_s: float = 1.0,      # a cooperative victim's checkpoint+yield latency
         shrink_rebuild_s: float = 2.0,  # an elastic victim's shed/rebuild latency
         seed: int = 0,
+        policy_impl: str = "indexed",   # tony.pool.scheduler.indexed spelling
+        record_trace: bool = False,     # collect per-event decision traces (--parity)
+        verify_index: bool = False,     # audit WorldIndex vs brute force per event
     ):
         self.now = 0.0
         self.queues = dict(queues)
@@ -132,7 +147,8 @@ class PoolSimulator:
         self.shrink_rebuild_s = shrink_rebuild_s
         self.eviction_budget = eviction_budget
         self.budget_window_ms = budget_window_ms
-        self.policy = PreemptionPolicy(
+        self.policy = make_policy(
+            policy_impl,
             queues,
             preemption=preemption,
             grace_ms=grace_ms,
@@ -141,6 +157,21 @@ class PoolSimulator:
             budget_window_ms=budget_window_ms,
             clock=lambda: self.now,
         )
+        self.policy_impl = policy_impl
+        # the indexed policy runs over a PERSISTENT world the event handlers
+        # feed deltas — the same cross-pass incrementality the live pool
+        # uses, exercised here under thousands of seeded arrival/eviction/
+        # shed/death transitions (and audited brute-force per event when
+        # ``verify_index`` is set)
+        self._world: WorldIndex | None = (
+            WorldIndex() if policy_impl == "indexed" else None
+        )
+        self.verify_index = verify_index
+        self.record_trace = record_trace
+        #: (event_no, event kind, event app, virtual now, admits, evicts,
+        #: shrinks) per non-empty decision — what ``tony sim --parity`` diffs
+        self.trace: list[tuple] = []
+        self._event_no = 0
         self.seed = seed
         self._events: list[tuple[float, int, str, str]] = []  # (t, seq, kind, app_id)
         self._seq = 0
@@ -201,6 +232,8 @@ class PoolSimulator:
                 break
             self._accrue_busy(t)
             self.now = t
+            self._event_no += 1
+            self._cur_event = (kind, app_id)
             if kind == "tick":
                 self._stagnant_ticks += 1
                 if self._stagnant_ticks > 600:
@@ -217,6 +250,12 @@ class PoolSimulator:
             if not self._schedule().empty():
                 self._stagnant_ticks = 0  # a tick that admitted IS progress
             self._check_invariants()
+            if self._world is not None and self.verify_index:
+                errs = self._world.audit(self._policy_views())
+                if errs:
+                    self.report.violations.append(
+                        f"index inconsistency at t={self.now:.1f}s "
+                        f"({kind}:{app_id}): " + "; ".join(errs[:5]))
             # the live pool re-runs admission on every AM allocate retry; the
             # sim's analog is a 1 Hz tick while anyone waits, so decisions
             # deferred by grace / minimum-runtime protection / a draining
@@ -247,9 +286,16 @@ class PoolSimulator:
         st = self._jobs[app_id]
         st.arrived = True
         self._active[app_id] = st
-        st.view.seq = self._seq  # arrival order IS the FIFO order
+        # arrival order IS the FIFO order — and seqs are UNIQUE per app,
+        # like the pool's itertools.count (two same-instant arrivals used to
+        # share the push counter's value, leaving their relative order to
+        # the accident of list position)
+        self._seq += 1
+        st.view.seq = self._seq
         st.view.wait_since = self.now
         st.wait_started = self.now
+        if self._world is not None:
+            self._world.adopt(st.view)
 
     def _on_tick(self, app_id: str) -> None:
         self._tick_pending = False  # the run loop's _schedule does the work
@@ -265,6 +311,8 @@ class PoolSimulator:
         st.remaining_s = 0.0
         st.done_at = self.now
         st.started_at = None
+        if self._world is not None:
+            self._world.remove(app_id)  # before the flags flip: still admitted
         st.view.admitted = False
         st.view.held = (0, 0, 0)
         self._active.pop(app_id, None)
@@ -289,6 +337,16 @@ class PoolSimulator:
             st.rework_s += lost
         st.dying_until = None
         st.view.held = (0, 0, 0)
+        if self._world is not None and st.done_at is None:
+            if st.view.app_id in self._world.views:
+                # evicted and re-admitted in one pass: it never left the
+                # world — only its physical holdings just vanished
+                self._world.reaccount(st.view)
+            else:
+                # the victim's containers are gone: it re-enters the
+                # policy's world as an ordinary waiter (it left at
+                # eviction time)
+                self._world.adopt(st.view)
 
     def _on_shed(self, app_id: str) -> None:
         """An elastic victim finishes its shrink rebuild: physical occupancy
@@ -307,6 +365,8 @@ class PoolSimulator:
         st.view.shrink_pending = False
         st.shrinks += 1
         self.report.shrinks += 1
+        if self._world is not None:
+            self._world.reaccount(st.view)  # held dropped to the shed size
         self._reschedule_completion(st)
 
     # ------------------------------------------------------------ scheduling
@@ -315,12 +375,28 @@ class PoolSimulator:
         st.expected_done_at = self.now + st.remaining_s
         self._push(st.expected_done_at, "complete", st.view.app_id)
 
-    def _schedule(self) -> Decision:
-        views = [
+    def _policy_views(self) -> list[AppView]:
+        """The views the policy decides over: everything arrived-and-alive
+        except evicted-but-still-dying waiters (their claims moved at
+        eviction; their demand re-queues only once the containers die)."""
+        return [
             st.view for st in self._active.values()
             if st.view.admitted or st.dying_until is None
         ]
-        decision = self.policy.schedule(views, self.totals)
+
+    def _schedule(self) -> Decision:
+        if self._world is not None:
+            decision = self.policy.schedule_world(self._world, self.totals)
+        else:
+            decision = self.policy.schedule(self._policy_views(), self.totals)
+        if self.record_trace and not decision.empty():
+            kind, app_id = self._cur_event
+            self.trace.append((
+                self._event_no, kind, app_id, round(self.now, 6),
+                tuple(decision.admit),
+                tuple((e.app_id, e.for_app) for e in decision.evict),
+                tuple((s.app_id, s.workers, s.for_app) for s in decision.shrink),
+            ))
         for sh in decision.shrink:
             self._charge_log.append((self.now, self._jobs[sh.for_app].view.queue))
             self._push(self.now + self.shrink_rebuild_s, "shed", sh.app_id)
@@ -340,6 +416,14 @@ class PoolSimulator:
                 st.checkpointed_s = st.job.work_s - st.remaining_s
             st.dying_until = death
             st.wait_started = self.now
+            if self._world is not None and not st.view.admitted:
+                # a dying victim is outside the policy's world until its
+                # containers actually exit (_on_die re-adopts it). The guard
+                # matters: one decision may evict an app for one head and
+                # RE-ADMIT it later in the same pass (an overshooting
+                # preemption refits it) — the final state is admitted, and
+                # the membership rule (admitted or not-dying) keeps it in
+                self._world.remove(ev.app_id)
             self._push(death, "die", ev.app_id)
         for app_id in decision.admit:
             st = self._jobs[app_id]
@@ -350,6 +434,8 @@ class PoolSimulator:
             # (claims == occupancy for the admittee; a dying victim's nodes
             # overlap transiently, exactly like the live pool's drain)
             st.view.held = st.view.demand
+            if self._world is not None:
+                self._world.reaccount(st.view)
             self._reschedule_completion(st)
         return decision
 
@@ -521,6 +607,7 @@ def run_mix(
     min_runtime_ms: int = 3_000,
     eviction_budget: int = 0,
     budget_window_ms: int = 60_000,
+    policy_impl: str = "indexed",
 ) -> SimReport:
     """One seeded simulation over ``n`` arrivals of the named mix — the unit
     tier-1 asserts invariants over, and what ``tony sim`` wraps."""
@@ -530,8 +617,71 @@ def run_mix(
         preemption=preemption, grace_ms=grace_ms, drain_ms=drain_ms,
         min_runtime_ms=min_runtime_ms, eviction_budget=eviction_budget,
         budget_window_ms=budget_window_ms, seed=seed,
+        policy_impl=policy_impl,
     )
     return sim.run(generate_jobs(mix, n, queues, seed))
+
+
+# ---------------------------------------------------------------------------
+# indexed ↔ reference parity (tony sim --parity)
+# ---------------------------------------------------------------------------
+def diff_traces(indexed: list[tuple], reference: list[tuple]) -> str | None:
+    """First divergence between two decision traces, rendered for a human
+    (None = byte-identical). Each entry is (event_no, event kind, event app,
+    virtual t, admits, evicts, shrinks)."""
+    for i, (a, b) in enumerate(zip(indexed, reference)):
+        if a != b:
+            return (
+                f"decision #{i} diverges at event {a[0]} ({a[1]}:{a[2]}, "
+                f"t={a[3]}s):\n  indexed:   admits={a[4]} evicts={a[5]} shrinks={a[6]}\n"
+                f"  reference: event {b[0]} ({b[1]}:{b[2]}, t={b[3]}s) "
+                f"admits={b[4]} evicts={b[5]} shrinks={b[6]}"
+            )
+    if len(indexed) != len(reference):
+        longer, name = (indexed, "indexed") if len(indexed) > len(reference) else (reference, "reference")
+        e = longer[min(len(indexed), len(reference))]
+        return (
+            f"trace lengths differ (indexed={len(indexed)} reference={len(reference)}): "
+            f"{name} additionally decided at event {e[0]} ({e[1]}:{e[2]}, t={e[3]}s): "
+            f"admits={e[4]} evicts={e[5]} shrinks={e[6]}"
+        )
+    return None
+
+
+def run_parity(
+    mix: str,
+    n: int = 1000,
+    *,
+    queues: dict[str, float] | None = None,
+    totals: Vec = (8 * GB, 256, 0),
+    seed: int = 0,
+    **knobs,
+) -> tuple[SimReport, SimReport, str | None]:
+    """Replay one seeded mix through the indexed AND the reference policy,
+    diffing decision traces event-by-event — the end-to-end half of the
+    parity contract (the per-world property suite is
+    tests/test_policy_parity.py). Returns (indexed report, reference
+    report, first divergence or None)."""
+    queues = queues or {"prod": 0.6, "dev": 0.4}
+    defaults = dict(
+        preemption=True, grace_ms=2_000, drain_ms=5_000, min_runtime_ms=3_000,
+        eviction_budget=0, budget_window_ms=60_000,
+    )
+    defaults.update(knobs)
+    traces: dict[str, list[tuple]] = {}
+    reports: dict[str, SimReport] = {}
+    for impl in ("indexed", "reference"):
+        sim = PoolSimulator(
+            queues, totals, seed=seed, policy_impl=impl, record_trace=True,
+            **defaults,
+        )
+        reports[impl] = sim.run(generate_jobs(mix, n, queues, seed))
+        traces[impl] = sim.trace
+    return (
+        reports["indexed"],
+        reports["reference"],
+        diff_traces(traces["indexed"], traces["reference"]),
+    )
 
 
 def render_report(report: SimReport, as_json: bool = False) -> str:

@@ -43,6 +43,11 @@ GATE_METRICS: dict[str, int] = {
     # their latency tails and the restart-replay wall regress upward.
     "sched_decisions_per_sec": +1,
     "sched_decision_p99_ms": -1,
+    # steady-state scheduler sub-bench (PR 14): repeated passes over a
+    # delta-fed WorldIndex — the cross-pass O(changed) win, gated so it
+    # can't silently regress back to rebuild-the-world-per-tick
+    "sched_incremental_p50_ms": -1,
+    "sched_incremental_passes_per_sec": +1,
     "heartbeats_per_sec": +1,
     "heartbeat_p99_ms": -1,
     "heartbeat_churn_p99_ms": -1,
@@ -69,6 +74,10 @@ DEFAULT_TOLERANCE_PCT = 5.0
 DEFAULT_METRIC_TOLERANCE_PCT: dict[str, float] = {
     "sched_decisions_per_sec": 20.0,
     "sched_decision_p99_ms": 50.0,
+    # sub-millisecond medians over 100 passes: scheduler-noise dominated,
+    # but a regression to world-rebuild-per-tick is a ~100x move, not 50%
+    "sched_incremental_p50_ms": 50.0,
+    "sched_incremental_passes_per_sec": 25.0,
     "heartbeats_per_sec": 20.0,
     "heartbeat_p99_ms": 50.0,
     "heartbeat_churn_p99_ms": 50.0,
@@ -93,6 +102,23 @@ def parsed_of(record: dict[str, Any]) -> dict[str, Any]:
     already IS a raw ``bench.py`` output line."""
     inner = record.get("parsed")
     return inner if isinstance(inner, dict) else record
+
+
+def machine_of(parsed: dict[str, Any]) -> tuple | None:
+    """The record's machine fingerprint (None when it carries none).
+
+    CPU-bound throughput rounds are only comparable on equal hardware: a
+    CI reallocation from 8 cores to 2 halves every control-plane lane with
+    zero code change, and gating across that boundary reports fiction in
+    both directions. The fingerprint is deliberately coarse — core count +
+    ISA, not the kernel build string — so routine image patches don't
+    orphan a trajectory. Records WITHOUT a fingerprint compare with each
+    other (the pre-provenance trajectory stays self-consistent) but not
+    with fingerprinted ones — we cannot know they were the same box."""
+    m = parsed.get("machine")
+    if not isinstance(m, dict):
+        return None
+    return (m.get("cpus"), m.get("arch"))
 
 
 def validate_record(record: dict[str, Any], *, wrapper: bool = True) -> list[str]:
@@ -195,7 +221,11 @@ def evaluate(
     threshold relative to the trajectory's best; metrics absent from either
     side are skipped (a CPU-distilled record has no kernel smoke, an old
     round has no step_time). Comparisons only happen within the same
-    headline ``metric`` name — a preset change starts a fresh trajectory.
+    headline ``metric`` name — a preset change starts a fresh trajectory —
+    and, for records carrying ``machine`` provenance, within the same
+    hardware fingerprint (:func:`machine_of`): a round measured on a
+    different CPU allocation is surfaced as a note, never used as a
+    regression reference.
 
     Threshold resolution, strongest first: ``per_metric_pct`` (the CLI's
     repeatable ``--threshold METRIC=PCT``), then an explicit
@@ -207,13 +237,23 @@ def evaluate(
     per_metric_pct = per_metric_pct or {}
     cur = parsed_of(current)
     cur_name = cur.get("metric")
-    peers = [
-        (fname, parsed_of(rec)) for fname, rec in trajectory
-        if parsed_of(rec).get("metric") == cur_name
+    cur_machine = machine_of(cur)
+    peers = []
+    skipped_machines: list[str] = []
+    for fname, rec in trajectory:
+        p = parsed_of(rec)
+        if p.get("metric") != cur_name:
+            continue
         # self-comparison guard: gating the newest checked-in record against
         # the trajectory must diff it against the OTHERS
-        and parsed_of(rec) is not cur and parsed_of(rec) != cur
-    ]
+        if p is cur or p == cur:
+            continue
+        if machine_of(p) != cur_machine:
+            # different (or unknown-vs-known) hardware: not a regression
+            # reference — surfaced below, never silently dropped
+            skipped_machines.append(fname)
+            continue
+        peers.append((fname, p))
     checks: list[GateCheck] = []
 
     for metric, direction in GATE_METRICS.items():
@@ -242,6 +282,16 @@ def evaluate(
             passed=drop <= allowed,
             note="" if drop <= allowed else
             f"regressed {drop / abs(best) * 100.0:.2f}% past the {pct:.1f}% threshold"))
+
+    if skipped_machines:
+        cpus = cur_machine[0] if cur_machine else "?"
+        checks.append(GateCheck(
+            metric="provenance", current=None, reference=None,
+            reference_from="-", threshold_pct=0.0, direction=+1, passed=True,
+            note=f"NOTE: {len(skipped_machines)} record(s) measured on "
+                 f"different hardware not used as regression references "
+                 f"({', '.join(skipped_machines[:4])}; this record: "
+                 f"{cpus} cpus) — same-machine rounds gate normally"))
 
     # anti-"gate-without-movement" (ROADMAP item 2): a perf-lane round that
     # gates green with the headline metric sitting exactly where the prior
