@@ -1,0 +1,16 @@
+"""events-discipline fixture: documented, undocumented, and suppressed members."""
+
+import enum
+
+
+class EventType(enum.Enum):
+    TASK_STARTED = "TASK_STARTED"  # documented in docs/observability.md: clean
+    TOTALLY_UNDOCUMENTED_EVENT = "TOTALLY_UNDOCUMENTED_EVENT"  # finding
+    ANOTHER_MISSING_EVENT = "ANOTHER_MISSING_EVENT"  # finding
+    DELIBERATE_EXPERIMENT = "DELIBERATE_EXPERIMENT"  # lint: disable=events-discipline — fixture: flag-gated experiment
+    _ORDINAL = 7  # non-string member value: not an event name
+
+
+class NotEventType(enum.Enum):
+    # a different enum: its members are not .jhist vocabulary
+    SOME_STATE = "SOME_STATE"

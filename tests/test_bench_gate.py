@@ -210,6 +210,28 @@ class TestCbenchFamily:
         assert r02["journal_replay_ms"] < r01["journal_replay_ms"]
         assert r02["vs_baseline"] > 1.0
 
+    def test_recorder_round_holds_the_scheduler_lane(self):
+        """Acceptance (r15): the flight recorder rides the scheduler lane
+        from r04 on (`sched_recorder: "on"`), and observability must not
+        undo PR 14's win — r04's `sched_incremental_p50_ms` stays within the
+        gate tolerance of r03's, compared directly when the rounds share a
+        machine fingerprint (the gate itself only ever compares
+        same-fingerprint peers)."""
+        by_round = {rec["n"]: gate.parsed_of(rec) for _, rec in _cbench_trajectory()}
+        r03, r04 = by_round[3], by_round[4]
+        assert r04.get("sched_recorder") == "on"
+        assert "sched_recorder" not in r03  # the pre-recorder round
+        if gate.machine_of(r04) == gate.machine_of(r03):
+            tol = gate.DEFAULT_METRIC_TOLERANCE_PCT["sched_incremental_p50_ms"]
+            ceiling = r03["sched_incremental_p50_ms"] * (1 + tol / 100.0)
+            assert r04["sched_incremental_p50_ms"] <= ceiling, (
+                f"recorder-on round regressed the incremental pass: "
+                f"{r04['sched_incremental_p50_ms']}ms > {ceiling}ms")
+            # the cold full-pass lane holds too
+            tol = gate.DEFAULT_METRIC_TOLERANCE_PCT["sched_decisions_per_sec"]
+            floor = r03["sched_decisions_per_sec"] * (1 - tol / 100.0)
+            assert r04["sched_decisions_per_sec"] >= floor
+
     def test_gate_cli_passes_on_cbench_trajectory(self, capsys):
         from tony_tpu.cli.history import main_bench
 

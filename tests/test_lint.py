@@ -177,6 +177,29 @@ def test_metrics_discipline_library_is_clean():
     assert findings == [], [f"{f.path}:{f.line}: {f.message}" for f in findings]
 
 
+# ---------------------------------------------------------- events-discipline
+def test_events_discipline_flags_undocumented_members():
+    findings = run_lint("events_bad.py", checks={"events-discipline"})
+    # documented (7), suppressed (10), non-string member (11), and the
+    # differently-named enum (16) are all absent
+    assert lines_of(findings, "events-discipline") == [8, 9]
+    assert "TOTALLY_UNDOCUMENTED_EVENT" in findings[0].message
+    assert "docs/observability.md" in findings[0].message
+
+
+def test_events_discipline_library_is_clean():
+    """The ratchet: every EventType member declared in tony_tpu/ has a row
+    in docs/observability.md's event catalog — a new .jhist event type
+    cannot land undocumented (the drift PRs 9-14 accumulated and this PR
+    backfilled)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    analyzer = Analyzer(
+        [c for c in all_checkers() if c.name == "events-discipline"], root=repo
+    )
+    findings = analyzer.run([os.path.join(repo, "tony_tpu")])
+    assert findings == [], [f"{f.path}:{f.line}: {f.message}" for f in findings]
+
+
 # ------------------------------------------------------------------ host-sync
 def test_host_sync_true_positives():
     findings = run_lint("host_sync_bad.py", checks={"host-sync"})

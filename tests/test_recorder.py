@@ -1,0 +1,774 @@
+"""Scheduler flight recorder: decision provenance, telemetry, `tony explain`.
+
+PR 15 (docs/scheduling.md "Explaining decisions"). Layers under test:
+
+- recorder units: ring bounding, deny coalescing, causal chains, telemetry
+  window aggregation, the cluster-series JSONL carrier;
+- policy provenance: every binding rule in the vocabulary produced by a
+  scenario that actually binds on it, and the hard neutrality contract —
+  attaching a recorder NEVER changes a decision;
+- the chain property: a seeded simulation's every app folds its record
+  chain into a legal transition sequence that reaches its terminal state
+  (no decision gaps);
+- sim-vs-live parity: the same seeded arrival mix through `tony sim` and a
+  live PoolService emits the same decision stream;
+- pool integration: `pool_status` blocked_reason, the enriched allocate
+  wait answer, `pool_explain` over real RPC, `tony explain` CLI, the
+  no-rect placement record, telemetry flush → history-store
+  ``cluster_series`` → portal capacity dashboard.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from tony_tpu.cluster.policy import AppView, PreemptionPolicy, make_policy
+from tony_tpu.cluster.pool import PoolService
+from tony_tpu.cluster.recorder import (
+    DENY_RULES,
+    FlightRecorder,
+    QueueTelemetry,
+    read_window_lines,
+    window_line,
+)
+from tony_tpu.cluster.sim import PoolSimulator, SimJob, generate_jobs
+
+from tests.test_pool import SECRET, register_cpu_node
+
+pytestmark = pytest.mark.sched
+
+GB = 1024**3
+
+
+def make_pool(**kw):
+    return PoolService(heartbeat_interval_ms=100, max_missed_heartbeats=3,
+                       secret=SECRET, **kw)
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder units
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_notes_and_latest(self):
+        rec = FlightRecorder(clock=lambda: 100.0)
+        rec.begin_pass()
+        rec.note("admit", "a1", "prod", "fits-free")
+        rec.note("deny", "a2", "dev", "no-capacity", ask=[1, 0, 0])
+        assert rec.latest("a1").action == "admit"
+        assert rec.blocked_reason("a1") is None
+        assert rec.blocked_reason("a2") == "no-capacity"
+        assert rec.latest("a2").unix_ms == 100_000
+
+    def test_deny_coalescing(self):
+        rec = FlightRecorder()
+        for i in range(50):
+            rec.begin_pass()
+            rec.note("deny", "a1", "prod", "share-deficit", used=i)
+        assert len(rec.records) == 1
+        r = rec.latest("a1")
+        assert r.count == 50 and r.pass_id == 50 and r.detail == {"used": 49}
+        # a different rule breaks the run: new record
+        rec.note("deny", "a1", "prod", "budget-exhausted")
+        assert len(rec.records) == 2
+        assert rec.blocked_reason("a1") == "budget-exhausted"
+        # an action between two identical denies also breaks the run
+        rec.note("admit", "a1", "prod", "fits-free")
+        rec.note("deny", "a1", "prod", "budget-exhausted")
+        assert len(rec.records) == 4
+
+    def test_ring_bounded_and_latest_pruned(self):
+        rec = FlightRecorder(capacity=16)
+        for i in range(100):
+            rec.note("admit", f"a{i}", "q", "fits-free")
+        assert len(rec.records) == 16
+        assert rec.latest("a0") is None          # rotated out
+        assert rec.latest("a99") is not None
+
+    def test_explain_chain_includes_funded_actions(self):
+        rec = FlightRecorder()
+        rec.note("deny", "head", "prod", "share-deficit")
+        rec.note("shrink", "victim", "dev", "partial-reclaim", for_app="head", workers=2)
+        rec.note("evict", "victim2", "dev", "share-reclaim", for_app="head")
+        rec.note("admit", "head", "prod", "share-reclaim")
+        chain = [r.app_id for r in rec.explain("head")]
+        assert chain == ["head", "victim", "victim2", "head"]
+        # the victim's chain names the head its shed capacity funded
+        vchain = rec.explain("victim")
+        assert [(r.action, r.for_app) for r in vchain] == [("shrink", "head")]
+
+    def test_queue_counters(self):
+        rec = FlightRecorder()
+        rec.note("admit", "a", "prod", "fits-free")
+        rec.note("deny", "b", "prod", "no-capacity")
+        rec.note("deny", "b", "prod", "no-capacity")   # coalesced, still counted
+        assert rec.counters("prod") == {"admit": 1, "deny": 2}
+
+    def test_on_note_hook(self):
+        seen = []
+        rec = FlightRecorder(on_note=seen.append)
+        rec.note("deny", "a", "q", "no-capacity")
+        assert [r.rule for r in seen] == ["no-capacity"]
+
+
+# ---------------------------------------------------------------------------
+# QueueTelemetry units
+# ---------------------------------------------------------------------------
+class TestQueueTelemetry:
+    def test_window_aggregation_and_counter_deltas(self):
+        now = [0.0]
+        t = QueueTelemetry(window_ms=1_000, clock=lambda: now[0])
+        counters = {"prod": {"admit": 3, "deny": 10}}
+        t.sample({"prod": {"used": 2, "share_capacity": 4, "demand": 6,
+                           "waiting": 3, "wait_age_s": 5.0}}, counters)
+        now[0] = 0.5
+        counters = {"prod": {"admit": 5, "deny": 12, "evict": 1}}
+        t.sample({"prod": {"used": 4, "share_capacity": 4, "demand": 2,
+                           "waiting": 1, "wait_age_s": 9.0}}, counters)
+        assert t.drain_finalized() == []          # window still open
+        now[0] = 1.2                              # crosses the 1s boundary
+        t.sample({"prod": {"used": 0, "share_capacity": 4, "demand": 0,
+                           "waiting": 0, "wait_age_s": 0.0}}, counters)
+        (w,) = t.drain_finalized()
+        assert w["queue"] == "prod" and w["samples"] == 2
+        m = w["metrics"]
+        assert m["used_avg"] == 3.0 and m["used_max"] == 4
+        assert m["utilization_avg"] == 0.75
+        assert m["demand_max"] == 6 and m["waiting_max"] == 3
+        assert m["wait_age_max_s"] == 9.0
+        # deltas against the window-start counters
+        assert m["admissions"] == 2 and m["denials"] == 2 and m["evictions"] == 1
+
+    def test_boundary_gap_events_attribute_to_next_window(self):
+        """Events between one window's last sample and the next window's
+        first sample must count in the NEXT window, not vanish."""
+        now = [0.0]
+        t = QueueTelemetry(window_ms=1_000, clock=lambda: now[0])
+        q = {"q": {"used": 0, "share_capacity": 1, "demand": 0,
+                   "waiting": 0, "wait_age_s": 0.0}}
+        t.sample(q, {"q": {"deny": 1}})
+        now[0] = 1.5  # crosses the boundary; 9 denials landed in the gap
+        t.sample(q, {"q": {"deny": 10}})
+        (w1,) = t.drain_finalized()
+        assert w1["metrics"]["denials"] == 0  # none seen inside window 1
+        now[0] = 2.5
+        t.sample(q, {"q": {"deny": 10}})
+        (w2,) = t.drain_finalized()
+        assert w2["metrics"]["denials"] == 9  # the gap burst, not dropped
+
+    def test_flush_force_finalizes(self):
+        now = [0.0]
+        t = QueueTelemetry(window_ms=60_000, clock=lambda: now[0])
+        t.sample({"q": {"used": 1, "share_capacity": 2, "demand": 0,
+                        "waiting": 0, "wait_age_s": 0.0}})
+        (w,) = t.flush(now_ms=500)
+        assert w["window_end_ms"] == 500 and w["metrics"]["used_avg"] == 1.0
+        assert t.flush() == []
+
+    def test_window_lines_torn_tail_tolerant(self, tmp_path):
+        p = tmp_path / "series.jsonl"
+        now = [0.0]
+        t = QueueTelemetry(window_ms=1_000, clock=lambda: now[0])
+        t.sample({"q": {"used": 1, "share_capacity": 2, "demand": 0,
+                        "waiting": 0, "wait_age_s": 0.0}})
+        windows = t.flush(now_ms=900)
+        with open(p, "w") as f:
+            for w in windows:
+                f.write(window_line("pool", w) + "\n")
+            f.write('{"queue": "q", "metr')     # torn mid-append
+        got = list(read_window_lines(p))
+        assert len(got) == 1 and got[0]["source"] == "pool"
+        assert list(read_window_lines(tmp_path / "missing.jsonl")) == []
+
+
+# ---------------------------------------------------------------------------
+# Policy provenance: every binding rule from a scenario that binds on it
+# ---------------------------------------------------------------------------
+def view(app_id, queue, *, mem=1, admitted=False, prio=0, seq=0, wait=0.0,
+         admitted_at=0.0, unit=0, slack=0, shrink_pending=False):
+    d = (mem * GB, 1, 0)
+    return AppView(
+        app_id=app_id, queue=queue, priority=prio, seq=seq, demand=d,
+        held=d if admitted else (0, 0, 0), admitted=admitted,
+        wait_since=wait, admitted_at=admitted_at,
+        elastic_unit=(unit * GB, 0, 0) if unit else (0, 0, 0),
+        elastic_slack=slack, shrink_pending=shrink_pending,
+    )
+
+
+def run_pass(views, *, totals=(4 * GB, 64, 0), clock_now=1000.0, **policy_kw):
+    rec = FlightRecorder(clock=lambda: clock_now)
+    pol = PreemptionPolicy(
+        policy_kw.pop("queues", {"prod": 0.5, "dev": 0.5}),
+        clock=lambda: clock_now, sink=rec, **policy_kw)
+    decision = pol.schedule(views, totals)
+    return decision, rec
+
+
+class TestPolicyProvenance:
+    def test_fits_free_admit(self):
+        d, rec = run_pass([view("a", "prod")])
+        assert d.admit == ["a"]
+        assert rec.latest("a").rule == "fits-free"
+
+    def test_pool_empty(self):
+        d, rec = run_pass([view("a", "prod")], totals=(0, 0, 0))
+        assert d.empty() and rec.blocked_reason("a") == "pool-empty"
+
+    def test_no_capacity(self):
+        d, rec = run_pass([view("big", "prod", mem=3, admitted=True),
+                           view("a", "prod", mem=2, seq=1)])
+        assert d.empty() and rec.blocked_reason("a") == "no-capacity"
+        assert rec.latest("a").detail["ask"][0] == 2 * GB
+
+    def test_share_deficit(self):
+        # prod at its 2 GB share; its next app over-share while dev waits
+        d, rec = run_pass([
+            view("p1", "prod", mem=2, admitted=True),
+            view("p2", "prod", mem=1, seq=1),
+            view("d1", "dev", mem=3, seq=2),
+        ])
+        assert d.empty()
+        assert rec.blocked_reason("p2") == "share-deficit"
+        assert rec.latest("p2").detail["share_capacity"] == 2 * GB
+        # the dev head is blocked by capacity, not its share
+        assert rec.blocked_reason("d1") == "no-capacity"
+
+    def test_priority_preemption_records(self):
+        d, rec = run_pass(
+            [view("low", "prod", mem=3, admitted=True, prio=0),
+             view("high", "prod", mem=3, prio=9, seq=1),
+             view("filler", "dev", mem=1, admitted=True, seq=2)],
+            preemption=True)
+        assert d.admit == ["high"] and [e.app_id for e in d.evict] == ["low"]
+        chain = rec.explain("low")
+        ev = next(r for r in chain if r.action == "evict")
+        assert ev.rule == "priority-preemption" and ev.for_app == "high"
+        assert ev.detail["head_priority"] == 9
+        # the victim re-queued inside the same pass and was denied again:
+        # its LATEST record says why it now waits
+        assert rec.latest("low").action == "deny"
+        ad = rec.latest("high")
+        assert ad.rule == "priority-preemption" and ad.detail["evicted"] == ["low"]
+
+    def test_share_reclaim_shrink_records(self):
+        d, rec = run_pass(
+            [view("borrower", "dev", mem=4, admitted=True, unit=1, slack=3),
+             view("head", "prod", mem=2, seq=1)],
+            preemption=True)
+        assert d.admit == ["head"]
+        assert [(s.app_id, s.workers) for s in d.shrink] == [("borrower", 2)]
+        sh = rec.latest("borrower")
+        assert sh.action == "shrink" and sh.rule == "partial-reclaim"
+        assert sh.for_app == "head" and sh.detail["workers"] == 2
+        assert rec.latest("head").rule == "share-reclaim"
+        assert rec.latest("head").detail["shrunk"] == ["borrower"]
+
+    def test_grace_pending(self):
+        d, rec = run_pass(
+            [view("borrower", "dev", mem=4, admitted=True),
+             view("head", "prod", mem=2, seq=1, wait=999.0)],
+            preemption=True, grace_ms=30_000, clock_now=1000.0)
+        assert d.empty()
+        r = rec.latest("head")
+        assert r.rule == "grace-pending"
+        assert r.detail["grace_ms"] == 30_000 and r.detail["waited_ms"] == 1000
+
+    def test_min_runtime_shield(self):
+        d, rec = run_pass(
+            [view("borrower", "dev", mem=4, admitted=True, admitted_at=999.5),
+             view("head", "prod", mem=2, seq=1)],
+            preemption=True, min_runtime_ms=60_000, clock_now=1000.0)
+        assert d.empty()
+        r = rec.latest("head")
+        assert r.rule == "min-runtime-shield"
+        assert r.detail["protected_victims"] >= 1
+
+    def test_drain_pending(self):
+        d, rec = run_pass(
+            [view("borrower", "dev", mem=4, admitted=True, shrink_pending=True),
+             view("head", "prod", mem=2, seq=1)],
+            preemption=True)
+        assert d.empty()
+        assert rec.blocked_reason("head") == "drain-pending"
+
+    def test_budget_exhausted(self):
+        clock = [1000.0]
+        rec = FlightRecorder(clock=lambda: clock[0])
+        pol = PreemptionPolicy({"prod": 0.5, "dev": 0.5}, preemption=True,
+                               eviction_budget=1, budget_window_ms=60_000,
+                               clock=lambda: clock[0], sink=rec)
+        totals = (4 * GB, 64, 0)
+        # first reclaim spends prod's 1-disruption budget...
+        first = [view("b1", "dev", mem=4, admitted=True),
+                 view("h1", "prod", mem=2, seq=1)]
+        assert pol.schedule(first, totals).admit == ["h1"]
+        # ...the second, inside the same window, is denied on the budget
+        second = [view("b2", "dev", mem=4, admitted=True, seq=2),
+                  view("h2", "prod", mem=2, seq=3)]
+        assert pol.schedule(second, totals).empty()
+        r = rec.latest("h2")
+        assert r.rule == "budget-exhausted" and r.detail["budget"] == 1
+
+    def test_no_eligible_victims(self):
+        # dev holds capacity but sits exactly AT its share: nothing to reclaim
+        d, rec = run_pass(
+            [view("d1", "dev", mem=2, admitted=True),
+             view("p1", "prod", mem=1, admitted=True),
+             view("head", "prod", mem=2, seq=2)],
+            preemption=True)
+        assert d.empty()
+        assert rec.blocked_reason("head") in ("no-eligible-victims", "share-deficit")
+
+    def test_rules_stay_in_vocabulary(self):
+        # every deny rule any scenario above produced is a documented one
+        for impl_rule in DENY_RULES:
+            assert isinstance(impl_rule, str)
+
+
+class TestProvenanceNeutrality:
+    """The hard contract: recording never changes a decision."""
+
+    @pytest.mark.parametrize("mix", ["bursty", "elastic", "priority"])
+    def test_sim_trace_identical_with_and_without_recorder(self, mix):
+        queues = {"prod": 0.6, "dev": 0.4}
+        traces = {}
+        for record in (False, True):
+            sim = PoolSimulator(
+                queues, (8 * GB, 256, 0), seed=7, policy_impl="indexed",
+                record_trace=True, record_decisions=record,
+                preemption=True, grace_ms=2_000, drain_ms=5_000,
+                min_runtime_ms=3_000)
+            rep = sim.run(generate_jobs(mix, 300, queues, 7))
+            assert rep.ok(), rep.violations
+            traces[record] = sim.trace
+        assert traces[False] == traces[True]
+
+
+# ---------------------------------------------------------------------------
+# chain property: terminal state reachable from the record chain, no gaps
+# ---------------------------------------------------------------------------
+class TestChainProperty:
+    @pytest.mark.parametrize("mix,seed", [("priority", 3), ("elastic", 11),
+                                          ("bursty", 5)])
+    def test_every_completed_app_chain_folds_to_admitted(self, mix, seed):
+        queues = {"prod": 0.6, "dev": 0.4}
+        sim = PoolSimulator(
+            queues, (8 * GB, 256, 0), seed=seed, policy_impl="indexed",
+            record_decisions=True, preemption=True, grace_ms=1_000,
+            drain_ms=4_000, min_runtime_ms=2_000)
+        # an unbounded ring for the property: the fold must see whole chains
+        sim.recorder = FlightRecorder(capacity=1_000_000,
+                                      clock=lambda: sim.now)
+        sim.policy.sink = sim.recorder
+        rep = sim.run(generate_jobs(mix, 400, queues, seed))
+        assert rep.ok(), rep.violations
+        rec = sim.recorder
+        assert rec.records and rec.records[0].seq == 1  # nothing rotated out
+        for st in sim._jobs.values():
+            app_id = st.view.app_id
+            subject = [r for r in rec.records if r.app_id == app_id]
+            # no decision gaps: every app's life is fully explained —
+            # strictly legal transitions from "waiting", ending "admitted"
+            # (every job completed, and completion happens while admitted)
+            assert subject, f"{app_id} completed with no decision records"
+            state = "waiting"
+            for r in subject:
+                if r.action == "admit":
+                    assert state == "waiting", (
+                        f"{app_id}: admit while {state} (seq {r.seq})")
+                    state = "admitted"
+                elif r.action == "evict":
+                    assert state == "admitted", (
+                        f"{app_id}: evict while {state} (seq {r.seq})")
+                    state = "waiting"
+                elif r.action == "shrink":
+                    assert state == "admitted", (
+                        f"{app_id}: shrink while {state} (seq {r.seq})")
+                elif r.action == "deny":
+                    assert state == "waiting", (
+                        f"{app_id}: denied while {state} (seq {r.seq})")
+            assert st.done_at is not None
+            assert state == "admitted", (
+                f"{app_id} completed but its chain folds to {state}")
+
+
+# ---------------------------------------------------------------------------
+# sim-vs-live record parity on a seeded arrival mix
+# ---------------------------------------------------------------------------
+class TestSimLiveParity:
+    def test_same_arrival_mix_same_decision_stream(self):
+        queues = {"prod": 0.6, "dev": 0.4}
+        rng_jobs = generate_jobs("batch", 40, queues, seed=13)
+        # arrivals only: effectively-infinite work, so capacity never frees
+        # and every decision is arrival-driven (time-independent policy:
+        # no grace/min-runtime/budget)
+        jobs = [
+            SimJob(app_id=j.app_id, queue=j.queue, arrival_s=float(i),
+                   work_s=10_000_000.0, demand=j.demand, priority=j.priority)
+            for i, j in enumerate(rng_jobs)
+        ]
+        sim = PoolSimulator(queues, (8 * GB, 256, 0), seed=13,
+                            policy_impl="indexed", record_decisions=True,
+                            preemption=False)
+        sim.run(jobs, horizon_s=50_000.0)  # starvation report is expected
+        sim_stream = [
+            (r.action, r.app_id, r.rule)
+            for r in sim.recorder.records if r.action != "deny"
+        ]
+        sim_denied = {(r.app_id, r.rule)
+                      for r in sim.recorder.records if r.action == "deny"}
+
+        svc = make_pool(queues=queues, preemption=False)
+        try:
+            register_cpu_node(svc, "n0", memory=8 * GB, vcores=256)
+            for j in jobs:
+                svc.register_app(
+                    j.app_id, queue=j.queue, priority=j.priority,
+                    memory_bytes=j.demand[0], vcores=j.demand[1])
+            live_stream = [
+                (r.action, r.app_id, r.rule)
+                for r in svc.recorder.records if r.action != "deny"
+            ]
+            live_denied = {(r.app_id, r.rule)
+                           for r in svc.recorder.records if r.action == "deny"}
+        finally:
+            svc.stop()
+        assert sim_stream == live_stream
+        assert sim_denied == live_denied
+        # the streams decided something (the mix overloads an 8 GB pool)
+        assert any(a == "admit" for a, _, _ in sim_stream)
+        assert sim_denied
+
+
+# ---------------------------------------------------------------------------
+# pool integration
+# ---------------------------------------------------------------------------
+class TestPoolIntegration:
+    def test_blocked_reason_in_status_and_allocate_answer(self):
+        svc = make_pool()
+        try:
+            register_cpu_node(svc, "n0")  # 4 GB
+            svc.register_app("app1", memory_bytes=3 * GB, vcores=1)
+            svc.allocate("app1", "worker", 0, 3 * GB, 1, 0)
+            svc.register_app("app2", memory_bytes=3 * GB, vcores=1)
+            svc.register_app("app3", memory_bytes=3 * GB, vcores=1)
+            wait = svc.allocate("app2", "worker", 0, 3 * GB, 1, 0)
+            assert wait["blocked_reason"] == "no-capacity"
+            assert "blocked: no-capacity" in wait["reason"]
+            st = svc.pool_status()
+            waiting = st["queues"]["default"]["waiting"]
+            assert waiting[0]["blocked_reason"] == "no-capacity"
+            assert waiting[1]["blocked_reason"] == "behind-queue-head"
+        finally:
+            svc.stop()
+
+    def test_no_rect_placement_record(self):
+        svc = make_pool()
+        try:
+            # two 4 GB hosts; app1 pins 3 GB on each → 2 GB free TOTAL but
+            # only 1 GB per host: app2 (2 GB demand) is admitted yet
+            # unplaceable on any single node
+            register_cpu_node(svc, "n0")
+            register_cpu_node(svc, "n1")
+            svc.register_app("app1", memory_bytes=6 * GB, vcores=2)
+            svc.allocate("app1", "worker", 0, 3 * GB, 1, 0)
+            svc.allocate("app1", "worker", 1, 3 * GB, 1, 0)
+            svc.register_app("app2", memory_bytes=2 * GB, vcores=1)
+            got = svc.allocate("app2", "worker", 0, 2 * GB, 1, 0)
+            assert got.get("wait") is True
+            r = svc.recorder.latest("app2")
+            assert r.action == "deny" and r.rule == "no-rect-placement"
+            assert r.detail["task"] == "worker:0"
+            ex = svc.pool_explain(app_id="app2")
+            assert ex["app"]["admitted"] is True
+            assert any(rr["rule"] == "no-rect-placement" for rr in ex["records"])
+        finally:
+            svc.stop()
+
+    def test_recorder_disabled_pool(self):
+        svc = make_pool(recorder_enabled=False)
+        try:
+            register_cpu_node(svc, "n0")
+            svc.register_app("app1", memory_bytes=3 * GB, vcores=1)
+            assert svc.pool_explain() == {"enabled": False}
+            st = svc.pool_status()  # blocked_reason degrades to None/behind
+            assert st["queues"]["default"]["waiting"] == []
+        finally:
+            svc.stop()
+
+    def test_telemetry_windows_flush_to_series_file(self, tmp_path):
+        from tony_tpu.histserver.ingest import sweep_cluster_series
+        from tony_tpu.histserver.store import HistoryStore
+
+        series = tmp_path / "pool_series.jsonl"
+        svc = make_pool(queues={"prod": 0.5, "dev": 0.5},
+                        recorder_series_file=str(series))
+        try:
+            register_cpu_node(svc, "n0")
+            # prod admits first (registration order); dev then waits
+            svc.register_app("app1", queue="prod", memory_bytes=3 * GB, vcores=1)
+            svc.allocate("app1", "worker", 0, 3 * GB, 1, 0)
+            svc.register_app("app2", queue="dev", memory_bytes=3 * GB, vcores=1)
+            assert svc.allocate("app2", "worker", 0, 3 * GB, 1, 0).get("wait")
+            # deterministic clock for the telemetry windows
+            now = [0.0]
+            svc._telemetry = QueueTelemetry(window_ms=1_000, clock=lambda: now[0])
+            with svc._lock:
+                svc._sample_telemetry_locked()
+            now[0] = 0.6
+            with svc._lock:
+                svc._sample_telemetry_locked()
+            now[0] = 1.3  # crosses the window boundary → finalize + flush
+            with svc._lock:
+                svc._sample_telemetry_locked()
+        finally:
+            svc.stop()  # flushes the open windows too
+        windows = list(read_window_lines(series))
+        assert {w["queue"] for w in windows} >= {"prod", "dev"}
+        dev = next(w for w in windows if w["queue"] == "dev"
+                   and w["window_end_ms"] == 1000)
+        assert dev["metrics"]["waiting_max"] == 1.0
+        assert dev["metrics"]["demand_max"] == 3 * GB
+        prod = next(w for w in windows if w["queue"] == "prod"
+                    and w["window_end_ms"] == 1000)
+        assert prod["metrics"]["used_max"] == 3 * GB
+        assert prod["metrics"]["utilization_avg"] == 1.5  # borrowing over share
+
+        # → history store: idempotent rows, query shape, retention
+        store = HistoryStore(str(tmp_path / "hist.sqlite"))
+        try:
+            counts = sweep_cluster_series(store, [str(series)])
+            assert counts["files"] == 1 and counts["rows"] > 0
+            again = sweep_cluster_series(store, [str(series)])
+            assert again["rows"] == counts["rows"]  # REPLACE converged
+            pts = store.cluster_series("waiting_max", queue="dev")
+            assert [p["value"] for p in pts][:1] == [1.0]
+            # source = the series file's stem, so two pools feeding one
+            # store through different files keep distinct row keys
+            assert ("pool_series", "prod") in store.cluster_queues()
+            purged = store.purge_cluster_older_than(10_000_000)
+            assert purged == counts["rows"]
+        finally:
+            store.close()
+
+    def test_gauges_exported(self):
+        from tony_tpu.obs import metrics as obs_metrics
+
+        svc = make_pool(queues={"prod": 0.5, "dev": 0.5})
+        try:
+            register_cpu_node(svc, "n0")
+            svc.register_app("app1", queue="prod", memory_bytes=3 * GB, vcores=1)
+            svc.allocate("app1", "worker", 0, 3 * GB, 1, 0)
+            with svc._lock:
+                svc._sample_telemetry_locked()
+            text = obs_metrics.REGISTRY.render()
+            assert 'tony_pool_queue_used{queue="prod"}' in text
+            assert 'tony_pool_queue_share_capacity{queue="dev"}' in text
+        finally:
+            svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# the e2e: a real pool under pressure + `tony explain` over real RPC
+# ---------------------------------------------------------------------------
+class TestExplainE2E:
+    @pytest.fixture()
+    def pressured_pool(self, monkeypatch):
+        svc = make_pool(queues={"prod": 0.5, "dev": 0.5}, preemption=True)
+        svc.start()
+        register_cpu_node(svc, "n0")
+        # the elastic borrower fills the pool from 'dev' (idle-pool borrowing)
+        svc.register_app("borrower", queue="dev", memory_bytes=4 * GB, vcores=4,
+                         elastic_unit=[GB, 1, 0], elastic_slack=3)
+        svc.allocate("borrower", "worker", 0, 4 * GB, 4, 0)
+        monkeypatch.setenv("TONY_POOL_SECRET", SECRET)
+        yield svc
+        svc.stop()
+
+    def run_cli(self, capsys, *args):
+        from tony_tpu.cli.explain import main as explain_main
+
+        rc = explain_main(list(args))
+        out = capsys.readouterr().out
+        return rc, out
+
+    def test_explain_names_binding_rules_for_queued_and_shrink_victim(
+            self, pressured_pool, capsys):
+        svc = pressured_pool
+        host, port = svc.address
+        pool_arg = f"{host}:{port}"
+        # under-share head arrives: the policy shrinks the borrower for it
+        svc.register_app("head", queue="prod", memory_bytes=2 * GB, vcores=2)
+        st = svc.pool_status()
+        assert st["queues"]["dev"]["admitted"][0]["draining"] is True
+
+        # the shrink victim's chain names partial-reclaim and who it funded
+        rc, out = self.run_cli(capsys, "borrower", "--pool", pool_arg)
+        assert rc == 0
+        assert "partial-reclaim" in out and "for head" in out
+        assert "shrink" in out
+
+        # a queued app blocked behind the in-flight shrink
+        svc.register_app("queued", queue="dev", memory_bytes=3 * GB, vcores=1)
+        got = svc.allocate("queued", "worker", 0, 3 * GB, 1, 0)
+        assert got.get("wait") is True
+        rc, out = self.run_cli(capsys, "queued", "--pool", pool_arg)
+        assert rc == 0
+        assert "WAITING in 'dev'" in out
+        assert "blocked:" in out and "deny" in out
+
+        # the queue view lists waiters with their rules
+        rc, out = self.run_cli(capsys, "--queue", "dev", "--pool", pool_arg)
+        assert rc == 0
+        assert "queued" in out and "counters:" in out
+
+        # records the CLI rendered match the recorder's own state (the RPC
+        # is a faithful view, not a re-derivation)
+        ex = svc.pool_explain(app_id="borrower")
+        assert any(r["rule"] == "partial-reclaim" and r["for_app"] == "head"
+                   for r in ex["records"])
+
+    def test_explain_records_match_journal_stream(self, tmp_path, capsys):
+        """The recorder's admit/evict facts line up with what the journal
+        persisted — provenance describes the same history the recovery
+        stream records."""
+        from tony_tpu.cluster.journal import iter_journal
+
+        jpath = tmp_path / "pool_journal.jsonl"
+        svc = make_pool(queues={"prod": 0.5, "dev": 0.5}, preemption=True,
+                        journal_path=str(jpath))
+        try:
+            register_cpu_node(svc, "n0")
+            svc.register_app("low", queue="prod", priority=0,
+                             memory_bytes=4 * GB, vcores=1)
+            svc.allocate("low", "worker", 0, 4 * GB, 1, 0)
+            svc.register_app("high", queue="prod", priority=9,
+                             memory_bytes=4 * GB, vcores=1)
+            # priority preemption: high evicts low
+            assert any(
+                r.action == "evict" and r.rule == "priority-preemption"
+                for r in svc.recorder.explain("low"))
+            journaled = {
+                rec["app_id"]: rec["admitted"]
+                for rec in iter_journal(str(jpath)) if rec.get("t") == "app"
+            }
+            # last-wins journal rows agree with the recorder's latest facts
+            assert journaled["low"] is False and journaled["high"] is True
+        finally:
+            svc.stop()
+
+    def test_cli_errors(self, capsys):
+        from tony_tpu.cli.explain import main as explain_main
+
+        assert explain_main([]) == 2                       # no target
+        assert explain_main(["a", "--queue", "q"]) == 2    # both targets
+        rc = explain_main(["app", "--pool", "127.0.0.1:1"])
+        assert rc == 1                                     # unreachable pool
+
+    def test_sim_explain_flag_conflicts(self, capsys):
+        from tony_tpu.cli.sim import main as sim_main
+
+        # --explain needs the instrumented (indexed) policy...
+        assert sim_main(["--jobs", "5", "--policy", "reference",
+                         "--explain", "x"]) == 2
+        # ...and is rejected loudly with --parity rather than ignored
+        assert sim_main(["--jobs", "5", "--parity", "--explain", "x"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# cbench: the scheduler lane runs with the recorder ON
+# ---------------------------------------------------------------------------
+class TestCbenchRecorderLane:
+    def test_scaled_lane_reports_recorder_on(self):
+        from tony_tpu.cluster.cbench import CbenchSizes, bench_scheduler
+
+        sizes = CbenchSizes(seed=0).scaled(0.01)
+        result = bench_scheduler(sizes, passes=2)
+        assert result["sched_recorder"] == "on"
+        assert result["sched_decisions_per_sec"] > 0
+        # the reference lane stays uninstrumented
+        ref = bench_scheduler(sizes, passes=2, policy_impl="reference")
+        assert ref["sched_recorder"] == "off"
+
+    def test_recorder_does_not_change_bench_decisions(self):
+        from tony_tpu.cluster.cbench import CbenchSizes, _scheduler_world
+        from dataclasses import replace as _replace
+
+        sizes = CbenchSizes(seed=0).scaled(0.01)
+        policy, template, totals = _scheduler_world(sizes)
+        bare = policy.schedule([_replace(v) for v in template], totals)
+        policy._charges.clear()
+        policy.sink = FlightRecorder()
+        recorded = policy.schedule([_replace(v) for v in template], totals)
+        assert bare.admit == recorded.admit
+        assert [(e.app_id, e.for_app) for e in bare.evict] == [
+            (e.app_id, e.for_app) for e in recorded.evict]
+
+
+# ---------------------------------------------------------------------------
+# portal: /pool blocked reasons + /history capacity dashboard
+# ---------------------------------------------------------------------------
+class TestPortalSurfaces:
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.read().decode()
+
+    def test_pool_page_and_history_capacity_dashboard(self, tmp_path, monkeypatch):
+        from tony_tpu.histserver.store import HistoryStore
+        from tony_tpu.portal import server as portal_server
+
+        monkeypatch.setenv("TONY_POOL_SECRET", SECRET)
+        svc = make_pool(queues={"prod": 0.5, "dev": 0.5})
+        svc.start()
+        try:
+            register_cpu_node(svc, "n0")
+            svc.register_app("app1", queue="prod", memory_bytes=4 * GB, vcores=1)
+            svc.allocate("app1", "worker", 0, 4 * GB, 1, 0)
+            svc.register_app("app2", queue="dev", memory_bytes=2 * GB, vcores=1)
+            svc.allocate("app2", "worker", 0, 2 * GB, 1, 0)
+            # a few telemetry samples so /pool has sparkline material
+            now = [0.0]
+            svc._telemetry = QueueTelemetry(window_ms=1_000, clock=lambda: now[0])
+            for t in (0.0, 0.3, 0.6):
+                now[0] = t
+                with svc._lock:
+                    svc._sample_telemetry_locked()
+
+            db = tmp_path / "history.sqlite"
+            store = HistoryStore(str(db))
+            store.put_cluster_windows("pool", [
+                {"queue": "prod", "window_start_ms": s, "window_end_ms": s + 1000,
+                 "metrics": {"utilization_avg": 0.5 + s / 10_000,
+                             "demand_avg": 1.0, "waiting_avg": 1.0}}
+                for s in (0, 1000, 2000)
+            ])
+            store.close()
+
+            host, port = svc.address
+            httpd = portal_server.serve(
+                str(tmp_path / "history"), 0, staging_root=str(tmp_path),
+                pool=f"{host}:{port}", history_db=str(db))
+            thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+            thread.start()
+            try:
+                pport = httpd.server_address[1]
+                pool_page = self._get(pport, "/pool")
+                assert "blocked: no-capacity" in pool_page
+                assert "queue telemetry" in pool_page
+                assert "recent scheduling decisions" in pool_page
+                hist_page = self._get(pport, "/history")
+                assert "cluster capacity" in hist_page
+                assert "pool/prod" in hist_page
+                api = json.loads(self._get(
+                    pport, "/api/history/cluster/utilization_avg"))
+                assert len(api) == 3 and api[0]["queue"] == "prod"
+            finally:
+                httpd.shutdown()
+                httpd.server_close()
+                thread.join()
+        finally:
+            svc.stop()

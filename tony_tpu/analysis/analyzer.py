@@ -328,6 +328,7 @@ def all_checkers() -> list[Checker]:
     per-run state, so instances must not be shared between runs)."""
     from tony_tpu.analysis.config_keys import ConfigKeyChecker
     from tony_tpu.analysis.donation import DonationChecker
+    from tony_tpu.analysis.events_discipline import EventsDisciplineChecker
     from tony_tpu.analysis.host_sync import HostSyncChecker
     from tony_tpu.analysis.jit_purity import JitPurityChecker
     from tony_tpu.analysis.locks import LockDisciplineChecker
@@ -343,5 +344,6 @@ def all_checkers() -> list[Checker]:
         MeshAxisChecker(),
         PrintDisciplineChecker(),
         MetricsDisciplineChecker(),
+        EventsDisciplineChecker(),
         HostSyncChecker(),
     ]

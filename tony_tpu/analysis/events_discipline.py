@@ -1,0 +1,106 @@
+"""events-discipline: the `.jhist` event vocabulary is documented.
+
+Every member of an ``EventType`` enum (cluster/events.py — the types the
+``EventHandler`` writes into the job history stream and every consumer —
+portal, ``tony history``/``goodput``/``trace``, the ingest distiller —
+switches on) must appear in docs/observability.md's event table. Same
+ratchet as ``metrics-discipline``, and the drift it catches is just as
+real: four generations of observability (PRs 9–14) added preemption /
+straggler / alert / takeover events faster than the docs followed, so the
+one table operators grep to interpret a ``.jhist`` stream went stale.
+
+Declaration-site check on purpose: consumers can only emit declared
+members (``EventType.X`` on an undeclared ``X`` is an ``AttributeError``),
+so documenting the declaration covers every emission. Exempt by path:
+tests, fixtures, examples, docs. A deliberately undocumented member (e.g.
+an experiment behind a flag) carries an inline
+``# lint: disable=events-discipline — <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable
+
+from tony_tpu.analysis.analyzer import Checker, Finding, Module, dotted_name
+
+EXEMPT_PARTS = frozenset({"tests", "fixtures", "examples", "docs"})
+
+_DOC_RELPATH = os.path.join("docs", "observability.md")
+#: backticked ALL_CAPS tokens — the event table's name cells
+_NAME_RE = re.compile(r"`([A-Z][A-Z0-9_]{2,})`")
+
+#: enum base spellings under which EventType classes are declared
+_ENUM_BASES = frozenset({"enum.Enum", "Enum", "enum.StrEnum", "StrEnum"})
+
+
+def _documented_names(start: str) -> "set[str] | None":
+    """All backticked ALL-CAPS names in docs/observability.md, found by
+    walking up from ``start``; None when the doc is missing (a vendored
+    checkout without docs — nothing to ratchet against)."""
+    d = os.path.dirname(os.path.abspath(start))
+    for _ in range(12):
+        doc = os.path.join(d, _DOC_RELPATH)
+        if os.path.exists(doc):
+            try:
+                with open(doc, encoding="utf-8") as f:
+                    return set(_NAME_RE.findall(f.read()))
+            except OSError:
+                return None
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
+class EventsDisciplineChecker(Checker):
+    name = "events-discipline"
+    description = (
+        "every EventType member (the .jhist event vocabulary) has a row in "
+        "docs/observability.md's event table"
+    )
+
+    def __init__(self) -> None:
+        self._doc_names: "set[str] | None" = None
+        self._doc_loaded = False
+
+    @staticmethod
+    def _is_event_enum(node: ast.ClassDef) -> bool:
+        if node.name != "EventType":
+            return False
+        return any(
+            (dotted_name(b) or "") in _ENUM_BASES for b in node.bases
+        )
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        parts = set(os.path.normpath(module.path).split(os.sep))
+        if parts & EXEMPT_PARTS:
+            return
+        if not self._doc_loaded:
+            self._doc_loaded = True
+            self._doc_names = _documented_names(module.abspath)
+        if self._doc_names is None:
+            return  # no docs tree in scope: nothing to ratchet against
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef) or not self._is_event_enum(node):
+                continue
+            for stmt in node.body:
+                if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                    continue
+                target = stmt.targets[0]
+                if not isinstance(target, ast.Name):
+                    continue
+                value = stmt.value
+                if not (isinstance(value, ast.Constant) and isinstance(value.value, str)):
+                    continue
+                if value.value not in self._doc_names and target.id not in self._doc_names:
+                    yield self.finding(
+                        module, stmt,
+                        f"event type {value.value!r} is not in "
+                        "docs/observability.md's event table — an "
+                        "undocumented event is a .jhist record operators "
+                        "cannot interpret; add a row (name in backticks)",
+                    )

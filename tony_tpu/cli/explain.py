@@ -1,0 +1,202 @@
+"""``tony explain``: why is my app queued — and who paid for what ran.
+
+Renders the pool's scheduler flight recorder (cluster/recorder.py, the
+``pool_explain`` RPC; docs/scheduling.md "Explaining decisions"):
+
+    tony explain app_123 --pool 127.0.0.1:31000     # one app's causal chain
+    tony explain --queue prod --pool 127.0.0.1:31000  # queue health + records
+
+The pool address comes from ``--pool host:port``, or from ``tony-site.json``'s
+``tony.tpu.pool`` (the ``rm:host:port`` spelling jobs submit against); the
+secret from ``$TONY_POOL_SECRET`` (or the site file's ``tony.tpu.pool.secret``).
+
+Output for an app is its current scheduling state — including the BINDING
+RULE currently blocking it (``share-deficit``, ``budget-exhausted``,
+``min-runtime-shield``, ``no-rect-placement``, …) — followed by its decision
+chain: every admit/evict/shrink it was the subject of or funded, and every
+coalesced denial, oldest first. For a shrink victim the chain names the head
+the shed workers funded; for a waiting head it names the guard that keeps
+refusing it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any
+
+from tony_tpu import constants
+
+
+def _fmt_ts(unix_ms: int) -> str:
+    """Wall-clock records render as clock time; the simulator's virtual-clock
+    records (small millisecond values) render as ``t=<seconds>s``."""
+    if unix_ms >= 10_000_000_000:  # ~1970-04 in ms: anything real is past this
+        return time.strftime("%H:%M:%S", time.localtime(unix_ms / 1000.0))
+    return f"t={unix_ms / 1000.0:.1f}s"
+
+
+def _fmt_detail(detail: dict[str, Any]) -> str:
+    return " ".join(f"{k}={v}" for k, v in detail.items())
+
+
+def render_records(records: list[dict[str, Any]]) -> list[str]:
+    """Human lines for a DecisionRecord list (dict form), oldest first."""
+    lines = []
+    for r in records:
+        count = f"  ×{r['count']}" if r.get("count", 1) > 1 else ""
+        target = f" for {r['for_app']}" if r.get("for_app") else ""
+        lines.append(
+            f"  [pass {r['pass_id']:>4}] {_fmt_ts(r['unix_ms'])}  "
+            f"{r['action']:<6} {r['rule']:<20} {r['app_id']}{target}"
+            + (f"  ({_fmt_detail(r['detail'])})" if r.get("detail") else "")
+            + count
+        )
+    return lines
+
+
+def render_app(payload: dict[str, Any], app_id: str) -> str:
+    state = payload.get("app")
+    lines: list[str] = []
+    if state is None:
+        lines.append(f"{app_id}: not registered with this pool "
+                     "(finished, or never submitted here)")
+    elif state["admitted"]:
+        drain = (f", {state['drain_mode']} in flight"
+                 if state.get("draining") else "")
+        lines.append(
+            f"{app_id}: ADMITTED in {state['queue']!r} "
+            f"(priority {state['priority']}, claim {state['claim']}{drain})")
+    else:
+        blocked = state.get("blocked_reason")
+        lines.append(
+            f"{app_id}: WAITING in {state['queue']!r} "
+            f"(position {state['position']}, {state['waiting_s']:.0f}s"
+            + (", preempted" if state.get("preempted") else "") + ")"
+            + (f" — blocked: {blocked}" if blocked else ""))
+    records = payload.get("records") or []
+    if records:
+        lines.append("decision chain (oldest first):")
+        lines.extend(render_records(records))
+    else:
+        lines.append("no decision records yet (the scheduler has not "
+                     "evaluated a pass involving this app, or the ring "
+                     "rotated past it)")
+    return "\n".join(lines)
+
+
+def render_queue(payload: dict[str, Any], queue: str) -> str:
+    q = payload.get("queue") or {}
+    lines = [
+        f"queue {queue!r}: share {q.get('share')}, "
+        f"used {q.get('used')} / guarantee {q.get('share_capacity')}, "
+        f"waiting demand {q.get('demand')} "
+        f"({int(q.get('waiting') or 0)} app(s), oldest {q.get('wait_age_s')}s)",
+        "counters: " + (_fmt_detail(q.get("counters") or {}) or "none"),
+    ]
+    for w in q.get("waiters") or []:
+        lines.append(f"  #{w['position']} {w['app_id']}"
+                     + (f" — blocked: {w['blocked_reason']}"
+                        if w.get("blocked_reason") else ""))
+    records = payload.get("records") or []
+    if records:
+        lines.append("recent records (oldest first):")
+        lines.extend(render_records(records))
+    series = payload.get("series") or []
+    if series:
+        last = series[-1]
+        lines.append(
+            f"telemetry: {len(series)} sample(s); latest used={last['used']} "
+            f"demand={last['demand']} waiting={int(last['waiting'])} "
+            f"wait_age={last['wait_age_s']}s")
+    return "\n".join(lines)
+
+
+def _resolve_pool(pool_flag: str) -> tuple[str, int, str]:
+    """(host, port, secret) from --pool / tony-site.json / environment."""
+    secret = os.environ.get(constants.ENV_POOL_SECRET, "")
+    addr = pool_flag
+    if not addr or not secret:
+        site = os.path.join(os.getcwd(), constants.TONY_SITE_CONF)
+        if os.path.exists(site):
+            from tony_tpu.config import TonyConfig, keys
+
+            cfg = TonyConfig.from_layers(site_file=site)
+            if not addr:
+                spec = cfg.get(keys.TPU_POOL_SPEC) or ""
+                if spec.startswith("rm:"):
+                    addr = spec[3:]
+            if not secret:
+                secret = cfg.get(keys.TPU_POOL_SECRET) or ""
+    if not addr:
+        raise ValueError(
+            "no pool address: pass --pool host:port, or run where "
+            "tony-site.json sets tony.tpu.pool=rm:host:port")
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"bad pool address {addr!r} (want host:port)")
+    return host, int(port), secret
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tony explain",
+        description="render the pool scheduler's decision provenance for an "
+                    "app or a queue (docs/scheduling.md 'Explaining decisions')",
+    )
+    p.add_argument("app_id", nargs="?", default="",
+                   help="application id to explain")
+    p.add_argument("--queue", default="",
+                   help="explain a queue instead: health, waiters' binding "
+                        "rules, recent records, telemetry")
+    p.add_argument("--pool", default="",
+                   help="pool service host:port (default: tony-site.json's "
+                        "tony.tpu.pool=rm:host:port)")
+    p.add_argument("--limit", type=int, default=50,
+                   help="most recent records to fetch")
+    p.add_argument("--json", action="store_true", help="raw pool_explain payload")
+    args = p.parse_args(argv)
+
+    if bool(args.app_id) == bool(args.queue):
+        print("tony explain: give exactly one of <app_id> or --queue",
+              file=sys.stderr)
+        return 2
+    try:
+        host, port, secret = _resolve_pool(args.pool)
+    except ValueError as e:
+        print(f"tony explain: {e}", file=sys.stderr)
+        return 2
+
+    from tony_tpu.cluster.rpc import RpcClient, RpcError
+
+    cli = RpcClient(host, port, secret=secret, timeout_s=5.0)
+    try:
+        payload = cli.call(
+            "pool_explain", app_id=args.app_id, queue=args.queue,
+            limit=args.limit)
+    except (RpcError, OSError) as e:
+        print(f"tony explain: pool {host}:{port} unreachable: {e}",
+              file=sys.stderr)
+        return 1
+    finally:
+        cli.close()
+
+    if not payload.get("enabled"):
+        print("tony explain: this pool runs with the flight recorder "
+              "disabled (tony.pool.recorder.enabled=false)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(payload, indent=1))
+        return 0
+    if args.app_id:
+        print(render_app(payload, args.app_id))
+    else:
+        print(render_queue(payload, args.queue))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

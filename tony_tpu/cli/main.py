@@ -124,6 +124,12 @@ def _cmd_sim(argv: list[str]) -> int:
     return sim_main(argv)
 
 
+def _cmd_explain(argv: list[str]) -> int:
+    from tony_tpu.cli.explain import main as explain_main
+
+    return explain_main(argv)
+
+
 def _cmd_loadtest(argv: list[str]) -> int:
     from tony_tpu.cli.loadtest import main as loadtest_main
 
@@ -346,6 +352,7 @@ _COMMANDS = {
     "resize": _cmd_resize,
     "goodput": _cmd_goodput,
     "sim": _cmd_sim,
+    "explain": _cmd_explain,
     "tune": _cmd_tune,
     "loadtest": _cmd_loadtest,
     "cbench": _cmd_cbench,
@@ -355,7 +362,7 @@ _COMMANDS = {
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|history-server|bench|cbench|portal|notebook|serve|loadtest|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput|sim|tune} [options]\n")
+        print("usage: tony {submit|pool|history|history-server|bench|cbench|portal|notebook|serve|loadtest|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput|sim|explain|tune} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    query the persistent history tier (list|show|compare|ingest|gc)")
@@ -377,6 +384,7 @@ def main(argv: list[str] | None = None) -> int:
         print("  resize     retarget a RUNNING job's per-type instance count (elastic rebuild)")
         print("  goodput    exact goodput/badput phase accounting + straggler skew + alert history")
         print("  sim        replay seeded synthetic arrivals against the live scheduler policy (invariant check)")
+        print("  explain    render the pool scheduler's decision provenance for an app or queue (flight recorder)")
         print("  tune       autotune Pallas kernel block sizes on this backend into the on-disk cache")
         return 0
     cmd = _COMMANDS.get(argv[0])

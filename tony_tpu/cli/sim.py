@@ -74,6 +74,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="replay ALL mixes through BOTH policy implementations "
                         "and diff decision traces event-by-event; exits 1 on "
                         "the first divergence, printing both decisions")
+    p.add_argument("--explain", default="", metavar="APP_ID",
+                   help="record DecisionRecords during the replay (the same "
+                        "flight recorder the live pool runs) and print this "
+                        "app's causal chain after the run — offline what-if "
+                        "provenance, diffable against `tony explain` "
+                        "(docs/scheduling.md 'Explaining decisions'). "
+                        "Requires --policy indexed")
     p.add_argument("--json", action="store_true", help="machine-readable report")
     args = p.parse_args(argv)
 
@@ -86,6 +93,11 @@ def main(argv: list[str] | None = None) -> int:
         print("tony sim: --jobs must be >= 1", file=sys.stderr)
         return 2
     totals = (int(args.memory * GB), int(args.vcores), int(args.chips))
+    if args.explain and args.parity:
+        print("tony sim: --explain and --parity are mutually exclusive "
+              "(parity replays both policies; run --explain separately)",
+              file=sys.stderr)
+        return 2
     if args.parity:
         rc = 0
         for mix in MIXES:
@@ -108,6 +120,10 @@ def main(argv: list[str] | None = None) -> int:
             if not ok:
                 rc = 1
         return rc
+    if args.explain and args.policy != "indexed":
+        print("tony sim: --explain needs the indexed policy (the reference "
+              "oracle is uninstrumented)", file=sys.stderr)
+        return 2
     sim = PoolSimulator(
         queues, totals,
         preemption=not args.no_preemption,
@@ -118,9 +134,20 @@ def main(argv: list[str] | None = None) -> int:
         budget_window_ms=args.budget_window_ms,
         seed=args.seed,
         policy_impl=args.policy,
+        record_decisions=bool(args.explain),
     )
     report = sim.run(generate_jobs(args.mix, args.jobs, queues, args.seed))
     print(render_report(report, as_json=args.json))
+    if args.explain and sim.recorder is not None:
+        from tony_tpu.cli.explain import render_records
+
+        chain = [r.to_dict() for r in sim.recorder.explain(args.explain)]
+        if chain:
+            print(f"\n{args.explain} decision chain (virtual clock, oldest first):")
+            print("\n".join(render_records(chain)))
+        else:
+            print(f"\n{args.explain}: no decision records in this replay "
+                  "(unknown app id, or it never reached a scheduling pass)")
     return 0 if report.ok() else 1
 
 

@@ -33,6 +33,7 @@ from typing import Any
 
 from tony_tpu.cluster.journal import Journal
 from tony_tpu.cluster.policy import AppView, WorldIndex, make_policy
+from tony_tpu.cluster.recorder import FlightRecorder
 from tony_tpu.config import TonyConfig, keys
 from tony_tpu.serve.loadgen import percentile as _percentile_of  # nearest-rank, shared
 
@@ -147,10 +148,18 @@ def bench_scheduler(
 
     ``sched_policy`` records which implementation ran (provenance — an
     indexed and a reference round are different benchmarks wearing the same
-    name)."""
+    name). The flight recorder (cluster/recorder.py) rides the whole timed
+    region on the indexed pass — ``sched_recorder: "on"`` in the record —
+    so the gate proves decision provenance costs nothing material: the
+    recorder-enabled round must hold ``sched_incremental_p50_ms`` (and the
+    rest of the lane) within tolerance of the recorder-less trajectory."""
     import gc
 
     policy, template, totals = _scheduler_world(sizes, policy_impl)
+    recorder: FlightRecorder | None = None
+    if hasattr(policy, "schedule_world"):  # the indexed implementation
+        recorder = FlightRecorder(capacity=4096)
+        policy.sink = recorder
     times: list[float] = []
     admitted = 0
     for i in range(passes + 1):
@@ -175,6 +184,7 @@ def bench_scheduler(
         "sched_decision_p99_ms": round(_percentile(times, 0.99) * 1000, 3),
         "sched_admitted_per_pass": admitted,
         "sched_policy": policy_impl,
+        "sched_recorder": "on" if recorder is not None else "off",
     }
     if hasattr(policy, "schedule_world"):
         result.update(_bench_scheduler_steady_state(policy, template, totals, sizes))

@@ -526,7 +526,17 @@ class WorldIndex:
 # ---------------------------------------------------------------------------
 class _PolicyCore:
     """Guards and configuration shared by both implementations, stateful
-    only in the per-queue eviction budget (a rolling log of charges)."""
+    only in the per-queue eviction budget (a rolling log of charges).
+
+    ``sink`` is the decision-provenance seam (cluster/recorder.py,
+    docs/scheduling.md "Explaining decisions"): an object with
+    ``begin_pass()`` and ``note(action, app_id, queue, rule, for_app="",
+    **detail)``. When set on the INDEXED implementation, every committed
+    admit/evict/shrink and every blocked queue head's binding rule is
+    reported; recording never changes a decision (asserted by the
+    provenance-neutrality test in tests/test_recorder.py). The reference
+    oracle ignores the sink — it exists as the parity spec, and
+    instrumenting it would only create a second vocabulary to drift."""
 
     def __init__(
         self,
@@ -538,9 +548,11 @@ class _PolicyCore:
         eviction_budget: int = 0,
         budget_window_ms: int = 60_000,
         clock=time.monotonic,
+        sink=None,
     ):
         validate_queue_shares(queues)
         self.queues = dict(queues)
+        self.sink = sink
         self.preemption = preemption
         # cross-queue reclaim fires only for heads waiting at least this
         # long (tony.pool.preemption.grace-ms): transient waits — an app
@@ -976,7 +988,23 @@ class PreemptionPolicy(_PolicyCore):
         next pass without a rebuild."""
         decision = Decision()
         self.last_wake_at = None
+        sink = self.sink
+        if sink is not None:
+            sink.begin_pass()
+        #: provenance: app_id → (binding rule, detail | None) for blocked
+        #: heads — refined by the preemption paths, reported at pass end for
+        #: heads that stayed waiting. The hot admit loop stores rule
+        #: sentinels only (no dict/list building per iteration — the
+        #: recorder must cost nothing material, CBENCH's recorder-on gate);
+        #: detail materializes once, for the ≤len(queues) final heads.
+        #: Pure bookkeeping; decisions never read it.
+        deny: dict[str, tuple[str, dict | None]] = {}
         if not any(totals):
+            if sink is not None:
+                for q in self.queues:
+                    head = world.head(q)
+                    if head is not None:
+                        sink.note("deny", head.app_id, q, "pool-empty")
             return decision  # no capacity registered yet — everything waits
         primary = 2 if totals[2] > 0 else 0  # chips when the pool has chips
         now = self.clock()
@@ -1016,6 +1044,8 @@ class PreemptionPolicy(_PolicyCore):
                     continue
                 if not self._fits(free, head.demand):
                     blocked_heads.append(head)
+                    if sink is not None:
+                        deny[head.app_id] = ("no-capacity", None)
                     continue
                 used = queue_used.get(q, 0)
                 others_waiting = world.waiting_total - world.waiting_count(q) > 0
@@ -1026,32 +1056,58 @@ class PreemptionPolicy(_PolicyCore):
                     # borrowing only applies to an otherwise-idle pool; a
                     # queue's FIRST app always may run)
                     blocked_heads.append(head)
+                    if sink is not None:
+                        deny[head.app_id] = ("share-deficit", None)
                     continue
                 key = (used / share, head.sort_key)
                 if best is None or key < best[0]:
                     best = (key, head)
             if best is not None:
+                if sink is not None:
+                    sink.note("admit", best[1].app_id, best[1].queue, "fits-free")
+                    deny.pop(best[1].app_id, None)
                 admit(best[1])
                 continue
             if self.preemption and blocked_heads:
                 blocked_heads.sort(key=lambda a: a.sort_key)
                 if self._preempt_for(
                     blocked_heads[0], world, free, queue_used, primary, totals,
-                    admit, do_evict, now,
+                    admit, do_evict, now, deny,
                 ):
                     continue
                 if any(
                     self._reclaim_across_queues(
                         h, world, free, queue_used, primary, totals,
-                        admit, do_evict, decision, now, allow_shrink=True,
+                        admit, do_evict, decision, now, deny, allow_shrink=True,
                     )
                     or self._reclaim_across_queues(
                         h, world, free, queue_used, primary, totals,
-                        admit, do_evict, decision, now, allow_shrink=False,
+                        admit, do_evict, decision, now, deny, allow_shrink=False,
                     )
                     for h in blocked_heads
                 ):
                     continue
+            if sink is not None:
+                # the pass settled: report each still-blocked head's binding
+                # rule — the newest refinement (a preemption path that got
+                # further than the admit loop's base reason) wins. Details
+                # the hot loop deferred (None) materialize here, once.
+                for head in blocked_heads:
+                    rule, detail = deny.get(head.app_id, ("no-capacity", None))
+                    if detail is None:
+                        if rule == "no-capacity":
+                            detail = {"ask": list(head.demand), "free": list(free)}
+                        elif rule == "share-deficit":
+                            detail = {
+                                "used": queue_used.get(head.queue, 0),
+                                "ask": head.demand[primary],
+                                "share_capacity": int(
+                                    self.queues.get(head.queue, 1.0)
+                                    * totals[primary]),
+                            }
+                        else:
+                            detail = {}
+                    sink.note("deny", head.app_id, head.queue, rule, **detail)
             return decision
 
     def _preempt_for(
@@ -1065,20 +1121,31 @@ class PreemptionPolicy(_PolicyCore):
         admit,
         do_evict,
         now: float,
+        deny: dict | None = None,
     ) -> bool:
         """Same-queue priority preemption over the maintained victim order
         (see ``ReferencePolicy._preempt_for`` for the full semantics). The
         victim walk stops at the first admitted app whose priority reaches
         ``cand``'s — everything after it in (priority, -seq) order is
-        ineligible by construction."""
+        ineligible by construction.
+
+        ``deny`` is provenance-only (sink attached): a failure refines the
+        candidate's binding rule when a guard — not raw capacity — blocked
+        it. Never consulted by the decision."""
+        sink = self.sink
         demand = cand.demand
         chosen: list[AppView] = []
         trial = list(free)
         freed_primary = 0
+        shield_skips = drain_skips = 0
         for v in world.victims_iter(cand.queue):
             if v.priority >= cand.priority:
                 break
-            if v.shrink_pending or self._note_protected(v, now):
+            if v.shrink_pending:
+                drain_skips += 1
+                continue
+            if self._note_protected(v, now):
+                shield_skips += 1
                 continue
             if self._fits(trial, demand):
                 break
@@ -1088,6 +1155,16 @@ class PreemptionPolicy(_PolicyCore):
             freed_primary += c[primary]
             chosen.append(v)
         if not chosen or not self._fits(trial, demand):
+            if sink is not None and deny is not None and not self._fits(free, demand):
+                # refine only when a GUARD withheld victims that existed:
+                # with none skipped, "no-capacity" (the base reason) is true
+                if shield_skips:
+                    deny[cand.app_id] = ("min-runtime-shield", {
+                        "protected_victims": shield_skips,
+                        "min_runtime_ms": self.min_runtime_ms})
+                elif drain_skips:
+                    deny[cand.app_id] = ("drain-pending", {
+                        "draining_victims": drain_skips})
             return False
         net_growth = demand[primary] - freed_primary
         if net_growth > 0:
@@ -1095,13 +1172,30 @@ class PreemptionPolicy(_PolicyCore):
             used_after = queue_used.get(cand.queue, 0) - freed_primary
             cap = self.queues.get(cand.queue, 1.0) * totals[primary]
             if others_waiting and used_after > 0 and used_after + demand[primary] > cap:
+                if sink is not None and deny is not None:
+                    deny[cand.app_id] = ("share-deficit", {
+                        "used_after_evictions": used_after,
+                        "ask": demand[primary], "share_capacity": int(cap)})
                 return False
         if len(chosen) > self._budget_remaining(cand.queue, now):
             self._wake_budget(cand.queue, now)
+            if sink is not None and deny is not None:
+                deny[cand.app_id] = ("budget-exhausted", {
+                    "needed": len(chosen), "budget": self.eviction_budget,
+                    "window_ms": self.budget_window_ms})
             return False  # aggressor queue spent its preemption budget: wait
         self._charge(cand.queue, len(chosen), now)
         for v in chosen:
+            if sink is not None:
+                sink.note("evict", v.app_id, v.queue, "priority-preemption",
+                          for_app=cand.app_id,
+                          victim_priority=v.priority, head_priority=cand.priority)
             do_evict(v, cand)
+        if sink is not None:
+            sink.note("admit", cand.app_id, cand.queue, "priority-preemption",
+                      evicted=[v.app_id for v in chosen])
+            if deny is not None:
+                deny.pop(cand.app_id, None)
         admit(cand)
         return True
 
@@ -1117,18 +1211,33 @@ class PreemptionPolicy(_PolicyCore):
         do_evict,
         decision: Decision,
         now: float,
+        deny: dict | None = None,
+        *,
         allow_shrink: bool,
     ) -> bool:
         """Cross-queue reclaim over the maintained victim orders (see
         ``ReferencePolicy._reclaim_across_queues`` for the full semantics —
         rules and outcome are identical; only the victim lookup changed
-        from sort-everything to walk-the-index)."""
+        from sort-everything to walk-the-index). ``deny`` is provenance-only
+        (see ``_preempt_for``)."""
+        sink = self.sink
         demand = cand.demand
         cap_cand = self.queues.get(cand.queue, 1.0) * totals[primary]
         if queue_used.get(cand.queue, 0) + demand[primary] > cap_cand:
+            if sink is not None and deny is not None:
+                # the YARN-style guarantee gate: reclaim only ever RESTORES a
+                # share — a head whose claim overshoots its own guarantee may
+                # not fund itself with other queues' evictions
+                deny[cand.app_id] = ("share-deficit", {
+                    "used": queue_used.get(cand.queue, 0),
+                    "ask": demand[primary], "share_capacity": int(cap_cand)})
             return False  # head would overshoot its own guarantee
         if now - cand.wait_since < self.grace_ms / 1000.0:
             self._wake(cand.wait_since + self.grace_ms / 1000.0)
+            if sink is not None and deny is not None:
+                deny[cand.app_id] = ("grace-pending", {
+                    "waited_ms": int((now - cand.wait_since) * 1000),
+                    "grace_ms": self.grace_ms})
             return False
         trial = list(free)
         trial_used = dict(queue_used)
@@ -1136,6 +1245,7 @@ class PreemptionPolicy(_PolicyCore):
         chosen_ids: set[str] = set()
         shrinks: dict[str, int] = {}          # app_id → workers to shed
         slack_left: dict[str, int] = {}       # lazily seeded from the views
+        shield_skips = drain_skips = 0
         while not self._fits(trial, demand):
             # most over-share queue first (by primary-dimension excess)
             best: tuple[float, AppView] | None = None
@@ -1151,14 +1261,30 @@ class PreemptionPolicy(_PolicyCore):
                     # took it as far as its slack allows, and shrinking and
                     # whole-evicting the same app would double-free it (the
                     # pure-evict fallback pass may still evict it whole)
-                    if (v.app_id in chosen_ids or v.app_id in shrinks
-                            or v.shrink_pending or self._note_protected(v, now)):
+                    if v.app_id in chosen_ids or v.app_id in shrinks:
+                        continue
+                    if v.shrink_pending:
+                        drain_skips += 1
+                        continue
+                    if self._note_protected(v, now):
+                        shield_skips += 1
                         continue
                     victim = v
                     break
                 if victim is not None and (best is None or excess > best[0]):
                     best = (excess, victim)
             if best is None:
+                if sink is not None and deny is not None:
+                    if shield_skips:
+                        deny[cand.app_id] = ("min-runtime-shield", {
+                            "protected_victims": shield_skips,
+                            "min_runtime_ms": self.min_runtime_ms})
+                    elif drain_skips:
+                        deny[cand.app_id] = ("drain-pending", {
+                            "draining_victims": drain_skips})
+                    elif not chosen and not shrinks:
+                        deny[cand.app_id] = ("no-eligible-victims", {
+                            "ask": demand[primary]})
                 return False  # no eligible borrower left and cand still unfit
             excess, v = best
             unit = v.elastic_unit
@@ -1198,6 +1324,10 @@ class PreemptionPolicy(_PolicyCore):
         disruptions = len(chosen) + len(shrinks)
         if disruptions > self._budget_remaining(cand.queue, now):
             self._wake_budget(cand.queue, now)
+            if sink is not None and deny is not None:
+                deny[cand.app_id] = ("budget-exhausted", {
+                    "needed": disruptions, "budget": self.eviction_budget,
+                    "window_ms": self.budget_window_ms})
             return False  # aggressor queue spent its preemption budget: wait
         self._charge(cand.queue, disruptions, now)
         for app_id, k in shrinks.items():
@@ -1210,9 +1340,21 @@ class PreemptionPolicy(_PolicyCore):
                 free[i] += k * unit[i]
             queue_used[v.queue] -= k * unit[primary]
             decision.shrink.append(Shrink(app_id=app_id, workers=k, for_app=cand.app_id))
+            if sink is not None:
+                sink.note("shrink", app_id, v.queue, "partial-reclaim",
+                          for_app=cand.app_id, workers=k)
             world.note_shrunk(v)
         for v in chosen:
+            if sink is not None:
+                sink.note("evict", v.app_id, v.queue, "share-reclaim",
+                          for_app=cand.app_id)
             do_evict(v, cand)
+        if sink is not None:
+            sink.note("admit", cand.app_id, cand.queue, "share-reclaim",
+                      evicted=[v.app_id for v in chosen],
+                      shrunk=sorted(shrinks))
+            if deny is not None:
+                deny.pop(cand.app_id, None)
         admit(cand)
         return True
 

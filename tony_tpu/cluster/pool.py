@@ -56,6 +56,12 @@ from tony_tpu.cluster.policy import (
     make_policy,
     validate_queue_shares as _validate_queue_shares,
 )
+from tony_tpu.cluster.recorder import (
+    DecisionRecord,
+    FlightRecorder,
+    QueueTelemetry,
+    window_line,
+)
 from tony_tpu.cluster.resources import (
     AllocationError,
     AllocationPending,
@@ -79,6 +85,7 @@ POOL_RPC_METHODS = [
     "poll_exited",
     "request_kill",
     "pool_status",
+    "pool_explain",
     "cluster_capacity",
     "pool_metrics",
 ]
@@ -99,6 +106,30 @@ _POOL_DRAIN_SECONDS = obs_metrics.histogram(
     "tony_pool_drain_duration_seconds",
     "eviction-to-resolution latency of cooperative drain/shrink episodes",
     buckets=obs_metrics.WAIT_BUCKETS)
+# per-queue telemetry (tony.pool.recorder.*, docs/scheduling.md "Explaining
+# decisions"): sampled on the liveness tick, primary capacity dimension
+_POOL_QUEUE_USED = obs_metrics.gauge(
+    "tony_pool_queue_used",
+    "admitted claim per queue in the pool's primary capacity dimension",
+    labelnames=("queue",))
+_POOL_QUEUE_SHARE_CAPACITY = obs_metrics.gauge(
+    "tony_pool_queue_share_capacity",
+    "the queue's share GUARANTEE in the primary capacity dimension",
+    labelnames=("queue",))
+_POOL_QUEUE_DEMAND = obs_metrics.gauge(
+    "tony_pool_queue_demand",
+    "waiting (unadmitted) claim per queue in the primary capacity dimension",
+    labelnames=("queue",))
+_POOL_QUEUE_WAITING = obs_metrics.gauge(
+    "tony_pool_queue_waiting", "apps waiting per queue", labelnames=("queue",))
+_POOL_QUEUE_WAIT_AGE = obs_metrics.gauge(
+    "tony_pool_queue_wait_age_seconds",
+    "age of the queue's oldest waiter", labelnames=("queue",))
+_POOL_QUEUE_DENIALS = obs_metrics.counter(
+    "tony_pool_queue_denials_total",
+    "blocked-head denials by binding rule (the flight recorder's deny "
+    "records; docs/scheduling.md 'Explaining decisions')",
+    labelnames=("queue", "rule"))
 
 _RUNNING, _EXITED, _RELEASED = "RUNNING", "EXITED", "RELEASED"
 
@@ -240,6 +271,10 @@ class PoolService:
         journal_path: str | None = None,
         journal_compact_every: int = 0,
         scheduler_indexed: bool = True,
+        recorder_enabled: bool = True,
+        recorder_capacity: int = 2048,
+        recorder_window_ms: int = 60_000,
+        recorder_series_file: str | None = None,
         chaos=None,
     ):
         self.heartbeat_interval_ms = heartbeat_interval_ms
@@ -276,6 +311,32 @@ class PoolService:
         self._sched_seen_version = -1
         self._sched_last_empty = False
         self._sched_wake_at: float | None = None
+        # flight recorder (tony.pool.recorder.*, docs/scheduling.md
+        # "Explaining decisions"): the policy's decision-provenance sink —
+        # admit/evict/shrink facts plus every blocked head's binding rule in
+        # a bounded in-memory ring served by `pool_explain` / `tony explain`.
+        # In-memory on purpose: provenance is diagnostics, not recoverable
+        # state — a restarted pool re-derives current reasons in one pass.
+        # Provenance needs the indexed pass (the default); the reference
+        # oracle stays uninstrumented by design.
+        self.recorder: FlightRecorder | None = None
+        self._telemetry: QueueTelemetry | None = None
+        self._series_file = recorder_series_file or None
+        # the cluster_series source identity: the series file's stem, so two
+        # pools feeding one history store through different files can never
+        # clobber each other's (source, queue, metric, window) rows
+        self._series_source = (
+            os.path.splitext(os.path.basename(self._series_file))[0] or "pool"
+            if self._series_file else "pool"
+        )
+        self._telemetry_next = 0.0
+        if recorder_enabled:
+            self.recorder = FlightRecorder(
+                capacity=recorder_capacity,
+                on_note=self._on_decision_record,
+            )
+            self._policy.sink = self.recorder
+            self._telemetry = QueueTelemetry(window_ms=recorder_window_ms)
         # held resources per app over RUNNING containers, maintained at the
         # container create/exit/release transitions so neither the policy
         # views nor pool_status rescan every container record
@@ -573,6 +634,11 @@ class PoolService:
     def stop(self) -> None:
         self._stop.set()
         self.rpc.stop()
+        if self._telemetry is not None:
+            # partial windows still carry signal: flush them marked by their
+            # true end instant rather than losing the tail of the pool's life
+            with self._lock:
+                self._flush_series_locked(self._telemetry.flush())
         if self._journal is not None:
             self._journal.close()
 
@@ -866,19 +932,22 @@ class PoolService:
                         f"pool's total capacity ({totals[0]}B/{totals[1]}vc/"
                         f"{totals[2]}ch) — it can never be admitted"
                     )
-                waiting = [
-                    a for a in self._apps.values()
-                    if a.queue == app.queue and not a.admitted
-                ]
-                waiting.sort(key=lambda a: a.sort_key)
+                waiting = self._waiting_sorted_locked(app.queue)
+                position = waiting.index(app)
+                blocked = self._blocked_reason_locked(app, position)
                 _POOL_ALLOCATE_QUEUED.inc()
                 return {
                     "wait": True,
                     "queue": app.queue,
-                    "position": waiting.index(app),
+                    "position": position,
+                    "blocked_reason": blocked,
                     "reason": f"queued in {app.queue!r} at position "
-                              f"{waiting.index(app)} of {len(waiting)}"
-                              + (" (preempted)" if app.preempted else ""),
+                              f"{position} of {len(waiting)}"
+                              + (" (preempted)" if app.preempted else "")
+                              # the recorder's binding rule rides the wait
+                              # answer: the AM's status (and `tony top`'s
+                              # header) then say WHY, not just how long
+                              + (f" — blocked: {blocked}" if blocked else ""),
                 }
             if chips > 0:
                 # pack the gang's chips into as few slices as possible: prefer
@@ -928,6 +997,14 @@ class PoolService:
             # ADMITTED but nothing fits right now (other tenants' containers
             # still draining, or fragmentation): transient — the app keeps
             # its claim and the AM retries. Never-fit asks were rejected above.
+            if self.recorder is not None:
+                # a pool-side fact the policy cannot see: the claim fits the
+                # AGGREGATE but no single host can form the placement (chips
+                # must be one contiguous rectangle on one host)
+                self.recorder.note(
+                    "deny", app_id, app.queue, "no-rect-placement",
+                    ask_chips=chips, ask_memory=memory_bytes,
+                    task=f"{job_type}:{task_index}")
             _POOL_ALLOCATE_QUEUED.inc()
             return {
                 "wait": True,
@@ -1036,12 +1113,12 @@ class PoolService:
                             "position": i, "preempted": a.preempted,
                             "waiting_s": round(max(now - a.wait_since, 0.0), 3),
                             "draining": a.app_id in self._drains,
+                            # the binding rule from the flight recorder's
+                            # latest deny record — what `tony top`/portal
+                            # show instead of bare waiting_s guesswork
+                            "blocked_reason": self._blocked_reason_locked(a, i),
                         }
-                        for i, a in enumerate(sorted(
-                            (a for a in self._apps.values()
-                             if a.queue == q and not a.admitted),
-                            key=lambda a: a.sort_key,
-                        ))
+                        for i, a in enumerate(self._waiting_sorted_locked(q))
                     ],
                 }
 
@@ -1066,6 +1143,165 @@ class PoolService:
                 "scheduler": "indexed" if self._world is not None else "reference",
                 "drains_active": len(self._drains),
             }
+
+    # --------------------------------------- flight recorder & telemetry
+    def _on_decision_record(self, rec: DecisionRecord) -> None:
+        """Recorder note hook: denials become the per-rule counter (the
+        admit/evict/shrink instruments already exist)."""
+        if rec.action == "deny":
+            _POOL_QUEUE_DENIALS.inc(queue=rec.queue, rule=rec.rule)
+
+    def _waiting_sorted_locked(self, q: str) -> list[_App]:
+        return sorted(
+            (a for a in self._apps.values() if a.queue == q and not a.admitted),
+            key=lambda a: a.sort_key,
+        )
+
+    def _blocked_reason_locked(self, app: _App, position: int) -> str | None:
+        """The binding rule currently blocking a waiting app: queue heads
+        answer from their latest deny record; everyone behind the head is
+        simply not at the front yet (their turn's rule would be fiction)."""
+        if position > 0:
+            return "behind-queue-head"
+        if self.recorder is None:
+            return None
+        return self.recorder.blocked_reason(app.app_id)
+
+    def _queue_sample_locked(
+        self, now: float, totals: tuple[int, int, int], primary: int,
+    ) -> dict[str, dict[str, float]]:
+        """One tick's per-queue stats in the primary capacity dimension."""
+        out: dict[str, dict[str, float]] = {}
+        waiting_claims: dict[str, list[float]] = {}
+        oldest: dict[str, float] = {}
+        used: dict[str, float] = {}
+        for a in self._apps.values():
+            c = self._claim_locked(a)[primary]
+            if a.admitted:
+                used[a.queue] = used.get(a.queue, 0.0) + c
+            else:
+                waiting_claims.setdefault(a.queue, []).append(c)
+                age = max(now - a.wait_since, 0.0)
+                oldest[a.queue] = max(oldest.get(a.queue, 0.0), age)
+        for q, share in self.queues.items():
+            out[q] = {
+                "used": used.get(q, 0.0),
+                "share_capacity": float(int(share * totals[primary])),
+                "demand": sum(waiting_claims.get(q, ())),
+                "waiting": float(len(waiting_claims.get(q, ()))),
+                "wait_age_s": round(oldest.get(q, 0.0), 3),
+            }
+        return out
+
+    def _sample_telemetry_locked(self) -> None:
+        """Feed the telemetry ring + the `tony_pool_queue_*` gauges, then
+        flush any finalized windows to the cluster-series file (one JSONL
+        line per window; histserver/ingest.py sweeps it). Called from the
+        liveness tick, throttled to ~1 Hz — O(apps) per sample, amortized
+        to noise against the tick's existing work."""
+        if self._telemetry is None:
+            return
+        totals = self._totals_locked()
+        primary = 2 if totals[2] > 0 else 0
+        now = time.monotonic()
+        sample = self._queue_sample_locked(now, totals, primary)
+        for q, s in sample.items():
+            _POOL_QUEUE_USED.set(s["used"], queue=q)
+            _POOL_QUEUE_SHARE_CAPACITY.set(s["share_capacity"], queue=q)
+            _POOL_QUEUE_DEMAND.set(s["demand"], queue=q)
+            _POOL_QUEUE_WAITING.set(s["waiting"], queue=q)
+            _POOL_QUEUE_WAIT_AGE.set(s["wait_age_s"], queue=q)
+        counters = self.recorder.queue_counters if self.recorder is not None else {}
+        self._telemetry.sample(sample, counters=counters)
+        self._flush_series_locked(self._telemetry.drain_finalized())
+
+    def _flush_series_locked(self, windows: list[dict[str, Any]]) -> None:
+        if not windows or not self._series_file:
+            return
+        try:
+            with open(self._series_file, "a", encoding="utf-8") as f:
+                for w in windows:
+                    f.write(window_line(self._series_source, w) + "\n")
+        except OSError as e:
+            obs_logging.warning(
+                f"[tony-pool] cluster-series flush failed: {e}")
+
+    def pool_explain(
+        self, app_id: str = "", queue: str = "", limit: int = 50,
+    ) -> dict[str, Any]:
+        """Decision provenance for `tony explain` and the portal.
+
+        - ``app_id``: the app's current scheduling state + its causal chain
+          (latest records where it is the subject, funded, or was funded);
+        - ``queue``: the queue's snapshot + its recent records + the
+          telemetry sample ring (live sparkline source);
+        - neither: every queue's sample ring + the newest records.
+        """
+        with self._lock:
+            if self.recorder is None:
+                return {"enabled": False}
+            out: dict[str, Any] = {
+                "enabled": True,
+                "scheduler": "indexed" if self._world is not None else "reference",
+                "pass_id": self.recorder.pass_id,
+            }
+            now = time.monotonic()
+            if app_id:
+                app = self._apps.get(app_id)
+                state: dict[str, Any] | None = None
+                if app is not None:
+                    waiting = self._waiting_sorted_locked(app.queue)
+                    position = waiting.index(app) if app in waiting else -1
+                    state = {
+                        "app_id": app.app_id, "queue": app.queue,
+                        "priority": app.priority,
+                        "admitted": app.admitted, "preempted": app.preempted,
+                        "draining": app_id in self._drains,
+                        "drain_mode": (self._drains.get(app_id) or {}).get("mode"),
+                        "claim": list(self._claim_locked(app)),
+                        "waiting_s": (
+                            round(max(now - app.wait_since, 0.0), 3)
+                            if not app.admitted else 0.0),
+                        "position": position if not app.admitted else -1,
+                        "blocked_reason": (
+                            self._blocked_reason_locked(app, position)
+                            if not app.admitted else None),
+                    }
+                out["app"] = state
+                out["records"] = [
+                    r.to_dict() for r in self.recorder.explain(app_id, limit)]
+                return out
+            if queue:
+                totals = self._totals_locked()
+                primary = 2 if totals[2] > 0 else 0
+                sample = self._queue_sample_locked(now, totals, primary)
+                out["queue"] = {
+                    "name": queue,
+                    "share": self.queues.get(queue),
+                    **sample.get(queue, {}),
+                    "counters": self.recorder.counters(queue),
+                    "waiters": [
+                        {"app_id": a.app_id, "position": i,
+                         "blocked_reason": self._blocked_reason_locked(a, i)}
+                        for i, a in enumerate(self._waiting_sorted_locked(queue))
+                    ],
+                }
+                out["records"] = [
+                    r.to_dict() for r in self.recorder.queue_records(queue, limit)]
+                out["series"] = (
+                    self._telemetry.recent(queue, limit)
+                    if self._telemetry is not None else [])
+                return out
+            out["records"] = [r.to_dict() for r in self.recorder.tail(limit)]
+            out["queues"] = {
+                q: {
+                    "counters": self.recorder.counters(q),
+                    "series": (self._telemetry.recent(q, limit)
+                               if self._telemetry is not None else []),
+                }
+                for q in self.queues
+            }
+            return out
 
     def cluster_capacity(self) -> dict[str, int]:
         """TOTAL capacity of currently-alive nodes (the admission universe) —
@@ -1403,6 +1639,12 @@ class PoolService:
             if entry["escalated"] or now < entry["deadline"]:
                 continue
             entry["escalated"] = True
+            if self.recorder is not None:
+                app = self._apps.get(app_id)
+                self.recorder.note(
+                    "evict", app_id, app.queue if app else "", "drain-escalated",
+                    mode=entry["mode"],
+                    overdue_ms=int((now - entry["deadline"]) * 1000))
             if entry["mode"] == "shrink":
                 # the partial reclaim failed: fall back to the whole-gang
                 # eviction the shrink was trying to avoid — and restore the
@@ -1516,6 +1758,11 @@ class PoolService:
                 # cooperative-drain deadline enforcement: victims that never
                 # yielded/shed get the classic kill path
                 self._escalate_drains_locked()
+                # per-queue telemetry sample (~1 Hz, whatever the heartbeat
+                # cadence): gauges + the cluster_series window ring
+                if self._telemetry is not None and now >= self._telemetry_next:
+                    self._telemetry_next = now + 1.0
+                    self._sample_telemetry_locked()
 
 
 class RemoteResourceManager(ResourceManager):
@@ -1853,6 +2100,10 @@ def main(argv: list[str] | None = None) -> int:
         else (config.get(keys.POOL_JOURNAL_FILE) or None),
         journal_compact_every=config.get_int(keys.POOL_JOURNAL_COMPACT_EVERY, 0),
         scheduler_indexed=config.get_bool(keys.POOL_SCHEDULER_INDEXED, True),
+        recorder_enabled=config.get_bool(keys.POOL_RECORDER_ENABLED, True),
+        recorder_capacity=config.get_int(keys.POOL_RECORDER_CAPACITY, 2048),
+        recorder_window_ms=config.get_time_ms(keys.POOL_RECORDER_WINDOW_MS, 60_000),
+        recorder_series_file=config.get(keys.POOL_RECORDER_SERIES_FILE) or None,
         chaos=ChaosContext.from_config(config, identity="pool"),
     )
     svc.start()

@@ -1,0 +1,400 @@
+"""Scheduler flight recorder: decision provenance + per-queue telemetry.
+
+PR 14 made the pool's scheduling pass fast; this module makes it
+*explainable*. Two instruments, both bounded, both pure enough for the
+simulator to share (clock injected, no locks, no RPC, no metrics):
+
+- :class:`FlightRecorder` — the decision-provenance sink the indexed
+  :class:`~tony_tpu.cluster.policy.PreemptionPolicy` drives through the
+  ``sink`` seam: every committed action (admit / evict / shrink) and every
+  blocked queue head's **binding rule** (the one guard that actually denied
+  it this pass — share deficit vs. claim, budget exhausted, min-runtime
+  shield, grace pending, drain pending, plain no-capacity, or the pool-side
+  no-rect placement failure) becomes a :class:`DecisionRecord` in a bounded
+  in-memory ring. Repeated denials of the same app for the same rule
+  coalesce into one record with a count, so a waiter retrying every tick
+  costs one ring slot, not one per tick. ``explain(app_id)`` walks the ring
+  for the app's causal chain — the records where it is the subject AND the
+  ones it funded or was funded by — which is exactly what the
+  ``pool_explain`` RPC serves and ``tony explain`` renders
+  (docs/scheduling.md "Explaining decisions").
+
+- :class:`QueueTelemetry` — per-queue utilization/share/demand/wait-age/
+  disruption counters sampled on the pool's existing liveness tick into a
+  ring of samples, aggregated into fixed windows. A *finalized* window is
+  one row of the history store's ``cluster_series`` table (the pool flushes
+  them to ``tony.pool.recorder.series-file``; ``histserver/ingest.py``
+  sweeps that file with the same idempotent/retention discipline it applies
+  to jobs), which is what the portal's ``/history`` cross-run capacity
+  dashboards chart — and the measurement substrate ROADMAP item 3 (the
+  serve/train capacity market) will be judged by.
+
+The live pool and ``tony sim`` both attach the SAME recorder class to the
+same policy seam, so an offline what-if replay and the production pool emit
+diffable record streams (asserted by the sim-vs-live parity test in
+tests/test_recorder.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+# ---------------------------------------------------------------------------
+# the binding-rule vocabulary (docs/scheduling.md "Explaining decisions")
+# ---------------------------------------------------------------------------
+#: rules an ADMIT record may carry: what funded the admission
+ADMIT_RULES = ("fits-free", "priority-preemption", "share-reclaim")
+#: rules an EVICT / SHRINK record may carry: which preemption path chose it
+EVICT_RULES = ("priority-preemption", "share-reclaim", "drain-escalated")
+SHRINK_RULES = ("partial-reclaim",)
+#: rules a DENY record may carry: the one guard that blocked a queue head
+DENY_RULES = (
+    "pool-empty",           # no capacity registered at all — everything waits
+    "no-capacity",          # demand doesn't fit free and preemption found no funding
+    "share-deficit",        # fits, but the claim would breach the queue's share while others wait
+    "grace-pending",        # cross-queue reclaim gated on tony.pool.preemption.grace-ms
+    "min-runtime-shield",   # every eligible victim is protected by min-runtime-ms
+    "drain-pending",        # every eligible victim already has a drain/shrink in flight
+    "budget-exhausted",     # the aggressor queue spent tony.pool.preemption.budget
+    "no-eligible-victims",  # no over-share borrower (or lower-priority app) to reclaim from
+    "no-rect-placement",    # admitted, but no single host can form the chip rectangle
+    "behind-queue-head",    # not this app's turn: it waits behind its queue's head
+)
+
+
+@dataclass
+class DecisionRecord:
+    """One provenance fact: what the scheduler did (or refused) and why."""
+
+    seq: int                 # monotone record number (ring-global)
+    pass_id: int             # scheduling pass that produced it
+    unix_ms: int             # recorder-clock milliseconds
+    action: str              # "admit" | "evict" | "shrink" | "deny"
+    app_id: str
+    queue: str
+    rule: str                # the binding rule (vocabulary above)
+    for_app: str = ""        # evict/shrink: the head this action funded
+    detail: dict[str, Any] = field(default_factory=dict)
+    count: int = 1           # coalesced repeats (deny dedup)
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {
+            "seq": self.seq, "pass_id": self.pass_id, "unix_ms": self.unix_ms,
+            "action": self.action, "app_id": self.app_id, "queue": self.queue,
+            "rule": self.rule, "count": self.count,
+        }
+        if self.for_app:
+            d["for_app"] = self.for_app
+        if self.detail:
+            d["detail"] = self.detail
+        return d
+
+
+class FlightRecorder:
+    """Bounded ring of :class:`DecisionRecord`\\s + per-app latest index.
+
+    This is the ``sink`` object the policy drives (see the seam contract in
+    cluster/policy.py): ``begin_pass()`` once per evaluated pass, then
+    ``note(action, app_id, queue, rule, ...)`` per decision fact. Hosts may
+    also call ``note`` directly for pool-side facts the policy cannot see
+    (the no-rect placement failure in ``allocate``, drain escalations).
+
+    Not thread-safe by itself — the pool calls it under its service lock
+    (the same lock the pass already holds), the simulator is single-threaded.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 2048,
+        clock: Callable[[], float] = time.time,
+        on_note: Callable[[DecisionRecord], None] | None = None,
+    ):
+        self.capacity = max(int(capacity), 16)
+        self.clock = clock
+        self.on_note = on_note
+        self.pass_id = 0
+        self.records: deque[DecisionRecord] = deque(maxlen=self.capacity)
+        self._seq = 0
+        #: app_id → its newest record (evicted lazily: a ring overflow may
+        #: leave a dangling latest — still the truthful newest fact we have)
+        self._latest: dict[str, DecisionRecord] = {}
+        #: cumulative per-queue action counters (telemetry window deltas)
+        self.queue_counters: dict[str, dict[str, int]] = {}
+
+    # ------------------------------------------------------------- the sink
+    def begin_pass(self) -> None:
+        self.pass_id += 1
+
+    def note(
+        self,
+        action: str,
+        app_id: str,
+        queue: str,
+        rule: str,
+        for_app: str = "",
+        **detail: Any,
+    ) -> DecisionRecord:
+        now_ms = int(self.clock() * 1000)
+        qc = self.queue_counters.setdefault(queue, {})
+        qc[action] = qc.get(action, 0) + 1
+        if action == "deny":
+            prev = self._latest.get(app_id)
+            if (
+                prev is not None
+                and prev.action == "deny"
+                and prev.rule == rule
+                and prev.queue == queue
+            ):
+                # the same wall, hit again: coalesce — a waiter retrying
+                # every allocate tick must cost one ring slot, not thousands
+                # (the counter above still counts every hit: telemetry's
+                # denial deltas measure pressure, not ring occupancy)
+                prev.count += 1
+                prev.pass_id = self.pass_id
+                prev.unix_ms = now_ms
+                if detail:
+                    prev.detail = detail
+                if self.on_note is not None:
+                    self.on_note(prev)
+                return prev
+        self._seq += 1
+        rec = DecisionRecord(
+            seq=self._seq, pass_id=self.pass_id, unix_ms=now_ms,
+            action=action, app_id=app_id, queue=queue, rule=rule,
+            for_app=for_app, detail=detail,
+        )
+        if len(self.records) == self.capacity:
+            old = self.records[0]
+            if self._latest.get(old.app_id) is old:
+                del self._latest[old.app_id]
+        self.records.append(rec)
+        self._latest[app_id] = rec
+        if self.on_note is not None:
+            self.on_note(rec)
+        return rec
+
+    # ------------------------------------------------------------- queries
+    def latest(self, app_id: str) -> DecisionRecord | None:
+        return self._latest.get(app_id)
+
+    def blocked_reason(self, app_id: str) -> str | None:
+        """The binding rule currently blocking ``app_id``, or None (its
+        newest record is not a denial — e.g. it was just admitted)."""
+        rec = self._latest.get(app_id)
+        return rec.rule if rec is not None and rec.action == "deny" else None
+
+    def explain(self, app_id: str, limit: int = 50) -> list[DecisionRecord]:
+        """``app_id``'s causal chain, oldest first: records where it is the
+        subject, plus the evictions/shrinks it funded (``for_app``) and —
+        when it was itself a victim — the admission its capacity funded."""
+        out = [
+            r for r in self.records
+            if r.app_id == app_id or r.for_app == app_id
+        ]
+        return out[-limit:] if limit else out
+
+    def queue_records(self, queue: str, limit: int = 50) -> list[DecisionRecord]:
+        out = [r for r in self.records if r.queue == queue]
+        return out[-limit:] if limit else out
+
+    def tail(self, limit: int = 50) -> list[DecisionRecord]:
+        if limit and len(self.records) > limit:
+            return list(self.records)[-limit:]
+        return list(self.records)
+
+    def counters(self, queue: str) -> dict[str, int]:
+        return dict(self.queue_counters.get(queue, {}))
+
+
+# ---------------------------------------------------------------------------
+# per-queue telemetry windows (the cluster_series substrate)
+# ---------------------------------------------------------------------------
+#: the per-window metrics a finalized window row carries, in column order
+WINDOW_METRICS = (
+    "used_avg", "used_max", "share_capacity", "utilization_avg",
+    "demand_avg", "demand_max", "waiting_avg", "waiting_max",
+    "wait_age_max_s", "admissions", "evictions", "shrinks", "denials",
+)
+
+
+@dataclass
+class _Window:
+    queue: str
+    start_ms: int
+    samples: int = 0
+    used_sum: float = 0.0
+    used_max: float = 0.0
+    share_capacity: float = 0.0
+    util_sum: float = 0.0
+    demand_sum: float = 0.0
+    demand_max: float = 0.0
+    waiting_sum: float = 0.0
+    waiting_max: float = 0.0
+    wait_age_max_s: float = 0.0
+    counters0: dict[str, int] = field(default_factory=dict)
+    counters: dict[str, int] = field(default_factory=dict)
+
+
+class QueueTelemetry:
+    """Fixed-window aggregation of per-queue samples.
+
+    ``sample()`` is called on the pool's liveness tick (throttled by the
+    caller); when a sample lands past the current window's end, the window
+    FINALIZES into a row (queue, window_start_ms, window_end_ms, metrics)
+    queued for the host to flush — to the ``cluster_series`` JSONL file the
+    history sweep ingests. A short ring of raw samples per queue is kept for
+    the live views (``pool_explain`` sparklines on the portal ``/pool``
+    page).
+    """
+
+    def __init__(
+        self,
+        window_ms: int = 60_000,
+        sample_capacity: int = 256,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.window_ms = max(int(window_ms), 1_000)
+        self.clock = clock
+        self._windows: dict[str, _Window] = {}
+        self._finalized: list[dict[str, Any]] = []
+        self._samples: dict[str, deque] = {}
+        self._sample_capacity = max(int(sample_capacity), 8)
+
+    def sample(
+        self,
+        queues: dict[str, dict[str, float]],
+        counters: dict[str, dict[str, int]] | None = None,
+        now_ms: int | None = None,
+    ) -> None:
+        """Fold one tick's per-queue stats. Each queue entry carries
+        ``used``/``share_capacity``/``demand``/``waiting``/``wait_age_s``
+        (primary-dimension units); ``counters`` is the recorder's cumulative
+        per-queue action counts (windows report deltas)."""
+        now = int(self.clock() * 1000) if now_ms is None else int(now_ms)
+        counters = counters or {}
+        for q, s in queues.items():
+            w = self._windows.get(q)
+            start = now - now % self.window_ms
+            carry: dict[str, int] | None = None
+            if w is not None and now >= w.start_ms + self.window_ms:
+                self._finalize(w, end_ms=w.start_ms + self.window_ms)
+                # events landing in the gap between the old window's last
+                # sample and this one must attribute to the NEW window, not
+                # vanish: its baseline is the old window's last-seen
+                # counters, never the current cumulative values
+                carry = w.counters
+                w = None
+            if w is None:
+                w = self._windows[q] = _Window(
+                    queue=q, start_ms=start,
+                    counters0=dict(carry if carry is not None
+                                   else counters.get(q, {})),
+                )
+            used = float(s.get("used", 0))
+            cap = float(s.get("share_capacity", 0))
+            demand = float(s.get("demand", 0))
+            waiting = float(s.get("waiting", 0))
+            age = float(s.get("wait_age_s", 0.0))
+            w.samples += 1
+            w.used_sum += used
+            w.used_max = max(w.used_max, used)
+            w.share_capacity = cap
+            w.util_sum += (used / cap) if cap > 0 else 0.0
+            w.demand_sum += demand
+            w.demand_max = max(w.demand_max, demand)
+            w.waiting_sum += waiting
+            w.waiting_max = max(w.waiting_max, waiting)
+            w.wait_age_max_s = max(w.wait_age_max_s, age)
+            w.counters = dict(counters.get(q, {}))
+            ring = self._samples.setdefault(
+                q, deque(maxlen=self._sample_capacity))
+            ring.append({
+                "unix_ms": now, "used": used, "share_capacity": cap,
+                "demand": demand, "waiting": waiting, "wait_age_s": age,
+            })
+
+    def _finalize(self, w: _Window, end_ms: int) -> None:
+        n = max(w.samples, 1)
+        delta = {
+            k: w.counters.get(k, 0) - w.counters0.get(k, 0)
+            for k in ("admit", "evict", "shrink", "deny")
+        }
+        self._finalized.append({
+            "queue": w.queue,
+            "window_start_ms": w.start_ms,
+            "window_end_ms": end_ms,
+            "samples": w.samples,
+            "metrics": {
+                "used_avg": round(w.used_sum / n, 3),
+                "used_max": w.used_max,
+                "share_capacity": w.share_capacity,
+                "utilization_avg": round(w.util_sum / n, 4),
+                "demand_avg": round(w.demand_sum / n, 3),
+                "demand_max": w.demand_max,
+                "waiting_avg": round(w.waiting_sum / n, 3),
+                "waiting_max": w.waiting_max,
+                "wait_age_max_s": round(w.wait_age_max_s, 3),
+                "admissions": delta["admit"],
+                "evictions": delta["evict"],
+                "shrinks": delta["shrink"],
+                "denials": delta["deny"],
+            },
+        })
+
+    def drain_finalized(self) -> list[dict[str, Any]]:
+        """Windows finalized since the last drain (the host appends each as
+        one JSONL line to the cluster-series file)."""
+        out, self._finalized = self._finalized, []
+        return out
+
+    def flush(self, now_ms: int | None = None) -> list[dict[str, Any]]:
+        """Force-finalize every open window (shutdown / tests) and drain."""
+        now = int(self.clock() * 1000) if now_ms is None else int(now_ms)
+        for q, w in list(self._windows.items()):
+            if w.samples:
+                self._finalize(w, end_ms=now)
+            del self._windows[q]
+        return self.drain_finalized()
+
+    def recent(self, queue: str, limit: int = 0) -> list[dict[str, Any]]:
+        ring = self._samples.get(queue)
+        if not ring:
+            return []
+        out = list(ring)
+        return out[-limit:] if limit else out
+
+    def queues(self) -> list[str]:
+        return sorted(self._samples)
+
+
+# ---------------------------------------------------------------------------
+# cluster-series JSONL carrier (pool writes, histserver/ingest.py sweeps)
+# ---------------------------------------------------------------------------
+def window_line(source: str, window: dict[str, Any]) -> str:
+    """One finalized window as a ``cluster_series`` JSONL line."""
+    return json.dumps({"source": source, **window}, sort_keys=True)
+
+
+def read_window_lines(path: str) -> Iterable[dict[str, Any]]:
+    """Parse a cluster-series JSONL file with the journal's torn-tail
+    tolerance: a half-written final line (the pool died mid-append) is
+    skipped, a corrupt middle line is skipped too (each window row is
+    independent — unlike the pool journal, later rows don't depend on it)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "queue" in rec and "metrics" in rec:
+                    yield rec
+    except OSError:
+        return

@@ -54,6 +54,7 @@ from tony_tpu.cluster.policy import (
     WorldIndex,
     make_policy,
 )
+from tony_tpu.cluster.recorder import FlightRecorder
 
 
 @dataclass
@@ -136,6 +137,7 @@ class PoolSimulator:
         seed: int = 0,
         policy_impl: str = "indexed",   # tony.pool.scheduler.indexed spelling
         record_trace: bool = False,     # collect per-event decision traces (--parity)
+        record_decisions: bool = False,  # attach a FlightRecorder (tony sim --explain)
         verify_index: bool = False,     # audit WorldIndex vs brute force per event
     ):
         self.now = 0.0
@@ -166,6 +168,15 @@ class PoolSimulator:
         self._world: WorldIndex | None = (
             WorldIndex() if policy_impl == "indexed" else None
         )
+        # decision provenance (docs/scheduling.md "Explaining decisions"):
+        # the SAME FlightRecorder class the live pool attaches, driven on the
+        # virtual clock — an offline what-if run and the production pool emit
+        # diffable DecisionRecord streams. Indexed only: the reference oracle
+        # is deliberately uninstrumented (cluster/policy.py sink contract).
+        self.recorder: FlightRecorder | None = None
+        if record_decisions and policy_impl == "indexed":
+            self.recorder = FlightRecorder(clock=lambda: self.now)
+            self.policy.sink = self.recorder
         self.verify_index = verify_index
         self.record_trace = record_trace
         #: (event_no, event kind, event app, virtual now, admits, evicts,

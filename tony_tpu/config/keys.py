@@ -187,6 +187,22 @@ POOL_JOURNAL_COMPACT_EVERY = "tony.pool.journal.compact-every"
 # (full-rescan) implementation verbatim — the kill switch, not a semantic
 # choice: both produce byte-identical decisions.
 POOL_SCHEDULER_INDEXED = "tony.pool.scheduler.indexed"
+# Scheduler flight recorder (docs/scheduling.md "Explaining decisions"): the
+# pool keeps a bounded in-memory ring of DecisionRecords — every committed
+# admit/evict/shrink plus each blocked queue head's binding rule — served by
+# the `pool_explain` RPC and rendered by `tony explain <app_id|--queue Q>`.
+# Per-queue telemetry (used/share/demand/wait-age/disruption counters) is
+# sampled on the liveness tick into `tony_pool_queue_*` gauges and
+# fixed-width windows. Provenance needs the indexed scheduler pass (the
+# default); under the reference kill switch only pool-side records appear.
+POOL_RECORDER_ENABLED = "tony.pool.recorder.enabled"
+POOL_RECORDER_CAPACITY = "tony.pool.recorder.capacity"      # ring size, records
+# telemetry aggregation window; each finalized window is one cluster_series row
+POOL_RECORDER_WINDOW_MS = "tony.pool.recorder.window-ms"
+# finalized windows append here as JSONL; the history server sweeps this file
+# into the store's cluster_series table (empty disables the flush — the
+# in-memory ring and gauges still work)
+POOL_RECORDER_SERIES_FILE = "tony.pool.recorder.series-file"
 
 # ---------------------------------------------------------------------------
 # tony.history.* / tony.portal.* — events, history, portal, history server
@@ -209,6 +225,11 @@ HISTORY_MAX_SERIES_POINTS = "tony.history.max-series-points"
 # Let the DAEMON's sweep also GC raw staging dirs past retention (the CLI
 # `tony history gc` works regardless). Never touches live/un-ingested jobs.
 HISTORY_GC_ENABLED = "tony.history.gc.enabled"
+# Cluster-series sources: comma-separated JSONL paths the sweep ingests into
+# the store's cluster_series table (each line = one finalized per-queue
+# telemetry window the pool wrote via tony.pool.recorder.series-file). The
+# portal's /history capacity dashboards chart these across runs.
+HISTORY_CLUSTER_SERIES = "tony.history.cluster-series"
 PORTAL_PORT = "tony.portal.port"
 # O(changed) portal scrape (docs/performance.md "Control-plane scalability"):
 # a running AM's get_metrics result is cached and re-served for up to this
@@ -503,6 +524,10 @@ DEFAULTS: dict[str, str] = {
     POOL_JOURNAL_FILE: "",
     POOL_JOURNAL_COMPACT_EVERY: "0",
     POOL_SCHEDULER_INDEXED: "true",
+    POOL_RECORDER_ENABLED: "true",
+    POOL_RECORDER_CAPACITY: "2048",
+    POOL_RECORDER_WINDOW_MS: "60s",
+    POOL_RECORDER_SERIES_FILE: "",
 
     HISTORY_LOCATION: "",            # empty → <staging-root>/history
     HISTORY_MOVE_INTERVAL_MS: "1000",
@@ -512,6 +537,7 @@ DEFAULTS: dict[str, str] = {
     HISTORY_RETENTION_DAYS: "0",
     HISTORY_MAX_SERIES_POINTS: "512",
     HISTORY_GC_ENABLED: "false",
+    HISTORY_CLUSTER_SERIES: "",
     PORTAL_PORT: "28080",
     PORTAL_SCRAPE_TTL_MS: "0",
 

@@ -29,6 +29,7 @@ from typing import Any
 from tony_tpu.obs import artifacts as obs_artifacts
 from tony_tpu.obs import goodput as obs_goodput
 from tony_tpu.obs import logging as obs_logging
+from tony_tpu.cluster.recorder import read_window_lines
 from tony_tpu.histserver.store import HistoryStore
 
 #: train/serve metric keys distilled into per-job series (train loop's step
@@ -306,6 +307,49 @@ def sweep(
                     f"[tony-history] ingest of {app_id} failed: {type(e).__name__}: {e}")
     if cutoff is not None:
         counts["purged"] = len(store.purge_older_than(cutoff))
+    return counts
+
+
+def sweep_cluster_series(
+    store: HistoryStore,
+    paths: list[str],
+    retention_days: float = 0.0,
+    now_ms: int | None = None,
+) -> dict[str, int]:
+    """One pass over the pool's cluster-series JSONL files (the scheduler
+    flight recorder's finalized per-queue telemetry windows,
+    ``tony.pool.recorder.series-file``) into the store's ``cluster_series``
+    table, then retention.
+
+    Same discipline as the job sweep: idempotent (rows REPLACE on their
+    window key, so re-reading a growing file converges), torn-tail tolerant
+    (a line the pool died mid-append is skipped), per-file error isolation.
+    Files are small by construction — one line per queue per
+    ``tony.pool.recorder.window-ms`` — so re-reading whole files each sweep
+    costs less than one job ingest."""
+    counts = {"files": 0, "windows": 0, "rows": 0, "errors": 0, "purged_rows": 0}
+    for path in paths:
+        if not path:
+            continue
+        try:
+            windows = list(read_window_lines(path))
+            source = os.path.splitext(os.path.basename(path))[0]
+            by_source: dict[str, list[dict[str, Any]]] = {}
+            for w in windows:
+                by_source.setdefault(str(w.get("source") or source), []).append(w)
+            for src, ws in by_source.items():
+                counts["rows"] += store.put_cluster_windows(src, ws)
+            counts["windows"] += len(windows)
+            counts["files"] += 1
+        except Exception as e:  # noqa: BLE001 — one bad file must not stall the sweep
+            counts["errors"] += 1
+            obs_logging.warning(
+                f"[tony-history] cluster-series ingest of {path} failed: "
+                f"{type(e).__name__}: {e}")
+    if retention_days > 0:
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        cutoff = now - int(retention_days * 86_400_000)
+        counts["purged_rows"] = store.purge_cluster_older_than(cutoff)
     return counts
 
 

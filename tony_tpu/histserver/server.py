@@ -11,6 +11,8 @@ and the optional staging-dir GC, and serves a JSON query API:
 - ``GET /api/job/<app_id>``           — one row + summary + series names
 - ``GET /api/series/<app_id>/<m>``    — one distilled series
 - ``GET /api/trend/<metric>``         — cross-job trend points
+- ``GET /api/cluster/<metric>[/<q>]`` — pool per-queue telemetry windows
+  (``cluster_series``, swept from ``tony.pool.recorder.series-file``)
 - ``GET /``                           — minimal HTML index (the portal's
   ``/history`` pages are the real dashboards)
 
@@ -70,10 +72,14 @@ class HistoryServer:
         retention_days: float = 0.0,
         max_series_points: int = 512,
         gc_enabled: bool = False,
+        cluster_series_paths: list[str] | None = None,
     ):
         if not staging_roots:
             raise ValueError("history server needs at least one staging root")
         self.staging_roots = [r.rstrip("/") for r in staging_roots]
+        # pool telemetry windows (tony.history.cluster-series): JSONL files
+        # the scheduler flight recorder flushes; swept into cluster_series
+        self.cluster_series_paths = [p for p in (cluster_series_paths or []) if p]
         self.store = HistoryStore(
             store_path or default_store_path(self.staging_roots[0]),
             max_series_points=max_series_points)
@@ -125,6 +131,12 @@ class HistoryServer:
         counts = _ingest.sweep(
             self.store, self.staging_roots, retention_days=self.retention_days,
             on_ingested=self._evaluate_final_alerts)
+        if self.cluster_series_paths:
+            ccounts = _ingest.sweep_cluster_series(
+                self.store, self.cluster_series_paths,
+                retention_days=self.retention_days)
+            counts["cluster_windows"] = ccounts["windows"]
+            counts["cluster_errors"] = ccounts["errors"]
         if self.gc_enabled and self.retention_days > 0:
             for root in self.staging_roots:
                 removed = _ingest.gc_staging(self.store, root, self.retention_days)
@@ -203,6 +215,11 @@ class HistoryServer:
                 self._json(req, self.store.series(app_id, metric))
             elif path.startswith("/api/trend/"):
                 self._json(req, self.store.trend(path.split("/")[3]))
+            elif path.startswith("/api/cluster/"):
+                # /api/cluster/<metric>[/<queue>] — pool telemetry windows
+                parts = path.split("/")
+                self._json(req, self.store.cluster_series(
+                    parts[3], queue=parts[4] if len(parts) > 4 else None))
             elif path == "":
                 self._raw(req, self._index_page(), "text/html")
             else:
@@ -270,6 +287,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--gc", action="store_true",
                    help="also remove ingested jobs' raw staging dirs past "
                         "retention (tony.history.gc.enabled)")
+    p.add_argument("--cluster-series", action="append", default=[],
+                   help="pool cluster-series JSONL to sweep into the "
+                        "cluster_series table (repeatable; "
+                        "tony.history.cluster-series)")
     args = p.parse_args(argv)
 
     # flags override tony-site.json which overrides defaults — the same
@@ -292,6 +313,11 @@ def main(argv: list[str] | None = None) -> int:
         retention_days=retention,
         max_series_points=cfg.get_int(keys.HISTORY_MAX_SERIES_POINTS, 512),
         gc_enabled=args.gc or cfg.get_bool(keys.HISTORY_GC_ENABLED, False),
+        cluster_series_paths=args.cluster_series or [
+            p.strip()
+            for p in (cfg.get(keys.HISTORY_CLUSTER_SERIES) or "").split(",")
+            if p.strip()
+        ],
     )
     server.start()
     host, bound = server.address

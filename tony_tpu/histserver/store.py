@@ -11,6 +11,14 @@ The persistence layer under the history server (docs/history.md). Schema:
   distilled from ``METRICS_SNAPSHOT`` events, compacted to at most
   ``max_series_points`` evenly-strided points per (job, metric) at write
   time (``tony.history.max-series-points``).
+- ``cluster_series``: CLUSTER-level per-queue telemetry windows (the pool's
+  scheduler flight recorder flushes them to
+  ``tony.pool.recorder.series-file``; ``ingest.sweep_cluster_series``
+  distills each window's metrics into one row per metric). Keyed
+  (source, queue, metric, window_start_ms) so re-ingesting the same file
+  converges — same idempotence discipline as jobs. The portal's
+  ``/history`` capacity dashboards chart these across runs
+  (docs/scheduling.md "Explaining decisions").
 
 Writes are idempotent by construction: :meth:`HistoryStore.put_job` replaces
 the job row and its series in one transaction, so re-ingesting a job (the
@@ -64,6 +72,17 @@ CREATE TABLE IF NOT EXISTS series (
   PRIMARY KEY (app_id, metric, seq)
 );
 CREATE INDEX IF NOT EXISTS series_by_metric ON series (metric, app_id);
+CREATE TABLE IF NOT EXISTS cluster_series (
+  source TEXT NOT NULL,
+  queue TEXT NOT NULL,
+  metric TEXT NOT NULL,
+  window_start_ms INTEGER NOT NULL,
+  window_end_ms INTEGER DEFAULT 0,
+  value REAL NOT NULL,
+  PRIMARY KEY (source, queue, metric, window_start_ms)
+);
+CREATE INDEX IF NOT EXISTS cluster_series_by_metric
+  ON cluster_series (metric, source, queue);
 """
 
 #: jobs columns callers may pass into put_job (summary/config are JSON'd)
@@ -172,6 +191,74 @@ class HistoryStore:
                 self._db.execute(f"DELETE FROM jobs WHERE app_id IN ({qs})", ids)
                 self._db.commit()
             return ids
+
+    # ------------------------------------------------- cluster telemetry
+    def put_cluster_windows(self, source: str, windows: list[dict[str, Any]]) -> int:
+        """Fold finalized per-queue telemetry windows (recorder.py shape:
+        ``{queue, window_start_ms, window_end_ms, metrics: {...}}``) into
+        ``cluster_series`` rows — one row per (window, metric), REPLACE on
+        the primary key so re-sweeping the same file converges. Returns the
+        rows written."""
+        rows = [
+            (source, str(w["queue"]), str(metric),
+             int(w["window_start_ms"]), int(w.get("window_end_ms") or 0),
+             float(value))
+            for w in windows
+            for metric, value in (w.get("metrics") or {}).items()
+            if isinstance(value, (int, float))
+        ]
+        if not rows:
+            return 0
+        with self._lock:
+            try:
+                self._db.executemany(
+                    "INSERT OR REPLACE INTO cluster_series "
+                    "(source, queue, metric, window_start_ms, window_end_ms, value) "
+                    "VALUES (?, ?, ?, ?, ?, ?)", rows)
+                self._db.commit()
+            except Exception:
+                self._db.rollback()
+                raise
+        return len(rows)
+
+    def cluster_series(
+        self, metric: str, queue: str | None = None, source: str | None = None,
+        limit: int = 0,
+    ) -> list[dict[str, Any]]:
+        """Window points for one cluster metric, oldest first — the capacity
+        dashboards' chart source."""
+        q = ("SELECT source, queue, window_start_ms, window_end_ms, value "
+             "FROM cluster_series WHERE metric = ?")
+        params: list[Any] = [metric]
+        if queue is not None:
+            q += " AND queue = ?"
+            params.append(queue)
+        if source is not None:
+            q += " AND source = ?"
+            params.append(source)
+        q += " ORDER BY window_start_ms"
+        with self._lock:
+            rows = self._db.execute(q, params).fetchall()
+        out = [dict(r) for r in rows]
+        return out[-limit:] if limit else out
+
+    def cluster_queues(self) -> list[tuple[str, str]]:
+        """Distinct (source, queue) pairs with any telemetry windows."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT DISTINCT source, queue FROM cluster_series "
+                "ORDER BY source, queue").fetchall()
+        return [(r["source"], r["queue"]) for r in rows]
+
+    def purge_cluster_older_than(self, cutoff_ms: int) -> int:
+        """Retention for cluster telemetry (same sweep discipline as jobs):
+        windows that ENDED before ``cutoff_ms`` are dropped."""
+        with self._lock:
+            cur = self._db.execute(
+                "DELETE FROM cluster_series WHERE window_end_ms > 0 "
+                "AND window_end_ms < ?", (cutoff_ms,))
+            self._db.commit()
+            return cur.rowcount
 
     # -------------------------------------------------------------- reads
     @staticmethod

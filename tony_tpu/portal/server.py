@@ -184,6 +184,15 @@ class PortalHandler(BaseHTTPRequestHandler):
                 if store:
                     store.close()
                 self._send(json.dumps(trend).encode(), ctype="application/json")
+            elif path.startswith("/api/history/cluster/"):
+                parts = path.split("/")
+                store = self._store()
+                pts = (store.cluster_series(
+                    parts[4], queue=parts[5] if len(parts) > 5 else None)
+                    if store else [])
+                if store:
+                    store.close()
+                self._send(json.dumps(pts).encode(), ctype="application/json")
             elif path.startswith("/job/"):
                 parts = path.split("/")
                 app_id = parts[2]
@@ -359,7 +368,7 @@ class PortalHandler(BaseHTTPRequestHandler):
         groups.insert(0, (REGISTRY.snapshot(), {}))
         return render_merged(groups)
 
-    def _pool_status(self):
+    def _pool_call(self, method: str, **kwargs):
         if not self.pool_addr:
             return None
         try:
@@ -369,11 +378,21 @@ class PortalHandler(BaseHTTPRequestHandler):
             cli = RpcClient(host, int(port),
                             os.environ.get(constants.ENV_POOL_SECRET, ""), timeout_s=2.0)
             try:
-                return cli.call("pool_status")
+                return cli.call(method, **kwargs)
             finally:
                 cli.close()
-        except Exception:  # noqa: BLE001 — pool may be down; render that
+        except Exception:  # noqa: BLE001 — pool may be down (or predate the method); render that
             return None
+
+    def _pool_status(self):
+        return self._pool_call("pool_status")
+
+    def _pool_explain(self):
+        """The flight recorder's all-queue view (telemetry sample rings +
+        newest records) — None against a recorder-less or pre-recorder
+        pool; the /pool page then simply omits the trend row."""
+        got = self._pool_call("pool_explain")
+        return got if got and got.get("enabled") else None
 
     def _log_records(self, app_id: str) -> list[dict]:
         """The newest records of the job's merged structured-log aggregate
@@ -493,10 +512,29 @@ class PortalHandler(BaseHTTPRequestHandler):
                 f'<td>{j["takeovers"]}</td></tr>'
                 for j in jobs
             )
+            # cluster capacity dashboards: per-queue telemetry windows the
+            # pool's flight recorder flushed and the sweep ingested — the
+            # cross-run view of utilization/demand/preemption pressure
+            cap_blocks = []
+            for source, queue in store.cluster_queues():
+                qcharts = "".join(
+                    _sparkline(
+                        [p["value"] for p in store.cluster_series(
+                            m, queue=queue, source=source)],
+                        m)
+                    for m in ("utilization_avg", "demand_avg", "waiting_avg",
+                              "wait_age_max_s", "evictions", "denials")
+                )
+                if qcharts:
+                    cap_blocks.append(
+                        f"<p><b>{html.escape(source)}/{html.escape(queue)}"
+                        f"</b><br>{qcharts}</p>")
             body = (
                 f"<p>{len(jobs)} ingested job(s) "
                 '(<a href="/api/history/jobs">json</a>)</p>'
                 + (f"<h2>trends across runs</h2><p>{charts}</p>" if charts else "")
+                + (f"<h2>cluster capacity (per queue)</h2>{''.join(cap_blocks)}"
+                   if cap_blocks else "")
                 + "<h2>ingested jobs</h2>"
                 "<table><tr><th>application</th><th>status</th><th>duration</th>"
                 "<th>goodput</th><th>mfu p50</th><th>step ms p50</th><th>queue wait</th>"
@@ -843,6 +881,10 @@ class PortalHandler(BaseHTTPRequestHandler):
                 waiting = ", ".join(
                     f"#{w['position']} {html.escape(w['app_id'])} (p{w['priority']})"
                     + (f" {w['waiting_s']:.0f}s" if w.get("waiting_s") is not None else "")
+                    # the flight recorder's binding rule: WHY it waits, not
+                    # just how long (docs/scheduling.md)
+                    + (f" <b>blocked: {html.escape(str(w['blocked_reason']))}</b>"
+                       if w.get("blocked_reason") else "")
                     + (" [draining]" if w.get("draining")
                        else " [preempted]" if w.get("preempted") else "")
                     for w in q.get("waiting", [])
@@ -860,6 +902,42 @@ class PortalHandler(BaseHTTPRequestHandler):
                 "<table><tr><th>queue</th><th>share</th><th>used / guarantee</th>"
                 f"<th>admitted</th><th>waiting</th></tr>{''.join(qrows)}</table>"
             )
+        explain = self._pool_explain()
+        if explain:
+            blocks = []
+            for qname, qinfo in sorted((explain.get("queues") or {}).items()):
+                series = qinfo.get("series") or []
+                charts = (
+                    _sparkline([float(s["used"]) for s in series], "used")
+                    + _sparkline([float(s["demand"]) for s in series], "demand")
+                    + _sparkline([float(s["waiting"]) for s in series], "waiting")
+                )
+                counters = qinfo.get("counters") or {}
+                if charts or counters:
+                    ctext = ", ".join(f"{k}={v}" for k, v in sorted(counters.items()))
+                    blocks.append(f"<p><b>{html.escape(qname)}</b>"
+                                  + (f" — {html.escape(ctext)}" if ctext else "")
+                                  + f"<br>{charts}</p>")
+            if blocks:
+                body += ("<h3>queue telemetry (flight recorder, "
+                         "<code>tony explain --queue Q</code>)</h3>"
+                         + "".join(blocks))
+            recs = explain.get("records") or []
+            if recs:
+                rrows = "".join(
+                    f"<tr><td>{r['pass_id']}</td><td>{r['unix_ms']}</td>"
+                    f"<td>{html.escape(r['action'])}</td>"
+                    f"<td>{html.escape(r['rule'])}</td>"
+                    f"<td>{html.escape(r['app_id'])}"
+                    + (f" → {html.escape(r['for_app'])}" if r.get("for_app") else "")
+                    + f"</td><td>{r.get('count', 1)}</td></tr>"
+                    for r in recs[-20:]
+                )
+                body += (
+                    "<h3>recent scheduling decisions</h3>"
+                    "<table><tr><th>pass</th><th>ts</th><th>action</th>"
+                    f"<th>rule</th><th>app</th><th>×</th></tr>{rrows}</table>"
+                )
         return _page(f"pool {self.pool_addr}", body)
 
     def _job_config(self, app_id: str) -> bytes:
