@@ -1,0 +1,32 @@
+"""blocking-under-lock fixture: blocking ops inside critical sections."""
+
+import os
+import sqlite3
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def sleepy():
+    with _lock:
+        time.sleep(0.5)                     # direct op under module lock
+
+
+def _sync(f):
+    os.fsync(f.fileno())                    # no lock of its own
+
+
+def flush(f):
+    with _lock:
+        _sync(f)                            # transitive: callee fsyncs
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(":memory:")
+
+    def put(self, row):
+        with self._lock:
+            self._db.execute("INSERT INTO t VALUES (?)", row)  # typed receiver

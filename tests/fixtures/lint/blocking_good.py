@@ -1,0 +1,32 @@
+"""blocking-under-lock fixture: clean patterns and justified suppressions."""
+
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def stage_then_write(queue, f):
+    with _lock:
+        line = queue.pop()                  # fast: under the lock
+    f.write(line)                           # slow: outside it
+    os.fsync(f.fileno())
+
+
+def sleep_outside():
+    with _lock:
+        n = 3
+    time.sleep(n)                           # blocking op after release
+
+
+class SerializedSink:
+    """The lock IS the sink serializer — the deliberate, suppressed shape."""
+
+    def __init__(self, f):
+        self._lock = threading.Lock()
+        self._f = f
+
+    def emit(self, line):
+        with self._lock:
+            self._f.write(line)  # lint: disable=blocking-under-lock — leaf serializer fixture: the lock exists to order these writes

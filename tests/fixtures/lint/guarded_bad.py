@@ -1,0 +1,25 @@
+"""guarded-fields fixture: a field consistently written under a lock from
+two concurrency contexts, then accessed lock-free."""
+
+import threading
+
+
+class Svc:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):                        # thread context writer
+        with self._lock:
+            self._state["tick"] = 1
+
+    def update(self, k, v):                 # main context writer
+        with self._lock:
+            self._state[k] = v
+
+    def peek(self):
+        return self._state.get("tick")      # lock-free read: finding
+
+    def wipe(self):
+        self._state = {}                    # lock-free write: finding

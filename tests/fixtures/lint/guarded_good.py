@@ -1,0 +1,45 @@
+"""guarded-fields fixture: clean patterns the checker must NOT flag."""
+
+import threading
+
+
+class SingleWriter:
+    """All writes happen on ONE thread (the monitor): the incidental locked
+    writes do not make the field guarded — bare snapshot reads from other
+    threads are the GIL-atomic read pattern, not a race."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._phase = "init"
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._lock:
+            self._phase = "running"
+        self._step()
+
+    def _step(self):
+        with self._lock:
+            self._phase = "stepping"
+
+    def status(self):
+        return self._phase                  # snapshot read: clean
+
+
+class FullyGuarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._t = threading.Thread(target=self._drain, daemon=True)
+
+    def _drain(self):
+        with self._lock:
+            self._items.clear()
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def size(self):
+        with self._lock:
+            return len(self._items)         # every access holds the lock

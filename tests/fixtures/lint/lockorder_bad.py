@@ -1,0 +1,46 @@
+"""lock-ordering fixture: acquisition cycles the checker must report."""
+
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+
+def ab():
+    with _A:
+        with _B:        # edge A -> B
+            pass
+
+
+def ba():
+    with _B:
+        with _A:        # edge B -> A: cycle with ab()
+            pass
+
+
+def reenter():
+    with _A:
+        with _A:        # non-reentrant re-acquire: single-thread deadlock
+            pass
+
+
+class Pair:
+    """Cycle formed THROUGH a call: nm() holds _n and calls a helper that
+    takes _m, while mn() takes them in the opposite order."""
+
+    def __init__(self):
+        self._m = threading.Lock()
+        self._n = threading.Lock()
+
+    def mn(self):
+        with self._m:
+            self._grab_n()
+
+    def _grab_n(self):
+        with self._n:
+            pass
+
+    def nm(self):
+        with self._n:
+            with self._m:
+                pass

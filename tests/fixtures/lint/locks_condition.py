@@ -1,0 +1,53 @@
+"""lock-discipline fixture: Condition wait/notify discipline, multi-lock
+``with a, b:`` acquires, and locktrace ``make_lock`` factory recognition."""
+
+import threading
+
+from tony_tpu.obs.locktrace import make_lock
+
+
+class CondQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._q = []
+
+    def put(self, x):
+        with self._cv:
+            self._q.append(x)
+            self._cv.notify()               # ok: cv held
+
+    def put_via_owner(self, x):
+        with self._lock:
+            self._q.append(x)
+            self._cv.notify()               # ok: the cv's OWNING lock held
+
+    def take_bad(self):
+        self._cv.wait()                     # line 26: finding (no lock)
+        return self._q.pop()
+
+    def poke_bad(self):
+        self._cv.notify_all()               # line 30: finding (no lock)
+
+
+class MultiAcquire:
+    """``with self._a, self._b:`` counts both as held; ``make_lock`` is a
+    lock factory exactly like ``threading.Lock``."""
+
+    def __init__(self):
+        self._a = make_lock("locks_condition.MultiAcquire._a")
+        self._b = threading.RLock()
+        self._x = 0
+        self._y = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._a, self._b:
+            self._x += 1                    # both locks held: clean
+            self._y += 1
+
+    def reset(self):
+        with self._a:
+            self._x = 0                     # clean (make_lock recognized)
+        with self._b:
+            self._y = 0                     # clean (RLock recognized)

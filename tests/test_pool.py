@@ -276,9 +276,11 @@ class TestPoolJournalCompaction:
                 "deadline": time.monotonic() + 30.0,
                 "t0": time.monotonic() - 2.0, "escalated": False,
             }
-            # any journaled transition now triggers a compaction that must
-            # fold the drain into the snapshot
+            # stage a journaled transition; the sync below (what every RPC
+            # entry point runs after releasing the lock) must trigger a
+            # compaction that folds the drain into the snapshot
             svc._jlog_locked("app_removed", app_id="nobody")
+        svc._journal_sync()
         svc.stop()
         restarted = PoolService(journal_path=path, port=0)
         try:

@@ -516,14 +516,13 @@ class TestPoolIntegration:
             # deterministic clock for the telemetry windows
             now = [0.0]
             svc._telemetry = QueueTelemetry(window_ms=1_000, clock=lambda: now[0])
-            with svc._lock:
-                svc._sample_telemetry_locked()
-            now[0] = 0.6
-            with svc._lock:
-                svc._sample_telemetry_locked()
-            now[0] = 1.3  # crosses the window boundary → finalize + flush
-            with svc._lock:
-                svc._sample_telemetry_locked()
+            # sampling drains finalized windows under the lock; WRITING them
+            # happens outside it — the liveness tick's two-phase shape
+            for t in (0.0, 0.6, 1.3):  # 1.3 crosses the boundary → finalize
+                now[0] = t
+                with svc._lock:
+                    drained = svc._sample_telemetry_locked()
+                svc._write_series(drained)
         finally:
             svc.stop()  # flushes the open windows too
         windows = list(read_window_lines(series))

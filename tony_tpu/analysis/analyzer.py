@@ -25,6 +25,7 @@ import io
 import json
 import os
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from enum import Enum
@@ -213,6 +214,10 @@ class Analyzer:
     def __init__(self, checkers: list[Checker], root: str | None = None):
         self.checkers = checkers
         self.root = root or os.getcwd()
+        #: per-checker wall-clock seconds (collect + check) from the last
+        #: :meth:`run` — ``tony lint --format json`` reports these, and the
+        #: CLI warns (non-fatally) when one exceeds its budget
+        self.timings: dict[str, float] = {}
 
     def _display(self, abspath: str) -> str:
         try:
@@ -221,7 +226,15 @@ class Analyzer:
             return abspath
         return abspath if rel.startswith("..") else rel
 
-    def run(self, paths: Iterable[str]) -> list[Finding]:
+    def run(
+        self, paths: Iterable[str], check_paths: Iterable[str] | None = None,
+    ) -> list[Finding]:
+        """Collect over every module under ``paths``, then check. With
+        ``check_paths`` (the ``--changed`` incremental mode) findings are
+        only emitted for those files, but collection still covers the full
+        path set — cross-module registries (declared config keys, the call
+        graph, RPC method lists) must see the whole tree or the filtered
+        check would be unsound, not just incomplete."""
         modules: list[Module] = []
         findings: list[Finding] = []
         for abspath in discover(paths):
@@ -241,14 +254,24 @@ class Analyzer:
                     checker="parse", path=display, line=1, col=0,
                     message=f"unreadable source: {e}",
                 ))
+        if check_paths is None:
+            to_check = modules
+        else:
+            wanted = {os.path.abspath(p) for p in check_paths}
+            to_check = [m for m in modules if m.abspath in wanted]
+        self.timings = {}
         for checker in self.checkers:
+            t0 = time.perf_counter()
             for mod in modules:
                 checker.collect(mod)
+            self.timings[checker.name] = time.perf_counter() - t0
         for checker in self.checkers:
-            for mod in modules:
+            t0 = time.perf_counter()
+            for mod in to_check:
                 for f in checker.check(mod):
                     if not mod.suppressed(checker.name, f.line):
                         findings.append(f)
+            self.timings[checker.name] += time.perf_counter() - t0
         # dedup: a node can be reached through two walks (e.g. a jitted
         # function nested inside another jitted function)
         findings = list(dict.fromkeys(findings))
@@ -303,18 +326,26 @@ def render_text(findings: list[Finding], grandfathered: int = 0) -> str:
     lines.append(summary)
     return "\n".join(lines)
 
-def render_json(findings: list[Finding], grandfathered: int = 0) -> str:
-    return json.dumps(
-        {
-            "findings": [f.to_dict() for f in findings],
-            "summary": {
-                "total": len(findings),
-                "grandfathered": grandfathered,
-                "by_checker": _counts(findings),
-            },
+def render_json(
+    findings: list[Finding], grandfathered: int = 0,
+    timings: dict[str, float] | None = None, budget_s: float = 0.0,
+) -> str:
+    doc: dict = {
+        "findings": [f.to_dict() for f in findings],
+        "summary": {
+            "total": len(findings),
+            "grandfathered": grandfathered,
+            "by_checker": _counts(findings),
         },
-        indent=1,
-    )
+    }
+    if timings is not None:
+        doc["timings"] = {
+            "per_checker_s": {n: round(t, 4) for n, t in sorted(timings.items())},
+            "budget_s": budget_s,
+            "over_budget": sorted(
+                n for n, t in timings.items() if budget_s > 0 and t > budget_s),
+        }
+    return json.dumps(doc, indent=1)
 
 def _counts(findings: list[Finding]) -> dict[str, int]:
     out: dict[str, int] = {}
@@ -326,11 +357,14 @@ def _counts(findings: list[Finding]) -> dict[str, int]:
 def all_checkers() -> list[Checker]:
     """One fresh instance of every built-in checker (registries are
     per-run state, so instances must not be shared between runs)."""
+    from tony_tpu.analysis.blocking import BlockingUnderLockChecker
     from tony_tpu.analysis.config_keys import ConfigKeyChecker
     from tony_tpu.analysis.donation import DonationChecker
     from tony_tpu.analysis.events_discipline import EventsDisciplineChecker
+    from tony_tpu.analysis.guarded_fields import GuardedFieldsChecker
     from tony_tpu.analysis.host_sync import HostSyncChecker
     from tony_tpu.analysis.jit_purity import JitPurityChecker
+    from tony_tpu.analysis.lock_order import LockOrderingChecker
     from tony_tpu.analysis.locks import LockDisciplineChecker
     from tony_tpu.analysis.mesh_axes import MeshAxisChecker
     from tony_tpu.analysis.metrics_discipline import MetricsDisciplineChecker
@@ -341,6 +375,9 @@ def all_checkers() -> list[Checker]:
         JitPurityChecker(),
         DonationChecker(),
         LockDisciplineChecker(),
+        LockOrderingChecker(),
+        BlockingUnderLockChecker(),
+        GuardedFieldsChecker(),
         MeshAxisChecker(),
         PrintDisciplineChecker(),
         MetricsDisciplineChecker(),

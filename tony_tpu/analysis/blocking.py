@@ -1,0 +1,184 @@
+"""blocking-under-lock: no RPC, socket/HTTP I/O, subprocess, sleep,
+fsync/file write, or SQLite statement while a lock is held.
+
+A blocking call under a control-plane lock is a latency cliff: every
+thread that needs the lock — RPC handlers, the liveness loop, telemetry —
+stalls behind one fsync or socket round-trip. The checker combines
+
+- *direct ops*: a vocabulary of blocking calls (``time.sleep``,
+  ``os.fsync``, ``subprocess.run``, ``socket.create_connection``, ``open``,
+  ``os.replace``…) plus receiver-typed methods on attributes whose
+  constructor was collected (``self._db = sqlite3.connect(...)`` makes
+  ``self._db.execute(...)`` a SQLite op; ``RpcClient`` attrs make
+  ``.call(...)`` an RPC op), and
+- *effect summaries*: every function summarizes to a set of
+  ``(kind, locks-held-at-the-op)`` pairs, accumulated transitively through
+  resolved calls. A call site holding lock L is a finding iff L is NOT
+  already in the op's held set — so the journal's fsync under the journal
+  lock is one (suppressed, deliberate) finding inside the journal, while
+  the pool calling ``journal.append`` under the POOL lock is a separate,
+  real finding at the pool's call site: a new lock held across the same
+  blocking op.
+
+Deliberately-synchronous sites (the journal's fsync under the journal
+lock, the RPC client's socket under its serializer lock) carry inline
+``# lint: disable=blocking-under-lock`` suppressions with justifications.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tony_tpu.analysis.analyzer import Checker, Finding, Module, dotted_name
+from tony_tpu.analysis.callgraph import (
+    CallGraph,
+    FunctionInfo,
+    build_callgraph,
+)
+
+#: dotted call name -> effect kind
+BLOCKING_CALLS: dict[str, str] = {
+    "time.sleep": "time.sleep",
+    "os.fsync": "fsync",
+    "subprocess.run": "subprocess",
+    "subprocess.Popen": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "socket.create_connection": "network I/O",
+    "urllib.request.urlopen": "network I/O",
+    "open": "file I/O",
+    "io.open": "file I/O",
+    "os.replace": "file I/O",
+    "os.rename": "file I/O",
+}
+
+#: receiver type tag -> method names -> effect kind
+_TYPED_METHODS: dict[str, tuple[frozenset[str], str]] = {
+    "sqlite": (frozenset({"execute", "executemany", "executescript",
+                          "commit"}), "sqlite"),
+    "file": (frozenset({"write", "flush"}), "file I/O"),
+    "rpc": (frozenset({"call", "call_with_retry"}), "rpc"),
+}
+
+
+def _classify(call: ast.Call, fn: FunctionInfo) -> str | None:
+    """Effect kind of a direct blocking op, else None."""
+    fname = dotted_name(call.func)
+    if fname in BLOCKING_CALLS:
+        return BLOCKING_CALLS[fname]
+    func = call.func
+    if (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Attribute)
+            and isinstance(func.value.value, ast.Name)
+            and func.value.value.id == "self"
+            and fn.cls is not None):
+        tag = fn.cls.attr_types.get(func.value.attr)
+        if tag in _TYPED_METHODS:
+            methods, kind = _TYPED_METHODS[tag]
+            if func.attr in methods:
+                return kind
+    return None
+
+
+class BlockingUnderLockChecker(Checker):
+    name = "blocking-under-lock"
+    description = (
+        "no RPC / socket / subprocess / sleep / fsync / file-write / "
+        "SQLite work while holding a lock (latency cliff for every "
+        "thread behind it)"
+    )
+
+    def __init__(self) -> None:
+        self._modules: list[Module] = []
+        self._findings: dict[str, list[Finding]] | None = None
+        self._graph: CallGraph | None = None
+        self._effects_memo: dict[str, frozenset[tuple[str, frozenset[str]]]] = {}
+        self._effects_stack: set[str] = set()
+
+    def collect(self, module: Module) -> None:
+        self._modules.append(module)
+
+    # --------------------------------------------------------- summaries
+    def _effects(self, qualname: str) -> frozenset[tuple[str, frozenset[str]]]:
+        """``(kind, locks held at the op)`` for every blocking op a call to
+        ``qualname`` may transitively perform. The held set is what the
+        op's own call chain accounts for; a caller holding anything beyond
+        it stretches a NEW lock across the blocking work."""
+        memo = self._effects_memo.get(qualname)
+        if memo is not None:
+            return memo
+        if qualname in self._effects_stack:
+            return frozenset()
+        graph = self._graph
+        assert graph is not None
+        fn = graph.functions.get(qualname)
+        if fn is None:
+            return frozenset()
+        self._effects_stack.add(qualname)
+        try:
+            out: set[tuple[str, frozenset[str]]] = set()
+            for node, held in graph.iter_held(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _classify(node, fn)
+                if kind is not None:
+                    out.add((kind, held))
+                    continue
+                callee = graph.resolve_call(node, fn)
+                if callee is not None:
+                    for k, oheld in self._effects(callee.qualname):
+                        out.add((k, oheld | held))
+        finally:
+            self._effects_stack.discard(qualname)
+        result = frozenset(out)
+        self._effects_memo[qualname] = result
+        return result
+
+    # ---------------------------------------------------------- findings
+    def _finalize(self) -> dict[str, list[Finding]]:
+        graph = self._graph = build_callgraph(self._modules)
+        by_path: dict[str, list[Finding]] = {}
+        for fn in graph.functions.values():
+            reported: set[str] = set()   # effect kinds already flagged here
+            for node, held in graph.iter_held(fn):
+                if not held or not isinstance(node, ast.Call):
+                    continue
+                locks = ", ".join(sorted(held))
+                kind = _classify(node, fn)
+                if kind is not None:
+                    if kind in reported:
+                        continue
+                    reported.add(kind)
+                    msg = (f"{kind} in {fn.qualname!r} while holding "
+                           f"{locks} — move it outside the critical section")
+                    by_path.setdefault(fn.module.path, []).append(Finding(
+                        checker=self.name, path=fn.module.path,
+                        line=node.lineno, col=node.col_offset, message=msg,
+                    ))
+                    continue
+                callee = graph.resolve_call(node, fn)
+                if callee is None:
+                    continue
+                kinds = {
+                    k for (k, oheld) in self._effects(callee.qualname)
+                    if held - oheld
+                } - reported
+                if not kinds:
+                    continue
+                reported |= kinds
+                msg = (f"call to {callee.qualname!r} performs "
+                       f"{', '.join(sorted(kinds))} while "
+                       f"{fn.qualname!r} holds {locks} — move the call "
+                       f"outside the critical section")
+                by_path.setdefault(fn.module.path, []).append(Finding(
+                    checker=self.name, path=fn.module.path,
+                    line=node.lineno, col=node.col_offset, message=msg,
+                ))
+        return by_path
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if self._findings is None:
+            self._findings = self._finalize()
+        return self._findings.get(module.path, [])

@@ -1,0 +1,545 @@
+"""Interprocedural call-graph and lock-identity substrate for the
+concurrency checkers (lock-ordering, blocking-under-lock, guarded-fields).
+
+Pure AST, like every other checker: linted code is never imported. The
+graph resolves three call shapes — ``self._method(...)`` (same class),
+``self._attr.method(...)`` when ``self._attr`` was assigned a constructor
+call of a collected class (``self._journal = Journal(p)``) or carries a
+class annotation, and bare/alias module-function calls — which is exactly
+enough for lock effects to propagate through the repo's ``_locked`` helper
+convention and through owned collaborators like the journal.
+
+Lock identity is a string id stable across modules:
+
+    ``<module-stem>.<Class>.<attr>``   instance locks (``pool.PoolService._lock``)
+    ``<module-stem>.<name>``           module-level locks (``native._build_lock``)
+
+These are the SAME strings callers pass to :func:`tony_tpu.obs.locktrace.
+make_lock`, so the statically-derived order graph and the runtime witness
+graph compare directly. When a lock is created via ``make_lock("...")`` the
+explicit name wins over the derived id.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from tony_tpu.analysis.analyzer import Module, dotted_name
+
+#: spellings that construct a plain mutex
+LOCK_FACTORIES = frozenset({"threading.Lock", "Lock"})
+#: spellings that construct a reentrant mutex
+RLOCK_FACTORIES = frozenset({"threading.RLock", "RLock"})
+#: spellings that construct a condition variable
+CONDITION_FACTORIES = frozenset({"threading.Condition", "Condition"})
+#: spellings of the traced-lock factory (obs/locktrace.py)
+MAKE_LOCK_FACTORIES = frozenset({
+    "locktrace.make_lock", "obs_locktrace.make_lock", "make_lock",
+})
+#: class names treated as framed-RPC clients (receiver-typed blocking calls)
+RPC_CLIENT_CLASSES = frozenset({"RpcClient"})
+
+
+def lock_kind_of_call(call: ast.Call) -> str | None:
+    """'lock' | 'rlock' | 'condition' for a lock-constructing call."""
+    fname = dotted_name(call.func)
+    if fname in LOCK_FACTORIES:
+        return "lock"
+    if fname in RLOCK_FACTORIES:
+        return "rlock"
+    if fname in CONDITION_FACTORIES:
+        return "condition"
+    if fname in MAKE_LOCK_FACTORIES:
+        for kw in call.keywords:
+            if kw.arg == "reentrant" and isinstance(kw.value, ast.Constant):
+                if kw.value.value:
+                    return "rlock"
+        return "lock"
+    return None
+
+
+def _make_lock_name(call: ast.Call) -> str | None:
+    """The explicit name argument of a ``make_lock("...")`` call, if any."""
+    if dotted_name(call.func) not in MAKE_LOCK_FACTORIES:
+        return None
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+@dataclass
+class ClassInfo:
+    stem: str                     # module file stem
+    name: str                     # bare class name
+    node: ast.ClassDef
+    module: Module
+    #: lock attr -> 'lock' | 'rlock' | 'condition'
+    locks: dict[str, str] = field(default_factory=dict)
+    #: explicit make_lock("...") name per lock attr (wins over derived id)
+    lock_names: dict[str, str] = field(default_factory=dict)
+    #: condition attr -> owning lock attr (threading.Condition(self._lock))
+    cond_owner: dict[str, str] = field(default_factory=dict)
+    #: self attr -> constructor tag: 'sqlite' | 'file' | 'rpc' | <ClassName>
+    attr_types: dict[str, str] = field(default_factory=dict)
+    methods: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = field(
+        default_factory=dict)
+
+    def lock_id(self, attr: str) -> str:
+        explicit = self.lock_names.get(attr)
+        return explicit or f"{self.stem}.{self.name}.{attr}"
+
+    @property
+    def primary_lock(self) -> str | None:
+        """The lock a ``*_locked`` method of this class is trusted to hold:
+        the attr named ``_lock`` when declared, else the single declared
+        non-condition lock, else unknown."""
+        plain = [a for a, k in self.locks.items() if k != "condition"]
+        if "_lock" in plain:
+            return "_lock"
+        if len(plain) == 1:
+            return plain[0]
+        return None
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str                 # '<stem>.<Class>.<method>' or '<stem>.<fn>'
+    module: Module
+    cls: ClassInfo | None
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+
+
+_SQLITE_CTORS = frozenset({"sqlite3.connect"})
+_FILE_CTORS = frozenset({"open", "io.open", "tokenize.open"})
+_THREAD_NAMES = frozenset({"threading.Thread", "Thread"})
+
+
+class CallGraph:
+    """Cross-module registries plus lazy lock-effect summaries."""
+
+    def __init__(self) -> None:
+        #: bare class name -> ClassInfo, or None when two modules collide
+        self.classes: dict[str, ClassInfo | None] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        #: stem -> module-level lock name -> kind
+        self.module_locks: dict[str, dict[str, str]] = {}
+        #: stem -> module-level lock name -> explicit make_lock name
+        self.module_lock_names: dict[str, dict[str, str]] = {}
+        #: stem -> import alias -> imported module stem
+        self.aliases: dict[str, dict[str, str]] = {}
+        #: lock id -> kind (filled as ids are minted)
+        self.lock_kinds: dict[str, str] = {}
+        self._closure_memo: dict[str, frozenset[str]] = {}
+        self._on_stack: set[str] = set()
+        #: qualname -> locks held on entry; None until the fixpoint ran
+        self._entry: dict[str, frozenset[str]] | None = None
+        #: qualnames referenced as bare attributes (callbacks, Thread
+        #: targets) — their call sites are invisible, so no inference
+        self._escaped: set[str] = set()
+        #: module-level NAME = ["str", ...] constants (RPC method lists),
+        #: cross-module like LockDisciplineChecker's registry
+        self.string_lists: dict[str, list[str]] = {}
+        self._contexts_memo: dict[tuple[str, str], dict[str, frozenset[str]]] = {}
+
+    # ------------------------------------------------------------ building
+    def add_module(self, module: Module) -> None:
+        stem = module.name
+        self.aliases.setdefault(stem, {})
+        self.module_locks.setdefault(stem, {})
+        self.module_lock_names.setdefault(stem, {})
+        for node in module.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._collect_import(stem, node)
+            elif (isinstance(node, ast.Assign)
+                    and isinstance(node.value, (ast.List, ast.Tuple))):
+                values = [
+                    el.value for el in node.value.elts
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str)
+                ]
+                if values and len(values) == len(node.value.elts):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.string_lists[t.id] = values
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                kind = lock_kind_of_call(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks[stem][t.id] = kind
+                            explicit = _make_lock_name(node.value)
+                            if explicit:
+                                self.module_lock_names[stem][t.id] = explicit
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{stem}.{node.name}"
+                self.functions[qn] = FunctionInfo(qn, module, None, node)
+            elif isinstance(node, ast.ClassDef):
+                self._collect_class(stem, module, node)
+
+    def _collect_import(self, stem: str, node: ast.Import | ast.ImportFrom) -> None:
+        table = self.aliases[stem]
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                leaf = alias.name.split(".")[-1]
+                table[alias.asname or alias.name.split(".")[0]] = leaf
+        else:
+            for alias in node.names:
+                # `from tony_tpu.cluster import journal [as j]` — module
+                # imports and class imports both land here; class names are
+                # resolved through self.classes instead, so a wrong module
+                # mapping for them is simply never consulted.
+                table[alias.asname or alias.name] = alias.name
+
+    def _collect_class(self, stem: str, module: Module, node: ast.ClassDef) -> None:
+        ci = ClassInfo(stem=stem, name=node.name, node=node, module=module)
+        for n in node.body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[n.name] = n
+        # __init__ parameter annotations: `def __init__(self, journal: Journal)`
+        ann: dict[str, str] = {}
+        init = ci.methods.get("__init__")
+        if init is not None:
+            for a in list(init.args.args) + list(init.args.kwonlyargs):
+                if a.annotation is not None:
+                    t = dotted_name(a.annotation)
+                    if t:
+                        ann[a.arg] = t.split(".")[-1]
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Assign):
+                continue
+            targets = [
+                t for t in n.targets
+                if isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name) and t.value.id == "self"
+            ]
+            if not targets:
+                continue
+            if isinstance(n.value, ast.Call):
+                kind = lock_kind_of_call(n.value)
+                if kind:
+                    for t in targets:
+                        ci.locks[t.attr] = kind
+                        explicit = _make_lock_name(n.value)
+                        if explicit:
+                            ci.lock_names[t.attr] = explicit
+                        if kind == "condition" and n.value.args:
+                            owner = n.value.args[0]
+                            if (isinstance(owner, ast.Attribute)
+                                    and isinstance(owner.value, ast.Name)
+                                    and owner.value.id == "self"):
+                                ci.cond_owner[t.attr] = owner.attr
+                    continue
+                fname = dotted_name(n.value.func)
+                tag = None
+                if fname in _SQLITE_CTORS:
+                    tag = "sqlite"
+                elif fname in _FILE_CTORS:
+                    tag = "file"
+                elif fname and fname.split(".")[-1] in RPC_CLIENT_CLASSES:
+                    tag = "rpc"
+                elif fname and fname.split(".")[-1][:1].isupper():
+                    tag = fname.split(".")[-1]   # candidate class constructor
+                if tag:
+                    for t in targets:
+                        ci.attr_types.setdefault(t.attr, tag)
+            elif isinstance(n.value, ast.Name) and n.value.id in ann:
+                for t in targets:
+                    ci.attr_types.setdefault(t.attr, ann[n.value.id])
+        if node.name in self.classes and self.classes[node.name] is not ci:
+            self.classes[node.name] = None   # ambiguous across modules
+        else:
+            self.classes[node.name] = ci
+        for mname, fn in ci.methods.items():
+            qn = f"{stem}.{node.name}.{mname}"
+            self.functions[qn] = FunctionInfo(qn, module, ci, fn)
+        for attr in ci.locks:
+            self.lock_kinds[ci.lock_id(attr)] = ci.locks[attr]
+
+    def finalize(self) -> None:
+        for stem, table in self.module_locks.items():
+            for name, kind in table.items():
+                lid = self.module_lock_names.get(stem, {}).get(name) \
+                    or f"{stem}.{name}"
+                self.lock_kinds[lid] = kind
+
+    # ----------------------------------------------------------- resolution
+    def class_of(self, name: str) -> ClassInfo | None:
+        """ClassInfo for a bare class name, None if unknown or ambiguous."""
+        return self.classes.get(name)
+
+    def with_item_locks(self, expr: ast.AST, fn: FunctionInfo) -> list[str]:
+        """Lock ids acquired by one ``with`` item's context expression.
+        A condition owning a lock acquires the owner's id (that is the
+        mutex wait/notify contend on)."""
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name) and expr.value.id == "self"
+                and fn.cls is not None):
+            attr = expr.attr
+            kind = fn.cls.locks.get(attr)
+            if kind is None:
+                return []
+            if kind == "condition":
+                owner = fn.cls.cond_owner.get(attr)
+                if owner and owner in fn.cls.locks:
+                    return [fn.cls.lock_id(owner)]
+            return [fn.cls.lock_id(attr)]
+        if isinstance(expr, ast.Name):
+            stem = fn.module.name
+            if expr.id in self.module_locks.get(stem, {}):
+                return [self.module_lock_names.get(stem, {}).get(expr.id)
+                        or f"{stem}.{expr.id}"]
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            stem = fn.module.name
+            target = self.aliases.get(stem, {}).get(expr.value.id)
+            if target and expr.attr in self.module_locks.get(target, {}):
+                return [self.module_lock_names.get(target, {}).get(expr.attr)
+                        or f"{target}.{expr.attr}"]
+        return []
+
+    def _declared_entry(self, fn: FunctionInfo) -> frozenset[str]:
+        """The ``_locked`` naming contract: trusted to hold the class's
+        primary lock on entry."""
+        if fn.cls is not None and fn.node.name.endswith("_locked"):
+            primary = fn.cls.primary_lock
+            if primary:
+                return frozenset({fn.cls.lock_id(primary)})
+        return frozenset()
+
+    def entry_holds(self, fn: FunctionInfo) -> frozenset[str]:
+        """Locks a function holds on entry: the ``_locked`` naming contract
+        plus inference — a private function whose every resolved call site
+        holds lock L effectively runs under L (``_perform_takeover`` calling
+        ``_adopt_state`` inside ``with self._epoch_lock`` covers the callee's
+        writes). Inference is skipped for functions whose name escapes as a
+        bare attribute (callbacks, ``Thread(target=...)``): those have
+        invisible call sites."""
+        if self._entry is None:
+            self._compute_entry_holds()
+        assert self._entry is not None
+        return self._entry.get(fn.qualname, frozenset())
+
+    def _compute_entry_holds(self) -> None:
+        # bare `self.m` / `mod.f` references that are not the func of a
+        # call: their targets can run with any lockset
+        for fn in self.functions.values():
+            call_funcs = {
+                id(n.func) for n in ast.walk(fn.node) if isinstance(n, ast.Call)
+            }
+            for n in ast.walk(fn.node):
+                if (isinstance(n, ast.Attribute) and id(n) not in call_funcs
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self" and fn.cls is not None
+                        and n.attr in fn.cls.methods):
+                    self._escaped.add(f"{fn.cls.stem}.{fn.cls.name}.{n.attr}")
+        entry = {qn: self._declared_entry(f) for qn, f in self.functions.items()}
+        # monotone fixpoint: call-site held sets only grow as caller entry
+        # sets grow, so the per-callee intersections only grow
+        while True:
+            changed = False
+            site_holds: dict[str, list[frozenset[str]]] = {}
+            for fn in self.functions.values():
+                for node, held in self._iter_held(fn, entry[fn.qualname]):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = self.resolve_call(node, fn)
+                    if callee is None:
+                        continue
+                    leaf = callee.qualname.rsplit(".", 1)[-1]
+                    if (not leaf.startswith("_") or leaf.startswith("__")
+                            or callee.qualname in self._escaped):
+                        continue
+                    site_holds.setdefault(callee.qualname, []).append(held)
+            for qn, holds in site_holds.items():
+                inferred = frozenset.intersection(*holds)
+                new = entry[qn] | inferred
+                if new != entry[qn]:
+                    entry[qn] = new
+                    changed = True
+            if not changed:
+                break
+        self._entry = entry
+
+    def resolve_call(self, call: ast.Call, fn: FunctionInfo) -> FunctionInfo | None:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and fn.cls is not None:
+                    if func.attr in fn.cls.methods:
+                        return self.functions.get(
+                            f"{fn.cls.stem}.{fn.cls.name}.{func.attr}")
+                    return None
+                # alias.func_name — imported analyzed module
+                target = self.aliases.get(fn.module.name, {}).get(base.id)
+                if target:
+                    return self.functions.get(f"{target}.{func.attr}")
+                # ClassName.method staticmethod-style
+                ci = self.class_of(base.id)
+                if ci and func.attr in ci.methods:
+                    return self.functions.get(f"{ci.stem}.{ci.name}.{func.attr}")
+                return None
+            # self.<attr>.<method> through a typed collaborator
+            if (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self" and fn.cls is not None):
+                tag = fn.cls.attr_types.get(base.attr)
+                if tag and tag not in ("sqlite", "file", "rpc"):
+                    ci = self.class_of(tag)
+                    if ci and func.attr in ci.methods:
+                        return self.functions.get(
+                            f"{ci.stem}.{ci.name}.{func.attr}")
+            return None
+        if isinstance(func, ast.Name):
+            got = self.functions.get(f"{fn.module.name}.{func.id}")
+            if got is not None:
+                return got
+            ci = self.class_of(func.id)
+            if ci is not None:
+                return self.functions.get(f"{ci.stem}.{ci.name}.__init__")
+        return None
+
+    # --------------------------------------------------------- held walking
+    def iter_held(self, fn: FunctionInfo) -> Iterator[tuple[ast.AST, frozenset[str]]]:
+        """Pre-order (node, held-lock-ids) over a function body. ``with``
+        bodies extend the held set; nested function/lambda bodies are
+        skipped (they execute later, on an unknown thread and lockset)."""
+        return self._iter_held(fn, self.entry_holds(fn))
+
+    def _iter_held(
+        self, fn: FunctionInfo, entry: frozenset[str]
+    ) -> Iterator[tuple[ast.AST, frozenset[str]]]:
+        def visit(node: ast.AST, held: frozenset[str]):
+            yield node, held
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return
+            if isinstance(node, ast.With):
+                inner = held
+                for item in node.items:
+                    yield from visit(item.context_expr, inner)
+                    inner = inner | frozenset(self.with_item_locks(
+                        item.context_expr, fn))
+                for stmt in node.body:
+                    yield from visit(stmt, inner)
+                return
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, held)
+
+        for stmt in fn.node.body:
+            yield from visit(stmt, entry)
+
+    def class_contexts(self, ci: ClassInfo) -> dict[str, frozenset[str]]:
+        """Concurrency context(s) each method of ``ci`` runs in: the thread
+        roots (``threading.Thread(target=self.m)``) and the shared RPC
+        handler pool (``rpc.register_object``) it is reachable from through
+        self-calls, or ``{"main"}`` for caller-thread-only methods — the
+        same model LockDisciplineChecker uses to decide what is shared."""
+        key = (ci.stem, ci.name)
+        memo = self._contexts_memo.get(key)
+        if memo is not None:
+            return memo
+        roots: dict[str, set[str]] = {}
+        for node in ast.walk(ci.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname in _THREAD_NAMES:
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    tgt = kw.value
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                            and tgt.attr in ci.methods):
+                        roots.setdefault(f"thread:{tgt.attr}", set()).add(tgt.attr)
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "register_object"
+                    and len(node.args) >= 2):
+                names: list[str] = []
+                second = node.args[1]
+                if isinstance(second, ast.Name):
+                    names = self.string_lists.get(second.id, [])
+                elif isinstance(second, (ast.List, ast.Tuple)):
+                    names = [
+                        el.value for el in second.elts
+                        if isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)
+                    ]
+                handlers = {n for n in names if n in ci.methods}
+                if handlers:
+                    roots.setdefault("rpc", set()).update(handlers)
+        closures: dict[str, set[str]] = {}
+        for label, seeds in roots.items():
+            out = set(seeds)
+            frontier = list(seeds)
+            while frontier:
+                m = ci.methods.get(frontier.pop())
+                if m is None:
+                    continue
+                for node in ast.walk(m):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id == "self"
+                            and node.func.attr in ci.methods
+                            and node.func.attr not in out):
+                        out.add(node.func.attr)
+                        frontier.append(node.func.attr)
+            closures[label] = out
+        result: dict[str, frozenset[str]] = {}
+        for mname in ci.methods:
+            got = {label for label, cl in closures.items() if mname in cl}
+            result[mname] = frozenset(got or {"main"})
+        self._contexts_memo[key] = result
+        return result
+
+    def direct_calls(self, fn: FunctionInfo) -> Iterator[tuple[ast.Call, FunctionInfo, frozenset[str]]]:
+        """(call node, resolved callee, held ids) for resolvable calls."""
+        for node, held in self.iter_held(fn):
+            if isinstance(node, ast.Call):
+                callee = self.resolve_call(node, fn)
+                if callee is not None:
+                    yield node, callee, held
+
+    def acquire_closure(self, qualname: str) -> frozenset[str]:
+        """Every lock id a call to ``qualname`` may acquire, transitively,
+        beyond what it is trusted to hold on entry."""
+        memo = self._closure_memo.get(qualname)
+        if memo is not None:
+            return memo
+        if qualname in self._on_stack:
+            return frozenset()        # break recursion; caller memoizes
+        fn = self.functions.get(qualname)
+        if fn is None:
+            return frozenset()
+        self._on_stack.add(qualname)
+        try:
+            out: set[str] = set()
+            entry = self.entry_holds(fn)
+            for node, held in self.iter_held(fn):
+                if isinstance(node, ast.With):
+                    inner = held
+                    for item in node.items:
+                        for lid in self.with_item_locks(item.context_expr, fn):
+                            if lid not in inner and lid not in entry:
+                                out.add(lid)
+                            inner = inner | {lid}
+                elif isinstance(node, ast.Call):
+                    callee = self.resolve_call(node, fn)
+                    if callee is not None:
+                        out |= self.acquire_closure(callee.qualname) - entry
+        finally:
+            self._on_stack.discard(qualname)
+        result = frozenset(out)
+        self._closure_memo[qualname] = result
+        return result
+
+
+def build_callgraph(modules: list[Module]) -> CallGraph:
+    graph = CallGraph()
+    for m in modules:
+        graph.add_module(m)
+    graph.finalize()
+    return graph

@@ -1,0 +1,158 @@
+"""guarded-fields: infer GuardedBy and flag lock-free accesses.
+
+A ``self._x`` written under the same class lock at two or more distinct
+sites has an inferred guard; any other read or write of it that does not
+hold that lock is a candidate data race. ``__init__`` and other dunders
+are construction-time (single-threaded) and never count; ``*_locked``
+methods are trusted to run under the class's primary lock (the repo's
+naming contract), so their accesses are guarded.
+
+The two-site threshold keeps set-once configuration attributes (written
+in ``__init__``, read everywhere) out of scope — those are immutable
+after construction and safely read bare.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter
+from typing import Iterable
+
+from tony_tpu.analysis.analyzer import (
+    MUTATOR_METHODS as _MUTATORS,
+    Checker,
+    Finding,
+    Module,
+)
+from tony_tpu.analysis.callgraph import build_callgraph
+
+
+class GuardedFieldsChecker(Checker):
+    name = "guarded-fields"
+    description = (
+        "self._* fields written under a lock in >=2 sites (inferred "
+        "GuardedBy) are never read or written lock-free elsewhere"
+    )
+
+    def __init__(self) -> None:
+        self._modules: list[Module] = []
+        self._findings: dict[str, list[Finding]] | None = None
+
+    def collect(self, module: Module) -> None:
+        self._modules.append(module)
+
+    def _finalize(self) -> dict[str, list[Finding]]:
+        graph = build_callgraph(self._modules)
+        by_path: dict[str, list[Finding]] = {}
+        classes = [ci for ci in graph.classes.values() if ci is not None]
+        for ci in classes:
+            lock_ids = {ci.lock_id(a) for a, k in ci.locks.items()
+                        if k != "condition"}
+            if not lock_ids:
+                continue
+            # attr -> [(method, node, held, is_write)]
+            sites: dict[str, list[tuple[str, ast.AST, frozenset[str], bool]]] = {}
+            for mname, mnode in ci.methods.items():
+                if mname.startswith("__"):
+                    continue   # construction / dunder protocol: one thread
+                fn = graph.functions.get(f"{ci.stem}.{ci.name}.{mname}")
+                if fn is None:
+                    continue
+                claimed: set[int] = set()   # write-root Attribute node ids
+
+                def root_attr(node: ast.AST) -> ast.Attribute | None:
+                    """The ``self._x`` attribute at the base of an access
+                    chain (``self._x[k].y`` -> the ``self._x`` node)."""
+                    while isinstance(node, (ast.Attribute, ast.Subscript)):
+                        if (isinstance(node, ast.Attribute)
+                                and isinstance(node.value, ast.Name)
+                                and node.value.id == "self"):
+                            a = node.attr
+                            if (a.startswith("_") and a not in ci.locks):
+                                return node
+                            return None
+                        node = node.value
+                    return None
+
+                held_of: dict[int, frozenset[str]] = {}
+                order: list[tuple[ast.AST, frozenset[str]]] = []
+                for node, held in graph.iter_held(fn):
+                    held_of[id(node)] = held
+                    order.append((node, held))
+                # pass 1: writes (assignment chain roots, mutator calls)
+                for node, held in order:
+                    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                        targets = (node.targets if isinstance(node, ast.Assign)
+                                   else [node.target])
+                        for t in targets:
+                            els = (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                                   else [t])
+                            for el in els:
+                                root = root_attr(el)
+                                if root is not None:
+                                    claimed.add(id(root))
+                                    sites.setdefault(root.attr, []).append(
+                                        (mname, root, held, True))
+                    elif (isinstance(node, ast.Call)
+                          and isinstance(node.func, ast.Attribute)
+                          and node.func.attr in _MUTATORS):
+                        root = root_attr(node.func.value)
+                        if root is not None:
+                            claimed.add(id(root))
+                            sites.setdefault(root.attr, []).append(
+                                (mname, root, held_of.get(id(root), held), True))
+                # pass 2: bare reads (any remaining self._x load)
+                for node, held in order:
+                    if (isinstance(node, ast.Attribute)
+                            and isinstance(node.ctx, ast.Load)
+                            and isinstance(node.value, ast.Name)
+                            and node.value.id == "self"
+                            and node.attr.startswith("_")
+                            and node.attr not in ci.locks
+                            and id(node) not in claimed):
+                        sites.setdefault(node.attr, []).append(
+                            (mname, node, held, False))
+            contexts = graph.class_contexts(ci)
+            for attr, accesses in sorted(sites.items()):
+                locked_writes = [
+                    (m, n, h) for (m, n, h, w) in accesses if w and h & lock_ids
+                ]
+                if len(locked_writes) < 2:
+                    continue
+                # the lock only mediates this field if its writers span two
+                # concurrency contexts; a single-writer-thread field whose
+                # locked writes are incidental (the lock was held for other
+                # state) is the documented snapshot-read pattern, not a guard
+                writer_contexts: set[str] = set()
+                for (m, _, _, w) in accesses:
+                    if w:
+                        writer_contexts |= contexts.get(m, frozenset({"main"}))
+                if len(writer_contexts) < 2:
+                    continue
+                guard = Counter(
+                    lid for (_, _, h) in locked_writes for lid in h & lock_ids
+                ).most_common(1)[0][0]
+                guarded_writes = [x for x in locked_writes if guard in x[2]]
+                if len(guarded_writes) < 2:
+                    continue
+                for (m, n, h, w) in accesses:
+                    if guard in h:
+                        continue
+                    verb = "written" if w else "read"
+                    msg = (
+                        f"self.{attr} is guarded by {guard} "
+                        f"({len(guarded_writes)} writes hold it) but is "
+                        f"{verb} in {m!r} without the lock — hold "
+                        f"{guard} or document why the access is safe"
+                    )
+                    by_path.setdefault(ci.module.path, []).append(Finding(
+                        checker=self.name, path=ci.module.path,
+                        line=getattr(n, "lineno", ci.node.lineno),
+                        col=getattr(n, "col_offset", 0), message=msg,
+                    ))
+        return by_path
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if self._findings is None:
+            self._findings = self._finalize()
+        return self._findings.get(module.path, [])

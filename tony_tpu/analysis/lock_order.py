@@ -1,0 +1,285 @@
+"""lock-ordering: build the cross-module lock-acquisition order graph and
+report every cycle as a potential deadlock, with both acquisition paths.
+
+An edge ``A -> B`` means some execution acquires lock ``B`` while already
+holding ``A`` — either lexically (``with self._a: ... with self._b:``) or
+through a resolved call whose transitive acquire-closure contains ``B``
+(:meth:`CallGraph.acquire_closure`, which propagates through the
+``_locked`` helper convention and owned collaborators like the journal).
+Two threads taking a cycle's edges in opposite order can deadlock; a
+re-acquisition of a non-reentrant ``threading.Lock`` (directly or through
+a call) deadlocks a single thread and is reported as a self-cycle.
+
+The same graph backs ``tony lint --lock-graph`` and the locktrace
+witness-embedding test (:func:`build_lock_graph`).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from tony_tpu.analysis.analyzer import Checker, Finding, Module, load_module
+from tony_tpu.analysis.callgraph import CallGraph, FunctionInfo, build_callgraph
+
+
+@dataclass(frozen=True)
+class Witness:
+    """Where an order edge was observed: which function, which line."""
+
+    qualname: str
+    path: str
+    line: int
+
+    def describe(self) -> str:
+        return f"in {self.qualname!r} ({self.path}:{self.line})"
+
+
+@dataclass
+class LockGraph:
+    """The acquisition-order digraph over lock ids, plus its defects."""
+
+    nodes: set[str] = field(default_factory=set)
+    #: (held, acquired) -> first witness
+    edges: dict[tuple[str, str], Witness] = field(default_factory=dict)
+    #: cycles as edge lists, deterministic order
+    cycles: list[list[tuple[str, str]]] = field(default_factory=list)
+
+    def has_path(self, a: str, b: str) -> bool:
+        """True when the graph orders ``a`` before ``b`` (edge or path)."""
+        if a == b:
+            return True
+        frontier, seen = [a], {a}
+        succ: dict[str, list[str]] = {}
+        for (x, y) in self.edges:
+            succ.setdefault(x, []).append(y)
+        while frontier:
+            n = frontier.pop()
+            for m in succ.get(n, ()):
+                if m == b:
+                    return True
+                if m not in seen:
+                    seen.add(m)
+                    frontier.append(m)
+        return False
+
+    def render(self) -> str:
+        lines = [f"lock-order graph: {len(self.nodes)} locks, "
+                 f"{len(self.edges)} edges, {len(self.cycles)} cycles"]
+        for (a, b) in sorted(self.edges):
+            w = self.edges[(a, b)]
+            lines.append(f"  {a} -> {b}   [{w.describe()}]")
+        for cyc in self.cycles:
+            chain = " -> ".join([cyc[0][0]] + [e[1] for e in cyc])
+            lines.append(f"  CYCLE: {chain}")
+        return "\n".join(lines)
+
+
+def _collect_edges(graph: CallGraph) -> dict[tuple[str, str], Witness]:
+    edges: dict[tuple[str, str], Witness] = {}
+
+    def add(a: str, b: str, fn: FunctionInfo, line: int) -> None:
+        key = (a, b)
+        if key not in edges:
+            edges[key] = Witness(fn.qualname, fn.module.path, line)
+
+    for fn in graph.functions.values():
+        for node, held in graph.iter_held(fn):
+            if isinstance(node, ast.With):
+                inner = held
+                for item in node.items:
+                    for lid in graph.with_item_locks(item.context_expr, fn):
+                        if lid in inner:
+                            if graph.lock_kinds.get(lid) == "lock":
+                                add(lid, lid, fn, item.context_expr.lineno)
+                        else:
+                            for h in inner:
+                                add(h, lid, fn, item.context_expr.lineno)
+                        inner = inner | {lid}
+            elif isinstance(node, ast.Call) and held:
+                callee = graph.resolve_call(node, fn)
+                if callee is None:
+                    continue
+                closure = graph.acquire_closure(callee.qualname)
+                for b in closure:
+                    if b in held:
+                        if graph.lock_kinds.get(b) == "lock":
+                            add(b, b, fn, node.lineno)
+                        continue
+                    for h in held:
+                        add(h, b, fn, node.lineno)
+    return edges
+
+
+def _find_cycles(edges: dict[tuple[str, str], Witness]) -> list[list[tuple[str, str]]]:
+    """Each strongly connected component with a cycle, reduced to one
+    concrete cycle (edge list), deterministically ordered."""
+    succ: dict[str, list[str]] = {}
+    nodes: set[str] = set()
+    for (a, b) in edges:
+        nodes |= {a, b}
+        succ.setdefault(a, []).append(b)
+    for outs in succ.values():
+        outs.sort()
+
+    # Tarjan SCC, iterative.
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(succ.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(succ.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+
+    cycles: list[list[tuple[str, str]]] = []
+    # every self-loop is its own single-thread deadlock, reported even when
+    # its node also sits inside a larger SCC — one must not mask the other
+    for (a, b) in sorted(edges):
+        if a == b:
+            cycles.append([(a, b)])
+    for comp in sccs:
+        comp_set = set(comp)
+        if len(comp) == 1:
+            continue  # self-loops already reported above
+        # one concrete multi-lock cycle inside the SCC: DFS from its
+        # smallest node, ignoring self-edges
+        start = min(comp)
+        path: list[tuple[str, str]] = []
+        seen: set[str] = set()
+
+        def dfs(v: str) -> bool:
+            for w in succ.get(v, ()):
+                if w == v or w not in comp_set:
+                    continue
+                if w == start:
+                    path.append((v, w))
+                    return True
+                if w in seen:
+                    continue
+                seen.add(w)
+                path.append((v, w))
+                if dfs(w):
+                    return True
+                path.pop()
+            return False
+
+        seen.add(start)
+        if dfs(start):
+            cycles.append(list(path))
+    cycles.sort(key=lambda c: (c[0][0], c[0][1], len(c)))
+    return cycles
+
+
+def lock_graph_of(graph: CallGraph) -> LockGraph:
+    edges = _collect_edges(graph)
+    nodes = set(graph.lock_kinds)
+    for (a, b) in edges:
+        nodes |= {a, b}
+    return LockGraph(nodes=nodes, edges=edges, cycles=_find_cycles(edges))
+
+
+def build_lock_graph(paths: Iterable[str]) -> LockGraph:
+    """Load .py files/dirs and return their lock-order graph — the entry
+    point for ``tony lint --lock-graph`` and the locktrace witness test."""
+    from tony_tpu.analysis.analyzer import discover
+    import os
+
+    modules: list[Module] = []
+    for abspath in discover(paths):
+        try:
+            modules.append(load_module(os.path.abspath(abspath), abspath))
+        except (SyntaxError, UnicodeDecodeError, ValueError):
+            continue
+    return lock_graph_of(build_callgraph(modules))
+
+
+class LockOrderingChecker(Checker):
+    name = "lock-ordering"
+    description = (
+        "the cross-module lock-acquisition order graph is cycle-free "
+        "(a cycle is a potential deadlock; a re-acquired non-reentrant "
+        "lock is a single-thread deadlock)"
+    )
+
+    def __init__(self) -> None:
+        self._modules: list[Module] = []
+        self._findings: dict[str, list[Finding]] | None = None
+
+    def collect(self, module: Module) -> None:
+        self._modules.append(module)
+
+    def _finalize(self) -> dict[str, list[Finding]]:
+        graph = build_callgraph(self._modules)
+        lg = lock_graph_of(graph)
+        by_path: dict[str, list[Finding]] = {}
+        for cyc in lg.cycles:
+            first = lg.edges[cyc[0]]
+            if len(cyc) == 1 and cyc[0][0] == cyc[0][1]:
+                lid = cyc[0][0]
+                msg = (
+                    f"non-reentrant lock {lid} is re-acquired while already "
+                    f"held {first.describe()} — a single-thread deadlock; "
+                    f"use threading.RLock or restructure the call"
+                )
+            else:
+                chain = " -> ".join([cyc[0][0]] + [e[1] for e in cyc])
+                paths = "; ".join(
+                    f"{a} -> {b} acquired {lg.edges[(a, b)].describe()}"
+                    for (a, b) in cyc
+                )
+                msg = (
+                    f"potential deadlock: lock acquisition cycle {chain}; "
+                    f"{paths} — threads taking these edges in opposite "
+                    f"order can deadlock"
+                )
+            f = Finding(
+                checker=self.name, path=first.path,
+                line=first.line, col=0, message=msg,
+            )
+            by_path.setdefault(first.path, []).append(f)
+        return by_path
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if self._findings is None:
+            self._findings = self._finalize()
+        return self._findings.get(module.path, [])

@@ -34,8 +34,16 @@ from tony_tpu.analysis.analyzer import (
     dotted_name,
 )
 
-_LOCK_FACTORIES = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "Lock", "RLock",
+    # traced named locks (obs/locktrace) — same discipline as plain locks
+    "locktrace.make_lock", "obs_locktrace.make_lock", "make_lock",
+}
+_COND_FACTORIES = {"threading.Condition", "Condition"}
 _THREAD_NAMES = {"threading.Thread", "Thread"}
+#: Condition methods that REQUIRE the owning lock held (RuntimeError at
+#: runtime otherwise — but only on the path that actually races)
+_COND_METHODS = {"wait", "wait_for", "notify", "notify_all"}
 
 
 class LockDisciplineChecker(Checker):
@@ -81,7 +89,11 @@ class LockDisciplineChecker(Checker):
             for n in cls.body
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         }
-        locks = self._declared_locks(cls)
+        locks, conds, cond_owner = self._declared_locks(cls)
+        # a Condition IS a lock (it wraps one): ``with self._cv:`` protects
+        # writes exactly like ``with self._lock:``
+        locks = locks | set(conds)
+        yield from self._check_conditions(module, methods, conds, cond_owner)
         roots = self._entry_roots(cls, methods)
         if not roots:
             return  # no concurrency inside this class
@@ -136,16 +148,90 @@ class LockDisciplineChecker(Checker):
         others = sorted({m for m, _, _ in sites if m != method})
         return ", ".join(repr(m) for m in others) or "another thread"
 
+    # ----------------------------------------------------------- conditions
+    def _check_conditions(
+        self,
+        module: Module,
+        methods: dict,
+        conds: set[str],
+        cond_owner: dict[str, str | None],
+    ) -> Iterable[Finding]:
+        """``self._cv.wait()/notify()`` must run with the condition's lock
+        held — lexically inside ``with self._cv:`` (or ``with self._lock:``
+        for ``Condition(self._lock)``). At runtime the miss raises only on
+        the interleaving that actually races; statically it is always
+        wrong."""
+        if not conds:
+            return
+        for name, fn in methods.items():
+            if name.startswith("__"):
+                continue
+            if name.endswith("_locked"):
+                continue  # caller-holds-the-lock contract covers the cv too
+            for cv, call, held in self._cond_calls(fn, conds):
+                owner = cond_owner.get(cv)
+                if cv in held or (owner is not None and owner in held):
+                    continue
+                need = f"self.{cv}" + (f" (or self.{owner})" if owner else "")
+                yield self.finding(
+                    module, call,
+                    f"self.{cv}.{call.func.attr}() in {name!r} without "
+                    f"holding {need} — Condition wait/notify requires the "
+                    f"owning lock (runtime RuntimeError, but only on the "
+                    f"interleaving that races)",
+                )
+
+    @staticmethod
+    def _cond_calls(
+        fn: ast.AST, conds: set[str]
+    ) -> Iterable[tuple[str, ast.Call, set[str]]]:
+        """(cv_attr, call, self-attrs lexically held) for every
+        wait/notify-family call on a declared Condition."""
+
+        def visit(node: ast.AST, held: set[str]) -> Iterable[tuple[str, ast.Call, set[str]]]:
+            if isinstance(node, ast.With):
+                inner = set(held)
+                for item in node.items:
+                    d = dotted_name(item.context_expr)
+                    if d and d.startswith("self."):
+                        inner.add(d[len("self."):])
+                for child in ast.iter_child_nodes(node):
+                    yield from visit(child, inner)
+                return
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _COND_METHODS
+                and isinstance(node.func.value, ast.Attribute)
+                and isinstance(node.func.value.value, ast.Name)
+                and node.func.value.value.id == "self"
+                and node.func.value.attr in conds
+            ):
+                yield node.func.value.attr, node, held
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, held)
+
+        yield from visit(fn, set())
+
     # ------------------------------------------------------------ gathering
-    def _declared_locks(self, cls: ast.ClassDef) -> set[str]:
+    def _declared_locks(
+        self, cls: ast.ClassDef
+    ) -> tuple[set[str], set[str], dict[str, str | None]]:
+        """(plain locks, conditions, condition -> wrapped lock attr)."""
         locks: set[str] = set()
+        conds: set[str] = set()
+        cond_owner: dict[str, str | None] = {}
         for node in ast.walk(cls):
             if not isinstance(node, ast.Assign):
                 continue
-            if not (
-                isinstance(node.value, ast.Call)
-                and dotted_name(node.value.func) in _LOCK_FACTORIES
-            ):
+            if not isinstance(node.value, ast.Call):
+                continue
+            fname = dotted_name(node.value.func)
+            if fname in _LOCK_FACTORIES:
+                dest = locks
+            elif fname in _COND_FACTORIES:
+                dest = conds
+            else:
                 continue
             for t in node.targets:
                 if (
@@ -153,8 +239,15 @@ class LockDisciplineChecker(Checker):
                     and isinstance(t.value, ast.Name)
                     and t.value.id == "self"
                 ):
-                    locks.add(t.attr)
-        return locks
+                    dest.add(t.attr)
+                    if dest is conds:
+                        owner = None
+                        if node.value.args:
+                            d = dotted_name(node.value.args[0])
+                            if d and d.startswith("self."):
+                                owner = d[len("self."):]
+                        cond_owner[t.attr] = owner
+        return locks, conds, cond_owner
 
     def _entry_roots(self, cls: ast.ClassDef, methods: dict) -> dict[str, set[str]]:
         """Concurrency roots: each ``threading.Thread`` target is its own

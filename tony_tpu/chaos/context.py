@@ -185,7 +185,7 @@ class ChaosContext:
         obs_trace.add_event(f"chaos.{f.kind}", fault=f.key, identity=self.identity)
         if self._log_path:
             try:
-                with open(self._log_path, "a") as fh:
+                with open(self._log_path, "a") as fh:  # lint: disable=blocking-under-lock — chaos-injection log: leaf sink serializer on a fault-injection (test-only) path
                     fh.write(json.dumps(rec) + "\n")
             except OSError:
                 pass

@@ -7,13 +7,21 @@ Exit-code contract (stable, for CI consumption):
 
 ``--format json`` prints a single JSON object on stdout:
 ``{"findings": [...], "summary": {"total": N, "grandfathered": N,
-"by_checker": {...}}}``.
+"by_checker": {...}}, "timings": {"per_checker_s": {...}, ...}}``.
+
+``--changed`` is the git-aware incremental mode: every module is still
+parsed and collected (cross-module registries must be sound), but findings
+are only reported for files changed since the merge-base with the default
+branch (plus untracked files). Outside a git checkout it silently degrades
+to the full run. ``--lock-graph`` dumps the static lock-order graph (and
+any cycles) instead of linting.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 from tony_tpu.analysis.analyzer import (
@@ -72,7 +80,56 @@ def build_parser() -> argparse.ArgumentParser:
         "--update-baseline", action="store_true",
         help="write the current findings to the baseline file and exit 0",
     )
+    p.add_argument(
+        "--changed", action="store_true",
+        help="incremental mode: report findings only for files changed "
+             "since the merge-base with the default branch (full collection "
+             "still runs for soundness; full run outside a git checkout)",
+    )
+    p.add_argument(
+        "--lock-graph", action="store_true",
+        help="print the static lock-acquisition-order graph (and any "
+             "cycles) for the given paths, then exit",
+    )
+    p.add_argument(
+        "--budget-seconds", type=float, default=5.0,
+        help="per-checker time budget; checkers exceeding it draw a "
+             "non-failing warning on stderr (default: 5.0; 0 disables)",
+    )
     return p
+
+
+def changed_files(root: str) -> list[str] | None:
+    """Python files changed vs the merge-base with the default branch, plus
+    untracked ones — or None when ``root`` is not a git checkout (caller
+    falls back to a full run). Any git hiccup degrades the same way: a
+    broken incremental filter must widen the run, never narrow it."""
+
+    def git(*args: str) -> str:
+        r = subprocess.run(
+            ["git", "-C", root, *args],
+            capture_output=True, text=True, timeout=30)
+        if r.returncode != 0:
+            raise RuntimeError(r.stderr.strip() or f"git {args[0]} failed")
+        return r.stdout
+
+    try:
+        base = "HEAD"
+        for ref in ("origin/main", "main", "origin/master", "master"):
+            try:
+                base = git("merge-base", "HEAD", ref).strip()
+                break
+            except RuntimeError:
+                continue
+        names: set[str] = set()
+        names.update(git("diff", "--name-only", base).splitlines())
+        names.update(git("ls-files", "--others", "--exclude-standard").splitlines())
+        return sorted(
+            os.path.join(root, n) for n in names
+            if n.endswith(".py") and os.path.exists(os.path.join(root, n))
+        )
+    except Exception:
+        return None
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -96,8 +153,25 @@ def main(argv: list[str] | None = None) -> int:
                 )
             checkers = [c for c in checkers if c.name in wanted]
         paths = args.paths or [os.path.join(repo_root(), "tony_tpu")]
+        if args.lock_graph:
+            from tony_tpu.analysis.lock_order import build_lock_graph
+
+            graph = build_lock_graph(paths)
+            print(graph.render())
+            return EXIT_FINDINGS if graph.cycles else EXIT_CLEAN
+        check_paths = None
+        if args.changed:
+            check_paths = changed_files(repo_root())  # None → full run
         analyzer = Analyzer(checkers, root=repo_root())
-        findings = analyzer.run(paths)
+        findings = analyzer.run(paths, check_paths=check_paths)
+        if args.budget_seconds > 0:
+            for name, took in sorted(analyzer.timings.items()):
+                if took > args.budget_seconds:
+                    # advisory only: a slow checker is a performance bug in
+                    # the lint, not a reason to fail the build being linted
+                    print(f"tony lint: warning: checker '{name}' took "
+                          f"{took:.1f}s (budget {args.budget_seconds:.0f}s)",
+                          file=sys.stderr)
 
         baseline_path = args.baseline or default_baseline_path()
         if args.update_baseline:
@@ -113,8 +187,12 @@ def main(argv: list[str] | None = None) -> int:
             return EXIT_CLEAN
         baseline = set() if args.no_baseline else load_baseline(baseline_path)
         fresh, grandfathered = apply_baseline(findings, baseline)
-        render = render_json if args.format == "json" else render_text
-        print(render(fresh, grandfathered))
+        if args.format == "json":
+            print(render_json(fresh, grandfathered,
+                              timings=analyzer.timings,
+                              budget_s=args.budget_seconds))
+        else:
+            print(render_text(fresh, grandfathered))
         return EXIT_FINDINGS if fresh else EXIT_CLEAN
     except Exception as e:
         print(f"tony lint: internal error: {type(e).__name__}: {e}", file=sys.stderr)

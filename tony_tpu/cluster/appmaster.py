@@ -40,6 +40,7 @@ from tony_tpu.cluster.journal import (
 from tony_tpu.obs import alerts as obs_alerts
 from tony_tpu.obs import goodput as obs_goodput
 from tony_tpu.obs import introspect as obs_introspect
+from tony_tpu.obs import locktrace as obs_locktrace
 from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
 from tony_tpu.obs import trace as obs_trace
@@ -372,9 +373,8 @@ class ApplicationMaster:
         self._capacity_short_since: float | None = None  # downsize hysteresis
         # guards (attempt, session) as one unit: RPC handlers capture both
         # atomically so a stale-attempt call can never touch a fresh session
-        import threading
-
-        self._epoch_lock = threading.Lock()
+        self._epoch_lock = obs_locktrace.make_lock(
+            "appmaster.ApplicationMaster._epoch_lock")
 
     # ------------------------------------------------------ takeover journal
     def _jlog(self, t: str, **fields: Any) -> None:
@@ -518,12 +518,19 @@ class ApplicationMaster:
         if session is None:
             return {"spec": None, "stale": True}
         spec = session.cluster_spec()
-        if spec is None or not self._gang_complete_fired:
+        with self._epoch_lock:
+            # capture (fired, attempt) atomically with respect to a
+            # concurrent gang restart on the monitor thread: a spec handed
+            # out with the OLD attempt but the NEW gang's fired flag would
+            # let a stale executor proceed with the wrong ranks
+            fired = self._gang_complete_fired
+            attempt = self._restart_attempt
+        if spec is None or not fired:
             return {"spec": None}
         return {
             "spec": spec,
             "extra_env": self.runtime.am_extra_env(session, job_name, index),
-            "restart_attempt": self._restart_attempt,
+            "restart_attempt": attempt,
         }
 
     def register_execution_result(
@@ -1061,9 +1068,18 @@ class ApplicationMaster:
             # failures/pending_resize are CROSS-epoch (last record wins), so
             # a degraded reset must re-journal them explicitly — otherwise a
             # later takeover would resurrect the pre-degrade budget/resize.
-            self._jlog("epoch", attempt=self._restart_attempt, resized=dict(self._resized))
-            self._jlog("failures", n=self._failures_seen)
-            self._jlog("pending_resize", resizes=dict(self._pending_resize))
+            with self._epoch_lock:
+                # the RPC server is already registered a few lines up, so a
+                # resize handler can race this epoch snapshot — capture the
+                # cross-epoch fields atomically, then journal outside the
+                # lock (appends fsync)
+                epoch_attempt = self._restart_attempt
+                epoch_resized = dict(self._resized)
+                epoch_failures = self._failures_seen
+                epoch_pending = dict(self._pending_resize)
+            self._jlog("epoch", attempt=epoch_attempt, resized=epoch_resized)
+            self._jlog("failures", n=epoch_failures)
+            self._jlog("pending_resize", resizes=epoch_pending)
         if self.am_attempt == 0:
             self.events.emit(
                 EventType.APPLICATION_INITED,
@@ -2244,6 +2260,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="which AM attempt this is (0 = original launch)")
     args = p.parse_args(argv)
     config = TonyConfig.load_final(os.path.join(args.staging_dir, constants.TONY_FINAL_CONF))
+    if config.get_bool(keys.DEBUG_LOCKTRACE):
+        # before the AM constructs its locks — a plain Lock cannot
+        # retroactively grow tracing (obs/locktrace.py)
+        obs_locktrace.set_enabled(True)
     am = ApplicationMaster(config, args.app_id, args.staging_dir,
                            takeover=args.takeover, am_attempt=args.am_attempt)
     am.prepare()

@@ -36,9 +36,9 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from typing import Any, Iterator
 
+from tony_tpu.obs import locktrace
 from tony_tpu.obs import metrics as _metrics
 
 #: the one record type this module owns: compaction's folded-state carrier
@@ -68,7 +68,7 @@ class Journal:
         if parent:
             os.makedirs(parent, exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
-        self._lock = threading.Lock()
+        self._lock = locktrace.make_lock("journal.Journal._lock")
         self._failed = False
         #: appends since the last :meth:`compact` (or open) — the writer's
         #: compaction trigger (``tony.{pool,am}.journal.compact-every``)
@@ -77,19 +77,62 @@ class Journal:
         #: concurrency token for writers whose appends are NOT all serialized
         #: under one state lock (the AM)
         self.total_appends = 0
+        #: serialized-but-unflushed lines (:meth:`enqueue`) — the pool's
+        #: under-its-lock half of a journaled transition; durability comes
+        #: from the caller's :meth:`flush_pending` outside its lock
+        self._pending: list[str] = []
+        #: lifetime enqueues — :meth:`compact`'s token for enqueue-path
+        #: writers (mirror of :attr:`total_appends` for the append path)
+        self.total_enqueued = 0
 
     def append(self, t: str, **fields: Any) -> None:
         line = json.dumps({"t": t, **fields}, sort_keys=True)
         with self._lock:
-            if self._append_line_locked(line):
+            # pending enqueues were accepted first — keep file order FIFO
+            self._flush_pending_locked()
+            if self._write_lines_locked([line]):
                 self.appends_since_compact += 1
                 self.total_appends += 1
 
-    def _append_line_locked(self, line: str) -> bool:
+    def enqueue(self, t: str, **fields: Any) -> None:
+        """Stage one record without touching the disk — O(json.dumps), no
+        fsync, safe to call while holding a hot state lock (the pool's).
+        The record becomes durable at the caller's next
+        :meth:`flush_pending` (or any :meth:`append`/:meth:`compact`/
+        :meth:`close`), which the caller runs OUTSIDE its lock and before
+        acking the transition — same durability contract as append, the
+        fsync latency just stops serializing unrelated threads."""
+        line = json.dumps({"t": t, **fields}, sort_keys=True)
+        with self._lock:
+            self._pending.append(line)
+            self.total_enqueued += 1
+
+    def flush_pending(self) -> bool:
+        """Drain every staged record with ONE batched write+fsync. Any
+        thread's flush drains the whole shared queue, so a caller returns
+        knowing its own enqueues are durable regardless of which thread
+        paid the fsync."""
+        with self._lock:
+            return self._flush_pending_locked()
+
+    def _flush_pending_locked(self) -> bool:
+        if not self._pending:
+            return True
+        lines = self._pending
+        self._pending = []
+        if self._write_lines_locked(lines):
+            self.appends_since_compact += len(lines)
+            self.total_appends += len(lines)
+            return True
+        return False  # best-effort like append: records dropped, warned once
+
+    def _write_lines_locked(self, lines: list[str]) -> bool:
         try:
-            self._f.write(line + "\n")
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            # one write + one fsync however many records — the batch costs
+            # what a single append used to
+            self._f.write("".join(ln + "\n" for ln in lines))  # lint: disable=blocking-under-lock — the journal lock IS the fsync serializer (leaf lock, nothing acquired under it)
+            self._f.flush()  # lint: disable=blocking-under-lock — see above
+            os.fsync(self._f.fileno())  # lint: disable=blocking-under-lock — see above
             self._failed = False
             return True
         except (OSError, ValueError):
@@ -106,7 +149,8 @@ class Journal:
             return False
 
     def compact(self, records: list[dict[str, Any]],
-                expected_total: int | None = None) -> bool:
+                expected_total: int | None = None,
+                expected_enqueued: int | None = None) -> bool:
         """Fold the caller's live state into one durable snapshot record,
         then rotate the file down to just that record.
 
@@ -117,8 +161,13 @@ class Journal:
         compaction is skipped (returns False, nothing written) if any append
         landed since — an interleaved record would otherwise sort before the
         stale snapshot and be silently discarded by the replay barrier. The
-        caller simply retries on a later tick. Writers that hold their state
-        lock across build+compact (the pool) pass None.
+        caller simply retries on a later tick. ``expected_enqueued`` is the
+        same token for the :meth:`enqueue` path (pass :attr:`total_enqueued`
+        as read together with the state ``records`` capture): an enqueue
+        that races the snapshot build would be drained below, sort before a
+        snapshot that does NOT fold it, and be discarded by the replay
+        barrier — the token turns that into a skipped compaction instead.
+        Writers that hold their state lock across build+compact pass None.
 
         Two-phase, each safe to die in:
 
@@ -143,7 +192,13 @@ class Journal:
         with self._lock:
             if expected_total is not None and self.total_appends != expected_total:
                 return False  # an append raced the snapshot build: stale
-            if not self._append_line_locked(line):
+            if expected_enqueued is not None and self.total_enqueued != expected_enqueued:
+                return False  # an enqueue raced the snapshot build: stale
+            # records staged before the token read are folded into the
+            # snapshot state; drain them first so nothing pending can land
+            # AFTER the snapshot line it is already part of
+            self._flush_pending_locked()
+            if not self._write_lines_locked([line]):
                 # degraded sink (disk full): re-arm the cadence instead of
                 # leaving the trigger latched — otherwise EVERY subsequent
                 # journaled transition would rebuild + serialize the whole
@@ -157,11 +212,11 @@ class Journal:
             _COMPACTIONS.inc()
             tmp = self.path + ".compact.tmp"
             try:
-                with open(tmp, "w", encoding="utf-8") as tf:
+                with open(tmp, "w", encoding="utf-8") as tf:  # lint: disable=blocking-under-lock — rotation must exclude concurrent appends; the journal lock is a leaf
                     tf.write(line + "\n")
                     tf.flush()
-                    os.fsync(tf.fileno())
-                os.replace(tmp, self.path)
+                    os.fsync(tf.fileno())  # lint: disable=blocking-under-lock — see above
+                os.replace(tmp, self.path)  # lint: disable=blocking-under-lock — see above
             except OSError:
                 return True  # snapshot durable; rotation skipped (space only)
             try:
@@ -169,13 +224,14 @@ class Journal:
             except OSError:
                 pass
             try:
-                self._f = open(self.path, "a", encoding="utf-8")
+                self._f = open(self.path, "a", encoding="utf-8")  # lint: disable=blocking-under-lock — handle swap must exclude concurrent appends; leaf lock
             except OSError:
                 self._failed = True  # further appends will warn + no-op
             return True
 
     def close(self) -> None:
         with self._lock:
+            self._flush_pending_locked()  # staged records must not die with us
             try:
                 self._f.close()
             except OSError:

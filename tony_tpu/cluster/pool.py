@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from tony_tpu import constants
+from tony_tpu.obs import locktrace
 from tony_tpu.obs import logging as obs_logging
 from tony_tpu.cluster.journal import (
     SNAPSHOT_RECORD,
@@ -356,7 +357,11 @@ class PoolService:
         # one-shot cancellation notices (drain victim re-admitted before it
         # yielded): app_id → req_id, delivered on the app's next poll
         self._cancelled: dict[str, str] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.make_lock("pool.PoolService._lock")
+        # leaf serializer for the cluster-series file only — held across the
+        # append so concurrent flushers don't interleave lines, never while
+        # holding (or taking) the state lock above
+        self._series_lock = locktrace.make_lock("pool.PoolService._series_lock")
         self._stop = threading.Event()
         # work-preserving restart (tony.pool.journal.file): registrations,
         # admissions, and allocations are journaled so a restarted pool
@@ -401,24 +406,44 @@ class PoolService:
 
     # ------------------------------------------------------ recovery journal
     def _jlog_locked(self, t: str, **fields: Any) -> None:
+        """Stage a journal record under the state lock — O(json.dumps),
+        nothing touches the disk here. The caller's :meth:`_journal_sync`
+        (run OUTSIDE the lock, before the RPC response returns) makes it
+        durable. The old shape — append + fsync + inline compaction right
+        here, under the state lock — serialized every RPC handler, the
+        liveness tick, and telemetry behind each fsync; blocking-under-lock
+        now flags exactly that."""
         if self._journal is None:
             return
-        self._journal.append(t, **fields)
+        self._journal.enqueue(t, **fields)
+
+    def _journal_sync(self) -> None:
+        """Make every staged record durable, then compact on cadence — all
+        OUTSIDE the state lock. Each journaling entry point calls this
+        after releasing the lock and before acking its response: the
+        transition is durable before anyone acts on the ack, same contract
+        as the old inline append, but the fsync no longer serializes
+        unrelated threads. Any thread's flush drains the whole shared
+        queue, so concurrent entry points cover each other.
+
+        Compaction folds the live state into one snapshot (docs/
+        performance.md "Control-plane scalability"): the state lock is
+        re-taken briefly to capture a consistent snapshot + the enqueue
+        token, the two fsyncs happen after it is released, and a racing
+        enqueue between capture and compact makes the token stale —
+        :meth:`Journal.compact` skips, and a later sync retries."""
+        j = self._journal
+        if j is None:
+            return
+        j.flush_pending()
         if (
             self._journal_compact_every > 0
-            and self._journal.appends_since_compact >= self._journal_compact_every
+            and j.appends_since_compact >= self._journal_compact_every
         ):
-            # fold live state into a snapshot + rotate (docs/performance.md
-            # "Control-plane scalability"): safe here because every _jlog
-            # caller already holds self._lock, so the snapshot is consistent
-            # with the record just appended. Deliberately inline rather than
-            # deferred to the liveness loop: the compact would hold this same
-            # lock wherever it ran, so concurrent RPCs stall identically
-            # either way — unlike the AM, where deferral to the monitor loop
-            # is about lock ORDER (RPC handlers journal without the epoch
-            # lock), not latency. Cost is amortized: O(live state) + two
-            # fsyncs once per compact-every appends, tuned by the operator.
-            self._journal.compact(self._snapshot_records_locked())
+            with self._lock:
+                token = j.total_enqueued
+                records = self._snapshot_records_locked()
+            j.compact(records, expected_enqueued=token)
 
     def _snapshot_records_locked(self) -> list[dict[str, Any]]:
         """The live state as replayable records (the journal's own
@@ -638,9 +663,10 @@ class PoolService:
             # partial windows still carry signal: flush them marked by their
             # true end instant rather than losing the tail of the pool's life
             with self._lock:
-                self._flush_series_locked(self._telemetry.flush())
+                windows = self._telemetry.flush()
+            self._write_series(windows)
         if self._journal is not None:
-            self._journal.close()
+            self._journal.close()  # drains staged records before closing
 
     @property
     def address(self) -> tuple[str, int]:
@@ -747,6 +773,7 @@ class PoolService:
             if self._world is not None:
                 self._world.touch()  # pool totals moved with the node set
             self._schedule_locked()
+        self._journal_sync()  # seen/exit records durable before the agent acts
         return {
             "ack": True,
             "heartbeat_interval_ms": self.heartbeat_interval_ms,
@@ -786,6 +813,7 @@ class PoolService:
                     elif rec.get("seen_live") and cid not in (exited or {}):
                         self._record_exit_locked(cid, constants.EXIT_NODE_LOST)
             kills, node.pending_kills = node.pending_kills, []
+        self._journal_sync()  # exited/seen records durable before the ack
         return {"ack": True, "kill": kills}
 
     # --------------------------------------------------------------- AM side
@@ -832,7 +860,9 @@ class PoolService:
             self._world_upsert_locked(app)
             self._schedule_locked()
             self._journal_app_locked(app)
-            return {"ack": True, "queue": queue, "admitted": app.admitted}
+            out = {"ack": True, "queue": queue, "admitted": app.admitted}
+        self._journal_sync()  # the app row is durable before the AM proceeds
+        return out
 
     def allocate(
         self,
@@ -842,6 +872,23 @@ class PoolService:
         memory_bytes: int,
         vcores: int,
         chips: int = 0,
+    ) -> dict[str, Any]:
+        try:
+            return self._allocate_impl(
+                app_id, job_type, task_index, memory_bytes, vcores, chips)
+        finally:
+            # the container record staged under the lock becomes durable
+            # HERE — before the AM sees the allocation it would launch on
+            self._journal_sync()
+
+    def _allocate_impl(
+        self,
+        app_id: str,
+        job_type: str,
+        task_index: int,
+        memory_bytes: int,
+        vcores: int,
+        chips: int,
     ) -> dict[str, Any]:
         with self._lock:
             alive = [n for n in self._nodes.values() if n.alive]
@@ -1024,6 +1071,7 @@ class PoolService:
         with self._lock:
             self._release_locked(container_id)
             self._schedule_locked()
+        self._journal_sync()
         return {"ack": True}
 
     def release_all(self, app_id: str) -> dict[str, Any]:
@@ -1043,6 +1091,7 @@ class PoolService:
                 self._jlog_locked("drain_done", app_id=app_id)
             self._jlog_locked("app_removed", app_id=app_id)
             self._schedule_locked()
+        self._journal_sync()  # removal durable before the AM tears down
         return {"ack": True}
 
     def poll_exited(self, app_id: str, with_preempt: bool = False) -> dict[str, Any]:
@@ -1056,9 +1105,10 @@ class PoolService:
             if exits:
                 # delivered: a restarted pool must not re-deliver these
                 self._jlog_locked("polled", app_id=app_id)
-            if not with_preempt:
-                return exits
-            return {"exits": exits, "preempt": self._preempt_notice_locked(app_id)}
+            out: dict[str, Any] = exits if not with_preempt else {
+                "exits": exits, "preempt": self._preempt_notice_locked(app_id)}
+        self._journal_sync()  # "polled" durable before the AM consumes exits
+        return out
 
     def request_kill(self, container_id: str) -> dict[str, Any]:
         """Backstop kill path when the AM cannot reach the agent directly:
@@ -1067,6 +1117,7 @@ class PoolService:
             rec = self._containers.get(container_id)
             if rec is not None:
                 self._request_kill_locked(rec)
+        self._journal_sync()  # kill_requested durable before the ack
         return {"ack": True}
 
     def pool_metrics(self) -> dict[str, Any]:
@@ -1193,14 +1244,16 @@ class PoolService:
             }
         return out
 
-    def _sample_telemetry_locked(self) -> None:
-        """Feed the telemetry ring + the `tony_pool_queue_*` gauges, then
-        flush any finalized windows to the cluster-series file (one JSONL
-        line per window; histserver/ingest.py sweeps it). Called from the
-        liveness tick, throttled to ~1 Hz — O(apps) per sample, amortized
-        to noise against the tick's existing work."""
+    def _sample_telemetry_locked(self) -> list[dict[str, Any]]:
+        """Feed the telemetry ring + the `tony_pool_queue_*` gauges, and
+        return any finalized windows for the caller to write to the
+        cluster-series file (:meth:`_write_series`) AFTER releasing the
+        state lock — the file append must not extend this critical
+        section. Called from the liveness tick, throttled to ~1 Hz —
+        O(apps) per sample, amortized to noise against the tick's
+        existing work."""
         if self._telemetry is None:
-            return
+            return []
         totals = self._totals_locked()
         primary = 2 if totals[2] > 0 else 0
         now = time.monotonic()
@@ -1213,15 +1266,21 @@ class PoolService:
             _POOL_QUEUE_WAIT_AGE.set(s["wait_age_s"], queue=q)
         counters = self.recorder.queue_counters if self.recorder is not None else {}
         self._telemetry.sample(sample, counters=counters)
-        self._flush_series_locked(self._telemetry.drain_finalized())
+        return self._telemetry.drain_finalized()
 
-    def _flush_series_locked(self, windows: list[dict[str, Any]]) -> None:
+    def _write_series(self, windows: list[dict[str, Any]]) -> None:
+        """Append finalized telemetry windows to the cluster-series file
+        (one JSONL line per window; histserver/ingest.py sweeps it).
+        Runs OUTSIDE the state lock; the tiny ``_series_lock`` only keeps
+        concurrent flushers (liveness tick vs stop()) from interleaving
+        lines."""
         if not windows or not self._series_file:
             return
         try:
-            with open(self._series_file, "a", encoding="utf-8") as f:
-                for w in windows:
-                    f.write(window_line(self._series_source, w) + "\n")
+            with self._series_lock:
+                with open(self._series_file, "a", encoding="utf-8") as f:  # lint: disable=blocking-under-lock — leaf serializer for the series file; nothing is acquired under it
+                    for w in windows:
+                        f.write(window_line(self._series_source, w) + "\n")
         except OSError as e:
             obs_logging.warning(
                 f"[tony-pool] cluster-series flush failed: {e}")
@@ -1751,6 +1810,7 @@ class PoolService:
                 # journal record beyond what each transition already fsync'd
                 os.kill(os.getpid(), signal.SIGKILL)
             now = time.monotonic()
+            windows: list[dict[str, Any]] = []
             with self._lock:
                 for node in self._nodes.values():
                     if node.alive and now - node.last_heartbeat > timeout_s:
@@ -1762,7 +1822,11 @@ class PoolService:
                 # cadence): gauges + the cluster_series window ring
                 if self._telemetry is not None and now >= self._telemetry_next:
                     self._telemetry_next = now + 1.0
-                    self._sample_telemetry_locked()
+                    windows = self._sample_telemetry_locked()
+            # the tick's journal records (node-lost exits, drain kills) and
+            # telemetry windows hit the disk with the lock released
+            self._journal_sync()
+            self._write_series(windows)
 
 
 class RemoteResourceManager(ResourceManager):
@@ -1785,7 +1849,7 @@ class RemoteResourceManager(ResourceManager):
         # pre-drain pool service: rejects the cooperative-preemption kwargs
         # with a TypeError error frame — detected once, then spoken legacy
         self._legacy_pool = False
-        self._lock = threading.Lock()
+        self._lock = locktrace.make_lock("pool.RemoteResourceManager._lock")
 
     def _agent(self, addr: tuple[str, int]) -> RpcClient:
         with self._lock:
@@ -2076,6 +2140,10 @@ def main(argv: list[str] | None = None) -> int:
                         "a restarted pool replays it and re-adopts live work")
     args = p.parse_args(argv)
     config = TonyConfig.from_layers(conf_file=args.conf_file, conf_args=args.conf)
+    if config.get_bool(keys.DEBUG_LOCKTRACE):
+        # before the service constructs its locks — a plain Lock cannot
+        # retroactively grow tracing (obs/locktrace.py)
+        locktrace.set_enabled(True)
     from tony_tpu.chaos import ChaosContext
 
     svc = PoolService(

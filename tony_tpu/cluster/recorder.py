@@ -33,6 +33,15 @@ The live pool and ``tony sim`` both attach the SAME recorder class to the
 same policy seam, so an offline what-if replay and the production pool emit
 diffable record streams (asserted by the sim-vs-live parity test in
 tests/test_recorder.py).
+
+Locking contract: this module owns NO locks — callers serialize. The pool
+mutates both instruments under its state lock but keeps the slow half out
+of it: the liveness tick calls :meth:`QueueTelemetry.sample` +
+:meth:`drain_finalized` under the lock (pure in-memory work), then renders
+gauges and appends the window JSONL *after releasing it*
+(``PoolService._write_series``, behind its own leaf ``_series_lock``) — the
+shape ``tony lint``'s blocking-under-lock checker enforces
+(docs/static-analysis.md).
 """
 
 from __future__ import annotations

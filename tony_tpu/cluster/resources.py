@@ -469,7 +469,7 @@ class ContainerLauncher:
                     out[cid] = rc
                     self._reported.add(cid)
             for cid, pid in self._adopted.items():
-                if cid in self._reported or (pid is not None and _pid_alive(pid)):
+                if cid in self._reported or (pid is not None and _pid_alive(pid)):  # lint: disable=blocking-under-lock — procfs read: memory-backed, never blocks on storage
                     continue
                 # init reaped the real exit status with the dead AM; the
                 # executor's RPC result report (which rides out the takeover)
@@ -531,7 +531,7 @@ class ContainerLauncher:
             live = [cid for cid, p in self._procs.items() if p.poll() is None]
             live += [
                 cid for cid, pid in self._adopted.items()
-                if pid is not None and _pid_alive(pid)
+                if pid is not None and _pid_alive(pid)  # lint: disable=blocking-under-lock — procfs read: memory-backed, never blocks on storage
             ]
             return live
 

@@ -202,7 +202,7 @@ class RpcClient:
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
-            s = socket.create_connection((self.host, self.port), timeout=self.timeout_s)
+            s = socket.create_connection((self.host, self.port), timeout=self.timeout_s)  # lint: disable=blocking-under-lock — the client lock deliberately serializes the ONE socket (request/response framing); a connect races nothing else
             s.settimeout(self.timeout_s)
             self._sock = s
         return self._sock

@@ -388,6 +388,12 @@ TRACE_DIR = "tony.trace.dir"
 # step time) — exposed at the portal's /metrics (Prometheus text) and the
 # AM's get_metrics RPC. false turns every recording call into a no-op.
 METRICS_ENABLED = "tony.metrics.enabled"
+# Traced control-plane locks (obs/locktrace.py): record real acquisition
+# order, hold times (tony_lock_hold_seconds), and contention for every lock
+# the static lock-order graph models. Debug/test-only — false (the default)
+# hands out plain threading locks, zero overhead and byte-identical
+# behavior. Also settable via TONY_LOCKTRACE=1 before process start.
+DEBUG_LOCKTRACE = "tony.debug.locktrace"
 
 # ---------------------------------------------------------------------------
 # tony.goodput.* — goodput accounting + straggler detection (docs/observability.md)
@@ -596,6 +602,7 @@ DEFAULTS: dict[str, str] = {
     TRACE_ENABLED: "false",
     TRACE_DIR: "",                   # empty → <staging>/trace
     METRICS_ENABLED: "true",
+    DEBUG_LOCKTRACE: "false",
 
     GOODPUT_ENABLED: "true",
     GOODPUT_INTERVAL_MS: "5000",

@@ -55,6 +55,7 @@ ENV_CLUSTER_SPEC = "CLUSTER_SPEC"       # full cluster spec JSON (legacy TF cont
 ENV_TB_PORT = "TB_PORT"                 # tensorboard task port
 # train loop drops step metrics here; the executor push loop picks them up
 ENV_TRAIN_METRICS_FILE = "TONY_TRAIN_METRICS_FILE"
+ENV_LOCKTRACE = "TONY_LOCKTRACE"        # "1"/"true": traced control-plane locks (tony.debug.locktrace)
 ENV_KILL_GRACE_MS = "TONY_KILL_GRACE_MS"  # SIGTERM→SIGKILL window for this container (tony.task.kill-grace-ms)
 ENV_CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"            # from tony.checkpoint.dir
 ENV_CHECKPOINT_INTERVAL = "TONY_CHECKPOINT_INTERVAL"  # from tony.checkpoint.interval-steps

@@ -41,7 +41,7 @@ def _load_library():
                 # edited .cc actually rebuilds. Build failure only matters
                 # when no previously built library exists to load.
                 try:
-                    subprocess.run(
+                    subprocess.run(  # lint: disable=blocking-under-lock — build-once serializer: concurrent first callers MUST wait for the one make
                         ["make", "-C", str(_NATIVE_DIR)],
                         check=True,
                         capture_output=True,

@@ -28,16 +28,25 @@ instead of duplicating. Retention (``tony.history.retention-days``) is
 
 SQLite is stdlib, single-file, and crash-safe under WAL — the right weight
 for a control-plane store that sees one write per finished job.
+
+Locking: ONE connection serialized by ONE lock — the lock's whole job is to
+be held across SQLite statements, and nothing is ever acquired under it (a
+leaf in the lock-order graph, enforced by ``tony lint``'s lock-ordering
+checker). Python-side work — row building, series compaction, JSON
+encoding — happens OUTSIDE it, so the critical sections are exactly the
+statements.
 """
+# lint: disable-file=blocking-under-lock — the store lock IS the single-SQLite-connection serializer; it exists to be held across statements and is a leaf (nothing acquired under it)
 
 from __future__ import annotations
 
 import json
 import os
 import sqlite3
-import threading
 import time
 from typing import Any
+
+from tony_tpu.obs import locktrace
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS jobs (
@@ -119,7 +128,7 @@ class HistoryStore:
         # per finished job and low-rate reads — simplicity over pooling
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.row_factory = sqlite3.Row
-        self._lock = threading.Lock()
+        self._lock = locktrace.make_lock("store.HistoryStore._lock")
         with self._lock:
             if path != ":memory:":
                 self._db.execute("PRAGMA journal_mode=WAL")
@@ -159,19 +168,23 @@ class HistoryStore:
         row["config"] = json.dumps(config or {}, sort_keys=True)
         cols = ", ".join(row)
         qs = ", ".join("?" for _ in row)
+        # series compaction + row building are O(points) Python work —
+        # done out here so writers behind the lock only wait on SQLite
+        series_rows = [
+            (row["app_id"], metric, i, int(ts), float(v))
+            for metric, points in (series or {}).items()
+            for i, (ts, v) in enumerate(
+                compact_series(points, self.max_series_points))
+        ]
         with self._lock:
             try:
                 self._db.execute(
                     f"INSERT OR REPLACE INTO jobs ({cols}) VALUES ({qs})",
                     tuple(row.values()))
                 self._db.execute("DELETE FROM series WHERE app_id = ?", (row["app_id"],))
-                for metric, points in (series or {}).items():
-                    pts = compact_series(points, self.max_series_points)
-                    self._db.executemany(
-                        "INSERT OR REPLACE INTO series (app_id, metric, seq, ts_ms, value) "
-                        "VALUES (?, ?, ?, ?, ?)",
-                        [(row["app_id"], metric, i, int(ts), float(v))
-                         for i, (ts, v) in enumerate(pts)])
+                self._db.executemany(
+                    "INSERT OR REPLACE INTO series (app_id, metric, seq, ts_ms, value) "
+                    "VALUES (?, ?, ?, ?, ?)", series_rows)
                 self._db.commit()
             except Exception:
                 self._db.rollback()

@@ -481,7 +481,7 @@ class JhistFollower:
 
         with self._lock:
             try:
-                with open(self.path, "rb") as f:
+                with open(self.path, "rb") as f:  # lint: disable=blocking-under-lock — leaf lock serializing the follower's (pos, tail-buffer) against concurrent polls; local jhist read
                     f.seek(self._pos)
                     chunk = f.read()
             except OSError:

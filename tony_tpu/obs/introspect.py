@@ -256,7 +256,7 @@ class ProfileCourier:
             ):
                 return
             out_dir = self.artifact_dir(req_id)
-            write_json_atomic(metrics_path + CONTROL_SUFFIX, {
+            write_json_atomic(metrics_path + CONTROL_SUFFIX, {  # lint: disable=blocking-under-lock — leaf lock; tiny local control file once per profile request, on the heartbeat cadence
                 "req_id": req_id,
                 "num_steps": int(piggyback.get("num_steps", 5) or 5),
                 "memory": bool(piggyback.get("memory")),
@@ -271,7 +271,7 @@ class ProfileCourier:
 
     def _check_done_locked(self) -> None:
         assert self._outstanding is not None
-        done = read_json(self._outstanding["done"])
+        done = read_json(self._outstanding["done"])  # lint: disable=blocking-under-lock — leaf lock; tiny local done-file probe, heartbeat cadence
         if done is None or done.get("req_id") != self._outstanding["req_id"]:
             return
         req_id = self._outstanding["req_id"]
@@ -316,7 +316,7 @@ class DrainCourier:
                metrics_path: str | None) -> None:
         with self._lock:
             if self._outstanding is not None and metrics_path:
-                done = read_json(metrics_path + DRAIN_DONE_SUFFIX)
+                done = read_json(metrics_path + DRAIN_DONE_SUFFIX)  # lint: disable=blocking-under-lock — leaf lock; tiny local done-file probe, heartbeat cadence
                 if done is not None and done.get("req_id") == self._outstanding:
                     req_id = self._outstanding
                     self._report(req_id=req_id, step=int(done.get("step") or 0))

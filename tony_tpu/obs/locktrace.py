@@ -1,0 +1,169 @@
+"""Opt-in traced locks: the runtime witness that keeps the static
+concurrency model honest (docs/static-analysis.md).
+
+Every control-plane lock the lint's lock-order graph models is created
+through :func:`make_lock` with the SAME string id the static analysis
+derives (``<module-stem>.<Class>.<attr>``, e.g. ``pool.PoolService._lock``).
+Off (the default — ``tony.debug.locktrace`` unset, ``TONY_LOCKTRACE``
+unset), ``make_lock`` returns a plain ``threading.Lock``/``RLock``: zero
+overhead, byte-identical behavior, nothing recorded. On, it returns a
+:class:`_TracedLock` that observes, per thread, the real acquisition
+order (every ``held -> acquired`` edge), per-lock hold times (the
+``tony_lock_hold_seconds`` histogram), and contention (acquirer had to
+wait). The tier-1 witness test drives representative pool/AM/store
+workloads under it and asserts every witnessed edge embeds into the
+static graph — an inversion the lint did not model fails the build.
+
+The witness state is process-global (locks cross object boundaries);
+tests snapshot it with :func:`witness` and clear it with
+:func:`reset_witness`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any
+
+from tony_tpu import constants
+from tony_tpu.obs import metrics as _metrics
+
+#: sub-microsecond grabs up to multi-second stalls — a control-plane lock
+#: held past ~100ms is exactly the cliff blocking-under-lock hunts
+HOLD_BUCKETS: tuple[float, ...] = (
+    0.000001, 0.00001, 0.0001, 0.001, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0,
+)
+
+_HOLD = _metrics.histogram(
+    "tony_lock_hold_seconds",
+    "traced control-plane lock hold time (tony.debug.locktrace only)",
+    labelnames=("lock",), buckets=HOLD_BUCKETS)
+
+_enabled = os.environ.get(constants.ENV_LOCKTRACE, "").lower() in (
+    "1", "true", "yes")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    """Flip tracing for locks created AFTER this call (daemon mains read
+    ``tony.debug.locktrace`` before constructing their services; tests
+    flip it around service construction). Existing locks keep whatever
+    they are — a plain Lock cannot retroactively grow tracing."""
+    global _enabled
+    _enabled = bool(on)
+
+
+class _Witness:
+    """Process-global record of what traced locks actually did."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        #: (held_name, acquired_name) -> count
+        self.edges: dict[tuple[str, str], int] = {}
+        #: name -> acquisition count
+        self.acquires: dict[str, int] = {}
+        #: name -> times the acquirer found the lock taken
+        self.contended: dict[str, int] = {}
+
+    def record(self, stack: list[str], name: str, waited: bool) -> None:
+        with self._lock:
+            self.acquires[name] = self.acquires.get(name, 0) + 1
+            if waited:
+                self.contended[name] = self.contended.get(name, 0) + 1
+            for held in stack:
+                if held != name:  # reentrant re-acquire is not an edge
+                    key = (held, name)
+                    self.edges[key] = self.edges.get(key, 0) + 1
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "edges": dict(self.edges),
+                "acquires": dict(self.acquires),
+                "contended": dict(self.contended),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.edges.clear()
+            self.acquires.clear()
+            self.contended.clear()
+
+
+_WITNESS = _Witness()
+_held_stack = threading.local()
+
+
+def witness() -> dict[str, Any]:
+    """Snapshot of the witnessed order edges / acquire / contention counts."""
+    return _WITNESS.snapshot()
+
+
+def reset_witness() -> None:
+    _WITNESS.reset()
+
+
+class _TracedLock:
+    """Wraps a real Lock/RLock; context-manager protocol plus the
+    acquire/release methods the wrapped code already uses."""
+
+    __slots__ = ("name", "_inner", "_t0")
+
+    def __init__(self, name: str, reentrant: bool):
+        self.name = name
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        # per-acquisition start times, a stack for reentrant locks
+        self._t0: list[float] = []
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        waited = not self._inner.acquire(blocking=False)
+        if waited:
+            if not blocking:
+                return False
+            if not self._inner.acquire(True, timeout):
+                return False
+        stack = getattr(_held_stack, "names", None)
+        if stack is None:
+            stack = _held_stack.names = []
+        _WITNESS.record(stack, self.name, waited)
+        stack.append(self.name)
+        self._t0.append(time.perf_counter())
+        return True
+
+    def release(self) -> None:
+        t0 = self._t0.pop() if self._t0 else None
+        stack = getattr(_held_stack, "names", None)
+        if stack and self.name in stack:
+            # remove the innermost occurrence (reentrant-safe)
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] == self.name:
+                    del stack[i]
+                    break
+        self._inner.release()
+        # observe AFTER releasing: the histogram's own lock must never
+        # extend this lock's critical section
+        if t0 is not None:
+            _HOLD.observe(time.perf_counter() - t0, lock=self.name)
+
+    def __enter__(self) -> "_TracedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        probe = getattr(self._inner, "locked", None)  # RLock lacks it pre-3.12
+        return bool(probe()) if probe else bool(self._t0)
+
+
+def make_lock(name: str, reentrant: bool = False):
+    """A lock named with its static-analysis id. Plain (untraced, zero
+    overhead) unless locktrace is enabled at creation time."""
+    if not _enabled:
+        return threading.RLock() if reentrant else threading.Lock()
+    return _TracedLock(name, reentrant)

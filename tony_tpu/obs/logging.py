@@ -120,7 +120,7 @@ class JsonLogger:
             return  # a log record must never take the process down
         with self._lock:
             try:
-                self._file.write(line + "\n")
+                self._file.write(line + "\n")  # lint: disable=blocking-under-lock — the logger lock IS the log-line serializer (leaf; line already serialized outside it)
             except (OSError, ValueError):
                 # disk full / IO error / closed mid-teardown: logging is
                 # best-effort by contract and must never take the process down

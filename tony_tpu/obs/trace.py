@@ -224,7 +224,7 @@ class Tracer:
         line = json.dumps(span.to_dict())
         with self._lock:
             try:
-                self._file.write(line + "\n")
+                self._file.write(line + "\n")  # lint: disable=blocking-under-lock — the tracer lock IS the span-line serializer (leaf; span serialized outside it)
             except ValueError:
                 pass  # closed mid-teardown: spans are best-effort by contract
 
