@@ -622,6 +622,25 @@ class TestGoodputHeadlineE2E:
             time.sleep(interval)
         return None
 
+    def _wait_observed(self, probe, stall_s=60.0, cap_s=420.0, interval=0.25):
+        """Progress-derived deadline: ``probe()`` returns ``(result,
+        signal)``; returns ``result`` once truthy. The wait only gives up
+        after ``stall_s`` seconds with no change in ``signal`` (hard backstop
+        ``cap_s``) — a slow-but-progressing run gets more time, a wedged one
+        still fails fast."""
+        t0 = last_t = time.time()
+        last: object = object()
+        while True:
+            result, sig = probe()
+            if result:
+                return result
+            now = time.time()
+            if sig != last:
+                last, last_t = sig, now
+            if now - last_t >= stall_s or now - t0 >= cap_s:
+                return None
+            time.sleep(interval)
+
     def test_restart_resize_straggler_and_alert_accounted(
             self, tmp_tony_root, tmp_path, capsys):
         from tests.test_e2e import FAST, fixture_cmd
@@ -664,7 +683,8 @@ class TestGoodputHeadlineE2E:
         def restarted_and_progressing():
             rpc = handle.rpc(timeout_s=5)
             if rpc is None:
-                return None
+                return None, None
+            sig = None
             try:
                 st = rpc.call("get_application_status")
                 infos = rpc.call("get_task_infos")
@@ -672,16 +692,20 @@ class TestGoodputHeadlineE2E:
                     ((t.get("metrics") or {}).get("train") or {}).get("step") or 0
                     for t in infos
                 ]
-                if (st.get("restart_attempt", 0) >= 1
-                        and sum(1 for t in infos if t["status"] == "RUNNING") >= 3
-                        and max(steps, default=0) >= 8):
-                    return rpc
+                running = sum(1 for t in infos if t["status"] == "RUNNING")
+                sig = (st.get("restart_attempt", 0), running,
+                       max(steps, default=0))
+                if sig[0] >= 1 and running >= 3 and sig[2] >= 8:
+                    return rpc, sig
             except Exception:  # noqa: BLE001 — AM mid-restart
                 pass
             rpc.close()
-            return None
+            return None, sig
 
-        rpc = self._wait(restarted_and_progressing, timeout_s=90)
+        # deadline derived from observed progress: restart attempts landing
+        # and step reports advancing extend the wait; only a stall fails
+        rpc = self._wait_observed(restarted_and_progressing,
+                                  stall_s=60, cap_s=300)
         assert rpc is not None, "gang restart never landed (or never progressed)"
         try:
             # give the straggler detector a couple of ticks on the restarted

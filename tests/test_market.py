@@ -1,0 +1,507 @@
+"""The serve/train capacity market (docs/scheduling.md "Capacity market").
+
+Four layers, innermost out:
+
+- the pure policy passes (cluster/policy.py): ``fund_demand`` sheds elastic
+  workers from over-share borrowers to cover a published deficit — never
+  admitting, never whole-evicting — and ``plan_growback`` returns the debt
+  once demand ebbs, both under the reclaim pass's own guards (share floor,
+  min-runtime shield, eviction budget, plus the grow-back anti-thrash
+  shield);
+- the live ``PoolService`` market plumbing: the ``update_demand`` RPC
+  (journal-durable, double-shed-proof while a demand drain is in flight),
+  the liveness tick's TTL expiry / funding retry / quiet-window grow-back
+  offers, and grow acceptance through re-registration;
+- the seeded capacity-market simulator (``tony sim --mix serve-train``):
+  deterministic by seed, market invariants asserted every virtual second —
+  the fast tier-1 smoke the verify run-book registers;
+- the headline E2E: a serve head whose fleet cannot place publishes its
+  deficit to a real pool; an elastic train gang sheds workers through the
+  drain/urgent-checkpoint contract (no whole-gang eviction), the serve
+  fleet lands inside the spike, and after the ebb the pool grows the gang
+  back (``GANG_RESIZED`` trigger=capacity) — every decision in the flight
+  recorder under ``demand-spike`` / ``grow-back``.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from tony_tpu.cluster.policy import AppView, PreemptionPolicy, WorldIndex
+from tony_tpu.cluster.pool import PoolService, RemoteResourceManager
+from tony_tpu.cluster.recorder import FlightRecorder
+from tony_tpu.cluster.resources import Resources
+from tony_tpu.cluster.rpc import RpcError
+from tony_tpu.cluster.session import JobStatus
+from tony_tpu.cluster.sim import GB, run_market_mix
+from tony_tpu.config import keys
+from tony_tpu.obs import goodput as obs_goodput
+
+from tests.test_pool import FAST, SECRET, register_cpu_node, spawn_agent
+from tests.test_pool_queue import submit_async
+from tests.test_sched import (
+    PREEMPT_CONF,
+    FakeClock,
+    counter_value,
+    finished_events,
+    fixture_cmd,
+    read_step,
+    wait_for,
+)
+
+pytestmark = pytest.mark.sched
+
+
+def _totals(mem_gb=8):
+    return (mem_gb * GB, 256, 0)
+
+
+def _world(*views):
+    w = WorldIndex()
+    for v in views:
+        w.adopt(v)
+    return w
+
+
+def _train(app_id="t1", workers=6, floor=2, seq=1, queue="train", **over):
+    return AppView(
+        app_id, queue, seq=seq, admitted=True,
+        demand=(workers * GB, workers, 0), held=(workers * GB, workers, 0),
+        elastic_unit=(GB, 1, 0), elastic_slack=workers - floor, **over)
+
+
+def _serve_head(app_id="s1", gb=2, queue="serve", **over):
+    return AppView(app_id, queue, priority=5, seq=99, admitted=True,
+                   demand=(gb * GB, gb, 0), held=(gb * GB, gb, 0), **over)
+
+
+# ---------------------------------------------------------------------------
+# Pure policy units: fund_demand / plan_growback
+# ---------------------------------------------------------------------------
+class TestFundDemand:
+    def test_sheds_from_overshare_elastic_borrower(self):
+        clock = FakeClock()
+        rec = FlightRecorder(clock=lambda: clock.t)
+        p = PreemptionPolicy({"serve": 0.5, "train": 0.5}, preemption=True,
+                             clock=clock, sink=rec)
+        t1, s1 = _train(workers=6), _serve_head()
+        world = _world(t1, s1)
+        free = [0, 248, 0]  # 8 GiB pool fully held: 6 train + 2 serve
+        d = p.fund_demand(world, _totals(), free, app_id="s1", queue="serve",
+                          need=(2 * GB, 2, 0))
+        assert [(sh.app_id, sh.workers, sh.for_app) for sh in d.shrink] == \
+            [("t1", 2, "s1")]
+        assert not d.admit and not d.evict  # the market never admits/evicts
+        assert free[0] == 2 * GB and free[1] == 250
+        # the victim's view mutated like the scheduling pass would
+        assert t1.elastic_slack == 2 and t1.shrink_pending
+        assert t1.demand == (4 * GB, 4, 0)
+        chain = [r.rule for r in rec.explain("t1")]
+        assert "demand-spike" in chain
+
+    def test_headroom_already_covers_deficit(self):
+        p = PreemptionPolicy({"serve": 0.5, "train": 0.5}, preemption=True,
+                             clock=FakeClock())
+        world = _world(_train(workers=4))
+        d = p.fund_demand(world, _totals(), [4 * GB, 250, 0],
+                          app_id="s1", queue="serve", need=(2 * GB, 2, 0))
+        assert d.empty()
+
+    def test_rigid_gang_never_whole_evicted(self):
+        rec = FlightRecorder(clock=lambda: 0.0)
+        p = PreemptionPolicy({"serve": 0.5, "train": 0.5}, preemption=True,
+                             clock=FakeClock(), sink=rec)
+        rigid = _train(workers=6, floor=6)  # slack 0: nothing to shed
+        world = _world(rigid, _serve_head())
+        d = p.fund_demand(world, _totals(), [0, 248, 0],
+                          app_id="s1", queue="serve", need=(2 * GB, 2, 0))
+        assert d.empty()  # no shrink AND no eviction fallback
+        assert "demand-unfunded" in [r.rule for r in rec.explain("s1")]
+
+    def test_share_floor_bounds_the_shed(self):
+        # train share 0.5 of 8 GiB = 4 GiB: holding 6, only 2 GiB excess is
+        # on the market even though slack would allow shedding deeper
+        p = PreemptionPolicy({"serve": 0.5, "train": 0.5}, preemption=True,
+                             clock=FakeClock())
+        t1 = _train(workers=6, floor=0)
+        world = _world(t1, _serve_head())
+        d = p.fund_demand(world, _totals(), [0, 248, 0],
+                          app_id="s1", queue="serve", need=(4 * GB, 4, 0))
+        assert sum(sh.workers for sh in d.shrink) == 2
+
+    def test_growback_shield_prevents_thrash(self):
+        clock = FakeClock()
+        rec = FlightRecorder(clock=lambda: clock.t)
+        p = PreemptionPolicy({"serve": 0.5, "train": 0.5}, preemption=True,
+                             min_runtime_ms=5000, clock=clock, sink=rec)
+        t1 = _train(workers=6)
+        world = _world(t1, _serve_head())
+        grown_at = {"t1": clock.t - 1.0}  # re-grown 1s ago
+        d = p.fund_demand(world, _totals(), [0, 248, 0], app_id="s1",
+                          queue="serve", need=(2 * GB, 2, 0),
+                          grown_at=grown_at)
+        assert d.empty()  # freshly restored: shielded from the next spike
+        assert "demand-unfunded" in [r.rule for r in rec.explain("s1")]
+        clock.t += 10.0  # shield window over
+        d = p.fund_demand(world, _totals(), [0, 248, 0], app_id="s1",
+                          queue="serve", need=(2 * GB, 2, 0),
+                          grown_at=grown_at)
+        assert sum(sh.workers for sh in d.shrink) == 2
+
+    def test_budget_bounds_disruptions_but_commits_partial(self):
+        clock = FakeClock()
+        rec = FlightRecorder(clock=lambda: clock.t)
+        p = PreemptionPolicy({"serve": 0.5, "train": 0.5}, preemption=True,
+                             eviction_budget=1, clock=clock, sink=rec)
+        t1 = _train("t1", workers=3, floor=2, seq=1)
+        t2 = _train("t2", workers=3, floor=2, seq=2)
+        world = _world(t1, t2, _serve_head())
+        d = p.fund_demand(world, _totals(), [0, 247, 0], app_id="s1",
+                          queue="serve", need=(2 * GB, 2, 0))
+        # one disruption allowed → one borrower sheds its single slack
+        # worker; the partial funding is committed, not discarded
+        assert len(d.shrink) == 1 and d.shrink[0].workers == 1
+        assert "budget-exhausted" in [r.rule for r in rec.explain("s1")]
+
+
+class TestPlanGrowback:
+    def test_grants_oldest_first_bounded_by_free(self):
+        rec = FlightRecorder(clock=lambda: 0.0)
+        p = PreemptionPolicy({"serve": 0.5, "train": 0.5}, preemption=True,
+                             clock=FakeClock(), sink=rec)
+        t1, t2 = _train("t1", workers=2, floor=2), _train("t2", workers=2, floor=2)
+        world = _world(t1, t2)
+        free = [3 * GB, 3, 0]
+        grants = p.plan_growback(
+            world, free, [("t1", 2, (GB, 1, 0)), ("t2", 2, (GB, 1, 0))])
+        assert grants == [("t1", 2), ("t2", 1)]  # oldest debt paid first
+        assert free[0] == 0  # offers hold the capacity they promise
+        assert {r.rule for r in rec.explain("t1")} == {"grow-back"}
+
+    def test_step_caps_per_pass_and_gone_apps_skipped(self):
+        p = PreemptionPolicy({"serve": 0.5, "train": 0.5}, preemption=True,
+                             clock=FakeClock())
+        world = _world(_train("t1", workers=2, floor=2))
+        grants = p.plan_growback(
+            world, [8 * GB, 8, 0],
+            [("gone", 2, (GB, 1, 0)), ("t1", 3, (GB, 1, 0))], step=1)
+        assert grants == [("t1", 1)]
+
+
+# ---------------------------------------------------------------------------
+# Live pool plumbing: update_demand RPC, liveness tick, grow acceptance
+# ---------------------------------------------------------------------------
+class TestPoolMarket:
+    def _pool(self, tmp_path, **over):
+        svc = PoolService(
+            port=0, preemption=True, preemption_drain_ms=10_000,
+            queues={"serve": 0.7, "train": 0.3},
+            journal_path=str(tmp_path / "pool.jsonl"), **over)
+        register_cpu_node(svc, "n0", memory=8 * GB, vcores=64)
+        return svc
+
+    def _admit_train(self, svc, workers=6, floor=2):
+        svc.register_app("train1", queue="train", memory_bytes=workers * GB,
+                         vcores=workers, elastic_unit=[GB, 1, 0],
+                         elastic_slack=workers - floor)
+        for i in range(workers):
+            got = svc.allocate("train1", "worker", i, GB, 1)
+            assert "id" in got, got
+
+    def test_publish_funds_journals_and_is_double_shed_proof(self, tmp_path):
+        funded_before = counter_value(
+            "tony_pool_market_funded_workers_total", queue="train")
+        svc = self._pool(tmp_path)
+        try:
+            self._admit_train(svc)
+            svc.register_app("serve1", queue="serve",
+                             memory_bytes=2 * GB, vcores=2)
+            for i in range(2):
+                assert "id" in svc.allocate("serve1", "serve", i, GB, 1)
+            out = svc.update_demand("serve1", workers=2, unit=[GB, 1, 0],
+                                    reason="pending serve x2")
+            assert out == {"ack": True, "funded_workers": 2}
+            assert svc._demand["serve1"]["workers"] == 2
+            entry = svc._drains["train1"]
+            assert entry["mode"] == "shrink" and entry["origin"] == "demand"
+            assert entry["for_app"] == "serve1"
+            assert counter_value("tony_pool_market_funded_workers_total",
+                                 queue="train") == funded_before + 2
+            # re-publish while the shed is in flight: the pending drain's
+            # undo_demand covers the deficit — no double shed
+            out2 = svc.update_demand("serve1", workers=2, unit=[GB, 1, 0])
+            assert out2["funded_workers"] == 0
+
+            st = svc.pool_status()
+            assert st["market"]["demand"]["serve1"]["workers"] == 2
+            assert svc.recorder is not None
+            chain = [r.rule for r in svc.recorder.explain("train1")]
+            assert "demand-spike" in chain
+
+            # clearing retracts the published deficit and starts the quiet
+            # clock the grow-back hysteresis counts from
+            assert svc.update_demand("serve1", workers=0)["ack"]
+            assert "serve1" not in svc._demand
+            assert svc._demand_quiet_since is not None
+        finally:
+            svc.stop()
+
+    def test_unknown_app_and_disabled_pool_refuse(self, tmp_path):
+        svc = self._pool(tmp_path, demand_enabled=False)
+        try:
+            assert svc.update_demand("ghost", workers=1)["unknown_app"]
+            svc.register_app("a1", queue="serve", memory_bytes=GB, vcores=1)
+            assert svc.update_demand("a1", workers=1)["disabled"]
+        finally:
+            svc.stop()
+
+    def test_tick_expires_ttl_offers_growback_and_acceptance_settles(
+            self, tmp_path):
+        growback_before = counter_value(
+            "tony_pool_market_growback_workers_total", queue="train")
+        svc = self._pool(tmp_path, demand_ttl_ms=5_000, growback_ebb_ms=1_000)
+        try:
+            self._admit_train(svc, workers=4, floor=2)
+            svc.register_app("serve1", queue="serve",
+                             memory_bytes=2 * GB, vcores=2)
+            now = time.monotonic()
+            with svc._lock:
+                # a publisher that went quiet: TTL-expired by the tick
+                svc._demand["serve1"] = {
+                    "workers": 2, "unit": (GB, 1, 0),
+                    "unix": time.time() - 10.0, "mono": now - 10.0,
+                }
+                svc._market_tick_locked(now)
+                assert "serve1" not in svc._demand
+                # grow-back: debt + quiet window elapsed + free capacity
+                svc._shrunk["train1"] = {
+                    "workers": 2, "unit": (GB, 1, 0), "queue": "train",
+                    "since_unix": time.time() - 30.0,
+                }
+                svc._demand_quiet_since = now - 30.0
+                svc._market_tick_locked(now)
+                grow = svc._grows["train1"]
+                assert grow["workers"] == 2
+                assert grow["expected_primary"] == 6 * GB  # memory-primary pool
+                notice = svc._preempt_notice_locked("train1")
+                assert notice["mode"] == "grow"
+                assert notice["grow_workers"] == 2
+                assert notice["req_id"] == grow["req_id"]
+            # acceptance: the AM resizes up and re-registers at the grown
+            # demand — the debt settles and the anti-thrash shield arms
+            svc.register_app("train1", queue="train", memory_bytes=6 * GB,
+                             vcores=6, elastic_unit=[GB, 1, 0],
+                             elastic_slack=4)
+            assert "train1" not in svc._shrunk
+            assert "train1" not in svc._grows
+            assert "train1" in svc._grown_at
+            assert counter_value("tony_pool_market_growback_workers_total",
+                                 queue="train") == growback_before + 2
+        finally:
+            svc.stop()
+
+    def test_client_degrades_on_pre_market_pool(self):
+        rrm = object.__new__(RemoteResourceManager)
+        rrm.app_id = "app_1"
+        rrm._market_unsupported = False
+        calls = []
+
+        class _Cli:
+            def call(self, method, **kw):
+                calls.append(method)
+                raise RpcError("unknown method 'update_demand'")
+
+        rrm.rm = _Cli()
+        assert rrm.update_demand(2, Resources(GB, 1, 0)) is False
+        assert rrm._market_unsupported is True
+        assert rrm.update_demand(2, Resources(GB, 1, 0)) is False
+        assert calls == ["update_demand"]  # detected once, never re-sent
+
+
+# ---------------------------------------------------------------------------
+# Seeded capacity-market simulator (tier-1 smoke; verify run-book entry)
+# ---------------------------------------------------------------------------
+class TestMarketSim:
+    def test_seeded_mix_ok_deterministic_with_provenance(self):
+        r1, rec = run_market_mix("serve-train", seed=0, record_decisions=True)
+        r2, _ = run_market_mix("serve-train", seed=0)
+        assert r1.ok(), r1.violations
+        assert r1.to_dict() == r2.to_dict()  # same seed, same market
+        assert r1.evictions == 0 and r1.shed_workers > 0
+        assert r1.restored_all and r1.growback_workers == r1.shed_workers
+        assert r1.badput_fraction <= 0.25
+        rules = {r.rule for r in rec.records}
+        assert {"demand-spike", "grow-back"} <= rules
+
+    def test_seeds_vary_the_spike_schedule(self):
+        r0, _ = run_market_mix("serve-train", seed=0)
+        r3, _ = run_market_mix("serve-train", seed=3)
+        assert r3.ok(), r3.violations
+        assert r0.to_dict() != r3.to_dict()  # a different seeded market
+
+    def test_cli_routes_market_mix(self, capsys):
+        from tony_tpu.cli.sim import main as sim_main
+
+        assert sim_main(["--mix", "serve-train", "--seed", "3"]) == 0
+        out = capsys.readouterr().out
+        assert "market sim seed 3" in out
+        assert sim_main(["--mix", "serve-train", "--seed", "1",
+                         "--json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["evictions"] == 0 and data["shed_workers"] > 0
+
+    def test_cli_rejects_infeasible_pool(self, capsys):
+        from tony_tpu.cli.sim import main as sim_main
+
+        assert sim_main(["--mix", "serve-train", "--memory", "6"]) == 2
+        assert "too small" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# E2E headline: live spike funded by partial reclaim, grown back after ebb
+# ---------------------------------------------------------------------------
+@pytest.mark.e2e
+class TestCapacityMarketE2E:
+    def test_spike_sheds_train_workers_then_grows_back(
+            self, tmp_tony_root, tmp_path):
+        """A serve head that cannot place its fleet publishes the deficit;
+        the pool funds it by shrinking the elastic train gang (urgent
+        checkpoint, no whole-gang eviction); the fleet lands inside the
+        spike; after the ebb the pool offers the shed workers back and the
+        gang grows to full size (``GANG_RESIZED`` trigger=capacity)."""
+        svc = PoolService(
+            heartbeat_interval_ms=100, max_missed_heartbeats=4, secret=SECRET,
+            preemption=True, preemption_drain_ms=15_000,
+            queues={"serve": 0.8, "train": 0.2},
+            growback_ebb_ms=1_500,
+        )
+        svc.start()
+        agent = spawn_agent(svc.address, "solo", str(tmp_path), memory="8g",
+                            extra=("--vcores", "16"))
+        shrink_before = counter_value("tony_pool_preemptions_total", mode="shrink")
+        kill_before = counter_value("tony_pool_preemptions_total", mode="kill")
+        funded_before = counter_value(
+            "tony_pool_market_funded_workers_total", queue="train")
+        try:
+            wait_for(lambda: any(n.alive for n in svc._nodes.values()),
+                     "agent registration", 15)
+            shared = tmp_path / "market-shared"
+            # the borrower: 4×1g elastic train gang, floor 2
+            h1, t1, r1 = submit_async(tmp_tony_root, {
+                **FAST, **PREEMPT_CONF,
+                keys.TPU_POOL_SPEC: "rm:%s:%d" % svc.address,
+                keys.TPU_POOL_SECRET: SECRET,
+                keys.APPLICATION_QUEUE: "train",
+                "tony.worker.instances": "4", "tony.worker.memory": "1g",
+                keys.ELASTIC_MIN_WORKERS: "2",
+                keys.ELASTIC_SHRINK_ON_PREEMPT: "true",
+                keys.EXECUTES: fixture_cmd("preempt_train.py", shared, 400, 150),
+            })
+            wait_for(lambda: read_step(shared / "step-r0.json") >= 3,
+                     "train gang progress")
+            # the serve head: ADMITTED at 2×1g (claims fit), market bridge
+            # on. The spike lands as a mid-flight scale-up to 6 replicas —
+            # 2 more than physically free — so its AM sits in
+            # AllocationPending and publishes the unmet deficit instead of
+            # waiting the spike out.
+            quick = tmp_path / "serve-replica.py"
+            quick.write_text("import time; time.sleep(8)\n")
+            h2, t2, r2 = submit_async(tmp_tony_root, {
+                **FAST,
+                keys.TPU_POOL_SPEC: "rm:%s:%d" % svc.address,
+                keys.TPU_POOL_SECRET: SECRET,
+                keys.APPLICATION_QUEUE: "serve",
+                "tony.worker.instances": "2", "tony.worker.memory": "1g",
+                keys.SERVE_MARKET_ENABLED: "true",
+                keys.TASK_RESTART_ON_FAILURE: "true",
+                keys.EXECUTES: f"{sys.executable} {quick}",
+            })
+
+            def serve_fleet_up():
+                rpc = h2.rpc(timeout_s=5)
+                if rpc is None:
+                    return None
+                try:
+                    infos = rpc.call("get_task_infos")
+                    if sum(1 for t in infos if t["status"] == "RUNNING") >= 2:
+                        return rpc
+                except Exception:  # noqa: BLE001 — AM still starting
+                    pass
+                rpc.close()
+                return None
+
+            rpc = wait_for_value(serve_fleet_up, "serve fleet up", 90)
+            try:
+                assert rpc.call("resize_jobtype", job_name="worker",
+                                instances=6)["ack"]
+            finally:
+                rpc.close()
+            wait_for(lambda: svc._demand or svc._shrunk,
+                     "published deficit reaching the pool", 60)
+            # the grown serve fleet places INSIDE the spike (funded by the
+            # shed) and runs to completion
+            t2.join(timeout=150)
+            assert r2.get("final") == JobStatus.SUCCEEDED, h2.final_status()
+            assert counter_value("tony_pool_market_funded_workers_total",
+                                 queue="train") >= funded_before + 2
+            assert counter_value("tony_pool_preemptions_total",
+                                 mode="shrink") >= shrink_before + 1
+
+            # ebb → quiet window → grow offer → the gang accepts and grows
+            # back to 4 workers under trigger=capacity
+            def grown_back():
+                evs = finished_events(tmp_tony_root, h1.app_id)
+                return [
+                    e for e in evs
+                    if e.type.value == "GANG_RESIZED"
+                    and not e.payload.get("rejected")
+                    and e.payload.get("trigger") == "capacity"
+                ] or None
+
+            resized = wait_for_value(grown_back, "grow-back resize", 90)
+            assert resized[-1].payload["instances"].get("worker") == 4
+            wait_for(lambda: not svc._shrunk, "grow-back debt settled", 60)
+
+            events = finished_events(tmp_tony_root, h1.app_id)
+            types = [e.type.value for e in events]
+            # the shed was cooperative partial reclaim, never an eviction
+            req = next(e for e in events
+                       if e.type.value == "PREEMPTION_REQUESTED")
+            assert req.payload.get("mode") == "shrink"
+            assert "PREEMPTION_ESCALATED" not in types
+            assert counter_value("tony_pool_preemptions_total",
+                                 mode="kill") == kill_before
+            # provenance: the flight recorder chains name the market rules
+            chain = [r.rule for r in svc.recorder.explain(h1.app_id)]
+            assert "demand-spike" in chain and "grow-back" in chain
+            # disruption stays bounded: the goodput ledger charges the shed
+            # and the grow-back rebuilds, and they are a fraction of the run
+            led = obs_goodput.build_ledger(
+                h1.app_id, events, now_ms=int(time.time() * 1000))
+            assert led.disruption_fraction() < 0.75, led.phases_ms
+        finally:
+            from tony_tpu.cluster.client import Client
+
+            Client.kill(h1)
+            t1.join(timeout=60)
+            if agent.poll() is None:
+                agent.terminate()
+            try:
+                agent.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                agent.kill()
+            svc.stop()
+
+
+def wait_for_value(cond, what, timeout=45):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(0.1)
+    pytest.fail(f"timed out waiting for {what}")

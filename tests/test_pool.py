@@ -291,6 +291,68 @@ class TestPoolJournalCompaction:
         finally:
             restarted.stop()
 
+    def test_capacity_market_survives_restart_and_compaction(self, tmp_path):
+        """A pool restart mid-spike preserves the capacity market: the
+        published serve deficit (its TTL counted from the ORIGINAL publish
+        instant, not restart time), the grow-back debt ledger, and an
+        in-flight grow offer with its deadline rebased onto the new
+        process's clock (docs/scheduling.md "Capacity market")."""
+        path = str(tmp_path / "pool.jsonl")
+        svc = PoolService(journal_path=path, journal_compact_every=1, port=0)
+        now_unix, now_mono = time.time(), time.monotonic()
+        with svc._lock:
+            svc._demand["serve_head"] = {
+                "workers": 2, "unit": (1 << 30, 1, 0),
+                "unix": now_unix - 10.0, "mono": now_mono - 10.0,
+            }
+            svc._journal_demand_locked("serve_head")
+            svc._shrunk["train_gang"] = {
+                "workers": 2, "unit": (1 << 30, 1, 0), "queue": "train",
+                "since_unix": now_unix - 8.0,
+            }
+            svc._grows["train_gang"] = {
+                "req_id": "grow-pre-1", "workers": 1,
+                "expected_primary": 4, "deadline": now_mono + 30.0,
+            }
+            svc._journal_growback_locked("train_gang")
+            # stage a journaled transition; the sync below must fold the
+            # market rows into the compaction snapshot
+            svc._jlog_locked("app_removed", app_id="nobody")
+        svc._journal_sync()
+        svc.stop()
+        restarted = PoolService(journal_path=path, port=0)
+        try:
+            d = restarted._demand["serve_head"]
+            assert d["workers"] == 2 and d["unit"] == (1 << 30, 1, 0)
+            # TTL clock rebased: ~10s of publish age already elapsed
+            assert 8.0 < time.monotonic() - d["mono"] < 13.0
+            s = restarted._shrunk["train_gang"]
+            assert s["workers"] == 2 and s["queue"] == "train"
+            assert s["unit"] == (1 << 30, 1, 0)
+            assert abs(s["since_unix"] - (now_unix - 8.0)) < 2.0
+            g = restarted._grows["train_gang"]
+            assert g["req_id"] == "grow-pre-1" and g["workers"] == 1
+            assert g["expected_primary"] == 4
+            remaining = g["deadline"] - time.monotonic()
+            assert 20.0 < remaining < 31.0  # rebased, not reset
+
+            # clearing records replay too: workers=0 retracts the deficit
+            # and settles the debt (dropping the offer with it)
+            with restarted._lock:
+                restarted._demand.pop("serve_head")
+                restarted._journal_demand_locked("serve_head")
+                restarted._shrunk.pop("train_gang")
+                restarted._grows.pop("train_gang")
+                restarted._journal_growback_locked("train_gang")
+            restarted._journal_sync()
+        finally:
+            restarted.stop()
+        final = PoolService(journal_path=path, port=0)
+        try:
+            assert not final._demand and not final._shrunk and not final._grows
+        finally:
+            final.stop()
+
 
 # ---------------------------------------------------------------------------
 # E2E: pool service + ≥2 agent PROCESSES on loopback, full submit spine

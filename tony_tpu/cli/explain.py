@@ -13,10 +13,14 @@ secret from ``$TONY_POOL_SECRET`` (or the site file's ``tony.tpu.pool.secret``).
 Output for an app is its current scheduling state — including the BINDING
 RULE currently blocking it (``share-deficit``, ``budget-exhausted``,
 ``min-runtime-shield``, ``no-rect-placement``, …) — followed by its decision
-chain: every admit/evict/shrink it was the subject of or funded, and every
-coalesced denial, oldest first. For a shrink victim the chain names the head
-the shed workers funded; for a waiting head it names the guard that keeps
-refusing it.
+chain: every admit/evict/shrink/grow it was the subject of or funded, and
+every coalesced denial, oldest first. For a shrink victim the chain names the
+head the shed workers funded; for a waiting head it names the guard that
+keeps refusing it. Capacity-market episodes appear under their own rules:
+``demand-spike`` (a borrower shed workers to fund published serve demand),
+``grow-back`` (the pool offered them back after the ebb), and
+``demand-unfunded`` / ``budget-exhausted`` denials when a deficit could not
+be met (docs/scheduling.md "Capacity market").
 """
 
 from __future__ import annotations

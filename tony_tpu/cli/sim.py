@@ -29,10 +29,13 @@ import sys
 from tony_tpu.cluster.pool import parse_queue_spec
 from tony_tpu.cluster.sim import (
     GB,
+    MARKET_MIXES,
     MIXES,
     PoolSimulator,
     generate_jobs,
+    render_market_report,
     render_report,
+    run_market_mix,
     run_parity,
 )
 
@@ -43,8 +46,11 @@ def main(argv: list[str] | None = None) -> int:
         description="replay seeded synthetic arrivals against the live "
                     "admission/preemption policy and assert its invariants",
     )
-    p.add_argument("--mix", default="batch", choices=MIXES,
-                   help="synthetic workload shape")
+    p.add_argument("--mix", default="batch", choices=MIXES + MARKET_MIXES,
+                   help="synthetic workload shape ('serve-train' runs the "
+                        "capacity-market simulator instead of the event "
+                        "simulator: seeded serve spikes funded by partial "
+                        "reclaim, then grown back after the ebb)")
     p.add_argument("--jobs", type=int, default=1000, help="arrivals to replay")
     p.add_argument("--seed", type=int, default=0,
                    help="workload seed: the same (mix, jobs, queues, seed) "
@@ -98,6 +104,35 @@ def main(argv: list[str] | None = None) -> int:
               "(parity replays both policies; run --explain separately)",
               file=sys.stderr)
         return 2
+    if args.mix in MARKET_MIXES:
+        # the capacity-market simulator (docs/scheduling.md "Capacity
+        # market"): fixed serve/train co-tenancy, seeded spike schedule,
+        # the live fund_demand/plan_growback passes. --jobs does not apply.
+        market_queues = queues if "serve" in queues else None
+        if args.memory == p.get_default("memory") and args.chips == 0:
+            # the fixed co-tenancy scenario needs a 16 GiB pool; the event
+            # mixes' 8 GiB default would be infeasible by construction
+            totals = (16 * GB, int(args.vcores), 0)
+        try:
+            report, recorder = run_market_mix(
+                args.mix, seed=args.seed, queues=market_queues, totals=totals,
+                drain_ms=args.drain_ms, min_runtime_ms=args.min_runtime_ms,
+                record_decisions=bool(args.explain),
+            )
+        except ValueError as e:
+            print(f"tony sim: {e}", file=sys.stderr)
+            return 2
+        print(render_market_report(report, as_json=args.json))
+        if args.explain and recorder is not None:
+            from tony_tpu.cli.explain import render_records
+
+            chain = [r.to_dict() for r in recorder.explain(args.explain)]
+            if chain:
+                print(f"\n{args.explain} decision chain (virtual clock, oldest first):")
+                print("\n".join(render_records(chain)))
+            else:
+                print(f"\n{args.explain}: no decision records in this replay")
+        return 0 if report.ok() else 1
     if args.parity:
         rc = 0
         for mix in MIXES:

@@ -371,6 +371,16 @@ class ApplicationMaster:
         self._jhist = obs_goodput.JhistFollower(self.events.intermediate_path)
         self._last_capacity_probe = 0.0
         self._capacity_short_since: float | None = None  # downsize hysteresis
+        # capacity market (tony.serve.market.enabled): while our allocation
+        # pends, publish the unmet deficit to the pool (update_demand) so the
+        # preemption policy can fund it by partially shrinking elastic
+        # borrowers; cleared the moment the gang places. Advisory: every
+        # failure degrades to silence, never to failing the AM.
+        self._market_enabled = config.get_bool(keys.SERVE_MARKET_ENABLED, False)
+        self._market_slo_ttft_ms = config.get_int(
+            keys.SERVE_MARKET_SLO_TTFT_MS, 2000)
+        self._market_published = False
+        self._last_market_publish = 0.0
         # guards (attempt, session) as one unit: RPC handlers capture both
         # atomically so a stale-attempt call can never touch a fresh session
         self._epoch_lock = obs_locktrace.make_lock(
@@ -1678,6 +1688,85 @@ class ApplicationMaster:
             return None
         return {et: target}
 
+    # -------------------------------------------- capacity market
+    def _publish_market_deficit(self) -> None:
+        """While our allocation pends, publish the unmet deficit to the
+        pool's capacity market (docs/scheduling.md "Capacity market"):
+        workers = unlaunched instances of the highest-priority pending type,
+        unit = its per-instance ask. The pool may fund it by partially
+        shrinking elastic borrowers; re-published every ~2s as the demand
+        heartbeat the pool's TTL watches. Advisory by design — any failure
+        degrades to silence."""
+        if not self._market_enabled or not hasattr(self.rm, "update_demand"):
+            return
+        now = time.monotonic()
+        if now - self._last_market_publish < 2.0:
+            return
+        self._last_market_publish = now
+        pending = [p for p in self.scheduler.plans.values() if not p.launched]
+        if not pending:
+            return
+        plan = min(pending, key=lambda p: p.priority)
+        # net deficit: instances this plan still needs beyond the containers
+        # it already holds — publishing the gross count would tax borrowers
+        # for capacity we are already sitting on
+        placed = sum(1 for c in self._containers.values()
+                     if c.job_type == plan.job_type)
+        deficit = max(plan.instances - placed, 0)
+        if deficit < 1:
+            return
+        if self.rm.update_demand(
+            deficit, plan.resources,
+            reason=(f"pending {plan.job_type} x{deficit}"
+                    f" (ttft slo {self._market_slo_ttft_ms}ms)"),
+        ):
+            self._market_published = True
+
+    def _clear_market_deficit(self) -> None:
+        """The gang placed (or is tearing down): retract our published
+        demand so the market stops taxing borrowers for a deficit that no
+        longer exists."""
+        if not self._market_published or not hasattr(self.rm, "update_demand"):
+            return
+        self._market_published = False
+        self._last_market_publish = 0.0
+        from tony_tpu.cluster.resources import Resources
+
+        self.rm.update_demand(0, Resources(), reason="placed")
+
+    def _handle_grow_offer(self, req_id: str, workers: int) -> None:
+        """A grow-back offer from the pool's capacity market (demand ebbed):
+        resize the elastic jobtype back up by the offered workers, capped by
+        ``tony.elastic.max-workers``. Acceptance is implicit — the resize
+        re-registers the grown demand with the pool, which settles this
+        gang's entry in the grow-back ledger; an offer we cannot use simply
+        expires pool-side (the debt stays booked)."""
+        self._drain_handled.add(req_id)  # offers re-send until resolved
+        et = self._elastic_jobtype()
+        cfg = self._effective_config()
+        if workers < 1 or et not in cfg.job_types():
+            return
+        current = cfg.instances(et)
+        target = current + workers
+        ceiling = self.config.get_int(keys.ELASTIC_MAX_WORKERS, 0)
+        if ceiling > 0:
+            target = min(target, ceiling)
+        if target <= current:
+            return
+        resize = {et: target}
+        reason = (f"capacity returned (grow-back {req_id}): "
+                  f"{et} {current}→{target}")
+        obs_logging.info(f"[tony-am] {reason}")
+        if not self._containers:
+            self._resize_while_queued(resize, reason, trigger="capacity")
+        else:
+            # budget-exempt like preemption: growing back is a cluster
+            # action, not a job failure
+            self._maybe_restart_gang(
+                reason, exit_code=constants.EXIT_PREEMPTED,
+                resize=resize, trigger="capacity",
+            )
+
     def _poll_preemption_notice(self) -> None:
         """Read the pool's cooperative-preemption piggyback (rode the
         ``poll_exited`` the monitor loop just made) and open a drain episode:
@@ -1714,6 +1803,10 @@ class ApplicationMaster:
             if self._drain is not None:
                 return  # one episode at a time; the pool re-sends until resolved
         mode = str(notice.get("mode") or "drain")
+        if mode == "grow":
+            # capacity market grow-back: no drain episode — a resize back up
+            self._handle_grow_offer(req_id, int(notice.get("grow_workers") or 0))
+            return
         deadline_s = max(int(notice.get("deadline_ms") or 0), 0) / 1000
         shrink_workers = int(notice.get("shrink_workers") or 0)
         resize = self._plan_drain_shrink(shrink_workers) if mode == "shrink" else None
@@ -2016,6 +2109,7 @@ class ApplicationMaster:
                 if self._queue_waiting:
                     self._queue_waiting = False
                     self.events.emit(EventType.QUEUE_WAIT, state="admitted")
+                    self._clear_market_deficit()
                     if self._queue_wait_started is not None:
                         waited_s = time.monotonic() - self._queue_wait_started
                         self._queue_wait_started = None
@@ -2033,6 +2127,9 @@ class ApplicationMaster:
                     self._queue_waiting = True
                     self._queue_wait_started = time.monotonic()
                     self.events.emit(EventType.QUEUE_WAIT, state="waiting", reason=str(e))
+                # capacity market: tell the pool what is missing so it can
+                # fund the wait by shrinking elastic borrowers (throttled)
+                self._publish_market_deficit()
                 # mid-wait elastic check (throttled): if capacity was lost
                 # for good while we queued, shrink instead of waiting forever
                 now = time.time()

@@ -1358,6 +1358,196 @@ class PreemptionPolicy(_PolicyCore):
         admit(cand)
         return True
 
+    # -------------------------------------------------- the capacity market
+    def fund_demand(
+        self,
+        world: WorldIndex,
+        totals: Vec,
+        free: list[int],
+        *,
+        app_id: str,
+        queue: str,
+        need: Vec,
+        grown_at: dict[str, float] | None = None,
+    ) -> Decision:
+        """Fund published demand by shrinking elastic borrowers.
+
+        The capacity-market half of partial reclaim (docs/scheduling.md
+        "Capacity market"): ``need`` is the deficit an ADMITTED queue head
+        published via ``update_demand`` — capacity it claims but cannot
+        place. Unlike the scheduling pass this never admits and never
+        evicts whole gangs: it only plans shrinks (the drain/urgent-
+        checkpoint contract the victims already honour) until ``free``
+        covers ``need``. Best-effort — a partial funding is committed
+        rather than discarded, because every shed worker is real capacity
+        the demander's retrying allocate can use.
+
+        The guards are the reclaim pass's own: victims walk the maintained
+        per-queue eviction order, only over-share queues pay, FLOOR
+        division keeps a shrink from digging its queue below its share,
+        min-runtime shields freshly-admitted apps, and disruptions charge
+        the demander queue's eviction budget. One new guard: ``grown_at``
+        (app → monotonic re-grow time, host-maintained) shields a gang the
+        grow-back pass just restored for the min-runtime window — the
+        spike→ebb→spike anti-thrash. Mutates ``world`` (``note_shrunk``)
+        and ``free`` in place exactly like the scheduling pass; the host
+        applies ``Decision.shrink`` through the normal drain machinery.
+        """
+        decision = Decision()
+        now = self.clock()
+        sink = self.sink
+        if sink is not None:
+            sink.begin_pass()
+        if self._fits(free, need):
+            return decision  # physical headroom already covers the deficit
+        primary = 2 if totals[2] > 0 else 0  # chips when the pool has chips
+        queue_used: dict[str, int] = {q: 0 for q in self.queues}
+        for q, qc in world.queue_claims.items():
+            if qc[primary]:
+                queue_used[q] = queue_used.get(q, 0) + qc[primary]
+        trial = list(free)
+        trial_used = dict(queue_used)
+        shrinks: dict[str, int] = {}          # app_id → workers to shed
+        barren: set[str] = set()              # slackless / unhelpful victims
+        shield_skips = drain_skips = 0
+        budget = self._budget_remaining(queue, now)
+        budget_hit = False
+        shield_s = self.min_runtime_ms / 1000.0
+        while not self._fits(trial, need):
+            if len(shrinks) >= budget:
+                budget_hit = True
+                break
+            # most over-share queue first (by primary-dimension excess)
+            best: tuple[float, AppView] | None = None
+            for q, share in self.queues.items():
+                if q == queue:
+                    continue
+                excess = trial_used.get(q, 0) - share * totals[primary]
+                if excess <= 0:
+                    continue  # at or under share: protected from the market
+                victim: AppView | None = None
+                for v in world.victims_iter(q):
+                    if v.app_id in shrinks or v.app_id in barren:
+                        continue
+                    if v.shrink_pending:
+                        drain_skips += 1
+                        continue
+                    if self._note_protected(v, now):
+                        shield_skips += 1
+                        continue
+                    if (grown_at is not None and shield_s > 0
+                            and v.app_id in grown_at
+                            and now - grown_at[v.app_id] < shield_s):
+                        shield_skips += 1
+                        continue
+                    if v.elastic_slack <= 0:
+                        continue  # rigid gang: the market never whole-evicts
+                    victim = v
+                    break
+                if victim is not None and (best is None or excess > best[0]):
+                    best = (excess, victim)
+            if best is None:
+                break  # no eligible borrower left: commit what we have
+            excess, v = best
+            unit = v.elastic_unit
+            deficit_dims = [
+                i for i in range(3) if unit[i] > 0 and need[i] - trial[i] > 0
+            ]
+            if not deficit_dims:
+                break  # remaining deficit is in dims no worker frees
+            deficit_k = max(
+                -(-(need[i] - trial[i]) // unit[i]) for i in deficit_dims
+            )
+            k = min(
+                v.elastic_slack,
+                deficit_k,
+                int(excess // unit[primary]) if unit[primary] > 0 else deficit_k,
+            )
+            if k < 1:
+                barren.add(v.app_id)  # a shed here frees nothing useful
+                continue
+            shrinks[v.app_id] = k
+            for i in range(3):
+                trial[i] += k * unit[i]
+            trial_used[v.queue] -= k * unit[primary]
+        if sink is not None and not self._fits(trial, need):
+            missing = [max(d - t, 0) for d, t in zip(need, trial)]
+            if budget_hit:
+                sink.note("deny", app_id, queue, "budget-exhausted",
+                          needed=len(shrinks) + 1, budget=self.eviction_budget,
+                          window_ms=self.budget_window_ms)
+            elif shield_skips:
+                sink.note("deny", app_id, queue, "demand-unfunded",
+                          missing=missing, protected_victims=shield_skips,
+                          min_runtime_ms=self.min_runtime_ms)
+            elif drain_skips:
+                sink.note("deny", app_id, queue, "demand-unfunded",
+                          missing=missing, draining_victims=drain_skips)
+            else:
+                sink.note("deny", app_id, queue, "demand-unfunded",
+                          missing=missing)
+        if not shrinks:
+            return decision
+        self._charge(queue, len(shrinks), now)
+        for victim_id, k in shrinks.items():
+            v = world.views[victim_id]
+            unit = v.elastic_unit
+            v.demand = tuple(max(d - k * u, 0) for d, u in zip(v.demand, unit))  # type: ignore[assignment]
+            v.elastic_slack -= k
+            v.shrink_pending = True
+            for i in range(3):
+                free[i] += k * unit[i]
+            decision.shrink.append(
+                Shrink(app_id=victim_id, workers=k, for_app=app_id))
+            if sink is not None:
+                sink.note("shrink", victim_id, v.queue, "demand-spike",
+                          for_app=app_id, workers=k)
+            world.note_shrunk(v)
+        return decision
+
+    def plan_growback(
+        self,
+        world: WorldIndex,
+        free: list[int],
+        shrunk: Iterable[tuple[str, int, Vec]],
+        *,
+        step: int = 0,
+    ) -> list[tuple[str, int]]:
+        """Return reclaimed capacity to shrunken borrowers once demand ebbs.
+
+        ``shrunk`` is the host's grow-back ledger, oldest shed first:
+        ``(app_id, workers_owed, per_worker_unit)``. Grants are bounded by
+        ``free`` (current physical headroom across every dimension a worker
+        occupies) and by ``step`` (max workers per app per pass; 0 = all
+        owed at once); the host applies the ebb hysteresis BEFORE calling.
+        Pure planning: a grant becomes a grow OFFER the borrower's AM
+        accepts by resizing up, and ``world`` is updated by the normal
+        re-register path when the gang actually grows — nothing here
+        mutates the index, only ``free``.
+        """
+        grants: list[tuple[str, int]] = []
+        sink = self.sink
+        noted_pass = False
+        for entry_id, owed, unit in shrunk:
+            v = world.views.get(entry_id)
+            if v is None or not v.admitted or owed < 1:
+                continue
+            k = owed if step < 1 else min(owed, step)
+            for i in range(3):
+                if unit[i] > 0:
+                    k = min(k, free[i] // unit[i])
+            if k < 1:
+                continue
+            for i in range(3):
+                free[i] -= k * unit[i]
+            grants.append((entry_id, k))
+            if sink is not None:
+                if not noted_pass:
+                    sink.begin_pass()
+                    noted_pass = True
+                sink.note("grow", entry_id, v.queue, "grow-back", workers=k)
+        return grants
+
 
 #: importable alias: the indexed implementation IS the default policy class
 IndexedPolicy = PreemptionPolicy

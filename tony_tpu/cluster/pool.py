@@ -84,6 +84,7 @@ POOL_RPC_METHODS = [
     "release",
     "release_all",
     "poll_exited",
+    "update_demand",
     "request_kill",
     "pool_status",
     "pool_explain",
@@ -131,6 +132,22 @@ _POOL_QUEUE_DENIALS = obs_metrics.counter(
     "blocked-head denials by binding rule (the flight recorder's deny "
     "records; docs/scheduling.md 'Explaining decisions')",
     labelnames=("queue", "rule"))
+# the serve/train capacity market (docs/scheduling.md "Capacity market")
+_POOL_QUEUE_PUBLISHED = obs_metrics.gauge(
+    "tony_pool_queue_published_demand",
+    "unmet demand admitted apps published via update_demand (capacity the "
+    "market is asked to fund), primary capacity dimension",
+    labelnames=("queue",))
+_POOL_MARKET_FUNDED = obs_metrics.counter(
+    "tony_pool_market_funded_workers_total",
+    "elastic workers shed to fund published demand (recorder rule "
+    "demand-spike), labeled by the shed borrower's queue",
+    labelnames=("queue",))
+_POOL_MARKET_GROWBACK = obs_metrics.counter(
+    "tony_pool_market_growback_workers_total",
+    "workers offered back to shrunken borrowers after demand ebbed "
+    "(recorder rule grow-back), labeled by the borrower's queue",
+    labelnames=("queue",))
 
 _RUNNING, _EXITED, _RELEASED = "RUNNING", "EXITED", "RELEASED"
 
@@ -269,6 +286,10 @@ class PoolService:
         preemption_min_runtime_ms: int = 0,
         preemption_budget: int = 0,
         preemption_budget_window_ms: int = 60_000,
+        demand_enabled: bool = True,
+        demand_ttl_ms: int = 60_000,
+        growback_ebb_ms: int = 30_000,
+        growback_step: int = 0,
         journal_path: str | None = None,
         journal_compact_every: int = 0,
         scheduler_indexed: bool = True,
@@ -357,6 +378,29 @@ class PoolService:
         # one-shot cancellation notices (drain victim re-admitted before it
         # yielded): app_id → req_id, delivered on the app's next poll
         self._cancelled: dict[str, str] = {}
+        # ---- the serve/train capacity market (tony.pool.demand.*,
+        # docs/scheduling.md "Capacity market"). All three ledgers are
+        # journaled so a restart mid-spike keeps the published demand and
+        # the debt owed to shrunken borrowers.
+        self.demand_enabled = demand_enabled
+        self.demand_ttl_ms = demand_ttl_ms
+        self.growback_ebb_ms = growback_ebb_ms
+        self.growback_step = growback_step
+        # app_id → published unmet demand {workers, unit, unix, mono}
+        self._demand: dict[str, dict[str, Any]] = {}
+        # grow-back ledger (workers the market took and still owes):
+        # app_id → {workers, unit, queue, since_unix}
+        self._shrunk: dict[str, dict[str, Any]] = {}
+        # in-flight grow offers awaiting the borrower's resize:
+        # app_id → {req_id, workers, expected_primary, deadline (monotonic)}
+        self._grows: dict[str, dict[str, Any]] = {}
+        # anti-thrash shield: app_id → monotonic instant of its last accepted
+        # grow-back (in-memory only: after a restart the budget still guards)
+        self._grown_at: dict[str, float] = {}
+        # when the LAST published deficit cleared (monotonic) — the grow-back
+        # ebb hysteresis measures from here; None while any demand is live
+        self._demand_quiet_since: float | None = time.monotonic()
+        self._grow_seq = itertools.count(1)
         self._lock = locktrace.make_lock("pool.PoolService._lock")
         # leaf serializer for the cluster-series file only — held across the
         # append so concurrent flushers don't interleave lines, never while
@@ -397,6 +441,9 @@ class PoolService:
                         self._containers = {}
                         self._app_exits = {}
                         self._drains = {}
+                        self._demand = {}
+                        self._shrunk = {}
+                        self._grows = {}
                         self._app_seq = itertools.count()
                         self._rebuild_derived_locked()
             self._journal = Journal(journal_path)
@@ -505,6 +552,28 @@ class PoolService:
             }
             if entry.get("reduced_demand"):
                 rec["reduced_demand"] = [int(x) for x in entry["reduced_demand"]]
+            if entry.get("origin"):
+                rec["origin"] = entry["origin"]
+                rec["for_app"] = entry.get("for_app", "")
+            recs.append(rec)
+        for app_id, d in self._demand.items():
+            recs.append({
+                "t": "demand", "app_id": app_id, "workers": d["workers"],
+                "unit": [int(x) for x in d["unit"]], "unix": d["unix"],
+            })
+        for app_id, s in self._shrunk.items():
+            rec = {
+                "t": "growback", "app_id": app_id, "workers": s["workers"],
+                "unit": [int(x) for x in s["unit"]], "queue": s["queue"],
+                "since_unix": s["since_unix"],
+            }
+            g = self._grows.get(app_id)
+            if g is not None:
+                rec["offer"] = {
+                    "req_id": g["req_id"], "workers": g["workers"],
+                    "expected_primary": g["expected_primary"],
+                    "deadline_unix": now_unix + (g["deadline"] - now_mono),
+                }
             recs.append(rec)
         return recs
 
@@ -523,6 +592,41 @@ class PoolService:
             wait_unix=app.wait_unix, admitted_unix=app.admitted_unix,
             elastic_unit=list(app.elastic_unit), elastic_slack=app.elastic_slack,
         )
+
+    def _journal_demand_locked(self, app_id: str) -> None:
+        """Full published-demand row (last record wins on replay; workers=0
+        clears) — written whenever an app's published deficit CHANGES."""
+        d = self._demand.get(app_id)
+        if d is None:
+            self._jlog_locked("demand", app_id=app_id, workers=0)
+        else:
+            self._jlog_locked(
+                "demand", app_id=app_id, workers=d["workers"],
+                unit=[int(x) for x in d["unit"]], unix=d["unix"],
+            )
+
+    def _journal_growback_locked(self, app_id: str) -> None:
+        """Full grow-back ledger row for ``app_id`` — workers owed plus any
+        in-flight grow offer (last record wins on replay; workers=0 settles
+        the debt and drops the offer)."""
+        s = self._shrunk.get(app_id)
+        if s is None:
+            self._jlog_locked("growback", app_id=app_id, workers=0)
+            return
+        rec: dict[str, Any] = dict(
+            app_id=app_id, workers=s["workers"],
+            unit=[int(x) for x in s["unit"]], queue=s["queue"],
+            since_unix=s["since_unix"],
+        )
+        g = self._grows.get(app_id)
+        if g is not None:
+            now_mono, now_unix = time.monotonic(), time.time()
+            rec["offer"] = {
+                "req_id": g["req_id"], "workers": g["workers"],
+                "expected_primary": g["expected_primary"],
+                "deadline_unix": now_unix + (g["deadline"] - now_mono),
+            }
+        self._jlog_locked("growback", **rec)
 
     def _recover_from_journal_locked(self, records) -> None:
         """Rebuild apps/containers/undelivered-exits from the journal (any
@@ -556,6 +660,9 @@ class PoolService:
                 self._containers.clear()
                 self._app_exits.clear()
                 self._drains.clear()
+                self._demand.clear()
+                self._shrunk.clear()
+                self._grows.clear()
                 max_seq = -1
             elif t == "app":
                 wait_unix = float(rec.get("wait_unix") or now_unix)
@@ -586,6 +693,9 @@ class PoolService:
             elif t == "app_removed":
                 self._apps.pop(str(rec["app_id"]), None)
                 self._app_exits.pop(str(rec["app_id"]), None)
+                self._demand.pop(str(rec["app_id"]), None)
+                self._shrunk.pop(str(rec["app_id"]), None)
+                self._grows.pop(str(rec["app_id"]), None)
             elif t == "container":
                 crec = dict(rec["rec"])
                 crec.pop("seen_live", None)  # must be re-observed by a live agent
@@ -625,9 +735,53 @@ class PoolService:
                     "deadline": rebase(float(rec.get("deadline_unix") or now_unix)),
                     "t0": rebase(float(rec.get("t0_unix") or now_unix)),
                     "escalated": False,
+                    "origin": str(rec.get("origin", "sched")),
+                    "for_app": str(rec.get("for_app", "")),
                 }
             elif t == "drain_done":
                 self._drains.pop(str(rec["app_id"]), None)
+            elif t == "demand":
+                # published unmet demand (capacity market): last record wins,
+                # workers=0 clears. The publish instant is journaled as wall
+                # clock and rebased so the TTL expiry survives the restart.
+                app_id = str(rec["app_id"])
+                workers = int(rec.get("workers", 0))
+                if workers <= 0:
+                    self._demand.pop(app_id, None)
+                else:
+                    unix = float(rec.get("unix") or now_unix)
+                    self._demand[app_id] = {
+                        "workers": workers,
+                        "unit": tuple(int(x) for x in (rec.get("unit") or (0, 0, 0))),
+                        "unix": unix,
+                        "mono": rebase(unix) or now_mono,
+                    }
+            elif t == "growback":
+                # grow-back ledger + any in-flight grow offer: last record
+                # wins, workers=0 settles the debt. Offer deadlines rebase
+                # like drain deadlines — retraction must still fire.
+                app_id = str(rec["app_id"])
+                workers = int(rec.get("workers", 0))
+                if workers <= 0:
+                    self._shrunk.pop(app_id, None)
+                    self._grows.pop(app_id, None)
+                else:
+                    self._shrunk[app_id] = {
+                        "workers": workers,
+                        "unit": tuple(int(x) for x in (rec.get("unit") or (0, 0, 0))),
+                        "queue": str(rec.get("queue", "")),
+                        "since_unix": float(rec.get("since_unix") or now_unix),
+                    }
+                    offer = rec.get("offer")
+                    if offer:
+                        self._grows[app_id] = {
+                            "req_id": str(offer.get("req_id", "")),
+                            "workers": int(offer.get("workers", 0)),
+                            "expected_primary": int(offer.get("expected_primary", 0)),
+                            "deadline": rebase(float(offer.get("deadline_unix") or now_unix)),
+                        }
+                    else:
+                        self._grows.pop(app_id, None)
             else:
                 raise JournalError(f"unknown pool journal record type {t!r}")
         self._app_seq = itertools.count(max_seq + 1)
@@ -857,6 +1011,24 @@ class PoolService:
             app.demand_chips = int(chips)
             app.elastic_unit = tuple(int(x) for x in (elastic_unit or (0, 0, 0)))
             app.elastic_slack = max(int(elastic_slack), 0)
+            grow = self._grows.get(app_id)
+            if grow is not None and app.admitted:
+                primary = 2 if self._totals_locked()[2] > 0 else 0
+                new_primary = (app.demand_memory, app.demand_vcores,
+                               app.demand_chips)[primary]
+                if new_primary >= grow["expected_primary"]:
+                    # the borrower ACCEPTED the grow offer by re-registering
+                    # its grown demand: settle that much of the owed debt and
+                    # shield it from the market for the min-runtime window
+                    self._grows.pop(app_id, None)
+                    self._grown_at[app_id] = time.monotonic()
+                    owed = self._shrunk.get(app_id)
+                    if owed is not None:
+                        owed["workers"] -= grow["workers"]
+                        if owed["workers"] <= 0:
+                            self._shrunk.pop(app_id, None)
+                    self._journal_growback_locked(app_id)
+                    _POOL_MARKET_GROWBACK.inc(grow["workers"], queue=app.queue)
             self._world_upsert_locked(app)
             self._schedule_locked()
             self._journal_app_locked(app)
@@ -1089,6 +1261,15 @@ class PoolService:
                 # the app left the pool mid-drain (finished, or torn down):
                 # the episode is over either way
                 self._jlog_locked("drain_done", app_id=app_id)
+            # the market forgets a departed app entirely: its published
+            # demand, any debt owed to it, and any open grow offer
+            if self._demand.pop(app_id, None) is not None:
+                self._jlog_locked("demand", app_id=app_id, workers=0)
+            if (self._shrunk.pop(app_id, None) is not None
+                    or self._grows.pop(app_id, None) is not None):
+                self._grows.pop(app_id, None)
+                self._jlog_locked("growback", app_id=app_id, workers=0)
+            self._grown_at.pop(app_id, None)
             self._jlog_locked("app_removed", app_id=app_id)
             self._schedule_locked()
         self._journal_sync()  # removal durable before the AM tears down
@@ -1108,6 +1289,56 @@ class PoolService:
             out: dict[str, Any] = exits if not with_preempt else {
                 "exits": exits, "preempt": self._preempt_notice_locked(app_id)}
         self._journal_sync()  # "polled" durable before the AM consumes exits
+        return out
+
+    def update_demand(
+        self,
+        app_id: str,
+        workers: int,
+        unit: list[int] | None = None,
+        reason: str = "",
+    ) -> dict[str, Any]:
+        """The capacity market's demand bridge (docs/scheduling.md "Capacity
+        market"): an ADMITTED app publishes the replicas it wants but cannot
+        place — ``workers`` each occupying ``unit`` — as live queue demand.
+        ``workers=0`` clears. The deficit is journaled like every pool
+        mutation (a restart mid-spike keeps it), folded into the queue's
+        ``tony_pool_queue_demand`` series, and — with preemption on — funded
+        immediately by shrinking over-share elastic borrowers
+        (:meth:`_fund_demand_locked`, recorder rule ``demand-spike``); the
+        liveness tick retries while the deficit persists and TTL-expires a
+        publisher that went quiet (``tony.pool.demand.ttl-ms``)."""
+        workers = max(int(workers), 0)
+        u = tuple(int(x) for x in (unit or (0, 0, 0)))
+        with self._lock:
+            app = self._apps.get(app_id)
+            if app is None:
+                return {"ack": False, "unknown_app": True}
+            if not self.demand_enabled:
+                return {"ack": False, "disabled": True}
+            funded = 0
+            prev = self._demand.get(app_id)
+            if workers <= 0:
+                if prev is not None:
+                    self._demand.pop(app_id, None)
+                    self._journal_demand_locked(app_id)
+            else:
+                if (prev is None or prev["workers"] != workers
+                        or tuple(prev["unit"]) != u):
+                    self._demand[app_id] = {
+                        "workers": workers, "unit": u,
+                        "unix": time.time(), "mono": time.monotonic(),
+                    }
+                    self._journal_demand_locked(app_id)
+                else:
+                    # refreshed, not changed: bump the TTL clock without
+                    # journal churn — the TTL already tolerates a restart
+                    # restoring the older publish instant
+                    prev["unix"], prev["mono"] = time.time(), time.monotonic()
+                funded = self._fund_demand_locked(app_id)
+            self._maintain_quiet_clock_locked()
+            out = {"ack": True, "funded_workers": funded}
+        self._journal_sync()  # the deficit is durable before the AM backs off
         return out
 
     def request_kill(self, container_id: str) -> dict[str, Any]:
@@ -1193,6 +1424,25 @@ class PoolService:
                 "preemption": self.preemption,
                 "scheduler": "indexed" if self._world is not None else "reference",
                 "drains_active": len(self._drains),
+                # the capacity market's live ledgers (docs/scheduling.md
+                # "Capacity market"): published deficits, debt owed to
+                # shrunken borrowers, grow offers awaiting acceptance
+                "market": {
+                    "demand": {
+                        a: {"workers": d["workers"], "unit": list(d["unit"]),
+                            "age_s": round(max(now - d["mono"], 0.0), 3)}
+                        for a, d in self._demand.items()
+                    },
+                    "shrunk": {
+                        a: {"workers": s["workers"], "queue": s["queue"]}
+                        for a, s in self._shrunk.items()
+                    },
+                    "grows": {
+                        a: {"workers": g["workers"],
+                            "deadline_s": round(g["deadline"] - now, 3)}
+                        for a, g in self._grows.items()
+                    },
+                },
             }
 
     # --------------------------------------- flight recorder & telemetry
@@ -1234,11 +1484,23 @@ class PoolService:
                 waiting_claims.setdefault(a.queue, []).append(c)
                 age = max(now - a.wait_since, 0.0)
                 oldest[a.queue] = max(oldest.get(a.queue, 0.0), age)
+        published: dict[str, float] = {}
+        for app_id, d in self._demand.items():
+            app = self._apps.get(app_id)
+            if app is not None:
+                published[app.queue] = (
+                    published.get(app.queue, 0.0)
+                    + d["workers"] * d["unit"][primary]
+                )
         for q, share in self.queues.items():
             out[q] = {
                 "used": used.get(q, 0.0),
                 "share_capacity": float(int(share * totals[primary])),
-                "demand": sum(waiting_claims.get(q, ())),
+                # published deficits ARE live queue demand (the capacity
+                # market's bridge): folding them here makes a serve spike
+                # visible in tony_pool_queue_demand and cluster_series even
+                # though the demanding app is admitted, not waiting
+                "demand": sum(waiting_claims.get(q, ())) + published.get(q, 0.0),
                 "waiting": float(len(waiting_claims.get(q, ()))),
                 "wait_age_s": round(oldest.get(q, 0.0), 3),
             }
@@ -1258,12 +1520,21 @@ class PoolService:
         primary = 2 if totals[2] > 0 else 0
         now = time.monotonic()
         sample = self._queue_sample_locked(now, totals, primary)
+        published: dict[str, float] = {}
+        for app_id, d in self._demand.items():
+            app = self._apps.get(app_id)
+            if app is not None:
+                published[app.queue] = (
+                    published.get(app.queue, 0.0)
+                    + d["workers"] * d["unit"][primary]
+                )
         for q, s in sample.items():
             _POOL_QUEUE_USED.set(s["used"], queue=q)
             _POOL_QUEUE_SHARE_CAPACITY.set(s["share_capacity"], queue=q)
             _POOL_QUEUE_DEMAND.set(s["demand"], queue=q)
             _POOL_QUEUE_WAITING.set(s["waiting"], queue=q)
             _POOL_QUEUE_WAIT_AGE.set(s["wait_age_s"], queue=q)
+            _POOL_QUEUE_PUBLISHED.set(published.get(q, 0.0), queue=q)
         counters = self.recorder.queue_counters if self.recorder is not None else {}
         self._telemetry.sample(sample, counters=counters)
         return self._telemetry.drain_finalized()
@@ -1582,12 +1853,17 @@ class PoolService:
                 self._request_kill_locked(rec)
             _POOL_PREEMPTIONS.inc(mode="kill")
 
-    def _apply_shrink_locked(self, sh) -> None:
+    def _apply_shrink_locked(self, sh, *, origin: str = "sched") -> None:
         """Partial reclaim: reduce the victim's registered demand by the
         shed workers' resources and ask its AM (through the poll path) to
         shrink the elastic jobtype by K. The freed claim funds the head
         admitted in the same pass; escalation whole-gang-evicts at the
-        deadline if the AM never sheds."""
+        deadline if the AM never sheds.
+
+        ``origin`` tags the episode's provenance: ``"sched"`` (the normal
+        scheduling pass) or ``"demand"`` (the capacity market funding
+        published demand) — a demand-origin shed that lands cooperatively
+        books the workers into the grow-back ledger."""
         v = self._apps[sh.app_id]
         self._cancelled.pop(v.app_id, None)  # superseded by the new episode
         unit = v.elastic_unit
@@ -1614,6 +1890,7 @@ class PoolService:
                             sh.workers * unit[2]],
             "reduced_demand": [v.demand_memory, v.demand_vcores, v.demand_chips],
             "deadline": now + drain_s, "t0": now, "escalated": False,
+            "origin": origin, "for_app": sh.for_app,
         }
         self._drains[v.app_id] = entry
         self._world_upsert_locked(v)
@@ -1624,10 +1901,142 @@ class PoolService:
             undo_demand=list(entry["undo_demand"]),
             reduced_demand=list(entry["reduced_demand"]),
             deadline_unix=time.time() + drain_s, t0_unix=time.time(),
+            origin=origin, for_app=sh.for_app,
         )
         obs_logging.info(
             f"[tony-pool] asking {v.app_id} to shrink by {sh.workers} elastic "
             f"worker(s) for {sh.for_app} (partial reclaim, deadline {drain_s:.0f}s)")
+
+    # ------------------------------------------------ the capacity market
+    def _phys_free_locked(self) -> list[int]:
+        """Aggregate physical headroom over alive nodes — the funding pass's
+        target: a published deficit is met when this covers it (placement
+        granularity is the allocate retry's problem, not the market's)."""
+        free = [0, 0, 0]
+        for n in self._nodes.values():
+            if n.alive:
+                free[0] += n.memory_bytes - n.used_memory
+                free[1] += n.vcores - n.used_vcores
+                free[2] += len(n.free_chips)
+        return free
+
+    def _maintain_quiet_clock_locked(self) -> None:
+        """The grow-back hysteresis clock: running while NO deficit is
+        published, reset by any live demand — spike→ebb→spike cannot thrash
+        because grow-back waits a full quiet window each time."""
+        if self._demand:
+            self._demand_quiet_since = None
+        elif self._demand_quiet_since is None:
+            self._demand_quiet_since = time.monotonic()
+
+    def _fund_demand_locked(self, app_id: str) -> int:
+        """One funding pass for ``app_id``'s published deficit: shed elastic
+        workers from over-share borrowers (policy ``fund_demand``, recorder
+        rule ``demand-spike``) until physical free capacity covers it.
+        Returns workers newly asked to shed; the caller journal-syncs."""
+        if (not self.demand_enabled or not self.preemption
+                or self._world is None):
+            return 0
+        d = self._demand.get(app_id)
+        app = self._apps.get(app_id)
+        if d is None or app is None or not app.admitted:
+            return 0
+        need = [d["workers"] * u for u in d["unit"]]
+        # subtract capacity already being freed by in-flight demand-origin
+        # sheds: funding is once per deficit, never once per retry tick —
+        # otherwise a 2-worker deficit re-funds every tick of the
+        # multi-second drain and strips the borrowers bare
+        for entry in self._drains.values():
+            if entry.get("origin") == "demand" and not entry["escalated"]:
+                pending = entry.get("undo_demand") or (0, 0, 0)
+                for i in range(3):
+                    need[i] -= int(pending[i])
+        need = tuple(max(x, 0) for x in need)
+        if not any(need):
+            return 0
+        decision = self._policy.fund_demand(
+            self._world, self._totals_locked(), self._phys_free_locked(),
+            app_id=app_id, queue=app.queue, need=need,
+            grown_at=self._grown_at,
+        )
+        funded = 0
+        for sh in decision.shrink:
+            self._apply_shrink_locked(sh, origin="demand")
+            funded += sh.workers
+            victim = self._apps.get(sh.app_id)
+            _POOL_MARKET_FUNDED.inc(
+                sh.workers, queue=victim.queue if victim is not None else "")
+        return funded
+
+    def _market_tick_locked(self, now: float) -> None:
+        """The liveness tick's market maintenance: TTL-expire stale
+        published demand, retry funding for deficits that persist, retract
+        unaccepted grow offers, and — once demand has ebbed for the full
+        hysteresis window — offer reclaimed capacity back to the oldest
+        shrunken borrowers (policy ``plan_growback``, rule ``grow-back``)."""
+        if not self.demand_enabled:
+            return
+        ttl_s = self.demand_ttl_ms / 1000
+        for app_id, d in list(self._demand.items()):
+            if ttl_s > 0 and now - d["mono"] > ttl_s:
+                # publisher went quiet (crashed mid-spike, or ebbed without
+                # clearing): stale demand must not keep taxing borrowers
+                self._demand.pop(app_id, None)
+                self._journal_demand_locked(app_id)
+            else:
+                self._fund_demand_locked(app_id)
+        self._maintain_quiet_clock_locked()
+        # retract offers the borrower never accepted (its AM crashed or is
+        # mid-rebuild): the debt stays booked, a later pass re-offers
+        for app_id, g in list(self._grows.items()):
+            if now >= g["deadline"]:
+                self._grows.pop(app_id, None)
+                self._journal_growback_locked(app_id)
+        quiet = self._demand_quiet_since
+        if (quiet is None or not self._shrunk or self._world is None
+                or now - quiet < self.growback_ebb_ms / 1000):
+            return
+        free = self._phys_free_locked()
+        # offers in flight hold their capacity out of the pool: subtract so
+        # two passes can never promise the same free space twice
+        for app_id, g in self._grows.items():
+            v = self._apps.get(app_id)
+            unit = v.elastic_unit if v is not None else (0, 0, 0)
+            for i in range(3):
+                free[i] -= g["workers"] * unit[i]
+        ledger = sorted(
+            (
+                (app_id, s["workers"], tuple(s["unit"]))
+                for app_id, s in self._shrunk.items()
+                if app_id not in self._grows
+                and app_id not in self._drains
+                and app_id not in self._cancelled
+            ),
+            key=lambda e: self._shrunk[e[0]]["since_unix"],
+        )
+        if not ledger:
+            return
+        primary = 2 if self._totals_locked()[2] > 0 else 0
+        grants = self._policy.plan_growback(
+            self._world, free, ledger, step=self.growback_step)
+        for app_id, k in grants:
+            app = self._apps.get(app_id)
+            if app is None:
+                continue
+            unit = self._shrunk[app_id]["unit"]
+            expected = (app.demand_memory + k * unit[0],
+                        app.demand_vcores + k * unit[1],
+                        app.demand_chips + k * unit[2])[primary]
+            self._grows[app_id] = {
+                "req_id": f"grow-{next(self._grow_seq)}-{uuid.uuid4().hex[:6]}",
+                "workers": k,
+                "expected_primary": expected,
+                "deadline": now + max(self.growback_ebb_ms, 30_000) / 1000,
+            }
+            self._journal_growback_locked(app_id)
+            obs_logging.info(
+                f"[tony-pool] offering {app_id} {k} worker(s) back "
+                "(grow-back: demand ebbed)")
 
     # ------------------------------------------------ drain lifecycle
     def _preempt_notice_locked(self, app_id: str) -> dict[str, Any] | None:
@@ -1648,6 +2057,17 @@ class PoolService:
         req_id = self._cancelled.get(app_id)
         if req_id is not None:
             return {"cancelled": req_id}
+        grow = self._grows.get(app_id)
+        if grow is not None:
+            # grow-back offer (capacity market): demand ebbed, the pool
+            # invites this shrunken borrower to resize back up. Accepted by
+            # the AM re-registering grown demand; retracted at the deadline.
+            return {
+                "req_id": grow["req_id"],
+                "mode": "grow",
+                "deadline_ms": max(int((grow["deadline"] - time.monotonic()) * 1000), 0),
+                "grow_workers": grow["workers"],
+            }
         return None
 
     def _resolve_drain_locked(self, app_id: str, *, mode: str) -> None:
@@ -1657,6 +2077,20 @@ class PoolService:
         app = self._apps.get(app_id)
         if app is not None:
             self._world_upsert_locked(app)  # shrink_pending cleared
+            if mode == "shrink" and entry.get("origin") == "demand":
+                # a market-funded shed LANDED: book the debt — these workers
+                # come back through the grow-back pass when demand ebbs
+                s = self._shrunk.get(app_id)
+                if s is None:
+                    self._shrunk[app_id] = {
+                        "workers": int(entry.get("workers", 0)),
+                        "unit": tuple(app.elastic_unit),
+                        "queue": app.queue,
+                        "since_unix": time.time(),
+                    }
+                else:
+                    s["workers"] += int(entry.get("workers", 0))
+                self._journal_growback_locked(app_id)
         self._jlog_locked("drain_done", app_id=app_id)
         _POOL_PREEMPTIONS.inc(mode=mode)
         if mode in ("drain", "shrink"):
@@ -1818,6 +2252,9 @@ class PoolService:
                 # cooperative-drain deadline enforcement: victims that never
                 # yielded/shed get the classic kill path
                 self._escalate_drains_locked()
+                # the capacity market's maintenance: demand TTL + funding
+                # retries + grow-back once demand has ebbed long enough
+                self._market_tick_locked(now)
                 # per-queue telemetry sample (~1 Hz, whatever the heartbeat
                 # cadence): gauges + the cluster_series window ring
                 if self._telemetry is not None and now >= self._telemetry_next:
@@ -1849,6 +2286,9 @@ class RemoteResourceManager(ResourceManager):
         # pre-drain pool service: rejects the cooperative-preemption kwargs
         # with a TypeError error frame — detected once, then spoken legacy
         self._legacy_pool = False
+        # pre-market pool service: no update_demand RPC — detected once,
+        # then the demand bridge goes silent (it is advisory by design)
+        self._market_unsupported = False
         self._lock = locktrace.make_lock("pool.RemoteResourceManager._lock")
 
     def _agent(self, addr: tuple[str, int]) -> RpcClient:
@@ -2092,6 +2532,30 @@ class RemoteResourceManager(ResourceManager):
         with self._lock:
             return self._preempt_notice
 
+    def update_demand(
+        self, workers: int, unit: Resources, reason: str = "",
+    ) -> bool:
+        """Publish this app's unmet replica deficit — ``workers`` each
+        needing ``unit`` — to the pool's capacity market (``workers=0``
+        clears it). Advisory by design: any failure degrades to silence,
+        never to failing the AM; a pool without the RPC is detected once
+        and never called again."""
+        if self._market_unsupported:
+            return False
+        try:
+            out = self.rm.call(
+                "update_demand", app_id=self.app_id, workers=int(workers),
+                unit=[unit.memory_bytes, unit.vcores, unit.chips],
+                reason=reason,
+            )
+        except RpcError as e:
+            if self._is_unknown_kwarg(e) or "unknown method" in str(e):
+                self._market_unsupported = True
+            return False
+        except OSError:
+            return False
+        return bool(isinstance(out, dict) and out.get("ack"))
+
     def kill_container(self, container: Container) -> None:
         with self._lock:
             entry = self._containers.get(container.id)
@@ -2163,6 +2627,10 @@ def main(argv: list[str] | None = None) -> int:
         preemption_min_runtime_ms=config.get_time_ms(keys.POOL_PREEMPTION_MIN_RUNTIME_MS, 0),
         preemption_budget=config.get_int(keys.POOL_PREEMPTION_BUDGET, 0),
         preemption_budget_window_ms=config.get_time_ms(keys.POOL_PREEMPTION_BUDGET_WINDOW_MS, 60_000),
+        demand_enabled=config.get_bool(keys.POOL_DEMAND_ENABLED, True),
+        demand_ttl_ms=config.get_time_ms(keys.POOL_DEMAND_TTL_MS, 60_000),
+        growback_ebb_ms=config.get_time_ms(keys.POOL_DEMAND_GROWBACK_EBB_MS, 30_000),
+        growback_step=config.get_int(keys.POOL_DEMAND_GROWBACK_STEP, 0),
         journal_path=args.journal_file
         if args.journal_file is not None
         else (config.get(keys.POOL_JOURNAL_FILE) or None),

@@ -59,7 +59,12 @@ from typing import Any, Callable, Iterable
 ADMIT_RULES = ("fits-free", "priority-preemption", "share-reclaim")
 #: rules an EVICT / SHRINK record may carry: which preemption path chose it
 EVICT_RULES = ("priority-preemption", "share-reclaim", "drain-escalated")
-SHRINK_RULES = ("partial-reclaim",)
+SHRINK_RULES = (
+    "partial-reclaim",      # schedule_world: funding a waiting queue head
+    "demand-spike",         # capacity market: funding published serve demand
+)
+#: rules a GROW record may carry: why reclaimed capacity went back
+GROW_RULES = ("grow-back",)  # capacity market: demand ebbed, restore borrower
 #: rules a DENY record may carry: the one guard that blocked a queue head
 DENY_RULES = (
     "pool-empty",           # no capacity registered at all — everything waits
@@ -72,6 +77,7 @@ DENY_RULES = (
     "no-eligible-victims",  # no over-share borrower (or lower-priority app) to reclaim from
     "no-rect-placement",    # admitted, but no single host can form the chip rectangle
     "behind-queue-head",    # not this app's turn: it waits behind its queue's head
+    "demand-unfunded",      # published serve demand the market could not (fully) fund
 )
 
 
@@ -82,7 +88,7 @@ class DecisionRecord:
     seq: int                 # monotone record number (ring-global)
     pass_id: int             # scheduling pass that produced it
     unix_ms: int             # recorder-clock milliseconds
-    action: str              # "admit" | "evict" | "shrink" | "deny"
+    action: str              # "admit" | "evict" | "shrink" | "grow" | "deny"
     app_id: str
     queue: str
     rule: str                # the binding rule (vocabulary above)
@@ -226,7 +232,8 @@ class FlightRecorder:
 WINDOW_METRICS = (
     "used_avg", "used_max", "share_capacity", "utilization_avg",
     "demand_avg", "demand_max", "waiting_avg", "waiting_max",
-    "wait_age_max_s", "admissions", "evictions", "shrinks", "denials",
+    "wait_age_max_s", "admissions", "evictions", "shrinks", "growbacks",
+    "denials",
 )
 
 
@@ -330,7 +337,7 @@ class QueueTelemetry:
         n = max(w.samples, 1)
         delta = {
             k: w.counters.get(k, 0) - w.counters0.get(k, 0)
-            for k in ("admit", "evict", "shrink", "deny")
+            for k in ("admit", "evict", "shrink", "grow", "deny")
         }
         self._finalized.append({
             "queue": w.queue,
@@ -350,6 +357,7 @@ class QueueTelemetry:
                 "admissions": delta["admit"],
                 "evictions": delta["evict"],
                 "shrinks": delta["shrink"],
+                "growbacks": delta["grow"],
                 "denials": delta["deny"],
             },
         })

@@ -544,6 +544,13 @@ GB = 1024 ** 3
 
 MIXES = ("batch", "bursty", "elastic", "priority")
 
+#: capacity-market mixes run through :class:`MarketSimulator`, not
+#: :class:`PoolSimulator` — deliberately NOT in ``MIXES``: the parity
+#: contract (tony sim --parity, tests/test_policy_parity.py) replays MIXES
+#: through both policy implementations, and the market passes
+#: (fund_demand / plan_growback) are indexed-only by design.
+MARKET_MIXES = ("serve-train",)
+
 
 def generate_jobs(
     mix: str, n: int, queues: dict[str, float], seed: int
@@ -631,6 +638,403 @@ def run_mix(
         policy_impl=policy_impl,
     )
     return sim.run(generate_jobs(mix, n, queues, seed))
+
+
+# ---------------------------------------------------------------------------
+# the serve/train capacity market (tony sim --mix serve-train)
+# ---------------------------------------------------------------------------
+@dataclass
+class MarketSpike:
+    """One serve traffic spike: the autoscaler wants ``replicas`` extra
+    replicas from ``start_s`` until ``end_s``."""
+
+    start_s: float
+    end_s: float
+    replicas: int
+    funded_at: float | None = None     # first instant the whole deficit placed
+
+
+@dataclass
+class MarketReport:
+    """What a seeded serve-train market run proved (or violated)."""
+
+    seed: int
+    spikes: int = 0
+    shed_workers: int = 0              # workers shed under rule demand-spike
+    growback_workers: int = 0          # workers returned under rule grow-back
+    evictions: int = 0                 # whole-gang evictions — MUST stay 0
+    max_fund_latency_s: float = 0.0    # slowest spike start → fully placed
+    badput_fraction: float = 0.0       # gang seconds lost to shed/grow churn
+    restored_all: bool = False         # every gang back at full size by the end
+    wall_s: float = 0.0
+    violations: list[str] = field(default_factory=list)
+
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self.__dict__)
+
+
+class MarketSimulator:
+    """The serve/train capacity market on a virtual clock.
+
+    Co-tenants one serve head (queue ``serve``) with elastic training gangs
+    (queue ``train``, borrowing over their share) on a fixed pool, then
+    replays a seeded spike schedule through the EXACT market passes the
+    live pool runs — :meth:`PreemptionPolicy.fund_demand` when the serve
+    deficit is published and :meth:`PreemptionPolicy.plan_growback` once
+    demand has ebbed for the hysteresis window — with the same physics the
+    event simulator uses: a funded shed frees physical capacity only after
+    the drain lands, a grow-back is a gang rebuild, and every disruption is
+    metered as badput. The invariants it asserts are the market's contract
+    (docs/scheduling.md "Capacity market"):
+
+    - **SLO-capacity** — every spike's deficit is fully placed within
+      drain + a few decision ticks, and never clawed back mid-spike;
+    - **zero evictions** — the market only ever shrinks; no training gang
+      is whole-gang evicted, and none digs below its elastic floor;
+    - **bounded badput** — gang seconds lost to shed/grow churn stay under
+      a fraction of total gang seconds;
+    - **gangs restored** — after the final ebb, every gang is offered its
+      shed workers back and returns to full size within the ebb window
+      plus one rebuild.
+    """
+
+    def __init__(
+        self,
+        queues: dict[str, float] | None = None,
+        totals: Vec = (16 * GB, 256, 0),
+        *,
+        seed: int = 0,
+        drain_s: float = 5.0,           # shed decision → capacity actually free
+        rebuild_s: float = 2.0,         # gang restart cost (shed land / grow land)
+        coop_yield_s: float = 1.0,      # urgent-checkpoint + yield latency
+        ebb_s: float = 20.0,            # grow-back hysteresis (quiet window)
+        growback_step: int = 0,         # workers per grow offer (0 = all owed)
+        min_runtime_ms: int = 3_000,
+        eviction_budget: int = 0,
+        budget_window_ms: int = 60_000,
+        record_decisions: bool = False,
+    ):
+        self.now = 0.0
+        self.queues = dict(queues or {"serve": 0.7, "train": 0.3})
+        self.totals = totals
+        self.seed = seed
+        self.drain_s = drain_s
+        self.rebuild_s = rebuild_s
+        self.coop_yield_s = coop_yield_s
+        self.ebb_s = ebb_s
+        self.growback_step = growback_step
+        self.policy = make_policy(
+            "indexed", self.queues, preemption=True, grace_ms=0,
+            min_runtime_ms=min_runtime_ms, eviction_budget=eviction_budget,
+            budget_window_ms=budget_window_ms, clock=lambda: self.now,
+        )
+        self.world = WorldIndex()
+        self.recorder: FlightRecorder | None = None
+        if record_decisions:
+            self.recorder = FlightRecorder(clock=lambda: self.now)
+            self.policy.sink = self.recorder
+        self.report = MarketReport(seed=seed)
+
+    # ------------------------------------------------------------- plumbing
+    def _phys_free(self) -> list[int]:
+        used = [0, 0, 0]
+        for v in self.world.views.values():
+            for i in range(3):
+                used[i] += v.held[i]
+        return [t - u for t, u in zip(self.totals, used)]
+
+    # ------------------------------------------------------------ lifecycle
+    def run(
+        self,
+        *,
+        gangs: int = 2,
+        gang_workers: int = 5,
+        gang_floor: int = 2,
+        serve_base: int = 2,
+        n_spikes: int = 3,
+    ) -> MarketReport:
+        rng = random.Random(
+            (zlib.crc32(b"serve-train") & 0xFFFF) * 1_000_003 + self.seed)
+        rep = self.report
+        # feasibility: the fixed co-tenancy (gangs at full size + serve base
+        # + the largest possible spike funded by every shed) must fit the
+        # pool, or the invariants are violated by arithmetic, not by policy
+        worst = (
+            gangs * gang_floor * GB                      # gangs at their floors
+            + (serve_base + 4) * 2 * GB                  # serve at max spike
+        )
+        if worst > self.totals[0]:
+            raise ValueError(
+                f"pool too small for the market scenario: needs "
+                f">= {worst / GB:.0f} GiB memory, has {self.totals[0] / GB:.0f}")
+        # seeded spike schedule: bursts spaced so each has room to fund,
+        # ebb, and grow back before the next one tests the thrash guards
+        spikes: list[MarketSpike] = []
+        t = rng.uniform(20, 40)
+        for _ in range(n_spikes):
+            dur = rng.uniform(30, 60)
+            spikes.append(MarketSpike(
+                start_s=round(t, 1), end_s=round(t + dur, 1),
+                replicas=rng.randint(2, min(4, gangs * (gang_workers - gang_floor) // 2)),
+            ))
+            t += dur + self.ebb_s + rng.uniform(40, 70)
+        horizon = t + self.ebb_s + 120
+        rep.spikes = len(spikes)
+        # the co-tenants: elastic train gangs borrowing over their share,
+        # and the serve head at its base fleet — all admitted and running
+        gang_state: dict[str, dict[str, Any]] = {}
+        for g in range(gangs):
+            view = AppView(
+                app_id=f"train-{g}", queue="train", priority=0, seq=g + 1,
+                demand=(gang_workers * GB, gang_workers, 0),
+                elastic_unit=(GB, 1, 0),
+                elastic_slack=gang_workers - gang_floor,
+                admitted=True,
+            )
+            view.held = view.demand
+            self.world.adopt(view)
+            gang_state[view.app_id] = {
+                "view": view, "workers": gang_workers, "badput_s": 0.0,
+                "restored_at": None,
+            }
+        serve_unit: Vec = (2 * GB, 1, 0)
+        serve = AppView(
+            app_id="serve-head", queue="serve", priority=5, seq=1000,
+            demand=tuple(serve_base * u for u in serve_unit),  # type: ignore[arg-type]
+            admitted=True,
+        )
+        serve.held = serve.demand
+        self.world.adopt(serve)
+        placed = serve_base
+
+        pending_sheds: list[tuple[float, Any]] = []      # (land_at, Shrink)
+        pending_grows: list[tuple[float, str, int]] = []  # (land_at, app, k)
+        debt: dict[str, int] = {}                         # grow-back ledger
+        debt_since: dict[str, float] = {}
+        grown_at: dict[str, float] = {}
+        quiet_since: float | None = 0.0
+        last_end = spikes[-1].end_s
+
+        step = 0.0
+        while step <= horizon:
+            self.now = step
+            # 1. land sheds whose drains expired: physical capacity frees,
+            # the gang rebuilds at the reduced size (badput: yield + rebuild)
+            for land_at, sh in [p for p in pending_sheds if p[0] <= step]:
+                pending_sheds.remove((land_at, sh))
+                st = gang_state[sh.app_id]
+                v = st["view"]
+                v.held = v.demand            # demand was reduced at decision
+                v.shrink_pending = False
+                self.world.reaccount(v)
+                st["workers"] -= sh.workers
+                st["badput_s"] += self.coop_yield_s + self.rebuild_s
+                st["restored_at"] = None
+                debt[sh.app_id] = debt.get(sh.app_id, 0) + sh.workers
+                debt_since.setdefault(sh.app_id, step)
+                rep.shed_workers += sh.workers
+            # 2. land accepted grow offers: the gang restarts at the grown
+            # size (one rebuild of badput) and its debt settles
+            for land_at, app_id, k in [p for p in pending_grows if p[0] <= step]:
+                pending_grows.remove((land_at, app_id, k))
+                st = gang_state[app_id]
+                v = st["view"]
+                v.demand = tuple(
+                    d + k * u for d, u in zip(v.demand, v.elastic_unit))  # type: ignore[assignment]
+                v.held = v.demand
+                v.elastic_slack += k
+                self.world.reaccount(v)
+                st["workers"] += k
+                st["badput_s"] += self.rebuild_s
+                grown_at[app_id] = step
+                debt[app_id] -= k
+                if debt[app_id] <= 0:
+                    debt.pop(app_id)
+                    debt_since.pop(app_id, None)
+                rep.growback_workers += k
+                if st["workers"] == gang_workers:
+                    st["restored_at"] = step
+            # 3. the serve autoscaler's view: wanted replicas follow the
+            # spike schedule; scale-down at spike end is immediate (removing
+            # a replica needs no new capacity)
+            active = next(
+                (s for s in spikes if s.start_s <= step < s.end_s), None)
+            wanted = serve_base + (active.replicas if active else 0)
+            if placed > wanted:
+                placed = wanted
+                serve.demand = tuple(placed * u for u in serve_unit)  # type: ignore[assignment]
+                serve.held = serve.demand
+                self.world.reaccount(serve)
+            # place replicas into whatever physically fits (the AM's
+            # retrying allocate): this is what consumes funded capacity
+            free = self._phys_free()
+            while placed < wanted and all(
+                u <= f for u, f in zip(serve_unit, free)
+            ):
+                placed += 1
+                serve.demand = tuple(placed * u for u in serve_unit)  # type: ignore[assignment]
+                serve.held = serve.demand
+                self.world.reaccount(serve)
+                free = [f - u for f, u in zip(free, serve_unit)]
+            deficit = wanted - placed
+            if active and deficit == 0 and active.funded_at is None:
+                active.funded_at = step
+                rep.max_fund_latency_s = max(
+                    rep.max_fund_latency_s, step - active.start_s)
+            if active and deficit > 0 and active.funded_at is not None:
+                rep.violations.append(
+                    f"SLO-capacity: spike at {active.start_s:.0f}s funded at "
+                    f"{active.funded_at:.0f}s then clawed back at {step:.0f}s")
+                active.funded_at = step  # report once
+            # 4. publish + fund: the pool-side demand bridge, minus capacity
+            # already in flight from earlier sheds (fund once per deficit,
+            # not once per retry tick)
+            if deficit > 0:
+                quiet_since = None
+                need = [deficit * u for u in serve_unit]
+                for _, sh in pending_sheds:
+                    unit = gang_state[sh.app_id]["view"].elastic_unit
+                    for i in range(3):
+                        need[i] -= sh.workers * unit[i]
+                need = tuple(max(x, 0) for x in need)
+                if any(need):
+                    decision = self.policy.fund_demand(
+                        self.world, self.totals, self._phys_free(),
+                        app_id=serve.app_id, queue=serve.queue, need=need,
+                        grown_at=grown_at,
+                    )
+                    rep.evictions += len(decision.evict)
+                    if decision.admit or decision.evict:
+                        rep.violations.append(
+                            f"market pass admitted/evicted at t={step:.0f}s: "
+                            f"{decision.admit} {decision.evict}")
+                    for sh in decision.shrink:
+                        pending_sheds.append((step + self.drain_s, sh))
+            elif quiet_since is None:
+                quiet_since = step
+            # 5. grow back once demand has ebbed for the full window
+            if (quiet_since is not None and debt
+                    and step - quiet_since >= self.ebb_s):
+                in_flight = {a for _, a, _ in pending_grows}
+                ledger = sorted(
+                    (
+                        (a, owed, gang_state[a]["view"].elastic_unit)
+                        for a, owed in debt.items() if a not in in_flight
+                    ),
+                    key=lambda e: debt_since.get(e[0], 0.0),
+                )
+                free = self._phys_free()
+                for _, a, k in pending_grows:   # offers hold their capacity
+                    unit = gang_state[a]["view"].elastic_unit
+                    for i in range(3):
+                        free[i] -= k * unit[i]
+                for app_id, k in self.policy.plan_growback(
+                    self.world, free, ledger, step=self.growback_step,
+                ):
+                    pending_grows.append((step + self.rebuild_s, app_id, k))
+            # 6. per-tick invariants: claims within capacity, floors held
+            for i in range(3):
+                claimed = sum(
+                    v.claim()[i] for v in self.world.views.values())
+                if claimed > self.totals[i]:
+                    rep.violations.append(
+                        f"oversubscription at t={step:.0f}s dim {i}: "
+                        f"{claimed} > {self.totals[i]}")
+            for app_id, st in gang_state.items():
+                if st["view"].demand[1] < gang_floor:
+                    rep.violations.append(
+                        f"floor: {app_id} dug to {st['view'].demand[1]} "
+                        f"< {gang_floor} at t={step:.0f}s")
+            step += 1.0
+
+        # ---------------------------------------------------- final verdict
+        rep.wall_s = horizon
+        for s in spikes:
+            if s.funded_at is None:
+                rep.violations.append(
+                    f"SLO-capacity: spike at {s.start_s:.0f}s "
+                    f"({s.replicas} replicas) never fully placed")
+        bound = self.drain_s + 4.0  # drain + a few 1 Hz decision ticks
+        if rep.max_fund_latency_s > bound:
+            rep.violations.append(
+                f"SLO-capacity: slowest funding took "
+                f"{rep.max_fund_latency_s:.0f}s > bound {bound:.0f}s")
+        if rep.evictions:
+            rep.violations.append(
+                f"{rep.evictions} whole-gang eviction(s): the market must "
+                "only ever shrink")
+        restore_bound = last_end + self.ebb_s + self.drain_s + self.rebuild_s + 10
+        rep.restored_all = all(
+            st["workers"] == gang_workers for st in gang_state.values())
+        for app_id, st in gang_state.items():
+            if st["workers"] != gang_workers:
+                rep.violations.append(
+                    f"grow-back: {app_id} ended at {st['workers']}/"
+                    f"{gang_workers} workers (debt never repaid)")
+            elif st["restored_at"] is not None and st["restored_at"] > restore_bound:
+                rep.violations.append(
+                    f"grow-back: {app_id} restored at {st['restored_at']:.0f}s "
+                    f"> bound {restore_bound:.0f}s after the final ebb")
+        gang_seconds = gangs * horizon
+        rep.badput_fraction = round(
+            sum(st["badput_s"] for st in gang_state.values())
+            / max(gang_seconds, 1e-9), 4)
+        if rep.badput_fraction > 0.25:
+            rep.violations.append(
+                f"badput fraction {rep.badput_fraction:.2%} > 25% — the "
+                "market is churning gangs faster than they do work")
+        return rep
+
+
+def run_market_mix(
+    mix: str = "serve-train",
+    *,
+    seed: int = 0,
+    queues: dict[str, float] | None = None,
+    totals: Vec = (16 * GB, 256, 0),
+    drain_ms: int = 5_000,
+    ebb_ms: int = 20_000,
+    growback_step: int = 0,
+    min_runtime_ms: int = 3_000,
+    record_decisions: bool = False,
+) -> tuple[MarketReport, FlightRecorder | None]:
+    """One seeded serve-train market run — the unit tier-1 asserts the
+    market invariants over, and what ``tony sim --mix serve-train`` wraps.
+    Deterministic per (seed, knobs) across processes."""
+    if mix not in MARKET_MIXES:
+        raise ValueError(f"unknown market mix {mix!r} (choose from {MARKET_MIXES})")
+    sim = MarketSimulator(
+        queues, totals, seed=seed,
+        drain_s=drain_ms / 1000.0, ebb_s=ebb_ms / 1000.0,
+        growback_step=growback_step, min_runtime_ms=min_runtime_ms,
+        record_decisions=record_decisions,
+    )
+    return sim.run(), sim.recorder
+
+
+def render_market_report(report: MarketReport, as_json: bool = False) -> str:
+    if as_json:
+        return json.dumps(report.to_dict(), indent=1)
+    lines = [
+        f"market sim seed {report.seed}: {report.spikes} spike(s) over "
+        f"{report.wall_s:.0f} virtual seconds",
+        f"  workers shed to fund spikes (demand-spike): {report.shed_workers}",
+        f"  workers returned after ebb (grow-back): {report.growback_workers}",
+        f"  whole-gang evictions: {report.evictions}",
+        f"  slowest spike funding: {report.max_fund_latency_s:.1f}s",
+        f"  gang badput fraction: {report.badput_fraction:.2%}",
+        f"  all gangs restored to full size: {report.restored_all}",
+    ]
+    if report.violations:
+        lines.append(f"  MARKET INVARIANT VIOLATIONS ({len(report.violations)}):")
+        lines.extend(f"    - {v}" for v in report.violations[:20])
+    else:
+        lines.append("  market invariants: OK (SLO-capacity, zero evictions, "
+                     "bounded badput, gangs restored)")
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------

@@ -78,10 +78,31 @@ def tpu_interpret_params(**kwargs):
     return cls(**_filter_kwargs(cls, kwargs))
 
 
+def tpu_interpret_supported() -> bool:
+    """Whether this jax ships the TPU Pallas interpreter (emulated RDMA /
+    semaphores). Kernels using remote copies need it — the generic pallas
+    ``interpret=True`` path can't emulate them."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return (
+        getattr(pltpu, "InterpretParams", None) is not None
+        or getattr(pltpu, "TPUInterpretParams", None) is not None
+    )
+
+
+def cpu_devices_configurable() -> bool:
+    """Whether ``jax_num_cpu_devices`` exists as a config option (newer jax).
+    Older builds only grow virtual CPU devices via the
+    ``--xla_force_host_platform_device_count`` XLA flag set before init."""
+    return hasattr(jax.config, "jax_num_cpu_devices")
+
+
 __all__ = [
     "axis_size",
+    "cpu_devices_configurable",
     "shard_map",
     "tpu_compiler_params",
     "tpu_interpret_params",
+    "tpu_interpret_supported",
     "tree_leaves_with_path",
 ]

@@ -94,7 +94,10 @@ class TonyConfig:
         if path.endswith(".xml"):
             self.update_from(_parse_hadoop_xml(path))
         elif path.endswith(".toml"):
-            import tomllib
+            try:
+                import tomllib
+            except ImportError:  # py<3.11: the backport package, same API
+                import tomli as tomllib
 
             with open(path, "rb") as f:
                 self.update_from(dict(_flatten(tomllib.load(f))))

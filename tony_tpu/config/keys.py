@@ -203,6 +203,19 @@ POOL_RECORDER_WINDOW_MS = "tony.pool.recorder.window-ms"
 # into the store's cluster_series table (empty disables the flush — the
 # in-memory ring and gauges still work)
 POOL_RECORDER_SERIES_FILE = "tony.pool.recorder.series-file"
+# The capacity market (docs/scheduling.md "Capacity market"): admitted apps
+# may publish unmet demand via the update_demand RPC; with preemption on,
+# the pool funds it by shrinking over-share elastic borrowers (recorder
+# rule demand-spike) and grows them back once demand ebbs (rule grow-back).
+POOL_DEMAND_ENABLED = "tony.pool.demand.enabled"
+# A published deficit whose publisher goes quiet expires after this long —
+# a crashed spike must not keep taxing borrowers. 0 = never expire.
+POOL_DEMAND_TTL_MS = "tony.pool.demand.ttl-ms"
+# Grow-back hysteresis: ALL published demand must have been clear for this
+# long before shed workers are offered back (spike→ebb→spike cannot thrash).
+POOL_DEMAND_GROWBACK_EBB_MS = "tony.pool.demand.growback-ebb-ms"
+# Max workers offered back per borrower per grow-back pass; 0 = all owed.
+POOL_DEMAND_GROWBACK_STEP = "tony.pool.demand.growback-step"
 
 # ---------------------------------------------------------------------------
 # tony.history.* / tony.portal.* — events, history, portal, history server
@@ -323,6 +336,14 @@ SERVE_LOADTEST_TURNS = "tony.serve.loadtest.turns"
 SERVE_LOADTEST_PROMPT_MIX = "tony.serve.loadtest.prompt-mix"
 SERVE_LOADTEST_MAX_TOKENS = "tony.serve.loadtest.max-tokens"
 SERVE_LOADTEST_STREAM = "tony.serve.loadtest.stream"
+# Capacity market (serve side): when enabled, a serve AM whose allocation
+# request sits pending (the autoscaler asked for replicas the pool cannot
+# place) publishes the deficit to the pool via ``update_demand``; the pool's
+# preemption policy may fund it by partially shrinking elastic training
+# borrowers (see ``tony.pool.demand.*``). slo-ttft-ms is the serve-side p99
+# time-to-first-token objective the live market e2e/loadtest verdict checks.
+SERVE_MARKET_ENABLED = "tony.serve.market.enabled"
+SERVE_MARKET_SLO_TTFT_MS = "tony.serve.market.slo-ttft-ms"
 
 # ---------------------------------------------------------------------------
 # tony.cbench.* — control-plane benchmark sizes (`tony cbench`,
@@ -534,6 +555,10 @@ DEFAULTS: dict[str, str] = {
     POOL_RECORDER_CAPACITY: "2048",
     POOL_RECORDER_WINDOW_MS: "60s",
     POOL_RECORDER_SERIES_FILE: "",
+    POOL_DEMAND_ENABLED: "true",
+    POOL_DEMAND_TTL_MS: "60s",
+    POOL_DEMAND_GROWBACK_EBB_MS: "30s",
+    POOL_DEMAND_GROWBACK_STEP: "0",
 
     HISTORY_LOCATION: "",            # empty → <staging-root>/history
     HISTORY_MOVE_INTERVAL_MS: "1000",
@@ -578,6 +603,8 @@ DEFAULTS: dict[str, str] = {
     SERVE_LOADTEST_PROMPT_MIX: "16:0.5,64:0.3,256:0.2",
     SERVE_LOADTEST_MAX_TOKENS: "16",
     SERVE_LOADTEST_STREAM: "true",
+    SERVE_MARKET_ENABLED: "false",
+    SERVE_MARKET_SLO_TTFT_MS: "2000",
 
     CBENCH_APPS: "10000",
     CBENCH_QUEUES: "8",

@@ -822,7 +822,14 @@ def main(argv: list[str] | None = None) -> int:
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     obs_logging.info(f"[tony-serve] {url} preset={args.preset} slots={args.slots} "
                      f"max_len={args.max_len}")
-    done.wait()
+    # poll rather than block forever: a process-directed SIGTERM may be
+    # delivered to a busy worker thread, in which case CPython only runs the
+    # Python-level handler once the MAIN thread executes bytecode again — a
+    # main thread parked in an untimed Event.wait() never does, and the
+    # signal (and the whole drain) would be swallowed. Waking twice a second
+    # bounds drain-start latency without relying on who the kernel picked.
+    while not done.wait(0.5):
+        pass
     if srv.error is not None:
         obs_logging.error(f"[tony-serve] engine failed: {srv.error}")
         httpd.shutdown()

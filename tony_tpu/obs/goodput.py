@@ -117,6 +117,17 @@ class Ledger:
                  if p != "productive" and ms > 0]
         return dict(sorted(items, key=lambda kv: -kv[1]))
 
+    def disruption_fraction(self, phases: tuple[str, ...] = (
+            "restart_rework", "preempt_drain", "resize")) -> float:
+        """Fraction of wall-time lost to the named disruption phases — the
+        capacity-market verdict number: a borrower repeatedly shed and
+        regrown pays exactly these (drain windows, restart/resize rebuilds,
+        replayed work), so the market e2e bounds this fraction to prove the
+        spike's funding did not churn the training gang to death."""
+        if self.wall_ms <= 0:
+            return 0.0
+        return sum(self.phases_ms.get(p, 0) for p in phases) / self.wall_ms
+
     def window_fraction(self, window_ms: int) -> float:
         """Goodput over the trailing ``window_ms`` (clipped to the job) —
         the value live alert rules evaluate: a cumulative fraction can never

@@ -902,6 +902,31 @@ class PortalHandler(BaseHTTPRequestHandler):
                 "<table><tr><th>queue</th><th>share</th><th>used / guarantee</th>"
                 f"<th>admitted</th><th>waiting</th></tr>{''.join(qrows)}</table>"
             )
+        market = st.get("market") or {}
+        if any(market.get(k) for k in ("demand", "shrunk", "grows")):
+            # the capacity market's live state (docs/scheduling.md "Capacity
+            # market"): published deficits, the grow-back ledger, offers out
+            mrows = []
+            for app, d in sorted((market.get("demand") or {}).items()):
+                mrows.append(
+                    f"<tr><td>demand</td><td>{html.escape(app)}</td>"
+                    f"<td>{d.get('workers', 0)} worker(s) wanted</td>"
+                    f"<td>{d.get('age_s', 0):.0f}s old</td></tr>")
+            for app, s in sorted((market.get("shrunk") or {}).items()):
+                mrows.append(
+                    f"<tr><td>owed</td><td>{html.escape(app)}</td>"
+                    f"<td>{s.get('workers', 0)} worker(s) to grow back</td>"
+                    f"<td>queue {html.escape(str(s.get('queue', '')))}</td></tr>")
+            for app, g in sorted((market.get("grows") or {}).items()):
+                mrows.append(
+                    f"<tr><td>grow offer</td><td>{html.escape(app)}</td>"
+                    f"<td>{g.get('workers', 0)} worker(s) offered</td>"
+                    f"<td>expires in {g.get('deadline_s', 0):.0f}s</td></tr>")
+            body += (
+                "<h3>capacity market</h3>"
+                "<table><tr><th>kind</th><th>app</th><th>what</th>"
+                f"<th>detail</th></tr>{''.join(mrows)}</table>"
+            )
         explain = self._pool_explain()
         if explain:
             blocks = []

@@ -55,6 +55,10 @@ _DOWN_DRAINS = obs_metrics.counter(
     "tony_serve_scale_down_drains_total",
     "scale-down victim drains by how they resolved "
     "(drained / timeout / superseded)", labelnames=("outcome",))
+_DEFICIT = obs_metrics.gauge(
+    "tony_serve_replica_deficit",
+    "replicas the autoscaler wants but the fleet has not placed — the "
+    "deficit the AM publishes to the pool's capacity market")
 
 
 @dataclass
@@ -148,8 +152,17 @@ class Autoscaler:
             return max(current - 1, max(p.min_replicas, 1))
         return current
 
+    def deficit(self) -> int:
+        """Replicas wanted but not yet placed: how far the fleet lags the
+        last requested target. Nonzero while a scale-up waits on capacity —
+        the quantity the AM's capacity-market publish mirrors pool-side."""
+        if self.target is None:
+            return 0
+        return max(self.target - self.health.fleet_signals().replicas_known, 0)
+
     def tick(self) -> None:
         sig = self.health.fleet_signals()
+        _DEFICIT.set(max((self.target or 0) - sig.replicas_known, 0))
         current = sig.replicas_known or (self.target or 0)
         if current == 0:
             return  # nothing resolved yet

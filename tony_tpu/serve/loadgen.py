@@ -226,6 +226,13 @@ class LoadGenerator:
         self._results: list[Turn] = []
         self._lock = threading.Lock()
 
+    def completed(self) -> int:
+        """Turns finished so far (ok or failed) — a live progress signal for
+        callers deriving deadlines from observed progress instead of a fixed
+        stopwatch (the e2e suites extend their waits while this advances)."""
+        with self._lock:
+            return len(self._results)
+
     # ------------------------------------------------------------ plumbing
     def _router_stats(self) -> dict[str, Any] | None:
         try:
