@@ -440,6 +440,7 @@ class TestResizeCLI:
 # ---------------------------------------------------------------------------
 @pytest.mark.e2e
 class TestSparePromotionE2E:
+    @pytest.mark.slow
     def test_grow_promotes_a_parked_spare(self, tmp_tony_root):
         from tony_tpu.cluster import history
         from tony_tpu.cluster.client import Client

@@ -2,6 +2,8 @@
 
 import dataclasses
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -27,6 +29,7 @@ class TestCacheForwardParity:
             np.asarray(last), np.asarray(full[:, -1]), rtol=2e-2, atol=2e-2
         )
 
+    @pytest.mark.slow
     def test_incremental_decode_matches_full_forward(self):
         """Feeding tokens one at a time through the cache must give the same
         logits as one full causal forward — the cache-correctness proof."""

@@ -180,12 +180,14 @@ class TestLoopParity:
                        warmup_steps=0, prefetch_depth=depth, **extra),
         )
 
+    @pytest.mark.slow
     def test_synthetic_loss_trajectory_is_bit_identical(self, tmp_path):
         sync = self._run(tmp_path, "sync", depth=0)
         overlapped = self._run(tmp_path, "pre", depth=2)
         assert overlapped["step"] == sync["step"]
         assert overlapped["loss"] == sync["loss"], (sync, overlapped)
 
+    @pytest.mark.slow
     def test_loader_loss_trajectory_is_bit_identical(self, tmp_path):
         from tony_tpu.data import write_token_shard
 
@@ -423,6 +425,7 @@ class TestKernelConsult:
                    key=lambda r: r["ms"])
         assert (bq, bk) == (best["params"]["block_q"], best["params"]["block_k"])
 
+    @pytest.mark.slow
     def test_tune_cli_dry_run_and_persist(self, tmp_path, capsys):
         from tony_tpu.cli.tune import main as tune_main
 

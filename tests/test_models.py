@@ -148,6 +148,7 @@ class TestLlama:
                 atol=5e-2, rtol=5e-2,
             )
 
+    @pytest.mark.slow
     def test_grad_accumulation_matches_full_batch(self):
         cfg = self.cfg
         params = llama.init(KEY, cfg)
@@ -198,6 +199,7 @@ class TestMixtral:
         )
         assert abs(float(got) - float(want)) < 0.05
 
+    @pytest.mark.slow
     def test_train_step(self):
         opt = quick_opt()
         mesh = MeshSpec(data=2, expert=4).build()
@@ -213,6 +215,7 @@ class TestMixtral:
 class TestBert:
     cfg = bert.BERT_TINY
 
+    @pytest.mark.slow
     def test_mlm_loss_and_convergence(self):
         params = bert.init(KEY, self.cfg)
         opt = quick_opt()
@@ -315,6 +318,7 @@ class TestResNet:
         stem = new_state["stem"]["bn"]
         assert float(jnp.abs(stem["mean"]).sum()) > 0
 
+    @pytest.mark.slow
     def test_loss_decreases(self):
         params, bn_state = resnet.init(KEY, self.cfg)
         opt = quick_opt()

@@ -393,6 +393,7 @@ class TestMoE:
         y_ref, _ = moe_ffn(x, router, wg, wu, wd, big)
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_fused_kernel_matches_xla_ragged(self):
         """The Pallas fused grouped-GEMM SwiGLU (interpret mode here) must
         match the jax.lax.ragged_dot path bit-for-tolerance: outputs, aux,

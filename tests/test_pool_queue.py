@@ -466,6 +466,7 @@ class TestQueueE2E:
         assert r1.get("final") == JobStatus.SUCCEEDED, h1.final_status()
         assert r2.get("final") == JobStatus.SUCCEEDED, h2.final_status()
 
+    @pytest.mark.slow
     def test_cross_queue_reclaim_evicts_borrower_end_to_end(
         self, tmp_tony_root, tmp_path
     ):

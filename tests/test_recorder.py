@@ -681,6 +681,7 @@ class TestExplainE2E:
 # cbench: the scheduler lane runs with the recorder ON
 # ---------------------------------------------------------------------------
 class TestCbenchRecorderLane:
+    @pytest.mark.slow
     def test_scaled_lane_reports_recorder_on(self):
         from tony_tpu.cluster.cbench import CbenchSizes, bench_scheduler
 

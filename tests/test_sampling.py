@@ -5,6 +5,8 @@ against hand-computable distributions, and the engine's per-slot path: mixed
 greedy + sampled requests decoding in the same batch.
 """
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -33,6 +35,7 @@ class TestSampleLogits:
             jnp.asarray([p], jnp.float32),
         )
 
+    @pytest.mark.slow
     def test_greedy_row(self):
         assert _counts(self._one(0.0, 0, 0.0), n=5) == {0: 5}
 

@@ -770,6 +770,7 @@ class TestPreemptionE2E:
         assert sum(led_d.phases_ms.values()) == led_d.wall_ms
         assert sum(led_k.phases_ms.values()) == led_k.wall_ms
 
+    @pytest.mark.slow
     def test_elastic_victim_sheds_workers_instead_of_dying(
         self, tmp_tony_root, tmp_path
     ):

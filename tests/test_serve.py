@@ -497,6 +497,7 @@ from tests.test_pool_queue import small_pool  # noqa: F401, E402 — fixture reu
 
 @pytest.mark.e2e
 class TestServeComposesWithPool:
+    @pytest.mark.slow
     def test_high_priority_serve_preempts_training(
         self, tmp_tony_root, small_pool, tmp_path  # noqa: F811
     ):

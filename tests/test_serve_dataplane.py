@@ -744,6 +744,7 @@ class TestRequestTaskDrainE2E:
 @pytest.mark.e2e
 @pytest.mark.chaos
 class TestServeDataPlaneE2E:
+    @pytest.mark.slow
     def test_loadtest_affinity_preemption_and_drained_scale_down(
         self, tmp_tony_root
     ):

@@ -697,6 +697,7 @@ class TestResizeJobtypeE2E:
 @pytest.mark.e2e
 @pytest.mark.chaos
 class TestFleetChaosE2E:
+    @pytest.mark.slow
     def test_replica_crash_is_not_client_visible(self, tmp_tony_root):
         from tony_tpu.cli.serve import _fleet_am_client, build_serve_config
         from tony_tpu.portal import server as portal

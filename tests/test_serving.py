@@ -39,6 +39,7 @@ class TestContinuousBatching:
                 err_msg=f"request {i} diverged from generate()",
             )
 
+    @pytest.mark.slow
     def test_more_requests_than_slots(self):
         # 2 slots, 4 requests: retirement must free slots for later admissions
         params = _params()

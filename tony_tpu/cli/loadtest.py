@@ -52,6 +52,10 @@ def build_spec(argv: list[str]) -> tuple[LoadSpec, argparse.Namespace]:
                         "(cross-session prefix-reuse probe)")
     p.add_argument("--timeout-s", type=float, default=120.0,
                    help="per-request client deadline")
+    p.add_argument("--profile", choices=("uniform", "diurnal"), default="uniform",
+                   help="arrival shape: uniform open loop, or a diurnal "
+                        "squared-sine spike (same total duration; the SLO "
+                        "burn e2e's load shape)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default="",
                    help="write the full JSON report here")
@@ -84,6 +88,7 @@ def build_spec(argv: list[str]) -> tuple[LoadSpec, argparse.Namespace]:
         shared_prefix=args.shared_prefix,
         timeout_s=args.timeout_s,
         seed=args.seed,
+        profile=args.profile,
     )
     if spec.sessions < 1 or spec.turns < 1:
         raise SystemExit("tony loadtest: --sessions and --turns must be >= 1")
@@ -102,6 +107,10 @@ def main(argv: list[str] | None = None) -> int:
     report = LoadGenerator(spec).run()
     d = report.to_dict()
     print(json.dumps(d, indent=2))
+    for w in d.get("worst_ttft") or []:
+        print(f"[tony-loadtest] worst ttft {w['ttft_ms']:.1f}ms  "
+              f"request {w['request_id'] or '?'}  "
+              f"(session {w['session']} turn {w['turn']})")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(d, f, indent=2)

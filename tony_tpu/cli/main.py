@@ -118,6 +118,12 @@ def _cmd_goodput(argv: list[str]) -> int:
     return goodput_main(argv)
 
 
+def _cmd_slo(argv: list[str]) -> int:
+    from tony_tpu.cli.slo import main as slo_main
+
+    return slo_main(argv)
+
+
 def _cmd_sim(argv: list[str]) -> int:
     from tony_tpu.cli.sim import main as sim_main
 
@@ -351,6 +357,7 @@ _COMMANDS = {
     "top": _cmd_top,
     "resize": _cmd_resize,
     "goodput": _cmd_goodput,
+    "slo": _cmd_slo,
     "sim": _cmd_sim,
     "explain": _cmd_explain,
     "tune": _cmd_tune,
@@ -362,7 +369,7 @@ _COMMANDS = {
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: tony {submit|pool|history|history-server|bench|cbench|portal|notebook|serve|loadtest|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput|sim|explain|tune} [options]\n")
+        print("usage: tony {submit|pool|history|history-server|bench|cbench|portal|notebook|serve|loadtest|mini|data-prep|lint|chaos|trace|profile|logs|top|resize|goodput|slo|sim|explain|tune} [options]\n")
         print("  submit     submit and monitor a job (tony submit --help)")
         print("  pool       run a pool service + host agents on this machine (RM/NM analog)")
         print("  history    query the persistent history tier (list|show|compare|ingest|gc)")
@@ -383,6 +390,7 @@ def main(argv: list[str] | None = None) -> int:
         print("  top        refreshing live status view (per-task state, step rate, heartbeat age)")
         print("  resize     retarget a RUNNING job's per-type instance count (elastic rebuild)")
         print("  goodput    exact goodput/badput phase accounting + straggler skew + alert history")
+        print("  slo        SLO error budgets + burn rates (status) and the history-backed verdict")
         print("  sim        replay seeded synthetic arrivals against the live scheduler policy (invariant check)")
         print("  explain    render the pool scheduler's decision provenance for an app or queue (flight recorder)")
         print("  tune       autotune Pallas kernel block sizes on this backend into the on-disk cache")

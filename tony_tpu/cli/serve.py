@@ -145,6 +145,22 @@ def _fleet_am_client(handle) -> RpcClient | None:
     return RpcClient(shared.host, shared.port, secret=shared.secret, timeout_s=5.0)
 
 
+def _slo_fast_burn(rpc: RpcClient) -> float | None:
+    """The worst serve-objective fast-burn rate from the AM's ``get_slo``
+    RPC, or None (SLO disabled / no data / AM unreachable) — the
+    autoscaler's SLO up-pressure input."""
+    doc = rpc.call("get_slo")
+    if not isinstance(doc, dict) or not doc.get("enabled"):
+        return None
+    burns = [
+        o.get("burn_fast")
+        for name, o in (doc.get("objectives") or {}).items()
+        if name.startswith("serve-")
+    ]
+    burns = [b for b in burns if isinstance(b, (int, float))]
+    return max(burns) if burns else None
+
+
 def _push_router_metrics_loop(rpc: RpcClient, stop: threading.Event,
                               interval_s: float = 2.0) -> None:
     """Ship this process's metrics registry (router request/retry/hedge
@@ -236,6 +252,12 @@ def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0,
             max_sessions=config.get_int(keys.SERVE_SESSION_MAX_SESSIONS, 10_000),
             prefix_span=config.get_int(keys.SERVE_SESSION_PREFIX_SPAN, 256),
         ),
+        # SLO-aligned latency bucket edge (exact good/bad counts) when a
+        # TTFT objective is declared
+        slo_ttft_threshold_ms=(
+            config.get_float(keys.SLO_SERVE_TTFT_THRESHOLD_MS, 0.0)
+            or config.get_float(keys.SERVE_MARKET_SLO_TTFT_MS, 0.0)
+        ) if config.get(keys.SLO_SERVE_TTFT_TARGET) else None,
     ).start()
     autoscaler = None
     max_replicas = config.get_int(keys.SERVE_MAX_REPLICAS, 0)
@@ -261,6 +283,11 @@ def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0,
                 "request_task_drain", job_name=job, index=i),
             drain_timeout_s=config.get_time_ms(
                 keys.SERVE_SCALE_DOWN_DRAIN_MS, 10_000) / 1000,
+            # SLO-aware up-pressure: the AM's SLO engine distilled to the
+            # worst serve-objective fast-burn rate (None when disabled)
+            burn=(lambda: _slo_fast_burn(fleet_rpc))
+            if config.get(keys.SLO_SERVE_TTFT_TARGET)
+            or config.get(keys.SLO_SERVE_AVAILABILITY_TARGET) else None,
         ).start()
     stop_push = threading.Event()
     threading.Thread(

@@ -1,0 +1,236 @@
+"""``tony slo`` — live SLO status and the history-backed verdict.
+
+Two surfaces over the SLO engine (obs/slo.py, docs/observability.md "SLOs &
+error budgets"):
+
+- ``tony slo status <app_id>`` (also the default subcommand): the live
+  per-objective budget/burn table from the AM's ``get_slo`` RPC, falling
+  back to a replay of the app's ``slo.jsonl`` when the AM is gone.
+- ``tony slo verdict <app_id> --window W``: the machine-readable pass/fail.
+  Deliberately read from PERSISTED rows — the history store's ``slo_series``
+  merged with the app's raw ``slo.jsonl`` (the jsonl is at least as fresh as
+  the last sweep) — never from in-process state, so the verdict survives the
+  AM and means the same thing hours later. Exit code 0 = PASS, 1 = FAIL,
+  2 = NO_DATA.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any
+
+from tony_tpu import constants
+from tony_tpu.obs import artifacts as obs_artifacts
+from tony_tpu.obs import slo as obs_slo
+
+
+def _read_jsonl_rows(path: str) -> list[dict[str, Any]]:
+    """slo.jsonl rows in file order, skipping torn/partial lines."""
+    rows: list[dict[str, Any]] = []
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(doc, dict):
+                    rows.append(doc)
+    except OSError:
+        pass
+    return rows
+
+
+def _merged_rows(staging: str, app_id: str, store_path: str) -> list[dict[str, Any]]:
+    """slo_series rows (store) merged with the app's raw slo.jsonl, deduped
+    by (source, objective, bucket) with the jsonl winning — the AM re-emits
+    each bucket with fuller counts, so later writes for a key are fuller,
+    and summing both copies would double-count the budget."""
+    by_key: dict[tuple[str, str, int], dict[str, Any]] = {}
+
+    def fold(source_default: str, rows: list[dict[str, Any]]) -> None:
+        for r in rows:
+            try:
+                key = (str(r.get("source") or r.get("app_id") or source_default),
+                       str(r["objective"]), int(r["window_start_ms"]))
+            except (KeyError, TypeError, ValueError):
+                continue
+            by_key[key] = r
+
+    if store_path and os.path.exists(store_path):
+        from tony_tpu.histserver.store import HistoryStore
+
+        store = HistoryStore(store_path)
+        try:
+            fold(app_id, store.slo_series(source=app_id))
+        finally:
+            store.close()
+    fold(app_id, _read_jsonl_rows(os.path.join(staging, app_id, "slo.jsonl")))
+    return list(by_key.values())
+
+
+def _bar(frac: float, width: int = 24) -> str:
+    frac = min(max(frac, 0.0), 1.0)
+    n = int(round(frac * width))
+    return "#" * n + "." * (width - n)
+
+
+def _fmt_burn(v: Any) -> str:
+    return f"{v:.2f}x" if isinstance(v, (int, float)) else "   -"
+
+
+def render_status(doc: dict[str, Any]) -> str:
+    lines = [
+        f"{doc.get('app_id') or '?'}  SLO window "
+        f"{int(doc.get('window_ms') or 0) / 1000.0:.0f}s  "
+        f"(fast-burn page ≥{doc.get('fast_burn')}x over "
+        f"{int(doc.get('fast_window_ms') or 0) / 1000.0:.0f}s, "
+        f"slow-burn warn ≥{doc.get('slow_burn')}x over "
+        f"{int(doc.get('slow_window_ms') or 0) / 1000.0:.0f}s)",
+        "",
+    ]
+    objectives = doc.get("objectives") or {}
+    if not objectives:
+        lines.append("no SLO objectives configured (tony.slo.*-target keys)")
+        return "\n".join(lines)
+    for name, o in sorted(objectives.items()):
+        rem = o.get("budget_remaining")
+        rem_cell = f"{rem:7.1%}" if isinstance(rem, (int, float)) else "      ?"
+        lines.append(
+            f"  {name:<20s} target {o.get('target'):.4g}  "
+            f"good {o.get('good')} bad {o.get('bad')}  "
+            f"budget [{_bar(rem if isinstance(rem, (int, float)) else 0.0)}] "
+            f"{rem_cell}  burn fast {_fmt_burn(o.get('burn_fast'))} "
+            f"slow {_fmt_burn(o.get('burn_slow'))}")
+        for ex in (o.get("exemplars") or [])[:3]:
+            lines.append(f"      worst: {ex.get('value_s', 0):.3f}s  "
+                         f"request {ex.get('request_id')}")
+    alerts = doc.get("alerts") or []
+    if alerts:
+        lines += ["", "burn alerts firing NOW:"]
+        for a in alerts:
+            lines.append(f"  {a['rule']}: burn {a.get('value')} vs "
+                         f"threshold {a.get('threshold')}x")
+    return "\n".join(lines)
+
+
+def _status_from_rows(app_id: str, rows: list[dict[str, Any]],
+                      now_ms: int) -> dict[str, Any]:
+    """Last-known status replayed from persisted rows (AM gone): per
+    objective, the freshest bucket's burn/budget plus window totals."""
+    doc: dict[str, Any] = {"app_id": app_id, "enabled": bool(rows),
+                           "ts_ms": now_ms, "objectives": {}, "stale": True}
+    latest: dict[str, dict[str, Any]] = {}
+    for r in sorted(rows, key=lambda r: int(r.get("window_start_ms") or 0)):
+        latest[str(r.get("objective"))] = r
+    for name, r in latest.items():
+        good = sum(int(x.get("good") or 0) for x in rows
+                   if x.get("objective") == name)
+        bad = sum(int(x.get("bad") or 0) for x in rows
+                  if x.get("objective") == name)
+        doc["objectives"][name] = {
+            "target": float(r.get("target") or 0.0),
+            "unit": r.get("unit") or "",
+            "good": good, "bad": bad,
+            "budget_remaining": r.get("budget_remaining"),
+            "burn_fast": r.get("burn_fast"),
+            "burn_slow": r.get("burn_slow"),
+            "exemplars": [],
+        }
+    return doc
+
+
+def _cmd_status(args) -> int:
+    staging = args.staging or constants.default_tony_root()
+    art = obs_artifacts.index(staging, args.app_id)
+    doc: dict[str, Any] | None = None
+    cli = art.am_client(timeout_s=5.0)
+    if cli is not None:
+        try:
+            doc = cli.call("get_slo")
+        except Exception:  # noqa: BLE001 — AM mid-exit: fall back to the jsonl
+            doc = None
+        finally:
+            cli.close()
+    if doc is None:
+        rows = _merged_rows(staging, args.app_id, _store_path(args, staging))
+        if not rows:
+            print(f"no SLO data for {args.app_id} under {staging} — is "
+                  "tony.slo.* configured?", file=sys.stderr)
+            return 1
+        doc = _status_from_rows(args.app_id, rows, int(time.time() * 1000))
+    if args.json:
+        print(json.dumps(doc))
+        return 0
+    if doc.get("stale"):
+        print("(AM unreachable — last persisted state)\n")
+    print(render_status(doc))
+    return 0
+
+
+def _store_path(args, staging: str) -> str:
+    if getattr(args, "store", None):
+        return args.store
+    from tony_tpu.histserver.server import default_store_path
+
+    return default_store_path(staging)
+
+
+def _cmd_verdict(args) -> int:
+    staging = args.staging or constants.default_tony_root()
+    rows = _merged_rows(staging, args.app_id, _store_path(args, staging))
+    verdict = obs_slo.verdict_from_rows(
+        rows, int(args.window * 1000), int(time.time() * 1000))
+    verdict["app_id"] = args.app_id
+    print(json.dumps(verdict, sort_keys=True))
+    return {"PASS": 0, "FAIL": 1}.get(verdict["verdict"], 2)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tony slo",
+        description="SLO error budgets, burn rates, and the loadtest verdict "
+                    "(docs/observability.md)")
+    sub = p.add_subparsers(dest="cmd")
+
+    def common(sp: argparse.ArgumentParser) -> None:
+        sp.add_argument("app_id", help="application id (staging dir name)")
+        sp.add_argument("--staging", default=None,
+                        help="staging root holding <app_id>/ (default: $TONY_ROOT)")
+        sp.add_argument("--store", default=None,
+                        help="history store path (default <staging>/history/"
+                             "history.sqlite)")
+
+    ps = sub.add_parser("status", help="live budget/burn table (default)")
+    common(ps)
+    ps.add_argument("--json", action="store_true",
+                    help="machine-readable status document")
+    ps.set_defaults(fn=_cmd_status)
+
+    pv = sub.add_parser(
+        "verdict", help="machine-readable pass/fail over persisted windows")
+    common(pv)
+    pv.add_argument("--window", type=float, default=3600.0,
+                    help="trailing compliance window in seconds (default 3600)")
+    pv.set_defaults(fn=_cmd_verdict)
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # bare `tony slo <app_id>` means status
+    if argv and argv[0] not in ("status", "verdict", "-h", "--help"):
+        argv.insert(0, "status")
+    args = p.parse_args(argv)
+    if not getattr(args, "fn", None):
+        p.print_help()
+        return 2
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,7 @@ from tony_tpu.obs import introspect as obs_introspect
 from tony_tpu.obs import locktrace as obs_locktrace
 from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import slo as obs_slo
 from tony_tpu.obs import trace as obs_trace
 from tony_tpu.cluster.events import EventHandler, EventType
 from tony_tpu.cluster.resources import (
@@ -365,6 +366,17 @@ class ApplicationMaster:
             ),
             app_id=app_id,
         )
+        # SLO plane (tony.slo.*): declarative objectives with error-budget
+        # ledgers; their multi-window burn-rate rules ride THIS SAME alert
+        # engine, name-prefixed "slo-" so the tick's emit loop publishes
+        # them as SLO_BURN_ALERT/SLO_BURN_RESOLVED instead of ALERT_*
+        self._slo = obs_slo.SloEngine(
+            config, app_id=app_id,
+            sink_path=config.get(keys.SLO_SINK)
+            or os.path.join(staging_dir, "slo.jsonl"),
+        )
+        if self._slo.enabled:
+            self._alerts.rules.extend(self._slo.burn_rules())
         self._last_goodput_tick = 0.0
         # incremental .jhist reader: the tick/RPC pay O(new events), not a
         # full re-parse of a multi-day job's history every few seconds
@@ -1022,12 +1034,24 @@ class ApplicationMaster:
         if ledger is not None:
             _GOODPUT_FRACTION.set(
                 round(ledger.window_fraction(self._goodput_window_ms), 6))
+        values = self._alert_values(infos, task_obs, ledger)
+        if self._slo.enabled:
+            now_ms = int(time.time() * 1000)
+            for tid, obs in task_obs.items():
+                if obs:
+                    self._slo.observe_serve(tid, obs, now_ms)
+            if ledger is not None:
+                self._slo.observe_train(self.app_id, ledger, now_ms)
+            values.update(self._slo.tick(now_ms))
+            self._slo.append_windows(now_ms)
         if self._alerts.rules:
-            for rec in self._alerts.evaluate(
-                self._alert_values(infos, task_obs, ledger)
-            ):
-                etype = (EventType.ALERT_FIRED if rec["state"] == "fired"
-                         else EventType.ALERT_RESOLVED)
+            for rec in self._alerts.evaluate(values):
+                if rec["rule"].startswith(obs_slo.RULE_PREFIX):
+                    etype = (EventType.SLO_BURN_ALERT if rec["state"] == "fired"
+                             else EventType.SLO_BURN_RESOLVED)
+                else:
+                    etype = (EventType.ALERT_FIRED if rec["state"] == "fired"
+                             else EventType.ALERT_RESOLVED)
                 self.events.emit(
                     etype, **{k: v for k, v in rec.items() if k != "app_id"})
                 obs_logging.warning(
@@ -1049,6 +1073,17 @@ class ApplicationMaster:
             "stragglers": sorted(self._straggler.flagged),
             "alerts": self._alerts.active(),
         }
+
+    def get_slo(self) -> dict[str, Any]:
+        """Live SLO surface (`tony slo` / portal `/slo`): per-objective
+        budgets, burn rates, worst-offender exemplars, and whichever of the
+        alert engine's `slo-` rules are currently firing."""
+        doc = self._slo.status(int(time.time() * 1000))
+        doc["alerts"] = [
+            a for a in self._alerts.active()
+            if a["rule"].startswith(obs_slo.RULE_PREFIX)
+        ]
+        return doc
 
     # ------------------------------------------------------------ lifecycle
     def prepare(self) -> None:

@@ -55,6 +55,8 @@ class EventType(enum.Enum):
     STRAGGLER_RESOLVED = "STRAGGLER_RESOLVED"  # flagged rank back under the skew factor (or gone)
     ALERT_FIRED = "ALERT_FIRED"                # a tony.alerts.* rule crossed its threshold
     ALERT_RESOLVED = "ALERT_RESOLVED"          # the rule's signal recovered (or the job finalized)
+    SLO_BURN_ALERT = "SLO_BURN_ALERT"          # an SLO burn-rate rule (tony.slo.*) started firing
+    SLO_BURN_RESOLVED = "SLO_BURN_RESOLVED"    # the burn rate dropped back under the rule threshold
     APPLICATION_FINISHED = "APPLICATION_FINISHED"
 
 

@@ -349,6 +349,12 @@ class TaskExecutor:
                 env[constants.ENV_TRACE_PARENT] = self._root_span.span_id
         if not self.config.get_bool(keys.METRICS_ENABLED, True):
             env[constants.ENV_METRICS_ENABLED] = "0"  # child honors the job's opt-out
+        if self.config.get(keys.SLO_SERVE_TTFT_TARGET):
+            # SLO contract: serve children align a TTFT bucket edge to the
+            # objective threshold (empty → the capacity market's number)
+            env[constants.ENV_SLO_TTFT_MS] = str(
+                self.config.get(keys.SLO_SERVE_TTFT_THRESHOLD_MS)
+                or self.config.get(keys.SERVE_MARKET_SLO_TTFT_MS) or "2000")
         # child-process structured-logging contract: records land in the same
         # <staging>/logs aggregate as this supervisor's (tony logs merges them)
         log_level = self.config.get(keys.LOG_LEVEL) or "info"

@@ -349,4 +349,5 @@ APPLICATION_RPC_METHODS = [
     "report_drain_saved",    # executors report the child's urgent pre-preemption checkpoint
     "request_task_drain",    # drain ONE task (autoscaler pre-scale-down lever); idempotent poll
     "get_goodput",           # live goodput ledger + straggler skew + active alerts
+    "get_slo",               # SLO objectives: budgets, burn rates, exemplars (obs/slo.py)
 ]

@@ -97,9 +97,21 @@ def cpu_devices_configurable() -> bool:
     return hasattr(jax.config, "jax_num_cpu_devices")
 
 
+def multiprocess_cpu_supported() -> bool:
+    """Whether this jax can run CROSS-PROCESS collectives on the CPU
+    backend. Older builds raise ``Multiprocess computations aren't
+    implemented on the CPU backend`` the moment a psum spans two
+    ``jax.distributed`` processes — single-process multi-device SPMD still
+    works everywhere. The gloo-backed CPU collectives arrived together with
+    the ``jax_cpu_collectives_implementation`` config option, so probing the
+    option is a static stand-in for spawning a two-process gang."""
+    return hasattr(jax.config, "jax_cpu_collectives_implementation")
+
+
 __all__ = [
     "axis_size",
     "cpu_devices_configurable",
+    "multiprocess_cpu_supported",
     "shard_map",
     "tpu_compiler_params",
     "tpu_interpret_params",

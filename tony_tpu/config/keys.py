@@ -445,6 +445,33 @@ ALERTS_SINK = "tony.alerts.sink"        # transition JSONL; empty → <staging>/
 ALERTS_WEBHOOK = "tony.alerts.webhook"  # optional URL POSTed each transition
 
 # ---------------------------------------------------------------------------
+# tony.slo.* — declarative SLO objectives + error budgets (obs/slo.py,
+# docs/observability.md "SLOs & error budgets"). An objective is active when
+# its target is non-empty (mirrors tony.alerts.*); the AM's goodput tick
+# feeds the budget ledgers and compiles the burn-rate rules into the alert
+# engine (SLO_BURN_ALERT/SLO_BURN_RESOLVED events, tony_slo_* gauges).
+# ---------------------------------------------------------------------------
+SLO_WINDOW_MS = "tony.slo.window-ms"    # compliance window the budget spans
+SLO_BUCKET_MS = "tony.slo.bucket-ms"    # ledger bucket width (accounting grain)
+# serve-ttft: fraction of requests whose TTFT lands under threshold-ms.
+# Empty threshold inherits tony.serve.market.slo-ttft-ms so the market's
+# defended number and the measured objective can't drift apart.
+SLO_SERVE_TTFT_TARGET = "tony.slo.serve-ttft-target"
+SLO_SERVE_TTFT_THRESHOLD_MS = "tony.slo.serve-ttft-threshold-ms"
+# serve-availability: fraction of requests answered without server error.
+SLO_SERVE_AVAILABILITY_TARGET = "tony.slo.serve-availability-target"
+# train-goodput: windowed goodput fraction floor (per queue, from the ledger).
+SLO_TRAIN_GOODPUT_TARGET = "tony.slo.train-goodput-target"
+# Multi-window multi-burn-rate alerting (SRE workbook shape): the fast rule
+# pages when the short-window burn rate exceeds fast-burn (budget gone in
+# hours), the slow rule warns on sustained slow leaks.
+SLO_FAST_BURN = "tony.slo.fast-burn"
+SLO_FAST_WINDOW_MS = "tony.slo.fast-window-ms"
+SLO_SLOW_BURN = "tony.slo.slow-burn"
+SLO_SLOW_WINDOW_MS = "tony.slo.slow-window-ms"
+SLO_SINK = "tony.slo.sink"  # budget-window JSONL; empty → <staging>/<app>/slo.jsonl
+
+# ---------------------------------------------------------------------------
 # tony.train.* — step-path knobs of the framework train loop (docs/performance.md)
 # ---------------------------------------------------------------------------
 # Input-pipeline lookahead: batch N+1 is assembled (loader read / synthetic
@@ -643,6 +670,18 @@ DEFAULTS: dict[str, str] = {
     ALERTS_QUEUE_DEPTH: "",
     ALERTS_SINK: "",
     ALERTS_WEBHOOK: "",
+
+    SLO_WINDOW_MS: "3600000",
+    SLO_BUCKET_MS: "5000",
+    SLO_SERVE_TTFT_TARGET: "",
+    SLO_SERVE_TTFT_THRESHOLD_MS: "",  # empty → tony.serve.market.slo-ttft-ms
+    SLO_SERVE_AVAILABILITY_TARGET: "",
+    SLO_TRAIN_GOODPUT_TARGET: "",
+    SLO_FAST_BURN: "14.4",
+    SLO_FAST_WINDOW_MS: "300000",
+    SLO_SLOW_BURN: "6.0",
+    SLO_SLOW_WINDOW_MS: "1800000",
+    SLO_SINK: "",
 
     TRAIN_PREFETCH_DEPTH: "2",
     TRAIN_INPUT_WAIT_SPAN_MS: "25",

@@ -67,6 +67,10 @@ ENV_TRACE_ENABLED = "TONY_TRACE_ENABLED"  # "1" → tracing on in this process t
 ENV_TRACE_DIR = "TONY_TRACE_DIR"          # span JSONL sink dir (<staging>/trace)
 ENV_TRACE_PARENT = "TONY_TRACE_PARENT"    # parent span id for this process's root span
 ENV_METRICS_ENABLED = "TONY_METRICS_ENABLED"  # "0" → child metrics recording off (tony.metrics.enabled)
+# SLO contract (tony.slo.*): serve children align a TTFT histogram bucket
+# edge to this threshold so good/bad request counts are exact, not
+# interpolated (obs/slo.py)
+ENV_SLO_TTFT_MS = "TONY_SLO_TTFT_MS"
 # Structured-logging contract across process spawns (tony.log.*): the
 # executor exports these so the training child's JSONL records land in the
 # same <staging>/logs/ aggregate `tony logs` merges

@@ -38,6 +38,10 @@ GATE_METRICS: dict[str, int] = {
     "step_time_ms": -1,
     "ttft_p99_ms": -1,      # SERVE_BENCH: tail time-to-first-token
     "ttft_p95_ms": -1,
+    # SERVE_BENCH SLO lane (tony loadtest + obs/slo.py): the share of the
+    # error budget the run burned regresses upward; the verdict itself is a
+    # must-be-PASS contract below (same discipline as kernel_smoke)
+    "budget_burned_pct": -1,
     # CBENCH family (tony cbench, docs/performance.md "Control-plane
     # scalability"): the five control-plane throughputs regress downward,
     # their latency tails and the restart-replay wall regress upward.
@@ -151,6 +155,9 @@ def validate_record(record: dict[str, Any], *, wrapper: bool = True) -> list[str
     smoke = p.get("kernel_smoke")
     if smoke is not None and smoke_fraction(smoke) is None:
         errors.append(f"kernel_smoke not 'passed/total': {smoke!r}")
+    sv = p.get("slo_verdict")
+    if sv is not None and str(sv) not in ("PASS", "FAIL", "NO_DATA"):
+        errors.append(f"slo_verdict not PASS/FAIL/NO_DATA: {sv!r}")
     return errors
 
 
@@ -347,6 +354,19 @@ def evaluate(
             note="WARNING: no 'sizes' block in the cbench record — rounds "
                  "must carry the tony.cbench.* scale they measured at "
                  "(tony cbench records it by default)"))
+
+    # SLO verdict contract (SERVE_BENCH family): a record carrying an SLO
+    # verdict must carry PASS — same must-hold shape as kernel_smoke, with
+    # NO_DATA failing too (a loadtest that produced no windows measured
+    # nothing and must not gate green)
+    sv = cur.get("slo_verdict")
+    if sv is not None:
+        ok = str(sv) == "PASS"
+        checks.append(GateCheck(
+            metric="slo_verdict", current=1.0 if ok else 0.0, reference=1.0,
+            reference_from="contract", threshold_pct=0.0, direction=+1,
+            passed=ok,
+            note="" if ok else f"SLO verdict {sv!r} (contract: PASS)"))
 
     frac = smoke_fraction(cur.get("kernel_smoke")) if "kernel_smoke" in cur else None
     if frac is not None:

@@ -353,6 +353,60 @@ def sweep_cluster_series(
     return counts
 
 
+def sweep_slo_series(
+    store: HistoryStore,
+    staging_roots: list[str],
+    retention_days: float = 0.0,
+    now_ms: int | None = None,
+) -> dict[str, int]:
+    """One pass over every staged app's ``slo.jsonl`` (the AM's SLO engine
+    appends one budget-bucket row per objective per tick, obs/slo.py
+    ``append_windows``) into the store's ``slo_series`` table, then
+    retention.
+
+    Same discipline as the cluster-series sweep: idempotent (rows REPLACE on
+    (source, objective, bucket) and the AM re-emits the current bucket with
+    fuller counts each tick, so the last write for a bucket wins), torn-tail
+    tolerant (a line the AM died mid-append is skipped), per-file error
+    isolation. This is what makes ``tony slo verdict`` readable from history
+    alone — no live AM required."""
+    import json as _json
+
+    counts = {"files": 0, "rows": 0, "errors": 0, "purged_rows": 0}
+    for root in staging_roots:
+        for app_id in obs_artifacts.staged_ids(root):
+            path = os.path.join(root, app_id, "slo.jsonl")
+            if not os.path.isfile(path):
+                continue
+            try:
+                rows: list[dict[str, Any]] = []
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            doc = _json.loads(line)
+                        except ValueError:
+                            continue  # torn tail / partial append
+                        if isinstance(doc, dict):
+                            rows.append(doc)
+                counts["rows"] += store.put_slo_windows(
+                    str(rows[0].get("app_id") or app_id) if rows else app_id,
+                    rows)
+                counts["files"] += 1
+            except Exception as e:  # noqa: BLE001 — one bad file must not stall the sweep
+                counts["errors"] += 1
+                obs_logging.warning(
+                    f"[tony-history] slo-series ingest of {path} failed: "
+                    f"{type(e).__name__}: {e}")
+    if retention_days > 0:
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        cutoff = now - int(retention_days * 86_400_000)
+        counts["purged_rows"] = store.purge_slo_older_than(cutoff)
+    return counts
+
+
 def gc_staging(
     store: HistoryStore,
     staging_root: str,

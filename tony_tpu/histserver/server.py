@@ -137,6 +137,13 @@ class HistoryServer:
                 retention_days=self.retention_days)
             counts["cluster_windows"] = ccounts["windows"]
             counts["cluster_errors"] = ccounts["errors"]
+        scounts = _ingest.sweep_slo_series(
+            self.store, self.staging_roots,
+            retention_days=self.retention_days)
+        if scounts["rows"]:
+            counts["slo_rows"] = scounts["rows"]
+        if scounts["errors"]:
+            counts["slo_errors"] = scounts["errors"]
         if self.gc_enabled and self.retention_days > 0:
             for root in self.staging_roots:
                 removed = _ingest.gc_staging(self.store, root, self.retention_days)

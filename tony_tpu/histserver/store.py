@@ -19,6 +19,14 @@ The persistence layer under the history server (docs/history.md). Schema:
   converges — same idempotence discipline as jobs. The portal's
   ``/history`` capacity dashboards chart these across runs
   (docs/scheduling.md "Explaining decisions").
+- ``slo_series``: per-app SLO budget buckets (obs/slo.py appends one JSONL
+  row per objective per tick to the app's ``slo.jsonl``;
+  ``ingest.sweep_slo_series`` folds them in). Keyed
+  (source, objective, window_start_ms) with REPLACE semantics — the AM
+  re-emits the CURRENT bucket each tick with fuller counts, so the last
+  write wins and re-sweeping converges. ``tony slo verdict`` aggregates
+  these (good/bad sums per objective) instead of trusting any in-process
+  state (docs/observability.md "SLOs & error budgets").
 
 Writes are idempotent by construction: :meth:`HistoryStore.put_job` replaces
 the job row and its series in one transaction, so re-ingesting a job (the
@@ -92,6 +100,22 @@ CREATE TABLE IF NOT EXISTS cluster_series (
 );
 CREATE INDEX IF NOT EXISTS cluster_series_by_metric
   ON cluster_series (metric, source, queue);
+CREATE TABLE IF NOT EXISTS slo_series (
+  source TEXT NOT NULL,
+  objective TEXT NOT NULL,
+  window_start_ms INTEGER NOT NULL,
+  window_end_ms INTEGER DEFAULT 0,
+  good INTEGER DEFAULT 0,
+  bad INTEGER DEFAULT 0,
+  burn_fast REAL,
+  burn_slow REAL,
+  budget_remaining REAL,
+  target REAL DEFAULT 0.0,
+  unit TEXT DEFAULT '',
+  PRIMARY KEY (source, objective, window_start_ms)
+);
+CREATE INDEX IF NOT EXISTS slo_series_by_objective
+  ON slo_series (objective, source);
 """
 
 #: jobs columns callers may pass into put_job (summary/config are JSON'd)
@@ -233,6 +257,75 @@ class HistoryStore:
                 self._db.rollback()
                 raise
         return len(rows)
+
+    # ----------------------------------------------------- SLO telemetry
+    def put_slo_windows(self, source: str, rows: list[dict[str, Any]]) -> int:
+        """Fold SLO budget-bucket rows (obs/slo.py ``window_rows`` shape)
+        into ``slo_series`` — one row per (source, objective, bucket),
+        REPLACE on the primary key. The AM appends a fresh row for the
+        CURRENT bucket every tick, so later sweeps overwrite earlier
+        partial counts with fuller ones: the last write for a bucket is the
+        complete one, and re-sweeping converges. Returns rows written."""
+        tuples = [
+            (source, str(r["objective"]),
+             int(r["window_start_ms"]), int(r.get("window_end_ms") or 0),
+             int(r.get("good") or 0), int(r.get("bad") or 0),
+             r.get("burn_fast"), r.get("burn_slow"),
+             r.get("budget_remaining"), float(r.get("target") or 0.0),
+             str(r.get("unit") or ""))
+            for r in rows
+            if r.get("objective") and r.get("window_start_ms") is not None
+        ]
+        if not tuples:
+            return 0
+        with self._lock:
+            try:
+                self._db.executemany(
+                    "INSERT OR REPLACE INTO slo_series "
+                    "(source, objective, window_start_ms, window_end_ms, "
+                    " good, bad, burn_fast, burn_slow, budget_remaining, "
+                    " target, unit) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    tuples)
+                self._db.commit()
+            except Exception:
+                self._db.rollback()
+                raise
+        return len(tuples)
+
+    def slo_series(
+        self, objective: str | None = None, source: str | None = None,
+        since_ms: int = 0, limit: int = 0,
+    ) -> list[dict[str, Any]]:
+        """SLO budget-bucket rows oldest first — what ``tony slo verdict``
+        and the portal's ``/slo`` history strip aggregate over."""
+        q = ("SELECT source, objective, window_start_ms, window_end_ms, "
+             "good, bad, burn_fast, burn_slow, budget_remaining, target, unit "
+             "FROM slo_series WHERE 1=1")
+        params: list[Any] = []
+        if objective is not None:
+            q += " AND objective = ?"
+            params.append(objective)
+        if source is not None:
+            q += " AND source = ?"
+            params.append(source)
+        if since_ms:
+            q += " AND window_start_ms > ?"
+            params.append(since_ms)
+        q += " ORDER BY window_start_ms"
+        with self._lock:
+            rows = self._db.execute(q, params).fetchall()
+        out = [dict(r) for r in rows]
+        return out[-limit:] if limit else out
+
+    def purge_slo_older_than(self, cutoff_ms: int) -> int:
+        """Retention for SLO buckets (same sweep discipline as cluster
+        telemetry): buckets that ENDED before ``cutoff_ms`` are dropped."""
+        with self._lock:
+            cur = self._db.execute(
+                "DELETE FROM slo_series WHERE window_end_ms > 0 "
+                "AND window_end_ms < ?", (cutoff_ms,))
+            self._db.commit()
+            return cur.rowcount
 
     def cluster_series(
         self, metric: str, queue: str | None = None, source: str | None = None,

@@ -56,6 +56,7 @@ from tony_tpu.models.llama import PRESETS, init
 from tony_tpu.models.serving import ContinuousBatcher
 from tony_tpu.obs import logging as obs_logging
 from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import trace as obs_trace
 
 # Serving instruments (obs registry, satellite of the training child's:
 # snapshots drop at <train-metrics-file>.obs and ride the executor's
@@ -85,9 +86,10 @@ class RequestStream:
     the client-disconnect/deadline path: the engine thread picks the flag
     up within one decode chunk and frees the slot/pages."""
 
-    __slots__ = ("q", "cancelled", "submitted_s", "last_fanout_s")
+    __slots__ = ("q", "cancelled", "submitted_s", "last_fanout_s",
+                 "request_id", "span", "stage")
 
-    def __init__(self, maxsize: int = 0):
+    def __init__(self, maxsize: int = 0, request_id: str = ""):
         self.q: queue.Queue = queue.Queue(maxsize)
         self.cancelled = threading.Event()
         # instrument timestamps (engine-thread only): TTFT measures from
@@ -95,6 +97,13 @@ class RequestStream:
         # client actually experiences
         self.submitted_s = time.time()
         self.last_fanout_s = 0.0
+        #: router-propagated id (X-Tony-Request-Id) — exemplar + span key
+        self.request_id = request_id
+        # per-request span chain (queue → prefill → decode) under one
+        # serve.request umbrella; both stay None with tracing disabled, so
+        # every hot-path hook below is a single attribute check
+        self.span = None
+        self.stage = None
 
     def get(self, timeout: float | None = None):
         return self.q.get(timeout=timeout)
@@ -104,6 +113,28 @@ class RequestStream:
 
     def cancel(self) -> None:
         self.cancelled.set()
+
+    # ------------------------------------------------------ request spans
+    def open_trace(self) -> None:
+        """Start the serve.request umbrella + its queue stage (no-op — and
+        allocation-free — when tracing is disabled)."""
+        self.span = obs_trace.start_manual("serve.request", rid=self.request_id)
+        if self.span is not None:
+            self.stage = obs_trace.start_manual(
+                "serve.queue", parent_id=self.span.span_id)
+
+    def begin_stage(self, name: str, **attrs: Any) -> None:
+        """End the current stage span and open the next one in the chain."""
+        if self.span is not None:
+            obs_trace.end_manual(self.stage)
+            self.stage = obs_trace.start_manual(
+                name, parent_id=self.span.span_id, **attrs)
+
+    def finish_trace(self, status: str = "ok") -> None:
+        if self.span is not None:
+            obs_trace.end_manual(self.stage, status)
+            obs_trace.end_manual(self.span, status)
+            self.span = self.stage = None
 
 
 class EngineServer:
@@ -162,17 +193,23 @@ class EngineServer:
     def submit(
         self, prompt_tokens: list[int], max_tokens: int,
         sampling: dict | None = None, timeout_s: float | None = None,
+        request_id: str = "",
     ) -> RequestStream:
         """Enqueue a request; returns the stream its events arrive on:
         ("tokens", [..]) zero or more times, then ("done", all_tokens) —
         or ("error", message). ``sampling``: per-request temperature /
         top_k / top_p overrides. ``timeout_s`` overrides the server's
-        default per-request deadline (0/None → no deadline)."""
-        out = RequestStream(self.STREAM_QUEUE_CHUNKS)
+        default per-request deadline (0/None → no deadline).
+        ``request_id``: router-propagated id for spans/exemplars."""
+        out = RequestStream(self.STREAM_QUEUE_CHUNKS, request_id=request_id)
+        # span chain opens BEFORE the inbox put: once the engine thread can
+        # see the stream, only it touches the spans
+        out.open_trace()
         with self._admit_lock:
             if self._draining.is_set() or self.error is not None:
                 out.put(("error", "server is draining" if self.error is None
                          else f"engine failed: {self.error}"))
+                out.finish_trace("error")
                 return out
             timeout = timeout_s if timeout_s is not None else self.request_timeout_s
             # the deadline clock starts at SUBMISSION, so time spent queued
@@ -184,6 +221,7 @@ class EngineServer:
                                         deadline_abs, out))
             except queue.Full:
                 out.put(("error", "overloaded: admission queue full"))
+                out.finish_trace("error")
         return out
 
     def _queue_depth(self) -> int:
@@ -241,6 +279,7 @@ class EngineServer:
                 _REQUESTS_DONE.inc(len(self._streams), outcome="error")
             for out in self._streams.values():
                 self._finish_stream(out, ("error", f"engine failed: {e}"))
+                out.finish_trace("error")
             self._streams.clear()
             if self._on_fatal is not None:
                 self._on_fatal()
@@ -294,6 +333,7 @@ class EngineServer:
                 )
                 self.requests_cancelled += 1
                 _REQUESTS_DONE.inc(outcome="cancelled")
+                stream.finish_trace("error")
                 del self._streams[rid]
                 self._deadlines.pop(rid, None)
 
@@ -313,18 +353,22 @@ class EngineServer:
                     except queue.Empty:
                         break
                 if out.cancelled.is_set():
+                    out.finish_trace("error")
                     continue  # client gone before the engine ever saw it
                 if deadline and time.time() > deadline:
                     out.put(("error", "deadline exceeded"))
                     self.requests_cancelled += 1
                     _REQUESTS_DONE.inc(outcome="cancelled")
+                    out.finish_trace("error")
                     continue  # expired while queued in the inbox
                 try:
                     rid = eng.submit(prompt, max_tokens, **sampling)
                 except (ValueError, TypeError) as e:
                     out.put(("error", str(e)))
+                    out.finish_trace("error")
                     continue
                 self._streams[rid] = out
+                out.begin_stage("serve.prefill")
                 if deadline:
                     self._deadlines[rid] = deadline
             self._sweep_cancellations()
@@ -348,7 +392,11 @@ class EngineServer:
                     if out.last_fanout_s:
                         _TOKEN_LATENCY.observe((now_s - out.last_fanout_s) / len(toks))
                     else:
-                        _TTFT.observe(now_s - out.submitted_s)
+                        ttft = now_s - out.submitted_s
+                        # worst-offender exemplars: id-carrying requests link
+                        # a burning TTFT SLO straight to their trace
+                        _TTFT.observe(ttft, exemplar=out.request_id or None)
+                        out.begin_stage("serve.decode", ttft_s=round(ttft, 6))
                     out.last_fanout_s = now_s
                 self.tokens_out += len(toks)
                 if done:
@@ -360,6 +408,7 @@ class EngineServer:
                     self._finish_stream(
                         out, ("done", final if final is not None else toks)
                     )
+                    out.finish_trace("ok")
                     del self._streams[rid]
                     self._deadlines.pop(rid, None)
                 else:
@@ -444,7 +493,9 @@ class _Handler(BaseHTTPRequestHandler):
         except (TypeError, ValueError, json.JSONDecodeError) as e:
             self._reply(400, {"error": str(e)})
             return
-        out = self.server_ref.submit(prompt, max_tokens, sampling, timeout_s=timeout_s)
+        request_id = (self.headers.get("X-Tony-Request-Id") or "").strip()
+        out = self.server_ref.submit(prompt, max_tokens, sampling,
+                                     timeout_s=timeout_s, request_id=request_id)
         if stream:
             self._stream_response(out)
         else:
@@ -767,10 +818,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--request-timeout-s", type=float, default=0.0,
                    help="default per-request deadline (0 = none); requests "
                         "may override via the timeout_s body field")
+    p.add_argument("--slo-ttft-ms", type=float,
+                   default=float(os.environ.get(constants.ENV_SLO_TTFT_MS, "0") or 0),
+                   help="align a TTFT histogram bucket edge to this SLO "
+                        "threshold (exact good/bad counts; default from "
+                        "TONY_SLO_TTFT_MS, 0 = off)")
     args = p.parse_args(argv)
 
     if os.environ.get(constants.ENV_METRICS_ENABLED) == "0":
         obs_metrics.set_enabled(False)  # job opted out (tony.metrics.enabled)
+    if args.slo_ttft_ms > 0:
+        _TTFT.ensure_bucket(args.slo_ttft_ms / 1000.0)
+    # per-request span chain sink (no-op unless the executor exported the
+    # tracing contract — the training child's init_from_env, reused)
+    obs_trace.init_from_env()
     done = threading.Event()
     srv = EngineServer(
         build_engine(args), on_fatal=done.set,

@@ -120,6 +120,10 @@ class Gauge(_Metric):
             return float(self._children.get(key, 0.0))
 
 
+#: Worst-offender exemplars kept per histogram label child (highest values).
+EXEMPLAR_K = 5
+
+
 class Histogram(_Metric):
     """Fixed-bucket histogram (per-bucket increments; cumulated at render)."""
 
@@ -133,7 +137,26 @@ class Histogram(_Metric):
             raise ValueError(f"{name}: buckets must be finite and non-empty")
         self.buckets = tuple(bs)
 
-    def observe(self, value: float, **labels: Any) -> None:
+    def ensure_bucket(self, bound: float) -> None:
+        """Insert a bucket boundary (idempotent) — e.g. the configured SLO
+        TTFT threshold, so good/bad request counts are exact from cumulative
+        bucket counts rather than interpolated. Call at process startup:
+        observations recorded before the insert stay in their original
+        (coarser) bucket, so a mid-stream insert undercounts at the new edge.
+        """
+        b = float(bound)
+        if not math.isfinite(b) or b <= 0:
+            raise ValueError(f"{self.name}: SLO bucket bound must be finite and > 0")
+        with self._lock:
+            if b in self.buckets:
+                return
+            merged = sorted(self.buckets + (b,))
+            idx = merged.index(b)
+            self.buckets = tuple(merged)
+            for child in self._children.values():
+                child["counts"].insert(idx, 0)
+
+    def observe(self, value: float, exemplar: Any = None, **labels: Any) -> None:
         if not _enabled:
             return
         key = self._key(labels)
@@ -143,6 +166,7 @@ class Histogram(_Metric):
                 # [per-bucket counts..., overflow], sum, count
                 child = self._children[key] = {
                     "counts": [0] * (len(self.buckets) + 1), "sum": 0.0, "count": 0,
+                    "exemplars": [],
                 }
             for i, ub in enumerate(self.buckets):
                 if value <= ub:
@@ -152,6 +176,25 @@ class Histogram(_Metric):
                 child["counts"][-1] += 1
             child["sum"] += value
             child["count"] += 1
+            if exemplar is not None:
+                # worst-K by value: lets an operator jump from a burning
+                # latency SLO straight to the offending request ids
+                ex = child["exemplars"]
+                ex.append((float(value), str(exemplar)))
+                ex.sort(key=lambda t: -t[0])
+                del ex[EXEMPLAR_K:]
+
+    def _snapshot_children(self) -> "tuple[list[float], list[tuple[tuple[str, ...], dict]]]":
+        # buckets + children under ONE lock: ensure_bucket resizes counts in
+        # place, and reading them separately could tear bucket/count lengths
+        with self._lock:
+            return list(self.buckets), [
+                (k, {
+                    "counts": list(v["counts"]), "sum": v["sum"], "count": v["count"],
+                    "exemplars": [list(e) for e in v.get("exemplars", ())],
+                })
+                for k, v in self._children.items()
+            ]
 
 
 class MetricsRegistry:
@@ -199,13 +242,15 @@ class MetricsRegistry:
                 "labelnames": list(m.labelnames), "samples": [],
             }
             if isinstance(m, Histogram):
-                entry["buckets"] = list(m.buckets)
-                for key, child in m._label_dicts():
+                buckets, children = m._snapshot_children()
+                entry["buckets"] = buckets
+                for key, child in children:
                     entry["samples"].append({
                         "labels": dict(zip(m.labelnames, key)),
-                        "counts": list(child["counts"]),
+                        "counts": child["counts"],
                         "sum": child["sum"],
                         "count": child["count"],
+                        "exemplars": child["exemplars"],
                     })
             else:
                 for key, value in m._label_dicts():

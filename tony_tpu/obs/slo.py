@@ -1,0 +1,553 @@
+"""SLO objectives, error-budget ledgers, and multi-window burn-rate alerting.
+
+The judgement layer over the telemetry the stack already collects: operators
+declare *objectives* as plain ``tony.slo.*`` config keys and this module
+turns the raw counters (serve TTFT histograms, request-outcome counters, the
+train goodput ledger) into
+
+- an **error-budget ledger** per objective — exact good/bad accounting over
+  a trailing compliance window, bucketed at ``tony.slo.bucket-ms`` grain,
+  reset-safe against replica restarts (the same exactness contract as
+  goodput's wall-time partition, property-tested the same way);
+- **multi-window multi-burn-rate alert rules** (SRE-workbook shape: a
+  fast-burn page and a slow-burn warn, each confirmed by a short secondary
+  window so rules resolve promptly once the burn actually stops) compiled
+  into the AM's edge-triggered :class:`~tony_tpu.obs.alerts.AlertEngine`,
+  with rule names prefixed ``slo-`` so the emit loop publishes them as
+  ``SLO_BURN_ALERT`` / ``SLO_BURN_RESOLVED`` events;
+- ``tony_slo_budget_remaining`` / ``tony_slo_burn_rate`` gauges, a status
+  document for ``tony slo`` / the portal ``/slo`` page, and per-bucket
+  JSONL window rows (``<staging>/<app>/slo.jsonl``) the history server
+  ingests into ``slo_series`` so verdicts survive the AM.
+
+================================  ============================================
+``tony.slo.serve-ttft-target``    fraction of requests whose TTFT must land
+                                  under ``serve-ttft-threshold-ms`` (empty
+                                  threshold inherits the capacity market's
+                                  ``tony.serve.market.slo-ttft-ms``)
+``tony.slo.serve-availability-target``  fraction of requests finishing
+                                  without server error
+``tony.slo.train-goodput-target``  windowed goodput-ms floor (unit is
+                                  milliseconds, not requests — the ledger
+                                  partition feeds it)
+================================  ============================================
+
+Exactness matters here the same way it does for goodput: the serve TTFT
+histogram grows a bucket edge aligned to the configured threshold
+(:meth:`~tony_tpu.obs.metrics.Histogram.ensure_bucket`), so good/bad counts
+come straight off cumulative bucket counts — never interpolated — and the
+``tony slo verdict`` read from history is count-exact, not estimated.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from dataclasses import dataclass
+from typing import Any, Iterable, Mapping
+
+from tony_tpu.obs import alerts as obs_alerts
+from tony_tpu.obs import logging as obs_logging
+from tony_tpu.obs import metrics as obs_metrics
+
+_BUDGET_REMAINING = obs_metrics.gauge(
+    "tony_slo_budget_remaining",
+    "fraction of the compliance-window error budget left, per objective",
+    labelnames=("objective",))
+_BURN_RATE = obs_metrics.gauge(
+    "tony_slo_burn_rate",
+    "error-budget burn rate per objective over the fast/slow alert windows",
+    labelnames=("objective", "window"))
+
+#: Alert-rule name prefix the AM's emit loop branches on to publish SLO
+#: transitions as SLO_BURN_ALERT/SLO_BURN_RESOLVED instead of ALERT_*.
+RULE_PREFIX = "slo-"
+
+#: objective vocabulary: name → unit of its good/bad counts.
+OBJECTIVES: dict[str, str] = {
+    "serve-ttft": "requests",
+    "serve-availability": "requests",
+    "train-goodput": "ms",
+}
+
+
+@dataclass(frozen=True)
+class Objective:
+    name: str                  # one of OBJECTIVES
+    target: float              # good fraction promised, 0 < target < 1
+    unit: str = "requests"
+    threshold_ms: float | None = None   # serve-ttft: the aligned bucket edge
+
+    @property
+    def allowed_bad_fraction(self) -> float:
+        return 1.0 - self.target
+
+
+def objectives_from_config(config) -> list[Objective]:
+    """Parse ``tony.slo.*`` into objectives; empty targets are disabled,
+    unparseable values a loud no (mirrors alerts.rules_from_config)."""
+    from tony_tpu.config import keys
+
+    def target_of(key: str) -> float | None:
+        raw = config.get(key)
+        if raw in (None, ""):
+            return None
+        try:
+            t = float(raw)
+        except ValueError as e:
+            raise ValueError(f"{key}={raw!r} is not a number") from e
+        if not 0.0 < t < 1.0:
+            raise ValueError(f"{key}={raw!r} must be a fraction in (0, 1)")
+        return t
+
+    out: list[Objective] = []
+    t = target_of(keys.SLO_SERVE_TTFT_TARGET)
+    if t is not None:
+        raw_thr = config.get(keys.SLO_SERVE_TTFT_THRESHOLD_MS)
+        if raw_thr in (None, ""):
+            raw_thr = config.get(keys.SERVE_MARKET_SLO_TTFT_MS) or "2000"
+        thr = float(raw_thr)
+        if not (math.isfinite(thr) and thr > 0):
+            raise ValueError(f"slo serve-ttft threshold {raw_thr!r} must be > 0 ms")
+        out.append(Objective("serve-ttft", t, "requests", thr))
+    t = target_of(keys.SLO_SERVE_AVAILABILITY_TARGET)
+    if t is not None:
+        out.append(Objective("serve-availability", t, "requests"))
+    t = target_of(keys.SLO_TRAIN_GOODPUT_TARGET)
+    if t is not None:
+        out.append(Objective("train-goodput", t, "ms"))
+    return out
+
+
+class BudgetLedger:
+    """Exact good/bad accounting for one objective over a trailing window.
+
+    Ingests **cumulative** (good_total, bad_total) counter samples — the
+    shape registry snapshots give us — per source (task identity), deltas
+    them against a watermark, and banks the deltas into fixed-width time
+    buckets. A counter running backwards is a process restart: the fresh
+    totals ARE the delta (nothing is lost, nothing double-counted).
+
+    The exactness contract (property-tested like goodput's partition):
+    ``total ingested == expired out the window + still banked in buckets``
+    at every point in time, for any interleaving of ingests, advances,
+    window boundaries, and counter resets.
+    """
+
+    def __init__(self, objective: Objective, window_ms: int, bucket_ms: int):
+        window_ms, bucket_ms = int(window_ms), int(bucket_ms)
+        if window_ms <= 0 or bucket_ms <= 0 or bucket_ms > window_ms:
+            raise ValueError(
+                f"slo {objective.name}: need 0 < bucket-ms ({bucket_ms}) "
+                f"<= window-ms ({window_ms})")
+        self.objective = objective
+        self.window_ms = window_ms
+        self.bucket_ms = bucket_ms
+        self._buckets: dict[int, list[int]] = {}       # bucket start → [good, bad]
+        self._last: dict[str, tuple[int, int]] = {}    # source → cumulative watermark
+        self.total_good = 0
+        self.total_bad = 0
+        self.expired_good = 0
+        self.expired_bad = 0
+
+    def ingest(self, source: str, good_total: int, bad_total: int,
+               now_ms: int) -> tuple[int, int]:
+        """Account one cumulative sample; returns the (good, bad) delta banked."""
+        g, b = int(good_total), int(bad_total)
+        last = self._last.get(source)
+        if last is None:
+            dg, db = g, b
+        else:
+            dg, db = g - last[0], b - last[1]
+            if dg < 0 or db < 0:   # counter reset: restarted source starts fresh
+                dg, db = g, b
+        self._last[source] = (g, b)
+        if dg or db:
+            start = (int(now_ms) // self.bucket_ms) * self.bucket_ms
+            cell = self._buckets.get(start)
+            if cell is None:
+                cell = self._buckets[start] = [0, 0]
+            cell[0] += dg
+            cell[1] += db
+            self.total_good += dg
+            self.total_bad += db
+        return dg, db
+
+    def forget(self, source: str) -> None:
+        """Drop a source's watermark (task gone); its banked history stays."""
+        self._last.pop(source, None)
+
+    def advance(self, now_ms: int) -> None:
+        """Expire buckets that fell wholly out of the compliance window."""
+        edge = int(now_ms) - self.window_ms
+        for start in [s for s in self._buckets if s + self.bucket_ms <= edge]:
+            g, b = self._buckets.pop(start)
+            self.expired_good += g
+            self.expired_bad += b
+
+    def window_counts(self, now_ms: int,
+                      window_ms: int | None = None) -> tuple[int, int]:
+        """(good, bad) banked within the trailing ``window_ms`` (≤ the
+        compliance window; buckets overlapping the edge count whole — the
+        grain of truth is the bucket, never a fraction of one)."""
+        now = int(now_ms)
+        w = self.window_ms if window_ms is None else min(int(window_ms), self.window_ms)
+        lo = now - w
+        good = bad = 0
+        for start, (g, b) in self._buckets.items():
+            if start + self.bucket_ms > lo and start <= now:
+                good += g
+                bad += b
+        return good, bad
+
+    def bucket_counts(self, now_ms: int) -> tuple[int, int, int]:
+        """(bucket_start_ms, good, bad) for the bucket ``now_ms`` lands in."""
+        start = (int(now_ms) // self.bucket_ms) * self.bucket_ms
+        cell = self._buckets.get(start) or (0, 0)
+        return start, int(cell[0]), int(cell[1])
+
+    def burn_rate(self, now_ms: int, window_ms: int | None = None) -> float | None:
+        """bad-fraction / allowed-bad-fraction over the window; 1.0 burns the
+        budget in exactly one compliance window. None = no traffic (no data
+        must neither fire nor resolve, same contract as AlertEngine)."""
+        good, bad = self.window_counts(now_ms, window_ms)
+        total = good + bad
+        if total == 0:
+            return None
+        allowed = self.objective.allowed_bad_fraction
+        if allowed <= 0.0:
+            return math.inf if bad else 0.0
+        return (bad / total) / allowed
+
+    def budget_remaining(self, now_ms: int) -> float:
+        """Fraction of the window's error budget left (budget = allowed bad
+        count given the observed volume); clamped at 0."""
+        good, bad = self.window_counts(now_ms)
+        allowed = self.objective.allowed_bad_fraction * (good + bad)
+        if allowed <= 0.0:
+            return 1.0 if bad == 0 else 0.0
+        return max(0.0, 1.0 - bad / allowed)
+
+
+# --------------------------------------------------------------- extraction
+def _snapshot_metric(snapshot: Iterable[Mapping[str, Any]],
+                     name: str) -> Mapping[str, Any] | None:
+    for m in snapshot or ():
+        if m.get("name") == name:
+            return m
+    return None
+
+
+def ttft_good_bad(snapshot: Iterable[Mapping[str, Any]],
+                  threshold_ms: float,
+                  name: str = "tony_serve_ttft_seconds") -> tuple[int, int] | None:
+    """Cumulative (good, bad) request counts from a TTFT histogram snapshot:
+    good = cumulative count at the largest bucket edge ≤ threshold. Exact
+    when the engine inserted the SLO-aligned edge (ensure_bucket)."""
+    m = _snapshot_metric(snapshot, name)
+    if m is None:
+        return None
+    thr_s = float(threshold_ms) / 1000.0
+    buckets = m.get("buckets") or []
+    good = total = 0
+    for sample in m.get("samples", []):
+        cum = 0
+        at_thr = 0
+        for ub, n in zip(buckets, sample.get("counts", [])):
+            cum += int(n)
+            if float(ub) <= thr_s + 1e-9:
+                at_thr = cum
+            else:
+                break
+        good += at_thr
+        total += int(sample.get("count", 0))
+    return good, max(total - good, 0)
+
+
+def availability_good_bad(
+        snapshot: Iterable[Mapping[str, Any]],
+        name: str = "tony_serve_requests_total") -> tuple[int, int] | None:
+    """(non-error, error) finished-request counts by outcome label. A client
+    cancel is not a server error — it spends no availability budget."""
+    m = _snapshot_metric(snapshot, name)
+    if m is None:
+        return None
+    good = bad = 0
+    for sample in m.get("samples", []):
+        v = int(sample.get("value", 0))
+        if sample.get("labels", {}).get("outcome") == "error":
+            bad += v
+        else:
+            good += v
+    return good, bad
+
+
+def ttft_exemplars(snapshot: Iterable[Mapping[str, Any]],
+                   name: str = "tony_serve_ttft_seconds") -> list[tuple[float, str]]:
+    """Worst-offender (ttft_seconds, request_id) exemplars from a snapshot."""
+    m = _snapshot_metric(snapshot, name)
+    if m is None:
+        return []
+    out: list[tuple[float, str]] = []
+    for sample in m.get("samples", []):
+        for e in sample.get("exemplars", ()):
+            try:
+                out.append((float(e[0]), str(e[1])))
+            except (TypeError, ValueError, IndexError):
+                continue
+    out.sort(key=lambda t: -t[0])
+    return out[:obs_metrics.EXEMPLAR_K]
+
+
+# ------------------------------------------------------------------- engine
+class SloEngine:
+    """Objectives + ledgers + burn rules + gauges + the slo.jsonl stream.
+
+    Owned by the AM; fed from the goodput tick (serve registry snapshots per
+    task, the train ledger) and read by the ``get_slo`` RPC. All public
+    methods take the caller's clock so tests drive time deterministically.
+    """
+
+    def __init__(self, config, app_id: str = "", sink_path: str | None = None):
+        from tony_tpu.config import keys
+
+        self.app_id = app_id
+        self.objectives = objectives_from_config(config)
+        self.window_ms = int(config.get(keys.SLO_WINDOW_MS) or "3600000")
+        self.bucket_ms = int(config.get(keys.SLO_BUCKET_MS) or "5000")
+        self.fast_burn = float(config.get(keys.SLO_FAST_BURN) or "14.4")
+        self.fast_window_ms = int(config.get(keys.SLO_FAST_WINDOW_MS) or "300000")
+        self.slow_burn = float(config.get(keys.SLO_SLOW_BURN) or "6.0")
+        self.slow_window_ms = int(config.get(keys.SLO_SLOW_WINDOW_MS) or "1800000")
+        self.sink_path = sink_path or None
+        self.ledgers = {
+            o.name: BudgetLedger(o, self.window_ms, self.bucket_ms)
+            for o in self.objectives
+        }
+        self._exemplars: dict[str, list[tuple[float, str]]] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.objectives)
+
+    def ttft_threshold_ms(self) -> float | None:
+        for o in self.objectives:
+            if o.name == "serve-ttft":
+                return o.threshold_ms
+        return None
+
+    def burn_rules(self) -> list[obs_alerts.AlertRule]:
+        """The rules to append to the AM's AlertEngine: per objective, a
+        fast-burn page and a slow-burn warn (burn rate is unitless ×)."""
+        rules: list[obs_alerts.AlertRule] = []
+        for o in self.objectives:
+            rules.append(obs_alerts.AlertRule(
+                f"{RULE_PREFIX}{o.name}-fast-burn", self.fast_burn, "above", "x"))
+            rules.append(obs_alerts.AlertRule(
+                f"{RULE_PREFIX}{o.name}-slow-burn", self.slow_burn, "above", "x"))
+        return rules
+
+    # ---------------------------------------------------------- ingestion
+    def observe_serve(self, source: str, snapshot: Iterable[Mapping[str, Any]],
+                      now_ms: int) -> None:
+        """Account one serve task's registry snapshot (from task_obs)."""
+        with self._lock:
+            for o in self.objectives:
+                if o.name == "serve-ttft":
+                    gb = ttft_good_bad(snapshot, o.threshold_ms or 0.0)
+                elif o.name == "serve-availability":
+                    gb = availability_good_bad(snapshot)
+                else:
+                    continue
+                if gb is not None:
+                    self.ledgers[o.name].ingest(source, gb[0], gb[1], now_ms)
+            if any(o.name == "serve-ttft" for o in self.objectives):
+                fresh = ttft_exemplars(snapshot)
+                if fresh:
+                    merged = {rid: v for v, rid in self._exemplars.get("serve-ttft", [])}
+                    merged.update({rid: v for v, rid in fresh})
+                    top = sorted(((v, rid) for rid, v in merged.items()),
+                                 key=lambda t: -t[0])
+                    self._exemplars["serve-ttft"] = top[:obs_metrics.EXEMPLAR_K]
+
+    def observe_train(self, source: str, ledger, now_ms: int) -> None:
+        """Account the goodput ledger's exact wall partition: good =
+        productive ms, bad = everything else (cumulative, reset-safe)."""
+        if "train-goodput" not in self.ledgers or ledger is None:
+            return
+        wall = int(ledger.wall_ms)
+        good = int(ledger.phases_ms.get("productive", 0))
+        with self._lock:
+            self.ledgers["train-goodput"].ingest(
+                source, good, max(wall - good, 0), now_ms)
+
+    # --------------------------------------------------------- evaluation
+    def _rule_burn(self, led: BudgetLedger, window_ms: int,
+                   now_ms: int) -> float | None:
+        """Multi-window burn: the long window supplies the sustained signal,
+        a short confirmation window (window/12, floored at one bucket) makes
+        the rule resolve promptly once the burn actually stops. No data in
+        the short window means no *current* burn (min with 0)."""
+        long_burn = led.burn_rate(now_ms, window_ms)
+        if long_burn is None:
+            return None
+        short_w = max(self.bucket_ms, int(window_ms) // 12)
+        short_burn = led.burn_rate(now_ms, short_w)
+        return min(long_burn, short_burn if short_burn is not None else 0.0)
+
+    def tick(self, now_ms: int) -> dict[str, float | None]:
+        """Advance ledgers, refresh the gauges, and return the value per
+        burn rule for AlertEngine.evaluate (None = no data, state holds)."""
+        values: dict[str, float | None] = {}
+        with self._lock:
+            for o in self.objectives:
+                led = self.ledgers[o.name]
+                led.advance(now_ms)
+                fast = self._rule_burn(led, self.fast_window_ms, now_ms)
+                slow = self._rule_burn(led, self.slow_window_ms, now_ms)
+                values[f"{RULE_PREFIX}{o.name}-fast-burn"] = fast
+                values[f"{RULE_PREFIX}{o.name}-slow-burn"] = slow
+                if fast is not None:
+                    _BURN_RATE.set(fast, objective=o.name, window="fast")
+                if slow is not None:
+                    _BURN_RATE.set(slow, objective=o.name, window="slow")
+                _BUDGET_REMAINING.set(led.budget_remaining(now_ms), objective=o.name)
+        return values
+
+    # ----------------------------------------------------------- surfaces
+    def status(self, now_ms: int) -> dict[str, Any]:
+        """The ``tony slo`` / portal document: per objective, the window
+        counts, budget, burn rates, and worst-offender exemplars."""
+        out: dict[str, Any] = {
+            "app_id": self.app_id,
+            "enabled": self.enabled,
+            "window_ms": self.window_ms,
+            "bucket_ms": self.bucket_ms,
+            "fast_burn": self.fast_burn,
+            "fast_window_ms": self.fast_window_ms,
+            "slow_burn": self.slow_burn,
+            "slow_window_ms": self.slow_window_ms,
+            "ts_ms": int(now_ms),
+            "objectives": {},
+        }
+        with self._lock:
+            for o in self.objectives:
+                led = self.ledgers[o.name]
+                good, bad = led.window_counts(now_ms)
+                out["objectives"][o.name] = {
+                    "target": o.target,
+                    "unit": o.unit,
+                    "threshold_ms": o.threshold_ms,
+                    "good": good,
+                    "bad": bad,
+                    "budget_remaining": led.budget_remaining(now_ms),
+                    "burn_fast": self._rule_burn(led, self.fast_window_ms, now_ms),
+                    "burn_slow": self._rule_burn(led, self.slow_window_ms, now_ms),
+                    "exemplars": [
+                        {"value_s": v, "request_id": rid}
+                        for v, rid in self._exemplars.get(o.name, [])
+                    ],
+                }
+        return out
+
+    def window_rows(self, now_ms: int) -> list[dict[str, Any]]:
+        """One row per objective for the bucket ``now_ms`` lands in — the
+        slo.jsonl / slo_series shape. Rewriting the same bucket as it fills
+        is fine: the store keys on (source, objective, window_start_ms) and
+        REPLACEs, so the last write (the fullest) wins."""
+        rows: list[dict[str, Any]] = []
+        with self._lock:
+            for o in self.objectives:
+                led = self.ledgers[o.name]
+                start, good, bad = led.bucket_counts(now_ms)
+                rows.append({
+                    "app_id": self.app_id,
+                    "objective": o.name,
+                    "target": o.target,
+                    "unit": o.unit,
+                    "window_start_ms": start,
+                    "window_end_ms": start + self.bucket_ms,
+                    "good": good,
+                    "bad": bad,
+                    "burn_fast": self._rule_burn(led, self.fast_window_ms, now_ms),
+                    "burn_slow": self._rule_burn(led, self.slow_window_ms, now_ms),
+                    "budget_remaining": led.budget_remaining(now_ms),
+                })
+        return rows
+
+    def append_windows(self, now_ms: int) -> None:
+        """Best-effort slo.jsonl append (same torn-tail discipline as every
+        other artifact; a full disk must never take down the AM)."""
+        if not self.sink_path or not self.enabled:
+            return
+        try:
+            rows = self.window_rows(now_ms)
+            with open(self.sink_path, "a") as f:
+                for row in rows:
+                    f.write(json.dumps(row) + "\n")
+        except OSError as e:
+            obs_logging.warning(f"[tony-slo] sink write failed: {e}")
+
+
+# ------------------------------------------------------------------ verdict
+def verdict_from_rows(rows: Iterable[Mapping[str, Any]], window_ms: int,
+                      now_ms: int) -> dict[str, Any]:
+    """The machine-readable pass/fail over persisted ``slo_series`` rows
+    (history store or slo.jsonl) — deliberately NOT in-process state, so the
+    verdict survives the AM. Counts are summed per objective over the
+    trailing window; an objective passes when its achieved good fraction
+    meets the target it recorded (rows are self-describing). Overall:
+    PASS = every objective with data passes; NO_DATA = nothing in window.
+    """
+    lo = int(now_ms) - int(window_ms)
+    agg: dict[str, dict[str, Any]] = {}
+    for r in rows:
+        try:
+            start = int(r["window_start_ms"])
+            name = str(r["objective"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if start + 1 <= lo or start > now_ms:
+            continue
+        a = agg.setdefault(name, {
+            "good": 0, "bad": 0, "target": float(r.get("target") or 0.0),
+            "unit": str(r.get("unit") or ""), "rows": 0,
+        })
+        a["good"] += int(r.get("good") or 0)
+        a["bad"] += int(r.get("bad") or 0)
+        a["target"] = max(a["target"], float(r.get("target") or 0.0))
+        a["rows"] += 1
+    objectives: dict[str, Any] = {}
+    all_pass = True
+    for name, a in sorted(agg.items()):
+        total = a["good"] + a["bad"]
+        achieved = a["good"] / total if total else None
+        allowed = (1.0 - a["target"]) * total
+        if allowed > 0.0:
+            burned_pct = 100.0 * a["bad"] / allowed
+        else:
+            burned_pct = 0.0 if a["bad"] == 0 else math.inf
+        passed = (achieved is not None
+                  and achieved + 1e-12 >= a["target"])
+        if total and not passed:
+            all_pass = False
+        objectives[name] = {
+            "target": a["target"],
+            "unit": a["unit"],
+            "good": a["good"],
+            "bad": a["bad"],
+            "achieved": achieved,
+            "budget_burned_pct": burned_pct,
+            "rows": a["rows"],
+            "passed": passed if total else None,
+        }
+    with_data = [o for o in objectives.values() if (o["good"] + o["bad"])]
+    verdict = "NO_DATA" if not with_data else ("PASS" if all_pass else "FAIL")
+    return {
+        "verdict": verdict,
+        "window_ms": int(window_ms),
+        "ts_ms": int(now_ms),
+        "objectives": objectives,
+    }

@@ -87,6 +87,37 @@ def maybe_span(name: str, kind: str = "internal", **attrs: Any):
     return tr.span(name, kind=kind, **attrs)
 
 
+def start_manual(name: str, kind: str = "internal", parent_id: str | None = None,
+                 **attrs: Any) -> "Span | None":
+    """A span NOT bound to the thread's context — for lifecycles that cross
+    event-loop iterations (one serve request's queue → prefill → decode
+    chain lives across many engine steps). Returns None when tracing is off:
+    the disabled hot path stays one None check, no Span allocation (same
+    contract as :func:`maybe_span`). Pair with :func:`end_manual`."""
+    tr = _tracer
+    if tr is None:
+        return None
+    if parent_id is None:
+        cur = _CURRENT.get()
+        parent_id = cur.span_id if cur is not None else tr.root_parent
+    span = Span(name, tr.trace_id, _new_span_id(), parent_id, kind, tr.identity)
+    if attrs:
+        span.attrs.update(attrs)
+    return span
+
+
+def end_manual(span: "Span | None", status: str = "ok", **attrs: Any) -> None:
+    """Finish and sink a :func:`start_manual` span (no-op on None)."""
+    tr = _tracer
+    if tr is None or span is None:
+        return
+    if attrs:
+        span.attrs.update(attrs)
+    span.end_ms = time.time() * 1000.0
+    span.status = status
+    tr._write(span)
+
+
 def _new_span_id() -> str:
     return os.urandom(8).hex()
 

@@ -67,7 +67,8 @@ def _page(title: str, body: str) -> bytes:
         f"<!doctype html><html><head><title>{html.escape(title)}</title>"
         f"<style>{_STYLE}</style></head><body><h1>{html.escape(title)}</h1>"
         f'<p><a href="/">← jobs</a> · <a href="/history">history</a> · '
-        f'<a href="/alerts">alerts</a> · <a href="/pool">pool</a> · '
+        f'<a href="/alerts">alerts</a> · <a href="/slo">slo</a> · '
+        f'<a href="/pool">pool</a> · '
         f'<a href="/metrics">metrics</a></p>{body}</body></html>'
     ).encode()
 
@@ -168,6 +169,13 @@ class PortalHandler(BaseHTTPRequestHandler):
                 self._send(self._pool_page())
             elif path == "/alerts":
                 self._send(self._alerts_page())
+            elif path == "/slo":
+                self._send(self._slo_page())
+            elif path == "/api/slo":
+                self._send(
+                    json.dumps(self._fleet_slo()).encode(),
+                    ctype="application/json",
+                )
             elif path == "/history":
                 self._send(self._history_index())
             elif path.startswith("/history/"):
@@ -459,6 +467,81 @@ class PortalHandler(BaseHTTPRequestHandler):
                 "alert_events": payload.get("alert_events") or [],
             })
         return out
+
+    def _fleet_slo(self) -> list[dict]:
+        """Live SLO documents (get_slo RPC) across every RUNNING job with
+        the SLO engine enabled."""
+        out = []
+        for app_id in self._running_ids():
+            try:
+                res = self._am_call(app_id, "get_slo")
+            except Exception:  # noqa: BLE001 — AM mid-exit: skip, not 500
+                continue
+            if res and isinstance(res[0], dict) and res[0].get("enabled"):
+                doc = res[0]
+                doc["app_id"] = doc.get("app_id") or app_id
+                out.append(doc)
+        return out
+
+    def _slo_page(self) -> bytes:
+        """Fleet SLO dashboard: per-objective error-budget bars, burn rates
+        vs the page/warn thresholds, worst-offender request exemplars, and
+        the persisted budget history strip (slo_series)."""
+        blocks = []
+        for doc in self._fleet_slo():
+            app = doc.get("app_id") or "?"
+            alerts = {a.get("rule") for a in doc.get("alerts") or []}
+            rows = []
+            for name, o in sorted((doc.get("objectives") or {}).items()):
+                rem = o.get("budget_remaining")
+                bar = _share_bar({"share_capacity": 1000,
+                                  "used": int((1.0 - (rem or 0.0)) * 1000)}) \
+                    if isinstance(rem, (int, float)) else "—"
+                exem = ", ".join(
+                    f"{e.get('value_s', 0):.3f}s {html.escape(str(e.get('request_id') or ''))}"
+                    for e in (o.get("exemplars") or [])[:3]) or "—"
+                firing = [r for r in alerts if r and name in r]
+                rows.append(
+                    f"<tr><td>{html.escape(name)}</td>"
+                    f"<td>{o.get('target')}</td>"
+                    f"<td>{o.get('good')}</td><td>{o.get('bad')}</td>"
+                    f"<td>{bar}</td>"
+                    f"<td>{o.get('burn_fast') if o.get('burn_fast') is not None else '—'}</td>"
+                    f"<td>{o.get('burn_slow') if o.get('burn_slow') is not None else '—'}</td>"
+                    f"<td>{exem}</td>"
+                    f"<td class=\"FAILED\">{html.escape(', '.join(sorted(firing)))}</td></tr>")
+            blocks.append(
+                f'<h2>{html.escape(app)}'
+                + (' — <b class="FAILED">BURN ALERT</b>' if alerts else "")
+                + "</h2>"
+                "<table><tr><th>objective</th><th>target</th><th>good</th>"
+                "<th>bad</th><th>budget burned</th><th>burn (fast)</th>"
+                "<th>burn (slow)</th><th>worst requests</th><th>firing</th>"
+                f"</tr>{''.join(rows)}</table>")
+        if not blocks:
+            blocks.append("<p>no running jobs with tony.slo.* objectives</p>")
+        # persisted budget history from the ingested slo_series: the page
+        # answers "how did the budget drain" even after the AMs died
+        store = self._store()
+        if store is not None:
+            try:
+                series = store.slo_series()
+                per: dict[tuple[str, str], list[float]] = {}
+                for r in series:
+                    v = r.get("budget_remaining")
+                    if isinstance(v, (int, float)):
+                        per.setdefault(
+                            (r["source"], r["objective"]), []).append(float(v))
+                charts = "".join(
+                    _sparkline(vals, f"{src}:{obj} budget")
+                    for (src, obj), vals in sorted(per.items())
+                    if len(vals) >= 2)
+                if charts:
+                    blocks.append("<h2>budget history (slo_series)</h2>" + charts)
+            finally:
+                store.close()
+        return _page("fleet SLOs", '<p><a href="/api/slo">json</a></p>'
+                     + "".join(blocks))
 
     def _store(self):
         """The history-server store behind the /history pages, or None (no

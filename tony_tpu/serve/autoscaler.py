@@ -11,7 +11,10 @@ every ``tony.serve.autoscale-interval-ms``:
   ``scale-down-utilization``, sustained for ``scale-down-ticks`` samples
   (longer than up: adding capacity is cheap, removing it costs a rebuild);
 - clamped to [``min-replicas``, ``max-replicas``]; no decision while the
-  fleet is mid-restart (zero healthy replicas says nothing about load).
+  fleet is mid-restart (zero healthy replicas says nothing about load);
+- **SLO-aware** when a ``burn`` supplier is wired (tony.slo.*): a serve
+  fast-burn rate >= 1.0 counts as up-pressure and vetoes scale-down — the
+  fleet grows while the error budget is draining, not after the page.
 
 Decisions call the AM's ``resize_jobtype`` RPC — the same rebuild path
 capacity-loss downsizing uses — never a re-submission, so queue placement,
@@ -91,9 +94,18 @@ class Autoscaler:
         interval_s: float = 5.0,
         drain: Callable[[str, int], Any] | None = None,
         drain_timeout_s: float = 10.0,
+        burn: Callable[[], float | None] | None = None,
     ):
         self.health = health
         self._resize = resize
+        #: SLO fast-burn supplier (the AM's get_slo RPC distilled to the
+        #: worst serve-objective fast burn, or None for no data). A burn
+        #: >= 1.0 means the error budget drains faster than the compliance
+        #: window sustains — counted as up-pressure alongside queue depth
+        #: and utilization, so the fleet grows BEFORE the page fires rather
+        #: than after the budget is gone. Optional: None keeps the classic
+        #: load-only policy.
+        self._burn = burn
         #: drain(job_name, index) → {"drained": bool, ...} — the AM's
         #: request_task_drain lever (idempotent poll). None → legacy abrupt
         #: scale-down (resize without draining the victim first).
@@ -126,9 +138,11 @@ class Autoscaler:
                 pass
 
     # ------------------------------------------------------------- decision
-    def decide(self, current: int, sig: FleetSignals) -> int:
-        """Next replica target given the fleet's load signals. Mutates the
-        hysteresis tick counters; returns ``current`` for "hold"."""
+    def decide(self, current: int, sig: FleetSignals,
+               burning: bool = False) -> int:
+        """Next replica target given the fleet's load signals (and the SLO
+        burn flag when a supplier is wired). Mutates the hysteresis tick
+        counters; returns ``current`` for "hold"."""
         p = self.policy
         if sig.replicas_healthy == 0:
             # mid-restart / fleet down: no signal, no decision — and reset
@@ -140,8 +154,13 @@ class Autoscaler:
         want_up = (
             queue_per_replica > p.scale_up_queue_depth
             or sig.utilization > p.scale_up_utilization
+            or burning
         )
-        want_down = sig.queue_depth == 0 and sig.utilization < p.scale_down_utilization
+        # a burning budget also vetoes scale-down: idle slots mean nothing
+        # while the latency objective is missing
+        want_down = (sig.queue_depth == 0
+                     and sig.utilization < p.scale_down_utilization
+                     and not burning)
         self._up_ticks = self._up_ticks + 1 if want_up else 0
         self._down_ticks = self._down_ticks + 1 if want_down else 0
         if self._up_ticks >= p.scale_up_ticks:
@@ -166,7 +185,14 @@ class Autoscaler:
         current = sig.replicas_known or (self.target or 0)
         if current == 0:
             return  # nothing resolved yet
-        target = self.decide(current, sig)
+        burning = False
+        if self._burn is not None:
+            try:
+                b = self._burn()
+                burning = b is not None and b >= 1.0
+            except Exception:  # noqa: BLE001 — AM mid-exit: load signals still decide
+                pass
+        target = self.decide(current, sig, burning=burning)
         _TARGET.set(target)
         if self.pending_down is not None:
             # carry the shrink through even if pressure returned: the drain
@@ -187,6 +213,7 @@ class Autoscaler:
             "autoscale.decision", direction=direction,
             current=current, target=target,
             queue_depth=sig.queue_depth, utilization=round(sig.utilization, 3),
+            slo_burning=burning,
         )
         if direction == "down" and self._drain is not None:
             # drain-aware shrink: the resize retires the HIGHEST index —

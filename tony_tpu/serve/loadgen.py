@@ -30,8 +30,10 @@ the router runs, including inside tier-1 CI against a CPU fleet.
 
 from __future__ import annotations
 
+import bisect
 import http.client
 import json
+import math
 import random
 import threading
 import time
@@ -91,6 +93,38 @@ class LoadSpec:
     vocab: int = 1000          # token id range for synthetic prompts
     timeout_s: float = 120.0   # per-request client deadline
     seed: int = 0
+    profile: str = "uniform"   # arrival shape: "uniform" | "diurnal"
+    diurnal_amp: float = 3.0   # diurnal peak rate = (1 + amp) x the trough
+
+
+def arrival_offsets(sessions: int, rate: float, profile: str = "uniform",
+                    amp: float = 3.0) -> list[float]:
+    """Session start offsets (seconds from t0) for one run.
+
+    ``uniform`` is the classic open loop: fixed ``1/rate`` spacing.
+    ``diurnal`` keeps the SAME total duration (``sessions/rate``) but draws
+    arrivals from a squared-sine rate shape — quiet shoulders, a mid-run
+    spike peaking at ``(1+amp)x`` the trough — by inverting the shape's
+    cumulative mass on a fixed grid. Deterministic (no RNG): the spike's
+    timing is part of the spec, so an SLO burn e2e can point at it.
+    """
+    if sessions <= 0:
+        return []
+    if rate <= 0:
+        return [0.0] * sessions
+    total = sessions / rate
+    if profile != "diurnal":
+        return [i / rate for i in range(sessions)]
+    grid = 512
+    cum: list[float] = []
+    s = 0.0
+    for j in range(grid):
+        s += 1.0 + amp * math.sin(math.pi * (j + 0.5) / grid) ** 2
+        cum.append(s)
+    return [
+        bisect.bisect_left(cum, (i + 0.5) / sessions * s) / grid * total
+        for i in range(sessions)
+    ]
 
 
 @dataclass
@@ -103,6 +137,7 @@ class Turn:
     status: int = 0
     error: str = ""
     replica: str = ""
+    request_id: str = ""       # router-assigned X-Tony-Request-Id echo
     tokens: int = 0
     ttft_ms: float = 0.0       # first generated-token fanout (stream) / full reply
     latency_ms: float = 0.0
@@ -164,6 +199,7 @@ class LoadReport:
             "turns_per_session": self.spec.turns,
             "stream": self.spec.stream,
             "rate_per_s": self.spec.rate,
+            "profile": self.spec.profile,
             "wall_s": round(self.wall_s, 3),
             "requests_ok": len(self.ok_turns),
             "requests_failed": len(self.errors),
@@ -184,6 +220,18 @@ class LoadReport:
         hits = self._router_delta("fleet", "prefix_hit_tokens")
         if hits is not None:
             out["prefix_hit_tokens"] = int(hits)
+        # worst-offender exemplars: the slowest TTFTs with the router's
+        # request ids, so a bad tail is greppable straight into the span
+        # chain / TTFT histogram exemplars (docs/observability.md)
+        worst = sorted(
+            (t for t in self.ok_turns if t.ttft_ms > 0),
+            key=lambda t: -t.ttft_ms)[:5]
+        if worst:
+            out["worst_ttft"] = [
+                {"ttft_ms": round(t.ttft_ms, 2), "request_id": t.request_id,
+                 "session": t.session, "turn": t.turn, "replica": t.replica}
+                for t in worst
+            ]
         if self.errors:
             out["first_errors"] = [
                 {"session": t.session, "turn": t.turn,
@@ -193,10 +241,13 @@ class LoadReport:
         return out
 
     def to_bench_record(self, round_n: int, baseline_tokens_per_sec: float | None = None,
-                        rc: int = 0) -> dict[str, Any]:
+                        rc: int = 0, slo_verdict: str | None = None,
+                        budget_burned_pct: float | None = None) -> dict[str, Any]:
         """The ``SERVE_BENCH_r<N>.json`` wrapper ``tony bench --gate``
         enforces: headline = sustained tokens/s (↑), with ``ttft_p99_ms``
-        gated downward alongside it."""
+        gated downward alongside it. When the run was measured against an
+        SLO (``tony slo verdict``), ``slo_verdict`` becomes a must-be-PASS
+        contract and ``budget_burned_pct`` gates downward."""
         d = self.to_dict()
         vs = (self.tokens_per_sec / baseline_tokens_per_sec
               if baseline_tokens_per_sec else 1.0)
@@ -212,9 +263,13 @@ class LoadReport:
                 "wall_s",
             )},
         }
-        for opt in ("session_repins", "prefix_hit_tokens"):
+        for opt in ("session_repins", "prefix_hit_tokens", "profile"):
             if opt in d:
                 parsed[opt] = d[opt]
+        if slo_verdict is not None:
+            parsed["slo_verdict"] = str(slo_verdict)
+        if budget_burned_pct is not None:
+            parsed["budget_burned_pct"] = round(float(budget_burned_pct), 3)
         return {"n": int(round_n), "rc": int(rc), "parsed": parsed}
 
 
@@ -293,6 +348,7 @@ class LoadGenerator:
                 status, headers, payload = self._post(req, session_id)
                 result.status = status
                 result.replica = headers.get("X-Tony-Replica", "")
+                result.request_id = headers.get("X-Tony-Request-Id", "")
                 if spec.stream and isinstance(payload, tuple):
                     conn, resp = payload
                     try:
@@ -357,11 +413,13 @@ class LoadGenerator:
         spec = self.spec
         before = self._router_stats()
         rngs = [random.Random((spec.seed << 20) ^ i) for i in range(spec.sessions)]
+        offsets = arrival_offsets(
+            spec.sessions, spec.rate, spec.profile, spec.diurnal_amp)
         t0 = time.monotonic()
         threads = [
             threading.Thread(
                 target=self._run_session,
-                args=(i, i / spec.rate if spec.rate > 0 else 0.0, t0, rngs[i]),
+                args=(i, offsets[i], t0, rngs[i]),
                 name=f"loadgen-{i}", daemon=True)
             for i in range(spec.sessions)
         ]

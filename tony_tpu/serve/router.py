@@ -45,6 +45,7 @@ Tracing disabled (the default) stays allocation-free on the hot path.
 from __future__ import annotations
 
 import http.client
+import itertools
 import json
 import queue
 import threading
@@ -123,6 +124,7 @@ class FleetRouter:
         connect_timeout_s: float = 5.0,
         replica_timeout_s: float = 300.0,
         sessions: SessionTable | None = None,
+        slo_ttft_threshold_ms: float | None = None,
     ):
         self.health = health
         #: session-affinity table (None → a default-config table; pass an
@@ -139,6 +141,16 @@ class FleetRouter:
         self.replica_timeout_s = replica_timeout_s
         self.started_s = time.time()
         self._latencies = _Latencies()
+        # request ids: every request through the front door gets one (or
+        # keeps the client's X-Tony-Request-Id) — the key that joins the
+        # router span, the replica's queue→prefill→decode span chain, and
+        # the TTFT worst-offender exemplars. itertools.count is atomic in
+        # CPython, so handler threads need no lock here.
+        self._rid_prefix = f"{int(self.started_s * 1000) & 0xFFFFFFFF:08x}"
+        self._rid_seq = itertools.count(1)
+        if slo_ttft_threshold_ms and slo_ttft_threshold_ms > 0:
+            # SLO-aligned bucket edge: good/bad latency counts become exact
+            _REPLICA_LATENCY.ensure_bucket(float(slo_ttft_threshold_ms) / 1000.0)
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -232,13 +244,15 @@ class FleetRouter:
         except (ValueError, AttributeError):
             pass  # the replica will answer 400; route it through anyway
         session_id = (h.headers.get("X-Tony-Session") or "").strip() or None
+        rid = ((h.headers.get("X-Tony-Request-Id") or "").strip()
+               or f"{self._rid_prefix}-{next(self._rid_seq):x}")
         with obs_trace.maybe_span("router.request", path=h.path, stream=stream,
-                                  session=session_id):
-            self._route(h, h.path, body, stream, session_id, prompt_tokens)
+                                  session=session_id, rid=rid):
+            self._route(h, h.path, body, stream, session_id, prompt_tokens, rid)
 
     def _route(self, h: BaseHTTPRequestHandler, path: str, body: bytes, stream: bool,
                session_id: str | None = None,
-               prompt_tokens: list[int] | None = None) -> None:
+               prompt_tokens: list[int] | None = None, rid: str = "") -> None:
         deadline = time.monotonic() + self.failover_deadline_s
         tried: set[int] = set()
         soft_failovers = 0
@@ -251,7 +265,8 @@ class FleetRouter:
                 if time.monotonic() >= deadline:
                     _REQUESTS.inc(outcome="unavailable")
                     _reply_json(h, 503, {"error": "no healthy replica "
-                                         f"(waited {self.failover_deadline_s:.0f}s)"})
+                                         f"(waited {self.failover_deadline_s:.0f}s)"},
+                                rid=rid)
                     return
                 # whole fleet down (gang restart in flight): wait for the
                 # health monitor to resolve the relaunched endpoints
@@ -259,9 +274,10 @@ class FleetRouter:
                 continue
             try:
                 if stream:
-                    self._attempt_stream(h, replica, path, body)
+                    self._attempt_stream(h, replica, path, body, rid)
                 else:
-                    status, headers, payload = self._attempt_hedged(replica, tried, path, body)
+                    status, headers, payload = self._attempt_hedged(
+                        replica, tried, path, body, rid)
                     _relay(h, status, headers, payload)
                     _REQUESTS.inc(outcome="ok" if status == 200 else "forwarded")
                 return
@@ -282,7 +298,8 @@ class FleetRouter:
                         # replaying a systematic failure forever would only
                         # amplify it
                         _REQUESTS.inc(outcome="failed")
-                        _reply_json(h, 502, {"error": f"replicas failing: {e}"})
+                        _reply_json(h, 502, {"error": f"replicas failing: {e}"},
+                                    rid=rid)
                         return
 
     # ------------------------------------------------------------ selection
@@ -344,17 +361,20 @@ class FleetRouter:
             self.sessions.drop_replica(replica.index)
         return _AttemptFailed(replica, reason, hard)
 
-    def _open(self, replica: Replica, path: str, body: bytes):
+    def _open(self, replica: Replica, path: str, body: bytes, rid: str = ""):
         """One POST to a replica → live (conn, response). Connection-level
         failures raise _AttemptFailed(hard=True)."""
         parts = urlsplit(replica.url)
+        headers = {"Content-Type": "application/json"}
+        if rid:
+            # the id the replica's span chain + TTFT exemplars key on
+            headers["X-Tony-Request-Id"] = rid
         try:
             conn = http.client.HTTPConnection(
                 parts.hostname, parts.port, timeout=self.connect_timeout_s)
             conn.connect()
             conn.sock.settimeout(self.replica_timeout_s)
-            conn.request("POST", path, body,
-                         {"Content-Type": "application/json"})
+            conn.request("POST", path, body, headers)
             resp = conn.getresponse()
         except (ConnectionError, OSError) as e:
             raise self._fail(replica, f"connect/send failed: {e}", hard=True) from e
@@ -378,14 +398,16 @@ class FleetRouter:
                 replica, f"replica answered {resp.status}: {payload[:200]!r}", hard=False)
         return conn, resp
 
-    def _attempt_once(self, replica: Replica, path: str, body: bytes) -> tuple[int, dict, bytes]:
+    def _attempt_once(self, replica: Replica, path: str, body: bytes,
+                      rid: str = "") -> tuple[int, dict, bytes]:
         """Buffered (non-streaming) attempt; returns (status, headers, body)."""
         with self.health.lock:
             replica.outstanding += 1
         t0 = time.perf_counter()
         try:
-            with obs_trace.maybe_span("router.attempt", replica=replica.index):
-                conn, resp = self._open(replica, path, body)
+            with obs_trace.maybe_span("router.attempt", replica=replica.index,
+                                      rid=rid):
+                conn, resp = self._open(replica, path, body, rid)
                 try:
                     payload = resp.read()
                 except (ConnectionError, OSError) as e:
@@ -396,16 +418,20 @@ class FleetRouter:
             with self.health.lock:
                 replica.outstanding -= 1
         took = time.perf_counter() - t0
-        _REPLICA_LATENCY.observe(took, replica=str(replica.index))
+        _REPLICA_LATENCY.observe(took, exemplar=rid or None,
+                                 replica=str(replica.index))
         if resp.status == 200:
             self._latencies.observe(took)
         self.health.report_success(replica)
         headers = {k: resp.headers[k] for k in _FORWARD_HEADERS if resp.headers.get(k)}
         headers["X-Tony-Replica"] = str(replica.index)
+        if rid:
+            headers["X-Tony-Request-Id"] = rid
         return resp.status, headers, payload
 
     def _attempt_hedged(
-        self, replica: Replica, tried: set[int], path: str, body: bytes
+        self, replica: Replica, tried: set[int], path: str, body: bytes,
+        rid: str = "",
     ) -> tuple[int, dict, bytes]:
         """Non-streaming attempt with optional tail hedging. The primary
         failure mode propagates as _AttemptFailed only when no hedge is in
@@ -416,13 +442,13 @@ class FleetRouter:
             if p is not None:
                 threshold = max(p, self.hedge_min_s)
         if threshold is None:
-            return self._attempt_once(replica, path, body)
+            return self._attempt_once(replica, path, body, rid)
 
         results: "queue.Queue[tuple[bool, Any, Replica]]" = queue.Queue()
 
         def run(r: Replica) -> None:
             try:
-                results.put((True, self._attempt_once(r, path, body), r))
+                results.put((True, self._attempt_once(r, path, body, rid), r))
             except _AttemptFailed as e:
                 results.put((False, e, r))
 
@@ -462,7 +488,8 @@ class FleetRouter:
 
     # ------------------------------------------------------------ streaming
     def _attempt_stream(
-        self, h: BaseHTTPRequestHandler, replica: Replica, path: str, body: bytes
+        self, h: BaseHTTPRequestHandler, replica: Replica, path: str, body: bytes,
+        rid: str = "",
     ) -> None:
         """SSE relay. Retryable only until the response status is known; once
         bytes flow to the client a replica death truncates the stream (the
@@ -472,8 +499,9 @@ class FleetRouter:
             replica.outstanding += 1
         t0 = time.perf_counter()
         try:
-            with obs_trace.maybe_span("router.attempt", replica=replica.index, stream=True):
-                conn, resp = self._open(replica, path, body)
+            with obs_trace.maybe_span("router.attempt", replica=replica.index,
+                                      stream=True, rid=rid):
+                conn, resp = self._open(replica, path, body, rid)
                 try:
                     if not (resp.headers.get("Content-Type") or "").startswith(
                         "text/event-stream"
@@ -488,6 +516,8 @@ class FleetRouter:
                         headers = {k: resp.headers[k] for k in _FORWARD_HEADERS
                                    if resp.headers.get(k)}
                         headers["X-Tony-Replica"] = str(replica.index)
+                        if rid:
+                            headers["X-Tony-Request-Id"] = rid
                         _relay(h, resp.status, headers, payload)
                         _REQUESTS.inc(outcome="ok" if resp.status == 200 else "forwarded")
                         self.health.report_success(replica)
@@ -496,6 +526,8 @@ class FleetRouter:
                     h.send_header("Content-Type", resp.headers["Content-Type"])
                     h.send_header("Cache-Control", "no-cache")
                     h.send_header("X-Tony-Replica", str(replica.index))
+                    if rid:
+                        h.send_header("X-Tony-Request-Id", rid)
                     h.end_headers()
                     while True:
                         try:
@@ -524,15 +556,19 @@ class FleetRouter:
             with self.health.lock:
                 replica.outstanding -= 1
             _REPLICA_LATENCY.observe(
-                time.perf_counter() - t0, replica=str(replica.index))
+                time.perf_counter() - t0, exemplar=rid or None,
+                replica=str(replica.index))
 
 
 # ---------------------------------------------------------------- helpers
-def _reply_json(h: BaseHTTPRequestHandler, status: int, obj: Any) -> None:
+def _reply_json(h: BaseHTTPRequestHandler, status: int, obj: Any,
+                rid: str = "") -> None:
     body = json.dumps(obj).encode()
     h.send_response(status)
     h.send_header("Content-Type", "application/json")
     h.send_header("Content-Length", str(len(body)))
+    if rid:
+        h.send_header("X-Tony-Request-Id", rid)
     h.end_headers()
     h.wfile.write(body)
 
