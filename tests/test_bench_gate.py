@@ -120,6 +120,34 @@ class TestServeBenchFamily:
     def test_gate_directions_cover_the_serve_headline(self):
         assert gate.GATE_METRICS.get("ttft_p99_ms") == -1
         assert gate.GATE_METRICS.get("tokens_per_sec") == +1
+        # disagg rounds gate the prefill→decode handoff p50 downward too
+        assert gate.GATE_METRICS.get("handoff_p50_ms") == -1
+
+    def test_gate_fails_on_regressed_handoff_latency(self):
+        """A disagg round whose KV-handoff tail blows up must fail the gate
+        even when throughput held — and an improving handoff passes."""
+        base = json.loads(json.dumps(_serve_trajectory()[-1][1]))
+        base["parsed"]["handoff_p50_ms"] = 100.0
+        cand = json.loads(json.dumps(base))
+        cand["n"] = base["n"] + 1
+        cand["parsed"]["handoff_p50_ms"] = 400.0
+        result = gate.evaluate(cand, [("SERVE_BENCH_base.json", base)])
+        assert not result.passed
+        assert [c.metric for c in result.checks if not c.passed] == \
+            ["handoff_p50_ms"]
+        cand["parsed"]["handoff_p50_ms"] = 50.0
+        assert gate.evaluate(cand, [("SERVE_BENCH_base.json", base)]).passed
+
+    def test_disagg_rounds_carry_the_handoff_field(self):
+        """Any serve round that moved KV pages through the handoff must also
+        record the handoff latency it is gated on."""
+        seen = 0
+        for fname, rec in _serve_trajectory():
+            p = gate.parsed_of(rec)
+            if p.get("kv_handoff_pages"):
+                seen += 1
+                assert p.get("handoff_p50_ms", 0) > 0, fname
+        assert seen > 0, "no disagg round in the SERVE_BENCH trajectory"
 
     def test_gate_cli_passes_on_serve_trajectory(self, capsys):
         from tony_tpu.cli.history import main_bench

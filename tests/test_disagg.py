@@ -1,0 +1,560 @@
+"""Disaggregated prefill/decode serving + the sharded router tier
+(tony_tpu/serve/disagg.py; docs/serving.md "Disaggregated serving").
+
+Unit layer: the consistent-hash shard ring, the paged-KV export→ship→adopt
+contract over real (tiny, CPU-interpret) paged engines, the coordinator's
+prefill leg, and the shard front's exactly-once re-pin accounting.
+
+E2E layer (the headline): a prefill-tier + decode-tier fleet behind TWO
+router shards and one front, under the open-loop loadgen — a multi-turn
+session workload completes with ZERO client-visible failures, KV pages are
+adopted (not recomputed) on decode, and the run emits a SERVE_BENCH record
+that satisfies the gate schema with the new handoff-latency field.
+"""
+
+import json
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, "tests")
+
+from test_serve_fleet import (  # noqa: E402
+    FakeAM,
+    FakeReplica,
+    dead_url,
+    make_health,
+    post_router,
+)
+
+from tony_tpu.obs import metrics as obs_metrics  # noqa: E402
+from tony_tpu.serve import sessions as sessions_mod  # noqa: E402
+from tony_tpu.serve.autoscaler import AutoscalePolicy, Autoscaler  # noqa: E402
+from tony_tpu.serve.disagg import (  # noqa: E402
+    DisaggCoordinator,
+    RouterShardFront,
+    ShardRing,
+)
+from tony_tpu.serve.health import FleetSignals  # noqa: E402
+from tony_tpu.serve.loadgen import LoadGenerator, LoadSpec  # noqa: E402
+from tony_tpu.serve.router import FleetRouter  # noqa: E402
+from tony_tpu.serve.sessions import SessionTable  # noqa: E402
+
+pytestmark = [pytest.mark.serve, pytest.mark.disagg]
+
+
+class TieredAM(FakeAM):
+    """FakeAM with two jobtypes (``serve`` + ``prefill``) — set_task keys on
+    (name, index) so one application can carry both tiers."""
+
+    def set_task(self, name, idx, url, status="RUNNING"):
+        self.tasks[(name, idx)] = {
+            "name": name, "index": idx, "url": url, "status": status}
+
+    def drop_task(self, name, idx):
+        self.tasks.pop((name, idx), None)
+
+
+def _counter(name, **labels):
+    # same name+shape re-registration hands back the existing instrument
+    m = obs_metrics.REGISTRY.counter(name, labelnames=tuple(labels))
+    return m.value(**labels)
+
+
+def make_router(health, sessions=None, disagg=None, **kw):
+    kw.setdefault("retries", 2)
+    kw.setdefault("failover_deadline_s", 5.0)
+    return FleetRouter(health, sessions=sessions or SessionTable(),
+                       disagg=disagg, **kw).start()
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash shard ring
+# ---------------------------------------------------------------------------
+class TestShardRing:
+    def test_assignment_is_deterministic_and_covers_all_shards(self):
+        r1, r2 = ShardRing(3), ShardRing(3)
+        got = {f"s{i}": r1.assign(f"s{i}") for i in range(300)}
+        assert got == {k: r2.assign(k) for k in got}  # pure function
+        assert set(got.values()) == {0, 1, 2}          # no starving shard
+
+    def test_only_the_dead_shards_sessions_move(self):
+        ring = ShardRing(3)
+        keys = [f"session-{i}" for i in range(300)]
+        before = {k: ring.assign(k, {0, 1, 2}) for k in keys}
+        after = {k: ring.assign(k, {0, 2}) for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        # minimal disruption: exactly the dead shard's arc re-resolves, and
+        # it lands only on live shards
+        assert moved == [k for k in keys if before[k] == 1]
+        assert all(after[k] in (0, 2) for k in keys)
+
+    def test_no_live_shard_resolves_none(self):
+        ring = ShardRing(2)
+        assert ring.assign("s", set()) is None
+        assert ShardRing(0).assign("s") is None
+
+
+# ---------------------------------------------------------------------------
+# paged-KV handoff over real engines (CPU interpret via conftest)
+# ---------------------------------------------------------------------------
+class TestKvHandoff:
+    def _paged_server(self, **kw):
+        from test_serve import http_server, tiny_engine
+
+        from tony_tpu.models.serving_http import EngineServer
+
+        defaults = dict(kv="paged", page_len=8, num_slots=2, max_len=64)
+        defaults.update(kw)
+        role = defaults.pop("role", "serve")
+        srv = EngineServer(tiny_engine(**defaults), role=role).start()
+        httpd, url = http_server(srv)
+        return srv, httpd, url
+
+    def test_export_ship_adopt_then_decode_prefix_hits(self):
+        from test_serve import post_raw, tiny_engine
+
+        from tony_tpu.models.serving_http import EngineServer
+
+        pre, ph, pre_url = self._paged_server(role="prefill")
+        dec, dh, dec_url = self._paged_server()
+        try:
+            prompt = list(range(1, 25))  # 24 tokens = 3 full pages
+            st, resp = post_raw(pre_url + "/v1/prefill",
+                                {"prompt_tokens": prompt, "decode_url": dec_url})
+            assert st == 200 and resp["pages"] == 3
+            assert resp["adopted"] == 3 and resp["already_resident"] == 0
+            assert resp["handoff_ms"] > 0
+            # the decode replica now serves the prompt WITHOUT recomputing:
+            # adopted pages satisfy the admission-time prefix match
+            st2, out = post_raw(dec_url + "/v1/completions",
+                                {"prompt_tokens": prompt, "max_tokens": 4})
+            assert st2 == 200
+            stats = json.loads(
+                urllib.request.urlopen(dec_url + "/stats").read())
+            assert stats["kv_handoff_adopted"] == 3
+            assert stats["prefix_hit_tokens"] > 0
+            assert stats["role"] == "serve"
+            # parity: adopted KV must not change the sampled tokens
+            ref = EngineServer(tiny_engine(
+                kv="paged", page_len=8, num_slots=2, max_len=64)).start()
+            try:
+                r = ref.submit(prompt, 4, {})
+                while True:
+                    kind, payload = r.get()
+                    if kind == "done":
+                        break
+                assert out["tokens"] == list(payload)
+            finally:
+                ref.stop()
+            # re-ship is idempotent: everything already resident, nothing
+            # double-registered
+            st3, again = post_raw(pre_url + "/v1/prefill",
+                                  {"prompt_tokens": prompt, "decode_url": dec_url})
+            assert st3 == 200
+            assert again["adopted"] == 0 and again["already_resident"] == 3
+        finally:
+            for httpd in (ph, dh):
+                httpd.shutdown()
+                httpd.server_close()
+            pre.stop()
+            dec.stop()
+
+    def test_prefill_needs_a_paged_engine(self):
+        from test_serve import http_server, post_raw, tiny_engine
+
+        from tony_tpu.models.serving_http import EngineServer
+
+        srv = EngineServer(tiny_engine(kv="dense")).start()
+        httpd, url = http_server(srv)
+        try:
+            st, resp = post_raw(url + "/v1/prefill",
+                                {"prompt_tokens": [1, 2, 3]})
+            assert st == 409 and "paged" in resp["error"]
+            st2, _ = post_raw(url + "/v1/kv/adopt", {"page_len": 8})
+            assert st2 == 409
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            srv.stop()
+
+    def test_adopt_rejects_mismatched_geometry(self):
+        from test_serve import post_raw
+
+        pre, ph, pre_url = self._paged_server(role="prefill")
+        dec, dh, dec_url = self._paged_server(page_len=16)
+        try:
+            prompt = list(range(1, 25))
+            st, resp = post_raw(pre_url + "/v1/prefill",
+                                {"prompt_tokens": prompt, "decode_url": dec_url})
+            # the prefill leg still succeeds (degrade contract) but the ship
+            # is refused by the decode side's 400 → surfaced as ship_error
+            assert st == 200
+            assert resp["adopted"] == 0 and "ship_error" in resp
+            assert "page_len" in resp["ship_error"]
+        finally:
+            for httpd in (ph, dh):
+                httpd.shutdown()
+                httpd.server_close()
+            pre.stop()
+            dec.stop()
+
+    def test_ship_failure_degrades_not_errors(self):
+        from test_serve import post_raw
+
+        pre, ph, pre_url = self._paged_server(role="prefill")
+        try:
+            st, resp = post_raw(pre_url + "/v1/prefill",
+                                {"prompt_tokens": list(range(1, 25)),
+                                 "decode_url": dead_url(), "timeout_s": 2.0})
+            assert st == 200  # never client-visible
+            assert resp["pages"] == 3 and resp["adopted"] == 0
+            assert "ship_error" in resp
+        finally:
+            ph.shutdown()
+            ph.server_close()
+            pre.stop()
+
+
+# ---------------------------------------------------------------------------
+# coordinator: the prefill leg from the router's side
+# ---------------------------------------------------------------------------
+class TestDisaggCoordinator:
+    def test_no_replica_returns_none(self):
+        am = TieredAM()
+        coord = DisaggCoordinator(make_health(am, job_name="prefill"))
+        before = _counter("tony_router_prefill_legs_total", outcome="no_replica")
+        assert coord.prefill([1, 2, 3], "http://x") is None
+        assert _counter("tony_router_prefill_legs_total",
+                        outcome="no_replica") == before + 1
+
+    def test_leg_success_records_latency(self):
+        rep, am = FakeReplica(), TieredAM()
+        am.set_task("prefill", 0, rep.url)
+        h = make_health(am, job_name="prefill")
+        try:
+            h.tick()
+            coord = DisaggCoordinator(h, timeout_s=5.0)
+            before = _counter("tony_router_prefill_legs_total", outcome="ok")
+            got = coord.prefill([1, 2, 3], "http://decode")
+            assert isinstance(got, dict)
+            assert _counter("tony_router_prefill_legs_total",
+                            outcome="ok") == before + 1
+            s = coord.stats()
+            assert s["handoff_p50_ms"] is not None and s["handoff_p50_ms"] > 0
+            # balanced accounting: outstanding returned to zero
+            assert all(r.outstanding == 0 for r in h.snapshot())
+        finally:
+            rep.close()
+
+    def test_dead_prefill_replica_degrades(self):
+        am = TieredAM()
+        am.set_task("prefill", 0, dead_url())
+        h = make_health(am, job_name="prefill")
+        h._resolve()  # UNKNOWN is still eligible (optimistic first touch)
+        coord = DisaggCoordinator(h, timeout_s=2.0)
+        before = _counter("tony_router_prefill_legs_total", outcome="error")
+        assert coord.prefill([1, 2, 3], "http://decode") is None
+        assert _counter("tony_router_prefill_legs_total",
+                        outcome="error") == before + 1
+
+    def test_router_fires_one_leg_per_request(self):
+        prefill, decode, am = FakeReplica(), FakeReplica(), TieredAM()
+        am.set_task("prefill", 0, prefill.url)
+        am.set_task("serve", 0, decode.url)
+        ph = make_health(am, job_name="prefill")
+        dh = make_health(am, job_name="serve")
+        router = None
+        try:
+            ph.tick()
+            dh.tick()
+            coord = DisaggCoordinator(ph, timeout_s=5.0)
+            router = make_router(dh, disagg=coord)
+            st, headers, body = post_router(
+                router.url, {"prompt_tokens": [1, 2, 3], "max_tokens": 2})
+            assert st == 200 and body["tokens"]
+            assert prefill.cfg["hits"] == 1  # exactly one leg
+            assert decode.cfg["hits"] == 1
+            assert "disagg" in router.stats()
+        finally:
+            if router is not None:
+                router.stop()
+            prefill.close()
+            decode.close()
+
+
+# ---------------------------------------------------------------------------
+# sharded router tier: front, failover re-pin, gossip
+# ---------------------------------------------------------------------------
+class TestRouterShardFront:
+    def _fleet(self, n_routers=2, coord=None):
+        a, b, am = FakeReplica(), FakeReplica(), FakeAM()
+        am.set_replica(0, a.url)
+        am.set_replica(1, b.url)
+        h = make_health(am)
+        h.tick()
+        routers = [make_router(h, disagg=coord) for _ in range(n_routers)]
+        front = RouterShardFront(routers, gossip_interval_s=0).start()
+        return a, b, h, routers, front
+
+    def test_relay_and_shard_stamp(self):
+        a, b, h, routers, front = self._fleet()
+        try:
+            st, headers, body = post_router(
+                front.url, {"prompt_tokens": [1], "max_tokens": 2},
+            )
+            assert st == 200 and body["tokens"]
+            assert headers.get("X-Tony-Shard") in ("0", "1")
+            stats = front.stats()
+            assert stats["front"]["shards"] == 2
+            assert stats["front"]["shards_live"] == 2
+            assert stats["fleet"]["slots_total"] == 16
+        finally:
+            front.stop()
+            for r in routers:
+                r.stop()
+            a.close()
+            b.close()
+
+    def _post_session(self, url, sid, stream=False):
+        req = urllib.request.Request(
+            url + "/v1/completions",
+            json.dumps({"prompt_tokens": [1, 2, 3], "max_tokens": 2,
+                        "stream": stream}).encode(),
+            {"Content-Type": "application/json", "X-Tony-Session": sid})
+        resp = urllib.request.urlopen(req, timeout=30)
+        shard = resp.headers.get("X-Tony-Shard")
+        resp.read()
+        return resp.status, shard
+
+    def test_shard_failover_repins_exactly_once(self):
+        """Satellite: a router worker dies; its sessions re-resolve to a
+        surviving shard via the ring with EXACTLY ONE re-pin counted by
+        tony_router_session_repins_total — and stay there."""
+        a, b, h, routers, front = self._fleet()
+        try:
+            sid = "failover-session"
+            st, shard = self._post_session(front.url, sid)
+            assert st == 200 and shard is not None
+            victim = int(shard)
+            survivor = 1 - victim
+            routers[victim].stop()
+            before = sessions_mod.repins_total()
+            st2, shard2 = self._post_session(front.url, sid)
+            assert st2 == 200 and int(shard2) == survivor
+            assert sessions_mod.repins_total() == before + 1
+            # next turn: sticky on the survivor, NO further re-pin
+            st3, shard3 = self._post_session(front.url, sid)
+            assert st3 == 200 and int(shard3) == survivor
+            assert sessions_mod.repins_total() == before + 1
+            assert front.stats()["front"]["shards_live"] == 1
+            routers[victim] = None  # already stopped
+        finally:
+            front.stop()
+            for r in routers:
+                if r is not None:
+                    r.stop()
+            a.close()
+            b.close()
+
+    def test_sessions_stick_to_their_shard(self):
+        a, b, h, routers, front = self._fleet()
+        try:
+            for sid in ("s-one", "s-two", "s-three"):
+                _, first = self._post_session(front.url, sid)
+                _, second = self._post_session(front.url, sid)
+                assert first == second
+        finally:
+            front.stop()
+            for r in routers:
+                r.stop()
+            a.close()
+            b.close()
+
+    def test_gossip_replicates_prefix_hints(self):
+        a, b, h, routers, front = self._fleet()
+        try:
+            prompt = list(range(1, 300))  # >= default prefix_span
+            # pin a session with a fingerprinted prompt on shard 0's table
+            routers[0].sessions.pin("gossip-s", 1, prompt)
+            assert routers[1].sessions.hint(prompt) is None
+            front.gossip_hints()
+            assert routers[1].sessions.hint(prompt) == 1
+            # local ownership survives future gossip; dropped replica purges
+            routers[1].sessions.drop_replica(1)
+            assert routers[1].sessions.hint(prompt) is None
+        finally:
+            front.stop()
+            for r in routers:
+                r.stop()
+            a.close()
+            b.close()
+
+    def test_no_live_shard_is_503(self):
+        a, b, h, routers, front = self._fleet()
+        try:
+            for r in routers:
+                r.stop()
+            st, _, body = post_router(front.url, {"prompt_tokens": [1]})
+            assert st in (502, 503)
+            assert "shard" in body["error"]
+        finally:
+            front.stop()
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: decode tier's KV-occupancy signal
+# ---------------------------------------------------------------------------
+class TestKvOccupancyScaling:
+    def _scaler(self, **policy):
+        p = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                            scale_up_ticks=2, scale_down_ticks=2, **policy)
+        return Autoscaler(None, lambda j, n: None, p)
+
+    def test_kv_occupancy_drives_scale_up(self):
+        sc = self._scaler(scale_up_kv_occupancy=0.9)
+        sig = FleetSignals(replicas_known=2, replicas_healthy=2,
+                           slots_active=0, slots_total=16,
+                           pages_live=95, pages_total=100)
+        assert sig.kv_occupancy == 0.95
+        assert sc.decide(2, sig) == 2      # tick 1: hysteresis holds
+        assert sc.decide(2, sig) == 3      # tick 2: memory-bound scale-up
+
+    def test_kv_occupancy_vetoes_scale_down(self):
+        sc = self._scaler(scale_up_kv_occupancy=0.9)
+        idle_but_full = FleetSignals(replicas_known=2, replicas_healthy=2,
+                                     slots_active=0, slots_total=16,
+                                     pages_live=95, pages_total=100)
+        # idle slots + full pool: decode is memory-bound, not idle
+        assert sc.decide(2, idle_but_full) in (2, 3)
+        sc2 = self._scaler(scale_up_kv_occupancy=0.0)  # disabled
+        idle = FleetSignals(replicas_known=2, replicas_healthy=2,
+                            slots_active=0, slots_total=16)
+        assert sc2.decide(2, idle) == 2
+        assert sc2.decide(2, idle) == 1    # classic idle shrink still works
+
+    def test_dense_fleet_reports_zero_occupancy(self):
+        assert FleetSignals(replicas_healthy=1).kv_occupancy == 0.0
+
+
+# ---------------------------------------------------------------------------
+# loadgen: multi-router drive
+# ---------------------------------------------------------------------------
+class TestLoadgenSharding:
+    def test_session_url_spread_is_sticky(self):
+        spec = LoadSpec(url="http://a", urls=("http://b", "http://a/"))
+        assert spec.all_urls() == ("http://a", "http://b")
+        got = {spec.session_url(i) for i in range(8)}
+        assert got == {"http://a", "http://b"}
+        assert spec.session_url(3) == spec.session_url(3)
+
+    def test_run_across_two_routers_directly(self):
+        a, am = FakeReplica(), FakeAM()
+        am.set_replica(0, a.url)
+        h = make_health(am)
+        h.tick()
+        r1, r2 = make_router(h), make_router(h)
+        try:
+            spec = LoadSpec(url=r1.url, urls=(r2.url,), rate=50.0,
+                            sessions=4, turns=2, prompt_mix=[(8, 1.0)],
+                            max_tokens=4, stream=True, timeout_s=30.0)
+            d = LoadGenerator(spec).run().to_dict()
+            assert d["requests_failed"] == 0 and d["requests_ok"] == 8
+            # both shard tables carry pins: the spread actually happened
+            assert len(r1.sessions) > 0 and len(r2.sessions) > 0
+        finally:
+            r1.stop()
+            r2.stop()
+            a.close()
+
+
+# ---------------------------------------------------------------------------
+# headline e2e: disaggregated fleet across 2 router shards under loadtest
+# ---------------------------------------------------------------------------
+class TestDisaggHeadline:
+    def test_disagg_fleet_across_two_shards_zero_failures(self):
+        """Prefill tier + decode tier (real paged engines) behind TWO router
+        shards and one front, under the open-loop loadgen: a multi-turn
+        session workload completes with zero client-visible failures, KV
+        pages are ADOPTED on decode (tony_serve_kv_handoff_total / prefix
+        hits — not recomputed), and the run emits a gate-valid SERVE_BENCH
+        record carrying the handoff-latency field."""
+        from test_serve import http_server, tiny_engine
+
+        from tony_tpu.histserver import gate as bench_gate
+        from tony_tpu.models.serving_http import EngineServer
+
+        def paged(role):
+            srv = EngineServer(
+                tiny_engine(kv="paged", page_len=8, num_slots=4, max_len=128),
+                role=role).start()
+            httpd, url = http_server(srv)
+            return srv, httpd, url
+
+        pre, pre_httpd, pre_url = paged("prefill")
+        dec0, dec0_httpd, dec0_url = paged("serve")
+        dec1, dec1_httpd, dec1_url = paged("serve")
+        am = TieredAM()
+        am.set_task("prefill", 0, pre_url)
+        am.set_task("serve", 0, dec0_url)
+        am.set_task("serve", 1, dec1_url)
+        prefill_health = make_health(am, job_name="prefill", interval_s=0.2)
+        decode_health = make_health(am, job_name="serve", interval_s=0.2)
+        routers, front = [], None
+        try:
+            prefill_health.tick()
+            decode_health.tick()
+            # live ticking: the fleet agg (prefix hits, handoff counters)
+            # the loadgen deltas is refreshed by the probe loop
+            prefill_health.start()
+            decode_health.start()
+            coord = DisaggCoordinator(prefill_health, timeout_s=60.0)
+            routers = [
+                make_router(decode_health, disagg=coord,
+                            failover_deadline_s=60.0)
+                for _ in range(2)
+            ]
+            front = RouterShardFront(routers, gossip_interval_s=0.5).start()
+            adopted_before = sum(
+                s.kv_handoff_adopted for s in (dec0, dec1))
+            spec = LoadSpec(url=front.url, rate=8.0, sessions=6, turns=3,
+                            prompt_mix=[(16, 1.0)], max_tokens=4,
+                            shared_prefix=8, stream=True, timeout_s=120.0,
+                            seed=7)
+            report = LoadGenerator(spec).run()
+            d = report.to_dict()
+            assert d["requests_failed"] == 0, d.get("first_errors")
+            assert d["requests_ok"] == 18
+            # KV pages moved through the handoff and were adopted — the
+            # decode tier did NOT recompute every prompt
+            adopted_after = sum(s.kv_handoff_adopted for s in (dec0, dec1))
+            assert adopted_after > adopted_before
+            assert pre.kv_handoff_exported > 0
+            assert d.get("prefix_hit_tokens", 0) > 0
+            assert d.get("kv_handoff_pages", 0) > 0
+            assert d.get("handoff_p50_ms", 0) > 0
+            # sessions sharded across BOTH router tables
+            assert sum(len(r.sessions) for r in routers) == 6
+            assert front.stats()["front"]["shards_live"] == 2
+            # and the round is gate-grade: schema-valid, handoff field in
+            # the record, hardware provenance stamped
+            rec = report.to_bench_record(2, baseline_tokens_per_sec=100.59)
+            assert bench_gate.validate_record(rec, wrapper=True) == []
+            assert rec["parsed"]["handoff_p50_ms"] > 0
+            assert rec["parsed"]["machine"]["cpus"] > 0
+        finally:
+            prefill_health.stop()
+            decode_health.stop()
+            if front is not None:
+                front.stop()
+            for r in routers:
+                r.stop()
+            for httpd in (pre_httpd, dec0_httpd, dec1_httpd):
+                httpd.shutdown()
+                httpd.server_close()
+            for srv in (pre, dec0, dec1):
+                srv.stop()

@@ -28,7 +28,10 @@ from tony_tpu.serve.loadgen import LoadGenerator, LoadSpec, parse_prompt_mix
 def build_spec(argv: list[str]) -> tuple[LoadSpec, argparse.Namespace]:
     p = argparse.ArgumentParser(prog="tony loadtest", description=__doc__)
     p.add_argument("--url", required=True,
-                   help="fleet router (or single replica) base URL")
+                   help="fleet router (or single replica) base URL; "
+                        "comma-separate several to drive the sharded router "
+                        "tier directly — each session sticks to one router "
+                        "(tony serve --routers N)")
     p.add_argument("--conf_file", default=None)
     p.add_argument("--conf", action="append", default=[], metavar="K=V")
     p.add_argument("--rate", type=float, default=None,
@@ -71,8 +74,12 @@ def build_spec(argv: list[str]) -> tuple[LoadSpec, argparse.Namespace]:
 
     config = TonyConfig.from_layers(conf_file=args.conf_file, conf_args=args.conf)
     stream = not args.no_stream and config.get_bool(keys.SERVE_LOADTEST_STREAM)
+    urls = tuple(u.strip().rstrip("/") for u in args.url.split(",") if u.strip())
+    if not urls:
+        raise SystemExit("tony loadtest: --url must name at least one endpoint")
     spec = LoadSpec(
-        url=args.url.rstrip("/"),
+        url=urls[0],
+        urls=urls[1:],
         rate=args.rate if args.rate is not None
         else config.get_float(keys.SERVE_LOADTEST_RATE, 4.0),
         sessions=args.sessions if args.sessions is not None
@@ -101,7 +108,9 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as e:
         print(f"tony loadtest: {e}", file=sys.stderr)
         return 2
-    print(f"[tony-loadtest] {spec.url}: {spec.sessions} session(s) x "
+    endpoints = spec.all_urls()
+    where = spec.url if len(endpoints) == 1 else f"{len(endpoints)} routers"
+    print(f"[tony-loadtest] {where}: {spec.sessions} session(s) x "
           f"{spec.turns} turn(s) at {spec.rate}/s "
           f"({'SSE' if spec.stream else 'buffered'})", flush=True)
     report = LoadGenerator(spec).run()

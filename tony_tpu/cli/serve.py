@@ -58,6 +58,17 @@ def build_serve_config(argv: list[str]) -> tuple[TonyConfig, argparse.Namespace]
                         "(tony.serve.max-replicas)")
     p.add_argument("--router_port", type=int, default=None,
                    help="fleet router listen port (tony.serve.router.port; 0 = free)")
+    p.add_argument("--routers", type=int, default=None,
+                   help="router shard workers behind one front "
+                        "(tony.serve.routers; sessions shard by consistent "
+                        "hash of session id, pins survive a shard dying)")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated serving (tony.serve.disagg.enabled): "
+                        "run a second 'prefill' jobtype that hands finished "
+                        "KV pages to the decode tier (needs --kv paged)")
+    p.add_argument("--prefill_replicas", type=int, default=None,
+                   help="prefill-tier task instances when --disagg "
+                        "(tony.serve.disagg.prefill-replicas)")
     p.add_argument("--hedge_percentile", type=float, default=None,
                    help="hedge non-streaming requests past this latency "
                         "percentile (tony.serve.hedge-percentile; 0 = off)")
@@ -128,10 +139,29 @@ def build_serve_config(argv: list[str]) -> tuple[TonyConfig, argparse.Namespace]
         ("max_replicas", keys.SERVE_MAX_REPLICAS),
         ("router_port", keys.SERVE_ROUTER_PORT),
         ("hedge_percentile", keys.SERVE_HEDGE_PERCENTILE),
+        ("routers", keys.SERVE_ROUTERS),
+        ("prefill_replicas", keys.SERVE_DISAGG_PREFILL_REPLICAS),
     ):
         v = getattr(args, flag)
         if v is not None:
             config.set(key, str(v))
+    if args.disagg:
+        config.set(keys.SERVE_DISAGG_ENABLED, "true")
+    if config.get_bool(keys.SERVE_DISAGG_ENABLED, False):
+        # prefill tier: a SECOND jobtype of the same application, same
+        # engine binary flagged into the prompt role — it answers /v1/prefill
+        # and ships pages to whichever decode replica the router names
+        n_prefill = config.get_int(keys.SERVE_DISAGG_PREFILL_REPLICAS, 1)
+        if n_prefill < 1:
+            raise SystemExit("tony serve: --prefill_replicas must be >= 1")
+        config.set(
+            keys.jobtype_key(constants.PREFILL_JOB_NAME, keys.INSTANCES_SUFFIX),
+            str(n_prefill),
+        )
+        config.set(
+            keys.jobtype_key(constants.PREFILL_JOB_NAME, keys.COMMAND_SUFFIX),
+            shlex.join(cmd + ["--role", "prefill"]),
+        )
     return config, args
 
 
@@ -180,8 +210,10 @@ def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0,
     from tony_tpu.serve import (
         AutoscalePolicy,
         Autoscaler,
+        DisaggCoordinator,
         FleetRouter,
         HealthMonitor,
+        RouterShardFront,
         SessionTable,
     )
 
@@ -240,25 +272,71 @@ def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0,
         client.monitor_application(handle, quiet=True)
         return constants.EXIT_KILLED
     health.start()
-    router = FleetRouter(
-        health,
-        port=config.get_int(keys.SERVE_ROUTER_PORT, 0),
-        retries=config.get_int(keys.SERVE_ROUTER_RETRIES, 3),
-        failover_deadline_s=config.get_time_ms(keys.SERVE_FAILOVER_DEADLINE_MS, 120_000) / 1000,
-        hedge_percentile=config.get_float(keys.SERVE_HEDGE_PERCENTILE, 0.0),
-        hedge_min_s=config.get_time_ms(keys.SERVE_HEDGE_MIN_MS, 50) / 1000,
-        sessions=SessionTable(
-            ttl_s=config.get_time_ms(keys.SERVE_SESSION_TTL_MS, 600_000) / 1000,
-            max_sessions=config.get_int(keys.SERVE_SESSION_MAX_SESSIONS, 10_000),
-            prefix_span=config.get_int(keys.SERVE_SESSION_PREFIX_SPAN, 256),
-        ),
-        # SLO-aligned latency bucket edge (exact good/bad counts) when a
-        # TTFT objective is declared
-        slo_ttft_threshold_ms=(
-            config.get_float(keys.SLO_SERVE_TTFT_THRESHOLD_MS, 0.0)
-            or config.get_float(keys.SERVE_MARKET_SLO_TTFT_MS, 0.0)
-        ) if config.get(keys.SLO_SERVE_TTFT_TARGET) else None,
-    ).start()
+    # disaggregated prefill tier: its own health monitor over the second
+    # jobtype + the coordinator the routers fire prefill legs through
+    prefill_health = None
+    disagg = None
+    if config.get_bool(keys.SERVE_DISAGG_ENABLED, False):
+        prefill_health = HealthMonitor(
+            fleet_rpc.call,
+            job_name=constants.PREFILL_JOB_NAME,
+            interval_s=config.get_time_ms(keys.SERVE_HEALTH_INTERVAL_MS, 1000) / 1000,
+            fail_threshold=config.get_int(keys.SERVE_HEALTH_FAIL_THRESHOLD, 3),
+        )
+        try:
+            prefill_health.tick()
+        except KeyboardInterrupt:
+            print("[tony-serve] interrupt — killing serving job", flush=True)
+            Client.kill(handle)
+            client.monitor_application(handle, quiet=True)
+            return constants.EXIT_KILLED
+        prefill_health.start()
+        disagg = DisaggCoordinator(
+            prefill_health,
+            timeout_s=config.get_time_ms(
+                keys.SERVE_DISAGG_HANDOFF_TIMEOUT_MS, 30_000) / 1000,
+        )
+
+    def make_router(port: int) -> FleetRouter:
+        return FleetRouter(
+            health,
+            port=port,
+            retries=config.get_int(keys.SERVE_ROUTER_RETRIES, 3),
+            failover_deadline_s=config.get_time_ms(keys.SERVE_FAILOVER_DEADLINE_MS, 120_000) / 1000,
+            hedge_percentile=config.get_float(keys.SERVE_HEDGE_PERCENTILE, 0.0),
+            hedge_min_s=config.get_time_ms(keys.SERVE_HEDGE_MIN_MS, 50) / 1000,
+            sessions=SessionTable(
+                ttl_s=config.get_time_ms(keys.SERVE_SESSION_TTL_MS, 600_000) / 1000,
+                max_sessions=config.get_int(keys.SERVE_SESSION_MAX_SESSIONS, 10_000),
+                prefix_span=config.get_int(keys.SERVE_SESSION_PREFIX_SPAN, 256),
+            ),
+            disagg=disagg,
+            # SLO-aligned latency bucket edge (exact good/bad counts) when a
+            # TTFT objective is declared
+            slo_ttft_threshold_ms=(
+                config.get_float(keys.SLO_SERVE_TTFT_THRESHOLD_MS, 0.0)
+                or config.get_float(keys.SERVE_MARKET_SLO_TTFT_MS, 0.0)
+            ) if config.get(keys.SLO_SERVE_TTFT_TARGET) else None,
+        )
+
+    n_routers = max(config.get_int(keys.SERVE_ROUTERS, 1), 1)
+    router_port = config.get_int(keys.SERVE_ROUTER_PORT, 0)
+    front = None
+    if n_routers > 1:
+        # sharded router tier: each worker owns a consistent-hash shard of
+        # the session space behind one front; the configured port belongs
+        # to the front (the printed endpoint), shards take ephemeral ports
+        routers = [make_router(0).start() for _ in range(n_routers)]
+        front = RouterShardFront(
+            routers,
+            port=router_port,
+            gossip_interval_s=config.get_time_ms(
+                keys.SERVE_ROUTER_GOSSIP_INTERVAL_MS, 2000) / 1000,
+        ).start()
+        endpoint = front.url
+    else:
+        routers = [make_router(router_port).start()]
+        endpoint = routers[0].url
     autoscaler = None
     max_replicas = config.get_int(keys.SERVE_MAX_REPLICAS, 0)
     if max_replicas > 0:
@@ -270,6 +348,8 @@ def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0,
             scale_down_utilization=config.get_float(keys.SERVE_SCALE_DOWN_UTILIZATION, 0.25),
             scale_up_ticks=config.get_int(keys.SERVE_SCALE_UP_TICKS, 2),
             scale_down_ticks=config.get_int(keys.SERVE_SCALE_DOWN_TICKS, 6),
+            scale_up_kv_occupancy=config.get_float(
+                keys.SERVE_SCALE_UP_KV_OCCUPANCY, 0.0),
         )
         autoscaler = Autoscaler(
             health,
@@ -289,13 +369,44 @@ def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0,
             if config.get(keys.SLO_SERVE_TTFT_TARGET)
             or config.get(keys.SLO_SERVE_AVAILABILITY_TARGET) else None,
         ).start()
+    # the prefill tier scales independently: queue depth / TTFT burn are its
+    # signals (prefill is compute-bound — KV occupancy belongs to decode)
+    prefill_autoscaler = None
+    prefill_max = config.get_int(keys.SERVE_DISAGG_PREFILL_MAX_REPLICAS, 0)
+    if prefill_health is not None and prefill_max > 0:
+        prefill_autoscaler = Autoscaler(
+            prefill_health,
+            lambda job, n: fleet_rpc.call("resize_jobtype", job_name=job, instances=n),
+            AutoscalePolicy(
+                min_replicas=max(config.get_int(
+                    keys.SERVE_DISAGG_PREFILL_MIN_REPLICAS, 0), 1),
+                max_replicas=prefill_max,
+                scale_up_queue_depth=config.get_float(keys.SERVE_SCALE_UP_QUEUE_DEPTH, 4.0),
+                scale_up_utilization=config.get_float(keys.SERVE_SCALE_UP_UTILIZATION, 0.85),
+                scale_down_utilization=config.get_float(keys.SERVE_SCALE_DOWN_UTILIZATION, 0.25),
+                scale_up_ticks=config.get_int(keys.SERVE_SCALE_UP_TICKS, 2),
+                scale_down_ticks=config.get_int(keys.SERVE_SCALE_DOWN_TICKS, 6),
+            ),
+            job_name=constants.PREFILL_JOB_NAME,
+            interval_s=config.get_time_ms(keys.SERVE_AUTOSCALE_INTERVAL_MS, 5000) / 1000,
+            drain=lambda job, i: fleet_rpc.call(
+                "request_task_drain", job_name=job, index=i),
+            drain_timeout_s=config.get_time_ms(
+                keys.SERVE_SCALE_DOWN_DRAIN_MS, 10_000) / 1000,
+            burn=(lambda: _slo_fast_burn(fleet_rpc))
+            if config.get(keys.SLO_SERVE_TTFT_TARGET)
+            or config.get(keys.SLO_SERVE_AVAILABILITY_TARGET) else None,
+        ).start()
     stop_push = threading.Event()
     threading.Thread(
         target=_push_router_metrics_loop, args=(fleet_rpc, stop_push), daemon=True
     ).start()
     print(
-        f"[tony-serve] fleet router {router.url} → {replicas} replica(s) "
-        f"(POST /v1/completions; GET /stats, /healthz, /fleet"
+        f"[tony-serve] fleet router {endpoint} → {replicas} replica(s)"
+        + (f" over {n_routers} router shards" if front is not None else "")
+        + (f" + {config.instances(constants.PREFILL_JOB_NAME)} prefill"
+           if disagg is not None else "")
+        + " (POST /v1/completions; GET /stats, /healthz, /fleet"
         + (f"; autoscale [{policy.min_replicas},{policy.max_replicas}]" if autoscaler else "")
         + ")",
         flush=True,
@@ -306,8 +417,15 @@ def submit_serve(config: TonyConfig, url_timeout_s: float = 180.0,
         stop_push.set()
         if autoscaler is not None:
             autoscaler.stop()
+        if prefill_autoscaler is not None:
+            prefill_autoscaler.stop()
         health.stop()
-        router.stop()
+        if prefill_health is not None:
+            prefill_health.stop()
+        if front is not None:
+            front.stop()
+        for r in routers:
+            r.stop()
         fleet_rpc.close()
 
 

@@ -344,6 +344,26 @@ SERVE_LOADTEST_STREAM = "tony.serve.loadtest.stream"
 # time-to-first-token objective the live market e2e/loadtest verdict checks.
 SERVE_MARKET_ENABLED = "tony.serve.market.enabled"
 SERVE_MARKET_SLO_TTFT_MS = "tony.serve.market.slo-ttft-ms"
+# Router tier sharding (serve/disagg.py RouterShardFront): N FleetRouter
+# workers, each owning a consistent-hash shard of the session-pin space,
+# behind one front (``tony serve --routers N``); prefix hints replicate
+# between shards every gossip tick.
+SERVE_ROUTERS = "tony.serve.routers"
+SERVE_ROUTER_GOSSIP_INTERVAL_MS = "tony.serve.router.gossip-interval-ms"
+# Disaggregated prefill/decode serving (serve/disagg.py): a second jobtype
+# (``prefill``) runs the prompt phase and ships finished KV pages to the
+# decode tier over the paged-KV handoff contract. prefill-replicas sizes the
+# tier at submit; prefill-min/max-replicas bound its own autoscaler (max 0 =
+# no autoscaling); handoff-timeout-ms bounds one prefill leg end-to-end.
+SERVE_DISAGG_ENABLED = "tony.serve.disagg.enabled"
+SERVE_DISAGG_PREFILL_REPLICAS = "tony.serve.disagg.prefill-replicas"
+SERVE_DISAGG_PREFILL_MIN_REPLICAS = "tony.serve.disagg.prefill-min-replicas"
+SERVE_DISAGG_PREFILL_MAX_REPLICAS = "tony.serve.disagg.prefill-max-replicas"
+SERVE_DISAGG_HANDOFF_TIMEOUT_MS = "tony.serve.disagg.handoff-timeout-ms"
+# Decode-tier memory-bound scaling: paged-KV occupancy (live/total pages)
+# above which the autoscaler counts up-pressure even with idle slots. 0
+# disables (dense fleets report occupancy 0).
+SERVE_SCALE_UP_KV_OCCUPANCY = "tony.serve.scale-up-kv-occupancy"
 
 # ---------------------------------------------------------------------------
 # tony.cbench.* — control-plane benchmark sizes (`tony cbench`,
@@ -632,6 +652,14 @@ DEFAULTS: dict[str, str] = {
     SERVE_LOADTEST_STREAM: "true",
     SERVE_MARKET_ENABLED: "false",
     SERVE_MARKET_SLO_TTFT_MS: "2000",
+    SERVE_ROUTERS: "1",
+    SERVE_ROUTER_GOSSIP_INTERVAL_MS: "2000",
+    SERVE_DISAGG_ENABLED: "false",
+    SERVE_DISAGG_PREFILL_REPLICAS: "1",
+    SERVE_DISAGG_PREFILL_MIN_REPLICAS: "0",
+    SERVE_DISAGG_PREFILL_MAX_REPLICAS: "0",
+    SERVE_DISAGG_HANDOFF_TIMEOUT_MS: "30000",
+    SERVE_SCALE_UP_KV_OCCUPANCY: "0",
 
     CBENCH_APPS: "10000",
     CBENCH_QUEUES: "8",

@@ -152,6 +152,11 @@ EVALUATOR_JOB_NAME = "evaluator"
 TENSORBOARD_JOB_NAME = "tensorboard"
 NOTEBOOK_JOB_NAME = "notebook"
 SERVE_JOB_NAME = "serve"
+# Disaggregated serving (docs/serving.md "Disaggregated serving"): the
+# prefill tier runs as a SECOND jobtype of the same application — prompt
+# processing there, token decode on the ``serve`` tier, KV pages handed off
+# between them (serve/disagg.py).
+PREFILL_JOB_NAME = "prefill"
 DRIVER_JOB_NAME = "driver"
 
 # Exit codes (analog of TonY's exit-code conventions)

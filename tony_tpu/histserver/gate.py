@@ -38,6 +38,10 @@ GATE_METRICS: dict[str, int] = {
     "step_time_ms": -1,
     "ttft_p99_ms": -1,      # SERVE_BENCH: tail time-to-first-token
     "ttft_p95_ms": -1,
+    # SERVE_BENCH disagg lane (serve/disagg.py): the prefill→decode KV
+    # handoff's median wall time regresses upward — a slow handoff eats the
+    # TTFT win disaggregation exists for
+    "handoff_p50_ms": -1,
     # SERVE_BENCH SLO lane (tony loadtest + obs/slo.py): the share of the
     # error budget the run burned regresses upward; the verdict itself is a
     # must-be-PASS contract below (same discipline as kernel_smoke)

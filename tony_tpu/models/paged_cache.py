@@ -206,6 +206,46 @@ def gather_prefix_into_staging(
     return staging._replace(k=sk, v=sv, length=jnp.int32(n * page_len))
 
 
+@functools.partial(jax.jit, static_argnames=("n",))
+def gather_pages(pk: jax.Array, pv: jax.Array, pages: jax.Array, n: int = 0):
+    """Read ``n`` physical pages out of the pools — the EXPORT half of the
+    disaggregated KV handoff (serve/disagg.py): a prefill replica gathers
+    its finished full-prompt pages into one [L, n, Hkv, page_len, Dh] pair
+    to serialize toward the decode replica. One device gather, host copy at
+    the caller (jax.device_get)."""
+    return pk[:, pages], pv[:, pages]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("n",))
+def scatter_pages(
+    cache: PagedCache,
+    pages: jax.Array,                    # [n] destination physical pages
+    vals_k: jax.Array, vals_v: jax.Array,  # [L, n, Hkv, page_len, Dh]
+    n: int = 0,
+):
+    """Write ``n`` received pages into the pools in place (donated) — the
+    ADOPT half of the KV handoff. The caller (engine thread) has already
+    alloc()'d the destination pages, so nothing live is overwritten; a
+    fori_loop of per-page dynamic_update_slice keeps the update aliasing
+    the donated pool, same shape discipline as insert_paged_prefill."""
+    L, _, Hkv, page_len, Dh = cache.k.shape
+
+    def body(j, kv):
+        k, v = kv
+        k = jax.lax.dynamic_update_slice(
+            k, jax.lax.dynamic_slice(vals_k, (0, j, 0, 0, 0),
+                                     (L, 1, Hkv, page_len, Dh)),
+            (0, pages[j], 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(
+            v, jax.lax.dynamic_slice(vals_v, (0, j, 0, 0, 0),
+                                     (L, 1, Hkv, page_len, Dh)),
+            (0, pages[j], 0, 0, 0))
+        return k, v
+
+    k, v = jax.lax.fori_loop(0, n, body, (cache.k, cache.v))
+    return cache._replace(k=k, v=v)
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def insert_paged_prefill(
     cache: PagedCache,

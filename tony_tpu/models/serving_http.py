@@ -78,6 +78,15 @@ _REQUESTS_DONE = obs_metrics.counter(
 _PREFIX_HITS = obs_metrics.counter(
     "tony_serve_prefix_hit_tokens_total",
     "prompt tokens whose prefill was skipped via paged prefix-cache hits")
+_KV_HANDOFF = obs_metrics.counter(
+    "tony_serve_kv_handoff_total",
+    "KV pages moved through the disaggregated prefill→decode handoff "
+    "(exported by the prefill tier / adopted into the decode tier's pool)",
+    labelnames=("side",))
+_HANDOFF_LATENCY = obs_metrics.histogram(
+    "tony_serve_kv_handoff_seconds",
+    "disaggregated handoff wall time on the prefill replica: prompt done → "
+    "pages exported, shipped, and acked by the decode replica")
 
 
 class RequestStream:
@@ -87,7 +96,7 @@ class RequestStream:
     up within one decode chunk and frees the slot/pages."""
 
     __slots__ = ("q", "cancelled", "submitted_s", "last_fanout_s",
-                 "request_id", "span", "stage")
+                 "request_id", "span", "stage", "defer_finish")
 
     def __init__(self, maxsize: int = 0, request_id: str = ""):
         self.q: queue.Queue = queue.Queue(maxsize)
@@ -99,6 +108,11 @@ class RequestStream:
         self.last_fanout_s = 0.0
         #: router-propagated id (X-Tony-Request-Id) — exemplar + span key
         self.request_id = request_id
+        #: disagg handoff: True → on "done" the engine opens a serve.handoff
+        #: stage instead of closing the span; the /v1/prefill handler owns
+        #: finish_trace after the pages ship (safe: the engine thread never
+        #: touches the stream again after its terminal event)
+        self.defer_finish = False
         # per-request span chain (queue → prefill → decode) under one
         # serve.request umbrella; both stay None with tracing disabled, so
         # every hot-path hook below is a single attribute check
@@ -155,9 +169,18 @@ class EngineServer:
     STREAM_QUEUE_CHUNKS = 1024  # per-request event bound (chunks, not tokens)
 
     def __init__(self, engine: ContinuousBatcher, on_fatal=None,
-                 max_queue: int = 256, request_timeout_s: float = 0.0):
+                 max_queue: int = 256, request_timeout_s: float = 0.0,
+                 role: str = "serve"):
         self.engine = engine
+        #: tier this replica serves in ("serve" = decode-capable default,
+        #: "prefill" = disagg prompt tier) — advisory: /stats carries it so
+        #: the per-tier health monitors and the docs' tier diagram line up
+        self.role = role
         self._inbox: "queue.Queue[tuple]" = queue.Queue(maxsize=max_queue)
+        #: engine-thread control channel (disagg KV export/adopt): closures
+        #: that must run where the allocator + cache live. Drained at the
+        #: top of every loop iteration, answered (ok, value) on a per-op box.
+        self._control: "queue.Queue[tuple]" = queue.Queue()
         self._streams: dict[int, RequestStream] = {}
         self._deadlines: dict[int, float] = {}
         self.request_timeout_s = request_timeout_s
@@ -177,6 +200,10 @@ class EngineServer:
         self.requests_done = 0
         self.requests_cancelled = 0
         self._prefix_hits_exported = 0  # engine-thread watermark → registry delta
+        # disagg handoff accounting (engine-thread only: export/adopt both
+        # run as control ops, so plain ints need no lock)
+        self.kv_handoff_exported = 0    # pages shipped toward decode replicas
+        self.kv_handoff_adopted = 0     # pages adopted into this pool
         # delivered is the ONE counter with multiple writers (every HTTP
         # handler thread); unsynchronized += would lose updates
         self._delivered_lock = threading.Lock()
@@ -185,6 +212,38 @@ class EngineServer:
         with self._delivered_lock:
             self.tokens_delivered += n
         _DELIVERED.inc(n)
+
+    def run_on_engine(self, fn, timeout_s: float = 30.0):
+        """Run ``fn()`` ON the engine thread (between decode chunks) and
+        return its result. The disagg KV export/adopt path: the page
+        allocator and the cache arrays have exactly one owner, and a handler
+        thread mutating them mid-step would race the loop's functional
+        cache updates. Raises what ``fn`` raised; TimeoutError when the
+        engine never picked the op up (draining / wedged)."""
+        box: "queue.Queue[tuple]" = queue.Queue(1)
+        self._control.put((fn, box))
+        try:
+            ok, val = box.get(timeout=timeout_s)
+        except queue.Empty:
+            raise TimeoutError("engine did not service the control op "
+                               f"within {timeout_s:.0f}s") from None
+        if not ok:
+            raise val
+        return val
+
+    def _drain_control(self) -> None:
+        """Service queued control ops (engine thread only). A failing op
+        answers its caller and never takes the loop down — export/adopt
+        problems are per-request errors, not engine fatals."""
+        while True:
+            try:
+                fn, box = self._control.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                box.put((True, fn()))
+            except Exception as e:  # noqa: BLE001 — answered to the caller
+                box.put((False, e))
 
     def start(self) -> "EngineServer":
         self._thread.start()
@@ -247,11 +306,14 @@ class EngineServer:
             "uptime_s": round(up, 1),
             "draining": self._draining.is_set(),
             "healthy": self.error is None,
+            "role": self.role,
             **(
                 {
                     "pages_live": eng.allocator.live_pages(),
                     "pages_total": eng.num_pages - 1,
                     "prefix_hit_tokens": eng.prefix_hit_tokens,
+                    "kv_handoff_exported": self.kv_handoff_exported,
+                    "kv_handoff_adopted": self.kv_handoff_adopted,
                 }
                 if getattr(eng, "kv", "dense") == "paged"
                 else {}
@@ -290,6 +352,12 @@ class EngineServer:
                 while True:
                     try:
                         self._inbox.get_nowait()[-1].put(("error", "server is draining"))
+                    except queue.Empty:
+                        break
+                while True:  # control ops must not leave their caller hanging
+                    try:
+                        _, box = self._control.get_nowait()
+                        box.put((False, RuntimeError("engine stopped")))
                     except queue.Empty:
                         break
                 self._stopped.set()
@@ -372,6 +440,7 @@ class EngineServer:
                 if deadline:
                     self._deadlines[rid] = deadline
             self._sweep_cancellations()
+            self._drain_control()
             _QUEUE_DEPTH.set(self._queue_depth())
             had_work = eng.step()
             # export the engine's prefix-reuse win as a REAL instrument, not
@@ -408,7 +477,12 @@ class EngineServer:
                     self._finish_stream(
                         out, ("done", final if final is not None else toks)
                     )
-                    out.finish_trace("ok")
+                    if out.defer_finish:
+                        # disagg: the span stays open through the KV handoff;
+                        # the /v1/prefill handler closes it after the ship
+                        out.begin_stage("serve.handoff")
+                    else:
+                        out.finish_trace("ok")
                     del self._streams[rid]
                     self._deadlines.pop(rid, None)
                 else:
@@ -463,6 +537,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": "not found"})
 
     def do_POST(self) -> None:  # noqa: N802
+        if self.path == "/v1/prefill":
+            self._handle_prefill()
+            return
+        if self.path == "/v1/kv/adopt":
+            self._handle_adopt()
+            return
         if self.path != "/v1/completions":
             self._reply(404, {"error": "not found"})
             return
@@ -500,6 +580,101 @@ class _Handler(BaseHTTPRequestHandler):
             self._stream_response(out)
         else:
             self._block_response(out)
+
+    def _handle_prefill(self) -> None:
+        """Disagg prefill leg (serve/disagg.py contract): run the prompt
+        through this engine for exactly ONE generated token (the prefill +
+        first sample), export the finished full-prompt KV pages, POST them
+        to the assigned decode replica's ``/v1/kv/adopt``, and reply with
+        the first token + handoff accounting. The handoff is best-effort
+        past the first token: a failed ship degrades to a decode-side
+        recompute, never to a client-visible error."""
+        from tony_tpu.serve import disagg
+
+        srv = self.server_ref
+        try:
+            req = _json_body(self)
+            if not isinstance(req, dict):
+                raise ValueError("request body must be a JSON object")
+            prompt = [int(t) for t in (req.get("prompt_tokens") or [])]
+            if not prompt:
+                raise ValueError("empty prompt")
+            decode_url = str(req.get("decode_url") or "").rstrip("/")
+            sampling = {
+                k: (float(req[k]) if k != "top_k" else int(req[k]))
+                for k in ("temperature", "top_k", "top_p")
+                if req.get(k) is not None
+            }
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        if getattr(srv.engine, "kv", "dense") != "paged":
+            self._reply(409, {"error": "kv handoff needs a paged engine "
+                                       "(--kv paged)"})
+            return
+        request_id = (self.headers.get("X-Tony-Request-Id") or "").strip()
+        t0 = time.perf_counter()
+        out = srv.submit(prompt, 1, sampling, request_id=request_id)
+        out.defer_finish = True
+        while True:
+            kind, payload = out.get()
+            if kind in ("done", "error"):
+                break
+        if kind == "error":
+            self._error_reply(payload)
+            return
+        first = list(payload)
+        shipped = have = pages = 0
+        ship_error = ""
+        try:
+            exported = srv.run_on_engine(
+                lambda: disagg.export_prefix_pages(srv, prompt))
+            if exported is not None:
+                pages = len(exported["keys"])
+                if decode_url:
+                    shipped, have = disagg.ship_pages(
+                        decode_url, exported,
+                        timeout_s=float(req.get("timeout_s") or 30.0))
+        except Exception as e:  # noqa: BLE001 — degrade to decode recompute
+            ship_error = str(e)[:200]
+        took = time.perf_counter() - t0
+        _HANDOFF_LATENCY.observe(took, exemplar=request_id or None)
+        out.finish_trace("ok" if not ship_error else "error")
+        resp = {
+            "first_token": first[-1] if first else None,
+            "pages": pages,
+            "adopted": shipped,
+            "already_resident": have,
+            "handoff_ms": round(took * 1000, 3),
+        }
+        if ship_error:
+            resp["ship_error"] = ship_error
+        self._reply(200, resp)
+
+    def _handle_adopt(self) -> None:
+        """Adopt shipped KV pages into this replica's paged pool (the decode
+        half of the handoff): alloc → scatter → register → park in the reuse
+        pool, where the next matching prompt's prefix match picks them up
+        instead of recomputing the prefill."""
+        from tony_tpu.serve import disagg
+
+        srv = self.server_ref
+        if getattr(srv.engine, "kv", "dense") != "paged":
+            self._reply(409, {"error": "kv adopt needs a paged engine"})
+            return
+        try:
+            payload = _json_body(self)
+            if not isinstance(payload, dict):
+                raise ValueError("adopt body must be a JSON object")
+            adopted, have = srv.run_on_engine(
+                lambda: disagg.adopt_pages(srv, payload))
+        except (TypeError, ValueError, KeyError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        except (TimeoutError, RuntimeError) as e:
+            self._reply(503, {"error": str(e)})
+            return
+        self._reply(200, {"adopted": adopted, "already_resident": have})
 
     def _error_reply(self, payload: str) -> None:
         if "overloaded" in payload:
@@ -818,6 +993,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--request-timeout-s", type=float, default=0.0,
                    help="default per-request deadline (0 = none); requests "
                         "may override via the timeout_s body field")
+    p.add_argument("--role", default="serve", choices=["serve", "prefill"],
+                   help="disagg tier this replica serves in: 'prefill' "
+                        "replicas take /v1/prefill legs and ship KV pages; "
+                        "'serve' replicas decode (and adopt shipped pages). "
+                        "Both answer the full API — the role is advisory "
+                        "(stats/logs), routing is the router's job")
     p.add_argument("--slo-ttft-ms", type=float,
                    default=float(os.environ.get(constants.ENV_SLO_TTFT_MS, "0") or 0),
                    help="align a TTFT histogram bucket edge to this SLO "
@@ -836,6 +1017,7 @@ def main(argv: list[str] | None = None) -> int:
     srv = EngineServer(
         build_engine(args), on_fatal=done.set,
         max_queue=args.admission_queue, request_timeout_s=args.request_timeout_s,
+        role=args.role,
     ).start()
     tokenizer = None
     if args.tokenizer:
@@ -881,8 +1063,8 @@ def main(argv: list[str] | None = None) -> int:
         target=_drain_watch, args=(srv, stop_drain_watch, budget_s), daemon=True
     ).start()
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    obs_logging.info(f"[tony-serve] {url} preset={args.preset} slots={args.slots} "
-                     f"max_len={args.max_len}")
+    obs_logging.info(f"[tony-serve] {url} role={args.role} preset={args.preset} "
+                     f"slots={args.slots} max_len={args.max_len}")
     # poll rather than block forever: a process-directed SIGTERM may be
     # delivered to a busy worker thread, in which case CPython only runs the
     # Python-level handler once the MAIN thread executes bytecode again — a

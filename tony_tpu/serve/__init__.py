@@ -21,10 +21,16 @@ machinery, fronted by submitter-side pieces —
 - :class:`~tony_tpu.serve.loadgen.LoadGenerator`: open-loop multi-session
   load harness behind ``tony loadtest`` — sustained tokens/s, TTFT/token
   latency percentiles, reuse-loss accounting, and the gated
-  ``SERVE_BENCH_*`` record family.
+  ``SERVE_BENCH_*`` record family;
+- :mod:`~tony_tpu.serve.disagg`: prefill/decode disaggregation (a second
+  ``prefill`` jobtype hands finished KV pages to the decode tier over the
+  paged-KV handoff contract) + the sharded router tier — N router workers
+  behind one :class:`~tony_tpu.serve.disagg.RouterShardFront`, session pins
+  sharded by consistent hash so they survive a router dying.
 """
 
 from tony_tpu.serve.autoscaler import AutoscalePolicy, Autoscaler
+from tony_tpu.serve.disagg import DisaggCoordinator, RouterShardFront, ShardRing
 from tony_tpu.serve.health import FleetSignals, HealthMonitor, Replica, ReplicaState
 from tony_tpu.serve.router import FleetRouter
 from tony_tpu.serve.sessions import SessionPin, SessionTable
@@ -32,11 +38,14 @@ from tony_tpu.serve.sessions import SessionPin, SessionTable
 __all__ = [
     "AutoscalePolicy",
     "Autoscaler",
+    "DisaggCoordinator",
     "FleetRouter",
     "FleetSignals",
     "HealthMonitor",
     "Replica",
     "ReplicaState",
+    "RouterShardFront",
     "SessionPin",
     "SessionTable",
+    "ShardRing",
 ]

@@ -75,6 +75,11 @@ class AutoscalePolicy:
     scale_down_utilization: float = 0.25
     scale_up_ticks: int = 2
     scale_down_ticks: int = 6
+    #: paged-KV occupancy (live/total pages) above which the tier scales up
+    #: — the decode tier's memory-bound signal in a disaggregated fleet,
+    #: where slots can look idle while the page pool is the real ceiling.
+    #: 0 disables (dense fleets report occupancy 0.0 anyway).
+    scale_up_kv_occupancy: float = 0.0
 
 
 class Autoscaler:
@@ -154,12 +159,17 @@ class Autoscaler:
         want_up = (
             queue_per_replica > p.scale_up_queue_depth
             or sig.utilization > p.scale_up_utilization
+            or (p.scale_up_kv_occupancy > 0
+                and sig.kv_occupancy > p.scale_up_kv_occupancy)
             or burning
         )
         # a burning budget also vetoes scale-down: idle slots mean nothing
-        # while the latency objective is missing
+        # while the latency objective is missing — and so does a loaded KV
+        # pool (idle slots + full pages = memory-bound, not idle)
         want_down = (sig.queue_depth == 0
                      and sig.utilization < p.scale_down_utilization
+                     and not (p.scale_up_kv_occupancy > 0
+                              and sig.kv_occupancy > p.scale_up_kv_occupancy)
                      and not burning)
         self._up_ticks = self._up_ticks + 1 if want_up else 0
         self._down_ticks = self._down_ticks + 1 if want_down else 0

@@ -1,0 +1,660 @@
+"""Disaggregated prefill/decode serving + the sharded router tier.
+
+Prefill is compute-bound (one big batched matmul over the prompt), decode is
+memory-bound (KV reads dominate); co-scheduling both phases on one replica
+tier sizes the fleet for whichever bound is worse at the moment and wastes
+the other resource. This module splits them into two jobtypes of ONE
+application (``prefill`` + ``serve``, constants.PREFILL_JOB_NAME) over the
+existing gang/RPC machinery, connected by a paged-KV transfer contract:
+
+1. the router fires a **prefill leg** at a prefill replica
+   (:class:`DisaggCoordinator` → ``POST /v1/prefill``) carrying the decode
+   replica's URL;
+2. the prefill replica runs the prompt for exactly one token, exports its
+   finished full-prompt pages (:func:`export_prefix_pages` — match_prefix
+   pins them, ``gather_pages`` reads them out, release unpins) and ships
+   them (:func:`ship_pages` → ``POST /v1/kv/adopt``);
+3. the decode replica adopts them (:func:`adopt_pages` — alloc → scatter →
+   register → release parks the pages in its reuse pool, content-addressed
+   under the same incremental prefix keys the engine computes at admission);
+4. the router then routes the request to that decode replica, whose
+   admission-time ``match_prefix`` finds the adopted pages and skips the
+   prefill — ``prefix_hit_tokens`` and ``tony_serve_kv_handoff_total``
+   account for it.
+
+Every step degrades gracefully: a failed leg/ship/adopt costs one decode-
+side recompute, never a client-visible error.
+
+The second half is the **router shard tier**: N :class:`FleetRouter`
+workers, each owning a shard of the session-pin space by consistent hash of
+session id (:class:`ShardRing`), behind one :class:`RouterShardFront`
+(``tony serve --routers N``). A shard dying moves only its arc of the ring:
+surviving sessions keep their pins, the orphaned ones re-resolve to a live
+shard with exactly-once re-pin accounting through the same
+``tony_router_session_repins_total`` counter the in-table move path uses.
+Prefix hints replicate between shards on the stats/housekeeping tick
+(gossip-on-stats) so a shared system prompt steers correctly no matter
+which shard admits the session.
+"""
+
+from __future__ import annotations
+
+import base64
+import bisect
+import hashlib
+import http.client
+import itertools
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib.parse import urlsplit
+
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.obs import trace as obs_trace
+from tony_tpu.serve import sessions as sessions_mod
+from tony_tpu.serve.health import HealthMonitor, Replica, ReplicaState
+
+# same instrument serving_http.py registers — the registry hands back the
+# existing counter, so both sides account into one series without either
+# module importing the other (serving_http lazy-imports us per request)
+_KV_HANDOFF = obs_metrics.counter(
+    "tony_serve_kv_handoff_total",
+    "KV pages moved through the disaggregated prefill→decode handoff "
+    "(side=exported|adopted)", labelnames=("side",))
+_PREFILL_LEGS = obs_metrics.counter(
+    "tony_router_prefill_legs_total",
+    "disagg prefill legs fired by the router, by outcome "
+    "(ok | refused | error | no_replica)", labelnames=("outcome",))
+_SHARD_FAILOVERS = obs_metrics.counter(
+    "tony_router_shard_failovers_total",
+    "requests re-routed by the shard front after a router shard died")
+
+
+# =========================================================================
+# KV handoff: engine-side contract (runs via EngineServer.run_on_engine)
+# =========================================================================
+
+def export_prefix_pages(srv, prompt: list[int]) -> dict | None:
+    """ENGINE THREAD ONLY. Read the full-prompt pages this engine holds for
+    ``prompt`` out of the device pools into a wire payload, or None when
+    nothing is resident (the prompt spans <1 page, or the pages were evicted
+    between decode-done and export — both legal, both mean the decode side
+    recomputes). Pages are pinned (match_prefix) across the device read and
+    released after: the reuse pool must not evict them mid-gather."""
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models.paged_cache import gather_pages, prefix_keys
+
+    eng = srv.engine
+    keys = prefix_keys(prompt, eng.page_len)
+    if not keys:
+        return None
+    pages = eng.allocator.match_prefix(keys)  # pins every matched page
+    if not pages:
+        return None
+    try:
+        pk, pv = gather_pages(eng.cache.k, eng.cache.v,
+                              jnp.asarray(pages, jnp.int32), n=len(pages))
+        pk, pv = jax.device_get((pk, pv))
+    finally:
+        for p in pages:
+            eng.allocator.release(p)
+    srv.kv_handoff_exported += len(pages)
+    _KV_HANDOFF.inc(len(pages), side="exported")
+    return {
+        "page_len": int(eng.page_len),
+        "dtype": str(pk.dtype),
+        "shape": list(pk.shape),                       # [L, n, Hkv, page_len, Dh]
+        "keys": [[int(j), d.hex()] for j, d in keys[:len(pages)]],
+        "k": base64.b64encode(pk.tobytes()).decode("ascii"),
+        "v": base64.b64encode(pv.tobytes()).decode("ascii"),
+    }
+
+
+def adopt_pages(srv, payload: dict) -> tuple[int, int]:
+    """ENGINE THREAD ONLY. Adopt shipped pages into this engine's paged
+    pool: alloc physical pages, scatter the shipped values in, register them
+    under their content keys, and release — parking them in the reuse pool
+    exactly like a retired request's prompt pages, where the next matching
+    prompt's admission-time match_prefix resurrects them instead of
+    recomputing. Returns ``(adopted, already_resident)``. Raises ValueError
+    on a geometry/dtype mismatch (serving_http maps it to 400)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from tony_tpu.models.paged_cache import scatter_pages
+
+    eng = srv.engine
+    page_len = int(payload["page_len"])
+    if page_len != eng.page_len:
+        raise ValueError(
+            f"page_len mismatch: shipped {page_len}, pool {eng.page_len}")
+    keys = [(int(j), bytes.fromhex(d)) for j, d in payload["keys"]]
+    L, _, Hkv, _, Dh = eng.cache.k.shape
+    shape = tuple(int(x) for x in payload["shape"])
+    want = (L, len(keys), Hkv, page_len, Dh)
+    if shape != want:
+        raise ValueError(f"page geometry mismatch: shipped {shape}, want {want}")
+    dtype = _np_dtype(str(payload["dtype"]))
+    pool_dtype = np.dtype(str(eng.cache.k.dtype))
+    if dtype != pool_dtype:
+        raise ValueError(f"dtype mismatch: shipped {dtype}, pool {pool_dtype}")
+    raw_k = np.frombuffer(base64.b64decode(payload["k"]), dtype=dtype)
+    raw_v = np.frombuffer(base64.b64decode(payload["v"]), dtype=dtype)
+    n_elems = 1
+    for x in shape:
+        n_elems *= x
+    if raw_k.size != n_elems or raw_v.size != n_elems:
+        raise ValueError("payload size does not match declared shape")
+    raw_k = raw_k.reshape(shape)
+    raw_v = raw_v.reshape(shape)
+    alloc = eng.allocator
+    fresh = [i for i, key in enumerate(keys) if not alloc.has_key(key)]
+    have = len(keys) - len(fresh)
+    # adoption is pure opportunity: never evict this replica's own warm
+    # reuse pool to make room for shipped pages — cap at what's free
+    fresh = fresh[:max(alloc.available(), 0)]
+    if not fresh:
+        return 0, have
+    pages = alloc.alloc(len(fresh))
+    vk = jnp.asarray(np.ascontiguousarray(raw_k[:, fresh]))
+    vv = jnp.asarray(np.ascontiguousarray(raw_v[:, fresh]))
+    eng.cache = scatter_pages(eng.cache, jnp.asarray(pages, jnp.int32),
+                              vk, vv, n=len(fresh))
+    for p, i in zip(pages, fresh):
+        alloc.register(p, keys[i])
+        alloc.release(p)  # ref 0 + registered → reusable AND matchable
+    srv.kv_handoff_adopted += len(fresh)
+    _KV_HANDOFF.inc(len(fresh), side="adopted")
+    return len(fresh), have
+
+
+def ship_pages(decode_url: str, exported: dict,
+               timeout_s: float = 30.0) -> tuple[int, int]:
+    """POST an export payload to a decode replica's ``/v1/kv/adopt``.
+    Returns ``(adopted, already_resident)``; raises on transport/HTTP
+    failure (the caller degrades to a decode-side recompute)."""
+    parts = urlsplit(decode_url)
+    conn = http.client.HTTPConnection(parts.hostname, parts.port,
+                                      timeout=timeout_s)
+    try:
+        body = json.dumps(exported).encode()
+        conn.request("POST", "/v1/kv/adopt", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"adopt refused: HTTP {resp.status}: {data[:200]!r}")
+        obj = json.loads(data or b"{}")
+        return int(obj.get("adopted") or 0), int(obj.get("already_resident") or 0)
+    finally:
+        conn.close()
+
+
+def _np_dtype(name: str):
+    """Resolve a wire dtype name, including the ml_dtypes extended set
+    (bfloat16 et al.) numpy alone does not know."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+# =========================================================================
+# Router-side coordinator: the prefill leg
+# =========================================================================
+
+class DisaggCoordinator:
+    """Fires the prefill leg of a disaggregated request.
+
+    Holds the prefill tier's own :class:`HealthMonitor` (jobtype
+    ``prefill``) and picks least-outstanding exactly like the router's
+    decode pick. ``prefill()`` is strictly best-effort — every failure path
+    returns None and the decode replica recomputes the prompt; the client
+    never sees the difference beyond TTFT."""
+
+    def __init__(self, prefill_health: HealthMonitor,
+                 timeout_s: float = 30.0, window: int = 512):
+        self.health = prefill_health
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self._lat_ms: "deque[float]" = deque(maxlen=max(int(window), 1))
+
+    def pick(self) -> Replica | None:
+        snap = self.health.snapshot()
+        for state in (ReplicaState.HEALTHY, ReplicaState.UNKNOWN):
+            cands = [r for r in snap if r.state == state]
+            if cands:
+                return min(cands, key=lambda r: (r.outstanding, r.index))
+        return None
+
+    def prefill(self, prompt_tokens: list[int], decode_url: str,
+                rid: str = "") -> dict | None:
+        replica = self.pick()
+        if replica is None:
+            _PREFILL_LEGS.inc(outcome="no_replica")
+            return None
+        body = json.dumps({
+            "prompt_tokens": prompt_tokens,
+            "decode_url": decode_url,
+            "timeout_s": self.timeout_s,
+        }).encode()
+        headers = {"Content-Type": "application/json"}
+        if rid:
+            headers["X-Tony-Request-Id"] = rid
+        parts = urlsplit(replica.url)
+        with self.health.lock:
+            replica.outstanding += 1
+        t0 = time.perf_counter()
+        try:
+            conn = http.client.HTTPConnection(parts.hostname, parts.port,
+                                              timeout=self.timeout_s)
+            try:
+                conn.request("POST", "/v1/prefill", body, headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+            finally:
+                conn.close()
+        except (ConnectionError, OSError) as e:
+            self.health.report_failure(replica, hard=True)
+            _PREFILL_LEGS.inc(outcome="error")
+            obs_trace.add_event("disagg.prefill_failed",
+                                replica=replica.index, reason=str(e)[:200])
+            return None
+        finally:
+            with self.health.lock:
+                replica.outstanding -= 1
+        if resp.status != 200:
+            # 409 (dense engine) / 429 (overloaded) are the replica working
+            # as designed — refuse the leg without marking it unhealthy;
+            # only 5xx is a replica failure
+            if resp.status >= 500:
+                self.health.report_failure(replica, hard=False)
+                _PREFILL_LEGS.inc(outcome="error")
+            else:
+                _PREFILL_LEGS.inc(outcome="refused")
+            return None
+        self.health.report_success(replica)
+        took_ms = (time.perf_counter() - t0) * 1000.0
+        with self._lock:
+            self._lat_ms.append(took_ms)
+        _PREFILL_LEGS.inc(outcome="ok")
+        try:
+            return json.loads(payload or b"{}")
+        except ValueError:
+            return None
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            xs = sorted(self._lat_ms)
+
+        def pct(p: float) -> float | None:
+            if not xs:
+                return None
+            return round(xs[min(int(len(xs) * p), len(xs) - 1)], 3)
+
+        return {
+            "legs_ok": _PREFILL_LEGS.value(outcome="ok"),
+            "legs_refused": _PREFILL_LEGS.value(outcome="refused"),
+            "legs_error": _PREFILL_LEGS.value(outcome="error"),
+            "legs_no_replica": _PREFILL_LEGS.value(outcome="no_replica"),
+            "handoff_p50_ms": pct(0.50),
+            "handoff_p95_ms": pct(0.95),
+        }
+
+
+# =========================================================================
+# Router tier sharding
+# =========================================================================
+
+def _hash64(s: str) -> int:
+    return int.from_bytes(hashlib.sha256(s.encode()).digest()[:8], "big")
+
+
+class ShardRing:
+    """Consistent hash ring over router-shard indices with virtual nodes.
+
+    ``assign`` is a pure function of (key, ring geometry, live set): every
+    front replica — and a restarted front — resolves the same session to
+    the same shard, which is what lets pins survive front failover without
+    a shared store. A shard leaving moves only the sessions on its arcs
+    (~1/N of the space), not a full rehash."""
+
+    def __init__(self, shards: int, vnodes: int = 64):
+        self.shards = int(shards)
+        self.vnodes = max(int(vnodes), 1)
+        pts = sorted((_hash64(f"shard-{s}:vn-{v}"), s)
+                     for s in range(self.shards) for v in range(self.vnodes))
+        self._points = pts
+        self._hashes = [h for h, _ in pts]
+
+    def assign(self, key: str, live: "set[int] | None" = None) -> int | None:
+        """First live shard clockwise of ``key``'s point, or None when no
+        shard is live. ``live=None`` means all shards."""
+        if not self._points or (live is not None and not live):
+            return None
+        i = bisect.bisect_right(self._hashes, _hash64(key)) % len(self._points)
+        seen: set[int] = set()
+        for step in range(len(self._points)):
+            s = self._points[(i + step) % len(self._points)][1]
+            if live is None or s in live:
+                return s
+            seen.add(s)
+            if len(seen) == self.shards:
+                break
+        return None
+
+
+class RouterShardFront:
+    """One HTTP front over N in-process :class:`FleetRouter` shards.
+
+    Sessionful requests (``X-Tony-Session``) resolve to a shard by
+    consistent hash over the LIVE shard set; sessionless ones round-robin.
+    A shard connection failure marks it down, re-resolves the session on
+    the ring, and counts exactly one re-pin for it through the same
+    ``tony_router_session_repins_total`` the in-table move uses — the new
+    shard's table has no pin, so the session's next turn pays one cold
+    routing decision, which is precisely what that counter prices.
+
+    A housekeeping thread doubles as the gossip-on-stats channel: each tick
+    it (a) probes down shards back to life and (b) merges every live
+    shard's prefix-hint snapshot into the others, so shared-system-prompt
+    steering works no matter which shard admits the session."""
+
+    def __init__(self, routers: list, port: int = 0, host: str = "127.0.0.1",
+                 vnodes: int = 64, max_assignments: int = 100_000,
+                 gossip_interval_s: float = 2.0,
+                 connect_timeout_s: float = 5.0,
+                 relay_timeout_s: float = 300.0):
+        if not routers:
+            raise ValueError("RouterShardFront needs at least one router")
+        self.routers = list(routers)
+        self.ring = ShardRing(len(self.routers), vnodes=vnodes)
+        self.max_assignments = max(int(max_assignments), 1)
+        self.gossip_interval_s = float(gossip_interval_s)
+        self.connect_timeout_s = connect_timeout_s
+        self.relay_timeout_s = relay_timeout_s
+        self.started_s = time.time()
+        self._lock = threading.Lock()
+        self._assigned: "OrderedDict[str, int]" = OrderedDict()
+        self._down: set[int] = set()
+        self._rr = itertools.count()
+        self._stop = threading.Event()
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a) -> None:  # quiet
+                pass
+
+            def do_GET(self) -> None:  # noqa: N802
+                front._handle_get(self)
+
+            def do_POST(self) -> None:  # noqa: N802
+                front._handle_post(self)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="router-shard-front",
+            daemon=True)
+        self._gossip_thread = threading.Thread(
+            target=self._housekeeping_loop, name="router-shard-gossip",
+            daemon=True)
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "RouterShardFront":
+        self._thread.start()
+        if self.gossip_interval_s > 0:
+            self._gossip_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ------------------------------------------------------------ resolution
+    def live_shards(self) -> set[int]:
+        with self._lock:
+            return set(range(len(self.routers))) - self._down
+
+    def _mark_down(self, shard: int) -> None:
+        with self._lock:
+            self._down.add(shard)
+
+    def _resolve(self, session_id: str | None) -> int | None:
+        """Shard for this request. Sessionful: sticky assignment while its
+        shard lives, ring re-resolution (counted once) when it died."""
+        live = self.live_shards()
+        if not live:
+            return None
+        if not session_id:
+            # sessionless: cheap spread; any live shard is equally right
+            order = sorted(live)
+            return order[next(self._rr) % len(order)]
+        with self._lock:
+            prior = self._assigned.get(session_id)
+            if prior is not None and prior not in self._down:
+                self._assigned.move_to_end(session_id)
+                return prior
+        shard = self.ring.assign(session_id, live)
+        if shard is None:
+            return None
+        with self._lock:
+            prior = self._assigned.get(session_id)
+            if prior is not None and prior != shard and prior in self._down:
+                # the session's pin died with its shard: exactly one re-pin
+                # per failover — the fast path above short-circuits before
+                # the ring once the new assignment is recorded
+                sessions_mod.record_repin()
+                obs_trace.add_event("router.shard_repin", session=session_id,
+                                    old=prior, new=shard)
+            self._assigned[session_id] = shard
+            self._assigned.move_to_end(session_id)
+            while len(self._assigned) > self.max_assignments:
+                self._assigned.popitem(last=False)
+        return shard
+
+    # --------------------------------------------------------------- proxy
+    def _handle_post(self, h: BaseHTTPRequestHandler) -> None:
+        length = int(h.headers.get("Content-Length") or 0)
+        body = h.rfile.read(length) if length else b""
+        session_id = (h.headers.get("X-Tony-Session") or "").strip() or None
+        fwd = {k: v for k, v in h.headers.items()
+               if k.lower() in ("content-type", "x-tony-session",
+                                "x-tony-request-id")}
+        attempts = 0
+        while attempts <= len(self.routers):
+            attempts += 1
+            shard = self._resolve(session_id)
+            if shard is None:
+                _reply_json_front(h, 503, {"error": "no live router shard"})
+                return
+            try:
+                self._relay_to_shard(h, shard, h.path, body, fwd)
+                return
+            except _ShardDown:
+                _SHARD_FAILOVERS.inc()
+                self._mark_down(shard)
+                continue
+        _reply_json_front(h, 502, {"error": "router shards failing"})
+
+    def _relay_to_shard(self, h: BaseHTTPRequestHandler, shard: int,
+                        path: str, body: bytes, fwd: dict) -> None:
+        """Relay one request to a shard's own HTTP server, streaming SSE
+        through. Raises :class:`_ShardDown` only while no response byte has
+        reached the client — after that a shard death truncates the stream,
+        same contract as the router's own replica relay."""
+        router = self.routers[shard]
+        parts = urlsplit(router.url)
+        try:
+            conn = http.client.HTTPConnection(
+                parts.hostname, parts.port, timeout=self.connect_timeout_s)
+            conn.connect()
+            conn.sock.settimeout(self.relay_timeout_s)
+            conn.request("POST", path, body, fwd)
+            resp = conn.getresponse()
+        except (ConnectionError, OSError) as e:
+            raise _ShardDown(str(e)) from e
+        try:
+            ctype = resp.headers.get("Content-Type") or ""
+            if not ctype.startswith("text/event-stream"):
+                try:
+                    payload = resp.read()
+                except (ConnectionError, OSError) as e:
+                    raise _ShardDown(str(e)) from e
+                h.send_response(resp.status)
+                for k in ("Content-Type", "Retry-After", "X-Tony-Replica",
+                          "X-Tony-Request-Id"):
+                    if resp.headers.get(k):
+                        h.send_header(k, resp.headers[k])
+                h.send_header("X-Tony-Shard", str(shard))
+                h.send_header("Content-Length", str(len(payload)))
+                h.end_headers()
+                h.wfile.write(payload)
+                return
+            h.send_response(200)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Cache-Control", "no-cache")
+            h.send_header("X-Tony-Shard", str(shard))
+            for k in ("X-Tony-Replica", "X-Tony-Request-Id"):
+                if resp.headers.get(k):
+                    h.send_header(k, resp.headers[k])
+            h.end_headers()
+            while True:
+                try:
+                    chunk = resp.read1(8192)
+                except (ConnectionError, OSError):
+                    return  # truncation: the client sees the closed stream
+                if not chunk:
+                    return
+                try:
+                    h.wfile.write(chunk)
+                    h.wfile.flush()
+                except OSError:
+                    return  # client went away
+        finally:
+            conn.close()
+
+    # ----------------------------------------------------------- GET pages
+    def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
+        if h.path == "/healthz":
+            live = self.live_shards()
+            _reply_json_front(h, 200 if live else 503, {
+                "ok": bool(live),
+                "shards": len(self.routers),
+                "shards_live": len(live),
+            })
+        elif h.path == "/stats":
+            _reply_json_front(h, 200, self.stats())
+        else:
+            _reply_json_front(h, 404, {"error": "not found"})
+
+    def stats(self) -> dict[str, Any]:
+        """Front + per-shard view. Router-level counters are process-global
+        (every in-process shard reads the same registry series), so the
+        front reports them ONCE from a live shard instead of summing N
+        copies; only per-table figures (sessions) sum across shards."""
+        live = self.live_shards()
+        base: dict[str, Any] = {}
+        for i in sorted(live):
+            try:
+                base = self.routers[i].stats()
+                break
+            except Exception:  # noqa: BLE001 — shard died under us
+                continue
+        shards = []
+        total_sessions = 0
+        for i, r in enumerate(self.routers):
+            n = len(r.sessions)
+            if i in live:
+                total_sessions += n
+            shards.append({"shard": i, "live": i in live, "url": r.url,
+                           "sessions": n})
+        router = dict(base.get("router") or {})
+        router["sessions"] = total_sessions
+        with self._lock:
+            assigned = len(self._assigned)
+        out = {
+            "front": {
+                "uptime_s": round(time.time() - self.started_s, 1),
+                "shards": len(self.routers),
+                "shards_live": len(live),
+                "assigned_sessions": assigned,
+                "shard_failovers": _SHARD_FAILOVERS.value(),
+            },
+            "router": router,
+            "fleet": base.get("fleet") or {},
+            "replicas": base.get("replicas") or [],
+            "shards": shards,
+        }
+        if "disagg" in base:
+            out["disagg"] = base["disagg"]
+        return out
+
+    # ------------------------------------------------- gossip/housekeeping
+    def _housekeeping_loop(self) -> None:
+        while not self._stop.wait(self.gossip_interval_s):
+            try:
+                self._probe_down_shards()
+                self.gossip_hints()
+            except Exception:  # noqa: BLE001 — housekeeping must never die
+                pass
+
+    def _probe_down_shards(self) -> None:
+        with self._lock:
+            down = list(self._down)
+        for shard in down:
+            parts = urlsplit(self.routers[shard].url)
+            try:
+                conn = http.client.HTTPConnection(
+                    parts.hostname, parts.port, timeout=self.connect_timeout_s)
+                try:
+                    conn.request("GET", "/healthz")
+                    conn.getresponse().read()
+                finally:
+                    conn.close()
+            except (ConnectionError, OSError):
+                continue
+            with self._lock:
+                self._down.discard(shard)
+
+    def gossip_hints(self) -> int:
+        """Merge every live shard's prefix-hint snapshot into the others
+        (the gossip-on-stats channel). Returns the number of hints
+        replicated this tick."""
+        live = sorted(self.live_shards())
+        merged: dict[str, int] = {}
+        for i in live:
+            merged.update(self.routers[i].sessions.export_hints())
+        moved = 0
+        for i in live:
+            moved += self.routers[i].sessions.merge_hints(merged)
+        return moved
+
+
+class _ShardDown(Exception):
+    """Shard-level connection failure (retryable on another shard)."""
+
+
+def _reply_json_front(h: BaseHTTPRequestHandler, status: int, obj: Any) -> None:
+    body = json.dumps(obj).encode()
+    h.send_response(status)
+    h.send_header("Content-Type", "application/json")
+    h.send_header("Content-Length", str(len(body)))
+    h.end_headers()
+    h.wfile.write(body)

@@ -101,10 +101,18 @@ class FleetSignals:
     queue_depth: int = 0      # summed engine admission+staging queues
     slots_active: int = 0
     slots_total: int = 0
+    pages_live: int = 0       # paged engines only: referenced KV pages
+    pages_total: int = 0      # paged engines only: pool capacity
 
     @property
     def utilization(self) -> float:
         return self.slots_active / self.slots_total if self.slots_total else 0.0
+
+    @property
+    def kv_occupancy(self) -> float:
+        """Live-page fraction of the fleet's paged-KV pools (0.0 on dense
+        fleets) — the decode tier's memory-bound scaling signal."""
+        return self.pages_live / self.pages_total if self.pages_total else 0.0
 
 
 class HealthMonitor:
@@ -277,6 +285,8 @@ class HealthMonitor:
                 sig.queue_depth += int(st.get("queue_depth") or 0)
                 sig.slots_active += int(st.get("slots_active") or 0)
                 sig.slots_total += int(st.get("slots_total") or 0)
+                sig.pages_live += int(st.get("pages_live") or 0)
+                sig.pages_total += int(st.get("pages_total") or 0)
         return sig
 
     def fleet_info(self) -> dict[str, Any]:

@@ -34,6 +34,8 @@ import bisect
 import http.client
 import json
 import math
+import os
+import platform
 import random
 import threading
 import time
@@ -81,6 +83,11 @@ class LoadSpec:
     """One loadtest run's parameters (CLI flags / tony.serve.loadtest.*)."""
 
     url: str
+    #: additional router endpoints (the sharded tier, ``tony serve
+    #: --routers N`` driven WITHOUT the front): sessions spread across
+    #: ``(url,) + urls`` deterministically by session index, and each
+    #: session stays on its router so pins live in exactly one shard table
+    urls: tuple = ()
     rate: float = 4.0          # session arrivals per second (open loop)
     sessions: int = 16
     turns: int = 3
@@ -95,6 +102,20 @@ class LoadSpec:
     seed: int = 0
     profile: str = "uniform"   # arrival shape: "uniform" | "diurnal"
     diurnal_amp: float = 3.0   # diurnal peak rate = (1 + amp) x the trough
+
+    def all_urls(self) -> tuple:
+        """Every endpoint this run drives (primary first, deduplicated)."""
+        seen = []
+        for u in (self.url, *self.urls):
+            u = (u or "").rstrip("/")
+            if u and u not in seen:
+                seen.append(u)
+        return tuple(seen)
+
+    def session_url(self, idx: int) -> str:
+        """The endpoint session ``idx`` sticks to for its whole lifetime."""
+        urls = self.all_urls()
+        return urls[idx % len(urls)]
 
 
 def arrival_offsets(sessions: int, rate: float, profile: str = "uniform",
@@ -220,6 +241,17 @@ class LoadReport:
         hits = self._router_delta("fleet", "prefix_hit_tokens")
         if hits is not None:
             out["prefix_hit_tokens"] = int(hits)
+        # disaggregated fleets only: pages adopted through the prefill→
+        # decode handoff during the run, and the coordinator's observed
+        # handoff latency (the "handoff" phase of the serve.request chain)
+        adopted = self._router_delta("fleet", "kv_handoff_adopted")
+        if adopted is not None and adopted > 0:
+            out["kv_handoff_pages"] = int(adopted)
+        dis = (self.router_after or {}).get("disagg")
+        if isinstance(dis, dict):
+            for k in ("handoff_p50_ms", "handoff_p95_ms"):
+                if isinstance(dis.get(k), (int, float)):
+                    out[k] = dis[k]
         # worst-offender exemplars: the slowest TTFTs with the router's
         # request ids, so a bad tail is greppable straight into the span
         # chain / TTFT histogram exemplars (docs/observability.md)
@@ -263,13 +295,18 @@ class LoadReport:
                 "wall_s",
             )},
         }
-        for opt in ("session_repins", "prefix_hit_tokens", "profile"):
+        for opt in ("session_repins", "prefix_hit_tokens", "profile",
+                    "kv_handoff_pages", "handoff_p50_ms", "handoff_p95_ms"):
             if opt in d:
                 parsed[opt] = d[opt]
         if slo_verdict is not None:
             parsed["slo_verdict"] = str(slo_verdict)
         if budget_burned_pct is not None:
             parsed["budget_burned_pct"] = round(float(budget_burned_pct), 3)
+        # hardware provenance (same discipline as cbench records): the gate
+        # only trend-compares rounds measured on the same fingerprint
+        parsed["machine"] = {"cpus": os.cpu_count() or 0,
+                             "arch": platform.machine()}
         return {"n": int(round_n), "rc": int(rc), "parsed": parsed}
 
 
@@ -296,10 +333,11 @@ class LoadGenerator:
         except Exception:  # noqa: BLE001 — a bare replica has /stats too, but
             return None    # reuse-loss accounting is best-effort either way
 
-    def _post(self, body: dict[str, Any], session_id: str) -> tuple[int, dict, Any]:
+    def _post(self, body: dict[str, Any], session_id: str,
+              url: str | None = None) -> tuple[int, dict, Any]:
         """One POST /v1/completions. Returns (status, headers, parsed-or-
         stream-handle); streaming responses return the live HTTPResponse."""
-        parts = urlsplit(self.spec.url)
+        parts = urlsplit(url or self.spec.url)
         conn = http.client.HTTPConnection(
             parts.hostname, parts.port, timeout=self.spec.timeout_s)
         payload = json.dumps(body).encode()
@@ -327,6 +365,7 @@ class LoadGenerator:
             time.sleep(delay)
         spec = self.spec
         session_id = f"lt-{spec.seed}-{idx}"
+        url = spec.session_url(idx)
         lengths = [n for n, _ in spec.prompt_mix]
         weights = [w for _, w in spec.prompt_mix]
         first_len = rng.choices(lengths, weights=weights, k=1)[0]
@@ -345,7 +384,7 @@ class LoadGenerator:
             }
             t_start = time.monotonic()
             try:
-                status, headers, payload = self._post(req, session_id)
+                status, headers, payload = self._post(req, session_id, url)
                 result.status = status
                 result.replica = headers.get("X-Tony-Replica", "")
                 result.request_id = headers.get("X-Tony-Request-Id", "")

@@ -125,8 +125,13 @@ class FleetRouter:
         replica_timeout_s: float = 300.0,
         sessions: SessionTable | None = None,
         slo_ttft_threshold_ms: float | None = None,
+        disagg: Any | None = None,
     ):
         self.health = health
+        #: DisaggCoordinator (serve/disagg.py) — when set, completions
+        #: requests carrying prompt_tokens fire a prefill leg at the prefill
+        #: tier before the decode attempt; strictly best-effort
+        self.disagg = disagg
         #: session-affinity table (None → a default-config table; pass an
         #: explicitly-configured one from tony.serve.session.* keys)
         self.sessions = sessions if sessions is not None else SessionTable()
@@ -209,11 +214,12 @@ class FleetRouter:
             if r.state == ReplicaState.HEALTHY:
                 for k in ("slots_total", "slots_active", "queue_depth",
                           "requests_done", "tokens_out", "tokens_delivered",
-                          "prefix_hit_tokens"):
+                          "prefix_hit_tokens", "pages_live", "pages_total",
+                          "kv_handoff_exported", "kv_handoff_adopted"):
                     v = r.stats.get(k)
                     if isinstance(v, (int, float)):
                         agg[k] = agg.get(k, 0) + v
-        return {
+        out: dict[str, Any] = {
             "router": {
                 "uptime_s": round(time.time() - self.started_s, 1),
                 "requests_ok": _REQUESTS.value(outcome="ok"),
@@ -228,6 +234,9 @@ class FleetRouter:
             "fleet": agg,
             "replicas": per_replica,
         }
+        if self.disagg is not None:
+            out["disagg"] = self.disagg.stats()
+        return out
 
     # --------------------------------------------------------- POST → proxy
     def _handle_post(self, h: BaseHTTPRequestHandler) -> None:
@@ -256,6 +265,7 @@ class FleetRouter:
         deadline = time.monotonic() + self.failover_deadline_s
         tried: set[int] = set()
         soft_failovers = 0
+        prefill_done = False
         while True:
             replica = self._pick(tried, session_id, prompt_tokens)
             if replica is None:
@@ -272,6 +282,16 @@ class FleetRouter:
                 # health monitor to resolve the relaunched endpoints
                 time.sleep(0.1)
                 continue
+            if (self.disagg is not None and prompt_tokens and not prefill_done
+                    and path.endswith("/completions")):
+                # ONE prefill leg per request, not per failover attempt: the
+                # leg warms the chosen decode replica's page pool; a decode
+                # failover after the handoff simply recomputes (the pages
+                # died with the replica), it must not re-run the leg
+                prefill_done = True
+                with obs_trace.maybe_span("router.prefill_leg", rid=rid,
+                                          decode_replica=replica.index):
+                    self.disagg.prefill(prompt_tokens, replica.url, rid)
             try:
                 if stream:
                     self._attempt_stream(h, replica, path, body, rid)

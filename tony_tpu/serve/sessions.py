@@ -57,6 +57,15 @@ def repins_total() -> float:
     return _REPINS.value()
 
 
+def record_repin() -> None:
+    """Count a re-pin that happens OUTSIDE a SessionTable move — the router
+    shard front re-assigning a session to a surviving shard after its shard
+    died (serve/disagg.py). Same counter as the in-table move path: either
+    way the session's next turn pays one cold routing decision, and capacity
+    planning wants ONE number for that."""
+    _REPINS.inc()
+
+
 def prefix_fingerprint(prompt_tokens: list[int], span: int) -> str | None:
     """Content hash of the prompt's first ``span`` tokens (None when the
     prompt is shorter — too little shared material to steer on). Matches the
@@ -113,6 +122,11 @@ class SessionTable:
         #: system prompt expiring must not blind new sessions to the other
         #: N-1 keeping the pages warm)
         self._prefix_live: dict[str, int] = {}
+        #: gossiped hints from sibling router shards (serve/disagg.py):
+        #: kept apart from _prefix_owner because they carry no local live-pin
+        #: refcount — merging them into the owner map would corrupt the
+        #: _prefix_live bookkeeping. LRU-capped at max_sessions.
+        self._gossip: "OrderedDict[str, int]" = OrderedDict()
 
     # ------------------------------------------------------------- routing
     def lookup(self, session_id: str) -> SessionPin | None:
@@ -172,12 +186,41 @@ class SessionTable:
         if fp is None:
             return None
         with self._lock:
-            return self._prefix_owner.get(fp)
+            got = self._prefix_owner.get(fp)
+            if got is None:
+                got = self._gossip.get(fp)
+            return got
 
     def record_route(self, outcome: str) -> None:
         """Exposition of how a session request was routed
         (pinned/repinned/new/hinted)."""
         _AFFINITY.inc(outcome=outcome)
+
+    # -------------------------------------------------------------- gossip
+    def export_hints(self) -> dict[str, int]:
+        """Snapshot of the LOCALLY-OWNED prefix hints (fingerprint →
+        replica) for replication to sibling router shards. Gossiped-in
+        hints are excluded — re-exporting them would let a stale entry
+        bounce between shards forever."""
+        with self._lock:
+            return dict(self._prefix_owner)
+
+    def merge_hints(self, hints: dict[str, int]) -> int:
+        """Adopt sibling shards' prefix hints. Local ownership wins (a
+        local live pin is fresher than gossip); the gossip side table is
+        LRU-capped at max_sessions. Returns how many entries were new."""
+        added = 0
+        with self._lock:
+            for fp, idx in hints.items():
+                if fp in self._prefix_owner:
+                    continue
+                if fp not in self._gossip:
+                    added += 1
+                self._gossip[fp] = int(idx)
+                self._gossip.move_to_end(fp)
+            while len(self._gossip) > self.max_sessions:
+                self._gossip.popitem(last=False)
+        return added
 
     # --------------------------------------------------------- maintenance
     def drop_replica(self, replica_index: int) -> int:
@@ -190,7 +233,11 @@ class SessionTable:
                      if idx == replica_index]
             for fp in stale:
                 del self._prefix_owner[fp]
-            return len(stale)
+            gone = [fp for fp, idx in self._gossip.items()
+                    if idx == replica_index]
+            for fp in gone:
+                del self._gossip[fp]
+            return len(stale) + len(gone)
 
     def sweep(self) -> int:
         """Expire idle sessions (TTL); returns how many were evicted. The
