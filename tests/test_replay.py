@@ -1,0 +1,543 @@
+"""Trace-driven capacity planning (cluster/replay.py, `tony sim
+--from-history`, portal /pool/whatif): reconstruct recorded history into a
+workload, gate a no-override replay on reproducing the recorded decision
+sequence exactly, and answer what-ifs with counterfactual reports.
+
+The fidelity headline drives a REAL PoolService through a multi-queue
+admit/shrink/evict episode and replays its journal; the rest of the suite
+covers the exit-code contract, override/sweep directionality, torn/partial
+inputs (byte-chopped journal, mid-sweep history DB), and the portal page.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from tests.test_pool import register_cpu_node
+from tony_tpu.cli.sim import main as sim_main
+from tony_tpu.cluster.pool import PoolService
+from tony_tpu.cluster.replay import (
+    ReplayError,
+    parse_override,
+    parse_sweep,
+    reconstruct,
+    run_whatif,
+)
+
+pytestmark = [pytest.mark.replay]
+
+GB = 1024**3
+T0 = 1_700_000_000.0  # fixed wall-clock origin for synthesized journals
+
+
+# ---------------------------------------------------------------------------
+# synthesized journals (hand-written history with known shape)
+# ---------------------------------------------------------------------------
+def _app_row(app_id, queue, seq, admitted, preempted, demand_gb, wait_unix,
+             admitted_unix, unit=(0, 0, 0), slack=0):
+    return {
+        "t": "app", "app_id": app_id, "queue": queue, "priority": 0,
+        "seq": seq, "admitted": admitted, "preempted": preempted,
+        "demand_memory": demand_gb * GB, "demand_vcores": 1,
+        "demand_chips": 0, "wait_unix": wait_unix,
+        "admitted_unix": admitted_unix, "elastic_unit": list(unit),
+        "elastic_slack": slack,
+    }
+
+
+def _congested_journal(path):
+    """Two 4 GiB prod hogs fill an 8 GiB pool for ~120s; four 2 GiB dev
+    jobs queue behind them and only run after the hogs leave. The recorded
+    admit order is hand-written (the real policy would preempt), so this
+    fixture doubles as the fidelity-divergence case; its point is the
+    counterfactual: more dev share → less dev wait."""
+    recs = [
+        {"t": "config", "queues": {"prod": 0.6, "dev": 0.4},
+         "preemption": True, "grace_ms": 0, "drain_ms": 5000,
+         "min_runtime_ms": 0, "budget": 0, "budget_window_ms": 60_000,
+         "unix": T0},
+        {"t": "capacity", "totals": [8 * GB, 256, 0], "unix": T0},
+        _app_row("p1", "prod", 0, False, False, 4, T0, 0.0),
+        _app_row("p1", "prod", 0, True, False, 4, T0, T0),
+        _app_row("p2", "prod", 1, False, False, 4, T0 + 1, 0.0),
+        _app_row("p2", "prod", 1, True, False, 4, T0 + 1, T0 + 1),
+    ]
+    recs += [_app_row(f"d{i}", "dev", 2 + i, False, False, 2, T0 + 5 + i, 0.0)
+             for i in range(4)]
+    recs += [
+        {"t": "app_removed", "app_id": "p1", "unix": T0 + 120},
+        {"t": "app_removed", "app_id": "p2", "unix": T0 + 121},
+    ]
+    recs += [_app_row(f"d{i}", "dev", 2 + i, True, False, 2, T0 + 5 + i,
+                      T0 + 121) for i in range(4)]
+    recs += [{"t": "app_removed", "app_id": f"d{i}", "unix": T0 + 131}
+             for i in range(4)]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return path
+
+
+def _calm_journal(path):
+    """Two non-contending jobs: a journal whose recorded sequence the
+    policy reproduces trivially (the exit-0 fidelity fixture)."""
+    recs = [
+        {"t": "config", "queues": {"prod": 0.6, "dev": 0.4},
+         "preemption": True, "grace_ms": 0, "drain_ms": 5000,
+         "min_runtime_ms": 0, "budget": 0, "budget_window_ms": 60_000,
+         "unix": T0},
+        {"t": "capacity", "totals": [8 * GB, 256, 0], "unix": T0},
+        _app_row("p1", "prod", 0, False, False, 4, T0, 0.0),
+        _app_row("p1", "prod", 0, True, False, 4, T0, T0),
+        _app_row("d1", "dev", 1, False, False, 2, T0 + 5, 0.0),
+        _app_row("d1", "dev", 1, True, False, 2, T0 + 5, T0 + 5),
+        {"t": "app_removed", "app_id": "d1", "unix": T0 + 30},
+        {"t": "app_removed", "app_id": "p1", "unix": T0 + 60},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# the fidelity headline: a REAL pool's journal replays exactly
+# ---------------------------------------------------------------------------
+class TestFidelityAgainstLivePool:
+    def test_recorded_multi_queue_run_replays_exactly(self, tmp_path):
+        """Drive a real PoolService through admits, an elastic shrink, a
+        whole-gang evict, and a post-release re-admit; the no-override
+        replay must reproduce that admit/evict/shrink sequence exactly
+        (ROADMAP item 4's fidelity gate). Event spacing (1.5s) stays above
+        the sim's 1 Hz revisit tick so virtual decision instants cannot
+        alias."""
+        import time
+
+        journal = tmp_path / "pool.jsonl"
+        svc = PoolService(
+            port=0, preemption=True, preemption_drain_ms=2000,
+            queues={"prod": 0.6, "dev": 0.4}, journal_path=str(journal))
+        try:
+            register_cpu_node(svc, "n0", memory=8 * GB, vcores=64)
+            # dev1: elastic, 6 GiB (over dev's 3.2 GiB share — admitted
+            # work-conserving while the pool is empty), may shed to 2 GiB
+            svc.register_app("dev1", queue="dev", memory_bytes=6 * GB,
+                             vcores=6, elastic_unit=[GB, 1, 0],
+                             elastic_slack=4)
+            time.sleep(1.5)
+            # prod1's 4 GiB is within prod's 4.8 GiB share cap (reclaim
+            # never funds past the cap): share-reclaim shrinks dev1 by two
+            # workers instead of evicting it, and admits prod1 same pass
+            svc.register_app("prod1", queue="prod", memory_bytes=4 * GB, vcores=4)
+            time.sleep(1.5)
+            svc.release_all("prod1")
+            time.sleep(1.0)
+            svc.register_app("dev2", queue="dev", memory_bytes=3 * GB, vcores=3)
+            time.sleep(1.5)
+            # prod2 needs 4 GiB with 1 GiB free: whole-gang-evicting dev2
+            # (3 GiB, no containers running → instant requeue) covers it
+            svc.register_app("prod2", queue="prod", memory_bytes=4 * GB, vcores=4)
+            time.sleep(1.5)
+            svc.release_all("prod2")
+            time.sleep(1.5)
+            for app in ("dev2", "dev1"):
+                svc.release_all(app)
+        finally:
+            svc.rpc.stop()
+
+        trace = reconstruct(str(journal))
+        assert trace.kind == "journal"
+        assert not trace.incomplete, trace.notes
+        assert trace.queues == {"prod": 0.6, "dev": 0.4}
+        assert trace.totals[0] == 8 * GB
+        assert trace.knobs["drain_ms"] == 2000
+        actions = [e.action for e in trace.recorded]
+        # the episode must actually exercise all three decision kinds, or
+        # the gate gates nothing
+        assert actions.count("admit") >= 4, trace.recorded
+        assert "shrink" in actions, trace.recorded
+        assert "evict" in actions, trace.recorded
+
+        report = run_whatif(trace)
+        fid = report["fidelity"]
+        assert fid["applicable"]
+        assert fid["ok"], fid["detail"]
+        assert fid["recorded_len"] == len(trace.recorded)
+
+        # the CLI contract on the same journal: 0 = fidelity OK
+        assert sim_main(["--from-history", str(journal)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# exit-code contract (satellite: mirrors the lint / bench-gate CLIs)
+# ---------------------------------------------------------------------------
+class TestExitCodeContract:
+    def test_exit_0_when_counterfactual_report_produced(self, tmp_path, capsys):
+        journal = _congested_journal(tmp_path / "j.jsonl")
+        rc = sim_main(["--from-history", str(journal),
+                       "--override", "share.dev=0.5", "--json"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["overrides"] == {"share.dev": 0.5}
+        assert "delta" in report
+
+    def test_exit_0_on_exact_fidelity(self, tmp_path):
+        assert sim_main(["--from-history",
+                         str(_calm_journal(tmp_path / "j.jsonl"))]) == 0
+
+    def test_exit_1_on_fidelity_divergence(self, tmp_path, capsys):
+        journal = _congested_journal(tmp_path / "j.jsonl")
+        assert sim_main(["--from-history", str(journal)]) == 1
+        out = capsys.readouterr().out
+        assert "DIVERGED" in out
+        # the loud report names the first divergent decision and shows the
+        # replay's causal chain (pool_explain vocabulary)
+        assert "decision #" in out
+        assert "replay chain" in out
+
+    def test_exit_2_on_missing_and_garbage_input(self, tmp_path, capsys):
+        assert sim_main(["--from-history", str(tmp_path / "nope.jsonl")]) == 2
+        garbage = tmp_path / "garbage.bin"
+        garbage.write_bytes(b"\x00\xffnot a journal\n" * 4)
+        assert sim_main(["--from-history", str(garbage)]) == 2
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert sim_main(["--from-history", str(empty)]) == 2
+        capsys.readouterr()
+
+    def test_exit_2_on_bad_override_and_sweep_specs(self, tmp_path, capsys):
+        journal = _calm_journal(tmp_path / "j.jsonl")
+        assert sim_main(["--from-history", str(journal),
+                         "--override", "bogus=1"]) == 2
+        assert sim_main(["--from-history", str(journal),
+                         "--sweep", "share.dev=broken"]) == 2
+        assert sim_main(["--from-history", str(journal),
+                         "--override", "share.nosuch=0.5"]) == 2
+        capsys.readouterr()
+
+    def test_override_flags_require_from_history(self, capsys):
+        assert sim_main(["--override", "share.dev=0.5"]) == 2
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# counterfactuals: the whole point
+# ---------------------------------------------------------------------------
+class TestCounterfactuals:
+    def test_share_sweep_deltas_are_directional(self, tmp_path, capsys):
+        """More dev share → monotonically non-increasing dev queue wait:
+        the acceptance criterion's direction check, read from the CLI's
+        --json output."""
+        journal = _congested_journal(tmp_path / "j.jsonl")
+        rc = sim_main(["--from-history", str(journal),
+                       "--sweep", "share.dev=0.1:0.5:0.2", "--json"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        rows = report["sweep"]["rows"]
+        assert [r["value"] for r in rows] == [0.1, 0.3, 0.5]
+        p99 = [r["metrics"]["queue_wait"]["dev"]["wait_p99_s"] for r in rows]
+        assert all(a >= b - 1e-9 for a, b in zip(p99, p99[1:])), p99
+        assert p99[0] > p99[-1], "sweep must actually move the dev wait"
+        # and the grid table renders in text mode too
+        assert sim_main(["--from-history", str(journal),
+                         "--sweep", "share.dev=0.1:0.5:0.2"]) == 0
+        assert "sweep over share.dev" in capsys.readouterr().out
+
+    def test_single_override_reports_delta_and_notes_renormalization(
+            self, tmp_path):
+        trace = reconstruct(str(_congested_journal(tmp_path / "j.jsonl")))
+        report = run_whatif(trace, {"share.dev": 0.5})
+        # 0.5 + prod 0.6 oversubscribes: prod is rescaled, loudly
+        assert any("rescaled" in n for n in report["config_notes"])
+        assert report["delta"]["queue_wait"]["dev"]["wait_p99_s_delta"] < 0
+        # decision records explaining the variant ride the report
+        assert any(r["action"] in ("admit", "evict", "shrink")
+                   for r in report["variant_decisions"])
+
+    def test_capacity_and_knob_overrides_parse(self):
+        assert parse_override("memory-gb=16") == ("memory-gb", 16.0)
+        assert parse_override("drain-ms=10000") == ("drain-ms", 10000.0)
+        assert parse_override("preemption=0") == ("preemption", 0.0)
+        with pytest.raises(ReplayError):
+            parse_override("share=0.5")  # share needs a queue
+        key, vals = parse_sweep("drain-ms=0:10000:5000")
+        assert key == "drain-ms" and vals == [0.0, 5000.0, 10000.0]
+        with pytest.raises(ReplayError):
+            parse_sweep("share.dev=0.5:0.1:0.1")  # hi < lo
+        with pytest.raises(ReplayError):
+            parse_sweep("share.dev=0:1:0.001")  # > 64 grid points
+
+    def test_more_capacity_reduces_waits(self, tmp_path):
+        trace = reconstruct(str(_congested_journal(tmp_path / "j.jsonl")))
+        report = run_whatif(trace, {"memory-gb": 16})
+        d = report["delta"]["queue_wait"]["dev"]
+        assert d["wait_p99_s_delta"] <= 0
+        assert report["variant"]["queue_wait"]["dev"]["wait_p99_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# torn / partial inputs (satellite: journal.py's torn-tail discipline)
+# ---------------------------------------------------------------------------
+class TestTornAndPartialInputs:
+    def test_byte_chopped_journal_is_usable_and_flagged(self, tmp_path):
+        journal = _congested_journal(tmp_path / "j.jsonl")
+        raw = journal.read_bytes()
+        # chop mid-record somewhere past the first few app rows: the torn
+        # final line is dropped (journal discipline) and the apps left
+        # mid-flight surface as an explicit incomplete flag
+        chopped = tmp_path / "chopped.jsonl"
+        chopped.write_bytes(raw[: int(len(raw) * 0.6)])
+        trace = reconstruct(str(chopped))
+        assert trace.incomplete
+        assert any("mid-flight" in n or "truncated" in n for n in trace.notes)
+        assert trace.jobs, "truncated-but-USABLE: the surviving apps replay"
+        report = run_whatif(trace, {"share.dev": 0.5})
+        assert "delta" in report
+        assert report["trace"]["incomplete"] is True
+
+    def test_midfile_garbage_truncates_with_note_never_crashes(self, tmp_path):
+        journal = _calm_journal(tmp_path / "j.jsonl")
+        lines = journal.read_text().splitlines(keepends=True)
+        # corrupt a MIDDLE line (not the tail): iter_journal raises
+        # JournalError lazily; reconstruction must degrade, not die
+        lines[3] = "{this is not json\n"
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text("".join(lines))
+        trace = reconstruct(str(bad))
+        assert trace.incomplete
+        assert any("truncated mid-stream" in n for n in trace.notes)
+        assert trace.jobs
+
+    def test_mid_sweep_history_db_yields_incomplete_trace(self, tmp_path):
+        from tony_tpu.histserver.store import HistoryStore
+
+        store = HistoryStore(str(tmp_path / "hist.sqlite"))
+        windows = [
+            {"queue": "prod", "window_start_ms": i * 60_000,
+             "window_end_ms": (i + 1) * 60_000,
+             "metrics": {"admissions": 2, "used_avg": 4.0 * GB,
+                         "share_capacity": 5.0 * GB}}
+            for i in range(3)
+        ]
+        # dev ingested only one window: a sweep caught mid-flight
+        windows.append(
+            {"queue": "dev", "window_start_ms": 0, "window_end_ms": 60_000,
+             "metrics": {"admissions": 1, "used_avg": 2.0 * GB,
+                         "share_capacity": 3.0 * GB}})
+        store.put_cluster_windows("pool", windows)
+        exported = store.cluster_trace("pool")
+        assert len(exported) == 4
+        store.close()
+        trace = reconstruct(str(tmp_path / "hist.sqlite"))
+        assert trace.kind == "history-db"
+        assert trace.approximate
+        assert trace.incomplete  # window coverage differs across queues
+        assert any("coverage differs" in n for n in trace.notes)
+        assert len(trace.jobs) == 7  # 3*2 prod + 1 dev
+        # the fidelity gate does not apply to synthesized workloads — and
+        # an approximate replay still reports, exit 0
+        report = run_whatif(trace)
+        assert report["fidelity"]["applicable"] is False
+        assert sim_main(["--from-history", str(tmp_path / "hist.sqlite")]) == 0
+
+    def test_empty_history_db_is_exit_2(self, tmp_path, capsys):
+        from tony_tpu.histserver.store import HistoryStore
+
+        HistoryStore(str(tmp_path / "hist.sqlite")).close()
+        assert sim_main(["--from-history", str(tmp_path / "hist.sqlite")]) == 2
+        assert "no cluster_series rows" in capsys.readouterr().err
+
+    def test_series_file_reconstructs_with_torn_line_skipped(self, tmp_path):
+        from tony_tpu.cluster.recorder import window_line
+
+        series = tmp_path / "cluster.series.jsonl"
+        lines = [
+            window_line("pool", {
+                "queue": "prod", "window_start_ms": i * 60_000,
+                "window_end_ms": (i + 1) * 60_000,
+                "metrics": {"admissions": 1, "used_avg": 4.0 * GB,
+                            "share_capacity": 5.0 * GB}})
+            for i in range(2)
+        ]
+        series.write_text("\n".join(lines) + "\n" + lines[0][: len(lines[0]) // 2])
+        trace = reconstruct(str(series))
+        assert trace.kind == "series"
+        assert trace.approximate
+        assert len(trace.jobs) == 2
+
+
+# ---------------------------------------------------------------------------
+# journal reconstruction details
+# ---------------------------------------------------------------------------
+class TestReconstruction:
+    def test_missing_config_and_capacity_fall_back_loudly(self, tmp_path):
+        """Pre-upgrade journals (no config/capacity records) still replay:
+        equal shares, inferred totals, and notes saying exactly that."""
+        journal = tmp_path / "old.jsonl"
+        recs = [
+            _app_row("a1", "q1", 0, False, False, 4, T0, 0.0),
+            _app_row("a1", "q1", 0, True, False, 4, T0, T0),
+            _app_row("a2", "q2", 1, False, False, 2, T0 + 2, 0.0),
+            _app_row("a2", "q2", 1, True, False, 2, T0 + 2, T0 + 2),
+            {"t": "app_removed", "app_id": "a1", "unix": T0 + 30},
+            {"t": "app_removed", "app_id": "a2", "unix": T0 + 30},
+        ]
+        journal.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        trace = reconstruct(str(journal))
+        assert trace.queues == {"q1": 0.5, "q2": 0.5}
+        assert trace.totals[0] >= 6 * GB  # peak concurrent admitted demand
+        assert any("inferred EQUAL" in n for n in trace.notes)
+        assert any("totals inferred" in n for n in trace.notes)
+
+    def test_no_app_records_is_replay_error(self, tmp_path):
+        journal = tmp_path / "cfg-only.jsonl"
+        journal.write_text(json.dumps(
+            {"t": "config", "queues": {"q": 1.0}, "unix": T0}) + "\n")
+        with pytest.raises(ReplayError, match="no app records"):
+            reconstruct(str(journal))
+
+    def test_compacted_journal_reconstructs_from_snapshot(self, tmp_path):
+        """A compacted journal (snapshot barrier + embedded records) folds
+        like the pool's own recovery: surviving state replays, and a note
+        says pre-snapshot runtimes are folded away."""
+        inner = [
+            {"t": "config", "queues": {"prod": 1.0}, "preemption": True,
+             "grace_ms": 0, "drain_ms": 5000, "min_runtime_ms": 0,
+             "budget": 0, "budget_window_ms": 60_000, "unix": T0},
+            {"t": "capacity", "totals": [8 * GB, 64, 0], "unix": T0},
+            _app_row("a1", "prod", 0, True, False, 4, T0, T0 + 1),
+        ]
+        recs = [
+            {"t": "snapshot", "records": inner},
+            {"t": "app_removed", "app_id": "a1", "unix": T0 + 40},
+        ]
+        journal = (tmp_path / "compacted.jsonl")
+        journal.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        trace = reconstruct(str(journal))
+        assert [j.app_id for j in trace.jobs] == ["a1"]
+        assert trace.jobs[0].work_s == pytest.approx(39.0, abs=0.1)
+        assert any("compacted" in n for n in trace.notes)
+
+    def test_evict_and_elastic_contract_survive_reconstruction(self, tmp_path):
+        journal = tmp_path / "j.jsonl"
+        recs = [
+            {"t": "config", "queues": {"prod": 0.5, "dev": 0.5},
+             "preemption": True, "grace_ms": 0, "drain_ms": 5000,
+             "min_runtime_ms": 0, "budget": 0, "budget_window_ms": 60_000,
+             "unix": T0},
+            {"t": "capacity", "totals": [8 * GB, 64, 0], "unix": T0},
+            _app_row("e1", "dev", 0, False, False, 6, T0, 0.0,
+                     unit=(GB, 1, 0), slack=4),
+            _app_row("e1", "dev", 0, True, False, 6, T0, T0,
+                     unit=(GB, 1, 0), slack=4),
+            # policy shrink: app row shows reduced demand, drain names it
+            _app_row("e1", "dev", 0, True, False, 3, T0, T0,
+                     unit=(GB, 1, 0), slack=1),
+            {"t": "drain", "app_id": "e1", "req_id": "r1", "mode": "shrink",
+             "workers": 3, "target_primary": 3 * GB, "origin": "sched",
+             "for_app": "p1", "deadline_unix": T0 + 20, "t0_unix": T0 + 10},
+            # later evicted whole for another head
+            _app_row("e1", "dev", 0, False, True, 3, T0 + 30, 0.0,
+                     unit=(GB, 1, 0), slack=1),
+            {"t": "app_removed", "app_id": "e1", "unix": T0 + 60},
+        ]
+        journal.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        trace = reconstruct(str(journal))
+        job = trace.jobs[0]
+        # ORIGINAL demand and slack (elementwise max over history), not the
+        # shrunken remnant — the replay re-decides the shrink itself
+        assert job.demand[0] == 6 * GB
+        assert job.elastic_slack == 4
+        assert job.elastic_unit == (GB, 1, 0)
+        keys = [e.key() for e in trace.recorded]
+        assert ("admit", "e1") in keys
+        assert ("shrink", "e1", 3) in keys
+        assert ("evict", "e1") in keys
+
+
+# ---------------------------------------------------------------------------
+# portal /pool/whatif (acceptance: deltas visible on the page too)
+# ---------------------------------------------------------------------------
+class TestPortalWhatif:
+    def _portal(self, tmp_path, journal):
+        from tony_tpu.portal.server import serve
+
+        root = tmp_path / "history"
+        root.mkdir(exist_ok=True)
+        srv = serve(str(root), port=0, pool_journal=str(journal))
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, srv.server_address[1]
+
+    def test_whatif_page_renders_overlay_and_sweep(self, tmp_path):
+        journal = _congested_journal(tmp_path / "j.jsonl")
+        srv, port = self._portal(tmp_path, journal)
+        try:
+            api = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/pool/whatif"
+                "?override=share.dev=0.5&sweep=share.dev=0.1:0.5:0.2"))
+            # directional: more dev share → less dev wait, on the portal too
+            assert api["delta"]["queue_wait"]["dev"]["wait_p99_s_delta"] < 0
+            p99 = [r["metrics"]["queue_wait"]["dev"]["wait_p99_s"]
+                   for r in api["sweep"]["rows"]]
+            assert all(a >= b - 1e-9 for a, b in zip(p99, p99[1:])), p99
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/pool/whatif"
+                "?override=share.dev=0.5&sweep=share.dev=0.1:0.5:0.2"
+            ).read().decode()
+            assert "counterfactual" in page
+            assert "sweep over share.dev" in page
+            # deltas link back to the decision records that explain them
+            assert "decision records behind" in page
+            assert "baseline" in page and "share.dev" in page
+        finally:
+            srv.shutdown()
+
+    def test_whatif_without_journal_explains_instead_of_500(self, tmp_path):
+        srv, port = self._portal(tmp_path, "")
+        try:
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/pool/whatif").read().decode()
+            assert "no --pool-journal" in page
+            api = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/pool/whatif"))
+            assert "error" in api
+        finally:
+            srv.shutdown()
+
+    def test_whatif_bad_override_is_a_rendered_error(self, tmp_path):
+        journal = _calm_journal(tmp_path / "j.jsonl")
+        srv, port = self._portal(tmp_path, journal)
+        try:
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/pool/whatif?override=bogus=1"
+            ).read().decode()
+            assert "replay failed" in page
+            assert "unknown" in page
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# instruments (metrics-discipline: registered + documented)
+# ---------------------------------------------------------------------------
+class TestInstruments:
+    def test_replay_runs_counter_moves_by_outcome(self, tmp_path):
+        from tony_tpu.obs import metrics as obs_metrics
+
+        def counter_value(name, **labels):
+            snap = obs_metrics.REGISTRY.snapshot()
+            for fam in snap:
+                if fam["name"] == name:
+                    for s in fam["samples"]:
+                        if all(s["labels"].get(k) == v
+                               for k, v in labels.items()):
+                            return s["value"]
+            return 0.0
+
+        before = counter_value("tony_sim_replay_runs_total",
+                               outcome="counterfactual")
+        trace = reconstruct(str(_calm_journal(tmp_path / "j.jsonl")))
+        run_whatif(trace, {"drain-ms": 1000})
+        after = counter_value("tony_sim_replay_runs_total",
+                              outcome="counterfactual")
+        assert after == before + 1

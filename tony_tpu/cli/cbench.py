@@ -53,6 +53,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--seed", type=int, default=None, help="tony.cbench.seed")
     p.add_argument("--scale", type=float, default=1.0,
                    help="proportionally shrink every size (0.01 ≈ a smoke run)")
+    p.add_argument("--scale-probe", action="store_true",
+                   help="run the 10x scale probe (default 100k apps / 10k "
+                        "executors; --apps/--executors override) instead of "
+                        "the gated family: reports each phase's cost and "
+                        "scaling exponent and names the next wall. Writes "
+                        "no CBENCH round — probe sizes are not the "
+                        "headline's provenance")
     p.add_argument("--workdir", default="",
                    help="scratch directory (default: a fresh temp dir)")
     p.add_argument("--out", default="", help="write the parsed JSON report here")
@@ -80,6 +87,29 @@ def main(argv: list[str] | None = None) -> int:
     if args.scale != 1.0:
         sizes = sizes.scaled(args.scale)
     print(f"[tony-cbench] sizes: {sizes}", flush=True)
+
+    if args.scale_probe:
+        from tony_tpu.cluster.cbench import bench_scale_probe
+
+        def probe(workdir: str) -> dict:
+            return bench_scale_probe(
+                workdir,
+                apps=args.apps or 100_000,
+                executors=args.executors or 10_000,
+                heartbeat_seconds=args.heartbeat_seconds,
+                log=lambda m: print(m, flush=True),
+            )
+
+        if args.workdir:
+            parsed = probe(args.workdir)
+        else:
+            with tempfile.TemporaryDirectory(prefix="tony-cbench-") as workdir:
+                parsed = probe(workdir)
+        print(json.dumps(parsed, indent=2))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(parsed, f, indent=2)
+        return 0
 
     def run(workdir: str) -> dict:
         return run_all(sizes, workdir, log=lambda m: print(m, flush=True))

@@ -391,7 +391,8 @@ def main(argv: list[str] | None = None) -> int:
         print("  resize     retarget a RUNNING job's per-type instance count (elastic rebuild)")
         print("  goodput    exact goodput/badput phase accounting + straggler skew + alert history")
         print("  slo        SLO error budgets + burn rates (status) and the history-backed verdict")
-        print("  sim        replay seeded synthetic arrivals against the live scheduler policy (invariant check)")
+        print("  sim        replay seeded synthetic arrivals against the live scheduler policy (invariant check),")
+        print("             or recorded history with --from-history (fidelity gate + what-if counterfactuals)")
         print("  explain    render the pool scheduler's decision provenance for an app or queue (flight recorder)")
         print("  tune       autotune Pallas kernel block sizes on this backend into the on-disk cache")
         return 0

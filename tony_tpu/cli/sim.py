@@ -16,9 +16,22 @@ event-by-event, exiting nonzero on the first divergence:
 
     tony sim --parity --jobs 1000          # all four mixes, both policies
 
+History mode (docs/scheduling.md "What-if capacity planning") replays a
+RECORDED workload instead of a synthetic one: the pool journal (or a
+history-store DB / cluster-series file) is reconstructed into arrivals,
+demands, elastic contracts, and runtimes, and replayed through the same
+policy under the recorded config or a modified one:
+
+    tony sim --from-history /var/tony/pool.jsonl                    # fidelity gate
+    tony sim --from-history pool.jsonl --override share.dev=0.15    # counterfactual
+    tony sim --from-history pool.jsonl --sweep share.dev=0.1:0.5:0.1
+
 Exit code 0 = every job completed and every invariant held (and, with
 --parity, both policies decided identically); 1 = a violation or divergence
 (the report names it, and the seed reproduces it exactly); 2 = usage error.
+With --from-history: 0 = report produced (fidelity OK, or a counterfactual
+report with --override/--sweep), 1 = the no-override replay diverged from
+the recorded decision sequence, 2 = usage error or unreadable input.
 """
 
 from __future__ import annotations
@@ -38,6 +51,48 @@ from tony_tpu.cluster.sim import (
     run_market_mix,
     run_parity,
 )
+
+
+def _from_history(args) -> int:
+    """``tony sim --from-history``: reconstruct → fidelity-gate → what-if.
+    Exit contract (asserted in tests/test_replay.py, mirroring the lint and
+    bench-gate CLIs): 0 report produced, 1 fidelity divergence, 2 usage
+    error or unreadable input."""
+    from tony_tpu.cluster.replay import (
+        ReplayError,
+        parse_override,
+        parse_sweep,
+        reconstruct,
+        render_whatif,
+        run_whatif,
+    )
+    from tony_tpu.config import TonyConfig, keys
+
+    config = TonyConfig.from_layers(conf_file=args.conf_file or None,
+                                    conf_args=args.conf)
+    try:
+        overrides = dict(parse_override(s) for s in args.override)
+        sweep = parse_sweep(args.sweep) if args.sweep else None
+        trace = reconstruct(
+            args.from_history,
+            source=args.source or None,
+            default_work_s=config.get_float(keys.SIM_REPLAY_DEFAULT_WORK_S, 30.0),
+        )
+        report = run_whatif(
+            trace, overrides or None, sweep,
+            horizon_s=config.get_float(keys.SIM_REPLAY_HORIZON_S, 10_000_000.0),
+            coop_yield_s=config.get_float(keys.SIM_REPLAY_COOP_YIELD_S, 1.0),
+            shrink_rebuild_s=config.get_float(
+                keys.SIM_REPLAY_SHRINK_REBUILD_S, 2.0),
+        )
+    except ReplayError as e:
+        print(f"tony sim: {e}", file=sys.stderr)
+        return 2
+    print(render_whatif(report, as_json=args.json))
+    fid = report["fidelity"]
+    if not overrides and not sweep and fid["applicable"] and not fid["ok"]:
+        return 1
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -88,7 +143,35 @@ def main(argv: list[str] | None = None) -> int:
                         "(docs/scheduling.md 'Explaining decisions'). "
                         "Requires --policy indexed")
     p.add_argument("--json", action="store_true", help="machine-readable report")
+    p.add_argument("--from-history", default="", metavar="PATH",
+                   help="replay RECORDED history instead of a synthetic mix: "
+                        "a pool journal (tony.pool.journal.file), a history-"
+                        "store sqlite DB, or a cluster-series JSONL file. "
+                        "Without --override/--sweep this is the fidelity "
+                        "gate: exit 1 unless the replay reproduces the "
+                        "recorded admit/evict/shrink sequence exactly")
+    p.add_argument("--source", default="",
+                   help="with a history-db input: restrict to this "
+                        "cluster_series source (series-file stem)")
+    p.add_argument("--override", action="append", default=[], metavar="KEY=VAL",
+                   help="counterfactual config change, repeatable: "
+                        "share.<queue>=, drain-ms=, grace-ms=, "
+                        "min-runtime-ms=, budget=, budget-window-ms=, "
+                        "memory-gb=, vcores=, chips=, preemption=0/1")
+    p.add_argument("--sweep", default="", metavar="KEY=LO:HI:STEP",
+                   help="replay once per grid point of one knob and print "
+                        "the counterfactual delta table")
+    p.add_argument("--conf-file", default="", help="tony site config (tony.sim.*)")
+    p.add_argument("--conf", action="append", default=[], metavar="KEY=VAL",
+                   help="config override, repeatable")
     args = p.parse_args(argv)
+
+    if args.from_history:
+        return _from_history(args)
+    if args.override or args.sweep:
+        print("tony sim: --override/--sweep need --from-history "
+              "(synthetic mixes take their knobs as flags)", file=sys.stderr)
+        return 2
 
     try:
         queues = parse_queue_spec(args.queues)

@@ -653,6 +653,82 @@ BENCHMARKS = (
     ("portal", bench_portal_scrape),
 )
 
+def bench_scale_probe(
+    workdir: str,
+    *,
+    apps: int = 100_000,
+    executors: int = 10_000,
+    heartbeat_seconds: float | None = None,
+    log=print,
+) -> dict[str, Any]:
+    """ROADMAP item 4 stretch: the indexed scheduler made 10k apps cheap —
+    find the NEXT wall before production does. One probe at 10x the
+    checked-in CBENCH sizes (100k apps / 10k executors), reporting each
+    control-plane phase's cost at probe scale, its scaling exponent vs the
+    standard size (1.0 = linear; above ~1.2 = the wall is superlinear and
+    approaching), and the single phase that dominates — the ``next_wall``.
+
+    Not part of the gated CBENCH family: the headline's sizes are frozen
+    provenance (a 100k-app record and a 10k-app record are different
+    benchmarks wearing the same name), so the probe writes no round — it
+    names where the next one must be earned."""
+    base = CbenchSizes()
+    big = replace(base, apps=int(apps), executors=int(executors),
+                  heartbeat_seconds=float(heartbeat_seconds
+                                          if heartbeat_seconds is not None
+                                          else base.heartbeat_seconds))
+    log(f"[tony-cbench] scale probe: {big.apps} apps / {big.executors} "
+        f"executors (standard: {base.apps} / {base.executors})")
+    # reference points at the standard size (few passes: exponents need a
+    # ratio, not a distribution)
+    small_sched = bench_scheduler(base, passes=3)
+    # the probe's three wall candidates, all in seconds at probe scale:
+    # (a) a cold full-world scheduling pass; (b) rebuilding the WorldIndex
+    # from scratch (pool restart / journal recovery path); (c) one full
+    # heartbeat sweep of the executor fleet
+    big_sched = bench_scheduler(big, passes=3)
+    _, template, _ = _scheduler_world(big, "indexed")
+    views = [replace(v) for v in template]
+    t0 = time.perf_counter()
+    WorldIndex.of_views(views)
+    of_views_s = time.perf_counter() - t0
+    hb = bench_heartbeats(big, workdir)
+    cold_s = big_sched["sched_decision_p50_ms"] / 1000.0
+    sweep_s = big.executors / max(hb["heartbeats_per_sec"], 1e-9)
+    scale = big.apps / base.apps
+    cold_exp = math.log(
+        max(cold_s, 1e-9)
+        / max(small_sched["sched_decision_p50_ms"] / 1000.0, 1e-9)
+    ) / math.log(scale)
+    incr_exp = math.log(
+        max(big_sched["sched_incremental_p50_ms"], 1e-6)
+        / max(small_sched["sched_incremental_p50_ms"], 1e-6)
+    ) / math.log(scale)
+    walls = {
+        "sched_cold_pass": cold_s,
+        "world_index_rebuild": of_views_s,
+        "heartbeat_full_sweep": sweep_s,
+    }
+    next_wall = max(walls, key=walls.get)  # type: ignore[arg-type]
+    result = {
+        "probe_apps": big.apps,
+        "probe_executors": big.executors,
+        "probe_sched_cold_p50_s": round(cold_s, 3),
+        "probe_sched_incremental_p50_ms": big_sched["sched_incremental_p50_ms"],
+        "probe_world_index_rebuild_s": round(of_views_s, 3),
+        "probe_heartbeat_sweep_s": round(sweep_s, 3),
+        "probe_heartbeat_p99_ms": hb["heartbeat_p99_ms"],
+        "probe_cold_scaling_exponent": round(cold_exp, 3),
+        "probe_incremental_scaling_exponent": round(incr_exp, 3),
+        "next_wall": next_wall,
+        "next_wall_seconds": round(walls[next_wall], 3),
+    }
+    log(f"[tony-cbench] scale probe: next wall is {next_wall} "
+        f"({walls[next_wall]:.2f}s at probe scale; cold-pass exponent "
+        f"{cold_exp:.2f}, incremental exponent {incr_exp:.2f})")
+    return result
+
+
 #: parsed-record throughputs the headline composes (geometric mean): one
 #: per benchmark, all higher-is-better
 HEADLINE_COMPONENTS = (

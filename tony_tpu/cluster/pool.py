@@ -447,6 +447,24 @@ class PoolService:
                         self._app_seq = itertools.count()
                         self._rebuild_derived_locked()
             self._journal = Journal(journal_path)
+            # make the decision CONTEXT replayable (cluster/replay.py):
+            # every process start records the config its scheduling
+            # decisions run under, so `tony sim --from-history` replays a
+            # journal under the shares/knobs that actually produced it —
+            # not guesses. Capacity rides separate records at every node
+            # join/loss (register_node / _mark_node_lost_locked).
+            self._jlog_locked(
+                "config",
+                queues=dict(self.queues),
+                preemption=bool(self.preemption),
+                grace_ms=int(self.preemption_grace_ms),
+                drain_ms=int(self.preemption_drain_ms),
+                min_runtime_ms=int(self._policy.min_runtime_ms),
+                budget=int(self._policy.eviction_budget),
+                budget_window_ms=int(self._policy.budget_window_ms),
+                unix=time.time(),
+            )
+            self._journal_sync()
         self.rpc = RpcServer(host=bind_host, port=port, secret=secret)
         self.rpc.register_object(self, POOL_RPC_METHODS)
         self._monitor = threading.Thread(target=self._liveness_loop, name="pool-liveness", daemon=True)
@@ -502,6 +520,23 @@ class PoolService:
         tests/test_pool.py)."""
         now_mono, now_unix = time.monotonic(), time.time()
         recs: list[dict[str, Any]] = []
+        # the replay context survives compaction: a folded journal must
+        # still say what config/capacity its surviving rows' decisions ran
+        # under, or `tony sim --from-history` falls back to guessed shares
+        recs.append({
+            "t": "config", "queues": dict(self.queues),
+            "preemption": bool(self.preemption),
+            "grace_ms": int(self.preemption_grace_ms),
+            "drain_ms": int(self.preemption_drain_ms),
+            "min_runtime_ms": int(self._policy.min_runtime_ms),
+            "budget": int(self._policy.eviction_budget),
+            "budget_window_ms": int(self._policy.budget_window_ms),
+            "unix": now_unix,
+        })
+        recs.append({
+            "t": "capacity", "totals": list(self._totals_locked()),
+            "unix": now_unix,
+        })
         for app in self._apps.values():
             recs.append({
                 "t": "app", "app_id": app.app_id, "queue": app.queue,
@@ -782,6 +817,12 @@ class PoolService:
                         }
                     else:
                         self._grows.pop(app_id, None)
+            elif t in ("config", "capacity"):
+                # replay-context records (cluster/replay.py): the config the
+                # decisions ran under and the capacity timeline. Recovery
+                # state comes from the constructor and re-registration — the
+                # records exist for `tony sim --from-history`, not for us.
+                pass
             else:
                 raise JournalError(f"unknown pool journal record type {t!r}")
         self._app_seq = itertools.count(max_seq + 1)
@@ -926,6 +967,8 @@ class PoolService:
             )
             if self._world is not None:
                 self._world.touch()  # pool totals moved with the node set
+            self._jlog_locked(
+                "capacity", totals=list(self._totals_locked()), unix=time.time())
             self._schedule_locked()
         self._journal_sync()  # seen/exit records durable before the agent acts
         return {
@@ -1270,7 +1313,7 @@ class PoolService:
                 self._grows.pop(app_id, None)
                 self._jlog_locked("growback", app_id=app_id, workers=0)
             self._grown_at.pop(app_id, None)
-            self._jlog_locked("app_removed", app_id=app_id)
+            self._jlog_locked("app_removed", app_id=app_id, unix=time.time())
             self._schedule_locked()
         self._journal_sync()  # removal durable before the AM tears down
         return {"ack": True}
@@ -2232,6 +2275,8 @@ class PoolService:
         node.alive = False
         if self._world is not None:
             self._world.touch()  # pool totals shrank with the node
+        self._jlog_locked(
+            "capacity", totals=list(self._totals_locked()), unix=time.time())
         for cid, rec in self._containers.items():
             if rec["node"] == node.name and rec["state"] == _RUNNING:
                 self._record_exit_locked(cid, constants.EXIT_NODE_LOST)

@@ -1,0 +1,1116 @@
+"""Trace-driven capacity planning: replay RECORDED history through the sim.
+
+``tony sim --from-history <journal|history-db|series-file>`` closes the
+recorder → simulator loop (ROADMAP item 4, docs/scheduling.md "What-if
+capacity planning"): the pool already journals every app transition and
+charts every decision — this module turns that history back into a
+workload and replays it through the EXACT
+:class:`~tony_tpu.cluster.policy.PreemptionPolicy` the live pool ran,
+under the recorded config or a modified one.
+
+Three source kinds, decreasing fidelity:
+
+- **pool journal** (``tony.pool.journal.file``) — the full per-app
+  timeline: arrivals (``wait_unix``), demands and elastic contracts,
+  admit/evict transitions, shrink episodes (``drain`` records), removals.
+  The journal's ``config``/``capacity`` records carry the queue shares,
+  preemption knobs, and pool totals the decisions were made under, so a
+  **no-override replay is a fidelity gate**: the replayed
+  admit/evict/shrink sequence must reproduce the recorded one exactly,
+  and any divergence is reported loudly with the first divergent
+  decision and its causal chain (the same
+  :class:`~tony_tpu.cluster.recorder.FlightRecorder` vocabulary
+  ``pool_explain`` serves).
+- **history-store DB** (``cluster_series`` table) and **cluster-series
+  JSONL** — per-queue telemetry windows only. The workload is
+  *synthesized* to match the recorded per-window admission counts and
+  occupancy, the trace is flagged ``approximate``, and the fidelity gate
+  does not apply (there is no recorded decision sequence to gate on).
+
+Overridden replays (``--override share.dev=0.15``, ``--sweep
+key=lo:hi:step``) emit counterfactual reports — per-queue queue-wait
+p50/p99, preemption counts by mode, goodput/badput deltas against the
+recorded baseline — answering "what if the dev queue's share were 15%?"
+from data, not vibes.
+
+Torn/partial inputs follow cluster/journal.py's discipline: a
+byte-chopped journal or a mid-sweep history DB yields a
+truncated-but-usable trace with an explicit ``incomplete`` flag (and the
+reason in ``notes``), never a crash.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Iterator
+
+from tony_tpu.cluster.journal import SNAPSHOT_RECORD, JournalError, iter_journal
+from tony_tpu.cluster.policy import Vec, validate_queue_shares
+from tony_tpu.cluster.recorder import read_window_lines
+from tony_tpu.cluster.sim import GB, PoolSimulator, SimJob
+from tony_tpu.obs import metrics as obs_metrics
+from tony_tpu.serve.loadgen import percentile as _percentile
+
+_REPLAY_RUNS = obs_metrics.counter(
+    "tony_sim_replay_runs_total",
+    "history replays by outcome: fidelity-ok (no-override replay reproduced "
+    "the recorded decision sequence), divergence (it did not), "
+    "counterfactual (an overridden/sweep replay produced its report), "
+    "error (unreadable or unusable input)",
+    labelnames=("outcome",))
+
+
+class ReplayError(ValueError):
+    """Unusable input or bad override spec — the CLI's exit-2 class."""
+
+
+#: knobs a replay runs under when the journal predates ``config`` records
+#: (overridable per run; the note says so loudly)
+DEFAULT_KNOBS = {
+    "preemption": True,
+    "grace_ms": 0,
+    "drain_ms": 5_000,
+    "min_runtime_ms": 0,
+    "budget": 0,
+    "budget_window_ms": 60_000,
+}
+
+#: work assigned to an app the record shows WAITING but never admitted
+#: (tony.sim.replay.default-work-s): the replay must give it something to
+#: do once a counterfactual config admits it
+DEFAULT_WORK_S = 30.0
+
+
+# ---------------------------------------------------------------------------
+# the reconstructed trace
+# ---------------------------------------------------------------------------
+@dataclass
+class RecordedEvent:
+    """One recorded scheduler action, in journal order."""
+
+    action: str                # admit | evict | shrink
+    app_id: str
+    unix: float = 0.0
+    workers: int = 0           # shrink only
+    for_app: str = ""          # shrink only
+    origin: str = "sched"      # shrink only: sched (policy) | demand (market)
+
+    def key(self) -> tuple:
+        if self.action == "shrink":
+            return (self.action, self.app_id, self.workers)
+        return (self.action, self.app_id)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self.__dict__)
+
+
+@dataclass
+class ScriptedAction:
+    """A recorded transition the REPLAY applies verbatim instead of
+    re-deciding: market-origin sheds (decided by ``fund_demand``, a pass
+    the event simulator does not run) and grow-backs landing. They are
+    external inputs to the scheduler under test, not its decisions."""
+
+    at_s: float                # virtual instant (relative to trace t0)
+    kind: str                  # shrink | grow
+    app_id: str
+    workers: int = 0
+    for_app: str = ""
+    demand: Vec = (0, 0, 0)    # grow: the demand vector after the grow landed
+
+
+@dataclass
+class ReplayTrace:
+    """The reconstructed workload plus the config it recorded."""
+
+    source: str
+    kind: str                              # journal | history-db | series
+    jobs: list[SimJob] = field(default_factory=list)
+    recorded: list[RecordedEvent] = field(default_factory=list)
+    scripted: list[ScriptedAction] = field(default_factory=list)
+    queues: dict[str, float] = field(default_factory=dict)
+    totals: Vec = (0, 0, 0)
+    knobs: dict[str, Any] = field(default_factory=lambda: dict(DEFAULT_KNOBS))
+    t0_unix: float = 0.0
+    #: the input was torn/partial (byte-chopped journal, mid-sweep DB, or
+    #: apps still mid-flight at the end of the record) — the trace is
+    #: usable but truncated; ``notes`` names every reason
+    incomplete: bool = False
+    #: the workload was synthesized from telemetry windows (history-db /
+    #: series sources) — counterfactuals apply, the fidelity gate does not
+    approximate: bool = False
+    notes: list[str] = field(default_factory=list)
+
+    def summary(self) -> dict[str, Any]:
+        return {
+            "source": self.source,
+            "kind": self.kind,
+            "jobs": len(self.jobs),
+            "recorded_events": len(self.recorded),
+            "queues": dict(self.queues),
+            "totals": list(self.totals),
+            "knobs": dict(self.knobs),
+            "incomplete": self.incomplete,
+            "approximate": self.approximate,
+            "notes": list(self.notes),
+        }
+
+
+# ---------------------------------------------------------------------------
+# journal reconstruction
+# ---------------------------------------------------------------------------
+def _expand_snapshots(records: Iterable[dict]) -> Iterator[dict]:
+    """Flatten compaction snapshots exactly like the pool's replay fold:
+    a bare barrier marker, then the embedded records."""
+    for rec in records:
+        if rec.get("t") == SNAPSHOT_RECORD:
+            inner = rec.get("records")
+            if not isinstance(inner, list):
+                raise JournalError("snapshot record carries no records")
+            yield {"t": SNAPSHOT_RECORD}
+            for r in inner:
+                if not isinstance(r, dict):
+                    raise JournalError("snapshot embeds a non-record")
+                yield dict(r)
+        else:
+            yield rec
+
+
+@dataclass
+class _AppTimeline:
+    """Per-app fold state while streaming the journal."""
+
+    app_id: str
+    queue: str = ""
+    priority: int = 0
+    seq: int = 0
+    demand: tuple[int, int, int] = (0, 0, 0)       # elementwise max seen
+    elastic_unit: tuple[int, int, int] = (0, 0, 0)
+    elastic_slack: int = 0                          # max seen
+    admitted: bool = False
+    last_demand: tuple[int, int, int] = (0, 0, 0)
+    arrival_unix: float = 0.0
+    admit_unix: float = 0.0
+    run_s: float = 0.0
+    removed: bool = False
+
+
+def reconstruct_journal(path: str, *, default_work_s: float = DEFAULT_WORK_S) -> ReplayTrace:
+    """Rebuild the workload + recorded decision sequence from a pool
+    journal. Torn tails are dropped silently (journal discipline);
+    mid-file garbage truncates the trace and flags it ``incomplete``."""
+    trace = ReplayTrace(source=path, kind="journal")
+    apps: dict[str, _AppTimeline] = {}
+    order: list[str] = []                  # first-sighting order (FIFO seq)
+    last_unix = 0.0
+    knobs_seen = totals_seen = False
+    capacity_changed = False
+
+    def bump(unix: float) -> float:
+        nonlocal last_unix
+        if unix:
+            last_unix = max(last_unix, float(unix))
+        return float(unix or 0.0)
+
+    it = _expand_snapshots(iter_journal(path))
+    while True:
+        try:
+            rec = next(it)
+        except StopIteration:
+            break
+        except JournalError as e:
+            trace.incomplete = True
+            trace.notes.append(f"journal truncated mid-stream: {e}")
+            break
+        t = rec.get("t")
+        if t == SNAPSHOT_RECORD:
+            # compaction barrier: per-app history BEFORE it was folded away;
+            # the embedded rows that follow carry the surviving state
+            trace.notes.append(
+                "journal was compacted: pre-snapshot transitions are folded "
+                "(runtimes before the snapshot are not recoverable)")
+            continue
+        if t == "config":
+            q = rec.get("queues")
+            if isinstance(q, dict) and q:
+                trace.queues = {str(k): float(v) for k, v in q.items()}
+            for k in ("grace_ms", "drain_ms", "min_runtime_ms",
+                      "budget", "budget_window_ms"):
+                if rec.get(k) is not None:
+                    trace.knobs[k] = int(rec[k])
+            if rec.get("preemption") is not None:
+                trace.knobs["preemption"] = bool(rec["preemption"])
+            knobs_seen = True
+            bump(rec.get("unix") or 0.0)
+        elif t == "capacity":
+            tot = rec.get("totals")
+            if isinstance(tot, list) and len(tot) == 3:
+                new = tuple(int(x) for x in tot)
+                if totals_seen and new != trace.totals:
+                    capacity_changed = True
+                # replay runs under ONE capacity: keep the elementwise max
+                trace.totals = tuple(
+                    max(a, b) for a, b in zip(trace.totals, new))  # type: ignore[assignment]
+                totals_seen = True
+            bump(rec.get("unix") or 0.0)
+        elif t == "app":
+            app_id = str(rec["app_id"])
+            wait_unix = bump(rec.get("wait_unix") or 0.0)
+            admitted_unix = bump(rec.get("admitted_unix") or 0.0)
+            demand = (int(rec.get("demand_memory", 0)),
+                      int(rec.get("demand_vcores", 0)),
+                      int(rec.get("demand_chips", 0)))
+            st = apps.get(app_id)
+            if st is None:
+                st = apps[app_id] = _AppTimeline(
+                    app_id=app_id, arrival_unix=wait_unix or last_unix)
+                order.append(app_id)
+            st.queue = str(rec.get("queue", st.queue))
+            st.priority = int(rec.get("priority", st.priority))
+            st.seq = int(rec.get("seq", st.seq))
+            st.demand = tuple(
+                max(a, b) for a, b in zip(st.demand, demand))  # type: ignore[assignment]
+            unit = rec.get("elastic_unit")
+            if unit:
+                st.elastic_unit = tuple(int(x) for x in unit)  # type: ignore[assignment]
+            st.elastic_slack = max(st.elastic_slack, int(rec.get("elastic_slack", 0)))
+            admitted = bool(rec.get("admitted"))
+            if admitted and not st.admitted:
+                trace.recorded.append(RecordedEvent(
+                    "admit", app_id, unix=admitted_unix or last_unix))
+                st.admit_unix = admitted_unix or last_unix
+            elif st.admitted and not admitted:
+                end = wait_unix or last_unix
+                st.run_s += max(end - st.admit_unix, 0.0)
+                if bool(rec.get("preempted")):
+                    trace.recorded.append(RecordedEvent("evict", app_id, unix=end))
+                else:
+                    trace.notes.append(
+                        f"{app_id}: admitted→waiting without preemption flag "
+                        "(unexpected transition; treated as a requeue)")
+            elif admitted and st.admitted and any(st.elastic_unit) \
+                    and any(d > l for d, l in zip(demand, st.last_demand)):
+                # an elastic grow landed (grow-back resize): scripted — the
+                # scheduler under test did not decide it
+                grown = (demand[0] - st.last_demand[0])
+                unit_p = st.elastic_unit[0] or 1
+                trace.scripted.append(ScriptedAction(
+                    at_s=last_unix, kind="grow", app_id=app_id,
+                    workers=max(grown // unit_p, 1), demand=demand))
+            st.admitted = admitted
+            st.last_demand = demand
+        elif t == "app_removed":
+            app_id = str(rec["app_id"])
+            end = bump(rec.get("unix") or 0.0) or last_unix
+            st = apps.get(app_id)
+            if st is not None:
+                if st.admitted:
+                    st.run_s += max(end - st.admit_unix, 0.0)
+                    st.admitted = False
+                st.removed = True
+        elif t == "drain":
+            mode = str(rec.get("mode", "drain"))
+            t0 = bump(rec.get("t0_unix") or 0.0)   # deadlines are future: never bump those
+            if mode == "shrink":
+                app_id = str(rec["app_id"])
+                origin = str(rec.get("origin", "sched"))
+                ev = RecordedEvent(
+                    "shrink", app_id, unix=t0 or last_unix,
+                    workers=int(rec.get("workers", 0)),
+                    for_app=str(rec.get("for_app", "")), origin=origin)
+                trace.recorded.append(ev)
+                if origin == "demand":
+                    trace.scripted.append(ScriptedAction(
+                        at_s=ev.unix, kind="shrink", app_id=app_id,
+                        workers=ev.workers, for_app=ev.for_app))
+        elif t == "demand":
+            bump(rec.get("unix") or 0.0)
+        elif t == "growback":
+            bump(rec.get("since_unix") or 0.0)
+        elif t in ("drain_done", "container", "seen", "kill_requested",
+                   "exited", "released", "polled"):
+            pass                           # container-level records: no workload signal
+        else:
+            # an unknown record type would RAISE in the pool's own recovery;
+            # reconstruction degrades instead — note it and keep folding
+            trace.notes.append(f"unknown journal record type {t!r} skipped")
+
+    if not apps:
+        raise ReplayError(
+            f"{path}: no app records survive in this journal — nothing to replay")
+
+    # ---- fold the timelines into SimJobs
+    t0 = min((st.arrival_unix or last_unix) for st in apps.values())
+    trace.t0_unix = t0
+    finished = [st.run_s for st in apps.values() if st.removed and st.run_s > 0]
+    fallback = _percentile(finished, 50.0) if finished else default_work_s
+    open_ended: list[str] = []
+    for app_id in order:
+        st = apps[app_id]
+        work = st.run_s
+        if st.admitted and not st.removed:
+            work += max(last_unix - st.admit_unix, 0.0)
+            open_ended.append(app_id)
+        if not st.removed and not st.admitted:
+            open_ended.append(app_id)
+        if work <= 0:
+            work = fallback      # recorded waiting-only: give the replay something to run
+        trace.jobs.append(SimJob(
+            app_id=app_id,
+            queue=st.queue,
+            arrival_s=round(max((st.arrival_unix or t0) - t0, 0.0), 3),
+            work_s=round(max(work, 0.5), 3),
+            demand=st.demand,
+            priority=st.priority,
+            cooperative=True,
+            elastic_unit=st.elastic_unit,
+            elastic_slack=st.elastic_slack,
+        ))
+    trace.jobs.sort(key=lambda j: (j.arrival_s, apps[j.app_id].seq))
+    for s in trace.scripted:
+        s.at_s = round(max(s.at_s - t0, 0.0), 3)
+    for e in trace.recorded:
+        e.unix = round(e.unix, 3)
+    if open_ended:
+        trace.incomplete = True
+        trace.notes.append(
+            f"{len(open_ended)} app(s) still mid-flight when the record ends "
+            f"(journal truncated or pool still running): {sorted(open_ended)[:5]}")
+    if not trace.queues:
+        qs = sorted({st.queue for st in apps.values() if st.queue})
+        share = round(1.0 / max(len(qs), 1), 6)
+        trace.queues = {q: share for q in qs} or {"default": 1.0}
+        trace.notes.append(
+            "no config record in this journal (pre-upgrade pool): queue "
+            "shares inferred EQUAL — override with --override share.<q>=...")
+    if not knobs_seen:
+        trace.notes.append(
+            "no config record in this journal: preemption knobs default to "
+            f"{DEFAULT_KNOBS} — override per knob if the pool ran others")
+    if not totals_seen:
+        trace.totals = _peak_concurrent_demand(trace)
+        trace.notes.append(
+            "no capacity record in this journal: pool totals inferred from "
+            "peak concurrent admitted demand — override with --override "
+            "memory-gb=/vcores=/chips=")
+    if capacity_changed:
+        trace.notes.append(
+            "pool capacity changed during the record (nodes joined/left): "
+            "the replay runs under the elementwise MAX capacity")
+    return trace
+
+
+def _peak_concurrent_demand(trace: ReplayTrace) -> Vec:
+    """Fallback totals: the peak admitted claim the recorded sequence ever
+    reached, per dimension (a lower bound on the real pool's size)."""
+    admitted: dict[str, Vec] = {}
+    demand_of = {j.app_id: j.demand for j in trace.jobs}
+    peak = [0, 0, 0]
+    for ev in trace.recorded:
+        if ev.action == "admit":
+            admitted[ev.app_id] = demand_of.get(ev.app_id, (0, 0, 0))
+        elif ev.action == "evict":
+            admitted.pop(ev.app_id, None)
+        for i in range(3):
+            peak[i] = max(peak[i], sum(d[i] for d in admitted.values()))
+    if peak[0] <= 0:
+        peak = [sum(d[0] for d in demand_of.values()) or GB,
+                sum(d[1] for d in demand_of.values()) or 1, 0]
+    return tuple(peak)  # type: ignore[return-value]
+
+
+# ---------------------------------------------------------------------------
+# telemetry-window reconstruction (history DB / series file) — approximate
+# ---------------------------------------------------------------------------
+def _windows_to_trace(
+    source: str, kind: str, windows: list[dict[str, Any]],
+    *, incomplete: bool = False, notes: list[str] | None = None,
+) -> ReplayTrace:
+    """Synthesize a workload from finalized per-queue telemetry windows
+    (recorder.py shape). Coarse by construction: each window contributes
+    its recorded ``admissions`` as jobs sized to its average occupancy and
+    running for one window — enough for directional what-ifs, never for
+    the fidelity gate."""
+    trace = ReplayTrace(source=source, kind=kind, approximate=True,
+                        incomplete=incomplete, notes=list(notes or []))
+    if not windows:
+        raise ReplayError(f"{source}: no cluster-series windows — nothing to replay")
+    windows = sorted(windows, key=lambda w: (int(w.get("window_start_ms") or 0),
+                                             str(w.get("queue", ""))))
+    t0_ms = int(windows[0].get("window_start_ms") or 0)
+    trace.t0_unix = t0_ms / 1000.0
+    share_cap: dict[str, float] = {}
+    counts: dict[str, int] = {}
+    starts: dict[str, set] = {}
+    for w in windows:
+        q = str(w.get("queue", "default"))
+        m = w.get("metrics") or {}
+        share_cap[q] = max(share_cap.get(q, 0.0), float(m.get("share_capacity", 0.0)))
+        counts[q] = counts.get(q, 0) + 1
+        starts.setdefault(q, set()).add(int(w.get("window_start_ms") or 0))
+        start_s = (int(w.get("window_start_ms") or 0) - t0_ms) / 1000.0
+        end_ms = int(w.get("window_end_ms") or 0)
+        win_s = max((end_ms - int(w.get("window_start_ms") or 0)) / 1000.0, 1.0)
+        n = int(m.get("admissions", 0) or 0)
+        if n <= 0:
+            continue
+        used = float(m.get("used_avg", 0.0) or m.get("used_max", 0.0))
+        per_job = max(int(used / n), 1)
+        for i in range(n):
+            trace.jobs.append(SimJob(
+                app_id=f"{q}-{int(start_s)}-{i:03d}",
+                queue=q,
+                arrival_s=round(start_s + i * (win_s / n), 3),
+                work_s=round(win_s, 3),
+                demand=(per_job, 1, 0),
+            ))
+    total_primary = sum(share_cap.values())
+    if total_primary <= 0:
+        total_primary = max(sum(j.demand[0] for j in trace.jobs), 1)
+        trace.notes.append(
+            "no share_capacity metric in the windows: totals set to the "
+            "synthesized demand sum")
+    trace.totals = (int(total_primary), max(len(trace.jobs), 256), 0)
+    trace.queues = {
+        q: round(max(c / total_primary, 1e-6), 6) for q, c in share_cap.items()
+    } if any(share_cap.values()) else {
+        q: round(1.0 / max(len(counts), 1), 6) for q in counts}
+    norm = sum(trace.queues.values())
+    if norm > 1.0:
+        trace.queues = {q: v / norm for q, v in trace.queues.items()}
+    # a mid-sweep DB / partially-flushed series file shows up as window
+    # coverage gaps between queues: flag, keep what survives
+    if len({frozenset(s) for s in starts.values()}) > 1:
+        trace.incomplete = True
+        trace.notes.append(
+            "window coverage differs across queues (mid-sweep ingest or "
+            "partial flush): trace truncated to what was recorded")
+    trace.notes.append(
+        "workload SYNTHESIZED from telemetry windows (approximate): the "
+        "fidelity gate does not apply to this source kind")
+    if not trace.jobs:
+        raise ReplayError(
+            f"{source}: windows carry no admissions — nothing to replay")
+    return trace
+
+
+def reconstruct_series(path: str) -> ReplayTrace:
+    """Cluster-series JSONL → approximate trace (torn lines skipped by
+    :func:`~tony_tpu.cluster.recorder.read_window_lines`)."""
+    return _windows_to_trace(path, "series", list(read_window_lines(path)))
+
+
+def reconstruct_history_db(path: str, *, source: str | None = None) -> ReplayTrace:
+    """History-store SQLite → approximate trace. A mid-sweep or locked DB
+    yields what was read before the fault, flagged ``incomplete``."""
+    import sqlite3
+
+    windows: dict[tuple[str, int], dict[str, Any]] = {}
+    incomplete = False
+    notes: list[str] = []
+    try:
+        db = sqlite3.connect(f"file:{path}?mode=ro", uri=True)
+        db.row_factory = sqlite3.Row
+    except sqlite3.Error as e:
+        raise ReplayError(f"{path}: cannot open history DB: {e}") from e
+    try:
+        q = ("SELECT source, queue, metric, window_start_ms, window_end_ms, value "
+             "FROM cluster_series")
+        params: list[Any] = []
+        if source:
+            q += " WHERE source = ?"
+            params.append(source)
+        q += " ORDER BY window_start_ms, queue"
+        try:
+            for r in db.execute(q, params):
+                key = (str(r["queue"]), int(r["window_start_ms"]))
+                w = windows.setdefault(key, {
+                    "queue": key[0], "window_start_ms": key[1],
+                    "window_end_ms": int(r["window_end_ms"] or 0), "metrics": {},
+                })
+                w["metrics"][str(r["metric"])] = float(r["value"])
+        except sqlite3.Error as e:
+            # mid-sweep / corrupt page: keep the rows already folded
+            incomplete = True
+            notes.append(f"history DB read truncated: {e}")
+    finally:
+        db.close()
+    if not windows:
+        raise ReplayError(
+            f"{path}: no cluster_series rows"
+            + (f" for source {source!r}" if source else "")
+            + " — nothing to replay (is the sweep ingesting this pool?)")
+    return _windows_to_trace(path, "history-db", list(windows.values()),
+                             incomplete=incomplete, notes=notes)
+
+
+def reconstruct(path: str, *, source: str | None = None,
+                default_work_s: float = DEFAULT_WORK_S) -> ReplayTrace:
+    """Sniff the source kind and reconstruct. Raises :class:`ReplayError`
+    (the CLI's exit-2 class) on unreadable/unusable input."""
+    if not os.path.isfile(path):
+        raise ReplayError(f"{path}: no such file")
+    try:
+        with open(path, "rb") as f:
+            head = f.read(64)
+    except OSError as e:
+        raise ReplayError(f"{path}: unreadable: {e}") from e
+    if head.startswith(b"SQLite format 3\x00"):
+        return reconstruct_history_db(path, source=source)
+    if not head.strip():
+        raise ReplayError(f"{path}: empty file — nothing to replay")
+    # JSONL: a pool journal line carries "t"; a series line carries
+    # "source" + "metrics". Sniff the first parseable line.
+    first: dict[str, Any] | None = None
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    first = rec
+                    break
+    except OSError as e:
+        raise ReplayError(f"{path}: unreadable: {e}") from e
+    if first is None:
+        raise ReplayError(f"{path}: no parseable JSONL line — not a journal, "
+                          "series file, or history DB")
+    if "t" in first:
+        return reconstruct_journal(path, default_work_s=default_work_s)
+    if "metrics" in first:
+        return reconstruct_series(path)
+    raise ReplayError(
+        f"{path}: JSONL lines are neither pool-journal records (no 't' "
+        "field) nor cluster-series windows (no 'metrics' field)")
+
+
+# ---------------------------------------------------------------------------
+# overrides
+# ---------------------------------------------------------------------------
+#: override keys ↔ the config keys the live pool reads (docs/configuration.md)
+OVERRIDE_KEYS = (
+    "share.<queue>", "memory-gb", "vcores", "chips", "preemption",
+    "grace-ms", "drain-ms", "min-runtime-ms", "budget", "budget-window-ms",
+)
+
+
+def parse_override(spec: str) -> tuple[str, float]:
+    """One ``key=value`` override. Raises :class:`ReplayError` on junk."""
+    if "=" not in spec:
+        raise ReplayError(f"override {spec!r}: expected key=value "
+                          f"(keys: {', '.join(OVERRIDE_KEYS)})")
+    key, _, raw = spec.partition("=")
+    key = key.strip()
+    try:
+        val = float(raw.strip())
+    except ValueError:
+        raise ReplayError(f"override {spec!r}: value {raw!r} is not a number") from None
+    base = key.split(".", 1)[0]
+    if base not in ("share", "memory-gb", "vcores", "chips", "preemption",
+                    "grace-ms", "drain-ms", "min-runtime-ms", "budget",
+                    "budget-window-ms"):
+        raise ReplayError(f"override key {key!r} unknown "
+                          f"(keys: {', '.join(OVERRIDE_KEYS)})")
+    if base == "share" and "." not in key:
+        raise ReplayError("share override needs a queue: share.<queue>=0.15")
+    return key, val
+
+
+@dataclass
+class ReplayConfig:
+    queues: dict[str, float]
+    totals: Vec
+    knobs: dict[str, Any]
+    notes: list[str] = field(default_factory=list)
+
+
+def apply_overrides(trace: ReplayTrace, overrides: dict[str, float]) -> ReplayConfig:
+    """The recorded config with ``overrides`` applied. A share override
+    that would oversubscribe renormalizes the OTHER queues proportionally
+    (noted loudly — silent rescaling would be a lie in the report)."""
+    queues = dict(trace.queues)
+    knobs = dict(trace.knobs)
+    totals = list(trace.totals)
+    notes: list[str] = []
+    for key, val in overrides.items():
+        if key.startswith("share."):
+            q = key.split(".", 1)[1]
+            if q not in queues:
+                raise ReplayError(
+                    f"override {key}: queue {q!r} not in the recorded config "
+                    f"(queues: {', '.join(sorted(queues))})")
+            if not 0.0 < val <= 1.0:
+                raise ReplayError(f"override {key}: share must be in (0, 1]")
+            queues[q] = val
+            others = {k: v for k, v in queues.items() if k != q}
+            spill = sum(others.values()) + val - 1.0
+            if spill > 1e-9 and others:
+                scale = (1.0 - val) / sum(others.values())
+                for k in others:
+                    queues[k] = round(queues[k] * scale, 6)
+                notes.append(
+                    f"share.{q}={val:g} oversubscribed the pool: other "
+                    f"queues rescaled proportionally to fit (sum == 1)")
+        elif key == "memory-gb":
+            totals[0] = int(val * GB)
+        elif key == "vcores":
+            totals[1] = int(val)
+        elif key == "chips":
+            totals[2] = int(val)
+        elif key == "preemption":
+            knobs["preemption"] = bool(int(val))
+        else:
+            knobs[key.replace("-", "_")] = int(val)
+    try:
+        validate_queue_shares(queues)
+    except ValueError as e:
+        raise ReplayError(f"overridden queue shares are invalid: {e}") from e
+    return ReplayConfig(queues=queues, totals=tuple(totals), knobs=knobs,  # type: ignore[arg-type]
+                        notes=notes)
+
+
+# ---------------------------------------------------------------------------
+# the replay simulator: PoolSimulator + scripted (recorded) transitions
+# ---------------------------------------------------------------------------
+class _ReplaySimulator(PoolSimulator):
+    """The event simulator plus a handler for recorded transitions the
+    policy under test did not decide: market-origin sheds and grow-backs
+    are applied verbatim at their recorded instants (guarded — in a
+    counterfactual the target may not be admitted; the action is skipped
+    and noted, never crashes the replay)."""
+
+    def __init__(self, *args, scripted: dict[str, deque] | None = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._scripted_q = scripted or {}
+        self.scripted_skipped: list[str] = []
+
+    def _on_scripted(self, app_id: str) -> None:
+        q = self._scripted_q.get(app_id)
+        if not q:
+            return
+        act: ScriptedAction = q.popleft()
+        st = self._jobs.get(app_id)
+        if st is None or st.done_at is not None or not st.view.admitted:
+            self.scripted_skipped.append(
+                f"{act.kind} of {app_id} at t={self.now:.1f}s skipped: "
+                "app not admitted at that instant in this replay")
+            return
+        v = st.view
+        if act.kind == "shrink":
+            workers = min(act.workers, v.elastic_slack)
+            if workers <= 0 or not any(v.elastic_unit):
+                self.scripted_skipped.append(
+                    f"shrink of {app_id} at t={self.now:.1f}s skipped: "
+                    "no elastic slack left in this replay")
+                return
+            v.demand = tuple(
+                max(d - workers * u, 0) for d, u in zip(v.demand, v.elastic_unit))  # type: ignore[assignment]
+            v.elastic_slack -= workers
+            v.shrink_pending = True
+            if self._world is not None:
+                self._world.note_shrunk(v)
+            if self.record_trace:
+                self.trace.append((
+                    self._event_no, "scripted", app_id, round(self.now, 6),
+                    (), (), ((app_id, workers, act.for_app),),
+                ))
+            self._push(self.now + self.shrink_rebuild_s, "shed", app_id)
+        elif act.kind == "grow":
+            if st.started_at is None:
+                self.scripted_skipped.append(
+                    f"grow of {app_id} at t={self.now:.1f}s skipped: not running")
+                return
+            st.remaining_s = max(st.remaining_s - (self.now - st.started_at), 0.0)
+            old = v.held
+            v.demand = tuple(max(d, n) for d, n in zip(v.demand, act.demand))  # type: ignore[assignment]
+            v.elastic_slack += act.workers
+            v.held = v.demand
+            if old[self._primary] > 0 and v.held[self._primary] > 0:
+                st.remaining_s *= old[self._primary] / v.held[self._primary]
+            if self._world is not None:
+                self._world.reaccount(v)
+            self._reschedule_completion(st)
+
+
+# ---------------------------------------------------------------------------
+# running a replay + its metrics
+# ---------------------------------------------------------------------------
+@dataclass
+class ReplayRun:
+    """One replay's outcome: the sim report, the flattened decision
+    sequence, and the counterfactual metrics the reports diff."""
+
+    report: Any                            # SimReport
+    events: list[RecordedEvent]
+    metrics: dict[str, Any]
+    config: ReplayConfig
+    recorder: Any = None                   # FlightRecorder | None
+    scripted_skipped: list[str] = field(default_factory=list)
+
+
+def _flatten_trace(entries: list[tuple]) -> list[RecordedEvent]:
+    """Sim decision trace → the journal's application order: shrinks,
+    evictions, then admits, per decision."""
+    out: list[RecordedEvent] = []
+    for (_no, _kind, _app, t, admits, evicts, shrinks) in entries:
+        for (a, w, fa) in shrinks:
+            out.append(RecordedEvent("shrink", a, unix=t, workers=w, for_app=fa))
+        for (a, _fa) in evicts:
+            out.append(RecordedEvent("evict", a, unix=t))
+        for a in admits:
+            out.append(RecordedEvent("admit", a, unix=t))
+    return out
+
+
+def _run_metrics(sim: PoolSimulator, trace: ReplayTrace) -> dict[str, Any]:
+    rep = sim.report
+    waits: dict[str, list[float]] = {q: [] for q in sim.queues}
+    for st in sim._jobs.values():
+        if not st.arrived:
+            continue
+        w = st.waited_total_s
+        if st.wait_started is not None and st.done_at is None:
+            w += max(sim.now - st.wait_started, 0.0)   # still waiting at horizon
+        waits.setdefault(st.view.queue, []).append(w)
+    queue_wait = {
+        q: {
+            "jobs": len(v),
+            "wait_p50_s": round(_percentile(v, 50.0), 3) if v else 0.0,
+            "wait_p99_s": round(_percentile(v, 99.0), 3) if v else 0.0,
+            "wait_mean_s": round(sum(v) / len(v), 3) if v else 0.0,
+        }
+        for q, v in sorted(waits.items())
+    }
+    goodput_s = round(sum(
+        st.job.work_s if st.done_at is not None
+        else max(st.job.work_s - st.remaining_s, 0.0)
+        for st in sim._jobs.values()), 3)
+    return {
+        "jobs": rep.jobs,
+        "completed": rep.completed,
+        "wall_s": round(rep.wall_s, 3),
+        "utilization": rep.utilization,
+        "queue_wait": queue_wait,
+        "preemptions": {
+            "evictions": rep.evictions,
+            "evictions_cooperative": rep.evictions_cooperative,
+            "evictions_killed": rep.evictions_killed,
+            "shrinks": rep.shrinks,
+        },
+        "goodput_s": goodput_s,
+        "badput_s": rep.total_rework_s,
+        "violations": len(rep.violations),
+    }
+
+
+def replay(
+    trace: ReplayTrace,
+    overrides: dict[str, float] | None = None,
+    *,
+    record_decisions: bool = False,
+    horizon_s: float = 10_000_000.0,
+    coop_yield_s: float = 1.0,
+    shrink_rebuild_s: float = 2.0,
+) -> ReplayRun:
+    """Replay the reconstructed workload under the recorded config with
+    ``overrides`` applied (empty → the fidelity baseline)."""
+    cfg = apply_overrides(trace, overrides or {})
+    scripted: dict[str, deque] = {}
+    for act in sorted(trace.scripted, key=lambda a: a.at_s):
+        scripted.setdefault(act.app_id, deque()).append(act)
+    sim = _ReplaySimulator(
+        cfg.queues, cfg.totals,
+        preemption=bool(cfg.knobs.get("preemption", True)),
+        grace_ms=int(cfg.knobs.get("grace_ms", 0)),
+        drain_ms=int(cfg.knobs.get("drain_ms", 5_000)),
+        min_runtime_ms=int(cfg.knobs.get("min_runtime_ms", 0)),
+        eviction_budget=int(cfg.knobs.get("budget", 0)),
+        budget_window_ms=int(cfg.knobs.get("budget_window_ms", 60_000)),
+        coop_yield_s=coop_yield_s,
+        shrink_rebuild_s=shrink_rebuild_s,
+        record_trace=True,
+        record_decisions=record_decisions,
+        scripted=scripted,
+    )
+    for act in sorted(trace.scripted, key=lambda a: a.at_s):
+        sim._push(act.at_s, "scripted", act.app_id)
+    report = sim.run([SimJob(**dict(j.__dict__)) for j in trace.jobs],
+                     horizon_s=horizon_s)
+    return ReplayRun(
+        report=report,
+        events=_flatten_trace(sim.trace),
+        metrics=_run_metrics(sim, trace),
+        config=cfg,
+        recorder=sim.recorder,
+        scripted_skipped=list(sim.scripted_skipped),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the fidelity gate
+# ---------------------------------------------------------------------------
+@dataclass
+class FidelityResult:
+    ok: bool
+    applicable: bool = True
+    divergence_index: int = -1
+    recorded_len: int = 0
+    replayed_len: int = 0
+    detail: str = ""
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self.__dict__)
+
+
+def check_fidelity(trace: ReplayTrace, run: ReplayRun) -> FidelityResult:
+    """Does the no-override replay reproduce the recorded admit/evict/
+    shrink sequence EXACTLY? Divergence is reported loudly with the first
+    divergent decision and — when the run carried a flight recorder — the
+    replay's causal chain for the app involved (``pool_explain`` style)."""
+    if trace.approximate:
+        return FidelityResult(
+            ok=True, applicable=False,
+            detail="fidelity gate not applicable: workload synthesized from "
+                   "telemetry windows (journal sources gate; series/db do not)")
+    rec, rep = trace.recorded, run.events
+    res = FidelityResult(ok=True, recorded_len=len(rec), replayed_len=len(rep))
+    for i, (a, b) in enumerate(zip(rec, rep)):
+        if a.key() != b.key():
+            res.ok = False
+            res.divergence_index = i
+            res.detail = (
+                f"decision #{i} diverges:\n"
+                f"  recorded: {a.action} {a.app_id}"
+                + (f" workers={a.workers} for={a.for_app}" if a.action == "shrink" else "")
+                + f" (wall +{max(a.unix - trace.t0_unix, 0):.1f}s)\n"
+                f"  replayed: {b.action} {b.app_id}"
+                + (f" workers={b.workers} for={b.for_app}" if b.action == "shrink" else "")
+                + f" (virtual t={b.unix:.1f}s)"
+                + _explain_suffix(run, a.app_id))
+            return res
+    if len(rec) != len(rep):
+        res.ok = False
+        res.divergence_index = min(len(rec), len(rep))
+        longer, name = (rec, "recorded") if len(rec) > len(rep) else (rep, "replayed")
+        e = longer[res.divergence_index]
+        res.detail = (
+            f"sequence lengths differ (recorded={len(rec)} replayed={len(rep)}): "
+            f"{name} additionally decided {e.action} {e.app_id}"
+            + _explain_suffix(run, e.app_id))
+    return res
+
+
+def _explain_suffix(run: ReplayRun, app_id: str) -> str:
+    if run.recorder is None:
+        return ""
+    chain = run.recorder.explain(app_id)
+    if not chain:
+        return f"\n  replay chain for {app_id}: (no decision records)"
+    lines = [
+        f"    t={r.unix_ms / 1000:.1f}s {r.action} rule={r.rule}"
+        + (f" for={r.for_app}" if r.for_app else "")
+        + (f" n={r.count}" if r.count > 1 else "")
+        for r in chain[-8:]
+    ]
+    return f"\n  replay chain for {app_id} (oldest first):\n" + "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# counterfactual + sweep reports
+# ---------------------------------------------------------------------------
+def diff_metrics(base: dict[str, Any], variant: dict[str, Any]) -> dict[str, Any]:
+    """Per-queue wait deltas + preemption/goodput deltas, variant − base."""
+    queues = sorted(set(base["queue_wait"]) | set(variant["queue_wait"]))
+    zero = {"jobs": 0, "wait_p50_s": 0.0, "wait_p99_s": 0.0, "wait_mean_s": 0.0}
+    qd = {}
+    for q in queues:
+        b = base["queue_wait"].get(q, zero)
+        v = variant["queue_wait"].get(q, zero)
+        qd[q] = {
+            "wait_p50_s_delta": round(v["wait_p50_s"] - b["wait_p50_s"], 3),
+            "wait_p99_s_delta": round(v["wait_p99_s"] - b["wait_p99_s"], 3),
+            "wait_mean_s_delta": round(v["wait_mean_s"] - b["wait_mean_s"], 3),
+        }
+    return {
+        "queue_wait": qd,
+        "preemptions": {
+            k: variant["preemptions"][k] - base["preemptions"][k]
+            for k in base["preemptions"]
+        },
+        "goodput_s_delta": round(variant["goodput_s"] - base["goodput_s"], 3),
+        "badput_s_delta": round(variant["badput_s"] - base["badput_s"], 3),
+        "completed_delta": variant["completed"] - base["completed"],
+    }
+
+
+def parse_sweep(spec: str) -> tuple[str, list[float]]:
+    """``key=lo:hi:step`` → (key, [values]). Inclusive of ``hi`` within a
+    half-step tolerance (float grids must not drop their last point)."""
+    if "=" not in spec:
+        raise ReplayError(f"sweep {spec!r}: expected key=lo:hi:step")
+    key, _, rng = spec.partition("=")
+    parts = rng.split(":")
+    if len(parts) != 3:
+        raise ReplayError(f"sweep {spec!r}: expected key=lo:hi:step")
+    try:
+        lo, hi, step = (float(p) for p in parts)
+    except ValueError:
+        raise ReplayError(f"sweep {spec!r}: lo/hi/step must be numbers") from None
+    if step <= 0 or hi < lo:
+        raise ReplayError(f"sweep {spec!r}: need step > 0 and hi >= lo")
+    if (hi - lo) / step > 64:
+        raise ReplayError(f"sweep {spec!r}: more than 64 grid points — "
+                          "that is a benchmark, not a what-if")
+    parse_override(f"{key}={lo}")          # validate the key shape up front
+    vals, v = [], lo
+    while v <= hi + step / 2:
+        vals.append(round(v, 9))
+        v += step
+    return key.strip(), vals
+
+
+def run_whatif(
+    trace: ReplayTrace,
+    overrides: dict[str, float] | None = None,
+    sweep: tuple[str, list[float]] | None = None,
+    *,
+    record_decisions: bool = True,
+    horizon_s: float = 10_000_000.0,
+    coop_yield_s: float = 1.0,
+    shrink_rebuild_s: float = 2.0,
+) -> dict[str, Any]:
+    """Baseline + counterfactual(s) + fidelity, as one report dict (the
+    CLI renders it as text or ``--json``; the portal charts it)."""
+    sim_kw = dict(horizon_s=horizon_s, coop_yield_s=coop_yield_s,
+                  shrink_rebuild_s=shrink_rebuild_s)
+    baseline = replay(trace, record_decisions=record_decisions, **sim_kw)
+    fid = check_fidelity(trace, baseline)
+    out: dict[str, Any] = {
+        "trace": trace.summary(),
+        "baseline": baseline.metrics,
+        "fidelity": fid.to_dict(),
+    }
+    outcome = "fidelity-ok" if fid.ok else "divergence"
+    if baseline.recorder is not None:
+        out["baseline_decisions"] = [
+            r.to_dict() for r in baseline.recorder.tail(40)]
+    if overrides:
+        var = replay(trace, overrides, record_decisions=record_decisions, **sim_kw)
+        out["overrides"] = dict(overrides)
+        out["variant"] = var.metrics
+        out["delta"] = diff_metrics(baseline.metrics, var.metrics)
+        out["config_notes"] = var.config.notes
+        if var.recorder is not None:
+            # the decision records that EXPLAIN the delta — the same
+            # vocabulary `tony explain` serves, rendered by /pool/whatif
+            out["variant_decisions"] = [r.to_dict() for r in var.recorder.tail(40)]
+        if var.scripted_skipped:
+            out["scripted_skipped"] = var.scripted_skipped
+        outcome = "counterfactual"
+    if sweep:
+        key, vals = sweep
+        rows = []
+        for v in vals:
+            merged = dict(overrides or {})
+            merged[key] = v
+            r = replay(trace, merged, **sim_kw)
+            rows.append({
+                "value": v,
+                "metrics": r.metrics,
+                "delta": diff_metrics(baseline.metrics, r.metrics),
+            })
+        out["sweep"] = {"key": key, "rows": rows}
+        outcome = "counterfactual"
+    _REPLAY_RUNS.inc(outcome=outcome)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+def _fmt_queue_waits(metrics: dict[str, Any], indent: str = "  ") -> list[str]:
+    return [
+        f"{indent}{q}: {m['jobs']} job(s), wait p50 {m['wait_p50_s']:.1f}s "
+        f"p99 {m['wait_p99_s']:.1f}s mean {m['wait_mean_s']:.1f}s"
+        for q, m in metrics["queue_wait"].items()
+    ]
+
+
+def render_whatif(report: dict[str, Any], as_json: bool = False) -> str:
+    if as_json:
+        return json.dumps(report, indent=1, sort_keys=True)
+    tr = report["trace"]
+    lines = [
+        f"replay of {tr['source']} ({tr['kind']}): {tr['jobs']} job(s), "
+        f"{tr['recorded_events']} recorded decision(s)"
+        + (" [INCOMPLETE input]" if tr["incomplete"] else "")
+        + (" [approximate]" if tr["approximate"] else ""),
+        f"  recorded config: queues {tr['queues']}, "
+        f"totals {tr['totals'][0] / GB:.1f} GiB / {tr['totals'][1]} vc / "
+        f"{tr['totals'][2]} chips, knobs {tr['knobs']}",
+    ]
+    for n in tr["notes"]:
+        lines.append(f"  note: {n}")
+    fid = report["fidelity"]
+    if not fid["applicable"]:
+        lines.append(f"  fidelity: n/a — {fid['detail']}")
+    elif fid["ok"]:
+        lines.append(
+            f"  fidelity: OK — replay reproduced all "
+            f"{fid['recorded_len']} recorded decision(s) exactly")
+    else:
+        lines.append("  fidelity: DIVERGED — the replay does NOT reproduce "
+                     "the recorded sequence:")
+        lines.extend("    " + ln for ln in fid["detail"].splitlines())
+    base = report["baseline"]
+    lines.append(
+        f"  baseline: {base['completed']}/{base['jobs']} completed over "
+        f"{base['wall_s']:.0f}s, util {base['utilization']:.1%}, "
+        f"{base['preemptions']['evictions']} eviction(s) "
+        f"{base['preemptions']['shrinks']} shrink(s), "
+        f"goodput {base['goodput_s']:.0f}s badput {base['badput_s']:.0f}s")
+    lines.extend(_fmt_queue_waits(base, "    "))
+    if "variant" in report:
+        var, d = report["variant"], report["delta"]
+        lines.append(f"  counterfactual under {report['overrides']}:")
+        for n in report.get("config_notes", []):
+            lines.append(f"    note: {n}")
+        lines.append(
+            f"    {var['completed']}/{var['jobs']} completed over "
+            f"{var['wall_s']:.0f}s, util {var['utilization']:.1%}, "
+            f"evictions {var['preemptions']['evictions']:+d} delta "
+            f"{d['preemptions']['evictions']:+d}, "
+            f"goodput delta {d['goodput_s_delta']:+.0f}s "
+            f"badput delta {d['badput_s_delta']:+.0f}s")
+        lines.extend(_fmt_queue_waits(var, "    "))
+        for q, qd in d["queue_wait"].items():
+            lines.append(
+                f"    Δ {q}: wait p50 {qd['wait_p50_s_delta']:+.1f}s "
+                f"p99 {qd['wait_p99_s_delta']:+.1f}s "
+                f"mean {qd['wait_mean_s_delta']:+.1f}s")
+        for s in report.get("scripted_skipped", []):
+            lines.append(f"    note: {s}")
+    if "sweep" in report:
+        sw = report["sweep"]
+        lines.append(f"  sweep over {sw['key']}:")
+        header = f"    {'value':>10} | {'evict':>5} {'shrink':>6} | " + " | ".join(
+            f"{q} p50Δ/p99Δ" for q in base["queue_wait"])
+        lines.append(header)
+        for row in sw["rows"]:
+            m, d = row["metrics"], row["delta"]
+            cells = " | ".join(
+                f"{d['queue_wait'][q]['wait_p50_s_delta']:+8.1f}/"
+                f"{d['queue_wait'][q]['wait_p99_s_delta']:+6.1f}"
+                for q in base["queue_wait"])
+            lines.append(
+                f"    {row['value']:>10g} | {m['preemptions']['evictions']:>5} "
+                f"{m['preemptions']['shrinks']:>6} | {cells}")
+    return "\n".join(lines)

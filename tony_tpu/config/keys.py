@@ -381,6 +381,13 @@ CBENCH_HISTORY_JOBS = "tony.cbench.history-jobs"    # finalized fixture jobs the
 CBENCH_PORTAL_AMS = "tony.cbench.portal-ams"        # registered AMs the portal scrapes
 CBENCH_SEED = "tony.cbench.seed"                    # every benchmark draw is seeded from this
 
+# ---- tony sim --from-history (cluster/replay.py, docs/scheduling.md
+# "What-if capacity planning"): trace-driven replay of recorded history
+SIM_REPLAY_DEFAULT_WORK_S = "tony.sim.replay.default-work-s"    # work for apps recorded waiting-only
+SIM_REPLAY_HORIZON_S = "tony.sim.replay.horizon-s"              # virtual-seconds cap per replay
+SIM_REPLAY_COOP_YIELD_S = "tony.sim.replay.coop-yield-s"        # cooperative victim yield latency
+SIM_REPLAY_SHRINK_REBUILD_S = "tony.sim.replay.shrink-rebuild-s"  # elastic shed/rebuild latency
+
 # ---------------------------------------------------------------------------
 # tony.profile.* — ON-DEMAND profiler capture (docs/observability.md)
 # ---------------------------------------------------------------------------
@@ -670,6 +677,10 @@ DEFAULTS: dict[str, str] = {
     CBENCH_HISTORY_JOBS: "10000",
     CBENCH_PORTAL_AMS: "500",
     CBENCH_SEED: "0",
+    SIM_REPLAY_DEFAULT_WORK_S: "30",
+    SIM_REPLAY_HORIZON_S: "10000000",
+    SIM_REPLAY_COOP_YIELD_S: "1.0",
+    SIM_REPLAY_SHRINK_REBUILD_S: "2.0",
 
     PROFILE_STEPS: "5",
     PROFILE_MEMORY: "false",

@@ -348,6 +348,34 @@ class HistoryStore:
         out = [dict(r) for r in rows]
         return out[-limit:] if limit else out
 
+    def cluster_trace(self, source: str | None = None) -> list[dict[str, Any]]:
+        """Export cluster telemetry as reconstructed WINDOWS (the
+        recorder.py shape ``{queue, window_start_ms, window_end_ms,
+        metrics: {...}}``, oldest first) — the trace-replay feed:
+        ``tony sim --from-history`` and the portal what-if page rebuild a
+        synthetic workload from exactly this (cluster/replay.py,
+        docs/scheduling.md "What-if capacity planning")."""
+        q = ("SELECT source, queue, metric, window_start_ms, window_end_ms, "
+             "value FROM cluster_series")
+        params: list[Any] = []
+        if source is not None:
+            q += " WHERE source = ?"
+            params.append(source)
+        q += " ORDER BY window_start_ms, queue"
+        with self._lock:
+            rows = self._db.execute(q, params).fetchall()
+        windows: dict[tuple[str, str, int], dict[str, Any]] = {}
+        for r in rows:
+            key = (r["source"], r["queue"], int(r["window_start_ms"]))
+            w = windows.setdefault(key, {
+                "source": r["source"], "queue": r["queue"],
+                "window_start_ms": int(r["window_start_ms"]),
+                "window_end_ms": int(r["window_end_ms"] or 0),
+                "metrics": {},
+            })
+            w["metrics"][str(r["metric"])] = float(r["value"])
+        return list(windows.values())
+
     def cluster_queues(self) -> list[tuple[str, str]]:
         """Distinct (source, queue) pairs with any telemetry windows."""
         with self._lock:

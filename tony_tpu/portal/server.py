@@ -48,6 +48,11 @@ _SCRAPE_AGE = obs_metrics.gauge(
     "age of the served scrape result per app when the O(changed) scrape "
     "cache answered (tony.portal.scrape-ttl-ms); 0 = freshly scraped",
     labelnames=("app",))
+_WHATIF_REQUESTS = obs_metrics.counter(
+    "tony_whatif_requests_total",
+    "/pool/whatif replays served, by outcome: ok (report rendered), "
+    "error (unusable input or bad overrides — the page explains why)",
+    labelnames=("outcome",))
 
 _STYLE = """
 body{font-family:system-ui,sans-serif;margin:2em;color:#222}
@@ -134,6 +139,7 @@ class PortalHandler(BaseHTTPRequestHandler):
     history_root = ""
     staging_root = ""       # where <app_id>/am_info.json lives (TONY_ROOT)
     pool_addr = ""          # "host:port" of a pool service, optional
+    pool_journal = ""       # pool journal path for /pool/whatif replays, optional
     history_db = ""         # history-server store; "" → <history_root>/history.sqlite
     # O(changed) scrape cache (tony.portal.scrape-ttl-ms, performance.md
     # "Control-plane scalability"): 0 → scrape every AM on every /metrics.
@@ -142,6 +148,10 @@ class PortalHandler(BaseHTTPRequestHandler):
     scrape_ttl_ms = 0
     scrape_cache: "dict | None" = None
     scrape_lock = None
+    # /pool/whatif trace cache: reconstruction streams the whole journal, so
+    # one (path, mtime) → ReplayTrace entry is kept per portal instance
+    whatif_cache: "dict | None" = None
+    whatif_lock = None
 
     def log_message(self, *args) -> None:  # quiet
         pass
@@ -167,6 +177,11 @@ class PortalHandler(BaseHTTPRequestHandler):
                 )
             elif path == "/pool":
                 self._send(self._pool_page())
+            elif path == "/pool/whatif":
+                self._send(self._whatif_page())
+            elif path == "/api/pool/whatif":
+                self._send(json.dumps(self._whatif_report()).encode(),
+                           ctype="application/json")
             elif path == "/alerts":
                 self._send(self._alerts_page())
             elif path == "/slo":
@@ -1048,6 +1063,206 @@ class PortalHandler(BaseHTTPRequestHandler):
                 )
         return _page(f"pool {self.pool_addr}", body)
 
+    # -- /pool/whatif: trace-driven capacity planning -----------------------
+    # (docs/scheduling.md "What-if capacity planning"): reconstruct the pool
+    # journal into a workload, replay it server-side through the live policy
+    # under the overrides picked in the form, and render baseline-vs-
+    # counterfactual overlays with the decision records that explain them.
+
+    def _whatif_trace(self):
+        """Reconstruct (or serve the cached) ReplayTrace for the configured
+        journal. Cache key is (path, mtime): a journal the pool appended to
+        since the last request is re-read."""
+        from tony_tpu.cluster.replay import reconstruct
+
+        path = self.pool_journal
+        key = (path, os.path.getmtime(path))
+        lock = self.whatif_lock
+        if lock is not None:
+            with lock:
+                cache = self.whatif_cache
+                if cache is not None and cache.get("key") == key:
+                    return cache["trace"]
+        trace = reconstruct(path)
+        if lock is not None:
+            with lock:
+                if self.whatif_cache is not None:
+                    self.whatif_cache.clear()
+                    self.whatif_cache.update({"key": key, "trace": trace})
+        return trace
+
+    def _whatif_report(self) -> dict:
+        """The whatif replay as JSON (the page's data source and the
+        machine-readable sibling of `tony sim --from-history --json`)."""
+        from urllib.parse import parse_qs
+
+        from tony_tpu.cluster.replay import (
+            ReplayError,
+            parse_override,
+            parse_sweep,
+            run_whatif,
+        )
+
+        if not self.pool_journal:
+            _WHATIF_REQUESTS.inc(outcome="error")
+            return {"error": "no --pool-journal configured on this portal "
+                             "(point it at tony.pool.journal.file)"}
+        qs = parse_qs(urlparse(self.path).query)
+        try:
+            overrides: dict[str, float] = {}
+            for spec in qs.get("override", []):
+                for part in spec.split(","):
+                    if part.strip():
+                        k, v = parse_override(part.strip())
+                        overrides[k] = v
+            sweep_spec = qs.get("sweep", [""])[0].strip()
+            sweep = parse_sweep(sweep_spec) if sweep_spec else None
+            report = run_whatif(self._whatif_trace(), overrides or None, sweep)
+        except (ReplayError, OSError) as e:
+            _WHATIF_REQUESTS.inc(outcome="error")
+            return {"error": str(e)}
+        _WHATIF_REQUESTS.inc(outcome="ok")
+        return report
+
+    @staticmethod
+    def _whatif_bars(base_v: float, var_v: float | None, scale: float) -> str:
+        """Baseline-vs-counterfactual overlay: two inline bars on a shared
+        scale (SVG-free — the numbers matter more than the chrome)."""
+        width = max(scale, 1e-9)
+
+        def bar(v: float, color: str, label: str) -> str:
+            w = max(int(180 * v / width), 1)
+            return (f"<div style='background:{color};width:{w}px;height:10px;"
+                    f"display:inline-block'></div> {v:.1f}s <small>{label}</small>")
+
+        out = bar(base_v, "#8ab", "baseline")
+        if var_v is not None:
+            out += "<br>" + bar(var_v, "#e90" if var_v > base_v else "#3a5",
+                                "counterfactual")
+        return out
+
+    def _whatif_page(self) -> bytes:
+        report = self._whatif_report()
+        qs_raw = urlparse(self.path).query
+        form = (
+            "<form method='get' action='/pool/whatif'>"
+            "overrides <input name='override' size='40' "
+            "placeholder='share.dev=0.15,drain-ms=10000'> "
+            "sweep <input name='sweep' size='24' "
+            "placeholder='share.dev=0.1:0.5:0.1'> "
+            "<button>replay</button></form>"
+            "<p><small>keys: share.&lt;queue&gt;, drain-ms, grace-ms, "
+            "min-runtime-ms, budget, budget-window-ms, memory-gb, vcores, "
+            "chips, preemption — replayed against the recorded journal "
+            f"(<a href='/api/pool/whatif?{html.escape(qs_raw)}'>json</a>)"
+            "</small></p>")
+        if "error" in report:
+            return _page("pool what-if",
+                         form + f"<p><b>replay failed:</b> "
+                                f"{html.escape(report['error'])}</p>")
+        tr = report["trace"]
+        fid = report["fidelity"]
+        body = form
+        body += (
+            f"<h3>recorded trace</h3><p>{tr['jobs']} job(s), "
+            f"{tr['recorded_events']} recorded decision(s) from "
+            f"<code>{html.escape(tr['source'])}</code> ({tr['kind']})"
+            + (" — <b>INCOMPLETE input</b>" if tr["incomplete"] else "")
+            + (" — approximate" if tr["approximate"] else "") + "<br>"
+            f"queues {html.escape(json.dumps(tr['queues']))}, knobs "
+            f"{html.escape(json.dumps(tr['knobs']))}</p>")
+        for n in tr["notes"]:
+            body += f"<p><small>note: {html.escape(n)}</small></p>"
+        if not fid["applicable"]:
+            body += f"<p>fidelity: n/a — {html.escape(fid['detail'])}</p>"
+        elif fid["ok"]:
+            body += (f"<p>fidelity: <b style='color:#080'>OK</b> — replay "
+                     f"reproduced all {fid['recorded_len']} recorded "
+                     f"decision(s) exactly</p>")
+        else:
+            body += ("<p>fidelity: <b style='color:#b00'>DIVERGED</b></p>"
+                     f"<pre>{html.escape(fid['detail'])}</pre>")
+        base = report["baseline"]
+        var = report.get("variant")
+        delta = report.get("delta")
+        scale = max(
+            [m["wait_p99_s"] for m in base["queue_wait"].values()]
+            + ([m["wait_p99_s"] for m in var["queue_wait"].values()] if var else [])
+            + [1.0])
+        rows = ""
+        for q, m in base["queue_wait"].items():
+            vm = (var or {}).get("queue_wait", {}).get(q)
+            d = (delta or {}).get("queue_wait", {}).get(q)
+            rows += (
+                f"<tr><td>{html.escape(q)}</td><td>{m['jobs']}</td>"
+                f"<td>{self._whatif_bars(m['wait_p99_s'], vm and vm['wait_p99_s'], scale)}</td>"
+                f"<td>{m['wait_p50_s']:.1f}s"
+                + (f" → {vm['wait_p50_s']:.1f}s" if vm else "") + "</td>"
+                + (f"<td>{d['wait_p50_s_delta']:+.1f}s / "
+                   f"{d['wait_p99_s_delta']:+.1f}s</td>" if d else "<td>—</td>")
+                + "</tr>")
+        body += (
+            "<h3>queue wait: baseline"
+            + (f" vs counterfactual {html.escape(json.dumps(report.get('overrides', {})))}"
+               if var else "") + "</h3>"
+            "<table><tr><th>queue</th><th>jobs</th><th>wait p99 overlay</th>"
+            "<th>p50</th><th>&Delta; p50 / p99</th></tr>" + rows + "</table>")
+        pre = base["preemptions"]
+        body += (
+            f"<p>baseline: {base['completed']}/{base['jobs']} completed, "
+            f"util {base['utilization']:.1%}, {pre['evictions']} eviction(s) "
+            f"({pre['evictions_cooperative']} cooperative / "
+            f"{pre['evictions_killed']} killed), {pre['shrinks']} shrink(s), "
+            f"goodput {base['goodput_s']:.0f}s badput {base['badput_s']:.0f}s</p>")
+        if var and delta:
+            vpre = var["preemptions"]
+            body += (
+                f"<p>counterfactual: {var['completed']}/{var['jobs']} "
+                f"completed, util {var['utilization']:.1%}, "
+                f"{vpre['evictions']} eviction(s), {vpre['shrinks']} "
+                f"shrink(s) — goodput &Delta; {delta['goodput_s_delta']:+.0f}s, "
+                f"badput &Delta; {delta['badput_s_delta']:+.0f}s</p>")
+            for n in report.get("config_notes", []):
+                body += f"<p><small>note: {html.escape(n)}</small></p>"
+        if "sweep" in report:
+            sw = report["sweep"]
+            srows = ""
+            for row in sw["rows"]:
+                m, d = row["metrics"], row["delta"]
+                cells = "".join(
+                    f"<td>{d['queue_wait'][q]['wait_p50_s_delta']:+.1f}s / "
+                    f"{d['queue_wait'][q]['wait_p99_s_delta']:+.1f}s</td>"
+                    for q in base["queue_wait"])
+                srows += (f"<tr><td>{row['value']:g}</td>"
+                          f"<td>{m['preemptions']['evictions']}</td>"
+                          f"<td>{m['preemptions']['shrinks']}</td>{cells}</tr>")
+            heads = "".join(f"<th>{html.escape(q)} &Delta; p50/p99</th>"
+                            for q in base["queue_wait"])
+            body += (
+                f"<h3>sweep over {html.escape(sw['key'])}</h3>"
+                f"<table><tr><th>value</th><th>evictions</th><th>shrinks</th>"
+                f"{heads}</tr>{srows}</table>")
+        decisions = report.get("variant_decisions") or report.get(
+            "baseline_decisions") or []
+        acted = [r for r in decisions if r.get("action") != "deny"]
+        if acted:
+            drows = "".join(
+                f"<tr><td>{r['unix_ms'] / 1000:.1f}s</td>"
+                f"<td>{html.escape(r['action'])}</td>"
+                f"<td>{html.escape(r['app_id'])}</td>"
+                f"<td>{html.escape(r['rule'])}</td>"
+                f"<td>{html.escape(r.get('for_app', ''))}</td></tr>"
+                for r in acted[-20:])
+            body += (
+                "<h3>decision records behind "
+                + ("the counterfactual" if var else "the baseline")
+                + "</h3><p><small>the replay's flight-recorder chain — the "
+                "same vocabulary <code>tony explain</code> serves for the "
+                "live pool</small></p>"
+                "<table><tr><th>t</th><th>action</th><th>app</th>"
+                f"<th>rule</th><th>for</th></tr>{drows}</table>")
+        return _page("pool what-if", body)
+
     def _job_config(self, app_id: str) -> bytes:
         path = self._art(app_id).config_snapshot_path
         if path and os.path.exists(path):
@@ -1059,7 +1274,7 @@ class PortalHandler(BaseHTTPRequestHandler):
 
 def serve(
     history_root: str, port: int = 28080, staging_root: str = "", pool: str = "",
-    history_db: str = "", scrape_ttl_ms: int = 0,
+    history_db: str = "", scrape_ttl_ms: int = 0, pool_journal: str = "",
 ) -> ThreadingHTTPServer:
     import threading
 
@@ -1067,10 +1282,12 @@ def serve(
         "Handler", (PortalHandler,),
         {"history_root": history_root, "staging_root": staging_root,
          "pool_addr": pool, "history_db": history_db,
+         "pool_journal": pool_journal,
          # per-portal scrape cache: handler objects are per-request, so the
          # cache + its lock live on this portal instance's handler class
          "scrape_ttl_ms": int(scrape_ttl_ms), "scrape_cache": {},
-         "scrape_lock": threading.Lock()},
+         "scrape_lock": threading.Lock(),
+         "whatif_cache": {}, "whatif_lock": threading.Lock()},
     )
     server = ThreadingHTTPServer(("0.0.0.0", port), handler)
     return server
@@ -1083,6 +1300,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="staging root holding <app_id>/am_info.json for the "
                         "live view (default: parent of --root)")
     p.add_argument("--pool", default="", help="pool service host:port for /pool")
+    p.add_argument("--pool-journal", default="",
+                   help="pool journal path (tony.pool.journal.file) behind "
+                        "/pool/whatif: what-if replays reconstruct and "
+                        "replay this recorded history server-side")
     p.add_argument("--history-db", default="",
                    help="history-server store behind /history "
                         "(tony.history.store; default <root>/history.sqlite)")
@@ -1108,7 +1329,8 @@ def main(argv: list[str] | None = None) -> int:
             except (OSError, ValueError):
                 ttl = 0
     server = serve(root, args.port, staging, args.pool,
-                   history_db=args.history_db, scrape_ttl_ms=ttl)
+                   history_db=args.history_db, scrape_ttl_ms=ttl,
+                   pool_journal=args.pool_journal)
     obs_logging.info(f"[tony-portal] serving {root} on http://0.0.0.0:{args.port}"
                      + (f" (pool {args.pool})" if args.pool else ""))
     try:
